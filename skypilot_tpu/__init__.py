@@ -1,0 +1,2 @@
+"""skypilot_tpu: a TPU-native cloud orchestration + workload framework."""
+__version__ = '0.1.0'

@@ -1,0 +1,325 @@
+"""GCP catalog: TPU slice offerings + host VM types, prices, zones.
+
+Counterpart of the reference's sky/clouds/service_catalog/gcp_catalog.py
+(:420-553 TPU row handling) and the hosted-CSV cache in
+service_catalog/common.py:29-115.  Differences by design:
+  - TPU offerings are *computed* from the generation topology table
+    (utils/accelerator_registry.py) instead of enumerating thousands of
+    CSV rows: any valid slice shape of a generation is priced as
+    chips x price-per-chip-hour x region multiplier.
+  - Static snapshot of public list prices (2025) with an update hook
+    (`set_pricing_override`) so deployments can refresh without code edits;
+    the reference refreshes by pulling hosted CSVs instead.
+"""
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import accelerator_registry
+
+# ---------------------------------------------------------------------------
+# TPU pricing: $ per chip-hour, on-demand and spot (public list prices,
+# us-central anchors). v2/v3 are priced per-core by GCP; normalized to
+# per-chip here (2 cores/chip).
+# ---------------------------------------------------------------------------
+_TPU_PRICE_PER_CHIP_HOUR: Dict[str, Tuple[float, float]] = {
+    # gen: (on_demand, spot)
+    'v2': (1.125, 0.3375),
+    'v3': (2.00, 0.60),
+    'v4': (3.22, 0.966),
+    'v5e': (1.20, 0.48),
+    'v5p': (4.20, 1.68),
+    'v6e': (2.70, 1.08),
+}
+
+_REGION_PRICE_MULTIPLIER: Dict[str, float] = {
+    'us-central1': 1.0,
+    'us-central2': 1.0,
+    'us-east1': 1.0,
+    'us-east5': 1.0,
+    'us-west1': 1.0,
+    'us-west4': 1.05,
+    'us-south1': 1.05,
+    'europe-west4': 1.10,
+    'asia-east1': 1.15,
+    'asia-northeast1': 1.15,
+}
+
+# Zones where each TPU generation is available (public availability snapshot).
+_TPU_ZONES: Dict[str, List[str]] = {
+    'v2': ['us-central1-b', 'us-central1-c', 'us-central1-f',
+           'europe-west4-a', 'asia-east1-c'],
+    'v3': ['us-central1-a', 'us-central1-b', 'europe-west4-a'],
+    'v4': ['us-central2-b'],
+    'v5e': ['us-central1-a', 'us-west4-a', 'us-east1-c', 'us-east5-b',
+            'europe-west4-b'],
+    'v5p': ['us-east5-a', 'us-central1-a', 'europe-west4-b'],
+    'v6e': ['us-east1-d', 'us-east5-a', 'us-east5-b', 'europe-west4-a',
+            'asia-northeast1-b', 'us-south1-a'],
+}
+
+# Max chips of a single slice per generation (largest public pod slice).
+_TPU_MAX_CHIPS: Dict[str, int] = {
+    'v2': 256, 'v3': 512, 'v4': 4096, 'v5e': 256, 'v5p': 8960, 'v6e': 256,
+}
+
+# ---------------------------------------------------------------------------
+# Host VM types (controllers, CPU-only tasks, GPU VMs). Small static table;
+# per-region multiplier applies.  price = on-demand $/h, spot_price = $/h.
+# ---------------------------------------------------------------------------
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+n2-standard-2,2,8,,0,0.0971,0.0233
+n2-standard-4,4,16,,0,0.1942,0.0466
+n2-standard-8,8,32,,0,0.3885,0.0932
+n2-standard-16,16,64,,0,0.7769,0.1864
+n2-standard-32,32,128,,0,1.5539,0.3729
+n2-highmem-8,8,64,,0,0.5241,0.1258
+e2-standard-2,2,8,,0,0.0670,0.0201
+e2-standard-4,4,16,,0,0.1340,0.0402
+e2-standard-8,8,32,,0,0.2681,0.0804
+a2-highgpu-1g,12,85,A100,1,3.6730,1.1019
+a2-highgpu-8g,96,680,A100,8,29.3838,8.8151
+a2-ultragpu-8g,96,1360,A100-80GB,8,40.5500,12.1650
+g2-standard-4,4,16,L4,1,0.7054,0.2116
+g2-standard-48,48,192,L4,4,2.8216,0.8465
+a3-highgpu-8g,208,1872,H100,8,88.2500,26.4750
+"""
+
+_VM_ZONES = ['us-central1-a', 'us-central1-b', 'us-central2-b', 'us-east1-c',
+             'us-east5-a', 'us-east5-b', 'us-west1-a', 'us-west4-a',
+             'europe-west4-a', 'europe-west4-b', 'asia-east1-c',
+             'asia-northeast1-b', 'us-south1-a', 'us-east1-d',
+             'us-central1-c', 'us-central1-f']
+
+_df: Optional[pd.DataFrame] = None
+_pricing_override: Dict[str, Tuple[float, float]] = {}
+
+
+def _vm_df() -> pd.DataFrame:
+    global _df
+    if _df is None:
+        _df = pd.read_csv(io.StringIO(_VMS_CSV))
+    return _df
+
+
+def set_pricing_override(per_chip: Dict[str, Tuple[float, float]]) -> None:
+    _pricing_override.update(per_chip)
+
+
+def zone_to_region(zone: str) -> str:
+    return zone.rsplit('-', 1)[0]
+
+
+def _region_multiplier(region: Optional[str]) -> float:
+    if region is None:
+        return 1.0
+    return _REGION_PRICE_MULTIPLIER.get(region, 1.1)
+
+
+# ---------------------------------------------------------------------------
+# TPU offerings
+# ---------------------------------------------------------------------------
+def validate_tpu_slice(spec: accelerator_registry.TpuSliceSpec) -> None:
+    gen = spec.generation.name
+    max_chips = _TPU_MAX_CHIPS[gen]
+    if spec.num_chips > max_chips:
+        raise exceptions.ResourcesValidationError(
+            f'{spec.accelerator_name}: {spec.num_chips} chips exceeds the '
+            f'largest {gen} slice ({max_chips} chips).')
+    if spec.num_chips > 1 and spec.num_chips % 2 != 0:
+        raise exceptions.ResourcesValidationError(
+            f'{spec.accelerator_name}: chip count must be even.')
+
+
+def tpu_zones(gen: str, region: Optional[str] = None,
+              zone: Optional[str] = None) -> List[str]:
+    zones = _TPU_ZONES.get(gen, [])
+    if region is not None:
+        zones = [z for z in zones if zone_to_region(z) == region]
+    if zone is not None:
+        zones = [z for z in zones if z == zone]
+    return zones
+
+
+def tpu_regions(gen: str) -> List[str]:
+    return sorted({zone_to_region(z) for z in _TPU_ZONES.get(gen, [])})
+
+
+def get_tpu_hourly_cost(spec: accelerator_registry.TpuSliceSpec,
+                        use_spot: bool,
+                        region: Optional[str] = None,
+                        zone: Optional[str] = None) -> float:
+    gen = spec.generation.name
+    if zone is not None and region is None:
+        region = zone_to_region(zone)
+    od, spot = _pricing_override.get(gen, _TPU_PRICE_PER_CHIP_HOUR[gen])
+    per_chip = spot if use_spot else od
+    return per_chip * spec.num_chips * _region_multiplier(region)
+
+
+def tpu_supports_spot(gen: str) -> bool:
+    return True  # All current generations offer preemptible/spot capacity.
+
+
+# ---------------------------------------------------------------------------
+# VM offerings
+# ---------------------------------------------------------------------------
+def instance_type_exists(instance_type: str) -> bool:
+    if instance_type == 'TPU-VM':
+        return True
+    return instance_type in set(_vm_df()['instance_type'])
+
+
+def get_hourly_cost(instance_type: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    if instance_type == 'TPU-VM':
+        # TPU-VM host cost is bundled into the accelerator price (same
+        # modeling as the reference, sky/clouds/gcp.py:600-651).
+        return 0.0
+    df = _vm_df()
+    rows = df[df['instance_type'] == instance_type]
+    if rows.empty:
+        raise exceptions.ResourcesValidationError(
+            f'Unknown GCP instance type {instance_type!r}.')
+    if zone is not None and region is None:
+        region = zone_to_region(zone)
+    price = rows.iloc[0]['spot_price' if use_spot else 'price']
+    return float(price) * _region_multiplier(region)
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    if instance_type == 'TPU-VM':
+        return None, None
+    df = _vm_df()
+    rows = df[df['instance_type'] == instance_type]
+    if rows.empty:
+        return None, None
+    return float(rows.iloc[0]['vcpus']), float(rows.iloc[0]['memory_gb'])
+
+
+def get_accelerators_from_instance_type(
+        instance_type: str) -> Optional[Dict[str, int]]:
+    df = _vm_df()
+    rows = df[df['instance_type'] == instance_type]
+    if rows.empty or not isinstance(rows.iloc[0]['accelerator_name'], str):
+        return None
+    name = rows.iloc[0]['accelerator_name']
+    if not name:
+        return None
+    return {name: int(rows.iloc[0]['accelerator_count'])}
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None,
+                              disk_tier: Optional[str] = None
+                              ) -> Optional[str]:
+    """Cheapest VM meeting the cpu/memory request. '8+' means >= 8; plain
+    '8' means exactly 8 (reference resources semantics)."""
+    del disk_tier
+    df = _vm_df()
+    df = df[df['accelerator_count'] == 0]
+    if cpus is None and memory is None:
+        cpus = '8'
+
+    def _match(series: pd.Series, request: Optional[str]) -> pd.Series:
+        if request is None:
+            return pd.Series(True, index=series.index)
+        if request.endswith('+'):
+            return series >= float(request[:-1])
+        if request.endswith('x'):  # memory = Nx vcpus form
+            return pd.Series(True, index=series.index)
+        return series == float(request)
+
+    mask = _match(df['vcpus'], cpus) & _match(df['memory_gb'], memory)
+    if memory is not None and memory.endswith('x'):
+        factor = float(memory[:-1])
+        mask &= df['memory_gb'] >= df['vcpus'] * factor
+    candidates = df[mask].sort_values('price')
+    if candidates.empty:
+        return None
+    return str(candidates.iloc[0]['instance_type'])
+
+
+def get_instance_type_for_accelerator(
+        acc_name: str, acc_count: int) -> Optional[List[str]]:
+    df = _vm_df()
+    rows = df[(df['accelerator_name'] == acc_name) &
+              (df['accelerator_count'] == acc_count)]
+    if rows.empty:
+        return None
+    return list(rows.sort_values('price')['instance_type'])
+
+
+def get_accelerator_hourly_cost(acc_name: str, acc_count: int, use_spot: bool,
+                                region: Optional[str] = None,
+                                zone: Optional[str] = None) -> float:
+    if acc_name.lower().startswith('tpu-'):
+        spec = accelerator_registry.parse_tpu_accelerator(acc_name, acc_count)
+        return get_tpu_hourly_cost(spec, use_spot, region, zone)
+    # GPU prices are bundled in their host instance types (a2/g2/a3).
+    return 0.0
+
+
+def vm_zones(region: Optional[str] = None,
+             zone: Optional[str] = None) -> List[str]:
+    zones = list(_VM_ZONES)
+    if region is not None:
+        zones = [z for z in zones if zone_to_region(z) == region]
+    if zone is not None:
+        zones = [z for z in zones if z == zone]
+    return zones
+
+
+def list_accelerators(
+        name_filter: Optional[str] = None
+) -> Dict[str, List[Dict[str, object]]]:
+    """Inventory for `show-tpus` (reference: `sky show-gpus`,
+    service_catalog.list_accelerators)."""
+    out: Dict[str, List[Dict[str, object]]] = {}
+    for gen_key, gen in accelerator_registry.TPU_GENERATIONS.items():
+        base = 8 if not gen.counts_chips else 4
+        sizes: List[int] = []
+        n = base
+        while True:
+            spec = accelerator_registry.parse_tpu_accelerator(
+                f'tpu-{gen_key}-{n}')
+            if spec.num_chips > _TPU_MAX_CHIPS[gen_key]:
+                break
+            sizes.append(n)
+            n *= 2
+        for n in sizes:
+            spec = accelerator_registry.parse_tpu_accelerator(
+                f'tpu-{gen_key}-{n}')
+            name = spec.accelerator_name
+            if name_filter and name_filter.lower() not in name:
+                continue
+            out.setdefault(name, []).append({
+                'accelerator_name': name,
+                'chips': spec.num_chips,
+                'hosts': spec.num_hosts,
+                'hbm_gb': spec.total_hbm_gb,
+                'bf16_tflops': spec.total_bf16_tflops,
+                'price': get_tpu_hourly_cost(spec, False),
+                'spot_price': get_tpu_hourly_cost(spec, True),
+                'regions': tpu_regions(gen_key),
+            })
+    df = _vm_df()
+    for _, row in df[df['accelerator_count'] > 0].iterrows():
+        name = f"{row['accelerator_name']}:{int(row['accelerator_count'])}"
+        if name_filter and name_filter.lower() not in name.lower():
+            continue
+        out.setdefault(name, []).append({
+            'accelerator_name': row['accelerator_name'],
+            'count': int(row['accelerator_count']),
+            'instance_type': row['instance_type'],
+            'price': float(row['price']),
+            'spot_price': float(row['spot_price']),
+        })
+    return out

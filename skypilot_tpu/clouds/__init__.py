@@ -1,0 +1,14 @@
+"""Cloud capability models (reference: sky/clouds/)."""
+from skypilot_tpu.clouds.cloud import (Cloud, CloudImplementationFeatures,
+                                       FeasibleResources, Region, Zone)
+from skypilot_tpu.clouds.registry import CLOUD_REGISTRY
+
+# Importing the modules registers the clouds.
+from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.fake import Fake, fake_cloud_state
+from skypilot_tpu.clouds.local import Local
+
+__all__ = [
+    'Cloud', 'CloudImplementationFeatures', 'FeasibleResources', 'Region',
+    'Zone', 'CLOUD_REGISTRY', 'GCP', 'Fake', 'Local', 'fake_cloud_state',
+]

@@ -1,0 +1,233 @@
+"""Cloud capability model.
+
+Counterpart of the reference's abstract Cloud (sky/clouds/cloud.py:117) with
+its `CloudImplementationFeatures` enum (:29-50), Region/Zone records
+(:51-67) and the `zones_provision_loop` failover iterator (:188).  The TPU
+twist: feasibility and deploy-variable generation understand *slices* — a
+request for `tpu-v5p-128` is one logical node backed by 16 host VMs that
+must be created/destroyed atomically by the provisioner.
+"""
+from __future__ import annotations
+
+import collections
+import enum
+import typing
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class CloudImplementationFeatures(enum.Enum):
+    """Features a cloud impl may lack for specific resources; the optimizer
+    and provisioner consult these to filter/fail early (reference
+    sky/clouds/cloud.py:29-50)."""
+    STOP = 'stop'
+    MULTI_NODE = 'multi-node'
+    CLONE_DISK = 'clone_disk'
+    IMAGE_ID = 'image_id'
+    DOCKER_IMAGE = 'docker_image'
+    SPOT_INSTANCE = 'spot_instance'
+    CUSTOM_DISK_TIER = 'custom_disk_tier'
+    OPEN_PORTS = 'open_ports'
+    STORAGE_MOUNTING = 'storage_mounting'
+    HOST_CONTROLLERS = 'host_controllers'
+    AUTOSTOP = 'autostop'
+
+
+class Region(NamedTuple):
+    name: str
+
+    def __str__(self) -> str:  # noqa: D105
+        return self.name
+
+
+class Zone(NamedTuple):
+    name: str
+    region: str
+
+    def __str__(self) -> str:  # noqa: D105
+        return self.name
+
+
+class FeasibleResources(NamedTuple):
+    """Result of get_feasible_launchable_resources (reference
+    sky/clouds/cloud.py FeasibleResources)."""
+    resources_list: List['resources_lib.Resources']
+    fuzzy_candidate_list: List[str]
+    hint: Optional[str]
+
+
+class Cloud:
+    """Abstract per-cloud capability model. Subclasses register themselves
+    into CLOUD_REGISTRY (clouds/registry.py)."""
+
+    _REPR = 'Cloud'
+    # Name of the provisioner module under skypilot_tpu/provision/.
+    PROVISIONER_MODULE = ''
+    # Max length for cluster names on this cloud's APIs.
+    MAX_CLUSTER_NAME_LEN_LIMIT: Optional[int] = None
+    OPEN_PORTS_VERSION = 1
+
+    # ---- identity --------------------------------------------------------
+    @classmethod
+    def canonical_name(cls) -> str:
+        return cls._REPR.lower()
+
+    def __repr__(self) -> str:
+        return self._REPR
+
+    def is_same_cloud(self, other: Optional['Cloud']) -> bool:
+        return other is not None and self.canonical_name() == \
+            other.canonical_name()
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Cloud) and self.is_same_cloud(other)
+
+    def __hash__(self) -> int:
+        return hash(self.canonical_name())
+
+    # ---- capability ------------------------------------------------------
+    @classmethod
+    def _unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[CloudImplementationFeatures, str]:
+        raise NotImplementedError
+
+    @classmethod
+    def check_features_are_supported(
+        cls, resources: 'resources_lib.Resources',
+        requested_features: set) -> None:
+        unsupported = cls._unsupported_features_for_resources(resources)
+        offenders = requested_features & set(unsupported)
+        if offenders:
+            table = '; '.join(
+                f'{f.value}: {unsupported[f]}' for f in offenders)
+            raise exceptions.NotSupportedError(
+                f'{cls._REPR} does not support the requested features for '
+                f'{resources}: {table}')
+
+    # ---- regions/zones ---------------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[Region]:
+        raise NotImplementedError
+
+    @classmethod
+    def zones_provision_loop(
+        cls, *, region: str, num_nodes: int,
+        instance_type: str,
+        accelerators: Optional[Dict[str, int]] = None,
+        use_spot: bool = False,
+    ) -> Iterator[Optional[List[Zone]]]:
+        """Yield zone groups to try, in order, within `region`.
+
+        Each yielded list is one provisioning attempt; yielding None means
+        the cloud is region-scoped (no zone concept).  Reference:
+        sky/clouds/cloud.py:188 zones_provision_loop.
+        """
+        raise NotImplementedError
+
+    @classmethod
+    def validate_region_zone(cls, region: Optional[str],
+                             zone: Optional[str]) -> bool:
+        try:
+            regions = cls.regions_with_offering(None, None, False, region,
+                                                zone)
+        except NotImplementedError:
+            return True
+        return len(regions) > 0
+
+    # ---- pricing ---------------------------------------------------------
+    @classmethod
+    def instance_type_to_hourly_cost(cls, instance_type: str, use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        raise NotImplementedError
+
+    @classmethod
+    def accelerators_to_hourly_cost(cls, accelerators: Dict[str, int],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        raise NotImplementedError
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        return 0.0
+
+    # ---- instance types --------------------------------------------------
+    @classmethod
+    def instance_type_exists(cls, instance_type: str) -> bool:
+        raise NotImplementedError
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        raise NotImplementedError
+
+    @classmethod
+    def get_default_instance_type(
+            cls, cpus: Optional[str] = None, memory: Optional[str] = None,
+            disk_tier: Optional[str] = None) -> Optional[str]:
+        raise NotImplementedError
+
+    @classmethod
+    def get_accelerators_from_instance_type(
+            cls, instance_type: str) -> Optional[Dict[str, int]]:
+        return None
+
+    # ---- feasibility (optimizer entry point) -----------------------------
+    @classmethod
+    def get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources',
+        num_nodes: int = 1) -> FeasibleResources:
+        """Concretize partial Resources into launchable candidates on this
+        cloud (reference cloud.get_feasible_launchable_resources)."""
+        if resources.is_launchable():
+            return FeasibleResources([resources], [], None)
+        return cls._get_feasible_launchable_resources(resources, num_nodes)
+
+    @classmethod
+    def _get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources',
+        num_nodes: int) -> FeasibleResources:
+        raise NotImplementedError
+
+    # ---- deploy ----------------------------------------------------------
+    @classmethod
+    def make_deploy_resources_variables(
+            cls, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: Region,
+            zones: Optional[List[Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # ---- credentials -----------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        raise NotImplementedError
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        """Active identities; first is the current one. None = no concept."""
+        return None
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        return {}
+
+    # ---- misc ------------------------------------------------------------
+    @classmethod
+    def query_status(cls, name: str, tag_filters: Dict[str, str],
+                     region: Optional[str], zone: Optional[str]) -> List[Any]:
+        raise NotImplementedError
+
+    @classmethod
+    def expand_infras(cls) -> List[str]:
+        return [cls.canonical_name()]

@@ -1,0 +1,303 @@
+"""FakeCloud: an in-process cloud for hermetic tests.
+
+The reference has no fake-cloud simulator — its unit tests stop at the
+optimizer/dryrun boundary and everything past `bulk_provision` needs a real
+cloud (SURVEY.md §4).  This cloud plus `provision/fake/` closes that gap:
+the whole provision → failover → recover → autoscale machinery is testable
+in-process.  Capacity and failures are injected via `fake_cloud_state()`:
+
+    state = fake.fake_cloud_state()
+    state.set_zone_capacity('fake-a-1', 0)        # exhaust a zone
+    state.fail_next('fake-b-1', ProvisionError)   # one-shot fault
+    state.preempt_cluster('mycluster')            # spot preemption
+
+FakeCloud offers every TPU slice shape (so slice-level gang/failover tests
+run without GCP) plus simple CPU instance types.
+"""
+from __future__ import annotations
+
+import threading
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds.registry import CLOUD_REGISTRY
+from skypilot_tpu.utils import accelerator_registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_REGIONS = ['fake-a', 'fake-b', 'fake-c']
+_ZONES_PER_REGION = 2
+# Region price multipliers so the optimizer has real choices to make.
+_REGION_MULT = {'fake-a': 1.0, 'fake-b': 1.2, 'fake-c': 1.5}
+
+_INSTANCE_TYPES: Dict[str, Tuple[float, float, float]] = {
+    # name: (vcpus, memory_gb, $/h)
+    'fake-cpu-2': (2, 8, 0.08),
+    'fake-cpu-8': (8, 32, 0.32),
+    'fake-cpu-32': (32, 128, 1.28),
+    'TPU-VM': (96, 192, 0.0),
+}
+_SPOT_DISCOUNT = 0.3  # spot price = 30% of on-demand
+_TPU_PER_CHIP = 1.0
+
+
+class FakeCloudState:
+    """Injectable control-plane state shared with provision/fake."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.zone_capacity: Dict[str, int] = {}       # zone -> slots left
+        self.one_shot_failures: Dict[str, List[Exception]] = {}
+        self.persistent_failures: Dict[str, Exception] = {}
+        self.instances: Dict[str, Dict[str, Any]] = {}  # id -> record
+        self.provision_delay_s: float = 0.0
+        self._counter = 0
+
+    def reset(self) -> None:
+        with self._lock:
+            self.zone_capacity.clear()
+            self.one_shot_failures.clear()
+            self.persistent_failures.clear()
+            self.instances.clear()
+            self.provision_delay_s = 0.0
+            self._counter = 0
+
+    # -- fault injection ---------------------------------------------------
+    def set_zone_capacity(self, zone: str, capacity: int) -> None:
+        with self._lock:
+            self.zone_capacity[zone] = capacity
+
+    def fail_next(self, zone: str, error: Exception) -> None:
+        with self._lock:
+            self.one_shot_failures.setdefault(zone, []).append(error)
+
+    def fail_always(self, zone: str, error: Exception) -> None:
+        with self._lock:
+            self.persistent_failures[zone] = error
+
+    def clear_failures(self, zone: Optional[str] = None) -> None:
+        with self._lock:
+            if zone is None:
+                self.one_shot_failures.clear()
+                self.persistent_failures.clear()
+            else:
+                self.one_shot_failures.pop(zone, None)
+                self.persistent_failures.pop(zone, None)
+
+    def preempt_cluster(self, cluster_name_on_cloud: str) -> int:
+        """Mark all spot instances of a cluster TERMINATED (spot preemption
+        fault injection — the reference does this by literally terminating
+        cloud instances in smoke tests, SURVEY.md §5)."""
+        n = 0
+        with self._lock:
+            for rec in self.instances.values():
+                if (rec['cluster'] == cluster_name_on_cloud and
+                        rec['status'] == 'running'):
+                    rec['status'] = 'terminated'
+                    rec['preempted'] = True
+                    n += 1
+        return n
+
+    def stop_cluster_instances(self, cluster_name_on_cloud: str) -> None:
+        with self._lock:
+            for rec in self.instances.values():
+                if rec['cluster'] == cluster_name_on_cloud:
+                    rec['status'] = 'stopped'
+
+    # -- control plane used by provision/fake ------------------------------
+    def next_id(self) -> str:
+        with self._lock:
+            self._counter += 1
+            return f'fake-inst-{self._counter}'
+
+    def check_and_take_capacity(self, zone: str, count: int) -> None:
+        from skypilot_tpu import exceptions
+        with self._lock:
+            if zone in self.persistent_failures:
+                raise self.persistent_failures[zone]
+            if self.one_shot_failures.get(zone):
+                raise self.one_shot_failures[zone].pop(0)
+            cap = self.zone_capacity.get(zone)
+            if cap is not None:
+                if cap < count:
+                    raise exceptions.ProvisionError(
+                        f'FakeCloud: zone {zone} out of capacity '
+                        f'(requested {count}, available {cap}).')
+                self.zone_capacity[zone] = cap - count
+
+
+_STATE = FakeCloudState()
+
+
+def fake_cloud_state() -> FakeCloudState:
+    return _STATE
+
+
+def _all_zones() -> List[str]:
+    return [f'{r}-{i + 1}' for r in _REGIONS
+            for i in range(_ZONES_PER_REGION)]
+
+
+@CLOUD_REGISTRY.register()
+class Fake(cloud.Cloud):
+    """In-process simulated cloud (tests + demos; no real execution)."""
+
+    _REPR = 'Fake'
+    PROVISIONER_MODULE = 'fake'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 64
+
+    @classmethod
+    def _unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        unsupported: Dict[cloud.CloudImplementationFeatures, str] = {
+            cloud.CloudImplementationFeatures.CLONE_DISK:
+                'FakeCloud has no disks.',
+        }
+        spec = resources.tpu_slice
+        if spec is not None and spec.is_pod:
+            unsupported[cloud.CloudImplementationFeatures.STOP] = (
+                'TPU pod slices cannot be stopped (parity with GCP).')
+        return unsupported
+
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del instance_type, accelerators, use_spot
+        regions = list(_REGIONS)
+        if region is not None:
+            regions = [r for r in regions if r == region]
+        if zone is not None:
+            regions = [r for r in regions
+                       if any(z == zone for z in _all_zones()
+                              if z.startswith(r))]
+        return [cloud.Region(r) for r in regions]
+
+    @classmethod
+    def zones_provision_loop(
+        cls, *, region: str, num_nodes: int, instance_type: str,
+        accelerators: Optional[Dict[str, int]] = None,
+        use_spot: bool = False,
+    ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del num_nodes, instance_type, accelerators, use_spot
+        for i in range(_ZONES_PER_REGION):
+            yield [cloud.Zone(f'{region}-{i + 1}', region)]
+
+    @classmethod
+    def instance_type_to_hourly_cost(cls, instance_type: str, use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        if zone is not None and region is None:
+            region = zone.rsplit('-', 1)[0]
+        base = _INSTANCE_TYPES[instance_type][2]
+        if use_spot:
+            base *= _SPOT_DISCOUNT
+        return base * _REGION_MULT.get(region or 'fake-a', 1.0)
+
+    @classmethod
+    def accelerators_to_hourly_cost(cls, accelerators: Dict[str, int],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        (name, count), = accelerators.items()
+        if zone is not None and region is None:
+            region = zone.rsplit('-', 1)[0]
+        mult = _REGION_MULT.get(region or 'fake-a', 1.0)
+        if name.lower().startswith('tpu-'):
+            spec = accelerator_registry.parse_tpu_accelerator(name, count)
+            base = spec.num_chips * _TPU_PER_CHIP
+        else:
+            base = 2.0 * count
+        if use_spot:
+            base *= _SPOT_DISCOUNT
+        return base * mult
+
+    @classmethod
+    def instance_type_exists(cls, instance_type: str) -> bool:
+        return instance_type in _INSTANCE_TYPES
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        vcpus, mem, _ = _INSTANCE_TYPES[instance_type]
+        return float(vcpus), float(mem)
+
+    @classmethod
+    def get_default_instance_type(
+            cls, cpus: Optional[str] = None, memory: Optional[str] = None,
+            disk_tier: Optional[str] = None) -> Optional[str]:
+        del disk_tier
+
+        def ok(req: Optional[str], have: float) -> bool:
+            if req is None:
+                return True
+            if req.endswith('+'):
+                return have >= float(req[:-1])
+            return have == float(req)
+
+        for name, (vcpus, mem, _) in sorted(_INSTANCE_TYPES.items(),
+                                            key=lambda kv: kv[1][2]):
+            if name == 'TPU-VM':
+                continue
+            if ok(cpus, vcpus) and ok(memory, mem):
+                return name
+        return None
+
+    @classmethod
+    def _get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources',
+        num_nodes: int) -> cloud.FeasibleResources:
+        del num_nodes
+        if resources.tpu_slice is not None:
+            return cloud.FeasibleResources(
+                [resources.copy(cloud=cls(), instance_type='TPU-VM')], [],
+                None)
+        if resources.accelerators is not None:
+            # Any GPU accelerator maps onto the biggest CPU shape.
+            return cloud.FeasibleResources(
+                [resources.copy(cloud=cls(), instance_type='fake-cpu-32')],
+                [], None)
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = cls.get_default_instance_type(
+                resources.cpus, resources.memory)
+        if instance_type is None:
+            return cloud.FeasibleResources(
+                [], list(_INSTANCE_TYPES), 'No fake instance type fits.')
+        return cloud.FeasibleResources(
+            [resources.copy(cloud=cls(), instance_type=instance_type)], [],
+            None)
+
+    @classmethod
+    def make_deploy_resources_variables(
+            cls, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        assert zones
+        spec = resources.tpu_slice
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': zones[0].name,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'num_nodes': num_nodes,
+            'tpu_vm': spec is not None,
+            'tpu_type': spec.gcp_accelerator_type if spec else None,
+            'num_tpu_hosts': spec.num_hosts if spec else 1,
+            'chips_per_host': spec.chips_per_host if spec else 0,
+        }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        return [['fake-user']]

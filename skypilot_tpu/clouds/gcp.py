@@ -1,0 +1,278 @@
+"""GCP cloud with TPU pod slices as the primary offering.
+
+Counterpart of the reference's sky/clouds/gcp.py:1-1230, but TPU-first:
+where the reference bolts TPU support onto a GPU-VM cloud
+(gcp.py:460-651), here the slice is the native unit — feasibility, deploy
+variables and feature gating all route through `TpuSliceSpec`.
+
+Reference behaviors preserved:
+  - STOP unsupported for TPU pods; preempted TPU VMs require deletion
+    (gcp.py:193-204, resources.py:633).
+  - TPU resources use pseudo instance type 'TPU-VM' whose host shape comes
+    from the generation table (gcp.py:600-651 hard-codes 96/240 vCPUs).
+  - deploy variables carry tpu_type / runtime_version / tpu_name
+    (gcp.py:460-539).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.catalog import gcp_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds.registry import CLOUD_REGISTRY
+from skypilot_tpu.utils import accelerator_registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_DEFAULT_CPU_IMAGE = 'projects/debian-cloud/global/images/family/debian-12'
+_CREDENTIAL_HINT = (
+    'GCP credentials not found. Run `gcloud auth application-default login` '
+    'or set GOOGLE_APPLICATION_CREDENTIALS.')
+
+
+@CLOUD_REGISTRY.register(aliases=['google', 'gce'])
+class GCP(cloud.Cloud):
+    """Google Cloud Platform (TPU slices + GCE VMs)."""
+
+    _REPR = 'GCP'
+    PROVISIONER_MODULE = 'gcp'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 35
+
+    @classmethod
+    def _unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        unsupported: Dict[cloud.CloudImplementationFeatures, str] = {}
+        spec = resources.tpu_slice
+        if spec is not None:
+            if spec.is_pod:
+                unsupported[cloud.CloudImplementationFeatures.STOP] = (
+                    'TPU pod slices cannot be stopped; only terminated '
+                    '(multi-host slices have no stop API).')
+                unsupported[cloud.CloudImplementationFeatures.AUTOSTOP] = (
+                    'Autostop is implemented as autodown for TPU pods.')
+            unsupported[cloud.CloudImplementationFeatures.CLONE_DISK] = (
+                'TPU VMs do not support disk cloning.')
+            unsupported[cloud.CloudImplementationFeatures.IMAGE_ID] = (
+                'TPU VMs use runtime versions, not custom images; set '
+                'accelerator_args.runtime_version instead.')
+        return unsupported
+
+    # ---- regions/zones ---------------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del use_spot
+        if accelerators and accelerator_registry.is_tpu(accelerators):
+            (name, count), = accelerators.items()
+            spec = accelerator_registry.parse_tpu_accelerator(name, count)
+            zones = gcp_catalog.tpu_zones(spec.generation.name, region, zone)
+        else:
+            zones = gcp_catalog.vm_zones(region, zone)
+        regions = sorted({gcp_catalog.zone_to_region(z) for z in zones})
+        return [cloud.Region(r) for r in regions]
+
+    @classmethod
+    def zones_provision_loop(
+        cls, *, region: str, num_nodes: int, instance_type: str,
+        accelerators: Optional[Dict[str, int]] = None,
+        use_spot: bool = False,
+    ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del num_nodes, use_spot
+        if accelerators and accelerator_registry.is_tpu(accelerators):
+            (name, count), = accelerators.items()
+            spec = accelerator_registry.parse_tpu_accelerator(name, count)
+            zones = gcp_catalog.tpu_zones(spec.generation.name, region)
+        else:
+            zones = gcp_catalog.vm_zones(region)
+        # GCP provisions one zone at a time (reference gcp.py: zones are
+        # tried individually in the failover loop).
+        for z in zones:
+            yield [cloud.Zone(z, region)]
+
+    # ---- pricing ---------------------------------------------------------
+    @classmethod
+    def instance_type_to_hourly_cost(cls, instance_type: str, use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        return gcp_catalog.get_hourly_cost(instance_type, use_spot, region,
+                                           zone)
+
+    @classmethod
+    def accelerators_to_hourly_cost(cls, accelerators: Dict[str, int],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        (name, count), = accelerators.items()
+        return gcp_catalog.get_accelerator_hourly_cost(
+            name, count, use_spot, region, zone)
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        # Tiered internet egress (reference sky/clouds/gcp.py get_egress_cost).
+        if num_gigabytes <= 0:
+            return 0.0
+        if num_gigabytes <= 1024:
+            return 0.12 * num_gigabytes
+        if num_gigabytes <= 10240:
+            return 0.11 * num_gigabytes
+        return 0.08 * num_gigabytes
+
+    # ---- instance types --------------------------------------------------
+    @classmethod
+    def instance_type_exists(cls, instance_type: str) -> bool:
+        return gcp_catalog.instance_type_exists(instance_type)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return gcp_catalog.get_vcpus_mem_from_instance_type(instance_type)
+
+    @classmethod
+    def get_default_instance_type(
+            cls, cpus: Optional[str] = None, memory: Optional[str] = None,
+            disk_tier: Optional[str] = None) -> Optional[str]:
+        return gcp_catalog.get_default_instance_type(cpus, memory, disk_tier)
+
+    @classmethod
+    def get_accelerators_from_instance_type(
+            cls, instance_type: str) -> Optional[Dict[str, int]]:
+        return gcp_catalog.get_accelerators_from_instance_type(instance_type)
+
+    # ---- feasibility -----------------------------------------------------
+    @classmethod
+    def _get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources',
+        num_nodes: int) -> cloud.FeasibleResources:
+        del num_nodes
+        spec = resources.tpu_slice
+        if spec is not None:
+            gcp_catalog.validate_tpu_slice(spec)
+            zones = gcp_catalog.tpu_zones(spec.generation.name,
+                                          resources.region, resources.zone)
+            if not zones:
+                return cloud.FeasibleResources(
+                    [], [],
+                    f'{spec.accelerator_name} is not offered in '
+                    f'region={resources.region} zone={resources.zone}. '
+                    f'Available regions: '
+                    f'{gcp_catalog.tpu_regions(spec.generation.name)}')
+            r = resources.copy(cloud=cls(), instance_type='TPU-VM')
+            return cloud.FeasibleResources([r], [], None)
+
+        if resources.accelerators is not None:
+            (acc, acc_count), = resources.accelerators.items()
+            instance_types = gcp_catalog.get_instance_type_for_accelerator(
+                acc, acc_count)
+            if not instance_types:
+                fuzzy = [
+                    f'{name} (GCP)'
+                    for name in gcp_catalog.list_accelerators(acc[:4])
+                ]
+                return cloud.FeasibleResources([], fuzzy[:5], None)
+            return cloud.FeasibleResources(
+                [resources.copy(cloud=cls(), instance_type=it)
+                 for it in instance_types], [], None)
+
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = cls.get_default_instance_type(
+                resources.cpus, resources.memory, resources.disk_tier)
+        if instance_type is None:
+            return cloud.FeasibleResources(
+                [], [], 'No GCP instance type satisfies '
+                f'cpus={resources.cpus} memory={resources.memory}.')
+        return cloud.FeasibleResources(
+            [resources.copy(cloud=cls(), instance_type=instance_type)], [],
+            None)
+
+    # ---- deploy ----------------------------------------------------------
+    @classmethod
+    def make_deploy_resources_variables(
+            cls, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        assert zones, 'GCP provisioning requires zones'
+        zone = zones[0].name
+        spec = resources.tpu_slice
+        variables: Dict[str, Any] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': zone,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'disk_tier': resources.disk_tier or 'medium',
+            'labels': resources.labels or {},
+            'num_nodes': num_nodes,
+            'ports': resources.ports,
+        }
+        if spec is not None:
+            args = resources.accelerator_args or {}
+            variables.update({
+                'tpu_vm': True,
+                'tpu_type': spec.gcp_accelerator_type,
+                'tpu_generation': spec.generation.name,
+                'runtime_version': args.get(
+                    'runtime_version', spec.default_runtime_version()),
+                'tpu_name': args.get('tpu_name', cluster_name_on_cloud),
+                'tpu_topology': args.get('topology'),
+                'num_tpu_hosts': spec.num_hosts,
+                'chips_per_host': spec.chips_per_host,
+            })
+        else:
+            variables.update({
+                'tpu_vm': False,
+                'image_id': resources.image_id or _DEFAULT_CPU_IMAGE,
+                'accelerators': resources.accelerators,
+            })
+        return variables
+
+    # ---- credentials -----------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        adc = os.path.expanduser(
+            '~/.config/gcloud/application_default_credentials.json')
+        if os.environ.get('GOOGLE_APPLICATION_CREDENTIALS') or \
+                os.path.exists(adc):
+            return True, None
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'auth', 'list',
+                 '--filter=status:ACTIVE', '--format=value(account)'],
+                capture_output=True, text=True, timeout=15, check=False)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return True, None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            pass
+        return False, _CREDENTIAL_HINT
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'config', 'list', '--format=value(core.account)'],
+                capture_output=True, text=True, timeout=15, check=False)
+            account = proc.stdout.strip()
+            if account:
+                return [[account]]
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            pass
+        return None
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        mounts = {}
+        gcloud_dir = os.path.expanduser('~/.config/gcloud')
+        if os.path.isdir(gcloud_dir):
+            mounts['~/.config/gcloud'] = '~/.config/gcloud'
+        return mounts

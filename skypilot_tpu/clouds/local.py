@@ -1,0 +1,143 @@
+"""LocalCloud: clusters as processes on this machine.
+
+Counterpart of the reference's `sky local`/LocalDockerBackend escape hatch
+(sky/backends/local_docker_backend.py) but promoted to a full Cloud: a
+"cluster" is a directory under ~/.skytpu/local_clusters/<name>/ with one
+sub-root per simulated host, and the gang launcher runs real processes with
+the full rank/env contract.  This is both a user feature (iterate on a
+laptop or on a TPU VM you already own, incl. the live single-chip TPU in
+this environment) and the substrate for hermetic end-to-end tests.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds.registry import CLOUD_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_LOCAL_PRICE_PER_HOUR = 0.0
+
+
+@CLOUD_REGISTRY.register(aliases=['localhost'])
+class Local(cloud.Cloud):
+
+    _REPR = 'Local'
+    PROVISIONER_MODULE = 'local'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 64
+
+    @classmethod
+    def _unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        del resources
+        return {
+            cloud.CloudImplementationFeatures.SPOT_INSTANCE:
+                'Local machines cannot be preempted.',
+            cloud.CloudImplementationFeatures.CLONE_DISK:
+                'No disks to clone locally.',
+            cloud.CloudImplementationFeatures.IMAGE_ID:
+                'No machine images locally.',
+        }
+
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del instance_type, accelerators
+        if use_spot:
+            return []
+        if region not in (None, 'local'):
+            return []
+        if zone not in (None, 'local'):
+            return []
+        return [cloud.Region('local')]
+
+    @classmethod
+    def zones_provision_loop(
+        cls, *, region: str, num_nodes: int, instance_type: str,
+        accelerators: Optional[Dict[str, int]] = None,
+        use_spot: bool = False,
+    ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del region, num_nodes, instance_type, accelerators, use_spot
+        yield [cloud.Zone('local', 'local')]
+
+    @classmethod
+    def instance_type_to_hourly_cost(cls, instance_type: str, use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        return _LOCAL_PRICE_PER_HOUR
+
+    @classmethod
+    def accelerators_to_hourly_cost(cls, accelerators: Dict[str, int],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        return _LOCAL_PRICE_PER_HOUR
+
+    @classmethod
+    def instance_type_exists(cls, instance_type: str) -> bool:
+        return instance_type == 'localhost'
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        try:
+            vcpus = float(os.cpu_count() or 1)
+        except OSError:
+            vcpus = 1.0
+        return vcpus, None
+
+    @classmethod
+    def get_default_instance_type(
+            cls, cpus: Optional[str] = None, memory: Optional[str] = None,
+            disk_tier: Optional[str] = None) -> Optional[str]:
+        return 'localhost'
+
+    @classmethod
+    def _get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources',
+        num_nodes: int) -> cloud.FeasibleResources:
+        del num_nodes
+        if resources.use_spot:
+            return cloud.FeasibleResources(
+                [], [], 'Local machines cannot be spot instances.')
+        # Accelerator requests are allowed: the local machine may be a TPU
+        # VM (this environment has one live chip); feasibility of the chip
+        # count is the user's responsibility.
+        return cloud.FeasibleResources(
+            [resources.copy(cloud=cls(), instance_type='localhost')], [],
+            None)
+
+    @classmethod
+    def make_deploy_resources_variables(
+            cls, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        spec = resources.tpu_slice
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': 'local',
+            'zone': 'local',
+            'instance_type': 'localhost',
+            'use_spot': False,
+            'num_nodes': num_nodes,
+            'tpu_vm': spec is not None,
+            'num_tpu_hosts': spec.num_hosts if spec else 1,
+            'chips_per_host': spec.chips_per_host if spec else 0,
+        }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        return None

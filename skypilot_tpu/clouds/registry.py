@@ -1,0 +1,41 @@
+"""Name → Cloud registry (reference: sky/clouds/cloud_registry.py)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Type
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+
+class _CloudRegistry(Dict[str, cloud_lib.Cloud]):
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.aliases: Dict[str, str] = {}
+
+    def from_str(self, name: Optional[str]) -> Optional[cloud_lib.Cloud]:
+        if name is None:
+            return None
+        key = name.lower()
+        key = self.aliases.get(key, key)
+        if key not in self:
+            raise exceptions.ResourcesValidationError(
+                f'Cloud {name!r} is not a supported cloud. Supported: '
+                f'{sorted(self.keys())}')
+        return self[key]
+
+    def register(
+        self, aliases: Optional[List[str]] = None
+    ) -> Callable[[Type[cloud_lib.Cloud]], Type[cloud_lib.Cloud]]:
+        def decorator(cls: Type[cloud_lib.Cloud]) -> Type[cloud_lib.Cloud]:
+            name = cls.canonical_name()
+            assert name not in self, f'{name} registered twice'
+            self[name] = cls()
+            for alias in aliases or []:
+                self.aliases[alias.lower()] = name
+            return cls
+
+        return decorator
+
+
+CLOUD_REGISTRY = _CloudRegistry()

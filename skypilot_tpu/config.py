@@ -1,0 +1,148 @@
+"""Layered user configuration (~/.skytpu/config.yaml).
+
+Counterpart of the reference's sky/skypilot_config.py:1-259: a nested dict
+loaded once per process, `get_nested`/`set_nested` accessors over key
+tuples, an env-var override for the config path, and a context manager to
+substitute config for tests and controller processes (controllers receive a
+serialized copy, reference sky/utils/controller_utils.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+ENV_VAR_CONFIG_PATH = 'SKYTPU_CONFIG'
+CONFIG_PATH = '~/.skytpu/config.yaml'
+
+_dict: Optional[Dict[str, Any]] = None
+_loaded_config_path: Optional[str] = None
+_lock = threading.Lock()
+
+
+class Config(dict):
+    """Nested-dict wrapper with tuple-keyed accessors."""
+
+    def get_nested(self, keys: Tuple[str, ...], default_value: Any,
+                   override_configs: Optional[Dict[str, Any]] = None) -> Any:
+        config = copy.deepcopy(self)
+        if override_configs:
+            config = _recursive_update(config, override_configs)
+        return _get_nested(config, keys, default_value)
+
+    def set_nested(self, keys: Tuple[str, ...], value: Any) -> None:
+        override = {}
+        cursor = override
+        for key in keys[:-1]:
+            cursor[key] = {}
+            cursor = cursor[key]
+        cursor[keys[-1]] = value
+        _recursive_update(self, override)
+
+
+def _get_nested(config: Dict[str, Any], keys: Tuple[str, ...],
+                default_value: Any) -> Any:
+    cursor: Any = config
+    for key in keys:
+        if not isinstance(cursor, dict) or key not in cursor:
+            return default_value
+        cursor = cursor[key]
+    return cursor
+
+
+def _recursive_update(base: Dict[str, Any],
+                      override: Dict[str, Any]) -> Dict[str, Any]:
+    for key, value in override.items():
+        if (isinstance(value, dict) and key in base and
+                isinstance(base[key], dict)):
+            _recursive_update(base[key], value)
+        else:
+            base[key] = value
+    return base
+
+
+def _try_load() -> None:
+    global _dict, _loaded_config_path
+    config_path = os.environ.get(ENV_VAR_CONFIG_PATH,
+                                 os.path.expanduser(CONFIG_PATH))
+    config_path = os.path.expanduser(config_path)
+    if os.path.exists(config_path):
+        try:
+            with open(config_path, encoding='utf-8') as f:
+                raw = yaml.safe_load(f) or {}
+        except yaml.YAMLError as e:
+            raise exceptions.InvalidSkyTpuConfigError(
+                f'Failed to parse config at {config_path}: {e}') from e
+        if not isinstance(raw, dict):
+            raise exceptions.InvalidSkyTpuConfigError(
+                f'Config at {config_path} must be a YAML mapping.')
+        from skypilot_tpu.utils import schemas
+        schemas.validate(raw, schemas.get_config_schema(),
+                         exceptions.InvalidSkyTpuConfigError,
+                         'Invalid config: ')
+        _dict = Config(raw)
+        _loaded_config_path = config_path
+    else:
+        _dict = Config()
+        _loaded_config_path = None
+
+
+def _ensure_loaded() -> Config:
+    global _dict
+    with _lock:
+        if _dict is None:
+            _try_load()
+        assert _dict is not None
+        return _dict  # type: ignore[return-value]
+
+
+def loaded() -> bool:
+    return bool(_ensure_loaded())
+
+
+def loaded_config_path() -> Optional[str]:
+    _ensure_loaded()
+    return _loaded_config_path
+
+
+def get_nested(keys: Tuple[str, ...], default_value: Any = None,
+               override_configs: Optional[Dict[str, Any]] = None) -> Any:
+    return _ensure_loaded().get_nested(keys, default_value, override_configs)
+
+
+def set_nested(keys: Tuple[str, ...], value: Any) -> None:
+    _ensure_loaded().set_nested(keys, value)
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(dict(_ensure_loaded()))
+
+
+def reload() -> None:
+    global _dict
+    with _lock:
+        _dict = None
+    _ensure_loaded()
+
+
+@contextlib.contextmanager
+def replace_config(new_config: Optional[Dict[str, Any]]) -> Iterator[None]:
+    """Swap the process-wide config (tests, controllers)."""
+    global _dict
+    with _lock:
+        old = _dict
+        _dict = Config(new_config or {})
+    try:
+        yield
+    finally:
+        with _lock:
+            _dict = old

@@ -1,0 +1,97 @@
+"""Task DAGs.
+
+Counterpart of the reference's sky/dag.py:1-106: a thin networkx DiGraph of
+Tasks with a thread-local "current dag" context so `with Dag() as dag:` plus
+the `Task.__rshift__` operator build pipelines.  Only single-task DAGs are
+executed directly (reference sky/execution.py:181); chain DAGs are consumed
+by the managed-jobs pipeline runner.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import networkx as nx
+
+from skypilot_tpu import exceptions
+
+
+class Dag:
+    """Directed acyclic graph of Tasks."""
+
+    def __init__(self) -> None:
+        self.graph = nx.DiGraph()
+        self.name: Optional[str] = None
+        self.policy_applied: bool = False
+
+    @property
+    def tasks(self) -> List['task_lib.Task']:
+        return list(self.graph.nodes)
+
+    def add(self, task) -> None:
+        self.graph.add_node(task)
+
+    def remove(self, task) -> None:
+        self.graph.remove_node(task)
+
+    def add_edge(self, op1, op2) -> None:
+        assert op1 in self.graph.nodes
+        assert op2 in self.graph.nodes
+        self.graph.add_edge(op1, op2)
+
+    def __len__(self) -> int:
+        return len(self.graph.nodes)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        pop_dag()
+
+    def __repr__(self) -> str:
+        pformat = ', '.join(repr(t) for t in self.tasks)
+        return f'DAG:\n {pformat}'
+
+    def get_graph(self) -> nx.DiGraph:
+        return self.graph
+
+    def is_chain(self) -> bool:
+        """True iff the DAG is a linear chain (reference sky/dag.py:60)."""
+        nodes = list(self.graph.nodes)
+        out_degrees = [self.graph.out_degree(n) for n in nodes]
+        in_degrees = [self.graph.in_degree(n) for n in nodes]
+        return (len(nodes) <= 1 or
+                (all(d <= 1 for d in out_degrees) and
+                 all(d <= 1 for d in in_degrees) and
+                 sum(d == 0 for d in out_degrees) == 1 and
+                 sum(d == 0 for d in in_degrees) == 1))
+
+    def validate(self) -> None:
+        if not nx.is_directed_acyclic_graph(self.graph):
+            raise exceptions.DagError('DAG has a cycle.')
+        for task in self.tasks:
+            task.validate()
+
+
+class _DagContext(threading.local):
+    """Thread-local stack of active dags (reference sky/dag.py:75-106)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._stack: List[Dag] = []
+
+    def push(self, dag: Dag) -> None:
+        self._stack.append(dag)
+
+    def pop(self) -> Dag:
+        return self._stack.pop()
+
+    def current(self) -> Optional[Dag]:
+        return self._stack[-1] if self._stack else None
+
+
+_dag_context = _DagContext()
+push_dag = _dag_context.push
+pop_dag = _dag_context.pop
+get_current_dag = _dag_context.current

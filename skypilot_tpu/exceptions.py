@@ -1,0 +1,231 @@
+"""Exception hierarchy for the framework.
+
+Modeled on the reference's exception surface (sky/exceptions.py:1-308) but
+re-scoped for a TPU-slice-first orchestrator: slice-level failures are
+first-class (a pod slice fails as a unit), and preempted TPU VMs require
+teardown rather than stop (reference: sky/resources.py:633).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No cloud/zone could satisfy the requested resources.
+
+    Carries the failover history so callers (and users) can see every
+    placement attempt that was made before giving up.  Mirrors the
+    reference's ResourcesUnavailableError with `failover_history`
+    (sky/exceptions.py:40-60).
+    """
+
+    def __init__(self, message: str,
+                 failover_history: Optional[List[Exception]] = None) -> None:
+        super().__init__(message)
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not fit the existing cluster."""
+
+
+class ProvisionError(SkyTpuError):
+    """A cloud-level provisioning call failed.
+
+    `no_failover=True` means the error is terminal for the whole request
+    (e.g. invalid credentials), not just for this zone.
+    """
+
+    def __init__(self, message: str, no_failover: bool = False) -> None:
+        super().__init__(message)
+        self.no_failover = no_failover
+
+
+class ProvisionTimeoutError(ProvisionError):
+    """Instances did not reach RUNNING within the deadline."""
+
+
+class StopFailoverError(SkyTpuError):
+    """Cleanup (stop/terminate) after a failed provision itself failed.
+
+    The cluster may be leaking cloud resources; surfaced loudly.
+    Reference: sky/provision/provisioner.py:199.
+    """
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+    def __init__(self, message: str, cluster_status: Any = None,
+                 handle: Any = None) -> None:
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster not found in the state store."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster was created under a different cloud identity."""
+
+
+class NotSupportedError(SkyTpuError):
+    """The requested operation is not supported for this cloud/resource."""
+
+
+class CloudUserIdentityError(SkyTpuError):
+    """Failed to determine the active cloud user identity."""
+
+
+class InvalidCloudCredentials(SkyTpuError):
+    """Cloud credentials are missing or invalid."""
+
+
+class InvalidSkyTpuConfigError(SkyTpuError):
+    """~/.skytpu/config.yaml failed schema validation."""
+
+
+class TaskValidationError(SkyTpuError, ValueError):
+    """Task YAML / constructor arguments are invalid."""
+
+
+class ResourcesValidationError(SkyTpuError, ValueError):
+    """Resources arguments are invalid."""
+
+
+class DagError(SkyTpuError, ValueError):
+    """Invalid DAG structure (cycles, etc)."""
+
+
+class CommandError(SkyTpuError):
+    """A remote command exited non-zero.
+
+    Mirrors reference sky/exceptions.py CommandError: keeps the command and
+    a tail of its output for the user-facing message.
+    """
+
+    def __init__(self, returncode: int, command: str, error_msg: str,
+                 detailed_reason: Optional[str] = None) -> None:
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        if len(command) > 100:
+            command = command[:100] + '...'
+        super().__init__(
+            f'Command {command} failed with return code {returncode}.'
+            f'\n{error_msg}')
+
+
+class CommandTimeoutError(SkyTpuError):
+    """A remote command timed out."""
+
+
+class FetchClusterInfoError(SkyTpuError):
+    """Failed to query cluster liveness/IPs from the cloud.
+
+    Reference: sky/exceptions.py FetchClusterInfoError with Reason enum.
+    """
+
+    class Reason(enum.Enum):
+        HEAD = 'head'
+        WORKER = 'worker'
+
+    def __init__(self, reason: 'FetchClusterInfoError.Reason') -> None:
+        super().__init__(f'Failed to fetch info for {reason.value} node(s).')
+        self.reason = reason
+
+
+class JobNotFoundError(SkyTpuError):
+    """Job id not present in a cluster's job queue."""
+
+
+class JobExitCode(enum.IntEnum):
+    """Process exit codes used to propagate job status through CLIs.
+
+    Mirrors reference sky/exceptions.py JobExitCode semantics.
+    """
+    SUCCEEDED = 0
+    FAILED = 100
+    NOT_FINISHED = 101
+    NOT_FOUND = 102
+
+    @classmethod
+    def from_job_status(cls, status: Any) -> 'JobExitCode':
+        if status is None:
+            return cls.NOT_FOUND
+        if not status.is_terminal():
+            return cls.NOT_FINISHED
+        name = status.name
+        if name == 'SUCCEEDED':
+            return cls.SUCCEEDED
+        return cls.FAILED
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job recovery gave up after max retries."""
+
+
+class ManagedJobStatusError(SkyTpuError):
+    """Inconsistent managed-job state."""
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    """Service was torn down by the user while an op was in flight."""
+
+
+class StorageError(SkyTpuError):
+    """Base for storage subsystem errors."""
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageBucketDeleteError(StorageError):
+    pass
+
+
+class StorageSourceError(StorageError, ValueError):
+    pass
+
+
+class StorageNameError(StorageError, ValueError):
+    pass
+
+
+class StorageModeError(StorageError, ValueError):
+    pass
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud is enabled/authenticated (run `check`)."""
+
+
+class AgentVersionError(SkyTpuError):
+    """On-cluster agent version is incompatible with this client."""
+
+
+def format_failover_history(history: List[Exception]) -> str:
+    if not history:
+        return ''
+    lines = ['Failover history:']
+    for i, err in enumerate(history):
+        lines.append(f'  [{i + 1}] {err.__class__.__name__}: {err}')
+    return '\n'.join(lines)

@@ -1,0 +1,518 @@
+"""Immutable Resources model with TPU pod slices as the first-class unit.
+
+Counterpart of the reference's sky/resources.py:31-1631, redesigned so that
+TPU topology is structural rather than a GCP special case: an accelerator
+like `tpu-v5p-128` resolves to a `TpuSliceSpec` that the optimizer,
+provisioner and gang launcher all consume (`num_hosts`, chips/host, ICI
+topology).  Key reference behaviors preserved:
+  - validation pipeline before any cloud call (resources.py:750-1016)
+  - `less_demanding_than` for cluster-reuse fit checks (resources.py:1119)
+  - `need_cleanup_after_preemption_or_failure` — preempted TPU VMs must be
+    *deleted*, not stopped (resources.py:633)
+  - `copy(**override)` returning a new frozen instance
+  - YAML round-trip incl. `any_of:` / `ordered:` candidate sets.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import accelerator_registry
+from skypilot_tpu.utils import schemas
+
+logger = sky_logging.init_logger(__name__)
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+
+class Resources:
+    """A (possibly partial) specification of compute resources.
+
+    Unset fields mean "let the optimizer decide".  Instances are immutable;
+    use `.copy(**overrides)`.
+    """
+
+    _VERSION = 1
+
+    def __init__(
+        self,
+        cloud: Optional[Union[str, 'clouds.Cloud']] = None,
+        instance_type: Optional[str] = None,
+        cpus: Optional[Union[int, float, str]] = None,
+        memory: Optional[Union[int, float, str]] = None,
+        accelerators: Optional[Union[str, Dict[str, int]]] = None,
+        accelerator_args: Optional[Dict[str, Any]] = None,
+        use_spot: Optional[bool] = None,
+        job_recovery: Optional[Union[str, Dict[str, Any]]] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        disk_size: Optional[int] = None,
+        disk_tier: Optional[str] = None,
+        ports: Optional[Union[int, str, List[Union[int, str]]]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        image_id: Optional[str] = None,
+        _cluster_config_overrides: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        from skypilot_tpu import clouds  # deferred: avoid import cycle
+        self._cloud: Optional['clouds.Cloud'] = None
+        if cloud is not None:
+            if isinstance(cloud, str):
+                self._cloud = clouds.CLOUD_REGISTRY.from_str(cloud)
+            else:
+                self._cloud = cloud
+        self._instance_type = instance_type
+        self._cpus = str(cpus) if cpus is not None else None
+        self._memory = str(memory) if memory is not None else None
+        self._accelerators = self._canonicalize_accelerators(accelerators)
+        self._accelerator_args = dict(accelerator_args or {}) or None
+        self._use_spot_specified = use_spot is not None
+        self._use_spot = bool(use_spot) if use_spot is not None else False
+        self._job_recovery = self._parse_job_recovery(job_recovery)
+        self._region = region
+        self._zone = zone
+        self._disk_size = (int(disk_size)
+                           if disk_size is not None else _DEFAULT_DISK_SIZE_GB)
+        self._disk_size_specified = disk_size is not None
+        self._disk_tier = disk_tier
+        self._ports = self._parse_ports(ports)
+        self._labels = dict(labels) if labels else None
+        self._image_id = image_id
+        self._cluster_config_overrides = _cluster_config_overrides or {}
+
+        self._tpu_slice: Optional[accelerator_registry.TpuSliceSpec] = None
+        if self._accelerators is not None:
+            for name, count in self._accelerators.items():
+                if accelerator_registry.is_tpu({name: count}):
+                    self._tpu_slice = accelerator_registry.parse_tpu_accelerator(
+                        name, count)
+        self._validate()
+
+    # -- parsing helpers ---------------------------------------------------
+    @staticmethod
+    def _canonicalize_accelerators(
+        accelerators: Optional[Union[str, Dict[str, int]]]
+    ) -> Optional[Dict[str, int]]:
+        if accelerators is None:
+            return None
+        if isinstance(accelerators, str):
+            if ':' in accelerators:
+                name, count_s = accelerators.split(':', 1)
+                try:
+                    count = int(count_s)
+                except ValueError:
+                    raise exceptions.ResourcesValidationError(
+                        f'Invalid accelerator count in {accelerators!r}.')
+            else:
+                name, count = accelerators, 1
+            accelerators = {name: count}
+        if len(accelerators) != 1:
+            raise exceptions.ResourcesValidationError(
+                f'Only one accelerator type per task is supported, got '
+                f'{accelerators}.')
+        out: Dict[str, int] = {}
+        for name, count in accelerators.items():
+            if name.lower().startswith('tpu-'):
+                spec = accelerator_registry.parse_tpu_accelerator(
+                    name, int(count))
+                # Normalize to name-embedded-count form with count 1:
+                # accelerators={'tpu-v5p-128': 1}.
+                out[spec.accelerator_name] = 1
+            else:
+                canonical = accelerator_registry.canonicalize_accelerator_name(
+                    name)
+                out[canonical] = int(count)
+        return out
+
+    @staticmethod
+    def _parse_job_recovery(
+        job_recovery: Optional[Union[str, Dict[str, Any]]]
+    ) -> Optional[Dict[str, Any]]:
+        """Normalize `job_recovery: EAGER_NEXT_REGION` or
+        `{strategy:..., max_restarts_on_errors: N}` (reference
+        resources.py:439)."""
+        if job_recovery is None:
+            return None
+        if isinstance(job_recovery, str):
+            return {'strategy': job_recovery.upper()}
+        out = dict(job_recovery)
+        if 'strategy' in out and isinstance(out['strategy'], str):
+            out['strategy'] = out['strategy'].upper()
+        return out
+
+    @staticmethod
+    def _parse_ports(
+        ports: Optional[Union[int, str, List[Union[int, str]]]]
+    ) -> Optional[List[str]]:
+        if ports is None:
+            return None
+        if isinstance(ports, (int, str)):
+            ports = [ports]
+        out = []
+        for p in ports:
+            s = str(p)
+            if '-' in s:
+                lo, hi = s.split('-', 1)
+                lo_i, hi_i = int(lo), int(hi)
+                if not 1 <= lo_i <= hi_i <= 65535:
+                    raise exceptions.ResourcesValidationError(
+                        f'Invalid port range {s!r}.')
+            else:
+                if not 1 <= int(s) <= 65535:
+                    raise exceptions.ResourcesValidationError(
+                        f'Invalid port {s!r}.')
+            out.append(s)
+        return sorted(set(out)) or None
+
+    # -- validation pipeline ----------------------------------------------
+    def _validate(self) -> None:
+        self._try_validate_cpus_memory()
+        self._try_validate_tpu()
+        self._try_validate_region_zone()
+        self._try_validate_disk_tier()
+        self._try_validate_instance_type()
+
+    def _try_validate_cpus_memory(self) -> None:
+        for label, value in (('cpus', self._cpus), ('memory', self._memory)):
+            if value is None:
+                continue
+            s = value[:-1] if value.endswith(('+', 'x')) else value
+            try:
+                v = float(s)
+            except ValueError:
+                raise exceptions.ResourcesValidationError(
+                    f'Invalid {label} spec {value!r}: expected a number with '
+                    "optional '+' suffix (e.g. '8', '8+').")
+            if v <= 0:
+                raise exceptions.ResourcesValidationError(
+                    f'{label} must be positive, got {value!r}.')
+
+    def _try_validate_tpu(self) -> None:
+        if self._tpu_slice is None:
+            if self._accelerator_args:
+                tpu_only_keys = {'runtime_version', 'tpu_name', 'tpu_vm',
+                                 'topology'}
+                bad = set(self._accelerator_args) & tpu_only_keys
+                if bad:
+                    raise exceptions.ResourcesValidationError(
+                        f'accelerator_args {sorted(bad)} are only valid for '
+                        'TPU accelerators.')
+            return
+        args = dict(self._accelerator_args or {})
+        if not args.get('tpu_vm', True):
+            raise exceptions.ResourcesValidationError(
+                'Legacy TPU Node architecture is not supported; only TPU VM '
+                '(the reference deprecates TPU nodes as well, '
+                'sky/clouds/gcp.py:193-204).')
+        args.setdefault('runtime_version',
+                        self._tpu_slice.default_runtime_version())
+        self._accelerator_args = args
+        if self._use_spot and self._tpu_slice.generation.name == 'v2':
+            logger.debug('v2 spot availability is limited.')
+
+    def _try_validate_region_zone(self) -> None:
+        if self._zone is not None and self._region is None:
+            # Infer region from zone (e.g. us-central2-b -> us-central2).
+            parts = self._zone.rsplit('-', 1)
+            if len(parts) == 2 and len(parts[1]) <= 2:
+                self._region = parts[0]
+        if self._cloud is not None and self._region is not None:
+            valid = self._cloud.validate_region_zone(self._region, self._zone)
+            if not valid:
+                raise exceptions.ResourcesValidationError(
+                    f'Invalid region/zone {self._region}/{self._zone} for '
+                    f'cloud {self._cloud}.')
+
+    def _try_validate_disk_tier(self) -> None:
+        if self._disk_tier is not None and self._disk_tier not in (
+                'low', 'medium', 'high', 'ultra', 'best'):
+            raise exceptions.ResourcesValidationError(
+                f'Invalid disk_tier {self._disk_tier!r}; expected one of '
+                "'low', 'medium', 'high', 'ultra', 'best'.")
+
+    def _try_validate_instance_type(self) -> None:
+        if self._instance_type is None or self._cloud is not None:
+            return
+        from skypilot_tpu import clouds
+        feasible = [
+            cloud for cloud in clouds.CLOUD_REGISTRY.values()
+            if cloud.instance_type_exists(self._instance_type)
+        ]
+        if len(feasible) == 1:
+            self._cloud = feasible[0]
+        elif len(feasible) > 1:
+            raise exceptions.ResourcesValidationError(
+                f'Instance type {self._instance_type!r} exists in multiple '
+                f'clouds {feasible}; please specify `cloud`.')
+
+    # -- accessors ---------------------------------------------------------
+    @property
+    def cloud(self):
+        return self._cloud
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def cpus(self) -> Optional[str]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[str]:
+        return self._memory
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, int]]:
+        return dict(self._accelerators) if self._accelerators else None
+
+    @property
+    def accelerator_args(self) -> Optional[Dict[str, Any]]:
+        return dict(self._accelerator_args) if self._accelerator_args else None
+
+    @property
+    def tpu_slice(self) -> Optional[accelerator_registry.TpuSliceSpec]:
+        return self._tpu_slice
+
+    @property
+    def is_tpu(self) -> bool:
+        return self._tpu_slice is not None
+
+    @property
+    def num_hosts_per_node(self) -> int:
+        """Hosts behind one logical node. >1 for TPU pod slices (the
+        reference's num_ips_per_node, cloud_vm_ray_backend.py:2550)."""
+        if self._tpu_slice is not None:
+            return self._tpu_slice.num_hosts
+        return 1
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot_specified
+
+    @property
+    def job_recovery(self) -> Optional[Dict[str, Any]]:
+        return self._job_recovery
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def disk_tier(self) -> Optional[str]:
+        return self._disk_tier
+
+    @property
+    def ports(self) -> Optional[List[str]]:
+        return list(self._ports) if self._ports else None
+
+    @property
+    def labels(self) -> Optional[Dict[str, str]]:
+        return dict(self._labels) if self._labels else None
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def cluster_config_overrides(self) -> Dict[str, Any]:
+        return dict(self._cluster_config_overrides)
+
+    @property
+    def need_cleanup_after_preemption_or_failure(self) -> bool:
+        """Preempted/failed TPU VMs cannot be restarted in place — they must
+        be deleted and re-created (reference: sky/resources.py:633, consumed
+        by the jobs controller at sky/jobs/controller.py:352-360)."""
+        return self.is_tpu
+
+    def is_launchable(self) -> bool:
+        return self._cloud is not None and self._instance_type is not None
+
+    # -- cost --------------------------------------------------------------
+    def get_cost(self, seconds: float) -> float:
+        """Cost in $ for running this resource for `seconds`."""
+        hours = seconds / 3600.0
+        assert self._cloud is not None and self._instance_type is not None, (
+            'get_cost() requires launchable resources.')
+        cost = self._cloud.instance_type_to_hourly_cost(
+            self._instance_type, self._use_spot, self._region, self._zone)
+        if self._accelerators is not None:
+            cost += self._cloud.accelerators_to_hourly_cost(
+                self._accelerators, self._use_spot, self._region, self._zone)
+        return cost * hours
+
+    # -- deploy variables --------------------------------------------------
+    def make_deploy_variables(self, cluster_name_on_cloud: str,
+                              region: 'clouds.Region',
+                              zones: Optional[List['clouds.Zone']],
+                              num_nodes: int) -> Dict[str, Any]:
+        assert self._cloud is not None
+        return self._cloud.make_deploy_resources_variables(
+            self, cluster_name_on_cloud, region, zones, num_nodes)
+
+    # -- comparison --------------------------------------------------------
+    def less_demanding_than(self, other: 'Resources',
+                            requested_num_nodes: int = 1) -> bool:
+        """True if `self` fits on a cluster provisioned as `other`.
+
+        Used for cluster-reuse checks on `exec`/relaunch (reference
+        sky/resources.py:1119).
+        """
+        if self._cloud is not None and not self._cloud.is_same_cloud(
+                other.cloud):
+            return False
+        if self._region is not None and self._region != other.region:
+            return False
+        if self._zone is not None and self._zone != other.zone:
+            return False
+        if (self._instance_type is not None and
+                self._instance_type != other.instance_type):
+            return False
+        if self._use_spot_specified and self._use_spot != other.use_spot:
+            return False
+        if self._accelerators is not None:
+            if other.accelerators is None:
+                return False
+            for name, count in self._accelerators.items():
+                if other.accelerators.get(name, 0) < count:
+                    return False
+        if self._ports is not None:
+            other_ports = set(other.ports or [])
+            if not set(self._ports) <= other_ports:
+                return False
+        return True
+
+    # -- copy / serialization ---------------------------------------------
+    def copy(self, **override: Any) -> 'Resources':
+        fields = dict(
+            cloud=self._cloud,
+            instance_type=self._instance_type,
+            cpus=self._cpus,
+            memory=self._memory,
+            accelerators=self.accelerators,
+            accelerator_args=self.accelerator_args,
+            use_spot=self._use_spot if self._use_spot_specified else None,
+            job_recovery=self._job_recovery,
+            region=self._region,
+            zone=self._zone,
+            disk_size=(self._disk_size
+                       if self._disk_size_specified else None),
+            disk_tier=self._disk_tier,
+            ports=self.ports,
+            labels=self.labels,
+            image_id=self._image_id,
+            _cluster_config_overrides=self._cluster_config_overrides,
+        )
+        fields.update(override)
+        return Resources(**fields)
+
+    @classmethod
+    def from_yaml_config(
+        cls, config: Optional[Dict[str, Any]]
+    ) -> Union['Resources', List['Resources'], Set['Resources']]:
+        """Build Resources (or an any_of set / ordered list) from YAML.
+
+        Reference: sky/resources.py from_yaml_config with any_of/ordered
+        candidate-resources support.
+        """
+        if config is None:
+            return Resources()
+        schemas.validate(config, schemas.get_resources_schema(),
+                         exceptions.ResourcesValidationError,
+                         'Invalid resources: ')
+        config = dict(config)
+        any_of = config.pop('any_of', None)
+        ordered = config.pop('ordered', None)
+        if any_of is not None and ordered is not None:
+            raise exceptions.ResourcesValidationError(
+                'Cannot specify both any_of and ordered.')
+
+        def _build(override: Dict[str, Any]) -> 'Resources':
+            merged = {**config, **override}
+            return cls(**merged)  # type: ignore[arg-type]
+
+        if any_of is not None:
+            return {_build(o or {}) for o in any_of}
+        if ordered is not None:
+            return [_build(o or {}) for o in ordered]
+        return _build({})
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key: str, value: Any) -> None:
+            if value is not None:
+                config[key] = value
+
+        add('cloud', str(self._cloud) if self._cloud else None)
+        add('instance_type', self._instance_type)
+        add('cpus', self._cpus)
+        add('memory', self._memory)
+        if self._accelerators:
+            add('accelerators', self._accelerators)
+        add('accelerator_args', self.accelerator_args)
+        if self._use_spot_specified:
+            add('use_spot', self._use_spot)
+        add('job_recovery', self._job_recovery)
+        add('region', self._region)
+        add('zone', self._zone)
+        if self._disk_size_specified:
+            add('disk_size', self._disk_size)
+        add('disk_tier', self._disk_tier)
+        add('ports', self.ports)
+        add('labels', self.labels)
+        add('image_id', self._image_id)
+        return config
+
+    # -- dunder ------------------------------------------------------------
+    def __eq__(self, other: Any) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        def freeze(v):
+            if isinstance(v, dict):
+                return tuple(sorted((k, freeze(x)) for k, x in v.items()))
+            if isinstance(v, list):
+                return tuple(freeze(x) for x in v)
+            return v
+
+        return hash(freeze(self.to_yaml_config()))
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud is not None:
+            parts.append(str(self._cloud))
+        if self._instance_type is not None:
+            parts.append(self._instance_type)
+        if self._accelerators is not None:
+            accs = ', '.join(f'{k}:{v}' if v != 1 else k
+                             for k, v in self._accelerators.items())
+            parts.append(f'{{{accs}}}')
+            if self._tpu_slice is not None and self._tpu_slice.is_pod:
+                parts.append(f'[{self._tpu_slice.num_hosts} hosts]')
+        if self._cpus is not None:
+            parts.append(f'cpus={self._cpus}')
+        if self._memory is not None:
+            parts.append(f'mem={self._memory}')
+        if self._use_spot:
+            parts.append('[Spot]')
+        if self._region is not None:
+            parts.append(f'region={self._region}')
+        if self._zone is not None:
+            parts.append(f'zone={self._zone}')
+        inner = ', '.join(parts) if parts else ''
+        return f'Resources({inner})'

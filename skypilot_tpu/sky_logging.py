@@ -1,0 +1,73 @@
+"""Logging setup.
+
+Env-tunable logger analogous to the reference's sky/sky_logging.py:1-179:
+a single stream handler with an optional rich-style prefix, module-level
+`init_logger`, and context managers to silence output in nested calls
+(used when controllers invoke the SDK recursively).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import threading
+
+_FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
+_DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_root_logger = logging.getLogger('skypilot_tpu')
+_default_handler = None
+_lock = threading.Lock()
+
+
+def _setup() -> None:
+    global _default_handler
+    with _lock:
+        if _default_handler is not None:
+            return
+        _default_handler = logging.StreamHandler(sys.stdout)
+        _default_handler.flush = sys.stdout.flush  # type: ignore[method-assign]
+        level = os.environ.get('SKYTPU_DEBUG')
+        _default_handler.setLevel(
+            logging.DEBUG if level == '1' else logging.INFO)
+        _default_handler.setFormatter(
+            logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
+        _root_logger.addHandler(_default_handler)
+        _root_logger.setLevel(logging.DEBUG)
+        _root_logger.propagate = False
+
+
+def init_logger(name: str) -> logging.Logger:
+    _setup()
+    return logging.getLogger(name if name.startswith('skypilot_tpu')
+                             else f'skypilot_tpu.{name}')
+
+
+@contextlib.contextmanager
+def silent():
+    """Suppress all framework log output inside the context.
+
+    Used when the SDK is invoked programmatically by controllers
+    (reference: sky/sky_logging.py silent()).
+    """
+    _setup()
+    assert _default_handler is not None
+    previous = _default_handler.level
+    _default_handler.setLevel(logging.CRITICAL)
+    try:
+        yield
+    finally:
+        _default_handler.setLevel(previous)
+
+
+def is_silent() -> bool:
+    _setup()
+    assert _default_handler is not None
+    return _default_handler.level >= logging.CRITICAL
+
+
+def set_verbose(verbose: bool) -> None:
+    _setup()
+    assert _default_handler is not None
+    _default_handler.setLevel(logging.DEBUG if verbose else logging.INFO)

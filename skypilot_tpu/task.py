@@ -1,0 +1,333 @@
+"""The Task model: a unit of work with resource requirements.
+
+Counterpart of the reference's sky/task.py:171-1221.  A Task carries:
+name, setup, run (bash string or a Python callable taking
+(node_rank, host_ips)), workdir, num_nodes (logical nodes — for TPU slices
+each node is a whole slice and fan-out to hosts is handled by the backend),
+envs with ${VAR} substitution, file_mounts, storage_mounts, a set of
+candidate Resources, and an optional serve `service` spec.  YAML round-trip
+via `from_yaml_config` / `to_yaml_config`.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import schemas
+
+logger = sky_logging.init_logger(__name__)
+
+_VALID_NAME_REGEX = '[a-zA-Z0-9]+(?:[._-]{1,2}[a-zA-Z0-9]+)*'
+_VALID_ENV_VAR_REGEX = '[a-zA-Z_][a-zA-Z0-9_]*'
+
+RunFn = Callable[[int, List[str]], Optional[str]]
+
+
+def _fill_in_env_vars(yaml_field: Any, task_envs: Dict[str, str]) -> Any:
+    """Substitute ${VAR} / $VAR occurrences using task envs (reference
+    sky/task.py:73 _fill_in_env_vars)."""
+    if isinstance(yaml_field, str):
+        def repl(m: 're.Match[str]') -> str:
+            var = m.group(1) or m.group(2)
+            return task_envs.get(var, m.group(0))
+
+        return re.sub(r'\$\{(' + _VALID_ENV_VAR_REGEX + r')\}|'
+                      r'\$(' + _VALID_ENV_VAR_REGEX + r')\b', repl,
+                      yaml_field)
+    if isinstance(yaml_field, dict):
+        return {k: _fill_in_env_vars(v, task_envs)
+                for k, v in yaml_field.items()}
+    if isinstance(yaml_field, list):
+        return [_fill_in_env_vars(v, task_envs) for v in yaml_field]
+    return yaml_field
+
+
+class Task:
+    """A coarse-grained unit of work submitted to the framework."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: Optional[Union[str, RunFn]] = None,
+        envs: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self._envs = {k: str(v) if v is not None else ''
+                      for k, v in (envs or {}).items()}
+        self._num_nodes = 1
+        if num_nodes is not None:
+            self.num_nodes = num_nodes
+        self.file_mounts: Optional[Dict[str, str]] = None
+        if file_mounts is not None:
+            self.set_file_mounts(file_mounts)
+        self.storage_mounts: Dict[str, Any] = {}
+        self.service: Optional[Any] = None  # serve.SkyServiceSpec
+        self.resources: Union[Set[resources_lib.Resources],
+                              List[resources_lib.Resources]] = {
+                                  resources_lib.Resources()}
+        self.best_resources: Optional[resources_lib.Resources] = None
+        self.estimated_outputs_size_gb: Optional[float] = None
+        # Registered into the ambient DAG context, if any (sky/task.py).
+        dag = dag_lib.get_current_dag()
+        if dag is not None:
+            dag.add(self)
+
+    # -- validation --------------------------------------------------------
+    def validate(self) -> None:
+        self.validate_name()
+        self.validate_run()
+        if self.workdir is not None:
+            full = os.path.abspath(os.path.expanduser(self.workdir))
+            if not os.path.isdir(full):
+                raise exceptions.TaskValidationError(
+                    f'Workdir must be an existing directory: {self.workdir}')
+
+    def validate_name(self) -> None:
+        if self.name is not None and not re.fullmatch(_VALID_NAME_REGEX,
+                                                      self.name):
+            raise exceptions.TaskValidationError(
+                f'Invalid task name {self.name!r}: must match '
+                f'{_VALID_NAME_REGEX}')
+
+    def validate_run(self) -> None:
+        if self.run is None or isinstance(self.run, str):
+            return
+        if callable(self.run):
+            # Python-callable run fn receives (node_rank, host_ips) and
+            # returns the bash command for that rank (reference
+            # sky/task.py:269 run-as-generator form).
+            return
+        raise exceptions.TaskValidationError(
+            f'run must be a string, callable, or None; got {type(self.run)}')
+
+    # -- envs --------------------------------------------------------------
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    def update_envs(
+            self, envs: Union[None, List[tuple], Dict[str, str]]) -> 'Task':
+        if envs is None:
+            return self
+        if isinstance(envs, (list, tuple)):
+            envs = dict(envs)
+        for key, value in envs.items():
+            if not isinstance(key, str) or not re.fullmatch(
+                    _VALID_ENV_VAR_REGEX, key):
+                raise exceptions.TaskValidationError(
+                    f'Invalid env var name {key!r}.')
+            self._envs[key] = str(value) if value is not None else ''
+        return self
+
+    # -- num_nodes ---------------------------------------------------------
+    @property
+    def num_nodes(self) -> int:
+        return self._num_nodes
+
+    @num_nodes.setter
+    def num_nodes(self, num_nodes: Optional[int]) -> None:
+        if num_nodes is None:
+            num_nodes = 1
+        if not isinstance(num_nodes, int) or num_nodes < 1:
+            raise exceptions.TaskValidationError(
+                f'num_nodes must be a positive int, got {num_nodes!r}')
+        self._num_nodes = num_nodes
+
+    # -- resources ---------------------------------------------------------
+    def set_resources(
+        self, resources: Union[resources_lib.Resources,
+                               Set[resources_lib.Resources],
+                               List[resources_lib.Resources]]
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = {resources}
+        if not resources:
+            raise exceptions.TaskValidationError('Empty resources set.')
+        self.resources = resources
+        return self
+
+    @property
+    def resources_ordered(self) -> bool:
+        """Whether candidate resources are a preference-ordered list."""
+        return isinstance(self.resources, list)
+
+    def get_preferred_resources(self) -> List[resources_lib.Resources]:
+        if isinstance(self.resources, list):
+            return list(self.resources)
+        return sorted(self.resources, key=repr)
+
+    # -- file mounts -------------------------------------------------------
+    def set_file_mounts(
+            self, file_mounts: Optional[Dict[str, str]]) -> 'Task':
+        if file_mounts is None:
+            self.file_mounts = None
+            return self
+        for target, source in file_mounts.items():
+            if target.endswith('/') or source.endswith('/'):
+                raise exceptions.TaskValidationError(
+                    'File mount paths cannot end with a slash; got '
+                    f'{target}: {source}. For directories, omit the '
+                    'trailing slash.')
+            if not _is_cloud_store_url(source):
+                full = os.path.abspath(os.path.expanduser(source))
+                if not os.path.exists(full):
+                    raise exceptions.TaskValidationError(
+                        f'File mount source {source!r} does not exist '
+                        'locally.')
+        self.file_mounts = dict(file_mounts)
+        return self
+
+    def update_file_mounts(self, file_mounts: Dict[str, str]) -> 'Task':
+        merged = dict(self.file_mounts or {})
+        merged.update(file_mounts)
+        return self.set_file_mounts(merged)
+
+    def set_storage_mounts(self, storage_mounts: Optional[Dict[str, Any]]
+                           ) -> 'Task':
+        self.storage_mounts = dict(storage_mounts or {})
+        return self
+
+    # -- service -----------------------------------------------------------
+    def set_service(self, service: Optional[Any]) -> 'Task':
+        self.service = service
+        return self
+
+    # -- YAML round-trip ---------------------------------------------------
+    @staticmethod
+    def from_yaml_config(config: Dict[str, Any],
+                         env_overrides: Optional[List[tuple]] = None
+                         ) -> 'Task':
+        if env_overrides is not None:
+            new_envs = dict(config.get('envs') or {})
+            new_envs.update(dict(env_overrides))
+            config['envs'] = new_envs
+        for key in list(config.get('envs', {}) or {}):
+            value = config['envs'][key]
+            if value is None:
+                raise exceptions.TaskValidationError(
+                    f'Env var {key!r} has no value set. Set it in the YAML '
+                    'or with --env.')
+            config['envs'][key] = str(value)
+        # Env substitution happens before schema validation so that
+        # `${VAR}` placeholders in any field are resolved first
+        # (reference sky/task.py:347 from_yaml_config).
+        config = _fill_in_env_vars(config, config.get('envs', {}) or {})
+        schemas.validate(config, schemas.get_task_schema(),
+                         exceptions.TaskValidationError, 'Invalid task: ')
+
+        task = Task(
+            config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+            envs=config.get('envs'),
+        )
+        if config.get('file_mounts') is not None:
+            # Separate plain-path mounts from inline storage-spec mounts.
+            plain: Dict[str, str] = {}
+            storages: Dict[str, Any] = {}
+            for target, source in config['file_mounts'].items():
+                if isinstance(source, str):
+                    plain[target] = source
+                elif isinstance(source, dict):
+                    storages[target] = source
+            if plain:
+                task.set_file_mounts(plain)
+            if storages:
+                from skypilot_tpu.data import storage as storage_lib
+                task.set_storage_mounts({
+                    t: storage_lib.Storage.from_yaml_config(s)
+                    for t, s in storages.items()
+                })
+        resources_config = config.get('resources')
+        task.set_resources(
+            resources_lib.Resources.from_yaml_config(resources_config))
+        if config.get('service') is not None:
+            from skypilot_tpu.serve import service_spec
+            task.set_service(
+                service_spec.SkyServiceSpec.from_yaml_config(
+                    config['service']))
+        task.validate()
+        return task
+
+    @staticmethod
+    def from_yaml(yaml_path: str) -> 'Task':
+        config = common_utils.read_yaml(yaml_path)
+        if isinstance(config, str):
+            raise exceptions.TaskValidationError(
+                f'{yaml_path} is not a YAML mapping.')
+        return Task.from_yaml_config(config or {})
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key: str, value: Any) -> None:
+            if value is not None and value != {} and value != []:
+                config[key] = value
+
+        add('name', self.name)
+        if len(self.get_preferred_resources()) == 1:
+            add('resources',
+                self.get_preferred_resources()[0].to_yaml_config())
+        elif self.resources_ordered:
+            add('resources', {
+                'ordered': [r.to_yaml_config()
+                            for r in self.get_preferred_resources()]
+            })
+        else:
+            add('resources', {
+                'any_of': [r.to_yaml_config()
+                           for r in self.get_preferred_resources()]
+            })
+        if self.service is not None:
+            add('service', self.service.to_yaml_config())
+        if self._num_nodes != 1:
+            add('num_nodes', self._num_nodes)
+        add('envs', self._envs or None)
+        add('workdir', self.workdir)
+        add('setup', self.setup)
+        add('run', self.run if isinstance(self.run, str) else None)
+        add('file_mounts', self.file_mounts)
+        if self.storage_mounts:
+            add('storage_mounts_config', {
+                t: s.to_yaml_config() for t, s in self.storage_mounts.items()
+            })
+        return config
+
+    # -- DAG sugar ---------------------------------------------------------
+    def __rshift__(self, other: 'Task') -> 'Task':
+        dag = dag_lib.get_current_dag()
+        if dag is None:
+            raise exceptions.DagError(
+                'Task >> Task requires an active `with Dag():` context.')
+        dag.add_edge(self, other)
+        return other
+
+    def __repr__(self) -> str:
+        if self.name:
+            return f'Task({self.name})'
+        s = 'Task(run='
+        if isinstance(self.run, str):
+            s += repr(common_utils.truncate_long_string(self.run, 20))
+        else:
+            s += repr(self.run)
+        return s + ')'
+
+
+def _is_cloud_store_url(url: str) -> bool:
+    return bool(re.match(r'^(s3|gs|gcs|r2|cos|https?)://', url))

@@ -1,0 +1,193 @@
+"""Accelerator registry with first-class TPU slice topology.
+
+The reference keeps TPU knowledge scattered across
+sky/utils/accelerator_registry.py (canonical names, "schedulable as custom
+resource" flag), sky/clouds/utils/gcp_utils.py:29-68 (is_tpu / is_tpu_vm /
+is_tpu_vm_pod heuristics) and sky/clouds/gcp.py:460-651 (deploy variables,
+hard-coded host shapes).  Here the topology model is the core abstraction:
+a `TpuSliceSpec` knows its generation, chip/core counts, hosts per slice and
+ICI topology, because the *atomic schedulable unit* of this framework is the
+pod slice (SURVEY.md §7), and the gang launcher / optimizer / provisioner
+all need `num_hosts` and per-host device counts.
+
+Naming convention (matches GCP accelerator types the reference accepts, e.g.
+`tpu-v4-8`, `tpu-v5litepod-16`, `tpu-v5p-128`, `tpu-v6e-32`):
+  tpu-<gen>-<N>  where N counts TensorCores for v2/v3/v4/v5p and chips for
+  v5e (v5litepod) and v6e — the same convention GCP's TPU API uses.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    """Per-generation hardware facts (public Cloud TPU documentation)."""
+    name: str                  # 'v4', 'v5e', ...
+    gcp_prefix: str            # accelerator-type prefix used by the TPU API
+    counts_chips: bool         # True if the name suffix counts chips (v5e/v6e)
+    cores_per_chip: int
+    chips_per_host: int        # chips handled by one host VM at full shape
+    hbm_gb_per_chip: float
+    bf16_tflops_per_chip: float
+    host_vcpus: int
+    host_memory_gb: float
+    supports_preemptible: bool = True
+
+
+# Host shapes: the reference hard-codes 96/240 vCPUs and 334/400GB for
+# TPU-VM hosts (sky/clouds/gcp.py:600-651); we keep per-generation values.
+TPU_GENERATIONS: Dict[str, TpuGeneration] = {
+    'v2': TpuGeneration('v2', 'v2', False, 2, 4, 8, 23, 96, 334),
+    'v3': TpuGeneration('v3', 'v3', False, 2, 4, 16, 61, 96, 334),
+    'v4': TpuGeneration('v4', 'v4', False, 2, 4, 32, 137.5, 240, 400),
+    'v5e': TpuGeneration('v5e', 'v5litepod', True, 1, 4, 16, 98.5, 112, 192),
+    'v5p': TpuGeneration('v5p', 'v5p', False, 2, 4, 95, 229.5, 208, 448),
+    'v6e': TpuGeneration('v6e', 'v6e', True, 1, 4, 32, 459, 180, 720),
+}
+
+_TPU_NAME_RE = re.compile(
+    r'^tpu-(?P<gen>v2|v3|v4|v5e|v5litepod|v5p|v6e)-(?P<count>\d+)$')
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSliceSpec:
+    """Resolved topology of one TPU slice request."""
+    accelerator_name: str      # canonical, e.g. 'tpu-v5p-128'
+    generation: TpuGeneration
+    count: int                 # the N in the name (cores or chips, see gen)
+
+    @property
+    def num_chips(self) -> int:
+        if self.generation.counts_chips:
+            return self.count
+        return max(1, self.count // self.generation.cores_per_chip)
+
+    @property
+    def num_cores(self) -> int:
+        return self.num_chips * self.generation.cores_per_chip
+
+    @property
+    def num_hosts(self) -> int:
+        """Hosts in the slice — the reference's `num_ips_per_node` analog
+        (sky/backends/cloud_vm_ray_backend.py:2550): a slice is ONE logical
+        node with num_hosts IPs, and gang exec must fan out to all of them."""
+        return max(1, self.num_chips // self.generation.chips_per_host)
+
+    @property
+    def chips_per_host(self) -> int:
+        return min(self.num_chips, self.generation.chips_per_host)
+
+    @property
+    def is_pod(self) -> bool:
+        """Multi-host slice (reference: gcp_utils.is_tpu_vm_pod — TPU count
+        > 8 cores, sky/clouds/utils/gcp_utils.py:48)."""
+        return self.num_hosts > 1
+
+    @property
+    def gcp_accelerator_type(self) -> str:
+        """Name the GCP TPU API expects, e.g. 'v5litepod-16', 'v4-8'."""
+        return f'{self.generation.gcp_prefix}-{self.count}'
+
+    @property
+    def total_hbm_gb(self) -> float:
+        return self.num_chips * self.generation.hbm_gb_per_chip
+
+    @property
+    def total_bf16_tflops(self) -> float:
+        return self.num_chips * self.generation.bf16_tflops_per_chip
+
+    def default_runtime_version(self) -> str:
+        return {
+            'v2': 'tpu-vm-base',
+            'v3': 'tpu-vm-base',
+            'v4': 'tpu-vm-v4-base',
+            'v5e': 'v2-alpha-tpuv5-lite',
+            'v5p': 'v2-alpha-tpuv5',
+            'v6e': 'v2-alpha-tpuv6e',
+        }[self.generation.name]
+
+    def ici_topology(self) -> Tuple[int, ...]:
+        """A plausible physical ICI torus shape for the chip count (used by
+        the parallel planner to prefer meshes whose collectives ride ICI)."""
+        chips = self.num_chips
+        if chips <= 4:
+            return (chips,)
+        # Factor into a near-square/cube torus.
+        dims: List[int] = []
+        remaining = chips
+        for _ in range(2):
+            f = _largest_factor_leq(remaining, int(round(remaining ** 0.5)))
+            if f <= 1:
+                break
+            dims.append(f)
+            remaining //= f
+        dims.append(remaining)
+        return tuple(sorted(d for d in dims if d > 1) or (chips,))
+
+
+def _largest_factor_leq(n: int, bound: int) -> int:
+    for f in range(bound, 0, -1):
+        if n % f == 0:
+            return f
+    return 1
+
+
+def is_tpu(accelerators: Optional[Dict[str, int]]) -> bool:
+    if not accelerators:
+        return False
+    return any(a.lower().startswith('tpu-') for a in accelerators)
+
+
+def parse_tpu_accelerator(name: str, count: int = 1) -> TpuSliceSpec:
+    """Parse 'tpu-v5p-128' (count in name) or ('tpu-v5p', 128) style."""
+    name = name.lower()
+    m = _TPU_NAME_RE.fullmatch(name)
+    if m is None:
+        # Allow 'tpu-v5p' + count style (reference accepts
+        # accelerators={'tpu-v5p': 128} dict form).
+        gen_key = name[len('tpu-'):]
+        if gen_key == 'v5litepod':
+            gen_key = 'v5e'
+        if gen_key in TPU_GENERATIONS:
+            gen = TPU_GENERATIONS[gen_key]
+            canonical = f'tpu-{gen.name}-{count}'
+            return TpuSliceSpec(canonical, gen, count)
+        raise exceptions.ResourcesValidationError(
+            f'Invalid TPU accelerator name: {name!r}. Expected e.g. '
+            "'tpu-v4-8', 'tpu-v5e-16', 'tpu-v5p-128', 'tpu-v6e-32'.")
+    gen_key = m.group('gen')
+    if gen_key == 'v5litepod':
+        gen_key = 'v5e'
+    gen = TPU_GENERATIONS[gen_key]
+    n = int(m.group('count'))
+    canonical = f'tpu-{gen.name}-{n}'
+    return TpuSliceSpec(canonical, gen, n)
+
+
+# ---------------------------------------------------------------------------
+# Non-TPU accelerators (kept for multi-cloud parity in the catalog/optimizer;
+# reference: sky/utils/accelerator_registry.py canonical-name list).
+# ---------------------------------------------------------------------------
+_CANONICAL_GPUS = [
+    'A100', 'A100-80GB', 'A10G', 'A10', 'H100', 'H200', 'L4', 'L40S', 'T4',
+    'V100', 'V100-32GB', 'P100', 'K80',
+]
+_GPU_CANONICAL_MAP = {g.lower(): g for g in _CANONICAL_GPUS}
+
+
+def canonicalize_accelerator_name(name: str) -> str:
+    if name.lower().startswith('tpu-'):
+        return parse_tpu_accelerator(name).accelerator_name
+    return _GPU_CANONICAL_MAP.get(name.lower(), name)
+
+
+def is_schedulable_non_gpu_accelerator(name: str) -> bool:
+    """TPUs are scheduled as slice units, never as per-process GPU counts
+    (reference: accelerator_registry.is_schedulable_non_gpu_accelerator,
+    used at cloud_vm_ray_backend.py:414-424)."""
+    return name.lower().startswith('tpu-')

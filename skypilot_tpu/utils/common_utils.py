@@ -1,0 +1,224 @@
+"""Small shared helpers (ids, name validation, retries, yaml io).
+
+Counterpart of the reference's sky/utils/common_utils.py.
+"""
+from __future__ import annotations
+
+import functools
+import getpass
+import hashlib
+import os
+import random
+import re
+import socket
+import sys
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import yaml
+
+CLUSTER_NAME_VALID_REGEX = r'[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?'
+_USER_HASH_FILE = os.path.expanduser('~/.skytpu/user_hash')
+USER_HASH_LENGTH = 8
+
+
+def get_user_hash() -> str:
+    """Stable per-user hash, persisted; used to namespace cloud resources.
+
+    Reference: sky/utils/common_utils.py get_user_hash.
+    """
+    env = os.environ.get('SKYTPU_USER_HASH')
+    if env and re.fullmatch('[0-9a-f]+', env):
+        return env[:USER_HASH_LENGTH]
+    if os.path.exists(_USER_HASH_FILE):
+        with open(_USER_HASH_FILE, encoding='utf-8') as f:
+            h = f.read().strip()
+        if re.fullmatch('[0-9a-f]+', h):
+            return h[:USER_HASH_LENGTH]
+    h = hashlib.md5(
+        f'{getpass.getuser()}+{socket.gethostname()}'.encode()).hexdigest(
+        )[:USER_HASH_LENGTH]
+    os.makedirs(os.path.dirname(_USER_HASH_FILE), exist_ok=True)
+    with open(_USER_HASH_FILE, 'w', encoding='utf-8') as f:
+        f.write(h)
+    return h
+
+
+def get_usage_run_id() -> str:
+    return str(uuid.uuid4())
+
+
+def base36(n: int) -> str:
+    chars = '0123456789abcdefghijklmnopqrstuvwxyz'
+    out = ''
+    n = abs(n)
+    while True:
+        n, r = divmod(n, 36)
+        out = chars[r] + out
+        if n == 0:
+            return out
+
+
+def generate_cluster_name() -> str:
+    return f'skytpu-{base36(int(time.time()))}-{get_user_hash()[:4]}'
+
+
+def check_cluster_name_is_valid(name: Optional[str]) -> None:
+    if name is None:
+        return
+    if not re.fullmatch(CLUSTER_NAME_VALID_REGEX, name):
+        from skypilot_tpu import exceptions
+        raise exceptions.TaskValidationError(
+            f'Cluster name {name!r} is invalid: must match '
+            f'{CLUSTER_NAME_VALID_REGEX} (alphanumeric with -_. separators, '
+            'starting with a letter).')
+
+
+def make_cluster_name_on_cloud(display_name: str, max_length: int = 35) -> str:
+    """Append the user hash and truncate to cloud naming limits.
+
+    Reference: sky/utils/common_utils.py make_cluster_name_on_cloud — cloud
+    resource names embed a user hash so multiple users of one project don't
+    collide, and long display names are content-hashed to fit limits.
+    """
+    user_hash = get_user_hash()
+    name = f'{display_name}-{user_hash}'
+    if len(name) <= max_length:
+        return _sanitize_cloud_name(name)
+    digest = hashlib.md5(display_name.encode()).hexdigest()[:4]
+    prefix_len = max_length - len(user_hash) - len(digest) - 2
+    return _sanitize_cloud_name(
+        f'{display_name[:prefix_len]}-{digest}-{user_hash}')
+
+
+def _sanitize_cloud_name(name: str) -> str:
+    name = re.sub(r'[._]', '-', name.lower())
+    return re.sub(r'[^a-z0-9-]', '', name)
+
+
+def read_yaml(path: str) -> Dict[str, Any]:
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        return yaml.safe_load(f)
+
+
+def read_yaml_all(path: str) -> List[Dict[str, Any]]:
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        return list(yaml.safe_load_all(f))
+
+
+def dump_yaml(path: str, config: Union[Dict[str, Any], List[Any]]) -> None:
+    with open(os.path.expanduser(path), 'w', encoding='utf-8') as f:
+        f.write(dump_yaml_str(config))
+
+
+def dump_yaml_str(config: Union[Dict[str, Any], List[Any]]) -> str:
+    class _Dumper(yaml.SafeDumper):
+        pass
+
+    _Dumper.add_representer(
+        tuple, lambda dumper, data: dumper.represent_list(list(data)))
+    if isinstance(config, list):
+        return yaml.dump_all(config, Dumper=_Dumper, default_flow_style=False)
+    return yaml.dump(config, Dumper=_Dumper, default_flow_style=False)
+
+
+def retry(fn: Optional[Callable] = None, *, max_retries: int = 3,
+          initial_backoff: float = 1.0, max_backoff: float = 30.0,
+          exceptions_to_retry: tuple = (Exception,)) -> Callable:
+    """Exponential backoff retry decorator with jitter."""
+
+    def decorate(f: Callable) -> Callable:
+        @functools.wraps(f)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            backoff = Backoff(initial_backoff, max_backoff)
+            for attempt in range(max_retries):
+                try:
+                    return f(*args, **kwargs)
+                except exceptions_to_retry:
+                    if attempt == max_retries - 1:
+                        raise
+                    time.sleep(backoff.current_backoff())
+            raise AssertionError('unreachable')
+
+        return wrapper
+
+    if fn is not None:
+        return decorate(fn)
+    return decorate
+
+
+class Backoff:
+    """Exponential backoff with jitter (reference: common_utils.Backoff)."""
+    MULTIPLIER = 1.6
+    JITTER = 0.4
+
+    def __init__(self, initial_backoff: float = 5.0,
+                 max_backoff_factor: float = 5.0) -> None:
+        self._initial = True
+        self._backoff = 0.0
+        self._initial_backoff = initial_backoff
+        self._max_backoff = max_backoff_factor * initial_backoff
+
+    def current_backoff(self) -> float:
+        if self._initial:
+            self._initial = False
+            self._backoff = min(self._initial_backoff, self._max_backoff)
+        else:
+            self._backoff = min(self._backoff * self.MULTIPLIER,
+                                self._max_backoff)
+        self._backoff += random.uniform(-self.JITTER * self._backoff,
+                                        self.JITTER * self._backoff)
+        return self._backoff
+
+
+def format_float(num: Union[float, int], precision: int = 1) -> str:
+    if isinstance(num, int) or float(num).is_integer():
+        return str(int(num))
+    return f'{num:.{precision}f}'
+
+
+def parse_memory_gb(mem: Union[str, int, float]) -> float:
+    """Parse '64', '64+', '64x' style memory strings to GB floats."""
+    s = str(mem)
+    if s.endswith(('+', 'x')):
+        s = s[:-1]
+    return float(s)
+
+
+def truncate_long_string(s: str, max_length: int = 35) -> str:
+    if len(s) <= max_length:
+        return s
+    splits = s.split(' ')
+    if len(splits[0]) > max_length:
+        return s[:max_length - 3] + '...'
+    out = ''
+    for part in splits:
+        if len(out) + len(part) + 1 > max_length - 3:
+            break
+        out += part + ' '
+    return out.rstrip() + '...'
+
+
+def class_fullname(cls: type) -> str:
+    return f'{cls.__module__}.{cls.__name__}'
+
+
+def remove_color(s: str) -> str:
+    return re.sub(r'\x1b\[[0-9;]*m', '', s)
+
+
+def is_port_available(port: int, host: str = '127.0.0.1') -> bool:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        try:
+            s.bind((host, port))
+            return True
+        except OSError:
+            return False
+
+
+def find_free_port(start: int = 30000, host: str = '127.0.0.1') -> int:
+    for port in range(start, start + 2000):
+        if is_port_available(port, host):
+            return port
+    raise RuntimeError('No free port found.')

@@ -1,0 +1,19 @@
+"""Boolean environment options (reference: sky/utils/env_options.py)."""
+from __future__ import annotations
+
+import enum
+import os
+
+
+class Options(enum.Enum):
+    IS_DEVELOPER = 'SKYTPU_DEV'
+    SHOW_DEBUG_INFO = 'SKYTPU_DEBUG'
+    DISABLE_LOGGING = 'SKYTPU_DISABLE_USAGE_COLLECTION'
+    MINIMIZE_LOGGING = 'SKYTPU_MINIMIZE_LOGGING'
+    RUNNING_REMOTELY = 'SKYTPU_INTERNAL_RUNNING_REMOTELY'
+
+    def get(self) -> bool:
+        return os.environ.get(self.value, '0') in ('1', 'true', 'True')
+
+    def __bool__(self) -> bool:
+        return self.get()

@@ -1,0 +1,64 @@
+"""Central on-disk layout. Everything lives under the state dir
+(~/.skytpu by default; SKYTPU_STATE_DIR overrides — tests point it at a
+tmp dir)."""
+from __future__ import annotations
+
+import os
+
+
+def state_dir() -> str:
+    d = os.environ.get('SKYTPU_STATE_DIR', os.path.expanduser('~/.skytpu'))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def state_db_path() -> str:
+    return os.path.join(state_dir(), 'state.db')
+
+
+def generated_dir() -> str:
+    d = os.path.join(state_dir(), 'generated')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def local_clusters_dir() -> str:
+    d = os.path.join(state_dir(), 'local_clusters')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def fake_cloud_dir() -> str:
+    d = os.path.join(state_dir(), 'fake_cloud')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def locks_dir() -> str:
+    d = os.path.join(state_dir(), 'locks')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def logs_dir() -> str:
+    d = os.path.join(state_dir(), 'logs')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def catalogs_dir() -> str:
+    d = os.path.join(state_dir(), 'catalogs')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def keys_dir() -> str:
+    d = os.path.join(state_dir(), 'keys')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def benchmarks_dir() -> str:
+    d = os.path.join(state_dir(), 'benchmarks')
+    os.makedirs(d, exist_ok=True)
+    return d

@@ -1,0 +1,222 @@
+"""JSON Schemas for task YAML, resources, services, and user config.
+
+Counterpart of the reference's sky/utils/schemas.py:1-987.  Validation is
+done with `jsonschema` at every YAML ingestion point so user errors are
+caught before any cloud call.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Type
+
+import jsonschema
+
+
+def _case_insensitive_enum(values) -> Dict[str, Any]:
+    return {
+        'type': 'string',
+        'case_insensitive_enum': list(values),
+    }
+
+
+_RESOURCES_PROPERTIES: Dict[str, Any] = {
+    'cloud': {'type': ['string', 'null']},
+    'region': {'type': ['string', 'null']},
+    'zone': {'type': ['string', 'null']},
+    'instance_type': {'type': ['string', 'null']},
+    'cpus': {'type': ['string', 'number', 'null']},
+    'memory': {'type': ['string', 'number', 'null']},
+    'accelerators': {'type': ['string', 'object', 'null']},
+    'accelerator_args': {
+        'type': ['object', 'null'],
+        'properties': {
+            'runtime_version': {'type': 'string'},
+            'tpu_name': {'type': ['string', 'null']},
+            'tpu_vm': {'type': 'boolean'},
+            'topology': {'type': ['string', 'null']},
+        },
+        'additionalProperties': False,
+    },
+    'use_spot': {'type': ['boolean', 'null']},
+    'job_recovery': {'type': ['string', 'object', 'null']},
+    'disk_size': {'type': ['integer', 'null']},
+    'disk_tier': {'type': ['string', 'null']},
+    'ports': {
+        'anyOf': [
+            {'type': 'string'},
+            {'type': 'integer'},
+            {'type': 'array', 'items': {'type': ['string', 'integer']}},
+            {'type': 'null'},
+        ]
+    },
+    'labels': {'type': ['object', 'null']},
+    'image_id': {'type': ['string', 'object', 'null']},
+    'any_of': {'type': 'array'},
+    'ordered': {'type': 'array'},
+    '_cluster_config_overrides': {'type': ['object', 'null']},
+}
+
+
+def get_resources_schema() -> Dict[str, Any]:
+    return {
+        '$schema': 'https://json-schema.org/draft/2020-12/schema',
+        'type': 'object',
+        'properties': _RESOURCES_PROPERTIES,
+        'additionalProperties': False,
+    }
+
+
+def get_storage_schema() -> Dict[str, Any]:
+    return {
+        'type': 'object',
+        'properties': {
+            'name': {'type': ['string', 'null']},
+            'source': {
+                'anyOf': [{'type': 'string'},
+                          {'type': 'array', 'items': {'type': 'string'}},
+                          {'type': 'null'}]
+            },
+            'store': {'type': ['string', 'null']},
+            'persistent': {'type': 'boolean'},
+            'mode': {'type': 'string'},
+            '_force_delete': {'type': 'boolean'},
+        },
+        'additionalProperties': False,
+    }
+
+
+def get_service_schema() -> Dict[str, Any]:
+    """SkyServe-style service section (reference: schemas.get_service_schema)."""
+    return {
+        'type': 'object',
+        'required': ['readiness_probe'],
+        'properties': {
+            'readiness_probe': {
+                'anyOf': [
+                    {'type': 'string'},
+                    {
+                        'type': 'object',
+                        'required': ['path'],
+                        'properties': {
+                            'path': {'type': 'string'},
+                            'initial_delay_seconds': {'type': 'number'},
+                            'timeout_seconds': {'type': 'number'},
+                            'post_data': {'type': ['string', 'object']},
+                            'headers': {'type': 'object'},
+                        },
+                        'additionalProperties': False,
+                    },
+                ]
+            },
+            'replica_policy': {
+                'type': 'object',
+                'properties': {
+                    'min_replicas': {'type': 'integer', 'minimum': 0},
+                    'max_replicas': {'type': ['integer', 'null']},
+                    'target_qps_per_replica': {'type': ['number', 'null']},
+                    'upscale_delay_seconds': {'type': 'number'},
+                    'downscale_delay_seconds': {'type': 'number'},
+                    'base_ondemand_fallback_replicas': {'type': 'integer'},
+                    'dynamic_ondemand_fallback': {'type': 'boolean'},
+                },
+                'additionalProperties': False,
+            },
+            'replicas': {'type': 'integer'},
+            'load_balancing_policy': {'type': ['string', 'null']},
+        },
+        'additionalProperties': False,
+    }
+
+
+def get_task_schema() -> Dict[str, Any]:
+    return {
+        '$schema': 'https://json-schema.org/draft/2020-12/schema',
+        'type': 'object',
+        'properties': {
+            'name': {'type': ['string', 'null']},
+            'workdir': {'type': ['string', 'null']},
+            'setup': {'type': ['string', 'null']},
+            'run': {'type': ['string', 'null']},
+            'envs': {
+                'type': ['object', 'null'],
+                'patternProperties': {
+                    r'^[a-zA-Z_][a-zA-Z0-9_]*$':
+                        {'type': ['string', 'number', 'null']}
+                },
+                'additionalProperties': False,
+            },
+            'num_nodes': {'type': ['integer', 'null'], 'minimum': 1},
+            'resources': {'type': ['object', 'null']},
+            'file_mounts': {'type': ['object', 'null']},
+            'storage_mounts': {'type': ['object', 'null']},
+            'service': {'type': ['object', 'null']},
+            'inputs': {'type': ['object', 'null']},
+            'outputs': {'type': ['object', 'null']},
+        },
+        'additionalProperties': False,
+    }
+
+
+def get_config_schema() -> Dict[str, Any]:
+    """~/.skytpu/config.yaml schema (reference: schemas.get_config_schema)."""
+    controller_resources = {
+        'type': 'object',
+        'properties': {
+            'controller': {
+                'type': 'object',
+                'properties': {'resources': {'type': 'object'}},
+                'additionalProperties': True,
+            },
+        },
+        'additionalProperties': True,
+    }
+    return {
+        '$schema': 'https://json-schema.org/draft/2020-12/schema',
+        'type': 'object',
+        'properties': {
+            'jobs': controller_resources,
+            'serve': controller_resources,
+            'gcp': {
+                'type': 'object',
+                'properties': {
+                    'project_id': {'type': 'string'},
+                    'specific_reservations': {'type': 'array'},
+                    'managed_instance_group': {'type': 'object'},
+                },
+                'additionalProperties': True,
+            },
+            'admin_policy': {'type': 'string'},
+            'allowed_clouds': {'type': 'array',
+                               'items': {'type': 'string'}},
+            'docker': {'type': 'object'},
+            'nvidia_gpus': {'type': 'object'},
+            'usage': {'type': 'object'},
+        },
+        'additionalProperties': True,
+    }
+
+
+def _check_case_insensitive_enums(instance: Any, schema: Dict[str, Any],
+                                  path: str = '') -> None:
+    """Our small extension: `case_insensitive_enum` keyword (the reference
+    uses the same trick for cloud names, sky/utils/schemas.py)."""
+    if isinstance(schema, dict):
+        enum_vals = schema.get('case_insensitive_enum')
+        if enum_vals is not None and isinstance(instance, str):
+            if instance.lower() not in [v.lower() for v in enum_vals]:
+                raise jsonschema.ValidationError(
+                    f'{instance!r} is not one of {enum_vals} '
+                    f'(case-insensitive) at {path or "root"}')
+        if isinstance(instance, dict):
+            for key, subschema in schema.get('properties', {}).items():
+                if key in instance:
+                    _check_case_insensitive_enums(instance[key], subschema,
+                                                  f'{path}.{key}')
+
+
+def validate(instance: Any, schema: Dict[str, Any],
+             err_class: Type[Exception], err_prefix: str = '') -> None:
+    try:
+        jsonschema.validate(instance, schema)
+        _check_case_insensitive_enums(instance, schema)
+    except jsonschema.ValidationError as e:
+        raise err_class(f'{err_prefix}{e.message}') from e

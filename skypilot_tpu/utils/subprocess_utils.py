@@ -1,0 +1,151 @@
+"""Subprocess helpers: parallel fanout, process-tree management, daemons.
+
+Counterpart of sky/utils/subprocess_utils.py:1-339 in the reference; the
+parallel fanout here is what drives per-host SSH across a pod slice, and
+`launch_new_process_tree` daemonizes controller processes (managed jobs /
+serve) so they outlive the submitting process.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+
+def _import_psutil():
+    try:
+        import psutil  # type: ignore
+        return psutil
+    except ImportError:
+        return None
+
+
+def run(cmd: Union[str, Sequence[str]], **kwargs: Any) -> subprocess.CompletedProcess:
+    shell = isinstance(cmd, str)
+    kwargs.setdefault('shell', shell)
+    kwargs.setdefault('check', True)
+    kwargs.setdefault('executable', '/bin/bash' if shell else None)
+    if kwargs['executable'] is None:
+        kwargs.pop('executable')
+    return subprocess.run(cmd, **kwargs)
+
+
+def run_no_outputs(cmd: Union[str, Sequence[str]], **kwargs: Any):
+    return run(cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+               **kwargs)
+
+
+def get_parallel_threads(n_jobs: Optional[int] = None) -> int:
+    cpus = os.cpu_count() or 4
+    limit = max(4, cpus - 1)
+    if n_jobs is not None:
+        return min(n_jobs, limit)
+    return limit
+
+
+def run_in_parallel(func: Callable, args: Sequence[Any],
+                    num_threads: Optional[int] = None) -> List[Any]:
+    """Map `func` over `args` with a thread pool; preserves order; re-raises
+    the first exception.  Reference: subprocess_utils.run_in_parallel."""
+    if not args:
+        return []
+    if len(args) == 1:
+        return [func(args[0])]
+    with ThreadPoolExecutor(
+            max_workers=get_parallel_threads(num_threads)) as pool:
+        return list(pool.map(func, args))
+
+
+def kill_process_daemon(parent_pid: int, child_pid: int) -> None:
+    """Spawn a tiny watchdog that kills `child_pid`'s tree if `parent_pid`
+    dies.  Reference: sky/skylet/subprocess_daemon.py — prevents orphaned
+    user-job process trees when a job driver is killed."""
+    daemon_code = (
+        'import os, sys, time, signal\n'
+        f'parent, child = {parent_pid}, {child_pid}\n'
+        'while True:\n'
+        '    try:\n'
+        '        os.kill(parent, 0)\n'
+        '    except OSError:\n'
+        '        break\n'
+        '    try:\n'
+        '        os.kill(child, 0)\n'
+        '    except OSError:\n'
+        '        sys.exit(0)\n'
+        '    time.sleep(1)\n'
+        'try:\n'
+        '    os.killpg(os.getpgid(child), signal.SIGTERM)\n'
+        '    time.sleep(3)\n'
+        '    os.killpg(os.getpgid(child), signal.SIGKILL)\n'
+        'except OSError:\n'
+        '    pass\n')
+    subprocess.Popen(['python3', '-u', '-c', daemon_code],
+                     start_new_session=True,
+                     stdout=subprocess.DEVNULL,
+                     stderr=subprocess.DEVNULL)
+
+
+def kill_children_processes(parent_pids: Optional[List[int]] = None,
+                            force: bool = False) -> None:
+    """Kill all descendant processes of the given pids (default: self)."""
+    psutil = _import_psutil()
+    sig = signal.SIGKILL if force else signal.SIGTERM
+    if psutil is not None:
+        parents = [psutil.Process(pid) for pid in (parent_pids or
+                                                   [os.getpid()])]
+        procs = []
+        for parent in parents:
+            try:
+                procs.extend(parent.children(recursive=True))
+            except psutil.NoSuchProcess:
+                pass
+        for proc in procs:
+            try:
+                proc.send_signal(sig)
+            except psutil.NoSuchProcess:
+                pass
+        return
+    # Fallback without psutil: use process groups.
+    for pid in (parent_pids or [os.getpid()]):
+        try:
+            os.killpg(os.getpgid(pid), sig)
+        except OSError:
+            pass
+
+
+def launch_new_process_tree(cmd: str, log_output: str = '/dev/null') -> int:
+    """Double-fork-style detach via setsid+nohup; returns the daemon pid.
+
+    Reference: subprocess_utils.launch_new_process_tree — used to start
+    controller processes that must survive the CLI process.
+    """
+    wrapped = (f'nohup bash -c {shlex.quote(cmd)} '
+               f'>> {shlex.quote(log_output)} 2>&1 & echo $!')
+    proc = subprocess.run(wrapped, shell=True, check=True,
+                          capture_output=True, text=True,
+                          start_new_session=True, executable='/bin/bash')
+    return int(proc.stdout.strip().splitlines()[-1])
+
+
+def process_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def wait_for(predicate: Callable[[], bool], timeout: float,
+             interval: float = 0.2, desc: str = 'condition') -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f'Timed out after {timeout}s waiting for {desc}.')

@@ -1,0 +1,147 @@
+"""Chrome-trace-event tracing for control-plane operations.
+
+Re-implements the reference's decorator-based tracer
+(sky/utils/timeline.py:1-133): `@timeline.event` wraps any callable, and
+`FileLockEvent` wraps lock acquisition, emitting complete ('X'-phase style
+begin/end 'B'/'E') events into a JSON trace written at process exit when
+SKYTPU_DEBUG=1.  Workload-level profiling is handled separately by
+`jax.profiler` hooks in skypilot_tpu/train (the TPU analog of what the
+reference delegates to user tools, SURVEY.md §5).
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, List, Optional, Union
+
+import filelock
+
+_events: List[dict] = []
+_events_lock = threading.Lock()
+_enabled = os.environ.get('SKYTPU_DEBUG') == '1'
+_save_path: Optional[str] = None
+
+
+def _now_us() -> int:
+    return int(time.time() * 1e6)
+
+
+class Event:
+    """Record a begin/end event pair around a code region."""
+
+    def __init__(self, name: str, message: Optional[str] = None) -> None:
+        self._name = name
+        self._message = message
+
+    def begin(self) -> None:
+        if not _enabled:
+            return
+        event = {
+            'name': self._name,
+            'cat': 'event',
+            'ph': 'B',
+            'ts': _now_us(),
+            'pid': os.getpid(),
+            'tid': threading.get_ident(),
+        }
+        if self._message is not None:
+            event['args'] = {'message': self._message}
+        with _events_lock:
+            _events.append(event)
+
+    def end(self) -> None:
+        if not _enabled:
+            return
+        with _events_lock:
+            _events.append({
+                'name': self._name,
+                'cat': 'event',
+                'ph': 'E',
+                'ts': _now_us(),
+                'pid': os.getpid(),
+                'tid': threading.get_ident(),
+            })
+
+    def __enter__(self) -> 'Event':
+        self.begin()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self.end()
+
+
+def event(name_or_fn: Union[str, Callable], message: Optional[str] = None):
+    """Decorator / context factory: `@timeline.event` or `timeline.event('x')`."""
+    if isinstance(name_or_fn, str):
+        return Event(name_or_fn, message)
+    fn = name_or_fn
+
+    @functools.wraps(fn)
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        with Event(f'{fn.__module__}.{fn.__qualname__}'):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+class FileLockEvent:
+    """A filelock whose acquire/hold phases show up in the trace.
+
+    Reference: sky/utils/timeline.py FileLockEvent — lock contention is one
+    of the main sources of control-plane latency, so it is traced explicitly.
+    """
+
+    def __init__(self, lockfile: str, timeout: float = -1) -> None:
+        self._lockfile = lockfile
+        os.makedirs(os.path.dirname(os.path.abspath(lockfile)), exist_ok=True)
+        self._lock = filelock.FileLock(lockfile, timeout)
+        self._hold_event = Event(f'[FileLock.hold]:{lockfile}')
+
+    def acquire(self) -> None:
+        with Event(f'[FileLock.acquire]:{self._lockfile}'):
+            self._lock.acquire()
+        self._hold_event.begin()
+
+    def release(self) -> None:
+        self._lock.release()
+        self._hold_event.end()
+
+    def __enter__(self) -> 'FileLockEvent':
+        self.acquire()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self.release()
+
+    def __call__(self, fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            with self:
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def save_timeline() -> None:
+    if not _enabled or not _events:
+        return
+    path = _save_path or os.environ.get(
+        'SKYTPU_TIMELINE_FILE',
+        os.path.expanduser(f'~/.skytpu/timeline-{os.getpid()}.json'))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with _events_lock:
+        payload = {
+            'traceEvents': list(_events),
+            'displayTimeUnit': 'ms',
+            'otherData': {'argv': ' '.join(os.sys.argv)},
+        }
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f)
+
+
+if _enabled:
+    atexit.register(save_timeline)

@@ -1,0 +1,39 @@
+"""Test harness config.
+
+All tests run on CPU with an 8-device virtual TPU-like mesh
+(`--xla_force_host_platform_device_count=8`), mirroring how the driver
+dry-runs multi-chip sharding (see __graft_entry__.dryrun_multichip).
+State dirs are redirected to a per-session tmp dir so tests never touch
+~/.skytpu.
+"""
+import os
+
+# Must be set before jax (or anything importing jax) is imported.
+os.environ['JAX_PLATFORMS'] = 'cpu'
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _isolated_state(tmp_path, monkeypatch):
+    """Redirect all on-disk state to a per-test tmp dir."""
+    home = tmp_path / 'home'
+    home.mkdir()
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(home / '.skytpu'))
+    monkeypatch.setenv('SKYTPU_CONFIG', str(home / 'config.yaml'))
+    monkeypatch.setenv('SKYTPU_USER_HASH', 'abcd1234')
+    # Reset module-level caches that capture state paths.
+    import skypilot_tpu.config as config_lib
+    config_lib.reload()
+    try:
+        from skypilot_tpu import global_user_state
+        global_user_state.reset_for_tests()
+    except ImportError:
+        pass
+    from skypilot_tpu.clouds import fake as fake_cloud
+    fake_cloud.fake_cloud_state().reset()
+    yield

@@ -1,0 +1,146 @@
+"""Unit tests for the Resources model (reference analog:
+tests/unit_tests test_resources + TPU cases from
+tests/test_optimizer_dryruns.py:134-147 test_partial_tpu/test_invalid_cloud_tpu)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import accelerator_registry
+
+Resources = resources_lib.Resources
+
+
+class TestTpuParsing:
+
+    def test_slice_topology_v5p(self):
+        spec = accelerator_registry.parse_tpu_accelerator('tpu-v5p-128')
+        assert spec.num_chips == 64
+        assert spec.num_hosts == 16
+        assert spec.is_pod
+        assert spec.gcp_accelerator_type == 'v5p-128'
+
+    def test_slice_topology_v5e(self):
+        spec = accelerator_registry.parse_tpu_accelerator('tpu-v5e-16')
+        assert spec.num_chips == 16
+        assert spec.num_hosts == 4
+        assert spec.gcp_accelerator_type == 'v5litepod-16'
+
+    def test_single_host(self):
+        spec = accelerator_registry.parse_tpu_accelerator('tpu-v4-8')
+        assert spec.num_chips == 4
+        assert spec.num_hosts == 1
+        assert not spec.is_pod
+
+    def test_v6e(self):
+        spec = accelerator_registry.parse_tpu_accelerator('tpu-v6e-32')
+        assert spec.num_chips == 32
+        assert spec.num_hosts == 8
+
+    def test_dict_form_count(self):
+        r = Resources(accelerators={'tpu-v5e': 16})
+        assert r.tpu_slice is not None
+        assert r.tpu_slice.num_chips == 16
+        assert r.accelerators == {'tpu-v5e-16': 1}
+
+    def test_v5litepod_alias(self):
+        spec = accelerator_registry.parse_tpu_accelerator('tpu-v5litepod-16')
+        assert spec.accelerator_name == 'tpu-v5e-16'
+
+    def test_invalid_name(self):
+        with pytest.raises(exceptions.ResourcesValidationError):
+            accelerator_registry.parse_tpu_accelerator('tpu-v99-8')
+
+
+class TestResources:
+
+    def test_defaults(self):
+        r = Resources()
+        assert r.cloud is None
+        assert not r.use_spot
+        assert not r.use_spot_specified
+        assert r.tpu_slice is None
+
+    def test_runtime_version_default(self):
+        r = Resources(accelerators='tpu-v5p-8')
+        assert r.accelerator_args['runtime_version'] == 'v2-alpha-tpuv5'
+
+    def test_tpu_needs_cleanup_after_preemption(self):
+        # Reference: sky/resources.py:633.
+        assert Resources(accelerators='tpu-v4-8').\
+            need_cleanup_after_preemption_or_failure
+        assert not Resources(cpus='4').\
+            need_cleanup_after_preemption_or_failure
+
+    def test_tpu_node_rejected(self):
+        with pytest.raises(exceptions.ResourcesValidationError):
+            Resources(accelerators='tpu-v2-8',
+                      accelerator_args={'tpu_vm': False})
+
+    def test_accelerator_args_on_non_tpu(self):
+        with pytest.raises(exceptions.ResourcesValidationError):
+            Resources(accelerators='A100',
+                      accelerator_args={'runtime_version': 'x'})
+
+    def test_zone_infers_region(self):
+        r = Resources(zone='us-central2-b')
+        assert r.region == 'us-central2'
+
+    def test_invalid_region_for_cloud(self):
+        with pytest.raises(exceptions.ResourcesValidationError):
+            Resources(cloud='gcp', region='mars-central1')
+
+    def test_bad_cpus(self):
+        with pytest.raises(exceptions.ResourcesValidationError):
+            Resources(cpus='abc')
+
+    def test_ports_parsing(self):
+        r = Resources(ports=[8080, '9000-9010'])
+        assert r.ports == ['8080', '9000-9010']
+        with pytest.raises(exceptions.ResourcesValidationError):
+            Resources(ports='99999')
+
+    def test_copy_override(self):
+        r = Resources(accelerators='tpu-v5e-16', use_spot=True)
+        r2 = r.copy(use_spot=False)
+        assert not r2.use_spot
+        assert r2.tpu_slice.num_chips == 16
+        assert r.use_spot  # original unchanged
+
+    def test_yaml_roundtrip(self):
+        r = Resources(cloud='gcp', accelerators='tpu-v5p-32', use_spot=True,
+                      region='us-east5', disk_size=100)
+        r2 = Resources.from_yaml_config(r.to_yaml_config())
+        assert r == r2
+        assert hash(r) == hash(r2)
+
+    def test_any_of(self):
+        rs = Resources.from_yaml_config({
+            'accelerators': 'tpu-v5e-8',
+            'any_of': [{'use_spot': True}, {'use_spot': False}],
+        })
+        assert isinstance(rs, set)
+        assert len(rs) == 2
+
+    def test_ordered(self):
+        rs = Resources.from_yaml_config({
+            'ordered': [{'accelerators': 'tpu-v5p-8'},
+                        {'accelerators': 'tpu-v5e-8'}],
+        })
+        assert isinstance(rs, list)
+        assert rs[0].tpu_slice.generation.name == 'v5p'
+
+    def test_less_demanding_than(self):
+        want = Resources(accelerators='tpu-v5e-16')
+        have = Resources(cloud='gcp', instance_type='TPU-VM',
+                         accelerators='tpu-v5e-16')
+        assert want.less_demanding_than(have)
+        bigger = Resources(accelerators='tpu-v5e-32')
+        assert not bigger.less_demanding_than(have)
+
+    def test_cost(self):
+        r = Resources(cloud='gcp', instance_type='TPU-VM',
+                      accelerators='tpu-v5e-16')
+        # 16 chips * $1.20/chip-hr.
+        assert r.get_cost(3600) == pytest.approx(19.2)
+        spot = r.copy(use_spot=True)
+        assert spot.get_cost(3600) == pytest.approx(19.2 * 0.4)

@@ -1,0 +1,119 @@
+"""Unit tests for Task YAML round-trip, env substitution, and DAGs."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+
+Task = task_lib.Task
+
+
+class TestTask:
+
+    def test_basic(self):
+        t = Task('train', run='echo hi', num_nodes=2)
+        assert t.num_nodes == 2
+        t.validate()
+
+    def test_invalid_name(self):
+        t = Task('bad name!')
+        with pytest.raises(exceptions.TaskValidationError):
+            t.validate()
+
+    def test_env_substitution(self):
+        t = Task.from_yaml_config({
+            'envs': {'MODEL': 'llama3-8b', 'BS': 32},
+            'run': 'python train.py --model ${MODEL} --bs $BS',
+        })
+        assert t.run == 'python train.py --model llama3-8b --bs 32'
+        assert t.envs == {'MODEL': 'llama3-8b', 'BS': '32'}
+
+    def test_env_none_value_rejected(self):
+        with pytest.raises(exceptions.TaskValidationError):
+            Task.from_yaml_config({'envs': {'MODEL': None}, 'run': 'x'})
+
+    def test_env_overrides(self):
+        t = Task.from_yaml_config({'envs': {'A': '1'}, 'run': 'echo $A'},
+                                  env_overrides=[('A', '2')])
+        assert t.run == 'echo 2'
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(exceptions.TaskValidationError):
+            Task.from_yaml_config({'runn': 'typo'})
+
+    def test_num_nodes_validation(self):
+        with pytest.raises(exceptions.TaskValidationError):
+            Task(num_nodes=0)
+
+    def test_yaml_roundtrip(self, tmp_path):
+        yaml_str = textwrap.dedent("""\
+            name: tpu-train
+            num_nodes: 2
+            resources:
+              accelerators: tpu-v5e-16
+              use_spot: true
+            envs:
+              EPOCHS: '3'
+            setup: pip list
+            run: python train.py
+        """)
+        p = tmp_path / 'task.yaml'
+        p.write_text(yaml_str)
+        t = Task.from_yaml(str(p))
+        config = t.to_yaml_config()
+        t2 = Task.from_yaml_config(config)
+        assert t2.name == 'tpu-train'
+        assert t2.num_nodes == 2
+        (r,) = t2.get_preferred_resources()
+        assert r.use_spot
+        assert r.tpu_slice.num_chips == 16
+
+    def test_callable_run(self):
+        def run_fn(rank, ips):
+            return f'echo rank={rank} n={len(ips)}'
+
+        t = Task(run=run_fn)
+        t.validate()
+
+    def test_missing_file_mount_source(self):
+        with pytest.raises(exceptions.TaskValidationError):
+            Task().set_file_mounts({'/dst': '/nonexistent/source/path'})
+
+
+class TestDag:
+
+    def test_chain(self):
+        with dag_lib.Dag() as d:
+            a = Task('a', run='echo a')
+            b = Task('b', run='echo b')
+            c = Task('c', run='echo c')
+            a >> b >> c
+        assert len(d) == 3
+        assert d.is_chain()
+        d.validate()
+
+    def test_non_chain(self):
+        with dag_lib.Dag() as d:
+            a = Task('a', run='x')
+            b = Task('b', run='x')
+            c = Task('c', run='x')
+            a >> c
+            b >> c
+        assert not d.is_chain()
+
+    def test_cycle_rejected(self):
+        with dag_lib.Dag() as d:
+            a = Task('a', run='x')
+            b = Task('b', run='x')
+            a >> b
+            b >> a
+        with pytest.raises(exceptions.DagError):
+            d.validate()
+
+    def test_rshift_outside_dag(self):
+        a = Task('a', run='x')
+        b = Task('b', run='x')
+        with pytest.raises(exceptions.DagError):
+            a >> b
