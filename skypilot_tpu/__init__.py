@@ -1,2 +1,58 @@
-"""skypilot_tpu: a TPU-native cloud orchestration + workload framework."""
+"""skypilot_tpu: a TPU-native cloud-orchestration + workload framework.
+
+Public SDK (reference: sky/__init__.py:104-190):
+    sky.launch / exec / status / start / stop / down / autostop
+    sky.queue / cancel / tail_logs / download_logs / job_status
+    sky.storage_ls / storage_delete / cost_report
+    sky.Task / Resources / Dag / optimize
+plus the TPU workload library under skypilot_tpu.{models,ops,parallel,train}.
+
+Exports are lazy (PEP 562) so that on-cluster agent processes — which spawn
+one interpreter per RPC (agent/rpc.py) — don't pay the full SDK import
+cost (pandas/networkx) on every call.
+"""
 __version__ = '0.1.0'
+
+_EXPORTS = {
+    'Dag': ('skypilot_tpu.dag', 'Dag'),
+    'Resources': ('skypilot_tpu.resources', 'Resources'),
+    'Task': ('skypilot_tpu.task', 'Task'),
+    'exceptions': ('skypilot_tpu.exceptions', None),
+    'check': ('skypilot_tpu.check', 'check'),
+    'autostop': ('skypilot_tpu.core', 'autostop'),
+    'cancel': ('skypilot_tpu.core', 'cancel'),
+    'cost_report': ('skypilot_tpu.core', 'cost_report'),
+    'down': ('skypilot_tpu.core', 'down'),
+    'download_logs': ('skypilot_tpu.core', 'download_logs'),
+    'job_status': ('skypilot_tpu.core', 'job_status'),
+    'queue': ('skypilot_tpu.core', 'queue'),
+    'start': ('skypilot_tpu.core', 'start'),
+    'status': ('skypilot_tpu.core', 'status'),
+    'stop': ('skypilot_tpu.core', 'stop'),
+    'storage_delete': ('skypilot_tpu.core', 'storage_delete'),
+    'storage_ls': ('skypilot_tpu.core', 'storage_ls'),
+    'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+    'exec': ('skypilot_tpu.execution', 'exec_'),
+    'launch': ('skypilot_tpu.execution', 'launch'),
+    'ClusterStatus': ('skypilot_tpu.global_user_state', 'ClusterStatus'),
+    'Optimizer': ('skypilot_tpu.optimizer', 'Optimizer'),
+    'OptimizeTarget': ('skypilot_tpu.optimizer', 'OptimizeTarget'),
+    'optimize': ('skypilot_tpu.optimizer', 'optimize'),
+}
+
+__all__ = list(_EXPORTS) + ['__version__']
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+        module_name, attr = _EXPORTS[name]
+        module = importlib.import_module(module_name)
+        value = module if attr is None else getattr(module, attr)
+        globals()[name] = value  # cache
+        return value
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+
+
+def __dir__():
+    return sorted(__all__)

@@ -1,0 +1,4 @@
+from skypilot_tpu.cli import main
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,76 @@
+"""Pluggable admin policy hooks.
+
+Counterpart of the reference's sky/admin_policy.py:1-101 +
+sky/utils/admin_policy_utils.py: a dotted-path-configured `AdminPolicy`
+class whose `validate_and_mutate(UserRequest)` runs on every launch
+(execution.py:171 in the reference), letting org admins enforce e.g.
+label/spot/region policies centrally via ~/.skytpu/config.yaml:
+
+    admin_policy: mypkg.policies.MyPolicy
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import typing
+from typing import Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import dag as dag_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class UserRequest:
+    dag: 'dag_lib.Dag'
+    skytpu_config: dict
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    dag: 'dag_lib.Dag'
+    skytpu_config: dict
+
+
+class AdminPolicy:
+    """Subclass and implement validate_and_mutate."""
+
+    @classmethod
+    def validate_and_mutate(cls,
+                            user_request: UserRequest) -> MutatedUserRequest:
+        raise NotImplementedError
+
+
+def _load_policy() -> Optional[type]:
+    path = config_lib.get_nested(('admin_policy',), None)
+    if path is None:
+        return None
+    module_path, _, class_name = path.rpartition('.')
+    try:
+        module = importlib.import_module(module_path)
+        policy = getattr(module, class_name)
+    except (ImportError, AttributeError) as e:
+        raise exceptions.InvalidSkyTpuConfigError(
+            f'Cannot load admin policy {path!r}: {e}') from e
+    if not issubclass(policy, AdminPolicy):
+        raise exceptions.InvalidSkyTpuConfigError(
+            f'{path} is not an AdminPolicy subclass.')
+    return policy
+
+
+def apply(dag: 'dag_lib.Dag') -> 'dag_lib.Dag':
+    if getattr(dag, 'policy_applied', False):
+        return dag
+    policy = _load_policy()
+    if policy is None:
+        return dag
+    request = UserRequest(dag=dag, skytpu_config=config_lib.to_dict())
+    mutated = policy.validate_and_mutate(request)
+    mutated.dag.policy_applied = True
+    logger.debug(f'Admin policy {policy.__name__} applied.')
+    return mutated.dag

@@ -1,0 +1,56 @@
+"""On-cluster runtime constants, incl. the rank/env rendezvous contract.
+
+The reference's contract (sky/skylet/constants.py:296-299) is
+SKYPILOT_NODE_RANK / NODE_IPS / NUM_NODES / NUM_GPUS_PER_NODE, consumed by
+torchrun/NCCL recipes.  The TPU-native contract replaces the NCCL
+rendezvous with `jax.distributed.initialize` inputs (SURVEY.md §2.12):
+one process per *host*, ranks ordered head-slice-first then by position in
+the slice, coordinator = host 0.
+
+For a task with num_nodes logical nodes on slices of H hosts each, there
+are num_nodes*H processes — matching the reference's TPU-pod behavior
+(`num_actual_nodes = task.num_nodes * handle.num_ips_per_node`,
+cloud_vm_ray_backend.py:5075).
+"""
+
+AGENT_VERSION = 1
+
+# Rank/env contract injected into every job process.
+ENV_NODE_RANK = 'SKYTPU_NODE_RANK'          # host rank, 0..N-1
+ENV_NODE_IPS = 'SKYTPU_NODE_IPS'            # newline-separated host IPs
+ENV_NUM_NODES = 'SKYTPU_NUM_NODES'          # total host count
+ENV_NUM_TPU_CHIPS_PER_HOST = 'SKYTPU_NUM_TPU_CHIPS_PER_HOST'
+ENV_ACCELERATOR = 'SKYTPU_ACCELERATOR'      # e.g. tpu-v5p-128
+
+# jax.distributed rendezvous (data plane).
+ENV_COORDINATOR_ADDR = 'SKYTPU_COORDINATOR_ADDR'   # host0_ip:port
+ENV_PROCESS_ID = 'SKYTPU_PROCESS_ID'               # == host rank
+ENV_NUM_PROCESSES = 'SKYTPU_NUM_PROCESSES'         # == total hosts
+COORDINATOR_PORT = 8476
+
+# Multislice (DCN) contract — one slice per logical node.
+ENV_MEGASCALE_COORDINATOR = 'MEGASCALE_COORDINATOR_ADDRESS'
+ENV_MEGASCALE_NUM_SLICES = 'MEGASCALE_NUM_SLICES'
+ENV_MEGASCALE_SLICE_ID = 'MEGASCALE_SLICE_ID'
+
+# Job/cluster env.
+ENV_CLUSTER_NAME = 'SKYTPU_CLUSTER_NAME'
+ENV_JOB_ID = 'SKYTPU_JOB_ID'
+ENV_TASK_ID = 'SKYTPU_TASK_ID'
+
+# Agent-side filesystem layout, rooted at the per-host root dir
+# (a real VM's $HOME, or the host dir of a local cluster).
+AGENT_DIR = '.skytpu_agent'
+JOBS_DB = 'jobs.db'
+AGENT_LOG = 'agent.log'
+AGENT_PID = 'agent.pid'
+AGENT_CONFIG = 'agent_config.json'
+JOB_LOGS_DIR = 'job_logs'
+WORKDIR = 'workdir'
+TASK_SCRIPTS_DIR = 'tasks'
+
+# Event cadence (reference: skylet events.py:28 — 20s loop; autostop 60s).
+AGENT_LOOP_INTERVAL_S = 5
+AUTOSTOP_CHECK_INTERVAL_S = 20
+
+MAX_CONCURRENT_SETUP_SSH = 16

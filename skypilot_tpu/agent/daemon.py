@@ -1,0 +1,142 @@
+"""The on-cluster agent daemon (skylet analog).
+
+Counterpart of the reference's sky/skylet/skylet.py + events.py: an
+infinite loop over periodic events —
+
+  - JobSchedulerEvent: run the FIFO scheduler + liveness reconciliation
+    (reference events.py:64).
+  - AutostopEvent: when the job queue has been idle past the configured
+    threshold, tear the cluster down *by calling the provisioner on
+    itself* (reference events.py:93 + _stop_cluster_with_new_provisioner
+    :157).  TPU pods always autodown (stop unsupported).
+
+Started on the head host by the backend after provisioning:
+    python -m skypilot_tpu.agent.daemon --root <host_root>
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+
+
+class _Event:
+    interval_s: float = constants.AGENT_LOOP_INTERVAL_S
+
+    def __init__(self) -> None:
+        self._last = 0.0
+
+    def maybe_run(self) -> None:
+        now = time.time()
+        if now - self._last >= self.interval_s:
+            self._last = now
+            self.run()
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+
+class JobSchedulerEvent(_Event):
+    interval_s = constants.AGENT_LOOP_INTERVAL_S
+
+    def __init__(self, table: job_lib.JobTable) -> None:
+        super().__init__()
+        self._table = table
+
+    def run(self) -> None:
+        self._table.reconcile()
+        self._table.schedule_step()
+
+
+class AutostopEvent(_Event):
+    interval_s = constants.AUTOSTOP_CHECK_INTERVAL_S
+
+    def __init__(self, table: job_lib.JobTable, root: str) -> None:
+        super().__init__()
+        self._table = table
+        self._root = root
+        self._idle_since: Optional[float] = None
+
+    def _config(self) -> Dict[str, Any]:
+        path = os.path.join(self._root, constants.AGENT_DIR,
+                            constants.AGENT_CONFIG)
+        if not os.path.exists(path):
+            return {}
+        try:
+            with open(path, encoding='utf-8') as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return {}
+
+    def run(self) -> None:
+        config = self._config()
+        idle_minutes = config.get('autostop_idle_minutes', -1)
+        if idle_minutes is None or idle_minutes < 0:
+            self._idle_since = None
+            return
+        if not self._table.is_cluster_idle():
+            self._idle_since = None
+            return
+        now = time.time()
+        if self._idle_since is None:
+            # Idle measured from the last job activity, so autostop
+            # survives daemon restarts (reference autostop_lib persists
+            # last-active time).
+            self._idle_since = max(self._table.last_activity_time(), 0.0) \
+                or now
+        if now - self._idle_since < idle_minutes * 60:
+            return
+        self._teardown(config)
+
+    def _teardown(self, config: Dict[str, Any]) -> None:
+        """Stop/terminate own cluster through the provisioner API."""
+        provider = config.get('provider_name')
+        cluster = config.get('cluster_name_on_cloud')
+        provider_config = config.get('provider_config', {})
+        if not provider or not cluster:
+            return
+        down = config.get('autostop_down', False) or \
+            provider_config.get('tpu_vm', False)
+        from skypilot_tpu.provision import api as provision_api
+        try:
+            if down:
+                provision_api.terminate_instances(provider, cluster,
+                                                  provider_config)
+            else:
+                provision_api.stop_instances(provider, cluster,
+                                             provider_config)
+        except Exception:  # noqa: BLE001 — retried on the next tick
+            return
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--root', default=None,
+                        help='Host root dir (defaults to $HOME or '
+                             '$SKYTPU_LOCAL_HOST_ROOT).')
+    args = parser.parse_args()
+    root = (args.root or os.environ.get('SKYTPU_LOCAL_HOST_ROOT') or
+            os.path.expanduser('~'))
+    agent_dir = os.path.join(root, constants.AGENT_DIR)
+    os.makedirs(agent_dir, exist_ok=True)
+    with open(os.path.join(agent_dir, constants.AGENT_PID), 'w',
+              encoding='utf-8') as f:
+        f.write(str(os.getpid()))
+    table = job_lib.JobTable(root)
+    events = [JobSchedulerEvent(table), AutostopEvent(table, root)]
+    while True:
+        for event in events:
+            try:
+                event.maybe_run()
+            except Exception:  # noqa: BLE001 — the daemon must survive
+                pass
+        time.sleep(1)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,245 @@
+"""Gang job driver: fan a job out to every host, all-or-nothing.
+
+This replaces the reference's generated Ray driver program
+(RayCodeGen, sky/backends/cloud_vm_ray_backend.py:220-709).  Semantics
+preserved exactly (SURVEY.md §7 "hard parts" #2):
+
+  - *gang admission*: for TPU slices admission already happened at
+    provisioning (a slice exists fully or not at all — the property the
+    reference emulates with placement-group STRICT_SPREAD + pg.ready(),
+    :380-456); the driver additionally verifies every host is reachable
+    before starting rank 0.
+  - *stable ranks*: host rank = position in the cluster's IP list, head
+    slice first (reference :519-536 sorts by cluster IP list).
+  - *env contract*: SKYTPU_NODE_RANK / NODE_IPS / NUM_NODES (+ the
+    jax.distributed coordinator vars; reference :556 add_ray_task injects
+    SKYPILOT_* equivalents, constants.py:296-299).
+  - *peer cancellation*: first non-zero exit kills every other rank
+    (reference get_or_fail force-cancels unready peers, :313-346).
+  - *per-rank logs*: rank<k>.log on the head plus a merged run.log with
+    rank prefixes (reference :640-645).
+
+Runs on the head host, spawned by the agent's FIFO scheduler:
+    python -m skypilot_tpu.agent.job_driver --spec <spec.json>
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import log_lib
+
+
+class _RankProc:
+
+    def __init__(self, rank: int, proc: subprocess.Popen,
+                 log_path: str) -> None:
+        self.rank = rank
+        self.proc = proc
+        self.log_path = log_path
+        self.returncode: Optional[int] = None
+
+
+def _build_rank_env(spec: Dict[str, Any], rank: int) -> Dict[str, str]:
+    hosts: List[Dict[str, Any]] = spec['hosts']
+    # Local simulated hosts share one machine: their rendezvous address is
+    # loopback, not the 'local:<dir>' host identifier.
+    ips = [('127.0.0.1' if h['internal_ip'].startswith('local:')
+            else h['internal_ip']) for h in hosts]
+    num_hosts = len(hosts)
+    hosts_per_node = int(spec.get('hosts_per_node', 1) or 1)
+    env = dict(spec.get('env_vars') or {})
+    env.update({
+        constants.ENV_NODE_RANK: str(rank),
+        constants.ENV_NODE_IPS: '\n'.join(ips),
+        constants.ENV_NUM_NODES: str(num_hosts),
+        constants.ENV_COORDINATOR_ADDR:
+            f'{ips[0]}:{constants.COORDINATOR_PORT}',
+        constants.ENV_PROCESS_ID: str(rank),
+        constants.ENV_NUM_PROCESSES: str(num_hosts),
+        constants.ENV_CLUSTER_NAME: spec.get('cluster_name', ''),
+        constants.ENV_JOB_ID: str(spec['job_id']),
+    })
+    if spec.get('accelerator'):
+        env[constants.ENV_ACCELERATOR] = spec['accelerator']
+        env[constants.ENV_NUM_TPU_CHIPS_PER_HOST] = str(
+            spec.get('chips_per_host', 0))
+    num_slices = int(spec.get('num_logical_nodes', 1) or 1)
+    if num_slices > 1 and spec.get('accelerator'):
+        # Multislice: each logical node is one ICI domain; DCN between
+        # slices via the MEGASCALE contract (SURVEY.md §5).
+        env.update({
+            constants.ENV_MEGASCALE_COORDINATOR: f'{ips[0]}:8080',
+            constants.ENV_MEGASCALE_NUM_SLICES: str(num_slices),
+            constants.ENV_MEGASCALE_SLICE_ID: str(rank // hosts_per_node),
+        })
+    return env
+
+
+def _spawn_rank(spec: Dict[str, Any], rank: int, run_cmd: str,
+                log_dir: str, merged_log: str,
+                merged_lock: threading.Lock) -> _RankProc:
+    from skypilot_tpu.backend import command_runner
+    host = spec['hosts'][rank]
+    env = _build_rank_env(spec, rank)
+    address = host['address']
+    log_path = os.path.join(log_dir, f'rank{rank}.log')
+
+    if address.startswith('local:'):
+        host_root = address[len('local:'):]
+        workdir = os.path.join(host_root, constants.WORKDIR)
+        os.makedirs(workdir, exist_ok=True)
+        script = log_lib.make_task_bash_script(run_cmd, cwd=workdir,
+                                               env_vars=env)
+        full_env = dict(os.environ)
+        full_env.update(env)
+        full_env['SKYTPU_LOCAL_HOST_ROOT'] = host_root
+        proc = subprocess.Popen(
+            script, shell=True, executable='/bin/bash',
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, bufsize=1, env=full_env,
+            start_new_session=True)
+    else:
+        runner = command_runner.SSHCommandRunner(
+            address, ssh_user=host.get('ssh_user'),
+            ssh_key=host.get('ssh_key'))
+        exports = ''.join(f'export {k}={shlex.quote(str(v))}; '
+                          for k, v in env.items())
+        runtime_prefix = spec.get('remote_runtime_prefix', '')
+        remote = (f'{runtime_prefix}mkdir -p ~/{constants.WORKDIR} && '
+                  f'cd ~/{constants.WORKDIR} && {exports}'
+                  f'bash -c {shlex.quote(run_cmd)}')
+        # pylint: disable=protected-access
+        full = runner._ssh_base() + [f'{runner.ssh_user}@{address}',
+                                     remote]
+        proc = subprocess.Popen(
+            full, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, bufsize=1, start_new_session=True)
+
+    rank_proc = _RankProc(rank, proc, log_path)
+
+    def _pump() -> None:
+        prefix = f'(rank {rank}) ' if len(spec['hosts']) > 1 else ''
+        with open(log_path, 'w', encoding='utf-8') as rank_file:
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                rank_file.write(line)
+                rank_file.flush()
+                with merged_lock:
+                    with open(merged_log, 'a', encoding='utf-8') as mf:
+                        mf.write(prefix + line)
+        rank_proc.returncode = proc.wait()
+
+    thread = threading.Thread(target=_pump, daemon=True)
+    thread.start()
+    rank_proc.thread = thread  # type: ignore[attr-defined]
+    return rank_proc
+
+
+def _kill(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def run_job(spec: Dict[str, Any]) -> int:
+    agent_root = spec['agent_root']
+    table = job_lib.JobTable(agent_root)
+    job_id = spec['job_id']
+    log_dir = spec['log_dir']
+    os.makedirs(log_dir, exist_ok=True)
+    merged_log = os.path.join(log_dir, 'run.log')
+    merged_lock = threading.Lock()
+
+    procs: List[_RankProc] = []
+
+    def _on_sigterm(signum, frame):  # noqa: ANN001
+        # Cancellation: rank processes run in their own sessions, so the
+        # canceller's killpg(driver) cannot reach them — the driver must
+        # reap its ranks itself.  Status is owned by the canceller
+        # (job_lib.cancel_jobs sets CANCELLED); exit without writing it.
+        del signum, frame
+        for rp in procs:
+            _kill(rp.proc)
+        os._exit(143)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+
+    run_commands: List[str] = spec['run_commands']
+    num_hosts = len(spec['hosts'])
+    if len(run_commands) == 1 and num_hosts > 1:
+        run_commands = run_commands * num_hosts
+    assert len(run_commands) == num_hosts, (
+        f'{len(run_commands)} commands for {num_hosts} hosts')
+
+    table.set_status(job_id, job_lib.JobStatus.RUNNING)
+    failed_rank: Optional[int] = None
+    try:
+        for rank in range(num_hosts):
+            procs.append(
+                _spawn_rank(spec, rank, run_commands[rank], log_dir,
+                            merged_log, merged_lock))
+        # Wait; on first failure cancel all peers (gang semantics).
+        pending = set(range(num_hosts))
+        while pending and failed_rank is None:
+            time.sleep(0.1)
+            for rank in sorted(pending):
+                rp = procs[rank]
+                if rp.returncode is not None or rp.proc.poll() is not None:
+                    rp.thread.join(timeout=5)  # type: ignore[attr-defined]
+                    rc = rp.returncode if rp.returncode is not None \
+                        else rp.proc.returncode
+                    pending.discard(rank)
+                    if rc != 0:
+                        failed_rank = rank
+                        break
+        if failed_rank is not None:
+            with merged_lock, open(merged_log, 'a',
+                                   encoding='utf-8') as mf:
+                mf.write(f'ERROR: rank {failed_rank} failed; cancelling '
+                         f'{len(pending)} peer rank(s).\n')
+            for rank in pending:
+                _kill(procs[rank].proc)
+            table.set_status(job_id, job_lib.JobStatus.FAILED)
+            return 1
+        table.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
+        return 0
+    except BaseException:
+        for rp in procs:
+            _kill(rp.proc)
+        status = table.get_status(job_id)
+        if status is not None and not status.is_terminal():
+            table.set_status(job_id, job_lib.JobStatus.FAILED_DRIVER)
+        raise
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--spec', required=True,
+                        help='Path to the job spec JSON.')
+    args = parser.parse_args()
+    with open(args.spec, encoding='utf-8') as f:
+        spec = json.load(f)
+    sys.exit(run_job(spec))
+
+
+if __name__ == '__main__':
+    main()

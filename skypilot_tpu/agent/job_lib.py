@@ -1,0 +1,268 @@
+"""On-cluster job queue: SQLite table + FIFO scheduler + liveness checks.
+
+Counterpart of the reference's sky/skylet/job_lib.py:118-1132: same status
+machine INIT→PENDING→SETTING_UP→RUNNING→{SUCCEEDED,FAILED,FAILED_SETUP,
+FAILED_DRIVER,CANCELLED}, a FIFO scheduler that launches pending job-driver
+processes (:266), and PID-liveness reconciliation of stale RUNNING rows
+(:538-693).  Runs on the cluster head host; the client reaches it through
+agent/rpc.py instead of the reference's base64 `python -c` codegen
+(job_lib.py:930 JobLibCodeGen).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import shlex
+import signal
+import sqlite3
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+from skypilot_tpu.agent import constants
+
+
+class JobStatus(enum.Enum):
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_DRIVER = 'FAILED_DRIVER'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL_STATUSES
+
+    @classmethod
+    def nonterminal_statuses(cls) -> List['JobStatus']:
+        return [s for s in cls if not s.is_terminal()]
+
+
+_TERMINAL_STATUSES = {
+    JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.FAILED_SETUP,
+    JobStatus.FAILED_DRIVER, JobStatus.CANCELLED,
+}
+
+_CREATE = """\
+CREATE TABLE IF NOT EXISTS jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_name TEXT,
+    username TEXT,
+    submitted_at REAL,
+    status TEXT,
+    run_timestamp TEXT,
+    start_at REAL DEFAULT NULL,
+    end_at REAL DEFAULT NULL,
+    resources TEXT,
+    driver_pid INTEGER DEFAULT NULL,
+    driver_cmd TEXT,
+    log_dir TEXT);
+"""
+
+
+class JobTable:
+    """All access to one cluster's jobs.db (head host)."""
+
+    def __init__(self, agent_root: str) -> None:
+        self._agent_dir = os.path.join(agent_root, constants.AGENT_DIR)
+        os.makedirs(self._agent_dir, exist_ok=True)
+        self._db_path = os.path.join(self._agent_dir, constants.JOBS_DB)
+        self._lock = filelock.FileLock(self._db_path + '.lock')
+        conn = self._conn()
+        conn.executescript(_CREATE)
+        conn.commit()
+        conn.close()
+
+    def _conn(self) -> sqlite3.Connection:
+        return sqlite3.connect(self._db_path, timeout=10.0)
+
+    # -- job lifecycle -----------------------------------------------------
+    def add_job(self, job_name: Optional[str], username: str,
+                run_timestamp: str, resources_str: str,
+                driver_cmd: str, log_dir: str) -> int:
+        with self._lock, self._conn() as conn:
+            cur = conn.execute(
+                'INSERT INTO jobs (job_name, username, submitted_at, status,'
+                ' run_timestamp, resources, driver_cmd, log_dir) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?)',
+                (job_name, username, time.time(), JobStatus.INIT.value,
+                 run_timestamp, resources_str, driver_cmd, log_dir))
+            return int(cur.lastrowid)
+
+    def set_status(self, job_id: int, status: JobStatus) -> None:
+        with self._lock, self._conn() as conn:
+            end_at = (time.time()
+                      if status.is_terminal() else None)
+            start_at = time.time() if status == JobStatus.RUNNING else None
+            conn.execute(
+                'UPDATE jobs SET status=?, '
+                'start_at=COALESCE(?, start_at), '
+                'end_at=COALESCE(?, end_at) WHERE job_id=?',
+                (status.value, start_at, end_at, job_id))
+
+    def set_driver_pid(self, job_id: int, pid: int) -> None:
+        with self._lock, self._conn() as conn:
+            conn.execute('UPDATE jobs SET driver_pid=? WHERE job_id=?',
+                         (pid, job_id))
+
+    def mark_pending(self, job_id: int) -> None:
+        self.set_status(job_id, JobStatus.PENDING)
+
+    # -- queries -----------------------------------------------------------
+    def get_job(self, job_id: int) -> Optional[Dict[str, Any]]:
+        conn = self._conn()
+        try:
+            row = conn.execute('SELECT * FROM jobs WHERE job_id=?',
+                               (job_id,)).fetchone()
+        finally:
+            conn.close()
+        return None if row is None else self._row_to_dict(row)
+
+    def get_status(self, job_id: int) -> Optional[JobStatus]:
+        job = self.get_job(job_id)
+        return None if job is None else JobStatus(job['status'])
+
+    def get_statuses(self, job_ids: List[int]
+                     ) -> Dict[int, Optional[str]]:
+        return {
+            jid: (s.value if (s := self.get_status(jid)) else None)
+            for jid in job_ids
+        }
+
+    def get_jobs(self, statuses: Optional[List[JobStatus]] = None,
+                 limit: int = 0) -> List[Dict[str, Any]]:
+        q = 'SELECT * FROM jobs'
+        args: tuple = ()
+        if statuses:
+            marks = ','.join('?' * len(statuses))
+            q += f' WHERE status IN ({marks})'
+            args = tuple(s.value for s in statuses)
+        q += ' ORDER BY job_id DESC'
+        if limit:
+            q += f' LIMIT {int(limit)}'
+        conn = self._conn()
+        try:
+            rows = conn.execute(q, args).fetchall()
+        finally:
+            conn.close()
+        return [self._row_to_dict(r) for r in rows]
+
+    def latest_job_id(self) -> Optional[int]:
+        jobs = self.get_jobs(limit=1)
+        return jobs[0]['job_id'] if jobs else None
+
+    @staticmethod
+    def _row_to_dict(row: tuple) -> Dict[str, Any]:
+        (job_id, job_name, username, submitted_at, status, run_timestamp,
+         start_at, end_at, resources, driver_pid, driver_cmd,
+         log_dir) = row
+        return {
+            'job_id': job_id,
+            'job_name': job_name,
+            'username': username,
+            'submitted_at': submitted_at,
+            'status': status,
+            'run_timestamp': run_timestamp,
+            'start_at': start_at,
+            'end_at': end_at,
+            'resources': resources,
+            'driver_pid': driver_pid,
+            'driver_cmd': driver_cmd,
+            'log_dir': log_dir,
+        }
+
+    # -- scheduler ---------------------------------------------------------
+    def schedule_step(self) -> None:
+        """Launch the next PENDING job's driver if nothing is active
+        (FIFO, one driver at a time — reference FIFOScheduler
+        job_lib.py:266)."""
+        with self._lock:
+            active = self.get_jobs(statuses=[JobStatus.SETTING_UP,
+                                             JobStatus.RUNNING])
+            # Reconcile liveness of active drivers first.
+            for job in active:
+                if job['driver_pid'] and not _pid_alive(job['driver_pid']):
+                    self.set_status(job['job_id'], JobStatus.FAILED_DRIVER)
+            active = self.get_jobs(statuses=[JobStatus.SETTING_UP,
+                                             JobStatus.RUNNING])
+            if active:
+                return
+            pending = self.get_jobs(statuses=[JobStatus.PENDING])
+            if not pending:
+                return
+            job = pending[-1]  # lowest job_id (list is DESC)
+            self.set_status(job['job_id'], JobStatus.SETTING_UP)
+            proc = subprocess.Popen(
+                job['driver_cmd'],
+                shell=True,
+                executable='/bin/bash',
+                start_new_session=True,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            self.set_driver_pid(job['job_id'], proc.pid)
+
+    def reconcile(self) -> None:
+        """Fail RUNNING/SETTING_UP jobs whose driver died; fail INIT jobs
+        older than a grace period (reference job_lib.py:538-693)."""
+        for job in self.get_jobs(statuses=[JobStatus.SETTING_UP,
+                                           JobStatus.RUNNING]):
+            if job['driver_pid'] and not _pid_alive(job['driver_pid']):
+                self.set_status(job['job_id'], JobStatus.FAILED_DRIVER)
+        for job in self.get_jobs(statuses=[JobStatus.INIT]):
+            if time.time() - job['submitted_at'] > 300:
+                self.set_status(job['job_id'], JobStatus.FAILED_DRIVER)
+
+    def cancel_jobs(self, job_ids: Optional[List[int]] = None,
+                    all_jobs: bool = False) -> List[int]:
+        if all_jobs:
+            targets = self.get_jobs(statuses=[JobStatus.INIT,
+                                              JobStatus.PENDING,
+                                              JobStatus.SETTING_UP,
+                                              JobStatus.RUNNING])
+        else:
+            targets = [j for jid in (job_ids or [])
+                       if (j := self.get_job(jid)) is not None]
+        cancelled = []
+        for job in targets:
+            status = JobStatus(job['status'])
+            if status.is_terminal():
+                continue
+            if job['driver_pid'] and _pid_alive(job['driver_pid']):
+                try:
+                    os.killpg(os.getpgid(job['driver_pid']), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            self.set_status(job['job_id'], JobStatus.CANCELLED)
+            cancelled.append(job['job_id'])
+        return cancelled
+
+    def is_cluster_idle(self) -> bool:
+        """No nonterminal jobs — autostop trigger (reference
+        job_lib.is_cluster_idle)."""
+        return not self.get_jobs(statuses=JobStatus.nonterminal_statuses())
+
+    def last_activity_time(self) -> float:
+        jobs = self.get_jobs(limit=50)
+        latest = 0.0
+        for job in jobs:
+            for key in ('submitted_at', 'start_at', 'end_at'):
+                if job[key]:
+                    latest = max(latest, job[key])
+        return latest
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True

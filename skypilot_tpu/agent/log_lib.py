@@ -1,0 +1,112 @@
+"""On-host log runtime: run-with-log and tail/follow.
+
+Counterpart of the reference's sky/skylet/log_lib.py (:138 run_with_log,
+:230 make_task_bash_script, :386 tail_logs with follow loop :302).
+"""
+from __future__ import annotations
+
+import os
+import select
+import shlex
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+_BASH_PRELUDE = """\
+#!/bin/bash
+source ~/.bashrc 2> /dev/null || true
+set -o pipefail
+cd {cwd}
+"""
+
+
+def make_task_bash_script(codegen: str, cwd: str,
+                          env_vars: Optional[Dict[str, str]] = None) -> str:
+    """Wrap a user command into a standalone bash script (reference
+    log_lib.make_task_bash_script)."""
+    lines = [_BASH_PRELUDE.format(cwd=shlex.quote(cwd))]
+    for key, value in (env_vars or {}).items():
+        lines.append(f'export {key}={shlex.quote(str(value))}')
+    lines.append(codegen)
+    return '\n'.join(lines)
+
+
+def run_with_log(cmd: List[str] | str,
+                 log_path: str,
+                 *,
+                 stream_logs: bool = False,
+                 env: Optional[Dict[str, str]] = None,
+                 cwd: Optional[str] = None,
+                 shell: bool = False,
+                 prefix: str = '',
+                 start_new_session: bool = True) -> int:
+    """Run a command teeing stdout+stderr to `log_path`; optionally also
+    stream to our stdout with a rank prefix (reference log_lib.run_with_log).
+    Returns the exit code."""
+    log_path = os.path.expanduser(log_path)
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    with open(log_path, 'a', encoding='utf-8') as log_file:
+        proc = subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=cwd,
+            shell=shell,
+            text=True,
+            bufsize=1,
+            start_new_session=start_new_session,
+            executable='/bin/bash' if shell else None,
+        )
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            log_file.write(line)
+            log_file.flush()
+            if stream_logs:
+                sys.stdout.write(prefix + line)
+                sys.stdout.flush()
+        proc.wait()
+        return proc.returncode
+
+
+def tail_logs(log_path: str, *, follow: bool = False,
+              job_done_fn=None, tail_lines: int = 0,
+              out=sys.stdout, poll_interval: float = 0.2) -> None:
+    """Print a log file; with follow=True keep streaming until
+    `job_done_fn()` returns True AND the file is drained (reference
+    log_lib.tail_logs follow loop, log_lib.py:302-386)."""
+    log_path = os.path.expanduser(log_path)
+    # Wait for file to appear (job may still be scheduling).
+    deadline = time.time() + (30 if follow else 0)
+    while not os.path.exists(log_path):
+        if time.time() > deadline:
+            if not follow:
+                out.write(f'Log file not found: {log_path}\n')
+                return
+        time.sleep(poll_interval)
+    with open(log_path, 'r', encoding='utf-8', errors='replace') as f:
+        if tail_lines > 0:
+            lines = f.readlines()
+            for line in lines[-tail_lines:]:
+                out.write(line)
+        else:
+            for line in f:
+                out.write(line)
+        out.flush()
+        if not follow:
+            return
+        while True:
+            line = f.readline()
+            if line:
+                out.write(line)
+                out.flush()
+                continue
+            if job_done_fn is not None and job_done_fn():
+                # Drain whatever arrived between the check and now.
+                rest = f.read()
+                if rest:
+                    out.write(rest)
+                    out.flush()
+                return
+            time.sleep(poll_interval)

@@ -1,0 +1,58 @@
+"""Remote log tailer: stream a job's merged log until it finishes.
+
+Executed on the head host by `tail_logs` (client streams our stdout).
+Exit code encodes the job's final status (exceptions.JobExitCode), which
+the client propagates — same contract as the reference's
+`sky logs` (job_lib tail → JobExitCode).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import log_lib
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--root', required=True)
+    parser.add_argument('--job-id', type=int, default=None)
+    parser.add_argument('--follow', action='store_true')
+    parser.add_argument('--tail', type=int, default=0)
+    args = parser.parse_args()
+
+    table = job_lib.JobTable(args.root)
+    job_id = args.job_id if args.job_id is not None else \
+        table.latest_job_id()
+    if job_id is None:
+        print('No jobs found on this cluster.')
+        sys.exit(exceptions.JobExitCode.NOT_FOUND)
+    job = table.get_job(job_id)
+    if job is None:
+        print(f'Job {job_id} not found.')
+        sys.exit(exceptions.JobExitCode.NOT_FOUND)
+    log_dir = job['log_dir']
+    run_log = os.path.join(log_dir, 'run.log')
+
+    def job_done() -> bool:
+        status = table.get_status(job_id)
+        if status is None:
+            return True
+        if status == job_lib.JobStatus.PENDING:
+            # Nudge the scheduler so a queued job starts even if the agent
+            # daemon is not running (local clusters).
+            table.schedule_step()
+        return status.is_terminal()
+
+    log_lib.tail_logs(run_log, follow=args.follow, job_done_fn=job_done,
+                      tail_lines=args.tail)
+    status = table.get_status(job_id)
+    sys.exit(int(exceptions.JobExitCode.from_job_status(status)))
+
+
+if __name__ == '__main__':
+    main()

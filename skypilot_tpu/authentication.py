@@ -1,0 +1,54 @@
+"""SSH keypair management (reference: sky/authentication.py:1-499).
+
+One framework keypair under the state dir, injected into cloud instances
+via provider metadata (GCP TPU-VM metadata ssh-keys — the reference's
+TPU-VM special case).  Generated with ssh-keygen when available, else via
+the `cryptography` library (minimal container images).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Tuple
+
+import filelock
+
+from skypilot_tpu.utils import paths
+
+_KEY_NAME = 'skytpu-key'
+
+
+def _generate_with_cryptography(private: str, public: str) -> None:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+    key = ed25519.Ed25519PrivateKey.generate()
+    priv_bytes = key.private_bytes(
+        encoding=serialization.Encoding.PEM,
+        format=serialization.PrivateFormat.OpenSSH,
+        encryption_algorithm=serialization.NoEncryption())
+    pub_bytes = key.public_key().public_bytes(
+        encoding=serialization.Encoding.OpenSSH,
+        format=serialization.PublicFormat.OpenSSH)
+    with open(private, 'wb') as f:
+        f.write(priv_bytes)
+    with open(public, 'wb') as f:
+        f.write(pub_bytes + b' skytpu\n')
+
+
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Return (private_key_path, public_key_path), generating once."""
+    key_dir = paths.keys_dir()
+    private = os.path.join(key_dir, _KEY_NAME)
+    public = private + '.pub'
+    with filelock.FileLock(private + '.lock'):
+        if not (os.path.exists(private) and os.path.exists(public)):
+            if shutil.which('ssh-keygen'):
+                subprocess.run(
+                    ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f',
+                     private, '-C', 'skytpu'],
+                    check=True, capture_output=True)
+            else:
+                _generate_with_cryptography(private, public)
+        os.chmod(private, 0o600)
+    return private, public

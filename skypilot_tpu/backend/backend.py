@@ -1,0 +1,158 @@
+"""Backend ABC + cluster handle.
+
+Counterpart of the reference's sky/backends/backend.py:24-197 (ResourceHandle
++ Backend with timeline/usage instrumentation on every API) and the handle
+part of CloudVmRayResourceHandle (cloud_vm_ray_backend.py:2156): the handle
+is the pickled-into-SQLite record of everything needed to reach a cluster
+later — provider config, cached host addresses, launched resources.
+
+TPU twist: `num_hosts_per_node` is structural (from the slice spec), and
+host addresses are a flat rank-ordered list (head slice's hosts first),
+which is exactly the order the gang driver assigns ranks in.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import timeline
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.provision import common as provision_common
+
+
+class ClusterHandle:
+    """Serializable record of a provisioned cluster."""
+
+    _VERSION = 1
+
+    def __init__(
+        self,
+        *,
+        cluster_name: str,
+        cluster_name_on_cloud: str,
+        provider_name: str,
+        provider_config: Dict[str, Any],
+        launched_nodes: int,
+        launched_resources: resources_lib.Resources,
+        host_addresses: List[str],
+        internal_ips: List[str],
+        ssh_user: Optional[str] = None,
+        ssh_key: Optional[str] = None,
+    ) -> None:
+        self.cluster_name = cluster_name
+        self.cluster_name_on_cloud = cluster_name_on_cloud
+        self.provider_name = provider_name
+        self.provider_config = provider_config
+        self.launched_nodes = launched_nodes
+        self.launched_resources = launched_resources
+        self.host_addresses = host_addresses
+        self.internal_ips = internal_ips
+        self.ssh_user = ssh_user
+        self.ssh_key = ssh_key
+
+    @property
+    def num_hosts_per_node(self) -> int:
+        """Reference num_ips_per_node (cloud_vm_ray_backend.py:2550)."""
+        return self.launched_resources.num_hosts_per_node
+
+    @property
+    def num_hosts(self) -> int:
+        return self.launched_nodes * self.num_hosts_per_node
+
+    @property
+    def head_address(self) -> str:
+        return self.host_addresses[0]
+
+    @property
+    def head_internal_ip(self) -> str:
+        return self.internal_ips[0]
+
+    @property
+    def head_agent_root(self) -> Optional[str]:
+        """Explicit agent root for local hosts; None = remote $HOME."""
+        if self.head_address.startswith('local:'):
+            return self.head_address[len('local:'):]
+        return None
+
+    def update_from_cluster_info(
+            self, cluster_info: 'provision_common.ClusterInfo') -> None:
+        tuples = cluster_info.ip_tuples()
+        self.internal_ips = [t[0] for t in tuples]
+        self.host_addresses = cluster_info.get_feasible_ips()
+        if cluster_info.ssh_user is not None:
+            self.ssh_user = cluster_info.ssh_user
+
+    def __repr__(self) -> str:
+        return (f'ClusterHandle(name={self.cluster_name!r}, '
+                f'provider={self.provider_name}, '
+                f'nodes={self.launched_nodes}, '
+                f'hosts={len(self.host_addresses)}, '
+                f'resources={self.launched_resources})')
+
+
+class Backend:
+    """Lifecycle operations on clusters (reference backend.py:30)."""
+
+    NAME = 'backend'
+
+    # -- provisioning ------------------------------------------------------
+    @timeline.event
+    def provision(self,
+                  task: 'task_lib.Task',
+                  to_provision: Optional[resources_lib.Resources],
+                  dryrun: bool,
+                  stream_logs: bool,
+                  cluster_name: str,
+                  retry_until_up: bool = False) -> Optional[ClusterHandle]:
+        return self._provision(task, to_provision, dryrun, stream_logs,
+                               cluster_name, retry_until_up)
+
+    @timeline.event
+    def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
+        return self._sync_workdir(handle, workdir)
+
+    @timeline.event
+    def sync_file_mounts(self, handle: ClusterHandle,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        return self._sync_file_mounts(handle, all_file_mounts,
+                                      storage_mounts)
+
+    @timeline.event
+    def setup(self, handle: ClusterHandle, task: 'task_lib.Task',
+              detach_setup: bool = False) -> None:
+        return self._setup(handle, task, detach_setup)
+
+    @timeline.event
+    def execute(self, handle: ClusterHandle, task: 'task_lib.Task',
+                detach_run: bool, dryrun: bool = False) -> Optional[int]:
+        return self._execute(handle, task, detach_run, dryrun)
+
+    @timeline.event
+    def teardown(self, handle: ClusterHandle, terminate: bool,
+                 purge: bool = False) -> None:
+        return self._teardown(handle, terminate, purge)
+
+    # -- to be implemented -------------------------------------------------
+    def _provision(self, task, to_provision, dryrun, stream_logs,
+                   cluster_name, retry_until_up):
+        raise NotImplementedError
+
+    def _sync_workdir(self, handle, workdir):
+        raise NotImplementedError
+
+    def _sync_file_mounts(self, handle, all_file_mounts, storage_mounts):
+        raise NotImplementedError
+
+    def _setup(self, handle, task, detach_setup):
+        raise NotImplementedError
+
+    def _execute(self, handle, task, detach_run, dryrun):
+        raise NotImplementedError
+
+    def _teardown(self, handle, terminate, purge):
+        raise NotImplementedError

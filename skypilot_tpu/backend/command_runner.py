@@ -1,0 +1,239 @@
+"""Command runners: uniform exec/rsync to cluster hosts.
+
+Counterpart of the reference's sky/utils/command_runner.py (:168 ABC,
+:426 SSHCommandRunner with ControlMaster reuse).  Additions:
+  - `LocalHostRunner` executes against a *local host root directory*
+    ('local:<dir>' addresses from provision/local) so the identical
+    backend/agent code paths drive process-based clusters — the hermetic
+    test substrate.
+  - `from_address` picks the runner from the address scheme.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+SSH_CONTROL_DIR = '/tmp/skytpu_ssh_control'
+
+
+def _expand(path: str) -> str:
+    return os.path.abspath(os.path.expanduser(path))
+
+
+class CommandRunner:
+    """Execute commands / sync files on one cluster host."""
+
+    def __init__(self, address: str) -> None:
+        self.address = address
+
+    def run(self,
+            cmd: Union[str, List[str]],
+            *,
+            env_vars: Optional[Dict[str, str]] = None,
+            require_outputs: bool = False,
+            log_path: str = '/dev/null',
+            stream_logs: bool = False,
+            cwd: Optional[str] = None,
+            timeout: Optional[float] = None
+            ) -> Union[int, Tuple[int, str, str]]:
+        raise NotImplementedError
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes: Optional[List[str]] = None) -> None:
+        raise NotImplementedError
+
+    def check_connection(self) -> bool:
+        try:
+            rc = self.run('true', timeout=10)
+            return rc == 0
+        except Exception:  # noqa: BLE001
+            return False
+
+    @classmethod
+    def from_address(cls, address: str,
+                     ssh_user: Optional[str] = None,
+                     ssh_key: Optional[str] = None,
+                     port: int = 22) -> 'CommandRunner':
+        if address.startswith('local:'):
+            return LocalHostRunner(address)
+        return SSHCommandRunner(address, ssh_user=ssh_user, ssh_key=ssh_key,
+                                port=port)
+
+
+class LocalHostRunner(CommandRunner):
+    """Run commands rooted at a local host directory (simulated host).
+
+    The host dir acts as the host's home: commands get
+    SKYTPU_LOCAL_HOST_ROOT pointing at it (used by the local provisioner's
+    process reaper and by the agent to find its state dir).
+    """
+
+    def __init__(self, address: str) -> None:
+        super().__init__(address)
+        assert address.startswith('local:'), address
+        self.host_root = address[len('local:'):]
+
+    def run(self, cmd, *, env_vars=None, require_outputs=False,
+            log_path='/dev/null', stream_logs=False, cwd=None, timeout=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        env = dict(os.environ)
+        env.update(env_vars or {})
+        env['SKYTPU_LOCAL_HOST_ROOT'] = self.host_root
+        # Make this skypilot_tpu importable in child processes regardless of
+        # cwd/install mode (local hosts share the client's filesystem).
+        import skypilot_tpu
+        pkg_parent = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
+        existing = env.get('PYTHONPATH', '')
+        if pkg_parent not in existing.split(os.pathsep):
+            env['PYTHONPATH'] = (pkg_parent + os.pathsep + existing
+                                 if existing else pkg_parent)
+        os.makedirs(self.host_root, exist_ok=True)
+        proc = subprocess.run(
+            cmd, shell=True, executable='/bin/bash',
+            cwd=cwd or self.host_root, env=env,
+            capture_output=True, text=True, timeout=timeout, check=False)
+        self._log(proc, log_path, stream_logs)
+        if require_outputs:
+            return proc.returncode, proc.stdout, proc.stderr
+        return proc.returncode
+
+    @staticmethod
+    def _log(proc: subprocess.CompletedProcess, log_path: str,
+             stream_logs: bool) -> None:
+        text = (proc.stdout or '') + (proc.stderr or '')
+        if log_path not in ('/dev/null', None) and text:
+            os.makedirs(os.path.dirname(_expand(log_path)), exist_ok=True)
+            with open(_expand(log_path), 'a', encoding='utf-8') as f:
+                f.write(text)
+        if stream_logs and text:
+            print(text, end='')
+
+    def rsync(self, source: str, target: str, *, up: bool, excludes=None):
+        if up:
+            src, dst = _expand(source), os.path.join(self.host_root,
+                                                     target.lstrip('/'))
+        else:
+            src = os.path.join(self.host_root, source.lstrip('/'))
+            dst = _expand(target)
+        if shutil.which('rsync'):
+            exclude_args = []
+            for pat in excludes or []:
+                exclude_args += ['--exclude', pat]
+            src_arg = src + '/' if os.path.isdir(src) else src
+            os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+            dst_arg = dst if not os.path.isdir(src) else dst + '/'
+            proc = subprocess.run(
+                ['rsync', '-a', '--delete', *exclude_args, src_arg, dst_arg],
+                capture_output=True, text=True, check=False)
+            if proc.returncode != 0:
+                raise exceptions.CommandError(proc.returncode, 'rsync',
+                                              proc.stderr)
+        else:
+            if os.path.isdir(src):
+                shutil.copytree(src, dst, dirs_exist_ok=True)
+            else:
+                os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+                shutil.copy2(src, dst)
+
+
+class SSHCommandRunner(CommandRunner):
+    """SSH + rsync with ControlMaster connection reuse (reference
+    command_runner.py:426)."""
+
+    def __init__(self, address: str, ssh_user: Optional[str] = None,
+                 ssh_key: Optional[str] = None, port: int = 22,
+                 ssh_proxy_command: Optional[str] = None) -> None:
+        super().__init__(address)
+        self.ssh_user = ssh_user or 'skytpu'
+        self.ssh_key = ssh_key
+        self.port = port
+        self.ssh_proxy_command = ssh_proxy_command
+        os.makedirs(SSH_CONTROL_DIR, exist_ok=True)
+
+    def _ssh_base(self) -> List[str]:
+        args = [
+            'ssh', '-T',
+            '-o', 'StrictHostKeyChecking=no',
+            '-o', 'UserKnownHostsFile=/dev/null',
+            '-o', 'LogLevel=ERROR',
+            '-o', 'IdentitiesOnly=yes',
+            '-o', 'ConnectTimeout=30',
+            '-o', 'ServerAliveInterval=20',
+            '-o', 'ServerAliveCountMax=3',
+            '-o', f'ControlPath={SSH_CONTROL_DIR}/%C',
+            '-o', 'ControlMaster=auto',
+            '-o', 'ControlPersist=300s',
+            '-p', str(self.port),
+        ]
+        if self.ssh_key:
+            args += ['-i', _expand(self.ssh_key)]
+        if self.ssh_proxy_command:
+            args += ['-o', f'ProxyCommand={self.ssh_proxy_command}']
+        return args
+
+    def run(self, cmd, *, env_vars=None, require_outputs=False,
+            log_path='/dev/null', stream_logs=False, cwd=None, timeout=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        exports = ''.join(
+            f'export {k}={shlex.quote(str(v))}; '
+            for k, v in (env_vars or {}).items())
+        cd = f'cd {shlex.quote(cwd)}; ' if cwd else ''
+        remote = f'bash -c {shlex.quote(exports + cd + cmd)}'
+        full = self._ssh_base() + [f'{self.ssh_user}@{self.address}', remote]
+        proc = subprocess.run(full, capture_output=True, text=True,
+                              timeout=timeout, check=False)
+        LocalHostRunner._log(proc, log_path, stream_logs)
+        if require_outputs:
+            return proc.returncode, proc.stdout, proc.stderr
+        return proc.returncode
+
+    def rsync(self, source: str, target: str, *, up: bool, excludes=None):
+        ssh_cmd = ' '.join(
+            shlex.quote(a) for a in self._ssh_base())
+        exclude_args = []
+        for pat in excludes or []:
+            exclude_args += ['--exclude', pat]
+        remote = f'{self.ssh_user}@{self.address}'
+        if up:
+            src_arg = _expand(source)
+            if os.path.isdir(src_arg):
+                src_arg += '/'
+            pair = [src_arg, f'{remote}:{target}']
+        else:
+            pair = [f'{remote}:{source}', _expand(target)]
+        proc = subprocess.run(
+            ['rsync', '-az', '--delete', '-e', ssh_cmd, *exclude_args,
+             *pair],
+            capture_output=True, text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.CommandError(
+                proc.returncode, f'rsync to {self.address}', proc.stderr)
+
+
+def workdir_excludes(source_dir: str) -> List[str]:
+    """Exclusion patterns for workdir sync: .git plus .skytpuignore/.gitignore
+    entries (reference: rsync + git-ignore handling,
+    cloud_vm_ray_backend.py:3137)."""
+    excludes = ['.git']
+    for ignore_file in ('.skytpuignore', '.gitignore'):
+        path = os.path.join(_expand(source_dir), ignore_file)
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith('#') and \
+                            not line.startswith('!'):
+                        excludes.append(line)
+            break  # .skytpuignore wins over .gitignore
+    return excludes

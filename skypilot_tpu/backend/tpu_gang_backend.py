@@ -1,0 +1,536 @@
+"""TpuGangBackend: the concrete cluster runtime.
+
+Counterpart of the reference's CloudVmRayBackend
+(sky/backends/cloud_vm_ray_backend.py:2620-5115), restructured around TPU
+slices and with Ray removed:
+
+  - provisioning goes through provision/provisioner.RetryingProvisioner
+    (zone→region→cloud failover with re-optimize, :1979/:2093-2150);
+  - runtime setup replaces "install Ray + start head/workers"
+    (instance_setup.py:250-331) with: ship the framework runtime, write the
+    agent config, start the agent daemon on the head host;
+  - job execution replaces RayCodeGen + `ray job submit` (:220-709, :3358)
+    with an agent-RPC job submission and the gang job driver
+    (agent/job_driver.py) fanning out one process per slice host;
+  - `exec` fast path = SYNC_WORKDIR + EXEC only (execution.py:553).
+"""
+from __future__ import annotations
+
+import getpass
+import json
+import os
+import shlex
+import subprocess
+import sys
+import time
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.agent import constants as agent_constants
+from skypilot_tpu.agent import rpc as agent_rpc
+from skypilot_tpu.backend import backend as backend_lib
+from skypilot_tpu.backend import command_runner as runner_lib
+from skypilot_tpu.provision import api as provision_api
+from skypilot_tpu.provision import provisioner as provisioner_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import subprocess_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_RUNTIME_DIR = '.skytpu_runtime'
+_SSH_RUNTIME_PREFIX = (
+    f'export PYTHONPATH=$HOME/{_RUNTIME_DIR}:$PYTHONPATH; ')
+
+
+class TpuGangBackend(backend_lib.Backend):
+
+    NAME = 'tpu_gang'
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def _runner_for(self, handle: backend_lib.ClusterHandle,
+                    address: str) -> runner_lib.CommandRunner:
+        return runner_lib.CommandRunner.from_address(
+            address, ssh_user=handle.ssh_user, ssh_key=handle.ssh_key)
+
+    def _head_runner(self, handle: backend_lib.ClusterHandle
+                     ) -> runner_lib.CommandRunner:
+        return self._runner_for(handle, handle.head_address)
+
+    def _is_local(self, handle: backend_lib.ClusterHandle) -> bool:
+        return handle.head_address.startswith('local:')
+
+    def _runtime_prefix(self, handle: backend_lib.ClusterHandle) -> str:
+        return '' if self._is_local(handle) else _SSH_RUNTIME_PREFIX
+
+    def run_on_head(self, handle: backend_lib.ClusterHandle, cmd: str,
+                    **kwargs: Any):
+        """Reference run_on_head (cloud_vm_ray_backend.py:4485)."""
+        return self._head_runner(handle).run(
+            self._runtime_prefix(handle) + cmd, **kwargs)
+
+    def _rpc(self, handle: backend_lib.ClusterHandle, method: str,
+             **params: Any) -> Dict[str, Any]:
+        """Execute an agent RPC on the head host (the reference's
+        codegen-over-SSH channel, job_lib.py:930)."""
+        root = handle.head_agent_root
+        if root is not None:
+            params['agent_root'] = root
+            # Local clusters share our filesystem: dispatch in-process and
+            # skip the ~2s interpreter spawn per call.
+            result = agent_rpc.handle_request(method, params)
+            if 'error' in result:
+                raise exceptions.SkyTpuError(
+                    f'Agent RPC {method} failed: {result["error"]}')
+            return result['result']
+        cmd = agent_rpc.make_rpc_command(method, **params)
+        rc, stdout, stderr = self.run_on_head(handle, cmd,
+                                              require_outputs=True,
+                                              timeout=120)
+        if rc != 0:
+            raise exceptions.CommandError(rc, f'agent rpc {method}',
+                                          stderr or stdout)
+        response = agent_rpc.parse_response(stdout)
+        if 'error' in response:
+            raise exceptions.SkyTpuError(
+                f'Agent RPC {method} failed: {response["error"]}')
+        return response['result']
+
+    # ------------------------------------------------------------------
+    # provision
+    # ------------------------------------------------------------------
+    def _provision(self, task: 'task_lib.Task',
+                   to_provision: Optional[resources_lib.Resources],
+                   dryrun: bool, stream_logs: bool, cluster_name: str,
+                   retry_until_up: bool = False
+                   ) -> Optional[backend_lib.ClusterHandle]:
+        del stream_logs
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        if record is not None and record['status'] == \
+                global_user_state.ClusterStatus.UP:
+            handle: backend_lib.ClusterHandle = record['handle']
+            self.check_resources_fit_cluster(handle, task)
+            logger.info(f'Cluster {cluster_name!r} is UP; reusing.')
+            return handle
+        resume = record is not None and record['status'] == \
+            global_user_state.ClusterStatus.STOPPED
+        if resume:
+            # Resume must target where the stopped instances actually are,
+            # not wherever the optimizer would place a fresh launch.
+            old_handle: backend_lib.ClusterHandle = record['handle']
+            self.check_resources_fit_cluster(old_handle, task)
+            to_provision = old_handle.launched_resources
+        elif to_provision is None:
+            assert task.best_resources is not None, (
+                'Run the optimizer before provisioning.')
+            to_provision = task.best_resources
+        if dryrun:
+            logger.info(f'Dryrun: would provision {to_provision} '
+                        f'x{task.num_nodes} as {cluster_name!r}.')
+            return None
+
+        max_len = (to_provision.cloud.MAX_CLUSTER_NAME_LEN_LIMIT
+                   if to_provision.cloud else None) or 35
+        cluster_name_on_cloud = common_utils.make_cluster_name_on_cloud(
+            cluster_name, max_len)
+        retrier = provisioner_lib.RetryingProvisioner(
+            cluster_name, cluster_name_on_cloud,
+            authentication_config=self._authentication_config(
+                to_provision.cloud))
+
+        backoff = common_utils.Backoff(initial_backoff=30)
+        while True:
+            try:
+                if resume:
+                    cloud = to_provision.cloud
+                    result = provisioner_lib.bulk_provision(
+                        cloud,
+                        typing.cast(Any, cloud).regions_with_offering(
+                            None, None, False, to_provision.region,
+                            to_provision.zone)[0],
+                        [
+                            # Reuse recorded zone on resume.
+                            type('Z', (), {'name': to_provision.zone})()
+                        ] if to_provision.zone else None,
+                        cluster_name_on_cloud, task.num_nodes, to_provision,
+                        authentication_config=self._authentication_config(
+                            cloud),
+                        resume_stopped_nodes=True)
+                else:
+                    result = retrier.provision_with_retries(
+                        task, to_provision, task.num_nodes)
+                break
+            except exceptions.ResourcesUnavailableError as e:
+                if not retry_until_up:
+                    raise
+                wait = backoff.current_backoff()
+                logger.info(f'Retrying in {wait:.0f}s (retry_until_up): {e}')
+                time.sleep(wait)
+
+        handle = backend_lib.ClusterHandle(
+            cluster_name=cluster_name,
+            cluster_name_on_cloud=cluster_name_on_cloud,
+            provider_name=result.provider_name,
+            provider_config=result.provider_config,
+            launched_nodes=task.num_nodes,
+            launched_resources=result.resources,
+            host_addresses=result.cluster_info.get_feasible_ips(),
+            internal_ips=[t[0] for t in result.cluster_info.ip_tuples()],
+            ssh_user=result.cluster_info.ssh_user,
+            ssh_key=self._ssh_key_path(result.resources.cloud),
+        )
+        global_user_state.add_or_update_cluster(
+            cluster_name, handle, set(task.resources), ready=False)
+        self._post_provision_runtime_setup(handle)
+        global_user_state.add_or_update_cluster(
+            cluster_name, handle, set(task.resources), ready=True)
+        owner = (result.resources.cloud.get_user_identities() or [None])[0] \
+            if result.resources.cloud else None
+        global_user_state.set_owner_identity_for_cluster(cluster_name, owner)
+        return handle
+
+    def check_resources_fit_cluster(self, handle: backend_lib.ClusterHandle,
+                                    task: 'task_lib.Task') -> None:
+        """Reference: Resources.less_demanding_than on exec/relaunch
+        (resources.py:1119)."""
+        for resources in task.get_preferred_resources():
+            if resources.less_demanding_than(handle.launched_resources):
+                return
+        raise exceptions.ResourcesMismatchError(
+            f'Requested resources do not fit cluster '
+            f'{handle.cluster_name!r}: requested '
+            f'{task.get_preferred_resources()}, cluster has '
+            f'{handle.launched_resources}. Use a new cluster name or '
+            'relax the request.')
+
+    def _authentication_config(
+            self,
+            cloud: Optional[Any] = None) -> Dict[str, Any]:
+        if cloud is not None and cloud.canonical_name() in ('local', 'fake'):
+            return {}  # no SSH needed for process-based/simulated hosts
+        from skypilot_tpu import authentication
+        pub = authentication.get_or_generate_keys()[1]
+        with open(pub, encoding='utf-8') as f:
+            pub_key = f.read().strip()
+        return {
+            'ssh_keys': f'skytpu:{pub_key}',
+            'ssh_user': 'skytpu',
+        }
+
+    def _ssh_key_path(self,
+                      cloud: Optional[Any] = None) -> Optional[str]:
+        if cloud is not None and cloud.canonical_name() in ('local', 'fake'):
+            return None
+        from skypilot_tpu import authentication
+        return authentication.get_or_generate_keys()[0]
+
+    def _post_provision_runtime_setup(
+            self, handle: backend_lib.ClusterHandle) -> None:
+        """Wait for hosts, ship runtime, start the agent daemon
+        (reference post_provision_runtime_setup, provisioner.py:631)."""
+        runners = [self._runner_for(handle, a)
+                   for a in handle.host_addresses]
+
+        def _wait_host(runner: runner_lib.CommandRunner) -> None:
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                if runner.check_connection():
+                    return
+                time.sleep(3)
+            raise exceptions.FetchClusterInfoError(
+                exceptions.FetchClusterInfoError.Reason.HEAD)
+
+        subprocess_utils.run_in_parallel(_wait_host, runners)
+
+        if not self._is_local(handle):
+            # Ship the framework source to every host (the reference ships a
+            # wheel built client-side, wheel_utils.py:1-40; rsyncing the
+            # package tree gives the same exact-client-code property).
+            import skypilot_tpu
+            pkg_dir = os.path.dirname(skypilot_tpu.__file__)
+
+            def _ship(runner: runner_lib.CommandRunner) -> None:
+                runner.run(f'mkdir -p ~/{_RUNTIME_DIR}', timeout=60)
+                runner.rsync(pkg_dir, f'~/{_RUNTIME_DIR}/skypilot_tpu',
+                             up=True, excludes=['__pycache__'])
+
+            subprocess_utils.run_in_parallel(_ship, runners)
+            # The head host fans out rank processes to its peers over SSH
+            # (gang driver), so the cluster key must live on the head too
+            # (reference: internal_file_mounts ships credentials,
+            # provisioner.py:503).
+            key_path = self._ssh_key_path()
+            if key_path is not None:
+                head = self._head_runner(handle)
+                head.run('mkdir -p ~/.ssh && chmod 700 ~/.ssh', timeout=60)
+                head.rsync(key_path, '~/.ssh/skytpu-key', up=True)
+                head.run('chmod 600 ~/.ssh/skytpu-key', timeout=60)
+
+        # Agent config (autostop teardown needs provider details).
+        agent_config = {
+            'provider_name': handle.provider_name,
+            'cluster_name_on_cloud': handle.cluster_name_on_cloud,
+            'provider_config': handle.provider_config,
+        }
+        root = handle.head_agent_root
+        config_dir = (os.path.join(root, agent_constants.AGENT_DIR)
+                      if root else f'~/{agent_constants.AGENT_DIR}')
+        head = self._head_runner(handle)
+        config_json = json.dumps(agent_config)
+        head.run(
+            f'mkdir -p {config_dir} && cat > '
+            f'{config_dir}/{agent_constants.AGENT_CONFIG} <<\'EOF\'\n'
+            f'{config_json}\nEOF', timeout=60)
+        self._start_agent_daemon(handle)
+
+    def _start_agent_daemon(self, handle: backend_lib.ClusterHandle) -> None:
+        """Start (or restart on version change) the agent daemon on head
+        (reference start_skylet_on_head_node, instance_setup.py:440 +
+        attempt_skylet version gating)."""
+        root = handle.head_agent_root
+        root_arg = f'--root {shlex.quote(root)}' if root else ''
+        agent_dir = (os.path.join(root, agent_constants.AGENT_DIR)
+                     if root else f'$HOME/{agent_constants.AGENT_DIR}')
+        pid_file = f'{agent_dir}/{agent_constants.AGENT_PID}'
+        log_file = f'{agent_dir}/{agent_constants.AGENT_LOG}'
+        cmd = (
+            f'mkdir -p {agent_dir}; '
+            f'if [ -f {pid_file} ] && kill -0 $(cat {pid_file}) '
+            '2>/dev/null; then true; else '
+            f'nohup python3 -u -m skypilot_tpu.agent.daemon {root_arg} '
+            f'>> {log_file} 2>&1 & fi')
+        self.run_on_head(handle, cmd, timeout=60)
+
+    # ------------------------------------------------------------------
+    # sync / setup
+    # ------------------------------------------------------------------
+    def _sync_workdir(self, handle: backend_lib.ClusterHandle,
+                      workdir: str) -> None:
+        excludes = runner_lib.workdir_excludes(workdir)
+
+        def _sync(address: str) -> None:
+            runner = self._runner_for(handle, address)
+            target = (agent_constants.WORKDIR
+                      if address.startswith('local:')
+                      else f'~/{agent_constants.WORKDIR}')
+            runner.rsync(workdir, target, up=True, excludes=excludes)
+
+        subprocess_utils.run_in_parallel(_sync, handle.host_addresses)
+
+    def _sync_file_mounts(self, handle: backend_lib.ClusterHandle,
+                          all_file_mounts: Optional[Dict[str, str]],
+                          storage_mounts: Optional[Dict[str, Any]]) -> None:
+        for target, source in (all_file_mounts or {}).items():
+            if source.startswith(('s3://', 'gs://', 'gcs://', 'r2://',
+                                  'http://', 'https://')):
+                from skypilot_tpu.data import cloud_stores
+                cmd = cloud_stores.make_download_command(source, target)
+
+                def _dl(address: str, cmd=cmd) -> None:
+                    runner = self._runner_for(handle, address)
+                    rc, out, err = runner.run(cmd, require_outputs=True)
+                    if rc != 0:
+                        raise exceptions.CommandError(
+                            rc, f'download {source}', err or out)
+
+                subprocess_utils.run_in_parallel(_dl,
+                                                 handle.host_addresses)
+            else:
+                def _up(address: str, target=target, source=source) -> None:
+                    runner = self._runner_for(handle, address)
+                    dst = target
+                    if not address.startswith('local:') and \
+                            not dst.startswith(('~', '/')):
+                        dst = f'~/{dst}'
+                    runner.rsync(os.path.expanduser(source), dst, up=True)
+
+                subprocess_utils.run_in_parallel(_up,
+                                                 handle.host_addresses)
+        for target, storage in (storage_mounts or {}).items():
+            from skypilot_tpu.data import storage_mounting
+            storage_mounting.mount_storage(self, handle, target, storage)
+
+    def _setup(self, handle: backend_lib.ClusterHandle,
+               task: 'task_lib.Task', detach_setup: bool = False) -> None:
+        if task.setup is None:
+            return
+        del detach_setup
+        prefix = self._runtime_prefix(handle)
+        setup_script = task.setup
+        envs = task.envs
+
+        def _run_setup(address: str) -> None:
+            runner = self._runner_for(handle, address)
+            workdir = (agent_constants.WORKDIR
+                       if address.startswith('local:')
+                       else f'~/{agent_constants.WORKDIR}')
+            cmd = (f'{prefix}mkdir -p {workdir} && cd {workdir} && '
+                   f'bash -c {shlex.quote(setup_script)}')
+            rc, out, err = runner.run(cmd, env_vars=envs,
+                                      require_outputs=True)
+            if rc != 0:
+                raise exceptions.CommandError(
+                    rc, f'setup on {address}',
+                    (out or '') + (err or ''))
+
+        logger.info(f'Running setup on {len(handle.host_addresses)} '
+                    'host(s).')
+        subprocess_utils.run_in_parallel(_run_setup, handle.host_addresses)
+
+    # ------------------------------------------------------------------
+    # execute
+    # ------------------------------------------------------------------
+    def _execute(self, handle: backend_lib.ClusterHandle,
+                 task: 'task_lib.Task', detach_run: bool,
+                 dryrun: bool = False) -> Optional[int]:
+        if dryrun:
+            logger.info(f'Dryrun: would execute {task} on '
+                        f'{handle.cluster_name!r}.')
+            return None
+        if task.run is None:
+            logger.info('Nothing to run (no run section).')
+            return None
+        spec = self._make_job_spec(handle, task)
+        result = self._rpc(handle, 'add_job', spec=spec)
+        job_id = result['job_id']
+        self._rpc(handle, 'schedule')
+        logger.info(f'Job {job_id} submitted to {handle.cluster_name!r}.'
+                    + ('' if detach_run else ' Streaming logs...'))
+        self.last_job_exit_code = 0
+        if not detach_run:
+            # Propagate the job's final status (JobExitCode contract,
+            # reference: `sky launch` streams then reflects job failure).
+            self.last_job_exit_code = self.tail_logs(handle, job_id,
+                                                     follow=True)
+        return job_id
+
+    def _make_job_spec(self, handle: backend_lib.ClusterHandle,
+                       task: 'task_lib.Task') -> Dict[str, Any]:
+        spec_res = handle.launched_resources.tpu_slice
+        hosts = []
+        for address, internal in zip(handle.host_addresses,
+                                     handle.internal_ips):
+            hosts.append({
+                'address': address,
+                'internal_ip': internal,
+                'ssh_user': handle.ssh_user,
+                'ssh_key': (f'~/.ssh/skytpu-key'
+                            if not self._is_local(handle) else None),
+            })
+        num_hosts = len(hosts)
+        if callable(task.run):
+            ips = [h['internal_ip'] for h in hosts]
+            run_commands = []
+            for rank in range(num_hosts):
+                cmd = task.run(rank, ips)
+                run_commands.append(cmd if cmd else 'true')
+        else:
+            run_commands = [task.run]
+        return {
+            'job_name': task.name,
+            'username': getpass.getuser(),
+            'run_timestamp': time.strftime('%Y-%m-%d-%H-%M-%S'),
+            'resources_str': repr(handle.launched_resources),
+            'cluster_name': handle.cluster_name,
+            'hosts': hosts,
+            'num_logical_nodes': handle.launched_nodes,
+            'hosts_per_node': handle.num_hosts_per_node,
+            'run_commands': run_commands,
+            'env_vars': task.envs,
+            'accelerator':
+                spec_res.accelerator_name if spec_res else None,
+            'chips_per_host': spec_res.chips_per_host if spec_res else 0,
+            'remote_runtime_prefix': self._runtime_prefix(handle),
+        }
+
+    # ------------------------------------------------------------------
+    # logs / queue / cancel / autostop
+    # ------------------------------------------------------------------
+    def tail_logs(self, handle: backend_lib.ClusterHandle,
+                  job_id: Optional[int], follow: bool = True,
+                  tail: int = 0) -> int:
+        root = handle.head_agent_root
+        root_arg = shlex.quote(root) if root else '$HOME'
+        cmd = (f'{self._runtime_prefix(handle)}'
+               f'python3 -u -m skypilot_tpu.agent.log_tail '
+               f'--root {root_arg}'
+               + (f' --job-id {job_id}' if job_id is not None else '')
+               + (' --follow' if follow else '')
+               + (f' --tail {tail}' if tail else ''))
+        # Stream directly to our stdout/stderr (interactive follow).
+        head = self._head_runner(handle)
+        if isinstance(head, runner_lib.LocalHostRunner):
+            env = dict(os.environ)
+            env['SKYTPU_LOCAL_HOST_ROOT'] = head.host_root
+            import skypilot_tpu
+            pkg_parent = os.path.dirname(
+                os.path.dirname(skypilot_tpu.__file__))
+            env['PYTHONPATH'] = (pkg_parent + os.pathsep +
+                                 env.get('PYTHONPATH', ''))
+            proc = subprocess.run(cmd, shell=True, executable='/bin/bash',
+                                  env=env, cwd=head.host_root, check=False)
+            return proc.returncode
+        assert isinstance(head, runner_lib.SSHCommandRunner)
+        # pylint: disable=protected-access
+        full = head._ssh_base() + [f'{head.ssh_user}@{head.address}', cmd]
+        proc = subprocess.run(full, check=False)
+        return proc.returncode
+
+    def get_job_queue(self, handle: backend_lib.ClusterHandle
+                      ) -> List[Dict[str, Any]]:
+        return self._rpc(handle, 'queue')['jobs']
+
+    def get_job_status(self, handle: backend_lib.ClusterHandle,
+                       job_ids: List[int]) -> Dict[int, Optional[str]]:
+        statuses = self._rpc(handle, 'get_statuses',
+                             job_ids=job_ids)['statuses']
+        return {int(k): v for k, v in statuses.items()}
+
+    def cancel_jobs(self, handle: backend_lib.ClusterHandle,
+                    job_ids: Optional[List[int]] = None,
+                    all_jobs: bool = False) -> List[int]:
+        return self._rpc(handle, 'cancel', job_ids=job_ids,
+                         all=all_jobs)['cancelled']
+
+    def set_autostop(self, handle: backend_lib.ClusterHandle,
+                     idle_minutes: int, down: bool = False) -> None:
+        spec = handle.launched_resources.tpu_slice
+        if spec is not None and spec.is_pod and idle_minutes >= 0 and \
+                not down:
+            logger.info('TPU pod slices cannot stop; autostop will '
+                        'autodown instead.')
+            down = True
+        self._rpc(handle, 'set_autostop', idle_minutes=idle_minutes,
+                  down=down)
+        global_user_state.set_cluster_autostop_value(
+            handle.cluster_name, idle_minutes, down)
+
+    # ------------------------------------------------------------------
+    # teardown
+    # ------------------------------------------------------------------
+    def _teardown(self, handle: backend_lib.ClusterHandle, terminate: bool,
+                  purge: bool = False) -> None:
+        if not terminate:
+            spec = handle.launched_resources.tpu_slice
+            if spec is not None and spec.is_pod:
+                raise exceptions.NotSupportedError(
+                    'TPU pod slices cannot be stopped; use down/terminate '
+                    '(reference parity: sky/clouds/gcp.py:193-204).')
+        try:
+            provisioner_lib.teardown_cluster(
+                handle.provider_name, handle.cluster_name_on_cloud,
+                handle.provider_config, terminate=terminate)
+        except Exception:  # noqa: BLE001
+            if not purge:
+                raise
+            logger.warning('Teardown failed; purging state anyway.')
+        global_user_state.remove_cluster(handle.cluster_name,
+                                         terminate=terminate)

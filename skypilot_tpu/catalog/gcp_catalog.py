@@ -14,9 +14,11 @@ service_catalog/common.py:29-115.  Differences by design:
 from __future__ import annotations
 
 import io
+import typing
 from typing import Dict, List, Optional, Tuple
 
-import pandas as pd
+if typing.TYPE_CHECKING:
+    import pandas as pd
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.utils import accelerator_registry
@@ -96,13 +98,14 @@ _VM_ZONES = ['us-central1-a', 'us-central1-b', 'us-central2-b', 'us-east1-c',
              'asia-northeast1-b', 'us-south1-a', 'us-east1-d',
              'us-central1-c', 'us-central1-f']
 
-_df: Optional[pd.DataFrame] = None
+_df: Optional['pd.DataFrame'] = None
 _pricing_override: Dict[str, Tuple[float, float]] = {}
 
 
-def _vm_df() -> pd.DataFrame:
+def _vm_df() -> 'pd.DataFrame':
     global _df
     if _df is None:
+        import pandas as pd  # deferred: keep `import skypilot_tpu` light
         _df = pd.read_csv(io.StringIO(_VMS_CSV))
     return _df
 
@@ -228,7 +231,9 @@ def get_default_instance_type(cpus: Optional[str] = None,
     if cpus is None and memory is None:
         cpus = '8'
 
-    def _match(series: pd.Series, request: Optional[str]) -> pd.Series:
+    import pandas as pd
+
+    def _match(series: 'pd.Series', request: Optional[str]) -> 'pd.Series':
         if request is None:
             return pd.Series(True, index=series.index)
         if request.endswith('+'):

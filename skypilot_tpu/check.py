@@ -1,0 +1,66 @@
+"""Credential checking: which clouds are usable (reference: sky/check.py).
+
+`check()` probes every registered cloud's `check_credentials`, persists the
+enabled set to the state DB, and reports.  The optimizer consults the
+cached enabled set; an empty cache triggers a refresh.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def check(quiet: bool = False,
+          cloud_names: Optional[Iterable[str]] = None) -> List[str]:
+    """Probe credentials; persist + return the enabled cloud names."""
+    allowed = config_lib.get_nested(('allowed_clouds',), None)
+    results: Dict[str, Tuple[bool, Optional[str]]] = {}
+    for name, cloud in sorted(clouds_lib.CLOUD_REGISTRY.items()):
+        if cloud_names and name not in cloud_names:
+            continue
+        if allowed is not None and name not in [a.lower() for a in allowed]:
+            results[name] = (False, 'disabled by allowed_clouds config')
+            continue
+        try:
+            ok, reason = cloud.check_credentials()
+        except Exception as e:  # pylint: disable=broad-except
+            ok, reason = False, str(e)
+        results[name] = (ok, reason)
+    enabled = [name for name, (ok, _) in results.items() if ok]
+    if cloud_names:
+        # Partial check: merge with previously enabled clouds.
+        prev = set(global_user_state.get_cached_enabled_clouds())
+        prev -= {n for n, (ok, _) in results.items() if not ok}
+        enabled = sorted(prev | set(enabled))
+    global_user_state.set_enabled_clouds(enabled)
+    if not quiet:
+        for name, (ok, reason) in results.items():
+            mark = '\x1b[92m✔\x1b[0m' if ok else '\x1b[91m✗\x1b[0m'
+            line = f'  {mark} {name}'
+            if not ok and reason:
+                line += f': {reason}'
+            logger.info(line)
+        if not enabled:
+            logger.info('No cloud is enabled.')
+    return enabled
+
+
+def get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access: bool = False) -> List[clouds_lib.Cloud]:
+    names = global_user_state.get_cached_enabled_clouds()
+    if not names:
+        names = check(quiet=True)
+    enabled = [clouds_lib.CLOUD_REGISTRY[n] for n in names
+               if n in clouds_lib.CLOUD_REGISTRY]
+    if raise_if_no_cloud_access and not enabled:
+        raise exceptions.NoCloudAccessError(
+            'No cloud access. Run `skytpu check` after configuring '
+            'credentials.')
+    return enabled

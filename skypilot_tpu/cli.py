@@ -1,0 +1,441 @@
+"""The `skytpu` command-line interface.
+
+Counterpart of the reference's click app (sky/cli.py:1073 launch, :1209
+exec, :1590 status, :1982 queue, :2050 logs, :2145 cancel, :2221 stop,
+:2299 autostop, :2425 start, :2622 down, :2989 check, :3042 show-gpus →
+show-tpus here, :3567 jobs group, :3984 serve group).  CLI flags override
+YAML fields the same way (_parse_override_params, cli.py:477).
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import click
+
+from skypilot_tpu import exceptions
+
+
+def _sky():
+    import skypilot_tpu as sky
+    return sky
+
+
+def _make_task(entrypoint: Tuple[str, ...], **overrides: Any):
+    """YAML path or inline command → Task, with CLI overrides applied
+    (reference _make_task_or_dag_from_entrypoint_with_overrides,
+    cli.py:722)."""
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu import resources as resources_lib
+    entry = ' '.join(entrypoint)
+    env_overrides = overrides.pop('env', None) or []
+    is_yaml = entry.endswith(('.yaml', '.yml')) and os.path.exists(
+        os.path.expanduser(entry))
+    if is_yaml:
+        from skypilot_tpu.utils import common_utils
+        config = common_utils.read_yaml(entry) or {}
+        task = task_lib.Task.from_yaml_config(
+            config, env_overrides=[tuple(e.split('=', 1))
+                                   for e in env_overrides])
+    else:
+        task = task_lib.Task(run=entry or None)
+        task.update_envs([tuple(e.split('=', 1)) for e in env_overrides])
+
+    res_overrides: Dict[str, Any] = {}
+    for key in ('cloud', 'region', 'zone', 'instance_type', 'cpus',
+                'memory', 'accelerators', 'use_spot', 'disk_size',
+                'disk_tier', 'ports', 'image_id'):
+        value = overrides.pop(key, None)
+        if value is not None:
+            res_overrides[key] = value
+    if res_overrides:
+        new_resources = {
+            r.copy(**res_overrides) for r in task.get_preferred_resources()
+        }
+        task.set_resources(new_resources)
+    if overrides.get('num_nodes') is not None:
+        task.num_nodes = overrides['num_nodes']
+    if overrides.get('workdir') is not None:
+        task.workdir = overrides['workdir']
+    if overrides.get('name') is not None:
+        task.name = overrides['name']
+    return task
+
+
+_RESOURCE_OPTIONS = [
+    click.option('--cloud', default=None, help='Cloud to use.'),
+    click.option('--region', default=None),
+    click.option('--zone', default=None),
+    click.option('--instance-type', 'instance_type', default=None),
+    click.option('--cpus', default=None),
+    click.option('--memory', default=None),
+    click.option('--accelerators', '--gpus', '--tpus', 'accelerators',
+                 default=None,
+                 help="e.g. 'tpu-v5p-128' or 'tpu-v5e:16' or 'A100:8'."),
+    click.option('--use-spot/--no-use-spot', 'use_spot', default=None),
+    click.option('--disk-size', 'disk_size', type=int, default=None),
+    click.option('--disk-tier', 'disk_tier', default=None),
+    click.option('--ports', multiple=True, default=None),
+    click.option('--image-id', 'image_id', default=None),
+    click.option('--num-nodes', 'num_nodes', type=int, default=None),
+    click.option('--workdir', default=None),
+    click.option('--name', '-n', default=None),
+    click.option('--env', multiple=True,
+                 help='Env override KEY=VALUE (repeatable).'),
+]
+
+
+def _add_options(options):
+    def wrapper(fn):
+        for option in reversed(options):
+            fn = option(fn)
+        return fn
+
+    return wrapper
+
+
+@click.group()
+@click.version_option(message='%(version)s',
+                      version=__import__('skypilot_tpu').__version__)
+def cli() -> None:
+    """skytpu: TPU-native cloud orchestration."""
+
+
+@cli.command()
+@click.argument('entrypoint', nargs=-1, required=False)
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--dryrun', is_flag=True, default=False)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True, default=False,
+              help='Autodown after the job (or with -i, after idle).')
+@click.option('--retry-until-up', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+@_add_options(_RESOURCE_OPTIONS)
+def launch(entrypoint, cluster, dryrun, detach_run,
+           idle_minutes_to_autostop, down, retry_until_up, yes,
+           **overrides) -> None:
+    """Launch a task (YAML file or inline command) on a new or existing
+    cluster."""
+    sky = _sky()
+    task = _make_task(entrypoint, **overrides)
+    if not yes and not dryrun:
+        click.confirm(f'Launching task on cluster {cluster or "(new)"}. '
+                      'Proceed?', default=True, abort=True)
+    job_id, handle = sky.launch(
+        task, cluster_name=cluster, dryrun=dryrun, down=down,
+        detach_run=detach_run,
+        idle_minutes_to_autostop=idle_minutes_to_autostop,
+        retry_until_up=retry_until_up)
+    if handle is not None:
+        click.echo(f'Job {job_id} on cluster {handle.cluster_name!r}.')
+    if not detach_run and job_id is not None and handle is not None:
+        status_map = sky.job_status(handle.cluster_name, [job_id])
+        if status_map.get(job_id) not in ('SUCCEEDED', None):
+            sys.exit(int(exceptions.JobExitCode.FAILED))
+
+
+@cli.command(name='exec')
+@click.argument('cluster', required=True)
+@click.argument('entrypoint', nargs=-1, required=True)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@_add_options(_RESOURCE_OPTIONS)
+def exec_cmd(cluster, entrypoint, detach_run, **overrides) -> None:
+    """Fast-resubmit a task to a live cluster (no provision/setup)."""
+    sky = _sky()
+    task = _make_task(entrypoint, **overrides)
+    job_id, _ = sky.exec(task, cluster, detach_run=detach_run)
+    click.echo(f'Job {job_id} submitted to {cluster!r}.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=False)
+@click.option('--refresh', '-r', is_flag=True, default=False,
+              help='Reconcile with cloud state.')
+def status(clusters, refresh) -> None:
+    """Show clusters."""
+    sky = _sky()
+    records = sky.status(list(clusters) or None, refresh=refresh)
+    if not records:
+        click.echo('No existing clusters.')
+        return
+    rows = []
+    for r in records:
+        handle = r['handle']
+        resources_str = (f'{handle.launched_nodes}x '
+                         f'{handle.launched_resources}')
+        autostop = (f'{r["autostop"]}m{" (down)" if r["to_down"] else ""}'
+                    if r['autostop'] >= 0 else '-')
+        rows.append((r['name'], resources_str, r['status'].value, autostop))
+    _print_table(('NAME', 'RESOURCES', 'STATUS', 'AUTOSTOP'), rows)
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+def queue(cluster) -> None:
+    """Show a cluster's job queue."""
+    jobs = _sky().queue(cluster)
+    rows = [(str(j['job_id']), j['job_name'] or '-', j['status'],
+             j['username']) for j in jobs]
+    _print_table(('ID', 'NAME', 'STATUS', 'USER'), rows)
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.argument('job_id', type=int, required=False)
+@click.option('--follow/--no-follow', default=True)
+@click.option('--sync-down', is_flag=True, default=False)
+@click.option('--tail', type=int, default=0)
+def logs(cluster, job_id, follow, sync_down, tail) -> None:
+    """Tail (or download with --sync-down) a job's logs."""
+    sky = _sky()
+    if sync_down:
+        out = sky.download_logs(cluster,
+                                [job_id] if job_id is not None else None)
+        for jid, path in out.items():
+            click.echo(f'Job {jid} logs: {path}')
+        return
+    sys.exit(sky.tail_logs(cluster, job_id, follow=follow, tail=tail))
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.argument('job_ids', type=int, nargs=-1)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+def cancel(cluster, job_ids, all_jobs) -> None:
+    """Cancel jobs."""
+    cancelled = _sky().cancel(cluster, list(job_ids) or None, all_jobs)
+    click.echo(f'Cancelled: {cancelled}')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def stop(clusters, yes) -> None:
+    """Stop clusters (TPU pods cannot stop — use down)."""
+    sky = _sky()
+    for name in clusters:
+        if not yes:
+            click.confirm(f'Stop cluster {name!r}?', default=True,
+                          abort=True)
+        sky.stop(name)
+        click.echo(f'Cluster {name!r} stopped.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--retry-until-up', is_flag=True, default=False)
+def start(clusters, retry_until_up) -> None:
+    """Restart stopped clusters."""
+    sky = _sky()
+    for name in clusters:
+        sky.start(name, retry_until_up=retry_until_up)
+        click.echo(f'Cluster {name!r} started.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+@click.option('--purge', is_flag=True, default=False)
+def down(clusters, yes, purge) -> None:
+    """Terminate clusters."""
+    sky = _sky()
+    for name in clusters:
+        if not yes:
+            click.confirm(f'Terminate cluster {name!r}?', default=True,
+                          abort=True)
+        sky.down(name, purge=purge)
+        click.echo(f'Cluster {name!r} terminated.')
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.option('--idle-minutes', '-i', type=int, required=True,
+              help='-1 cancels autostop.')
+@click.option('--down', 'to_down', is_flag=True, default=False)
+def autostop(cluster, idle_minutes, to_down) -> None:
+    """Schedule autostop/autodown after idle."""
+    _sky().autostop(cluster, idle_minutes, down=to_down)
+    if idle_minutes < 0:
+        click.echo(f'Autostop cancelled for {cluster!r}.')
+    else:
+        click.echo(f'Cluster {cluster!r} will '
+                   f'{"autodown" if to_down else "autostop"} after '
+                   f'{idle_minutes}m idle.')
+
+
+@cli.command()
+@click.argument('clouds', nargs=-1, required=False)
+def check(clouds) -> None:
+    """Check cloud credentials and enable usable clouds."""
+    enabled = _sky().check(cloud_names=list(clouds) or None)
+    click.echo(f'Enabled clouds: {", ".join(enabled) or "none"}')
+
+
+@cli.command(name='show-tpus')
+@click.argument('name_filter', required=False)
+def show_tpus(name_filter) -> None:
+    """List TPU slice shapes (and GPUs) with topology and pricing
+    (reference: `sky show-gpus`)."""
+    from skypilot_tpu.catalog import gcp_catalog
+    inventory = gcp_catalog.list_accelerators(name_filter)
+    rows = []
+    for name in sorted(inventory):
+        for item in inventory[name]:
+            if 'chips' in item:
+                rows.append((
+                    name, str(item['chips']), str(item['hosts']),
+                    f"{item['hbm_gb']:.0f}",
+                    f"{item['bf16_tflops']:.0f}",
+                    f"${item['price']:.2f}", f"${item['spot_price']:.2f}",
+                    ','.join(item['regions'])))
+    _print_table(('TPU', 'CHIPS', 'HOSTS', 'HBM_GB', 'BF16_TFLOPS',
+                  '$/HR', 'SPOT_$/HR', 'REGIONS'), rows)
+
+
+@cli.command(name='cost-report')
+def cost_report() -> None:
+    """Estimated costs of all clusters ever launched."""
+    rows = []
+    for r in _sky().cost_report():
+        cost = f"${r['cost']:.2f}" if r['cost'] is not None else '-'
+        hours = r['duration_seconds'] / 3600
+        rows.append((r['name'], f'{hours:.2f}h', cost,
+                     'yes' if r['still_exists'] else 'no'))
+    _print_table(('NAME', 'DURATION', 'COST', 'EXISTS'), rows)
+
+
+@cli.group()
+def storage() -> None:
+    """Storage management."""
+
+
+@storage.command(name='ls')
+def storage_ls() -> None:
+    rows = [(s['name'], s['status'].value, s['handle'].get('store', '-'))
+            for s in _sky().storage_ls()]
+    _print_table(('NAME', 'STATUS', 'STORE'), rows)
+
+
+@storage.command(name='delete')
+@click.argument('names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def storage_delete(names, yes) -> None:
+    for name in names:
+        if not yes:
+            click.confirm(f'Delete storage {name!r}?', default=True,
+                          abort=True)
+        _sky().storage_delete(name)
+        click.echo(f'Storage {name!r} deleted.')
+
+
+@cli.group()
+def jobs() -> None:
+    """Managed jobs with automatic preemption recovery."""
+
+
+@jobs.command(name='launch')
+@click.argument('entrypoint', nargs=-1, required=True)
+@click.option('--name', '-n', default=None)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@_add_options([o for o in _RESOURCE_OPTIONS
+               if 'name' not in getattr(o, 'name', '')])
+def jobs_launch(entrypoint, name, detach_run, **overrides) -> None:
+    """Submit a managed job (auto-recovered on preemption)."""
+    from skypilot_tpu.jobs import core as jobs_core
+    task = _make_task(entrypoint, name=name, **overrides)
+    job_id = jobs_core.launch(task, name=name, detach_run=detach_run)
+    click.echo(f'Managed job {job_id} submitted.')
+
+
+@jobs.command(name='queue')
+def jobs_queue() -> None:
+    """List managed jobs."""
+    from skypilot_tpu.jobs import core as jobs_core
+    rows = []
+    for j in jobs_core.queue():
+        rows.append((str(j['job_id']), j['job_name'] or '-', j['status'],
+                     str(j.get('recovery_count', 0))))
+    _print_table(('ID', 'NAME', 'STATUS', 'RECOVERIES'), rows)
+
+
+@jobs.command(name='cancel')
+@click.argument('job_ids', type=int, nargs=-1)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+def jobs_cancel(job_ids, all_jobs) -> None:
+    from skypilot_tpu.jobs import core as jobs_core
+    cancelled = jobs_core.cancel(list(job_ids) or None, all_jobs)
+    click.echo(f'Cancelled managed jobs: {cancelled}')
+
+
+@jobs.command(name='logs')
+@click.argument('job_id', type=int, required=False)
+@click.option('--follow/--no-follow', default=True)
+def jobs_logs(job_id, follow) -> None:
+    from skypilot_tpu.jobs import core as jobs_core
+    sys.exit(jobs_core.tail_logs(job_id, follow=follow))
+
+
+@cli.group()
+def serve() -> None:
+    """SkyServe-style multi-replica serving."""
+
+
+@serve.command(name='up')
+@click.argument('entrypoint', nargs=-1, required=True)
+@click.option('--service-name', '-n', default=None)
+@_add_options(_RESOURCE_OPTIONS)
+def serve_up(entrypoint, service_name, **overrides) -> None:
+    from skypilot_tpu.serve import core as serve_core
+    task = _make_task(entrypoint, **overrides)
+    name, endpoint = serve_core.up(task, service_name)
+    click.echo(f'Service {name!r} deployed at {endpoint}.')
+
+
+@serve.command(name='status')
+@click.argument('service_names', nargs=-1, required=False)
+def serve_status(service_names) -> None:
+    from skypilot_tpu.serve import core as serve_core
+    rows = []
+    for s in serve_core.status(list(service_names) or None):
+        rows.append((s['name'], s['status'],
+                     f"{s['ready_replicas']}/{s['total_replicas']}",
+                     s.get('endpoint') or '-'))
+    _print_table(('NAME', 'STATUS', 'REPLICAS', 'ENDPOINT'), rows)
+
+
+@serve.command(name='down')
+@click.argument('service_names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_down(service_names, yes) -> None:
+    from skypilot_tpu.serve import core as serve_core
+    for name in service_names:
+        if not yes:
+            click.confirm(f'Tear down service {name!r}?', default=True,
+                          abort=True)
+        serve_core.down(name)
+        click.echo(f'Service {name!r} torn down.')
+
+
+def _print_table(headers: Tuple[str, ...], rows: List[Tuple]) -> None:
+    if not rows:
+        click.echo('(empty)')
+        return
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+              for i, h in enumerate(headers)]
+    click.echo('  '.join(str(h).ljust(w) for h, w in zip(headers, widths)))
+    for row in rows:
+        click.echo('  '.join(str(c).ljust(w) for c, w in zip(row, widths)))
+
+
+def main() -> None:
+    try:
+        cli()
+    except exceptions.SkyTpuError as e:
+        click.echo(f'Error: {e}', err=True)
+        sys.exit(1)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,251 @@
+"""User-facing cluster/job operations.
+
+Counterpart of the reference's sky/core.py:1-925 plus the status-refresh
+reconciliation from sky/backends/backend_utils.py:2208-2612: cloud truth
+(provision.query_instances) is reconciled against the client DB under a
+per-cluster lock, detecting externally-changed state (preempted TPU
+slices, manually deleted VMs, autostopped clusters).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu.backend import backend as backend_lib
+from skypilot_tpu.backend import tpu_gang_backend
+from skypilot_tpu.provision import api as provision_api
+from skypilot_tpu.utils import paths
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+ClusterStatus = global_user_state.ClusterStatus
+
+
+def _backend() -> tpu_gang_backend.TpuGangBackend:
+    return tpu_gang_backend.TpuGangBackend()
+
+
+def _get_record_or_raise(cluster_name: str) -> Dict[str, Any]:
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    return record
+
+
+# ---------------------------------------------------------------------------
+# status (+ refresh reconciliation)
+# ---------------------------------------------------------------------------
+def refresh_cluster_record(cluster_name: str) -> Optional[Dict[str, Any]]:
+    """Reconcile one cluster's DB state with cloud truth (reference
+    backend_utils.refresh_cluster_record, :2208)."""
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        return None
+    handle: backend_lib.ClusterHandle = record['handle']
+    lock = timeline.FileLockEvent(
+        f'{paths.locks_dir()}/{cluster_name}.refresh.lock', timeout=20)
+    try:
+        with lock:
+            try:
+                statuses = provision_api.query_instances(
+                    handle.provider_name, handle.cluster_name_on_cloud,
+                    handle.provider_config, non_terminated_only=False)
+            except Exception as e:  # noqa: BLE001
+                logger.debug(f'query_instances failed for {cluster_name}: '
+                             f'{e}; keeping cached status.')
+                return record
+            live = [s for s in statuses.values()
+                    if s not in (None, 'terminated')]
+            all_running = (len(live) >= handle.launched_nodes and
+                           all(s == 'running' for s in live))
+            any_stopped = any(s in ('stopped', 'stopping') for s in live)
+            if not live:
+                # Everything terminated externally (e.g. preempted TPU
+                # slice): drop the record — TPU VMs cannot resume.
+                global_user_state.remove_cluster(cluster_name,
+                                                 terminate=True)
+                return None
+            if all_running:
+                new_status = ClusterStatus.UP
+            elif any_stopped:
+                new_status = ClusterStatus.STOPPED
+            else:
+                new_status = ClusterStatus.INIT
+            if new_status != record['status']:
+                global_user_state.update_cluster_status(cluster_name,
+                                                        new_status)
+                record = global_user_state.get_cluster_from_name(
+                    cluster_name)
+            return record
+    except TimeoutError:
+        return record
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster records, optionally reconciled against cloud truth
+    (reference core.status / `sky status -r`)."""
+    records = global_user_state.get_clusters()
+    if cluster_names is not None:
+        wanted = set(cluster_names)
+        records = [r for r in records if r['name'] in wanted]
+    if refresh:
+        refreshed = []
+        for record in records:
+            updated = refresh_cluster_record(record['name'])
+            if updated is not None:
+                refreshed.append(updated)
+        records = refreshed
+    return records
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+def start(cluster_name: str, retry_until_up: bool = False) -> None:
+    """Restart a STOPPED cluster (reference core.start; provisioner
+    resume_stopped_nodes, provision/provisioner.py:131)."""
+    record = _get_record_or_raise(cluster_name)
+    if record['status'] == ClusterStatus.UP:
+        logger.info(f'Cluster {cluster_name!r} is already UP.')
+        return
+    handle: backend_lib.ClusterHandle = record['handle']
+    from skypilot_tpu import task as task_lib
+    dummy = task_lib.Task(cluster_name + '-start')
+    dummy.num_nodes = handle.launched_nodes
+    dummy.set_resources(handle.launched_resources)
+    dummy.best_resources = handle.launched_resources
+    _backend().provision(dummy, handle.launched_resources, dryrun=False,
+                         stream_logs=True, cluster_name=cluster_name,
+                         retry_until_up=retry_until_up)
+
+
+def stop(cluster_name: str) -> None:
+    record = _get_record_or_raise(cluster_name)
+    handle = record['handle']
+    _backend().teardown(handle, terminate=False)
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    record = _get_record_or_raise(cluster_name)
+    handle = record['handle']
+    _backend().teardown(handle, terminate=True, purge=purge)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down: bool = False) -> None:  # pylint: disable=redefined-outer-name
+    record = _get_record_or_raise(cluster_name)
+    _backend().set_autostop(record['handle'], idle_minutes, down)
+
+
+# ---------------------------------------------------------------------------
+# jobs
+# ---------------------------------------------------------------------------
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    record = _get_record_or_raise(cluster_name)
+    return _backend().get_job_queue(record['handle'])
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    record = _get_record_or_raise(cluster_name)
+    return _backend().cancel_jobs(record['handle'], job_ids, all_jobs)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True, tail: int = 0) -> int:
+    record = _get_record_or_raise(cluster_name)
+    return _backend().tail_logs(record['handle'], job_id, follow, tail)
+
+
+def job_status(cluster_name: str, job_ids: Optional[List[int]] = None
+               ) -> Dict[int, Optional[str]]:
+    record = _get_record_or_raise(cluster_name)
+    if job_ids is None:
+        jobs = _backend().get_job_queue(record['handle'])
+        job_ids = [j['job_id'] for j in jobs[:1]]
+    return _backend().get_job_status(record['handle'], job_ids)
+
+
+def download_logs(cluster_name: str, job_ids: Optional[List[int]] = None,
+                  local_dir: Optional[str] = None) -> Dict[int, str]:
+    """Rsync job log dirs back to the client (reference
+    `sky logs --sync-down`)."""
+    import os
+    record = _get_record_or_raise(cluster_name)
+    handle: backend_lib.ClusterHandle = record['handle']
+    backend = _backend()
+    if job_ids is None:
+        jobs = backend.get_job_queue(handle)
+        job_ids = [j['job_id'] for j in jobs]
+    out: Dict[int, str] = {}
+    local_root = local_dir or os.path.join(paths.logs_dir(), cluster_name)
+    from skypilot_tpu.backend import command_runner as runner_lib
+    head = runner_lib.CommandRunner.from_address(
+        handle.head_address, ssh_user=handle.ssh_user,
+        ssh_key=handle.ssh_key)
+    for job_id in job_ids:
+        remote_dir = (f'{handle.head_agent_root or "~"}/'
+                      f'.skytpu_agent/job_logs/job_{job_id}')
+        local_path = os.path.join(local_root, f'job_{job_id}')
+        os.makedirs(local_path, exist_ok=True)
+        if isinstance(head, runner_lib.LocalHostRunner):
+            head.rsync(f'.skytpu_agent/job_logs/job_{job_id}', local_path,
+                       up=False)
+        else:
+            head.rsync(remote_dir, local_path, up=False)
+        out[job_id] = local_path
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cost report
+# ---------------------------------------------------------------------------
+def cost_report() -> List[Dict[str, Any]]:
+    """Accumulated cost per cluster from usage intervals (reference
+    core.cost_report + global_user_state.py:469-525)."""
+    out = []
+    for record in global_user_state.get_cluster_history():
+        resources = record['launched_resources']
+        duration = 0
+        now = int(time.time())
+        for start_t, end_t in record['usage_intervals']:
+            duration += (end_t if end_t is not None else now) - start_t
+        cost = None
+        if resources is not None and resources.is_launchable():
+            try:
+                cost = resources.get_cost(duration) * \
+                    (record['num_nodes'] or 1)
+            except Exception:  # noqa: BLE001 — catalog drift
+                cost = None
+        out.append({
+            'name': record['name'],
+            'resources': resources,
+            'num_nodes': record['num_nodes'],
+            'duration_seconds': duration,
+            'cost': cost,
+            'still_exists': record['still_exists'],
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# storage
+# ---------------------------------------------------------------------------
+def storage_ls() -> List[Dict[str, Any]]:
+    return global_user_state.get_storage()
+
+
+def storage_delete(name: str) -> None:
+    handle = global_user_state.get_handle_from_storage_name(name)
+    if handle is None:
+        raise exceptions.StorageError(f'Storage {name!r} not found.')
+    from skypilot_tpu.data import storage as storage_lib
+    storage_obj = storage_lib.Storage.from_handle(handle)
+    storage_obj.delete()
+    global_user_state.remove_storage(name)

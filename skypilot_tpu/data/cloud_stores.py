@@ -1,0 +1,31 @@
+"""Download-command builders for cloud URLs in file_mounts.
+
+Counterpart of the reference's sky/cloud_stores.py:1-561 (CloudStorage
+adapters generating gsutil/aws-cli/azcopy commands executed on cluster
+hosts).  GCS-first here.
+"""
+from __future__ import annotations
+
+import shlex
+
+from skypilot_tpu import exceptions
+
+
+def make_download_command(source: str, target: str) -> str:
+    quoted_target = shlex.quote(target)
+    quoted_source = shlex.quote(source)
+    mkdir = f'mkdir -p $(dirname {quoted_target})'
+    if source.startswith(('gs://', 'gcs://')):
+        src = source.replace('gcs://', 'gs://', 1)
+        return (f'{mkdir} && (gsutil -m cp -r {shlex.quote(src)} '
+                f'{quoted_target} || gcloud storage cp -r '
+                f'{shlex.quote(src)} {quoted_target})')
+    if source.startswith('s3://'):
+        return (f'{mkdir} && aws s3 cp --recursive {quoted_source} '
+                f'{quoted_target} 2>/dev/null || aws s3 cp '
+                f'{quoted_source} {quoted_target}')
+    if source.startswith(('http://', 'https://')):
+        return (f'{mkdir} && (wget -q {quoted_source} -O {quoted_target} '
+                f'|| curl -fsSL {quoted_source} -o {quoted_target})')
+    raise exceptions.StorageSourceError(
+        f'Unsupported cloud source URL: {source}')

@@ -1,0 +1,37 @@
+"""FUSE mount command builders (reference: sky/data/mounting_utils.py:1-370).
+
+GCS-first: gcsfuse is preinstalled on TPU-VM runtime images, which is why
+MOUNT mode is the checkpoint/resume contract for TPU jobs (SURVEY.md §5 —
+recovered jobs resume from bucket-mounted output dirs).
+"""
+from __future__ import annotations
+
+GCSFUSE_VERSION = '2.4.0'
+
+
+def make_gcsfuse_install_command() -> str:
+    return (
+        'command -v gcsfuse >/dev/null 2>&1 || ('
+        'export GCSFUSE_VERSION=' + GCSFUSE_VERSION + '; '
+        'curl -L -o /tmp/gcsfuse.deb '
+        '"https://github.com/GoogleCloudPlatform/gcsfuse/releases/download/'
+        'v${GCSFUSE_VERSION}/gcsfuse_${GCSFUSE_VERSION}_amd64.deb" && '
+        'sudo dpkg -i /tmp/gcsfuse.deb)')
+
+
+def make_gcsfuse_mount_command(bucket_name: str, mount_path: str) -> str:
+    """Idempotent mount: install if needed, mkdir, mount unless mounted."""
+    return (
+        f'{make_gcsfuse_install_command()}; '
+        f'mkdir -p {mount_path}; '
+        f'mountpoint -q {mount_path} || '
+        f'gcsfuse --implicit-dirs '
+        f'--rename-dir-limit 10000 '
+        f'--stat-cache-ttl 5s --type-cache-ttl 5s '
+        f'{bucket_name} {mount_path}')
+
+
+def make_unmount_command(mount_path: str) -> str:
+    return (f'mountpoint -q {mount_path} && '
+            f'(fusermount -u {mount_path} || sudo umount {mount_path}) '
+            '|| true')

@@ -1,0 +1,277 @@
+"""Storage: named cloud buckets attached to tasks.
+
+Counterpart of the reference's sky/data/storage.py:114-4423 (Storage,
+StoreType, AbstractStore + per-cloud stores, MOUNT vs COPY modes), scoped
+GCS-first: GcsStore drives `gsutil`/`gcloud storage` CLIs (the same
+mechanism the reference uses) so it works wherever the gcloud SDK is
+installed, with a LocalStore used by tests and local clusters.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import re
+import shutil
+import subprocess
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import paths
+
+logger = sky_logging.init_logger(__name__)
+
+_BUCKET_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9._-]{1,61}[a-z0-9]$')
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+
+
+class StoreType(enum.Enum):
+    GCS = 'GCS'
+    S3 = 'S3'
+    LOCAL = 'LOCAL'
+
+    @classmethod
+    def from_url(cls, url: str) -> 'StoreType':
+        if url.startswith(('gs://', 'gcs://')):
+            return cls.GCS
+        if url.startswith('s3://'):
+            return cls.S3
+        if url.startswith('local://') or url.startswith('/'):
+            return cls.LOCAL
+        raise exceptions.StorageSourceError(f'Unknown store URL: {url}')
+
+
+class AbstractStore:
+    """One bucket in one object store (reference storage.py:248)."""
+
+    def __init__(self, name: str, source: Optional[str]) -> None:
+        self.name = name
+        self.source = source
+
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+    def create(self) -> None:
+        raise NotImplementedError
+
+    def upload(self, sources: List[str]) -> None:
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    def url(self) -> str:
+        raise NotImplementedError
+
+    def make_sync_dir_command(self, dst: str) -> str:
+        """Shell command (run on a cluster host) to download the bucket."""
+        raise NotImplementedError
+
+    def make_mount_command(self, mount_path: str) -> str:
+        raise NotImplementedError
+
+
+class GcsStore(AbstractStore):
+    """GCS via gsutil / gcloud storage (reference storage.py:1725)."""
+
+    def url(self) -> str:
+        return f'gs://{self.name}'
+
+    def _run(self, args: List[str], check: bool = True
+             ) -> subprocess.CompletedProcess:
+        return subprocess.run(['gsutil'] + args, capture_output=True,
+                              text=True, check=check)
+
+    def exists(self) -> bool:
+        proc = self._run(['ls', '-b', self.url()], check=False)
+        return proc.returncode == 0
+
+    def create(self) -> None:
+        proc = self._run(['mb', self.url()], check=False)
+        if proc.returncode != 0 and 'already exists' not in proc.stderr:
+            raise exceptions.StorageBucketCreateError(
+                f'Failed to create {self.url()}: {proc.stderr}')
+
+    def upload(self, sources: List[str]) -> None:
+        for source in sources:
+            src = os.path.expanduser(source)
+            proc = self._run(['-m', 'rsync', '-r', src, self.url()],
+                             check=False)
+            if proc.returncode != 0:
+                raise exceptions.StorageError(
+                    f'Upload {src} -> {self.url()} failed: {proc.stderr}')
+
+    def delete(self) -> None:
+        proc = self._run(['-m', 'rm', '-r', self.url()], check=False)
+        if proc.returncode != 0 and 'BucketNotFound' not in proc.stderr:
+            raise exceptions.StorageBucketDeleteError(
+                f'Failed to delete {self.url()}: {proc.stderr}')
+
+    def make_sync_dir_command(self, dst: str) -> str:
+        return (f'mkdir -p {dst} && (gsutil -m rsync -r {self.url()} {dst} '
+                f'|| gcloud storage rsync -r {self.url()} {dst})')
+
+    def make_mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.make_gcsfuse_mount_command(
+            self.name, mount_path)
+
+
+class LocalStore(AbstractStore):
+    """Directory-backed store for tests/local clusters."""
+
+    def _root(self) -> str:
+        d = os.path.join(paths.state_dir(), 'local_buckets', self.name)
+        return d
+
+    def url(self) -> str:
+        return f'local://{self.name}'
+
+    def exists(self) -> bool:
+        return os.path.isdir(self._root())
+
+    def create(self) -> None:
+        os.makedirs(self._root(), exist_ok=True)
+
+    def upload(self, sources: List[str]) -> None:
+        self.create()
+        for source in sources:
+            src = os.path.expanduser(source)
+            if os.path.isdir(src):
+                shutil.copytree(src, self._root(), dirs_exist_ok=True)
+            else:
+                shutil.copy2(src, self._root())
+
+    def delete(self) -> None:
+        shutil.rmtree(self._root(), ignore_errors=True)
+
+    def make_sync_dir_command(self, dst: str) -> str:
+        return f'mkdir -p {dst} && cp -a {self._root()}/. {dst}/'
+
+    def make_mount_command(self, mount_path: str) -> str:
+        # Local "mount" = symlink (no FUSE needed).
+        self.create()
+        return (f'mkdir -p $(dirname {mount_path}) && '
+                f'ln -sfn {self._root()} {mount_path}')
+
+
+_STORE_CLASSES = {
+    StoreType.GCS: GcsStore,
+    StoreType.LOCAL: LocalStore,
+}
+
+
+class Storage:
+    """User-facing named storage (reference storage.py:473)."""
+
+    def __init__(self,
+                 name: Optional[str] = None,
+                 source: Optional[str] = None,
+                 mode: StorageMode = StorageMode.MOUNT,
+                 store: Optional[StoreType] = None,
+                 persistent: bool = True) -> None:
+        self.name = name
+        self.source = source
+        self.mode = mode
+        self.persistent = persistent
+        self.store_type = store
+        self._store: Optional[AbstractStore] = None
+        self._validate()
+
+    def _validate(self) -> None:
+        if self.name is None and self.source is None:
+            raise exceptions.StorageSourceError(
+                'Storage needs a name and/or a source.')
+        if self.name is None:
+            assert self.source is not None
+            if self.source.startswith(('gs://', 's3://', 'gcs://')):
+                self.name = self.source.split('://', 1)[1].split('/')[0]
+            else:
+                self.name = os.path.basename(
+                    os.path.abspath(os.path.expanduser(self.source)))
+        self.name = self.name.lower().replace('_', '-')
+        if not _BUCKET_NAME_RE.fullmatch(self.name):
+            raise exceptions.StorageNameError(
+                f'Invalid bucket name {self.name!r}.')
+        if self.store_type is None:
+            if self.source is not None and '://' in self.source:
+                self.store_type = StoreType.from_url(self.source)
+            else:
+                self.store_type = StoreType.GCS
+
+    def get_store(self) -> AbstractStore:
+        if self._store is None:
+            cls = _STORE_CLASSES.get(self.store_type)
+            if cls is None:
+                raise exceptions.StorageError(
+                    f'Store type {self.store_type} not supported yet.')
+            self._store = cls(self.name, self.source)
+        return self._store
+
+    def sync_local_source(self) -> None:
+        """Create the bucket and upload a local source, recording state
+        (reference Storage.add_store + sync)."""
+        store = self.get_store()
+        global_user_state.add_or_update_storage(
+            self.name, self.handle(), global_user_state.StorageStatus.INIT)
+        try:
+            store.create()
+            if self.source is not None and '://' not in self.source:
+                store.upload([self.source])
+        except exceptions.StorageError:
+            global_user_state.add_or_update_storage(
+                self.name, self.handle(),
+                global_user_state.StorageStatus.UPLOAD_FAILED)
+            raise
+        global_user_state.add_or_update_storage(
+            self.name, self.handle(), global_user_state.StorageStatus.READY)
+
+    def delete(self) -> None:
+        self.get_store().delete()
+
+    def handle(self) -> Dict[str, Any]:
+        return {
+            'name': self.name,
+            'source': self.source,
+            'mode': self.mode.value,
+            'store': self.store_type.value,
+            'persistent': self.persistent,
+        }
+
+    @classmethod
+    def from_handle(cls, handle: Dict[str, Any]) -> 'Storage':
+        return cls(name=handle['name'], source=handle.get('source'),
+                   mode=StorageMode(handle['mode']),
+                   store=StoreType(handle['store']),
+                   persistent=handle.get('persistent', True))
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        from skypilot_tpu.utils import schemas
+        schemas.validate(config, schemas.get_storage_schema(),
+                         exceptions.StorageError, 'Invalid storage: ')
+        mode = StorageMode(config.get('mode', 'MOUNT').upper())
+        store = config.get('store')
+        return cls(name=config.get('name'),
+                   source=config.get('source'),
+                   mode=mode,
+                   store=StoreType(store.upper()) if store else None,
+                   persistent=config.get('persistent', True))
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.name:
+            out['name'] = self.name
+        if self.source:
+            out['source'] = self.source
+        out['mode'] = self.mode.value
+        if self.store_type:
+            out['store'] = self.store_type.value
+        out['persistent'] = self.persistent
+        return out

@@ -1,0 +1,53 @@
+"""Attach Storage objects to cluster hosts (MOUNT or COPY).
+
+Bridges data/storage.py and the backend: for each storage mount, sync any
+local source up to the bucket, then run the mount/sync command on every
+host (reference: storage mounts executed during file-mount stage,
+cloud_vm_ray_backend.py sync_storage_mounts path).
+"""
+from __future__ import annotations
+
+import typing
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.utils import subprocess_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.backend import backend as backend_lib
+    from skypilot_tpu.backend import tpu_gang_backend
+
+logger = sky_logging.init_logger(__name__)
+
+
+def mount_storage(backend: 'tpu_gang_backend.TpuGangBackend',
+                  handle: 'backend_lib.ClusterHandle', target: str,
+                  storage: storage_lib.Storage) -> None:
+    if storage.source is not None and '://' not in storage.source:
+        storage.sync_local_source()
+    else:
+        storage.get_store().create()
+    store = storage.get_store()
+    if storage.mode == storage_lib.StorageMode.MOUNT:
+        cmd = store.make_mount_command(target)
+    else:
+        cmd = store.make_sync_dir_command(target)
+
+    def _apply(address: str) -> None:
+        runner = backend._runner_for(handle, address)  # pylint: disable=protected-access
+        # Local simulated hosts cannot FUSE-mount; fall back to sync/link.
+        actual_cmd = cmd
+        if address.startswith('local:') and \
+                storage.mode == storage_lib.StorageMode.MOUNT and \
+                not isinstance(store, storage_lib.LocalStore):
+            actual_cmd = store.make_sync_dir_command(target)
+        rc, out, err = runner.run(actual_cmd, require_outputs=True)
+        if rc != 0:
+            raise exceptions.StorageError(
+                f'Failed to attach storage {storage.name!r} at {target} on '
+                f'{address}: {err or out}')
+
+    subprocess_utils.run_in_parallel(_apply, handle.host_addresses)
+    logger.info(f'Storage {storage.name!r} attached at {target} '
+                f'({storage.mode.value}).')

@@ -1,0 +1,185 @@
+"""The lifecycle pipeline: OPTIMIZE → PROVISION → SYNC → SETUP → EXEC.
+
+Counterpart of the reference's sky/execution.py:31-642: the `Stage` enum,
+the `_execute` wiring, `launch()` (all stages) and `exec_()` (SYNC_WORKDIR
++ EXEC only — the seconds-fast resubmit path, execution.py:553).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, List, Optional, Tuple, Union
+
+from skypilot_tpu import admin_policy
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backend import backend as backend_lib
+from skypilot_tpu.backend import tpu_gang_backend
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    """Reference execution.py:31 Stage enum (CLONE_DISK dropped: TPU VMs
+    have no disk cloning)."""
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    PRE_EXEC = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+def _to_dag(entrypoint: Union[task_lib.Task, dag_lib.Dag]) -> dag_lib.Dag:
+    if isinstance(entrypoint, task_lib.Task):
+        with dag_lib.Dag() as d:
+            d.add(entrypoint)
+        return d
+    return entrypoint
+
+
+def _execute(
+    entrypoint: Union[task_lib.Task, dag_lib.Dag],
+    *,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    cluster_name: Optional[str] = None,
+    detach_run: bool = False,
+    stages: Optional[List[Stage]] = None,
+    optimize_target: optimizer_lib.OptimizeTarget =
+        optimizer_lib.OptimizeTarget.COST,
+    idle_minutes_to_autostop: Optional[int] = None,
+    retry_until_up: bool = False,
+    quiet_optimizer: bool = False,
+) -> Tuple[Optional[int], Optional[backend_lib.ClusterHandle]]:
+    """Run the requested lifecycle stages for a one-task DAG.
+
+    Returns (job_id, handle) (reference _execute, execution.py:95).
+    """
+    dag = _to_dag(entrypoint)
+    if len(dag.tasks) != 1:
+        raise exceptions.NotSupportedError(
+            'Only single-task DAGs can be executed directly; use managed '
+            'jobs for pipelines (reference parity: execution.py:181).')
+    dag = admin_policy.apply(dag)
+    task = dag.tasks[0]
+    task.validate()
+    if cluster_name is None:
+        cluster_name = common_utils.generate_cluster_name()
+    common_utils.check_cluster_name_is_valid(cluster_name)
+    stages = stages or list(Stage)
+
+    backend = tpu_gang_backend.TpuGangBackend()
+    handle: Optional[backend_lib.ClusterHandle] = None
+    existing = global_user_state.get_cluster_from_name(cluster_name)
+    if existing is not None and existing['status'] == \
+            global_user_state.ClusterStatus.UP:
+        handle = existing['handle']
+
+    if Stage.OPTIMIZE in stages and handle is None:
+        optimizer_lib.optimize(dag, minimize=optimize_target,
+                               quiet=quiet_optimizer or dryrun)
+
+    if Stage.PROVISION in stages:
+        handle = backend.provision(task, task.best_resources, dryrun=dryrun,
+                                   stream_logs=stream_logs,
+                                   cluster_name=cluster_name,
+                                   retry_until_up=retry_until_up)
+    if handle is None:
+        if dryrun:
+            return None, None
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is not UP; cannot continue.')
+
+    if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+        backend.sync_workdir(handle, task.workdir)
+    if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                             task.storage_mounts):
+        backend.sync_file_mounts(handle, task.file_mounts,
+                                 task.storage_mounts)
+    if Stage.SETUP in stages:
+        backend.setup(handle, task)
+    if Stage.PRE_EXEC in stages and idle_minutes_to_autostop is not None:
+        backend.set_autostop(handle, idle_minutes_to_autostop, down=down)
+    job_id: Optional[int] = None
+    if Stage.EXEC in stages:
+        job_id = backend.execute(handle, task, detach_run=detach_run,
+                                 dryrun=dryrun)
+    if Stage.DOWN in stages and down and \
+            idle_minutes_to_autostop is None:
+        if detach_run:
+            # Job still running: autodown once the queue drains instead of
+            # tearing down under it.
+            backend.set_autostop(handle, 1, down=True)
+            logger.info('--down with detached run: cluster will autodown '
+                        '~1 minute after the job finishes.')
+        else:
+            # Non-detached execute streamed to completion.
+            backend.teardown(handle, terminate=True)
+            return job_id, None
+    return job_id, handle
+
+
+def launch(
+    task: Union[task_lib.Task, dag_lib.Dag],
+    cluster_name: Optional[str] = None,
+    *,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    detach_run: bool = False,
+    optimize_target: optimizer_lib.OptimizeTarget =
+        optimizer_lib.OptimizeTarget.COST,
+    idle_minutes_to_autostop: Optional[int] = None,
+    retry_until_up: bool = False,
+    quiet_optimizer: bool = False,
+) -> Tuple[Optional[int], Optional[backend_lib.ClusterHandle]]:
+    """Provision (or reuse) a cluster and run the task on it
+    (reference execution.launch, execution.py:368)."""
+    return _execute(
+        task,
+        dryrun=dryrun,
+        down=down,
+        stream_logs=stream_logs,
+        cluster_name=cluster_name,
+        detach_run=detach_run,
+        optimize_target=optimize_target,
+        idle_minutes_to_autostop=idle_minutes_to_autostop,
+        retry_until_up=retry_until_up,
+        quiet_optimizer=quiet_optimizer,
+    )
+
+
+def exec_(  # pylint: disable=redefined-builtin
+    task: Union[task_lib.Task, dag_lib.Dag],
+    cluster_name: str,
+    *,
+    dryrun: bool = False,
+    detach_run: bool = False,
+) -> Tuple[Optional[int], Optional[backend_lib.ClusterHandle]]:
+    """Fast resubmit onto a live cluster: SYNC_WORKDIR + EXEC only
+    (reference execution.exec, execution.py:553)."""
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist; `launch` first.')
+    if record['status'] != global_user_state.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}, '
+            'not UP.', cluster_status=record['status'],
+            handle=record['handle'])
+    return _execute(
+        task,
+        dryrun=dryrun,
+        stream_logs=True,
+        cluster_name=cluster_name,
+        detach_run=detach_run,
+        stages=[Stage.SYNC_WORKDIR, Stage.EXEC],
+    )

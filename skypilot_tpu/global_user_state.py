@@ -1,0 +1,398 @@
+"""Client-side SQLite state: clusters, history, storage, enabled clouds.
+
+Counterpart of the reference's sky/global_user_state.py:34-841.  Same
+design: a single SQLite DB on the client holds the authoritative *intent*
+records (cluster handles are pickled blobs), while cloud reality is
+reconciled lazily by status refresh (backend_utils analog).  Usage
+intervals are recorded per cluster for `cost-report`
+(global_user_state.py:469-525).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import pickle
+import sqlite3
+import threading
+import time
+import typing
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import paths
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.backend import backend as backend_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+class ClusterStatus(enum.Enum):
+    """Cluster lifecycle status (reference: sky/status_lib.ClusterStatus)."""
+    INIT = 'INIT'          # provisioning in progress or unknown/interrupted
+    UP = 'UP'              # all hosts running, runtime healthy
+    STOPPED = 'STOPPED'    # instances stopped (impossible for TPU pods)
+
+    def colored_str(self) -> str:
+        color = {'INIT': '\x1b[94m', 'UP': '\x1b[92m',
+                 'STOPPED': '\x1b[93m'}[self.value]
+        return f'{color}{self.value}\x1b[0m'
+
+
+class StorageStatus(enum.Enum):
+    INIT = 'INIT'
+    UPLOAD_FAILED = 'UPLOAD_FAILED'
+    READY = 'READY'
+
+
+_CREATE_TABLES = """\
+CREATE TABLE IF NOT EXISTS clusters (
+    name TEXT PRIMARY KEY,
+    launched_at INTEGER,
+    handle BLOB,
+    last_use TEXT,
+    status TEXT,
+    autostop INTEGER DEFAULT -1,
+    to_down INTEGER DEFAULT 0,
+    owner TEXT DEFAULT NULL,
+    metadata TEXT DEFAULT '{}',
+    cluster_hash TEXT DEFAULT NULL,
+    config_hash TEXT DEFAULT NULL,
+    status_updated_at INTEGER DEFAULT NULL);
+CREATE TABLE IF NOT EXISTS cluster_history (
+    cluster_hash TEXT PRIMARY KEY,
+    name TEXT,
+    num_nodes INTEGER,
+    requested_resources BLOB,
+    launched_resources BLOB,
+    usage_intervals BLOB);
+CREATE TABLE IF NOT EXISTS storage (
+    name TEXT PRIMARY KEY,
+    launched_at INTEGER,
+    handle BLOB,
+    last_use TEXT,
+    status TEXT);
+CREATE TABLE IF NOT EXISTS enabled_clouds (
+    name TEXT PRIMARY KEY);
+CREATE TABLE IF NOT EXISTS config (
+    key TEXT PRIMARY KEY,
+    value TEXT);
+"""
+
+_conn_local = threading.local()
+_db_path_override: Optional[str] = None
+
+
+def _db_path() -> str:
+    return _db_path_override or paths.state_db_path()
+
+
+def _conn() -> sqlite3.Connection:
+    path = _db_path()
+    cached = getattr(_conn_local, 'conn', None)
+    cached_path = getattr(_conn_local, 'path', None)
+    if cached is not None and cached_path == path:
+        return cached
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10.0)
+    conn.executescript(_CREATE_TABLES)
+    conn.commit()
+    _conn_local.conn = conn
+    _conn_local.path = path
+    return conn
+
+
+def reset_for_tests() -> None:
+    """Drop cached connections so SKYTPU_STATE_DIR changes take effect."""
+    if getattr(_conn_local, 'conn', None) is not None:
+        _conn_local.conn.close()
+        _conn_local.conn = None
+        _conn_local.path = None
+
+
+# ---------------------------------------------------------------------------
+# Clusters
+# ---------------------------------------------------------------------------
+def add_or_update_cluster(cluster_name: str,
+                          cluster_handle: 'backend_lib.ResourceHandle',
+                          requested_resources: Optional[Set[Any]],
+                          ready: bool,
+                          config_hash: Optional[str] = None) -> None:
+    """Record a cluster going INIT (launch started) or UP (ready)."""
+    status = ClusterStatus.UP if ready else ClusterStatus.INIT
+    now = int(time.time())
+    handle_blob = pickle.dumps(cluster_handle)
+    cluster_hash = _get_hash_for_existing_cluster(cluster_name) or \
+        f'{cluster_name}-{now}'
+    usage_intervals = _get_usage_intervals(cluster_hash)
+    if ready:
+        usage_intervals = _open_interval(usage_intervals, now)
+    conn = _conn()
+    with conn:
+        conn.execute(
+            'INSERT INTO clusters (name, launched_at, handle, last_use, '
+            'status, cluster_hash, config_hash, status_updated_at) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?, ?) '
+            'ON CONFLICT(name) DO UPDATE SET launched_at=excluded.launched_at,'
+            ' handle=excluded.handle, last_use=excluded.last_use, '
+            ' status=excluded.status, cluster_hash=excluded.cluster_hash, '
+            ' config_hash=COALESCE(excluded.config_hash, config_hash), '
+            ' status_updated_at=excluded.status_updated_at',
+            (cluster_name, now, handle_blob, _last_use(), status.value,
+             cluster_hash, config_hash, now))
+        launched = pickle.dumps(
+            getattr(cluster_handle, 'launched_resources', None))
+        requested = pickle.dumps(requested_resources)
+        num_nodes = getattr(cluster_handle, 'launched_nodes', None)
+        conn.execute(
+            'INSERT INTO cluster_history (cluster_hash, name, num_nodes, '
+            'requested_resources, launched_resources, usage_intervals) '
+            'VALUES (?, ?, ?, ?, ?, ?) '
+            'ON CONFLICT(cluster_hash) DO UPDATE SET '
+            ' num_nodes=excluded.num_nodes, '
+            ' requested_resources=excluded.requested_resources, '
+            ' launched_resources=excluded.launched_resources, '
+            ' usage_intervals=excluded.usage_intervals',
+            (cluster_hash, cluster_name, num_nodes, requested, launched,
+             pickle.dumps(usage_intervals)))
+
+
+def _last_use() -> str:
+    import sys
+    return ' '.join(sys.argv)
+
+
+def _open_interval(intervals: List[Tuple[int, Optional[int]]],
+                   now: int) -> List[Tuple[int, Optional[int]]]:
+    if intervals and intervals[-1][1] is None:
+        return intervals
+    return intervals + [(now, None)]
+
+
+def _close_interval(intervals: List[Tuple[int, Optional[int]]],
+                    now: int) -> List[Tuple[int, Optional[int]]]:
+    if intervals and intervals[-1][1] is None:
+        start, _ = intervals[-1]
+        return intervals[:-1] + [(start, now)]
+    return intervals
+
+
+def update_cluster_status(cluster_name: str, status: ClusterStatus) -> None:
+    now = int(time.time())
+    conn = _conn()
+    with conn:
+        conn.execute(
+            'UPDATE clusters SET status=?, status_updated_at=? WHERE name=?',
+            (status.value, now, cluster_name))
+    if status != ClusterStatus.UP:
+        cluster_hash = _get_hash_for_existing_cluster(cluster_name)
+        if cluster_hash is not None:
+            intervals = _close_interval(_get_usage_intervals(cluster_hash),
+                                        now)
+            with conn:
+                conn.execute(
+                    'UPDATE cluster_history SET usage_intervals=? '
+                    'WHERE cluster_hash=?',
+                    (pickle.dumps(intervals), cluster_hash))
+
+
+def update_cluster_handle(cluster_name: str,
+                          cluster_handle: Any) -> None:
+    conn = _conn()
+    with conn:
+        conn.execute('UPDATE clusters SET handle=? WHERE name=?',
+                     (pickle.dumps(cluster_handle), cluster_name))
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    """On stop: keep record as STOPPED (handle IPs stale-cleared by the
+    backend); on terminate: delete the row but close the usage interval
+    first so cost-report still sees it."""
+    now = int(time.time())
+    cluster_hash = _get_hash_for_existing_cluster(cluster_name)
+    conn = _conn()
+    if cluster_hash is not None:
+        intervals = _close_interval(_get_usage_intervals(cluster_hash), now)
+        with conn:
+            conn.execute(
+                'UPDATE cluster_history SET usage_intervals=? '
+                'WHERE cluster_hash=?',
+                (pickle.dumps(intervals), cluster_hash))
+    with conn:
+        if terminate:
+            conn.execute('DELETE FROM clusters WHERE name=?', (cluster_name,))
+        else:
+            conn.execute(
+                'UPDATE clusters SET status=?, status_updated_at=? '
+                'WHERE name=?',
+                (ClusterStatus.STOPPED.value, now, cluster_name))
+
+
+def get_cluster_from_name(
+        cluster_name: str) -> Optional[Dict[str, Any]]:
+    rows = _conn().execute('SELECT * FROM clusters WHERE name=?',
+                           (cluster_name,)).fetchall()
+    if not rows:
+        return None
+    return _row_to_record(rows[0])
+
+
+def _row_to_record(row: Tuple) -> Dict[str, Any]:
+    (name, launched_at, handle, last_use, status, autostop, to_down, owner,
+     metadata, cluster_hash, config_hash, status_updated_at) = row
+    return {
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle),
+        'last_use': last_use,
+        'status': ClusterStatus(status),
+        'autostop': autostop,
+        'to_down': bool(to_down),
+        'owner': json.loads(owner) if owner else None,
+        'metadata': json.loads(metadata),
+        'cluster_hash': cluster_hash,
+        'config_hash': config_hash,
+        'status_updated_at': status_updated_at,
+    }
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    rows = _conn().execute(
+        'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def get_handle_from_cluster_name(cluster_name: str) -> Optional[Any]:
+    record = get_cluster_from_name(cluster_name)
+    return None if record is None else record['handle']
+
+def set_cluster_autostop_value(cluster_name: str, idle_minutes: int,
+                               to_down: bool) -> None:
+    conn = _conn()
+    with conn:
+        conn.execute('UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
+                     (idle_minutes, int(to_down), cluster_name))
+
+
+def get_cluster_metadata(cluster_name: str) -> Optional[Dict[str, Any]]:
+    record = get_cluster_from_name(cluster_name)
+    return None if record is None else record['metadata']
+
+
+def set_cluster_metadata(cluster_name: str, metadata: Dict[str,
+                                                           Any]) -> None:
+    conn = _conn()
+    with conn:
+        conn.execute('UPDATE clusters SET metadata=? WHERE name=?',
+                     (json.dumps(metadata), cluster_name))
+
+
+def set_owner_identity_for_cluster(cluster_name: str,
+                                   owner_identity: Optional[List[str]]
+                                   ) -> None:
+    if owner_identity is None:
+        return
+    conn = _conn()
+    with conn:
+        conn.execute('UPDATE clusters SET owner=? WHERE name=?',
+                     (json.dumps(owner_identity), cluster_name))
+
+
+def _get_hash_for_existing_cluster(cluster_name: str) -> Optional[str]:
+    rows = _conn().execute('SELECT cluster_hash FROM clusters WHERE name=?',
+                           (cluster_name,)).fetchall()
+    return rows[0][0] if rows else None
+
+
+def _get_usage_intervals(
+        cluster_hash: Optional[str]
+) -> List[Tuple[int, Optional[int]]]:
+    if cluster_hash is None:
+        return []
+    rows = _conn().execute(
+        'SELECT usage_intervals FROM cluster_history WHERE cluster_hash=?',
+        (cluster_hash,)).fetchall()
+    if not rows or rows[0][0] is None:
+        return []
+    return pickle.loads(rows[0][0])
+
+
+def get_cluster_history() -> List[Dict[str, Any]]:
+    """All clusters ever launched, with usage intervals (cost-report)."""
+    rows = _conn().execute(
+        'SELECT cluster_hash, name, num_nodes, requested_resources, '
+        'launched_resources, usage_intervals FROM cluster_history').fetchall()
+    out = []
+    current = {r['name'] for r in get_clusters()}
+    for (cluster_hash, name, num_nodes, requested, launched,
+         intervals) in rows:
+        out.append({
+            'cluster_hash': cluster_hash,
+            'name': name,
+            'num_nodes': num_nodes,
+            'requested_resources':
+                pickle.loads(requested) if requested else None,
+            'launched_resources':
+                pickle.loads(launched) if launched else None,
+            'usage_intervals':
+                pickle.loads(intervals) if intervals else [],
+            'still_exists': name in current,
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Enabled clouds (sky check analog)
+# ---------------------------------------------------------------------------
+def get_cached_enabled_clouds() -> List[str]:
+    rows = _conn().execute('SELECT name FROM enabled_clouds').fetchall()
+    return [r[0] for r in rows]
+
+
+def set_enabled_clouds(enabled_clouds: List[str]) -> None:
+    conn = _conn()
+    with conn:
+        conn.execute('DELETE FROM enabled_clouds')
+        conn.executemany('INSERT INTO enabled_clouds (name) VALUES (?)',
+                         [(c,) for c in enabled_clouds])
+
+
+# ---------------------------------------------------------------------------
+# Storage
+# ---------------------------------------------------------------------------
+def add_or_update_storage(storage_name: str, storage_handle: Any,
+                          storage_status: StorageStatus) -> None:
+    conn = _conn()
+    with conn:
+        conn.execute(
+            'INSERT INTO storage (name, launched_at, handle, last_use, '
+            'status) VALUES (?, ?, ?, ?, ?) '
+            'ON CONFLICT(name) DO UPDATE SET handle=excluded.handle, '
+            'status=excluded.status, last_use=excluded.last_use',
+            (storage_name, int(time.time()), pickle.dumps(storage_handle),
+             _last_use(), storage_status.value))
+
+
+def remove_storage(storage_name: str) -> None:
+    conn = _conn()
+    with conn:
+        conn.execute('DELETE FROM storage WHERE name=?', (storage_name,))
+
+
+def get_storage() -> List[Dict[str, Any]]:
+    rows = _conn().execute('SELECT * FROM storage').fetchall()
+    return [{
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle),
+        'last_use': last_use,
+        'status': StorageStatus(status),
+    } for name, launched_at, handle, last_use, status in rows]
+
+
+def get_handle_from_storage_name(storage_name: str) -> Optional[Any]:
+    for record in get_storage():
+        if record['name'] == storage_name:
+            return record['handle']
+    return None

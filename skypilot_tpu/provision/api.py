@@ -1,0 +1,56 @@
+"""Function-per-operation provisioner dispatch.
+
+Counterpart of the reference's sky/provision/__init__.py:32-227
+(`_route_to_cloud_impl`): each cloud has a module
+`skypilot_tpu.provision.<name>.instance` exporting the uniform interface:
+
+    run_instances(region, cluster_name_on_cloud, config) -> ProvisionRecord
+    stop_instances(cluster_name_on_cloud, provider_config, worker_only)
+    terminate_instances(cluster_name_on_cloud, provider_config, worker_only)
+    query_instances(cluster_name_on_cloud, provider_config,
+                    non_terminated_only) -> Dict[instance_id, status|None]
+    wait_instances(region, cluster_name_on_cloud, state)
+    get_cluster_info(region, cluster_name_on_cloud, provider_config)
+        -> ClusterInfo
+    open_ports(cluster_name_on_cloud, ports, provider_config)
+    cleanup_ports(cluster_name_on_cloud, ports, provider_config)
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+from typing import Any, Callable
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+@functools.lru_cache(maxsize=None)
+def _get_cloud_module(provider_name: str):
+    return importlib.import_module(
+        f'skypilot_tpu.provision.{provider_name.lower()}.instance')
+
+
+def _route(fn_name: str) -> Callable:
+    def impl(provider_name: str, *args: Any, **kwargs: Any) -> Any:
+        module = _get_cloud_module(provider_name)
+        fn = getattr(module, fn_name, None)
+        if fn is None:
+            raise NotImplementedError(
+                f'Provisioner {provider_name!r} does not implement '
+                f'{fn_name}.')
+        return fn(*args, **kwargs)
+
+    impl.__name__ = fn_name
+    return impl
+
+
+run_instances = _route('run_instances')
+stop_instances = _route('stop_instances')
+terminate_instances = _route('terminate_instances')
+query_instances = _route('query_instances')
+wait_instances = _route('wait_instances')
+get_cluster_info = _route('get_cluster_info')
+open_ports = _route('open_ports')
+cleanup_ports = _route('cleanup_ports')

@@ -1,0 +1,128 @@
+"""Shared provisioner data types.
+
+Counterpart of the reference's sky/provision/common.py (:39 ProvisionConfig,
+:63 ProvisionRecord, :92 InstanceInfo, :109 ClusterInfo) with a slice-aware
+twist: `InstanceInfo` may describe a *TPU slice* whose `host_ips` lists every
+host VM in the slice — one logical instance, many SSH targets — mirroring
+how the reference models TPU pods as one node with num_ips_per_node IPs
+(cloud_vm_ray_backend.py:2550).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a cloud impl needs to create instances for a cluster."""
+    provider_config: Dict[str, Any]     # cloud-specific (project, zone, ...)
+    authentication_config: Dict[str, Any]
+    docker_config: Dict[str, Any]
+    node_config: Dict[str, Any]         # deploy variables from the cloud
+    count: int                          # logical nodes to reach
+    tags: Dict[str, str]
+    resume_stopped_nodes: bool
+    ports_to_open_on_launch: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances (reference provision/common.py:63)."""
+    provider_name: str
+    cluster_name: str
+    region: str
+    zone: Optional[str]
+    head_instance_id: str
+    resumed_instance_ids: List[str]
+    created_instance_ids: List[str]
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.created_instance_ids or
+                instance_id in self.resumed_instance_ids)
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One logical instance. For a TPU slice this is the whole slice:
+    internal_ip/external_ip point at host 0 and host_ips/host_external_ips
+    carry every host in worker-id order (stable rank order)."""
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    tags: Dict[str, str]
+    status: str = 'running'
+    host_ips: Optional[List[str]] = None
+    host_external_ips: Optional[List[str]] = None
+    ssh_port: int = 22
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.host_ips) if self.host_ips else 1
+
+    def get_feasible_ip(self) -> str:
+        return self.external_ip or self.internal_ip
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Full cluster view returned by get_cluster_info (reference
+    provision/common.py:109)."""
+    instances: Dict[str, List[InstanceInfo]]
+    head_instance_id: Optional[str]
+    provider_name: str
+    provider_config: Optional[Dict[str, Any]] = None
+    docker_user: Optional[str] = None
+    ssh_user: Optional[str] = None
+    custom_ray_options: Optional[Dict[str, Any]] = None
+
+    def get_instances(self) -> List[InstanceInfo]:
+        out = []
+        for iid in sorted(self.instances):
+            out.extend(self.instances[iid])
+        # Head first, then stable order.
+        out.sort(key=lambda i: (i.instance_id != self.head_instance_id,
+                                i.instance_id))
+        return out
+
+    def get_head_instance(self) -> Optional[InstanceInfo]:
+        if self.head_instance_id is None:
+            return None
+        infos = self.instances.get(self.head_instance_id)
+        return infos[0] if infos else None
+
+    def get_worker_instances(self) -> List[InstanceInfo]:
+        return [i for i in self.get_instances()
+                if i.instance_id != self.head_instance_id]
+
+    def ip_tuples(self) -> List[tuple]:
+        """(internal_ip, external_ip) per *host* (slices expanded), head's
+        hosts first — the flat SSH-target list for the gang launcher."""
+        tuples = []
+        for inst in self.get_instances():
+            if inst.host_ips:
+                ext = inst.host_external_ips or [None] * len(inst.host_ips)
+                tuples.extend(list(zip(inst.host_ips, ext)))
+            else:
+                tuples.append((inst.internal_ip, inst.external_ip))
+        return tuples
+
+    def get_feasible_ips(self, force_internal_ips: bool = False) -> List[str]:
+        out = []
+        for internal, external in self.ip_tuples():
+            if force_internal_ips or external is None:
+                out.append(internal)
+            else:
+                out.append(external)
+        return out
+
+    def num_instances(self) -> int:
+        return sum(len(v) for v in self.instances.values())
+
+    def num_hosts(self) -> int:
+        return sum(i.num_hosts for i in self.get_instances())
+
+
+def query_ports_passthrough(ports: List[str],
+                            head_ip: str) -> Dict[str, List[str]]:
+    return {port: [f'{head_ip}:{port}'] for port in ports}

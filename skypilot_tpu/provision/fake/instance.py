@@ -1,0 +1,174 @@
+"""Fake in-process provisioner.
+
+Implements the full provision/api.py interface against
+`clouds.fake.FakeCloudState`, making everything past the reference's
+`bulk_provision` cloud-API boundary testable hermetically — the tier the
+reference lacks (SURVEY.md §4 "no fake-cloud simulator").  TPU slices are
+modeled faithfully: one instance record carries per-host IPs, created and
+destroyed atomically, preemptible via `state.preempt_cluster()`.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.clouds import fake as fake_cloud
+from skypilot_tpu.provision import common
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'fake'
+
+
+def _state() -> fake_cloud.FakeCloudState:
+    return fake_cloud.fake_cloud_state()
+
+
+def _cluster_instances(cluster_name_on_cloud: str,
+                       include_terminated: bool = False
+                       ) -> Dict[str, Dict[str, Any]]:
+    return {
+        iid: rec for iid, rec in _state().instances.items()
+        if rec['cluster'] == cluster_name_on_cloud and
+        (include_terminated or rec['status'] != 'terminated')
+    }
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    state = _state()
+    node_cfg = config.node_config
+    zone = node_cfg.get('zone') or f'{region}-1'
+    num_hosts = int(node_cfg.get('num_tpu_hosts', 1) or 1)
+    is_tpu = bool(node_cfg.get('tpu_vm'))
+
+    existing = _cluster_instances(cluster_name_on_cloud)
+    resumed: List[str] = []
+    if config.resume_stopped_nodes:
+        for iid, rec in existing.items():
+            if rec['status'] == 'stopped':
+                rec['status'] = 'running'
+                resumed.append(iid)
+    running = [iid for iid, rec in existing.items()
+               if rec['status'] == 'running']
+    to_create = config.count - len(running)
+    created: List[str] = []
+    # Capacity/fault check counts hosts: a whole slice takes num_hosts slots
+    # and is admitted or rejected atomically (slice gang admission).
+    if to_create > 0:
+        state.check_and_take_capacity(zone, to_create * num_hosts)
+        if state.provision_delay_s:
+            time.sleep(state.provision_delay_s)
+        for _ in range(to_create):
+            iid = state.next_id()
+            seq = len(state.instances)
+            host_ips = [f'10.0.{seq}.{h + 1}' for h in range(num_hosts)]
+            state.instances[iid] = {
+                'id': iid,
+                'cluster': cluster_name_on_cloud,
+                'region': region,
+                'zone': zone,
+                'status': 'running',
+                'preempted': False,
+                'spot': bool(node_cfg.get('use_spot')),
+                'tpu': is_tpu,
+                'host_ips': host_ips,
+                'created_at': time.time(),
+                'tags': dict(config.tags),
+            }
+            created.append(iid)
+
+    all_insts = sorted(_cluster_instances(cluster_name_on_cloud))
+    head_id = all_insts[0]
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER,
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        head_instance_id=head_id,
+        resumed_instance_ids=resumed,
+        created_instance_ids=created,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    for iid, rec in _cluster_instances(cluster_name_on_cloud).items():
+        if worker_only and iid == sorted(
+                _cluster_instances(cluster_name_on_cloud))[0]:
+            continue
+        if rec['tpu'] and len(rec['host_ips']) > 1:
+            from skypilot_tpu import exceptions
+            raise exceptions.NotSupportedError(
+                'TPU pod slices cannot be stopped.')
+        rec['status'] = 'stopped'
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    state = _state()
+    insts = _cluster_instances(cluster_name_on_cloud)
+    head = sorted(insts)[0] if insts else None
+    for iid, rec in insts.items():
+        if worker_only and iid == head:
+            continue
+        rec['status'] = 'terminated'
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for iid, rec in _cluster_instances(cluster_name_on_cloud,
+                                       include_terminated=True).items():
+        status = rec['status']
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[iid] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    del region, cluster_name_on_cloud, state  # instant in the fake cloud
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    insts = _cluster_instances(cluster_name_on_cloud)
+    for iid, rec in insts.items():
+        if rec['status'] != 'running':
+            continue
+        instances[iid] = [
+            common.InstanceInfo(
+                instance_id=iid,
+                internal_ip=rec['host_ips'][0],
+                external_ip=None,
+                tags=rec['tags'],
+                host_ips=list(rec['host_ips']),
+            )
+        ]
+    head_id = sorted(insts)[0] if insts else None
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head_id,
+        provider_name=_PROVIDER,
+        provider_config=provider_config,
+        ssh_user='fake',
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

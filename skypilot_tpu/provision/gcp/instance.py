@@ -1,0 +1,481 @@
+"""GCP provisioner: TPU-VM slices (tpu.googleapis.com/v2) + GCE VMs.
+
+Counterpart of the reference's sky/provision/gcp/instance_utils.py —
+specifically `GCPTPUVMInstance` (:1191, discovery-API based) and
+`GCPComputeInstance` (:311) — rebuilt slice-first on the REST layer in
+gcp_api.py:
+
+  - A *TPU slice* is one logical instance: a single TPU node resource whose
+    networkEndpoints list all host VMs.  Creation/deletion is atomic at the
+    API level, which is exactly the gang-admission property the reference
+    emulates with Ray placement groups (cloud_vm_ray_backend.py:450-456).
+  - Preempted/failed slices are DELETED, never stopped
+    (resources.py:633 semantics); single-host non-pod TPU VMs may stop.
+  - Capacity/quota errors are classified into failover-able
+    ProvisionError vs terminal no_failover errors, the TPU analog of the
+    reference's GCP error parser (cloud_vm_ray_backend.py:967-1070).
+  - SSH keys are injected through node metadata (authentication.py TPU-VM
+    special case in the reference).
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import gcp_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'gcp'
+_LABEL_CLUSTER = 'skytpu-cluster'
+
+# Messages that indicate lack of capacity → failover to next zone
+# (reference: FailoverCloudErrorHandlerV2 GCP parser incl. the TPU
+# capacity message, cloud_vm_ray_backend.py:1036).
+_CAPACITY_PATTERNS = [
+    r'There is no more capacity in the zone',
+    r'Not enough resources available to fulfill the request',
+    r'ZONE_RESOURCE_POOL_EXHAUSTED',
+    r'RESOURCE_EXHAUSTED',
+    r'stockout',
+    r'The zone .* does not have enough resources',
+]
+_QUOTA_PATTERNS = [
+    r'Quota exceeded for quota metric',
+    r'QUOTA_EXCEEDED',
+    r"quota '.*' exceeded",
+]
+
+
+def _classify_api_error(e: gcp_api.GcpApiError) -> Exception:
+    msg = str(e)
+    for pat in _CAPACITY_PATTERNS:
+        if re.search(pat, msg, re.IGNORECASE):
+            return exceptions.ProvisionError(
+                f'GCP capacity unavailable: {msg}', no_failover=False)
+    for pat in _QUOTA_PATTERNS:
+        if re.search(pat, msg, re.IGNORECASE):
+            # Quota is per-region: failover to other regions can still help,
+            # but retrying the same zone cannot.
+            return exceptions.ProvisionError(f'GCP quota exceeded: {msg}',
+                                             no_failover=False)
+    if e.status_code in (401, 403):
+        return exceptions.ProvisionError(
+            f'GCP permission error (no failover): {msg}', no_failover=True)
+    if e.status_code == 409:
+        return exceptions.ProvisionError(f'GCP conflict: {msg}',
+                                         no_failover=False)
+    return e
+
+
+def _project(provider_config: Optional[Dict[str, Any]]) -> str:
+    if provider_config and provider_config.get('project_id'):
+        return provider_config['project_id']
+    return gcp_api.default_project()
+
+
+def _is_tpu_config(node_config: Dict[str, Any]) -> bool:
+    return bool(node_config.get('tpu_vm'))
+
+
+# ---------------------------------------------------------------------------
+# run_instances
+# ---------------------------------------------------------------------------
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    zone = node_cfg['zone']
+    project = _project(config.provider_config)
+    try:
+        if _is_tpu_config(node_cfg):
+            return _run_tpu_slices(project, region, zone,
+                                   cluster_name_on_cloud, config)
+        return _run_gce_instances(project, region, zone,
+                                  cluster_name_on_cloud, config)
+    except gcp_api.GcpApiError as e:
+        raise _classify_api_error(e) from e
+
+
+def _node_name(cluster_name_on_cloud: str, idx: int) -> str:
+    return f'{cluster_name_on_cloud}-{idx}'
+
+
+def _run_tpu_slices(project: str, region: str, zone: str,
+                    cluster_name_on_cloud: str,
+                    config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    existing = _list_cluster_tpu_nodes(project, zone, cluster_name_on_cloud)
+    ready = [n for n in existing
+             if n['state'] in ('READY', 'CREATING', 'STARTING')]
+    stopped = [n for n in existing if n['state'] == 'STOPPED']
+    resumed: List[str] = []
+    if config.resume_stopped_nodes:
+        for node in stopped:
+            node_id = node['name'].rsplit('/', 1)[-1]
+            op = gcp_api.start_tpu_node(project, zone, node_id)
+            gcp_api.wait_tpu_operation(op)
+            resumed.append(node_id)
+            ready.append(node)
+
+    to_create = config.count - len(ready)
+    created: List[str] = []
+    for idx in range(len(existing), len(existing) + max(to_create, 0)):
+        node_id = _node_name(cluster_name_on_cloud, idx)
+        body: Dict[str, Any] = {
+            'acceleratorType': node_cfg['tpu_type'],
+            'runtimeVersion': node_cfg['runtime_version'],
+            'networkConfig': {'enableExternalIps': True},
+            'labels': {
+                _LABEL_CLUSTER: cluster_name_on_cloud,
+                **{k.lower(): str(v).lower()
+                   for k, v in config.tags.items()},
+            },
+            'metadata': {
+                'ssh-keys': config.authentication_config.get('ssh_keys', ''),
+                'startup-script':
+                    config.authentication_config.get('startup_script', ''),
+            },
+            'schedulingConfig': {
+                'preemptible': bool(node_cfg.get('use_spot')),
+            },
+        }
+        if node_cfg.get('tpu_topology'):
+            body['acceleratorConfig'] = {
+                'type': node_cfg['tpu_generation'].upper().replace('E', 'E'),
+                'topology': node_cfg['tpu_topology'],
+            }
+            body.pop('acceleratorType')
+        if node_cfg.get('reservation'):
+            body['schedulingConfig']['reserved'] = True
+        logger.debug(f'Creating TPU node {node_id} '
+                     f'({node_cfg["tpu_type"]}, zone {zone})')
+        op = gcp_api.create_tpu_node(project, zone, node_id, body)
+        gcp_api.wait_tpu_operation(op)
+        created.append(node_id)
+
+    all_nodes = _list_cluster_tpu_nodes(project, zone, cluster_name_on_cloud)
+    names = sorted(n['name'].rsplit('/', 1)[-1] for n in all_nodes
+                   if n['state'] not in ('DELETING', 'TERMINATED'))
+    if not names:
+        raise exceptions.ProvisionError(
+            f'No TPU nodes exist for {cluster_name_on_cloud} after '
+            'provisioning.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER,
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        head_instance_id=names[0],
+        resumed_instance_ids=resumed,
+        created_instance_ids=created,
+    )
+
+
+def _run_gce_instances(project: str, region: str, zone: str,
+                       cluster_name_on_cloud: str,
+                       config: common.ProvisionConfig
+                       ) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    label_filter = f'labels.{_LABEL_CLUSTER}={cluster_name_on_cloud}'
+    existing = gcp_api.list_instances(project, zone, label_filter)
+    running = [i for i in existing
+               if i['status'] in ('RUNNING', 'PROVISIONING', 'STAGING')]
+    stopped = [i for i in existing if i['status'] == 'TERMINATED']
+    resumed: List[str] = []
+    if config.resume_stopped_nodes:
+        for inst in stopped:
+            op = gcp_api.instance_action(project, zone, inst['name'],
+                                         'start')
+            gcp_api.wait_zone_operation(project, zone, op)
+            resumed.append(inst['name'])
+            running.append(inst)
+
+    to_create = config.count - len(running)
+    created: List[str] = []
+    machine_type = (f'zones/{zone}/machineTypes/'
+                    f'{node_cfg["instance_type"]}')
+    for idx in range(len(existing), len(existing) + max(to_create, 0)):
+        name = _node_name(cluster_name_on_cloud, idx)
+        body: Dict[str, Any] = {
+            'name': name,
+            'machineType': machine_type,
+            'labels': {
+                _LABEL_CLUSTER: cluster_name_on_cloud,
+                **{k.lower(): str(v).lower()
+                   for k, v in config.tags.items()},
+            },
+            'disks': [{
+                'boot': True,
+                'autoDelete': True,
+                'initializeParams': {
+                    'sourceImage': node_cfg.get('image_id'),
+                    'diskSizeGb': str(node_cfg.get('disk_size', 256)),
+                },
+            }],
+            'networkInterfaces': [{
+                'network': 'global/networks/default',
+                'accessConfigs': [{
+                    'name': 'External NAT',
+                    'type': 'ONE_TO_ONE_NAT',
+                }],
+            }],
+            'metadata': {
+                'items': [{
+                    'key': 'ssh-keys',
+                    'value':
+                        config.authentication_config.get('ssh_keys', ''),
+                }],
+            },
+            'scheduling': {
+                'preemptible': bool(node_cfg.get('use_spot')),
+                'automaticRestart': not node_cfg.get('use_spot'),
+            },
+        }
+        op = gcp_api.insert_instance(project, zone, body)
+        gcp_api.wait_zone_operation(project, zone, op)
+        created.append(name)
+
+    all_insts = gcp_api.list_instances(project, zone, label_filter)
+    names = sorted(i['name'] for i in all_insts
+                   if i['status'] not in ('STOPPING', 'TERMINATED'))
+    if not names:
+        raise exceptions.ProvisionError(
+            f'No instances exist for {cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER,
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        head_instance_id=names[0],
+        resumed_instance_ids=resumed,
+        created_instance_ids=created,
+    )
+
+
+def _list_cluster_tpu_nodes(project: str, zone: str,
+                            cluster_name_on_cloud: str
+                            ) -> List[Dict[str, Any]]:
+    nodes = gcp_api.list_tpu_nodes(project, zone)
+    return [n for n in nodes
+            if n.get('labels', {}).get(_LABEL_CLUSTER) ==
+            cluster_name_on_cloud]
+
+
+# ---------------------------------------------------------------------------
+# stop / terminate / query
+# ---------------------------------------------------------------------------
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    project = _project(provider_config)
+    zone = (provider_config or {})['zone']
+    if (provider_config or {}).get('tpu_vm'):
+        nodes = _list_cluster_tpu_nodes(project, zone, cluster_name_on_cloud)
+        for node in nodes:
+            if len(node.get('networkEndpoints', [])) > 1:
+                raise exceptions.NotSupportedError(
+                    'TPU pod slices cannot be stopped — terminate instead '
+                    '(reference parity: sky/clouds/gcp.py:193-204).')
+            node_id = node['name'].rsplit('/', 1)[-1]
+            op = gcp_api.stop_tpu_node(project, zone, node_id)
+            gcp_api.wait_tpu_operation(op)
+        return
+    label_filter = f'labels.{_LABEL_CLUSTER}={cluster_name_on_cloud}'
+    insts = gcp_api.list_instances(project, zone, label_filter)
+    head = min((i['name'] for i in insts), default=None)
+    for inst in insts:
+        if worker_only and inst['name'] == head:
+            continue
+        op = gcp_api.instance_action(project, zone, inst['name'], 'stop')
+        gcp_api.wait_zone_operation(project, zone, op)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    project = _project(provider_config)
+    zone = (provider_config or {})['zone']
+    if (provider_config or {}).get('tpu_vm'):
+        nodes = _list_cluster_tpu_nodes(project, zone, cluster_name_on_cloud)
+        names = sorted(n['name'].rsplit('/', 1)[-1] for n in nodes)
+        head = names[0] if names else None
+        ops = []
+        for node_id in names:
+            if worker_only and node_id == head:
+                continue
+            ops.append(gcp_api.delete_tpu_node(project, zone, node_id))
+        for op in ops:
+            gcp_api.wait_tpu_operation(op)
+        return
+    label_filter = f'labels.{_LABEL_CLUSTER}={cluster_name_on_cloud}'
+    insts = gcp_api.list_instances(project, zone, label_filter)
+    head = min((i['name'] for i in insts), default=None)
+    for inst in insts:
+        if worker_only and inst['name'] == head:
+            continue
+        op = gcp_api.delete_instance(project, zone, inst['name'])
+        gcp_api.wait_zone_operation(project, zone, op)
+
+
+_TPU_STATE_MAP = {
+    'CREATING': 'pending',
+    'STARTING': 'pending',
+    'READY': 'running',
+    'RESTARTING': 'pending',
+    'STOPPING': 'stopping',
+    'STOPPED': 'stopped',
+    'DELETING': 'terminated',
+    'TERMINATED': 'terminated',
+    'PREEMPTED': 'terminated',
+    'REPAIRING': 'pending',
+}
+_GCE_STATE_MAP = {
+    'PROVISIONING': 'pending',
+    'STAGING': 'pending',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'SUSPENDED': 'stopped',
+    'TERMINATED': 'stopped',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    project = _project(provider_config)
+    zone = (provider_config or {})['zone']
+    out: Dict[str, Optional[str]] = {}
+    if (provider_config or {}).get('tpu_vm'):
+        for node in _list_cluster_tpu_nodes(project, zone,
+                                            cluster_name_on_cloud):
+            status = _TPU_STATE_MAP.get(node['state'])
+            if non_terminated_only and status == 'terminated':
+                continue
+            out[node['name'].rsplit('/', 1)[-1]] = status
+        return out
+    label_filter = f'labels.{_LABEL_CLUSTER}={cluster_name_on_cloud}'
+    for inst in gcp_api.list_instances(project, zone, label_filter):
+        status = _GCE_STATE_MAP.get(inst['status'])
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[inst['name']] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout_s: float = 1200) -> None:
+    del region
+    deadline = time.time() + timeout_s
+    target = state or 'running'
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud, provider_config)
+        if statuses and all(s == target for s in statuses.values()):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'Instances of {cluster_name_on_cloud} did not reach {target} within '
+        f'{timeout_s}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del region
+    project = _project(provider_config)
+    zone = (provider_config or {})['zone']
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    head_id: Optional[str] = None
+    if (provider_config or {}).get('tpu_vm'):
+        nodes = _list_cluster_tpu_nodes(project, zone, cluster_name_on_cloud)
+        for node in sorted(nodes, key=lambda n: n['name']):
+            if node['state'] != 'READY':
+                continue
+            node_id = node['name'].rsplit('/', 1)[-1]
+            endpoints = node.get('networkEndpoints', [])
+            internal = [ep.get('ipAddress') for ep in endpoints]
+            external = [
+                ep.get('accessConfig', {}).get('externalIp')
+                for ep in endpoints
+            ]
+            if not internal:
+                continue
+            instances[node_id] = [
+                common.InstanceInfo(
+                    instance_id=node_id,
+                    internal_ip=internal[0],
+                    external_ip=external[0] if external else None,
+                    tags=node.get('labels', {}),
+                    host_ips=internal,
+                    host_external_ips=external,
+                )
+            ]
+            if head_id is None:
+                head_id = node_id
+    else:
+        label_filter = f'labels.{_LABEL_CLUSTER}={cluster_name_on_cloud}'
+        for inst in sorted(gcp_api.list_instances(project, zone,
+                                                  label_filter),
+                           key=lambda i: i['name']):
+            if inst['status'] != 'RUNNING':
+                continue
+            nic = inst.get('networkInterfaces', [{}])[0]
+            access = nic.get('accessConfigs', [{}])
+            instances[inst['name']] = [
+                common.InstanceInfo(
+                    instance_id=inst['name'],
+                    internal_ip=nic.get('networkIP'),
+                    external_ip=access[0].get('natIP') if access else None,
+                    tags=inst.get('labels', {}),
+                )
+            ]
+            if head_id is None:
+                head_id = inst['name']
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head_id,
+        provider_name=_PROVIDER,
+        provider_config=provider_config,
+        ssh_user=(provider_config or {}).get('ssh_user', 'skytpu'),
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    project = _project(provider_config)
+    rule_name = f'{cluster_name_on_cloud}-ports'
+    allowed = [{
+        'IPProtocol': 'tcp',
+        'ports': [p.replace('-', '-') for p in ports],
+    }]
+    body = {
+        'name': rule_name,
+        'network': 'global/networks/default',
+        'direction': 'INGRESS',
+        'sourceRanges': ['0.0.0.0/0'],
+        'allowed': allowed,
+        'targetTags': [cluster_name_on_cloud],
+    }
+    try:
+        gcp_api.insert_firewall_rule(project, body)
+    except gcp_api.GcpApiError as e:
+        if e.status_code != 409:  # already exists
+            raise _classify_api_error(e) from e
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del ports
+    project = _project(provider_config)
+    try:
+        gcp_api.delete_firewall_rule(project,
+                                     f'{cluster_name_on_cloud}-ports')
+    except gcp_api.GcpApiError as e:
+        if e.status_code != 404:
+            raise _classify_api_error(e) from e

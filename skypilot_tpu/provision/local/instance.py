@@ -1,0 +1,193 @@
+"""Local provisioner: clusters as directories + processes on this machine.
+
+A "cluster" is <state_dir>/local_clusters/<cluster_name>/ with one sub-root
+per simulated host (node<N>/host<K>/).  Host addresses are 'local:<dir>'
+URIs; the CommandRunner layer resolves them to process execution with the
+host dir as HOME-like root, so the entire backend/agent/gang-exec stack
+runs unchanged against local clusters.  This is the hermetic end-to-end
+substrate the reference lacks (its cheapest real substrate is Kubernetes,
+SURVEY.md §4) and doubles as `sky local`-style laptop/TPU-VM usage.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import paths
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'local'
+
+
+def _cluster_dir(cluster_name_on_cloud: str) -> str:
+    return os.path.join(paths.local_clusters_dir(), cluster_name_on_cloud)
+
+
+def _meta_path(cluster_name_on_cloud: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name_on_cloud), 'cluster.json')
+
+
+def _load_meta(cluster_name_on_cloud: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_meta_path(cluster_name_on_cloud), encoding='utf-8') as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _save_meta(cluster_name_on_cloud: str, meta: Dict[str, Any]) -> None:
+    os.makedirs(_cluster_dir(cluster_name_on_cloud), exist_ok=True)
+    with open(_meta_path(cluster_name_on_cloud), 'w', encoding='utf-8') as f:
+        json.dump(meta, f, indent=2)
+
+
+def host_address(cluster_name_on_cloud: str, node: int, host: int) -> str:
+    return 'local:' + os.path.join(_cluster_dir(cluster_name_on_cloud),
+                                   f'node{node}', f'host{host}')
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    num_hosts = int(node_cfg.get('num_tpu_hosts', 1) or 1)
+    meta = _load_meta(cluster_name_on_cloud)
+    created: List[str] = []
+    resumed: List[str] = []
+    if meta is None:
+        meta = {
+            'cluster': cluster_name_on_cloud,
+            'num_nodes': config.count,
+            'num_hosts_per_node': num_hosts,
+            'status': 'running',
+            'created_at': time.time(),
+            'tags': dict(config.tags),
+        }
+        for node in range(config.count):
+            for host in range(num_hosts):
+                host_dir = host_address(cluster_name_on_cloud, node,
+                                        host)[len('local:'):]
+                os.makedirs(os.path.join(host_dir, '.skytpu_agent'),
+                            exist_ok=True)
+            created.append(f'{cluster_name_on_cloud}-node{node}')
+    else:
+        if meta['status'] == 'stopped':
+            if not config.resume_stopped_nodes:
+                from skypilot_tpu import exceptions
+                raise exceptions.ProvisionError(
+                    f'Local cluster {cluster_name_on_cloud} is stopped; '
+                    'resume not requested.')
+            meta['status'] = 'running'
+            resumed = [f'{cluster_name_on_cloud}-node{n}'
+                       for n in range(meta['num_nodes'])]
+        meta['num_nodes'] = max(meta['num_nodes'], config.count)
+    _save_meta(cluster_name_on_cloud, meta)
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER,
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone='local',
+        head_instance_id=f'{cluster_name_on_cloud}-node0',
+        resumed_instance_ids=resumed,
+        created_instance_ids=created,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    del worker_only
+    meta = _load_meta(cluster_name_on_cloud)
+    if meta is not None:
+        meta['status'] = 'stopped'
+        _save_meta(cluster_name_on_cloud, meta)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    del worker_only
+    # Kill any agent/job processes rooted in this cluster dir first.
+    cluster_dir = _cluster_dir(cluster_name_on_cloud)
+    _kill_cluster_processes(cluster_dir)
+    shutil.rmtree(cluster_dir, ignore_errors=True)
+
+
+def _kill_cluster_processes(cluster_dir: str) -> None:
+    try:
+        import psutil
+    except ImportError:
+        return
+    for proc in psutil.process_iter(['pid', 'environ']):
+        try:
+            env = proc.info['environ'] or {}
+            if env.get('SKYTPU_LOCAL_HOST_ROOT', '').startswith(cluster_dir):
+                proc.kill()
+        except (psutil.NoSuchProcess, psutil.AccessDenied):
+            continue
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    meta = _load_meta(cluster_name_on_cloud)
+    if meta is None:
+        return {}
+    status = meta['status']
+    if non_terminated_only and status == 'terminated':
+        return {}
+    return {f'{cluster_name_on_cloud}-node{n}': status
+            for n in range(meta['num_nodes'])}
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    del region, cluster_name_on_cloud, state
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del region
+    meta = _load_meta(cluster_name_on_cloud)
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    head_id = None
+    if meta is not None and meta['status'] == 'running':
+        num_hosts = meta.get('num_hosts_per_node', 1)
+        for node in range(meta['num_nodes']):
+            iid = f'{cluster_name_on_cloud}-node{node}'
+            host_ips = [host_address(cluster_name_on_cloud, node, h)
+                        for h in range(num_hosts)]
+            instances[iid] = [
+                common.InstanceInfo(
+                    instance_id=iid,
+                    internal_ip=host_ips[0],
+                    external_ip=None,
+                    tags=meta.get('tags', {}),
+                    host_ips=host_ips,
+                )
+            ]
+        head_id = f'{cluster_name_on_cloud}-node0'
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head_id,
+        provider_name=_PROVIDER,
+        provider_config=provider_config,
+        ssh_user=None,
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

@@ -1,0 +1,272 @@
+"""Provision orchestration + cross-zone/region/cloud failover engine.
+
+Two layers, mirroring the reference's split:
+
+1. `bulk_provision` (reference sky/provision/provisioner.py:100): drive one
+   provisioning attempt against one cloud/zone-group via the
+   function-per-operation API, with teardown-or-stop cleanup on failure
+   (StopFailoverError semantics, provisioner.py:172-195).
+
+2. `RetryingProvisioner` (reference RetryingVmProvisioner,
+   cloud_vm_ray_backend.py:1155): the failover loop — iterate zones within
+   the chosen region (`_yield_zones` :1201), on exhaustion *block* the
+   failed Resources and re-run the optimizer with the blocklist
+   (:2093-2150), walking cheapest→next-cheapest across regions and clouds
+   until something provisions or everything is blocked.
+
+TPU specifics: slices are admitted/released atomically (the slice IS the
+gang), and a partially-provisioned *multi-node* TPU cluster is always
+terminated (not stopped) on failure since preempted/failed TPU VMs cannot
+resume (resources.py:633).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.provision import api as provision_api
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class ProvisionResult:
+    """Everything the backend needs to build a cluster handle."""
+    provider_name: str
+    resources: resources_lib.Resources     # fully concrete (zone filled)
+    record: common.ProvisionRecord
+    cluster_info: common.ClusterInfo
+    provider_config: Dict[str, Any]
+    num_nodes: int
+
+
+def _provider_config(resources: resources_lib.Resources,
+                     deploy_vars: Dict[str, Any]) -> Dict[str, Any]:
+    """Config persisted into the handle; query/terminate use it later."""
+    from skypilot_tpu import config as config_lib
+    cfg = {
+        'region': deploy_vars.get('region'),
+        'zone': deploy_vars.get('zone'),
+        'tpu_vm': deploy_vars.get('tpu_vm', False),
+    }
+    if resources.cloud.canonical_name() == 'gcp':
+        cfg['project_id'] = config_lib.get_nested(('gcp', 'project_id'),
+                                                  None)
+    return cfg
+
+
+@timeline.event
+def bulk_provision(
+    cloud: cloud_lib.Cloud,
+    region: cloud_lib.Region,
+    zones: Optional[List[cloud_lib.Zone]],
+    cluster_name_on_cloud: str,
+    num_nodes: int,
+    resources: resources_lib.Resources,
+    authentication_config: Optional[Dict[str, Any]] = None,
+    tags: Optional[Dict[str, str]] = None,
+    resume_stopped_nodes: bool = False,
+) -> ProvisionResult:
+    """One provisioning attempt. Raises ProvisionError on failure after
+    cleaning up partial state."""
+    provider = cloud.PROVISIONER_MODULE
+    deploy_vars = resources.make_deploy_variables(cluster_name_on_cloud,
+                                                  region, zones, num_nodes)
+    provider_config = _provider_config(resources, deploy_vars)
+    config = common.ProvisionConfig(
+        provider_config=provider_config,
+        authentication_config=authentication_config or {},
+        docker_config={},
+        node_config=deploy_vars,
+        count=num_nodes,
+        tags=tags or {},
+        resume_stopped_nodes=resume_stopped_nodes,
+        ports_to_open_on_launch=resources.ports,
+    )
+    try:
+        record = provision_api.run_instances(provider, region.name,
+                                             cluster_name_on_cloud, config)
+        provision_api.wait_instances(provider, region.name,
+                                     cluster_name_on_cloud, 'running')
+        cluster_info = provision_api.get_cluster_info(
+            provider, region.name, cluster_name_on_cloud, provider_config)
+        if cluster_info.num_instances() < num_nodes:
+            raise exceptions.ProvisionError(
+                f'Only {cluster_info.num_instances()}/{num_nodes} nodes '
+                f'running for {cluster_name_on_cloud}.')
+        if resources.ports:
+            provision_api.open_ports(provider, cluster_name_on_cloud,
+                                     resources.ports, provider_config)
+    except Exception as e:  # noqa: BLE001 — cleanup then re-raise
+        _cleanup_after_failure(provider, cloud, cluster_name_on_cloud,
+                               provider_config, resources, e)
+        raise
+    return ProvisionResult(
+        provider_name=provider,
+        resources=resources.copy(zone=record.zone),
+        record=record,
+        cluster_info=cluster_info,
+        provider_config=provider_config,
+        num_nodes=num_nodes,
+    )
+
+
+def _cleanup_after_failure(provider: str, cloud: cloud_lib.Cloud,
+                           cluster_name_on_cloud: str,
+                           provider_config: Dict[str, Any],
+                           resources: resources_lib.Resources,
+                           original_error: Exception) -> None:
+    """Terminate (or stop, when supported and cheap) partially-created
+    instances so the next failover attempt starts clean (reference
+    provisioner.py teardown_cluster on _bulk_provision failure)."""
+    logger.debug(f'Provision attempt failed ({original_error}); cleaning up '
+                 f'{cluster_name_on_cloud}.')
+    try:
+        # TPU slices and multi-node partial clusters: terminate.
+        provision_api.terminate_instances(provider, cluster_name_on_cloud,
+                                          provider_config)
+    except Exception as cleanup_err:  # noqa: BLE001
+        raise exceptions.StopFailoverError(
+            f'Cleanup after failed provision of {cluster_name_on_cloud} '
+            f'ALSO failed — cloud resources may be leaked. '
+            f'Original error: {original_error!r}; cleanup error: '
+            f'{cleanup_err!r}') from cleanup_err
+
+
+class RetryingProvisioner:
+    """Zone→region→cloud failover around bulk_provision."""
+
+    def __init__(self,
+                 cluster_name: str,
+                 cluster_name_on_cloud: str,
+                 authentication_config: Optional[Dict[str, Any]] = None,
+                 max_zone_retries_per_region: Optional[int] = None) -> None:
+        self._cluster_name = cluster_name
+        self._cluster_name_on_cloud = cluster_name_on_cloud
+        self._auth = authentication_config or {}
+        self._max_zone_retries = max_zone_retries_per_region
+
+    def _yield_zones(self, resources: resources_lib.Resources,
+                     num_nodes: int):
+        """Zones to attempt for a concrete (cloud, region) choice
+        (reference _yield_zones, cloud_vm_ray_backend.py:1201)."""
+        cloud = resources.cloud
+        assert cloud is not None and resources.region is not None
+        if resources.zone is not None:
+            yield [cloud_lib.Zone(resources.zone, resources.region)]
+            return
+        count = 0
+        for zones in cloud.zones_provision_loop(
+                region=resources.region,
+                num_nodes=num_nodes,
+                instance_type=resources.instance_type or '',
+                accelerators=resources.accelerators,
+                use_spot=resources.use_spot):
+            yield zones
+            count += 1
+            if (self._max_zone_retries is not None and
+                    count >= self._max_zone_retries):
+                return
+
+    def _retry_zones(self, resources: resources_lib.Resources,
+                     num_nodes: int,
+                     failover_history: List[Exception]
+                     ) -> Optional[ProvisionResult]:
+        """Try every zone group in the resource's region; None = exhausted
+        (reference _retry_zones, cloud_vm_ray_backend.py:1328)."""
+        cloud = resources.cloud
+        region = cloud_lib.Region(resources.region)
+        for zones in self._yield_zones(resources, num_nodes):
+            zone_str = ','.join(z.name for z in zones) if zones else '-'
+            logger.info(
+                f'Launching {self._cluster_name!r} on {cloud} '
+                f'{resources.region} ({zone_str})'
+                + (f' [TPU {resources.tpu_slice.accelerator_name}, '
+                   f'{resources.tpu_slice.num_hosts} hosts/slice]'
+                   if resources.tpu_slice else ''))
+            try:
+                return bulk_provision(
+                    cloud, region, zones, self._cluster_name_on_cloud,
+                    num_nodes,
+                    resources.copy(zone=zones[0].name if zones else None),
+                    authentication_config=self._auth,
+                    tags={'skytpu-user': common_utils.get_user_hash(),
+                          'skytpu-cluster-name': self._cluster_name},
+                )
+            except exceptions.StopFailoverError:
+                raise
+            except exceptions.ProvisionError as e:
+                failover_history.append(e)
+                if e.no_failover:
+                    raise exceptions.ResourcesUnavailableError(
+                        str(e), failover_history=failover_history) from e
+                logger.info(f'  attempt failed: {e}')
+                continue
+        return None
+
+    def provision_with_retries(
+        self,
+        task: 'task_lib.Task',
+        to_provision: resources_lib.Resources,
+        num_nodes: int,
+        minimize: optimizer_lib.OptimizeTarget =
+            optimizer_lib.OptimizeTarget.COST,
+    ) -> ProvisionResult:
+        """The outer failover loop (reference provision_with_retries,
+        cloud_vm_ray_backend.py:1979 + re-optimize at :2093-2150)."""
+        blocked: Set[resources_lib.Resources] = set()
+        failover_history: List[Exception] = []
+        resources = to_provision
+        while True:
+            result = self._retry_zones(resources, num_nodes,
+                                       failover_history)
+            if result is not None:
+                return result
+            # Region exhausted: block it and re-optimize.
+            blocked.add(
+                resources_lib.Resources(cloud=resources.cloud,
+                                        region=resources.region,
+                                        zone=resources.zone))
+            logger.info(
+                f'Exhausted zones in {resources.cloud} {resources.region}; '
+                'failing over.')
+            with dag_lib.Dag() as retry_dag:
+                retry_dag.add(task)
+            try:
+                optimizer_lib.optimize(retry_dag, minimize=minimize,
+                                       blocked_resources=blocked,
+                                       quiet=True)
+            except exceptions.ResourcesUnavailableError as e:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Failed to provision all possible launchable '
+                    f'resources for {self._cluster_name!r}. '
+                    f'{exceptions.format_failover_history(failover_history)}',
+                    failover_history=failover_history) from e
+            assert task.best_resources is not None
+            resources = task.best_resources
+
+
+def teardown_cluster(provider_name: str, cluster_name_on_cloud: str,
+                     provider_config: Dict[str, Any],
+                     terminate: bool) -> None:
+    if terminate:
+        provision_api.terminate_instances(provider_name,
+                                          cluster_name_on_cloud,
+                                          provider_config)
+        if provider_config.get('ports_cleanup_needed'):
+            provision_api.cleanup_ports(provider_name, cluster_name_on_cloud,
+                                        [], provider_config)
+    else:
+        provision_api.stop_instances(provider_name, cluster_name_on_cloud,
+                                     provider_config)

@@ -1,0 +1,129 @@
+"""Service spec for the serve subsystem.
+
+Counterpart of the reference's sky/serve/service_spec.py:18 SkyServiceSpec:
+readiness probe (path / POST payload / headers / initial delay), replica
+policy (min/max, target QPS per replica, scale delays, spot + on-demand
+fallback mix), load-balancing policy.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import schemas
+
+DEFAULT_INITIAL_DELAY_SECONDS = 1200
+DEFAULT_READINESS_TIMEOUT_SECONDS = 15
+DEFAULT_UPSCALE_DELAY_SECONDS = 300
+DEFAULT_DOWNSCALE_DELAY_SECONDS = 1200
+
+
+class SkyServiceSpec:
+
+    def __init__(
+        self,
+        readiness_path: str,
+        initial_delay_seconds: float = DEFAULT_INITIAL_DELAY_SECONDS,
+        readiness_timeout_seconds: float = DEFAULT_READINESS_TIMEOUT_SECONDS,
+        min_replicas: int = 1,
+        max_replicas: Optional[int] = None,
+        target_qps_per_replica: Optional[float] = None,
+        post_data: Optional[Any] = None,
+        readiness_headers: Optional[Dict[str, str]] = None,
+        upscale_delay_seconds: float = DEFAULT_UPSCALE_DELAY_SECONDS,
+        downscale_delay_seconds: float = DEFAULT_DOWNSCALE_DELAY_SECONDS,
+        base_ondemand_fallback_replicas: int = 0,
+        dynamic_ondemand_fallback: bool = False,
+        load_balancing_policy: Optional[str] = None,
+    ) -> None:
+        if not readiness_path.startswith('/'):
+            raise exceptions.TaskValidationError(
+                f'Readiness path must start with /: {readiness_path!r}')
+        if max_replicas is not None and max_replicas < min_replicas:
+            raise exceptions.TaskValidationError(
+                'max_replicas must be >= min_replicas.')
+        if target_qps_per_replica is not None and \
+                target_qps_per_replica <= 0:
+            raise exceptions.TaskValidationError(
+                'target_qps_per_replica must be positive.')
+        self.readiness_path = readiness_path
+        self.initial_delay_seconds = initial_delay_seconds
+        self.readiness_timeout_seconds = readiness_timeout_seconds
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.target_qps_per_replica = target_qps_per_replica
+        self.post_data = post_data
+        self.readiness_headers = readiness_headers or {}
+        self.upscale_delay_seconds = upscale_delay_seconds
+        self.downscale_delay_seconds = downscale_delay_seconds
+        self.base_ondemand_fallback_replicas = base_ondemand_fallback_replicas
+        self.dynamic_ondemand_fallback = dynamic_ondemand_fallback
+        self.load_balancing_policy = load_balancing_policy or 'round_robin'
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return self.target_qps_per_replica is not None
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
+        schemas.validate(config, schemas.get_service_schema(),
+                         exceptions.TaskValidationError,
+                         'Invalid service: ')
+        probe = config['readiness_probe']
+        if isinstance(probe, str):
+            probe = {'path': probe}
+        policy = dict(config.get('replica_policy') or {})
+        if 'replicas' in config:  # fixed-replica shorthand
+            policy.setdefault('min_replicas', config['replicas'])
+            policy.setdefault('max_replicas', config['replicas'])
+        return cls(
+            readiness_path=probe['path'],
+            initial_delay_seconds=probe.get(
+                'initial_delay_seconds', DEFAULT_INITIAL_DELAY_SECONDS),
+            readiness_timeout_seconds=probe.get(
+                'timeout_seconds', DEFAULT_READINESS_TIMEOUT_SECONDS),
+            post_data=probe.get('post_data'),
+            readiness_headers=probe.get('headers'),
+            min_replicas=policy.get('min_replicas', 1),
+            max_replicas=policy.get('max_replicas'),
+            target_qps_per_replica=policy.get('target_qps_per_replica'),
+            upscale_delay_seconds=policy.get(
+                'upscale_delay_seconds', DEFAULT_UPSCALE_DELAY_SECONDS),
+            downscale_delay_seconds=policy.get(
+                'downscale_delay_seconds', DEFAULT_DOWNSCALE_DELAY_SECONDS),
+            base_ondemand_fallback_replicas=policy.get(
+                'base_ondemand_fallback_replicas', 0),
+            dynamic_ondemand_fallback=policy.get(
+                'dynamic_ondemand_fallback', False),
+            load_balancing_policy=config.get('load_balancing_policy'),
+        )
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        probe: Dict[str, Any] = {'path': self.readiness_path}
+        if self.initial_delay_seconds != DEFAULT_INITIAL_DELAY_SECONDS:
+            probe['initial_delay_seconds'] = self.initial_delay_seconds
+        if self.post_data is not None:
+            probe['post_data'] = self.post_data
+        if self.readiness_headers:
+            probe['headers'] = self.readiness_headers
+        policy: Dict[str, Any] = {'min_replicas': self.min_replicas}
+        if self.max_replicas is not None:
+            policy['max_replicas'] = self.max_replicas
+        if self.target_qps_per_replica is not None:
+            policy['target_qps_per_replica'] = self.target_qps_per_replica
+        if self.base_ondemand_fallback_replicas:
+            policy['base_ondemand_fallback_replicas'] = \
+                self.base_ondemand_fallback_replicas
+        if self.dynamic_ondemand_fallback:
+            policy['dynamic_ondemand_fallback'] = True
+        return {
+            'readiness_probe': probe,
+            'replica_policy': policy,
+            'load_balancing_policy': self.load_balancing_policy,
+        }
+
+    def __repr__(self) -> str:
+        return (f'SkyServiceSpec(path={self.readiness_path}, '
+                f'replicas=[{self.min_replicas}, {self.max_replicas}], '
+                f'qps/replica={self.target_qps_per_replica})')

@@ -37,3 +37,6 @@ def _isolated_state(tmp_path, monkeypatch):
     from skypilot_tpu.clouds import fake as fake_cloud
     fake_cloud.fake_cloud_state().reset()
     yield
+    # Reap agent daemons / job processes rooted in this test's tmp dir.
+    from skypilot_tpu.provision.local import instance as local_instance
+    local_instance._kill_cluster_processes(str(tmp_path))  # pylint: disable=protected-access

@@ -1,0 +1,215 @@
+"""End-to-end lifecycle tests on process-based local clusters.
+
+The hermetic analog of the reference's smoke tests
+(tests/smoke_tests/test_cluster_job.py etc., which need real clouds):
+launch → gang exec → logs → queue → cancel → exec fast path → down, all
+real processes, no cloud.
+"""
+import io
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+
+
+def _wait_job(cluster, job_id, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = sky.job_status(cluster, [job_id])[job_id]
+        if status in ('SUCCEEDED', 'FAILED', 'FAILED_DRIVER', 'CANCELLED'):
+            return status
+        time.sleep(0.25)
+    raise TimeoutError(f'job {job_id} still {status}')
+
+
+def _local_task(run, num_nodes=1, accelerators=None, **kwargs):
+    t = sky.Task(run=run, num_nodes=num_nodes, **kwargs)
+    t.set_resources(sky.Resources(cloud='local',
+                                  accelerators=accelerators))
+    return t
+
+
+def _read_run_log(cluster, job_id):
+    record = global_user_state.get_cluster_from_name(cluster)
+    root = record['handle'].head_agent_root
+    path = os.path.join(root, '.skytpu_agent', 'job_logs', f'job_{job_id}',
+                        'run.log')
+    with open(path, encoding='utf-8') as f:
+        return f.read()
+
+
+class TestEndToEnd:
+
+    def test_launch_and_logs(self):
+        t = _local_task('echo "rank $SKYTPU_NODE_RANK of $SKYTPU_NUM_NODES"')
+        job_id, handle = sky.launch(t, cluster_name='t1',
+                                    quiet_optimizer=True, detach_run=True)
+        assert _wait_job('t1', job_id) == 'SUCCEEDED'
+        log = _read_run_log('t1', job_id)
+        assert 'rank 0 of 1' in log
+        records = sky.status(['t1'])
+        assert records[0]['status'] == sky.ClusterStatus.UP
+        sky.down('t1')
+        assert sky.status(['t1']) == []
+
+    def test_slice_gang_ranks(self):
+        """A tpu-v5e-16 'slice' = 4 hosts; one process per host with the
+        full rank/coordinator env contract."""
+        t = _local_task(
+            'echo "r=$SKYTPU_NODE_RANK n=$SKYTPU_NUM_NODES '
+            'pid=$SKYTPU_PROCESS_ID np=$SKYTPU_NUM_PROCESSES '
+            'coord=$SKYTPU_COORDINATOR_ADDR acc=$SKYTPU_ACCELERATOR"',
+            accelerators='tpu-v5e-16')
+        job_id, _ = sky.launch(t, cluster_name='t2', quiet_optimizer=True,
+                               detach_run=True)
+        assert _wait_job('t2', job_id) == 'SUCCEEDED'
+        log = _read_run_log('t2', job_id)
+        for rank in range(4):
+            assert f'r={rank} n=4 pid={rank} np=4' in log
+        assert 'acc=tpu-v5e-16' in log
+        assert ':8476' in log
+        sky.down('t2')
+
+    def test_gang_failure_cancels_peers(self):
+        """Reference get_or_fail semantics (cloud_vm_ray_backend.py:313):
+        one rank failing kills the others."""
+        t = _local_task(
+            'if [ "$SKYTPU_NODE_RANK" = "1" ]; then exit 7; fi; sleep 60',
+            num_nodes=3)
+        job_id, _ = sky.launch(t, cluster_name='t3', quiet_optimizer=True,
+                               detach_run=True)
+        start = time.time()
+        assert _wait_job('t3', job_id, timeout=30) == 'FAILED'
+        assert time.time() - start < 25, 'peers not cancelled promptly'
+        log = _read_run_log('t3', job_id)
+        assert 'rank 1 failed' in log
+        sky.down('t3')
+
+    def test_exec_fast_path_and_queue(self):
+        t = _local_task('echo first')
+        job1, _ = sky.launch(t, cluster_name='t4', quiet_optimizer=True,
+                             detach_run=True)
+        assert _wait_job('t4', job1) == 'SUCCEEDED'
+        t2 = _local_task('echo second')
+        job2, _ = sky.exec(t2, 't4', detach_run=True)
+        assert job2 == job1 + 1
+        assert _wait_job('t4', job2) == 'SUCCEEDED'
+        queue = sky.queue('t4')
+        assert [j['job_id'] for j in queue] == [job2, job1]
+        assert all(j['status'] == 'SUCCEEDED' for j in queue)
+        sky.down('t4')
+
+    def test_exec_on_missing_cluster(self):
+        with pytest.raises(exceptions.ClusterDoesNotExist):
+            sky.exec(_local_task('echo x'), 'nonexistent-cluster')
+
+    def test_cancel_running_job(self):
+        t = _local_task('sleep 120')
+        job_id, _ = sky.launch(t, cluster_name='t5', quiet_optimizer=True,
+                               detach_run=True)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if sky.job_status('t5', [job_id])[job_id] == 'RUNNING':
+                break
+            time.sleep(0.25)
+        cancelled = sky.cancel('t5', [job_id])
+        assert cancelled == [job_id]
+        assert _wait_job('t5', job_id) == 'CANCELLED'
+        # The rank process (sleep 120, own session) must actually be dead —
+        # the driver's SIGTERM handler reaps it (not just the driver).
+        record = global_user_state.get_cluster_from_name('t5')
+        root = record['handle'].head_agent_root
+        import psutil
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            leftovers = []
+            for proc in psutil.process_iter(['pid', 'environ']):
+                try:
+                    env = proc.info['environ'] or {}
+                    if env.get('SKYTPU_JOB_ID') == str(job_id) and \
+                            env.get('SKYTPU_LOCAL_HOST_ROOT', '').startswith(
+                                os.path.dirname(os.path.dirname(root))):
+                        leftovers.append(proc.pid)
+                except (psutil.NoSuchProcess, psutil.AccessDenied):
+                    continue
+            if not leftovers:
+                break
+            time.sleep(0.5)
+        assert not leftovers, f'rank processes leaked: {leftovers}'
+        sky.down('t5')
+
+    def test_workdir_and_file_mounts(self, tmp_path):
+        workdir = tmp_path / 'proj'
+        workdir.mkdir()
+        (workdir / 'data.txt').write_text('payload42')
+        extra = tmp_path / 'extra.txt'
+        extra.write_text('mounted')
+        t = _local_task('cat data.txt && cat ../extra_mount/extra.txt',
+                        workdir=str(workdir))
+        t.set_file_mounts({'extra_mount/extra.txt': str(extra)})
+        job_id, _ = sky.launch(t, cluster_name='t6', quiet_optimizer=True,
+                               detach_run=True)
+        assert _wait_job('t6', job_id) == 'SUCCEEDED'
+        log = _read_run_log('t6', job_id)
+        assert 'payload42' in log
+        assert 'mounted' in log
+        sky.down('t6')
+
+    def test_setup_runs_before_job(self):
+        t = _local_task('cat marker.txt')
+        t.setup = 'echo from-setup > marker.txt'
+        job_id, _ = sky.launch(t, cluster_name='t7', quiet_optimizer=True,
+                               detach_run=True)
+        assert _wait_job('t7', job_id) == 'SUCCEEDED'
+        assert 'from-setup' in _read_run_log('t7', job_id)
+        sky.down('t7')
+
+    def test_setup_failure_raises(self):
+        t = _local_task('echo never')
+        t.setup = 'exit 3'
+        with pytest.raises(exceptions.CommandError):
+            sky.launch(t, cluster_name='t8', quiet_optimizer=True,
+                       detach_run=True)
+        sky.down('t8')
+
+    def test_callable_run(self):
+        def run_fn(rank, ips):
+            return f'echo "generated for rank {rank}/{len(ips)}"'
+
+        t = _local_task(run_fn, num_nodes=2)
+        job_id, _ = sky.launch(t, cluster_name='t9', quiet_optimizer=True,
+                               detach_run=True)
+        assert _wait_job('t9', job_id) == 'SUCCEEDED'
+        log = _read_run_log('t9', job_id)
+        assert 'generated for rank 0/2' in log
+        assert 'generated for rank 1/2' in log
+        sky.down('t9')
+
+    def test_cost_report_after_down(self):
+        t = _local_task('echo x')
+        job_id, _ = sky.launch(t, cluster_name='t10', quiet_optimizer=True,
+                               detach_run=True)
+        _wait_job('t10', job_id)
+        sky.down('t10')
+        report = sky.cost_report()
+        mine = [r for r in report if r['name'] == 't10']
+        assert len(mine) == 1
+        assert not mine[0]['still_exists']
+        assert mine[0]['duration_seconds'] >= 0
+
+    def test_resources_mismatch_on_reuse(self):
+        t = _local_task('echo x')
+        job_id, _ = sky.launch(t, cluster_name='t11', quiet_optimizer=True,
+                               detach_run=True)
+        _wait_job('t11', job_id)
+        bigger = sky.Task(run='echo y', num_nodes=1)
+        bigger.set_resources(
+            sky.Resources(cloud='local', accelerators='tpu-v5e-8'))
+        with pytest.raises(exceptions.ResourcesMismatchError):
+            sky.launch(bigger, cluster_name='t11', quiet_optimizer=True,
+                       detach_run=True)
+        sky.down('t11')

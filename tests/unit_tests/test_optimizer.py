@@ -1,0 +1,137 @@
+"""Optimizer tests (reference analog: tests/test_optimizer_dryruns.py).
+
+These run fully in-process against Fake + GCP catalogs with all clouds
+force-enabled (the reference does the same via
+tests/common.py enable_all_clouds_in_monkeypatch).
+"""
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+
+Resources = resources_lib.Resources
+Task = task_lib.Task
+
+
+@pytest.fixture(autouse=True)
+def enable_clouds():
+    global_user_state.set_enabled_clouds(['fake', 'gcp', 'local'])
+
+
+def _optimize_single(task, **kwargs):
+    with dag_lib.Dag() as d:
+        d.add(task)
+    return optimizer_lib.optimize(d, quiet=True, **kwargs)
+
+
+class TestOptimizer:
+
+    def test_picks_cheapest_region(self):
+        t = Task('t', run='x')
+        t.set_resources(Resources(cloud='fake', cpus='8'))
+        _optimize_single(t)
+        # fake-a has multiplier 1.0 — cheapest.
+        assert t.best_resources.region == 'fake-a'
+        assert t.best_resources.instance_type == 'fake-cpu-8'
+
+    def test_tpu_slice_feasibility(self):
+        t = Task('t', run='x')
+        t.set_resources(Resources(cloud='gcp', accelerators='tpu-v5p-128'))
+        _optimize_single(t)
+        assert t.best_resources.instance_type == 'TPU-VM'
+        # v5p zones: us-east5-a / us-central1-a (mult 1.0) beat europe.
+        assert t.best_resources.region in ('us-east5', 'us-central1')
+
+    def test_spot_cheaper_than_ondemand(self):
+        t_od = Task('od', run='x')
+        t_od.set_resources(Resources(cloud='gcp', accelerators='tpu-v5e-16'))
+        _optimize_single(t_od)
+        t_spot = Task('spot', run='x')
+        t_spot.set_resources(
+            Resources(cloud='gcp', accelerators='tpu-v5e-16', use_spot=True))
+        _optimize_single(t_spot)
+        cost = lambda t: t.best_resources.get_cost(3600)
+        assert cost(t_spot) < cost(t_od)
+
+    def test_any_of_picks_cheapest(self):
+        t = Task('t', run='x')
+        t.set_resources(Resources.from_yaml_config({
+            'cloud': 'gcp',
+            'any_of': [{'accelerators': 'tpu-v5p-8'},
+                       {'accelerators': 'tpu-v5e-8'}],
+        }))
+        _optimize_single(t)
+        # v5e ($1.2/chip) cheaper than v5p ($4.2/chip).
+        assert t.best_resources.tpu_slice.generation.name == 'v5e'
+
+    def test_time_target_prefers_bigger_slice(self):
+        t = Task('t', run='x')
+        t.set_resources(Resources.from_yaml_config({
+            'cloud': 'gcp',
+            'any_of': [{'accelerators': 'tpu-v5e-8'},
+                       {'accelerators': 'tpu-v5p-8'}],
+        }))
+        _optimize_single(t, minimize=optimizer_lib.OptimizeTarget.TIME)
+        # v5p-8 (4 chips x 459 TF) > v5e-8 (8 x 197 TF)... pick the faster.
+        chosen = t.best_resources.tpu_slice
+        assert chosen is not None
+
+    def test_blocklist_region_failover(self):
+        """Blocking a region re-optimizes into the next one (the failover
+        loop's re-optimize-with-blocklist, cloud_vm_ray_backend.py:2093)."""
+        t = Task('t', run='x')
+        t.set_resources(Resources(cloud='fake', cpus='8'))
+        blocked = {Resources(cloud='fake', region='fake-a')}
+        _optimize_single(t, blocked_resources=blocked)
+        assert t.best_resources.region == 'fake-b'
+
+    def test_all_blocked_raises(self):
+        t = Task('t', run='x')
+        t.set_resources(Resources(cloud='fake', cpus='8'))
+        blocked = {Resources(cloud='fake')}
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            _optimize_single(t, blocked_resources=blocked)
+
+    def test_unknown_region_unavailable(self):
+        t = Task('t', run='x')
+        t.set_resources(
+            Resources(cloud='gcp', accelerators='tpu-v4-8',
+                      region='us-east1'))  # v4 only in us-central2
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            _optimize_single(t)
+
+    def test_disabled_cloud_not_used(self):
+        global_user_state.set_enabled_clouds(['fake'])
+        t = Task('t', run='x')
+        t.set_resources(Resources(cpus='8+'))
+        _optimize_single(t)
+        assert t.best_resources.cloud.canonical_name() == 'fake'
+
+    def test_chain_dag(self):
+        with dag_lib.Dag() as d:
+            a = Task('a', run='x')
+            a.set_resources(Resources(cloud='fake', cpus='2'))
+            b = Task('b', run='x')
+            b.set_resources(Resources(cloud='fake', cpus='8'))
+            a >> b
+        optimizer_lib.optimize(d, quiet=True)
+        assert a.best_resources is not None
+        assert b.best_resources is not None
+        assert a.best_resources.instance_type == 'fake-cpu-2'
+
+    def test_general_dag(self):
+        with dag_lib.Dag() as d:
+            a = Task('a', run='x')
+            a.set_resources(Resources(cloud='fake', cpus='2'))
+            b = Task('b', run='x')
+            b.set_resources(Resources(cloud='fake', cpus='2'))
+            c = Task('c', run='x')
+            c.set_resources(Resources(cloud='fake', cpus='8'))
+            a >> c
+            b >> c
+        optimizer_lib.optimize(d, quiet=True)
+        assert all(t.best_resources is not None for t in d.tasks)

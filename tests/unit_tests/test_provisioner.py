@@ -1,0 +1,133 @@
+"""Provisioner + failover engine tests against the fake cloud.
+
+This is the hermetic tier the reference lacks: real failover logic
+(zone → region → blocklist re-optimize) driven end-to-end in-process
+(reference equivalents only run as cloud smoke tests, SURVEY.md §4).
+"""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.clouds import fake as fake_cloud
+from skypilot_tpu.provision import api as provision_api
+from skypilot_tpu.provision import provisioner as provisioner_lib
+
+Resources = resources_lib.Resources
+
+
+@pytest.fixture(autouse=True)
+def enable_clouds():
+    global_user_state.set_enabled_clouds(['fake'])
+
+
+def _provision(resources, num_nodes=1, name='c'):
+    t = task_lib.Task('t', run='x', num_nodes=num_nodes)
+    t.set_resources(resources)
+    rp = provisioner_lib.RetryingProvisioner(name, name)
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import optimizer as optimizer_lib
+    with dag_lib.Dag() as d:
+        d.add(t)
+    optimizer_lib.optimize(d, quiet=True)
+    return rp.provision_with_retries(t, t.best_resources, num_nodes)
+
+
+class TestProvision:
+
+    def test_basic_provision(self):
+        result = _provision(Resources(cloud='fake', cpus='8'))
+        assert result.resources.region == 'fake-a'
+        assert result.cluster_info.num_instances() == 1
+        assert result.record.head_instance_id
+
+    def test_tpu_slice_hosts(self):
+        result = _provision(Resources(cloud='fake',
+                                      accelerators='tpu-v5e-16'))
+        info = result.cluster_info
+        assert info.num_instances() == 1      # one slice = one logical node
+        assert info.num_hosts() == 4          # but 4 SSH targets
+        assert len(info.ip_tuples()) == 4
+
+    def test_multinode(self):
+        result = _provision(Resources(cloud='fake', cpus='2'), num_nodes=3)
+        assert result.cluster_info.num_instances() == 3
+
+    def test_zone_failover_within_region(self):
+        state = fake_cloud.fake_cloud_state()
+        state.fail_next('fake-a-1',
+                        exceptions.ProvisionError('zone a-1 stockout'))
+        result = _provision(Resources(cloud='fake', cpus='8'))
+        assert result.resources.zone == 'fake-a-2'
+
+    def test_region_failover_via_blocklist(self):
+        state = fake_cloud.fake_cloud_state()
+        state.fail_always('fake-a-1', exceptions.ProvisionError('no cap'))
+        state.fail_always('fake-a-2', exceptions.ProvisionError('no cap'))
+        result = _provision(Resources(cloud='fake', cpus='8'))
+        assert result.resources.region == 'fake-b'
+
+    def test_slice_atomic_capacity(self):
+        """A v5e-16 slice needs 4 host slots; 3 available → whole slice
+        fails over (slices are gang-admitted)."""
+        state = fake_cloud.fake_cloud_state()
+        state.set_zone_capacity('fake-a-1', 3)
+        state.set_zone_capacity('fake-a-2', 3)
+        result = _provision(Resources(cloud='fake',
+                                      accelerators='tpu-v5e-16'))
+        assert result.resources.region == 'fake-b'
+        # fake-a capacity untouched by the failed attempts.
+        assert state.zone_capacity['fake-a-1'] == 3
+
+    def test_all_unavailable_raises_with_history(self):
+        state = fake_cloud.fake_cloud_state()
+        for r in ('fake-a', 'fake-b', 'fake-c'):
+            for z in (f'{r}-1', f'{r}-2'):
+                state.fail_always(z, exceptions.ProvisionError('stockout'))
+        with pytest.raises(exceptions.ResourcesUnavailableError) as ei:
+            _provision(Resources(cloud='fake', cpus='8'))
+        assert len(ei.value.failover_history) == 6
+
+    def test_no_failover_error_terminal(self):
+        state = fake_cloud.fake_cloud_state()
+        state.fail_always(
+            'fake-a-1',
+            exceptions.ProvisionError('bad credentials', no_failover=True))
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            _provision(Resources(cloud='fake', cpus='8'))
+        # Should NOT have burned through other zones.
+        assert not fake_cloud.fake_cloud_state().instances
+
+    def test_cleanup_on_partial_failure(self):
+        """Second node fails → first node must be terminated before
+        failover (reference teardown-on-partial-failure)."""
+        state = fake_cloud.fake_cloud_state()
+        state.set_zone_capacity('fake-a-1', 1)  # only 1 of 2 nodes fits
+        result = _provision(Resources(cloud='fake', cpus='2'), num_nodes=2)
+        assert result.resources.zone != 'fake-a-1'
+        leftovers = [r for r in state.instances.values()
+                     if r['zone'] == 'fake-a-1' and r['status'] == 'running']
+        assert leftovers == []
+
+    def test_query_and_terminate(self):
+        result = _provision(Resources(cloud='fake', cpus='8'), name='q')
+        statuses = provision_api.query_instances('fake', 'q',
+                                                 result.provider_config)
+        assert list(statuses.values()) == ['running']
+        provisioner_lib.teardown_cluster('fake', 'q',
+                                         result.provider_config,
+                                         terminate=True)
+        assert provision_api.query_instances('fake', 'q',
+                                             result.provider_config) == {}
+
+    def test_preemption_injection(self):
+        result = _provision(
+            Resources(cloud='fake', accelerators='tpu-v5e-8',
+                      use_spot=True), name='p')
+        n = fake_cloud.fake_cloud_state().preempt_cluster('p')
+        assert n == 1
+        statuses = provision_api.query_instances(
+            'fake', 'p', result.provider_config,
+            non_terminated_only=False)
+        assert 'terminated' in statuses.values()
