@@ -1,0 +1,117 @@
+"""Headline benchmark: Llama train-step throughput on the attached TPU.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: Llama-3-8B-equivalent training tokens/sec per chip — measured
+model FLOP/s on a real train step (6*N_params*tokens) normalized to the
+8B parameter count, so runs on any chip count/model size compare directly
+against the reference anchor.
+
+Baseline: the reference's published TPU numbers (BASELINE.md) — Llama-3-8B
+torch-xla FSDP on v6e-8 at 0.476 samples/s, block 8192
+(docs/source/reference/tpu.rst:138-150) = 487 tok/s/chip on v6e;
+bf16-FLOPs-scaled to this chip's generation for a like-for-like
+vs_baseline ratio.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+# Reference anchor: tokens/sec/chip for Llama-3-8B on v6e (918 bf16
+# TFLOP/s/chip): 0.476 samples/s * 8192 tokens / 8 chips.
+_BASELINE_V6E_TOKENS_PER_SEC_PER_CHIP = 0.476 * 8192 / 8
+_V6E_TFLOPS = 918.0
+_8B_PARAMS = 8.03e9
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--quick', action='store_true',
+                        help='Fewer steps / smaller model.')
+    parser.add_argument('--steps', type=int, default=None)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = jax.default_backend() == 'tpu'
+    n_chips = len(jax.devices())
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.train import data as data_lib
+    from skypilot_tpu.train import trainer as trainer_lib
+    from skypilot_tpu.utils import accelerator_registry
+
+    if on_tpu:
+        # ~550M-param model: big enough to saturate the MXU, small enough
+        # for one chip's HBM with f32 master params + Adam.
+        overrides = dict(vocab_size=32768, dim=1536, n_layers=12,
+                         n_heads=12, n_kv_heads=4, ffn_dim=6144,
+                         max_seq_len=2048)
+        batch, seq = 8, 2048
+        steps = args.steps or (6 if args.quick else 20)
+        # Identify the chip generation for FLOPs-scaled baseline.
+        device_kind = jax.devices()[0].device_kind.lower()
+        gen = 'v5e'
+        for name in ('v6e', 'v5p', 'v5e', 'v5 lite', 'v4', 'v3', 'v2'):
+            if name.replace(' ', '') in device_kind.replace(' ', '') or \
+                    name in device_kind:
+                gen = 'v5e' if 'lite' in name else name
+                break
+        chip_tflops = accelerator_registry.TPU_GENERATIONS[
+            gen].bf16_tflops_per_chip
+    else:
+        overrides = dict(vocab_size=2048, dim=256, n_layers=2, n_heads=4,
+                         n_kv_heads=2, ffn_dim=512, max_seq_len=256)
+        batch, seq = 4, 256
+        steps = args.steps or 4
+        chip_tflops = _V6E_TFLOPS  # nominal; CPU runs are smoke only
+
+    config = trainer_lib.TrainConfig(
+        model='llama-tiny', global_batch_size=batch, seq_len=seq,
+        total_steps=steps, mesh=mesh_lib.MeshConfig(data=1, fsdp=-1),
+        model_overrides=overrides)
+    trainer = trainer_lib.Trainer(config)
+    trainer.init_state()
+    n_params = llama.num_params(trainer.model_config)
+    data_iter = data_lib.synthetic_data(
+        trainer.mesh, global_batch_size=batch, seq_len=seq,
+        vocab_size=trainer.model_config.vocab_size)
+
+    # Warmup (compile) then timed steps.
+    batch0 = next(data_iter)
+    trainer.step(batch0)
+    jax.block_until_ready(trainer.state.params)
+    t0 = time.time()
+    for _ in range(steps):
+        metrics = trainer.step(next(data_iter))
+    jax.block_until_ready(metrics['loss'])
+    dt = time.time() - t0
+
+    tokens_per_sec = steps * batch * seq / dt
+    model_flops_per_sec = 6 * n_params * tokens_per_sec
+    equiv_8b_tokens_per_sec = model_flops_per_sec / (6 * _8B_PARAMS)
+    per_chip = equiv_8b_tokens_per_sec / n_chips
+    baseline_per_chip = (_BASELINE_V6E_TOKENS_PER_SEC_PER_CHIP *
+                         chip_tflops / _V6E_TFLOPS)
+    result = {
+        'metric': 'llama3-8b-equiv train tokens/sec/chip',
+        'value': round(per_chip, 2),
+        'unit': 'tokens/s/chip',
+        'vs_baseline': round(per_chip / baseline_per_chip, 3),
+    }
+    print(json.dumps(result))
+    print(f'# raw: {tokens_per_sec:,.0f} tok/s, model={n_params/1e6:.0f}M '
+          f'params, {model_flops_per_sec/1e12:.1f} model TFLOP/s on '
+          f'{n_chips} chip(s) [{jax.devices()[0].device_kind}], '
+          f'mfu~{model_flops_per_sec/(n_chips*chip_tflops*1e12):.2%}',
+          file=sys.stderr)
+
+
+if __name__ == '__main__':
+    main()

@@ -119,8 +119,12 @@ def _spawn_rank(spec: Dict[str, Any], rank: int, run_cmd: str,
         remote = (f'{runtime_prefix}mkdir -p ~/{constants.WORKDIR} && '
                   f'cd ~/{constants.WORKDIR} && {exports}'
                   f'bash -c {shlex.quote(run_cmd)}')
+        # '-tt' forces a pty so killing the local ssh client delivers
+        # SIGHUP to the remote rank process — without it peer cancellation
+        # would only kill the ssh client and leak the remote workload.
         # pylint: disable=protected-access
-        full = runner._ssh_base() + [f'{runner.ssh_user}@{address}',
+        full = runner._ssh_base() + ['-tt',
+                                     f'{runner.ssh_user}@{address}',
                                      remote]
         proc = subprocess.Popen(
             full, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,

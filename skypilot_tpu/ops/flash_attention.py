@@ -1,0 +1,281 @@
+"""Fused flash attention for TPU (Pallas) with a memory-efficient VJP.
+
+The reference delegates attention to torch-xla's flash attention
+(docs/source/reference/tpu.rst:99-127 `torch_xla[pallas]` +
+`--flash_attention`); here it is a first-party kernel:
+
+  - forward: online-softmax flash attention (Dao et al.) as a Pallas TPU
+    kernel — grid (batch*heads, q_blocks, kv_blocks) with kv innermost,
+    f32 accumulators in VMEM scratch, causal blocks skipped entirely
+    (upper-triangular tiles never touch the MXU);
+  - backward: FlashAttention-2 formulation as a blockwise double-scan in
+    jnp (O(block) attention materialization, XLA-fused) using the saved
+    logsumexp — a Pallas backward kernel is the planned next optimization;
+  - off-TPU (tests, CPU sims) the same kernel runs in interpreter mode.
+
+Layout: [batch, num_heads, seq, head_dim] ("BHSD"), head_dim a multiple
+of 128 on TPU for MXU alignment.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_KV = 512
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == 'tpu'
+
+
+def _pick_block(seq: int, requested: int, what: str) -> int:
+    """Largest block <= requested that exactly divides seq.
+
+    Sequences must be a multiple of 128 (TPU lane width); partial edge
+    blocks would otherwise pollute the non-causal softmax (forward pads)
+    and break the blockwise backward reshape.
+    """
+    if seq % 128 != 0 and seq < 128:
+        # Tiny sequences (tests): one block covering everything.
+        return seq
+    if seq % 128 != 0:
+        raise ValueError(
+            f'flash_attention requires {what} length divisible by 128, '
+            f'got {seq}. Pad the sequence.')
+    b = min(requested, seq)
+    b -= b % 128
+    while b > 0 and seq % b != 0:
+        b -= 128
+    return max(b, 128)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *, scale: float,
+                      causal: bool, block_q: int, block_kv: int) -> None:
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_kv
+    # Causal: a kv block strictly above the diagonal contributes nothing.
+    should_run = True
+    if causal:
+        should_run = k_start <= q_start + block_q - 1
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0].astype(jnp.float32)           # [bkv, d]
+        v = v_ref[0].astype(jnp.float32)           # [bkv, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bkv]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                       # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)   # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                      # [bq, bkv]
+        correction = jnp.exp(m_prev - m_new)        # [bq, 1]
+        l_new = correction * l_ref[:, :1] + \
+            jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(l_safe)
+        lse_ref[0] = lse.astype(lse_ref.dtype)
+
+
+def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float,
+               causal: bool, block_q: int,
+               block_kv: int) -> Tuple[jax.Array, jax.Array]:
+    batch, heads, seq_q, d = q.shape
+    seq_kv = k.shape[2]
+    bh = batch * heads
+    block_q = _pick_block(seq_q, block_q, 'query')
+    block_kv = _pick_block(seq_kv, block_kv, 'key/value')
+    q3 = q.reshape(bh, seq_q, d)
+    k3 = k.reshape(bh, seq_kv, d)
+    v3 = v.reshape(bh, seq_kv, d)
+    grid = (bh, pl.cdiv(seq_q, block_q), pl.cdiv(seq_kv, block_kv))
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale,
+                               causal=causal, block_q=block_q,
+                               block_kv=block_kv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            # lse as [bh, seq, 1]: TPU block tiling needs the last two
+            # dims (8,128)-divisible or equal to the array dims.
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=not _on_tpu(),
+    )(q3, k3, v3)
+    return (out.reshape(batch, heads, seq_q, d),
+            lse.reshape(batch, heads, seq_q))  # lse [bh,seq,1] squeezed
+
+
+# ---------------------------------------------------------------------------
+# backward (FlashAttention-2 blockwise double-scan, jnp)
+# ---------------------------------------------------------------------------
+def _flash_bwd(scale: float, causal: bool, block_q: int, block_kv: int,
+               residuals, g) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    q, k, v, out, lse = residuals
+    do = g
+    batch, heads, seq_q, d = q.shape
+    seq_kv = k.shape[2]
+    block_q = _pick_block(seq_q, block_q, 'query')
+    block_kv = _pick_block(seq_kv, block_kv, 'key/value')
+    nq = seq_q // block_q
+    nk = seq_kv // block_kv
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    # delta_i = rowsum(dO * O)  [B,H,S]
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)
+
+    q_blocks = qf.reshape(batch, heads, nq, block_q, d)
+    do_blocks = dof.reshape(batch, heads, nq, block_q, d)
+    lse_blocks = lse.reshape(batch, heads, nq, block_q)
+    delta_blocks = delta.reshape(batch, heads, nq, block_q)
+    k_blocks = kf.reshape(batch, heads, nk, block_kv, d)
+    v_blocks = vf.reshape(batch, heads, nk, block_kv, d)
+
+    def q_step(carry, qi):
+        dk_acc, dv_acc = carry
+        q_i = q_blocks[:, :, qi]                   # [B,H,bq,d]
+        do_i = do_blocks[:, :, qi]
+        lse_i = lse_blocks[:, :, qi]               # [B,H,bq]
+        delta_i = delta_blocks[:, :, qi]
+
+        def kv_step(dq_i, ki):
+            k_j = k_blocks[:, :, ki]               # [B,H,bkv,d]
+            v_j = v_blocks[:, :, ki]
+            s = jnp.einsum('bhqd,bhkd->bhqk', q_i, k_j) * scale
+            if causal:
+                rows = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_kv), 0)
+                cols = ki * block_kv + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_kv), 1)
+                s = jnp.where(rows >= cols, s, _NEG_INF)
+            p = jnp.exp(s - lse_i[..., None])      # [B,H,bq,bkv]
+            dp = jnp.einsum('bhqd,bhkd->bhqk', do_i, v_j)
+            ds = p * (dp - delta_i[..., None]) * scale
+            dq_i = dq_i + jnp.einsum('bhqk,bhkd->bhqd', ds, k_j)
+            dk_j = jnp.einsum('bhqk,bhqd->bhkd', ds, q_i)
+            dv_j = jnp.einsum('bhqk,bhqd->bhkd', p, do_i)
+            return dq_i, (dk_j, dv_j)
+
+        dq_i0 = jnp.zeros_like(q_i)
+        dq_i, (dk_js, dv_js) = jax.lax.scan(kv_step, dq_i0,
+                                            jnp.arange(nk))
+        # dk_js: [nk,B,H,bkv,d] — accumulate into the carried full dk/dv.
+        dk_acc = dk_acc + jnp.moveaxis(dk_js, 0, 2).reshape(
+            batch, heads, seq_kv, d)
+        dv_acc = dv_acc + jnp.moveaxis(dv_js, 0, 2).reshape(
+            batch, heads, seq_kv, d)
+        return (dk_acc, dv_acc), dq_i
+
+    (dk, dv), dq_blocks = jax.lax.scan(
+        q_step,
+        (jnp.zeros((batch, heads, seq_kv, d), jnp.float32),
+         jnp.zeros((batch, heads, seq_kv, d), jnp.float32)),
+        jnp.arange(nq))
+    dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(batch, heads, seq_q, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    scale: Optional[float] = None, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_kv: int = DEFAULT_BLOCK_KV) -> jax.Array:
+    """Flash attention over [batch, heads, seq, head_dim] inputs."""
+    out, _ = _fwd_impl(q, k, v, scale, causal, block_q, block_kv)
+    return out
+
+
+def _fwd_impl(q, k, v, scale, causal, block_q, block_kv):
+    actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash_fwd(q, k, v, scale=actual_scale, causal=causal,
+                      block_q=block_q, block_kv=block_kv)
+
+
+def _vjp_fwd(q, k, v, scale, causal, block_q, block_kv):
+    out, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_kv)
+    return out, (q, k, v, out, lse)
+
+
+def _vjp_bwd(scale, causal, block_q, block_kv, residuals, g):
+    q = residuals[0]
+    actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash_bwd(actual_scale, causal, block_q, block_kv, residuals,
+                      g)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                  scale: Optional[float] = None,
+                  causal: bool = True) -> jax.Array:
+    """Plain-jnp attention for correctness tests."""
+    actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * actual_scale
+    if causal:
+        seq_q, seq_kv = s.shape[-2:]
+        mask = jnp.tril(jnp.ones((seq_q, seq_kv), bool),
+                        k=seq_kv - seq_q)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bhqk,bhkd->bhqd', p,
+                      v.astype(jnp.float32)).astype(q.dtype)

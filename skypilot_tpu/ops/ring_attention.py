@@ -1,0 +1,217 @@
+"""Ring attention: exact attention over sequence shards on an ICI ring.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.11 — "no
+hits for ring-attention/Ulysses"); this is green-field TPU design:
+
+  - the sequence is sharded over the mesh's `context` axis; each device
+    holds q/k/v chunks [B, H, S/c, D];
+  - c ring steps: compute blockwise attention of the local q chunk
+    against the currently-held kv chunk (Pallas flash kernel), merge with
+    the running (out, lse) online-softmax state, then rotate kv to the
+    ICI neighbor with `jax.lax.ppermute` — communication overlaps compute
+    and total memory stays O(S/c) per device (Liu et al., Ring Attention
+    with Blockwise Transformers);
+  - backward is a second ring pass (FlashAttention-2 block math) where
+    (k, v, dk, dv) travel the ring together and return to their owners —
+    the whole op is a custom_vjp so autodiff never sees the loop;
+  - causal masking is applied per (q_chunk, kv_chunk) pair from the ring
+    offsets; fully-masked pairs skip the kernel via lax.cond.
+
+Must be called under shard_map (or an equivalent axis context) with the
+sequence dimension sharded over `axis_name`.  `ulysses_attention` is the
+all-to-all head-scatter alternative for meshes where a ring is a poor
+fit.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.ops import flash_attention as fa
+
+_NEG_INF = -1e30
+
+
+def _merge(out1, lse1, out2, lse2):
+    """Online-softmax merge of two partial attention results."""
+    lse_new = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse_new)[..., None]
+    w2 = jnp.exp(lse2 - lse_new)[..., None]
+    return out1 * w1 + out2 * w2, lse_new
+
+
+def _block_fwd(q, k, v, scale, q_off, k_off, chunk):
+    """(out, lse) of one q-chunk vs one kv-chunk with global causal mask.
+
+    Three cases by ring offset: kv strictly ahead of q → fully masked;
+    same chunk → causal within; kv behind → full attention.
+    """
+    def full(_):
+        return fa._fwd_impl(q, k, v, scale, False,  # pylint: disable=protected-access
+                            fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_KV)
+
+    def diag(_):
+        return fa._fwd_impl(q, k, v, scale, True,  # pylint: disable=protected-access
+                            fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_KV)
+
+    def masked(_):
+        return (jnp.zeros_like(q),
+                jnp.full(q.shape[:-1], _NEG_INF, jnp.float32))
+
+    return jax.lax.cond(
+        k_off > q_off, masked,
+        lambda _: jax.lax.cond(k_off == q_off, diag, full, None), None)
+
+
+def _ring_fwd_loop(q, k, v, scale, axis_name, axis_size, causal):
+    my = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    out = jnp.zeros((b, h, s_local, d), jnp.float32)
+    lse = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(t, carry):
+        out, lse, k_cur, v_cur = carry
+        src = (my - t) % axis_size
+        if causal:
+            part_out, part_lse = _block_fwd(q, k_cur, v_cur, scale, my,
+                                            src, s_local)
+        else:
+            part_out, part_lse = fa._fwd_impl(  # pylint: disable=protected-access
+                q, k_cur, v_cur, scale, False, fa.DEFAULT_BLOCK_Q,
+                fa.DEFAULT_BLOCK_KV)
+        out, lse = _merge(out, lse, part_out.astype(jnp.float32),
+                          part_lse)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return out, lse, k_next, v_next
+
+    out, lse, _, _ = jax.lax.fori_loop(0, axis_size, step,
+                                       (out, lse, k, v))
+    return out.astype(q.dtype), lse
+
+
+def _block_bwd(q, k, v, do, lse, delta, scale, q_off, k_off):
+    """FA2 block backward for one (q_chunk, kv_chunk) pair."""
+    s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    sl = q.shape[2]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 1)
+    # Global causal mask from ring offsets.
+    mask = jnp.where(q_off == k_off, rows >= cols, q_off > k_off)
+    s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    dof = do.astype(jnp.float32)
+    dp = jnp.einsum('bhqd,bhkd->bhqk', dof, v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum('bhqk,bhkd->bhqd', ds, k.astype(jnp.float32))
+    dk = jnp.einsum('bhqk,bhqd->bhkd', ds, q.astype(jnp.float32))
+    dv = jnp.einsum('bhqk,bhqd->bhkd', p, dof)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = 'context',
+                   causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    out, _ = _ring_fwd(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale):
+    actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
+    axis_size = jax.lax.axis_size(axis_name)
+    return _ring_fwd_loop(q, k, v, actual_scale, axis_name, axis_size,
+                          causal)
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_fwd(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, scale, residuals, g):
+    q, k, v, out, lse = residuals
+    actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
+    axis_size = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+
+    def step(t, carry):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (my - t) % axis_size
+        q_off = my if causal else jnp.int32(1)
+        k_off = src if causal else jnp.int32(0)
+        dq_t, dk_t, dv_t = _block_bwd(q, k_cur, v_cur, g, lse, delta,
+                                      actual_scale, q_off, k_off)
+        dq = dq + dq_t
+        dk_cur = dk_cur + dk_t
+        dv_cur = dv_cur + dv_t
+        # Rotate kv and its accumulating grads together: after axis_size
+        # steps they are back at the owner.
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return dq, k_cur, v_cur, dk_cur, dv_cur
+
+    dq, _, _, dk, dv = jax.lax.fori_loop(
+        0, axis_size, step, (dq, k, v, dk0, dv0))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all head scatter) alternative
+# ---------------------------------------------------------------------------
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = 'context',
+                      causal: bool = True) -> jax.Array:
+    """DeepSpeed-Ulysses-style context parallelism: all-to-all converts
+    sequence sharding into head sharding, attention runs unsharded per
+    head group, and a second all-to-all restores sequence sharding.
+    Cheaper than a ring when heads >= axis_size and sequence is moderate;
+    the ring wins at very long context (SURVEY.md §5).
+    Inputs per shard: [B, H, S/c, D]; requires H % c == 0.
+    """
+    c = jax.lax.axis_size(axis_name)
+
+    # all_to_all(tiled=False): the split axis is REMOVED and a new
+    # device axis of size c is INSERTED at concat_axis.
+    def scatter_heads(x):
+        # [B, H, S/c, D] -> [B, H/c, S, D]
+        b, h, sl, d = x.shape
+        x = x.reshape(b, c, h // c, sl, d)
+        # (b, c, h/c, sl, d) -> (b, h/c, c, sl, d): device axis lands
+        # just before the local-seq axis so the flatten is seq-ordered.
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                               tiled=False)
+        return x.reshape(b, h // c, c * sl, d)
+
+    def gather_heads(x):
+        # [B, H/c, S, D] -> [B, H, S/c, D]
+        b, hc, s, d = x.shape
+        x = x.reshape(b, hc, c, s // c, d)
+        # (b, hc, c, sl, d) -> (b, c, hc, sl, d): device axis before the
+        # local-head axis restores block-major head order.
+        x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                               tiled=False)
+        return x.reshape(b, hc * c, s // c, d)
+
+    q_h = scatter_heads(q)
+    k_h = scatter_heads(k)
+    v_h = scatter_heads(v)
+    out = fa.flash_attention(q_h, k_h, v_h, None, causal)
+    return gather_heads(out)

@@ -1,0 +1,113 @@
+"""Device mesh construction: the parallelism substrate.
+
+The reference framework implements no model parallelism — it gang-schedules
+torchrun recipes (SURVEY.md §2.11).  Here parallelism is a first-class
+library: a named `jax.sharding.Mesh` with standard axes
+
+    data    — pure data parallel (batch split, gradient psum)
+    fsdp    — ZeRO-style parameter/optimizer sharding (still batch-split)
+    tensor  — Megatron-style intra-layer model parallelism
+    expert  — MoE expert parallelism
+    context — sequence/context parallelism (ring attention)
+
+Mesh planning maps these onto the physical slice so that the
+highest-traffic axes (tensor, context) land on contiguous ICI neighbors
+and `data` spans slice/DCN boundaries — the scaling-book recipe: pick a
+mesh, annotate shardings, let XLA insert collectives.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: fastest-varying (last) = most-communicating, so
+# neighboring devices (ICI) carry tensor/context traffic.
+AXES = ('data', 'fsdp', 'expert', 'pipe', 'context', 'tensor')
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical parallelism degrees. -1 on `data` or `fsdp` means 'absorb
+    all remaining devices'."""
+    data: int = 1
+    fsdp: int = -1
+    expert: int = 1
+    pipe: int = 1
+    context: int = 1
+    tensor: int = 1
+
+    def resolve(self, num_devices: int) -> Dict[str, int]:
+        sizes = {axis: getattr(self, axis) for axis in AXES}
+        fixed = math.prod(v for v in sizes.values() if v > 0)
+        free_axes = [a for a, v in sizes.items() if v == -1]
+        if not free_axes:
+            if fixed != num_devices:
+                raise ValueError(
+                    f'Mesh {sizes} needs {fixed} devices, have '
+                    f'{num_devices}.')
+            return sizes
+        if len(free_axes) > 1:
+            raise ValueError('At most one axis may be -1.')
+        if num_devices % fixed != 0:
+            raise ValueError(
+                f'{num_devices} devices not divisible by fixed axes '
+                f'{fixed}.')
+        sizes[free_axes[0]] = num_devices // fixed
+        return sizes
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh over `devices` (default: all) with the AXES order."""
+    if devices is None:
+        devices = jax.devices()
+    config = config or MeshConfig()
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+    try:
+        # Topology-aware placement when available (real TPU slices): lets
+        # jax lay contiguous mesh dims onto ICI neighbors.
+        from jax.experimental import mesh_utils
+        device_array = mesh_utils.create_device_mesh(
+            shape, devices=list(devices))
+    except (ValueError, ImportError, AssertionError):
+        device_array = np.array(list(devices)).reshape(shape)
+    return Mesh(device_array, AXES)
+
+
+def batch_axes() -> Tuple[str, ...]:
+    """Mesh axes over which the global batch is split."""
+    return ('data', 'fsdp')
+
+
+def num_batch_shards(mesh: Mesh) -> int:
+    return int(np.prod([mesh.shape[a] for a in batch_axes()]))
+
+
+def plan_for_slice(accelerator: str, *, model_params_b: float = 8.0,
+                   sequence_length: int = 8192) -> MeshConfig:
+    """Heuristic mesh plan for a slice (used by recipes when the user
+    doesn't pin one).
+
+    Rules of thumb (scaling-book): FSDP as the default scaling axis within
+    a slice; add tensor parallelism once per-device parameters exceed a
+    few GB; add context parallelism for long sequences.
+    """
+    from skypilot_tpu.utils import accelerator_registry
+    spec = accelerator_registry.parse_tpu_accelerator(accelerator)
+    n = spec.num_jax_devices  # megacore-aware (v4/v5p: 1 device/chip)
+    tensor = 1
+    hbm_per_device = spec.hbm_gb_per_jax_device
+    # bf16 params + fp32 grads + adam moments ≈ 16 bytes/param under pure
+    # FSDP — fine; tensor parallel only for very large models per device.
+    if model_params_b * 16 / n > hbm_per_device * 0.6:
+        tensor = min(4, n)
+    context = 1
+    if sequence_length > 32768:
+        context = min(4, n // tensor)
+    return MeshConfig(data=1, fsdp=-1, tensor=tensor, context=context)

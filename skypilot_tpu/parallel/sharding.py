@@ -1,0 +1,103 @@
+"""Logical-axis sharding rules (pjit partition specs).
+
+Models annotate parameters with *logical* axis names (via
+flax.linen.with_partitioning); this module maps logical names to mesh axes
+and builds NamedShardings.  The default rules implement the standard
+Llama/MaxText-style layout:
+
+    embed        — hidden dim: sharded over tensor for attn/mlp inputs
+    mlp          — ffn dim: tensor-sharded (column/row parallel pair)
+    heads        — attention heads: tensor-sharded
+    kv_heads     — kv heads: tensor-sharded (grouped-query attn)
+    vocab        — output embedding: tensor-sharded
+    fsdp_dim     — the dimension each param is ZeRO-sharded over
+    batch        — data+fsdp (batch split)
+    sequence     — context axis (ring attention)
+    experts      — expert axis (MoE)
+
+Rules are (logical_name -> mesh axis | None); params additionally get
+'fsdp' sharding applied on their largest eligible dimension.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical name -> mesh axis (None = replicated on that dim).
+DEFAULT_RULES: Dict[str, Optional[Union[str, Tuple[str, ...]]]] = {
+    'batch': ('data', 'fsdp'),
+    'sequence': 'context',
+    'embed': None,            # hidden dim of activations: replicated
+    'embed_fsdp': 'fsdp',     # hidden dim of *params*: ZeRO-sharded
+    'heads': 'tensor',
+    'kv_heads': 'tensor',
+    'head_dim': None,
+    'mlp': 'tensor',
+    'vocab': 'tensor',
+    'experts': 'expert',
+    'stage': 'pipe',
+    None: None,
+}
+
+
+def logical_to_spec(
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict[str, Any]] = None,
+) -> P:
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    mesh_axes = []
+    used = set()
+    for name in logical_axes:
+        axis = rules.get(name)
+        # A mesh axis can appear at most once in a PartitionSpec.
+        if axis is not None:
+            flat = axis if isinstance(axis, tuple) else (axis,)
+            if any(a in used for a in flat):
+                axis = None
+            else:
+                used.update(flat)
+        mesh_axes.append(axis)
+    return P(*mesh_axes)
+
+
+def tree_to_shardings(mesh: Mesh, logical_tree: Any,
+                      rules: Optional[Dict[str, Any]] = None) -> Any:
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(a, (str, type(None))) for a in x))
+
+
+def params_to_shardings(mesh: Mesh, params: Any,
+                        rules: Optional[Dict[str, Any]] = None) -> Any:
+    """Shardings for a flax param tree that used nn.with_partitioning
+    (leaves are nn.Partitioned) — unannotated leaves are replicated."""
+    import flax.linen as nn
+
+    def _leaf(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            return NamedSharding(mesh, logical_to_spec(leaf.names, rules))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(_leaf, params,
+                        is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(('data', 'fsdp')))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def unbox(tree: Any) -> Any:
+    """Strip flax Partitioned boxes -> raw arrays."""
+    import flax.linen as nn
+    return jax.tree.map(
+        lambda x: x.value if isinstance(x, nn.Partitioned) else x, tree,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned))

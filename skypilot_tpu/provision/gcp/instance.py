@@ -104,6 +104,20 @@ def _node_name(cluster_name_on_cloud: str, idx: int) -> str:
     return f'{cluster_name_on_cloud}-{idx}'
 
 
+def _fresh_node_names(cluster_name_on_cloud: str, taken: set,
+                      count: int) -> List[str]:
+    """Names not colliding with live OR deleted-but-listed nodes (a
+    preempted node's index must not be reused while its record lingers)."""
+    out: List[str] = []
+    idx = 0
+    while len(out) < count:
+        name = _node_name(cluster_name_on_cloud, idx)
+        if name not in taken:
+            out.append(name)
+        idx += 1
+    return out
+
+
 def _run_tpu_slices(project: str, region: str, zone: str,
                     cluster_name_on_cloud: str,
                     config: common.ProvisionConfig) -> common.ProvisionRecord:
@@ -123,8 +137,9 @@ def _run_tpu_slices(project: str, region: str, zone: str,
 
     to_create = config.count - len(ready)
     created: List[str] = []
-    for idx in range(len(existing), len(existing) + max(to_create, 0)):
-        node_id = _node_name(cluster_name_on_cloud, idx)
+    taken = {n['name'].rsplit('/', 1)[-1] for n in existing}
+    for node_id in _fresh_node_names(cluster_name_on_cloud, taken,
+                                     max(to_create, 0)):
         body: Dict[str, Any] = {
             'acceleratorType': node_cfg['tpu_type'],
             'runtimeVersion': node_cfg['runtime_version'],
@@ -144,8 +159,13 @@ def _run_tpu_slices(project: str, region: str, zone: str,
             },
         }
         if node_cfg.get('tpu_topology'):
+            # TPU API AcceleratorConfig enum names.
+            accel_type = {
+                'v2': 'V2', 'v3': 'V3', 'v4': 'V4',
+                'v5e': 'V5LITE_POD', 'v5p': 'V5P', 'v6e': 'V6E',
+            }[node_cfg['tpu_generation']]
             body['acceleratorConfig'] = {
-                'type': node_cfg['tpu_generation'].upper().replace('E', 'E'),
+                'type': accel_type,
                 'topology': node_cfg['tpu_topology'],
             }
             body.pop('acceleratorType')
@@ -198,8 +218,9 @@ def _run_gce_instances(project: str, region: str, zone: str,
     created: List[str] = []
     machine_type = (f'zones/{zone}/machineTypes/'
                     f'{node_cfg["instance_type"]}')
-    for idx in range(len(existing), len(existing) + max(to_create, 0)):
-        name = _node_name(cluster_name_on_cloud, idx)
+    taken = {i['name'] for i in existing}
+    for name in _fresh_node_names(cluster_name_on_cloud, taken,
+                                  max(to_create, 0)):
         body: Dict[str, Any] = {
             'name': name,
             'machineType': machine_type,

@@ -59,6 +59,7 @@ def _provider_config(resources: resources_lib.Resources,
         'region': deploy_vars.get('region'),
         'zone': deploy_vars.get('zone'),
         'tpu_vm': deploy_vars.get('tpu_vm', False),
+        'ports': resources.ports,
     }
     if resources.cloud.canonical_name() == 'gcp':
         cfg['project_id'] = config_lib.get_nested(('gcp', 'project_id'),
@@ -264,9 +265,10 @@ def teardown_cluster(provider_name: str, cluster_name_on_cloud: str,
         provision_api.terminate_instances(provider_name,
                                           cluster_name_on_cloud,
                                           provider_config)
-        if provider_config.get('ports_cleanup_needed'):
+        if provider_config.get('ports'):
             provision_api.cleanup_ports(provider_name, cluster_name_on_cloud,
-                                        [], provider_config)
+                                        provider_config['ports'],
+                                        provider_config)
     else:
         provision_api.stop_instances(provider_name, cluster_name_on_cloud,
                                      provider_config)

@@ -1,0 +1,90 @@
+"""Train entrypoint: `python -m skypilot_tpu.train --model llama3-8b ...`
+
+The workload that task YAMLs gang-run on slices (the JAX analog of the
+reference's llm/llama-3_1-finetuning torchtune command).  Initializes
+jax.distributed from the gang driver's env contract, builds the mesh over
+all devices, trains, optionally checkpointing to a (bucket-mounted) dir.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description='skypilot_tpu trainer')
+    parser.add_argument('--model', default='llama-tiny')
+    parser.add_argument('--steps', type=int, default=100)
+    parser.add_argument('--global-batch-size', type=int, default=8)
+    parser.add_argument('--seq-len', type=int, default=512)
+    parser.add_argument('--learning-rate', type=float, default=3e-4)
+    parser.add_argument('--grad-accum-steps', type=int, default=1)
+    parser.add_argument('--mesh', default='fsdp=-1',
+                        help="e.g. 'data=2,fsdp=-1,tensor=4'")
+    parser.add_argument('--checkpoint-dir', default=None)
+    parser.add_argument('--checkpoint-every', type=int, default=0)
+    parser.add_argument('--dataset', default=None,
+                        help='HF dataset (default: synthetic).')
+    parser.add_argument('--tokenizer', default=None)
+    parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--json-metrics', action='store_true',
+                        help='Print final metrics as one JSON line.')
+    args = parser.parse_args()
+
+    from skypilot_tpu.train import launcher
+    launcher.maybe_initialize_distributed()
+
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.train import data as data_lib
+    from skypilot_tpu.train import trainer as trainer_lib
+
+    mesh_kwargs = {}
+    for part in args.mesh.split(','):
+        if part:
+            k, v = part.split('=')
+            mesh_kwargs[k] = int(v)
+    config = trainer_lib.TrainConfig(
+        model=args.model,
+        global_batch_size=args.global_batch_size,
+        seq_len=args.seq_len,
+        learning_rate=args.learning_rate,
+        grad_accum_steps=args.grad_accum_steps,
+        total_steps=args.steps,
+        mesh=mesh_lib.MeshConfig(**mesh_kwargs),
+        model_overrides={'max_seq_len': args.seq_len},
+    )
+    trainer = trainer_lib.Trainer(config)
+    manager = None
+    if args.checkpoint_dir:
+        from skypilot_tpu.train import checkpoint as ckpt_lib
+        manager = ckpt_lib.make_manager(args.checkpoint_dir)
+        ckpt_lib.restore_or_init(manager, trainer)
+    else:
+        trainer.init_state()
+
+    if args.dataset:
+        data_iter = data_lib.hf_text_data(
+            trainer.mesh, dataset_name=args.dataset,
+            tokenizer_name=args.tokenizer or args.dataset,
+            global_batch_size=config.global_batch_size,
+            seq_len=config.seq_len)
+    else:
+        data_iter = data_lib.synthetic_data(
+            trainer.mesh, global_batch_size=config.global_batch_size,
+            seq_len=config.seq_len,
+            vocab_size=trainer.model_config.vocab_size)
+
+    remaining = args.steps - int(trainer.state.step)
+    metrics = trainer.train(data_iter, num_steps=max(remaining, 0),
+                            log_every=args.log_every,
+                            checkpoint_manager=manager,
+                            checkpoint_every=args.checkpoint_every)
+    if manager is not None:
+        from skypilot_tpu.train import checkpoint as ckpt_lib
+        ckpt_lib.save(manager, trainer.state, wait=True)
+    if args.json_metrics:
+        print(json.dumps(metrics))
+
+
+if __name__ == '__main__':
+    main()

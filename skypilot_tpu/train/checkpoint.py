@@ -1,0 +1,76 @@
+"""Async multi-host checkpointing (Orbax) — the resume half of the
+bucket-checkpoint contract.
+
+The reference's recovery story is "write checkpoints to a bucket-mounted
+dir, recovered jobs resume from it" (SURVEY.md §5, llm/llama-3_1-
+finetuning/lora.yaml:24-30); managed TPU jobs here follow the same
+contract with first-class async Orbax saves: every host writes its own
+param shards (OCDBT), so a v5p-128 checkpoint scales with hosts, and
+`restore_or_init` makes the trainer preemption-transparent.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def make_manager(directory: str, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 0):
+    import orbax.checkpoint as ocp
+    directory = os.path.abspath(os.path.expanduser(directory)) \
+        if '://' not in directory else directory
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=max_to_keep,
+        enable_async_checkpointing=True,
+    )
+    return ocp.CheckpointManager(directory, options=options)
+
+
+def save(manager, state, *, wait: bool = False) -> int:
+    import orbax.checkpoint as ocp
+    step = int(jax.device_get(state.step))
+    manager.save(step, args=ocp.args.Composite(
+        state=ocp.args.StandardSave({'params': state.params,
+                                     'opt_state': state.opt_state,
+                                     'step': state.step})))
+    if wait:
+        manager.wait_until_finished()
+    logger.info(f'Checkpoint step {step} saved (async).')
+    return step
+
+
+def restore(manager, state):
+    """Restore into the sharded structure of `state` (shapes/shardings
+    from the live state; works across host counts)."""
+    import orbax.checkpoint as ocp
+    latest = manager.latest_step()
+    if latest is None:
+        return None
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if isinstance(x, jax.Array) else x,
+        {'params': state.params, 'opt_state': state.opt_state,
+         'step': state.step})
+    restored = manager.restore(
+        latest, args=ocp.args.Composite(
+            state=ocp.args.StandardRestore(abstract)))['state']
+    logger.info(f'Restored checkpoint step {latest}.')
+    return state.replace(step=restored['step'], params=restored['params'],
+                         opt_state=restored['opt_state'])
+
+
+def restore_or_init(manager, trainer) -> Any:
+    """Preemption-transparent init: restore latest if present, else fresh
+    init (the managed-jobs recovery contract)."""
+    state = trainer.init_state()
+    restored = restore(manager, state)
+    if restored is not None:
+        trainer.state = restored
+        return restored
+    return state

@@ -1,0 +1,89 @@
+"""Training data pipelines: per-host sharded batches onto the mesh.
+
+Two sources:
+  - `synthetic_data`: deterministic token stream (benchmarks and tests —
+    same role as the reference's torch_ddp_benchmark synthetic inputs);
+  - `hf_text_data`: HuggingFace datasets + tokenizer packing (the llm/
+    recipe path), gated on the libraries being present.
+
+Every iterator yields GLOBAL batches as jax.Arrays already sharded over
+the mesh's batch axes: each host materializes only its local shard and
+`jax.make_array_from_process_local_data` assembles the global view.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _global_batch(mesh: Mesh, local: Dict[str, np.ndarray]
+                  ) -> Dict[str, jax.Array]:
+    sharding = NamedSharding(mesh, P(('data', 'fsdp')))
+    return {
+        key: jax.make_array_from_process_local_data(sharding, value)
+        for key, value in local.items()
+    }
+
+
+def synthetic_data(mesh: Mesh, *, global_batch_size: int, seq_len: int,
+                   vocab_size: int, seed: int = 0
+                   ) -> Iterator[Dict[str, jax.Array]]:
+    """Infinite deterministic LM batches: inputs + next-token targets."""
+    num_hosts = jax.process_count()
+    if global_batch_size % num_hosts != 0:
+        raise ValueError(
+            f'global_batch_size {global_batch_size} not divisible by '
+            f'{num_hosts} hosts.')
+    local_bs = global_batch_size // num_hosts
+    rng = np.random.default_rng(seed + jax.process_index())
+    while True:
+        tokens = rng.integers(1, vocab_size, (local_bs, seq_len + 1),
+                              dtype=np.int32)
+        yield _global_batch(mesh, {
+            'inputs': tokens[:, :-1],
+            'targets': tokens[:, 1:],
+            'mask': np.ones((local_bs, seq_len), np.float32),
+        })
+
+
+def hf_text_data(mesh: Mesh, *, dataset_name: str, tokenizer_name: str,
+                 global_batch_size: int, seq_len: int,
+                 split: str = 'train', text_field: str = 'text',
+                 seed: int = 0) -> Iterator[Dict[str, jax.Array]]:
+    """Packed-causal-LM batches from a HF dataset (each host streams its
+    own shard — per-host sharded loading, SURVEY.md §2.11 'per-host
+    sharded data loading')."""
+    try:
+        import datasets  # type: ignore
+        from transformers import AutoTokenizer  # type: ignore
+    except ImportError as e:
+        raise RuntimeError(
+            'hf_text_data requires `datasets` and `transformers`.') from e
+    num_hosts = jax.process_count()
+    local_bs = global_batch_size // num_hosts
+    tokenizer = AutoTokenizer.from_pretrained(tokenizer_name)
+    ds = datasets.load_dataset(dataset_name, split=split, streaming=True)
+    ds = ds.shard(num_shards=num_hosts, index=jax.process_index())
+    ds = ds.shuffle(seed=seed, buffer_size=10_000)
+
+    def packed() -> Iterator[np.ndarray]:
+        buffer: list = []
+        for example in ds:
+            buffer.extend(tokenizer(example[text_field])['input_ids'])
+            buffer.append(tokenizer.eos_token_id or 0)
+            while len(buffer) >= seq_len + 1:
+                yield np.asarray(buffer[:seq_len + 1], np.int32)
+                buffer = buffer[seq_len:]
+
+    stream = packed()
+    while True:
+        rows = [next(stream) for _ in range(local_bs)]
+        tokens = np.stack(rows)
+        yield _global_batch(mesh, {
+            'inputs': tokens[:, :-1],
+            'targets': tokens[:, 1:],
+            'mask': np.ones((local_bs, seq_len), np.float32),
+        })

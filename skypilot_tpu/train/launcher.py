@@ -1,0 +1,48 @@
+"""Distributed bring-up: connect this process to the job's jax.distributed
+rendezvous using the env contract injected by the gang driver
+(agent/constants.py) — the TPU-native replacement for the reference's
+torchrun/NCCL rendezvous over SKYPILOT_NODE_* env vars
+(examples/nccl_test.yaml:31-41, SURVEY.md §2.12).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.agent import constants
+
+logger = sky_logging.init_logger(__name__)
+
+
+def maybe_initialize_distributed() -> bool:
+    """Initialize jax.distributed from SKYTPU_* env vars if present.
+
+    Returns True if a multi-process rendezvous was set up.  Single-process
+    (one host, or env absent) is a no-op — jax works standalone.
+    """
+    coordinator = os.environ.get(constants.ENV_COORDINATOR_ADDR)
+    num_processes = int(os.environ.get(constants.ENV_NUM_PROCESSES, '1'))
+    process_id = int(os.environ.get(constants.ENV_PROCESS_ID, '0'))
+    if coordinator is None or num_processes <= 1:
+        return False
+    import jax
+    logger.info(f'jax.distributed.initialize(coordinator={coordinator}, '
+                f'num_processes={num_processes}, process_id={process_id})')
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def process_info() -> dict:
+    return {
+        'process_id': int(os.environ.get(constants.ENV_PROCESS_ID, '0')),
+        'num_processes': int(os.environ.get(constants.ENV_NUM_PROCESSES,
+                                            '1')),
+        'coordinator': os.environ.get(constants.ENV_COORDINATOR_ADDR),
+        'accelerator': os.environ.get(constants.ENV_ACCELERATOR),
+        'slice_id': os.environ.get(constants.ENV_MEGASCALE_SLICE_ID),
+    }

@@ -42,12 +42,13 @@ class TpuGeneration:
 # Host shapes: the reference hard-codes 96/240 vCPUs and 334/400GB for
 # TPU-VM hosts (sky/clouds/gcp.py:600-651); we keep per-generation values.
 TPU_GENERATIONS: Dict[str, TpuGeneration] = {
-    'v2': TpuGeneration('v2', 'v2', False, 2, 4, 8, 23, 96, 334),
-    'v3': TpuGeneration('v3', 'v3', False, 2, 4, 16, 61, 96, 334),
-    'v4': TpuGeneration('v4', 'v4', False, 2, 4, 32, 137.5, 240, 400),
-    'v5e': TpuGeneration('v5e', 'v5litepod', True, 1, 4, 16, 98.5, 112, 192),
-    'v5p': TpuGeneration('v5p', 'v5p', False, 2, 4, 95, 229.5, 208, 448),
-    'v6e': TpuGeneration('v6e', 'v6e', True, 1, 4, 32, 459, 180, 720),
+    # per-CHIP figures: hbm_gb, bf16 peak TFLOP/s.
+    'v2': TpuGeneration('v2', 'v2', False, 2, 4, 16, 46, 96, 334),
+    'v3': TpuGeneration('v3', 'v3', False, 2, 4, 32, 123, 96, 334),
+    'v4': TpuGeneration('v4', 'v4', False, 2, 4, 32, 275, 240, 400),
+    'v5e': TpuGeneration('v5e', 'v5litepod', True, 1, 4, 16, 197, 112, 192),
+    'v5p': TpuGeneration('v5p', 'v5p', False, 2, 4, 95, 459, 208, 448),
+    'v6e': TpuGeneration('v6e', 'v6e', True, 1, 4, 32, 918, 180, 720),
 }
 
 _TPU_NAME_RE = re.compile(
@@ -70,6 +71,19 @@ class TpuSliceSpec:
     @property
     def num_cores(self) -> int:
         return self.num_chips * self.generation.cores_per_chip
+
+    @property
+    def num_jax_devices(self) -> int:
+        """Devices jax.devices() exposes: v4/v5p fuse both cores of a chip
+        into one megacore device; v2/v3 expose per-core devices; v5e/v6e
+        are single-core chips."""
+        if self.generation.name in ('v4', 'v5p'):
+            return self.num_chips
+        return self.num_cores
+
+    @property
+    def hbm_gb_per_jax_device(self) -> float:
+        return self.total_hbm_gb / self.num_jax_devices
 
     @property
     def num_hosts(self) -> int:

@@ -8,12 +8,18 @@ State dirs are redirected to a per-session tmp dir so tests never touch
 """
 import os
 
-# Must be set before jax (or anything importing jax) is imported.
+# Force an 8-device virtual CPU mesh.  XLA_FLAGS must be set before the
+# first backend initialization; the platform override must go through
+# jax.config because this environment's sitecustomize imports jax at
+# interpreter startup (env-var JAX_PLATFORMS is captured then).
 os.environ['JAX_PLATFORMS'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
 
 import pytest
 
