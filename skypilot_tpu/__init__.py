@@ -38,6 +38,8 @@ _EXPORTS = {
     'Optimizer': ('skypilot_tpu.optimizer', 'Optimizer'),
     'OptimizeTarget': ('skypilot_tpu.optimizer', 'OptimizeTarget'),
     'optimize': ('skypilot_tpu.optimizer', 'optimize'),
+    'jobs': ('skypilot_tpu.jobs', None),
+    'serve': ('skypilot_tpu.serve', None),
 }
 
 __all__ = list(_EXPORTS) + ['__version__']

@@ -174,6 +174,11 @@ class JobExitCode(enum.IntEnum):
         return cls.FAILED
 
 
+class ManagedJobCancelledError(SkyTpuError):
+    """Raised inside the controller when a cancel request interrupts a
+    launch/recovery retry loop."""
+
+
 class ManagedJobReachedMaxRetriesError(SkyTpuError):
     """Managed job recovery gave up after max retries."""
 

@@ -7,7 +7,7 @@ the `_execute` wiring, `launch()` (all stages) and `exec_()` (SYNC_WORKDIR
 from __future__ import annotations
 
 import enum
-from typing import Any, List, Optional, Tuple, Union
+from typing import Any, List, Optional, Set, Tuple, Union
 
 from skypilot_tpu import admin_policy
 from skypilot_tpu import dag as dag_lib
@@ -58,6 +58,7 @@ def _execute(
     idle_minutes_to_autostop: Optional[int] = None,
     retry_until_up: bool = False,
     quiet_optimizer: bool = False,
+    blocked_resources: Optional[Set[Any]] = None,
 ) -> Tuple[Optional[int], Optional[backend_lib.ClusterHandle]]:
     """Run the requested lifecycle stages for a one-task DAG.
 
@@ -85,6 +86,7 @@ def _execute(
 
     if Stage.OPTIMIZE in stages and handle is None:
         optimizer_lib.optimize(dag, minimize=optimize_target,
+                               blocked_resources=blocked_resources,
                                quiet=quiet_optimizer or dryrun)
 
     if Stage.PROVISION in stages:
@@ -140,6 +142,7 @@ def launch(
     idle_minutes_to_autostop: Optional[int] = None,
     retry_until_up: bool = False,
     quiet_optimizer: bool = False,
+    blocked_resources: Optional[Set[Any]] = None,
 ) -> Tuple[Optional[int], Optional[backend_lib.ClusterHandle]]:
     """Provision (or reuse) a cluster and run the task on it
     (reference execution.launch, execution.py:368)."""
@@ -154,6 +157,7 @@ def launch(
         idle_minutes_to_autostop=idle_minutes_to_autostop,
         retry_until_up=retry_until_up,
         quiet_optimizer=quiet_optimizer,
+        blocked_resources=blocked_resources,
     )
 
 

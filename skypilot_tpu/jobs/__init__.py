@@ -1,0 +1,14 @@
+"""Managed jobs: launch-and-forget tasks with automatic preemption
+recovery (reference: sky/jobs/)."""
+from skypilot_tpu.jobs.core import cancel
+from skypilot_tpu.jobs.core import get_status
+from skypilot_tpu.jobs.core import launch
+from skypilot_tpu.jobs.core import queue
+from skypilot_tpu.jobs.core import tail_logs
+from skypilot_tpu.jobs.core import wait
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+__all__ = [
+    'cancel', 'get_status', 'launch', 'queue', 'tail_logs', 'wait',
+    'ManagedJobStatus',
+]

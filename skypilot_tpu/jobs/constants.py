@@ -1,0 +1,40 @@
+"""Managed-jobs constants (reference: sky/jobs/constants.py + the polling
+gaps hard-coded in sky/jobs/controller.py).  Env-overridable so hermetic
+tests can run the recovery hot loop in milliseconds."""
+from __future__ import annotations
+
+import os
+
+
+def _f(env: str, default: float) -> float:
+    try:
+        return float(os.environ.get(env, default))
+    except ValueError:
+        return default
+
+
+def job_status_check_gap_seconds() -> float:
+    """Poll gap of the controller's monitor loop (reference
+    JOB_STATUS_CHECK_GAP_SECONDS = 20, sky/jobs/controller.py)."""
+    return _f('SKYTPU_JOBS_STATUS_GAP', 20.0)
+
+
+def launch_max_retry() -> int:
+    return int(_f('SKYTPU_JOBS_LAUNCH_MAX_RETRY', 3))
+
+
+def launch_retry_backoff_seconds() -> float:
+    return _f('SKYTPU_JOBS_LAUNCH_BACKOFF', 5.0)
+
+
+# Controller-wide parallelism caps (reference sky/jobs/scheduler.py:
+# derived from controller VM size; here from the local host).
+def max_concurrent_launches() -> int:
+    return int(_f('SKYTPU_JOBS_MAX_LAUNCHES', 8))
+
+
+def max_alive_jobs() -> int:
+    return int(_f('SKYTPU_JOBS_MAX_ALIVE', 16))
+
+
+JOB_CLUSTER_NAME_PREFIX = 'skytpu-job-'

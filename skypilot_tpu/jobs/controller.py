@@ -1,0 +1,317 @@
+"""The managed-jobs controller: one monitor loop per job.
+
+Counterpart of the reference's sky/jobs/controller.py: `JobsController`
+(:50) and its `_run_one_task` recovery hot loop (:116) — every
+`JOB_STATUS_CHECK_GAP` poll the task cluster's job status; SUCCEEDED →
+clean up and advance the pipeline; cluster preempted/down → RECOVERING →
+`strategy.recover()`; user-code failure → consume `max_restarts_on_errors`
+credits or fail the job.
+
+Deployment shift vs the reference: the reference runs this file on a
+*controller VM* (a cluster provisioned just to babysit jobs); here the
+controller runs as a detached local process (`python -m
+skypilot_tpu.jobs.controller --job-id N`) or an in-process thread —
+clients are assumed long-lived (workstation/CI), and nothing in the loop
+needs cloud-side placement.  All state is SQLite (jobs/state.py), so a
+controller process can be restarted and resume monitoring.
+"""
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+import traceback
+import typing
+from typing import Optional
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu.backend import tpu_gang_backend
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import dag_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+# Agent-side job statuses (skypilot_tpu/agent/job_lib.py JobStatus values).
+_TERMINAL_OK = ('SUCCEEDED',)
+_TERMINAL_USER_FAIL = ('FAILED',)
+_TERMINAL_SETUP_FAIL = ('FAILED_SETUP',)
+_TERMINAL_INFRA_FAIL = ('FAILED_DRIVER', 'CANCELLED')
+# RPC failures tolerated before we treat the cluster as down even though
+# the provider still reports it running.
+_MAX_RPC_FAILURES = 3
+
+
+class JobsController:
+    """Monitors and recovers one managed job (possibly a pipeline)."""
+
+    def __init__(self, job_id: int, dag: 'dag_lib.Dag') -> None:
+        self._job_id = job_id
+        self._dag = dag
+        self._backend = tpu_gang_backend.TpuGangBackend()
+        self._strategy: Optional[recovery_strategy.StrategyExecutor] = None
+
+    # -- helpers -----------------------------------------------------------
+    def _event(self, event: str, **kv) -> None:
+        jobs_state.append_event(self._job_id, event, **kv)
+
+    def _cluster_name(self, task_id: int) -> str:
+        base = getattr(self._dag, 'name', None) or 'job'
+        return (f'{constants.JOB_CLUSTER_NAME_PREFIX}'
+                f'{common_utils.make_cluster_name_on_cloud(base, 20)}'
+                f'-{self._job_id}-{task_id}')
+
+    def _cluster_is_up(self, cluster_name: str) -> bool:
+        """Cloud-truth liveness: refresh reconciles DB with the provider
+        (preempted TPU slices disappear entirely — core.py refresh)."""
+        try:
+            record = core.refresh_cluster_record(cluster_name)
+        except Exception:  # noqa: BLE001
+            return False
+        return (record is not None and
+                record['status'] == global_user_state.ClusterStatus.UP)
+
+    def _poll_job_status(self, cluster_name: str,
+                         job_id_on_cluster: int) -> Optional[str]:
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        if record is None:
+            return None
+        statuses = self._backend.get_job_status(record['handle'],
+                                                [job_id_on_cluster])
+        return statuses.get(job_id_on_cluster)
+
+    # -- the hot loop ------------------------------------------------------
+    def run(self) -> None:
+        """Run all pipeline stages; record terminal state and release the
+        scheduler slot no matter what (reference JobsController.run,
+        controller.py:369)."""
+        import networkx as nx
+        try:
+            order = list(nx.topological_sort(self._dag.get_graph()))
+            for task_id, task in enumerate(order):
+                if not self._run_one_task(task_id, task):
+                    return
+        except Exception as e:  # noqa: BLE001
+            logger.error(f'Managed job {self._job_id} controller error: '
+                         f'{e}\n{traceback.format_exc()}')
+            jobs_state.set_failed(
+                self._job_id, None,
+                jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                f'Controller crashed: {e}')
+            if self._strategy is not None:
+                self._strategy.cleanup_cluster()
+        finally:
+            scheduler.job_done(self._job_id)
+
+    def _handle_cancel(self, task_id: int, cluster_name: str) -> None:
+        self._event('cancelling', task_id=task_id)
+        jobs_state.set_cancelling(self._job_id)
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        if record is not None:
+            try:
+                self._backend.cancel_jobs(record['handle'], all_jobs=True)
+            except Exception:  # noqa: BLE001
+                pass
+        if self._strategy is not None:
+            self._strategy.cleanup_cluster()
+        jobs_state.set_cancelled(self._job_id)
+        jobs_state.clear_cancel(self._job_id)
+        self._event('cancelled', task_id=task_id)
+
+    def _run_one_task(self, task_id: int, task: 'task_lib.Task') -> bool:
+        """Returns True iff the task SUCCEEDED (reference _run_one_task,
+        controller.py:116)."""
+        job_id = self._job_id
+        cluster_name = self._cluster_name(task_id)
+        strategy = recovery_strategy.StrategyExecutor.make(
+            cluster_name, task)
+        strategy.should_abort = \
+            lambda: jobs_state.cancel_requested(job_id)
+        self._strategy = strategy
+        jobs_state.set_submitted(job_id, task_id, cluster_name)
+        self._event('submitted', task_id=task_id, cluster=cluster_name)
+
+        jobs_state.set_starting(job_id, task_id)
+        try:
+            with scheduler.scheduled_launch(job_id):
+                start_time = strategy.launch()
+        except exceptions.ManagedJobCancelledError:
+            self._handle_cancel(task_id, cluster_name)
+            return False
+        except exceptions.ManagedJobReachedMaxRetriesError as e:
+            jobs_state.set_failed(
+                job_id, task_id,
+                jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
+            return False
+        except (exceptions.TaskValidationError,
+                exceptions.ResourcesValidationError,
+                exceptions.InvalidCloudCredentials) as e:
+            jobs_state.set_failed(
+                job_id, task_id,
+                jobs_state.ManagedJobStatus.FAILED_PRECHECKS, str(e))
+            return False
+        except exceptions.CommandError as e:
+            # Only setup failures propagate as CommandError out of the
+            # strategy's launch loop (recovery_strategy._launch).
+            jobs_state.set_failed(
+                job_id, task_id,
+                jobs_state.ManagedJobStatus.FAILED_SETUP,
+                f'Setup failed: {e}')
+            return False
+        jobs_state.set_started(job_id, task_id, start_time)
+        self._event('started', task_id=task_id)
+
+        rpc_failures = 0
+        gap = constants.job_status_check_gap_seconds()
+        while True:
+            if jobs_state.cancel_requested(job_id):
+                self._handle_cancel(task_id, cluster_name)
+                return False
+            time.sleep(gap)
+
+            status: Optional[str] = None
+            rpc_ok = True
+            try:
+                assert strategy.job_id_on_cluster is not None
+                status = self._poll_job_status(cluster_name,
+                                               strategy.job_id_on_cluster)
+            except Exception as e:  # noqa: BLE001
+                rpc_ok = False
+                logger.debug(f'Status poll failed for {cluster_name}: {e}')
+
+            if status in _TERMINAL_OK:
+                jobs_state.set_succeeded(job_id, task_id, time.time())
+                self._event('succeeded', task_id=task_id)
+                strategy.cleanup_cluster()
+                return True
+
+            if status in _TERMINAL_USER_FAIL:
+                if strategy.should_restart_on_failure():
+                    self._event('restart_on_failure', task_id=task_id,
+                                attempt=strategy.restart_cnt_on_failure)
+                    if self._recover(task_id, strategy) is None:
+                        return False
+                    rpc_failures = 0
+                    continue
+                jobs_state.set_failed(
+                    job_id, task_id, jobs_state.ManagedJobStatus.FAILED,
+                    'User program exited non-zero (restart budget '
+                    'exhausted).')
+                strategy.cleanup_cluster()
+                return False
+
+            if status in _TERMINAL_SETUP_FAIL:
+                # Setup failures do not heal on relaunch (same setup
+                # script would fail again) — reference fails fast here.
+                jobs_state.set_failed(
+                    job_id, task_id,
+                    jobs_state.ManagedJobStatus.FAILED_SETUP,
+                    'Setup script exited non-zero.')
+                strategy.cleanup_cluster()
+                return False
+
+            if status in _TERMINAL_INFRA_FAIL:
+                # Driver died / job cancelled out from under us: infra
+                # fault → recover (reference treats non-user terminal as
+                # recoverable).
+                self._event('infra_failure', task_id=task_id,
+                            status=status)
+                if self._recover(task_id, strategy) is None:
+                    return False
+                rpc_failures = 0
+                continue
+
+            if status is not None:
+                # PENDING / SETTING_UP / RUNNING — healthy.
+                rpc_failures = 0
+                continue
+
+            # status is None: job missing or cluster unreachable (rpc_ok
+            # distinguishes the two only for logging).
+            del rpc_ok
+            rpc_failures += 1
+            if rpc_failures < _MAX_RPC_FAILURES and \
+                    self._cluster_is_up(cluster_name):
+                # Transient agent hiccup on a live cluster.
+                continue
+            # Cloud truth says down (or repeated failures): preemption.
+            self._event('preemption_detected', task_id=task_id)
+            if self._recover(task_id, strategy) is None:
+                return False
+            rpc_failures = 0
+
+    def _recover(self, task_id: int,
+                 strategy: recovery_strategy.StrategyExecutor
+                 ) -> Optional[float]:
+        """Returns the new start time, or None if a cancel interrupted
+        the recovery (the job is then already CANCELLED)."""
+        jobs_state.set_recovering(self._job_id, task_id)
+        self._event('recovering', task_id=task_id)
+        try:
+            with scheduler.scheduled_launch(self._job_id):
+                start_time = strategy.recover()
+        except exceptions.ManagedJobCancelledError:
+            self._handle_cancel(task_id, strategy.cluster_name)
+            return None
+        except exceptions.CommandError as e:
+            jobs_state.set_failed(
+                self._job_id, task_id,
+                jobs_state.ManagedJobStatus.FAILED_SETUP,
+                f'Setup failed during recovery: {e}')
+            strategy.cleanup_cluster()
+            return None
+        jobs_state.set_recovered(self._job_id, task_id, start_time)
+        self._event('recovered', task_id=task_id)
+        return start_time
+
+
+def run_controller(job_id: int) -> None:
+    """Entry point: load the job's DAG and run the controller to
+    completion (process mode target)."""
+    info = jobs_state.get_job_info(job_id)
+    if info is None:
+        raise exceptions.ManagedJobStatusError(f'No managed job {job_id}.')
+    dag = dag_utils.load_chain_dag_from_yaml(info['dag_yaml_path'])
+    JobsController(job_id, dag).run()
+
+
+_ACTIVE_THREADS: list = []
+
+
+def start_controller_thread(job_id: int) -> threading.Thread:
+    t = threading.Thread(target=run_controller, args=(job_id,),
+                         name=f'jobs-controller-{job_id}', daemon=True)
+    _ACTIVE_THREADS.append(t)
+    t.start()
+    return t
+
+
+def join_all_controller_threads(timeout: float = 30.0) -> None:
+    """Join thread-mode controllers (test teardown: prevents a lingering
+    controller from writing into the next test's state dir)."""
+    deadline = time.time() + timeout
+    for t in list(_ACTIVE_THREADS):
+        t.join(max(0.0, deadline - time.time()))
+        if not t.is_alive():
+            _ACTIVE_THREADS.remove(t)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    run_controller(args.job_id)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,156 @@
+"""Managed-jobs SDK: launch / queue / cancel / tail_logs.
+
+Counterpart of the reference's sky/jobs/core.py (launch :39, queue,
+cancel, tail_logs).  The reference ships the DAG to a controller VM via a
+rendered `jobs-controller.yaml.j2` task; here the controller is a local
+detached process (or thread — see jobs/controller.py module docstring),
+so launch = persist DAG YAML + rows, then start the controller.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import controller as controller_lib
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.utils import dag_utils
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
+           name: Optional[str] = None,
+           controller_mode: str = 'process') -> int:
+    """Submit a managed job; returns its managed-job id immediately
+    (recovery runs in the controller, not the caller).
+
+    controller_mode: 'process' (default; detached, survives the caller),
+    'thread' (daemon thread — hermetic tests), or 'inline' (block until
+    the job reaches a terminal state).
+    """
+    dag = dag_utils.convert_entrypoint_to_dag(entrypoint)
+    dag.validate()
+    if not dag.is_chain():
+        raise exceptions.NotSupportedError(
+            'Managed jobs support single tasks and chain pipelines only.')
+    if name is not None:
+        dag.name = name
+    for t in dag.tasks:
+        t.validate()
+
+    dags_dir = os.path.join(jobs_state.jobs_dir(), 'dags')
+    os.makedirs(dags_dir, exist_ok=True)
+    dag_yaml_path = os.path.join(dags_dir, f'dag-{uuid.uuid4().hex}.yaml')
+    dag_utils.dump_chain_dag_to_yaml(dag, dag_yaml_path)
+
+    job_id = jobs_state.set_job_info(dag.name, dag_yaml_path)
+    import networkx as nx
+    order = list(nx.topological_sort(dag.get_graph()))
+    for task_id, t in enumerate(order):
+        rs = ', '.join(str(r) for r in t.get_preferred_resources())
+        jobs_state.set_pending(job_id, task_id, t.name, rs)
+
+    if controller_mode == 'process':
+        log_path = jobs_state.controller_log_path(job_id)
+        pid = subprocess_utils.launch_new_process_tree(
+            f'{sys.executable} -m skypilot_tpu.jobs.controller '
+            f'--job-id {job_id}', log_output=log_path + '.stderr')
+        jobs_state.set_controller_pid(job_id, pid)
+    elif controller_mode == 'thread':
+        controller_lib.start_controller_thread(job_id)
+    elif controller_mode == 'inline':
+        controller_lib.run_controller(job_id)
+    else:
+        raise ValueError(f'Unknown controller_mode {controller_mode!r}')
+    logger.info(f'Managed job {job_id} ({dag.name or "unnamed"}) '
+                f'submitted ({controller_mode} controller).')
+    return job_id
+
+
+def queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
+    """All managed jobs, newest first (reference jobs/core.py queue)."""
+    jobs = jobs_state.get_managed_jobs()
+    if skip_finished:
+        jobs = [j for j in jobs if not j['status'].is_terminal()]
+    return jobs
+
+
+def get_status(job_id: int) -> Optional[jobs_state.ManagedJobStatus]:
+    return jobs_state.get_status(job_id)
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           name: Optional[str] = None,
+           all_jobs: bool = False) -> List[int]:
+    """Signal cancellation; the controller tears the task cluster down
+    (reference jobs/core.py cancel)."""
+    if all_jobs:
+        job_ids = sorted({j['job_id'] for j in jobs_state.get_managed_jobs()
+                          if not j['status'].is_terminal()})
+    elif name is not None:
+        job_ids = jobs_state.get_job_ids_by_name(name)
+        if not job_ids:
+            raise exceptions.ManagedJobStatusError(
+                f'No managed job named {name!r}.')
+    if not job_ids:
+        return []
+    cancelled = []
+    for job_id in job_ids:
+        st = jobs_state.get_status(job_id)
+        if st is None or st.is_terminal():
+            continue
+        jobs_state.signal_cancel(job_id)
+        cancelled.append(job_id)
+    return cancelled
+
+
+def wait(job_id: int, timeout: float = 300.0,
+         poll_seconds: float = 0.5) -> jobs_state.ManagedJobStatus:
+    """Block until the managed job reaches a terminal state (test/CI
+    convenience; the reference exposes this only via `--follow` log
+    streaming)."""
+    deadline = time.time() + timeout
+    while True:
+        st = jobs_state.get_status(job_id)
+        if st is not None and st.is_terminal():
+            return st
+        if time.time() > deadline:
+            raise TimeoutError(
+                f'Managed job {job_id} still {st} after {timeout}s.')
+        time.sleep(poll_seconds)
+
+
+def tail_logs(job_id: Optional[int] = None, name: Optional[str] = None,
+              controller: bool = False) -> str:
+    """Return the job's logs: controller event log (controller=True) or
+    the task cluster's run log if the cluster is still up."""
+    if job_id is None:
+        if name is None:
+            raise ValueError('Provide job_id or name.')
+        ids = jobs_state.get_job_ids_by_name(name)
+        if not ids:
+            raise exceptions.ManagedJobStatusError(
+                f'No managed job named {name!r}.')
+        job_id = ids[0]
+    if controller:
+        path = jobs_state.controller_log_path(job_id)
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                return f.read()
+        return ''
+    from skypilot_tpu import core as sky_core
+    from skypilot_tpu import global_user_state
+    for row in jobs_state.get_job_tasks(job_id):
+        cluster = row['cluster_name']
+        if cluster and global_user_state.get_cluster_from_name(cluster):
+            sky_core.tail_logs(cluster, follow=False)
+            return ''
+    return ''

@@ -1,0 +1,259 @@
+"""Preemption-recovery strategies for managed jobs.
+
+Counterpart of the reference's sky/jobs/recovery_strategy.py: a
+`StrategyExecutor` base with a name registry (`__init_subclass__`,
+recovery_strategy.py:71), `FAILOVER` (:388 — retry the last-used
+region/zone first, then fail over) and `EAGER_NEXT_REGION` (:471, the
+default — immediately blocklist the preempted region and move on, because
+a preempted zone usually stays capacity-starved for a while).
+
+TPU-specific semantics baked in:
+  - a preempted TPU-VM slice must be *deleted*, never stopped
+    (`Resources.need_cleanup_after_preemption_or_failure`, reference
+    resources.py:633) — `cleanup_cluster()` always terminates;
+  - a slice fails as a unit, so "partially alive" clusters are treated as
+    down and relaunched.
+"""
+from __future__ import annotations
+
+import time
+import typing
+from typing import Any, Dict, Optional, Set
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.jobs import state as jobs_state
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.backend import backend as backend_lib
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_RECOVERY_STRATEGY = 'EAGER_NEXT_REGION'
+
+RECOVERY_STRATEGIES: Dict[str, type] = {}
+
+
+class StrategyExecutor:
+    """Handles launch / monitor-observed-failure / recover for one task
+    (reference StrategyExecutor, recovery_strategy.py:46)."""
+
+    NAME = '_ABSTRACT'
+
+    def __init__(self, cluster_name: str, task: 'task_lib.Task',
+                 max_restarts_on_errors: int = 0) -> None:
+        self.cluster_name = cluster_name
+        self.task = task
+        self.max_restarts_on_errors = max_restarts_on_errors
+        self.restart_cnt_on_failure = 0
+        # Job id of the task's run in the cluster-side agent queue,
+        # refreshed on every (re)launch; the controller polls it.
+        self.job_id_on_cluster: Optional[int] = None
+        # Set by the controller: checked between retries so a cancel can
+        # interrupt an endless capacity-starved launch loop.
+        self.should_abort: Optional[Any] = None
+
+    def __init_subclass__(cls) -> None:
+        if cls.NAME != '_ABSTRACT':
+            RECOVERY_STRATEGIES[cls.NAME] = cls
+
+    @classmethod
+    def make(cls, cluster_name: str,
+             task: 'task_lib.Task') -> 'StrategyExecutor':
+        """Build the executor named by the task's `job_recovery` config
+        (reference recovery_strategy.py:79 make)."""
+        recovery: Dict[str, Any] = {}
+        for r in task.get_preferred_resources():
+            if r.job_recovery:
+                recovery = dict(r.job_recovery)
+                break
+        name = recovery.pop('strategy', DEFAULT_RECOVERY_STRATEGY) or \
+            DEFAULT_RECOVERY_STRATEGY
+        if name not in RECOVERY_STRATEGIES:
+            raise exceptions.ManagedJobStatusError(
+                f'Unknown recovery strategy {name!r}; available: '
+                f'{sorted(RECOVERY_STRATEGIES)}')
+        max_restarts = int(recovery.pop('max_restarts_on_errors', 0))
+        return RECOVERY_STRATEGIES[name](cluster_name, task,
+                                         max_restarts_on_errors=max_restarts)
+
+    # -- public API used by the controller ---------------------------------
+    def launch(self) -> float:
+        """First launch.  Returns the job start timestamp."""
+        t = self._launch(max_retry=constants.launch_max_retry(),
+                         raise_on_failure=True)
+        assert t is not None
+        return t
+
+    def recover(self) -> float:
+        """Relaunch after preemption/failure; returns new start timestamp.
+        Subclasses implement placement policy."""
+        raise NotImplementedError
+
+    def should_restart_on_failure(self) -> bool:
+        """User-code failure budget (reference recovery_strategy.py:229):
+        consume one restart credit; False once exhausted."""
+        self.restart_cnt_on_failure += 1
+        return self.restart_cnt_on_failure <= self.max_restarts_on_errors
+
+    def cleanup_cluster(self) -> None:
+        """Terminate the task cluster (always terminate — TPU slices
+        cannot be meaningfully stopped after preemption)."""
+        try:
+            core.down(self.cluster_name, purge=True)
+        except (exceptions.ClusterDoesNotExist, exceptions.ClusterNotUpError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f'cleanup of {self.cluster_name} failed: {e}')
+
+    # -- shared launch machinery -------------------------------------------
+    def _launch(self, max_retry: Optional[int] = 3,
+                raise_on_failure: bool = True,
+                blocked_resources: Optional[
+                    Set['resources_lib.Resources']] = None
+                ) -> Optional[float]:
+        """Launch with retries + backoff (reference _launch,
+        recovery_strategy.py:239).  Returns job start time, or None if
+        all retries exhausted and raise_on_failure=False."""
+        backoff = constants.launch_retry_backoff_seconds()
+        attempt = 0
+        while True:
+            attempt += 1
+            if self.should_abort is not None and self.should_abort():
+                raise exceptions.ManagedJobCancelledError(
+                    f'Cancel requested while launching '
+                    f'{self.cluster_name}.')
+            try:
+                # Detached run: the controller monitors via job status
+                # polls, never holds a streaming connection.
+                job_id, _ = execution.launch(
+                    self.task,
+                    cluster_name=self.cluster_name,
+                    detach_run=True,
+                    stream_logs=False,
+                    quiet_optimizer=True,
+                    blocked_resources=blocked_resources)
+                self.job_id_on_cluster = job_id
+                return time.time()
+            except exceptions.ResourcesUnavailableError as e:
+                logger.info(
+                    f'Launch attempt {attempt} for {self.cluster_name} '
+                    f'found no resources: {e}')
+            except (exceptions.InvalidCloudCredentials,
+                    exceptions.TaskValidationError,
+                    exceptions.ResourcesValidationError) as e:
+                # Precheck-class errors never heal by retrying.
+                if raise_on_failure:
+                    raise
+                logger.warning(f'Precheck failure: {e}')
+                return None
+            except exceptions.CommandError as e:
+                if e.command.startswith('setup on'):
+                    # Setup scripts fail deterministically — a relaunch
+                    # would run the same script again (the controller
+                    # maps this to FAILED_SETUP).
+                    self.cleanup_cluster()
+                    raise
+                logger.warning(
+                    f'Launch attempt {attempt} for {self.cluster_name} '
+                    f'failed running commands: {e}')
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    f'Launch attempt {attempt} for {self.cluster_name} '
+                    f'failed: {e}')
+            # Partially-provisioned cluster from the failed attempt must
+            # not leak into the next attempt.
+            self.cleanup_cluster()
+            if max_retry is not None and attempt >= max_retry:
+                if raise_on_failure:
+                    raise exceptions.ManagedJobReachedMaxRetriesError(
+                        f'Failed to launch {self.cluster_name} after '
+                        f'{attempt} attempts.')
+                return None
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 60.0)
+
+
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Retry the same cloud/region first (capacity often returns in
+    place), then fail over anywhere (reference recovery_strategy.py:388)."""
+
+    NAME = 'FAILOVER'
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self._last_launched: Optional['resources_lib.Resources'] = None
+
+    def launch(self) -> float:
+        t = super().launch()
+        self._remember_launched()
+        return t
+
+    def _remember_launched(self) -> None:
+        record = global_user_state.get_cluster_from_name(self.cluster_name)
+        if record is not None:
+            handle: 'backend_lib.ClusterHandle' = record['handle']
+            self._last_launched = handle.launched_resources
+
+    def recover(self) -> float:
+        self.cleanup_cluster()
+        # Step 1: pin to the previously-used region (one quick attempt).
+        if self._last_launched is not None and \
+                self._last_launched.region is not None:
+            saved = list(self.task.get_preferred_resources())
+            self.task.set_resources([
+                r.copy(region=self._last_launched.region, zone=None)
+                for r in saved
+            ])
+            try:
+                t = self._launch(max_retry=1, raise_on_failure=False)
+            finally:
+                self.task.set_resources(saved)
+            if t is not None:
+                self._remember_launched()
+                return t
+        # Step 2: anywhere, forever (retry_until_up semantics).
+        t = self._launch(max_retry=None, raise_on_failure=True)
+        assert t is not None
+        self._remember_launched()
+        return t
+
+
+class EagerNextRegionStrategyExecutor(StrategyExecutor):
+    """Default: on preemption, blocklist the preempted region immediately
+    and re-optimize elsewhere (reference recovery_strategy.py:471 — a
+    just-preempted zone is the *worst* place to retry)."""
+
+    NAME = 'EAGER_NEXT_REGION'
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self._blocked: Set['resources_lib.Resources'] = set()
+
+    def recover(self) -> float:
+        from skypilot_tpu import resources as resources_lib
+        record = global_user_state.get_cluster_from_name(self.cluster_name)
+        if record is not None:
+            handle: 'backend_lib.ClusterHandle' = record['handle']
+            launched = handle.launched_resources
+            if launched is not None and launched.region is not None:
+                self._blocked.add(resources_lib.Resources(
+                    cloud=launched.cloud, region=launched.region))
+        self.cleanup_cluster()
+        # First pass skips the preempted region; if the whole fleet is
+        # starved, fall back to unconstrained retry-forever.
+        t = self._launch(max_retry=constants.launch_max_retry(),
+                         raise_on_failure=False,
+                         blocked_resources=self._blocked or None)
+        if t is not None:
+            return t
+        self._blocked.clear()
+        t = self._launch(max_retry=None, raise_on_failure=True)
+        assert t is not None
+        return t

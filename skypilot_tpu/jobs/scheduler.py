@@ -1,0 +1,68 @@
+"""Controller-wide scheduling of managed jobs.
+
+Counterpart of the reference's sky/jobs/scheduler.py (283 LoC): caps the
+number of concurrent cluster launches (launches are the expensive,
+rate-limited phase) and of alive jobs, using a filelock around the
+schedule-state column in the jobs DB (`maybe_schedule_next_jobs` :71,
+`scheduled_launch` :184).  State machine per job:
+
+    WAITING → LAUNCHING → ALIVE → (LAUNCHING ⇄ ALIVE on recoveries) → DONE
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+import filelock
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.jobs import state as jobs_state
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _can_start_launch() -> bool:
+    launching = jobs_state.count_schedule_states(
+        [jobs_state.ScheduleState.LAUNCHING])
+    alive = jobs_state.count_schedule_states(
+        [jobs_state.ScheduleState.LAUNCHING, jobs_state.ScheduleState.ALIVE])
+    return (launching < constants.max_concurrent_launches() and
+            alive < constants.max_alive_jobs())
+
+
+def wait_until_launchable(job_id: int, poll_seconds: float = 0.5,
+                          timeout: float = 3600.0) -> None:
+    """Block until this job may enter LAUNCHING, then claim the slot."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            with jobs_state.scheduler_lock():
+                if _can_start_launch():
+                    jobs_state.set_schedule_state(
+                        job_id, jobs_state.ScheduleState.LAUNCHING)
+                    return
+        except filelock.Timeout:
+            pass
+        if time.time() > deadline:
+            raise TimeoutError(
+                f'Job {job_id} waited >{timeout}s for a launch slot.')
+        time.sleep(poll_seconds)
+
+
+@contextlib.contextmanager
+def scheduled_launch(job_id: int) -> Iterator[None]:
+    """Launch-slot guard (reference scheduled_launch, scheduler.py:184).
+    On exit the job transitions LAUNCHING→ALIVE (success or not — a
+    failed job is moved to DONE separately by job_done)."""
+    wait_until_launchable(job_id)
+    try:
+        yield
+    finally:
+        jobs_state.set_schedule_state(job_id,
+                                      jobs_state.ScheduleState.ALIVE)
+
+
+def job_done(job_id: int) -> None:
+    jobs_state.set_schedule_state(job_id, jobs_state.ScheduleState.DONE)

@@ -1,0 +1,402 @@
+"""Managed-jobs state: SQLite tables + the ManagedJobStatus state machine.
+
+Counterpart of the reference's sky/jobs/state.py (1,030 LoC): the `spot`
+per-task rows and `job_info` per-job rows, with the
+PENDING→SUBMITTED→STARTING→RUNNING→RECOVERING→terminal lifecycle
+(sky/jobs/state.py:186).  The DB lives client-side (our controller runs
+as a local process/thread rather than on a controller VM — a deliberate
+TPU-native shift: no controller cluster to provision means
+seconds-not-minutes to first recovery loop; process mode keeps the
+reference's isolation).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import pathlib
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import paths
+
+logger = sky_logging.init_logger(__name__)
+
+_lock = threading.RLock()
+
+
+class ManagedJobStatus(enum.Enum):
+    """Reference sky/jobs/state.py:186 ManagedJobStatus."""
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    CANCELLING = 'CANCELLING'
+    # Terminal.
+    SUCCEEDED = 'SUCCEEDED'
+    CANCELLED = 'CANCELLED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_PRECHECKS = 'FAILED_PRECHECKS'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in (self.FAILED, self.FAILED_SETUP,
+                        self.FAILED_PRECHECKS, self.FAILED_NO_RESOURCE,
+                        self.FAILED_CONTROLLER)
+
+    def colored_str(self) -> str:
+        return self.value
+
+
+_TERMINAL = {
+    ManagedJobStatus.SUCCEEDED, ManagedJobStatus.CANCELLED,
+    ManagedJobStatus.FAILED, ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_PRECHECKS, ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER,
+}
+
+
+class ScheduleState(enum.Enum):
+    """Controller-wide scheduling state of a job (reference
+    sky/jobs/scheduler.py state machine)."""
+    WAITING = 'WAITING'
+    LAUNCHING = 'LAUNCHING'
+    ALIVE = 'ALIVE'
+    DONE = 'DONE'
+
+
+def jobs_dir() -> str:
+    d = os.path.join(paths.state_dir(), 'managed_jobs')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _db_path() -> str:
+    return os.path.join(jobs_dir(), 'managed_jobs.db')
+
+
+_local = threading.local()
+
+
+def _conn() -> sqlite3.Connection:
+    """Thread-local cached connection (keyed by DB path — tests swap the
+    state dir per test); schema is created once per connection."""
+    path = _db_path()
+    cache = getattr(_local, 'conns', None)
+    if cache is None:
+        cache = _local.conns = {}
+    conn = cache.get(path)
+    if conn is not None:
+        return conn
+    conn = sqlite3.connect(path, timeout=10)
+    conn.execute("""CREATE TABLE IF NOT EXISTS job_info (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        name TEXT,
+        dag_yaml_path TEXT,
+        schedule_state TEXT DEFAULT 'WAITING',
+        controller_pid INTEGER,
+        submitted_at REAL)""")
+    conn.execute("""CREATE TABLE IF NOT EXISTS spot (
+        job_id INTEGER,
+        task_id INTEGER DEFAULT 0,
+        task_name TEXT,
+        status TEXT,
+        cluster_name TEXT,
+        submitted_at REAL,
+        start_at REAL,
+        end_at REAL,
+        last_recovered_at REAL DEFAULT -1,
+        recovery_count INTEGER DEFAULT 0,
+        failure_reason TEXT,
+        resources_str TEXT,
+        PRIMARY KEY (job_id, task_id))""")
+    conn.commit()
+    cache[path] = conn
+    return conn
+
+
+def reset_for_tests() -> None:
+    with _lock:
+        cache = getattr(_local, 'conns', None)
+        if cache:
+            for conn in cache.values():
+                conn.close()
+            cache.clear()
+        try:
+            os.remove(_db_path())
+        except FileNotFoundError:
+            pass
+        for name in os.listdir(jobs_dir()):
+            if name.startswith('cancel_'):
+                os.remove(os.path.join(jobs_dir(), name))
+
+
+# -- job creation ----------------------------------------------------------
+def set_job_info(name: Optional[str], dag_yaml_path: str) -> int:
+    """Create the job row; returns the new job_id."""
+    with _lock, _conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO job_info (name, dag_yaml_path, submitted_at) '
+            'VALUES (?, ?, ?)', (name, dag_yaml_path, time.time()))
+        return int(cur.lastrowid)
+
+
+def set_pending(job_id: int, task_id: int, task_name: Optional[str],
+                resources_str: str) -> None:
+    with _lock, _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO spot (job_id, task_id, task_name, '
+            'status, submitted_at, resources_str) VALUES (?, ?, ?, ?, ?, ?)',
+            (job_id, task_id, task_name, ManagedJobStatus.PENDING.value,
+             time.time(), resources_str))
+
+
+# -- state transitions (reference state.py set_* family) -------------------
+def _set(job_id: int, task_id: int, **fields: Any) -> None:
+    cols = ', '.join(f'{k} = ?' for k in fields)
+    with _lock, _conn() as conn:
+        conn.execute(
+            f'UPDATE spot SET {cols} WHERE job_id = ? AND task_id = ?',
+            (*fields.values(), job_id, task_id))
+
+
+def set_submitted(job_id: int, task_id: int, cluster_name: str) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.SUBMITTED.value,
+         cluster_name=cluster_name)
+
+
+def set_starting(job_id: int, task_id: int) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.STARTING.value)
+
+
+def set_started(job_id: int, task_id: int, start_time: float) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.RUNNING.value,
+         start_at=start_time, last_recovered_at=start_time)
+
+
+def set_recovering(job_id: int, task_id: int) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.RECOVERING.value)
+
+
+def set_recovered(job_id: int, task_id: int, recovered_time: float) -> None:
+    with _lock, _conn() as conn:
+        conn.execute(
+            'UPDATE spot SET status = ?, last_recovered_at = ?, '
+            'recovery_count = recovery_count + 1 '
+            'WHERE job_id = ? AND task_id = ?',
+            (ManagedJobStatus.RUNNING.value, recovered_time, job_id,
+             task_id))
+
+
+def set_succeeded(job_id: int, task_id: int, end_time: float) -> None:
+    _set(job_id, task_id, status=ManagedJobStatus.SUCCEEDED.value,
+         end_at=end_time)
+
+
+def set_failed(job_id: int, task_id: Optional[int],
+               failure_type: ManagedJobStatus,
+               failure_reason: str,
+               end_time: Optional[float] = None) -> None:
+    assert failure_type.is_failed(), failure_type
+    end_time = time.time() if end_time is None else end_time
+    with _lock, _conn() as conn:
+        where = 'job_id = ?'
+        args: List[Any] = [failure_type.value, failure_reason, end_time,
+                           job_id]
+        if task_id is not None:
+            where += ' AND task_id = ?'
+            args.append(task_id)
+        # Only non-terminal rows move to failed (a SUCCEEDED earlier
+        # pipeline stage stays SUCCEEDED).
+        conn.execute(
+            f'UPDATE spot SET status = ?, failure_reason = ?, end_at = ? '
+            f'WHERE {where} AND status NOT IN '
+            f'({",".join(repr(s.value) for s in _TERMINAL)})', args)
+
+
+def set_cancelling(job_id: int) -> None:
+    with _lock, _conn() as conn:
+        conn.execute(
+            'UPDATE spot SET status = ? WHERE job_id = ? AND status NOT IN '
+            f'({",".join(repr(s.value) for s in _TERMINAL)})',
+            (ManagedJobStatus.CANCELLING.value, job_id))
+
+
+def set_cancelled(job_id: int) -> None:
+    with _lock, _conn() as conn:
+        conn.execute(
+            'UPDATE spot SET status = ?, end_at = ? '
+            'WHERE job_id = ? AND status = ?',
+            (ManagedJobStatus.CANCELLED.value, time.time(), job_id,
+             ManagedJobStatus.CANCELLING.value))
+
+
+# -- queries ---------------------------------------------------------------
+def get_status(job_id: int) -> Optional[ManagedJobStatus]:
+    """Aggregate job status = the first non-terminal task's status, else
+    the last task's terminal status (reference get_status semantics for
+    pipelines)."""
+    rows = get_job_tasks(job_id)
+    if not rows:
+        return None
+    for row in rows:
+        st = ManagedJobStatus(row['status'])
+        if not st.is_terminal():
+            return st
+        if st != ManagedJobStatus.SUCCEEDED:
+            return st
+    return ManagedJobStatus(rows[-1]['status'])
+
+
+def get_job_tasks(job_id: int) -> List[Dict[str, Any]]:
+    with _lock, _conn() as conn:
+        cur = conn.execute(
+            'SELECT job_id, task_id, task_name, status, cluster_name, '
+            'submitted_at, start_at, end_at, last_recovered_at, '
+            'recovery_count, failure_reason, resources_str FROM spot '
+            'WHERE job_id = ? ORDER BY task_id', (job_id,))
+        return [_row_to_dict(r) for r in cur.fetchall()]
+
+
+def get_managed_jobs() -> List[Dict[str, Any]]:
+    """All jobs, newest first, one record per (job, task)."""
+    with _lock, _conn() as conn:
+        cur = conn.execute(
+            'SELECT s.job_id, s.task_id, s.task_name, s.status, '
+            's.cluster_name, s.submitted_at, s.start_at, s.end_at, '
+            's.last_recovered_at, s.recovery_count, s.failure_reason, '
+            's.resources_str, j.name, j.schedule_state, j.controller_pid '
+            'FROM spot s JOIN job_info j ON s.job_id = j.job_id '
+            'ORDER BY s.job_id DESC, s.task_id')
+        out = []
+        for r in cur.fetchall():
+            d = _row_to_dict(r[:12])
+            d['job_name'] = r[12] if r[12] is not None else d['task_name']
+            d['schedule_state'] = r[13]
+            d['controller_pid'] = r[14]
+            out.append(d)
+        return out
+
+
+def get_job_ids_by_name(name: str) -> List[int]:
+    with _lock, _conn() as conn:
+        cur = conn.execute(
+            'SELECT job_id FROM job_info WHERE name = ? '
+            'ORDER BY job_id DESC', (name,))
+        return [int(r[0]) for r in cur.fetchall()]
+
+
+def get_job_info(job_id: int) -> Optional[Dict[str, Any]]:
+    with _lock, _conn() as conn:
+        cur = conn.execute(
+            'SELECT job_id, name, dag_yaml_path, schedule_state, '
+            'controller_pid, submitted_at FROM job_info WHERE job_id = ?',
+            (job_id,))
+        row = cur.fetchone()
+        if row is None:
+            return None
+        return {
+            'job_id': row[0], 'name': row[1], 'dag_yaml_path': row[2],
+            'schedule_state': ScheduleState(row[3]),
+            'controller_pid': row[4], 'submitted_at': row[5],
+        }
+
+
+def _row_to_dict(row: tuple) -> Dict[str, Any]:
+    status = ManagedJobStatus(row[3])
+    end = row[7]
+    start = row[6]
+    duration = (end - start) if (start and end) else (
+        (time.time() - start) if start and not status.is_terminal() else
+        None)
+    return {
+        'job_id': row[0], 'task_id': row[1], 'task_name': row[2],
+        'status': status, 'cluster_name': row[4], 'submitted_at': row[5],
+        'start_at': start, 'end_at': end, 'last_recovered_at': row[8],
+        'recovery_count': row[9], 'failure_reason': row[10],
+        'resources_str': row[11], 'job_duration': duration,
+    }
+
+
+# -- scheduler state (reference sky/jobs/scheduler.py over job_info) -------
+def scheduler_lock() -> filelock.FileLock:
+    return filelock.FileLock(
+        os.path.join(paths.locks_dir(), 'managed_jobs_scheduler.lock'),
+        timeout=30)
+
+
+def set_schedule_state(job_id: int, state: ScheduleState) -> None:
+    with _lock, _conn() as conn:
+        conn.execute(
+            'UPDATE job_info SET schedule_state = ? WHERE job_id = ?',
+            (state.value, job_id))
+
+
+def set_controller_pid(job_id: int, controller_pid: int) -> None:
+    """Record the controller's pid without touching schedule_state (the
+    spawned controller may already have advanced it)."""
+    with _lock, _conn() as conn:
+        conn.execute(
+            'UPDATE job_info SET controller_pid = ? WHERE job_id = ?',
+            (controller_pid, job_id))
+
+
+def count_schedule_states(states: List[ScheduleState]) -> int:
+    with _lock, _conn() as conn:
+        cur = conn.execute(
+            'SELECT COUNT(*) FROM job_info WHERE schedule_state IN '
+            f'({",".join("?" * len(states))})', [s.value for s in states])
+        return int(cur.fetchone()[0])
+
+
+def get_waiting_job_ids() -> List[int]:
+    with _lock, _conn() as conn:
+        cur = conn.execute(
+            'SELECT job_id FROM job_info WHERE schedule_state = ? '
+            'ORDER BY job_id', (ScheduleState.WAITING.value,))
+        return [int(r[0]) for r in cur.fetchall()]
+
+
+# -- cancel signalling (reference jobs/utils.py cancellation file) ---------
+def _cancel_flag_path(job_id: int) -> str:
+    return os.path.join(jobs_dir(), f'cancel_{job_id}')
+
+
+def signal_cancel(job_id: int) -> None:
+    pathlib.Path(_cancel_flag_path(job_id)).touch()
+
+
+def cancel_requested(job_id: int) -> bool:
+    return os.path.exists(_cancel_flag_path(job_id))
+
+
+def clear_cancel(job_id: int) -> None:
+    try:
+        os.remove(_cancel_flag_path(job_id))
+    except FileNotFoundError:
+        pass
+
+
+# -- controller event log (observability; reference logs per-job under
+#    ~/.sky/jobs/) ---------------------------------------------------------
+def controller_log_path(job_id: int) -> str:
+    d = os.path.join(jobs_dir(), 'controller_logs')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'job_{job_id}.log')
+
+
+def append_event(job_id: int, event: str, **kv: Any) -> None:
+    rec = {'ts': time.time(), 'event': event, **kv}
+    with open(controller_log_path(job_id), 'a', encoding='utf-8') as f:
+        f.write(json.dumps(rec) + '\n')

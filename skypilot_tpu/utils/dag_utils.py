@@ -1,0 +1,54 @@
+"""DAG ⇄ multi-document YAML round trip (reference: sky/utils/dag_utils.py
+— first doc carries the dag name, each following doc is one task; chain
+edges are implied by document order)."""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import common_utils
+
+
+def convert_entrypoint_to_dag(
+        entrypoint: Union[task_lib.Task, dag_lib.Dag]) -> dag_lib.Dag:
+    if isinstance(entrypoint, dag_lib.Dag):
+        return entrypoint
+    with dag_lib.Dag() as d:
+        d.add(entrypoint)
+    d.name = entrypoint.name
+    return d
+
+
+def load_chain_dag_from_yaml(path: str,
+                             name: Optional[str] = None) -> dag_lib.Dag:
+    configs = common_utils.read_yaml_all(path)
+    dag_name = name
+    start = 0
+    if configs and configs[0] and 'name' in configs[0] and \
+            'run' not in configs[0] and 'resources' not in configs[0]:
+        if dag_name is None:
+            dag_name = configs[0]['name']
+        start = 1
+    with dag_lib.Dag() as d:
+        prev = None
+        for config in configs[start:]:
+            if not config:
+                continue
+            t = task_lib.Task.from_yaml_config(config)
+            d.add(t)
+            if prev is not None:
+                d.add_edge(prev, t)
+            prev = t
+    d.name = dag_name
+    return d
+
+
+def dump_chain_dag_to_yaml(dag: dag_lib.Dag, path: str) -> None:
+    assert dag.is_chain(), 'Only chain DAGs round-trip to YAML.'
+    docs = [{'name': getattr(dag, 'name', None)}]
+    import networkx as nx
+    order = list(nx.topological_sort(dag.get_graph()))
+    for t in order:
+        docs.append(t.to_yaml_config())
+    common_utils.dump_yaml(path, docs)

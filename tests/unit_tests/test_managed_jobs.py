@@ -1,0 +1,165 @@
+"""Managed-jobs tests: lifecycle, preemption recovery, restarts, cancel.
+
+Hermetic analog of the reference's managed-job smoke tests
+(tests/smoke_tests/test_managed_job.py — which induce preemption by
+*really terminating cloud instances*): here the task clusters are local
+process clusters and preemption = terminating the cluster's instances
+through the provisioner API out from under the controller.
+"""
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_user_state
+from skypilot_tpu import jobs
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.provision.local import instance as local_instance
+
+
+@pytest.fixture(autouse=True)
+def _fast_loops(monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_STATUS_GAP', '0.3')
+    monkeypatch.setenv('SKYTPU_JOBS_LAUNCH_BACKOFF', '0.2')
+    yield
+    # Cancel anything still alive, then join controller threads so they
+    # cannot write into the next test's state dir.
+    from skypilot_tpu.jobs import controller as controller_lib
+    try:
+        jobs.cancel(all_jobs=True)
+    except Exception:  # noqa: BLE001
+        pass
+    controller_lib.join_all_controller_threads(60)
+
+
+def _local_task(run, name=None, **kwargs):
+    t = sky.Task(name=name, run=run)
+    t.set_resources(sky.Resources(cloud='local', **kwargs))
+    return t
+
+
+def _wait(pred, timeout=60, gap=0.2, desc='condition'):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(gap)
+    raise TimeoutError(f'Timed out waiting for {desc}.')
+
+
+def _task_row(job_id, task_id=0):
+    return jobs_state.get_job_tasks(job_id)[task_id]
+
+
+class TestManagedJobs:
+
+    def test_job_succeeds_and_cleans_up(self):
+        job_id = jobs.launch(_local_task('echo managed-ok', name='mj1'),
+                             controller_mode='thread')
+        status = jobs.wait(job_id, timeout=90)
+        assert status == jobs.ManagedJobStatus.SUCCEEDED
+        row = _task_row(job_id)
+        assert row['recovery_count'] == 0
+        # Task cluster is torn down after success.
+        _wait(lambda: global_user_state.get_cluster_from_name(
+            row['cluster_name']) is None, desc='cluster teardown')
+
+    def test_queue_and_get_status(self):
+        job_id = jobs.launch(_local_task('echo q', name='mjq'),
+                             controller_mode='thread')
+        rows = jobs.queue()
+        assert any(r['job_id'] == job_id for r in rows)
+        jobs.wait(job_id, timeout=90)
+        assert jobs.get_status(job_id) == jobs.ManagedJobStatus.SUCCEEDED
+        info = jobs_state.get_job_info(job_id)
+        assert info['schedule_state'] == jobs_state.ScheduleState.DONE
+
+    def test_user_failure_not_recovered(self):
+        job_id = jobs.launch(_local_task('exit 1', name='mjf'),
+                             controller_mode='thread')
+        status = jobs.wait(job_id, timeout=90)
+        assert status == jobs.ManagedJobStatus.FAILED
+        assert _task_row(job_id)['recovery_count'] == 0
+
+    def test_max_restarts_on_errors(self):
+        t = _local_task('exit 1', name='mjr',
+                        job_recovery={'strategy': 'FAILOVER',
+                                      'max_restarts_on_errors': 1})
+        job_id = jobs.launch(t, controller_mode='thread')
+        status = jobs.wait(job_id, timeout=120)
+        assert status == jobs.ManagedJobStatus.FAILED
+        # One restart was consumed: the task was relaunched exactly once.
+        assert _task_row(job_id)['recovery_count'] == 1
+
+    def test_preemption_recovery(self):
+        # Long-running job; we preempt its cluster mid-flight.
+        job_id = jobs.launch(_local_task('sleep 600', name='mjp'),
+                             controller_mode='thread')
+        _wait(lambda: _task_row(job_id)['status'] ==
+              jobs.ManagedJobStatus.RUNNING, timeout=90, desc='RUNNING')
+        cluster_name = _task_row(job_id)['cluster_name']
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        assert record is not None
+        handle = record['handle']
+        # Preemption: the provider terminates the instances externally.
+        local_instance.terminate_instances(handle.cluster_name_on_cloud)
+        _wait(lambda: _task_row(job_id)['recovery_count'] >= 1,
+              timeout=120, desc='recovery')
+        _wait(lambda: _task_row(job_id)['status'] ==
+              jobs.ManagedJobStatus.RUNNING, timeout=90,
+              desc='RUNNING after recovery')
+        # New cluster is a different incarnation and is UP.
+        rec2 = global_user_state.get_cluster_from_name(cluster_name)
+        assert rec2 is not None
+        assert rec2['status'] == global_user_state.ClusterStatus.UP
+        jobs.cancel([job_id])
+        jobs.wait(job_id, timeout=90)
+
+    def test_cancel(self):
+        job_id = jobs.launch(_local_task('sleep 600', name='mjc'),
+                             controller_mode='thread')
+        _wait(lambda: _task_row(job_id)['status'] ==
+              jobs.ManagedJobStatus.RUNNING, timeout=90, desc='RUNNING')
+        cancelled = jobs.cancel([job_id])
+        assert cancelled == [job_id]
+        status = jobs.wait(job_id, timeout=90)
+        assert status == jobs.ManagedJobStatus.CANCELLED
+        row = _task_row(job_id)
+        _wait(lambda: global_user_state.get_cluster_from_name(
+            row['cluster_name']) is None, desc='cluster teardown')
+
+    def test_pipeline_chain(self):
+        a = _local_task('echo stage-a', name='stage-a')
+        b = _local_task('echo stage-b', name='stage-b')
+        with sky.Dag() as d:
+            d.add(a)
+            d.add(b)
+            d.add_edge(a, b)
+        d.name = 'mj-pipe'
+        job_id = jobs.launch(d, controller_mode='thread')
+        status = jobs.wait(job_id, timeout=180)
+        assert status == jobs.ManagedJobStatus.SUCCEEDED
+        rows = jobs_state.get_job_tasks(job_id)
+        assert len(rows) == 2
+        assert all(r['status'] == jobs.ManagedJobStatus.SUCCEEDED
+                   for r in rows)
+
+    def test_cancel_by_name_and_unknown(self):
+        with pytest.raises(Exception):
+            jobs.cancel(name='no-such-job')
+
+    def test_setup_failure_fails_fast(self):
+        t = sky.Task(name='mjs', run='echo never', setup='exit 1')
+        t.set_resources(sky.Resources(cloud='local'))
+        job_id = jobs.launch(t, controller_mode='thread')
+        status = jobs.wait(job_id, timeout=90)
+        assert status == jobs.ManagedJobStatus.FAILED_SETUP
+        # No recovery attempts for setup failures.
+        assert _task_row(job_id)['recovery_count'] == 0
+
+    def test_process_mode_controller(self):
+        job_id = jobs.launch(_local_task('echo proc-mode', name='mjproc'),
+                             controller_mode='process')
+        status = jobs.wait(job_id, timeout=120)
+        assert status == jobs.ManagedJobStatus.SUCCEEDED
+        assert jobs_state.get_job_info(job_id)['controller_pid'] is not None
