@@ -338,15 +338,26 @@ def jobs() -> None:
 @jobs.command(name='launch')
 @click.argument('entrypoint', nargs=-1, required=True)
 @click.option('--name', '-n', default=None)
-@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--detach-run', '-d', is_flag=True, default=False,
+              help='Do not wait for the job to finish.')
 @_add_options([o for o in _RESOURCE_OPTIONS
                if 'name' not in getattr(o, 'name', '')])
 def jobs_launch(entrypoint, name, detach_run, **overrides) -> None:
     """Submit a managed job (auto-recovered on preemption)."""
     from skypilot_tpu.jobs import core as jobs_core
     task = _make_task(entrypoint, name=name, **overrides)
-    job_id = jobs_core.launch(task, name=name, detach_run=detach_run)
+    job_id = jobs_core.launch(task, name=name)
     click.echo(f'Managed job {job_id} submitted.')
+    if not detach_run:
+        while True:
+            try:
+                status = jobs_core.wait(job_id, timeout=3600)
+                break
+            except TimeoutError:
+                continue  # still running; keep waiting
+        click.echo(f'Managed job {job_id} finished: {status.value}')
+        if status.is_failed():
+            sys.exit(1)
 
 
 @jobs.command(name='queue')
@@ -355,7 +366,9 @@ def jobs_queue() -> None:
     from skypilot_tpu.jobs import core as jobs_core
     rows = []
     for j in jobs_core.queue():
-        rows.append((str(j['job_id']), j['job_name'] or '-', j['status'],
+        status_str = j['status'].value if hasattr(j['status'], 'value') \
+            else str(j['status'])
+        rows.append((str(j['job_id']), j['job_name'] or '-', status_str,
                      str(j.get('recovery_count', 0))))
     _print_table(('ID', 'NAME', 'STATUS', 'RECOVERIES'), rows)
 
@@ -371,10 +384,16 @@ def jobs_cancel(job_ids, all_jobs) -> None:
 
 @jobs.command(name='logs')
 @click.argument('job_id', type=int, required=False)
+@click.option('--name', '-n', default=None)
 @click.option('--follow/--no-follow', default=True)
-def jobs_logs(job_id, follow) -> None:
+@click.option('--controller', is_flag=True, default=False,
+              help='Show the recovery controller log instead.')
+def jobs_logs(job_id, name, follow, controller) -> None:
     from skypilot_tpu.jobs import core as jobs_core
-    sys.exit(jobs_core.tail_logs(job_id, follow=follow))
+    out = jobs_core.tail_logs(job_id, name=name, controller=controller,
+                              follow=follow and not controller)
+    if out:
+        click.echo(out)
 
 
 @cli.group()
@@ -397,25 +416,51 @@ def serve_up(entrypoint, service_name, **overrides) -> None:
 @click.argument('service_names', nargs=-1, required=False)
 def serve_status(service_names) -> None:
     from skypilot_tpu.serve import core as serve_core
-    rows = []
-    for s in serve_core.status(list(service_names) or None):
-        rows.append((s['name'], s['status'],
-                     f"{s['ready_replicas']}/{s['total_replicas']}",
-                     s.get('endpoint') or '-'))
-    _print_table(('NAME', 'STATUS', 'REPLICAS', 'ENDPOINT'), rows)
+    from skypilot_tpu.serve import serve_utils
+    records = serve_core.status(list(service_names) or None)
+    click.echo(serve_utils.format_service_table(records))
+    for s in records:
+        if s['replica_info']:
+            click.echo(f'\nReplicas of {s["name"]!r}:')
+            click.echo(serve_utils.format_replica_table(s['name']))
+
+
+@serve.command(name='update')
+@click.argument('service_name', required=True)
+@click.argument('entrypoint', nargs=-1, required=True)
+@_add_options(_RESOURCE_OPTIONS)
+def serve_update(service_name, entrypoint, **overrides) -> None:
+    """Rolling-update a running service to a new task/spec."""
+    from skypilot_tpu.serve import core as serve_core
+    task = _make_task(entrypoint, **overrides)
+    version = serve_core.update(task, service_name)
+    click.echo(f'Service {service_name!r} updating to version {version}.')
+
+
+@serve.command(name='logs')
+@click.argument('service_name', required=True)
+def serve_logs(service_name) -> None:
+    """Show the service runtime log (controller + LB)."""
+    from skypilot_tpu.serve import core as serve_core
+    click.echo(serve_core.tail_logs(service_name))
 
 
 @serve.command(name='down')
-@click.argument('service_names', nargs=-1, required=True)
+@click.argument('service_names', nargs=-1, required=False)
+@click.option('--all', '-a', 'all_services', is_flag=True, default=False)
+@click.option('--purge', is_flag=True, default=False)
 @click.option('--yes', '-y', is_flag=True, default=False)
-def serve_down(service_names, yes) -> None:
+def serve_down(service_names, all_services, purge, yes) -> None:
     from skypilot_tpu.serve import core as serve_core
-    for name in service_names:
-        if not yes:
-            click.confirm(f'Tear down service {name!r}?', default=True,
-                          abort=True)
-        serve_core.down(name)
-        click.echo(f'Service {name!r} torn down.')
+    if not service_names and not all_services:
+        raise click.UsageError('Provide service names or --all.')
+    if not yes:
+        target = ', '.join(service_names) if service_names else 'ALL'
+        click.confirm(f'Tear down service(s) {target}?', default=True,
+                      abort=True)
+    serve_core.down(list(service_names) or None, all_services=all_services,
+                    purge=purge)
+    click.echo('Service(s) torn down.')
 
 
 def _print_table(headers: Tuple[str, ...], rows: List[Tuple]) -> None:

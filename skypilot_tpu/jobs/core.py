@@ -129,9 +129,10 @@ def wait(job_id: int, timeout: float = 300.0,
 
 
 def tail_logs(job_id: Optional[int] = None, name: Optional[str] = None,
-              controller: bool = False) -> str:
+              controller: bool = False, follow: bool = False) -> str:
     """Return the job's logs: controller event log (controller=True) or
-    the task cluster's run log if the cluster is still up."""
+    the task cluster's run log if the cluster is still up (streamed,
+    optionally following, via core.tail_logs)."""
     if job_id is None:
         if name is None:
             raise ValueError('Provide job_id or name.')
@@ -151,6 +152,6 @@ def tail_logs(job_id: Optional[int] = None, name: Optional[str] = None,
     for row in jobs_state.get_job_tasks(job_id):
         cluster = row['cluster_name']
         if cluster and global_user_state.get_cluster_from_name(cluster):
-            sky_core.tail_logs(cluster, follow=False)
+            sky_core.tail_logs(cluster, follow=follow)
             return ''
     return ''

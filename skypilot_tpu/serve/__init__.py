@@ -1,0 +1,15 @@
+"""SkyServe-analog: multi-replica serving with autoscaling + LB
+(reference: sky/serve/, §2.7 of SURVEY.md)."""
+from skypilot_tpu.serve.core import down
+from skypilot_tpu.serve.core import status
+from skypilot_tpu.serve.core import tail_logs
+from skypilot_tpu.serve.core import up
+from skypilot_tpu.serve.core import update
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+__all__ = [
+    'down', 'status', 'tail_logs', 'up', 'update',
+    'ReplicaStatus', 'ServiceStatus', 'SkyServiceSpec',
+]

@@ -1,0 +1,257 @@
+"""Autoscalers: QPS-driven replica-count decisions.
+
+Counterpart of the reference's sky/serve/autoscalers.py: `Autoscaler`
+ABC (:115), `RequestRateAutoscaler` (:431) — target QPS per replica with
+upscale/downscale hysteresis counters (:348-429) — and
+`FallbackRequestRateAutoscaler` (:546) — spot replicas with a base
+on-demand fallback count plus dynamic on-demand backfill while spot
+capacity is preempted.  Decisions are data (`ScaleUp(n)` /
+`ScaleDown(ids)`), applied by the replica manager; the logic is pure so
+it is unit-testable without clusters (mirrors
+tests/test_serve_autoscaler.py in the reference).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve import serve_state
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+logger = sky_logging.init_logger(__name__)
+
+# Statuses that count toward provisioned capacity (anything not on its
+# way out).
+_PROVISIONING_STATUSES = (serve_state.ReplicaStatus.PENDING,
+                          serve_state.ReplicaStatus.PROVISIONING,
+                          serve_state.ReplicaStatus.STARTING)
+# NOT_READY replicas still hold a live cluster: they count as capacity
+# (and are first in line for scale-down) until the prober/preemption
+# path removes them.
+_ALIVE_STATUSES = _PROVISIONING_STATUSES + (
+    serve_state.ReplicaStatus.READY,
+    serve_state.ReplicaStatus.NOT_READY)
+
+
+@dataclasses.dataclass
+class ScaleUpDecision:
+    """Launch `count` new replicas (use_spot per the autoscaler's mix)."""
+    count: int
+    use_spot: bool = False
+
+
+@dataclasses.dataclass
+class ScaleDownDecision:
+    """Terminate these replica ids."""
+    replica_ids: List[int]
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    scale_up: List[ScaleUpDecision] = dataclasses.field(default_factory=list)
+    scale_down: List[ScaleDownDecision] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def is_noop(self) -> bool:
+        return not self.scale_up and not self.scale_down
+
+
+def _alive(replicas: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [r for r in replicas if r['status'] in _ALIVE_STATUSES]
+
+
+def _scale_down_order(replicas: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Broken/youngest first (reference replica_managers scale-down
+    selection: keep the oldest READY replicas)."""
+    order = {s: i for i, s in enumerate(
+        serve_state.ReplicaStatus.scale_down_candidates())}
+    return sorted(replicas,
+                  key=lambda r: (order.get(r['status'], 99),
+                                 -(r['launched_at'] or 0)))
+
+
+class Autoscaler:
+    """Base: fixed replica count = min_replicas (reference
+    autoscalers.py:115 Autoscaler, which serves the no-autoscaling
+    path)."""
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        self.spec = spec
+        self.update_spec(spec)
+
+    def update_spec(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        """Rolling update: adopt the new spec's policy in place."""
+        self.spec = spec
+
+    # -- request-stats intake (from the load balancer sync) ---------------
+    def collect_request_information(
+            self, request_timestamps: List[float]) -> None:
+        del request_timestamps  # fixed-count autoscaler ignores traffic
+
+    def evaluate_scaling(
+            self, replicas: List[Dict[str, Any]]) -> AutoscalerDecision:
+        alive = _alive(replicas)
+        target = self.spec.min_replicas
+        decision = AutoscalerDecision()
+        if len(alive) < target:
+            decision.scale_up.append(
+                ScaleUpDecision(count=target - len(alive)))
+        elif len(alive) > target:
+            excess = _scale_down_order(alive)[:len(alive) - target]
+            decision.scale_down.append(
+                ScaleDownDecision([r['replica_id'] for r in excess]))
+        return decision
+
+    @classmethod
+    def from_spec(cls, spec: 'spec_lib.SkyServiceSpec') -> 'Autoscaler':
+        if spec.target_qps_per_replica is None:
+            return Autoscaler(spec)
+        if (spec.base_ondemand_fallback_replicas > 0 or
+                spec.dynamic_ondemand_fallback):
+            return FallbackRequestRateAutoscaler(spec)
+        return RequestRateAutoscaler(spec)
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """Reference autoscalers.py:431: target = ceil(qps /
+    target_qps_per_replica), bounded to [min, max], applied only after
+    the target has persisted for upscale_delay / downscale_delay
+    seconds (hysteresis counters :348-429)."""
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec',
+                 decision_interval_seconds: float =
+                 constants.AUTOSCALER_INTERVAL_SECONDS,
+                 qps_window_seconds: float =
+                 constants.QPS_WINDOW_SECONDS) -> None:
+        self.decision_interval = decision_interval_seconds
+        self.qps_window = qps_window_seconds
+        self.request_timestamps: List[float] = []
+        self.upscale_counter = 0
+        self.downscale_counter = 0
+        super().__init__(spec)
+
+    def update_spec(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        super().update_spec(spec)
+        self.scale_up_threshold = max(
+            1, int(math.ceil(spec.upscale_delay_seconds /
+                             self.decision_interval)))
+        self.scale_down_threshold = max(
+            1, int(math.ceil(spec.downscale_delay_seconds /
+                             self.decision_interval)))
+
+    def collect_request_information(
+            self, request_timestamps: List[float]) -> None:
+        self.request_timestamps.extend(request_timestamps)
+        cutoff = time.time() - self.qps_window
+        i = 0
+        while (i < len(self.request_timestamps) and
+               self.request_timestamps[i] < cutoff):
+            i += 1
+        del self.request_timestamps[:i]
+
+    def _current_qps(self) -> float:
+        return len(self.request_timestamps) / self.qps_window
+
+    def _raw_target(self) -> int:
+        qps = self._current_qps()
+        assert self.spec.target_qps_per_replica is not None
+        target = int(math.ceil(qps / self.spec.target_qps_per_replica))
+        # Spec validation requires max_replicas with autoscaling; the
+        # fallback (no scaling beyond min) is defense in depth.
+        max_r = (self.spec.max_replicas
+                 if self.spec.max_replicas is not None
+                 else self.spec.min_replicas)
+        return max(self.spec.min_replicas, min(max_r, target))
+
+    def _hysteresis_target(self, current: int) -> int:
+        """Move toward _raw_target only after it has persisted for the
+        configured number of consecutive decisions."""
+        target = self._raw_target()
+        if target > current:
+            self.upscale_counter += 1
+            self.downscale_counter = 0
+            if self.upscale_counter >= self.scale_up_threshold:
+                self.upscale_counter = 0
+                return target
+        elif target < current:
+            self.downscale_counter += 1
+            self.upscale_counter = 0
+            if self.downscale_counter >= self.scale_down_threshold:
+                self.downscale_counter = 0
+                return target
+        else:
+            self.upscale_counter = self.downscale_counter = 0
+        return current
+
+    def evaluate_scaling(
+            self, replicas: List[Dict[str, Any]]) -> AutoscalerDecision:
+        alive = _alive(replicas)
+        current = len(alive)
+        # Below min is not subject to hysteresis (cold start / failures).
+        if current < self.spec.min_replicas:
+            return AutoscalerDecision(scale_up=[ScaleUpDecision(
+                count=self.spec.min_replicas - current)])
+        target = self._hysteresis_target(current)
+        decision = AutoscalerDecision()
+        if target > current:
+            decision.scale_up.append(ScaleUpDecision(count=target - current))
+        elif target < current:
+            excess = _scale_down_order(alive)[:current - target]
+            decision.scale_down.append(
+                ScaleDownDecision([r['replica_id'] for r in excess]))
+        return decision
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Reference autoscalers.py:546: serve traffic on spot replicas with
+    `base_ondemand_fallback_replicas` always-on on-demand replicas;
+    with `dynamic_ondemand_fallback`, temporarily backfill on-demand
+    replicas 1:1 while spot replicas are provisioning/preempted."""
+
+    def evaluate_scaling(
+            self, replicas: List[Dict[str, Any]]) -> AutoscalerDecision:
+        alive = _alive(replicas)
+        spot = [r for r in alive if r['is_spot']]
+        ondemand = [r for r in alive if not r['is_spot']]
+        num_ready_spot = sum(
+            1 for r in spot
+            if r['status'] == serve_state.ReplicaStatus.READY)
+
+        current = len(alive)
+        if current < self.spec.min_replicas:
+            target_total = self.spec.min_replicas
+        else:
+            target_total = self._hysteresis_target(current)
+
+        base_od = min(self.spec.base_ondemand_fallback_replicas,
+                      target_total)
+        target_spot = target_total - base_od
+        target_od = base_od
+        if self.spec.dynamic_ondemand_fallback:
+            # Backfill on-demand for every target spot replica not READY.
+            target_od += max(0, target_spot - num_ready_spot)
+
+        decision = AutoscalerDecision()
+        if len(spot) < target_spot:
+            decision.scale_up.append(ScaleUpDecision(
+                count=target_spot - len(spot), use_spot=True))
+        elif len(spot) > target_spot:
+            excess = _scale_down_order(spot)[:len(spot) - target_spot]
+            decision.scale_down.append(
+                ScaleDownDecision([r['replica_id'] for r in excess]))
+        if len(ondemand) < target_od:
+            decision.scale_up.append(ScaleUpDecision(
+                count=target_od - len(ondemand), use_spot=False))
+        elif len(ondemand) > target_od:
+            excess = _scale_down_order(ondemand)[:len(ondemand) - target_od]
+            decision.scale_down.append(
+                ScaleDownDecision([r['replica_id'] for r in excess]))
+        return decision

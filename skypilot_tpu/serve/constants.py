@@ -1,0 +1,33 @@
+"""Serve subsystem constants (reference: sky/serve/constants.py)."""
+
+# Port ranges for locally-hosted control processes.  The reference runs
+# the controller/LB on a dedicated controller VM with fixed ports
+# (sky/serve/constants.py); here the control plane may share a host with
+# other services, so each service gets the next free port in the range.
+CONTROLLER_PORT_START = 20001
+LOAD_BALANCER_PORT_START = 30001
+
+# Replica port range used for local-cloud replicas (every replica shares
+# the host's network namespace, so each needs its own port).  On real
+# clouds every replica has its own IP and the service spec's single port
+# is used as-is.
+LOCAL_REPLICA_PORT_START = 40001
+
+# Controller loop intervals (seconds).  The reference probes every 10 s
+# and runs the autoscaler every 20 s (sky/serve/constants.py); tests
+# override these to sub-second via ControllerConfig.
+AUTOSCALER_INTERVAL_SECONDS = 20.0
+PROBE_INTERVAL_SECONDS = 10.0
+LB_SYNC_INTERVAL_SECONDS = 20.0
+
+# Consecutive probe failures before READY -> NOT_READY.
+PROBE_FAILURE_THRESHOLD = 3
+
+# QPS window for autoscaling decisions (reference
+# autoscalers.py qps_window_size = 60).
+QPS_WINDOW_SECONDS = 60.0
+
+# Env vars injected into replica tasks.
+REPLICA_PORT_ENV = 'SKYTPU_SERVE_REPLICA_PORT'
+REPLICA_ID_ENV = 'SKYTPU_SERVE_REPLICA_ID'
+SERVICE_NAME_ENV = 'SKYTPU_SERVE_SERVICE_NAME'

@@ -1,0 +1,214 @@
+"""SkyServe controller: autoscaler loop + LB sync endpoint.
+
+Counterpart of the reference's sky/serve/controller.py:36
+`SkyServeController` — a small HTTP app exposing
+`/controller/load_balancer_sync` (the LB posts request timestamps, gets
+back the ready-replica set) and `/controller/update_service`, plus a
+periodic `_run_autoscaler` loop (:64) that feeds request stats into the
+autoscaler and applies its decisions through the replica manager.
+
+Built on stdlib http.server (threaded) instead of FastAPI/uvicorn: the
+control plane has no dependency beyond the framework itself.
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import serve_state
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+class SkyServeController:
+
+    def __init__(self, service_name: str, spec: 'spec_lib.SkyServiceSpec',
+                 task_yaml_path: str, port: int,
+                 autoscaler_interval_seconds: float =
+                 constants.AUTOSCALER_INTERVAL_SECONDS,
+                 probe_interval_seconds: float =
+                 constants.PROBE_INTERVAL_SECONDS) -> None:
+        self.service_name = service_name
+        self.port = port
+        self.autoscaler_interval = autoscaler_interval_seconds
+        self.probe_interval = probe_interval_seconds
+        self.replica_manager = replica_managers.ReplicaManager(
+            service_name, spec, task_yaml_path)
+        self.autoscaler = autoscalers.Autoscaler.from_spec(spec)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+
+    # -- loops -------------------------------------------------------------
+    def _autoscaler_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._run_autoscaler_once()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'Autoscaler iteration failed: {e}')
+            self._stop.wait(self.autoscaler_interval)
+
+    def _run_autoscaler_once(self) -> None:
+        # Scaling decisions consider only current-version replicas;
+        # old-version replicas keep serving (surge) and are removed by
+        # the drain path below once the new version has READY capacity
+        # (reference replica_managers.py:1172 rolling update).
+        version = self.replica_manager.version
+        replicas = [r for r in serve_state.get_replicas(self.service_name)
+                    if r['version'] == version]
+        decision = self.autoscaler.evaluate_scaling(replicas)
+        for up in decision.scale_up:
+            for _ in range(up.count):
+                rid = self.replica_manager.launch_replica(
+                    use_spot=up.use_spot)
+                logger.info(f'Scaling up {self.service_name}: replica '
+                            f'{rid} (spot={up.use_spot}).')
+        for down in decision.scale_down:
+            for rid in down.replica_ids:
+                logger.info(f'Scaling down {self.service_name}: replica '
+                            f'{rid}.')
+                self.replica_manager.scale_down_replica(rid)
+        # Rolling update: drain old-version replicas once the new
+        # version has enough READY capacity.
+        for rid in self.replica_manager.old_version_replicas_to_drain():
+            logger.info(f'Rolling update: draining old replica {rid}.')
+            self.replica_manager.scale_down_replica(rid)
+        # PREEMPTED rows are informational while the replacement is in
+        # flight; purge them once READY capacity is restored so the
+        # replica table doesn't grow without bound on spotty services.
+        n_ready = sum(1 for r in replicas
+                      if r['status'] == serve_state.ReplicaStatus.READY)
+        if n_ready >= self.autoscaler.spec.min_replicas:
+            for r in serve_state.get_replicas(self.service_name):
+                if r['status'] == serve_state.ReplicaStatus.PREEMPTED:
+                    serve_state.remove_replica(self.service_name,
+                                               r['replica_id'])
+        self._refresh_service_status()
+
+    def _prober_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.replica_manager.probe_all()
+                self._refresh_service_status()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'Prober iteration failed: {e}')
+            self._stop.wait(self.probe_interval)
+
+    def _refresh_service_status(self) -> None:
+        record = serve_state.get_service(self.service_name)
+        if record is None or record['status'] in (
+                serve_state.ServiceStatus.SHUTTING_DOWN,):
+            return
+        replicas = serve_state.get_replicas(self.service_name)
+        n_ready = sum(1 for r in replicas
+                      if r['status'] == serve_state.ReplicaStatus.READY)
+        alive = [r for r in replicas if not r['status'].is_terminal()]
+        if n_ready > 0:
+            status = serve_state.ServiceStatus.READY
+        elif alive:
+            status = serve_state.ServiceStatus.REPLICA_INIT
+        elif replicas and all(r['status'].is_terminal() for r in replicas):
+            status = serve_state.ServiceStatus.FAILED
+        else:
+            status = serve_state.ServiceStatus.NO_REPLICA
+        if status != record['status']:
+            serve_state.set_service_status(self.service_name, status)
+
+    # -- HTTP (LB sync + service ops) --------------------------------------
+    def _make_handler(self):
+        controller = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def log_message(self, *args: Any) -> None:
+                pass
+
+            def _send_json(self, obj: Dict[str, Any],
+                           code: int = 200) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self) -> None:  # noqa: N802
+                length = int(self.headers.get('Content-Length', 0))
+                payload = json.loads(self.rfile.read(length) or b'{}')
+                if self.path == '/controller/load_balancer_sync':
+                    timestamps = payload.get('request_aggregator',
+                                             {}).get('timestamps', [])
+                    controller.autoscaler.collect_request_information(
+                        timestamps)
+                    self._send_json({
+                        'ready_replica_urls':
+                            controller.replica_manager
+                            .ready_replica_endpoints()})
+                elif self.path == '/controller/update_service':
+                    version = payload['version']
+                    controller.update_service_version(version)
+                    self._send_json({'version': version})
+                else:
+                    self._send_json({'error': 'not found'}, code=404)
+
+            def do_GET(self) -> None:  # noqa: N802
+                if self.path == '/controller/health':
+                    self._send_json({'service': controller.service_name})
+                else:
+                    self._send_json({'error': 'not found'}, code=404)
+
+        return Handler
+
+    def update_service_version(self, version: int) -> None:
+        """Adopt the (already persisted) spec for `version`."""
+        from skypilot_tpu.serve import service_spec as spec_lib
+        import yaml
+        record = serve_state.get_service(self.service_name)
+        assert record is not None
+        spec = spec_lib.SkyServiceSpec.from_yaml_config(
+            yaml.safe_load(record['spec_yaml']))
+        self.replica_manager.update_version(version, spec,
+                                            record['task_yaml_path'])
+        self.autoscaler.update_spec(spec)
+        logger.info(f'Service {self.service_name} updated to version '
+                    f'{version}.')
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._server = http.server.ThreadingHTTPServer(
+            ('127.0.0.1', self.port), self._make_handler())
+        self._server.daemon_threads = True
+        for target, name in ((self._server.serve_forever, 'http'),
+                             (self._autoscaler_loop, 'autoscaler'),
+                             (self._prober_loop, 'prober')):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f'{self.service_name}-ctrl-{name}')
+            t.start()
+            self._threads.append(t)
+        logger.info(f'Controller for {self.service_name} on port '
+                    f'{self.port}.')
+
+    def stop(self, terminate_replicas: bool = True) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        if terminate_replicas:
+            serve_state.set_service_status(
+                self.service_name, serve_state.ServiceStatus.SHUTTING_DOWN)
+            self.replica_manager.terminate_all()
+
+    def run_until_stopped(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(0.5)

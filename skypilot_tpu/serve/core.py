@@ -1,0 +1,243 @@
+"""Serve SDK: up / update / down / status (reference sky/serve/core.py).
+
+`up` (:136) persists the service + task, then starts the service runtime
+(controller + load balancer) — detached process by default, or
+in-process for hermetic tests; `update` (:362) bumps the service
+version for a rolling update; `down` (:525) terminates replicas and the
+runtime; `status` (:635) reads the state DB.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import serve_utils
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+# In-process runtimes (mode='inline'), keyed by service name.
+_INLINE_RUNTIMES: Dict[str, Any] = {}
+
+
+def _extract_task(entrypoint: Union[task_lib.Task, 'dag_lib.Dag']
+                  ) -> task_lib.Task:
+    if isinstance(entrypoint, dag_lib.Dag):
+        if len(entrypoint.tasks) != 1:
+            raise exceptions.NotSupportedError(
+                'Services must be single-task.')
+        return entrypoint.tasks[0]
+    return entrypoint
+
+
+def up(task: Union[task_lib.Task, 'dag_lib.Dag'],
+       service_name: Optional[str] = None,
+       mode: str = 'process',
+       **runtime_kwargs: Any) -> Tuple[str, str]:
+    """Spin up a service; returns (service_name, endpoint).
+
+    mode: 'process' (default; detached service runtime) or 'inline'
+    (runtime threads in this process — hermetic tests; extra
+    runtime_kwargs like autoscaler_interval_seconds are honored).
+    """
+    task = _extract_task(task)
+    if task.service is None:
+        raise exceptions.TaskValidationError(
+            'Task must define a `service` section for sky serve up.')
+    if service_name is None:
+        service_name = f'service-{uuid.uuid4().hex[:4]}'
+    serve_utils.validate_service_name(service_name)
+    task.validate()
+
+    spec = task.service
+    service_dir = serve_state.service_dir(service_name)
+    task_yaml_path = os.path.join(service_dir, 'task_v1.yaml')
+    common_utils.dump_yaml(task_yaml_path, task.to_yaml_config())
+    resources_str = ', '.join(
+        str(r) for r in task.get_preferred_resources())
+    # Lock port allocation + registration together: two concurrent `up`
+    # calls must not be handed the same controller/LB ports.
+    import filelock
+    from skypilot_tpu.utils import paths
+    lock = filelock.FileLock(
+        os.path.join(paths.locks_dir(), 'serve_ports.lock'))
+    with lock:
+        ports = serve_utils.allocate_ports()
+        ok = serve_state.add_service(
+            service_name,
+            spec_yaml=common_utils.dump_yaml_str(spec.to_yaml_config()),
+            task_yaml_path=task_yaml_path,
+            controller_port=ports['controller_port'],
+            load_balancer_port=ports['load_balancer_port'],
+            policy=spec.load_balancing_policy,
+            requested_resources_str=resources_str)
+    if not ok:
+        raise exceptions.ServeUserTerminatedError(
+            f'Service {service_name!r} already exists. Use '
+            '`sky serve update` to update it or `down` to remove it.')
+
+    if mode == 'process':
+        log_path = os.path.join(service_dir, 'service.log')
+        pid = subprocess_utils.launch_new_process_tree(
+            f'{sys.executable} -m skypilot_tpu.serve.service '
+            f'--service-name {service_name}', log_output=log_path)
+        serve_state.set_service_controller_pid(service_name, pid)
+    elif mode == 'inline':
+        from skypilot_tpu.serve import service as service_lib
+        runtime = service_lib.ServiceRuntime(service_name, **runtime_kwargs)
+        runtime.start()
+        _INLINE_RUNTIMES[service_name] = runtime
+    else:
+        raise ValueError(f'Unknown mode {mode!r}')
+
+    record = serve_state.get_service(service_name)
+    endpoint = serve_utils.get_endpoint(record)
+    logger.info(f'Service {service_name!r} spinning up at {endpoint} '
+                f'({mode} runtime).')
+    return service_name, endpoint
+
+
+def update(task: Union[task_lib.Task, 'dag_lib.Dag'],
+           service_name: str) -> int:
+    """Rolling update: persist the new spec/task as version N+1 and tell
+    the controller (reference serve/core.py:362)."""
+    task = _extract_task(task)
+    if task.service is None:
+        raise exceptions.TaskValidationError(
+            'Task must define a `service` section.')
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.ServeUserTerminatedError(
+            f'Service {service_name!r} does not exist.')
+    task.validate()
+    new_version = record['version'] + 1
+    task_yaml_path = os.path.join(serve_state.service_dir(service_name),
+                                  f'task_v{new_version}.yaml')
+    common_utils.dump_yaml(task_yaml_path, task.to_yaml_config())
+    serve_state.set_service_version(
+        service_name, new_version,
+        spec_yaml=common_utils.dump_yaml_str(
+            task.service.to_yaml_config()),
+        task_yaml_path=task_yaml_path)
+    # Notify the runtime.
+    if service_name in _INLINE_RUNTIMES:
+        _INLINE_RUNTIMES[service_name].controller.update_service_version(
+            new_version)
+    else:
+        import json
+        import urllib.request
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{record["controller_port"]}'
+            '/controller/update_service',
+            data=json.dumps({'version': new_version}).encode(),
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+    logger.info(f'Service {service_name!r} updated to version '
+                f'{new_version}.')
+    return new_version
+
+
+def down(service_names: Optional[Union[str, List[str]]] = None,
+         all_services: bool = False, purge: bool = False) -> None:
+    """Terminate services: replicas first, then the runtime
+    (reference serve/core.py:525)."""
+    if all_services:
+        names = [s['name'] for s in serve_state.get_services()]
+    elif service_names is None:
+        raise ValueError('Provide service names or all_services=True.')
+    elif isinstance(service_names, str):
+        names = [service_names]
+    else:
+        names = list(service_names)
+    for name in names:
+        record = serve_state.get_service(name)
+        if record is None:
+            if purge:
+                continue
+            raise exceptions.ServeUserTerminatedError(
+                f'Service {name!r} does not exist.')
+        if name in _INLINE_RUNTIMES:
+            runtime = _INLINE_RUNTIMES.pop(name)
+            runtime.stop(terminate_replicas=True)
+        elif record['controller_pid'] and _is_service_runtime(
+                record['controller_pid'], name):
+            try:
+                # The runtime's SIGTERM handler tears replicas down.
+                os.kill(record['controller_pid'], signal.SIGTERM)
+                deadline = time.time() + 60
+                while (time.time() < deadline and
+                       subprocess_utils.process_alive(
+                           record['controller_pid'])):
+                    time.sleep(0.2)
+            except ProcessLookupError:
+                pass
+            _cleanup_orphan_replicas(name)
+            serve_state.remove_service(name)
+        else:
+            _cleanup_orphan_replicas(name)
+            serve_state.remove_service(name)
+        logger.info(f'Service {name!r} terminated.')
+
+
+def _is_service_runtime(pid: int, service_name: str) -> bool:
+    """Guard against PID reuse: only signal a process that really is
+    this service's runtime."""
+    try:
+        with open(f'/proc/{pid}/cmdline', 'rb') as f:
+            cmdline = f.read().decode(errors='replace').replace('\0', ' ')
+        return ('skypilot_tpu.serve.service' in cmdline and
+                service_name in cmdline)
+    except OSError:
+        return False
+
+
+def _cleanup_orphan_replicas(service_name: str) -> None:
+    """Best-effort teardown of replica clusters whose runtime is gone."""
+    from skypilot_tpu import core as sky_core
+    for r in serve_state.get_replicas(service_name):
+        if not r['cluster_name']:
+            continue
+        try:
+            sky_core.down(r['cluster_name'])
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(
+                f'Failed to tear down replica cluster '
+                f'{r["cluster_name"]}: {e}')
+
+
+def status(service_names: Optional[Union[str, List[str]]] = None
+           ) -> List[Dict[str, Any]]:
+    """Service records with their replica lists
+    (reference serve/core.py:635)."""
+    records = serve_state.get_services()
+    if service_names is not None:
+        if isinstance(service_names, str):
+            service_names = [service_names]
+        records = [r for r in records if r['name'] in service_names]
+    for rec in records:
+        rec['replica_info'] = serve_state.get_replicas(rec['name'])
+        rec['endpoint'] = serve_utils.get_endpoint(rec)
+    return records
+
+
+def tail_logs(service_name: str) -> str:
+    """The service runtime's log (controller + LB + autoscaler)."""
+    path = os.path.join(serve_state.service_dir(service_name),
+                        'service.log')
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            return f.read()
+    return ''

@@ -1,0 +1,188 @@
+"""Serve load balancer: HTTP reverse proxy over the ready replicas.
+
+Counterpart of the reference's sky/serve/load_balancer.py:22
+`SkyServeLoadBalancer`: a reverse proxy that (a) forwards every request
+to a replica chosen by the load-balancing policy, (b) aggregates request
+timestamps, and (c) periodically syncs with the controller — posting the
+aggregated stats and receiving the current ready-replica URL set.
+
+Stdlib-only (ThreadingHTTPServer + urllib) instead of
+FastAPI/uvicorn/httpx; streaming bodies are relayed in chunks.
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+
+logger = sky_logging.init_logger(__name__)
+
+_HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
+                'proxy-authorization', 'te', 'trailers',
+                'transfer-encoding', 'upgrade', 'host', 'content-length'}
+
+
+class RequestAggregator:
+    """Sliding window of request timestamps (reference
+    load_balancer.py request aggregator feeding the autoscaler)."""
+
+    def __init__(self) -> None:
+        self._timestamps: List[float] = []
+        self._lock = threading.Lock()
+
+    def add(self) -> None:
+        with self._lock:
+            self._timestamps.append(time.time())
+
+    def drain(self) -> List[float]:
+        with self._lock:
+            out, self._timestamps = self._timestamps, []
+            return out
+
+
+class SkyServeLoadBalancer:
+
+    def __init__(self, controller_url: str, port: int,
+                 policy_name: str = 'round_robin',
+                 sync_interval_seconds: float =
+                 constants.LB_SYNC_INTERVAL_SECONDS) -> None:
+        self.controller_url = controller_url.rstrip('/')
+        self.port = port
+        self.policy = lb_policies.LoadBalancingPolicy.from_name(policy_name)
+        self.sync_interval = sync_interval_seconds
+        self.aggregator = RequestAggregator()
+        self._stop = threading.Event()
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+        self._threads: List[threading.Thread] = []
+
+    # -- controller sync ---------------------------------------------------
+    def _sync_once(self) -> None:
+        payload = json.dumps({
+            'request_aggregator': {
+                'timestamps': self.aggregator.drain()
+            }
+        }).encode()
+        req = urllib.request.Request(
+            self.controller_url + '/controller/load_balancer_sync',
+            data=payload, headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            data = json.loads(resp.read())
+        self.policy.set_ready_replicas(data.get('ready_replica_urls', []))
+
+    def _sync_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._sync_once()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'LB sync failed: {e}')
+            self._stop.wait(self.sync_interval)
+
+    # -- proxy -------------------------------------------------------------
+    def _make_handler(self):
+        lb = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *args: Any) -> None:
+                pass
+
+            def _proxy(self) -> None:
+                lb.aggregator.add()
+                replica = lb.policy.select_replica()
+                if replica is None:
+                    body = b'No ready replicas. Use "sky serve status" ' \
+                           b'to check the status.'
+                    self.send_response(503)
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                lb.policy.pre_execute_hook(replica)
+                try:
+                    length = int(self.headers.get('Content-Length', 0))
+                    data = self.rfile.read(length) if length else None
+                    headers = {k: v for k, v in self.headers.items()
+                               if k.lower() not in _HOP_HEADERS}
+                    req = urllib.request.Request(
+                        replica + self.path, data=data, headers=headers,
+                        method=self.command)
+                    with urllib.request.urlopen(req, timeout=300) as resp:
+                        # Relay in chunks so token-streaming (SSE /
+                        # chunked) inference responses reach the client
+                        # incrementally.
+                        self.send_response(resp.status)
+                        for k, v in resp.headers.items():
+                            if k.lower() not in _HOP_HEADERS:
+                                self.send_header(k, v)
+                        length = resp.headers.get('Content-Length')
+                        if length is not None:
+                            self.send_header('Content-Length', length)
+                            self.end_headers()
+                        else:
+                            self.send_header('Transfer-Encoding', 'chunked')
+                            self.end_headers()
+                        while True:
+                            # read1: return as soon as one upstream
+                            # chunk arrives (read() would block filling
+                            # the whole buffer — no streaming).
+                            chunk = resp.read1(64 * 1024)
+                            if length is not None:
+                                if not chunk:
+                                    break
+                                self.wfile.write(chunk)
+                            else:
+                                if not chunk:
+                                    self.wfile.write(b'0\r\n\r\n')
+                                    break
+                                self.wfile.write(
+                                    f'{len(chunk):x}\r\n'.encode())
+                                self.wfile.write(chunk)
+                                self.wfile.write(b'\r\n')
+                            self.wfile.flush()
+                except urllib.error.HTTPError as e:
+                    body = e.read()
+                    self.send_response(e.code)
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:  # pylint: disable=broad-except
+                    body = f'Replica request failed: {e}'.encode()
+                    self.send_response(502)
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                finally:
+                    lb.policy.post_execute_hook(replica)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _proxy
+
+        return Handler
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._server = http.server.ThreadingHTTPServer(
+            ('0.0.0.0', self.port), self._make_handler())
+        self._server.daemon_threads = True
+        for target, name in ((self._server.serve_forever, 'http'),
+                             (self._sync_loop, 'sync')):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f'lb-{name}')
+            t.start()
+            self._threads.append(t)
+        logger.info(f'Load balancer on port {self.port} -> '
+                    f'{self.controller_url} ({self.policy.NAME}).')
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()

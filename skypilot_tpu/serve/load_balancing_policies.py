@@ -1,0 +1,102 @@
+"""Load-balancing policies (reference: sky/serve/load_balancing_policies.py).
+
+`LoadBalancingPolicy` ABC (:32) with `round_robin` and
+`least_number_of_requests` implementations, selected by name from the
+service spec.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+POLICIES = {}
+
+
+def register(name: str):
+    def deco(cls):
+        POLICIES[name] = cls
+        cls.NAME = name
+        return cls
+    return deco
+
+
+class LoadBalancingPolicy:
+    """Tracks the ready-replica set and picks a target per request."""
+    NAME = 'abstract'
+
+    def __init__(self) -> None:
+        self.ready_replicas: List[str] = []
+        self._lock = threading.Lock()
+
+    def set_ready_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            if set(replicas) != set(self.ready_replicas):
+                self._on_replicas_changed(replicas)
+            self.ready_replicas = list(replicas)
+
+    def _on_replicas_changed(self, replicas: List[str]) -> None:
+        pass
+
+    def select_replica(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def pre_execute_hook(self, replica: str) -> None:
+        pass
+
+    def post_execute_hook(self, replica: str) -> None:
+        pass
+
+    @classmethod
+    def from_name(cls, name: str) -> 'LoadBalancingPolicy':
+        if name not in POLICIES:
+            raise ValueError(
+                f'Unknown load balancing policy {name!r}; '
+                f'available: {sorted(POLICIES)}')
+        return POLICIES[name]()
+
+
+@register('round_robin')
+class RoundRobinPolicy(LoadBalancingPolicy):
+    """Reference load_balancing_policies.py round_robin."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._index = 0
+
+    def _on_replicas_changed(self, replicas: List[str]) -> None:
+        self._index = 0
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replicas:
+                return None
+            replica = self.ready_replicas[self._index %
+                                          len(self.ready_replicas)]
+            self._index = (self._index + 1) % len(self.ready_replicas)
+            return replica
+
+
+@register('least_number_of_requests')
+class LeastNumberOfRequestsPolicy(LoadBalancingPolicy):
+    """Reference load_balancing_policies.py least_number_of_requests:
+    route to the replica with the fewest in-flight requests."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._inflight: Dict[str, int] = {}
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replicas:
+                return None
+            return min(self.ready_replicas,
+                       key=lambda r: self._inflight.get(r, 0))
+
+    def pre_execute_hook(self, replica: str) -> None:
+        with self._lock:
+            self._inflight[replica] = self._inflight.get(replica, 0) + 1
+
+    def post_execute_hook(self, replica: str) -> None:
+        with self._lock:
+            self._inflight[replica] = max(
+                0, self._inflight.get(replica, 0) - 1)

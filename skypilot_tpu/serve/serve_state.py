@@ -1,0 +1,351 @@
+"""Serve state: SQLite tables + service/replica state machines.
+
+Counterpart of the reference's sky/serve/serve_state.py (557 LoC):
+`services` and `replicas` tables, `ServiceStatus` and `ReplicaStatus`
+enums, and the version bookkeeping used for rolling updates
+(sky/serve/replica_managers.py:1172).  As with managed jobs, the control
+plane runs client-side (thread/process) instead of on a controller VM,
+so the DB lives under the local state dir.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import paths
+
+logger = sky_logging.init_logger(__name__)
+
+_lock = threading.RLock()
+
+INITIAL_VERSION = 1
+
+
+class ServiceStatus(enum.Enum):
+    """Reference sky/serve/serve_state.py ServiceStatus."""
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'
+    READY = 'READY'
+    NO_REPLICA = 'NO_REPLICA'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    CONTROLLER_FAILED = 'CONTROLLER_FAILED'
+
+    def is_terminal(self) -> bool:
+        return self in (ServiceStatus.FAILED,
+                        ServiceStatus.CONTROLLER_FAILED)
+
+
+class ReplicaStatus(enum.Enum):
+    """Reference sky/serve/serve_state.py ReplicaStatus (driven by the
+    `ReplicaStatusProperty` state machine, replica_managers.py:225)."""
+    PENDING = 'PENDING'            # queued, not yet launching
+    PROVISIONING = 'PROVISIONING'  # sky.launch in flight
+    STARTING = 'STARTING'          # cluster UP, waiting on readiness probe
+    READY = 'READY'                # probe passing
+    NOT_READY = 'NOT_READY'        # probe failing post-READY
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    PREEMPTED = 'PREEMPTED'
+    FAILED = 'FAILED'              # launch or probe-deadline failure
+    FAILED_CLEANUP = 'FAILED_CLEANUP'
+
+    def is_terminal(self) -> bool:
+        return self in (ReplicaStatus.FAILED, ReplicaStatus.FAILED_CLEANUP)
+
+    @classmethod
+    def scale_down_candidates(cls) -> List['ReplicaStatus']:
+        """Order in which the autoscaler prefers to remove replicas:
+        broken first, newest-READY last (reference
+        replica_managers.py scale-down selection)."""
+        return [cls.FAILED, cls.NOT_READY, cls.PREEMPTED, cls.PENDING,
+                cls.PROVISIONING, cls.STARTING, cls.READY]
+
+
+def serve_dir() -> str:
+    d = os.path.join(paths.state_dir(), 'serve')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def service_dir(service_name: str) -> str:
+    d = os.path.join(serve_dir(), service_name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _db_path() -> str:
+    return os.path.join(serve_dir(), 'services.db')
+
+
+_local = threading.local()
+
+
+def _conn() -> sqlite3.Connection:
+    path = _db_path()
+    cache = getattr(_local, 'conns', None)
+    if cache is None:
+        cache = _local.conns = {}
+    conn = cache.get(path)
+    if conn is not None:
+        return conn
+    conn = sqlite3.connect(path, timeout=10)
+    conn.execute("""CREATE TABLE IF NOT EXISTS services (
+        name TEXT PRIMARY KEY,
+        status TEXT,
+        spec_yaml TEXT,
+        task_yaml_path TEXT,
+        version INTEGER DEFAULT 1,
+        controller_port INTEGER,
+        load_balancer_port INTEGER,
+        controller_pid INTEGER,
+        policy TEXT,
+        requested_resources_str TEXT,
+        submitted_at REAL)""")
+    conn.execute("""CREATE TABLE IF NOT EXISTS replicas (
+        service_name TEXT,
+        replica_id INTEGER,
+        status TEXT,
+        cluster_name TEXT,
+        endpoint TEXT,
+        is_spot INTEGER DEFAULT 0,
+        version INTEGER DEFAULT 1,
+        launched_at REAL,
+        ready_at REAL,
+        consecutive_failures INTEGER DEFAULT 0,
+        failure_reason TEXT,
+        PRIMARY KEY (service_name, replica_id))""")
+    conn.commit()
+    cache[path] = conn
+    return conn
+
+
+def reset_for_tests() -> None:
+    with _lock:
+        cache = getattr(_local, 'conns', None)
+        if cache:
+            for conn in cache.values():
+                conn.close()
+            cache.clear()
+        try:
+            os.remove(_db_path())
+        except FileNotFoundError:
+            pass
+
+
+# -- services --------------------------------------------------------------
+
+
+def add_service(name: str, spec_yaml: str, task_yaml_path: str,
+                controller_port: int, load_balancer_port: int,
+                policy: str, requested_resources_str: str) -> bool:
+    """Returns False if a service with this name already exists."""
+    with _lock:
+        try:
+            _conn().execute(
+                'INSERT INTO services (name, status, spec_yaml, '
+                'task_yaml_path, version, controller_port, '
+                'load_balancer_port, policy, requested_resources_str, '
+                'submitted_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
+                (name, ServiceStatus.CONTROLLER_INIT.value, spec_yaml,
+                 task_yaml_path, INITIAL_VERSION, controller_port,
+                 load_balancer_port, policy, requested_resources_str,
+                 time.time()))
+            _conn().commit()
+            return True
+        except sqlite3.IntegrityError:
+            return False
+
+
+def remove_service(name: str) -> None:
+    with _lock:
+        _conn().execute('DELETE FROM services WHERE name = ?', (name,))
+        _conn().execute('DELETE FROM replicas WHERE service_name = ?',
+                        (name,))
+        _conn().commit()
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    with _lock:
+        _conn().execute('UPDATE services SET status = ? WHERE name = ?',
+                        (status.value, name))
+        _conn().commit()
+
+
+def set_service_controller_pid(name: str, pid: int) -> None:
+    with _lock:
+        _conn().execute(
+            'UPDATE services SET controller_pid = ? WHERE name = ?',
+            (pid, name))
+        _conn().commit()
+
+
+def set_service_version(name: str, version: int,
+                        spec_yaml: Optional[str] = None,
+                        task_yaml_path: Optional[str] = None) -> None:
+    with _lock:
+        _conn().execute('UPDATE services SET version = ? WHERE name = ?',
+                        (version, name))
+        if spec_yaml is not None:
+            _conn().execute(
+                'UPDATE services SET spec_yaml = ? WHERE name = ?',
+                (spec_yaml, name))
+        if task_yaml_path is not None:
+            _conn().execute(
+                'UPDATE services SET task_yaml_path = ? WHERE name = ?',
+                (task_yaml_path, name))
+        _conn().commit()
+
+
+_SERVICE_COLS = ('name', 'status', 'spec_yaml', 'task_yaml_path', 'version',
+                 'controller_port', 'load_balancer_port', 'controller_pid',
+                 'policy', 'requested_resources_str', 'submitted_at')
+
+
+def _service_row_to_dict(row: tuple) -> Dict[str, Any]:
+    rec = dict(zip(_SERVICE_COLS, row))
+    rec['status'] = ServiceStatus(rec['status'])
+    return rec
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    cols = ', '.join(_SERVICE_COLS)
+    row = _conn().execute(
+        f'SELECT {cols} FROM services WHERE name = ?', (name,)).fetchone()
+    return _service_row_to_dict(row) if row else None
+
+
+def get_services() -> List[Dict[str, Any]]:
+    cols = ', '.join(_SERVICE_COLS)
+    rows = _conn().execute(
+        f'SELECT {cols} FROM services ORDER BY submitted_at').fetchall()
+    return [_service_row_to_dict(r) for r in rows]
+
+
+def max_used_port(column: str) -> Optional[int]:
+    assert column in ('controller_port', 'load_balancer_port')
+    row = _conn().execute(f'SELECT MAX({column}) FROM services').fetchone()
+    return row[0]
+
+
+# -- replicas --------------------------------------------------------------
+
+_REPLICA_COLS = ('service_name', 'replica_id', 'status', 'cluster_name',
+                 'endpoint', 'is_spot', 'version', 'launched_at', 'ready_at',
+                 'consecutive_failures', 'failure_reason')
+
+
+def add_replica(service_name: str, replica_id: int, cluster_name: str,
+                is_spot: bool, version: int) -> None:
+    with _lock:
+        _conn().execute(
+            'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
+            'status, cluster_name, is_spot, version, launched_at) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?)',
+            (service_name, replica_id, ReplicaStatus.PENDING.value,
+             cluster_name, int(is_spot), version, time.time()))
+        _conn().commit()
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    with _lock:
+        _conn().execute(
+            'DELETE FROM replicas WHERE service_name = ? AND '
+            'replica_id = ?', (service_name, replica_id))
+        _conn().commit()
+
+
+def set_replica_status(service_name: str, replica_id: int,
+                       status: ReplicaStatus,
+                       failure_reason: Optional[str] = None) -> None:
+    with _lock:
+        _conn().execute(
+            'UPDATE replicas SET status = ? WHERE service_name = ? AND '
+            'replica_id = ?',
+            (status.value, service_name, replica_id))
+        if status == ReplicaStatus.READY:
+            _conn().execute(
+                'UPDATE replicas SET ready_at = ?, consecutive_failures = 0 '
+                'WHERE service_name = ? AND replica_id = ?',
+                (time.time(), service_name, replica_id))
+        if failure_reason is not None:
+            _conn().execute(
+                'UPDATE replicas SET failure_reason = ? WHERE '
+                'service_name = ? AND replica_id = ?',
+                (failure_reason, service_name, replica_id))
+        _conn().commit()
+
+
+def set_replica_endpoint(service_name: str, replica_id: int,
+                         endpoint: str) -> None:
+    with _lock:
+        _conn().execute(
+            'UPDATE replicas SET endpoint = ? WHERE service_name = ? AND '
+            'replica_id = ?', (endpoint, service_name, replica_id))
+        _conn().commit()
+
+
+def bump_replica_failures(service_name: str, replica_id: int) -> int:
+    """Increment and return the consecutive probe-failure count."""
+    with _lock:
+        _conn().execute(
+            'UPDATE replicas SET consecutive_failures = '
+            'consecutive_failures + 1 WHERE service_name = ? AND '
+            'replica_id = ?', (service_name, replica_id))
+        _conn().commit()
+        row = _conn().execute(
+            'SELECT consecutive_failures FROM replicas WHERE '
+            'service_name = ? AND replica_id = ?',
+            (service_name, replica_id)).fetchone()
+        return row[0] if row else 0
+
+
+def clear_replica_failures(service_name: str, replica_id: int) -> None:
+    with _lock:
+        _conn().execute(
+            'UPDATE replicas SET consecutive_failures = 0 WHERE '
+            'service_name = ? AND replica_id = ?',
+            (service_name, replica_id))
+        _conn().commit()
+
+
+def _replica_row_to_dict(row: tuple) -> Dict[str, Any]:
+    rec = dict(zip(_REPLICA_COLS, row))
+    rec['status'] = ReplicaStatus(rec['status'])
+    rec['is_spot'] = bool(rec['is_spot'])
+    return rec
+
+
+def get_replica(service_name: str,
+                replica_id: int) -> Optional[Dict[str, Any]]:
+    cols = ', '.join(_REPLICA_COLS)
+    row = _conn().execute(
+        f'SELECT {cols} FROM replicas WHERE service_name = ? AND '
+        'replica_id = ?', (service_name, replica_id)).fetchone()
+    return _replica_row_to_dict(row) if row else None
+
+
+def get_replicas(service_name: str) -> List[Dict[str, Any]]:
+    cols = ', '.join(_REPLICA_COLS)
+    rows = _conn().execute(
+        f'SELECT {cols} FROM replicas WHERE service_name = ? ORDER BY '
+        'replica_id', (service_name,)).fetchall()
+    return [_replica_row_to_dict(r) for r in rows]
+
+
+def next_replica_id(service_name: str) -> int:
+    row = _conn().execute(
+        'SELECT MAX(replica_id) FROM replicas WHERE service_name = ?',
+        (service_name,)).fetchone()
+    return (row[0] or 0) + 1
+
+
+def total_replicas_launched(service_name: str) -> int:
+    row = _conn().execute(
+        'SELECT COUNT(*) FROM replicas WHERE service_name = ?',
+        (service_name,)).fetchone()
+    return row[0]

@@ -1,0 +1,91 @@
+"""Serve helpers: port allocation, name validation, status formatting.
+
+Counterpart of the reference's sky/serve/serve_utils.py (1,044 LoC,
+mostly codegen-RPC which this rebuild replaces with direct HTTP to the
+controller — see controller.py).
+"""
+from __future__ import annotations
+
+import re
+import socket
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve import serve_state
+
+_SERVICE_NAME_RE = re.compile(r'^[a-z]([a-z0-9-]{0,48}[a-z0-9])?$')
+
+
+def validate_service_name(name: str) -> None:
+    if not _SERVICE_NAME_RE.match(name):
+        raise exceptions.TaskValidationError(
+            f'Service name {name!r} is invalid: must match '
+            f'{_SERVICE_NAME_RE.pattern} (lowercase, digits, dashes).')
+
+
+def _port_is_free(port: int) -> bool:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        try:
+            s.bind(('127.0.0.1', port))
+            return True
+        except OSError:
+            return False
+
+
+def allocate_ports() -> Dict[str, int]:
+    """Next free (controller, load balancer) port pair."""
+    used_ctrl = serve_state.max_used_port('controller_port')
+    used_lb = serve_state.max_used_port('load_balancer_port')
+    ctrl = max(constants.CONTROLLER_PORT_START, (used_ctrl or 0) + 1)
+    lb = max(constants.LOAD_BALANCER_PORT_START, (used_lb or 0) + 1)
+    while not _port_is_free(ctrl):
+        ctrl += 1
+    while not _port_is_free(lb):
+        lb += 1
+    return {'controller_port': ctrl, 'load_balancer_port': lb}
+
+
+def format_service_table(records: List[Dict[str, Any]]) -> str:
+    if not records:
+        return 'No existing services.'
+    headers = ['NAME', 'VERSION', 'STATUS', 'REPLICAS', 'ENDPOINT']
+    rows = []
+    for rec in records:
+        replicas = serve_state.get_replicas(rec['name'])
+        n_ready = sum(1 for r in replicas if r['status'] ==
+                      serve_state.ReplicaStatus.READY)
+        rows.append([
+            rec['name'],
+            str(rec['version']),
+            rec['status'].value,
+            f'{n_ready}/{len(replicas)}',
+            f'http://127.0.0.1:{rec["load_balancer_port"]}',
+        ])
+    widths = [max(len(h), *(len(r[i]) for r in rows))
+              for i, h in enumerate(headers)]
+    lines = ['  '.join(h.ljust(w) for h, w in zip(headers, widths))]
+    for row in rows:
+        lines.append('  '.join(c.ljust(w) for c, w in zip(row, widths)))
+    return '\n'.join(lines)
+
+
+def format_replica_table(service_name: str) -> str:
+    replicas = serve_state.get_replicas(service_name)
+    if not replicas:
+        return 'No replicas.'
+    headers = ['ID', 'VERSION', 'STATUS', 'SPOT', 'ENDPOINT', 'CLUSTER']
+    rows = [[str(r['replica_id']), str(r['version']), r['status'].value,
+             'spot' if r['is_spot'] else 'on-demand',
+             r['endpoint'] or '-', r['cluster_name'] or '-']
+            for r in replicas]
+    widths = [max(len(h), *(len(r[i]) for r in rows))
+              for i, h in enumerate(headers)]
+    lines = ['  '.join(h.ljust(w) for h, w in zip(headers, widths))]
+    for row in rows:
+        lines.append('  '.join(c.ljust(w) for c, w in zip(row, widths)))
+    return '\n'.join(lines)
+
+
+def get_endpoint(record: Dict[str, Any]) -> str:
+    return f'http://127.0.0.1:{record["load_balancer_port"]}'

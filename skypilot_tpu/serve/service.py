@@ -1,0 +1,96 @@
+"""Per-service bootstrap: controller + load balancer.
+
+Counterpart of the reference's sky/serve/service.py:133 `_start`: for
+one service, start the controller (autoscaler + replica manager) and the
+load balancer, then supervise until terminated.  The reference runs
+these as separate OS processes on a controller VM; here both live in one
+service process (threads), started detached by `serve.core.up` — or
+in-process for hermetic tests.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+from typing import Optional
+
+import yaml
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+class ServiceRuntime:
+    """The controller + LB pair for one service."""
+
+    def __init__(self, service_name: str,
+                 autoscaler_interval_seconds: Optional[float] = None,
+                 probe_interval_seconds: Optional[float] = None,
+                 lb_sync_interval_seconds: Optional[float] = None) -> None:
+        record = serve_state.get_service(service_name)
+        if record is None:
+            raise ValueError(f'Service {service_name!r} not in state DB.')
+        self.service_name = service_name
+        self.record = record
+        spec = spec_lib.SkyServiceSpec.from_yaml_config(
+            yaml.safe_load(record['spec_yaml']))
+        self.controller = controller_lib.SkyServeController(
+            service_name, spec, record['task_yaml_path'],
+            port=record['controller_port'],
+            autoscaler_interval_seconds=(autoscaler_interval_seconds or
+                                         constants
+                                         .AUTOSCALER_INTERVAL_SECONDS),
+            probe_interval_seconds=(probe_interval_seconds or
+                                    constants.PROBE_INTERVAL_SECONDS))
+        self.load_balancer = lb_lib.SkyServeLoadBalancer(
+            controller_url=f'http://127.0.0.1:{record["controller_port"]}',
+            port=record['load_balancer_port'],
+            policy_name=record['policy'],
+            sync_interval_seconds=(lb_sync_interval_seconds or
+                                   constants.LB_SYNC_INTERVAL_SECONDS))
+
+    def start(self) -> None:
+        self.controller.start()
+        self.load_balancer.start()
+        serve_state.set_service_status(
+            self.service_name, serve_state.ServiceStatus.REPLICA_INIT)
+
+    def stop(self, terminate_replicas: bool = True) -> None:
+        self.load_balancer.stop()
+        self.controller.stop(terminate_replicas=terminate_replicas)
+        if terminate_replicas:
+            serve_state.remove_service(self.service_name)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    args = parser.parse_args()
+    runtime = ServiceRuntime(args.service_name)
+    serve_state.set_service_controller_pid(args.service_name, os.getpid())
+    stop_event = threading.Event()
+
+    def _on_term(signum, frame):  # pylint: disable=unused-argument
+        logger.info(f'Service {args.service_name}: received signal '
+                    f'{signum}; terminating replicas.')
+        runtime.stop(terminate_replicas=True)
+        stop_event.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    runtime.start()
+    while not stop_event.is_set():
+        stop_event.wait(1.0)
+    sys.exit(0)
+
+
+if __name__ == '__main__':
+    main()

@@ -36,6 +36,7 @@ class SkyServiceSpec:
         base_ondemand_fallback_replicas: int = 0,
         dynamic_ondemand_fallback: bool = False,
         load_balancing_policy: Optional[str] = None,
+        port: int = 8080,
     ) -> None:
         if not readiness_path.startswith('/'):
             raise exceptions.TaskValidationError(
@@ -47,6 +48,11 @@ class SkyServiceSpec:
                 target_qps_per_replica <= 0:
             raise exceptions.TaskValidationError(
                 'target_qps_per_replica must be positive.')
+        if target_qps_per_replica is not None and max_replicas is None:
+            raise exceptions.TaskValidationError(
+                'max_replicas is required when target_qps_per_replica is '
+                'set: autoscaling without an upper bound could launch an '
+                'unbounded number of TPU clusters.')
         self.readiness_path = readiness_path
         self.initial_delay_seconds = initial_delay_seconds
         self.readiness_timeout_seconds = readiness_timeout_seconds
@@ -60,6 +66,7 @@ class SkyServiceSpec:
         self.base_ondemand_fallback_replicas = base_ondemand_fallback_replicas
         self.dynamic_ondemand_fallback = dynamic_ondemand_fallback
         self.load_balancing_policy = load_balancing_policy or 'round_robin'
+        self.port = port
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -97,6 +104,7 @@ class SkyServiceSpec:
             dynamic_ondemand_fallback=policy.get(
                 'dynamic_ondemand_fallback', False),
             load_balancing_policy=config.get('load_balancing_policy'),
+            port=config.get('port', 8080),
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -121,6 +129,7 @@ class SkyServiceSpec:
             'readiness_probe': probe,
             'replica_policy': policy,
             'load_balancing_policy': self.load_balancing_policy,
+            'port': self.port,
         }
 
     def __repr__(self) -> str:

@@ -122,6 +122,7 @@ def get_service_schema() -> Dict[str, Any]:
             },
             'replicas': {'type': 'integer'},
             'load_balancing_policy': {'type': ['string', 'null']},
+            'port': {'type': 'integer', 'minimum': 1, 'maximum': 65535},
         },
         'additionalProperties': False,
     }

@@ -1,0 +1,200 @@
+"""End-to-end serve tests on process-based local clusters.
+
+Hermetic analog of the reference's tests/smoke_tests/test_sky_serve.py:
+up → replicas launch as real local clusters → readiness probes pass →
+LB round-robins real HTTP traffic → autoscaler replaces a preempted
+replica → rolling update → down.
+"""
+import time
+import urllib.request
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_user_state
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import serve_state
+
+ReplicaStatus = serve_state.ReplicaStatus
+
+# A tiny HTTP server replica: 200 on every path, body identifies the
+# replica. Bash-quoted for Task.run.
+_SERVER_PY = (
+    "import os,sys;"
+    "from http.server import BaseHTTPRequestHandler,HTTPServer\n"
+    "class H(BaseHTTPRequestHandler):\n"
+    "    def do_GET(self):\n"
+    "        b=('replica-'+os.environ['SKYTPU_SERVE_REPLICA_ID']"
+    "+':'+os.environ.get('MARKER','v1')).encode()\n"
+    "        self.send_response(200);"
+    "self.send_header('Content-Length',str(len(b)));"
+    "self.end_headers();self.wfile.write(b)\n"
+    "    def log_message(self,*a): pass\n"
+    "HTTPServer(('127.0.0.1',int(os.environ["
+    "'SKYTPU_SERVE_REPLICA_PORT'])),H).serve_forever()\n")
+
+
+def _service_task(min_replicas=1, max_replicas=None, marker='v1',
+                  **policy_kwargs):
+    import shlex
+    run = f'python3 -c {shlex.quote(_SERVER_PY)}'
+    t = sky.Task(run=run, envs={'MARKER': marker})
+    t.set_resources(sky.Resources(cloud='local'))
+    from skypilot_tpu.serve import service_spec as spec_lib
+    t.set_service(spec_lib.SkyServiceSpec(
+        readiness_path='/health',
+        initial_delay_seconds=60,
+        readiness_timeout_seconds=2,
+        min_replicas=min_replicas,
+        max_replicas=max_replicas,
+        **policy_kwargs))
+    return t
+
+
+_FAST = dict(autoscaler_interval_seconds=0.3,
+             probe_interval_seconds=0.3,
+             lb_sync_interval_seconds=0.4)
+
+
+def _wait_ready(service_name, n, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        replicas = serve_state.get_replicas(service_name)
+        ready = [r for r in replicas
+                 if r['status'] == ReplicaStatus.READY]
+        if len(ready) >= n:
+            return replicas
+        time.sleep(0.3)
+    raise TimeoutError(
+        f'{n} READY replicas not reached; state: '
+        f'{[(r["replica_id"], r["status"]) for r in replicas]}')
+
+
+def _get(url, timeout=5):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestServeEndToEnd:
+
+    def test_up_traffic_down(self):
+        name, endpoint = serve_core.up(
+            _service_task(min_replicas=2), service_name='svc-basic',
+            mode='inline', **_FAST)
+        try:
+            _wait_ready(name, 2)
+            # Service status reaches READY.
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                rec = serve_state.get_service(name)
+                if rec['status'] == serve_state.ServiceStatus.READY:
+                    break
+                time.sleep(0.2)
+            assert rec['status'] == serve_state.ServiceStatus.READY
+            # Wait for the LB to learn the replica set, then round-robin.
+            deadline = time.time() + 15
+            seen = set()
+            while time.time() < deadline and len(seen) < 2:
+                code, body = _get(endpoint + '/any/path')
+                if code == 200 and body.startswith('replica-'):
+                    seen.add(body)
+                time.sleep(0.1)
+            assert len(seen) == 2, f'LB did not spread load: {seen}'
+            # Status SDK view.
+            records = serve_core.status([name])
+            assert len(records) == 1
+            assert len(records[0]['replica_info']) == 2
+        finally:
+            serve_core.down(name)
+        assert serve_state.get_service(name) is None
+        # Replica clusters are gone.
+        assert sky.status() == []
+
+    def test_replica_preemption_recovery(self):
+        name, _ = serve_core.up(
+            _service_task(min_replicas=1), service_name='svc-prempt',
+            mode='inline', **_FAST)
+        try:
+            replicas = _wait_ready(name, 1)
+            victim = replicas[0]
+            # Simulate preemption: kill the replica's cluster from under
+            # the service (reference smoke tests terminate instances via
+            # the cloud CLI).
+            sky.down(victim['cluster_name'])
+            # The prober must flag it and the autoscaler must replace it.
+            deadline = time.time() + 90
+            replaced = None
+            while time.time() < deadline:
+                current = serve_state.get_replicas(name)
+                ready = [r for r in current
+                         if r['status'] == ReplicaStatus.READY and
+                         r['replica_id'] != victim['replica_id']]
+                if ready:
+                    replaced = ready[0]
+                    break
+                time.sleep(0.3)
+            assert replaced is not None, 'preempted replica not replaced'
+        finally:
+            serve_core.down(name)
+
+    def test_rolling_update(self):
+        name, endpoint = serve_core.up(
+            _service_task(min_replicas=1, marker='v1'),
+            service_name='svc-update', mode='inline', **_FAST)
+        try:
+            _wait_ready(name, 1)
+            serve_core.update(
+                _service_task(min_replicas=1, marker='v2'), name)
+            assert serve_state.get_service(name)['version'] == 2
+            # New-version replica becomes READY, old one drains.
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                replicas = serve_state.get_replicas(name)
+                v2_ready = [r for r in replicas if r['version'] == 2 and
+                            r['status'] == ReplicaStatus.READY]
+                v1_left = [r for r in replicas if r['version'] == 1]
+                if v2_ready and not v1_left:
+                    break
+                time.sleep(0.3)
+            assert v2_ready and not v1_left, (
+                f'rolling update incomplete: '
+                f'{[(r["replica_id"], r["version"], r["status"]) for r in replicas]}')
+            # Traffic now hits v2.
+            deadline = time.time() + 15
+            body = ''
+            while time.time() < deadline:
+                code, body = _get(endpoint + '/')
+                if code == 200 and body.endswith(':v2'):
+                    break
+                time.sleep(0.2)
+            assert body.endswith(':v2'), body
+        finally:
+            serve_core.down(name)
+
+    def test_failed_replica_marked(self):
+        """A replica that never opens its port FAILs after
+        initial_delay."""
+        t = sky.Task(run='sleep 300')
+        t.set_resources(sky.Resources(cloud='local'))
+        from skypilot_tpu.serve import service_spec as spec_lib
+        t.set_service(spec_lib.SkyServiceSpec(
+            readiness_path='/health', initial_delay_seconds=2,
+            readiness_timeout_seconds=0.5, min_replicas=1))
+        name, _ = serve_core.up(t, service_name='svc-fail',
+                                mode='inline', **_FAST)
+        try:
+            deadline = time.time() + 60
+            failed = False
+            while time.time() < deadline:
+                replicas = serve_state.get_replicas(name)
+                if any(r['status'] == ReplicaStatus.FAILED
+                       for r in replicas):
+                    failed = True
+                    break
+                time.sleep(0.3)
+            assert failed, 'replica never marked FAILED'
+        finally:
+            serve_core.down(name)

@@ -1,0 +1,247 @@
+"""Unit tests for the serve subsystem: autoscalers, LB policies, state.
+
+Mirrors the reference's tests/test_serve_autoscaler.py (drives
+autoscaler decisions directly with fabricated replica records).
+"""
+import time
+
+import pytest
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+
+ReplicaStatus = serve_state.ReplicaStatus
+
+
+def _spec(**kwargs):
+    kwargs.setdefault('readiness_path', '/health')
+    return spec_lib.SkyServiceSpec(**kwargs)
+
+
+def _replica(rid, status=ReplicaStatus.READY, is_spot=False, version=1,
+             age=100.0):
+    return {
+        'replica_id': rid,
+        'status': status,
+        'is_spot': is_spot,
+        'version': version,
+        'launched_at': time.time() - age,
+        'endpoint': f'http://127.0.0.1:{40000 + rid}',
+    }
+
+
+class TestFixedAutoscaler:
+
+    def test_scales_to_min_replicas(self):
+        a = autoscalers.Autoscaler.from_spec(_spec(min_replicas=3))
+        assert type(a) is autoscalers.Autoscaler
+        d = a.evaluate_scaling([_replica(1)])
+        assert len(d.scale_up) == 1 and d.scale_up[0].count == 2
+
+    def test_noop_at_target(self):
+        a = autoscalers.Autoscaler.from_spec(_spec(min_replicas=2))
+        d = a.evaluate_scaling([_replica(1), _replica(2)])
+        assert d.is_noop
+
+    def test_scales_down_excess_broken_first(self):
+        a = autoscalers.Autoscaler.from_spec(_spec(min_replicas=1))
+        d = a.evaluate_scaling([
+            _replica(1, ReplicaStatus.READY),
+            _replica(2, ReplicaStatus.NOT_READY),
+        ])
+        assert d.scale_down[0].replica_ids == [2]
+
+    def test_provisioning_counts_as_alive(self):
+        a = autoscalers.Autoscaler.from_spec(_spec(min_replicas=2))
+        d = a.evaluate_scaling([
+            _replica(1, ReplicaStatus.PROVISIONING),
+            _replica(2, ReplicaStatus.STARTING),
+        ])
+        assert d.is_noop
+
+
+class TestRequestRateAutoscaler:
+
+    def _autoscaler(self, **spec_kwargs):
+        spec_kwargs.setdefault('min_replicas', 1)
+        spec_kwargs.setdefault('max_replicas', 4)
+        spec_kwargs.setdefault('target_qps_per_replica', 1.0)
+        spec_kwargs.setdefault('upscale_delay_seconds', 2)
+        spec_kwargs.setdefault('downscale_delay_seconds', 2)
+        spec = _spec(**spec_kwargs)
+        return autoscalers.RequestRateAutoscaler(
+            spec, decision_interval_seconds=1.0, qps_window_seconds=10.0)
+
+    def _drive_qps(self, a, qps):
+        now = time.time()
+        a.request_timestamps = [now - 0.01 * i
+                                for i in range(int(qps * a.qps_window))]
+
+    def test_upscale_needs_sustained_traffic(self):
+        a = self._autoscaler()
+        replicas = [_replica(1)]
+        self._drive_qps(a, 3.0)
+        # Threshold = ceil(2/1) = 2 consecutive decisions.
+        assert a.evaluate_scaling(replicas).is_noop
+        d = a.evaluate_scaling(replicas)
+        assert d.scale_up and d.scale_up[0].count == 2
+
+    def test_spike_then_drop_does_not_upscale(self):
+        a = self._autoscaler()
+        replicas = [_replica(1)]
+        self._drive_qps(a, 3.0)
+        assert a.evaluate_scaling(replicas).is_noop
+        self._drive_qps(a, 1.0)  # spike gone → counter resets
+        assert a.evaluate_scaling(replicas).is_noop
+        self._drive_qps(a, 3.0)
+        assert a.evaluate_scaling(replicas).is_noop
+
+    def test_downscale_after_sustained_idle(self):
+        a = self._autoscaler()
+        replicas = [_replica(1), _replica(2), _replica(3)]
+        self._drive_qps(a, 0.0)
+        assert a.evaluate_scaling(replicas).is_noop
+        d = a.evaluate_scaling(replicas)
+        assert d.scale_down
+        # min_replicas=1: scale down to 1 (remove the 2 youngest).
+        assert len(d.scale_down[0].replica_ids) == 2
+
+    def test_max_replicas_cap(self):
+        a = self._autoscaler()
+        replicas = [_replica(1)]
+        self._drive_qps(a, 100.0)
+        a.evaluate_scaling(replicas)
+        d = a.evaluate_scaling(replicas)
+        assert d.scale_up[0].count == 3  # capped at max=4
+
+    def test_below_min_bypasses_hysteresis(self):
+        a = self._autoscaler(min_replicas=2)
+        d = a.evaluate_scaling([])
+        assert d.scale_up and d.scale_up[0].count == 2
+
+    def test_qps_window_expiry(self):
+        a = self._autoscaler()
+        a.collect_request_information([time.time() - 100])  # stale
+        assert len(a.request_timestamps) == 0
+        a.collect_request_information([time.time()])
+        assert len(a.request_timestamps) == 1
+
+
+class TestFallbackAutoscaler:
+
+    def _autoscaler(self, **spec_kwargs):
+        spec_kwargs.setdefault('min_replicas', 2)
+        spec_kwargs.setdefault('max_replicas', 4)
+        spec_kwargs.setdefault('target_qps_per_replica', 1.0)
+        spec_kwargs.setdefault('base_ondemand_fallback_replicas', 1)
+        spec_kwargs.setdefault('upscale_delay_seconds', 1)
+        spec_kwargs.setdefault('downscale_delay_seconds', 1)
+        spec = _spec(**spec_kwargs)
+        a = autoscalers.Autoscaler.from_spec(spec)
+        assert isinstance(a, autoscalers.FallbackRequestRateAutoscaler)
+        a.decision_interval = 1.0
+        a.update_spec(spec)
+        return a
+
+    def test_spot_plus_base_ondemand_mix(self):
+        a = self._autoscaler()
+        d = a.evaluate_scaling([])
+        spot_up = [u for u in d.scale_up if u.use_spot]
+        od_up = [u for u in d.scale_up if not u.use_spot]
+        assert sum(u.count for u in spot_up) == 1
+        assert sum(u.count for u in od_up) == 1
+
+    def test_dynamic_fallback_backfills_preempted_spot(self):
+        a = self._autoscaler(dynamic_ondemand_fallback=True)
+        # Target 2 = 1 spot + 1 base od; spot replica not READY →
+        # dynamic backfill requests one more on-demand.
+        replicas = [
+            _replica(1, ReplicaStatus.PROVISIONING, is_spot=True),
+            _replica(2, ReplicaStatus.READY, is_spot=False),
+        ]
+        d = a.evaluate_scaling(replicas)
+        od_up = [u for u in d.scale_up if not u.use_spot]
+        assert sum(u.count for u in od_up) == 1
+
+    def test_dynamic_fallback_drains_when_spot_ready(self):
+        a = self._autoscaler(dynamic_ondemand_fallback=True)
+        replicas = [
+            _replica(1, ReplicaStatus.READY, is_spot=True),
+            _replica(2, ReplicaStatus.READY, is_spot=False),
+            _replica(3, ReplicaStatus.READY, is_spot=False),  # backfill
+        ]
+        d = a.evaluate_scaling(replicas)
+        assert d.scale_down and len(d.scale_down[0].replica_ids) == 1
+
+
+class TestLoadBalancingPolicies:
+
+    def test_round_robin_cycles(self):
+        p = lb_policies.LoadBalancingPolicy.from_name('round_robin')
+        p.set_ready_replicas(['a', 'b', 'c'])
+        picks = [p.select_replica() for _ in range(6)]
+        assert picks == ['a', 'b', 'c', 'a', 'b', 'c']
+
+    def test_round_robin_empty(self):
+        p = lb_policies.LoadBalancingPolicy.from_name('round_robin')
+        assert p.select_replica() is None
+
+    def test_round_robin_reset_on_change(self):
+        p = lb_policies.LoadBalancingPolicy.from_name('round_robin')
+        p.set_ready_replicas(['a', 'b'])
+        p.select_replica()
+        p.set_ready_replicas(['a', 'b', 'c'])
+        assert p.select_replica() == 'a'
+
+    def test_least_requests(self):
+        p = lb_policies.LoadBalancingPolicy.from_name(
+            'least_number_of_requests')
+        p.set_ready_replicas(['a', 'b'])
+        first = p.select_replica()
+        p.pre_execute_hook(first)
+        second = p.select_replica()
+        assert second != first
+        p.post_execute_hook(first)
+        p.pre_execute_hook(second)
+        assert p.select_replica() == first
+
+    def test_unknown_policy(self):
+        with pytest.raises(ValueError):
+            lb_policies.LoadBalancingPolicy.from_name('nope')
+
+
+class TestServeState:
+
+    def test_service_roundtrip(self):
+        assert serve_state.add_service(
+            'svc', 'spec: {}', '/tmp/task.yaml', 20001, 30001,
+            'round_robin', 'local')
+        assert not serve_state.add_service(  # duplicate
+            'svc', 'spec: {}', '/tmp/task.yaml', 20002, 30002,
+            'round_robin', 'local')
+        rec = serve_state.get_service('svc')
+        assert rec['status'] == serve_state.ServiceStatus.CONTROLLER_INIT
+        assert rec['version'] == 1
+        serve_state.set_service_version('svc', 2)
+        assert serve_state.get_service('svc')['version'] == 2
+        serve_state.remove_service('svc')
+        assert serve_state.get_service('svc') is None
+
+    def test_replica_lifecycle(self):
+        serve_state.add_service('svc', '', '/t.yaml', 1, 2, 'round_robin',
+                                'local')
+        assert serve_state.next_replica_id('svc') == 1
+        serve_state.add_replica('svc', 1, 'svc-1', is_spot=True, version=1)
+        assert serve_state.next_replica_id('svc') == 2
+        serve_state.set_replica_status(
+            'svc', 1, serve_state.ReplicaStatus.READY)
+        rec = serve_state.get_replica('svc', 1)
+        assert rec['status'] == serve_state.ReplicaStatus.READY
+        assert rec['is_spot'] and rec['ready_at'] is not None
+        assert serve_state.bump_replica_failures('svc', 1) == 1
+        assert serve_state.bump_replica_failures('svc', 1) == 2
+        serve_state.clear_replica_failures('svc', 1)
+        assert serve_state.get_replica(
+            'svc', 1)['consecutive_failures'] == 0
