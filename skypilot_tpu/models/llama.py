@@ -44,6 +44,12 @@ class LlamaConfig:
     scan_layers: bool = True
     remat: bool = True
     attention_impl: str = 'flash'   # flash | ring | reference
+    # Attach logical-axis metadata to params (nn.with_partitioning).
+    # Disabled when modules are applied inside a shard_map manual region
+    # (pipeline stages): flax's apply-time shape validation eval_shapes
+    # the init fn, and a Partitioned box would then emit a sharding
+    # constraint with logical names against the abstract manual mesh.
+    partition_params: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -76,21 +82,49 @@ def get_config(name: str, **overrides: Any) -> LlamaConfig:
 
 
 # ---------------------------------------------------------------------------
+# shared forward pieces — used by Llama.__call__ AND the pipelined
+# trainer path (train/trainer.py _pipelined_apply), so the two forwards
+# cannot diverge on embed/position/head math.
+# ---------------------------------------------------------------------------
+def default_positions(tokens: jax.Array) -> jax.Array:
+    return jnp.broadcast_to(
+        jnp.arange(tokens.shape[1], dtype=jnp.int32)[None], tokens.shape)
+
+
+def embed_lookup(cfg: 'LlamaConfig', tok_embed: jax.Array,
+                 tokens: jax.Array) -> jax.Array:
+    return jnp.take(tok_embed.astype(cfg.dtype), tokens, axis=0)
+
+
+def apply_final_head(cfg: 'LlamaConfig', final_norm_params,
+                     lm_head_params, x: jax.Array) -> jax.Array:
+    """Final RMSNorm + lm_head on raw param trees (pipelined path).
+    Must mirror the inline modules at the end of Llama.__call__."""
+    x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params).apply(
+        {'params': final_norm_params}, x)
+    return nn.DenseGeneral(
+        cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+        param_dtype=cfg.param_dtype).apply({'params': lm_head_params}, x)
+
+
+# ---------------------------------------------------------------------------
 # building blocks
 # ---------------------------------------------------------------------------
-def _partitioned_init(init_fn: Callable, names: Tuple[Optional[str], ...]):
-    return nn.with_partitioning(init_fn, names)
+def _partitioned_init(init_fn: Callable, names: Tuple[Optional[str], ...],
+                      partition: bool = True):
+    return nn.with_partitioning(init_fn, names) if partition else init_fn
 
 
 class RMSNorm(nn.Module):
     eps: float
     dtype: Any
+    partition: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         scale = self.param('scale',
                            _partitioned_init(nn.initializers.ones,
-                                             ('embed',)),
+                                             ('embed',), self.partition),
                            (x.shape[-1],), jnp.float32)
         xf = x.astype(jnp.float32)
         norm = jax.lax.rsqrt(
@@ -125,7 +159,8 @@ class Attention(nn.Module):
             kernel_init=_partitioned_init(
                 nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5
                                        if name == 'o_proj'
-                                       else 0.02), names))
+                                       else 0.02), names,
+                cfg.partition_params))
         b, s, _ = x.shape
         h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         q = dense((h, hd), ('embed_fsdp', 'heads', 'head_dim'),
@@ -156,7 +191,7 @@ class Attention(nn.Module):
             param_dtype=cfg.param_dtype,
             kernel_init=_partitioned_init(
                 nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
-                ('heads', 'embed_fsdp')))(out)
+                ('heads', 'embed_fsdp'), cfg.partition_params))(out)
 
 
 class MLP(nn.Module):
@@ -169,7 +204,7 @@ class MLP(nn.Module):
             features, use_bias=False, name=name, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=_partitioned_init(nn.initializers.normal(0.02),
-                                          names))
+                                          names, cfg.partition_params))
         gate = dense(cfg.ffn_dim, ('embed_fsdp', 'mlp'), 'gate_proj')(x)
         up = dense(cfg.ffn_dim, ('embed_fsdp', 'mlp'), 'up_proj')(x)
         hidden = nn.silu(gate) * up
@@ -183,10 +218,12 @@ class Block(nn.Module):
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         cfg = self.config
         x = x + Attention(cfg, name='attention')(
-            RMSNorm(cfg.norm_eps, cfg.dtype, name='attention_norm')(x),
+            RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                    name='attention_norm')(x),
             positions)
         x = x + MLP(cfg, name='mlp')(
-            RMSNorm(cfg.norm_eps, cfg.dtype, name='mlp_norm')(x))
+            RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                    name='mlp_norm')(x))
         return x
 
 
@@ -199,15 +236,14 @@ class Llama(nn.Module):
                  positions: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         if positions is None:
-            positions = jnp.broadcast_to(
-                jnp.arange(tokens.shape[1], dtype=jnp.int32)[None],
-                tokens.shape)
+            positions = default_positions(tokens)
         embed = self.param(
             'tok_embed',
             _partitioned_init(nn.initializers.normal(1.0),
-                              ('vocab', 'embed_fsdp')),
+                              ('vocab', 'embed_fsdp'),
+                              cfg.partition_params),
             (cfg.vocab_size, cfg.dim), cfg.param_dtype)
-        x = jnp.take(embed.astype(cfg.dtype), tokens, axis=0)
+        x = embed_lookup(cfg, embed, tokens)
 
         block_cls = Block
         if cfg.remat:
@@ -225,13 +261,15 @@ class Llama(nn.Module):
         else:
             for i in range(cfg.n_layers):
                 x = block_cls(cfg, name=f'layer_{i}')(x, positions)
-        x = RMSNorm(cfg.norm_eps, cfg.dtype, name='final_norm')(x)
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                    name='final_norm')(x)
         # Tied-untied: separate output head (Llama3 unties embeddings).
         logits = nn.DenseGeneral(
             cfg.vocab_size, use_bias=False, name='lm_head',
             dtype=jnp.float32, param_dtype=cfg.param_dtype,
             kernel_init=_partitioned_init(nn.initializers.normal(0.02),
-                                          ('embed_fsdp', 'vocab')))(x)
+                                          ('embed_fsdp', 'vocab'),
+                                          cfg.partition_params))(x)
         return logits
 
 

@@ -1,0 +1,228 @@
+"""Mixture-of-Experts model family (Mixtral-style), expert-parallel.
+
+The reference only *serves* MoE models through vLLM/DeepSpeed recipes
+(reference `llm/mixtral/`, `llm/dbrx/` — SURVEY.md §2.11: "vLLM/DeepSpeed
+handle EP internally"); here expert parallelism is first-party:
+
+  - experts are stacked parameters [E, ...] carrying the `experts`
+    logical axis, sharded over the `expert` mesh axis
+    (parallel/sharding.py);
+  - routing is top-k (k=2 for Mixtral) with a capacity factor; dispatch
+    and combine are dense one-hot einsums (GShard/Switch formulation) so
+    shapes stay static and XLA lowers the token movement to
+    all-to-alls over the expert axis — no ragged ops, no host control
+    flow;
+  - a load-balance auxiliary loss (Switch Transformers) is sown under
+    `intermediates/aux_loss` for the trainer to fold in;
+  - everything else (GQA flash attention, RMSNorm, rope, scan/remat)
+    reuses the Llama blocks, so dp/fsdp/tp compose with ep.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(llama.LlamaConfig):
+    n_experts: int = 8
+    experts_per_token: int = 2
+    # capacity per expert = capacity_factor * tokens * k / E.
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.02
+
+
+CONFIGS: Dict[str, MoEConfig] = {
+    'mixtral-tiny': MoEConfig(
+        'mixtral-tiny', vocab_size=512, dim=256, n_layers=2, n_heads=2,
+        n_kv_heads=1, ffn_dim=512, max_seq_len=512, n_experts=4,
+        experts_per_token=2),
+    'mixtral-8x7b': MoEConfig(
+        'mixtral-8x7b', vocab_size=32000, dim=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, ffn_dim=14336, max_seq_len=32768,
+        rope_theta=1e6, n_experts=8, experts_per_token=2),
+    'mixtral-8x22b': MoEConfig(
+        'mixtral-8x22b', vocab_size=32768, dim=6144, n_layers=56,
+        n_heads=48, n_kv_heads=8, ffn_dim=16384, max_seq_len=65536,
+        rope_theta=1e6, n_experts=8, experts_per_token=2),
+}
+
+
+def get_config(name: str, **overrides: Any) -> MoEConfig:
+    if name not in CONFIGS:
+        raise ValueError(f'Unknown MoE config {name!r}; '
+                         f'available: {sorted(CONFIGS)}')
+    return dataclasses.replace(CONFIGS[name], **overrides)
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed expert FFN with capacity-based dense dispatch."""
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        b, s, d = x.shape
+        n_exp, k = cfg.n_experts, cfg.experts_per_token
+        tokens = b * s
+        capacity = max(
+            1, int(cfg.capacity_factor * tokens * k / n_exp))
+
+        xf = x.reshape(tokens, d)
+        # Router in f32 for a stable softmax.
+        router_logits = nn.DenseGeneral(
+            n_exp, use_bias=False, name='router', dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            kernel_init=llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(0.02), ('embed', None),
+                cfg.partition_params))(xf.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)       # [T, E]
+        gate_vals, expert_idx = jax.lax.top_k(probs, k)      # [T, k]
+        # Mixtral renormalizes the top-k gate weights.
+        gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+
+        # Position of each (token, choice) in its expert's buffer:
+        # running count of prior assignments to the same expert, counted
+        # over the flattened (choice-major) assignment list so the two
+        # choices of one token never collide.
+        assign = jax.nn.one_hot(expert_idx, n_exp,
+                                dtype=jnp.int32)             # [T, k, E]
+        flat_assign = assign.transpose(1, 0, 2).reshape(
+            k * tokens, n_exp)                               # [kT, E]
+        pos_flat = jnp.cumsum(flat_assign, axis=0) - flat_assign
+        position = jnp.einsum('fe,fe->f', pos_flat,
+                              flat_assign).reshape(k, tokens)
+        position = position.T                                 # [T, k]
+        keep = position < capacity
+
+        # Load-balance aux loss (Switch): mean gate fraction * mean
+        # dispatch fraction per expert, scaled by E.
+        me = jnp.mean(probs, axis=0)
+        ce = jnp.mean(assign.sum(1).astype(jnp.float32), axis=0)
+        aux = cfg.router_aux_coef * n_exp * jnp.sum(me * ce)
+        self.sow('intermediates', 'aux_loss', aux)
+
+        # Dense dispatch/combine tensors.
+        pos_oh = jax.nn.one_hot(jnp.where(keep, position, capacity),
+                                capacity, dtype=xf.dtype)    # [T, k, C]
+        disp = jnp.einsum('tke,tkc->tec',
+                          assign.astype(xf.dtype), pos_oh)   # [T, E, C]
+        comb = jnp.einsum('tec,tk,tke->tec', disp,
+                          gate_vals.astype(xf.dtype),
+                          assign.astype(xf.dtype))           # weighted
+
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        expert_in = jnp.einsum('tec,td->ecd', disp, xf)      # [E, C, D]
+        # Pin the expert-parallel layout: XLA turns the dispatch einsum
+        # into an all-to-all over the expert axis.
+        expert_in = sharding_lib.maybe_constraint(
+            expert_in, jax.sharding.PartitionSpec('expert', None, None))
+
+        # Batched expert FFN over the expert-stacked params.
+        gate_p = self.param(
+            'gate_proj',
+            llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(0.02),
+                ('experts', 'embed_fsdp', 'mlp'), cfg.partition_params),
+            (n_exp, d, cfg.ffn_dim), cfg.param_dtype)
+        up_p = self.param(
+            'up_proj',
+            llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(0.02),
+                ('experts', 'embed_fsdp', 'mlp'), cfg.partition_params),
+            (n_exp, d, cfg.ffn_dim), cfg.param_dtype)
+        down_p = self.param(
+            'down_proj',
+            llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(0.02),
+                ('experts', 'mlp', 'embed_fsdp'), cfg.partition_params),
+            (n_exp, cfg.ffn_dim, d), cfg.param_dtype)
+
+        h = expert_in.astype(cfg.dtype)
+        gate = jnp.einsum('ecd,edf->ecf', h, gate_p.astype(cfg.dtype))
+        up = jnp.einsum('ecd,edf->ecf', h, up_p.astype(cfg.dtype))
+        act = nn.silu(gate) * up
+        expert_out = jnp.einsum('ecf,efd->ecd', act,
+                                down_p.astype(cfg.dtype))    # [E, C, D]
+
+        out = jnp.einsum('tec,ecd->td', comb.astype(cfg.dtype),
+                         expert_out)
+        return out.reshape(b, s, d)
+
+
+class MoEBlock(nn.Module):
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = x + llama.Attention(cfg, name='attention')(
+            llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                          name='attention_norm')(x), positions)
+        x = x + MoEMLP(cfg, name='moe_mlp')(
+            llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                          name='mlp_norm')(x))
+        return x
+
+
+class Mixtral(nn.Module):
+    """Decoder-only MoE transformer; returns logits [B, S, vocab]."""
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, positions=None) -> jax.Array:
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32)[None],
+                tokens.shape)
+        embed = self.param(
+            'tok_embed',
+            llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(1.0), ('vocab', 'embed_fsdp'),
+                cfg.partition_params),
+            (cfg.vocab_size, cfg.dim), cfg.param_dtype)
+        x = jnp.take(embed.astype(cfg.dtype), tokens, axis=0)
+
+        block_cls = MoEBlock
+        if cfg.remat:
+            block_cls = nn.remat(
+                MoEBlock, prevent_cse=not cfg.scan_layers,
+                policy=jax.checkpoint_policies.nothing_saveable)
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mod, carry, _: (mod(carry, positions), None),
+                variable_axes={'params': 0, 'intermediates': 0},
+                split_rngs={'params': True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: 'layers'},
+            )(block_cls(cfg, name='layers'), x, None)
+        else:
+            for i in range(cfg.n_layers):
+                x = block_cls(cfg, name=f'layer_{i}')(x, positions)
+        x = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                          name='final_norm')(x)
+        logits = nn.DenseGeneral(
+            cfg.vocab_size, use_bias=False, name='lm_head',
+            dtype=jnp.float32, param_dtype=cfg.param_dtype,
+            kernel_init=llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(0.02), ('embed_fsdp', 'vocab'),
+                cfg.partition_params))(x)
+        return logits
+
+
+def num_params(config: MoEConfig) -> int:
+    cfg = config
+    attn = cfg.dim * cfg.head_dim * (cfg.n_heads + 2 * cfg.n_kv_heads) \
+        + cfg.n_heads * cfg.head_dim * cfg.dim
+    moe = cfg.n_experts * 3 * cfg.dim * cfg.ffn_dim \
+        + cfg.dim * cfg.n_experts
+    per_layer = attn + moe + 2 * cfg.dim
+    return (cfg.vocab_size * cfg.dim * 2
+            + cfg.n_layers * per_layer + cfg.dim)

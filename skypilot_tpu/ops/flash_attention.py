@@ -35,6 +35,27 @@ def _on_tpu() -> bool:
     return jax.default_backend() == 'tpu'
 
 
+def _out_vma(*arrays):
+    """Varying-manual-axes type for pallas outputs: the union of the
+    inputs' vma (empty outside shard_map; e.g. {'pipe'} inside a
+    pipeline stage, {'context'} inside a ring-attention shard)."""
+    vmas = [getattr(jax.typeof(a), 'vma', None) for a in arrays]
+    vmas = [v for v in vmas if v is not None]
+    if not vmas:
+        return None
+    return frozenset().union(*vmas)
+
+
+def _cast_vma(x: jax.Array, vma) -> jax.Array:
+    """Mark a freshly-created (replicated-typed) array as varying over
+    `vma` so scan carries type-check inside shard_map manual regions."""
+    have = getattr(jax.typeof(x), 'vma', None) or frozenset()
+    missing = (vma or frozenset()) - have
+    if missing:
+        return jax.lax.pcast(x, tuple(missing), to='varying')
+    return x
+
+
 def _pick_block(seq: int, requested: int, what: str) -> int:
     """Largest block <= requested that exactly divides seq.
 
@@ -145,8 +166,10 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float,
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype,
+                                 vma=_out_vma(q3, k3, v3)),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32,
+                                 vma=_out_vma(q3, k3, v3)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -172,6 +195,7 @@ def _flash_bwd(scale: float, causal: bool, block_q: int, block_kv: int,
     block_kv = _pick_block(seq_kv, block_kv, 'key/value')
     nq = seq_q // block_q
     nk = seq_kv // block_kv
+    vma = _out_vma(q, k, v, do)
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
@@ -211,7 +235,7 @@ def _flash_bwd(scale: float, causal: bool, block_q: int, block_kv: int,
             dv_j = jnp.einsum('bhqk,bhqd->bhkd', p, do_i)
             return dq_i, (dk_j, dv_j)
 
-        dq_i0 = jnp.zeros_like(q_i)
+        dq_i0 = _cast_vma(jnp.zeros_like(q_i), vma)
         dq_i, (dk_js, dv_js) = jax.lax.scan(kv_step, dq_i0,
                                             jnp.arange(nk))
         # dk_js: [nk,B,H,bkv,d] — accumulate into the carried full dk/dv.
@@ -223,8 +247,10 @@ def _flash_bwd(scale: float, causal: bool, block_q: int, block_kv: int,
 
     (dk, dv), dq_blocks = jax.lax.scan(
         q_step,
-        (jnp.zeros((batch, heads, seq_kv, d), jnp.float32),
-         jnp.zeros((batch, heads, seq_kv, d), jnp.float32)),
+        (_cast_vma(jnp.zeros((batch, heads, seq_kv, d), jnp.float32),
+                   vma),
+         _cast_vma(jnp.zeros((batch, heads, seq_kv, d), jnp.float32),
+                   vma)),
         jnp.arange(nq))
     dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(batch, heads, seq_q, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)

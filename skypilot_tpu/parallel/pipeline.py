@@ -1,0 +1,154 @@
+"""Pipeline parallelism: GPipe-style microbatching over the `pipe` axis.
+
+The reference has no pipeline parallelism of its own — its recipes
+delegate PP to DeepSpeed (reference `examples/deepspeed-multinode/sky.yaml`,
+SURVEY.md §2.11); here it is a first-class mesh axis, TPU-style:
+
+  - the model's layer-stacked parameters ([L, ...] from nn.scan) are
+    sharded over `pipe` so each device group owns L/P contiguous layers
+    (one *stage*);
+  - the batch is split into M microbatches; a `jax.shard_map` manual
+    only over `pipe` (all other axes — fsdp/tensor/... — stay automatic,
+    so in-stage sharding is still compiler-partitioned) runs the classic
+    GPipe schedule as a lax.scan over M+P-1 ticks: stage 0 injects
+    microbatch t, every stage applies its layers, activations hop to the
+    next stage via `jax.lax.ppermute` (neighbor ICI hop), the last stage
+    collects outputs;
+  - the whole schedule is differentiable (scan + ppermute + where), so
+    the backward pipeline is the automatic transpose — activations flow
+    back through the inverse permutes with no hand-written adjoint;
+  - bubble fraction is (P-1)/(M+P-1); choose M >= 2P to keep it small.
+
+This module is schedule-generic: `gpipe` takes any stage function, so it
+also pipelines non-transformer stage stacks.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _spec_leading(axis_name: str):
+    return P(axis_name)
+
+
+def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
+          stage_params: Any,
+          microbatches: jax.Array,
+          *,
+          mesh: Mesh,
+          axis_name: str = 'pipe') -> jax.Array:
+    """Run `stage_fn` as a GPipe pipeline over `axis_name`.
+
+    Args:
+      stage_fn: (local_stage_params, x) -> y applied by each stage. Its
+        params are the per-stage slice of `stage_params`; x/y share the
+        microbatch shape.
+      stage_params: pytree whose leaves carry the stage dimension at
+        axis 0 with total extent divisible by the axis size
+        (layer-stacked params: [L, ...] -> local [L/P, ...]).
+      microbatches: [M, ...microbatch shape...], replicated over
+        `axis_name` (other mesh axes may shard the inner dims; they stay
+        automatic).
+      mesh: the device mesh containing `axis_name`.
+
+    Returns:
+      [M, ...] outputs of the final stage, replicated over `axis_name`.
+    """
+    n_stages = mesh.shape[axis_name]
+    if n_stages == 1:
+        # Degenerate pipeline: plain sequential application.
+        return jax.lax.map(lambda mb: stage_fn(stage_params, mb),
+                           microbatches)
+
+    num_micro = microbatches.shape[0]
+    if num_micro < n_stages:
+        raise ValueError(
+            f'need >= {n_stages} microbatches to fill a {n_stages}-stage '
+            f'pipeline, got {num_micro}.')
+
+    # XLA's CPU backend crashes on low-precision psum inside a
+    # partially-manual shard_map (including the psum that autodiff
+    # inserts as the transpose of the replicated->varying cast below),
+    # so off-TPU the pipeline boundary runs in f32; stages still compute
+    # in the model dtype.  On TPU activations stay bf16 end to end.
+    orig_dtype = microbatches.dtype
+    boundary_f32 = (orig_dtype in (jnp.bfloat16, jnp.float16)
+                    and jax.default_backend() != 'tpu')
+    work_dtype = jnp.float32 if boundary_f32 else orig_dtype
+
+    inner_stage_fn = stage_fn
+    if boundary_f32:
+        def stage_fn(p, x):  # noqa: F811
+            return inner_stage_fn(p, x.astype(orig_dtype)).astype(
+                work_dtype)
+
+    def _pipelined(local_params, mbs):
+        # The (replicated) microbatch buffer feeds scan carries / cond
+        # branches whose other operands vary over the pipe axis; cast it
+        # varying so the VMA types line up.
+        if axis_name not in (getattr(jax.typeof(mbs), 'vma', None)
+                             or frozenset()):
+            mbs = jax.lax.pcast(mbs, (axis_name,), to='varying')
+        my = jax.lax.axis_index(axis_name)
+        # Shift activations to the next stage (no wraparound: the last
+        # stage's output leaves the pipeline through the output buffer).
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            state, out = carry
+            inject = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, num_micro - 1), axis=0,
+                keepdims=False)
+            x_in = jnp.where(my == 0, inject, state)
+            y = stage_fn(local_params, x_in)
+            j = t - (n_stages - 1)
+            is_output = (my == n_stages - 1) & (j >= 0) & (j < num_micro)
+            out = jax.lax.cond(
+                is_output,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(j, 0, num_micro - 1), 0),
+                lambda o: o, out)
+            state = jax.lax.ppermute(y, axis_name, perm)
+            return (state, out), None
+
+        state0 = jnp.zeros_like(mbs[0])
+        out0 = jnp.zeros_like(mbs)
+        (_, out), _ = jax.lax.scan(
+            tick, (state0, out0), jnp.arange(num_micro + n_stages - 1))
+        # Only the last stage wrote `out`; psum replicates it to every
+        # stage (zeros elsewhere), keeping out_specs replicated so the
+        # surrounding auto-sharded graph (final norm / lm head / loss)
+        # sees a normal array.  The psum runs in f32: low-precision psum
+        # under partially-manual shard_map crashes the XLA CPU backend
+        # ("Invalid binary instruction opcode copy"), and one f32
+        # all-reduce per step is noise on TPU anyway.
+        return jax.lax.psum(out.astype(jnp.float32),
+                            axis_name).astype(out.dtype)
+
+    out = jax.shard_map(
+        _pipelined,
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: _spec_leading(axis_name),
+                               stage_params), P()),
+        out_specs=P(),
+        axis_names=frozenset({axis_name}),
+    )(stage_params, microbatches.astype(work_dtype))
+    return out.astype(orig_dtype)
+
+
+def microbatch(x: jax.Array, num_micro: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...]."""
+    if x.shape[0] % num_micro:
+        raise ValueError(
+            f'batch {x.shape[0]} not divisible by {num_micro} '
+            f'microbatches.')
+    return x.reshape(num_micro, x.shape[0] // num_micro, *x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """[M, B/M, ...] -> [B, ...]."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])

@@ -38,6 +38,9 @@ DEFAULT_RULES: Dict[str, Optional[Union[str, Tuple[str, ...]]]] = {
     'vocab': 'tensor',
     'experts': 'expert',
     'stage': 'pipe',
+    # nn.scan-stacked layer dim: sharded over pipe so each pipeline
+    # stage owns a contiguous block of layers (parallel/pipeline.py).
+    'layers': 'pipe',
     None: None,
 }
 
@@ -85,6 +88,40 @@ def params_to_shardings(mesh: Mesh, params: Any,
 
     return jax.tree.map(_leaf, params,
                         is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def _ambient_mesh_axes() -> tuple:
+    """Axis names of whichever mesh is in context during tracing: the
+    new-style abstract mesh (jax.set_mesh) or the legacy `with mesh:`
+    thread resource env — the latter is what Trainer.step uses, and
+    PartitionSpec sharding constraints resolve against it inside jit."""
+    mesh = jax.sharding.get_abstract_mesh()
+    axes = getattr(mesh, 'axis_names', ()) or ()
+    if axes:
+        return tuple(axes)
+    try:
+        from jax._src import mesh as mesh_src
+        physical = mesh_src.thread_resources.env.physical_mesh
+        if physical is not None and not physical.empty:
+            return tuple(physical.axis_names)
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return ()
+
+
+def maybe_constraint(x: jax.Array, spec: P) -> jax.Array:
+    """with_sharding_constraint that degrades to a no-op when no mesh
+    (or a mesh lacking the referenced axes) is in context — lets model
+    code carry layout hints without requiring a mesh in unit tests."""
+    axes = _ambient_mesh_axes()
+    referenced = []
+    for entry in spec:
+        if entry is None:
+            continue
+        referenced.extend(entry if isinstance(entry, tuple) else (entry,))
+    if not axes or any(a not in axes for a in referenced):
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -45,6 +45,10 @@ class TrainConfig:
     weight_decay: float = 0.1
     grad_clip_norm: float = 1.0
     grad_accum_steps: int = 1
+    # Pipeline parallelism: microbatches per step when the mesh has a
+    # pipe axis > 1 (None -> 2 * pipe stages, keeping the GPipe bubble
+    # under a third).
+    pipeline_microbatches: Optional[int] = None
     mesh: mesh_lib.MeshConfig = mesh_lib.MeshConfig()
     model_overrides: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
@@ -72,18 +76,33 @@ def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     )
 
 
+def sum_aux_losses(mutated_collections) -> jax.Array:
+    """Total of every `aux_loss` sown during apply (MoE router
+    load-balance terms; stacked over scanned layers)."""
+    total = jnp.zeros((), jnp.float32)
+    if not mutated_collections:
+        return total
+    flat = jax.tree_util.tree_flatten_with_path(
+        dict(mutated_collections))[0]
+    for path, leaf in flat:
+        if any(getattr(p, 'key', '') == 'aux_loss' for p in path):
+            total = total + jnp.sum(leaf)
+    return total
+
+
 def loss_fn(params, apply_fn, batch) -> Tuple[jax.Array, Dict[str, Any]]:
-    logits = apply_fn({'params': params}, batch['inputs'])
+    logits, aux_loss = apply_fn({'params': params}, batch['inputs'])
     targets = batch['targets']
     mask = batch['mask']
     logits = logits.astype(jnp.float32)
     ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     total_weight = jnp.maximum(mask.sum(), 1.0)
-    loss = (ce * mask).sum() / total_weight
+    ce_loss = (ce * mask).sum() / total_weight
+    loss = ce_loss + aux_loss
     accuracy = ((jnp.argmax(logits, -1) == targets) * mask).sum() / \
         total_weight
-    return loss, {'loss': loss, 'accuracy': accuracy,
-                  'tokens': total_weight}
+    return loss, {'loss': ce_loss, 'accuracy': accuracy,
+                  'tokens': total_weight, 'aux_loss': aux_loss}
 
 
 def train_step(state: TrainState, batch: Dict[str, jax.Array],
@@ -108,7 +127,8 @@ def train_step(state: TrainState, batch: Dict[str, jax.Array],
                                 *x.shape[1:]), batch)
         zero_grads = jax.tree.map(jnp.zeros_like, state.params)
         zero_metrics = {'loss': jnp.float32(0), 'accuracy': jnp.float32(0),
-                        'tokens': jnp.float32(0)}
+                        'tokens': jnp.float32(0),
+                        'aux_loss': jnp.float32(0)}
         (grads, metrics), _ = jax.lax.scan(
             micro, (zero_grads, zero_metrics), microbatches)
         grads = jax.tree.map(lambda g: g / grad_accum_steps, grads)
@@ -127,9 +147,10 @@ class Trainer:
 
     def __init__(self, config: TrainConfig,
                  mesh: Optional[Mesh] = None) -> None:
+        import skypilot_tpu.models as models_lib
         self.config = config
-        self.model_config = llama.get_config(config.model,
-                                             **config.model_overrides)
+        self.model, self.model_config = models_lib.get_model(
+            config.model, **config.model_overrides)
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
             config.mesh)
         tensor = self.mesh.shape['tensor']
@@ -146,7 +167,27 @@ class Trainer:
             raise ValueError(
                 f'per-step microbatch {micro} must be divisible by the '
                 f'data*fsdp shards ({n_batch}).')
-        self.model = llama.Llama(self.model_config)
+        n_pipe = self.mesh.shape['pipe']
+        if n_pipe > 1:
+            if hasattr(self.model_config, 'n_experts'):
+                raise ValueError('pipeline parallelism does not yet '
+                                 'compose with MoE models.')
+            if not self.model_config.scan_layers:
+                raise ValueError('pipeline parallelism requires '
+                                 'scan_layers=True (stacked layer params).')
+            if self.model_config.n_layers % n_pipe:
+                raise ValueError(
+                    f'pipe={n_pipe} must divide n_layers='
+                    f'{self.model_config.n_layers}.')
+            pp_micro = config.pipeline_microbatches or 2 * n_pipe
+            if pp_micro < n_pipe or micro % pp_micro:
+                raise ValueError(
+                    f'pipeline microbatches {pp_micro} must be >= '
+                    f'pipe={n_pipe} and divide the per-step batch '
+                    f'{micro}.')
+            self.pp_microbatches = pp_micro
+        else:
+            self.pp_microbatches = 0
         self.tx = make_optimizer(config)
         self._jit_step = None
         self.state: Optional[TrainState] = None
@@ -208,7 +249,49 @@ class Trainer:
         return self.state
 
     def _apply_unboxed(self, variables, tokens):
-        return self.model.apply(variables, tokens)
+        """Returns (logits, aux_loss)."""
+        if self.pp_microbatches:
+            return (self._pipelined_apply(variables['params'], tokens),
+                    jnp.zeros((), jnp.float32))
+        if hasattr(self.model_config, 'n_experts'):
+            # MoE: collect the sown router load-balance losses.
+            logits, mutated = self.model.apply(
+                variables, tokens, mutable=['intermediates'])
+            return logits, sum_aux_losses(mutated)
+        return self.model.apply(variables, tokens), \
+            jnp.zeros((), jnp.float32)
+
+    def _pipelined_apply(self, params, tokens):
+        """Forward with the decoder blocks run as a GPipe pipeline over
+        the `pipe` mesh axis (embed / final norm / lm_head stay in the
+        surrounding auto-sharded graph)."""
+        from skypilot_tpu.parallel import pipeline as pipeline_lib
+
+        cfg = dataclasses.replace(self.model_config,
+                                  partition_params=False)
+        x = llama.embed_lookup(cfg, params['tok_embed'], tokens)
+        block = llama.Block(cfg)
+
+        def block_apply(layer_params, h, pos):
+            return block.apply({'params': layer_params}, h, pos)
+
+        if cfg.remat:
+            block_apply = jax.checkpoint(
+                block_apply,
+                policy=jax.checkpoint_policies.nothing_saveable)
+
+        def stage_fn(local_layers, mb):
+            pos = llama.default_positions(mb[..., 0])
+            return jax.lax.scan(
+                lambda h, lp: (block_apply(lp, h, pos), None),
+                mb, local_layers)[0]
+
+        mbs = pipeline_lib.microbatch(x, self.pp_microbatches)
+        x = pipeline_lib.unmicrobatch(
+            pipeline_lib.gpipe(stage_fn, params['layers'], mbs,
+                               mesh=self.mesh))
+        return llama.apply_final_head(cfg, params['final_norm'],
+                                      params['lm_head'], x)
 
     # -- stepping ----------------------------------------------------------
     def compiled_step(self):
