@@ -1,0 +1,2 @@
+"""Benchmark tooling: collective bandwidth (nccl-tests analog) and the
+multi-resource task benchmark harness (`sky bench` analog)."""

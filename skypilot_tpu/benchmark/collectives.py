@@ -1,0 +1,153 @@
+"""Collective bandwidth benchmark over ICI/DCN — the nccl-tests analog.
+
+The reference ships `examples/nccl_test.yaml` (all_reduce_perf via
+torchrun over NCCL; published anchor: algbw 2.053 GB/s / busbw 3.850
+GB/s at 4 GB payload on 2x A100:8 across TCP — BASELINE.md).  Here the
+same measurement runs on XLA collectives over the device mesh:
+
+  - all-reduce (psum), all-gather, reduce-scatter, ppermute (ring hop)
+    and all-to-all, each timed at a sweep of payload sizes;
+  - bus bandwidth uses the standard nccl-tests correction factors so
+    numbers are directly comparable to the reference's NCCL anchors:
+    all-reduce 2(n-1)/n, all-gather/reduce-scatter (n-1)/n,
+    ppermute/all-to-all 1;
+  - multi-host: run under the gang launcher; `jax.distributed` is
+    initialized by train/launcher.py and the mesh spans all processes'
+    devices, so the same script measures ICI within a slice and DCN
+    across slices.
+
+CLI: python -m skypilot_tpu.benchmark.collectives --sizes-mb 1,16,64
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+_AXIS = 'x'
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveResult:
+    op: str
+    payload_bytes: int
+    num_devices: int
+    seconds: float
+    algbw_gbps: float
+    busbw_gbps: float
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def _busbw_factor(op: str, n: int) -> float:
+    if op == 'all_reduce':
+        return 2.0 * (n - 1) / n
+    if op in ('all_gather', 'reduce_scatter'):
+        return (n - 1) / n
+    return 1.0
+
+
+def _collective_fns(n: int) -> Dict[str, Callable]:
+    ring = [(i, (i + 1) % n) for i in range(n)]
+    return {
+        'all_reduce': lambda x: jax.lax.psum(x, _AXIS),
+        'all_gather': lambda x: jax.lax.all_gather(x, _AXIS),
+        'reduce_scatter': lambda x: jax.lax.psum_scatter(
+            x, _AXIS, tiled=True),
+        'ppermute': lambda x: jax.lax.ppermute(x, _AXIS, ring),
+        'all_to_all': lambda x: jax.lax.all_to_all(
+            x.reshape(n, -1), _AXIS, 0, 0, tiled=True),
+    }
+
+
+def run_bench(ops: Optional[Sequence[str]] = None,
+              sizes_mb: Sequence[float] = (1, 16, 64),
+              iters: int = 10,
+              warmup: int = 2,
+              devices: Optional[Sequence[jax.Device]] = None
+              ) -> List[CollectiveResult]:
+    """Time each collective at each payload size; returns results.
+
+    Sizes are the GLOBAL message size in MB (f32), nccl-tests
+    convention — the per-device shard is size/n."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n < 2:
+        raise ValueError('collective bench needs >= 2 devices')
+    mesh = Mesh(np.array(devices), (_AXIS,))
+    fns = _collective_fns(n)
+    # Output layout per op: psum's replication is inferred; all_gather's
+    # is not provable to the vma checker, so its [n, shard] output is
+    # typed as sharded — fine here, only timing matters.
+    out_specs = {'all_reduce': P(), 'all_gather': P(_AXIS),
+                 'reduce_scatter': P(_AXIS), 'ppermute': P(_AXIS),
+                 'all_to_all': P(_AXIS)}
+    ops = list(ops) if ops else list(fns)
+    results: List[CollectiveResult] = []
+    for op in ops:
+        if op not in fns:
+            raise ValueError(f'unknown op {op!r}; have {sorted(fns)}')
+        for mb in sizes_mb:
+            # `mb` is the GLOBAL message size (nccl-tests convention);
+            # round so shards divide evenly (all_to_all needs n^2).
+            elems = max(int(mb * 1024 * 1024 // 4), n * n)
+            elems -= elems % (n * n)
+            global_x = jnp.arange(elems, dtype=jnp.float32)
+            fn = jax.jit(jax.shard_map(
+                fns[op], mesh=mesh, in_specs=P(_AXIS),
+                out_specs=out_specs[op]))
+            fn(global_x).block_until_ready()   # compile
+            for _ in range(warmup):
+                fn(global_x).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(global_x)
+            out.block_until_ready()
+            dt = (time.perf_counter() - t0) / iters
+            payload = elems * 4
+            algbw = payload / dt / 1e9
+            busbw = algbw * _busbw_factor(op, n)
+            results.append(CollectiveResult(
+                op=op, payload_bytes=payload, num_devices=n,
+                seconds=dt, algbw_gbps=algbw, busbw_gbps=busbw))
+    return results
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--ops', default=None,
+                        help='comma list: all_reduce,all_gather,...')
+    parser.add_argument('--sizes-mb', default='1,16,64')
+    parser.add_argument('--iters', type=int, default=10)
+    parser.add_argument('--json', action='store_true')
+    parser.add_argument('--distributed', action='store_true',
+                        help='initialize jax.distributed from the gang '
+                             'launcher env first (multi-host)')
+    args = parser.parse_args()
+    if args.distributed:
+        from skypilot_tpu.train import launcher
+        launcher.maybe_initialize_distributed()
+    ops = args.ops.split(',') if args.ops else None
+    sizes = [float(s) for s in args.sizes_mb.split(',')]
+    results = run_bench(ops=ops, sizes_mb=sizes, iters=args.iters)
+    if args.json:
+        print(json.dumps([r.to_dict() for r in results]))
+        return
+    print(f'{"op":<15} {"payload":>12} {"time":>10} {"algbw":>10} '
+          f'{"busbw":>10}')
+    for r in results:
+        print(f'{r.op:<15} {r.payload_bytes/1e6:>10.1f}MB '
+              f'{r.seconds*1e3:>8.2f}ms {r.algbw_gbps:>8.2f}GB/s '
+              f'{r.busbw_gbps:>8.2f}GB/s')
+
+
+if __name__ == '__main__':
+    main()

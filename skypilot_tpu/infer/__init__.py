@@ -1,0 +1,10 @@
+"""TPU-native inference: KV-cache engine + HTTP server.
+
+The reference serves LLMs by shelling out to vLLM/TGI recipes
+(reference `llm/qwen`, `llm/mixtral` — SURVEY.md §2.11); here serving is
+first-party so SkyServe replicas run a framework-owned engine
+(JetStream-style prefill/decode split) instead of an external binary.
+"""
+from skypilot_tpu.infer.engine import (InferenceEngine, SamplingConfig)
+
+__all__ = ['InferenceEngine', 'SamplingConfig']

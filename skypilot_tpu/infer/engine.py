@@ -1,0 +1,304 @@
+"""KV-cache autoregressive inference engine (prefill/decode split).
+
+JetStream-style serving loop, TPU-first:
+
+  - **prefill**: one jitted full-prompt forward writes K/V into a
+    static-shape cache [B, kv_heads, max_seq_len, head_dim] per layer
+    (models/llama.py `_cached_attention`) — large matmuls, MXU-bound.
+    Prompts are right-padded to bucket multiples so the set of compiled
+    prefill shapes is small and the readiness warmup is honest;
+  - **decode**: ONE jitted step per generated token that fuses
+    sampling, the kv-mask slot write, and the forward — the host loop
+    only fetches the sampled ids (needed for output/eos anyway);
+  - ragged batches share one batch via the [B, max_seq_len] kv-mask, so
+    rows of different lengths can't cross-contaminate (verified against
+    cache-free re-forwarding in tests/unit_tests/test_infer.py);
+  - params are served in bf16 by default (no optimizer here; f32 master
+    weights are a training concern), sharded over a mesh when given,
+    and loadable from a trainer Orbax checkpoint (the bucket-checkpoint
+    contract, train/checkpoint.py).
+
+The reference's serving path is an external vLLM container
+(`llm/qwen/serve-110b.yaml` — SURVEY.md §2.11); this engine is the
+framework-native replacement that SkyServe replicas run
+(infer/server.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import skypilot_tpu.models as models_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu.parallel import sharding as sharding_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    temperature: float = 0.0     # 0 => greedy
+    top_k: int = 0               # 0 => disabled
+    top_p: float = 1.0           # 1 => disabled
+    eos_id: Optional[int] = None
+    max_new_tokens: int = 64
+
+
+def sample_logits(logits: jax.Array, rng: jax.Array,
+                  config: SamplingConfig) -> jax.Array:
+    """Sample token ids [B] from logits [B, V]."""
+    if config.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / config.temperature
+    if config.top_k > 0:
+        kth = jax.lax.top_k(logits, config.top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if config.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Smallest set of tokens whose mass exceeds top_p.
+        cutoff_idx = jnp.sum(cum < config.top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def _cache_sharding(mesh, leaf) -> NamedSharding:
+    """KV caches shard their kv-heads dim over `tensor` (matching the
+    attention head sharding); scalars/cursors replicate.  Leaf shapes:
+    [B, kvh, S, hd] unscanned, [L, B, kvh, S, hd] scanned."""
+    tensor = mesh.shape.get('tensor', 1)
+    if leaf.ndim == 4 and leaf.shape[1] % max(tensor, 1) == 0:
+        return NamedSharding(mesh, P(None, 'tensor', None, None))
+    if leaf.ndim == 5 and leaf.shape[2] % max(tensor, 1) == 0:
+        return NamedSharding(mesh, P(None, None, 'tensor', None, None))
+    return NamedSharding(mesh, P())
+
+
+class InferenceEngine:
+    """Batched KV-cache generation over a (possibly sharded) model."""
+
+    def __init__(self, model: str = 'llama-tiny',
+                 mesh=None,
+                 params: Any = None,
+                 checkpoint_dir: Optional[str] = None,
+                 max_batch_size: int = 4,
+                 max_seq_len: Optional[int] = None,
+                 model_overrides: Optional[Dict[str, Any]] = None,
+                 param_dtype: Any = jnp.bfloat16,
+                 prefill_bucket: int = 64,
+                 seed: int = 0) -> None:
+        overrides = dict(model_overrides or {})
+        overrides.update(decode=True, remat=False)
+        overrides.setdefault('param_dtype', param_dtype)
+        if max_seq_len is not None:
+            overrides['max_seq_len'] = max_seq_len
+        self.model, self.config = models_lib.get_model(model, **overrides)
+        self.max_batch = max_batch_size
+        self.max_seq_len = self.config.max_seq_len
+        self.prefill_bucket = max(1, prefill_bucket)
+        self.mesh = mesh
+
+        init_tokens = jnp.zeros((max_batch_size, 1), jnp.int32)
+        rng = jax.random.PRNGKey(seed)
+
+        def _init():
+            return self.model.init(rng, init_tokens)
+
+        abstract = jax.eval_shape(_init)
+        if mesh is not None:
+            param_shardings = sharding_lib.unbox(
+                sharding_lib.params_to_shardings(mesh,
+                                                 abstract['params']))
+            cache_shardings = jax.tree.map(
+                functools.partial(_cache_sharding, mesh),
+                abstract['cache'])
+        else:
+            param_shardings = cache_shardings = None
+
+        self._cache_shardings = cache_shardings
+        self._abstract_cache = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            sharding_lib.unbox(abstract['cache']))
+        if params is not None:
+            self.params = self._place(params, param_shardings)
+        elif checkpoint_dir is not None:
+            self.params = self._load_checkpoint(checkpoint_dir,
+                                                abstract['params'],
+                                                param_shardings)
+        else:
+            logger.warning('InferenceEngine: no params/checkpoint given '
+                           '— serving randomly initialized weights '
+                           '(tests/dev only).')
+
+            def _init_params():
+                return sharding_lib.unbox(_init())['params']
+            if mesh is not None:
+                self.params = jax.jit(
+                    _init_params, out_shardings=param_shardings)()
+            else:
+                self.params = _init_params()
+
+        def _forward(p, cache, tokens, positions, kv_mask):
+            logits, mutated = self.model.apply(
+                {'params': p, 'cache': cache}, tokens, positions,
+                kv_mask, mutable=['cache'])
+            return logits, mutated['cache']
+
+        # Prefill: donate the cache buffers (they are replaced).
+        self._prefill = jax.jit(_forward, donate_argnums=(1,))
+
+        def _decode_step(p, cache, last_logits, kv_mask, lengths,
+                         prefill_len, step, rng, active,
+                         sampling: SamplingConfig):
+            """Fused: sample from last logits -> reveal the new slot ->
+            one-token forward.  Returns (token, next logits, cache,
+            kv_mask).
+
+            The new token's K/V land at the cache *cursor*
+            (prefill_len + step — prompts are right-padded to
+            prefill_len), while its rope position is the row's true
+            length + step; the kv mask bridges the difference.
+            """
+            step_rng = jax.random.fold_in(rng, step)
+            next_tok = sample_logits(last_logits, step_rng, sampling)
+            slot = prefill_len + step
+            kv_mask = jax.lax.dynamic_update_slice(
+                kv_mask, active[:, None], (0, slot))
+            positions = (lengths + step)[:, None]
+            logits, cache = _forward(p, cache, next_tok[:, None],
+                                     positions, kv_mask)
+            return next_tok, logits[:, 0], cache, kv_mask
+
+        self._decode = jax.jit(_decode_step, static_argnames=('sampling',),
+                               donate_argnums=(1, 3))
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._generation = 0
+
+    # -- weights -----------------------------------------------------------
+    def _place(self, params, shardings):
+        cast = jax.tree.map(
+            lambda x: jnp.asarray(x, self.config.param_dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else
+            jnp.asarray(x), params)
+        if shardings is None:
+            return cast
+        return jax.device_put(cast, shardings)
+
+    def _load_checkpoint(self, directory: str, abstract_params,
+                         shardings):
+        """Load params from a trainer checkpoint (train/checkpoint.py
+        layout: Composite 'state' holding params/opt_state/step)."""
+        from skypilot_tpu.train import checkpoint as ckpt_lib
+        manager = ckpt_lib.make_manager(directory)
+        latest = manager.latest_step()
+        if latest is None:
+            raise FileNotFoundError(
+                f'no checkpoint found under {directory!r}')
+        raw = manager.restore(latest)['state']['params']
+        want = jax.tree.structure(sharding_lib.unbox(abstract_params))
+        got = jax.tree.structure(raw)
+        if want != got:
+            raise ValueError(
+                f'checkpoint param tree does not match model '
+                f'{self.config.name!r}: {got} vs {want}')
+        logger.info(f'loaded checkpoint step {latest} from {directory}')
+        return self._place(raw, shardings)
+
+    def _fresh_cache(self):
+        def _make(leaf, sharding=None):
+            if sharding is not None:
+                return jnp.zeros(leaf.shape, leaf.dtype,
+                                 device=sharding)
+            return jnp.zeros(leaf.shape, leaf.dtype)
+        if self._cache_shardings is None:
+            return jax.tree.map(_make, self._abstract_cache)
+        return jax.tree.map(_make, self._abstract_cache,
+                            self._cache_shardings)
+
+    def _bucketed(self, s_max: int) -> int:
+        b = self.prefill_bucket
+        padded = ((s_max + b - 1) // b) * b
+        return min(padded, self.max_seq_len)
+
+    # -- generation --------------------------------------------------------
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 sampling: Optional[SamplingConfig] = None
+                 ) -> List[List[int]]:
+        """Generate continuations for up to `max_batch_size` prompts of
+        (possibly) different lengths. Returns one id list per prompt."""
+        cfg = sampling or SamplingConfig()
+        n = len(prompts)
+        if n == 0:
+            return []
+        if n > self.max_batch:
+            raise ValueError(
+                f'{n} prompts > max_batch_size={self.max_batch}.')
+        lengths = np.array([len(p) for p in prompts], np.int32)
+        if (lengths <= 0).any():
+            raise ValueError('empty prompt')
+        if int(lengths.max()) + cfg.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f'prompt ({int(lengths.max())}) + max_new_tokens '
+                f'({cfg.max_new_tokens}) exceeds max_seq_len '
+                f'{self.max_seq_len}.')
+        # Bucket the padded prompt length so prefill compiles once per
+        # bucket, not once per distinct prompt length.
+        s_max = self._bucketed(
+            min(int(lengths.max()) + cfg.max_new_tokens,
+                self.max_seq_len)) - cfg.max_new_tokens
+        s_max = max(s_max, int(lengths.max()))
+
+        b = self.max_batch
+        tokens = np.zeros((b, s_max), np.int32)
+        prompt_mask = np.zeros((b, s_max), bool)
+        for i, p in enumerate(prompts):
+            tokens[i, :len(p)] = p
+            prompt_mask[i, :len(p)] = True
+        full_lengths = np.zeros((b,), np.int32)
+        full_lengths[:n] = lengths
+
+        kv_mask = jnp.zeros((b, self.max_seq_len), bool)
+        kv_mask = kv_mask.at[:, :s_max].set(jnp.asarray(prompt_mask))
+        positions = jnp.broadcast_to(
+            jnp.arange(s_max, dtype=jnp.int32)[None], (b, s_max))
+        lengths_dev = jnp.asarray(full_lengths)
+
+        cache = self._fresh_cache()
+        self._generation += 1
+        rng = jax.random.fold_in(self._rng, self._generation)
+        ctx = self.mesh if self.mesh is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            logits, cache = self._prefill(
+                self.params, cache, jnp.asarray(tokens), positions,
+                kv_mask)
+            last = logits[jnp.arange(b),
+                          jnp.maximum(lengths_dev - 1, 0)]
+
+            outputs: List[List[int]] = [[] for _ in range(n)]
+            done = np.zeros((b,), bool)
+            done[n:] = True
+            for t in range(cfg.max_new_tokens):
+                tok_dev, last, cache, kv_mask = self._decode(
+                    self.params, cache, last, kv_mask, lengths_dev,
+                    jnp.int32(s_max), jnp.int32(t), rng,
+                    jnp.asarray(~done), sampling=cfg)
+                next_tok = np.asarray(jax.device_get(tok_dev))
+                for i in range(n):
+                    if not done[i]:
+                        outputs[i].append(int(next_tok[i]))
+                        if cfg.eos_id is not None and \
+                                int(next_tok[i]) == cfg.eos_id:
+                            done[i] = True
+                if done.all():
+                    break
+        return outputs

@@ -1,0 +1,157 @@
+"""HTTP serving front-end for the inference engine.
+
+This is what a SkyServe replica runs (the reference's replicas run a
+vLLM container instead — `llm/qwen/serve-110b.yaml`).  Stdlib threaded
+http.server, matching the rest of the serve stack (serve/controller.py):
+
+  GET  /health              -> 200 {"status": "ok"} once the engine is
+                               warm (used by the replica readiness probe)
+  POST /generate            -> {"tokens": [[...], ...]}
+       body: {"prompt_ids": [[...], ...], "max_new_tokens": N,
+              "temperature": T, "top_k": K, "top_p": P, "eos_id": E}
+
+Requests are serialized through a lock: the engine owns the single
+TPU context, and decode batches are formed per request (request-level
+batching; continuous batching is a planned optimization).
+
+Run: python -m skypilot_tpu.infer.server --model llama-tiny --port 8000
+"""
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import threading
+from typing import Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.infer import engine as engine_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+class InferenceServer:
+
+    def __init__(self, model: str = 'llama-tiny', port: int = 8000,
+                 host: str = '0.0.0.0', max_batch_size: int = 4,
+                 max_seq_len: Optional[int] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 mesh_config: Optional[str] = None,
+                 model_overrides=None) -> None:
+        mesh = None
+        if mesh_config:
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            kwargs = {}
+            for part in mesh_config.split(','):
+                if part:
+                    k, v = part.split('=')
+                    kwargs[k] = int(v)
+            mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(**kwargs))
+        self.engine = engine_lib.InferenceEngine(
+            model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
+            max_batch_size=max_batch_size,
+            max_seq_len=max_seq_len, model_overrides=model_overrides)
+        # Warm the compile caches (smallest prefill bucket + decode) so
+        # /health flips to ready only after the common-path compiles are
+        # done.  Other prefill buckets still compile on first use.
+        self.engine.generate(
+            [[1, 2, 3]],
+            engine_lib.SamplingConfig(max_new_tokens=2))
+        self._lock = threading.Lock()
+        self._port = port
+        self._host = host
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    def _handle_generate(self, payload: dict) -> dict:
+        prompts = payload.get('prompt_ids')
+        if not isinstance(prompts, list) or not prompts:
+            raise ValueError('prompt_ids must be a non-empty list of '
+                             'token-id lists')
+        sampling = engine_lib.SamplingConfig(
+            temperature=float(payload.get('temperature', 0.0)),
+            top_k=int(payload.get('top_k', 0)),
+            top_p=float(payload.get('top_p', 1.0)),
+            eos_id=payload.get('eos_id'),
+            max_new_tokens=int(payload.get('max_new_tokens', 64)))
+        with self._lock:
+            tokens = self.engine.generate(prompts, sampling)
+        return {'tokens': tokens}
+
+    def serve_forever(self) -> None:
+        self.start()
+        assert self._server is not None
+        logger.info(f'inference server on :{self.port}')
+        self._server.serve_forever()
+
+    def start(self) -> None:
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def log_message(self, *args):  # quiet
+                del args
+
+            def _reply(self, code: int, body: dict) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == '/health':
+                    self._reply(200, {'status': 'ok'})
+                else:
+                    self._reply(404, {'error': 'not found'})
+
+            def do_POST(self):  # noqa: N802
+                if self.path != '/generate':
+                    self._reply(404, {'error': 'not found'})
+                    return
+                try:
+                    length = int(self.headers.get('Content-Length', 0))
+                    payload = json.loads(self.rfile.read(length) or b'{}')
+                    self._reply(200, outer._handle_generate(payload))  # pylint: disable=protected-access
+                except ValueError as e:
+                    self._reply(400, {'error': str(e)})
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.exception('generate failed')
+                    self._reply(500, {'error': str(e)})
+
+        self._server = http.server.ThreadingHTTPServer(
+            (self._host, self._port), Handler)
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='llama-tiny')
+    parser.add_argument('--port', type=int, default=8000)
+    parser.add_argument('--host', default='0.0.0.0')
+    parser.add_argument('--max-batch-size', type=int, default=4)
+    parser.add_argument('--max-seq-len', type=int, default=None)
+    parser.add_argument('--checkpoint-dir', default=None,
+                        help='trainer Orbax checkpoint to serve '
+                             '(bucket-mounted path)')
+    parser.add_argument('--mesh', default=None,
+                        help="shard over local devices, e.g. 'tensor=4'")
+    args = parser.parse_args()
+    InferenceServer(model=args.model, port=args.port, host=args.host,
+                    max_batch_size=args.max_batch_size,
+                    max_seq_len=args.max_seq_len,
+                    checkpoint_dir=args.checkpoint_dir,
+                    mesh_config=args.mesh).serve_forever()
+
+
+if __name__ == '__main__':
+    main()

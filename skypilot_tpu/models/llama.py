@@ -44,6 +44,9 @@ class LlamaConfig:
     scan_layers: bool = True
     remat: bool = True
     attention_impl: str = 'flash'   # flash | ring | reference
+    # Autoregressive serving mode: attention keeps a KV cache in the
+    # 'cache' variable collection (infer/engine.py drives it).
+    decode: bool = False
     # Attach logical-axis metadata to params (nn.with_partitioning).
     # Disabled when modules are applied inside a shard_map manual region
     # (pipeline stages): flax's apply-time shape validation eval_shapes
@@ -151,7 +154,8 @@ class Attention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         dense = lambda features, names, name: nn.DenseGeneral(  # noqa: E731
             features, axis=-1, use_bias=False, name=name,
@@ -175,6 +179,10 @@ class Attention(nn.Module):
         v = jnp.transpose(v, (0, 2, 1, 3))
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
+        if cfg.decode:
+            out = self._cached_attention(q, k, v, kv_mask)
+            return dense(cfg.dim, ('heads', 'embed_fsdp'), 'o_proj')(
+                out.reshape(b, s, h * hd))
         if kv != h:  # GQA: broadcast kv heads to query heads
             k = jnp.repeat(k, h // kv, axis=1)
             v = jnp.repeat(v, h // kv, axis=1)
@@ -192,6 +200,50 @@ class Attention(nn.Module):
             kernel_init=_partitioned_init(
                 nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
                 ('heads', 'embed_fsdp'), cfg.partition_params))(out)
+
+    def _cached_attention(self, q: jax.Array, k: jax.Array,
+                          v: jax.Array,
+                          kv_mask: Optional[jax.Array]) -> jax.Array:
+        """Attention against the KV cache (serving).
+
+        The cache is written at the global slot cursor `cache_index`
+        (same for every row); per-row validity — right-padded prompts,
+        finished rows — is carried by `kv_mask` [B, max_seq_len], so
+        slots and rope positions may disagree for padded rows without
+        affecting valid tokens.  Returns [B, S, H, hd].
+        """
+        cfg = self.config
+        b, h, s, hd = q.shape
+        kvh = cfg.n_kv_heads
+        max_len = cfg.max_seq_len
+        cached_k = self.variable('cache', 'cached_key', jnp.zeros,
+                                 (b, kvh, max_len, hd), cfg.dtype)
+        cached_v = self.variable('cache', 'cached_value', jnp.zeros,
+                                 (b, kvh, max_len, hd), cfg.dtype)
+        cursor = self.variable('cache', 'cache_index',
+                               lambda: jnp.zeros((), jnp.int32))
+        idx = cursor.value
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(cfg.dtype), (0, 0, idx, 0))
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(cfg.dtype), (0, 0, idx, 0))
+        cursor.value = idx + s
+        keys, values = cached_k.value, cached_v.value
+        if kvh != h:
+            keys = jnp.repeat(keys, h // kvh, axis=1)
+            values = jnp.repeat(values, h // kvh, axis=1)
+        scores = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
+                            keys.astype(jnp.float32)) * (hd ** -0.5)
+        slots = jnp.arange(max_len)
+        causal = slots[None, :] <= (idx + jnp.arange(s))[:, None]
+        mask = causal[None, None]                      # [1,1,s,max]
+        if kv_mask is not None:
+            mask = mask & kv_mask[:, None, None, :]
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum('bhqk,bhkd->bhqd', probs.astype(cfg.dtype),
+                         values)
+        return jnp.transpose(out, (0, 2, 1, 3))
 
 
 class MLP(nn.Module):
@@ -215,12 +267,13 @@ class Block(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         x = x + Attention(cfg, name='attention')(
             RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                     name='attention_norm')(x),
-            positions)
+            positions, kv_mask)
         x = x + MLP(cfg, name='mlp')(
             RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                     name='mlp_norm')(x))
@@ -233,7 +286,8 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
+                 positions: Optional[jax.Array] = None,
+                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         if positions is None:
             positions = default_positions(tokens)
@@ -251,16 +305,21 @@ class Llama(nn.Module):
                 Block, prevent_cse=not cfg.scan_layers,
                 policy=jax.checkpoint_policies.nothing_saveable)
         if cfg.scan_layers:
+            variable_axes = {'params': 0}
+            if cfg.decode:
+                variable_axes['cache'] = 0
             x, _ = nn.scan(
-                lambda mod, carry, _: (mod(carry, positions), None),
-                variable_axes={'params': 0},
+                lambda mod, carry, _: (mod(carry, positions, kv_mask),
+                                       None),
+                variable_axes=variable_axes,
                 split_rngs={'params': True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: 'layers'},
             )(block_cls(cfg, name='layers'), x, None)
         else:
             for i in range(cfg.n_layers):
-                x = block_cls(cfg, name=f'layer_{i}')(x, positions)
+                x = block_cls(cfg, name=f'layer_{i}')(x, positions,
+                                                      kv_mask)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                     name='final_norm')(x)
         # Tied-untied: separate output head (Llama3 unties embeddings).

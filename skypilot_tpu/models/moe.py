@@ -160,11 +160,12 @@ class MoEBlock(nn.Module):
     config: MoEConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 kv_mask=None) -> jax.Array:
         cfg = self.config
         x = x + llama.Attention(cfg, name='attention')(
             llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
-                          name='attention_norm')(x), positions)
+                          name='attention_norm')(x), positions, kv_mask)
         x = x + MoEMLP(cfg, name='moe_mlp')(
             llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                           name='mlp_norm')(x))
@@ -176,12 +177,11 @@ class Mixtral(nn.Module):
     config: MoEConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, positions=None) -> jax.Array:
+    def __call__(self, tokens: jax.Array, positions=None,
+                 kv_mask=None) -> jax.Array:
         cfg = self.config
         if positions is None:
-            positions = jnp.broadcast_to(
-                jnp.arange(tokens.shape[1], dtype=jnp.int32)[None],
-                tokens.shape)
+            positions = llama.default_positions(tokens)
         embed = self.param(
             'tok_embed',
             llama._partitioned_init(  # pylint: disable=protected-access
@@ -196,16 +196,21 @@ class Mixtral(nn.Module):
                 MoEBlock, prevent_cse=not cfg.scan_layers,
                 policy=jax.checkpoint_policies.nothing_saveable)
         if cfg.scan_layers:
+            variable_axes = {'params': 0, 'intermediates': 0}
+            if cfg.decode:
+                variable_axes['cache'] = 0
             x, _ = nn.scan(
-                lambda mod, carry, _: (mod(carry, positions), None),
-                variable_axes={'params': 0, 'intermediates': 0},
+                lambda mod, carry, _: (mod(carry, positions, kv_mask),
+                                       None),
+                variable_axes=variable_axes,
                 split_rngs={'params': True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: 'layers'},
             )(block_cls(cfg, name='layers'), x, None)
         else:
             for i in range(cfg.n_layers):
-                x = block_cls(cfg, name=f'layer_{i}')(x, positions)
+                x = block_cls(cfg, name=f'layer_{i}')(x, positions,
+                                                      kv_mask)
         x = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                           name='final_norm')(x)
         logits = nn.DenseGeneral(

@@ -20,7 +20,11 @@ def main() -> None:
     parser.add_argument('--learning-rate', type=float, default=3e-4)
     parser.add_argument('--grad-accum-steps', type=int, default=1)
     parser.add_argument('--mesh', default='fsdp=-1',
-                        help="e.g. 'data=2,fsdp=-1,tensor=4'")
+                        help="e.g. 'data=2,fsdp=-1,pipe=2,tensor=4'")
+    parser.add_argument('--pipeline-microbatches', type=int,
+                        default=None,
+                        help='GPipe microbatches when pipe>1 '
+                             '(default: 2*pipe).')
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=0)
     parser.add_argument('--dataset', default=None,
@@ -51,6 +55,7 @@ def main() -> None:
         grad_accum_steps=args.grad_accum_steps,
         total_steps=args.steps,
         mesh=mesh_lib.MeshConfig(**mesh_kwargs),
+        pipeline_microbatches=args.pipeline_microbatches,
         model_overrides={'max_seq_len': args.seq_len},
     )
     trainer = trainer_lib.Trainer(config)

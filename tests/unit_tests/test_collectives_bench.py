@@ -1,0 +1,31 @@
+"""Collective bandwidth benchmark (nccl-tests analog) smoke tests."""
+import jax
+import pytest
+
+from skypilot_tpu.benchmark import collectives
+
+
+class TestCollectivesBench:
+
+    def test_all_ops_produce_results(self):
+        results = collectives.run_bench(
+            sizes_mb=[0.01], iters=2, warmup=1,
+            devices=jax.devices()[:4])
+        assert len(results) == 5
+        for r in results:
+            assert r.num_devices == 4
+            assert r.seconds > 0
+            assert r.algbw_gbps > 0
+            assert r.busbw_gbps > 0
+            assert r.payload_bytes >= 16
+
+    def test_busbw_factors(self):
+        assert collectives._busbw_factor('all_reduce', 8) == \
+            pytest.approx(2 * 7 / 8)
+        assert collectives._busbw_factor('all_gather', 8) == \
+            pytest.approx(7 / 8)
+        assert collectives._busbw_factor('ppermute', 8) == 1.0
+
+    def test_single_device_rejected(self):
+        with pytest.raises(ValueError, match='2 devices'):
+            collectives.run_bench(devices=jax.devices()[:1])

@@ -1,0 +1,25 @@
+"""Every example YAML must parse into a valid Task (the reference uses
+examples/ as living fixtures for its smoke tests — SURVEY.md §4)."""
+import pathlib
+
+import pytest
+
+from skypilot_tpu import task as task_lib
+
+EXAMPLES = sorted(
+    (pathlib.Path(__file__).resolve().parents[2] / 'examples')
+    .glob('*.yaml'))
+
+
+@pytest.mark.parametrize('path', EXAMPLES, ids=lambda p: p.name)
+def test_example_parses(path):
+    t = task_lib.Task.from_yaml(str(path))
+    t.validate()
+    assert t.run
+    if path.name == 'serve_llama.yaml':
+        assert t.service is not None
+        assert t.service.readiness_path == '/health'
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 5
