@@ -104,6 +104,18 @@ def _spawn_rank(spec: Dict[str, Any], rank: int, run_cmd: str,
         full_env = dict(os.environ)
         full_env.update(env)
         full_env['SKYTPU_LOCAL_HOST_ROOT'] = host_root
+        # Jobs must be able to import skypilot_tpu (callbacks, train
+        # entrypoints) no matter how THIS driver found it — sys.path
+        # tricks (pytest cwd) don't inherit, so pin the package parent
+        # into the job's PYTHONPATH (the local-runtime analog of the
+        # reference installing its wheel on every cluster).
+        import skypilot_tpu
+        pkg_parent = os.path.dirname(
+            os.path.dirname(skypilot_tpu.__file__))
+        existing = full_env.get('PYTHONPATH', '')
+        if pkg_parent not in existing.split(os.pathsep):
+            full_env['PYTHONPATH'] = (
+                pkg_parent + (os.pathsep + existing if existing else ''))
         proc = subprocess.Popen(
             script, shell=True, executable='/bin/bash',
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,

@@ -100,7 +100,12 @@ def run_bench(ops: Optional[Sequence[str]] = None,
             # round so shards divide evenly (all_to_all needs n^2).
             elems = max(int(mb * 1024 * 1024 // 4), n * n)
             elems -= elems % (n * n)
-            global_x = jnp.arange(elems, dtype=jnp.float32)
+            # Pre-shard the input over the axis: without this the timed
+            # loop would include resharding the device-0-committed array
+            # across the mesh, polluting the collective measurement.
+            global_x = jax.device_put(
+                jnp.arange(elems, dtype=jnp.float32),
+                jax.sharding.NamedSharding(mesh, P(_AXIS)))
             fn = jax.jit(jax.shard_map(
                 fns[op], mesh=mesh, in_specs=P(_AXIS),
                 out_specs=out_specs[op]))

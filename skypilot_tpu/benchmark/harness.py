@@ -1,0 +1,173 @@
+"""Benchmark harness: one task, N candidate resources, $/step verdicts.
+
+Reference `sky bench` (sky/benchmark/, SURVEY.md §2.9): launches the
+same task on several candidate resources in parallel, wraps the task
+with a step-timestamp logger, and reports seconds-per-step and
+dollars-per-step so users pick hardware by price-performance.  Key
+differences here:
+
+  - the step log is a JSONL file on each head node written by
+    skypilot_tpu/callbacks.py (env `SKYTPU_BENCHMARK_LOG`), collected
+    over the agent RPC channel — no shared results bucket to set up;
+  - candidates are resource-override dicts applied to the task's
+    resources (accelerators / instance_type / use_spot / ...);
+  - $/step uses the optimizer catalog's hourly cost for each
+    candidate (Resources.get_cost).
+"""
+from __future__ import annotations
+
+import json
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import callbacks
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.benchmark import state as bench_state
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+def _cluster_name(benchmark: str, idx: int) -> str:
+    return f'skytpu-bench-{benchmark}-{idx}'
+
+
+def _log_path(cluster: str) -> str:
+    # Per-cluster filename: candidates on the `local` cloud share one
+    # filesystem, and a shared file would interleave their records.
+    return f'~/.skytpu/benchmark_steps-{cluster}.jsonl'
+
+
+def launch(task, candidates: List[Dict[str, Any]], benchmark: str,
+           *, detach: bool = True) -> List[str]:
+    """Launch `task` once per candidate resource override; returns the
+    cluster names."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import task as task_lib
+
+    if not candidates:
+        raise exceptions.TaskValidationError('no benchmark candidates')
+    base_config = task.to_yaml_config()
+
+    clusters: List[str] = []
+    launch_args = []
+    for i, overrides in enumerate(candidates):
+        config = json.loads(json.dumps(base_config))  # deep copy
+        resources = dict(config.get('resources') or {})
+        resources.update(overrides)
+        config['resources'] = resources
+        name = _cluster_name(benchmark, i)
+        config.setdefault('envs', {})[
+            callbacks.BENCHMARK_LOG_ENV] = _log_path(name)
+        candidate_task = task_lib.Task.from_yaml_config(config)
+        clusters.append(name)
+        launch_args.append((candidate_task, name, resources))
+
+    def _launch_one(args):
+        candidate_task, name, resources = args
+        job_id, _ = sky.launch(candidate_task, cluster_name=name,
+                               detach_run=detach, stream_logs=False,
+                               quiet_optimizer=True)
+        bench_state.add_run(benchmark, name, resources, job_id)
+        return name
+
+    # Register the benchmark row only once at least one candidate is
+    # actually up — a totally-failed launch must not leave an orphan
+    # name that status() then misreports.
+    try:
+        subprocess_utils.run_in_parallel(_launch_one, launch_args)
+    finally:
+        if bench_state.get_runs(benchmark):
+            bench_state.add_benchmark(benchmark, json.dumps(base_config))
+    logger.info(f'benchmark {benchmark!r}: launched {len(clusters)} '
+                f'candidates: {clusters}')
+    return clusters
+
+
+def _fetch_step_records(cluster: str) -> List[Dict[str, Any]]:
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.backend import tpu_gang_backend
+    record = global_user_state.get_cluster_from_name(cluster)
+    if record is None:
+        return []
+    backend = tpu_gang_backend.TpuGangBackend()
+    # No shlex.quote: the path starts with ~ which must tilde-expand,
+    # and _log_path emits no shell metacharacters.
+    code, out, _ = backend.run_on_head(
+        record['handle'],
+        f'cat {_log_path(cluster)} 2>/dev/null || true',
+        stream_logs=False, require_outputs=True)
+    if code != 0:
+        return []
+    records = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith('{'):
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return records
+
+
+def status(benchmark: str) -> List[Dict[str, Any]]:
+    """Per-candidate steps/sec and $/step from collected step logs."""
+    from skypilot_tpu import resources as resources_lib
+    runs = bench_state.get_runs(benchmark)
+    if not runs:
+        raise exceptions.BenchmarkError(
+            f'unknown benchmark {benchmark!r}; have '
+            f'{bench_state.get_benchmarks()}')
+    results = []
+    for run in runs:
+        records = _fetch_step_records(run['cluster'])
+        entry: Dict[str, Any] = {
+            'cluster': run['cluster'],
+            'resources': run['resources'],
+            'num_steps': len(records),
+            'secs_per_step': None,
+            'dollars_per_step': None,
+            'steps_per_sec': None,
+        }
+        if len(records) >= 2:
+            ts = sorted(r['ts'] for r in records)
+            deltas = [b - a for a, b in zip(ts, ts[1:]) if b > a]
+            if deltas:
+                deltas.sort()
+                median = deltas[len(deltas) // 2]
+                entry['secs_per_step'] = median
+                entry['steps_per_sec'] = 1.0 / median if median else None
+                try:
+                    res = resources_lib.Resources(**run['resources'])
+                    entry['dollars_per_step'] = res.get_cost(median)
+                except Exception:  # pylint: disable=broad-except
+                    pass
+        results.append(entry)
+    return results
+
+
+def down(benchmark: str, *, purge: bool = False) -> None:
+    """Tear down every candidate cluster of a benchmark."""
+    from skypilot_tpu import core
+    for run in bench_state.get_runs(benchmark):
+        try:
+            core.down(run['cluster'])
+        except Exception as e:  # pylint: disable=broad-except
+            if not purge:
+                raise
+            logger.warning(f'down {run["cluster"]} failed: {e}')
+    bench_state.delete_benchmark(benchmark)
+
+
+def wait_for_steps(benchmark: str, min_steps: int,
+                   timeout: float = 300.0) -> bool:
+    """Block until every candidate logged >= min_steps (tests/CI)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        counts = [len(_fetch_step_records(r['cluster']))
+                  for r in bench_state.get_runs(benchmark)]
+        if counts and all(c >= min_steps for c in counts):
+            return True
+        time.sleep(1.0)
+    return False

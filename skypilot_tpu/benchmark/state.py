@@ -1,0 +1,89 @@
+"""Benchmark harness state (sqlite) — reference's sky/benchmark/
+benchmark_state.py analog, same pattern as global_user_state."""
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+_CREATE_TABLES = """\
+CREATE TABLE IF NOT EXISTS benchmarks (
+    name TEXT PRIMARY KEY,
+    task_yaml TEXT,
+    created_at REAL
+);
+CREATE TABLE IF NOT EXISTS benchmark_runs (
+    benchmark TEXT,
+    cluster TEXT,
+    resources_json TEXT,
+    job_id INTEGER,
+    launched_at REAL,
+    PRIMARY KEY (benchmark, cluster)
+);
+"""
+
+_conn_local = threading.local()
+
+
+def _conn() -> sqlite3.Connection:
+    import os
+    path = os.path.join(paths.benchmarks_dir(), 'benchmark.db')
+    cached = getattr(_conn_local, 'conn', None)
+    if cached is not None and getattr(_conn_local, 'path', None) == path:
+        return cached
+    conn = sqlite3.connect(path, timeout=10.0)
+    conn.executescript(_CREATE_TABLES)
+    conn.commit()
+    _conn_local.conn = conn
+    _conn_local.path = path
+    return conn
+
+
+def add_benchmark(name: str, task_yaml: str) -> None:
+    conn = _conn()
+    conn.execute(
+        'INSERT OR REPLACE INTO benchmarks VALUES (?, ?, ?)',
+        (name, task_yaml, time.time()))
+    conn.commit()
+
+
+def add_run(benchmark: str, cluster: str, resources: Dict[str, Any],
+            job_id: Optional[int]) -> None:
+    conn = _conn()
+    conn.execute(
+        'INSERT OR REPLACE INTO benchmark_runs VALUES (?, ?, ?, ?, ?)',
+        (benchmark, cluster, json.dumps(resources), job_id, time.time()))
+    conn.commit()
+
+
+def get_benchmarks() -> List[str]:
+    return [r[0] for r in _conn().execute(
+        'SELECT name FROM benchmarks ORDER BY created_at')]
+
+
+def get_runs(benchmark: str) -> List[Dict[str, Any]]:
+    rows = _conn().execute(
+        'SELECT cluster, resources_json, job_id, launched_at '
+        'FROM benchmark_runs WHERE benchmark = ? ORDER BY cluster',
+        (benchmark,)).fetchall()
+    return [{'cluster': c, 'resources': json.loads(r), 'job_id': j,
+             'launched_at': t} for c, r, j, t in rows]
+
+
+def delete_benchmark(name: str) -> None:
+    conn = _conn()
+    conn.execute('DELETE FROM benchmarks WHERE name = ?', (name,))
+    conn.execute('DELETE FROM benchmark_runs WHERE benchmark = ?',
+                 (name,))
+    conn.commit()
+
+
+def reset_for_tests() -> None:
+    if getattr(_conn_local, 'conn', None) is not None:
+        _conn_local.conn.close()
+        _conn_local.conn = None
+    _conn_local.path = None

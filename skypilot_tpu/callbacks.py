@@ -1,0 +1,55 @@
+"""Step-timing callbacks feeding the benchmark harness.
+
+Counterpart of the reference's separate `sky/callbacks/sky_callback`
+pip package (SkyCallback step reporters for Keras/Lightning/HF feeding
+a shared bucket — SURVEY.md §2.9).  Here the logger is part of the
+framework: any training loop (ours or user code) calls
+`BenchmarkLogger.maybe_from_env()` and `log_step()`; records land in a
+JSONL file on the head node that `skypilot_tpu bench status` collects
+via the agent RPC channel (no shared bucket required).
+
+Env contract (injected by benchmark/harness.py):
+    SKYTPU_BENCHMARK_LOG — absolute path of the JSONL step log.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+BENCHMARK_LOG_ENV = 'SKYTPU_BENCHMARK_LOG'
+
+
+class BenchmarkLogger:
+    """Appends {"step": n, "ts": unix_seconds} lines; one per step."""
+
+    def __init__(self, path: str) -> None:
+        self._path = os.path.expanduser(path)
+        os.makedirs(os.path.dirname(self._path) or '.', exist_ok=True)
+        self._fh = open(self._path, 'a', buffering=1)  # line-buffered
+
+    @classmethod
+    def maybe_from_env(cls) -> Optional['BenchmarkLogger']:
+        path = os.environ.get(BENCHMARK_LOG_ENV)
+        return cls(path) if path else None
+
+    def log_step(self, step: int, **extra) -> None:
+        rec = {'step': int(step), 'ts': time.time()}
+        rec.update(extra)
+        self._fh.write(json.dumps(rec) + '\n')
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def log_step_from_env(step: int, **extra) -> None:
+    """One-shot convenience for user scripts (opens/append/closes)."""
+    path = os.environ.get(BENCHMARK_LOG_ENV)
+    if not path:
+        return
+    logger = BenchmarkLogger(path)
+    try:
+        logger.log_step(step, **extra)
+    finally:
+        logger.close()

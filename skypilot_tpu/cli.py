@@ -8,6 +8,7 @@ YAML fields the same way (_parse_override_params, cli.py:477).
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
@@ -461,6 +462,68 @@ def serve_down(service_names, all_services, purge, yes) -> None:
     serve_core.down(list(service_names) or None, all_services=all_services,
                     purge=purge)
     click.echo('Service(s) torn down.')
+
+
+@cli.group()
+def bench() -> None:
+    """Benchmark one task across candidate resources ($/step)."""
+
+
+@bench.command(name='launch')
+@click.argument('entrypoint', nargs=-1, required=True)
+@click.option('--benchmark', '-b', required=True, help='Benchmark name.')
+@click.option('--candidate', '-c', 'candidates', multiple=True,
+              required=True,
+              help="Resource override, e.g. 'accelerators=tpu-v5e-8' "
+                   "or 'accelerators=tpu-v6e-8,use_spot=true'. Repeat "
+                   'for each candidate.')
+def bench_launch(entrypoint, benchmark, candidates) -> None:
+    from skypilot_tpu.benchmark import harness
+    task = _make_task(entrypoint)
+    parsed = []
+    for cand in candidates:
+        overrides = {}
+        for kv in cand.split(','):
+            if '=' not in kv or not kv.split('=', 1)[0].strip():
+                raise click.UsageError(
+                    f'bad --candidate entry {kv!r} in {cand!r}: '
+                    "expected key=value (e.g. 'accelerators=tpu-v5e-8')")
+            k, v = kv.split('=', 1)
+            overrides[k.strip()] = (
+                v.strip().lower() == 'true' if v.strip().lower() in
+                ('true', 'false') else v.strip())
+        parsed.append(overrides)
+    clusters = harness.launch(task, parsed, benchmark)
+    click.echo(f'Benchmark {benchmark!r} launched on: '
+               f'{", ".join(clusters)}')
+
+
+@bench.command(name='status')
+@click.argument('benchmark', required=True)
+def bench_status(benchmark) -> None:
+    from skypilot_tpu.benchmark import harness
+    rows = []
+    for r in harness.status(benchmark):
+        rows.append((
+            r['cluster'], json.dumps(r['resources']), r['num_steps'],
+            f"{r['secs_per_step']:.3f}" if r['secs_per_step'] else '-',
+            f"${r['dollars_per_step']:.6f}"
+            if r['dollars_per_step'] else '-'))
+    _print_table(('CLUSTER', 'RESOURCES', 'STEPS', 'SEC/STEP', '$/STEP'),
+                 rows)
+
+
+@bench.command(name='down')
+@click.argument('benchmark', required=True)
+@click.option('--purge', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_down(benchmark, purge, yes) -> None:
+    from skypilot_tpu.benchmark import harness
+    if not yes:
+        click.confirm(f'Tear down benchmark {benchmark!r} clusters?',
+                      default=True, abort=True)
+    harness.down(benchmark, purge=purge)
+    click.echo(f'Benchmark {benchmark!r} torn down.')
 
 
 def _print_table(headers: Tuple[str, ...], rows: List[Tuple]) -> None:

@@ -227,6 +227,10 @@ class AgentVersionError(SkyTpuError):
     """On-cluster agent version is incompatible with this client."""
 
 
+class BenchmarkError(SkyTpuError):
+    """Benchmark harness failure (unknown benchmark, no results)."""
+
+
 def format_failover_history(history: List[Exception]) -> str:
     if not history:
         return ''

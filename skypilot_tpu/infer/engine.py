@@ -158,7 +158,7 @@ class InferenceEngine:
 
         def _decode_step(p, cache, last_logits, kv_mask, lengths,
                          prefill_len, step, rng, active,
-                         sampling: SamplingConfig):
+                         temperature: float, top_k: int, top_p: float):
             """Fused: sample from last logits -> reveal the new slot ->
             one-token forward.  Returns (token, next logits, cache,
             kv_mask).
@@ -167,9 +167,16 @@ class InferenceEngine:
             (prefill_len + step — prompts are right-padded to
             prefill_len), while its rope position is the row's true
             length + step; the kv mask bridges the difference.
+
+            Only the fields sampling actually uses are static compile
+            keys — max_new_tokens / eos_id live in the host loop and
+            must not fragment the compile cache.
             """
             step_rng = jax.random.fold_in(rng, step)
-            next_tok = sample_logits(last_logits, step_rng, sampling)
+            next_tok = sample_logits(
+                last_logits, step_rng,
+                SamplingConfig(temperature=temperature, top_k=top_k,
+                               top_p=top_p))
             slot = prefill_len + step
             kv_mask = jax.lax.dynamic_update_slice(
                 kv_mask, active[:, None], (0, slot))
@@ -178,8 +185,10 @@ class InferenceEngine:
                                      positions, kv_mask)
             return next_tok, logits[:, 0], cache, kv_mask
 
-        self._decode = jax.jit(_decode_step, static_argnames=('sampling',),
-                               donate_argnums=(1, 3))
+        self._decode = jax.jit(
+            _decode_step,
+            static_argnames=('temperature', 'top_k', 'top_p'),
+            donate_argnums=(1, 3))
         self._rng = jax.random.PRNGKey(seed + 1)
         self._generation = 0
 
@@ -251,11 +260,13 @@ class InferenceEngine:
                 f'({cfg.max_new_tokens}) exceeds max_seq_len '
                 f'{self.max_seq_len}.')
         # Bucket the padded prompt length so prefill compiles once per
-        # bucket, not once per distinct prompt length.
-        s_max = self._bucketed(
-            min(int(lengths.max()) + cfg.max_new_tokens,
-                self.max_seq_len)) - cfg.max_new_tokens
-        s_max = max(s_max, int(lengths.max()))
+        # bucket, not once per (prompt length, max_new_tokens) pair;
+        # only near the max_seq_len ceiling does the clamp reintroduce
+        # a max_new dependence.
+        lmax = int(lengths.max())
+        s_max = min(self._bucketed(lmax),
+                    self.max_seq_len - cfg.max_new_tokens)
+        s_max = max(s_max, lmax)
 
         b = self.max_batch
         tokens = np.zeros((b, s_max), np.int32)
@@ -291,7 +302,8 @@ class InferenceEngine:
                 tok_dev, last, cache, kv_mask = self._decode(
                     self.params, cache, last, kv_mask, lengths_dev,
                     jnp.int32(s_max), jnp.int32(t), rng,
-                    jnp.asarray(~done), sampling=cfg)
+                    jnp.asarray(~done), temperature=cfg.temperature,
+                    top_k=cfg.top_k, top_p=cfg.top_p)
                 next_tok = np.asarray(jax.device_get(tok_dev))
                 for i in range(n):
                     if not done[i]:

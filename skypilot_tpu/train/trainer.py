@@ -324,11 +324,17 @@ class Trainer:
               log_every: int = 10,
               checkpoint_manager=None,
               checkpoint_every: int = 0) -> Dict[str, float]:
+        from skypilot_tpu import callbacks
         cfg = self.config
         if self.state is None:
             self.init_state()
         steps = num_steps if num_steps is not None else cfg.total_steps
         tokens_per_step = cfg.global_batch_size * cfg.seq_len
+        # Step-log only from process 0: every rank of a multi-host job
+        # inherits the same log path, and interleaved per-rank records
+        # would corrupt the harness's sec/step medians.
+        bench_logger = (callbacks.BenchmarkLogger.maybe_from_env()
+                        if jax.process_index() == 0 else None)
         t0 = time.time()
         window_tokens = 0
         last: Dict[str, float] = {}
@@ -336,6 +342,8 @@ class Trainer:
             batch = next(data_iter)
             metrics = self.step(batch)
             window_tokens += tokens_per_step
+            if bench_logger is not None:
+                bench_logger.log_step(i + 1)
             if (i + 1) % log_every == 0 or i + 1 == steps:
                 metrics = jax.device_get(metrics)
                 dt = time.time() - t0

@@ -1,0 +1,79 @@
+"""Benchmark harness (`sky bench` analog) against real local clusters.
+
+Mirrors the reference's benchmark flow (sky/benchmark/) hermetically:
+launch the same task on two 'candidate' local clusters, each writing
+step timestamps via the callbacks contract, then compute sec/step and
+tear down.
+"""
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import callbacks
+from skypilot_tpu import exceptions
+from skypilot_tpu.benchmark import harness
+from skypilot_tpu.benchmark import state as bench_state
+
+_STEP_SCRIPT = (
+    'python3 -c "\n'
+    'import time\n'
+    'from skypilot_tpu import callbacks\n'
+    'lg = callbacks.BenchmarkLogger.maybe_from_env()\n'
+    'for i in range(5):\n'
+    '    time.sleep(0.05)\n'
+    '    lg.log_step(i + 1)\n'
+    '"')
+
+
+@pytest.fixture(autouse=True)
+def _reset_bench_state():
+    bench_state.reset_for_tests()
+    yield
+    bench_state.reset_for_tests()
+
+
+class TestBenchmarkHarness:
+
+    def test_launch_status_down(self):
+        task = sky.Task(run=_STEP_SCRIPT)
+        task.set_resources(sky.Resources(cloud='local'))
+        clusters = harness.launch(task, [{}, {}], 'unittest',
+                                  detach=True)
+        assert len(clusters) == 2
+        assert harness.wait_for_steps('unittest', min_steps=5,
+                                      timeout=120)
+        results = harness.status('unittest')
+        assert len(results) == 2
+        for r in results:
+            assert r['num_steps'] >= 5
+            assert r['secs_per_step'] is not None
+            assert 0 < r['secs_per_step'] < 10
+        harness.down('unittest')
+        assert bench_state.get_runs('unittest') == []
+
+    def test_unknown_benchmark(self):
+        with pytest.raises(exceptions.BenchmarkError):
+            harness.status('nope')
+
+
+class TestBenchmarkLogger:
+
+    def test_logger_writes_jsonl(self, tmp_path, monkeypatch):
+        path = tmp_path / 'steps.jsonl'
+        monkeypatch.setenv(callbacks.BENCHMARK_LOG_ENV, str(path))
+        logger = callbacks.BenchmarkLogger.maybe_from_env()
+        assert logger is not None
+        t0 = time.time()
+        logger.log_step(1)
+        logger.log_step(2, loss=1.5)
+        logger.close()
+        import json
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [l['step'] for l in lines] == [1, 2]
+        assert lines[1]['loss'] == 1.5
+        assert lines[0]['ts'] >= t0
+
+    def test_absent_env_returns_none(self, monkeypatch):
+        monkeypatch.delenv(callbacks.BENCHMARK_LOG_ENV, raising=False)
+        assert callbacks.BenchmarkLogger.maybe_from_env() is None
