@@ -51,6 +51,35 @@ class CommandRunner:
               excludes: Optional[List[str]] = None) -> None:
         raise NotImplementedError
 
+    @staticmethod
+    def _shell_command(cmd: Union[str, List[str]],
+                       env_vars: Optional[Dict[str, str]],
+                       cwd: Optional[str]) -> str:
+        """One bash command string: env exports + cd + the command
+        (shared by every runner so quoting fixes land once)."""
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        exports = ''.join(
+            f'export {k}={shlex.quote(str(v))}; '
+            for k, v in (env_vars or {}).items())
+        cd = f'cd {shlex.quote(cwd)}; ' if cwd else ''
+        return exports + cd + cmd
+
+    @staticmethod
+    def _finish(proc: 'subprocess.CompletedProcess', log_path: str,
+                stream_logs: bool, require_outputs: bool):
+        text = (proc.stdout or '') + (proc.stderr or '')
+        if log_path not in ('/dev/null', None) and text:
+            os.makedirs(os.path.dirname(_expand(log_path)),
+                        exist_ok=True)
+            with open(_expand(log_path), 'a', encoding='utf-8') as f:
+                f.write(text)
+        if stream_logs and text:
+            print(text, end='')
+        if require_outputs:
+            return proc.returncode, proc.stdout, proc.stderr
+        return proc.returncode
+
     def check_connection(self) -> bool:
         try:
             rc = self.run('true', timeout=10)
@@ -65,6 +94,8 @@ class CommandRunner:
                      port: int = 22) -> 'CommandRunner':
         if address.startswith('local:'):
             return LocalHostRunner(address)
+        if address.startswith('k8s:'):
+            return KubernetesPodRunner(address)
         return SSHCommandRunner(address, ssh_user=ssh_user, ssh_key=ssh_key,
                                 port=port)
 
@@ -102,21 +133,7 @@ class LocalHostRunner(CommandRunner):
             cmd, shell=True, executable='/bin/bash',
             cwd=cwd or self.host_root, env=env,
             capture_output=True, text=True, timeout=timeout, check=False)
-        self._log(proc, log_path, stream_logs)
-        if require_outputs:
-            return proc.returncode, proc.stdout, proc.stderr
-        return proc.returncode
-
-    @staticmethod
-    def _log(proc: subprocess.CompletedProcess, log_path: str,
-             stream_logs: bool) -> None:
-        text = (proc.stdout or '') + (proc.stderr or '')
-        if log_path not in ('/dev/null', None) and text:
-            os.makedirs(os.path.dirname(_expand(log_path)), exist_ok=True)
-            with open(_expand(log_path), 'a', encoding='utf-8') as f:
-                f.write(text)
-        if stream_logs and text:
-            print(text, end='')
+        return self._finish(proc, log_path, stream_logs, require_outputs)
 
     def rsync(self, source: str, target: str, *, up: bool, excludes=None):
         if up:
@@ -183,20 +200,12 @@ class SSHCommandRunner(CommandRunner):
 
     def run(self, cmd, *, env_vars=None, require_outputs=False,
             log_path='/dev/null', stream_logs=False, cwd=None, timeout=None):
-        if isinstance(cmd, list):
-            cmd = ' '.join(shlex.quote(c) for c in cmd)
-        exports = ''.join(
-            f'export {k}={shlex.quote(str(v))}; '
-            for k, v in (env_vars or {}).items())
-        cd = f'cd {shlex.quote(cwd)}; ' if cwd else ''
-        remote = f'bash -c {shlex.quote(exports + cd + cmd)}'
+        remote = ('bash -c ' +
+                  shlex.quote(self._shell_command(cmd, env_vars, cwd)))
         full = self._ssh_base() + [f'{self.ssh_user}@{self.address}', remote]
         proc = subprocess.run(full, capture_output=True, text=True,
                               timeout=timeout, check=False)
-        LocalHostRunner._log(proc, log_path, stream_logs)
-        if require_outputs:
-            return proc.returncode, proc.stdout, proc.stderr
-        return proc.returncode
+        return self._finish(proc, log_path, stream_logs, require_outputs)
 
     def rsync(self, source: str, target: str, *, up: bool, excludes=None):
         ssh_cmd = ' '.join(
@@ -219,6 +228,82 @@ class SSHCommandRunner(CommandRunner):
         if proc.returncode != 0:
             raise exceptions.CommandError(
                 proc.returncode, f'rsync to {self.address}', proc.stderr)
+
+
+class KubernetesPodRunner(CommandRunner):
+    """`kubectl exec`-based runner for GKE pods (reference
+    KubernetesCommandRunner, sky/utils/command_runner.py:685).
+
+    Address scheme: 'k8s:<context>/<namespace>/<pod>' (context may be
+    empty for the kubeconfig default).  File sync uses `kubectl cp`
+    (tar under the hood) instead of rsync.
+    """
+
+    def __init__(self, address: str) -> None:
+        super().__init__(address)
+        assert address.startswith('k8s:'), address
+        context, namespace, pod = address[len('k8s:'):].split('/', 2)
+        self.context = context or None
+        self.namespace = namespace
+        self.pod = pod
+
+    def _base(self) -> List[str]:
+        args = ['kubectl']
+        if self.context:
+            args += ['--context', self.context]
+        args += ['--namespace', self.namespace]
+        return args
+
+    def run(self, cmd, *, env_vars=None, require_outputs=False,
+            log_path='/dev/null', stream_logs=False, cwd=None,
+            timeout=None):
+        full = self._base() + [
+            'exec', self.pod, '--', '/bin/bash', '-c',
+            self._shell_command(cmd, env_vars, cwd)]
+        proc = subprocess.run(full, capture_output=True, text=True,
+                              timeout=timeout, check=False)
+        return self._finish(proc, log_path, stream_logs, require_outputs)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes=None):
+        """Tar streamed through `kubectl exec` (NOT kubectl cp: cp
+        neither expands '~' in pod paths nor supports excludes, and the
+        backend syncs to ~-prefixed targets with gitignore excludes)."""
+        exclude_args = ' '.join(
+            f'--exclude={shlex.quote(pat)}' for pat in excludes or [])
+        if up:
+            src = _expand(source)
+            if os.path.isdir(src):
+                tar_src = f'-C {shlex.quote(src)} .'
+            else:
+                tar_src = (f'-C {shlex.quote(os.path.dirname(src))} '
+                           f'{shlex.quote(os.path.basename(src))}')
+            # $HOME expands inside the pod's bash.
+            remote_dir = target.replace('~', '$HOME', 1)
+            local_cmd = f'tar czf - {exclude_args} {tar_src}'
+            remote_cmd = (f'mkdir -p "{remote_dir}" && '
+                          f'tar xzf - -C "{remote_dir}"')
+            full = (f'{local_cmd} | ' + ' '.join(
+                shlex.quote(a) for a in self._base() +
+                ['exec', '-i', self.pod, '--', '/bin/bash', '-c',
+                 remote_cmd]))
+        else:
+            remote_src = source.replace('~', '$HOME', 1)
+            dst = _expand(target)
+            os.makedirs(dst if not os.path.splitext(dst)[1] else
+                        os.path.dirname(dst), exist_ok=True)
+            remote_cmd = (f'cd "$(dirname "{remote_src}")" && '
+                          f'tar czf - "$(basename "{remote_src}")"')
+            full = (' '.join(shlex.quote(a) for a in self._base() +
+                             ['exec', self.pod, '--', '/bin/bash', '-c',
+                              remote_cmd]) +
+                    f' | tar xzf - -C {shlex.quote(dst)}')
+        proc = subprocess.run(full, shell=True, executable='/bin/bash',
+                              capture_output=True, text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.CommandError(
+                proc.returncode, f'tar-over-exec sync to {self.pod}',
+                proc.stderr)
 
 
 def workdir_excludes(source_dir: str) -> List[str]:

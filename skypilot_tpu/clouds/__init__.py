@@ -6,6 +6,7 @@ from skypilot_tpu.clouds.registry import CLOUD_REGISTRY
 # Importing the modules registers the clouds.
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.fake import Fake, fake_cloud_state
+from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 
 __all__ = [

@@ -1,0 +1,299 @@
+"""Kubernetes (GKE-first) cloud: TPU pod slices as k8s pods.
+
+Counterpart of the reference's Kubernetes cloud (sky/clouds/kubernetes.py,
+~713 LoC, pods-as-nodes with label-based GPU selection).  TPU-first
+redesign: the schedulable unit is a GKE TPU *podslice* — node pools carry
+`cloud.google.com/gke-tpu-accelerator` + `gke-tpu-topology` labels and
+each slice host becomes one pod requesting `google.com/tpu` chips
+(public GKE TPU docs); multi-host slices get one pod per host plus a
+headless service for stable DNS, mirroring the GCE provisioner's
+slice-as-atomic-unit model.
+
+Pricing reuses the GCP TPU catalog (GKE TPU node pools bill the
+underlying TPU VMs).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.catalog import gcp_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds.registry import CLOUD_REGISTRY
+from skypilot_tpu.utils import accelerator_registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_DEFAULT_NAMESPACE = 'default'
+# Runtime image for pods; override via ~/.skytpu/config.yaml
+# kubernetes.image or resources.image_id.
+_DEFAULT_IMAGE = 'python:3.11-slim'
+_DEFAULT_TPU_IMAGE = 'python:3.11-slim'
+
+# GKE accelerator label per TPU generation (cloud.google.com/
+# gke-tpu-accelerator).  v2/v3 node pools are not offered on GKE.
+GKE_TPU_ACCELERATORS = {
+    'v4': 'tpu-v4-podslice',
+    'v5e': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
+    'v6e': 'tpu-v6e-slice',
+}
+
+# Published GKE topologies (gke-tpu-topology) for 2D generations
+# (v5e/v6e); 3D generations (v4/v5p) use cubic factorizations.
+_2D_TOPOLOGIES = {1: '1x1', 4: '2x2', 8: '2x4', 16: '4x4', 32: '4x8',
+                  64: '8x8', 128: '8x16', 256: '16x16'}
+
+
+def gke_topology(spec: accelerator_registry.TpuSliceSpec) -> str:
+    chips = spec.num_chips
+    if spec.generation.name in ('v5e', 'v6e'):
+        if chips in _2D_TOPOLOGIES:
+            return _2D_TOPOLOGIES[chips]
+        side = int(round(chips ** 0.5))
+        while side > 1 and chips % side:
+            side -= 1
+        return f'{side}x{chips // side}'
+    # 3D torus (v4/v5p count cores; topology counts chips).  Published
+    # GKE labels are ascending with trailing 1s: 2x2x1, 2x2x2, 2x2x4,
+    # 2x4x4, 4x4x4, ...
+    dims = [1, 1, 1]
+    remaining = chips
+    i = 0
+    while remaining > 1:
+        if remaining % 2 == 0:
+            dims[i % 3] *= 2
+            remaining //= 2
+        else:
+            dims[i % 3] *= remaining
+            remaining = 1
+        i += 1
+    dims = sorted(d for d in dims if d > 1) + [1] * dims.count(1)
+    return 'x'.join(str(d) for d in dims)
+
+
+@CLOUD_REGISTRY.register(aliases=['k8s', 'gke'])
+class Kubernetes(cloud.Cloud):
+    """GKE-first Kubernetes cloud."""
+
+    _REPR = 'Kubernetes'
+    PROVISIONER_MODULE = 'kubernetes'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 63   # RFC1123 label
+
+    _CLOUD_UNSUPPORTED_FEATURES = {
+        cloud.CloudImplementationFeatures.STOP:
+            'Pods cannot be stopped; use down/autodown.',
+        cloud.CloudImplementationFeatures.CLONE_DISK:
+            'No disk cloning for pods.',
+        cloud.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+            'Pod storage is cluster-determined.',
+        cloud.CloudImplementationFeatures.AUTOSTOP:
+            'Pods cannot stop; autodown is supported instead.',
+    }
+
+    @classmethod
+    def _unsupported_features_for_resources(
+            cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        return dict(cls._CLOUD_UNSUPPORTED_FEATURES)
+
+    # ---- regions ---------------------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del instance_type, accelerators, use_spot
+        context = cls._current_context()
+        if context is None:
+            return []
+        if region is not None and region != context:
+            return []
+        del zone  # contexts have no zones
+        return [cloud.Region(context)]
+
+    @classmethod
+    def zones_provision_loop(cls, *, region: str,
+                             instance_type: Optional[str] = None,
+                             accelerators: Optional[Dict[str, int]] = None,
+                             use_spot: bool = False):
+        for r in cls.regions_with_offering(instance_type, accelerators,
+                                           use_spot, region, None):
+            yield r, None
+
+    # ---- pricing (GKE TPU node pools bill like GCE TPU VMs) -------------
+    @classmethod
+    def instance_type_to_hourly_cost(cls, instance_type: str,
+                                     use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        del instance_type, use_spot, region, zone
+        return 0.0   # CPU pod pricing is cluster-operator territory.
+
+    @classmethod
+    def accelerators_to_hourly_cost(cls, accelerators: Dict[str, int],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        ((acc, count),) = accelerators.items()
+        if accelerator_registry.is_tpu({acc: count}):
+            spec = accelerator_registry.parse_tpu_accelerator(acc, count)
+            return gcp_catalog.get_tpu_hourly_cost(spec, use_spot)
+        return 0.0
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        del num_gigabytes
+        return 0.0
+
+    # ---- instance types --------------------------------------------------
+    @classmethod
+    def instance_type_exists(cls, instance_type: str) -> bool:
+        return instance_type.startswith('k8s-')
+
+    @classmethod
+    def get_default_instance_type(
+            cls, cpus: Optional[str] = None,
+            memory: Optional[str] = None,
+            disk_tier: Optional[str] = None) -> str:
+        del disk_tier
+        cpu = (cpus or '4').rstrip('+')
+        mem_spec = (memory or '').rstrip('+')
+        if mem_spec.endswith('x'):
+            # 'Nx' = N times the vCPU count (resources.py memory spec).
+            mem = f'{float(mem_spec[:-1]) * float(cpu):g}'
+        elif mem_spec:
+            mem = mem_spec
+        else:
+            mem = f'{float(cpu) * 4:g}'
+        return f'k8s-{cpu}cpu-{mem}gb'
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        try:
+            body = instance_type[len('k8s-'):]
+            cpu_part, mem_part = body.split('-', 1)
+            return (float(cpu_part.replace('cpu', '')),
+                    float(mem_part.replace('gb', '')))
+        except (ValueError, IndexError):
+            return None, None
+
+    @classmethod
+    def get_accelerators_from_instance_type(
+            cls, instance_type: str) -> Optional[Dict[str, int]]:
+        del instance_type
+        return None
+
+    # ---- feasibility -----------------------------------------------------
+    @classmethod
+    def _get_feasible_launchable_resources(
+            cls, resources: 'resources_lib.Resources',
+            num_nodes: int = 1) -> cloud.FeasibleResources:
+        del num_nodes
+        accs = resources.accelerators
+        if accs and accelerator_registry.is_tpu(accs):
+            ((acc, count),) = accs.items()
+            spec = accelerator_registry.parse_tpu_accelerator(acc, count)
+            if spec.generation.name not in GKE_TPU_ACCELERATORS:
+                return cloud.FeasibleResources(
+                    [], [],
+                    f'TPU {spec.generation.name} is not offered on GKE.')
+            r = resources.copy(
+                cloud=cls(),
+                instance_type='k8s-tpu-host',
+                accelerators=accs,
+            )
+            return cloud.FeasibleResources([r], [], None)
+        if accs:
+            return cloud.FeasibleResources(
+                [], [], 'Only TPU accelerators are modeled on '
+                'Kubernetes in this version.')
+        instance_type = cls.get_default_instance_type(
+            resources.cpus, resources.memory)
+        r = resources.copy(cloud=cls(), instance_type=instance_type)
+        return cloud.FeasibleResources([r], [], None)
+
+    # ---- deploy variables ------------------------------------------------
+    @classmethod
+    def make_deploy_resources_variables(
+            cls, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        del zones
+        from skypilot_tpu import config as config_lib
+        namespace = config_lib.get_nested(
+            ('kubernetes', 'namespace'), _DEFAULT_NAMESPACE)
+        spec = resources.tpu_slice
+        variables: Dict[str, Any] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'context': region.name,
+            'namespace': namespace,
+            'num_nodes': num_nodes,
+            'use_spot': resources.use_spot,
+            'labels': resources.labels or {},
+            'ports': resources.ports,
+            'image': resources.image_id or config_lib.get_nested(
+                ('kubernetes', 'image'),
+                _DEFAULT_TPU_IMAGE if spec else _DEFAULT_IMAGE),
+        }
+        if spec is not None:
+            variables.update({
+                'tpu_vm': True,
+                'gke_accelerator':
+                    GKE_TPU_ACCELERATORS[spec.generation.name],
+                'gke_topology': gke_topology(spec),
+                'num_tpu_hosts': spec.num_hosts,
+                'chips_per_host': spec.chips_per_host,
+                'tpu_generation': spec.generation.name,
+            })
+        else:
+            cpus, mem = cls.get_vcpus_mem_from_instance_type(
+                resources.instance_type or
+                cls.get_default_instance_type())
+            variables.update({
+                'tpu_vm': False,
+                'cpus': cpus or 4,
+                'memory_gb': mem or 16,
+            })
+        return variables
+
+    # ---- credentials -----------------------------------------------------
+    @classmethod
+    def _current_context(cls) -> Optional[str]:
+        try:
+            proc = subprocess.run(
+                ['kubectl', 'config', 'current-context'],
+                capture_output=True, text=True, timeout=10, check=False)
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        return proc.stdout.strip() or None
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        context = cls._current_context()
+        if context is None:
+            return False, ('kubectl not found or no current context; '
+                           'run `gcloud container clusters '
+                           'get-credentials <cluster>` first.')
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        context = cls._current_context()
+        return [[context]] if context else None
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        kubeconfig = os.path.expanduser(
+            os.environ.get('KUBECONFIG', '~/.kube/config'))
+        if os.path.exists(kubeconfig):
+            return {'~/.kube/config': kubeconfig}
+        return {}

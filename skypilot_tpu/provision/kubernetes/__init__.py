@@ -1,0 +1,3 @@
+from skypilot_tpu.provision.kubernetes.instance import (  # noqa: F401
+    cleanup_ports, get_cluster_info, open_ports, query_instances,
+    run_instances, stop_instances, terminate_instances, wait_instances)

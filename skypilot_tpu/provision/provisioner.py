@@ -64,6 +64,11 @@ def _provider_config(resources: resources_lib.Resources,
     if resources.cloud.canonical_name() == 'gcp':
         cfg['project_id'] = config_lib.get_nested(('gcp', 'project_id'),
                                                   None)
+    # Kubernetes: later query/terminate/get_cluster_info calls must hit
+    # the same context + namespace the pods were created in.
+    for key in ('context', 'namespace'):
+        if key in deploy_vars:
+            cfg[key] = deploy_vars[key]
     return cfg
 
 

@@ -1,0 +1,228 @@
+"""Kubernetes/GKE cloud + provisioner with a mocked kubectl.
+
+Hermetic analog of the reference's kubernetes unit tests: every kubectl
+invocation is intercepted so manifests, selectors and parsing are
+validated without a cluster.
+"""
+import json
+import subprocess
+
+import pytest
+
+from skypilot_tpu.clouds import kubernetes as k8s_cloud
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+from skypilot_tpu.utils import accelerator_registry
+
+
+class _FakeKubectl:
+    """Records kubectl calls; returns canned pods for get."""
+
+    def __init__(self):
+        self.calls = []
+        self.pods = []
+
+    def __call__(self, cmd, input=None, capture_output=True, text=True,
+                 timeout=None, check=False):  # noqa: A002
+        self.calls.append((cmd, input))
+        out = ''
+        if 'apply' in cmd:
+            applied = json.loads(input)
+            for obj in applied['items']:
+                if obj['kind'] == 'Pod':
+                    obj = json.loads(json.dumps(obj))
+                    obj.setdefault('status', {})['phase'] = 'Running'
+                    obj['status']['podIP'] = \
+                        f'10.8.0.{len(self.pods) + 1}'
+                    self.pods.append(obj)
+        elif 'get' in cmd:
+            out = json.dumps({'items': self.pods})
+        elif 'delete' in cmd:
+            self.pods = []
+        return subprocess.CompletedProcess(cmd, 0, stdout=out, stderr='')
+
+
+@pytest.fixture()
+def fake_kubectl(monkeypatch):
+    fake = _FakeKubectl()
+    monkeypatch.setattr(k8s_instance.subprocess, 'run', fake)
+    return fake
+
+
+def _tpu_config(acc='tpu-v5e-16'):
+    spec = accelerator_registry.parse_tpu_accelerator(acc)
+    return {
+        'context': 'gke_ctx',
+        'namespace': 'default',
+        'image': 'python:3.11-slim',
+        'tpu_vm': True,
+        'gke_accelerator':
+            k8s_cloud.GKE_TPU_ACCELERATORS[spec.generation.name],
+        'gke_topology': k8s_cloud.gke_topology(spec),
+        'num_tpu_hosts': spec.num_hosts,
+        'chips_per_host': spec.chips_per_host,
+        'use_spot': False,
+        'labels': {},
+    }
+
+
+class TestManifests:
+
+    def test_v5e_16_slice_pods(self):
+        cfg = _tpu_config('tpu-v5e-16')
+        objs = k8s_instance.build_manifests('c1', cfg, num_nodes=1,
+                                            namespace='default')
+        pods = [o for o in objs if o['kind'] == 'Pod']
+        svcs = [o for o in objs if o['kind'] == 'Service']
+        assert len(svcs) == 1 and svcs[0]['spec']['clusterIP'] == 'None'
+        # v5e-16 = 4 hosts -> 4 pods, 4 chips each.
+        assert len(pods) == 4
+        for pod in pods:
+            sel = pod['spec']['nodeSelector']
+            assert sel['cloud.google.com/gke-tpu-accelerator'] == \
+                'tpu-v5-lite-podslice'
+            assert sel['cloud.google.com/gke-tpu-topology'] == '4x4'
+            limits = pod['spec']['containers'][0]['resources']['limits']
+            assert limits['google.com/tpu'] == '4'
+            assert pod['spec']['subdomain'] == 'c1'
+
+    def test_spot_toleration(self):
+        cfg = _tpu_config()
+        cfg['use_spot'] = True
+        objs = k8s_instance.build_manifests('c1', cfg, 1, 'default')
+        pod = [o for o in objs if o['kind'] == 'Pod'][0]
+        assert pod['spec']['nodeSelector'][
+            'cloud.google.com/gke-spot'] == 'true'
+        assert pod['spec']['tolerations'][0]['key'] == \
+            'cloud.google.com/gke-spot'
+
+    def test_cpu_pod(self):
+        cfg = {'context': 'c', 'namespace': 'default',
+               'image': 'python:3.11-slim', 'tpu_vm': False, 'cpus': 8,
+               'memory_gb': 32, 'use_spot': False, 'labels': {}}
+        objs = k8s_instance.build_manifests('cpu1', cfg, 2, 'default')
+        pods = [o for o in objs if o['kind'] == 'Pod']
+        assert len(pods) == 2
+        req = pods[0]['spec']['containers'][0]['resources']['requests']
+        assert req == {'cpu': '8', 'memory': '32Gi'}
+
+
+class TestLifecycle:
+
+    def test_run_query_info_terminate(self, fake_kubectl):
+        cfg = _tpu_config('tpu-v5e-16')
+        config = common.ProvisionConfig(
+            provider_config={'context': 'gke_ctx',
+                             'namespace': 'default'},
+            authentication_config={}, docker_config={},
+            node_config=cfg, count=1, tags={},
+            resume_stopped_nodes=False)
+        record = k8s_instance.run_instances('gke_ctx', 'c1', config)
+        assert record.head_instance_id == 'c1-n0'
+        assert len(record.created_instance_ids) == 4
+
+        statuses = k8s_instance.query_instances(
+            'c1', {'context': 'gke_ctx', 'namespace': 'default'})
+        assert statuses == {'c1-n0': 'running'}
+
+        info = k8s_instance.get_cluster_info(
+            'gke_ctx', 'c1', {'context': 'gke_ctx',
+                              'namespace': 'default'})
+        assert info.head_instance_id == 'c1-n0'
+        (inst,) = info.instances['c1-n0']
+        assert inst.num_hosts == 4
+        assert inst.host_external_ips[0] == \
+            'k8s:gke_ctx/default/c1-n0-h0'
+
+        k8s_instance.terminate_instances(
+            'c1', {'context': 'gke_ctx', 'namespace': 'default'})
+        assert k8s_instance.query_instances(
+            'c1', {'context': 'gke_ctx', 'namespace': 'default'}) == {}
+
+    def test_stop_unsupported(self):
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.NotSupportedError):
+            k8s_instance.stop_instances('c1', {})
+
+
+class TestCloud:
+
+    def test_topologies(self):
+        for acc, want in [('tpu-v5e-16', '4x4'), ('tpu-v5e-8', '2x4'),
+                          ('tpu-v6e-32', '4x8'), ('tpu-v5e-256', '16x16')]:
+            spec = accelerator_registry.parse_tpu_accelerator(acc)
+            assert k8s_cloud.gke_topology(spec) == want, acc
+
+    def test_v4_topology_is_3d(self):
+        spec = accelerator_registry.parse_tpu_accelerator('tpu-v4-16')
+        topo = k8s_cloud.gke_topology(spec)
+        assert topo.count('x') == 2
+        import math
+        assert math.prod(int(d) for d in topo.split('x')) == \
+            spec.num_chips
+
+    def test_v4_8_matches_published_gke_label(self):
+        # v4-8 = 4 chips; GKE's published label is 2x2x1 (trailing 1s).
+        spec = accelerator_registry.parse_tpu_accelerator('tpu-v4-8')
+        assert k8s_cloud.gke_topology(spec) == '2x2x1'
+
+    def test_memory_multiplier_spec(self):
+        # '4x' = 4x vCPUs (resources.py memory spec), not 4 GB.
+        t = k8s_cloud.Kubernetes.get_default_instance_type(
+            cpus='8', memory='4x')
+        assert t == 'k8s-8cpu-32gb'
+        t = k8s_cloud.Kubernetes.get_default_instance_type(
+            cpus='8', memory='16')
+        assert t == 'k8s-8cpu-16gb'
+
+    def test_pod_rsync_tilde_and_excludes(self, monkeypatch):
+        from skypilot_tpu.backend import command_runner
+        calls = []
+
+        def fake_run(cmd, **kwargs):
+            calls.append(cmd)
+            return subprocess.CompletedProcess(cmd, 0, stdout='',
+                                               stderr='')
+        monkeypatch.setattr(command_runner.subprocess, 'run', fake_run)
+        runner = command_runner.CommandRunner.from_address(
+            'k8s:ctx/ns1/pod-0')
+        runner.rsync('/tmp', '~/.skytpu_runtime/pkg', up=True,
+                     excludes=['.git', '*.pyc'])
+        (cmd,) = calls
+        # Tilde must become $HOME (expanded in the pod), excludes must
+        # reach tar.
+        assert '$HOME/.skytpu_runtime/pkg' in cmd
+        assert '--exclude=.git' in cmd
+        assert "--exclude='*.pyc'" in cmd
+        assert 'kubectl' in cmd and 'exec' in cmd
+
+    def test_feasible_tpu(self):
+        from skypilot_tpu import resources as resources_lib
+        k8s = k8s_cloud.Kubernetes()
+        r = resources_lib.Resources(accelerators='tpu-v5e-16')
+        feas = k8s._get_feasible_launchable_resources(r)
+        assert len(feas.resources_list) == 1
+        assert str(feas.resources_list[0].cloud) == 'Kubernetes'
+
+    def test_v3_rejected(self):
+        from skypilot_tpu import resources as resources_lib
+        k8s = k8s_cloud.Kubernetes()
+        r = resources_lib.Resources(accelerators='tpu-v3-8')
+        feas = k8s._get_feasible_launchable_resources(r)
+        assert feas.resources_list == []
+        assert 'not offered on GKE' in feas.hint
+
+    def test_tpu_pricing_matches_gcp(self):
+        k8s = k8s_cloud.Kubernetes()
+        cost = k8s.accelerators_to_hourly_cost({'tpu-v5e-16': 1},
+                                               use_spot=False)
+        assert cost > 0
+
+    def test_pod_runner_address_parse(self):
+        from skypilot_tpu.backend import command_runner
+        runner = command_runner.CommandRunner.from_address(
+            'k8s:ctx/ns1/pod-0')
+        assert isinstance(runner, command_runner.KubernetesPodRunner)
+        assert runner.context == 'ctx'
+        assert runner.namespace == 'ns1'
+        assert runner.pod == 'pod-0'
