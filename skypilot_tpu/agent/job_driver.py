@@ -116,6 +116,30 @@ def _spawn_rank(spec: Dict[str, Any], rank: int, run_cmd: str,
         if pkg_parent not in existing.split(os.pathsep):
             full_env['PYTHONPATH'] = (
                 pkg_parent + (os.pathsep + existing if existing else ''))
+        from skypilot_tpu import native as native_lib
+        if native_lib.available():
+            # Native supervisor: session spawn + C++ log pump (the
+            # Python Popen path below is the fallback).
+            sup = native_lib.SupervisedProcess(script, env=full_env)
+            rank_proc = _RankProc(rank, sup, log_path)
+            prefix = (f'(rank {rank}) '
+                      if len(spec['hosts']) > 1 else '')
+
+            def _pump_native() -> None:
+                merged_fd = os.open(
+                    merged_log,
+                    os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+                try:
+                    sup.pump(log_path, prefix=prefix,
+                             merged_fd=merged_fd)
+                finally:
+                    os.close(merged_fd)
+                rank_proc.returncode = sup.wait()
+
+            thread = threading.Thread(target=_pump_native, daemon=True)
+            thread.start()
+            rank_proc.thread = thread  # type: ignore[attr-defined]
+            return rank_proc
         proc = subprocess.Popen(
             script, shell=True, executable='/bin/bash',
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -162,7 +186,33 @@ def _spawn_rank(spec: Dict[str, Any], rank: int, run_cmd: str,
     return rank_proc
 
 
-def _kill(proc: subprocess.Popen) -> None:
+def _signal_tree(proc, sig: int) -> None:
+    """Signal a rank's process group without waiting and WITHOUT taking
+    any locks — safe from inside a signal handler.  Skips ranks whose
+    pid has already been reaped (a recycled pid must never be
+    signalled)."""
+    if proc.returncode is not None:
+        return
+    if hasattr(proc, 'kill_tree'):     # native SupervisedProcess
+        proc.kill_tree(sig)
+        return
+    try:
+        os.killpg(os.getpgid(proc.pid), sig)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def _kill(proc) -> None:
+    """TERM, wait up to 5 s, escalate to KILL.  Not signal-handler-safe
+    (native wait_timeout takes the reap lock) — handlers use
+    _signal_tree directly."""
+    if proc.returncode is not None:
+        return
+    if hasattr(proc, 'kill_tree'):     # native SupervisedProcess
+        proc.kill_tree(signal.SIGTERM)
+        if proc.wait_timeout(5) is None:
+            proc.kill_tree(signal.SIGKILL)
+        return
     try:
         os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
     except (ProcessLookupError, PermissionError):
@@ -192,9 +242,15 @@ def run_job(spec: Dict[str, Any]) -> int:
         # canceller's killpg(driver) cannot reach them — the driver must
         # reap its ranks itself.  Status is owned by the canceller
         # (job_lib.cancel_jobs sets CANCELLED); exit without writing it.
+        # Handler context: only lock-free signalling (_signal_tree) —
+        # a wait would deadlock on the reap lock the interrupted main
+        # frame may hold.
         del signum, frame
         for rp in procs:
-            _kill(rp.proc)
+            _signal_tree(rp.proc, signal.SIGTERM)
+        time.sleep(1.0)
+        for rp in procs:
+            _signal_tree(rp.proc, signal.SIGKILL)
         os._exit(143)
 
     signal.signal(signal.SIGTERM, _on_sigterm)

@@ -1,0 +1,187 @@
+"""Native runtime components: build + ctypes bindings.
+
+The supervisor (native/supervisor.cpp) is compiled on first use with
+the host toolchain (g++ is part of the cluster runtime image) and
+cached by source hash under the state dir, so clusters never need a
+prebuilt wheel per platform.  Every entry point has a pure-Python
+fallback — a missing compiler degrades performance, not correctness.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_SOURCE = os.path.join(os.path.dirname(__file__), 'supervisor.cpp')
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _cache_dir() -> str:
+    from skypilot_tpu.utils import paths
+    d = os.path.join(paths.state_dir(), 'native')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _build() -> Optional[str]:
+    with open(_SOURCE, 'rb') as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f'libskysupervisor-{digest}.so')
+    if os.path.exists(out):
+        return out
+    tmp = out + f'.tmp{os.getpid()}'
+    cmd = ['g++', '-O2', '-shared', '-fPIC', '-std=c++17', _SOURCE,
+           '-o', tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120, check=False)
+    except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+        logger.debug(f'native build unavailable: {e}')
+        return None
+    if proc.returncode != 0:
+        logger.warning(
+            f'native supervisor build failed (falling back to Python): '
+            f'{proc.stderr.strip()[:500]}')
+        return None
+    os.replace(tmp, out)
+    return out
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The supervisor library, built+cached on first call (None when no
+    toolchain is available)."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError as e:
+            logger.warning(f'native supervisor load failed: {e}')
+            _load_failed = True
+            return None
+        lib.sky_spawn.restype = ctypes.c_longlong
+        lib.sky_spawn.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int)]
+        lib.sky_pump.restype = ctypes.c_int
+        lib.sky_pump.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int,
+                                 ctypes.c_int]
+        lib.sky_wait.restype = ctypes.c_int
+        lib.sky_wait.argtypes = [ctypes.c_longlong]
+        lib.sky_try_wait.restype = ctypes.c_int
+        lib.sky_try_wait.argtypes = [ctypes.c_longlong]
+        lib.sky_kill_tree.restype = ctypes.c_int
+        lib.sky_kill_tree.argtypes = [ctypes.c_longlong, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _envp(env: Optional[dict]):
+    if env is None:
+        return None
+    entries = [f'{k}={v}'.encode() for k, v in env.items()]
+    arr = (ctypes.c_char_p * (len(entries) + 1))()
+    arr[:-1] = entries
+    arr[-1] = None
+    return arr
+
+
+class SupervisedProcess:
+    """A rank process owned by the native supervisor.
+
+    API mirrors the bits of subprocess.Popen the job driver uses (pid,
+    wait, kill-tree), plus `pump()` — the blocking C++ tee loop.
+    """
+
+    def __init__(self, command: str, *, env: Optional[dict] = None,
+                 cwd: Optional[str] = None) -> None:
+        lib = load()
+        assert lib is not None, 'native supervisor unavailable'
+        self._lib = lib
+        fd = ctypes.c_int(-1)
+        self.pid = int(lib.sky_spawn(
+            command.encode(), _envp(env),
+            (cwd or '').encode(), ctypes.byref(fd)))
+        if self.pid < 0:
+            raise OSError('sky_spawn failed')
+        self.stdout_fd = int(fd.value)
+        self.returncode: Optional[int] = None
+        # Single-reaper discipline: poll/wait/wait_timeout may be called
+        # from the pump thread AND the driver loop; waitpid must not
+        # race itself.
+        self._reap_lock = threading.Lock()
+
+    def pump(self, log_path: str, *, prefix: str = '',
+             stream_stdout: bool = False, merged_fd: int = -1) -> None:
+        """Blocking: drain child output into `log_path` (+ optional
+        prefixed stdout / merged fd).  Call from a dedicated thread."""
+        self._lib.sky_pump(self.stdout_fd, log_path.encode(),
+                           prefix.encode(), int(stream_stdout),
+                           merged_fd)
+
+    def poll(self) -> Optional[int]:
+        """Non-blocking: exit code, or None while running."""
+        with self._reap_lock:
+            if self.returncode is not None:
+                return self.returncode
+            code = int(self._lib.sky_try_wait(self.pid))
+            if code == -256:
+                return None
+            self.returncode = code
+            return code
+
+    def wait(self) -> int:
+        import time
+        while True:
+            code = self.poll()
+            if code is not None:
+                return code
+            time.sleep(0.05)
+
+    def wait_timeout(self, timeout: float) -> Optional[int]:
+        """Poll up to `timeout` seconds; None if still running."""
+        import time
+        deadline = time.time() + timeout
+        while True:
+            code = self.poll()
+            if code is not None:
+                return code
+            if time.time() >= deadline:
+                return None
+            time.sleep(0.05)
+
+    def kill_tree(self, sig: int) -> None:
+        self._lib.sky_kill_tree(self.pid, sig)
+
+
+def run_with_log_native(command: str, log_path: str, *,
+                        env: Optional[dict] = None,
+                        cwd: Optional[str] = None,
+                        prefix: str = '',
+                        stream_stdout: bool = False) -> int:
+    """Native run-with-log: spawn + pump + wait in C++ (the Python
+    fallback is agent/log_lib.run_with_log)."""
+    proc = SupervisedProcess(command, env=env, cwd=cwd)
+    proc.pump(log_path, prefix=prefix, stream_stdout=stream_stdout)
+    return proc.wait()

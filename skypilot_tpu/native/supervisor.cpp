@@ -1,0 +1,164 @@
+// Per-host rank supervisor: process-session spawn + log pump + reaping.
+//
+// The native piece of the gang-exec runtime (SURVEY.md §2.10: the
+// reference outsources this to Ray's C++ core; here it is first-party).
+// The Python agent (agent/job_driver.py) calls these via ctypes:
+//
+//   sky_spawn(cmd, envp, cwd, &out_fd) -> pid
+//       fork + setsid (own process group, so cancellation can kill the
+//       whole tree) + exec /bin/bash -c cmd with stdout+stderr merged
+//       into a pipe whose read end is returned via out_fd.
+//
+//   sky_pump(fd, log_path, prefix, stream_stdout, merged_fd)
+//       blocking line pump: tees raw bytes to log_path (append,
+//       line-flushed), and — when streaming — writes each line with a
+//       rank prefix to stdout and/or a shared merged-log fd.  Merged
+//       writes are one write(2) per line on an O_APPEND fd, so ranks
+//       never interleave mid-line without any cross-process lock.
+//
+//   sky_wait(pid) -> exit code (or -signal, Python returncode
+//       convention);  sky_kill_tree(pid, sig) -> killpg.
+//
+// Build: g++ -O2 -shared -fPIC (native/__init__.py compiles and caches
+// by source hash; TSAN check: g++ -fsanitize=thread -shared ...).
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+extern "C" {
+
+long long sky_spawn(const char* command, const char* const* envp,
+                    const char* cwd, int* out_fd) {
+  int pipefd[2];
+  if (pipe(pipefd) != 0) return -1;
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(pipefd[0]);
+    close(pipefd[1]);
+    return -1;
+  }
+  if (pid == 0) {
+    // Child: own session/process group so killpg reaps the whole tree.
+    setsid();
+    close(pipefd[0]);
+    dup2(pipefd[1], STDOUT_FILENO);
+    dup2(pipefd[1], STDERR_FILENO);
+    close(pipefd[1]);
+    if (cwd != nullptr && cwd[0] != '\0') {
+      if (chdir(cwd) != 0) {
+        fprintf(stderr, "sky_spawn: chdir(%s): %s\n", cwd,
+                strerror(errno));
+        _exit(127);
+      }
+    }
+    const char* argv[] = {"/bin/bash", "-c", command, nullptr};
+    if (envp != nullptr) {
+      execve("/bin/bash", const_cast<char* const*>(argv),
+             const_cast<char* const*>(envp));
+    } else {
+      execv("/bin/bash", const_cast<char* const*>(argv));
+    }
+    fprintf(stderr, "sky_spawn: exec: %s\n", strerror(errno));
+    _exit(127);
+  }
+  close(pipefd[1]);
+  *out_fd = pipefd[0];
+  return static_cast<long long>(pid);
+}
+
+// Write a full buffer, retrying on partial writes / EINTR.
+static int write_all(int fd, const char* buf, size_t len) {
+  while (len > 0) {
+    ssize_t n = write(fd, buf, len);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    buf += n;
+    len -= static_cast<size_t>(n);
+  }
+  return 0;
+}
+
+int sky_pump(int fd, const char* log_path, const char* prefix,
+             int stream_stdout, int merged_fd) {
+  int log_fd = open(log_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (log_fd < 0) return -1;
+  std::string pending;   // partial line carried between reads
+  std::vector<char> buf(1 << 16);
+  const std::string pfx = prefix ? prefix : "";
+  for (;;) {
+    ssize_t n = read(fd, buf.data(), buf.size());
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    if (n == 0) break;
+    write_all(log_fd, buf.data(), static_cast<size_t>(n));
+    if (!stream_stdout && merged_fd < 0) continue;
+    pending.append(buf.data(), static_cast<size_t>(n));
+    size_t start = 0;
+    for (;;) {
+      size_t nl = pending.find('\n', start);
+      if (nl == std::string::npos) break;
+      std::string line =
+          pfx + pending.substr(start, nl - start + 1);
+      if (stream_stdout)
+        write_all(STDOUT_FILENO, line.data(), line.size());
+      if (merged_fd >= 0)
+        write_all(merged_fd, line.data(), line.size());
+      start = nl + 1;
+    }
+    pending.erase(0, start);
+  }
+  if (!pending.empty()) {
+    std::string line = pfx + pending + "\n";
+    if (stream_stdout)
+      write_all(STDOUT_FILENO, line.data(), line.size());
+    if (merged_fd >= 0) write_all(merged_fd, line.data(), line.size());
+  }
+  close(log_fd);
+  close(fd);
+  return 0;
+}
+
+int sky_wait(long long pid) {
+  int status = 0;
+  pid_t r;
+  do {
+    r = waitpid(static_cast<pid_t>(pid), &status, 0);
+  } while (r < 0 && errno == EINTR);
+  if (r < 0) return -255;
+  if (WIFEXITED(status)) return WEXITSTATUS(status);
+  if (WIFSIGNALED(status)) return -WTERMSIG(status);
+  return -255;
+}
+
+// Non-blocking wait: -256 when still running, else the exit code
+// (Python returncode convention).
+int sky_try_wait(long long pid) {
+  int status = 0;
+  pid_t r = waitpid(static_cast<pid_t>(pid), &status, WNOHANG);
+  if (r == 0) return -256;
+  if (r < 0) return -255;
+  if (WIFEXITED(status)) return WEXITSTATUS(status);
+  if (WIFSIGNALED(status)) return -WTERMSIG(status);
+  return -255;
+}
+
+int sky_kill_tree(long long pid, int sig) {
+  pid_t pgid = getpgid(static_cast<pid_t>(pid));
+  if (pgid > 0) return killpg(pgid, sig);
+  return kill(static_cast<pid_t>(pid), sig);
+}
+
+}  // extern "C"

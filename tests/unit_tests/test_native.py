@@ -1,0 +1,100 @@
+"""Native C++ supervisor: build, spawn/pump/wait semantics, kill-tree.
+
+The reference has no native code of its own (SURVEY.md §2.10 — it
+leans on Ray's C++ core for process supervision); this validates our
+first-party replacement against the same semantics the Python
+fallback (agent/log_lib.run_with_log) provides.
+"""
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='no C++ toolchain')
+
+
+class TestSupervisor:
+
+    def test_run_with_log_captures_output(self, tmp_path):
+        log = tmp_path / 'out.log'
+        code = native.run_with_log_native(
+            'echo line1; echo line2 >&2; exit 7', str(log))
+        assert code == 7
+        content = log.read_text()
+        assert 'line1' in content and 'line2' in content
+
+    def test_exit_signal_convention(self, tmp_path):
+        log = tmp_path / 'out.log'
+        code = native.run_with_log_native('kill -TERM $$', str(log))
+        assert code == -signal.SIGTERM
+
+    def test_env_and_cwd(self, tmp_path):
+        log = tmp_path / 'out.log'
+        code = native.run_with_log_native(
+            'echo "$MARKER in $(pwd)"', str(log),
+            env={'MARKER': 'hello', 'PATH': os.environ['PATH']},
+            cwd=str(tmp_path))
+        assert code == 0
+        assert f'hello in {tmp_path}' in log.read_text()
+
+    def test_kill_tree_reaps_grandchildren(self, tmp_path):
+        log = tmp_path / 'out.log'
+        proc = native.SupervisedProcess(
+            'bash -c "sleep 300" & CHILD=$!; echo child=$CHILD; '
+            'wait $CHILD', env={'PATH': os.environ['PATH']})
+        pump = threading.Thread(
+            target=proc.pump, args=(str(log),), daemon=True)
+        pump.start()
+        time.sleep(0.5)
+        proc.kill_tree(signal.SIGKILL)
+        code = proc.wait()
+        assert code == -signal.SIGKILL
+        pump.join(timeout=5)
+        # The grandchild sleep must be gone too (it shares the session).
+        child_line = [l for l in log.read_text().splitlines()
+                      if l.startswith('child=')]
+        assert child_line, log.read_text()
+        child_pid = int(child_line[0].split('=')[1])
+
+        def _gone(pid: int) -> bool:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return True
+            # Might linger as a zombie until init reaps it.
+            try:
+                with open(f'/proc/{pid}/stat', encoding='utf-8') as f:
+                    return f.read().split(') ')[1].split()[0] == 'Z'
+            except FileNotFoundError:
+                return True
+
+        deadline = time.time() + 5
+        while not _gone(child_pid) and time.time() < deadline:
+            time.sleep(0.1)
+        assert _gone(child_pid), f'grandchild {child_pid} survived'
+
+    def test_merged_fd_line_prefixing(self, tmp_path):
+        log = tmp_path / 'rank.log'
+        merged = tmp_path / 'merged.log'
+        mfd = os.open(str(merged),
+                      os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+        try:
+            proc = native.SupervisedProcess(
+                'printf "a\\nb\\n"', env={'PATH': os.environ['PATH']})
+            proc.pump(str(log), prefix='(rank 3) ', merged_fd=mfd)
+            assert proc.wait() == 0
+        finally:
+            os.close(mfd)
+        # Raw log unprefixed; merged log prefixed per line.
+        assert log.read_text() == 'a\nb\n'
+        assert merged.read_text() == '(rank 3) a\n(rank 3) b\n'
+
+    def test_build_is_cached(self):
+        first = native.load()
+        second = native.load()
+        assert first is second
