@@ -188,9 +188,10 @@ class Attention(nn.Module):
             v = jnp.repeat(v, h // kv, axis=1)
         if cfg.attention_impl == 'flash':
             out = fa.flash_attention(q, k, v)
-        elif cfg.attention_impl == 'ring':
+        elif cfg.attention_impl in ('ring', 'ulysses'):
             from skypilot_tpu.ops import ring_attention
-            out = ring_attention.ring_attention(q, k, v, axis_name='context')
+            out = ring_attention.context_parallel_attention(
+                q, k, v, impl=cfg.attention_impl)
         else:
             out = fa.mha_reference(q, k, v)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h * hd)

@@ -19,6 +19,7 @@ of 128 on TPU for MXU alignment.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -33,6 +34,35 @@ _NEG_INF = -1e30
 
 def _on_tpu() -> bool:
     return jax.default_backend() == 'tpu'
+
+
+# Tests pin the pallas kernel (interpret mode) off-TPU; everything else
+# off-TPU uses the XLA-native forward — interpret mode is orders of
+# magnitude slower and its HLO interpreter rejects mixed varying-manual
+# -axes operands inside partial-manual shard_map regions.
+FORCE_PALLAS = os.environ.get('SKYTPU_FORCE_PALLAS', '') == '1'
+
+
+def _mha_fwd_xla(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                 scale: float, causal: bool
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """XLA-native (out, lse) forward with the same semantics as the
+    pallas kernel (used off-TPU; XLA fuses this fine on CPU)."""
+    s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        seq_q, seq_kv = s.shape[-2:]
+        mask = jnp.tril(jnp.ones((seq_q, seq_kv), bool),
+                        k=seq_kv - seq_q)
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum('bhqk,bhkd->bhqd', p / l_safe,
+                     v.astype(jnp.float32)).astype(q.dtype)
+    lse = (m + jnp.log(l_safe))[..., 0]
+    return out, lse
 
 
 def _out_vma(*arrays):
@@ -271,6 +301,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _fwd_impl(q, k, v, scale, causal, block_q, block_kv):
     actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if not _on_tpu() and not FORCE_PALLAS:
+        return _mha_fwd_xla(q, k, v, scale=actual_scale, causal=causal)
     return _flash_fwd(q, k, v, scale=actual_scale, causal=causal,
                       block_q=block_q, block_kv=block_kv)
 

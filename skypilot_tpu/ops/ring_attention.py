@@ -49,6 +49,8 @@ def _block_fwd(q, k, v, scale, q_off, k_off, chunk):
     Three cases by ring offset: kv strictly ahead of q → fully masked;
     same chunk → causal within; kv behind → full attention.
     """
+    vma = fa._out_vma(q, k, v)  # pylint: disable=protected-access
+
     def full(_):
         return fa._fwd_impl(q, k, v, scale, False,  # pylint: disable=protected-access
                             fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_KV)
@@ -58,8 +60,11 @@ def _block_fwd(q, k, v, scale, q_off, k_off, chunk):
                             fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_KV)
 
     def masked(_):
-        return (jnp.zeros_like(q),
-                jnp.full(q.shape[:-1], _NEG_INF, jnp.float32))
+        # Fresh arrays must carry the manual-axes type of the real
+        # branches (varying over the context axis).
+        return (fa._cast_vma(jnp.zeros_like(q), vma),  # pylint: disable=protected-access
+                fa._cast_vma(jnp.full(q.shape[:-1], _NEG_INF,  # pylint: disable=protected-access
+                                      jnp.float32), vma))
 
     return jax.lax.cond(
         k_off > q_off, masked,
@@ -69,8 +74,10 @@ def _block_fwd(q, k, v, scale, q_off, k_off, chunk):
 def _ring_fwd_loop(q, k, v, scale, axis_name, axis_size, causal):
     my = jax.lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
-    out = jnp.zeros((b, h, s_local, d), jnp.float32)
-    lse = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+    vma = fa._out_vma(q, k, v)  # pylint: disable=protected-access
+    out = fa._cast_vma(jnp.zeros((b, h, s_local, d), jnp.float32), vma)  # pylint: disable=protected-access
+    lse = fa._cast_vma(jnp.full((b, h, s_local), _NEG_INF, jnp.float32),  # pylint: disable=protected-access
+                       vma)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def step(t, carry):
@@ -143,9 +150,10 @@ def _ring_vjp_bwd(axis_name, causal, scale, residuals, g):
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
-    dq = jnp.zeros(q.shape, jnp.float32)
-    dk0 = jnp.zeros(k.shape, jnp.float32)
-    dv0 = jnp.zeros(v.shape, jnp.float32)
+    vma = fa._out_vma(q, k, v, g)  # pylint: disable=protected-access
+    dq = fa._cast_vma(jnp.zeros(q.shape, jnp.float32), vma)  # pylint: disable=protected-access
+    dk0 = fa._cast_vma(jnp.zeros(k.shape, jnp.float32), vma)  # pylint: disable=protected-access
+    dv0 = fa._cast_vma(jnp.zeros(v.shape, jnp.float32), vma)  # pylint: disable=protected-access
 
     def step(t, carry):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
@@ -173,6 +181,33 @@ def _ring_vjp_bwd(axis_name, causal, scale, residuals, g):
 ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
+def context_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                               *, causal: bool = True,
+                               impl: str = 'ring',
+                               axis_name: str = 'context') -> jax.Array:
+    """Context-parallel attention inside an auto-sharded (pjit) graph.
+
+    Wraps ring/ulysses attention in a shard_map that is manual ONLY
+    over the context axis of the ambient mesh (other axes — data/fsdp/
+    tensor — stay compiler-partitioned), sharding the sequence dim.
+    Falls back to plain flash attention when no mesh with a context
+    axis > 1 is active, so models can call this unconditionally.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    mesh = sharding_lib.ambient_physical_mesh()
+    if mesh is None or mesh.shape.get(axis_name, 1) == 1:
+        return fa.flash_attention(q, k, v, None, causal)
+    fn = ring_attention if impl == 'ring' else ulysses_attention
+    spec = P(None, None, axis_name, None)
+    wrapped = jax.shard_map(
+        functools.partial(fn, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({axis_name}))
+    return wrapped(q, k, v)
+
+
 # ---------------------------------------------------------------------------
 # Ulysses (all-to-all head scatter) alternative
 # ---------------------------------------------------------------------------
@@ -188,27 +223,16 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     c = jax.lax.axis_size(axis_name)
 
-    # all_to_all(tiled=False): the split axis is REMOVED and a new
-    # device axis of size c is INSERTED at concat_axis.
+    # tiled all_to_all: split_axis is divided into c chunks that land
+    # concatenated along concat_axis — [B, H, S/c, D] <-> [B, H/c, S, D]
+    # in one collective each way, no reshape bookkeeping.
     def scatter_heads(x):
-        # [B, H, S/c, D] -> [B, H/c, S, D]
-        b, h, sl, d = x.shape
-        x = x.reshape(b, c, h // c, sl, d)
-        # (b, c, h/c, sl, d) -> (b, h/c, c, sl, d): device axis lands
-        # just before the local-seq axis so the flatten is seq-ordered.
-        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                               tiled=False)
-        return x.reshape(b, h // c, c * sl, d)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
 
     def gather_heads(x):
-        # [B, H/c, S, D] -> [B, H, S/c, D]
-        b, hc, s, d = x.shape
-        x = x.reshape(b, hc, c, s // c, d)
-        # (b, hc, c, sl, d) -> (b, c, hc, sl, d): device axis before the
-        # local-head axis restores block-major head order.
-        x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                               tiled=False)
-        return x.reshape(b, hc * c, s // c, d)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
 
     q_h = scatter_heads(q)
     k_h = scatter_heads(k)

@@ -90,6 +90,19 @@ def params_to_shardings(mesh: Mesh, params: Any,
                         is_leaf=lambda x: isinstance(x, nn.Partitioned))
 
 
+def ambient_physical_mesh() -> Optional[Mesh]:
+    """The concrete mesh of the enclosing `with mesh:` context (what
+    Trainer.step activates), visible during jit tracing — or None."""
+    try:
+        from jax._src import mesh as mesh_src
+        physical = mesh_src.thread_resources.env.physical_mesh
+        if physical is not None and not physical.empty:
+            return physical
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return None
+
+
 def _ambient_mesh_axes() -> tuple:
     """Axis names of whichever mesh is in context during tracing: the
     new-style abstract mesh (jax.set_mesh) or the legacy `with mesh:`
@@ -99,13 +112,9 @@ def _ambient_mesh_axes() -> tuple:
     axes = getattr(mesh, 'axis_names', ()) or ()
     if axes:
         return tuple(axes)
-    try:
-        from jax._src import mesh as mesh_src
-        physical = mesh_src.thread_resources.env.physical_mesh
-        if physical is not None and not physical.empty:
-            return tuple(physical.axis_names)
-    except Exception:  # pylint: disable=broad-except
-        pass
+    physical = ambient_physical_mesh()
+    if physical is not None:
+        return tuple(physical.axis_names)
     return ()
 
 

@@ -149,8 +149,15 @@ class Trainer:
                  mesh: Optional[Mesh] = None) -> None:
         import skypilot_tpu.models as models_lib
         self.config = config
+        overrides = dict(config.model_overrides)
+        context_size = (mesh.shape['context'] if mesh is not None
+                        else config.mesh.context)
+        if context_size > 1:
+            # Context parallelism: sequence-sharded ring attention
+            # unless the user pinned another implementation.
+            overrides.setdefault('attention_impl', 'ring')
         self.model, self.model_config = models_lib.get_model(
-            config.model, **config.model_overrides)
+            config.model, **overrides)
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
             config.mesh)
         tensor = self.mesh.shape['tensor']
@@ -167,8 +174,16 @@ class Trainer:
             raise ValueError(
                 f'per-step microbatch {micro} must be divisible by the '
                 f'data*fsdp shards ({n_batch}).')
+        n_context = self.mesh.shape['context']
+        if n_context > 1 and config.seq_len % n_context:
+            raise ValueError(
+                f'context={n_context} must divide seq_len='
+                f'{config.seq_len}.')
         n_pipe = self.mesh.shape['pipe']
         if n_pipe > 1:
+            if n_context > 1:
+                raise ValueError('pipeline and context parallelism do '
+                                 'not yet compose.')
             if hasattr(self.model_config, 'n_experts'):
                 raise ValueError('pipeline parallelism does not yet '
                                  'compose with MoE models.')

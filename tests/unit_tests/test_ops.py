@@ -22,6 +22,12 @@ def _qkv(b=1, h=2, s=256, d=128, dtype=jnp.float32, seed=0):
 
 class TestFlashAttention:
 
+    @pytest.fixture(autouse=True)
+    def _pin_pallas(self, monkeypatch):
+        # These tests exist to validate the pallas KERNEL (interpret
+        # mode on CPU); production CPU paths use the XLA forward.
+        monkeypatch.setattr(fa, 'FORCE_PALLAS', True)
+
     @pytest.mark.parametrize('causal', [True, False])
     def test_fwd_matches_reference(self, causal):
         q, k, v = _qkv()
