@@ -8,10 +8,13 @@ The reference delegates attention to torch-xla's flash attention
     kernel — grid (batch*heads, q_blocks, kv_blocks) with kv innermost,
     f32 accumulators in VMEM scratch, causal blocks skipped entirely
     (upper-triangular tiles never touch the MXU);
-  - backward: FlashAttention-2 formulation as a blockwise double-scan in
-    jnp (O(block) attention materialization, XLA-fused) using the saved
-    logsumexp — a Pallas backward kernel is the planned next optimization;
-  - off-TPU (tests, CPU sims) the same kernel runs in interpreter mode.
+  - backward: FlashAttention-2 as two Pallas kernels sharing the saved
+    logsumexp and delta=rowsum(dO*O): a dq pass (kv blocks innermost)
+    and a dk/dv pass (q blocks innermost), both with f32 VMEM
+    accumulators and causal blocks skipped; off-TPU default falls back
+    to a blockwise jnp double-scan that XLA fuses fine on CPU;
+  - off-TPU with SKYTPU_FORCE_PALLAS=1 (tests) the same kernels run in
+    interpreter mode.
 
 Layout: [batch, num_heads, seq, head_dim] ("BHSD"), head_dim a multiple
 of 128 on TPU for MXU alignment.
@@ -213,12 +216,182 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float,
 
 
 # ---------------------------------------------------------------------------
-# backward (FlashAttention-2 blockwise double-scan, jnp)
+# backward kernels (FlashAttention-2, two-pass: dq then dk/dv)
 # ---------------------------------------------------------------------------
-def _flash_bwd(scale: float, causal: bool, block_q: int, block_kv: int,
-               residuals, g) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    q, k, v, out, lse = residuals
-    do = g
+def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    q_start, k_start, *, scale: float, causal: bool,
+                    block_q: int, block_kv: int):
+    """Shared FA2 recompute for one (q, kv) block pair.
+
+    Returns (q, k, do, p, ds) in f32 — everything the dq and dk/dv
+    kernels need for their respective accumulation matmuls.  Kept as
+    one helper so the mask/scale math can never desynchronize between
+    the two backward passes.
+    """
+    q = q_ref[0].astype(jnp.float32)            # [bq, d]
+    k = k_ref[0].astype(jnp.float32)            # [bkv, d]
+    v = v_ref[0].astype(jnp.float32)            # [bkv, d]
+    do = do_ref[0].astype(jnp.float32)          # [bq, d]
+    lse = lse_ref[0]                            # [bq, 1] f32
+    delta = delta_ref[0]                        # [bq, 1] f32
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [bq, bkv]
+    if causal:
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    p = jnp.exp(s - lse)                        # [bq, bkv]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)     # [bq, bkv]
+    ds = p * (dp - delta) * scale
+    return q, k, do, p, ds
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *, scale: float, causal: bool,
+                         block_q: int, block_kv: int) -> None:
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_kv
+    should_run = True
+    if causal:
+        # kv blocks strictly above the diagonal contribute nothing.
+        should_run = k_start <= q_start + block_q - 1
+
+    @pl.when(should_run)
+    def _compute():
+        _, k, _, _, ds = _bwd_block_math(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
+            k_start, scale=scale, causal=causal, block_q=block_q,
+            block_kv=block_kv)
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [bq, d]
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                          causal: bool, block_q: int,
+                          block_kv: int) -> None:
+    ki = pl.program_id(1)
+    qj = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qj == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qj * block_q
+    k_start = ki * block_kv
+    should_run = True
+    if causal:
+        should_run = q_start + block_q - 1 >= k_start
+
+    @pl.when(should_run)
+    def _compute():
+        q, _, do, p, ds = _bwd_block_math(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
+            k_start, scale=scale, causal=causal, block_q=block_q,
+            block_kv=block_kv)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [bkv, d]
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [bkv, d]
+
+    @pl.when(qj == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
+                      do: jax.Array, lse: jax.Array, delta: jax.Array, *,
+                      scale: float, causal: bool, block_q: int,
+                      block_kv: int
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pallas dq + dk/dv backward. lse/delta are [B,H,S] f32."""
+    batch, heads, seq_q, d = q.shape
+    seq_kv = k.shape[2]
+    bh = batch * heads
+    block_q = _pick_block(seq_q, block_q, 'query')
+    block_kv = _pick_block(seq_kv, block_kv, 'key/value')
+    nq = pl.cdiv(seq_q, block_q)
+    nk = pl.cdiv(seq_kv, block_kv)
+    q3 = q.reshape(bh, seq_q, d)
+    k3 = k.reshape(bh, seq_kv, d)
+    v3 = v.reshape(bh, seq_kv, d)
+    do3 = do.reshape(bh, seq_q, d)
+    lse3 = lse.astype(jnp.float32).reshape(bh, seq_q, 1)
+    delta3 = delta.astype(jnp.float32).reshape(bh, seq_q, 1)
+    vma = _out_vma(q3, k3, v3, do3)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_q_inner = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale,
+                          causal=causal, block_q=block_q,
+                          block_kv=block_kv),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, kv_q_inner, kv_q_inner, q_spec, row_spec,
+                  row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), jnp.float32,
+                                       vma=vma),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=not _on_tpu(),
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    # dk/dv pass: kv blocks outer, q blocks inner.
+    kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, i, 0))
+    q_inner = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    row_inner = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale,
+                          causal=causal, block_q=block_q,
+                          block_kv=block_kv),
+        grid=(bh, nk, nq),
+        in_specs=[q_inner, kv_spec, kv_spec, q_inner, row_inner,
+                  row_inner],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_kv, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, seq_kv, d), jnp.float32, vma=vma),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
+                        pltpu.VMEM((block_kv, d), jnp.float32)],
+        interpret=not _on_tpu(),
+    )(q3, k3, v3, do3, lse3, delta3)
+    return (dq.reshape(batch, heads, seq_q, d),
+            dk.reshape(batch, heads, seq_kv, d),
+            dv.reshape(batch, heads, seq_kv, d))
+
+
+# ---------------------------------------------------------------------------
+# backward (FlashAttention-2 blockwise double-scan, jnp — off-TPU path)
+# ---------------------------------------------------------------------------
+def _flash_bwd_xla(q, k, v, do, lse, delta, *, scale: float, causal: bool,
+                   block_q: int, block_kv: int
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     batch, heads, seq_q, d = q.shape
     seq_kv = k.shape[2]
     block_q = _pick_block(seq_q, block_q, 'query')
@@ -230,8 +403,6 @@ def _flash_bwd(scale: float, causal: bool, block_q: int, block_kv: int,
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
     dof = do.astype(jnp.float32)
-    # delta_i = rowsum(dO * O)  [B,H,S]
-    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)
 
     q_blocks = qf.reshape(batch, heads, nq, block_q, d)
     do_blocks = dof.reshape(batch, heads, nq, block_q, d)
@@ -283,7 +454,25 @@ def _flash_bwd(scale: float, causal: bool, block_q: int, block_kv: int,
                    vma)),
         jnp.arange(nq))
     dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(batch, heads, seq_q, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return dq, dk, dv
+
+
+def _pair_bwd(q, k, v, do, lse, delta, *, scale: float, causal: bool,
+              block_q: int = DEFAULT_BLOCK_Q,
+              block_kv: int = DEFAULT_BLOCK_KV
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """f32 (dq, dk, dv) given saved lse and delta=rowsum(dO*O).
+
+    Shared with ring attention, which calls it once per (q chunk,
+    kv chunk) ring pair with the global lse/delta.
+    """
+    if not _on_tpu() and not FORCE_PALLAS:
+        return _flash_bwd_xla(q, k, v, do, lse, delta, scale=scale,
+                              causal=causal, block_q=block_q,
+                              block_kv=block_kv)
+    return _flash_bwd_pallas(q, k, v, do, lse, delta, scale=scale,
+                             causal=causal, block_q=block_q,
+                             block_kv=block_kv)
 
 
 # ---------------------------------------------------------------------------
@@ -313,10 +502,14 @@ def _vjp_fwd(q, k, v, scale, causal, block_q, block_kv):
 
 
 def _vjp_bwd(scale, causal, block_q, block_kv, residuals, g):
-    q = residuals[0]
+    q, k, v, out, lse = residuals
     actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
-    return _flash_bwd(actual_scale, causal, block_q, block_kv, residuals,
-                      g)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    dq, dk, dv = _pair_bwd(q, k, v, g, lse, delta, scale=actual_scale,
+                           causal=causal, block_q=block_q,
+                           block_kv=block_kv)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

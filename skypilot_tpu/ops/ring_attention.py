@@ -102,23 +102,32 @@ def _ring_fwd_loop(q, k, v, scale, axis_name, axis_size, causal):
 
 
 def _block_bwd(q, k, v, do, lse, delta, scale, q_off, k_off):
-    """FA2 block backward for one (q_chunk, kv_chunk) pair."""
-    s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    sl = q.shape[2]
-    rows = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 1)
-    # Global causal mask from ring offsets.
-    mask = jnp.where(q_off == k_off, rows >= cols, q_off > k_off)
-    s = jnp.where(mask, s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])
-    dof = do.astype(jnp.float32)
-    dp = jnp.einsum('bhqd,bhkd->bhqk', dof, v.astype(jnp.float32))
-    ds = p * (dp - delta[..., None]) * scale
-    dq = jnp.einsum('bhqk,bhkd->bhqd', ds, k.astype(jnp.float32))
-    dk = jnp.einsum('bhqk,bhqd->bhkd', ds, q.astype(jnp.float32))
-    dv = jnp.einsum('bhqk,bhqd->bhkd', p, dof)
-    return dq, dk, dv
+    """FA2 block backward for one (q_chunk, kv_chunk) pair.
+
+    Reuses the flash backward (Pallas on TPU) with the global lse/delta
+    — O(block) attention materialization instead of the full
+    [chunk x chunk] probability matrix.  Three cases by ring offset,
+    like the forward: kv strictly ahead → zero grads; same chunk →
+    causal; kv behind → full attention.
+    """
+    vma = fa._out_vma(q, k, v, do)  # pylint: disable=protected-access
+
+    def masked(_):
+        z = lambda x: fa._cast_vma(  # pylint: disable=protected-access
+            jnp.zeros(x.shape, jnp.float32), vma)
+        return z(q), z(k), z(v)
+
+    def diag(_):
+        return fa._pair_bwd(q, k, v, do, lse, delta,  # pylint: disable=protected-access
+                            scale=scale, causal=True)
+
+    def full(_):
+        return fa._pair_bwd(q, k, v, do, lse, delta,  # pylint: disable=protected-access
+                            scale=scale, causal=False)
+
+    return jax.lax.cond(
+        k_off > q_off, masked,
+        lambda _: jax.lax.cond(k_off == q_off, diag, full, None), None)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -50,6 +50,25 @@ class TestFlashAttention:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.parametrize('causal', [True, False])
+    def test_grads_multiblock(self, causal):
+        # 4 q-blocks x 4 kv-blocks: exercises cross-block accumulation
+        # in the Pallas dq and dk/dv backward kernels, incl. the causal
+        # block-skip predicate.
+        q, k, v = _qkv(s=512)
+
+        def loss_fa(q, k, v):
+            return (fa.flash_attention(q, k, v, None, causal, 128, 128)
+                    ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (fa.mha_reference(q, k, v, causal=causal) ** 2).sum()
+
+        g1 = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
+
     def test_uneven_blocks(self):
         q, k, v = _qkv(s=384)  # 3 blocks of 128
         out = fa.flash_attention(q, k, v, None, True, 128, 128)
