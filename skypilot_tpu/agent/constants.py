@@ -36,6 +36,7 @@ ENV_MEGASCALE_SLICE_ID = 'MEGASCALE_SLICE_ID'
 # Job/cluster env.
 ENV_CLUSTER_NAME = 'SKYTPU_CLUSTER_NAME'
 ENV_JOB_ID = 'SKYTPU_JOB_ID'
+ENV_LOG_DIR = 'SKYTPU_LOG_DIR'
 ENV_TASK_ID = 'SKYTPU_TASK_ID'
 
 # Agent-side filesystem layout, rooted at the per-host root dir

@@ -99,6 +99,10 @@ def _spawn_rank(spec: Dict[str, Any], rank: int, run_cmd: str,
         host_root = address[len('local:'):]
         workdir = os.path.join(host_root, constants.WORKDIR)
         os.makedirs(workdir, exist_ok=True)
+        # Job code (e.g. the trainer's SKYTPU_PROFILE hook) writes
+        # artifacts next to the per-rank logs (driver-local path, valid
+        # only for local ranks).
+        env[constants.ENV_LOG_DIR] = log_dir
         script = log_lib.make_task_bash_script(run_cmd, cwd=workdir,
                                                env_vars=env)
         full_env = dict(os.environ)
@@ -151,8 +155,15 @@ def _spawn_rank(spec: Dict[str, Any], rank: int, run_cmd: str,
             ssh_key=host.get('ssh_key'))
         exports = ''.join(f'export {k}={shlex.quote(str(v))}; '
                           for k, v in env.items())
+        # Remote rank: the driver's log_dir doesn't exist on that
+        # machine — artifacts go to a per-job dir under the remote home.
+        remote_artifacts = (f'$HOME/.skytpu/job_artifacts/'
+                            f'{int(spec["job_id"])}')
+        exports += (f'export {constants.ENV_LOG_DIR}='
+                    f'"{remote_artifacts}"; ')
         runtime_prefix = spec.get('remote_runtime_prefix', '')
-        remote = (f'{runtime_prefix}mkdir -p ~/{constants.WORKDIR} && '
+        remote = (f'{runtime_prefix}mkdir -p ~/{constants.WORKDIR} '
+                  f'"{remote_artifacts}" && '
                   f'cd ~/{constants.WORKDIR} && {exports}'
                   f'bash -c {shlex.quote(run_cmd)}')
         # '-tt' forces a pty so killing the local ssh client delivers

@@ -339,12 +339,29 @@ class Trainer:
               log_every: int = 10,
               checkpoint_manager=None,
               checkpoint_every: int = 0) -> Dict[str, float]:
+        import os
+
         from skypilot_tpu import callbacks
         cfg = self.config
         if self.state is None:
             self.init_state()
         steps = num_steps if num_steps is not None else cfg.total_steps
         tokens_per_step = cfg.global_batch_size * cfg.seq_len
+        # Workload profiling (the TPU analog of what the reference
+        # delegates to user tools): SKYTPU_PROFILE_DIR=<dir> (or
+        # SKYTPU_PROFILE=1 to write under the job log dir) captures an
+        # XLA trace of a few steady-state steps, viewable in
+        # TensorBoard/Perfetto.
+        profile_dir = os.environ.get('SKYTPU_PROFILE_DIR', '')
+        if not profile_dir and os.environ.get('SKYTPU_PROFILE') == '1':
+            profile_dir = os.path.join(
+                os.environ.get('SKYTPU_LOG_DIR', os.getcwd()), 'profile')
+        if jax.process_index() != 0:
+            profile_dir = ''
+        # Skip the compile step so the trace shows steady-state compute.
+        prof_start = 1 if steps > 1 else 0
+        prof_stop = min(prof_start + 3, steps)
+        profiling = False
         # Step-log only from process 0: every rank of a multi-host job
         # inherits the same log path, and interleaved per-rank records
         # would corrupt the harness's sec/step medians.
@@ -353,30 +370,41 @@ class Trainer:
         t0 = time.time()
         window_tokens = 0
         last: Dict[str, float] = {}
-        for i in range(steps):
-            batch = next(data_iter)
-            metrics = self.step(batch)
-            window_tokens += tokens_per_step
-            if bench_logger is not None:
-                bench_logger.log_step(i + 1)
-            if (i + 1) % log_every == 0 or i + 1 == steps:
-                metrics = jax.device_get(metrics)
-                dt = time.time() - t0
-                tps = window_tokens / dt if dt > 0 else 0.0
-                last = {
-                    'step': int(self.state.step),
-                    'loss': float(metrics['loss']),
-                    'accuracy': float(metrics['accuracy']),
-                    'grad_norm': float(metrics['grad_norm']),
-                    'tokens_per_sec': tps,
-                }
-                logger.info(
-                    f'step {last["step"]} loss {last["loss"]:.4f} '
-                    f'acc {last["accuracy"]:.3f} {tps:,.0f} tok/s')
-                t0 = time.time()
-                window_tokens = 0
-            if checkpoint_manager is not None and checkpoint_every and \
-                    (i + 1) % checkpoint_every == 0:
-                from skypilot_tpu.train import checkpoint as ckpt_lib
-                ckpt_lib.save(checkpoint_manager, self.state)
+        try:
+            for i in range(steps):
+                if profile_dir and i == prof_start:
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                batch = next(data_iter)
+                metrics = self.step(batch)
+                if profiling and i + 1 == prof_stop:
+                    jax.device_get(metrics['loss'])  # drain async work
+                    jax.profiler.stop_trace()
+                    profiling = False
+                window_tokens += tokens_per_step
+                if bench_logger is not None:
+                    bench_logger.log_step(i + 1)
+                if (i + 1) % log_every == 0 or i + 1 == steps:
+                    metrics = jax.device_get(metrics)
+                    dt = time.time() - t0
+                    tps = window_tokens / dt if dt > 0 else 0.0
+                    last = {
+                        'step': int(self.state.step),
+                        'loss': float(metrics['loss']),
+                        'accuracy': float(metrics['accuracy']),
+                        'grad_norm': float(metrics['grad_norm']),
+                        'tokens_per_sec': tps,
+                    }
+                    logger.info(
+                        f'step {last["step"]} loss {last["loss"]:.4f} '
+                        f'acc {last["accuracy"]:.3f} {tps:,.0f} tok/s')
+                    t0 = time.time()
+                    window_tokens = 0
+                if checkpoint_manager is not None and checkpoint_every and \
+                        (i + 1) % checkpoint_every == 0:
+                    from skypilot_tpu.train import checkpoint as ckpt_lib
+                    ckpt_lib.save(checkpoint_manager, self.state)
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
         return last

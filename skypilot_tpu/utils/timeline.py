@@ -4,9 +4,11 @@ Re-implements the reference's decorator-based tracer
 (sky/utils/timeline.py:1-133): `@timeline.event` wraps any callable, and
 `FileLockEvent` wraps lock acquisition, emitting complete ('X'-phase style
 begin/end 'B'/'E') events into a JSON trace written at process exit when
-SKYTPU_DEBUG=1.  Workload-level profiling is handled separately by
-`jax.profiler` hooks in skypilot_tpu/train (the TPU analog of what the
-reference delegates to user tools, SURVEY.md §5).
+SKYTPU_DEBUG=1.  Workload-level profiling is separate: the trainer's
+loop (skypilot_tpu/train/trainer.py Trainer.train) captures a
+`jax.profiler` trace of a few steady-state steps when
+SKYTPU_PROFILE_DIR=<dir> or SKYTPU_PROFILE=1 is set (the TPU analog of
+what the reference delegates to user tools, SURVEY.md §5).
 """
 from __future__ import annotations
 

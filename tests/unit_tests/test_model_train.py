@@ -108,6 +108,18 @@ class TestTrainer:
         last = float(jax.device_get(metrics['loss']))
         assert last < first - 0.5, (first, last)
 
+    def test_profiler_hook_writes_trace(self, tmp_path, monkeypatch):
+        prof_dir = tmp_path / 'profile'
+        monkeypatch.setenv('SKYTPU_PROFILE_DIR', str(prof_dir))
+        trainer = self._trainer()
+        data_iter = data_lib.synthetic_data(
+            trainer.mesh, global_batch_size=8, seq_len=64,
+            vocab_size=trainer.model_config.vocab_size)
+        trainer.train(data_iter, num_steps=4, log_every=10)
+        traces = list(prof_dir.rglob('*'))
+        assert any(p.is_file() for p in traces), (
+            f'no trace files under {prof_dir}')
+
     def test_grad_accum_matches_single_step(self):
         t1 = self._trainer(grad_accum_steps=1, grad_clip_norm=1e9)
         t2 = self._trainer(grad_accum_steps=2, grad_clip_norm=1e9)
