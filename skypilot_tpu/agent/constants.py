@@ -13,7 +13,11 @@ are num_nodes*H processes — matching the reference's TPU-pod behavior
 cloud_vm_ray_backend.py:5075).
 """
 
-AGENT_VERSION = 1
+# Bump on any agent/RPC behavior change: a running daemon whose
+# recorded version differs is killed and restarted with the freshly
+# shipped runtime on the next launch (reference: SKYLET_VERSION gating,
+# sky/skylet/attempt_skylet.py + constants.py:89).
+AGENT_VERSION = 2
 
 # Rank/env contract injected into every job process.
 ENV_NODE_RANK = 'SKYTPU_NODE_RANK'          # host rank, 0..N-1
@@ -45,6 +49,7 @@ AGENT_DIR = '.skytpu_agent'
 JOBS_DB = 'jobs.db'
 AGENT_LOG = 'agent.log'
 AGENT_PID = 'agent.pid'
+AGENT_VERSION_FILE = 'agent.version'
 AGENT_CONFIG = 'agent_config.json'
 JOB_LOGS_DIR = 'job_logs'
 WORKDIR = 'workdir'

@@ -127,6 +127,12 @@ def main() -> None:
     with open(os.path.join(agent_dir, constants.AGENT_PID), 'w',
               encoding='utf-8') as f:
         f.write(str(os.getpid()))
+    # Version gate: the backend compares this file against its own
+    # AGENT_VERSION after shipping a new runtime and restarts us on
+    # mismatch (reference attempt_skylet.py).
+    with open(os.path.join(agent_dir, constants.AGENT_VERSION_FILE), 'w',
+              encoding='utf-8') as f:
+        f.write(str(constants.AGENT_VERSION))
     table = job_lib.JobTable(root)
     events = [JobSchedulerEvent(table), AutostopEvent(table, root)]
     while True:

@@ -119,6 +119,11 @@ class TpuGangBackend(backend_lib.Backend):
             handle: backend_lib.ClusterHandle = record['handle']
             self.check_resources_fit_cluster(handle, task)
             logger.info(f'Cluster {cluster_name!r} is UP; reusing.')
+            # Refresh the shipped runtime and restart the agent if its
+            # version is stale (reference: wheel re-ship on every launch
+            # + attempt_skylet version gating).  rsync makes the ship
+            # incremental; `exec` skips this via its fast path.
+            self._post_provision_runtime_setup(handle)
             return handle
         resume = record is not None and record['status'] == \
             global_user_state.ClusterStatus.STOPPED
@@ -301,10 +306,18 @@ class TpuGangBackend(backend_lib.Backend):
                      if root else f'$HOME/{agent_constants.AGENT_DIR}')
         pid_file = f'{agent_dir}/{agent_constants.AGENT_PID}'
         log_file = f'{agent_dir}/{agent_constants.AGENT_LOG}'
+        version_file = f'{agent_dir}/{agent_constants.AGENT_VERSION_FILE}'
+        want = agent_constants.AGENT_VERSION
+        # Keep a live daemon only if its recorded version matches the
+        # runtime just shipped; otherwise kill it and start fresh
+        # (reference attempt_skylet.py restart-on-version-change).
         cmd = (
             f'mkdir -p {agent_dir}; '
+            f'have=$(cat {version_file} 2>/dev/null || echo 0); '
             f'if [ -f {pid_file} ] && kill -0 $(cat {pid_file}) '
-            '2>/dev/null; then true; else '
+            f'2>/dev/null && [ "$have" = "{want}" ]; then true; else '
+            f'if [ -f {pid_file} ]; then kill $(cat {pid_file}) '
+            '2>/dev/null || true; fi; '
             f'nohup python3 -u -m skypilot_tpu.agent.daemon {root_arg} '
             f'>> {log_file} 2>&1 & fi')
         self.run_on_head(handle, cmd, timeout=60)

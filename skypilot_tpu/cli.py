@@ -341,12 +341,39 @@ def jobs() -> None:
 @click.option('--name', '-n', default=None)
 @click.option('--detach-run', '-d', is_flag=True, default=False,
               help='Do not wait for the job to finish.')
+@click.option('--remote-controller', '-r', is_flag=True, default=False,
+              help='Run the recovery controller on a self-hosted '
+                   'controller cluster (survives this client exiting).')
 @_add_options([o for o in _RESOURCE_OPTIONS
                if 'name' not in getattr(o, 'name', '')])
-def jobs_launch(entrypoint, name, detach_run, **overrides) -> None:
+def jobs_launch(entrypoint, name, detach_run, remote_controller,
+                **overrides) -> None:
     """Submit a managed job (auto-recovered on preemption)."""
     from skypilot_tpu.jobs import core as jobs_core
     task = _make_task(entrypoint, name=name, **overrides)
+    if remote_controller:
+        import time as time_lib
+
+        import skypilot_tpu as sky
+        from skypilot_tpu.jobs import remote as jobs_remote
+        cluster, agent_job = jobs_remote.launch(task, name=name)
+        click.echo(f'Managed job submitted to controller cluster '
+                   f'{cluster!r} (controller job {agent_job}). Query '
+                   f'with: sky jobs queue --remote-controller')
+        if not detach_run:
+            # The controller job's lifetime IS the managed job's
+            # lifetime; wait for it like the local path waits.
+            while True:
+                status = sky.job_status(cluster, [agent_job])[agent_job]
+                if status in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP',
+                              'FAILED_DRIVER', 'CANCELLED'):
+                    break
+                time_lib.sleep(5)
+            click.echo(f'Managed job finished (controller job status: '
+                       f'{status}).')
+            if status != 'SUCCEEDED':
+                sys.exit(1)
+        return
     job_id = jobs_core.launch(task, name=name)
     click.echo(f'Managed job {job_id} submitted.')
     if not detach_run:
@@ -362,11 +389,18 @@ def jobs_launch(entrypoint, name, detach_run, **overrides) -> None:
 
 
 @jobs.command(name='queue')
-def jobs_queue() -> None:
+@click.option('--remote-controller', '-r', is_flag=True, default=False,
+              help='Query the self-hosted controller cluster.')
+def jobs_queue(remote_controller) -> None:
     """List managed jobs."""
-    from skypilot_tpu.jobs import core as jobs_core
+    if remote_controller:
+        from skypilot_tpu.jobs import remote as jobs_remote
+        jobs_rows = jobs_remote.queue()
+    else:
+        from skypilot_tpu.jobs import core as jobs_core
+        jobs_rows = jobs_core.queue()
     rows = []
-    for j in jobs_core.queue():
+    for j in jobs_rows:
         status_str = j['status'].value if hasattr(j['status'], 'value') \
             else str(j['status'])
         rows.append((str(j['job_id']), j['job_name'] or '-', status_str,
@@ -377,9 +411,15 @@ def jobs_queue() -> None:
 @jobs.command(name='cancel')
 @click.argument('job_ids', type=int, nargs=-1)
 @click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
-def jobs_cancel(job_ids, all_jobs) -> None:
-    from skypilot_tpu.jobs import core as jobs_core
-    cancelled = jobs_core.cancel(list(job_ids) or None, all_jobs)
+@click.option('--remote-controller', '-r', is_flag=True, default=False,
+              help='Cancel on the self-hosted controller cluster.')
+def jobs_cancel(job_ids, all_jobs, remote_controller) -> None:
+    if remote_controller:
+        from skypilot_tpu.jobs import remote as jobs_remote
+        cancelled = jobs_remote.cancel(list(job_ids) or None, all_jobs)
+    else:
+        from skypilot_tpu.jobs import core as jobs_core
+        cancelled = jobs_core.cancel(list(job_ids) or None, all_jobs)
     click.echo(f'Cancelled managed jobs: {cancelled}')
 
 

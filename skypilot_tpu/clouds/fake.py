@@ -16,6 +16,9 @@ run without GCP) plus simple CPU instance types.
 """
 from __future__ import annotations
 
+import contextlib
+import json
+import os
 import threading
 import typing
 from typing import Any, Dict, Iterator, List, Optional, Tuple
@@ -43,42 +46,186 @@ _SPOT_DISCOUNT = 0.3  # spot price = 30% of on-demand
 _TPU_PER_CHIP = 1.0
 
 
+def _dump_exc(e: Exception) -> Dict[str, Any]:
+    attrs = {}
+    for k, v in vars(e).items():
+        if isinstance(v, (str, int, float, bool, type(None))):
+            attrs[k] = v
+    return {'module': type(e).__module__, 'type': type(e).__name__,
+            'args': [str(a) for a in e.args], 'attrs': attrs}
+
+
+def _load_exc(d: Dict[str, Any]) -> Exception:
+    import importlib
+    try:
+        cls = getattr(importlib.import_module(d['module']), d['type'])
+        if not (isinstance(cls, type) and issubclass(cls, BaseException)):
+            cls = Exception
+    except Exception:  # noqa: BLE001
+        cls = Exception
+    try:
+        exc = cls(*d.get('args', []))
+    except TypeError:
+        exc = Exception(*d.get('args', []))
+    for k, v in d.get('attrs', {}).items():
+        try:
+            setattr(exc, k, v)
+        except AttributeError:
+            pass
+    return exc
+
+
 class FakeCloudState:
-    """Injectable control-plane state shared with provision/fake."""
+    """Injectable control-plane state shared with provision/fake.
+
+    File-backed (JSON under the state dir, filelock-guarded) so a
+    controller running in a separate process — e.g. a self-hosted jobs
+    controller on a local-cloud cluster — observes fault injections made
+    by the client/test process, the way a real cloud's control plane is
+    shared.  All reads/mutations go through `transaction()`; nested
+    transactions reuse the outer snapshot and save once at the end.
+    """
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
-        self.zone_capacity: Dict[str, int] = {}       # zone -> slots left
-        self.one_shot_failures: Dict[str, List[Exception]] = {}
-        self.persistent_failures: Dict[str, Exception] = {}
-        self.instances: Dict[str, Dict[str, Any]] = {}  # id -> record
-        self.provision_delay_s: float = 0.0
+        self._tlock = threading.RLock()
+        self._depth = 0
+        self._flock: Optional[Any] = None
+        self._flock_path: Optional[str] = None
+        self._zone_capacity: Dict[str, int] = {}      # zone -> slots left
+        self._one_shot_failures: Dict[str, List[Exception]] = {}
+        self._persistent_failures: Dict[str, Exception] = {}
+        self._instances: Dict[str, Dict[str, Any]] = {}  # id -> record
+        self._provision_delay_s: float = 0.0
         self._counter = 0
 
+    # -- persistence -------------------------------------------------------
+    def _file(self) -> str:
+        from skypilot_tpu.utils import paths
+        return os.path.join(paths.fake_cloud_dir(), 'state.json')
+
+    def _load(self, path: str) -> None:
+        try:
+            with open(path, encoding='utf-8') as f:
+                data = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            data = {}
+        self._zone_capacity = dict(data.get('zone_capacity', {}))
+        self._one_shot_failures = {
+            z: [_load_exc(e) for e in excs]
+            for z, excs in data.get('one_shot_failures', {}).items()}
+        self._persistent_failures = {
+            z: _load_exc(e)
+            for z, e in data.get('persistent_failures', {}).items()}
+        self._instances = dict(data.get('instances', {}))
+        self._provision_delay_s = float(
+            data.get('provision_delay_s', 0.0))
+        self._counter = int(data.get('counter', 0))
+
+    def _save(self, path: str) -> None:
+        data = {
+            'zone_capacity': self._zone_capacity,
+            'one_shot_failures': {
+                z: [_dump_exc(e) for e in excs]
+                for z, excs in self._one_shot_failures.items()},
+            'persistent_failures': {
+                z: _dump_exc(e)
+                for z, e in self._persistent_failures.items()},
+            'instances': self._instances,
+            'provision_delay_s': self._provision_delay_s,
+            'counter': self._counter,
+        }
+        tmp = path + f'.tmp{os.getpid()}'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+
+    @contextlib.contextmanager
+    def transaction(self) -> Iterator['FakeCloudState']:
+        import filelock
+        with self._tlock:
+            path = self._file()
+            if self._depth == 0:
+                if self._flock is None or self._flock_path != path:
+                    self._flock = filelock.FileLock(path + '.lock')
+                    self._flock_path = path
+                self._flock.acquire()
+                self._load(path)
+            self._depth += 1
+            try:
+                yield self
+            finally:
+                self._depth -= 1
+                if self._depth == 0:
+                    try:
+                        self._save(path)
+                    finally:
+                        self._flock.release()
+
+    def _refreshed(self) -> 'FakeCloudState':
+        """Load from disk unless a transaction already holds a snapshot.
+
+        Reads through the field properties below are therefore always
+        cross-process fresh; mutations only persist inside
+        `with state.transaction():`.
+        """
+        with self._tlock:
+            if self._depth == 0:
+                self._load(self._file())
+            return self
+
+    @property
+    def instances(self) -> Dict[str, Dict[str, Any]]:
+        return self._refreshed()._instances
+
+    @property
+    def zone_capacity(self) -> Dict[str, int]:
+        return self._refreshed()._zone_capacity
+
+    @property
+    def one_shot_failures(self) -> Dict[str, List[Exception]]:
+        return self._refreshed()._one_shot_failures
+
+    @property
+    def persistent_failures(self) -> Dict[str, Exception]:
+        return self._refreshed()._persistent_failures
+
+    @property
+    def provision_delay_s(self) -> float:
+        return self._refreshed()._provision_delay_s
+
+    @provision_delay_s.setter
+    def provision_delay_s(self, seconds: float) -> None:
+        with self.transaction():
+            self._provision_delay_s = float(seconds)
+
     def reset(self) -> None:
-        with self._lock:
-            self.zone_capacity.clear()
-            self.one_shot_failures.clear()
-            self.persistent_failures.clear()
-            self.instances.clear()
-            self.provision_delay_s = 0.0
+        # Take the file lock first so a process mid-transaction can't
+        # have its snapshot overwrite the reset (the .lock file itself
+        # is left in place — unlinking it would split mutual exclusion
+        # across two inodes).
+        with self.transaction():
+            self._zone_capacity = {}
+            self._one_shot_failures = {}
+            self._persistent_failures = {}
+            self._instances = {}
+            self._provision_delay_s = 0.0
             self._counter = 0
 
     # -- fault injection ---------------------------------------------------
     def set_zone_capacity(self, zone: str, capacity: int) -> None:
-        with self._lock:
+        with self.transaction():
             self.zone_capacity[zone] = capacity
 
     def fail_next(self, zone: str, error: Exception) -> None:
-        with self._lock:
+        with self.transaction():
             self.one_shot_failures.setdefault(zone, []).append(error)
 
     def fail_always(self, zone: str, error: Exception) -> None:
-        with self._lock:
+        with self.transaction():
             self.persistent_failures[zone] = error
 
     def clear_failures(self, zone: Optional[str] = None) -> None:
-        with self._lock:
+        with self.transaction():
             if zone is None:
                 self.one_shot_failures.clear()
                 self.persistent_failures.clear()
@@ -91,8 +238,8 @@ class FakeCloudState:
         fault injection — the reference does this by literally terminating
         cloud instances in smoke tests, SURVEY.md §5)."""
         n = 0
-        with self._lock:
-            for rec in self.instances.values():
+        with self.transaction():
+            for rec in self._instances.values():
                 if (rec['cluster'] == cluster_name_on_cloud and
                         rec['status'] == 'running'):
                     rec['status'] = 'terminated'
@@ -101,20 +248,20 @@ class FakeCloudState:
         return n
 
     def stop_cluster_instances(self, cluster_name_on_cloud: str) -> None:
-        with self._lock:
-            for rec in self.instances.values():
+        with self.transaction():
+            for rec in self._instances.values():
                 if rec['cluster'] == cluster_name_on_cloud:
                     rec['status'] = 'stopped'
 
     # -- control plane used by provision/fake ------------------------------
     def next_id(self) -> str:
-        with self._lock:
+        with self.transaction():
             self._counter += 1
             return f'fake-inst-{self._counter}'
 
     def check_and_take_capacity(self, zone: str, count: int) -> None:
         from skypilot_tpu import exceptions
-        with self._lock:
+        with self.transaction():
             if zone in self.persistent_failures:
                 raise self.persistent_failures[zone]
             if self.one_shot_failures.get(zone):

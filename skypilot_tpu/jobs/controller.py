@@ -7,13 +7,13 @@ clean up and advance the pipeline; cluster preempted/down → RECOVERING →
 `strategy.recover()`; user-code failure → consume `max_restarts_on_errors`
 credits or fail the job.
 
-Deployment shift vs the reference: the reference runs this file on a
-*controller VM* (a cluster provisioned just to babysit jobs); here the
-controller runs as a detached local process (`python -m
-skypilot_tpu.jobs.controller --job-id N`) or an in-process thread —
-clients are assumed long-lived (workstation/CI), and nothing in the loop
-needs cloud-side placement.  All state is SQLite (jobs/state.py), so a
-controller process can be restarted and resume monitoring.
+Deployment: by default the controller runs as a detached local process
+(`python -m skypilot_tpu.jobs.controller --job-id N`) or an in-process
+thread; for recovery that survives the client machine, jobs/remote.py
+self-hosts this same loop on a controller *cluster* (the reference's
+controller-VM deployment, sky/jobs/core.py:39).  All state is SQLite
+(jobs/state.py), so a controller process can be restarted and resume
+monitoring.
 """
 from __future__ import annotations
 

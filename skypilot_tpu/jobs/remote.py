@@ -1,0 +1,229 @@
+"""Self-hosted jobs controller: recovery that survives the client.
+
+Reference semantics (sky/jobs/core.py:39 + jobs-controller.yaml.j2 +
+wheel shipping, sky/backends/wheel_utils.py): `jobs launch` renders a
+controller Task that file-mounts the user's DAG onto a dedicated
+controller cluster and launches it there, so preemption recovery keeps
+running after the client machine disappears.
+
+Here the same shape, without the template layer:
+
+  - a small, reusable controller cluster (default name
+    `skytpu-jobs-controller`, resources from config
+    `jobs.controller.resources`) is provisioned through the normal
+    launch path — which ships the runtime tree and starts the agent;
+  - the DAG YAML is file-mounted onto it and the submitted job runs
+    `python -m skypilot_tpu.jobs.remote --dag <yaml>`: ON the
+    controller host this registers the managed job in the host's own
+    jobs DB and runs the controller inline, so the agent job stays
+    RUNNING for the life of the managed job and its log is the
+    controller event log;
+  - client-side queue/cancel go through the same
+    run-a-module-on-the-head channel the agent RPC uses (the
+    reference's ManagedJobCodeGen-over-SSH analog, sky/jobs/utils.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.utils import dag_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_RESPONSE_BEGIN = '<skytpu-jobs-remote>'
+_RESPONSE_END = '</skytpu-jobs-remote>'
+# Relative to the job's cwd (<root>/<workdir>); file mounts land at the
+# host root on every cloud (local: host dir, SSH: $HOME).
+_DAG_MOUNT_DIR = 'managed_dags'
+
+
+def controller_cluster_name() -> str:
+    from skypilot_tpu import config
+    return config.get_nested(('jobs', 'controller', 'cluster_name'),
+                             'skytpu-jobs-controller')
+
+
+def controller_resources() -> 'Any':
+    """Controller cluster resources: config override or a small CPU VM
+    (reference controller_utils.get_controller_resources)."""
+    from skypilot_tpu import config
+    from skypilot_tpu import resources as resources_lib
+    spec = config.get_nested(('jobs', 'controller', 'resources'), None)
+    if spec:
+        return resources_lib.Resources.from_yaml_config(dict(spec))
+    return resources_lib.Resources(cpus='2+')
+
+
+def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
+           name: Optional[str] = None,
+           controller_cluster: Optional[str] = None,
+           resources: Optional[Any] = None) -> Tuple[str, int]:
+    """Submit a managed job to the (auto-provisioned) controller
+    cluster.  Returns (controller_cluster_name, agent_job_id) — the
+    managed-job id is allocated on the controller host; query it with
+    `queue()`.
+    """
+    from skypilot_tpu import execution
+
+    import re
+    import shlex
+    import shutil
+
+    dag = dag_utils.convert_entrypoint_to_dag(entrypoint)
+    dag.validate()
+    if not dag.is_chain():
+        raise exceptions.NotSupportedError(
+            'Managed jobs support single tasks and chain pipelines only.')
+    if name is not None:
+        dag.name = name
+    if dag.name is not None and not re.fullmatch(
+            task_lib._VALID_NAME_REGEX, dag.name):  # pylint: disable=protected-access
+        raise exceptions.TaskValidationError(
+            f'Invalid managed-job name {dag.name!r}; must match '
+            f'{task_lib._VALID_NAME_REGEX}')  # pylint: disable=protected-access
+    for t in dag.tasks:
+        t.validate()
+
+    cluster = controller_cluster or controller_cluster_name()
+    dag_basename = f'dag-{int(time.time())}-{uuid.uuid4().hex[:8]}.yaml'
+    local_dir = tempfile.mkdtemp(prefix='skytpu-managed-')
+    local_yaml = os.path.join(local_dir, dag_basename)
+    dag_utils.dump_chain_dag_to_yaml(dag, local_yaml)
+
+    job_name = dag.name or 'unnamed'
+    controller_task = task_lib.Task(
+        name=f'managed-{job_name}',
+        run=(f'python3 -m skypilot_tpu.jobs.remote '
+             f'--dag ../{_DAG_MOUNT_DIR}/{dag_basename} '
+             f'--name {shlex.quote(job_name)}'),
+    )
+    controller_task.set_file_mounts(
+        {f'{_DAG_MOUNT_DIR}/{dag_basename}': local_yaml})
+    controller_task.set_resources(resources or controller_resources())
+
+    try:
+        job_id, _ = execution.launch(controller_task,
+                                     cluster_name=cluster,
+                                     detach_run=True,
+                                     quiet_optimizer=True)
+    finally:
+        shutil.rmtree(local_dir, ignore_errors=True)
+    logger.info(
+        f'Managed job {job_name!r} submitted to controller cluster '
+        f'{cluster!r} (agent job {job_id}). Recovery now runs there and '
+        f'survives this client.')
+    return cluster, job_id
+
+
+# ---------------------------------------------------------------------------
+# Client-side queries (run a module invocation on the controller head)
+# ---------------------------------------------------------------------------
+def _controller_handle(controller_cluster: Optional[str] = None):
+    from skypilot_tpu import global_user_state
+    cluster = controller_cluster or controller_cluster_name()
+    record = global_user_state.get_cluster_from_name(cluster)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Jobs controller cluster {cluster!r} does not exist.')
+    return record['handle']
+
+
+def _run_remote(controller_cluster: Optional[str],
+                args: str) -> Dict[str, Any]:
+    from skypilot_tpu.backend import tpu_gang_backend
+    handle = _controller_handle(controller_cluster)
+    backend = tpu_gang_backend.TpuGangBackend()
+    cmd = f'python3 -u -m skypilot_tpu.jobs.remote {args}'
+    rc, stdout, stderr = backend.run_on_head(handle, cmd,
+                                             require_outputs=True,
+                                             timeout=120)
+    if rc != 0:
+        raise exceptions.CommandError(rc, cmd, stderr or stdout)
+    start = stdout.rfind(_RESPONSE_BEGIN)
+    end = stdout.rfind(_RESPONSE_END)
+    if start == -1 or end == -1 or end < start:
+        raise exceptions.SkyTpuError(
+            f'Malformed jobs-remote response: {stdout[-500:]!r}')
+    return json.loads(stdout[start + len(_RESPONSE_BEGIN):end])
+
+
+def queue(controller_cluster: Optional[str] = None
+          ) -> List[Dict[str, Any]]:
+    """Managed jobs on the controller cluster, newest first."""
+    return _run_remote(controller_cluster, '--queue-json')['jobs']
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False,
+           controller_cluster: Optional[str] = None) -> List[int]:
+    if all_jobs:
+        args = '--cancel-all'
+    elif job_ids:
+        args = '--cancel ' + ' '.join(str(i) for i in job_ids)
+    else:
+        return []
+    return _run_remote(controller_cluster, args)['cancelled']
+
+
+# ---------------------------------------------------------------------------
+# Controller-host side (the file-mounted job's run command)
+# ---------------------------------------------------------------------------
+def _emit(payload: Dict[str, Any]) -> None:
+    print(_RESPONSE_BEGIN + json.dumps(payload) + _RESPONSE_END,
+          flush=True)
+
+
+def _serve_dag(dag_path: str, name: Optional[str]) -> None:
+    """Register + run the managed job inline; the surrounding agent job
+    is the controller process (its liveness IS controller liveness)."""
+    from skypilot_tpu.jobs import core as jobs_core
+    dag = dag_utils.load_chain_dag_from_yaml(os.path.expanduser(dag_path))
+    job_id = jobs_core.launch(dag, name=name, controller_mode='inline')
+    from skypilot_tpu.jobs import state as jobs_state
+    status = jobs_state.get_status(job_id)
+    print(f'managed job {job_id} finished: {status}', flush=True)
+    if status is not jobs_state.ManagedJobStatus.SUCCEEDED:
+        sys.exit(1)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dag', default=None)
+    parser.add_argument('--name', default=None)
+    parser.add_argument('--queue-json', action='store_true')
+    parser.add_argument('--cancel', type=int, nargs='+', default=None)
+    parser.add_argument('--cancel-all', action='store_true')
+    args = parser.parse_args(argv)
+
+    from skypilot_tpu.jobs import core as jobs_core
+    if args.dag:
+        _serve_dag(args.dag, args.name)
+    elif args.queue_json:
+        jobs = jobs_core.queue()
+        for j in jobs:
+            j['status'] = str(j['status'].value
+                              if hasattr(j['status'], 'value')
+                              else j['status'])
+        _emit({'jobs': jobs})
+    elif args.cancel or args.cancel_all:
+        cancelled = jobs_core.cancel(job_ids=args.cancel,
+                                     all_jobs=args.cancel_all)
+        _emit({'cancelled': cancelled})
+    else:
+        parser.error('Nothing to do.')
+
+
+if __name__ == '__main__':
+    main()

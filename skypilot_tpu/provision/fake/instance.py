@@ -28,6 +28,8 @@ def _state() -> fake_cloud.FakeCloudState:
 def _cluster_instances(cluster_name_on_cloud: str,
                        include_terminated: bool = False
                        ) -> Dict[str, Dict[str, Any]]:
+    """Cluster records from the CURRENT transaction snapshot (callers
+    mutate the returned records, so they must hold a transaction)."""
     return {
         iid: rec for iid, rec in _state().instances.items()
         if rec['cluster'] == cluster_name_on_cloud and
@@ -37,29 +39,37 @@ def _cluster_instances(cluster_name_on_cloud: str,
 
 def run_instances(region: str, cluster_name_on_cloud: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
-    state = _state()
     node_cfg = config.node_config
     zone = node_cfg.get('zone') or f'{region}-1'
     num_hosts = int(node_cfg.get('num_tpu_hosts', 1) or 1)
     is_tpu = bool(node_cfg.get('tpu_vm'))
 
-    existing = _cluster_instances(cluster_name_on_cloud)
-    resumed: List[str] = []
-    if config.resume_stopped_nodes:
-        for iid, rec in existing.items():
-            if rec['status'] == 'stopped':
-                rec['status'] = 'running'
-                resumed.append(iid)
-    running = [iid for iid, rec in existing.items()
-               if rec['status'] == 'running']
-    to_create = config.count - len(running)
+    with _state().transaction() as state:
+        existing = _cluster_instances(cluster_name_on_cloud)
+        resumed: List[str] = []
+        if config.resume_stopped_nodes:
+            for iid, rec in existing.items():
+                if rec['status'] == 'stopped':
+                    rec['status'] = 'running'
+                    resumed.append(iid)
+        running = [iid for iid, rec in existing.items()
+                   if rec['status'] == 'running']
+        to_create = config.count - len(running)
+        # Capacity/fault check counts hosts: a whole slice takes
+        # num_hosts slots and is admitted or rejected atomically (slice
+        # gang admission).
+        if to_create > 0:
+            state.check_and_take_capacity(zone, to_create * num_hosts)
+        delay = state.provision_delay_s
+
+    # Simulated provisioning latency runs with the control-plane lock
+    # RELEASED, so tests/controllers can race fault injections against
+    # an in-flight provision (capacity is already reserved above).
+    if to_create > 0 and delay:
+        time.sleep(delay)
+
     created: List[str] = []
-    # Capacity/fault check counts hosts: a whole slice takes num_hosts slots
-    # and is admitted or rejected atomically (slice gang admission).
-    if to_create > 0:
-        state.check_and_take_capacity(zone, to_create * num_hosts)
-        if state.provision_delay_s:
-            time.sleep(state.provision_delay_s)
+    with _state().transaction() as state:
         for _ in range(to_create):
             iid = state.next_id()
             seq = len(state.instances)
@@ -79,8 +89,8 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             }
             created.append(iid)
 
-    all_insts = sorted(_cluster_instances(cluster_name_on_cloud))
-    head_id = all_insts[0]
+        all_insts = sorted(_cluster_instances(cluster_name_on_cloud))
+        head_id = all_insts[0]
     return common.ProvisionRecord(
         provider_name=_PROVIDER,
         cluster_name=cluster_name_on_cloud,
@@ -95,27 +105,29 @@ def run_instances(region: str, cluster_name_on_cloud: str,
 def stop_instances(cluster_name_on_cloud: str,
                    provider_config: Optional[Dict[str, Any]] = None,
                    worker_only: bool = False) -> None:
-    for iid, rec in _cluster_instances(cluster_name_on_cloud).items():
-        if worker_only and iid == sorted(
-                _cluster_instances(cluster_name_on_cloud))[0]:
-            continue
-        if rec['tpu'] and len(rec['host_ips']) > 1:
-            from skypilot_tpu import exceptions
-            raise exceptions.NotSupportedError(
-                'TPU pod slices cannot be stopped.')
-        rec['status'] = 'stopped'
+    with _state().transaction():
+        insts = _cluster_instances(cluster_name_on_cloud)
+        head = sorted(insts)[0] if insts else None
+        for iid, rec in insts.items():
+            if worker_only and iid == head:
+                continue
+            if rec['tpu'] and len(rec['host_ips']) > 1:
+                from skypilot_tpu import exceptions
+                raise exceptions.NotSupportedError(
+                    'TPU pod slices cannot be stopped.')
+            rec['status'] = 'stopped'
 
 
 def terminate_instances(cluster_name_on_cloud: str,
                         provider_config: Optional[Dict[str, Any]] = None,
                         worker_only: bool = False) -> None:
-    state = _state()
-    insts = _cluster_instances(cluster_name_on_cloud)
-    head = sorted(insts)[0] if insts else None
-    for iid, rec in insts.items():
-        if worker_only and iid == head:
-            continue
-        rec['status'] = 'terminated'
+    with _state().transaction():
+        insts = _cluster_instances(cluster_name_on_cloud)
+        head = sorted(insts)[0] if insts else None
+        for iid, rec in insts.items():
+            if worker_only and iid == head:
+                continue
+            rec['status'] = 'terminated'
 
 
 def query_instances(cluster_name_on_cloud: str,

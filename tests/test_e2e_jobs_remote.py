@@ -1,0 +1,122 @@
+"""End-to-end: self-hosted jobs controller on a controller cluster.
+
+The reference's marquee managed-jobs property — recovery survives the
+client because the controller runs on its own cluster
+(sky/jobs/core.py:39 + jobs-controller.yaml.j2) — exercised hermetically:
+the controller cluster and the task cluster are both local process
+clusters; preemption is injected by terminating the task cluster's
+instances through the provisioner API.  The controller process is
+parented to the (detached) agent daemon of the controller cluster, not
+to this test process, which is the survives-client-exit property.
+"""
+import os
+import time
+
+import psutil
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_user_state
+from skypilot_tpu.jobs import remote as jobs_remote
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.provision.local import instance as local_instance
+
+CONTROLLER = 'jc1'
+
+
+@pytest.fixture(autouse=True)
+def _fast_loops(monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_STATUS_GAP', '0.3')
+    monkeypatch.setenv('SKYTPU_JOBS_LAUNCH_BACKOFF', '0.2')
+    yield
+    # Tearing down the controller cluster kills the controller process
+    # tree (local provisioner reaper), so nothing leaks into later tests.
+    try:
+        sky.down(CONTROLLER)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _wait(predicate, timeout, desc):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.25)
+    raise TimeoutError(f'timed out waiting for {desc}')
+
+
+def _newest_job():
+    rows = jobs_state.get_managed_jobs()
+    return rows[0] if rows else None
+
+
+def _task_row(job_id):
+    return jobs_state.get_job_tasks(job_id)[0]
+
+
+class TestSelfHostedController:
+
+    def test_recovers_after_preemption_without_client(self):
+        task = sky.Task(run='sleep 600', name='rmj')
+        task.set_resources(sky.Resources(cloud='local'))
+        cluster, agent_job = jobs_remote.launch(
+            task, controller_cluster=CONTROLLER,
+            resources=sky.Resources(cloud='local'))
+        assert cluster == CONTROLLER
+
+        # The controller host shares this machine's state dir (local
+        # cloud), so the managed-job rows become visible here once the
+        # controller-side registration runs.
+        _wait(lambda: _newest_job() is not None, 60, 'job registered')
+        job_id = _newest_job()['job_id']
+        _wait(lambda: _task_row(job_id)['status'] ==
+              jobs_state.ManagedJobStatus.RUNNING, 90, 'RUNNING')
+
+        # The recovery loop must not live in this (client) process: no
+        # controller threads here, and the controller process is in a
+        # different session (parented to the detached agent daemon).
+        from skypilot_tpu.jobs import controller as controller_lib
+        assert not [t for t in controller_lib._ACTIVE_THREADS  # pylint: disable=protected-access
+                    if t.is_alive()]
+        my_sid = os.getsid(os.getpid())
+        controller_procs = []
+        for proc in psutil.process_iter(['pid', 'cmdline']):
+            try:
+                cmd = ' '.join(proc.info['cmdline'] or [])
+                if 'skypilot_tpu.jobs.remote' in cmd and '--dag' in cmd:
+                    controller_procs.append(proc)
+            except (psutil.NoSuchProcess, psutil.AccessDenied):
+                continue
+        assert controller_procs, 'controller process not found'
+        assert all(os.getsid(p.pid) != my_sid for p in controller_procs), \
+            'controller runs in the client session'
+
+        # Preempt the task cluster out from under the remote controller.
+        task_cluster = _task_row(job_id)['cluster_name']
+        record = global_user_state.get_cluster_from_name(task_cluster)
+        assert record is not None
+        local_instance.terminate_instances(
+            record['handle'].cluster_name_on_cloud)
+
+        _wait(lambda: _task_row(job_id)['recovery_count'] >= 1, 120,
+              'recovery')
+        _wait(lambda: _task_row(job_id)['status'] ==
+              jobs_state.ManagedJobStatus.RUNNING, 90,
+              'RUNNING after recovery')
+
+        # Client-side RPC surface against the controller cluster.
+        queue = jobs_remote.queue(controller_cluster=CONTROLLER)
+        assert any(j['job_id'] == job_id for j in queue)
+        cancelled = jobs_remote.cancel(job_ids=[job_id],
+                                       controller_cluster=CONTROLLER)
+        assert cancelled == [job_id]
+        _wait(lambda: jobs_state.get_status(job_id) ==
+              jobs_state.ManagedJobStatus.CANCELLED, 90, 'CANCELLED')
+
+        # The managed task cluster is gone; the agent job on the
+        # controller cluster reaches a terminal state.
+        _wait(lambda: global_user_state.get_cluster_from_name(
+            task_cluster) is None, 60, 'task cluster torn down')
+        _wait(lambda: sky.job_status(CONTROLLER, [agent_job])[agent_job]
+              in ('SUCCEEDED', 'FAILED'), 60, 'controller job finished')

@@ -201,6 +201,48 @@ class TestEndToEnd:
         assert not mine[0]['still_exists']
         assert mine[0]['duration_seconds'] >= 0
 
+    def test_agent_restarts_on_version_change(self):
+        """Reference attempt_skylet semantics: a launch onto an UP
+        cluster whose agent predates the shipped runtime restarts the
+        agent; a matching agent is left alone."""
+        t = _local_task('echo x')
+        job_id, _ = sky.launch(t, cluster_name='tvg', quiet_optimizer=True,
+                               detach_run=True)
+        _wait_job('tvg', job_id)
+        record = global_user_state.get_cluster_from_name('tvg')
+        root = record['handle'].head_agent_root
+        agent_dir = os.path.join(root, '.skytpu_agent')
+        pid_file = os.path.join(agent_dir, 'agent.pid')
+        with open(pid_file, encoding='utf-8') as f:
+            pid1 = int(f.read())
+
+        # Same version: relaunch keeps the daemon.
+        job2, _ = sky.launch(_local_task('echo y'), cluster_name='tvg',
+                             quiet_optimizer=True, detach_run=True)
+        _wait_job('tvg', job2)
+        with open(pid_file, encoding='utf-8') as f:
+            assert int(f.read()) == pid1
+
+        # Stale version: relaunch must replace the daemon.
+        with open(os.path.join(agent_dir, 'agent.version'), 'w',
+                  encoding='utf-8') as f:
+            f.write('0')
+        job3, _ = sky.launch(_local_task('echo z'), cluster_name='tvg',
+                             quiet_optimizer=True, detach_run=True)
+        _wait_job('tvg', job3)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with open(pid_file, encoding='utf-8') as f:
+                pid2 = int(f.read())
+            if pid2 != pid1:
+                break
+            time.sleep(0.25)
+        assert pid2 != pid1, 'stale agent was not restarted'
+        import psutil
+        assert not psutil.pid_exists(pid1) or \
+            psutil.Process(pid1).status() == psutil.STATUS_ZOMBIE
+        sky.down('tvg')
+
     def test_resources_mismatch_on_reuse(self):
         t = _local_task('echo x')
         job_id, _ = sky.launch(t, cluster_name='t11', quiet_optimizer=True,
