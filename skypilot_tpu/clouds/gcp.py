@@ -228,6 +228,10 @@ class GCP(cloud.Cloud):
                 'tpu_topology': args.get('topology'),
                 'num_tpu_hosts': spec.num_hosts,
                 'chips_per_host': spec.chips_per_host,
+                # 'queued' routes creation through the queuedResources
+                # API (DWS-style capacity; provision/gcp/instance.py).
+                'provision_mode': args.get('provision_mode', 'direct'),
+                'reservation': args.get('reservation'),
             })
         else:
             variables.update({

@@ -19,6 +19,7 @@ gcp_api.py:
 """
 from __future__ import annotations
 
+import os
 import re
 import time
 from typing import Any, Dict, List, Optional
@@ -118,6 +119,134 @@ def _fresh_node_names(cluster_name_on_cloud: str, taken: set,
     return out
 
 
+def _tpu_node_body(node_cfg: Dict[str, Any], cluster_name_on_cloud: str,
+                   config: common.ProvisionConfig) -> Dict[str, Any]:
+    body: Dict[str, Any] = {
+        'acceleratorType': node_cfg['tpu_type'],
+        'runtimeVersion': node_cfg['runtime_version'],
+        'networkConfig': {'enableExternalIps': True},
+        'labels': {
+            _LABEL_CLUSTER: cluster_name_on_cloud,
+            **{k.lower(): str(v).lower()
+               for k, v in config.tags.items()},
+        },
+        'metadata': {
+            'ssh-keys': config.authentication_config.get('ssh_keys', ''),
+            'startup-script':
+                config.authentication_config.get('startup_script', ''),
+        },
+        'schedulingConfig': {
+            'preemptible': bool(node_cfg.get('use_spot')),
+        },
+    }
+    if node_cfg.get('tpu_topology'):
+        # TPU API AcceleratorConfig enum names.
+        accel_type = {
+            'v2': 'V2', 'v3': 'V3', 'v4': 'V4',
+            'v5e': 'V5LITE_POD', 'v5p': 'V5P', 'v6e': 'V6E',
+        }[node_cfg['tpu_generation']]
+        body['acceleratorConfig'] = {
+            'type': accel_type,
+            'topology': node_cfg['tpu_topology'],
+        }
+        body.pop('acceleratorType')
+    if node_cfg.get('reservation'):
+        body['schedulingConfig']['reserved'] = True
+    return body
+
+
+def _queued_timeout_s() -> float:
+    try:
+        return float(os.environ.get('SKYTPU_QUEUED_TIMEOUT', 1800))
+    except ValueError:
+        return 1800.0
+
+
+def _qr_id(node_id: str) -> str:
+    return f'{node_id}-qr'
+
+
+def _create_via_queued_resource(project: str, zone: str, node_id: str,
+                                node_body: Dict[str, Any],
+                                node_cfg: Dict[str, Any]) -> None:
+    """Create one TPU slice through the queuedResources API and wait
+    for ACTIVE (reference analog: DWS/MIG machinery,
+    sky/provision/gcp/instance_utils.py:978 + mig_utils.py — the
+    real-world way to obtain v5p/v6e capacity).
+
+    State machine: ACCEPTED → PROVISIONING → ACTIVE; FAILED / SUSPENDED
+    (or timeout) raises ProvisionError so the retrying provisioner
+    blocklists the zone and fails over.  The request is deleted on any
+    non-ACTIVE outcome so a retry can reuse the id.
+    """
+    qr_id = _qr_id(node_id)
+    # Node bodies inside a QR must not carry schedulingConfig; the
+    # tier (spot/guaranteed) is expressed on the QR itself.
+    node_spec_body = dict(node_body)
+    node_spec_body.pop('schedulingConfig', None)
+    qr_body: Dict[str, Any] = {
+        'tpu': {
+            'nodeSpec': [{
+                'parent': gcp_api.tpu_parent(project, zone),
+                'nodeId': node_id,
+                'node': node_spec_body,
+            }],
+        },
+    }
+    reservation = node_cfg.get('reservation')
+    if node_cfg.get('use_spot'):
+        qr_body['spot'] = {}
+    elif reservation:
+        qr_body['guaranteed'] = {'reserved': True}
+        if isinstance(reservation, str):
+            # Target a SPECIFIC reservation by name.
+            qr_body['reservationName'] = (
+                reservation if '/' in reservation else
+                f'projects/{project}/locations/{zone}/reservations/'
+                f'{reservation}')
+    op = gcp_api.create_queued_resource(project, zone, qr_id, qr_body)
+    gcp_api.wait_tpu_operation(op)
+    deadline = time.time() + _queued_timeout_s()
+    interval = 5.0
+    missing_polls = 0
+    while True:
+        qr = gcp_api.get_queued_resource(project, zone, qr_id)
+        if qr is None:
+            # Created but not visible: tolerate brief read-after-write
+            # lag, then fail over rather than burn the whole timeout.
+            missing_polls += 1
+            if missing_polls >= 3:
+                raise exceptions.ProvisionError(
+                    f'Queued resource {qr_id} disappeared after '
+                    'creation; failing over.', no_failover=False)
+            time.sleep(interval)
+            continue
+        missing_polls = 0
+        state = (qr.get('state') or {}).get('state', 'UNKNOWN')
+        if state == 'ACTIVE':
+            return
+        if state in ('FAILED', 'SUSPENDED', 'SUSPENDING'):
+            detail = (qr.get('state') or {}).get('stateInitiator', '')
+            try:
+                gcp_api.delete_queued_resource(project, zone, qr_id)
+            except gcp_api.GcpApiError:
+                pass
+            raise exceptions.ProvisionError(
+                f'Queued resource {qr_id} entered {state} {detail}; '
+                f'failing over.', no_failover=False)
+        if time.time() > deadline:
+            try:
+                gcp_api.delete_queued_resource(project, zone, qr_id)
+            except gcp_api.GcpApiError:
+                pass
+            raise exceptions.ProvisionError(
+                f'Queued resource {qr_id} still {state} after '
+                f'{_queued_timeout_s():.0f}s; failing over.',
+                no_failover=False)
+        time.sleep(interval)
+        interval = min(interval * 1.3, 30.0)
+
+
 def _run_tpu_slices(project: str, region: str, zone: str,
                     cluster_name_on_cloud: str,
                     config: common.ProvisionConfig) -> common.ProvisionRecord:
@@ -138,43 +267,25 @@ def _run_tpu_slices(project: str, region: str, zone: str,
     to_create = config.count - len(ready)
     created: List[str] = []
     taken = {n['name'].rsplit('/', 1)[-1] for n in existing}
+    queued = node_cfg.get('provision_mode') == 'queued'
+    if not queued and isinstance(node_cfg.get('reservation'), str):
+        logger.warning(
+            'A NAMED reservation can only be targeted through queued '
+            'provisioning; direct mode requests any reserved capacity. '
+            "Set accelerator_args: {provision_mode: queued} to target "
+            f'{node_cfg["reservation"]!r}.')
     for node_id in _fresh_node_names(cluster_name_on_cloud, taken,
                                      max(to_create, 0)):
-        body: Dict[str, Any] = {
-            'acceleratorType': node_cfg['tpu_type'],
-            'runtimeVersion': node_cfg['runtime_version'],
-            'networkConfig': {'enableExternalIps': True},
-            'labels': {
-                _LABEL_CLUSTER: cluster_name_on_cloud,
-                **{k.lower(): str(v).lower()
-                   for k, v in config.tags.items()},
-            },
-            'metadata': {
-                'ssh-keys': config.authentication_config.get('ssh_keys', ''),
-                'startup-script':
-                    config.authentication_config.get('startup_script', ''),
-            },
-            'schedulingConfig': {
-                'preemptible': bool(node_cfg.get('use_spot')),
-            },
-        }
-        if node_cfg.get('tpu_topology'):
-            # TPU API AcceleratorConfig enum names.
-            accel_type = {
-                'v2': 'V2', 'v3': 'V3', 'v4': 'V4',
-                'v5e': 'V5LITE_POD', 'v5p': 'V5P', 'v6e': 'V6E',
-            }[node_cfg['tpu_generation']]
-            body['acceleratorConfig'] = {
-                'type': accel_type,
-                'topology': node_cfg['tpu_topology'],
-            }
-            body.pop('acceleratorType')
-        if node_cfg.get('reservation'):
-            body['schedulingConfig']['reserved'] = True
+        body = _tpu_node_body(node_cfg, cluster_name_on_cloud, config)
         logger.debug(f'Creating TPU node {node_id} '
-                     f'({node_cfg["tpu_type"]}, zone {zone})')
-        op = gcp_api.create_tpu_node(project, zone, node_id, body)
-        gcp_api.wait_tpu_operation(op)
+                     f'({node_cfg["tpu_type"]}, zone {zone}, '
+                     f'{"queued" if queued else "direct"})')
+        if queued:
+            _create_via_queued_resource(project, zone, node_id, body,
+                                        node_cfg)
+        else:
+            op = gcp_api.create_tpu_node(project, zone, node_id, body)
+            gcp_api.wait_tpu_operation(op)
         created.append(node_id)
 
     all_nodes = _list_cluster_tpu_nodes(project, zone, cluster_name_on_cloud)
@@ -324,10 +435,23 @@ def terminate_instances(cluster_name_on_cloud: str,
         nodes = _list_cluster_tpu_nodes(project, zone, cluster_name_on_cloud)
         names = sorted(n['name'].rsplit('/', 1)[-1] for n in nodes)
         head = names[0] if names else None
+        queued = (provider_config or {}).get('provision_mode') == 'queued'
         ops = []
         for node_id in names:
             if worker_only and node_id == head:
                 continue
+            if queued:
+                # Nodes obtained through queuedResources must be torn
+                # down via their request (force-delete removes the node
+                # too); 404 means this particular node predates queued
+                # mode and is deleted directly.
+                try:
+                    ops.append(gcp_api.delete_queued_resource(
+                        project, zone, _qr_id(node_id)))
+                    continue
+                except gcp_api.GcpApiError as e:
+                    if e.status_code != 404:
+                        raise
             ops.append(gcp_api.delete_tpu_node(project, zone, node_id))
         for op in ops:
             gcp_api.wait_tpu_operation(op)

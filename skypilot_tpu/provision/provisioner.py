@@ -61,6 +61,9 @@ def _provider_config(resources: resources_lib.Resources,
         'tpu_vm': deploy_vars.get('tpu_vm', False),
         'ports': resources.ports,
     }
+    if deploy_vars.get('provision_mode'):
+        # Teardown must know whether nodes came via queuedResources.
+        cfg['provision_mode'] = deploy_vars['provision_mode']
     if resources.cloud.canonical_name() == 'gcp':
         cfg['project_id'] = config_lib.get_nested(('gcp', 'project_id'),
                                                   None)

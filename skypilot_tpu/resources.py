@@ -191,7 +191,8 @@ class Resources:
         if self._tpu_slice is None:
             if self._accelerator_args:
                 tpu_only_keys = {'runtime_version', 'tpu_name', 'tpu_vm',
-                                 'topology'}
+                                 'topology', 'provision_mode',
+                                 'reservation'}
                 bad = set(self._accelerator_args) & tpu_only_keys
                 if bad:
                     raise exceptions.ResourcesValidationError(
@@ -204,6 +205,14 @@ class Resources:
                 'Legacy TPU Node architecture is not supported; only TPU VM '
                 '(the reference deprecates TPU nodes as well, '
                 'sky/clouds/gcp.py:193-204).')
+        mode = args.get('provision_mode', 'direct')
+        if mode not in ('direct', 'queued'):
+            raise exceptions.ResourcesValidationError(
+                f"provision_mode must be 'direct' or 'queued', got "
+                f'{mode!r}.')
+        if args.get('reservation') and self._use_spot:
+            raise exceptions.ResourcesValidationError(
+                'use_spot and reservation are mutually exclusive.')
         args.setdefault('runtime_version',
                         self._tpu_slice.default_runtime_version())
         self._accelerator_args = args

@@ -33,6 +33,10 @@ _RESOURCES_PROPERTIES: Dict[str, Any] = {
             'tpu_name': {'type': ['string', 'null']},
             'tpu_vm': {'type': 'boolean'},
             'topology': {'type': ['string', 'null']},
+            # 'queued' obtains capacity via the queuedResources API
+            # (DWS-style); see provision/gcp/instance.py.
+            'provision_mode': {'enum': ['direct', 'queued']},
+            'reservation': {'type': ['boolean', 'string', 'null']},
         },
         'additionalProperties': False,
     },
