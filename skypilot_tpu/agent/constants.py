@@ -31,6 +31,9 @@ ENV_COORDINATOR_ADDR = 'SKYTPU_COORDINATOR_ADDR'   # host0_ip:port
 ENV_PROCESS_ID = 'SKYTPU_PROCESS_ID'               # == host rank
 ENV_NUM_PROCESSES = 'SKYTPU_NUM_PROCESSES'         # == total hosts
 COORDINATOR_PORT = 8476
+# Separate port for the MEGASCALE (multislice DCN) coordinator so it
+# never collides with the jax.distributed coordinator on the same host.
+MEGASCALE_COORDINATOR_PORT = 8477
 
 # Multislice (DCN) contract — one slice per logical node.
 ENV_MEGASCALE_COORDINATOR = 'MEGASCALE_COORDINATOR_ADDRESS'

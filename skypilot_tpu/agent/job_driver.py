@@ -79,7 +79,8 @@ def _build_rank_env(spec: Dict[str, Any], rank: int) -> Dict[str, str]:
         # Multislice: each logical node is one ICI domain; DCN between
         # slices via the MEGASCALE contract (SURVEY.md §5).
         env.update({
-            constants.ENV_MEGASCALE_COORDINATOR: f'{ips[0]}:8080',
+            constants.ENV_MEGASCALE_COORDINATOR:
+                f'{ips[0]}:{constants.MEGASCALE_COORDINATOR_PORT}',
             constants.ENV_MEGASCALE_NUM_SLICES: str(num_slices),
             constants.ENV_MEGASCALE_SLICE_ID: str(rank // hosts_per_node),
         })

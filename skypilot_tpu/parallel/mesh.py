@@ -25,6 +25,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
 # Canonical axis order: fastest-varying (last) = most-communicating, so
 # neighboring devices (ICI) carry tensor/context traffic.
 AXES = ('data', 'fsdp', 'expert', 'pipe', 'context', 'tensor')
@@ -61,23 +65,104 @@ class MeshConfig:
         return sizes
 
 
-def make_mesh(config: Optional[MeshConfig] = None,
-              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a Mesh over `devices` (default: all) with the AXES order."""
-    if devices is None:
-        devices = jax.devices()
-    config = config or MeshConfig()
-    sizes = config.resolve(len(devices))
-    shape = tuple(sizes[a] for a in AXES)
+def _detect_num_slices() -> int:
+    """Multislice degree from the gang driver's MEGASCALE contract."""
+    import os
+
+    from skypilot_tpu.agent import constants as agent_constants
+    try:
+        return int(os.environ.get(
+            agent_constants.ENV_MEGASCALE_NUM_SLICES, '1') or 1)
+    except ValueError:
+        return 1
+
+
+def _group_by_slice(devices: Sequence[jax.Device],
+                    num_slices: int) -> List[List[jax.Device]]:
+    """Partition devices into ICI domains (slices).
+
+    Real multislice devices carry `slice_index`; virtual/CPU meshes
+    (tests, dryrun) are split into contiguous equal chunks.
+    """
+    if all(getattr(d, 'slice_index', None) is not None for d in devices):
+        by_idx: Dict[int, List[jax.Device]] = {}
+        for d in devices:
+            by_idx.setdefault(d.slice_index, []).append(d)
+        groups = [by_idx[k] for k in sorted(by_idx)]
+        if len(groups) != num_slices:
+            raise ValueError(
+                f'Devices span {len(groups)} slices but num_slices='
+                f'{num_slices}.')
+        if len({len(g) for g in groups}) > 1:
+            raise ValueError(
+                'Slices must be equal-sized for a rectangular mesh; '
+                f'got {[len(g) for g in groups]} devices per slice.')
+        return groups
+    if len(devices) % num_slices:
+        raise ValueError(
+            f'{len(devices)} devices not divisible into {num_slices} '
+            'slices.')
+    per = len(devices) // num_slices
+    devices = list(devices)
+    return [devices[i * per:(i + 1) * per] for i in range(num_slices)]
+
+
+def _sub_device_array(shape: Tuple[int, ...],
+                      devices: Sequence[jax.Device]) -> np.ndarray:
     try:
         # Topology-aware placement when available (real TPU slices): lets
         # jax lay contiguous mesh dims onto ICI neighbors.
         from jax.experimental import mesh_utils
-        device_array = mesh_utils.create_device_mesh(
-            shape, devices=list(devices))
+        return mesh_utils.create_device_mesh(shape, devices=list(devices))
     except (ValueError, ImportError, AssertionError):
-        device_array = np.array(list(devices)).reshape(shape)
-    return Mesh(device_array, AXES)
+        return np.array(list(devices)).reshape(shape)
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None,
+              num_slices: Optional[int] = None) -> Mesh:
+    """Build a Mesh over `devices` (default: all) with the AXES order.
+
+    Multislice (num_slices > 1, or auto-detected from the gang driver's
+    MEGASCALE env): the leading `data` axis is laid out slice-major so
+    ONLY data-parallel gradient reductions cross the DCN between
+    slices, while fsdp/expert/pipe/context/tensor collectives stay on
+    ICI inside each slice — the scaling-book placement rule.
+    """
+    if devices is None:
+        devices = jax.devices()
+    config = config or MeshConfig()
+    detected = False
+    if num_slices is None:
+        num_slices = _detect_num_slices()
+        detected = True
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+    if num_slices <= 1:
+        return Mesh(_sub_device_array(shape, devices), AXES)
+
+    if sizes['data'] % num_slices:
+        msg = (
+            f"data axis ({sizes['data']}) must be a multiple of "
+            f'num_slices ({num_slices}): the DCN between slices can '
+            'only carry the data-parallel axis efficiently. Set '
+            'MeshConfig.data to a multiple of the slice count (e.g. '
+            'data=-1 with the other axes sized per-slice).')
+        if detected:
+            # Auto-detected multislice must not break meshes that ran
+            # before (e.g. fsdp spanning DCN — slower, not wrong).
+            logger.warning(
+                f'{msg} Falling back to a slice-oblivious layout; '
+                'non-data collectives will cross the DCN.')
+            return Mesh(_sub_device_array(shape, devices), AXES)
+        raise ValueError(msg)
+    groups = _group_by_slice(devices, num_slices)
+    local_sizes = dict(sizes)
+    local_sizes['data'] = sizes['data'] // num_slices
+    local_shape = tuple(local_sizes[a] for a in AXES)
+    subarrays = [_sub_device_array(local_shape, g) for g in groups]
+    # AXES[0] is 'data': concatenating along it stacks slices slice-major.
+    return Mesh(np.concatenate(subarrays, axis=0), AXES)
 
 
 def batch_axes() -> Tuple[str, ...]:

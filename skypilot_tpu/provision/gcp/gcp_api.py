@@ -215,6 +215,20 @@ def delete_queued_resource(project: str, zone: str,
     return session().request('DELETE', url, params={'force': 'true'})
 
 
+def list_queued_resources(project: str,
+                          zone: str) -> List[Dict[str, Any]]:
+    url = f'{TPU_API}/{tpu_parent(project, zone)}/queuedResources'
+    out: List[Dict[str, Any]] = []
+    params: Optional[Dict[str, Any]] = None
+    while True:
+        resp = session().request('GET', url, params=params)
+        out.extend(resp.get('queuedResources', []))
+        token = resp.get('nextPageToken')
+        if not token:
+            return out
+        params = {'pageToken': token}
+
+
 # ---------------------------------------------------------------------------
 # Compute API — controller VMs + firewall
 # ---------------------------------------------------------------------------

@@ -166,33 +166,34 @@ def _qr_id(node_id: str) -> str:
     return f'{node_id}-qr'
 
 
-def _create_via_queued_resource(project: str, zone: str, node_id: str,
-                                node_body: Dict[str, Any],
+def _create_via_queued_resource(project: str, zone: str,
+                                node_ids: List[str],
+                                node_bodies: List[Dict[str, Any]],
                                 node_cfg: Dict[str, Any]) -> None:
-    """Create one TPU slice through the queuedResources API and wait
-    for ACTIVE (reference analog: DWS/MIG machinery,
+    """Create ALL requested TPU slices through ONE queuedResource and
+    wait for ACTIVE (reference analog: DWS/MIG machinery,
     sky/provision/gcp/instance_utils.py:978 + mig_utils.py — the
     real-world way to obtain v5p/v6e capacity).
 
-    State machine: ACCEPTED → PROVISIONING → ACTIVE; FAILED / SUSPENDED
-    (or timeout) raises ProvisionError so the retrying provisioner
-    blocklists the zone and fails over.  The request is deleted on any
-    non-ACTIVE outcome so a retry can reuse the id.
+    A single multi-nodeSpec request gives gang admission at the
+    capacity level: all slices are allocated together or the request
+    fails as a unit, and there is one wait instead of N serialized
+    timeouts.  State machine: ACCEPTED → PROVISIONING → ACTIVE;
+    FAILED / SUSPENDED, timeout, a vanished request, or ANY abnormal
+    exit (including interruption) deletes the request so nothing leaks
+    and a retry can reuse the id.
     """
-    qr_id = _qr_id(node_id)
-    # Node bodies inside a QR must not carry schedulingConfig; the
-    # tier (spot/guaranteed) is expressed on the QR itself.
-    node_spec_body = dict(node_body)
-    node_spec_body.pop('schedulingConfig', None)
-    qr_body: Dict[str, Any] = {
-        'tpu': {
-            'nodeSpec': [{
-                'parent': gcp_api.tpu_parent(project, zone),
-                'nodeId': node_id,
-                'node': node_spec_body,
-            }],
-        },
-    }
+    qr_id = _qr_id(node_ids[0])
+    parent = gcp_api.tpu_parent(project, zone)
+    node_specs = []
+    for node_id, node_body in zip(node_ids, node_bodies):
+        # Node bodies inside a QR must not carry schedulingConfig; the
+        # tier (spot/guaranteed) is expressed on the QR itself.
+        spec_body = dict(node_body)
+        spec_body.pop('schedulingConfig', None)
+        node_specs.append({'parent': parent, 'nodeId': node_id,
+                           'node': spec_body})
+    qr_body: Dict[str, Any] = {'tpu': {'nodeSpec': node_specs}}
     reservation = node_cfg.get('reservation')
     if node_cfg.get('use_spot'):
         qr_body['spot'] = {}
@@ -209,42 +210,48 @@ def _create_via_queued_resource(project: str, zone: str, node_id: str,
     deadline = time.time() + _queued_timeout_s()
     interval = 5.0
     missing_polls = 0
-    while True:
-        qr = gcp_api.get_queued_resource(project, zone, qr_id)
-        if qr is None:
-            # Created but not visible: tolerate brief read-after-write
-            # lag, then fail over rather than burn the whole timeout.
-            missing_polls += 1
-            if missing_polls >= 3:
+    active = False
+    try:
+        while True:
+            qr = gcp_api.get_queued_resource(project, zone, qr_id)
+            if qr is None:
+                # Created but not visible: tolerate brief
+                # read-after-write lag, then fail over rather than burn
+                # the whole timeout.
+                missing_polls += 1
+                if missing_polls >= 3:
+                    raise exceptions.ProvisionError(
+                        f'Queued resource {qr_id} disappeared after '
+                        'creation; failing over.', no_failover=False)
+                time.sleep(interval)
+                continue
+            missing_polls = 0
+            state = (qr.get('state') or {}).get('state', 'UNKNOWN')
+            if state == 'ACTIVE':
+                active = True
+                return
+            if state in ('FAILED', 'SUSPENDED', 'SUSPENDING'):
+                detail = (qr.get('state') or {}).get('stateInitiator',
+                                                     '')
                 raise exceptions.ProvisionError(
-                    f'Queued resource {qr_id} disappeared after '
-                    'creation; failing over.', no_failover=False)
+                    f'Queued resource {qr_id} entered {state} {detail};'
+                    f' failing over.', no_failover=False)
+            if time.time() > deadline:
+                raise exceptions.ProvisionError(
+                    f'Queued resource {qr_id} still {state} after '
+                    f'{_queued_timeout_s():.0f}s; failing over.',
+                    no_failover=False)
             time.sleep(interval)
-            continue
-        missing_polls = 0
-        state = (qr.get('state') or {}).get('state', 'UNKNOWN')
-        if state == 'ACTIVE':
-            return
-        if state in ('FAILED', 'SUSPENDED', 'SUSPENDING'):
-            detail = (qr.get('state') or {}).get('stateInitiator', '')
+            interval = min(interval * 1.3, 30.0)
+    finally:
+        if not active:
+            # Covers FAILED/timeout AND interruption (Ctrl-C, kill):
+            # a pending request left behind would later turn ACTIVE
+            # and bill capacity no cluster record tracks.
             try:
                 gcp_api.delete_queued_resource(project, zone, qr_id)
             except gcp_api.GcpApiError:
                 pass
-            raise exceptions.ProvisionError(
-                f'Queued resource {qr_id} entered {state} {detail}; '
-                f'failing over.', no_failover=False)
-        if time.time() > deadline:
-            try:
-                gcp_api.delete_queued_resource(project, zone, qr_id)
-            except gcp_api.GcpApiError:
-                pass
-            raise exceptions.ProvisionError(
-                f'Queued resource {qr_id} still {state} after '
-                f'{_queued_timeout_s():.0f}s; failing over.',
-                no_failover=False)
-        time.sleep(interval)
-        interval = min(interval * 1.3, 30.0)
 
 
 def _run_tpu_slices(project: str, region: str, zone: str,
@@ -274,19 +281,28 @@ def _run_tpu_slices(project: str, region: str, zone: str,
             'provisioning; direct mode requests any reserved capacity. '
             "Set accelerator_args: {provision_mode: queued} to target "
             f'{node_cfg["reservation"]!r}.')
-    for node_id in _fresh_node_names(cluster_name_on_cloud, taken,
-                                     max(to_create, 0)):
-        body = _tpu_node_body(node_cfg, cluster_name_on_cloud, config)
-        logger.debug(f'Creating TPU node {node_id} '
-                     f'({node_cfg["tpu_type"]}, zone {zone}, '
-                     f'{"queued" if queued else "direct"})')
-        if queued:
-            _create_via_queued_resource(project, zone, node_id, body,
-                                        node_cfg)
-        else:
+    fresh = _fresh_node_names(cluster_name_on_cloud, taken,
+                              max(to_create, 0))
+    if queued and fresh:
+        # One multi-nodeSpec request: gang admission for the whole
+        # cluster's slices, one ACTIVE wait.
+        bodies = [_tpu_node_body(node_cfg, cluster_name_on_cloud,
+                                 config) for _ in fresh]
+        logger.debug(f'Creating {len(fresh)} TPU node(s) via one '
+                     f'queuedResource ({node_cfg["tpu_type"]}, zone '
+                     f'{zone})')
+        _create_via_queued_resource(project, zone, fresh, bodies,
+                                    node_cfg)
+        created.extend(fresh)
+    else:
+        for node_id in fresh:
+            body = _tpu_node_body(node_cfg, cluster_name_on_cloud,
+                                  config)
+            logger.debug(f'Creating TPU node {node_id} '
+                         f'({node_cfg["tpu_type"]}, zone {zone})')
             op = gcp_api.create_tpu_node(project, zone, node_id, body)
             gcp_api.wait_tpu_operation(op)
-        created.append(node_id)
+            created.append(node_id)
 
     all_nodes = _list_cluster_tpu_nodes(project, zone, cluster_name_on_cloud)
     names = sorted(n['name'].rsplit('/', 1)[-1] for n in all_nodes
@@ -437,21 +453,29 @@ def terminate_instances(cluster_name_on_cloud: str,
         head = names[0] if names else None
         queued = (provider_config or {}).get('provision_mode') == 'queued'
         ops = []
+        covered: set = set()
+        if queued and not worker_only:
+            # Sweep the cluster's queued requests FIRST: this also
+            # reaps pending (no-node-yet) requests that would otherwise
+            # turn ACTIVE later and bill untracked capacity.  Their
+            # force-delete removes any materialized nodes too.
+            prefix = f'{cluster_name_on_cloud}-'
+            for qr in gcp_api.list_queued_resources(project, zone):
+                qr_name = qr.get('name', '').rsplit('/', 1)[-1]
+                if not (qr_name.startswith(prefix) and
+                        qr_name.endswith('-qr')):
+                    continue
+                for spec in ((qr.get('tpu') or {}).get('nodeSpec')
+                             or []):
+                    if spec.get('nodeId'):
+                        covered.add(spec['nodeId'])
+                ops.append(gcp_api.delete_queued_resource(
+                    project, zone, qr_name))
         for node_id in names:
             if worker_only and node_id == head:
                 continue
-            if queued:
-                # Nodes obtained through queuedResources must be torn
-                # down via their request (force-delete removes the node
-                # too); 404 means this particular node predates queued
-                # mode and is deleted directly.
-                try:
-                    ops.append(gcp_api.delete_queued_resource(
-                        project, zone, _qr_id(node_id)))
-                    continue
-                except gcp_api.GcpApiError as e:
-                    if e.status_code != 404:
-                        raise
+            if node_id in covered:
+                continue  # dies with its queued request
             ops.append(gcp_api.delete_tpu_node(project, zone, node_id))
         for op in ops:
             gcp_api.wait_tpu_operation(op)

@@ -74,6 +74,27 @@ class TestEndToEnd:
         assert ':8476' in log
         sky.down('t2')
 
+    def test_multislice_megascale_env(self):
+        """num_nodes=2 TPU slices = a multislice job: each rank gets the
+        MEGASCALE DCN contract (coordinator on the dedicated port, slice
+        ids by logical node) on top of the rank/coordinator env."""
+        t = _local_task(
+            'echo "r=$SKYTPU_NODE_RANK slice=$MEGASCALE_SLICE_ID '
+            'n=$MEGASCALE_NUM_SLICES coord=$MEGASCALE_COORDINATOR_ADDRESS"',
+            num_nodes=2, accelerators='tpu-v5e-8')
+        job_id, _ = sky.launch(t, cluster_name='tms', quiet_optimizer=True,
+                               detach_run=True)
+        assert _wait_job('tms', job_id) == 'SUCCEEDED'
+        log = _read_run_log('tms', job_id)
+        # tpu-v5e-8 = 2 hosts/slice: ranks 0-1 are slice 0, 2-3 slice 1.
+        assert 'r=0 slice=0 n=2' in log
+        assert 'r=1 slice=0 n=2' in log
+        assert 'r=2 slice=1 n=2' in log
+        assert 'r=3 slice=1 n=2' in log
+        assert ':8477' in log
+        assert ':8080' not in log
+        sky.down('tms')
+
     def test_gang_failure_cancels_peers(self):
         """Reference get_or_fail semantics (cloud_vm_ray_backend.py:313):
         one rank failing kills the others."""

@@ -88,13 +88,19 @@ class FakeTpuApi:
             self.nodes.pop(spec['nodeId'], None)
         return {'done': True}
 
+    def list_queued_resources(self, project, zone):
+        return [{'name': f'projects/{project}/locations/{zone}/'
+                         f'queuedResources/{qr_id}',
+                 **qr['body']} for qr_id, qr in self.qrs.items()]
+
 
 @pytest.fixture()
 def fake_api(monkeypatch):
     api = FakeTpuApi()
     for fn in ('list_tpu_nodes', 'create_tpu_node', 'delete_tpu_node',
                'wait_tpu_operation', 'create_queued_resource',
-               'get_queued_resource', 'delete_queued_resource'):
+               'get_queued_resource', 'delete_queued_resource',
+               'list_queued_resources'):
         monkeypatch.setattr(gcp_api, fn, getattr(api, fn))
     monkeypatch.setattr(gcp_instance.time, 'sleep', lambda s: None)
     monkeypatch.setenv('SKYTPU_QUEUED_TIMEOUT', '9999')
@@ -179,6 +185,30 @@ class TestQueuedResources:
         assert not fake_api.qrs
         assert 'c7-0' not in fake_api.nodes
         assert 'c8-0' not in fake_api.nodes
+
+    def test_gang_allocation_single_qr(self, fake_api):
+        """count=N goes through ONE multi-nodeSpec request: atomic
+        capacity admission for the whole multislice cluster."""
+        rec = gcp_instance.run_instances(
+            'us-central2', 'cg', _config(count=3,
+                                         provision_mode='queued'))
+        assert sorted(rec.created_instance_ids) == \
+            ['cg-0', 'cg-1', 'cg-2']
+        assert fake_api.qr_creates == ['cg-0-qr']
+        specs = fake_api.qrs['cg-0-qr']['body']['tpu']['nodeSpec']
+        assert [s['nodeId'] for s in specs] == ['cg-0', 'cg-1', 'cg-2']
+
+    def test_teardown_reaps_pending_qr(self, fake_api):
+        """A queued request that never materialized nodes (interrupted
+        mid-wait) must still be deleted by terminate, or it would turn
+        ACTIVE later and bill untracked capacity."""
+        fake_api.create_queued_resource(
+            'proj', 'us-central2-b', 'cp-0-qr',
+            {'tpu': {'nodeSpec': [{'nodeId': 'cp-0', 'node': {}}]}})
+        gcp_instance.terminate_instances(
+            'cp', {'project_id': 'proj', 'zone': 'us-central2-b',
+                   'tpu_vm': True, 'provision_mode': 'queued'})
+        assert not fake_api.qrs
 
     def test_named_reservation_on_qr(self, fake_api):
         gcp_instance.run_instances(
