@@ -190,6 +190,15 @@ def _ring_vjp_bwd(axis_name, causal, scale, residuals, g):
 ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
+def _in_manual_region(axis_name: str) -> bool:
+    """True when already inside a shard_map manual over `axis_name`."""
+    try:
+        jax.lax.axis_size(axis_name)
+        return True
+    except (NameError, KeyError, ValueError):
+        return False
+
+
 def context_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                *, causal: bool = True,
                                impl: str = 'ring',
@@ -205,10 +214,25 @@ def context_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     from jax.sharding import PartitionSpec as P
 
     from skypilot_tpu.parallel import sharding as sharding_lib
+    fn = ring_attention if impl == 'ring' else ulysses_attention
+    if _in_manual_region(axis_name):
+        # Already inside a shard_map manual over the context axis (e.g.
+        # a pipeline stage manual over {'pipe','context'}): q/k/v are
+        # the local sequence shards — no nested shard_map.  Off-TPU the
+        # XLA CPU backend crashes on low-precision collectives nested
+        # in partial-manual scans ("Invalid binary instruction opcode
+        # copy" — same bug parallel/pipeline.py works around), so the
+        # ring runs in f32 there; on TPU it stays in the model dtype.
+        if (jax.default_backend() != 'tpu'
+                and q.dtype in (jnp.bfloat16, jnp.float16)):
+            out = fn(q.astype(jnp.float32), k.astype(jnp.float32),
+                     v.astype(jnp.float32), axis_name=axis_name,
+                     causal=causal)
+            return out.astype(q.dtype)
+        return fn(q, k, v, axis_name=axis_name, causal=causal)
     mesh = sharding_lib.ambient_physical_mesh()
     if mesh is None or mesh.shape.get(axis_name, 1) == 1:
         return fa.flash_attention(q, k, v, None, causal)
-    fn = ring_attention if impl == 'ring' else ulysses_attention
     spec = P(None, None, axis_name, None)
     wrapped = jax.shard_map(
         functools.partial(fn, axis_name=axis_name, causal=causal),

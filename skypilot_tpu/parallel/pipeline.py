@@ -17,7 +17,15 @@ SURVEY.md §2.11); here it is a first-class mesh axis, TPU-style:
   - the whole schedule is differentiable (scan + ppermute + where), so
     the backward pipeline is the automatic transpose — activations flow
     back through the inverse permutes with no hand-written adjoint;
-  - bubble fraction is (P-1)/(M+P-1); choose M >= 2P to keep it small.
+  - bubble fraction is (P-1)/(M+P-1); choose M >= 2P to keep it small —
+    or use `circular_repeats=R` for the interleaved schedule (each
+    stage holds R non-contiguous layer groups, wraparound ppermute,
+    stage-0 holding buffer), which shrinks the bubble to
+    (P-1)/(R*M+P-1) at R x the ppermute hops;
+  - composes with context parallelism: pass
+    extra_manual_axes={'context'} and a sequence-sharded mb_spec, and
+    run ring attention directly inside the stage (the trainer does
+    this; ops/ring_attention.py detects the manual region).
 
 This module is schedule-generic: `gpipe` takes any stage function, so it
 also pipelines non-transformer stage stacks.
@@ -40,8 +48,12 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
           microbatches: jax.Array,
           *,
           mesh: Mesh,
-          axis_name: str = 'pipe') -> jax.Array:
-    """Run `stage_fn` as a GPipe pipeline over `axis_name`.
+          axis_name: str = 'pipe',
+          extra_manual_axes: frozenset = frozenset(),
+          mb_spec: P = P(),
+          circular_repeats: int = 1) -> jax.Array:
+    """Run `stage_fn` as a (optionally circular) pipeline over
+    `axis_name`.
 
     Args:
       stage_fn: (local_stage_params, x) -> y applied by each stage. Its
@@ -54,9 +66,24 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
         `axis_name` (other mesh axes may shard the inner dims; they stay
         automatic).
       mesh: the device mesh containing `axis_name`.
+      extra_manual_axes: additional mesh axes the stage function
+        handles MANUALLY (e.g. {'context'} when stages run ring
+        attention on local sequence shards); the microbatch buffer is
+        then sharded per `mb_spec` instead of replicated.
+      mb_spec: PartitionSpec of the [M, ...] microbatch buffer over the
+        extra manual axes (never mentions `axis_name`).
+      circular_repeats: R > 1 runs the interleaved ("circular")
+        schedule: each stage owns R non-contiguous layer groups (stage
+        p holds groups p, p+P, ..., p+(R-1)P) and every microbatch
+        loops the ring R times, with a wraparound ppermute and a
+        stage-0 holding buffer for in-flight wraps.  Bubble fraction
+        drops from (P-1)/(M+P-1) to (P-1)/(R*M+P-1) — the
+        interleaved-1F1B bubble — at the cost of R x more ppermute
+        hops per token.
 
     Returns:
-      [M, ...] outputs of the final stage, replicated over `axis_name`.
+      [M, ...] outputs of the final stage, replicated over `axis_name`
+      (sharded per `mb_spec` over the extra manual axes).
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
@@ -69,6 +96,27 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
         raise ValueError(
             f'need >= {n_stages} microbatches to fill a {n_stages}-stage '
             f'pipeline, got {num_micro}.')
+    repeats = int(circular_repeats)
+    if repeats < 1:
+        raise ValueError(
+            f'circular_repeats must be >= 1, got {circular_repeats}.')
+    if repeats > 1:
+        # Reorder the stacked layers so contiguous sharding over the
+        # leading dim gives stage p the groups (p, P+p, ..., (R-1)P+p),
+        # each of c = L/(P*R) layers, ordered by repeat: [L, ...] ->
+        # [R, P, c, ...] -> transpose -> [P, R, c, ...] -> [P*R*c, ...]
+        def _circularize(leaf):
+            total = leaf.shape[0]
+            if total % (n_stages * repeats):
+                raise ValueError(
+                    f'{total} stacked layers not divisible by stages*'
+                    f'repeats = {n_stages}*{repeats}.')
+            c = total // (n_stages * repeats)
+            re = leaf.reshape(repeats, n_stages, c, *leaf.shape[1:])
+            return jnp.moveaxis(re, 0, 1).reshape(total,
+                                                  *leaf.shape[1:])
+
+        stage_params = jax.tree.map(_circularize, stage_params)
 
     # XLA's CPU backend crashes on low-precision psum inside a
     # partially-manual shard_map (including the psum that autodiff
@@ -94,31 +142,71 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
                              or frozenset()):
             mbs = jax.lax.pcast(mbs, (axis_name,), to='varying')
         my = jax.lax.axis_index(axis_name)
-        # Shift activations to the next stage (no wraparound: the last
-        # stage's output leaves the pipeline through the output buffer).
-        perm = [(i, i + 1) for i in range(n_stages - 1)]
+        last = n_stages - 1
+        if repeats == 1:
+            # Shift activations to the next stage (no wraparound: the
+            # last stage's output leaves through the output buffer).
+            perm = [(i, i + 1) for i in range(last)]
+        else:
+            # Circular: the last stage wraps to stage 0 for the next
+            # repeat; the local [R*c, ...] params regroup to [R, c, ...]
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            local_params = jax.tree.map(
+                lambda a: a.reshape(repeats, a.shape[0] // repeats,
+                                    *a.shape[1:]), local_params)
 
         def tick(carry, t):
-            state, out = carry
+            state, circ, out = carry
+            if repeats > 1:
+                # A wrap (stage last's output from tick t-1) lands on
+                # stage 0 each tick t >= P; hold it in the circular
+                # buffer until its turn (consumed M ticks after its
+                # repeat finished; safe because M >= P).
+                arr_idx = jnp.mod(t - n_stages, num_micro)
+                circ = jax.lax.cond(
+                    (my == 0) & (t >= n_stages),
+                    lambda c: jax.lax.dynamic_update_index_in_dim(
+                        c, state, arr_idx, 0),
+                    lambda c: c, circ)
             inject = jax.lax.dynamic_index_in_dim(
                 mbs, jnp.clip(t, 0, num_micro - 1), axis=0,
                 keepdims=False)
-            x_in = jnp.where(my == 0, inject, state)
-            y = stage_fn(local_params, x_in)
-            j = t - (n_stages - 1)
-            is_output = (my == n_stages - 1) & (j >= 0) & (j < num_micro)
+            if repeats > 1:
+                from_circ = jax.lax.dynamic_index_in_dim(
+                    circ, jnp.mod(t, num_micro), axis=0, keepdims=False)
+                x0 = jnp.where(t < num_micro, inject, from_circ)
+            else:
+                x0 = inject
+            x_in = jnp.where(my == 0, x0, state)
+            if repeats > 1:
+                # This stage is serving repeat r of the microbatch that
+                # entered the global stream at step t - my.
+                r_idx = jnp.clip((t - my) // num_micro, 0, repeats - 1)
+                group = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, r_idx, 0, keepdims=False), local_params)
+            else:
+                group = local_params
+            y = stage_fn(group, x_in)
+            s = t - last
+            j = jnp.mod(s, num_micro)
+            is_output = (my == last) & (s >= (repeats - 1) * num_micro) \
+                & (s < repeats * num_micro)
             out = jax.lax.cond(
                 is_output,
                 lambda o: jax.lax.dynamic_update_index_in_dim(
-                    o, y, jnp.clip(j, 0, num_micro - 1), 0),
+                    o, y, j, 0),
                 lambda o: o, out)
             state = jax.lax.ppermute(y, axis_name, perm)
-            return (state, out), None
+            return (state, circ, out), None
 
         state0 = jnp.zeros_like(mbs[0])
         out0 = jnp.zeros_like(mbs)
-        (_, out), _ = jax.lax.scan(
-            tick, (state0, out0), jnp.arange(num_micro + n_stages - 1))
+        circ0 = jnp.zeros_like(mbs) if repeats > 1 else \
+            jnp.zeros((), mbs.dtype)
+        (_, _, out), _ = jax.lax.scan(
+            tick, (state0, circ0, out0),
+            jnp.arange(repeats * num_micro + n_stages - 1))
         # Only the last stage wrote `out`; psum replicates it to every
         # stage (zeros elsewhere), keeping out_specs replicated so the
         # surrounding auto-sharded graph (final norm / lm head / loss)
@@ -133,9 +221,9 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
         _pipelined,
         mesh=mesh,
         in_specs=(jax.tree.map(lambda _: _spec_leading(axis_name),
-                               stage_params), P()),
-        out_specs=P(),
-        axis_names=frozenset({axis_name}),
+                               stage_params), mb_spec),
+        out_specs=mb_spec,
+        axis_names=frozenset({axis_name}) | frozenset(extra_manual_axes),
     )(stage_params, microbatches.astype(work_dtype))
     return out.astype(orig_dtype)
 

@@ -452,18 +452,29 @@ def terminate_instances(cluster_name_on_cloud: str,
         names = sorted(n['name'].rsplit('/', 1)[-1] for n in nodes)
         head = names[0] if names else None
         queued = (provider_config or {}).get('provision_mode') == 'queued'
+        if queued and worker_only:
+            # A gang queuedResource covers head+workers together; the
+            # TPU API does not allow deleting a subset of its nodes.
+            # No in-tree caller uses worker_only; refuse loudly rather
+            # than leave the request referencing deleted nodes.
+            logger.warning(
+                f'{cluster_name_on_cloud}: queued-mode clusters tear '
+                'down atomically; ignoring worker_only teardown.')
+            return
         ops = []
         covered: set = set()
-        if queued and not worker_only:
+        if queued:
             # Sweep the cluster's queued requests FIRST: this also
             # reaps pending (no-node-yet) requests that would otherwise
             # turn ACTIVE later and bill untracked capacity.  Their
             # force-delete removes any materialized nodes too.
-            prefix = f'{cluster_name_on_cloud}-'
+            # Exact-name match (cluster-qr ids are '{cluster}-{idx}-qr')
+            # so a sibling cluster whose name extends ours is untouched.
+            qr_pat = re.compile(
+                re.escape(cluster_name_on_cloud) + r'-\d+-qr$')
             for qr in gcp_api.list_queued_resources(project, zone):
                 qr_name = qr.get('name', '').rsplit('/', 1)[-1]
-                if not (qr_name.startswith(prefix) and
-                        qr_name.endswith('-qr')):
+                if not qr_pat.fullmatch(qr_name):
                     continue
                 for spec in ((qr.get('tpu') or {}).get('nodeSpec')
                              or []):

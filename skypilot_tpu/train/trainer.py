@@ -49,6 +49,10 @@ class TrainConfig:
     # pipe axis > 1 (None -> 2 * pipe stages, keeping the GPipe bubble
     # under a third).
     pipeline_microbatches: Optional[int] = None
+    # Circular (interleaved) schedule: each stage holds this many
+    # non-contiguous layer groups; bubble shrinks by the same factor
+    # (parallel/pipeline.py gpipe circular_repeats).
+    pipeline_circular_repeats: int = 1
     mesh: mesh_lib.MeshConfig = mesh_lib.MeshConfig()
     model_overrides: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
@@ -181,19 +185,17 @@ class Trainer:
                 f'{config.seq_len}.')
         n_pipe = self.mesh.shape['pipe']
         if n_pipe > 1:
-            if n_context > 1:
-                raise ValueError('pipeline and context parallelism do '
-                                 'not yet compose.')
             if hasattr(self.model_config, 'n_experts'):
                 raise ValueError('pipeline parallelism does not yet '
                                  'compose with MoE models.')
             if not self.model_config.scan_layers:
                 raise ValueError('pipeline parallelism requires '
                                  'scan_layers=True (stacked layer params).')
-            if self.model_config.n_layers % n_pipe:
+            repeats = max(config.pipeline_circular_repeats, 1)
+            if self.model_config.n_layers % (n_pipe * repeats):
                 raise ValueError(
-                    f'pipe={n_pipe} must divide n_layers='
-                    f'{self.model_config.n_layers}.')
+                    f'pipe={n_pipe} x circular_repeats={repeats} must '
+                    f'divide n_layers={self.model_config.n_layers}.')
             pp_micro = config.pipeline_microbatches or 2 * n_pipe
             if pp_micro < n_pipe or micro % pp_micro:
                 raise ValueError(
@@ -279,11 +281,29 @@ class Trainer:
     def _pipelined_apply(self, params, tokens):
         """Forward with the decoder blocks run as a GPipe pipeline over
         the `pipe` mesh axis (embed / final norm / lm_head stay in the
-        surrounding auto-sharded graph)."""
+        surrounding auto-sharded graph).
+
+        Composes with context parallelism: the pipeline shard_map is
+        then manual over {'pipe','context'}, the microbatch buffer is
+        sequence-sharded, stages compute GLOBAL RoPE positions from
+        their context index, and the in-block ring attention runs
+        directly on the local shards (ops/ring_attention.py detects the
+        manual region)."""
+        from jax.sharding import PartitionSpec as P
+
         from skypilot_tpu.parallel import pipeline as pipeline_lib
 
         cfg = dataclasses.replace(self.model_config,
                                   partition_params=False)
+        n_context = self.mesh.shape['context']
+        if (n_context > 1 and jax.default_backend() != 'tpu'
+                and jnp.dtype(cfg.dtype) in (jnp.bfloat16, jnp.float16)):
+            # The XLA CPU backend aborts ("Invalid binary instruction
+            # opcode copy") on bf16 compute nested inside the
+            # {pipe, context} partial-manual region; stages run f32
+            # off-TPU (same class of workaround as
+            # parallel/pipeline.py's f32 boundary). TPU stays bf16.
+            cfg = dataclasses.replace(cfg, dtype=jnp.float32)
         x = llama.embed_lookup(cfg, params['tok_embed'], tokens)
         block = llama.Block(cfg)
 
@@ -296,15 +316,29 @@ class Trainer:
                 policy=jax.checkpoint_policies.nothing_saveable)
 
         def stage_fn(local_layers, mb):
-            pos = llama.default_positions(mb[..., 0])
+            s_local = mb.shape[1]
+            offset = 0
+            if n_context > 1:
+                offset = jax.lax.axis_index('context') * s_local
+            pos = jnp.broadcast_to(
+                offset + jnp.arange(s_local, dtype=jnp.int32)[None],
+                mb.shape[:2])
             return jax.lax.scan(
                 lambda h, lp: (block_apply(lp, h, pos), None),
                 mb, local_layers)[0]
 
+        extra_axes = frozenset({'context'}) if n_context > 1 \
+            else frozenset()
+        # mbs: [M, mbb, seq, dim] — sequence sharded over context.
+        mb_spec = P(None, None, 'context', None) if n_context > 1 \
+            else P()
         mbs = pipeline_lib.microbatch(x, self.pp_microbatches)
         x = pipeline_lib.unmicrobatch(
-            pipeline_lib.gpipe(stage_fn, params['layers'], mbs,
-                               mesh=self.mesh))
+            pipeline_lib.gpipe(
+                stage_fn, params['layers'], mbs, mesh=self.mesh,
+                extra_manual_axes=extra_axes, mb_spec=mb_spec,
+                circular_repeats=max(
+                    self.config.pipeline_circular_repeats, 1)))
         return llama.apply_final_head(cfg, params['final_norm'],
                                       params['lm_head'], x)
 

@@ -54,10 +54,16 @@ class TestContextParallelTrainer:
                 model='llama-tiny', global_batch_size=8, seq_len=129,
                 mesh=mesh_lib.MeshConfig(data=1, fsdp=-1, context=2)))
 
-    def test_pp_sp_rejected(self):
-        from skypilot_tpu.train import trainer as trainer_lib
-        with pytest.raises(ValueError, match='do not yet compose'):
-            trainer_lib.Trainer(trainer_lib.TrainConfig(
-                model='llama-tiny', global_batch_size=8, seq_len=256,
-                mesh=mesh_lib.MeshConfig(data=1, fsdp=-1, context=2,
-                                         pipe=2)))
+    def test_pp_sp_composition_matches_unsharded(self):
+        """pipe=2 x context=2 (x data=2): the pipeline stage runs ring
+        attention manually on local sequence shards with global RoPE
+        positions; losses must match the unsharded trainer."""
+        pp_sp_trainer, pp_sp = _losses(
+            mesh_lib.MeshConfig(data=2, fsdp=1, context=2, pipe=2),
+            scan_layers=True)
+        assert pp_sp_trainer.model_config.attention_impl == 'ring'
+        assert pp_sp_trainer.pp_microbatches >= 2
+        _, base = _losses(mesh_lib.MeshConfig(data=2, fsdp=-1),
+                          scan_layers=True)
+        for a, b in zip(pp_sp, base):
+            assert abs(a - b) < 0.05, (pp_sp, base)

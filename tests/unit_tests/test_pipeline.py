@@ -54,6 +54,35 @@ class TestGPipe:
         np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
                                    atol=1e-4)
 
+    @pytest.mark.parametrize('repeats', [2, 4])
+    def test_circular_forward_matches_sequential(self, repeats):
+        mesh = mesh_lib.make_mesh(
+            mesh_lib.MeshConfig(data=2, fsdp=2, pipe=2))
+        ws, x = _make(l=8, m=4)
+        with mesh:
+            out = pipeline.gpipe(_stage_fn, ws, x, mesh=mesh,
+                                 circular_repeats=repeats)
+        ref = jax.lax.map(lambda mb: _stage_fn(ws, mb), x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_circular_grad_matches_sequential(self):
+        mesh = mesh_lib.make_mesh(
+            mesh_lib.MeshConfig(data=2, fsdp=1, pipe=4))
+        ws, x = _make(l=8, m=8)
+
+        def loss(ws):
+            with mesh:
+                return pipeline.gpipe(_stage_fn, ws, x, mesh=mesh,
+                                      circular_repeats=2).sum()
+
+        g = jax.grad(loss)(ws)
+        g_ref = jax.grad(
+            lambda ws: jax.lax.map(lambda mb: _stage_fn(ws, mb),
+                                   x).sum())(ws)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   atol=1e-4)
+
     def test_too_few_microbatches_raises(self):
         mesh = mesh_lib.make_mesh(
             mesh_lib.MeshConfig(data=1, fsdp=-1, pipe=4))
@@ -104,6 +133,33 @@ class TestPipelinedTrainer:
         # Same params (same seed), same data: identical math up to
         # bf16 reduction-order noise.
         assert abs(losses['pp'] - losses['nopp']) < 0.05, losses
+
+    def test_circular_trainer_step_matches_unpipelined(self):
+        from skypilot_tpu.train import data as data_lib
+        from skypilot_tpu.train import trainer as trainer_lib
+
+        losses = {}
+        for name, mesh_config, kw in [
+                ('circ', mesh_lib.MeshConfig(data=2, fsdp=2, pipe=2),
+                 dict(pipeline_microbatches=2,
+                      pipeline_circular_repeats=2)),
+                ('nopp', mesh_lib.MeshConfig(data=2, fsdp=-1, pipe=1),
+                 {}),
+        ]:
+            config = trainer_lib.TrainConfig(
+                model='llama-tiny', global_batch_size=8, seq_len=128,
+                total_steps=1, mesh=mesh_config,
+                model_overrides={'n_heads': 4, 'n_kv_heads': 2,
+                                 'n_layers': 4, 'max_seq_len': 128,
+                                 'remat': False}, **kw)
+            trainer = trainer_lib.Trainer(config)
+            trainer.init_state()
+            it = data_lib.synthetic_data(
+                trainer.mesh, global_batch_size=8, seq_len=128,
+                vocab_size=trainer.model_config.vocab_size, seed=7)
+            metrics = trainer.step(next(it))
+            losses[name] = float(jax.device_get(metrics['loss']))
+        assert abs(losses['circ'] - losses['nopp']) < 0.05, losses
 
     def test_pipe_must_divide_layers(self):
         from skypilot_tpu.train import trainer as trainer_lib
