@@ -1,0 +1,104 @@
+"""Catalog cache: on-disk CSV overrides + update machinery.
+
+Reference: sky/clouds/service_catalog/common.py:29-115 — the hosted-CSV
+fetch + `~/.sky/catalogs/v<N>/` cache with lazily-loaded dataframes.
+Here the tiering is:
+
+    1. in-code snapshot (always present; ships with the package),
+    2. `~/.skytpu/catalogs/v1/<cloud>/<table>.csv` override when it
+       exists — written by `sky catalog update`, which can export the
+       built-in snapshot for hand-editing, import a file, or fetch a
+       URL (a hosted catalog or a pricing-API exporter's output).
+
+So deployments refresh prices/zones without code edits, and air-gapped
+environments keep working off the snapshot.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import paths
+
+if typing.TYPE_CHECKING:
+    import pandas as pd
+
+logger = sky_logging.init_logger(__name__)
+
+CATALOG_SCHEMA_VERSION = 'v1'
+
+
+def catalog_dir(cloud: str) -> str:
+    return os.path.join(paths.catalogs_dir(), CATALOG_SCHEMA_VERSION,
+                        cloud)
+
+
+def catalog_path(cloud: str, table: str) -> str:
+    return os.path.join(catalog_dir(cloud), f'{table}.csv')
+
+
+def read_catalog_csv(cloud: str, table: str,
+                     required_columns: Optional[List[str]] = None
+                     ) -> Optional['pd.DataFrame']:
+    """The on-disk override for a table, or None to use the snapshot."""
+    path = catalog_path(cloud, table)
+    if not os.path.exists(path):
+        return None
+    import pandas as pd
+    try:
+        df = pd.read_csv(path)
+    except Exception as e:  # noqa: BLE001 — corrupt override
+        logger.warning(f'Ignoring unreadable catalog override {path}: '
+                       f'{e}')
+        return None
+    missing = set(required_columns or []) - set(df.columns)
+    if missing:
+        logger.warning(
+            f'Ignoring catalog override {path}: missing columns '
+            f'{sorted(missing)}')
+        return None
+    return df
+
+
+def write_catalog_csv(cloud: str, table: str, text: str) -> str:
+    path = catalog_path(cloud, table)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + f'.tmp{os.getpid()}'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+def update_from_file(cloud: str, table: str, source_path: str) -> str:
+    with open(os.path.expanduser(source_path), encoding='utf-8') as f:
+        return write_catalog_csv(cloud, table, f.read())
+
+
+def update_from_url(cloud: str, table: str, url: str,
+                    timeout: float = 30.0) -> str:
+    """Fetch a hosted catalog CSV (reference: hosted-catalog HTTP fetch,
+    service_catalog/common.py:159)."""
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            text = resp.read().decode('utf-8')
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        raise exceptions.SkyTpuError(
+            f'Could not fetch catalog {table} from {url}: {e}. '
+            'Offline? Use `sky catalog update --from-file` or keep the '
+            'built-in snapshot.') from e
+    return write_catalog_csv(cloud, table, text)
+
+
+def remove_override(cloud: str, table: str) -> bool:
+    path = catalog_path(cloud, table)
+    try:
+        os.unlink(path)
+        return True
+    except FileNotFoundError:
+        return False

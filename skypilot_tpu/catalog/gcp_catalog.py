@@ -7,9 +7,11 @@ service_catalog/common.py:29-115.  Differences by design:
     (utils/accelerator_registry.py) instead of enumerating thousands of
     CSV rows: any valid slice shape of a generation is priced as
     chips x price-per-chip-hour x region multiplier.
-  - Static snapshot of public list prices (2025) with an update hook
-    (`set_pricing_override`) so deployments can refresh without code edits;
-    the reference refreshes by pulling hosted CSVs instead.
+  - Prices/zones come from a built-in snapshot of public list prices
+    (2025) overridable by `~/.skytpu/catalogs/v1/gcp/{vms,tpu_prices,
+    tpu_zones}.csv` (written by `sky catalog update` — export the
+    snapshot, edit, or fetch a hosted CSV; catalog/common.py), plus the
+    in-process `set_pricing_override` hook.
 """
 from __future__ import annotations
 
@@ -99,15 +101,86 @@ _VM_ZONES = ['us-central1-a', 'us-central1-b', 'us-central2-b', 'us-east1-c',
              'us-central1-c', 'us-central1-f']
 
 _df: Optional['pd.DataFrame'] = None
+_tpu_price_table: Optional[Dict[str, Tuple[float, float]]] = None
+_tpu_zone_table: Optional[Dict[str, List[str]]] = None
 _pricing_override: Dict[str, Tuple[float, float]] = {}
+
+_VM_COLUMNS = ['instance_type', 'vcpus', 'memory_gb',
+               'accelerator_name', 'accelerator_count', 'price',
+               'spot_price']
 
 
 def _vm_df() -> 'pd.DataFrame':
     global _df
     if _df is None:
         import pandas as pd  # deferred: keep `import skypilot_tpu` light
-        _df = pd.read_csv(io.StringIO(_VMS_CSV))
+
+        from skypilot_tpu.catalog import common
+        _df = common.read_catalog_csv('gcp', 'vms', _VM_COLUMNS)
+        if _df is None:
+            _df = pd.read_csv(io.StringIO(_VMS_CSV))
     return _df
+
+
+def _tpu_prices() -> Dict[str, Tuple[float, float]]:
+    global _tpu_price_table
+    if _tpu_price_table is None:
+        from skypilot_tpu.catalog import common
+        table = dict(_TPU_PRICE_PER_CHIP_HOUR)
+        df = common.read_catalog_csv('gcp', 'tpu_prices',
+                                     ['generation', 'price',
+                                      'spot_price'])
+        if df is not None:
+            for _, row in df.iterrows():
+                table[str(row['generation'])] = (float(row['price']),
+                                                 float(row['spot_price']))
+        _tpu_price_table = table
+    return _tpu_price_table
+
+
+def _tpu_zone_map() -> Dict[str, List[str]]:
+    global _tpu_zone_table
+    if _tpu_zone_table is None:
+        from skypilot_tpu.catalog import common
+        df = common.read_catalog_csv('gcp', 'tpu_zones',
+                                     ['generation', 'zone'])
+        # MERGE over the snapshot (same semantics as tpu_prices): a
+        # partial override replaces only the generations it lists.
+        table = dict(_TPU_ZONES)
+        if df is not None:
+            overridden: Dict[str, List[str]] = {}
+            for _, row in df.iterrows():
+                overridden.setdefault(str(row['generation']), []).append(
+                    str(row['zone']))
+            table.update(overridden)
+        _tpu_zone_table = table
+    return _tpu_zone_table
+
+
+def reload() -> None:
+    """Drop memoized tables so on-disk overrides take effect (called
+    after `sky catalog update` and by tests)."""
+    global _df, _tpu_price_table, _tpu_zone_table
+    _df = None
+    _tpu_price_table = None
+    _tpu_zone_table = None
+
+
+def export_snapshot() -> Dict[str, str]:
+    """The currently-effective tables as CSV text, keyed by table name
+    (`sky catalog update --export` writes these to the cache dir as a
+    starting point for hand edits)."""
+    prices = _tpu_prices()
+    price_lines = ['generation,price,spot_price'] + [
+        f'{g},{od},{sp}' for g, (od, sp) in sorted(prices.items())]
+    zone_lines = ['generation,zone'] + [
+        f'{g},{z}' for g, zs in sorted(_tpu_zone_map().items())
+        for z in zs]
+    return {
+        'vms': _vm_df().to_csv(index=False),
+        'tpu_prices': '\n'.join(price_lines) + '\n',
+        'tpu_zones': '\n'.join(zone_lines) + '\n',
+    }
 
 
 def set_pricing_override(per_chip: Dict[str, Tuple[float, float]]) -> None:
@@ -141,7 +214,7 @@ def validate_tpu_slice(spec: accelerator_registry.TpuSliceSpec) -> None:
 
 def tpu_zones(gen: str, region: Optional[str] = None,
               zone: Optional[str] = None) -> List[str]:
-    zones = _TPU_ZONES.get(gen, [])
+    zones = _tpu_zone_map().get(gen, [])
     if region is not None:
         zones = [z for z in zones if zone_to_region(z) == region]
     if zone is not None:
@@ -150,7 +223,7 @@ def tpu_zones(gen: str, region: Optional[str] = None,
 
 
 def tpu_regions(gen: str) -> List[str]:
-    return sorted({zone_to_region(z) for z in _TPU_ZONES.get(gen, [])})
+    return sorted({zone_to_region(z) for z in _tpu_zone_map().get(gen, [])})
 
 
 def get_tpu_hourly_cost(spec: accelerator_registry.TpuSliceSpec,
@@ -160,7 +233,7 @@ def get_tpu_hourly_cost(spec: accelerator_registry.TpuSliceSpec,
     gen = spec.generation.name
     if zone is not None and region is None:
         region = zone_to_region(zone)
-    od, spot = _pricing_override.get(gen, _TPU_PRICE_PER_CHIP_HOUR[gen])
+    od, spot = _pricing_override.get(gen, _tpu_prices()[gen])
     per_chip = spot if use_spot else od
     return per_chip * spec.num_chips * _region_multiplier(region)
 

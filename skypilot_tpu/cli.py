@@ -274,14 +274,11 @@ def check(clouds) -> None:
     click.echo(f'Enabled clouds: {", ".join(enabled) or "none"}')
 
 
-@cli.command(name='show-tpus')
-@click.argument('name_filter', required=False)
-def show_tpus(name_filter) -> None:
-    """List TPU slice shapes (and GPUs) with topology and pricing
-    (reference: `sky show-gpus`)."""
+def _show_accelerators(name_filter, include_gpus: bool) -> None:
     from skypilot_tpu.catalog import gcp_catalog
     inventory = gcp_catalog.list_accelerators(name_filter)
     rows = []
+    gpu_rows = []
     for name in sorted(inventory):
         for item in inventory[name]:
             if 'chips' in item:
@@ -291,8 +288,87 @@ def show_tpus(name_filter) -> None:
                     f"{item['bf16_tflops']:.0f}",
                     f"${item['price']:.2f}", f"${item['spot_price']:.2f}",
                     ','.join(item['regions'])))
+            elif include_gpus:
+                gpu_rows.append((
+                    name, str(item['instance_type']),
+                    f"${item['price']:.2f}",
+                    f"${item['spot_price']:.2f}"))
     _print_table(('TPU', 'CHIPS', 'HOSTS', 'HBM_GB', 'BF16_TFLOPS',
                   '$/HR', 'SPOT_$/HR', 'REGIONS'), rows)
+    if gpu_rows:
+        click.echo()
+        _print_table(('GPU', 'INSTANCE_TYPE', '$/HR', 'SPOT_$/HR'),
+                     gpu_rows)
+
+
+@cli.command(name='show-tpus')
+@click.argument('name_filter', required=False)
+def show_tpus(name_filter) -> None:
+    """List TPU slice shapes with topology and pricing
+    (reference: `sky show-gpus`)."""
+    _show_accelerators(name_filter, include_gpus=False)
+
+
+@cli.command(name='show-accelerators')
+@click.argument('name_filter', required=False)
+def show_accelerators(name_filter) -> None:
+    """List ALL accelerator offerings — TPU slices and GPU VMs — with
+    pricing (reference: `sky show-gpus`)."""
+    _show_accelerators(name_filter, include_gpus=True)
+
+
+@cli.group()
+def catalog() -> None:
+    """Manage the pricing/offerings catalog cache."""
+
+
+@catalog.command(name='update')
+@click.option('--cloud', default='gcp')
+@click.option('--table', default=None,
+              help='vms | tpu_prices | tpu_zones')
+@click.option('--from-file', 'from_file', default=None,
+              help='Import a CSV file as the table override.')
+@click.option('--url', default=None,
+              help='Fetch the table from a hosted catalog URL.')
+@click.option('--export', is_flag=True, default=False,
+              help='Write the effective snapshot to the cache dir '
+                   'as editable CSVs.')
+@click.option('--reset', is_flag=True, default=False,
+              help='Drop all overrides; revert to the built-in '
+                   'snapshot.')
+def catalog_update(cloud, table, from_file, url, export, reset) -> None:
+    """Refresh the local catalog cache (reference: hosted-catalog
+    fetch, sky/clouds/service_catalog/common.py)."""
+    from skypilot_tpu.catalog import common as catalog_common
+    from skypilot_tpu.catalog import gcp_catalog
+    if cloud != 'gcp':
+        raise click.UsageError(f'Unknown catalog cloud {cloud!r}.')
+    if reset:
+        for t in ('vms', 'tpu_prices', 'tpu_zones'):
+            if catalog_common.remove_override(cloud, t):
+                click.echo(f'Removed {t} override.')
+        gcp_catalog.reload()
+        return
+    if export:
+        for t, text in gcp_catalog.export_snapshot().items():
+            click.echo(
+                f'Wrote {catalog_common.write_catalog_csv(cloud, t, text)}')
+        gcp_catalog.reload()
+        return
+    if not table or not (from_file or url):
+        raise click.UsageError(
+            'Provide --table with --from-file or --url, or use '
+            '--export / --reset.')
+    if table not in ('vms', 'tpu_prices', 'tpu_zones'):
+        raise click.UsageError(
+            f'Unknown table {table!r}; expected vms, tpu_prices, or '
+            'tpu_zones.')
+    if from_file:
+        path = catalog_common.update_from_file(cloud, table, from_file)
+    else:
+        path = catalog_common.update_from_url(cloud, table, url)
+    gcp_catalog.reload()
+    click.echo(f'Updated {path}')
 
 
 @cli.command(name='cost-report')

@@ -191,7 +191,11 @@ class Trainer:
             if not self.model_config.scan_layers:
                 raise ValueError('pipeline parallelism requires '
                                  'scan_layers=True (stacked layer params).')
-            repeats = max(config.pipeline_circular_repeats, 1)
+            repeats = config.pipeline_circular_repeats
+            if repeats < 1:
+                raise ValueError(
+                    f'pipeline_circular_repeats must be >= 1, got '
+                    f'{repeats}.')
             if self.model_config.n_layers % (n_pipe * repeats):
                 raise ValueError(
                     f'pipe={n_pipe} x circular_repeats={repeats} must '
@@ -337,8 +341,7 @@ class Trainer:
             pipeline_lib.gpipe(
                 stage_fn, params['layers'], mbs, mesh=self.mesh,
                 extra_manual_axes=extra_axes, mb_spec=mb_spec,
-                circular_repeats=max(
-                    self.config.pipeline_circular_repeats, 1)))
+                circular_repeats=self.config.pipeline_circular_repeats))
         return llama.apply_final_head(cfg, params['final_norm'],
                                       params['lm_head'], x)
 
