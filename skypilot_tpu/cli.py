@@ -395,6 +395,33 @@ def storage_ls() -> None:
     _print_table(('NAME', 'STATUS', 'STORE'), rows)
 
 
+@storage.command(name='transfer')
+@click.argument('src_url')
+@click.argument('dst_url')
+@click.option('--transfer-service', is_flag=True, default=False,
+              help='S3->GCS only: server-side copy via the GCP Storage '
+                   'Transfer Service instead of daisy-chaining through '
+                   'this machine.')
+def storage_transfer(src_url, dst_url, transfer_service) -> None:
+    """Copy a bucket between clouds (gs:// <-> s3://)."""
+    from skypilot_tpu.data import data_transfer
+    if transfer_service:
+        if not (src_url.startswith('s3://') and
+                dst_url.startswith('gs://')):
+            raise click.UsageError(
+                '--transfer-service supports s3:// -> gs:// only.')
+        src_bkt = src_url[len('s3://'):].rstrip('/')
+        dst_bkt = dst_url[len('gs://'):].rstrip('/')
+        if '/' in src_bkt or '/' in dst_bkt:
+            raise click.UsageError(
+                '--transfer-service copies whole buckets; prefix URLs '
+                'are only supported by the default (gsutil) path.')
+        data_transfer.s3_to_gcs_via_transfer_service(src_bkt, dst_bkt)
+    else:
+        data_transfer.transfer(src_url, dst_url)
+    click.echo(f'Transferred {src_url} -> {dst_url}.')
+
+
 @storage.command(name='delete')
 @click.argument('names', nargs=-1, required=True)
 @click.option('--yes', '-y', is_flag=True, default=False)

@@ -31,6 +31,29 @@ def make_gcsfuse_mount_command(bucket_name: str, mount_path: str) -> str:
         f'{bucket_name} {mount_path}')
 
 
+GOOFYS_VERSION = '0.24.0'
+
+
+def make_goofys_install_command() -> str:
+    return (
+        'command -v goofys >/dev/null 2>&1 || ('
+        'sudo curl -L -o /usr/local/bin/goofys '
+        '"https://github.com/kahing/goofys/releases/download/'
+        f'v{GOOFYS_VERSION}/goofys" && '
+        'sudo chmod +x /usr/local/bin/goofys)')
+
+
+def make_goofys_mount_command(bucket_name: str, mount_path: str) -> str:
+    """Idempotent S3 FUSE mount (reference mounting_utils goofys
+    command builder)."""
+    return (
+        f'{make_goofys_install_command()}; '
+        f'mkdir -p {mount_path}; '
+        f'mountpoint -q {mount_path} || '
+        f'goofys --stat-cache-ttl 5s --type-cache-ttl 5s '
+        f'{bucket_name} {mount_path}')
+
+
 def make_unmount_command(mount_path: str) -> str:
     return (f'mountpoint -q {mount_path} && '
             f'(fusermount -u {mount_path} || sudo umount {mount_path}) '

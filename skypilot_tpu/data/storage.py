@@ -99,10 +99,20 @@ class GcsStore(AbstractStore):
                 f'Failed to create {self.url()}: {proc.stderr}')
 
     def upload(self, sources: List[str]) -> None:
+        from skypilot_tpu.data import storage_utils
         for source in sources:
             src = os.path.expanduser(source)
-            proc = self._run(['-m', 'rsync', '-r', src, self.url()],
-                             check=False)
+            if os.path.isdir(src):
+                args = ['-m', 'rsync', '-r']
+                patterns = storage_utils.read_excluded_patterns(src)
+                if patterns:
+                    args += ['-x',
+                             storage_utils.gsutil_exclude_regex(patterns)]
+                args += [src, self.url()]
+            else:
+                # gsutil rsync rejects non-directory sources.
+                args = ['-m', 'cp', src, self.url()]
+            proc = self._run(args, check=False)
             if proc.returncode != 0:
                 raise exceptions.StorageError(
                     f'Upload {src} -> {self.url()} failed: {proc.stderr}')
@@ -123,6 +133,60 @@ class GcsStore(AbstractStore):
             self.name, mount_path)
 
 
+class S3Store(AbstractStore):
+    """S3 via the aws CLI (reference storage.py:1221 S3Store; same
+    CLI-driven mechanism, goofys for MOUNT mode)."""
+
+    def url(self) -> str:
+        return f's3://{self.name}'
+
+    def _run(self, args: List[str], check: bool = True
+             ) -> subprocess.CompletedProcess:
+        return subprocess.run(['aws'] + args, capture_output=True,
+                              text=True, check=check)
+
+    def exists(self) -> bool:
+        proc = self._run(['s3api', 'head-bucket', '--bucket', self.name],
+                         check=False)
+        return proc.returncode == 0
+
+    def create(self) -> None:
+        proc = self._run(['s3', 'mb', self.url()], check=False)
+        if proc.returncode != 0 and \
+                'BucketAlreadyOwnedByYou' not in proc.stderr:
+            raise exceptions.StorageBucketCreateError(
+                f'Failed to create {self.url()}: {proc.stderr}')
+
+    def upload(self, sources: List[str]) -> None:
+        from skypilot_tpu.data import storage_utils
+        for source in sources:
+            src = os.path.expanduser(source)
+            if os.path.isdir(src):
+                args = ['s3', 'sync', src, self.url()]
+                args += storage_utils.aws_exclude_args(
+                    storage_utils.read_excluded_patterns(src))
+            else:
+                args = ['s3', 'cp', src, self.url()]
+            proc = self._run(args, check=False)
+            if proc.returncode != 0:
+                raise exceptions.StorageError(
+                    f'Upload {src} -> {self.url()} failed: {proc.stderr}')
+
+    def delete(self) -> None:
+        proc = self._run(['s3', 'rb', self.url(), '--force'], check=False)
+        if proc.returncode != 0 and 'NoSuchBucket' not in proc.stderr:
+            raise exceptions.StorageBucketDeleteError(
+                f'Failed to delete {self.url()}: {proc.stderr}')
+
+    def make_sync_dir_command(self, dst: str) -> str:
+        return f'mkdir -p {dst} && aws s3 sync {self.url()} {dst}'
+
+    def make_mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.make_goofys_mount_command(
+            self.name, mount_path)
+
+
 class LocalStore(AbstractStore):
     """Directory-backed store for tests/local clusters."""
 
@@ -140,11 +204,16 @@ class LocalStore(AbstractStore):
         os.makedirs(self._root(), exist_ok=True)
 
     def upload(self, sources: List[str]) -> None:
+        from skypilot_tpu.data import storage_utils
         self.create()
         for source in sources:
             src = os.path.expanduser(source)
             if os.path.isdir(src):
-                shutil.copytree(src, self._root(), dirs_exist_ok=True)
+                patterns = storage_utils.read_excluded_patterns(src)
+                shutil.copytree(
+                    src, self._root(), dirs_exist_ok=True,
+                    ignore=(storage_utils.local_ignore(patterns)
+                            if patterns else None))
             else:
                 shutil.copy2(src, self._root())
 
@@ -163,6 +232,7 @@ class LocalStore(AbstractStore):
 
 _STORE_CLASSES = {
     StoreType.GCS: GcsStore,
+    StoreType.S3: S3Store,
     StoreType.LOCAL: LocalStore,
 }
 

@@ -1,0 +1,71 @@
+"""Bucket-upload exclusion lists: the `.skyignore` contract.
+
+Reference: sky/data/storage_utils.py — a `.skyignore` file at the root
+of a local source lists glob patterns (one per line, `#` comments)
+excluded from bucket uploads, so virtualenvs/caches/checkpoints never
+leave the machine.  Translated per uploader: `gsutil rsync -x` takes
+one regex, `aws s3 sync` takes repeated `--exclude` globs, local
+copies use a shutil-style ignore callable.
+"""
+from __future__ import annotations
+
+import fnmatch
+import os
+import re
+from typing import Callable, List
+
+SKYIGNORE_FILE = '.skyignore'
+
+
+def read_excluded_patterns(src_dir: str) -> List[str]:
+    path = os.path.join(os.path.expanduser(src_dir), SKYIGNORE_FILE)
+    if not os.path.isfile(path):
+        return []
+    patterns: List[str] = []
+    with open(path, encoding='utf-8') as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith('#'):
+                continue
+            patterns.append(line.rstrip('/'))
+    return patterns
+
+
+def gsutil_exclude_regex(patterns: List[str]) -> str:
+    """One rsync -x regex matching any pattern.
+
+    Uniform semantics across stores: a pattern matches a path
+    component at ANY depth (gitignore-style), and whole subtrees under
+    a matched directory are excluded.  Each alternation branch is
+    re-anchored with \\Z because gsutil applies the regex with
+    re.match (start-anchored only) — without it '*.log' would
+    prefix-match 'keep.login.txt'.
+    """
+    parts = []
+    for pat in patterns:
+        base = fnmatch.translate(pat)[:-2]  # strip trailing \Z
+        parts.append(f'(?:(?:.*/)?(?:{base})(?:/.*)?\\Z)')
+    return '|'.join(parts)
+
+
+def aws_exclude_args(patterns: List[str]) -> List[str]:
+    """Repeated --exclude globs covering the pattern at any depth and
+    everything beneath it (aws s3 sync globs are root-anchored)."""
+    args: List[str] = []
+    for pat in patterns:
+        for glob in (pat, f'{pat}/*', f'*/{pat}', f'*/{pat}/*'):
+            args += ['--exclude', glob]
+    return args
+
+
+def local_ignore(patterns: List[str]
+                 ) -> Callable[[str, List[str]], List[str]]:
+    """shutil.copytree-compatible ignore callable."""
+    compiled = [re.compile(fnmatch.translate(p)) for p in patterns]
+
+    def _ignore(directory: str, names: List[str]) -> List[str]:
+        del directory
+        return [n for n in names
+                if any(c.fullmatch(n) for c in compiled)]
+
+    return _ignore

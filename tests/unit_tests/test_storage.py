@@ -1,0 +1,197 @@
+"""Storage breadth: S3 store (COPY + MOUNT), GCS<->S3 transfer, and
+.skyignore bucket exclusions — all against mocked CLIs.
+
+Reference analogs: sky/data/storage.py:1221 (S3Store),
+sky/data/data_transfer.py:1-239, sky/data/storage_utils.py
+(.skyignore).
+"""
+import subprocess
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import data_transfer
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.data import storage_utils
+
+
+class _CliRecorder:
+    """Capture subprocess.run invocations; scripted returncodes."""
+
+    def __init__(self, returncode=0, stderr=''):
+        self.calls = []
+        self.returncode = returncode
+        self.stderr = stderr
+
+    def __call__(self, cmd, **kwargs):
+        self.calls.append(cmd)
+        return subprocess.CompletedProcess(cmd, self.returncode,
+                                           stdout='', stderr=self.stderr)
+
+
+@pytest.fixture()
+def cli(monkeypatch):
+    rec = _CliRecorder()
+    monkeypatch.setattr(subprocess, 'run', rec)
+    return rec
+
+
+class TestS3Store:
+
+    def test_lifecycle_commands(self, cli, tmp_path):
+        (tmp_path / 'f.txt').write_text('x')
+        store = storage_lib.S3Store('mybkt', str(tmp_path))
+        cli.returncode = 1  # head-bucket says missing
+        assert not store.exists()
+        cli.returncode = 0
+        store.create()
+        store.upload([str(tmp_path)])
+        store.delete()
+        flat = [' '.join(c) for c in cli.calls]
+        assert any('s3api head-bucket --bucket mybkt' in c for c in flat)
+        assert any('s3 mb s3://mybkt' in c for c in flat)
+        assert any(c.startswith('aws s3 sync') and 's3://mybkt' in c
+                   for c in flat)
+        assert any('s3 rb s3://mybkt --force' in c for c in flat)
+
+    def test_copy_and_mount_commands(self):
+        store = storage_lib.S3Store('mybkt', None)
+        sync = store.make_sync_dir_command('/data')
+        assert 'aws s3 sync s3://mybkt /data' in sync
+        mount = store.make_mount_command('/data')
+        assert 'goofys' in mount
+        assert 'mybkt /data' in mount
+        assert 'mountpoint -q /data' in mount
+
+    def test_storage_selects_s3_from_url(self):
+        s = storage_lib.Storage(source='s3://mybkt/sub')
+        assert s.store_type == storage_lib.StoreType.S3
+        assert isinstance(s.get_store(), storage_lib.S3Store)
+
+    def test_mount_mode_roundtrip_yaml(self):
+        s = storage_lib.Storage.from_yaml_config(
+            {'name': 'mybkt', 'store': 's3', 'mode': 'MOUNT'})
+        assert s.store_type == storage_lib.StoreType.S3
+        assert s.to_yaml_config()['store'] == 'S3'
+
+
+class TestSkyignore:
+
+    def _src(self, tmp_path):
+        (tmp_path / 'keep.txt').write_text('k')
+        (tmp_path / 'skip.log').write_text('s')
+        sub = tmp_path / '__pycache__'
+        sub.mkdir()
+        (sub / 'x.pyc').write_text('p')
+        (tmp_path / '.skyignore').write_text(
+            '# caches\n__pycache__\n*.log\n')
+        return str(tmp_path)
+
+    def test_read_patterns(self, tmp_path):
+        src = self._src(tmp_path)
+        assert storage_utils.read_excluded_patterns(src) == \
+            ['__pycache__', '*.log']
+        assert storage_utils.read_excluded_patterns(
+            str(tmp_path / 'nonexistent')) == []
+
+    def test_gsutil_regex(self, tmp_path):
+        import re
+        src = self._src(tmp_path)
+        regex = storage_utils.gsutil_exclude_regex(
+            storage_utils.read_excluded_patterns(src))
+        assert re.match(regex, '__pycache__')
+        assert re.match(regex, '__pycache__/x.pyc')
+        assert re.match(regex, 'sub/__pycache__/x.pyc')  # any depth
+        assert re.match(regex, 'a.log')
+        assert re.match(regex, 'sub/a.log')
+        assert not re.match(regex, 'keep.txt')
+        # gsutil applies re.match (start-anchored): the branches must
+        # be end-anchored so '*.log' can't prefix-match these.
+        assert not re.match(regex, 'metrics.logs')
+        assert not re.match(regex, 'keep.login.txt')
+
+    def test_aws_excludes_cover_any_depth(self):
+        args = storage_utils.aws_exclude_args(['__pycache__'])
+        globs = args[1::2]
+        assert '__pycache__/*' in globs
+        assert '*/__pycache__/*' in globs
+
+    def test_gcs_single_file_uses_cp(self, cli, tmp_path):
+        f = tmp_path / 'data.csv'
+        f.write_text('1,2\n')
+        storage_lib.GcsStore('b', str(f)).upload([str(f)])
+        (cmd,) = cli.calls
+        assert 'cp' in cmd
+        assert 'rsync' not in cmd
+
+    def test_gcs_upload_applies_excludes(self, cli, tmp_path):
+        src = self._src(tmp_path)
+        storage_lib.GcsStore('b', src).upload([src])
+        (cmd,) = cli.calls
+        assert '-x' in cmd
+        assert 'rsync' in cmd
+
+    def test_s3_upload_applies_excludes(self, cli, tmp_path):
+        src = self._src(tmp_path)
+        storage_lib.S3Store('b', src).upload([src])
+        (cmd,) = cli.calls
+        assert '--exclude' in cmd
+        assert '__pycache__' in cmd
+
+    def test_local_store_skips_ignored(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path / 'state'))
+        (tmp_path / 'src').mkdir()
+        src = self._src(tmp_path / 'src')
+        store = storage_lib.LocalStore('b', src)
+        store.upload([src])
+        import os
+        root = store._root()  # pylint: disable=protected-access
+        assert os.path.exists(os.path.join(root, 'keep.txt'))
+        assert not os.path.exists(os.path.join(root, 'skip.log'))
+        assert not os.path.exists(os.path.join(root, '__pycache__'))
+
+
+class TestTransfer:
+
+    def test_transfer_command_both_directions(self):
+        cmd = data_transfer.transfer_command('gs://a', 's3://b')
+        assert cmd == ['gsutil', '-m', 'rsync', '-r', 'gs://a', 's3://b']
+        cmd = data_transfer.transfer_command('s3://b/x/', 'gs://a')
+        assert cmd[-2:] == ['s3://b/x', 'gs://a']
+
+    def test_transfer_rejects_other_schemes(self):
+        with pytest.raises(exceptions.StorageSourceError):
+            data_transfer.transfer_command('https://x', 'gs://a')
+
+    def test_transfer_runs_and_raises_on_failure(self, cli):
+        data_transfer.transfer('gs://a', 's3://b')
+        assert cli.calls
+        cli.returncode = 1
+        cli.stderr = 'boom'
+        with pytest.raises(exceptions.StorageError, match='boom'):
+            data_transfer.transfer('gs://a', 's3://b')
+
+    def test_transfer_service_job_body(self, monkeypatch):
+        requests = []
+
+        class FakeSession:
+            def request(self, method, url, json_body=None, **kw):
+                requests.append((method, url, json_body))
+                if url.endswith('transferJobs'):
+                    return {'name': 'transferJobs/123'}
+                if url.endswith(':run'):
+                    return {'name': 'transferOperations/456'}
+                return {'done': True}
+
+        from skypilot_tpu.provision.gcp import gcp_api
+        monkeypatch.setattr(gcp_api, 'session', lambda: FakeSession())
+        job = data_transfer.s3_to_gcs_via_transfer_service(
+            'src-bkt', 'dst-bkt', project='proj',
+            aws_access_key_id='AK', aws_secret_access_key='SK')
+        assert job['name'] == 'transferJobs/123'
+        method, url, body = requests[0]
+        assert method == 'POST' and url.endswith('transferJobs')
+        spec = body['transferSpec']
+        assert spec['awsS3DataSource']['bucketName'] == 'src-bkt'
+        assert spec['gcsDataSink']['bucketName'] == 'dst-bkt'
+        assert requests[1][1].endswith(':run')
