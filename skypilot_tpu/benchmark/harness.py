@@ -33,10 +33,12 @@ def _cluster_name(benchmark: str, idx: int) -> str:
     return f'skytpu-bench-{benchmark}-{idx}'
 
 
-def _log_path(cluster: str) -> str:
-    # Per-cluster filename: candidates on the `local` cloud share one
-    # filesystem, and a shared file would interleave their records.
-    return f'~/.skytpu/benchmark_steps-{cluster}.jsonl'
+def _log_path(cluster: str, nonce: int) -> str:
+    # Per-cluster AND per-launch filename: candidates on the `local`
+    # cloud share one filesystem (a shared file would interleave their
+    # records), and the logger appends, so a reused cluster name must
+    # not read a previous launch's steps.
+    return f'~/.skytpu/benchmark_steps-{cluster}-{nonce}.jsonl'
 
 
 def launch(task, candidates: List[Dict[str, Any]], benchmark: str,
@@ -52,24 +54,28 @@ def launch(task, candidates: List[Dict[str, Any]], benchmark: str,
 
     clusters: List[str] = []
     launch_args = []
+    nonce = int(time.time() * 1000)
     for i, overrides in enumerate(candidates):
         config = json.loads(json.dumps(base_config))  # deep copy
         resources = dict(config.get('resources') or {})
         resources.update(overrides)
         config['resources'] = resources
         name = _cluster_name(benchmark, i)
+        log_path = _log_path(name, nonce)
         config.setdefault('envs', {})[
-            callbacks.BENCHMARK_LOG_ENV] = _log_path(name)
+            callbacks.BENCHMARK_LOG_ENV] = log_path
         candidate_task = task_lib.Task.from_yaml_config(config)
         clusters.append(name)
-        launch_args.append((candidate_task, name, resources))
+        launch_args.append((candidate_task, name, resources, log_path))
 
     def _launch_one(args):
-        candidate_task, name, resources = args
+        candidate_task, name, resources, log_path = args
+        started = time.time()
         job_id, _ = sky.launch(candidate_task, cluster_name=name,
                                detach_run=detach, stream_logs=False,
                                quiet_optimizer=True)
-        bench_state.add_run(benchmark, name, resources, job_id)
+        bench_state.add_run(benchmark, name, resources, job_id,
+                            started_at=started, log_path=log_path)
         return name
 
     # Register the benchmark row only once at least one candidate is
@@ -85,18 +91,18 @@ def launch(task, candidates: List[Dict[str, Any]], benchmark: str,
     return clusters
 
 
-def _fetch_step_records(cluster: str) -> List[Dict[str, Any]]:
+def _fetch_step_records(run: Dict[str, Any]) -> List[Dict[str, Any]]:
     from skypilot_tpu import global_user_state
     from skypilot_tpu.backend import tpu_gang_backend
-    record = global_user_state.get_cluster_from_name(cluster)
-    if record is None:
+    record = global_user_state.get_cluster_from_name(run['cluster'])
+    if record is None or not run.get('log_path'):
         return []
     backend = tpu_gang_backend.TpuGangBackend()
     # No shlex.quote: the path starts with ~ which must tilde-expand,
     # and _log_path emits no shell metacharacters.
     code, out, _ = backend.run_on_head(
         record['handle'],
-        f'cat {_log_path(cluster)} 2>/dev/null || true',
+        f'cat {run["log_path"]} 2>/dev/null || true',
         stream_logs=False, require_outputs=True)
     if code != 0:
         return []
@@ -121,7 +127,11 @@ def status(benchmark: str) -> List[Dict[str, Any]]:
             f'{bench_state.get_benchmarks()}')
     results = []
     for run in runs:
-        records = _fetch_step_records(run['cluster'])
+        # The step log appends across launches of the same cluster
+        # name; only records from THIS run (>= launch start) count.
+        t0 = run.get('launched_at') or 0
+        records = [r for r in _fetch_step_records(run)
+                   if r.get('ts', 0) >= t0]
         entry: Dict[str, Any] = {
             'cluster': run['cluster'],
             'resources': run['resources'],
@@ -129,7 +139,13 @@ def status(benchmark: str) -> List[Dict[str, Any]]:
             'secs_per_step': None,
             'dollars_per_step': None,
             'steps_per_sec': None,
+            # Half the BASELINE north star: launch-call start to the
+            # workload's first step callback.
+            'provision_to_first_step': None,
         }
+        if records and run.get('launched_at'):
+            entry['provision_to_first_step'] = (
+                min(r['ts'] for r in records) - run['launched_at'])
         if len(records) >= 2:
             ts = sorted(r['ts'] for r in records)
             deltas = [b - a for a, b in zip(ts, ts[1:]) if b > a]
@@ -165,7 +181,7 @@ def wait_for_steps(benchmark: str, min_steps: int,
     """Block until every candidate logged >= min_steps (tests/CI)."""
     deadline = time.time() + timeout
     while time.time() < deadline:
-        counts = [len(_fetch_step_records(r['cluster']))
+        counts = [len(_fetch_step_records(r))
                   for r in bench_state.get_runs(benchmark)]
         if counts and all(c >= min_steps for c in counts):
             return True

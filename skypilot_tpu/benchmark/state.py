@@ -22,6 +22,7 @@ CREATE TABLE IF NOT EXISTS benchmark_runs (
     resources_json TEXT,
     job_id INTEGER,
     launched_at REAL,
+    log_path TEXT,
     PRIMARY KEY (benchmark, cluster)
 );
 """
@@ -37,6 +38,11 @@ def _conn() -> sqlite3.Connection:
         return cached
     conn = sqlite3.connect(path, timeout=10.0)
     conn.executescript(_CREATE_TABLES)
+    try:  # migrate pre-log_path DBs
+        conn.execute(
+            'ALTER TABLE benchmark_runs ADD COLUMN log_path TEXT')
+    except sqlite3.OperationalError:
+        pass
     conn.commit()
     _conn_local.conn = conn
     _conn_local.path = path
@@ -52,11 +58,17 @@ def add_benchmark(name: str, task_yaml: str) -> None:
 
 
 def add_run(benchmark: str, cluster: str, resources: Dict[str, Any],
-            job_id: Optional[int]) -> None:
+            job_id: Optional[int],
+            started_at: Optional[float] = None,
+            log_path: Optional[str] = None) -> None:
+    """started_at: when the LAUNCH began (not when it returned), so
+    provision-to-first-step latency can be derived from step logs."""
     conn = _conn()
     conn.execute(
-        'INSERT OR REPLACE INTO benchmark_runs VALUES (?, ?, ?, ?, ?)',
-        (benchmark, cluster, json.dumps(resources), job_id, time.time()))
+        'INSERT OR REPLACE INTO benchmark_runs VALUES (?, ?, ?, ?, ?, ?)',
+        (benchmark, cluster, json.dumps(resources), job_id,
+         started_at if started_at is not None else time.time(),
+         log_path))
     conn.commit()
 
 
@@ -67,11 +79,12 @@ def get_benchmarks() -> List[str]:
 
 def get_runs(benchmark: str) -> List[Dict[str, Any]]:
     rows = _conn().execute(
-        'SELECT cluster, resources_json, job_id, launched_at '
+        'SELECT cluster, resources_json, job_id, launched_at, log_path '
         'FROM benchmark_runs WHERE benchmark = ? ORDER BY cluster',
         (benchmark,)).fetchall()
     return [{'cluster': c, 'resources': json.loads(r), 'job_id': j,
-             'launched_at': t} for c, r, j, t in rows]
+             'launched_at': t, 'log_path': p}
+            for c, r, j, t, p in rows]
 
 
 def delete_benchmark(name: str) -> None:

@@ -16,6 +16,7 @@ the rest.  Two paths here:
 from __future__ import annotations
 
 import subprocess
+import sys
 import time
 from typing import Any, Dict, Optional
 
@@ -49,12 +50,22 @@ def transfer(src_url: str, dst_url: str) -> None:
     """
     cmd = transfer_command(src_url, dst_url)
     logger.info(f'Transferring {src_url} -> {dst_url} ...')
-    proc = subprocess.run(cmd, capture_output=True, text=True,
-                          check=False)
-    if proc.returncode != 0:
+    # Stream output (a multi-TB rsync runs for hours; buffering it all
+    # would look hung and hold the log in memory), keep a stderr tail
+    # for the error message.
+    proc = subprocess.Popen(cmd, stdout=None,
+                            stderr=subprocess.PIPE, text=True)
+    tail: list = []
+    assert proc.stderr is not None
+    for line in proc.stderr:
+        sys.stderr.write(line)
+        tail.append(line)
+        if len(tail) > 50:
+            tail.pop(0)
+    if proc.wait() != 0:
         raise exceptions.StorageError(
             f'Transfer {src_url} -> {dst_url} failed: '
-            f'{proc.stderr or proc.stdout}')
+            f'{"".join(tail)[-2000:]}')
 
 
 def s3_to_gcs_via_transfer_service(
@@ -124,7 +135,11 @@ def _local_aws_credentials() -> tuple:
     """(key_id, secret) from the local aws CLI config, or (None, None)."""
     out = []
     for key in ('aws_access_key_id', 'aws_secret_access_key'):
-        proc = subprocess.run(['aws', 'configure', 'get', key],
-                              capture_output=True, text=True, check=False)
+        try:
+            proc = subprocess.run(['aws', 'configure', 'get', key],
+                                  capture_output=True, text=True,
+                                  check=False)
+        except (FileNotFoundError, OSError):
+            return (None, None)
         out.append(proc.stdout.strip() if proc.returncode == 0 else None)
     return tuple(out)

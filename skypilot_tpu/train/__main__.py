@@ -32,8 +32,22 @@ def main() -> None:
     parser.add_argument('--tokenizer', default=None)
     parser.add_argument('--log-every', type=int, default=10)
     parser.add_argument('--json-metrics', action='store_true',
-                        help='Print final metrics as one JSON line.')
+                        help='Print final metrics as one JSON line '
+                             '(adds params/device info for benchmark '
+                             'normalization).')
+    parser.add_argument('--model-overrides', default=None,
+                        help='JSON dict of model-config overrides, '
+                             "e.g. '{\"dim\": 1536, \"n_layers\": 12}'")
     args = parser.parse_args()
+
+    # Honor an explicit JAX_PLATFORMS even when the interpreter's
+    # sitecustomize captured a different platform at startup (this
+    # environment pins 'axon'); same recipe as tests/conftest.py.
+    import os
+    plat = os.environ.get('JAX_PLATFORMS')
+    if plat and ',' not in plat:
+        import jax
+        jax.config.update('jax_platforms', plat)
 
     from skypilot_tpu.train import launcher
     launcher.maybe_initialize_distributed()
@@ -47,6 +61,9 @@ def main() -> None:
         if part:
             k, v = part.split('=')
             mesh_kwargs[k] = int(v)
+    overrides = {'max_seq_len': args.seq_len}
+    if args.model_overrides:
+        overrides.update(json.loads(args.model_overrides))
     config = trainer_lib.TrainConfig(
         model=args.model,
         global_batch_size=args.global_batch_size,
@@ -56,7 +73,7 @@ def main() -> None:
         total_steps=args.steps,
         mesh=mesh_lib.MeshConfig(**mesh_kwargs),
         pipeline_microbatches=args.pipeline_microbatches,
-        model_overrides={'max_seq_len': args.seq_len},
+        model_overrides=overrides,
     )
     trainer = trainer_lib.Trainer(config)
     manager = None
@@ -88,7 +105,23 @@ def main() -> None:
         from skypilot_tpu.train import checkpoint as ckpt_lib
         ckpt_lib.save(manager, trainer.state, wait=True)
     if args.json_metrics:
-        print(json.dumps(metrics))
+        import jax
+
+        from skypilot_tpu.models import llama
+        metrics = dict(metrics)
+        try:
+            n_params = llama.num_params(trainer.model_config)
+        except (TypeError, AttributeError):
+            n_params = sum(
+                x.size for x in jax.tree.leaves(trainer.state.params))
+        metrics.update({
+            'n_params': n_params,
+            'n_devices': len(jax.devices()),
+            'device_kind': jax.devices()[0].device_kind,
+            'global_batch_size': config.global_batch_size,
+            'seq_len': config.seq_len,
+        })
+        print('SKYTPU_METRICS ' + json.dumps(metrics), flush=True)
 
 
 if __name__ == '__main__':

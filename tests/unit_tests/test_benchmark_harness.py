@@ -49,12 +49,40 @@ class TestBenchmarkHarness:
             assert r['num_steps'] >= 5
             assert r['secs_per_step'] is not None
             assert 0 < r['secs_per_step'] < 10
+            # Half the BASELINE north star: launch start -> first step.
+            assert r['provision_to_first_step'] is not None
+            assert 0 < r['provision_to_first_step'] < 120
         harness.down('unittest')
         assert bench_state.get_runs('unittest') == []
 
     def test_unknown_benchmark(self):
         with pytest.raises(exceptions.BenchmarkError):
             harness.status('nope')
+
+
+class TestBenchE2E:
+
+    def test_bench_py_through_launch(self, monkeypatch, capsys):
+        """bench.py's default mode drives sky launch -> agent -> gang
+        driver -> trainer and reports throughput + provision-to-first-
+        step from the step log (tiny shapes on CPU)."""
+        import importlib.util
+        import os
+        bench_path = os.path.join(os.path.dirname(__file__), '..',
+                                  '..', 'bench.py')
+        spec = importlib.util.spec_from_file_location(
+            'bench', bench_path)
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        monkeypatch.setenv('SKYTPU_BENCH_TINY', '1')
+        bench.run_through_launch(steps_arg=3)
+        out = capsys.readouterr().out
+        import json
+        line = json.loads(
+            [l for l in out.splitlines() if l.startswith('{')][0])
+        assert line['value'] > 0
+        assert 'seq256' in line['metric']
+        assert line['provision_to_first_step_s'] > 0
 
 
 class TestBenchmarkLogger:

@@ -163,11 +163,24 @@ class TestTransfer:
         with pytest.raises(exceptions.StorageSourceError):
             data_transfer.transfer_command('https://x', 'gs://a')
 
-    def test_transfer_runs_and_raises_on_failure(self, cli):
+    def test_transfer_runs_and_raises_on_failure(self, monkeypatch):
+        calls = []
+        state = {'rc': 0, 'stderr': ''}
+
+        class FakePopen:
+            def __init__(self, cmd, **kwargs):
+                calls.append(cmd)
+                import io
+                self.stderr = io.StringIO(state['stderr'])
+
+            def wait(self):
+                return state['rc']
+
+        monkeypatch.setattr(subprocess, 'Popen', FakePopen)
         data_transfer.transfer('gs://a', 's3://b')
-        assert cli.calls
-        cli.returncode = 1
-        cli.stderr = 'boom'
+        assert calls
+        state['rc'] = 1
+        state['stderr'] = 'boom\n'
         with pytest.raises(exceptions.StorageError, match='boom'):
             data_transfer.transfer('gs://a', 's3://b')
 
