@@ -206,7 +206,12 @@ def _finish_through_launch(sky, cluster, job_id, handle, step_log,
         if 'SKYTPU_METRICS ' in line:
             metrics = json.loads(
                 line.split('SKYTPU_METRICS ', 1)[1])
-    assert metrics, f'no metrics line in {log_path}'
+    if not metrics:
+        print(json.dumps({'metric': 'bench-e2e', 'value': 0,
+                          'unit': 'error', 'vs_baseline': 0,
+                          'error': f'no metrics line in {log_path}'}))
+        print(log[-2000:], file=sys.stderr)
+        return
     first_step_ts = None
     if os.path.exists(step_log):
         with open(step_log, encoding='utf-8') as f:

@@ -127,11 +127,10 @@ def status(benchmark: str) -> List[Dict[str, Any]]:
             f'{bench_state.get_benchmarks()}')
     results = []
     for run in runs:
-        # The step log appends across launches of the same cluster
-        # name; only records from THIS run (>= launch start) count.
-        t0 = run.get('launched_at') or 0
-        records = [r for r in _fetch_step_records(run)
-                   if r.get('ts', 0) >= t0]
+        # Records from other launches are excluded by the per-launch
+        # nonce in the log path; no wall-clock filter (cluster clocks
+        # may be skewed vs this client).
+        records = _fetch_step_records(run)
         entry: Dict[str, Any] = {
             'cluster': run['cluster'],
             'resources': run['resources'],

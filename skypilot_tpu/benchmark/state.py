@@ -38,11 +38,11 @@ def _conn() -> sqlite3.Connection:
         return cached
     conn = sqlite3.connect(path, timeout=10.0)
     conn.executescript(_CREATE_TABLES)
-    try:  # migrate pre-log_path DBs
+    cols = {r[1] for r in conn.execute(
+        'PRAGMA table_info(benchmark_runs)')}
+    if 'log_path' not in cols:  # migrate pre-log_path DBs
         conn.execute(
             'ALTER TABLE benchmark_runs ADD COLUMN log_path TEXT')
-    except sqlite3.OperationalError:
-        pass
     conn.commit()
     _conn_local.conn = conn
     _conn_local.path = path

@@ -540,6 +540,21 @@ def jobs_logs(job_id, name, follow, controller) -> None:
         click.echo(out)
 
 
+@jobs.command(name='dashboard')
+@click.option('--host', default='127.0.0.1', show_default=True)
+@click.option('--port', '-p', default=None, type=int,
+              help='Port to serve on (default 5050).')
+def jobs_dashboard(host, port) -> None:
+    """Serve the managed-jobs web dashboard (reference cli.py:3934)."""
+    from skypilot_tpu.jobs import dashboard
+    port = port if port is not None else dashboard.DEFAULT_PORT
+    click.echo(f'Jobs dashboard: http://{host}:{port} (Ctrl-C to stop)')
+    try:
+        dashboard.serve_forever(host, port)
+    except KeyboardInterrupt:
+        pass
+
+
 @cli.group()
 def serve() -> None:
     """SkyServe-style multi-replica serving."""

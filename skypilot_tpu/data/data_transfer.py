@@ -51,13 +51,14 @@ def transfer(src_url: str, dst_url: str) -> None:
     cmd = transfer_command(src_url, dst_url)
     logger.info(f'Transferring {src_url} -> {dst_url} ...')
     # Stream output (a multi-TB rsync runs for hours; buffering it all
-    # would look hung and hold the log in memory), keep a stderr tail
-    # for the error message.
-    proc = subprocess.Popen(cmd, stdout=None,
-                            stderr=subprocess.PIPE, text=True)
+    # would look hung and hold the log in memory), keep a tail for the
+    # error message.  stdout is merged into the stream: some transfer
+    # tools report errors there, and callers' stdout stays clean.
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
     tail: list = []
-    assert proc.stderr is not None
-    for line in proc.stderr:
+    assert proc.stdout is not None
+    for line in proc.stdout:
         sys.stderr.write(line)
         tail.append(line)
         if len(tail) > 50:

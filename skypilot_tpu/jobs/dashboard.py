@@ -1,0 +1,223 @@
+"""Managed-jobs dashboard: a zero-dependency HTTP view of the job queue.
+
+Counterpart of the reference's sky/jobs/dashboard/dashboard.py (a Flask
+app + Jinja template served from the jobs controller, reached over SSH
+port-forwarding via `sky jobs dashboard`, cli.py:3934).  Redesigned on
+the stdlib: a ThreadingHTTPServer renders the same jobs table plus a
+JSON API, so the dashboard works identically on a laptop, on a
+self-hosted controller VM, or inside a test — no Flask, no template
+directory to ship with the runtime rsync.
+
+Routes:
+  GET /              HTML page (auto-refreshing jobs table).
+  GET /api/jobs      JSON list of (job, task) rows.
+  GET /api/jobs/<id> JSON job detail: info + tasks + recent events.
+  GET /healthz       liveness probe.
+"""
+from __future__ import annotations
+
+import html
+import http.server
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.jobs import state as jobs_state
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_PORT = 5050
+
+
+def _jsonable(row: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, (jobs_state.ManagedJobStatus,
+                          jobs_state.ScheduleState)):
+            v = v.value
+        out[k] = v
+    return out
+
+
+def jobs_snapshot() -> List[Dict[str, Any]]:
+    return [_jsonable(r) for r in jobs_state.get_managed_jobs()]
+
+
+def job_detail(job_id: int) -> Optional[Dict[str, Any]]:
+    info = jobs_state.get_job_info(job_id)
+    if info is None:
+        return None
+    events: List[Dict[str, Any]] = []
+    try:
+        with open(jobs_state.controller_log_path(job_id),
+                  encoding='utf-8') as f:
+            for line in f.readlines()[-200:]:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    events.append({'raw': line.rstrip()})
+    except OSError:
+        pass
+    return {
+        'info': _jsonable(info),
+        'tasks': [_jsonable(t) for t in jobs_state.get_job_tasks(job_id)],
+        'events': events,
+    }
+
+
+def _fmt_ts(ts: Optional[float]) -> str:
+    if not ts:
+        return '-'
+    return time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(ts))
+
+
+def _fmt_dur(sec: Optional[float]) -> str:
+    if sec is None:
+        return '-'
+    sec = int(sec)
+    h, rem = divmod(sec, 3600)
+    m, s = divmod(rem, 60)
+    return f'{h}h {m}m {s}s' if h else (f'{m}m {s}s' if m else f'{s}s')
+
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>Managed jobs</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2em; color: #222; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ text-align: left; padding: 6px 10px;
+           border-bottom: 1px solid #ddd; font-size: 14px; }}
+ th {{ background: #f5f5f5; }}
+ .SUCCEEDED {{ color: #1a7f37; }} .RUNNING {{ color: #0969da; }}
+ .RECOVERING, .STARTING, .PENDING, .SUBMITTED {{ color: #9a6700; }}
+ .FAILED, .FAILED_SETUP, .FAILED_PRECHECKS, .FAILED_NO_RESOURCE,
+ .FAILED_CONTROLLER {{ color: #cf222e; }}
+ .CANCELLED, .CANCELLING {{ color: #6e7781; }}
+ #meta {{ color: #6e7781; font-size: 13px; margin-bottom: 1em; }}
+</style></head>
+<body>
+<h2>Managed jobs</h2>
+<div id="meta">auto-refreshing every 5s</div>
+<table id="jobs"><thead><tr>
+<th>ID</th><th>Task</th><th>Name</th><th>Resources</th><th>Submitted</th>
+<th>Duration</th><th>Status</th><th>Cluster</th><th>#Recoveries</th>
+<th>Failure</th></tr></thead><tbody>{rows}</tbody></table>
+<script>
+async function refresh() {{
+  try {{
+    const r = await fetch('/api/jobs');
+    const jobs = await r.json();
+    const tb = document.querySelector('#jobs tbody');
+    tb.innerHTML = jobs.map(j => `<tr>
+      <td>${{j.job_id}}</td><td>${{j.task_id}}</td>
+      <td>${{j.job_name ?? j.task_name ?? '-'}}</td>
+      <td>${{j.resources_str ?? '-'}}</td>
+      <td>${{j.submitted_at ? new Date(j.submitted_at*1000)
+             .toLocaleString() : '-'}}</td>
+      <td>${{j.job_duration != null ? Math.round(j.job_duration)+'s'
+             : '-'}}</td>
+      <td class="${{j.status}}">${{j.status}}</td>
+      <td>${{j.cluster_name ?? '-'}}</td>
+      <td>${{j.recovery_count ?? 0}}</td>
+      <td>${{j.failure_reason ?? ''}}</td></tr>`).join('');
+    document.querySelector('#meta').textContent =
+      jobs.length + ' jobs · refreshed ' + new Date().toLocaleTimeString();
+  }} catch (e) {{ /* controller restarting; retry next tick */ }}
+}}
+refresh(); setInterval(refresh, 5000);
+</script>
+</body></html>
+"""
+
+
+def render_index() -> str:
+    rows = []
+    for j in jobs_snapshot():
+        status = j['status']
+        rows.append(
+            '<tr>' + ''.join(
+                f'<td{cls}>{html.escape(str(v))}</td>'
+                for v, cls in [
+                    (j['job_id'], ''), (j['task_id'], ''),
+                    (j.get('job_name') or j.get('task_name') or '-', ''),
+                    (j.get('resources_str') or '-', ''),
+                    (_fmt_ts(j.get('submitted_at')), ''),
+                    (_fmt_dur(j.get('job_duration')), ''),
+                    (status, f' class="{status}"'),
+                    (j.get('cluster_name') or '-', ''),
+                    (j.get('recovery_count') or 0, ''),
+                    (j.get('failure_reason') or '', ''),
+                ]) + '</tr>')
+    return _PAGE.format(rows=''.join(rows))
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        logger.debug('dashboard: ' + fmt % args)
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header('Content-Type', ctype)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, obj: Any) -> None:
+        self._send(code, json.dumps(obj).encode(), 'application/json')
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
+        path = self.path.split('?', 1)[0].rstrip('/') or '/'
+        try:
+            if path == '/':
+                self._send(200, render_index().encode(), 'text/html')
+            elif path == '/healthz':
+                self._json(200, {'ok': True})
+            elif path == '/api/jobs':
+                self._json(200, jobs_snapshot())
+            elif path.startswith('/api/jobs/'):
+                try:
+                    job_id = int(path.rsplit('/', 1)[1])
+                except ValueError:
+                    self._json(400, {'error': 'bad job id'})
+                    return
+                detail = job_detail(job_id)
+                if detail is None:
+                    self._json(404, {'error': f'no such job {job_id}'})
+                else:
+                    self._json(200, detail)
+            else:
+                self._json(404, {'error': 'not found'})
+        except BrokenPipeError:
+            pass
+
+
+def start(host: str = '127.0.0.1',
+          port: int = DEFAULT_PORT
+          ) -> Tuple[http.server.ThreadingHTTPServer, threading.Thread]:
+    """Start the dashboard in a daemon thread; returns (server, thread).
+
+    Callers own shutdown: `server.shutdown(); server.server_close()`.
+    Pass port=0 to bind an ephemeral port (tests); the bound port is
+    `server.server_address[1]`.
+    """
+    server = http.server.ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever,
+                              name='jobs-dashboard', daemon=True)
+    thread.start()
+    logger.info('Jobs dashboard at http://%s:%d',
+                host, server.server_address[1])
+    return server, thread
+
+
+def serve_forever(host: str = '127.0.0.1',
+                  port: int = DEFAULT_PORT) -> None:
+    server, thread = start(host, port)
+    try:
+        thread.join()
+    finally:
+        server.shutdown()
+        server.server_close()

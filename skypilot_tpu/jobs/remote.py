@@ -189,6 +189,21 @@ def _serve_dag(dag_path: str, name: Optional[str]) -> None:
     """Register + run the managed job inline; the surrounding agent job
     is the controller process (its liveness IS controller liveness)."""
     from skypilot_tpu.jobs import core as jobs_core
+    # Reference parity: the controller VM also serves the jobs dashboard
+    # (systemd unit in jobs-controller.yaml.j2); here it rides the
+    # controller process itself, reachable over SSH port-forwarding.
+    dash_port = os.environ.get('SKYTPU_JOBS_DASHBOARD_PORT')
+    if dash_port:
+        try:
+            from skypilot_tpu.jobs import dashboard
+            dashboard.start(
+                os.environ.get('SKYTPU_JOBS_DASHBOARD_HOST', '127.0.0.1'),
+                int(dash_port))
+        except (OSError, ValueError) as e:
+            # Observability nicety must never fail the managed job
+            # (e.g. EADDRINUSE when a concurrent controller already
+            # serves the dashboard on this host).
+            logger.warning(f'jobs dashboard not started: {e}')
     dag = dag_utils.load_chain_dag_from_yaml(os.path.expanduser(dag_path))
     job_id = jobs_core.launch(dag, name=name, controller_mode='inline')
     from skypilot_tpu.jobs import state as jobs_state

@@ -1,0 +1,90 @@
+"""Jobs dashboard tests: HTML index, JSON API, detail, 404s.
+
+Hermetic analog of the reference's Flask dashboard
+(sky/jobs/dashboard/dashboard.py) — ours is stdlib-served, so the test
+binds an ephemeral port and exercises real HTTP round-trips.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.jobs import dashboard
+from skypilot_tpu.jobs import state as jobs_state
+
+
+@pytest.fixture()
+def _dash():
+    server, thread = dashboard.start(port=0)
+    port = server.server_address[1]
+    yield f'http://127.0.0.1:{port}'
+    server.shutdown()
+    server.server_close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def _seed_jobs():
+    jid1 = jobs_state.set_job_info('train-llama', '/tmp/dag1.yaml')
+    jobs_state.set_pending(jid1, 0, 'train-llama', 'tpu-v5p-8')
+    jobs_state.set_submitted(jid1, 0, 'mj-cluster-1')
+    jobs_state.set_starting(jid1, 0)
+    jobs_state.set_started(jid1, 0, time.time() - 30)
+    jid2 = jobs_state.set_job_info('flaky', '/tmp/dag2.yaml')
+    jobs_state.set_pending(jid2, 0, 'flaky', 'tpu-v6e-4')
+    jobs_state.set_failed(jid2, 0, jobs_state.ManagedJobStatus.FAILED,
+                          'boom & <bust>')
+    jobs_state.append_event(jid1, 'launch', cluster='mj-cluster-1')
+    jobs_state.append_event(jid1, 'recovery', attempt=1)
+    return jid1, jid2
+
+
+class TestDashboardApi:
+
+    def test_healthz(self, _dash):
+        status, body = _get(_dash + '/healthz')
+        assert status == 200 and json.loads(body) == {'ok': True}
+
+    def test_api_jobs_lists_rows(self, _dash):
+        jid1, jid2 = _seed_jobs()
+        _, body = _get(_dash + '/api/jobs')
+        rows = json.loads(body)
+        by_id = {r['job_id']: r for r in rows}
+        assert by_id[jid1]['status'] == 'RUNNING'
+        assert by_id[jid1]['cluster_name'] == 'mj-cluster-1'
+        assert by_id[jid1]['job_duration'] >= 29
+        assert by_id[jid2]['status'] == 'FAILED'
+        assert by_id[jid2]['failure_reason'] == 'boom & <bust>'
+
+    def test_api_job_detail_includes_events(self, _dash):
+        jid1, _ = _seed_jobs()
+        _, body = _get(_dash + f'/api/jobs/{jid1}')
+        detail = json.loads(body)
+        assert detail['info']['name'] == 'train-llama'
+        assert detail['tasks'][0]['resources_str'] == 'tpu-v5p-8'
+        events = [e['event'] for e in detail['events'] if 'event' in e]
+        assert events == ['launch', 'recovery']
+
+    def test_api_job_detail_404(self, _dash):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(_dash + '/api/jobs/9999')
+        assert exc.value.code == 404
+
+    def test_index_renders_escaped_html(self, _dash):
+        _seed_jobs()
+        status, body = _get(_dash + '/')
+        assert status == 200
+        assert 'train-llama' in body
+        # Failure reason must be HTML-escaped.
+        assert 'boom &amp; &lt;bust&gt;' in body
+        assert '<bust>' not in body
+
+    def test_unknown_route_404(self, _dash):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(_dash + '/nope')
+        assert exc.value.code == 404
