@@ -78,6 +78,21 @@ class ClusterHandle:
             return self.head_address[len('local:'):]
         return None
 
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        """Version-aware unpickle: handles written by older clients get
+        defaults for fields added since (reference:
+        CloudVmRayResourceHandle.__setstate__ version upgrades)."""
+        version = state.pop('_handle_version', 0)
+        state.setdefault('ssh_user', None)
+        state.setdefault('ssh_key', None)
+        del version  # no field renames yet; bump _VERSION when needed
+        self.__dict__.update(state)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = dict(self.__dict__)
+        state['_handle_version'] = self._VERSION
+        return state
+
     def update_from_cluster_info(
             self, cluster_info: 'provision_common.ClusterInfo') -> None:
         tuples = cluster_info.ip_tuples()

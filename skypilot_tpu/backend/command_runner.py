@@ -62,7 +62,13 @@ class CommandRunner:
         exports = ''.join(
             f'export {k}={shlex.quote(str(v))}; '
             for k, v in (env_vars or {}).items())
-        cd = f'cd {shlex.quote(cwd)}; ' if cwd else ''
+        if cwd and cwd.startswith('~/'):
+            # '~' must stay outside the quotes to expand remotely.
+            cd = f'cd "$HOME"/{shlex.quote(cwd[2:])}; '
+        elif cwd:
+            cd = f'cd {shlex.quote(cwd)}; '
+        else:
+            cd = ''
         return exports + cd + cmd
 
     @staticmethod
@@ -80,6 +86,80 @@ class CommandRunner:
             return proc.returncode, proc.stdout, proc.stderr
         return proc.returncode
 
+    # -- tar-over-exec sync (shared by k8s + docker runners) --------------
+    def _exec_argv(self, interactive: bool) -> List[str]:
+        """argv prefix that execs `/bin/bash -c <cmd>` in the remote
+        substrate; the shell command is appended as the last element."""
+        raise NotImplementedError
+
+    def _tar_over_exec_rsync(self, source: str, target: str, *, up: bool,
+                             excludes: Optional[List[str]] = None) -> None:
+        """rsync-equivalent file sync streamed through an exec channel
+        (`kubectl exec` / `docker exec`): dirs merge into the target
+        directory; a single file lands AT the target path (rsync
+        semantics, so all runner substrates behave identically).
+        Relative targets are rooted at the remote $HOME."""
+        exclude_args = ' '.join(
+            f'--exclude={shlex.quote(pat)}' for pat in excludes or [])
+
+        def argv_str(interactive: bool, remote_cmd: str) -> str:
+            return ' '.join(
+                shlex.quote(a)
+                for a in self._exec_argv(interactive) + [remote_cmd])
+
+        if up:
+            src = _expand(source)
+            if not target.startswith(('/', '~')):
+                target = f'~/{target}'
+            remote_target = target.replace('~', '$HOME', 1)
+            if os.path.isdir(src):
+                tar_src = f'-C {shlex.quote(src)} .'
+                remote_cmd = (f'mkdir -p "{remote_target}" && '
+                              f'tar xzf - -C "{remote_target}"')
+            else:
+                src_dir, src_base = os.path.split(src)
+                tar_src = (f'-C {shlex.quote(src_dir)} '
+                           f'{shlex.quote(src_base)}')
+                # File destination: the target IS the file path.
+                remote_cmd = (
+                    f'dst="{remote_target}"; '
+                    f'mkdir -p "$(dirname "$dst")" && '
+                    f'tar xzf - -C "$(dirname "$dst")" && '
+                    f'if [ "$(basename "$dst")" != '
+                    f'{shlex.quote(src_base)} ]; then '
+                    f'mv "$(dirname "$dst")/"{shlex.quote(src_base)} '
+                    f'"$dst"; fi')
+            full = (f'tar czf - {exclude_args} {tar_src} | '
+                    + argv_str(True, remote_cmd))
+        else:
+            if not source.startswith(('/', '~')):
+                source = f'~/{source}'
+            remote_src = source.replace('~', '$HOME', 1)
+            src_base = source.rstrip('/').rsplit('/', 1)[-1]
+            dst = _expand(target)
+            # rsync semantics: an existing-dir (or trailing-slash)
+            # target receives the entry under its remote basename; any
+            # other target IS the destination path (renamed).
+            if os.path.isdir(dst) or target.endswith('/'):
+                out_dir, final = dst, None
+            else:
+                out_dir = os.path.dirname(dst) or '.'
+                final = dst
+            os.makedirs(out_dir, exist_ok=True)
+            remote_cmd = (f'cd "$(dirname "{remote_src}")" && '
+                          f'tar czf - "$(basename "{remote_src}")"')
+            full = (argv_str(False, remote_cmd)
+                    + f' | tar xzf - -C {shlex.quote(out_dir)}')
+        proc = subprocess.run(full, shell=True, executable='/bin/bash',
+                              capture_output=True, text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.CommandError(
+                proc.returncode,
+                f'tar-over-exec sync to {self.address}', proc.stderr)
+        if not up and final is not None and \
+                os.path.basename(final) != src_base:
+            os.replace(os.path.join(out_dir, src_base), final)
+
     def check_connection(self) -> bool:
         try:
             rc = self.run('true', timeout=10)
@@ -96,6 +176,8 @@ class CommandRunner:
             return LocalHostRunner(address)
         if address.startswith('k8s:'):
             return KubernetesPodRunner(address)
+        if address.startswith('docker:'):
+            return DockerContainerRunner(address)
         return SSHCommandRunner(address, ssh_user=ssh_user, ssh_key=ssh_key,
                                 port=port)
 
@@ -254,11 +336,15 @@ class KubernetesPodRunner(CommandRunner):
         args += ['--namespace', self.namespace]
         return args
 
+    def _exec_argv(self, interactive: bool) -> List[str]:
+        return (self._base() + ['exec']
+                + (['-i'] if interactive else [])
+                + [self.pod, '--', '/bin/bash', '-c'])
+
     def run(self, cmd, *, env_vars=None, require_outputs=False,
             log_path='/dev/null', stream_logs=False, cwd=None,
             timeout=None):
-        full = self._base() + [
-            'exec', self.pod, '--', '/bin/bash', '-c',
+        full = self._exec_argv(False) + [
             self._shell_command(cmd, env_vars, cwd)]
         proc = subprocess.run(full, capture_output=True, text=True,
                               timeout=timeout, check=False)
@@ -269,41 +355,42 @@ class KubernetesPodRunner(CommandRunner):
         """Tar streamed through `kubectl exec` (NOT kubectl cp: cp
         neither expands '~' in pod paths nor supports excludes, and the
         backend syncs to ~-prefixed targets with gitignore excludes)."""
-        exclude_args = ' '.join(
-            f'--exclude={shlex.quote(pat)}' for pat in excludes or [])
-        if up:
-            src = _expand(source)
-            if os.path.isdir(src):
-                tar_src = f'-C {shlex.quote(src)} .'
-            else:
-                tar_src = (f'-C {shlex.quote(os.path.dirname(src))} '
-                           f'{shlex.quote(os.path.basename(src))}')
-            # $HOME expands inside the pod's bash.
-            remote_dir = target.replace('~', '$HOME', 1)
-            local_cmd = f'tar czf - {exclude_args} {tar_src}'
-            remote_cmd = (f'mkdir -p "{remote_dir}" && '
-                          f'tar xzf - -C "{remote_dir}"')
-            full = (f'{local_cmd} | ' + ' '.join(
-                shlex.quote(a) for a in self._base() +
-                ['exec', '-i', self.pod, '--', '/bin/bash', '-c',
-                 remote_cmd]))
-        else:
-            remote_src = source.replace('~', '$HOME', 1)
-            dst = _expand(target)
-            os.makedirs(dst if not os.path.splitext(dst)[1] else
-                        os.path.dirname(dst), exist_ok=True)
-            remote_cmd = (f'cd "$(dirname "{remote_src}")" && '
-                          f'tar czf - "$(basename "{remote_src}")"')
-            full = (' '.join(shlex.quote(a) for a in self._base() +
-                             ['exec', self.pod, '--', '/bin/bash', '-c',
-                              remote_cmd]) +
-                    f' | tar xzf - -C {shlex.quote(dst)}')
-        proc = subprocess.run(full, shell=True, executable='/bin/bash',
-                              capture_output=True, text=True, check=False)
-        if proc.returncode != 0:
-            raise exceptions.CommandError(
-                proc.returncode, f'tar-over-exec sync to {self.pod}',
-                proc.stderr)
+        self._tar_over_exec_rsync(source, target, up=up,
+                                  excludes=excludes)
+
+
+class DockerContainerRunner(CommandRunner):
+    """`docker exec`-based runner for local containers (reference:
+    sky/backends/docker_utils.py + DOCKER_IMAGE feature, cloud.py:29-50).
+
+    Address scheme: 'docker:<container>'.  File sync streams tar
+    through `docker exec -i`, mirroring the Kubernetes runner, so '~'
+    targets and excludes behave identically across substrates.
+    """
+
+    def __init__(self, address: str) -> None:
+        super().__init__(address)
+        assert address.startswith('docker:'), address
+        self.container = address[len('docker:'):]
+
+    def _exec_argv(self, interactive: bool) -> List[str]:
+        return (['docker', 'exec']
+                + (['-i'] if interactive else [])
+                + [self.container, '/bin/bash', '-c'])
+
+    def run(self, cmd, *, env_vars=None, require_outputs=False,
+            log_path='/dev/null', stream_logs=False, cwd=None,
+            timeout=None):
+        full = self._exec_argv(False) + [
+            self._shell_command(cmd, env_vars, cwd)]
+        proc = subprocess.run(full, capture_output=True, text=True,
+                              timeout=timeout, check=False)
+        return self._finish(proc, log_path, stream_logs, require_outputs)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes=None):
+        self._tar_over_exec_rsync(source, target, up=up,
+                                  excludes=excludes)
 
 
 def workdir_excludes(source_dir: str) -> List[str]:

@@ -1,0 +1,271 @@
+"""Local-docker backend: run tasks in containers on this machine.
+
+Counterpart of the reference's sky/backends/local_docker_backend.py
+(646 LoC with docker_utils.py): an alternate `Backend` that "provisions"
+a local container instead of a cloud cluster — the zero-cloud dev loop
+for task images.  Parity notes, same as the reference's documented
+limitations: no job queue/autostop (execute is blocking or detached via
+nohup inside the container), one node.
+
+The container substrate is driven entirely through the `docker` CLI
+(DockerContainerRunner) so tests can shim a fake `docker` on PATH; no
+docker SDK dependency.
+
+Resources opt in with image_id='docker:<image>' (the DOCKER_IMAGE
+feature flag, reference cloud.py:29-50).
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu.backend import backend as backend_lib
+from skypilot_tpu.backend import command_runner
+from skypilot_tpu.utils import paths
+
+logger = sky_logging.init_logger(__name__)
+
+_CONTAINER_PREFIX = 'skytpu-docker-'
+_LABEL = 'skytpu.cluster'
+DEFAULT_IMAGE = 'ubuntu:22.04'
+
+
+def docker_image_from_resources(
+        resources: Optional[resources_lib.Resources]) -> Optional[str]:
+    """The explicitly requested image, or None (no preference — a
+    relaunch onto an existing container keeps whatever it runs)."""
+    image_id = getattr(resources, 'image_id', None) if resources else None
+    if image_id and image_id.startswith('docker:'):
+        return image_id[len('docker:'):]
+    return None
+
+
+def container_name(cluster_name: str) -> str:
+    return _CONTAINER_PREFIX + cluster_name
+
+
+def _docker(*args: str, check: bool = True,
+            timeout: Optional[float] = 600) -> 'subprocess.CompletedProcess':
+    proc = subprocess.run(['docker', *args], capture_output=True,
+                          text=True, timeout=timeout, check=False)
+    if check and proc.returncode != 0:
+        raise exceptions.CommandError(
+            proc.returncode, 'docker ' + ' '.join(args), proc.stderr)
+    return proc
+
+
+def docker_available() -> bool:
+    if shutil.which('docker') is None:
+        return False
+    try:
+        return _docker('version', '--format', '{{.Server.Os}}',
+                       check=False, timeout=10).returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+class LocalDockerBackend(backend_lib.Backend):
+    """Reference LocalDockerBackend redone over the docker CLI."""
+
+    NAME = 'local_docker'
+
+    def _runner(self,
+                handle: backend_lib.ClusterHandle
+                ) -> command_runner.DockerContainerRunner:
+        runner = command_runner.CommandRunner.from_address(
+            handle.head_address)
+        assert isinstance(runner, command_runner.DockerContainerRunner)
+        return runner
+
+    # -- lifecycle ---------------------------------------------------------
+    def _provision(self, task, to_provision, dryrun, stream_logs,
+                   cluster_name, retry_until_up):
+        if task.num_nodes != 1:
+            raise exceptions.NotSupportedError(
+                'local_docker backend is single-node (reference '
+                'local_docker_backend.py limitation).')
+        requested = docker_image_from_resources(to_provision)
+        image = requested or DEFAULT_IMAGE
+        if dryrun:
+            logger.info(f'Dryrun: would run container {image!r} as '
+                        f'{container_name(cluster_name)!r}.')
+            return None
+        if not docker_available():
+            raise exceptions.ProvisionError(
+                'docker CLI not found or daemon unreachable; the '
+                'local_docker backend needs a working `docker`.')
+        name = container_name(cluster_name)
+        # Idempotent relaunch: a running container is reused unless a
+        # *different* image was explicitly requested (no request — e.g.
+        # the optimizer was skipped because the cluster is UP — never
+        # destroys container state).
+        existing = _docker('ps', '-a', '--filter', f'name=^{name}$',
+                           '--format', '{{.Image}} {{.State}}',
+                           check=False).stdout.strip()
+        if existing:
+            ex_image, _, state = existing.partition(' ')
+            state = state.strip()
+            if requested is not None and requested != ex_image:
+                _docker('rm', '-f', name, check=False)
+                existing = ''
+            elif state == 'running':
+                logger.info(f'Reusing running container {name!r}.')
+                image = ex_image
+            else:
+                # `sky start` of a stopped container: restart in place,
+                # preserving container state (docker analog of
+                # resume_stopped_nodes).
+                _docker('start', name)
+                image = ex_image
+        if not existing:
+            _docker('run', '-d', '--name', name,
+                    '--label', f'{_LABEL}={cluster_name}',
+                    image, 'sleep', 'infinity')
+            # The run/setup cwd must exist even when no workdir is
+            # synced.
+            _docker('exec', name, '/bin/bash', '-c',
+                    'mkdir -p ~/sky_workdir')
+        handle = backend_lib.ClusterHandle(
+            cluster_name=cluster_name,
+            cluster_name_on_cloud=name,
+            provider_name='local_docker',
+            provider_config={'image': image},
+            launched_nodes=1,
+            launched_resources=(to_provision or
+                                resources_lib.Resources(cloud='local')),
+            host_addresses=[f'docker:{name}'],
+            internal_ips=['127.0.0.1'],
+        )
+        global_user_state.add_or_update_cluster(
+            cluster_name, handle, {to_provision} if to_provision else None,
+            ready=True)
+        return handle
+
+    def _sync_workdir(self, handle, workdir):
+        self._runner(handle).rsync(
+            workdir, '~/sky_workdir', up=True,
+            excludes=command_runner.workdir_excludes(workdir))
+
+    def _sync_file_mounts(self, handle, all_file_mounts, storage_mounts):
+        runner = self._runner(handle)
+        for dst, src in (all_file_mounts or {}).items():
+            if not os.path.exists(os.path.expanduser(src)):
+                raise exceptions.CommandError(
+                    1, f'file_mount {dst}',
+                    f'source {src!r} does not exist.')
+            runner.rsync(src, dst, up=True)
+        if storage_mounts:
+            raise exceptions.NotSupportedError(
+                'storage_mounts need FUSE; unsupported inside the '
+                'local_docker backend (reference parity).')
+
+    def _log_path(self, handle: backend_lib.ClusterHandle) -> str:
+        d = os.path.join(paths.logs_dir(), 'docker',
+                         handle.cluster_name)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, 'run.log')
+
+    def _setup(self, handle, task, detach_setup):
+        if not task.setup:
+            return
+        rc = self._runner(handle).run(
+            task.setup, env_vars=task.envs, cwd='~/sky_workdir',
+            log_path=self._log_path(handle), stream_logs=True)
+        if rc != 0:
+            raise exceptions.CommandError(
+                rc, 'task setup (docker)', f'see {self._log_path(handle)}')
+
+    def _execute(self, handle, task, detach_run, dryrun):
+        if dryrun or not task.run:
+            return None
+        env = dict(task.envs or {})
+        # Single-node rank contract, same names the gang driver injects.
+        env.update({'SKYTPU_NODE_RANK': '0', 'SKYTPU_NUM_NODES': '1',
+                    'SKYTPU_NODE_IPS': '127.0.0.1'})
+        runner = self._runner(handle)
+        if detach_run:
+            inner = command_runner.CommandRunner._shell_command(
+                task.run, env, '~/sky_workdir')
+            rc = runner.run(
+                f'nohup bash -c {shlex.quote(inner)} '
+                f'> ~/skytpu_run.log 2>&1 & echo started')
+            if rc != 0:
+                raise exceptions.CommandError(rc, 'detached run (docker)',
+                                              '')
+            return None
+        rc = runner.run(task.run, env_vars=env, cwd='~/sky_workdir',
+                        log_path=self._log_path(handle), stream_logs=True)
+        if rc != 0:
+            raise exceptions.CommandError(
+                rc, 'task run (docker)', f'see {self._log_path(handle)}')
+        return None
+
+    def _teardown(self, handle, terminate, purge):
+        name = handle.cluster_name_on_cloud
+        try:
+            if terminate:
+                _docker('rm', '-f', name, check=False)
+                global_user_state.remove_cluster(handle.cluster_name,
+                                                 terminate=True)
+            else:
+                _docker('stop', name)
+                global_user_state.update_cluster_status(
+                    handle.cluster_name,
+                    global_user_state.ClusterStatus.STOPPED)
+        except exceptions.CommandError:
+            if not purge:
+                raise
+            global_user_state.remove_cluster(handle.cluster_name,
+                                             terminate=True)
+
+    def set_autostop(self, handle, idle_minutes, down=False):
+        raise exceptions.NotSupportedError(
+            'autostop is not supported by the local_docker backend '
+            '(reference parity: local_docker_backend.py has no skylet).')
+
+    # No agent runs in the container, so there is no job queue —
+    # reference parity: LocalDockerBackend has no skylet/job table.
+    def get_job_queue(self, handle):
+        raise exceptions.NotSupportedError(
+            'job queue is not supported by the local_docker backend.')
+
+    def cancel_jobs(self, handle, job_ids=None, all_jobs=False):
+        raise exceptions.NotSupportedError(
+            'job cancel is not supported by the local_docker backend.')
+
+    def tail_logs(self, handle, job_id=None, follow=True, tail=0):
+        raise exceptions.NotSupportedError(
+            'log tailing is not supported by the local_docker backend; '
+            'run logs stream during execute.')
+
+    def get_job_status(self, handle, job_ids=None):
+        raise exceptions.NotSupportedError(
+            'job status is not supported by the local_docker backend.')
+
+    # -- queries -----------------------------------------------------------
+    def query_status(self, handle: backend_lib.ClusterHandle
+                     ) -> Optional[str]:
+        out = _docker('ps', '-a', '--filter',
+                      f'name=^{handle.cluster_name_on_cloud}$',
+                      '--format', '{{.State}}', check=False).stdout.strip()
+        return out or None
+
+    def list_containers(self) -> Dict[str, Any]:
+        out = _docker('ps', '-a', '--filter', f'label={_LABEL}',
+                      '--format',
+                      '{{.Names}}\t{{.Label "skytpu.cluster"}}\t'
+                      '{{.State}}', check=False).stdout
+        result = {}
+        for line in out.splitlines():
+            parts = line.split('\t')
+            if len(parts) == 3:
+                result[parts[1]] = {'container': parts[0],
+                                    'state': parts[2]}
+        return result

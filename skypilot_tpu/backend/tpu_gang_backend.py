@@ -34,6 +34,7 @@ from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.agent import rpc as agent_rpc
 from skypilot_tpu.backend import backend as backend_lib
 from skypilot_tpu.backend import command_runner as runner_lib
+from skypilot_tpu.clouds import cloud as clouds_lib
 from skypilot_tpu.provision import api as provision_api
 from skypilot_tpu.provision import provisioner as provisioner_lib
 from skypilot_tpu.utils import common_utils
@@ -156,15 +157,16 @@ class TpuGangBackend(backend_lib.Backend):
             try:
                 if resume:
                     cloud = to_provision.cloud
+                    region = typing.cast(Any, cloud).regions_with_offering(
+                        None, None, False, to_provision.region,
+                        to_provision.zone)[0]
+                    # Resume pins the recorded zone (stopped instances
+                    # only exist there).
+                    zones = ([clouds_lib.Zone(name=to_provision.zone,
+                                              region=region.name)]
+                             if to_provision.zone else None)
                     result = provisioner_lib.bulk_provision(
-                        cloud,
-                        typing.cast(Any, cloud).regions_with_offering(
-                            None, None, False, to_provision.region,
-                            to_provision.zone)[0],
-                        [
-                            # Reuse recorded zone on resume.
-                            type('Z', (), {'name': to_provision.zone})()
-                        ] if to_provision.zone else None,
+                        cloud, region, zones,
                         cluster_name_on_cloud, task.num_nodes, to_provision,
                         authentication_config=self._authentication_config(
                             cloud),

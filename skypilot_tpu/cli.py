@@ -113,10 +113,13 @@ def cli() -> None:
               help='Autodown after the job (or with -i, after idle).')
 @click.option('--retry-until-up', is_flag=True, default=False)
 @click.option('--yes', '-y', is_flag=True, default=False)
+@click.option('--docker', 'use_docker', is_flag=True, default=False,
+              help='Run in a local docker container instead of a cloud '
+                   'cluster (reference local_docker_backend).')
 @_add_options(_RESOURCE_OPTIONS)
 def launch(entrypoint, cluster, dryrun, detach_run,
            idle_minutes_to_autostop, down, retry_until_up, yes,
-           **overrides) -> None:
+           use_docker, **overrides) -> None:
     """Launch a task (YAML file or inline command) on a new or existing
     cluster."""
     sky = _sky()
@@ -124,11 +127,15 @@ def launch(entrypoint, cluster, dryrun, detach_run,
     if not yes and not dryrun:
         click.confirm(f'Launching task on cluster {cluster or "(new)"}. '
                       'Proceed?', default=True, abort=True)
+    backend = None
+    if use_docker:
+        from skypilot_tpu.backend import docker_backend
+        backend = docker_backend.LocalDockerBackend()
     job_id, handle = sky.launch(
         task, cluster_name=cluster, dryrun=dryrun, down=down,
         detach_run=detach_run,
         idle_minutes_to_autostop=idle_minutes_to_autostop,
-        retry_until_up=retry_until_up)
+        retry_until_up=retry_until_up, backend=backend)
     if handle is not None:
         click.echo(f'Job {job_id} on cluster {handle.cluster_name!r}.')
     if not detach_run and job_id is not None and handle is not None:
@@ -290,15 +297,24 @@ def _show_accelerators(name_filter, include_gpus: bool) -> None:
                     ','.join(item['regions'])))
             elif include_gpus:
                 gpu_rows.append((
-                    name, str(item['instance_type']),
+                    name, 'GCP', str(item['instance_type']),
+                    f"${item['price']:.2f}",
+                    f"${item['spot_price']:.2f}"))
+    if include_gpus:
+        from skypilot_tpu.catalog import aws_catalog
+        aws_inventory = aws_catalog.list_accelerators(name_filter)
+        for name in sorted(aws_inventory):
+            for item in aws_inventory[name]:
+                gpu_rows.append((
+                    name, 'AWS', str(item['instance_type']),
                     f"${item['price']:.2f}",
                     f"${item['spot_price']:.2f}"))
     _print_table(('TPU', 'CHIPS', 'HOSTS', 'HBM_GB', 'BF16_TFLOPS',
                   '$/HR', 'SPOT_$/HR', 'REGIONS'), rows)
     if gpu_rows:
         click.echo()
-        _print_table(('GPU', 'INSTANCE_TYPE', '$/HR', 'SPOT_$/HR'),
-                     gpu_rows)
+        _print_table(('GPU', 'CLOUD', 'INSTANCE_TYPE', '$/HR',
+                      'SPOT_$/HR'), gpu_rows)
 
 
 @cli.command(name='show-tpus')
@@ -340,34 +356,39 @@ def catalog_update(cloud, table, from_file, url, export, reset) -> None:
     """Refresh the local catalog cache (reference: hosted-catalog
     fetch, sky/clouds/service_catalog/common.py)."""
     from skypilot_tpu.catalog import common as catalog_common
-    from skypilot_tpu.catalog import gcp_catalog
-    if cloud != 'gcp':
+    if cloud == 'gcp':
+        from skypilot_tpu.catalog import gcp_catalog as cat
+        tables = ('vms', 'tpu_prices', 'tpu_zones')
+    elif cloud == 'aws':
+        from skypilot_tpu.catalog import aws_catalog as cat
+        tables = ('vms',)
+    else:
         raise click.UsageError(f'Unknown catalog cloud {cloud!r}.')
     if reset:
-        for t in ('vms', 'tpu_prices', 'tpu_zones'):
+        for t in tables:
             if catalog_common.remove_override(cloud, t):
                 click.echo(f'Removed {t} override.')
-        gcp_catalog.reload()
+        cat.reload()
         return
     if export:
-        for t, text in gcp_catalog.export_snapshot().items():
+        for t, text in cat.export_snapshot().items():
             click.echo(
                 f'Wrote {catalog_common.write_catalog_csv(cloud, t, text)}')
-        gcp_catalog.reload()
+        cat.reload()
         return
     if not table or not (from_file or url):
         raise click.UsageError(
             'Provide --table with --from-file or --url, or use '
             '--export / --reset.')
-    if table not in ('vms', 'tpu_prices', 'tpu_zones'):
+    if table not in tables:
         raise click.UsageError(
-            f'Unknown table {table!r}; expected vms, tpu_prices, or '
-            'tpu_zones.')
+            f'Unknown table {table!r} for {cloud}; expected one of '
+            f'{tables}.')
     if from_file:
         path = catalog_common.update_from_file(cloud, table, from_file)
     else:
         path = catalog_common.update_from_url(cloud, table, url)
-    gcp_catalog.reload()
+    cat.reload()
     click.echo(f'Updated {path}')
 
 

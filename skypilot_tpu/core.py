@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import sky_logging
+from skypilot_tpu import usage
 from skypilot_tpu.backend import backend as backend_lib
 from skypilot_tpu.backend import tpu_gang_backend
 from skypilot_tpu.provision import api as provision_api
@@ -25,7 +26,13 @@ logger = sky_logging.init_logger(__name__)
 ClusterStatus = global_user_state.ClusterStatus
 
 
-def _backend() -> tpu_gang_backend.TpuGangBackend:
+def _backend(handle: Optional['backend_lib.ClusterHandle'] = None
+             ) -> 'backend_lib.Backend':
+    """Backend for a cluster handle: gang backend for cloud clusters,
+    the docker backend for locally containerized ones."""
+    if getattr(handle, 'provider_name', None) == 'local_docker':
+        from skypilot_tpu.backend import docker_backend
+        return docker_backend.LocalDockerBackend()
     return tpu_gang_backend.TpuGangBackend()
 
 
@@ -47,6 +54,8 @@ def refresh_cluster_record(cluster_name: str) -> Optional[Dict[str, Any]]:
     if record is None:
         return None
     handle: backend_lib.ClusterHandle = record['handle']
+    if handle.provider_name == 'local_docker':
+        return _refresh_docker_record(cluster_name, record, handle)
     lock = timeline.FileLockEvent(
         f'{paths.locks_dir()}/{cluster_name}.refresh.lock', timeout=20)
     try:
@@ -86,6 +95,27 @@ def refresh_cluster_record(cluster_name: str) -> Optional[Dict[str, Any]]:
         return record
 
 
+def _refresh_docker_record(cluster_name: str, record: Dict[str, Any],
+                           handle: 'backend_lib.ClusterHandle'
+                           ) -> Optional[Dict[str, Any]]:
+    """Docker-substrate reconciliation: container state is cloud truth."""
+    from skypilot_tpu.backend import docker_backend
+    backend = docker_backend.LocalDockerBackend()
+    if not docker_backend.docker_available():
+        return record  # can't query; keep cached status
+    state = backend.query_status(handle)
+    if state is None:
+        global_user_state.remove_cluster(cluster_name, terminate=True)
+        return None
+    new_status = (ClusterStatus.UP if state == 'running'
+                  else ClusterStatus.STOPPED)
+    if new_status != record['status']:
+        global_user_state.update_cluster_status(cluster_name, new_status)
+        record = global_user_state.get_cluster_from_name(cluster_name)
+    return record
+
+
+@usage.entrypoint('sky.status')
 def status(cluster_names: Optional[List[str]] = None,
            refresh: bool = False) -> List[Dict[str, Any]]:
     """Cluster records, optionally reconciled against cloud truth
@@ -107,6 +137,7 @@ def status(cluster_names: Optional[List[str]] = None,
 # ---------------------------------------------------------------------------
 # lifecycle
 # ---------------------------------------------------------------------------
+@usage.entrypoint('sky.start')
 def start(cluster_name: str, retry_until_up: bool = False) -> None:
     """Restart a STOPPED cluster (reference core.start; provisioner
     resume_stopped_nodes, provision/provisioner.py:131)."""
@@ -120,58 +151,66 @@ def start(cluster_name: str, retry_until_up: bool = False) -> None:
     dummy.num_nodes = handle.launched_nodes
     dummy.set_resources(handle.launched_resources)
     dummy.best_resources = handle.launched_resources
-    _backend().provision(dummy, handle.launched_resources, dryrun=False,
+    _backend(handle).provision(dummy, handle.launched_resources, dryrun=False,
                          stream_logs=True, cluster_name=cluster_name,
                          retry_until_up=retry_until_up)
 
 
+@usage.entrypoint('sky.stop')
 def stop(cluster_name: str) -> None:
     record = _get_record_or_raise(cluster_name)
     handle = record['handle']
-    _backend().teardown(handle, terminate=False)
+    _backend(handle).teardown(handle, terminate=False)
 
 
+@usage.entrypoint('sky.down')
 def down(cluster_name: str, purge: bool = False) -> None:
     record = _get_record_or_raise(cluster_name)
     handle = record['handle']
-    _backend().teardown(handle, terminate=True, purge=purge)
+    _backend(handle).teardown(handle, terminate=True, purge=purge)
 
 
+@usage.entrypoint('sky.autostop')
 def autostop(cluster_name: str, idle_minutes: int,
              down: bool = False) -> None:  # pylint: disable=redefined-outer-name
     record = _get_record_or_raise(cluster_name)
-    _backend().set_autostop(record['handle'], idle_minutes, down)
+    _backend(record['handle']).set_autostop(record['handle'], idle_minutes, down)
 
 
 # ---------------------------------------------------------------------------
 # jobs
 # ---------------------------------------------------------------------------
+@usage.entrypoint('sky.queue')
 def queue(cluster_name: str) -> List[Dict[str, Any]]:
     record = _get_record_or_raise(cluster_name)
-    return _backend().get_job_queue(record['handle'])
+    return _backend(record['handle']).get_job_queue(record['handle'])
 
 
+@usage.entrypoint('sky.cancel')
 def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
            all_jobs: bool = False) -> List[int]:
     record = _get_record_or_raise(cluster_name)
-    return _backend().cancel_jobs(record['handle'], job_ids, all_jobs)
+    return _backend(record['handle']).cancel_jobs(record['handle'], job_ids, all_jobs)
 
 
+@usage.entrypoint('sky.tail_logs')
 def tail_logs(cluster_name: str, job_id: Optional[int] = None,
               follow: bool = True, tail: int = 0) -> int:
     record = _get_record_or_raise(cluster_name)
-    return _backend().tail_logs(record['handle'], job_id, follow, tail)
+    return _backend(record['handle']).tail_logs(record['handle'], job_id, follow, tail)
 
 
+@usage.entrypoint('sky.job_status')
 def job_status(cluster_name: str, job_ids: Optional[List[int]] = None
                ) -> Dict[int, Optional[str]]:
     record = _get_record_or_raise(cluster_name)
     if job_ids is None:
-        jobs = _backend().get_job_queue(record['handle'])
+        jobs = _backend(record['handle']).get_job_queue(record['handle'])
         job_ids = [j['job_id'] for j in jobs[:1]]
-    return _backend().get_job_status(record['handle'], job_ids)
+    return _backend(record['handle']).get_job_status(record['handle'], job_ids)
 
 
+@usage.entrypoint('sky.download_logs')
 def download_logs(cluster_name: str, job_ids: Optional[List[int]] = None,
                   local_dir: Optional[str] = None) -> Dict[int, str]:
     """Rsync job log dirs back to the client (reference
@@ -179,7 +218,7 @@ def download_logs(cluster_name: str, job_ids: Optional[List[int]] = None,
     import os
     record = _get_record_or_raise(cluster_name)
     handle: backend_lib.ClusterHandle = record['handle']
-    backend = _backend()
+    backend = _backend(handle)
     if job_ids is None:
         jobs = backend.get_job_queue(handle)
         job_ids = [j['job_id'] for j in jobs]
@@ -206,6 +245,7 @@ def download_logs(cluster_name: str, job_ids: Optional[List[int]] = None,
 # ---------------------------------------------------------------------------
 # cost report
 # ---------------------------------------------------------------------------
+@usage.entrypoint('sky.cost_report')
 def cost_report() -> List[Dict[str, Any]]:
     """Accumulated cost per cluster from usage intervals (reference
     core.cost_report + global_user_state.py:469-525)."""
@@ -237,10 +277,12 @@ def cost_report() -> List[Dict[str, Any]]:
 # ---------------------------------------------------------------------------
 # storage
 # ---------------------------------------------------------------------------
+@usage.entrypoint('sky.storage_ls')
 def storage_ls() -> List[Dict[str, Any]]:
     return global_user_state.get_storage()
 
 
+@usage.entrypoint('sky.storage_delete')
 def storage_delete(name: str) -> None:
     handle = global_user_state.get_handle_from_storage_name(name)
     if handle is None:

@@ -16,6 +16,7 @@ from skypilot_tpu import global_user_state
 from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
+from skypilot_tpu import usage
 from skypilot_tpu.backend import backend as backend_lib
 from skypilot_tpu.backend import tpu_gang_backend
 from skypilot_tpu.utils import common_utils
@@ -59,6 +60,7 @@ def _execute(
     retry_until_up: bool = False,
     quiet_optimizer: bool = False,
     blocked_resources: Optional[Set[Any]] = None,
+    backend: Optional[backend_lib.Backend] = None,
 ) -> Tuple[Optional[int], Optional[backend_lib.ClusterHandle]]:
     """Run the requested lifecycle stages for a one-task DAG.
 
@@ -75,14 +77,30 @@ def _execute(
     if cluster_name is None:
         cluster_name = common_utils.generate_cluster_name()
     common_utils.check_cluster_name_is_valid(cluster_name)
+    usage.record_task(task)
+    usage.record_cluster_name(cluster_name)
     stages = stages or list(Stage)
 
-    backend = tpu_gang_backend.TpuGangBackend()
     handle: Optional[backend_lib.ClusterHandle] = None
     existing = global_user_state.get_cluster_from_name(cluster_name)
     if existing is not None and existing['status'] == \
             global_user_state.ClusterStatus.UP:
         handle = existing['handle']
+    if existing is not None:
+        # An existing cluster's substrate wins over the per-invocation
+        # backend choice: `sky exec` (or a re-launch without --docker)
+        # onto a docker cluster must not drive the gang backend against
+        # a container handle, and vice versa.
+        from skypilot_tpu import core
+        chosen = core._backend(existing['handle'])  # pylint: disable=protected-access
+        if backend is not None and backend.NAME != chosen.NAME:
+            logger.warning(
+                f'Cluster {cluster_name!r} runs on the {chosen.NAME} '
+                f'backend; ignoring the requested {backend.NAME} '
+                'backend for this invocation.')
+        backend = chosen
+    elif backend is None:
+        backend = tpu_gang_backend.TpuGangBackend()
 
     if Stage.OPTIMIZE in stages and handle is None:
         optimizer_lib.optimize(dag, minimize=optimize_target,
@@ -129,6 +147,7 @@ def _execute(
     return job_id, handle
 
 
+@usage.entrypoint('sky.launch')
 def launch(
     task: Union[task_lib.Task, dag_lib.Dag],
     cluster_name: Optional[str] = None,
@@ -143,11 +162,13 @@ def launch(
     retry_until_up: bool = False,
     quiet_optimizer: bool = False,
     blocked_resources: Optional[Set[Any]] = None,
+    backend: Optional[backend_lib.Backend] = None,
 ) -> Tuple[Optional[int], Optional[backend_lib.ClusterHandle]]:
     """Provision (or reuse) a cluster and run the task on it
     (reference execution.launch, execution.py:368)."""
     return _execute(
         task,
+        backend=backend,
         dryrun=dryrun,
         down=down,
         stream_logs=stream_logs,
@@ -161,6 +182,7 @@ def launch(
     )
 
 
+@usage.entrypoint('sky.exec')
 def exec_(  # pylint: disable=redefined-builtin
     task: Union[task_lib.Task, dag_lib.Dag],
     cluster_name: str,

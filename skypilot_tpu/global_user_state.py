@@ -97,10 +97,38 @@ def _conn() -> sqlite3.Connection:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     conn = sqlite3.connect(path, timeout=10.0)
     conn.executescript(_CREATE_TABLES)
+    _migrate(conn)
     conn.commit()
     _conn_local.conn = conn
     _conn_local.path = path
     return conn
+
+
+# Columns added after the first released schema, with their ALTER
+# defaults — a state.db written by an older client gains them on first
+# open (reference analog: backward_compatibility_tests.sh guarantees an
+# old client's state keeps working with new code).
+_CLUSTER_COLUMN_MIGRATIONS = [
+    ('owner', 'TEXT DEFAULT NULL'),
+    ('metadata', "TEXT DEFAULT '{}'"),
+    ('cluster_hash', 'TEXT DEFAULT NULL'),
+    ('config_hash', 'TEXT DEFAULT NULL'),
+    ('status_updated_at', 'INTEGER DEFAULT NULL'),
+]
+
+
+def _migrate(conn: sqlite3.Connection) -> None:
+    cols = {r[1] for r in conn.execute('PRAGMA table_info(clusters)')}
+    for name, decl in _CLUSTER_COLUMN_MIGRATIONS:
+        if name not in cols:
+            try:
+                conn.execute(
+                    f'ALTER TABLE clusters ADD COLUMN {name} {decl}')
+            except sqlite3.OperationalError as e:
+                # Another process migrated between our PRAGMA read and
+                # the ALTER; anything else is a real failure.
+                if 'duplicate column' not in str(e).lower():
+                    raise
 
 
 def reset_for_tests() -> None:

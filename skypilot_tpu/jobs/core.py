@@ -14,6 +14,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Union
 
+from skypilot_tpu import usage
 from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
@@ -26,6 +27,7 @@ from skypilot_tpu.utils import subprocess_utils
 logger = sky_logging.init_logger(__name__)
 
 
+@usage.entrypoint('sky.jobs.launch')
 def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
            name: Optional[str] = None,
            controller_mode: str = 'process') -> int:

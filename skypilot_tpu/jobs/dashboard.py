@@ -16,6 +16,7 @@ Routes:
 """
 from __future__ import annotations
 
+import collections
 import html
 import http.server
 import json
@@ -53,7 +54,9 @@ def job_detail(job_id: int) -> Optional[Dict[str, Any]]:
     try:
         with open(jobs_state.controller_log_path(job_id),
                   encoding='utf-8') as f:
-            for line in f.readlines()[-200:]:
+            # deque streams the file; readlines() would hold a
+            # recovery-churning job's whole event log in memory.
+            for line in collections.deque(f, maxlen=200):
                 try:
                     events.append(json.loads(line))
                 except json.JSONDecodeError:
@@ -105,23 +108,35 @@ _PAGE = """<!doctype html>
 <th>Duration</th><th>Status</th><th>Cluster</th><th>#Recoveries</th>
 <th>Failure</th></tr></thead><tbody>{rows}</tbody></table>
 <script>
+// All job fields are user-controlled (names, failure reasons): build
+// cells with textContent, never innerHTML, to keep them inert.
+function cell(text, cls) {{
+  const td = document.createElement('td');
+  td.textContent = text;
+  if (cls) td.className = cls;
+  return td;
+}}
 async function refresh() {{
   try {{
     const r = await fetch('/api/jobs');
     const jobs = await r.json();
     const tb = document.querySelector('#jobs tbody');
-    tb.innerHTML = jobs.map(j => `<tr>
-      <td>${{j.job_id}}</td><td>${{j.task_id}}</td>
-      <td>${{j.job_name ?? j.task_name ?? '-'}}</td>
-      <td>${{j.resources_str ?? '-'}}</td>
-      <td>${{j.submitted_at ? new Date(j.submitted_at*1000)
-             .toLocaleString() : '-'}}</td>
-      <td>${{j.job_duration != null ? Math.round(j.job_duration)+'s'
-             : '-'}}</td>
-      <td class="${{j.status}}">${{j.status}}</td>
-      <td>${{j.cluster_name ?? '-'}}</td>
-      <td>${{j.recovery_count ?? 0}}</td>
-      <td>${{j.failure_reason ?? ''}}</td></tr>`).join('');
+    tb.replaceChildren(...jobs.map(j => {{
+      const tr = document.createElement('tr');
+      tr.append(
+        cell(j.job_id), cell(j.task_id),
+        cell(j.job_name ?? j.task_name ?? '-'),
+        cell(j.resources_str ?? '-'),
+        cell(j.submitted_at ? new Date(j.submitted_at*1000)
+             .toLocaleString() : '-'),
+        cell(j.job_duration != null ? Math.round(j.job_duration)+'s'
+             : '-'),
+        cell(j.status, /^[A-Z_]+$/.test(j.status) ? j.status : ''),
+        cell(j.cluster_name ?? '-'),
+        cell(j.recovery_count ?? 0),
+        cell(j.failure_reason ?? ''));
+      return tr;
+    }}));
     document.querySelector('#meta').textContent =
       jobs.length + ' jobs · refreshed ' + new Date().toLocaleTimeString();
   }} catch (e) {{ /* controller restarting; retry next tick */ }}
@@ -190,7 +205,9 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     self._json(200, detail)
             else:
                 self._json(404, {'error': 'not found'})
-        except BrokenPipeError:
+        except OSError:
+            # Client went away mid-write (closed tab, aborted fetch):
+            # not an error worth a traceback in the controller log.
             pass
 
 

@@ -19,6 +19,14 @@ LOCAL_REPLICA_PORT_START = 40001
 AUTOSCALER_INTERVAL_SECONDS = 20.0
 PROBE_INTERVAL_SECONDS = 10.0
 LB_SYNC_INTERVAL_SECONDS = 20.0
+# Per-attempt replica timeout (urllib blocking-op timeout; generous for
+# long token-streaming inference responses) and how many *distinct*
+# replicas one request may TCP-probe before 502.  Failover happens at
+# the probe stage only: once a replica accepts a connection the request
+# is delivered exactly once, so non-idempotent inference calls can
+# never execute twice.
+LB_REPLICA_TIMEOUT_SECONDS = 300.0
+LB_MAX_ATTEMPTS = 3
 
 # Consecutive probe failures before READY -> NOT_READY.
 PROBE_FAILURE_THRESHOLD = 3

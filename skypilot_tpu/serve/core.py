@@ -15,6 +15,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple, Union
 
+from skypilot_tpu import usage
 from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
@@ -40,6 +41,7 @@ def _extract_task(entrypoint: Union[task_lib.Task, 'dag_lib.Dag']
     return entrypoint
 
 
+@usage.entrypoint('sky.serve.up')
 def up(task: Union[task_lib.Task, 'dag_lib.Dag'],
        service_name: Optional[str] = None,
        mode: str = 'process',
@@ -148,6 +150,7 @@ def update(task: Union[task_lib.Task, 'dag_lib.Dag'],
     return new_version
 
 
+@usage.entrypoint('sky.serve.down')
 def down(service_names: Optional[Union[str, List[str]]] = None,
          all_services: bool = False, purge: bool = False) -> None:
     """Terminate services: replicas first, then the runtime

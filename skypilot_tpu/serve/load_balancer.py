@@ -11,11 +11,14 @@ FastAPI/uvicorn/httpx; streaming bodies are relayed in chunks.
 """
 from __future__ import annotations
 
+import http.client
 import http.server
 import json
+import socket
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, List, Optional
 
@@ -28,6 +31,23 @@ logger = sky_logging.init_logger(__name__)
 _HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
                 'proxy-authorization', 'te', 'trailers',
                 'transfer-encoding', 'upgrade', 'host', 'content-length'}
+
+_PROBE_TIMEOUT_SECONDS = 3.0
+
+
+def _probe(replica_url: str) -> bool:
+    """TCP connect-probe a replica URL ('http://host:port')."""
+    parsed = urllib.parse.urlparse(replica_url)
+    host = parsed.hostname
+    port = parsed.port or (443 if parsed.scheme == 'https' else 80)
+    if host is None:
+        return False
+    try:
+        with socket.create_connection((host, port),
+                                      timeout=_PROBE_TIMEOUT_SECONDS):
+            return True
+    except OSError:
+        return False
 
 
 class RequestAggregator:
@@ -53,11 +73,14 @@ class SkyServeLoadBalancer:
     def __init__(self, controller_url: str, port: int,
                  policy_name: str = 'round_robin',
                  sync_interval_seconds: float =
-                 constants.LB_SYNC_INTERVAL_SECONDS) -> None:
+                 constants.LB_SYNC_INTERVAL_SECONDS,
+                 replica_timeout_seconds: float =
+                 constants.LB_REPLICA_TIMEOUT_SECONDS) -> None:
         self.controller_url = controller_url.rstrip('/')
         self.port = port
         self.policy = lb_policies.LoadBalancingPolicy.from_name(policy_name)
         self.sync_interval = sync_interval_seconds
+        self.replica_timeout = replica_timeout_seconds
         self.aggregator = RequestAggregator()
         self._stop = threading.Event()
         self._server: Optional[http.server.ThreadingHTTPServer] = None
@@ -97,71 +120,127 @@ class SkyServeLoadBalancer:
 
             def _proxy(self) -> None:
                 lb.aggregator.add()
-                replica = lb.policy.select_replica()
+                try:
+                    length = int(self.headers.get('Content-Length', 0))
+                except ValueError:
+                    self._client_write(400, b'Bad Content-Length header.')
+                    return
+                data = self.rfile.read(length) if length > 0 else None
+                # Dead-replica failover happens BEFORE the request is
+                # forwarded: a cheap TCP probe weeds out replicas whose
+                # host is gone (preempted/terminated).  Once a replica
+                # accepts a connection the request is sent exactly once
+                # — a timeout or reset after delivery is never retried,
+                # so non-idempotent inference calls cannot run twice.
+                tried: set = set()
+                replica: Optional[str] = None
+                for _ in range(constants.LB_MAX_ATTEMPTS):
+                    cand = lb.policy.select_replica(exclude=tried)
+                    if cand is None:
+                        break
+                    tried.add(cand)
+                    if _probe(cand):
+                        replica = cand
+                        break
+                    logger.warning(f'Replica {cand} failed TCP probe; '
+                                   'trying another replica.')
                 if replica is None:
-                    body = b'No ready replicas. Use "sky serve status" ' \
-                           b'to check the status.'
-                    self.send_response(503)
+                    if not tried:
+                        self._client_write(
+                            503, b'No ready replicas. Use "sky serve '
+                                 b'status" to check the status.')
+                    else:
+                        self._client_write(
+                            502, (f'All {len(tried)} attempted replicas '
+                                  'unreachable.').encode())
+                    return
+                self._forward(replica, data)
+
+            def _client_write(self, code: int, body: bytes) -> None:
+                """Send a full response; client-socket failures only
+                close the connection (they must never look like replica
+                failures)."""
+                try:
+                    self.send_response(code)
                     self.send_header('Content-Length', str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
-                    return
+                except OSError:
+                    self.close_connection = True
+
+            def _forward(self, replica: str, data) -> None:
+                """Proxy the single delivery attempt; all failure modes
+                terminate here (no retry once the request is sent)."""
                 lb.policy.pre_execute_hook(replica)
                 try:
-                    length = int(self.headers.get('Content-Length', 0))
-                    data = self.rfile.read(length) if length else None
                     headers = {k: v for k, v in self.headers.items()
                                if k.lower() not in _HOP_HEADERS}
                     req = urllib.request.Request(
                         replica + self.path, data=data, headers=headers,
                         method=self.command)
-                    with urllib.request.urlopen(req, timeout=300) as resp:
-                        # Relay in chunks so token-streaming (SSE /
-                        # chunked) inference responses reach the client
-                        # incrementally.
-                        self.send_response(resp.status)
-                        for k, v in resp.headers.items():
-                            if k.lower() not in _HOP_HEADERS:
-                                self.send_header(k, v)
-                        length = resp.headers.get('Content-Length')
-                        if length is not None:
-                            self.send_header('Content-Length', length)
-                            self.end_headers()
-                        else:
-                            self.send_header('Transfer-Encoding', 'chunked')
-                            self.end_headers()
-                        while True:
-                            # read1: return as soon as one upstream
-                            # chunk arrives (read() would block filling
-                            # the whole buffer — no streaming).
-                            chunk = resp.read1(64 * 1024)
-                            if length is not None:
-                                if not chunk:
-                                    break
-                                self.wfile.write(chunk)
-                            else:
-                                if not chunk:
-                                    self.wfile.write(b'0\r\n\r\n')
-                                    break
-                                self.wfile.write(
-                                    f'{len(chunk):x}\r\n'.encode())
-                                self.wfile.write(chunk)
-                                self.wfile.write(b'\r\n')
-                            self.wfile.flush()
-                except urllib.error.HTTPError as e:
-                    body = e.read()
-                    self.send_response(e.code)
-                    self.send_header('Content-Length', str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                except Exception as e:  # pylint: disable=broad-except
-                    body = f'Replica request failed: {e}'.encode()
-                    self.send_response(502)
-                    self.send_header('Content-Length', str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    try:
+                        resp = urllib.request.urlopen(
+                            req, timeout=lb.replica_timeout)
+                    except urllib.error.HTTPError as e:
+                        # The replica *responded* (with an error
+                        # status): forward it verbatim.
+                        self._client_write(e.code, e.read())
+                        return
+                    except (urllib.error.URLError, ConnectionError,
+                            TimeoutError, OSError,
+                            http.client.HTTPException, ValueError) as e:
+                        # OSError family: connection problems; HTTP-
+                        # Exception: garbled replica response (e.g.
+                        # BadStatusLine); ValueError: urllib URL
+                        # validation.  All → 502, never a traceback.
+                        self._client_write(
+                            502, f'Replica request failed: {e}'.encode())
+                        return
+                    with resp:
+                        self._stream_response(resp)
                 finally:
                     lb.policy.post_execute_hook(replica)
+
+            def _stream_response(self, resp) -> None:
+                """Relay in chunks so token-streaming (SSE / chunked)
+                inference responses reach the client incrementally.
+                Once the status line is sent the request is no longer
+                retryable, so mid-stream failures abort the connection
+                instead of propagating to the retry loop."""
+                try:
+                    self.send_response(resp.status)
+                    for k, v in resp.headers.items():
+                        if k.lower() not in _HOP_HEADERS:
+                            self.send_header(k, v)
+                    length = resp.headers.get('Content-Length')
+                    if length is not None:
+                        self.send_header('Content-Length', length)
+                        self.end_headers()
+                    else:
+                        self.send_header('Transfer-Encoding', 'chunked')
+                        self.end_headers()
+                    while True:
+                        # read1: return as soon as one upstream chunk
+                        # arrives (read() would block filling the whole
+                        # buffer — no streaming).
+                        chunk = resp.read1(64 * 1024)
+                        if length is not None:
+                            if not chunk:
+                                break
+                            self.wfile.write(chunk)
+                        else:
+                            if not chunk:
+                                self.wfile.write(b'0\r\n\r\n')
+                                break
+                            self.wfile.write(
+                                f'{len(chunk):x}\r\n'.encode())
+                            self.wfile.write(chunk)
+                            self.wfile.write(b'\r\n')
+                        self.wfile.flush()
+                except (OSError, ConnectionError, TimeoutError) as e:
+                    logger.warning(f'Mid-stream proxy failure: {e}; '
+                                   'closing client connection.')
+                    self.close_connection = True
 
             do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _proxy
 

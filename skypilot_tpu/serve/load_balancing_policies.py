@@ -37,7 +37,10 @@ class LoadBalancingPolicy:
     def _on_replicas_changed(self, replicas: List[str]) -> None:
         pass
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self, exclude: Optional[set] = None
+                       ) -> Optional[str]:
+        """Pick a target; `exclude` skips replicas the current request
+        already failed against (LB connection-retry support)."""
         raise NotImplementedError
 
     def pre_execute_hook(self, replica: str) -> None:
@@ -66,13 +69,16 @@ class RoundRobinPolicy(LoadBalancingPolicy):
     def _on_replicas_changed(self, replicas: List[str]) -> None:
         self._index = 0
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self, exclude: Optional[set] = None
+                       ) -> Optional[str]:
         with self._lock:
-            if not self.ready_replicas:
+            pool = [r for r in self.ready_replicas
+                    if not exclude or r not in exclude]
+            if not pool:
                 return None
-            replica = self.ready_replicas[self._index %
-                                          len(self.ready_replicas)]
-            self._index = (self._index + 1) % len(self.ready_replicas)
+            replica = pool[self._index % len(pool)]
+            self._index = (self._index + 1) % max(
+                1, len(self.ready_replicas))
             return replica
 
 
@@ -85,12 +91,14 @@ class LeastNumberOfRequestsPolicy(LoadBalancingPolicy):
         super().__init__()
         self._inflight: Dict[str, int] = {}
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self, exclude: Optional[set] = None
+                       ) -> Optional[str]:
         with self._lock:
-            if not self.ready_replicas:
+            pool = [r for r in self.ready_replicas
+                    if not exclude or r not in exclude]
+            if not pool:
                 return None
-            return min(self.ready_replicas,
-                       key=lambda r: self._inflight.get(r, 0))
+            return min(pool, key=lambda r: self._inflight.get(r, 0))
 
     def pre_execute_hook(self, replica: str) -> None:
         with self._lock:

@@ -262,6 +262,11 @@ class Task:
             task.set_service(
                 service_spec.SkyServiceSpec.from_yaml_config(
                     config['service']))
+        outputs = config.get('outputs')
+        if isinstance(outputs, dict):
+            size = outputs.get('estimated_size_gigabytes')
+            if size is not None:
+                task.estimated_outputs_size_gb = float(size)
         task.validate()
         return task
 
@@ -299,6 +304,9 @@ class Task:
         if self._num_nodes != 1:
             add('num_nodes', self._num_nodes)
         add('envs', self._envs or None)
+        if self.estimated_outputs_size_gb is not None:
+            add('outputs', {
+                'estimated_size_gigabytes': self.estimated_outputs_size_gb})
         add('workdir', self.workdir)
         add('setup', self.setup)
         add('run', self.run if isinstance(self.run, str) else None)

@@ -1,0 +1,9 @@
+"""Usage telemetry (reference: sky/usage/)."""
+from skypilot_tpu.usage.usage_lib import (entrypoint, entrypoint_context,
+                                          messages,
+                                          record_cluster_name,
+                                          record_exception,
+                                          record_task)
+
+__all__ = ['entrypoint', 'entrypoint_context', 'messages',
+           'record_cluster_name', 'record_exception', 'record_task']

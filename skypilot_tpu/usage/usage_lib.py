@@ -1,0 +1,246 @@
+"""Usage telemetry: what ran, how long, and how it failed — never code.
+
+Counterpart of the reference's sky/usage/usage_lib.py (496 LoC:
+`@usage_lib.entrypoint` wrapping every public API, a `MessageToReport`
+schema POSTed to a Grafana Loki endpoint, opt-out via
+SKYPILOT_DISABLE_USAGE_COLLECTION).  Redesigned for this stack:
+
+- **Spool-first transport.** Messages are always appended to a local
+  JSONL spool (`<state>/usage/messages.jsonl`) and only POSTed when an
+  endpoint is explicitly configured (`SKYTPU_USAGE_ENDPOINT`), so the
+  subsystem is fully functional — and testable — with zero egress.
+  Delivery is best-effort with a short timeout and never raises into
+  the user's operation.
+- **Privacy.** User code never leaves the machine: task `run`/`setup`
+  are reported as line counts, envs as key names only, file_mounts as a
+  count.  The user is identified by the existing random hash
+  (utils/common_utils.get_user_hash), matching the reference's
+  anonymization.
+- Opt-out: SKYTPU_DISABLE_USAGE_COLLECTION=1
+  (utils/env_options.Options.DISABLE_LOGGING) makes every call a no-op.
+
+The outermost @entrypoint on the call stack owns the message; nested
+entrypoints are recorded in its `api_calls` trail (same semantics as
+the reference's `entrypoint_context` re-entrancy guard).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Iterator, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import env_options
+from skypilot_tpu.utils import paths
+
+logger = sky_logging.init_logger(__name__)
+
+SCHEMA_VERSION = 1
+_ENDPOINT_ENV = 'SKYTPU_USAGE_ENDPOINT'
+_POST_TIMEOUT_SECONDS = 2.0
+_SPOOL_MAX_BYTES = 4 * 1024 * 1024  # rotate the spool past this size
+
+
+def _disabled() -> bool:
+    return env_options.Options.DISABLE_LOGGING.get()
+
+
+@dataclasses.dataclass
+class UsageMessage:
+    """One reported operation (reference UsageMessageToReport)."""
+    schema_version: int = SCHEMA_VERSION
+    run_id: str = ''
+    user_hash: str = ''
+    client_version: str = ''
+    entrypoint: str = ''
+    api_calls: List[str] = dataclasses.field(default_factory=list)
+    cluster_names: List[str] = dataclasses.field(default_factory=list)
+    task_summary: Optional[Dict[str, Any]] = None
+    start_time: float = 0.0
+    duration_seconds: Optional[float] = None
+    exception_type: Optional[str] = None
+    exception_module: Optional[str] = None
+    ok: Optional[bool] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class _State(threading.local):
+
+    def __init__(self) -> None:
+        self.message: Optional[UsageMessage] = None
+        self.depth = 0
+
+
+_state = _State()
+
+
+_run_counter = itertools.count()
+
+
+def _new_message(name: str) -> UsageMessage:
+    from skypilot_tpu import __version__
+    return UsageMessage(
+        run_id=(f'{int(time.time()*1000):x}-{os.getpid():x}'
+                f'-{next(_run_counter)}'),
+        user_hash=common_utils.get_user_hash(),
+        client_version=__version__,
+        entrypoint=name,
+        start_time=time.time(),
+    )
+
+
+def messages() -> Optional[UsageMessage]:
+    """The in-flight message of this thread (None outside entrypoints)."""
+    return _state.message
+
+
+def record_cluster_name(name: Optional[str]) -> None:
+    m = _state.message
+    if m is not None and name and name not in m.cluster_names:
+        m.cluster_names.append(name)
+
+
+def record_task(task: Any) -> None:
+    """Attach a privacy-scrubbed task summary (reference _clean_yaml)."""
+    m = _state.message
+    if m is None or m.task_summary is not None:
+        return
+    try:
+        resources = [str(r) for r in task.get_preferred_resources()]
+    except Exception:  # pylint: disable=broad-except
+        resources = []
+    run = task.run if isinstance(getattr(task, 'run', None), str) else None
+    setup = task.setup if isinstance(getattr(task, 'setup', None),
+                                     str) else None
+    m.task_summary = {
+        'num_nodes': getattr(task, 'num_nodes', None),
+        'resources': resources,
+        'run_lines': len(run.splitlines()) if run else 0,
+        'setup_lines': len(setup.splitlines()) if setup else 0,
+        'env_keys': sorted((getattr(task, 'envs', None) or {}).keys()),
+        'num_file_mounts': len(getattr(task, 'file_mounts', None) or {}),
+    }
+
+
+def record_exception(exc: BaseException) -> None:
+    m = _state.message
+    if m is not None:
+        m.exception_type = type(exc).__name__
+        m.exception_module = type(exc).__module__
+
+
+def _spool_path() -> str:
+    d = os.path.join(paths.state_dir(), 'usage')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'messages.jsonl')
+
+
+def _write_spool(message: UsageMessage) -> None:
+    path = _spool_path()
+    try:
+        if (os.path.exists(path)
+                and os.path.getsize(path) > _SPOOL_MAX_BYTES):
+            os.replace(path, path + '.1')
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps(message.to_json()) + '\n')
+    except OSError as e:
+        logger.debug(f'usage spool write failed: {e}')
+
+
+def _post(message: UsageMessage) -> None:
+    endpoint = os.environ.get(_ENDPOINT_ENV)
+    if not endpoint:
+        return
+    try:
+        req = urllib.request.Request(
+            endpoint,
+            data=json.dumps(message.to_json()).encode(),
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=_POST_TIMEOUT_SECONDS):
+            pass
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        logger.debug(f'usage post failed: {e}')
+
+
+def _flush(message: UsageMessage) -> None:
+    _write_spool(message)
+    _post(message)
+
+
+@contextlib.contextmanager
+def entrypoint_context(name: str) -> Iterator[None]:
+    """Re-entrant usage scope: outermost call owns + flushes the
+    message; inner entrypoints only append to its api_calls trail."""
+    if _disabled():
+        yield
+        return
+    _state.depth += 1
+    is_outermost = _state.depth == 1
+    if is_outermost:
+        _state.message = _new_message(name)
+    else:
+        m = _state.message
+        if m is not None:
+            m.api_calls.append(name)
+    try:
+        yield
+        if is_outermost and _state.message is not None:
+            _state.message.ok = True
+    except (Exception, SystemExit, KeyboardInterrupt) as e:
+        record_exception(e)
+        if is_outermost and _state.message is not None:
+            _state.message.ok = False
+        raise
+    finally:
+        _state.depth -= 1
+        if is_outermost:
+            m = _state.message
+            _state.message = None
+            if m is not None:
+                m.duration_seconds = round(time.time() - m.start_time, 3)
+                _flush(m)
+
+
+def entrypoint(name_or_fn):
+    """Decorator form: @usage.entrypoint or @usage.entrypoint('name')."""
+    if isinstance(name_or_fn, str):
+        def named(fn):
+            return _wrap(fn, name_or_fn)
+        return named
+    return _wrap(name_or_fn, name_or_fn.__qualname__)
+
+
+def _wrap(fn, name: str):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with entrypoint_context(name):
+            return fn(*args, **kwargs)
+    return wrapper
+
+
+def read_spool() -> List[Dict[str, Any]]:
+    """All spooled messages (newest last); for tests and `sky check`."""
+    path = _spool_path()
+    out = []
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    except OSError:
+        pass
+    return out

@@ -1,0 +1,208 @@
+"""Local-docker backend tests against a fake `docker` CLI on PATH.
+
+The shim records every docker invocation to a call log and emulates
+the handful of subcommands the backend uses (version/ps/run/rm/stop/
+exec), so the full launch lifecycle is exercised hermetically —
+the same trick the provisioner tests use for cloud APIs.
+"""
+import json
+import os
+import stat
+import subprocess
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_user_state
+from skypilot_tpu.backend import command_runner
+from skypilot_tpu.backend import docker_backend
+
+
+@pytest.fixture()
+def fake_docker(tmp_path, monkeypatch):
+    """A `docker` shim: containers tracked in a JSON file; `exec` runs
+    the command in a real local bash (so task run/setup behave)."""
+    state = tmp_path / 'containers.json'
+    state.write_text('{}')
+    calls = tmp_path / 'calls.log'
+    script = tmp_path / 'bin' / 'docker'
+    script.parent.mkdir()
+    script.write_text(f'''#!/usr/bin/env python3
+import json, subprocess, sys
+state_path = {str(state)!r}
+with open({str(calls)!r}, 'a') as f:
+    f.write(json.dumps(sys.argv[1:]) + '\\n')
+containers = json.load(open(state_path))
+def save():
+    json.dump(containers, open(state_path, 'w'))
+args = sys.argv[1:]
+cmd = args[0] if args else ''
+if cmd == 'version':
+    print('linux'); sys.exit(0)
+elif cmd == 'run':
+    name = args[args.index('--name') + 1]
+    image = args[-3]
+    containers[name] = {{'image': image, 'state': 'running'}}
+    save(); print('c0ffee'); sys.exit(0)
+elif cmd == 'ps':
+    fmt = args[args.index('--format') + 1]
+    flt = [a for a in args if a.startswith('name=')]
+    out = []
+    for name, c in containers.items():
+        if flt and name not in flt[0]:
+            continue
+        line = fmt.replace('{{{{.Image}}}}', c['image'])
+        line = line.replace('{{{{.State}}}}', c['state'])
+        line = line.replace('{{{{.Names}}}}', name)
+        line = line.replace('{{{{.Label "skytpu.cluster"}}}}',
+                            name.replace('skytpu-docker-', ''))
+        out.append(line)
+    print('\\n'.join(out)); sys.exit(0)
+elif cmd == 'rm':
+    for n in [a for a in args[1:] if not a.startswith('-')]:
+        containers.pop(n, None)
+    save(); sys.exit(0)
+elif cmd == 'stop':
+    for n in args[1:]:
+        if n in containers: containers[n]['state'] = 'exited'
+    save(); sys.exit(0)
+elif cmd == 'start':
+    for n in args[1:]:
+        if n in containers: containers[n]['state'] = 'running'
+    save(); sys.exit(0)
+elif cmd == 'exec':
+    rest = [a for a in args[1:] if a != '-i']
+    # rest = [container, '/bin/bash', '-c', script]
+    sys.exit(subprocess.run(['bash', '-c', rest[3]]).returncode)
+sys.exit(1)
+''')
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('PATH',
+                       f"{script.parent}:{os.environ['PATH']}")
+    return calls
+
+
+def _calls(calls_log):
+    return [json.loads(line)
+            for line in calls_log.read_text().splitlines()]
+
+
+class TestLocalDockerBackend:
+
+    def test_full_lifecycle(self, fake_docker, tmp_path):
+        wd = tmp_path / 'wd'
+        wd.mkdir()
+        (wd / 'data.txt').write_text('payload\n')
+        out = tmp_path / 'out.txt'
+        t = sky.Task(name='dockerized',
+                     setup='echo setup-ran',
+                     run=f'cat ~/sky_workdir/data.txt > {out}; '
+                         f'echo rank=$SKYTPU_NODE_RANK >> {out}')
+        t.workdir = str(wd)
+        t.set_resources(sky.Resources(cloud='local',
+                                      image_id='docker:python:3.11'))
+        backend = docker_backend.LocalDockerBackend()
+        job_id, handle = sky.launch(t, cluster_name='dk1', backend=backend)
+        assert handle.provider_name == 'local_docker'
+        assert handle.head_address == 'docker:skytpu-docker-dk1'
+        # The shim's exec ran in a real bash: run command wrote through.
+        assert out.read_text() == 'payload\nrank=0\n'
+        # Image came from the docker: image_id.
+        run_call = next(c for c in _calls(fake_docker) if c[0] == 'run')
+        assert 'python:3.11' in run_call
+        # Registered in cluster state as UP.
+        rec = global_user_state.get_cluster_from_name('dk1')
+        assert rec['status'] == global_user_state.ClusterStatus.UP
+
+        # `sky down` must route to the docker backend (not the gang
+        # backend's cloud provisioner) based on the handle's provider.
+        sky.down('dk1')
+        assert any(c[:2] == ['rm', '-f'] for c in _calls(fake_docker))
+        assert global_user_state.get_cluster_from_name('dk1') is None
+
+    def test_reuses_running_container_same_image(self, fake_docker):
+        t = sky.Task(run='true')
+        t.set_resources(sky.Resources(cloud='local'))
+        backend = docker_backend.LocalDockerBackend()
+        sky.launch(t, cluster_name='dk2', backend=backend)
+        sky.launch(t, cluster_name='dk2', backend=backend)
+        runs = [c for c in _calls(fake_docker) if c[0] == 'run']
+        assert len(runs) == 1  # second launch reused the container
+
+    def test_stop_start_cycle_preserves_container(self, fake_docker):
+        t = sky.Task(run='true')
+        t.set_resources(sky.Resources(cloud='local'))
+        backend = docker_backend.LocalDockerBackend()
+        _, handle = sky.launch(t, cluster_name='dk3', backend=backend)
+        sky.stop('dk3')
+        rec = global_user_state.get_cluster_from_name('dk3')
+        assert rec['status'] == global_user_state.ClusterStatus.STOPPED
+        assert backend.query_status(handle) == 'exited'
+        # start restarts the same container (docker start, not rm+run).
+        sky.start('dk3')
+        assert backend.query_status(handle) == 'running'
+        runs = [c for c in _calls(fake_docker) if c[0] == 'run']
+        assert len(runs) == 1
+        assert any(c[0] == 'start' for c in _calls(fake_docker))
+        # status -r reconciles from container state.
+        recs = sky.status(['dk3'], refresh=True)
+        assert recs[0]['status'] == global_user_state.ClusterStatus.UP
+
+    def test_multinode_rejected(self, fake_docker):
+        t = sky.Task(run='true', num_nodes=2)
+        t.set_resources(sky.Resources(cloud='local'))
+        with pytest.raises(Exception, match='single-node'):
+            sky.launch(t, cluster_name='dk4',
+                       backend=docker_backend.LocalDockerBackend())
+
+    def test_docker_missing_is_clean_error(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('PATH', str(tmp_path))  # no docker anywhere
+        t = sky.Task(run='true')
+        t.set_resources(sky.Resources(cloud='local'))
+        with pytest.raises(Exception, match='docker CLI'):
+            sky.launch(t, cluster_name='dk5',
+                       backend=docker_backend.LocalDockerBackend())
+
+
+class TestDockerRunner:
+
+    def test_runner_scheme_dispatch(self):
+        r = command_runner.CommandRunner.from_address('docker:abc')
+        assert isinstance(r, command_runner.DockerContainerRunner)
+        assert r.container == 'abc'
+
+    def test_exec_and_rsync_round_trip(self, fake_docker, tmp_path):
+        # Provision a container through the backend, then use the
+        # runner directly.
+        t = sky.Task(run='true')
+        t.set_resources(sky.Resources(cloud='local'))
+        backend = docker_backend.LocalDockerBackend()
+        _, handle = sky.launch(t, cluster_name='dk6', backend=backend)
+        runner = command_runner.CommandRunner.from_address(
+            handle.head_address)
+        rc, out, _ = runner.run('echo hi-$((2+3))', require_outputs=True)
+        assert rc == 0 and out.strip() == 'hi-5'
+        # rsync file semantics: a single file lands AT the target path
+        # (renamed), exactly like the SSH/rsync substrate.
+        src = tmp_path / 'f.txt'
+        src.write_text('roundtrip')
+        dst_dir = tmp_path / 'dl'
+        runner.rsync(str(src), str(tmp_path / 'up' / 'renamed.yml'),
+                     up=True)
+        assert (tmp_path / 'up' / 'renamed.yml').read_text() == \
+            'roundtrip'
+        # Download into an existing dir: keeps the remote basename.
+        dst_dir.mkdir()
+        runner.rsync(str(tmp_path / 'up' / 'renamed.yml'), str(dst_dir),
+                     up=False)
+        assert (dst_dir / 'renamed.yml').read_text() == 'roundtrip'
+        # Download to an explicit file path: lands AT the path, renamed.
+        runner.rsync(str(tmp_path / 'up' / 'renamed.yml'),
+                     str(tmp_path / 'back.yml'), up=False)
+        assert (tmp_path / 'back.yml').read_text() == 'roundtrip'
+        # Directory semantics: contents merge into the target dir.
+        d = tmp_path / 'srcdir'
+        d.mkdir()
+        (d / 'a.txt').write_text('A')
+        runner.rsync(str(d), str(tmp_path / 'destdir'), up=True)
+        assert (tmp_path / 'destdir' / 'a.txt').read_text() == 'A'

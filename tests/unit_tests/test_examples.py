@@ -1,25 +1,81 @@
-"""Every example YAML must parse into a valid Task (the reference uses
-examples/ as living fixtures for its smoke tests — SURVEY.md §4)."""
+"""Every example YAML must parse into a valid Task/Dag — living
+fixtures, as the reference uses examples/ + llm/ for its smoke tests
+(SURVEY.md §4).  Train recipes are checked against the model registry
+and the mesh-axis grammar so a recipe can't silently rot.
+"""
 import pathlib
+import re
 
 import pytest
 
+from skypilot_tpu import models
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import dag_utils
 
-EXAMPLES = sorted(
-    (pathlib.Path(__file__).resolve().parents[2] / 'examples')
-    .glob('*.yaml'))
+_EXAMPLES_DIR = pathlib.Path(__file__).resolve().parents[2] / 'examples'
+_ALL_YAMLS = sorted(_EXAMPLES_DIR.rglob('*.yaml'))
 
 
-@pytest.mark.parametrize('path', EXAMPLES, ids=lambda p: p.name)
+def _is_multidoc(path):
+    return len(common_utils.read_yaml_all(str(path))) > 1
+
+
+SINGLE = [p for p in _ALL_YAMLS if not _is_multidoc(p)]
+MULTI = [p for p in _ALL_YAMLS if _is_multidoc(p)]
+
+
+def _check_train_invocation(run: str) -> None:
+    """A `python -m skypilot_tpu.train` line must name a registered
+    model and use only real mesh axes."""
+    model = re.search(r'--model\s+(\$\w+|\S+)', run)
+    if model and not model.group(1).startswith('$'):
+        assert model.group(1) in models.available_models(), (
+            f'unknown model {model.group(1)!r} in example')
+    mesh = re.search(r'--mesh\s+(\S+)', run)
+    if mesh and not mesh.group(1).startswith('$'):
+        for part in mesh.group(1).split(','):
+            axis, _, size = part.partition('=')
+            assert axis in mesh_lib.AXES, f'unknown mesh axis {axis!r}'
+            assert int(size) >= -1
+
+
+@pytest.mark.parametrize('path', SINGLE, ids=lambda p: p.name)
 def test_example_parses(path):
     t = task_lib.Task.from_yaml(str(path))
     t.validate()
     assert t.run
-    if path.name == 'serve_llama.yaml':
+    if isinstance(t.run, str) and 'skypilot_tpu.train' in t.run:
+        _check_train_invocation(t.run)
+    if path.name in ('serve_llama.yaml', 'serve_autoscale_spot.yaml'):
         assert t.service is not None
         assert t.service.readiness_path == '/health'
 
 
+@pytest.mark.parametrize('path', MULTI, ids=lambda p: p.name)
+def test_example_dag_parses(path):
+    d = dag_utils.load_chain_dag_from_yaml(str(path))
+    assert d.is_chain()
+    assert len(d.tasks) >= 2
+    for t in d.tasks:
+        t.validate()
+
+
+def test_dag_example_has_egress_priced_output():
+    d = dag_utils.load_chain_dag_from_yaml(
+        str(_EXAMPLES_DIR / 'cpu_prep_tpu_train_dag.yaml'))
+    by_name = {t.name: t for t in d.tasks}
+    assert by_name['tokenize'].estimated_outputs_size_gb == 200
+
+
+def test_spot_mix_service_fields_round_trip():
+    t = task_lib.Task.from_yaml(
+        str(_EXAMPLES_DIR / 'llm' / 'serve_autoscale_spot.yaml'))
+    assert t.service.base_ondemand_fallback_replicas == 1
+    (r,) = t.get_preferred_resources()
+    assert r.use_spot
+
+
 def test_examples_exist():
-    assert len(EXAMPLES) >= 5
+    assert len(_ALL_YAMLS) >= 12

@@ -1,0 +1,219 @@
+"""Load-balancer tests under real concurrency: distribution, retry on
+dead replicas, streaming, timeouts (VERDICT weak #11 — the stdlib LB
+had zero perf/robustness coverage)."""
+import http.server
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from skypilot_tpu.serve import load_balancer as lb_lib
+
+
+class _Replica:
+    """A tiny real HTTP replica that records hits."""
+
+    def __init__(self, delay=0.0):
+        self.hits = 0
+        self.delay = delay
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                outer.hits += 1
+                if outer.delay:
+                    time.sleep(outer.delay)
+                body = json.dumps({'port': outer.port}).encode()
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get('Content-Length', 0))
+                data = self.rfile.read(n)
+                outer.hits += 1
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.server = http.server.ThreadingHTTPServer(('127.0.0.1', 0), H)
+        self.server.daemon_threads = True
+        self.port = self.server.server_address[1]
+        self.url = f'http://127.0.0.1:{self.port}'
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def _lb():
+    """An LB with a no-op controller sync (replicas injected directly)."""
+    lb = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:1', port=0,
+                                     sync_interval_seconds=3600,
+                                     replica_timeout_seconds=5)
+    # Bind an ephemeral port: replicate start() minus the sync loop.
+    lb._server = http.server.ThreadingHTTPServer(
+        ('127.0.0.1', 0), lb._make_handler())
+    lb._server.daemon_threads = True
+    threading.Thread(target=lb._server.serve_forever, daemon=True).start()
+    lb.url = f'http://127.0.0.1:{lb._server.server_address[1]}'
+    yield lb
+    lb.stop()
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+class TestLoadBalancer:
+
+    def test_concurrent_round_robin_distribution(self, _lb):
+        replicas = [_Replica() for _ in range(3)]
+        _lb.policy.set_ready_replicas([r.url for r in replicas])
+        n = 60
+        with ThreadPoolExecutor(16) as pool:
+            results = list(pool.map(
+                lambda _: _get(_lb.url + '/x')[0], range(n)))
+        assert results == [200] * n
+        hits = [r.hits for r in replicas]
+        assert sum(hits) == n
+        # Round-robin under concurrency: no replica starved or hogged.
+        assert min(hits) >= n // 3 - 8, hits
+        for r in replicas:
+            r.stop()
+
+    def test_dead_replica_retried_on_healthy_one(self, _lb):
+        live = _Replica()
+        # A port with nothing listening.
+        dead_url = 'http://127.0.0.1:1'
+        _lb.policy.set_ready_replicas([dead_url, live.url])
+        statuses = [_get(_lb.url + '/x')[0] for _ in range(8)]
+        assert statuses == [200] * 8  # every request survived the dead one
+        assert live.hits == 8
+        live.stop()
+
+    def test_all_replicas_dead_is_502(self, _lb):
+        _lb.policy.set_ready_replicas(
+            ['http://127.0.0.1:1', 'http://127.0.0.1:2'])
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(_lb.url + '/x')
+        assert e.value.code == 502
+        assert b'unreachable' in e.value.read()
+
+    def test_no_replicas_is_503(self, _lb):
+        _lb.policy.set_ready_replicas([])
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(_lb.url + '/x')
+        assert e.value.code == 503
+
+    def test_post_body_relayed_and_not_replayed_to_success(self, _lb):
+        live = _Replica()
+        _lb.policy.set_ready_replicas(['http://127.0.0.1:1', live.url])
+        req = urllib.request.Request(_lb.url + '/gen',
+                                     data=b'{"prompt": "hi"}',
+                                     method='POST')
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.read() == b'{"prompt": "hi"}'
+        assert live.hits == 1
+        live.stop()
+
+    def test_replica_error_status_forwarded_not_retried(self, _lb):
+        class _ErrReplica(_Replica):
+            def __init__(self):
+                super().__init__()
+
+        err = _Replica()
+        # Swap handler: always 500.
+        outer_hits = {'n': 0}
+
+        class H500(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                outer_hits['n'] += 1
+                body = b'boom'
+                self.send_response(500)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        err.server.RequestHandlerClass = H500
+        healthy = _Replica()
+        _lb.policy.set_ready_replicas([err.url, healthy.url])
+        codes = []
+        for _ in range(4):
+            try:
+                codes.append(_get(_lb.url + '/x')[0])
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+        # 500s forwarded verbatim (application errors are not retried),
+        # healthy replica still serves its share.
+        assert set(codes) == {200, 500}
+        assert outer_hits['n'] == 2 and healthy.hits == 2
+        err.stop()
+        healthy.stop()
+
+    def test_timeout_after_delivery_never_duplicates_execution(self):
+        """A replica that accepted the request but answers too slowly
+        gets a 502 — the request must NOT be replayed on another
+        replica (non-idempotent inference calls)."""
+        lb = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:1', port=0,
+                                         sync_interval_seconds=3600,
+                                         replica_timeout_seconds=0.5)
+        lb._server = http.server.ThreadingHTTPServer(
+            ('127.0.0.1', 0), lb._make_handler())
+        lb._server.daemon_threads = True
+        threading.Thread(target=lb._server.serve_forever,
+                         daemon=True).start()
+        url = f'http://127.0.0.1:{lb._server.server_address[1]}'
+        slow = _Replica(delay=2.0)
+        other = _Replica()
+        lb.policy.set_ready_replicas([slow.url, other.url])
+        codes = []
+        for _ in range(2):
+            try:
+                codes.append(_get(url + '/x', timeout=10)[0])
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+        time.sleep(2.5)  # let slow replica finish its handlers
+        # Each request ran on exactly one replica; timeouts were not
+        # failed over.
+        assert slow.hits + other.hits == 2, (slow.hits, other.hits)
+        assert 502 in codes  # the slow replica's request timed out
+        slow.stop()
+        other.stop()
+        lb.stop()
+
+    def test_slow_replica_does_not_block_others(self, _lb):
+        slow = _Replica(delay=1.5)
+        fast = _Replica()
+        _lb.policy.set_ready_replicas([slow.url, fast.url])
+        t0 = time.time()
+        with ThreadPoolExecutor(8) as pool:
+            results = list(pool.map(
+                lambda _: _get(_lb.url + '/x')[0], range(8)))
+        elapsed = time.time() - t0
+        assert results == [200] * 8
+        # 4 slow hits at 1.5s each would serialize to 6s without
+        # concurrency; the threading server keeps it near one delay.
+        assert elapsed < 5, elapsed
+        slow.stop()
+        fast.stop()

@@ -1,0 +1,176 @@
+"""Optimizer fuzz: random DAGs vs a brute-force reference optimizer.
+
+Analog of the reference's tests/test_optimizer_random_dag.py: generate
+seeded random chains (hits the DP path) and branched DAGs (hits the
+exhaustive path), then check the optimizer's plan objective equals an
+independently computed brute-force optimum over the same candidate
+space — including inter-task egress cost.
+"""
+import itertools
+import random
+
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+
+Resources = resources_lib.Resources
+Task = task_lib.Task
+
+
+@pytest.fixture(autouse=True)
+def enable_clouds():
+    global_user_state.set_enabled_clouds(['fake', 'gcp', 'local'])
+
+
+# Small spec pool keeps per-task candidates below the exhaustive
+# solver's truncation threshold, so brute force and optimizer search
+# identical spaces.
+_SPEC_POOL = [
+    dict(cloud='fake', cpus='2'),
+    dict(cloud='fake', cpus='8'),
+    dict(cloud='gcp', accelerators='tpu-v5e-8'),
+    dict(cloud='gcp', accelerators='tpu-v5e-8', use_spot=True),
+    dict(cloud='gcp', accelerators='tpu-v4-8'),
+]
+
+
+def _random_task(rng: random.Random, idx: int) -> Task:
+    t = Task(f'fuzz-{idx}', run='x')
+    t.set_resources(Resources(**rng.choice(_SPEC_POOL)))
+    if rng.random() < 0.7:
+        t.estimated_outputs_size_gb = rng.choice([0, 1, 50, 500])
+    return t
+
+
+def _candidates(task, minimize):
+    """The same candidate metric list the optimizer builds."""
+    launchable, _ = optimizer_lib._fill_in_launchable_resources(
+        task, None, quiet=True)
+    cands = []
+    for _, rs in launchable.items():
+        for r in rs:
+            hours = optimizer_lib._estimate_runtime_hours(task, r)
+            cost = r.get_cost(hours * 3600) * task.num_nodes
+            cands.append((r, cost, hours))
+    idx = 1 if minimize == optimizer_lib.OptimizeTarget.COST else 2
+    cands.sort(key=lambda t: (t[idx], t[1], repr(t[0])))
+    return cands
+
+
+def _egress(src_task, src_r, dst_r):
+    gigabytes = src_task.estimated_outputs_size_gb or 0
+    if gigabytes <= 0 or src_r.cloud is None or dst_r.cloud is None:
+        return 0.0
+    if src_r.cloud.is_same_cloud(dst_r.cloud):
+        return 0.0
+    return src_r.cloud.get_egress_cost(gigabytes)
+
+
+def _brute_force_total(graph, topo, per_task, objective_idx):
+    best = None
+    for assignment in itertools.product(*(per_task[t] for t in topo)):
+        plan = dict(zip(topo, assignment))
+        total = sum(c[objective_idx] for c in assignment)
+        for u, v in graph.edges:
+            total += _egress(u, plan[u][0], plan[v][0])
+        if best is None or total < best:
+            best = total
+    return best
+
+
+def _plan_total(graph, topo, per_task, objective_idx):
+    """Objective of the plan the optimizer actually chose."""
+    chosen = {}
+    for t in topo:
+        match = [c for c in per_task[t] if c[0] == t.best_resources]
+        assert match, (t, t.best_resources)
+        chosen[t] = match[0]
+    total = sum(chosen[t][objective_idx] for t in topo)
+    for u, v in graph.edges:
+        total += _egress(u, chosen[u][0], chosen[v][0])
+    return total
+
+
+def _check_dag(d, minimize):
+    optimizer_lib.optimize(d, minimize=minimize, quiet=True)
+    graph = d.get_graph()
+    import networkx as nx
+    topo = list(nx.topological_sort(graph))
+    per_task = {t: _candidates(t, minimize) for t in topo}
+    objective_idx = (1 if minimize == optimizer_lib.OptimizeTarget.COST
+                     else 2)
+    expected = _brute_force_total(graph, topo, per_task, objective_idx)
+    actual = _plan_total(graph, topo, per_task, objective_idx)
+    assert actual == pytest.approx(expected, rel=1e-9), (
+        f'optimizer plan objective {actual} != brute-force optimum '
+        f'{expected}')
+
+
+class TestRandomChains:
+
+    @pytest.mark.parametrize('seed', range(8))
+    def test_chain_matches_brute_force_cost(self, seed):
+        rng = random.Random(seed)
+        n = rng.randint(1, 5)
+        with dag_lib.Dag() as d:
+            tasks = [_random_task(rng, i) for i in range(n)]
+            for a, b in zip(tasks, tasks[1:]):
+                a >> b
+        _check_dag(d, optimizer_lib.OptimizeTarget.COST)
+
+    @pytest.mark.parametrize('seed', range(4))
+    def test_chain_matches_brute_force_time(self, seed):
+        rng = random.Random(1000 + seed)
+        n = rng.randint(2, 4)
+        with dag_lib.Dag() as d:
+            tasks = [_random_task(rng, i) for i in range(n)]
+            for a, b in zip(tasks, tasks[1:]):
+                a >> b
+        _check_dag(d, optimizer_lib.OptimizeTarget.TIME)
+
+
+class TestRandomBranchedDags:
+
+    @pytest.mark.parametrize('seed', range(4))
+    def test_diamond_matches_brute_force(self, seed):
+        rng = random.Random(2000 + seed)
+        with dag_lib.Dag() as d:
+            src = _random_task(rng, 0)
+            mid1 = _random_task(rng, 1)
+            mid2 = _random_task(rng, 2)
+            sink = _random_task(rng, 3)
+            src >> mid1
+            src >> mid2
+            mid1 >> sink
+            mid2 >> sink
+        _check_dag(d, optimizer_lib.OptimizeTarget.COST)
+
+    @pytest.mark.parametrize('seed', range(3))
+    def test_random_tree(self, seed):
+        rng = random.Random(3000 + seed)
+        n = rng.randint(3, 6)
+        with dag_lib.Dag() as d:
+            tasks = [_random_task(rng, i) for i in range(n)]
+            for i in range(1, n):
+                parent = tasks[rng.randrange(i)]
+                parent >> tasks[i]
+        _check_dag(d, optimizer_lib.OptimizeTarget.COST)
+
+
+def test_egress_changes_the_decision():
+    """Egress must actually influence placement: a big output makes
+    keeping both stages on one cloud optimal even when the second
+    stage's compute is marginally cheaper elsewhere."""
+    with dag_lib.Dag() as d:
+        a = Task('producer', run='x')
+        a.set_resources(Resources(cloud='gcp', accelerators='tpu-v5e-8'))
+        a.estimated_outputs_size_gb = 10000  # huge egress if moved
+        b = Task('consumer', run='x')
+        b.set_resources(Resources())  # any cloud
+        a >> b
+    optimizer_lib.optimize(d, quiet=True)
+    assert b.best_resources.cloud.is_same_cloud(a.best_resources.cloud)

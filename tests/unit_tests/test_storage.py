@@ -171,16 +171,18 @@ class TestTransfer:
             def __init__(self, cmd, **kwargs):
                 calls.append(cmd)
                 import io
-                self.stderr = io.StringIO(state['stderr'])
+                # transfer() streams merged stdout+stderr from .stdout.
+                self.stdout = io.StringIO(state['output'])
 
             def wait(self):
                 return state['rc']
 
+        state.update(output='')
         monkeypatch.setattr(subprocess, 'Popen', FakePopen)
         data_transfer.transfer('gs://a', 's3://b')
         assert calls
         state['rc'] = 1
-        state['stderr'] = 'boom\n'
+        state['output'] = 'boom\n'
         with pytest.raises(exceptions.StorageError, match='boom'):
             data_transfer.transfer('gs://a', 's3://b')
 

@@ -1,0 +1,107 @@
+"""Usage telemetry tests: entrypoint nesting, spool, POST, privacy,
+opt-out (reference: sky/usage/usage_lib.py semantics)."""
+import json
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import usage
+from skypilot_tpu.usage import usage_lib
+
+
+@usage.entrypoint('outer.op')
+def _outer():
+    return _inner()
+
+
+@usage.entrypoint('inner.op')
+def _inner():
+    return 42
+
+
+@usage.entrypoint('failing.op')
+def _failing():
+    raise ValueError('user-secret-path /home/x')
+
+
+class TestEntrypoint:
+
+    def test_outermost_owns_message_inner_in_trail(self):
+        assert _outer() == 42
+        msgs = usage_lib.read_spool()
+        assert len(msgs) == 1
+        m = msgs[0]
+        assert m['entrypoint'] == 'outer.op'
+        assert m['api_calls'] == ['inner.op']
+        assert m['ok'] is True
+        assert m['duration_seconds'] is not None
+        assert m['schema_version'] == usage_lib.SCHEMA_VERSION
+        assert m['user_hash'] == 'abcd1234'  # conftest-pinned
+
+    def test_exception_recorded_type_only(self):
+        with pytest.raises(ValueError):
+            _failing()
+        (m,) = usage_lib.read_spool()
+        assert m['ok'] is False
+        assert m['exception_type'] == 'ValueError'
+        # The exception *message* (may contain paths) is never reported.
+        assert 'user-secret-path' not in json.dumps(m)
+
+    def test_disable_env_is_total_noop(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_DISABLE_USAGE_COLLECTION', '1')
+        assert _outer() == 42
+        assert usage_lib.read_spool() == []
+
+    def test_consecutive_ops_get_separate_messages(self):
+        _outer()
+        _outer()
+        msgs = usage_lib.read_spool()
+        assert [m['entrypoint'] for m in msgs] == ['outer.op', 'outer.op']
+        assert msgs[0]['run_id'] != msgs[1]['run_id']
+
+
+class TestPostTransport:
+
+    def test_post_only_when_endpoint_configured(self, monkeypatch):
+        posted = []
+        monkeypatch.setattr(
+            usage_lib.urllib.request, 'urlopen',
+            lambda req, timeout=None: posted.append(req) or
+            __import__('contextlib').nullcontext())
+        _outer()
+        assert posted == []  # no endpoint -> spool only
+        monkeypatch.setenv('SKYTPU_USAGE_ENDPOINT',
+                           'http://localhost:1/loki')
+        _outer()
+        assert len(posted) == 1
+        body = json.loads(posted[0].data.decode())
+        assert body['entrypoint'] == 'outer.op'
+
+    def test_post_failure_never_raises(self, monkeypatch):
+        def boom(req, timeout=None):
+            raise OSError('connection refused')
+        monkeypatch.setattr(usage_lib.urllib.request, 'urlopen', boom)
+        monkeypatch.setenv('SKYTPU_USAGE_ENDPOINT', 'http://localhost:1/')
+        assert _outer() == 42  # telemetry failure is invisible
+
+
+class TestLaunchIntegration:
+
+    def test_launch_reports_scrubbed_task(self):
+        t = sky.Task(name='tele', run='echo secret-command\necho two',
+                     envs={'WANDB_API_KEY': 'hunter2'})
+        t.set_resources(sky.Resources(cloud='local'))
+        sky.launch(t, cluster_name='telemetry-c')
+        msgs = [m for m in usage_lib.read_spool()
+                if m['entrypoint'] == 'sky.launch']
+        assert msgs, usage_lib.read_spool()
+        m = msgs[-1]
+        assert m['cluster_names'] == ['telemetry-c']
+        summary = m['task_summary']
+        assert summary['run_lines'] == 2
+        assert summary['env_keys'] == ['WANDB_API_KEY']
+        blob = json.dumps(m)
+        # Neither the command nor the env value ever leaves the machine.
+        assert 'secret-command' not in blob
+        assert 'hunter2' not in blob
+        sky.down('telemetry-c')
