@@ -1,0 +1,232 @@
+"""AWS EC2 catalog: instance types, prices, regions/AZs.
+
+Counterpart of the reference's sky/clouds/service_catalog/aws_catalog.py
+(hosted-CSV cache + az-mapping; reference common.py:29-115).  Same
+structure as catalog/gcp_catalog.py: a built-in snapshot of public
+on-demand/spot list prices (us-east-1 anchors, per-region multiplier),
+overridable by `~/.skytpu/catalogs/v1/aws/vms.csv` (written/edited via
+`sky catalog update`; catalog/common.py).
+
+The AWS story here is deliberately VM-only (no TPUs on AWS): it gives
+the optimizer true multi-cloud placement — CPU controllers, GPU
+fallbacks, and egress-priced cross-cloud DAG stages — against the
+TPU-first GCP path.
+"""
+from __future__ import annotations
+
+import io
+import typing
+from typing import Dict, List, Optional, Tuple
+
+if typing.TYPE_CHECKING:
+    import pandas as pd
+
+from skypilot_tpu import exceptions
+
+# price/spot_price are us-east-1 anchors ($/h, public list 2025).
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+t3.medium,2,4,,0,0.0416,0.0125
+m6i.large,2,8,,0,0.0960,0.0288
+m6i.xlarge,4,16,,0,0.1920,0.0576
+m6i.2xlarge,8,32,,0,0.3840,0.1152
+m6i.4xlarge,16,64,,0,0.7680,0.2304
+m6i.8xlarge,32,128,,0,1.5360,0.4608
+c6i.4xlarge,16,32,,0,0.6800,0.2040
+r6i.2xlarge,8,64,,0,0.5040,0.1512
+g5.xlarge,4,16,A10G,1,1.0060,0.3018
+g5.12xlarge,48,192,A10G,4,5.6720,1.7016
+p4d.24xlarge,96,1152,A100,8,32.7726,9.8318
+p4de.24xlarge,96,1152,A100-80GB,8,40.9657,12.2897
+p5.48xlarge,192,2048,H100,8,98.3200,29.4960
+"""
+
+_REGION_PRICE_MULTIPLIER: Dict[str, float] = {
+    'us-east-1': 1.0,
+    'us-east-2': 1.0,
+    'us-west-2': 1.0,
+    'eu-west-1': 1.10,
+    'eu-central-1': 1.15,
+    'ap-northeast-1': 1.20,
+}
+
+# Availability zones per region (suffix letters; snapshot of typical AZ
+# sets — the provisioner treats any listed AZ as a candidate).
+_REGION_AZS: Dict[str, List[str]] = {
+    'us-east-1': ['a', 'b', 'c', 'd', 'f'],
+    'us-east-2': ['a', 'b', 'c'],
+    'us-west-2': ['a', 'b', 'c', 'd'],
+    'eu-west-1': ['a', 'b', 'c'],
+    'eu-central-1': ['a', 'b', 'c'],
+    'ap-northeast-1': ['a', 'c', 'd'],
+}
+
+# GPU instance types are not offered everywhere; snapshot of regions
+# with P4/P5/G5 capacity pools.
+_GPU_REGIONS = ['us-east-1', 'us-east-2', 'us-west-2', 'eu-west-1',
+                'eu-central-1', 'ap-northeast-1']
+
+_VM_COLUMNS = ['instance_type', 'vcpus', 'memory_gb',
+               'accelerator_name', 'accelerator_count', 'price',
+               'spot_price']
+
+_df: Optional['pd.DataFrame'] = None
+
+
+def _vm_df() -> 'pd.DataFrame':
+    global _df
+    if _df is None:
+        import pandas as pd  # deferred: keep `import skypilot_tpu` light
+
+        from skypilot_tpu.catalog import common
+        _df = common.read_catalog_csv('aws', 'vms', _VM_COLUMNS)
+        if _df is None:
+            _df = pd.read_csv(io.StringIO(_VMS_CSV))
+    return _df
+
+
+def reload() -> None:
+    global _df
+    _df = None
+
+
+def export_snapshot() -> Dict[str, str]:
+    return {'vms': _vm_df().to_csv(index=False)}
+
+
+def regions() -> List[str]:
+    return sorted(_REGION_AZS)
+
+
+def zones(region: Optional[str] = None,
+          zone: Optional[str] = None) -> List[str]:
+    out = []
+    for r, suffixes in sorted(_REGION_AZS.items()):
+        if region is not None and r != region:
+            continue
+        for s in suffixes:
+            z = f'{r}{s}'
+            if zone is None or z == zone:
+                out.append(z)
+    return out
+
+
+def zone_to_region(zone: str) -> str:
+    # 'us-east-1a' -> 'us-east-1'
+    return zone.rstrip('abcdef')
+
+
+def _region_multiplier(region: Optional[str]) -> float:
+    if region is None:
+        return 1.0
+    return _REGION_PRICE_MULTIPLIER.get(region, 1.2)
+
+
+def instance_type_exists(instance_type: str) -> bool:
+    df = _vm_df()
+    return bool((df['instance_type'] == instance_type).any())
+
+
+def _row(instance_type: str):
+    df = _vm_df()
+    rows = df[df['instance_type'] == instance_type]
+    if rows.empty:
+        raise exceptions.ResourcesUnavailableError(
+            f'No AWS instance type {instance_type!r}; have '
+            f'{sorted(df["instance_type"])}')
+    return rows.iloc[0]
+
+
+def get_hourly_cost(instance_type: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    if zone is not None and region is None:
+        region = zone_to_region(zone)
+    row = _row(instance_type)
+    base = float(row['spot_price'] if use_spot else row['price'])
+    return base * _region_multiplier(region)
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    row = _row(instance_type)
+    return float(row['vcpus']), float(row['memory_gb'])
+
+
+def get_accelerators_from_instance_type(
+        instance_type: str) -> Optional[Dict[str, int]]:
+    row = _row(instance_type)
+    if not row['accelerator_name'] or str(row['accelerator_name']) == 'nan':
+        return None
+    return {str(row['accelerator_name']): int(row['accelerator_count'])}
+
+
+def _parse_bound(request: Optional[str]) -> Tuple[Optional[float], bool]:
+    if request is None:
+        return None, False
+    s = str(request)
+    if s.endswith('+'):
+        return float(s[:-1]), True
+    return float(s), False
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None,
+                              disk_tier: Optional[str] = None
+                              ) -> Optional[str]:
+    del disk_tier
+    import pandas as pd  # noqa: F401
+
+    df = _vm_df()
+    df = df[df['accelerator_count'] == 0]
+    cpu_val, cpu_plus = _parse_bound(cpus)
+    mem_val, mem_plus = _parse_bound(memory)
+    if cpu_val is not None:
+        df = df[df['vcpus'] >= cpu_val] if cpu_plus else \
+            df[df['vcpus'] == cpu_val]
+    elif memory is None:
+        # SkyPilot default: 8 vCPUs.
+        df = df[df['vcpus'] >= 8]
+    if mem_val is not None:
+        df = df[df['memory_gb'] >= mem_val] if mem_plus else \
+            df[df['memory_gb'] == mem_val]
+    if df.empty:
+        return None
+    return str(df.sort_values('price').iloc[0]['instance_type'])
+
+
+def get_instance_type_for_accelerator(acc_name: str,
+                                      acc_count: int) -> List[str]:
+    df = _vm_df()
+    rows = df[(df['accelerator_name'] == acc_name)
+              & (df['accelerator_count'] == acc_count)]
+    return sorted(rows['instance_type'])
+
+
+def get_accelerator_hourly_cost(acc_name: str, acc_count: int,
+                                use_spot: bool,
+                                region: Optional[str] = None,
+                                zone: Optional[str] = None) -> float:
+    types = get_instance_type_for_accelerator(acc_name, acc_count)
+    if not types:
+        raise exceptions.ResourcesUnavailableError(
+            f'No AWS instance type offers {acc_name}:{acc_count}.')
+    return min(get_hourly_cost(t, use_spot, region, zone) for t in types)
+
+
+def list_accelerators(name_filter: Optional[str] = None
+                      ) -> Dict[str, List[Dict[str, object]]]:
+    """name -> offerings (for `sky show-accelerators`)."""
+    df = _vm_df()
+    out: Dict[str, List[Dict[str, object]]] = {}
+    for _, row in df[df['accelerator_count'] > 0].iterrows():
+        name = str(row['accelerator_name'])
+        if name_filter and name_filter.lower() not in name.lower():
+            continue
+        out.setdefault(name, []).append({
+            'accelerator_count': int(row['accelerator_count']),
+            'instance_type': str(row['instance_type']),
+            'price': float(row['price']),
+            'spot_price': float(row['spot_price']),
+        })
+    return out

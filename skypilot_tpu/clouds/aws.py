@@ -1,0 +1,199 @@
+"""AWS cloud (EC2 VMs): capability model + catalog glue.
+
+Counterpart of the reference's sky/clouds/aws.py (1,174 LoC over
+boto3).  This implementation is SDK-free: pricing/feasibility ride the
+catalog snapshot (catalog/aws_catalog.py) and provisioning drives the
+EC2 Query API directly with SigV4-signed REST calls
+(provision/aws/ec2_api.py) — the same stance as the first-party GCP
+REST client, and fully mockable in tests.
+
+Scope: CPU/GPU VMs (controllers, data-prep stages, GPU fallbacks for
+serving) — the TPU path stays on GCP/GKE.  This gives the optimizer a
+real second cloud: cross-cloud placement with egress pricing.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.catalog import aws_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_DEFAULT_AMI_BY_REGION_KEY = 'ami'  # resolved by the provisioner
+
+
+@registry.CLOUD_REGISTRY.register()
+class AWS(cloud.Cloud):
+    """Amazon Web Services (EC2 VMs)."""
+
+    _REPR = 'AWS'
+    PROVISIONER_MODULE = 'aws'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 40
+
+    @classmethod
+    def _unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        unsupported: Dict[cloud.CloudImplementationFeatures, str] = {}
+        if resources.tpu_slice is not None:
+            unsupported[cloud.CloudImplementationFeatures.MULTI_NODE] = (
+                'AWS offers no TPUs; use GCP/Kubernetes for TPU slices.')
+        unsupported[cloud.CloudImplementationFeatures.CLONE_DISK] = (
+            'disk cloning is not implemented for AWS.')
+        return unsupported
+
+    # ---- regions/zones ---------------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del instance_type, accelerators, use_spot
+        zones = aws_catalog.zones(region, zone)
+        regions = sorted({aws_catalog.zone_to_region(z) for z in zones})
+        return [cloud.Region(r) for r in regions]
+
+    @classmethod
+    def zones_provision_loop(
+        cls, *, region: str, num_nodes: int, instance_type: str,
+        accelerators: Optional[Dict[str, int]] = None,
+        use_spot: bool = False,
+    ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del num_nodes, instance_type, accelerators, use_spot
+        for z in aws_catalog.zones(region):
+            yield [cloud.Zone(z, region)]
+
+    # ---- pricing ---------------------------------------------------------
+    @classmethod
+    def instance_type_to_hourly_cost(cls, instance_type: str,
+                                     use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        return aws_catalog.get_hourly_cost(instance_type, use_spot,
+                                           region, zone)
+
+    @classmethod
+    def accelerators_to_hourly_cost(cls, accelerators: Dict[str, int],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        (acc, count), = accelerators.items()
+        return aws_catalog.get_accelerator_hourly_cost(
+            acc, count, use_spot, region, zone)
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        # Public internet egress, tiered (reference sky/clouds/aws.py
+        # get_egress_cost: 0.09 first 10TB).
+        if num_gigabytes <= 0.1:
+            return 0.0
+        return num_gigabytes * 0.09
+
+    # ---- instance types --------------------------------------------------
+    @classmethod
+    def instance_type_exists(cls, instance_type: str) -> bool:
+        return aws_catalog.instance_type_exists(instance_type)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return aws_catalog.get_vcpus_mem_from_instance_type(instance_type)
+
+    @classmethod
+    def get_default_instance_type(
+            cls, cpus: Optional[str] = None, memory: Optional[str] = None,
+            disk_tier: Optional[str] = None) -> Optional[str]:
+        return aws_catalog.get_default_instance_type(cpus, memory,
+                                                     disk_tier)
+
+    @classmethod
+    def get_accelerators_from_instance_type(
+            cls, instance_type: str) -> Optional[Dict[str, int]]:
+        return aws_catalog.get_accelerators_from_instance_type(
+            instance_type)
+
+    # ---- feasibility -----------------------------------------------------
+    @classmethod
+    def _get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources',
+        num_nodes: int) -> cloud.FeasibleResources:
+        del num_nodes
+        if resources.tpu_slice is not None:
+            return cloud.FeasibleResources(
+                [], [], 'AWS offers no TPUs; TPU slices run on GCP/GKE.')
+        if resources.accelerators is not None:
+            (acc, acc_count), = resources.accelerators.items()
+            instance_types = aws_catalog.get_instance_type_for_accelerator(
+                acc, acc_count)
+            if not instance_types:
+                fuzzy = [f'{name} (AWS)' for name in
+                         aws_catalog.list_accelerators(acc[:4])]
+                return cloud.FeasibleResources([], fuzzy[:5], None)
+            return cloud.FeasibleResources(
+                [resources.copy(cloud=cls(), instance_type=it)
+                 for it in instance_types], [], None)
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = cls.get_default_instance_type(
+                resources.cpus, resources.memory, resources.disk_tier)
+        if instance_type is None:
+            return cloud.FeasibleResources(
+                [], [], 'No AWS instance type satisfies '
+                f'cpus={resources.cpus} memory={resources.memory}.')
+        return cloud.FeasibleResources(
+            [resources.copy(cloud=cls(), instance_type=instance_type)],
+            [], None)
+
+    # ---- deploy ----------------------------------------------------------
+    @classmethod
+    def make_deploy_resources_variables(
+            cls, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        assert zones, 'AWS provisioning requires availability zones'
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': zones[0].name,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,  # None -> provisioner default
+            'labels': resources.labels or {},
+            'num_nodes': num_nodes,
+            'ports': resources.ports,
+        }
+
+    # ---- credentials -----------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.aws import auth
+        if auth.load_credentials() is None:
+            return False, (
+                'No AWS credentials. Set AWS_ACCESS_KEY_ID / '
+                'AWS_SECRET_ACCESS_KEY or populate ~/.aws/credentials.')
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.aws import auth
+        creds = auth.load_credentials()
+        if creds is None:
+            return None
+        # Access key id is the stable identity anchor without an STS
+        # round-trip (reference uses sts.get_caller_identity).
+        return [[creds.access_key_id]]
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        path = os.path.expanduser('~/.aws/credentials')
+        if os.path.exists(path):
+            return {'~/.aws/credentials': '~/.aws/credentials'}
+        return {}

@@ -1,0 +1,176 @@
+"""Minimal EC2 Query-API client (SigV4 + urllib, XML responses).
+
+The reference drives EC2 through boto3 (sky/provision/aws/instance.py);
+this is the SDK-free equivalent, mirroring the stance of the first-
+party GCP REST client (provision/gcp/gcp_api.py).  Only the operations
+the provisioner needs: RunInstances, TerminateInstances, StopInstances,
+StartInstances, DescribeInstances, CreateTags.
+
+All calls route through `_call`, so tests monkeypatch exactly one seam.
+"""
+from __future__ import annotations
+
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision.aws import auth
+
+logger = sky_logging.init_logger(__name__)
+
+API_VERSION = '2016-11-15'
+_TIMEOUT = 60.0
+
+
+class AwsApiError(exceptions.ProvisionError):
+
+    def __init__(self, status_code: int, code: str, message: str) -> None:
+        no_failover = code in ('AuthFailure', 'UnauthorizedOperation',
+                               'InvalidClientTokenId')
+        super().__init__(f'AWS API error {status_code} {code}: {message}',
+                         no_failover=no_failover)
+        self.status_code = status_code
+        self.code = code
+
+
+def _strip_ns(tag: str) -> str:
+    return tag.rsplit('}', 1)[-1]
+
+
+def _xml_to_obj(elem: ET.Element) -> Any:
+    """XML -> nested dict/list: <item> sequences become lists."""
+    children = list(elem)
+    if not children:
+        return elem.text.strip() if elem.text and elem.text.strip() \
+            else ''
+    if all(_strip_ns(c.tag) == 'item' for c in children):
+        return [_xml_to_obj(c) for c in children]
+    out: Dict[str, Any] = {}
+    for c in children:
+        out[_strip_ns(c.tag)] = _xml_to_obj(c)
+    return out
+
+
+def _call(action: str, region: str,
+          params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    creds = auth.load_credentials()
+    if creds is None:
+        raise AwsApiError(401, 'AuthFailure', 'no AWS credentials found')
+    host = f'ec2.{region}.amazonaws.com'
+    all_params = {'Action': action, 'Version': API_VERSION}
+    all_params.update(params or {})
+    body = auth._canonical_query(all_params).encode()  # pylint: disable=protected-access
+    headers, _ = auth.sign_request(
+        creds, method='POST', service='ec2', region=region, host=host,
+        path='/', body=body)
+    headers['Content-Type'] = 'application/x-www-form-urlencoded'
+    req = urllib.request.Request(f'https://{host}/', data=body,
+                                 headers=headers, method='POST')
+    try:
+        with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
+            text = resp.read().decode()
+    except urllib.error.HTTPError as e:
+        err_text = e.read().decode(errors='replace')
+        code, message = _parse_error(err_text)
+        raise AwsApiError(e.code, code, message) from None
+    except urllib.error.URLError as e:
+        raise AwsApiError(0, 'Unreachable', str(e)) from None
+    root = ET.fromstring(text)
+    obj = _xml_to_obj(root)
+    return obj if isinstance(obj, dict) else {'result': obj}
+
+
+def _parse_error(text: str) -> tuple:
+    try:
+        root = ET.fromstring(text)
+        code = root.findtext('.//Code') or 'Unknown'
+        message = root.findtext('.//Message') or text[:500]
+        return code, message
+    except ET.ParseError:
+        return 'Unknown', text[:500]
+
+
+def _tag_params(prefix: str, tags: Dict[str, str]) -> Dict[str, str]:
+    out = {}
+    for i, (k, v) in enumerate(sorted(tags.items()), 1):
+        out[f'{prefix}.Tag.{i}.Key'] = k
+        out[f'{prefix}.Tag.{i}.Value'] = v
+    return out
+
+
+def run_instances(region: str, zone: str, *, image_id: str,
+                  instance_type: str, count: int,
+                  tags: Dict[str, str], use_spot: bool = False,
+                  disk_size_gb: int = 256,
+                  key_name: Optional[str] = None,
+                  user_data_b64: Optional[str] = None
+                  ) -> List[Dict[str, Any]]:
+    params: Dict[str, str] = {
+        'ImageId': image_id,
+        'InstanceType': instance_type,
+        'MinCount': str(count),
+        'MaxCount': str(count),
+        'Placement.AvailabilityZone': zone,
+        'BlockDeviceMapping.1.DeviceName': '/dev/sda1',
+        'BlockDeviceMapping.1.Ebs.VolumeSize': str(disk_size_gb),
+        'BlockDeviceMapping.1.Ebs.VolumeType': 'gp3',
+        'TagSpecification.1.ResourceType': 'instance',
+    }
+    params.update(_tag_params('TagSpecification.1', tags))
+    if use_spot:
+        params['InstanceMarketOptions.MarketType'] = 'spot'
+        params['InstanceMarketOptions.SpotOptions.'
+               'InstanceInterruptionBehavior'] = 'terminate'
+    if key_name:
+        params['KeyName'] = key_name
+    if user_data_b64:
+        params['UserData'] = user_data_b64
+    resp = _call('RunInstances', region, params)
+    instances = resp.get('instancesSet', [])
+    if isinstance(instances, dict):
+        instances = [instances]
+    return instances
+
+
+def describe_instances(region: str,
+                       filters: Dict[str, str]) -> List[Dict[str, Any]]:
+    params: Dict[str, str] = {}
+    for i, (name, value) in enumerate(sorted(filters.items()), 1):
+        params[f'Filter.{i}.Name'] = name
+        params[f'Filter.{i}.Value.1'] = value
+    resp = _call('DescribeInstances', region, params)
+    reservations = resp.get('reservationSet', [])
+    if isinstance(reservations, dict):
+        reservations = [reservations]
+    out = []
+    for r in reservations:
+        insts = r.get('instancesSet', [])
+        if isinstance(insts, dict):
+            insts = [insts]
+        out.extend(insts)
+    return out
+
+
+def _instance_id_params(instance_ids: List[str]) -> Dict[str, str]:
+    return {f'InstanceId.{i}': iid
+            for i, iid in enumerate(instance_ids, 1)}
+
+
+def terminate_instances(region: str,
+                        instance_ids: List[str]) -> None:
+    if instance_ids:
+        _call('TerminateInstances', region,
+              _instance_id_params(instance_ids))
+
+
+def stop_instances(region: str, instance_ids: List[str]) -> None:
+    if instance_ids:
+        _call('StopInstances', region, _instance_id_params(instance_ids))
+
+
+def start_instances(region: str, instance_ids: List[str]) -> None:
+    if instance_ids:
+        _call('StartInstances', region, _instance_id_params(instance_ids))

@@ -1,0 +1,263 @@
+"""AWS EC2 provisioner: the uniform provision interface over ec2_api.
+
+Counterpart of the reference's sky/provision/aws/instance.py (boto3,
+1,684 LoC with security-group machinery); this implementation keeps the
+same lifecycle semantics — idempotent run_instances that resumes
+stopped nodes first, tag-scoped queries, head-node election by lowest
+instance id — over the SigV4 REST client.
+"""
+from __future__ import annotations
+
+import base64
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.aws import ec2_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'aws'
+_CLUSTER_TAG = 'skytpu-cluster'
+
+# Region -> Ubuntu 22.04 LTS amd64 AMI (public Canonical images
+# snapshot; overridable per-launch via resources.image_id).
+_DEFAULT_AMIS: Dict[str, str] = {
+    'us-east-1': 'ami-0e2c8caa4b6378d8c',
+    'us-east-2': 'ami-036841078a4b68e14',
+    'us-west-2': 'ami-05d38da78ce859165',
+    'eu-west-1': 'ami-0d64bb532e0502c46',
+    'eu-central-1': 'ami-0e54671bdf3c8ed8d',
+    'ap-northeast-1': 'ami-0b20f552f63953f0e',
+}
+
+_CAPACITY_ERROR_CODES = {
+    'InsufficientInstanceCapacity', 'InstanceLimitExceeded',
+    'SpotMaxPriceTooLow', 'MaxSpotInstanceCountExceeded',
+    'Unsupported', 'VcpuLimitExceeded',
+}
+
+
+def _classify(e: ec2_api.AwsApiError) -> Exception:
+    if e.code in _CAPACITY_ERROR_CODES:
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _region(provider_config: Optional[Dict[str, Any]]) -> str:
+    assert provider_config and provider_config.get('region'), \
+        'AWS provider_config must carry region'
+    return provider_config['region']
+
+
+def _cluster_filter(cluster_name_on_cloud: str) -> Dict[str, str]:
+    return {f'tag:{_CLUSTER_TAG}': cluster_name_on_cloud}
+
+
+def _state(inst: Dict[str, Any]) -> str:
+    state = inst.get('instanceState', {})
+    return state.get('name', 'unknown') if isinstance(state, dict) \
+        else 'unknown'
+
+
+def _ssh_key_user_data(auth_config: Dict[str, Any]) -> Optional[str]:
+    """cloud-init script installing the framework SSH key for the
+    default user (EC2 key-pair-free analog of GCP's key metadata; the
+    auth config carries 'user:pubkey', tpu_gang_backend format)."""
+    ssh_keys = (auth_config or {}).get('ssh_keys', '')
+    if ':' not in ssh_keys:
+        return None
+    pub = ssh_keys.split(':', 1)[1]
+    script = ('#!/bin/bash\n'
+              'mkdir -p /home/ubuntu/.ssh\n'
+              f'echo {pub!r} >> /home/ubuntu/.ssh/authorized_keys\n'
+              'chown -R ubuntu:ubuntu /home/ubuntu/.ssh\n'
+              'chmod 600 /home/ubuntu/.ssh/authorized_keys\n')
+    return base64.b64encode(script.encode()).decode()
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    zone = node_cfg.get('zone') or f'{region}a'
+    image = node_cfg.get('image_id') or _DEFAULT_AMIS.get(region)
+    if image is None:
+        raise exceptions.ResourcesUnavailableError(
+            f'No default AMI known for region {region}; set image_id.')
+    try:
+        existing = ec2_api.describe_instances(
+            region, _cluster_filter(cluster_name_on_cloud))
+    except ec2_api.AwsApiError as e:
+        raise _classify(e) from None
+    by_state: Dict[str, List[str]] = {}
+    for inst in existing:
+        by_state.setdefault(_state(inst), []).append(
+            str(inst.get('instanceId')))
+    running = by_state.get('running', []) + by_state.get('pending', [])
+    stopped = by_state.get('stopped', []) + by_state.get('stopping', [])
+
+    resumed: List[str] = []
+    if config.resume_stopped_nodes and stopped:
+        need = config.count - len(running)
+        to_resume = sorted(stopped)[:max(need, 0)]
+        if to_resume:
+            try:
+                ec2_api.start_instances(region, to_resume)
+            except ec2_api.AwsApiError as e:
+                raise _classify(e) from None
+            resumed = to_resume
+            running += to_resume
+
+    created: List[str] = []
+    to_create = config.count - len(running)
+    if to_create > 0:
+        tags = {_CLUSTER_TAG: cluster_name_on_cloud,
+                'Name': cluster_name_on_cloud}
+        tags.update(config.tags)
+        try:
+            instances = ec2_api.run_instances(
+                region, zone,
+                image_id=image,
+                instance_type=node_cfg['instance_type'],
+                count=to_create,
+                tags=tags,
+                use_spot=bool(node_cfg.get('use_spot')),
+                disk_size_gb=int(node_cfg.get('disk_size') or 256),
+                key_name=node_cfg.get('key_name'),
+                user_data_b64=_ssh_key_user_data(
+                    config.authentication_config),
+            )
+        except ec2_api.AwsApiError as e:
+            raise _classify(e) from None
+        created = [str(i.get('instanceId')) for i in instances]
+        running += created
+
+    if not running:
+        raise exceptions.ResourcesUnavailableError(
+            f'AWS returned no instances for {cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER,
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        head_instance_id=sorted(running)[0],
+        resumed_instance_ids=resumed,
+        created_instance_ids=created,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    region = _region(provider_config)
+    insts = ec2_api.describe_instances(
+        region, _cluster_filter(cluster_name_on_cloud))
+    ids = sorted(str(i['instanceId']) for i in insts
+                 if _state(i) in ('running', 'pending'))
+    if worker_only and ids:
+        ids = ids[1:]  # head is the lowest id
+    ec2_api.stop_instances(region, ids)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    region = _region(provider_config)
+    insts = ec2_api.describe_instances(
+        region, _cluster_filter(cluster_name_on_cloud))
+    ids = sorted(str(i['instanceId']) for i in insts
+                 if _state(i) not in ('terminated', 'shutting-down'))
+    if worker_only and ids:
+        ids = ids[1:]
+    ec2_api.terminate_instances(region, ids)
+
+
+_STATUS_MAP = {
+    'pending': 'pending',
+    'running': 'running',
+    'stopping': 'stopping',
+    'stopped': 'stopped',
+    'shutting-down': 'terminated',
+    'terminated': 'terminated',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    region = _region(provider_config)
+    insts = ec2_api.describe_instances(
+        region, _cluster_filter(cluster_name_on_cloud))
+    out: Dict[str, Optional[str]] = {}
+    for inst in insts:
+        status = _STATUS_MAP.get(_state(inst))
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[str(inst['instanceId'])] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 600.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud,
+                                   {'region': region},
+                                   non_terminated_only=False)
+        live = [s for s in statuses.values() if s != 'terminated']
+        if live and all(s == state for s in live):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: instances did not reach '
+        f'{state!r} within {timeout}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    insts = ec2_api.describe_instances(
+        region, _cluster_filter(cluster_name_on_cloud))
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for inst in insts:
+        if _state(inst) != 'running':
+            continue
+        iid = str(inst['instanceId'])
+        tags = {}
+        tagset = inst.get('tagSet', [])
+        if isinstance(tagset, dict):
+            tagset = [tagset]
+        for t in tagset:
+            tags[str(t.get('key'))] = str(t.get('value'))
+        instances[iid] = [common.InstanceInfo(
+            instance_id=iid,
+            internal_ip=str(inst.get('privateIpAddress', '')),
+            external_ip=str(inst['ipAddress'])
+            if inst.get('ipAddress') else None,
+            tags=tags,
+        )]
+    head = sorted(instances)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head,
+        provider_name=_PROVIDER,
+        provider_config=provider_config,
+        ssh_user='ubuntu',
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # Security-group mutation is not implemented in the REST-thin
+    # client; default VPC SG rules are assumed (reference implements
+    # this via boto3 authorize_security_group_ingress).
+    logger.warning('AWS open_ports is a no-op in this build; open %s '
+                   'on the security group manually.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

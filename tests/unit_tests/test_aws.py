@@ -1,0 +1,345 @@
+"""AWS cloud tests: SigV4 against the published spec vector, EC2 XML
+client against canned responses, provision lifecycle over a mocked EC2,
+catalog + optimizer cross-cloud placement."""
+import datetime
+import io
+import urllib.error
+
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.catalog import aws_catalog
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.aws import auth
+from skypilot_tpu.provision.aws import ec2_api
+from skypilot_tpu.provision.aws import instance as aws_instance
+
+Resources = resources_lib.Resources
+
+
+class TestSigV4:
+
+    def test_published_spec_vector(self):
+        """The worked example from the public SigV4 documentation
+        (GET iam ListUsers, 20150830T123600Z) — exact signature."""
+        creds = auth.Credentials(
+            'AKIDEXAMPLE', 'wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY')
+        now = datetime.datetime(2015, 8, 30, 12, 36, 0,
+                                tzinfo=datetime.timezone.utc)
+        headers, query = auth.sign_request(
+            creds, method='GET', service='iam', region='us-east-1',
+            host='iam.amazonaws.com',
+            params={'Action': 'ListUsers', 'Version': '2010-05-08'},
+            extra_headers={
+                'content-type':
+                    'application/x-www-form-urlencoded; charset=utf-8'},
+            now=now)
+        assert query == 'Action=ListUsers&Version=2010-05-08'
+        assert headers['Authorization'] == (
+            'AWS4-HMAC-SHA256 '
+            'Credential=AKIDEXAMPLE/20150830/us-east-1/iam/aws4_request, '
+            'SignedHeaders=content-type;host;x-amz-date, '
+            'Signature=5d672d79c15b13162d9279b0855cfba6789a8edb4c'
+            '82c400e06b5924a6f2b5d7')
+
+    def test_session_token_is_signed(self):
+        creds = auth.Credentials('AKID', 'secret', session_token='tok')
+        headers, _ = auth.sign_request(
+            creds, method='POST', service='ec2', region='us-east-1',
+            host='ec2.us-east-1.amazonaws.com', body=b'Action=X')
+        assert headers['X-Amz-Security-Token'] == 'tok'
+        assert 'x-amz-security-token' in headers['Authorization']
+
+    def test_credentials_from_ini(self, tmp_path, monkeypatch):
+        monkeypatch.delenv('AWS_ACCESS_KEY_ID', raising=False)
+        monkeypatch.delenv('AWS_SECRET_ACCESS_KEY', raising=False)
+        ini = tmp_path / 'credentials'
+        ini.write_text('[default]\naws_access_key_id = AKIDFILE\n'
+                       'aws_secret_access_key = filesecret\n')
+        monkeypatch.setenv('AWS_SHARED_CREDENTIALS_FILE', str(ini))
+        creds = auth.load_credentials()
+        assert creds.access_key_id == 'AKIDFILE'
+
+    def test_env_wins_over_file(self, monkeypatch):
+        monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AKIDENV')
+        monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'envsecret')
+        assert auth.load_credentials().access_key_id == 'AKIDENV'
+
+
+_DESCRIBE_XML = """<?xml version="1.0"?>
+<DescribeInstancesResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+  <reservationSet><item>
+    <instancesSet>
+      <item>
+        <instanceId>i-0002</instanceId>
+        <instanceState><code>16</code><name>running</name></instanceState>
+        <privateIpAddress>10.0.0.2</privateIpAddress>
+        <ipAddress>54.0.0.2</ipAddress>
+        <tagSet><item><key>skytpu-cluster</key><value>c1</value></item>
+        </tagSet>
+      </item>
+      <item>
+        <instanceId>i-0001</instanceId>
+        <instanceState><code>16</code><name>running</name></instanceState>
+        <privateIpAddress>10.0.0.1</privateIpAddress>
+        <ipAddress>54.0.0.1</ipAddress>
+        <tagSet><item><key>skytpu-cluster</key><value>c1</value></item>
+        </tagSet>
+      </item>
+    </instancesSet>
+  </item></reservationSet>
+</DescribeInstancesResponse>
+"""
+
+_ERROR_XML = """<?xml version="1.0"?>
+<Response><Errors><Error>
+  <Code>InsufficientInstanceCapacity</Code>
+  <Message>We currently do not have sufficient p4d capacity.</Message>
+</Error></Errors></Response>
+"""
+
+
+class TestEc2Client:
+
+    @pytest.fixture(autouse=True)
+    def _creds(self, monkeypatch):
+        monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AKID')
+        monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'secret')
+
+    def test_describe_parses_xml(self, monkeypatch):
+        sent = {}
+
+        class _Resp(io.BytesIO):
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def fake_urlopen(req, timeout=None):
+            sent['url'] = req.full_url
+            sent['body'] = req.data.decode()
+            return _Resp(_DESCRIBE_XML.encode())
+
+        monkeypatch.setattr(ec2_api.urllib.request, 'urlopen',
+                            fake_urlopen)
+        insts = ec2_api.describe_instances(
+            'us-east-1', {'tag:skytpu-cluster': 'c1'})
+        assert sent['url'] == 'https://ec2.us-east-1.amazonaws.com/'
+        assert 'Action=DescribeInstances' in sent['body']
+        assert 'Filter.1.Name=tag%3Askytpu-cluster' in sent['body']
+        ids = sorted(i['instanceId'] for i in insts)
+        assert ids == ['i-0001', 'i-0002']
+        assert insts[0]['instanceState']['name'] == 'running'
+
+    def test_api_error_classified(self, monkeypatch):
+        def fake_urlopen(req, timeout=None):
+            raise urllib.error.HTTPError(
+                req.full_url, 500, 'err', {},
+                io.BytesIO(_ERROR_XML.encode()))
+
+        monkeypatch.setattr(ec2_api.urllib.request, 'urlopen',
+                            fake_urlopen)
+        with pytest.raises(ec2_api.AwsApiError) as e:
+            ec2_api.describe_instances('us-east-1', {})
+        assert e.value.code == 'InsufficientInstanceCapacity'
+        # The provisioner maps capacity errors to failover-able ones.
+        assert isinstance(aws_instance._classify(e.value),
+                          exceptions.ResourcesUnavailableError)
+
+    def test_auth_error_no_failover(self):
+        err = ec2_api.AwsApiError(401, 'AuthFailure', 'bad key')
+        assert err.no_failover
+        assert aws_instance._classify(err) is err
+
+
+class _FakeEc2:
+    """In-memory EC2 emulating the ec2_api functions."""
+
+    def __init__(self):
+        self.instances = {}
+        self.counter = 0
+
+    def run_instances(self, region, zone, *, image_id, instance_type,
+                      count, tags, use_spot=False, disk_size_gb=256,
+                      key_name=None, user_data_b64=None):
+        out = []
+        for _ in range(count):
+            self.counter += 1
+            iid = f'i-{self.counter:04d}'
+            self.instances[iid] = {
+                'instanceId': iid,
+                'instanceState': {'name': 'running'},
+                'privateIpAddress': f'10.0.0.{self.counter}',
+                'ipAddress': f'54.0.0.{self.counter}',
+                'tagSet': [{'key': k, 'value': v}
+                           for k, v in tags.items()],
+                'zone': zone, 'image': image_id,
+                'spot': use_spot, 'user_data': user_data_b64,
+            }
+            out.append(self.instances[iid])
+        return out
+
+    def describe_instances(self, region, filters):
+        tag_filters = {k[len('tag:'):]: v for k, v in filters.items()
+                       if k.startswith('tag:')}
+        out = []
+        for inst in self.instances.values():
+            tags = {t['key']: t['value'] for t in inst['tagSet']}
+            if all(tags.get(k) == v for k, v in tag_filters.items()):
+                out.append(inst)
+        return out
+
+    def _set_state(self, ids, state):
+        for iid in ids:
+            if iid in self.instances:
+                self.instances[iid]['instanceState'] = {'name': state}
+
+    def terminate_instances(self, region, ids):
+        self._set_state(ids, 'terminated')
+
+    def stop_instances(self, region, ids):
+        self._set_state(ids, 'stopped')
+
+    def start_instances(self, region, ids):
+        self._set_state(ids, 'running')
+
+
+@pytest.fixture()
+def fake_ec2(monkeypatch):
+    fake = _FakeEc2()
+    for fn in ('run_instances', 'describe_instances',
+               'terminate_instances', 'stop_instances',
+               'start_instances'):
+        monkeypatch.setattr(ec2_api, fn, getattr(fake, fn))
+        monkeypatch.setattr(aws_instance.ec2_api, fn, getattr(fake, fn))
+    return fake
+
+
+def _pconfig(count=1, resume=False, **node):
+    node_cfg = {'instance_type': 'm6i.2xlarge', 'zone': 'us-east-1a',
+                'use_spot': False, 'disk_size': 100}
+    node_cfg.update(node)
+    return provision_common.ProvisionConfig(
+        provider_config={'region': 'us-east-1'},
+        authentication_config={'ssh_keys': 'skytpu:ssh-ed25519 AAAA key'},
+        docker_config={}, node_config=node_cfg, count=count, tags={},
+        resume_stopped_nodes=resume)
+
+
+class TestAwsProvisioner:
+
+    def test_run_stop_resume_terminate_lifecycle(self, fake_ec2):
+        record = aws_instance.run_instances('us-east-1', 'c1',
+                                            _pconfig(count=2))
+        assert len(record.created_instance_ids) == 2
+        assert record.head_instance_id == sorted(
+            record.created_instance_ids)[0]
+        # SSH key shipped via cloud-init user data.
+        assert any(i['user_data'] for i in fake_ec2.instances.values())
+
+        info = aws_instance.get_cluster_info('us-east-1', 'c1',
+                                             {'region': 'us-east-1'})
+        assert info.ssh_user == 'ubuntu'
+        assert len(info.instances) == 2
+
+        aws_instance.stop_instances('c1', {'region': 'us-east-1'})
+        statuses = aws_instance.query_instances(
+            'c1', {'region': 'us-east-1'}, non_terminated_only=False)
+        assert set(statuses.values()) == {'stopped'}
+
+        # Resume: same instances restarted, none created.
+        record2 = aws_instance.run_instances(
+            'us-east-1', 'c1', _pconfig(count=2, resume=True))
+        assert sorted(record2.resumed_instance_ids) == \
+            sorted(record.created_instance_ids)
+        assert record2.created_instance_ids == []
+
+        aws_instance.terminate_instances('c1', {'region': 'us-east-1'})
+        assert aws_instance.query_instances(
+            'c1', {'region': 'us-east-1'}) == {}
+
+    def test_worker_only_stop_keeps_head(self, fake_ec2):
+        aws_instance.run_instances('us-east-1', 'c2', _pconfig(count=3))
+        aws_instance.stop_instances('c2', {'region': 'us-east-1'},
+                                    worker_only=True)
+        statuses = aws_instance.query_instances(
+            'c2', {'region': 'us-east-1'}, non_terminated_only=False)
+        assert sorted(statuses.values()) == ['running', 'stopped',
+                                            'stopped']
+
+
+class TestAwsCatalogAndCloud:
+
+    def test_default_instance_type(self):
+        assert aws_catalog.get_default_instance_type('8+') == \
+            'm6i.2xlarge'
+        assert aws_catalog.get_default_instance_type('2') == 't3.medium'
+
+    def test_gpu_lookup(self):
+        assert aws_catalog.get_instance_type_for_accelerator(
+            'A100', 8) == ['p4d.24xlarge']
+        cost = aws_catalog.get_accelerator_hourly_cost(
+            'H100', 8, use_spot=True, region='us-east-1')
+        assert cost == pytest.approx(29.4960)
+
+    def test_region_multiplier(self):
+        base = aws_catalog.get_hourly_cost('m6i.large', False,
+                                           'us-east-1')
+        eu = aws_catalog.get_hourly_cost('m6i.large', False,
+                                         'eu-central-1')
+        assert eu == pytest.approx(base * 1.15)
+
+    def test_cloud_feasibility(self):
+        aws = registry.CLOUD_REGISTRY.from_str('aws')
+        feasible = aws.get_feasible_launchable_resources(
+            Resources(cpus='16+'))
+        types = [r.instance_type for r in feasible.resources_list]
+        assert 'm6i.4xlarge' in types or 'c6i.4xlarge' in types
+
+    def test_optimizer_places_cpu_on_cheapest_cloud(self):
+        global_user_state.set_enabled_clouds(['gcp', 'aws'])
+        t = task_lib.Task('t', run='x')
+        t.set_resources(Resources(cpus='8+'))
+        with dag_lib.Dag() as d:
+            d.add(t)
+        optimizer_lib.optimize(d, quiet=True)
+        # Both clouds priced; winner must be the globally cheapest
+        # 8-vCPU offering across the two catalogs.
+        gcp_cost = 0.2681   # e2-standard-8 us anchor
+        aws_cost = 0.3840   # m6i.2xlarge us anchor
+        assert t.best_resources.cloud.canonical_name() == (
+            'gcp' if gcp_cost < aws_cost else 'aws')
+
+    def test_optimizer_cross_cloud_egress(self):
+        """A huge producer output pins the consumer to the same cloud
+        even across the gcp/aws boundary."""
+        global_user_state.set_enabled_clouds(['gcp', 'aws'])
+        with dag_lib.Dag() as d:
+            a = task_lib.Task('producer', run='x')
+            a.set_resources(Resources(cloud='aws', cpus='8+'))
+            a.estimated_outputs_size_gb = 10000
+            b = task_lib.Task('consumer', run='x')
+            b.set_resources(Resources(cpus='8+'))
+            a >> b
+        optimizer_lib.optimize(d, quiet=True)
+        assert b.best_resources.cloud.canonical_name() == 'aws'
+
+    def test_check_credentials_gated(self, monkeypatch, tmp_path):
+        monkeypatch.delenv('AWS_ACCESS_KEY_ID', raising=False)
+        monkeypatch.delenv('AWS_SECRET_ACCESS_KEY', raising=False)
+        monkeypatch.setenv('AWS_SHARED_CREDENTIALS_FILE',
+                           str(tmp_path / 'nope'))
+        aws = registry.CLOUD_REGISTRY.from_str('aws')
+        ok, msg = aws.check_credentials()
+        assert not ok and 'credentials' in msg.lower()
+        monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AKID')
+        monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 's')
+        ok, _ = aws.check_credentials()
+        assert ok
