@@ -1,8 +1,9 @@
 """Model registry: name -> (flax module, config).
 
 Families: llama-* / llama3* (models/llama.py), mixtral-* MoE
-(models/moe.py).  The trainer and serving engine resolve models through
-`get_model` so new families plug in without touching the training loop.
+(models/moe.py), gemma-* (models/gemma.py), gpt2-* (models/gpt2.py).
+The trainer and serving engine resolve models through `get_model` so
+new families plug in without touching the training loop.
 """
 from __future__ import annotations
 
@@ -11,17 +12,24 @@ from typing import Any, Tuple
 
 def get_model(name: str, **overrides: Any) -> Tuple[Any, Any]:
     """Return (nn.Module instance, config) for a model name."""
-    from skypilot_tpu.models import llama, moe
+    from skypilot_tpu.models import gemma, gpt2, llama, moe
     if name in moe.CONFIGS:
         config = moe.get_config(name, **overrides)
         return moe.Mixtral(config), config
     if name in llama.CONFIGS:
         config = llama.get_config(name, **overrides)
         return llama.Llama(config), config
+    if name in gemma.CONFIGS:
+        config = gemma.get_config(name, **overrides)
+        return gemma.Gemma(config), config
+    if name in gpt2.CONFIGS:
+        config = gpt2.get_config(name, **overrides)
+        return gpt2.Gpt2(config), config
     raise ValueError(f'Unknown model {name!r}; '
                      f'available: {available_models()}')
 
 
 def available_models():
-    from skypilot_tpu.models import llama, moe
-    return sorted(llama.CONFIGS) + sorted(moe.CONFIGS)
+    from skypilot_tpu.models import gemma, gpt2, llama, moe
+    return (sorted(llama.CONFIGS) + sorted(moe.CONFIGS)
+            + sorted(gemma.CONFIGS) + sorted(gpt2.CONFIGS))

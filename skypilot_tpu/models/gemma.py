@@ -1,0 +1,126 @@
+"""Gemma model family, TPU-first (reference parity: llm/gemma/ serves
+Gemma via vLLM; here it is first-party like the Llama family).
+
+Architectural deltas from Llama (models/llama.py), all config-driven so
+the attention/MLP/block machinery is shared:
+  - GeGLU MLP (gelu(gate) * up) via `activation='gelu'`
+  - RMSNorm stores the weight as an offset from 1 (`norm_plus_one`)
+  - embeddings scaled by sqrt(dim) at lookup
+  - lm_head tied to the token embedding (logits = x @ embedᵀ)
+  - head_dim decoupled from dim (e.g. 7B: dim=3072, 16 heads × 256)
+  - optional final-logit softcapping (Gemma-2 convention)
+
+Sharing the blocks means Gemma inherits the Pallas flash/ring attention
+paths, GQA, KV-cache decode, scan + remat, and the logical-axis
+sharding rules without re-implementation.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmaConfig:
+    """Duck-typed against LlamaConfig: the shared blocks read these
+    fields plus `activation`/`norm_plus_one` via getattr."""
+    name: str
+    vocab_size: int = 256128
+    dim: int = 3072
+    n_layers: int = 28
+    n_heads: int = 16
+    n_kv_heads: int = 16
+    head_dim: int = 256
+    ffn_dim: int = 24576
+    max_seq_len: int = 8192
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    attention_impl: str = 'flash'
+    decode: bool = False
+    partition_params: bool = True
+    # Gemma-specific knobs consumed by the shared blocks / this module.
+    activation: str = 'gelu'
+    norm_plus_one: bool = True
+    final_logit_softcap: Optional[float] = None   # Gemma-2: 30.0
+
+
+CONFIGS: Dict[str, GemmaConfig] = {
+    'gemma-tiny': GemmaConfig('gemma-tiny', vocab_size=512, dim=128,
+                              n_layers=2, n_heads=2, n_kv_heads=1,
+                              head_dim=64, ffn_dim=256, max_seq_len=512),
+    'gemma-2b': GemmaConfig('gemma-2b', dim=2048, n_layers=18,
+                            n_heads=8, n_kv_heads=1, head_dim=256,
+                            ffn_dim=16384),
+    'gemma-7b': GemmaConfig('gemma-7b'),
+    'gemma2-9b': GemmaConfig('gemma2-9b', vocab_size=256128, dim=3584,
+                             n_layers=42, n_heads=16, n_kv_heads=8,
+                             head_dim=256, ffn_dim=14336,
+                             final_logit_softcap=30.0),
+}
+
+
+def get_config(name: str, **overrides: Any) -> GemmaConfig:
+    if name not in CONFIGS:
+        raise ValueError(f'Unknown gemma config {name!r}; '
+                         f'available: {sorted(CONFIGS)}')
+    return dataclasses.replace(CONFIGS[name], **overrides)
+
+
+class Gemma(nn.Module):
+    """Decoder-only transformer; returns logits [B, S, vocab]."""
+    config: GemmaConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 positions: Optional[jax.Array] = None,
+                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        if positions is None:
+            positions = llama.default_positions(tokens)
+        # Small init: the head is tied to this matrix, so (with the
+        # sqrt(dim) lookup scaling compensating on the input side)
+        # init-time logits stay O(sqrt(dim)*0.02), not O(sqrt(dim)).
+        embed = self.param(
+            'tok_embed',
+            llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(0.02), ('vocab', 'embed_fsdp'),
+                cfg.partition_params),
+            (cfg.vocab_size, cfg.dim), cfg.param_dtype)
+        x = llama.embed_lookup(cfg, embed, tokens)
+        # Gemma scales embeddings by sqrt(dim) at lookup.
+        x = (x.astype(jnp.float32) * (cfg.dim ** 0.5)).astype(cfg.dtype)
+
+        x = llama.apply_blocks(cfg, llama.Block, x, positions, kv_mask)
+        x = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                          plus_one=True, name='final_norm')(x)
+        # Tied head: logits against the embedding matrix (no lm_head
+        # params — Gemma ties embeddings).
+        kernel = embed
+        if isinstance(kernel, nn.Partitioned):
+            kernel = kernel.value
+        logits = jnp.einsum('bsd,vd->bsv', x.astype(jnp.float32),
+                            kernel.astype(jnp.float32))
+        if cfg.final_logit_softcap:
+            cap = cfg.final_logit_softcap
+            logits = cap * jnp.tanh(logits / cap)
+        return logits
+
+
+def num_params(config: GemmaConfig) -> int:
+    """Analytic parameter count (tied head: embed counted once)."""
+    cfg = config
+    per_layer = (cfg.dim * cfg.head_dim * (cfg.n_heads
+                                           + 2 * cfg.n_kv_heads)
+                 + cfg.n_heads * cfg.head_dim * cfg.dim
+                 + 3 * cfg.dim * cfg.ffn_dim + 2 * cfg.dim)
+    return cfg.vocab_size * cfg.dim + cfg.n_layers * per_layer + cfg.dim

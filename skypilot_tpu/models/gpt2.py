@@ -1,0 +1,206 @@
+"""GPT-2 model family, TPU-first (reference parity: llm/gpt-2/ runs
+Karpathy's llm.c build via SkyPilot; here the model is first-party).
+
+A second *architecture* family, not a Llama retune: LayerNorm with
+bias, learned positional embeddings (no rope), biased projections,
+single-head-group MHA, GELU MLP, tied lm_head.  Attention still runs on
+the shared Pallas flash kernel and params carry the same logical axis
+names, so fsdp/tensor sharding rules apply unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import flash_attention as fa
+
+
+@dataclasses.dataclass(frozen=True)
+class Gpt2Config:
+    name: str
+    vocab_size: int = 50257
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_seq_len: int = 1024
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    attention_impl: str = 'flash'
+    partition_params: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CONFIGS: Dict[str, Gpt2Config] = {
+    'gpt2-tiny': Gpt2Config('gpt2-tiny', vocab_size=512, dim=128,
+                            n_layers=2, n_heads=2, ffn_dim=256,
+                            max_seq_len=256),
+    'gpt2': Gpt2Config('gpt2'),
+    'gpt2-medium': Gpt2Config('gpt2-medium', dim=1024, n_layers=24,
+                              n_heads=16, ffn_dim=4096),
+    'gpt2-large': Gpt2Config('gpt2-large', dim=1280, n_layers=36,
+                             n_heads=20, ffn_dim=5120),
+    'gpt2-xl': Gpt2Config('gpt2-xl', dim=1600, n_layers=48, n_heads=25,
+                          ffn_dim=6400),
+}
+
+
+def get_config(name: str, **overrides: Any) -> Gpt2Config:
+    if name not in CONFIGS:
+        raise ValueError(f'Unknown gpt2 config {name!r}; '
+                         f'available: {sorted(CONFIGS)}')
+    if overrides.pop('decode', False):
+        # Fail fast with a clear message: the inference engine requests
+        # decode=True for every model; this family has no KV-cache path
+        # yet (train/finetune only).
+        raise ValueError(
+            'The gpt2 family does not support KV-cache serving yet; '
+            'serve a llama-* / gemma-* / mixtral-* model instead.')
+    return dataclasses.replace(CONFIGS[name], **overrides)
+
+
+def _pinit(init, names, partition):
+    return llama._partitioned_init(init, names, partition)  # pylint: disable=protected-access
+
+
+class LayerNorm(nn.Module):
+    eps: float
+    dtype: Any
+    partition: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d = x.shape[-1]
+        scale = self.param('scale',
+                           _pinit(nn.initializers.ones, ('embed',),
+                                  self.partition), (d,), jnp.float32)
+        bias = self.param('bias',
+                          _pinit(nn.initializers.zeros, ('embed',),
+                                 self.partition), (d,), jnp.float32)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean((xf - mean) ** 2, axis=-1, keepdims=True)
+        out = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        return (out * scale + bias).astype(self.dtype)
+
+
+class Gpt2Attention(nn.Module):
+    config: Gpt2Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        b, s, _ = x.shape
+        h, hd = cfg.n_heads, cfg.head_dim
+        dense = lambda features, names, name, init_std: nn.DenseGeneral(  # noqa: E731
+            features, axis=-1, use_bias=True, name=name,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=_pinit(nn.initializers.normal(init_std), names,
+                               cfg.partition_params))
+        qkv = dense((3, h, hd), ('embed_fsdp', None, 'heads', 'head_dim'),
+                    'qkv_proj', 0.02)(x)
+        q, k, v = (jnp.transpose(qkv[:, :, i], (0, 2, 1, 3))
+                   for i in range(3))
+        if cfg.attention_impl == 'flash':
+            out = fa.flash_attention(q, k, v)
+        else:
+            out = fa.mha_reference(q, k, v)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h * hd)
+        # GPT-2 scales residual-writing projections by 1/sqrt(2L).
+        return dense(cfg.dim, ('heads', 'embed_fsdp'), 'o_proj',
+                     0.02 / (2 * cfg.n_layers) ** 0.5)(out)
+
+
+class Gpt2Mlp(nn.Module):
+    config: Gpt2Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        up = nn.DenseGeneral(
+            cfg.ffn_dim, use_bias=True, name='up_proj', dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=_pinit(nn.initializers.normal(0.02),
+                               ('embed_fsdp', 'mlp'),
+                               cfg.partition_params))(x)
+        hidden = nn.gelu(up, approximate=True)
+        return nn.DenseGeneral(
+            cfg.dim, use_bias=True, name='down_proj', dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=_pinit(
+                nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
+                ('mlp', 'embed_fsdp'), cfg.partition_params))(hidden)
+
+
+class Gpt2Block(nn.Module):
+    config: Gpt2Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array,
+                 positions: Optional[jax.Array] = None,
+                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
+        # positions/kv_mask accepted for the shared apply_blocks
+        # signature; GPT-2 blocks need neither (absolute positions are
+        # added at the embedding, no KV cache).
+        del positions, kv_mask
+        cfg = self.config
+        x = x + Gpt2Attention(cfg, name='attention')(
+            LayerNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                      name='ln_1')(x))
+        x = x + Gpt2Mlp(cfg, name='mlp')(
+            LayerNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                      name='ln_2')(x))
+        return x
+
+
+class Gpt2(nn.Module):
+    """Decoder-only transformer; returns logits [B, S, vocab]."""
+    config: Gpt2Config
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 positions: Optional[jax.Array] = None,
+                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        if positions is None:
+            positions = llama.default_positions(tokens)
+        embed = self.param(
+            'tok_embed',
+            _pinit(nn.initializers.normal(0.02), ('vocab', 'embed_fsdp'),
+                   cfg.partition_params),
+            (cfg.vocab_size, cfg.dim), cfg.param_dtype)
+        pos_embed = self.param(
+            'pos_embed',
+            _pinit(nn.initializers.normal(0.01), (None, 'embed_fsdp'),
+                   cfg.partition_params),
+            (cfg.max_seq_len, cfg.dim), cfg.param_dtype)
+        x = (jnp.take(embed, tokens, axis=0)
+             + jnp.take(pos_embed, positions, axis=0)).astype(cfg.dtype)
+
+        x = llama.apply_blocks(cfg, Gpt2Block, x, positions, kv_mask)
+        x = LayerNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                      name='ln_f')(x)
+        # Tied lm_head (GPT-2 ties input/output embeddings).
+        logits = jnp.einsum('bsd,vd->bsv', x.astype(jnp.float32),
+                            embed.astype(jnp.float32))
+        return logits
+
+
+def num_params(config: Gpt2Config) -> int:
+    cfg = config
+    per_layer = (4 * cfg.dim * cfg.dim + 3 * cfg.dim + cfg.dim   # attn
+                 + 2 * cfg.dim * cfg.ffn_dim + cfg.ffn_dim + cfg.dim
+                 + 4 * cfg.dim)                                  # 2 LN
+    return (cfg.vocab_size * cfg.dim + cfg.max_seq_len * cfg.dim
+            + cfg.n_layers * per_layer + 2 * cfg.dim)

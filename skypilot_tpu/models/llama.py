@@ -122,13 +122,20 @@ class RMSNorm(nn.Module):
     eps: float
     dtype: Any
     partition: bool = True
+    # Gemma convention: weight stored as an offset from 1 and
+    # initialized to zero ((1 + scale) * x̂).
+    plus_one: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        init = (nn.initializers.zeros if self.plus_one
+                else nn.initializers.ones)
         scale = self.param('scale',
-                           _partitioned_init(nn.initializers.ones,
-                                             ('embed',), self.partition),
+                           _partitioned_init(init, ('embed',),
+                                             self.partition),
                            (x.shape[-1],), jnp.float32)
+        if self.plus_one:
+            scale = 1.0 + scale
         xf = x.astype(jnp.float32)
         norm = jax.lax.rsqrt(
             jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
@@ -260,7 +267,12 @@ class MLP(nn.Module):
                                           names, cfg.partition_params))
         gate = dense(cfg.ffn_dim, ('embed_fsdp', 'mlp'), 'gate_proj')(x)
         up = dense(cfg.ffn_dim, ('embed_fsdp', 'mlp'), 'up_proj')(x)
-        hidden = nn.silu(gate) * up
+        # Gated-MLP activation: Llama uses SiLU; Gemma's GeGLU plugs in
+        # through the config (duck-typed field, default silu).
+        act = getattr(cfg, 'activation', 'silu')
+        act_fn = (nn.silu if act == 'silu'
+                  else lambda g: nn.gelu(g, approximate=True))
+        hidden = act_fn(gate) * up
         return dense(cfg.dim, ('mlp', 'embed_fsdp'), 'down_proj')(hidden)
 
 
@@ -271,14 +283,45 @@ class Block(nn.Module):
     def __call__(self, x: jax.Array, positions: jax.Array,
                  kv_mask: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
+        plus_one = getattr(cfg, 'norm_plus_one', False)
         x = x + Attention(cfg, name='attention')(
             RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
-                    name='attention_norm')(x),
+                    plus_one, name='attention_norm')(x),
             positions, kv_mask)
         x = x + MLP(cfg, name='mlp')(
             RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
-                    name='mlp_norm')(x))
+                    plus_one, name='mlp_norm')(x))
         return x
+
+
+def apply_blocks(cfg, block_base, x: jax.Array, positions: jax.Array,
+                 kv_mask: Optional[jax.Array]) -> jax.Array:
+    """Run the layer stack with the cfg's remat/scan policy — shared by
+    every decoder family (Llama/Gemma/GPT-2) so the scan metadata,
+    remat policy, and cache axes can never diverge between them.  Must
+    be called from inside the parent's @nn.compact __call__."""
+    block_cls = block_base
+    if cfg.remat:
+        block_cls = nn.remat(
+            block_base, prevent_cse=not cfg.scan_layers,
+            policy=jax.checkpoint_policies.nothing_saveable)
+    if cfg.scan_layers:
+        variable_axes = {'params': 0}
+        if getattr(cfg, 'decode', False):
+            variable_axes['cache'] = 0
+        x, _ = nn.scan(
+            lambda mod, carry, _: (mod(carry, positions, kv_mask),
+                                   None),
+            variable_axes=variable_axes,
+            split_rngs={'params': True},
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: 'layers'},
+        )(block_cls(cfg, name='layers'), x, None)
+    else:
+        for i in range(cfg.n_layers):
+            x = block_cls(cfg, name=f'layer_{i}')(x, positions,
+                                                  kv_mask)
+    return x
 
 
 class Llama(nn.Module):
@@ -299,28 +342,7 @@ class Llama(nn.Module):
                               cfg.partition_params),
             (cfg.vocab_size, cfg.dim), cfg.param_dtype)
         x = embed_lookup(cfg, embed, tokens)
-
-        block_cls = Block
-        if cfg.remat:
-            block_cls = nn.remat(
-                Block, prevent_cse=not cfg.scan_layers,
-                policy=jax.checkpoint_policies.nothing_saveable)
-        if cfg.scan_layers:
-            variable_axes = {'params': 0}
-            if cfg.decode:
-                variable_axes['cache'] = 0
-            x, _ = nn.scan(
-                lambda mod, carry, _: (mod(carry, positions, kv_mask),
-                                       None),
-                variable_axes=variable_axes,
-                split_rngs={'params': True},
-                length=cfg.n_layers,
-                metadata_params={nn.PARTITION_NAME: 'layers'},
-            )(block_cls(cfg, name='layers'), x, None)
-        else:
-            for i in range(cfg.n_layers):
-                x = block_cls(cfg, name=f'layer_{i}')(x, positions,
-                                                      kv_mask)
+        x = apply_blocks(cfg, Block, x, positions, kv_mask)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                     name='final_norm')(x)
         # Tied-untied: separate output head (Llama3 unties embeddings).

@@ -165,12 +165,13 @@ class Trainer:
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(
             config.mesh)
         tensor = self.mesh.shape['tensor']
-        if (self.model_config.n_heads % tensor or
-                self.model_config.n_kv_heads % tensor):
+        # Families without grouped KV (e.g. GPT-2) have no n_kv_heads.
+        n_kv = getattr(self.model_config, 'n_kv_heads',
+                       self.model_config.n_heads)
+        if self.model_config.n_heads % tensor or n_kv % tensor:
             raise ValueError(
                 f'tensor parallelism {tensor} must divide n_heads='
-                f'{self.model_config.n_heads} and n_kv_heads='
-                f'{self.model_config.n_kv_heads} '
+                f'{self.model_config.n_heads} and n_kv_heads={n_kv} '
                 f'(model {self.model_config.name!r}).')
         n_batch = mesh_lib.num_batch_shards(self.mesh)
         micro = config.global_batch_size // max(config.grad_accum_steps, 1)

@@ -1,0 +1,204 @@
+"""Gemma + GPT-2 family tests: forward shapes, architectural deltas
+(tied heads, GeGLU, plus-one norms, learned positions), causality,
+trainer integration on the 8-device mesh, registry dispatch."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import models
+from skypilot_tpu.models import gemma
+from skypilot_tpu.models import gpt2
+from skypilot_tpu.parallel import sharding as sharding_lib
+
+
+def _count(tree):
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+class TestGemma:
+
+    def test_forward_shape_and_registry(self):
+        model, cfg = models.get_model('gemma-tiny', remat=False)
+        tokens = jnp.zeros((2, 32), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        logits = model.apply(variables, tokens)
+        assert logits.shape == (2, 32, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_tied_head_no_lm_head_params(self):
+        model, cfg = models.get_model('gemma-tiny', remat=False)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))
+        params = sharding_lib.unbox(variables['params'])
+        assert 'lm_head' not in params  # tied to tok_embed
+        assert _count(params) == gemma.num_params(cfg)
+
+    def test_plus_one_norm_and_geglu_in_effect(self):
+        """At init the RMSNorm offset param is all zeros (scale==1
+        effective); the MLP must be GeGLU (gelu-gated)."""
+        model, cfg = models.get_model('gemma-tiny', remat=False,
+                                      scan_layers=False)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))
+        params = sharding_lib.unbox(variables['params'])
+        scale = params['layer_0']['attention_norm']['scale']
+        np.testing.assert_array_equal(np.asarray(scale), 0.0)
+        assert cfg.activation == 'gelu' and cfg.norm_plus_one
+
+    def test_embed_scaling_changes_output(self):
+        """sqrt(dim) embedding scaling is load-bearing: a no-scale
+        forward differs."""
+        cfg = gemma.get_config('gemma-tiny', remat=False,
+                               dtype=jnp.float32)
+        model = gemma.Gemma(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0,
+                                    cfg.vocab_size)
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        out = model.apply(variables, tokens)
+        assert jnp.isfinite(out).all()
+        # Scaled embeddings at init have RMS ≈ 1 (normal(1.0) * sqrt(d)
+        # / sqrt(d) ... sanity: outputs are in a sane range, not 1e-2).
+        assert jnp.abs(out).max() > 1e-2
+
+    def test_causality(self):
+        cfg = gemma.get_config('gemma-tiny', remat=False,
+                               dtype=jnp.float32)
+        model = gemma.Gemma(cfg)
+        t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0,
+                                cfg.vocab_size)
+        t2 = t1.at[0, -1].set((t1[0, -1] + 1) % cfg.vocab_size)
+        variables = model.init(jax.random.PRNGKey(0), t1)
+        o1 = model.apply(variables, t1)
+        o2 = model.apply(variables, t2)
+        np.testing.assert_allclose(o1[0, :-1], o2[0, :-1], atol=1e-5)
+
+    def test_logit_softcap(self):
+        cfg = gemma.get_config('gemma-tiny', remat=False,
+                               final_logit_softcap=5.0)
+        model = gemma.Gemma(cfg)
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        logits = model.apply(variables, tokens)
+        assert jnp.abs(logits).max() <= 5.0
+
+    def test_decode_cache_matches_full_forward(self):
+        """Token-by-token decode through the shared KV cache must match
+        the full (non-decode) forward."""
+        cfg_full = gemma.get_config('gemma-tiny', remat=False,
+                                    dtype=jnp.float32,
+                                    attention_impl='reference')
+        cfg_dec = gemma.get_config('gemma-tiny', remat=False,
+                                   dtype=jnp.float32, decode=True,
+                                   max_seq_len=16)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                    cfg_full.vocab_size)
+        m_full = gemma.Gemma(cfg_full)
+        variables = m_full.init(jax.random.PRNGKey(0), tokens)
+        full_logits = m_full.apply(variables, tokens)
+
+        m_dec = gemma.Gemma(cfg_dec)
+        # init() runs the module body (cursor advances past the dummy
+        # token): start decoding from a pristine zero cache, as the
+        # inference engine does (infer/engine.py eval_shape + zeros).
+        cache = jax.tree.map(
+            jnp.zeros_like,
+            m_dec.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 1), jnp.int32))['cache'])
+        step_logits = []
+        for i in range(tokens.shape[1]):
+            out, mut = m_dec.apply(
+                {'params': variables['params'], 'cache': cache},
+                tokens[:, i:i + 1],
+                jnp.full((1, 1), i, jnp.int32),
+                mutable=['cache'])
+            cache = mut['cache']
+            step_logits.append(out[:, 0])
+        np.testing.assert_allclose(
+            jnp.stack(step_logits, axis=1), full_logits,
+            atol=2e-3, rtol=2e-3)
+
+
+class TestGpt2:
+
+    def test_forward_shape_and_registry(self):
+        model, cfg = models.get_model('gpt2-tiny', remat=False)
+        tokens = jnp.zeros((2, 32), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        logits = model.apply(variables, tokens)
+        assert logits.shape == (2, 32, cfg.vocab_size)
+
+    def test_param_count_and_tied_head(self):
+        model, cfg = models.get_model('gpt2-tiny', remat=False)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))
+        params = sharding_lib.unbox(variables['params'])
+        assert 'lm_head' not in params
+        assert _count(params) == gpt2.num_params(cfg)
+
+    def test_positions_are_learned_not_rotary(self):
+        """Same tokens at different positions must produce different
+        logits (learned absolute positions)."""
+        cfg = gpt2.get_config('gpt2-tiny', remat=False,
+                              dtype=jnp.float32)
+        model = gpt2.Gpt2(cfg)
+        tokens = jnp.full((1, 4), 7, jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        p0 = model.apply(variables, tokens,
+                         jnp.arange(4, dtype=jnp.int32)[None])
+        p5 = model.apply(variables, tokens,
+                         (jnp.arange(4, dtype=jnp.int32) + 5)[None])
+        assert not np.allclose(np.asarray(p0), np.asarray(p5))
+        params = sharding_lib.unbox(variables['params'])
+        assert 'pos_embed' in params
+
+    def test_causality(self):
+        cfg = gpt2.get_config('gpt2-tiny', remat=False,
+                              dtype=jnp.float32)
+        model = gpt2.Gpt2(cfg)
+        t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0,
+                                cfg.vocab_size)
+        t2 = t1.at[0, -1].set((t1[0, -1] + 1) % cfg.vocab_size)
+        variables = model.init(jax.random.PRNGKey(0), t1)
+        o1 = model.apply(variables, t1)
+        o2 = model.apply(variables, t2)
+        np.testing.assert_allclose(o1[0, :-1], o2[0, :-1], atol=1e-5)
+
+    def test_gpt2_full_size_param_count(self):
+        # The canonical GPT-2 small is ~124M params.
+        assert 123e6 < gpt2.num_params(gpt2.CONFIGS['gpt2']) < 126e6
+
+    def test_serving_rejected_with_clear_error(self):
+        # The inference engine always passes decode=True; this family
+        # must fail fast with guidance, not an opaque TypeError.
+        with pytest.raises(ValueError, match='serving'):
+            models.get_model('gpt2-tiny', decode=True)
+
+
+class TestTrainerIntegration:
+
+    @pytest.mark.parametrize('name', ['gemma-tiny', 'gpt2-tiny'])
+    def test_sharded_train_loss_decreases(self, name):
+        """Both new families must train sharded (data x fsdp mesh) out
+        of the box — logical axis names feed the same sharding rules."""
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.train import data as data_lib
+        from skypilot_tpu.train import trainer as trainer_lib
+        config = trainer_lib.TrainConfig(
+            model=name, global_batch_size=8, seq_len=32,
+            total_steps=12, warmup_steps=1,
+            mesh=mesh_lib.MeshConfig(data=2, fsdp=-1),
+            model_overrides={'max_seq_len': 64})
+        trainer = trainer_lib.Trainer(config)
+        trainer.init_state()
+        data_iter = data_lib.synthetic_data(
+            trainer.mesh, global_batch_size=8, seq_len=32,
+            vocab_size=trainer.model_config.vocab_size)
+        batch = next(data_iter)
+        first = last = None
+        for _ in range(12):
+            metrics = trainer.step(batch)
+            loss = float(jax.device_get(metrics['loss']))
+            first = first if first is not None else loss
+            last = loss
+        assert last < first, (first, last)
