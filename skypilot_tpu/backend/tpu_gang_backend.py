@@ -345,7 +345,7 @@ class TpuGangBackend(backend_lib.Backend):
                           storage_mounts: Optional[Dict[str, Any]]) -> None:
         for target, source in (all_file_mounts or {}).items():
             if source.startswith(('s3://', 'gs://', 'gcs://', 'r2://',
-                                  'http://', 'https://')):
+                                  'az://', 'http://', 'https://')):
                 from skypilot_tpu.data import cloud_stores
                 cmd = cloud_stores.make_download_command(source, target)
 

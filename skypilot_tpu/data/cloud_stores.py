@@ -24,6 +24,27 @@ def make_download_command(source: str, target: str) -> str:
         return (f'{mkdir} && aws s3 cp --recursive {quoted_source} '
                 f'{quoted_target} 2>/dev/null || aws s3 cp '
                 f'{quoted_source} {quoted_target}')
+    if source.startswith('r2://'):
+        from skypilot_tpu.data import storage as storage_lib
+        endpoint = storage_lib.R2Store.endpoint_url()
+        s3_src = shlex.quote(source.replace('r2://', 's3://', 1))
+        prefix = ('AWS_SHARED_CREDENTIALS_FILE='
+                  f'{storage_lib.R2Store.CREDENTIALS_FILE} '
+                  f'aws --profile {storage_lib.R2Store.PROFILE} '
+                  f'--endpoint-url {endpoint} ')
+        return (f'{mkdir} && {prefix}s3 cp --recursive {s3_src} '
+                f'{quoted_target} 2>/dev/null || {prefix}s3 cp '
+                f'{s3_src} {quoted_target}')
+    if source.startswith('az://'):
+        from skypilot_tpu.data import storage as storage_lib
+        account = storage_lib.AzureBlobStore.storage_account()
+        url = (f'https://{account}.blob.core.windows.net/'
+               + source[len('az://'):])
+        return (f'{mkdir} && azcopy copy {shlex.quote(url)} '
+                f'{quoted_target} --recursive')
+    if '.blob.core.windows.net' in source:
+        return (f'{mkdir} && azcopy copy {quoted_source} '
+                f'{quoted_target} --recursive')
     if source.startswith(('http://', 'https://')):
         return (f'{mkdir} && (wget -q {quoted_source} -O {quoted_target} '
                 f'|| curl -fsSL {quoted_source} -o {quoted_target})')

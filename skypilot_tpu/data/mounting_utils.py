@@ -43,15 +43,50 @@ def make_goofys_install_command() -> str:
         'sudo chmod +x /usr/local/bin/goofys)')
 
 
-def make_goofys_mount_command(bucket_name: str, mount_path: str) -> str:
+def make_goofys_mount_command(bucket_name: str, mount_path: str,
+                              endpoint: str = '',
+                              profile: str = '',
+                              credentials_file: str = '') -> str:
     """Idempotent S3 FUSE mount (reference mounting_utils goofys
-    command builder)."""
+    command builder).  `endpoint`/`profile`/`credentials_file` support
+    S3-compatible stores (R2)."""
+    env = (f'AWS_SHARED_CREDENTIALS_FILE={credentials_file} '
+           if credentials_file else '')
+    flags = ''
+    if endpoint:
+        flags += f' --endpoint {endpoint}'
+    if profile:
+        flags += f' --profile {profile}'
     return (
         f'{make_goofys_install_command()}; '
         f'mkdir -p {mount_path}; '
         f'mountpoint -q {mount_path} || '
-        f'goofys --stat-cache-ttl 5s --type-cache-ttl 5s '
+        f'{env}goofys --stat-cache-ttl 5s --type-cache-ttl 5s{flags} '
         f'{bucket_name} {mount_path}')
+
+
+def make_blobfuse2_install_command() -> str:
+    return ('command -v blobfuse2 >/dev/null 2>&1 || ('
+            'sudo apt-get update -qq && '
+            'sudo apt-get install -y -qq blobfuse2)')
+
+
+def make_blobfuse2_mount_command(storage_account: str,
+                                 container_name: str,
+                                 mount_path: str) -> str:
+    """Idempotent Azure Blob FUSE mount (reference mounting_utils
+    blobfuse2 command builder)."""
+    return (
+        f'{make_blobfuse2_install_command()}; '
+        f'mkdir -p {mount_path}; '
+        f'mountpoint -q {mount_path} || '
+        f'AZURE_STORAGE_ACCOUNT={storage_account} '
+        f'blobfuse2 mount {mount_path} '
+        f'--container-name {container_name} --use-adls=false '
+        f'-o allow_other 2>/dev/null || '
+        f'AZURE_STORAGE_ACCOUNT={storage_account} '
+        f'blobfuse2 mount {mount_path} '
+        f'--container-name {container_name} --use-adls=false')
 
 
 def make_unmount_command(mount_path: str) -> str:

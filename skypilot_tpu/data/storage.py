@@ -34,6 +34,8 @@ class StorageMode(enum.Enum):
 class StoreType(enum.Enum):
     GCS = 'GCS'
     S3 = 'S3'
+    R2 = 'R2'
+    AZURE = 'AZURE'
     LOCAL = 'LOCAL'
 
     @classmethod
@@ -42,6 +44,10 @@ class StoreType(enum.Enum):
             return cls.GCS
         if url.startswith('s3://'):
             return cls.S3
+        if url.startswith('r2://'):
+            return cls.R2
+        if url.startswith('az://') or '.blob.core.windows.net' in url:
+            return cls.AZURE
         if url.startswith('local://') or url.startswith('/'):
             return cls.LOCAL
         raise exceptions.StorageSourceError(f'Unknown store URL: {url}')
@@ -187,6 +193,145 @@ class S3Store(AbstractStore):
             self.name, mount_path)
 
 
+class R2Store(S3Store):
+    """Cloudflare R2 via the aws CLI against the R2 S3-compatible
+    endpoint (reference storage.py:3071 R2Store: same mechanism —
+    AWS_SHARED_CREDENTIALS_FILE=~/.cloudflare/r2.credentials with an
+    `r2` profile + --endpoint-url)."""
+
+    CREDENTIALS_FILE = '~/.cloudflare/r2.credentials'
+    PROFILE = 'r2'
+
+    @staticmethod
+    def endpoint_url() -> str:
+        from skypilot_tpu import config as config_lib
+        account = os.environ.get('R2_ACCOUNT_ID') or config_lib.get_nested(
+            ('r2', 'account_id'), None)
+        if not account:
+            raise exceptions.StorageError(
+                'R2 needs an account id: set R2_ACCOUNT_ID or '
+                'config r2.account_id.')
+        return f'https://{account}.r2.cloudflarestorage.com'
+
+    def url(self) -> str:
+        return f'r2://{self.name}'
+
+    def _s3_url(self) -> str:
+        return f's3://{self.name}'
+
+    def _run(self, args: List[str], check: bool = True
+             ) -> subprocess.CompletedProcess:
+        env = dict(os.environ)
+        env.setdefault('AWS_SHARED_CREDENTIALS_FILE',
+                       os.path.expanduser(self.CREDENTIALS_FILE))
+        # The r2:// scheme is ours; the CLI speaks s3:// + endpoint.
+        args = [a.replace('r2://', 's3://', 1)
+                if isinstance(a, str) and a.startswith('r2://') else a
+                for a in args]
+        return subprocess.run(
+            ['aws', '--profile', self.PROFILE,
+             '--endpoint-url', self.endpoint_url()] + args,
+            capture_output=True, text=True, check=check, env=env)
+
+    def make_sync_dir_command(self, dst: str) -> str:
+        endpoint = self.endpoint_url()
+        return (f'mkdir -p {dst} && '
+                f'AWS_SHARED_CREDENTIALS_FILE={self.CREDENTIALS_FILE} '
+                f'aws --profile {self.PROFILE} --endpoint-url {endpoint} '
+                f's3 sync {self._s3_url()} {dst}')
+
+    def make_mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.make_goofys_mount_command(
+            self.name, mount_path, endpoint=self.endpoint_url(),
+            profile=self.PROFILE,
+            credentials_file=self.CREDENTIALS_FILE)
+
+
+class AzureBlobStore(AbstractStore):
+    """Azure Blob container via the az CLI + azcopy, blobfuse2 for
+    MOUNT (reference storage.py:2232 AzureBlobStore — same tools)."""
+
+    @staticmethod
+    def storage_account() -> str:
+        from skypilot_tpu import config as config_lib
+        account = (os.environ.get('AZURE_STORAGE_ACCOUNT')
+                   or config_lib.get_nested(('azure', 'storage_account'),
+                                            None))
+        if not account:
+            raise exceptions.StorageError(
+                'Azure needs a storage account: set '
+                'AZURE_STORAGE_ACCOUNT or config azure.storage_account.')
+        return account
+
+    def url(self) -> str:
+        return (f'https://{self.storage_account()}.blob.core.windows.net/'
+                f'{self.name}')
+
+    def _run(self, args: List[str], check: bool = True
+             ) -> subprocess.CompletedProcess:
+        return subprocess.run(['az'] + args, capture_output=True,
+                              text=True, check=check)
+
+    def exists(self) -> bool:
+        proc = self._run(['storage', 'container', 'exists', '--name',
+                          self.name, '--account-name',
+                          self.storage_account()], check=False)
+        return proc.returncode == 0 and '"exists": true' in proc.stdout
+
+    def create(self) -> None:
+        proc = self._run(['storage', 'container', 'create', '--name',
+                          self.name, '--account-name',
+                          self.storage_account()], check=False)
+        if proc.returncode != 0 and \
+                'ContainerAlreadyExists' not in proc.stderr:
+            raise exceptions.StorageBucketCreateError(
+                f'Failed to create {self.url()}: {proc.stderr}')
+
+    def upload(self, sources: List[str]) -> None:
+        from skypilot_tpu.data import storage_utils
+        for source in sources:
+            src = os.path.expanduser(source)
+            if os.path.isdir(src):
+                args = ['storage', 'blob', 'sync', '--container',
+                        self.name, '--account-name',
+                        self.storage_account(), '--source', src]
+                patterns = storage_utils.read_excluded_patterns(src)
+                if patterns:
+                    # az blob sync wraps azcopy: semicolon-joined
+                    # wildcard patterns, matched at any depth.
+                    args += ['--exclude-pattern', ';'.join(patterns)]
+            else:
+                args = ['storage', 'blob', 'upload', '--container-name',
+                        self.name, '--account-name',
+                        self.storage_account(), '--file', src,
+                        '--overwrite']
+            proc = self._run(args, check=False)
+            if proc.returncode != 0:
+                raise exceptions.StorageError(
+                    f'Upload {src} -> {self.url()} failed: {proc.stderr}')
+
+    def delete(self) -> None:
+        proc = self._run(['storage', 'container', 'delete', '--name',
+                          self.name, '--account-name',
+                          self.storage_account()], check=False)
+        if proc.returncode != 0 and \
+                'ContainerNotFound' not in proc.stderr:
+            raise exceptions.StorageBucketDeleteError(
+                f'Failed to delete {self.url()}: {proc.stderr}')
+
+    def make_sync_dir_command(self, dst: str) -> str:
+        account = self.storage_account()
+        return (f'mkdir -p {dst} && azcopy sync '
+                f'"https://{account}.blob.core.windows.net/{self.name}" '
+                f'{dst} --recursive')
+
+    def make_mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.make_blobfuse2_mount_command(
+            self.storage_account(), self.name, mount_path)
+
+
 class LocalStore(AbstractStore):
     """Directory-backed store for tests/local clusters."""
 
@@ -233,6 +378,8 @@ class LocalStore(AbstractStore):
 _STORE_CLASSES = {
     StoreType.GCS: GcsStore,
     StoreType.S3: S3Store,
+    StoreType.R2: R2Store,
+    StoreType.AZURE: AzureBlobStore,
     StoreType.LOCAL: LocalStore,
 }
 
@@ -260,7 +407,18 @@ class Storage:
                 'Storage needs a name and/or a source.')
         if self.name is None:
             assert self.source is not None
-            if self.source.startswith(('gs://', 's3://', 'gcs://')):
+            if '.blob.core.windows.net' in self.source:
+                # https://<account>.blob.core.windows.net/<container>[/..]
+                _, sep, rest = self.source.partition(
+                    '.blob.core.windows.net/')
+                container = rest.split('/')[0] if sep else ''
+                if not container:
+                    raise exceptions.StorageSourceError(
+                        f'Azure blob URL {self.source!r} has no '
+                        'container name.')
+                self.name = container
+            elif self.source.startswith(('gs://', 's3://', 'gcs://',
+                                         'r2://', 'az://')):
                 self.name = self.source.split('://', 1)[1].split('/')[0]
             else:
                 self.name = os.path.basename(

@@ -62,10 +62,11 @@ CONFIGS: Dict[str, GemmaConfig] = {
                             n_heads=8, n_kv_heads=1, head_dim=256,
                             ffn_dim=16384),
     'gemma-7b': GemmaConfig('gemma-7b'),
-    'gemma2-9b': GemmaConfig('gemma2-9b', vocab_size=256128, dim=3584,
-                             n_layers=42, n_heads=16, n_kv_heads=8,
-                             head_dim=256, ffn_dim=14336,
-                             final_logit_softcap=30.0),
+    # NOTE: no gemma2-* configs yet — real Gemma-2 additionally has
+    # post-layernorms, attention-logit softcapping, and alternating
+    # local/global attention; shipping a half-faithful config under
+    # that name would silently diverge from published checkpoints.
+    # The final_logit_softcap knob is available for experimentation.
 }
 
 
@@ -104,12 +105,10 @@ class Gemma(nn.Module):
         x = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                           plus_one=True, name='final_norm')(x)
         # Tied head: logits against the embedding matrix (no lm_head
-        # params — Gemma ties embeddings).
-        kernel = embed
-        if isinstance(kernel, nn.Partitioned):
-            kernel = kernel.value
+        # params — Gemma ties embeddings; self.param returns the
+        # unboxed array).
         logits = jnp.einsum('bsd,vd->bsv', x.astype(jnp.float32),
-                            kernel.astype(jnp.float32))
+                            embed.astype(jnp.float32))
         if cfg.final_logit_softcap:
             cap = cfg.final_logit_softcap
             logits = cap * jnp.tanh(logits / cap)

@@ -338,4 +338,4 @@ class Task:
 
 
 def _is_cloud_store_url(url: str) -> bool:
-    return bool(re.match(r'^(s3|gs|gcs|r2|cos|https?)://', url))
+    return bool(re.match(r'^(s3|gs|gcs|r2|az|cos|https?)://', url))

@@ -210,3 +210,137 @@ class TestTransfer:
         assert spec['awsS3DataSource']['bucketName'] == 'src-bkt'
         assert spec['gcsDataSink']['bucketName'] == 'dst-bkt'
         assert requests[1][1].endswith(':run')
+
+
+class TestR2Store:
+
+    @pytest.fixture(autouse=True)
+    def _account(self, monkeypatch):
+        monkeypatch.setenv('R2_ACCOUNT_ID', 'acct123')
+
+    def test_endpoint_and_url(self):
+        store = storage_lib.R2Store('bkt', None)
+        assert store.url() == 'r2://bkt'
+        assert storage_lib.R2Store.endpoint_url() == \
+            'https://acct123.r2.cloudflarestorage.com'
+
+    def test_cli_gets_endpoint_profile_and_credentials(self, monkeypatch):
+        calls = []
+
+        def fake_run(cmd, **kwargs):
+            calls.append((cmd, kwargs.get('env', {})))
+            return subprocess.CompletedProcess(cmd, 0, '', '')
+
+        monkeypatch.setattr(subprocess, 'run', fake_run)
+        store = storage_lib.R2Store('bkt', None)
+        store.create()
+        cmd, env = calls[0]
+        assert cmd[:3] == ['aws', '--profile', 'r2']
+        assert '--endpoint-url' in cmd
+        assert 'acct123.r2.cloudflarestorage.com' in \
+            cmd[cmd.index('--endpoint-url') + 1]
+        # r2:// rewritten to s3:// for the CLI.
+        assert any(a == 's3://bkt' for a in cmd)
+        assert env.get('AWS_SHARED_CREDENTIALS_FILE', '').endswith(
+            '.cloudflare/r2.credentials')
+
+    def test_sync_and_mount_commands(self):
+        store = storage_lib.R2Store('bkt', None)
+        sync = store.make_sync_dir_command('/data')
+        assert 's3 sync s3://bkt /data' in sync
+        assert '--endpoint-url https://acct123' in sync
+        mount = store.make_mount_command('/mnt/r2')
+        assert 'goofys' in mount and '--endpoint' in mount
+        assert '--profile r2' in mount
+
+    def test_storage_routes_r2_scheme(self):
+        s = storage_lib.Storage(source='r2://my-bucket/prefix')
+        assert s.store_type == storage_lib.StoreType.R2
+        assert s.name == 'my-bucket'
+
+    def test_missing_account_is_clear_error(self, monkeypatch):
+        monkeypatch.delenv('R2_ACCOUNT_ID')
+        with pytest.raises(exceptions.StorageError, match='account'):
+            storage_lib.R2Store.endpoint_url()
+
+    def test_download_command(self):
+        from skypilot_tpu.data import cloud_stores
+        cmd = cloud_stores.make_download_command('r2://bkt/ckpt', '/ckpt')
+        assert '--endpoint-url https://acct123' in cmd
+        assert 's3 cp' in cmd and 's3://bkt/ckpt' in cmd
+
+
+class TestAzureBlobStore:
+
+    @pytest.fixture(autouse=True)
+    def _account(self, monkeypatch):
+        monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'skyacct')
+
+    def test_url_and_name_derivation(self):
+        store = storage_lib.AzureBlobStore('ctr', None)
+        assert store.url() == \
+            'https://skyacct.blob.core.windows.net/ctr'
+        s = storage_lib.Storage(
+            source='https://skyacct.blob.core.windows.net/data-ctr/x')
+        assert s.store_type == storage_lib.StoreType.AZURE
+        assert s.name == 'data-ctr'
+        s2 = storage_lib.Storage(source='az://ctr2')
+        assert s2.store_type == storage_lib.StoreType.AZURE
+
+    def test_az_cli_commands(self, monkeypatch):
+        calls = []
+
+        def fake_run(cmd, **kwargs):
+            calls.append(cmd)
+            return subprocess.CompletedProcess(cmd, 0,
+                                               '"exists": true', '')
+
+        monkeypatch.setattr(subprocess, 'run', fake_run)
+        store = storage_lib.AzureBlobStore('ctr', None)
+        store.create()
+        assert calls[-1][:4] == ['az', 'storage', 'container', 'create']
+        assert store.exists()
+        store.delete()
+        assert calls[-1][:4] == ['az', 'storage', 'container', 'delete']
+
+    def test_sync_and_mount_commands(self):
+        store = storage_lib.AzureBlobStore('ctr', None)
+        sync = store.make_sync_dir_command('/data')
+        assert 'azcopy sync' in sync
+        assert 'skyacct.blob.core.windows.net/ctr' in sync
+        mount = store.make_mount_command('/mnt/az')
+        assert 'blobfuse2 mount /mnt/az' in mount
+        assert '--container-name ctr' in mount
+        assert 'AZURE_STORAGE_ACCOUNT=skyacct' in mount
+
+    def test_download_command(self):
+        from skypilot_tpu.data import cloud_stores
+        cmd = cloud_stores.make_download_command(
+            'https://skyacct.blob.core.windows.net/ctr/model', '/model')
+        assert 'azcopy copy' in cmd and '--recursive' in cmd
+
+    def test_az_scheme_download_and_errors(self):
+        from skypilot_tpu.data import cloud_stores
+        cmd = cloud_stores.make_download_command('az://ctr/model', '/m')
+        assert 'azcopy copy' in cmd
+        assert 'skyacct.blob.core.windows.net/ctr/model' in cmd
+        with pytest.raises(exceptions.StorageSourceError,
+                           match='container'):
+            storage_lib.Storage(
+                source='https://skyacct.blob.core.windows.net')
+
+    def test_upload_applies_skyignore(self, monkeypatch, tmp_path):
+        calls = []
+
+        def fake_run(cmd, **kwargs):
+            calls.append(cmd)
+            return subprocess.CompletedProcess(cmd, 0, '', '')
+
+        monkeypatch.setattr(subprocess, 'run', fake_run)
+        (tmp_path / '.skyignore').write_text('__pycache__\n*.log\n')
+        (tmp_path / 'f.txt').write_text('x')
+        storage_lib.AzureBlobStore('ctr', str(tmp_path)).upload(
+            [str(tmp_path)])
+        (cmd,) = calls
+        assert '--exclude-pattern' in cmd
+        assert '__pycache__;*.log' in cmd
