@@ -52,6 +52,10 @@ class GemmaConfig:
     activation: str = 'gelu'
     norm_plus_one: bool = True
     final_logit_softcap: Optional[float] = None   # Gemma-2: 30.0
+    # LoRA (shared llama.maybe_lora machinery).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple = ('q_proj', 'k_proj', 'v_proj', 'o_proj')
 
 
 CONFIGS: Dict[str, GemmaConfig] = {

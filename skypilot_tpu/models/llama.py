@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import flax.linen as nn
 import jax
+from jax.ad_checkpoint import checkpoint_name
 import jax.numpy as jnp
 
 from skypilot_tpu.ops import flash_attention as fa
@@ -43,6 +44,11 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     scan_layers: bool = True
     remat: bool = True
+    # 'nothing' = recompute everything in backward (min memory);
+    # 'save_attn' = keep attention outputs (skips recomputing the
+    # seq-quadratic part — the right trade at long sequence lengths
+    # whenever HBM allows).
+    remat_policy: str = 'nothing'
     attention_impl: str = 'flash'   # flash | ring | reference
     # Autoregressive serving mode: attention keeps a KV cache in the
     # 'cache' variable collection (infer/engine.py drives it).
@@ -53,6 +59,17 @@ class LlamaConfig:
     # the init fn, and a Partitioned box would then emit a sharding
     # constraint with logical names against the abstract manual mesh.
     partition_params: bool = True
+    # LoRA finetuning (reference marquee recipe:
+    # llm/llama-3_1-finetuning/lora.yaml via torchtune): rank 0 = off.
+    # Adapters are ADDITIVE sibling params ('<proj>_lora'), so base
+    # param paths are unchanged and a pretrained base checkpoint loads
+    # through the params-only partial restore
+    # (train/checkpoint.py restore_params_partial); train only the
+    # adapters with trainer `train_only='lora'`.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ('q_proj', 'k_proj', 'v_proj',
+                                     'o_proj')
 
     @property
     def head_dim(self) -> int:
@@ -157,6 +174,51 @@ def apply_rope(x: jax.Array, positions: jax.Array,
     return out.astype(x.dtype)
 
 
+class LoraAdapter(nn.Module):
+    """Low-rank additive delta for one projection: (x @ A) @ B scaled
+    by alpha/rank.  B starts at zero, so a fresh adapter is a no-op and
+    finetuning starts exactly at the base model."""
+    rank: int
+    alpha: float
+    features: Tuple[int, ...]
+    dtype: Any
+    param_dtype: Any
+    partition: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        flat = 1
+        for f in self.features:
+            flat *= f
+        a = self.param(
+            'a',
+            _partitioned_init(nn.initializers.normal(1.0 / self.rank),
+                              ('embed_fsdp', None), self.partition),
+            (x.shape[-1], self.rank), self.param_dtype)
+        b = self.param(
+            'b',
+            _partitioned_init(nn.initializers.zeros, (None, None),
+                              self.partition),
+            (self.rank, flat), self.param_dtype)
+        delta = (x.astype(self.dtype) @ a.astype(self.dtype)) \
+            @ b.astype(self.dtype)
+        delta = delta * (self.alpha / self.rank)
+        return delta.reshape(*x.shape[:-1], *self.features)
+
+
+def maybe_lora(cfg, name: str, x: jax.Array, y: jax.Array,
+               features) -> jax.Array:
+    """Add the LoRA delta for projection `name` when enabled."""
+    if not getattr(cfg, 'lora_rank', 0) or \
+            name not in getattr(cfg, 'lora_targets', ()):
+        return y
+    feats = features if isinstance(features, tuple) else (features,)
+    return y + LoraAdapter(cfg.lora_rank, cfg.lora_alpha, feats,
+                           cfg.dtype, cfg.param_dtype,
+                           cfg.partition_params,
+                           name=f'{name}_lora')(x)
+
+
 class Attention(nn.Module):
     config: LlamaConfig
 
@@ -174,12 +236,17 @@ class Attention(nn.Module):
                 cfg.partition_params))
         b, s, _ = x.shape
         h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-        q = dense((h, hd), ('embed_fsdp', 'heads', 'head_dim'),
-                  'q_proj')(x)
-        k = dense((kv, hd), ('embed_fsdp', 'kv_heads', 'head_dim'),
-                  'k_proj')(x)
-        v = dense((kv, hd), ('embed_fsdp', 'kv_heads', 'head_dim'),
-                  'v_proj')(x)
+        q = maybe_lora(cfg, 'q_proj', x,
+                       dense((h, hd), ('embed_fsdp', 'heads', 'head_dim'),
+                             'q_proj')(x), (h, hd))
+        k = maybe_lora(cfg, 'k_proj', x,
+                       dense((kv, hd),
+                             ('embed_fsdp', 'kv_heads', 'head_dim'),
+                             'k_proj')(x), (kv, hd))
+        v = maybe_lora(cfg, 'v_proj', x,
+                       dense((kv, hd),
+                             ('embed_fsdp', 'kv_heads', 'head_dim'),
+                             'v_proj')(x), (kv, hd))
         # [B, S, H, hd] -> [B, H, S, hd]
         q = jnp.transpose(q, (0, 2, 1, 3))
         k = jnp.transpose(k, (0, 2, 1, 3))
@@ -188,8 +255,11 @@ class Attention(nn.Module):
         k = apply_rope(k, positions, cfg.rope_theta)
         if cfg.decode:
             out = self._cached_attention(q, k, v, kv_mask)
-            return dense(cfg.dim, ('heads', 'embed_fsdp'), 'o_proj')(
-                out.reshape(b, s, h * hd))
+            flat = out.reshape(b, s, h * hd)
+            return maybe_lora(
+                cfg, 'o_proj', flat,
+                dense(cfg.dim, ('heads', 'embed_fsdp'), 'o_proj')(flat),
+                cfg.dim)
         if kv != h:  # GQA: broadcast kv heads to query heads
             k = jnp.repeat(k, h // kv, axis=1)
             v = jnp.repeat(v, h // kv, axis=1)
@@ -201,13 +271,17 @@ class Attention(nn.Module):
                 q, k, v, impl=cfg.attention_impl)
         else:
             out = fa.mha_reference(q, k, v)
+        # Named so remat_policy='save_attn' can keep it (skipping the
+        # O(s^2) recompute in the backward pass).
+        out = checkpoint_name(out, 'attn_out')
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h * hd)
-        return nn.DenseGeneral(
+        proj = nn.DenseGeneral(
             cfg.dim, use_bias=False, name='o_proj', dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=_partitioned_init(
                 nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
                 ('heads', 'embed_fsdp'), cfg.partition_params))(out)
+        return maybe_lora(cfg, 'o_proj', out, proj, cfg.dim)
 
     def _cached_attention(self, q: jax.Array, k: jax.Array,
                           v: jax.Array,
@@ -265,15 +339,24 @@ class MLP(nn.Module):
             param_dtype=cfg.param_dtype,
             kernel_init=_partitioned_init(nn.initializers.normal(0.02),
                                           names, cfg.partition_params))
-        gate = dense(cfg.ffn_dim, ('embed_fsdp', 'mlp'), 'gate_proj')(x)
-        up = dense(cfg.ffn_dim, ('embed_fsdp', 'mlp'), 'up_proj')(x)
+        gate = maybe_lora(
+            cfg, 'gate_proj', x,
+            dense(cfg.ffn_dim, ('embed_fsdp', 'mlp'), 'gate_proj')(x),
+            cfg.ffn_dim)
+        up = maybe_lora(
+            cfg, 'up_proj', x,
+            dense(cfg.ffn_dim, ('embed_fsdp', 'mlp'), 'up_proj')(x),
+            cfg.ffn_dim)
         # Gated-MLP activation: Llama uses SiLU; Gemma's GeGLU plugs in
         # through the config (duck-typed field, default silu).
         act = getattr(cfg, 'activation', 'silu')
         act_fn = (nn.silu if act == 'silu'
                   else lambda g: nn.gelu(g, approximate=True))
         hidden = act_fn(gate) * up
-        return dense(cfg.dim, ('mlp', 'embed_fsdp'), 'down_proj')(hidden)
+        return maybe_lora(
+            cfg, 'down_proj', hidden,
+            dense(cfg.dim, ('mlp', 'embed_fsdp'), 'down_proj')(hidden),
+            cfg.dim)
 
 
 class Block(nn.Module):
@@ -302,9 +385,19 @@ def apply_blocks(cfg, block_base, x: jax.Array, positions: jax.Array,
     be called from inside the parent's @nn.compact __call__."""
     block_cls = block_base
     if cfg.remat:
+        policy_name = getattr(cfg, 'remat_policy', 'nothing')
+        if policy_name == 'save_attn':
+            policy = jax.checkpoint_policies.save_only_these_names(
+                'attn_out', 'attn_lse')
+        elif policy_name == 'nothing':
+            policy = jax.checkpoint_policies.nothing_saveable
+        else:
+            raise ValueError(
+                f'Unknown remat_policy {policy_name!r}; expected '
+                "'nothing' or 'save_attn'.")
         block_cls = nn.remat(
             block_base, prevent_cse=not cfg.scan_layers,
-            policy=jax.checkpoint_policies.nothing_saveable)
+            policy=policy)
     if cfg.scan_layers:
         variable_axes = {'params': 0}
         if getattr(cfg, 'decode', False):

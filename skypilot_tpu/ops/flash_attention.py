@@ -26,6 +26,7 @@ import os
 from typing import Optional, Tuple
 
 import jax
+from jax.ad_checkpoint import checkpoint_name
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -498,6 +499,12 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_kv):
 
 def _vjp_fwd(q, k, v, scale, causal, block_q, block_kv):
     out, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_kv)
+    # Named residuals: under jax.checkpoint with policy
+    # save_only_these_names('attn_out', 'attn_lse') the backward reuses
+    # them instead of re-running the forward kernel (q/k/v projections
+    # are cheap linear recomputes; the O(s^2) kernel is not).
+    out = checkpoint_name(out, 'attn_out')
+    lse = checkpoint_name(lse, 'attn_lse')
     return out, (q, k, v, out, lse)
 
 

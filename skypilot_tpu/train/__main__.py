@@ -35,6 +35,10 @@ def main() -> None:
                         help='Print final metrics as one JSON line '
                              '(adds params/device info for benchmark '
                              'normalization).')
+    parser.add_argument('--train-only', default=None,
+                        help='Train only params whose path contains '
+                             "this substring (e.g. 'lora'); the rest "
+                             'are frozen.')
     parser.add_argument('--model-overrides', default=None,
                         help='JSON dict of model-config overrides, '
                              "e.g. '{\"dim\": 1536, \"n_layers\": 12}'")
@@ -74,6 +78,7 @@ def main() -> None:
         mesh=mesh_lib.MeshConfig(**mesh_kwargs),
         pipeline_microbatches=args.pipeline_microbatches,
         model_overrides=overrides,
+        train_only=args.train_only,
     )
     trainer = trainer_lib.Trainer(config)
     manager = None

@@ -65,11 +65,58 @@ def restore(manager, state):
                          opt_state=restored['opt_state'])
 
 
+def restore_params_partial(manager, state):
+    """Base-weights restore into a *different* live tree: every saved
+    param whose path+shape matches the live params is loaded; the rest
+    (e.g. fresh LoRA adapters) keep their init, and optimizer state is
+    rebuilt fresh at step 0.  This is what lets the LoRA recipe start
+    from a pretrained base checkpoint saved without adapters."""
+    import flax
+    import orbax.checkpoint as ocp
+    latest = manager.latest_step()
+    if latest is None:
+        return None
+    # Untyped restore of the saved params subtree only.
+    raw = manager.restore(
+        latest, args=ocp.args.Composite(state=ocp.args.StandardRestore())
+    )['state']
+    saved = flax.traverse_util.flatten_dict(raw['params'])
+    live = flax.traverse_util.flatten_dict(state.params)
+    merged, loaded, skipped = {}, 0, []
+    for key, value in live.items():
+        sv = saved.get(key)
+        if sv is not None and tuple(sv.shape) == tuple(value.shape):
+            merged[key] = jax.device_put(
+                jax.numpy.asarray(sv, dtype=value.dtype), value.sharding)
+            loaded += 1
+        else:
+            merged[key] = value
+            skipped.append('/'.join(map(str, key)))
+    params = flax.traverse_util.unflatten_dict(merged)
+    logger.info(
+        f'Partial restore from step {latest}: {loaded} params loaded, '
+        f'{len(skipped)} kept from init '
+        f'(e.g. {skipped[:3]}); optimizer state reset.')
+    return state.replace(params=params,
+                         opt_state=state.tx.init(params),
+                         step=jax.numpy.zeros_like(state.step))
+
+
 def restore_or_init(manager, trainer) -> Any:
     """Preemption-transparent init: restore latest if present, else fresh
-    init (the managed-jobs recovery contract)."""
+    init (the managed-jobs recovery contract).  A checkpoint whose tree
+    does not match the live state (a base checkpoint opened by a LoRA/
+    frozen-finetune config) falls back to a params-only partial
+    restore."""
     state = trainer.init_state()
-    restored = restore(manager, state)
+    try:
+        restored = restore(manager, state)
+    except Exception as e:  # noqa: BLE001 — orbax raises various types
+        if manager.latest_step() is None:
+            raise
+        logger.info(f'Exact-tree restore failed ({type(e).__name__}); '
+                    'attempting params-only partial restore.')
+        restored = restore_params_partial(manager, state)
     if restored is not None:
         trainer.state = restored
         return restored

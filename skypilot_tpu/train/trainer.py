@@ -56,6 +56,10 @@ class TrainConfig:
     mesh: mesh_lib.MeshConfig = mesh_lib.MeshConfig()
     model_overrides: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
+    # Freeze everything except params whose path contains this
+    # substring (e.g. 'lora' for adapter-only finetuning — reference
+    # llm/llama-3_1-finetuning/lora.yaml semantics).  None = train all.
+    train_only: Optional[str] = None
     seed: int = 0
 
 
@@ -67,17 +71,38 @@ class TrainState(struct.PyTreeNode):
     tx: Any = struct.field(pytree_node=False)
 
 
+def _trainable_mask(params: Any, needle: str) -> Any:
+    """True exactly for params whose path contains `needle`."""
+    import flax
+    flat = flax.traverse_util.flatten_dict(params)
+    mask = {k: any(needle in str(part) for part in k) for k in flat}
+    return flax.traverse_util.unflatten_dict(mask)
+
+
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=config.learning_rate,
         warmup_steps=config.warmup_steps,
         decay_steps=max(config.total_steps, config.warmup_steps + 1),
         end_value=config.learning_rate * 0.1)
-    return optax.chain(
+    tx = optax.chain(
         optax.clip_by_global_norm(config.grad_clip_norm),
         optax.adamw(schedule, b1=0.9, b2=0.95, eps=1e-8,
                     weight_decay=config.weight_decay),
     )
+    if config.train_only:
+        # Frozen params get zero updates (optax.masked alone would let
+        # raw gradients pass through for masked-out leaves).
+        def labels(params):
+            import flax
+            mask = _trainable_mask(params, config.train_only)
+            return flax.traverse_util.unflatten_dict({
+                k: ('train' if v else 'freeze')
+                for k, v in flax.traverse_util.flatten_dict(mask).items()
+            })
+        tx = optax.multi_transform(
+            {'train': tx, 'freeze': optax.set_to_zero()}, labels)
+    return tx
 
 
 def sum_aux_losses(mutated_collections) -> jax.Array:
@@ -110,9 +135,25 @@ def loss_fn(params, apply_fn, batch) -> Tuple[jax.Array, Dict[str, Any]]:
 
 
 def train_step(state: TrainState, batch: Dict[str, jax.Array],
-               grad_accum_steps: int = 1
+               grad_accum_steps: int = 1,
+               train_only: Optional[str] = None
                ) -> Tuple[TrainState, Dict[str, jax.Array]]:
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    if train_only:
+        # stop_gradient on frozen params: XLA then DCEs their weight-
+        # gradient matmuls and buffers (LoRA's memory/FLOPs win), and
+        # grad_norm below describes only the updates actually applied.
+        freeze_mask = _trainable_mask(state.params, train_only)
+
+        def loss_with_frozen(params, apply_fn, batch):
+            mixed = jax.tree.map(
+                lambda p, trainable: p if trainable
+                else jax.lax.stop_gradient(p),
+                params, freeze_mask)
+            return loss_fn(mixed, apply_fn, batch)
+
+        grad_fn = jax.value_and_grad(loss_with_frozen, has_aux=True)
+    else:
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     if grad_accum_steps == 1:
         (_, metrics), grads = grad_fn(state.params, state.apply_fn, batch)
@@ -358,7 +399,8 @@ class Trainer:
             self._jit_step = jax.jit(
                 functools.partial(
                     train_step,
-                    grad_accum_steps=self.config.grad_accum_steps),
+                    grad_accum_steps=self.config.grad_accum_steps,
+                    train_only=self.config.train_only),
                 in_shardings=(self.state_shardings, batch_sharding),
                 out_shardings=(self.state_shardings, None),
                 donate_argnums=(0,),
