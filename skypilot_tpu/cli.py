@@ -584,17 +584,42 @@ def serve() -> None:
 @serve.command(name='up')
 @click.argument('entrypoint', nargs=-1, required=True)
 @click.option('--service-name', '-n', default=None)
+@click.option('--remote-controller', is_flag=True, default=False,
+              help='Run the service runtime on a controller cluster so '
+                   'it survives this client (reference: serve '
+                   'controller VM).')
 @_add_options(_RESOURCE_OPTIONS)
-def serve_up(entrypoint, service_name, **overrides) -> None:
-    from skypilot_tpu.serve import core as serve_core
+def serve_up(entrypoint, service_name, remote_controller,
+             **overrides) -> None:
     task = _make_task(entrypoint, **overrides)
+    if remote_controller:
+        from skypilot_tpu.serve import remote as serve_remote
+        result = serve_remote.up(task, service_name)
+        click.echo(
+            f"Service {result['service_name']!r} deployed at "
+            f"{result['endpoint']} (controller cluster "
+            f"{result['controller_cluster']!r}). Query with: "
+            'sky serve status --remote-controller')
+        return
+    from skypilot_tpu.serve import core as serve_core
     name, endpoint = serve_core.up(task, service_name)
     click.echo(f'Service {name!r} deployed at {endpoint}.')
 
 
 @serve.command(name='status')
 @click.argument('service_names', nargs=-1, required=False)
-def serve_status(service_names) -> None:
+@click.option('--remote-controller', is_flag=True, default=False)
+def serve_status(service_names, remote_controller) -> None:
+    if remote_controller:
+        from skypilot_tpu.serve import remote as serve_remote
+        for s in serve_remote.status(list(service_names) or None):
+            replicas = s.get('replica_info', [])
+            ready = sum(1 for r in replicas
+                        if str(r.get('status')) == 'READY')
+            click.echo(f"{s['name']}\t{s.get('status')}\t"
+                       f"{ready}/{len(replicas)} ready\t"
+                       f"{s.get('endpoint')}")
+        return
     from skypilot_tpu.serve import core as serve_core
     from skypilot_tpu.serve import serve_utils
     records = serve_core.status(list(service_names) or None)
@@ -630,14 +655,23 @@ def serve_logs(service_name) -> None:
 @click.option('--all', '-a', 'all_services', is_flag=True, default=False)
 @click.option('--purge', is_flag=True, default=False)
 @click.option('--yes', '-y', is_flag=True, default=False)
-def serve_down(service_names, all_services, purge, yes) -> None:
-    from skypilot_tpu.serve import core as serve_core
+@click.option('--remote-controller', is_flag=True, default=False)
+def serve_down(service_names, all_services, purge, yes,
+               remote_controller) -> None:
     if not service_names and not all_services:
         raise click.UsageError('Provide service names or --all.')
     if not yes:
         target = ', '.join(service_names) if service_names else 'ALL'
         click.confirm(f'Tear down service(s) {target}?', default=True,
                       abort=True)
+    if remote_controller:
+        from skypilot_tpu.serve import remote as serve_remote
+        downed = serve_remote.down(list(service_names) or None,
+                                   all_services=all_services,
+                                   purge=purge)
+        click.echo(f'Torn down on controller: {downed}')
+        return
+    from skypilot_tpu.serve import core as serve_core
     serve_core.down(list(service_names) or None, all_services=all_services,
                     purge=purge)
     click.echo('Service(s) torn down.')

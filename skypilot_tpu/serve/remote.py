@@ -1,0 +1,278 @@
+"""Self-hosted serve controller: the service runtime survives the
+client.
+
+Reference semantics (sky/serve/core.py:136 + sky-serve-controller.yaml
+.j2): `sky serve up` launches the *controller cluster* first, then the
+per-service controller + load balancer run THERE — so autoscaling,
+readiness probing, and replica recovery continue when the submitting
+laptop disappears.  Same deployment shift as the self-hosted jobs
+controller (jobs/remote.py), riding identical machinery:
+
+  - a small reusable controller cluster (default
+    `skytpu-serve-controller`, resources from config
+    serve.controller.resources) provisioned through the normal
+    optimizer/provisioner path — the framework launching itself;
+  - the service task YAML is file-mounted and the agent job runs
+    `python -m skypilot_tpu.serve.remote --task <yaml> --service-name
+    <n>` ON the controller host, which registers the service in the
+    HOST's serve state and starts the detached service runtime there
+    (serve/core.py mode='process');
+  - client-side queries (`--remote-controller` CLI flags) are module
+    invocations on the controller head, JSON between sentinel markers
+    (the reference's codegen-RPC idea without base64 payload blobs).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import shutil
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_TASK_MOUNT_DIR = 'skytpu_services'
+_RESPONSE_BEGIN = '<skytpu-serve-remote>'
+_RESPONSE_END = '</skytpu-serve-remote>'
+
+
+def controller_cluster_name() -> str:
+    from skypilot_tpu import config
+    return config.get_nested(('serve', 'controller', 'cluster_name'),
+                             'skytpu-serve-controller')
+
+
+def controller_resources() -> Any:
+    """Default controller shape (reference
+    controller_utils.get_controller_resources)."""
+    from skypilot_tpu import config
+    from skypilot_tpu import resources as resources_lib
+    spec = config.get_nested(('serve', 'controller', 'resources'), None)
+    if spec:
+        return resources_lib.Resources.from_yaml_config(spec)
+    return resources_lib.Resources(cloud='gcp', cpus='4+')
+
+
+def up(task: task_lib.Task,
+       service_name: Optional[str] = None,
+       controller_cluster: Optional[str] = None,
+       resources: Optional[Any] = None) -> Dict[str, Any]:
+    """Deploy a service whose runtime lives on the controller cluster.
+
+    Returns {'service_name', 'endpoint', 'controller_cluster'} — the
+    endpoint is the controller host address with the LB port."""
+    from skypilot_tpu import execution
+    if task.service is None:
+        raise exceptions.TaskValidationError(
+            'Task must define a `service` section for sky serve up.')
+    if service_name is None:
+        service_name = f'service-{uuid.uuid4().hex[:4]}'
+    cluster = controller_cluster or controller_cluster_name()
+
+    basename = f'svc-{service_name}-{int(time.time())}.yaml'
+    local_dir = tempfile.mkdtemp(prefix='skytpu-serve-')
+    local_yaml = os.path.join(local_dir, basename)
+    from skypilot_tpu.utils import common_utils
+    common_utils.dump_yaml(local_yaml, task.to_yaml_config())
+
+    controller_task = task_lib.Task(
+        name=f'serve-{service_name}',
+        run=(f'python3 -m skypilot_tpu.serve.remote '
+             f'--task ../{_TASK_MOUNT_DIR}/{basename} '
+             f'--service-name {shlex.quote(service_name)}'),
+    )
+    controller_task.set_file_mounts(
+        {f'{_TASK_MOUNT_DIR}/{basename}': local_yaml})
+    controller_task.set_resources(resources or controller_resources())
+    try:
+        job_id, handle = execution.launch(controller_task,
+                                          cluster_name=cluster,
+                                          detach_run=True,
+                                          quiet_optimizer=True)
+    finally:
+        shutil.rmtree(local_dir, ignore_errors=True)
+
+    # The registration job prints the endpoint; poll its output.
+    deadline = time.time() + 300
+    last: Dict[str, Any] = {}
+    while time.time() < deadline:
+        try:
+            last = _read_job_response(handle, job_id)
+            break
+        except exceptions.SkyTpuError:
+            time.sleep(2)
+    if not last:
+        raise exceptions.ServeUserTerminatedError(
+            f'Service registration on controller cluster {cluster!r} '
+            f'produced no response within 300s; inspect the controller '
+            f'job log: sky logs {cluster} {job_id}')
+    if 'error' in last:
+        raise exceptions.ServeUserTerminatedError(last['error'])
+    endpoint = _rewrite_endpoint(last.get('endpoint', ''), handle)
+    logger.info(
+        f'Service {service_name!r} deployed on controller cluster '
+        f'{cluster!r} at {endpoint}; the runtime survives this client.')
+    return {'service_name': service_name, 'endpoint': endpoint,
+            'controller_cluster': cluster}
+
+
+def _rewrite_endpoint(endpoint: str, handle) -> str:
+    """The controller host reports its local endpoint; expose it via the
+    cluster's reachable address."""
+    if not endpoint:
+        return endpoint
+    port = endpoint.rsplit(':', 1)[-1]
+    address = handle.head_internal_ip
+    if handle.head_address.startswith('local:'):
+        address = '127.0.0.1'
+    return f'http://{address}:{port}'
+
+
+def _read_job_response(handle, job_id: int) -> Dict[str, Any]:
+    root = handle.head_agent_root
+    if root is None:
+        # Remote host: read the job log over the runner.
+        from skypilot_tpu.backend import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        rc, out, err = backend.run_on_head(
+            handle,
+            f'cat ~/.skytpu_agent/job_logs/job_{job_id}/run.log',
+            require_outputs=True, timeout=60)
+        text = out if rc == 0 else ''
+    else:
+        path = os.path.join(root, '.skytpu_agent', 'job_logs',
+                            f'job_{job_id}', 'run.log')
+        text = ''
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                text = f.read()
+    start = text.rfind(_RESPONSE_BEGIN)
+    end = text.rfind(_RESPONSE_END)
+    if start == -1 or end == -1 or end < start:
+        raise exceptions.SkyTpuError('serve-remote response not ready')
+    return json.loads(text[start + len(_RESPONSE_BEGIN):end])
+
+
+# ---------------------------------------------------------------------------
+# Client-side queries (module invocation on the controller head)
+# ---------------------------------------------------------------------------
+def _run_remote(controller_cluster: Optional[str],
+                args: str) -> Dict[str, Any]:
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.backend import tpu_gang_backend
+    cluster = controller_cluster or controller_cluster_name()
+    record = global_user_state.get_cluster_from_name(cluster)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Serve controller cluster {cluster!r} does not exist.')
+    backend = tpu_gang_backend.TpuGangBackend()
+    cmd = f'python3 -u -m skypilot_tpu.serve.remote {args}'
+    rc, stdout, stderr = backend.run_on_head(record['handle'], cmd,
+                                             require_outputs=True,
+                                             timeout=120)
+    if rc != 0:
+        raise exceptions.CommandError(rc, cmd, stderr or stdout)
+    start = stdout.rfind(_RESPONSE_BEGIN)
+    end = stdout.rfind(_RESPONSE_END)
+    if start == -1 or end == -1 or end < start:
+        raise exceptions.SkyTpuError(
+            f'Malformed serve-remote response: {stdout[-500:]!r}')
+    return json.loads(stdout[start + len(_RESPONSE_BEGIN):end])
+
+
+def status(service_names: Optional[List[str]] = None,
+           controller_cluster: Optional[str] = None
+           ) -> List[Dict[str, Any]]:
+    args = '--status-json'
+    if service_names:
+        args += ' --service-names ' + ' '.join(
+            shlex.quote(s) for s in service_names)
+    return _run_remote(controller_cluster, args)['services']
+
+
+def down(service_names: Optional[List[str]] = None, *,
+         all_services: bool = False, purge: bool = False,
+         controller_cluster: Optional[str] = None) -> List[str]:
+    if all_services:
+        args = '--down-all'
+    elif service_names:
+        args = '--down ' + ' '.join(shlex.quote(s)
+                                    for s in service_names)
+    else:
+        return []
+    if purge:
+        args += ' --purge'
+    return _run_remote(controller_cluster, args)['down']
+
+
+# ---------------------------------------------------------------------------
+# Controller-host side
+# ---------------------------------------------------------------------------
+def _emit(payload: Dict[str, Any]) -> None:
+    print(_RESPONSE_BEGIN + json.dumps(payload) + _RESPONSE_END,
+          flush=True)
+
+
+def _register_service(task_path: str, service_name: str) -> None:
+    from skypilot_tpu.serve import core as serve_core
+    try:
+        task = task_lib.Task.from_yaml(os.path.expanduser(task_path))
+        name, endpoint = serve_core.up(task, service_name,
+                                       mode='process')
+        _emit({'service_name': name, 'endpoint': endpoint})
+    except Exception as e:  # noqa: BLE001 — reported to the client
+        _emit({'error': f'{type(e).__name__}: {e}'})
+        raise
+
+
+def _status_json(service_names: Optional[List[str]]) -> None:
+    from skypilot_tpu.serve import core as serve_core
+    services = serve_core.status(service_names)
+    for s in services:
+        for key, value in list(s.items()):
+            if hasattr(value, 'value'):
+                s[key] = value.value
+        for r in s.get('replica_info', []):
+            for key, value in list(r.items()):
+                if hasattr(value, 'value'):
+                    r[key] = value.value
+    _emit({'services': services})
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--task', default=None)
+    parser.add_argument('--service-name', default=None)
+    parser.add_argument('--status-json', action='store_true')
+    parser.add_argument('--service-names', nargs='+', default=None)
+    parser.add_argument('--down', nargs='+', default=None)
+    parser.add_argument('--down-all', action='store_true')
+    parser.add_argument('--purge', action='store_true')
+    args = parser.parse_args(argv)
+
+    if args.task:
+        _register_service(args.task, args.service_name)
+    elif args.status_json:
+        _status_json(args.service_names)
+    elif args.down or args.down_all:
+        from skypilot_tpu.serve import core as serve_core
+        from skypilot_tpu.serve import serve_state
+        names = (args.down if args.down else
+                 [s['name'] for s in serve_state.get_services()])
+        serve_core.down(args.down, all_services=args.down_all,
+                        purge=args.purge)
+        _emit({'down': names})
+    else:
+        parser.error('Nothing to do.')
+
+
+if __name__ == '__main__':
+    main()

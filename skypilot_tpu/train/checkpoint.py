@@ -7,6 +7,11 @@ finetuning/lora.yaml:24-30); managed TPU jobs here follow the same
 contract with first-class async Orbax saves: every host writes its own
 param shards (OCDBT), so a v5p-128 checkpoint scales with hosts, and
 `restore_or_init` makes the trainer preemption-transparent.
+
+Layout: params / opt_state / step are separate Composite items, so a
+*base* checkpoint's params can be restored sharded into a different
+live tree (LoRA finetune from pretrained weights) without touching its
+optimizer state.
 """
 from __future__ import annotations
 
@@ -29,93 +34,143 @@ def make_manager(directory: str, *, max_to_keep: int = 3,
         max_to_keep=max_to_keep,
         enable_async_checkpointing=True,
     )
-    return ocp.CheckpointManager(directory, options=options)
+    # Declared item layout + handlers: a fresh process (e.g. a LoRA
+    # finetune opening a base checkpoint it never wrote) can then read
+    # item_metadata without having saved first.
+    return ocp.CheckpointManager(
+        directory, options=options,
+        item_handlers={
+            'params': ocp.StandardCheckpointHandler(),
+            'opt_state': ocp.StandardCheckpointHandler(),
+            'step': ocp.ArrayCheckpointHandler(),
+        })
 
 
 def save(manager, state, *, wait: bool = False) -> int:
     import orbax.checkpoint as ocp
     step = int(jax.device_get(state.step))
     manager.save(step, args=ocp.args.Composite(
-        state=ocp.args.StandardSave({'params': state.params,
-                                     'opt_state': state.opt_state,
-                                     'step': state.step})))
+        params=ocp.args.StandardSave(state.params),
+        opt_state=ocp.args.StandardSave(state.opt_state),
+        step=ocp.args.ArraySave(state.step)))
     if wait:
         manager.wait_until_finished()
     logger.info(f'Checkpoint step {step} saved (async).')
     return step
 
 
+def _abstract(tree):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=x.sharding)
+        if isinstance(x, jax.Array) else x, tree)
+
+
 def restore(manager, state):
-    """Restore into the sharded structure of `state` (shapes/shardings
-    from the live state; works across host counts)."""
+    """Exact restore into the sharded structure of `state` (shapes/
+    shardings from the live state; works across host counts).  Raises
+    on any failure — a broken resume must be loud, not a silent
+    restart."""
     import orbax.checkpoint as ocp
     latest = manager.latest_step()
     if latest is None:
         return None
-    abstract = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-        if isinstance(x, jax.Array) else x,
-        {'params': state.params, 'opt_state': state.opt_state,
-         'step': state.step})
     restored = manager.restore(
         latest, args=ocp.args.Composite(
-            state=ocp.args.StandardRestore(abstract)))['state']
+            params=ocp.args.StandardRestore(_abstract(state.params)),
+            opt_state=ocp.args.StandardRestore(
+                _abstract(state.opt_state)),
+            step=ocp.args.ArrayRestore(
+                jax.ShapeDtypeStruct(state.step.shape, state.step.dtype,
+                                     sharding=state.step.sharding))))
     logger.info(f'Restored checkpoint step {latest}.')
-    return state.replace(step=restored['step'], params=restored['params'],
+    return state.replace(step=restored['step'],
+                         params=restored['params'],
                          opt_state=restored['opt_state'])
+
+
+def _flatten_metadata(meta):
+    """Orbax metadata tree -> {path_tuple: ArrayMetadata} with flax-
+    style string-key paths (metadata impls are pytrees but not plain
+    dicts)."""
+    import jax.tree_util as jtu
+    out = {}
+    for path, leaf in jtu.tree_flatten_with_path(meta)[0]:
+        key = tuple(
+            str(getattr(p, 'key', getattr(p, 'name', p))) for p in path)
+        out[key] = leaf
+    return out
 
 
 def restore_params_partial(manager, state):
     """Base-weights restore into a *different* live tree: every saved
-    param whose path+shape matches the live params is loaded; the rest
-    (e.g. fresh LoRA adapters) keep their init, and optimizer state is
-    rebuilt fresh at step 0.  This is what lets the LoRA recipe start
-    from a pretrained base checkpoint saved without adapters."""
+    param whose path+shape matches the live params is restored WITH the
+    live sharding (host-sharded OCDBT read); the rest (e.g. fresh LoRA
+    adapters) keep their init.  Optimizer state is rebuilt fresh at
+    step 0 — this is a finetune start, not a resume."""
     import flax
     import orbax.checkpoint as ocp
     latest = manager.latest_step()
     if latest is None:
         return None
-    # Untyped restore of the saved params subtree only.
-    raw = manager.restore(
-        latest, args=ocp.args.Composite(state=ocp.args.StandardRestore())
-    )['state']
-    saved = flax.traverse_util.flatten_dict(raw['params'])
+    meta = manager.item_metadata(latest)['params']
+    saved_meta = _flatten_metadata(meta)
     live = flax.traverse_util.flatten_dict(state.params)
-    merged, loaded, skipped = {}, 0, []
+    abstract = {}
+    for key, m in saved_meta.items():
+        lv = live.get(key)
+        if lv is not None and tuple(m.shape) == tuple(lv.shape):
+            abstract[key] = jax.ShapeDtypeStruct(
+                lv.shape, lv.dtype, sharding=lv.sharding)
+        else:
+            # Saved param with no live counterpart (rare): replicated.
+            abstract[key] = jax.ShapeDtypeStruct(tuple(m.shape), m.dtype)
+    restored = flax.traverse_util.flatten_dict(
+        manager.restore(
+            latest, args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(
+                    flax.traverse_util.unflatten_dict(abstract))))
+        ['params'])
+    merged, loaded, kept = {}, 0, []
     for key, value in live.items():
-        sv = saved.get(key)
+        sv = restored.get(key)
         if sv is not None and tuple(sv.shape) == tuple(value.shape):
-            merged[key] = jax.device_put(
-                jax.numpy.asarray(sv, dtype=value.dtype), value.sharding)
+            merged[key] = sv
             loaded += 1
         else:
             merged[key] = value
-            skipped.append('/'.join(map(str, key)))
+            kept.append('/'.join(map(str, key)))
     params = flax.traverse_util.unflatten_dict(merged)
     logger.info(
         f'Partial restore from step {latest}: {loaded} params loaded, '
-        f'{len(skipped)} kept from init '
-        f'(e.g. {skipped[:3]}); optimizer state reset.')
+        f'{len(kept)} kept from init (e.g. {kept[:3]}); optimizer '
+        'state reset, step reset to 0.')
     return state.replace(params=params,
                          opt_state=state.tx.init(params),
                          step=jax.numpy.zeros_like(state.step))
 
 
 def restore_or_init(manager, trainer) -> Any:
-    """Preemption-transparent init: restore latest if present, else fresh
-    init (the managed-jobs recovery contract).  A checkpoint whose tree
-    does not match the live state (a base checkpoint opened by a LoRA/
-    frozen-finetune config) falls back to a params-only partial
-    restore."""
+    """Preemption-transparent init: restore latest if present, else
+    fresh init (the managed-jobs recovery contract).
+
+    Only a *frozen-base finetune* config (`train_only` set) is allowed
+    to fall back to the params-only partial restore when the exact tree
+    does not match — opening a base checkpoint with a LoRA config is
+    the intended use.  A normal resume that fails to restore raises:
+    silently restarting from step 0 (and then garbage-collecting the
+    real checkpoints) would be data loss.
+    """
     state = trainer.init_state()
     try:
         restored = restore(manager, state)
     except Exception as e:  # noqa: BLE001 — orbax raises various types
-        if manager.latest_step() is None:
+        if manager.latest_step() is None or \
+                not getattr(trainer.config, 'train_only', None):
             raise
-        logger.info(f'Exact-tree restore failed ({type(e).__name__}); '
-                    'attempting params-only partial restore.')
+        logger.info(f'Exact-tree restore failed ({type(e).__name__}) '
+                    'and train_only is set: attempting params-only '
+                    'partial restore of the base checkpoint.')
         restored = restore_params_partial(manager, state)
     if restored is not None:
         trainer.state = restored
