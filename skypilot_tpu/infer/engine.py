@@ -205,22 +205,36 @@ class InferenceEngine:
     def _load_checkpoint(self, directory: str, abstract_params,
                          shardings):
         """Load params from a trainer checkpoint (train/checkpoint.py
-        layout: Composite 'state' holding params/opt_state/step)."""
+        layout: Composite items params/opt_state/step) — params only,
+        restored directly into the serving shardings."""
+        import orbax.checkpoint as ocp
+
         from skypilot_tpu.train import checkpoint as ckpt_lib
         manager = ckpt_lib.make_manager(directory)
         latest = manager.latest_step()
         if latest is None:
             raise FileNotFoundError(
                 f'no checkpoint found under {directory!r}')
-        raw = manager.restore(latest)['state']['params']
-        want = jax.tree.structure(sharding_lib.unbox(abstract_params))
-        got = jax.tree.structure(raw)
-        if want != got:
+        abstract = sharding_lib.unbox(abstract_params)
+        if shardings is not None:
+            abs_tree = jax.tree.map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                  sharding=s),
+                abstract, shardings)
+        else:
+            abs_tree = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                abstract)
+        try:
+            restored = manager.restore(
+                latest, args=ocp.args.Composite(
+                    params=ocp.args.StandardRestore(abs_tree)))['params']
+        except ValueError as e:
             raise ValueError(
                 f'checkpoint param tree does not match model '
-                f'{self.config.name!r}: {got} vs {want}')
+                f'{self.config.name!r}: {e}') from None
         logger.info(f'loaded checkpoint step {latest} from {directory}')
-        return self._place(raw, shardings)
+        return restored
 
     def _fresh_cache(self):
         def _make(leaf, sharding=None):

@@ -142,21 +142,10 @@ def _controller_handle(controller_cluster: Optional[str] = None):
 
 def _run_remote(controller_cluster: Optional[str],
                 args: str) -> Dict[str, Any]:
-    from skypilot_tpu.backend import tpu_gang_backend
-    handle = _controller_handle(controller_cluster)
-    backend = tpu_gang_backend.TpuGangBackend()
-    cmd = f'python3 -u -m skypilot_tpu.jobs.remote {args}'
-    rc, stdout, stderr = backend.run_on_head(handle, cmd,
-                                             require_outputs=True,
-                                             timeout=120)
-    if rc != 0:
-        raise exceptions.CommandError(rc, cmd, stderr or stdout)
-    start = stdout.rfind(_RESPONSE_BEGIN)
-    end = stdout.rfind(_RESPONSE_END)
-    if start == -1 or end == -1 or end < start:
-        raise exceptions.SkyTpuError(
-            f'Malformed jobs-remote response: {stdout[-500:]!r}')
-    return json.loads(stdout[start + len(_RESPONSE_BEGIN):end])
+    from skypilot_tpu.utils import controller_rpc
+    cluster = controller_cluster or controller_cluster_name()
+    return controller_rpc.call(cluster, 'skypilot_tpu.jobs.remote',
+                               args, _RESPONSE_BEGIN, _RESPONSE_END)
 
 
 def queue(controller_cluster: Optional[str] = None
@@ -181,8 +170,8 @@ def cancel(job_ids: Optional[List[int]] = None,
 # Controller-host side (the file-mounted job's run command)
 # ---------------------------------------------------------------------------
 def _emit(payload: Dict[str, Any]) -> None:
-    print(_RESPONSE_BEGIN + json.dumps(payload) + _RESPONSE_END,
-          flush=True)
+    from skypilot_tpu.utils import controller_rpc
+    controller_rpc.emit(payload, _RESPONSE_BEGIN, _RESPONSE_END)
 
 
 def _serve_dag(dag_path: str, name: Optional[str]) -> None:

@@ -35,6 +35,8 @@ class Gpt2Config:
     scan_layers: bool = True
     remat: bool = True
     attention_impl: str = 'flash'
+    # Serving mode: KV cache via the shared llama.run_cached_attention.
+    decode: bool = False
     partition_params: bool = True
 
     @property
@@ -60,13 +62,6 @@ def get_config(name: str, **overrides: Any) -> Gpt2Config:
     if name not in CONFIGS:
         raise ValueError(f'Unknown gpt2 config {name!r}; '
                          f'available: {sorted(CONFIGS)}')
-    if overrides.pop('decode', False):
-        # Fail fast with a clear message: the inference engine requests
-        # decode=True for every model; this family has no KV-cache path
-        # yet (train/finetune only).
-        raise ValueError(
-            'The gpt2 family does not support KV-cache serving yet; '
-            'serve a llama-* / gemma-* / mixtral-* model instead.')
     return dataclasses.replace(CONFIGS[name], **overrides)
 
 
@@ -99,7 +94,8 @@ class Gpt2Attention(nn.Module):
     config: Gpt2Config
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array,
+                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         b, s, _ = x.shape
         h, hd = cfg.n_heads, cfg.head_dim
@@ -112,11 +108,17 @@ class Gpt2Attention(nn.Module):
                     'qkv_proj', 0.02)(x)
         q, k, v = (jnp.transpose(qkv[:, :, i], (0, 2, 1, 3))
                    for i in range(3))
-        if cfg.attention_impl == 'flash':
+        if cfg.decode:
+            out = llama.run_cached_attention(
+                self, q, k, v, kv_mask, n_kv_heads=h,
+                max_seq_len=cfg.max_seq_len, dtype=cfg.dtype)
+            out = out.reshape(b, s, h * hd)
+        elif cfg.attention_impl == 'flash':
             out = fa.flash_attention(q, k, v)
+            out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h * hd)
         else:
             out = fa.mha_reference(q, k, v)
-        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h * hd)
+            out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h * hd)
         # GPT-2 scales residual-writing projections by 1/sqrt(2L).
         return dense(cfg.dim, ('heads', 'embed_fsdp'), 'o_proj',
                      0.02 / (2 * cfg.n_layers) ** 0.5)(out)
@@ -150,14 +152,13 @@ class Gpt2Block(nn.Module):
     def __call__(self, x: jax.Array,
                  positions: Optional[jax.Array] = None,
                  kv_mask: Optional[jax.Array] = None) -> jax.Array:
-        # positions/kv_mask accepted for the shared apply_blocks
-        # signature; GPT-2 blocks need neither (absolute positions are
-        # added at the embedding, no KV cache).
-        del positions, kv_mask
+        # positions accepted for the shared apply_blocks signature;
+        # GPT-2 adds absolute positions at the embedding instead.
+        del positions
         cfg = self.config
         x = x + Gpt2Attention(cfg, name='attention')(
             LayerNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
-                      name='ln_1')(x))
+                      name='ln_1')(x), kv_mask)
         x = x + Gpt2Mlp(cfg, name='mlp')(
             LayerNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                       name='ln_2')(x))

@@ -219,6 +219,52 @@ def maybe_lora(cfg, name: str, x: jax.Array, y: jax.Array,
                            name=f'{name}_lora')(x)
 
 
+def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
+                         v: jax.Array,
+                         kv_mask: Optional[jax.Array], *,
+                         n_kv_heads: int, max_seq_len: int,
+                         dtype: Any) -> jax.Array:
+    """Attention against the KV cache (serving) — shared by every
+    family (Llama/Gemma via llama.Attention, GPT-2's MHA).
+
+    The cache is written at the global slot cursor `cache_index`
+    (same for every row); per-row validity — right-padded prompts,
+    finished rows — is carried by `kv_mask` [B, max_seq_len], so
+    slots and rope positions may disagree for padded rows without
+    affecting valid tokens.  Returns [B, S, H, hd].
+    """
+    b, h, s, hd = q.shape
+    kvh = n_kv_heads
+    max_len = max_seq_len
+    cached_k = module.variable('cache', 'cached_key', jnp.zeros,
+                               (b, kvh, max_len, hd), dtype)
+    cached_v = module.variable('cache', 'cached_value', jnp.zeros,
+                               (b, kvh, max_len, hd), dtype)
+    cursor = module.variable('cache', 'cache_index',
+                             lambda: jnp.zeros((), jnp.int32))
+    idx = cursor.value
+    cached_k.value = jax.lax.dynamic_update_slice(
+        cached_k.value, k.astype(dtype), (0, 0, idx, 0))
+    cached_v.value = jax.lax.dynamic_update_slice(
+        cached_v.value, v.astype(dtype), (0, 0, idx, 0))
+    cursor.value = idx + s
+    keys, values = cached_k.value, cached_v.value
+    if kvh != h:
+        keys = jnp.repeat(keys, h // kvh, axis=1)
+        values = jnp.repeat(values, h // kvh, axis=1)
+    scores = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
+                        keys.astype(jnp.float32)) * (hd ** -0.5)
+    slots = jnp.arange(max_len)
+    causal = slots[None, :] <= (idx + jnp.arange(s))[:, None]
+    mask = causal[None, None]                      # [1,1,s,max]
+    if kv_mask is not None:
+        mask = mask & kv_mask[:, None, None, :]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bhqk,bhkd->bhqd', probs.astype(dtype), values)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
 class Attention(nn.Module):
     config: LlamaConfig
 
@@ -286,46 +332,11 @@ class Attention(nn.Module):
     def _cached_attention(self, q: jax.Array, k: jax.Array,
                           v: jax.Array,
                           kv_mask: Optional[jax.Array]) -> jax.Array:
-        """Attention against the KV cache (serving).
-
-        The cache is written at the global slot cursor `cache_index`
-        (same for every row); per-row validity — right-padded prompts,
-        finished rows — is carried by `kv_mask` [B, max_seq_len], so
-        slots and rope positions may disagree for padded rows without
-        affecting valid tokens.  Returns [B, S, H, hd].
-        """
         cfg = self.config
-        b, h, s, hd = q.shape
-        kvh = cfg.n_kv_heads
-        max_len = cfg.max_seq_len
-        cached_k = self.variable('cache', 'cached_key', jnp.zeros,
-                                 (b, kvh, max_len, hd), cfg.dtype)
-        cached_v = self.variable('cache', 'cached_value', jnp.zeros,
-                                 (b, kvh, max_len, hd), cfg.dtype)
-        cursor = self.variable('cache', 'cache_index',
-                               lambda: jnp.zeros((), jnp.int32))
-        idx = cursor.value
-        cached_k.value = jax.lax.dynamic_update_slice(
-            cached_k.value, k.astype(cfg.dtype), (0, 0, idx, 0))
-        cached_v.value = jax.lax.dynamic_update_slice(
-            cached_v.value, v.astype(cfg.dtype), (0, 0, idx, 0))
-        cursor.value = idx + s
-        keys, values = cached_k.value, cached_v.value
-        if kvh != h:
-            keys = jnp.repeat(keys, h // kvh, axis=1)
-            values = jnp.repeat(values, h // kvh, axis=1)
-        scores = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
-                            keys.astype(jnp.float32)) * (hd ** -0.5)
-        slots = jnp.arange(max_len)
-        causal = slots[None, :] <= (idx + jnp.arange(s))[:, None]
-        mask = causal[None, None]                      # [1,1,s,max]
-        if kv_mask is not None:
-            mask = mask & kv_mask[:, None, None, :]
-        scores = jnp.where(mask, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum('bhqk,bhkd->bhqd', probs.astype(cfg.dtype),
-                         values)
-        return jnp.transpose(out, (0, 2, 1, 3))
+        return run_cached_attention(self, q, k, v, kv_mask,
+                                    n_kv_heads=cfg.n_kv_heads,
+                                    max_seq_len=cfg.max_seq_len,
+                                    dtype=cfg.dtype)
 
 
 class MLP(nn.Module):

@@ -75,9 +75,14 @@ def up(task: task_lib.Task,
             'Task must define a `service` section for sky serve up.')
     if service_name is None:
         service_name = f'service-{uuid.uuid4().hex[:4]}'
+    # Reject bad names before a controller cluster gets provisioned.
+    from skypilot_tpu.serve import serve_utils
+    serve_utils.validate_service_name(service_name)
     cluster = controller_cluster or controller_cluster_name()
 
-    basename = f'svc-{service_name}-{int(time.time())}.yaml'
+    # Mount path is name-free (names are validated, but keep shell
+    # quoting concerns out of the path entirely).
+    basename = f'svc-{uuid.uuid4().hex[:8]}.yaml'
     local_dir = tempfile.mkdtemp(prefix='skytpu-serve-')
     local_yaml = os.path.join(local_dir, basename)
     from skypilot_tpu.utils import common_utils
@@ -125,40 +130,27 @@ def up(task: task_lib.Task,
 
 
 def _rewrite_endpoint(endpoint: str, handle) -> str:
-    """The controller host reports its local endpoint; expose it via the
-    cluster's reachable address."""
+    """The controller host reports its local endpoint; expose it via
+    the address the CLIENT can reach (the same one SSH uses), not the
+    VPC-internal IP."""
     if not endpoint:
         return endpoint
     port = endpoint.rsplit(':', 1)[-1]
-    address = handle.head_internal_ip
-    if handle.head_address.startswith('local:'):
+    address = handle.head_address
+    if address.startswith('local:'):
         address = '127.0.0.1'
+    elif address.startswith(('k8s:', 'docker:')):
+        # Exec-style substrates have no routable address; internal IP
+        # is the best available hint.
+        address = handle.head_internal_ip
     return f'http://{address}:{port}'
 
 
 def _read_job_response(handle, job_id: int) -> Dict[str, Any]:
-    root = handle.head_agent_root
-    if root is None:
-        # Remote host: read the job log over the runner.
-        from skypilot_tpu.backend import tpu_gang_backend
-        backend = tpu_gang_backend.TpuGangBackend()
-        rc, out, err = backend.run_on_head(
-            handle,
-            f'cat ~/.skytpu_agent/job_logs/job_{job_id}/run.log',
-            require_outputs=True, timeout=60)
-        text = out if rc == 0 else ''
-    else:
-        path = os.path.join(root, '.skytpu_agent', 'job_logs',
-                            f'job_{job_id}', 'run.log')
-        text = ''
-        if os.path.exists(path):
-            with open(path, encoding='utf-8') as f:
-                text = f.read()
-    start = text.rfind(_RESPONSE_BEGIN)
-    end = text.rfind(_RESPONSE_END)
-    if start == -1 or end == -1 or end < start:
-        raise exceptions.SkyTpuError('serve-remote response not ready')
-    return json.loads(text[start + len(_RESPONSE_BEGIN):end])
+    from skypilot_tpu.utils import controller_rpc
+    return controller_rpc.read_job_response(handle, job_id,
+                                            _RESPONSE_BEGIN,
+                                            _RESPONSE_END)
 
 
 # ---------------------------------------------------------------------------
@@ -166,26 +158,10 @@ def _read_job_response(handle, job_id: int) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 def _run_remote(controller_cluster: Optional[str],
                 args: str) -> Dict[str, Any]:
-    from skypilot_tpu import global_user_state
-    from skypilot_tpu.backend import tpu_gang_backend
+    from skypilot_tpu.utils import controller_rpc
     cluster = controller_cluster or controller_cluster_name()
-    record = global_user_state.get_cluster_from_name(cluster)
-    if record is None:
-        raise exceptions.ClusterDoesNotExist(
-            f'Serve controller cluster {cluster!r} does not exist.')
-    backend = tpu_gang_backend.TpuGangBackend()
-    cmd = f'python3 -u -m skypilot_tpu.serve.remote {args}'
-    rc, stdout, stderr = backend.run_on_head(record['handle'], cmd,
-                                             require_outputs=True,
-                                             timeout=120)
-    if rc != 0:
-        raise exceptions.CommandError(rc, cmd, stderr or stdout)
-    start = stdout.rfind(_RESPONSE_BEGIN)
-    end = stdout.rfind(_RESPONSE_END)
-    if start == -1 or end == -1 or end < start:
-        raise exceptions.SkyTpuError(
-            f'Malformed serve-remote response: {stdout[-500:]!r}')
-    return json.loads(stdout[start + len(_RESPONSE_BEGIN):end])
+    return controller_rpc.call(cluster, 'skypilot_tpu.serve.remote',
+                               args, _RESPONSE_BEGIN, _RESPONSE_END)
 
 
 def status(service_names: Optional[List[str]] = None,
@@ -195,7 +171,18 @@ def status(service_names: Optional[List[str]] = None,
     if service_names:
         args += ' --service-names ' + ' '.join(
             shlex.quote(s) for s in service_names)
-    return _run_remote(controller_cluster, args)['services']
+    services = _run_remote(controller_cluster, args)['services']
+    # Endpoints are controller-local (http://127.0.0.1:port); translate
+    # to the client-reachable controller address, as up() does.
+    from skypilot_tpu import global_user_state
+    cluster = controller_cluster or controller_cluster_name()
+    record = global_user_state.get_cluster_from_name(cluster)
+    if record is not None:
+        for s in services:
+            if s.get('endpoint'):
+                s['endpoint'] = _rewrite_endpoint(s['endpoint'],
+                                                  record['handle'])
+    return services
 
 
 def down(service_names: Optional[List[str]] = None, *,
@@ -217,8 +204,8 @@ def down(service_names: Optional[List[str]] = None, *,
 # Controller-host side
 # ---------------------------------------------------------------------------
 def _emit(payload: Dict[str, Any]) -> None:
-    print(_RESPONSE_BEGIN + json.dumps(payload) + _RESPONSE_END,
-          flush=True)
+    from skypilot_tpu.utils import controller_rpc
+    controller_rpc.emit(payload, _RESPONSE_BEGIN, _RESPONSE_END)
 
 
 def _register_service(task_path: str, service_name: str) -> None:

@@ -43,7 +43,22 @@ def make_manager(directory: str, *, max_to_keep: int = 3,
             'params': ocp.StandardCheckpointHandler(),
             'opt_state': ocp.StandardCheckpointHandler(),
             'step': ocp.ArrayCheckpointHandler(),
+            # Pre-split layout (single 'state' item) — read-only
+            # compatibility for checkpoints written by earlier builds.
+            'state': ocp.StandardCheckpointHandler(),
         })
+
+
+def _is_legacy_layout(manager, step: int) -> bool:
+    """True when the checkpoint was written as one Composite 'state'
+    item (the pre-split layout)."""
+    try:
+        d = manager.directory
+    except AttributeError:
+        return False
+    step_dir = os.path.join(str(d), str(step))
+    return (os.path.isdir(os.path.join(step_dir, 'state'))
+            and not os.path.isdir(os.path.join(step_dir, 'params')))
 
 
 def save(manager, state, *, wait: bool = False) -> int:
@@ -75,14 +90,24 @@ def restore(manager, state):
     latest = manager.latest_step()
     if latest is None:
         return None
-    restored = manager.restore(
-        latest, args=ocp.args.Composite(
-            params=ocp.args.StandardRestore(_abstract(state.params)),
-            opt_state=ocp.args.StandardRestore(
-                _abstract(state.opt_state)),
-            step=ocp.args.ArrayRestore(
-                jax.ShapeDtypeStruct(state.step.shape, state.step.dtype,
-                                     sharding=state.step.sharding))))
+    if _is_legacy_layout(manager, latest):
+        restored = manager.restore(
+            latest, args=ocp.args.Composite(
+                state=ocp.args.StandardRestore({
+                    'params': _abstract(state.params),
+                    'opt_state': _abstract(state.opt_state),
+                    'step': _abstract(state.step),
+                })))['state']
+    else:
+        restored = manager.restore(
+            latest, args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(_abstract(state.params)),
+                opt_state=ocp.args.StandardRestore(
+                    _abstract(state.opt_state)),
+                step=ocp.args.ArrayRestore(
+                    jax.ShapeDtypeStruct(
+                        state.step.shape, state.step.dtype,
+                        sharding=state.step.sharding))))
     logger.info(f'Restored checkpoint step {latest}.')
     return state.replace(step=restored['step'],
                          params=restored['params'],

@@ -1,0 +1,74 @@
+"""Controller-head RPC: module invocations with sentinel-framed JSON.
+
+The reference's client↔controller RPC is base64-payload "codegen" SSH
+snippets (sky/skylet/job_lib.py:930 JobLibCodeGen, sky/jobs/utils.py,
+sky/serve/serve_utils.py).  Here both self-hosted controllers (managed
+jobs and serve) share one transport: run `python -m <module> <args>` on
+the controller head and parse the JSON between the module's sentinel
+markers — human-readable on the wire, greppable in logs.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+
+def emit(payload: Dict[str, Any], begin: str, end: str) -> None:
+    """Controller-host side: print one framed response."""
+    print(begin + json.dumps(payload) + end, flush=True)
+
+
+def parse(text: str, begin: str, end: str) -> Dict[str, Any]:
+    """Extract the LAST framed response from mixed output."""
+    start = text.rfind(begin)
+    stop = text.rfind(end)
+    if start == -1 or stop == -1 or stop < start:
+        raise exceptions.SkyTpuError(
+            f'Malformed controller response: {text[-500:]!r}')
+    return json.loads(text[start + len(begin):stop])
+
+
+def call(cluster: str, module: str, args: str, begin: str, end: str,
+         *, timeout: float = 120.0) -> Dict[str, Any]:
+    """Client side: run the module on the controller head, parse the
+    framed response."""
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.backend import tpu_gang_backend
+    record = global_user_state.get_cluster_from_name(cluster)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Controller cluster {cluster!r} does not exist.')
+    backend = tpu_gang_backend.TpuGangBackend()
+    cmd = f'python3 -u -m {module} {args}'
+    rc, stdout, stderr = backend.run_on_head(record['handle'], cmd,
+                                             require_outputs=True,
+                                             timeout=timeout)
+    if rc != 0:
+        raise exceptions.CommandError(rc, cmd, stderr or stdout)
+    return parse(stdout, begin, end)
+
+
+def read_job_response(handle, job_id: int, begin: str, end: str,
+                      agent_dir: str = '.skytpu_agent'
+                      ) -> Optional[Dict[str, Any]]:
+    """Read a framed response from a controller agent job's run.log
+    (used to collect the result of a detached registration job)."""
+    import os
+    root = handle.head_agent_root
+    rel = f'{agent_dir}/job_logs/job_{job_id}/run.log'
+    if root is None:
+        from skypilot_tpu.backend import tpu_gang_backend
+        backend = tpu_gang_backend.TpuGangBackend()
+        rc, out, _ = backend.run_on_head(handle, f'cat ~/{rel}',
+                                         require_outputs=True,
+                                         timeout=60)
+        text = out if rc == 0 else ''
+    else:
+        path = os.path.join(root, rel)
+        text = ''
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                text = f.read()
+    return parse(text, begin, end)

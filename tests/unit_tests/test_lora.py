@@ -157,3 +157,38 @@ class TestBaseCheckpointIntoLora:
             t1.mesh, global_batch_size=8, seq_len=32,
             vocab_size=t1.model_config.vocab_size)
         t1.step(next(it1))
+
+
+class TestLegacyCheckpointLayout:
+
+    def test_single_item_state_checkpoint_restores(self, tmp_path):
+        """Checkpoints written by earlier builds (one Composite 'state'
+        item) must keep restoring after the layout split."""
+        import orbax.checkpoint as ocp
+
+        from skypilot_tpu.train import checkpoint as ckpt_lib
+        cfg = trainer_lib.TrainConfig(
+            model='llama-tiny', global_batch_size=8, seq_len=32,
+            total_steps=3, warmup_steps=1,
+            mesh=mesh_lib.MeshConfig(data=2, fsdp=-1),
+            model_overrides={'max_seq_len': 64, 'remat': False})
+        t0 = trainer_lib.Trainer(cfg)
+        t0.init_state()
+        legacy = ocp.CheckpointManager(
+            str(tmp_path / 'ck'),
+            options=ocp.CheckpointManagerOptions(
+                enable_async_checkpointing=False))
+        legacy.save(0, args=ocp.args.Composite(
+            state=ocp.args.StandardSave({
+                'params': t0.state.params,
+                'opt_state': t0.state.opt_state,
+                'step': t0.state.step})))
+        legacy.wait_until_finished()
+        legacy.close()
+        embed = np.asarray(t0.state.params['tok_embed'])
+
+        t1 = trainer_lib.Trainer(cfg)
+        manager = ckpt_lib.make_manager(str(tmp_path / 'ck'))
+        state = ckpt_lib.restore_or_init(manager, t1)
+        np.testing.assert_array_equal(
+            np.asarray(state.params['tok_embed']), embed)

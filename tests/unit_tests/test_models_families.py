@@ -168,11 +168,38 @@ class TestGpt2:
         # The canonical GPT-2 small is ~124M params.
         assert 123e6 < gpt2.num_params(gpt2.CONFIGS['gpt2']) < 126e6
 
-    def test_serving_rejected_with_clear_error(self):
-        # The inference engine always passes decode=True; this family
-        # must fail fast with guidance, not an opaque TypeError.
-        with pytest.raises(ValueError, match='serving'):
-            models.get_model('gpt2-tiny', decode=True)
+    def test_decode_cache_matches_full_forward(self):
+        """GPT-2 serves through the shared KV cache: token-by-token
+        decode must match the full forward."""
+        # Same max_seq_len in both: pos_embed is sized by it.
+        cfg_full = gpt2.get_config('gpt2-tiny', remat=False,
+                                   dtype=jnp.float32, max_seq_len=16,
+                                   attention_impl='reference')
+        cfg_dec = gpt2.get_config('gpt2-tiny', remat=False,
+                                  dtype=jnp.float32, decode=True,
+                                  max_seq_len=16)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                    cfg_full.vocab_size)
+        m_full = gpt2.Gpt2(cfg_full)
+        variables = m_full.init(jax.random.PRNGKey(0), tokens)
+        full_logits = m_full.apply(variables, tokens)
+        m_dec = gpt2.Gpt2(cfg_dec)
+        cache = jax.tree.map(
+            jnp.zeros_like,
+            m_dec.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 1), jnp.int32))['cache'])
+        step_logits = []
+        for i in range(tokens.shape[1]):
+            out, mut = m_dec.apply(
+                {'params': variables['params'], 'cache': cache},
+                tokens[:, i:i + 1],
+                jnp.full((1, 1), i, jnp.int32),
+                mutable=['cache'])
+            cache = mut['cache']
+            step_logits.append(out[:, 0])
+        np.testing.assert_allclose(
+            jnp.stack(step_logits, axis=1), full_logits,
+            atol=2e-3, rtol=2e-3)
 
 
 class TestTrainerIntegration:
