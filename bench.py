@@ -36,10 +36,12 @@ _V6E_TFLOPS = 918.0
 _8B_PARAMS = 8.03e9
 
 # ~550M-param proxy, seq 8192 (where attention actually matters):
-# fits one v5e chip's HBM with remat + bf16.
+# fits one v5e chip's HBM with remat + bf16.  save_attn keeps the
+# flash-attention residuals (~600MB here) so the backward never
+# re-runs the O(s^2) forward kernel — strictly less recompute.
 _BENCH_OVERRIDES = dict(vocab_size=32768, dim=1536, n_layers=12,
                         n_heads=12, n_kv_heads=4, ffn_dim=6144,
-                        remat=True)
+                        remat=True, remat_policy='save_attn')
 _BENCH_BATCH, _BENCH_SEQ = 2, 8192
 # CPU smoke shapes (shared by --quick/--direct and SKYTPU_BENCH_TINY=1
 # e2e so their numbers stay comparable).

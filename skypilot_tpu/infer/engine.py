@@ -205,10 +205,8 @@ class InferenceEngine:
     def _load_checkpoint(self, directory: str, abstract_params,
                          shardings):
         """Load params from a trainer checkpoint (train/checkpoint.py
-        layout: Composite items params/opt_state/step) — params only,
-        restored directly into the serving shardings."""
-        import orbax.checkpoint as ocp
-
+        layouts, split or legacy) — params only, restored directly into
+        the serving shardings."""
         from skypilot_tpu.train import checkpoint as ckpt_lib
         manager = ckpt_lib.make_manager(directory)
         latest = manager.latest_step()
@@ -226,13 +224,19 @@ class InferenceEngine:
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 abstract)
         try:
-            restored = manager.restore(
-                latest, args=ocp.args.Composite(
-                    params=ocp.args.StandardRestore(abs_tree)))['params']
-        except ValueError as e:
+            restored = ckpt_lib.load_params_for_serving(manager,
+                                                        abs_tree)
+        except Exception as e:  # noqa: BLE001 — rewrap with context
+            hint = ''
+            if any('pos_embed' in '/'.join(map(str, path))
+                   for path, _ in jax.tree_util.tree_flatten_with_path(
+                       abs_tree)[0]):
+                hint = (' (this family sizes pos_embed by max_seq_len; '
+                        'serve with the same max_seq_len the model was '
+                        'trained with)')
             raise ValueError(
                 f'checkpoint param tree does not match model '
-                f'{self.config.name!r}: {e}') from None
+                f'{self.config.name!r}: {e}{hint}') from None
         logger.info(f'loaded checkpoint step {latest} from {directory}')
         return restored
 

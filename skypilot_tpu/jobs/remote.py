@@ -130,16 +130,6 @@ def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
 # ---------------------------------------------------------------------------
 # Client-side queries (run a module invocation on the controller head)
 # ---------------------------------------------------------------------------
-def _controller_handle(controller_cluster: Optional[str] = None):
-    from skypilot_tpu import global_user_state
-    cluster = controller_cluster or controller_cluster_name()
-    record = global_user_state.get_cluster_from_name(cluster)
-    if record is None:
-        raise exceptions.ClusterDoesNotExist(
-            f'Jobs controller cluster {cluster!r} does not exist.')
-    return record['handle']
-
-
 def _run_remote(controller_cluster: Optional[str],
                 args: str) -> Dict[str, Any]:
     from skypilot_tpu.utils import controller_rpc

@@ -109,15 +109,15 @@ class Gpt2Attention(nn.Module):
         q, k, v = (jnp.transpose(qkv[:, :, i], (0, 2, 1, 3))
                    for i in range(3))
         if cfg.decode:
+            # run_cached_attention returns [B, S, H, hd] already.
             out = llama.run_cached_attention(
                 self, q, k, v, kv_mask, n_kv_heads=h,
-                max_seq_len=cfg.max_seq_len, dtype=cfg.dtype)
-            out = out.reshape(b, s, h * hd)
-        elif cfg.attention_impl == 'flash':
-            out = fa.flash_attention(q, k, v)
-            out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h * hd)
+                max_seq_len=cfg.max_seq_len,
+                dtype=cfg.dtype).reshape(b, s, h * hd)
         else:
-            out = fa.mha_reference(q, k, v)
+            out = (fa.flash_attention(q, k, v)
+                   if cfg.attention_impl == 'flash'
+                   else fa.mha_reference(q, k, v))
             out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h * hd)
         # GPT-2 scales residual-writing projections by 1/sqrt(2L).
         return dense(cfg.dim, ('heads', 'embed_fsdp'), 'o_proj',

@@ -182,6 +182,10 @@ def status(service_names: Optional[List[str]] = None,
             if s.get('endpoint'):
                 s['endpoint'] = _rewrite_endpoint(s['endpoint'],
                                                   record['handle'])
+    else:
+        logger.warning(
+            f'Controller cluster {cluster!r} record vanished mid-query; '
+            'endpoints shown are controller-local.')
     return services
 
 

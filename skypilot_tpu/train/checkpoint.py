@@ -51,7 +51,17 @@ def make_manager(directory: str, *, max_to_keep: int = 3,
 
 def _is_legacy_layout(manager, step: int) -> bool:
     """True when the checkpoint was written as one Composite 'state'
-    item (the pre-split layout)."""
+    item (the pre-split layout).  Item metadata works for local AND
+    bucket (gs://, s3://) directories; the os.path probe is only a
+    fallback."""
+    try:
+        meta = manager.item_metadata(step)
+        has_state = meta['state'] is not None
+        has_params = meta['params'] is not None
+        if has_state or has_params:
+            return has_state and not has_params
+    except Exception:  # noqa: BLE001 — fall through to the path probe
+        pass
     try:
         d = manager.directory
     except AttributeError:
@@ -125,6 +135,33 @@ def _flatten_metadata(meta):
             str(getattr(p, 'key', getattr(p, 'name', p))) for p in path)
         out[key] = leaf
     return out
+
+
+def load_params_for_serving(manager, abstract_params):
+    """Params-only load for the inference engine: abstract_params is a
+    tree of ShapeDtypeStructs (with serving shardings); handles both
+    the split layout and the legacy single-'state' layout."""
+    import orbax.checkpoint as ocp
+    latest = manager.latest_step()
+    if latest is None:
+        raise FileNotFoundError('no checkpoint step found')
+    if _is_legacy_layout(manager, latest):
+        # Legacy: params live inside the 'state' item.  Restoring a
+        # sub-tree of a StandardSave item is not supported, so restore
+        # the item with abstract params + untyped rest.
+        meta = manager.item_metadata(latest)['state']
+        abstract_state = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype),
+            meta)
+        abstract_state['params'] = abstract_params
+        restored = manager.restore(
+            latest, args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract_state)))['state']
+        return restored['params']
+    restored = manager.restore(
+        latest, args=ocp.args.Composite(
+            params=ocp.args.StandardRestore(abstract_params)))
+    return restored['params']
 
 
 def restore_params_partial(manager, state):

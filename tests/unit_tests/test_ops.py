@@ -129,3 +129,68 @@ class TestUlysses:
         out = jax.jit(uly)(q, k, v)
         ref = fa.mha_reference(q, k, v)
         np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+class TestSaveAttnRematPolicy:
+
+    def test_grads_match_nothing_saveable(self):
+        """remat with save_only_these_names(attn_out, attn_lse) must be
+        numerically identical to full-recompute remat (it only changes
+        WHAT is stored, not the math)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from skypilot_tpu.ops import flash_attention as fa
+
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 128, 64),
+                              jnp.float32)
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 128, 64),
+                              jnp.float32)
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 128, 64),
+                              jnp.float32)
+
+        def loss(q, k, v):
+            return fa.flash_attention(q, k, v).sum()
+
+        g_plain = jax.grad(loss)(q, k, v)
+        g_nothing = jax.grad(jax.checkpoint(
+            loss, policy=jax.checkpoint_policies.nothing_saveable))(
+                q, k, v)
+        g_save = jax.grad(jax.checkpoint(
+            loss, policy=jax.checkpoint_policies.save_only_these_names(
+                'attn_out', 'attn_lse')))(q, k, v)
+        np.testing.assert_allclose(np.asarray(g_save),
+                                   np.asarray(g_nothing), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_save),
+                                   np.asarray(g_plain), atol=1e-5)
+
+    def test_model_level_policy_matches(self):
+        """Llama forward/backward with remat_policy='save_attn' matches
+        the 'nothing' policy bit-for-bit-ish."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from skypilot_tpu.models import llama
+
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                    512)
+
+        def run(policy):
+            cfg = llama.get_config('llama-tiny', dtype=jnp.float32,
+                                   remat=True, remat_policy=policy)
+            model = llama.Llama(cfg)
+            variables = model.init(jax.random.PRNGKey(0), tokens)
+
+            def loss(params):
+                return model.apply({'params': params},
+                                   tokens).astype(jnp.float32).sum()
+
+            return jax.grad(loss)(variables['params'])
+
+        g0 = run('nothing')
+        g1 = run('save_attn')
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
