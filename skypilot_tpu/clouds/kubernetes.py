@@ -42,6 +42,18 @@ GKE_TPU_ACCELERATORS = {
     'v6e': 'tpu-v6e-slice',
 }
 
+# GKE GPU node-pool accelerator labels (cloud.google.com/
+# gke-accelerator) — reference analog: label-based GPU selection in
+# sky/clouds/kubernetes.py + sky/utils/kubernetes/gpu_labeler.py.
+GKE_GPU_ACCELERATORS = {
+    'T4': 'nvidia-tesla-t4',
+    'V100': 'nvidia-tesla-v100',
+    'L4': 'nvidia-l4',
+    'A100': 'nvidia-tesla-a100',
+    'A100-80GB': 'nvidia-a100-80gb',
+    'H100': 'nvidia-h100-80gb',
+}
+
 # Published GKE topologies (gke-tpu-topology) for 2D generations
 # (v5e/v6e); 3D generations (v4/v5p) use cubic factorizations.
 _2D_TOPOLOGIES = {1: '1x1', 4: '2x2', 8: '2x4', 16: '4x4', 32: '4x8',
@@ -73,6 +85,36 @@ def gke_topology(spec: accelerator_registry.TpuSliceSpec) -> str:
         i += 1
     dims = sorted(d for d in dims if d > 1) + [1] * dims.count(1)
     return 'x'.join(str(d) for d in dims)
+
+
+# Per-GPU $/hr anchors for accelerators without a per-count host row in
+# the GCP VM catalog (public list prices; spot ≈ 0.3x).
+_GPU_HOURLY_FALLBACK = {
+    'T4': 0.35, 'V100': 2.48, 'L4': 0.705,
+    'A100': 3.67, 'A100-80GB': 5.07, 'H100': 11.06,
+}
+
+
+def _per_gpu_hourly_price(acc: str, use_spot: bool) -> Optional[float]:
+    """Per-GPU price: derived from any catalog host row carrying this
+    accelerator, else the static anchor table."""
+    inventory = gcp_catalog.list_accelerators(acc)
+    candidates = []
+    for items in inventory.values():
+        for item in items:
+            if item.get('accelerator_name') != acc:
+                continue
+            n = int(item.get('count', 0))
+            if n > 0:
+                price = float(item['spot_price' if use_spot
+                                   else 'price'])
+                candidates.append(price / n)
+    if candidates:
+        return min(candidates)
+    base = _GPU_HOURLY_FALLBACK.get(acc)
+    if base is None:
+        return None
+    return base * 0.3 if use_spot else base
 
 
 @CLOUD_REGISTRY.register(aliases=['k8s', 'gke'])
@@ -142,6 +184,20 @@ class Kubernetes(cloud.Cloud):
         if accelerator_registry.is_tpu({acc: count}):
             spec = accelerator_registry.parse_tpu_accelerator(acc, count)
             return gcp_catalog.get_tpu_hourly_cost(spec, use_spot)
+        if acc in GKE_GPU_ACCELERATORS:
+            # Underlying GKE node price: GCP bundles GPU prices into
+            # their host instance types (a2/g2/a3).  Exact-count host
+            # match first; otherwise scale a per-GPU price derived from
+            # any catalog row, so no combo silently prices at $0 (the
+            # optimizer would then always 'prefer' k8s).
+            types = gcp_catalog.get_instance_type_for_accelerator(
+                acc, count)
+            if types:
+                return min(gcp_catalog.get_hourly_cost(t, use_spot)
+                           for t in types)
+            per_gpu = _per_gpu_hourly_price(acc, use_spot)
+            if per_gpu is not None:
+                return per_gpu * count
         return 0.0
 
     @classmethod
@@ -210,9 +266,19 @@ class Kubernetes(cloud.Cloud):
             )
             return cloud.FeasibleResources([r], [], None)
         if accs:
+            ((acc, count),) = accs.items()
+            if acc in GKE_GPU_ACCELERATORS:
+                r = resources.copy(cloud=cls(),
+                                   instance_type='k8s-gpu-host',
+                                   accelerators=accs)
+                return cloud.FeasibleResources([r], [], None)
+            fuzzy = [f'{name} (Kubernetes)'
+                     for name in GKE_GPU_ACCELERATORS
+                     if acc[:3].lower() in name.lower()]
             return cloud.FeasibleResources(
-                [], [], 'Only TPU accelerators are modeled on '
-                'Kubernetes in this version.')
+                [], fuzzy[:5],
+                f'Accelerator {acc!r} is not a known GKE TPU or GPU '
+                f'type; GPUs: {sorted(GKE_GPU_ACCELERATORS)}.')
         instance_type = cls.get_default_instance_type(
             resources.cpus, resources.memory)
         r = resources.copy(cloud=cls(), instance_type=instance_type)
@@ -256,11 +322,29 @@ class Kubernetes(cloud.Cloud):
             cpus, mem = cls.get_vcpus_mem_from_instance_type(
                 resources.instance_type or
                 cls.get_default_instance_type())
+            # Explicit cpus/memory requests win over the instance-type
+            # defaults ('k8s-gpu-host' is a sentinel with no shape, so
+            # GPU pods would otherwise silently get 4 CPU / 16Gi).
+            def _bound(request) -> Optional[float]:
+                if request is None:
+                    return None
+                return float(str(request).rstrip('+'))
+
+            cpus = _bound(resources.cpus) or cpus
+            mem = _bound(resources.memory) or mem
             variables.update({
                 'tpu_vm': False,
                 'cpus': cpus or 4,
                 'memory_gb': mem or 16,
             })
+            accs = resources.accelerators
+            if accs:
+                ((acc, count),) = accs.items()
+                if acc in GKE_GPU_ACCELERATORS:
+                    variables.update({
+                        'gpu_accelerator': GKE_GPU_ACCELERATORS[acc],
+                        'gpu_count': int(count),
+                    })
         return variables
 
     # ---- credentials -----------------------------------------------------

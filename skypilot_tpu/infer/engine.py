@@ -224,9 +224,11 @@ class InferenceEngine:
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 abstract)
         try:
-            restored = ckpt_lib.load_params_for_serving(manager,
-                                                        abs_tree)
-        except Exception as e:  # noqa: BLE001 — rewrap with context
+            restored = ckpt_lib.load_params_for_serving(
+                manager, abs_tree, step=latest)
+        except ValueError as e:
+            # Genuine tree/shape mismatch; other failures (network,
+            # auth, corruption) propagate with their own tracebacks.
             hint = ''
             if any('pos_embed' in '/'.join(map(str, path))
                    for path, _ in jax.tree_util.tree_flatten_with_path(
@@ -236,7 +238,7 @@ class InferenceEngine:
                         'trained with)')
             raise ValueError(
                 f'checkpoint param tree does not match model '
-                f'{self.config.name!r}: {e}{hint}') from None
+                f'{self.config.name!r}: {e}{hint}') from e
         logger.info(f'loaded checkpoint step {latest} from {directory}')
         return restored
 

@@ -97,6 +97,21 @@ def _pod_manifest(cluster: str, node: int, host: int,
             'limits': {'google.com/tpu': str(chips)},
             'requests': {'google.com/tpu': str(chips)},
         }
+    elif cfg.get('gpu_accelerator'):
+        # GPU pod: nvidia.com/gpu device-plugin resource, pinned to the
+        # node pool via the GKE accelerator label (reference: label-
+        # based GPU selection, sky/clouds/kubernetes.py).
+        node_selector['cloud.google.com/gke-accelerator'] = \
+            cfg['gpu_accelerator']
+        count = str(cfg.get('gpu_count', 1))
+        container['resources'] = {
+            'limits': {'nvidia.com/gpu': count},
+            'requests': {
+                'nvidia.com/gpu': count,
+                'cpu': str(cfg.get('cpus', 4)),
+                'memory': f"{cfg.get('memory_gb', 16)}Gi",
+            },
+        }
     else:
         container['resources'] = {
             'requests': {

@@ -45,7 +45,9 @@ def make_manager(directory: str, *, max_to_keep: int = 3,
             'step': ocp.ArrayCheckpointHandler(),
             # Pre-split layout (single 'state' item) — read-only
             # compatibility for checkpoints written by earlier builds.
-            'state': ocp.StandardCheckpointHandler(),
+            # PyTreeCheckpointHandler (same on-disk format Standard*
+            # wraps) so partial_restore can pull just the params.
+            'state': ocp.PyTreeCheckpointHandler(),
         })
 
 
@@ -103,7 +105,7 @@ def restore(manager, state):
     if _is_legacy_layout(manager, latest):
         restored = manager.restore(
             latest, args=ocp.args.Composite(
-                state=ocp.args.StandardRestore({
+                state=ocp.args.PyTreeRestore(item={
                     'params': _abstract(state.params),
                     'opt_state': _abstract(state.opt_state),
                     'step': _abstract(state.step),
@@ -137,26 +139,24 @@ def _flatten_metadata(meta):
     return out
 
 
-def load_params_for_serving(manager, abstract_params):
+def load_params_for_serving(manager, abstract_params,
+                            step: Optional[int] = None):
     """Params-only load for the inference engine: abstract_params is a
     tree of ShapeDtypeStructs (with serving shardings); handles both
     the split layout and the legacy single-'state' layout."""
     import orbax.checkpoint as ocp
-    latest = manager.latest_step()
+    latest = step if step is not None else manager.latest_step()
     if latest is None:
         raise FileNotFoundError('no checkpoint step found')
     if _is_legacy_layout(manager, latest):
-        # Legacy: params live inside the 'state' item.  Restoring a
-        # sub-tree of a StandardSave item is not supported, so restore
-        # the item with abstract params + untyped rest.
-        meta = manager.item_metadata(latest)['state']
-        abstract_state = jax.tree.map(
-            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype),
-            meta)
-        abstract_state['params'] = abstract_params
+        # Legacy: params live inside the 'state' item.  partial_restore
+        # pulls ONLY the params subtree — a serving host sized for the
+        # params must not materialize the (2x larger) optimizer state.
         restored = manager.restore(
             latest, args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract_state)))['state']
+                state=ocp.args.PyTreeRestore(
+                    item={'params': abstract_params},
+                    partial_restore=True)))['state']
         return restored['params']
     restored = manager.restore(
         latest, args=ocp.args.Composite(

@@ -226,3 +226,76 @@ class TestCloud:
         assert runner.context == 'ctx'
         assert runner.namespace == 'ns1'
         assert runner.pod == 'pod-0'
+
+
+class TestGkeGpus:
+
+    def test_gpu_pod_manifest(self):
+        cfg = {'context': 'c', 'namespace': 'default',
+               'image': 'python:3.11-slim', 'tpu_vm': False, 'cpus': 8,
+               'memory_gb': 32, 'use_spot': False, 'labels': {},
+               'gpu_accelerator': 'nvidia-tesla-a100', 'gpu_count': 8}
+        objs = k8s_instance.build_manifests('gp1', cfg, 1, 'default')
+        (pod,) = [o for o in objs if o['kind'] == 'Pod']
+        res = pod['spec']['containers'][0]['resources']
+        assert res['limits'] == {'nvidia.com/gpu': '8'}
+        assert res['requests']['nvidia.com/gpu'] == '8'
+        assert pod['spec']['nodeSelector'][
+            'cloud.google.com/gke-accelerator'] == 'nvidia-tesla-a100'
+
+    def test_gpu_feasibility_and_deploy_vars(self):
+        from skypilot_tpu import resources as resources_lib
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        k8s = k8s_cloud.Kubernetes()
+        r = resources_lib.Resources(accelerators='A100:8')
+        feas = k8s._get_feasible_launchable_resources(r)
+        assert len(feas.resources_list) == 1
+        chosen = feas.resources_list[0]
+        assert chosen.instance_type == 'k8s-gpu-host'
+        variables = k8s.make_deploy_resources_variables(
+            chosen, 'gp2', cloud_lib.Region('ctx'), None, 1)
+        assert variables['gpu_accelerator'] == 'nvidia-tesla-a100'
+        assert variables['gpu_count'] == 8
+
+    def test_unknown_accelerator_hint(self):
+        from skypilot_tpu import resources as resources_lib
+        k8s = k8s_cloud.Kubernetes()
+        r = resources_lib.Resources(accelerators='RTX4090:1')
+        feas = k8s._get_feasible_launchable_resources(r)
+        assert feas.resources_list == []
+        assert 'not a known GKE' in feas.hint
+
+    def test_gpu_priced_from_gcp_catalog(self):
+        # Priced as the cheapest GCP host carrying the accelerator
+        # (GPU prices are bundled into a2/g2/a3 instance types).
+        cost = k8s_cloud.Kubernetes.accelerators_to_hourly_cost(
+            {'A100': 8}, use_spot=False)
+        from skypilot_tpu.catalog import gcp_catalog
+        assert cost == pytest.approx(
+            gcp_catalog.get_hourly_cost('a2-highgpu-8g', False))
+        assert cost > 0
+
+    def test_uncatalogued_gpu_counts_still_priced(self):
+        # A100:4 has no exact host row; per-GPU scaling must apply.
+        c4 = k8s_cloud.Kubernetes.accelerators_to_hourly_cost(
+            {'A100': 4}, use_spot=False)
+        c1 = k8s_cloud.Kubernetes.accelerators_to_hourly_cost(
+            {'A100': 1}, use_spot=False)
+        assert c4 == pytest.approx(4 * (c1 if c1 else c4 / 4), rel=0.3)
+        assert c4 > 0
+        # T4 has no catalog row at all -> static anchor.
+        assert k8s_cloud.Kubernetes.accelerators_to_hourly_cost(
+            {'T4': 1}, use_spot=False) > 0
+
+    def test_gpu_pod_honors_explicit_cpu_memory(self):
+        from skypilot_tpu import resources as resources_lib
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        k8s = k8s_cloud.Kubernetes()
+        r = resources_lib.Resources(accelerators='A100:8', cpus='32+',
+                                    memory='128')
+        (chosen,) = k8s._get_feasible_launchable_resources(
+            r).resources_list
+        variables = k8s.make_deploy_resources_variables(
+            chosen, 'gp3', cloud_lib.Region('ctx'), None, 1)
+        assert variables['cpus'] == 32
+        assert variables['memory_gb'] == 128

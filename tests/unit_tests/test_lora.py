@@ -192,3 +192,36 @@ class TestLegacyCheckpointLayout:
         state = ckpt_lib.restore_or_init(manager, t1)
         np.testing.assert_array_equal(
             np.asarray(state.params['tok_embed']), embed)
+
+    def test_serving_partial_load_from_legacy(self, tmp_path):
+        """The inference engine's params-only load must read legacy
+        checkpoints WITHOUT materializing their optimizer state."""
+        import orbax.checkpoint as ocp
+
+        from skypilot_tpu.train import checkpoint as ckpt_lib
+        cfg = trainer_lib.TrainConfig(
+            model='llama-tiny', global_batch_size=8, seq_len=32,
+            total_steps=3, warmup_steps=1,
+            mesh=mesh_lib.MeshConfig(data=2, fsdp=-1),
+            model_overrides={'max_seq_len': 64, 'remat': False})
+        t0 = trainer_lib.Trainer(cfg)
+        t0.init_state()
+        legacy = ocp.CheckpointManager(
+            str(tmp_path / 'ck'),
+            options=ocp.CheckpointManagerOptions(
+                enable_async_checkpointing=False))
+        legacy.save(0, args=ocp.args.Composite(
+            state=ocp.args.StandardSave({
+                'params': t0.state.params,
+                'opt_state': t0.state.opt_state,
+                'step': t0.state.step})))
+        legacy.wait_until_finished()
+        legacy.close()
+        manager = ckpt_lib.make_manager(str(tmp_path / 'ck'))
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            t0.state.params)
+        params = ckpt_lib.load_params_for_serving(manager, abstract)
+        np.testing.assert_array_equal(
+            np.asarray(params['tok_embed']),
+            np.asarray(t0.state.params['tok_embed']))
