@@ -633,12 +633,18 @@ def serve_status(service_names, remote_controller) -> None:
 @serve.command(name='update')
 @click.argument('service_name', required=True)
 @click.argument('entrypoint', nargs=-1, required=True)
+@click.option('--remote-controller', is_flag=True, default=False)
 @_add_options(_RESOURCE_OPTIONS)
-def serve_update(service_name, entrypoint, **overrides) -> None:
+def serve_update(service_name, entrypoint, remote_controller,
+                 **overrides) -> None:
     """Rolling-update a running service to a new task/spec."""
-    from skypilot_tpu.serve import core as serve_core
     task = _make_task(entrypoint, **overrides)
-    version = serve_core.update(task, service_name)
+    if remote_controller:
+        from skypilot_tpu.serve import remote as serve_remote
+        version = serve_remote.update(task, service_name)
+    else:
+        from skypilot_tpu.serve import core as serve_core
+        version = serve_core.update(task, service_name)
     click.echo(f'Service {service_name!r} updating to version {version}.')
 
 

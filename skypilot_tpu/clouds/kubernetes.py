@@ -328,10 +328,18 @@ class Kubernetes(cloud.Cloud):
             def _bound(request) -> Optional[float]:
                 if request is None:
                     return None
-                return float(str(request).rstrip('+'))
+                s = str(request).rstrip('+')
+                if s.endswith('x'):
+                    return None  # 'Nx' (mem = N * vCPUs): resolved below
+                return float(s)
 
             cpus = _bound(resources.cpus) or cpus
-            mem = _bound(resources.memory) or mem
+            explicit_mem = _bound(resources.memory)
+            if explicit_mem is None and resources.memory is not None \
+                    and str(resources.memory).rstrip('+').endswith('x'):
+                factor = float(str(resources.memory).rstrip('+')[:-1])
+                explicit_mem = factor * (cpus or 4)
+            mem = explicit_mem or mem
             variables.update({
                 'tpu_vm': False,
                 'cpus': cpus or 4,

@@ -61,6 +61,44 @@ def controller_resources() -> Any:
     return resources_lib.Resources(cloud='gcp', cpus='4+')
 
 
+def _run_controller_job(cluster: str, run_cmd_fmt: str,
+                        local_yaml_src: str, basename: str,
+                        resources: Optional[Any],
+                        what: str) -> Dict[str, Any]:
+    """Ship a YAML to the controller cluster, run a skypilot_tpu.serve.
+    remote invocation there as a detached agent job, and poll its framed
+    response (shared by up()/update())."""
+    from skypilot_tpu import execution
+    controller_task = task_lib.Task(
+        name=what,
+        run=run_cmd_fmt.format(path=f'../{_TASK_MOUNT_DIR}/{basename}'),
+    )
+    controller_task.set_file_mounts(
+        {f'{_TASK_MOUNT_DIR}/{basename}': local_yaml_src})
+    if resources is not None:
+        controller_task.set_resources(resources)
+    job_id, handle = execution.launch(controller_task,
+                                      cluster_name=cluster,
+                                      detach_run=True,
+                                      quiet_optimizer=True)
+    deadline = time.time() + 300
+    last: Dict[str, Any] = {}
+    while time.time() < deadline:
+        try:
+            last = _read_job_response(handle, job_id)
+            break
+        except exceptions.SkyTpuError:
+            time.sleep(2)
+    if not last:
+        raise exceptions.ServeUserTerminatedError(
+            f'{what} on controller cluster {cluster!r} produced no '
+            f'response within 300s; see: sky logs {cluster} {job_id}')
+    if 'error' in last:
+        raise exceptions.ServeUserTerminatedError(last['error'])
+    last['_handle'] = handle
+    return last
+
+
 def up(task: task_lib.Task,
        service_name: Optional[str] = None,
        controller_cluster: Optional[str] = None,
@@ -69,7 +107,6 @@ def up(task: task_lib.Task,
 
     Returns {'service_name', 'endpoint', 'controller_cluster'} — the
     endpoint is the controller host address with the LB port."""
-    from skypilot_tpu import execution
     if task.service is None:
         raise exceptions.TaskValidationError(
             'Task must define a `service` section for sky serve up.')
@@ -87,46 +124,58 @@ def up(task: task_lib.Task,
     local_yaml = os.path.join(local_dir, basename)
     from skypilot_tpu.utils import common_utils
     common_utils.dump_yaml(local_yaml, task.to_yaml_config())
-
-    controller_task = task_lib.Task(
-        name=f'serve-{service_name}',
-        run=(f'python3 -m skypilot_tpu.serve.remote '
-             f'--task ../{_TASK_MOUNT_DIR}/{basename} '
-             f'--service-name {shlex.quote(service_name)}'),
-    )
-    controller_task.set_file_mounts(
-        {f'{_TASK_MOUNT_DIR}/{basename}': local_yaml})
-    controller_task.set_resources(resources or controller_resources())
+    run_fmt = ('python3 -m skypilot_tpu.serve.remote --task {path} '
+               f'--service-name {shlex.quote(service_name)}')
     try:
-        job_id, handle = execution.launch(controller_task,
-                                          cluster_name=cluster,
-                                          detach_run=True,
-                                          quiet_optimizer=True)
+        last = _run_controller_job(
+            cluster, run_fmt, local_yaml, basename,
+            resources or controller_resources(),
+            f'serve-{service_name}')
     finally:
         shutil.rmtree(local_dir, ignore_errors=True)
-
-    # The registration job prints the endpoint; poll its output.
-    deadline = time.time() + 300
-    last: Dict[str, Any] = {}
-    while time.time() < deadline:
-        try:
-            last = _read_job_response(handle, job_id)
-            break
-        except exceptions.SkyTpuError:
-            time.sleep(2)
-    if not last:
-        raise exceptions.ServeUserTerminatedError(
-            f'Service registration on controller cluster {cluster!r} '
-            f'produced no response within 300s; inspect the controller '
-            f'job log: sky logs {cluster} {job_id}')
-    if 'error' in last:
-        raise exceptions.ServeUserTerminatedError(last['error'])
-    endpoint = _rewrite_endpoint(last.get('endpoint', ''), handle)
+    endpoint = _rewrite_endpoint(last.get('endpoint', ''),
+                                 last['_handle'])
     logger.info(
         f'Service {service_name!r} deployed on controller cluster '
         f'{cluster!r} at {endpoint}; the runtime survives this client.')
     return {'service_name': service_name, 'endpoint': endpoint,
             'controller_cluster': cluster}
+
+
+def update(task: task_lib.Task, service_name: str,
+           controller_cluster: Optional[str] = None) -> int:
+    """Rolling-update a service on the controller cluster: ship the new
+    task YAML there and bump the service version (reference
+    serve/core.py:362 semantics, controller-hosted)."""
+    if task.service is None:
+        raise exceptions.TaskValidationError(
+            'Task must define a `service` section.')
+    cluster = controller_cluster or controller_cluster_name()
+    # Update targets an EXISTING controller; never provision one as a
+    # side effect (a missing controller means there is no service).
+    from skypilot_tpu import global_user_state
+    if global_user_state.get_cluster_from_name(cluster) is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Serve controller cluster {cluster!r} does not exist; '
+            'deploy with `sky serve up --remote-controller` first.')
+    basename = f'svc-update-{uuid.uuid4().hex[:8]}.yaml'
+    local_dir = tempfile.mkdtemp(prefix='skytpu-serve-')
+    local_yaml = os.path.join(local_dir, basename)
+    from skypilot_tpu.utils import common_utils
+    common_utils.dump_yaml(local_yaml, task.to_yaml_config())
+    run_fmt = ('python3 -m skypilot_tpu.serve.remote '
+               '--update-task {path} '
+               f'--service-name {shlex.quote(service_name)}')
+    try:
+        last = _run_controller_job(cluster, run_fmt, local_yaml,
+                                   basename, None,
+                                   f'serve-update-{service_name}')
+    finally:
+        shutil.rmtree(local_dir, ignore_errors=True)
+    version = int(last['version'])
+    logger.info(f'Service {service_name!r} updating to version '
+                f'{version} on controller {cluster!r}.')
+    return version
 
 
 def _rewrite_endpoint(endpoint: str, handle) -> str:
@@ -224,6 +273,17 @@ def _register_service(task_path: str, service_name: str) -> None:
         raise
 
 
+def _update_service(task_path: str, service_name: str) -> None:
+    from skypilot_tpu.serve import core as serve_core
+    try:
+        task = task_lib.Task.from_yaml(os.path.expanduser(task_path))
+        version = serve_core.update(task, service_name)
+        _emit({'service_name': service_name, 'version': version})
+    except Exception as e:  # noqa: BLE001 — reported to the client
+        _emit({'error': f'{type(e).__name__}: {e}'})
+        raise
+
+
 def _status_json(service_names: Optional[List[str]]) -> None:
     from skypilot_tpu.serve import core as serve_core
     services = serve_core.status(service_names)
@@ -241,6 +301,7 @@ def _status_json(service_names: Optional[List[str]]) -> None:
 def main(argv: Optional[List[str]] = None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--task', default=None)
+    parser.add_argument('--update-task', default=None)
     parser.add_argument('--service-name', default=None)
     parser.add_argument('--status-json', action='store_true')
     parser.add_argument('--service-names', nargs='+', default=None)
@@ -251,6 +312,8 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     if args.task:
         _register_service(args.task, args.service_name)
+    elif args.update_task:
+        _update_service(args.update_task, args.service_name)
     elif args.status_json:
         _status_json(args.service_names)
     elif args.down or args.down_all:

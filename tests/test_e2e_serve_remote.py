@@ -102,6 +102,11 @@ class TestServeRemoteController:
                 time.sleep(0.5)
         assert body and body.startswith('replica-'), body
 
+        # Rolling update through the controller: bump the spec/task.
+        version = serve_remote.update(_service_task(), 'rsvc',
+                                      controller_cluster=CONTROLLER)
+        assert version == 2
+
         downed = serve_remote.down(['rsvc'],
                                    controller_cluster=CONTROLLER)
         assert downed == ['rsvc']

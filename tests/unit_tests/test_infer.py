@@ -125,6 +125,18 @@ class TestEngineSharded:
         assert len(out) == 2
         assert all(len(o) == 4 for o in out)
 
+    def test_gemma_engine_generates(self):
+        eng = engine_lib.InferenceEngine(
+            'gemma-tiny', max_batch_size=2,
+            model_overrides={'max_seq_len': 64,
+                             'dtype': jnp.float32,
+                             'param_dtype': jnp.float32})
+        out = eng.generate(
+            [[5, 6, 7], [1, 2]],
+            engine_lib.SamplingConfig(max_new_tokens=4))
+        assert len(out) == 2
+        assert all(len(o) == 4 for o in out)
+
 
 class TestEngineCheckpoint:
 

@@ -69,7 +69,9 @@ class TestManagedJobs:
                              controller_mode='thread')
         rows = jobs.queue()
         assert any(r['job_id'] == job_id for r in rows)
-        jobs.wait(job_id, timeout=90)
+        # Generous: under a fully loaded suite the thread controller's
+        # launch+probe loop can lag well past the usual few seconds.
+        jobs.wait(job_id, timeout=150)
         assert jobs.get_status(job_id) == jobs.ManagedJobStatus.SUCCEEDED
         info = jobs_state.get_job_info(job_id)
         assert info['schedule_state'] == jobs_state.ScheduleState.DONE
