@@ -1,0 +1,53 @@
+"""CLI surface regression net: every command group and verb the
+reference exposes (cli.py:1073-5163 analogs) stays present, with the
+TPU-first additions. Cheap --help invocations only."""
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import cli as cli_mod
+
+
+@pytest.fixture()
+def runner():
+    return CliRunner()
+
+
+def _ok(runner, *args):
+    result = runner.invoke(cli_mod.cli, [*args, '--help'])
+    assert result.exit_code == 0, result.output
+    return result.output
+
+
+TOP_LEVEL = ['launch', 'exec', 'status', 'queue', 'logs', 'cancel',
+             'stop', 'start', 'down', 'autostop', 'check', 'show-tpus',
+             'show-accelerators', 'cost-report']
+GROUPS = {
+    'jobs': ['launch', 'queue', 'cancel', 'logs', 'dashboard'],
+    'serve': ['up', 'status', 'update', 'logs', 'down'],
+    'storage': [],
+    'catalog': ['update'],
+    'bench': [],
+}
+
+
+class TestCliSurface:
+
+    @pytest.mark.parametrize('cmd', TOP_LEVEL)
+    def test_top_level_commands(self, runner, cmd):
+        _ok(runner, cmd)
+
+    @pytest.mark.parametrize('group,verbs',
+                             list(GROUPS.items()),
+                             ids=list(GROUPS))
+    def test_groups_and_verbs(self, runner, group, verbs):
+        out = _ok(runner, group)
+        for verb in verbs:
+            assert verb in out, f'{group} {verb} missing'
+            _ok(runner, group, verb)
+
+    def test_tpu_first_flags_present(self, runner):
+        assert '--docker' in _ok(runner, 'launch')
+        assert '--remote-controller' in _ok(runner, 'jobs', 'launch')
+        for verb in ('up', 'status', 'update', 'down'):
+            assert '--remote-controller' in _ok(runner, 'serve', verb)
+        assert '--accelerators' in _ok(runner, 'launch')

@@ -245,3 +245,38 @@ class TestServeState:
         serve_state.clear_replica_failures('svc', 1)
         assert serve_state.get_replica(
             'svc', 1)['consecutive_failures'] == 0
+
+
+class TestServeRemoteClientSide:
+    """Hermetic client-side behavior of the self-hosted controller
+    surface (the full loop is covered by tests/test_e2e_serve_remote)."""
+
+    def test_bad_service_name_rejected_before_provisioning(self):
+        import skypilot_tpu as sky
+        from skypilot_tpu.serve import remote as serve_remote
+        t = sky.Task(run='true')
+        t.set_resources(sky.Resources(cloud='local'))
+        from skypilot_tpu.serve import service_spec as spec_lib
+        t.set_service(spec_lib.SkyServiceSpec(readiness_path='/h',
+                                              min_replicas=1))
+        with pytest.raises(Exception, match='[Ii]nvalid'):
+            serve_remote.up(t, service_name='Bad Name!')
+
+    def test_update_requires_existing_controller(self):
+        import skypilot_tpu as sky
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.serve import remote as serve_remote
+        t = sky.Task(run='true')
+        t.set_resources(sky.Resources(cloud='local'))
+        from skypilot_tpu.serve import service_spec as spec_lib
+        t.set_service(spec_lib.SkyServiceSpec(readiness_path='/h',
+                                              min_replicas=1))
+        with pytest.raises(exceptions.ClusterDoesNotExist):
+            serve_remote.update(t, 'svc',
+                                controller_cluster='nonexistent-ctrl')
+
+    def test_status_requires_existing_controller(self):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.serve import remote as serve_remote
+        with pytest.raises(exceptions.ClusterDoesNotExist):
+            serve_remote.status(controller_cluster='nonexistent-ctrl')
