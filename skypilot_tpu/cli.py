@@ -553,7 +553,19 @@ def jobs_cancel(job_ids, all_jobs, remote_controller) -> None:
 @click.option('--follow/--no-follow', default=True)
 @click.option('--controller', is_flag=True, default=False,
               help='Show the recovery controller log instead.')
-def jobs_logs(job_id, name, follow, controller) -> None:
+@click.option('--remote-controller', is_flag=True, default=False,
+              help='Fetch the controller EVENT log from the controller '
+                   'cluster (one-shot; task run logs stream via '
+                   '`sky logs <task-cluster>`).')
+def jobs_logs(job_id, name, follow, controller,
+              remote_controller) -> None:
+    if remote_controller:
+        if job_id is None or name is not None:
+            raise click.UsageError(
+                '--remote-controller takes a job id (not --name).')
+        from skypilot_tpu.jobs import remote as jobs_remote
+        click.echo(jobs_remote.tail_logs(job_id))
+        return
     from skypilot_tpu.jobs import core as jobs_core
     out = jobs_core.tail_logs(job_id, name=name, controller=controller,
                               follow=follow and not controller)

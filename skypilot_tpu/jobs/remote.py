@@ -156,6 +156,16 @@ def cancel(job_ids: Optional[List[int]] = None,
     return _run_remote(controller_cluster, args)['cancelled']
 
 
+def tail_logs(job_id: int, *,
+              controller_cluster: Optional[str] = None) -> str:
+    """The managed job's controller EVENT log, fetched from the
+    controller host.  (Task run logs stream from the task cluster
+    itself — `sky logs <task-cluster>` — not through this RPC: a
+    framed response cannot carry a live stream.)"""
+    args = f'--job-log {int(job_id)}'
+    return _run_remote(controller_cluster, args)['log']
+
+
 # ---------------------------------------------------------------------------
 # Controller-host side (the file-mounted job's run command)
 # ---------------------------------------------------------------------------
@@ -197,6 +207,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument('--dag', default=None)
     parser.add_argument('--name', default=None)
     parser.add_argument('--queue-json', action='store_true')
+    parser.add_argument('--job-log', type=int, default=None)
     parser.add_argument('--cancel', type=int, nargs='+', default=None)
     parser.add_argument('--cancel-all', action='store_true')
     args = parser.parse_args(argv)
@@ -211,6 +222,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                               if hasattr(j['status'], 'value')
                               else j['status'])
         _emit({'jobs': jobs})
+    elif args.job_log is not None:
+        log = jobs_core.tail_logs(args.job_log, controller=True)
+        _emit({'log': log[-200_000:]})
     elif args.cancel or args.cancel_all:
         cancelled = jobs_core.cancel(job_ids=args.cancel,
                                      all_jobs=args.cancel_all)

@@ -108,6 +108,10 @@ class TestSelfHostedController:
         # Client-side RPC surface against the controller cluster.
         queue = jobs_remote.queue(controller_cluster=CONTROLLER)
         assert any(j['job_id'] == job_id for j in queue)
+        log = jobs_remote.tail_logs(job_id,
+                                    controller_cluster=CONTROLLER)
+        # Controller event log: registration/launch events present.
+        assert '"event"' in log and 'submitted' in log, log[-300:]
         cancelled = jobs_remote.cancel(job_ids=[job_id],
                                        controller_cluster=CONTROLLER)
         assert cancelled == [job_id]
