@@ -105,3 +105,18 @@ class TestLaunchIntegration:
         assert 'secret-command' not in blob
         assert 'hunter2' not in blob
         sky.down('telemetry-c')
+
+
+class TestSpoolRotation:
+
+    def test_spool_rotates_past_size_cap(self, monkeypatch):
+        monkeypatch.setattr(usage_lib, '_SPOOL_MAX_BYTES', 512)
+        for _ in range(30):
+            _outer()
+        import os
+        path = usage_lib._spool_path()
+        assert os.path.exists(path)
+        assert os.path.getsize(path) <= 512 + 1024  # one message slack
+        assert os.path.exists(path + '.1')  # rotated generation kept
+        # Spool remains parseable after rotation.
+        assert all('entrypoint' in m for m in usage_lib.read_spool())
