@@ -28,17 +28,37 @@ MULTI = [p for p in _ALL_YAMLS if _is_multidoc(p)]
 
 def _check_train_invocation(run: str) -> None:
     """A `python -m skypilot_tpu.train` line must name a registered
-    model and use only real mesh axes."""
+    model, use only real mesh axes, and carry overrides the model
+    config actually accepts."""
+    import dataclasses
+    import json
+
     model = re.search(r'--model\s+(\$\w+|\S+)', run)
+    model_name = None
     if model and not model.group(1).startswith('$'):
-        assert model.group(1) in models.available_models(), (
-            f'unknown model {model.group(1)!r} in example')
+        model_name = model.group(1)
+        assert model_name in models.available_models(), (
+            f'unknown model {model_name!r} in example')
     mesh = re.search(r'--mesh\s+(\S+)', run)
     if mesh and not mesh.group(1).startswith('$'):
         for part in mesh.group(1).split(','):
             axis, _, size = part.partition('=')
             assert axis in mesh_lib.AXES, f'unknown mesh axis {axis!r}'
             assert int(size) >= -1
+    overrides = re.search(r"--model-overrides\s+'([^']+)'", run)
+    if overrides and model_name:
+        parsed = json.loads(overrides.group(1))
+        _, config = models.get_model(model_name)
+        valid = {f.name for f in dataclasses.fields(config)}
+        unknown = set(parsed) - valid
+        assert not unknown, (
+            f'overrides {unknown} not in {model_name!r} config')
+    train_only = re.search(r'--train-only\s+(\S+)', run)
+    if train_only:
+        # 'lora' freezing only makes sense with adapters enabled.
+        assert overrides is not None and \
+            'lora_rank' in overrides.group(1), (
+                '--train-only without lora_rank freezes everything')
 
 
 @pytest.mark.parametrize('path', SINGLE, ids=lambda p: p.name)
