@@ -31,6 +31,15 @@ import os
 import sys
 import time
 
+class BenchError(RuntimeError):
+    """A benchmark attempt produced no metric (job failed, no metrics
+    line, backend refused init, ...).  Carries a log tail for stderr."""
+
+    def __init__(self, msg: str, log_tail: str = ''):
+        super().__init__(msg)
+        self.log_tail = log_tail
+
+
 _BASELINE_V6E_TOKENS_PER_SEC_PER_CHIP = 0.476 * 8192 / 8
 _V6E_TFLOPS = 918.0
 _8B_PARAMS = 8.03e9
@@ -109,12 +118,26 @@ def run_direct(quick: bool, steps_arg) -> None:
     """In-process trainer (no orchestration path)."""
     import jax
 
+    if quick:
+        # --quick is a CPU smoke: must never touch (or hang on) the
+        # tunneled TPU backend.  The env var alone is not enough —
+        # this environment's sitecustomize registers the tunnel
+        # platform at interpreter startup — so force via jax.config,
+        # same recipe as tests/conftest.py.
+        jax.config.update('jax_platforms', 'cpu')
+
     from skypilot_tpu.models import llama
     from skypilot_tpu.parallel import mesh as mesh_lib
     from skypilot_tpu.train import data as data_lib
     from skypilot_tpu.train import trainer as trainer_lib
 
-    on_tpu = jax.default_backend() == 'tpu'
+    # First backend touch goes through the hang watchdog: a wedged
+    # tunnel raises (so the retry/fallback ladder runs) instead of
+    # blocking forever.
+    devices = mesh_lib.devices_with_retry()
+    kinds = {getattr(d, 'device_kind', '') for d in devices}
+    on_tpu = (jax.default_backend() in ('tpu', 'axon')
+              or any('TPU' in k.upper() for k in kinds))
     if on_tpu and not quick:
         overrides = dict(_BENCH_OVERRIDES, max_seq_len=_BENCH_SEQ)
         batch, seq = _BENCH_BATCH, _BENCH_SEQ
@@ -144,6 +167,47 @@ def run_direct(quick: bool, steps_arg) -> None:
     _emit(steps * batch * seq / dt, n_params, len(jax.devices()),
           jax.devices()[0].device_kind, seq,
           attn_flops_per_token=_attn_flops_per_token(overrides, seq))
+
+
+def run_direct_subprocess(steps_arg) -> None:
+    """--direct in a fresh interpreter with a hard wall-clock cap.
+
+    The fallback must be isolated: if the in-job backend hang already
+    burned an e2e attempt, this (orchestrating) process has never
+    imported jax and must stay that way — a child that wedges is
+    killed by the timeout and surfaces as BenchError, not a hung
+    driver run.
+    """
+    import subprocess
+    timeout_s = float(os.environ.get('SKYTPU_BENCH_DIRECT_TIMEOUT_S',
+                                     '2400'))
+    cmd = [sys.executable, os.path.abspath(__file__), '--direct']
+    if steps_arg:
+        cmd += ['--steps', str(steps_arg)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, check=False)
+    except subprocess.TimeoutExpired as e:
+        # Surface whatever the child managed to say before the kill —
+        # this is exactly the wedged-backend case the timeout guards.
+        def _txt(b):
+            return b.decode('utf-8', 'replace') if isinstance(
+                b, bytes) else (b or '')
+        raise BenchError(
+            f'--direct subprocess timed out after {timeout_s:.0f}s',
+            (_txt(e.stdout) + _txt(e.stderr))[-1500:]) from e
+    sys.stderr.write(proc.stderr)
+    metric = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith('{'):
+            metric = line
+    if proc.returncode != 0 or metric is None:
+        raise BenchError(
+            f'--direct subprocess failed (rc={proc.returncode}, '
+            f'metric={"present" if metric else "missing"})',
+            proc.stdout[-1000:])
+    print(metric)
 
 
 def run_through_launch(steps_arg) -> None:
@@ -198,7 +262,8 @@ def run_through_launch(steps_arg) -> None:
 
 def _finish_through_launch(sky, cluster, job_id, handle, step_log,
                            launch_started, overrides) -> None:
-    deadline = time.time() + 3600
+    deadline = time.time() + float(
+        os.environ.get('SKYTPU_BENCH_E2E_DEADLINE_S', '3600'))
     while time.time() < deadline:
         status = sky.job_status(cluster, [job_id])[job_id]
         if status in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP',
@@ -213,23 +278,14 @@ def _finish_through_launch(sky, cluster, job_id, handle, step_log,
         with open(log_path, encoding='utf-8') as f:
             log = f.read()
     if status != 'SUCCEEDED':
-        print(json.dumps({'metric': 'bench-e2e', 'value': 0,
-                          'unit': 'error',
-                          'vs_baseline': 0,
-                          'error': f'job {status}'}))
-        print(log[-2000:], file=sys.stderr)
-        return
+        raise BenchError(f'job {status}', log[-2000:])
     metrics = None
     for line in log.splitlines():
         if 'SKYTPU_METRICS ' in line:
             metrics = json.loads(
                 line.split('SKYTPU_METRICS ', 1)[1])
     if not metrics:
-        print(json.dumps({'metric': 'bench-e2e', 'value': 0,
-                          'unit': 'error', 'vs_baseline': 0,
-                          'error': f'no metrics line in {log_path}'}))
-        print(log[-2000:], file=sys.stderr)
-        return
+        raise BenchError(f'no metrics line in {log_path}', log[-2000:])
     first_step_ts = None
     if os.path.exists(step_log):
         with open(step_log, encoding='utf-8') as f:
@@ -261,8 +317,45 @@ def main() -> None:
     args = parser.parse_args()
     if args.quick or args.direct:
         run_direct(args.quick, args.steps)
-    else:
-        run_through_launch(args.steps)
+        return
+    # The e2e path is primary (provision-to-first-step is half the
+    # north star) but the capture must be unkillable: retry the e2e
+    # once, then fall back to --direct (no orchestration, still a real
+    # hardware number), and exit non-zero if NO attempt produced a
+    # metric — a silent rc-0/no-metric run must never happen again.
+    failures = []
+    for attempt in range(2):
+        try:
+            run_through_launch(args.steps)
+            return
+        except BaseException as e:  # noqa: BLE001 — any loss of the
+            # metric (job failure, backend init, orchestration crash)
+            # must trigger the retry/fallback ladder, not a bare exit.
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+            failures.append(f'e2e attempt {attempt + 1}: {e!r}')
+            print(f'# bench e2e attempt {attempt + 1} failed: {e!r}',
+                  file=sys.stderr)
+            tail = getattr(e, 'log_tail', '')
+            if tail:
+                print(tail, file=sys.stderr)
+            if attempt == 0:
+                time.sleep(15)
+    print('# falling back to --direct (subprocess trainer)',
+          file=sys.stderr)
+    try:
+        run_direct_subprocess(args.steps)
+        return
+    except BaseException as e:  # noqa: BLE001
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            raise
+        failures.append(f'direct fallback: {e!r}')
+        print(f'# bench --direct fallback failed: {e!r}',
+              file=sys.stderr)
+    print(json.dumps({'metric': 'bench-e2e', 'value': 0,
+                      'unit': 'error', 'vs_baseline': 0,
+                      'error': ' | '.join(failures)[:900]}))
+    sys.exit(1)
 
 
 if __name__ == '__main__':

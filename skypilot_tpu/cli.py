@@ -81,10 +81,15 @@ _RESOURCE_OPTIONS = [
     click.option('--image-id', 'image_id', default=None),
     click.option('--num-nodes', 'num_nodes', type=int, default=None),
     click.option('--workdir', default=None),
-    click.option('--name', '-n', default=None),
     click.option('--env', multiple=True,
                  help='Env override KEY=VALUE (repeatable).'),
 ]
+
+# Task-name override is separate from _RESOURCE_OPTIONS: commands that
+# already bind `-n` to something else (jobs launch, serve up) must not
+# re-declare it — click warns on duplicate parameter declarations.
+_TASK_NAME_OPTION = click.option('--name', '-n', default=None,
+                                 help='Task name override.')
 
 
 def _add_options(options):
@@ -116,6 +121,7 @@ def cli() -> None:
 @click.option('--docker', 'use_docker', is_flag=True, default=False,
               help='Run in a local docker container instead of a cloud '
                    'cluster (reference local_docker_backend).')
+@_TASK_NAME_OPTION
 @_add_options(_RESOURCE_OPTIONS)
 def launch(entrypoint, cluster, dryrun, detach_run,
            idle_minutes_to_autostop, down, retry_until_up, yes,
@@ -148,6 +154,7 @@ def launch(entrypoint, cluster, dryrun, detach_run,
 @click.argument('cluster', required=True)
 @click.argument('entrypoint', nargs=-1, required=True)
 @click.option('--detach-run', '-d', is_flag=True, default=False)
+@_TASK_NAME_OPTION
 @_add_options(_RESOURCE_OPTIONS)
 def exec_cmd(cluster, entrypoint, detach_run, **overrides) -> None:
     """Fast-resubmit a task to a live cluster (no provision/setup)."""
@@ -468,8 +475,7 @@ def jobs() -> None:
 @click.option('--remote-controller', '-r', is_flag=True, default=False,
               help='Run the recovery controller on a self-hosted '
                    'controller cluster (survives this client exiting).')
-@_add_options([o for o in _RESOURCE_OPTIONS
-               if 'name' not in getattr(o, 'name', '')])
+@_add_options(_RESOURCE_OPTIONS)
 def jobs_launch(entrypoint, name, detach_run, remote_controller,
                 **overrides) -> None:
     """Submit a managed job (auto-recovered on preemption)."""
@@ -646,6 +652,7 @@ def serve_status(service_names, remote_controller) -> None:
 @click.argument('service_name', required=True)
 @click.argument('entrypoint', nargs=-1, required=True)
 @click.option('--remote-controller', is_flag=True, default=False)
+@_TASK_NAME_OPTION
 @_add_options(_RESOURCE_OPTIONS)
 def serve_update(service_name, entrypoint, remote_controller,
                  **overrides) -> None:

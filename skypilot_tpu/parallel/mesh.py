@@ -65,6 +65,111 @@ class MeshConfig:
         return sizes
 
 
+class BackendInitHang(RuntimeError):
+    """Backend init neither returned nor raised within the timeout.
+
+    Distinct from a clean init failure: the hung (daemon) thread still
+    holds jax's backend-init lock, so any further device touch in THIS
+    process would deadlock — callers must fail over to a new process,
+    not retry here.
+    """
+
+
+def _touch_devices(timeout_s: float) -> Sequence[jax.Device]:
+    """`jax.devices()` that raises instead of hanging.
+
+    Tunneled TPU backends have been observed to block indefinitely
+    inside PJRT client creation (round-2 postmortem: a bare
+    jax.devices() hung during judging).  The touch runs on a daemon
+    thread; on timeout the thread is abandoned and BackendInitHang
+    raised so the process can exit cleanly.
+    """
+    if timeout_s <= 0:
+        return jax.devices()
+    import threading
+    box: Dict[str, object] = {}
+
+    def _run() -> None:
+        try:
+            box['devices'] = jax.devices()
+        except BaseException as e:  # noqa: BLE001 — reraised below
+            box['error'] = e
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name='skytpu-backend-init')
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise BackendInitHang(
+            f'backend init did not return within {timeout_s:.0f}s '
+            '(tunneled TPU hang); retry in a fresh process')
+    if 'error' in box:
+        raise box['error']  # type: ignore[misc]
+    return box['devices']  # type: ignore[return-value]
+
+
+def _devices_with_retry() -> Sequence[jax.Device]:
+    """`jax.devices()` with bounded retry-with-backoff and a hang
+    watchdog.
+
+    Tunneled/shared TPU backends can transiently refuse the first
+    client connection ("Unable to initialize backend ...: UNAVAILABLE")
+    — a flake class, not a config error.  JAX caches a failed platform
+    init, so each retry must clear the backend cache before touching
+    the device list again.  A HANG (vs a clean failure) aborts
+    immediately: the abandoned thread holds jax's backend lock and an
+    in-process retry would deadlock.  Tunables:
+    SKYTPU_BACKEND_INIT_RETRIES (default 3 extra attempts),
+    SKYTPU_BACKEND_INIT_BACKOFF_S (default 5, doubled per attempt),
+    SKYTPU_BACKEND_INIT_TIMEOUT_S (default 180; 0 disables watchdog).
+    """
+    import os
+    import time
+
+    retries = int(os.environ.get('SKYTPU_BACKEND_INIT_RETRIES', '3'))
+    backoff = float(os.environ.get('SKYTPU_BACKEND_INIT_BACKOFF_S', '5'))
+    timeout_s = float(os.environ.get('SKYTPU_BACKEND_INIT_TIMEOUT_S',
+                                     '180'))
+    last_exc: Optional[Exception] = None
+    for attempt in range(retries + 1):
+        if attempt:
+            logger.warning(
+                f'TPU backend init failed ({last_exc}); retrying in '
+                f'{backoff:.0f}s (attempt {attempt}/{retries}).')
+            time.sleep(backoff)
+            backoff *= 2
+            _clear_backends_best_effort()
+        try:
+            return _touch_devices(timeout_s)
+        except BackendInitHang:
+            raise
+        except RuntimeError as e:  # jax wraps init failures in this
+            last_exc = e
+    raise RuntimeError(
+        f'TPU backend unavailable after {retries + 1} attempts: '
+        f'{last_exc}') from last_exc
+
+
+# Public name — bench.py and the trainer route their first backend
+# touch through this.
+devices_with_retry = _devices_with_retry
+
+
+def _clear_backends_best_effort() -> None:
+    """Drop jax's cached (failed) backend init so a retry re-attempts."""
+    for clear in ('jax.extend.backend.clear_backends',
+                  'jax._src.api.clear_backends',
+                  'jax._src.xla_bridge._clear_backends'):
+        mod_name, _, fn_name = clear.rpartition('.')
+        try:
+            import importlib
+            fn = getattr(importlib.import_module(mod_name), fn_name)
+            fn()
+            return
+        except Exception:  # noqa: BLE001 — version-dependent API
+            continue
+
+
 def _detect_num_slices() -> int:
     """Multislice degree from the gang driver's MEGASCALE contract."""
     import os
@@ -130,7 +235,7 @@ def make_mesh(config: Optional[MeshConfig] = None,
     ICI inside each slice — the scaling-book placement rule.
     """
     if devices is None:
-        devices = jax.devices()
+        devices = _devices_with_retry()
     config = config or MeshConfig()
     detected = False
     if num_slices is None:

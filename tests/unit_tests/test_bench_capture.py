@@ -1,0 +1,141 @@
+"""The benchmark capture must be unkillable (round-2 postmortem).
+
+A failed e2e benchmark run must never exit 0 without a metric line:
+bench.main() retries the e2e once, falls back to --direct, and exits
+non-zero (with a single error-JSON line) only when every rung failed.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_BENCH_PATH = os.path.join(
+    os.path.dirname(__file__), '..', '..', 'bench.py')
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location('bench', _BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod.time, 'sleep', lambda _s: None)
+    monkeypatch.setattr(sys, 'argv', ['bench.py'])
+    return mod
+
+
+def test_e2e_failure_retries_then_falls_back_to_direct(bench,
+                                                       monkeypatch,
+                                                       capsys):
+    calls = {'e2e': 0, 'direct': 0}
+
+    def _e2e(_steps):
+        calls['e2e'] += 1
+        raise bench.BenchError('job FAILED', log_tail='boom')
+
+    def _direct(_steps):
+        calls['direct'] += 1
+        print(json.dumps({'metric': 'm', 'value': 1, 'unit': 'u',
+                          'vs_baseline': 1}))
+
+    monkeypatch.setattr(bench, 'run_through_launch', _e2e)
+    monkeypatch.setattr(bench, 'run_direct_subprocess', _direct)
+    bench.main()  # must NOT raise SystemExit — a metric was produced
+    assert calls == {'e2e': 2, 'direct': 1}
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1  # exactly ONE json line on stdout
+    assert json.loads(out[0])['value'] == 1
+
+
+def test_all_rungs_failing_exits_nonzero_with_error_json(bench,
+                                                         monkeypatch,
+                                                         capsys):
+    monkeypatch.setattr(
+        bench, 'run_through_launch',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+    monkeypatch.setattr(
+        bench, 'run_direct_subprocess',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('direct')))
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    parsed = json.loads(out[0])
+    assert parsed['unit'] == 'error'
+    assert 'backend' in parsed['error'] and 'direct' in parsed['error']
+
+
+def test_e2e_success_never_touches_direct(bench, monkeypatch, capsys):
+    calls = {'direct': 0}
+    monkeypatch.setattr(
+        bench, 'run_through_launch',
+        lambda _s: print(json.dumps({'metric': 'm', 'value': 2,
+                                     'unit': 'u', 'vs_baseline': 1})))
+    monkeypatch.setattr(
+        bench, 'run_direct_subprocess',
+        lambda _s: calls.__setitem__('direct', 1))
+    bench.main()
+    assert calls['direct'] == 0
+    assert json.loads(capsys.readouterr().out.strip())['value'] == 2
+
+
+def test_backend_init_retry_clears_and_retries(monkeypatch):
+    """mesh._devices_with_retry retries a transient UNAVAILABLE."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+
+    attempts = {'n': 0}
+
+    def _flaky_devices():
+        attempts['n'] += 1
+        if attempts['n'] < 3:
+            raise RuntimeError(
+                "Unable to initialize backend 'axon': UNAVAILABLE")
+        return ['dev0']
+
+    monkeypatch.setenv('SKYTPU_BACKEND_INIT_BACKOFF_S', '0')
+    monkeypatch.setattr(mesh_lib.jax, 'devices', _flaky_devices)
+    assert mesh_lib._devices_with_retry() == ['dev0']
+    assert attempts['n'] == 3
+
+
+def test_backend_init_retry_gives_up(monkeypatch):
+    from skypilot_tpu.parallel import mesh as mesh_lib
+
+    monkeypatch.setenv('SKYTPU_BACKEND_INIT_BACKOFF_S', '0')
+    monkeypatch.setenv('SKYTPU_BACKEND_INIT_RETRIES', '1')
+    monkeypatch.setattr(
+        mesh_lib.jax, 'devices',
+        lambda: (_ for _ in ()).throw(RuntimeError('UNAVAILABLE')))
+    with pytest.raises(RuntimeError, match='after 2 attempts'):
+        mesh_lib._devices_with_retry()
+
+
+def test_backend_init_hang_raises_not_blocks(monkeypatch):
+    """A wedged backend init (the round-2 failure mode: jax.devices()
+    blocks forever inside PJRT client creation) must surface as a
+    prompt BackendInitHang, never a hang — and must NOT be retried
+    in-process (the abandoned thread holds jax's backend lock)."""
+    import threading
+    import time as time_mod
+
+    from skypilot_tpu.parallel import mesh as mesh_lib
+
+    release = threading.Event()
+    attempts = {'n': 0}
+
+    def _wedged_devices():
+        attempts['n'] += 1
+        release.wait(30)  # simulates the indefinite PJRT hang
+        return []
+
+    monkeypatch.setenv('SKYTPU_BACKEND_INIT_TIMEOUT_S', '0.2')
+    monkeypatch.setenv('SKYTPU_BACKEND_INIT_BACKOFF_S', '0')
+    monkeypatch.setattr(mesh_lib.jax, 'devices', _wedged_devices)
+    t0 = time_mod.time()
+    with pytest.raises(mesh_lib.BackendInitHang, match='fresh process'):
+        mesh_lib.devices_with_retry()
+    assert time_mod.time() - t0 < 5  # prompt, not a 30s block
+    assert attempts['n'] == 1  # no in-process retry after a hang
+    release.set()

@@ -6,6 +6,11 @@ from click.testing import CliRunner
 
 from skypilot_tpu import cli as cli_mod
 
+# Duplicate click option declarations (e.g. `--name`/`-n` applied both
+# explicitly and via _RESOURCE_OPTIONS) surface as UserWarnings — treat
+# them as failures so the surface stays warning-clean.
+pytestmark = pytest.mark.filterwarnings('error::UserWarning')
+
 
 @pytest.fixture()
 def runner():
