@@ -1,0 +1,171 @@
+"""Serving throughput benchmark: tokens/s under concurrent streams
+THROUGH the load balancer.
+
+The reference's serving-throughput story is vLLM's continuous batching
+(README.md:54 "24x higher throughput", llm/qwen/serve-110b.yaml); this
+bench measures the native stack end-to-end: client streams -> SkyServe
+load balancer -> InferenceServer (continuous slot-based decode by
+default, `--no-continuous` for the request-level baseline).
+
+For each concurrency level C: C worker threads each send
+`--requests-per-stream` sequential /generate requests; throughput =
+total generated tokens / wall-clock.  Prints one JSON line per level:
+
+    {"metric": "serving tokens/s @c8", "value": ..., "unit": "tok/s",
+     "concurrency": 8, "requests": 32, "p50_latency_s": ...,
+     "continuous": true}
+
+Run (CPU smoke): python -m skypilot_tpu.benchmark.serving \
+    --concurrency 1,8 --requests-per-stream 2 --max-new-tokens 8
+"""
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import statistics
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_TINY_OVERRIDES = {'n_heads': 4, 'n_kv_heads': 2, 'n_layers': 2,
+                   'dim': 64, 'ffn_dim': 128, 'vocab_size': 256,
+                   'max_seq_len': 256}
+
+
+def _start_replica(model: str, slots: int, continuous: bool,
+                   max_seq_len: Optional[int],
+                   overrides: Optional[Dict[str, Any]]):
+    from skypilot_tpu.infer import server as server_lib
+    srv = server_lib.InferenceServer(
+        model=model, port=0, host='127.0.0.1', max_batch_size=slots,
+        max_seq_len=max_seq_len, model_overrides=overrides,
+        continuous=continuous)
+    srv.start()
+    threading.Thread(target=srv._server.serve_forever,  # pylint: disable=protected-access
+                     daemon=True).start()
+    return srv
+
+
+def _start_lb(replica_url: str):
+    """LB with the replica injected directly (no controller process —
+    the proxy path is what we are measuring)."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1', port=0, sync_interval_seconds=3600)
+    lb._server = lb_lib.LBHTTPServer(  # pylint: disable=protected-access
+        ('127.0.0.1', 0), lb._make_handler())  # pylint: disable=protected-access
+    threading.Thread(
+        target=lb._server.serve_forever,  # pylint: disable=protected-access
+        daemon=True).start()
+    lb.policy.set_ready_replicas([replica_url])
+    return lb, f'http://127.0.0.1:{lb._server.server_address[1]}'  # pylint: disable=protected-access
+
+
+def _one_request(base_url: str, prompt: List[int],
+                 max_new_tokens: int) -> int:
+    req = urllib.request.Request(
+        base_url + '/generate',
+        data=json.dumps({'prompt_ids': [prompt],
+                         'max_new_tokens': max_new_tokens}).encode(),
+        headers={'Content-Type': 'application/json'})
+    with urllib.request.urlopen(req, timeout=600) as r:
+        return len(json.load(r)['tokens'][0])
+
+
+def run_level(base_url: str, concurrency: int, requests_per_stream: int,
+              prompt_len: int, max_new_tokens: int, vocab: int,
+              continuous: bool) -> dict:
+    latencies: List[float] = []
+    tokens = [0] * concurrency
+    lock = threading.Lock()
+
+    def _stream(idx: int) -> None:
+        # Distinct deterministic prompts per stream (no RNG: content
+        # doesn't matter, shape does).
+        for r in range(requests_per_stream):
+            prompt = [(idx * 131 + r * 17 + j) % vocab
+                      for j in range(prompt_len)]
+            t0 = time.time()
+            n = _one_request(base_url, prompt, max_new_tokens)
+            dt = time.time() - t0
+            with lock:
+                tokens[idx] += n
+                latencies.append(dt)
+
+    threads = [threading.Thread(target=_stream, args=(i,))
+               for i in range(concurrency)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    total = sum(tokens)
+    return {
+        'metric': f'serving tokens/s @c{concurrency}',
+        'value': round(total / wall, 2),
+        'unit': 'tok/s',
+        'concurrency': concurrency,
+        'requests': concurrency * requests_per_stream,
+        'total_tokens': total,
+        'wall_s': round(wall, 2),
+        'p50_latency_s': round(statistics.median(latencies), 3),
+        'continuous': continuous,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='llama-tiny')
+    parser.add_argument('--model-overrides', default=None,
+                        help='JSON dict; default: tiny CPU-able config')
+    parser.add_argument('--concurrency', default='1,8,32',
+                        help='comma-separated stream counts')
+    parser.add_argument('--requests-per-stream', type=int, default=4)
+    parser.add_argument('--prompt-len', type=int, default=16)
+    parser.add_argument('--max-new-tokens', type=int, default=32)
+    parser.add_argument('--slots', type=int, default=8)
+    parser.add_argument('--max-seq-len', type=int, default=None)
+    parser.add_argument('--no-continuous', dest='continuous',
+                        action='store_false', default=True)
+    parser.add_argument('--platform', default=None,
+                        help="Force a jax platform (e.g. 'cpu' for the "
+                             'smoke run; env JAX_PLATFORMS alone is '
+                             'not enough on tunneled-TPU hosts).')
+    args = parser.parse_args()
+    overrides = (json.loads(args.model_overrides)
+                 if args.model_overrides else dict(_TINY_OVERRIDES))
+
+    if args.platform:
+        import jax
+        jax.config.update('jax_platforms', args.platform)
+    # Hang-proof first backend touch (tunneled TPU backends can wedge
+    # inside PJRT init — see parallel/mesh.devices_with_retry).
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    mesh_lib.devices_with_retry()
+
+    srv = _start_replica(args.model, args.slots, args.continuous,
+                         args.max_seq_len, overrides)
+    lb, lb_url = _start_lb(f'http://127.0.0.1:{srv.port}')
+    try:
+        # Warm every concurrency level's compile paths once.
+        _one_request(lb_url, [1, 2, 3], 4)
+        for level in [int(c) for c in args.concurrency.split(',')]:
+            result = run_level(
+                lb_url, level, args.requests_per_stream,
+                args.prompt_len, args.max_new_tokens,
+                srv.engine.config.vocab_size, args.continuous)
+            print(json.dumps(result), flush=True)
+    finally:
+        lb.stop()
+        srv.shutdown()
+
+
+if __name__ == '__main__':
+    main()

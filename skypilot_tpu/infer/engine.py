@@ -53,22 +53,36 @@ class SamplingConfig:
 
 def sample_logits(logits: jax.Array, rng: jax.Array,
                   config: SamplingConfig) -> jax.Array:
-    """Sample token ids [B] from logits [B, V]."""
-    if config.temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / config.temperature
-    if config.top_k > 0:
-        kth = jax.lax.top_k(logits, config.top_k)[0][:, -1:]
-        logits = jnp.where(logits < kth, -1e30, logits)
-    if config.top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    """Sample token ids [B] from logits [B, V] (one shared config —
+    delegates to the batched per-row-temperature kernel)."""
+    temps = jnp.full((logits.shape[0],), config.temperature,
+                     jnp.float32)
+    return sample_logits_batched(logits, rng, temps, config.top_k,
+                                 config.top_p)
+
+
+def sample_logits_batched(logits: jax.Array, rng: jax.Array,
+                          temps: jax.Array, top_k: int,
+                          top_p: float) -> jax.Array:
+    """Per-row-temperature sampling [B, V] -> [B]: rows with temp<=0
+    decode greedily, the rest sample — one jit for a continuous batch
+    whose slots carry different requests' sampling configs."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe = jnp.where(temps > 0, temps, 1.0)[:, None]
+    scaled = logits / safe
+    if top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        # Smallest set of tokens whose mass exceeds top_p.
-        cutoff_idx = jnp.sum(cum < config.top_p, axis=-1, keepdims=True)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-        logits = jnp.where(logits < cutoff, -1e30, logits)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+        scaled = jnp.where(scaled < cutoff, -1e30, scaled)
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(
+        jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
 
 
 def _cache_sharding(mesh, leaf) -> NamedSharding:
@@ -81,6 +95,355 @@ def _cache_sharding(mesh, leaf) -> NamedSharding:
     if leaf.ndim == 5 and leaf.shape[2] % max(tensor, 1) == 0:
         return NamedSharding(mesh, P(None, None, 'tensor', None, None))
     return NamedSharding(mesh, P())
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host-side state of one occupied decode slot."""
+    request_id: int
+    prompt_len: int           # true prompt length (rope base)
+    pad_len: int              # bucketed prefill length (cache cursor base)
+    max_new: int
+    eos_id: Optional[int]
+    temperature: float
+    top_k: int
+    top_p: float
+    generated: int = 0
+    outputs: List[int] = dataclasses.field(default_factory=list)
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over the KV-cache model.
+
+    The serving-throughput design the reference gets from vLLM
+    (`llm/qwen/serve-110b.yaml`, README.md:54) rebuilt TPU-first:
+
+      - a fixed [n_slots, max_seq_len] KV cache lives across requests;
+        every decode step advances ALL occupied slots at once (one
+        jitted step, static shapes — no per-request batch formation);
+      - new prompts are admitted into free slots BETWEEN decode steps:
+        a batch-1 jitted prefill computes the prompt's KV, then a
+        jitted insert writes it into the slot's cache row (prefill
+        interleaving — decode of live requests is never blocked for
+        the whole prefill of a newcomer at the batch level);
+      - per-row cache cursors: each slot writes its next token's K/V at
+        its own depth (models/llama.py run_cached_attention slot mode —
+        the write position is the row's highest revealed kv_mask slot);
+      - slots are evicted on EOS / budget and immediately reusable;
+      - per-slot temperature rides the jit as a vector (greedy and
+        sampled requests share a step); top_k/top_p are compile keys,
+        so a decode batch is always HOMOGENEOUS in (top_k, top_p):
+        requests with other values queue until the current group
+        drains (one compile per distinct pair, bounded in practice).
+
+    Thread model: `submit()`/`cancel()` are thread-safe; `step()` must
+    be driven by ONE thread (the server runs it in a dedicated decode
+    loop).
+    """
+
+    def __init__(self, model: str = 'llama-tiny',
+                 mesh=None,
+                 params: Any = None,
+                 checkpoint_dir: Optional[str] = None,
+                 n_slots: int = 4,
+                 max_seq_len: Optional[int] = None,
+                 model_overrides: Optional[Dict[str, Any]] = None,
+                 param_dtype: Any = jnp.bfloat16,
+                 prefill_bucket: int = 64,
+                 seed: int = 0) -> None:
+        import collections
+        import threading
+
+        # Model build, param load/sharding, and the [n_slots, ...]
+        # cache scaffolding are identical to the request-level engine.
+        self._eng = InferenceEngine(
+            model=model, mesh=mesh, params=params,
+            checkpoint_dir=checkpoint_dir, max_batch_size=n_slots,
+            max_seq_len=max_seq_len, model_overrides=model_overrides,
+            param_dtype=param_dtype, prefill_bucket=prefill_bucket,
+            seed=seed)
+        self.model = self._eng.model
+        self.config = self._eng.config
+        self.mesh = mesh
+        self.n_slots = n_slots
+        self.max_seq_len = self._eng.max_seq_len
+
+        # Batch-1 prefill cache template.
+        rng = jax.random.PRNGKey(seed)
+        abstract1 = jax.eval_shape(
+            lambda: self.model.init(rng, jnp.zeros((1, 1), jnp.int32)))
+        self._abstract_cache1 = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            sharding_lib.unbox(abstract1['cache']))
+        if mesh is not None:
+            self._cache1_shardings = jax.tree.map(
+                functools.partial(_cache_sharding, mesh),
+                self._abstract_cache1)
+        else:
+            self._cache1_shardings = None
+
+        def _forward(p, cache, tokens, positions, kv_mask):
+            logits, mutated = self.model.apply(
+                {'params': p, 'cache': cache}, tokens, positions,
+                kv_mask, mutable=['cache'])
+            return logits, mutated['cache']
+
+        self._prefill1 = jax.jit(_forward, donate_argnums=(1,))
+
+        def _insert(cache, last, kv_mask, cache1, last_row, mask_row,
+                    slot):
+            """Write a freshly prefilled request into slot `slot`:
+            cache rows, last-logits row, kv_mask row.  `slot` is a
+            traced scalar — one compile covers every slot."""
+            def _ins(big, small):
+                if big.ndim == 4:      # [B, kvh, S, hd]
+                    return jax.lax.dynamic_update_slice(
+                        big, small, (slot, 0, 0, 0))
+                if big.ndim == 5:      # scanned: [L, B, kvh, S, hd]
+                    return jax.lax.dynamic_update_slice(
+                        big, small, (0, slot, 0, 0, 0))
+                return big             # cursor scalars: unused in slot mode
+            cache = jax.tree.map(_ins, cache, cache1)
+            last = jax.lax.dynamic_update_slice(
+                last, last_row[None], (slot, 0))
+            kv_mask = jax.lax.dynamic_update_slice(
+                kv_mask, mask_row[None], (slot, 0))
+            return cache, last, kv_mask
+
+        self._insert = jax.jit(_insert, donate_argnums=(0, 1, 2))
+
+        def _decode_step(p, cache, last, kv_mask, rope_pos, cursors,
+                         rng, stepno, active, temps,
+                         top_k: int, top_p: float):
+            """Fused: sample every slot's next token from `last`,
+            reveal each ACTIVE slot's write position, one-token
+            forward for all slots."""
+            step_rng = jax.random.fold_in(rng, stepno)
+            tok = sample_logits_batched(last, step_rng, temps, top_k,
+                                        top_p)
+            brange = jnp.arange(tok.shape[0])
+            reveal = kv_mask[brange, cursors] | active
+            kv_mask = kv_mask.at[brange, cursors].set(reveal)
+            logits, cache = _forward(p, cache, tok[:, None],
+                                     rope_pos[:, None], kv_mask)
+            return tok, logits[:, 0], cache, kv_mask
+
+        self._decode = jax.jit(
+            _decode_step, static_argnames=('top_k', 'top_p'),
+            donate_argnums=(1, 3))
+
+        self._cache = self._eng._fresh_cache()
+        self._last = jnp.zeros((n_slots, self.config.vocab_size),
+                               jnp.float32)
+        self._kv_mask = jnp.zeros((n_slots, self.max_seq_len), bool)
+        self._slots: List[Optional[_Slot]] = [None] * n_slots
+        self._queue = collections.deque()
+        self._results: Dict[int, List[int]] = {}
+        self._events: Dict[int, threading.Event] = {}
+        self._canceled: set = set()
+        self._submit_lock = threading.Lock()
+        self._next_rid = 0
+        self._stepno = 0
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+    @property
+    def params(self):
+        return self._eng.params
+
+    # -- request intake ----------------------------------------------------
+    def submit(self, prompt_ids: Sequence[int],
+               sampling: Optional[SamplingConfig] = None) -> int:
+        """Enqueue one prompt; returns a request id for wait()."""
+        import threading
+        cfg = sampling or SamplingConfig()
+        if len(prompt_ids) == 0:
+            raise ValueError('empty prompt')
+        if len(prompt_ids) + cfg.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f'prompt ({len(prompt_ids)}) + max_new_tokens '
+                f'({cfg.max_new_tokens}) exceeds max_seq_len '
+                f'{self.max_seq_len}.')
+        with self._submit_lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            self._events[rid] = threading.Event()
+            self._queue.append((rid, list(prompt_ids), cfg))
+        return rid
+
+    def cancel(self, request_id: int) -> None:
+        """Drop a request wherever it is (queued, decoding, or done but
+        unread) and release its bookkeeping — abandoned requests must
+        not leak results/events in a long-running replica."""
+        with self._submit_lock:
+            self._queue = type(self._queue)(
+                item for item in self._queue if item[0] != request_id)
+            self._results.pop(request_id, None)
+            self._events.pop(request_id, None)
+            if any(s is not None and s.request_id == request_id
+                   for s in self._slots):
+                # step() evicts it at the next tick.
+                self._canceled.add(request_id)
+
+    def wait(self, request_id: int,
+             timeout: Optional[float] = None) -> List[int]:
+        """Block until `request_id` finishes; returns its token ids.
+        On timeout the request is CANCELED (not left orphaned) and
+        TimeoutError raised."""
+        event = self._events[request_id]
+        if not event.wait(timeout):
+            self.cancel(request_id)
+            raise TimeoutError(f'request {request_id} not done')
+        with self._submit_lock:
+            del self._events[request_id]
+            return self._results.pop(request_id)
+
+    # -- the decode loop ---------------------------------------------------
+    def _admit(self, slot_idx: int, rid: int, prompt: List[int],
+               cfg: SamplingConfig) -> None:
+        true_len = len(prompt)
+        pad = max(self._eng._bucketed(true_len), true_len)
+        pad = min(pad, self.max_seq_len - cfg.max_new_tokens)
+        pad = max(pad, true_len)
+        tokens = np.zeros((1, pad), np.int32)
+        tokens[0, :true_len] = prompt
+        positions = jnp.arange(pad, dtype=jnp.int32)[None]
+        mask_row = np.zeros((self.max_seq_len,), bool)
+        mask_row[:true_len] = True
+        kv_mask1 = jnp.asarray(mask_row)[None]
+
+        def _zeros(leaf, sharding=None):
+            if sharding is not None:
+                return jnp.zeros(leaf.shape, leaf.dtype, device=sharding)
+            return jnp.zeros(leaf.shape, leaf.dtype)
+        if self._cache1_shardings is None:
+            cache1 = jax.tree.map(_zeros, self._abstract_cache1)
+        else:
+            cache1 = jax.tree.map(_zeros, self._abstract_cache1,
+                                  self._cache1_shardings)
+        from skypilot_tpu.models import llama
+        with llama.slot_mode():
+            logits, cache1 = self._prefill1(
+                self.params, cache1, jnp.asarray(tokens), positions,
+                kv_mask1)
+        last_row = logits[0, true_len - 1]
+        self._cache, self._last, self._kv_mask = self._insert(
+            self._cache, self._last, self._kv_mask, cache1, last_row,
+            jnp.asarray(mask_row), jnp.int32(slot_idx))
+        self._slots[slot_idx] = _Slot(
+            request_id=rid, prompt_len=true_len, pad_len=pad,
+            max_new=cfg.max_new_tokens, eos_id=cfg.eos_id,
+            temperature=cfg.temperature, top_k=cfg.top_k,
+            top_p=cfg.top_p)
+
+    def _complete(self, slot_idx: int) -> None:
+        slot = self._slots[slot_idx]
+        assert slot is not None
+        with self._submit_lock:
+            if slot.request_id in self._canceled:
+                self._canceled.discard(slot.request_id)
+                event = None
+            else:
+                self._results[slot.request_id] = slot.outputs
+                event = self._events.get(slot.request_id)
+        if event is not None:
+            event.set()
+        self._slots[slot_idx] = None
+
+    def step(self) -> bool:
+        """One scheduler tick: admit pending prompts into free slots,
+        then one decode step for all occupied slots.  Returns False
+        when fully idle (nothing queued, nothing occupied)."""
+        ctx = self.mesh if self.mesh is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            return self._step_inner()
+
+    def _evict_canceled(self) -> None:
+        for i, s in enumerate(self._slots):
+            if s is not None and s.request_id in self._canceled:
+                with self._submit_lock:
+                    self._canceled.discard(s.request_id)
+                self._slots[i] = None
+
+    def _step_inner(self) -> bool:
+        from skypilot_tpu.models import llama
+
+        self._evict_canceled()
+        # (top_k, top_p) are compile keys of the decode step, so the
+        # batch must stay homogeneous in them: admit only queued
+        # requests matching the live group; when the batch is empty
+        # the group resets to the queue head's pair (so no request
+        # starves — each group drains in FIFO turns).
+        group = next(((s.top_k, s.top_p) for s in self._slots
+                      if s is not None), None)
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        while free:
+            with self._submit_lock:
+                item = None
+                if self._queue:
+                    if group is None:
+                        item = self._queue.popleft()
+                        group = (item[2].top_k, item[2].top_p)
+                    else:
+                        for j, cand in enumerate(self._queue):
+                            if (cand[2].top_k, cand[2].top_p) == group:
+                                del self._queue[j]
+                                item = cand
+                                break
+            if item is None:
+                break
+            self._admit(free.pop(0), *item)
+        occupied = [i for i, s in enumerate(self._slots)
+                    if s is not None]
+        if not occupied:
+            return False
+
+        b = self.n_slots
+        cursors = np.zeros((b,), np.int32)
+        rope = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        temps = np.zeros((b,), np.float32)
+        for i in occupied:
+            s = self._slots[i]
+            cursors[i] = s.pad_len + s.generated
+            rope[i] = s.prompt_len + s.generated
+            active[i] = True
+            temps[i] = s.temperature
+        with llama.slot_mode():
+            tok_dev, self._last, self._cache, self._kv_mask = \
+                self._decode(
+                    self.params, self._cache, self._last, self._kv_mask,
+                    jnp.asarray(rope), jnp.asarray(cursors), self._rng,
+                    jnp.int32(self._stepno), jnp.asarray(active),
+                    jnp.asarray(temps), top_k=group[0], top_p=group[1])
+        self._stepno += 1
+        toks = np.asarray(jax.device_get(tok_dev))
+        for i in occupied:
+            s = self._slots[i]
+            s.outputs.append(int(toks[i]))
+            s.generated += 1
+            if (s.eos_id is not None and int(toks[i]) == s.eos_id) or \
+                    s.generated >= s.max_new:
+                self._complete(i)
+        return True
+
+    def run_until_idle(self) -> None:
+        while self.step():
+            pass
+
+    # -- convenience (request-level API parity) ---------------------------
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 sampling: Optional[SamplingConfig] = None
+                 ) -> List[List[int]]:
+        """Submit `prompts` (any count — more than n_slots queues) and
+        drive the loop until all finish."""
+        rids = [self.submit(p, sampling) for p in prompts]
+        pending = set(rids)
+        while pending:
+            if not self.step():
+                break
+            pending = {r for r in rids if not self._events[r].is_set()}
+        return [self.wait(r, timeout=0.001) for r in rids]
 
 
 class InferenceEngine:
@@ -220,8 +583,14 @@ class InferenceEngine:
                                                   sharding=s),
                 abstract, shardings)
         else:
+            # Mesh-less serving still passes an explicit sharding:
+            # without one Orbax falls back to the checkpoint's sharding
+            # file — unsafe when restoring on a different topology than
+            # saved (the managed-jobs recovery shape) and noisy.
+            single = jax.sharding.SingleDeviceSharding(jax.devices()[0])
             abs_tree = jax.tree.map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=single),
                 abstract)
         try:
             restored = ckpt_lib.load_params_for_serving(

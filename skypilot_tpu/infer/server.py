@@ -10,9 +10,12 @@ http.server, matching the rest of the serve stack (serve/controller.py):
        body: {"prompt_ids": [[...], ...], "max_new_tokens": N,
               "temperature": T, "top_k": K, "top_p": P, "eos_id": E}
 
-Requests are serialized through a lock: the engine owns the single
-TPU context, and decode batches are formed per request (request-level
-batching; continuous batching is a planned optimization).
+Default mode is CONTINUOUS BATCHING (engine.ContinuousBatchingEngine):
+a dedicated decode-loop thread drives slot-based decode; concurrent
+/generate requests are admitted into free KV-cache slots between decode
+steps and complete independently — the serving-throughput design the
+reference delegates to vLLM (README.md:54).  `--no-continuous` falls
+back to request-level batching serialized through a lock.
 
 Run: python -m skypilot_tpu.infer.server --model llama-tiny --port 8000
 """
@@ -30,6 +33,13 @@ from skypilot_tpu.infer import engine as engine_lib
 logger = sky_logging.init_logger(__name__)
 
 
+class _HTTPServer(http.server.ThreadingHTTPServer):
+    # Default listen backlog (5) drops connections under concurrent
+    # load (benchmark/serving.py at 32 streams saw 502s via the LB).
+    request_queue_size = 128
+    daemon_threads = True
+
+
 class InferenceServer:
 
     def __init__(self, model: str = 'llama-tiny', port: int = 8000,
@@ -37,7 +47,8 @@ class InferenceServer:
                  max_seq_len: Optional[int] = None,
                  checkpoint_dir: Optional[str] = None,
                  mesh_config: Optional[str] = None,
-                 model_overrides=None) -> None:
+                 model_overrides=None,
+                 continuous: bool = True) -> None:
         mesh = None
         if mesh_config:
             from skypilot_tpu.parallel import mesh as mesh_lib
@@ -47,13 +58,22 @@ class InferenceServer:
                     k, v = part.split('=')
                     kwargs[k] = int(v)
             mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(**kwargs))
-        self.engine = engine_lib.InferenceEngine(
-            model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
-            max_batch_size=max_batch_size,
-            max_seq_len=max_seq_len, model_overrides=model_overrides)
+        self.continuous = continuous
+        if continuous:
+            self.engine = engine_lib.ContinuousBatchingEngine(
+                model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
+                n_slots=max_batch_size,
+                max_seq_len=max_seq_len, model_overrides=model_overrides)
+        else:
+            self.engine = engine_lib.InferenceEngine(
+                model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
+                max_batch_size=max_batch_size,
+                max_seq_len=max_seq_len, model_overrides=model_overrides)
         # Warm the compile caches (smallest prefill bucket + decode) so
         # /health flips to ready only after the common-path compiles are
         # done.  Other prefill buckets still compile on first use.
+        # (Continuous engine: generate() drives step() inline — the
+        # decode-loop thread only starts in start().)
         self.engine.generate(
             [[1, 2, 3]],
             engine_lib.SamplingConfig(max_new_tokens=2))
@@ -61,6 +81,18 @@ class InferenceServer:
         self._port = port
         self._host = host
         self._server: Optional[http.server.ThreadingHTTPServer] = None
+        self._running = False
+        self._decode_thread: Optional[threading.Thread] = None
+        self._work = threading.Event()
+
+    def _decode_loop(self) -> None:
+        """Single driver of ContinuousBatchingEngine.step(): decodes
+        while any slot is occupied, sleeps on the work event when
+        idle.  Handler threads only submit()/wait()."""
+        while self._running:
+            if not self.engine.step():
+                self._work.wait(0.05)
+                self._work.clear()
 
     @property
     def port(self) -> int:
@@ -78,6 +110,21 @@ class InferenceServer:
             top_p=float(payload.get('top_p', 1.0)),
             eos_id=payload.get('eos_id'),
             max_new_tokens=int(payload.get('max_new_tokens', 64)))
+        if self.continuous:
+            # All-or-nothing: a rejected prompt (e.g. overlong) must
+            # not strand its siblings decoding with no reader.
+            rids = []
+            try:
+                for p in prompts:
+                    rids.append(self.engine.submit(p, sampling))
+                self._work.set()
+                tokens = [self.engine.wait(r, timeout=600)
+                          for r in rids]
+            except BaseException:
+                for r in rids:
+                    self.engine.cancel(r)
+                raise
+            return {'tokens': tokens}
         with self._lock:
             tokens = self.engine.generate(prompts, sampling)
         return {'tokens': tokens}
@@ -124,10 +171,20 @@ class InferenceServer:
                     logger.exception('generate failed')
                     self._reply(500, {'error': str(e)})
 
-        self._server = http.server.ThreadingHTTPServer(
-            (self._host, self._port), Handler)
+        self._server = _HTTPServer((self._host, self._port), Handler)
+        if self.continuous and self._decode_thread is None:
+            self._running = True
+            self._decode_thread = threading.Thread(
+                target=self._decode_loop, daemon=True,
+                name='skytpu-decode-loop')
+            self._decode_thread.start()
 
     def shutdown(self) -> None:
+        self._running = False
+        self._work.set()
+        if self._decode_thread is not None:
+            self._decode_thread.join(timeout=5)
+            self._decode_thread = None
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -145,12 +202,17 @@ def main() -> None:
                              '(bucket-mounted path)')
     parser.add_argument('--mesh', default=None,
                         help="shard over local devices, e.g. 'tensor=4'")
+    parser.add_argument('--no-continuous', dest='continuous',
+                        action='store_false', default=True,
+                        help='Request-level batching instead of '
+                             'continuous (slot-based) batching.')
     args = parser.parse_args()
     InferenceServer(model=args.model, port=args.port, host=args.host,
                     max_batch_size=args.max_batch_size,
                     max_seq_len=args.max_seq_len,
                     checkpoint_dir=args.checkpoint_dir,
-                    mesh_config=args.mesh).serve_forever()
+                    mesh_config=args.mesh,
+                    continuous=args.continuous).serve_forever()
 
 
 if __name__ == '__main__':

@@ -17,7 +17,9 @@ Design notes:
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import flax.linen as nn
@@ -219,6 +221,24 @@ def maybe_lora(cfg, name: str, x: jax.Array, y: jax.Array,
                            name=f'{name}_lora')(x)
 
 
+_SLOT_MODE = threading.local()
+
+
+@contextlib.contextmanager
+def slot_mode():
+    """Enable per-row cache cursors in run_cached_attention for calls
+    traced under this context (ContinuousBatchingEngine wraps its jit
+    CALLS in it — the flag is captured at trace time, so each engine's
+    compiled steps keep their mode forever).  The request-level engine
+    never enters it and keeps the global-cursor fast path."""
+    prev = getattr(_SLOT_MODE, 'on', False)
+    _SLOT_MODE.on = True
+    try:
+        yield
+    finally:
+        _SLOT_MODE.on = prev
+
+
 def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
                          v: jax.Array,
                          kv_mask: Optional[jax.Array], *,
@@ -243,22 +263,45 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
     cursor = module.variable('cache', 'cache_index',
                              lambda: jnp.zeros((), jnp.int32))
     idx = cursor.value
-    cached_k.value = jax.lax.dynamic_update_slice(
-        cached_k.value, k.astype(dtype), (0, 0, idx, 0))
-    cached_v.value = jax.lax.dynamic_update_slice(
-        cached_v.value, v.astype(dtype), (0, 0, idx, 0))
-    cursor.value = idx + s
+    if s == 1 and kv_mask is not None and getattr(_SLOT_MODE, 'on',
+                                                  False):
+        # Slot-mode decode (continuous batching): each row's write
+        # position is its highest *revealed* kv_mask slot — the engine
+        # reveals the new token's slot before this forward, so rows at
+        # different decode depths (different prompts admitted at
+        # different times) share one step.  Visibility is kv_mask
+        # alone; the global-cursor causal term would be wrong when
+        # rows disagree.  Rows whose mask is untouched this step
+        # (finished/empty slots) rewrite their last revealed slot with
+        # a dead token's K/V — harmless: their outputs are discarded
+        # and re-admission re-prefills the slot.
+        write_pos = jnp.max(
+            jnp.where(kv_mask, jnp.arange(max_len, dtype=jnp.int32), 0),
+            axis=-1)                               # [B]
+        brange = jnp.arange(b)
+        cached_k.value = cached_k.value.at[
+            brange, :, write_pos, :].set(k[:, :, 0, :].astype(dtype))
+        cached_v.value = cached_v.value.at[
+            brange, :, write_pos, :].set(v[:, :, 0, :].astype(dtype))
+        cursor.value = idx + 1
+        mask = kv_mask[:, None, None, :]
+    else:
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(dtype), (0, 0, idx, 0))
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(dtype), (0, 0, idx, 0))
+        cursor.value = idx + s
+        slots = jnp.arange(max_len)
+        causal = slots[None, :] <= (idx + jnp.arange(s))[:, None]
+        mask = causal[None, None]                  # [1,1,s,max]
+        if kv_mask is not None:
+            mask = mask & kv_mask[:, None, None, :]
     keys, values = cached_k.value, cached_v.value
     if kvh != h:
         keys = jnp.repeat(keys, h // kvh, axis=1)
         values = jnp.repeat(values, h // kvh, axis=1)
     scores = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
                         keys.astype(jnp.float32)) * (hd ** -0.5)
-    slots = jnp.arange(max_len)
-    causal = slots[None, :] <= (idx + jnp.arange(s))[:, None]
-    mask = causal[None, None]                      # [1,1,s,max]
-    if kv_mask is not None:
-        mask = mask & kv_mask[:, None, None, :]
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum('bhqk,bhkd->bhqd', probs.astype(dtype), values)

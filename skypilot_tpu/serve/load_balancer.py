@@ -35,6 +35,13 @@ _HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
 _PROBE_TIMEOUT_SECONDS = 3.0
 
 
+class LBHTTPServer(http.server.ThreadingHTTPServer):
+    """Listen backlog sized for concurrent streams (the stdlib default
+    of 5 drops connections — 502s at 32 concurrent clients)."""
+    request_queue_size = 128
+    daemon_threads = True
+
+
 def _probe(replica_url: str) -> bool:
     """TCP connect-probe a replica URL ('http://host:port')."""
     parsed = urllib.parse.urlparse(replica_url)
@@ -248,9 +255,8 @@ class SkyServeLoadBalancer:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
-        self._server = http.server.ThreadingHTTPServer(
+        self._server = LBHTTPServer(
             ('0.0.0.0', self.port), self._make_handler())
-        self._server.daemon_threads = True
         for target, name in ((self._server.serve_forever, 'http'),
                              (self._sync_loop, 'sync')):
             t = threading.Thread(target=target, daemon=True,

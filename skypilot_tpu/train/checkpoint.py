@@ -116,10 +116,16 @@ def restore(manager, state):
                 params=ocp.args.StandardRestore(_abstract(state.params)),
                 opt_state=ocp.args.StandardRestore(
                     _abstract(state.opt_state)),
+                # ArrayRestore's `item` is ignored for sharding; the
+                # explicit sharding must ride restore_args or Orbax
+                # falls back to the checkpoint's sharding FILE —
+                # unsafe when resuming on a different topology (the
+                # managed-jobs recovery shape).
                 step=ocp.args.ArrayRestore(
-                    jax.ShapeDtypeStruct(
-                        state.step.shape, state.step.dtype,
-                        sharding=state.step.sharding))))
+                    restore_args=ocp.type_handlers.ArrayRestoreArgs(
+                        sharding=state.step.sharding,
+                        global_shape=state.step.shape,
+                        dtype=state.step.dtype))))
     logger.info(f'Restored checkpoint step {latest}.')
     return state.replace(step=restored['step'],
                          params=restored['params'],
@@ -178,6 +184,18 @@ def restore_params_partial(manager, state):
     meta = manager.item_metadata(latest)['params']
     saved_meta = _flatten_metadata(meta)
     live = flax.traverse_util.flatten_dict(state.params)
+    # Saved params with no live counterpart restore replicated — but
+    # still with an EXPLICIT sharding, never the checkpoint's sharding
+    # file (wrong topology on recovery, and Orbax warns).
+    replicated = None
+    for lv in live.values():
+        s = getattr(lv, 'sharding', None)
+        if isinstance(s, jax.sharding.NamedSharding):
+            replicated = jax.sharding.NamedSharding(
+                s.mesh, jax.sharding.PartitionSpec())
+            break
+    if replicated is None:
+        replicated = jax.sharding.SingleDeviceSharding(jax.devices()[0])
     abstract = {}
     for key, m in saved_meta.items():
         lv = live.get(key)
@@ -185,8 +203,8 @@ def restore_params_partial(manager, state):
             abstract[key] = jax.ShapeDtypeStruct(
                 lv.shape, lv.dtype, sharding=lv.sharding)
         else:
-            # Saved param with no live counterpart (rare): replicated.
-            abstract[key] = jax.ShapeDtypeStruct(tuple(m.shape), m.dtype)
+            abstract[key] = jax.ShapeDtypeStruct(
+                tuple(m.shape), m.dtype, sharding=replicated)
     restored = flax.traverse_util.flatten_dict(
         manager.restore(
             latest, args=ocp.args.Composite(

@@ -1,0 +1,180 @@
+"""Continuous batching: slot-based decode with prefill interleaving.
+
+The decisive test: a slot freed mid-stream (EOS/budget) is reused by a
+NEW prompt while other slots keep decoding, and every request's greedy
+output equals the cache-free full re-forward — proving per-slot
+cursors, kv-mask isolation, and cache-row inserts never
+cross-contaminate.
+"""
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.infer import engine as engine_lib
+from tests.unit_tests.test_infer import _OVERRIDES, _reference_greedy
+
+
+@pytest.fixture(scope='module')
+def cbe():
+    return engine_lib.ContinuousBatchingEngine(
+        'llama-tiny', n_slots=2, model_overrides=dict(_OVERRIDES),
+        param_dtype=jnp.float32, prefill_bucket=8)
+
+
+class TestContinuousCorrectness:
+
+    def test_single_request_matches_cache_free(self, cbe):
+        prompt = [5, 17, 3, 42, 8]
+        got = cbe.generate(
+            [prompt], engine_lib.SamplingConfig(max_new_tokens=6))[0]
+        want = _reference_greedy(cbe.params, prompt, 6)
+        assert got == want, (got, want)
+
+    def test_slot_reuse_mid_stream_matches_cache_free(self, cbe):
+        """3 requests, 2 slots, different budgets: A finishes first, C
+        is admitted into A's slot while B is mid-decode."""
+        a, b, c = [5, 17, 3], [9, 1, 30, 31], [7, 8, 9, 10, 11]
+        rid_a = cbe.submit(a, engine_lib.SamplingConfig(
+            max_new_tokens=2))
+        rid_b = cbe.submit(b, engine_lib.SamplingConfig(
+            max_new_tokens=9))
+        rid_c = cbe.submit(c, engine_lib.SamplingConfig(
+            max_new_tokens=4))
+        # Drive manually and observe the interleaving: C must enter
+        # while B is still active.
+        steps_when_c_admitted = None
+        n = 0
+        while any(not cbe._events[r].is_set()
+                  for r in (rid_a, rid_b, rid_c)):
+            assert cbe.step()
+            n += 1
+            if steps_when_c_admitted is None and any(
+                    s is not None and s.request_id == rid_c
+                    for s in cbe._slots):
+                steps_when_c_admitted = n
+                assert any(s is not None and s.request_id == rid_b
+                           for s in cbe._slots), \
+                    'C should share the batch with a live B'
+            assert n < 50
+        assert steps_when_c_admitted is not None
+        assert cbe.wait(rid_a) == _reference_greedy(cbe.params, a, 2)
+        assert cbe.wait(rid_b) == _reference_greedy(cbe.params, b, 9)
+        assert cbe.wait(rid_c) == _reference_greedy(cbe.params, c, 4)
+
+    def test_queueing_beyond_slots(self, cbe):
+        """More prompts than slots: generate() drains the queue."""
+        prompts = [[5, 17, 3], [9, 1], [30, 31, 32], [4, 4, 4, 4],
+                   [50, 60]]
+        outs = cbe.generate(
+            prompts, engine_lib.SamplingConfig(max_new_tokens=3))
+        for p, got in zip(prompts, outs):
+            assert got == _reference_greedy(cbe.params, p, 3), p
+
+    def test_eos_evicts_slot(self, cbe):
+        prompt = [5, 17, 3]
+        base = cbe.generate(
+            [prompt], engine_lib.SamplingConfig(max_new_tokens=8))[0]
+        eos = base[2]
+        got = cbe.generate(
+            [prompt],
+            engine_lib.SamplingConfig(max_new_tokens=8, eos_id=eos))[0]
+        assert got == base[:3], (got, base)
+
+    def test_mixed_greedy_and_sampled_rows(self, cbe):
+        """Greedy and temperature>0 requests share one decode step;
+        the greedy row stays exact."""
+        g, s = [5, 17, 3, 42, 8], [1, 2, 3]
+        rid_g = cbe.submit(g, engine_lib.SamplingConfig(
+            max_new_tokens=5))
+        rid_s = cbe.submit(s, engine_lib.SamplingConfig(
+            max_new_tokens=5, temperature=1.0))
+        cbe.run_until_idle()
+        assert cbe.wait(rid_g) == _reference_greedy(cbe.params, g, 5)
+        sampled = cbe.wait(rid_s)
+        assert len(sampled) == 5
+        assert all(0 <= t < cbe.config.vocab_size for t in sampled)
+
+    def test_mixed_top_k_groups_batch_homogeneously(self, cbe):
+        """(top_k, top_p) are compile keys: requests with different
+        pairs queue into separate homogeneous batches, and all finish
+        with correct outputs."""
+        g1, g2 = [5, 17, 3], [9, 1, 30]
+        rid_plain = cbe.submit(g1, engine_lib.SamplingConfig(
+            max_new_tokens=4))
+        rid_topk = cbe.submit(g2, engine_lib.SamplingConfig(
+            max_new_tokens=4, temperature=1.0, top_k=5))
+        while not (cbe._events[rid_plain].is_set()
+                   and cbe._events[rid_topk].is_set()):
+            assert cbe.step() or cbe._queue
+        assert cbe.wait(rid_plain) == _reference_greedy(
+            cbe.params, g1, 4)
+        sampled = cbe.wait(rid_topk)
+        assert len(sampled) == 4
+
+    def test_cancel_releases_bookkeeping(self, cbe):
+        """Canceled requests (queued, active, or finished-unread) leave
+        no events/results behind."""
+        base_events = len(cbe._events)
+        # Queued cancel.
+        rid_q = cbe.submit([1, 2], engine_lib.SamplingConfig(
+            max_new_tokens=4))
+        cbe.cancel(rid_q)
+        assert rid_q not in cbe._events and not cbe._queue
+        # Active cancel: admit, then cancel mid-decode.
+        rid_a = cbe.submit([1, 2, 3], engine_lib.SamplingConfig(
+            max_new_tokens=8))
+        assert cbe.step()
+        cbe.cancel(rid_a)
+        cbe.run_until_idle()
+        assert rid_a not in cbe._results and rid_a not in cbe._events
+        assert all(s is None for s in cbe._slots)
+        # Finished-unread cancel.
+        rid_f = cbe.submit([4, 5], engine_lib.SamplingConfig(
+            max_new_tokens=2))
+        cbe.run_until_idle()
+        assert rid_f in cbe._results
+        cbe.cancel(rid_f)
+        assert rid_f not in cbe._results and rid_f not in cbe._events
+        assert len(cbe._events) == base_events
+
+    def test_overlong_request_rejected(self, cbe):
+        with pytest.raises(ValueError, match='max_seq_len'):
+            cbe.submit(list(range(60)),
+                       engine_lib.SamplingConfig(max_new_tokens=30))
+
+
+class TestContinuousServer:
+
+    def test_concurrent_requests_share_decode_batch(self):
+        """Concurrent /generate requests through the continuous server
+        all return the cache-free-correct greedy outputs."""
+        import concurrent.futures
+        import json
+        import urllib.request
+
+        from skypilot_tpu.infer import server as server_lib
+        srv = server_lib.InferenceServer(
+            model='llama-tiny', port=0, host='127.0.0.1',
+            max_batch_size=2, model_overrides=dict(_OVERRIDES))
+        assert srv.continuous
+        srv.start()
+        import threading
+        threading.Thread(target=srv._server.serve_forever,  # pylint: disable=protected-access
+                         daemon=True).start()
+        prompts = [[5, 17, 3], [9, 1], [30, 31, 32], [4, 4, 4, 4]]
+
+        def _post(p):
+            req = urllib.request.Request(
+                f'http://127.0.0.1:{srv.port}/generate',
+                data=json.dumps({'prompt_ids': [p],
+                                 'max_new_tokens': 4}).encode(),
+                headers={'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return json.load(r)['tokens'][0]
+        try:
+            with concurrent.futures.ThreadPoolExecutor(4) as pool:
+                got = list(pool.map(_post, prompts))
+            for p, tokens in zip(prompts, got):
+                assert tokens == _reference_greedy(
+                    srv.engine.params, p, 4), p
+        finally:
+            srv.shutdown()
