@@ -83,6 +83,7 @@ def run_level(base_url: str, concurrency: int, requests_per_stream: int,
               continuous: bool) -> dict:
     latencies: List[float] = []
     tokens = [0] * concurrency
+    errors: List[str] = []
     lock = threading.Lock()
 
     def _stream(idx: int) -> None:
@@ -92,7 +93,13 @@ def run_level(base_url: str, concurrency: int, requests_per_stream: int,
             prompt = [(idx * 131 + r * 17 + j) % vocab
                       for j in range(prompt_len)]
             t0 = time.time()
-            n = _one_request(base_url, prompt, max_new_tokens)
+            try:
+                n = _one_request(base_url, prompt, max_new_tokens)
+            except Exception as e:  # noqa: BLE001 — a lost request
+                # must count as an error, not a silently faster run.
+                with lock:
+                    errors.append(repr(e))
+                continue
             dt = time.time() - t0
             with lock:
                 tokens[idx] += n
@@ -107,6 +114,13 @@ def run_level(base_url: str, concurrency: int, requests_per_stream: int,
         t.join()
     wall = time.time() - t0
     total = sum(tokens)
+    if errors:
+        logger.warning(f'{len(errors)} failed requests at '
+                       f'concurrency {concurrency}: {errors[:3]}')
+    if not latencies:
+        raise RuntimeError(
+            f'every request failed at concurrency {concurrency}: '
+            f'{errors[:3]}')
     return {
         'metric': f'serving tokens/s @c{concurrency}',
         'value': round(total / wall, 2),
@@ -116,6 +130,7 @@ def run_level(base_url: str, concurrency: int, requests_per_stream: int,
         'total_tokens': total,
         'wall_s': round(wall, 2),
         'p50_latency_s': round(statistics.median(latencies), 3),
+        'failed_requests': len(errors),
         'continuous': continuous,
     }
 

@@ -70,6 +70,9 @@ _VM_COLUMNS = ['instance_type', 'vcpus', 'memory_gb',
                'accelerator_name', 'accelerator_count', 'price',
                'spot_price']
 
+# See gcp_catalog.SNAPSHOT_DATE — same staleness contract.
+SNAPSHOT_DATE = '2025-03-01'
+
 _df: Optional['pd.DataFrame'] = None
 
 
@@ -81,6 +84,7 @@ def _vm_df() -> 'pd.DataFrame':
         from skypilot_tpu.catalog import common
         _df = common.read_catalog_csv('aws', 'vms', _VM_COLUMNS)
         if _df is None:
+            common.warn_if_snapshot_stale('aws', SNAPSHOT_DATE)
             _df = pd.read_csv(io.StringIO(_VMS_CSV))
     return _df
 

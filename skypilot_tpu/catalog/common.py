@@ -95,6 +95,33 @@ def update_from_url(cloud: str, table: str, url: str,
     return write_catalog_csv(cloud, table, text)
 
 
+SNAPSHOT_MAX_AGE_DAYS = 180
+_stale_warned: set = set()
+
+
+def warn_if_snapshot_stale(cloud: str, snapshot_date: str,
+                           table: str = 'vms') -> None:
+    """Once per process: flag a built-in price snapshot past its
+    shelf life when no fetched/imported override is in effect —
+    prices silently rot otherwise (the r2 verdict's catalog gap)."""
+    if cloud in _stale_warned or os.path.exists(
+            catalog_path(cloud, table)):
+        return
+    import datetime
+    try:
+        age = (datetime.date.today()
+               - datetime.date.fromisoformat(snapshot_date)).days
+    except ValueError:
+        return
+    if age > SNAPSHOT_MAX_AGE_DAYS:
+        _stale_warned.add(cloud)
+        logger.warning(
+            f'{cloud} catalog is the built-in snapshot from '
+            f'{snapshot_date} ({age} days old); prices may be stale. '
+            f'Refresh with: sky catalog update --cloud {cloud} '
+            '--fetch')
+
+
 def remove_override(cloud: str, table: str) -> bool:
     path = catalog_path(cloud, table)
     try:

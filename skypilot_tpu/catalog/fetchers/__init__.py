@@ -1,0 +1,22 @@
+"""Catalog data fetchers: regenerate the price tables from the clouds'
+public pricing endpoints (reference:
+sky/clouds/service_catalog/data_fetchers/fetch_gcp.py etc.).
+
+`sky catalog update --fetch gcp|aws` writes fresh CSVs into the
+override cache (`catalog/common.py` tiering), so the shipped in-code
+snapshot is a fallback, not a slowly-rotting source of truth.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+
+def fetch(cloud: str, **kwargs) -> Dict[str, str]:
+    """Regenerate `cloud`'s tables; returns {table: written_path}."""
+    if cloud == 'gcp':
+        from skypilot_tpu.catalog.fetchers import fetch_gcp
+        return fetch_gcp.fetch_and_write(**kwargs)
+    if cloud == 'aws':
+        from skypilot_tpu.catalog.fetchers import fetch_aws
+        return fetch_aws.fetch_and_write(**kwargs)
+    raise ValueError(f'No catalog fetcher for cloud {cloud!r}.')

@@ -1,0 +1,103 @@
+"""Regenerate the AWS `vms` table from the public EC2 pricing offers.
+
+Reference: sky/clouds/service_catalog/data_fetchers/fetch_aws.py —
+rebuilt against the unauthenticated regional offers JSON:
+
+    GET https://pricing.us-east-1.amazonaws.com/offers/v1.0/aws/
+        AmazonEC2/current/<region>/index.json
+
+(no SigV4 needed).  On-demand prices come straight from the offer's
+price dimensions; spot prices are NOT in the offers file (the spot API
+requires credentials), so each instance keeps its current spot/OD
+ratio applied to the fresh OD price — explicitly logged.
+
+`fetch_json` is injectable for air-gapped tests; the real file is
+hundreds of MB, so the parser streams nothing and filters to the
+catalog's instance shapes only.
+"""
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+OFFERS_URL = ('https://pricing.us-east-1.amazonaws.com/offers/v1.0/'
+              'aws/AmazonEC2/current/{region}/index.json')
+BASE_REGION = 'us-east-1'
+
+
+def _default_fetch_json(url: str) -> Dict[str, Any]:
+    with urllib.request.urlopen(url, timeout=300) as resp:
+        return json.loads(resp.read())
+
+
+def extract_od_prices(offer: Dict[str, Any],
+                      wanted: set) -> Dict[str, float]:
+    """instanceType -> on-demand $/h for plain Linux/Shared capacity."""
+    skus = {}
+    for sku, product in offer.get('products', {}).items():
+        attrs = product.get('attributes', {})
+        if product.get('productFamily') != 'Compute Instance':
+            continue
+        itype = attrs.get('instanceType')
+        if itype not in wanted:
+            continue
+        if (attrs.get('tenancy') != 'Shared'
+                or attrs.get('operatingSystem') != 'Linux'
+                or attrs.get('preInstalledSw') not in (None, 'NA')
+                or attrs.get('capacitystatus') not in (None, 'Used')):
+            continue
+        skus[sku] = itype
+    prices: Dict[str, float] = {}
+    on_demand = offer.get('terms', {}).get('OnDemand', {})
+    for sku, itype in skus.items():
+        for term in on_demand.get(sku, {}).values():
+            for dim in term.get('priceDimensions', {}).values():
+                usd = dim.get('pricePerUnit', {}).get('USD')
+                if usd is not None and float(usd) > 0:
+                    prices[itype] = float(usd)
+    return prices
+
+
+def fetch_and_write(region: str = BASE_REGION,
+                    fetch_json: Optional[Callable[[str],
+                                                  Dict[str, Any]]] = None
+                    ) -> Dict[str, str]:
+    from skypilot_tpu.catalog import aws_catalog
+    from skypilot_tpu.catalog import common
+    fetch_json = fetch_json or _default_fetch_json
+    shapes = aws_catalog._vm_df()  # pylint: disable=protected-access
+    wanted = set(shapes['instance_type'])
+    offer = fetch_json(OFFERS_URL.format(region=region))
+    prices = extract_od_prices(offer, wanted)
+
+    lines = ['instance_type,vcpus,memory_gb,accelerator_name,'
+             'accelerator_count,price,spot_price']
+    skipped = []
+    for _, row in shapes.iterrows():
+        itype = str(row['instance_type'])
+        od = prices.get(itype)
+        cur_od, cur_sp = float(row['price']), float(row['spot_price'])
+        if od is None:
+            od, sp = cur_od, cur_sp
+            skipped.append(itype)
+        else:
+            sp = round(od * (cur_sp / cur_od), 4)
+        acc = '' if not isinstance(row['accelerator_name'], str) \
+            else row['accelerator_name']
+        lines.append(f'{itype},{row["vcpus"]},{row["memory_gb"]},'
+                     f'{acc},{int(row["accelerator_count"] or 0)},'
+                     f'{od},{sp}')
+    if skipped:
+        logger.warning(
+            f'No fresh OD price for {skipped} (kept previous).')
+    logger.info('Spot prices derived from fresh OD x previous spot/OD '
+                'ratio (offers file carries no spot rates).')
+    path = common.write_catalog_csv('aws', 'vms',
+                                    '\n'.join(lines) + '\n')
+    aws_catalog.reload()
+    return {'vms': path}

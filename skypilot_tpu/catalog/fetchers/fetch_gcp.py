@@ -1,0 +1,228 @@
+"""Regenerate the GCP price tables from the Cloud Billing Catalog API.
+
+Reference: sky/clouds/service_catalog/data_fetchers/fetch_gcp.py:1 —
+the reference pulls SKUs from the Cloud Billing Catalog API and
+rebuilds its CSVs; this is the same pipeline against our table shapes:
+
+  - `vms`: per-instance on-demand/spot prices recomputed from the
+    per-core + per-GB (+ per-GPU) SKU rates of each machine family;
+    the instance SHAPES (vcpus/memory/accelerators) come from the
+    currently-effective table — shapes are stable, prices are not.
+  - `tpu_prices`: $/chip-hour per TPU generation from the TPU SKUs.
+
+The Billing Catalog API is public but keyed:
+    GET https://cloudbilling.googleapis.com/v1/services/
+        6F81-5844-456A/skus?key=<api_key>&pageSize=5000
+(6F81-5844-456A = Compute Engine, which carries the TPU SKUs too.)
+`fetch_json` is injectable so air-gapped tests (and this zero-egress
+build environment) exercise the full parse/shape pipeline on fixture
+pages.
+"""
+from __future__ import annotations
+
+import json
+import re
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+BILLING_URL = ('https://cloudbilling.googleapis.com/v1/services/'
+               '6F81-5844-456A/skus')
+BASE_REGION = 'us-central1'
+
+# Machine families whose instances are priced per-core + per-GB.
+_FAMILIES = ('n2', 'e2', 'a2', 'g2', 'a3', 'n2d', 'c2', 'm1')
+_GPU_NAMES = {
+    'a100 80gb': 'A100-80GB',
+    'a100': 'A100',
+    'l4': 'L4',
+    'h100': 'H100',
+    't4': 'T4',
+    'v100': 'V100',
+}
+
+
+def _default_fetch_json(url: str) -> Dict[str, Any]:
+    with urllib.request.urlopen(url, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def iter_skus(api_key: Optional[str],
+              fetch_json: Callable[[str], Dict[str, Any]]
+              ) -> Iterator[Dict[str, Any]]:
+    """Paginate the Billing Catalog SKU list."""
+    page_token = ''
+    while True:
+        params = {'pageSize': '5000'}
+        if api_key:
+            params['key'] = api_key
+        if page_token:
+            params['pageToken'] = page_token
+        url = BILLING_URL + '?' + urllib.parse.urlencode(params)
+        page = fetch_json(url)
+        yield from page.get('skus', [])
+        page_token = page.get('nextPageToken', '')
+        if not page_token:
+            return
+
+
+def _unit_price(sku: Dict[str, Any]) -> Optional[float]:
+    """$/unit from the last (steady-state) tiered rate."""
+    try:
+        rates = (sku['pricingInfo'][0]['pricingExpression']
+                 ['tieredRates'])
+        unit = rates[-1]['unitPrice']
+        return float(unit.get('units', 0) or 0) + \
+            float(unit.get('nanos', 0) or 0) / 1e9
+    except (KeyError, IndexError, TypeError, ValueError):
+        return None
+
+
+def _in_region(sku: Dict[str, Any], region: str) -> bool:
+    regions = sku.get('serviceRegions', [])
+    return region in regions or 'global' in regions
+
+
+_TPU_GEN_RE = re.compile(r'\btpu[ -]?v(\d+[ep]?)\b', re.IGNORECASE)
+
+
+class _Rates:
+    """Accumulated $/h rates keyed by (kind, name, usage)."""
+
+    def __init__(self) -> None:
+        self.core: Dict[Tuple[str, str], float] = {}   # (family, usage)
+        self.ram: Dict[Tuple[str, str], float] = {}
+        self.gpu: Dict[Tuple[str, str], float] = {}    # (gpu_name, usage)
+        self.tpu: Dict[Tuple[str, str], float] = {}    # (gen, usage)
+
+
+def collect_rates(skus: Iterator[Dict[str, Any]],
+                  region: str = BASE_REGION) -> _Rates:
+    rates = _Rates()
+    for sku in skus:
+        if not _in_region(sku, region):
+            continue
+        cat = sku.get('category', {})
+        usage = cat.get('usageType', '')
+        if usage not in ('OnDemand', 'Preemptible'):
+            continue
+        desc = sku.get('description', '')
+        low = desc.lower()
+        price = _unit_price(sku)
+        if price is None:
+            continue
+        tpu_match = _TPU_GEN_RE.search(low)
+        if tpu_match or 'cloud tpu' in low:
+            gen = tpu_match.group(1) if tpu_match else None
+            if gen and 'pod' not in low:
+                rates.tpu.setdefault((f'v{gen}', usage), price)
+            continue
+        group = cat.get('resourceGroup', '')
+        if group == 'GPU':
+            for key, name in _GPU_NAMES.items():
+                if key in low:
+                    rates.gpu.setdefault((name, usage), price)
+                    break
+            continue
+        if group in ('CPU', 'RAM') or 'instance core' in low \
+                or 'instance ram' in low:
+            family = low.split(' ', 1)[0]
+            if family not in _FAMILIES:
+                continue
+            if 'core' in low:
+                rates.core.setdefault((family, usage), price)
+            elif 'ram' in low:
+                rates.ram.setdefault((family, usage), price)
+    return rates
+
+
+def build_vms_csv(rates: _Rates, shapes) -> Tuple[str, List[str]]:
+    """Recompute the vms table prices from SKU rates.
+
+    `shapes` is the currently-effective vms dataframe; rows whose
+    family/GPU rates were not found keep their previous prices (and
+    are reported in `skipped`)."""
+    lines = ['instance_type,vcpus,memory_gb,accelerator_name,'
+             'accelerator_count,price,spot_price']
+    skipped: List[str] = []
+    for _, row in shapes.iterrows():
+        itype = str(row['instance_type'])
+        family = itype.split('-', 1)[0]
+        vcpus = float(row['vcpus'])
+        mem = float(row['memory_gb'])
+        acc = '' if not isinstance(row['accelerator_name'], str) \
+            else row['accelerator_name']
+        acc_n = int(row['accelerator_count'] or 0)
+
+        def _price(usage: str, fallback: float) -> float:
+            core = rates.core.get((family, usage))
+            ram = rates.ram.get((family, usage))
+            if core is None or ram is None:
+                return fallback
+            total = vcpus * core + mem * ram
+            if acc and acc_n:
+                gpu = rates.gpu.get((acc, usage))
+                if gpu is None:
+                    return fallback
+                total += acc_n * gpu
+            return round(total, 4)
+
+        od = _price('OnDemand', float(row['price']))
+        sp = _price('Preemptible', float(row['spot_price']))
+        if od == float(row['price']) and sp == float(row['spot_price']):
+            skipped.append(itype)
+        lines.append(f'{itype},{row["vcpus"]},{row["memory_gb"]},'
+                     f'{acc},{acc_n},{od},{sp}')
+    return '\n'.join(lines) + '\n', skipped
+
+
+def build_tpu_prices_csv(rates: _Rates,
+                         current: Dict[str, Tuple[float, float]]
+                         ) -> Tuple[str, List[str]]:
+    lines = ['generation,price,spot_price']
+    skipped: List[str] = []
+    for gen in sorted(current):
+        od = rates.tpu.get((gen, 'OnDemand'))
+        sp = rates.tpu.get((gen, 'Preemptible'))
+        cur_od, cur_sp = current[gen]
+        if od is None:
+            od, sp = cur_od, cur_sp
+            skipped.append(gen)
+        elif sp is None:
+            # Spot SKU missing: keep the current spot/od ratio.
+            sp = round(od * (cur_sp / cur_od), 4)
+        lines.append(f'{gen},{od},{sp}')
+    return '\n'.join(lines) + '\n', skipped
+
+
+def fetch_and_write(api_key: Optional[str] = None,
+                    fetch_json: Optional[Callable[[str],
+                                                  Dict[str, Any]]] = None
+                    ) -> Dict[str, str]:
+    """Pull SKUs, rebuild `vms` + `tpu_prices`, write the overrides."""
+    from skypilot_tpu.catalog import common
+    from skypilot_tpu.catalog import gcp_catalog
+    fetch_json = fetch_json or _default_fetch_json
+    rates = collect_rates(iter_skus(api_key, fetch_json))
+    vms_csv, vm_skipped = build_vms_csv(rates, gcp_catalog._vm_df())  # pylint: disable=protected-access
+    tpu_csv, tpu_skipped = build_tpu_prices_csv(
+        rates, gcp_catalog._tpu_prices())  # pylint: disable=protected-access
+    if vm_skipped:
+        logger.warning(
+            f'No fresh rates for {len(vm_skipped)} instance types '
+            f'(kept previous prices): {vm_skipped[:5]}...')
+    if tpu_skipped:
+        logger.warning(
+            f'No fresh TPU rates for generations {tpu_skipped} '
+            '(kept previous prices).')
+    paths = {
+        'vms': common.write_catalog_csv('gcp', 'vms', vms_csv),
+        'tpu_prices': common.write_catalog_csv('gcp', 'tpu_prices',
+                                               tpu_csv),
+    }
+    gcp_catalog.reload()
+    return paths

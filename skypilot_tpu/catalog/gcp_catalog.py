@@ -109,6 +109,11 @@ _VM_COLUMNS = ['instance_type', 'vcpus', 'memory_gb',
                'accelerator_name', 'accelerator_count', 'price',
                'spot_price']
 
+# Date the in-code price tables above were snapshotted from public
+# list prices; catalog/common.py warns when this rots without a
+# fetched override in place.
+SNAPSHOT_DATE = '2025-03-01'
+
 
 def _vm_df() -> 'pd.DataFrame':
     global _df
@@ -118,6 +123,7 @@ def _vm_df() -> 'pd.DataFrame':
         from skypilot_tpu.catalog import common
         _df = common.read_catalog_csv('gcp', 'vms', _VM_COLUMNS)
         if _df is None:
+            common.warn_if_snapshot_stale('gcp', SNAPSHOT_DATE)
             _df = pd.read_csv(io.StringIO(_VMS_CSV))
     return _df
 

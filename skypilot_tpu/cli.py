@@ -359,10 +359,34 @@ def catalog() -> None:
 @click.option('--reset', is_flag=True, default=False,
               help='Drop all overrides; revert to the built-in '
                    'snapshot.')
-def catalog_update(cloud, table, from_file, url, export, reset) -> None:
+@click.option('--fetch', is_flag=True, default=False,
+              help='Regenerate the tables from the cloud pricing APIs '
+                   '(GCP Cloud Billing Catalog / AWS EC2 offers).')
+@click.option('--api-key', default=None,
+              help='API key for the GCP Billing Catalog API '
+                   '(with --fetch --cloud gcp).')
+@click.option('--pricing-region', default=None,
+              help='Region whose prices to fetch (aws: offers region).')
+def catalog_update(cloud, table, from_file, url, export, reset, fetch,
+                   api_key, pricing_region) -> None:
     """Refresh the local catalog cache (reference: hosted-catalog
-    fetch, sky/clouds/service_catalog/common.py)."""
+    fetch, sky/clouds/service_catalog/common.py + data_fetchers/)."""
     from skypilot_tpu.catalog import common as catalog_common
+    if fetch:
+        from skypilot_tpu.catalog import fetchers
+        kwargs = {}
+        if cloud == 'gcp' and api_key:
+            kwargs['api_key'] = api_key
+        if cloud == 'aws' and pricing_region:
+            kwargs['region'] = pricing_region
+        try:
+            paths = fetchers.fetch(cloud, **kwargs)
+        except Exception as e:  # noqa: BLE001 — network/auth failures
+            raise click.ClickException(
+                f'Catalog fetch for {cloud!r} failed: {e}') from e
+        for t, p in paths.items():
+            click.echo(f'Fetched {t}: {p}')
+        return
     if cloud == 'gcp':
         from skypilot_tpu.catalog import gcp_catalog as cat
         tables = ('vms', 'tpu_prices', 'tpu_zones')

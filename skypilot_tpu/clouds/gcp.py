@@ -30,6 +30,11 @@ if typing.TYPE_CHECKING:
     from skypilot_tpu import resources as resources_lib
 
 _DEFAULT_CPU_IMAGE = 'projects/debian-cloud/global/images/family/debian-12'
+# GPU VMs need NVIDIA drivers + CUDA baked in (a bare debian image
+# boots driverless): GCP's Deep Learning VM family (reference picks
+# its own GPU images in sky/templates/gcp-ray.yml.j2 image sections).
+_DEFAULT_GPU_IMAGE = ('projects/deeplearning-platform-release/global/'
+                      'images/family/common-cu121-debian-11')
 _CREDENTIAL_HINT = (
     'GCP credentials not found. Run `gcloud auth application-default login` '
     'or set GOOGLE_APPLICATION_CREDENTIALS.')
@@ -234,10 +239,18 @@ class GCP(cloud.Cloud):
                 'reservation': args.get('reservation'),
             })
         else:
+            # A bare GPU instance_type (a2/g2/a3 bundle their GPUs)
+            # is a GPU VM even with no accelerators dict.
+            accelerators = resources.accelerators or (
+                gcp_catalog.get_accelerators_from_instance_type(
+                    resources.instance_type)
+                if resources.instance_type else None)
             variables.update({
                 'tpu_vm': False,
-                'image_id': resources.image_id or _DEFAULT_CPU_IMAGE,
-                'accelerators': resources.accelerators,
+                'image_id': resources.image_id or (
+                    _DEFAULT_GPU_IMAGE if accelerators
+                    else _DEFAULT_CPU_IMAGE),
+                'accelerators': accelerators,
             })
         return variables
 

@@ -241,6 +241,8 @@ class ContinuousBatchingEngine:
         self._results: Dict[int, List[int]] = {}
         self._events: Dict[int, threading.Event] = {}
         self._canceled: set = set()
+        self._admitting_rid: Optional[int] = None
+        self._fatal: Optional[BaseException] = None
         self._submit_lock = threading.Lock()
         self._next_rid = 0
         self._stepno = 0
@@ -279,9 +281,11 @@ class ContinuousBatchingEngine:
                 item for item in self._queue if item[0] != request_id)
             self._results.pop(request_id, None)
             self._events.pop(request_id, None)
-            if any(s is not None and s.request_id == request_id
-                   for s in self._slots):
-                # step() evicts it at the next tick.
+            if request_id == self._admitting_rid or any(
+                    s is not None and s.request_id == request_id
+                    for s in self._slots):
+                # In a slot — or popped from the queue and mid-prefill
+                # (the admission window): step() evicts it next tick.
                 self._canceled.add(request_id)
 
     def wait(self, request_id: int,
@@ -294,8 +298,23 @@ class ContinuousBatchingEngine:
             self.cancel(request_id)
             raise TimeoutError(f'request {request_id} not done')
         with self._submit_lock:
+            if self._fatal is not None and \
+                    request_id not in self._results:
+                self._events.pop(request_id, None)
+                raise RuntimeError(
+                    f'decode loop died: {self._fatal!r}') \
+                    from self._fatal
             del self._events[request_id]
             return self._results.pop(request_id)
+
+    def abort(self, error: BaseException) -> None:
+        """Fatal decode failure: wake every waiter so none blocks its
+        full timeout; wait() raises for requests without results."""
+        with self._submit_lock:
+            self._fatal = error
+            events = list(self._events.values())
+        for e in events:
+            e.set()
 
     # -- the decode loop ---------------------------------------------------
     def _admit(self, slot_idx: int, rid: int, prompt: List[int],
@@ -359,21 +378,27 @@ class ContinuousBatchingEngine:
             return self._step_inner()
 
     def _evict_canceled(self) -> None:
+        with self._submit_lock:
+            snapshot = set(self._canceled)
         for i, s in enumerate(self._slots):
-            if s is not None and s.request_id in self._canceled:
-                with self._submit_lock:
-                    self._canceled.discard(s.request_id)
+            if s is not None and s.request_id in snapshot:
                 self._slots[i] = None
+        # Entries with no slot are stale (e.g. admission raised after a
+        # mid-prefill cancel) — drop them too, the set must not grow.
+        with self._submit_lock:
+            self._canceled -= snapshot
 
     def _step_inner(self) -> bool:
         from skypilot_tpu.models import llama
 
         self._evict_canceled()
         # (top_k, top_p) are compile keys of the decode step, so the
-        # batch must stay homogeneous in them: admit only queued
-        # requests matching the live group; when the batch is empty
-        # the group resets to the queue head's pair (so no request
-        # starves — each group drains in FIFO turns).
+        # batch must stay homogeneous in them.  Admission is strictly
+        # FIFO from the queue HEAD: a head whose pair doesn't match
+        # the live group simply waits for the batch to drain (bounded
+        # by max_new_tokens), then becomes the new group — leapfrogging
+        # it for matching requests further back would starve it under
+        # steady same-group traffic.
         group = next(((s.top_k, s.top_p) for s in self._slots
                       if s is not None), None)
         free = [i for i, s in enumerate(self._slots) if s is None]
@@ -381,18 +406,19 @@ class ContinuousBatchingEngine:
             with self._submit_lock:
                 item = None
                 if self._queue:
-                    if group is None:
+                    head = self._queue[0]
+                    if group is None or \
+                            (head[2].top_k, head[2].top_p) == group:
                         item = self._queue.popleft()
                         group = (item[2].top_k, item[2].top_p)
-                    else:
-                        for j, cand in enumerate(self._queue):
-                            if (cand[2].top_k, cand[2].top_p) == group:
-                                del self._queue[j]
-                                item = cand
-                                break
+                        self._admitting_rid = item[0]
             if item is None:
                 break
-            self._admit(free.pop(0), *item)
+            try:
+                self._admit(free.pop(0), *item)
+            finally:
+                with self._submit_lock:
+                    self._admitting_rid = None
         occupied = [i for i, s in enumerate(self._slots)
                     if s is not None]
         if not occupied:

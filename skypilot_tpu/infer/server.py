@@ -33,11 +33,9 @@ from skypilot_tpu.infer import engine as engine_lib
 logger = sky_logging.init_logger(__name__)
 
 
-class _HTTPServer(http.server.ThreadingHTTPServer):
-    # Default listen backlog (5) drops connections under concurrent
-    # load (benchmark/serving.py at 32 streams saw 502s via the LB).
-    request_queue_size = 128
-    daemon_threads = True
+from skypilot_tpu.utils import http_utils
+
+_HTTPServer = http_utils.HighBacklogHTTPServer
 
 
 class InferenceServer:
@@ -84,15 +82,25 @@ class InferenceServer:
         self._running = False
         self._decode_thread: Optional[threading.Thread] = None
         self._work = threading.Event()
+        self._fatal: Optional[BaseException] = None
 
     def _decode_loop(self) -> None:
         """Single driver of ContinuousBatchingEngine.step(): decodes
         while any slot is occupied, sleeps on the work event when
-        idle.  Handler threads only submit()/wait()."""
-        while self._running:
-            if not self.engine.step():
-                self._work.wait(0.05)
-                self._work.clear()
+        idle.  Handler threads only submit()/wait().  A fatal step()
+        error (device wedge, OOM) marks the replica UNHEALTHY — the
+        readiness probe must stop routing here, and waiters must fail
+        fast instead of blocking their full timeout."""
+        try:
+            while self._running:
+                if not self.engine.step():
+                    self._work.wait(0.05)
+                    self._work.clear()
+        except BaseException as e:  # noqa: BLE001 — replica is dead
+            logger.exception('decode loop died; marking unhealthy')
+            self._fatal = e
+            self._running = False
+            self.engine.abort(e)
 
     @property
     def port(self) -> int:
@@ -153,7 +161,12 @@ class InferenceServer:
 
             def do_GET(self):  # noqa: N802
                 if self.path == '/health':
-                    self._reply(200, {'status': 'ok'})
+                    if outer._fatal is not None:  # pylint: disable=protected-access
+                        self._reply(503, {
+                            'status': 'unhealthy',
+                            'error': repr(outer._fatal)})  # pylint: disable=protected-access
+                    else:
+                        self._reply(200, {'status': 'ok'})
                 else:
                     self._reply(404, {'error': 'not found'})
 

@@ -22,7 +22,7 @@ from __future__ import annotations
 import os
 import re
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
@@ -322,6 +322,54 @@ def _run_tpu_slices(project: str, region: str, zone: str,
     )
 
 
+# GCE acceleratorType ids for attachable GPUs (n1-family attach;
+# a2/g2/a3 machine types come with their GPUs bundled and must NOT
+# carry guestAccelerators).
+_GCE_GPU_TYPES = {
+    'A100': 'nvidia-tesla-a100',
+    'A100-80GB': 'nvidia-a100-80gb',
+    'L4': 'nvidia-l4',
+    'H100': 'nvidia-h100-80gb',
+    'T4': 'nvidia-tesla-t4',
+    'V100': 'nvidia-tesla-v100',
+    'P100': 'nvidia-tesla-p100',
+}
+_BUNDLED_GPU_FAMILIES = ('a2-', 'g2-', 'a3-')
+
+
+def _gpu_body_parts(node_cfg: Dict[str, Any],
+                    zone: str) -> Tuple[List[Dict[str, Any]], bool]:
+    """(guestAccelerators, is_gpu_vm) for the instance body.
+
+    GPU VMs must schedule with onHostMaintenance=TERMINATE (GCE cannot
+    live-migrate them); bundled-GPU machine families carry no
+    guestAccelerators field, attachable GPUs (n1 + T4/V100/...) do.
+    Reference behavior: sky/templates/gcp-ray.yml.j2 GPU sections.
+    """
+    instance_type = node_cfg.get('instance_type', '')
+    if instance_type.startswith(_BUNDLED_GPU_FAMILIES):
+        # Bundled families ARE GPU VMs even when requested by bare
+        # instance_type with no accelerators dict.
+        return [], True
+    accelerators = node_cfg.get('accelerators') or {}
+    if not accelerators:
+        return [], False
+    guest = []
+    for name, count in accelerators.items():
+        gce_type = _GCE_GPU_TYPES.get(name)
+        if gce_type is None:
+            raise exceptions.ProvisionError(
+                f'GPU {name!r} has no GCE acceleratorType mapping; '
+                f'known: {sorted(_GCE_GPU_TYPES)}. Use a bundled-GPU '
+                'machine type (a2/g2/a3) or GKE/AWS.')
+        guest.append({
+            'acceleratorType':
+                f'zones/{zone}/acceleratorTypes/{gce_type}',
+            'acceleratorCount': int(count),
+        })
+    return guest, True
+
+
 def _run_gce_instances(project: str, region: str, zone: str,
                        cluster_name_on_cloud: str,
                        config: common.ProvisionConfig
@@ -345,6 +393,7 @@ def _run_gce_instances(project: str, region: str, zone: str,
     created: List[str] = []
     machine_type = (f'zones/{zone}/machineTypes/'
                     f'{node_cfg["instance_type"]}')
+    guest_accelerators, is_gpu_vm = _gpu_body_parts(node_cfg, zone)
     taken = {i['name'] for i in existing}
     for name in _fresh_node_names(cluster_name_on_cloud, taken,
                                   max(to_create, 0)):
@@ -383,6 +432,11 @@ def _run_gce_instances(project: str, region: str, zone: str,
                 'automaticRestart': not node_cfg.get('use_spot'),
             },
         }
+        if is_gpu_vm:
+            # GCE cannot live-migrate GPU VMs.
+            body['scheduling']['onHostMaintenance'] = 'TERMINATE'
+            if guest_accelerators:
+                body['guestAccelerators'] = guest_accelerators
         op = gcp_api.insert_instance(project, zone, body)
         gcp_api.wait_zone_operation(project, zone, op)
         created.append(name)

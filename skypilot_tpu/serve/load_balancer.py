@@ -35,11 +35,9 @@ _HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
 _PROBE_TIMEOUT_SECONDS = 3.0
 
 
-class LBHTTPServer(http.server.ThreadingHTTPServer):
-    """Listen backlog sized for concurrent streams (the stdlib default
-    of 5 drops connections — 502s at 32 concurrent clients)."""
-    request_queue_size = 128
-    daemon_threads = True
+from skypilot_tpu.utils import http_utils
+
+LBHTTPServer = http_utils.HighBacklogHTTPServer
 
 
 def _probe(replica_url: str) -> bool:

@@ -1,0 +1,16 @@
+"""Shared stdlib HTTP server tuning.
+
+One subclass for every serving hop (inference replica, serve LB) so
+the backlog setting cannot drift between them.
+"""
+from __future__ import annotations
+
+import http.server
+
+
+class HighBacklogHTTPServer(http.server.ThreadingHTTPServer):
+    """Listen backlog sized for concurrent streams: the stdlib default
+    of 5 drops connections under load (benchmark/serving.py at 32
+    concurrent clients saw 502s through the LB)."""
+    request_queue_size = 128
+    daemon_threads = True

@@ -1,0 +1,198 @@
+"""Catalog data fetchers: pricing-API pages -> fresh CSV overrides.
+
+Zero-egress environment: the HTTP layer is injected with fixture pages
+shaped like the real endpoints (GCP Cloud Billing Catalog SKUs, AWS
+EC2 offers), and the full parse -> write -> reload -> price-query ->
+optimizer pipeline runs on top.
+"""
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu.catalog import aws_catalog
+from skypilot_tpu.catalog import common as catalog_common
+from skypilot_tpu.catalog import gcp_catalog
+from skypilot_tpu.catalog.fetchers import fetch_aws, fetch_gcp
+
+
+def _gcp_sku(description, usage, units, nanos, group='CPU',
+             regions=('us-central1',)):
+    return {
+        'description': description,
+        'category': {'resourceFamily': 'Compute',
+                     'resourceGroup': group, 'usageType': usage},
+        'serviceRegions': list(regions),
+        'pricingInfo': [{'pricingExpression': {'tieredRates': [
+            {'unitPrice': {'units': str(units), 'nanos': nanos}}]}}],
+    }
+
+
+_GCP_PAGE_1 = {
+    'skus': [
+        _gcp_sku('N2 Instance Core running in Americas', 'OnDemand',
+                 0, 40_000_000),
+        _gcp_sku('N2 Instance Ram running in Americas', 'OnDemand',
+                 0, 5_000_000),
+        _gcp_sku('N2 Instance Core running in Americas', 'Preemptible',
+                 0, 10_000_000),
+        _gcp_sku('N2 Instance Ram running in Americas', 'Preemptible',
+                 0, 1_250_000),
+    ],
+    'nextPageToken': 'page2',
+}
+_GCP_PAGE_2 = {
+    'skus': [
+        _gcp_sku('Nvidia Tesla A100 GPU running in Americas',
+                 'OnDemand', 2, 0, group='GPU'),
+        _gcp_sku('Tpu-v5e chip hour in us-central1', 'OnDemand',
+                 1, 500_000_000, group='TPU'),
+        _gcp_sku('Tpu-v5e chip hour in us-central1', 'Preemptible',
+                 0, 600_000_000, group='TPU'),
+        # Wrong region: must be ignored.
+        _gcp_sku('Tpu-v5p chip hour in europe', 'OnDemand', 9, 0,
+                 group='TPU', regions=('europe-west4',)),
+    ],
+}
+
+
+def _gcp_fetch_json(url):
+    return _GCP_PAGE_2 if 'pageToken=page2' in url else _GCP_PAGE_1
+
+
+class TestFetchGcp:
+
+    def test_fetch_writes_overrides_and_reprices(self):
+        paths = fetch_gcp.fetch_and_write(fetch_json=_gcp_fetch_json)
+        assert set(paths) == {'vms', 'tpu_prices'}
+        # n2-standard-8: 8 * 0.04 + 32 * 0.005 = 0.48 od;
+        # spot 8 * 0.01 + 32 * 0.00125 = 0.12.
+        assert gcp_catalog.get_hourly_cost(
+            'n2-standard-8', use_spot=False,
+            region='us-central1') == pytest.approx(0.48)
+        assert gcp_catalog.get_hourly_cost(
+            'n2-standard-8', use_spot=True,
+            region='us-central1') == pytest.approx(0.12)
+        # v5e chips got fresh od=1.5 / spot=0.6 per chip-hour.
+        from skypilot_tpu.utils import accelerator_registry
+        spec = accelerator_registry.parse_tpu_accelerator('tpu-v5e-8')
+        od = gcp_catalog.get_tpu_hourly_cost(spec, use_spot=False,
+                                             region='us-central1')
+        assert od == pytest.approx(1.5 * spec.num_chips)
+
+    def test_unfetched_rows_keep_previous_prices(self):
+        fetch_gcp.fetch_and_write(fetch_json=_gcp_fetch_json)
+        # e2 family had no SKUs in the fixture pages.
+        assert gcp_catalog.get_hourly_cost(
+            'e2-standard-4', use_spot=False,
+            region='us-central1') == pytest.approx(0.1340)
+        # v6e had no TPU SKU: previous prices preserved.
+        from skypilot_tpu.utils import accelerator_registry
+        spec = accelerator_registry.parse_tpu_accelerator('tpu-v6e-8')
+        assert gcp_catalog.get_tpu_hourly_cost(
+            spec, use_spot=False,
+            region='us-central1') == pytest.approx(2.70 * 8)
+
+    def test_fetched_tables_round_trip_through_optimizer(self):
+        """A plan priced AFTER a fetch uses the fetched numbers."""
+        fetch_gcp.fetch_and_write(fetch_json=_gcp_fetch_json)
+        from skypilot_tpu import dag as dag_lib
+        from skypilot_tpu import global_user_state
+        from skypilot_tpu import optimizer as optimizer_lib
+        from skypilot_tpu import resources as resources_lib
+        from skypilot_tpu import task as task_lib
+        global_user_state.set_enabled_clouds(['gcp'])
+        task = task_lib.Task('t', run='echo hi')
+        task.set_resources(resources_lib.Resources(
+            cloud='gcp', instance_type='n2-standard-8'))
+        with dag_lib.Dag() as d:
+            d.add(task)
+        optimizer_lib.optimize(d, quiet=True)
+        chosen = task.best_resources
+        assert chosen.get_cost(3600) == pytest.approx(0.48)
+
+
+def _aws_offer():
+    def product(sku, itype, **attrs):
+        base = {'tenancy': 'Shared', 'operatingSystem': 'Linux',
+                'preInstalledSw': 'NA', 'capacitystatus': 'Used',
+                'instanceType': itype}
+        base.update(attrs)
+        return sku, {'productFamily': 'Compute Instance',
+                     'attributes': base}
+
+    products = dict([
+        product('SKU1', 'm6i.2xlarge'),
+        product('SKU2', 'p4d.24xlarge'),
+        # Windows row for the same shape: must be ignored.
+        product('SKU3', 'm6i.2xlarge', operatingSystem='Windows'),
+    ])
+    terms = {'OnDemand': {
+        'SKU1': {'T1': {'priceDimensions': {
+            'D1': {'pricePerUnit': {'USD': '0.5000'}}}}},
+        'SKU2': {'T2': {'priceDimensions': {
+            'D2': {'pricePerUnit': {'USD': '40.0000'}}}}},
+        'SKU3': {'T3': {'priceDimensions': {
+            'D3': {'pricePerUnit': {'USD': '9.9900'}}}}},
+    }}
+    return {'products': products, 'terms': terms}
+
+
+class TestFetchAws:
+
+    def test_fetch_reprices_and_keeps_spot_ratio(self):
+        shapes = aws_catalog._vm_df()  # pylint: disable=protected-access
+        row = shapes[shapes.instance_type == 'm6i.2xlarge'].iloc[0]
+        ratio = float(row['spot_price']) / float(row['price'])
+        paths = fetch_aws.fetch_and_write(
+            fetch_json=lambda url: _aws_offer())
+        assert 'vms' in paths
+        assert aws_catalog.get_hourly_cost(
+            'm6i.2xlarge', use_spot=False,
+            region='us-east-1') == pytest.approx(0.5)
+        assert aws_catalog.get_hourly_cost(
+            'm6i.2xlarge', use_spot=True,
+            region='us-east-1') == pytest.approx(0.5 * ratio,
+                                                 rel=1e-3)
+
+    def test_missing_instance_keeps_previous(self):
+        fetch_aws.fetch_and_write(fetch_json=lambda url: _aws_offer())
+        shapes = aws_catalog._vm_df()  # pylint: disable=protected-access
+        assert (shapes.price > 0).all()
+
+
+class TestCliAndStaleness:
+
+    def test_cli_fetch_gcp(self, monkeypatch):
+        from skypilot_tpu import cli as cli_mod
+        monkeypatch.setattr(fetch_gcp, '_default_fetch_json',
+                            _gcp_fetch_json)
+        result = CliRunner().invoke(
+            cli_mod.cli, ['catalog', 'update', '--cloud', 'gcp',
+                          '--fetch'])
+        assert result.exit_code == 0, result.output
+        assert 'tpu_prices' in result.output
+
+    def test_snapshot_staleness_warning(self, monkeypatch):
+        warnings_seen = []
+        monkeypatch.setattr(catalog_common.logger, 'warning',
+                            warnings_seen.append)
+        monkeypatch.setattr(gcp_catalog, 'SNAPSHOT_DATE', '2019-01-01')
+        catalog_common._stale_warned.discard('gcp')  # pylint: disable=protected-access
+        gcp_catalog.reload()
+        gcp_catalog._vm_df()  # pylint: disable=protected-access
+        assert any('stale' in w for w in warnings_seen)
+        # Once per process only.
+        warnings_seen.clear()
+        gcp_catalog.reload()
+        gcp_catalog._vm_df()  # pylint: disable=protected-access
+        assert not warnings_seen
+
+    def test_no_warning_when_override_present(self, monkeypatch):
+        fetch_gcp.fetch_and_write(fetch_json=_gcp_fetch_json)
+        warnings_seen = []
+        monkeypatch.setattr(catalog_common.logger, 'warning',
+                            warnings_seen.append)
+        monkeypatch.setattr(gcp_catalog, 'SNAPSHOT_DATE', '2019-01-01')
+        catalog_common._stale_warned.discard('gcp')  # pylint: disable=protected-access
+        gcp_catalog.reload()
+        gcp_catalog._vm_df()  # pylint: disable=protected-access
+        assert not any('stale' in w for w in warnings_seen)

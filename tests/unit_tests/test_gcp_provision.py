@@ -264,3 +264,131 @@ class TestResourcesSelection:
             resources_lib.Resources(
                 cloud='gcp', accelerators='tpu-v5p-8', use_spot=True,
                 accelerator_args={'reservation': True})
+
+
+class FakeGceApi:
+    """In-memory compute.googleapis.com instances API."""
+
+    def __init__(self):
+        self.instances = {}
+        self.insert_bodies = []
+
+    def list_instances(self, project, zone, label_filter=None):
+        return [dict(i) for i in self.instances.values()]
+
+    def insert_instance(self, project, zone, body):
+        self.insert_bodies.append(body)
+        self.instances[body['name']] = {
+            'name': body['name'], 'status': 'RUNNING',
+            'labels': dict(body.get('labels', {})),
+        }
+        return {'name': f'op-{body["name"]}', 'done': True}
+
+    def instance_action(self, project, zone, name, action):
+        return {'name': f'op-{action}-{name}', 'done': True}
+
+    def wait_zone_operation(self, project, zone, op, timeout_s=0):
+        return op
+
+
+@pytest.fixture()
+def fake_gce(monkeypatch):
+    api = FakeGceApi()
+    for fn in ('list_instances', 'insert_instance', 'instance_action',
+               'wait_zone_operation'):
+        monkeypatch.setattr(gcp_api, fn, getattr(api, fn))
+    monkeypatch.setattr(gcp_instance.time, 'sleep', lambda s: None)
+    return api
+
+
+def _gce_config(count=1, **node_cfg):
+    base = {'zone': 'us-central1-a', 'tpu_vm': False,
+            'instance_type': 'n2-standard-8',
+            'image_id': 'projects/debian-cloud/global/images/family/'
+                        'debian-12'}
+    base.update(node_cfg)
+    return common.ProvisionConfig(
+        provider_config={'project_id': 'proj', 'zone': 'us-central1-a',
+                         'tpu_vm': False},
+        authentication_config={'ssh_keys': 'k'},
+        docker_config={}, node_config=base, count=count,
+        tags={}, resume_stopped_nodes=False)
+
+
+class TestGceGpuBodies:
+    """VERDICT r2 item 4: GPU VMs must render a bootable body — GPU
+    image with drivers, TERMINATE maintenance, and guestAccelerators
+    only for attachable (non-bundled) GPU machine families."""
+
+    def test_cpu_vm_body_has_no_gpu_fields(self, fake_gce):
+        gcp_instance.run_instances('us-central1', 'c1', _gce_config())
+        (body,) = fake_gce.insert_bodies
+        assert 'guestAccelerators' not in body
+        assert 'onHostMaintenance' not in body['scheduling']
+
+    def test_bundled_a2_gpu_vm(self, fake_gce):
+        gcp_instance.run_instances(
+            'us-central1', 'c1',
+            _gce_config(instance_type='a2-highgpu-8g',
+                        accelerators={'A100': 8}))
+        (body,) = fake_gce.insert_bodies
+        # a2 bundles its GPUs: no guestAccelerators, but TERMINATE.
+        assert 'guestAccelerators' not in body
+        assert body['scheduling']['onHostMaintenance'] == 'TERMINATE'
+
+    def test_attachable_t4_gpu_vm(self, fake_gce):
+        gcp_instance.run_instances(
+            'us-central1', 'c1',
+            _gce_config(instance_type='n1-standard-8',
+                        accelerators={'T4': 2}))
+        (body,) = fake_gce.insert_bodies
+        assert body['guestAccelerators'] == [{
+            'acceleratorType':
+                'zones/us-central1-a/acceleratorTypes/nvidia-tesla-t4',
+            'acceleratorCount': 2,
+        }]
+        assert body['scheduling']['onHostMaintenance'] == 'TERMINATE'
+
+    def test_unknown_gpu_fails_fast(self, fake_gce):
+        with pytest.raises(exceptions.ProvisionError,
+                           match='no GCE acceleratorType'):
+            gcp_instance.run_instances(
+                'us-central1', 'c1',
+                _gce_config(instance_type='n1-standard-8',
+                            accelerators={'MI300': 1}))
+        assert not fake_gce.insert_bodies  # nothing half-created
+
+    def test_gpu_resources_pick_gpu_image(self):
+        r = resources_lib.Resources(cloud='gcp', accelerators='A100:8')
+        variables = gcp_cloud.GCP.make_deploy_resources_variables(
+            r, 'c', cloud_lib.Region('us-central1'),
+            [cloud_lib.Zone('us-central1-a', 'us-central1')], 1)
+        assert 'deeplearning-platform-release' in variables['image_id']
+        assert variables['accelerators'] == {'A100': 8}
+
+    def test_cpu_resources_pick_debian_image(self):
+        r = resources_lib.Resources(cloud='gcp',
+                                    instance_type='n2-standard-8')
+        variables = gcp_cloud.GCP.make_deploy_resources_variables(
+            r, 'c', cloud_lib.Region('us-central1'),
+            [cloud_lib.Zone('us-central1-a', 'us-central1')], 1)
+        assert 'debian-cloud' in variables['image_id']
+
+    def test_bundled_gpu_by_bare_instance_type(self, fake_gce):
+        """a2/g2/a3 requested via instance_type alone (no accelerators
+        dict) are still GPU VMs: TERMINATE maintenance + GPU image."""
+        gcp_instance.run_instances(
+            'us-central1', 'c1',
+            _gce_config(instance_type='a2-highgpu-1g'))
+        (body,) = fake_gce.insert_bodies
+        assert body['scheduling']['onHostMaintenance'] == 'TERMINATE'
+        assert 'guestAccelerators' not in body
+
+    def test_deploy_vars_infer_accelerators_from_instance_type(self):
+        r = resources_lib.Resources(cloud='gcp',
+                                    instance_type='a2-highgpu-1g')
+        variables = gcp_cloud.GCP.make_deploy_resources_variables(
+            r, 'c', cloud_lib.Region('us-central1'),
+            [cloud_lib.Zone('us-central1-a', 'us-central1')], 1)
+        assert variables['accelerators'] == {'A100': 1}
+        assert 'deeplearning-platform-release' in variables['image_id']
