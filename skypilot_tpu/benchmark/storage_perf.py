@@ -1,0 +1,117 @@
+"""Storage perf smoke: sequential + small-IO throughput of a path.
+
+The reference publishes fio numbers for MOUNT-mode buckets
+(`examples/perf/results.md`: 642 MB/s seq read on S3-goofys vs 130 on
+EBS); this is the first-party analog — point it at a bucket MOUNT dir
+(gcsfuse/goofys/blobfuse2) on a cluster, or any local dir as the
+baseline:
+
+    python -m skypilot_tpu.benchmark.storage_perf /ckpt --size-mb 256
+
+Prints one JSON line:
+    {"metric": "storage-perf", "path": ..., "seq_write_mb_s": ...,
+     "seq_read_mb_s": ..., "small_write_iops": ...,
+     "small_read_iops": ...}
+
+Sequential IO uses a large block (8 MiB) like checkpoint writers do;
+small IO is 4 KiB random-offset read/write — the metadata/journal
+pattern that hurts most on FUSE mounts.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import time
+from typing import Dict
+
+_SEQ_BLOCK = 8 * 1024 * 1024
+_SMALL_BLOCK = 4 * 1024
+
+
+def _drop_page_cache(path: str) -> None:
+    """Best-effort: re-open with O_DIRECT is FUSE-hostile; instead
+    fsync + (on Linux, root) advise the kernel.  On FUSE mounts reads
+    go to the daemon anyway."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+        finally:
+            os.close(fd)
+    except (AttributeError, OSError):
+        pass
+
+
+def run(path: str, size_mb: int = 128,
+        small_ops: int = 512) -> Dict[str, float]:
+    os.makedirs(path, exist_ok=True)
+    target = os.path.join(path, f'.skytpu_perf_{os.getpid()}')
+    payload = os.urandom(_SEQ_BLOCK)
+    n_blocks = max(1, size_mb * 1024 * 1024 // _SEQ_BLOCK)
+    try:
+        t0 = time.time()
+        with open(target, 'wb') as f:
+            for _ in range(n_blocks):
+                f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        seq_write = n_blocks * _SEQ_BLOCK / (time.time() - t0) / 1e6
+
+        _drop_page_cache(target)
+        t0 = time.time()
+        with open(target, 'rb') as f:
+            while f.read(_SEQ_BLOCK):
+                pass
+        seq_read = n_blocks * _SEQ_BLOCK / (time.time() - t0) / 1e6
+
+        size = n_blocks * _SEQ_BLOCK
+        rng = random.Random(0)
+        offsets = [rng.randrange(0, size - _SMALL_BLOCK)
+                   for _ in range(small_ops)]
+        small = os.urandom(_SMALL_BLOCK)
+        t0 = time.time()
+        with open(target, 'r+b') as f:
+            for off in offsets:
+                f.seek(off)
+                f.write(small)
+            f.flush()
+            os.fsync(f.fileno())
+        small_write_iops = small_ops / (time.time() - t0)
+
+        _drop_page_cache(target)
+        t0 = time.time()
+        with open(target, 'rb') as f:
+            for off in offsets:
+                f.seek(off)
+                f.read(_SMALL_BLOCK)
+        small_read_iops = small_ops / (time.time() - t0)
+    finally:
+        try:
+            os.unlink(target)
+        except OSError:
+            pass
+    return {
+        'metric': 'storage-perf',
+        'path': path,
+        'size_mb': n_blocks * _SEQ_BLOCK // (1024 * 1024),
+        'seq_write_mb_s': round(seq_write, 1),
+        'seq_read_mb_s': round(seq_read, 1),
+        'small_write_iops': round(small_write_iops, 1),
+        'small_read_iops': round(small_read_iops, 1),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('path', help='directory to benchmark '
+                                     '(bucket MOUNT dir or local)')
+    parser.add_argument('--size-mb', type=int, default=128)
+    parser.add_argument('--small-ops', type=int, default=512)
+    args = parser.parse_args()
+    print(json.dumps(run(args.path, args.size_mb, args.small_ops)))
+
+
+if __name__ == '__main__':
+    main()

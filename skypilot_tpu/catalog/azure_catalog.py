@@ -1,0 +1,233 @@
+"""Azure VM catalog: instance types, prices, regions/zones.
+
+Counterpart of the reference's
+sky/clouds/service_catalog/azure_catalog.py; same structure as
+catalog/aws_catalog.py: a built-in snapshot of public pay-as-you-go /
+spot list prices (eastus anchors, per-region multiplier), overridable
+by `~/.skytpu/catalogs/v1/azure/vms.csv` (`sky catalog update`).
+
+Azure zones are numbered (1/2/3) within a region; this catalog
+represents them as '<region>-<n>'.
+"""
+from __future__ import annotations
+
+import io
+import typing
+from typing import Dict, List, Optional, Tuple
+
+if typing.TYPE_CHECKING:
+    import pandas as pd
+
+from skypilot_tpu import exceptions
+
+# price/spot_price are eastus anchors ($/h, public list 2025).
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+Standard_D2s_v5,2,8,,0,0.0960,0.0288
+Standard_D4s_v5,4,16,,0,0.1920,0.0576
+Standard_D8s_v5,8,32,,0,0.3840,0.1152
+Standard_D16s_v5,16,64,,0,0.7680,0.2304
+Standard_D32s_v5,32,128,,0,1.5360,0.4608
+Standard_E8s_v5,8,64,,0,0.5040,0.1512
+Standard_F16s_v2,16,32,,0,0.6770,0.2031
+Standard_NC4as_T4_v3,4,28,T4,1,0.5260,0.1578
+Standard_NC64as_T4_v3,64,440,T4,4,4.3520,1.3056
+Standard_NV36ads_A10_v5,36,440,A10,1,3.2000,0.9600
+Standard_NC24ads_A100_v4,24,220,A100-80GB,1,3.6730,1.1019
+Standard_ND96asr_v4,96,900,A100,8,27.1970,8.1591
+Standard_ND96amsr_A100_v4,96,1900,A100-80GB,8,32.7700,9.8310
+Standard_NC40ads_H100_v5,40,320,H100,1,6.9800,2.0940
+Standard_ND96isr_H100_v5,96,1900,H100,8,98.3200,29.4960
+"""
+
+_REGION_PRICE_MULTIPLIER: Dict[str, float] = {
+    'eastus': 1.0,
+    'eastus2': 1.0,
+    'southcentralus': 1.05,
+    'westus2': 1.0,
+    'westeurope': 1.15,
+    'northeurope': 1.10,
+    'japaneast': 1.20,
+}
+
+# Azure availability zones are numbered per region.
+_REGION_ZONES: Dict[str, List[str]] = {
+    'eastus': ['1', '2', '3'],
+    'eastus2': ['1', '2', '3'],
+    'southcentralus': ['1', '2', '3'],
+    'westus2': ['1', '2', '3'],
+    'westeurope': ['1', '2', '3'],
+    'northeurope': ['1', '2', '3'],
+    'japaneast': ['1', '2', '3'],
+}
+
+_VM_COLUMNS = ['instance_type', 'vcpus', 'memory_gb',
+               'accelerator_name', 'accelerator_count', 'price',
+               'spot_price']
+
+# See gcp_catalog.SNAPSHOT_DATE — same staleness contract.
+SNAPSHOT_DATE = '2025-03-01'
+
+_df: Optional['pd.DataFrame'] = None
+
+
+def _vm_df() -> 'pd.DataFrame':
+    global _df
+    if _df is None:
+        import pandas as pd  # deferred: keep `import skypilot_tpu` light
+
+        from skypilot_tpu.catalog import common
+        _df = common.read_catalog_csv('azure', 'vms', _VM_COLUMNS)
+        if _df is None:
+            common.warn_if_snapshot_stale('azure', SNAPSHOT_DATE)
+            _df = pd.read_csv(io.StringIO(_VMS_CSV))
+    return _df
+
+
+def reload() -> None:
+    global _df
+    _df = None
+
+
+def export_snapshot() -> Dict[str, str]:
+    return {'vms': _vm_df().to_csv(index=False)}
+
+
+def regions() -> List[str]:
+    return sorted(_REGION_ZONES)
+
+
+def zones(region: Optional[str] = None,
+          zone: Optional[str] = None) -> List[str]:
+    out = []
+    for r, numbers in sorted(_REGION_ZONES.items()):
+        if region is not None and r != region:
+            continue
+        for n in numbers:
+            z = f'{r}-{n}'
+            if zone is None or z == zone:
+                out.append(z)
+    return out
+
+
+def zone_to_region(zone: str) -> str:
+    # 'eastus-1' -> 'eastus'
+    return zone.rsplit('-', 1)[0]
+
+
+def zone_number(zone: str) -> str:
+    # 'eastus-1' -> '1' (the ARM `zones` field value)
+    return zone.rsplit('-', 1)[1]
+
+
+def _region_multiplier(region: Optional[str]) -> float:
+    if region is None:
+        return 1.0
+    return _REGION_PRICE_MULTIPLIER.get(region, 1.2)
+
+
+def instance_type_exists(instance_type: str) -> bool:
+    df = _vm_df()
+    return bool((df['instance_type'] == instance_type).any())
+
+
+def _row(instance_type: str):
+    df = _vm_df()
+    rows = df[df['instance_type'] == instance_type]
+    if rows.empty:
+        raise exceptions.ResourcesUnavailableError(
+            f'No Azure instance type {instance_type!r}; have '
+            f'{sorted(df["instance_type"])}')
+    return rows.iloc[0]
+
+
+def get_hourly_cost(instance_type: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    if zone is not None and region is None:
+        region = zone_to_region(zone)
+    row = _row(instance_type)
+    base = float(row['spot_price'] if use_spot else row['price'])
+    return base * _region_multiplier(region)
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    row = _row(instance_type)
+    return float(row['vcpus']), float(row['memory_gb'])
+
+
+def get_accelerators_from_instance_type(
+        instance_type: str) -> Optional[Dict[str, int]]:
+    row = _row(instance_type)
+    if not row['accelerator_name'] or str(row['accelerator_name']) == 'nan':
+        return None
+    return {str(row['accelerator_name']): int(row['accelerator_count'])}
+
+
+def _parse_bound(request: Optional[str]) -> Tuple[Optional[float], bool]:
+    if request is None:
+        return None, False
+    s = str(request)
+    if s.endswith('+'):
+        return float(s[:-1]), True
+    return float(s), False
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None,
+                              disk_tier: Optional[str] = None
+                              ) -> Optional[str]:
+    del disk_tier
+    df = _vm_df()
+    df = df[df['accelerator_count'] == 0]
+    cpu_val, cpu_plus = _parse_bound(cpus)
+    mem_val, mem_plus = _parse_bound(memory)
+    if cpu_val is not None:
+        df = df[df['vcpus'] >= cpu_val] if cpu_plus else \
+            df[df['vcpus'] == cpu_val]
+    elif memory is None:
+        df = df[df['vcpus'] >= 8]
+    if mem_val is not None:
+        df = df[df['memory_gb'] >= mem_val] if mem_plus else \
+            df[df['memory_gb'] == mem_val]
+    if df.empty:
+        return None
+    return str(df.sort_values('price').iloc[0]['instance_type'])
+
+
+def get_instance_type_for_accelerator(acc_name: str,
+                                      acc_count: int) -> List[str]:
+    df = _vm_df()
+    rows = df[(df['accelerator_name'] == acc_name)
+              & (df['accelerator_count'] == acc_count)]
+    return sorted(rows['instance_type'])
+
+
+def get_accelerator_hourly_cost(acc_name: str, acc_count: int,
+                                use_spot: bool,
+                                region: Optional[str] = None,
+                                zone: Optional[str] = None) -> float:
+    types = get_instance_type_for_accelerator(acc_name, acc_count)
+    if not types:
+        raise exceptions.ResourcesUnavailableError(
+            f'No Azure instance type offers {acc_name}:{acc_count}.')
+    return min(get_hourly_cost(t, use_spot, region, zone) for t in types)
+
+
+def list_accelerators(name_filter: Optional[str] = None
+                      ) -> Dict[str, List[Dict[str, object]]]:
+    """name -> offerings (for `sky show-accelerators`)."""
+    df = _vm_df()
+    out: Dict[str, List[Dict[str, object]]] = {}
+    for _, row in df[df['accelerator_count'] > 0].iterrows():
+        name = str(row['accelerator_name'])
+        if name_filter and name_filter.lower() not in name.lower():
+            continue
+        out.setdefault(name, []).append({
+            'accelerator_count': int(row['accelerator_count']),
+            'instance_type': str(row['instance_type']),
+            'price': float(row['price']),
+            'spot_price': float(row['spot_price']),
+        })
+    return out

@@ -19,4 +19,7 @@ def fetch(cloud: str, **kwargs) -> Dict[str, str]:
     if cloud == 'aws':
         from skypilot_tpu.catalog.fetchers import fetch_aws
         return fetch_aws.fetch_and_write(**kwargs)
+    if cloud == 'azure':
+        from skypilot_tpu.catalog.fetchers import fetch_azure
+        return fetch_azure.fetch_and_write(**kwargs)
     raise ValueError(f'No catalog fetcher for cloud {cloud!r}.')

@@ -74,6 +74,13 @@ def fetch_and_write(region: str = BASE_REGION,
     wanted = set(shapes['instance_type'])
     offer = fetch_json(OFFERS_URL.format(region=region))
     prices = extract_od_prices(offer, wanted)
+    # Table stores BASE_REGION anchors; normalize other regions back
+    # through the catalog's own multiplier (see fetch_azure).
+    divisor = aws_catalog._REGION_PRICE_MULTIPLIER.get(region, 1.2)  # pylint: disable=protected-access
+    if divisor != 1.0:
+        logger.info(f'Normalizing {region} prices to '
+                    f'{BASE_REGION} anchors (/{divisor}).')
+        prices = {k: v / divisor for k, v in prices.items()}
 
     lines = ['instance_type,vcpus,memory_gb,accelerator_name,'
              'accelerator_count,price,spot_price']

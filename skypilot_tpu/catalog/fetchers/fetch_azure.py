@@ -1,0 +1,116 @@
+"""Regenerate the Azure `vms` table from the Retail Prices API.
+
+Reference: sky/clouds/service_catalog/data_fetchers/fetch_azure.py —
+rebuilt against the unauthenticated Retail Prices endpoint (the one
+public pricing API that needs no key and carries SPOT prices too):
+
+    GET https://prices.azure.com/api/retail/prices?
+        $filter=serviceName eq 'Virtual Machines'
+                and armRegionName eq '<region>'
+        (paginated via NextPageLink)
+
+`fetch_json` is injectable for air-gapped tests.
+"""
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, Iterator, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+RETAIL_URL = 'https://prices.azure.com/api/retail/prices'
+BASE_REGION = 'eastus'
+
+
+def _default_fetch_json(url: str) -> Dict[str, Any]:
+    with urllib.request.urlopen(url, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def iter_items(region: str,
+               fetch_json: Callable[[str], Dict[str, Any]]
+               ) -> Iterator[Dict[str, Any]]:
+    flt = (f"serviceName eq 'Virtual Machines' and "
+           f"armRegionName eq '{region}'")
+    url = RETAIL_URL + '?' + urllib.parse.urlencode({'$filter': flt})
+    while url:
+        page = fetch_json(url)
+        yield from page.get('Items', [])
+        url = page.get('NextPageLink') or ''
+
+
+def collect_prices(items: Iterator[Dict[str, Any]],
+                   wanted: set) -> Dict[str, Dict[str, float]]:
+    """armSkuName -> {'od': $/h, 'spot': $/h} (Linux consumption)."""
+    prices: Dict[str, Dict[str, float]] = {}
+    for item in items:
+        sku = item.get('armSkuName')
+        if sku not in wanted:
+            continue
+        if item.get('type') != 'Consumption':
+            continue
+        product = item.get('productName', '')
+        sku_name = item.get('skuName', '')
+        if 'Windows' in product or 'Low Priority' in sku_name:
+            continue
+        price = float(item.get('retailPrice', 0) or 0)
+        if price <= 0:
+            continue
+        kind = 'spot' if 'Spot' in sku_name else 'od'
+        prices.setdefault(sku, {}).setdefault(kind, price)
+    return prices
+
+
+def fetch_and_write(region: str = BASE_REGION,
+                    fetch_json: Optional[Callable[[str],
+                                                  Dict[str, Any]]] = None
+                    ) -> Dict[str, str]:
+    from skypilot_tpu.catalog import azure_catalog
+    from skypilot_tpu.catalog import common
+    fetch_json = fetch_json or _default_fetch_json
+    shapes = azure_catalog._vm_df()  # pylint: disable=protected-access
+    wanted = set(shapes['instance_type'])
+    prices = collect_prices(iter_items(region, fetch_json), wanted)
+    # The vms table stores BASE_REGION anchors with a per-region
+    # multiplier on top; prices fetched from another region must be
+    # normalized back to the anchor or the multiplier double-counts.
+    divisor = azure_catalog._REGION_PRICE_MULTIPLIER.get(region, 1.2)  # pylint: disable=protected-access
+    if divisor != 1.0:
+        logger.info(f'Normalizing {region} prices to '
+                    f'{BASE_REGION} anchors (/{divisor}).')
+        prices = {sku: {k: v / divisor for k, v in p.items()}
+                  for sku, p in prices.items()}
+
+    lines = ['instance_type,vcpus,memory_gb,accelerator_name,'
+             'accelerator_count,price,spot_price']
+    skipped = []
+    for _, row in shapes.iterrows():
+        itype = str(row['instance_type'])
+        cur_od, cur_sp = float(row['price']), float(row['spot_price'])
+        fresh = prices.get(itype, {})
+        od = fresh.get('od')
+        if od is None:
+            od, sp = cur_od, cur_sp
+            skipped.append(itype)
+        else:
+            # Retail API carries spot; fall back to the previous
+            # spot/OD ratio only when the spot row is absent.
+            sp = fresh.get('spot')
+            if sp is None:
+                sp = round(od * (cur_sp / cur_od), 4)
+        acc = '' if not isinstance(row['accelerator_name'], str) \
+            else row['accelerator_name']
+        lines.append(f'{itype},{row["vcpus"]},{row["memory_gb"]},'
+                     f'{acc},{int(row["accelerator_count"] or 0)},'
+                     f'{od},{sp}')
+    if skipped:
+        logger.warning(
+            f'No fresh Azure price for {skipped} (kept previous).')
+    path = common.write_catalog_csv('azure', 'vms',
+                                    '\n'.join(lines) + '\n')
+    azure_catalog.reload()
+    return {'vms': path}

@@ -309,13 +309,16 @@ def _show_accelerators(name_filter, include_gpus: bool) -> None:
                     f"${item['spot_price']:.2f}"))
     if include_gpus:
         from skypilot_tpu.catalog import aws_catalog
-        aws_inventory = aws_catalog.list_accelerators(name_filter)
-        for name in sorted(aws_inventory):
-            for item in aws_inventory[name]:
-                gpu_rows.append((
-                    name, 'AWS', str(item['instance_type']),
-                    f"${item['price']:.2f}",
-                    f"${item['spot_price']:.2f}"))
+        from skypilot_tpu.catalog import azure_catalog
+        for label, cat in (('AWS', aws_catalog),
+                           ('Azure', azure_catalog)):
+            inv = cat.list_accelerators(name_filter)
+            for name in sorted(inv):
+                for item in inv[name]:
+                    gpu_rows.append((
+                        name, label, str(item['instance_type']),
+                        f"${item['price']:.2f}",
+                        f"${item['spot_price']:.2f}"))
     _print_table(('TPU', 'CHIPS', 'HOSTS', 'HBM_GB', 'BF16_TFLOPS',
                   '$/HR', 'SPOT_$/HR', 'REGIONS'), rows)
     if gpu_rows:
@@ -377,7 +380,7 @@ def catalog_update(cloud, table, from_file, url, export, reset, fetch,
         kwargs = {}
         if cloud == 'gcp' and api_key:
             kwargs['api_key'] = api_key
-        if cloud == 'aws' and pricing_region:
+        if cloud in ('aws', 'azure') and pricing_region:
             kwargs['region'] = pricing_region
         try:
             paths = fetchers.fetch(cloud, **kwargs)
@@ -392,6 +395,9 @@ def catalog_update(cloud, table, from_file, url, export, reset, fetch,
         tables = ('vms', 'tpu_prices', 'tpu_zones')
     elif cloud == 'aws':
         from skypilot_tpu.catalog import aws_catalog as cat
+        tables = ('vms',)
+    elif cloud == 'azure':
+        from skypilot_tpu.catalog import azure_catalog as cat
         tables = ('vms',)
     else:
         raise click.UsageError(f'Unknown catalog cloud {cloud!r}.')

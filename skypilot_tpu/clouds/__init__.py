@@ -5,6 +5,7 @@ from skypilot_tpu.clouds.registry import CLOUD_REGISTRY
 
 # Importing the modules registers the clouds.
 from skypilot_tpu.clouds.aws import AWS
+from skypilot_tpu.clouds.azure import Azure
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.fake import Fake, fake_cloud_state
 from skypilot_tpu.clouds.kubernetes import Kubernetes
@@ -12,6 +13,6 @@ from skypilot_tpu.clouds.local import Local
 
 __all__ = [
     'Cloud', 'CloudImplementationFeatures', 'FeasibleResources', 'Region',
-    'Zone', 'CLOUD_REGISTRY', 'AWS', 'GCP', 'Fake', 'Local',
+    'Zone', 'CLOUD_REGISTRY', 'AWS', 'Azure', 'GCP', 'Fake', 'Local',
     'fake_cloud_state',
 ]

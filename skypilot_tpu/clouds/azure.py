@@ -1,0 +1,215 @@
+"""Azure cloud (VMs): capability model + catalog glue.
+
+Counterpart of the reference's sky/clouds/azure.py (706 LoC over the
+azure SDKs).  SDK-free like the AWS impl: pricing/feasibility ride the
+catalog snapshot (catalog/azure_catalog.py) and provisioning drives
+the ARM REST API with OAuth2 bearer tokens
+(provision/azure/{auth,arm_api}.py) — fully mockable in tests.
+
+Scope: CPU/GPU VMs (controllers, data-prep stages, GPU serving
+fallbacks) — the TPU path stays on GCP/GKE.  With GCP + AWS + Azure
+the optimizer places across three real clouds.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.catalog import azure_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class Azure(cloud.Cloud):
+    """Microsoft Azure (VMs via ARM)."""
+
+    _REPR = 'Azure'
+    PROVISIONER_MODULE = 'azure'
+    # RG names ride the cluster name; ARM caps RG names at 90 chars
+    # but VM computer names at 64 — keep headroom for '-NNNN'.
+    MAX_CLUSTER_NAME_LEN_LIMIT = 42
+
+    @classmethod
+    def _unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        unsupported: Dict[cloud.CloudImplementationFeatures, str] = {}
+        if resources.tpu_slice is not None:
+            unsupported[cloud.CloudImplementationFeatures.MULTI_NODE] = (
+                'Azure offers no TPUs; use GCP/Kubernetes for TPU '
+                'slices.')
+        unsupported[cloud.CloudImplementationFeatures.CLONE_DISK] = (
+            'disk cloning is not implemented for Azure.')
+        return unsupported
+
+    # ---- regions/zones ---------------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del instance_type, accelerators, use_spot
+        zones = azure_catalog.zones(region, zone)
+        regions = sorted({azure_catalog.zone_to_region(z)
+                          for z in zones})
+        return [cloud.Region(r) for r in regions]
+
+    @classmethod
+    def zones_provision_loop(
+        cls, *, region: str, num_nodes: int, instance_type: str,
+        accelerators: Optional[Dict[str, int]] = None,
+        use_spot: bool = False,
+    ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del num_nodes, instance_type, accelerators, use_spot
+        for z in azure_catalog.zones(region):
+            yield [cloud.Zone(z, region)]
+
+    # ---- pricing ---------------------------------------------------------
+    @classmethod
+    def instance_type_to_hourly_cost(cls, instance_type: str,
+                                     use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        return azure_catalog.get_hourly_cost(instance_type, use_spot,
+                                             region, zone)
+
+    @classmethod
+    def accelerators_to_hourly_cost(cls, accelerators: Dict[str, int],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        (acc, count), = accelerators.items()
+        return azure_catalog.get_accelerator_hourly_cost(
+            acc, count, use_spot, region, zone)
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        # Internet egress (reference sky/clouds/azure.py
+        # get_egress_cost: ~0.0875 under 10TB).
+        if num_gigabytes <= 0.1:
+            return 0.0
+        return num_gigabytes * 0.0875
+
+    # ---- instance types --------------------------------------------------
+    @classmethod
+    def instance_type_exists(cls, instance_type: str) -> bool:
+        return azure_catalog.instance_type_exists(instance_type)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return azure_catalog.get_vcpus_mem_from_instance_type(
+            instance_type)
+
+    @classmethod
+    def get_default_instance_type(
+            cls, cpus: Optional[str] = None,
+            memory: Optional[str] = None,
+            disk_tier: Optional[str] = None) -> Optional[str]:
+        return azure_catalog.get_default_instance_type(cpus, memory,
+                                                       disk_tier)
+
+    @classmethod
+    def get_accelerators_from_instance_type(
+            cls, instance_type: str) -> Optional[Dict[str, int]]:
+        return azure_catalog.get_accelerators_from_instance_type(
+            instance_type)
+
+    # ---- feasibility -----------------------------------------------------
+    @classmethod
+    def _get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources',
+        num_nodes: int) -> cloud.FeasibleResources:
+        del num_nodes
+        if resources.tpu_slice is not None:
+            return cloud.FeasibleResources(
+                [], [],
+                'Azure offers no TPUs; TPU slices run on GCP/GKE.')
+        if resources.accelerators is not None:
+            (acc, acc_count), = resources.accelerators.items()
+            instance_types = \
+                azure_catalog.get_instance_type_for_accelerator(
+                    acc, acc_count)
+            if not instance_types:
+                fuzzy = [f'{name} (Azure)' for name in
+                         azure_catalog.list_accelerators(acc[:4])]
+                return cloud.FeasibleResources([], fuzzy[:5], None)
+            return cloud.FeasibleResources(
+                [resources.copy(cloud=cls(), instance_type=it)
+                 for it in instance_types], [], None)
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = cls.get_default_instance_type(
+                resources.cpus, resources.memory, resources.disk_tier)
+        if instance_type is None:
+            return cloud.FeasibleResources(
+                [], [], 'No Azure instance type satisfies '
+                f'cpus={resources.cpus} memory={resources.memory}.')
+        return cloud.FeasibleResources(
+            [resources.copy(cloud=cls(), instance_type=instance_type)],
+            [], None)
+
+    # ---- deploy ----------------------------------------------------------
+    @classmethod
+    def make_deploy_resources_variables(
+            cls, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        # Deploy vars keep the CATALOG zone name ('eastus-1'): it
+        # round-trips through ProvisionRecord.zone into the handle and
+        # back into this method on relaunch (provisioner.py
+        # resources.copy(zone=...)).  The provisioner converts to the
+        # ARM zone number at VM-create time.
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': zones[0].name if zones else None,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,
+            'labels': resources.labels or {},
+            'num_nodes': num_nodes,
+            'ports': resources.ports,
+        }
+
+    # ---- credentials -----------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.azure import auth
+        creds = auth.load_credentials()
+        if creds is None:
+            return False, (
+                'No Azure credentials. Set AZURE_TENANT_ID / '
+                'AZURE_CLIENT_ID / AZURE_CLIENT_SECRET (+ '
+                'AZURE_SUBSCRIPTION_ID), or write '
+                '~/.azure/skytpu_credentials.json.')
+        if auth.subscription_id(creds) is None:
+            return False, ('Azure credentials found but no '
+                           'subscription id; set '
+                           'AZURE_SUBSCRIPTION_ID.')
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.azure import auth
+        creds = auth.load_credentials()
+        if creds is None:
+            return None
+        # client_id is the stable service-principal identity anchor.
+        return [[creds.client_id]]
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        path = os.path.expanduser('~/.azure/skytpu_credentials.json')
+        if os.path.exists(path):
+            return {'~/.azure/skytpu_credentials.json':
+                    '~/.azure/skytpu_credentials.json'}
+        return {}

@@ -1,0 +1,204 @@
+"""Minimal Azure Resource Manager REST client (JSON over urllib).
+
+The reference drives Azure through the azure-mgmt SDKs
+(sky/provision/azure/instance.py); this is the SDK-free equivalent in
+the mold of the first-party GCP/AWS REST clients.  Everything routes
+through `request()`, so tests monkeypatch exactly one seam.
+
+ARM niceties this client leans on:
+  - PUTs are idempotent upserts by resource name;
+  - deleting a resource group tears down everything inside it — the
+    cleanup story the reference needs a dependency-ordered deleter for.
+"""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision.azure import auth
+
+logger = sky_logging.init_logger(__name__)
+
+ARM_HOST = 'https://management.azure.com'
+_TIMEOUT = 60.0
+
+# api-version per resource provider (stable GA versions).
+API_VERSIONS = {
+    'resourcegroups': '2021-04-01',
+    'Microsoft.Compute': '2023-09-01',
+    'Microsoft.Network': '2023-09-01',
+}
+
+# Errors that are definitively NOT capacity (failover won't help).
+_NO_FAILOVER_CODES = {
+    'AuthenticationFailed', 'AuthorizationFailed',
+    'InvalidAuthenticationToken', 'ExpiredAuthenticationToken',
+    'SubscriptionNotFound', 'InvalidSubscriptionId',
+}
+
+
+class AzureApiError(exceptions.ProvisionError):
+
+    def __init__(self, status_code: int, code: str, message: str) -> None:
+        super().__init__(
+            f'Azure API error {status_code} {code}: {message}',
+            no_failover=code in _NO_FAILOVER_CODES)
+        self.status_code = status_code
+        self.code = code
+
+
+_token_cache = auth.TokenCache()
+
+
+def _parse_error(status: int, text: str) -> AzureApiError:
+    try:
+        err = json.loads(text).get('error', {})
+        return AzureApiError(status, err.get('code', 'Unknown'),
+                             err.get('message', text[:300]))
+    except (json.JSONDecodeError, AttributeError):
+        return AzureApiError(status, 'Unknown', text[:300])
+
+
+def request(method: str, path: str, api_version: str,
+            body: Optional[Dict[str, Any]] = None,
+            params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """One ARM call.  `path` starts at /subscriptions/...; returns the
+    parsed JSON body ({} for empty 200/202/204 responses)."""
+    query = {'api-version': api_version}
+    query.update(params or {})
+    url = f'{ARM_HOST}{path}?' + urllib.parse.urlencode(query)
+    return request_url(method, url, body)
+
+
+def request_url(method: str, url: str,
+                body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """ARM call against a pre-built URL (nextLink pagination)."""
+    creds = auth.load_credentials()
+    if creds is None:
+        raise AzureApiError(401, 'AuthenticationFailed',
+                            'no Azure credentials found')
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={
+            'Authorization': f'Bearer {_token_cache.bearer(creds)}',
+            'Content-Type': 'application/json',
+        })
+    try:
+        with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
+            text = resp.read().decode()
+    except urllib.error.HTTPError as e:
+        raise _parse_error(e.code, e.read().decode(errors='replace')) \
+            from None
+    except urllib.error.URLError as e:
+        raise AzureApiError(0, 'Unreachable', str(e)) from None
+    return json.loads(text) if text.strip() else {}
+
+
+def _sub() -> str:
+    sub = auth.subscription_id()
+    if not sub:
+        raise AzureApiError(401, 'SubscriptionNotFound',
+                            'set AZURE_SUBSCRIPTION_ID')
+    return sub
+
+
+def _rg_path(rg: str) -> str:
+    return f'/subscriptions/{_sub()}/resourcegroups/{rg}'
+
+
+# -- resource groups -------------------------------------------------------
+def ensure_resource_group(rg: str, region: str,
+                          tags: Optional[Dict[str, str]] = None) -> None:
+    request('PUT', _rg_path(rg), API_VERSIONS['resourcegroups'],
+            body={'location': region, 'tags': tags or {}})
+
+
+def delete_resource_group(rg: str) -> None:
+    try:
+        request('DELETE', _rg_path(rg),
+                API_VERSIONS['resourcegroups'])
+    except AzureApiError as e:
+        if e.status_code != 404:
+            raise
+
+
+def resource_group_exists(rg: str) -> bool:
+    try:
+        request('GET', _rg_path(rg), API_VERSIONS['resourcegroups'])
+        return True
+    except AzureApiError as e:
+        if e.status_code == 404:
+            return False
+        raise
+
+
+# -- generic compute/network resources -------------------------------------
+def _resource_path(rg: str, provider: str, rtype: str,
+                   name: str = '') -> str:
+    path = f'{_rg_path(rg)}/providers/{provider}/{rtype}'
+    return f'{path}/{name}' if name else path
+
+
+def put_resource(rg: str, provider: str, rtype: str, name: str,
+                 body: Dict[str, Any]) -> Dict[str, Any]:
+    return request('PUT', _resource_path(rg, provider, rtype, name),
+                   API_VERSIONS[provider], body=body)
+
+
+def get_resource(rg: str, provider: str, rtype: str, name: str,
+                 params: Optional[Dict[str, str]] = None
+                 ) -> Dict[str, Any]:
+    return request('GET', _resource_path(rg, provider, rtype, name),
+                   API_VERSIONS[provider], params=params)
+
+
+def delete_resource(rg: str, provider: str, rtype: str,
+                    name: str) -> None:
+    try:
+        request('DELETE', _resource_path(rg, provider, rtype, name),
+                API_VERSIONS[provider])
+    except AzureApiError as e:
+        if e.status_code != 404:
+            raise
+
+
+def list_resources(rg: str, provider: str,
+                   rtype: str) -> List[Dict[str, Any]]:
+    items: List[Dict[str, Any]] = []
+    try:
+        out = request('GET', _resource_path(rg, provider, rtype),
+                      API_VERSIONS[provider])
+        items.extend(out.get('value', []))
+        # ARM pages list responses via nextLink (a full URL) — a
+        # truncated VM list would make stop/terminate skip live VMs.
+        while out.get('nextLink'):
+            out = request_url('GET', out['nextLink'])
+            items.extend(out.get('value', []))
+    except AzureApiError as e:
+        if e.status_code == 404:  # resource group gone
+            return []
+        raise
+    return items
+
+
+def vm_instance_view(rg: str, name: str) -> Dict[str, Any]:
+    return request(
+        'GET',
+        _resource_path(rg, 'Microsoft.Compute', 'virtualMachines',
+                       f'{name}/instanceView'),
+        API_VERSIONS['Microsoft.Compute'])
+
+
+def vm_action(rg: str, name: str, action: str) -> None:
+    """start | deallocate | restart."""
+    request(
+        'POST',
+        _resource_path(rg, 'Microsoft.Compute', 'virtualMachines',
+                       f'{name}/{action}'),
+        API_VERSIONS['Microsoft.Compute'])

@@ -1,0 +1,100 @@
+"""Azure credentials + ARM bearer tokens, stdlib-only.
+
+The reference authenticates through the azure SDKs
+(sky/adaptors/azure.py); no SDK here, so tokens come from the OAuth2
+client-credentials grant against Microsoft Entra ID (the documented
+service-principal flow):
+
+    POST https://login.microsoftonline.com/{tenant}/oauth2/v2.0/token
+         grant_type=client_credentials&scope=https://management.azure.com/.default
+
+Credential sources, in order (same contract as the SDKs'
+EnvironmentCredential):
+  - env: AZURE_TENANT_ID + AZURE_CLIENT_ID + AZURE_CLIENT_SECRET
+    (+ AZURE_SUBSCRIPTION_ID for the target subscription)
+  - ~/.azure/skytpu_credentials.json written by the operator:
+    {"tenant_id": ..., "client_id": ..., "client_secret": ...,
+     "subscription_id": ...}
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+ARM_SCOPE = 'https://management.azure.com/.default'
+_CRED_FILE = '~/.azure/skytpu_credentials.json'
+
+
+@dataclasses.dataclass(frozen=True)
+class Credentials:
+    tenant_id: str
+    client_id: str
+    client_secret: str
+    subscription_id: Optional[str] = None
+
+
+def load_credentials() -> Optional[Credentials]:
+    tenant = os.environ.get('AZURE_TENANT_ID')
+    client = os.environ.get('AZURE_CLIENT_ID')
+    secret = os.environ.get('AZURE_CLIENT_SECRET')
+    if tenant and client and secret:
+        return Credentials(tenant, client, secret,
+                           os.environ.get('AZURE_SUBSCRIPTION_ID'))
+    path = os.path.expanduser(
+        os.environ.get('AZURE_CREDENTIALS_FILE', _CRED_FILE))
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            data = json.load(f)
+        return Credentials(data['tenant_id'], data['client_id'],
+                           data['client_secret'],
+                           data.get('subscription_id'))
+    except (json.JSONDecodeError, KeyError, OSError):
+        return None
+
+
+def subscription_id(creds: Optional[Credentials] = None) -> Optional[str]:
+    sub = os.environ.get('AZURE_SUBSCRIPTION_ID')
+    if sub:
+        return sub
+    creds = creds or load_credentials()
+    return creds.subscription_id if creds else None
+
+
+class TokenCache:
+    """One bearer token per (tenant, client), refreshed before expiry.
+    `http_post` is injectable for tests."""
+
+    def __init__(self, http_post: Optional[Callable[..., Dict[str, Any]]]
+                 = None) -> None:
+        self._token: Optional[str] = None
+        self._expires_at = 0.0
+        self._http_post = http_post or _post_form
+
+    def bearer(self, creds: Credentials) -> str:
+        if self._token is None or time.time() > self._expires_at - 120:
+            url = (f'https://login.microsoftonline.com/'
+                   f'{creds.tenant_id}/oauth2/v2.0/token')
+            resp = self._http_post(url, {
+                'grant_type': 'client_credentials',
+                'client_id': creds.client_id,
+                'client_secret': creds.client_secret,
+                'scope': ARM_SCOPE,
+            })
+            self._token = resp['access_token']
+            self._expires_at = time.time() + float(
+                resp.get('expires_in', 3600))
+        return self._token
+
+
+def _post_form(url: str, form: Dict[str, str]) -> Dict[str, Any]:
+    data = urllib.parse.urlencode(form).encode()
+    req = urllib.request.Request(url, data=data, method='POST')
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())

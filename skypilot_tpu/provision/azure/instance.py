@@ -1,0 +1,447 @@
+"""Azure VM provisioner: the uniform provision interface over arm_api.
+
+Counterpart of the reference's sky/provision/azure/instance.py (azure
+SDK, 1,332 LoC); same lifecycle semantics as the AWS impl —
+idempotent run_instances that resumes deallocated nodes first,
+tag-scoped queries, head-node election by lowest VM name — over the
+SDK-free ARM client.
+
+Azure mapping choices:
+  - one RESOURCE GROUP per cluster ('skytpu-<cluster>'): terminate =
+    delete the group, which tears down VMs/NICs/IPs/disks atomically
+    (no dependency-ordered deletion machinery needed);
+  - 'stop' = deallocate (stops billing, keeps disks — the semantic
+    the framework's autostop expects);
+  - spot = Spot priority with Deallocate eviction.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.azure import arm_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'azure'
+_CLUSTER_TAG = 'skytpu-cluster'
+_COMPUTE = 'Microsoft.Compute'
+_NETWORK = 'Microsoft.Network'
+_ADMIN_USER = 'azureuser'
+
+# Ubuntu 22.04 LTS Gen2 (Canonical's marketplace image, all regions).
+_IMAGE_REFERENCE = {
+    'publisher': 'Canonical',
+    'offer': '0001-com-ubuntu-server-jammy',
+    'sku': '22_04-lts-gen2',
+    'version': 'latest',
+}
+
+def _arm_zone(zone: Optional[str]) -> Optional[str]:
+    """Catalog zone name ('eastus-1') -> ARM zone number ('1').
+    Accepts a bare number too (older handles)."""
+    if not zone:
+        return None
+    return zone.rsplit('-', 1)[1] if '-' in zone else zone
+
+
+def _image_reference(node_cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """User image_id -> ARM imageReference.
+
+    Accepted forms (else the Ubuntu 22.04 default):
+      - '/subscriptions/.../images/...'  (managed image / gallery id)
+      - 'publisher:offer:sku[:version]'  (marketplace urn)
+    """
+    image_id = node_cfg.get('image_id')
+    if not image_id:
+        return dict(node_cfg.get('image_reference') or _IMAGE_REFERENCE)
+    if image_id.startswith('/'):
+        return {'id': image_id}
+    parts = image_id.split(':')
+    if len(parts) in (3, 4):
+        return {'publisher': parts[0], 'offer': parts[1],
+                'sku': parts[2],
+                'version': parts[3] if len(parts) == 4 else 'latest'}
+    raise exceptions.ProvisionError(
+        f'Azure image_id {image_id!r} is neither an ARM resource id '
+        "(/subscriptions/...) nor a marketplace urn "
+        "('publisher:offer:sku[:version]').")
+
+
+_CAPACITY_ERROR_CODES = {
+    'SkuNotAvailable', 'AllocationFailed', 'ZonalAllocationFailed',
+    'OverconstrainedAllocationRequest', 'QuotaExceeded',
+    'OperationNotAllowed', 'SpotQuotaExceeded',
+}
+
+
+def _classify(e: arm_api.AzureApiError) -> Exception:
+    if e.code in _CAPACITY_ERROR_CODES:
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _rg(cluster_name_on_cloud: str,
+        provider_config: Optional[Dict[str, Any]] = None) -> str:
+    if provider_config and provider_config.get('resource_group'):
+        return provider_config['resource_group']
+    return f'skytpu-{cluster_name_on_cloud}'
+
+
+def _region(provider_config: Optional[Dict[str, Any]]) -> str:
+    assert provider_config and provider_config.get('region'), \
+        'Azure provider_config must carry region'
+    return provider_config['region']
+
+
+def _vm_name(cluster: str, idx: int) -> str:
+    return f'{cluster}-{idx:04d}'
+
+
+def _public_key(auth_config: Dict[str, Any]) -> Optional[str]:
+    ssh_keys = (auth_config or {}).get('ssh_keys', '')
+    if ':' not in ssh_keys:
+        return None
+    return ssh_keys.split(':', 1)[1]
+
+
+def _ensure_network(rg: str, region: str) -> str:
+    """VNet + subnet + ssh-open NSG (idempotent PUTs); returns the
+    subnet resource id."""
+    arm_api.put_resource(rg, _NETWORK, 'networkSecurityGroups',
+                         'skytpu-nsg', {
+                             'location': region,
+                             'properties': {'securityRules': [{
+                                 'name': 'allow-ssh',
+                                 'properties': {
+                                     'priority': 1000,
+                                     'direction': 'Inbound',
+                                     'access': 'Allow',
+                                     'protocol': 'Tcp',
+                                     'sourcePortRange': '*',
+                                     'destinationPortRange': '22',
+                                     'sourceAddressPrefix': '*',
+                                     'destinationAddressPrefix': '*',
+                                 },
+                             }]},
+                         })
+    vnet = arm_api.put_resource(rg, _NETWORK, 'virtualNetworks',
+                                'skytpu-vnet', {
+                                    'location': region,
+                                    'properties': {
+                                        'addressSpace': {
+                                            'addressPrefixes':
+                                                ['10.42.0.0/16']},
+                                        'subnets': [{
+                                            'name': 'default',
+                                            'properties': {
+                                                'addressPrefix':
+                                                    '10.42.0.0/24'},
+                                        }],
+                                    },
+                                })
+    subnets = vnet.get('properties', {}).get('subnets', [])
+    if subnets and subnets[0].get('id'):
+        return subnets[0]['id']
+    return (f"{vnet.get('id', '')}/subnets/default")
+
+
+def _create_vm(rg: str, region: str, name: str, node_cfg: Dict[str, Any],
+               subnet_id: str, tags: Dict[str, str],
+               public_key: Optional[str],
+               zone: Optional[str]) -> None:
+    ip = arm_api.put_resource(rg, _NETWORK, 'publicIPAddresses',
+                              f'{name}-ip', {
+                                  'location': region,
+                                  'sku': {'name': 'Standard'},
+                                  'properties': {
+                                      'publicIPAllocationMethod':
+                                          'Static'},
+                              })
+    nic = arm_api.put_resource(rg, _NETWORK, 'networkInterfaces',
+                               f'{name}-nic', {
+                                   'location': region,
+                                   'properties': {
+                                       'ipConfigurations': [{
+                                           'name': 'primary',
+                                           'properties': {
+                                               'subnet': {
+                                                   'id': subnet_id},
+                                               'publicIPAddress': {
+                                                   'id': ip.get('id')},
+                                           },
+                                       }],
+                                   },
+                               })
+    os_profile: Dict[str, Any] = {
+        'computerName': name,
+        'adminUsername': _ADMIN_USER,
+        'linuxConfiguration': {'disablePasswordAuthentication': True},
+    }
+    if public_key:
+        os_profile['linuxConfiguration']['ssh'] = {'publicKeys': [{
+            'path': f'/home/{_ADMIN_USER}/.ssh/authorized_keys',
+            'keyData': public_key,
+        }]}
+    body: Dict[str, Any] = {
+        'location': region,
+        'tags': tags,
+        'properties': {
+            'hardwareProfile': {
+                'vmSize': node_cfg['instance_type']},
+            'storageProfile': {
+                'imageReference': _image_reference(node_cfg),
+                'osDisk': {
+                    'createOption': 'FromImage',
+                    'diskSizeGB': int(node_cfg.get('disk_size')
+                                      or 256),
+                    'managedDisk': {
+                        'storageAccountType': 'Premium_LRS'},
+                },
+            },
+            'osProfile': os_profile,
+            'networkProfile': {
+                'networkInterfaces': [{'id': nic.get('id')}]},
+        },
+    }
+    if node_cfg.get('use_spot'):
+        body['properties']['priority'] = 'Spot'
+        body['properties']['evictionPolicy'] = 'Deallocate'
+        body['properties']['billingProfile'] = {'maxPrice': -1}
+    arm_zone = _arm_zone(zone)
+    if arm_zone:
+        body['zones'] = [arm_zone]
+    arm_api.put_resource(rg, _COMPUTE, 'virtualMachines', name, body)
+
+
+def _power_state(rg: str, name: str) -> str:
+    view = arm_api.vm_instance_view(rg, name)
+    for status in view.get('statuses', []):
+        code = str(status.get('code', ''))
+        if code.startswith('PowerState/'):
+            return code.split('/', 1)[1]
+    return 'unknown'
+
+
+def _cluster_vms(rg: str,
+                 cluster_name_on_cloud: str) -> List[Dict[str, Any]]:
+    vms = arm_api.list_resources(rg, _COMPUTE, 'virtualMachines')
+    return sorted(
+        (vm for vm in vms
+         if vm.get('tags', {}).get(_CLUSTER_TAG)
+         == cluster_name_on_cloud),
+        key=lambda vm: vm.get('name', ''))
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    rg = _rg(cluster_name_on_cloud, config.provider_config)
+    zone = node_cfg.get('zone')
+    tags = {_CLUSTER_TAG: cluster_name_on_cloud}
+    tags.update({k: str(v) for k, v in config.tags.items()})
+    try:
+        arm_api.ensure_resource_group(rg, region, tags)
+        subnet_id = _ensure_network(rg, region)
+        existing = _cluster_vms(rg, cluster_name_on_cloud)
+        states = {vm['name']: _power_state(rg, vm['name'])
+                  for vm in existing}
+        running = [n for n, s in states.items()
+                   if s in ('running', 'starting')]
+        stopped = [n for n, s in states.items()
+                   if s in ('deallocated', 'stopped')]
+
+        resumed: List[str] = []
+        if config.resume_stopped_nodes and stopped:
+            need = config.count - len(running)
+            for name in sorted(stopped)[:max(need, 0)]:
+                arm_api.vm_action(rg, name, 'start')
+                resumed.append(name)
+                running.append(name)
+
+        created: List[str] = []
+        taken = set(states)
+        idx = 0
+        public_key = _public_key(config.authentication_config)
+        while len(running) + len(created) < config.count:
+            name = _vm_name(cluster_name_on_cloud, idx)
+            idx += 1
+            if name in taken:
+                continue
+            _create_vm(rg, region, name, node_cfg, subnet_id, tags,
+                       public_key, zone)
+            created.append(name)
+    except arm_api.AzureApiError as e:
+        raise _classify(e) from None
+
+    names = sorted(running + created)
+    if not names:
+        raise exceptions.ResourcesUnavailableError(
+            f'Azure returned no VMs for {cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER,
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        head_instance_id=names[0],
+        resumed_instance_ids=resumed,
+        created_instance_ids=created,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    rg = _rg(cluster_name_on_cloud, provider_config)
+    names = [vm['name'] for vm in _cluster_vms(rg,
+                                               cluster_name_on_cloud)]
+    if worker_only and names:
+        names = sorted(names)[1:]
+    for name in names:
+        if _power_state(rg, name) in ('running', 'starting'):
+            arm_api.vm_action(rg, name, 'deallocate')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    rg = _rg(cluster_name_on_cloud, provider_config)
+    if not worker_only:
+        # The whole cluster lives in its own resource group: one
+        # delete reaps VMs, NICs, IPs, and disks.
+        arm_api.delete_resource_group(rg)
+        return
+    for name in sorted(
+            vm['name']
+            for vm in _cluster_vms(rg, cluster_name_on_cloud))[1:]:
+        arm_api.delete_resource(rg, _COMPUTE, 'virtualMachines', name)
+        arm_api.delete_resource(rg, _NETWORK, 'networkInterfaces',
+                                f'{name}-nic')
+        arm_api.delete_resource(rg, _NETWORK, 'publicIPAddresses',
+                                f'{name}-ip')
+
+
+_STATUS_MAP = {
+    'running': 'running',
+    'starting': 'pending',
+    'stopping': 'stopping',
+    'stopped': 'stopped',
+    'deallocating': 'stopping',
+    'deallocated': 'stopped',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    rg = _rg(cluster_name_on_cloud, provider_config)
+    out: Dict[str, Optional[str]] = {}
+    for vm in _cluster_vms(rg, cluster_name_on_cloud):
+        status = _STATUS_MAP.get(_power_state(rg, vm['name']))
+        if non_terminated_only and status is None:
+            continue
+        out[vm['name']] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 900.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud, None,
+                                   non_terminated_only=False)
+        live = [s for s in statuses.values() if s is not None]
+        if live and all(s == state for s in live):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: VMs did not reach {state!r} '
+        f'within {timeout}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    rg = _rg(cluster_name_on_cloud, provider_config)
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for vm in _cluster_vms(rg, cluster_name_on_cloud):
+        name = vm['name']
+        if _power_state(rg, name) != 'running':
+            continue
+        internal, external = '', None
+        try:
+            nic = arm_api.get_resource(rg, _NETWORK,
+                                       'networkInterfaces',
+                                       f'{name}-nic')
+            ip_cfgs = nic.get('properties', {}).get(
+                'ipConfigurations', [])
+            if ip_cfgs:
+                internal = str(ip_cfgs[0].get('properties', {}).get(
+                    'privateIPAddress', ''))
+            ip = arm_api.get_resource(rg, _NETWORK,
+                                      'publicIPAddresses',
+                                      f'{name}-ip')
+            external = ip.get('properties', {}).get('ipAddress')
+        except arm_api.AzureApiError:
+            pass
+        instances[name] = [common.InstanceInfo(
+            instance_id=name,
+            internal_ip=internal,
+            external_ip=external,
+            tags=dict(vm.get('tags', {})),
+        )]
+    head = sorted(instances)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head,
+        provider_name=_PROVIDER,
+        provider_config=provider_config,
+        ssh_user=_ADMIN_USER,
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    rg = _rg(cluster_name_on_cloud, provider_config)
+    # Priorities must be unique across ALL existing rules, including
+    # ones from earlier open_ports calls — read the NSG and allocate
+    # the next free slots (re-opening the same port is a no-op PUT of
+    # the same rule).
+    nsg = arm_api.get_resource(rg, _NETWORK, 'networkSecurityGroups',
+                               'skytpu-nsg')
+    existing = nsg.get('properties', {}).get('securityRules', [])
+    used = {int(r.get('properties', {}).get('priority', 0))
+            for r in existing}
+    by_name = {r.get('name') for r in existing}
+    next_priority = 1100
+    for port in ports:
+        rule_name = f'allow-{port}'.replace(':', '-')
+        if rule_name in by_name:
+            continue
+        while next_priority in used:
+            next_priority += 1
+        used.add(next_priority)
+        arm_api.put_resource(
+            rg, _NETWORK,
+            'networkSecurityGroups/skytpu-nsg/securityRules',
+            rule_name, {
+                'properties': {
+                    'priority': next_priority,
+                    'direction': 'Inbound',
+                    'access': 'Allow',
+                    'protocol': 'Tcp',
+                    'sourcePortRange': '*',
+                    'destinationPortRange': str(port),
+                    'sourceAddressPrefix': '*',
+                    'destinationAddressPrefix': '*',
+                },
+            })
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

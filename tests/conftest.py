@@ -35,8 +35,12 @@ def _isolated_state(tmp_path, monkeypatch):
     # Reset module-level caches that capture state paths.
     import skypilot_tpu.config as config_lib
     config_lib.reload()
+    from skypilot_tpu.catalog import aws_catalog
+    from skypilot_tpu.catalog import azure_catalog
     from skypilot_tpu.catalog import gcp_catalog
     gcp_catalog.reload()
+    aws_catalog.reload()
+    azure_catalog.reload()
     try:
         from skypilot_tpu import global_user_state
         global_user_state.reset_for_tests()

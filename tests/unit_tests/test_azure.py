@@ -1,0 +1,462 @@
+"""Azure cloud tests: token flow, ARM client error classification,
+provision lifecycle over an in-memory ARM, catalog + 3-cloud optimizer
+placement — the AWS-mold test set (test_aws.py) for the third cloud."""
+import json
+import re
+import urllib.error
+
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.catalog import azure_catalog
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.azure import arm_api
+from skypilot_tpu.provision.azure import auth
+from skypilot_tpu.provision.azure import instance as az_instance
+
+Resources = resources_lib.Resources
+
+
+@pytest.fixture(autouse=True)
+def _azure_creds(monkeypatch):
+    monkeypatch.setenv('AZURE_TENANT_ID', 'tenant')
+    monkeypatch.setenv('AZURE_CLIENT_ID', 'client')
+    monkeypatch.setenv('AZURE_CLIENT_SECRET', 'secret')
+    monkeypatch.setenv('AZURE_SUBSCRIPTION_ID', 'sub-1234')
+
+
+class TestAuth:
+
+    def test_token_cache_refreshes_before_expiry(self):
+        calls = []
+
+        def fake_post(url, form):
+            calls.append((url, form))
+            return {'access_token': f'tok{len(calls)}',
+                    'expires_in': 3600}
+
+        cache = auth.TokenCache(http_post=fake_post)
+        creds = auth.load_credentials()
+        assert cache.bearer(creds) == 'tok1'
+        assert cache.bearer(creds) == 'tok1'  # cached
+        assert len(calls) == 1
+        url, form = calls[0]
+        assert 'login.microsoftonline.com/tenant' in url
+        assert form['grant_type'] == 'client_credentials'
+        assert form['scope'] == auth.ARM_SCOPE
+        cache._expires_at = 0  # force expiry
+        assert cache.bearer(creds) == 'tok2'
+
+    def test_credentials_from_file(self, tmp_path, monkeypatch):
+        for var in ('AZURE_TENANT_ID', 'AZURE_CLIENT_ID',
+                    'AZURE_CLIENT_SECRET', 'AZURE_SUBSCRIPTION_ID'):
+            monkeypatch.delenv(var, raising=False)
+        path = tmp_path / 'creds.json'
+        path.write_text(json.dumps({
+            'tenant_id': 't', 'client_id': 'c', 'client_secret': 's',
+            'subscription_id': 'filesub'}))
+        monkeypatch.setenv('AZURE_CREDENTIALS_FILE', str(path))
+        creds = auth.load_credentials()
+        assert creds.client_id == 'c'
+        assert auth.subscription_id(creds) == 'filesub'
+
+    def test_no_creds(self, tmp_path, monkeypatch):
+        for var in ('AZURE_TENANT_ID', 'AZURE_CLIENT_ID',
+                    'AZURE_CLIENT_SECRET'):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv('AZURE_CREDENTIALS_FILE',
+                           str(tmp_path / 'nope.json'))
+        assert auth.load_credentials() is None
+
+
+class TestArmErrors:
+
+    def test_capacity_error_classified_for_failover(self):
+        err = arm_api.AzureApiError(409, 'SkuNotAvailable',
+                                    'not available in eastus')
+        assert not err.no_failover
+        assert isinstance(az_instance._classify(err),
+                          exceptions.ResourcesUnavailableError)
+
+    def test_auth_error_no_failover(self):
+        err = arm_api.AzureApiError(401, 'AuthenticationFailed', 'bad')
+        assert err.no_failover
+        assert az_instance._classify(err) is err
+
+    def test_error_body_parsed(self, monkeypatch):
+        import io
+
+        def fake_urlopen(req, timeout=None):
+            raise urllib.error.HTTPError(
+                req.full_url, 409, 'Conflict', {},
+                io.BytesIO(json.dumps({'error': {
+                    'code': 'QuotaExceeded',
+                    'message': 'Family vCPU quota'}}).encode()))
+
+        monkeypatch.setattr(arm_api.urllib.request, 'urlopen',
+                            fake_urlopen)
+        monkeypatch.setattr(arm_api._token_cache, 'bearer',
+                            lambda creds: 'tok')
+        with pytest.raises(arm_api.AzureApiError) as e:
+            arm_api.request('GET', '/subscriptions/sub-1234', 'v')
+        assert e.value.code == 'QuotaExceeded'
+
+
+class FakeArm:
+    """In-memory ARM: resource groups + nested resources + VM power
+    states, behind the single arm_api.request seam."""
+
+    def __init__(self):
+        self.rgs = {}           # rg -> {resources: {path: body}}
+        self.power = {}         # (rg, vm) -> state
+
+    def request(self, method, path, api_version, body=None, params=None):
+        del api_version, params
+        parts = [p for p in path.split('/') if p]
+        assert parts[0] == 'subscriptions'
+        if len(parts) == 4 and parts[2] == 'resourcegroups':
+            rg = parts[3]
+            if method == 'PUT':
+                self.rgs.setdefault(rg, {'resources': {}})
+                return {'name': rg}
+            if method == 'GET':
+                if rg not in self.rgs:
+                    raise arm_api.AzureApiError(
+                        404, 'ResourceGroupNotFound', rg)
+                return {'name': rg}
+            if method == 'DELETE':
+                self.rgs.pop(rg, None)
+                self.power = {k: v for k, v in self.power.items()
+                              if k[0] != rg}
+                return {}
+        assert parts[4] == 'providers'
+        rg, rest = parts[3], '/'.join(parts[6:])
+        if rg not in self.rgs:
+            raise arm_api.AzureApiError(404, 'ResourceGroupNotFound',
+                                        rg)
+        store = self.rgs[rg]['resources']
+        if method == 'POST':
+            rest, action = rest.rsplit('/', 1)
+            assert action in ('start', 'deallocate', 'restart')
+            vm = rest.rsplit('/', 1)[1]
+            self.power[(rg, vm)] = 'running' if action != 'deallocate' \
+                else 'deallocated'
+            return {}
+        if method == 'GET' and rest.endswith('/instanceView'):
+            vm = rest.split('/')[-2]
+            state = self.power.get((rg, vm), 'unknown')
+            return {'statuses': [
+                {'code': 'ProvisioningState/succeeded'},
+                {'code': f'PowerState/{state}'}]}
+        if method == 'PUT':
+            name = rest.rsplit('/', 1)[1]
+            record = dict(body or {})
+            record.setdefault('name', name)
+            record['id'] = f'/fake/{rg}/{rest}'
+            if rest.endswith('virtualNetworks/skytpu-vnet'):
+                for s in record.get('properties', {}).get('subnets',
+                                                          []):
+                    s['id'] = record['id'] + '/subnets/' + s['name']
+            store[rest] = record
+            if parts[5] == 'Microsoft.Compute' and \
+                    rest.startswith('virtualMachines/'):
+                self.power[(rg, name)] = 'running'
+            return record
+        if method == 'GET':
+            if rest in store:
+                return store[rest]
+            # List: direct children of the collection prefix.
+            items = [v for k, v in store.items()
+                     if k.startswith(rest + '/')
+                     and '/' not in k[len(rest) + 1:]]
+            return {'value': items}
+        if method == 'DELETE':
+            store.pop(rest, None)
+            if rest.startswith('virtualMachines/'):
+                self.power.pop((rg, rest.split('/', 1)[1]), None)
+            return {}
+        raise AssertionError(f'unhandled {method} {path}')
+
+
+@pytest.fixture()
+def fake_arm(monkeypatch):
+    fake = FakeArm()
+    monkeypatch.setattr(arm_api, 'request', fake.request)
+    monkeypatch.setattr(az_instance.time, 'sleep', lambda s: None)
+    return fake
+
+
+def _pconfig(count=1, resume=False, **node):
+    node_cfg = {'instance_type': 'Standard_D8s_v5', 'zone': '1',
+                'use_spot': False, 'disk_size': 100}
+    node_cfg.update(node)
+    return provision_common.ProvisionConfig(
+        provider_config={'region': 'eastus'},
+        authentication_config={
+            'ssh_keys': 'skytpu:ssh-ed25519 AAAA key'},
+        docker_config={}, node_config=node_cfg, count=count, tags={},
+        resume_stopped_nodes=resume)
+
+
+class TestAzureProvisioner:
+
+    def test_run_stop_resume_terminate_lifecycle(self, fake_arm):
+        record = az_instance.run_instances('eastus', 'c1',
+                                           _pconfig(count=2))
+        assert len(record.created_instance_ids) == 2
+        assert record.head_instance_id == 'c1-0000'
+        # Network scaffolding exists in the cluster's resource group.
+        store = fake_arm.rgs['skytpu-c1']['resources']
+        assert 'virtualNetworks/skytpu-vnet' in store
+        assert 'networkSecurityGroups/skytpu-nsg' in store
+        # Each VM has its NIC + public IP + the SSH public key.
+        vm = store['virtualMachines/c1-0000']
+        ssh = vm['properties']['osProfile']['linuxConfiguration']['ssh']
+        assert 'ssh-ed25519 AAAA key' in \
+            ssh['publicKeys'][0]['keyData']
+        assert 'networkInterfaces/c1-0000-nic' in store
+        assert vm['zones'] == ['1']
+
+        info = az_instance.get_cluster_info('eastus', 'c1',
+                                            {'region': 'eastus'})
+        assert info.ssh_user == 'azureuser'
+        assert len(info.instances) == 2
+
+        az_instance.stop_instances('c1', {'region': 'eastus'})
+        statuses = az_instance.query_instances(
+            'c1', {'region': 'eastus'}, non_terminated_only=False)
+        assert set(statuses.values()) == {'stopped'}
+
+        record2 = az_instance.run_instances(
+            'eastus', 'c1', _pconfig(count=2, resume=True))
+        assert sorted(record2.resumed_instance_ids) == ['c1-0000',
+                                                        'c1-0001']
+        assert record2.created_instance_ids == []
+
+        az_instance.terminate_instances('c1', {'region': 'eastus'})
+        assert 'skytpu-c1' not in fake_arm.rgs
+        assert az_instance.query_instances(
+            'c1', {'region': 'eastus'}) == {}
+
+    def test_worker_only_stop_keeps_head(self, fake_arm):
+        az_instance.run_instances('eastus', 'c2', _pconfig(count=3))
+        az_instance.stop_instances('c2', {'region': 'eastus'},
+                                   worker_only=True)
+        statuses = az_instance.query_instances(
+            'c2', {'region': 'eastus'}, non_terminated_only=False)
+        assert statuses['c2-0000'] == 'running'
+        assert statuses['c2-0001'] == statuses['c2-0002'] == 'stopped'
+
+    def test_worker_only_terminate_keeps_head(self, fake_arm):
+        az_instance.run_instances('eastus', 'c3', _pconfig(count=2))
+        az_instance.terminate_instances('c3', {'region': 'eastus'},
+                                        worker_only=True)
+        statuses = az_instance.query_instances('c3',
+                                               {'region': 'eastus'})
+        assert list(statuses) == ['c3-0000']
+
+    def test_spot_priority_on_body(self, fake_arm):
+        az_instance.run_instances('eastus', 'c4',
+                                  _pconfig(use_spot=True))
+        vm = fake_arm.rgs['skytpu-c4']['resources'][
+            'virtualMachines/c4-0000']
+        assert vm['properties']['priority'] == 'Spot'
+        assert vm['properties']['evictionPolicy'] == 'Deallocate'
+
+    def test_capacity_error_becomes_failover(self, fake_arm,
+                                             monkeypatch):
+        def deny(*a, **k):
+            raise arm_api.AzureApiError(409, 'AllocationFailed',
+                                        'no capacity')
+        monkeypatch.setattr(az_instance.arm_api, 'put_resource', deny)
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            az_instance.run_instances('eastus', 'c5', _pconfig())
+
+
+class TestAzureCatalogAndCloud:
+
+    def test_default_instance_type(self):
+        assert azure_catalog.get_default_instance_type('8+') == \
+            'Standard_D8s_v5'
+
+    def test_gpu_lookup(self):
+        assert azure_catalog.get_instance_type_for_accelerator(
+            'A100', 8) == ['Standard_ND96asr_v4']
+        cost = azure_catalog.get_accelerator_hourly_cost(
+            'T4', 1, use_spot=False, region='eastus')
+        assert cost == pytest.approx(0.5260)
+
+    def test_region_multiplier_and_zones(self):
+        base = azure_catalog.get_hourly_cost('Standard_D8s_v5', False,
+                                             'eastus')
+        eu = azure_catalog.get_hourly_cost('Standard_D8s_v5', False,
+                                           'westeurope')
+        assert eu == pytest.approx(base * 1.15)
+        assert azure_catalog.zone_to_region('eastus-2') == 'eastus'
+        assert azure_catalog.zone_number('eastus-2') == '2'
+
+    def test_cloud_feasibility_and_deploy_vars(self):
+        azure = registry.CLOUD_REGISTRY.from_str('azure')
+        feasible = azure.get_feasible_launchable_resources(
+            Resources(cpus='16+'))
+        types = [r.instance_type for r in feasible.resources_list]
+        assert 'Standard_D16s_v5' in types or \
+            'Standard_F16s_v2' in types
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        variables = azure.make_deploy_resources_variables(
+            Resources(cloud='azure',
+                      instance_type='Standard_D8s_v5'), 'c',
+            cloud_lib.Region('eastus'),
+            [cloud_lib.Zone('eastus-1', 'eastus')], 1)
+        # Catalog zone name (round-trips through the handle); the
+        # provisioner converts to the ARM number at VM create.
+        assert variables['zone'] == 'eastus-1'
+
+    def test_tpu_refused(self):
+        azure = registry.CLOUD_REGISTRY.from_str('azure')
+        feasible = azure.get_feasible_launchable_resources(
+            Resources(accelerators='tpu-v5e-8'))
+        assert feasible.resources_list == []
+        assert 'no TPUs' in feasible.hint
+
+    def test_optimizer_places_three_cloud_dag(self):
+        """A 3-task chain lands on all three clouds when pinned, and
+        the free CPU stage picks the globally cheapest offering."""
+        global_user_state.set_enabled_clouds(['gcp', 'aws', 'azure'])
+        with dag_lib.Dag() as d:
+            a = task_lib.Task('prep', run='x')
+            a.set_resources(Resources(cloud='aws', cpus='8+'))
+            b = task_lib.Task('train', run='x')
+            b.set_resources(Resources(cloud='gcp',
+                                      accelerators='tpu-v5e-8'))
+            c = task_lib.Task('serve', run='x')
+            c.set_resources(Resources(cloud='azure',
+                                      accelerators='T4:1'))
+            a >> b
+            b >> c
+        optimizer_lib.optimize(d, quiet=True)
+        assert a.best_resources.cloud.canonical_name() == 'aws'
+        assert b.best_resources.cloud.canonical_name() == 'gcp'
+        assert c.best_resources.cloud.canonical_name() == 'azure'
+        assert c.best_resources.instance_type == \
+            'Standard_NC4as_T4_v3'
+
+    def test_optimizer_free_choice_includes_azure(self):
+        global_user_state.set_enabled_clouds(['gcp', 'aws', 'azure'])
+        t = task_lib.Task('t', run='x')
+        t.set_resources(Resources(cpus='8+'))
+        with dag_lib.Dag() as d:
+            d.add(t)
+        optimizer_lib.optimize(d, quiet=True)
+        # gcp e2-standard-8 (0.2681) < azure/aws D8s/m6i (0.384).
+        assert t.best_resources.cloud.canonical_name() == 'gcp'
+
+    def test_check_credentials_gated(self, monkeypatch, tmp_path):
+        azure = registry.CLOUD_REGISTRY.from_str('azure')
+        ok, _ = azure.check_credentials()
+        assert ok
+        monkeypatch.delenv('AZURE_SUBSCRIPTION_ID')
+        ok, msg = azure.check_credentials()
+        assert not ok and 'subscription' in msg.lower()
+        for var in ('AZURE_TENANT_ID', 'AZURE_CLIENT_ID',
+                    'AZURE_CLIENT_SECRET'):
+            monkeypatch.delenv(var)
+        monkeypatch.setenv('AZURE_CREDENTIALS_FILE',
+                           str(tmp_path / 'nope.json'))
+        ok, msg = azure.check_credentials()
+        assert not ok and 'credentials' in msg.lower()
+
+    def test_cluster_name_length_cap(self):
+        azure = registry.CLOUD_REGISTRY.from_str('azure')
+        assert azure.MAX_CLUSTER_NAME_LEN_LIMIT <= 42
+
+
+class TestReviewRegressions:
+
+    def test_zone_round_trips_through_provision_record(self, fake_arm):
+        """Deploy vars carry the catalog zone name; the record echoes
+        it so resources.copy(zone=...) re-enters deploy vars safely."""
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        azure = registry.CLOUD_REGISTRY.from_str('azure')
+        variables = azure.make_deploy_resources_variables(
+            Resources(cloud='azure',
+                      instance_type='Standard_D8s_v5'), 'c6',
+            cloud_lib.Region('eastus'),
+            [cloud_lib.Zone('eastus-2', 'eastus')], 1)
+        assert variables['zone'] == 'eastus-2'
+        cfg = provision_common.ProvisionConfig(
+            provider_config={'region': 'eastus'},
+            authentication_config={}, docker_config={},
+            node_config=variables, count=1, tags={},
+            resume_stopped_nodes=False)
+        record = az_instance.run_instances('eastus', 'c6', cfg)
+        assert record.zone == 'eastus-2'
+        # ARM body got the zone NUMBER.
+        vm = fake_arm.rgs['skytpu-c6']['resources'][
+            'virtualMachines/c6-0000']
+        assert vm['zones'] == ['2']
+        # And the round-tripped zone re-renders fine + prices right.
+        variables2 = azure.make_deploy_resources_variables(
+            Resources(cloud='azure', instance_type='Standard_D8s_v5',
+                      zone=record.zone), 'c6',
+            cloud_lib.Region('eastus'),
+            [cloud_lib.Zone(record.zone, 'eastus')], 1)
+        assert variables2['zone'] == 'eastus-2'
+        assert azure_catalog.get_hourly_cost(
+            'Standard_D8s_v5', False,
+            zone=record.zone) == pytest.approx(0.3840)
+
+    def test_custom_image_urn_and_id(self, fake_arm):
+        az_instance.run_instances(
+            'eastus', 'c7',
+            _pconfig(image_id='Canonical:ubuntu-24_04-lts:server'))
+        vm = fake_arm.rgs['skytpu-c7']['resources'][
+            'virtualMachines/c7-0000']
+        ref = vm['properties']['storageProfile']['imageReference']
+        assert ref == {'publisher': 'Canonical',
+                       'offer': 'ubuntu-24_04-lts',
+                       'sku': 'server', 'version': 'latest'}
+        az_instance.run_instances(
+            'eastus', 'c8',
+            _pconfig(image_id='/subscriptions/s/my/image'))
+        vm = fake_arm.rgs['skytpu-c8']['resources'][
+            'virtualMachines/c8-0000']
+        assert vm['properties']['storageProfile'][
+            'imageReference'] == {'id': '/subscriptions/s/my/image'}
+
+    def test_bad_image_id_fails_fast(self, fake_arm):
+        with pytest.raises(exceptions.ProvisionError,
+                           match='marketplace urn'):
+            az_instance.run_instances('eastus', 'c9',
+                                      _pconfig(image_id='garbage'))
+
+    def test_list_resources_follows_next_link(self, monkeypatch):
+        pages = [
+            {'value': [{'name': 'vm-a'}],
+             'nextLink': 'https://management.azure.com/page2'},
+            {'value': [{'name': 'vm-b'}]},
+        ]
+        calls = []
+
+        def fake_request(method, path, api_version, body=None,
+                         params=None):
+            calls.append(('request', path))
+            return pages[0]
+
+        def fake_request_url(method, url, body=None):
+            calls.append(('request_url', url))
+            return pages[1]
+
+        monkeypatch.setattr(arm_api, 'request', fake_request)
+        monkeypatch.setattr(arm_api, 'request_url', fake_request_url)
+        out = arm_api.list_resources('rg', 'Microsoft.Compute',
+                                    'virtualMachines')
+        assert [i['name'] for i in out] == ['vm-a', 'vm-b']
+        assert calls[1] == ('request_url',
+                            'https://management.azure.com/page2')

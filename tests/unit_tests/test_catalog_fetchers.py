@@ -159,6 +159,53 @@ class TestFetchAws:
         assert (shapes.price > 0).all()
 
 
+def _azure_pages(url):
+    if 'NextPageLink' in url:
+        items = [
+            {'armSkuName': 'Standard_D8s_v5', 'type': 'Consumption',
+             'productName': 'Virtual Machines Dsv5 Series',
+             'skuName': 'D8s v5 Spot', 'retailPrice': 0.11},
+        ]
+        return {'Items': items}
+    items = [
+        {'armSkuName': 'Standard_D8s_v5', 'type': 'Consumption',
+         'productName': 'Virtual Machines Dsv5 Series',
+         'skuName': 'D8s v5', 'retailPrice': 0.40},
+        # Windows + Low Priority + Reservation rows must be ignored.
+        {'armSkuName': 'Standard_D8s_v5', 'type': 'Consumption',
+         'productName': 'Virtual Machines Dsv5 Series Windows',
+         'skuName': 'D8s v5', 'retailPrice': 0.77},
+        {'armSkuName': 'Standard_D8s_v5', 'type': 'Consumption',
+         'productName': 'Virtual Machines Dsv5 Series',
+         'skuName': 'D8s v5 Low Priority', 'retailPrice': 0.05},
+        {'armSkuName': 'Standard_D8s_v5', 'type': 'Reservation',
+         'productName': 'Virtual Machines Dsv5 Series',
+         'skuName': 'D8s v5', 'retailPrice': 0.20},
+    ]
+    return {'Items': items,
+            'NextPageLink': url + '&NextPageLink=2'}
+
+
+class TestFetchAzure:
+
+    def test_fetch_reprices_with_real_spot_rows(self):
+        from skypilot_tpu.catalog import azure_catalog
+        from skypilot_tpu.catalog.fetchers import fetch_azure
+        paths = fetch_azure.fetch_and_write(fetch_json=_azure_pages)
+        assert 'vms' in paths
+        assert azure_catalog.get_hourly_cost(
+            'Standard_D8s_v5', use_spot=False,
+            region='eastus') == pytest.approx(0.40)
+        # Spot comes from the API's own Spot row, not a ratio.
+        assert azure_catalog.get_hourly_cost(
+            'Standard_D8s_v5', use_spot=True,
+            region='eastus') == pytest.approx(0.11)
+        # Unfetched shapes keep previous prices.
+        assert azure_catalog.get_hourly_cost(
+            'Standard_D4s_v5', use_spot=False,
+            region='eastus') == pytest.approx(0.1920)
+
+
 class TestCliAndStaleness:
 
     def test_cli_fetch_gcp(self, monkeypatch):

@@ -70,8 +70,9 @@ class TestManagedJobs:
         rows = jobs.queue()
         assert any(r['job_id'] == job_id for r in rows)
         # Generous: under a fully loaded suite the thread controller's
-        # launch+probe loop can lag well past the usual few seconds.
-        jobs.wait(job_id, timeout=150)
+        # launch+probe loop can lag well past the usual few seconds
+        # (flaked at 150s once the suite passed 400 tests).
+        jobs.wait(job_id, timeout=300)
         assert jobs.get_status(job_id) == jobs.ManagedJobStatus.SUCCEEDED
         info = jobs_state.get_job_info(job_id)
         assert info['schedule_state'] == jobs_state.ScheduleState.DONE

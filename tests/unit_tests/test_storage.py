@@ -344,3 +344,33 @@ class TestAzureBlobStore:
         (cmd,) = calls
         assert '--exclude-pattern' in cmd
         assert '__pycache__;*.log' in cmd
+
+
+class TestStoragePerfSmoke:
+
+    def test_local_dir_numbers_are_sane(self, tmp_path):
+        from skypilot_tpu.benchmark import storage_perf
+        result = storage_perf.run(str(tmp_path), size_mb=16,
+                                  small_ops=64)
+        assert result['seq_write_mb_s'] > 0
+        assert result['seq_read_mb_s'] > 0
+        assert result['small_read_iops'] > 0
+        assert result['small_write_iops'] > 0
+        # The probe file is cleaned up.
+        assert not [p for p in tmp_path.iterdir()
+                    if p.name.startswith('.skytpu_perf')]
+
+    def test_cli_prints_one_json_line(self, tmp_path, capsys):
+        import json as json_lib
+        import sys
+        from skypilot_tpu.benchmark import storage_perf
+        argv = sys.argv
+        sys.argv = ['storage_perf', str(tmp_path), '--size-mb', '8',
+                    '--small-ops', '16']
+        try:
+            storage_perf.main()
+        finally:
+            sys.argv = argv
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1
+        assert json_lib.loads(out[0])['metric'] == 'storage-perf'
