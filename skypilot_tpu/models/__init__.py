@@ -1,7 +1,8 @@
 """Model registry: name -> (flax module, config).
 
 Families: llama-* / llama3* (models/llama.py), mixtral-* MoE
-(models/moe.py), gemma-* (models/gemma.py), gpt2-* (models/gpt2.py).
+(models/moe.py), gemma-* (models/gemma.py), gpt2-* (models/gpt2.py),
+qwen* (models/qwen.py).
 The trainer and serving engine resolve models through `get_model` so
 new families plug in without touching the training loop.
 """
@@ -12,7 +13,7 @@ from typing import Any, Tuple
 
 def get_model(name: str, **overrides: Any) -> Tuple[Any, Any]:
     """Return (nn.Module instance, config) for a model name."""
-    from skypilot_tpu.models import gemma, gpt2, llama, moe
+    from skypilot_tpu.models import gemma, gpt2, llama, moe, qwen
     if name in moe.CONFIGS:
         config = moe.get_config(name, **overrides)
         return moe.Mixtral(config), config
@@ -25,11 +26,15 @@ def get_model(name: str, **overrides: Any) -> Tuple[Any, Any]:
     if name in gpt2.CONFIGS:
         config = gpt2.get_config(name, **overrides)
         return gpt2.Gpt2(config), config
+    if name in qwen.CONFIGS:
+        config = qwen.get_config(name, **overrides)
+        return qwen.Qwen(config), config
     raise ValueError(f'Unknown model {name!r}; '
                      f'available: {available_models()}')
 
 
 def available_models():
-    from skypilot_tpu.models import gemma, gpt2, llama, moe
+    from skypilot_tpu.models import gemma, gpt2, llama, moe, qwen
     return (sorted(llama.CONFIGS) + sorted(moe.CONFIGS)
-            + sorted(gemma.CONFIGS) + sorted(gpt2.CONFIGS))
+            + sorted(gemma.CONFIGS) + sorted(gpt2.CONFIGS)
+            + sorted(qwen.CONFIGS))

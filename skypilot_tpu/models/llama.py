@@ -315,27 +315,31 @@ class Attention(nn.Module):
     def __call__(self, x: jax.Array, positions: jax.Array,
                  kv_mask: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
-        dense = lambda features, names, name: nn.DenseGeneral(  # noqa: E731
-            features, axis=-1, use_bias=False, name=name,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            kernel_init=_partitioned_init(
-                nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5
-                                       if name == 'o_proj'
-                                       else 0.02), names,
-                cfg.partition_params))
+        # Qwen-style families put biases on Q/K/V (never O) — a config
+        # knob so the whole attention stack stays shared.
+        qkv_bias = getattr(cfg, 'attention_bias', False)
+        dense = lambda features, names, name, use_bias=False: \
+            nn.DenseGeneral(  # noqa: E731
+                features, axis=-1, use_bias=use_bias, name=name,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                kernel_init=_partitioned_init(
+                    nn.initializers.normal(
+                        0.02 / (2 * cfg.n_layers) ** 0.5
+                        if name == 'o_proj' else 0.02), names,
+                    cfg.partition_params))
         b, s, _ = x.shape
         h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         q = maybe_lora(cfg, 'q_proj', x,
                        dense((h, hd), ('embed_fsdp', 'heads', 'head_dim'),
-                             'q_proj')(x), (h, hd))
+                             'q_proj', qkv_bias)(x), (h, hd))
         k = maybe_lora(cfg, 'k_proj', x,
                        dense((kv, hd),
                              ('embed_fsdp', 'kv_heads', 'head_dim'),
-                             'k_proj')(x), (kv, hd))
+                             'k_proj', qkv_bias)(x), (kv, hd))
         v = maybe_lora(cfg, 'v_proj', x,
                        dense((kv, hd),
                              ('embed_fsdp', 'kv_heads', 'head_dim'),
-                             'v_proj')(x), (kv, hd))
+                             'v_proj', qkv_bias)(x), (kv, hd))
         # [B, S, H, hd] -> [B, H, S, hd]
         q = jnp.transpose(q, (0, 2, 1, 3))
         k = jnp.transpose(k, (0, 2, 1, 3))

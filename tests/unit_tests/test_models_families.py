@@ -229,3 +229,101 @@ class TestTrainerIntegration:
             first = first if first is not None else loss
             last = loss
         assert last < first, (first, last)
+
+
+class TestQwen:
+
+    def test_forward_shape_and_registry(self):
+        model, cfg = models.get_model('qwen-tiny', remat=False)
+        tokens = jnp.zeros((2, 32), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        logits = model.apply(variables, tokens)
+        assert logits.shape == (2, 32, cfg.vocab_size)
+        assert 'qwen2-7b' in models.available_models()
+
+    def test_qkv_bias_present_o_bias_absent(self):
+        """The Qwen2 signature: biases on Q/K/V only."""
+        model, _ = models.get_model('qwen-tiny', remat=False,
+                                    scan_layers=False)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))
+        params = sharding_lib.unbox(variables['params'])
+        attn = params['layer_0']['attention']
+        for proj in ('q_proj', 'k_proj', 'v_proj'):
+            assert 'bias' in attn[proj], proj
+        assert 'bias' not in attn['o_proj']
+
+    def test_param_count_tied_and_untied(self):
+        from skypilot_tpu.models import qwen
+        for tie in (True, False):
+            model, cfg = models.get_model('qwen-tiny', remat=False,
+                                          tie_embeddings=tie)
+            variables = model.init(jax.random.PRNGKey(0),
+                                   jnp.zeros((1, 8), jnp.int32))
+            params = sharding_lib.unbox(variables['params'])
+            assert ('lm_head' in params) == (not tie)
+            assert _count(params) == qwen.num_params(cfg), tie
+
+    def test_decode_cache_matches_full_forward(self):
+        from skypilot_tpu.models import qwen
+        cfg_full = qwen.get_config('qwen-tiny', remat=False,
+                                   dtype=jnp.float32,
+                                   param_dtype=jnp.float32,
+                                   attention_impl='reference')
+        cfg_dec = qwen.get_config('qwen-tiny', remat=False,
+                                  dtype=jnp.float32,
+                                  param_dtype=jnp.float32,
+                                  decode=True, max_seq_len=16)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                    cfg_full.vocab_size)
+        m_full = qwen.Qwen(cfg_full)
+        variables = m_full.init(jax.random.PRNGKey(0), tokens)
+        full_logits = m_full.apply(variables, tokens)
+
+        m_dec = qwen.Qwen(cfg_dec)
+        cache = jax.tree.map(
+            jnp.zeros_like,
+            m_dec.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 1), jnp.int32))['cache'])
+        step_logits = []
+        for i in range(tokens.shape[1]):
+            out, mut = m_dec.apply(
+                {'params': variables['params'], 'cache': cache},
+                tokens[:, i:i + 1],
+                jnp.full((1, 1), i, jnp.int32),
+                mutable=['cache'])
+            cache = mut['cache']
+            step_logits.append(out[:, 0])
+        np.testing.assert_allclose(
+            jnp.stack(step_logits, axis=1), full_logits,
+            atol=2e-3, rtol=2e-3)
+
+    def test_trainer_one_step_sharded(self):
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.train import data as data_lib
+        from skypilot_tpu.train import trainer as trainer_lib
+        config = trainer_lib.TrainConfig(
+            model='qwen-tiny', global_batch_size=8, seq_len=64,
+            total_steps=1,
+            mesh=mesh_lib.MeshConfig(data=2, fsdp=2, tensor=2),
+            model_overrides={'max_seq_len': 64, 'remat': False})
+        trainer = trainer_lib.Trainer(config)
+        trainer.init_state()
+        it = data_lib.synthetic_data(
+            trainer.mesh, global_batch_size=8, seq_len=64,
+            vocab_size=trainer.model_config.vocab_size)
+        loss = float(jax.device_get(trainer.step(next(it))['loss']))
+        assert loss > 0
+
+    def test_continuous_batching_serves_qwen(self):
+        from skypilot_tpu.infer import engine as engine_lib
+        eng = engine_lib.ContinuousBatchingEngine(
+            'qwen-tiny', n_slots=2,
+            model_overrides={'dtype': jnp.float32,
+                             'param_dtype': jnp.float32,
+                             'max_seq_len': 64},
+            param_dtype=jnp.float32, prefill_bucket=8)
+        outs = eng.generate(
+            [[1, 2, 3], [4, 5]],
+            engine_lib.SamplingConfig(max_new_tokens=4))
+        assert all(len(o) == 4 for o in outs)
