@@ -166,12 +166,8 @@ def get_accelerators_from_instance_type(
 
 
 def _parse_bound(request: Optional[str]) -> Tuple[Optional[float], bool]:
-    if request is None:
-        return None, False
-    s = str(request)
-    if s.endswith('+'):
-        return float(s[:-1]), True
-    return float(s), False
+    from skypilot_tpu.catalog import common
+    return common.parse_bound(request)
 
 
 def get_default_instance_type(cpus: Optional[str] = None,

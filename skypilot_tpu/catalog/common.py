@@ -95,6 +95,18 @@ def update_from_url(cloud: str, table: str, url: str,
     return write_catalog_csv(cloud, table, text)
 
 
+def parse_bound(request) -> 'tuple[Optional[float], bool]':
+    """Resource-request grammar shared by the VM catalogs:
+    '8+' -> (8.0, True: at-least), '8' -> (8.0, False: exact),
+    None -> (None, False)."""
+    if request is None:
+        return None, False
+    s = str(request)
+    if s.endswith('+'):
+        return float(s[:-1]), True
+    return float(s), False
+
+
 SNAPSHOT_MAX_AGE_DAYS = 180
 _stale_warned: set = set()
 

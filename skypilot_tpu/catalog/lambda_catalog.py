@@ -1,13 +1,10 @@
-"""Azure VM catalog: instance types, prices, regions/zones.
+"""Lambda Cloud catalog: GPU instance types, prices, regions.
 
 Counterpart of the reference's
-sky/clouds/service_catalog/azure_catalog.py; same structure as
-catalog/aws_catalog.py: a built-in snapshot of public pay-as-you-go /
-spot list prices (eastus anchors, per-region multiplier), overridable
-by `~/.skytpu/catalogs/v1/azure/vms.csv` (`sky catalog update`).
-
-Azure zones are numbered (1/2/3) within a region; this catalog
-represents them as '<region>-<n>'.
+sky/clouds/service_catalog/lambda_catalog.py — the minor-cloud tier.
+Lambda sells flat-rate GPU boxes (no spot, no stop): one price per
+type, identical across regions, so no multiplier table.  Snapshot
+overridable by `~/.skytpu/catalogs/v1/lambda/vms.csv`.
 """
 from __future__ import annotations
 
@@ -20,52 +17,25 @@ if typing.TYPE_CHECKING:
 
 from skypilot_tpu import exceptions
 
-# price/spot_price are eastus anchors ($/h, public list 2025).
+# Public list prices 2025 ($/h, flat — Lambda has no spot tier;
+# spot_price mirrors price so shared cost plumbing stays total).
 _VMS_CSV = """\
 instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
-Standard_D2s_v5,2,8,,0,0.0960,0.0288
-Standard_D4s_v5,4,16,,0,0.1920,0.0576
-Standard_D8s_v5,8,32,,0,0.3840,0.1152
-Standard_D16s_v5,16,64,,0,0.7680,0.2304
-Standard_D32s_v5,32,128,,0,1.5360,0.4608
-Standard_E8s_v5,8,64,,0,0.5040,0.1512
-Standard_F16s_v2,16,32,,0,0.6770,0.2031
-Standard_NC4as_T4_v3,4,28,T4,1,0.5260,0.1578
-Standard_NC64as_T4_v3,64,440,T4,4,4.3520,1.3056
-Standard_NV36ads_A10_v5,36,440,A10,1,3.2000,0.9600
-Standard_NC24ads_A100_v4,24,220,A100-80GB,1,3.6730,1.1019
-Standard_ND96asr_v4,96,900,A100,8,27.1970,8.1591
-Standard_ND96amsr_A100_v4,96,1900,A100-80GB,8,32.7700,9.8310
-Standard_NC40ads_H100_v5,40,320,H100,1,6.9800,2.0940
-Standard_ND96isr_H100_v5,96,1900,H100,8,98.3200,29.4960
+gpu_1x_a10,30,200,A10,1,0.75,0.75
+gpu_1x_a100_sxm4,30,200,A100,1,1.29,1.29
+gpu_8x_a100_80gb_sxm4,240,1800,A100-80GB,8,14.32,14.32
+gpu_1x_h100_pcie,26,200,H100,1,2.49,2.49
+gpu_8x_h100_sxm5,208,1800,H100,8,23.92,23.92
+cpu_4x_general,4,16,,0,0.08,0.08
 """
 
-_REGION_PRICE_MULTIPLIER: Dict[str, float] = {
-    'eastus': 1.0,
-    'eastus2': 1.0,
-    'southcentralus': 1.05,
-    'westus2': 1.0,
-    'westeurope': 1.15,
-    'northeurope': 1.10,
-    'japaneast': 1.20,
-}
-
-# Azure availability zones are numbered per region.
-_REGION_ZONES: Dict[str, List[str]] = {
-    'eastus': ['1', '2', '3'],
-    'eastus2': ['1', '2', '3'],
-    'southcentralus': ['1', '2', '3'],
-    'westus2': ['1', '2', '3'],
-    'westeurope': ['1', '2', '3'],
-    'northeurope': ['1', '2', '3'],
-    'japaneast': ['1', '2', '3'],
-}
+_REGIONS = ['us-east-1', 'us-west-1', 'us-west-2', 'us-midwest-1',
+            'europe-central-1', 'asia-south-1']
 
 _VM_COLUMNS = ['instance_type', 'vcpus', 'memory_gb',
                'accelerator_name', 'accelerator_count', 'price',
                'spot_price']
 
-# See gcp_catalog.SNAPSHOT_DATE — same staleness contract.
 SNAPSHOT_DATE = '2025-03-01'
 
 _df: Optional['pd.DataFrame'] = None
@@ -74,12 +44,12 @@ _df: Optional['pd.DataFrame'] = None
 def _vm_df() -> 'pd.DataFrame':
     global _df
     if _df is None:
-        import pandas as pd  # deferred: keep `import skypilot_tpu` light
+        import pandas as pd
 
         from skypilot_tpu.catalog import common
-        _df = common.read_catalog_csv('azure', 'vms', _VM_COLUMNS)
+        _df = common.read_catalog_csv('lambda', 'vms', _VM_COLUMNS)
         if _df is None:
-            common.warn_if_snapshot_stale('azure', SNAPSHOT_DATE)
+            common.warn_if_snapshot_stale('lambda', SNAPSHOT_DATE)
             _df = pd.read_csv(io.StringIO(_VMS_CSV))
     return _df
 
@@ -94,36 +64,7 @@ def export_snapshot() -> Dict[str, str]:
 
 
 def regions() -> List[str]:
-    return sorted(_REGION_ZONES)
-
-
-def zones(region: Optional[str] = None,
-          zone: Optional[str] = None) -> List[str]:
-    out = []
-    for r, numbers in sorted(_REGION_ZONES.items()):
-        if region is not None and r != region:
-            continue
-        for n in numbers:
-            z = f'{r}-{n}'
-            if zone is None or z == zone:
-                out.append(z)
-    return out
-
-
-def zone_to_region(zone: str) -> str:
-    # 'eastus-1' -> 'eastus'
-    return zone.rsplit('-', 1)[0]
-
-
-def zone_number(zone: str) -> str:
-    # 'eastus-1' -> '1' (the ARM `zones` field value)
-    return zone.rsplit('-', 1)[1]
-
-
-def _region_multiplier(region: Optional[str]) -> float:
-    if region is None:
-        return 1.0
-    return _REGION_PRICE_MULTIPLIER.get(region, 1.2)
+    return list(_REGIONS)
 
 
 def instance_type_exists(instance_type: str) -> bool:
@@ -136,7 +77,7 @@ def _row(instance_type: str):
     rows = df[df['instance_type'] == instance_type]
     if rows.empty:
         raise exceptions.ResourcesUnavailableError(
-            f'No Azure instance type {instance_type!r}; have '
+            f'No Lambda instance type {instance_type!r}; have '
             f'{sorted(df["instance_type"])}')
     return rows.iloc[0]
 
@@ -144,11 +85,8 @@ def _row(instance_type: str):
 def get_hourly_cost(instance_type: str, use_spot: bool,
                     region: Optional[str] = None,
                     zone: Optional[str] = None) -> float:
-    if zone is not None and region is None:
-        region = zone_to_region(zone)
-    row = _row(instance_type)
-    base = float(row['spot_price'] if use_spot else row['price'])
-    return base * _region_multiplier(region)
+    del use_spot, region, zone  # flat pricing, no spot tier
+    return float(_row(instance_type)['price'])
 
 
 def get_vcpus_mem_from_instance_type(
@@ -165,24 +103,23 @@ def get_accelerators_from_instance_type(
     return {str(row['accelerator_name']): int(row['accelerator_count'])}
 
 
-def _parse_bound(request: Optional[str]) -> Tuple[Optional[float], bool]:
-    from skypilot_tpu.catalog import common
-    return common.parse_bound(request)
-
-
 def get_default_instance_type(cpus: Optional[str] = None,
                               memory: Optional[str] = None,
                               disk_tier: Optional[str] = None
                               ) -> Optional[str]:
     del disk_tier
+    from skypilot_tpu.catalog import common
     df = _vm_df()
     df = df[df['accelerator_count'] == 0]
-    cpu_val, cpu_plus = _parse_bound(cpus)
-    mem_val, mem_plus = _parse_bound(memory)
+    cpu_val, cpu_plus = common.parse_bound(cpus)
+    mem_val, mem_plus = common.parse_bound(memory)
     if cpu_val is not None:
         df = df[df['vcpus'] >= cpu_val] if cpu_plus else \
             df[df['vcpus'] == cpu_val]
     elif memory is None:
+        # Same implicit >=8-vCPU default floor as the other catalogs —
+        # Lambda's only CPU box is 4 vCPUs, so default (unspecified)
+        # tasks are simply infeasible here rather than undersized.
         df = df[df['vcpus'] >= 8]
     if mem_val is not None:
         df = df[df['memory_gb'] >= mem_val] if mem_plus else \
@@ -207,13 +144,13 @@ def get_accelerator_hourly_cost(acc_name: str, acc_count: int,
     types = get_instance_type_for_accelerator(acc_name, acc_count)
     if not types:
         raise exceptions.ResourcesUnavailableError(
-            f'No Azure instance type offers {acc_name}:{acc_count}.')
-    return min(get_hourly_cost(t, use_spot, region, zone) for t in types)
+            f'No Lambda instance type offers {acc_name}:{acc_count}.')
+    return min(get_hourly_cost(t, use_spot, region, zone)
+               for t in types)
 
 
 def list_accelerators(name_filter: Optional[str] = None
                       ) -> Dict[str, List[Dict[str, object]]]:
-    """name -> offerings (for `sky show-accelerators`)."""
     df = _vm_df()
     out: Dict[str, List[Dict[str, object]]] = {}
     for _, row in df[df['accelerator_count'] > 0].iterrows():

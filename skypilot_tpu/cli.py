@@ -310,8 +310,10 @@ def _show_accelerators(name_filter, include_gpus: bool) -> None:
     if include_gpus:
         from skypilot_tpu.catalog import aws_catalog
         from skypilot_tpu.catalog import azure_catalog
+        from skypilot_tpu.catalog import lambda_catalog
         for label, cat in (('AWS', aws_catalog),
-                           ('Azure', azure_catalog)):
+                           ('Azure', azure_catalog),
+                           ('Lambda', lambda_catalog)):
             inv = cat.list_accelerators(name_filter)
             for name in sorted(inv):
                 for item in inv[name]:
@@ -398,6 +400,9 @@ def catalog_update(cloud, table, from_file, url, export, reset, fetch,
         tables = ('vms',)
     elif cloud == 'azure':
         from skypilot_tpu.catalog import azure_catalog as cat
+        tables = ('vms',)
+    elif cloud == 'lambda':
+        from skypilot_tpu.catalog import lambda_catalog as cat
         tables = ('vms',)
     else:
         raise click.UsageError(f'Unknown catalog cloud {cloud!r}.')

@@ -9,10 +9,11 @@ from skypilot_tpu.clouds.azure import Azure
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.fake import Fake, fake_cloud_state
 from skypilot_tpu.clouds.kubernetes import Kubernetes
+from skypilot_tpu.clouds.lambda_cloud import Lambda
 from skypilot_tpu.clouds.local import Local
 
 __all__ = [
     'Cloud', 'CloudImplementationFeatures', 'FeasibleResources', 'Region',
-    'Zone', 'CLOUD_REGISTRY', 'AWS', 'Azure', 'GCP', 'Fake', 'Local',
-    'fake_cloud_state',
+    'Zone', 'CLOUD_REGISTRY', 'AWS', 'Azure', 'GCP', 'Fake', 'Lambda',
+    'Local', 'fake_cloud_state',
 ]

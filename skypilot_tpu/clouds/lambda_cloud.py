@@ -1,0 +1,208 @@
+"""Lambda Cloud: capability model + catalog glue.
+
+Counterpart of the reference's sky/clouds/lambda_cloud.py — the
+exemplar of the minor-cloud tail (cudo/do/fluidstack/paperspace/
+runpod follow the same recipe: a flat GPU catalog + a small REST
+client + a feature model declaring what the platform cannot do).
+
+Platform truths the feature model encodes: no stop/resume (terminate
+only), no spot tier, no custom images, no per-cluster firewalling.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.catalog import lambda_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class Lambda(cloud.Cloud):
+    """Lambda Cloud (flat-rate GPU instances)."""
+
+    _REPR = 'Lambda'
+    PROVISIONER_MODULE = 'lambda_cloud'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 60
+
+    @classmethod
+    def _unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        unsupported = {
+            cloud.CloudImplementationFeatures.STOP:
+                'Lambda instances cannot be stopped, only terminated.',
+            cloud.CloudImplementationFeatures.AUTOSTOP:
+                'no stop support; use autodown.',
+            cloud.CloudImplementationFeatures.SPOT_INSTANCE:
+                'Lambda has no spot tier.',
+            cloud.CloudImplementationFeatures.IMAGE_ID:
+                'Lambda boots its own Ubuntu images only.',
+            cloud.CloudImplementationFeatures.OPEN_PORTS:
+                'firewalling is account-wide in the Lambda console.',
+            cloud.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+                'fixed local NVMe.',
+            cloud.CloudImplementationFeatures.CLONE_DISK:
+                'not supported.',
+        }
+        if resources.tpu_slice is not None:
+            unsupported[cloud.CloudImplementationFeatures.MULTI_NODE] = (
+                'Lambda offers no TPUs; use GCP/Kubernetes.')
+        return unsupported
+
+    # ---- regions ---------------------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del instance_type, accelerators
+        if use_spot or zone is not None:
+            return []
+        return [cloud.Region(r) for r in lambda_catalog.regions()
+                if region is None or r == region]
+
+    @classmethod
+    def zones_provision_loop(
+        cls, *, region: str, num_nodes: int, instance_type: str,
+        accelerators: Optional[Dict[str, int]] = None,
+        use_spot: bool = False,
+    ) -> Iterator[Optional[List[cloud.Zone]]]:
+        # Lambda has no zones; one attempt per region.
+        del num_nodes, instance_type, accelerators, use_spot, region
+        yield None
+
+    # ---- pricing ---------------------------------------------------------
+    @classmethod
+    def instance_type_to_hourly_cost(cls, instance_type: str,
+                                     use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        return lambda_catalog.get_hourly_cost(instance_type, use_spot,
+                                              region, zone)
+
+    @classmethod
+    def accelerators_to_hourly_cost(cls, accelerators: Dict[str, int],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        (acc, count), = accelerators.items()
+        return lambda_catalog.get_accelerator_hourly_cost(
+            acc, count, use_spot, region, zone)
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        return 0.0  # Lambda does not bill egress.
+
+    # ---- instance types --------------------------------------------------
+    @classmethod
+    def instance_type_exists(cls, instance_type: str) -> bool:
+        return lambda_catalog.instance_type_exists(instance_type)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return lambda_catalog.get_vcpus_mem_from_instance_type(
+            instance_type)
+
+    @classmethod
+    def get_default_instance_type(
+            cls, cpus: Optional[str] = None,
+            memory: Optional[str] = None,
+            disk_tier: Optional[str] = None) -> Optional[str]:
+        return lambda_catalog.get_default_instance_type(cpus, memory,
+                                                        disk_tier)
+
+    @classmethod
+    def get_accelerators_from_instance_type(
+            cls, instance_type: str) -> Optional[Dict[str, int]]:
+        return lambda_catalog.get_accelerators_from_instance_type(
+            instance_type)
+
+    # ---- feasibility -----------------------------------------------------
+    @classmethod
+    def _get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources',
+        num_nodes: int) -> cloud.FeasibleResources:
+        del num_nodes
+        if resources.tpu_slice is not None:
+            return cloud.FeasibleResources(
+                [], [], 'Lambda offers no TPUs.')
+        if resources.use_spot:
+            return cloud.FeasibleResources(
+                [], [], 'Lambda has no spot tier.')
+        if resources.accelerators is not None:
+            (acc, acc_count), = resources.accelerators.items()
+            instance_types = \
+                lambda_catalog.get_instance_type_for_accelerator(
+                    acc, acc_count)
+            if not instance_types:
+                fuzzy = [f'{name} (Lambda)' for name in
+                         lambda_catalog.list_accelerators(acc[:4])]
+                return cloud.FeasibleResources([], fuzzy[:5], None)
+            return cloud.FeasibleResources(
+                [resources.copy(cloud=cls(), instance_type=it)
+                 for it in instance_types], [], None)
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = cls.get_default_instance_type(
+                resources.cpus, resources.memory, resources.disk_tier)
+        if instance_type is None:
+            return cloud.FeasibleResources(
+                [], [], 'No Lambda instance type satisfies '
+                f'cpus={resources.cpus} memory={resources.memory}.')
+        return cloud.FeasibleResources(
+            [resources.copy(cloud=cls(), instance_type=instance_type)],
+            [], None)
+
+    # ---- deploy ----------------------------------------------------------
+    @classmethod
+    def make_deploy_resources_variables(
+            cls, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        del zones
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,
+            'disk_size': resources.disk_size,
+            'labels': resources.labels or {},
+            'num_nodes': num_nodes,
+            'ports': resources.ports,
+        }
+
+    # ---- credentials -----------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.lambda_cloud import lambda_api
+        if lambda_api.load_api_key() is None:
+            return False, (
+                'No Lambda API key. Set LAMBDA_API_KEY or write '
+                "'api_key = <key>' to ~/.lambda_cloud/lambda_keys.")
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.lambda_cloud import lambda_api
+        key = lambda_api.load_api_key()
+        if key is None:
+            return None
+        return [[key[:12]]]  # key prefix as the identity anchor
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        import os
+        path = os.path.expanduser('~/.lambda_cloud/lambda_keys')
+        if os.path.exists(path):
+            return {'~/.lambda_cloud/lambda_keys':
+                    '~/.lambda_cloud/lambda_keys'}
+        return {}

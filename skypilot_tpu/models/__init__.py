@@ -33,6 +33,20 @@ def get_model(name: str, **overrides: Any) -> Tuple[Any, Any]:
                      f'available: {available_models()}')
 
 
+def num_params(config: Any) -> int:
+    """Analytic parameter count, dispatched by config family —
+    families duck-type each other's fields, so calling one family's
+    counter on another's config returns a silently-wrong number."""
+    from skypilot_tpu.models import gemma, gpt2, llama, moe, qwen
+    for mod, cfg_cls in ((moe, moe.MoEConfig),
+                         (gemma, gemma.GemmaConfig),
+                         (gpt2, gpt2.Gpt2Config),
+                         (qwen, qwen.QwenConfig)):
+        if isinstance(config, cfg_cls):
+            return mod.num_params(config)
+    return llama.num_params(config)
+
+
 def available_models():
     from skypilot_tpu.models import gemma, gpt2, llama, moe, qwen
     return (sorted(llama.CONFIGS) + sorted(moe.CONFIGS)

@@ -1,0 +1,179 @@
+"""Lambda Cloud provisioner: the uniform provision interface.
+
+Counterpart of the reference's sky/provision/lambda_cloud/instance.py.
+Lambda semantics: instances launch by (region, type, quantity), carry
+a NAME (our cluster tag), cannot stop/resume (terminate only — the
+cloud declares STOP unsupported), and the platform injects registered
+SSH keys, so the framework key is registered via the /ssh-keys API
+before launch.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.lambda_cloud import lambda_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'lambda'
+_KEY_NAME = 'skytpu-key'
+
+_CAPACITY_CODES = {'instance-operations/launch/insufficient-capacity',
+                   'insufficient-capacity',
+                   'global/quota-exceeded'}
+
+
+def _classify(e: lambda_api.LambdaApiError) -> Exception:
+    if e.code in _CAPACITY_CODES or 'capacity' in e.code:
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _cluster_instances(cluster_name_on_cloud: str
+                       ) -> List[Dict[str, Any]]:
+    return sorted(
+        (i for i in lambda_api.list_instances()
+         if i.get('name') == cluster_name_on_cloud),
+        key=lambda i: str(i.get('id')))
+
+
+def _ensure_ssh_key(auth_config: Dict[str, Any]) -> List[str]:
+    ssh_keys = (auth_config or {}).get('ssh_keys', '')
+    if ':' not in ssh_keys:
+        # No framework key: fall back to whatever keys the account has.
+        return [k['name'] for k in lambda_api.list_ssh_keys()][:1]
+    pub = ssh_keys.split(':', 1)[1]
+    for key in lambda_api.list_ssh_keys():
+        if key.get('public_key', '').strip() == pub.strip():
+            return [key['name']]
+    name = f'{_KEY_NAME}-{abs(hash(pub)) % 10**8}'
+    lambda_api.add_ssh_key(name, pub)
+    return [name]
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    try:
+        existing = _cluster_instances(cluster_name_on_cloud)
+        active = [i for i in existing
+                  if i.get('status') in ('active', 'booting')]
+        to_create = config.count - len(active)
+        created: List[str] = []
+        if to_create > 0:
+            key_names = _ensure_ssh_key(config.authentication_config)
+            created = lambda_api.launch(
+                region, node_cfg['instance_type'], key_names,
+                quantity=to_create, name=cluster_name_on_cloud)
+    except lambda_api.LambdaApiError as e:
+        raise _classify(e) from None
+    ids = sorted([str(i['id']) for i in active] + created)
+    if not ids:
+        raise exceptions.ResourcesUnavailableError(
+            f'Lambda returned no instances for '
+            f'{cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER,
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone=None,
+        head_instance_id=ids[0],
+        resumed_instance_ids=[],
+        created_instance_ids=created,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    raise exceptions.NotSupportedError(
+        'Lambda Cloud cannot stop instances; use `sky down` '
+        '(terminate).')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    ids = sorted(str(i['id'])
+                 for i in _cluster_instances(cluster_name_on_cloud))
+    if worker_only and ids:
+        ids = ids[1:]
+    lambda_api.terminate(ids)
+
+
+_STATUS_MAP = {
+    'booting': 'pending',
+    'active': 'running',
+    'unhealthy': 'running',
+    'terminating': 'terminated',
+    'terminated': 'terminated',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for inst in _cluster_instances(cluster_name_on_cloud):
+        status = _STATUS_MAP.get(str(inst.get('status')))
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[str(inst['id'])] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 900.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud, None,
+                                   non_terminated_only=False)
+        live = [s for s in statuses.values() if s != 'terminated']
+        if live and all(s == state for s in live):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: instances did not reach '
+        f'{state!r} within {timeout}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for inst in _cluster_instances(cluster_name_on_cloud):
+        if inst.get('status') != 'active':
+            continue
+        iid = str(inst['id'])
+        instances[iid] = [common.InstanceInfo(
+            instance_id=iid,
+            internal_ip=str(inst.get('private_ip') or ''),
+            external_ip=inst.get('ip'),
+            tags={'name': str(inst.get('name'))},
+        )]
+    head = sorted(instances)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head,
+        provider_name=_PROVIDER,
+        provider_config=provider_config,
+        ssh_user='ubuntu',
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # Lambda exposes instances on a public IP with open firewalling
+    # managed account-wide in their console; nothing per-cluster.
+    logger.warning('Lambda open_ports is account-wide (console); '
+                   'ensure %s are reachable.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

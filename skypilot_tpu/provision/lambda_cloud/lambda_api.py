@@ -1,0 +1,112 @@
+"""Minimal Lambda Cloud REST client (JSON over urllib).
+
+Counterpart of the reference's sky/provision/lambda_cloud/ (which
+wraps the same public API): https://cloud.lambdalabs.com/api/v1/ with
+Bearer API-key auth.  Key sources: env LAMBDA_API_KEY, then
+`~/.lambda_cloud/lambda_keys` ('api_key = <key>' — the reference's
+file).  All calls route through `_call`, the single test seam.
+"""
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_ROOT = 'https://cloud.lambdalabs.com/api/v1'
+_TIMEOUT = 60.0
+_KEY_FILE = '~/.lambda_cloud/lambda_keys'
+
+
+class LambdaApiError(exceptions.ProvisionError):
+
+    def __init__(self, status_code: int, code: str, message: str) -> None:
+        no_failover = status_code in (401, 403)
+        super().__init__(
+            f'Lambda API error {status_code} {code}: {message}',
+            no_failover=no_failover)
+        self.status_code = status_code
+        self.code = code
+
+
+def load_api_key() -> Optional[str]:
+    key = os.environ.get('LAMBDA_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(
+        os.environ.get('LAMBDA_KEY_FILE', _KEY_FILE))
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                if line.strip().startswith('api_key'):
+                    return line.split('=', 1)[1].strip()
+    except OSError:
+        return None
+    return None
+
+
+def _call(method: str, path: str,
+          body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    key = load_api_key()
+    if key is None:
+        raise LambdaApiError(401, 'NoCredentials',
+                             'no Lambda API key found')
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f'{API_ROOT}{path}', data=data, method=method,
+        headers={'Authorization': f'Bearer {key}',
+                 'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        text = e.read().decode(errors='replace')
+        try:
+            err = json.loads(text).get('error', {})
+            raise LambdaApiError(e.code, err.get('code', 'unknown'),
+                                 err.get('message', text[:200])) \
+                from None
+        except (json.JSONDecodeError, AttributeError):
+            raise LambdaApiError(e.code, 'unknown', text[:200]) \
+                from None
+    except urllib.error.URLError as e:
+        raise LambdaApiError(0, 'Unreachable', str(e)) from None
+
+
+def list_instances() -> List[Dict[str, Any]]:
+    return list(_call('GET', '/instances').get('data', []))
+
+
+def launch(region: str, instance_type: str, ssh_key_names: List[str],
+           quantity: int = 1,
+           name: Optional[str] = None) -> List[str]:
+    body: Dict[str, Any] = {
+        'region_name': region,
+        'instance_type_name': instance_type,
+        'ssh_key_names': ssh_key_names,
+        'quantity': quantity,
+    }
+    if name:
+        body['name'] = name
+    out = _call('POST', '/instance-operations/launch', body)
+    return list(out.get('data', {}).get('instance_ids', []))
+
+
+def terminate(instance_ids: List[str]) -> None:
+    if instance_ids:
+        _call('POST', '/instance-operations/terminate',
+              {'instance_ids': instance_ids})
+
+
+def list_ssh_keys() -> List[Dict[str, Any]]:
+    return list(_call('GET', '/ssh-keys').get('data', []))
+
+
+def add_ssh_key(name: str, public_key: str) -> None:
+    _call('POST', '/ssh-keys',
+          {'name': name, 'public_key': public_key})

@@ -112,10 +112,10 @@ def main() -> None:
     if args.json_metrics:
         import jax
 
-        from skypilot_tpu.models import llama
+        from skypilot_tpu import models as models_lib
         metrics = dict(metrics)
         try:
-            n_params = llama.num_params(trainer.model_config)
+            n_params = models_lib.num_params(trainer.model_config)
         except (TypeError, AttributeError):
             n_params = sum(
                 x.size for x in jax.tree.leaves(trainer.state.params))

@@ -1,0 +1,190 @@
+"""Lambda Cloud tests: the minor-cloud-tail exemplar — API-key auth,
+launch/terminate lifecycle over a mocked REST seam, no-stop semantics,
+catalog + optimizer integration."""
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.catalog import lambda_catalog
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.lambda_cloud import instance as lm_instance
+from skypilot_tpu.provision.lambda_cloud import lambda_api
+
+Resources = resources_lib.Resources
+
+
+@pytest.fixture(autouse=True)
+def _api_key(monkeypatch):
+    monkeypatch.setenv('LAMBDA_API_KEY', 'lk-test')
+
+
+class TestAuth:
+
+    def test_key_from_env(self):
+        assert lambda_api.load_api_key() == 'lk-test'
+
+    def test_key_from_file(self, tmp_path, monkeypatch):
+        monkeypatch.delenv('LAMBDA_API_KEY')
+        f = tmp_path / 'lambda_keys'
+        f.write_text('api_key = lk-file\n')
+        monkeypatch.setenv('LAMBDA_KEY_FILE', str(f))
+        assert lambda_api.load_api_key() == 'lk-file'
+
+    def test_check_credentials(self, tmp_path, monkeypatch):
+        lam = registry.CLOUD_REGISTRY.from_str('lambda')
+        ok, _ = lam.check_credentials()
+        assert ok
+        monkeypatch.delenv('LAMBDA_API_KEY')
+        monkeypatch.setenv('LAMBDA_KEY_FILE', str(tmp_path / 'nope'))
+        ok, msg = lam.check_credentials()
+        assert not ok and 'API key' in msg
+
+
+class FakeLambda:
+    """In-memory Lambda API behind the _call seam."""
+
+    def __init__(self):
+        self.instances = {}
+        self.keys = []
+        self.counter = 0
+        self.fail_launch = None
+
+    def _call(self, method, path, body=None):
+        if path == '/instances':
+            return {'data': list(self.instances.values())}
+        if path == '/ssh-keys' and method == 'GET':
+            return {'data': list(self.keys)}
+        if path == '/ssh-keys':
+            self.keys.append(dict(body))
+            return {'data': body}
+        if path == '/instance-operations/launch':
+            if self.fail_launch:
+                raise lambda_api.LambdaApiError(400, self.fail_launch,
+                                                'no capacity')
+            ids = []
+            for _ in range(body.get('quantity', 1)):
+                self.counter += 1
+                iid = f'lam-{self.counter:04d}'
+                self.instances[iid] = {
+                    'id': iid, 'name': body.get('name'),
+                    'status': 'active',
+                    'ip': f'129.0.0.{self.counter}',
+                    'private_ip': f'10.9.0.{self.counter}',
+                    'region': {'name': body['region_name']},
+                    'ssh_key_names': body['ssh_key_names'],
+                }
+                ids.append(iid)
+            return {'data': {'instance_ids': ids}}
+        if path == '/instance-operations/terminate':
+            for iid in body['instance_ids']:
+                if iid in self.instances:
+                    self.instances[iid]['status'] = 'terminated'
+            return {'data': {}}
+        raise AssertionError(f'unhandled {method} {path}')
+
+
+@pytest.fixture()
+def fake_lambda(monkeypatch):
+    fake = FakeLambda()
+    monkeypatch.setattr(lambda_api, '_call', fake._call)
+    monkeypatch.setattr(lm_instance.time, 'sleep', lambda s: None)
+    return fake
+
+
+def _pconfig(count=1, **node):
+    node_cfg = {'instance_type': 'gpu_1x_a100_sxm4', 'zone': None}
+    node_cfg.update(node)
+    return provision_common.ProvisionConfig(
+        provider_config={'region': 'us-east-1'},
+        authentication_config={
+            'ssh_keys': 'skytpu:ssh-ed25519 AAAA key'},
+        docker_config={}, node_config=node_cfg, count=count, tags={},
+        resume_stopped_nodes=False)
+
+
+class TestLambdaProvisioner:
+
+    def test_launch_query_terminate(self, fake_lambda):
+        record = lm_instance.run_instances('us-east-1', 'c1',
+                                           _pconfig(count=2))
+        assert len(record.created_instance_ids) == 2
+        assert record.head_instance_id == 'lam-0001'
+        # The framework SSH key was registered with the account.
+        assert fake_lambda.keys and 'ssh-ed25519 AAAA key' in \
+            fake_lambda.keys[0]['public_key']
+
+        info = lm_instance.get_cluster_info('us-east-1', 'c1',
+                                            {'region': 'us-east-1'})
+        assert info.ssh_user == 'ubuntu'
+        assert len(info.instances) == 2
+        assert info.instances['lam-0001'][0].external_ip == '129.0.0.1'
+
+        # Idempotent: re-run creates nothing new.
+        record2 = lm_instance.run_instances('us-east-1', 'c1',
+                                            _pconfig(count=2))
+        assert record2.created_instance_ids == []
+
+        lm_instance.terminate_instances('c1', {'region': 'us-east-1'})
+        assert lm_instance.query_instances(
+            'c1', {'region': 'us-east-1'}) == {}
+
+    def test_ssh_key_reused_not_redundantly_registered(self,
+                                                       fake_lambda):
+        lm_instance.run_instances('us-east-1', 'c1', _pconfig())
+        lm_instance.run_instances('us-east-1', 'c2', _pconfig())
+        assert len(fake_lambda.keys) == 1
+
+    def test_stop_raises_not_supported(self, fake_lambda):
+        lm_instance.run_instances('us-east-1', 'c1', _pconfig())
+        with pytest.raises(exceptions.NotSupportedError,
+                           match='cannot stop'):
+            lm_instance.stop_instances('c1', {'region': 'us-east-1'})
+
+    def test_capacity_error_classified(self, fake_lambda):
+        fake_lambda.fail_launch = 'insufficient-capacity'
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            lm_instance.run_instances('us-east-1', 'c9', _pconfig())
+
+
+class TestLambdaCloudAndCatalog:
+
+    def test_flat_pricing_no_spot(self):
+        assert lambda_catalog.get_hourly_cost(
+            'gpu_1x_a100_sxm4', use_spot=False) == pytest.approx(1.29)
+        lam = registry.CLOUD_REGISTRY.from_str('lambda')
+        feasible = lam.get_feasible_launchable_resources(
+            Resources(accelerators='H100:8'))
+        assert [r.instance_type for r in feasible.resources_list] == \
+            ['gpu_8x_h100_sxm5']
+        # Spot requests are infeasible, loudly.
+        feasible = lam.get_feasible_launchable_resources(
+            Resources(accelerators='H100:8', use_spot=True))
+        assert feasible.resources_list == []
+
+    def test_feature_model_blocks_stop_and_images(self):
+        lam = registry.CLOUD_REGISTRY.from_str('lambda')
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        unsupported = lam._unsupported_features_for_resources(
+            Resources(cloud='lambda',
+                      instance_type='gpu_1x_a100_sxm4'))
+        assert cloud_lib.CloudImplementationFeatures.STOP in unsupported
+        assert cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE in \
+            unsupported
+
+    def test_optimizer_picks_lambda_when_cheapest_gpu(self):
+        """A100:8 80GB: Lambda's flat $14.32 undercuts AWS p4de
+        ($40.97) and Azure ND96amsr ($32.77)."""
+        global_user_state.set_enabled_clouds(['aws', 'azure', 'lambda'])
+        t = task_lib.Task('t', run='x')
+        t.set_resources(Resources(accelerators='A100-80GB:8'))
+        with dag_lib.Dag() as d:
+            d.add(t)
+        optimizer_lib.optimize(d, quiet=True)
+        assert t.best_resources.cloud.canonical_name() == 'lambda'
+        assert t.best_resources.instance_type == \
+            'gpu_8x_a100_80gb_sxm4'
