@@ -40,12 +40,13 @@ _TINY_OVERRIDES = {'n_heads': 4, 'n_kv_heads': 2, 'n_layers': 2,
 
 def _start_replica(model: str, slots: int, continuous: bool,
                    max_seq_len: Optional[int],
-                   overrides: Optional[Dict[str, Any]]):
+                   overrides: Optional[Dict[str, Any]],
+                   prefill_chunk: int = 0):
     from skypilot_tpu.infer import server as server_lib
     srv = server_lib.InferenceServer(
         model=model, port=0, host='127.0.0.1', max_batch_size=slots,
         max_seq_len=max_seq_len, model_overrides=overrides,
-        continuous=continuous)
+        continuous=continuous, prefill_chunk=prefill_chunk)
     srv.start()
     threading.Thread(target=srv._server.serve_forever,  # pylint: disable=protected-access
                      daemon=True).start()
@@ -149,6 +150,7 @@ def main() -> None:
     parser.add_argument('--max-seq-len', type=int, default=None)
     parser.add_argument('--no-continuous', dest='continuous',
                         action='store_false', default=True)
+    parser.add_argument('--prefill-chunk', type=int, default=0)
     parser.add_argument('--platform', default=None,
                         help="Force a jax platform (e.g. 'cpu' for the "
                              'smoke run; env JAX_PLATFORMS alone is '
@@ -166,7 +168,8 @@ def main() -> None:
     mesh_lib.devices_with_retry()
 
     srv = _start_replica(args.model, args.slots, args.continuous,
-                         args.max_seq_len, overrides)
+                         args.max_seq_len, overrides,
+                         args.prefill_chunk)
     lb, lb_url = _start_lb(f'http://127.0.0.1:{srv.port}')
     try:
         # Warm every concurrency level's compile paths once.

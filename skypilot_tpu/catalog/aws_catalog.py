@@ -175,24 +175,8 @@ def get_default_instance_type(cpus: Optional[str] = None,
                               disk_tier: Optional[str] = None
                               ) -> Optional[str]:
     del disk_tier
-    import pandas as pd  # noqa: F401
-
-    df = _vm_df()
-    df = df[df['accelerator_count'] == 0]
-    cpu_val, cpu_plus = _parse_bound(cpus)
-    mem_val, mem_plus = _parse_bound(memory)
-    if cpu_val is not None:
-        df = df[df['vcpus'] >= cpu_val] if cpu_plus else \
-            df[df['vcpus'] == cpu_val]
-    elif memory is None:
-        # SkyPilot default: 8 vCPUs.
-        df = df[df['vcpus'] >= 8]
-    if mem_val is not None:
-        df = df[df['memory_gb'] >= mem_val] if mem_plus else \
-            df[df['memory_gb'] == mem_val]
-    if df.empty:
-        return None
-    return str(df.sort_values('price').iloc[0]['instance_type'])
+    from skypilot_tpu.catalog import common
+    return common.pick_default_instance_type(_vm_df(), cpus, memory)
 
 
 def get_instance_type_for_accelerator(acc_name: str,

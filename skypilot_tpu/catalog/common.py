@@ -107,6 +107,30 @@ def parse_bound(request) -> 'tuple[Optional[float], bool]':
     return float(s), False
 
 
+def pick_default_instance_type(df, cpus: Optional[str],
+                               memory: Optional[str],
+                               min_default_vcpus: int = 8
+                               ) -> Optional[str]:
+    """Cheapest CPU-only row of a vms dataframe satisfying the
+    cpus/memory request — ONE copy of the selection the per-cloud
+    catalogs share, including the implicit >=8-vCPU floor when nothing
+    is requested."""
+    df = df[df['accelerator_count'] == 0]
+    cpu_val, cpu_plus = parse_bound(cpus)
+    mem_val, mem_plus = parse_bound(memory)
+    if cpu_val is not None:
+        df = df[df['vcpus'] >= cpu_val] if cpu_plus else \
+            df[df['vcpus'] == cpu_val]
+    elif memory is None:
+        df = df[df['vcpus'] >= min_default_vcpus]
+    if mem_val is not None:
+        df = df[df['memory_gb'] >= mem_val] if mem_plus else \
+            df[df['memory_gb'] == mem_val]
+    if df.empty:
+        return None
+    return str(df.sort_values('price').iloc[0]['instance_type'])
+
+
 SNAPSHOT_MAX_AGE_DAYS = 180
 _stale_warned: set = set()
 

@@ -112,6 +112,23 @@ class _Slot:
     outputs: List[int] = dataclasses.field(default_factory=list)
 
 
+@dataclasses.dataclass
+class _PendingPrefill:
+    """A reserved slot whose prompt is being prefilled in chunks —
+    one chunk per scheduler tick, so live slots keep decoding instead
+    of stalling for a long prompt's whole prefill."""
+    slot_idx: int
+    rid: int
+    cfg: SamplingConfig
+    true_len: int
+    pad: int
+    tokens: Any               # np [1, pad]
+    mask_row: Any             # np [max_seq]
+    cache1: Any
+    done: int = 0
+    last_row: Any = None      # logits at the prompt's last true token
+
+
 class ContinuousBatchingEngine:
     """Slot-based continuous batching over the KV-cache model.
 
@@ -150,6 +167,7 @@ class ContinuousBatchingEngine:
                  model_overrides: Optional[Dict[str, Any]] = None,
                  param_dtype: Any = jnp.bfloat16,
                  prefill_bucket: int = 64,
+                 prefill_chunk: int = 0,
                  seed: int = 0) -> None:
         import collections
         import threading
@@ -243,6 +261,11 @@ class ContinuousBatchingEngine:
         self._canceled: set = set()
         self._admitting_rid: Optional[int] = None
         self._fatal: Optional[BaseException] = None
+        # prefill_chunk > 0: prompts longer than this prefill one
+        # chunk per tick (decode of live slots interleaves between
+        # chunks).  0 = whole-prompt prefill at admission.
+        self.prefill_chunk = prefill_chunk
+        self._prefills: List[_PendingPrefill] = []
         self._submit_lock = threading.Lock()
         self._next_rid = 0
         self._stepno = 0
@@ -282,6 +305,7 @@ class ContinuousBatchingEngine:
             self._results.pop(request_id, None)
             self._events.pop(request_id, None)
             if request_id == self._admitting_rid or any(
+                    p.rid == request_id for p in self._prefills) or any(
                     s is not None and s.request_id == request_id
                     for s in self._slots):
                 # In a slot — or popped from the queue and mid-prefill
@@ -317,6 +341,16 @@ class ContinuousBatchingEngine:
             e.set()
 
     # -- the decode loop ---------------------------------------------------
+    def _fresh_cache1(self):
+        def _zeros(leaf, sharding=None):
+            if sharding is not None:
+                return jnp.zeros(leaf.shape, leaf.dtype, device=sharding)
+            return jnp.zeros(leaf.shape, leaf.dtype)
+        if self._cache1_shardings is None:
+            return jax.tree.map(_zeros, self._abstract_cache1)
+        return jax.tree.map(_zeros, self._abstract_cache1,
+                            self._cache1_shardings)
+
     def _admit(self, slot_idx: int, rid: int, prompt: List[int],
                cfg: SamplingConfig) -> None:
         true_len = len(prompt)
@@ -325,34 +359,63 @@ class ContinuousBatchingEngine:
         pad = max(pad, true_len)
         tokens = np.zeros((1, pad), np.int32)
         tokens[0, :true_len] = prompt
-        positions = jnp.arange(pad, dtype=jnp.int32)[None]
         mask_row = np.zeros((self.max_seq_len,), bool)
         mask_row[:true_len] = True
-        kv_mask1 = jnp.asarray(mask_row)[None]
+        pending = _PendingPrefill(
+            slot_idx=slot_idx, rid=rid, cfg=cfg, true_len=true_len,
+            pad=pad, tokens=tokens, mask_row=mask_row,
+            cache1=self._fresh_cache1())
+        if self.prefill_chunk > 0:
+            # Reserve the slot; one chunk runs per tick from
+            # _step_inner so live slots keep decoding in between.
+            self._prefills.append(pending)
+            return
+        while pending.done < pending.pad:
+            self._prefill_chunk_step(pending)
+        self._finish_prefill(pending)
 
-        def _zeros(leaf, sharding=None):
-            if sharding is not None:
-                return jnp.zeros(leaf.shape, leaf.dtype, device=sharding)
-            return jnp.zeros(leaf.shape, leaf.dtype)
-        if self._cache1_shardings is None:
-            cache1 = jax.tree.map(_zeros, self._abstract_cache1)
-        else:
-            cache1 = jax.tree.map(_zeros, self._abstract_cache1,
-                                  self._cache1_shardings)
-        from skypilot_tpu.models import llama
-        with llama.slot_mode():
-            logits, cache1 = self._prefill1(
-                self.params, cache1, jnp.asarray(tokens), positions,
-                kv_mask1)
-        last_row = logits[0, true_len - 1]
+    def _prefill_chunk_step(self, pending: _PendingPrefill) -> None:
+        """Run the next prompt chunk through the batch-1 forward; the
+        chunk's K/V land at the cache cursor (sequential chunks, same
+        cache1).
+
+        Deliberately NOT under llama.slot_mode(): prefill must take
+        the global-cursor/causal branch of run_cached_attention — a
+        size-1 chunk traced in slot mode would scatter its K/V at the
+        row's highest revealed kv_mask slot (true_len-1) instead of
+        the cursor, silently corrupting the prompt."""
+        chunk = self.prefill_chunk if self.prefill_chunk > 0 \
+            else pending.pad
+        start = pending.done
+        size = min(chunk, pending.pad - start)
+        tokens = jnp.asarray(pending.tokens[:, start:start + size])
+        positions = jnp.arange(start, start + size,
+                               dtype=jnp.int32)[None]
+        kv_mask1 = jnp.asarray(pending.mask_row)[None]
+        logits, pending.cache1 = self._prefill1(
+            self.params, pending.cache1, tokens, positions, kv_mask1)
+        last_idx = pending.true_len - 1
+        if start <= last_idx < start + size:
+            pending.last_row = logits[0, last_idx - start]
+        pending.done = start + size
+        if pending.done >= pending.true_len:
+            # The rest of the padded length is masked-off zeros that
+            # decode never reads (it writes at pad_len + generated):
+            # skip those pure-padding chunks instead of burning ticks.
+            pending.done = pending.pad
+
+    def _finish_prefill(self, pending: _PendingPrefill) -> None:
+        assert pending.last_row is not None
         self._cache, self._last, self._kv_mask = self._insert(
-            self._cache, self._last, self._kv_mask, cache1, last_row,
-            jnp.asarray(mask_row), jnp.int32(slot_idx))
-        self._slots[slot_idx] = _Slot(
-            request_id=rid, prompt_len=true_len, pad_len=pad,
-            max_new=cfg.max_new_tokens, eos_id=cfg.eos_id,
-            temperature=cfg.temperature, top_k=cfg.top_k,
-            top_p=cfg.top_p)
+            self._cache, self._last, self._kv_mask, pending.cache1,
+            pending.last_row, jnp.asarray(pending.mask_row),
+            jnp.int32(pending.slot_idx))
+        cfg = pending.cfg
+        self._slots[pending.slot_idx] = _Slot(
+            request_id=pending.rid, prompt_len=pending.true_len,
+            pad_len=pending.pad, max_new=cfg.max_new_tokens,
+            eos_id=cfg.eos_id, temperature=cfg.temperature,
+            top_k=cfg.top_k, top_p=cfg.top_p)
 
     def _complete(self, slot_idx: int) -> None:
         slot = self._slots[slot_idx]
@@ -383,6 +446,8 @@ class ContinuousBatchingEngine:
         for i, s in enumerate(self._slots):
             if s is not None and s.request_id in snapshot:
                 self._slots[i] = None
+        self._prefills = [p for p in self._prefills
+                          if p.rid not in snapshot]
         # Entries with no slot are stale (e.g. admission raised after a
         # mid-prefill cancel) — drop them too, the set must not grow.
         with self._submit_lock:
@@ -399,9 +464,13 @@ class ContinuousBatchingEngine:
         # by max_new_tokens), then becomes the new group — leapfrogging
         # it for matching requests further back would starve it under
         # steady same-group traffic.
-        group = next(((s.top_k, s.top_p) for s in self._slots
-                      if s is not None), None)
-        free = [i for i, s in enumerate(self._slots) if s is None]
+        group = next(
+            ((s.top_k, s.top_p) for s in self._slots if s is not None),
+            next(((p.cfg.top_k, p.cfg.top_p) for p in self._prefills),
+                 None))
+        reserved = {p.slot_idx for p in self._prefills}
+        free = [i for i, s in enumerate(self._slots)
+                if s is None and i not in reserved]
         while free:
             with self._submit_lock:
                 item = None
@@ -419,10 +488,22 @@ class ContinuousBatchingEngine:
             finally:
                 with self._submit_lock:
                     self._admitting_rid = None
+
+        # One prefill chunk per tick (FIFO across pending prompts):
+        # decode below still runs for live slots, so a long prompt
+        # costs each of them one chunk's latency per tick, not its
+        # whole prefill.
+        if self._prefills:
+            pending = self._prefills[0]
+            self._prefill_chunk_step(pending)
+            if pending.done >= pending.pad:
+                self._finish_prefill(pending)
+                self._prefills.pop(0)
+
         occupied = [i for i, s in enumerate(self._slots)
                     if s is not None]
         if not occupied:
-            return False
+            return bool(self._prefills) or bool(self._queue)
 
         b = self.n_slots
         cursors = np.zeros((b,), np.int32)

@@ -46,7 +46,8 @@ class InferenceServer:
                  checkpoint_dir: Optional[str] = None,
                  mesh_config: Optional[str] = None,
                  model_overrides=None,
-                 continuous: bool = True) -> None:
+                 continuous: bool = True,
+                 prefill_chunk: int = 0) -> None:
         mesh = None
         if mesh_config:
             from skypilot_tpu.parallel import mesh as mesh_lib
@@ -61,7 +62,9 @@ class InferenceServer:
             self.engine = engine_lib.ContinuousBatchingEngine(
                 model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
                 n_slots=max_batch_size,
-                max_seq_len=max_seq_len, model_overrides=model_overrides)
+                max_seq_len=max_seq_len,
+                model_overrides=model_overrides,
+                prefill_chunk=prefill_chunk)
         else:
             self.engine = engine_lib.InferenceEngine(
                 model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
@@ -219,13 +222,19 @@ def main() -> None:
                         action='store_false', default=True,
                         help='Request-level batching instead of '
                              'continuous (slot-based) batching.')
+    parser.add_argument('--prefill-chunk', type=int, default=0,
+                        help='Chunked prefill: process long prompts '
+                             'this many tokens per decode tick so live '
+                             'requests keep generating (0 = whole '
+                             'prompt at admission).')
     args = parser.parse_args()
     InferenceServer(model=args.model, port=args.port, host=args.host,
                     max_batch_size=args.max_batch_size,
                     max_seq_len=args.max_seq_len,
                     checkpoint_dir=args.checkpoint_dir,
                     mesh_config=args.mesh,
-                    continuous=args.continuous).serve_forever()
+                    continuous=args.continuous,
+                    prefill_chunk=args.prefill_chunk).serve_forever()
 
 
 if __name__ == '__main__':

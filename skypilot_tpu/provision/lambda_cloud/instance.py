@@ -44,8 +44,13 @@ def _cluster_instances(cluster_name_on_cloud: str
 def _ensure_ssh_key(auth_config: Dict[str, Any]) -> List[str]:
     ssh_keys = (auth_config or {}).get('ssh_keys', '')
     if ':' not in ssh_keys:
-        # No framework key: fall back to whatever keys the account has.
-        return [k['name'] for k in lambda_api.list_ssh_keys()][:1]
+        # No framework key: fall back to the account's existing keys.
+        names = [k['name'] for k in lambda_api.list_ssh_keys()]
+        if not names:
+            raise exceptions.ProvisionError(
+                'Lambda requires an SSH key: none in the launch auth '
+                'config and none registered with the account.')
+        return names[:1]
     pub = ssh_keys.split(':', 1)[1]
     for key in lambda_api.list_ssh_keys():
         if key.get('public_key', '').strip() == pub.strip():
@@ -98,8 +103,13 @@ def stop_instances(cluster_name_on_cloud: str,
 def terminate_instances(cluster_name_on_cloud: str,
                         provider_config: Optional[Dict[str, Any]] = None,
                         worker_only: bool = False) -> None:
-    ids = sorted(str(i['id'])
-                 for i in _cluster_instances(cluster_name_on_cloud))
+    # Lambda keeps terminated instances in /instances listings for a
+    # while — filter them out BEFORE electing the head, or a stale
+    # dead instance shadows the real head and worker_only kills it.
+    ids = sorted(
+        str(i['id'])
+        for i in _cluster_instances(cluster_name_on_cloud)
+        if i.get('status') not in ('terminated', 'terminating'))
     if worker_only and ids:
         ids = ids[1:]
     lambda_api.terminate(ids)

@@ -43,8 +43,10 @@ def load_api_key() -> Optional[str]:
     try:
         with open(path, encoding='utf-8') as f:
             for line in f:
-                if line.strip().startswith('api_key'):
-                    return line.split('=', 1)[1].strip()
+                key_part, sep, value = line.strip().partition('=')
+                if sep and key_part.strip() == 'api_key' and \
+                        value.strip():
+                    return value.strip()
     except OSError:
         return None
     return None

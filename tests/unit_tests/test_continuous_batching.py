@@ -142,6 +142,99 @@ class TestContinuousCorrectness:
                        engine_lib.SamplingConfig(max_new_tokens=30))
 
 
+class TestChunkedPrefill:
+
+    @pytest.fixture(scope='class')
+    def cpe(self):
+        return engine_lib.ContinuousBatchingEngine(
+            'llama-tiny', n_slots=2, model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32, prefill_bucket=4,
+            prefill_chunk=4)
+
+    def test_chunked_matches_cache_free(self, cpe):
+        prompt = list(range(3, 17))  # 14 tokens -> 4 chunks of <=4
+        got = cpe.generate(
+            [prompt], engine_lib.SamplingConfig(max_new_tokens=5))[0]
+        assert got == _reference_greedy(cpe.params, prompt, 5)
+
+    def test_decode_interleaves_between_chunks(self, cpe):
+        """While a long prompt prefills chunk-by-chunk, a live slot
+        keeps generating."""
+        short, long_p = [5, 17, 3], list(range(1, 20))  # 19 -> 5 chunks
+        rid_s = cpe.submit(short, engine_lib.SamplingConfig(
+            max_new_tokens=12))
+        assert cpe.step()  # admit+prefill short (fits one tick)
+        rid_l = cpe.submit(long_p, engine_lib.SamplingConfig(
+            max_new_tokens=3))
+        progressed_during_prefill = []
+        while any(p.rid == rid_l for p in cpe._prefills) or \
+                not any(s is not None and s.request_id == rid_l
+                        for s in cpe._slots):
+            short_slot = next((s for s in cpe._slots
+                               if s is not None
+                               and s.request_id == rid_s), None)
+            if short_slot is None:
+                break  # short finished before long admitted
+            progressed_during_prefill.append(short_slot.generated)
+            if not cpe.step():
+                break
+        # The short request generated tokens across the long one's
+        # prefill ticks.
+        assert len(set(progressed_during_prefill)) > 1, \
+            progressed_during_prefill
+        cpe.run_until_idle()
+        assert cpe.wait(rid_s) == _reference_greedy(cpe.params, short,
+                                                    12)
+        assert cpe.wait(rid_l) == _reference_greedy(cpe.params, long_p,
+                                                    3)
+
+    def test_size_one_chunks_stay_on_prefill_path(self):
+        """chunk=1 makes every prefill forward s==1 — it must trace
+        the global-cursor prefill branch, NOT slot-mode (which would
+        scatter each prompt token's K/V at the row's last revealed
+        slot and silently corrupt generation)."""
+        eng = engine_lib.ContinuousBatchingEngine(
+            'llama-tiny', n_slots=2, model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32, prefill_bucket=4,
+            prefill_chunk=1)
+        prompt = [5, 17, 3, 42, 8, 9, 1]
+        got = eng.generate(
+            [prompt], engine_lib.SamplingConfig(max_new_tokens=5))[0]
+        assert got == _reference_greedy(eng.params, prompt, 5)
+
+    def test_padding_chunks_are_skipped(self):
+        """A short prompt in a large bucket must not burn ticks
+        prefilling pure padding."""
+        eng = engine_lib.ContinuousBatchingEngine(
+            'llama-tiny', n_slots=1, model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32, prefill_bucket=32,
+            prefill_chunk=4)
+        rid = eng.submit([5, 17, 3], engine_lib.SamplingConfig(
+            max_new_tokens=2))
+        ticks = 0
+        while any(p.rid == rid for p in eng._prefills) or not any(
+                s is not None and s.request_id == rid
+                for s in eng._slots):
+            assert eng.step()
+            ticks += 1
+            assert ticks < 4  # 1 chunk covers the 3-token prompt
+        eng.run_until_idle()
+        assert eng.wait(rid) == _reference_greedy(
+            eng.params, [5, 17, 3], 2)
+
+    def test_cancel_mid_chunked_prefill(self, cpe):
+        long_p = list(range(1, 20))
+        rid = cpe.submit(long_p, engine_lib.SamplingConfig(
+            max_new_tokens=3))
+        cpe.step()  # first chunk
+        assert any(p.rid == rid for p in cpe._prefills)
+        cpe.cancel(rid)
+        cpe.run_until_idle()
+        assert not cpe._prefills
+        assert rid not in cpe._results and rid not in cpe._events
+        assert all(s is None for s in cpe._slots)
+
+
 class TestContinuousServer:
 
     def test_concurrent_requests_share_decode_batch(self):
