@@ -199,6 +199,15 @@ class RequestRateAutoscaler(Autoscaler):
         if current < self.spec.min_replicas:
             return AutoscalerDecision(scale_up=[ScaleUpDecision(
                 count=self.spec.min_replicas - current)])
+        # Scale-FROM-zero bypasses the upscale delay: with
+        # min_replicas=0 the first request must wake the service
+        # immediately — the requester is already waiting at the LB.
+        # _raw_target is max-capped, so a (degenerate) max_replicas=0
+        # spec stays at zero.
+        if current == 0 and self._current_qps() > 0 and \
+                self._raw_target() > 0:
+            return AutoscalerDecision(scale_up=[ScaleUpDecision(
+                count=self._raw_target())])
         target = self._hysteresis_target(current)
         decision = AutoscalerDecision()
         if target > current:
@@ -228,6 +237,12 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
         current = len(alive)
         if current < self.spec.min_replicas:
             target_total = self.spec.min_replicas
+        elif current == 0 and self._current_qps() > 0 and \
+                self._raw_target() > 0:
+            # Scale-from-zero bypasses hysteresis here too (same
+            # contract as the base autoscaler — the waker is blocked
+            # at the LB).
+            target_total = self._raw_target()
         else:
             target_total = self._hysteresis_target(current)
 

@@ -27,6 +27,11 @@ LB_SYNC_INTERVAL_SECONDS = 20.0
 # never execute twice.
 LB_REPLICA_TIMEOUT_SECONDS = 300.0
 LB_MAX_ATTEMPTS = 3
+# With min_replicas=0 the first request arrives while no replica
+# exists; the LB holds it while the autoscaler wakes one (cold starts
+# include provisioning) instead of bouncing 503 at the waker.
+LB_SCALE_FROM_ZERO_WAIT_SECONDS = 120.0
+LB_SCALE_FROM_ZERO_POLL_SECONDS = 2.0
 
 # Consecutive probe failures before READY -> NOT_READY.
 PROBE_FAILURE_THRESHOLD = 3

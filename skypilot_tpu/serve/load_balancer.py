@@ -72,6 +72,11 @@ class RequestAggregator:
             out, self._timestamps = self._timestamps, []
             return out
 
+    def requeue(self, timestamps: List[float]) -> None:
+        """Return a drained batch after a failed sync (kept in order)."""
+        with self._lock:
+            self._timestamps = sorted(timestamps + self._timestamps)
+
 
 class SkyServeLoadBalancer:
 
@@ -80,12 +85,17 @@ class SkyServeLoadBalancer:
                  sync_interval_seconds: float =
                  constants.LB_SYNC_INTERVAL_SECONDS,
                  replica_timeout_seconds: float =
-                 constants.LB_REPLICA_TIMEOUT_SECONDS) -> None:
+                 constants.LB_REPLICA_TIMEOUT_SECONDS,
+                 scale_from_zero_wait_seconds: float = 0.0) -> None:
+        # scale_from_zero_wait_seconds > 0 ONLY for scale-to-zero
+        # services (serve/service.py wires it); the default keeps the
+        # empty-replica-set fast-503 for everything else.
         self.controller_url = controller_url.rstrip('/')
         self.port = port
         self.policy = lb_policies.LoadBalancingPolicy.from_name(policy_name)
         self.sync_interval = sync_interval_seconds
         self.replica_timeout = replica_timeout_seconds
+        self.scale_from_zero_wait = scale_from_zero_wait_seconds
         self.aggregator = RequestAggregator()
         self._stop = threading.Event()
         self._server: Optional[http.server.ThreadingHTTPServer] = None
@@ -93,16 +103,22 @@ class SkyServeLoadBalancer:
 
     # -- controller sync ---------------------------------------------------
     def _sync_once(self) -> None:
+        timestamps = self.aggregator.drain()
         payload = json.dumps({
-            'request_aggregator': {
-                'timestamps': self.aggregator.drain()
-            }
+            'request_aggregator': {'timestamps': timestamps}
         }).encode()
         req = urllib.request.Request(
             self.controller_url + '/controller/load_balancer_sync',
             data=payload, headers={'Content-Type': 'application/json'})
-        with urllib.request.urlopen(req, timeout=5) as resp:
-            data = json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                data = json.loads(resp.read())
+        except Exception:
+            # A drained-but-unsent batch must survive a transient
+            # controller outage: at scale-from-zero it can hold the
+            # ONLY timestamp that wakes the service.
+            self.aggregator.requeue(timestamps)
+            raise
         self.policy.set_ready_replicas(data.get('ready_replica_urls', []))
 
     def _sync_loop(self) -> None:
@@ -149,6 +165,13 @@ class SkyServeLoadBalancer:
                         break
                     logger.warning(f'Replica {cand} failed TCP probe; '
                                    'trying another replica.')
+                if replica is None and not tried and \
+                        lb.scale_from_zero_wait > 0:
+                    # Scale-from-zero: this request's timestamp is
+                    # already in the aggregator, so the controller
+                    # will wake a replica — hold the request while
+                    # the sync loop learns about it.
+                    replica = self._await_wake()
                 if replica is None:
                     if not tried:
                         self._client_write(
@@ -160,6 +183,16 @@ class SkyServeLoadBalancer:
                                   'unreachable.').encode())
                     return
                 self._forward(replica, data)
+
+            def _await_wake(self) -> Optional[str]:
+                deadline = time.time() + lb.scale_from_zero_wait
+                while time.time() < deadline:
+                    cand = lb.policy.select_replica()
+                    if cand is not None and _probe(cand):
+                        return cand
+                    time.sleep(
+                        constants.LB_SCALE_FROM_ZERO_POLL_SECONDS)
+                return None
 
             def _client_write(self, code: int, body: bytes) -> None:
                 """Send a full response; client-socket failures only

@@ -50,12 +50,22 @@ class ServiceRuntime:
                                          .AUTOSCALER_INTERVAL_SECONDS),
             probe_interval_seconds=(probe_interval_seconds or
                                     constants.PROBE_INTERVAL_SECONDS))
+        # The request-hold on an empty replica set exists ONLY for
+        # scale-to-zero wakes; ordinary services (provisioning or in
+        # outage) must keep fast-failing 503.  The hold must cover the
+        # cold start, which the spec itself estimates via the
+        # readiness probe's initial delay.
+        wake_wait = 0.0
+        if spec.min_replicas == 0:
+            wake_wait = max(constants.LB_SCALE_FROM_ZERO_WAIT_SECONDS,
+                            spec.initial_delay_seconds)
         self.load_balancer = lb_lib.SkyServeLoadBalancer(
             controller_url=f'http://127.0.0.1:{record["controller_port"]}',
             port=record['load_balancer_port'],
             policy_name=record['policy'],
             sync_interval_seconds=(lb_sync_interval_seconds or
-                                   constants.LB_SYNC_INTERVAL_SECONDS))
+                                   constants.LB_SYNC_INTERVAL_SECONDS),
+            scale_from_zero_wait_seconds=wake_wait)
 
     def start(self) -> None:
         self.controller.start()

@@ -41,6 +41,14 @@ class SkyServiceSpec:
         if not readiness_path.startswith('/'):
             raise exceptions.TaskValidationError(
                 f'Readiness path must start with /: {readiness_path!r}')
+        if min_replicas < 0:
+            raise exceptions.TaskValidationError(
+                'min_replicas must be >= 0.')
+        if min_replicas == 0 and target_qps_per_replica is None:
+            raise exceptions.TaskValidationError(
+                'min_replicas=0 (scale-to-zero) requires '
+                'target_qps_per_replica so traffic can wake the '
+                'service.')
         if max_replicas is not None and max_replicas < min_replicas:
             raise exceptions.TaskValidationError(
                 'max_replicas must be >= min_replicas.')

@@ -64,7 +64,8 @@ def _lb():
     """An LB with a no-op controller sync (replicas injected directly)."""
     lb = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:1', port=0,
                                      sync_interval_seconds=3600,
-                                     replica_timeout_seconds=5)
+                                     replica_timeout_seconds=5,
+                                     scale_from_zero_wait_seconds=0)
     # Bind an ephemeral port: replicate start() minus the sync loop.
     lb._server = http.server.ThreadingHTTPServer(
         ('127.0.0.1', 0), lb._make_handler())

@@ -280,3 +280,128 @@ class TestServeRemoteClientSide:
         from skypilot_tpu.serve import remote as serve_remote
         with pytest.raises(exceptions.ClusterDoesNotExist):
             serve_remote.status(controller_cluster='nonexistent-ctrl')
+
+
+class TestScaleToZero:
+    """min_replicas=0: idle services cost nothing; the first request
+    wakes them (reference SkyServe scale-to-zero semantics)."""
+
+    def _autoscaler(self, **kw):
+        kw.setdefault('min_replicas', 0)
+        kw.setdefault('max_replicas', 4)
+        kw.setdefault('target_qps_per_replica', 1.0)
+        kw.setdefault('upscale_delay_seconds', 30)
+        kw.setdefault('downscale_delay_seconds', 2)
+        spec = _spec(**kw)
+        return autoscalers.RequestRateAutoscaler(
+            spec, decision_interval_seconds=1.0, qps_window_seconds=10.0)
+
+    def test_spec_requires_qps_target(self):
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.TaskValidationError,
+                           match='scale-to-zero'):
+            _spec(min_replicas=0)
+        with pytest.raises(exceptions.TaskValidationError,
+                           match='>= 0'):
+            _spec(min_replicas=-1,
+                  target_qps_per_replica=1.0)
+
+    def test_idle_at_zero_is_noop(self):
+        a = self._autoscaler()
+        assert a.evaluate_scaling([]).is_noop
+
+    def test_first_request_wakes_immediately(self):
+        """Scale-from-zero bypasses the (30s) upscale delay — the
+        requester is blocked at the LB."""
+        a = self._autoscaler()
+        now = time.time()
+        a.request_timestamps = [now - 0.5]
+        d = a.evaluate_scaling([])
+        assert d.scale_up and d.scale_up[0].count == 1
+
+    def test_sustained_idle_scales_back_to_zero(self):
+        a = self._autoscaler()
+        replicas = [_replica(1)]
+        a.request_timestamps = []
+        assert a.evaluate_scaling(replicas).is_noop  # hysteresis 1/2
+        d = a.evaluate_scaling(replicas)
+        assert d.scale_down and d.scale_down[0].replica_ids == [1]
+
+    def test_lb_holds_request_until_replica_wakes(self):
+        """A request hitting an empty LB waits for the woken replica
+        instead of bouncing 503."""
+        import http.server as http_server
+        import json as json_lib
+        import threading
+        import urllib.request
+
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        lb = lb_lib.SkyServeLoadBalancer(
+            'http://127.0.0.1:1', port=0, sync_interval_seconds=3600,
+            scale_from_zero_wait_seconds=20)
+        lb._server = lb_lib.LBHTTPServer(
+            ('127.0.0.1', 0), lb._make_handler())
+        threading.Thread(target=lb._server.serve_forever,
+                         daemon=True).start()
+        url = f'http://127.0.0.1:{lb._server.server_address[1]}'
+
+        class _Replica(http_server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = json_lib.dumps({'ok': True}).encode()
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        replica_srv = http_server.ThreadingHTTPServer(
+            ('127.0.0.1', 0), _Replica)
+        threading.Thread(target=replica_srv.serve_forever,
+                         daemon=True).start()
+        replica_url = \
+            f'http://127.0.0.1:{replica_srv.server_address[1]}'
+
+        def _wake():
+            time.sleep(1.0)  # autoscaler provisioning, in miniature
+            lb.policy.set_ready_replicas([replica_url])
+
+        threading.Thread(target=_wake, daemon=True).start()
+        t0 = time.time()
+        with urllib.request.urlopen(url + '/x', timeout=30) as r:
+            assert r.status == 200
+        assert time.time() - t0 >= 0.9  # actually waited for the wake
+        lb.stop()
+        replica_srv.shutdown()
+
+    def test_fallback_autoscaler_also_wakes_from_zero(self):
+        spec = _spec(min_replicas=0, max_replicas=4,
+                     target_qps_per_replica=1.0,
+                     upscale_delay_seconds=300,
+                     downscale_delay_seconds=300,
+                     base_ondemand_fallback_replicas=1)
+        a = autoscalers.FallbackRequestRateAutoscaler(
+            spec, decision_interval_seconds=1.0,
+            qps_window_seconds=10.0)
+        assert a.evaluate_scaling([]).is_noop  # idle stays at zero
+        a.request_timestamps = [time.time() - 0.5]
+        d = a.evaluate_scaling([])
+        assert d.scale_up  # no 300s hysteresis for the waker
+        assert sum(u.count for u in d.scale_up) >= 1
+
+    def test_max_replicas_zero_never_launches(self):
+        a = self._autoscaler(max_replicas=0)
+        a.request_timestamps = [time.time() - 0.5]
+        assert a.evaluate_scaling([]).is_noop
+
+    def test_failed_sync_requeues_wake_timestamp(self, monkeypatch):
+        """A transient controller outage must not eat the only
+        timestamp that wakes a scaled-to-zero service."""
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        lb = lb_lib.SkyServeLoadBalancer(
+            'http://127.0.0.1:1', port=0, sync_interval_seconds=3600)
+        lb.aggregator.add()
+        with pytest.raises(Exception):
+            lb._sync_once()  # controller unreachable
+        assert len(lb.aggregator.drain()) == 1  # requeued, not lost
