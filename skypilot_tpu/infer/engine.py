@@ -168,6 +168,7 @@ class ContinuousBatchingEngine:
                  param_dtype: Any = jnp.bfloat16,
                  prefill_bucket: int = 64,
                  prefill_chunk: int = 0,
+                 kv_read_bucket: int = 512,
                  seed: int = 0) -> None:
         import collections
         import threading
@@ -232,22 +233,28 @@ class ContinuousBatchingEngine:
 
         def _decode_step(p, cache, last, kv_mask, rope_pos, cursors,
                          rng, stepno, active, temps,
-                         top_k: int, top_p: float):
+                         top_k: int, top_p: float, kv_bucket: int):
             """Fused: sample every slot's next token from `last`,
             reveal each ACTIVE slot's write position, one-token
-            forward for all slots."""
+            forward for all slots.  `kv_bucket` (static) caps the
+            decode attention's cache READS to the live prefix — one
+            compile per bucket, big HBM savings while contexts are
+            short."""
+            from skypilot_tpu.models import llama as llama_lib
             step_rng = jax.random.fold_in(rng, stepno)
             tok = sample_logits_batched(last, step_rng, temps, top_k,
                                         top_p)
             brange = jnp.arange(tok.shape[0])
             reveal = kv_mask[brange, cursors] | active
             kv_mask = kv_mask.at[brange, cursors].set(reveal)
-            logits, cache = _forward(p, cache, tok[:, None],
-                                     rope_pos[:, None], kv_mask)
+            with llama_lib.kv_read_bucket(kv_bucket):
+                logits, cache = _forward(p, cache, tok[:, None],
+                                         rope_pos[:, None], kv_mask)
             return tok, logits[:, 0], cache, kv_mask
 
         self._decode = jax.jit(
-            _decode_step, static_argnames=('top_k', 'top_p'),
+            _decode_step,
+            static_argnames=('top_k', 'top_p', 'kv_bucket'),
             donate_argnums=(1, 3))
 
         self._cache = self._eng._fresh_cache()
@@ -266,6 +273,8 @@ class ContinuousBatchingEngine:
         # chunks).  0 = whole-prompt prefill at admission.
         self.prefill_chunk = prefill_chunk
         self._prefills: List[_PendingPrefill] = []
+        # Decode-read bucket granularity (0 disables the read cap).
+        self.kv_read_bucket = kv_read_bucket
         self._submit_lock = threading.Lock()
         self._next_rid = 0
         self._stepno = 0
@@ -516,13 +525,21 @@ class ContinuousBatchingEngine:
             rope[i] = s.prompt_len + s.generated
             active[i] = True
             temps[i] = s.temperature
+        if self.kv_read_bucket > 0:
+            live = int(cursors[occupied].max()) + 1
+            gran = self.kv_read_bucket
+            bucket = min(self.max_seq_len,
+                         ((live + gran - 1) // gran) * gran)
+        else:
+            bucket = self.max_seq_len
         with llama.slot_mode():
             tok_dev, self._last, self._cache, self._kv_mask = \
                 self._decode(
                     self.params, self._cache, self._last, self._kv_mask,
                     jnp.asarray(rope), jnp.asarray(cursors), self._rng,
                     jnp.int32(self._stepno), jnp.asarray(active),
-                    jnp.asarray(temps), top_k=group[0], top_p=group[1])
+                    jnp.asarray(temps), top_k=group[0], top_p=group[1],
+                    kv_bucket=bucket)
         self._stepno += 1
         toks = np.asarray(jax.device_get(tok_dev))
         for i in occupied:

@@ -47,7 +47,8 @@ class InferenceServer:
                  mesh_config: Optional[str] = None,
                  model_overrides=None,
                  continuous: bool = True,
-                 prefill_chunk: int = 0) -> None:
+                 prefill_chunk: int = 0,
+                 kv_read_bucket: int = 512) -> None:
         mesh = None
         if mesh_config:
             from skypilot_tpu.parallel import mesh as mesh_lib
@@ -64,7 +65,8 @@ class InferenceServer:
                 n_slots=max_batch_size,
                 max_seq_len=max_seq_len,
                 model_overrides=model_overrides,
-                prefill_chunk=prefill_chunk)
+                prefill_chunk=prefill_chunk,
+                kv_read_bucket=kv_read_bucket)
         else:
             self.engine = engine_lib.InferenceEngine(
                 model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
@@ -227,6 +229,13 @@ def main() -> None:
                              'this many tokens per decode tick so live '
                              'requests keep generating (0 = whole '
                              'prompt at admission).')
+    parser.add_argument('--kv-read-bucket', type=int, default=512,
+                        help='Decode attention reads only the live '
+                             'cache prefix, rounded up to this bucket '
+                             '(one compile per bucket crossed; big HBM '
+                             'savings at long max-seq-len). 0 reads '
+                             'the full cache and compiles decode '
+                             'exactly once.')
     args = parser.parse_args()
     InferenceServer(model=args.model, port=args.port, host=args.host,
                     max_batch_size=args.max_batch_size,
@@ -234,7 +243,8 @@ def main() -> None:
                     checkpoint_dir=args.checkpoint_dir,
                     mesh_config=args.mesh,
                     continuous=args.continuous,
-                    prefill_chunk=args.prefill_chunk).serve_forever()
+                    prefill_chunk=args.prefill_chunk,
+                    kv_read_bucket=args.kv_read_bucket).serve_forever()
 
 
 if __name__ == '__main__':

@@ -225,6 +225,22 @@ _SLOT_MODE = threading.local()
 
 
 @contextlib.contextmanager
+def kv_read_bucket(n: Optional[int]):
+    """Cap slot-mode decode attention READS to the first `n` cache
+    positions (a static trace-time value; the engine rounds the
+    deepest live cursor up to a bucket and compiles one decode step
+    per bucket).  Writes still target the full cache; positions beyond
+    the deepest cursor are unrevealed, so numerics are identical —
+    this only cuts HBM traffic while contexts are short."""
+    prev = getattr(_SLOT_MODE, 'kv_bucket', None)
+    _SLOT_MODE.kv_bucket = n
+    try:
+        yield
+    finally:
+        _SLOT_MODE.kv_bucket = prev
+
+
+@contextlib.contextmanager
 def slot_mode():
     """Enable per-row cache cursors in run_cached_attention for calls
     traced under this context (ContinuousBatchingEngine wraps its jit
@@ -285,6 +301,16 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
             brange, :, write_pos, :].set(v[:, :, 0, :].astype(dtype))
         cursor.value = idx + 1
         mask = kv_mask[:, None, None, :]
+        # Static read-window over the live prefix of the cache (see
+        # kv_read_bucket) — everything past it is unrevealed for
+        # active rows, so slicing keys/values/mask is exact.  The
+        # shared epilogue below handles the (possibly shortened) set.
+        bucket = getattr(_SLOT_MODE, 'kv_bucket', None)
+        read_len = bucket if (bucket is not None
+                              and bucket < max_len) else max_len
+        keys = cached_k.value[:, :, :read_len]
+        values = cached_v.value[:, :, :read_len]
+        mask = mask[:, :, :, :read_len]
     else:
         cached_k.value = jax.lax.dynamic_update_slice(
             cached_k.value, k.astype(dtype), (0, 0, idx, 0))
@@ -296,7 +322,7 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
         mask = causal[None, None]                  # [1,1,s,max]
         if kv_mask is not None:
             mask = mask & kv_mask[:, None, None, :]
-    keys, values = cached_k.value, cached_v.value
+        keys, values = cached_k.value, cached_v.value
     if kvh != h:
         keys = jnp.repeat(keys, h // kvh, axis=1)
         values = jnp.repeat(values, h // kvh, axis=1)

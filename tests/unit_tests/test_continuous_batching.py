@@ -235,6 +235,41 @@ class TestChunkedPrefill:
         assert all(s is None for s in cpe._slots)
 
 
+class TestKvReadBucket:
+
+    def test_bucketed_reads_match_cache_free(self):
+        """Decode with a tiny read bucket (8) must cross several
+        bucket boundaries mid-generation and stay exact."""
+        eng = engine_lib.ContinuousBatchingEngine(
+            'llama-tiny', n_slots=2, model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32, prefill_bucket=8,
+            kv_read_bucket=8)
+        a, b = [5, 17, 3, 42, 8, 9], [7, 7]
+        outs = eng.generate(
+            [a, b], engine_lib.SamplingConfig(max_new_tokens=20))
+        assert outs[0] == _reference_greedy(eng.params, a, 20)
+        assert outs[1] == _reference_greedy(eng.params, b, 20)
+
+    def test_bucket_never_below_deepest_cursor(self):
+        eng = engine_lib.ContinuousBatchingEngine(
+            'llama-tiny', n_slots=2, model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32, prefill_bucket=8,
+            kv_read_bucket=8)
+        # Slot A deep in context, slot B fresh: the shared bucket must
+        # cover A, and B must still be exact.
+        rid_a = eng.submit(list(range(1, 12)),
+                           engine_lib.SamplingConfig(max_new_tokens=16))
+        for _ in range(10):
+            eng.step()
+        rid_b = eng.submit([4, 5], engine_lib.SamplingConfig(
+            max_new_tokens=4))
+        eng.run_until_idle()
+        assert eng.wait(rid_a) == _reference_greedy(
+            eng.params, list(range(1, 12)), 16)
+        assert eng.wait(rid_b) == _reference_greedy(
+            eng.params, [4, 5], 4)
+
+
 class TestContinuousServer:
 
     def test_concurrent_requests_share_decode_batch(self):
