@@ -159,13 +159,8 @@ def main() -> None:
     overrides = (json.loads(args.model_overrides)
                  if args.model_overrides else dict(_TINY_OVERRIDES))
 
-    if args.platform:
-        import jax
-        jax.config.update('jax_platforms', args.platform)
-    # Hang-proof first backend touch (tunneled TPU backends can wedge
-    # inside PJRT init — see parallel/mesh.devices_with_retry).
     from skypilot_tpu.parallel import mesh as mesh_lib
-    mesh_lib.devices_with_retry()
+    mesh_lib.force_platform_and_touch(args.platform)
 
     srv = _start_replica(args.model, args.slots, args.continuous,
                          args.max_seq_len, overrides,

@@ -49,9 +49,13 @@ class InferenceServer:
                  continuous: bool = True,
                  prefill_chunk: int = 0,
                  kv_read_bucket: int = 512) -> None:
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        # Hang-proof first backend touch: a wedged tunneled TPU makes
+        # this raise (replica exits, probe marks it FAILED) instead of
+        # hanging forever behind a 200 /health that never comes.
+        mesh_lib.force_platform_and_touch()
         mesh = None
         if mesh_config:
-            from skypilot_tpu.parallel import mesh as mesh_lib
             kwargs = {}
             for part in mesh_config.split(','):
                 if part:
@@ -229,6 +233,10 @@ def main() -> None:
                              'this many tokens per decode tick so live '
                              'requests keep generating (0 = whole '
                              'prompt at admission).')
+    parser.add_argument('--platform', default=None,
+                        help="Force a jax platform (e.g. 'cpu' for "
+                             'tests; env JAX_PLATFORMS alone is not '
+                             'enough on tunneled-TPU hosts).')
     parser.add_argument('--kv-read-bucket', type=int, default=512,
                         help='Decode attention reads only the live '
                              'cache prefix, rounded up to this bucket '
@@ -237,6 +245,9 @@ def main() -> None:
                              'the full cache and compiles decode '
                              'exactly once.')
     args = parser.parse_args()
+    if args.platform:
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        mesh_lib.force_platform_and_touch(args.platform)
     InferenceServer(model=args.model, port=args.port, host=args.host,
                     max_batch_size=args.max_batch_size,
                     max_seq_len=args.max_seq_len,

@@ -155,6 +155,16 @@ def _devices_with_retry() -> Sequence[jax.Device]:
 devices_with_retry = _devices_with_retry
 
 
+def force_platform_and_touch(platform: Optional[str] = None) -> None:
+    """Entry-point preamble for serving/bench processes: optionally
+    force a jax platform (env JAX_PLATFORMS alone is not enough on
+    tunneled-TPU hosts whose sitecustomize registers the tunnel), then
+    make the first backend touch hang-proof."""
+    if platform:
+        jax.config.update('jax_platforms', platform)
+    _devices_with_retry()
+
+
 def _clear_backends_best_effort() -> None:
     """Drop jax's cached (failed) backend init so a retry re-attempts."""
     for clear in ('jax.extend.backend.clear_backends',
