@@ -39,17 +39,24 @@ def main() -> None:
                         help='Train only params whose path contains '
                              "this substring (e.g. 'lora'); the rest "
                              'are frozen.')
+    parser.add_argument('--platform', default=None,
+                        help="Force a jax platform (e.g. 'cpu' for "
+                             'smoke runs; env JAX_PLATFORMS alone is '
+                             'not enough on tunneled-TPU hosts).')
     parser.add_argument('--model-overrides', default=None,
                         help='JSON dict of model-config overrides, '
                              "e.g. '{\"dim\": 1536, \"n_layers\": 12}'")
     args = parser.parse_args()
 
-    # Honor an explicit JAX_PLATFORMS even when the interpreter's
-    # sitecustomize captured a different platform at startup (this
-    # environment pins 'axon'); same recipe as tests/conftest.py.
+    # Honor --platform / an explicit JAX_PLATFORMS even when the
+    # interpreter's sitecustomize captured a different platform at
+    # startup (this environment pins 'axon'); same recipe as
+    # tests/conftest.py.
     import os
-    plat = os.environ.get('JAX_PLATFORMS')
-    if plat and ',' not in plat:
+    plat = args.platform or os.environ.get('JAX_PLATFORMS')
+    # (The single-platform guard only applies to the ambient env var;
+    # an explicit --platform, comma list or not, is always honored.)
+    if args.platform or (plat and ',' not in plat):
         import jax
         jax.config.update('jax_platforms', plat)
 
@@ -89,17 +96,21 @@ def main() -> None:
     else:
         trainer.init_state()
 
+    # Resume token-exact: a recovered job's data stream starts where
+    # the lost run's left off (the managed-jobs checkpoint contract).
+    start_step = int(trainer.state.step)
     if args.dataset:
         data_iter = data_lib.hf_text_data(
             trainer.mesh, dataset_name=args.dataset,
             tokenizer_name=args.tokenizer or args.dataset,
             global_batch_size=config.global_batch_size,
-            seq_len=config.seq_len)
+            seq_len=config.seq_len, start_step=start_step)
     else:
         data_iter = data_lib.synthetic_data(
             trainer.mesh, global_batch_size=config.global_batch_size,
             seq_len=config.seq_len,
-            vocab_size=trainer.model_config.vocab_size)
+            vocab_size=trainer.model_config.vocab_size,
+            start_step=start_step)
 
     remaining = args.steps - int(trainer.state.step)
     metrics = trainer.train(data_iter, num_steps=max(remaining, 0),

@@ -18,6 +18,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
 
 def _global_batch(mesh: Mesh, local: Dict[str, np.ndarray]
                   ) -> Dict[str, jax.Array]:
@@ -29,19 +33,27 @@ def _global_batch(mesh: Mesh, local: Dict[str, np.ndarray]
 
 
 def synthetic_data(mesh: Mesh, *, global_batch_size: int, seq_len: int,
-                   vocab_size: int, seed: int = 0
+                   vocab_size: int, seed: int = 0, start_step: int = 0
                    ) -> Iterator[Dict[str, jax.Array]]:
-    """Infinite deterministic LM batches: inputs + next-token targets."""
+    """Infinite deterministic LM batches: inputs + next-token targets.
+
+    Per-step counter-based seeding makes resume token-exact and O(1):
+    a recovered job passes `start_step` (its restored step) and sees
+    exactly the batches the lost run would have seen next.
+    """
     num_hosts = jax.process_count()
     if global_batch_size % num_hosts != 0:
         raise ValueError(
             f'global_batch_size {global_batch_size} not divisible by '
             f'{num_hosts} hosts.')
     local_bs = global_batch_size // num_hosts
-    rng = np.random.default_rng(seed + jax.process_index())
+    step = start_step
     while True:
+        rng = np.random.default_rng(
+            (seed, jax.process_index(), step))
         tokens = rng.integers(1, vocab_size, (local_bs, seq_len + 1),
                               dtype=np.int32)
+        step += 1
         yield _global_batch(mesh, {
             'inputs': tokens[:, :-1],
             'targets': tokens[:, 1:],
@@ -52,10 +64,17 @@ def synthetic_data(mesh: Mesh, *, global_batch_size: int, seq_len: int,
 def hf_text_data(mesh: Mesh, *, dataset_name: str, tokenizer_name: str,
                  global_batch_size: int, seq_len: int,
                  split: str = 'train', text_field: str = 'text',
-                 seed: int = 0) -> Iterator[Dict[str, jax.Array]]:
+                 seed: int = 0, start_step: int = 0
+                 ) -> Iterator[Dict[str, jax.Array]]:
     """Packed-causal-LM batches from a HF dataset (each host streams its
     own shard — per-host sharded loading, SURVEY.md §2.11 'per-host
-    sharded data loading')."""
+    sharded data loading').
+
+    `start_step` fast-forwards the packed stream past the sequences a
+    resumed job already consumed — token-exact given the same
+    dataset/seed (it replays tokenization for the skipped prefix, so
+    resume cost is IO/tokenizer time, not training time).
+    """
     try:
         import datasets  # type: ignore
         from transformers import AutoTokenizer  # type: ignore
@@ -79,6 +98,20 @@ def hf_text_data(mesh: Mesh, *, dataset_name: str, tokenizer_name: str,
                 buffer = buffer[seq_len:]
 
     stream = packed()
+    if start_step > 0:
+        skip = start_step * local_bs
+        logger.info(f'Resuming data stream: skipping {skip} packed '
+                    f'sequences ({start_step} steps).')
+        for i in range(skip):
+            try:
+                next(stream)
+            except StopIteration:
+                raise RuntimeError(
+                    f'Dataset {dataset_name!r} exhausted during '
+                    f'resume fast-forward after {i}/{skip} packed '
+                    'sequences — did the dataset, split, or host '
+                    'count change since the checkpoint was written?'
+                ) from None
     while True:
         rows = [next(stream) for _ in range(local_bs)]
         tokens = np.stack(rows)
