@@ -85,6 +85,68 @@ def sample_logits_batched(logits: jax.Array, rng: jax.Array,
     return jnp.where(temps > 0, sampled, greedy)
 
 
+_QUANT_KEYS = frozenset(('q8', 'scale'))
+
+
+def quantize_params_int8(params: Any) -> Any:
+    """Weight-only int8: matmul kernels and token embeddings become
+    {'q8': int8, 'scale': f32} with per-output-channel scales (absmax
+    over the leaf's FIRST axis — its input/vocab axis; quantized
+    serving forces scan_layers=False so no leaf carries a leading
+    layer axis).  Halves the param bytes decode must stream from HBM —
+    the dominant cost of TPU decode — with dequant fused into each
+    consumer.  Biases/norms/rope tables stay float."""
+    import flax
+
+    flat = flax.traverse_util.flatten_dict(params)
+    out = {}
+    for key, x in flat.items():
+        x = jnp.asarray(x)
+        name = str(key[-1])
+        if (name == 'kernel' or name == 'tok_embed') and x.ndim >= 2 \
+                and jnp.issubdtype(x.dtype, jnp.floating):
+            scale = jnp.max(jnp.abs(x), axis=0, keepdims=True) / 127.0
+            scale = jnp.maximum(scale, 1e-8).astype(jnp.float32)
+            q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                         -127, 127).astype(jnp.int8)
+            out[key + ('q8',)] = q
+            out[key + ('scale',)] = scale
+        else:
+            out[key] = x
+    return flax.traverse_util.unflatten_dict(out)
+
+
+def unstack_scanned_params(params: Any, n_layers: int) -> Any:
+    """Scanned-layer params ('layers' subtree with a leading [L] axis,
+    how the trainer saves them by default) -> the unscanned layout
+    ('layer_i' subtrees) that quantized serving uses."""
+    import flax
+
+    flat = flax.traverse_util.flatten_dict(params)
+    out = {}
+    for key, x in flat.items():
+        if key[0] == 'layers':
+            for i in range(n_layers):
+                out[(f'layer_{i}',) + key[1:]] = x[i]
+        else:
+            out[key] = x
+    return flax.traverse_util.unflatten_dict(out)
+
+
+def _is_quant_leaf(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and set(leaf) == _QUANT_KEYS
+
+
+def maybe_dequantize_params(params: Any, dtype: Any) -> Any:
+    """Inverse of quantize_params_int8, run INSIDE the jitted forward
+    so the int8 weights are what lives in (and streams from) HBM."""
+    return jax.tree.map(
+        lambda leaf: (leaf['q8'].astype(jnp.float32)
+                      * leaf['scale']).astype(dtype)
+        if _is_quant_leaf(leaf) else leaf,
+        params, is_leaf=_is_quant_leaf)
+
+
 def _cache_sharding(mesh, leaf) -> NamedSharding:
     """KV caches shard their kv-heads dim over `tensor` (matching the
     attention head sharding); scalars/cursors replicate.  Leaf shapes:
@@ -169,6 +231,7 @@ class ContinuousBatchingEngine:
                  prefill_bucket: int = 64,
                  prefill_chunk: int = 0,
                  kv_read_bucket: int = 512,
+                 quantize: Optional[str] = None,
                  seed: int = 0) -> None:
         import collections
         import threading
@@ -180,7 +243,7 @@ class ContinuousBatchingEngine:
             checkpoint_dir=checkpoint_dir, max_batch_size=n_slots,
             max_seq_len=max_seq_len, model_overrides=model_overrides,
             param_dtype=param_dtype, prefill_bucket=prefill_bucket,
-            seed=seed)
+            quantize=quantize, seed=seed)
         self.model = self._eng.model
         self.config = self._eng.config
         self.mesh = mesh
@@ -202,6 +265,7 @@ class ContinuousBatchingEngine:
             self._cache1_shardings = None
 
         def _forward(p, cache, tokens, positions, kv_mask):
+            p = maybe_dequantize_params(p, self.config.param_dtype)
             logits, mutated = self.model.apply(
                 {'params': p, 'cache': cache}, tokens, positions,
                 kv_mask, mutable=['cache'])
@@ -582,13 +646,30 @@ class InferenceEngine:
                  model_overrides: Optional[Dict[str, Any]] = None,
                  param_dtype: Any = jnp.bfloat16,
                  prefill_bucket: int = 64,
+                 quantize: Optional[str] = None,
                  seed: int = 0) -> None:
+        if quantize not in (None, 'int8'):
+            raise ValueError(f"quantize must be None or 'int8', got "
+                             f'{quantize!r}.')
+        if quantize and mesh is not None:
+            raise NotImplementedError(
+                'int8 serving is single-device for now: quantized '
+                'leaves do not carry mesh shardings yet.')
+        self.quantize = quantize
         overrides = dict(model_overrides or {})
         overrides.update(decode=True, remat=False)
+        if quantize:
+            # Scanned layers would (a) give stacked kernels a leading
+            # layer axis that breaks per-output-channel scales and
+            # (b) force the dequantized tree to materialize as the
+            # scan while-loop's input each step, erasing the HBM win.
+            # Unscanned decode graphs fuse dequant into each consumer.
+            overrides['scan_layers'] = False
         overrides.setdefault('param_dtype', param_dtype)
         if max_seq_len is not None:
             overrides['max_seq_len'] = max_seq_len
         self.model, self.config = models_lib.get_model(model, **overrides)
+        self._model_name, self._overrides = model, dict(overrides)
         self.max_batch = max_batch_size
         self.max_seq_len = self.config.max_seq_len
         self.prefill_bucket = max(1, prefill_bucket)
@@ -633,8 +714,17 @@ class InferenceEngine:
                     _init_params, out_shardings=param_shardings)()
             else:
                 self.params = _init_params()
+        if self.quantize == 'int8':
+            if isinstance(self.params, dict) and 'layers' in self.params:
+                # Caller handed scanned-layout weights (the trainer
+                # default); this engine runs unscanned.
+                self.params = unstack_scanned_params(
+                    self.params, self.config.n_layers)
+            self.params = jax.tree.map(  # materialize, then quantize
+                jnp.asarray, quantize_params_int8(self.params))
 
         def _forward(p, cache, tokens, positions, kv_mask):
+            p = maybe_dequantize_params(p, self.config.param_dtype)
             logits, mutated = self.model.apply(
                 {'params': p, 'cache': cache}, tokens, positions,
                 kv_mask, mutable=['cache'])
@@ -720,6 +810,14 @@ class InferenceEngine:
             restored = ckpt_lib.load_params_for_serving(
                 manager, abs_tree, step=latest)
         except ValueError as e:
+            if self.quantize:
+                # Quantized serving uses the unscanned layout, but the
+                # trainer saves scanned ('layers' stacked) trees by
+                # default: restore scanned, then unstack.
+                scanned = self._try_load_scanned(ckpt_lib, manager,
+                                                 latest)
+                if scanned is not None:
+                    return scanned
             # Genuine tree/shape mismatch; other failures (network,
             # auth, corruption) propagate with their own tracebacks.
             hint = ''
@@ -734,6 +832,30 @@ class InferenceEngine:
                 f'{self.config.name!r}: {e}{hint}') from e
         logger.info(f'loaded checkpoint step {latest} from {directory}')
         return restored
+
+    def _try_load_scanned(self, ckpt_lib, manager, latest):
+        """Restore a scanned-layout checkpoint and unstack it into the
+        unscanned layout; None if the scanned shape doesn't fit
+        either."""
+        scanned_model, _ = models_lib.get_model(
+            self._model_name,
+            **{**self._overrides, 'scan_layers': True})
+        rng = jax.random.PRNGKey(0)
+        abstract = jax.eval_shape(lambda: scanned_model.init(
+            rng, jnp.zeros((1, 1), jnp.int32)))['params']
+        single = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        abs_tree = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=single),
+            sharding_lib.unbox(abstract))
+        try:
+            restored = ckpt_lib.load_params_for_serving(
+                manager, abs_tree, step=latest)
+        except ValueError:
+            return None
+        logger.info('loaded scanned checkpoint; unstacking layers for '
+                    'quantized (unscanned) serving.')
+        return unstack_scanned_params(restored, self.config.n_layers)
 
     def _fresh_cache(self):
         def _make(leaf, sharding=None):

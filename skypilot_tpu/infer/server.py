@@ -48,7 +48,8 @@ class InferenceServer:
                  model_overrides=None,
                  continuous: bool = True,
                  prefill_chunk: int = 0,
-                 kv_read_bucket: int = 512) -> None:
+                 kv_read_bucket: int = 512,
+                 quantize=None) -> None:
         from skypilot_tpu.parallel import mesh as mesh_lib
         # Hang-proof first backend touch: a wedged tunneled TPU makes
         # this raise (replica exits, probe marks it FAILED) instead of
@@ -70,12 +71,14 @@ class InferenceServer:
                 max_seq_len=max_seq_len,
                 model_overrides=model_overrides,
                 prefill_chunk=prefill_chunk,
-                kv_read_bucket=kv_read_bucket)
+                kv_read_bucket=kv_read_bucket,
+                quantize=quantize)
         else:
             self.engine = engine_lib.InferenceEngine(
                 model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
                 max_batch_size=max_batch_size,
-                max_seq_len=max_seq_len, model_overrides=model_overrides)
+                max_seq_len=max_seq_len,
+                model_overrides=model_overrides, quantize=quantize)
         # Warm the compile caches (smallest prefill bucket + decode) so
         # /health flips to ready only after the common-path compiles are
         # done.  Other prefill buckets still compile on first use.
@@ -233,6 +236,10 @@ def main() -> None:
                              'this many tokens per decode tick so live '
                              'requests keep generating (0 = whole '
                              'prompt at admission).')
+    parser.add_argument('--quantize', default=None,
+                        choices=['int8'],
+                        help='Weight-only int8 serving: halves param '
+                             'HBM traffic (single-device only).')
     parser.add_argument('--platform', default=None,
                         help="Force a jax platform (e.g. 'cpu' for "
                              'tests; env JAX_PLATFORMS alone is not '
@@ -255,7 +262,8 @@ def main() -> None:
                     mesh_config=args.mesh,
                     continuous=args.continuous,
                     prefill_chunk=args.prefill_chunk,
-                    kv_read_bucket=args.kv_read_bucket).serve_forever()
+                    kv_read_bucket=args.kv_read_bucket,
+                    quantize=args.quantize).serve_forever()
 
 
 if __name__ == '__main__':

@@ -1,0 +1,138 @@
+"""Weight-only int8 serving: the quantized engine must behave exactly
+like serving the dequantized weights (the quantization ERROR is a
+modeling decision; the engine plumbing must add none of its own)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import engine as engine_lib
+from tests.unit_tests.test_infer import _OVERRIDES, _reference_greedy
+
+
+class TestQuantizeTree:
+
+    def test_kernels_quantized_norms_untouched(self):
+        eng = engine_lib.InferenceEngine(
+            'llama-tiny', model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32, quantize='int8')
+        leaves = jax.tree_util.tree_leaves_with_path(
+            eng.params, is_leaf=engine_lib._is_quant_leaf)
+        q8 = [l for _, l in leaves if engine_lib._is_quant_leaf(l)]
+        plain = [l for _, l in leaves
+                 if not engine_lib._is_quant_leaf(l)]
+        assert q8, 'no quantized leaves'
+        for leaf in q8:
+            assert leaf['q8'].dtype == jnp.int8
+            assert leaf['scale'].dtype == jnp.float32
+        # Norm scales etc. (ndim < 2) stay float.
+        assert all(jnp.issubdtype(x.dtype, jnp.floating)
+                   for x in plain)
+
+    def test_round_trip_exact_for_representable_weights(self):
+        """Integers times the per-column scale survive exactly when
+        every column's absmax is 127."""
+        rng = np.random.default_rng(0)
+        ints = rng.integers(-126, 127, (14, 18)).astype(np.float32)
+        ints[0, :] = 127.0   # pin per-column absmax
+        col_scale = np.linspace(0.5, 2.0, 18,
+                                dtype=np.float32)[None, :]
+        w = jnp.asarray(ints * col_scale)
+        q = engine_lib.quantize_params_int8({'kernel': w})
+        np.testing.assert_array_equal(np.asarray(q['kernel']['q8']),
+                                      ints.astype(np.int8))
+        back = engine_lib.maybe_dequantize_params(q, jnp.float32)
+        np.testing.assert_allclose(np.asarray(back['kernel']),
+                                   np.asarray(w), rtol=1e-6)
+
+    def test_per_channel_scales(self):
+        w = jnp.stack([jnp.ones(4), 100 * jnp.ones(4)], axis=1)  # [4,2]
+        q = engine_lib.quantize_params_int8({'kernel': w})['kernel']
+        assert q['scale'].shape == (1, 2)
+        back = engine_lib.maybe_dequantize_params({'kernel': q},
+                                                  jnp.float32)['kernel']
+        np.testing.assert_allclose(np.asarray(back), np.asarray(w),
+                                   rtol=1e-2)
+
+
+class TestQuantizedEngineEquivalence:
+
+    def test_quantized_engine_matches_dequantized_weights(self):
+        """Engine(quantize) == Engine(params=dequantize(quantize(p))):
+        the serving plumbing around the weights is bit-identical.
+        The quantized engine unstacks the (default-scanned) weights it
+        is handed, so the reference must quantize the same unstacked
+        tree."""
+        base = engine_lib.InferenceEngine(
+            'llama-tiny', max_batch_size=2,
+            model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32)
+        unstacked = engine_lib.unstack_scanned_params(
+            base.params, base.config.n_layers)
+        deq = engine_lib.maybe_dequantize_params(
+            engine_lib.quantize_params_int8(unstacked), jnp.float32)
+        ref = engine_lib.InferenceEngine(
+            'llama-tiny', max_batch_size=2, params=deq,
+            model_overrides={**_OVERRIDES, 'scan_layers': False},
+            param_dtype=jnp.float32)
+        qeng = engine_lib.ContinuousBatchingEngine(
+            'llama-tiny', n_slots=2, params=base.params,
+            model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32, quantize='int8')
+        prompts = [[5, 17, 3, 42], [9, 1]]
+        cfg = engine_lib.SamplingConfig(max_new_tokens=6)
+        assert qeng.generate(prompts, cfg) == ref.generate(prompts,
+                                                           cfg)
+
+    def test_scanned_checkpoint_served_quantized(self, tmp_path):
+        """The trainer saves scanned trees by default; quantized
+        serving restores them and unstacks."""
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.train import checkpoint as ckpt_lib
+        from skypilot_tpu.train import trainer as trainer_lib
+        config = trainer_lib.TrainConfig(
+            model='llama-tiny', global_batch_size=8, seq_len=32,
+            total_steps=1, mesh=mesh_lib.MeshConfig(data=1, fsdp=-1),
+            model_overrides={**_OVERRIDES, 'max_seq_len': 64})
+        trainer = trainer_lib.Trainer(config)
+        trainer.init_state()
+        manager = ckpt_lib.make_manager(str(tmp_path / 'ckpt'))
+        ckpt_lib.save(manager, trainer.state, wait=True)
+
+        eng = engine_lib.ContinuousBatchingEngine(
+            'llama-tiny', checkpoint_dir=str(tmp_path / 'ckpt'),
+            n_slots=2, model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32, quantize='int8')
+        out = eng.generate([[1, 2, 3]],
+                           engine_lib.SamplingConfig(max_new_tokens=3))
+        assert len(out[0]) == 3
+
+    def test_quantized_outputs_close_to_fp(self):
+        """Int8 weight error must not derail a tiny model's greedy
+        path for short continuations (sanity, not exactness)."""
+        base = engine_lib.InferenceEngine(
+            'llama-tiny', model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32)
+        qeng = engine_lib.ContinuousBatchingEngine(
+            'llama-tiny', n_slots=2, params=base.params,
+            model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32, quantize='int8')
+        got = qeng.generate([[5, 17, 3]],
+                            engine_lib.SamplingConfig(
+                                max_new_tokens=2))[0]
+        want = _reference_greedy(base.params, [5, 17, 3], 2)
+        assert got[0] == want[0]  # first token robust to 8-bit error
+
+    def test_mesh_rejected(self):
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(data=1, fsdp=-1))
+        with pytest.raises(NotImplementedError, match='single-device'):
+            engine_lib.InferenceEngine(
+                'llama-tiny', mesh=mesh,
+                model_overrides=dict(_OVERRIDES), quantize='int8')
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match='int8'):
+            engine_lib.InferenceEngine(
+                'llama-tiny', model_overrides=dict(_OVERRIDES),
+                quantize='fp4')
