@@ -41,12 +41,14 @@ _TINY_OVERRIDES = {'n_heads': 4, 'n_kv_heads': 2, 'n_layers': 2,
 def _start_replica(model: str, slots: int, continuous: bool,
                    max_seq_len: Optional[int],
                    overrides: Optional[Dict[str, Any]],
-                   prefill_chunk: int = 0):
+                   prefill_chunk: int = 0,
+                   quantize: Optional[str] = None):
     from skypilot_tpu.infer import server as server_lib
     srv = server_lib.InferenceServer(
         model=model, port=0, host='127.0.0.1', max_batch_size=slots,
         max_seq_len=max_seq_len, model_overrides=overrides,
-        continuous=continuous, prefill_chunk=prefill_chunk)
+        continuous=continuous, prefill_chunk=prefill_chunk,
+        quantize=quantize)
     srv.start()
     threading.Thread(target=srv._server.serve_forever,  # pylint: disable=protected-access
                      daemon=True).start()
@@ -151,6 +153,7 @@ def main() -> None:
     parser.add_argument('--no-continuous', dest='continuous',
                         action='store_false', default=True)
     parser.add_argument('--prefill-chunk', type=int, default=0)
+    parser.add_argument('--quantize', default=None, choices=['int8'])
     parser.add_argument('--platform', default=None,
                         help="Force a jax platform (e.g. 'cpu' for the "
                              'smoke run; env JAX_PLATFORMS alone is '
@@ -164,7 +167,7 @@ def main() -> None:
 
     srv = _start_replica(args.model, args.slots, args.continuous,
                          args.max_seq_len, overrides,
-                         args.prefill_chunk)
+                         args.prefill_chunk, args.quantize)
     lb, lb_url = _start_lb(f'http://127.0.0.1:{srv.port}')
     try:
         # Warm every concurrency level's compile paths once.
