@@ -4,7 +4,8 @@ The reference drives EC2 through boto3 (sky/provision/aws/instance.py);
 this is the SDK-free equivalent, mirroring the stance of the first-
 party GCP REST client (provision/gcp/gcp_api.py).  Only the operations
 the provisioner needs: RunInstances, TerminateInstances, StopInstances,
-StartInstances, DescribeInstances, CreateTags.
+StartInstances, DescribeInstances, CreateTags,
+Authorize/RevokeSecurityGroupIngress.
 
 All calls route through `_call`, so tests monkeypatch exactly one seam.
 """
@@ -174,3 +175,32 @@ def stop_instances(region: str, instance_ids: List[str]) -> None:
 def start_instances(region: str, instance_ids: List[str]) -> None:
     if instance_ids:
         _call('StartInstances', region, _instance_id_params(instance_ids))
+
+
+def _sg_rule_params(group_id: str, from_port: int, to_port: int,
+                    protocol: str, cidr: str) -> Dict[str, str]:
+    return {
+        'GroupId': group_id,
+        'IpPermissions.1.IpProtocol': protocol,
+        'IpPermissions.1.FromPort': str(from_port),
+        'IpPermissions.1.ToPort': str(to_port),
+        'IpPermissions.1.IpRanges.1.CidrIp': cidr,
+    }
+
+
+def authorize_security_group_ingress(region: str, group_id: str,
+                                     from_port: int, to_port: int,
+                                     protocol: str = 'tcp',
+                                     cidr: str = '0.0.0.0/0') -> None:
+    """Open [from_port, to_port] on a security group (reference:
+    boto3 authorize_security_group_ingress)."""
+    _call('AuthorizeSecurityGroupIngress', region,
+          _sg_rule_params(group_id, from_port, to_port, protocol, cidr))
+
+
+def revoke_security_group_ingress(region: str, group_id: str,
+                                  from_port: int, to_port: int,
+                                  protocol: str = 'tcp',
+                                  cidr: str = '0.0.0.0/0') -> None:
+    _call('RevokeSecurityGroupIngress', region,
+          _sg_rule_params(group_id, from_port, to_port, protocol, cidr))

@@ -249,15 +249,74 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
     )
 
 
+def _port_range(port: str) -> tuple:
+    """'8000' -> (8000, 8000); '8000-8010' -> (8000, 8010)."""
+    s = str(port)
+    if '-' in s:
+        lo, hi = s.split('-', 1)
+        return int(lo), int(hi)
+    return int(s), int(s)
+
+
+def _cluster_group_ids(region: str,
+                       cluster_name_on_cloud: str) -> List[str]:
+    """Security groups of the cluster's LIVE instances — terminated
+    nodes linger in DescribeInstances for ~an hour and can reference
+    since-deleted groups."""
+    insts = ec2_api.describe_instances(
+        region, _cluster_filter(cluster_name_on_cloud))
+    group_ids = set()
+    for inst in insts:
+        if _state(inst) in ('terminated', 'shutting-down'):
+            continue
+        groups = inst.get('groupSet', [])
+        if isinstance(groups, dict):
+            groups = [groups]
+        for g in groups:
+            gid = g.get('groupId')
+            if gid:
+                group_ids.add(str(gid))
+    return sorted(group_ids)
+
+
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    # Security-group mutation is not implemented in the REST-thin
-    # client; default VPC SG rules are assumed (reference implements
-    # this via boto3 authorize_security_group_ingress).
-    logger.warning('AWS open_ports is a no-op in this build; open %s '
-                   'on the security group manually.', ports)
+    """Authorize ingress on every security group the cluster's live
+    instances belong to (reference: boto3
+    authorize_security_group_ingress).  Re-opening an already-open
+    port is a no-op (InvalidPermission.Duplicate tolerated).
+    cleanup_ports revokes the same rules at teardown — on a SHARED
+    (default-VPC) security group the open window exists only while
+    the cluster does."""
+    region = _region(provider_config)
+    for gid in _cluster_group_ids(region, cluster_name_on_cloud):
+        for port in ports:
+            lo, hi = _port_range(port)
+            try:
+                ec2_api.authorize_security_group_ingress(
+                    region, gid, lo, hi)
+            except ec2_api.AwsApiError as e:
+                if e.code != 'InvalidPermission.Duplicate':
+                    raise
 
 
 def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name_on_cloud, ports, provider_config
+    """Revoke exactly the ingress rules open_ports added — the rules
+    must not outlive the cluster on a shared security group.  Missing
+    rules (already revoked, group deleted) are tolerated; a
+    pre-existing identical user rule would be revoked too, the
+    documented cost of SG sharing."""
+    region = _region(provider_config)
+    for gid in _cluster_group_ids(region, cluster_name_on_cloud):
+        for port in ports:
+            lo, hi = _port_range(port)
+            try:
+                ec2_api.revoke_security_group_ingress(region, gid,
+                                                      lo, hi)
+            except ec2_api.AwsApiError as e:
+                if e.code not in ('InvalidPermission.NotFound',
+                                  'InvalidGroup.NotFound'):
+                    logger.warning(
+                        f'cleanup_ports: could not revoke {port} on '
+                        f'{gid}: {e}')

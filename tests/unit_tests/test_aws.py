@@ -343,3 +343,106 @@ class TestAwsCatalogAndCloud:
         monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 's')
         ok, _ = aws.check_credentials()
         assert ok
+
+
+class TestOpenPorts:
+
+    def test_opens_on_all_cluster_groups(self, fake_ec2, monkeypatch):
+        aws_instance.run_instances('us-east-1', 'c1', _pconfig(count=2))
+        # Attach security groups to the fake instances.
+        for inst in fake_ec2.instances.values():
+            inst['groupSet'] = [{'groupId': 'sg-1'},
+                                {'groupId': 'sg-2'}]
+        calls = []
+
+        def fake_auth(region, gid, lo, hi, protocol='tcp',
+                      cidr='0.0.0.0/0'):
+            calls.append((gid, lo, hi))
+
+        monkeypatch.setattr(aws_instance.ec2_api,
+                            'authorize_security_group_ingress',
+                            fake_auth)
+        aws_instance.open_ports('c1', ['8000', '9000-9005'],
+                                {'region': 'us-east-1'})
+        assert ('sg-1', 8000, 8000) in calls
+        assert ('sg-2', 9000, 9005) in calls
+        assert len(calls) == 4  # 2 groups x 2 port specs
+
+    def test_duplicate_rule_tolerated(self, fake_ec2, monkeypatch):
+        aws_instance.run_instances('us-east-1', 'c2', _pconfig())
+        for inst in fake_ec2.instances.values():
+            inst['groupSet'] = [{'groupId': 'sg-1'}]
+
+        def dup(*a, **k):
+            raise ec2_api.AwsApiError(
+                400, 'InvalidPermission.Duplicate', 'exists')
+
+        monkeypatch.setattr(aws_instance.ec2_api,
+                            'authorize_security_group_ingress', dup)
+        aws_instance.open_ports('c2', ['8000'],
+                                {'region': 'us-east-1'})  # no raise
+
+    def test_other_errors_propagate(self, fake_ec2, monkeypatch):
+        aws_instance.run_instances('us-east-1', 'c3', _pconfig())
+        for inst in fake_ec2.instances.values():
+            inst['groupSet'] = [{'groupId': 'sg-1'}]
+
+        def deny(*a, **k):
+            raise ec2_api.AwsApiError(403, 'UnauthorizedOperation',
+                                      'nope')
+
+        monkeypatch.setattr(aws_instance.ec2_api,
+                            'authorize_security_group_ingress', deny)
+        with pytest.raises(ec2_api.AwsApiError):
+            aws_instance.open_ports('c3', ['8000'],
+                                    {'region': 'us-east-1'})
+
+    def test_terminated_instances_groups_skipped(self, fake_ec2,
+                                                 monkeypatch):
+        aws_instance.run_instances('us-east-1', 'c4', _pconfig(count=2))
+        ids = sorted(fake_ec2.instances)
+        for iid in ids:
+            fake_ec2.instances[iid]['groupSet'] = [
+                {'groupId': 'sg-live'}]
+        # One instance terminated with a stale (deleted) group.
+        fake_ec2.instances[ids[0]]['instanceState'] = {
+            'name': 'terminated'}
+        fake_ec2.instances[ids[0]]['groupSet'] = [
+            {'groupId': 'sg-stale'}]
+        calls = []
+        monkeypatch.setattr(
+            aws_instance.ec2_api, 'authorize_security_group_ingress',
+            lambda region, gid, lo, hi, **k: calls.append(gid))
+        aws_instance.open_ports('c4', ['8000'],
+                                {'region': 'us-east-1'})
+        assert calls == ['sg-live']
+
+    def test_cleanup_revokes_what_open_added(self, fake_ec2,
+                                             monkeypatch):
+        aws_instance.run_instances('us-east-1', 'c5', _pconfig())
+        for inst in fake_ec2.instances.values():
+            inst['groupSet'] = [{'groupId': 'sg-1'}]
+        revoked = []
+        monkeypatch.setattr(
+            aws_instance.ec2_api, 'revoke_security_group_ingress',
+            lambda region, gid, lo, hi, **k: revoked.append(
+                (gid, lo, hi)))
+        aws_instance.cleanup_ports('c5', ['8000', '9000-9005'],
+                                   {'region': 'us-east-1'})
+        assert ('sg-1', 8000, 8000) in revoked
+        assert ('sg-1', 9000, 9005) in revoked
+
+    def test_cleanup_tolerates_missing_rule(self, fake_ec2,
+                                            monkeypatch):
+        aws_instance.run_instances('us-east-1', 'c6', _pconfig())
+        for inst in fake_ec2.instances.values():
+            inst['groupSet'] = [{'groupId': 'sg-1'}]
+
+        def gone(*a, **k):
+            raise ec2_api.AwsApiError(
+                400, 'InvalidPermission.NotFound', 'no such rule')
+
+        monkeypatch.setattr(aws_instance.ec2_api,
+                            'revoke_security_group_ingress', gone)
+        aws_instance.cleanup_ports('c6', ['8000'],
+                                   {'region': 'us-east-1'})  # no raise
