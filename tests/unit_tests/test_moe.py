@@ -107,3 +107,34 @@ class TestMoETrainer:
             trainer_lib.Trainer(trainer_lib.TrainConfig(
                 model='mixtral-tiny', global_batch_size=8, seq_len=128,
                 mesh=mesh_lib.MeshConfig(data=1, fsdp=-1, pipe=2)))
+
+
+class TestMoEServing:
+    """Mixtral through the continuous-batching engine — the reference
+    serves Mixtral via vLLM (llm/mixtral/); here it's first-party."""
+
+    def test_continuous_engine_matches_cache_free(self):
+        import numpy as np
+
+        from skypilot_tpu import models
+        from skypilot_tpu.infer import engine as engine_lib
+        overrides = {'max_seq_len': 64, 'dtype': jnp.float32,
+                     'param_dtype': jnp.float32, 'remat': False}
+        eng = engine_lib.ContinuousBatchingEngine(
+            'mixtral-tiny', n_slots=2, model_overrides=dict(overrides),
+            param_dtype=jnp.float32, prefill_bucket=8)
+        prompt = [5, 17, 3, 9]
+        got = eng.generate(
+            [prompt], engine_lib.SamplingConfig(max_new_tokens=5))[0]
+
+        model, _ = models.get_model('mixtral-tiny', decode=False,
+                                    **overrides)
+        toks = list(prompt)
+        want = []
+        for _ in range(5):
+            logits = model.apply({'params': eng.params},
+                                 jnp.asarray([toks], jnp.int32))
+            nxt = int(jnp.argmax(logits[0, -1]))
+            want.append(nxt)
+            toks.append(nxt)
+        assert got == want, (got, want)
