@@ -218,20 +218,33 @@ class TestInferenceServerE2E:
         from skypilot_tpu.serve import service_spec as spec_lib
         t.set_service(spec_lib.SkyServiceSpec(
             readiness_path='/health',
-            initial_delay_seconds=240,   # engine compile on CPU
+            # Engine compile on CPU; generous — under a fully loaded
+            # suite the replica's warmup can take minutes.
+            initial_delay_seconds=600,
             readiness_timeout_seconds=3,
             min_replicas=1))
         name, endpoint = serve_core.up(t, service_name='svc-infer',
                                        mode='inline', **_FAST)
         try:
-            _wait_ready(name, 1, timeout=240)
+            _wait_ready(name, 1, timeout=600)
             req = urllib.request.Request(
                 endpoint + '/generate',
                 data=json.dumps({'prompt_ids': [[1, 2, 3]],
                                  'max_new_tokens': 4}).encode(),
                 headers={'Content-Type': 'application/json'})
-            with urllib.request.urlopen(req, timeout=120) as resp:
-                body = json.loads(resp.read())
+            # READY in the controller propagates to the LB on its next
+            # sync tick — retry 503s briefly.
+            deadline = time.time() + 30
+            while True:
+                try:
+                    with urllib.request.urlopen(req,
+                                                timeout=120) as resp:
+                        body = json.loads(resp.read())
+                    break
+                except urllib.error.HTTPError as e:
+                    if e.code != 503 or time.time() > deadline:
+                        raise
+                    time.sleep(0.5)
             assert len(body['tokens']) == 1
             assert len(body['tokens'][0]) == 4
         finally:
