@@ -103,13 +103,20 @@ def restore(manager, state):
     if latest is None:
         return None
     if _is_legacy_layout(manager, latest):
+        # PyTreeRestore's `item` alone does not carry shardings into
+        # the array handler (same gotcha as ArrayRestore): explicit
+        # restore_args or Orbax reads the checkpoint's sharding file.
+        item = {
+            'params': _abstract(state.params),
+            'opt_state': _abstract(state.opt_state),
+            'step': _abstract(state.step),
+        }
         restored = manager.restore(
             latest, args=ocp.args.Composite(
-                state=ocp.args.PyTreeRestore(item={
-                    'params': _abstract(state.params),
-                    'opt_state': _abstract(state.opt_state),
-                    'step': _abstract(state.step),
-                })))['state']
+                state=ocp.args.PyTreeRestore(
+                    item=item,
+                    restore_args=ocp.checkpoint_utils
+                    .construct_restore_args(item))))['state']
     else:
         restored = manager.restore(
             latest, args=ocp.args.Composite(
@@ -145,12 +152,27 @@ def _flatten_metadata(meta):
     return out
 
 
+def _ensure_shardings(tree):
+    """Attach a SingleDeviceSharding to any abstract leaf that lacks
+    one — every restore must carry an explicit sharding (never the
+    checkpoint's sharding file; wrong topology on recovery)."""
+    default = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                       sharding=default)
+        if isinstance(a, jax.ShapeDtypeStruct)
+        and getattr(a, 'sharding', None) is None else a,
+        tree)
+
+
 def load_params_for_serving(manager, abstract_params,
                             step: Optional[int] = None):
     """Params-only load for the inference engine: abstract_params is a
-    tree of ShapeDtypeStructs (with serving shardings); handles both
-    the split layout and the legacy single-'state' layout."""
+    tree of ShapeDtypeStructs (with serving shardings; leaves without
+    one default to the first device); handles both the split layout
+    and the legacy single-'state' layout."""
     import orbax.checkpoint as ocp
+    abstract_params = _ensure_shardings(abstract_params)
     latest = step if step is not None else manager.latest_step()
     if latest is None:
         raise FileNotFoundError('no checkpoint step found')
@@ -158,10 +180,13 @@ def load_params_for_serving(manager, abstract_params,
         # Legacy: params live inside the 'state' item.  partial_restore
         # pulls ONLY the params subtree — a serving host sized for the
         # params must not materialize the (2x larger) optimizer state.
+        item = {'params': abstract_params}
         restored = manager.restore(
             latest, args=ocp.args.Composite(
                 state=ocp.args.PyTreeRestore(
-                    item={'params': abstract_params},
+                    item=item,
+                    restore_args=ocp.checkpoint_utils
+                    .construct_restore_args(item),
                     partial_restore=True)))['state']
         return restored['params']
     restored = manager.restore(

@@ -6,6 +6,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# Every Orbax restore must pass explicit shardings — the sharding-file
+# fallback is unsafe across topologies (managed-jobs recovery).
+pytestmark = pytest.mark.filterwarnings(
+    'error:Sharding info not provided')
+
 from skypilot_tpu.models import llama
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.parallel import sharding as sharding_lib
