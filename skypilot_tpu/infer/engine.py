@@ -49,6 +49,14 @@ class SamplingConfig:
     top_p: float = 1.0           # 1 => disabled
     eos_id: Optional[int] = None
     max_new_tokens: int = 64
+    # Per-request reproducibility: with a seed set, the sampled draw
+    # uses per-row keys folding (seed, generated_index) — independent
+    # of which other requests share the decode batch or when the
+    # request was admitted.  (Exact to compiled-graph numerics: batch
+    # companions can shift the kv-read-bucket compile and thus
+    # last-bit logits; at a near-tie that can still flip a token.)
+    # The request-level engine seeds the whole generate() call.
+    seed: Optional[int] = None
 
 
 def sample_logits(logits: jax.Array, rng: jax.Array,
@@ -61,12 +69,13 @@ def sample_logits(logits: jax.Array, rng: jax.Array,
                                  config.top_p)
 
 
-def sample_logits_batched(logits: jax.Array, rng: jax.Array,
-                          temps: jax.Array, top_k: int,
-                          top_p: float) -> jax.Array:
-    """Per-row-temperature sampling [B, V] -> [B]: rows with temp<=0
-    decode greedily, the rest sample — one jit for a continuous batch
-    whose slots carry different requests' sampling configs."""
+def sample_logits_rows(logits: jax.Array, keys: jax.Array,
+                       temps: jax.Array, top_k: int,
+                       top_p: float) -> jax.Array:
+    """Per-row sampling [B, V] -> [B] with one PRNG key per row: rows
+    with temp<=0 decode greedily, the rest sample — one jit for a
+    continuous batch whose slots carry different requests' sampling
+    configs AND seeds."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     safe = jnp.where(temps > 0, temps, 1.0)[:, None]
     scaled = logits / safe
@@ -80,9 +89,19 @@ def sample_logits_batched(logits: jax.Array, rng: jax.Array,
         cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         scaled = jnp.where(scaled < cutoff, -1e30, scaled)
-    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(
-        jnp.int32)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row))(
+            keys, scaled).astype(jnp.int32)
     return jnp.where(temps > 0, sampled, greedy)
+
+
+def sample_logits_batched(logits: jax.Array, rng: jax.Array,
+                          temps: jax.Array, top_k: int,
+                          top_p: float) -> jax.Array:
+    """Shared-rng variant (request-level engine): rows draw from
+    per-row splits of one key."""
+    keys = jax.random.split(rng, logits.shape[0])
+    return sample_logits_rows(logits, keys, temps, top_k, top_p)
 
 
 _QUANT_KEYS = frozenset(('q8', 'scale'))
@@ -170,6 +189,7 @@ class _Slot:
     temperature: float
     top_k: int
     top_p: float
+    seed: int = 0
     generated: int = 0
     outputs: List[int] = dataclasses.field(default_factory=list)
 
@@ -296,18 +316,21 @@ class ContinuousBatchingEngine:
         self._insert = jax.jit(_insert, donate_argnums=(0, 1, 2))
 
         def _decode_step(p, cache, last, kv_mask, rope_pos, cursors,
-                         rng, stepno, active, temps,
+                         seeds, gens, active, temps,
                          top_k: int, top_p: float, kv_bucket: int):
             """Fused: sample every slot's next token from `last`,
             reveal each ACTIVE slot's write position, one-token
-            forward for all slots.  `kv_bucket` (static) caps the
-            decode attention's cache READS to the live prefix — one
-            compile per bucket, big HBM savings while contexts are
-            short."""
+            forward for all slots.  Per-row keys fold (request seed,
+            generated index) so a seeded request's continuation is
+            reproducible regardless of batch composition or admission
+            time.  `kv_bucket` (static) caps the decode attention's
+            cache READS to the live prefix — one compile per bucket,
+            big HBM savings while contexts are short."""
             from skypilot_tpu.models import llama as llama_lib
-            step_rng = jax.random.fold_in(rng, stepno)
-            tok = sample_logits_batched(last, step_rng, temps, top_k,
-                                        top_p)
+            keys = jax.vmap(
+                lambda sd, g: jax.random.fold_in(
+                    jax.random.PRNGKey(sd), g))(seeds, gens)
+            tok = sample_logits_rows(last, keys, temps, top_k, top_p)
             brange = jnp.arange(tok.shape[0])
             reveal = kv_mask[brange, cursors] | active
             kv_mask = kv_mask.at[brange, cursors].set(reveal)
@@ -341,8 +364,7 @@ class ContinuousBatchingEngine:
         self.kv_read_bucket = kv_read_bucket
         self._submit_lock = threading.Lock()
         self._next_rid = 0
-        self._stepno = 0
-        self._rng = jax.random.PRNGKey(seed + 1)
+        self._seed0 = seed
 
     @property
     def params(self):
@@ -361,6 +383,15 @@ class ContinuousBatchingEngine:
                 f'prompt ({len(prompt_ids)}) + max_new_tokens '
                 f'({cfg.max_new_tokens}) exceeds max_seq_len '
                 f'{self.max_seq_len}.')
+        if cfg.seed is not None:
+            # Coerce + mask HERE (caller thread): a bad seed must 400
+            # the one request, never blow up the shared decode loop.
+            try:
+                cfg = dataclasses.replace(
+                    cfg, seed=int(cfg.seed) & 0x7FFFFFFF)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f'seed must be an integer: '
+                                 f'{cfg.seed!r}') from e
         with self._submit_lock:
             rid = self._next_rid
             self._next_rid += 1
@@ -484,11 +515,13 @@ class ContinuousBatchingEngine:
             pending.last_row, jnp.asarray(pending.mask_row),
             jnp.int32(pending.slot_idx))
         cfg = pending.cfg
+        seed = cfg.seed if cfg.seed is not None else (
+            hash((self._seed0, pending.rid)) & 0x7FFFFFFF)
         self._slots[pending.slot_idx] = _Slot(
             request_id=pending.rid, prompt_len=pending.true_len,
             pad_len=pending.pad, max_new=cfg.max_new_tokens,
             eos_id=cfg.eos_id, temperature=cfg.temperature,
-            top_k=cfg.top_k, top_p=cfg.top_p)
+            top_k=cfg.top_k, top_p=cfg.top_p, seed=seed)
 
     def _complete(self, slot_idx: int) -> None:
         slot = self._slots[slot_idx]
@@ -583,12 +616,16 @@ class ContinuousBatchingEngine:
         rope = np.zeros((b,), np.int32)
         active = np.zeros((b,), bool)
         temps = np.zeros((b,), np.float32)
+        seeds = np.zeros((b,), np.int32)
+        gens = np.zeros((b,), np.int32)
         for i in occupied:
             s = self._slots[i]
             cursors[i] = s.pad_len + s.generated
             rope[i] = s.prompt_len + s.generated
             active[i] = True
             temps[i] = s.temperature
+            seeds[i] = s.seed
+            gens[i] = s.generated
         if self.kv_read_bucket > 0:
             live = int(cursors[occupied].max()) + 1
             gran = self.kv_read_bucket
@@ -600,11 +637,10 @@ class ContinuousBatchingEngine:
             tok_dev, self._last, self._cache, self._kv_mask = \
                 self._decode(
                     self.params, self._cache, self._last, self._kv_mask,
-                    jnp.asarray(rope), jnp.asarray(cursors), self._rng,
-                    jnp.int32(self._stepno), jnp.asarray(active),
-                    jnp.asarray(temps), top_k=group[0], top_p=group[1],
-                    kv_bucket=bucket)
-        self._stepno += 1
+                    jnp.asarray(rope), jnp.asarray(cursors),
+                    jnp.asarray(seeds), jnp.asarray(gens),
+                    jnp.asarray(active), jnp.asarray(temps),
+                    top_k=group[0], top_p=group[1], kv_bucket=bucket)
         toks = np.asarray(jax.device_get(tok_dev))
         for i in occupied:
             s = self._slots[i]
@@ -920,7 +956,10 @@ class InferenceEngine:
 
         cache = self._fresh_cache()
         self._generation += 1
-        rng = jax.random.fold_in(self._rng, self._generation)
+        if cfg.seed is not None:
+            rng = jax.random.PRNGKey(int(cfg.seed) & 0x7FFFFFFF)
+        else:
+            rng = jax.random.fold_in(self._rng, self._generation)
         ctx = self.mesh if self.mesh is not None \
             else contextlib.nullcontext()
         with ctx:

@@ -129,7 +129,9 @@ class InferenceServer:
             top_k=int(payload.get('top_k', 0)),
             top_p=float(payload.get('top_p', 1.0)),
             eos_id=payload.get('eos_id'),
-            max_new_tokens=int(payload.get('max_new_tokens', 64)))
+            max_new_tokens=int(payload.get('max_new_tokens', 64)),
+            seed=(int(payload['seed'])
+                  if payload.get('seed') is not None else None))
         if self.continuous:
             # All-or-nothing: a rejected prompt (e.g. overlong) must
             # not strand its siblings decoding with no reader.
