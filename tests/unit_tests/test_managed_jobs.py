@@ -74,8 +74,12 @@ class TestManagedJobs:
         # (flaked at 150s once the suite passed 400 tests).
         jobs.wait(job_id, timeout=300)
         assert jobs.get_status(job_id) == jobs.ManagedJobStatus.SUCCEEDED
-        info = jobs_state.get_job_info(job_id)
-        assert info['schedule_state'] == jobs_state.ScheduleState.DONE
+        # The scheduler flips ALIVE -> DONE shortly AFTER the job
+        # reaches terminal status; don't assert the transition
+        # instantly.
+        _wait(lambda: jobs_state.get_job_info(job_id)['schedule_state']
+              == jobs_state.ScheduleState.DONE, timeout=60,
+              desc='schedule_state DONE')
 
     def test_user_failure_not_recovered(self):
         job_id = jobs.launch(_local_task('exit 1', name='mjf'),
