@@ -31,6 +31,68 @@ import os
 import sys
 import time
 
+def _cache_path() -> str:
+    return os.environ.get(
+        'SKYTPU_BENCH_CACHE',
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     'BENCH_CACHE.json'))
+
+
+def _write_cache(result: dict, raw: dict) -> None:
+    """Opportunistic capture (round-3 verdict): every successful
+    real-TPU measurement is persisted so a later capture window that
+    hits the wedged-tunnel hours can fall back to a real, dated number
+    instead of value 0."""
+    payload = dict(result)
+    payload['raw'] = raw
+    payload['captured_unix'] = time.time()
+    payload['captured_at'] = time.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                           time.gmtime())
+    tmp = _cache_path() + '.tmp'
+    try:
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, _cache_path())
+        print(f'# cached measurement -> {_cache_path()}',
+              file=sys.stderr)
+    except OSError as e:  # cache is best-effort; never sink a run
+        print(f'# could not write bench cache: {e}', file=sys.stderr)
+
+
+def emit_cached_result() -> bool:
+    """Final ladder rung: emit the last in-round hardware number,
+    marked stale, instead of value 0.  Returns False if none exists."""
+    try:
+        with open(_cache_path(), encoding='utf-8') as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if not payload.get('value'):
+        return False
+    # Age bound: "in-round" means hours, not a relic from a previous
+    # round masquerading as current performance.
+    max_age_s = float(os.environ.get('SKYTPU_BENCH_CACHE_MAX_AGE_S',
+                                     str(24 * 3600)))
+    captured = payload.get('captured_unix')
+    if captured is None or time.time() - captured > max_age_s:
+        print(f'# bench cache at {_cache_path()} too old '
+              f'(captured_at={payload.get("captured_at")}); ignoring',
+              file=sys.stderr)
+        return False
+    result = {k: payload[k] for k in
+              ('metric', 'value', 'unit', 'vs_baseline')
+              if k in payload}
+    if 'provision_to_first_step_s' in payload:
+        result['provision_to_first_step_s'] = \
+            payload['provision_to_first_step_s']
+    result['stale'] = True
+    result['captured_at'] = payload.get('captured_at')
+    print(json.dumps(result))
+    print(f'# live attempts failed; emitted cached measurement from '
+          f'{payload.get("captured_at")}', file=sys.stderr)
+    return True
+
+
 class BenchError(RuntimeError):
     """A benchmark attempt produced no metric (job failed, no metrics
     line, backend refused init, ...).  Carries a log tail for stderr."""
@@ -106,12 +168,20 @@ def _emit(tokens_per_sec: float, n_params: float, n_chips: int,
         result['provision_to_first_step_s'] = round(
             provision_to_first_step, 1)
     print(json.dumps(result))
+    mfu = total_flops_per_sec / (max(n_chips, 1) * chip_tflops * 1e12)
     print(f'# raw: {tokens_per_sec:,.0f} tok/s, model='
           f'{n_params/1e6:.0f}M params, '
           f'{total_flops_per_sec/1e12:.1f} TFLOP/s (incl. attention) on '
           f'{n_chips} chip(s) [{device_kind}], '
-          f'mfu~{total_flops_per_sec/(max(n_chips,1)*chip_tflops*1e12):.2%}'
+          f'mfu~{mfu:.2%}'
           f'{extra}', file=sys.stderr)
+    if 'TPU' in device_kind.upper():
+        _write_cache(result, {
+            'tokens_per_sec': round(tokens_per_sec, 1),
+            'n_params': n_params, 'n_chips': n_chips,
+            'device_kind': device_kind, 'seq': seq,
+            'mfu': round(mfu, 4), 'mode': extra.strip() or 'direct',
+        })
 
 
 def run_direct(quick: bool, steps_arg) -> None:
@@ -264,6 +334,7 @@ def _finish_through_launch(sky, cluster, job_id, handle, step_log,
                            launch_started, overrides) -> None:
     deadline = time.time() + float(
         os.environ.get('SKYTPU_BENCH_E2E_DEADLINE_S', '3600'))
+    status = None  # stays None if the deadline elapses before one poll
     while time.time() < deadline:
         status = sky.job_status(cluster, [job_id])[job_id]
         if status in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP',
@@ -277,6 +348,10 @@ def _finish_through_launch(sky, cluster, job_id, handle, step_log,
     if os.path.exists(log_path):
         with open(log_path, encoding='utf-8') as f:
             log = f.read()
+    if status is None:
+        raise BenchError('e2e deadline elapsed before any status poll '
+                         '(SKYTPU_BENCH_E2E_DEADLINE_S too small?)',
+                         log[-2000:])
     if status != 'SUCCEEDED':
         raise BenchError(f'job {status}', log[-2000:])
     metrics = None
@@ -341,17 +416,34 @@ def main() -> None:
                 print(tail, file=sys.stderr)
             if attempt == 0:
                 time.sleep(15)
-    print('# falling back to --direct (subprocess trainer)',
-          file=sys.stderr)
-    try:
-        run_direct_subprocess(args.steps)
-        return
-    except BaseException as e:  # noqa: BLE001
-        if isinstance(e, (KeyboardInterrupt, SystemExit)):
-            raise
-        failures.append(f'direct fallback: {e!r}')
-        print(f'# bench --direct fallback failed: {e!r}',
+    # Spaced --direct attempts: the tunnel hang can outlast any single
+    # watchdog window, so fresh-process attempts are spread over tens
+    # of minutes rather than fired back-to-back (round-3 verdict).
+    direct_attempts = int(os.environ.get(
+        'SKYTPU_BENCH_DIRECT_ATTEMPTS', '3'))
+    spacing_s = float(os.environ.get(
+        'SKYTPU_BENCH_DIRECT_SPACING_S', '600'))
+    for attempt in range(direct_attempts):
+        if attempt > 0:
+            print(f'# waiting {spacing_s:.0f}s before --direct attempt '
+                  f'{attempt + 1}/{direct_attempts} (fresh backend '
+                  f'window)', file=sys.stderr)
+            time.sleep(spacing_s)
+        print(f'# falling back to --direct (subprocess trainer, '
+              f'attempt {attempt + 1}/{direct_attempts})',
               file=sys.stderr)
+        try:
+            run_direct_subprocess(args.steps)
+            return
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+            failures.append(f'direct attempt {attempt + 1}: {e!r}')
+            print(f'# bench --direct attempt {attempt + 1} failed: '
+                  f'{e!r}', file=sys.stderr)
+    # Last rung: a dated in-round measurement beats no number at all.
+    if emit_cached_result():
+        return
     print(json.dumps({'metric': 'bench-e2e', 'value': 0,
                       'unit': 'error', 'vs_baseline': 0,
                       'error': ' | '.join(failures)[:900]}))

@@ -1,0 +1,54 @@
+#!/bin/bash
+# Opportunistic in-round benchmark capture (round-3 verdict item 1).
+#
+# The tunneled TPU backend on this host wedges (hangs inside PJRT
+# init) for hours at a time.  This loop probes it with a KILLABLE
+# subprocess on a spaced cadence; the first healthy window runs the
+# full bench ladder (e2e sky-launch first, so the capture carries
+# provision-to-first-step), which persists its result to
+# BENCH_CACHE.json via bench.py's _write_cache.  bench.py's final
+# ladder rung then emits that dated number if the driver's own capture
+# window lands on a wedged tunnel again.
+#
+# Usage: nohup scripts/bench_opportunistic.sh &   (or under tmux)
+# Stops by itself after a successful capture or MAX_HOURS.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${BENCH_PROBE_LOG:-.bench_probe.log}
+MAX_HOURS=${BENCH_PROBE_MAX_HOURS:-11}
+PROBE_SPACING_S=${BENCH_PROBE_SPACING_S:-900}
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+
+echo "[$(date -u +%FT%TZ)] probe loop start (spacing ${PROBE_SPACING_S}s)" >> "$LOG"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  # Killable probe: a wedged tunnel is killed by `timeout`, never
+  # wedging this loop (memory: in-process retry would deadlock on
+  # jax's backend lock).
+  if SKYTPU_BACKEND_INIT_TIMEOUT_S=90 SKYTPU_BACKEND_INIT_RETRIES=0 \
+     timeout 150 python -c "
+from skypilot_tpu.parallel import mesh
+devs = mesh.devices_with_retry()
+kinds = {getattr(d, 'device_kind', '') for d in devs}
+assert any('TPU' in k.upper() for k in kinds), kinds
+print('tunnel healthy:', kinds)
+" >> "$LOG" 2>&1; then
+    echo "[$(date -u +%FT%TZ)] tunnel healthy -> full bench capture" >> "$LOG"
+    if SKYTPU_BENCH_E2E_DEADLINE_S=2400 \
+       SKYTPU_BENCH_DIRECT_TIMEOUT_S=2400 \
+       SKYTPU_BENCH_DIRECT_ATTEMPTS=1 \
+       timeout 5400 python bench.py >> "$LOG" 2>&1; then
+      if [ -s BENCH_CACHE.json ]; then
+        echo "[$(date -u +%FT%TZ)] capture SUCCESS, cache written" >> "$LOG"
+        exit 0
+      fi
+      echo "[$(date -u +%FT%TZ)] bench rc=0 but no cache (CPU run?)" >> "$LOG"
+    else
+      echo "[$(date -u +%FT%TZ)] bench capture failed (rc=$?)" >> "$LOG"
+    fi
+  else
+    echo "[$(date -u +%FT%TZ)] tunnel still wedged (probe killed/failed)" >> "$LOG"
+  fi
+  sleep "$PROBE_SPACING_S"
+done
+echo "[$(date -u +%FT%TZ)] probe loop gave up after ${MAX_HOURS}h" >> "$LOG"
+exit 1

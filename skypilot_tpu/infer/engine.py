@@ -378,6 +378,12 @@ class ContinuousBatchingEngine:
         cfg = sampling or SamplingConfig()
         if len(prompt_ids) == 0:
             raise ValueError('empty prompt')
+        if cfg.max_new_tokens < 1:
+            # step() appends the sampled token before checking the
+            # budget, so 0/negative would still emit one token (and a
+            # negative value breaks the _admit pad clamp).
+            raise ValueError(
+                f'max_new_tokens must be >= 1, got {cfg.max_new_tokens}')
         if len(prompt_ids) + cfg.max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f'prompt ({len(prompt_ids)}) + max_new_tokens '

@@ -107,7 +107,8 @@ def run_instances(region: str, zone: str, *, image_id: str,
                   tags: Dict[str, str], use_spot: bool = False,
                   disk_size_gb: int = 256,
                   key_name: Optional[str] = None,
-                  user_data_b64: Optional[str] = None
+                  user_data_b64: Optional[str] = None,
+                  security_group_ids: Optional[List[str]] = None
                   ) -> List[Dict[str, Any]]:
     params: Dict[str, str] = {
         'ImageId': image_id,
@@ -129,6 +130,8 @@ def run_instances(region: str, zone: str, *, image_id: str,
         params['KeyName'] = key_name
     if user_data_b64:
         params['UserData'] = user_data_b64
+    for i, gid in enumerate(security_group_ids or [], 1):
+        params[f'SecurityGroupId.{i}'] = gid
     resp = _call('RunInstances', region, params)
     instances = resp.get('instancesSet', [])
     if isinstance(instances, dict):
@@ -175,6 +178,52 @@ def stop_instances(region: str, instance_ids: List[str]) -> None:
 def start_instances(region: str, instance_ids: List[str]) -> None:
     if instance_ids:
         _call('StartInstances', region, _instance_id_params(instance_ids))
+
+
+def create_security_group(region: str, group_name: str,
+                          description: str,
+                          tags: Dict[str, str]) -> str:
+    """Create a security group in the default VPC; returns the group
+    id (reference: boto3 create_security_group)."""
+    params = {
+        'GroupName': group_name,
+        'GroupDescription': description,
+        'TagSpecification.1.ResourceType': 'security-group',
+    }
+    params.update(_tag_params('TagSpecification.1', tags))
+    resp = _call('CreateSecurityGroup', region, params)
+    return str(resp.get('groupId', ''))
+
+
+def describe_security_groups(region: str,
+                             filters: Dict[str, str]
+                             ) -> List[Dict[str, Any]]:
+    params: Dict[str, str] = {}
+    for i, (name, value) in enumerate(sorted(filters.items()), 1):
+        params[f'Filter.{i}.Name'] = name
+        params[f'Filter.{i}.Value.1'] = value
+    resp = _call('DescribeSecurityGroups', region, params)
+    groups = resp.get('securityGroupInfo', [])
+    if isinstance(groups, dict):
+        groups = [groups]
+    return groups
+
+
+def delete_security_group(region: str, group_id: str) -> None:
+    _call('DeleteSecurityGroup', region, {'GroupId': group_id})
+
+
+def authorize_security_group_self_ingress(region: str,
+                                          group_id: str) -> None:
+    """Allow ALL traffic between members of the group (the default
+    VPC SG has this built in; a dedicated group must add it or
+    intra-cluster traffic — jax.distributed coordinator, agent RPC —
+    is blocked)."""
+    _call('AuthorizeSecurityGroupIngress', region, {
+        'GroupId': group_id,
+        'IpPermissions.1.IpProtocol': '-1',
+        'IpPermissions.1.Groups.1.GroupId': group_id,
+    })
 
 
 def _sg_rule_params(group_id: str, from_port: int, to_port: int,

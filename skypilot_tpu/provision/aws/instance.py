@@ -9,6 +9,7 @@ instance id — over the SigV4 REST client.
 from __future__ import annotations
 
 import base64
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -78,6 +79,58 @@ def _ssh_key_user_data(auth_config: Dict[str, Any]) -> Optional[str]:
     return base64.b64encode(script.encode()).decode()
 
 
+def _sg_name(cluster_name_on_cloud: str) -> str:
+    return f'skytpu-{cluster_name_on_cloud}'
+
+
+def _find_cluster_sg(region: str,
+                     cluster_name_on_cloud: str) -> Optional[str]:
+    groups = ec2_api.describe_security_groups(
+        region, {'group-name': _sg_name(cluster_name_on_cloud)})
+    for g in groups:
+        gid = g.get('groupId')
+        if gid:
+            return str(gid)
+    return None
+
+
+def _ensure_cluster_sg(region: str, cluster_name_on_cloud: str) -> str:
+    """Dedicated per-cluster security group (reference behavior) so
+    open_ports/cleanup_ports never touch the shared default-VPC group
+    — revoking there could cut traffic other clusters or pre-existing
+    user rules depend on.  SSH is opened at creation."""
+    existing = _find_cluster_sg(region, cluster_name_on_cloud)
+    if existing:
+        return existing
+    try:
+        gid = ec2_api.create_security_group(
+            region, _sg_name(cluster_name_on_cloud),
+            f'skytpu cluster {cluster_name_on_cloud}',
+            {_CLUSTER_TAG: cluster_name_on_cloud})
+    except ec2_api.AwsApiError as e:
+        if e.code != 'InvalidGroup.Duplicate':
+            raise
+        gid = _find_cluster_sg(region, cluster_name_on_cloud) or ''
+    if not gid:
+        raise exceptions.ProvisionError(
+            f'could not create security group for '
+            f'{cluster_name_on_cloud}')
+    try:
+        ec2_api.authorize_security_group_ingress(region, gid, 22, 22)
+    except ec2_api.AwsApiError as e:
+        if e.code != 'InvalidPermission.Duplicate':
+            raise
+    # Self-referencing allow-all: without it the dedicated group
+    # blocks node↔node traffic (jax.distributed coordinator :8476,
+    # agent RPC) that the default-VPC SG's built-in self-rule allowed.
+    try:
+        ec2_api.authorize_security_group_self_ingress(region, gid)
+    except ec2_api.AwsApiError as e:
+        if e.code != 'InvalidPermission.Duplicate':
+            raise
+    return gid
+
+
 def run_instances(region: str, cluster_name_on_cloud: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
     node_cfg = config.node_config
@@ -117,6 +170,7 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                 'Name': cluster_name_on_cloud}
         tags.update(config.tags)
         try:
+            sg_id = _ensure_cluster_sg(region, cluster_name_on_cloud)
             instances = ec2_api.run_instances(
                 region, zone,
                 image_id=image,
@@ -128,6 +182,7 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                 key_name=node_cfg.get('key_name'),
                 user_data_b64=_ssh_key_user_data(
                     config.authentication_config),
+                security_group_ids=[sg_id],
             )
         except ec2_api.AwsApiError as e:
             raise _classify(e) from None
@@ -172,6 +227,43 @@ def terminate_instances(cluster_name_on_cloud: str,
     if worker_only and ids:
         ids = ids[1:]
     ec2_api.terminate_instances(region, ids)
+    if not worker_only:
+        _delete_cluster_sg_best_effort(region, cluster_name_on_cloud)
+
+
+def _delete_cluster_sg_best_effort(region: str,
+                                   cluster_name_on_cloud: str) -> None:
+    """The dedicated SG can only be deleted once the terminated
+    instances' ENIs detach — AWS holds the attachment until the
+    instance reaches 'terminated' (tens of seconds), so an immediate
+    delete would hit DependencyViolation on virtually every teardown
+    and leak the group.  Retry with backoff for a bounded window
+    (SKYTPU_AWS_SG_DELETE_WAIT_S, default 120); on final failure the
+    group stays tagged to the cluster for a later terminate retry or
+    manual collection."""
+    gid = _find_cluster_sg(region, cluster_name_on_cloud)
+    if gid is None:
+        return
+    deadline = time.time() + float(
+        os.environ.get('SKYTPU_AWS_SG_DELETE_WAIT_S', '120'))
+    while True:
+        try:
+            ec2_api.delete_security_group(region, gid)
+            return
+        except ec2_api.AwsApiError as e:
+            if e.code == 'InvalidGroup.NotFound':
+                return
+            if e.code != 'DependencyViolation':
+                logger.warning(
+                    f'could not delete security group {gid}: {e}')
+                return
+            if time.time() >= deadline:
+                logger.warning(
+                    f'security group {gid} still attached after '
+                    f'delete window; leaving it (tagged '
+                    f'{_CLUSTER_TAG}={cluster_name_on_cloud}).')
+                return
+            time.sleep(10)
 
 
 _STATUS_MAP = {
@@ -258,65 +350,85 @@ def _port_range(port: str) -> tuple:
     return int(s), int(s)
 
 
-def _cluster_group_ids(region: str,
-                       cluster_name_on_cloud: str) -> List[str]:
-    """Security groups of the cluster's LIVE instances — terminated
-    nodes linger in DescribeInstances for ~an hour and can reference
-    since-deleted groups."""
+def _live_instance_group_ids(region: str,
+                             cluster_name_on_cloud: str) -> List[str]:
     insts = ec2_api.describe_instances(
         region, _cluster_filter(cluster_name_on_cloud))
-    group_ids = set()
+    gids = set()
     for inst in insts:
         if _state(inst) in ('terminated', 'shutting-down'):
             continue
         groups = inst.get('groupSet', [])
         if isinstance(groups, dict):
             groups = [groups]
-        for g in groups:
-            gid = g.get('groupId')
-            if gid:
-                group_ids.add(str(gid))
-    return sorted(group_ids)
+        gids.update(str(g['groupId']) for g in groups
+                    if g.get('groupId'))
+    return sorted(gids)
 
 
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    """Authorize ingress on every security group the cluster's live
-    instances belong to (reference: boto3
-    authorize_security_group_ingress).  Re-opening an already-open
-    port is a no-op (InvalidPermission.Duplicate tolerated).
-    cleanup_ports revokes the same rules at teardown — on a SHARED
-    (default-VPC) security group the open window exists only while
-    the cluster does."""
+    """Authorize ingress on the cluster's DEDICATED security group
+    (reference: boto3 authorize_security_group_ingress on a
+    per-cluster SG) — never on a shared group, so the rules affect
+    only this cluster's instances.  Re-opening an already-open port
+    is a no-op (InvalidPermission.Duplicate tolerated)."""
     region = _region(provider_config)
-    for gid in _cluster_group_ids(region, cluster_name_on_cloud):
-        for port in ports:
-            lo, hi = _port_range(port)
-            try:
-                ec2_api.authorize_security_group_ingress(
-                    region, gid, lo, hi)
-            except ec2_api.AwsApiError as e:
-                if e.code != 'InvalidPermission.Duplicate':
-                    raise
+    gid = _ensure_cluster_sg(region, cluster_name_on_cloud)
+    live_gids = _live_instance_group_ids(region, cluster_name_on_cloud)
+    if live_gids and gid not in live_gids:
+        # Cluster predates the dedicated-SG scheme: rules on the
+        # (detached) dedicated group would silently open nothing.
+        # Target the groups the live instances actually belong to.
+        logger.warning(
+            f'{cluster_name_on_cloud}: instances not attached to '
+            f'{_sg_name(cluster_name_on_cloud)}; opening ports on '
+            f'their attached group(s) {live_gids} instead.')
+        for legacy_gid in live_gids:
+            for port in ports:
+                lo, hi = _port_range(port)
+                try:
+                    ec2_api.authorize_security_group_ingress(
+                        region, legacy_gid, lo, hi)
+                except ec2_api.AwsApiError as e:
+                    if e.code != 'InvalidPermission.Duplicate':
+                        raise
+        return
+    for port in ports:
+        lo, hi = _port_range(port)
+        try:
+            ec2_api.authorize_security_group_ingress(
+                region, gid, lo, hi)
+        except ec2_api.AwsApiError as e:
+            if e.code != 'InvalidPermission.Duplicate':
+                raise
 
 
 def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    """Revoke exactly the ingress rules open_ports added — the rules
-    must not outlive the cluster on a shared security group.  Missing
-    rules (already revoked, group deleted) are tolerated; a
-    pre-existing identical user rule would be revoked too, the
-    documented cost of SG sharing."""
+    """Revoke the ingress rules open_ports added on the cluster's own
+    security group.  Scoped to the dedicated SG, so other clusters'
+    (or the user's default-VPC) rules are never touched.  Missing
+    rules/group (already revoked, already deleted) are tolerated."""
     region = _region(provider_config)
-    for gid in _cluster_group_ids(region, cluster_name_on_cloud):
+    gid = _find_cluster_sg(region, cluster_name_on_cloud)
+    live_gids = _live_instance_group_ids(region, cluster_name_on_cloud)
+    if gid is not None and (not live_gids or gid in live_gids):
+        targets = [gid]
+    else:
+        # Legacy cluster (rules went onto the instances' own groups)
+        # — mirror open_ports' fallback so the rules don't outlive
+        # the cluster there.
+        targets = live_gids
+    for target in targets:
         for port in ports:
             lo, hi = _port_range(port)
             try:
-                ec2_api.revoke_security_group_ingress(region, gid,
-                                                      lo, hi)
+                ec2_api.revoke_security_group_ingress(
+                    region, target, lo, hi)
             except ec2_api.AwsApiError as e:
                 if e.code not in ('InvalidPermission.NotFound',
                                   'InvalidGroup.NotFound'):
                     logger.warning(
                         f'cleanup_ports: could not revoke {port} on '
-                        f'{gid}: {e}')
+                        f'{target}: {e}')

@@ -112,6 +112,12 @@ def _rg_path(rg: str) -> str:
     return f'/subscriptions/{_sub()}/resourcegroups/{rg}'
 
 
+def resource_group_id(rg: str) -> str:
+    """Full ARM resource id of a resource group (for cross-resource
+    references like subnet→NSG association)."""
+    return _rg_path(rg)
+
+
 # -- resource groups -------------------------------------------------------
 def ensure_resource_group(rg: str, region: str,
                           tags: Optional[Dict[str, str]] = None) -> None:

@@ -111,23 +111,30 @@ def _public_key(auth_config: Dict[str, Any]) -> Optional[str]:
 def _ensure_network(rg: str, region: str) -> str:
     """VNet + subnet + ssh-open NSG (idempotent PUTs); returns the
     subnet resource id."""
-    arm_api.put_resource(rg, _NETWORK, 'networkSecurityGroups',
-                         'skytpu-nsg', {
-                             'location': region,
-                             'properties': {'securityRules': [{
-                                 'name': 'allow-ssh',
-                                 'properties': {
-                                     'priority': 1000,
-                                     'direction': 'Inbound',
-                                     'access': 'Allow',
-                                     'protocol': 'Tcp',
-                                     'sourcePortRange': '*',
-                                     'destinationPortRange': '22',
-                                     'sourceAddressPrefix': '*',
-                                     'destinationAddressPrefix': '*',
-                                 },
-                             }]},
-                         })
+    nsg = arm_api.put_resource(rg, _NETWORK, 'networkSecurityGroups',
+                               'skytpu-nsg', {
+                                   'location': region,
+                                   'properties': {'securityRules': [{
+                                       'name': 'allow-ssh',
+                                       'properties': {
+                                           'priority': 1000,
+                                           'direction': 'Inbound',
+                                           'access': 'Allow',
+                                           'protocol': 'Tcp',
+                                           'sourcePortRange': '*',
+                                           'destinationPortRange': '22',
+                                           'sourceAddressPrefix': '*',
+                                           'destinationAddressPrefix': '*',
+                                       },
+                                   }]},
+                               })
+    # Standard-SKU public IPs deny all inbound unless an NSG with an
+    # allow rule is associated; attach the NSG to the subnet so
+    # allow-ssh and every open_ports rule actually take effect
+    # (the reference attaches it in azure-config-template.json).
+    nsg_id = nsg.get('id') or (
+        f'{arm_api.resource_group_id(rg)}/providers/{_NETWORK}'
+        f'/networkSecurityGroups/skytpu-nsg')
     vnet = arm_api.put_resource(rg, _NETWORK, 'virtualNetworks',
                                 'skytpu-vnet', {
                                     'location': region,
@@ -139,7 +146,10 @@ def _ensure_network(rg: str, region: str) -> str:
                                             'name': 'default',
                                             'properties': {
                                                 'addressPrefix':
-                                                    '10.42.0.0/24'},
+                                                    '10.42.0.0/24',
+                                                'networkSecurityGroup':
+                                                    {'id': nsg_id},
+                                            },
                                         }],
                                     },
                                 })

@@ -165,10 +165,13 @@ class _FakeEc2:
     def __init__(self):
         self.instances = {}
         self.counter = 0
+        self.security_groups = {}   # gid -> {'groupName', 'rules': set}
+        self.sg_counter = 0
 
     def run_instances(self, region, zone, *, image_id, instance_type,
                       count, tags, use_spot=False, disk_size_gb=256,
-                      key_name=None, user_data_b64=None):
+                      key_name=None, user_data_b64=None,
+                      security_group_ids=None):
         out = []
         for _ in range(count):
             self.counter += 1
@@ -182,9 +185,63 @@ class _FakeEc2:
                            for k, v in tags.items()],
                 'zone': zone, 'image': image_id,
                 'spot': use_spot, 'user_data': user_data_b64,
+                'groupSet': [{'groupId': g}
+                             for g in (security_group_ids or [])],
             }
             out.append(self.instances[iid])
         return out
+
+    def create_security_group(self, region, group_name, description,
+                              tags):
+        for gid, g in self.security_groups.items():
+            if g['groupName'] == group_name:
+                raise ec2_api.AwsApiError(
+                    400, 'InvalidGroup.Duplicate', group_name)
+        self.sg_counter += 1
+        gid = f'sg-{self.sg_counter:04d}'
+        self.security_groups[gid] = {'groupId': gid,
+                                     'groupName': group_name,
+                                     'rules': set()}
+        return gid
+
+    def describe_security_groups(self, region, filters):
+        name = filters.get('group-name')
+        return [dict(g) for g in self.security_groups.values()
+                if name is None or g['groupName'] == name]
+
+    def delete_security_group(self, region, group_id):
+        attached = any(
+            {'groupId': group_id} in inst.get('groupSet', [])
+            and inst['instanceState']['name'] not in ('terminated',)
+            for inst in self.instances.values())
+        if attached:
+            raise ec2_api.AwsApiError(400, 'DependencyViolation',
+                                      group_id)
+        self.security_groups.pop(group_id, None)
+
+    def authorize_security_group_self_ingress(self, region, gid):
+        self.security_groups[gid]['rules'].add(
+            ('self', 'all', gid))
+
+    def authorize_security_group_ingress(self, region, gid, lo, hi,
+                                         protocol='tcp',
+                                         cidr='0.0.0.0/0'):
+        rule = (lo, hi, protocol, cidr)
+        if rule in self.security_groups[gid]['rules']:
+            raise ec2_api.AwsApiError(
+                400, 'InvalidPermission.Duplicate', str(rule))
+        self.security_groups[gid]['rules'].add(rule)
+
+    def revoke_security_group_ingress(self, region, gid, lo, hi,
+                                      protocol='tcp',
+                                      cidr='0.0.0.0/0'):
+        if gid not in self.security_groups:
+            raise ec2_api.AwsApiError(400, 'InvalidGroup.NotFound', gid)
+        rule = (lo, hi, protocol, cidr)
+        if rule not in self.security_groups[gid]['rules']:
+            raise ec2_api.AwsApiError(
+                400, 'InvalidPermission.NotFound', str(rule))
+        self.security_groups[gid]['rules'].discard(rule)
 
     def describe_instances(self, region, filters):
         tag_filters = {k[len('tag:'):]: v for k, v in filters.items()
@@ -216,7 +273,11 @@ def fake_ec2(monkeypatch):
     fake = _FakeEc2()
     for fn in ('run_instances', 'describe_instances',
                'terminate_instances', 'stop_instances',
-               'start_instances'):
+               'start_instances', 'create_security_group',
+               'describe_security_groups', 'delete_security_group',
+               'authorize_security_group_ingress',
+               'authorize_security_group_self_ingress',
+               'revoke_security_group_ingress'):
         monkeypatch.setattr(ec2_api, fn, getattr(fake, fn))
         monkeypatch.setattr(aws_instance.ec2_api, fn, getattr(fake, fn))
     return fake
@@ -346,46 +407,48 @@ class TestAwsCatalogAndCloud:
 
 
 class TestOpenPorts:
+    """Ports are managed on a DEDICATED per-cluster security group
+    (advisor r3: mutating the shared default-VPC group let cluster A's
+    cleanup revoke rules cluster B depended on)."""
 
-    def test_opens_on_all_cluster_groups(self, fake_ec2, monkeypatch):
+    def test_run_instances_creates_dedicated_sg(self, fake_ec2):
         aws_instance.run_instances('us-east-1', 'c1', _pconfig(count=2))
-        # Attach security groups to the fake instances.
+        groups = fake_ec2.describe_security_groups(
+            'us-east-1', {'group-name': 'skytpu-c1'})
+        assert len(groups) == 1
+        gid = groups[0]['groupId']
+        # SSH pre-opened; instances attached to the dedicated group.
+        assert (22, 22, 'tcp', '0.0.0.0/0') in \
+            fake_ec2.security_groups[gid]['rules']
+        # Intra-cluster self-rule: node↔node traffic (jax.distributed
+        # coordinator, agent RPC) must not be blocked.
+        assert ('self', 'all', gid) in \
+            fake_ec2.security_groups[gid]['rules']
         for inst in fake_ec2.instances.values():
-            inst['groupSet'] = [{'groupId': 'sg-1'},
-                                {'groupId': 'sg-2'}]
-        calls = []
+            assert {'groupId': gid} in inst['groupSet']
 
-        def fake_auth(region, gid, lo, hi, protocol='tcp',
-                      cidr='0.0.0.0/0'):
-            calls.append((gid, lo, hi))
-
-        monkeypatch.setattr(aws_instance.ec2_api,
-                            'authorize_security_group_ingress',
-                            fake_auth)
+    def test_opens_on_cluster_sg_only(self, fake_ec2):
+        aws_instance.run_instances('us-east-1', 'c1', _pconfig())
+        # A second cluster's group must not be touched.
+        other = fake_ec2.create_security_group(
+            'us-east-1', 'skytpu-other', 'x', {})
         aws_instance.open_ports('c1', ['8000', '9000-9005'],
                                 {'region': 'us-east-1'})
-        assert ('sg-1', 8000, 8000) in calls
-        assert ('sg-2', 9000, 9005) in calls
-        assert len(calls) == 4  # 2 groups x 2 port specs
+        gid = fake_ec2.describe_security_groups(
+            'us-east-1', {'group-name': 'skytpu-c1'})[0]['groupId']
+        rules = fake_ec2.security_groups[gid]['rules']
+        assert (8000, 8000, 'tcp', '0.0.0.0/0') in rules
+        assert (9000, 9005, 'tcp', '0.0.0.0/0') in rules
+        assert fake_ec2.security_groups[other]['rules'] == set()
 
-    def test_duplicate_rule_tolerated(self, fake_ec2, monkeypatch):
+    def test_duplicate_rule_tolerated(self, fake_ec2):
         aws_instance.run_instances('us-east-1', 'c2', _pconfig())
-        for inst in fake_ec2.instances.values():
-            inst['groupSet'] = [{'groupId': 'sg-1'}]
-
-        def dup(*a, **k):
-            raise ec2_api.AwsApiError(
-                400, 'InvalidPermission.Duplicate', 'exists')
-
-        monkeypatch.setattr(aws_instance.ec2_api,
-                            'authorize_security_group_ingress', dup)
+        aws_instance.open_ports('c2', ['8000'], {'region': 'us-east-1'})
         aws_instance.open_ports('c2', ['8000'],
                                 {'region': 'us-east-1'})  # no raise
 
     def test_other_errors_propagate(self, fake_ec2, monkeypatch):
         aws_instance.run_instances('us-east-1', 'c3', _pconfig())
-        for inst in fake_ec2.instances.values():
-            inst['groupSet'] = [{'groupId': 'sg-1'}]
 
         def deny(*a, **k):
             raise ec2_api.AwsApiError(403, 'UnauthorizedOperation',
@@ -397,52 +460,100 @@ class TestOpenPorts:
             aws_instance.open_ports('c3', ['8000'],
                                     {'region': 'us-east-1'})
 
-    def test_terminated_instances_groups_skipped(self, fake_ec2,
-                                                 monkeypatch):
-        aws_instance.run_instances('us-east-1', 'c4', _pconfig(count=2))
-        ids = sorted(fake_ec2.instances)
-        for iid in ids:
-            fake_ec2.instances[iid]['groupSet'] = [
-                {'groupId': 'sg-live'}]
-        # One instance terminated with a stale (deleted) group.
-        fake_ec2.instances[ids[0]]['instanceState'] = {
-            'name': 'terminated'}
-        fake_ec2.instances[ids[0]]['groupSet'] = [
-            {'groupId': 'sg-stale'}]
-        calls = []
-        monkeypatch.setattr(
-            aws_instance.ec2_api, 'authorize_security_group_ingress',
-            lambda region, gid, lo, hi, **k: calls.append(gid))
-        aws_instance.open_ports('c4', ['8000'],
-                                {'region': 'us-east-1'})
-        assert calls == ['sg-live']
-
-    def test_cleanup_revokes_what_open_added(self, fake_ec2,
-                                             monkeypatch):
+    def test_cleanup_revokes_only_cluster_rules(self, fake_ec2):
         aws_instance.run_instances('us-east-1', 'c5', _pconfig())
-        for inst in fake_ec2.instances.values():
-            inst['groupSet'] = [{'groupId': 'sg-1'}]
-        revoked = []
-        monkeypatch.setattr(
-            aws_instance.ec2_api, 'revoke_security_group_ingress',
-            lambda region, gid, lo, hi, **k: revoked.append(
-                (gid, lo, hi)))
+        other = fake_ec2.create_security_group(
+            'us-east-1', 'skytpu-other', 'x', {})
+        fake_ec2.authorize_security_group_ingress(
+            'us-east-1', other, 8000, 8000)
+        aws_instance.open_ports('c5', ['8000', '9000-9005'],
+                                {'region': 'us-east-1'})
         aws_instance.cleanup_ports('c5', ['8000', '9000-9005'],
                                    {'region': 'us-east-1'})
-        assert ('sg-1', 8000, 8000) in revoked
-        assert ('sg-1', 9000, 9005) in revoked
+        gid = fake_ec2.describe_security_groups(
+            'us-east-1', {'group-name': 'skytpu-c5'})[0]['groupId']
+        port_rules = {r for r in fake_ec2.security_groups[gid]['rules']
+                      if r[0] not in (22, 'self')}
+        assert port_rules == set()
+        # The other cluster's identical rule survives.
+        assert (8000, 8000, 'tcp', '0.0.0.0/0') in \
+            fake_ec2.security_groups[other]['rules']
 
-    def test_cleanup_tolerates_missing_rule(self, fake_ec2,
-                                            monkeypatch):
+    def test_cleanup_tolerates_missing_rule_and_group(self, fake_ec2):
         aws_instance.run_instances('us-east-1', 'c6', _pconfig())
-        for inst in fake_ec2.instances.values():
-            inst['groupSet'] = [{'groupId': 'sg-1'}]
+        aws_instance.cleanup_ports('c6', ['8000'],
+                                   {'region': 'us-east-1'})  # no rule
+        aws_instance.cleanup_ports('never-created', ['8000'],
+                                   {'region': 'us-east-1'})  # no group
 
-        def gone(*a, **k):
-            raise ec2_api.AwsApiError(
-                400, 'InvalidPermission.NotFound', 'no such rule')
+    def test_terminate_deletes_sg_when_detached(self, fake_ec2):
+        aws_instance.run_instances('us-east-1', 'c7', _pconfig())
+        assert fake_ec2.describe_security_groups(
+            'us-east-1', {'group-name': 'skytpu-c7'})
+        aws_instance.terminate_instances('c7',
+                                         {'region': 'us-east-1'})
+        assert not fake_ec2.describe_security_groups(
+            'us-east-1', {'group-name': 'skytpu-c7'})
+
+    def test_terminate_retries_then_tolerates_attached_sg(
+            self, fake_ec2, monkeypatch):
+        aws_instance.run_instances('us-east-1', 'c8', _pconfig())
+        attempts = {'n': 0}
+
+        def busy(region, gid):
+            attempts['n'] += 1
+            raise ec2_api.AwsApiError(400, 'DependencyViolation', gid)
 
         monkeypatch.setattr(aws_instance.ec2_api,
-                            'revoke_security_group_ingress', gone)
-        aws_instance.cleanup_ports('c6', ['8000'],
-                                   {'region': 'us-east-1'})  # no raise
+                            'delete_security_group', busy)
+        monkeypatch.setattr(aws_instance.time, 'sleep', lambda s: None)
+        monkeypatch.setenv('SKYTPU_AWS_SG_DELETE_WAIT_S', '0')
+        aws_instance.terminate_instances('c8',
+                                         {'region': 'us-east-1'})  # no raise
+        assert attempts['n'] >= 1
+
+    def test_terminate_retries_until_detach(self, fake_ec2,
+                                            monkeypatch):
+        """ENIs detach asynchronously after TerminateInstances; the
+        delete must retry through the DependencyViolation window."""
+        aws_instance.run_instances('us-east-1', 'c9', _pconfig())
+        attempts = {'n': 0}
+        real_delete = fake_ec2.delete_security_group
+
+        def eventually(region, gid):
+            attempts['n'] += 1
+            if attempts['n'] < 3:
+                raise ec2_api.AwsApiError(400, 'DependencyViolation',
+                                          gid)
+            real_delete(region, gid)
+
+        monkeypatch.setattr(aws_instance.ec2_api,
+                            'delete_security_group', eventually)
+        monkeypatch.setattr(aws_instance.time, 'sleep', lambda s: None)
+        aws_instance.terminate_instances('c9', {'region': 'us-east-1'})
+        assert attempts['n'] == 3
+        assert not fake_ec2.describe_security_groups(
+            'us-east-1', {'group-name': 'skytpu-c9'})
+
+    def test_open_ports_legacy_cluster_falls_back_to_attached_groups(
+            self, fake_ec2):
+        """A cluster whose instances are NOT in the dedicated group
+        (pre-dedicated-SG era) must get its ports opened on the groups
+        the instances actually use — rules on a detached group would
+        silently open nothing."""
+        fake_ec2.run_instances(
+            'us-east-1', 'us-east-1a', image_id='ami-1',
+            instance_type='m6i.2xlarge', count=1,
+            tags={'skytpu-cluster': 'old1', 'Name': 'old1'},
+            security_group_ids=['sg-default'])
+        fake_ec2.security_groups['sg-default'] = {
+            'groupId': 'sg-default', 'groupName': 'default',
+            'rules': set()}
+        aws_instance.open_ports('old1', ['8000'],
+                                {'region': 'us-east-1'})
+        assert (8000, 8000, 'tcp', '0.0.0.0/0') in \
+            fake_ec2.security_groups['sg-default']['rules']
+        aws_instance.cleanup_ports('old1', ['8000'],
+                                   {'region': 'us-east-1'})
+        assert (8000, 8000, 'tcp', '0.0.0.0/0') not in \
+            fake_ec2.security_groups['sg-default']['rules']

@@ -244,6 +244,18 @@ class TestAzureProvisioner:
         assert az_instance.query_instances(
             'c1', {'region': 'eastus'}) == {}
 
+    def test_nsg_associated_with_subnet(self, fake_arm):
+        """Advisor r3 (high): without subnet→NSG association the
+        allow-ssh and open_ports rules sit on an orphan NSG while the
+        Standard-SKU public IPs deny all inbound — SSH unreachable."""
+        az_instance.run_instances('eastus', 'c1', _pconfig())
+        store = fake_arm.rgs['skytpu-c1']['resources']
+        nsg = store['networkSecurityGroups/skytpu-nsg']
+        vnet = store['virtualNetworks/skytpu-vnet']
+        subnet = vnet['properties']['subnets'][0]
+        assoc = subnet['properties'].get('networkSecurityGroup')
+        assert assoc == {'id': nsg['id']}
+
     def test_worker_only_stop_keeps_head(self, fake_arm):
         az_instance.run_instances('eastus', 'c2', _pconfig(count=3))
         az_instance.stop_instances('c2', {'region': 'eastus'},

@@ -16,12 +16,15 @@ _BENCH_PATH = os.path.join(
 
 
 @pytest.fixture()
-def bench(monkeypatch):
+def bench(monkeypatch, tmp_path):
     spec = importlib.util.spec_from_file_location('bench', _BENCH_PATH)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     monkeypatch.setattr(mod.time, 'sleep', lambda _s: None)
     monkeypatch.setattr(sys, 'argv', ['bench.py'])
+    # Isolate from any real in-round capture sitting at the repo root.
+    monkeypatch.setenv('SKYTPU_BENCH_CACHE',
+                       str(tmp_path / 'bench_cache.json'))
     return mod
 
 
@@ -79,6 +82,135 @@ def test_e2e_success_never_touches_direct(bench, monkeypatch, capsys):
     bench.main()
     assert calls['direct'] == 0
     assert json.loads(capsys.readouterr().out.strip())['value'] == 2
+
+
+def test_all_rungs_failing_emits_stale_cache_when_present(
+        bench, monkeypatch, capsys, tmp_path):
+    """Round-4: a dated in-round hardware number beats value 0."""
+    cache = tmp_path / 'bench_cache.json'
+    cache.write_text(json.dumps({
+        'metric': 'llama3-8b-equiv train tokens/sec/chip @seq8192',
+        'value': 2967.4, 'unit': 'tokens/s/chip', 'vs_baseline': 28.4,
+        'provision_to_first_step_s': 18.6,
+        'captured_at': '2026-07-31T12:00:00Z',
+        'captured_unix': __import__('time').time() - 3600,
+        'raw': {'mfu': 0.72},
+    }))
+    monkeypatch.setenv('SKYTPU_BENCH_CACHE', str(cache))
+    monkeypatch.setattr(
+        bench, 'run_through_launch',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+    monkeypatch.setattr(
+        bench, 'run_direct_subprocess',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('direct')))
+    bench.main()  # no SystemExit: the cache rung produced a metric
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    parsed = json.loads(out[0])
+    assert parsed['value'] == 2967.4
+    assert parsed['stale'] is True
+    assert parsed['captured_at'] == '2026-07-31T12:00:00Z'
+    assert parsed['provision_to_first_step_s'] == 18.6
+
+
+def test_out_of_round_cache_not_emitted(bench, monkeypatch, capsys,
+                                        tmp_path):
+    """A relic from a previous round must not masquerade as current
+    performance (default age bound 24h)."""
+    cache = tmp_path / 'bench_cache.json'
+    cache.write_text(json.dumps({
+        'metric': 'm', 'value': 2967.4, 'unit': 'u',
+        'vs_baseline': 28.4, 'captured_at': '2026-06-01T00:00:00Z',
+        'captured_unix': __import__('time').time() - 30 * 24 * 3600,
+    }))
+    monkeypatch.setenv('SKYTPU_BENCH_CACHE', str(cache))
+    monkeypatch.setattr(
+        bench, 'run_through_launch',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+    monkeypatch.setattr(
+        bench, 'run_direct_subprocess',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('direct')))
+    with pytest.raises(SystemExit):
+        bench.main()
+    assert json.loads(
+        capsys.readouterr().out.strip())['unit'] == 'error'
+
+
+def test_empty_or_zero_cache_not_emitted(bench, monkeypatch, capsys,
+                                         tmp_path):
+    cache = tmp_path / 'bench_cache.json'
+    cache.write_text(json.dumps({'metric': 'm', 'value': 0,
+                                 'unit': 'u', 'vs_baseline': 0}))
+    monkeypatch.setenv('SKYTPU_BENCH_CACHE', str(cache))
+    monkeypatch.setattr(
+        bench, 'run_through_launch',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+    monkeypatch.setattr(
+        bench, 'run_direct_subprocess',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('direct')))
+    with pytest.raises(SystemExit):
+        bench.main()
+    assert json.loads(
+        capsys.readouterr().out.strip())['unit'] == 'error'
+
+
+def test_tpu_emit_writes_cache_cpu_does_not(bench, monkeypatch,
+                                            tmp_path, capsys):
+    cache = tmp_path / 'bench_cache.json'
+    monkeypatch.setenv('SKYTPU_BENCH_CACHE', str(cache))
+    bench._emit(1000.0, 5e8, 1, 'cpu', 256)
+    assert not cache.exists()
+    bench._emit(250000.0, 5.5e8, 1, 'TPU v5e', 8192,
+                provision_to_first_step=20.0)
+    payload = json.loads(cache.read_text())
+    assert payload['value'] > 0
+    assert payload['raw']['device_kind'] == 'TPU v5e'
+    assert payload['raw']['seq'] == 8192
+    assert payload['captured_at']
+    capsys.readouterr()  # drop the _emit lines
+    # And the freshly written cache round-trips through the emit rung.
+    monkeypatch.setattr(
+        bench, 'run_through_launch',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('x')))
+    monkeypatch.setattr(
+        bench, 'run_direct_subprocess',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('y')))
+    bench.main()  # no SystemExit: cache rung emits the capture
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    parsed = json.loads(out[0])
+    assert parsed['stale'] is True
+    assert parsed['value'] == payload['value']
+    assert parsed['provision_to_first_step_s'] == 20.0
+
+
+def test_spaced_direct_attempts(bench, monkeypatch, capsys):
+    """The direct rung retries in fresh windows, spaced (not
+    back-to-back), and succeeds when a later window finds the tunnel
+    healthy."""
+    sleeps = []
+    monkeypatch.setattr(bench.time, 'sleep', sleeps.append)
+    monkeypatch.setenv('SKYTPU_BENCH_DIRECT_ATTEMPTS', '3')
+    monkeypatch.setenv('SKYTPU_BENCH_DIRECT_SPACING_S', '600')
+    monkeypatch.setattr(
+        bench, 'run_through_launch',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+    calls = {'direct': 0}
+
+    def _direct(_steps):
+        calls['direct'] += 1
+        if calls['direct'] < 3:
+            raise bench.BenchError('hang')
+        print(json.dumps({'metric': 'm', 'value': 7, 'unit': 'u',
+                          'vs_baseline': 1}))
+
+    monkeypatch.setattr(bench, 'run_direct_subprocess', _direct)
+    bench.main()
+    assert calls['direct'] == 3
+    assert sleeps.count(600.0) == 2  # spacing between direct windows
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    assert json.loads(out[0])['value'] == 7
 
 
 def test_backend_init_retry_clears_and_retries(monkeypatch):
