@@ -33,10 +33,14 @@ assert any('TPU' in k.upper() for k in kinds), kinds
 print('tunnel healthy:', kinds)
 " >> "$LOG" 2>&1; then
     echo "[$(date -u +%FT%TZ)] tunnel healthy -> full bench capture" >> "$LOG"
-    if SKYTPU_BENCH_E2E_DEADLINE_S=2400 \
-       SKYTPU_BENCH_DIRECT_TIMEOUT_S=2400 \
+    # Outer timeout must exceed the worst-case inner ladder
+    # (2 e2e x deadline + 1 direct x timeout + provisioning slack) or
+    # bench.py gets SIGTERMed before the direct rung / cache write —
+    # wasting the rare healthy window.
+    if SKYTPU_BENCH_E2E_DEADLINE_S=1500 \
+       SKYTPU_BENCH_DIRECT_TIMEOUT_S=1800 \
        SKYTPU_BENCH_DIRECT_ATTEMPTS=1 \
-       timeout 5400 python bench.py >> "$LOG" 2>&1; then
+       timeout 5700 python bench.py >> "$LOG" 2>&1; then
       if [ -s BENCH_CACHE.json ]; then
         echo "[$(date -u +%FT%TZ)] capture SUCCESS, cache written" >> "$LOG"
         exit 0

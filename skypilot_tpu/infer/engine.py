@@ -70,38 +70,73 @@ def sample_logits(logits: jax.Array, rng: jax.Array,
 
 
 def sample_logits_rows(logits: jax.Array, keys: jax.Array,
-                       temps: jax.Array, top_k: int,
-                       top_p: float) -> jax.Array:
+                       temps: jax.Array, top_ks: jax.Array,
+                       top_ps: jax.Array, *, max_k: int,
+                       use_top_p: bool) -> jax.Array:
     """Per-row sampling [B, V] -> [B] with one PRNG key per row: rows
     with temp<=0 decode greedily, the rest sample — one jit for a
     continuous batch whose slots carry different requests' sampling
-    configs AND seeds."""
+    configs AND seeds.
+
+    `top_ks` [B] int32 and `top_ps` [B] f32 are TRACED, so greedy,
+    top-k and top-p requests share one compiled step; only the coarse
+    capability keys are static: `max_k` (0 = no top-k path compiled;
+    otherwise a power-of-two bucket >= every row's k, so the kernel's
+    lax.top_k width — and the compile cache — is bounded by log2(V)
+    buckets, not by the number of distinct user k values) and
+    `use_top_p` (skips the full-vocab sort when nobody asked for
+    nucleus sampling).  A row's k-th-largest threshold is exact for
+    any bucket >= k, so bucketing never changes the sampled
+    distribution."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     safe = jnp.where(temps > 0, temps, 1.0)[:, None]
     scaled = logits / safe
-    if top_k > 0:
-        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
-        scaled = jnp.where(scaled < kth, -1e30, scaled)
-    if top_p < 1.0:
+    if max_k > 0:
+        vals = jax.lax.top_k(scaled, max_k)[0]        # [B, max_k] desc
+        idx = jnp.clip(top_ks - 1, 0, max_k - 1)[:, None]
+        kth = jnp.take_along_axis(vals, idx, axis=-1)  # [B, 1]
+        keep = (top_ks[:, None] <= 0) | (scaled >= kth)
+        scaled = jnp.where(keep, scaled, -1e30)
+    if use_top_p:
         sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff_idx = jnp.sum(cum < top_ps[:, None], axis=-1,
+                             keepdims=True)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-        scaled = jnp.where(scaled < cutoff, -1e30, scaled)
+        keep = (top_ps[:, None] >= 1.0) | (scaled >= cutoff)
+        scaled = jnp.where(keep, scaled, -1e30)
     sampled = jax.vmap(
         lambda k, row: jax.random.categorical(k, row))(
             keys, scaled).astype(jnp.int32)
     return jnp.where(temps > 0, sampled, greedy)
 
 
+def top_k_bucket(k: int, vocab_size: int) -> int:
+    """Static lax.top_k width for a batch whose largest row k is `k`:
+    the next power of two, capped at the vocab (0 stays 0 — the top-k
+    path is compiled out entirely)."""
+    if k <= 0:
+        return 0
+    b = 1
+    while b < k:
+        b *= 2
+    return min(b, vocab_size)
+
+
 def sample_logits_batched(logits: jax.Array, rng: jax.Array,
                           temps: jax.Array, top_k: int,
                           top_p: float) -> jax.Array:
-    """Shared-rng variant (request-level engine): rows draw from
-    per-row splits of one key."""
+    """Shared-rng, shared-config variant (request-level engine): rows
+    draw from per-row splits of one key."""
     keys = jax.random.split(rng, logits.shape[0])
-    return sample_logits_rows(logits, keys, temps, top_k, top_p)
+    b = logits.shape[0]
+    return sample_logits_rows(
+        logits, keys, temps,
+        jnp.full((b,), top_k, jnp.int32),
+        jnp.full((b,), top_p, jnp.float32),
+        max_k=top_k_bucket(top_k, logits.shape[-1]),
+        use_top_p=top_p < 1.0)
 
 
 _QUANT_KEYS = frozenset(('q8', 'scale'))
@@ -154,6 +189,32 @@ def unstack_scanned_params(params: Any, n_layers: int) -> Any:
 
 def _is_quant_leaf(leaf: Any) -> bool:
     return isinstance(leaf, dict) and set(leaf) == _QUANT_KEYS
+
+
+def quantized_param_shardings(mesh, float_shardings: Any,
+                              quantized_params: Any) -> Any:
+    """Shardings for the quantize_params_int8 layout, derived from the
+    SAME logical rules as the float kernels: `q8` keeps its kernel's
+    NamedSharding verbatim (same shape, same partitioning); `scale`
+    (shape [1, *out_dims] — absmax over the input axis) drops the
+    now-size-1 first axis from the spec and keeps the output-axis
+    partitioning, so each tensor-parallel shard holds exactly the
+    scales of its own output channels."""
+    import flax
+
+    flat_q = flax.traverse_util.flatten_dict(quantized_params)
+    flat_s = flax.traverse_util.flatten_dict(float_shardings)
+    out = {}
+    for key in flat_q:
+        if key[-1] == 'q8':
+            out[key] = flat_s[key[:-1]]
+        elif key[-1] == 'scale' and key[:-1] in flat_s:
+            base_spec = tuple(flat_s[key[:-1]].spec)
+            out[key] = NamedSharding(
+                mesh, P(None, *base_spec[1:]))
+        else:
+            out[key] = flat_s[key]
+    return flax.traverse_util.unflatten_dict(out)
 
 
 def maybe_dequantize_params(params: Any, dtype: Any) -> Any:
@@ -229,11 +290,12 @@ class ContinuousBatchingEngine:
         its own depth (models/llama.py run_cached_attention slot mode —
         the write position is the row's highest revealed kv_mask slot);
       - slots are evicted on EOS / budget and immediately reusable;
-      - per-slot temperature rides the jit as a vector (greedy and
-        sampled requests share a step); top_k/top_p are compile keys,
-        so a decode batch is always HOMOGENEOUS in (top_k, top_p):
-        requests with other values queue until the current group
-        drains (one compile per distinct pair, bounded in practice).
+      - per-slot temperature, top_k and top_p ride the jit as [B]
+        vectors: greedy, top-k and top-p requests interleave in ONE
+        decode step with no admission constraint.  The only sampling
+        compile keys are coarse capability flags — the power-of-two
+        `max_k` bucket and `use_top_p` — so the compile cache is
+        bounded by log2(vocab) x 2, not by distinct (k, p) pairs.
 
     Thread model: `submit()`/`cancel()` are thread-safe; `step()` must
     be driven by ONE thread (the server runs it in a dedicated decode
@@ -266,6 +328,7 @@ class ContinuousBatchingEngine:
             quantize=quantize, seed=seed)
         self.model = self._eng.model
         self.config = self._eng.config
+        self.quantize = self._eng.quantize
         self.mesh = mesh
         self.n_slots = n_slots
         self.max_seq_len = self._eng.max_seq_len
@@ -316,21 +379,26 @@ class ContinuousBatchingEngine:
         self._insert = jax.jit(_insert, donate_argnums=(0, 1, 2))
 
         def _decode_step(p, cache, last, kv_mask, rope_pos, cursors,
-                         seeds, gens, active, temps,
-                         top_k: int, top_p: float, kv_bucket: int):
+                         seeds, gens, active, temps, top_ks, top_ps,
+                         max_k: int, use_top_p: bool, kv_bucket: int):
             """Fused: sample every slot's next token from `last`,
             reveal each ACTIVE slot's write position, one-token
             forward for all slots.  Per-row keys fold (request seed,
             generated index) so a seeded request's continuation is
             reproducible regardless of batch composition or admission
-            time.  `kv_bucket` (static) caps the decode attention's
-            cache READS to the live prefix — one compile per bucket,
-            big HBM savings while contexts are short."""
+            time.  top_ks/top_ps ride the jit as [B] vectors — one
+            compile serves heterogeneous sampling configs; the only
+            static keys are the coarse capability flags (`max_k`
+            power-of-two bucket, `use_top_p`) and `kv_bucket`, which
+            caps the decode attention's cache READS to the live prefix
+            — one compile per bucket, big HBM savings while contexts
+            are short."""
             from skypilot_tpu.models import llama as llama_lib
             keys = jax.vmap(
                 lambda sd, g: jax.random.fold_in(
                     jax.random.PRNGKey(sd), g))(seeds, gens)
-            tok = sample_logits_rows(last, keys, temps, top_k, top_p)
+            tok = sample_logits_rows(last, keys, temps, top_ks, top_ps,
+                                     max_k=max_k, use_top_p=use_top_p)
             brange = jnp.arange(tok.shape[0])
             reveal = kv_mask[brange, cursors] | active
             kv_mask = kv_mask.at[brange, cursors].set(reveal)
@@ -341,7 +409,7 @@ class ContinuousBatchingEngine:
 
         self._decode = jax.jit(
             _decode_step,
-            static_argnames=('top_k', 'top_p', 'kv_bucket'),
+            static_argnames=('max_k', 'use_top_p', 'kv_bucket'),
             donate_argnums=(1, 3))
 
         self._cache = self._eng._fresh_cache()
@@ -569,17 +637,12 @@ class ContinuousBatchingEngine:
         from skypilot_tpu.models import llama
 
         self._evict_canceled()
-        # (top_k, top_p) are compile keys of the decode step, so the
-        # batch must stay homogeneous in them.  Admission is strictly
-        # FIFO from the queue HEAD: a head whose pair doesn't match
-        # the live group simply waits for the batch to drain (bounded
-        # by max_new_tokens), then becomes the new group — leapfrogging
-        # it for matching requests further back would starve it under
-        # steady same-group traffic.
-        group = next(
-            ((s.top_k, s.top_p) for s in self._slots if s is not None),
-            next(((p.cfg.top_k, p.cfg.top_p) for p in self._prefills),
-                 None))
+        # top_k/top_p ride the decode jit as per-row vectors, so
+        # admission is unconditional FIFO — greedy, top-k and top-p
+        # requests interleave in one batch with no drain wait (the
+        # round-3 head-of-line stall and per-(k,p) compile cache are
+        # gone; the compile cache is bounded by the coarse max_k
+        # power-of-two bucket x use_top_p keys).
         reserved = {p.slot_idx for p in self._prefills}
         free = [i for i, s in enumerate(self._slots)
                 if s is None and i not in reserved]
@@ -587,12 +650,8 @@ class ContinuousBatchingEngine:
             with self._submit_lock:
                 item = None
                 if self._queue:
-                    head = self._queue[0]
-                    if group is None or \
-                            (head[2].top_k, head[2].top_p) == group:
-                        item = self._queue.popleft()
-                        group = (item[2].top_k, item[2].top_p)
-                        self._admitting_rid = item[0]
+                    item = self._queue.popleft()
+                    self._admitting_rid = item[0]
             if item is None:
                 break
             try:
@@ -624,6 +683,8 @@ class ContinuousBatchingEngine:
         temps = np.zeros((b,), np.float32)
         seeds = np.zeros((b,), np.int32)
         gens = np.zeros((b,), np.int32)
+        top_ks = np.zeros((b,), np.int32)
+        top_ps = np.ones((b,), np.float32)
         for i in occupied:
             s = self._slots[i]
             cursors[i] = s.pad_len + s.generated
@@ -632,6 +693,11 @@ class ContinuousBatchingEngine:
             temps[i] = s.temperature
             seeds[i] = s.seed
             gens[i] = s.generated
+            top_ks[i] = s.top_k
+            top_ps[i] = s.top_p
+        max_k = top_k_bucket(int(top_ks.max()),
+                             self.config.vocab_size)
+        use_top_p = bool((top_ps < 1.0).any())
         if self.kv_read_bucket > 0:
             live = int(cursors[occupied].max()) + 1
             gran = self.kv_read_bucket
@@ -646,7 +712,8 @@ class ContinuousBatchingEngine:
                     jnp.asarray(rope), jnp.asarray(cursors),
                     jnp.asarray(seeds), jnp.asarray(gens),
                     jnp.asarray(active), jnp.asarray(temps),
-                    top_k=group[0], top_p=group[1], kv_bucket=bucket)
+                    jnp.asarray(top_ks), jnp.asarray(top_ps),
+                    max_k=max_k, use_top_p=use_top_p, kv_bucket=bucket)
         toks = np.asarray(jax.device_get(tok_dev))
         for i in occupied:
             s = self._slots[i]
@@ -693,10 +760,6 @@ class InferenceEngine:
         if quantize not in (None, 'int8'):
             raise ValueError(f"quantize must be None or 'int8', got "
                              f'{quantize!r}.')
-        if quantize and mesh is not None:
-            raise NotImplementedError(
-                'int8 serving is single-device for now: quantized '
-                'leaves do not carry mesh shardings yet.')
         self.quantize = quantize
         overrides = dict(model_overrides or {})
         overrides.update(decode=True, remat=False)
@@ -739,6 +802,13 @@ class InferenceEngine:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
             sharding_lib.unbox(abstract['cache']))
         if params is not None:
+            if self.quantize and isinstance(params, dict) \
+                    and 'layers' in params:
+                # Scanned-layout weights (trainer default) must be
+                # unstacked BEFORE placement: param_shardings follow
+                # this engine's unscanned tree.
+                params = unstack_scanned_params(params,
+                                                self.config.n_layers)
             self.params = self._place(params, param_shardings)
         elif checkpoint_dir is not None:
             self.params = self._load_checkpoint(checkpoint_dir,
@@ -764,6 +834,14 @@ class InferenceEngine:
                     self.params, self.config.n_layers)
             self.params = jax.tree.map(  # materialize, then quantize
                 jnp.asarray, quantize_params_int8(self.params))
+            if mesh is not None:
+                # {q8, scale} leaves carry NamedShardings derived from
+                # the float kernels' logical rules — tensor-parallel
+                # int8 decode shards exactly like its float twin.
+                self.params = jax.device_put(
+                    self.params,
+                    quantized_param_shardings(mesh, param_shardings,
+                                              self.params))
 
         def _forward(p, cache, tokens, positions, kv_mask):
             p = maybe_dequantize_params(p, self.config.param_dtype)

@@ -170,7 +170,21 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                 'Name': cluster_name_on_cloud}
         tags.update(config.tags)
         try:
-            sg_id = _ensure_cluster_sg(region, cluster_name_on_cloud)
+            # New nodes must share a security group with the cluster's
+            # existing live nodes: self-referencing rules only cover
+            # same-group traffic, so a mixed-group cluster would block
+            # node↔node (coordinator/agent) connections.  Legacy
+            # clusters (pre-dedicated-SG) therefore keep their own
+            # groups for replacements; only fresh/dedicated clusters
+            # get the skytpu group.
+            live_gids = _live_instance_group_ids(region,
+                                                 cluster_name_on_cloud)
+            own = _find_cluster_sg(region, cluster_name_on_cloud)
+            if live_gids and (own is None or own not in live_gids):
+                sg_ids = live_gids
+            else:
+                sg_ids = [_ensure_cluster_sg(region,
+                                             cluster_name_on_cloud)]
             instances = ec2_api.run_instances(
                 region, zone,
                 image_id=image,
@@ -182,7 +196,7 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                 key_name=node_cfg.get('key_name'),
                 user_data_b64=_ssh_key_user_data(
                     config.authentication_config),
-                security_group_ids=[sg_id],
+                security_group_ids=sg_ids,
             )
         except ec2_api.AwsApiError as e:
             raise _classify(e) from None
@@ -374,12 +388,14 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
     only this cluster's instances.  Re-opening an already-open port
     is a no-op (InvalidPermission.Duplicate tolerated)."""
     region = _region(provider_config)
-    gid = _ensure_cluster_sg(region, cluster_name_on_cloud)
     live_gids = _live_instance_group_ids(region, cluster_name_on_cloud)
-    if live_gids and gid not in live_gids:
-        # Cluster predates the dedicated-SG scheme: rules on the
-        # (detached) dedicated group would silently open nothing.
-        # Target the groups the live instances actually belong to.
+    gid = _find_cluster_sg(region, cluster_name_on_cloud)
+    if live_gids and (gid is None or gid not in live_gids):
+        # Cluster predates the dedicated-SG scheme: rules on a
+        # (detached) dedicated group would silently open nothing —
+        # and creating one here would just leave an orphan
+        # world-open-SSH group no instance uses.  Target the groups
+        # the live instances actually belong to.
         logger.warning(
             f'{cluster_name_on_cloud}: instances not attached to '
             f'{_sg_name(cluster_name_on_cloud)}; opening ports on '
@@ -394,6 +410,10 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
                     if e.code != 'InvalidPermission.Duplicate':
                         raise
         return
+    if gid is None:
+        # Pre-provision open_ports (no instances yet): the dedicated
+        # group is created now and picked up by run_instances.
+        gid = _ensure_cluster_sg(region, cluster_name_on_cloud)
     for port in ports:
         lo, hi = _port_range(port)
         try:

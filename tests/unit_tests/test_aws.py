@@ -535,6 +535,31 @@ class TestOpenPorts:
         assert not fake_ec2.describe_security_groups(
             'us-east-1', {'group-name': 'skytpu-c9'})
 
+    def test_scale_up_legacy_cluster_reuses_attached_groups(
+            self, fake_ec2):
+        """Replacement nodes for a pre-dedicated-SG cluster must join
+        the live nodes' group: self-rules only cover same-group
+        traffic, so a mixed-group cluster would block node↔node
+        coordinator/agent connections."""
+        fake_ec2.security_groups['sg-default'] = {
+            'groupId': 'sg-default', 'groupName': 'default',
+            'rules': set()}
+        fake_ec2.run_instances(
+            'us-east-1', 'us-east-1a', image_id='ami-1',
+            instance_type='m6i.2xlarge', count=1,
+            tags={'skytpu-cluster': 'old2', 'Name': 'old2'},
+            security_group_ids=['sg-default'])
+        aws_instance.run_instances('us-east-1', 'old2',
+                                   _pconfig(count=2))
+        new_insts = [i for i in fake_ec2.instances.values()
+                     if i['instanceId'] != 'i-0001']
+        assert new_insts
+        for inst in new_insts:
+            assert inst['groupSet'] == [{'groupId': 'sg-default'}]
+        # No orphan dedicated group was created for the legacy cluster.
+        assert not fake_ec2.describe_security_groups(
+            'us-east-1', {'group-name': 'skytpu-old2'})
+
     def test_open_ports_legacy_cluster_falls_back_to_attached_groups(
             self, fake_ec2):
         """A cluster whose instances are NOT in the dedicated group

@@ -93,22 +93,54 @@ class TestContinuousCorrectness:
         assert len(sampled) == 5
         assert all(0 <= t < cbe.config.vocab_size for t in sampled)
 
-    def test_mixed_top_k_groups_batch_homogeneously(self, cbe):
-        """(top_k, top_p) are compile keys: requests with different
-        pairs queue into separate homogeneous batches, and all finish
-        with correct outputs."""
+    def test_mixed_sampling_interleaves_in_one_batch(self, cbe):
+        """top_k/top_p are per-row traced vectors (round-4): a greedy
+        and a top-k request DECODE TOGETHER — no drain wait, no
+        head-of-line stall — and each still produces exactly what it
+        produces alone (the request-level/solo reference)."""
         g1, g2 = [5, 17, 3], [9, 1, 30]
+        topk_cfg = engine_lib.SamplingConfig(
+            max_new_tokens=6, temperature=1.0, top_k=5, seed=77)
+        solo_topk = cbe.generate([g2], topk_cfg)[0]
         rid_plain = cbe.submit(g1, engine_lib.SamplingConfig(
-            max_new_tokens=4))
-        rid_topk = cbe.submit(g2, engine_lib.SamplingConfig(
-            max_new_tokens=4, temperature=1.0, top_k=5))
-        while not (cbe._events[rid_plain].is_set()
-                   and cbe._events[rid_topk].is_set()):
-            assert cbe.step() or cbe._queue
+            max_new_tokens=6))
+        rid_topk = cbe.submit(g2, topk_cfg)
+        cbe.step()
+        # Both live in the SAME decode batch despite different pairs.
+        live_pairs = {(s.top_k, s.top_p) for s in cbe._slots
+                      if s is not None}
+        assert live_pairs == {(0, 1.0), (5, 1.0)}
+        cbe.run_until_idle()
         assert cbe.wait(rid_plain) == _reference_greedy(
-            cbe.params, g1, 4)
-        sampled = cbe.wait(rid_topk)
-        assert len(sampled) == 4
+            cbe.params, g1, 6)
+        assert cbe.wait(rid_topk) == solo_topk
+
+    def test_mixed_top_p_and_top_k_match_solo(self, cbe):
+        """A top-p row and a top-k row sharing the batch each match
+        their solo output (per-row cutoffs don't cross-contaminate)."""
+        p1, p2 = [5, 17, 3, 42], [9, 1]
+        topp_cfg = engine_lib.SamplingConfig(
+            max_new_tokens=5, temperature=1.0, top_p=0.7, seed=11)
+        topk_cfg = engine_lib.SamplingConfig(
+            max_new_tokens=5, temperature=1.0, top_k=3, seed=22)
+        solo_p = cbe.generate([p1], topp_cfg)[0]
+        solo_k = cbe.generate([p2], topk_cfg)[0]
+        rid_p = cbe.submit(p1, topp_cfg)
+        rid_k = cbe.submit(p2, topk_cfg)
+        cbe.run_until_idle()
+        assert cbe.wait(rid_p) == solo_p
+        assert cbe.wait(rid_k) == solo_k
+
+    def test_top_k_bucket_bounds_compile_cache(self):
+        bucket = engine_lib.top_k_bucket
+        assert bucket(0, 96) == 0
+        assert bucket(1, 96) == 1
+        assert bucket(5, 96) == 8
+        assert bucket(8, 96) == 8
+        assert bucket(70, 96) == 96      # capped at vocab
+        # Distinct user ks collapse onto few buckets.
+        assert {bucket(k, 4096) for k in range(1, 100)} == \
+            {1, 2, 4, 8, 16, 32, 64, 128}
 
     def test_cancel_releases_bookkeeping(self, cbe):
         """Canceled requests (queued, active, or finished-unread) leave

@@ -70,14 +70,32 @@ class TestQuantizeTree:
             np.asarray(out['layer_1']['kernel']),
             np.arange(12.0).reshape(3, 2, 2)[1])
 
-    def test_mesh_rejected(self):
+    def test_quantized_shardings_follow_float_rules(self):
+        """q8 inherits the kernel's NamedSharding; scale drops the
+        (absmax-reduced) first axis but keeps output-axis sharding."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
         from skypilot_tpu.parallel import mesh as mesh_lib
-        mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(data=1, fsdp=-1))
-        with pytest.raises(NotImplementedError, match='single-device'):
-            engine_lib.InferenceEngine(
-                'llama-tiny', mesh=mesh,
-                model_overrides={'max_seq_len': 64},
-                quantize='int8')
+        mesh = mesh_lib.make_mesh(
+            mesh_lib.MeshConfig(data=1, fsdp=-1, tensor=2))
+        float_sh = {
+            'attn': {'kernel': NamedSharding(mesh, P('fsdp', 'tensor')),
+                     'bias': NamedSharding(mesh, P())},
+            'norm': {'scale': NamedSharding(mesh, P())},
+        }
+        qparams = {
+            'attn': {'kernel': {'q8': jnp.zeros((4, 4), jnp.int8),
+                                'scale': jnp.zeros((1, 4))},
+                     'bias': jnp.zeros((4,))},
+            'norm': {'scale': jnp.ones((4,))},
+        }
+        out = engine_lib.quantized_param_shardings(mesh, float_sh,
+                                                   qparams)
+        assert out['attn']['kernel']['q8'].spec == P('fsdp', 'tensor')
+        assert out['attn']['kernel']['scale'].spec == P(None, 'tensor')
+        # Non-quantized leaves (incl. a genuine norm 'scale') keep
+        # their float shardings untouched.
+        assert out['attn']['bias'].spec == P()
+        assert out['norm']['scale'].spec == P()
 
     def test_bad_mode_rejected(self):
         with pytest.raises(ValueError, match='int8'):
@@ -117,6 +135,37 @@ _CHILD = textwrap.dedent('''
     assert got == want, (got, want)
     print('EQUIV-OK')
 
+    # Sharded int8 (round-4): tensor=2 over the 8-device virtual mesh
+    # must decode the SAME tokens as the single-device dequantized ref
+    # — {q8, scale} leaves carry NamedShardings derived from the float
+    # kernels' rules.
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshConfig(data=1, fsdp=-1, tensor=2))
+    qeng_sharded = engine_lib.ContinuousBatchingEngine(
+        'llama-tiny', mesh=mesh, n_slots=2, params=base.params,
+        model_overrides=dict(OV), param_dtype=jnp.float32,
+        quantize='int8')
+    import flax as _flax
+    _specs = {k: v.sharding.spec for k, v in
+              _flax.traverse_util.flatten_dict(
+                  qeng_sharded.params).items() if k[-1] == 'q8'}
+    assert any('tensor' in str(s) for s in _specs.values()), _specs
+    got_sharded = qeng_sharded.generate(prompts, cfg)
+    assert got_sharded == want, (got_sharded, want)
+    print('SHARDED-INT8-OK')
+
+    # Serve path: --quantize composes with --mesh-config (the warmup
+    # generate in __init__ exercises the sharded quantized engine).
+    from skypilot_tpu.infer import server as server_lib
+    srv = server_lib.InferenceServer(
+        model='llama-tiny', port=0, max_batch_size=2,
+        mesh_config='data=1,fsdp=-1,tensor=2',
+        model_overrides=dict(OV), quantize='int8')
+    assert srv.engine.mesh is not None
+    assert srv.engine.quantize == 'int8'
+    print('SERVER-MESH-INT8-OK')
+
     # Scanned trainer checkpoint -> quantized (unscanned) serving.
     import tempfile
     from skypilot_tpu.parallel import mesh as mesh_lib
@@ -155,4 +204,6 @@ def test_quantized_engine_behavior_in_fresh_interpreter(tmp_path):
                           capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert 'EQUIV-OK' in proc.stdout
+    assert 'SHARDED-INT8-OK' in proc.stdout
+    assert 'SERVER-MESH-INT8-OK' in proc.stdout
     assert 'SCANNED-CKPT-OK' in proc.stdout
