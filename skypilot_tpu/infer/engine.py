@@ -801,6 +801,7 @@ class InferenceEngine:
         self._abstract_cache = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
             sharding_lib.unbox(abstract['cache']))
+        already_quantized = False
         if params is not None:
             if self.quantize and isinstance(params, dict) \
                     and 'layers' in params:
@@ -809,7 +810,28 @@ class InferenceEngine:
                 # this engine's unscanned tree.
                 params = unstack_scanned_params(params,
                                                 self.config.n_layers)
-            self.params = self._place(params, param_shardings)
+            if self.quantize == 'int8':
+                # Quantize BEFORE mesh placement: device_put-ing the
+                # float tree onto the mesh only to replace it with the
+                # int8 tree would double init-time host->HBM traffic
+                # and transiently hold both copies.  Cast to
+                # param_dtype first (same as _place) so q8/scale are
+                # derived from exactly the values float serving uses.
+                cast = jax.tree.map(
+                    lambda x: jnp.asarray(x, self.config.param_dtype)
+                    if jnp.issubdtype(jnp.asarray(x).dtype,
+                                      jnp.floating)
+                    else jnp.asarray(x), params)
+                q = jax.tree.map(jnp.asarray,
+                                 quantize_params_int8(cast))
+                if mesh is not None:
+                    q = jax.device_put(
+                        q, quantized_param_shardings(
+                            mesh, param_shardings, q))
+                self.params = q
+                already_quantized = True
+            else:
+                self.params = self._place(params, param_shardings)
         elif checkpoint_dir is not None:
             self.params = self._load_checkpoint(checkpoint_dir,
                                                 abstract['params'],
@@ -826,7 +848,7 @@ class InferenceEngine:
                     _init_params, out_shardings=param_shardings)()
             else:
                 self.params = _init_params()
-        if self.quantize == 'int8':
+        if self.quantize == 'int8' and not already_quantized:
             if isinstance(self.params, dict) and 'layers' in self.params:
                 # Caller handed scanned-layout weights (the trainer
                 # default); this engine runs unscanned.

@@ -241,7 +241,9 @@ def main() -> None:
     parser.add_argument('--quantize', default=None,
                         choices=['int8'],
                         help='Weight-only int8 serving: halves param '
-                             'HBM traffic (single-device only).')
+                             'HBM traffic; composes with --mesh '
+                             '(q8/scale leaves shard like their float '
+                             'kernels).')
     parser.add_argument('--platform', default=None,
                         help="Force a jax platform (e.g. 'cpu' for "
                              'tests; env JAX_PLATFORMS alone is not '

@@ -180,8 +180,13 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             live_gids = _live_instance_group_ids(region,
                                                  cluster_name_on_cloud)
             own = _find_cluster_sg(region, cluster_name_on_cloud)
-            if live_gids and (own is None or own not in live_gids):
-                sg_ids = live_gids
+            if live_gids and set(live_gids) != ({own} if own else set()):
+                # Legacy or mixed-group cluster: join ALL groups the
+                # live nodes use so every node pair shares at least
+                # one group's self-rule (joining only the dedicated
+                # group would partition new nodes from legacy ones).
+                sg_ids = sorted(set(live_gids) | ({own} if own else
+                                                  set()))
             else:
                 sg_ids = [_ensure_cluster_sg(region,
                                              cluster_name_on_cloud)]
