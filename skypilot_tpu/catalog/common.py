@@ -109,13 +109,16 @@ def parse_bound(request) -> 'tuple[Optional[float], bool]':
 
 def pick_default_instance_type(df, cpus: Optional[str],
                                memory: Optional[str],
-                               min_default_vcpus: int = 8
+                               min_default_vcpus: int = 8,
+                               allow_accelerators: bool = False
                                ) -> Optional[str]:
     """Cheapest CPU-only row of a vms dataframe satisfying the
     cpus/memory request — ONE copy of the selection the per-cloud
     catalogs share, including the implicit >=8-vCPU floor when nothing
-    is requested."""
-    df = df[df['accelerator_count'] == 0]
+    is requested.  GPU-only clouds (RunPod) pass allow_accelerators to
+    default to their cheapest qualifying GPU pod instead of nothing."""
+    if not allow_accelerators:
+        df = df[df['accelerator_count'] == 0]
     cpu_val, cpu_plus = parse_bound(cpus)
     mem_val, mem_plus = parse_bound(memory)
     if cpu_val is not None:

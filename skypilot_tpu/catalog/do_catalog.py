@@ -1,0 +1,156 @@
+"""DigitalOcean catalog: droplet sizes, prices, regions.
+
+Counterpart of the reference's service_catalog do tier.  DO prices
+are flat per size across regions (no spot tier); GPU droplets
+(gpu-h100x*) carry H100s.  Snapshot overridable by
+`~/.skytpu/catalogs/v1/do/vms.csv`; refresh via `catalog update do`
+(fetchers/fetch_do.py reads the public /v2/sizes API).
+"""
+from __future__ import annotations
+
+import io
+import typing
+from typing import Dict, List, Optional, Tuple
+
+if typing.TYPE_CHECKING:
+    import pandas as pd
+
+from skypilot_tpu import exceptions
+
+# Public list prices 2025 ($/h; DO has no spot — spot mirrors price).
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+s-4vcpu-8gb,4,8,,0,0.0714,0.0714
+s-8vcpu-16gb,8,16,,0,0.1429,0.1429
+c-8,8,16,,0,0.25,0.25
+c-16,16,32,,0,0.50,0.50
+g-8vcpu-32gb,8,32,,0,0.3752,0.3752
+m-8vcpu-64gb,8,64,,0,0.4988,0.4988
+c-32,32,64,,0,1.00,1.00
+gpu-h100x1-80gb,20,240,H100,1,3.39,3.39
+gpu-h100x8-640gb,160,1920,H100,8,23.92,23.92
+"""
+
+_REGIONS = ['nyc1', 'nyc2', 'nyc3', 'sfo2', 'sfo3', 'ams3', 'fra1',
+            'lon1', 'sgp1', 'blr1', 'syd1', 'tor1']
+# GPU droplets exist only in these regions (public availability list).
+_GPU_REGIONS = ['nyc2', 'tor1', 'ams3']
+
+_VM_COLUMNS = ['instance_type', 'vcpus', 'memory_gb',
+               'accelerator_name', 'accelerator_count', 'price',
+               'spot_price']
+
+SNAPSHOT_DATE = '2025-03-01'
+
+_df: Optional['pd.DataFrame'] = None
+
+
+def _vm_df() -> 'pd.DataFrame':
+    global _df
+    if _df is None:
+        import pandas as pd
+
+        from skypilot_tpu.catalog import common
+        _df = common.read_catalog_csv('do', 'vms', _VM_COLUMNS)
+        if _df is None:
+            common.warn_if_snapshot_stale('do', SNAPSHOT_DATE)
+            _df = pd.read_csv(io.StringIO(_VMS_CSV))
+    return _df
+
+
+def reload() -> None:
+    global _df
+    _df = None
+
+
+def export_snapshot() -> Dict[str, str]:
+    return {'vms': _vm_df().to_csv(index=False)}
+
+
+def regions(instance_type: Optional[str] = None) -> List[str]:
+    if instance_type and instance_type.startswith('gpu-'):
+        return list(_GPU_REGIONS)
+    return list(_REGIONS)
+
+
+def instance_type_exists(instance_type: str) -> bool:
+    df = _vm_df()
+    return bool((df['instance_type'] == instance_type).any())
+
+
+def _row(instance_type: str):
+    df = _vm_df()
+    rows = df[df['instance_type'] == instance_type]
+    if rows.empty:
+        raise exceptions.ResourcesUnavailableError(
+            f'No DigitalOcean size {instance_type!r}; have '
+            f'{sorted(df["instance_type"])}')
+    return rows.iloc[0]
+
+
+def get_hourly_cost(instance_type: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    del use_spot, region, zone  # flat pricing, no spot tier
+    return float(_row(instance_type)['price'])
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    row = _row(instance_type)
+    return float(row['vcpus']), float(row['memory_gb'])
+
+
+def get_accelerators_from_instance_type(
+        instance_type: str) -> Optional[Dict[str, int]]:
+    row = _row(instance_type)
+    if not row['accelerator_name'] or \
+            str(row['accelerator_name']) == 'nan':
+        return None
+    return {str(row['accelerator_name']): int(row['accelerator_count'])}
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None,
+                              disk_tier: Optional[str] = None
+                              ) -> Optional[str]:
+    del disk_tier
+    from skypilot_tpu.catalog import common
+    return common.pick_default_instance_type(_vm_df(), cpus, memory)
+
+
+def get_instance_type_for_accelerator(acc_name: str,
+                                      acc_count: int) -> List[str]:
+    df = _vm_df()
+    rows = df[(df['accelerator_name'] == acc_name)
+              & (df['accelerator_count'] == acc_count)]
+    return sorted(rows['instance_type'])
+
+
+def get_accelerator_hourly_cost(acc_name: str, acc_count: int,
+                                use_spot: bool,
+                                region: Optional[str] = None,
+                                zone: Optional[str] = None) -> float:
+    types = get_instance_type_for_accelerator(acc_name, acc_count)
+    if not types:
+        raise exceptions.ResourcesUnavailableError(
+            f'No DigitalOcean size offers {acc_name}:{acc_count}.')
+    return min(get_hourly_cost(t, use_spot, region, zone)
+               for t in types)
+
+
+def list_accelerators(name_filter: Optional[str] = None
+                      ) -> Dict[str, List[Dict[str, object]]]:
+    df = _vm_df()
+    out: Dict[str, List[Dict[str, object]]] = {}
+    for _, row in df[df['accelerator_count'] > 0].iterrows():
+        name = str(row['accelerator_name'])
+        if name_filter and name_filter.lower() not in name.lower():
+            continue
+        out.setdefault(name, []).append({
+            'accelerator_count': int(row['accelerator_count']),
+            'instance_type': str(row['instance_type']),
+            'price': float(row['price']),
+            'spot_price': float(row['spot_price']),
+        })
+    return out

@@ -22,4 +22,16 @@ def fetch(cloud: str, **kwargs) -> Dict[str, str]:
     if cloud == 'azure':
         from skypilot_tpu.catalog.fetchers import fetch_azure
         return fetch_azure.fetch_and_write(**kwargs)
+    if cloud == 'lambda':
+        from skypilot_tpu.catalog.fetchers import fetch_lambda
+        return fetch_lambda.fetch_and_write(**kwargs)
+    if cloud == 'runpod':
+        from skypilot_tpu.catalog.fetchers import fetch_runpod
+        return fetch_runpod.fetch_and_write(**kwargs)
+    if cloud == 'do':
+        from skypilot_tpu.catalog.fetchers import fetch_do
+        return fetch_do.fetch_and_write(**kwargs)
+    if cloud == 'fluidstack':
+        from skypilot_tpu.catalog.fetchers import fetch_fluidstack
+        return fetch_fluidstack.fetch_and_write(**kwargs)
     raise ValueError(f'No catalog fetcher for cloud {cloud!r}.')

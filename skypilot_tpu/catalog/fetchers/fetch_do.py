@@ -1,0 +1,90 @@
+"""Regenerate the DigitalOcean `vms` table from the /v2/sizes API.
+
+DO publishes every droplet size (vcpus, memory, hourly price, region
+availability) through the authenticated sizes endpoint:
+
+    GET https://api.digitalocean.com/v2/sizes?per_page=200
+
+`fetch_page` is injectable for air-gapped tests.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+# gpu-h100x8-640gb -> (H100, 8); non-gpu sizes carry no accelerator.
+_GPU_SLUG = re.compile(r'^gpu-([a-z0-9]+?)x(\d+)(?:-|$)')
+_GPU_NAMES = {'h100': 'H100', 'l40s': 'L40S', 'mi300x': 'MI300X'}
+# Size families worth carrying (the full list is hundreds of legacy
+# slugs; the catalog keeps the modern tiers the optimizer picks from).
+_FAMILIES = ('s-', 'c-', 'g-', 'm-', 'gpu-')
+
+
+def _default_fetch_page(page: int) -> Dict[str, Any]:
+    from skypilot_tpu.provision.do import do_api
+    return do_api.request('GET', '/sizes',
+                          params={'per_page': '200',
+                                  'page': str(page)})
+
+
+def rows_from_sizes(sizes: List[Dict[str, Any]]):
+    rows = []
+    for size in sizes or []:
+        slug = str(size.get('slug', ''))
+        if not slug.startswith(_FAMILIES) or \
+                not size.get('available', True):
+            continue
+        acc_name, acc_count = '', 0
+        m = _GPU_SLUG.match(slug)
+        if m:
+            acc_name = _GPU_NAMES.get(m.group(1), m.group(1).upper())
+            acc_count = int(m.group(2))
+        price = float(size.get('price_hourly', 0) or 0)
+        if price <= 0:
+            continue
+        rows.append({
+            'instance_type': slug,
+            'vcpus': float(size.get('vcpus', 0) or 0),
+            'memory_gb': float(size.get('memory', 0) or 0) / 1024.0,
+            'accelerator_name': acc_name,
+            'accelerator_count': acc_count,
+            'price': price,
+            'spot_price': price,  # no spot tier
+        })
+    return sorted(rows, key=lambda r: r['instance_type'])
+
+
+def fetch_and_write(fetch_page: Optional[Callable[[int],
+                                                  Dict[str, Any]]] = None
+                    ) -> Dict[str, str]:
+    from skypilot_tpu.catalog import common
+    from skypilot_tpu.catalog import do_catalog
+    fetch_page = fetch_page or _default_fetch_page
+    sizes: List[Dict[str, Any]] = []
+    page = 1
+    while True:
+        resp = fetch_page(page)
+        batch = list(resp.get('sizes') or [])
+        sizes.extend(batch)
+        if not resp.get('links', {}).get('pages', {}).get('next'):
+            break
+        page += 1
+    rows = rows_from_sizes(sizes)
+    if not rows:
+        raise RuntimeError('DigitalOcean sizes API returned no usable '
+                           'sizes; keeping the previous table.')
+    lines = ['instance_type,vcpus,memory_gb,accelerator_name,'
+             'accelerator_count,price,spot_price']
+    for r in rows:
+        lines.append(f"{r['instance_type']},{r['vcpus']},"
+                     f"{r['memory_gb']},{r['accelerator_name']},"
+                     f"{r['accelerator_count']},{r['price']},"
+                     f"{r['spot_price']}")
+    path = common.write_catalog_csv('do', 'vms',
+                                    '\n'.join(lines) + '\n')
+    do_catalog.reload()
+    return {'vms': path}

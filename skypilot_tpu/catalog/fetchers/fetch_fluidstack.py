@@ -1,0 +1,86 @@
+"""Regenerate the FluidStack `vms` table from the public plans API.
+
+Reference: sky/clouds/service_catalog/data_fetchers/
+fetch_fluidstack.py — rebuilt against the same endpoint:
+
+    GET https://platform.fluidstack.io/list_available_configurations
+    (api-key header; returns plans with gpu_type, price_per_gpu_hr,
+    gpu_counts, regions)
+
+`fetch_json` is injectable for air-gapped tests.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+PATH = '/list_available_configurations'
+
+# FluidStack gpu_type -> canonical accelerator name (subset the
+# snapshot carries; unknown types pass through verbatim).
+_GPU_NAMES = {
+    'RTX_A6000_48GB': 'RTXA6000',
+    'A100_PCIE_80GB': 'A100-80GB',
+    'A100_SXM4_80GB': 'A100-80GB-SXM',
+    'H100_PCIE_80GB': 'H100',
+    'H100_SXM5_80GB': 'H100-SXM',
+    'L40_48GB': 'L40',
+}
+# Host shape per GPU (vcpus, mem GB) — the plans API prices GPUs, not
+# host shapes; these are FluidStack's published per-GPU allotments.
+_PER_GPU_SHAPE = {'default': (28, 120)}
+
+
+def _default_fetch_json(_path: str) -> List[Dict[str, Any]]:
+    from skypilot_tpu.provision.fluidstack import fluidstack_api
+    return fluidstack_api.request('GET', PATH)
+
+
+def rows_from_plans(plans: List[Dict[str, Any]]):
+    rows = []
+    for plan in plans or []:
+        gpu_type = str(plan.get('gpu_type', ''))
+        if not gpu_type:
+            continue
+        per_gpu = float(plan.get('price_per_gpu_hr', 0) or 0)
+        if per_gpu <= 0:
+            continue
+        acc = _GPU_NAMES.get(gpu_type, gpu_type)
+        vcpus_per, mem_per = _PER_GPU_SHAPE['default']
+        for count in sorted(set(plan.get('gpu_counts') or [1])):
+            count = int(count)
+            rows.append({
+                'instance_type': f'{gpu_type}::{count}',
+                'vcpus': vcpus_per * count,
+                'memory_gb': mem_per * count,
+                'accelerator_name': acc,
+                'accelerator_count': count,
+                'price': round(per_gpu * count, 4),
+                'spot_price': round(per_gpu * count, 4),
+            })
+    return sorted(rows, key=lambda r: r['instance_type'])
+
+
+def fetch_and_write(fetch_json: Optional[Callable[[str], Any]] = None
+                    ) -> Dict[str, str]:
+    from skypilot_tpu.catalog import common
+    from skypilot_tpu.catalog import fluidstack_catalog
+    fetch_json = fetch_json or _default_fetch_json
+    rows = rows_from_plans(fetch_json(PATH))
+    if not rows:
+        raise RuntimeError('FluidStack plans API returned no plans; '
+                           'keeping the previous table.')
+    lines = ['instance_type,vcpus,memory_gb,accelerator_name,'
+             'accelerator_count,price,spot_price']
+    for r in rows:
+        lines.append(f"{r['instance_type']},{r['vcpus']},"
+                     f"{r['memory_gb']},{r['accelerator_name']},"
+                     f"{r['accelerator_count']},{r['price']},"
+                     f"{r['spot_price']}")
+    path = common.write_catalog_csv('fluidstack', 'vms',
+                                    '\n'.join(lines) + '\n')
+    fluidstack_catalog.reload()
+    return {'vms': path}

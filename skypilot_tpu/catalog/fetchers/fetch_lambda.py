@@ -1,0 +1,109 @@
+"""Regenerate the Lambda Cloud `vms` table from the public
+instance-types API.
+
+Reference: sky/clouds/service_catalog/data_fetchers/
+fetch_lambda_cloud.py — rebuilt against the same endpoint:
+
+    GET https://cloud.lambdalabs.com/api/v1/instance-types
+    (Bearer <api key>; returns every type with price_cents_per_hour,
+    specs, and the regions with capacity)
+
+`fetch_json` is injectable for air-gapped tests.
+"""
+from __future__ import annotations
+
+import json
+import re
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+URL = 'https://cloud.lambdalabs.com/api/v1/instance-types'
+
+# gpu_1x_a100_sxm4 -> A100; keep in sync with the accelerator names
+# the snapshot already uses (optimizer requests match on these).
+_GPU_PATTERNS = [
+    (re.compile(r'a100.*80gb|8x_a100_80gb', re.I), 'A100-80GB'),
+    (re.compile(r'a100', re.I), 'A100'),
+    (re.compile(r'h100', re.I), 'H100'),
+    (re.compile(r'gh200', re.I), 'GH200'),
+    (re.compile(r'a10\b', re.I), 'A10'),
+    (re.compile(r'a6000', re.I), 'A6000'),
+    (re.compile(r'rtx6000', re.I), 'RTX6000'),
+    (re.compile(r'v100', re.I), 'V100'),
+]
+
+
+def _default_fetch_json(url: str) -> Dict[str, Any]:
+    from skypilot_tpu.provision.lambda_cloud import lambda_api
+    key = lambda_api.load_api_key()
+    if key is None:
+        raise RuntimeError('Lambda catalog fetch needs an API key '
+                           '(env LAMBDA_API_KEY).')
+    req = urllib.request.Request(
+        url, headers={'Authorization': f'Bearer {key}'})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def _gpu_name(type_name: str, description: str) -> Optional[str]:
+    if not type_name.startswith('gpu_'):
+        return None
+    for pattern, name in _GPU_PATTERNS:
+        if pattern.search(type_name) or pattern.search(description):
+            return name
+    return None
+
+
+def _gpu_count(type_name: str) -> int:
+    m = re.match(r'gpu_(\d+)x_', type_name)
+    return int(m.group(1)) if m else 1
+
+
+def rows_from_response(payload: Dict[str, Any]):
+    """instance-types response -> vms-table rows (list of dicts)."""
+    rows = []
+    for entry in (payload.get('data') or {}).values():
+        it = entry.get('instance_type') or {}
+        name = str(it.get('name', ''))
+        if not name:
+            continue
+        specs = it.get('specs') or {}
+        price = float(it.get('price_cents_per_hour', 0)) / 100.0
+        gpu = _gpu_name(name, str(it.get('description', '')))
+        rows.append({
+            'instance_type': name,
+            'vcpus': float(specs.get('vcpus', 0) or 0),
+            'memory_gb': float(specs.get('memory_gib', 0) or 0),
+            'accelerator_name': gpu or '',
+            'accelerator_count': _gpu_count(name) if gpu else 0,
+            'price': price,
+            'spot_price': price,  # no spot tier
+        })
+    return sorted(rows, key=lambda r: r['instance_type'])
+
+
+def fetch_and_write(fetch_json: Optional[Callable[[str],
+                                                  Dict[str, Any]]] = None
+                    ) -> Dict[str, str]:
+    from skypilot_tpu.catalog import common
+    from skypilot_tpu.catalog import lambda_catalog
+    fetch_json = fetch_json or _default_fetch_json
+    rows = rows_from_response(fetch_json(URL))
+    if not rows:
+        raise RuntimeError('Lambda instance-types API returned no '
+                           'types; keeping the previous table.')
+    lines = ['instance_type,vcpus,memory_gb,accelerator_name,'
+             'accelerator_count,price,spot_price']
+    for r in rows:
+        lines.append(f"{r['instance_type']},{r['vcpus']},"
+                     f"{r['memory_gb']},{r['accelerator_name']},"
+                     f"{r['accelerator_count']},{r['price']},"
+                     f"{r['spot_price']}")
+    path = common.write_catalog_csv('lambda', 'vms',
+                                    '\n'.join(lines) + '\n')
+    lambda_catalog.reload()
+    return {'vms': path}

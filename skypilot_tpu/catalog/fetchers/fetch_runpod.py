@@ -1,0 +1,110 @@
+"""Regenerate the RunPod `vms` table from the GPU-types GraphQL query.
+
+Counterpart of the reference's runpod catalog refresh — RunPod
+publishes per-GPU prices through the same GraphQL API the provisioner
+uses:
+
+    query { gpuTypes { id displayName memoryInGb securePrice
+                       communityPrice secureSpotPrice
+                       communitySpotPrice } }
+
+`run_query` is injectable for air-gapped tests.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+QUERY = ('query GpuTypes { gpuTypes { id displayName memoryInGb '
+         'securePrice communityPrice secureSpotPrice '
+         'communitySpotPrice } }')
+
+# displayName -> catalog accelerator token (instance types are
+# <n>x_<token>_<TIER>, matching the shipped snapshot's grammar).
+_NAME_TOKENS = {
+    'NVIDIA A100 80GB PCIe': 'A100-80GB',
+    'NVIDIA A100-SXM4-80GB': 'A100-80GB-SXM',
+    'NVIDIA A40': 'A40',
+    'NVIDIA L40S': 'L40S',
+    'NVIDIA GeForce RTX 4090': 'RTX4090',
+    'NVIDIA H100 PCIe': 'H100',
+    'NVIDIA H100 80GB HBM3': 'H100-SXM',
+}
+_COUNTS = (1, 2, 4, 8)
+
+
+def _default_run_query(query: str) -> Dict[str, Any]:
+    from skypilot_tpu.provision.runpod import runpod_api
+    return runpod_api._call(query)  # pylint: disable=protected-access
+
+
+def rows_from_gpu_types(gpu_types: List[Dict[str, Any]],
+                        known_shapes: Optional[Dict[str, tuple]] = None):
+    """gpuTypes -> vms rows.  The query prices GPUs; it does NOT
+    describe host shapes (memoryInGb is VRAM).  Host vcpus/memory come
+    from `known_shapes` (the current table — only PRICES refresh for
+    known types; a refresh must never shrink a pod's advertised shape
+    and break cpus=/memory= requests that resolved before); brand-new
+    GPU types fall back to RunPod's published per-GPU allotments."""
+    known_shapes = known_shapes or {}
+    rows = []
+    for gpu in gpu_types or []:
+        token = _NAME_TOKENS.get(str(gpu.get('displayName', '')))
+        if token is None:
+            continue
+        vram = float(gpu.get('memoryInGb', 0) or 0)
+        for tier, price_key, spot_key in (
+                ('SECURE', 'securePrice', 'secureSpotPrice'),
+                ('COMMUNITY', 'communityPrice', 'communitySpotPrice')):
+            od = float(gpu.get(price_key) or 0)
+            if od <= 0:
+                continue
+            spot = float(gpu.get(spot_key) or 0) or od
+            for count in _COUNTS:
+                itype = f'{count}x_{token}_{tier}'
+                vcpus, mem = known_shapes.get(itype) or (
+                    (12 if vram >= 80 else 8) * count,
+                    max(vram, 8) * count + 16 * count)
+                rows.append({
+                    'instance_type': itype,
+                    'vcpus': vcpus,
+                    'memory_gb': mem,
+                    'accelerator_name': token,
+                    'accelerator_count': count,
+                    'price': round(od * count, 4),
+                    'spot_price': round(spot * count, 4),
+                })
+    return sorted(rows, key=lambda r: r['instance_type'])
+
+
+def fetch_and_write(run_query: Optional[Callable[[str],
+                                                 Dict[str, Any]]] = None
+                    ) -> Dict[str, str]:
+    from skypilot_tpu.catalog import common
+    from skypilot_tpu.catalog import runpod_catalog
+    run_query = run_query or _default_run_query
+    data = run_query(QUERY)
+    current = runpod_catalog._vm_df()  # pylint: disable=protected-access
+    known_shapes = {
+        str(r['instance_type']): (float(r['vcpus']),
+                                  float(r['memory_gb']))
+        for _, r in current.iterrows()}
+    rows = rows_from_gpu_types(list(data.get('gpuTypes') or []),
+                               known_shapes)
+    if not rows:
+        raise RuntimeError('RunPod gpuTypes query returned nothing '
+                           'usable; keeping the previous table.')
+    lines = ['instance_type,vcpus,memory_gb,accelerator_name,'
+             'accelerator_count,price,spot_price']
+    for r in rows:
+        lines.append(f"{r['instance_type']},{r['vcpus']},"
+                     f"{r['memory_gb']},{r['accelerator_name']},"
+                     f"{r['accelerator_count']},{r['price']},"
+                     f"{r['spot_price']}")
+    path = common.write_catalog_csv('runpod', 'vms',
+                                    '\n'.join(lines) + '\n')
+    runpod_catalog.reload()
+    return {'vms': path}

@@ -1,0 +1,156 @@
+"""FluidStack catalog: GPU plans, prices, regions.
+
+Counterpart of the reference's service_catalog fluidstack tier (the
+reference regenerates it with data_fetchers/fetch_fluidstack.py from
+the public list_available_configurations API; ours refreshes via
+`catalog update fluidstack` → fetchers/fetch_fluidstack.py).
+Instance types keep the reference's `<GPU_TYPE>::<count>` grammar.
+Snapshot overridable by `~/.skytpu/catalogs/v1/fluidstack/vms.csv`.
+"""
+from __future__ import annotations
+
+import io
+import typing
+from typing import Dict, List, Optional, Tuple
+
+if typing.TYPE_CHECKING:
+    import pandas as pd
+
+from skypilot_tpu import exceptions
+
+# Public per-GPU-hour list prices 2025 x count; no spot tier.
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+RTX_A6000_48GB::1,12,64,RTXA6000,1,0.49,0.49
+RTX_A6000_48GB::2,24,128,RTXA6000,2,0.98,0.98
+A100_PCIE_80GB::1,28,120,A100-80GB,1,1.49,1.49
+A100_PCIE_80GB::2,56,240,A100-80GB,2,2.98,2.98
+A100_PCIE_80GB::4,112,480,A100-80GB,4,5.96,5.96
+A100_PCIE_80GB::8,224,960,A100-80GB,8,11.92,11.92
+H100_PCIE_80GB::1,28,180,H100,1,2.89,2.89
+H100_PCIE_80GB::2,56,360,H100,2,5.78,5.78
+H100_PCIE_80GB::4,112,720,H100,4,11.56,11.56
+H100_PCIE_80GB::8,224,1440,H100,8,23.12,23.12
+"""
+
+_REGIONS = ['norway_2_eu', 'canada_1_ca', 'iceland_1_eu',
+            'united_states_1_us']
+
+_VM_COLUMNS = ['instance_type', 'vcpus', 'memory_gb',
+               'accelerator_name', 'accelerator_count', 'price',
+               'spot_price']
+
+SNAPSHOT_DATE = '2025-03-01'
+
+_df: Optional['pd.DataFrame'] = None
+
+
+def _vm_df() -> 'pd.DataFrame':
+    global _df
+    if _df is None:
+        import pandas as pd
+
+        from skypilot_tpu.catalog import common
+        _df = common.read_catalog_csv('fluidstack', 'vms', _VM_COLUMNS)
+        if _df is None:
+            common.warn_if_snapshot_stale('fluidstack', SNAPSHOT_DATE)
+            _df = pd.read_csv(io.StringIO(_VMS_CSV))
+    return _df
+
+
+def reload() -> None:
+    global _df
+    _df = None
+
+
+def export_snapshot() -> Dict[str, str]:
+    return {'vms': _vm_df().to_csv(index=False)}
+
+
+def regions() -> List[str]:
+    return list(_REGIONS)
+
+
+def instance_type_exists(instance_type: str) -> bool:
+    df = _vm_df()
+    return bool((df['instance_type'] == instance_type).any())
+
+
+def _row(instance_type: str):
+    df = _vm_df()
+    rows = df[df['instance_type'] == instance_type]
+    if rows.empty:
+        raise exceptions.ResourcesUnavailableError(
+            f'No FluidStack plan {instance_type!r}; have '
+            f'{sorted(df["instance_type"])}')
+    return rows.iloc[0]
+
+
+def get_hourly_cost(instance_type: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    del use_spot, region, zone  # flat pricing, no spot tier
+    return float(_row(instance_type)['price'])
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    row = _row(instance_type)
+    return float(row['vcpus']), float(row['memory_gb'])
+
+
+def get_accelerators_from_instance_type(
+        instance_type: str) -> Optional[Dict[str, int]]:
+    row = _row(instance_type)
+    if not row['accelerator_name'] or \
+            str(row['accelerator_name']) == 'nan':
+        return None
+    return {str(row['accelerator_name']): int(row['accelerator_count'])}
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None,
+                              disk_tier: Optional[str] = None
+                              ) -> Optional[str]:
+    # GPU-only platform: default to the cheapest qualifying plan.
+    del disk_tier
+    from skypilot_tpu.catalog import common
+    return common.pick_default_instance_type(_vm_df(), cpus, memory,
+                                             allow_accelerators=True)
+
+
+def get_instance_type_for_accelerator(acc_name: str,
+                                      acc_count: int) -> List[str]:
+    df = _vm_df()
+    rows = df[(df['accelerator_name'] == acc_name)
+              & (df['accelerator_count'] == acc_count)]
+    return sorted(rows['instance_type'])
+
+
+def get_accelerator_hourly_cost(acc_name: str, acc_count: int,
+                                use_spot: bool,
+                                region: Optional[str] = None,
+                                zone: Optional[str] = None) -> float:
+    types = get_instance_type_for_accelerator(acc_name, acc_count)
+    if not types:
+        raise exceptions.ResourcesUnavailableError(
+            f'No FluidStack plan offers {acc_name}:{acc_count}.')
+    return min(get_hourly_cost(t, use_spot, region, zone)
+               for t in types)
+
+
+def list_accelerators(name_filter: Optional[str] = None
+                      ) -> Dict[str, List[Dict[str, object]]]:
+    df = _vm_df()
+    out: Dict[str, List[Dict[str, object]]] = {}
+    for _, row in df[df['accelerator_count'] > 0].iterrows():
+        name = str(row['accelerator_name'])
+        if name_filter and name_filter.lower() not in name.lower():
+            continue
+        out.setdefault(name, []).append({
+            'accelerator_count': int(row['accelerator_count']),
+            'instance_type': str(row['instance_type']),
+            'price': float(row['price']),
+            'spot_price': float(row['spot_price']),
+        })
+    return out

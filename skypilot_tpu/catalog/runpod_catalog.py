@@ -1,0 +1,165 @@
+"""RunPod catalog: GPU pod types, on-demand + spot (interruptible)
+prices.
+
+Counterpart of the reference's service_catalog runpod tier.  RunPod
+prices per-GPU and sells SECURE (datacenter) and COMMUNITY (hosted)
+tiers; instance types keep the reference's `<n>x_<GPU>_<TIER>` shape
+so recipes port verbatim.  Region = country code (capacity is
+placement-matched, not zonal).  Snapshot overridable by
+`~/.skytpu/catalogs/v1/runpod/vms.csv`; refresh via
+`catalog update runpod` (fetchers/fetch_runpod.py).
+"""
+from __future__ import annotations
+
+import io
+import typing
+from typing import Dict, List, Optional, Tuple
+
+if typing.TYPE_CHECKING:
+    import pandas as pd
+
+from skypilot_tpu import exceptions
+
+# Public list prices 2025 ($/h per pod: per-GPU price x count; spot =
+# interruptible market floor).
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+1x_RTX4090_SECURE,8,32,RTX4090,1,0.69,0.35
+1x_A40_SECURE,8,48,A40,1,0.39,0.20
+1x_L40S_SECURE,12,48,L40S,1,0.99,0.50
+1x_A100-80GB_SECURE,12,96,A100-80GB,1,1.64,0.82
+2x_A100-80GB_SECURE,24,192,A100-80GB,2,3.28,1.64
+4x_A100-80GB_SECURE,48,384,A100-80GB,4,6.56,3.28
+8x_A100-80GB_SECURE,96,768,A100-80GB,8,13.12,6.56
+1x_H100_SECURE,16,96,H100,1,2.99,1.50
+2x_H100_SECURE,32,192,H100,2,5.98,3.00
+4x_H100_SECURE,64,384,H100,4,11.96,6.00
+8x_H100-SXM_SECURE,128,768,H100-SXM,8,35.92,18.00
+1x_RTX4090_COMMUNITY,8,32,RTX4090,1,0.44,0.22
+1x_A100-80GB_COMMUNITY,12,96,A100-80GB,1,1.19,0.60
+"""
+
+_REGIONS = ['US', 'CA', 'NL', 'NO', 'RO', 'SE', 'IS']
+
+_VM_COLUMNS = ['instance_type', 'vcpus', 'memory_gb',
+               'accelerator_name', 'accelerator_count', 'price',
+               'spot_price']
+
+SNAPSHOT_DATE = '2025-03-01'
+
+_df: Optional['pd.DataFrame'] = None
+
+
+def _vm_df() -> 'pd.DataFrame':
+    global _df
+    if _df is None:
+        import pandas as pd
+
+        from skypilot_tpu.catalog import common
+        _df = common.read_catalog_csv('runpod', 'vms', _VM_COLUMNS)
+        if _df is None:
+            common.warn_if_snapshot_stale('runpod', SNAPSHOT_DATE)
+            _df = pd.read_csv(io.StringIO(_VMS_CSV))
+    return _df
+
+
+def reload() -> None:
+    global _df
+    _df = None
+
+
+def export_snapshot() -> Dict[str, str]:
+    return {'vms': _vm_df().to_csv(index=False)}
+
+
+def regions() -> List[str]:
+    return list(_REGIONS)
+
+
+def instance_type_exists(instance_type: str) -> bool:
+    df = _vm_df()
+    return bool((df['instance_type'] == instance_type).any())
+
+
+def _row(instance_type: str):
+    df = _vm_df()
+    rows = df[df['instance_type'] == instance_type]
+    if rows.empty:
+        raise exceptions.ResourcesUnavailableError(
+            f'No RunPod instance type {instance_type!r}; have '
+            f'{sorted(df["instance_type"])}')
+    return rows.iloc[0]
+
+
+def get_hourly_cost(instance_type: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    del region, zone  # flat per-type pricing
+    row = _row(instance_type)
+    return float(row['spot_price'] if use_spot else row['price'])
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    row = _row(instance_type)
+    return float(row['vcpus']), float(row['memory_gb'])
+
+
+def get_accelerators_from_instance_type(
+        instance_type: str) -> Optional[Dict[str, int]]:
+    row = _row(instance_type)
+    if not row['accelerator_name'] or \
+            str(row['accelerator_name']) == 'nan':
+        return None
+    return {str(row['accelerator_name']): int(row['accelerator_count'])}
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None,
+                              disk_tier: Optional[str] = None
+                              ) -> Optional[str]:
+    # Every RunPod pod carries a GPU; the cheapest qualifying pod is
+    # the default (no CPU-only tier to prefer).
+    del disk_tier
+    from skypilot_tpu.catalog import common
+    return common.pick_default_instance_type(_vm_df(), cpus, memory,
+                                             allow_accelerators=True)
+
+
+def get_instance_type_for_accelerator(acc_name: str,
+                                      acc_count: int) -> List[str]:
+    df = _vm_df()
+    rows = df[(df['accelerator_name'] == acc_name)
+              & (df['accelerator_count'] == acc_count)]
+    # SECURE before COMMUNITY at equal spec: sort by price then name.
+    return list(rows.sort_values(['price', 'instance_type'])
+                ['instance_type'])
+
+
+def get_accelerator_hourly_cost(acc_name: str, acc_count: int,
+                                use_spot: bool,
+                                region: Optional[str] = None,
+                                zone: Optional[str] = None) -> float:
+    types = get_instance_type_for_accelerator(acc_name, acc_count)
+    if not types:
+        raise exceptions.ResourcesUnavailableError(
+            f'No RunPod instance type offers {acc_name}:{acc_count}.')
+    return min(get_hourly_cost(t, use_spot, region, zone)
+               for t in types)
+
+
+def list_accelerators(name_filter: Optional[str] = None
+                      ) -> Dict[str, List[Dict[str, object]]]:
+    df = _vm_df()
+    out: Dict[str, List[Dict[str, object]]] = {}
+    for _, row in df[df['accelerator_count'] > 0].iterrows():
+        name = str(row['accelerator_name'])
+        if name_filter and name_filter.lower() not in name.lower():
+            continue
+        out.setdefault(name, []).append({
+            'accelerator_count': int(row['accelerator_count']),
+            'instance_type': str(row['instance_type']),
+            'price': float(row['price']),
+            'spot_price': float(row['spot_price']),
+        })
+    return out

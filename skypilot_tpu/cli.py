@@ -310,10 +310,16 @@ def _show_accelerators(name_filter, include_gpus: bool) -> None:
     if include_gpus:
         from skypilot_tpu.catalog import aws_catalog
         from skypilot_tpu.catalog import azure_catalog
+        from skypilot_tpu.catalog import do_catalog
+        from skypilot_tpu.catalog import fluidstack_catalog
         from skypilot_tpu.catalog import lambda_catalog
+        from skypilot_tpu.catalog import runpod_catalog
         for label, cat in (('AWS', aws_catalog),
                            ('Azure', azure_catalog),
-                           ('Lambda', lambda_catalog)):
+                           ('Lambda', lambda_catalog),
+                           ('RunPod', runpod_catalog),
+                           ('DO', do_catalog),
+                           ('Fluidstack', fluidstack_catalog)):
             inv = cat.list_accelerators(name_filter)
             for name in sorted(inv):
                 for item in inv[name]:
@@ -403,6 +409,15 @@ def catalog_update(cloud, table, from_file, url, export, reset, fetch,
         tables = ('vms',)
     elif cloud == 'lambda':
         from skypilot_tpu.catalog import lambda_catalog as cat
+        tables = ('vms',)
+    elif cloud == 'runpod':
+        from skypilot_tpu.catalog import runpod_catalog as cat
+        tables = ('vms',)
+    elif cloud == 'do':
+        from skypilot_tpu.catalog import do_catalog as cat
+        tables = ('vms',)
+    elif cloud == 'fluidstack':
+        from skypilot_tpu.catalog import fluidstack_catalog as cat
         tables = ('vms',)
     else:
         raise click.UsageError(f'Unknown catalog cloud {cloud!r}.')

@@ -6,14 +6,17 @@ from skypilot_tpu.clouds.registry import CLOUD_REGISTRY
 # Importing the modules registers the clouds.
 from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.azure import Azure
+from skypilot_tpu.clouds.do import DO
+from skypilot_tpu.clouds.fluidstack import Fluidstack
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.fake import Fake, fake_cloud_state
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.lambda_cloud import Lambda
 from skypilot_tpu.clouds.local import Local
+from skypilot_tpu.clouds.runpod import RunPod
 
 __all__ = [
     'Cloud', 'CloudImplementationFeatures', 'FeasibleResources', 'Region',
-    'Zone', 'CLOUD_REGISTRY', 'AWS', 'Azure', 'GCP', 'Fake', 'Lambda',
-    'Local', 'fake_cloud_state',
+    'Zone', 'CLOUD_REGISTRY', 'AWS', 'Azure', 'DO', 'Fluidstack', 'GCP',
+    'Fake', 'Lambda', 'Local', 'RunPod', 'fake_cloud_state',
 ]

@@ -1,0 +1,204 @@
+"""DigitalOcean: capability model + catalog glue.
+
+Counterpart of the reference's sky/clouds/do.py, following the repo's
+Lambda minor-cloud recipe.  Platform truths: droplets stop/resume
+(power_off — disk keeps billing), flat pricing with no spot tier, no
+custom disk tiers, no default firewall (every port reachable), GPU
+droplets only in a few regions.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.catalog import do_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class DO(cloud.Cloud):
+    """DigitalOcean (droplets, incl. H100 GPU droplets)."""
+
+    _REPR = 'DO'
+    PROVISIONER_MODULE = 'do'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 247
+
+    @classmethod
+    def _unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        unsupported = {
+            cloud.CloudImplementationFeatures.SPOT_INSTANCE:
+                'DigitalOcean has no spot tier.',
+            cloud.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+                'fixed SSD tiers per size.',
+            cloud.CloudImplementationFeatures.CLONE_DISK:
+                'not supported.',
+            cloud.CloudImplementationFeatures.OPEN_PORTS:
+                'droplets have no default firewall; all ports are '
+                'already reachable.',
+        }
+        if resources.tpu_slice is not None:
+            unsupported[cloud.CloudImplementationFeatures.MULTI_NODE] = (
+                'DigitalOcean offers no TPUs; use GCP/Kubernetes.')
+        return unsupported
+
+    # ---- regions ---------------------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del accelerators
+        if use_spot or zone is not None:
+            return []
+        return [cloud.Region(r)
+                for r in do_catalog.regions(instance_type)
+                if region is None or r == region]
+
+    @classmethod
+    def zones_provision_loop(
+        cls, *, region: str, num_nodes: int, instance_type: str,
+        accelerators: Optional[Dict[str, int]] = None,
+        use_spot: bool = False,
+    ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del num_nodes, instance_type, accelerators, use_spot, region
+        yield None  # DO has no zones below region
+
+    # ---- pricing ---------------------------------------------------------
+    @classmethod
+    def instance_type_to_hourly_cost(cls, instance_type: str,
+                                     use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        return do_catalog.get_hourly_cost(instance_type, use_spot,
+                                          region, zone)
+
+    @classmethod
+    def accelerators_to_hourly_cost(cls, accelerators: Dict[str, int],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        (acc, count), = accelerators.items()
+        return do_catalog.get_accelerator_hourly_cost(
+            acc, count, use_spot, region, zone)
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        # Beyond the bundled transfer pool: $0.01/GiB.
+        return 0.01 * num_gigabytes
+
+    # ---- instance types --------------------------------------------------
+    @classmethod
+    def instance_type_exists(cls, instance_type: str) -> bool:
+        return do_catalog.instance_type_exists(instance_type)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return do_catalog.get_vcpus_mem_from_instance_type(
+            instance_type)
+
+    @classmethod
+    def get_default_instance_type(
+            cls, cpus: Optional[str] = None,
+            memory: Optional[str] = None,
+            disk_tier: Optional[str] = None) -> Optional[str]:
+        return do_catalog.get_default_instance_type(cpus, memory,
+                                                    disk_tier)
+
+    @classmethod
+    def get_accelerators_from_instance_type(
+            cls, instance_type: str) -> Optional[Dict[str, int]]:
+        return do_catalog.get_accelerators_from_instance_type(
+            instance_type)
+
+    # ---- feasibility -----------------------------------------------------
+    @classmethod
+    def _get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources',
+        num_nodes: int) -> cloud.FeasibleResources:
+        del num_nodes
+        if resources.tpu_slice is not None:
+            return cloud.FeasibleResources(
+                [], [], 'DigitalOcean offers no TPUs.')
+        if resources.use_spot:
+            return cloud.FeasibleResources(
+                [], [], 'DigitalOcean has no spot tier.')
+        if resources.accelerators is not None:
+            (acc, acc_count), = resources.accelerators.items()
+            instance_types = \
+                do_catalog.get_instance_type_for_accelerator(
+                    acc, acc_count)
+            if not instance_types:
+                fuzzy = [f'{name} (DigitalOcean)' for name in
+                         do_catalog.list_accelerators(acc[:4])]
+                return cloud.FeasibleResources([], fuzzy[:5], None)
+            return cloud.FeasibleResources(
+                [resources.copy(cloud=cls(), instance_type=it)
+                 for it in instance_types], [], None)
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = cls.get_default_instance_type(
+                resources.cpus, resources.memory, resources.disk_tier)
+        if instance_type is None:
+            return cloud.FeasibleResources(
+                [], [], 'No DigitalOcean size satisfies '
+                f'cpus={resources.cpus} memory={resources.memory}.')
+        return cloud.FeasibleResources(
+            [resources.copy(cloud=cls(), instance_type=instance_type)],
+            [], None)
+
+    # ---- deploy ----------------------------------------------------------
+    @classmethod
+    def make_deploy_resources_variables(
+            cls, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        del zones
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,
+            'labels': resources.labels or {},
+            'num_nodes': num_nodes,
+            'ports': resources.ports,
+        }
+
+    # ---- credentials -----------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.do import do_api
+        if do_api.load_token() is None:
+            return False, (
+                'No DigitalOcean token. Set DIGITALOCEAN_ACCESS_TOKEN '
+                'or run `doctl auth init` '
+                '(~/.config/doctl/config.yaml).')
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.do import do_api
+        token = do_api.load_token()
+        if token is None:
+            return None
+        return [[token[:12]]]
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        import os
+        path = os.path.expanduser('~/.config/doctl/config.yaml')
+        if os.path.exists(path):
+            return {'~/.config/doctl/config.yaml':
+                    '~/.config/doctl/config.yaml'}
+        return {}

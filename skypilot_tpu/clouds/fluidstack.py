@@ -1,0 +1,208 @@
+"""FluidStack: capability model + catalog glue.
+
+Counterpart of the reference's sky/clouds/fluidstack.py, following
+the repo's Lambda minor-cloud recipe.  Platform truths: GPU-only
+plans (`<GPU_TYPE>::<count>` grammar), no stop, no spot, fixed OS
+images, no per-cluster firewall, not suitable for hosting
+controllers (reference declares HOST_CONTROLLERS unsupported).
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.catalog import fluidstack_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@registry.CLOUD_REGISTRY.register()
+class Fluidstack(cloud.Cloud):
+    """FluidStack (flat-rate GPU instances)."""
+
+    _REPR = 'Fluidstack'
+    PROVISIONER_MODULE = 'fluidstack'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 57
+
+    @classmethod
+    def _unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        unsupported = {
+            cloud.CloudImplementationFeatures.STOP:
+                'FluidStack instances cannot be stopped, only '
+                'terminated.',
+            cloud.CloudImplementationFeatures.AUTOSTOP:
+                'no stop support; use autodown.',
+            cloud.CloudImplementationFeatures.SPOT_INSTANCE:
+                'FluidStack has no spot tier.',
+            cloud.CloudImplementationFeatures.IMAGE_ID:
+                'FluidStack boots its own OS images only.',
+            cloud.CloudImplementationFeatures.CUSTOM_DISK_TIER:
+                'fixed local NVMe.',
+            cloud.CloudImplementationFeatures.CLONE_DISK:
+                'not supported.',
+            cloud.CloudImplementationFeatures.OPEN_PORTS:
+                'no per-cluster firewall API.',
+            cloud.CloudImplementationFeatures.HOST_CONTROLLERS:
+                'controllers need a stable CPU tier; FluidStack is '
+                'GPU-only.',
+        }
+        if resources.tpu_slice is not None:
+            unsupported[cloud.CloudImplementationFeatures.MULTI_NODE] = (
+                'FluidStack offers no TPUs; use GCP/Kubernetes.')
+        return unsupported
+
+    # ---- regions ---------------------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del instance_type, accelerators
+        if use_spot or zone is not None:
+            return []
+        return [cloud.Region(r) for r in fluidstack_catalog.regions()
+                if region is None or r == region]
+
+    @classmethod
+    def zones_provision_loop(
+        cls, *, region: str, num_nodes: int, instance_type: str,
+        accelerators: Optional[Dict[str, int]] = None,
+        use_spot: bool = False,
+    ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del num_nodes, instance_type, accelerators, use_spot, region
+        yield None  # no zones; one attempt per region
+
+    # ---- pricing ---------------------------------------------------------
+    @classmethod
+    def instance_type_to_hourly_cost(cls, instance_type: str,
+                                     use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        return fluidstack_catalog.get_hourly_cost(
+            instance_type, use_spot, region, zone)
+
+    @classmethod
+    def accelerators_to_hourly_cost(cls, accelerators: Dict[str, int],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        (acc, count), = accelerators.items()
+        return fluidstack_catalog.get_accelerator_hourly_cost(
+            acc, count, use_spot, region, zone)
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        return 0.0  # FluidStack does not bill egress.
+
+    # ---- instance types --------------------------------------------------
+    @classmethod
+    def instance_type_exists(cls, instance_type: str) -> bool:
+        return fluidstack_catalog.instance_type_exists(instance_type)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return fluidstack_catalog.get_vcpus_mem_from_instance_type(
+            instance_type)
+
+    @classmethod
+    def get_default_instance_type(
+            cls, cpus: Optional[str] = None,
+            memory: Optional[str] = None,
+            disk_tier: Optional[str] = None) -> Optional[str]:
+        return fluidstack_catalog.get_default_instance_type(
+            cpus, memory, disk_tier)
+
+    @classmethod
+    def get_accelerators_from_instance_type(
+            cls, instance_type: str) -> Optional[Dict[str, int]]:
+        return fluidstack_catalog.get_accelerators_from_instance_type(
+            instance_type)
+
+    # ---- feasibility -----------------------------------------------------
+    @classmethod
+    def _get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources',
+        num_nodes: int) -> cloud.FeasibleResources:
+        del num_nodes
+        if resources.tpu_slice is not None:
+            return cloud.FeasibleResources(
+                [], [], 'FluidStack offers no TPUs.')
+        if resources.use_spot:
+            return cloud.FeasibleResources(
+                [], [], 'FluidStack has no spot tier.')
+        if resources.accelerators is not None:
+            (acc, acc_count), = resources.accelerators.items()
+            instance_types = \
+                fluidstack_catalog.get_instance_type_for_accelerator(
+                    acc, acc_count)
+            if not instance_types:
+                fuzzy = [f'{name} (FluidStack)' for name in
+                         fluidstack_catalog.list_accelerators(acc[:4])]
+                return cloud.FeasibleResources([], fuzzy[:5], None)
+            return cloud.FeasibleResources(
+                [resources.copy(cloud=cls(), instance_type=it)
+                 for it in instance_types], [], None)
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = cls.get_default_instance_type(
+                resources.cpus, resources.memory, resources.disk_tier)
+        if instance_type is None:
+            return cloud.FeasibleResources(
+                [], [], 'No FluidStack plan satisfies '
+                f'cpus={resources.cpus} memory={resources.memory}.')
+        return cloud.FeasibleResources(
+            [resources.copy(cloud=cls(), instance_type=instance_type)],
+            [], None)
+
+    # ---- deploy ----------------------------------------------------------
+    @classmethod
+    def make_deploy_resources_variables(
+            cls, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        del zones
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,
+            'disk_size': resources.disk_size,
+            'labels': resources.labels or {},
+            'num_nodes': num_nodes,
+            'ports': resources.ports,
+        }
+
+    # ---- credentials -----------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.fluidstack import fluidstack_api
+        if fluidstack_api.load_api_key() is None:
+            return False, (
+                'No FluidStack API key. Set FLUIDSTACK_API_KEY or '
+                'write the key to ~/.fluidstack/api_key.')
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.fluidstack import fluidstack_api
+        key = fluidstack_api.load_api_key()
+        if key is None:
+            return None
+        return [[key[:12]]]
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        import os
+        path = os.path.expanduser('~/.fluidstack/api_key')
+        if os.path.exists(path):
+            return {'~/.fluidstack/api_key': '~/.fluidstack/api_key'}
+        return {}

@@ -45,6 +45,31 @@ def make_download_command(source: str, target: str) -> str:
     if '.blob.core.windows.net' in source:
         return (f'{mkdir} && azcopy copy {quoted_source} '
                 f'{quoted_target} --recursive')
+    if source.startswith('cos://'):
+        from skypilot_tpu.data import storage as storage_lib
+        region, bucket = storage_lib.split_cos_url(source)
+        store = storage_lib.IBMCosStore(bucket, source)
+        endpoint = store.endpoint_url()
+        rest = source.split('://', 1)[1]
+        key = rest.split('/', 2)[2] if rest.count('/') >= 2 else ''
+        s3_src = shlex.quote(f's3://{bucket}/{key}' if key
+                             else f's3://{bucket}')
+        prefix = ('AWS_SHARED_CREDENTIALS_FILE='
+                  f'{storage_lib.IBMCosStore.CREDENTIALS_FILE} '
+                  f'aws --profile {storage_lib.IBMCosStore.PROFILE} '
+                  f'--endpoint-url {endpoint} ')
+        return (f'{mkdir} && {prefix}s3 cp --recursive {s3_src} '
+                f'{quoted_target} 2>/dev/null || {prefix}s3 cp '
+                f'{s3_src} {quoted_target}')
+    if source.startswith('oci://'):
+        rest = source[len('oci://'):]
+        bucket, _, key = rest.partition('/')
+        if key:
+            return (f'{mkdir} && oci os object get --bucket-name '
+                    f'{shlex.quote(bucket)} --name {shlex.quote(key)} '
+                    f'--file {quoted_target}')
+        return (f'{mkdir} && oci os object sync --bucket-name '
+                f'{shlex.quote(bucket)} --dest-dir {quoted_target}')
     if source.startswith(('http://', 'https://')):
         return (f'{mkdir} && (wget -q {quoted_source} -O {quoted_target} '
                 f'|| curl -fsSL {quoted_source} -o {quoted_target})')

@@ -89,6 +89,43 @@ def make_blobfuse2_mount_command(storage_account: str,
         f'--container-name {container_name} --use-adls=false')
 
 
+def make_rclone_install_command() -> str:
+    return ('command -v rclone >/dev/null 2>&1 || '
+            '(curl -fsSL https://rclone.org/install.sh | sudo bash)')
+
+
+def make_rclone_s3_mount_command(bucket_name: str, mount_path: str,
+                                 endpoint: str,
+                                 provider: str = 'Other',
+                                 credentials_file: str = '',
+                                 profile: str = '') -> str:
+    """Idempotent rclone FUSE mount of an S3-compatible bucket
+    (reference storage.py IBMCosStore mounts via rclone: the one FUSE
+    tool that speaks every S3 dialect incl. IBM COS and the OCI compat
+    endpoint).  Uses an on-the-fly `:s3:` remote, so no rclone.conf is
+    written on the cluster."""
+    env = (f'AWS_SHARED_CREDENTIALS_FILE={credentials_file} '
+           if credentials_file else '')
+    if profile:
+        env += f'AWS_PROFILE={profile} '
+    # Connection-string values containing ':' (the https endpoint)
+    # must be quoted INSIDE the remote string or rclone stops parsing
+    # at the first colon; the whole remote is single-quoted for the
+    # shell.
+    remote = (f':s3,provider={provider},env_auth=true,'
+              f'endpoint="{endpoint}":{bucket_name}')
+    mount = (f'{env}rclone mount \'{remote}\' {mount_path} '
+             f'--daemon --vfs-cache-mode writes --dir-cache-time 5s')
+    # --allow-other needs user_allow_other in /etc/fuse.conf (absent
+    # on stock images): try with it, fall back without — same pattern
+    # as make_blobfuse2_mount_command above.
+    return (
+        f'{make_rclone_install_command()}; '
+        f'mkdir -p {mount_path}; '
+        f'mountpoint -q {mount_path} || '
+        f'{mount} --allow-other 2>/dev/null || {mount}')
+
+
 def make_unmount_command(mount_path: str) -> str:
     return (f'mountpoint -q {mount_path} && '
             f'(fusermount -u {mount_path} || sudo umount {mount_path}) '

@@ -36,6 +36,8 @@ class StoreType(enum.Enum):
     S3 = 'S3'
     R2 = 'R2'
     AZURE = 'AZURE'
+    IBM = 'IBM'
+    OCI = 'OCI'
     LOCAL = 'LOCAL'
 
     @classmethod
@@ -48,9 +50,39 @@ class StoreType(enum.Enum):
             return cls.R2
         if url.startswith('az://') or '.blob.core.windows.net' in url:
             return cls.AZURE
+        if url.startswith('cos://'):
+            return cls.IBM
+        if url.startswith('oci://'):
+            return cls.OCI
         if url.startswith('local://') or url.startswith('/'):
             return cls.LOCAL
         raise exceptions.StorageSourceError(f'Unknown store URL: {url}')
+
+
+_COS_REGION_RE = re.compile(r'^[a-z]{2}-[a-z0-9]+$')
+
+
+def split_cos_url(url: str):
+    """'cos://<region>/<bucket>[/...]' -> (region, bucket); the
+    region-less 'cos://<bucket>' form is accepted too (region then
+    comes from env/config) — the reference's IBM URLs always carry the
+    region (sky/data/storage.py:3517).
+
+    A first component that does not LOOK like an IBM region
+    ('us-south', 'eu-de', ...) followed by more path is rejected
+    rather than guessed: silently treating a bucket as a region would
+    point at a non-existent endpoint host."""
+    rest = url.split('://', 1)[1]
+    parts = [p for p in rest.split('/') if p]
+    if len(parts) >= 2:
+        if not _COS_REGION_RE.fullmatch(parts[0]):
+            raise exceptions.StorageSourceError(
+                f'Ambiguous IBM COS URL {url!r}: the first path '
+                f'component {parts[0]!r} is not a region. Use '
+                f'cos://<region>/<bucket>[/key] (e.g. '
+                f'cos://us-south/{parts[0]}/...).')
+        return parts[0], parts[1]
+    return None, parts[0] if parts else ''
 
 
 class AbstractStore:
@@ -332,6 +364,206 @@ class AzureBlobStore(AbstractStore):
             self.storage_account(), self.name, mount_path)
 
 
+class IBMCosStore(S3Store):
+    """IBM Cloud Object Storage via the aws CLI against COS's
+    S3-compatible regional endpoint (reference storage.py:3517
+    IBMCosStore — it drives ibm-cos-sdk/boto3 with HMAC keys and
+    mounts with rclone; same control surface here, minus the SDK:
+    HMAC credentials live in an aws-CLI profile).
+
+    Credentials: AWS_SHARED_CREDENTIALS_FILE=~/.ibm/cos.credentials
+    with an `ibm` profile (HMAC access/secret keys from the COS
+    service credential).  Region: from the cos://<region>/<bucket>
+    URL, else IBM_COS_REGION / config ibm.cos_region.
+    """
+
+    CREDENTIALS_FILE = '~/.ibm/cos.credentials'
+    PROFILE = 'ibm'
+
+    def __init__(self, name: str, source: Optional[str]) -> None:
+        super().__init__(name, source)
+        self.region: Optional[str] = None
+        if source and source.startswith('cos://'):
+            self.region, bucket = split_cos_url(source)
+            if bucket:
+                self.name = bucket
+
+    def _region(self) -> str:
+        if self.region:
+            return self.region
+        from skypilot_tpu import config as config_lib
+        region = os.environ.get('IBM_COS_REGION') or \
+            config_lib.get_nested(('ibm', 'cos_region'), None)
+        if not region:
+            raise exceptions.StorageError(
+                'IBM COS needs a region: use cos://<region>/<bucket>, '
+                'set IBM_COS_REGION, or config ibm.cos_region.')
+        return region
+
+    def endpoint_url(self) -> str:
+        return (f'https://s3.{self._region()}.cloud-object-storage'
+                f'.appdomain.cloud')
+
+    def url(self) -> str:
+        return f'cos://{self._region()}/{self.name}'
+
+    def _s3_url(self) -> str:
+        return f's3://{self.name}'
+
+    def _run(self, args: List[str], check: bool = True
+             ) -> subprocess.CompletedProcess:
+        """exists/create/upload/delete are INHERITED from S3Store
+        (the R2Store pattern): this seam injects the COS endpoint +
+        profile and rewrites our cos://<region>/<bucket>[/key] URLs to
+        the s3://<bucket>[/key] the aws CLI speaks, key preserved."""
+        env = dict(os.environ)
+        env.setdefault('AWS_SHARED_CREDENTIALS_FILE',
+                       os.path.expanduser(self.CREDENTIALS_FILE))
+
+        def _rewrite(a):
+            if not (isinstance(a, str) and a.startswith('cos://')):
+                return a
+            rest = a.split('://', 1)[1]
+            parts = rest.split('/', 2)
+            bucket = parts[1] if len(parts) >= 2 else parts[0]
+            key = parts[2] if len(parts) >= 3 else ''
+            return f's3://{bucket}/{key}' if key else f's3://{bucket}'
+
+        args = [_rewrite(a) for a in args]
+        return subprocess.run(
+            ['aws', '--profile', self.PROFILE,
+             '--endpoint-url', self.endpoint_url()] + args,
+            capture_output=True, text=True, check=check, env=env)
+
+    def create(self) -> None:
+        proc = self._run(['s3', 'mb', self._s3_url()], check=False)
+        if proc.returncode != 0 and \
+                'BucketAlreadyOwnedByYou' not in proc.stderr and \
+                'BucketAlreadyExists' not in proc.stderr:
+            raise exceptions.StorageBucketCreateError(
+                f'Failed to create {self.url()}: {proc.stderr}')
+
+    def make_sync_dir_command(self, dst: str) -> str:
+        endpoint = self.endpoint_url()
+        return (f'mkdir -p {dst} && '
+                f'AWS_SHARED_CREDENTIALS_FILE={self.CREDENTIALS_FILE} '
+                f'aws --profile {self.PROFILE} '
+                f'--endpoint-url {endpoint} '
+                f's3 sync {self._s3_url()} {dst}')
+
+    def make_mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        return mounting_utils.make_rclone_s3_mount_command(
+            self.name, mount_path, endpoint=self.endpoint_url(),
+            provider='IBMCOS',
+            credentials_file=self.CREDENTIALS_FILE,
+            profile=self.PROFILE)
+
+
+class OciStore(AbstractStore):
+    """OCI Object Storage via the oci CLI (reference storage.py:3971
+    OciStore — it drives the oci SDK; the CLI exposes the same
+    surface: bucket get/create/delete, `oci os object sync` both
+    ways).  MOUNT rides rclone against OCI's S3-compatible endpoint
+    (needs the tenancy's object-storage namespace).
+
+    Config: OCI_NAMESPACE / config oci.namespace (for mounts),
+    OCI_COMPARTMENT_ID / config oci.compartment_id (for creates);
+    region resolves from the standard ~/.oci/config profile.
+    """
+
+    def url(self) -> str:
+        return f'oci://{self.name}'
+
+    def _run(self, args: List[str], check: bool = True
+             ) -> subprocess.CompletedProcess:
+        return subprocess.run(['oci'] + args, capture_output=True,
+                              text=True, check=check)
+
+    @staticmethod
+    def namespace() -> str:
+        from skypilot_tpu import config as config_lib
+        ns = os.environ.get('OCI_NAMESPACE') or config_lib.get_nested(
+            ('oci', 'namespace'), None)
+        if not ns:
+            raise exceptions.StorageError(
+                'OCI needs the object-storage namespace: set '
+                'OCI_NAMESPACE or config oci.namespace.')
+        return ns
+
+    @staticmethod
+    def _compartment() -> Optional[str]:
+        from skypilot_tpu import config as config_lib
+        return os.environ.get('OCI_COMPARTMENT_ID') or \
+            config_lib.get_nested(('oci', 'compartment_id'), None)
+
+    def exists(self) -> bool:
+        proc = self._run(['os', 'bucket', 'get', '--bucket-name',
+                          self.name], check=False)
+        return proc.returncode == 0
+
+    def create(self) -> None:
+        args = ['os', 'bucket', 'create', '--name', self.name]
+        compartment = self._compartment()
+        if compartment:
+            args += ['--compartment-id', compartment]
+        proc = self._run(args, check=False)
+        if proc.returncode != 0 and \
+                'BucketAlreadyExists' not in proc.stderr:
+            raise exceptions.StorageBucketCreateError(
+                f'Failed to create {self.url()}: {proc.stderr}')
+
+    def upload(self, sources: List[str]) -> None:
+        from skypilot_tpu.data import storage_utils
+        for source in sources:
+            src = os.path.expanduser(source)
+            if os.path.isdir(src):
+                args = ['os', 'object', 'sync', '--bucket-name',
+                        self.name, '--src-dir', src]
+                for pattern in storage_utils.read_excluded_patterns(
+                        src):
+                    args += ['--exclude', pattern]
+            else:
+                args = ['os', 'object', 'put', '--bucket-name',
+                        self.name, '--file', src, '--force']
+            proc = self._run(args, check=False)
+            if proc.returncode != 0:
+                raise exceptions.StorageError(
+                    f'Upload {src} -> {self.url()} failed: '
+                    f'{proc.stderr}')
+
+    def delete(self) -> None:
+        # Bucket delete requires empty: bulk-delete the objects first.
+        proc = self._run(['os', 'object', 'bulk-delete',
+                          '--bucket-name', self.name, '--force'],
+                         check=False)
+        if proc.returncode != 0 and \
+                'BucketNotFound' not in proc.stderr:
+            raise exceptions.StorageBucketDeleteError(
+                f'Failed to empty {self.url()}: {proc.stderr}')
+        proc = self._run(['os', 'bucket', 'delete', '--bucket-name',
+                          self.name, '--force'], check=False)
+        if proc.returncode != 0 and \
+                'BucketNotFound' not in proc.stderr:
+            raise exceptions.StorageBucketDeleteError(
+                f'Failed to delete {self.url()}: {proc.stderr}')
+
+    def make_sync_dir_command(self, dst: str) -> str:
+        return (f'mkdir -p {dst} && oci os object sync '
+                f'--bucket-name {self.name} --dest-dir {dst}')
+
+    def make_mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        from skypilot_tpu import config as config_lib
+        region = os.environ.get('OCI_REGION') or config_lib.get_nested(
+            ('oci', 'region'), 'us-ashburn-1')
+        endpoint = (f'https://{self.namespace()}.compat.objectstorage.'
+                    f'{region}.oraclecloud.com')
+        return mounting_utils.make_rclone_s3_mount_command(
+            self.name, mount_path, endpoint=endpoint,
+            provider='Other')
+
+
 class LocalStore(AbstractStore):
     """Directory-backed store for tests/local clusters."""
 
@@ -380,6 +612,8 @@ _STORE_CLASSES = {
     StoreType.S3: S3Store,
     StoreType.R2: R2Store,
     StoreType.AZURE: AzureBlobStore,
+    StoreType.IBM: IBMCosStore,
+    StoreType.OCI: OciStore,
     StoreType.LOCAL: LocalStore,
 }
 
@@ -417,8 +651,16 @@ class Storage:
                         f'Azure blob URL {self.source!r} has no '
                         'container name.')
                 self.name = container
+            elif self.source.startswith('cos://'):
+                # cos://<region>/<bucket>: the bucket is the SECOND
+                # component (the reference's IBM URL grammar).
+                _, bucket = split_cos_url(self.source)
+                if not bucket:
+                    raise exceptions.StorageSourceError(
+                        f'IBM COS URL {self.source!r} has no bucket.')
+                self.name = bucket
             elif self.source.startswith(('gs://', 's3://', 'gcs://',
-                                         'r2://', 'az://')):
+                                         'r2://', 'az://', 'oci://')):
                 self.name = self.source.split('://', 1)[1].split('/')[0]
             else:
                 self.name = os.path.basename(

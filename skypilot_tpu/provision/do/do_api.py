@@ -1,0 +1,142 @@
+"""Minimal DigitalOcean REST v2 client (JSON over urllib).
+
+Counterpart of the reference's sky/provision/do/utils.py (which uses
+the pydo SDK); SDK-free, in the mold of the repo's other first-party
+REST clients.  Everything routes through `request`, the single test
+seam.
+
+Auth: Bearer token from env DIGITALOCEAN_ACCESS_TOKEN, then doctl's
+config (~/.config/doctl/config.yaml, key `access-token`).  Droplets
+are tagged `skytpu-<cluster>` at create; all cluster queries filter
+by tag (the reference matches by name prefix instead — tags survive
+renames and need no escaping).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_ROOT = 'https://api.digitalocean.com/v2'
+_TIMEOUT = 60.0
+_DOCTL_CONFIG = '~/.config/doctl/config.yaml'
+
+
+class DoApiError(exceptions.ProvisionError):
+
+    def __init__(self, status_code: int, code: str, message: str) -> None:
+        no_failover = status_code in (401, 403)
+        super().__init__(
+            f'DigitalOcean API error {status_code} {code}: {message}',
+            no_failover=no_failover)
+        self.status_code = status_code
+        self.code = code
+
+
+def load_token() -> Optional[str]:
+    token = os.environ.get('DIGITALOCEAN_ACCESS_TOKEN')
+    if token:
+        return token
+    path = os.path.expanduser(
+        os.environ.get('DOCTL_CONFIG_FILE', _DOCTL_CONFIG))
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                m = re.match(r'\s*access-token\s*:\s*(\S+)',
+                             line.rstrip())
+                if m:
+                    return m.group(1).strip('\'"')
+    except OSError:
+        return None
+    return None
+
+
+def request(method: str, path: str,
+            body: Optional[Dict[str, Any]] = None,
+            params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    token = load_token()
+    if token is None:
+        raise DoApiError(401, 'NoCredentials',
+                         'no DigitalOcean token found')
+    url = f'{API_ROOT}{path}'
+    if params:
+        url += '?' + urllib.parse.urlencode(params)
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={'Authorization': f'Bearer {token}',
+                 'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
+            text = resp.read()
+            return json.loads(text) if text.strip() else {}
+    except urllib.error.HTTPError as e:
+        text = e.read().decode(errors='replace')
+        try:
+            err = json.loads(text)
+            raise DoApiError(e.code, str(err.get('id', 'unknown')),
+                             str(err.get('message', text[:200]))) \
+                from None
+        except (json.JSONDecodeError, AttributeError):
+            raise DoApiError(e.code, 'unknown', text[:200]) from None
+    except urllib.error.URLError as e:
+        raise DoApiError(0, 'Unreachable', str(e)) from None
+
+
+def list_droplets(tag_name: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    page = 1
+    while True:
+        resp = request('GET', '/droplets',
+                       params={'tag_name': tag_name, 'page': str(page),
+                               'per_page': '200'})
+        droplets = resp.get('droplets', [])
+        out.extend(droplets)
+        if not resp.get('links', {}).get('pages', {}).get('next'):
+            break
+        page += 1
+    return out
+
+
+def create_droplets(names: List[str], region: str, size: str,
+                    image: str, tags: List[str],
+                    user_data: Optional[str] = None
+                    ) -> List[Dict[str, Any]]:
+    """POST /droplets with the multi-create `names` form."""
+    body: Dict[str, Any] = {
+        'names': names,
+        'region': region,
+        'size': size,
+        'image': image,
+        'tags': tags,
+    }
+    if user_data:
+        body['user_data'] = user_data
+    resp = request('POST', '/droplets', body=body)
+    return list(resp.get('droplets', []))
+
+
+def get_droplet(droplet_id: str) -> Dict[str, Any]:
+    return request('GET', f'/droplets/{droplet_id}').get('droplet', {})
+
+
+def delete_droplet(droplet_id: str) -> None:
+    try:
+        request('DELETE', f'/droplets/{droplet_id}')
+    except DoApiError as e:
+        if e.status_code != 404:
+            raise
+
+
+def droplet_action(droplet_id: str, action_type: str) -> None:
+    """power_off / power_on / shutdown."""
+    request('POST', f'/droplets/{droplet_id}/actions',
+            body={'type': action_type})

@@ -1,0 +1,224 @@
+"""DigitalOcean provisioner: the uniform provision interface.
+
+Counterpart of the reference's sky/provision/do/instance.py (pydo).
+DO semantics: droplets are real VMs with stop/resume (power_off keeps
+billing the disk, like GCP's deallocate-adjacent model — the
+reference supports STOP and so do we), tagged `skytpu-<cluster>`,
+SSH key injected via cloud-init user_data (no account-level key
+registration needed), head elected by lowest droplet id.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.do import do_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'do'
+_CLUSTER_TAG_PREFIX = 'skytpu-'
+_DEFAULT_IMAGE = 'ubuntu-22-04-x64'
+_GPU_IMAGE = 'gpu-h100x1-base'  # DO AI/ML image for GPU droplets
+
+_CAPACITY_SUBSTRINGS = ('exceed', 'limit', 'unavailable', 'capacity')
+
+
+def _classify(e: do_api.DoApiError) -> Exception:
+    if e.status_code == 422 and any(
+            s in str(e).lower() for s in _CAPACITY_SUBSTRINGS):
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _tag(cluster_name_on_cloud: str) -> str:
+    return f'{_CLUSTER_TAG_PREFIX}{cluster_name_on_cloud}'
+
+
+def _cluster_droplets(cluster_name_on_cloud: str
+                      ) -> List[Dict[str, Any]]:
+    return sorted(do_api.list_droplets(_tag(cluster_name_on_cloud)),
+                  key=lambda d: int(d.get('id', 0)))
+
+
+def _ssh_key_user_data(auth_config: Dict[str, Any]) -> Optional[str]:
+    ssh_keys = (auth_config or {}).get('ssh_keys', '')
+    if ':' not in ssh_keys:
+        return None
+    pub = ssh_keys.split(':', 1)[1]
+    return ('#!/bin/bash\n'
+            'mkdir -p /root/.ssh\n'
+            f'echo {pub!r} >> /root/.ssh/authorized_keys\n'
+            'chmod 700 /root/.ssh\n'
+            'chmod 600 /root/.ssh/authorized_keys\n')
+
+
+def _status(droplet: Dict[str, Any]) -> str:
+    return str(droplet.get('status', 'unknown'))
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    size = node_cfg['instance_type']
+    image = node_cfg.get('image_id') or (
+        _GPU_IMAGE if size.startswith('gpu-') else _DEFAULT_IMAGE)
+    try:
+        existing = _cluster_droplets(cluster_name_on_cloud)
+        by_status: Dict[str, List[Dict[str, Any]]] = {}
+        for d in existing:
+            by_status.setdefault(_status(d), []).append(d)
+        running = by_status.get('active', []) + by_status.get('new', [])
+        stopped = by_status.get('off', [])
+
+        resumed: List[str] = []
+        if config.resume_stopped_nodes and stopped:
+            need = config.count - len(running)
+            for d in sorted(stopped, key=lambda d: int(d['id']))[
+                    :max(need, 0)]:
+                do_api.droplet_action(str(d['id']), 'power_on')
+                resumed.append(str(d['id']))
+            running += [d for d in stopped
+                        if str(d['id']) in resumed]
+
+        created: List[str] = []
+        to_create = config.count - len(running)
+        if to_create > 0:
+            base = len(existing)
+            names = [f'{cluster_name_on_cloud}-{base + i:04d}'
+                     for i in range(to_create)]
+            droplets = do_api.create_droplets(
+                names, region, size, image,
+                tags=[_tag(cluster_name_on_cloud)],
+                user_data=_ssh_key_user_data(
+                    config.authentication_config))
+            created = [str(d['id']) for d in droplets]
+    except do_api.DoApiError as e:
+        raise _classify(e) from None
+    ids = sorted([str(d['id']) for d in running] + created, key=int)
+    if not ids:
+        raise exceptions.ResourcesUnavailableError(
+            f'DigitalOcean returned no droplets for '
+            f'{cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER,
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone=None,
+        head_instance_id=ids[0],
+        resumed_instance_ids=resumed,
+        created_instance_ids=created,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    droplets = [d for d in _cluster_droplets(cluster_name_on_cloud)
+                if _status(d) in ('active', 'new')]
+    ids = sorted((str(d['id']) for d in droplets), key=int)
+    if worker_only and ids:
+        ids = ids[1:]  # head is the lowest id
+    for did in ids:
+        do_api.droplet_action(did, 'power_off')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    ids = sorted((str(d['id'])
+                  for d in _cluster_droplets(cluster_name_on_cloud)),
+                 key=int)
+    if worker_only and ids:
+        ids = ids[1:]
+    for did in ids:
+        do_api.delete_droplet(did)
+
+
+_STATUS_MAP = {
+    'new': 'pending',
+    'active': 'running',
+    'off': 'stopped',
+    'archive': 'terminated',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for d in _cluster_droplets(cluster_name_on_cloud):
+        status = _STATUS_MAP.get(_status(d))
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[str(d['id'])] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 600.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud, None,
+                                   non_terminated_only=False)
+        live = [s for s in statuses.values() if s != 'terminated']
+        if live and all(s == state for s in live):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: droplets did not reach '
+        f'{state!r} within {timeout}s.')
+
+
+def _ips(droplet: Dict[str, Any]):
+    """(private_ip, public_ip) from the droplet's v4 network list."""
+    private = public = None
+    for net in (droplet.get('networks') or {}).get('v4', []):
+        if net.get('type') == 'public' and public is None:
+            public = str(net.get('ip_address'))
+        if net.get('type') == 'private' and private is None:
+            private = str(net.get('ip_address'))
+    return private, public
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for d in _cluster_droplets(cluster_name_on_cloud):
+        if _status(d) != 'active':
+            continue
+        private, public = _ips(d)
+        did = str(d['id'])
+        instances[did] = [common.InstanceInfo(
+            instance_id=did,
+            internal_ip=private or public or '',
+            external_ip=public,
+            tags={'name': str(d.get('name'))},
+        )]
+    head = sorted(instances, key=int)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head,
+        provider_name=_PROVIDER,
+        provider_config=provider_config,
+        ssh_user='root',
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # Droplets ship with no cloud firewall attached: every port is
+    # already reachable.  (DO Cloud Firewalls are opt-in resources the
+    # user may attach; the framework does not manage them.)
+    logger.info('DigitalOcean droplets have no default firewall; '
+                'ports %s are already reachable.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

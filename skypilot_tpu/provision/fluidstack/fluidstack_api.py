@@ -1,0 +1,114 @@
+"""Minimal FluidStack REST client (JSON over urllib).
+
+Counterpart of the reference's
+sky/provision/fluidstack/fluidstack_utils.py (requests-based).
+API: https://platform.fluidstack.io/ with an `api-key` header; key
+from env FLUIDSTACK_API_KEY, then ~/.fluidstack/api_key (the
+reference's path).  All calls route through `request`, the single
+test seam.
+"""
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_ROOT = 'https://platform.fluidstack.io'
+_TIMEOUT = 60.0
+_KEY_FILE = '~/.fluidstack/api_key'
+
+
+class FluidstackApiError(exceptions.ProvisionError):
+
+    def __init__(self, status_code: int, code: str, message: str) -> None:
+        no_failover = status_code in (401, 403)
+        super().__init__(
+            f'FluidStack API error {status_code} {code}: {message}',
+            no_failover=no_failover)
+        self.status_code = status_code
+        self.code = code
+
+
+def load_api_key() -> Optional[str]:
+    key = os.environ.get('FLUIDSTACK_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(
+        os.environ.get('FLUIDSTACK_KEY_FILE', _KEY_FILE))
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            content = f.read().strip()
+        return content or None
+    except OSError:
+        return None
+
+
+def request(method: str, path: str,
+            body: Optional[Dict[str, Any]] = None) -> Any:
+    key = load_api_key()
+    if key is None:
+        raise FluidstackApiError(401, 'NoCredentials',
+                                 'no FluidStack API key found')
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f'{API_ROOT}{path}', data=data, method=method,
+        headers={'api-key': key, 'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
+            text = resp.read()
+            return json.loads(text) if text.strip() else {}
+    except urllib.error.HTTPError as e:
+        text = e.read().decode(errors='replace')
+        try:
+            err = json.loads(text)
+            msg = str(err.get('message', err.get('error', text[:200])))
+        except json.JSONDecodeError:
+            msg = text[:200]
+        code = 'out-of-stock' if 'stock' in msg.lower() else 'unknown'
+        raise FluidstackApiError(e.code, code, msg) from None
+    except urllib.error.URLError as e:
+        raise FluidstackApiError(0, 'Unreachable', str(e)) from None
+
+
+def list_instances() -> List[Dict[str, Any]]:
+    return list(request('GET', '/instances') or [])
+
+
+def create_instance(gpu_type: str, gpu_count: int, region: str,
+                    name: str, ssh_key_name: str) -> str:
+    resp = request('POST', '/instances', body={
+        'gpu_type': gpu_type,
+        'gpu_count': gpu_count,
+        'region': region,
+        'operating_system_label': 'ubuntu_22_04_lts_nvidia',
+        'name': name,
+        'ssh_key': ssh_key_name,
+    })
+    instance_id = (resp or {}).get('id')
+    if not instance_id:
+        raise FluidstackApiError(200, 'out-of-stock',
+                                 f'no instance created for {name}')
+    return str(instance_id)
+
+
+def delete_instance(instance_id: str) -> None:
+    try:
+        request('DELETE', f'/instances/{instance_id}')
+    except FluidstackApiError as e:
+        if e.status_code != 404:
+            raise
+
+
+def list_ssh_keys() -> List[Dict[str, Any]]:
+    return list(request('GET', '/ssh_keys') or [])
+
+
+def add_ssh_key(name: str, public_key: str) -> None:
+    request('POST', '/ssh_keys',
+            body={'name': name, 'public_key': public_key})

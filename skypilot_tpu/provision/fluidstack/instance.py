@@ -1,0 +1,196 @@
+"""FluidStack provisioner: the uniform provision interface.
+
+Counterpart of the reference's sky/provision/fluidstack/instance.py.
+FluidStack semantics: instances launch one at a time by (gpu_type,
+gpu_count, region) — the instance_type grammar is
+`<GPU_TYPE>::<count>` as in the reference's catalog — carry a NAME
+(our cluster tag), no stop support (the API has a /stop endpoint but
+billing continues; the reference declares STOP unsupported and so do
+we), platform-registered SSH keys.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.fluidstack import fluidstack_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'fluidstack'
+_KEY_NAME = 'skytpu-key'
+
+
+def parse_instance_type(instance_type: str):
+    """'H100_PCIE_80GB::2' -> ('H100_PCIE_80GB', 2)."""
+    gpu_type, sep, count = instance_type.partition('::')
+    if not sep:
+        raise exceptions.ProvisionError(
+            f'bad FluidStack instance type {instance_type!r} '
+            f'(want <GPU_TYPE>::<count>)')
+    return gpu_type, int(count)
+
+
+def _classify(e: fluidstack_api.FluidstackApiError) -> Exception:
+    if e.code == 'out-of-stock':
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _cluster_instances(cluster_name_on_cloud: str
+                       ) -> List[Dict[str, Any]]:
+    return sorted(
+        (i for i in fluidstack_api.list_instances()
+         if i.get('name') == cluster_name_on_cloud),
+        key=lambda i: str(i.get('id')))
+
+
+def _ensure_ssh_key(auth_config: Dict[str, Any]) -> str:
+    ssh_keys = (auth_config or {}).get('ssh_keys', '')
+    if ':' not in ssh_keys:
+        keys = fluidstack_api.list_ssh_keys()
+        if not keys:
+            raise exceptions.ProvisionError(
+                'FluidStack requires an SSH key: none in the launch '
+                'auth config and none registered with the account.')
+        return str(keys[0]['name'])
+    pub = ssh_keys.split(':', 1)[1]
+    for key in fluidstack_api.list_ssh_keys():
+        if str(key.get('public_key', '')).strip() == pub.strip():
+            return str(key['name'])
+    name = f'{_KEY_NAME}-{abs(hash(pub)) % 10**8}'
+    fluidstack_api.add_ssh_key(name, pub)
+    return name
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    try:
+        existing = _cluster_instances(cluster_name_on_cloud)
+        live = [i for i in existing
+                if str(i.get('status')) in
+                ('running', 'pending', 'provisioning')]
+        to_create = config.count - len(live)
+        created: List[str] = []
+        if to_create > 0:
+            gpu_type, gpu_count = parse_instance_type(
+                node_cfg['instance_type'])
+            key_name = _ensure_ssh_key(config.authentication_config)
+            for _ in range(to_create):
+                created.append(fluidstack_api.create_instance(
+                    gpu_type, gpu_count, region,
+                    cluster_name_on_cloud, key_name))
+    except fluidstack_api.FluidstackApiError as e:
+        raise _classify(e) from None
+    ids = sorted([str(i['id']) for i in live] + created)
+    if not ids:
+        raise exceptions.ResourcesUnavailableError(
+            f'FluidStack returned no instances for '
+            f'{cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER,
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone=None,
+        head_instance_id=ids[0],
+        resumed_instance_ids=[],
+        created_instance_ids=created,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    raise exceptions.NotSupportedError(
+        'FluidStack instances cannot be stopped; use `sky down` '
+        '(terminate).')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    ids = sorted(
+        str(i['id'])
+        for i in _cluster_instances(cluster_name_on_cloud)
+        if str(i.get('status')) not in ('terminated', 'terminating'))
+    if worker_only and ids:
+        ids = ids[1:]
+    for iid in ids:
+        fluidstack_api.delete_instance(iid)
+
+
+_STATUS_MAP = {
+    'provisioning': 'pending',
+    'pending': 'pending',
+    'running': 'running',
+    'stopped': 'stopped',
+    'terminating': 'terminated',
+    'terminated': 'terminated',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for inst in _cluster_instances(cluster_name_on_cloud):
+        status = _STATUS_MAP.get(str(inst.get('status')))
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[str(inst['id'])] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 900.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud, None,
+                                   non_terminated_only=False)
+        live = [s for s in statuses.values() if s != 'terminated']
+        if live and all(s == state for s in live):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: instances did not reach '
+        f'{state!r} within {timeout}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for inst in _cluster_instances(cluster_name_on_cloud):
+        if str(inst.get('status')) != 'running':
+            continue
+        iid = str(inst['id'])
+        instances[iid] = [common.InstanceInfo(
+            instance_id=iid,
+            internal_ip=str(inst.get('private_ip') or ''),
+            external_ip=inst.get('ip_address') or inst.get('ip'),
+            tags={'name': str(inst.get('name'))},
+        )]
+    head = sorted(instances)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head,
+        provider_name=_PROVIDER,
+        provider_config=provider_config,
+        ssh_user='ubuntu',
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.warning('FluidStack has no per-cluster firewall API; '
+                   'ensure %s are reachable.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

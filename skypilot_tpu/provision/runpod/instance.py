@@ -1,0 +1,247 @@
+"""RunPod provisioner: the uniform provision interface over the
+GraphQL client.
+
+Counterpart of the reference's sky/provision/runpod/instance.py.
+RunPod semantics: pods are containers named by us (cluster tag in the
+name), cannot stop (terminate only), and expose SSH through a public
+TCP port mapped onto container port 22 — get_cluster_info must
+surface the MAPPED port and the pod's public IP.  Single-node only
+(no inter-pod network fabric).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.runpod import runpod_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'runpod'
+_DEFAULT_IMAGE = 'runpod/base:0.0.2'
+
+# instance_type grammar (reference catalog rows keep the same shape):
+#   <count>x_<GPU-NAME>_<CLOUDTYPE>   e.g. 1x_A100-80GB_SECURE
+_GPU_NAME_TO_ID = {
+    'A100-80GB': 'NVIDIA A100 80GB PCIe',
+    'A100-80GB-SXM': 'NVIDIA A100-SXM4-80GB',
+    'A40': 'NVIDIA A40',
+    'L40S': 'NVIDIA L40S',
+    'RTX4090': 'NVIDIA GeForce RTX 4090',
+    'H100': 'NVIDIA H100 PCIe',
+    'H100-SXM': 'NVIDIA H100 80GB HBM3',
+}
+
+
+def parse_instance_type(instance_type: str):
+    """'2x_H100_SECURE' -> (gpu_type_id, 2)."""
+    parts = instance_type.split('_')
+    if len(parts) < 2 or not parts[0].endswith('x'):
+        raise exceptions.ProvisionError(
+            f'bad RunPod instance type {instance_type!r} '
+            f'(want <n>x_<GPU>_<CLOUDTYPE>)')
+    count = int(parts[0][:-1])
+    gpu = parts[1]
+    gpu_id = _GPU_NAME_TO_ID.get(gpu, gpu)
+    return gpu_id, count
+
+
+def _classify(e: runpod_api.RunPodApiError) -> Exception:
+    if 'capacity' in e.code or 'capacity' in str(e).lower():
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _cluster_pods(cluster_name_on_cloud: str) -> List[Dict[str, Any]]:
+    return sorted(
+        (p for p in runpod_api.list_pods()
+         if p.get('name') == cluster_name_on_cloud),
+        key=lambda p: str(p.get('id')))
+
+
+def _public_key(auth_config: Dict[str, Any]) -> str:
+    ssh_keys = (auth_config or {}).get('ssh_keys', '')
+    if ':' not in ssh_keys:
+        raise exceptions.ProvisionError(
+            'RunPod pods bootstrap sshd with the framework key; the '
+            'launch auth config carries none.')
+    return ssh_keys.split(':', 1)[1]
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    try:
+        existing = _cluster_pods(cluster_name_on_cloud)
+        live = [p for p in existing
+                if p.get('desiredStatus') in ('RUNNING', 'CREATED')]
+        to_create = config.count - len(live)
+        created: List[str] = []
+        if to_create > 0:
+            gpu_id, gpu_count = parse_instance_type(
+                node_cfg['instance_type'])
+            pub = _public_key(config.authentication_config)
+            ports = [str(p) for p in (node_cfg.get('ports') or [])]
+            use_spot = bool(node_cfg.get('use_spot'))
+            bid_per_gpu = node_cfg.get('bid_per_gpu')
+            if use_spot and not bid_per_gpu:
+                # A zero bid never wins interruptible capacity; the
+                # catalog spot price per GPU is the floor bid.
+                from skypilot_tpu.catalog import runpod_catalog
+                bid_per_gpu = round(
+                    runpod_catalog.get_hourly_cost(
+                        node_cfg['instance_type'], use_spot=True)
+                    / max(gpu_count, 1), 4)
+            for _ in range(to_create):
+                created.append(runpod_api.create_pod(
+                    name=cluster_name_on_cloud,
+                    gpu_type_id=gpu_id,
+                    gpu_count=gpu_count,
+                    region=region or None,
+                    disk_size_gb=int(node_cfg.get('disk_size') or 64),
+                    image_name=node_cfg.get('image_id')
+                    or _DEFAULT_IMAGE,
+                    public_key=pub,
+                    ports=ports,
+                    interruptible=use_spot,
+                    bid_per_gpu=bid_per_gpu,
+                ))
+    except runpod_api.RunPodApiError as e:
+        raise _classify(e) from None
+    ids = sorted([str(p['id']) for p in live] + created)
+    if not ids:
+        raise exceptions.ResourcesUnavailableError(
+            f'RunPod returned no pods for {cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER,
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone=None,
+        head_instance_id=ids[0],
+        resumed_instance_ids=[],
+        created_instance_ids=created,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    raise exceptions.NotSupportedError(
+        'RunPod pods cannot be stopped; use `sky down` (terminate).')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    pods = [p for p in _cluster_pods(cluster_name_on_cloud)
+            if p.get('desiredStatus') != 'TERMINATED']
+    ids = sorted(str(p['id']) for p in pods)
+    if worker_only and ids:
+        ids = ids[1:]
+    for pod_id in ids:
+        runpod_api.terminate_pod(pod_id)
+
+
+_STATUS_MAP = {
+    'CREATED': 'pending',
+    'RUNNING': 'running',
+    'RESTARTING': 'pending',
+    'PAUSED': 'stopped',
+    'EXITED': 'stopped',
+    'TERMINATED': 'terminated',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for pod in _cluster_pods(cluster_name_on_cloud):
+        status = _STATUS_MAP.get(str(pod.get('desiredStatus')))
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[str(pod['id'])] = status
+    return out
+
+
+def _ssh_endpoint(pod: Dict[str, Any]):
+    """(public_ip, mapped_port) of container port 22, or None while
+    the runtime/port mapping is still materializing."""
+    runtime = pod.get('runtime') or {}
+    for port in runtime.get('ports') or []:
+        if port.get('isIpPublic') and \
+                int(port.get('privatePort') or 0) == 22:
+            return str(port.get('ip')), int(port.get('publicPort'))
+    return None
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 900.0) -> None:
+    """Pods report RUNNING before sshd's port mapping exists — wait for
+    the SSH endpoint too, or the backend's first connect bounces."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods = [p for p in _cluster_pods(cluster_name_on_cloud)
+                if _STATUS_MAP.get(str(p.get('desiredStatus')))
+                != 'terminated']
+        if pods:
+            if state != 'running':
+                statuses = [_STATUS_MAP.get(str(p.get('desiredStatus')))
+                            for p in pods]
+                if all(s == state for s in statuses):
+                    return
+            elif all(p.get('desiredStatus') == 'RUNNING'
+                     and _ssh_endpoint(p) for p in pods):
+                return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: pods did not reach {state!r} (with '
+        f'SSH endpoints) within {timeout}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for pod in _cluster_pods(cluster_name_on_cloud):
+        if pod.get('desiredStatus') != 'RUNNING':
+            continue
+        endpoint = _ssh_endpoint(pod)
+        if endpoint is None:
+            continue
+        ip, port = endpoint
+        iid = str(pod['id'])
+        instances[iid] = [common.InstanceInfo(
+            instance_id=iid,
+            internal_ip=ip,   # pods see no private fabric; SSH IP only
+            external_ip=ip,
+            tags={'name': str(pod.get('name'))},
+            ssh_port=port,
+        )]
+    head = sorted(instances)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head,
+        provider_name=_PROVIDER,
+        provider_config=provider_config,
+        ssh_user='root',
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    # Ports must be declared at pod creation (launch-only port model,
+    # reference OPEN_PORTS_VERSION=LAUNCH_ONLY); run_instances already
+    # passes node_config['ports'].
+    logger.warning(
+        'RunPod exposes ports only at pod creation; %s were requested '
+        'post-launch and cannot be opened on live pods.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config  # die with the pod

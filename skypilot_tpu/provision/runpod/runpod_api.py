@@ -1,0 +1,173 @@
+"""Minimal RunPod GraphQL client (JSON over urllib).
+
+Counterpart of the reference's sky/provision/runpod/utils.py (which
+drives the same control plane through the `runpod` SDK's
+run_graphql_query); this is the SDK-free equivalent in the mold of
+the repo's other first-party REST clients.  Everything routes through
+`_call`, the single test seam.
+
+API: POST https://api.runpod.io/graphql with the key as a query
+param; pods are containers — SSH rides a public TCP port mapping of
+container port 22, so get_cluster_info must surface the mapped port,
+not 22.  Key sources: env RUNPOD_API_KEY, then ~/.runpod/config.toml
+(`apikey = "<key>"` — what `runpod config` writes).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import re
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_URL = 'https://api.runpod.io/graphql'
+_TIMEOUT = 60.0
+_CONFIG_FILE = '~/.runpod/config.toml'
+
+
+class RunPodApiError(exceptions.ProvisionError):
+
+    def __init__(self, status_code: int, code: str, message: str) -> None:
+        no_failover = status_code in (401, 403)
+        super().__init__(
+            f'RunPod API error {status_code} {code}: {message}',
+            no_failover=no_failover)
+        self.status_code = status_code
+        self.code = code
+
+
+def load_api_key() -> Optional[str]:
+    key = os.environ.get('RUNPOD_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(
+        os.environ.get('RUNPOD_CONFIG_FILE', _CONFIG_FILE))
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                m = re.match(r'\s*api_?key\s*=\s*"?([^"\s]+)"?',
+                             line.strip(), re.IGNORECASE)
+                if m:
+                    return m.group(1)
+    except OSError:
+        return None
+    return None
+
+
+def _call(query: str) -> Dict[str, Any]:
+    """One GraphQL request; raises RunPodApiError on transport or
+    GraphQL-level errors (RunPod returns 200 with an `errors` list)."""
+    key = load_api_key()
+    if key is None:
+        raise RunPodApiError(401, 'NoCredentials',
+                             'no RunPod API key found')
+    req = urllib.request.Request(
+        f'{API_URL}?api_key={key}',
+        data=json.dumps({'query': query}).encode(),
+        method='POST',
+        headers={'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
+            payload = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        text = e.read().decode(errors='replace')
+        raise RunPodApiError(e.code, 'http', text[:200]) from None
+    except urllib.error.URLError as e:
+        raise RunPodApiError(0, 'Unreachable', str(e)) from None
+    errors = payload.get('errors')
+    if errors:
+        msg = '; '.join(str(e.get('message', e)) for e in errors)
+        code = 'graphql'
+        if 'no longer any instances available' in msg.lower() or \
+                'not enough' in msg.lower():
+            code = 'insufficient-capacity'
+        raise RunPodApiError(200, code, msg[:300])
+    return payload.get('data', {})
+
+
+def _gql_str(s: str) -> str:
+    return json.dumps(str(s))
+
+
+def list_pods() -> List[Dict[str, Any]]:
+    data = _call("""
+        query Pods { myself { pods {
+            id name desiredStatus costPerHr
+            machine { gpuDisplayName }
+            runtime { ports {
+                ip isIpPublic privatePort publicPort type } }
+        } } }""")
+    return list((data.get('myself') or {}).get('pods') or [])
+
+
+def _ssh_bootstrap_docker_args(public_key: str) -> str:
+    """Pods are containers: the base image has no sshd, so the
+    container entrypoint installs one, trusts the framework key, and
+    idles.  base64 round-trip dodges the API's quoting pitfalls (the
+    reference does the same, sky/provision/runpod/utils.py:280)."""
+    script = (
+        'apt-get update && '
+        'DEBIAN_FRONTEND=noninteractive apt-get install -y '
+        'openssh-server rsync curl && '
+        'mkdir -p /var/run/sshd ~/.ssh && chmod 700 ~/.ssh && '
+        f'echo "{public_key}" >> ~/.ssh/authorized_keys && '
+        'chmod 644 ~/.ssh/authorized_keys && '
+        'sed -i "s/PermitRootLogin prohibit-password/PermitRootLogin '
+        'yes/" /etc/ssh/sshd_config && '
+        'cd /etc/ssh && ssh-keygen -A && service ssh start && '
+        'sleep infinity')
+    encoded = base64.b64encode(script.encode()).decode()
+    return (f"bash -c 'echo {encoded} | base64 --decode > /init.sh; "
+            f"bash /init.sh'")
+
+
+def create_pod(name: str, gpu_type_id: str, gpu_count: int,
+               region: Optional[str], disk_size_gb: int,
+               image_name: str, public_key: str,
+               ports: Optional[List[str]] = None,
+               interruptible: bool = False,
+               bid_per_gpu: Optional[float] = None) -> str:
+    """Deploy one pod; returns its id.  `interruptible` uses RunPod's
+    spot market (podRentInterruptable) at `bid_per_gpu`."""
+    port_specs = ['22/tcp'] + [f'{p}/tcp' for p in (ports or [])]
+    fields = [
+        f'name: {_gql_str(name)}',
+        f'imageName: {_gql_str(image_name)}',
+        f'gpuTypeId: {_gql_str(gpu_type_id)}',
+        f'gpuCount: {gpu_count}',
+        f'containerDiskInGb: {disk_size_gb}',
+        f'volumeInGb: 0',
+        f'minVcpuCount: {4 * gpu_count}',
+        f'minMemoryInGb: {8 * gpu_count}',
+        f'ports: {_gql_str(",".join(port_specs))}',
+        'supportPublicIp: true',
+        f'dockerArgs: {_gql_str(_ssh_bootstrap_docker_args(public_key))}',
+    ]
+    if region:
+        fields.append(f'countryCode: {_gql_str(region)}')
+    if interruptible:
+        fields.append(f'bidPerGpu: {bid_per_gpu or 0.0}')
+        mutation, out = 'podRentInterruptable', 'podRentInterruptable'
+    else:
+        mutation, out = ('podFindAndDeployOnDemand',
+                         'podFindAndDeployOnDemand')
+    data = _call(
+        f'mutation {{ {mutation}(input: {{ {", ".join(fields)} }}) '
+        f'{{ id desiredStatus }} }}')
+    pod = data.get(out) or {}
+    pod_id = pod.get('id')
+    if not pod_id:
+        raise RunPodApiError(200, 'insufficient-capacity',
+                             f'no pod deployed for {name}')
+    return str(pod_id)
+
+
+def terminate_pod(pod_id: str) -> None:
+    _call(f'mutation {{ podTerminate(input: {{ podId: '
+          f'{_gql_str(pod_id)} }}) }}')

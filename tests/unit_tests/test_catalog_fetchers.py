@@ -206,6 +206,151 @@ class TestFetchAzure:
             region='eastus') == pytest.approx(0.1920)
 
 
+class TestFetchLambda:
+
+    _RESPONSE = {'data': {
+        'gpu_1x_a100_sxm4': {'instance_type': {
+            'name': 'gpu_1x_a100_sxm4',
+            'description': '1x A100 (40 GB SXM4)',
+            'price_cents_per_hour': 110,
+            'specs': {'vcpus': 30, 'memory_gib': 200}}},
+        'gpu_8x_h100_sxm5': {'instance_type': {
+            'name': 'gpu_8x_h100_sxm5',
+            'description': '8x H100 (80 GB SXM5)',
+            'price_cents_per_hour': 2150,
+            'specs': {'vcpus': 208, 'memory_gib': 1800}}},
+        'cpu_4x_general': {'instance_type': {
+            'name': 'cpu_4x_general', 'description': '4x CPU',
+            'price_cents_per_hour': 9,
+            'specs': {'vcpus': 4, 'memory_gib': 16}}},
+    }}
+
+    def test_fetch_reprices_and_maps_gpus(self):
+        from skypilot_tpu.catalog import lambda_catalog
+        from skypilot_tpu.catalog.fetchers import fetch_lambda
+        paths = fetch_lambda.fetch_and_write(
+            fetch_json=lambda url: self._RESPONSE)
+        assert 'vms' in paths
+        # Fresh price replaces the snapshot's 1.29.
+        assert lambda_catalog.get_hourly_cost(
+            'gpu_1x_a100_sxm4', use_spot=False) == pytest.approx(1.10)
+        # GPU name + count derived from the type grammar.
+        assert lambda_catalog.get_accelerators_from_instance_type(
+            'gpu_8x_h100_sxm5') == {'H100': 8}
+        assert lambda_catalog.get_accelerators_from_instance_type(
+            'cpu_4x_general') is None
+        catalog_common.remove_override('lambda', 'vms')
+        lambda_catalog.reload()
+
+    def test_empty_response_keeps_previous_table(self):
+        from skypilot_tpu.catalog.fetchers import fetch_lambda
+        with pytest.raises(RuntimeError, match='no'):
+            fetch_lambda.fetch_and_write(
+                fetch_json=lambda url: {'data': {}})
+
+
+class TestFetchRunpod:
+
+    _GPU_TYPES = {'gpuTypes': [
+        {'id': 'NVIDIA H100 PCIe',
+         'displayName': 'NVIDIA H100 PCIe', 'memoryInGb': 80,
+         'securePrice': 2.79, 'communityPrice': 2.29,
+         'secureSpotPrice': 1.40, 'communitySpotPrice': 1.10},
+        {'id': 'unknown', 'displayName': 'Unknown GPU',
+         'memoryInGb': 16, 'securePrice': 0.2},
+    ]}
+
+    def test_fetch_builds_tiered_rows(self):
+        from skypilot_tpu.catalog import runpod_catalog
+        from skypilot_tpu.catalog.fetchers import fetch_runpod
+        paths = fetch_runpod.fetch_and_write(
+            run_query=lambda q: self._GPU_TYPES)
+        assert 'vms' in paths
+        assert runpod_catalog.get_hourly_cost(
+            '1x_H100_SECURE', use_spot=False) == pytest.approx(2.79)
+        assert runpod_catalog.get_hourly_cost(
+            '8x_H100_SECURE', use_spot=True) == pytest.approx(11.20)
+        assert runpod_catalog.get_hourly_cost(
+            '1x_H100_COMMUNITY', use_spot=False) == pytest.approx(2.29)
+        # Refresh reprices but must NOT shrink known host shapes
+        # (gpuTypes.memoryInGb is VRAM, not host RAM).
+        assert runpod_catalog.get_vcpus_mem_from_instance_type(
+            '1x_H100_SECURE') == (16.0, 96.0)
+        catalog_common.remove_override('runpod', 'vms')
+        runpod_catalog.reload()
+
+
+class TestFetchDo:
+
+    _SIZES = {'sizes': [
+        {'slug': 's-8vcpu-16gb', 'vcpus': 8, 'memory': 16384,
+         'price_hourly': 0.125, 'available': True},
+        {'slug': 'gpu-h100x1-80gb', 'vcpus': 20, 'memory': 245760,
+         'price_hourly': 3.19, 'available': True},
+        {'slug': 'legacy-512mb', 'vcpus': 1, 'memory': 512,
+         'price_hourly': 0.007, 'available': True},   # filtered family
+        {'slug': 'c-32', 'vcpus': 32, 'memory': 65536,
+         'price_hourly': 0.95, 'available': False},   # not available
+    ], 'links': {}}
+
+    def test_fetch_filters_and_reprices(self):
+        from skypilot_tpu.catalog import do_catalog
+        from skypilot_tpu.catalog.fetchers import fetch_do
+        paths = fetch_do.fetch_and_write(
+            fetch_page=lambda page: self._SIZES)
+        assert 'vms' in paths
+        assert do_catalog.get_hourly_cost(
+            's-8vcpu-16gb', use_spot=False) == pytest.approx(0.125)
+        assert do_catalog.get_accelerators_from_instance_type(
+            'gpu-h100x1-80gb') == {'H100': 1}
+        assert not do_catalog.instance_type_exists('legacy-512mb')
+        assert not do_catalog.instance_type_exists('c-32')
+        catalog_common.remove_override('do', 'vms')
+        do_catalog.reload()
+
+
+class TestFetchFluidstack:
+
+    _PLANS = [
+        {'gpu_type': 'H100_PCIE_80GB', 'price_per_gpu_hr': '2.49',
+         'gpu_counts': [1, 2, 8], 'regions': ['norway_2_eu']},
+        {'gpu_type': 'FREE_TIER', 'price_per_gpu_hr': 0,
+         'gpu_counts': [1], 'regions': []},    # zero price: skipped
+    ]
+
+    def test_fetch_expands_counts(self):
+        from skypilot_tpu.catalog import fluidstack_catalog
+        from skypilot_tpu.catalog.fetchers import fetch_fluidstack
+        paths = fetch_fluidstack.fetch_and_write(
+            fetch_json=lambda path: self._PLANS)
+        assert 'vms' in paths
+        assert fluidstack_catalog.get_hourly_cost(
+            'H100_PCIE_80GB::1', use_spot=False) == pytest.approx(2.49)
+        assert fluidstack_catalog.get_hourly_cost(
+            'H100_PCIE_80GB::8', use_spot=False) == pytest.approx(
+                19.92)
+        assert fluidstack_catalog.get_accelerators_from_instance_type(
+            'H100_PCIE_80GB::2') == {'H100': 2}
+        assert not fluidstack_catalog.instance_type_exists(
+            'FREE_TIER::1')
+        catalog_common.remove_override('fluidstack', 'vms')
+        fluidstack_catalog.reload()
+
+    def test_cli_fetch_fluidstack(self, monkeypatch):
+        from skypilot_tpu import cli as cli_mod
+        from skypilot_tpu.catalog import fluidstack_catalog
+        from skypilot_tpu.catalog.fetchers import fetch_fluidstack
+        monkeypatch.setattr(fetch_fluidstack, '_default_fetch_json',
+                            lambda path: self._PLANS)
+        result = CliRunner().invoke(
+            cli_mod.cli, ['catalog', 'update', '--cloud', 'fluidstack',
+                          '--fetch'])
+        assert result.exit_code == 0, result.output
+        assert 'vms' in result.output
+        catalog_common.remove_override('fluidstack', 'vms')
+        fluidstack_catalog.reload()
+
+
 class TestCliAndStaleness:
 
     def test_cli_fetch_gcp(self, monkeypatch):

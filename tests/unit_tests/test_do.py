@@ -1,0 +1,218 @@
+"""DigitalOcean tests: token auth, droplet lifecycle (incl. the
+stop/resume path DO supports, unlike the other minor clouds) over a
+mocked REST seam, catalog + optimizer integration (depth of
+test_lambda_cloud.py)."""
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.catalog import do_catalog
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.do import do_api
+from skypilot_tpu.provision.do import instance as do_instance
+
+Resources = resources_lib.Resources
+
+
+@pytest.fixture(autouse=True)
+def _token(monkeypatch):
+    monkeypatch.setenv('DIGITALOCEAN_ACCESS_TOKEN', 'do-test')
+
+
+class TestAuth:
+
+    def test_token_from_env(self):
+        assert do_api.load_token() == 'do-test'
+
+    def test_token_from_doctl_config(self, tmp_path, monkeypatch):
+        monkeypatch.delenv('DIGITALOCEAN_ACCESS_TOKEN')
+        f = tmp_path / 'config.yaml'
+        f.write_text('access-token: do-file\ncontext: default\n')
+        monkeypatch.setenv('DOCTL_CONFIG_FILE', str(f))
+        assert do_api.load_token() == 'do-file'
+
+    def test_check_credentials(self, tmp_path, monkeypatch):
+        do = registry.CLOUD_REGISTRY.from_str('do')
+        ok, _ = do.check_credentials()
+        assert ok
+        monkeypatch.delenv('DIGITALOCEAN_ACCESS_TOKEN')
+        monkeypatch.setenv('DOCTL_CONFIG_FILE', str(tmp_path / 'no'))
+        ok, msg = do.check_credentials()
+        assert not ok and 'token' in msg
+
+
+class FakeDo:
+    """In-memory droplet store behind the do_api.request seam."""
+
+    def __init__(self):
+        self.droplets = {}
+        self.counter = 0
+        self.fail_create = None
+
+    def request(self, method, path, body=None, params=None):
+        if path == '/droplets' and method == 'GET':
+            tag = (params or {}).get('tag_name')
+            out = [d for d in self.droplets.values()
+                   if tag in d['tags']]
+            return {'droplets': out, 'links': {}}
+        if path == '/droplets' and method == 'POST':
+            if self.fail_create:
+                raise do_api.DoApiError(422, 'unprocessable_entity',
+                                        self.fail_create)
+            out = []
+            for name in body['names']:
+                self.counter += 1
+                did = 9000 + self.counter
+                self.droplets[did] = {
+                    'id': did, 'name': name, 'status': 'active',
+                    'tags': list(body.get('tags', [])),
+                    'user_data': body.get('user_data'),
+                    'size_slug': body['size'],
+                    'networks': {'v4': [
+                        {'type': 'public',
+                         'ip_address': f'164.0.0.{self.counter}'},
+                        {'type': 'private',
+                         'ip_address': f'10.1.0.{self.counter}'},
+                    ]},
+                }
+                out.append(self.droplets[did])
+            return {'droplets': out}
+        if method == 'DELETE' and path.startswith('/droplets/'):
+            did = int(path.rsplit('/', 1)[1])
+            self.droplets.pop(did, None)
+            return {}
+        if method == 'POST' and path.endswith('/actions'):
+            did = int(path.split('/')[2])
+            action = body['type']
+            if did in self.droplets:
+                self.droplets[did]['status'] = (
+                    'off' if action == 'power_off' else 'active')
+            return {}
+        raise AssertionError(f'unhandled {method} {path}')
+
+
+@pytest.fixture()
+def fake_do(monkeypatch):
+    fake = FakeDo()
+    monkeypatch.setattr(do_api, 'request', fake.request)
+    monkeypatch.setattr(do_instance.do_api, 'request', fake.request)
+    monkeypatch.setattr(do_instance.time, 'sleep', lambda s: None)
+    return fake
+
+
+def _pconfig(count=1, resume=False, **node):
+    node_cfg = {'instance_type': 'gpu-h100x1-80gb', 'zone': None}
+    node_cfg.update(node)
+    return provision_common.ProvisionConfig(
+        provider_config={'region': 'nyc2'},
+        authentication_config={
+            'ssh_keys': 'skytpu:ssh-ed25519 AAAA key'},
+        docker_config={}, node_config=node_cfg, count=count, tags={},
+        resume_stopped_nodes=resume)
+
+
+class TestDoProvisioner:
+
+    def test_launch_stop_resume_terminate(self, fake_do):
+        record = do_instance.run_instances('nyc2', 'c1',
+                                           _pconfig(count=2))
+        assert len(record.created_instance_ids) == 2
+        head = record.head_instance_id
+        # SSH key rides cloud-init user_data (no account key API).
+        droplet = fake_do.droplets[int(head)]
+        assert 'ssh-ed25519 AAAA key' in droplet['user_data']
+        assert droplet['tags'] == ['skytpu-c1']
+
+        info = do_instance.get_cluster_info('nyc2', 'c1',
+                                            {'region': 'nyc2'})
+        assert info.ssh_user == 'root'
+        assert info.instances[head][0].internal_ip.startswith('10.1.')
+
+        # Stop (power_off) -> resume (power_on), DO's stop support.
+        do_instance.stop_instances('c1', {'region': 'nyc2'})
+        statuses = do_instance.query_instances(
+            'c1', {'region': 'nyc2'}, non_terminated_only=False)
+        assert set(statuses.values()) == {'stopped'}
+        record2 = do_instance.run_instances(
+            'nyc2', 'c1', _pconfig(count=2, resume=True))
+        assert sorted(record2.resumed_instance_ids) == \
+            sorted(statuses)
+        assert record2.created_instance_ids == []
+
+        do_instance.terminate_instances('c1', {'region': 'nyc2'})
+        assert do_instance.query_instances(
+            'c1', {'region': 'nyc2'}) == {}
+
+    def test_worker_only_stop_keeps_head(self, fake_do):
+        record = do_instance.run_instances('nyc2', 'c2',
+                                           _pconfig(count=2))
+        do_instance.stop_instances('c2', {'region': 'nyc2'},
+                                   worker_only=True)
+        statuses = do_instance.query_instances(
+            'c2', {'region': 'nyc2'}, non_terminated_only=False)
+        assert statuses[record.head_instance_id] == 'running'
+        assert sorted(statuses.values()) == ['running', 'stopped']
+
+    def test_capacity_error_classified(self, fake_do):
+        fake_do.fail_create = 'you have exceeded your droplet limit'
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            do_instance.run_instances('nyc2', 'c9', _pconfig())
+
+    def test_gpu_image_default(self, fake_do):
+        do_instance.run_instances('nyc2', 'g1', _pconfig())
+        do_instance.run_instances('nyc2', 'g2', _pconfig(
+            instance_type='s-8vcpu-16gb'))
+        sizes = {d['size_slug'] for d in fake_do.droplets.values()}
+        assert sizes == {'gpu-h100x1-80gb', 's-8vcpu-16gb'}
+
+
+class TestDoCloudAndCatalog:
+
+    def test_flat_pricing_no_spot(self):
+        assert do_catalog.get_hourly_cost(
+            'gpu-h100x1-80gb', use_spot=False) == pytest.approx(3.39)
+        do = registry.CLOUD_REGISTRY.from_str('do')
+        feasible = do.get_feasible_launchable_resources(
+            Resources(accelerators='H100:8'))
+        assert [r.instance_type for r in feasible.resources_list] == \
+            ['gpu-h100x8-640gb']
+        feasible = do.get_feasible_launchable_resources(
+            Resources(accelerators='H100:8', use_spot=True))
+        assert feasible.resources_list == []
+
+    def test_gpu_regions_narrower_than_cpu(self):
+        do = registry.CLOUD_REGISTRY.from_str('do')
+        cpu_regions = do.regions_with_offering(
+            's-8vcpu-16gb', None, False, None, None)
+        gpu_regions = do.regions_with_offering(
+            'gpu-h100x1-80gb', None, False, None, None)
+        assert len(gpu_regions) < len(cpu_regions)
+        assert {r.name for r in gpu_regions} <= \
+            {r.name for r in cpu_regions}
+
+    def test_feature_model_supports_stop(self):
+        do = registry.CLOUD_REGISTRY.from_str('do')
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        unsupported = do._unsupported_features_for_resources(
+            Resources(cloud='do', instance_type='s-8vcpu-16gb'))
+        assert cloud_lib.CloudImplementationFeatures.STOP \
+            not in unsupported
+        assert cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE in \
+            unsupported
+
+    def test_optimizer_picks_do_for_cheap_cpu(self):
+        """8 vCPU on-demand: DO's s-8vcpu-16gb ($0.1429) undercuts
+        GCP e2-standard-8 ($0.2681) and AWS m6i.2xlarge ($0.384)."""
+        global_user_state.set_enabled_clouds(['gcp', 'aws', 'do'])
+        t = task_lib.Task('t', run='x')
+        t.set_resources(Resources(cpus='8+'))
+        with dag_lib.Dag() as d:
+            d.add(t)
+        optimizer_lib.optimize(d, quiet=True)
+        assert t.best_resources.cloud.canonical_name() == 'do'
+        assert t.best_resources.instance_type == 's-8vcpu-16gb'

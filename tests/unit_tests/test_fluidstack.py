@@ -1,0 +1,193 @@
+"""FluidStack tests: api-key auth, instance lifecycle over a mocked
+REST seam, `GPU::count` plan grammar, no-stop semantics, catalog +
+optimizer integration (depth of test_lambda_cloud.py)."""
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.catalog import fluidstack_catalog
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.fluidstack import fluidstack_api
+from skypilot_tpu.provision.fluidstack import instance as fs_instance
+
+Resources = resources_lib.Resources
+
+
+@pytest.fixture(autouse=True)
+def _api_key(monkeypatch):
+    monkeypatch.setenv('FLUIDSTACK_API_KEY', 'fs-test')
+
+
+class TestAuth:
+
+    def test_key_from_env(self):
+        assert fluidstack_api.load_api_key() == 'fs-test'
+
+    def test_key_from_file(self, tmp_path, monkeypatch):
+        monkeypatch.delenv('FLUIDSTACK_API_KEY')
+        f = tmp_path / 'api_key'
+        f.write_text('fs-file\n')
+        monkeypatch.setenv('FLUIDSTACK_KEY_FILE', str(f))
+        assert fluidstack_api.load_api_key() == 'fs-file'
+
+    def test_check_credentials(self, tmp_path, monkeypatch):
+        fs = registry.CLOUD_REGISTRY.from_str('fluidstack')
+        ok, _ = fs.check_credentials()
+        assert ok
+        monkeypatch.delenv('FLUIDSTACK_API_KEY')
+        monkeypatch.setenv('FLUIDSTACK_KEY_FILE', str(tmp_path / 'no'))
+        ok, msg = fs.check_credentials()
+        assert not ok and 'API key' in msg
+
+
+class FakeFluidstack:
+    """In-memory instance store behind the request seam."""
+
+    def __init__(self):
+        self.instances = {}
+        self.keys = []
+        self.counter = 0
+        self.out_of_stock = False
+
+    def request(self, method, path, body=None):
+        if path == '/instances' and method == 'GET':
+            return list(self.instances.values())
+        if path == '/instances' and method == 'POST':
+            if self.out_of_stock:
+                raise fluidstack_api.FluidstackApiError(
+                    400, 'out-of-stock', 'Plan out of stock')
+            self.counter += 1
+            iid = f'fs-{self.counter:04d}'
+            self.instances[iid] = {
+                'id': iid, 'name': body['name'], 'status': 'running',
+                'gpu_type': body['gpu_type'],
+                'gpu_count': body['gpu_count'],
+                'region': body['region'],
+                'ip_address': f'93.0.0.{self.counter}',
+                'private_ip': f'10.2.0.{self.counter}',
+            }
+            return {'id': iid}
+        if method == 'DELETE' and path.startswith('/instances/'):
+            self.instances.pop(path.rsplit('/', 1)[1], None)
+            return {}
+        if path == '/ssh_keys' and method == 'GET':
+            return list(self.keys)
+        if path == '/ssh_keys' and method == 'POST':
+            self.keys.append(dict(body))
+            return dict(body)
+        raise AssertionError(f'unhandled {method} {path}')
+
+
+@pytest.fixture()
+def fake_fs(monkeypatch):
+    fake = FakeFluidstack()
+    monkeypatch.setattr(fluidstack_api, 'request', fake.request)
+    monkeypatch.setattr(fs_instance.fluidstack_api, 'request',
+                        fake.request)
+    monkeypatch.setattr(fs_instance.time, 'sleep', lambda s: None)
+    return fake
+
+
+def _pconfig(count=1, **node):
+    node_cfg = {'instance_type': 'H100_PCIE_80GB::2', 'zone': None}
+    node_cfg.update(node)
+    return provision_common.ProvisionConfig(
+        provider_config={'region': 'norway_2_eu'},
+        authentication_config={
+            'ssh_keys': 'skytpu:ssh-ed25519 AAAA key'},
+        docker_config={}, node_config=node_cfg, count=count, tags={},
+        resume_stopped_nodes=False)
+
+
+class TestFluidstackProvisioner:
+
+    def test_launch_query_terminate(self, fake_fs):
+        record = fs_instance.run_instances('norway_2_eu', 'c1',
+                                           _pconfig(count=2))
+        assert len(record.created_instance_ids) == 2
+        assert record.head_instance_id == 'fs-0001'
+        # Plan grammar decomposed into API fields.
+        inst = fake_fs.instances['fs-0001']
+        assert inst['gpu_type'] == 'H100_PCIE_80GB'
+        assert inst['gpu_count'] == 2
+        # Framework key registered once.
+        assert len(fake_fs.keys) == 1
+
+        info = fs_instance.get_cluster_info('norway_2_eu', 'c1',
+                                            {'region': 'norway_2_eu'})
+        assert info.ssh_user == 'ubuntu'
+        assert info.instances['fs-0001'][0].external_ip == '93.0.0.1'
+
+        record2 = fs_instance.run_instances('norway_2_eu', 'c1',
+                                            _pconfig(count=2))
+        assert record2.created_instance_ids == []
+
+        fs_instance.terminate_instances('c1',
+                                        {'region': 'norway_2_eu'})
+        assert fs_instance.query_instances(
+            'c1', {'region': 'norway_2_eu'}) == {}
+
+    def test_ssh_key_reused(self, fake_fs):
+        fs_instance.run_instances('norway_2_eu', 'c1', _pconfig())
+        fs_instance.run_instances('norway_2_eu', 'c2', _pconfig())
+        assert len(fake_fs.keys) == 1
+
+    def test_stop_raises_not_supported(self, fake_fs):
+        fs_instance.run_instances('norway_2_eu', 'c1', _pconfig())
+        with pytest.raises(exceptions.NotSupportedError,
+                           match='cannot be stopped'):
+            fs_instance.stop_instances('c1', {'region': 'norway_2_eu'})
+
+    def test_out_of_stock_classified(self, fake_fs):
+        fake_fs.out_of_stock = True
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            fs_instance.run_instances('norway_2_eu', 'c9', _pconfig())
+
+    def test_plan_grammar(self):
+        assert fs_instance.parse_instance_type(
+            'A100_PCIE_80GB::8') == ('A100_PCIE_80GB', 8)
+        with pytest.raises(exceptions.ProvisionError, match='bad'):
+            fs_instance.parse_instance_type('A100_PCIE_80GB')
+
+
+class TestFluidstackCloudAndCatalog:
+
+    def test_flat_pricing_no_spot(self):
+        assert fluidstack_catalog.get_hourly_cost(
+            'H100_PCIE_80GB::1', use_spot=False) == pytest.approx(2.89)
+        fs = registry.CLOUD_REGISTRY.from_str('fluidstack')
+        feasible = fs.get_feasible_launchable_resources(
+            Resources(accelerators='H100:4'))
+        assert [r.instance_type for r in feasible.resources_list] == \
+            ['H100_PCIE_80GB::4']
+        feasible = fs.get_feasible_launchable_resources(
+            Resources(accelerators='H100:4', use_spot=True))
+        assert feasible.resources_list == []
+
+    def test_feature_model(self):
+        fs = registry.CLOUD_REGISTRY.from_str('fluidstack')
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        unsupported = fs._unsupported_features_for_resources(
+            Resources(cloud='fluidstack',
+                      instance_type='H100_PCIE_80GB::1'))
+        assert cloud_lib.CloudImplementationFeatures.STOP in unsupported
+        assert cloud_lib.CloudImplementationFeatures.HOST_CONTROLLERS \
+            in unsupported
+
+    def test_optimizer_picks_fluidstack_when_cheapest(self):
+        """A100-80GB:8 on-demand: FluidStack's $11.92 undercuts
+        Lambda's $14.32 and the hyperscalers."""
+        global_user_state.set_enabled_clouds(
+            ['aws', 'azure', 'lambda', 'fluidstack'])
+        t = task_lib.Task('t', run='x')
+        t.set_resources(Resources(accelerators='A100-80GB:8'))
+        with dag_lib.Dag() as d:
+            d.add(t)
+        optimizer_lib.optimize(d, quiet=True)
+        assert t.best_resources.cloud.canonical_name() == 'fluidstack'
+        assert t.best_resources.instance_type == 'A100_PCIE_80GB::8'

@@ -1,0 +1,233 @@
+"""RunPod tests: GraphQL-key auth, pod lifecycle over a mocked
+GraphQL seam, mapped-SSH-port surfacing, no-stop semantics, catalog +
+optimizer integration (depth of test_lambda_cloud.py)."""
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.catalog import runpod_catalog
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.runpod import instance as rp_instance
+from skypilot_tpu.provision.runpod import runpod_api
+
+Resources = resources_lib.Resources
+
+
+@pytest.fixture(autouse=True)
+def _api_key(monkeypatch):
+    monkeypatch.setenv('RUNPOD_API_KEY', 'rp-test')
+
+
+class TestAuth:
+
+    def test_key_from_env(self):
+        assert runpod_api.load_api_key() == 'rp-test'
+
+    def test_key_from_config_toml(self, tmp_path, monkeypatch):
+        monkeypatch.delenv('RUNPOD_API_KEY')
+        f = tmp_path / 'config.toml'
+        f.write_text('[default]\napikey = "rp-file"\n')
+        monkeypatch.setenv('RUNPOD_CONFIG_FILE', str(f))
+        assert runpod_api.load_api_key() == 'rp-file'
+
+    def test_check_credentials(self, tmp_path, monkeypatch):
+        rp = registry.CLOUD_REGISTRY.from_str('runpod')
+        ok, _ = rp.check_credentials()
+        assert ok
+        monkeypatch.delenv('RUNPOD_API_KEY')
+        monkeypatch.setenv('RUNPOD_CONFIG_FILE', str(tmp_path / 'no'))
+        ok, msg = rp.check_credentials()
+        assert not ok and 'API key' in msg
+
+
+class FakeRunPod:
+    """In-memory pod store behind the GraphQL _call seam."""
+
+    def __init__(self):
+        self.pods = {}
+        self.counter = 0
+        self.fail_deploy = False
+
+    def _call(self, query):
+        q = ' '.join(query.split())
+        if 'myself { pods' in q:
+            return {'myself': {'pods': list(self.pods.values())}}
+        if 'podFindAndDeployOnDemand' in q or \
+                'podRentInterruptable' in q:
+            if self.fail_deploy:
+                raise runpod_api.RunPodApiError(
+                    200, 'insufficient-capacity',
+                    'There are no longer any instances available')
+            self.counter += 1
+            pid = f'pod-{self.counter:04d}'
+            name = q.split('name: "', 1)[1].split('"', 1)[0]
+            self.pods[pid] = {
+                'id': pid, 'name': name, 'desiredStatus': 'RUNNING',
+                'costPerHr': 1.0,
+                'machine': {'gpuDisplayName': 'H100'},
+                'runtime': {'ports': [{
+                    'ip': f'38.0.0.{self.counter}', 'isIpPublic': True,
+                    'privatePort': 22,
+                    'publicPort': 40000 + self.counter,
+                    'type': 'tcp'}]},
+            }
+            key = ('podRentInterruptable' if 'podRentInterruptable'
+                   in q else 'podFindAndDeployOnDemand')
+            return {key: {'id': pid, 'desiredStatus': 'RUNNING'}}
+        if 'podTerminate' in q:
+            pid = q.split('podId: "', 1)[1].split('"', 1)[0]
+            if pid in self.pods:
+                self.pods[pid]['desiredStatus'] = 'TERMINATED'
+            return {'podTerminate': None}
+        raise AssertionError(f'unhandled query {q[:120]}')
+
+
+@pytest.fixture()
+def fake_runpod(monkeypatch):
+    fake = FakeRunPod()
+    monkeypatch.setattr(runpod_api, '_call', fake._call)
+    monkeypatch.setattr(rp_instance.runpod_api, '_call', fake._call)
+    monkeypatch.setattr(rp_instance.time, 'sleep', lambda s: None)
+    return fake
+
+
+def _pconfig(count=1, **node):
+    node_cfg = {'instance_type': '1x_H100_SECURE', 'zone': None}
+    node_cfg.update(node)
+    return provision_common.ProvisionConfig(
+        provider_config={'region': 'US'},
+        authentication_config={
+            'ssh_keys': 'skytpu:ssh-ed25519 AAAA key'},
+        docker_config={}, node_config=node_cfg, count=count, tags={},
+        resume_stopped_nodes=False)
+
+
+class TestRunPodProvisioner:
+
+    def test_launch_query_terminate(self, fake_runpod):
+        record = rp_instance.run_instances('US', 'c1', _pconfig())
+        assert record.created_instance_ids == ['pod-0001']
+        assert record.head_instance_id == 'pod-0001'
+
+        info = rp_instance.get_cluster_info('US', 'c1',
+                                            {'region': 'US'})
+        assert info.ssh_user == 'root'
+        inst = info.instances['pod-0001'][0]
+        # SSH rides the MAPPED public port, never container 22.
+        assert inst.ssh_port == 40001
+        assert inst.external_ip == '38.0.0.1'
+
+        # Idempotent re-run.
+        record2 = rp_instance.run_instances('US', 'c1', _pconfig())
+        assert record2.created_instance_ids == []
+
+        rp_instance.terminate_instances('c1', {'region': 'US'})
+        assert rp_instance.query_instances('c1', {'region': 'US'}) == {}
+
+    def test_wait_requires_ssh_endpoint(self, fake_runpod):
+        rp_instance.run_instances('US', 'c2', _pconfig())
+        # Pod RUNNING but port mapping gone -> wait must time out.
+        for pod in fake_runpod.pods.values():
+            pod['runtime'] = {'ports': []}
+        with pytest.raises(exceptions.ProvisionTimeoutError):
+            rp_instance.wait_instances('US', 'c2', timeout=0.1)
+
+    def test_stop_raises_not_supported(self, fake_runpod):
+        rp_instance.run_instances('US', 'c1', _pconfig())
+        with pytest.raises(exceptions.NotSupportedError,
+                           match='cannot be stopped'):
+            rp_instance.stop_instances('c1', {'region': 'US'})
+
+    def test_capacity_error_classified(self, fake_runpod):
+        fake_runpod.fail_deploy = True
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            rp_instance.run_instances('US', 'c9', _pconfig())
+
+    def test_spot_uses_interruptible_market(self, fake_runpod,
+                                            monkeypatch):
+        seen = []
+        orig = fake_runpod._call
+
+        def spy(query):
+            seen.append(query)
+            return orig(query)
+
+        monkeypatch.setattr(rp_instance.runpod_api, '_call', spy)
+        monkeypatch.setattr(runpod_api, '_call', spy)
+        rp_instance.run_instances('US', 'c3',
+                                  _pconfig(use_spot=True))
+        spot_q = next(q for q in seen if 'podRentInterruptable' in q)
+        # A zero bid never wins interruptible capacity: the catalog
+        # spot price per GPU must ride the mutation.
+        bid = float(spot_q.split('bidPerGpu: ', 1)[1].split(',')[0]
+                    .split(' ')[0].rstrip('}'))
+        assert bid == pytest.approx(1.50)
+
+    def test_deploy_vars_carry_bid(self):
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        rp = registry.CLOUD_REGISTRY.from_str('runpod')
+        vars_ = rp.make_deploy_resources_variables(
+            Resources(cloud='runpod', instance_type='2x_H100_SECURE',
+                      use_spot=True),
+            'c1', cloud_lib.Region('US'), None, 1)
+        assert vars_['bid_per_gpu'] == pytest.approx(1.50)
+        vars_od = rp.make_deploy_resources_variables(
+            Resources(cloud='runpod', instance_type='2x_H100_SECURE'),
+            'c1', cloud_lib.Region('US'), None, 1)
+        assert vars_od['bid_per_gpu'] is None
+
+    def test_instance_type_parsing(self):
+        gpu_id, count = rp_instance.parse_instance_type(
+            '8x_A100-80GB_SECURE')
+        assert gpu_id == 'NVIDIA A100 80GB PCIe'
+        assert count == 8
+        with pytest.raises(exceptions.ProvisionError, match='bad'):
+            rp_instance.parse_instance_type('H100')
+
+
+class TestRunPodCloudAndCatalog:
+
+    def test_spot_pricing_differs(self):
+        od = runpod_catalog.get_hourly_cost('1x_H100_SECURE',
+                                            use_spot=False)
+        spot = runpod_catalog.get_hourly_cost('1x_H100_SECURE',
+                                              use_spot=True)
+        assert od == pytest.approx(2.99)
+        assert spot < od
+
+    def test_feature_model(self):
+        rp = registry.CLOUD_REGISTRY.from_str('runpod')
+        from skypilot_tpu.clouds import cloud as cloud_lib
+        unsupported = rp._unsupported_features_for_resources(
+            Resources(cloud='runpod', instance_type='1x_H100_SECURE'))
+        assert cloud_lib.CloudImplementationFeatures.STOP in unsupported
+        assert cloud_lib.CloudImplementationFeatures.MULTI_NODE in \
+            unsupported
+        # Spot IS supported (interruptible market).
+        assert cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE \
+            not in unsupported
+
+    def test_multi_node_infeasible(self):
+        rp = registry.CLOUD_REGISTRY.from_str('runpod')
+        feasible = rp.get_feasible_launchable_resources(
+            Resources(accelerators='H100:1'), num_nodes=2)
+        assert feasible.resources_list == []
+
+    def test_optimizer_picks_runpod_spot_when_cheapest(self):
+        """H100:1 spot: RunPod's interruptible $1.50 undercuts every
+        on-demand H100 (no other enabled cloud offers H100:1 spot at
+        that price)."""
+        global_user_state.set_enabled_clouds(
+            ['aws', 'azure', 'runpod'])
+        t = task_lib.Task('t', run='x')
+        t.set_resources(Resources(accelerators='H100:1',
+                                  use_spot=True))
+        with dag_lib.Dag() as d:
+            d.add(t)
+        optimizer_lib.optimize(d, quiet=True)
+        assert t.best_resources.cloud.canonical_name() == 'runpod'

@@ -346,6 +346,214 @@ class TestAzureBlobStore:
         assert '__pycache__;*.log' in cmd
 
 
+class TestIBMCosStore:
+
+    @pytest.fixture(autouse=True)
+    def _region(self, monkeypatch):
+        monkeypatch.setenv('IBM_COS_REGION', 'us-south')
+
+    def test_endpoint_region_and_url(self):
+        store = storage_lib.IBMCosStore('bkt', None)
+        assert store.url() == 'cos://us-south/bkt'
+        assert store.endpoint_url() == (
+            'https://s3.us-south.cloud-object-storage.appdomain.cloud')
+        # Region from the URL beats the env.
+        store = storage_lib.IBMCosStore('x', 'cos://eu-de/bkt2/pfx')
+        assert store.name == 'bkt2'
+        assert store.url() == 'cos://eu-de/bkt2'
+        assert 's3.eu-de.' in store.endpoint_url()
+
+    def test_cli_gets_endpoint_profile_and_credentials(self,
+                                                      monkeypatch):
+        calls = []
+
+        def fake_run(cmd, **kwargs):
+            calls.append((cmd, kwargs.get('env', {})))
+            return subprocess.CompletedProcess(cmd, 0, '', '')
+
+        monkeypatch.setattr(subprocess, 'run', fake_run)
+        store = storage_lib.IBMCosStore('bkt', None)
+        store.create()
+        cmd, env = calls[0]
+        assert cmd[:3] == ['aws', '--profile', 'ibm']
+        assert 'cloud-object-storage.appdomain.cloud' in \
+            cmd[cmd.index('--endpoint-url') + 1]
+        assert any(a == 's3://bkt' for a in cmd)
+        assert env.get('AWS_SHARED_CREDENTIALS_FILE', '').endswith(
+            '.ibm/cos.credentials')
+
+    def test_sync_and_mount_commands(self):
+        store = storage_lib.IBMCosStore('bkt', None)
+        sync = store.make_sync_dir_command('/data')
+        assert 's3 sync s3://bkt /data' in sync
+        assert '--endpoint-url https://s3.us-south.' in sync
+        mount = store.make_mount_command('/mnt/cos')
+        assert 'rclone mount' in mount
+        assert 'provider=IBMCOS' in mount
+        assert 'AWS_PROFILE=ibm' in mount
+        assert 'mountpoint -q /mnt/cos' in mount
+
+    def test_storage_routes_cos_scheme(self):
+        s = storage_lib.Storage(source='cos://us-east/my-bucket/sub')
+        assert s.store_type == storage_lib.StoreType.IBM
+        assert s.name == 'my-bucket'
+        assert isinstance(s.get_store(), storage_lib.IBMCosStore)
+
+    def test_missing_region_is_clear_error(self, monkeypatch):
+        monkeypatch.delenv('IBM_COS_REGION')
+        with pytest.raises(exceptions.StorageError, match='region'):
+            storage_lib.IBMCosStore('bkt', None).endpoint_url()
+
+    def test_ambiguous_url_rejected_not_guessed(self):
+        # 'cos://mybkt/data' would silently become endpoint
+        # s3.mybkt.… if the bucket were treated as a region.
+        with pytest.raises(exceptions.StorageSourceError,
+                           match='not a region'):
+            storage_lib.split_cos_url('cos://mybkt/data')
+
+    def test_mount_endpoint_quoted_and_allow_other_fallback(self):
+        store = storage_lib.IBMCosStore('bkt', None)
+        mount = store.make_mount_command('/mnt/cos')
+        # rclone connection-string values with ':' must be quoted.
+        assert 'endpoint="https://s3.us-south.' in mount
+        # --allow-other tried first, plain mount as fallback.
+        assert '--allow-other 2>/dev/null ||' in mount
+
+    def test_inherits_s3_lifecycle_with_key_preserving_rewrite(
+            self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(
+            subprocess, 'run',
+            lambda cmd, **k: (calls.append(cmd),
+                              subprocess.CompletedProcess(
+                                  cmd, 0, '', ''))[1])
+        store = storage_lib.IBMCosStore('bkt', None)
+        store.exists()
+        store.delete()
+        flat = [' '.join(c) for c in calls]
+        assert any('s3api head-bucket --bucket bkt' in c for c in flat)
+        assert any('s3 rb s3://bkt --force' in c for c in flat)
+        # A cos:// URL with a key keeps the key when rewritten.
+        proc_args = store._run(['s3', 'cp',
+                                'cos://us-south/bkt/sub/key', '/d'],
+                               check=False)
+        assert 's3://bkt/sub/key' in ' '.join(calls[-1])
+
+    def test_upload_applies_skyignore(self, monkeypatch, tmp_path):
+        (tmp_path / '.skyignore').write_text('*.log\n')
+        (tmp_path / 'keep.txt').write_text('x')
+        calls = []
+        monkeypatch.setattr(
+            subprocess, 'run',
+            lambda cmd, **k: (calls.append(cmd),
+                              subprocess.CompletedProcess(
+                                  cmd, 0, '', ''))[1])
+        store = storage_lib.IBMCosStore('bkt', str(tmp_path))
+        store.upload([str(tmp_path)])
+        flat = ' '.join(calls[0])
+        assert '--exclude' in flat and '*.log' in flat
+
+    def test_download_command(self):
+        from skypilot_tpu.data import cloud_stores
+        cmd = cloud_stores.make_download_command(
+            'cos://us-south/bkt/ckpt', '/ckpt')
+        assert '--endpoint-url https://s3.us-south.' in cmd
+        assert 's3 cp' in cmd and 's3://bkt/ckpt' in cmd
+
+
+class TestOciStore:
+
+    @pytest.fixture(autouse=True)
+    def _namespace(self, monkeypatch):
+        monkeypatch.setenv('OCI_NAMESPACE', 'mytenancy')
+
+    def test_oci_cli_lifecycle(self, cli, tmp_path):
+        (tmp_path / 'f.txt').write_text('x')
+        store = storage_lib.OciStore('bkt', str(tmp_path))
+        cli.returncode = 1
+        assert not store.exists()
+        cli.returncode = 0
+        store.create()
+        store.upload([str(tmp_path)])
+        store.delete()
+        flat = [' '.join(c) for c in cli.calls]
+        assert any('os bucket get --bucket-name bkt' in c
+                   for c in flat)
+        assert any('os bucket create --name bkt' in c for c in flat)
+        assert any('os object sync --bucket-name bkt --src-dir'
+                   in c for c in flat)
+        # Delete empties the bucket first (OCI requires empty).
+        assert any('os object bulk-delete' in c for c in flat)
+        assert any('os bucket delete --bucket-name bkt' in c
+                   for c in flat)
+
+    def test_compartment_passed_when_configured(self, cli,
+                                                monkeypatch):
+        monkeypatch.setenv('OCI_COMPARTMENT_ID', 'ocid1.compartment.x')
+        storage_lib.OciStore('bkt', None).create()
+        flat = ' '.join(cli.calls[0])
+        assert '--compartment-id ocid1.compartment.x' in flat
+
+    def test_sync_and_mount_commands(self):
+        store = storage_lib.OciStore('bkt', None)
+        sync = store.make_sync_dir_command('/data')
+        assert 'oci os object sync --bucket-name bkt --dest-dir ' \
+            '/data' in sync
+        mount = store.make_mount_command('/mnt/oci')
+        assert 'rclone mount' in mount
+        assert 'mytenancy.compat.objectstorage.' in mount
+
+    def test_storage_routes_oci_scheme(self):
+        s = storage_lib.Storage(source='oci://my-bucket/prefix')
+        assert s.store_type == storage_lib.StoreType.OCI
+        assert s.name == 'my-bucket'
+        assert isinstance(s.get_store(), storage_lib.OciStore)
+
+    def test_missing_namespace_is_clear_error(self, monkeypatch):
+        monkeypatch.delenv('OCI_NAMESPACE')
+        with pytest.raises(exceptions.StorageError, match='namespace'):
+            storage_lib.OciStore('bkt', None).make_mount_command('/m')
+
+    def test_upload_applies_skyignore(self, monkeypatch, tmp_path):
+        (tmp_path / '.skyignore').write_text('secret/\n')
+        calls = []
+        monkeypatch.setattr(
+            subprocess, 'run',
+            lambda cmd, **k: (calls.append(cmd),
+                              subprocess.CompletedProcess(
+                                  cmd, 0, '', ''))[1])
+        store = storage_lib.OciStore('bkt', str(tmp_path))
+        store.upload([str(tmp_path)])
+        flat = ' '.join(calls[0])
+        assert '--exclude' in flat and 'secret' in flat
+
+    def test_download_commands(self):
+        from skypilot_tpu.data import cloud_stores
+        cmd = cloud_stores.make_download_command('oci://bkt/ckpt',
+                                                 '/ckpt')
+        assert 'oci os object get --bucket-name bkt' in cmd
+        assert '--name ckpt' in cmd
+        whole = cloud_stores.make_download_command('oci://bkt', '/d')
+        assert 'oci os object sync --bucket-name bkt' in whole
+
+    def test_yaml_roundtrip_and_ls(self, cli, monkeypatch):
+        s = storage_lib.Storage.from_yaml_config(
+            {'name': 'mybkt', 'store': 'oci', 'mode': 'COPY'})
+        assert s.store_type == storage_lib.StoreType.OCI
+        assert s.to_yaml_config()['store'] == 'OCI'
+        # storage state round-trips through ls/delete handles.
+        from skypilot_tpu import global_user_state
+        s.sync_local_source()
+        records = {r['name']: r
+                   for r in global_user_state.get_storage()}
+        assert records['mybkt']['handle']['store'] == 'OCI'
+        restored = storage_lib.Storage.from_handle(
+            records['mybkt']['handle'])
+        assert isinstance(restored.get_store(), storage_lib.OciStore)
+        restored.delete()
+        global_user_state.remove_storage('mybkt')
+
+
 class TestStoragePerfSmoke:
 
     def test_local_dir_numbers_are_sane(self, tmp_path):
