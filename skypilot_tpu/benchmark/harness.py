@@ -50,6 +50,10 @@ def launch(task, candidates: List[Dict[str, Any]], benchmark: str,
 
     if not candidates:
         raise exceptions.TaskValidationError('no benchmark candidates')
+    # Relaunching a name replaces its record wholesale: stale runs
+    # from a previous (possibly wider) launch would otherwise linger
+    # as phantom candidates now that `down` keeps records.
+    bench_state.delete_benchmark(benchmark)
     base_config = task.to_yaml_config()
 
     clusters: List[str] = []
@@ -131,6 +135,11 @@ def status(benchmark: str) -> List[Dict[str, Any]]:
         # nonce in the log path; no wall-clock filter (cluster clocks
         # may be skewed vs this client).
         records = _fetch_step_records(run)
+        if not records and run.get('results'):
+            # Cluster gone (post-down): serve the snapshot taken at
+            # teardown instead of an empty shell.
+            results.append(run['results'])
+            continue
         entry: Dict[str, Any] = {
             'cluster': run['cluster'],
             'resources': run['resources'],
@@ -163,8 +172,20 @@ def status(benchmark: str) -> List[Dict[str, Any]]:
 
 
 def down(benchmark: str, *, purge: bool = False) -> None:
-    """Tear down every candidate cluster of a benchmark."""
+    """Tear down every candidate cluster of a benchmark.  The RECORDS
+    survive (reference: `sky benchmark-down` vs `benchmark-delete`,
+    cli.py:4723-5163) — the metrics are SNAPSHOTTED onto the records
+    first, because the step logs they derive from die with the
+    clusters; results stay queryable via `bench ls`/`status` until an
+    explicit `bench delete`."""
     from skypilot_tpu import core
+    try:
+        for entry in status(benchmark):
+            bench_state.set_run_results(benchmark, entry['cluster'],
+                                        entry)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(f'could not snapshot {benchmark!r} results '
+                       f'before teardown: {e}')
     for run in bench_state.get_runs(benchmark):
         try:
             core.down(run['cluster'])
@@ -172,7 +193,6 @@ def down(benchmark: str, *, purge: bool = False) -> None:
             if not purge:
                 raise
             logger.warning(f'down {run["cluster"]} failed: {e}')
-    bench_state.delete_benchmark(benchmark)
 
 
 def wait_for_steps(benchmark: str, min_steps: int,

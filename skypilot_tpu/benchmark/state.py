@@ -23,6 +23,7 @@ CREATE TABLE IF NOT EXISTS benchmark_runs (
     job_id INTEGER,
     launched_at REAL,
     log_path TEXT,
+    results_json TEXT,
     PRIMARY KEY (benchmark, cluster)
 );
 """
@@ -43,6 +44,9 @@ def _conn() -> sqlite3.Connection:
     if 'log_path' not in cols:  # migrate pre-log_path DBs
         conn.execute(
             'ALTER TABLE benchmark_runs ADD COLUMN log_path TEXT')
+    if 'results_json' not in cols:  # migrate pre-snapshot DBs
+        conn.execute(
+            'ALTER TABLE benchmark_runs ADD COLUMN results_json TEXT')
     conn.commit()
     _conn_local.conn = conn
     _conn_local.path = path
@@ -65,7 +69,9 @@ def add_run(benchmark: str, cluster: str, resources: Dict[str, Any],
     provision-to-first-step latency can be derived from step logs."""
     conn = _conn()
     conn.execute(
-        'INSERT OR REPLACE INTO benchmark_runs VALUES (?, ?, ?, ?, ?, ?)',
+        'INSERT OR REPLACE INTO benchmark_runs '
+        '(benchmark, cluster, resources_json, job_id, launched_at, '
+        'log_path) VALUES (?, ?, ?, ?, ?, ?)',
         (benchmark, cluster, json.dumps(resources), job_id,
          started_at if started_at is not None else time.time(),
          log_path))
@@ -79,12 +85,26 @@ def get_benchmarks() -> List[str]:
 
 def get_runs(benchmark: str) -> List[Dict[str, Any]]:
     rows = _conn().execute(
-        'SELECT cluster, resources_json, job_id, launched_at, log_path '
+        'SELECT cluster, resources_json, job_id, launched_at, '
+        'log_path, results_json '
         'FROM benchmark_runs WHERE benchmark = ? ORDER BY cluster',
         (benchmark,)).fetchall()
     return [{'cluster': c, 'resources': json.loads(r), 'job_id': j,
-             'launched_at': t, 'log_path': p}
-            for c, r, j, t, p in rows]
+             'launched_at': t, 'log_path': p,
+             'results': json.loads(res) if res else None}
+            for c, r, j, t, p, res in rows]
+
+
+def set_run_results(benchmark: str, cluster: str,
+                    results: Dict[str, Any]) -> None:
+    """Snapshot computed metrics onto the run record so results stay
+    queryable after the cluster (and its step logs) are gone."""
+    conn = _conn()
+    conn.execute(
+        'UPDATE benchmark_runs SET results_json = ? '
+        'WHERE benchmark = ? AND cluster = ?',
+        (json.dumps(results), benchmark, cluster))
+    conn.commit()
 
 
 def delete_benchmark(name: str) -> None:

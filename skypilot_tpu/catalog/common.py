@@ -107,6 +107,22 @@ def parse_bound(request) -> 'tuple[Optional[float], bool]':
     return float(s), False
 
 
+VMS_CSV_HEADER = ('instance_type,vcpus,memory_gb,accelerator_name,'
+                  'accelerator_count,price,spot_price')
+
+
+def rows_to_vms_csv(rows) -> str:
+    """Serialize fetcher row dicts into the shared vms-table CSV —
+    ONE copy of the column order every per-cloud catalog reads."""
+    lines = [VMS_CSV_HEADER]
+    for r in rows:
+        lines.append(f"{r['instance_type']},{r['vcpus']},"
+                     f"{r['memory_gb']},{r['accelerator_name']},"
+                     f"{r['accelerator_count']},{r['price']},"
+                     f"{r['spot_price']}")
+    return '\n'.join(lines) + '\n'
+
+
 def pick_default_instance_type(df, cpus: Optional[str],
                                memory: Optional[str],
                                min_default_vcpus: int = 8,

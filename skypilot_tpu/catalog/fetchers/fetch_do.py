@@ -77,14 +77,7 @@ def fetch_and_write(fetch_page: Optional[Callable[[int],
     if not rows:
         raise RuntimeError('DigitalOcean sizes API returned no usable '
                            'sizes; keeping the previous table.')
-    lines = ['instance_type,vcpus,memory_gb,accelerator_name,'
-             'accelerator_count,price,spot_price']
-    for r in rows:
-        lines.append(f"{r['instance_type']},{r['vcpus']},"
-                     f"{r['memory_gb']},{r['accelerator_name']},"
-                     f"{r['accelerator_count']},{r['price']},"
-                     f"{r['spot_price']}")
     path = common.write_catalog_csv('do', 'vms',
-                                    '\n'.join(lines) + '\n')
+                                    common.rows_to_vms_csv(rows))
     do_catalog.reload()
     return {'vms': path}

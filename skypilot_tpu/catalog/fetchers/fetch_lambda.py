@@ -96,14 +96,7 @@ def fetch_and_write(fetch_json: Optional[Callable[[str],
     if not rows:
         raise RuntimeError('Lambda instance-types API returned no '
                            'types; keeping the previous table.')
-    lines = ['instance_type,vcpus,memory_gb,accelerator_name,'
-             'accelerator_count,price,spot_price']
-    for r in rows:
-        lines.append(f"{r['instance_type']},{r['vcpus']},"
-                     f"{r['memory_gb']},{r['accelerator_name']},"
-                     f"{r['accelerator_count']},{r['price']},"
-                     f"{r['spot_price']}")
     path = common.write_catalog_csv('lambda', 'vms',
-                                    '\n'.join(lines) + '\n')
+                                    common.rows_to_vms_csv(rows))
     lambda_catalog.reload()
     return {'vms': path}

@@ -97,14 +97,7 @@ def fetch_and_write(run_query: Optional[Callable[[str],
     if not rows:
         raise RuntimeError('RunPod gpuTypes query returned nothing '
                            'usable; keeping the previous table.')
-    lines = ['instance_type,vcpus,memory_gb,accelerator_name,'
-             'accelerator_count,price,spot_price']
-    for r in rows:
-        lines.append(f"{r['instance_type']},{r['vcpus']},"
-                     f"{r['memory_gb']},{r['accelerator_name']},"
-                     f"{r['accelerator_count']},{r['price']},"
-                     f"{r['spot_price']}")
     path = common.write_catalog_csv('runpod', 'vms',
-                                    '\n'.join(lines) + '\n')
+                                    common.rows_to_vms_csv(rows))
     runpod_catalog.reload()
     return {'vms': path}

@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import click
@@ -812,6 +813,49 @@ def bench_down(benchmark, purge, yes) -> None:
                       default=True, abort=True)
     harness.down(benchmark, purge=purge)
     click.echo(f'Benchmark {benchmark!r} torn down.')
+
+
+@bench.command(name='ls')
+def bench_ls() -> None:
+    """List recorded benchmarks (reference: `sky benchmark-ls`,
+    cli.py:4723).  Records survive `bench down` — results stay
+    queryable after the clusters are gone."""
+    from skypilot_tpu.benchmark import state as bench_state
+    rows = []
+    for name in bench_state.get_benchmarks():
+        runs = bench_state.get_runs(name)
+        launched = min((r['launched_at'] for r in runs
+                        if r['launched_at']), default=None)
+        rows.append((
+            name, len(runs),
+            ', '.join(sorted(r['cluster'] for r in runs)) or '-',
+            time.strftime('%Y-%m-%d %H:%M',
+                          time.localtime(launched))
+            if launched else '-'))
+    _print_table(('BENCHMARK', 'CANDIDATES', 'CLUSTERS', 'LAUNCHED'),
+                 rows)
+
+
+@bench.command(name='delete')
+@click.argument('benchmarks', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_delete(benchmarks, yes) -> None:
+    """Delete recorded benchmark results (reference:
+    `sky benchmark-delete`, cli.py:5100).  Records only — clusters are
+    torn down by `bench down`."""
+    from skypilot_tpu.benchmark import state as bench_state
+    known = set(bench_state.get_benchmarks())
+    missing = [b for b in benchmarks if b not in known]
+    if missing:
+        raise click.UsageError(
+            f'No such benchmark record(s): {", ".join(missing)}')
+    if not yes:
+        click.confirm(
+            f'Delete benchmark record(s) {", ".join(benchmarks)}?',
+            default=True, abort=True)
+    for name in benchmarks:
+        bench_state.delete_benchmark(name)
+        click.echo(f'Deleted benchmark record {name!r}.')
 
 
 def _print_table(headers: Tuple[str, ...], rows: List[Tuple]) -> None:

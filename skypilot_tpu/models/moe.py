@@ -36,6 +36,14 @@ class MoEConfig(llama.LlamaConfig):
     # capacity per expert = capacity_factor * tokens * k / E.
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.02
+    # 'dense': GShard one-hot einsum dispatch — [T, E, C] dispatch/
+    # combine tensors, O(k*T^2*D) FLOPs and O(k*T^2) memory in the
+    # token count (fine at small scale, a real ceiling at 8x7B).
+    # 'sparse': sort-by-expert + capacity scatter/segment-add — static
+    # shapes (argsort + scatter, no ragged ops), identical routing
+    # semantics (same choice-major intra-expert ordering, same
+    # capacity drops), FLOPs linear in tokens and flat in E.
+    moe_dispatch: str = 'dense'
 
 
 CONFIGS: Dict[str, MoEConfig] = {
@@ -68,6 +76,12 @@ class MoEMLP(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.config
+        if cfg.moe_dispatch not in ('dense', 'sparse'):
+            # A typo must not silently run the O(T^2) dense path the
+            # user was trying to avoid.
+            raise ValueError(
+                f"moe_dispatch must be 'dense' or 'sparse', got "
+                f'{cfg.moe_dispatch!r}')
         b, s, d = x.shape
         n_exp, k = cfg.n_experts, cfg.experts_per_token
         tokens = b * s
@@ -87,40 +101,67 @@ class MoEMLP(nn.Module):
         # Mixtral renormalizes the top-k gate weights.
         gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
 
-        # Position of each (token, choice) in its expert's buffer:
-        # running count of prior assignments to the same expert, counted
-        # over the flattened (choice-major) assignment list so the two
-        # choices of one token never collide.
-        assign = jax.nn.one_hot(expert_idx, n_exp,
-                                dtype=jnp.int32)             # [T, k, E]
-        flat_assign = assign.transpose(1, 0, 2).reshape(
-            k * tokens, n_exp)                               # [kT, E]
-        pos_flat = jnp.cumsum(flat_assign, axis=0) - flat_assign
-        position = jnp.einsum('fe,fe->f', pos_flat,
-                              flat_assign).reshape(k, tokens)
-        position = position.T                                 # [T, k]
-        keep = position < capacity
-
         # Load-balance aux loss (Switch): mean gate fraction * mean
         # dispatch fraction per expert, scaled by E.
+        assign = jax.nn.one_hot(expert_idx, n_exp,
+                                dtype=jnp.int32)             # [T, k, E]
         me = jnp.mean(probs, axis=0)
         ce = jnp.mean(assign.sum(1).astype(jnp.float32), axis=0)
         aux = cfg.router_aux_coef * n_exp * jnp.sum(me * ce)
         self.sow('intermediates', 'aux_loss', aux)
 
-        # Dense dispatch/combine tensors.
-        pos_oh = jax.nn.one_hot(jnp.where(keep, position, capacity),
-                                capacity, dtype=xf.dtype)    # [T, k, C]
-        disp = jnp.einsum('tke,tkc->tec',
-                          assign.astype(xf.dtype), pos_oh)   # [T, E, C]
-        comb = jnp.einsum('tec,tk,tke->tec', disp,
-                          gate_vals.astype(xf.dtype),
-                          assign.astype(xf.dtype))           # weighted
-
         from skypilot_tpu.parallel import sharding as sharding_lib
-        expert_in = jnp.einsum('tec,td->ecd', disp, xf)      # [E, C, D]
-        # Pin the expert-parallel layout: XLA turns the dispatch einsum
-        # into an all-to-all over the expert axis.
+        if cfg.moe_dispatch == 'sparse':
+            # Sort-based dispatch: O(kT log kT + kT*D) instead of the
+            # dense path's O(kT^2*D) einsums / [T, E, C] residency.
+            # Choice-major flattening matches the dense path's
+            # intra-expert ordering exactly, so capacity drops (and
+            # therefore outputs) are identical.
+            flat_e = expert_idx.T.reshape(k * tokens)        # [kT]
+            flat_t = jnp.tile(jnp.arange(tokens), k)         # [kT]
+            flat_g = gate_vals.T.reshape(k * tokens)         # [kT]
+            order = jnp.argsort(flat_e, stable=True)
+            sort_e = flat_e[order]
+            sort_t = flat_t[order]
+            sort_g = flat_g[order]
+            # Position within the expert's buffer: index in the sorted
+            # list minus the expert's first index.
+            first = jnp.searchsorted(sort_e, sort_e, side='left')
+            pos = jnp.arange(k * tokens) - first
+            keep_s = pos < capacity
+            # Scatter kept rows into the [E*C, D] expert buffers;
+            # overflow rows get an out-of-range index and mode='drop'.
+            flat_idx = jnp.where(keep_s, sort_e * capacity + pos,
+                                 n_exp * capacity)
+            expert_in = jnp.zeros((n_exp * capacity, d), xf.dtype)
+            expert_in = expert_in.at[flat_idx].set(
+                xf[sort_t], mode='drop').reshape(
+                    n_exp, capacity, d)
+        else:
+            # Position of each (token, choice) in its expert's buffer:
+            # running count of prior assignments to the same expert,
+            # counted over the flattened (choice-major) assignment
+            # list so the two choices of one token never collide.
+            flat_assign = assign.transpose(1, 0, 2).reshape(
+                k * tokens, n_exp)                           # [kT, E]
+            pos_flat = jnp.cumsum(flat_assign, axis=0) - flat_assign
+            position = jnp.einsum('fe,fe->f', pos_flat,
+                                  flat_assign).reshape(k, tokens)
+            position = position.T                             # [T, k]
+            keep = position < capacity
+
+            # Dense dispatch/combine tensors.
+            pos_oh = jax.nn.one_hot(
+                jnp.where(keep, position, capacity),
+                capacity, dtype=xf.dtype)                    # [T, k, C]
+            disp = jnp.einsum('tke,tkc->tec',
+                              assign.astype(xf.dtype), pos_oh)
+            comb = jnp.einsum('tec,tk,tke->tec', disp,
+                              gate_vals.astype(xf.dtype),
+                              assign.astype(xf.dtype))       # weighted
+            expert_in = jnp.einsum('tec,td->ecd', disp, xf)  # [E, C, D]
+        # Pin the expert-parallel layout: XLA turns the dispatch
+        # (einsum or scatter) into an all-to-all over the expert axis.
         expert_in = sharding_lib.maybe_constraint(
             expert_in, jax.sharding.PartitionSpec('expert', None, None))
 
@@ -151,8 +192,19 @@ class MoEMLP(nn.Module):
         expert_out = jnp.einsum('ecf,efd->ecd', act,
                                 down_p.astype(cfg.dtype))    # [E, C, D]
 
-        out = jnp.einsum('tec,ecd->td', comb.astype(cfg.dtype),
-                         expert_out)
+        if cfg.moe_dispatch == 'sparse':
+            # Combine: gather each kept assignment's expert output and
+            # segment-add it back onto its token, gate-weighted.
+            flat_out = expert_out.reshape(n_exp * capacity, d)
+            gathered = flat_out.at[flat_idx].get(
+                mode='fill', fill_value=0)                   # [kT, D]
+            weighted = gathered * (sort_g *
+                                   keep_s)[:, None].astype(cfg.dtype)
+            out = jnp.zeros((tokens, d), cfg.dtype).at[sort_t].add(
+                weighted)
+        else:
+            out = jnp.einsum('tec,ecd->td', comb.astype(cfg.dtype),
+                             expert_out)
         return out.reshape(b, s, d)
 
 

@@ -53,11 +53,60 @@ class TestBenchmarkHarness:
             assert r['provision_to_first_step'] is not None
             assert 0 < r['provision_to_first_step'] < 120
         harness.down('unittest')
+        # Records SURVIVE down (reference benchmark-down vs -delete)
+        # WITH their metrics: down snapshots status() onto the rows
+        # before teardown, so results stay queryable after the
+        # clusters (and their step logs) are gone.
+        assert len(bench_state.get_runs('unittest')) == 2
+        assert 'unittest' in bench_state.get_benchmarks()
+        post = harness.status('unittest')
+        assert len(post) == 2
+        for r in post:
+            assert r['num_steps'] >= 5
+            assert r['secs_per_step'] is not None
+        bench_state.delete_benchmark('unittest')
         assert bench_state.get_runs('unittest') == []
+
+    def test_relaunch_replaces_stale_runs(self):
+        bench_state.add_benchmark('b2', 'task: x')
+        for i in range(3):
+            bench_state.add_run('b2', f'skytpu-bench-b2-{i}', {},
+                                job_id=i)
+        task = sky.Task(run=_STEP_SCRIPT)
+        task.set_resources(sky.Resources(cloud='local'))
+        clusters = harness.launch(task, [{}], 'b2', detach=True)
+        try:
+            # The previous launch's wider candidate set must not
+            # linger as phantom rows.
+            assert len(bench_state.get_runs('b2')) == 1
+            assert bench_state.get_runs('b2')[0]['cluster'] == \
+                clusters[0]
+        finally:
+            harness.down('b2')
+            bench_state.delete_benchmark('b2')
 
     def test_unknown_benchmark(self):
         with pytest.raises(exceptions.BenchmarkError):
             harness.status('nope')
+
+    def test_cli_ls_and_delete(self):
+        from click.testing import CliRunner
+        from skypilot_tpu import cli as cli_mod
+        bench_state.add_benchmark('b1', 'task: x')
+        bench_state.add_run('b1', 'b1-0', {'accelerators': 'tpu-v5e-8'},
+                            job_id=1)
+        runner = CliRunner()
+        out = runner.invoke(cli_mod.cli, ['bench', 'ls'])
+        assert out.exit_code == 0, out.output
+        assert 'b1' in out.output and 'b1-0' in out.output
+        out = runner.invoke(cli_mod.cli,
+                            ['bench', 'delete', 'b1', '--yes'])
+        assert out.exit_code == 0, out.output
+        assert bench_state.get_benchmarks() == []
+        out = runner.invoke(cli_mod.cli,
+                            ['bench', 'delete', 'nope', '--yes'])
+        assert out.exit_code != 0
+        assert 'No such benchmark' in out.output
 
 
 class TestBenchE2E:

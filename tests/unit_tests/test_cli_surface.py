@@ -31,7 +31,7 @@ GROUPS = {
     'serve': ['up', 'status', 'update', 'logs', 'down'],
     'storage': [],
     'catalog': ['update'],
-    'bench': [],
+    'bench': ['launch', 'status', 'down', 'ls', 'delete'],
 }
 
 

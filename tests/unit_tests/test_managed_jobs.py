@@ -6,6 +6,7 @@ Hermetic analog of the reference's managed-job smoke tests
 process clusters and preemption = terminating the cluster's instances
 through the provisioner API out from under the controller.
 """
+import os
 import time
 
 import pytest
@@ -38,8 +39,21 @@ def _local_task(run, name=None, **kwargs):
     return t
 
 
+def _load_factor() -> float:
+    """Suite-load-aware timeout scaling (round-3 verdict: the recovery
+    capstone passes isolated in ~1 min but timed out under the full
+    26-minute suite's machine load).  Timeouts are budgets, not
+    expectations — a green run never waits them out — so scale them
+    up when the 1-minute load average exceeds the core count."""
+    try:
+        per_core = os.getloadavg()[0] / max(os.cpu_count() or 1, 1)
+    except OSError:
+        return 1.0
+    return min(4.0, max(1.0, per_core))
+
+
 def _wait(pred, timeout=60, gap=0.2, desc='condition'):
-    deadline = time.time() + timeout
+    deadline = time.time() + timeout * _load_factor()
     while time.time() < deadline:
         if pred():
             return
@@ -210,9 +224,12 @@ class TestTrainerRecoveryCapstone:
         local_instance.terminate_instances(
             record['handle'].cluster_name_on_cloud)
         _wait(lambda: _task_row(job_id)['recovery_count'] >= 1,
-              timeout=180, gap=0.5, desc='recovery')
+              timeout=300, gap=0.5, desc='recovery')
+        # Relaunch cost (provision + agent + jax startup) is machine-
+        # load-dependent: the budget is generous AND load-scaled (a
+        # green run returns as soon as the transition lands).
         _wait(lambda: _task_row(job_id)['status'] ==
-              jobs.ManagedJobStatus.RUNNING, timeout=120, gap=0.5,
+              jobs.ManagedJobStatus.RUNNING, timeout=300, gap=0.5,
               desc='RUNNING after recovery')
 
         # The recovered incarnation restored step 6 (its log says so)
