@@ -50,10 +50,19 @@ def launch(task, candidates: List[Dict[str, Any]], benchmark: str,
 
     if not candidates:
         raise exceptions.TaskValidationError('no benchmark candidates')
-    # Relaunching a name replaces its record wholesale: stale runs
-    # from a previous (possibly wider) launch would otherwise linger
-    # as phantom candidates now that `down` keeps records.
-    bench_state.delete_benchmark(benchmark)
+    # Relaunching a name replaces its record — but never out from
+    # under LIVE clusters (they would keep billing with no
+    # bench-level handle), and never before the new launch succeeds
+    # (a failed relaunch must not destroy the preserved snapshots).
+    from skypilot_tpu import global_user_state
+    prior = bench_state.get_runs(benchmark)
+    live_prior = [r['cluster'] for r in prior
+                  if global_user_state.get_cluster_from_name(
+                      r['cluster']) is not None]
+    if live_prior:
+        raise exceptions.BenchmarkError(
+            f'benchmark {benchmark!r} still has live clusters '
+            f'{live_prior}; run `bench down {benchmark}` first.')
     base_config = task.to_yaml_config()
 
     clusters: List[str] = []
@@ -90,6 +99,12 @@ def launch(task, candidates: List[Dict[str, Any]], benchmark: str,
     finally:
         if bench_state.get_runs(benchmark):
             bench_state.add_benchmark(benchmark, json.dumps(base_config))
+    # All launches succeeded: NOW prune rows from a previous (wider)
+    # launch so they don't linger as phantom candidates.
+    new_names = set(clusters)
+    for run in prior:
+        if run['cluster'] not in new_names:
+            bench_state.delete_run(benchmark, run['cluster'])
     logger.info(f'benchmark {benchmark!r}: launched {len(clusters)} '
                 f'candidates: {clusters}')
     return clusters

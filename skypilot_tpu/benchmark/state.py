@@ -107,6 +107,14 @@ def set_run_results(benchmark: str, cluster: str,
     conn.commit()
 
 
+def delete_run(benchmark: str, cluster: str) -> None:
+    conn = _conn()
+    conn.execute(
+        'DELETE FROM benchmark_runs WHERE benchmark = ? AND '
+        'cluster = ?', (benchmark, cluster))
+    conn.commit()
+
+
 def delete_benchmark(name: str) -> None:
     conn = _conn()
     conn.execute('DELETE FROM benchmarks WHERE name = ?', (name,))

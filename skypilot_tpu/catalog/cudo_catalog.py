@@ -1,0 +1,25 @@
+"""Cudo Compute catalog (reference service_catalog cudo tier).
+
+Instance-type grammar keeps the reference's
+`<machine_type>_<gpu>x<vcpu>v<mem>gb` (fetch_cudo.py:43-46) so specs
+decompose back into the VM-create API's fields.
+"""
+from skypilot_tpu.catalog import flat
+
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+epyc-milan_0x8v32gb,8,32,,0,0.12,0.12
+epyc-milan_0x16v64gb,16,64,,0,0.24,0.24
+epyc-milan-rtx-a4000_1x4v16gb,4,16,RTXA4000,1,0.35,0.35
+epyc-milan-rtx-a5000_1x8v32gb,8,32,RTXA5000,1,0.55,0.55
+epyc-milan-rtx-a6000_1x8v48gb,8,48,RTXA6000,1,0.85,0.85
+epyc-milan-rtx-a6000_4x32v192gb,32,192,RTXA6000,4,3.40,3.40
+sapphire-rapids-h100_1x24v96gb,24,96,H100,1,2.79,2.79
+sapphire-rapids-h100_8x192v768gb,192,768,H100,8,22.32,22.32
+"""
+
+CATALOG = flat.FlatCatalog(
+    'cudo', _VMS_CSV,
+    regions=['no-luster-1', 'se-smedjebacken-1', 'gb-london-1',
+             'us-newyork-1', 'au-melbourne-1'],
+    snapshot_date='2025-03-01', display_name='Cudo')

@@ -1,0 +1,156 @@
+"""FlatCatalog: the shared engine behind every minor-cloud catalog.
+
+The minor-cloud tail (Lambda/RunPod/DO/FluidStack/Cudo/Paperspace/
+IBM/OCI/SCP/vSphere — reference sky/clouds/service_catalog/*_catalog.py)
+all price from one flat vms table: instance_type, shape, accelerator,
+price, spot_price.  One class holds the selection/pricing logic; each
+per-cloud catalog is just a CSV snapshot + a region list + flags.
+"""
+from __future__ import annotations
+
+import io
+import typing
+from typing import Dict, List, Optional, Sequence, Tuple
+
+if typing.TYPE_CHECKING:
+    import pandas as pd
+
+from skypilot_tpu import exceptions
+
+_VM_COLUMNS = ['instance_type', 'vcpus', 'memory_gb',
+               'accelerator_name', 'accelerator_count', 'price',
+               'spot_price']
+
+
+class FlatCatalog:
+    """Flat per-type pricing over a vms CSV with the standard columns.
+
+    cache-dir overrides (`~/.skytpu/catalogs/v1/<cloud>/vms.csv`) and
+    snapshot-staleness warnings ride catalog/common.py exactly like
+    the hand-written major catalogs.
+    """
+
+    def __init__(self, cloud: str, vms_csv: str,
+                 regions: Sequence[str], snapshot_date: str,
+                 *, has_spot: bool = False,
+                 gpu_only: bool = False,
+                 display_name: Optional[str] = None) -> None:
+        self.cloud = cloud
+        self.display_name = display_name or cloud
+        self._vms_csv = vms_csv
+        self._regions = list(regions)
+        self.SNAPSHOT_DATE = snapshot_date
+        self.has_spot = has_spot
+        self.gpu_only = gpu_only
+        self._df: Optional['pd.DataFrame'] = None
+
+    # -- table ------------------------------------------------------------
+    def _vm_df(self) -> 'pd.DataFrame':
+        if self._df is None:
+            import pandas as pd
+
+            from skypilot_tpu.catalog import common
+            self._df = common.read_catalog_csv(self.cloud, 'vms',
+                                               _VM_COLUMNS)
+            if self._df is None:
+                common.warn_if_snapshot_stale(self.cloud,
+                                              self.SNAPSHOT_DATE)
+                self._df = pd.read_csv(io.StringIO(self._vms_csv))
+        return self._df
+
+    def reload(self) -> None:
+        self._df = None
+
+    def export_snapshot(self) -> Dict[str, str]:
+        return {'vms': self._vm_df().to_csv(index=False)}
+
+    # -- lookups ----------------------------------------------------------
+    def regions(self) -> List[str]:
+        return list(self._regions)
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        df = self._vm_df()
+        return bool((df['instance_type'] == instance_type).any())
+
+    def _row(self, instance_type: str):
+        df = self._vm_df()
+        rows = df[df['instance_type'] == instance_type]
+        if rows.empty:
+            raise exceptions.ResourcesUnavailableError(
+                f'No {self.display_name} instance type '
+                f'{instance_type!r}; have '
+                f'{sorted(df["instance_type"])}')
+        return rows.iloc[0]
+
+    def get_hourly_cost(self, instance_type: str, use_spot: bool,
+                        region: Optional[str] = None,
+                        zone: Optional[str] = None) -> float:
+        del region, zone  # flat pricing across regions
+        row = self._row(instance_type)
+        if use_spot and self.has_spot:
+            return float(row['spot_price'])
+        return float(row['price'])
+
+    def get_vcpus_mem_from_instance_type(
+            self, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        row = self._row(instance_type)
+        return float(row['vcpus']), float(row['memory_gb'])
+
+    def get_accelerators_from_instance_type(
+            self, instance_type: str) -> Optional[Dict[str, int]]:
+        row = self._row(instance_type)
+        if not row['accelerator_name'] or \
+                str(row['accelerator_name']) == 'nan':
+            return None
+        return {str(row['accelerator_name']):
+                int(row['accelerator_count'])}
+
+    def get_default_instance_type(self, cpus: Optional[str] = None,
+                                  memory: Optional[str] = None,
+                                  disk_tier: Optional[str] = None
+                                  ) -> Optional[str]:
+        del disk_tier
+        from skypilot_tpu.catalog import common
+        return common.pick_default_instance_type(
+            self._vm_df(), cpus, memory,
+            allow_accelerators=self.gpu_only)
+
+    def get_instance_type_for_accelerator(
+            self, acc_name: str, acc_count: int) -> List[str]:
+        df = self._vm_df()
+        rows = df[(df['accelerator_name'] == acc_name)
+                  & (df['accelerator_count'] == acc_count)]
+        return list(rows.sort_values(['price', 'instance_type'])
+                    ['instance_type'])
+
+    def get_accelerator_hourly_cost(self, acc_name: str,
+                                    acc_count: int, use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None
+                                    ) -> float:
+        types = self.get_instance_type_for_accelerator(acc_name,
+                                                       acc_count)
+        if not types:
+            raise exceptions.ResourcesUnavailableError(
+                f'No {self.display_name} instance type offers '
+                f'{acc_name}:{acc_count}.')
+        return min(self.get_hourly_cost(t, use_spot, region, zone)
+                   for t in types)
+
+    def list_accelerators(self, name_filter: Optional[str] = None
+                          ) -> Dict[str, List[Dict[str, object]]]:
+        df = self._vm_df()
+        out: Dict[str, List[Dict[str, object]]] = {}
+        for _, row in df[df['accelerator_count'] > 0].iterrows():
+            name = str(row['accelerator_name'])
+            if name_filter and \
+                    name_filter.lower() not in name.lower():
+                continue
+            out.setdefault(name, []).append({
+                'accelerator_count': int(row['accelerator_count']),
+                'instance_type': str(row['instance_type']),
+                'price': float(row['price']),
+                'spot_price': float(row['spot_price']),
+            })
+        return out

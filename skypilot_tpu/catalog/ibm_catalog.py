@@ -1,0 +1,26 @@
+"""IBM Cloud VPC catalog (reference service_catalog ibm tier).
+
+VPC Gen2 profiles: bx2/cx2 CPU tiers + gx2/gx3 GPU profiles
+(V100 / L4 / L40S); flat hourly pricing, no spot.
+"""
+from skypilot_tpu.catalog import flat
+
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+bx2-8x32,8,32,,0,0.384,0.384
+bx2-16x64,16,64,,0,0.768,0.768
+cx2-8x16,8,16,,0,0.336,0.336
+cx2-16x32,16,32,,0,0.672,0.672
+gx2-8x64x1v100,8,64,V100,1,2.48,2.48
+gx2-16x128x2v100,16,128,V100,2,4.96,4.96
+gx3-16x80x1l4,16,80,L4,1,1.40,1.40
+gx3-32x160x2l4,32,160,L4,2,2.80,2.80
+gx3-24x120x1l40s,24,120,L40S,1,2.13,2.13
+gx3-48x240x2l40s,48,240,L40S,2,4.26,4.26
+"""
+
+CATALOG = flat.FlatCatalog(
+    'ibm', _VMS_CSV,
+    regions=['us-south', 'us-east', 'eu-gb', 'eu-de', 'jp-tok',
+             'au-syd', 'ca-tor', 'br-sao'],
+    snapshot_date='2025-03-01', display_name='IBM')

@@ -1,0 +1,24 @@
+"""OCI catalog (reference service_catalog oci tier).
+
+Flexible E4/E5 CPU shapes (fixed popular sizes snapshotted) + GPU
+shapes (A10 / A100 / H100).  OCI has preemptible capacity at a flat
+50% discount — has_spot with spot_price = price/2.
+"""
+from skypilot_tpu.catalog import flat
+
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+VM.Standard.E4.Flex-8-32,8,32,,0,0.20,0.10
+VM.Standard.E4.Flex-16-64,16,64,,0,0.40,0.20
+VM.Standard.E5.Flex-8-32,8,32,,0,0.24,0.12
+VM.GPU.A10.1,15,240,A10,1,2.00,1.00
+VM.GPU.A10.2,30,480,A10,2,4.00,2.00
+BM.GPU.A100-v2.8,128,2048,A100-80GB,8,32.00,16.00
+BM.GPU.H100.8,112,2048,H100,8,80.00,40.00
+"""
+
+CATALOG = flat.FlatCatalog(
+    'oci', _VMS_CSV,
+    regions=['us-ashburn-1', 'us-phoenix-1', 'eu-frankfurt-1',
+             'uk-london-1', 'ap-tokyo-1', 'ap-mumbai-1'],
+    snapshot_date='2025-03-01', has_spot=True, display_name='OCI')

@@ -1,0 +1,25 @@
+"""Paperspace catalog (reference service_catalog paperspace tier).
+
+Machine types are Paperspace's own names (C-series CPU, GPU+ /
+A4000-A100 GPU machines); flat hourly pricing, no spot.
+"""
+from skypilot_tpu.catalog import flat
+
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+C5,4,16,,0,0.08,0.08
+C7,12,30,,0,0.30,0.30
+P4000,8,30,P4000,1,0.51,0.51
+RTX4000,8,30,RTX4000,1,0.56,0.56
+A4000,8,45,RTXA4000,1,0.76,0.76
+A4000x2,16,90,RTXA4000,2,1.52,1.52
+A100,12,90,A100,1,3.09,3.09
+A100-80Gx8,96,640,A100-80GB,8,25.44,25.44
+H100,20,250,H100,1,5.95,5.95
+H100x8,128,1600,H100,8,47.60,47.60
+"""
+
+CATALOG = flat.FlatCatalog(
+    'paperspace', _VMS_CSV,
+    regions=['East Coast (NY2)', 'West Coast (CA1)', 'Europe (AMS1)'],
+    snapshot_date='2025-03-01', display_name='Paperspace')

@@ -1,0 +1,21 @@
+"""Samsung Cloud Platform catalog (reference service_catalog scp
+tier).  Standard/High-memory CPU servers + T4/V100 GPU servers; flat
+hourly pricing, no spot."""
+from skypilot_tpu.catalog import flat
+
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+s1v2m4,2,4,,0,0.059,0.059
+s1v8m16,8,16,,0,0.236,0.236
+s1v16m32,16,32,,0,0.472,0.472
+h1v8m64,8,64,,0,0.355,0.355
+g1v8m32t4,8,32,T4,1,0.756,0.756
+g1v16m64t4,16,64,T4,2,1.512,1.512
+g1v8m64v100,8,64,V100,1,2.10,2.10
+g1v32m256v100,32,256,V100,4,8.40,8.40
+"""
+
+CATALOG = flat.FlatCatalog(
+    'scp', _VMS_CSV,
+    regions=['KR-WEST-1', 'KR-EAST-1', 'KR-WEST-2'],
+    snapshot_date='2025-03-01', display_name='SCP')

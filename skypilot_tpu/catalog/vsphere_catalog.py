@@ -1,0 +1,24 @@
+"""vSphere catalog (reference service_catalog vsphere tier).
+
+On-prem vCenter: "instance types" are VM shape presets and the
+"price" is an internal chargeback anchor (the reference fetches real
+inventory with fetch_vsphere.py; here the standard preset table can
+be overridden per site via the catalog cache —
+~/.skytpu/catalogs/v1/vsphere/vms.csv).  Regions = datacenter names.
+"""
+from skypilot_tpu.catalog import flat
+
+_VMS_CSV = """\
+instance_type,vcpus,memory_gb,accelerator_name,accelerator_count,price,spot_price
+cpu-small,4,16,,0,0.05,0.05
+cpu-medium,8,32,,0,0.10,0.10
+cpu-large,16,64,,0,0.20,0.20
+gpu-t4-8x32,8,32,T4,1,0.40,0.40
+gpu-v100-8x64,8,64,V100,1,1.20,1.20
+gpu-a100-16x128,16,128,A100,1,2.40,2.40
+"""
+
+CATALOG = flat.FlatCatalog(
+    'vsphere', _VMS_CSV,
+    regions=['Datacenter'],
+    snapshot_date='2025-03-01', display_name='vSphere')

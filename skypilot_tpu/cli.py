@@ -311,16 +311,28 @@ def _show_accelerators(name_filter, include_gpus: bool) -> None:
     if include_gpus:
         from skypilot_tpu.catalog import aws_catalog
         from skypilot_tpu.catalog import azure_catalog
+        from skypilot_tpu.catalog import cudo_catalog
         from skypilot_tpu.catalog import do_catalog
         from skypilot_tpu.catalog import fluidstack_catalog
+        from skypilot_tpu.catalog import ibm_catalog
         from skypilot_tpu.catalog import lambda_catalog
+        from skypilot_tpu.catalog import oci_catalog
+        from skypilot_tpu.catalog import paperspace_catalog
         from skypilot_tpu.catalog import runpod_catalog
+        from skypilot_tpu.catalog import scp_catalog
+        from skypilot_tpu.catalog import vsphere_catalog
         for label, cat in (('AWS', aws_catalog),
                            ('Azure', azure_catalog),
                            ('Lambda', lambda_catalog),
                            ('RunPod', runpod_catalog),
                            ('DO', do_catalog),
-                           ('Fluidstack', fluidstack_catalog)):
+                           ('Fluidstack', fluidstack_catalog),
+                           ('Cudo', cudo_catalog.CATALOG),
+                           ('Paperspace', paperspace_catalog.CATALOG),
+                           ('IBM', ibm_catalog.CATALOG),
+                           ('OCI', oci_catalog.CATALOG),
+                           ('SCP', scp_catalog.CATALOG),
+                           ('vSphere', vsphere_catalog.CATALOG)):
             inv = cat.list_accelerators(name_filter)
             for name in sorted(inv):
                 for item in inv[name]:
@@ -350,6 +362,25 @@ def show_accelerators(name_filter) -> None:
     """List ALL accelerator offerings — TPU slices and GPU VMs — with
     pricing (reference: `sky show-gpus`)."""
     _show_accelerators(name_filter, include_gpus=True)
+
+
+def _catalog_for(cloud: str):
+    """Catalog object (module or FlatCatalog instance — both expose
+    reload/export_snapshot) for a cloud name; None if unknown."""
+    try:
+        if cloud in ('gcp', 'aws', 'azure', 'lambda', 'runpod', 'do',
+                     'fluidstack'):
+            import importlib
+            return importlib.import_module(
+                f'skypilot_tpu.catalog.{cloud}_catalog')
+        if cloud in ('cudo', 'paperspace', 'ibm', 'oci', 'scp',
+                     'vsphere'):
+            import importlib
+            return importlib.import_module(
+                f'skypilot_tpu.catalog.{cloud}_catalog').CATALOG
+    except ImportError:
+        return None
+    return None
 
 
 @cli.group()
@@ -399,29 +430,11 @@ def catalog_update(cloud, table, from_file, url, export, reset, fetch,
         for t, p in paths.items():
             click.echo(f'Fetched {t}: {p}')
         return
-    if cloud == 'gcp':
-        from skypilot_tpu.catalog import gcp_catalog as cat
-        tables = ('vms', 'tpu_prices', 'tpu_zones')
-    elif cloud == 'aws':
-        from skypilot_tpu.catalog import aws_catalog as cat
-        tables = ('vms',)
-    elif cloud == 'azure':
-        from skypilot_tpu.catalog import azure_catalog as cat
-        tables = ('vms',)
-    elif cloud == 'lambda':
-        from skypilot_tpu.catalog import lambda_catalog as cat
-        tables = ('vms',)
-    elif cloud == 'runpod':
-        from skypilot_tpu.catalog import runpod_catalog as cat
-        tables = ('vms',)
-    elif cloud == 'do':
-        from skypilot_tpu.catalog import do_catalog as cat
-        tables = ('vms',)
-    elif cloud == 'fluidstack':
-        from skypilot_tpu.catalog import fluidstack_catalog as cat
-        tables = ('vms',)
-    else:
+    cat = _catalog_for(cloud)
+    if cat is None:
         raise click.UsageError(f'Unknown catalog cloud {cloud!r}.')
+    tables = ('vms', 'tpu_prices', 'tpu_zones') if cloud == 'gcp' \
+        else ('vms',)
     if reset:
         for t in tables:
             if catalog_common.remove_override(cloud, t):

@@ -6,17 +6,24 @@ from skypilot_tpu.clouds.registry import CLOUD_REGISTRY
 # Importing the modules registers the clouds.
 from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.azure import Azure
+from skypilot_tpu.clouds.cudo import Cudo
 from skypilot_tpu.clouds.do import DO
 from skypilot_tpu.clouds.fluidstack import Fluidstack
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.fake import Fake, fake_cloud_state
+from skypilot_tpu.clouds.ibm import IBM
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.lambda_cloud import Lambda
 from skypilot_tpu.clouds.local import Local
+from skypilot_tpu.clouds.oci import OCI
+from skypilot_tpu.clouds.paperspace import Paperspace
 from skypilot_tpu.clouds.runpod import RunPod
+from skypilot_tpu.clouds.scp import SCP
+from skypilot_tpu.clouds.vsphere import Vsphere
 
 __all__ = [
     'Cloud', 'CloudImplementationFeatures', 'FeasibleResources', 'Region',
-    'Zone', 'CLOUD_REGISTRY', 'AWS', 'Azure', 'DO', 'Fluidstack', 'GCP',
-    'Fake', 'Lambda', 'Local', 'RunPod', 'fake_cloud_state',
+    'Zone', 'CLOUD_REGISTRY', 'AWS', 'Azure', 'Cudo', 'DO', 'Fluidstack',
+    'GCP', 'Fake', 'IBM', 'Lambda', 'Local', 'OCI', 'Paperspace',
+    'RunPod', 'SCP', 'Vsphere', 'fake_cloud_state',
 ]

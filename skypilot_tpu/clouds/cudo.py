@@ -1,0 +1,62 @@
+"""Cudo Compute (reference sky/clouds/cudo.py) on the MinorCloud
+skeleton.  No stop, no spot, fixed images, not controller-grade."""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.catalog import cudo_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import minor
+from skypilot_tpu.clouds import registry
+
+F = cloud.CloudImplementationFeatures
+
+
+@registry.CLOUD_REGISTRY.register()
+class Cudo(minor.MinorCloud):
+    """Cudo Compute (flat-rate GPU/CPU VMs)."""
+
+    _REPR = 'Cudo'
+    PROVISIONER_MODULE = 'cudo'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 60
+    CATALOG = cudo_catalog.CATALOG
+    UNSUPPORTED = {
+        F.STOP: 'Cudo VMs cannot be stopped, only terminated.',
+        F.AUTOSTOP: 'no stop support; use autodown.',
+        F.SPOT_INSTANCE: 'the Cudo API has no spot tier.',
+        F.CUSTOM_DISK_TIER: 'fixed disk tiers.',
+        F.IMAGE_ID: 'Cudo boots its own base images only.',
+        F.DOCKER_IMAGE: 'no docker runtime layer.',
+        F.CLONE_DISK: 'not supported.',
+        F.HOST_CONTROLLERS: 'no persistent small-CPU tier for '
+                            'controllers.',
+        F.OPEN_PORTS: 'firewalling is project-wide in the console.',
+    }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.cudo import cudo_api
+        if cudo_api.load_api_key() is None:
+            return False, (
+                'No Cudo API key. Set CUDO_API_KEY or write '
+                "'api-key: <key>' to ~/.config/cudo/cudo.yml "
+                '(what `cudoctl init` writes).')
+        if cudo_api.load_project_id() is None:
+            return False, ('No Cudo project. Set CUDO_PROJECT_ID or '
+                           "'project: <id>' in ~/.config/cudo/cudo.yml.")
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.cudo import cudo_api
+        key = cudo_api.load_api_key()
+        return [[key[:12]]] if key else None
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        path = os.path.expanduser('~/.config/cudo/cudo.yml')
+        if os.path.exists(path):
+            return {'~/.config/cudo/cudo.yml':
+                    '~/.config/cudo/cudo.yml'}
+        return {}

@@ -1,0 +1,56 @@
+"""IBM Cloud VPC (reference sky/clouds/ibm.py) on the MinorCloud
+skeleton.  VPC Gen2 instances support stop/start; no spot tier."""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.catalog import ibm_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import minor
+from skypilot_tpu.clouds import registry
+
+F = cloud.CloudImplementationFeatures
+
+
+@registry.CLOUD_REGISTRY.register()
+class IBM(minor.MinorCloud):
+    """IBM Cloud VPC (Gen2 profiles incl. V100/L4/L40S GPUs)."""
+
+    _REPR = 'IBM'
+    PROVISIONER_MODULE = 'ibm'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 63
+    CATALOG = ibm_catalog.CATALOG
+    EGRESS_PER_GB = 0.09
+    UNSUPPORTED = {
+        F.SPOT_INSTANCE: 'IBM VPC has no spot tier.',
+        F.CUSTOM_DISK_TIER: 'block-storage profiles are fixed per '
+                            'instance profile.',
+        F.CLONE_DISK: 'not supported.',
+        F.OPEN_PORTS: 'security-group management is not automated; '
+                      'default VPC groups allow outbound + SSH.',
+    }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.ibm import ibm_api
+        if ibm_api.load_api_key() is None:
+            return False, (
+                'No IBM Cloud credentials. Set IBM_API_KEY or write '
+                "'iam_api_key: <key>' to ~/.ibm/credentials.yaml "
+                '(the reference path).')
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.ibm import ibm_api
+        key = ibm_api.load_api_key()
+        return [[key[:12]]] if key else None
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        path = os.path.expanduser('~/.ibm/credentials.yaml')
+        if os.path.exists(path):
+            return {'~/.ibm/credentials.yaml':
+                    '~/.ibm/credentials.yaml'}
+        return {}

@@ -1,0 +1,180 @@
+"""MinorCloud: the shared capability-model skeleton of the
+minor-cloud family.
+
+Lambda proved the recipe (clouds/lambda_cloud.py); RunPod/DO/
+FluidStack refined it; this base class is the recipe itself so the
+remaining tail (Cudo/Paperspace/IBM/OCI/SCP/vSphere — reference
+sky/clouds/{cudo,paperspace,ibm,oci,scp,vsphere}.py) is each a small
+declaration: a FlatCatalog, a feature dict, and a credential probe.
+
+Subclasses set:
+  CATALOG        — catalog.flat.FlatCatalog instance
+  UNSUPPORTED    — {CloudImplementationFeatures: reason}
+  EGRESS_PER_GB  — $/GB (0 for flat-rate providers)
+and implement check_credentials / get_user_identities /
+get_credential_file_mounts (auth is the one genuinely per-cloud part).
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.catalog import flat as flat_catalog
+
+
+class MinorCloud(cloud.Cloud):
+    """Flat-catalog cloud: one price per type, regions without zones."""
+
+    CATALOG: 'flat_catalog.FlatCatalog'
+    UNSUPPORTED: Dict[cloud.CloudImplementationFeatures, str] = {}
+    EGRESS_PER_GB: float = 0.0
+    # Single-node-only platforms (no inter-node fabric) set this.
+    MULTI_NODE_REASON: Optional[str] = None
+
+    @classmethod
+    def _unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        unsupported = dict(cls.UNSUPPORTED)
+        if cls.MULTI_NODE_REASON:
+            unsupported[cloud.CloudImplementationFeatures.MULTI_NODE] \
+                = cls.MULTI_NODE_REASON
+        if resources.tpu_slice is not None:
+            unsupported[cloud.CloudImplementationFeatures.MULTI_NODE] \
+                = (f'{cls._REPR} offers no TPUs; use GCP/Kubernetes.')
+        return unsupported
+
+    # ---- regions ---------------------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del instance_type, accelerators
+        if zone is not None:
+            return []
+        if use_spot and not cls.CATALOG.has_spot:
+            return []
+        return [cloud.Region(r) for r in cls.CATALOG.regions()
+                if region is None or r == region]
+
+    @classmethod
+    def zones_provision_loop(
+        cls, *, region: str, num_nodes: int, instance_type: str,
+        accelerators: Optional[Dict[str, int]] = None,
+        use_spot: bool = False,
+    ) -> Iterator[Optional[List[cloud.Zone]]]:
+        # No zones below region: one attempt per region.
+        del num_nodes, instance_type, accelerators, use_spot, region
+        yield None
+
+    # ---- pricing ---------------------------------------------------------
+    @classmethod
+    def instance_type_to_hourly_cost(cls, instance_type: str,
+                                     use_spot: bool,
+                                     region: Optional[str] = None,
+                                     zone: Optional[str] = None) -> float:
+        return cls.CATALOG.get_hourly_cost(instance_type, use_spot,
+                                           region, zone)
+
+    @classmethod
+    def accelerators_to_hourly_cost(cls, accelerators: Dict[str, int],
+                                    use_spot: bool,
+                                    region: Optional[str] = None,
+                                    zone: Optional[str] = None) -> float:
+        (acc, count), = accelerators.items()
+        return cls.CATALOG.get_accelerator_hourly_cost(
+            acc, count, use_spot, region, zone)
+
+    @classmethod
+    def get_egress_cost(cls, num_gigabytes: float) -> float:
+        return cls.EGRESS_PER_GB * num_gigabytes
+
+    # ---- instance types --------------------------------------------------
+    @classmethod
+    def instance_type_exists(cls, instance_type: str) -> bool:
+        return cls.CATALOG.instance_type_exists(instance_type)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return cls.CATALOG.get_vcpus_mem_from_instance_type(
+            instance_type)
+
+    @classmethod
+    def get_default_instance_type(
+            cls, cpus: Optional[str] = None,
+            memory: Optional[str] = None,
+            disk_tier: Optional[str] = None) -> Optional[str]:
+        return cls.CATALOG.get_default_instance_type(cpus, memory,
+                                                     disk_tier)
+
+    @classmethod
+    def get_accelerators_from_instance_type(
+            cls, instance_type: str) -> Optional[Dict[str, int]]:
+        return cls.CATALOG.get_accelerators_from_instance_type(
+            instance_type)
+
+    # ---- feasibility -----------------------------------------------------
+    @classmethod
+    def _get_feasible_launchable_resources(
+        cls, resources: 'resources_lib.Resources',
+        num_nodes: int) -> cloud.FeasibleResources:
+        if resources.tpu_slice is not None:
+            return cloud.FeasibleResources(
+                [], [], f'{cls._REPR} offers no TPUs.')
+        if num_nodes > 1 and cls.MULTI_NODE_REASON:
+            return cloud.FeasibleResources(
+                [], [], f'{cls._REPR}: {cls.MULTI_NODE_REASON}')
+        if resources.use_spot and not cls.CATALOG.has_spot:
+            return cloud.FeasibleResources(
+                [], [], f'{cls._REPR} has no spot tier.')
+        if resources.accelerators is not None:
+            (acc, acc_count), = resources.accelerators.items()
+            instance_types = \
+                cls.CATALOG.get_instance_type_for_accelerator(
+                    acc, acc_count)
+            if not instance_types:
+                fuzzy = [f'{name} ({cls._REPR})' for name in
+                         cls.CATALOG.list_accelerators(acc[:4])]
+                return cloud.FeasibleResources([], fuzzy[:5], None)
+            return cloud.FeasibleResources(
+                [resources.copy(cloud=cls(), instance_type=it)
+                 for it in instance_types], [], None)
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = cls.get_default_instance_type(
+                resources.cpus, resources.memory, resources.disk_tier)
+        if instance_type is None:
+            return cloud.FeasibleResources(
+                [], [], f'No {cls._REPR} instance type satisfies '
+                f'cpus={resources.cpus} memory={resources.memory}.')
+        return cloud.FeasibleResources(
+            [resources.copy(cloud=cls(), instance_type=instance_type)],
+            [], None)
+
+    # ---- deploy ----------------------------------------------------------
+    @classmethod
+    def make_deploy_resources_variables(
+            cls, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        del zones
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot and cls.CATALOG.has_spot,
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,
+            'labels': resources.labels or {},
+            'num_nodes': num_nodes,
+            'ports': resources.ports,
+        }

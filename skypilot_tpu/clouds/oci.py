@@ -1,0 +1,55 @@
+"""Oracle Cloud Infrastructure (reference sky/clouds/oci.py) on the
+MinorCloud skeleton.  Instances support stop/start; preemptible
+capacity is a flat 50% discount (has_spot in the catalog).  The
+provisioner drives the `oci` CLI — the same control surface the OCI
+object store uses (data/storage.py OciStore)."""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.catalog import oci_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import minor
+from skypilot_tpu.clouds import registry
+
+F = cloud.CloudImplementationFeatures
+
+
+@registry.CLOUD_REGISTRY.register()
+class OCI(minor.MinorCloud):
+    """Oracle Cloud Infrastructure (E4/E5 Flex + A10/A100/H100)."""
+
+    _REPR = 'OCI'
+    PROVISIONER_MODULE = 'oci'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 200
+    CATALOG = oci_catalog.CATALOG
+    EGRESS_PER_GB = 0.0085
+    UNSUPPORTED = {
+        F.CUSTOM_DISK_TIER: 'boot volumes use balanced performance.',
+        F.CLONE_DISK: 'not supported.',
+        F.OPEN_PORTS: 'security-list management is not automated; '
+                      'the default list allows SSH.',
+    }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.oci import oci_cli
+        ok, msg = oci_cli.check_cli()
+        if not ok:
+            return False, msg
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.oci import oci_cli
+        user = oci_cli.config_value('user')
+        return [[user[:24]]] if user else None
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        mounts = {}
+        for path in ('~/.oci/config',):
+            if os.path.exists(os.path.expanduser(path)):
+                mounts[path] = path
+        return mounts

@@ -1,0 +1,55 @@
+"""Paperspace (reference sky/clouds/paperspace.py) on the MinorCloud
+skeleton.  Machines support stop/start; no spot, fixed OS templates."""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.catalog import paperspace_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import minor
+from skypilot_tpu.clouds import registry
+
+F = cloud.CloudImplementationFeatures
+
+
+@registry.CLOUD_REGISTRY.register()
+class Paperspace(minor.MinorCloud):
+    """Paperspace (CORE GPU machines)."""
+
+    _REPR = 'Paperspace'
+    PROVISIONER_MODULE = 'paperspace'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 120
+    CATALOG = paperspace_catalog.CATALOG
+    UNSUPPORTED = {
+        F.SPOT_INSTANCE: 'Paperspace has no spot tier.',
+        F.IMAGE_ID: 'fixed OS templates only.',
+        F.CUSTOM_DISK_TIER: 'fixed disk tiers per machine.',
+        F.CLONE_DISK: 'not supported.',
+        F.OPEN_PORTS: 'machines have a public IP with no managed '
+                      'firewall.',
+    }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.paperspace import paperspace_api
+        if paperspace_api.load_api_key() is None:
+            return False, (
+                'No Paperspace API key. Set PAPERSPACE_API_KEY or '
+                "write {\"apiKey\": \"<key>\"} to "
+                '~/.paperspace/config.json.')
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.paperspace import paperspace_api
+        key = paperspace_api.load_api_key()
+        return [[key[:12]]] if key else None
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        path = os.path.expanduser('~/.paperspace/config.json')
+        if os.path.exists(path):
+            return {'~/.paperspace/config.json':
+                    '~/.paperspace/config.json'}
+        return {}

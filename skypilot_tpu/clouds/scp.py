@@ -1,0 +1,59 @@
+"""Samsung Cloud Platform (reference sky/clouds/scp.py) on the
+MinorCloud skeleton.  Servers support stop/start; single-node only
+(the reference declares MULTI_NODE unsupported); no spot."""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.catalog import scp_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import minor
+from skypilot_tpu.clouds import registry
+
+F = cloud.CloudImplementationFeatures
+
+
+@registry.CLOUD_REGISTRY.register()
+class SCP(minor.MinorCloud):
+    """Samsung Cloud Platform (KR regions, T4/V100 GPU servers)."""
+
+    _REPR = 'SCP'
+    PROVISIONER_MODULE = 'scp'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 40
+    CATALOG = scp_catalog.CATALOG
+    MULTI_NODE_REASON = ('SCP provisioning is one server per virtual '
+                         'network operation (reference scp.py '
+                         '_MULTI_NODE).')
+    UNSUPPORTED = {
+        F.SPOT_INSTANCE: 'SCP has no spot tier.',
+        F.IMAGE_ID: 'fixed Ubuntu images only.',
+        F.DOCKER_IMAGE: 'no docker runtime layer.',
+        F.CUSTOM_DISK_TIER: 'fixed SSD tiers.',
+        F.CLONE_DISK: 'not supported.',
+        F.OPEN_PORTS: 'firewall automation is not implemented; '
+                      'allow inbound in the SCP console.',
+    }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.scp import scp_api
+        if scp_api.load_credentials() is None:
+            return False, (
+                'No SCP credentials. Set SCP_ACCESS_KEY / '
+                'SCP_SECRET_KEY / SCP_PROJECT_ID or write them to '
+                '~/.scp/scp_credential.')
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.scp import scp_api
+        creds = scp_api.load_credentials()
+        return [[creds.access_key[:12]]] if creds else None
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        path = os.path.expanduser('~/.scp/scp_credential')
+        if os.path.exists(path):
+            return {'~/.scp/scp_credential': '~/.scp/scp_credential'}
+        return {}

@@ -1,0 +1,61 @@
+"""vSphere / vCenter (reference sky/clouds/vsphere.py) on the
+MinorCloud skeleton — the on-prem cloud: VMs clone from content-
+library templates, "regions" are datacenters, prices are chargeback
+anchors.  Single-node per operation (reference declares MULTI_NODE
+unsupported); stop/start supported (power ops)."""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.catalog import vsphere_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.clouds import minor
+from skypilot_tpu.clouds import registry
+
+F = cloud.CloudImplementationFeatures
+
+
+@registry.CLOUD_REGISTRY.register()
+class Vsphere(minor.MinorCloud):
+    """VMware vSphere (on-prem vCenter)."""
+
+    _REPR = 'Vsphere'
+    PROVISIONER_MODULE = 'vsphere'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 80
+    CATALOG = vsphere_catalog.CATALOG
+    MULTI_NODE_REASON = ('vSphere provisioning clones one template VM '
+                         'per operation (reference vsphere.py).')
+    UNSUPPORTED = {
+        F.SPOT_INSTANCE: 'on-prem capacity has no spot market.',
+        F.IMAGE_ID: 'VMs clone from the configured content-library '
+                    'template.',
+        F.DOCKER_IMAGE: 'no docker runtime layer.',
+        F.CUSTOM_DISK_TIER: 'datastore-governed.',
+        F.CLONE_DISK: 'not supported.',
+        F.OPEN_PORTS: 'on-prem networking is site-managed.',
+    }
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.vsphere import vsphere_api
+        if vsphere_api.load_credentials() is None:
+            return False, (
+                'No vSphere credentials. Set VSPHERE_HOST / '
+                'VSPHERE_USER / VSPHERE_PASSWORD or write them to '
+                '~/.vsphere/credential.yaml (the reference path).')
+        return True, None
+
+    @classmethod
+    def get_user_identities(cls) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.vsphere import vsphere_api
+        creds = vsphere_api.load_credentials()
+        return [[f'{creds.user}@{creds.host}']] if creds else None
+
+    @classmethod
+    def get_credential_file_mounts(cls) -> Dict[str, str]:
+        path = os.path.expanduser('~/.vsphere/credential.yaml')
+        if os.path.exists(path):
+            return {'~/.vsphere/credential.yaml':
+                    '~/.vsphere/credential.yaml'}
+        return {}

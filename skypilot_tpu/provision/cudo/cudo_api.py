@@ -1,0 +1,122 @@
+"""Minimal Cudo Compute REST client (JSON over urllib).
+
+Counterpart of the reference's sky/provision/cudo/cudo_wrapper.py
+(which drives the `cudo-compute` SDK); SDK-free against the same API:
+https://rest.compute.cudo.org/v1 with Bearer API-key auth.  Key +
+project come from env CUDO_API_KEY / CUDO_PROJECT_ID or
+~/.config/cudo/cudo.yml (`api-key:` / `project:` — what `cudoctl
+init` writes).  All calls route through `request`, the single test
+seam.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_ROOT = 'https://rest.compute.cudo.org/v1'
+_TIMEOUT = 60.0
+_CONFIG_FILE = '~/.config/cudo/cudo.yml'
+
+
+class CudoApiError(exceptions.ProvisionError):
+
+    def __init__(self, status_code: int, code: str, message: str) -> None:
+        no_failover = status_code in (401, 403)
+        super().__init__(
+            f'Cudo API error {status_code} {code}: {message}',
+            no_failover=no_failover)
+        self.status_code = status_code
+        self.code = code
+
+
+def _config_value(key: str) -> Optional[str]:
+    path = os.path.expanduser(
+        os.environ.get('CUDO_CONFIG_FILE', _CONFIG_FILE))
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                m = re.match(rf'\s*{re.escape(key)}\s*:\s*(\S+)',
+                             line.rstrip())
+                if m:
+                    return m.group(1).strip('\'"')
+    except OSError:
+        return None
+    return None
+
+
+def load_api_key() -> Optional[str]:
+    return os.environ.get('CUDO_API_KEY') or _config_value('api-key')
+
+
+def load_project_id() -> Optional[str]:
+    return os.environ.get('CUDO_PROJECT_ID') or _config_value('project')
+
+
+def request(method: str, path: str,
+            body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    key = load_api_key()
+    if key is None:
+        raise CudoApiError(401, 'NoCredentials', 'no Cudo API key')
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f'{API_ROOT}{path}', data=data, method=method,
+        headers={'Authorization': f'Bearer {key}',
+                 'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
+            text = resp.read()
+            return json.loads(text) if text.strip() else {}
+    except urllib.error.HTTPError as e:
+        text = e.read().decode(errors='replace')
+        try:
+            err = json.loads(text)
+            msg = str(err.get('message', text[:200]))
+        except json.JSONDecodeError:
+            msg = text[:200]
+        code = ('insufficient-capacity'
+                if 'capacity' in msg.lower() or
+                'no host' in msg.lower() else 'unknown')
+        raise CudoApiError(e.code, code, msg) from None
+    except urllib.error.URLError as e:
+        raise CudoApiError(0, 'Unreachable', str(e)) from None
+
+
+def list_vms(project: str) -> List[Dict[str, Any]]:
+    return list(request('GET', f'/projects/{project}/vms')
+                .get('VMs') or [])
+
+
+def create_vm(project: str, vm_id: str, data_center_id: str,
+              machine_type: str, vcpus: int, memory_gib: int,
+              gpus: int, boot_disk_gib: int, public_key: str,
+              metadata: Dict[str, str]) -> str:
+    body = {
+        'vmId': vm_id,
+        'dataCenterId': data_center_id,
+        'machineType': machine_type,
+        'vcpus': vcpus,
+        'memoryGib': memory_gib,
+        'gpus': gpus,
+        'bootDisk': {'sizeGib': boot_disk_gib},
+        'bootDiskImageId': 'ubuntu-2204-nvidia-535-docker-v20240214',
+        'customSshKeys': [public_key],
+        'metadata': metadata,
+    }
+    resp = request('POST', f'/projects/{project}/vm', body)
+    return str((resp.get('vm') or {}).get('id') or vm_id)
+
+
+def terminate_vm(project: str, vm_id: str) -> None:
+    try:
+        request('POST', f'/projects/{project}/vms/{vm_id}/terminate')
+    except CudoApiError as e:
+        if e.status_code != 404:
+            raise

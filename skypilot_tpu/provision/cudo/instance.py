@@ -1,0 +1,200 @@
+"""Cudo Compute provisioner: the uniform provision interface.
+
+Counterpart of the reference's sky/provision/cudo/instance.py.  VM
+names carry the cluster tag + index; instance types decompose by the
+reference grammar `<machine_type>_<gpu>x<vcpu>v<mem>gb`
+(cudo_machine_type.py:43); no stop support (terminate only).
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.cudo import cudo_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'cudo'
+_TYPE_RE = re.compile(r'^(?P<mt>.+)_(?P<gpu>\d+)x(?P<vcpu>\d+)v'
+                      r'(?P<mem>\d+)gb$')
+
+
+def parse_instance_type(instance_type: str):
+    """'epyc-milan-rtx-a4000_1x4v16gb' ->
+    (machine_type, gpus, vcpus, mem_gib)."""
+    m = _TYPE_RE.match(instance_type)
+    if not m:
+        raise exceptions.ProvisionError(
+            f'bad Cudo instance type {instance_type!r} '
+            f'(want <machine_type>_<gpu>x<vcpu>v<mem>gb)')
+    return (m.group('mt'), int(m.group('gpu')), int(m.group('vcpu')),
+            int(m.group('mem')))
+
+
+def _classify(e: cudo_api.CudoApiError) -> Exception:
+    if e.code == 'insufficient-capacity':
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _project() -> str:
+    project = cudo_api.load_project_id()
+    if not project:
+        raise exceptions.ProvisionError('no Cudo project configured')
+    return project
+
+
+def _state(vm: Dict[str, Any]) -> str:
+    """Cudo responses carry `state` or (list views) `shortState` —
+    every consumer must accept both."""
+    return str(vm.get('state') or vm.get('shortState') or '').upper()
+
+
+def _cluster_vms(cluster_name_on_cloud: str) -> List[Dict[str, Any]]:
+    return sorted(
+        (vm for vm in cudo_api.list_vms(_project())
+         if (vm.get('metadata') or {}).get('skytpu-cluster')
+         == cluster_name_on_cloud),
+        key=lambda vm: str(vm.get('id')))
+
+
+def _public_key(auth_config: Dict[str, Any]) -> str:
+    ssh_keys = (auth_config or {}).get('ssh_keys', '')
+    if ':' not in ssh_keys:
+        raise exceptions.ProvisionError(
+            'Cudo VMs inject the framework SSH key at create; the '
+            'launch auth config carries none.')
+    return ssh_keys.split(':', 1)[1]
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    try:
+        existing = _cluster_vms(cluster_name_on_cloud)
+        live = [vm for vm in existing
+                if _state(vm) in ('ACTIVE', 'RUNNING', 'STARTING',
+                                  'INIT')]
+        to_create = config.count - len(live)
+        created: List[str] = []
+        if to_create > 0:
+            machine_type, gpus, vcpus, mem = parse_instance_type(
+                node_cfg['instance_type'])
+            pub = _public_key(config.authentication_config)
+            base = len(existing)
+            for i in range(to_create):
+                vm_id = f'{cluster_name_on_cloud}-{base + i:04d}'
+                created.append(cudo_api.create_vm(
+                    _project(), vm_id,
+                    data_center_id=region,
+                    machine_type=machine_type,
+                    vcpus=vcpus, memory_gib=mem, gpus=gpus,
+                    boot_disk_gib=int(node_cfg.get('disk_size')
+                                      or 100),
+                    public_key=pub,
+                    metadata={'skytpu-cluster': cluster_name_on_cloud},
+                ))
+    except cudo_api.CudoApiError as e:
+        raise _classify(e) from None
+    ids = sorted([str(vm['id']) for vm in live] + created)
+    if not ids:
+        raise exceptions.ResourcesUnavailableError(
+            f'Cudo returned no VMs for {cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER, cluster_name=cluster_name_on_cloud,
+        region=region, zone=None, head_instance_id=ids[0],
+        resumed_instance_ids=[], created_instance_ids=created)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    raise exceptions.NotSupportedError(
+        'Cudo VMs cannot be stopped; use `sky down` (terminate).')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    ids = sorted(
+        str(vm['id']) for vm in _cluster_vms(cluster_name_on_cloud)
+        if _state(vm) not in ('DELETED', 'DELETING'))
+    if worker_only and ids:
+        ids = ids[1:]
+    for vm_id in ids:
+        cudo_api.terminate_vm(_project(), vm_id)
+
+
+_STATUS_MAP = {
+    'INIT': 'pending', 'CREATING': 'pending', 'STARTING': 'pending',
+    'ACTIVE': 'running', 'RUNNING': 'running',
+    'STOPPED': 'stopped',
+    'DELETING': 'terminated', 'DELETED': 'terminated',
+    'FAILED': 'terminated',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for vm in _cluster_vms(cluster_name_on_cloud):
+        status = _STATUS_MAP.get(_state(vm))
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[str(vm['id'])] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 900.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud, None,
+                                   non_terminated_only=False)
+        live = [s for s in statuses.values() if s != 'terminated']
+        if live and all(s == state for s in live):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: VMs did not reach {state!r} '
+        f'within {timeout}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for vm in _cluster_vms(cluster_name_on_cloud):
+        if _STATUS_MAP.get(_state(vm)) != 'running':
+            continue
+        iid = str(vm['id'])
+        nic = (vm.get('nics') or [{}])[0]
+        instances[iid] = [common.InstanceInfo(
+            instance_id=iid,
+            internal_ip=str(nic.get('internalIpAddress') or ''),
+            external_ip=nic.get('externalIpAddress')
+            or vm.get('externalIpAddress'),
+            tags=dict(vm.get('metadata') or {}),
+        )]
+    head = sorted(instances)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head,
+        provider_name=_PROVIDER, provider_config=provider_config,
+        ssh_user='root')
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.warning('Cudo firewalling is project-wide (console); '
+                   'ensure %s are reachable.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

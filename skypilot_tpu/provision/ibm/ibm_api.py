@@ -1,0 +1,171 @@
+"""Minimal IBM Cloud VPC REST client (JSON over urllib).
+
+Counterpart of the reference's sky/adaptors/ibm.py +
+sky/providers/ibm/* (ibm-vpc SDK); SDK-free against the same VPC
+Gen2 API: IAM apikey -> bearer token at iam.cloud.ibm.com, then
+https://<region>.iaas.cloud.ibm.com/v1 with `version` + `generation`
+query params.  Key from env IBM_API_KEY or ~/.ibm/credentials.yaml
+(`iam_api_key:` — the reference path, adaptors/ibm.py:42).
+All calls route through `request`, the single test seam.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+IAM_URL = 'https://iam.cloud.ibm.com/identity/token'
+_API_VERSION = '2024-01-01'
+_TIMEOUT = 60.0
+_CREDENTIALS_FILE = '~/.ibm/credentials.yaml'
+
+_token_cache: Dict[str, Any] = {}
+
+
+class IbmApiError(exceptions.ProvisionError):
+
+    def __init__(self, status_code: int, code: str, message: str) -> None:
+        no_failover = status_code in (401, 403)
+        super().__init__(
+            f'IBM API error {status_code} {code}: {message}',
+            no_failover=no_failover)
+        self.status_code = status_code
+        self.code = code
+
+
+def load_api_key() -> Optional[str]:
+    key = os.environ.get('IBM_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(
+        os.environ.get('IBM_CREDENTIALS_FILE', _CREDENTIALS_FILE))
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                m = re.match(r'\s*iam_api_key\s*:\s*(\S+)',
+                             line.rstrip())
+                if m:
+                    return m.group(1).strip('\'"')
+    except OSError:
+        return None
+    return None
+
+
+def _iam_token() -> str:
+    now = time.time()
+    if _token_cache.get('expiry', 0) - 60 > now:
+        return _token_cache['token']
+    key = load_api_key()
+    if key is None:
+        raise IbmApiError(401, 'NoCredentials', 'no IBM API key')
+    data = urllib.parse.urlencode({
+        'grant_type': 'urn:ibm:params:oauth:grant-type:apikey',
+        'apikey': key}).encode()
+    req = urllib.request.Request(
+        IAM_URL, data=data, method='POST',
+        headers={'Content-Type': 'application/x-www-form-urlencoded'})
+    try:
+        with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
+            payload = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        raise IbmApiError(e.code, 'IamTokenExchange',
+                          e.read().decode(errors='replace')[:200]) \
+            from None
+    except urllib.error.URLError as e:
+        raise IbmApiError(0, 'Unreachable', str(e)) from None
+    _token_cache['token'] = payload['access_token']
+    _token_cache['expiry'] = now + float(payload.get('expires_in',
+                                                     3600))
+    return _token_cache['token']
+
+
+def request(method: str, region: str, path: str,
+            body: Optional[Dict[str, Any]] = None,
+            params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    qs = {'version': _API_VERSION, 'generation': '2'}
+    qs.update(params or {})
+    url = (f'https://{region}.iaas.cloud.ibm.com/v1{path}?'
+           + urllib.parse.urlencode(qs))
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={'Authorization': f'Bearer {_iam_token()}',
+                 'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
+            text = resp.read()
+            return json.loads(text) if text.strip() else {}
+    except urllib.error.HTTPError as e:
+        text = e.read().decode(errors='replace')
+        try:
+            errs = json.loads(text).get('errors', [])
+            code = str(errs[0].get('code', 'unknown')) if errs \
+                else 'unknown'
+            msg = str(errs[0].get('message', text[:200])) if errs \
+                else text[:200]
+        except (json.JSONDecodeError, AttributeError, IndexError):
+            code, msg = 'unknown', text[:200]
+        raise IbmApiError(e.code, code, msg) from None
+    except urllib.error.URLError as e:
+        raise IbmApiError(0, 'Unreachable', str(e)) from None
+
+
+def list_instances(region: str, name_prefix: str
+                   ) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    start = None
+    while True:
+        params = {'limit': '100'}
+        if start:
+            params['start'] = start
+        resp = request('GET', region, '/instances', params=params)
+        out.extend(i for i in resp.get('instances', [])
+                   if str(i.get('name', '')).startswith(name_prefix))
+        nxt = (resp.get('next') or {}).get('href', '')
+        m = re.search(r'[?&]start=([^&]+)', nxt)
+        if not m:
+            return out
+        start = m.group(1)
+
+
+def create_instance(region: str, zone: str, name: str, profile: str,
+                    vpc_id: str, subnet_id: str, image_id: str,
+                    key_ids: List[str],
+                    user_data: Optional[str] = None
+                    ) -> Dict[str, Any]:
+    body: Dict[str, Any] = {
+        'name': name,
+        'profile': {'name': profile},
+        'vpc': {'id': vpc_id},
+        'image': {'id': image_id},
+        'zone': {'name': zone},
+        'primary_network_interface': {'subnet': {'id': subnet_id}},
+        'keys': [{'id': k} for k in key_ids],
+    }
+    if user_data:
+        body['user_data'] = user_data
+    return request('POST', region, '/instances', body)
+
+
+def instance_action(region: str, instance_id: str,
+                    action_type: str) -> None:
+    """start | stop."""
+    request('POST', region, f'/instances/{instance_id}/actions',
+            {'type': action_type})
+
+
+def delete_instance(region: str, instance_id: str) -> None:
+    try:
+        request('DELETE', region, f'/instances/{instance_id}')
+    except IbmApiError as e:
+        if e.status_code != 404:
+            raise

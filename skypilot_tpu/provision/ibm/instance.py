@@ -1,0 +1,228 @@
+"""IBM Cloud VPC provisioner: the uniform provision interface.
+
+Counterpart of the reference's legacy sky/skylet/providers/ibm/* (the
+ray-autoscaler-era node provider) redone as a native provisioner.
+VPC/subnet/image/SSH-key ids come from config (`ibm.vpc_id`,
+`ibm.subnet_id`, `ibm.image_id`, `ibm.key_id` — VPC Gen2 instances
+cannot boot without them); instances are named `<cluster>-<idx>` and
+support stop/start.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.ibm import ibm_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'ibm'
+
+_CAPACITY_CODES = {'over_quota', 'insufficient_capacity',
+                   'quota_exceeded'}
+
+
+def _classify(e: ibm_api.IbmApiError) -> Exception:
+    if e.code in _CAPACITY_CODES or 'capacity' in e.code:
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _region(provider_config: Optional[Dict[str, Any]]) -> str:
+    assert provider_config and provider_config.get('region'), \
+        'IBM provider_config must carry region'
+    return provider_config['region']
+
+
+def _vpc_settings() -> Dict[str, str]:
+    from skypilot_tpu import config as config_lib
+    settings = {}
+    for key in ('vpc_id', 'subnet_id', 'image_id', 'key_id'):
+        value = config_lib.get_nested(('ibm', key), None)
+        if not value:
+            raise exceptions.ProvisionError(
+                f'IBM VPC provisioning needs config ibm.{key} '
+                '(VPC Gen2 instances cannot boot without it).')
+        settings[key] = value
+    return settings
+
+
+def _cluster_instances(region: str, cluster_name_on_cloud: str
+                       ) -> List[Dict[str, Any]]:
+    return sorted(
+        ibm_api.list_instances(region, f'{cluster_name_on_cloud}-'),
+        key=lambda i: str(i.get('name')))
+
+
+def _ssh_key_user_data(auth_config: Dict[str, Any]) -> Optional[str]:
+    ssh_keys = (auth_config or {}).get('ssh_keys', '')
+    if ':' not in ssh_keys:
+        return None
+    pub = ssh_keys.split(':', 1)[1]
+    return ('#!/bin/bash\n'
+            'mkdir -p /root/.ssh\n'
+            f'echo {pub!r} >> /root/.ssh/authorized_keys\n'
+            'chmod 600 /root/.ssh/authorized_keys\n')
+
+
+def _status(inst: Dict[str, Any]) -> str:
+    return str(inst.get('status', 'unknown'))
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    zone = node_cfg.get('zone') or f'{region}-1'
+    try:
+        settings = _vpc_settings()
+        existing = _cluster_instances(region, cluster_name_on_cloud)
+        running = [i for i in existing
+                   if _status(i) in ('running', 'starting',
+                                     'pending')]
+        stopped = [i for i in existing if _status(i) == 'stopped']
+
+        resumed: List[str] = []
+        if config.resume_stopped_nodes and stopped:
+            need = config.count - len(running)
+            for inst in stopped[:max(need, 0)]:
+                ibm_api.instance_action(region, str(inst['id']),
+                                        'start')
+                resumed.append(str(inst['id']))
+            running += [i for i in stopped
+                        if str(i['id']) in resumed]
+
+        created: List[str] = []
+        to_create = config.count - len(running)
+        if to_create > 0:
+            base = len(existing)
+            for i in range(to_create):
+                inst = ibm_api.create_instance(
+                    region, zone,
+                    name=f'{cluster_name_on_cloud}-{base + i:04d}',
+                    profile=node_cfg['instance_type'],
+                    vpc_id=settings['vpc_id'],
+                    subnet_id=settings['subnet_id'],
+                    image_id=settings['image_id'],
+                    key_ids=[settings['key_id']],
+                    user_data=_ssh_key_user_data(
+                        config.authentication_config))
+                created.append(str(inst.get('id')))
+    except ibm_api.IbmApiError as e:
+        raise _classify(e) from None
+    ids = sorted([str(i['id']) for i in running] + created)
+    if not ids:
+        raise exceptions.ResourcesUnavailableError(
+            f'IBM VPC returned no instances for '
+            f'{cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER, cluster_name=cluster_name_on_cloud,
+        region=region, zone=zone, head_instance_id=ids[0],
+        resumed_instance_ids=resumed, created_instance_ids=created)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    region = _region(provider_config)
+    insts = [i for i in _cluster_instances(region,
+                                           cluster_name_on_cloud)
+             if _status(i) in ('running', 'starting', 'pending')]
+    ids = sorted(str(i['id']) for i in insts)
+    if worker_only and ids:
+        ids = ids[1:]
+    for iid in ids:
+        ibm_api.instance_action(region, iid, 'stop')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    region = _region(provider_config)
+    ids = sorted(str(i['id'])
+                 for i in _cluster_instances(region,
+                                             cluster_name_on_cloud))
+    if worker_only and ids:
+        ids = ids[1:]
+    for iid in ids:
+        ibm_api.delete_instance(region, iid)
+
+
+_STATUS_MAP = {
+    'pending': 'pending',
+    'starting': 'pending',
+    'running': 'running',
+    'stopping': 'stopping',
+    'stopped': 'stopped',
+    'restarting': 'pending',
+    'deleting': 'terminated',
+    'failed': 'terminated',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    region = _region(provider_config)
+    out: Dict[str, Optional[str]] = {}
+    for inst in _cluster_instances(region, cluster_name_on_cloud):
+        status = _STATUS_MAP.get(_status(inst))
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[str(inst['id'])] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 600.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud,
+                                   {'region': region},
+                                   non_terminated_only=False)
+        live = [s for s in statuses.values() if s != 'terminated']
+        if live and all(s == state for s in live):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: instances did not reach {state!r} '
+        f'within {timeout}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for inst in _cluster_instances(region, cluster_name_on_cloud):
+        if _status(inst) != 'running':
+            continue
+        iid = str(inst['id'])
+        nic = inst.get('primary_network_interface') or {}
+        floating = (nic.get('floating_ips') or [{}])
+        instances[iid] = [common.InstanceInfo(
+            instance_id=iid,
+            internal_ip=str((nic.get('primary_ip') or {})
+                            .get('address', '')),
+            external_ip=(floating[0].get('address')
+                         if floating else None),
+            tags={'name': str(inst.get('name'))},
+        )]
+    head = sorted(instances)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head,
+        provider_name=_PROVIDER, provider_config=provider_config,
+        ssh_user='root')
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.warning('IBM VPC security-group automation is not '
+                   'implemented; allow %s in the VPC console.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

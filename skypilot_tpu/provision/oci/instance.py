@@ -1,0 +1,225 @@
+"""OCI provisioner: the uniform provision interface over the oci CLI.
+
+Counterpart of the reference's sky/provision/oci/instance.py (oci
+SDK).  Instances are freeform-tagged `skytpu-cluster=<name>`, support
+stop/start, and preemptible capacity maps to use_spot.  Flex shapes
+(`VM.Standard.E4.Flex-<ocpus>-<mem>` in the catalog grammar)
+decompose into --shape-config.
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.oci import oci_cli
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'oci'
+_FLEX_RE = re.compile(r'^(?P<shape>.+\.Flex)-(?P<ocpus>\d+)-'
+                      r'(?P<mem>\d+)$')
+
+_CAPACITY_MARKERS = ('OutOfCapacity', 'LimitExceeded', 'QuotaExceeded',
+                     'TooManyRequests')
+
+
+def parse_shape(instance_type: str):
+    """'VM.Standard.E4.Flex-8-32' -> ('VM.Standard.E4.Flex',
+    {'ocpus': 4.0, 'memoryInGBs': 32.0}); fixed shapes pass through.
+    (OCI Flex ocpus are physical cores: vcpus/2.)"""
+    m = _FLEX_RE.match(instance_type)
+    if not m:
+        return instance_type, None
+    return m.group('shape'), {
+        'ocpus': int(m.group('ocpus')) / 2.0,
+        'memoryInGBs': float(m.group('mem')),
+    }
+
+
+def _classify(e: oci_cli.OciCliError) -> Exception:
+    if any(marker in str(e) for marker in _CAPACITY_MARKERS):
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _net_settings() -> Dict[str, str]:
+    from skypilot_tpu import config as config_lib
+    settings = {}
+    for key in ('subnet_id', 'image_id', 'availability_domain'):
+        value = config_lib.get_nested(('oci', key), None)
+        if not value:
+            raise exceptions.ProvisionError(
+                f'OCI provisioning needs config oci.{key}.')
+        settings[key] = value
+    return settings
+
+
+def _public_key(auth_config: Dict[str, Any]) -> str:
+    ssh_keys = (auth_config or {}).get('ssh_keys', '')
+    if ':' not in ssh_keys:
+        raise exceptions.ProvisionError(
+            'OCI instances take the framework SSH key via metadata; '
+            'the launch auth config carries none.')
+    return ssh_keys.split(':', 1)[1]
+
+
+def _state(inst: Dict[str, Any]) -> str:
+    return str(inst.get('lifecycle-state', 'UNKNOWN')).upper()
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del region  # the oci CLI profile pins the region
+    node_cfg = config.node_config
+    try:
+        settings = _net_settings()
+        existing = oci_cli.list_instances(cluster_name_on_cloud)
+        running = [i for i in existing
+                   if _state(i) in ('RUNNING', 'PROVISIONING',
+                                    'STARTING')]
+        stopped = [i for i in existing if _state(i) == 'STOPPED']
+
+        resumed: List[str] = []
+        if config.resume_stopped_nodes and stopped:
+            need = config.count - len(running)
+            for inst in sorted(stopped,
+                               key=lambda i: str(i['id']))[
+                    :max(need, 0)]:
+                oci_cli.instance_action(str(inst['id']), 'START')
+                resumed.append(str(inst['id']))
+            running += [i for i in stopped
+                        if str(i['id']) in resumed]
+
+        created: List[str] = []
+        to_create = config.count - len(running)
+        if to_create > 0:
+            shape, shape_config = parse_shape(
+                node_cfg['instance_type'])
+            pub = _public_key(config.authentication_config)
+            base = len(existing)
+            for i in range(to_create):
+                inst = oci_cli.launch_instance(
+                    name=f'{cluster_name_on_cloud}-{base + i:04d}',
+                    shape=shape,
+                    availability_domain=settings[
+                        'availability_domain'],
+                    subnet_id=settings['subnet_id'],
+                    image_id=settings['image_id'],
+                    ssh_authorized_keys=pub,
+                    freeform_tags={'skytpu-cluster':
+                                   cluster_name_on_cloud},
+                    preemptible=bool(node_cfg.get('use_spot')),
+                    shape_config=shape_config)
+                created.append(str(inst.get('id')))
+    except oci_cli.OciCliError as e:
+        raise _classify(e) from None
+    ids = sorted([str(i['id']) for i in running] + created)
+    if not ids:
+        raise exceptions.ResourcesUnavailableError(
+            f'OCI returned no instances for {cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER, cluster_name=cluster_name_on_cloud,
+        region=oci_cli.config_value('region') or 'oci',
+        zone=None, head_instance_id=ids[0],
+        resumed_instance_ids=resumed, created_instance_ids=created)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    insts = [i for i in oci_cli.list_instances(cluster_name_on_cloud)
+             if _state(i) in ('RUNNING', 'PROVISIONING', 'STARTING')]
+    ids = sorted(str(i['id']) for i in insts)
+    if worker_only and ids:
+        ids = ids[1:]
+    for iid in ids:
+        oci_cli.instance_action(iid, 'STOP')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    ids = sorted(
+        str(i['id'])
+        for i in oci_cli.list_instances(cluster_name_on_cloud)
+        if _state(i) not in ('TERMINATED', 'TERMINATING'))
+    if worker_only and ids:
+        ids = ids[1:]
+    for iid in ids:
+        oci_cli.terminate_instance(iid)
+
+
+_STATUS_MAP = {
+    'PROVISIONING': 'pending',
+    'STARTING': 'pending',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'STOPPED': 'stopped',
+    'TERMINATING': 'terminated',
+    'TERMINATED': 'terminated',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for inst in oci_cli.list_instances(cluster_name_on_cloud):
+        status = _STATUS_MAP.get(_state(inst))
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[str(inst['id'])] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 600.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud, None,
+                                   non_terminated_only=False)
+        live = [s for s in statuses.values() if s != 'terminated']
+        if live and all(s == state for s in live):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: instances did not reach {state!r} '
+        f'within {timeout}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for inst in oci_cli.list_instances(cluster_name_on_cloud):
+        if _state(inst) != 'RUNNING':
+            continue
+        iid = str(inst['id'])
+        private, public = oci_cli.get_vnic_ips(iid)
+        instances[iid] = [common.InstanceInfo(
+            instance_id=iid,
+            internal_ip=private or '',
+            external_ip=public,
+            tags=dict(inst.get('freeform-tags') or {}),
+        )]
+    head = sorted(instances)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head,
+        provider_name=_PROVIDER, provider_config=provider_config,
+        ssh_user='ubuntu')
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.warning('OCI security-list automation is not implemented; '
+                   'allow %s in the VCN console.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

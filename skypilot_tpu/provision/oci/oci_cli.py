@@ -1,0 +1,145 @@
+"""OCI control plane via the `oci` CLI (JSON output).
+
+Counterpart of the reference's sky/provision/oci/* (oci SDK).  OCI
+API requests need RSA request signing; rather than reimplement that,
+the provisioner drives the official CLI — the exact pattern the OCI
+object store already uses (data/storage.py OciStore).  `run` is the
+single test seam.
+
+Config: compartment from OCI_COMPARTMENT_ID / config
+oci.compartment_id; subnet + image from config oci.subnet_id /
+oci.image_id; region/auth from the standard ~/.oci/config profile.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+_OCI_CONFIG = '~/.oci/config'
+
+
+class OciCliError(exceptions.ProvisionError):
+
+    def __init__(self, returncode: int, message: str) -> None:
+        no_failover = 'NotAuthenticated' in message or \
+            'NotAuthorized' in message
+        super().__init__(f'oci CLI error rc={returncode}: {message}',
+                         no_failover=no_failover)
+        self.returncode = returncode
+
+
+def check_cli() -> Tuple[bool, Optional[str]]:
+    if shutil.which('oci') is None:
+        return False, ('`oci` CLI not found; install oci-cli and run '
+                       '`oci setup config`.')
+    if not os.path.exists(os.path.expanduser(
+            os.environ.get('OCI_CLI_CONFIG_FILE', _OCI_CONFIG))):
+        return False, ('~/.oci/config not found; run '
+                       '`oci setup config`.')
+    return True, None
+
+
+def config_value(key: str) -> Optional[str]:
+    path = os.path.expanduser(
+        os.environ.get('OCI_CLI_CONFIG_FILE', _OCI_CONFIG))
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                m = re.match(rf'\s*{re.escape(key)}\s*=\s*(\S+)',
+                             line.rstrip())
+                if m:
+                    return m.group(1)
+    except OSError:
+        return None
+    return None
+
+
+def compartment_id() -> str:
+    from skypilot_tpu import config as config_lib
+    comp = os.environ.get('OCI_COMPARTMENT_ID') or \
+        config_lib.get_nested(('oci', 'compartment_id'), None) or \
+        config_value('tenancy')  # root compartment fallback
+    if not comp:
+        raise exceptions.ProvisionError(
+            'OCI needs a compartment: set OCI_COMPARTMENT_ID or '
+            'config oci.compartment_id.')
+    return comp
+
+
+def run(args: List[str]) -> Any:
+    """One `oci ...` invocation; parses JSON stdout."""
+    proc = subprocess.run(['oci'] + args + ['--output', 'json'],
+                          capture_output=True, text=True, check=False)
+    if proc.returncode != 0:
+        raise OciCliError(proc.returncode, proc.stderr[-500:])
+    out = proc.stdout.strip()
+    return json.loads(out) if out else {}
+
+
+def launch_instance(name: str, shape: str, availability_domain: str,
+                    subnet_id: str, image_id: str,
+                    ssh_authorized_keys: str,
+                    freeform_tags: Dict[str, str],
+                    preemptible: bool = False,
+                    shape_config: Optional[Dict[str, float]] = None
+                    ) -> Dict[str, Any]:
+    args = [
+        'compute', 'instance', 'launch',
+        '--compartment-id', compartment_id(),
+        '--availability-domain', availability_domain,
+        '--display-name', name,
+        '--shape', shape,
+        '--subnet-id', subnet_id,
+        '--image-id', image_id,
+        '--assign-public-ip', 'true',
+        '--metadata', json.dumps(
+            {'ssh_authorized_keys': ssh_authorized_keys}),
+        '--freeform-tags', json.dumps(freeform_tags),
+    ]
+    if shape_config:
+        args += ['--shape-config', json.dumps(shape_config)]
+    if preemptible:
+        args += ['--preemptible-instance-config',
+                 json.dumps({'preemptionAction':
+                             {'type': 'TERMINATE',
+                              'preserveBootVolume': False}})]
+    return dict(run(args).get('data') or {})
+
+
+def list_instances(tag_value: str) -> List[Dict[str, Any]]:
+    data = run(['compute', 'instance', 'list',
+                '--compartment-id', compartment_id(),
+                '--all']).get('data') or []
+    return [i for i in data
+            if (i.get('freeform-tags') or {}).get('skytpu-cluster')
+            == tag_value]
+
+
+def instance_action(instance_id: str, action: str) -> None:
+    """START | STOP."""
+    run(['compute', 'instance', 'action', '--instance-id',
+         instance_id, '--action', action])
+
+
+def terminate_instance(instance_id: str) -> None:
+    run(['compute', 'instance', 'terminate', '--instance-id',
+         instance_id, '--force'])
+
+
+def get_vnic_ips(instance_id: str) -> Tuple[Optional[str],
+                                            Optional[str]]:
+    """(private_ip, public_ip) from the instance's attached VNICs."""
+    data = run(['compute', 'instance', 'list-vnics',
+                '--instance-id', instance_id]).get('data') or []
+    for vnic in data:
+        if vnic.get('is-primary', True):
+            return vnic.get('private-ip'), vnic.get('public-ip')
+    return None, None

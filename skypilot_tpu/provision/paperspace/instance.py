@@ -1,0 +1,190 @@
+"""Paperspace provisioner: the uniform provision interface.
+
+Counterpart of the reference's sky/provision/paperspace/instance.py.
+Machines are named `<cluster>-<idx>`, support stop/start, and get the
+framework SSH key via a startup script (the reference registers a
+startup script the same way).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.paperspace import paperspace_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'paperspace'
+
+
+def _classify(e: paperspace_api.PaperspaceApiError) -> Exception:
+    if e.code == 'insufficient-capacity':
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _cluster_machines(cluster_name_on_cloud: str
+                      ) -> List[Dict[str, Any]]:
+    return sorted(
+        (m for m in paperspace_api.list_machines()
+         if str(m.get('name', '')).startswith(
+             f'{cluster_name_on_cloud}-')),
+        key=lambda m: str(m.get('name')))
+
+
+def _ssh_startup_script(auth_config: Dict[str, Any]) -> Optional[str]:
+    ssh_keys = (auth_config or {}).get('ssh_keys', '')
+    if ':' not in ssh_keys:
+        return None
+    pub = ssh_keys.split(':', 1)[1]
+    return ('#!/bin/bash\n'
+            'mkdir -p /home/paperspace/.ssh\n'
+            f'echo {pub!r} >> /home/paperspace/.ssh/authorized_keys\n'
+            'chown -R paperspace:paperspace /home/paperspace/.ssh\n'
+            'chmod 600 /home/paperspace/.ssh/authorized_keys\n')
+
+
+def _state(machine: Dict[str, Any]) -> str:
+    return str(machine.get('state', 'unknown'))
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node_cfg = config.node_config
+    try:
+        existing = _cluster_machines(cluster_name_on_cloud)
+        running = [m for m in existing
+                   if _state(m) in ('ready', 'starting',
+                                    'provisioning')]
+        stopped = [m for m in existing if _state(m) == 'off']
+
+        resumed: List[str] = []
+        if config.resume_stopped_nodes and stopped:
+            need = config.count - len(running)
+            for m in stopped[:max(need, 0)]:
+                paperspace_api.machine_action(str(m['id']), 'start')
+                resumed.append(str(m['id']))
+            running += [m for m in stopped
+                        if str(m['id']) in resumed]
+
+        created: List[str] = []
+        to_create = config.count - len(running)
+        if to_create > 0:
+            script = _ssh_startup_script(config.authentication_config)
+            base = len(existing)
+            for i in range(to_create):
+                machine = paperspace_api.create_machine(
+                    name=f'{cluster_name_on_cloud}-{base + i:04d}',
+                    machine_type=node_cfg['instance_type'],
+                    region=region,
+                    disk_size_gb=int(node_cfg.get('disk_size') or 100),
+                    startup_script=script)
+                created.append(str(machine.get('id')))
+    except paperspace_api.PaperspaceApiError as e:
+        raise _classify(e) from None
+    ids = sorted([str(m['id']) for m in running] + created)
+    if not ids:
+        raise exceptions.ResourcesUnavailableError(
+            f'Paperspace returned no machines for '
+            f'{cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER, cluster_name=cluster_name_on_cloud,
+        region=region, zone=None, head_instance_id=ids[0],
+        resumed_instance_ids=resumed, created_instance_ids=created)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    machines = [m for m in _cluster_machines(cluster_name_on_cloud)
+                if _state(m) in ('ready', 'starting', 'provisioning')]
+    ids = sorted(str(m['id']) for m in machines)
+    if worker_only and ids:
+        ids = ids[1:]
+    for mid in ids:
+        paperspace_api.machine_action(mid, 'stop')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    ids = sorted(str(m['id'])
+                 for m in _cluster_machines(cluster_name_on_cloud))
+    if worker_only and ids:
+        ids = ids[1:]
+    for mid in ids:
+        paperspace_api.delete_machine(mid)
+
+
+_STATUS_MAP = {
+    'provisioning': 'pending',
+    'starting': 'pending',
+    'restarting': 'pending',
+    'ready': 'running',
+    'stopping': 'stopping',
+    'off': 'stopped',
+    'upgrading': 'pending',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for m in _cluster_machines(cluster_name_on_cloud):
+        status = _STATUS_MAP.get(_state(m))
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[str(m['id'])] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 900.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud, None,
+                                   non_terminated_only=False)
+        live = [s for s in statuses.values() if s != 'terminated']
+        if live and all(s == state for s in live):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: machines did not reach {state!r} '
+        f'within {timeout}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for m in _cluster_machines(cluster_name_on_cloud):
+        if _state(m) != 'ready':
+            continue
+        mid = str(m['id'])
+        instances[mid] = [common.InstanceInfo(
+            instance_id=mid,
+            internal_ip=str(m.get('privateIp') or ''),
+            external_ip=m.get('publicIp'),
+            tags={'name': str(m.get('name'))},
+        )]
+    head = sorted(instances)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head,
+        provider_name=_PROVIDER, provider_config=provider_config,
+        ssh_user='paperspace')
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.info('Paperspace machines expose a public IP with no '
+                'managed firewall; ports %s are reachable.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

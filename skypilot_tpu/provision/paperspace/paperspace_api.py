@@ -1,0 +1,122 @@
+"""Minimal Paperspace REST client (JSON over urllib).
+
+Counterpart of the reference's sky/provision/paperspace/utils.py
+(requests-based PaperspaceCloudClient) against the same API:
+https://api.paperspace.com/v1 with Bearer API-key auth.  Key from env
+PAPERSPACE_API_KEY or ~/.paperspace/config.json ({"apiKey": ...}).
+All calls route through `request`, the single test seam.
+"""
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_ROOT = 'https://api.paperspace.com/v1'
+_TIMEOUT = 60.0
+_CONFIG_FILE = '~/.paperspace/config.json'
+
+
+class PaperspaceApiError(exceptions.ProvisionError):
+
+    def __init__(self, status_code: int, code: str, message: str) -> None:
+        no_failover = status_code in (401, 403)
+        super().__init__(
+            f'Paperspace API error {status_code} {code}: {message}',
+            no_failover=no_failover)
+        self.status_code = status_code
+        self.code = code
+
+
+def load_api_key() -> Optional[str]:
+    key = os.environ.get('PAPERSPACE_API_KEY')
+    if key:
+        return key
+    path = os.path.expanduser(
+        os.environ.get('PAPERSPACE_CONFIG_FILE', _CONFIG_FILE))
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding='utf-8') as f:
+            return json.load(f).get('apiKey')
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def request(method: str, path: str,
+            body: Optional[Dict[str, Any]] = None,
+            params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    key = load_api_key()
+    if key is None:
+        raise PaperspaceApiError(401, 'NoCredentials',
+                                 'no Paperspace API key')
+    url = f'{API_ROOT}{path}'
+    if params:
+        url += '?' + urllib.parse.urlencode(params)
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={'Authorization': f'Bearer {key}',
+                 'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
+            text = resp.read()
+            return json.loads(text) if text.strip() else {}
+    except urllib.error.HTTPError as e:
+        text = e.read().decode(errors='replace')
+        try:
+            msg = str(json.loads(text).get('message', text[:200]))
+        except json.JSONDecodeError:
+            msg = text[:200]
+        code = ('insufficient-capacity'
+                if 'out of stock' in msg.lower() or
+                'capacity' in msg.lower() else 'unknown')
+        raise PaperspaceApiError(e.code, code, msg) from None
+    except urllib.error.URLError as e:
+        raise PaperspaceApiError(0, 'Unreachable', str(e)) from None
+
+
+def list_machines(name: Optional[str] = None) -> List[Dict[str, Any]]:
+    params = {'limit': '100'}
+    if name:
+        params['name'] = name
+    return list(request('GET', '/machines', params=params)
+                .get('items') or [])
+
+
+def create_machine(name: str, machine_type: str, region: str,
+                   disk_size_gb: int,
+                   startup_script: Optional[str] = None
+                   ) -> Dict[str, Any]:
+    body: Dict[str, Any] = {
+        'name': name,
+        'machineType': machine_type,
+        'templateId': 't0nspur5',  # Ubuntu 22.04 ML-in-a-Box
+        'region': region,
+        'diskSize': disk_size_gb,
+        'publicIpType': 'dynamic',
+        'startOnCreate': True,
+    }
+    if startup_script:
+        body['startupScript'] = startup_script
+    return dict(request('POST', '/machines', body)
+                .get('data') or {})
+
+
+def machine_action(machine_id: str, action: str) -> None:
+    """start | stop."""
+    request('PATCH' if action == 'rename' else 'POST',
+            f'/machines/{machine_id}/{action}')
+
+
+def delete_machine(machine_id: str) -> None:
+    try:
+        request('DELETE', f'/machines/{machine_id}')
+    except PaperspaceApiError as e:
+        if e.status_code != 404:
+            raise

@@ -1,0 +1,210 @@
+"""SCP provisioner: the uniform provision interface.
+
+Counterpart of the reference's legacy sky/skylet/providers/scp/*
+(node provider) redone as a native provisioner.  Servers are named
+`<cluster>-<idx>`, support stop/start, single-node per cluster (the
+cloud declares MULTI_NODE unsupported); zone + image come from config
+(`scp.zone_id`, `scp.image_id`).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.scp import scp_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'scp'
+
+
+def _classify(e: scp_api.ScpApiError) -> Exception:
+    if e.code == 'insufficient-capacity':
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _settings() -> Dict[str, str]:
+    from skypilot_tpu import config as config_lib
+    out = {}
+    for key in ('zone_id', 'image_id'):
+        value = config_lib.get_nested(('scp', key), None)
+        if not value:
+            raise exceptions.ProvisionError(
+                f'SCP provisioning needs config scp.{key}.')
+        out[key] = value
+    return out
+
+
+def _cluster_servers(cluster_name_on_cloud: str
+                     ) -> List[Dict[str, Any]]:
+    return sorted(
+        (s for s in scp_api.list_servers()
+         if str(s.get('virtualServerName', '')).startswith(
+             f'{cluster_name_on_cloud}-')),
+        key=lambda s: str(s.get('virtualServerName')))
+
+
+def _ssh_init_script(auth_config: Dict[str, Any]) -> Optional[str]:
+    ssh_keys = (auth_config or {}).get('ssh_keys', '')
+    if ':' not in ssh_keys:
+        return None
+    pub = ssh_keys.split(':', 1)[1]
+    return ('#!/bin/bash\n'
+            'mkdir -p /root/.ssh\n'
+            f'echo {pub!r} >> /root/.ssh/authorized_keys\n'
+            'chmod 600 /root/.ssh/authorized_keys\n')
+
+
+def _state(server: Dict[str, Any]) -> str:
+    return str(server.get('virtualServerState', 'UNKNOWN')).upper()
+
+
+def _sid(server: Dict[str, Any]) -> str:
+    return str(server.get('virtualServerId'))
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del region  # zone id (config) selects the service zone
+    node_cfg = config.node_config
+    try:
+        settings = _settings()
+        existing = _cluster_servers(cluster_name_on_cloud)
+        running = [s for s in existing
+                   if _state(s) in ('RUNNING', 'STARTING',
+                                    'CREATING')]
+        stopped = [s for s in existing if _state(s) == 'STOPPED']
+
+        resumed: List[str] = []
+        if config.resume_stopped_nodes and stopped:
+            need = config.count - len(running)
+            for s in stopped[:max(need, 0)]:
+                scp_api.server_action(_sid(s), 'start')
+                resumed.append(_sid(s))
+            running += [s for s in stopped if _sid(s) in resumed]
+
+        created: List[str] = []
+        to_create = config.count - len(running)
+        if to_create > 0:
+            script = _ssh_init_script(config.authentication_config)
+            base = len(existing)
+            for i in range(to_create):
+                server = scp_api.create_server(
+                    name=f'{cluster_name_on_cloud}-{base + i:04d}',
+                    server_type=node_cfg['instance_type'],
+                    zone_id=settings['zone_id'],
+                    image_id=settings['image_id'],
+                    init_script=script)
+                created.append(str(server.get('resourceId')
+                                   or server.get('virtualServerId')))
+    except scp_api.ScpApiError as e:
+        raise _classify(e) from None
+    ids = sorted([_sid(s) for s in running] + created)
+    if not ids:
+        raise exceptions.ResourcesUnavailableError(
+            f'SCP returned no servers for {cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER, cluster_name=cluster_name_on_cloud,
+        region='scp', zone=None, head_instance_id=ids[0],
+        resumed_instance_ids=resumed, created_instance_ids=created)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    servers = [s for s in _cluster_servers(cluster_name_on_cloud)
+               if _state(s) in ('RUNNING', 'STARTING', 'CREATING')]
+    ids = sorted(_sid(s) for s in servers)
+    if worker_only and ids:
+        ids = ids[1:]
+    for sid in ids:
+        scp_api.server_action(sid, 'stop')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    ids = sorted(
+        _sid(s) for s in _cluster_servers(cluster_name_on_cloud)
+        if _state(s) not in ('TERMINATED', 'TERMINATING'))
+    if worker_only and ids:
+        ids = ids[1:]
+    for sid in ids:
+        scp_api.delete_server(sid)
+
+
+_STATUS_MAP = {
+    'CREATING': 'pending',
+    'STARTING': 'pending',
+    'RUNNING': 'running',
+    'STOPPING': 'stopping',
+    'STOPPED': 'stopped',
+    'TERMINATING': 'terminated',
+    'TERMINATED': 'terminated',
+    'ERROR': 'terminated',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    out: Dict[str, Optional[str]] = {}
+    for server in _cluster_servers(cluster_name_on_cloud):
+        status = _STATUS_MAP.get(_state(server))
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[_sid(server)] = status
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 600.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        statuses = query_instances(cluster_name_on_cloud, None,
+                                   non_terminated_only=False)
+        live = [s for s in statuses.values() if s != 'terminated']
+        if live and all(s == state for s in live):
+            return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: servers did not reach {state!r} '
+        f'within {timeout}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for server in _cluster_servers(cluster_name_on_cloud):
+        if _state(server) != 'RUNNING':
+            continue
+        sid = _sid(server)
+        instances[sid] = [common.InstanceInfo(
+            instance_id=sid,
+            internal_ip=str(server.get('ip') or ''),
+            external_ip=server.get('externalIp')
+            or server.get('natIp'),
+            tags={'name': str(server.get('virtualServerName'))},
+        )]
+    head = sorted(instances)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head,
+        provider_name=_PROVIDER, provider_config=provider_config,
+        ssh_user='root')
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.warning('SCP firewall automation is not implemented; '
+                   'allow %s in the SCP console.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

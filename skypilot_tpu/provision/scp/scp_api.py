@@ -1,0 +1,161 @@
+"""Minimal Samsung Cloud Platform REST client (JSON over urllib).
+
+Counterpart of the reference's sky/clouds/utils/scp_utils.py: the
+same OpenAPI host (openapi.samsungsdscloud.com) with the same
+HMAC-SHA256 request signature (client-type/timestamp/signature
+headers).  Credentials from env SCP_ACCESS_KEY / SCP_SECRET_KEY /
+SCP_PROJECT_ID or ~/.scp/scp_credential (key = value lines — the
+reference's file).  All calls route through `request`, the single
+test seam.
+"""
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+import hmac
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_ROOT = 'https://openapi.samsungsdscloud.com'
+_TIMEOUT = 60.0
+_CREDENTIALS_FILE = '~/.scp/scp_credential'
+
+
+class ScpApiError(exceptions.ProvisionError):
+
+    def __init__(self, status_code: int, code: str, message: str) -> None:
+        no_failover = status_code in (401, 403)
+        super().__init__(
+            f'SCP API error {status_code} {code}: {message}',
+            no_failover=no_failover)
+        self.status_code = status_code
+        self.code = code
+
+
+@dataclasses.dataclass(frozen=True)
+class ScpCredentials:
+    access_key: str
+    secret_key: str
+    project_id: str
+
+
+def load_credentials() -> Optional[ScpCredentials]:
+    env = {k: os.environ.get(f'SCP_{k.upper()}')
+           for k in ('access_key', 'secret_key', 'project_id')}
+    if all(env.values()):
+        return ScpCredentials(**env)  # type: ignore[arg-type]
+    path = os.path.expanduser(
+        os.environ.get('SCP_CREDENTIALS_FILE', _CREDENTIALS_FILE))
+    if not os.path.exists(path):
+        return None
+    values: Dict[str, str] = {}
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                key, sep, value = line.strip().partition('=')
+                if sep:
+                    values[key.strip()] = value.strip()
+    except OSError:
+        return None
+    try:
+        return ScpCredentials(values['access_key'],
+                              values['secret_key'],
+                              values['project_id'])
+    except KeyError:
+        return None
+
+
+def _signature(creds: ScpCredentials, method: str, url: str,
+               timestamp: str) -> str:
+    message = (method + url + timestamp + creds.access_key
+               + creds.project_id + 'OpenApi')
+    digest = hmac.new(creds.secret_key.encode(), message.encode(),
+                      hashlib.sha256).digest()
+    return base64.b64encode(digest).decode()
+
+
+def request(method: str, path: str,
+            body: Optional[Dict[str, Any]] = None,
+            params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    creds = load_credentials()
+    if creds is None:
+        raise ScpApiError(401, 'NoCredentials', 'no SCP credentials')
+    url = f'{API_ROOT}{path}'
+    if params:
+        url += '?' + urllib.parse.urlencode(params)
+    timestamp = str(int(time.time() * 1000))
+    headers = {
+        'X-Cmp-AccessKey': creds.access_key,
+        'X-Cmp-ClientType': 'OpenApi',
+        'X-Cmp-Timestamp': timestamp,
+        'X-Cmp-Signature': _signature(creds, method, url, timestamp),
+        'X-Cmp-ProjectId': creds.project_id,
+        'Content-Type': 'application/json',
+    }
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
+            text = resp.read()
+            return json.loads(text) if text.strip() else {}
+    except urllib.error.HTTPError as e:
+        text = e.read().decode(errors='replace')
+        try:
+            err = json.loads(text)
+            msg = str(err.get('message', text[:200]))
+        except json.JSONDecodeError:
+            msg = text[:200]
+        code = ('insufficient-capacity'
+                if 'capacity' in msg.lower() or
+                'resource' in msg.lower() else 'unknown')
+        raise ScpApiError(e.code, code, msg) from None
+    except urllib.error.URLError as e:
+        raise ScpApiError(0, 'Unreachable', str(e)) from None
+
+
+def list_servers() -> List[Dict[str, Any]]:
+    return list(request('GET', '/virtual-server/v2/virtual-servers')
+                .get('contents') or [])
+
+
+def create_server(name: str, server_type: str, zone_id: str,
+                  image_id: str, init_script: Optional[str]
+                  ) -> Dict[str, Any]:
+    body: Dict[str, Any] = {
+        'virtualServerName': name,
+        'serverType': server_type,
+        'serviceZoneId': zone_id,
+        'imageId': image_id,
+    }
+    if init_script:
+        body['initialScript'] = {
+            'encodingType': 'base64',
+            'initialScriptShell': 'bash',
+            'initialScriptContent': base64.b64encode(
+                init_script.encode()).decode(),
+        }
+    return request('POST', '/virtual-server/v2/virtual-servers', body)
+
+
+def server_action(server_id: str, action: str) -> None:
+    """start | stop."""
+    request('POST',
+            f'/virtual-server/v2/virtual-servers/{server_id}/{action}')
+
+
+def delete_server(server_id: str) -> None:
+    try:
+        request('DELETE',
+                f'/virtual-server/v2/virtual-servers/{server_id}')
+    except ScpApiError as e:
+        if e.status_code != 404:
+            raise

@@ -1,0 +1,190 @@
+"""vSphere provisioner: the uniform provision interface.
+
+Counterpart of the reference's sky/provision/vsphere/instance.py
+(pyvmomi).  VMs clone from a configured content-library/template VM
+(`vsphere.template_vm` config), are named `<cluster>-<idx>`, support
+power stop/start, and report IPs via guest tools.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.vsphere import vsphere_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVIDER = 'vsphere'
+
+
+def _classify(e: vsphere_api.VsphereApiError) -> Exception:
+    if e.code == 'insufficient-capacity':
+        return exceptions.ResourcesUnavailableError(str(e))
+    return e
+
+
+def _template_vm() -> str:
+    from skypilot_tpu import config as config_lib
+    template = config_lib.get_nested(('vsphere', 'template_vm'), None)
+    if not template:
+        raise exceptions.ProvisionError(
+            'vSphere provisioning needs config vsphere.template_vm '
+            '(the VM/template to clone; it must have the framework '
+            'SSH key in authorized_keys).')
+    return template
+
+
+def _cluster_vms(cluster_name_on_cloud: str) -> List[Dict[str, Any]]:
+    return sorted(
+        vsphere_api.list_vms(f'{cluster_name_on_cloud}-'),
+        key=lambda vm: str(vm.get('name')))
+
+
+def _power(vm: Dict[str, Any]) -> str:
+    return str(vm.get('power_state', 'UNKNOWN')).upper()
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del region  # on-prem: the vCenter host IS the site
+    try:
+        template = _template_vm()
+        existing = _cluster_vms(cluster_name_on_cloud)
+        running = [vm for vm in existing
+                   if _power(vm) == 'POWERED_ON']
+        stopped = [vm for vm in existing
+                   if _power(vm) == 'POWERED_OFF']
+
+        resumed: List[str] = []
+        if config.resume_stopped_nodes and stopped:
+            need = config.count - len(running)
+            for vm in stopped[:max(need, 0)]:
+                vsphere_api.power_action(str(vm['vm']), 'start')
+                resumed.append(str(vm['vm']))
+            running += [vm for vm in stopped
+                        if str(vm['vm']) in resumed]
+
+        created: List[str] = []
+        to_create = config.count - len(running)
+        if to_create > 0:
+            base = len(existing)
+            for i in range(to_create):
+                created.append(vsphere_api.clone_vm(
+                    template,
+                    f'{cluster_name_on_cloud}-{base + i:04d}'))
+    except vsphere_api.VsphereApiError as e:
+        raise _classify(e) from None
+    ids = sorted([str(vm['vm']) for vm in running] + created)
+    if not ids:
+        raise exceptions.ResourcesUnavailableError(
+            f'vSphere returned no VMs for {cluster_name_on_cloud}.')
+    return common.ProvisionRecord(
+        provider_name=_PROVIDER, cluster_name=cluster_name_on_cloud,
+        region='vsphere', zone=None, head_instance_id=ids[0],
+        resumed_instance_ids=resumed, created_instance_ids=created)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    vms = [vm for vm in _cluster_vms(cluster_name_on_cloud)
+           if _power(vm) == 'POWERED_ON']
+    ids = sorted(str(vm['vm']) for vm in vms)
+    if worker_only and ids:
+        ids = ids[1:]
+    for vid in ids:
+        vsphere_api.power_action(vid, 'stop')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    vms = _cluster_vms(cluster_name_on_cloud)
+    ids = sorted(str(vm['vm']) for vm in vms)
+    if worker_only and ids:
+        ids = ids[1:]
+    for vid in ids:
+        # Powered-on VMs cannot be deleted: stop first, tolerant of
+        # already-off.
+        try:
+            vsphere_api.power_action(vid, 'stop')
+        except vsphere_api.VsphereApiError:
+            pass
+        vsphere_api.delete_vm(vid)
+
+
+_STATUS_MAP = {
+    'POWERED_ON': 'running',
+    'POWERED_OFF': 'stopped',
+    'SUSPENDED': 'stopped',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    del non_terminated_only  # deleted VMs vanish from inventory
+    out: Dict[str, Optional[str]] = {}
+    for vm in _cluster_vms(cluster_name_on_cloud):
+        out[str(vm['vm'])] = _STATUS_MAP.get(_power(vm))
+    return out
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: str = 'running', timeout: float = 900.0) -> None:
+    """POWERED_ON is not enough to SSH: wait for guest-tools IPs too."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        vms = _cluster_vms(cluster_name_on_cloud)
+        if vms:
+            if state != 'running':
+                if all(_STATUS_MAP.get(_power(vm)) == state
+                       for vm in vms):
+                    return
+            elif all(_power(vm) == 'POWERED_ON'
+                     and vsphere_api.guest_ip(str(vm['vm']))
+                     for vm in vms):
+                return
+        time.sleep(5)
+    raise exceptions.ProvisionTimeoutError(
+        f'{cluster_name_on_cloud}: VMs did not reach {state!r} '
+        f'(with guest IPs) within {timeout}s.')
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    for vm in _cluster_vms(cluster_name_on_cloud):
+        if _power(vm) != 'POWERED_ON':
+            continue
+        vid = str(vm['vm'])
+        ip = vsphere_api.guest_ip(vid)
+        if not ip:
+            continue
+        instances[vid] = [common.InstanceInfo(
+            instance_id=vid,
+            internal_ip=ip,
+            external_ip=ip,  # on-prem: one routable address
+            tags={'name': str(vm.get('name'))},
+        )]
+    head = sorted(instances)[0] if instances else None
+    return common.ClusterInfo(
+        instances=instances, head_instance_id=head,
+        provider_name=_PROVIDER, provider_config=provider_config,
+        ssh_user='ubuntu')
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    logger.info('vSphere networking is site-managed; ports %s are '
+                'assumed reachable on-prem.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

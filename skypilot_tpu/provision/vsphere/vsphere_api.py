@@ -1,0 +1,177 @@
+"""Minimal vCenter Automation (REST) API client (JSON over urllib).
+
+Counterpart of the reference's sky/provision/vsphere/* (pyvmomi SOAP
++ vSphere Automation SDK); SDK-free against the vCenter REST API:
+POST /api/session (basic auth) -> session token header
+`vmware-api-session-id`, then /api/vcenter/vm endpoints.
+
+Credentials from env VSPHERE_HOST / VSPHERE_USER / VSPHERE_PASSWORD
+or ~/.vsphere/credential.yaml (the reference path).  All calls route
+through `request`, the single test seam.
+"""
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import os
+import re
+import ssl
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+_TIMEOUT = 60.0
+_CREDENTIALS_FILE = '~/.vsphere/credential.yaml'
+
+_session: Dict[str, str] = {}
+
+
+class VsphereApiError(exceptions.ProvisionError):
+
+    def __init__(self, status_code: int, code: str, message: str) -> None:
+        no_failover = status_code in (401, 403)
+        super().__init__(
+            f'vSphere API error {status_code} {code}: {message}',
+            no_failover=no_failover)
+        self.status_code = status_code
+        self.code = code
+
+
+@dataclasses.dataclass(frozen=True)
+class VsphereCredentials:
+    host: str
+    user: str
+    password: str
+
+
+def load_credentials() -> Optional[VsphereCredentials]:
+    env = {k: os.environ.get(f'VSPHERE_{k.upper()}')
+           for k in ('host', 'user', 'password')}
+    if all(env.values()):
+        return VsphereCredentials(**env)  # type: ignore[arg-type]
+    path = os.path.expanduser(
+        os.environ.get('VSPHERE_CREDENTIALS_FILE', _CREDENTIALS_FILE))
+    if not os.path.exists(path):
+        return None
+    values: Dict[str, str] = {}
+    try:
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                m = re.match(r'\s*(host|user|password)\s*:\s*(\S+)',
+                             line.rstrip())
+                if m:
+                    values[m.group(1)] = m.group(2).strip('\'"')
+    except OSError:
+        return None
+    if {'host', 'user', 'password'} <= set(values):
+        return VsphereCredentials(values['host'], values['user'],
+                                  values['password'])
+    return None
+
+
+def _urlopen(req: urllib.request.Request):
+    # On-prem vCenters overwhelmingly run self-signed certs.
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    return urllib.request.urlopen(req, timeout=_TIMEOUT, context=ctx)
+
+
+def _login() -> str:
+    creds = load_credentials()
+    if creds is None:
+        raise VsphereApiError(401, 'NoCredentials',
+                              'no vSphere credentials')
+    token = _session.get('token')
+    if token:
+        return token
+    basic = base64.b64encode(
+        f'{creds.user}:{creds.password}'.encode()).decode()
+    req = urllib.request.Request(
+        f'https://{creds.host}/api/session', method='POST',
+        headers={'Authorization': f'Basic {basic}'})
+    try:
+        with _urlopen(req) as resp:
+            token = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        raise VsphereApiError(e.code, 'SessionCreate',
+                              e.read().decode(errors='replace')[:200]) \
+            from None
+    except urllib.error.URLError as e:
+        raise VsphereApiError(0, 'Unreachable', str(e)) from None
+    _session['token'] = token
+    return token
+
+
+def request(method: str, path: str,
+            body: Optional[Dict[str, Any]] = None) -> Any:
+    creds = load_credentials()
+    if creds is None:
+        raise VsphereApiError(401, 'NoCredentials',
+                              'no vSphere credentials')
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f'https://{creds.host}{path}', data=data, method=method,
+        headers={'vmware-api-session-id': _login(),
+                 'Content-Type': 'application/json'})
+    try:
+        with _urlopen(req) as resp:
+            text = resp.read()
+            return json.loads(text) if text.strip() else {}
+    except urllib.error.HTTPError as e:
+        if e.code == 401:
+            _session.pop('token', None)  # session expired; re-login
+        text = e.read().decode(errors='replace')
+        code = 'unknown'
+        if 'resource' in text.lower() or 'insufficient' in \
+                text.lower():
+            code = 'insufficient-capacity'
+        raise VsphereApiError(e.code, code, text[:200]) from None
+    except urllib.error.URLError as e:
+        raise VsphereApiError(0, 'Unreachable', str(e)) from None
+
+
+def list_vms(name_prefix: str) -> List[Dict[str, Any]]:
+    vms = request('GET', '/api/vcenter/vm') or []
+    return [vm for vm in vms
+            if str(vm.get('name', '')).startswith(name_prefix)]
+
+
+def clone_vm(source_vm: str, name: str) -> str:
+    """Full clone of the template VM; returns the new VM id."""
+    return str(request('POST', '/api/vcenter/vm?action=clone', {
+        'source': source_vm,
+        'name': name,
+        'power_on': True,
+    }))
+
+
+def power_action(vm_id: str, action: str) -> None:
+    """start | stop."""
+    request('POST', f'/api/vcenter/vm/{vm_id}/power?action={action}')
+
+
+def delete_vm(vm_id: str) -> None:
+    try:
+        request('DELETE', f'/api/vcenter/vm/{vm_id}')
+    except VsphereApiError as e:
+        if e.status_code != 404:
+            raise
+
+
+def guest_ip(vm_id: str) -> Optional[str]:
+    """The guest-tools-reported primary IP (None until tools are up)."""
+    try:
+        info = request('GET',
+                       f'/api/vcenter/vm/{vm_id}/guest/networking')
+    except VsphereApiError:
+        return None
+    for itf in (info or {}).get('interfaces', []):
+        ip = (itf.get('ip') or {}).get('ip_addresses', [])
+        for addr in ip:
+            if addr.get('state') == 'PREFERRED':
+                return str(addr.get('ip_address'))
+    return None

@@ -67,6 +67,21 @@ class TestBenchmarkHarness:
         bench_state.delete_benchmark('unittest')
         assert bench_state.get_runs('unittest') == []
 
+    def test_relaunch_refuses_while_clusters_live(self):
+        """A relaunch must not orphan still-running clusters from a
+        previous launch (they would keep billing with no bench-level
+        handle)."""
+        task = sky.Task(run=_STEP_SCRIPT)
+        task.set_resources(sky.Resources(cloud='local'))
+        harness.launch(task, [{}], 'b3', detach=True)
+        try:
+            with pytest.raises(exceptions.BenchmarkError,
+                               match='live clusters'):
+                harness.launch(task, [{}], 'b3', detach=True)
+        finally:
+            harness.down('b3')
+            bench_state.delete_benchmark('b3')
+
     def test_relaunch_replaces_stale_runs(self):
         bench_state.add_benchmark('b2', 'task: x')
         for i in range(3):

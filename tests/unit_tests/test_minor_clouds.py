@@ -1,0 +1,603 @@
+"""The minor-cloud tail (Cudo/Paperspace/IBM/OCI/SCP/vSphere): auth,
+provisioner lifecycle over mocked API seams, catalog feasibility, and
+the MinorCloud/FlatCatalog family behaviors they share.
+
+With these six, every cloud in the reference's L2 roster
+(sky/clouds/*.py) has a counterpart.
+"""
+import pytest
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.provision import common as provision_common
+
+Resources = resources_lib.Resources
+F = cloud_lib.CloudImplementationFeatures
+
+ALL_MINOR = ('cudo', 'paperspace', 'ibm', 'oci', 'scp', 'vsphere')
+
+
+def _pconfig(instance_type, count=1, resume=False, region='r1'):
+    return provision_common.ProvisionConfig(
+        provider_config={'region': region},
+        authentication_config={
+            'ssh_keys': 'skytpu:ssh-ed25519 AAAA key'},
+        docker_config={},
+        node_config={'instance_type': instance_type, 'zone': None},
+        count=count, tags={}, resume_stopped_nodes=resume)
+
+
+class TestFamilyContracts:
+    """Shared MinorCloud behaviors, checked for every tail cloud."""
+
+    @pytest.mark.parametrize('name', ALL_MINOR)
+    def test_registered_with_catalog_and_default(self, name):
+        c = registry.CLOUD_REGISTRY.from_str(name)
+        assert c is not None
+        regions = c.regions_with_offering(None, None, False, None,
+                                          None)
+        assert regions
+        default = c.get_default_instance_type()
+        assert default is not None
+        assert c.instance_type_exists(default)
+        assert c.instance_type_to_hourly_cost(default, False) > 0
+
+    @pytest.mark.parametrize('name', ALL_MINOR)
+    def test_tpu_requests_infeasible(self, name):
+        c = registry.CLOUD_REGISTRY.from_str(name)
+        feasible = c.get_feasible_launchable_resources(
+            Resources(accelerators='tpu-v5e-8'))
+        assert feasible.resources_list == []
+
+    @pytest.mark.parametrize('name', ALL_MINOR)
+    def test_no_credentials_check_fails_with_hint(self, name,
+                                                  monkeypatch,
+                                                  tmp_path):
+        for var in ('CUDO_API_KEY', 'CUDO_PROJECT_ID',
+                    'PAPERSPACE_API_KEY', 'IBM_API_KEY',
+                    'SCP_ACCESS_KEY', 'SCP_SECRET_KEY',
+                    'SCP_PROJECT_ID', 'VSPHERE_HOST', 'VSPHERE_USER',
+                    'VSPHERE_PASSWORD'):
+            monkeypatch.delenv(var, raising=False)
+        for var in ('CUDO_CONFIG_FILE', 'PAPERSPACE_CONFIG_FILE',
+                    'IBM_CREDENTIALS_FILE', 'SCP_CREDENTIALS_FILE',
+                    'VSPHERE_CREDENTIALS_FILE', 'OCI_CLI_CONFIG_FILE'):
+            monkeypatch.setenv(var, str(tmp_path / 'nope'))
+        c = registry.CLOUD_REGISTRY.from_str(name)
+        ok, msg = c.check_credentials()
+        assert not ok and msg
+
+    @pytest.mark.parametrize(
+        'name', [n for n in ALL_MINOR if n not in ('oci',)])
+    def test_no_spot_clouds_reject_spot(self, name):
+        c = registry.CLOUD_REGISTRY.from_str(name)
+        feasible = c.get_feasible_launchable_resources(
+            Resources(use_spot=True))
+        assert feasible.resources_list == []
+
+    def test_oci_preemptible_half_price(self):
+        c = registry.CLOUD_REGISTRY.from_str('oci')
+        od = c.instance_type_to_hourly_cost('BM.GPU.A100-v2.8', False)
+        spot = c.instance_type_to_hourly_cost('BM.GPU.A100-v2.8',
+                                              True)
+        assert spot == pytest.approx(od / 2)
+
+    @pytest.mark.parametrize('name', ('scp', 'vsphere'))
+    def test_single_node_clouds_reject_multi_node(self, name):
+        c = registry.CLOUD_REGISTRY.from_str(name)
+        feasible = c.get_feasible_launchable_resources(
+            Resources(cpus='8+'), num_nodes=2)
+        assert feasible.resources_list == []
+        unsupported = c._unsupported_features_for_resources(
+            Resources(cloud=name))
+        assert F.MULTI_NODE in unsupported
+
+    def test_optimizer_sees_the_whole_tail(self):
+        """All six price into one optimizer run; the cheapest H100:8
+        across the enabled tail wins."""
+        from skypilot_tpu import dag as dag_lib
+        from skypilot_tpu import optimizer as optimizer_lib
+        from skypilot_tpu import task as task_lib
+        global_user_state.set_enabled_clouds(
+            ['cudo', 'paperspace', 'do'])
+        t = task_lib.Task('t', run='x')
+        t.set_resources(Resources(accelerators='H100:8'))
+        with dag_lib.Dag() as d:
+            d.add(t)
+        optimizer_lib.optimize(d, quiet=True)
+        # cudo 22.32 < do 23.92 < paperspace 47.60
+        assert t.best_resources.cloud.canonical_name() == 'cudo'
+
+
+class TestCudoProvisioner:
+
+    @pytest.fixture(autouse=True)
+    def _creds(self, monkeypatch):
+        monkeypatch.setenv('CUDO_API_KEY', 'ck')
+        monkeypatch.setenv('CUDO_PROJECT_ID', 'proj1')
+
+    @pytest.fixture()
+    def fake(self, monkeypatch):
+        from skypilot_tpu.provision.cudo import cudo_api
+        from skypilot_tpu.provision.cudo import instance as inst
+
+        class FakeCudo:
+            def __init__(self):
+                self.vms = {}
+                self.fail = False
+
+            def request(self, method, path, body=None):
+                if path.endswith('/vms') and method == 'GET':
+                    return {'VMs': list(self.vms.values())}
+                if path.endswith('/vm') and method == 'POST':
+                    if self.fail:
+                        raise cudo_api.CudoApiError(
+                            409, 'insufficient-capacity', 'no host')
+                    vid = body['vmId']
+                    self.vms[vid] = {
+                        'id': vid, 'state': 'ACTIVE',
+                        'metadata': body['metadata'],
+                        'machineType': body['machineType'],
+                        'vcpus': body['vcpus'],
+                        'gpus': body['gpus'],
+                        'nics': [{'internalIpAddress': '10.3.0.1',
+                                  'externalIpAddress': '45.0.0.1'}],
+                    }
+                    return {'vm': {'id': vid}}
+                if '/terminate' in path:
+                    vid = path.split('/')[-2]
+                    if vid in self.vms:
+                        self.vms[vid]['state'] = 'DELETED'
+                    return {}
+                raise AssertionError(f'unhandled {method} {path}')
+
+        fake = FakeCudo()
+        monkeypatch.setattr(cudo_api, 'request', fake.request)
+        monkeypatch.setattr(inst.time, 'sleep', lambda s: None)
+        return fake
+
+    def test_lifecycle(self, fake):
+        from skypilot_tpu.provision.cudo import instance as inst
+        record = inst.run_instances(
+            'no-luster-1', 'c1',
+            _pconfig('epyc-milan-rtx-a4000_1x4v16gb', count=2))
+        assert len(record.created_instance_ids) == 2
+        vm = fake.vms[record.head_instance_id]
+        assert vm['machineType'] == 'epyc-milan-rtx-a4000'
+        assert vm['vcpus'] == 4 and vm['gpus'] == 1
+        info = inst.get_cluster_info('no-luster-1', 'c1')
+        assert info.ssh_user == 'root'
+        assert len(info.instances) == 2
+        # Idempotent; stop unsupported; terminate clears.
+        assert inst.run_instances(
+            'no-luster-1', 'c1',
+            _pconfig('epyc-milan-rtx-a4000_1x4v16gb',
+                     count=2)).created_instance_ids == []
+        with pytest.raises(exceptions.NotSupportedError):
+            inst.stop_instances('c1')
+        inst.terminate_instances('c1')
+        assert inst.query_instances('c1') == {}
+
+    def test_capacity_classified(self, fake):
+        from skypilot_tpu.provision.cudo import instance as inst
+        fake.fail = True
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            inst.run_instances('no-luster-1', 'c9',
+                               _pconfig('epyc-milan_0x8v32gb'))
+
+    def test_type_grammar(self):
+        from skypilot_tpu.provision.cudo import instance as inst
+        assert inst.parse_instance_type(
+            'sapphire-rapids-h100_8x192v768gb') == \
+            ('sapphire-rapids-h100', 8, 192, 768)
+        with pytest.raises(exceptions.ProvisionError):
+            inst.parse_instance_type('h100-8')
+
+
+class TestPaperspaceProvisioner:
+
+    @pytest.fixture(autouse=True)
+    def _creds(self, monkeypatch):
+        monkeypatch.setenv('PAPERSPACE_API_KEY', 'pk')
+
+    @pytest.fixture()
+    def fake(self, monkeypatch):
+        from skypilot_tpu.provision.paperspace import (
+            instance as inst, paperspace_api)
+
+        class FakePs:
+            def __init__(self):
+                self.machines = {}
+                self.counter = 0
+
+            def request(self, method, path, body=None, params=None):
+                if path == '/machines' and method == 'GET':
+                    return {'items': list(self.machines.values())}
+                if path == '/machines' and method == 'POST':
+                    self.counter += 1
+                    mid = f'ps-{self.counter:04d}'
+                    self.machines[mid] = {
+                        'id': mid, 'name': body['name'],
+                        'state': 'ready',
+                        'machineType': body['machineType'],
+                        'privateIp': f'10.4.0.{self.counter}',
+                        'publicIp': f'72.0.0.{self.counter}',
+                        'startupScript': body.get('startupScript'),
+                    }
+                    return {'data': self.machines[mid]}
+                if method == 'POST' and path.endswith('/stop'):
+                    self.machines[path.split('/')[2]]['state'] = 'off'
+                    return {}
+                if method == 'POST' and path.endswith('/start'):
+                    self.machines[path.split('/')[2]]['state'] = \
+                        'ready'
+                    return {}
+                if method == 'DELETE':
+                    self.machines.pop(path.rsplit('/', 1)[1], None)
+                    return {}
+                raise AssertionError(f'unhandled {method} {path}')
+
+        fake = FakePs()
+        monkeypatch.setattr(paperspace_api, 'request', fake.request)
+        monkeypatch.setattr(inst.time, 'sleep', lambda s: None)
+        return fake
+
+    def test_lifecycle_with_stop_resume(self, fake):
+        from skypilot_tpu.provision.paperspace import instance as inst
+        record = inst.run_instances('East Coast (NY2)', 'c1',
+                                    _pconfig('A4000', count=2))
+        assert len(record.created_instance_ids) == 2
+        head = record.head_instance_id
+        assert 'ssh-ed25519 AAAA key' in \
+            fake.machines[head]['startupScript']
+        inst.stop_instances('c1')
+        assert set(inst.query_instances(
+            'c1', non_terminated_only=False).values()) == {'stopped'}
+        record2 = inst.run_instances(
+            'East Coast (NY2)', 'c1',
+            _pconfig('A4000', count=2, resume=True))
+        assert sorted(record2.resumed_instance_ids)
+        assert record2.created_instance_ids == []
+        info = inst.get_cluster_info('East Coast (NY2)', 'c1')
+        assert info.ssh_user == 'paperspace'
+        inst.terminate_instances('c1')
+        assert inst.query_instances('c1') == {}
+
+
+class TestIbmProvisioner:
+
+    @pytest.fixture(autouse=True)
+    def _creds(self, monkeypatch):
+        monkeypatch.setenv('IBM_API_KEY', 'ik')
+        for key in ('vpc_id', 'subnet_id', 'image_id', 'key_id'):
+            monkeypatch.setattr(
+                config_lib, 'get_nested',
+                lambda path, default=None: (
+                    f'id-{path[-1]}' if path[0] == 'ibm' else default),
+                raising=True)
+
+    @pytest.fixture()
+    def fake(self, monkeypatch):
+        from skypilot_tpu.provision.ibm import ibm_api
+        from skypilot_tpu.provision.ibm import instance as inst
+
+        class FakeIbm:
+            def __init__(self):
+                self.instances = {}
+                self.counter = 0
+
+            def request(self, method, region, path, body=None,
+                        params=None):
+                if path == '/instances' and method == 'GET':
+                    return {'instances':
+                            list(self.instances.values())}
+                if path == '/instances' and method == 'POST':
+                    self.counter += 1
+                    iid = f'ibm-{self.counter:04d}'
+                    self.instances[iid] = {
+                        'id': iid, 'name': body['name'],
+                        'status': 'running',
+                        'profile': body['profile'],
+                        'primary_network_interface': {
+                            'primary_ip':
+                                {'address': f'10.5.0.{self.counter}'},
+                            'floating_ips': [
+                                {'address': f'52.0.0.{self.counter}'}],
+                        },
+                    }
+                    return self.instances[iid]
+                if method == 'POST' and path.endswith('/actions'):
+                    iid = path.split('/')[2]
+                    self.instances[iid]['status'] = (
+                        'stopped' if body['type'] == 'stop'
+                        else 'running')
+                    return {}
+                if method == 'DELETE':
+                    self.instances.pop(path.rsplit('/', 1)[1], None)
+                    return {}
+                raise AssertionError(f'unhandled {method} {path}')
+
+        fake = FakeIbm()
+        monkeypatch.setattr(ibm_api, 'request', fake.request)
+        monkeypatch.setattr(inst.time, 'sleep', lambda s: None)
+        return fake
+
+    def test_lifecycle_with_stop_resume(self, fake):
+        from skypilot_tpu.provision.ibm import instance as inst
+        record = inst.run_instances('us-south', 'c1',
+                                    _pconfig('gx2-8x64x1v100',
+                                             count=2))
+        assert len(record.created_instance_ids) == 2
+        assert fake.instances[record.head_instance_id]['profile'] == \
+            {'name': 'gx2-8x64x1v100'}
+        inst.stop_instances('c1', {'region': 'us-south'})
+        record2 = inst.run_instances(
+            'us-south', 'c1',
+            _pconfig('gx2-8x64x1v100', count=2, resume=True))
+        assert record2.created_instance_ids == []
+        assert len(record2.resumed_instance_ids) == 2
+        info = inst.get_cluster_info('us-south', 'c1',
+                                     {'region': 'us-south'})
+        assert info.instances[record.head_instance_id][0] \
+            .external_ip.startswith('52.')
+        inst.terminate_instances('c1', {'region': 'us-south'})
+        assert inst.query_instances('c1',
+                                    {'region': 'us-south'}) == {}
+
+    def test_missing_vpc_config_is_clear(self, fake, monkeypatch):
+        from skypilot_tpu.provision.ibm import instance as inst
+        monkeypatch.setattr(config_lib, 'get_nested',
+                            lambda path, default=None: default)
+        with pytest.raises(exceptions.ProvisionError, match='ibm.'):
+            inst.run_instances('us-south', 'c9',
+                               _pconfig('bx2-8x32'))
+
+
+class TestOciProvisioner:
+
+    @pytest.fixture()
+    def fake(self, monkeypatch):
+        from skypilot_tpu.provision.oci import instance as inst
+        from skypilot_tpu.provision.oci import oci_cli
+
+        class FakeOci:
+            def __init__(self):
+                self.instances = {}
+                self.counter = 0
+
+            def run(self, args):
+                cmd = ' '.join(args[:3])
+                if cmd.startswith('compute instance launch'):
+                    self.counter += 1
+                    iid = f'ocid1.instance.{self.counter:04d}'
+                    name = args[args.index('--display-name') + 1]
+                    import json as j
+                    tags = j.loads(
+                        args[args.index('--freeform-tags') + 1])
+                    self.instances[iid] = {
+                        'id': iid, 'display-name': name,
+                        'lifecycle-state': 'RUNNING',
+                        'shape': args[args.index('--shape') + 1],
+                        'freeform-tags': tags,
+                        'preemptible':
+                            '--preemptible-instance-config' in args,
+                    }
+                    return {'data': self.instances[iid]}
+                if cmd.startswith('compute instance list-vnics'):
+                    return {'data': [{'is-primary': True,
+                                      'private-ip': '10.6.0.1',
+                                      'public-ip': '129.1.0.1'}]}
+                if cmd.startswith('compute instance list'):
+                    return {'data': list(self.instances.values())}
+                if cmd.startswith('compute instance action'):
+                    iid = args[args.index('--instance-id') + 1]
+                    action = args[args.index('--action') + 1]
+                    self.instances[iid]['lifecycle-state'] = (
+                        'STOPPED' if action == 'STOP' else 'RUNNING')
+                    return {}
+                if cmd.startswith('compute instance terminate'):
+                    iid = args[args.index('--instance-id') + 1]
+                    self.instances[iid]['lifecycle-state'] = \
+                        'TERMINATED'
+                    return {}
+                raise AssertionError(f'unhandled oci {cmd}')
+
+        fake = FakeOci()
+        monkeypatch.setattr(oci_cli, 'run', fake.run)
+        monkeypatch.setattr(oci_cli, 'compartment_id',
+                            lambda: 'ocid1.compartment.test')
+        monkeypatch.setattr(oci_cli, 'config_value',
+                            lambda key: 'us-ashburn-1')
+        monkeypatch.setattr(
+            config_lib, 'get_nested',
+            lambda path, default=None: (
+                f'ocid1.{path[-1]}' if path[0] == 'oci' else default))
+        monkeypatch.setattr(inst.time, 'sleep', lambda s: None)
+        return fake
+
+    def test_lifecycle_with_preemptible(self, fake):
+        from skypilot_tpu.provision.oci import instance as inst
+        cfg = _pconfig('VM.Standard.E4.Flex-8-32')
+        cfg.node_config['use_spot'] = True
+        record = inst.run_instances('us-ashburn-1', 'c1', cfg)
+        inst_rec = fake.instances[record.head_instance_id]
+        assert inst_rec['shape'] == 'VM.Standard.E4.Flex'
+        assert inst_rec['preemptible']
+        inst.stop_instances('c1')
+        record2 = inst.run_instances(
+            'us-ashburn-1', 'c1',
+            _pconfig('VM.Standard.E4.Flex-8-32', resume=True))
+        assert record2.resumed_instance_ids
+        info = inst.get_cluster_info('us-ashburn-1', 'c1')
+        assert info.instances[record.head_instance_id][0] \
+            .external_ip == '129.1.0.1'
+        inst.terminate_instances('c1')
+        assert inst.query_instances('c1') == {}
+
+    def test_flex_shape_grammar(self):
+        from skypilot_tpu.provision.oci import instance as inst
+        shape, cfg = inst.parse_shape('VM.Standard.E4.Flex-16-64')
+        assert shape == 'VM.Standard.E4.Flex'
+        assert cfg == {'ocpus': 8.0, 'memoryInGBs': 64.0}
+        shape, cfg = inst.parse_shape('BM.GPU.H100.8')
+        assert shape == 'BM.GPU.H100.8' and cfg is None
+
+
+class TestScpProvisioner:
+
+    @pytest.fixture(autouse=True)
+    def _creds(self, monkeypatch):
+        monkeypatch.setenv('SCP_ACCESS_KEY', 'ak')
+        monkeypatch.setenv('SCP_SECRET_KEY', 'sk')
+        monkeypatch.setenv('SCP_PROJECT_ID', 'p1')
+        monkeypatch.setattr(
+            config_lib, 'get_nested',
+            lambda path, default=None: (
+                f'scp-{path[-1]}' if path[0] == 'scp' else default))
+
+    @pytest.fixture()
+    def fake(self, monkeypatch):
+        from skypilot_tpu.provision.scp import instance as inst
+        from skypilot_tpu.provision.scp import scp_api
+
+        class FakeScp:
+            def __init__(self):
+                self.servers = {}
+                self.counter = 0
+
+            def request(self, method, path, body=None, params=None):
+                if path.endswith('/virtual-servers') and \
+                        method == 'GET':
+                    return {'contents':
+                            list(self.servers.values())}
+                if path.endswith('/virtual-servers') and \
+                        method == 'POST':
+                    self.counter += 1
+                    sid = f'scp-{self.counter:04d}'
+                    self.servers[sid] = {
+                        'virtualServerId': sid,
+                        'virtualServerName':
+                            body['virtualServerName'],
+                        'virtualServerState': 'RUNNING',
+                        'serverType': body['serverType'],
+                        'ip': f'10.7.0.{self.counter}',
+                        'externalIp': f'27.0.0.{self.counter}',
+                    }
+                    return {'resourceId': sid}
+                if method == 'POST' and path.endswith('/stop'):
+                    self.servers[path.split('/')[-2]][
+                        'virtualServerState'] = 'STOPPED'
+                    return {}
+                if method == 'POST' and path.endswith('/start'):
+                    self.servers[path.split('/')[-2]][
+                        'virtualServerState'] = 'RUNNING'
+                    return {}
+                if method == 'DELETE':
+                    self.servers.pop(path.rsplit('/', 1)[1], None)
+                    return {}
+                raise AssertionError(f'unhandled {method} {path}')
+
+        fake = FakeScp()
+        monkeypatch.setattr(scp_api, 'request', fake.request)
+        monkeypatch.setattr(inst.time, 'sleep', lambda s: None)
+        return fake
+
+    def test_lifecycle_with_stop_resume(self, fake):
+        from skypilot_tpu.provision.scp import instance as inst
+        record = inst.run_instances('KR-WEST-1', 'c1',
+                                    _pconfig('g1v8m32t4'))
+        assert len(record.created_instance_ids) == 1
+        inst.stop_instances('c1')
+        record2 = inst.run_instances('KR-WEST-1', 'c1',
+                                     _pconfig('g1v8m32t4',
+                                              resume=True))
+        assert record2.resumed_instance_ids
+        info = inst.get_cluster_info('KR-WEST-1', 'c1')
+        assert info.ssh_user == 'root'
+        inst.terminate_instances('c1')
+        assert inst.query_instances('c1') == {}
+
+    def test_signature_is_hmac(self, monkeypatch):
+        from skypilot_tpu.provision.scp import scp_api
+        creds = scp_api.ScpCredentials('ak', 'sk', 'p1')
+        sig = scp_api._signature(creds, 'GET', 'https://x/y', '123')
+        import base64
+        assert base64.b64decode(sig)  # valid b64 HMAC digest
+
+
+class TestVsphereProvisioner:
+
+    @pytest.fixture(autouse=True)
+    def _creds(self, monkeypatch):
+        monkeypatch.setenv('VSPHERE_HOST', 'vc.local')
+        monkeypatch.setenv('VSPHERE_USER', 'admin')
+        monkeypatch.setenv('VSPHERE_PASSWORD', 'pw')
+        monkeypatch.setattr(
+            config_lib, 'get_nested',
+            lambda path, default=None: (
+                'template-1' if path == ('vsphere', 'template_vm')
+                else default))
+
+    @pytest.fixture()
+    def fake(self, monkeypatch):
+        from skypilot_tpu.provision.vsphere import (
+            instance as inst, vsphere_api)
+
+        class FakeVc:
+            def __init__(self):
+                self.vms = {}
+                self.counter = 0
+
+            def request(self, method, path, body=None):
+                if path == '/api/vcenter/vm' and method == 'GET':
+                    return list(self.vms.values())
+                if path.startswith('/api/vcenter/vm?action=clone'):
+                    self.counter += 1
+                    vid = f'vm-{self.counter:04d}'
+                    self.vms[vid] = {
+                        'vm': vid, 'name': body['name'],
+                        'power_state': 'POWERED_ON',
+                        'source': body['source'],
+                    }
+                    return vid
+                if '/power?action=' in path:
+                    vid = path.split('/')[4].split('?')[0]
+                    action = path.rsplit('=', 1)[1]
+                    self.vms[vid]['power_state'] = (
+                        'POWERED_ON' if action == 'start'
+                        else 'POWERED_OFF')
+                    return {}
+                if method == 'DELETE':
+                    self.vms.pop(path.rsplit('/', 1)[1], None)
+                    return {}
+                if path.endswith('/guest/networking'):
+                    return {'interfaces': [{'ip': {'ip_addresses': [
+                        {'ip_address': '192.168.1.10',
+                         'state': 'PREFERRED'}]}}]}
+                raise AssertionError(f'unhandled {method} {path}')
+
+        fake = FakeVc()
+        monkeypatch.setattr(vsphere_api, 'request', fake.request)
+        monkeypatch.setattr(inst.time, 'sleep', lambda s: None)
+        return fake
+
+    def test_lifecycle_with_power_ops(self, fake):
+        from skypilot_tpu.provision.vsphere import instance as inst
+        record = inst.run_instances('Datacenter', 'c1',
+                                    _pconfig('cpu-medium'))
+        head = record.head_instance_id
+        assert fake.vms[head]['source'] == 'template-1'
+        inst.stop_instances('c1')
+        assert fake.vms[head]['power_state'] == 'POWERED_OFF'
+        record2 = inst.run_instances('Datacenter', 'c1',
+                                     _pconfig('cpu-medium',
+                                              resume=True))
+        assert record2.resumed_instance_ids == [head]
+        info = inst.get_cluster_info('Datacenter', 'c1')
+        assert info.instances[head][0].internal_ip == '192.168.1.10'
+        inst.terminate_instances('c1')
+        assert inst.query_instances('c1') == {}
