@@ -366,20 +366,18 @@ def show_accelerators(name_filter) -> None:
 
 def _catalog_for(cloud: str):
     """Catalog object (module or FlatCatalog instance — both expose
-    reload/export_snapshot) for a cloud name; None if unknown."""
-    try:
-        if cloud in ('gcp', 'aws', 'azure', 'lambda', 'runpod', 'do',
-                     'fluidstack'):
-            import importlib
-            return importlib.import_module(
-                f'skypilot_tpu.catalog.{cloud}_catalog')
-        if cloud in ('cudo', 'paperspace', 'ibm', 'oci', 'scp',
-                     'vsphere'):
-            import importlib
-            return importlib.import_module(
-                f'skypilot_tpu.catalog.{cloud}_catalog').CATALOG
-    except ImportError:
-        return None
+    reload/export_snapshot) for a cloud name; None only for UNKNOWN
+    names — a failing import inside a known catalog module must
+    surface as itself, not masquerade as 'unknown cloud'."""
+    import importlib
+    if cloud in ('gcp', 'aws', 'azure', 'lambda', 'runpod', 'do',
+                 'fluidstack'):
+        return importlib.import_module(
+            f'skypilot_tpu.catalog.{cloud}_catalog')
+    if cloud in ('cudo', 'paperspace', 'ibm', 'oci', 'scp',
+                 'vsphere'):
+        return importlib.import_module(
+            f'skypilot_tpu.catalog.{cloud}_catalog').CATALOG
     return None
 
 
@@ -880,6 +878,70 @@ def _print_table(headers: Tuple[str, ...], rows: List[Tuple]) -> None:
     click.echo('  '.join(str(h).ljust(w) for h, w in zip(headers, widths)))
     for row in rows:
         click.echo('  '.join(str(c).ljust(w) for c, w in zip(row, widths)))
+
+
+@cli.group(name='local')
+def local_group() -> None:
+    """Deploy a local/on-prem Kubernetes cluster as a cloud
+    (reference: `sky local`, cli.py:5246)."""
+
+
+@local_group.command(name='up')
+@click.option('--ips', 'ips_file', default=None,
+              help='File with one IP per line: deploy k3s over SSH '
+                   'onto these machines (first IP = server) instead '
+                   'of a kind cluster on this host.')
+@click.option('--ssh-user', default='root',
+              help='SSH user for --ips mode.')
+@click.option('--ssh-key-path', default=None,
+              help='SSH private key for --ips mode.')
+def local_up(ips_file, ssh_user, ssh_key_path) -> None:
+    """Create a Kubernetes cluster: kind on this machine, or k3s over
+    SSH onto --ips machines — then enable the kubernetes cloud."""
+    import skypilot_tpu.check as check_lib
+    from skypilot_tpu.utils import local_deploy
+    if ips_file:
+        ips = local_deploy.read_ips_file(ips_file)
+        path, _ = local_deploy.up_remote(ips, ssh_user, ssh_key_path)
+        click.echo(f'k3s cluster up on {len(ips)} machine(s); '
+                   f'kubeconfig: {path}')
+        click.echo(f'Run: export KUBECONFIG={path}')
+        # The credential check must probe the cluster we just built,
+        # not whatever context the user's default kubeconfig holds.
+        prev = os.environ.get('KUBECONFIG')
+        os.environ['KUBECONFIG'] = path
+        try:
+            check_lib.check(quiet=True, cloud_names=['kubernetes'])
+        finally:
+            if prev is None:
+                os.environ.pop('KUBECONFIG', None)
+            else:
+                os.environ['KUBECONFIG'] = prev
+    else:
+        context = local_deploy.up_local()
+        click.echo(f'kind cluster up (context {context}).')
+        check_lib.check(quiet=True, cloud_names=['kubernetes'])
+
+
+@local_group.command(name='down')
+@click.option('--ips', 'ips_file', default=None,
+              help='File with the IPs used at `local up --ips`.')
+@click.option('--ssh-user', default='root')
+@click.option('--ssh-key-path', default=None)
+def local_down(ips_file, ssh_user, ssh_key_path) -> None:
+    """Tear the `local up` cluster down."""
+    import skypilot_tpu.check as check_lib
+    from skypilot_tpu.utils import local_deploy
+    if ips_file:
+        ips = local_deploy.read_ips_file(ips_file)
+        local_deploy.down_remote(ips, ssh_user, ssh_key_path)
+        click.echo(f'k3s removed from {len(ips)} machine(s).')
+    else:
+        local_deploy.down_local()
+        click.echo('kind cluster deleted.')
+    # Drop the (now-dead) kubernetes entry from the enabled-clouds
+    # cache so the optimizer stops proposing a deleted cluster.
+    check_lib.check(quiet=True, cloud_names=['kubernetes'])
 
 
 def main() -> None:

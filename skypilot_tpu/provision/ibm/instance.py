@@ -9,6 +9,7 @@ support stop/start.
 """
 from __future__ import annotations
 
+import re
 import time
 from typing import Any, Dict, List, Optional
 
@@ -52,8 +53,12 @@ def _vpc_settings() -> Dict[str, str]:
 
 def _cluster_instances(region: str, cluster_name_on_cloud: str
                        ) -> List[Dict[str, Any]]:
+    pattern = re.compile(
+        rf'^{re.escape(cluster_name_on_cloud)}-\d{{4}}$')
     return sorted(
-        ibm_api.list_instances(region, f'{cluster_name_on_cloud}-'),
+        (i for i in ibm_api.list_instances(
+            region, f'{cluster_name_on_cloud}-')
+         if pattern.fullmatch(str(i.get('name', '')))),
         key=lambda i: str(i.get('name')))
 
 

@@ -7,6 +7,7 @@ startup script the same way).
 """
 from __future__ import annotations
 
+import re
 import time
 from typing import Any, Dict, List, Optional
 
@@ -28,10 +29,11 @@ def _classify(e: paperspace_api.PaperspaceApiError) -> Exception:
 
 def _cluster_machines(cluster_name_on_cloud: str
                       ) -> List[Dict[str, Any]]:
+    pattern = re.compile(
+        rf'^{re.escape(cluster_name_on_cloud)}-\d{{4}}$')
     return sorted(
         (m for m in paperspace_api.list_machines()
-         if str(m.get('name', '')).startswith(
-             f'{cluster_name_on_cloud}-')),
+         if pattern.fullmatch(str(m.get('name', '')))),
         key=lambda m: str(m.get('name')))
 
 

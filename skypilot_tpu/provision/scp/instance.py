@@ -8,6 +8,7 @@ cloud declares MULTI_NODE unsupported); zone + image come from config
 """
 from __future__ import annotations
 
+import re
 import time
 from typing import Any, Dict, List, Optional
 
@@ -41,10 +42,12 @@ def _settings() -> Dict[str, str]:
 
 def _cluster_servers(cluster_name_on_cloud: str
                      ) -> List[Dict[str, Any]]:
+    pattern = re.compile(
+        rf'^{re.escape(cluster_name_on_cloud)}-\d{{4}}$')
     return sorted(
         (s for s in scp_api.list_servers()
-         if str(s.get('virtualServerName', '')).startswith(
-             f'{cluster_name_on_cloud}-')),
+         if pattern.fullmatch(str(s.get('virtualServerName',
+                                       '')))),
         key=lambda s: str(s.get('virtualServerName')))
 
 

@@ -7,6 +7,7 @@ power stop/start, and report IPs via guest tools.
 """
 from __future__ import annotations
 
+import re
 import time
 from typing import Any, Dict, List, Optional
 
@@ -38,8 +39,12 @@ def _template_vm() -> str:
 
 
 def _cluster_vms(cluster_name_on_cloud: str) -> List[Dict[str, Any]]:
+    pattern = re.compile(
+        rf'^{re.escape(cluster_name_on_cloud)}-\d{{4}}$')
     return sorted(
-        vsphere_api.list_vms(f'{cluster_name_on_cloud}-'),
+        (vm for vm in vsphere_api.list_vms(
+            f'{cluster_name_on_cloud}-')
+         if pattern.fullmatch(str(vm.get('name', '')))),
         key=lambda vm: str(vm.get('name')))
 
 

@@ -1,0 +1,169 @@
+"""`sky local` backend: turn machines into a Kubernetes cloud.
+
+Counterpart of the reference's `sky local up/down` group
+(sky/cli.py:5246, sky/utils/kubernetes/{create_cluster.sh,
+deploy_remote_cluster.sh}) redesigned without shipped shell scripts:
+
+  - local mode: a kind cluster named `skytpu-local` on this machine
+    (docker required), context `kind-skytpu-local`;
+  - remote mode: k3s over SSH — server on the first IP, agents joined
+    with the node token — turning a list of on-prem boxes (e.g. a lab
+    of TPU-less CPU hosts, or GPU workstations) into a cluster the
+    `kubernetes` cloud schedules onto; the kubeconfig lands in
+    ~/.skytpu/local/kubeconfig.
+
+Every shell interaction routes through `_run`, the single test seam.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import paths
+
+logger = sky_logging.init_logger(__name__)
+
+CLUSTER_NAME = 'skytpu-local'
+_K3S_INSTALL = 'curl -sfL https://get.k3s.io'
+
+
+def _run(cmd: List[str], *, check: bool = True,
+         capture: bool = True,
+         input_text: Optional[str] = None
+         ) -> subprocess.CompletedProcess:
+    proc = subprocess.run(cmd, capture_output=capture, text=True,
+                          check=False, input=input_text)
+    if check and proc.returncode != 0:
+        raise exceptions.ClusterSetupError(
+            f'command failed (rc={proc.returncode}): '
+            f'{" ".join(cmd)}\n{(proc.stderr or "")[-800:]}')
+    return proc
+
+
+def _kubeconfig_path() -> str:
+    return os.path.join(paths.state_dir(), 'local', 'kubeconfig')
+
+
+# -- local (kind) mode -----------------------------------------------------
+def up_local() -> str:
+    """Create (or reuse) the kind cluster; returns the context name."""
+    for tool in ('docker', 'kind', 'kubectl'):
+        if shutil.which(tool) is None:
+            raise exceptions.ClusterSetupError(
+                f'`{tool}` not found — local mode needs docker + '
+                'kind + kubectl installed.')
+    existing = _run(['kind', 'get', 'clusters'], check=False)
+    if CLUSTER_NAME in (existing.stdout or '').split():
+        logger.info(f'kind cluster {CLUSTER_NAME!r} already exists.')
+    else:
+        _run(['kind', 'create', 'cluster', '--name', CLUSTER_NAME])
+    context = f'kind-{CLUSTER_NAME}'
+    _run(['kubectl', 'config', 'use-context', context])
+    return context
+
+
+def down_local() -> None:
+    if shutil.which('kind') is None:
+        raise exceptions.ClusterSetupError('`kind` not found.')
+    _run(['kind', 'delete', 'cluster', '--name', CLUSTER_NAME])
+
+
+# -- remote (k3s over SSH) mode --------------------------------------------
+def _ssh_base(user: str, key_path: Optional[str]) -> List[str]:
+    base = ['ssh', '-o', 'StrictHostKeyChecking=no',
+            '-o', 'ConnectTimeout=15']
+    if key_path:
+        base += ['-i', os.path.expanduser(key_path)]
+    return base
+
+
+def _ssh(host: str, user: str, key_path: Optional[str],
+         remote_cmd: str, *, check: bool = True,
+         input_text: Optional[str] = None
+         ) -> subprocess.CompletedProcess:
+    return _run(_ssh_base(user, key_path) + [f'{user}@{host}',
+                                             remote_cmd],
+                check=check, input_text=input_text)
+
+
+def up_remote(ips: List[str], user: str,
+              key_path: Optional[str] = None) -> Tuple[str, str]:
+    """k3s server on ips[0], agents on the rest; returns
+    (kubeconfig_path, context)."""
+    if not ips:
+        raise exceptions.ClusterSetupError('no IPs given.')
+    head, workers = ips[0], ips[1:]
+    logger.info(f'Installing k3s server on {head}...')
+    _ssh(head, user, key_path,
+         f'{_K3S_INSTALL} | sudo sh -s - server '
+         '--write-kubeconfig-mode 644')
+    token = _ssh(
+        head, user, key_path,
+        'sudo cat /var/lib/rancher/k3s/server/node-token'
+    ).stdout.strip()
+    if not token:
+        raise exceptions.ClusterSetupError(
+            f'could not read the k3s node token from {head}.')
+    for worker in workers:
+        logger.info(f'Joining {worker} as k3s agent...')
+        # The node token is a cluster-admin credential: ship it over
+        # stdin into a 0600 token file, NEVER on the command line
+        # (argv is world-readable in `ps` and would leak into error
+        # messages).
+        _ssh(worker, user, key_path,
+             'umask 077 && cat > /tmp/.skytpu_k3s_token',
+             input_text=token)
+        try:
+            _ssh(worker, user, key_path,
+                 f'{_K3S_INSTALL} | sudo sh -s - agent '
+                 f'--server https://{head}:6443 '
+                 f'--token-file /tmp/.skytpu_k3s_token')
+        finally:
+            _ssh(worker, user, key_path,
+                 'rm -f /tmp/.skytpu_k3s_token', check=False)
+    kubeconfig = _ssh(head, user, key_path,
+                      'sudo cat /etc/rancher/k3s/k3s.yaml').stdout
+    if 'clusters' not in kubeconfig:
+        raise exceptions.ClusterSetupError(
+            f'could not fetch the kubeconfig from {head}.')
+    # The server writes 127.0.0.1; the client must dial the head IP.
+    kubeconfig = kubeconfig.replace('127.0.0.1', head)
+    path = _kubeconfig_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(kubeconfig)
+    os.chmod(path, 0o600)
+    logger.info(f'kubeconfig written to {path}; export '
+                f'KUBECONFIG={path} (or merge it) to use the '
+                'kubernetes cloud against this cluster.')
+    return path, 'default'
+
+
+def down_remote(ips: List[str], user: str,
+                key_path: Optional[str] = None) -> None:
+    """Uninstall k3s everywhere (agents first, then the server)."""
+    if not ips:
+        raise exceptions.ClusterSetupError('no IPs given.')
+    head, workers = ips[0], ips[1:]
+    for worker in workers:
+        _ssh(worker, user, key_path,
+             'sudo /usr/local/bin/k3s-agent-uninstall.sh || true',
+             check=False)
+    _ssh(head, user, key_path,
+         'sudo /usr/local/bin/k3s-uninstall.sh || true', check=False)
+    path = _kubeconfig_path()
+    if os.path.exists(path):
+        os.unlink(path)
+
+
+def read_ips_file(path: str) -> List[str]:
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        ips = [line.strip() for line in f
+               if line.strip() and not line.strip().startswith('#')]
+    if not ips:
+        raise exceptions.ClusterSetupError(f'no IPs in {path!r}.')
+    return ips

@@ -32,6 +32,7 @@ GROUPS = {
     'storage': [],
     'catalog': ['update'],
     'bench': ['launch', 'status', 'down', 'ls', 'delete'],
+    'local': ['up', 'down'],
 }
 
 

@@ -1,0 +1,158 @@
+"""`sky local up/down`: kind + k3s-over-SSH deploy flows over the
+mocked shell seam (reference: sky/cli.py:5246 local group +
+utils/kubernetes/{create_cluster,deploy_remote_cluster}.sh)."""
+import subprocess
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import local_deploy
+
+_K3S_KCFG = """\
+apiVersion: v1
+clusters:
+- cluster:
+    server: https://127.0.0.1:6443
+  name: default
+"""
+
+
+class _ShellRecorder:
+    """Scripted subprocess.run: records argv + stdin, answers by
+    pattern."""
+
+    def __init__(self):
+        self.calls = []
+        self.inputs = []
+        self.responses = {}  # substring -> (rc, stdout)
+
+    def __call__(self, cmd, **kwargs):
+        self.calls.append(cmd)
+        self.inputs.append(kwargs.get('input'))
+        flat = ' '.join(cmd)
+        for needle, (rc, out) in self.responses.items():
+            if needle in flat:
+                return subprocess.CompletedProcess(cmd, rc, out, '')
+        return subprocess.CompletedProcess(cmd, 0, '', '')
+
+
+@pytest.fixture()
+def shell(monkeypatch):
+    rec = _ShellRecorder()
+    monkeypatch.setattr(local_deploy.subprocess, 'run', rec)
+    monkeypatch.setattr(local_deploy.shutil, 'which',
+                        lambda tool: f'/usr/bin/{tool}')
+    return rec
+
+
+class TestKindMode:
+
+    def test_up_creates_and_switches_context(self, shell):
+        context = local_deploy.up_local()
+        assert context == 'kind-skytpu-local'
+        flat = [' '.join(c) for c in shell.calls]
+        assert any('kind create cluster --name skytpu-local' in c
+                   for c in flat)
+        assert any('kubectl config use-context kind-skytpu-local'
+                   in c for c in flat)
+
+    def test_up_reuses_existing_cluster(self, shell):
+        shell.responses['kind get clusters'] = (0, 'skytpu-local\n')
+        local_deploy.up_local()
+        flat = [' '.join(c) for c in shell.calls]
+        assert not any('create cluster' in c for c in flat)
+
+    def test_missing_tool_is_clear_error(self, shell, monkeypatch):
+        monkeypatch.setattr(local_deploy.shutil, 'which',
+                            lambda tool: None)
+        with pytest.raises(exceptions.ClusterSetupError,
+                           match='docker'):
+            local_deploy.up_local()
+
+    def test_down(self, shell):
+        local_deploy.down_local()
+        assert any('kind delete cluster' in ' '.join(c)
+                   for c in shell.calls)
+
+
+class TestRemoteMode:
+
+    def test_up_installs_server_then_agents(self, shell):
+        shell.responses['node-token'] = (0, 'K10abc::token\n')
+        shell.responses['k3s.yaml'] = (0, _K3S_KCFG)
+        path, _ = local_deploy.up_remote(
+            ['10.0.0.1', '10.0.0.2', '10.0.0.3'], 'ubuntu',
+            key_path='~/.ssh/id_ed25519')
+        flat = [' '.join(c) for c in shell.calls]
+        # Server on the first IP; agents joined via a token FILE.
+        server = next(c for c in flat if 'server' in c
+                      and '10.0.0.1' in c)
+        assert 'get.k3s.io' in server
+        agents = [c for c in flat if '-s - agent' in c]
+        assert len(agents) == 2
+        assert all('https://10.0.0.1:6443' in c
+                   and '--token-file' in c for c in agents)
+        assert {'10.0.0.2', '10.0.0.3'} <= {
+            part.split('@')[1] for c in agents
+            for part in c.split() if '@' in part}
+        # The cluster-admin token must NEVER ride argv (ps-visible,
+        # error-message-visible): it goes over stdin into a 0600
+        # file, which is removed after the join.
+        assert not any('K10abc::token' in c for c in flat)
+        assert 'K10abc::token' in [i for i in shell.inputs if i]
+        token_writes = [c for c in flat
+                        if 'cat > /tmp/.skytpu_k3s_token' in c]
+        assert len(token_writes) == 2
+        assert all('umask 077' in c for c in token_writes)
+        assert sum('rm -f /tmp/.skytpu_k3s_token' in c
+                   for c in flat) == 2
+        # kubeconfig rewritten to dial the head, perms locked down.
+        with open(path, encoding='utf-8') as f:
+            content = f.read()
+        assert 'https://10.0.0.1:6443' in content
+        assert '127.0.0.1' not in content
+
+    def test_token_failure_is_clear(self, shell):
+        shell.responses['node-token'] = (0, '')
+        with pytest.raises(exceptions.ClusterSetupError,
+                           match='token'):
+            local_deploy.up_remote(['10.0.0.1'], 'root')
+
+    def test_down_uninstalls_agents_then_server(self, shell):
+        local_deploy.down_remote(['10.0.0.1', '10.0.0.2'], 'root')
+        flat = [' '.join(c) for c in shell.calls]
+        assert any('k3s-agent-uninstall' in c and '10.0.0.2' in c
+                   for c in flat)
+        assert any('k3s-uninstall' in c and '10.0.0.1' in c
+                   for c in flat)
+
+    def test_read_ips_file(self, tmp_path):
+        f = tmp_path / 'ips'
+        f.write_text('# head\n10.0.0.1\n\n10.0.0.2\n')
+        assert local_deploy.read_ips_file(str(f)) == ['10.0.0.1',
+                                                      '10.0.0.2']
+        (tmp_path / 'empty').write_text('\n')
+        with pytest.raises(exceptions.ClusterSetupError):
+            local_deploy.read_ips_file(str(tmp_path / 'empty'))
+
+
+class TestCli:
+
+    def test_local_up_remote_through_cli(self, shell, tmp_path,
+                                         monkeypatch):
+        import skypilot_tpu.check as check_lib
+        from skypilot_tpu import cli as cli_mod
+        shell.responses['node-token'] = (0, 'tok\n')
+        shell.responses['k3s.yaml'] = (0, _K3S_KCFG)
+        monkeypatch.setattr(check_lib, 'check',
+                            lambda quiet=False, cloud_names=None: [])
+        ips = tmp_path / 'ips'
+        ips.write_text('10.0.0.1\n10.0.0.2\n')
+        result = CliRunner().invoke(
+            cli_mod.cli,
+            ['local', 'up', '--ips', str(ips), '--ssh-user',
+             'ubuntu'])
+        assert result.exit_code == 0, result.output
+        assert 'k3s cluster up on 2 machine(s)' in result.output
+        assert 'KUBECONFIG=' in result.output

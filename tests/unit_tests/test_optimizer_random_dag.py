@@ -150,6 +150,35 @@ class TestRandomBranchedDags:
         _check_dag(d, optimizer_lib.OptimizeTarget.COST)
 
     @pytest.mark.parametrize('seed', range(3))
+    def test_diamond_many_candidates(self, seed):
+        """Round-4 regression: a diamond whose tasks each have MORE
+        candidates than the old truncated-exhaustive solver's per-task
+        cap (10000^(1/4) = 10) — the branch-and-bound must still
+        return the exact brute-force optimum, cross-cloud egress
+        trade-offs included."""
+        global_user_state.set_enabled_clouds(['fake', 'do', 'lambda'])
+        rng = random.Random(4000 + seed)
+        free = dict(cpus='8+')  # unpinned -> ~15 candidates
+        with dag_lib.Dag() as d:
+            tasks = []
+            for i in range(4):
+                t = Task(f'wide-{i}', run='x')
+                t.set_resources(Resources(**free))
+                t.estimated_outputs_size_gb = rng.choice(
+                    [0, 100, 2000])
+                tasks.append(t)
+            src, mid1, mid2, sink = tasks
+            src >> mid1
+            src >> mid2
+            mid1 >> sink
+            mid2 >> sink
+        per_task_sizes = [
+            len(_candidates(t, optimizer_lib.OptimizeTarget.COST))
+            for t in tasks]
+        assert min(per_task_sizes) > 10, per_task_sizes  # beats old K
+        _check_dag(d, optimizer_lib.OptimizeTarget.COST)
+
+    @pytest.mark.parametrize('seed', range(3))
     def test_random_tree(self, seed):
         rng = random.Random(3000 + seed)
         n = rng.randint(3, 6)
