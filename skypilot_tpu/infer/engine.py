@@ -660,16 +660,20 @@ class ContinuousBatchingEngine:
                 with self._submit_lock:
                     self._admitting_rid = None
 
-        # One prefill chunk per tick (FIFO across pending prompts):
-        # decode below still runs for live slots, so a long prompt
-        # costs each of them one chunk's latency per tick, not its
-        # whole prefill.
-        if self._prefills:
-            pending = self._prefills[0]
+        # One prefill chunk per tick for EVERY pending prompt
+        # (round-robin, not head-only): several long prompts make
+        # progress concurrently instead of queueing serially behind
+        # the first one's full chunk sequence.  Decode below still
+        # runs for live slots each tick, so live latency cost is one
+        # chunk per pending prompt, bounded by n_slots.
+        still_pending: List[_PendingPrefill] = []
+        for pending in self._prefills:
             self._prefill_chunk_step(pending)
             if pending.done >= pending.pad:
                 self._finish_prefill(pending)
-                self._prefills.pop(0)
+            else:
+                still_pending.append(pending)
+        self._prefills = still_pending
 
         occupied = [i for i, s in enumerate(self._slots)
                     if s is not None]

@@ -74,7 +74,11 @@ def down_local() -> None:
 
 # -- remote (k3s over SSH) mode --------------------------------------------
 def _ssh_base(user: str, key_path: Optional[str]) -> List[str]:
+    # UserKnownHostsFile=/dev/null: lab machines get reimaged and IPs
+    # reassigned — a stale known_hosts entry must not abort the
+    # deploy (same stance as backend/command_runner.py).
     base = ['ssh', '-o', 'StrictHostKeyChecking=no',
+            '-o', 'UserKnownHostsFile=/dev/null',
             '-o', 'ConnectTimeout=15']
     if key_path:
         base += ['-i', os.path.expanduser(key_path)]
@@ -111,20 +115,26 @@ def up_remote(ips: List[str], user: str,
     for worker in workers:
         logger.info(f'Joining {worker} as k3s agent...')
         # The node token is a cluster-admin credential: ship it over
-        # stdin into a 0600 token file, NEVER on the command line
-        # (argv is world-readable in `ps` and would leak into error
-        # messages).
-        _ssh(worker, user, key_path,
-             'umask 077 && cat > /tmp/.skytpu_k3s_token',
-             input_text=token)
+        # stdin into a mktemp-created 0600 file in the SSH user's
+        # HOME, never on the command line (argv is ps-visible and
+        # leaks into error messages) and never at a predictable /tmp
+        # path (pre-creation/symlink attack on shared lab hosts).
+        token_file = _ssh(
+            worker, user, key_path,
+            'f=$(mktemp ~/.skytpu_k3s_token.XXXXXX) && '
+            'cat > "$f" && echo "$f"',
+            input_text=token).stdout.strip()
+        if not token_file:
+            raise exceptions.ClusterSetupError(
+                f'could not stage the k3s token on {worker}.')
         try:
             _ssh(worker, user, key_path,
                  f'{_K3S_INSTALL} | sudo sh -s - agent '
                  f'--server https://{head}:6443 '
-                 f'--token-file /tmp/.skytpu_k3s_token')
+                 f'--token-file {token_file}')
         finally:
             _ssh(worker, user, key_path,
-                 'rm -f /tmp/.skytpu_k3s_token', check=False)
+                 f'rm -f {token_file}', check=False)
     kubeconfig = _ssh(head, user, key_path,
                       'sudo cat /etc/rancher/k3s/k3s.yaml').stdout
     if 'clusters' not in kubeconfig:

@@ -220,6 +220,34 @@ class TestChunkedPrefill:
         assert cpe.wait(rid_l) == _reference_greedy(cpe.params, long_p,
                                                     3)
 
+    def test_concurrent_long_prompts_prefill_round_robin(self):
+        """Round-4 (verdict weak #7): several long prompts advance one
+        chunk EACH per tick — the second must not wait for the first's
+        whole chunk sequence — and both decode correctly."""
+        eng = engine_lib.ContinuousBatchingEngine(
+            'llama-tiny', n_slots=2, model_overrides=dict(_OVERRIDES),
+            param_dtype=jnp.float32, prefill_bucket=4,
+            prefill_chunk=4)
+        long_a = list(range(1, 18))   # 17 tokens -> 5 chunks of 4
+        long_b = list(range(20, 37))  # 17 tokens -> 5 chunks
+        rid_a = eng.submit(long_a, engine_lib.SamplingConfig(
+            max_new_tokens=3))
+        rid_b = eng.submit(long_b, engine_lib.SamplingConfig(
+            max_new_tokens=3))
+        eng.step()  # both admitted into reserved slots
+        assert len(eng._prefills) == 2
+        done_before = [p.done for p in eng._prefills]
+        eng.step()
+        done_after = {p.rid: p.done for p in eng._prefills}
+        # BOTH pending prefills advanced on the same tick.
+        assert done_after[rid_a] > done_before[0]
+        assert done_after[rid_b] > done_before[1]
+        eng.run_until_idle()
+        assert eng.wait(rid_a) == _reference_greedy(eng.params,
+                                                    long_a, 3)
+        assert eng.wait(rid_b) == _reference_greedy(eng.params,
+                                                    long_b, 3)
+
     def test_size_one_chunks_stay_on_prefill_path(self):
         """chunk=1 makes every prefill forward s==1 — it must trace
         the global-cursor prefill branch, NOT slot-mode (which would

@@ -81,6 +81,8 @@ class TestRemoteMode:
     def test_up_installs_server_then_agents(self, shell):
         shell.responses['node-token'] = (0, 'K10abc::token\n')
         shell.responses['k3s.yaml'] = (0, _K3S_KCFG)
+        shell.responses['mktemp'] = (0,
+                                     '/home/u/.skytpu_k3s_token.x\n')
         path, _ = local_deploy.up_remote(
             ['10.0.0.1', '10.0.0.2', '10.0.0.3'], 'ubuntu',
             key_path='~/.ssh/id_ed25519')
@@ -97,15 +99,15 @@ class TestRemoteMode:
             part.split('@')[1] for c in agents
             for part in c.split() if '@' in part}
         # The cluster-admin token must NEVER ride argv (ps-visible,
-        # error-message-visible): it goes over stdin into a 0600
-        # file, which is removed after the join.
+        # error-message-visible): it goes over stdin into a
+        # mktemp-created file in $HOME (predictable /tmp paths are
+        # symlink-attackable on shared hosts), removed after the join.
         assert not any('K10abc::token' in c for c in flat)
         assert 'K10abc::token' in [i for i in shell.inputs if i]
         token_writes = [c for c in flat
-                        if 'cat > /tmp/.skytpu_k3s_token' in c]
+                        if 'mktemp ~/.skytpu_k3s_token' in c]
         assert len(token_writes) == 2
-        assert all('umask 077' in c for c in token_writes)
-        assert sum('rm -f /tmp/.skytpu_k3s_token' in c
+        assert sum('rm -f' in c and 'k3s_token' in c
                    for c in flat) == 2
         # kubeconfig rewritten to dial the head, perms locked down.
         with open(path, encoding='utf-8') as f:
@@ -145,6 +147,8 @@ class TestCli:
         from skypilot_tpu import cli as cli_mod
         shell.responses['node-token'] = (0, 'tok\n')
         shell.responses['k3s.yaml'] = (0, _K3S_KCFG)
+        shell.responses['mktemp'] = (0,
+                                     '/home/u/.skytpu_k3s_token.x\n')
         monkeypatch.setattr(check_lib, 'check',
                             lambda quiet=False, cloud_names=None: [])
         ips = tmp_path / 'ips'
