@@ -170,11 +170,18 @@ def warn_if_snapshot_stale(cloud: str, snapshot_date: str,
         return
     if age > SNAPSHOT_MAX_AGE_DAYS:
         _stale_warned.add(cloud)
+        # Only clouds with a pricing-API fetcher can honor --fetch;
+        # the rest take --from-file / --export edits.
+        from skypilot_tpu.catalog import fetchers
+        fetchable = cloud in fetchers.FETCHABLE
+        hint = (f'Refresh with: sky catalog update --cloud {cloud} '
+                '--fetch' if fetchable else
+                f'Override with: sky catalog update --cloud {cloud} '
+                '--table vms --from-file <csv>')
         logger.warning(
             f'{cloud} catalog is the built-in snapshot from '
             f'{snapshot_date} ({age} days old); prices may be stale. '
-            f'Refresh with: sky catalog update --cloud {cloud} '
-            '--fetch')
+            + hint)
 
 
 def remove_override(cloud: str, table: str) -> bool:

@@ -10,6 +10,12 @@ from __future__ import annotations
 
 from typing import Dict
 
+# The clouds `fetch` can regenerate — the staleness warning in
+# catalog/common.py keys its --fetch hint off this, so it cannot
+# drift from the dispatch below.
+FETCHABLE = frozenset(
+    ('gcp', 'aws', 'azure', 'lambda', 'runpod', 'do', 'fluidstack'))
+
 
 def fetch(cloud: str, **kwargs) -> Dict[str, str]:
     """Regenerate `cloud`'s tables; returns {table: written_path}."""

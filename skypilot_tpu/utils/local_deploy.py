@@ -17,6 +17,7 @@ Every shell interaction routes through `_run`, the single test seam.
 from __future__ import annotations
 
 import os
+import re
 import shutil
 import subprocess
 from typing import List, Optional, Tuple
@@ -119,22 +120,29 @@ def up_remote(ips: List[str], user: str,
         # HOME, never on the command line (argv is ps-visible and
         # leaks into error messages) and never at a predictable /tmp
         # path (pre-creation/symlink attack on shared lab hosts).
-        token_file = _ssh(
+        staged = _ssh(
             worker, user, key_path,
             'f=$(mktemp ~/.skytpu_k3s_token.XXXXXX) && '
             'cat > "$f" && echo "$f"',
             input_text=token).stdout.strip()
-        if not token_file:
+        # Shells that echo banners for non-interactive sessions mix
+        # noise into stdout: take the LAST line and validate it is
+        # actually the mktemp path before interpolating it into later
+        # commands.
+        token_file = staged.splitlines()[-1].strip() if staged else ''
+        if not re.fullmatch(r'\S*/\.skytpu_k3s_token\.\w+',
+                            token_file):
             raise exceptions.ClusterSetupError(
-                f'could not stage the k3s token on {worker}.')
+                f'could not stage the k3s token on {worker} '
+                f'(unexpected mktemp output {staged[-200:]!r}).')
         try:
             _ssh(worker, user, key_path,
                  f'{_K3S_INSTALL} | sudo sh -s - agent '
                  f'--server https://{head}:6443 '
-                 f'--token-file {token_file}')
+                 f'--token-file "{token_file}"')
         finally:
             _ssh(worker, user, key_path,
-                 f'rm -f {token_file}', check=False)
+                 f'rm -f "{token_file}"', check=False)
     kubeconfig = _ssh(head, user, key_path,
                       'sudo cat /etc/rancher/k3s/k3s.yaml').stdout
     if 'clusters' not in kubeconfig:
