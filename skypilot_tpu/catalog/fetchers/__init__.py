@@ -8,36 +8,30 @@ snapshot is a fallback, not a slowly-rotting source of truth.
 """
 from __future__ import annotations
 
+import importlib
 from typing import Dict
 
-# The clouds `fetch` can regenerate — the staleness warning in
-# catalog/common.py keys its --fetch hint off this, so it cannot
-# drift from the dispatch below.
-FETCHABLE = frozenset(
-    ('gcp', 'aws', 'azure', 'lambda', 'runpod', 'do', 'fluidstack'))
+# cloud -> fetcher module name.  FETCHABLE derives from this table,
+# so the staleness warning's --fetch hint (catalog/common.py) is
+# structurally tied to the dispatch: adding a fetcher here updates
+# both.
+_FETCHERS = {
+    'gcp': 'fetch_gcp',
+    'aws': 'fetch_aws',
+    'azure': 'fetch_azure',
+    'lambda': 'fetch_lambda',
+    'runpod': 'fetch_runpod',
+    'do': 'fetch_do',
+    'fluidstack': 'fetch_fluidstack',
+}
+FETCHABLE = frozenset(_FETCHERS)
 
 
 def fetch(cloud: str, **kwargs) -> Dict[str, str]:
     """Regenerate `cloud`'s tables; returns {table: written_path}."""
-    if cloud == 'gcp':
-        from skypilot_tpu.catalog.fetchers import fetch_gcp
-        return fetch_gcp.fetch_and_write(**kwargs)
-    if cloud == 'aws':
-        from skypilot_tpu.catalog.fetchers import fetch_aws
-        return fetch_aws.fetch_and_write(**kwargs)
-    if cloud == 'azure':
-        from skypilot_tpu.catalog.fetchers import fetch_azure
-        return fetch_azure.fetch_and_write(**kwargs)
-    if cloud == 'lambda':
-        from skypilot_tpu.catalog.fetchers import fetch_lambda
-        return fetch_lambda.fetch_and_write(**kwargs)
-    if cloud == 'runpod':
-        from skypilot_tpu.catalog.fetchers import fetch_runpod
-        return fetch_runpod.fetch_and_write(**kwargs)
-    if cloud == 'do':
-        from skypilot_tpu.catalog.fetchers import fetch_do
-        return fetch_do.fetch_and_write(**kwargs)
-    if cloud == 'fluidstack':
-        from skypilot_tpu.catalog.fetchers import fetch_fluidstack
-        return fetch_fluidstack.fetch_and_write(**kwargs)
-    raise ValueError(f'No catalog fetcher for cloud {cloud!r}.')
+    module_name = _FETCHERS.get(cloud)
+    if module_name is None:
+        raise ValueError(f'No catalog fetcher for cloud {cloud!r}.')
+    module = importlib.import_module(
+        f'skypilot_tpu.catalog.fetchers.{module_name}')
+    return module.fetch_and_write(**kwargs)

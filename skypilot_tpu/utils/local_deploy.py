@@ -130,8 +130,12 @@ def up_remote(ips: List[str], user: str,
         # actually the mktemp path before interpolating it into later
         # commands.
         token_file = staged.splitlines()[-1].strip() if staged else ''
-        if not re.fullmatch(r'\S*/\.skytpu_k3s_token\.\w+',
-                            token_file):
+        # Charset-anchored: the path feeds shell commands, so only
+        # plainly-safe characters may pass — a line with `$`/backtick
+        # (banner noise or something hostile) must be rejected, not
+        # quoted around.
+        if not re.fullmatch(r'[A-Za-z0-9_./~-]+/\.skytpu_k3s_token'
+                            r'\.\w+', token_file):
             raise exceptions.ClusterSetupError(
                 f'could not stage the k3s token on {worker} '
                 f'(unexpected mktemp output {staged[-200:]!r}).')
