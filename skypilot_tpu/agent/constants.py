@@ -60,6 +60,21 @@ TASK_SCRIPTS_DIR = 'tasks'
 
 # Event cadence (reference: skylet events.py:28 — 20s loop; autostop 60s).
 AGENT_LOOP_INTERVAL_S = 5
+
+# Control-plane PJRT strip: agent/daemon/driver/RPC interpreters never
+# touch jax, but hosts whose sitecustomize registers an accelerator
+# plugin (keyed off this env var) charge every python startup ~2s for
+# the import.  Shell-prefix a control-plane python with
+# PJRT_STRIP_PREFIX to skip it; job_driver restores the stashed value
+# into USER job envs (the one place the accelerator is needed).
+PJRT_PLUGIN_ENV = 'PALLAS_AXON_POOL_IPS'
+PJRT_STASH_ENV = 'SKYTPU_STASH_PJRT_ENV'
+# ${STASH:-${VAR:-}}: the spawner may itself already be stripped (its
+# stash, not its blanked live var, carries the real value).
+PJRT_STRIP_PREFIX = (
+    f'{PJRT_STASH_ENV}='
+    f'"${{{PJRT_STASH_ENV}:-${{{PJRT_PLUGIN_ENV}:-}}}}" '
+    f'{PJRT_PLUGIN_ENV}= ')
 AUTOSTOP_CHECK_INTERVAL_S = 20
 
 MAX_CONCURRENT_SETUP_SSH = 16

@@ -50,6 +50,19 @@ class _RankProc:
         self.returncode: Optional[int] = None
 
 
+def _restore_plugin_env(full_env: Dict[str, str]) -> None:
+    """Undo the control-plane PJRT-plugin strip
+    (constants.PJRT_STRIP_PREFIX): the DRIVER interpreter skips the
+    ~2s sitecustomize jax import by blanking the plugin env var, but
+    the USER job may need the accelerator — restore the stashed value
+    into its env."""
+    stashed = full_env.pop(constants.PJRT_STASH_ENV, None)
+    if stashed:
+        full_env[constants.PJRT_PLUGIN_ENV] = stashed
+    elif full_env.get(constants.PJRT_PLUGIN_ENV) == '':
+        full_env.pop(constants.PJRT_PLUGIN_ENV, None)
+
+
 def _build_rank_env(spec: Dict[str, Any], rank: int) -> Dict[str, str]:
     hosts: List[Dict[str, Any]] = spec['hosts']
     # Local simulated hosts share one machine: their rendezvous address is
@@ -107,6 +120,7 @@ def _spawn_rank(spec: Dict[str, Any], rank: int, run_cmd: str,
         script = log_lib.make_task_bash_script(run_cmd, cwd=workdir,
                                                env_vars=env)
         full_env = dict(os.environ)
+        _restore_plugin_env(full_env)
         full_env.update(env)
         full_env['SKYTPU_LOCAL_HOST_ROOT'] = host_root
         # Jobs must be able to import skypilot_tpu (callbacks, train

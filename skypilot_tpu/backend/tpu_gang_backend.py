@@ -320,6 +320,10 @@ class TpuGangBackend(backend_lib.Backend):
             f'2>/dev/null && [ "$have" = "{want}" ]; then true; else '
             f'if [ -f {pid_file} ]; then kill $(cat {pid_file}) '
             '2>/dev/null || true; fi; '
+            # Control-plane strip (agent/constants.PJRT_STRIP_PREFIX):
+            # the daemon never touches jax; the stash keeps the value
+            # for user jobs downstream.
+            f'{agent_constants.PJRT_STRIP_PREFIX}'
             f'nohup python3 -u -m skypilot_tpu.agent.daemon {root_arg} '
             f'>> {log_file} 2>&1 & fi')
         self.run_on_head(handle, cmd, timeout=60)

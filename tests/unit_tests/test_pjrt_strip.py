@@ -1,0 +1,80 @@
+"""Control-plane PJRT-plugin strip: agent/daemon/driver/RPC pythons
+skip the sitecustomize accelerator import; USER jobs get the env back.
+
+The silent failure mode of a regression here is user jobs starting
+without the accelerator env — jax falls back to CPU far from the
+causing change — so the stash round-trip is pinned at three layers:
+the shell fragment, the driver restore, and a real bash expansion.
+"""
+import subprocess
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_driver
+from skypilot_tpu.agent import rpc as agent_rpc
+
+
+class TestRestorePluginEnv:
+
+    def test_stash_restored_for_user_job(self):
+        env = {constants.PJRT_STASH_ENV: '10.0.0.9',
+               constants.PJRT_PLUGIN_ENV: ''}
+        job_driver._restore_plugin_env(env)
+        assert env[constants.PJRT_PLUGIN_ENV] == '10.0.0.9'
+        assert constants.PJRT_STASH_ENV not in env
+
+    def test_blank_var_without_stash_is_dropped(self):
+        # Host had no plugin env at all: the strip blanked it; the
+        # user env must not carry a confusing empty value.
+        env = {constants.PJRT_PLUGIN_ENV: ''}
+        job_driver._restore_plugin_env(env)
+        assert constants.PJRT_PLUGIN_ENV not in env
+
+    def test_untouched_env_passes_through(self):
+        env = {constants.PJRT_PLUGIN_ENV: '10.0.0.9', 'OTHER': 'x'}
+        job_driver._restore_plugin_env(env)
+        assert env[constants.PJRT_PLUGIN_ENV] == '10.0.0.9'
+        assert env['OTHER'] == 'x'
+
+
+class TestStripPrefix:
+
+    def test_rpc_command_carries_the_prefix(self):
+        cmd = agent_rpc.make_rpc_command('ping')
+        assert cmd.startswith(constants.PJRT_STRIP_PREFIX)
+
+    def _bash_env_after_prefix(self, outer_env):
+        """Run the real prefix through bash; report what a child sees."""
+        script = (constants.PJRT_STRIP_PREFIX +
+                  f'python3 -c "import os; '
+                  f"print(repr(os.environ.get('"
+                  f"{constants.PJRT_PLUGIN_ENV}'))); "
+                  f"print(repr(os.environ.get('"
+                  f"{constants.PJRT_STASH_ENV}')))\"")
+        proc = subprocess.run(['bash', '-c', script], env=outer_env,
+                              capture_output=True, text=True,
+                              check=True)
+        plugin, stash = proc.stdout.strip().splitlines()
+        return eval(plugin), eval(stash)  # noqa: S307 — repr round-trip
+
+    def test_fresh_spawner_stashes_live_value(self):
+        plugin, stash = self._bash_env_after_prefix(
+            {'PATH': '/usr/bin:/bin',
+             constants.PJRT_PLUGIN_ENV: '10.1.2.3'})
+        assert plugin == ''        # stripped for the control plane
+        assert stash == '10.1.2.3'  # preserved for user jobs
+
+    def test_stripped_spawner_forwards_inherited_stash(self):
+        # A stripped daemon spawning the driver: its blanked live var
+        # must NOT clobber the inherited stash.
+        plugin, stash = self._bash_env_after_prefix(
+            {'PATH': '/usr/bin:/bin',
+             constants.PJRT_PLUGIN_ENV: '',
+             constants.PJRT_STASH_ENV: '10.1.2.3'})
+        assert plugin == ''
+        assert stash == '10.1.2.3'
+
+    def test_no_plugin_host_stays_clean(self):
+        plugin, stash = self._bash_env_after_prefix(
+            {'PATH': '/usr/bin:/bin'})
+        assert plugin == ''
+        assert stash == ''
