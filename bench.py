@@ -308,10 +308,16 @@ def run_through_launch(steps_arg) -> None:
     # --log-every 1: each window device_gets (real sync on the
     # tunneled backend) and the metrics line reports the LAST window —
     # steady state, excluding the compile step.
+    # Persistent compile cache: a retry attempt (or a second capture
+    # in the same round) skips the first-step XLA compile — on TPU
+    # that is 20-40s of the provision-to-first-step number.
+    compile_cache = os.path.join(paths.state_dir(),
+                                 'bench_compile_cache')
     run_cmd = (
         f'python3 -m skypilot_tpu.train --model llama-tiny '
         f'--steps {steps + 1} --global-batch-size {batch} '
         f'--seq-len {seq} --log-every 1 '
+        f'--compilation-cache-dir {compile_cache} '
         f"--model-overrides '{overrides_json}' --json-metrics")
     task = sky.Task(run=run_cmd,
                     envs={callbacks.BENCHMARK_LOG_ENV: step_log})

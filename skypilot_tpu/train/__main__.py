@@ -27,6 +27,11 @@ def main() -> None:
                              '(default: 2*pipe).')
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=0)
+    parser.add_argument('--compilation-cache-dir', default=None,
+                        help='Persistent XLA compile cache: repeat/'
+                             'recovered runs skip the first-step '
+                             'compile. Point at the bucket-mounted '
+                             'checkpoint dir for preemption recovery.')
     parser.add_argument('--dataset', default=None,
                         help='HF dataset (default: synthetic).')
     parser.add_argument('--tokenizer', default=None)
@@ -86,6 +91,7 @@ def main() -> None:
         pipeline_microbatches=args.pipeline_microbatches,
         model_overrides=overrides,
         train_only=args.train_only,
+        compilation_cache_dir=args.compilation_cache_dir,
     )
     trainer = trainer_lib.Trainer(config)
     manager = None

@@ -70,6 +70,46 @@ class TestLlama:
         assert actual == llama.num_params(cfg)
 
 
+class TestCompilationCache:
+
+    def test_repeat_run_hits_persistent_cache(self, tmp_path):
+        """--compilation-cache-dir: the SECOND fresh-interpreter run
+        of the same program must reuse the first run's compiled
+        executables (on TPU this is 20-40s of provision-to-first-step;
+        the managed-jobs recovery path points the cache at the
+        checkpoint bucket)."""
+        import os
+        import subprocess
+        import sys
+        from skypilot_tpu.agent import constants as agent_constants
+        cache = tmp_path / 'cc'
+        env = dict(os.environ)
+        env['SKYTPU_STATE_DIR'] = str(tmp_path / 'state')
+        # --platform cpu: the PJRT plugin env is pure liability here
+        # (a wedged tunnel would stall the subprocess at sitecustomize
+        # import until the test timeout).
+        env.pop(agent_constants.PJRT_PLUGIN_ENV, None)
+        overrides = ('{"max_seq_len":32,"vocab_size":128,"dim":32,'
+                     '"n_layers":1,"n_heads":2,"n_kv_heads":1,'
+                     '"ffn_dim":64}')
+        cmd = [sys.executable, '-m', 'skypilot_tpu.train',
+               '--platform', 'cpu', '--model', 'llama-tiny',
+               '--steps', '1', '--global-batch-size', '8',
+               '--seq-len', '32', '--mesh', 'data=8,fsdp=1',
+               '--compilation-cache-dir', str(cache),
+               '--model-overrides', overrides, '--log-every', '1']
+        proc1 = subprocess.run(cmd, env=env, capture_output=True,
+                               text=True, timeout=300)
+        assert proc1.returncode == 0, proc1.stderr[-2000:]
+        entries_after_first = set(os.listdir(cache))
+        assert entries_after_first  # executables persisted
+        proc2 = subprocess.run(cmd, env=env, capture_output=True,
+                               text=True, timeout=300)
+        assert proc2.returncode == 0, proc2.stderr[-2000:]
+        # A fully-cached second run compiles nothing new.
+        assert set(os.listdir(cache)) == entries_after_first
+
+
 class TestTrainer:
 
     def _trainer(self, **kw):
