@@ -311,8 +311,9 @@ def run_through_launch(steps_arg) -> None:
     # Persistent compile cache: a retry attempt (or a second capture
     # in the same round) skips the first-step XLA compile — on TPU
     # that is 20-40s of the provision-to-first-step number.
-    compile_cache = os.path.join(paths.state_dir(),
-                                 'bench_compile_cache')
+    import shlex
+    compile_cache = shlex.quote(
+        os.path.join(paths.state_dir(), 'bench_compile_cache'))
     run_cmd = (
         f'python3 -m skypilot_tpu.train --model llama-tiny '
         f'--steps {steps + 1} --global-batch-size {batch} '

@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import http.server
 import json
+import os
 import threading
 from typing import Optional
 
@@ -49,12 +50,20 @@ class InferenceServer:
                  continuous: bool = True,
                  prefill_chunk: int = 0,
                  kv_read_bucket: int = 512,
-                 quantize=None) -> None:
+                 quantize=None,
+                 compilation_cache_dir=None) -> None:
         from skypilot_tpu.parallel import mesh as mesh_lib
         # Hang-proof first backend touch: a wedged tunneled TPU makes
         # this raise (replica exits, probe marks it FAILED) instead of
         # hanging forever behind a 200 /health that never comes.
         mesh_lib.force_platform_and_touch()
+        if compilation_cache_dir:
+            # Replica readiness is dominated by the prefill/decode
+            # compiles: a persistent cache (e.g. on the checkpoint
+            # bucket) makes scale-up replicas and restarts come READY
+            # in seconds instead of the full compile window.
+            mesh_lib.enable_persistent_compilation_cache(
+                compilation_cache_dir)
         mesh = None
         if mesh_config:
             kwargs = {}
@@ -244,6 +253,11 @@ def main() -> None:
                              'HBM traffic; composes with --mesh '
                              '(q8/scale leaves shard like their float '
                              'kernels).')
+    parser.add_argument('--compilation-cache-dir', default=None,
+                        help='Persistent XLA compile cache: '
+                             'scale-up replicas/restarts skip the '
+                             'prefill+decode compiles and come '
+                             'READY in seconds.')
     parser.add_argument('--platform', default=None,
                         help="Force a jax platform (e.g. 'cpu' for "
                              'tests; env JAX_PLATFORMS alone is not '
@@ -267,7 +281,9 @@ def main() -> None:
                     continuous=args.continuous,
                     prefill_chunk=args.prefill_chunk,
                     kv_read_bucket=args.kv_read_bucket,
-                    quantize=args.quantize).serve_forever()
+                    quantize=args.quantize,
+                    compilation_cache_dir=args.compilation_cache_dir,
+                    ).serve_forever()
 
 
 if __name__ == '__main__':

@@ -233,6 +233,21 @@ def _sub_device_array(shape: Tuple[int, ...],
         return np.array(list(devices)).reshape(shape)
 
 
+def enable_persistent_compilation_cache(cache_dir: str) -> None:
+    """Process-wide persistent XLA compile cache: repeat runs of the
+    same program (trainer restarts, scale-up serving replicas) load
+    the executable instead of recompiling — 20-40s per program on TPU.
+    Zero min-compile-time so tiny dev models cache too.  Shared by
+    train/trainer.py and infer/server.py (one home next to the other
+    process-level jax.config preamble, force_platform_and_touch)."""
+    import os
+    cache_dir = os.path.expanduser(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update('jax_compilation_cache_dir', cache_dir)
+    jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                      0.0)
+
+
 def make_mesh(config: Optional[MeshConfig] = None,
               devices: Optional[Sequence[jax.Device]] = None,
               num_slices: Optional[int] = None) -> Mesh:

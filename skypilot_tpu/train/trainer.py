@@ -200,16 +200,8 @@ class Trainer:
         import skypilot_tpu.models as models_lib
         self.config = config
         if config.compilation_cache_dir:
-            import os as os_lib
-            cache_dir = os_lib.path.expanduser(
+            mesh_lib.enable_persistent_compilation_cache(
                 config.compilation_cache_dir)
-            os_lib.makedirs(cache_dir, exist_ok=True)
-            jax.config.update('jax_compilation_cache_dir', cache_dir)
-            # Cache even fast compiles: tiny dev models compile in
-            # <1s (the default threshold) but repeat e2e runs still
-            # want the hit.
-            jax.config.update(
-                'jax_persistent_cache_min_compile_time_secs', 0.0)
         overrides = dict(config.model_overrides)
         context_size = (mesh.shape['context'] if mesh is not None
                         else config.mesh.context)

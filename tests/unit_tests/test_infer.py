@@ -93,6 +93,29 @@ class TestEngineCorrectness:
                 engine_lib.SamplingConfig(max_new_tokens=30))
 
 
+class TestServerSurface:
+
+    def test_server_cli_flags(self):
+        """The serve-recipe flags (examples/llm/*.yaml) must exist."""
+        import os
+        import subprocess
+        import sys
+        from skypilot_tpu.agent import constants as agent_constants
+        env = dict(os.environ)
+        # A wedged tunneled TPU must not stall --help at the
+        # sitecustomize plugin import (same stance as the
+        # compilation-cache test in test_model_train.py).
+        env.pop(agent_constants.PJRT_PLUGIN_ENV, None)
+        out = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.infer.server',
+             '--help'], capture_output=True, text=True,
+            timeout=120, env=env).stdout
+        for flag in ('--mesh', '--quantize', '--prefill-chunk',
+                     '--kv-read-bucket', '--compilation-cache-dir',
+                     '--checkpoint-dir'):
+            assert flag in out, flag
+
+
 class TestEngineSharded:
 
     def test_mesh_sharded_generation_matches_single(self):
