@@ -23,6 +23,8 @@ _FETCHERS = {
     'runpod': 'fetch_runpod',
     'do': 'fetch_do',
     'fluidstack': 'fetch_fluidstack',
+    'cudo': 'fetch_cudo',
+    'vsphere': 'fetch_vsphere',
 }
 FETCHABLE = frozenset(_FETCHERS)
 

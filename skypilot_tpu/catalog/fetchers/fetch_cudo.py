@@ -1,0 +1,104 @@
+"""Regenerate the Cudo `vms` table from the machine-types API.
+
+Reference: sky/clouds/service_catalog/data_fetchers/fetch_cudo.py —
+it walks a fixed (gpu, vcpu, mem) spec ladder and prices each
+machine type from the API's per-unit rates.  Same approach here over
+the REST endpoint the provisioner already uses:
+
+    GET /v1/vms/machine-types  (Bearer key)
+    -> machineTypes: [{machineType, gpuModel, dataCenterId,
+                       gpuPriceHr, vcpuPriceHr, memoryGibPriceHr}]
+
+`fetch_json` is injectable for air-gapped tests.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+PATH = '/vms/machine-types'
+
+# (gpus, vcpus, mem_gib) ladder per machine type — the reference's
+# spec list (fetch_cudo.py:63) in the same type grammar.
+_SPECS = [(0, 8, 32), (0, 16, 64), (1, 4, 16), (1, 8, 32),
+          (1, 8, 48), (1, 24, 96), (4, 32, 192), (8, 192, 768)]
+
+# Cudo gpuModel -> canonical accelerator name.
+_GPU_NAMES = {
+    'RTX A4000': 'RTXA4000',
+    'RTX A5000': 'RTXA5000',
+    'RTX A6000': 'RTXA6000',
+    'A100 PCIe 80GB': 'A100-80GB',
+    'H100 PCIe': 'H100',
+    'H100 SXM': 'H100-SXM',
+    'V100': 'V100',
+}
+
+
+def _default_fetch_json(_path: str) -> Dict[str, Any]:
+    from skypilot_tpu.provision.cudo import cudo_api
+    return cudo_api.request('GET', PATH)
+
+
+def _price(entry: Dict[str, Any], gpus: int, vcpus: int,
+           mem: int) -> float:
+    def _rate(key):
+        value = entry.get(key)
+        if isinstance(value, dict):  # {'value': '0.02'} API form
+            value = value.get('value')
+        return float(value or 0)
+    return round(gpus * _rate('gpuPriceHr')
+                 + vcpus * _rate('vcpuPriceHr')
+                 + mem * _rate('memoryGibPriceHr'), 4)
+
+
+def rows_from_machine_types(payload: Dict[str, Any]):
+    rows = []
+    seen = set()
+    for entry in payload.get('machineTypes') or []:
+        machine_type = str(entry.get('machineType', ''))
+        if not machine_type:
+            continue
+        gpu_model = str(entry.get('gpuModel', '') or '')
+        acc = _GPU_NAMES.get(gpu_model, gpu_model.replace(' ', ''))
+        for gpus, vcpus, mem in _SPECS:
+            if gpus > 0 and not gpu_model:
+                continue
+            if gpus == 0 and gpu_model:
+                continue
+            itype = f'{machine_type}_{gpus}x{vcpus}v{mem}gb'
+            if itype in seen:
+                continue
+            price = _price(entry, gpus, vcpus, mem)
+            if price <= 0:
+                continue
+            seen.add(itype)
+            rows.append({
+                'instance_type': itype,
+                'vcpus': vcpus,
+                'memory_gb': mem,
+                'accelerator_name': acc if gpus else '',
+                'accelerator_count': gpus,
+                'price': price,
+                'spot_price': price,  # no spot tier
+            })
+    return sorted(rows, key=lambda r: r['instance_type'])
+
+
+def fetch_and_write(fetch_json: Optional[Callable[[str],
+                                                  Dict[str, Any]]] = None
+                    ) -> Dict[str, str]:
+    from skypilot_tpu.catalog import common
+    from skypilot_tpu.catalog import cudo_catalog
+    fetch_json = fetch_json or _default_fetch_json
+    rows = rows_from_machine_types(fetch_json(PATH))
+    if not rows:
+        raise RuntimeError('Cudo machine-types API returned nothing '
+                           'usable; keeping the previous table.')
+    path = common.write_catalog_csv('cudo', 'vms',
+                                    common.rows_to_vms_csv(rows))
+    cudo_catalog.CATALOG.reload()
+    return {'vms': path}

@@ -1,0 +1,115 @@
+"""Regenerate the vSphere `vms` table from vCenter inventory.
+
+Reference: sky/clouds/service_catalog/data_fetchers/fetch_vsphere.py —
+it walks the vCenter host inventory (pyvmomi) and emits shapes the
+site can actually schedule.  Here the same walk rides the vCenter
+Automation REST API the provisioner already uses
+(GET /api/vcenter/host -> connected hosts).
+
+Preset shapes are emitted only up to the LARGEST connected host, and
+GPU presets only when config `vsphere.gpu_presets` opts in (the REST
+host summary carries no GPU inventory, so "has GPUs" is the site
+operator's call) — an on-prem catalog must not advertise shapes the
+site cannot place.  Prices are chargeback anchors carried over from
+the current table, falling back to the built-in snapshot's anchors.
+`fetch_json` is injectable for air-gapped tests.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+# (instance_type, vcpus, mem_gb, acc_name, acc_count) presets; the
+# fetch trims this to what the site's hosts can hold.
+_PRESETS = [
+    ('cpu-small', 4, 16, '', 0),
+    ('cpu-medium', 8, 32, '', 0),
+    ('cpu-large', 16, 64, '', 0),
+    ('cpu-xlarge', 32, 128, '', 0),
+    ('gpu-t4-8x32', 8, 32, 'T4', 1),
+    ('gpu-v100-8x64', 8, 64, 'V100', 1),
+    ('gpu-a100-16x128', 16, 128, 'A100', 1),
+]
+
+
+def _default_fetch_json(path: str) -> Any:
+    from skypilot_tpu.provision.vsphere import vsphere_api
+    return vsphere_api.request('GET', path)
+
+
+def rows_from_hosts(hosts: List[Dict[str, Any]],
+                    current_prices: Dict[str, float],
+                    gpu_presets: bool):
+    """Trim the preset ladder to the largest CONNECTED host."""
+    connected = [h for h in hosts or []
+                 if str(h.get('connection_state', '')).upper()
+                 == 'CONNECTED']
+    if not connected:
+        return []
+    max_cpu = max(int(h.get('cpu_count', 0) or 0)
+                  for h in connected)
+    max_mem = max(float(h.get('memory_size_MiB', 0) or 0) / 1024.0
+                  for h in connected)
+    # Hosts that don't report capacity still serve the full ladder
+    # (the REST summary omits these fields on some vCenter versions).
+    if max_cpu <= 0:
+        max_cpu, max_mem = 1 << 30, float(1 << 30)
+    rows = []
+    for itype, vcpus, mem, acc, count in _PRESETS:
+        if count > 0 and not gpu_presets:
+            logger.info(f'vsphere fetch: dropping {itype} — set '
+                        'config vsphere.gpu_presets: true if this '
+                        'site has passthrough GPUs.')
+            continue
+        if vcpus > max_cpu or mem > max_mem:
+            logger.info(f'vsphere fetch: dropping {itype} '
+                        f'({vcpus}v/{mem}g exceeds the largest host '
+                        f'{max_cpu}v/{max_mem:.0f}g).')
+            continue
+        price = current_prices.get(itype, 0.05 * (vcpus / 4))
+        rows.append({
+            'instance_type': itype,
+            'vcpus': vcpus,
+            'memory_gb': mem,
+            'accelerator_name': acc,
+            'accelerator_count': count,
+            'price': price,
+            'spot_price': price,
+        })
+    return rows
+
+
+def fetch_and_write(fetch_json: Optional[Callable[[str], Any]] = None
+                    ) -> Dict[str, str]:
+    from skypilot_tpu.catalog import common
+    from skypilot_tpu.catalog import vsphere_catalog
+    import io
+
+    import pandas as pd
+
+    from skypilot_tpu import config as config_lib
+    fetch_json = fetch_json or _default_fetch_json
+    hosts = fetch_json('/api/vcenter/host') or []
+    # Chargeback anchors: snapshot prices UNDER the current (possibly
+    # trimmed) override — a preset dropped by an earlier fetch must
+    # come back at its real anchor, not a formula guess.
+    current = {
+        str(r['instance_type']): float(r['price'])
+        for _, r in pd.read_csv(io.StringIO(
+            vsphere_catalog._VMS_CSV)).iterrows()}  # pylint: disable=protected-access
+    current.update({
+        str(r['instance_type']): float(r['price'])
+        for _, r in vsphere_catalog.CATALOG._vm_df().iterrows()})  # pylint: disable=protected-access
+    gpu_presets = bool(config_lib.get_nested(
+        ('vsphere', 'gpu_presets'), False))
+    rows = rows_from_hosts(hosts, current, gpu_presets)
+    if not rows:
+        raise RuntimeError('no CONNECTED vCenter hosts; keeping the '
+                           'previous table.')
+    path = common.write_catalog_csv('vsphere', 'vms',
+                                    common.rows_to_vms_csv(rows))
+    vsphere_catalog.CATALOG.reload()
+    return {'vms': path}

@@ -351,6 +351,116 @@ class TestFetchFluidstack:
         fluidstack_catalog.reload()
 
 
+class TestFetchCudo:
+
+    _TYPES = {'machineTypes': [
+        {'machineType': 'epyc-milan-rtx-a4000', 'gpuModel': 'RTX A4000',
+         'dataCenterId': 'no-luster-1', 'gpuPriceHr': {'value': '0.25'},
+         'vcpuPriceHr': {'value': '0.01'},
+         'memoryGibPriceHr': {'value': '0.002'}},
+        {'machineType': 'epyc-milan', 'gpuModel': '',
+         'dataCenterId': 'no-luster-1', 'gpuPriceHr': {'value': '0'},
+         'vcpuPriceHr': {'value': '0.01'},
+         'memoryGibPriceHr': {'value': '0.002'}},
+    ]}
+
+    def test_fetch_prices_from_unit_rates(self, monkeypatch):
+        monkeypatch.setenv('CUDO_API_KEY', 'ck')
+        from skypilot_tpu.catalog import cudo_catalog
+        from skypilot_tpu.catalog.fetchers import fetch_cudo
+        paths = fetch_cudo.fetch_and_write(
+            fetch_json=lambda path: self._TYPES)
+        assert 'vms' in paths
+        # 1 gpu * 0.25 + 4 vcpu * 0.01 + 16 gib * 0.002 = 0.322
+        assert cudo_catalog.CATALOG.get_hourly_cost(
+            'epyc-milan-rtx-a4000_1x4v16gb',
+            use_spot=False) == pytest.approx(0.322)
+        assert cudo_catalog.CATALOG.get_accelerators_from_instance_type(
+            'epyc-milan-rtx-a4000_1x4v16gb') == {'RTXA4000': 1}
+        # CPU machine types emit only gpu=0 rows and vice versa.
+        assert cudo_catalog.CATALOG.instance_type_exists(
+            'epyc-milan_0x8v32gb')
+        assert not cudo_catalog.CATALOG.instance_type_exists(
+            'epyc-milan_1x4v16gb')
+        catalog_common.remove_override('cudo', 'vms')
+        cudo_catalog.CATALOG.reload()
+
+
+class TestFetchVsphere:
+
+    _HOSTS = [
+        {'host': 'host-1', 'connection_state': 'CONNECTED',
+         'cpu_count': 16, 'memory_size_MiB': 64 * 1024},
+        {'host': 'host-2', 'connection_state': 'DISCONNECTED',
+         'cpu_count': 128, 'memory_size_MiB': 1024 * 1024},
+    ]
+
+    def test_fetch_trims_to_largest_connected_host(self, monkeypatch):
+        monkeypatch.setenv('VSPHERE_HOST', 'vc')
+        monkeypatch.setenv('VSPHERE_USER', 'u')
+        monkeypatch.setenv('VSPHERE_PASSWORD', 'p')
+        from skypilot_tpu.catalog import vsphere_catalog
+        from skypilot_tpu.catalog.fetchers import fetch_vsphere
+        paths = fetch_vsphere.fetch_and_write(
+            fetch_json=lambda path: self._HOSTS)
+        assert 'vms' in paths
+        # 16v/64g host: cpu-large fits, cpu-xlarge (32v) does not
+        # (the disconnected 128v host must not count); GPU presets
+        # are dropped without the vsphere.gpu_presets opt-in (the
+        # REST host summary carries no GPU inventory).
+        assert vsphere_catalog.CATALOG.instance_type_exists(
+            'cpu-large')
+        assert not vsphere_catalog.CATALOG.instance_type_exists(
+            'cpu-xlarge')
+        assert not vsphere_catalog.CATALOG.instance_type_exists(
+            'gpu-t4-8x32')
+        # Chargeback anchors carried over from the previous table.
+        assert vsphere_catalog.CATALOG.get_hourly_cost(
+            'cpu-medium', use_spot=False) == pytest.approx(0.10)
+        catalog_common.remove_override('vsphere', 'vms')
+        vsphere_catalog.CATALOG.reload()
+
+    def test_gpu_presets_opt_in_and_anchor_recovery(self, monkeypatch):
+        """With the opt-in, fitting GPU presets come back — and a
+        preset dropped by an earlier (narrower) fetch returns at its
+        SNAPSHOT anchor, not a formula guess."""
+        monkeypatch.setenv('VSPHERE_HOST', 'vc')
+        monkeypatch.setenv('VSPHERE_USER', 'u')
+        monkeypatch.setenv('VSPHERE_PASSWORD', 'p')
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu.catalog import vsphere_catalog
+        from skypilot_tpu.catalog.fetchers import fetch_vsphere
+        monkeypatch.setattr(
+            config_lib, 'get_nested',
+            lambda path, default=None: (
+                True if path == ('vsphere', 'gpu_presets')
+                else default))
+        # First fetch: small host -> GPU 16x128 preset dropped.
+        fetch_vsphere.fetch_and_write(
+            fetch_json=lambda path: self._HOSTS)
+        assert not vsphere_catalog.CATALOG.instance_type_exists(
+            'gpu-a100-16x128')
+        assert vsphere_catalog.CATALOG.instance_type_exists(
+            'gpu-t4-8x32')
+        # Site grows: re-fetch with a big host — the returning preset
+        # carries the built-in snapshot's 2.40 anchor.
+        big = [{'host': 'h', 'connection_state': 'CONNECTED',
+                'cpu_count': 64, 'memory_size_MiB': 512 * 1024}]
+        fetch_vsphere.fetch_and_write(fetch_json=lambda path: big)
+        assert vsphere_catalog.CATALOG.get_hourly_cost(
+            'gpu-a100-16x128', use_spot=False) == pytest.approx(2.40)
+        catalog_common.remove_override('vsphere', 'vms')
+        vsphere_catalog.CATALOG.reload()
+
+    def test_no_connected_hosts_keeps_previous(self, monkeypatch):
+        monkeypatch.setenv('VSPHERE_HOST', 'vc')
+        monkeypatch.setenv('VSPHERE_USER', 'u')
+        monkeypatch.setenv('VSPHERE_PASSWORD', 'p')
+        from skypilot_tpu.catalog.fetchers import fetch_vsphere
+        with pytest.raises(RuntimeError, match='CONNECTED'):
+            fetch_vsphere.fetch_and_write(fetch_json=lambda path: [])
+
+
 class TestCliAndStaleness:
 
     def test_cli_fetch_gcp(self, monkeypatch):
