@@ -192,6 +192,58 @@ def get_config_schema() -> Dict[str, Any]:
             'admin_policy': {'type': 'string'},
             'allowed_clouds': {'type': 'array',
                                'items': {'type': 'string'}},
+            # Per-cloud site settings consumed by the provisioners /
+            # stores (all optional; clouds error with the exact
+            # missing key at launch).
+            'ibm': {
+                'type': 'object',
+                'properties': {
+                    'vpc_id': {'type': 'string'},
+                    'subnet_id': {'type': 'string'},
+                    'image_id': {'type': 'string'},
+                    'key_id': {'type': 'string'},
+                    'cos_region': {'type': 'string'},
+                },
+                'additionalProperties': True,
+            },
+            'oci': {
+                'type': 'object',
+                'properties': {
+                    'subnet_id': {'type': 'string'},
+                    'image_id': {'type': 'string'},
+                    'availability_domain': {'type': 'string'},
+                    'compartment_id': {'type': 'string'},
+                    'namespace': {'type': 'string'},
+                    'region': {'type': 'string'},
+                },
+                'additionalProperties': True,
+            },
+            'scp': {
+                'type': 'object',
+                'properties': {
+                    'zone_id': {'type': 'string'},
+                    'image_id': {'type': 'string'},
+                },
+                'additionalProperties': True,
+            },
+            'vsphere': {
+                'type': 'object',
+                'properties': {
+                    'template_vm': {'type': 'string'},
+                    'gpu_presets': {'type': 'boolean'},
+                },
+                'additionalProperties': True,
+            },
+            'r2': {
+                'type': 'object',
+                'properties': {'account_id': {'type': 'string'}},
+                'additionalProperties': True,
+            },
+            'azure': {
+                'type': 'object',
+                'properties': {'storage_account': {'type': 'string'}},
+                'additionalProperties': True,
+            },
             'docker': {'type': 'object'},
             'nvidia_gpus': {'type': 'object'},
             'usage': {'type': 'object'},
