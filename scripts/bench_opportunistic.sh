@@ -14,7 +14,9 @@
 # Stops by itself after a successful capture or MAX_HOURS.
 set -u
 cd "$(dirname "$0")/.."
-LOG=${BENCH_PROBE_LOG:-.bench_probe.log}
+# Same var bench.py's _probe_forensics reads — reader and writer must
+# agree on a custom path.
+LOG=${SKYTPU_BENCH_PROBE_LOG:-.bench_probe.log}
 MAX_HOURS=${BENCH_PROBE_MAX_HOURS:-11}
 PROBE_SPACING_S=${BENCH_PROBE_SPACING_S:-900}
 DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))

@@ -213,6 +213,42 @@ def test_spaced_direct_attempts(bench, monkeypatch, capsys):
     assert json.loads(out[0])['value'] == 7
 
 
+def test_error_line_carries_probe_forensics(bench, monkeypatch,
+                                            capsys, tmp_path):
+    """When every rung fails AND no cache exists, the error line must
+    still show the round-long hunt (spaced probe attempts)."""
+    import time as time_mod
+    now = time_mod.time()
+
+    def _iso(age_s):
+        return time_mod.strftime('%Y-%m-%dT%H:%M:%SZ',
+                                 time_mod.gmtime(now - age_s))
+
+    stale, first, last = _iso(48 * 3600), _iso(7200), _iso(60)
+    probe_log = tmp_path / 'probe.log'
+    probe_log.write_text(
+        # Loop markers and noise must NOT count as attempts; stale
+        # stamps from a previous round must be age-bounded out.
+        f'[{first}] probe loop start (spacing 900s)\n'
+        'noise line\n'
+        f'[{stale}] tunnel still wedged\n'
+        f'[{first}] tunnel still wedged\n'
+        f'[{last}] tunnel still wedged\n')
+    monkeypatch.setenv('SKYTPU_BENCH_PROBE_LOG', str(probe_log))
+    monkeypatch.setattr(
+        bench, 'run_through_launch',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+    monkeypatch.setattr(
+        bench, 'run_direct_subprocess',
+        lambda _s: (_ for _ in ()).throw(RuntimeError('direct')))
+    with pytest.raises(SystemExit):
+        bench.main()
+    parsed = json.loads(capsys.readouterr().out.strip())
+    assert parsed['probe_attempts'] == 2  # fresh attempts only
+    assert parsed['probe_first'] == first
+    assert parsed['probe_last'] == last
+
+
 def test_backend_init_retry_clears_and_retries(monkeypatch):
     """mesh._devices_with_retry retries a transient UNAVAILABLE."""
     from skypilot_tpu.parallel import mesh as mesh_lib
