@@ -28,8 +28,36 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import time
+
+# ---- Total wall budget (round-4 verdict item 1b) -------------------
+# The ladder used to assume an unbounded window; the driver's outer
+# timeout then killed it mid-sleep with nothing on stdout (rc=124,
+# parsed null).  Now every rung draws from ONE budget and the final
+# rung (cached number or structured error line) is always reached:
+# the ladder checks remaining time before each rung, shortens the
+# inter-attempt sleeps to fit, and a SIGTERM/SIGALRM handler emits
+# the final line even if an external timeout fires first.
+_TOTAL_BUDGET_S = float(os.environ.get('SKYTPU_BENCH_TOTAL_BUDGET_S',
+                                       '1500'))
+_START_TIME = time.time()
+# Seconds reserved at the end for the cache/error rung itself.
+_FINAL_RUNG_RESERVE_S = 20.0
+
+
+def _remaining_s() -> float:
+    return _TOTAL_BUDGET_S - (time.time() - _START_TIME)
+
+
+_FAILURES: list = []
+_FINAL_EMITTED = False
+# Cluster the e2e rung has live right now; the signal handler must
+# tear it down (detached — the handler itself has to exit fast) or a
+# leaked job keeps the single-client TPU tunnel wedged for every
+# later capture attempt.
+_ACTIVE_CLUSTER: list = []
 
 def _cache_path() -> str:
     return os.environ.get(
@@ -79,18 +107,69 @@ def emit_cached_result() -> bool:
               f'(captured_at={payload.get("captured_at")}); ignoring',
               file=sys.stderr)
         return False
-    result = {k: payload[k] for k in
-              ('metric', 'value', 'unit', 'vs_baseline')
-              if k in payload}
-    if 'provision_to_first_step_s' in payload:
-        result['provision_to_first_step_s'] = \
-            payload['provision_to_first_step_s']
+    # Carry everything _emit wrote (incl. the self-auditing raw
+    # fields) except the nested raw dict and internal timestamps.
+    result = {k: v for k, v in payload.items()
+              if k not in ('raw', 'captured_unix', 'captured_at')}
     result['stale'] = True
     result['captured_at'] = payload.get('captured_at')
     print(json.dumps(result))
     print(f'# live attempts failed; emitted cached measurement from '
           f'{payload.get("captured_at")}', file=sys.stderr)
     return True
+
+
+def _final_rung(reason: str) -> bool:
+    """The unconditional last rung: a dated in-round hardware number
+    if one exists (returns True), else a structured error line with
+    the round's probe forensics (returns False).  Idempotent —
+    callable from the normal ladder end AND from a signal handler
+    without double-printing."""
+    global _FINAL_EMITTED
+    if _FINAL_EMITTED:
+        return False
+    _FINAL_EMITTED = True
+    if emit_cached_result():
+        return True
+    result = {'metric': 'bench-e2e', 'value': 0,
+              'unit': 'error', 'vs_baseline': 0,
+              'error': (' | '.join(_FAILURES) or reason)[:900]}
+    if reason and _FAILURES:
+        result['terminated_by'] = reason
+    result.update(_probe_forensics())
+    print(json.dumps(result), flush=True)
+    return False
+
+
+def _on_deadline_signal(signum, frame):  # noqa: ARG001
+    """SIGTERM (external driver timeout) / SIGALRM (our own budget
+    backstop): emit the final rung NOW and exit.  rc=124 with nothing
+    parseable on stdout must be impossible (round-4 verdict)."""
+    name = signal.Signals(signum).name
+    print(f'# bench received {name}; emitting final rung before exit',
+          file=sys.stderr, flush=True)
+    if _ACTIVE_CLUSTER:
+        # Detached best-effort teardown: it must survive our exit and
+        # must not delay the final line (the driver's SIGKILL follows).
+        import subprocess
+        cluster = _ACTIVE_CLUSTER[-1]
+        try:
+            subprocess.Popen(
+                [sys.executable, '-c',
+                 'import skypilot_tpu as sky; '
+                 f'sky.down({cluster!r})'],
+                start_new_session=True,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            print(f'# spawned detached teardown of {cluster!r}',
+                  file=sys.stderr, flush=True)
+        except OSError:
+            pass
+    cached = _final_rung(f'killed by {name} at '
+                         f'{time.time() - _START_TIME:.0f}s/'
+                         f'{_TOTAL_BUDGET_S:.0f}s budget')
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0 if cached else 1)
 
 
 class BenchError(RuntimeError):
@@ -158,17 +237,31 @@ def _emit(tokens_per_sec: float, n_params: float, n_chips: int,
     per_chip = equiv / max(n_chips, 1)
     baseline = (_BASELINE_V6E_TOKENS_PER_SEC_PER_CHIP *
                 chip_tflops / _V6E_TFLOPS)
+    mfu = total_flops_per_sec / (max(n_chips, 1) * chip_tflops * 1e12)
     result = {
         'metric': f'llama3-8b-equiv train tokens/sec/chip @seq{seq}',
         'value': round(per_chip, 2),
         'unit': 'tokens/s/chip',
         'vs_baseline': round(per_chip / baseline, 3),
+        # Self-auditing raw numbers (round-4 verdict item 2): the
+        # headline is parameter-FLOP-normalized to 8B params and
+        # chip-generation-scaled; these fields let a skeptic recompute
+        # it from scratch — raw throughput, raw utilization, and every
+        # normalization factor used.
+        'raw_tokens_per_sec': round(tokens_per_sec, 1),
+        'raw_mfu_pct': round(mfu * 100, 2),
+        'raw_model_params': round(n_params),
+        'n_chips': n_chips,
+        'device_kind': device_kind,
+        'chip_bf16_tflops': chip_tflops,
+        'baseline_v6e_tok_per_s_per_chip': round(
+            _BASELINE_V6E_TOKENS_PER_SEC_PER_CHIP, 1),
+        'baseline_scaled_to_this_chip': round(baseline, 1),
     }
     if provision_to_first_step is not None:
         result['provision_to_first_step_s'] = round(
             provision_to_first_step, 1)
     print(json.dumps(result))
-    mfu = total_flops_per_sec / (max(n_chips, 1) * chip_tflops * 1e12)
     print(f'# raw: {tokens_per_sec:,.0f} tok/s, model='
           f'{n_params/1e6:.0f}M params, '
           f'{total_flops_per_sec/1e12:.1f} TFLOP/s (incl. attention) on '
@@ -280,7 +373,7 @@ def run_direct_subprocess(steps_arg) -> None:
     print(metric)
 
 
-def run_through_launch(steps_arg) -> None:
+def run_through_launch(steps_arg, deadline_s=None) -> None:
     """The real path: sky launch -> agent -> gang driver -> trainer on
     a local-cloud cluster wrapping this host's TPU.  This process must
     NOT touch jax (the tunneled TPU admits one client); all device
@@ -325,22 +418,28 @@ def run_through_launch(steps_arg) -> None:
     task.set_resources(sky.Resources(cloud='local'))
 
     launch_started = time.time()
+    _ACTIVE_CLUSTER.append(cluster)
     job_id, handle = sky.launch(task, cluster_name=cluster,
                                 detach_run=True, quiet_optimizer=True)
     try:
         _finish_through_launch(sky, cluster, job_id, handle, step_log,
-                               launch_started, overrides)
+                               launch_started, overrides, deadline_s)
     finally:
         try:
             sky.down(cluster)
         except Exception:  # noqa: BLE001 — best-effort teardown
             pass
+        if cluster in _ACTIVE_CLUSTER:
+            _ACTIVE_CLUSTER.remove(cluster)
 
 
 def _finish_through_launch(sky, cluster, job_id, handle, step_log,
-                           launch_started, overrides) -> None:
-    deadline = time.time() + float(
-        os.environ.get('SKYTPU_BENCH_E2E_DEADLINE_S', '3600'))
+                           launch_started, overrides,
+                           deadline_s=None) -> None:
+    if deadline_s is None:
+        deadline_s = float(
+            os.environ.get('SKYTPU_BENCH_E2E_DEADLINE_S', '3600'))
+    deadline = time.time() + deadline_s
     status = None  # stays None if the deadline elapses before one poll
     while time.time() < deadline:
         status = sky.job_status(cluster, [job_id])[job_id]
@@ -401,62 +500,106 @@ def main() -> None:
         run_direct(args.quick, args.steps)
         return
     # The e2e path is primary (provision-to-first-step is half the
-    # north star) but the capture must be unkillable: retry the e2e
-    # once, then fall back to --direct (no orchestration, still a real
-    # hardware number), and exit non-zero if NO attempt produced a
-    # metric — a silent rc-0/no-metric run must never happen again.
-    failures = []
+    # north star) but the capture must be unkillable: retry the e2e,
+    # then fall back to --direct (no orchestration, still a real
+    # hardware number), then the cache rung — and every rung draws
+    # from ONE total wall budget so the final rung is ALWAYS reached
+    # before any realistic external timeout (round-4 verdict: rc=124
+    # with nothing parseable must be impossible).
+    signal.signal(signal.SIGTERM, _on_deadline_signal)
+    signal.signal(signal.SIGALRM, _on_deadline_signal)
+    # Our own backstop: even if the ladder's bookkeeping is wrong or a
+    # rung blocks in uninterruptible C code, the alarm fires at the
+    # budget and the handler emits the final line.
+    signal.alarm(max(30, int(_remaining_s())))
+    print(f'# bench ladder budget: {_TOTAL_BUDGET_S:.0f}s total, '
+          f'{_FINAL_RUNG_RESERVE_S:.0f}s reserved for the final rung',
+          file=sys.stderr)
+    try:
+        _run_ladder(args)
+    finally:
+        # The alarm must not outlive the ladder (it would fire inside
+        # whatever process state comes after, e.g. a test harness).
+        signal.alarm(0)
+
+
+def _run_ladder(args) -> None:
+
+    # --- e2e rung(s): need provisioning + compile + steps headroom.
+    e2e_min_s = 240.0
+    e2e_env_deadline = float(
+        os.environ.get('SKYTPU_BENCH_E2E_DEADLINE_S', '3600'))
     for attempt in range(2):
+        headroom = _remaining_s() - _FINAL_RUNG_RESERVE_S - 60
+        if headroom < e2e_min_s:
+            print(f'# skipping e2e attempt {attempt + 1}: only '
+                  f'{_remaining_s():.0f}s of budget left',
+                  file=sys.stderr)
+            break
         try:
-            run_through_launch(args.steps)
+            run_through_launch(args.steps,
+                               deadline_s=min(e2e_env_deadline,
+                                              headroom))
             return
         except BaseException as e:  # noqa: BLE001 — any loss of the
             # metric (job failure, backend init, orchestration crash)
             # must trigger the retry/fallback ladder, not a bare exit.
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 raise
-            failures.append(f'e2e attempt {attempt + 1}: {e!r}')
+            _FAILURES.append(f'e2e attempt {attempt + 1}: {e!r}')
             print(f'# bench e2e attempt {attempt + 1} failed: {e!r}',
                   file=sys.stderr)
             tail = getattr(e, 'log_tail', '')
             if tail:
                 print(tail, file=sys.stderr)
             if attempt == 0:
-                time.sleep(15)
-    # Spaced --direct attempts: the tunnel hang can outlast any single
-    # watchdog window, so fresh-process attempts are spread over tens
-    # of minutes rather than fired back-to-back (round-3 verdict).
+                time.sleep(min(15, max(0, _remaining_s() - e2e_min_s)))
+
+    # --- --direct rung(s): spaced fresh-process attempts (the tunnel
+    # hang can outlast any single watchdog window), but the spacing
+    # now bends to the budget instead of overrunning it.
     direct_attempts = int(os.environ.get(
         'SKYTPU_BENCH_DIRECT_ATTEMPTS', '3'))
     spacing_s = float(os.environ.get(
         'SKYTPU_BENCH_DIRECT_SPACING_S', '600'))
+    direct_min_s = 150.0
+    env_direct_timeout = float(os.environ.get(
+        'SKYTPU_BENCH_DIRECT_TIMEOUT_S', '2400'))
     for attempt in range(direct_attempts):
+        headroom = _remaining_s() - _FINAL_RUNG_RESERVE_S - 10
+        if headroom < direct_min_s:
+            print(f'# skipping --direct attempt {attempt + 1}: only '
+                  f'{_remaining_s():.0f}s of budget left',
+                  file=sys.stderr)
+            break
         if attempt > 0:
-            print(f'# waiting {spacing_s:.0f}s before --direct attempt '
-                  f'{attempt + 1}/{direct_attempts} (fresh backend '
-                  f'window)', file=sys.stderr)
-            time.sleep(spacing_s)
+            # Sleep only what the budget can spare after reserving a
+            # minimum-length attempt; 0 means back-to-back.
+            sleep_s = min(spacing_s,
+                          max(0.0, headroom - direct_min_s))
+            if sleep_s > 0:
+                print(f'# waiting {sleep_s:.0f}s before --direct '
+                      f'attempt {attempt + 1}/{direct_attempts} '
+                      f'(fresh backend window)', file=sys.stderr)
+                time.sleep(sleep_s)
+            headroom = _remaining_s() - _FINAL_RUNG_RESERVE_S - 10
         print(f'# falling back to --direct (subprocess trainer, '
               f'attempt {attempt + 1}/{direct_attempts})',
               file=sys.stderr)
         try:
+            os.environ['SKYTPU_BENCH_DIRECT_TIMEOUT_S'] = str(
+                max(direct_min_s, min(env_direct_timeout, headroom)))
             run_direct_subprocess(args.steps)
             return
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 raise
-            failures.append(f'direct attempt {attempt + 1}: {e!r}')
+            _FAILURES.append(f'direct attempt {attempt + 1}: {e!r}')
             print(f'# bench --direct attempt {attempt + 1} failed: '
                   f'{e!r}', file=sys.stderr)
     # Last rung: a dated in-round measurement beats no number at all.
-    if emit_cached_result():
-        return
-    result = {'metric': 'bench-e2e', 'value': 0,
-              'unit': 'error', 'vs_baseline': 0,
-              'error': ' | '.join(failures)[:900]}
-    result.update(_probe_forensics())
-    print(json.dumps(result))
-    sys.exit(1)
+    if not _final_rung('ladder exhausted'):
+        sys.exit(1)
 
 
 def _probe_forensics() -> dict:

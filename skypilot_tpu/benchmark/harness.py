@@ -144,46 +144,49 @@ def status(benchmark: str) -> List[Dict[str, Any]]:
         raise exceptions.BenchmarkError(
             f'unknown benchmark {benchmark!r}; have '
             f'{bench_state.get_benchmarks()}')
-    results = []
-    for run in runs:
-        # Records from other launches are excluded by the per-launch
-        # nonce in the log path; no wall-clock filter (cluster clocks
-        # may be skewed vs this client).
-        records = _fetch_step_records(run)
-        if not records and run.get('results'):
-            # Cluster gone (post-down): serve the snapshot taken at
-            # teardown instead of an empty shell.
-            results.append(run['results'])
-            continue
-        entry: Dict[str, Any] = {
-            'cluster': run['cluster'],
-            'resources': run['resources'],
-            'num_steps': len(records),
-            'secs_per_step': None,
-            'dollars_per_step': None,
-            'steps_per_sec': None,
-            # Half the BASELINE north star: launch-call start to the
-            # workload's first step callback.
-            'provision_to_first_step': None,
-        }
-        if records and run.get('launched_at'):
-            entry['provision_to_first_step'] = (
-                min(r['ts'] for r in records) - run['launched_at'])
-        if len(records) >= 2:
-            ts = sorted(r['ts'] for r in records)
-            deltas = [b - a for a, b in zip(ts, ts[1:]) if b > a]
-            if deltas:
-                deltas.sort()
-                median = deltas[len(deltas) // 2]
-                entry['secs_per_step'] = median
-                entry['steps_per_sec'] = 1.0 / median if median else None
-                try:
-                    res = resources_lib.Resources(**run['resources'])
-                    entry['dollars_per_step'] = res.get_cost(median)
-                except Exception:  # pylint: disable=broad-except
-                    pass
-        results.append(entry)
-    return results
+    return [_status_entry(run) for run in runs]
+
+
+def _status_entry(run: Dict[str, Any]) -> Dict[str, Any]:
+    """One candidate's steps/sec and $/step entry (may raise if its
+    cluster's step logs are unreachable)."""
+    from skypilot_tpu import resources as resources_lib
+    # Records from other launches are excluded by the per-launch
+    # nonce in the log path; no wall-clock filter (cluster clocks
+    # may be skewed vs this client).
+    records = _fetch_step_records(run)
+    if not records and run.get('results'):
+        # Cluster gone (post-down): serve the snapshot taken at
+        # teardown instead of an empty shell.
+        return run['results']
+    entry: Dict[str, Any] = {
+        'cluster': run['cluster'],
+        'resources': run['resources'],
+        'num_steps': len(records),
+        'secs_per_step': None,
+        'dollars_per_step': None,
+        'steps_per_sec': None,
+        # Half the BASELINE north star: launch-call start to the
+        # workload's first step callback.
+        'provision_to_first_step': None,
+    }
+    if records and run.get('launched_at'):
+        entry['provision_to_first_step'] = (
+            min(r['ts'] for r in records) - run['launched_at'])
+    if len(records) >= 2:
+        ts = sorted(r['ts'] for r in records)
+        deltas = [b - a for a, b in zip(ts, ts[1:]) if b > a]
+        if deltas:
+            deltas.sort()
+            median = deltas[len(deltas) // 2]
+            entry['secs_per_step'] = median
+            entry['steps_per_sec'] = 1.0 / median if median else None
+            try:
+                res = resources_lib.Resources(**run['resources'])
+                entry['dollars_per_step'] = res.get_cost(median)
+            except Exception:  # pylint: disable=broad-except
+                pass
+    return entry
 
 
 def down(benchmark: str, *, purge: bool = False) -> None:
@@ -194,13 +197,25 @@ def down(benchmark: str, *, purge: bool = False) -> None:
     clusters; results stay queryable via `bench ls`/`status` until an
     explicit `bench delete`."""
     from skypilot_tpu import core
-    try:
-        for entry in status(benchmark):
+    runs = bench_state.get_runs(benchmark)
+    if not runs:
+        # A mistyped name must not "succeed" silently while the real
+        # benchmark's clusters keep billing.
+        raise exceptions.BenchmarkError(
+            f'unknown benchmark {benchmark!r}; have '
+            f'{bench_state.get_benchmarks()}')
+    # Snapshot per candidate: one unreachable candidate's log fetch
+    # must not lose the step-log-derived results of every OTHER
+    # candidate to teardown.
+    for run in runs:
+        try:
+            entry = _status_entry(run)
             bench_state.set_run_results(benchmark, entry['cluster'],
                                         entry)
-    except Exception as e:  # pylint: disable=broad-except
-        logger.warning(f'could not snapshot {benchmark!r} results '
-                       f'before teardown: {e}')
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(
+                f'could not snapshot {benchmark!r} results for '
+                f'{run.get("cluster")!r} before teardown: {e}')
     for run in bench_state.get_runs(benchmark):
         try:
             core.down(run['cluster'])

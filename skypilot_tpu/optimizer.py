@@ -288,20 +288,22 @@ class Optimizer:
         expansions = 0
         capped = False
 
-        def _dfs(i: int, partial: float) -> None:
-            nonlocal best_total, best_choice, expansions, capped
-            if capped:
-                return
-            if i == n:
-                if best_total is None or partial < best_total:
-                    best_total = partial
-                    best_choice = list(choice)
-                return
-            for ci, cand in enumerate(cands[i]):
+        # Explicit-stack DFS: depth == number of tasks, so Python's
+        # recursion limit (~1000) would trip on adversarial DAGs long
+        # before the expansion backstop does.  Each frame keeps its
+        # live candidate iterator, so resuming after a descend picks
+        # up exactly where the loop left off.
+        stack: List[Tuple[int, Any, float]] = []
+        if n:
+            stack.append((0, iter(enumerate(cands[0])), 0.0))
+        while stack and not capped:
+            i, cand_iter, partial = stack[-1]
+            descended = False
+            for ci, cand in cand_iter:
                 expansions += 1
                 if expansions > Optimizer._MAX_BNB_EXPANSIONS:
                     capped = True
-                    return
+                    break
                 cost = partial + cand[objective_idx]
                 for j, producer in in_edges[i]:
                     cost += egress_cost_fn(
@@ -314,10 +316,18 @@ class Optimizer:
                     # be smaller — only skip THIS candidate.
                     continue
                 choice[i] = ci
-                _dfs(i + 1, cost)
-            choice[i] = 0
-
-        _dfs(0, 0.0)
+                if i + 1 == n:
+                    if best_total is None or cost < best_total:
+                        best_total = cost
+                        best_choice = list(choice)
+                    continue
+                stack.append((i + 1,
+                              iter(enumerate(cands[i + 1])), cost))
+                descended = True
+                break
+            if not descended and not capped:
+                choice[i] = 0
+                stack.pop()
         if capped:
             logger.warning(
                 'optimizer: branch-and-bound expansion cap '

@@ -67,11 +67,14 @@ def _call(query: str) -> Dict[str, Any]:
     if key is None:
         raise RunPodApiError(401, 'NoCredentials',
                              'no RunPod API key found')
+    # Key goes in the Authorization header, never the URL: query
+    # strings land in proxy/server logs and error contexts.
     req = urllib.request.Request(
-        f'{API_URL}?api_key={key}',
+        API_URL,
         data=json.dumps({'query': query}).encode(),
         method='POST',
-        headers={'Content-Type': 'application/json'})
+        headers={'Content-Type': 'application/json',
+                 'Authorization': f'Bearer {key}'})
     try:
         with urllib.request.urlopen(req, timeout=_TIMEOUT) as resp:
             payload = json.loads(resp.read())

@@ -33,7 +33,7 @@ def test_e2e_failure_retries_then_falls_back_to_direct(bench,
                                                        capsys):
     calls = {'e2e': 0, 'direct': 0}
 
-    def _e2e(_steps):
+    def _e2e(_steps, **_kw):
         calls['e2e'] += 1
         raise bench.BenchError('job FAILED', log_tail='boom')
 
@@ -56,7 +56,7 @@ def test_all_rungs_failing_exits_nonzero_with_error_json(bench,
                                                          capsys):
     monkeypatch.setattr(
         bench, 'run_through_launch',
-        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+        lambda _s, **_kw: (_ for _ in ()).throw(RuntimeError('backend')))
     monkeypatch.setattr(
         bench, 'run_direct_subprocess',
         lambda _s: (_ for _ in ()).throw(RuntimeError('direct')))
@@ -74,7 +74,7 @@ def test_e2e_success_never_touches_direct(bench, monkeypatch, capsys):
     calls = {'direct': 0}
     monkeypatch.setattr(
         bench, 'run_through_launch',
-        lambda _s: print(json.dumps({'metric': 'm', 'value': 2,
+        lambda _s, **_kw: print(json.dumps({'metric': 'm', 'value': 2,
                                      'unit': 'u', 'vs_baseline': 1})))
     monkeypatch.setattr(
         bench, 'run_direct_subprocess',
@@ -99,7 +99,7 @@ def test_all_rungs_failing_emits_stale_cache_when_present(
     monkeypatch.setenv('SKYTPU_BENCH_CACHE', str(cache))
     monkeypatch.setattr(
         bench, 'run_through_launch',
-        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+        lambda _s, **_kw: (_ for _ in ()).throw(RuntimeError('backend')))
     monkeypatch.setattr(
         bench, 'run_direct_subprocess',
         lambda _s: (_ for _ in ()).throw(RuntimeError('direct')))
@@ -126,7 +126,7 @@ def test_out_of_round_cache_not_emitted(bench, monkeypatch, capsys,
     monkeypatch.setenv('SKYTPU_BENCH_CACHE', str(cache))
     monkeypatch.setattr(
         bench, 'run_through_launch',
-        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+        lambda _s, **_kw: (_ for _ in ()).throw(RuntimeError('backend')))
     monkeypatch.setattr(
         bench, 'run_direct_subprocess',
         lambda _s: (_ for _ in ()).throw(RuntimeError('direct')))
@@ -144,7 +144,7 @@ def test_empty_or_zero_cache_not_emitted(bench, monkeypatch, capsys,
     monkeypatch.setenv('SKYTPU_BENCH_CACHE', str(cache))
     monkeypatch.setattr(
         bench, 'run_through_launch',
-        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+        lambda _s, **_kw: (_ for _ in ()).throw(RuntimeError('backend')))
     monkeypatch.setattr(
         bench, 'run_direct_subprocess',
         lambda _s: (_ for _ in ()).throw(RuntimeError('direct')))
@@ -171,7 +171,7 @@ def test_tpu_emit_writes_cache_cpu_does_not(bench, monkeypatch,
     # And the freshly written cache round-trips through the emit rung.
     monkeypatch.setattr(
         bench, 'run_through_launch',
-        lambda _s: (_ for _ in ()).throw(RuntimeError('x')))
+        lambda _s, **_kw: (_ for _ in ()).throw(RuntimeError('x')))
     monkeypatch.setattr(
         bench, 'run_direct_subprocess',
         lambda _s: (_ for _ in ()).throw(RuntimeError('y')))
@@ -194,7 +194,7 @@ def test_spaced_direct_attempts(bench, monkeypatch, capsys):
     monkeypatch.setenv('SKYTPU_BENCH_DIRECT_SPACING_S', '600')
     monkeypatch.setattr(
         bench, 'run_through_launch',
-        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+        lambda _s, **_kw: (_ for _ in ()).throw(RuntimeError('backend')))
     calls = {'direct': 0}
 
     def _direct(_steps):
@@ -237,7 +237,7 @@ def test_error_line_carries_probe_forensics(bench, monkeypatch,
     monkeypatch.setenv('SKYTPU_BENCH_PROBE_LOG', str(probe_log))
     monkeypatch.setattr(
         bench, 'run_through_launch',
-        lambda _s: (_ for _ in ()).throw(RuntimeError('backend')))
+        lambda _s, **_kw: (_ for _ in ()).throw(RuntimeError('backend')))
     monkeypatch.setattr(
         bench, 'run_direct_subprocess',
         lambda _s: (_ for _ in ()).throw(RuntimeError('direct')))
@@ -307,3 +307,113 @@ def test_backend_init_hang_raises_not_blocks(monkeypatch):
     assert time_mod.time() - t0 < 5  # prompt, not a 30s block
     assert attempts['n'] == 1  # no in-process retry after a hang
     release.set()
+
+
+def test_budget_exhausted_skips_rungs_and_emits_final_line(
+        bench, monkeypatch, capsys):
+    """Round-4 regression: with no budget left, every rung is skipped
+    and the final line still lands on stdout — never a silent rc-124."""
+    calls = {'e2e': 0, 'direct': 0}
+    monkeypatch.setattr(
+        bench, 'run_through_launch',
+        lambda _s, **_kw: calls.__setitem__('e2e', calls['e2e'] + 1))
+    monkeypatch.setattr(
+        bench, 'run_direct_subprocess',
+        lambda _s: calls.__setitem__('direct', calls['direct'] + 1))
+    monkeypatch.setattr(bench, '_TOTAL_BUDGET_S', 5.0)
+    with pytest.raises(SystemExit):
+        bench.main()
+    assert calls == {'e2e': 0, 'direct': 0}
+    parsed = json.loads(capsys.readouterr().out.strip())
+    assert parsed['unit'] == 'error'
+
+
+def test_direct_spacing_bends_to_budget(bench, monkeypatch, capsys):
+    """Inter-attempt sleeps shrink when the budget can't afford the
+    full spacing — the ladder must never sleep through its window."""
+    sleeps = []
+    monkeypatch.setattr(bench.time, 'sleep', sleeps.append)
+    monkeypatch.setenv('SKYTPU_BENCH_DIRECT_ATTEMPTS', '3')
+    monkeypatch.setenv('SKYTPU_BENCH_DIRECT_SPACING_S', '600')
+    # ~400s of budget: enough for attempts, NOT for two 600s sleeps.
+    monkeypatch.setattr(bench, '_TOTAL_BUDGET_S', 400.0)
+    monkeypatch.setattr(
+        bench, 'run_through_launch',
+        lambda _s, **_kw: (_ for _ in ()).throw(RuntimeError('x')))
+    calls = {'direct': 0}
+
+    def _direct(_steps):
+        calls['direct'] += 1
+        raise bench.BenchError('hang')
+
+    monkeypatch.setattr(bench, 'run_direct_subprocess', _direct)
+    with pytest.raises(SystemExit):
+        bench.main()
+    assert calls['direct'] >= 1
+    assert all(s < 600 for s in sleeps)  # every sleep bent to budget
+
+
+def test_sigterm_handler_emits_final_line(bench, monkeypatch, capsys):
+    """An external driver timeout (SIGTERM) mid-ladder must still put
+    the structured line on stdout before the process dies."""
+    import signal as signal_mod
+    exits = []
+    monkeypatch.setattr(bench.os, '_exit', exits.append)
+    bench._on_deadline_signal(signal_mod.SIGTERM, None)
+    parsed = json.loads(capsys.readouterr().out.strip())
+    assert parsed['unit'] == 'error'
+    assert 'SIGTERM' in parsed['error']
+    assert exits == [1]
+    # Idempotent: a second signal (or the normal ladder end) must not
+    # print a second line.
+    bench._on_deadline_signal(signal_mod.SIGTERM, None)
+    assert capsys.readouterr().out.strip() == ''
+
+
+def test_sigterm_handler_prefers_cached_number(bench, monkeypatch,
+                                               capsys, tmp_path):
+    import signal as signal_mod
+    import time as time_mod
+    cache = tmp_path / 'bench_cache.json'
+    cache.write_text(json.dumps({
+        'metric': 'm', 'value': 2000.0, 'unit': 'tokens/s/chip',
+        'vs_baseline': 19.0, 'raw_mfu_pct': 70.1,
+        'captured_at': '2026-08-01T00:00:00Z',
+        'captured_unix': time_mod.time() - 600,
+    }))
+    monkeypatch.setenv('SKYTPU_BENCH_CACHE', str(cache))
+    exits = []
+    monkeypatch.setattr(bench.os, '_exit', exits.append)
+    bench._on_deadline_signal(signal_mod.SIGTERM, None)
+    parsed = json.loads(capsys.readouterr().out.strip())
+    assert parsed['value'] == 2000.0
+    assert parsed['stale'] is True
+    assert parsed['raw_mfu_pct'] == 70.1  # raw fields survive caching
+    assert exits == [0]  # a cached number is a success exit
+
+
+def test_emit_metrics_line_is_self_auditing(bench, capsys):
+    """Round-4 verdict item 2: a skeptic must be able to recompute the
+    headline from the one JSON line."""
+    bench._emit(50000.0, 5.5e8, 1, 'TPU v5e', 8192,
+                attn_flops_per_token=bench._attn_flops_per_token(
+                    bench._BENCH_OVERRIDES, 8192))
+    line = capsys.readouterr().out.strip().splitlines()[0]
+    parsed = json.loads(line)
+    assert parsed['raw_tokens_per_sec'] == 50000.0
+    assert parsed['raw_model_params'] == 550000000
+    assert parsed['chip_bf16_tflops'] > 0
+    assert parsed['baseline_scaled_to_this_chip'] > 0
+    # Recompute the headline from the raw fields alone.
+    equiv = (6 * parsed['raw_model_params'] *
+             parsed['raw_tokens_per_sec']) / (6 * 8.03e9)
+    per_chip = equiv / parsed['n_chips']
+    assert abs(per_chip - parsed['value']) / parsed['value'] < 0.01
+    assert abs(per_chip / parsed['baseline_scaled_to_this_chip'] -
+               parsed['vs_baseline']) < 0.01
+    # MFU recomputes from raw throughput + chip TFLOPs.
+    flops = (6 * parsed['raw_model_params'] +
+             bench._attn_flops_per_token(bench._BENCH_OVERRIDES, 8192)
+             ) * parsed['raw_tokens_per_sec']
+    mfu = flops / (parsed['chip_bf16_tflops'] * 1e12) * 100
+    assert abs(mfu - parsed['raw_mfu_pct']) < 0.05
