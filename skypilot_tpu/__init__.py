@@ -24,6 +24,7 @@ _EXPORTS = {
     'cost_report': ('skypilot_tpu.core', 'cost_report'),
     'down': ('skypilot_tpu.core', 'down'),
     'download_logs': ('skypilot_tpu.core', 'download_logs'),
+    'endpoints': ('skypilot_tpu.core', 'endpoints'),
     'job_status': ('skypilot_tpu.core', 'job_status'),
     'queue': ('skypilot_tpu.core', 'queue'),
     'start': ('skypilot_tpu.core', 'start'),

@@ -169,9 +169,27 @@ def exec_cmd(cluster, entrypoint, detach_run, **overrides) -> None:
 @click.argument('clusters', nargs=-1, required=False)
 @click.option('--refresh', '-r', is_flag=True, default=False,
               help='Reconcile with cloud state.')
-def status(clusters, refresh) -> None:
+@click.option('--endpoints', 'show_endpoints', is_flag=True,
+              default=False,
+              help='Show reachable URLs for opened ports.')
+@click.option('--endpoint', 'endpoint_port', type=int, default=None,
+              help='Show the URL for ONE opened port.')
+def status(clusters, refresh, show_endpoints, endpoint_port) -> None:
     """Show clusters."""
     sky = _sky()
+    if show_endpoints or endpoint_port is not None:
+        # Reference `sky status --endpoints CLUSTER` (core.endpoints).
+        if len(clusters) != 1:
+            raise click.UsageError(
+                '--endpoints requires exactly one cluster name.')
+        eps = sky.endpoints(clusters[0], port=endpoint_port)
+        if not eps:
+            click.echo('No endpoint assigned yet (LoadBalancer '
+                       'pending?); retry shortly.')
+            return
+        for port, urls in sorted(eps.items(), key=lambda kv: int(kv[0])):
+            click.echo(f'{port}: {", ".join(urls)}')
+        return
     records = sky.status(list(clusters) or None, refresh=refresh)
     if not records:
         click.echo('No existing clusters.')

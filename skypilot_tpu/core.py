@@ -177,6 +177,39 @@ def autostop(cluster_name: str, idle_minutes: int,
     _backend(record['handle']).set_autostop(record['handle'], idle_minutes, down)
 
 
+@usage.entrypoint('sky.endpoints')
+def endpoints(cluster_name: str,
+              port: Optional[int] = None) -> Dict[str, List[str]]:
+    """Externally reachable URL(s) for a cluster's opened ports
+    (reference core.endpoints, sky/core.py:189).
+
+    Most clouds expose ports on the head's public IP; kubernetes
+    resolves through its LB/NodePort service.  Returns {} when the
+    endpoint is not (yet) assigned — e.g. a LoadBalancer still
+    pending."""
+    from skypilot_tpu.provision import api as provision_api
+    record = _get_record_or_raise(cluster_name)
+    handle = record['handle']
+    ports = list(getattr(handle.launched_resources, 'ports', None)
+                 or [])
+    if port is not None:
+        ports = [str(port)]
+    if not ports:
+        raise exceptions.NotSupportedError(
+            f'Cluster {cluster_name!r} has no opened ports; launch '
+            f'with `--ports` to expose one.')
+    head = handle.head_address
+    if head.startswith('local:'):
+        head_ip = '127.0.0.1'
+    elif ':' in head:  # k8s:/docker: scheme address — no direct IP
+        head_ip = None
+    else:
+        head_ip = head
+    return provision_api.query_ports(
+        handle.provider_name, handle.cluster_name_on_cloud, ports,
+        head_ip=head_ip, provider_config=handle.provider_config)
+
+
 # ---------------------------------------------------------------------------
 # jobs
 # ---------------------------------------------------------------------------

@@ -46,6 +46,23 @@ def _route(fn_name: str) -> Callable:
     return impl
 
 
+def query_ports(provider_name: str, cluster_name_on_cloud: str,
+                ports, head_ip=None, provider_config=None):
+    """Endpoint URLs for opened ports (reference
+    sky/provision/__init__.py query_ports): clouds that expose ports
+    on the head's public IP fall back to the passthrough; clouds with
+    an indirection layer (kubernetes LB/NodePort services) implement
+    their own."""
+    module = _get_cloud_module(provider_name)
+    fn = getattr(module, 'query_ports', None)
+    if fn is not None:
+        return fn(cluster_name_on_cloud, ports, provider_config)
+    if head_ip is None:
+        return {}
+    from skypilot_tpu.provision import common
+    return common.query_ports_passthrough(ports, head_ip)
+
+
 run_instances = _route('run_instances')
 stop_instances = _route('stop_instances')
 terminate_instances = _route('terminate_instances')

@@ -75,6 +75,10 @@ class ClusterInfo:
     docker_user: Optional[str] = None
     ssh_user: Optional[str] = None
     custom_ray_options: Optional[Dict[str, Any]] = None
+    # port -> externally reachable 'host:port' URLs, for clouds where
+    # opened ports live behind an indirection (kubernetes LB/NodePort
+    # services) rather than on the head's own IP.
+    port_endpoints: Optional[Dict[str, List[str]]] = None
 
     def get_instances(self) -> List[InstanceInfo]:
         out = []
@@ -123,6 +127,20 @@ class ClusterInfo:
         return sum(i.num_hosts for i in self.get_instances())
 
 
+def expand_ports(ports: List[str]) -> List[int]:
+    """'8080' / '8000-8002' specs -> sorted unique int list."""
+    out = set()
+    for spec in ports:
+        s = str(spec)
+        if '-' in s:
+            lo, hi = s.split('-', 1)
+            out.update(range(int(lo), int(hi) + 1))
+        else:
+            out.add(int(s))
+    return sorted(out)
+
+
 def query_ports_passthrough(ports: List[str],
                             head_ip: str) -> Dict[str, List[str]]:
-    return {port: [f'{head_ip}:{port}'] for port in ports}
+    return {str(port): [f'{head_ip}:{port}']
+            for port in expand_ports(ports)}

@@ -269,8 +269,13 @@ def terminate_instances(cluster_name_on_cloud: str,
               '--ignore-not-found', '--wait=false'],
              context=context, namespace=namespace, timeout=120)
     if not worker_only:
+        from skypilot_tpu.provision.kubernetes import network
         _kubectl(['delete', 'service', cluster_name_on_cloud,
                   '--ignore-not-found'],
+                 context=context, namespace=namespace)
+        _kubectl(['delete', 'service',
+                  network._service_name(cluster_name_on_cloud),
+                  '--ignore-not-found', '--wait=false'],
                  context=context, namespace=namespace)
 
 
@@ -308,6 +313,15 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
             host_ips=ips,
             host_external_ips=addresses,
         )]
+    from skypilot_tpu.provision.kubernetes import network
+    # Externally reachable endpoints for opened ports (LB / NodePort
+    # service), so callers never have to guess pod IPs.  Gated on the
+    # persisted ports declaration: a portless cluster must not pay an
+    # extra kubectl round trip on every refresh.
+    port_endpoints = None
+    if pc.get('ports'):
+        port_endpoints = network.query_ports(
+            cluster_name_on_cloud, pc['ports'], pc) or None
     return common.ClusterInfo(
         instances=instances,
         head_instance_id=_node_instance_id(cluster_name_on_cloud, 0)
@@ -315,16 +329,25 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
         provider_name=_PROVIDER,
         provider_config=pc,
         ssh_user=None,
+        port_endpoints=port_endpoints,
     )
 
 
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    # Ports surface via a LoadBalancer service (follow-up); pods are
-    # reachable in-cluster through the headless service already.
-    del cluster_name_on_cloud, ports, provider_config
+    from skypilot_tpu.provision.kubernetes import network
+    network.open_ports(cluster_name_on_cloud, ports, provider_config)
 
 
 def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name_on_cloud, ports, provider_config
+    from skypilot_tpu.provision.kubernetes import network
+    network.cleanup_ports(cluster_name_on_cloud, ports, provider_config)
+
+
+def query_ports(cluster_name_on_cloud: str, ports: List[str],
+                provider_config: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, List[str]]:
+    from skypilot_tpu.provision.kubernetes import network
+    return network.query_ports(cluster_name_on_cloud, ports,
+                               provider_config)

@@ -35,6 +35,53 @@ logger = sky_logging.init_logger(__name__)
 
 ReplicaStatus = serve_state.ReplicaStatus
 
+# How long a k8s replica waits for its LoadBalancer/NodePort service
+# to get an external address before giving up the launch.
+_K8S_ENDPOINT_TIMEOUT_S = 120.0
+
+
+def _port_covered(port_specs: Optional[List[str]], port: int) -> bool:
+    """True if `port` falls inside any '80' / '8000-8010' spec."""
+    for spec in port_specs or []:
+        s = str(spec)
+        if '-' in s:
+            lo, hi = s.split('-', 1)
+            if int(lo) <= port <= int(hi):
+                return True
+        elif int(s) == port:
+            return True
+    return False
+
+
+def _resolve_replica_endpoint(handle, port: int) -> str:
+    """Reachable http endpoint for a freshly launched replica.
+
+    Local-cloud "addresses" are local:<agent-root> paths (loopback);
+    k8s addresses are k8s:<ctx>/<ns>/<pod> schemes that resolve
+    through the cluster's ports service (LB ingress IP / NodePort) —
+    polled briefly, because LB controllers assign addresses
+    asynchronously."""
+    addr = handle.head_address
+    if addr.startswith('local:'):
+        return f'http://127.0.0.1:{port}'
+    if addr.startswith('k8s:'):
+        from skypilot_tpu.provision import api as provision_api
+        deadline = time.time() + _K8S_ENDPOINT_TIMEOUT_S
+        while True:
+            eps = provision_api.query_ports(
+                handle.provider_name, handle.cluster_name_on_cloud,
+                [str(port)], provider_config=handle.provider_config)
+            urls = eps.get(str(port))
+            if urls:
+                return f'http://{urls[0]}'
+            if time.time() >= deadline:
+                raise exceptions.ProvisionError(
+                    f'k8s replica ports service has no external '
+                    f'address for port {port} after '
+                    f'{_K8S_ENDPOINT_TIMEOUT_S:.0f}s.')
+            time.sleep(5)
+    return f'http://{addr}:{port}'
+
 
 def probe_endpoint(url: str, timeout: float,
                    post_data: Optional[Any] = None,
@@ -111,11 +158,19 @@ class ReplicaManager:
             constants.SERVICE_NAME_ENV: self.service_name,
         }
         task.update_envs(envs)
-        if use_spot:
-            task.set_resources([
-                r.copy(use_spot=True)
-                for r in task.get_preferred_resources()
-            ])
+        new_resources = []
+        for r in task.get_preferred_resources():
+            override: Dict[str, Any] = {}
+            if use_spot:
+                override['use_spot'] = True
+            # The replica's serving port must be OPENED, not just
+            # listened on: clouds with managed firewalls (and the k8s
+            # LB/NodePort service) only expose ports declared on the
+            # resources.
+            if not _port_covered(r.ports, port):
+                override['ports'] = list(r.ports or []) + [str(port)]
+            new_resources.append(r.copy(**override) if override else r)
+        task.set_resources(new_resources)
         return task
 
     def _replica_port(self, replica_id: int, cloud: Optional[str]) -> int:
@@ -157,10 +212,7 @@ class ReplicaManager:
             _, handle = execution.launch(
                 task, cluster_name=cluster_name, detach_run=True,
                 stream_logs=False, quiet_optimizer=True)
-            addr = handle.head_address
-            # Local-cloud "addresses" are local:<agent-root> paths.
-            host = '127.0.0.1' if addr.startswith('local:') else addr
-            endpoint = f'http://{host}:{port}'
+            endpoint = _resolve_replica_endpoint(handle, port)
             serve_state.set_replica_endpoint(self.service_name, replica_id,
                                              endpoint)
             serve_state.set_replica_status(self.service_name, replica_id,

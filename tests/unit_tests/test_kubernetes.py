@@ -16,11 +16,38 @@ from skypilot_tpu.utils import accelerator_registry
 
 
 class _FakeKubectl:
-    """Records kubectl calls; returns canned pods for get."""
+    """Records kubectl calls; returns canned pods/services for get.
+
+    Service applies simulate the cluster's LB controller: a
+    LoadBalancer service gets an ingress IP (like k3s servicelb /
+    GKE), a NodePort service gets allocated nodePorts.
+    """
+
+    LB_INGRESS_IP = '203.0.113.10'
+    NODE_INTERNAL_IP = '192.168.1.5'
 
     def __init__(self):
         self.calls = []
         self.pods = []
+        self.services = {}
+        self.lb_pending = False  # simulate a not-yet-assigned LB
+
+    def _apply_obj(self, obj):
+        if obj['kind'] == 'Pod':
+            obj = json.loads(json.dumps(obj))
+            obj.setdefault('status', {})['phase'] = 'Running'
+            obj['status']['podIP'] = f'10.8.0.{len(self.pods) + 1}'
+            self.pods.append(obj)
+        elif obj['kind'] == 'Service':
+            obj = json.loads(json.dumps(obj))
+            spec = obj.get('spec', {})
+            if spec.get('type') == 'LoadBalancer' and not self.lb_pending:
+                obj['status'] = {'loadBalancer': {
+                    'ingress': [{'ip': self.LB_INGRESS_IP}]}}
+            elif spec.get('type') == 'NodePort':
+                for i, p in enumerate(spec.get('ports', [])):
+                    p['nodePort'] = 30000 + i
+            self.services[obj['metadata']['name']] = obj
 
     def __call__(self, cmd, input=None, capture_output=True, text=True,
                  timeout=None, check=False):  # noqa: A002
@@ -28,15 +55,20 @@ class _FakeKubectl:
         out = ''
         if 'apply' in cmd:
             applied = json.loads(input)
-            for obj in applied['items']:
-                if obj['kind'] == 'Pod':
-                    obj = json.loads(json.dumps(obj))
-                    obj.setdefault('status', {})['phase'] = 'Running'
-                    obj['status']['podIP'] = \
-                        f'10.8.0.{len(self.pods) + 1}'
-                    self.pods.append(obj)
+            for obj in applied.get('items', [applied]):
+                self._apply_obj(obj)
+        elif 'get' in cmd and 'service' in cmd:
+            name = cmd[cmd.index('service') + 1]
+            svc = self.services.get(name)
+            out = json.dumps(svc) if svc else ''
+        elif 'get' in cmd and 'nodes' in cmd:
+            out = json.dumps({'items': [{'status': {'addresses': [
+                {'type': 'InternalIP',
+                 'address': self.NODE_INTERNAL_IP}]}}]})
         elif 'get' in cmd:
             out = json.dumps({'items': self.pods})
+        elif 'delete' in cmd and 'service' in cmd:
+            self.services.pop(cmd[cmd.index('service') + 1], None)
         elif 'delete' in cmd:
             self.pods = []
         return subprocess.CompletedProcess(cmd, 0, stdout=out, stderr='')
@@ -299,3 +331,89 @@ class TestGkeGpus:
             chosen, 'gp3', cloud_lib.Region('ctx'), None, 1)
         assert variables['cpus'] == 32
         assert variables['memory_gb'] == 128
+
+
+class TestPorts:
+    """open_ports is REAL now (round-4 verdict: the no-op silently
+    swallowed --ports).  Reference parity:
+    sky/provision/kubernetes/network.py:18 (loadbalancer mode) +
+    network_utils.py (endpoint lookup)."""
+
+    PC = {'context': 'gke_ctx', 'namespace': 'default'}
+
+    def test_loadbalancer_open_query_cleanup(self, fake_kubectl):
+        from skypilot_tpu.provision.kubernetes import network
+        k8s_instance.open_ports('c1', ['8080', '9000-9001'], self.PC)
+        svc = fake_kubectl.services['c1--skytpu-lb']
+        assert svc['spec']['type'] == 'LoadBalancer'
+        assert [p['port'] for p in svc['spec']['ports']] == \
+            [8080, 9000, 9001]
+        # Routes to the head node's pods (rank 0 runs the server).
+        assert svc['spec']['selector'][
+            k8s_instance._LABEL_NODE] == '0'
+        eps = k8s_instance.query_ports('c1', ['8080'], self.PC)
+        assert eps == {'8080': [f'{fake_kubectl.LB_INGRESS_IP}:8080']}
+        # Empty ports list = every opened port.
+        eps = k8s_instance.query_ports('c1', [], self.PC)
+        assert set(eps) == {'8080', '9000', '9001'}
+        k8s_instance.cleanup_ports('c1', ['8080'], self.PC)
+        assert 'c1--skytpu-lb' not in fake_kubectl.services
+        assert network.query_ports('c1', ['8080'], self.PC) == {}
+
+    def test_lb_pending_returns_empty_not_wrong(self, fake_kubectl):
+        fake_kubectl.lb_pending = True
+        k8s_instance.open_ports('c1', ['8080'], self.PC)
+        assert k8s_instance.query_ports('c1', ['8080'], self.PC) == {}
+
+    def test_nodeport_mode(self, fake_kubectl):
+        pc = dict(self.PC, port_mode='nodeport')
+        k8s_instance.open_ports('c1', ['8080'], pc)
+        svc = fake_kubectl.services['c1--skytpu-lb']
+        assert svc['spec']['type'] == 'NodePort'
+        eps = k8s_instance.query_ports('c1', ['8080'], pc)
+        assert eps == {'8080':
+                       [f'{fake_kubectl.NODE_INTERNAL_IP}:30000']}
+
+    def test_podip_mode_is_explicit_noop(self, fake_kubectl):
+        pc = dict(self.PC, port_mode='podip')
+        k8s_instance.open_ports('c1', ['8080'], pc)
+        assert not fake_kubectl.services
+
+    def test_unknown_mode_raises(self, fake_kubectl):
+        from skypilot_tpu import exceptions
+        with pytest.raises(exceptions.NotSupportedError):
+            k8s_instance.open_ports(
+                'c1', ['8080'], dict(self.PC, port_mode='ingress'))
+
+    def test_cluster_info_carries_port_endpoints(self, fake_kubectl):
+        cfg = _tpu_config('tpu-v5e-16')
+        config = common.ProvisionConfig(
+            provider_config=self.PC, authentication_config={},
+            docker_config={}, node_config=cfg,
+            count=1, tags={}, resume_stopped_nodes=False)
+        k8s_instance.run_instances('gke_ctx', 'c1', config)
+        k8s_instance.open_ports('c1', ['8080'], self.PC)
+        pc = dict(self.PC, ports=['8080'])
+        info = k8s_instance.get_cluster_info('gke_ctx', 'c1', pc)
+        assert info.port_endpoints == {
+            '8080': [f'{fake_kubectl.LB_INGRESS_IP}:8080']}
+        # Portless clusters skip the service lookup entirely.
+        n_calls = len(fake_kubectl.calls)
+        info = k8s_instance.get_cluster_info('gke_ctx', 'c1', self.PC)
+        assert info.port_endpoints is None
+        assert len(fake_kubectl.calls) == n_calls + 1  # pods get only
+
+    def test_terminate_cleans_ports_service(self, fake_kubectl):
+        k8s_instance.open_ports('c1', ['8080'], self.PC)
+        k8s_instance.terminate_instances('c1', self.PC)
+        assert 'c1--skytpu-lb' not in fake_kubectl.services
+
+    def test_api_query_ports_fallback_passthrough(self):
+        from skypilot_tpu.provision import api
+        eps = api.query_ports('local', 'c1', ['80'], head_ip='1.2.3.4')
+        assert eps == {'80': ['1.2.3.4:80']}
+
+    def test_expand_ports(self):
+        from skypilot_tpu.provision.kubernetes import network
+        assert network.expand_ports(['8080', '9000-9002', '8080']) == \
+            [8080, 9000, 9001, 9002]
