@@ -44,7 +44,7 @@ def _start_replica(model: str, slots: int, continuous: bool,
                    prefill_chunk: int = 0,
                    quantize: Optional[str] = None):
     from skypilot_tpu.infer import server as server_lib
-    srv = server_lib.InferenceServer(
+    srv = server_lib.InferenceServer(allow_random_weights=True, 
         model=model, port=0, host='127.0.0.1', max_batch_size=slots,
         max_seq_len=max_seq_len, model_overrides=overrides,
         continuous=continuous, prefill_chunk=prefill_chunk,

@@ -329,6 +329,7 @@ class ContinuousBatchingEngine:
         self.model = self._eng.model
         self.config = self._eng.config
         self.quantize = self._eng.quantize
+        self.loaded_real_weights = self._eng.loaded_real_weights
         self.mesh = mesh
         self.n_slots = n_slots
         self.max_seq_len = self._eng.max_seq_len
@@ -433,15 +434,25 @@ class ContinuousBatchingEngine:
         self._submit_lock = threading.Lock()
         self._next_rid = 0
         self._seed0 = seed
+        # rid -> per-token queue for stream() readers (SSE serving).
+        # Tokens are pushed as they decode; completion/cancel/abort
+        # push a sentinel so readers never block forever.
+        self._stream_queues: Dict[int, Any] = {}
 
     @property
     def params(self):
         return self._eng.params
 
     # -- request intake ----------------------------------------------------
+    _STREAM_END = None  # queue sentinel: request finished/canceled
+
     def submit(self, prompt_ids: Sequence[int],
-               sampling: Optional[SamplingConfig] = None) -> int:
-        """Enqueue one prompt; returns a request id for wait()."""
+               sampling: Optional[SamplingConfig] = None,
+               stream: bool = False) -> int:
+        """Enqueue one prompt; returns a request id for wait() (or,
+        with stream=True, for stream() — tokens are then ALSO pushed
+        to a per-request queue as each decode step lands)."""
+        import queue as queue_mod
         import threading
         cfg = sampling or SamplingConfig()
         if len(prompt_ids) == 0:
@@ -470,6 +481,8 @@ class ContinuousBatchingEngine:
             rid = self._next_rid
             self._next_rid += 1
             self._events[rid] = threading.Event()
+            if stream:
+                self._stream_queues[rid] = queue_mod.Queue()
             self._queue.append((rid, list(prompt_ids), cfg))
         return rid
 
@@ -482,6 +495,9 @@ class ContinuousBatchingEngine:
                 item for item in self._queue if item[0] != request_id)
             self._results.pop(request_id, None)
             self._events.pop(request_id, None)
+            q = self._stream_queues.pop(request_id, None)
+            if q is not None:
+                q.put(self._STREAM_END)  # unblock a live reader
             if request_id == self._admitting_rid or any(
                     p.rid == request_id for p in self._prefills) or any(
                     s is not None and s.request_id == request_id
@@ -515,8 +531,46 @@ class ContinuousBatchingEngine:
         with self._submit_lock:
             self._fatal = error
             events = list(self._events.values())
+            queues = list(self._stream_queues.values())
         for e in events:
             e.set()
+        for q in queues:
+            q.put(self._STREAM_END)  # stream() re-checks _fatal
+
+    def stream(self, request_id: int, timeout: Optional[float] = None):
+        """Yield `request_id`'s tokens as they decode (submit() must
+        have been called with stream=True).  `timeout` bounds the gap
+        BETWEEN tokens, not the whole generation; on a stall the
+        request is canceled and TimeoutError raised.  Raises
+        RuntimeError if the decode loop died mid-stream."""
+        import queue as queue_mod
+        with self._submit_lock:
+            q = self._stream_queues.get(request_id)
+        if q is None:
+            raise KeyError(
+                f'request {request_id} was not submitted with '
+                f'stream=True (or is already finished).')
+        while True:
+            try:
+                tok = q.get(timeout=timeout)
+            except queue_mod.Empty:
+                self.cancel(request_id)
+                raise TimeoutError(
+                    f'request {request_id}: no token within '
+                    f'{timeout}s') from None
+            if tok is self._STREAM_END:
+                with self._submit_lock:
+                    fatal = self._fatal
+                    self._stream_queues.pop(request_id, None)
+                    # wait()-side bookkeeping: a pure-stream consumer
+                    # must not leak the event/result entries.
+                    self._events.pop(request_id, None)
+                    self._results.pop(request_id, None)
+                if fatal is not None:
+                    raise RuntimeError(
+                        f'decode loop died: {fatal!r}') from fatal
+                return
+            yield tok
 
     # -- the decode loop ---------------------------------------------------
     def _fresh_cache1(self):
@@ -607,6 +661,9 @@ class ContinuousBatchingEngine:
             else:
                 self._results[slot.request_id] = slot.outputs
                 event = self._events.get(slot.request_id)
+            q = self._stream_queues.get(slot.request_id)
+        if q is not None:
+            q.put(self._STREAM_END)
         if event is not None:
             event.set()
         self._slots[slot_idx] = None
@@ -721,9 +778,14 @@ class ContinuousBatchingEngine:
         toks = np.asarray(jax.device_get(tok_dev))
         for i in occupied:
             s = self._slots[i]
-            s.outputs.append(int(toks[i]))
+            tok = int(toks[i])
+            s.outputs.append(tok)
             s.generated += 1
-            if (s.eos_id is not None and int(toks[i]) == s.eos_id) or \
+            with self._submit_lock:
+                q = self._stream_queues.get(s.request_id)
+            if q is not None:
+                q.put(tok)
+            if (s.eos_id is not None and tok == s.eos_id) or \
                     s.generated >= s.max_new:
                 self._complete(i)
         return True
@@ -806,6 +868,7 @@ class InferenceEngine:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
             sharding_lib.unbox(abstract['cache']))
         already_quantized = False
+        self.loaded_real_weights = True
         if params is not None:
             if self.quantize and isinstance(params, dict) \
                     and 'layers' in params:
@@ -841,6 +904,9 @@ class InferenceEngine:
                                                 abstract['params'],
                                                 param_shardings)
         else:
+            # Callers gate on this (the server refuses to expose an
+            # OpenAI endpoint over noise without an explicit opt-in).
+            self.loaded_real_weights = False
             logger.warning('InferenceEngine: no params/checkpoint given '
                            '— serving randomly initialized weights '
                            '(tests/dev only).')

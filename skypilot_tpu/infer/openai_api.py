@@ -1,0 +1,235 @@
+"""OpenAI-compatible protocol: request parsing + response framing.
+
+The reference's LLM recipes all serve the OpenAI API through vLLM
+(`llm/qwen/qwen25-7b.yaml:30-33`); this framework owns its engine, so
+it owns the protocol layer too.  Pure functions here — the HTTP/SSE
+transport lives in server.py, which keeps every framing rule unit-
+testable without sockets.
+
+Supported: /v1/completions and /v1/chat/completions (stream and
+non-stream), stop sequences, max_tokens/temperature/top_p/top_k/seed,
+usage accounting.  Unsupported fields (n>1, logprobs, tools) raise
+OpenAIError with an OpenAI-style error body.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class OpenAIError(ValueError):
+    """Maps to an OpenAI-style error JSON with an HTTP status."""
+
+    def __init__(self, message: str, status: int = 400,
+                 err_type: str = 'invalid_request_error'):
+        super().__init__(message)
+        self.status = status
+        self.err_type = err_type
+
+    def body(self) -> Dict[str, Any]:
+        return {'error': {'message': str(self), 'type': self.err_type,
+                          'param': None, 'code': None}}
+
+
+@dataclasses.dataclass
+class ParsedRequest:
+    """One generation request, normalized from either endpoint."""
+    prompt_text: str
+    max_tokens: int
+    temperature: float
+    top_p: float
+    top_k: int
+    seed: Optional[int]
+    stream: bool
+    stop: List[str]
+    model: str
+    chat: bool
+    request_id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex[:24])
+    created: int = dataclasses.field(
+        default_factory=lambda: int(time.time()))
+
+    @property
+    def oai_id(self) -> str:
+        prefix = 'chatcmpl' if self.chat else 'cmpl'
+        return f'{prefix}-{self.request_id}'
+
+
+def _parse_stop(raw: Any) -> List[str]:
+    if raw is None:
+        return []
+    if isinstance(raw, str):
+        return [raw]
+    if isinstance(raw, list) and all(isinstance(s, str) for s in raw):
+        if len(raw) > 4:
+            raise OpenAIError('stop: at most 4 sequences')
+        return raw
+    raise OpenAIError('stop must be a string or list of strings')
+
+
+def _get(payload: Dict[str, Any], key: str, default: Any) -> Any:
+    """Explicit JSON null means use-the-default (OpenAI semantics;
+    several client libraries serialize unset fields as nulls)."""
+    value = payload.get(key)
+    return default if value is None else value
+
+
+def _common_fields(payload: Dict[str, Any], default_model: str):
+    try:
+        if int(_get(payload, 'n', 1)) != 1:
+            raise OpenAIError('n > 1 is not supported')
+        if payload.get('logprobs'):
+            raise OpenAIError('logprobs is not supported')
+        max_tokens = int(_get(payload, 'max_tokens',
+                              _get(payload, 'max_completion_tokens',
+                                   16)))
+        if max_tokens < 1:
+            raise OpenAIError('max_tokens must be >= 1')
+        seed = payload.get('seed')
+        return dict(
+            max_tokens=max_tokens,
+            temperature=float(_get(payload, 'temperature', 1.0)),
+            top_p=float(_get(payload, 'top_p', 1.0)),
+            # top_k: extension (vLLM has it)
+            top_k=int(_get(payload, 'top_k', 0)),
+            seed=int(seed) if seed is not None else None,
+            stream=bool(payload.get('stream', False)),
+            stop=_parse_stop(payload.get('stop')),
+            model=str(payload.get('model') or default_model),
+        )
+    except (TypeError, ValueError) as e:
+        if isinstance(e, OpenAIError):
+            raise
+        raise OpenAIError(f'malformed request field: {e}') from e
+
+
+def parse_completion_request(payload: Dict[str, Any],
+                             default_model: str) -> ParsedRequest:
+    prompt = payload.get('prompt')
+    if isinstance(prompt, list):
+        if len(prompt) != 1 or not isinstance(prompt[0], str):
+            raise OpenAIError(
+                'prompt must be a string (or a 1-element list)')
+        prompt = prompt[0]
+    if not isinstance(prompt, str) or not prompt:
+        raise OpenAIError('prompt must be a non-empty string')
+    return ParsedRequest(prompt_text=prompt, chat=False,
+                         **_common_fields(payload, default_model))
+
+
+def render_chat_prompt(messages: List[Dict[str, Any]]) -> str:
+    """Minimal generic chat template (model-family templates belong
+    to real checkpoints' HF tokenizers; this is the fallback)."""
+    lines = []
+    for m in messages:
+        role, content = m.get('role'), m.get('content')
+        if role not in ('system', 'user', 'assistant') or \
+                not isinstance(content, str):
+            raise OpenAIError(
+                'each message needs a role in '
+                "('system','user','assistant') and string content")
+        lines.append(f'{role}: {content}')
+    lines.append('assistant:')
+    return '\n'.join(lines)
+
+
+def parse_chat_request(payload: Dict[str, Any],
+                       default_model: str) -> ParsedRequest:
+    messages = payload.get('messages')
+    if not isinstance(messages, list) or not messages:
+        raise OpenAIError('messages must be a non-empty list')
+    return ParsedRequest(prompt_text=render_chat_prompt(messages),
+                         chat=True,
+                         **_common_fields(payload, default_model))
+
+
+class StopScanner:
+    """Cuts the output at the earliest stop sequence across chunk
+    boundaries: emitted text never contains any part of a stop, and a
+    stop split across two decode steps is still caught."""
+
+    def __init__(self, stops: List[str]):
+        self._stops = [s for s in stops if s]
+        self._held = ''  # tail that could be a stop prefix
+        self.hit = False
+
+    def _longest_holdback(self, text: str) -> int:
+        n = 0
+        for stop in self._stops:
+            for k in range(min(len(stop) - 1, len(text)), 0, -1):
+                if text.endswith(stop[:k]):
+                    n = max(n, k)
+                    break
+        return n
+
+    def feed(self, chunk: str) -> str:
+        """Safe-to-emit text from this chunk ('' after a stop hit)."""
+        if self.hit or not self._stops:
+            return '' if self.hit else chunk
+        text = self._held + chunk
+        cut = None
+        for stop in self._stops:
+            idx = text.find(stop)
+            if idx != -1 and (cut is None or idx < cut):
+                cut = idx
+        if cut is not None:
+            self.hit = True
+            self._held = ''
+            return text[:cut]
+        hold = self._longest_holdback(text)
+        self._held = text[len(text) - hold:] if hold else ''
+        return text[:len(text) - hold] if hold else text
+
+    def flush(self) -> str:
+        """Pending holdback at end-of-generation (no stop ever hit)."""
+        out, self._held = self._held, ''
+        return '' if self.hit else out
+
+
+def completion_response(req: ParsedRequest, text: str,
+                        finish_reason: str, prompt_tokens: int,
+                        completion_tokens: int) -> Dict[str, Any]:
+    usage = {'prompt_tokens': prompt_tokens,
+             'completion_tokens': completion_tokens,
+             'total_tokens': prompt_tokens + completion_tokens}
+    if req.chat:
+        return {
+            'id': req.oai_id, 'object': 'chat.completion',
+            'created': req.created, 'model': req.model,
+            'choices': [{'index': 0,
+                         'message': {'role': 'assistant',
+                                     'content': text},
+                         'finish_reason': finish_reason}],
+            'usage': usage,
+        }
+    return {
+        'id': req.oai_id, 'object': 'text_completion',
+        'created': req.created, 'model': req.model,
+        'choices': [{'index': 0, 'text': text, 'logprobs': None,
+                     'finish_reason': finish_reason}],
+        'usage': usage,
+    }
+
+
+def stream_chunk(req: ParsedRequest, text: Optional[str],
+                 finish_reason: Optional[str] = None,
+                 first: bool = False) -> Dict[str, Any]:
+    """One SSE data event.  Chat streams send role on the first chunk
+    and content deltas after; completion streams send text deltas."""
+    if req.chat:
+        delta: Dict[str, Any] = {}
+        if first:
+            delta['role'] = 'assistant'
+        if text:
+            delta['content'] = text
+        choice = {'index': 0, 'delta': delta,
+                  'finish_reason': finish_reason}
+        obj = 'chat.completion.chunk'
+    else:
+        choice = {'index': 0, 'text': text or '', 'logprobs': None,
+                  'finish_reason': finish_reason}
+        obj = 'text_completion'
+    return {'id': req.oai_id, 'object': obj, 'created': req.created,
+            'model': req.model, 'choices': [choice]}

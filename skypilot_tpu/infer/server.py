@@ -9,6 +9,17 @@ http.server, matching the rest of the serve stack (serve/controller.py):
   POST /generate            -> {"tokens": [[...], ...]}
        body: {"prompt_ids": [[...], ...], "max_new_tokens": N,
               "temperature": T, "top_k": K, "top_p": P, "eos_id": E}
+  GET  /v1/models           -> OpenAI model list
+  POST /v1/completions      -> OpenAI completions (stream + non-stream)
+  POST /v1/chat/completions -> OpenAI chat (stream + non-stream)
+
+The /v1 surface is the OpenAI-compatible API every reference LLM
+recipe serves through vLLM (`llm/qwen/qwen25-7b.yaml:30-33`):
+text-level via the --tokenizer seam (HF name, or the built-in byte
+tokenizer for test models), SSE token streaming wired to the
+continuous-batching engine's incremental decode.  Serving RANDOM
+weights over this API is refused unless --allow-random-weights is
+passed (noise behind an LLM API is a footgun, not a default).
 
 Default mode is CONTINUOUS BATCHING (engine.ContinuousBatchingEngine):
 a dedicated decode-loop thread drives slot-based decode; concurrent
@@ -51,7 +62,10 @@ class InferenceServer:
                  prefill_chunk: int = 0,
                  kv_read_bucket: int = 512,
                  quantize=None,
-                 compilation_cache_dir=None) -> None:
+                 compilation_cache_dir=None,
+                 tokenizer: Optional[str] = None,
+                 allow_random_weights: bool = False,
+                 served_model_name: Optional[str] = None) -> None:
         from skypilot_tpu.parallel import mesh as mesh_lib
         # Hang-proof first backend touch: a wedged tunneled TPU makes
         # this raise (replica exits, probe marks it FAILED) instead of
@@ -88,6 +102,19 @@ class InferenceServer:
                 max_batch_size=max_batch_size,
                 max_seq_len=max_seq_len,
                 model_overrides=model_overrides, quantize=quantize)
+        if not self.engine.loaded_real_weights and \
+                not allow_random_weights:
+            raise ValueError(
+                'refusing to serve randomly initialized weights: pass '
+                '--checkpoint-dir (or --allow-random-weights for '
+                'tests/dev).')
+        from skypilot_tpu.infer import tokenizer as tokenizer_lib
+        self.tokenizer = tokenizer_lib.load(tokenizer)
+        self.model_name = served_model_name or model
+        # Bound on the gap BETWEEN streamed tokens (a stalled decode
+        # loop must not pin an SSE connection forever).
+        self.stream_token_timeout = float(
+            os.environ.get('SKYTPU_STREAM_TOKEN_TIMEOUT_S', '120'))
         # Warm the compile caches (smallest prefill bucket + decode) so
         # /health flips to ready only after the common-path compiles are
         # done.  Other prefill buckets still compile on first use.
@@ -160,6 +187,148 @@ class InferenceServer:
             tokens = self.engine.generate(prompts, sampling)
         return {'tokens': tokens}
 
+    # -- OpenAI-compatible surface ------------------------------------
+    def _sampling_for(self, req) -> 'engine_lib.SamplingConfig':
+        return engine_lib.SamplingConfig(
+            temperature=req.temperature, top_k=req.top_k,
+            top_p=req.top_p, eos_id=self.tokenizer.eos_id,
+            max_new_tokens=req.max_tokens, seed=req.seed)
+
+    def _openai_blocking(self, req, prompt_ids) -> dict:
+        from skypilot_tpu.infer import openai_api
+        sampling = self._sampling_for(req)
+        if self.continuous:
+            rid = self.engine.submit(prompt_ids, sampling)
+            self._work.set()
+            toks = self.engine.wait(rid, timeout=600)
+        else:
+            with self._lock:
+                toks = self.engine.generate([prompt_ids], sampling)[0]
+        eos = self.tokenizer.eos_id
+        eos_hit = bool(toks) and eos is not None and toks[-1] == eos
+        scanner = openai_api.StopScanner(req.stop)
+        text = scanner.feed(self.tokenizer.decode(toks))
+        text += scanner.flush()
+        finish = 'stop' if (eos_hit or scanner.hit) else 'length'
+        return openai_api.completion_response(
+            req, text, finish, prompt_tokens=len(prompt_ids),
+            completion_tokens=len(toks))
+
+    def _openai_stream(self, req, prompt_ids, handler) -> None:
+        """SSE: one `data:` event per decoded text fragment, riding
+        the engine's per-token stream queue; ends with the
+        finish_reason chunk and `data: [DONE]`."""
+        from skypilot_tpu.infer import openai_api
+        from skypilot_tpu.infer import tokenizer as tokenizer_lib
+        sampling = self._sampling_for(req)
+        rid = self.engine.submit(prompt_ids, sampling, stream=True)
+        self._work.set()
+
+        def _sse(obj) -> None:
+            handler.wfile.write(
+                f'data: {json.dumps(obj)}\n\n'.encode())
+            handler.wfile.flush()
+
+        def _sse_error(message: str) -> None:
+            """Mid-stream failure with a live client: an error event
+            + [DONE] is the only legal framing (a second HTTP status
+            line would be protocol garbage)."""
+            try:
+                _sse({'error': {
+                    'message': message, 'type': 'server_error',
+                    'param': None, 'code': None}})
+                handler.wfile.write(b'data: [DONE]\n\n')
+                handler.wfile.flush()
+            except OSError:
+                pass
+            handler.close_connection = True
+
+        decoder = tokenizer_lib.IncrementalDecoder(self.tokenizer)
+        scanner = openai_api.StopScanner(req.stop)
+        eos = self.tokenizer.eos_id
+        n_tokens = 0
+        eos_hit = False
+        started = False
+        try:
+            handler.send_response(200)
+            handler.send_header('Content-Type', 'text/event-stream')
+            handler.send_header('Cache-Control', 'no-cache')
+            handler.end_headers()
+            started = True
+            if req.chat:  # role announcement first
+                _sse(openai_api.stream_chunk(req, None, first=True))
+            for tok in self.engine.stream(
+                    rid, timeout=self.stream_token_timeout):
+                n_tokens += 1
+                if eos is not None and tok == eos:
+                    eos_hit = True
+                    continue  # engine completes after eos
+                piece = decoder.feed(tok)
+                if not piece:
+                    continue
+                out = scanner.feed(piece)
+                if out:
+                    _sse(openai_api.stream_chunk(req, out))
+                if scanner.hit:
+                    self.engine.cancel(rid)
+                    break
+            tail = decoder.flush()
+            out = (scanner.feed(tail) if tail else '') + \
+                scanner.flush()
+            if out:
+                _sse(openai_api.stream_chunk(req, out))
+            finish = 'stop' if (eos_hit or scanner.hit) else (
+                'length' if n_tokens >= req.max_tokens else 'stop')
+            _sse(openai_api.stream_chunk(req, None,
+                                         finish_reason=finish))
+            handler.wfile.write(b'data: [DONE]\n\n')
+            handler.wfile.flush()
+        except TimeoutError:
+            # Decode stalled past the inter-token bound; stream()
+            # already canceled the request.  MUST precede the OSError
+            # arm (TimeoutError subclasses it) — the client is still
+            # connected and deserves an error event, and the stall
+            # must be visible server-side.
+            logger.warning(
+                f'stream {req.oai_id}: no token within '
+                f'{self.stream_token_timeout:.0f}s; terminating SSE')
+            self.engine.cancel(rid)
+            _sse_error('inter-token timeout: decode stalled')
+        except (BrokenPipeError, ConnectionError, OSError):
+            # Client went away mid-stream: release the slot so it
+            # stops decoding for nobody (also covers a disconnect
+            # during header send, before any event went out).
+            self.engine.cancel(rid)
+            handler.close_connection = True
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception(f'stream {req.oai_id} failed mid-flight')
+            self.engine.cancel(rid)
+            if started:
+                _sse_error(f'stream failed: {e}')
+            else:
+                raise  # headers not sent; do_POST replies cleanly
+
+    def _handle_openai(self, payload: dict, chat: bool,
+                       handler) -> Optional[dict]:
+        """Returns a JSON body to reply with, or None if the handler
+        already streamed the response itself."""
+        from skypilot_tpu.infer import openai_api
+        parse = openai_api.parse_chat_request if chat else \
+            openai_api.parse_completion_request
+        req = parse(payload, self.model_name)
+        prompt_ids = self.tokenizer.encode(req.prompt_text)
+        if not prompt_ids:
+            raise openai_api.OpenAIError(
+                'prompt encodes to zero tokens')
+        if req.stream:
+            if not self.continuous:
+                raise openai_api.OpenAIError(
+                    'stream=true requires continuous batching '
+                    '(server started with --no-continuous)')
+            self._openai_stream(req, prompt_ids, handler)
+            return None
+        return self._openai_blocking(req, prompt_ids)
+
     def serve_forever(self) -> None:
         self.start()
         assert self._server is not None
@@ -190,19 +359,42 @@ class InferenceServer:
                             'error': repr(outer._fatal)})  # pylint: disable=protected-access
                     else:
                         self._reply(200, {'status': 'ok'})
+                elif self.path == '/v1/models':
+                    self._reply(200, {
+                        'object': 'list',
+                        'data': [{'id': outer.model_name,
+                                  'object': 'model',
+                                  'created': 0,
+                                  'owned_by': 'skypilot-tpu'}]})
                 else:
                     self._reply(404, {'error': 'not found'})
 
             def do_POST(self):  # noqa: N802
-                if self.path != '/generate':
+                from skypilot_tpu.infer import openai_api
+                routes = {'/generate', '/v1/completions',
+                          '/v1/chat/completions'}
+                if self.path not in routes:
                     self._reply(404, {'error': 'not found'})
                     return
                 try:
                     length = int(self.headers.get('Content-Length', 0))
                     payload = json.loads(self.rfile.read(length) or b'{}')
-                    self._reply(200, outer._handle_generate(payload))  # pylint: disable=protected-access
+                    if self.path == '/generate':
+                        self._reply(200, outer._handle_generate(payload))  # pylint: disable=protected-access
+                        return
+                    body = outer._handle_openai(  # pylint: disable=protected-access
+                        payload, chat=self.path.endswith(
+                            '/chat/completions'), handler=self)
+                    if body is not None:
+                        self._reply(200, body)
+                except openai_api.OpenAIError as e:
+                    self._reply(e.status, e.body())
                 except ValueError as e:
-                    self._reply(400, {'error': str(e)})
+                    if self.path == '/generate':
+                        self._reply(400, {'error': str(e)})
+                    else:
+                        self._reply(
+                            400, openai_api.OpenAIError(str(e)).body())
                 except Exception as e:  # pylint: disable=broad-except
                     logger.exception('generate failed')
                     self._reply(500, {'error': str(e)})
@@ -262,6 +454,19 @@ def main() -> None:
                         help="Force a jax platform (e.g. 'cpu' for "
                              'tests; env JAX_PLATFORMS alone is not '
                              'enough on tunneled-TPU hosts).')
+    parser.add_argument('--tokenizer', default=None,
+                        help='HF tokenizer name for the /v1 text API; '
+                             "default 'byte' (built-in UTF-8 byte "
+                             'tokenizer, test/dev models).')
+    parser.add_argument('--allow-random-weights', action='store_true',
+                        default=False,
+                        help='Serve without a checkpoint (randomly '
+                             'initialized weights). Tests/dev only; '
+                             'without this flag the server refuses '
+                             'to start paramless.')
+    parser.add_argument('--served-model-name', default=None,
+                        help='Model id reported by /v1/models and in '
+                             'OpenAI responses (default: --model).')
     parser.add_argument('--kv-read-bucket', type=int, default=512,
                         help='Decode attention reads only the live '
                              'cache prefix, rounded up to this bucket '
@@ -283,6 +488,9 @@ def main() -> None:
                     kv_read_bucket=args.kv_read_bucket,
                     quantize=args.quantize,
                     compilation_cache_dir=args.compilation_cache_dir,
+                    tokenizer=args.tokenizer,
+                    allow_random_weights=args.allow_random_weights,
+                    served_model_name=args.served_model_name,
                     ).serve_forever()
 
 

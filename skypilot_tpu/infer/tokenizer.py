@@ -1,0 +1,112 @@
+"""Tokenizer seam for the text-level (OpenAI-compatible) serving API.
+
+The engine works on token ids; the OpenAI surface works on text.  Two
+implementations behind one duck-typed interface (`encode(str) ->
+List[int]`, `decode(List[int]) -> str`, `eos_id`):
+
+  - `HFTokenizer`: any HuggingFace tokenizer by name — what a real
+    checkpoint serves with (the reference's recipes get this
+    implicitly from vLLM, e.g. llm/qwen/qwen25-7b.yaml).
+  - `ByteTokenizer`: self-contained UTF-8 byte-level fallback —
+    ids are bytes offset past the specials, so any text round-trips
+    with a 259-entry effective vocab.  This is what test/dev models
+    (llama-tiny, random weights) serve with: the API contract —
+    framing, SSE streaming, usage accounting — is fully exercised
+    without a 100MB tokenizer artifact.
+
+Incremental decode for SSE uses `IncrementalDecoder`: UTF-8 sequences
+split across token boundaries must not emit replacement chars
+mid-stream, so bytes are buffered until they form valid text.
+"""
+from __future__ import annotations
+
+import codecs
+from typing import List, Optional
+
+
+class ByteTokenizer:
+    """UTF-8 bytes offset by the special tokens: 0=pad 1=bos 2=eos."""
+
+    PAD_ID, BOS_ID, EOS_ID = 0, 1, 2
+    _OFFSET = 3
+
+    vocab_size = 256 + _OFFSET
+
+    @property
+    def eos_id(self) -> int:
+        return self.EOS_ID
+
+    def encode(self, text: str) -> List[int]:
+        return [b + self._OFFSET for b in text.encode('utf-8')]
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i - self._OFFSET for i in ids
+                     if i >= self._OFFSET and i - self._OFFSET < 256)
+        return data.decode('utf-8', errors='replace')
+
+
+class HFTokenizer:
+    """Thin adapter over transformers.AutoTokenizer."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer  # type: ignore
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+
+    @property
+    def eos_id(self) -> Optional[int]:
+        return self._tok.eos_token_id
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok(text)['input_ids']
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+class IncrementalDecoder:
+    """Streaming ids -> text without mid-codepoint mojibake.
+
+    ByteTokenizer path: a UTF-8 incremental codec buffers partial
+    multibyte sequences across feed() calls.  HF path: re-decode the
+    full id list and emit the suffix (HF tokenizers' decode is not
+    incremental; suffix-diffing is the standard approach)."""
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._byte_mode = isinstance(tokenizer, ByteTokenizer)
+        if self._byte_mode:
+            self._codec = codecs.getincrementaldecoder('utf-8')(
+                errors='replace')
+        else:
+            self._ids: List[int] = []
+            self._emitted = ''
+
+    def feed(self, token_id: int) -> str:
+        """Text newly available after this token ('' if the token
+        completes nothing yet, e.g. first byte of a multibyte char)."""
+        if self._byte_mode:
+            off = ByteTokenizer._OFFSET
+            if token_id < off or token_id - off >= 256:
+                return ''  # specials produce no text
+            return self._codec.decode(bytes([token_id - off]))
+        self._ids.append(token_id)
+        full = self._tok.decode(self._ids)
+        # Hold back while the tail is an incomplete sequence (HF
+        # decoders emit U+FFFD for it).
+        if full.endswith('�'):
+            return ''
+        new = full[len(self._emitted):]
+        self._emitted = full
+        return new
+
+    def flush(self) -> str:
+        if self._byte_mode:
+            return self._codec.decode(b'', final=True)
+        return ''
+
+
+def load(spec: Optional[str]):
+    """None/'' or 'byte' -> ByteTokenizer; anything else -> HF name."""
+    if not spec or spec == 'byte':
+        return ByteTokenizer()
+    return HFTokenizer(spec)

@@ -212,7 +212,8 @@ class TestInferenceServerE2E:
                '--model llama-tiny --host 127.0.0.1 '
                '--port $SKYTPU_SERVE_REPLICA_PORT '
                '--max-batch-size 2 --max-seq-len 64 '
-               '--prefill-chunk 8 --platform cpu')
+               '--prefill-chunk 8 --platform cpu '
+               '--allow-random-weights')
         t = sky.Task(run=run)
         t.set_resources(sky.Resources(cloud='local'))
         from skypilot_tpu.serve import service_spec as spec_lib
@@ -247,5 +248,42 @@ class TestInferenceServerE2E:
                     time.sleep(0.5)
             assert len(body['tokens']) == 1
             assert len(body['tokens'][0]) == 4
+
+            # OpenAI SSE streaming END-TO-END: client -> LB (chunked
+            # relay) -> replica server -> continuous-batching engine's
+            # per-token stream.  Reference analog: the vLLM OpenAI
+            # endpoint every LLM recipe serves
+            # (llm/qwen/qwen25-7b.yaml:30-33).
+            sse_req = urllib.request.Request(
+                endpoint + '/v1/completions',
+                data=json.dumps({'prompt': 'Hi', 'max_tokens': 4,
+                                 'temperature': 0.0,
+                                 'stream': True}).encode(),
+                headers={'Content-Type': 'application/json'})
+            with urllib.request.urlopen(sse_req, timeout=120) as resp:
+                assert resp.headers['Content-Type'] == \
+                    'text/event-stream'
+                events, done, buf = [], False, b''
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while b'\n\n' in buf:
+                        event, buf = buf.split(b'\n\n', 1)
+                        if not event.startswith(b'data: '):
+                            continue
+                        data = event[len(b'data: '):]
+                        if data == b'[DONE]':
+                            done = True
+                        else:
+                            events.append(json.loads(data))
+            assert done, 'SSE stream had no [DONE] terminator'
+            assert events and all(
+                e['object'] == 'text_completion' for e in events)
+            finishes = [e['choices'][0]['finish_reason']
+                        for e in events
+                        if e['choices'][0]['finish_reason']]
+            assert len(finishes) == 1
         finally:
             serve_core.down(name)

@@ -340,7 +340,7 @@ class TestContinuousServer:
         import urllib.request
 
         from skypilot_tpu.infer import server as server_lib
-        srv = server_lib.InferenceServer(
+        srv = server_lib.InferenceServer(allow_random_weights=True, 
             model='llama-tiny', port=0, host='127.0.0.1',
             max_batch_size=2, model_overrides=dict(_OVERRIDES))
         assert srv.continuous

@@ -223,7 +223,7 @@ class TestServer:
 
     def test_health_and_generate(self):
         from skypilot_tpu.infer import server as server_lib
-        srv = server_lib.InferenceServer(
+        srv = server_lib.InferenceServer(allow_random_weights=True, 
             model='llama-tiny', port=0, host='127.0.0.1',
             max_batch_size=2, model_overrides=dict(_OVERRIDES))
         srv.start()

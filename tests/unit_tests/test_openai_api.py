@@ -1,0 +1,300 @@
+"""OpenAI-compatible serving surface (reference parity: every LLM
+recipe serves the OpenAI API with streaming, llm/qwen/qwen25-7b.yaml
+via vLLM).  Protocol units + a live CPU server driving real SSE."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.infer import openai_api
+from skypilot_tpu.infer import tokenizer as tokenizer_lib
+
+# vocab >= 259 so the byte tokenizer's id space fits.
+_OVERRIDES = {'n_heads': 4, 'n_kv_heads': 2, 'max_seq_len': 64,
+              'n_layers': 2, 'dim': 64, 'ffn_dim': 128,
+              'vocab_size': 512, 'dtype': jnp.float32,
+              'param_dtype': jnp.float32}
+
+
+class TestByteTokenizer:
+
+    def test_round_trip(self):
+        tok = tokenizer_lib.ByteTokenizer()
+        for text in ('hello', 'héllo wörld', '日本語', 'a\nb\tc'):
+            assert tok.decode(tok.encode(text)) == text
+
+    def test_specials_skipped(self):
+        tok = tokenizer_lib.ByteTokenizer()
+        ids = [tok.BOS_ID] + tok.encode('hi') + [tok.EOS_ID]
+        assert tok.decode(ids) == 'hi'
+
+    def test_incremental_multibyte_split(self):
+        """A UTF-8 char split across token boundaries must not emit
+        replacement chars mid-stream."""
+        tok = tokenizer_lib.ByteTokenizer()
+        dec = tokenizer_lib.IncrementalDecoder(tok)
+        pieces = [dec.feed(t) for t in tok.encode('é日')]
+        assert '�' not in ''.join(pieces)
+        assert ''.join(pieces) + dec.flush() == 'é日'
+        # Multi-byte chars yield '' until their last byte arrives.
+        assert pieces[0] == ''
+
+
+class TestParsing:
+
+    def test_completion_defaults(self):
+        req = openai_api.parse_completion_request(
+            {'prompt': 'hi'}, 'm0')
+        assert (req.prompt_text, req.max_tokens, req.stream,
+                req.model, req.chat) == ('hi', 16, False, 'm0', False)
+        assert req.oai_id.startswith('cmpl-')
+
+    def test_rejects_unsupported(self):
+        with pytest.raises(openai_api.OpenAIError):
+            openai_api.parse_completion_request(
+                {'prompt': 'x', 'n': 2}, 'm')
+        with pytest.raises(openai_api.OpenAIError):
+            openai_api.parse_completion_request(
+                {'prompt': 'x', 'logprobs': 3}, 'm')
+        with pytest.raises(openai_api.OpenAIError):
+            openai_api.parse_completion_request({'prompt': ''}, 'm')
+        with pytest.raises(openai_api.OpenAIError):
+            openai_api.parse_completion_request(
+                {'prompt': 'x', 'stop': ['a'] * 5}, 'm')
+        with pytest.raises(openai_api.OpenAIError):
+            openai_api.parse_chat_request({'messages': []}, 'm')
+
+    def test_chat_prompt_render(self):
+        req = openai_api.parse_chat_request(
+            {'messages': [{'role': 'system', 'content': 's'},
+                          {'role': 'user', 'content': 'u'}]}, 'm')
+        assert req.prompt_text == 'system: s\nuser: u\nassistant:'
+        assert req.chat and req.oai_id.startswith('chatcmpl-')
+
+
+class TestStopScanner:
+
+    def test_cut_at_stop(self):
+        s = openai_api.StopScanner(['END'])
+        assert s.feed('abcENDxyz') == 'abc'
+        assert s.hit
+        assert s.feed('more') == ''
+
+    def test_stop_split_across_chunks(self):
+        s = openai_api.StopScanner(['END'])
+        out = s.feed('abcE')
+        assert out == 'abc'  # 'E' held back as a possible prefix
+        assert s.feed('NDxyz') == ''
+        assert s.hit
+
+    def test_holdback_released_when_not_stop(self):
+        s = openai_api.StopScanner(['END'])
+        assert s.feed('abcE') == 'abc'
+        assert s.feed('F') == 'EF'
+        assert not s.hit
+        assert s.flush() == ''
+
+    def test_earliest_stop_wins(self):
+        s = openai_api.StopScanner(['yz', 'cd'])
+        assert s.feed('abcdyz') == 'ab'
+
+    def test_no_stops_passthrough(self):
+        s = openai_api.StopScanner([])
+        assert s.feed('anything') == 'anything'
+        assert s.flush() == ''
+
+
+@pytest.fixture(scope='module')
+def oai_server():
+    from skypilot_tpu.infer import server as server_lib
+    srv = server_lib.InferenceServer(
+        model='llama-tiny', port=0, host='127.0.0.1',
+        max_batch_size=2, model_overrides=dict(_OVERRIDES),
+        allow_random_weights=True)
+    srv.start()
+    thread = threading.Thread(target=srv._server.serve_forever,  # pylint: disable=protected-access
+                              daemon=True)
+    thread.start()
+    yield f'http://127.0.0.1:{srv.port}'
+    srv.shutdown()
+
+
+def _post(url: str, payload: dict, timeout: float = 60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _read_sse(resp):
+    """data: events until [DONE]; asserts the terminator arrives."""
+    events, done = [], False
+    buf = b''
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b'\n\n' in buf:
+            event, buf = buf.split(b'\n\n', 1)
+            if not event.startswith(b'data: '):
+                continue
+            data = event[len(b'data: '):]
+            if data == b'[DONE]':
+                done = True
+            else:
+                events.append(json.loads(data))
+    assert done, 'stream did not end with data: [DONE]'
+    return events
+
+
+class TestServerOpenAI:
+
+    def test_models_list(self, oai_server):
+        with urllib.request.urlopen(f'{oai_server}/v1/models',
+                                    timeout=10) as r:
+            body = json.load(r)
+        assert body['object'] == 'list'
+        assert body['data'][0]['id'] == 'llama-tiny'
+
+    def test_completions_blocking(self, oai_server):
+        with _post(f'{oai_server}/v1/completions',
+                   {'prompt': 'Hello', 'max_tokens': 4,
+                    'temperature': 0.0}) as r:
+            body = json.load(r)
+        assert body['object'] == 'text_completion'
+        assert body['id'].startswith('cmpl-')
+        (choice,) = body['choices']
+        assert choice['finish_reason'] in ('stop', 'length')
+        assert isinstance(choice['text'], str)
+        assert body['usage']['prompt_tokens'] == 5  # byte tokenizer
+        assert body['usage']['completion_tokens'] <= 4
+        assert body['usage']['total_tokens'] == \
+            body['usage']['prompt_tokens'] + \
+            body['usage']['completion_tokens']
+
+    def test_completions_streaming_sse(self, oai_server):
+        with _post(f'{oai_server}/v1/completions',
+                   {'prompt': 'Hi', 'max_tokens': 4,
+                    'temperature': 0.0, 'stream': True}) as r:
+            assert r.headers['Content-Type'] == 'text/event-stream'
+            events = _read_sse(r)
+        assert events, 'no SSE events'
+        assert all(e['object'] == 'text_completion' for e in events)
+        # Exactly one terminal chunk, with a finish_reason.
+        finishes = [e['choices'][0]['finish_reason'] for e in events
+                    if e['choices'][0]['finish_reason']]
+        assert finishes in (['length'], ['stop'])
+        # All chunks share one request id.
+        assert len({e['id'] for e in events}) == 1
+
+    def test_chat_streaming_role_then_deltas(self, oai_server):
+        with _post(f'{oai_server}/v1/chat/completions',
+                   {'messages': [{'role': 'user', 'content': 'Hi'}],
+                    'max_tokens': 3, 'temperature': 0.0,
+                    'stream': True}) as r:
+            events = _read_sse(r)
+        assert events[0]['object'] == 'chat.completion.chunk'
+        assert events[0]['choices'][0]['delta'].get('role') == \
+            'assistant'
+        assert events[-1]['choices'][0]['finish_reason'] is not None
+
+    def test_chat_blocking(self, oai_server):
+        with _post(f'{oai_server}/v1/chat/completions',
+                   {'messages': [{'role': 'user', 'content': 'Hey'}],
+                    'max_tokens': 3}) as r:
+            body = json.load(r)
+        assert body['object'] == 'chat.completion'
+        assert body['choices'][0]['message']['role'] == 'assistant'
+
+    def test_openai_error_shape(self, oai_server):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(f'{oai_server}/v1/completions',
+                  {'prompt': 'x', 'n': 3})
+        assert exc.value.code == 400
+        body = json.loads(exc.value.read())
+        assert body['error']['type'] == 'invalid_request_error'
+
+    def test_generate_endpoint_still_works(self, oai_server):
+        with _post(f'{oai_server}/generate',
+                   {'prompt_ids': [[1, 2, 3]],
+                    'max_new_tokens': 2}) as r:
+            body = json.load(r)
+        assert len(body['tokens'][0]) == 2
+
+
+class TestRandomWeightsGuard:
+
+    def test_refuses_without_flag(self):
+        from skypilot_tpu.infer import server as server_lib
+        with pytest.raises(ValueError, match='randomly initialized'):
+            server_lib.InferenceServer(
+                model='llama-tiny', port=0, host='127.0.0.1',
+                max_batch_size=2, model_overrides=dict(_OVERRIDES))
+
+
+class TestEngineStream:
+
+    def test_stream_yields_each_token_then_ends(self):
+        from skypilot_tpu.infer import engine as engine_lib
+        eng = engine_lib.ContinuousBatchingEngine(
+            model='llama-tiny', n_slots=2,
+            model_overrides=dict(_OVERRIDES))
+        rid = eng.submit([1, 2, 3],
+                         engine_lib.SamplingConfig(max_new_tokens=5),
+                         stream=True)
+        got = []
+        stream = eng.stream(rid, timeout=30)
+        # Drive the loop from this thread, reading as tokens land.
+        eng.run_until_idle()
+        got = list(stream)
+        assert len(got) == 5
+        assert all(isinstance(t, int) for t in got)
+        # Bookkeeping fully released (no leaked events/results).
+        assert rid not in eng._events and rid not in eng._results  # pylint: disable=protected-access
+        assert rid not in eng._stream_queues  # pylint: disable=protected-access
+
+    def test_cancel_unblocks_live_stream_reader(self):
+        import time
+        from skypilot_tpu.infer import engine as engine_lib
+        eng = engine_lib.ContinuousBatchingEngine(
+            model='llama-tiny', n_slots=2,
+            model_overrides=dict(_OVERRIDES))
+        rid = eng.submit([1, 2], engine_lib.SamplingConfig(
+            max_new_tokens=50), stream=True)
+        got = []
+
+        def _reader():
+            for tok in eng.stream(rid, timeout=10):
+                got.append(tok)
+
+        thread = threading.Thread(target=_reader, daemon=True)
+        thread.start()
+        eng.step()  # admit + first decode -> at least one token
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got, 'reader saw no token'
+        eng.cancel(rid)  # pushes the end sentinel
+        thread.join(timeout=5)
+        assert not thread.is_alive(), 'cancel did not unblock reader'
+        assert len(got) < 50  # ended promptly, not the full budget
+
+
+class TestNullFields:
+
+    def test_null_fields_use_defaults(self):
+        req = openai_api.parse_completion_request(
+            {'prompt': 'hi', 'max_tokens': None, 'temperature': None,
+             'top_p': None, 'n': None, 'stop': None}, 'm')
+        assert req.max_tokens == 16
+        assert req.temperature == 1.0
+        assert req.top_p == 1.0
+
+    def test_bad_type_is_400_not_500(self):
+        with pytest.raises(openai_api.OpenAIError):
+            openai_api.parse_completion_request(
+                {'prompt': 'hi', 'max_tokens': 'many'}, 'm')

@@ -158,7 +158,7 @@ _CHILD = textwrap.dedent('''
     # Serve path: --quantize composes with --mesh-config (the warmup
     # generate in __init__ exercises the sharded quantized engine).
     from skypilot_tpu.infer import server as server_lib
-    srv = server_lib.InferenceServer(
+    srv = server_lib.InferenceServer(allow_random_weights=True, 
         model='llama-tiny', port=0, max_batch_size=2,
         mesh_config='data=1,fsdp=-1,tensor=2',
         model_overrides=dict(OV), quantize='int8')
