@@ -454,4 +454,14 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
 
 def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name_on_cloud, ports, provider_config
+    """Delete the `allow-<port>` NSG rules open_ports created.  The
+    whole resource group (NSG included) dies at terminate anyway, but
+    ports closed on a LIVE cluster must actually close."""
+    rg = _rg(cluster_name_on_cloud, provider_config)
+    for port in ports:
+        rule_name = f'allow-{port}'.replace(':', '-')
+        # delete_resource treats 404 as already-gone.
+        arm_api.delete_resource(
+            rg, _NETWORK,
+            'networkSecurityGroups/skytpu-nsg/securityRules',
+            rule_name)

@@ -178,9 +178,21 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
 
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name_on_cloud, ports, provider_config
+    """Recorded on the instance records, so hermetic tests can assert
+    the launch path really opened what the resources declared."""
+    del provider_config
+    with _state().transaction() as state:
+        for rec in state.instances.values():
+            if rec.get('cluster') == cluster_name_on_cloud:
+                opened = rec.setdefault('open_ports', [])
+                rec['open_ports'] = sorted(set(opened) | set(ports))
 
 
 def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name_on_cloud, ports, provider_config
+    del provider_config
+    with _state().transaction() as state:
+        for rec in state.instances.values():
+            if rec.get('cluster') == cluster_name_on_cloud:
+                rec['open_ports'] = sorted(
+                    set(rec.get('open_ports', [])) - set(ports))

@@ -1,0 +1,152 @@
+"""kubectl port-forward sessions: client-side network access to pods
+on clusters that expose nothing externally.
+
+Reference parity: sky/provision/kubernetes/instance.py:822 (ssh-jump
+pod) + sky/templates/kubernetes-port-forward-proxy-command.sh — the
+reference tunnels SSH through the API server because its runtime needs
+SSH.  This framework's pod runtime rides `kubectl exec` (no SSH
+anywhere), so the only remaining reachability gap is *TCP* access to
+in-pod services (replica HTTP servers, the agent RPC port) from
+outside the cluster when no LoadBalancer/NodePort is available
+(`port_mode: podip`, or clusters whose nodes have no public IPs).
+A `PortForward` wraps one `kubectl port-forward` child: start() parses
+the dynamically allocated local port, stop() kills the child; the
+module-level registry reuses live sessions per (context, ns, pod,
+port) and reaps them at interpreter exit.
+"""
+from __future__ import annotations
+
+import atexit
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_START_TIMEOUT_S = 30.0
+
+
+class PortForward:
+    """One `kubectl port-forward pod/<pod> :<port>` session."""
+
+    def __init__(self, pod: str, port: int,
+                 namespace: str = 'default',
+                 context: Optional[str] = None):
+        self.pod = pod
+        self.port = port
+        self.namespace = namespace
+        self.context = context
+        self.local_port: Optional[int] = None
+        self._proc: Optional[subprocess.Popen] = None
+
+    def _argv(self) -> List[str]:
+        args = ['kubectl']
+        if self.context:
+            args += ['--context', self.context]
+        args += ['--namespace', self.namespace,
+                 'port-forward', f'pod/{self.pod}',
+                 # :remote -> kubectl picks a free local port and
+                 # prints it; no TOCTOU against other processes.
+                 f':{self.port}', '--address', '127.0.0.1']
+        return args
+
+    def start(self) -> int:
+        """Spawn and block until the tunnel is listening; returns the
+        local port."""
+        self._proc = subprocess.Popen(
+            self._argv(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        assert self._proc.stdout is not None
+        deadline = time.time() + _START_TIMEOUT_S
+        line = ''
+        while time.time() < deadline:
+            if self._proc.poll() is not None:
+                err = (self._proc.stderr.read()
+                       if self._proc.stderr else '')
+                raise exceptions.ProvisionError(
+                    f'kubectl port-forward to {self.pod}:{self.port} '
+                    f'exited rc={self._proc.returncode}: '
+                    f'{err.strip()[:500]}')
+            line = self._proc.stdout.readline()
+            if not line:
+                time.sleep(0.05)
+                continue
+            # "Forwarding from 127.0.0.1:40123 -> 8000"
+            if 'Forwarding from' in line and ':' in line:
+                try:
+                    hostport = line.split('Forwarding from', 1)[1]
+                    hostport = hostport.split('->')[0].strip()
+                    self.local_port = int(hostport.rsplit(':', 1)[1])
+                    return self.local_port
+                except (IndexError, ValueError):
+                    continue
+        self.stop()
+        raise exceptions.ProvisionTimeoutError(
+            f'kubectl port-forward to {self.pod}:{self.port} did not '
+            f'report a local port within {_START_TIMEOUT_S:.0f}s '
+            f'(last line: {line.strip()!r}).')
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def stop(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        self._proc = None
+        self.local_port = None
+
+    def __enter__(self) -> 'PortForward':
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+_registry: Dict[Tuple[Optional[str], str, str, int], PortForward] = {}
+_registry_lock = threading.Lock()
+
+
+def get_or_create(pod: str, port: int, namespace: str = 'default',
+                  context: Optional[str] = None) -> PortForward:
+    """Live session for (context, ns, pod, port), starting one (or
+    restarting a dead one) if needed.  Long-lived callers (the serve
+    controller probing podip-mode replicas) share sessions instead of
+    spawning a kubectl per probe."""
+    key = (context, namespace, pod, port)
+    with _registry_lock:
+        pf = _registry.get(key)
+        if pf is not None and pf.alive():
+            return pf
+        pf = PortForward(pod, port, namespace=namespace,
+                         context=context)
+        pf.start()
+        _registry[key] = pf
+        return pf
+
+
+def close(pod: str, port: int, namespace: str = 'default',
+          context: Optional[str] = None) -> None:
+    with _registry_lock:
+        pf = _registry.pop((context, namespace, pod, port), None)
+    if pf is not None:
+        pf.stop()
+
+
+def close_all() -> None:
+    with _registry_lock:
+        sessions = list(_registry.values())
+        _registry.clear()
+    for pf in sessions:
+        pf.stop()
+
+
+atexit.register(close_all)

@@ -186,4 +186,5 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
 
 def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name_on_cloud, ports, provider_config
+    del cluster_name_on_cloud, provider_config
+    logger.info('Lambda Cloud has no firewall API per cluster; nothing to close for %s.', ports)

@@ -185,9 +185,14 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
 
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name_on_cloud, ports, provider_config
+    del cluster_name_on_cloud, provider_config
+    # Local 'nodes' share the host's network namespace: a port a
+    # process binds is already reachable — nothing to program.
+    logger.info('local cloud: ports %s ride the host network '
+                '(no firewall layer to open).', ports)
 
 
 def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name_on_cloud, ports, provider_config
+    del cluster_name_on_cloud, provider_config
+    logger.info('local cloud: nothing to close for ports %s.', ports)

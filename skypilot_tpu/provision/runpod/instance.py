@@ -244,4 +244,5 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
 
 def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del cluster_name_on_cloud, ports, provider_config  # die with the pod
+    del cluster_name_on_cloud, provider_config
+    logger.info('RunPod ports are fixed at pod creation (launch-only model); nothing to close for %s.', ports)  # die with the pod

@@ -65,6 +65,17 @@ def _resolve_replica_endpoint(handle, port: int) -> str:
     if addr.startswith('local:'):
         return f'http://127.0.0.1:{port}'
     if addr.startswith('k8s:'):
+        pc = getattr(handle, 'provider_config', None) or {}
+        if (pc.get('port_mode') or 'loadbalancer').lower() == 'podip':
+            # No external exposure on this cluster: tunnel through the
+            # API server instead (kubectl port-forward to the head
+            # pod) — the controller probes/routes via localhost.
+            from skypilot_tpu.provision.kubernetes import port_forward
+            context, namespace, pod = addr[len('k8s:'):].split('/', 2)
+            pf = port_forward.get_or_create(
+                pod, port, namespace=namespace,
+                context=context or None)
+            return f'http://127.0.0.1:{pf.local_port}'
         from skypilot_tpu.provision import api as provision_api
         deadline = time.time() + _K8S_ENDPOINT_TIMEOUT_S
         while True:

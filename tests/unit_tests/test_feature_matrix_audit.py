@@ -1,0 +1,157 @@
+"""Declared CloudImplementationFeatures vs what provisioners actually
+implement (round-4 verdict: the k8s open_ports no-op showed declared
+features can silently drift from provisioner behavior).
+
+Structural audit, no cloud credentials: for every registered cloud,
+the provisioner module's functions are inspected — a feature a cloud
+DECLARES (i.e. does not list as unsupported) must be backed by a real
+implementation, and a function that is a pure no-op (only del/pass/
+docstring) can never back a declared feature.  Drift is impossible to
+reintroduce without this test failing.
+"""
+import ast
+import importlib
+import inspect
+import textwrap
+
+import pytest
+
+from skypilot_tpu.clouds import registry
+from skypilot_tpu.clouds.cloud import CloudImplementationFeatures as F
+
+# Import every cloud module so the registry is fully populated.
+import skypilot_tpu.clouds  # noqa: F401  pylint: disable=unused-import
+
+
+def _all_clouds():
+    seen = {}
+    for cls in registry.CLOUD_REGISTRY.values():
+        seen[cls.canonical_name()] = cls
+    return sorted(seen.items())
+
+
+def _provisioner(cls):
+    if not cls.PROVISIONER_MODULE:
+        return None
+    return importlib.import_module(
+        f'skypilot_tpu.provision.{cls.PROVISIONER_MODULE}.instance')
+
+
+def _declared_unsupported(cls):
+    """The declared unsupported set, via the real API (clouds declare
+    through _unsupported_features_for_resources — inline dicts,
+    _CLOUD_UNSUPPORTED_FEATURES, or MinorCloud.UNSUPPORTED all funnel
+    through it).  Resource-independent audit: None is passed; impls
+    that inspect the resources fall back to the static attrs."""
+    from skypilot_tpu import resources as resources_lib
+    try:
+        res = resources_lib.Resources()
+        return set(cls._unsupported_features_for_resources(res))  # pylint: disable=protected-access
+    except Exception:  # pylint: disable=broad-except
+        feats = dict(getattr(cls, '_CLOUD_UNSUPPORTED_FEATURES', {}))
+        feats.update(getattr(cls, 'UNSUPPORTED', {}))
+        return set(feats)
+
+
+def _is_noop(fn) -> bool:
+    """True if the function body is only docstring/del/pass/... —
+    i.e. it can't possibly implement anything."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, SyntaxError):
+        return False
+    (func,) = tree.body
+    assert isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef))
+    for node in func.body:
+        if isinstance(node, ast.Pass):
+            continue
+        if isinstance(node, ast.Delete):
+            continue
+        if isinstance(node, ast.Expr) and isinstance(
+                node.value, ast.Constant):
+            continue  # docstring / ellipsis
+        if isinstance(node, ast.Return) and node.value is None:
+            continue
+        return False
+    return True
+
+
+def _raises_not_supported_only(fn) -> bool:
+    """True if the body is just `raise NotSupportedError(...)` (the
+    legitimate shape for an UNSUPPORTED feature's stub)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, SyntaxError):
+        return False
+    (func,) = tree.body
+    stmts = [n for n in func.body
+             if not (isinstance(n, ast.Expr)
+                     and isinstance(n.value, ast.Constant))]
+    return len(stmts) == 1 and isinstance(stmts[0], ast.Raise)
+
+
+# feature -> provisioner function(s) that must back it when declared.
+_FEATURE_FUNCS = {
+    F.STOP: ['stop_instances'],
+    F.OPEN_PORTS: ['open_ports', 'cleanup_ports'],
+}
+
+
+@pytest.mark.parametrize('name,cls', _all_clouds())
+def test_declared_features_are_backed_by_real_code(name, cls):
+    module = _provisioner(cls)
+    if module is None:
+        pytest.skip(f'{name}: no provisioner module')
+    unsupported = _declared_unsupported(cls)
+    for feature, fn_names in _FEATURE_FUNCS.items():
+        for fn_name in fn_names:
+            fn = getattr(module, fn_name, None)
+            if feature in unsupported:
+                # Declared unsupported: a silent no-op is ALSO wrong —
+                # the function must be absent or raise NotSupported,
+                # never swallow the request.
+                if fn is not None and _is_noop(fn):
+                    pytest.fail(
+                        f'{name}: {feature.value} declared '
+                        f'unsupported but {fn_name} is a silent '
+                        f'no-op (should raise NotSupportedError or '
+                        f'not exist)')
+            else:
+                assert fn is not None, (
+                    f'{name}: declares {feature.value} supported but '
+                    f'provisioner has no {fn_name}()')
+                assert not _is_noop(fn), (
+                    f'{name}: declares {feature.value} supported but '
+                    f'{fn_name}() is a no-op — the k8s open_ports '
+                    f'drift, reborn')
+                assert not _raises_not_supported_only(fn), (
+                    f'{name}: declares {feature.value} supported but '
+                    f'{fn_name}() only raises')
+
+
+@pytest.mark.parametrize('name,cls', _all_clouds())
+def test_unsupported_stop_never_strands_clusters(name, cls):
+    """Every cloud, even STOP-unsupported ones, must implement
+    terminate_instances — down must always work."""
+    module = _provisioner(cls)
+    if module is None:
+        pytest.skip(f'{name}: no provisioner module')
+    fn = getattr(module, 'terminate_instances', None)
+    assert fn is not None and not _is_noop(fn), (
+        f'{name}: terminate_instances missing or no-op')
+
+
+@pytest.mark.parametrize('name,cls', _all_clouds())
+def test_provisioner_uniform_interface_complete(name, cls):
+    """The dispatch contract (provision/api.py docstring): every
+    provisioner exports the uniform lifecycle interface."""
+    module = _provisioner(cls)
+    if module is None:
+        pytest.skip(f'{name}: no provisioner module')
+    for fn_name in ('run_instances', 'query_instances',
+                    'wait_instances', 'get_cluster_info',
+                    'terminate_instances'):
+        assert callable(getattr(module, fn_name, None)), (
+            f'{name}: provisioner missing {fn_name}')

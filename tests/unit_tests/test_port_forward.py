@@ -1,0 +1,124 @@
+"""kubectl port-forward sessions (reference parity: the port-forward
+proxy path for clusters with no external exposure,
+sky/templates/kubernetes-port-forward-proxy-command.sh).
+
+kubectl itself is faked with a real child process so the parsing,
+liveness and kill logic run against actual pipes and PIDs."""
+import subprocess
+import sys
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.kubernetes import port_forward
+
+
+_REAL_POPEN = subprocess.Popen  # monkeypatching the module attr would
+#                                 otherwise make the fake call itself
+
+
+def _fake_popen_factory(script: str):
+    """Popen lookalike: ignores kubectl argv, runs `script` instead."""
+
+    def _factory(argv, **kwargs):
+        assert argv[0] == 'kubectl'
+        assert 'port-forward' in argv
+        return _REAL_POPEN([sys.executable, '-c', script], **kwargs)
+
+    return _factory
+
+
+_FORWARD_OK = ("print('Forwarding from 127.0.0.1:43210 -> 8000',"
+               " flush=True)\n"
+               "import time; time.sleep(60)")
+_FORWARD_FAIL = ("import sys\n"
+                 "sys.stderr.write('error: unable to forward')\n"
+                 "sys.exit(1)")
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    port_forward.close_all()
+
+
+class TestPortForward:
+
+    def test_start_parses_local_port_and_stop_kills(self, monkeypatch):
+        monkeypatch.setattr(port_forward.subprocess, 'Popen',
+                            _fake_popen_factory(_FORWARD_OK))
+        pf = port_forward.PortForward('pod-a', 8000)
+        assert pf.start() == 43210
+        assert pf.local_port == 43210
+        assert pf.alive()
+        child = pf._proc  # pylint: disable=protected-access
+        pf.stop()
+        assert not pf.alive()
+        assert child.poll() is not None  # really dead, not orphaned
+
+    def test_failed_forward_raises_with_stderr(self, monkeypatch):
+        monkeypatch.setattr(port_forward.subprocess, 'Popen',
+                            _fake_popen_factory(_FORWARD_FAIL))
+        pf = port_forward.PortForward('pod-a', 8000)
+        with pytest.raises(exceptions.ProvisionError,
+                           match='unable to forward'):
+            pf.start()
+
+    def test_context_manager(self, monkeypatch):
+        monkeypatch.setattr(port_forward.subprocess, 'Popen',
+                            _fake_popen_factory(_FORWARD_OK))
+        with port_forward.PortForward('p', 80) as pf:
+            assert pf.local_port == 43210
+        assert not pf.alive()
+
+    def test_registry_reuses_live_session(self, monkeypatch):
+        monkeypatch.setattr(port_forward.subprocess, 'Popen',
+                            _fake_popen_factory(_FORWARD_OK))
+        a = port_forward.get_or_create('pod-a', 8000)
+        b = port_forward.get_or_create('pod-a', 8000)
+        assert a is b
+        c = port_forward.get_or_create('pod-b', 8000)
+        assert c is not a
+        # A dead session is transparently replaced.
+        a.stop()
+        d = port_forward.get_or_create('pod-a', 8000)
+        assert d is not a and d.alive()
+
+    def test_argv_shape(self):
+        pf = port_forward.PortForward('pod-x', 9000, namespace='ns1',
+                                      context='ctx1')
+        argv = pf._argv()  # pylint: disable=protected-access
+        assert argv[:5] == ['kubectl', '--context', 'ctx1',
+                            '--namespace', 'ns1']
+        assert 'pod/pod-x' in argv and ':9000' in argv
+
+
+class TestReplicaPodipEndpoint:
+
+    def test_podip_mode_resolves_via_port_forward(self, monkeypatch):
+        from skypilot_tpu.serve import replica_managers as rm
+
+        class _FakePF:
+            local_port = 40123
+
+        calls = {}
+
+        def _fake_get_or_create(pod, port, namespace='default',
+                                context=None):
+            calls.update(pod=pod, port=port, namespace=namespace,
+                         context=context)
+            return _FakePF()
+
+        monkeypatch.setattr(port_forward, 'get_or_create',
+                            _fake_get_or_create)
+
+        class _Handle:
+            head_address = 'k8s:gke_ctx/ns2/c1-n0-h0'
+            provider_config = {'port_mode': 'podip',
+                               'namespace': 'ns2',
+                               'context': 'gke_ctx'}
+
+        url = rm._resolve_replica_endpoint(_Handle(), 8080)  # pylint: disable=protected-access
+        assert url == 'http://127.0.0.1:40123'
+        assert calls == dict(pod='c1-n0-h0', port=8080,
+                             namespace='ns2', context='gke_ctx')
