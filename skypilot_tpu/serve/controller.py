@@ -134,7 +134,7 @@ class SkyServeController:
             def log_message(self, *args: Any) -> None:
                 pass
 
-            def _send_json(self, obj: Dict[str, Any],
+            def _send_json(self, obj: Any,
                            code: int = 200) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
@@ -163,8 +163,26 @@ class SkyServeController:
                     self._send_json({'error': 'not found'}, code=404)
 
             def do_GET(self) -> None:  # noqa: N802
-                if self.path == '/controller/health':
+                from skypilot_tpu.serve import dashboard
+                path = self.path.split('?', 1)[0].rstrip('/')
+                if path == '/controller/health':
                     self._send_json({'service': controller.service_name})
+                elif path == '/services':
+                    # Browsable `sky serve status` analog, scoped to
+                    # this controller's service.
+                    body = dashboard.render_index(
+                        controller.service_name).encode()
+                    self.send_response(200)
+                    self.send_header('Content-Type', 'text/html')
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == '/api/services':
+                    # Bare list — same shape as the standalone
+                    # dashboard's API, so the HTML page's fetch works
+                    # against either server.
+                    self._send_json(dashboard.services_snapshot(
+                        controller.service_name))
                 else:
                     self._send_json({'error': 'not found'}, code=404)
 

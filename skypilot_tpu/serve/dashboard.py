@@ -1,0 +1,224 @@
+"""SkyServe dashboard: zero-dependency HTTP view of services+replicas.
+
+Beats the reference here: it ships only a managed-jobs dashboard
+(sky/jobs/dashboard/), so `sky serve status` has no browsable analog.
+Same design as jobs/dashboard.py — stdlib ThreadingHTTPServer, inert
+textContent rendering, JSON API under the HTML — and the snapshot
+routes are ALSO mounted on every serve controller (`/services`,
+`/api/services`), so a running service is inspectable without a
+separate process.
+
+Routes:
+  GET /              HTML page (auto-refreshing services + replicas).
+  GET /api/services  JSON: [{service record, replicas: [...]}, ...].
+  GET /healthz       liveness probe.
+"""
+from __future__ import annotations
+
+import enum
+import html
+import http.server
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import serve_utils
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_PORT = 5051
+
+
+def _jsonable(row: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: (v.value if isinstance(v, enum.Enum) else v)
+            for k, v in row.items()}
+
+
+def services_snapshot(
+        service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Every service (or just one) with its replica rows — the same
+    truth `sky serve status` prints, as JSON."""
+    records = serve_state.get_services() if service_name is None else \
+        [r for r in [serve_state.get_service(service_name)]
+         if r is not None]
+    out = []
+    for rec in records:
+        replicas = [_jsonable(r)
+                    for r in serve_state.get_replicas(rec['name'])]
+        entry = _jsonable(rec)
+        entry.pop('spec_yaml', None)  # bulky; API serves the summary
+        entry['endpoint'] = serve_utils.get_endpoint(rec)
+        entry['replicas'] = replicas
+        entry['n_ready'] = sum(1 for r in replicas
+                               if r['status'] == 'READY')
+        out.append(entry)
+    return out
+
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>SkyServe services</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2em; color: #222; }}
+ table {{ border-collapse: collapse; width: 100%; margin-bottom: 1.5em; }}
+ th, td {{ text-align: left; padding: 6px 10px;
+           border-bottom: 1px solid #ddd; font-size: 14px; }}
+ th {{ background: #f5f5f5; }}
+ .READY {{ color: #1a7f37; }} .STARTING, .PROVISIONING,
+ .REPLICA_INIT, .PENDING {{ color: #9a6700; }}
+ .FAILED, .PREEMPTED, .SHUTTING_DOWN {{ color: #cf222e; }}
+ .NO_REPLICA, .NOT_READY {{ color: #6e7781; }}
+ #meta {{ color: #6e7781; font-size: 13px; margin-bottom: 1em; }}
+ h3 {{ margin-bottom: 4px; }}
+</style></head>
+<body>
+<h2>SkyServe services</h2>
+<div id="meta">auto-refreshing every 5s</div>
+<div id="services">{body}</div>
+<script>
+// Service/replica fields are user-controlled (names, endpoints):
+// build nodes with textContent, never innerHTML.
+function cell(text, cls) {{
+  const td = document.createElement('td');
+  td.textContent = text;
+  if (cls) td.className = cls;
+  return td;
+}}
+function table(headers, rows) {{
+  const t = document.createElement('table');
+  const tr = document.createElement('tr');
+  headers.forEach(h => {{
+    const th = document.createElement('th'); th.textContent = h;
+    tr.append(th);
+  }});
+  t.createTHead().append(tr);
+  const tb = t.createTBody();
+  rows.forEach(r => tb.append(r));
+  return t;
+}}
+async function refresh() {{
+  try {{
+    const r = await fetch('/api/services');
+    const svcs = await r.json();
+    const root = document.querySelector('#services');
+    root.replaceChildren(...svcs.flatMap(s => {{
+      const h = document.createElement('h3');
+      h.textContent = s.name + ' — ' + s.status + ' (' + s.n_ready +
+        ' ready) · ' + (s.endpoint ?? '');
+      const rows = s.replicas.map(rep => {{
+        const tr = document.createElement('tr');
+        tr.append(cell(rep.replica_id), cell(rep.cluster_name ?? '-'),
+                  cell(rep.version ?? '-'),
+                  cell(rep.endpoint ?? '-'),
+                  cell(rep.status,
+                       /^[A-Z_]+$/.test(rep.status) ? rep.status : ''),
+                  cell(rep.consecutive_failures ?? 0));
+        return tr;
+      }});
+      return [h, table(['ID', 'Cluster', 'Version', 'Endpoint',
+                        'Status', '#Failures'], rows)];
+    }}));
+    document.querySelector('#meta').textContent =
+      svcs.length + ' services · refreshed ' +
+      new Date().toLocaleTimeString();
+  }} catch (e) {{ /* controller restarting; retry next tick */ }}
+}}
+refresh(); setInterval(refresh, 5000);
+</script>
+</body></html>
+"""
+
+
+def render_index(service_name: Optional[str] = None) -> str:
+    """Server-side first paint (JS keeps it fresh afterwards)."""
+    parts = []
+    for svc in services_snapshot(service_name):
+        parts.append(
+            f'<h3>{html.escape(str(svc["name"]))} — '
+            f'{html.escape(str(svc["status"]))} '
+            f'({svc["n_ready"]} ready) · '
+            f'{html.escape(str(svc.get("endpoint") or ""))}</h3>')
+        rows = []
+        for rep in svc['replicas']:
+            status = str(rep['status'])
+            rows.append('<tr>' + ''.join(
+                f'<td{cls}>{html.escape(str(v))}</td>'
+                for v, cls in [
+                    (rep['replica_id'], ''),
+                    (rep.get('cluster_name') or '-', ''),
+                    (rep.get('version') or '-', ''),
+                    (rep.get('endpoint') or '-', ''),
+                    (status, f' class="{status}"'),
+                    (rep.get('consecutive_failures') or 0, ''),
+                ]) + '</tr>')
+        parts.append(
+            '<table><tr><th>ID</th><th>Cluster</th><th>Version</th>'
+            '<th>Endpoint</th><th>Status</th><th>#Failures</th></tr>'
+            + ''.join(rows) + '</table>')
+    return _PAGE.format(body=''.join(parts))
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        logger.debug('serve-dashboard: ' + fmt % args)
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header('Content-Type', ctype)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
+        path = self.path.split('?', 1)[0].rstrip('/') or '/'
+        try:
+            if path == '/':
+                self._send(200, render_index().encode(), 'text/html')
+            elif path == '/healthz':
+                self._send(200, b'{"ok": true}', 'application/json')
+            elif path == '/api/services':
+                self._send(200,
+                           json.dumps(services_snapshot()).encode(),
+                           'application/json')
+            else:
+                self._send(404, b'{"error": "not found"}',
+                           'application/json')
+        except OSError:
+            pass  # client went away mid-write
+
+
+def start(host: str = '127.0.0.1',
+          port: int = DEFAULT_PORT
+          ) -> Tuple[http.server.ThreadingHTTPServer, threading.Thread]:
+    """Standalone dashboard (all services) in a daemon thread; callers
+    own shutdown.  port=0 binds ephemeral (tests)."""
+    server = http.server.ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever,
+                              name='serve-dashboard', daemon=True)
+    thread.start()
+    logger.info('Serve dashboard at http://%s:%d',
+                host, server.server_address[1])
+    return server, thread
+
+
+def serve_forever(host: str = '127.0.0.1',
+                  port: int = DEFAULT_PORT) -> None:
+    server, thread = start(host, port)
+    try:
+        thread.join()
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+if __name__ == '__main__':
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    args = parser.parse_args()
+    serve_forever(args.host, args.port)

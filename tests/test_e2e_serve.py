@@ -285,5 +285,19 @@ class TestInferenceServerE2E:
                         for e in events
                         if e['choices'][0]['finish_reason']]
             assert len(finishes) == 1
+
+            # Controller-mounted dashboard snapshot (browsable
+            # `sky serve status` analog; beats the reference, which
+            # ships only a jobs dashboard).
+            from skypilot_tpu.serve import serve_state
+            rec = serve_state.get_service(name)
+            ctrl = f'http://127.0.0.1:{rec["controller_port"]}'
+            with urllib.request.urlopen(f'{ctrl}/api/services',
+                                        timeout=30) as resp:
+                (svc,) = json.loads(resp.read())
+            assert svc['name'] == name and svc['n_ready'] >= 1
+            with urllib.request.urlopen(f'{ctrl}/services',
+                                        timeout=30) as resp:
+                assert 'SkyServe services' in resp.read().decode()
         finally:
             serve_core.down(name)

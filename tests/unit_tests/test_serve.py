@@ -405,3 +405,72 @@ class TestScaleToZero:
         with pytest.raises(Exception):
             lb._sync_once()  # controller unreachable
         assert len(lb.aggregator.drain()) == 1  # requeued, not lost
+
+
+class TestServeDashboard:
+    """Serve status dashboard (beats the reference: it ships only a
+    jobs dashboard).  Snapshot correctness + live HTTP routes."""
+
+    def _seed(self, name='dash-svc'):
+        serve_state.remove_service(name)
+        serve_state.add_service(name, 'spec: {}', '/t.yaml', 20011,
+                                30011, 'round_robin', 'local')
+        serve_state.add_replica(name, 1, f'{name}-1', is_spot=False,
+                                version=1)
+        serve_state.set_replica_status(
+            name, 1, serve_state.ReplicaStatus.READY)
+        serve_state.set_replica_endpoint(
+            name, 1, 'http://127.0.0.1:40001')
+        serve_state.add_replica(name, 2, f'{name}-2', is_spot=True,
+                                version=1)
+        return name
+
+    def test_snapshot_shape(self):
+        from skypilot_tpu.serve import dashboard
+        name = self._seed()
+        try:
+            (svc,) = dashboard.services_snapshot(name)
+            assert svc['name'] == name
+            assert svc['n_ready'] == 1
+            assert len(svc['replicas']) == 2
+            assert svc['replicas'][0]['status'] == 'READY'
+            assert 'spec_yaml' not in svc  # bulky field dropped
+            assert svc['endpoint']
+            # Everything JSON-serializable (enums flattened).
+            import json as json_mod
+            json_mod.dumps(svc)
+        finally:
+            serve_state.remove_service(name)
+
+    def test_render_escapes_user_strings(self):
+        from skypilot_tpu.serve import dashboard
+        name = self._seed('dash-<svc>')
+        try:
+            page = dashboard.render_index(name)
+            assert '<script>alert' not in page
+            assert 'dash-&lt;svc&gt;' in page
+        finally:
+            serve_state.remove_service(name)
+
+    def test_http_routes(self):
+        import json as json_mod
+        import urllib.request
+        from skypilot_tpu.serve import dashboard
+        name = self._seed()
+        server, _thread = dashboard.start(port=0)
+        base = f'http://127.0.0.1:{server.server_address[1]}'
+        try:
+            with urllib.request.urlopen(f'{base}/healthz',
+                                        timeout=10) as r:
+                assert json_mod.load(r)['ok'] is True
+            with urllib.request.urlopen(f'{base}/api/services',
+                                        timeout=10) as r:
+                svcs = json_mod.load(r)
+            assert any(s['name'] == name for s in svcs)
+            with urllib.request.urlopen(base, timeout=10) as r:
+                page = r.read().decode()
+            assert 'SkyServe services' in page and name in page
+        finally:
+            server.shutdown()
+            server.server_close()
+            serve_state.remove_service(name)
