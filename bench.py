@@ -193,6 +193,10 @@ _BENCH_OVERRIDES = dict(vocab_size=32768, dim=1536, n_layers=12,
                         n_heads=12, n_kv_heads=4, ffn_dim=6144,
                         remat=True, remat_policy='save_attn')
 _BENCH_BATCH, _BENCH_SEQ = 2, 8192
+# Chunked CE: at seq 8192 x vocab 32768 the full f32 logits are
+# ~2.1 GB — the single biggest buffer in the step; the chunked head
+# (trainer.loss_fn_chunked) caps it at [B, 1024, V].
+_BENCH_LOSS_CHUNK = 1024
 # CPU smoke shapes (shared by --quick/--direct and SKYTPU_BENCH_TINY=1
 # e2e so their numbers stay comparable).
 _TINY_OVERRIDES = dict(vocab_size=2048, dim=256, n_layers=2, n_heads=4,
@@ -305,14 +309,16 @@ def run_direct(quick: bool, steps_arg) -> None:
         overrides = dict(_BENCH_OVERRIDES, max_seq_len=_BENCH_SEQ)
         batch, seq = _BENCH_BATCH, _BENCH_SEQ
         steps = steps_arg or 12
+        loss_chunk = _BENCH_LOSS_CHUNK
     else:
         overrides = dict(_TINY_OVERRIDES, max_seq_len=_TINY_SEQ)
         batch, seq = _TINY_BATCH, _TINY_SEQ
         steps = steps_arg or 4
+        loss_chunk = 0
     config = trainer_lib.TrainConfig(
         model='llama-tiny', global_batch_size=batch, seq_len=seq,
         total_steps=steps + 1, mesh=mesh_lib.MeshConfig(data=1, fsdp=-1),
-        model_overrides=overrides)
+        model_overrides=overrides, loss_chunk=loss_chunk)
     trainer = trainer_lib.Trainer(config)
     trainer.init_state()
     n_params = llama.num_params(trainer.model_config)
@@ -394,9 +400,11 @@ def run_through_launch(steps_arg, deadline_s=None) -> None:
     if os.environ.get('SKYTPU_BENCH_TINY') == '1':
         overrides = dict(_TINY_OVERRIDES)
         batch, seq = _TINY_BATCH, _TINY_SEQ
+        loss_chunk = 0
     else:
         overrides, batch, seq = (dict(_BENCH_OVERRIDES), _BENCH_BATCH,
                                  _BENCH_SEQ)
+        loss_chunk = _BENCH_LOSS_CHUNK
     overrides_json = json.dumps(overrides)
     # --log-every 1: each window device_gets (real sync on the
     # tunneled backend) and the metrics line reports the LAST window —
@@ -411,6 +419,7 @@ def run_through_launch(steps_arg, deadline_s=None) -> None:
         f'python3 -m skypilot_tpu.train --model llama-tiny '
         f'--steps {steps + 1} --global-batch-size {batch} '
         f'--seq-len {seq} --log-every 1 '
+        f'--loss-chunk {loss_chunk} '
         f'--compilation-cache-dir {compile_cache} '
         f"--model-overrides '{overrides_json}' --json-metrics")
     task = sky.Task(run=run_cmd,

@@ -508,7 +508,8 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
-                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
+                 kv_mask: Optional[jax.Array] = None,
+                 return_hidden: bool = False) -> jax.Array:
         cfg = self.config
         if positions is None:
             positions = default_positions(tokens)
@@ -523,13 +524,21 @@ class Llama(nn.Module):
         x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                     name='final_norm')(x)
         # Tied-untied: separate output head (Llama3 unties embeddings).
-        logits = nn.DenseGeneral(
+        head = nn.DenseGeneral(
             cfg.vocab_size, use_bias=False, name='lm_head',
             dtype=jnp.float32, param_dtype=cfg.param_dtype,
             kernel_init=_partitioned_init(nn.initializers.normal(0.02),
                                           ('embed_fsdp', 'vocab'),
-                                          cfg.partition_params))(x)
-        return logits
+                                          cfg.partition_params))
+        if return_hidden:
+            # Chunked-loss path (train/trainer.py chunked CE): the
+            # caller applies the head per sequence chunk so the full
+            # [B, S, vocab] f32 logits never materialize.  The head
+            # must still be CREATED here (1-token apply, discarded) so
+            # the param tree is identical either way.
+            _ = head(x[:, :1])
+            return x
+        return head(x)
 
 
 def num_params(config: LlamaConfig) -> int:

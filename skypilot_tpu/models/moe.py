@@ -230,7 +230,7 @@ class Mixtral(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array, positions=None,
-                 kv_mask=None) -> jax.Array:
+                 kv_mask=None, return_hidden: bool = False) -> jax.Array:
         cfg = self.config
         if positions is None:
             positions = llama.default_positions(tokens)
@@ -265,13 +265,18 @@ class Mixtral(nn.Module):
                                                       kv_mask)
         x = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                           name='final_norm')(x)
-        logits = nn.DenseGeneral(
+        head = nn.DenseGeneral(
             cfg.vocab_size, use_bias=False, name='lm_head',
             dtype=jnp.float32, param_dtype=cfg.param_dtype,
             kernel_init=llama._partitioned_init(  # pylint: disable=protected-access
                 nn.initializers.normal(0.02), ('embed_fsdp', 'vocab'),
-                cfg.partition_params))(x)
-        return logits
+                cfg.partition_params))
+        if return_hidden:
+            # Chunked-CE path; see models/llama.py — the head params
+            # must exist either way.
+            _ = head(x[:, :1])
+            return x
+        return head(x)
 
 
 def num_params(config: MoEConfig) -> int:

@@ -40,6 +40,11 @@ def main() -> None:
                         help='Print final metrics as one JSON line '
                              '(adds params/device info for benchmark '
                              'normalization).')
+    parser.add_argument('--loss-chunk', type=int, default=0,
+                        help='Chunked cross-entropy: apply the lm_head '
+                             'per this many sequence tokens so the '
+                             'full [B,S,vocab] f32 logits never '
+                             'materialize (0 = off; llama/mixtral).')
     parser.add_argument('--train-only', default=None,
                         help='Train only params whose path contains '
                              "this substring (e.g. 'lora'); the rest "
@@ -92,6 +97,7 @@ def main() -> None:
         model_overrides=overrides,
         train_only=args.train_only,
         compilation_cache_dir=args.compilation_cache_dir,
+        loss_chunk=args.loss_chunk,
     )
     trainer = trainer_lib.Trainer(config)
     manager = None

@@ -65,6 +65,11 @@ class TrainConfig:
     # Point it at the bucket-mounted checkpoint dir and preempted
     # managed jobs recover straight into a cached executable.
     compilation_cache_dir: Optional[str] = None
+    # Chunked cross-entropy: apply the lm_head per `loss_chunk` tokens
+    # of sequence (scan + remat) so the [B, S, vocab] f32 logits never
+    # materialize — at long seq x large vocab they are the biggest
+    # buffer in the step.  0 = off.  Requires llama/mixtral families.
+    loss_chunk: int = 0
     seed: int = 0
 
 
@@ -139,10 +144,66 @@ def loss_fn(params, apply_fn, batch) -> Tuple[jax.Array, Dict[str, Any]]:
                   'tokens': total_weight, 'aux_loss': aux_loss}
 
 
+def _chunked_ce_sums(hidden: jax.Array, kernel: jax.Array,
+                     targets: jax.Array, mask: jax.Array,
+                     chunk: int) -> Tuple[jax.Array, jax.Array]:
+    """Masked CE sum + correct-prediction sum, lm_head applied per
+    sequence chunk under jax.checkpoint, so at most [B, chunk, vocab]
+    f32 logits are live at once (forward AND backward) instead of the
+    full [B, S, vocab].  At long seq x large vocab the full logits are
+    the single biggest buffer in the step — e.g. seq 8192, vocab 32k,
+    batch 2: ~2.1 GB f32 that this scan never materializes."""
+    b, s, d = hidden.shape
+    assert s % chunk == 0, (s, chunk)
+    n = s // chunk
+    h = hidden.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
+    t = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+    m = mask.reshape(b, n, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        h_c, t_c, m_c = xs
+        # Mirrors the model head exactly: DenseGeneral dtype=f32
+        # promotes input and kernel to f32 before the matmul.
+        logits = jnp.einsum('bcd,dv->bcv', h_c.astype(jnp.float32),
+                            kernel.astype(jnp.float32))
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits,
+                                                             t_c)
+        correct = ((jnp.argmax(logits, -1) == t_c) * m_c).sum()
+        return (carry[0] + (ce * m_c).sum(),
+                carry[1] + correct.astype(jnp.float32)), None
+
+    (ce_sum, correct), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (h, t, m))
+    return ce_sum, correct
+
+
+def loss_fn_chunked(params, apply_fn, batch, *,
+                    chunk: int) -> Tuple[jax.Array, Dict[str, Any]]:
+    """loss_fn for models exposing `return_hidden` (llama, mixtral):
+    identical math, head applied chunk-by-chunk."""
+    hidden, aux_loss = apply_fn({'params': params}, batch['inputs'],
+                                return_hidden=True)
+    kernel = params['lm_head']['kernel']
+    targets = batch['targets']
+    mask = batch['mask']
+    total_weight = jnp.maximum(mask.sum(), 1.0)
+    ce_sum, correct = _chunked_ce_sums(hidden, kernel, targets, mask,
+                                       chunk)
+    ce_loss = ce_sum / total_weight
+    loss = ce_loss + aux_loss
+    return loss, {'loss': ce_loss, 'accuracy': correct / total_weight,
+                  'tokens': total_weight, 'aux_loss': aux_loss}
+
+
 def train_step(state: TrainState, batch: Dict[str, jax.Array],
                grad_accum_steps: int = 1,
-               train_only: Optional[str] = None
+               train_only: Optional[str] = None,
+               loss_chunk: int = 0
                ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+    base_loss_fn = (functools.partial(loss_fn_chunked, chunk=loss_chunk)
+                    if loss_chunk else loss_fn)
     if train_only:
         # stop_gradient on frozen params: XLA then DCEs their weight-
         # gradient matmuls and buffers (LoRA's memory/FLOPs win), and
@@ -154,11 +215,11 @@ def train_step(state: TrainState, batch: Dict[str, jax.Array],
                 lambda p, trainable: p if trainable
                 else jax.lax.stop_gradient(p),
                 params, freeze_mask)
-            return loss_fn(mixed, apply_fn, batch)
+            return base_loss_fn(mixed, apply_fn, batch)
 
         grad_fn = jax.value_and_grad(loss_with_frozen, has_aux=True)
     else:
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        grad_fn = jax.value_and_grad(base_loss_fn, has_aux=True)
 
     if grad_accum_steps == 1:
         (_, metrics), grads = grad_fn(state.params, state.apply_fn, batch)
@@ -233,6 +294,24 @@ class Trainer:
             raise ValueError(
                 f'context={n_context} must divide seq_len='
                 f'{config.seq_len}.')
+        if config.loss_chunk:
+            from skypilot_tpu.models import llama as llama_lib
+            from skypilot_tpu.models import moe as moe_lib
+            if not isinstance(self.model,
+                              (llama_lib.Llama, moe_lib.Mixtral)):
+                raise ValueError(
+                    'loss_chunk requires a model exposing '
+                    'return_hidden (llama/mixtral families); '
+                    f'{config.model!r} does not.')
+            if config.seq_len % config.loss_chunk:
+                raise ValueError(
+                    f'loss_chunk={config.loss_chunk} must divide '
+                    f'seq_len={config.seq_len}.')
+            if self.mesh.shape['pipe'] > 1:
+                raise ValueError(
+                    'loss_chunk does not yet compose with pipeline '
+                    'parallelism (the PP path applies the head per '
+                    'microbatch already).')
         n_pipe = self.mesh.shape['pipe']
         if n_pipe > 1:
             if hasattr(self.model_config, 'n_experts'):
@@ -319,18 +398,22 @@ class Trainer:
             apply_fn=self._apply_unboxed, tx=self.tx)
         return self.state
 
-    def _apply_unboxed(self, variables, tokens):
-        """Returns (logits, aux_loss)."""
+    def _apply_unboxed(self, variables, tokens, return_hidden=False):
+        """Returns (logits_or_hidden, aux_loss)."""
         if self.pp_microbatches:
+            assert not return_hidden  # rejected in __init__
             return (self._pipelined_apply(variables['params'], tokens),
                     jnp.zeros((), jnp.float32))
+        # Only pass the kwarg when set: model families without a
+        # chunked-loss path (gemma/gpt2/qwen tied heads) don't take it.
+        kwargs = {'return_hidden': True} if return_hidden else {}
         if hasattr(self.model_config, 'n_experts'):
             # MoE: collect the sown router load-balance losses.
-            logits, mutated = self.model.apply(
-                variables, tokens, mutable=['intermediates'])
-            return logits, sum_aux_losses(mutated)
-        return self.model.apply(variables, tokens), \
-            jnp.zeros((), jnp.float32)
+            out, mutated = self.model.apply(
+                variables, tokens, mutable=['intermediates'], **kwargs)
+            return out, sum_aux_losses(mutated)
+        return (self.model.apply(variables, tokens, **kwargs),
+                jnp.zeros((), jnp.float32))
 
     def _pipelined_apply(self, params, tokens):
         """Forward with the decoder blocks run as a GPipe pipeline over
@@ -408,7 +491,8 @@ class Trainer:
                 functools.partial(
                     train_step,
                     grad_accum_steps=self.config.grad_accum_steps,
-                    train_only=self.config.train_only),
+                    train_only=self.config.train_only,
+                    loss_chunk=self.config.loss_chunk),
                 in_shardings=(self.state_shardings, batch_sharding),
                 out_shardings=(self.state_shardings, None),
                 donate_argnums=(0,),
