@@ -33,8 +33,9 @@ from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
 
+# vocab >= 259: the byte tokenizer's id space must fit (stream mode).
 _TINY_OVERRIDES = {'n_heads': 4, 'n_kv_heads': 2, 'n_layers': 2,
-                   'dim': 64, 'ffn_dim': 128, 'vocab_size': 256,
+                   'dim': 64, 'ffn_dim': 128, 'vocab_size': 512,
                    'max_seq_len': 256}
 
 
@@ -79,6 +80,118 @@ def _one_request(base_url: str, prompt: List[int],
         headers={'Content-Type': 'application/json'})
     with urllib.request.urlopen(req, timeout=600) as r:
         return len(json.load(r)['tokens'][0])
+
+
+def _one_sse_request(base_url: str, prompt: str, max_tokens: int
+                     ) -> Dict[str, Any]:
+    """One streamed /v1/completions request; returns timing facts:
+    ttft (request start -> first content event) and per-event gaps."""
+    req = urllib.request.Request(
+        base_url + '/v1/completions',
+        data=json.dumps({'prompt': prompt, 'max_tokens': max_tokens,
+                         'temperature': 0.0,
+                         'stream': True}).encode(),
+        headers={'Content-Type': 'application/json'})
+    t0 = time.time()
+    events = 0
+    ttft = None
+    gaps: List[float] = []
+    last = None
+    done = False
+    with urllib.request.urlopen(req, timeout=600) as resp:
+        buf = b''
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            now = time.time()
+            buf += chunk
+            while b'\n\n' in buf:
+                event, buf = buf.split(b'\n\n', 1)
+                if not event.startswith(b'data: '):
+                    continue
+                data = event[len(b'data: '):]
+                if data == b'[DONE]':
+                    done = True
+                    continue
+                parsed = json.loads(data)
+                if not parsed['choices'][0].get('text'):
+                    continue  # finish chunk carries no content
+                events += 1
+                if ttft is None:
+                    ttft = now - t0
+                elif last is not None:
+                    gaps.append(now - last)
+                last = now
+    if not done:
+        raise RuntimeError('SSE stream ended without [DONE]')
+    return {'events': events, 'ttft': ttft, 'gaps': gaps,
+            'wall': time.time() - t0}
+
+
+def run_stream_level(base_url: str, concurrency: int,
+                     requests_per_stream: int,
+                     max_new_tokens: int) -> dict:
+    """Streaming latency level: TTFT and inter-token gap percentiles
+    through LB -> replica -> engine SSE — the numbers a chat UI feels
+    (the reference delegates these to vLLM's OpenAI benchmark)."""
+    ttfts: List[float] = []
+    gaps: List[float] = []
+    errors: List[str] = []
+    events = [0] * concurrency
+    lock = threading.Lock()
+
+    def _stream(idx: int) -> None:
+        for r in range(requests_per_stream):
+            prompt = f'stream {idx} request {r} ' + 'x' * 8
+            try:
+                facts = _one_sse_request(base_url, prompt,
+                                         max_new_tokens)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(repr(e))
+                continue
+            with lock:
+                events[idx] += facts['events']
+                if facts['ttft'] is not None:
+                    ttfts.append(facts['ttft'])
+                gaps.extend(facts['gaps'])
+
+    threads = [threading.Thread(target=_stream, args=(i,))
+               for i in range(concurrency)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    if not ttfts:
+        raise RuntimeError(
+            f'every streamed request failed at c{concurrency}: '
+            f'{errors[:3]}')
+
+    def _pct(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1,
+                              int(q * len(vals)))], 4)
+
+    return {
+        'metric': f'serving stream ttft @c{concurrency}',
+        'value': _pct(ttfts, 0.5),
+        'unit': 's',
+        'concurrency': concurrency,
+        'requests': concurrency * requests_per_stream,
+        'p50_ttft_s': _pct(ttfts, 0.5),
+        'p90_ttft_s': _pct(ttfts, 0.9),
+        'p50_itl_ms': (round(_pct(gaps, 0.5) * 1000, 2)
+                       if gaps else None),
+        'p90_itl_ms': (round(_pct(gaps, 0.9) * 1000, 2)
+                       if gaps else None),
+        'stream_tokens_per_s': round(sum(events) / wall, 2),
+        'failed_requests': len(errors),
+    }
 
 
 def run_level(base_url: str, concurrency: int, requests_per_stream: int,
@@ -158,6 +271,10 @@ def main() -> None:
                         help="Force a jax platform (e.g. 'cpu' for the "
                              'smoke run; env JAX_PLATFORMS alone is '
                              'not enough on tunneled-TPU hosts).')
+    parser.add_argument('--streaming', action='store_true',
+                        default=False,
+                        help='Also measure TTFT / inter-token latency '
+                             'per level through the OpenAI SSE path.')
     args = parser.parse_args()
     overrides = (json.loads(args.model_overrides)
                  if args.model_overrides else dict(_TINY_OVERRIDES))
@@ -178,6 +295,10 @@ def main() -> None:
                 args.prompt_len, args.max_new_tokens,
                 srv.engine.config.vocab_size, args.continuous)
             print(json.dumps(result), flush=True)
+            if args.streaming:
+                print(json.dumps(run_stream_level(
+                    lb_url, level, args.requests_per_stream,
+                    args.max_new_tokens)), flush=True)
     finally:
         lb.stop()
         srv.shutdown()

@@ -1,0 +1,38 @@
+"""Serving benchmark harness smoke: throughput + streaming-latency
+levels run hermetically through LB -> replica -> engine."""
+import jax.numpy as jnp
+
+from skypilot_tpu.benchmark import serving as serving_bench
+
+_OVERRIDES = {'n_heads': 4, 'n_kv_heads': 2, 'n_layers': 2,
+              'dim': 64, 'ffn_dim': 128, 'vocab_size': 512,
+              'max_seq_len': 128, 'dtype': jnp.float32,
+              'param_dtype': jnp.float32}
+
+
+def test_run_level_and_stream_level():
+    srv = serving_bench._start_replica(  # pylint: disable=protected-access
+        'llama-tiny', slots=2, continuous=True, max_seq_len=128,
+        overrides=dict(_OVERRIDES))
+    lb, lb_url = serving_bench._start_lb(  # pylint: disable=protected-access
+        f'http://127.0.0.1:{srv.port}')
+    try:
+        serving_bench._one_request(lb_url, [1, 2, 3], 2)  # warm
+        result = serving_bench.run_level(
+            lb_url, concurrency=2, requests_per_stream=2,
+            prompt_len=8, max_new_tokens=4, vocab=512,
+            continuous=True)
+        assert result['total_tokens'] == 2 * 2 * 4
+        assert result['value'] > 0
+        assert result['failed_requests'] == 0
+
+        stream = serving_bench.run_stream_level(
+            lb_url, concurrency=2, requests_per_stream=2,
+            max_new_tokens=4)
+        assert stream['p50_ttft_s'] is not None
+        assert stream['p50_ttft_s'] > 0
+        assert stream['stream_tokens_per_s'] > 0
+        assert stream['failed_requests'] == 0
+    finally:
+        lb.stop()
+        srv.shutdown()
