@@ -52,6 +52,12 @@ class LlamaConfig:
     # whenever HBM allows).
     remat_policy: str = 'nothing'
     attention_impl: str = 'flash'   # flash | ring | reference
+    # Sliding-window attention (Mistral-style): each token attends to
+    # its last `sliding_window` positions (inclusive).  None = full
+    # causal.  Applies to training (flash/reference) AND the decode
+    # cache paths; not yet composable with ring/ulysses context
+    # parallelism.
+    sliding_window: Optional[int] = None
     # Autoregressive serving mode: attention keeps a KV cache in the
     # 'cache' variable collection (infer/engine.py drives it).
     decode: bool = False
@@ -93,6 +99,13 @@ CONFIGS: Dict[str, LlamaConfig] = {
                              n_layers=32, n_heads=32, n_kv_heads=32,
                              ffn_dim=11008, rope_theta=10000.0,
                              max_seq_len=4096),
+    # Mistral = Llama arch + sliding-window attention (window 4096),
+    # which is what makes its 32k context affordable: attention
+    # compute/KV reads are O(S*W) not O(S^2).
+    'mistral-7b': LlamaConfig('mistral-7b', vocab_size=32000, dim=4096,
+                              n_layers=32, n_heads=32, n_kv_heads=8,
+                              ffn_dim=14336, rope_theta=10000.0,
+                              max_seq_len=32768, sliding_window=4096),
 }
 
 
@@ -259,7 +272,8 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
                          v: jax.Array,
                          kv_mask: Optional[jax.Array], *,
                          n_kv_heads: int, max_seq_len: int,
-                         dtype: Any) -> jax.Array:
+                         dtype: Any,
+                         window: Optional[int] = None) -> jax.Array:
     """Attention against the KV cache (serving) — shared by every
     family (Llama/Gemma via llama.Attention, GPT-2's MHA).
 
@@ -300,7 +314,15 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
         cached_v.value = cached_v.value.at[
             brange, :, write_pos, :].set(v[:, :, 0, :].astype(dtype))
         cursor.value = idx + 1
-        mask = kv_mask[:, None, None, :]
+        visible = kv_mask
+        if window is not None:
+            # A row's slots are its tokens in order, so windowing by
+            # slot index relative to the newest (write) slot matches
+            # training's position window exactly.
+            visible = visible & (
+                jnp.arange(max_len)[None, :] >=
+                write_pos[:, None] - window + 1)
+        mask = visible[:, None, None, :]
         # Static read-window over the live prefix of the cache (see
         # kv_read_bucket) — everything past it is unrevealed for
         # active rows, so slicing keys/values/mask is exact.  The
@@ -318,7 +340,10 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
             cached_v.value, v.astype(dtype), (0, 0, idx, 0))
         cursor.value = idx + s
         slots = jnp.arange(max_len)
-        causal = slots[None, :] <= (idx + jnp.arange(s))[:, None]
+        rows = idx + jnp.arange(s)
+        causal = slots[None, :] <= rows[:, None]
+        if window is not None:
+            causal &= slots[None, :] >= rows[:, None] - window + 1
         mask = causal[None, None]                  # [1,1,s,max]
         if kv_mask is not None:
             mask = mask & kv_mask[:, None, None, :]
@@ -382,14 +407,23 @@ class Attention(nn.Module):
         if kv != h:  # GQA: broadcast kv heads to query heads
             k = jnp.repeat(k, h // kv, axis=1)
             v = jnp.repeat(v, h // kv, axis=1)
+        # Duck-typed families (Gemma/Qwen share this module)
+        # may not declare the field.
+        window = getattr(cfg, 'sliding_window', None)
         if cfg.attention_impl == 'flash':
-            out = fa.flash_attention(q, k, v)
+            out = fa.flash_attention(q, k, v, None, True,
+                                     fa.DEFAULT_BLOCK_Q,
+                                     fa.DEFAULT_BLOCK_KV, window)
         elif cfg.attention_impl in ('ring', 'ulysses'):
+            if window is not None:
+                raise ValueError(
+                    'sliding_window does not yet compose with '
+                    f'{cfg.attention_impl} context parallelism.')
             from skypilot_tpu.ops import ring_attention
             out = ring_attention.context_parallel_attention(
                 q, k, v, impl=cfg.attention_impl)
         else:
-            out = fa.mha_reference(q, k, v)
+            out = fa.mha_reference(q, k, v, window=window)
         # Named so remat_policy='save_attn' can keep it (skipping the
         # O(s^2) recompute in the backward pass).
         out = checkpoint_name(out, 'attn_out')
@@ -409,7 +443,10 @@ class Attention(nn.Module):
         return run_cached_attention(self, q, k, v, kv_mask,
                                     n_kv_heads=cfg.n_kv_heads,
                                     max_seq_len=cfg.max_seq_len,
-                                    dtype=cfg.dtype)
+                                    dtype=cfg.dtype,
+                                    window=getattr(
+                                        cfg, 'sliding_window',
+                                        None))
 
 
 class MLP(nn.Module):

@@ -48,7 +48,8 @@ FORCE_PALLAS = os.environ.get('SKYTPU_FORCE_PALLAS', '') == '1'
 
 
 def _mha_fwd_xla(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                 scale: float, causal: bool
+                 scale: float, causal: bool,
+                 window: Optional[int] = None
                  ) -> Tuple[jax.Array, jax.Array]:
     """XLA-native (out, lse) forward with the same semantics as the
     pallas kernel (used off-TPU; XLA fuses this fine on CPU)."""
@@ -58,6 +59,11 @@ def _mha_fwd_xla(q: jax.Array, k: jax.Array, v: jax.Array, *,
         seq_q, seq_kv = s.shape[-2:]
         mask = jnp.tril(jnp.ones((seq_q, seq_kv), bool),
                         k=seq_kv - seq_q)
+        if window is not None:
+            # Sliding window: each query attends to its last `window`
+            # positions (inclusive of itself).
+            mask &= ~jnp.tril(jnp.ones((seq_q, seq_kv), bool),
+                              k=seq_kv - seq_q - window)
         s = jnp.where(mask, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
@@ -116,7 +122,8 @@ def _pick_block(seq: int, requested: int, what: str) -> int:
 # ---------------------------------------------------------------------------
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       acc_ref, m_ref, l_ref, *, scale: float,
-                      causal: bool, block_q: int, block_kv: int) -> None:
+                      causal: bool, window: Optional[int],
+                      block_q: int, block_kv: int) -> None:
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -130,9 +137,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     q_start = qi * block_q
     k_start = ki * block_kv
     # Causal: a kv block strictly above the diagonal contributes nothing.
+    # Window: a kv block entirely below every query's window start is
+    # skipped too — this is where sliding-window attention goes from
+    # O(S^2) to O(S*W) compute.
     should_run = True
     if causal:
         should_run = k_start <= q_start + block_q - 1
+        if window is not None:
+            should_run &= \
+                k_start + block_kv - 1 >= q_start - window + 1
 
     @pl.when(should_run)
     def _compute():
@@ -147,7 +160,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 jnp.int32, (block_q, block_kv), 0)
             cols = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            keep = rows >= cols
+            if window is not None:
+                keep &= cols >= rows - window + 1
+            s = jnp.where(keep, s, _NEG_INF)
         m_prev = m_ref[:, :1]                       # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)   # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
@@ -171,7 +187,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float,
-               causal: bool, block_q: int,
+               causal: bool, window: Optional[int], block_q: int,
                block_kv: int) -> Tuple[jax.Array, jax.Array]:
     batch, heads, seq_q, d = q.shape
     seq_kv = k.shape[2]
@@ -183,8 +199,8 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float,
     v3 = v.reshape(bh, seq_kv, d)
     grid = (bh, pl.cdiv(seq_q, block_q), pl.cdiv(seq_kv, block_kv))
     kernel = functools.partial(_flash_fwd_kernel, scale=scale,
-                               causal=causal, block_q=block_q,
-                               block_kv=block_kv)
+                               causal=causal, window=window,
+                               block_q=block_q, block_kv=block_kv)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -221,7 +237,8 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float,
 # ---------------------------------------------------------------------------
 def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     q_start, k_start, *, scale: float, causal: bool,
-                    block_q: int, block_kv: int):
+                    window: Optional[int], block_q: int,
+                    block_kv: int):
     """Shared FA2 recompute for one (q, kv) block pair.
 
     Returns (q, k, do, p, ds) in f32 — everything the dq and dk/dv
@@ -243,7 +260,10 @@ def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             jnp.int32, (block_q, block_kv), 0)
         cols = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1)
-        s = jnp.where(rows >= cols, s, _NEG_INF)
+        keep = rows >= cols
+        if window is not None:
+            keep &= cols >= rows - window + 1
+        s = jnp.where(keep, s, _NEG_INF)
     p = jnp.exp(s - lse)                        # [bq, bkv]
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
@@ -254,7 +274,8 @@ def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, acc_ref, *, scale: float, causal: bool,
-                         block_q: int, block_kv: int) -> None:
+                         window: Optional[int], block_q: int,
+                         block_kv: int) -> None:
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -267,15 +288,19 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_start = ki * block_kv
     should_run = True
     if causal:
-        # kv blocks strictly above the diagonal contribute nothing.
+        # kv blocks strictly above the diagonal contribute nothing;
+        # with a window, blocks entirely below it neither.
         should_run = k_start <= q_start + block_q - 1
+        if window is not None:
+            should_run &= \
+                k_start + block_kv - 1 >= q_start - window + 1
 
     @pl.when(should_run)
     def _compute():
         _, k, _, _, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
-            k_start, scale=scale, causal=causal, block_q=block_q,
-            block_kv=block_kv)
+            k_start, scale=scale, causal=causal, window=window,
+            block_q=block_q, block_kv=block_kv)
         acc_ref[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)     # [bq, d]
@@ -287,8 +312,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                          causal: bool, block_q: int,
-                          block_kv: int) -> None:
+                          causal: bool, window: Optional[int],
+                          block_q: int, block_kv: int) -> None:
     ki = pl.program_id(1)
     qj = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -303,13 +328,16 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     should_run = True
     if causal:
         should_run = q_start + block_q - 1 >= k_start
+        if window is not None:
+            should_run &= \
+                k_start + block_kv - 1 >= q_start - window + 1
 
     @pl.when(should_run)
     def _compute():
         q, _, do, p, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
-            k_start, scale=scale, causal=causal, block_q=block_q,
-            block_kv=block_kv)
+            k_start, scale=scale, causal=causal, window=window,
+            block_q=block_q, block_kv=block_kv)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)     # [bkv, d]
@@ -325,7 +353,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
                       do: jax.Array, lse: jax.Array, delta: jax.Array, *,
-                      scale: float, causal: bool, block_q: int,
+                      scale: float, causal: bool,
+                      window: Optional[int], block_q: int,
                       block_kv: int
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Pallas dq + dk/dv backward. lse/delta are [B,H,S] f32."""
@@ -350,8 +379,8 @@ def _flash_bwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale,
-                          causal=causal, block_q=block_q,
-                          block_kv=block_kv),
+                          causal=causal, window=window,
+                          block_q=block_q, block_kv=block_kv),
         grid=(bh, nq, nk),
         in_specs=[q_spec, kv_q_inner, kv_q_inner, q_spec, row_spec,
                   row_spec],
@@ -368,8 +397,8 @@ def _flash_bwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
     row_inner = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale,
-                          causal=causal, block_q=block_q,
-                          block_kv=block_kv),
+                          causal=causal, window=window,
+                          block_q=block_q, block_kv=block_kv),
         grid=(bh, nk, nq),
         in_specs=[q_inner, kv_spec, kv_spec, q_inner, row_inner,
                   row_inner],
@@ -391,7 +420,7 @@ def _flash_bwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
 # backward (FlashAttention-2 blockwise double-scan, jnp — off-TPU path)
 # ---------------------------------------------------------------------------
 def _flash_bwd_xla(q, k, v, do, lse, delta, *, scale: float, causal: bool,
-                   block_q: int, block_kv: int
+                   window: Optional[int], block_q: int, block_kv: int
                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     batch, heads, seq_q, d = q.shape
     seq_kv = k.shape[2]
@@ -428,7 +457,10 @@ def _flash_bwd_xla(q, k, v, do, lse, delta, *, scale: float, causal: bool,
                     jnp.int32, (block_q, block_kv), 0)
                 cols = ki * block_kv + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_kv), 1)
-                s = jnp.where(rows >= cols, s, _NEG_INF)
+                keep = rows >= cols
+                if window is not None:
+                    keep &= cols >= rows - window + 1
+                s = jnp.where(keep, s, _NEG_INF)
             p = jnp.exp(s - lse_i[..., None])      # [B,H,bq,bkv]
             dp = jnp.einsum('bhqd,bhkd->bhqk', do_i, v_j)
             ds = p * (dp - delta_i[..., None]) * scale
@@ -459,6 +491,7 @@ def _flash_bwd_xla(q, k, v, do, lse, delta, *, scale: float, causal: bool,
 
 
 def _pair_bwd(q, k, v, do, lse, delta, *, scale: float, causal: bool,
+              window: Optional[int] = None,
               block_q: int = DEFAULT_BLOCK_Q,
               block_kv: int = DEFAULT_BLOCK_KV
               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -469,36 +502,56 @@ def _pair_bwd(q, k, v, do, lse, delta, *, scale: float, causal: bool,
     """
     if not _on_tpu() and not FORCE_PALLAS:
         return _flash_bwd_xla(q, k, v, do, lse, delta, scale=scale,
-                              causal=causal, block_q=block_q,
-                              block_kv=block_kv)
+                              causal=causal, window=window,
+                              block_q=block_q, block_kv=block_kv)
     return _flash_bwd_pallas(q, k, v, do, lse, delta, scale=scale,
-                             causal=causal, block_q=block_q,
-                             block_kv=block_kv)
+                             causal=causal, window=window,
+                             block_q=block_q, block_kv=block_kv)
 
 
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     scale: Optional[float] = None, causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_kv: int = DEFAULT_BLOCK_KV) -> jax.Array:
-    """Flash attention over [batch, heads, seq, head_dim] inputs."""
-    out, _ = _fwd_impl(q, k, v, scale, causal, block_q, block_kv)
+                    block_kv: int = DEFAULT_BLOCK_KV,
+                    window: Optional[int] = None) -> jax.Array:
+    """Flash attention over [batch, heads, seq, head_dim] inputs.
+
+    `window`: sliding-window attention (Mistral-style) — each query
+    attends to its last `window` positions including itself.  Blocks
+    wholly outside the band are skipped, so compute is O(S*W) rather
+    than O(S^2)/2.  Requires causal=True and seq_q == seq_kv.
+    """
+    out, _ = _fwd_impl(q, k, v, scale, causal, block_q, block_kv,
+                       window)
     return out
 
 
-def _fwd_impl(q, k, v, scale, causal, block_q, block_kv):
+def _fwd_impl(q, k, v, scale, causal, block_q, block_kv, window=None):
+    if window is not None:
+        if not causal:
+            raise ValueError('window requires causal=True')
+        if q.shape[2] != k.shape[2]:
+            raise ValueError(
+                'window requires seq_q == seq_kv '
+                f'({q.shape[2]} vs {k.shape[2]}).')
+        if window >= q.shape[2]:
+            window = None  # full attention; skip the extra masking
     actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
     if not _on_tpu() and not FORCE_PALLAS:
-        return _mha_fwd_xla(q, k, v, scale=actual_scale, causal=causal)
+        return _mha_fwd_xla(q, k, v, scale=actual_scale, causal=causal,
+                            window=window)
     return _flash_fwd(q, k, v, scale=actual_scale, causal=causal,
-                      block_q=block_q, block_kv=block_kv)
+                      window=window, block_q=block_q,
+                      block_kv=block_kv)
 
 
-def _vjp_fwd(q, k, v, scale, causal, block_q, block_kv):
-    out, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_kv)
+def _vjp_fwd(q, k, v, scale, causal, block_q, block_kv, window=None):
+    out, lse = _fwd_impl(q, k, v, scale, causal, block_q, block_kv,
+                         window)
     # Named residuals: under jax.checkpoint with policy
     # save_only_these_names('attn_out', 'attn_lse') the backward reuses
     # them instead of re-running the forward kernel (q/k/v projections
@@ -508,14 +561,16 @@ def _vjp_fwd(q, k, v, scale, causal, block_q, block_kv):
     return out, (q, k, v, out, lse)
 
 
-def _vjp_bwd(scale, causal, block_q, block_kv, residuals, g):
+def _vjp_bwd(scale, causal, block_q, block_kv, window, residuals, g):
     q, k, v, out, lse = residuals
+    if window is not None and window >= q.shape[2]:
+        window = None  # mirror _fwd_impl's normalization
     actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
     dq, dk, dv = _pair_bwd(q, k, v, g, lse, delta, scale=actual_scale,
-                           causal=causal, block_q=block_q,
-                           block_kv=block_kv)
+                           causal=causal, window=window,
+                           block_q=block_q, block_kv=block_kv)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -524,7 +579,8 @@ flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                   scale: Optional[float] = None,
-                  causal: bool = True) -> jax.Array:
+                  causal: bool = True,
+                  window: Optional[int] = None) -> jax.Array:
     """Plain-jnp attention for correctness tests."""
     actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
@@ -533,6 +589,9 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
         seq_q, seq_kv = s.shape[-2:]
         mask = jnp.tril(jnp.ones((seq_q, seq_kv), bool),
                         k=seq_kv - seq_q)
+        if window is not None:
+            mask &= ~jnp.tril(jnp.ones((seq_q, seq_kv), bool),
+                              k=seq_kv - seq_q - window)
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum('bhqk,bhkd->bhqd', p,
