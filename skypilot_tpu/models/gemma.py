@@ -88,7 +88,8 @@ class Gemma(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
-                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
+                 kv_mask: Optional[jax.Array] = None,
+                 return_hidden: bool = False) -> jax.Array:
         cfg = self.config
         if positions is None:
             positions = llama.default_positions(tokens)
@@ -108,6 +109,10 @@ class Gemma(nn.Module):
         x = llama.apply_blocks(cfg, llama.Block, x, positions, kv_mask)
         x = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                           plus_one=True, name='final_norm')(x)
+        if return_hidden:
+            # Chunked-CE path (train/trainer.py): the head is tied —
+            # no extra params to create.
+            return x
         # Tied head: logits against the embedding matrix (no lm_head
         # params — Gemma ties embeddings; self.param returns the
         # unboxed array).

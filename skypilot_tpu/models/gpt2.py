@@ -172,7 +172,8 @@ class Gpt2(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
-                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
+                 kv_mask: Optional[jax.Array] = None,
+                 return_hidden: bool = False) -> jax.Array:
         cfg = self.config
         if positions is None:
             positions = llama.default_positions(tokens)
@@ -192,6 +193,8 @@ class Gpt2(nn.Module):
         x = llama.apply_blocks(cfg, Gpt2Block, x, positions, kv_mask)
         x = LayerNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                       name='ln_f')(x)
+        if return_hidden:
+            return x  # chunked-CE path; tied head, no params to make
         # Tied lm_head (GPT-2 ties input/output embeddings).
         logits = jnp.einsum('bsd,vd->bsv', x.astype(jnp.float32),
                             embed.astype(jnp.float32))

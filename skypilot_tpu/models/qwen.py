@@ -88,7 +88,8 @@ class Qwen(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
-                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
+                 kv_mask: Optional[jax.Array] = None,
+                 return_hidden: bool = False) -> jax.Array:
         cfg = self.config
         if positions is None:
             positions = llama.default_positions(tokens)
@@ -103,14 +104,20 @@ class Qwen(nn.Module):
         x = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                           name='final_norm')(x)
         if cfg.tie_embeddings:
+            if return_hidden:
+                return x  # tied head, no params to create
             return jnp.einsum('bsd,vd->bsv', x.astype(jnp.float32),
                               embed.astype(jnp.float32))
-        return nn.DenseGeneral(
+        head = nn.DenseGeneral(
             cfg.vocab_size, use_bias=False, name='lm_head',
             dtype=jnp.float32, param_dtype=cfg.param_dtype,
             kernel_init=llama._partitioned_init(  # pylint: disable=protected-access
                 nn.initializers.normal(0.02), ('embed_fsdp', 'vocab'),
-                cfg.partition_params))(x)
+                cfg.partition_params))
+        if return_hidden:
+            _ = head(x[:, :1])  # create params; see models/llama.py
+            return x
+        return head(x)
 
 
 def num_params(config: QwenConfig) -> int:

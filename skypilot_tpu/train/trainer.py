@@ -144,9 +144,21 @@ def loss_fn(params, apply_fn, batch) -> Tuple[jax.Array, Dict[str, Any]]:
                   'tokens': total_weight, 'aux_loss': aux_loss}
 
 
+def _head_projection(params, model_config):
+    """(kernel, einsum spec, softcap) for applying the model's head
+    outside the model: llama/mixtral/untied-qwen expose lm_head
+    [D, V]; the tied families (gemma/gpt2/tied-qwen) reuse tok_embed
+    [V, D] — and gemma additionally softcaps the final logits."""
+    if 'lm_head' in params:
+        return params['lm_head']['kernel'], 'bcd,dv->bcv', None
+    softcap = getattr(model_config, 'final_logit_softcap', None)
+    return params['tok_embed'], 'bcd,vd->bcv', softcap or None
+
+
 def _chunked_ce_sums(hidden: jax.Array, kernel: jax.Array,
                      targets: jax.Array, mask: jax.Array,
-                     chunk: int) -> Tuple[jax.Array, jax.Array]:
+                     chunk: int, head_spec: str = 'bcd,dv->bcv',
+                     softcap=None) -> Tuple[jax.Array, jax.Array]:
     """Masked CE sum + correct-prediction sum, lm_head applied per
     sequence chunk under jax.checkpoint, so at most [B, chunk, vocab]
     f32 logits are live at once (forward AND backward) instead of the
@@ -163,10 +175,12 @@ def _chunked_ce_sums(hidden: jax.Array, kernel: jax.Array,
     @jax.checkpoint
     def body(carry, xs):
         h_c, t_c, m_c = xs
-        # Mirrors the model head exactly: DenseGeneral dtype=f32
-        # promotes input and kernel to f32 before the matmul.
-        logits = jnp.einsum('bcd,dv->bcv', h_c.astype(jnp.float32),
+        # Mirrors the model head exactly: DenseGeneral dtype=f32 (or
+        # the tied-embedding einsum) promotes both operands to f32.
+        logits = jnp.einsum(head_spec, h_c.astype(jnp.float32),
                             kernel.astype(jnp.float32))
+        if softcap:
+            logits = softcap * jnp.tanh(logits / softcap)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits,
                                                              t_c)
         correct = ((jnp.argmax(logits, -1) == t_c) * m_c).sum()
@@ -179,18 +193,19 @@ def _chunked_ce_sums(hidden: jax.Array, kernel: jax.Array,
     return ce_sum, correct
 
 
-def loss_fn_chunked(params, apply_fn, batch, *,
-                    chunk: int) -> Tuple[jax.Array, Dict[str, Any]]:
-    """loss_fn for models exposing `return_hidden` (llama, mixtral):
+def loss_fn_chunked(params, apply_fn, batch, *, chunk: int,
+                    model_config=None
+                    ) -> Tuple[jax.Array, Dict[str, Any]]:
+    """loss_fn for models exposing `return_hidden` (every family):
     identical math, head applied chunk-by-chunk."""
     hidden, aux_loss = apply_fn({'params': params}, batch['inputs'],
                                 return_hidden=True)
-    kernel = params['lm_head']['kernel']
+    kernel, head_spec, softcap = _head_projection(params, model_config)
     targets = batch['targets']
     mask = batch['mask']
     total_weight = jnp.maximum(mask.sum(), 1.0)
     ce_sum, correct = _chunked_ce_sums(hidden, kernel, targets, mask,
-                                       chunk)
+                                       chunk, head_spec, softcap)
     ce_loss = ce_sum / total_weight
     loss = ce_loss + aux_loss
     return loss, {'loss': ce_loss, 'accuracy': correct / total_weight,
@@ -200,9 +215,11 @@ def loss_fn_chunked(params, apply_fn, batch, *,
 def train_step(state: TrainState, batch: Dict[str, jax.Array],
                grad_accum_steps: int = 1,
                train_only: Optional[str] = None,
-               loss_chunk: int = 0
+               loss_chunk: int = 0,
+               model_config=None
                ) -> Tuple[TrainState, Dict[str, jax.Array]]:
-    base_loss_fn = (functools.partial(loss_fn_chunked, chunk=loss_chunk)
+    base_loss_fn = (functools.partial(loss_fn_chunked, chunk=loss_chunk,
+                                      model_config=model_config)
                     if loss_chunk else loss_fn)
     if train_only:
         # stop_gradient on frozen params: XLA then DCEs their weight-
@@ -295,14 +312,13 @@ class Trainer:
                 f'context={n_context} must divide seq_len='
                 f'{config.seq_len}.')
         if config.loss_chunk:
-            from skypilot_tpu.models import llama as llama_lib
-            from skypilot_tpu.models import moe as moe_lib
-            if not isinstance(self.model,
-                              (llama_lib.Llama, moe_lib.Mixtral)):
+            import inspect
+            call_params = inspect.signature(
+                type(self.model).__call__).parameters
+            if 'return_hidden' not in call_params:
                 raise ValueError(
                     'loss_chunk requires a model exposing '
-                    'return_hidden (llama/mixtral families); '
-                    f'{config.model!r} does not.')
+                    f'return_hidden; {config.model!r} does not.')
             if config.seq_len % config.loss_chunk:
                 raise ValueError(
                     f'loss_chunk={config.loss_chunk} must divide '
@@ -404,8 +420,9 @@ class Trainer:
             assert not return_hidden  # rejected in __init__
             return (self._pipelined_apply(variables['params'], tokens),
                     jnp.zeros((), jnp.float32))
-        # Only pass the kwarg when set: model families without a
-        # chunked-loss path (gemma/gpt2/qwen tied heads) don't take it.
+        # Only pass the kwarg when set (keeps third-party models
+        # without a return_hidden parameter working for the normal
+        # logits path).
         kwargs = {'return_hidden': True} if return_hidden else {}
         if hasattr(self.model_config, 'n_experts'):
             # MoE: collect the sown router load-balance losses.
@@ -492,7 +509,8 @@ class Trainer:
                     train_step,
                     grad_accum_steps=self.config.grad_accum_steps,
                     train_only=self.config.train_only,
-                    loss_chunk=self.config.loss_chunk),
+                    loss_chunk=self.config.loss_chunk,
+                    model_config=self.model_config),
                 in_shardings=(self.state_shardings, batch_sharding),
                 out_shardings=(self.state_shardings, None),
                 donate_argnums=(0,),

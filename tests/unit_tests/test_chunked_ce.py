@@ -114,14 +114,41 @@ class TestChunkedCE:
                                    rtol=1e-4)
         assert float(jax.device_get(mb['aux_loss'])) > 0.0
 
-    def test_rejects_unsupported_model(self):
-        config = trainer_lib.TrainConfig(
-            model='gpt2-tiny', global_batch_size=8, seq_len=16,
-            total_steps=3, loss_chunk=4,
-            model_overrides={'n_layers': 2, 'dim': 32,
-                             'n_heads': 4, 'max_seq_len': 64})
-        with pytest.raises(ValueError, match='return_hidden'):
-            trainer_lib.Trainer(config)
+    @pytest.mark.parametrize('model,overrides', [
+        # Tied heads: the chunked path projects against tok_embed.
+        ('gpt2-tiny', {'n_layers': 2, 'dim': 32, 'n_heads': 4,
+                       'max_seq_len': 64, 'vocab_size': 97}),
+        ('gemma-tiny', {'n_layers': 2, 'dim': 32, 'n_heads': 2,
+                        'n_kv_heads': 1, 'head_dim': 16,
+                        'ffn_dim': 64, 'max_seq_len': 64,
+                        'vocab_size': 97,
+                        # Gemma-2 softcap must be replicated in the
+                        # chunked head or logits drift.
+                        'final_logit_softcap': 30.0}),
+        ('qwen-tiny', {'n_layers': 2, 'dim': 32, 'n_heads': 4,
+                       'n_kv_heads': 2, 'ffn_dim': 64,
+                       'max_seq_len': 64, 'vocab_size': 97}),
+    ])
+    def test_tied_head_families_match_naive(self, model, overrides):
+        overrides = {**overrides,
+                     'dtype': jnp.float32, 'param_dtype': jnp.float32}
+        a = trainer_lib.Trainer(trainer_lib.TrainConfig(
+            model=model, global_batch_size=8, seq_len=16,
+            total_steps=3, loss_chunk=0, model_overrides=overrides))
+        a.init_state()
+        b = trainer_lib.Trainer(trainer_lib.TrainConfig(
+            model=model, global_batch_size=8, seq_len=16,
+            total_steps=3, loss_chunk=4, model_overrides=overrides))
+        b.init_state()
+        batch = _batch(a)
+        ma = a.step(batch)
+        mb = b.step(batch)
+        np.testing.assert_allclose(jax.device_get(ma['loss']),
+                                   jax.device_get(mb['loss']),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(jax.device_get(ma['grad_norm']),
+                                   jax.device_get(mb['grad_norm']),
+                                   rtol=1e-4)
 
     def test_rejects_nondividing_chunk(self):
         with pytest.raises(ValueError, match='must divide'):
