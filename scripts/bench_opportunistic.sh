@@ -70,6 +70,9 @@ print('tunnel healthy:', kinds)
       SPACING_S="$RECAPTURE_SPACING_S"
     else
       echo "[$(date -u +%FT%TZ)] bench capture produced no fresh cache (rc=$RC)" >> "$LOG"
+      # Back to the fast cadence: a re-wedged tunnel must be hunted
+      # at probe speed, not at the post-success refresh interval.
+      SPACING_S="$PROBE_SPACING_S"
     fi
   else
     echo "[$(date -u +%FT%TZ)] tunnel still wedged (probe killed/failed)" >> "$LOG"

@@ -304,6 +304,10 @@ class Kubernetes(cloud.Cloud):
             'use_spot': resources.use_spot,
             'labels': resources.labels or {},
             'ports': resources.ports,
+            # How opened ports surface: loadbalancer (default) /
+            # nodeport / podip (in-cluster + port-forward tunnels).
+            'port_mode': config_lib.get_nested(
+                ('kubernetes', 'port_mode'), 'loadbalancer'),
             'image': resources.image_id or config_lib.get_nested(
                 ('kubernetes', 'image'),
                 _DEFAULT_TPU_IMAGE if spec else _DEFAULT_IMAGE),

@@ -190,14 +190,21 @@ def endpoints(cluster_name: str,
     from skypilot_tpu.provision import api as provision_api
     record = _get_record_or_raise(cluster_name)
     handle = record['handle']
-    ports = list(getattr(handle.launched_resources, 'ports', None)
-                 or [])
-    if port is not None:
-        ports = [str(port)]
-    if not ports:
+    from skypilot_tpu.provision import common as provision_common
+    declared = list(getattr(handle.launched_resources, 'ports', None)
+                    or [])
+    if not declared:
         raise exceptions.NotSupportedError(
             f'Cluster {cluster_name!r} has no opened ports; launch '
             f'with `--ports` to expose one.')
+    if port is not None:
+        if port not in provision_common.expand_ports(declared):
+            raise exceptions.NotSupportedError(
+                f'Port {port} was not opened on {cluster_name!r} '
+                f'(declared: {declared}).')
+        ports = [str(port)]
+    else:
+        ports = declared
     head = handle.head_address
     if head.startswith('local:'):
         head_ip = '127.0.0.1'

@@ -776,13 +776,16 @@ class ContinuousBatchingEngine:
                     jnp.asarray(top_ks), jnp.asarray(top_ps),
                     max_k=max_k, use_top_p=use_top_p, kv_bucket=bucket)
         toks = np.asarray(jax.device_get(tok_dev))
+        # One dict ref for the whole step: dict.get is GIL-atomic, and
+        # per-slot lock acquisitions in the decode hot loop would
+        # contend with submit()/cancel() from the HTTP threads.
+        stream_queues = self._stream_queues
         for i in occupied:
             s = self._slots[i]
             tok = int(toks[i])
             s.outputs.append(tok)
             s.generated += 1
-            with self._submit_lock:
-                q = self._stream_queues.get(s.request_id)
+            q = stream_queues.get(s.request_id)
             if q is not None:
                 q.put(tok)
             if (s.eos_id is not None and tok == s.eos_id) or \

@@ -102,7 +102,13 @@ class IncrementalDecoder:
     def flush(self) -> str:
         if self._byte_mode:
             return self._codec.decode(b'', final=True)
-        return ''
+        # HF mode: emit any held-back text, dropping only the trailing
+        # replacement char(s) from a genuinely incomplete byte
+        # sequence — NOT the valid text before them (a generation cut
+        # by max_tokens mid-multibyte must still stream its tail).
+        tail = self._tok.decode(self._ids)[len(self._emitted):]
+        self._emitted += tail
+        return tail.rstrip('�')
 
 
 def load(spec: Optional[str]):

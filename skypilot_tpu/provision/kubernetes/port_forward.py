@@ -9,14 +9,25 @@ anywhere), so the only remaining reachability gap is *TCP* access to
 in-pod services (replica HTTP servers, the agent RPC port) from
 outside the cluster when no LoadBalancer/NodePort is available
 (`port_mode: podip`, or clusters whose nodes have no public IPs).
-A `PortForward` wraps one `kubectl port-forward` child: start() parses
-the dynamically allocated local port, stop() kills the child; the
-module-level registry reuses live sessions per (context, ns, pod,
-port) and reaps them at interpreter exit.
+
+Design points (hard-won):
+  - start() waits for kubectl's "Forwarding from" line with a REAL
+    deadline (select on the pipe), so a silently hung kubectl cannot
+    block the caller forever;
+  - the registry assigns each (context, ns, pod, port) a FIXED local
+    port, so the URL callers persist (serve replica endpoints) stays
+    valid across tunnel restarts;
+  - a keepalive thread restarts dead tunnels on their fixed ports —
+    kubectl port-forward exits on any connection hiccup, and a stored
+    endpoint must not die with it;
+  - get_or_create() never holds the registry lock across the (slow,
+    possibly hanging) start().
 """
 from __future__ import annotations
 
 import atexit
+import select
+import socket
 import subprocess
 import threading
 import time
@@ -28,19 +39,29 @@ from skypilot_tpu import sky_logging
 logger = sky_logging.init_logger(__name__)
 
 _START_TIMEOUT_S = 30.0
+_KEEPALIVE_INTERVAL_S = 30.0
+
+
+def _free_local_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
 
 
 class PortForward:
-    """One `kubectl port-forward pod/<pod> :<port>` session."""
+    """One `kubectl port-forward pod/<pod> <local>:<port>` session."""
 
     def __init__(self, pod: str, port: int,
                  namespace: str = 'default',
-                 context: Optional[str] = None):
+                 context: Optional[str] = None,
+                 local_port: Optional[int] = None):
         self.pod = pod
         self.port = port
         self.namespace = namespace
         self.context = context
-        self.local_port: Optional[int] = None
+        # Fixed local port (0 = let kubectl choose; the registry always
+        # pins one so persisted URLs survive restarts).
+        self.local_port: Optional[int] = local_port
         self._proc: Optional[subprocess.Popen] = None
 
     def _argv(self) -> List[str]:
@@ -49,32 +70,38 @@ class PortForward:
             args += ['--context', self.context]
         args += ['--namespace', self.namespace,
                  'port-forward', f'pod/{self.pod}',
-                 # :remote -> kubectl picks a free local port and
-                 # prints it; no TOCTOU against other processes.
-                 f':{self.port}', '--address', '127.0.0.1']
+                 f'{self.local_port or ""}:{self.port}',
+                 '--address', '127.0.0.1']
         return args
 
     def start(self) -> int:
         """Spawn and block until the tunnel is listening; returns the
-        local port."""
+        local port.  The deadline is real: the pipe is polled with
+        select, so a kubectl that hangs printing nothing still times
+        out."""
         self._proc = subprocess.Popen(
             self._argv(), stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True)
         assert self._proc.stdout is not None
         deadline = time.time() + _START_TIMEOUT_S
-        line = ''
+        buf = ''
         while time.time() < deadline:
             if self._proc.poll() is not None:
                 err = (self._proc.stderr.read()
                        if self._proc.stderr else '')
+                self._proc = None
                 raise exceptions.ProvisionError(
                     f'kubectl port-forward to {self.pod}:{self.port} '
-                    f'exited rc={self._proc.returncode}: '
-                    f'{err.strip()[:500]}')
+                    f'exited: {err.strip()[:500]}')
+            ready, _, _ = select.select(
+                [self._proc.stdout], [], [],
+                max(0.05, min(1.0, deadline - time.time())))
+            if not ready:
+                continue
             line = self._proc.stdout.readline()
             if not line:
-                time.sleep(0.05)
                 continue
+            buf = line
             # "Forwarding from 127.0.0.1:40123 -> 8000"
             if 'Forwarding from' in line and ':' in line:
                 try:
@@ -88,7 +115,7 @@ class PortForward:
         raise exceptions.ProvisionTimeoutError(
             f'kubectl port-forward to {self.pod}:{self.port} did not '
             f'report a local port within {_START_TIMEOUT_S:.0f}s '
-            f'(last line: {line.strip()!r}).')
+            f'(last line: {buf.strip()!r}).')
 
     def alive(self) -> bool:
         return self._proc is not None and self._proc.poll() is None
@@ -101,7 +128,11 @@ class PortForward:
             except subprocess.TimeoutExpired:
                 self._proc.kill()
         self._proc = None
-        self.local_port = None
+
+    def restart(self) -> int:
+        """Relaunch on the SAME local port (callers hold the URL)."""
+        self.stop()
+        return self.start()
 
     def __enter__(self) -> 'PortForward':
         self.start()
@@ -113,24 +144,66 @@ class PortForward:
 
 _registry: Dict[Tuple[Optional[str], str, str, int], PortForward] = {}
 _registry_lock = threading.Lock()
+_keepalive: Optional[threading.Thread] = None
+_keepalive_stop = threading.Event()
+
+
+def _keepalive_loop() -> None:
+    while not _keepalive_stop.wait(_KEEPALIVE_INTERVAL_S):
+        with _registry_lock:
+            dead = [(key, pf) for key, pf in _registry.items()
+                    if not pf.alive()]
+        for key, pf in dead:
+            try:
+                pf.restart()
+                logger.info(
+                    f'port-forward to {pf.pod}:{pf.port} restarted '
+                    f'on local port {pf.local_port}.')
+            except exceptions.ProvisionError as e:
+                logger.warning(
+                    f'port-forward to {pf.pod}:{pf.port} could not '
+                    f'be restarted (will retry): {e}')
+
+
+def _ensure_keepalive() -> None:
+    global _keepalive
+    if _keepalive is None or not _keepalive.is_alive():
+        _keepalive_stop.clear()
+        _keepalive = threading.Thread(target=_keepalive_loop,
+                                      daemon=True,
+                                      name='k8s-port-forward-keepalive')
+        _keepalive.start()
 
 
 def get_or_create(pod: str, port: int, namespace: str = 'default',
                   context: Optional[str] = None) -> PortForward:
     """Live session for (context, ns, pod, port), starting one (or
-    restarting a dead one) if needed.  Long-lived callers (the serve
-    controller probing podip-mode replicas) share sessions instead of
-    spawning a kubectl per probe."""
+    restarting a dead one, on its original local port) if needed.
+    The registry lock is never held across the slow start()."""
     key = (context, namespace, pod, port)
     with _registry_lock:
         pf = _registry.get(key)
-        if pf is not None and pf.alive():
+    if pf is not None:
+        if pf.alive():
             return pf
-        pf = PortForward(pod, port, namespace=namespace,
-                         context=context)
-        pf.start()
-        _registry[key] = pf
+        pf.restart()
+        _ensure_keepalive()
         return pf
+    # Pin a local port up front so the URL survives restarts.  (The
+    # tiny bind-probe race is tolerable: a collision fails start() and
+    # the caller retries.)
+    new = PortForward(pod, port, namespace=namespace, context=context,
+                      local_port=_free_local_port())
+    new.start()
+    with _registry_lock:
+        cur = _registry.get(key)
+        if cur is not None and cur.alive():
+            # Lost a creation race; keep the established one.
+            new.stop()
+            return cur
+        _registry[key] = new
+    _ensure_keepalive()
+    return new
 
 
 def close(pod: str, port: int, namespace: str = 'default',
@@ -142,6 +215,7 @@ def close(pod: str, port: int, namespace: str = 'default',
 
 
 def close_all() -> None:
+    _keepalive_stop.set()
     with _registry_lock:
         sessions = list(_registry.values())
         _registry.clear()

@@ -67,9 +67,10 @@ def _provider_config(resources: resources_lib.Resources,
     if resources.cloud.canonical_name() == 'gcp':
         cfg['project_id'] = config_lib.get_nested(('gcp', 'project_id'),
                                                   None)
-    # Kubernetes: later query/terminate/get_cluster_info calls must hit
-    # the same context + namespace the pods were created in.
-    for key in ('context', 'namespace'):
+    # Kubernetes: later query/terminate/get_cluster_info/open_ports
+    # calls must hit the same context + namespace the pods were
+    # created in, and honor the same port exposure mode.
+    for key in ('context', 'namespace', 'port_mode'):
         if key in deploy_vars:
             cfg[key] = deploy_vars[key]
     return cfg

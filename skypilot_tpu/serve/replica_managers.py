@@ -42,15 +42,8 @@ _K8S_ENDPOINT_TIMEOUT_S = 120.0
 
 def _port_covered(port_specs: Optional[List[str]], port: int) -> bool:
     """True if `port` falls inside any '80' / '8000-8010' spec."""
-    for spec in port_specs or []:
-        s = str(spec)
-        if '-' in s:
-            lo, hi = s.split('-', 1)
-            if int(lo) <= port <= int(hi):
-                return True
-        elif int(s) == port:
-            return True
-    return False
+    from skypilot_tpu.provision import common as provision_common
+    return port in provision_common.expand_ports(port_specs or [])
 
 
 def _resolve_replica_endpoint(handle, port: int) -> str:
@@ -295,7 +288,28 @@ class ReplicaManager:
         record = global_user_state.get_cluster_from_name(cluster_name)
         return record['status'] if record else None
 
-    def probe_all(self) -> None:
+    def _reresolve_tunnel_endpoint(self, record) -> Optional[str]:
+        """Fresh endpoint for a podip-mode k8s replica (restarts the
+        port-forward tunnel); None when the replica isn't one."""
+        cluster = global_user_state.get_cluster_from_name(
+            record['cluster_name'])
+        if cluster is None:
+            return None
+        handle = cluster['handle']
+        addr = getattr(handle, 'head_address', '')
+        pc = getattr(handle, 'provider_config', None) or {}
+        if not addr.startswith('k8s:') or \
+                (pc.get('port_mode') or '').lower() != 'podip':
+            return None
+        try:
+            # k8s replicas always serve on the spec port (per-replica
+            # ports exist only on the shared-network local cloud).
+            return _resolve_replica_endpoint(handle, self.spec.port)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(
+                f'could not re-resolve tunnel endpoint for replica '
+                f'{record["replica_id"]}: {e}')
+            return None
         """One prober pass (reference _replica_prober :1026): check
         cluster liveness (preemption), then HTTP readiness."""
         now = time.time()
@@ -322,6 +336,22 @@ class ReplicaManager:
             ok = probe_endpoint(url, self.spec.readiness_timeout_seconds,
                                 self.spec.post_data,
                                 self.spec.readiness_headers)
+            if not ok and r['endpoint'].startswith('http://127.0.0.1'):
+                # podip-mode k8s replicas are reached through a local
+                # port-forward tunnel; a failed probe may just mean
+                # the tunnel died (or a controller restart lost it) —
+                # re-resolve, which restarts/recreates the tunnel, and
+                # re-probe before charging the replica a failure.
+                fresh = self._reresolve_tunnel_endpoint(r)
+                if fresh is not None:
+                    if fresh != r['endpoint']:
+                        serve_state.set_replica_endpoint(
+                            self.service_name, replica_id, fresh)
+                    url = fresh + self.spec.readiness_path
+                    ok = probe_endpoint(
+                        url, self.spec.readiness_timeout_seconds,
+                        self.spec.post_data,
+                        self.spec.readiness_headers)
             if ok:
                 if status != ReplicaStatus.READY:
                     logger.info(f'Replica {replica_id} of '

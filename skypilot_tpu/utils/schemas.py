@@ -192,6 +192,18 @@ def get_config_schema() -> Dict[str, Any]:
             'admin_policy': {'type': 'string'},
             'allowed_clouds': {'type': 'array',
                                'items': {'type': 'string'}},
+            'kubernetes': {
+                'type': 'object',
+                'properties': {
+                    'namespace': {'type': 'string'},
+                    'image': {'type': 'string'},
+                    # loadbalancer (default) | nodeport | podip — how
+                    # --ports surface (provision/kubernetes/network.py)
+                    'port_mode': _case_insensitive_enum(
+                        ['loadbalancer', 'nodeport', 'podip']),
+                },
+                'additionalProperties': True,
+            },
             # Per-cloud site settings consumed by the provisioners /
             # stores (all optional; clouds error with the exact
             # missing key at launch).

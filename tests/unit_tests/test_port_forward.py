@@ -79,10 +79,22 @@ class TestPortForward:
         assert a is b
         c = port_forward.get_or_create('pod-b', 8000)
         assert c is not a
-        # A dead session is transparently replaced.
+        # A dead session is transparently restarted IN PLACE, keeping
+        # its object (and thus its pinned local port — persisted URLs
+        # must stay valid across tunnel restarts).
         a.stop()
         d = port_forward.get_or_create('pod-a', 8000)
-        assert d is not a and d.alive()
+        assert d is a and d.alive()
+
+    def test_restart_keeps_local_port(self, monkeypatch):
+        monkeypatch.setattr(port_forward.subprocess, 'Popen',
+                            _fake_popen_factory(_FORWARD_OK))
+        pf = port_forward.PortForward('pod-a', 8000, local_port=43210)
+        pf.start()
+        first = pf.local_port
+        pf.restart()
+        assert pf.local_port == first
+        pf.stop()
 
     def test_argv_shape(self):
         pf = port_forward.PortForward('pod-x', 9000, namespace='ns1',
