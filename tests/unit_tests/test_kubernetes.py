@@ -417,3 +417,26 @@ class TestPorts:
         from skypilot_tpu.provision.kubernetes import network
         assert network.expand_ports(['8080', '9000-9002', '8080']) == \
             [8080, 9000, 9001, 9002]
+
+
+class TestPortModePlumbing:
+    """port_mode must flow site config -> deploy vars ->
+    provider_config, or nodeport/podip silently degrade to
+    loadbalancer (found by review; structurally pinned here)."""
+
+    def test_deploy_vars_carry_port_mode(self, monkeypatch):
+        from skypilot_tpu import config as config_lib
+        from skypilot_tpu import resources as resources_lib
+        monkeypatch.setattr(
+            config_lib, 'get_nested',
+            lambda keys, default=None: 'podip'
+            if keys == ('kubernetes', 'port_mode') else default)
+        res = resources_lib.Resources(cloud='kubernetes',
+                                      accelerators='tpu-v5e-8',
+                                      ports=8080)
+        deploy_vars = k8s_cloud.Kubernetes.make_deploy_resources_variables(
+            res, 'c1', k8s_cloud.cloud.Region('ctx'), None, 1)
+        assert deploy_vars['port_mode'] == 'podip'
+        from skypilot_tpu.provision import provisioner as prov
+        pc = prov._provider_config(res, deploy_vars)  # pylint: disable=protected-access
+        assert pc['port_mode'] == 'podip'
