@@ -204,16 +204,30 @@ _TINY_OVERRIDES = dict(vocab_size=2048, dim=256, n_layers=2, n_heads=4,
 _TINY_BATCH, _TINY_SEQ = 8, 256  # divisible by an 8-device virtual mesh
 
 
-def _gen_tflops(device_kind: str) -> float:
-    from skypilot_tpu.utils import accelerator_registry
+def _chip_generation(device_kind: str) -> str:
     kind = device_kind.lower().replace(' ', '')
-    gen = 'v5e'
     for name in ('v6e', 'v5p', 'v5e', 'v5lite', 'v4', 'v3', 'v2'):
         if name in kind:
-            gen = 'v5e' if 'lite' in name else name
-            break
+            return 'v5e' if 'lite' in name else name
+    return 'v5e'
+
+
+def _gen_tflops(device_kind: str) -> float:
+    from skypilot_tpu.utils import accelerator_registry
     return accelerator_registry.TPU_GENERATIONS[
-        gen].bf16_tflops_per_chip
+        _chip_generation(device_kind)].bf16_tflops_per_chip
+
+
+def _gen_price_per_chip_hour(device_kind: str) -> float:
+    """On-demand $/chip-hour from OUR catalog (us-central anchor) —
+    the north star is tokens/sec/$ (BASELINE.md), so the line carries
+    the $-normalized number too."""
+    from skypilot_tpu.catalog import gcp_catalog
+    return gcp_catalog._TPU_PRICE_PER_CHIP_HOUR[  # pylint: disable=protected-access
+        _chip_generation(device_kind)][0]
+
+
+_V6E_PRICE_PER_CHIP_HOUR = 2.70  # our catalog's us-central anchor
 
 
 def _attn_flops_per_token(overrides: dict, seq: int) -> float:
@@ -262,6 +276,18 @@ def _emit(tokens_per_sec: float, n_params: float, n_chips: int,
             _BASELINE_V6E_TOKENS_PER_SEC_PER_CHIP, 1),
         'baseline_scaled_to_this_chip': round(baseline, 1),
     }
+    if 'TPU' in device_kind.upper():
+        # The literal north star (BASELINE.md): tokens/sec/$.  Both
+        # sides priced from OUR catalog's on-demand anchors, so the
+        # ratio audits against one price table.
+        price = _gen_price_per_chip_hour(device_kind)
+        tokens_per_dollar = per_chip * 3600.0 / price
+        baseline_tpd = (_BASELINE_V6E_TOKENS_PER_SEC_PER_CHIP *
+                        3600.0 / _V6E_PRICE_PER_CHIP_HOUR)
+        result['price_per_chip_hour'] = price
+        result['equiv_tokens_per_dollar'] = round(tokens_per_dollar)
+        result['vs_baseline_per_dollar'] = round(
+            tokens_per_dollar / baseline_tpd, 3)
     if provision_to_first_step is not None:
         result['provision_to_first_step_s'] = round(
             provision_to_first_step, 1)

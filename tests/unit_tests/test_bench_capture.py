@@ -417,3 +417,19 @@ def test_emit_metrics_line_is_self_auditing(bench, capsys):
              ) * parsed['raw_tokens_per_sec']
     mfu = flops / (parsed['chip_bf16_tflops'] * 1e12) * 100
     assert abs(mfu - parsed['raw_mfu_pct']) < 0.05
+
+
+def test_emit_carries_tokens_per_dollar(bench, capsys):
+    """BASELINE.md's literal north star is tokens/sec/$: the metrics
+    line must carry the $-normalized number, recomputable from its own
+    price field."""
+    bench._emit(50000.0, 5.5e8, 1, 'TPU v5e', 8192)
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert parsed['price_per_chip_hour'] == 1.20  # our catalog's v5e
+    want = parsed['value'] * 3600 / parsed['price_per_chip_hour']
+    assert abs(parsed['equiv_tokens_per_dollar'] - want) < 20
+    assert parsed['vs_baseline_per_dollar'] > 0
+    # CPU runs don't price.
+    bench._emit(1000.0, 5.5e8, 1, 'cpu', 256)
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert 'equiv_tokens_per_dollar' not in parsed
