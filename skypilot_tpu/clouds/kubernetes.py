@@ -305,7 +305,8 @@ class Kubernetes(cloud.Cloud):
             'labels': resources.labels or {},
             'ports': resources.ports,
             # How opened ports surface: loadbalancer (default) /
-            # nodeport / podip (in-cluster + port-forward tunnels).
+            # nodeport / ingress (nginx path routing) / podip
+            # (in-cluster + port-forward tunnels).
             'port_mode': config_lib.get_nested(
                 ('kubernetes', 'port_mode'), 'loadbalancer'),
             'image': resources.image_id or config_lib.get_nested(

@@ -11,10 +11,13 @@ Jinja templates).  TPU-first redesign: two in-code manifest modes —
   - ``nodeport``: for clusters without any LB controller; the same
     Service with type NodePort, endpoint = node IP + allocated port.
 
-An ``ingress`` mode (reference: nginx path-routing) is deliberately
-not replicated: both supported modes give per-port TCP endpoints,
-which is what serve's load balancer and user tasks actually consume;
-HTTP-path multiplexing belongs to the serve layer here.
+  - ``ingress``: nginx path-routing (reference network.py
+    _open_ports_using_ingress + kubernetes-ingress.yml.j2): one
+    ClusterIP service + ONE Ingress carrying a rewrite rule per port
+    (batched — per-rule objects would hot-reload nginx once per
+    port), endpoint = http://<ingress addr>/skypilot/<ns>/<cluster>/<port>.
+  - ``podip``: in-cluster only; callers reach pods through managed
+    kubectl port-forward tunnels (port_forward.py).
 
 Everything shells through instance._kubectl so tests monkeypatch the
 same single seam as the pod lifecycle.
@@ -31,7 +34,10 @@ logger = sky_logging.init_logger(__name__)
 
 LB_SERVICE_SUFFIX = '--skytpu-lb'
 
-_MODES = ('loadbalancer', 'nodeport', 'podip')
+_MODES = ('loadbalancer', 'nodeport', 'ingress', 'podip')
+
+# Reference parity: sky/provision/kubernetes/network.py _PATH_PREFIX.
+_INGRESS_PATH = '/skypilot/{namespace}/{cluster}/{port}'
 
 
 def _service_name(cluster: str) -> str:
@@ -82,6 +88,45 @@ def _ports_service_manifest(cluster: str, namespace: str,
     }
 
 
+def _ingress_name(cluster: str) -> str:
+    return f'{cluster}--skytpu-ingress'
+
+
+def _ingress_manifest(cluster: str, namespace: str,
+                      ports: List[int]) -> Dict[str, Any]:
+    """One Ingress for ALL ports (reference batches rules into one
+    object: per-port objects would hot-reload nginx once per port,
+    network.py:93-100), path-rewritten to the backend service."""
+    paths = []
+    for p in ports:
+        prefix = _INGRESS_PATH.format(namespace=namespace,
+                                      cluster=cluster, port=p)
+        paths.append({
+            'path': f'{prefix}(/|$)(.*)',
+            'pathType': 'ImplementationSpecific',
+            'backend': {'service': {
+                'name': _service_name(cluster),
+                'port': {'number': p},
+            }},
+        })
+    return {
+        'apiVersion': 'networking.k8s.io/v1',
+        'kind': 'Ingress',
+        'metadata': {
+            'name': _ingress_name(cluster),
+            'namespace': namespace,
+            'annotations': {
+                'nginx.ingress.kubernetes.io/rewrite-target': '/$2',
+                'nginx.ingress.kubernetes.io/use-regex': 'true',
+            },
+        },
+        'spec': {
+            'ingressClassName': 'nginx',
+            'rules': [{'http': {'paths': paths}}],
+        },
+    }
+
+
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
     """Create/update the cluster's ports Service (idempotent apply)."""
@@ -91,19 +136,25 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
     if mode == 'podip':
         # In-cluster reachability only — explicitly configured, never
         # a silent default (round-4 verdict: a no-op must not swallow
-        # --ports).
+        # --ports).  Off-cluster callers ride port_forward.py tunnels.
         logger.info(f'port_mode=podip: ports {ports} reachable via '
                     f'pod IPs in-cluster only.')
         return
     port_list = expand_ports(ports)
-    manifest = _ports_service_manifest(
-        cluster_name_on_cloud, pc.get('namespace', 'default'),
-        port_list,
-        'LoadBalancer' if mode == 'loadbalancer' else 'NodePort')
+    namespace = pc.get('namespace', 'default')
+    svc_type = {'loadbalancer': 'LoadBalancer',
+                'nodeport': 'NodePort',
+                'ingress': 'ClusterIP'}[mode]
+    objs: List[Dict[str, Any]] = [_ports_service_manifest(
+        cluster_name_on_cloud, namespace, port_list, svc_type)]
+    if mode == 'ingress':
+        objs.append(_ingress_manifest(cluster_name_on_cloud,
+                                      namespace, port_list))
+    manifest = {'apiVersion': 'v1', 'kind': 'List', 'items': objs}
     proc = inst._kubectl(['apply', '-f', '-'],
                          input_data=json.dumps(manifest),
                          context=pc.get('context'),
-                         namespace=pc.get('namespace', 'default'))
+                         namespace=namespace)
     if proc.returncode != 0:
         raise exceptions.ProvisionError(
             f'opening ports {ports} on {cluster_name_on_cloud!r} '
@@ -117,15 +168,22 @@ def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None
                   ) -> None:
     from skypilot_tpu.provision.kubernetes import instance as inst
-    del ports  # the one Service carries them all
+    del ports  # the one Service (+ Ingress) carries them all
     pc = provider_config or {}
-    if _port_mode(pc) == 'podip':
+    mode = _port_mode(pc)
+    if mode == 'podip':
         return
     inst._kubectl(['delete', 'service',
                    _service_name(cluster_name_on_cloud),
                    '--ignore-not-found', '--wait=false'],
                   context=pc.get('context'),
                   namespace=pc.get('namespace', 'default'))
+    if mode == 'ingress':
+        inst._kubectl(['delete', 'ingress',
+                       _ingress_name(cluster_name_on_cloud),
+                       '--ignore-not-found', '--wait=false'],
+                      context=pc.get('context'),
+                      namespace=pc.get('namespace', 'default'))
 
 
 def _get_ports_service(cluster: str, pc: Dict[str, Any]
@@ -167,6 +225,34 @@ def _node_external_ip(pc: Dict[str, Any]) -> Optional[str]:
     return internal
 
 
+def _query_ingress_ports(cluster: str, pc: Dict[str, Any],
+                         requested) -> Dict[str, List[str]]:
+    from skypilot_tpu.provision.kubernetes import instance as inst
+    namespace = pc.get('namespace', 'default')
+    proc = inst._kubectl(
+        ['get', 'ingress', _ingress_name(cluster), '-o', 'json',
+         '--ignore-not-found'],
+        context=pc.get('context'), namespace=namespace)
+    if proc.returncode != 0 or not proc.stdout.strip():
+        return {}
+    try:
+        ing = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        return {}
+    addrs = [i.get('ip') or i.get('hostname')
+             for i in ing.get('status', {}).get(
+                 'loadBalancer', {}).get('ingress', [])
+             if i.get('ip') or i.get('hostname')]
+    if not addrs:
+        return {}
+    out: Dict[str, List[str]] = {}
+    for port in sorted(requested):
+        path = _INGRESS_PATH.format(namespace=namespace,
+                                    cluster=cluster, port=port)
+        out[str(port)] = [f'{a}{path}' for a in addrs]
+    return out
+
+
 def query_ports(cluster_name_on_cloud: str, ports: List[str],
                 provider_config: Optional[Dict[str, Any]] = None
                 ) -> Dict[str, List[str]]:
@@ -186,6 +272,11 @@ def query_ports(cluster_name_on_cloud: str, ports: List[str],
     requested = set(expand_ports(ports)) if ports else {
         p['port'] for p in svc_ports}
     out: Dict[str, List[str]] = {}
+    if spec.get('type') == 'ClusterIP':
+        # ingress mode: endpoint = ingress controller address + the
+        # per-port rewrite path.
+        return _query_ingress_ports(cluster_name_on_cloud, pc,
+                                    requested)
     if spec.get('type') == 'LoadBalancer':
         ingress = svc.get('status', {}).get(
             'loadBalancer', {}).get('ingress') or []

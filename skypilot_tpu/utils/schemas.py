@@ -200,7 +200,7 @@ def get_config_schema() -> Dict[str, Any]:
                     # loadbalancer (default) | nodeport | podip — how
                     # --ports surface (provision/kubernetes/network.py)
                     'port_mode': _case_insensitive_enum(
-                        ['loadbalancer', 'nodeport', 'podip']),
+                        ['loadbalancer', 'nodeport', 'ingress', 'podip']),
                 },
                 'additionalProperties': True,
             },

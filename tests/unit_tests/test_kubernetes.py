@@ -32,7 +32,15 @@ class _FakeKubectl:
         self.services = {}
         self.lb_pending = False  # simulate a not-yet-assigned LB
 
+    INGRESS_IP = '198.51.100.7'
+
     def _apply_obj(self, obj):
+        if obj['kind'] == 'Ingress':
+            obj = json.loads(json.dumps(obj))
+            obj['status'] = {'loadBalancer': {
+                'ingress': [{'ip': self.INGRESS_IP}]}}
+            self.services['ingress/' + obj['metadata']['name']] = obj
+            return
         if obj['kind'] == 'Pod':
             obj = json.loads(json.dumps(obj))
             obj.setdefault('status', {})['phase'] = 'Running'
@@ -57,6 +65,10 @@ class _FakeKubectl:
             applied = json.loads(input)
             for obj in applied.get('items', [applied]):
                 self._apply_obj(obj)
+        elif 'get' in cmd and 'ingress' in cmd:
+            name = cmd[cmd.index('ingress') + 1]
+            svc = self.services.get('ingress/' + name)
+            out = json.dumps(svc) if svc else ''
         elif 'get' in cmd and 'service' in cmd:
             name = cmd[cmd.index('service') + 1]
             svc = self.services.get(name)
@@ -67,6 +79,9 @@ class _FakeKubectl:
                  'address': self.NODE_INTERNAL_IP}]}}]})
         elif 'get' in cmd:
             out = json.dumps({'items': self.pods})
+        elif 'delete' in cmd and 'ingress' in cmd:
+            self.services.pop(
+                'ingress/' + cmd[cmd.index('ingress') + 1], None)
         elif 'delete' in cmd and 'service' in cmd:
             self.services.pop(cmd[cmd.index('service') + 1], None)
         elif 'delete' in cmd:
@@ -383,7 +398,29 @@ class TestPorts:
         from skypilot_tpu import exceptions
         with pytest.raises(exceptions.NotSupportedError):
             k8s_instance.open_ports(
-                'c1', ['8080'], dict(self.PC, port_mode='ingress'))
+                'c1', ['8080'], dict(self.PC, port_mode='bogus'))
+
+    def test_ingress_mode(self, fake_kubectl):
+        """Reference parity: nginx path-routing
+        (sky/provision/kubernetes/network.py _open_ports_using_ingress
+        + kubernetes-ingress.yml.j2) — ClusterIP service + ONE batched
+        Ingress, rewrite per port."""
+        pc = dict(self.PC, port_mode='ingress')
+        k8s_instance.open_ports('c1', ['8080', '9090'], pc)
+        svc = fake_kubectl.services['c1--skytpu-lb']
+        assert svc['spec']['type'] == 'ClusterIP'
+        ing = fake_kubectl.services['ingress/c1--skytpu-ingress']
+        assert ing['metadata']['annotations'][
+            'nginx.ingress.kubernetes.io/rewrite-target'] == '/$2'
+        paths = ing['spec']['rules'][0]['http']['paths']
+        assert len(paths) == 2  # one Ingress object, batched rules
+        eps = k8s_instance.query_ports('c1', ['8080'], pc)
+        assert eps == {'8080': [
+            f'{fake_kubectl.INGRESS_IP}'
+            f'/skypilot/default/c1/8080']}
+        k8s_instance.cleanup_ports('c1', ['8080'], pc)
+        assert 'ingress/c1--skytpu-ingress' not in fake_kubectl.services
+        assert 'c1--skytpu-lb' not in fake_kubectl.services
 
     def test_cluster_info_carries_port_endpoints(self, fake_kubectl):
         cfg = _tpu_config('tpu-v5e-16')
