@@ -218,16 +218,16 @@ def _gen_tflops(device_kind: str) -> float:
         _chip_generation(device_kind)].bf16_tflops_per_chip
 
 
-def _gen_price_per_chip_hour(device_kind: str) -> float:
-    """On-demand $/chip-hour from OUR catalog (us-central anchor) —
-    the north star is tokens/sec/$ (BASELINE.md), so the line carries
-    the $-normalized number too."""
+def _gen_price_per_chip_hour(gen_or_kind: str) -> float:
+    """On-demand $/chip-hour from OUR catalog (us-central anchor,
+    incl. any tpu_prices CSV overrides) — the north star is
+    tokens/sec/$ (BASELINE.md), so the line carries the $-normalized
+    number too."""
     from skypilot_tpu.catalog import gcp_catalog
-    return gcp_catalog._TPU_PRICE_PER_CHIP_HOUR[  # pylint: disable=protected-access
-        _chip_generation(device_kind)][0]
-
-
-_V6E_PRICE_PER_CHIP_HOUR = 2.70  # our catalog's us-central anchor
+    prices = gcp_catalog._tpu_prices()  # pylint: disable=protected-access
+    gen = gen_or_kind if gen_or_kind in prices \
+        else _chip_generation(gen_or_kind)
+    return prices[gen][0]
 
 
 def _attn_flops_per_token(overrides: dict, seq: int) -> float:
@@ -282,8 +282,10 @@ def _emit(tokens_per_sec: float, n_params: float, n_chips: int,
         # ratio audits against one price table.
         price = _gen_price_per_chip_hour(device_kind)
         tokens_per_dollar = per_chip * 3600.0 / price
+        # Baseline priced from the SAME table (v6e anchor), so a
+        # catalog price change moves both sides consistently.
         baseline_tpd = (_BASELINE_V6E_TOKENS_PER_SEC_PER_CHIP *
-                        3600.0 / _V6E_PRICE_PER_CHIP_HOUR)
+                        3600.0 / _gen_price_per_chip_hour('v6e'))
         result['price_per_chip_hour'] = price
         result['equiv_tokens_per_dollar'] = round(tokens_per_dollar)
         result['vs_baseline_per_dollar'] = round(

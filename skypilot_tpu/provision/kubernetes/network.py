@@ -1,8 +1,8 @@
-"""Kubernetes port exposure: LoadBalancer / NodePort services.
+"""Kubernetes port exposure: LB / NodePort / Ingress / podip.
 
 Counterpart of the reference's sky/provision/kubernetes/network.py:18
 + network_utils.py (LoadBalancer and Ingress port modes rendered from
-Jinja templates).  TPU-first redesign: two in-code manifest modes —
+Jinja templates).  TPU-first redesign: four in-code modes —
 
   - ``loadbalancer`` (default): one Service of type LoadBalancer per
     cluster carrying every opened port.  Satisfied natively by GKE and
@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
+from skypilot_tpu.provision.common import expand_ports
 
 logger = sky_logging.init_logger(__name__)
 
@@ -46,8 +47,6 @@ def _service_name(cluster: str) -> str:
     # DNS service named after the cluster itself.
     return f'{cluster}{LB_SERVICE_SUFFIX}'
 
-
-from skypilot_tpu.provision.common import expand_ports
 
 
 def _port_mode(provider_config: Optional[Dict[str, Any]]) -> str:
@@ -274,9 +273,12 @@ def query_ports(cluster_name_on_cloud: str, ports: List[str],
     out: Dict[str, List[str]] = {}
     if spec.get('type') == 'ClusterIP':
         # ingress mode: endpoint = ingress controller address + the
-        # per-port rewrite path.
+        # per-port rewrite path.  Intersect with the ports actually
+        # opened (like the other branches) — never fabricate a URL
+        # for a port with no Ingress rule behind it.
+        opened = {p['port'] for p in svc_ports}
         return _query_ingress_ports(cluster_name_on_cloud, pc,
-                                    requested)
+                                    requested & opened)
     if spec.get('type') == 'LoadBalancer':
         ingress = svc.get('status', {}).get(
             'loadBalancer', {}).get('ingress') or []

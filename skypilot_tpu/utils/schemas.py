@@ -197,8 +197,9 @@ def get_config_schema() -> Dict[str, Any]:
                 'properties': {
                     'namespace': {'type': 'string'},
                     'image': {'type': 'string'},
-                    # loadbalancer (default) | nodeport | podip — how
-                    # --ports surface (provision/kubernetes/network.py)
+                    # loadbalancer (default) | nodeport | ingress |
+                    # podip — how --ports surface
+                    # (provision/kubernetes/network.py)
                     'port_mode': _case_insensitive_enum(
                         ['loadbalancer', 'nodeport', 'ingress', 'podip']),
                 },
