@@ -310,6 +310,8 @@ class ReplicaManager:
                 f'could not re-resolve tunnel endpoint for replica '
                 f'{record["replica_id"]}: {e}')
             return None
+
+    def probe_all(self) -> None:
         """One prober pass (reference _replica_prober :1026): check
         cluster liveness (preemption), then HTTP readiness."""
         now = time.time()
