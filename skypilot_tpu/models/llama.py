@@ -415,13 +415,12 @@ class Attention(nn.Module):
                                      fa.DEFAULT_BLOCK_Q,
                                      fa.DEFAULT_BLOCK_KV, window)
         elif cfg.attention_impl in ('ring', 'ulysses'):
-            if window is not None:
-                raise ValueError(
-                    'sliding_window does not yet compose with '
-                    f'{cfg.attention_impl} context parallelism.')
+            # Windowed ring: static distance-bounded loop — chunks
+            # beyond the window are neither computed nor rotated
+            # (ops/ring_attention.py _ring_fwd_loop_windowed).
             from skypilot_tpu.ops import ring_attention
             out = ring_attention.context_parallel_attention(
-                q, k, v, impl=cfg.attention_impl)
+                q, k, v, impl=cfg.attention_impl, window=window)
         else:
             out = fa.mha_reference(q, k, v, window=window)
         # Named so remat_policy='save_attn' can keep it (skipping the

@@ -49,21 +49,26 @@ FORCE_PALLAS = os.environ.get('SKYTPU_FORCE_PALLAS', '') == '1'
 
 def _mha_fwd_xla(q: jax.Array, k: jax.Array, v: jax.Array, *,
                  scale: float, causal: bool,
-                 window: Optional[int] = None
+                 window: Optional[int] = None,
+                 offset: int = 0
                  ) -> Tuple[jax.Array, jax.Array]:
     """XLA-native (out, lse) forward with the same semantics as the
-    pallas kernel (used off-TPU; XLA fuses this fine on CPU)."""
+    pallas kernel (used off-TPU; XLA fuses this fine on CPU).
+
+    `offset`: query block's global position lead over the kv block
+    (ring attention off-diagonal pairs): query row r sits at global
+    position r + offset relative to kv column positions."""
     s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
         seq_q, seq_kv = s.shape[-2:]
         mask = jnp.tril(jnp.ones((seq_q, seq_kv), bool),
-                        k=seq_kv - seq_q)
+                        k=seq_kv - seq_q + offset)
         if window is not None:
             # Sliding window: each query attends to its last `window`
             # positions (inclusive of itself).
             mask &= ~jnp.tril(jnp.ones((seq_q, seq_kv), bool),
-                              k=seq_kv - seq_q - window)
+                              k=seq_kv - seq_q + offset - window)
         s = jnp.where(mask, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
@@ -123,7 +128,8 @@ def _pick_block(seq: int, requested: int, what: str) -> int:
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       acc_ref, m_ref, l_ref, *, scale: float,
                       causal: bool, window: Optional[int],
-                      block_q: int, block_kv: int) -> None:
+                      offset: int, block_q: int,
+                      block_kv: int) -> None:
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -142,10 +148,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # O(S^2) to O(S*W) compute.
     should_run = True
     if causal:
-        should_run = k_start <= q_start + block_q - 1
+        should_run = k_start <= q_start + offset + block_q - 1
         if window is not None:
             should_run &= \
-                k_start + block_kv - 1 >= q_start - window + 1
+                k_start + block_kv - 1 >= q_start + offset - window + 1
 
     @pl.when(should_run)
     def _compute():
@@ -156,7 +162,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bkv]
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
+            rows = q_start + offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
             cols = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
@@ -187,7 +193,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float,
-               causal: bool, window: Optional[int], block_q: int,
+               causal: bool, window: Optional[int], offset: int,
+               block_q: int,
                block_kv: int) -> Tuple[jax.Array, jax.Array]:
     batch, heads, seq_q, d = q.shape
     seq_kv = k.shape[2]
@@ -200,7 +207,8 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float,
     grid = (bh, pl.cdiv(seq_q, block_q), pl.cdiv(seq_kv, block_kv))
     kernel = functools.partial(_flash_fwd_kernel, scale=scale,
                                causal=causal, window=window,
-                               block_q=block_q, block_kv=block_kv)
+                               offset=offset, block_q=block_q,
+                               block_kv=block_kv)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -237,7 +245,7 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float,
 # ---------------------------------------------------------------------------
 def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     q_start, k_start, *, scale: float, causal: bool,
-                    window: Optional[int], block_q: int,
+                    window: Optional[int], offset: int, block_q: int,
                     block_kv: int):
     """Shared FA2 recompute for one (q, kv) block pair.
 
@@ -256,7 +264,7 @@ def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale   # [bq, bkv]
     if causal:
-        rows = q_start + jax.lax.broadcasted_iota(
+        rows = q_start + offset + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 0)
         cols = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1)
@@ -274,8 +282,8 @@ def _bwd_block_math(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, acc_ref, *, scale: float, causal: bool,
-                         window: Optional[int], block_q: int,
-                         block_kv: int) -> None:
+                         window: Optional[int], offset: int,
+                         block_q: int, block_kv: int) -> None:
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -290,17 +298,17 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         # kv blocks strictly above the diagonal contribute nothing;
         # with a window, blocks entirely below it neither.
-        should_run = k_start <= q_start + block_q - 1
+        should_run = k_start <= q_start + offset + block_q - 1
         if window is not None:
             should_run &= \
-                k_start + block_kv - 1 >= q_start - window + 1
+                k_start + block_kv - 1 >= q_start + offset - window + 1
 
     @pl.when(should_run)
     def _compute():
         _, k, _, _, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
             k_start, scale=scale, causal=causal, window=window,
-            block_q=block_q, block_kv=block_kv)
+            offset=offset, block_q=block_q, block_kv=block_kv)
         acc_ref[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)     # [bq, d]
@@ -313,7 +321,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
                           causal: bool, window: Optional[int],
-                          block_q: int, block_kv: int) -> None:
+                          offset: int, block_q: int,
+                          block_kv: int) -> None:
     ki = pl.program_id(1)
     qj = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -327,17 +336,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_start = ki * block_kv
     should_run = True
     if causal:
-        should_run = q_start + block_q - 1 >= k_start
+        should_run = q_start + offset + block_q - 1 >= k_start
         if window is not None:
             should_run &= \
-                k_start + block_kv - 1 >= q_start - window + 1
+                k_start + block_kv - 1 >= q_start + offset - window + 1
 
     @pl.when(should_run)
     def _compute():
         q, _, do, p, ds = _bwd_block_math(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
             k_start, scale=scale, causal=causal, window=window,
-            block_q=block_q, block_kv=block_kv)
+            offset=offset, block_q=block_q, block_kv=block_kv)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)     # [bkv, d]
@@ -354,7 +363,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
                       do: jax.Array, lse: jax.Array, delta: jax.Array, *,
                       scale: float, causal: bool,
-                      window: Optional[int], block_q: int,
+                      window: Optional[int], offset: int, block_q: int,
                       block_kv: int
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Pallas dq + dk/dv backward. lse/delta are [B,H,S] f32."""
@@ -379,7 +388,7 @@ def _flash_bwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale,
-                          causal=causal, window=window,
+                          causal=causal, window=window, offset=offset,
                           block_q=block_q, block_kv=block_kv),
         grid=(bh, nq, nk),
         in_specs=[q_spec, kv_q_inner, kv_q_inner, q_spec, row_spec,
@@ -397,7 +406,7 @@ def _flash_bwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
     row_inner = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale,
-                          causal=causal, window=window,
+                          causal=causal, window=window, offset=offset,
                           block_q=block_q, block_kv=block_kv),
         grid=(bh, nk, nq),
         in_specs=[q_inner, kv_spec, kv_spec, q_inner, row_inner,
@@ -420,7 +429,8 @@ def _flash_bwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
 # backward (FlashAttention-2 blockwise double-scan, jnp — off-TPU path)
 # ---------------------------------------------------------------------------
 def _flash_bwd_xla(q, k, v, do, lse, delta, *, scale: float, causal: bool,
-                   window: Optional[int], block_q: int, block_kv: int
+                   window: Optional[int], offset: int,
+                   block_q: int, block_kv: int
                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     batch, heads, seq_q, d = q.shape
     seq_kv = k.shape[2]
@@ -453,8 +463,9 @@ def _flash_bwd_xla(q, k, v, do, lse, delta, *, scale: float, causal: bool,
             v_j = v_blocks[:, :, ki]
             s = jnp.einsum('bhqd,bhkd->bhqk', q_i, k_j) * scale
             if causal:
-                rows = qi * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_kv), 0)
+                rows = qi * block_q + offset + \
+                    jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_kv), 0)
                 cols = ki * block_kv + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_kv), 1)
                 keep = rows >= cols
@@ -491,7 +502,7 @@ def _flash_bwd_xla(q, k, v, do, lse, delta, *, scale: float, causal: bool,
 
 
 def _pair_bwd(q, k, v, do, lse, delta, *, scale: float, causal: bool,
-              window: Optional[int] = None,
+              window: Optional[int] = None, offset: int = 0,
               block_q: int = DEFAULT_BLOCK_Q,
               block_kv: int = DEFAULT_BLOCK_KV
               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -503,10 +514,12 @@ def _pair_bwd(q, k, v, do, lse, delta, *, scale: float, causal: bool,
     if not _on_tpu() and not FORCE_PALLAS:
         return _flash_bwd_xla(q, k, v, do, lse, delta, scale=scale,
                               causal=causal, window=window,
-                              block_q=block_q, block_kv=block_kv)
+                              offset=offset, block_q=block_q,
+                              block_kv=block_kv)
     return _flash_bwd_pallas(q, k, v, do, lse, delta, scale=scale,
                              causal=causal, window=window,
-                             block_q=block_q, block_kv=block_kv)
+                             offset=offset, block_q=block_q,
+                             block_kv=block_kv)
 
 
 # ---------------------------------------------------------------------------
@@ -530,7 +543,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out
 
 
-def _fwd_impl(q, k, v, scale, causal, block_q, block_kv, window=None):
+def _fwd_impl(q, k, v, scale, causal, block_q, block_kv, window=None,
+              offset=0):
     if window is not None:
         if not causal:
             raise ValueError('window requires causal=True')
@@ -538,14 +552,14 @@ def _fwd_impl(q, k, v, scale, causal, block_q, block_kv, window=None):
             raise ValueError(
                 'window requires seq_q == seq_kv '
                 f'({q.shape[2]} vs {k.shape[2]}).')
-        if window >= q.shape[2]:
+        if offset == 0 and window >= q.shape[2]:
             window = None  # full attention; skip the extra masking
     actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
     if not _on_tpu() and not FORCE_PALLAS:
         return _mha_fwd_xla(q, k, v, scale=actual_scale, causal=causal,
-                            window=window)
+                            window=window, offset=offset)
     return _flash_fwd(q, k, v, scale=actual_scale, causal=causal,
-                      window=window, block_q=block_q,
+                      window=window, offset=offset, block_q=block_q,
                       block_kv=block_kv)
 
 
@@ -580,7 +594,8 @@ flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                   scale: Optional[float] = None,
                   causal: bool = True,
-                  window: Optional[int] = None) -> jax.Array:
+                  window: Optional[int] = None,
+                  offset: int = 0) -> jax.Array:
     """Plain-jnp attention for correctness tests."""
     actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
@@ -588,10 +603,10 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     if causal:
         seq_q, seq_kv = s.shape[-2:]
         mask = jnp.tril(jnp.ones((seq_q, seq_kv), bool),
-                        k=seq_kv - seq_q)
+                        k=seq_kv - seq_q + offset)
         if window is not None:
             mask &= ~jnp.tril(jnp.ones((seq_q, seq_kv), bool),
-                              k=seq_kv - seq_q - window)
+                              k=seq_kv - seq_q + offset - window)
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum('bhqk,bhkd->bhqd', p,

@@ -153,14 +153,22 @@ class TestModelWindow:
             seq.append(nxt)
         assert toks == want
 
-    def test_ring_rejects_window(self):
-        tokens = jnp.zeros((1, 32), jnp.int32)
-        cfg = llama.get_config('llama-tiny', **_CFG,
-                               attention_impl='ring',
-                               sliding_window=8)
-        model = llama.Llama(cfg)
-        with pytest.raises(ValueError, match='sliding_window'):
-            model.init(jax.random.PRNGKey(0), tokens)
+    def test_ring_impl_with_window_matches_flash(self):
+        """Outside a context mesh the ring impl falls back to plain
+        flash — windowed output must match the flash impl exactly."""
+        tokens = jnp.asarray(
+            np.random.RandomState(2).randint(0, 97, (1, 32)), jnp.int32)
+        cfg_flash = llama.get_config('llama-tiny', **_CFG,
+                                     sliding_window=8)
+        model_flash = llama.Llama(cfg_flash)
+        params = model_flash.init(jax.random.PRNGKey(0), tokens)
+        out_flash = model_flash.apply(params, tokens)
+        cfg_ring = llama.get_config('llama-tiny', **_CFG,
+                                    attention_impl='ring',
+                                    sliding_window=8)
+        out_ring = llama.Llama(cfg_ring).apply(params, tokens)
+        np.testing.assert_allclose(out_ring, out_flash,
+                                   rtol=2e-5, atol=2e-5)
 
     def test_slot_mode_decode_matches_batch_decode(self):
         """Continuous-batching slot decode (per-row write cursors,
