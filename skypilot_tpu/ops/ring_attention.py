@@ -71,6 +71,15 @@ def _block_fwd(q, k, v, scale, q_off, k_off, chunk):
         lambda _: jax.lax.cond(k_off == q_off, diag, full, None), None)
 
 
+def _use_windowed_ring(window, causal: bool, s_local: int,
+                       axis_size: int) -> bool:
+    """ONE predicate for both the forward and backward dispatch —
+    if they disagreed, custom_vjp would silently pair a full-ring
+    forward with a windowed backward (or vice versa)."""
+    return (window is not None and causal
+            and window < s_local * axis_size)
+
+
 def _window_max_distance(window: int, s_local: int,
                          axis_size: int) -> int:
     """Largest chunk distance d such that a q chunk still attends
@@ -207,10 +216,10 @@ def _ring_fwd(q, k, v, axis_name, causal, scale, window=None):
     axis_size = jax.lax.axis_size(axis_name)
     if window is not None and not causal:
         raise ValueError('window requires causal=True')
-    if window is not None and \
-            window < q.shape[2] * axis_size:  # else: plain full ring
+    if _use_windowed_ring(window, causal, q.shape[2], axis_size):
         return _ring_fwd_loop_windowed(q, k, v, actual_scale,
                                        axis_name, axis_size, window)
+    # window >= full sequence: plain full ring is identical.
     return _ring_fwd_loop(q, k, v, actual_scale, axis_name, axis_size,
                           causal)
 
@@ -281,8 +290,7 @@ def _ring_vjp_bwd(axis_name, causal, scale, window, residuals, g):
     axis_size = jax.lax.axis_size(axis_name)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
-    if window is not None and causal and \
-            window < q.shape[2] * axis_size:
+    if _use_windowed_ring(window, causal, q.shape[2], axis_size):
         return _ring_bwd_windowed(q, k, v, g, lse, delta,
                                   actual_scale, axis_name, axis_size,
                                   window)

@@ -216,7 +216,35 @@ class TestWindowedRing:
         ref = fa.mha_reference(q, k, v, window=window)
         np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
 
-    @pytest.mark.parametrize('window', [32, 64, 100])
+    @pytest.mark.parametrize('window', [130, 300])
+    def test_pallas_multiblock_offset_kernels(self, window,
+                                              monkeypatch):
+        """The TPU path of the feature: multi-block chunks (s_local
+        256, blocks 128) force the offset-adjusted block-skip
+        predicates in the pallas fwd AND both bwd kernels to actually
+        run with offset != 0 (the XLA-path tests never execute
+        them)."""
+        monkeypatch.setattr(fa, 'FORCE_PALLAS', True)
+        q, k, v = _qkv(s=512)
+        mesh = _context_mesh(2)  # s_local 256 = 2 pallas blocks
+        spec = P(None, None, 'context', None)
+        ring = shard_map(
+            functools.partial(ra.ring_attention, axis_name='context',
+                              causal=True, window=window),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        out = jax.jit(ring)(q, k, v)
+        ref = fa.mha_reference(q, k, v, window=window)
+        np.testing.assert_allclose(out, ref, atol=3e-4, rtol=3e-4)
+        g1 = jax.grad(lambda q, k, v: (jax.jit(ring)(q, k, v) ** 2)
+                      .sum(), argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(
+            lambda q, k, v: (fa.mha_reference(q, k, v, window=window)
+                             ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+    @pytest.mark.parametrize('window', [32, 64, 100, 200])
     def test_grads_match_windowed_reference(self, window):
         q, k, v = _qkv(s=256)
         mesh = _context_mesh(4)
