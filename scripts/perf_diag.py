@@ -1,0 +1,79 @@
+"""Isolate the per-step stall seen in perf_sweep: compute vs data
+transfer vs dispatch.  Run on the live chip after the sweep finishes.
+
+Points:
+  staged: steps over 2 pre-transferred batches (no host work in loop)
+  fresh:  bench-identical loop (per-step host gen + transfer)
+  put:    bare batch-transfer latency
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import bench
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.train import data as data_lib
+    from skypilot_tpu.train import trainer as trainer_lib
+
+    mesh_lib.devices_with_retry()
+    batch, seq = bench._BENCH_BATCH, bench._BENCH_SEQ
+    overrides = dict(bench._BENCH_OVERRIDES, max_seq_len=seq)
+    steps = 10
+    config = trainer_lib.TrainConfig(
+        model='llama-tiny', global_batch_size=batch, seq_len=seq,
+        total_steps=200, mesh=mesh_lib.MeshConfig(data=1, fsdp=-1),
+        model_overrides=overrides, loss_chunk=bench._BENCH_LOSS_CHUNK)
+    trainer = trainer_lib.Trainer(config)
+    trainer.init_state()
+    data_iter = data_lib.synthetic_data(
+        trainer.mesh, global_batch_size=batch, seq_len=seq,
+        vocab_size=trainer.model_config.vocab_size)
+
+    # bare transfer latency
+    t0 = time.time()
+    n_put = 5
+    batches = []
+    for _ in range(n_put):
+        b = next(data_iter)
+        jax.block_until_ready(b)
+        batches.append(b)
+    put_ms = 1000 * (time.time() - t0) / n_put
+
+    # compile
+    jax.device_get(trainer.step(batches[0])['loss'])
+
+    # staged: no host work in the loop (batch 0 was donated? batches are
+    # inputs, not donated — reusable)
+    t0 = time.time()
+    m = None
+    for i in range(steps):
+        m = trainer.step(batches[1 + (i % 2)])
+    jax.device_get(m['loss'])
+    staged_ms = 1000 * (time.time() - t0) / steps
+
+    # fresh: bench-identical
+    t0 = time.time()
+    for _ in range(steps):
+        m = trainer.step(next(data_iter))
+    jax.device_get(m['loss'])
+    fresh_ms = 1000 * (time.time() - t0) / steps
+
+    toks = batch * seq
+    print(json.dumps({
+        'put_ms': round(put_ms, 1),
+        'staged_step_ms': round(staged_ms, 1),
+        'fresh_step_ms': round(fresh_ms, 1),
+        'staged_tok_s': round(1000 * toks / staged_ms, 1),
+        'fresh_tok_s': round(1000 * toks / fresh_ms, 1),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,132 @@
+"""On-chip perf sweep for the bench trainer config (round-5 MFU push).
+
+Runs one (batch, block_q, block_kv, mode) point per subprocess — a
+wedged tunnel kills a single point, not the sweep — and prints one JSON
+line per point.  Mirrors bench.run_direct's shapes so results transfer
+1:1 to the headline number.
+
+Usage:
+    python scripts/perf_sweep.py            # run the standard grid
+    python scripts/perf_sweep.py --point base   # one point, in-process
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+POINTS = {
+    # name: (batch, block_q, block_kv, fwd_only, extra_overrides)
+    'base': (2, 512, 512, False, {}),
+    'b4': (4, 512, 512, False, {}),
+    'q1024': (2, 1024, 1024, False, {}),
+    'q1024kv512': (2, 1024, 512, False, {}),
+    'q512kv1024': (2, 512, 1024, False, {}),
+    'q2048kv512': (2, 2048, 512, False, {}),
+    'fwdonly': (2, 512, 512, True, {}),
+    'remat_nothing': (2, 512, 512, False,
+                      {'remat_policy': 'nothing'}),
+}
+
+
+def run_point(name: str) -> None:
+    batch, bq, bkv, fwd_only, extra = POINTS[name]
+    import jax
+    from skypilot_tpu.ops import flash_attention as fa
+    fa.DEFAULT_BLOCK_Q = bq
+    fa.DEFAULT_BLOCK_KV = bkv
+    import bench
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.train import data as data_lib
+    from skypilot_tpu.train import trainer as trainer_lib
+
+    mesh_lib.devices_with_retry()
+    overrides = dict(bench._BENCH_OVERRIDES, max_seq_len=bench._BENCH_SEQ,
+                     **extra)
+    seq = bench._BENCH_SEQ
+    steps = 10
+    config = trainer_lib.TrainConfig(
+        model='llama-tiny', global_batch_size=batch, seq_len=seq,
+        total_steps=steps + 1,
+        mesh=mesh_lib.MeshConfig(data=1, fsdp=-1),
+        model_overrides=overrides, loss_chunk=bench._BENCH_LOSS_CHUNK)
+    trainer = trainer_lib.Trainer(config)
+    trainer.init_state()
+    data_iter = data_lib.synthetic_data(
+        trainer.mesh, global_batch_size=batch, seq_len=seq,
+        vocab_size=trainer.model_config.vocab_size)
+
+    if fwd_only:
+        import functools
+        lf = functools.partial(trainer_lib.loss_fn_chunked,
+                               chunk=bench._BENCH_LOSS_CHUNK,
+                               model_config=trainer.model_config)
+        fwd = jax.jit(lambda params, b: lf(params, trainer._apply_unboxed,
+                                           b)[0])
+        b0 = next(data_iter)
+        jax.device_get(fwd(trainer.state.params, b0))  # compile
+        t0 = time.time()
+        for _ in range(steps):
+            out = fwd(trainer.state.params, next(data_iter))
+        jax.device_get(out)
+        dt = time.time() - t0
+    else:
+        jax.device_get(trainer.step(next(data_iter))['loss'])  # compile
+        t0 = time.time()
+        metrics = None
+        for _ in range(steps):
+            metrics = trainer.step(next(data_iter))
+        jax.device_get(metrics['loss'])
+        dt = time.time() - t0
+
+    toks = steps * batch * seq / dt
+    from skypilot_tpu.models import llama
+    n_params = llama.num_params(trainer.model_config)
+    # fwd-only flops: 2*N per token (+ causal attn fwd 2*L*s*d);
+    # train step: 6*N (+ 6*L*s*d).
+    mult = 2.0 if fwd_only else 6.0
+    flops_tok = mult * n_params + mult * overrides['n_layers'] * seq * \
+        overrides['dim']
+    tflops = toks * flops_tok / 1e12
+    print(json.dumps({
+        'point': name, 'batch': batch, 'block_q': bq, 'block_kv': bkv,
+        'fwd_only': fwd_only, 'tokens_per_sec': round(toks, 1),
+        'achieved_tflops': round(tflops, 1),
+        'mfu_pct': round(100 * tflops / 197.0, 2),
+        'step_ms': round(1000 * dt / steps, 1),
+    }))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--point')
+    parser.add_argument('--points', default=','.join(POINTS))
+    args = parser.parse_args()
+    if args.point:
+        run_point(args.point)
+        return
+    for name in args.points.split(','):
+        cmd = [sys.executable, os.path.abspath(__file__), '--point', name]
+        t0 = time.time()
+        proc = subprocess.run(cmd, timeout=900, capture_output=True,
+                              text=True, check=False,
+                              cwd=os.path.dirname(os.path.dirname(
+                                  os.path.abspath(__file__))))
+        for line in proc.stdout.splitlines():
+            if line.startswith('{'):
+                print(line, flush=True)
+                break
+        else:
+            tail = (proc.stderr or '')[-400:]
+            print(json.dumps({'point': name, 'error': proc.returncode,
+                              'tail': tail}), flush=True)
+        print(f'# {name}: {time.time() - t0:.0f}s wall', file=sys.stderr,
+              flush=True)
+
+
+if __name__ == '__main__':
+    main()

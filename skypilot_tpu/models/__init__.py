@@ -2,7 +2,7 @@
 
 Families: llama-* / llama3* (models/llama.py), mixtral-* MoE
 (models/moe.py), gemma-* (models/gemma.py), gpt2-* (models/gpt2.py),
-qwen* (models/qwen.py).
+qwen* (models/qwen.py), deepseek-* MLA+MoE (models/deepseek.py).
 The trainer and serving engine resolve models through `get_model` so
 new families plug in without touching the training loop.
 """
@@ -13,7 +13,11 @@ from typing import Any, Tuple
 
 def get_model(name: str, **overrides: Any) -> Tuple[Any, Any]:
     """Return (nn.Module instance, config) for a model name."""
-    from skypilot_tpu.models import gemma, gpt2, llama, moe, qwen
+    from skypilot_tpu.models import (deepseek, gemma, gpt2, llama, moe,
+                                     qwen)
+    if name in deepseek.CONFIGS:
+        config = deepseek.get_config(name, **overrides)
+        return deepseek.DeepSeek(config), config
     if name in moe.CONFIGS:
         config = moe.get_config(name, **overrides)
         return moe.Mixtral(config), config
@@ -37,8 +41,10 @@ def num_params(config: Any) -> int:
     """Analytic parameter count, dispatched by config family —
     families duck-type each other's fields, so calling one family's
     counter on another's config returns a silently-wrong number."""
-    from skypilot_tpu.models import gemma, gpt2, llama, moe, qwen
-    for mod, cfg_cls in ((moe, moe.MoEConfig),
+    from skypilot_tpu.models import (deepseek, gemma, gpt2, llama, moe,
+                                     qwen)
+    for mod, cfg_cls in ((deepseek, deepseek.DeepSeekConfig),
+                         (moe, moe.MoEConfig),
                          (gemma, gemma.GemmaConfig),
                          (gpt2, gpt2.Gpt2Config),
                          (qwen, qwen.QwenConfig)):
@@ -48,7 +54,8 @@ def num_params(config: Any) -> int:
 
 
 def available_models():
-    from skypilot_tpu.models import gemma, gpt2, llama, moe, qwen
+    from skypilot_tpu.models import (deepseek, gemma, gpt2, llama, moe,
+                                     qwen)
     return (sorted(llama.CONFIGS) + sorted(moe.CONFIGS)
             + sorted(gemma.CONFIGS) + sorted(gpt2.CONFIGS)
-            + sorted(qwen.CONFIGS))
+            + sorted(qwen.CONFIGS) + sorted(deepseek.CONFIGS))

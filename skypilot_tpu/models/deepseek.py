@@ -1,0 +1,415 @@
+"""DeepSeek model family (V2/V3/R1): multi-head latent attention +
+fine-grained MoE, TPU-first.
+
+Reference parity: the reference serves DeepSeek-R1 through vLLM
+(`llm/deepseek-r1/README.md`, `llm/deepseek-r1/deepseek-r1-671B.yaml`)
+and Janus (`llm/deepseek-janus/`); it ships no model code.  Here the
+family is first-party so MLA's latent KV cache — the whole point of
+the architecture — is exploited on TPU:
+
+  - **MLA (multi-head latent attention)**: K/V are up-projected from a
+    shared low-rank latent `c = W_dkv x` (kv_lora_rank wide) plus a
+    single shared RoPE key head.  Training materializes K/V and runs
+    the Pallas flash kernel.  Decode uses the *absorbed* form —
+    `q·k = (q_nope W_uk)·c + q_rope·k_rope` — so the KV cache holds
+    only `kv_lora_rank + qk_rope_head_dim` floats per token (576 for
+    V3 vs 32,768 for an equivalent MHA: ~57x less HBM per token).
+    Structurally that is ordinary cached attention with ONE kv head of
+    width `kv_lora_rank + qk_rope_head_dim`, so the decode path reuses
+    llama.run_cached_attention unchanged — slot-mode continuous
+    batching, kv read buckets, and the serving engine all work for
+    free.
+  - **DeepSeekMoE**: `first_k_dense` dense layers, then MoE layers =
+    shared expert(s) + top-k routed experts (models/moe.py MoEMLP with
+    the expert width swapped to `moe_ffn_dim`).  The dense prefix runs
+    unscanned; the homogeneous MoE suffix is scanned (compile time
+    O(1) in depth, same recipe as llama.apply_blocks).
+  - RoPE applies only to the decoupled `qk_rope_head_dim` slice; the
+    nope slice is position-independent (what makes the absorption
+    legal).  The rotation itself is the framework-shared llama rope
+    (bit-compat with upstream checkpoints is out of scope).
+
+Training attention pads q/k/v to a lane-aligned head width for the
+flash kernel (zero-padding is exact for dot products; the softmax
+scale is pinned to the true `qk_head_dim`).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+from jax.ad_checkpoint import checkpoint_name
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.models import moe as moe_lib
+from skypilot_tpu.ops import flash_attention as fa
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepSeekConfig:
+    """Duck-typed against LlamaConfig/MoEConfig where blocks are
+    shared (MoEMLP reads ffn_dim/n_experts/...; apply-side helpers
+    read dtype/partition_params/...)."""
+    name: str
+    vocab_size: int = 129280
+    dim: int = 7168
+    n_layers: int = 61
+    n_heads: int = 128
+    # MLA geometry (DeepSeek-V3 defaults).
+    q_lora_rank: int = 1536          # 0 = full q projection (V2-Lite)
+    kv_lora_rank: int = 512
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    # Dense MLP width (first_k_dense layers) / MoE geometry.
+    ffn_dim: int = 18432
+    first_k_dense: int = 3
+    n_experts: int = 256             # routed experts
+    experts_per_token: int = 8
+    n_shared_experts: int = 1
+    moe_ffn_dim: int = 2048          # per-expert (and per-shared) width
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.001
+    moe_dispatch: str = 'sparse'
+    max_seq_len: int = 8192
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    remat_policy: str = 'nothing'
+    attention_impl: str = 'flash'    # flash | reference
+    decode: bool = False
+    partition_params: bool = True
+    # Unused by MLA but read via getattr by shared helpers.
+    sliding_window: Optional[int] = None
+    lora_rank: int = 0
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def latent_dim(self) -> int:
+        """Per-token KV-cache width (the MLA headline number)."""
+        return self.kv_lora_rank + self.qk_rope_head_dim
+
+
+CONFIGS: Dict[str, DeepSeekConfig] = {
+    # Structurally complete tiny config: q-LoRA on, 1 dense + MoE
+    # suffix, shared expert — everything a test needs to exercise.
+    'deepseek-tiny': DeepSeekConfig(
+        'deepseek-tiny', vocab_size=512, dim=64, n_layers=2, n_heads=4,
+        q_lora_rank=24, kv_lora_rank=32, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, ffn_dim=128,
+        first_k_dense=1, n_experts=4, experts_per_token=2,
+        n_shared_experts=1, moe_ffn_dim=64, max_seq_len=256,
+        scan_layers=False, remat=False),
+    'deepseek-v2-lite': DeepSeekConfig(
+        'deepseek-v2-lite', vocab_size=102400, dim=2048, n_layers=27,
+        n_heads=16, q_lora_rank=0, kv_lora_rank=512,
+        qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+        ffn_dim=10944, first_k_dense=1, n_experts=64,
+        experts_per_token=6, n_shared_experts=2, moe_ffn_dim=1408,
+        max_seq_len=32768),
+    'deepseek-v2': DeepSeekConfig(
+        'deepseek-v2', vocab_size=102400, dim=5120, n_layers=60,
+        n_heads=128, q_lora_rank=1536, kv_lora_rank=512,
+        qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+        ffn_dim=12288, first_k_dense=1, n_experts=160,
+        experts_per_token=6, n_shared_experts=2, moe_ffn_dim=1536,
+        max_seq_len=32768),
+    'deepseek-v3': DeepSeekConfig('deepseek-v3', max_seq_len=32768),
+    # R1 is V3's architecture post-trained for reasoning (the
+    # reference's llm/deepseek-r1 recipe serves exactly this shape).
+    'deepseek-r1': DeepSeekConfig('deepseek-r1', max_seq_len=32768),
+}
+
+
+def get_config(name: str, **overrides: Any) -> DeepSeekConfig:
+    if name not in CONFIGS:
+        raise ValueError(f'Unknown deepseek config {name!r}; '
+                         f'available: {sorted(CONFIGS)}')
+    return dataclasses.replace(CONFIGS[name], **overrides)
+
+
+def _round_up(x: int, mult: int) -> int:
+    return (x + mult - 1) // mult * mult
+
+
+class MLAAttention(nn.Module):
+    """Multi-head latent attention (training + absorbed decode)."""
+    config: DeepSeekConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        b, s, _ = x.shape
+        h = cfg.n_heads
+        dn, dr, dv = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                      cfg.v_head_dim)
+        rkv = cfg.kv_lora_rank
+
+        def dense(features, names, name):
+            return nn.DenseGeneral(
+                features, axis=-1, use_bias=False, name=name,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                kernel_init=llama._partitioned_init(  # pylint: disable=protected-access
+                    nn.initializers.normal(0.02), names,
+                    cfg.partition_params))
+
+        # --- queries: optional low-rank bottleneck (V3) or full (Lite).
+        if cfg.q_lora_rank:
+            cq = dense(cfg.q_lora_rank, ('embed_fsdp', 'q_lora'),
+                       'q_down')(x)
+            cq = llama.RMSNorm(cfg.norm_eps, cfg.dtype,
+                               cfg.partition_params, name='q_norm')(cq)
+            q = dense((h, dn + dr), ('q_lora', 'heads', 'head_dim'),
+                      'q_up')(cq)
+        else:
+            q = dense((h, dn + dr), ('embed_fsdp', 'heads', 'head_dim'),
+                      'q_proj')(x)
+        q = jnp.transpose(q, (0, 2, 1, 3))        # [B, H, S, dn+dr]
+        q_nope, q_rope = q[..., :dn], q[..., dn:]
+        q_rope = llama.apply_rope(q_rope, positions, cfg.rope_theta)
+
+        # --- latent KV + decoupled shared rope key.
+        c = dense(rkv, ('embed_fsdp', 'kv_lora'), 'kv_down')(x)
+        c = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                          name='kv_norm')(c)      # [B, S, rkv]
+        # The decoupled rope key is ONE shared head — it cannot shard
+        # over 'tensor' (size 1); every tensor shard keeps a copy.
+        k_rope = dense((1, dr), ('embed_fsdp', None, 'head_dim'),
+                       'k_rope_proj')(x)          # [B, S, 1, dr]
+        k_rope = jnp.transpose(k_rope, (0, 2, 1, 3))
+        k_rope = llama.apply_rope(k_rope, positions, cfg.rope_theta)
+
+        # Up-projections as raw params: the SAME weights serve the
+        # training path (materialize K/V) and the decode path
+        # (absorbed into q / out) — einsum layouts differ, a
+        # DenseGeneral can't express both.
+        wuk = self.param(
+            'kv_up_k',
+            llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(0.02),
+                ('kv_lora', 'heads', 'head_dim'), cfg.partition_params),
+            (rkv, h, dn), cfg.param_dtype)
+        wuv = self.param(
+            'kv_up_v',
+            llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(0.02),
+                ('kv_lora', 'heads', 'head_dim'), cfg.partition_params),
+            (rkv, h, dv), cfg.param_dtype)
+        wuk_c = wuk.astype(cfg.dtype)
+        wuv_c = wuv.astype(cfg.dtype)
+        scale = cfg.qk_head_dim ** -0.5
+
+        if cfg.decode:
+            out = self._absorbed_cached(q_nope, q_rope, c, k_rope,
+                                        wuk_c, wuv_c, kv_mask, scale)
+        else:
+            out = self._train_attention(q_nope, q_rope, c, k_rope,
+                                        wuk_c, wuv_c, scale)
+        out = checkpoint_name(out, 'attn_out')    # [B, S, H, dv]
+        flat = out.reshape(b, s, h * dv)
+        return nn.DenseGeneral(
+            cfg.dim, use_bias=False, name='o_proj', dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
+                ('heads', 'embed_fsdp'), cfg.partition_params))(flat)
+
+    def _train_attention(self, q_nope, q_rope, c, k_rope, wuk, wuv,
+                         scale) -> jax.Array:
+        """Materialized K/V + flash kernel (or reference math)."""
+        cfg = self.config
+        dn, dr, dv = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                      cfg.v_head_dim)
+        h = cfg.n_heads
+        b, _, s, _ = q_nope.shape
+        k_nope = jnp.einsum('bsr,rhn->bhsn', c, wuk)
+        v = jnp.einsum('bsr,rhv->bhsv', c, wuv)
+        k_rope_b = jnp.broadcast_to(k_rope, (b, h, s, dr))
+        q = jnp.concatenate([q_nope, q_rope], axis=-1)
+        k = jnp.concatenate([k_nope, k_rope_b], axis=-1)
+        if cfg.attention_impl == 'flash':
+            # Lane-align the head width for the Pallas kernel; zero
+            # padding is exact (adds 0 to every dot product) and the
+            # explicit scale ignores the padded width.
+            dq = _round_up(max(dn + dr, dv), 128)
+            pad_qk = dq - (dn + dr)
+            spec = [(0, 0), (0, 0), (0, 0), (0, pad_qk)]
+            out = fa.flash_attention(
+                jnp.pad(q, spec), jnp.pad(k, spec),
+                jnp.pad(v, [(0, 0), (0, 0), (0, 0), (0, dq - dv)]),
+                scale, True, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_KV,
+                None)[..., :dv]
+        else:
+            out = fa.mha_reference(q, k, v, scale=scale)
+        return jnp.transpose(out, (0, 2, 1, 3))   # [B, S, H, dv]
+
+    def _absorbed_cached(self, q_nope, q_rope, c, k_rope, wuk, wuv,
+                         kv_mask, scale) -> jax.Array:
+        """Decode: cache [c ; k_rope] as ONE latent kv head.
+
+        q_eff = [q_nope·W_uk ; q_rope]   (width rkv + dr)
+        k_eff = [c ; k_rope]             (the cache entry)
+        v_eff = c zero-padded to width rkv + dr
+        then  q_eff·k_eff == q·k  and  (probs·v_eff)[..:rkv]·W_uv == out,
+        so llama.run_cached_attention (slot-mode continuous batching,
+        kv buckets, GQA broadcast of the single latent head) is reused
+        verbatim.  Its internal scale is width**-0.5 of the LATENT
+        width; q is pre-multiplied to land on the true qk_head_dim
+        scale."""
+        cfg = self.config
+        rkv, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+        b, h, s, _ = q_nope.shape
+        q_abs = jnp.einsum('bhsn,rhn->bhsr', q_nope, wuk)
+        q_eff = jnp.concatenate([q_abs, q_rope], axis=-1)
+        width = rkv + dr
+        q_eff = q_eff * (scale / (width ** -0.5))
+        k_eff = jnp.concatenate(
+            [c[:, None], k_rope], axis=-1)        # [B, 1, S, rkv+dr]
+        v_eff = jnp.pad(c[:, None], [(0, 0), (0, 0), (0, 0), (0, dr)])
+        out_latent = llama.run_cached_attention(
+            self, q_eff, k_eff, v_eff, kv_mask, n_kv_heads=1,
+            max_seq_len=cfg.max_seq_len, dtype=cfg.dtype)
+        out_latent = out_latent[..., :rkv]        # [B, S, H, rkv]
+        return jnp.einsum('bshr,rhv->bshv', out_latent, wuv)
+
+
+class SharedExpertMLP(nn.Module):
+    """Always-on expert(s): a dense gated MLP of width
+    n_shared_experts * moe_ffn_dim, added to the routed output."""
+    config: DeepSeekConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        width = cfg.n_shared_experts * cfg.moe_ffn_dim
+        shared_cfg = dataclasses.replace(cfg, ffn_dim=width)
+        return llama.MLP(shared_cfg, name='shared_mlp')(x)
+
+
+class DeepSeekBlock(nn.Module):
+    config: DeepSeekConfig
+    use_moe: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 kv_mask: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        x = x + MLAAttention(cfg, name='attention')(
+            llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                          name='attention_norm')(x), positions, kv_mask)
+        h = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                          name='mlp_norm')(x)
+        if self.use_moe:
+            routed_cfg = dataclasses.replace(cfg,
+                                             ffn_dim=cfg.moe_ffn_dim)
+            y = moe_lib.MoEMLP(routed_cfg, name='moe_mlp')(h)
+            y = y + SharedExpertMLP(cfg, name='shared')(h)
+        else:
+            y = llama.MLP(cfg, name='mlp')(h)
+        return x + y
+
+
+class DeepSeek(nn.Module):
+    """Decoder-only MLA+MoE transformer; returns logits [B, S, V]."""
+    config: DeepSeekConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, positions=None, kv_mask=None,
+                 return_hidden: bool = False) -> jax.Array:
+        cfg = self.config
+        if positions is None:
+            positions = llama.default_positions(tokens)
+        embed = self.param(
+            'tok_embed',
+            llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(1.0), ('vocab', 'embed_fsdp'),
+                cfg.partition_params),
+            (cfg.vocab_size, cfg.dim), cfg.param_dtype)
+        x = llama.embed_lookup(cfg, embed, tokens)
+
+        # Dense prefix (first_k_dense layers), unscanned — it is
+        # heterogeneous with the MoE suffix, and 1-3 layers don't move
+        # compile time.
+        n_dense = min(cfg.first_k_dense, cfg.n_layers)
+        # The unscanned prefix must keep prevent_cse=True; only the
+        # scanned suffix may drop it (llama.maybe_remat owns the rule).
+        prefix_cls = llama.maybe_remat(cfg, DeepSeekBlock,
+                                       scanned=False)
+        for i in range(n_dense):
+            x = prefix_cls(cfg, use_moe=False, name=f'dense_{i}')(
+                x, positions, kv_mask)
+
+        # Homogeneous MoE suffix: scanned (llama.apply_blocks recipe).
+        n_moe = cfg.n_layers - n_dense
+        if n_moe:
+            block_cls = llama.maybe_remat(cfg, DeepSeekBlock,
+                                          scanned=cfg.scan_layers)
+            if cfg.scan_layers:
+                variable_axes = {'params': 0, 'intermediates': 0}
+                if cfg.decode:
+                    variable_axes['cache'] = 0
+                x, _ = nn.scan(
+                    lambda mod, carry, _: (mod(carry, positions,
+                                               kv_mask), None),
+                    variable_axes=variable_axes,
+                    split_rngs={'params': True},
+                    length=n_moe,
+                    metadata_params={nn.PARTITION_NAME: 'layers'},
+                )(block_cls(cfg, use_moe=True, name='layers'), x, None)
+            else:
+                for i in range(n_moe):
+                    x = block_cls(cfg, use_moe=True,
+                                  name=f'layer_{i}')(x, positions,
+                                                     kv_mask)
+
+        x = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
+                          name='final_norm')(x)
+        head = nn.DenseGeneral(
+            cfg.vocab_size, use_bias=False, name='lm_head',
+            dtype=jnp.float32, param_dtype=cfg.param_dtype,
+            kernel_init=llama._partitioned_init(  # pylint: disable=protected-access
+                nn.initializers.normal(0.02), ('embed_fsdp', 'vocab'),
+                cfg.partition_params))
+        if return_hidden:
+            # Chunked-CE path; head params must exist either way (see
+            # models/llama.py).
+            _ = head(x[:, :1])
+            return x
+        return head(x)
+
+
+def num_params(config: DeepSeekConfig) -> int:
+    """Analytic parameter count (norm scales included)."""
+    cfg = config
+    h = cfg.n_heads
+    dn, dr, dv = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
+                  cfg.v_head_dim)
+    if cfg.q_lora_rank:
+        q = cfg.dim * cfg.q_lora_rank + cfg.q_lora_rank \
+            + cfg.q_lora_rank * h * (dn + dr)
+    else:
+        q = cfg.dim * h * (dn + dr)
+    attn = (q + cfg.dim * cfg.kv_lora_rank + cfg.kv_lora_rank  # down+norm
+            + cfg.dim * dr                                     # k_rope
+            + cfg.kv_lora_rank * h * (dn + dv)                 # up k+v
+            + h * dv * cfg.dim)                                # o_proj
+    dense_mlp = 3 * cfg.dim * cfg.ffn_dim
+    moe_mlp = (cfg.n_experts * 3 * cfg.dim * cfg.moe_ffn_dim
+               + cfg.dim * cfg.n_experts                       # router
+               + 3 * cfg.dim * cfg.n_shared_experts * cfg.moe_ffn_dim)
+    n_dense = min(cfg.first_k_dense, cfg.n_layers)
+    per_layer_common = attn + 2 * cfg.dim
+    total = (cfg.vocab_size * cfg.dim * 2 + cfg.dim
+             + n_dense * (per_layer_common + dense_mlp)
+             + (cfg.n_layers - n_dense) * (per_layer_common + moe_mlp))
+    return total

@@ -497,27 +497,34 @@ class Block(nn.Module):
         return x
 
 
+def maybe_remat(cfg, block_base, *, scanned: bool):
+    """Wrap a block class with the cfg's remat policy.  One definition
+    for every family (incl. heterogeneous stacks like DeepSeek's dense
+    prefix + scanned MoE suffix): the policy-name validation and the
+    prevent_cse rule (only safe to disable inside a scan) must never
+    diverge between call sites."""
+    if not cfg.remat:
+        return block_base
+    policy_name = getattr(cfg, 'remat_policy', 'nothing')
+    if policy_name == 'save_attn':
+        policy = jax.checkpoint_policies.save_only_these_names(
+            'attn_out', 'attn_lse')
+    elif policy_name == 'nothing':
+        policy = jax.checkpoint_policies.nothing_saveable
+    else:
+        raise ValueError(
+            f'Unknown remat_policy {policy_name!r}; expected '
+            "'nothing' or 'save_attn'.")
+    return nn.remat(block_base, prevent_cse=not scanned, policy=policy)
+
+
 def apply_blocks(cfg, block_base, x: jax.Array, positions: jax.Array,
                  kv_mask: Optional[jax.Array]) -> jax.Array:
     """Run the layer stack with the cfg's remat/scan policy — shared by
     every decoder family (Llama/Gemma/GPT-2) so the scan metadata,
     remat policy, and cache axes can never diverge between them.  Must
     be called from inside the parent's @nn.compact __call__."""
-    block_cls = block_base
-    if cfg.remat:
-        policy_name = getattr(cfg, 'remat_policy', 'nothing')
-        if policy_name == 'save_attn':
-            policy = jax.checkpoint_policies.save_only_these_names(
-                'attn_out', 'attn_lse')
-        elif policy_name == 'nothing':
-            policy = jax.checkpoint_policies.nothing_saveable
-        else:
-            raise ValueError(
-                f'Unknown remat_policy {policy_name!r}; expected '
-                "'nothing' or 'save_attn'.")
-        block_cls = nn.remat(
-            block_base, prevent_cse=not cfg.scan_layers,
-            policy=policy)
+    block_cls = maybe_remat(cfg, block_base, scanned=cfg.scan_layers)
     if cfg.scan_layers:
         variable_axes = {'params': 0}
         if getattr(cfg, 'decode', False):

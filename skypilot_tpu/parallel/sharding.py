@@ -33,6 +33,10 @@ DEFAULT_RULES: Dict[str, Optional[Union[str, Tuple[str, ...]]]] = {
     'embed_fsdp': 'fsdp',     # hidden dim of *params*: ZeRO-sharded
     'heads': 'tensor',
     'kv_heads': 'tensor',
+    # MLA latent bottlenecks (models/deepseek.py): contracted against
+    # head-sharded up-projections, so the latent dims stay replicated.
+    'q_lora': None,
+    'kv_lora': None,
     'head_dim': None,
     'mlp': 'tensor',
     'vocab': 'tensor',

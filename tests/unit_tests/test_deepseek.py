@@ -1,0 +1,233 @@
+"""DeepSeek MLA family tests: MLA parameter structure, the
+absorbed-latent decode equivalence (the load-bearing math), the
+latent-cache HBM claim, MoE wiring (shared + routed experts, dense
+prefix), trainer + continuous-batching integration.
+
+Reference parity: the reference serves this family via vLLM
+(llm/deepseek-r1/deepseek-r1-671B.yaml); model code is first-party
+here (models/deepseek.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import models
+from skypilot_tpu.models import deepseek
+from skypilot_tpu.parallel import sharding as sharding_lib
+
+F32 = dict(dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def _count(tree):
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+class TestDeepSeekModel:
+
+    def test_forward_shape_and_registry(self):
+        model, cfg = models.get_model('deepseek-tiny')
+        tokens = jnp.zeros((2, 32), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        logits = model.apply(variables, tokens)
+        assert logits.shape == (2, 32, cfg.vocab_size)
+        assert jnp.isfinite(logits).all()
+        assert 'deepseek-r1' in models.available_models()
+
+    def test_mla_param_structure(self):
+        """MLA signature: latent down-projections + decoupled rope key,
+        and NO full-rank k/v projections anywhere."""
+        model, cfg = models.get_model('deepseek-tiny')
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))
+        params = sharding_lib.unbox(variables['params'])
+        attn = params['dense_0']['attention']
+        assert set(attn) >= {'q_down', 'q_up', 'kv_down', 'kv_up_k',
+                             'kv_up_v', 'k_rope_proj', 'o_proj'}
+        assert 'k_proj' not in attn and 'v_proj' not in attn
+        assert attn['kv_down']['kernel'].shape == (cfg.dim,
+                                                   cfg.kv_lora_rank)
+        assert attn['kv_up_k'].shape == (cfg.kv_lora_rank, cfg.n_heads,
+                                         cfg.qk_nope_head_dim)
+        # Routed experts use moe_ffn_dim, not the dense ffn_dim.
+        moe_mlp = params['layer_0']['moe_mlp']
+        assert moe_mlp['gate_proj'].shape == (cfg.n_experts, cfg.dim,
+                                              cfg.moe_ffn_dim)
+
+    def test_param_count_matches_analytic(self):
+        model, cfg = models.get_model('deepseek-tiny')
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))
+        params = sharding_lib.unbox(variables['params'])
+        assert _count(params) == deepseek.num_params(cfg)
+
+    def test_v3_param_count_sane(self):
+        # DeepSeek-V3/R1 is ~671B total parameters.
+        total = deepseek.num_params(deepseek.CONFIGS['deepseek-v3'])
+        assert 6.3e11 < total < 7.1e11, total
+
+    def test_latent_cache_is_small(self):
+        """The architectural point: decode caches ONE latent head of
+        width kv_lora_rank + qk_rope_head_dim per token — not
+        n_heads * (qk_head_dim + v_head_dim)."""
+        model, cfg = models.get_model('deepseek-tiny', decode=True,
+                                      max_seq_len=16)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 1), jnp.int32))
+        cache = sharding_lib.unbox(variables['cache'])
+        width = cfg.kv_lora_rank + cfg.qk_rope_head_dim
+        for layer in ('dense_0', 'layer_0'):
+            entry = cache[layer]['attention']
+            assert entry['cached_key'].shape == (1, 1, 16, width)
+        # vs an equivalent-materialized MHA cache:
+        mha_width = cfg.n_heads * (cfg.qk_head_dim + cfg.v_head_dim)
+        latent_width = 2 * width  # cached_key + (padded) cached_value
+        assert latent_width < mha_width
+
+    def test_causality(self):
+        cfg = deepseek.get_config('deepseek-tiny', **F32)
+        model = deepseek.DeepSeek(cfg)
+        t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0,
+                                cfg.vocab_size)
+        t2 = t1.at[0, -1].set((t1[0, -1] + 1) % cfg.vocab_size)
+        variables = model.init(jax.random.PRNGKey(0), t1)
+        o1 = model.apply(variables, t1)
+        o2 = model.apply(variables, t2)
+        np.testing.assert_allclose(o1[0, :-1], o2[0, :-1], atol=1e-5)
+
+    def test_absorbed_decode_matches_full_forward(self):
+        """The load-bearing identity: softmax((q_nope W_uk)·c +
+        q_rope·k_rope) · c · W_uv == the training attention — decode
+        through the latent cache must reproduce the full forward."""
+        cfg_full = deepseek.get_config('deepseek-tiny',
+                                       attention_impl='reference',
+                                       **F32)
+        cfg_dec = deepseek.get_config('deepseek-tiny', decode=True,
+                                      max_seq_len=16, **F32)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                    cfg_full.vocab_size)
+        m_full = deepseek.DeepSeek(cfg_full)
+        variables = m_full.init(jax.random.PRNGKey(0), tokens)
+        full_logits = m_full.apply(variables, tokens)
+
+        m_dec = deepseek.DeepSeek(cfg_dec)
+        cache = jax.tree.map(
+            jnp.zeros_like,
+            m_dec.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 1), jnp.int32))['cache'])
+        step_logits = []
+        for i in range(tokens.shape[1]):
+            out, mut = m_dec.apply(
+                {'params': variables['params'], 'cache': cache},
+                tokens[:, i:i + 1],
+                jnp.full((1, 1), i, jnp.int32),
+                mutable=['cache'])
+            cache = mut['cache']
+            step_logits.append(out[:, 0])
+        np.testing.assert_allclose(
+            jnp.stack(step_logits, axis=1), full_logits,
+            atol=2e-3, rtol=2e-3)
+
+    def test_flash_padding_matches_reference(self):
+        """The lane-aligned zero-padding on the flash path is exact:
+        flash and reference forwards agree (tiny shapes run the
+        XLA-native fallback off-TPU, same padding code path)."""
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 32), 0,
+                                    512)
+        outs = {}
+        for impl in ('flash', 'reference'):
+            cfg = deepseek.get_config('deepseek-tiny',
+                                      attention_impl=impl, **F32)
+            model = deepseek.DeepSeek(cfg)
+            variables = model.init(jax.random.PRNGKey(0), tokens)
+            outs[impl] = model.apply(variables, tokens)
+        np.testing.assert_allclose(outs['flash'], outs['reference'],
+                                   atol=2e-3, rtol=2e-3)
+
+
+class TestDeepSeekTraining:
+
+    def test_sharded_train_loss_decreases(self):
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.train import data as data_lib
+        from skypilot_tpu.train import trainer as trainer_lib
+        config = trainer_lib.TrainConfig(
+            model='deepseek-tiny', global_batch_size=8, seq_len=32,
+            total_steps=12, warmup_steps=1,
+            mesh=mesh_lib.MeshConfig(data=2, fsdp=-1),
+            model_overrides={'max_seq_len': 64})
+        trainer = trainer_lib.Trainer(config)
+        trainer.init_state()
+        data_iter = data_lib.synthetic_data(
+            trainer.mesh, global_batch_size=8, seq_len=32,
+            vocab_size=trainer.model_config.vocab_size)
+        batch = next(data_iter)
+        first = last = None
+        for _ in range(12):
+            metrics = trainer.step(batch)
+            loss = float(jax.device_get(metrics['loss']))
+            first = first if first is not None else loss
+            last = loss
+        assert last < first, (first, last)
+
+    def test_router_aux_loss_reaches_trainer(self):
+        """The MoE suffix sows its balance loss; the train step must
+        pick it up (non-zero aux contribution)."""
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.train import data as data_lib
+        from skypilot_tpu.train import trainer as trainer_lib
+        config = trainer_lib.TrainConfig(
+            model='deepseek-tiny', global_batch_size=8, seq_len=32,
+            total_steps=1, mesh=mesh_lib.MeshConfig(data=1, fsdp=-1),
+            model_overrides={'max_seq_len': 64})
+        trainer = trainer_lib.Trainer(config)
+        trainer.init_state()
+        it = data_lib.synthetic_data(
+            trainer.mesh, global_batch_size=8, seq_len=32,
+            vocab_size=trainer.model_config.vocab_size)
+        metrics = jax.device_get(trainer.step(next(it)))
+        assert float(metrics['aux_loss']) > 0.0
+
+    def test_tensor_parallel_init(self):
+        """Head-sharded up-projections + replicated latents resolve
+        under a tensor axis (q_lora/kv_lora rules)."""
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.train import trainer as trainer_lib
+        config = trainer_lib.TrainConfig(
+            model='deepseek-tiny', global_batch_size=4, seq_len=32,
+            total_steps=1,
+            mesh=mesh_lib.MeshConfig(data=2, fsdp=2, tensor=2),
+            model_overrides={'max_seq_len': 64})
+        trainer = trainer_lib.Trainer(config)
+        trainer.init_state()
+        specs = jax.tree.map(
+            lambda s: s.spec, trainer.state_shardings.params,
+            is_leaf=lambda x: hasattr(x, 'spec'))
+        flat = {'/'.join(str(k.key) for k in path): spec
+                for path, spec in
+                jax.tree_util.tree_flatten_with_path(specs)[0]}
+        up = next(v for k, v in flat.items() if 'kv_up_k' in k)
+        assert 'tensor' in tuple(up), flat
+        rope = next(v for k, v in flat.items() if 'k_rope_proj' in k)
+        assert 'tensor' not in tuple(rope), flat  # shared head: replicated
+
+    def test_serving_continuous_engine_matches_cache_free(self):
+        from skypilot_tpu.infer import engine as engine_lib
+        overrides = {'max_seq_len': 64, **F32}
+        eng = engine_lib.ContinuousBatchingEngine(
+            'deepseek-tiny', n_slots=2,
+            model_overrides=dict(overrides),
+            param_dtype=jnp.float32, prefill_bucket=8)
+        prompt = [5, 17, 3, 9]
+        got = eng.generate(
+            [prompt], engine_lib.SamplingConfig(max_new_tokens=5))[0]
+        model, _ = models.get_model('deepseek-tiny', decode=False,
+                                    **overrides)
+        toks = list(prompt)
+        want = []
+        for _ in range(5):
+            logits = model.apply({'params': eng.params},
+                                 jnp.asarray([toks], jnp.int32))
+            nxt = int(jnp.argmax(logits[0, -1]))
+            want.append(nxt)
+            toks.append(nxt)
+        assert got == want, (got, want)
