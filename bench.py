@@ -350,9 +350,10 @@ def run_direct(quick: bool, steps_arg) -> None:
     trainer = trainer_lib.Trainer(config)
     trainer.init_state()
     n_params = llama.num_params(trainer.model_config)
-    data_iter = data_lib.synthetic_data(
-        trainer.mesh, global_batch_size=batch, seq_len=seq,
-        vocab_size=trainer.model_config.vocab_size)
+    data_iter = data_lib.prefetch_to_device(
+        data_lib.synthetic_data(
+            trainer.mesh, global_batch_size=batch, seq_len=seq,
+            vocab_size=trainer.model_config.vocab_size))
     # Warmup (compile) — device_get is the only real sync here.
     jax.device_get(trainer.step(next(data_iter))['loss'])
     t0 = time.time()

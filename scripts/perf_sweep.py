@@ -86,17 +86,19 @@ def run_point(name: str) -> None:
     toks = steps * batch * seq / dt
     from skypilot_tpu.models import llama
     n_params = llama.num_params(trainer.model_config)
-    # fwd-only flops: 2*N per token (+ causal attn fwd 2*L*s*d);
-    # train step: 6*N (+ 6*L*s*d).
+    # Reuse bench's accounting so results transfer 1:1: attn flops
+    # from its helper, peak from the per-generation table.  fwd-only
+    # is the 2x rule (vs the train step's 6x), attn scaled to match.
     mult = 2.0 if fwd_only else 6.0
-    flops_tok = mult * n_params + mult * overrides['n_layers'] * seq * \
-        overrides['dim']
+    flops_tok = mult * n_params + \
+        (mult / 6.0) * bench._attn_flops_per_token(overrides, seq)
     tflops = toks * flops_tok / 1e12
+    peak = bench._gen_tflops(jax.devices()[0].device_kind)
     print(json.dumps({
         'point': name, 'batch': batch, 'block_q': bq, 'block_kv': bkv,
         'fwd_only': fwd_only, 'tokens_per_sec': round(toks, 1),
         'achieved_tflops': round(tflops, 1),
-        'mfu_pct': round(100 * tflops / 197.0, 2),
+        'mfu_pct': round(100 * tflops / peak, 2),
         'step_ms': round(1000 * dt / steps, 1),
     }))
 
@@ -112,10 +114,17 @@ def main() -> None:
     for name in args.points.split(','):
         cmd = [sys.executable, os.path.abspath(__file__), '--point', name]
         t0 = time.time()
-        proc = subprocess.run(cmd, timeout=900, capture_output=True,
-                              text=True, check=False,
-                              cwd=os.path.dirname(os.path.dirname(
-                                  os.path.abspath(__file__))))
+        try:
+            proc = subprocess.run(cmd, timeout=900, capture_output=True,
+                                  text=True, check=False,
+                                  cwd=os.path.dirname(os.path.dirname(
+                                      os.path.abspath(__file__))))
+        except subprocess.TimeoutExpired:
+            # A wedged point must kill only that point (the whole
+            # reason for subprocess isolation).
+            print(json.dumps({'point': name, 'error': 'timeout900'}),
+                  flush=True)
+            continue
         for line in proc.stdout.splitlines():
             if line.startswith('{'):
                 print(line, flush=True)

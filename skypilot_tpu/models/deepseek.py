@@ -352,25 +352,10 @@ class DeepSeek(nn.Module):
         # Homogeneous MoE suffix: scanned (llama.apply_blocks recipe).
         n_moe = cfg.n_layers - n_dense
         if n_moe:
-            block_cls = llama.maybe_remat(cfg, DeepSeekBlock,
-                                          scanned=cfg.scan_layers)
-            if cfg.scan_layers:
-                variable_axes = {'params': 0, 'intermediates': 0}
-                if cfg.decode:
-                    variable_axes['cache'] = 0
-                x, _ = nn.scan(
-                    lambda mod, carry, _: (mod(carry, positions,
-                                               kv_mask), None),
-                    variable_axes=variable_axes,
-                    split_rngs={'params': True},
-                    length=n_moe,
-                    metadata_params={nn.PARTITION_NAME: 'layers'},
-                )(block_cls(cfg, use_moe=True, name='layers'), x, None)
-            else:
-                for i in range(n_moe):
-                    x = block_cls(cfg, use_moe=True,
-                                  name=f'layer_{i}')(x, positions,
-                                                     kv_mask)
+            x = llama.apply_blocks(cfg, DeepSeekBlock, x, positions,
+                                   kv_mask, n_layers=n_moe,
+                                   sow_intermediates=True,
+                                   block_kwargs={'use_moe': True})
 
         x = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                           name='final_norm')(x)

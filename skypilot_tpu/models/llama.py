@@ -519,14 +519,30 @@ def maybe_remat(cfg, block_base, *, scanned: bool):
 
 
 def apply_blocks(cfg, block_base, x: jax.Array, positions: jax.Array,
-                 kv_mask: Optional[jax.Array]) -> jax.Array:
+                 kv_mask: Optional[jax.Array], *,
+                 n_layers: Optional[int] = None,
+                 sow_intermediates: bool = False,
+                 block_kwargs: Optional[Dict[str, Any]] = None
+                 ) -> jax.Array:
     """Run the layer stack with the cfg's remat/scan policy — shared by
-    every decoder family (Llama/Gemma/GPT-2) so the scan metadata,
-    remat policy, and cache axes can never diverge between them.  Must
-    be called from inside the parent's @nn.compact __call__."""
+    every decoder family (Llama/Gemma/GPT-2/Qwen, Mixtral and
+    DeepSeek's MoE suffix via the keyword extensions) so the scan
+    metadata, remat policy, and cache axes can never diverge between
+    them.  Must be called from inside the parent's @nn.compact
+    __call__.
+
+    `n_layers` overrides cfg.n_layers (heterogeneous stacks scan only
+    their homogeneous suffix); `sow_intermediates` adds the
+    'intermediates' scan axis MoE families need for their sown router
+    aux losses; `block_kwargs` is forwarded to every block
+    construction."""
     block_cls = maybe_remat(cfg, block_base, scanned=cfg.scan_layers)
+    length = cfg.n_layers if n_layers is None else n_layers
+    kwargs = block_kwargs or {}
     if cfg.scan_layers:
         variable_axes = {'params': 0}
+        if sow_intermediates:
+            variable_axes['intermediates'] = 0
         if getattr(cfg, 'decode', False):
             variable_axes['cache'] = 0
         x, _ = nn.scan(
@@ -534,13 +550,13 @@ def apply_blocks(cfg, block_base, x: jax.Array, positions: jax.Array,
                                    None),
             variable_axes=variable_axes,
             split_rngs={'params': True},
-            length=cfg.n_layers,
+            length=length,
             metadata_params={nn.PARTITION_NAME: 'layers'},
-        )(block_cls(cfg, name='layers'), x, None)
+        )(block_cls(cfg, name='layers', **kwargs), x, None)
     else:
-        for i in range(cfg.n_layers):
-            x = block_cls(cfg, name=f'layer_{i}')(x, positions,
-                                                  kv_mask)
+        for i in range(length):
+            x = block_cls(cfg, name=f'layer_{i}', **kwargs)(
+                x, positions, kv_mask)
     return x
 
 

@@ -242,27 +242,10 @@ class Mixtral(nn.Module):
             (cfg.vocab_size, cfg.dim), cfg.param_dtype)
         x = jnp.take(embed.astype(cfg.dtype), tokens, axis=0)
 
-        block_cls = MoEBlock
-        if cfg.remat:
-            block_cls = nn.remat(
-                MoEBlock, prevent_cse=not cfg.scan_layers,
-                policy=jax.checkpoint_policies.nothing_saveable)
-        if cfg.scan_layers:
-            variable_axes = {'params': 0, 'intermediates': 0}
-            if cfg.decode:
-                variable_axes['cache'] = 0
-            x, _ = nn.scan(
-                lambda mod, carry, _: (mod(carry, positions, kv_mask),
-                                       None),
-                variable_axes=variable_axes,
-                split_rngs={'params': True},
-                length=cfg.n_layers,
-                metadata_params={nn.PARTITION_NAME: 'layers'},
-            )(block_cls(cfg, name='layers'), x, None)
-        else:
-            for i in range(cfg.n_layers):
-                x = block_cls(cfg, name=f'layer_{i}')(x, positions,
-                                                      kv_mask)
+        # Shared stack recipe (scan metadata + remat policy live in ONE
+        # place; sow axis for the router aux loss).
+        x = llama.apply_blocks(cfg, MoEBlock, x, positions, kv_mask,
+                               sow_intermediates=True)
         x = llama.RMSNorm(cfg.norm_eps, cfg.dtype, cfg.partition_params,
                           name='final_norm')(x)
         head = nn.DenseGeneral(

@@ -537,6 +537,19 @@ class Trainer:
             self.init_state()
         steps = num_steps if num_steps is not None else cfg.total_steps
         tokens_per_step = cfg.global_batch_size * cfg.seq_len
+        # Double-buffered input: host gen + host->device transfer of
+        # batch N+1 overlaps step N's compute (train/data.py
+        # prefetch_to_device).  CONTRACT: the producer thread reads up
+        # to depth+1 batches past the last consumed step, so a caller
+        # that reuses `data_iter` after train() returns would skip
+        # them — set SKYTPU_PREFETCH_DEPTH=0 for that pattern (or any
+        # test that counts batches).
+        prefetch_depth = int(os.environ.get('SKYTPU_PREFETCH_DEPTH',
+                                            '2'))
+        if prefetch_depth > 0:
+            from skypilot_tpu.train import data as data_lib
+            data_iter = data_lib.prefetch_to_device(data_iter,
+                                                    prefetch_depth)
         # Workload profiling (the TPU analog of what the reference
         # delegates to user tools): SKYTPU_PROFILE_DIR=<dir> (or
         # SKYTPU_PROFILE=1 to write under the job log dir) captures an

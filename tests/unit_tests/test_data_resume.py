@@ -95,3 +95,35 @@ class TestHfResume:
         resumed = data_lib.hf_text_data(mesh, start_step=2, **kwargs)
         np.testing.assert_array_equal(_take(resumed, 1)[0],
                                       first_four[2])
+
+
+class TestPrefetchToDevice:
+    """Double-buffered input pipeline (train/data.py
+    prefetch_to_device): order-exact passthrough, clean termination,
+    producer exceptions reach the consumer."""
+
+    def test_order_exact_vs_unwrapped(self, mesh):
+        kw = dict(global_batch_size=8, seq_len=8, vocab_size=64)
+        plain = _take(data_lib.synthetic_data(mesh, **kw), 5)
+        wrapped = _take(data_lib.prefetch_to_device(
+            data_lib.synthetic_data(mesh, **kw), depth=2), 5)
+        for a, b in zip(plain, wrapped):
+            np.testing.assert_array_equal(a, b)
+
+    def test_finite_iterator_terminates(self):
+        out = list(data_lib.prefetch_to_device(iter(range(7)), depth=3))
+        assert out == list(range(7))
+
+    def test_producer_exception_propagates(self):
+        def boom():
+            yield 1
+            raise RuntimeError('dataset died')
+
+        it = data_lib.prefetch_to_device(boom(), depth=2)
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match='dataset died'):
+            next(it)
+
+    def test_depth_zero_is_passthrough(self):
+        assert list(data_lib.prefetch_to_device(iter('abc'),
+                                                depth=0)) == list('abc')
