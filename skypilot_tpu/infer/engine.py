@@ -239,6 +239,49 @@ def _cache_sharding(mesh, leaf) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def decode_cache_read_bytes(abstract_cache: Any, n_heads: int,
+                            context: Optional[int] = None
+                            ) -> Dict[str, float]:
+    """Per-decode-step KV-cache read traffic estimate (HBM bytes).
+
+    Walks the cache pytree (K/V leaves are [B, kvh, S, hd] unscanned or
+    [L, B, kvh, S, hd] scanned; cursor/scalar leaves are skipped) and
+    sums the bytes one decode step streams from HBM:
+
+      - ``grouped_bytes``: what the grouped-einsum epilogue
+        (ops/grouped_attention.py) reads — each cache row once, at its
+        stored kvh head count;
+      - ``repeat_bytes``: what the old repeat-then-matmul epilogue
+        forced — every row materialized n_heads // kvh times so each
+        query head could matmul its own copy.
+
+    ``context`` caps the read length per row (a half-full cache reads
+    half the bytes); None charges the full static S.  The ratio
+    ``repeat_bytes / grouped_bytes`` is the h-fold bandwidth win the
+    grouped path keeps: n_heads/kvh per GQA leaf, n_heads for a
+    DeepSeek absorbed latent cache (kvh == 1).
+    """
+    grouped = 0
+    repeated = 0
+    for leaf in jax.tree.leaves(abstract_cache):
+        if leaf.ndim == 4:
+            layers, (b, kvh, s, hd) = 1, leaf.shape
+        elif leaf.ndim == 5:
+            layers, b, kvh, s, hd = leaf.shape
+        else:
+            continue  # cursors / scalars: not streamed per step
+        read_len = s if context is None else min(context, s)
+        itemsize = np.dtype(leaf.dtype).itemsize
+        leaf_bytes = layers * b * kvh * read_len * hd * itemsize
+        grouped += leaf_bytes
+        repeated += leaf_bytes * max(1, n_heads // kvh)
+    return {
+        'grouped_bytes': float(grouped),
+        'repeat_bytes': float(repeated),
+        'reduction': float(repeated) / float(grouped) if grouped else 1.0,
+    }
+
+
 @dataclasses.dataclass
 class _Slot:
     """Host-side state of one occupied decode slot."""
@@ -438,6 +481,12 @@ class ContinuousBatchingEngine:
         # Tokens are pushed as they decode; completion/cancel/abort
         # push a sentinel so readers never block forever.
         self._stream_queues: Dict[int, Any] = {}
+
+    def cache_read_bytes_per_step(self, context: Optional[int] = None
+                                  ) -> Dict[str, float]:
+        """Estimated HBM bytes one decode step reads from the shared
+        [n_slots, ...] cache — see decode_cache_read_bytes."""
+        return self._eng.cache_read_bytes_per_step(context)
 
     @property
     def params(self):
@@ -1087,6 +1136,14 @@ class InferenceEngine:
         b = self.prefill_bucket
         padded = ((s_max + b - 1) // b) * b
         return min(padded, self.max_seq_len)
+
+    def cache_read_bytes_per_step(self, context: Optional[int] = None
+                                  ) -> Dict[str, float]:
+        """Estimated HBM bytes one decode step reads from THIS engine's
+        cache (grouped epilogue vs the old repeat path) — see
+        decode_cache_read_bytes."""
+        return decode_cache_read_bytes(self._abstract_cache,
+                                       self.config.n_heads, context)
 
     # -- generation --------------------------------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],

@@ -32,6 +32,23 @@ the architecture — is exploited on TPU:
 Training attention pads q/k/v to a lane-aligned head width for the
 flash kernel (zero-padding is exact for dot products; the softmax
 scale is pinned to the true `qk_head_dim`).
+
+Known divergences from upstream DeepSeek v3/r1 — this family is
+architecture-shaped, NOT checkpoint-compatible:
+
+  - **Router**: routed experts use the shared Mixtral-style
+    softmax-top-k router with the Switch load-balancing aux loss
+    (models/moe.py).  Real v3/r1 routes with per-expert *sigmoid*
+    affinities, normalizes over the selected top-k only, and balances
+    loss-free via a learned per-expert bias nudged by an online update
+    — no aux-loss gradient interference.  Expect different expert
+    utilization dynamics, and do not expect upstream router weights to
+    transfer.
+  - **RoPE**: plain `rope_theta=1e4` at the configured 32k context.
+    Real v3/r1 trains at 4k native and extends to 128k with YaRN
+    (scaled theta + attention-temperature correction).  Long-context
+    behavior past a few thousand tokens therefore matches neither
+    upstream quality nor its positional geometry.
 """
 from __future__ import annotations
 
@@ -262,8 +279,11 @@ class MLAAttention(nn.Module):
         v_eff = c zero-padded to width rkv + dr
         then  q_eff·k_eff == q·k  and  (probs·v_eff)[..:rkv]·W_uv == out,
         so llama.run_cached_attention (slot-mode continuous batching,
-        kv buckets, GQA broadcast of the single latent head) is reused
-        verbatim.  Its internal scale is width**-0.5 of the LATENT
+        kv buckets) is reused verbatim.  Its grouped epilogue's kvh==1
+        branch scores all H query heads directly against the single
+        [B, 1, S, rkv+dr] latent (ops/grouped_attention.py) — the cache
+        is never broadcast to H heads, preserving MLA's bandwidth win
+        at decode.  Its internal scale is width**-0.5 of the LATENT
         width; q is pre-multiplied to land on the true qk_head_dim
         scale."""
         cfg = self.config

@@ -12,7 +12,11 @@ the attention/MLP/block machinery is shared:
 
 Sharing the blocks means Gemma inherits the Pallas flash/ring attention
 paths, GQA, KV-cache decode, scan + remat, and the logical-axis
-sharding rules without re-implementation.
+sharding rules without re-implementation.  Gemma-7B is MQA-like
+(n_kv_heads=1, 8 query heads): decode scores all heads against the
+single cached kv head via the grouped epilogue's kvh==1 branch
+(ops/grouped_attention.py) — the cache is never broadcast to n_heads
+in HBM.
 """
 from __future__ import annotations
 

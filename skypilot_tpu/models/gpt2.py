@@ -5,7 +5,11 @@ A second *architecture* family, not a Llama retune: LayerNorm with
 bias, learned positional embeddings (no rope), biased projections,
 single-head-group MHA, GELU MLP, tied lm_head.  Attention still runs on
 the shared Pallas flash kernel and params carry the same logical axis
-names, so fsdp/tensor sharding rules apply unchanged.
+names, so fsdp/tensor sharding rules apply unchanged.  Cached decode
+goes through llama.run_cached_attention with n_kv_heads == n_heads,
+which the grouped epilogue (ops/grouped_attention.py) dispatches to its
+plain per-head MHA branch — same code path as the GQA families, no
+grouping overhead.
 """
 from __future__ import annotations
 

@@ -9,8 +9,10 @@ Design notes:
   - every parameter carries *logical* axis names via nn.with_partitioning;
     parallel/sharding.py maps them to mesh axes (fsdp/tensor/...)
   - attention runs on the Pallas flash kernel (ops/flash_attention) with
-    GQA (kv head broadcast) and rotary embeddings; context-parallel ring
-    attention slots in via `attention_impl='ring'`
+    bandwidth-optimal GQA — K/V stay at n_kv_heads end-to-end and the
+    head-group broadcast happens inside the kernels/einsums
+    (ops/grouped_attention) — and rotary embeddings; context-parallel
+    ring attention slots in via `attention_impl='ring'`
   - layers are scanned (nn.scan) so compile time is O(1) in depth
   - activations/computation in bfloat16, params f32 (master), RMSNorm and
     softmax accumulate in f32
@@ -28,6 +30,7 @@ from jax.ad_checkpoint import checkpoint_name
 import jax.numpy as jnp
 
 from skypilot_tpu.ops import flash_attention as fa
+from skypilot_tpu.ops import grouped_attention as ga
 
 
 @dataclasses.dataclass(frozen=True)
@@ -348,15 +351,13 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
         if kv_mask is not None:
             mask = mask & kv_mask[:, None, None, :]
         keys, values = cached_k.value, cached_v.value
-    if kvh != h:
-        keys = jnp.repeat(keys, h // kvh, axis=1)
-        values = jnp.repeat(values, h // kvh, axis=1)
-    scores = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
-                        keys.astype(jnp.float32)) * (hd ** -0.5)
-    scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum('bhqk,bhkd->bhqd', probs.astype(dtype), values)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    # Grouped epilogue: the cache stays [B, kvh, read_len, hd] — the
+    # head-group broadcast happens inside the einsum, never in HBM
+    # (ops/grouped_attention.py).  The scale intentionally uses q's
+    # LAST dim: DeepSeek's absorbed decode pre-multiplies q so this
+    # lands on the true qk_head_dim scale (models/deepseek.py).
+    return ga.grouped_attention(q, keys, values, mask,
+                                scale=hd ** -0.5, probs_dtype=dtype)
 
 
 class Attention(nn.Module):
@@ -404,9 +405,11 @@ class Attention(nn.Module):
                 cfg, 'o_proj', flat,
                 dense(cfg.dim, ('heads', 'embed_fsdp'), 'o_proj')(flat),
                 cfg.dim)
-        if kv != h:  # GQA: broadcast kv heads to query heads
-            k = jnp.repeat(k, h // kv, axis=1)
-            v = jnp.repeat(v, h // kv, axis=1)
+        # GQA k/v stay at n_kv_heads: the flash kernel maps group
+        # members onto shared kv blocks via its BlockSpec index maps,
+        # the XLA fallback uses the grouped einsum, and the ring
+        # rotates [B, kvh, S/c, d] chunks (h/kvh-fold less ICI
+        # traffic).  No repeat ever materializes [B, H, S, d] K/V.
         # Duck-typed families (Gemma/Qwen share this module)
         # may not declare the field.
         window = getattr(cfg, 'sliding_window', None)

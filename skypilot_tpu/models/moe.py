@@ -15,7 +15,9 @@ handle EP internally"); here expert parallelism is first-party:
   - a load-balance auxiliary loss (Switch Transformers) is sown under
     `intermediates/aux_loss` for the trainer to fold in;
   - everything else (GQA flash attention, RMSNorm, rope, scan/remat)
-    reuses the Llama blocks, so dp/fsdp/tp compose with ep.
+    reuses the Llama blocks, so dp/fsdp/tp compose with ep — including
+    the grouped no-K/V-repeat decode epilogue: Mixtral's 4:1 GQA cache
+    is read at n_kv_heads per step (ops/grouped_attention.py).
 """
 from __future__ import annotations
 

@@ -17,7 +17,8 @@ The reference delegates attention to torch-xla's flash attention
     interpreter mode.
 
 Layout: [batch, num_heads, seq, head_dim] ("BHSD"), head_dim a multiple
-of 128 on TPU for MXU alignment.
+of 128 on TPU for MXU alignment.  K/V may carry fewer heads than q
+(GQA/MQA) — they are read unbroadcast; see `flash_attention`.
 """
 from __future__ import annotations
 
@@ -47,6 +48,15 @@ def _on_tpu() -> bool:
 FORCE_PALLAS = os.environ.get('SKYTPU_FORCE_PALLAS', '') == '1'
 
 
+def _group_counts(q: jax.Array, k: jax.Array) -> Tuple[int, int]:
+    """(kv_heads, group) for GQA inputs; validates divisibility."""
+    heads, kvh = q.shape[1], k.shape[1]
+    if heads % kvh:
+        raise ValueError(
+            f'query heads ({heads}) not divisible by kv heads ({kvh})')
+    return kvh, heads // kvh
+
+
 def _mha_fwd_xla(q: jax.Array, k: jax.Array, v: jax.Array, *,
                  scale: float, causal: bool,
                  window: Optional[int] = None,
@@ -55,13 +65,22 @@ def _mha_fwd_xla(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """XLA-native (out, lse) forward with the same semantics as the
     pallas kernel (used off-TPU; XLA fuses this fine on CPU).
 
+    GQA inputs (k/v with fewer heads than q) contract grouped —
+    [B, kvh, G, Sq, d] x [B, kvh, Sk, d] — so K/V are never broadcast
+    to H heads in HBM; with kvh == H the group axis is size 1 and the
+    math is the classic per-head form.
+
     `offset`: query block's global position lead over the kv block
     (ring attention off-diagonal pairs): query row r sits at global
     position r + offset relative to kv column positions."""
-    s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
+    batch, heads, seq_q, _ = q.shape
+    kvh, group = _group_counts(q, k)
+    qg = q.astype(jnp.float32).reshape(batch, kvh, group, seq_q,
+                                       q.shape[-1])
+    s = jnp.einsum('bngqd,bnkd->bngqk', qg,
                    k.astype(jnp.float32)) * scale
     if causal:
-        seq_q, seq_kv = s.shape[-2:]
+        seq_kv = k.shape[2]
         mask = jnp.tril(jnp.ones((seq_q, seq_kv), bool),
                         k=seq_kv - seq_q + offset)
         if window is not None:
@@ -74,27 +93,45 @@ def _mha_fwd_xla(q: jax.Array, k: jax.Array, v: jax.Array, *,
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    out = jnp.einsum('bhqk,bhkd->bhqd', p / l_safe,
+    out = jnp.einsum('bngqk,bnkd->bngqd', p / l_safe,
                      v.astype(jnp.float32)).astype(q.dtype)
-    lse = (m + jnp.log(l_safe))[..., 0]
+    out = out.reshape(batch, heads, seq_q, v.shape[-1])
+    lse = (m + jnp.log(l_safe))[..., 0].reshape(batch, heads, seq_q)
     return out, lse
 
 
 def _out_vma(*arrays):
     """Varying-manual-axes type for pallas outputs: the union of the
     inputs' vma (empty outside shard_map; e.g. {'pipe'} inside a
-    pipeline stage, {'context'} inside a ring-attention shard)."""
-    vmas = [getattr(jax.typeof(a), 'vma', None) for a in arrays]
+    pipeline stage, {'context'} inside a ring-attention shard).
+
+    None on jax builds without `jax.typeof` (pre-vma-typing): there the
+    manual-axes machinery doesn't exist, so outputs carry no vma."""
+    typeof = getattr(jax, 'typeof', None)
+    if typeof is None:
+        return None
+    vmas = [getattr(typeof(a), 'vma', None) for a in arrays]
     vmas = [v for v in vmas if v is not None]
     if not vmas:
         return None
     return frozenset().union(*vmas)
 
 
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct that only passes `vma=` when there is one —
+    older jax's constructor rejects the kwarg outright."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 def _cast_vma(x: jax.Array, vma) -> jax.Array:
     """Mark a freshly-created (replicated-typed) array as varying over
     `vma` so scan carries type-check inside shard_map manual regions."""
-    have = getattr(jax.typeof(x), 'vma', None) or frozenset()
+    typeof = getattr(jax, 'typeof', None)
+    if typeof is None:
+        return x
+    have = getattr(typeof(x), 'vma', None) or frozenset()
     missing = (vma or frozenset()) - have
     if missing:
         return jax.lax.pcast(x, tuple(missing), to='varying')
@@ -198,24 +235,32 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float,
                block_kv: int) -> Tuple[jax.Array, jax.Array]:
     batch, heads, seq_q, d = q.shape
     seq_kv = k.shape[2]
+    kvh, group = _group_counts(q, k)
     bh = batch * heads
     block_q = _pick_block(seq_q, block_q, 'query')
     block_kv = _pick_block(seq_kv, block_kv, 'key/value')
     q3 = q.reshape(bh, seq_q, d)
-    k3 = k.reshape(bh, seq_kv, d)
-    v3 = v.reshape(bh, seq_kv, d)
+    k3 = k.reshape(batch * kvh, seq_kv, d)
+    v3 = v.reshape(batch * kvh, seq_kv, d)
     grid = (bh, pl.cdiv(seq_q, block_q), pl.cdiv(seq_kv, block_kv))
     kernel = functools.partial(_flash_fwd_kernel, scale=scale,
                                causal=causal, window=window,
                                offset=offset, block_q=block_q,
                                block_kv=block_kv)
+    # GQA without materialization: program b serves query head
+    # (b % heads); its kv row in the UNBROADCAST k3/v3 is the group's
+    # shared head — the index map aliases group members onto the same
+    # block, so the broadcast happens in the BlockSpec, not in HBM.
+    kv_row = lambda b: b // heads * kvh + (b % heads) // group
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d),
+                         lambda b, i, j: (kv_row(b), j, 0)),
+            pl.BlockSpec((1, block_kv, d),
+                         lambda b, i, j: (kv_row(b), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -224,10 +269,8 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, scale: float,
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype,
-                                 vma=_out_vma(q3, k3, v3)),
-            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32,
-                                 vma=_out_vma(q3, k3, v3)),
+            _sds((bh, seq_q, d), q.dtype, _out_vma(q3, k3, v3)),
+            _sds((bh, seq_q, 1), jnp.float32, _out_vma(q3, k3, v3)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -322,7 +365,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
                           causal: bool, window: Optional[int],
                           offset: int, block_q: int,
-                          block_kv: int) -> None:
+                          block_kv: int, nq_blocks: int) -> None:
+    # Grid dim 0 runs over batch*KV heads; the inner dim folds (group
+    # member, q block) as j = g * nq_blocks + qj so one kv block's
+    # dk/dv accumulate over EVERY query head sharing it before the
+    # output block flushes (init at the first inner step, finalize at
+    # the last — accumulation across group members included).
     ki = pl.program_id(1)
     qj = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -332,7 +380,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q_start = qj * block_q
+    q_start = (qj % nq_blocks) * block_q
     k_start = ki * block_kv
     should_run = True
     if causal:
@@ -366,24 +414,33 @@ def _flash_bwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
                       window: Optional[int], offset: int, block_q: int,
                       block_kv: int
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Pallas dq + dk/dv backward. lse/delta are [B,H,S] f32."""
+    """Pallas dq + dk/dv backward. lse/delta are [B,H,S] f32.
+
+    GQA (k/v at kvh < H heads): dq reads shared kv blocks through the
+    same index-map aliasing as the forward; the dk/dv pass folds
+    (group member, q block) into its inner grid dim so each kv block's
+    gradients accumulate over all H/kvh query heads sharing it — dk/dv
+    come back at [B, kvh, S, d], no repeated operand anywhere."""
     batch, heads, seq_q, d = q.shape
     seq_kv = k.shape[2]
+    kvh, group = _group_counts(q, k)
     bh = batch * heads
     block_q = _pick_block(seq_q, block_q, 'query')
     block_kv = _pick_block(seq_kv, block_kv, 'key/value')
     nq = pl.cdiv(seq_q, block_q)
     nk = pl.cdiv(seq_kv, block_kv)
     q3 = q.reshape(bh, seq_q, d)
-    k3 = k.reshape(bh, seq_kv, d)
-    v3 = v.reshape(bh, seq_kv, d)
+    k3 = k.reshape(batch * kvh, seq_kv, d)
+    v3 = v.reshape(batch * kvh, seq_kv, d)
     do3 = do.reshape(bh, seq_q, d)
     lse3 = lse.astype(jnp.float32).reshape(bh, seq_q, 1)
     delta3 = delta.astype(jnp.float32).reshape(bh, seq_q, 1)
     vma = _out_vma(q3, k3, v3, do3)
+    kv_row = lambda b: b // heads * kvh + (b % heads) // group
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    kv_q_inner = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, j, 0))
+    kv_q_inner = pl.BlockSpec((1, block_kv, d),
+                              lambda b, i, j: (kv_row(b), j, 0))
     row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
@@ -394,35 +451,43 @@ def _flash_bwd_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
         in_specs=[q_spec, kv_q_inner, kv_q_inner, q_spec, row_spec,
                   row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), jnp.float32,
-                                       vma=vma),
+        out_shape=_sds((bh, seq_q, d), jnp.float32, vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=not _on_tpu(),
     )(q3, k3, v3, do3, lse3, delta3)
 
-    # dk/dv pass: kv blocks outer, q blocks inner.
+    # dk/dv pass: grid dim 0 over batch*kvh, kv blocks next, then the
+    # folded (group member, q block) inner dim j = g * nq + qj.  The
+    # q-row for program (b, i, j) is batch (b // kvh), query head
+    # (b % kvh) * group + j // nq.  Output kv blocks stay resident
+    # across the whole inner sweep, so accumulation over group members
+    # is contiguous (Pallas revisiting rule).
+    q_row = lambda b, j: b // kvh * heads + (b % kvh) * group + j // nq
     kv_spec = pl.BlockSpec((1, block_kv, d), lambda b, i, j: (b, i, 0))
-    q_inner = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
-    row_inner = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    q_inner = pl.BlockSpec((1, block_q, d),
+                           lambda b, i, j: (q_row(b, j), j % nq, 0))
+    row_inner = pl.BlockSpec((1, block_q, 1),
+                             lambda b, i, j: (q_row(b, j), j % nq, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale,
                           causal=causal, window=window, offset=offset,
-                          block_q=block_q, block_kv=block_kv),
-        grid=(bh, nk, nq),
+                          block_q=block_q, block_kv=block_kv,
+                          nq_blocks=nq),
+        grid=(batch * kvh, nk, group * nq),
         in_specs=[q_inner, kv_spec, kv_spec, q_inner, row_inner,
                   row_inner],
         out_specs=[kv_spec, kv_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq_kv, d), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((bh, seq_kv, d), jnp.float32, vma=vma),
+            _sds((batch * kvh, seq_kv, d), jnp.float32, vma),
+            _sds((batch * kvh, seq_kv, d), jnp.float32, vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
                         pltpu.VMEM((block_kv, d), jnp.float32)],
         interpret=not _on_tpu(),
     )(q3, k3, v3, do3, lse3, delta3)
     return (dq.reshape(batch, heads, seq_q, d),
-            dk.reshape(batch, heads, seq_kv, d),
-            dv.reshape(batch, heads, seq_kv, d))
+            dk.reshape(batch, kvh, seq_kv, d),
+            dv.reshape(batch, kvh, seq_kv, d))
 
 
 # ---------------------------------------------------------------------------
@@ -432,8 +497,14 @@ def _flash_bwd_xla(q, k, v, do, lse, delta, *, scale: float, causal: bool,
                    window: Optional[int], offset: int,
                    block_q: int, block_kv: int
                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Grouped throughout: q/do blocks carry a [kvh, group] head split,
+    k/v blocks stay at kvh heads, and the dk/dv einsums reduce over the
+    group axis — so dk/dv come back at [B, kvh, S, d] (matching the
+    unbroadcast inputs) without a repeated operand.  With kvh == H the
+    group axis is size 1 and this is the classic per-head backward."""
     batch, heads, seq_q, d = q.shape
     seq_kv = k.shape[2]
+    kvh, group = _group_counts(q, k)
     block_q = _pick_block(seq_q, block_q, 'query')
     block_kv = _pick_block(seq_kv, block_kv, 'key/value')
     nq = seq_q // block_q
@@ -444,24 +515,24 @@ def _flash_bwd_xla(q, k, v, do, lse, delta, *, scale: float, causal: bool,
     vf = v.astype(jnp.float32)
     dof = do.astype(jnp.float32)
 
-    q_blocks = qf.reshape(batch, heads, nq, block_q, d)
-    do_blocks = dof.reshape(batch, heads, nq, block_q, d)
-    lse_blocks = lse.reshape(batch, heads, nq, block_q)
-    delta_blocks = delta.reshape(batch, heads, nq, block_q)
-    k_blocks = kf.reshape(batch, heads, nk, block_kv, d)
-    v_blocks = vf.reshape(batch, heads, nk, block_kv, d)
+    q_blocks = qf.reshape(batch, kvh, group, nq, block_q, d)
+    do_blocks = dof.reshape(batch, kvh, group, nq, block_q, d)
+    lse_blocks = lse.reshape(batch, kvh, group, nq, block_q)
+    delta_blocks = delta.reshape(batch, kvh, group, nq, block_q)
+    k_blocks = kf.reshape(batch, kvh, nk, block_kv, d)
+    v_blocks = vf.reshape(batch, kvh, nk, block_kv, d)
 
     def q_step(carry, qi):
         dk_acc, dv_acc = carry
-        q_i = q_blocks[:, :, qi]                   # [B,H,bq,d]
-        do_i = do_blocks[:, :, qi]
-        lse_i = lse_blocks[:, :, qi]               # [B,H,bq]
-        delta_i = delta_blocks[:, :, qi]
+        q_i = q_blocks[:, :, :, qi]                # [B,n,g,bq,d]
+        do_i = do_blocks[:, :, :, qi]
+        lse_i = lse_blocks[:, :, :, qi]            # [B,n,g,bq]
+        delta_i = delta_blocks[:, :, :, qi]
 
         def kv_step(dq_i, ki):
-            k_j = k_blocks[:, :, ki]               # [B,H,bkv,d]
+            k_j = k_blocks[:, :, ki]               # [B,n,bkv,d]
             v_j = v_blocks[:, :, ki]
-            s = jnp.einsum('bhqd,bhkd->bhqk', q_i, k_j) * scale
+            s = jnp.einsum('bngqd,bnkd->bngqk', q_i, k_j) * scale
             if causal:
                 rows = qi * block_q + offset + \
                     jax.lax.broadcasted_iota(
@@ -472,32 +543,35 @@ def _flash_bwd_xla(q, k, v, do, lse, delta, *, scale: float, causal: bool,
                 if window is not None:
                     keep &= cols >= rows - window + 1
                 s = jnp.where(keep, s, _NEG_INF)
-            p = jnp.exp(s - lse_i[..., None])      # [B,H,bq,bkv]
-            dp = jnp.einsum('bhqd,bhkd->bhqk', do_i, v_j)
+            p = jnp.exp(s - lse_i[..., None])      # [B,n,g,bq,bkv]
+            dp = jnp.einsum('bngqd,bnkd->bngqk', do_i, v_j)
             ds = p * (dp - delta_i[..., None]) * scale
-            dq_i = dq_i + jnp.einsum('bhqk,bhkd->bhqd', ds, k_j)
-            dk_j = jnp.einsum('bhqk,bhqd->bhkd', ds, q_i)
-            dv_j = jnp.einsum('bhqk,bhqd->bhkd', p, do_i)
+            dq_i = dq_i + jnp.einsum('bngqk,bnkd->bngqd', ds, k_j)
+            # dk/dv reduce over the group axis too: every query head
+            # sharing the kv head contributes to its gradient.
+            dk_j = jnp.einsum('bngqk,bngqd->bnkd', ds, q_i)
+            dv_j = jnp.einsum('bngqk,bngqd->bnkd', p, do_i)
             return dq_i, (dk_j, dv_j)
 
         dq_i0 = _cast_vma(jnp.zeros_like(q_i), vma)
         dq_i, (dk_js, dv_js) = jax.lax.scan(kv_step, dq_i0,
                                             jnp.arange(nk))
-        # dk_js: [nk,B,H,bkv,d] — accumulate into the carried full dk/dv.
+        # dk_js: [nk,B,n,bkv,d] — accumulate into the carried full dk/dv.
         dk_acc = dk_acc + jnp.moveaxis(dk_js, 0, 2).reshape(
-            batch, heads, seq_kv, d)
+            batch, kvh, seq_kv, d)
         dv_acc = dv_acc + jnp.moveaxis(dv_js, 0, 2).reshape(
-            batch, heads, seq_kv, d)
+            batch, kvh, seq_kv, d)
         return (dk_acc, dv_acc), dq_i
 
     (dk, dv), dq_blocks = jax.lax.scan(
         q_step,
-        (_cast_vma(jnp.zeros((batch, heads, seq_kv, d), jnp.float32),
+        (_cast_vma(jnp.zeros((batch, kvh, seq_kv, d), jnp.float32),
                    vma),
-         _cast_vma(jnp.zeros((batch, heads, seq_kv, d), jnp.float32),
+         _cast_vma(jnp.zeros((batch, kvh, seq_kv, d), jnp.float32),
                    vma)),
         jnp.arange(nq))
-    dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(batch, heads, seq_q, d)
+    # dq_blocks: [nq,B,n,g,bq,d] -> [B,n,g,nq,bq,d] -> [B,H,Sq,d].
+    dq = jnp.moveaxis(dq_blocks, 0, 3).reshape(batch, heads, seq_q, d)
     return dq, dk, dv
 
 
@@ -532,6 +606,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_kv: int = DEFAULT_BLOCK_KV,
                     window: Optional[int] = None) -> jax.Array:
     """Flash attention over [batch, heads, seq, head_dim] inputs.
+
+    GQA: k/v may carry fewer heads than q (kvh dividing H).  They are
+    consumed UNBROADCAST — the Pallas kernels alias group members onto
+    shared kv blocks via BlockSpec index maps and the XLA fallback
+    contracts grouped einsums — and dk/dv come back at kvh heads, so
+    callers never `jnp.repeat` K/V before (or gradients after) this op.
 
     `window`: sliding-window attention (Mistral-style) — each query
     attends to its last `window` positions including itself.  Blocks
@@ -596,12 +676,18 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                   causal: bool = True,
                   window: Optional[int] = None,
                   offset: int = 0) -> jax.Array:
-    """Plain-jnp attention for correctness tests."""
+    """Plain-jnp attention for correctness tests.
+
+    Accepts GQA inputs (k/v at kvh <= H heads) like the kernels do —
+    contracted grouped, never repeated."""
     actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
-    s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
+    batch, heads, seq_q, d = q.shape
+    kvh, group = _group_counts(q, k)
+    qg = q.astype(jnp.float32).reshape(batch, kvh, group, seq_q, d)
+    s = jnp.einsum('bngqd,bnkd->bngqk', qg,
                    k.astype(jnp.float32)) * actual_scale
     if causal:
-        seq_q, seq_kv = s.shape[-2:]
+        seq_kv = k.shape[2]
         mask = jnp.tril(jnp.ones((seq_q, seq_kv), bool),
                         k=seq_kv - seq_q + offset)
         if window is not None:
@@ -609,5 +695,6 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                               k=seq_kv - seq_q + offset - window)
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum('bhqk,bhkd->bhqd', p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum('bngqk,bnkd->bngqd', p, v.astype(jnp.float32))
+    return out.reshape(batch, heads, seq_q,
+                       v.shape[-1]).astype(q.dtype)

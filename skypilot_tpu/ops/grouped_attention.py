@@ -1,0 +1,88 @@
+"""Grouped-query attention einsums that never broadcast K/V to H heads.
+
+Decode is bandwidth-bound: with GQA (kvh < H) the old cached-attention
+epilogue `jnp.repeat`-ed keys/values up to H query heads before the
+score matmul, materializing a [B, H, S, d] operand in HBM every layer
+every step — an h/kvh-fold inflation of the per-step cache working set.
+For DeepSeek's absorbed MLA decode (ONE latent head, H up to 128) that
+silently undid the latent-cache bandwidth win.
+
+The fix is free: reshape queries to [B, kvh, H/kvh, Sq, d] and contract
+against the *unbroadcast* [B, kvh, Sk, d] cache, so the head-group
+broadcast happens inside the einsum (a batched matmul with the group
+folded into the row dim — XLA never materializes the repeated operand).
+Numerics are bit-identical to repeat-then-matmul: each (query head,
+position) dot product sums the same values in the same order.
+
+Shared by every family's decode path (llama.run_cached_attention) and
+by the XLA training/prefill fallback in ops/flash_attention.py.  The
+Pallas flash kernels get the same property via BlockSpec index maps
+(group members read the same kv block) rather than these einsums.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def grouped_attention(q: jax.Array, keys: jax.Array, values: jax.Array,
+                      mask: Optional[jax.Array], *, scale: float,
+                      probs_dtype: Any) -> jax.Array:
+    """Masked softmax attention with unbroadcast grouped K/V.
+
+    q:      [B, H, Sq, dk]   (any dtype; scores accumulate in f32)
+    keys:   [B, kvh, Sk, dk] with H % kvh == 0 — NOT repeated to H
+    values: [B, kvh, Sk, dv]
+    mask:   bool, broadcastable to [B, 1, Sq, Sk] (or None = no mask)
+    scale:  score multiplier (callers pass dk**-0.5 or a custom scale)
+    probs_dtype: dtype the probabilities are cast to before the PV
+        matmul (the cache/compute dtype) — matches the old epilogue.
+
+    Returns [B, Sq, H, dv].
+    """
+    b, h, sq, _ = q.shape
+    kvh = keys.shape[1]
+    if h % kvh:
+        raise ValueError(
+            f'query heads ({h}) not divisible by kv heads ({kvh})')
+    qf = q.astype(jnp.float32)
+    kf = keys.astype(jnp.float32)
+    if kvh == h:
+        # MHA (GPT-2 and kvh==H configs): plain per-head contraction.
+        scores = jnp.einsum('bhqd,bhkd->bhqk', qf, kf) * scale
+        if mask is not None:
+            scores = jnp.where(mask, scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum('bhqk,bhkd->bhqd', probs.astype(probs_dtype),
+                         values)
+    elif kvh == 1:
+        # Latent/MQA fast branch: ONE shared kv head (DeepSeek's
+        # absorbed decode scores all H query heads directly against the
+        # single [B, 1, S, rkv+dr] latent) — drop the unit head axis
+        # instead of carrying a size-1 group dim through the einsum.
+        scores = jnp.einsum('bhqd,bkd->bhqk', qf, kf[:, 0]) * scale
+        if mask is not None:
+            scores = jnp.where(mask, scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum('bhqk,bkd->bhqd', probs.astype(probs_dtype),
+                         values[:, 0])
+    else:
+        # Grouped: [B, kvh, G, Sq, d] x [B, kvh, Sk, d] — the G query
+        # heads sharing a kv head ride the same contraction, so the kv
+        # operand is read once per group instead of once per head.
+        g = h // kvh
+        qg = qf.reshape(b, kvh, g, sq, qf.shape[-1])
+        scores = jnp.einsum('bngqd,bnkd->bngqk', qg, kf) * scale
+        if mask is not None:
+            # [B|1, 1, Sq, Sk] -> [B|1, 1, 1, Sq, Sk]: broadcast over
+            # both the kv-head and group axes.
+            scores = jnp.where(mask[:, :, None], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum('bngqk,bnkd->bngqd', probs.astype(probs_dtype),
+                         values)
+        out = out.reshape(b, h, sq, values.shape[-1])
+    return jnp.transpose(out, (0, 2, 1, 3))

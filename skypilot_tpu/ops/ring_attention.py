@@ -4,7 +4,8 @@ The reference has NO sequence/context parallelism (SURVEY.md §2.11 — "no
 hits for ring-attention/Ulysses"); this is green-field TPU design:
 
   - the sequence is sharded over the mesh's `context` axis; each device
-    holds q/k/v chunks [B, H, S/c, D];
+    holds q/k/v chunks [B, H, S/c, D] (k/v may carry kvh < H heads —
+    GQA chunks rotate unbroadcast, an h/kvh-fold ICI traffic saving);
   - c ring steps: compute blockwise attention of the local q chunk
     against the currently-held kv chunk (Pallas flash kernel), merge with
     the running (out, lse) online-softmax state, then rotate kv to the
@@ -33,6 +34,15 @@ import jax.numpy as jnp
 from skypilot_tpu.ops import flash_attention as fa
 
 _NEG_INF = -1e30
+
+
+def _axis_size(axis_name: str) -> int:
+    """Static size of a bound mesh axis.  `jax.lax.axis_size` where it
+    exists; older jax constant-folds `psum(1, axis)` to the same int."""
+    size_fn = getattr(jax.lax, 'axis_size', None)
+    if size_fn is not None:
+        return size_fn(axis_name)
+    return jax.lax.psum(1, axis_name)
 
 
 def _merge(out1, lse1, out2, lse2):
@@ -213,7 +223,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def _ring_fwd(q, k, v, axis_name, causal, scale, window=None):
     actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
-    axis_size = jax.lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     if window is not None and not causal:
         raise ValueError('window requires causal=True')
     if _use_windowed_ring(window, causal, q.shape[2], axis_size):
@@ -287,7 +297,7 @@ def _ring_bwd_windowed(q, k, v, g, lse, delta, scale, axis_name,
 def _ring_vjp_bwd(axis_name, causal, scale, window, residuals, g):
     q, k, v, out, lse = residuals
     actual_scale = scale if scale is not None else q.shape[-1] ** -0.5
-    axis_size = jax.lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
     if _use_windowed_ring(window, causal, q.shape[2], axis_size):
@@ -330,7 +340,7 @@ ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 def _in_manual_region(axis_name: str) -> bool:
     """True when already inside a shard_map manual over `axis_name`."""
     try:
-        jax.lax.axis_size(axis_name)
+        _axis_size(axis_name)
         return True
     except (NameError, KeyError, ValueError):
         return False
@@ -396,9 +406,18 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     head group, and a second all-to-all restores sequence sharding.
     Cheaper than a ring when heads >= axis_size and sequence is moderate;
     the ring wins at very long context (SURVEY.md §5).
-    Inputs per shard: [B, H, S/c, D]; requires H % c == 0.
+    Inputs per shard: [B, H, S/c, D]; requires H % c == 0.  K/V may
+    carry kvh < H heads (GQA): when kvh divides c they are scattered
+    unbroadcast (the flash kernel keeps the group contraction); when it
+    doesn't (e.g. MQA kvh=1 on a 2-wide axis) K/V are head-broadcast
+    first — ulysses fundamentally shards heads, so there is no
+    unbroadcast layout to scatter.  Prefer the ring for those shapes.
     """
-    c = jax.lax.axis_size(axis_name)
+    c = _axis_size(axis_name)
+    heads, kvh = q.shape[1], k.shape[1]
+    if kvh != heads and kvh % c != 0:
+        k = jnp.repeat(k, heads // kvh, axis=1)
+        v = jnp.repeat(v, heads // kvh, axis=1)
 
     # tiled all_to_all: split_axis is divided into c chunks that land
     # concatenated along concat_axis — [B, H, S/c, D] <-> [B, H/c, S, D]

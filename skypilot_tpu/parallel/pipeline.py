@@ -43,6 +43,36 @@ def _spec_leading(axis_name: str):
     return P(axis_name)
 
 
+def _shard_map(f, *, mesh: Mesh, in_specs, out_specs,
+               axis_names: frozenset):
+    """jax.shard_map with partially-manual axes, with a fallback for
+    older jax: the experimental shard_map spells the same thing as
+    `auto=` (the complement set) and has no VMA type system, so
+    check_rep is disabled (the replicated->varying casts below are
+    no-ops there)."""
+    new = getattr(jax, 'shard_map', None)
+    if new is not None:
+        return new(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   axis_names=axis_names)
+    from jax.experimental.shard_map import shard_map as old
+    auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               auto=auto, check_rep=False)
+
+
+def _cast_varying(x, axis_name: str):
+    """Cast a replicated array varying over `axis_name` so VMA types
+    line up inside scan carries / cond branches.  Older jax has no VMA
+    tracking (no jax.typeof / jax.lax.pcast) — identity there."""
+    typeof = getattr(jax, 'typeof', None)
+    if typeof is None or not hasattr(jax.lax, 'pcast'):
+        return x
+    if axis_name not in (getattr(typeof(x), 'vma', None)
+                         or frozenset()):
+        return jax.lax.pcast(x, (axis_name,), to='varying')
+    return x
+
+
 def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
           stage_params: Any,
           microbatches: jax.Array,
@@ -138,9 +168,7 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
         # The (replicated) microbatch buffer feeds scan carries / cond
         # branches whose other operands vary over the pipe axis; cast it
         # varying so the VMA types line up.
-        if axis_name not in (getattr(jax.typeof(mbs), 'vma', None)
-                             or frozenset()):
-            mbs = jax.lax.pcast(mbs, (axis_name,), to='varying')
+        mbs = _cast_varying(mbs, axis_name)
         my = jax.lax.axis_index(axis_name)
         last = n_stages - 1
         if repeats == 1:
@@ -217,7 +245,7 @@ def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array],
         return jax.lax.psum(out.astype(jnp.float32),
                             axis_name).astype(out.dtype)
 
-    out = jax.shard_map(
+    out = _shard_map(
         _pipelined,
         mesh=mesh,
         in_specs=(jax.tree.map(lambda _: _spec_leading(axis_name),

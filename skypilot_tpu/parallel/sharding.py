@@ -111,8 +111,11 @@ def _ambient_mesh_axes() -> tuple:
     """Axis names of whichever mesh is in context during tracing: the
     new-style abstract mesh (jax.set_mesh) or the legacy `with mesh:`
     thread resource env — the latter is what Trainer.step uses, and
-    PartitionSpec sharding constraints resolve against it inside jit."""
-    mesh = jax.sharding.get_abstract_mesh()
+    PartitionSpec sharding constraints resolve against it inside jit.
+    Older jax has no abstract-mesh tracking — fall through to the
+    thread-resource env, the only mesh context that exists there."""
+    get_abstract = getattr(jax.sharding, 'get_abstract_mesh', None)
+    mesh = get_abstract() if get_abstract is not None else None
     axes = getattr(mesh, 'axis_names', ()) or ()
     if axes:
         return tuple(axes)
@@ -151,3 +154,39 @@ def unbox(tree: Any) -> Any:
     return jax.tree.map(
         lambda x: x.value if isinstance(x, nn.Partitioned) else x, tree,
         is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def _patch_partitioned_unbox() -> None:
+    """Compat: on older jax, `with mesh:` registers a *physical* mesh
+    in the global resource env, and flax's Partitioned.unbox() then
+    applies its *logical* axis names as a sharding constraint against
+    that mesh — ValueError('Resource axis: vocab ... not found in
+    mesh') at model.init time.  Newer jax doesn't surface the context
+    mesh to flax there, so no constraint is attempted and placement is
+    pinned by jit out_shardings instead (trainer.init_state).  Restore
+    that behavior: skip the constraint whenever the box's names don't
+    all resolve in the ambient mesh."""
+    try:
+        from flax.core import meta as flax_meta
+    except ImportError:  # pragma: no cover
+        return
+    orig = flax_meta.Partitioned.unbox
+    if getattr(orig, '_skytpu_logical_names_safe', False):
+        return
+
+    def _unbox(self, apply_constraint=True):
+        if apply_constraint and self.mesh is None:
+            axes = _ambient_mesh_axes()
+            named = [n for n in jax.tree.leaves(tuple(self.names))
+                     if n is not None]
+            if named and any(n not in axes for n in named):
+                return self.value
+        return orig(self, apply_constraint=apply_constraint)
+
+    _unbox._skytpu_logical_names_safe = True
+    flax_meta.Partitioned.unbox = _unbox
+    # flax.linen re-exports the class object itself, so patching the
+    # method on flax.core.meta.Partitioned covers both spellings.
+
+
+_patch_partitioned_unbox()

@@ -136,30 +136,58 @@ def prefetch_to_device(it: Iterator[Dict[str, jax.Array]], depth: int = 2
     HBM and N+2 is in flight.  Token-exact resume is unaffected --
     iterators are recreated from the restored step counter, and
     batches prefetched but never consumed are simply dropped with the
-    thread.  The producer thread dies with the process (daemon) and
-    propagates its exceptions to the consumer."""
+    thread.  The producer propagates its exceptions to the consumer,
+    and shuts down promptly when the consumer abandons the iterator
+    early (finite train() runs, GeneratorExit): every queue put polls a
+    stop event, so the thread never blocks forever on a full queue that
+    nobody will drain again."""
     if depth <= 0:
         yield from it
         return
     q: 'queue.Queue' = queue.Queue(maxsize=depth)
     sentinel = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Blocking put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer() -> None:
         try:
             for batch in it:
-                q.put(batch)
+                if not _put(batch):
+                    return
         except BaseException as e:  # noqa: BLE001 — re-raised below
-            q.put((sentinel, e))
+            _put((sentinel, e))
             return
-        q.put((sentinel, None))
+        _put((sentinel, None))
 
-    threading.Thread(target=producer, daemon=True,
-                     name='skytpu-data-prefetch').start()
-    while True:
-        item = q.get()
-        if isinstance(item, tuple) and len(item) == 2 \
-                and item[0] is sentinel:
-            if item[1] is not None:
-                raise item[1]
-            return
-        yield item
+    thread = threading.Thread(target=producer, daemon=True,
+                              name='skytpu-data-prefetch')
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] is sentinel:
+                if item[1] is not None:
+                    raise item[1]
+                return
+            yield item
+    finally:
+        # Runs on exhaustion AND on early abandonment (GeneratorExit /
+        # gc of a half-consumed generator): release the producer if it
+        # is blocked on a full queue, then reap the thread.
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        thread.join(timeout=5)

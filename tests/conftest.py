@@ -24,6 +24,14 @@ jax.config.update('jax_platforms', 'cpu')
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers',
+        'slow: long integration tests excluded from the tier-1 fast '
+        "gate (pytest -m 'not slow'); run them with -m slow or no "
+        'marker filter.')
+
+
 @pytest.fixture(autouse=True)
 def _isolated_state(tmp_path, monkeypatch):
     """Redirect all on-disk state to a per-test tmp dir."""

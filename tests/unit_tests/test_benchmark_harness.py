@@ -126,6 +126,7 @@ class TestBenchmarkHarness:
 
 class TestBenchE2E:
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_bench_py_through_launch(self, monkeypatch, capsys):
         """bench.py's default mode drives sky launch -> agent -> gang
         driver -> trainer and reports throughput + provision-to-first-

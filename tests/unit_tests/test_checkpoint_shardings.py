@@ -38,6 +38,7 @@ def _step(trainer: trainer_lib.Trainer) -> None:
     trainer.step(next(it))
 
 
+@pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
 def test_restore_mesh_a_into_mesh_b(tmp_path):
     """Save on (data=2, fsdp=4), resume on (data=1, fsdp=8)."""
     t_a = _trainer(mesh_lib.MeshConfig(data=2, fsdp=4))

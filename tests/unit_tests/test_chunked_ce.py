@@ -74,6 +74,7 @@ class TestChunkedCE:
                 v0, flat1[key], rtol=2e-5, atol=1e-6,
                 err_msg=f'grad mismatch at {key}')
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_full_step_through_trainer(self):
         """End-to-end: a jitted trainer step with loss_chunk produces
         the same metrics as without (same seed => same init)."""
@@ -89,6 +90,7 @@ class TestChunkedCE:
                                    jax.device_get(mb['grad_norm']),
                                    rtol=1e-4)
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_moe_chunked(self):
         """Mixtral path: aux router loss flows alongside chunked CE."""
         overrides = {'n_heads': 4, 'n_kv_heads': 2, 'max_seq_len': 64,
@@ -129,6 +131,7 @@ class TestChunkedCE:
                        'n_kv_heads': 2, 'ffn_dim': 64,
                        'max_seq_len': 64, 'vocab_size': 97}),
     ])
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_tied_head_families_match_naive(self, model, overrides):
         overrides = {**overrides,
                      'dtype': jnp.float32, 'param_dtype': jnp.float32}

@@ -127,3 +127,55 @@ class TestPrefetchToDevice:
     def test_depth_zero_is_passthrough(self):
         assert list(data_lib.prefetch_to_device(iter('abc'),
                                                 depth=0)) == list('abc')
+
+    @staticmethod
+    def _live_prefetch_threads():
+        import threading
+        return [t for t in threading.enumerate()
+                if t.name == 'skytpu-data-prefetch' and t.is_alive()]
+
+    def _assert_producers_reaped(self):
+        import time
+        deadline = time.time() + 5
+        while self._live_prefetch_threads() and time.time() < deadline:
+            time.sleep(0.01)
+        assert not self._live_prefetch_threads(), (
+            'prefetch producer thread leaked — still alive after the '
+            'consumer went away')
+
+    def test_abandoned_consumer_stops_producer(self):
+        # Infinite source, consumer takes two batches and walks away:
+        # the producer used to block forever on q.put against a full
+        # queue nobody would ever drain again.
+        def forever():
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+        it = data_lib.prefetch_to_device(forever(), depth=2)
+        assert next(it) == 0
+        assert next(it) == 1
+        it.close()  # GeneratorExit -> shutdown path
+        self._assert_producers_reaped()
+
+    def test_short_train_leaves_no_producer_thread(self, mesh):
+        # train() wraps its data iterator in prefetch_to_device; after
+        # a finite run returns, the wrapped generator is dropped and
+        # the producer must die with it, not linger blocked on a full
+        # queue of never-consumed batches.
+        del mesh  # the trainer builds its own from TrainConfig.mesh
+        from skypilot_tpu.train import trainer as trainer_lib
+        config = trainer_lib.TrainConfig(
+            model='llama-tiny', global_batch_size=8, seq_len=32,
+            total_steps=4, warmup_steps=2,
+            mesh=mesh_lib.MeshConfig(data=1, fsdp=-1),
+            model_overrides={'n_heads': 2, 'n_kv_heads': 1, 'dim': 32,
+                             'ffn_dim': 64, 'n_layers': 2,
+                             'vocab_size': 64, 'max_seq_len': 32})
+        trainer = trainer_lib.Trainer(config)
+        data_iter = data_lib.synthetic_data(
+            trainer.mesh, global_batch_size=8, seq_len=32,
+            vocab_size=64)
+        trainer.train(data_iter, num_steps=2, log_every=10)
+        self._assert_producers_reaped()

@@ -146,6 +146,7 @@ class TestDeepSeekModel:
 
 class TestDeepSeekTraining:
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_sharded_train_loss_decreases(self):
         from skypilot_tpu.parallel import mesh as mesh_lib
         from skypilot_tpu.train import data as data_lib
@@ -169,6 +170,7 @@ class TestDeepSeekTraining:
             last = loss
         assert last < first, (first, last)
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_router_aux_loss_reaches_trainer(self):
         """The MoE suffix sows its balance loss; the train step must
         pick it up (non-zero aux contribution)."""
@@ -179,6 +181,26 @@ class TestDeepSeekTraining:
             model='deepseek-tiny', global_batch_size=8, seq_len=32,
             total_steps=1, mesh=mesh_lib.MeshConfig(data=1, fsdp=-1),
             model_overrides={'max_seq_len': 64})
+        trainer = trainer_lib.Trainer(config)
+        trainer.init_state()
+        it = data_lib.synthetic_data(
+            trainer.mesh, global_batch_size=8, seq_len=32,
+            vocab_size=trainer.model_config.vocab_size)
+        metrics = jax.device_get(trainer.step(next(it)))
+        assert float(metrics['aux_loss']) > 0.0
+
+    def test_scan_layers_router_aux_loss_reaches_trainer(self):
+        """deepseek-tiny defaults scan_layers=False, so the plain aux
+        test never exercises the nn.scan path: sown balance losses
+        live under a scan-stacked collection there and must still be
+        summed into the train step."""
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.train import data as data_lib
+        from skypilot_tpu.train import trainer as trainer_lib
+        config = trainer_lib.TrainConfig(
+            model='deepseek-tiny', global_batch_size=8, seq_len=32,
+            total_steps=1, mesh=mesh_lib.MeshConfig(data=1, fsdp=-1),
+            model_overrides={'max_seq_len': 64, 'scan_layers': True})
         trainer = trainer_lib.Trainer(config)
         trainer.init_state()
         it = data_lib.synthetic_data(
@@ -210,6 +232,7 @@ class TestDeepSeekTraining:
         rope = next(v for k, v in flat.items() if 'k_rope_proj' in k)
         assert 'tensor' not in tuple(rope), flat  # shared head: replicated
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_serving_continuous_engine_matches_cache_free(self):
         from skypilot_tpu.infer import engine as engine_lib
         overrides = {'max_seq_len': 64, **F32}

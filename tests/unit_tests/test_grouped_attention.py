@@ -1,0 +1,289 @@
+"""Grouped (no-K/V-repeat) decode attention: numerical parity with the
+old repeat-then-matmul epilogue across GQA ratios and both cursor modes
+of `run_cached_attention`, plus an HLO assertion that a lowered decode
+step never materializes the cache broadcast to H heads.
+
+The parity reference reimplements the pre-grouped epilogue verbatim
+(repeat K/V to H, per-head einsum, same f32/scale/mask/softmax/dtype
+sequence) so any drift in the shared epilogue shows up here, not in an
+end-to-end generation test three layers up.
+"""
+import re
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import grouped_attention as ga
+
+
+def _repeat_epilogue(q, keys, values, mask, *, scale, probs_dtype):
+    """The OLD run_cached_attention epilogue: broadcast K/V to H heads
+    in HBM, then plain per-head attention."""
+    h, kvh = q.shape[1], keys.shape[1]
+    if kvh != h:
+        keys = jnp.repeat(keys, h // kvh, axis=1)
+        values = jnp.repeat(values, h // kvh, axis=1)
+    scores = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
+                        keys.astype(jnp.float32)) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bhqk,bhkd->bhqd', probs.astype(probs_dtype),
+                     values)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+class _CachedAttn(nn.Module):
+    """Thin harness exposing run_cached_attention's cache collection."""
+    n_kv_heads: int
+    max_seq_len: int
+
+    @nn.compact
+    def __call__(self, q, k, v, kv_mask):
+        return llama.run_cached_attention(
+            self, q, k, v, kv_mask, n_kv_heads=self.n_kv_heads,
+            max_seq_len=self.max_seq_len, dtype=jnp.float32)
+
+
+def _qkv(rng, b, h, kvh, s, hd):
+    q = jnp.asarray(rng.standard_normal((b, h, s, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, kvh, s, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, kvh, s, hd)), jnp.float32)
+    return q, k, v
+
+
+HEADS = 8
+RATIO_KVH = [1, 2, 8]  # GQA ratios H, 4, 1 (kvh==1 is the MLA branch)
+
+
+class TestGroupedEinsum:
+    """grouped_attention vs the repeat reference, standalone."""
+
+    @pytest.mark.parametrize('kvh', RATIO_KVH)
+    def test_matches_repeat_epilogue(self, kvh):
+        rng = np.random.default_rng(0)
+        b, sq, sk, hd = 2, 3, 16, 16
+        q = jnp.asarray(rng.standard_normal((b, HEADS, sq, hd)),
+                        jnp.float32)
+        keys = jnp.asarray(rng.standard_normal((b, kvh, sk, hd)),
+                           jnp.float32)
+        values = jnp.asarray(rng.standard_normal((b, kvh, sk, hd)),
+                             jnp.float32)
+        mask = jnp.asarray(rng.random((b, 1, sq, sk)) > 0.3)
+        # Keep at least one visible position per query row.
+        mask = mask.at[:, :, :, 0].set(True)
+        got = ga.grouped_attention(q, keys, values, mask,
+                                   scale=hd ** -0.5,
+                                   probs_dtype=jnp.float32)
+        want = _repeat_epilogue(q, keys, values, mask,
+                                scale=hd ** -0.5,
+                                probs_dtype=jnp.float32)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    @pytest.mark.parametrize('kvh', RATIO_KVH)
+    def test_no_mask_matches(self, kvh):
+        rng = np.random.default_rng(1)
+        b, sq, sk, hd = 1, 2, 8, 8
+        q = jnp.asarray(rng.standard_normal((b, HEADS, sq, hd)),
+                        jnp.float32)
+        keys = jnp.asarray(rng.standard_normal((b, kvh, sk, hd)),
+                           jnp.float32)
+        values = jnp.asarray(rng.standard_normal((b, kvh, sk, hd)),
+                             jnp.float32)
+        got = ga.grouped_attention(q, keys, values, None,
+                                   scale=0.25, probs_dtype=jnp.float32)
+        want = _repeat_epilogue(q, keys, values, None, scale=0.25,
+                                probs_dtype=jnp.float32)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_rejects_indivisible_heads(self):
+        q = jnp.zeros((1, 6, 1, 8))
+        kv = jnp.zeros((1, 4, 2, 8))
+        with pytest.raises(ValueError, match='not divisible'):
+            ga.grouped_attention(q, kv, kv, None, scale=1.0,
+                                 probs_dtype=jnp.float32)
+
+
+class TestCachedAttentionParity:
+    """run_cached_attention's grouped epilogue vs the old repeat path,
+    driven through the real cache write/mask logic in both modes."""
+
+    def _parity(self, monkeypatch, kvh, *, slot, bucket=None):
+        rng = np.random.default_rng(2 + kvh)
+        b, hd, max_len = 2, 16, 16
+        m = _CachedAttn(n_kv_heads=kvh, max_seq_len=max_len)
+
+        def run(patched):
+            if patched:
+                monkeypatch.setattr(ga, 'grouped_attention',
+                                    _repeat_epilogue)
+            else:
+                monkeypatch.undo()
+            rng_l = np.random.default_rng(2 + kvh)  # same draws
+            outs = []
+            if slot:
+                # Rows at different decode depths: row 0 has 3 slots
+                # revealed, row 1 has 5 — the engine's steady state.
+                depths = np.array([3, 5])
+                kv_mask = jnp.asarray(
+                    np.arange(max_len)[None, :] < depths[:, None])
+                variables = None
+                with llama.slot_mode():
+                    for step in range(3):
+                        q, k, v = _qkv(rng_l, b, HEADS, kvh, 1, hd)
+                        if variables is None:
+                            variables = m.init(jax.random.PRNGKey(0),
+                                               q, k, v, kv_mask)
+                        ctx = (llama.kv_read_bucket(bucket)
+                               if bucket else
+                               llama.kv_read_bucket(None))
+                        with ctx:
+                            out, mut = m.apply(
+                                variables, q, k, v, kv_mask,
+                                mutable=['cache'])
+                        variables = {**variables, **mut}
+                        outs.append(out)
+                        depths = depths + 1
+                        kv_mask = jnp.asarray(
+                            np.arange(max_len)[None, :]
+                            < depths[:, None])
+            else:
+                # Global cursor: prefill s=4 then two s=1 decode steps.
+                prompt_len = 4
+                kv_mask = jnp.asarray(
+                    np.arange(max_len)[None, :].repeat(b, 0)
+                    < prompt_len + 2)
+                q, k, v = _qkv(rng_l, b, HEADS, kvh, prompt_len, hd)
+                variables = m.init(jax.random.PRNGKey(0), q, k, v,
+                                   kv_mask)
+                out, mut = m.apply(variables, q, k, v, kv_mask,
+                                   mutable=['cache'])
+                variables = {**variables, **mut}
+                outs.append(out)
+                for _ in range(2):
+                    q, k, v = _qkv(rng_l, b, HEADS, kvh, 1, hd)
+                    out, mut = m.apply(variables, q, k, v, kv_mask,
+                                       mutable=['cache'])
+                    variables = {**variables, **mut}
+                    outs.append(out)
+            return outs
+
+        new = run(patched=False)
+        old = run(patched=True)
+        for got, want in zip(new, old):
+            np.testing.assert_allclose(got, want, atol=1e-5)
+
+    @pytest.mark.parametrize('kvh', RATIO_KVH)
+    def test_global_cursor_mode(self, monkeypatch, kvh):
+        self._parity(monkeypatch, kvh, slot=False)
+
+    @pytest.mark.parametrize('kvh', RATIO_KVH)
+    def test_slot_mode(self, monkeypatch, kvh):
+        self._parity(monkeypatch, kvh, slot=True)
+
+    def test_slot_mode_with_read_bucket(self, monkeypatch):
+        self._parity(monkeypatch, 2, slot=True, bucket=8)
+
+
+class TestDecodeHLONoBroadcast:
+    """Lower one decode step and assert the compiled HLO never holds a
+    cache tensor broadcast to H heads — the bandwidth property the
+    grouped einsum exists for, enforced at the compiler-output level."""
+
+    B, H, KVH, MAX_LEN, HD = 2, 8, 2, 32, 16
+
+    def _compiled_decode_hlo(self, slot):
+        m = _CachedAttn(n_kv_heads=self.KVH, max_seq_len=self.MAX_LEN)
+        q = jnp.zeros((self.B, self.H, 1, self.HD), jnp.float32)
+        k = jnp.zeros((self.B, self.KVH, 1, self.HD), jnp.float32)
+        v = jnp.zeros((self.B, self.KVH, 1, self.HD), jnp.float32)
+        kv_mask = jnp.asarray(
+            np.arange(self.MAX_LEN)[None, :].repeat(self.B, 0) < 5)
+        variables = m.init(jax.random.PRNGKey(0), q, k, v, kv_mask)
+
+        def step(variables, q, k, v, kv_mask):
+            return m.apply(variables, q, k, v, kv_mask,
+                           mutable=['cache'])
+
+        if slot:
+            with llama.slot_mode():
+                lowered = jax.jit(step).lower(variables, q, k, v,
+                                              kv_mask)
+        else:
+            lowered = jax.jit(step).lower(variables, q, k, v, kv_mask)
+        return lowered.compile().as_text()
+
+    @pytest.mark.parametrize('slot', [False, True],
+                             ids=['global_cursor', 'slot'])
+    def test_no_h_head_cache_tensor(self, slot):
+        hlo = self._compiled_decode_hlo(slot)
+        # The repeated cache would appear as f32[B, H, max_len, hd]
+        # (any layout/whitespace); the unbroadcast cache at kvh heads
+        # must be present — that's the tensor actually read.
+        bad = re.compile(
+            r'f32\[%d,%d,%d,%d\]'
+            % (self.B, self.H, self.MAX_LEN, self.HD))
+        good = 'f32[%d,%d,%d,%d]' % (self.B, self.KVH, self.MAX_LEN,
+                                     self.HD)
+        assert good in hlo, 'cache tensor missing from compiled HLO'
+        assert not bad.search(hlo), (
+            'decode step materializes the K/V cache broadcast to H '
+            'heads — the grouped einsum regressed to repeat-then-'
+            'matmul')
+
+
+class TestCacheReadBytes:
+    """infer/engine.py decode_cache_read_bytes: per-step HBM traffic
+    estimate (grouped vs the old repeat path) over cache pytrees."""
+
+    def test_gqa_cache_ratio_is_heads_over_kv_heads(self):
+        from skypilot_tpu.infer import engine as engine_lib
+        cache = {'layers_0': {
+            'cached_key': jax.ShapeDtypeStruct((2, 2, 64, 16),
+                                               jnp.float32),
+            'cached_value': jax.ShapeDtypeStruct((2, 2, 64, 16),
+                                                 jnp.float32),
+            'cursor': jax.ShapeDtypeStruct((2,), jnp.int32),
+        }}
+        reads = engine_lib.decode_cache_read_bytes(cache, n_heads=8)
+        want = 2 * (2 * 2 * 64 * 16 * 4)  # k + v leaves, f32
+        assert reads['grouped_bytes'] == want
+        assert reads['repeat_bytes'] == want * 4     # 8 heads / 2 kvh
+        assert reads['reduction'] == 4.0
+
+    def test_context_caps_read_length(self):
+        from skypilot_tpu.infer import engine as engine_lib
+        cache = {'k': jax.ShapeDtypeStruct((1, 1, 128, 32),
+                                           jnp.bfloat16)}
+        full = engine_lib.decode_cache_read_bytes(cache, n_heads=4)
+        half = engine_lib.decode_cache_read_bytes(cache, n_heads=4,
+                                                  context=64)
+        assert half['grouped_bytes'] == full['grouped_bytes'] / 2
+        assert half['reduction'] == full['reduction'] == 4.0
+
+    def test_scanned_latent_cache_reduction_is_n_heads(self):
+        # DeepSeek absorbed decode: [L, B, 1, S, 576] latent — the
+        # repeat path would stream it n_heads times per step.
+        from skypilot_tpu.infer import engine as engine_lib
+        cache = {'c': jax.ShapeDtypeStruct((2, 4, 1, 512, 576),
+                                           jnp.float32)}
+        reads = engine_lib.decode_cache_read_bytes(cache, n_heads=16)
+        assert reads['grouped_bytes'] == 2 * 4 * 512 * 576 * 4
+        assert reads['reduction'] == 16.0
+
+    def test_engine_accessor_matches_module_function(self):
+        from skypilot_tpu.infer import engine as engine_lib
+        eng = engine_lib.InferenceEngine(
+            'llama-tiny', max_batch_size=2,
+            model_overrides={'n_heads': 4, 'n_kv_heads': 2, 'dim': 32,
+                             'ffn_dim': 64, 'n_layers': 2,
+                             'vocab_size': 64, 'max_seq_len': 64})
+        got = eng.cache_read_bytes_per_step(context=32)
+        want = engine_lib.decode_cache_read_bytes(
+            eng._abstract_cache, eng.config.n_heads, context=32)
+        assert got == want
+        assert got['reduction'] == 2.0

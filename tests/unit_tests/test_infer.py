@@ -163,6 +163,7 @@ class TestEngineSharded:
 
 class TestEngineCheckpoint:
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_serves_trainer_checkpoint(self, tmp_path):
         from skypilot_tpu.parallel import mesh as mesh_lib
         from skypilot_tpu.train import checkpoint as ckpt_lib

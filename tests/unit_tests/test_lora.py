@@ -79,6 +79,7 @@ class TestLoraModel:
 
 class TestLoraTraining:
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_only_adapters_train(self):
         config = trainer_lib.TrainConfig(
             model='llama-tiny', global_batch_size=8, seq_len=32,
@@ -123,6 +124,7 @@ class TestLoraTraining:
 
 class TestBaseCheckpointIntoLora:
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_partial_restore_loads_base_keeps_adapters(self, tmp_path):
         from skypilot_tpu.train import checkpoint as ckpt_lib
         base_cfg = dict(model='llama-tiny', global_batch_size=8,

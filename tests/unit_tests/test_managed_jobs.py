@@ -188,6 +188,7 @@ class TestManagedJobs:
 
 class TestTrainerRecoveryCapstone:
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_preempted_training_job_resumes_from_checkpoint(
             self, tmp_path):
         """The marquee TPU-recovery story end-to-end: a REAL trainer

@@ -72,6 +72,7 @@ class TestLlama:
 
 class TestCompilationCache:
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_repeat_run_hits_persistent_cache(self, tmp_path):
         """--compilation-cache-dir: the SECOND fresh-interpreter run
         of the same program must reuse the first run's compiled
@@ -132,6 +133,7 @@ class TestTrainer:
         mlp_kernel = state.params['layers']['mlp']['gate_proj']['kernel']
         assert mlp_kernel.sharding.spec != jax.sharding.PartitionSpec()
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_loss_decreases(self):
         trainer = self._trainer()
         trainer.init_state()
@@ -148,6 +150,7 @@ class TestTrainer:
         last = float(jax.device_get(metrics['loss']))
         assert last < first - 0.5, (first, last)
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_profiler_hook_writes_trace(self, tmp_path, monkeypatch):
         prof_dir = tmp_path / 'profile'
         monkeypatch.setenv('SKYTPU_PROFILE_DIR', str(prof_dir))
@@ -160,6 +163,7 @@ class TestTrainer:
         assert any(p.is_file() for p in traces), (
             f'no trace files under {prof_dir}')
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_grad_accum_matches_single_step(self):
         t1 = self._trainer(grad_accum_steps=1, grad_clip_norm=1e9)
         t2 = self._trainer(grad_accum_steps=2, grad_clip_norm=1e9)
@@ -187,6 +191,7 @@ class TestTrainer:
             float(jax.device_get(m1['loss'])),
             float(jax.device_get(m2['loss'])), rtol=5e-3)
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_checkpoint_roundtrip(self, tmp_path):
         from skypilot_tpu.train import checkpoint as ckpt_lib
         trainer = self._trainer()

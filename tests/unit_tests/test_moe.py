@@ -156,6 +156,7 @@ class TestSparseDispatch:
 
 class TestMoETrainer:
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_expert_parallel_train_step(self):
         from skypilot_tpu.train import data as data_lib
         from skypilot_tpu.train import trainer as trainer_lib
@@ -183,6 +184,27 @@ class TestMoETrainer:
         aux = float(jax.device_get(metrics['aux_loss']))
         assert aux > 0, 'MoE aux loss not collected'
 
+    def test_scan_layers_aux_loss_reaches_trainer(self):
+        """Pinned scan_layers=True (mixtral-tiny's default could drift):
+        the per-layer balance losses are sown inside nn.scan and must
+        survive the scan-stacked collection into the trainer's metrics."""
+        from skypilot_tpu.train import data as data_lib
+        from skypilot_tpu.train import trainer as trainer_lib
+
+        config = trainer_lib.TrainConfig(
+            model='mixtral-tiny', global_batch_size=8, seq_len=64,
+            total_steps=1, mesh=mesh_lib.MeshConfig(data=1, fsdp=-1),
+            model_overrides={'n_heads': 4, 'n_kv_heads': 2,
+                             'max_seq_len': 64, 'scan_layers': True,
+                             'remat': False})
+        trainer = trainer_lib.Trainer(config)
+        trainer.init_state()
+        it = data_lib.synthetic_data(
+            trainer.mesh, global_batch_size=8, seq_len=64,
+            vocab_size=trainer.model_config.vocab_size)
+        metrics = jax.device_get(trainer.step(next(it)))
+        assert float(metrics['aux_loss']) > 0, 'MoE aux loss not collected'
+
     def test_pp_moe_rejected(self):
         from skypilot_tpu.train import trainer as trainer_lib
         with pytest.raises(ValueError, match='MoE'):
@@ -195,6 +217,7 @@ class TestMoEServing:
     """Mixtral through the continuous-batching engine — the reference
     serves Mixtral via vLLM (llm/mixtral/); here it's first-party."""
 
+    @pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
     def test_continuous_engine_matches_cache_free(self):
         import numpy as np
 

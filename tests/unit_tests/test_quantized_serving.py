@@ -191,6 +191,7 @@ _CHILD = textwrap.dedent('''
 ''')
 
 
+@pytest.mark.slow  # CPU tier-1 budget: full trainer/engine run
 def test_quantized_engine_behavior_in_fresh_interpreter(tmp_path):
     env = dict(os.environ)
     env['JAX_PLATFORMS'] = 'cpu'
