@@ -368,9 +368,11 @@ def run_direct(quick: bool, steps_arg) -> None:
 
 
 def run_decode(steps_arg) -> None:
-    """CPU decode microbench: per-step decode throughput through the
+    """CPU decode microbench, two arms: grouped-bf16 KV vs
+    grouped-int8 KV — per-step decode throughput through the
     continuous-batching engine plus the per-step KV-cache read-bytes
-    estimate (infer/engine.py decode_cache_read_bytes).
+    estimate (infer/engine.py decode_cache_read_bytes, scale leaves
+    included for the int8 arm).
 
     The config is DeepSeek-V2-Lite's *attention geometry* — 16 query
     heads scoring against a single absorbed [B, 1, S, 576] latent row
@@ -378,8 +380,10 @@ def run_decode(steps_arg) -> None:
     orthogonal to decode bandwidth (vocab, dim, layer count, expert
     count/width) shrunk so the bench runs in seconds on CPU.  The
     grouped epilogue (ops/grouped_attention.py) reads each cache row
-    once; the old repeat path read it n_heads times — for this shape
-    the reported reduction is exactly 16x."""
+    once where the old repeat path read it n_heads times (16x for
+    this shape); int8 storage multiplies that by
+    2*576*2 / (2*576 + 2*4) ≈ 1.99x fewer bytes per position
+    (quantized rows plus their f32 scales, vs bf16 rows)."""
     import jax
 
     # Same CPU pin as --quick: never touch the tunneled TPU backend.
@@ -390,57 +394,97 @@ def run_decode(steps_arg) -> None:
 
     from skypilot_tpu.infer import engine as engine_lib
 
+    # stdout carries exactly one JSON line; the framework logger
+    # defaults to stdout (sky_logging), so point it at stderr here —
+    # the random-weights warning must not corrupt the metric line.
+    import logging
+    for h in logging.getLogger('skypilot_tpu').handlers:
+        if isinstance(h, logging.StreamHandler):
+            h.setStream(sys.stderr)
+            h.flush = sys.stderr.flush
+
     overrides = dict(
         vocab_size=1024, dim=256, n_layers=2, n_heads=16,
         q_lora_rank=0, kv_lora_rank=512, qk_nope_head_dim=128,
         qk_rope_head_dim=64, v_head_dim=128, ffn_dim=512,
         first_k_dense=1, n_experts=4, experts_per_token=2,
         n_shared_experts=1, moe_ffn_dim=256, max_seq_len=512,
-        dtype=jnp.float32, param_dtype=jnp.float32,
+        dtype=jnp.bfloat16, param_dtype=jnp.float32,
         scan_layers=False, remat=False)
     n_slots = 4
     prompt_len = 16
     max_new = steps_arg or 24
-    eng = engine_lib.ContinuousBatchingEngine(
-        'deepseek-v2-lite', n_slots=n_slots, prefill_bucket=16,
-        model_overrides=overrides, param_dtype=jnp.float32)
     rng = np.random.default_rng(0)
     prompts = [list(rng.integers(1, 1024, prompt_len))
                for _ in range(n_slots)]
     sampling = engine_lib.SamplingConfig(max_new_tokens=max_new,
                                          temperature=0.0)
-    eng.generate(prompts, sampling)          # compile warmup
-    t0 = time.time()
-    outs = eng.generate(prompts, sampling)
-    dt = time.time() - t0
-    tokens = sum(len(o) for o in outs)
-    # Every engine tick decodes all live slots at once, so the decode
-    # step count is the per-slot token count (plus the interleaved
-    # prefill ticks, charged here as decode steps — conservative).
-    steps = max(1, max(len(o) for o in outs))
-    reads = eng.cache_read_bytes_per_step(context=prompt_len + max_new)
+
+    def _arm(kv_cache_dtype, params):
+        eng = engine_lib.ContinuousBatchingEngine(
+            'deepseek-v2-lite', n_slots=n_slots, prefill_bucket=16,
+            model_overrides=dict(overrides), param_dtype=jnp.float32,
+            params=params, kv_cache_dtype=kv_cache_dtype)
+        eng.generate(prompts, sampling)      # compile warmup
+        t0 = time.time()
+        outs = eng.generate(prompts, sampling)
+        dt = time.time() - t0
+        tokens = sum(len(o) for o in outs)
+        # Every engine tick decodes all live slots at once, so the
+        # decode step count is the per-slot token count (plus the
+        # interleaved prefill ticks, charged here as decode steps —
+        # conservative).
+        steps = max(1, max(len(o) for o in outs))
+        reads = eng.cache_read_bytes_per_step(
+            context=prompt_len + max_new)
+        return eng.params, {
+            'kv_cache_dtype': kv_cache_dtype,
+            'tokens_per_step': round(tokens / steps, 2),
+            'tokens_per_sec': round(tokens / dt, 1),
+            'ms_per_step': round(dt / steps * 1000, 2),
+            'decode_steps': steps,
+            'cache_read_bytes_per_step_grouped': reads['grouped_bytes'],
+            'cache_read_bytes_per_step_repeat': reads['repeat_bytes'],
+            'cache_read_reduction_vs_repeat': round(
+                reads['reduction'], 1),
+        }, dt, tokens
+
+    # Both arms serve the SAME weights: the bf16-KV arm's randomly
+    # initialized params seed the int8-KV arm.
+    params, bf16_arm, bf16_dt, bf16_tokens = _arm('auto', None)
+    _, int8_arm, int8_dt, int8_tokens = _arm('int8', params)
+    ratio = (bf16_arm['cache_read_bytes_per_step_grouped']
+             / int8_arm['cache_read_bytes_per_step_grouped'])
     result = {
-        'metric': f'decode tokens/step (B={n_slots} slots, '
-                  f'deepseek-v2-lite attention geometry)',
-        'value': round(tokens / steps, 2),
-        'unit': 'tokens/step',
-        'tokens_per_sec': round(tokens / dt, 1),
-        'ms_per_step': round(dt / steps * 1000, 2),
-        'decode_steps': steps,
-        'cache_read_bytes_per_step_grouped': reads['grouped_bytes'],
-        'cache_read_bytes_per_step_repeat': reads['repeat_bytes'],
-        'cache_read_reduction': round(reads['reduction'], 1),
+        'metric': 'decode int8-KV cache-read reduction (B=4 slots, '
+                  'deepseek-v2-lite attention geometry)',
+        'value': round(ratio, 2),
+        'unit': 'x fewer bytes/step vs bf16 KV (scales included)',
+        'vs_baseline': f'bf16 KV '
+                       f'{bf16_arm["cache_read_bytes_per_step_grouped"] / 1e6:.2f}'
+                       f' MB/step -> int8 KV '
+                       f'{int8_arm["cache_read_bytes_per_step_grouped"] / 1e6:.2f}'
+                       f' MB/step',
+        'arms': {'bf16': bf16_arm, 'int8': int8_arm},
         'n_heads': 16,
         'kv_heads_in_cache': 1,
         'device_kind': jax.devices()[0].device_kind,
     }
     print(json.dumps(result))
-    print(f'# decode: {tokens} tokens in {dt:.2f}s '
-          f'({tokens / dt:,.0f} tok/s, {dt / steps * 1000:.1f} ms/step); '
-          f'cache reads/step {reads["grouped_bytes"] / 1e6:.2f} MB grouped '
-          f'vs {reads["repeat_bytes"] / 1e6:.2f} MB repeated '
-          f'({reads["reduction"]:.0f}x less HBM traffic)',
-          file=sys.stderr)
+    for name, arm, dt, tokens in (('bf16-KV', bf16_arm, bf16_dt,
+                                   bf16_tokens),
+                                  ('int8-KV', int8_arm, int8_dt,
+                                   int8_tokens)):
+        print(f'# decode [{name}]: {tokens} tokens in {dt:.2f}s '
+              f'({tokens / dt:,.0f} tok/s, '
+              f'{arm["ms_per_step"]:.1f} ms/step); '
+              f'cache reads/step '
+              f'{arm["cache_read_bytes_per_step_grouped"] / 1e6:.2f} MB '
+              f'grouped vs '
+              f'{arm["cache_read_bytes_per_step_repeat"] / 1e6:.2f} MB '
+              f'repeated', file=sys.stderr)
+    print(f'# decode: int8 KV reads {ratio:.2f}x fewer bytes/step '
+          f'than bf16 KV (f32 scale rows included)', file=sys.stderr)
 
 
 def run_direct_subprocess(steps_arg) -> None:

@@ -72,7 +72,8 @@ def sample_logits(logits: jax.Array, rng: jax.Array,
 def sample_logits_rows(logits: jax.Array, keys: jax.Array,
                        temps: jax.Array, top_ks: jax.Array,
                        top_ps: jax.Array, *, max_k: int,
-                       use_top_p: bool) -> jax.Array:
+                       use_top_p: bool,
+                       top_p_in_topk: bool = False) -> jax.Array:
     """Per-row sampling [B, V] -> [B] with one PRNG key per row: rows
     with temp<=0 decode greedily, the rest sample — one jit for a
     continuous batch whose slots carry different requests' sampling
@@ -87,7 +88,17 @@ def sample_logits_rows(logits: jax.Array, keys: jax.Array,
     `use_top_p` (skips the full-vocab sort when nobody asked for
     nucleus sampling).  A row's k-th-largest threshold is exact for
     any bucket >= k, so bucketing never changes the sampled
-    distribution."""
+    distribution.
+
+    `top_p_in_topk` (static): the caller promises every row with
+    top_ps < 1.0 also has top_ks > 0.  Then every logit a nucleus
+    cutoff could keep already sits in the descending `vals` from
+    lax.top_k, so the [B, max_k] window replaces the full-vocab
+    `jnp.sort` — O(V log V) -> O(V log k) per step.  Identical
+    numerics: dropped entries are -1e30 in both formulations and
+    contribute exactly-zero softmax mass, and rows with top_ks <= 0
+    (possible only with top_ps >= 1.0 under the promise) take the
+    keep-all branch of the cutoff."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     safe = jnp.where(temps > 0, temps, 1.0)[:, None]
     scaled = logits / safe
@@ -98,7 +109,15 @@ def sample_logits_rows(logits: jax.Array, keys: jax.Array,
         keep = (top_ks[:, None] <= 0) | (scaled >= kth)
         scaled = jnp.where(keep, scaled, -1e30)
     if use_top_p:
-        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+        if top_p_in_topk and max_k > 0:
+            # The surviving support is each row's first top_ks entries
+            # of `vals` (already descending); the -1e30 tail keeps the
+            # order sorted and carries zero probability mass.
+            sorted_logits = jnp.where(
+                jnp.arange(max_k)[None, :] < top_ks[:, None], vals,
+                -1e30)
+        else:
+            sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         cutoff_idx = jnp.sum(cum < top_ps[:, None], axis=-1,
@@ -136,7 +155,8 @@ def sample_logits_batched(logits: jax.Array, rng: jax.Array,
         jnp.full((b,), top_k, jnp.int32),
         jnp.full((b,), top_p, jnp.float32),
         max_k=top_k_bucket(top_k, logits.shape[-1]),
-        use_top_p=top_p < 1.0)
+        use_top_p=top_p < 1.0,
+        top_p_in_topk=top_k > 0)
 
 
 _QUANT_KEYS = frozenset(('q8', 'scale'))
@@ -260,6 +280,14 @@ def decode_cache_read_bytes(abstract_cache: Any, n_heads: int,
     ``repeat_bytes / grouped_bytes`` is the h-fold bandwidth win the
     grouped path keeps: n_heads/kvh per GQA leaf, n_heads for a
     DeepSeek absorbed latent cache (kvh == 1).
+
+    With kv_cache_dtype='int8' the K/V leaves arrive as int8
+    (itemsize 1, half/quarter of bf16/f32) and the per-(kv-head,
+    position) f32 scale leaves [B, kvh, S, 1] walk the SAME ndim-4/5
+    dispatch with hd == 1 — so the reported bytes charge the
+    quantized rows PLUS the scale reads, keeping the int8-vs-float
+    comparison honest (per position: 2*hd + 2*4 bytes vs
+    2*hd*itemsize).
     """
     grouped = 0
     repeated = 0
@@ -357,6 +385,7 @@ class ContinuousBatchingEngine:
                  prefill_chunk: int = 0,
                  kv_read_bucket: int = 512,
                  quantize: Optional[str] = None,
+                 kv_cache_dtype: str = 'auto',
                  seed: int = 0) -> None:
         import collections
         import threading
@@ -368,10 +397,12 @@ class ContinuousBatchingEngine:
             checkpoint_dir=checkpoint_dir, max_batch_size=n_slots,
             max_seq_len=max_seq_len, model_overrides=model_overrides,
             param_dtype=param_dtype, prefill_bucket=prefill_bucket,
-            quantize=quantize, seed=seed)
+            quantize=quantize, kv_cache_dtype=kv_cache_dtype,
+            seed=seed)
         self.model = self._eng.model
         self.config = self._eng.config
         self.quantize = self._eng.quantize
+        self.kv_cache_dtype = self._eng.kv_cache_dtype
         self.loaded_real_weights = self._eng.loaded_real_weights
         self.mesh = mesh
         self.n_slots = n_slots
@@ -424,7 +455,8 @@ class ContinuousBatchingEngine:
 
         def _decode_step(p, cache, last, kv_mask, rope_pos, cursors,
                          seeds, gens, active, temps, top_ks, top_ps,
-                         max_k: int, use_top_p: bool, kv_bucket: int):
+                         max_k: int, use_top_p: bool,
+                         top_p_in_topk: bool, kv_bucket: int):
             """Fused: sample every slot's next token from `last`,
             reveal each ACTIVE slot's write position, one-token
             forward for all slots.  Per-row keys fold (request seed,
@@ -442,7 +474,8 @@ class ContinuousBatchingEngine:
                 lambda sd, g: jax.random.fold_in(
                     jax.random.PRNGKey(sd), g))(seeds, gens)
             tok = sample_logits_rows(last, keys, temps, top_ks, top_ps,
-                                     max_k=max_k, use_top_p=use_top_p)
+                                     max_k=max_k, use_top_p=use_top_p,
+                                     top_p_in_topk=top_p_in_topk)
             brange = jnp.arange(tok.shape[0])
             reveal = kv_mask[brange, cursors] | active
             kv_mask = kv_mask.at[brange, cursors].set(reveal)
@@ -453,7 +486,8 @@ class ContinuousBatchingEngine:
 
         self._decode = jax.jit(
             _decode_step,
-            static_argnames=('max_k', 'use_top_p', 'kv_bucket'),
+            static_argnames=('max_k', 'use_top_p', 'top_p_in_topk',
+                             'kv_bucket'),
             donate_argnums=(1, 3))
 
         self._cache = self._eng._fresh_cache()
@@ -808,6 +842,14 @@ class ContinuousBatchingEngine:
         max_k = top_k_bucket(int(top_ks.max()),
                              self.config.vocab_size)
         use_top_p = bool((top_ps < 1.0).any())
+        # Static promise for the sort-free nucleus path: every row
+        # that actually needs a top-p cutoff also ran top-k, so its
+        # candidate set lives inside lax.top_k's sorted window.
+        # Inactive slots carry the keep-all defaults (top_p=1, k=0)
+        # and don't block the fast path.
+        top_p_in_topk = bool(
+            use_top_p and max_k > 0
+            and (top_ks[top_ps < 1.0] > 0).all())
         if self.kv_read_bucket > 0:
             live = int(cursors[occupied].max()) + 1
             gran = self.kv_read_bucket
@@ -823,7 +865,8 @@ class ContinuousBatchingEngine:
                     jnp.asarray(seeds), jnp.asarray(gens),
                     jnp.asarray(active), jnp.asarray(temps),
                     jnp.asarray(top_ks), jnp.asarray(top_ps),
-                    max_k=max_k, use_top_p=use_top_p, kv_bucket=bucket)
+                    max_k=max_k, use_top_p=use_top_p,
+                    top_p_in_topk=top_p_in_topk, kv_bucket=bucket)
         toks = np.asarray(jax.device_get(tok_dev))
         # One dict ref for the whole step: dict.get is GIL-atomic, and
         # per-slot lock acquisitions in the decode hot loop would
@@ -874,13 +917,20 @@ class InferenceEngine:
                  param_dtype: Any = jnp.bfloat16,
                  prefill_bucket: int = 64,
                  quantize: Optional[str] = None,
+                 kv_cache_dtype: str = 'auto',
                  seed: int = 0) -> None:
         if quantize not in (None, 'int8'):
             raise ValueError(f"quantize must be None or 'int8', got "
                              f'{quantize!r}.')
+        if kv_cache_dtype not in ('auto', 'int8'):
+            raise ValueError(f"kv_cache_dtype must be 'auto' or "
+                             f"'int8', got {kv_cache_dtype!r}.")
         self.quantize = quantize
         overrides = dict(model_overrides or {})
         overrides.update(decode=True, remat=False)
+        # Explicit model_overrides win; otherwise the engine flag
+        # reaches run_cached_attention through the model config.
+        overrides.setdefault('kv_cache_dtype', kv_cache_dtype)
         if quantize:
             # Scanned layers would (a) give stacked kernels a leading
             # layer axis that breaks per-output-channel scales and
@@ -893,6 +943,8 @@ class InferenceEngine:
             overrides['max_seq_len'] = max_seq_len
         self.model, self.config = models_lib.get_model(model, **overrides)
         self._model_name, self._overrides = model, dict(overrides)
+        self.kv_cache_dtype = getattr(self.config, 'kv_cache_dtype',
+                                      'auto')
         self.max_batch = max_batch_size
         self.max_seq_len = self.config.max_seq_len
         self.prefill_bucket = max(1, prefill_bucket)

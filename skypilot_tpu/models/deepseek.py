@@ -101,6 +101,10 @@ class DeepSeekConfig:
     remat_policy: str = 'nothing'
     attention_impl: str = 'flash'    # flash | reference
     decode: bool = False
+    # The absorbed latent cache (kvh==1) PARTICIPATES in int8 KV
+    # quantization: one absmax scale per (latent, position) row of the
+    # [B, 1, S, rkv+dr] cache — same layout as the GQA families.
+    kv_cache_dtype: str = 'auto'     # 'auto' | 'int8' (llama.py)
     partition_params: bool = True
     # Unused by MLA but read via getattr by shared helpers.
     sliding_window: Optional[int] = None
@@ -298,7 +302,8 @@ class MLAAttention(nn.Module):
         v_eff = jnp.pad(c[:, None], [(0, 0), (0, 0), (0, 0), (0, dr)])
         out_latent = llama.run_cached_attention(
             self, q_eff, k_eff, v_eff, kv_mask, n_kv_heads=1,
-            max_seq_len=cfg.max_seq_len, dtype=cfg.dtype)
+            max_seq_len=cfg.max_seq_len, dtype=cfg.dtype,
+            kv_cache_dtype=getattr(cfg, 'kv_cache_dtype', 'auto'))
         out_latent = out_latent[..., :rkv]        # [B, S, H, rkv]
         return jnp.einsum('bshr,rhv->bshv', out_latent, wuv)
 

@@ -51,6 +51,7 @@ class GemmaConfig:
     remat: bool = True
     attention_impl: str = 'flash'
     decode: bool = False
+    kv_cache_dtype: str = 'auto'     # 'auto' | 'int8' (llama.py)
     partition_params: bool = True
     # Gemma-specific knobs consumed by the shared blocks / this module.
     activation: str = 'gelu'

@@ -41,6 +41,7 @@ class Gpt2Config:
     attention_impl: str = 'flash'
     # Serving mode: KV cache via the shared llama.run_cached_attention.
     decode: bool = False
+    kv_cache_dtype: str = 'auto'     # 'auto' | 'int8' (llama.py)
     partition_params: bool = True
 
     @property
@@ -117,7 +118,9 @@ class Gpt2Attention(nn.Module):
             out = llama.run_cached_attention(
                 self, q, k, v, kv_mask, n_kv_heads=h,
                 max_seq_len=cfg.max_seq_len,
-                dtype=cfg.dtype).reshape(b, s, h * hd)
+                dtype=cfg.dtype,
+                kv_cache_dtype=getattr(cfg, 'kv_cache_dtype',
+                                       'auto')).reshape(b, s, h * hd)
         else:
             out = (fa.flash_attention(q, k, v)
                    if cfg.attention_impl == 'flash'

@@ -64,6 +64,11 @@ class LlamaConfig:
     # Autoregressive serving mode: attention keeps a KV cache in the
     # 'cache' variable collection (infer/engine.py drives it).
     decode: bool = False
+    # Serving KV-cache storage dtype: 'auto' stores at `dtype`; 'int8'
+    # stores rows as int8 + per-(kv-head, position) f32 absmax scales
+    # (run_cached_attention) and reads through the fused-dequant
+    # epilogue — halves decode cache traffic vs bf16.
+    kv_cache_dtype: str = 'auto'
     # Attach logical-axis metadata to params (nn.with_partitioning).
     # Disabled when modules are applied inside a shard_map manual region
     # (pipeline stages): flax's apply-time shape validation eval_shapes
@@ -276,7 +281,8 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
                          kv_mask: Optional[jax.Array], *,
                          n_kv_heads: int, max_seq_len: int,
                          dtype: Any,
-                         window: Optional[int] = None) -> jax.Array:
+                         window: Optional[int] = None,
+                         kv_cache_dtype: str = 'auto') -> jax.Array:
     """Attention against the KV cache (serving) — shared by every
     family (Llama/Gemma via llama.Attention, GPT-2's MHA).
 
@@ -285,14 +291,39 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
     finished rows — is carried by `kv_mask` [B, max_seq_len], so
     slots and rope positions may disagree for padded rows without
     affecting valid tokens.  Returns [B, S, H, hd].
+
+    kv_cache_dtype='int8' stores K/V rows as int8 with per-(kv-head,
+    position) f32 absmax scales in sibling 'cache' leaves
+    cached_{key,value}_scale [B, kvh, max_len, 1].  Writes quantize
+    through the SAME `.at[]`/dynamic_update_slice paths (scale leaves
+    share the cache's leading [B, kvh, pos] layout, so slot cursors,
+    chunked prefill, and the engines' ndim-based insert/sharding all
+    compose); reads go through the fused-dequant epilogue
+    (ops/grouped_attention.quantized_grouped_attention), which never
+    materializes a float copy of the cache.
     """
+    if kv_cache_dtype not in ('auto', 'int8'):
+        raise ValueError(
+            f'kv_cache_dtype must be "auto" or "int8", '
+            f'got {kv_cache_dtype!r}')
+    quant = kv_cache_dtype == 'int8'
     b, h, s, hd = q.shape
     kvh = n_kv_heads
     max_len = max_seq_len
+    cache_dtype = jnp.int8 if quant else dtype
     cached_k = module.variable('cache', 'cached_key', jnp.zeros,
-                               (b, kvh, max_len, hd), dtype)
+                               (b, kvh, max_len, hd), cache_dtype)
     cached_v = module.variable('cache', 'cached_value', jnp.zeros,
-                               (b, kvh, max_len, hd), dtype)
+                               (b, kvh, max_len, hd), cache_dtype)
+    if quant:
+        # Zero-init scales dequantize padding to exact zeros; masked
+        # positions never reach the softmax anyway.
+        k_scale = module.variable('cache', 'cached_key_scale',
+                                  jnp.zeros, (b, kvh, max_len, 1),
+                                  jnp.float32)
+        v_scale = module.variable('cache', 'cached_value_scale',
+                                  jnp.zeros, (b, kvh, max_len, 1),
+                                  jnp.float32)
     cursor = module.variable('cache', 'cache_index',
                              lambda: jnp.zeros((), jnp.int32))
     idx = cursor.value
@@ -312,10 +343,22 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
             jnp.where(kv_mask, jnp.arange(max_len, dtype=jnp.int32), 0),
             axis=-1)                               # [B]
         brange = jnp.arange(b)
-        cached_k.value = cached_k.value.at[
-            brange, :, write_pos, :].set(k[:, :, 0, :].astype(dtype))
-        cached_v.value = cached_v.value.at[
-            brange, :, write_pos, :].set(v[:, :, 0, :].astype(dtype))
+        if quant:
+            kq, ks = ga.quantize_int8_rows(k[:, :, 0, :])  # [b,kvh,hd]
+            vq, vs = ga.quantize_int8_rows(v[:, :, 0, :])
+            cached_k.value = cached_k.value.at[
+                brange, :, write_pos, :].set(kq)
+            cached_v.value = cached_v.value.at[
+                brange, :, write_pos, :].set(vq)
+            k_scale.value = k_scale.value.at[
+                brange, :, write_pos, :].set(ks)
+            v_scale.value = v_scale.value.at[
+                brange, :, write_pos, :].set(vs)
+        else:
+            cached_k.value = cached_k.value.at[
+                brange, :, write_pos, :].set(k[:, :, 0, :].astype(dtype))
+            cached_v.value = cached_v.value.at[
+                brange, :, write_pos, :].set(v[:, :, 0, :].astype(dtype))
         cursor.value = idx + 1
         visible = kv_mask
         if window is not None:
@@ -335,12 +378,27 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
                               and bucket < max_len) else max_len
         keys = cached_k.value[:, :, :read_len]
         values = cached_v.value[:, :, :read_len]
+        if quant:
+            k_sc = k_scale.value[:, :, :read_len]
+            v_sc = v_scale.value[:, :, :read_len]
         mask = mask[:, :, :, :read_len]
     else:
-        cached_k.value = jax.lax.dynamic_update_slice(
-            cached_k.value, k.astype(dtype), (0, 0, idx, 0))
-        cached_v.value = jax.lax.dynamic_update_slice(
-            cached_v.value, v.astype(dtype), (0, 0, idx, 0))
+        if quant:
+            kq, ks = ga.quantize_int8_rows(k)      # [b,kvh,s,hd/1]
+            vq, vs = ga.quantize_int8_rows(v)
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, kq, (0, 0, idx, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, vq, (0, 0, idx, 0))
+            k_scale.value = jax.lax.dynamic_update_slice(
+                k_scale.value, ks, (0, 0, idx, 0))
+            v_scale.value = jax.lax.dynamic_update_slice(
+                v_scale.value, vs, (0, 0, idx, 0))
+        else:
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(dtype), (0, 0, idx, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(dtype), (0, 0, idx, 0))
         cursor.value = idx + s
         slots = jnp.arange(max_len)
         rows = idx + jnp.arange(s)
@@ -351,11 +409,17 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
         if kv_mask is not None:
             mask = mask & kv_mask[:, None, None, :]
         keys, values = cached_k.value, cached_v.value
+        if quant:
+            k_sc, v_sc = k_scale.value, v_scale.value
     # Grouped epilogue: the cache stays [B, kvh, read_len, hd] — the
     # head-group broadcast happens inside the einsum, never in HBM
     # (ops/grouped_attention.py).  The scale intentionally uses q's
     # LAST dim: DeepSeek's absorbed decode pre-multiplies q so this
     # lands on the true qk_head_dim scale (models/deepseek.py).
+    if quant:
+        return ga.quantized_grouped_attention(
+            q, keys, k_sc, values, v_sc, mask, scale=hd ** -0.5,
+            probs_dtype=dtype)
     return ga.grouped_attention(q, keys, values, mask,
                                 scale=hd ** -0.5, probs_dtype=dtype)
 
@@ -448,7 +512,9 @@ class Attention(nn.Module):
                                     dtype=cfg.dtype,
                                     window=getattr(
                                         cfg, 'sliding_window',
-                                        None))
+                                        None),
+                                    kv_cache_dtype=getattr(
+                                        cfg, 'kv_cache_dtype', 'auto'))
 
 
 class MLP(nn.Module):

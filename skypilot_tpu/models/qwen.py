@@ -53,6 +53,7 @@ class QwenConfig:
     remat_policy: str = 'nothing'
     attention_impl: str = 'flash'
     decode: bool = False
+    kv_cache_dtype: str = 'auto'     # 'auto' | 'int8' (llama.py)
     partition_params: bool = True
     attention_bias: bool = True      # the Qwen2 signature
     tie_embeddings: bool = False

@@ -21,12 +21,18 @@ Pallas flash kernels get the same property via BlockSpec index maps
 """
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30
+# Symmetric int8/int16 ranges for the KV-cache / activation quant.
+_INT8_MAX = 127.0
+_INT16_MAX = 32767.0
+# Absmax floor: an all-zero row (cache padding, masked slots) must
+# quantize to zeros with a finite scale, not divide by zero.
+_SCALE_FLOOR = 1e-8
 
 
 def grouped_attention(q: jax.Array, keys: jax.Array, values: jax.Array,
@@ -86,3 +92,129 @@ def grouped_attention(q: jax.Array, keys: jax.Array, values: jax.Array,
                          values)
         out = out.reshape(b, h, sq, values.shape[-1])
     return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def quantize_int8_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-row symmetric int8 absmax quantization over the LAST axis.
+
+    For a cache write x [..., d] returns (q int8 [..., d],
+    scale f32 [..., 1]) with x ~= q * scale.  One scale per
+    (kv-head, position) row — the granularity the quantized epilogue
+    can fold into the score/PV contractions without ever materializing
+    a dequantized copy of the cache.
+    """
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                        _SCALE_FLOOR) / _INT8_MAX
+    q = jnp.clip(jnp.round(xf / scale), -_INT8_MAX,
+                 _INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def _quantize_int16_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-row int16 absmax quant for the ACTIVATION side of the
+    integer dots (queries, value-scaled probs).  int16 keeps the
+    activation quant error ~256x below the int8 cache's own error
+    floor, so the quantized path's accuracy is set by the cache quant
+    alone — while the dot still runs integer x integer and never
+    widens the cache to float in HBM."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                        _SCALE_FLOOR) / _INT16_MAX
+    q = jnp.clip(jnp.round(xf / scale), -_INT16_MAX,
+                 _INT16_MAX).astype(jnp.int16)
+    return q, scale
+
+
+def _int_dot(a16: jax.Array, b8: jax.Array, *, contract_a: int,
+             contract_b: int, batch_dims: int) -> jax.Array:
+    """lax.dot_general int16 x int8 -> int32 with leading batch dims."""
+    batch = tuple(range(batch_dims))
+    return jax.lax.dot_general(
+        a16, b8, (((contract_a,), (contract_b,)), (batch, batch)),
+        preferred_element_type=jnp.int32)
+
+
+def quantized_grouped_attention(q: jax.Array, keys_q: jax.Array,
+                                key_scale: jax.Array,
+                                values_q: jax.Array,
+                                value_scale: jax.Array,
+                                mask: Optional[jax.Array], *,
+                                scale: float,
+                                probs_dtype: Any) -> jax.Array:
+    """grouped_attention against an int8 cache, dequant fused.
+
+    q:           [B, H, Sq, dk]  float (quantized to int16 per row here)
+    keys_q:      [B, kvh, Sk, dk]  int8
+    key_scale:   [B, kvh, Sk, 1]   f32 per-(kv-head, position) absmax
+    values_q:    [B, kvh, Sk, dv]  int8
+    value_scale: [B, kvh, Sk, 1]   f32
+    mask/scale/probs_dtype: as grouped_attention.
+
+    The score dot contracts int16 queries against the int8 keys
+    (int32 accumulate — exact); k_scale sits outside the contracted
+    head_dim axis, so it multiplies the int32 scores afterwards.
+    v_scale sits ON the contracted position axis of the PV dot, so it
+    is folded into the probabilities BEFORE they are requantized to
+    int16 for the second integer dot.  No f32/bf16 tensor of the full
+    cache shape ever materializes — the bandwidth property the
+    compiled-HLO tests pin down.
+
+    Returns [B, Sq, H, dv].
+    """
+    b, h, sq, _ = q.shape
+    kvh = keys_q.shape[1]
+    if h % kvh:
+        raise ValueError(
+            f'query heads ({h}) not divisible by kv heads ({kvh})')
+    dv = values_q.shape[-1]
+    if kvh == h:
+        # MHA: per-head integer contraction.
+        qq, qs = _quantize_int16_rows(q)
+        scores = _int_dot(qq, keys_q, contract_a=3, contract_b=3,
+                          batch_dims=2).astype(jnp.float32)
+        # [B, kvh, Sk, 1] -> [B, kvh, 1, Sk] (broadcast over Sq).
+        scores = scores * qs * key_scale[:, :, None, :, 0] * scale
+        if mask is not None:
+            scores = jnp.where(mask, scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        pscaled = probs * value_scale[:, :, None, :, 0]
+        pq, ps = _quantize_int16_rows(pscaled)
+        out = _int_dot(pq, values_q, contract_a=3, contract_b=2,
+                       batch_dims=2).astype(jnp.float32) * ps
+    elif kvh == 1:
+        # Latent/MQA branch: drop the unit kv-head axis (DeepSeek's
+        # absorbed decode scores all H heads against one latent row).
+        qq, qs = _quantize_int16_rows(q)
+        scores = jax.lax.dot_general(
+            qq, keys_q[:, 0], (((3,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32).astype(jnp.float32)
+        # [B, 1, Sk, 1] -> [B, 1, 1, Sk] (broadcast over H and Sq).
+        ks = key_scale[:, 0, :, 0][:, None, None, :]
+        scores = scores * qs * ks * scale
+        if mask is not None:
+            scores = jnp.where(mask, scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        pscaled = probs * value_scale[:, 0, :, 0][:, None, None, :]
+        pq, ps = _quantize_int16_rows(pscaled)
+        out = jax.lax.dot_general(
+            pq, values_q[:, 0], (((3,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32).astype(jnp.float32) * ps
+    else:
+        # Grouped: [B, kvh, G, Sq, d] x [B, kvh, Sk, d] int dot.
+        g = h // kvh
+        qg = q.reshape(b, kvh, g, sq, q.shape[-1])
+        qq, qs = _quantize_int16_rows(qg)
+        scores = _int_dot(qq, keys_q, contract_a=4, contract_b=3,
+                          batch_dims=2).astype(jnp.float32)
+        # key_scale [B, kvh, Sk, 1] -> [B, kvh, 1, 1, Sk].
+        scores = scores * qs * key_scale[:, :, None, None, :, 0] * scale
+        if mask is not None:
+            scores = jnp.where(mask[:, :, None], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        pscaled = probs * value_scale[:, :, None, None, :, 0]
+        pq, ps = _quantize_int16_rows(pscaled)
+        out = _int_dot(pq, values_q, contract_a=4, contract_b=2,
+                       batch_dims=2).astype(jnp.float32) * ps
+        out = out.reshape(b, h, sq, dv)
+    return jnp.transpose(out.astype(probs_dtype), (0, 2, 1, 3))

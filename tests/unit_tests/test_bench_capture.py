@@ -433,3 +433,60 @@ def test_emit_carries_tokens_per_dollar(bench, capsys):
     bench._emit(1000.0, 5.5e8, 1, 'cpu', 256)
     parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
     assert 'equiv_tokens_per_dollar' not in parsed
+
+
+def test_decode_emits_one_json_line_and_stderr_summary(
+        bench, monkeypatch, capsys):
+    """--decode must put exactly ONE machine-readable JSON line on
+    stdout (metric/value/unit/vs_baseline + both arms) and its human
+    summary on stderr — same contract as the train bench, so the
+    driver can parse stdout blindly."""
+    import itertools
+
+    from skypilot_tpu.infer import engine as engine_mod
+
+    built = []
+
+    class _FakeCBE:
+        def __init__(self, model, n_slots=4, prefill_bucket=16,
+                     model_overrides=None, param_dtype=None,
+                     params=None, kv_cache_dtype='auto', **_kw):
+            self.kv_cache_dtype = kv_cache_dtype
+            self.params = {'w': 0} if params is None else params
+            built.append(self)
+
+        def generate(self, prompts, sampling):
+            return [[1] * sampling.max_new_tokens for _ in prompts]
+
+        def cache_read_bytes_per_step(self, context=None):
+            # bf16: 2*576*2 bytes/pos; int8: 2*576 + 2*4 (scales).
+            per_pos = 1160.0 if self.kv_cache_dtype == 'int8' \
+                else 2304.0
+            grouped = 2 * 4 * 44 * per_pos  # layers*B*context
+            return {'grouped_bytes': grouped,
+                    'repeat_bytes': grouped * 16.0,
+                    'reduction': 16.0}
+
+    monkeypatch.setattr(engine_mod, 'ContinuousBatchingEngine',
+                        _FakeCBE)
+    ticks = itertools.count()
+    monkeypatch.setattr(bench.time, 'time',
+                        lambda: float(next(ticks)))
+    bench.run_decode(None)
+    captured = capsys.readouterr()
+    out = captured.out.strip().splitlines()
+    assert len(out) == 1  # exactly ONE json line on stdout
+    parsed = json.loads(out[0])
+    for key in ('metric', 'value', 'unit', 'vs_baseline'):
+        assert key in parsed, key
+    assert parsed['value'] == round(2304.0 / 1160.0, 2)  # 1.99
+    assert set(parsed['arms']) == {'bf16', 'int8'}
+    assert parsed['arms']['int8']['kv_cache_dtype'] == 'int8'
+    assert 'int8' in parsed['metric']
+    # Both arms served the SAME weights.
+    assert built[0].kv_cache_dtype == 'auto'
+    assert built[1].kv_cache_dtype == 'int8'
+    assert built[1].params is built[0].params
+    err = [l for l in captured.err.splitlines() if l.startswith('#')]
+    assert len(err) == 3  # one per arm + the ratio line
+    assert 'fewer bytes/step' in err[-1]

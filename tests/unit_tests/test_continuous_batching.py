@@ -6,6 +6,7 @@ output equals the cache-free full re-forward — proving per-slot
 cursors, kv-mask isolation, and cache-row inserts never
 cross-contaminate.
 """
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -423,3 +424,90 @@ class TestPerRequestSeeds:
         a = eng.generate([[5, 17, 3]], cfg)[0]
         b = eng.generate([[5, 17, 3]], cfg)[0]
         assert a == b  # call-level reproducibility
+
+
+class TestTimeoutCleanup:
+    """wait()/stream() timeouts must leave the engine exactly as a
+    cancel() would: no _events/_results/_stream_queues entries for the
+    abandoned request, and its decode slot freed — a client that gives
+    up must not leak bookkeeping (or a slot) in a long-lived replica."""
+
+    def test_wait_timeout_releases_queued_request(self, cbe):
+        base_events = len(cbe._events)
+        rid = cbe.submit([1, 2], engine_lib.SamplingConfig(
+            max_new_tokens=4))
+        with pytest.raises(TimeoutError):
+            cbe.wait(rid, timeout=0.05)  # nothing drives step()
+        assert rid not in cbe._events
+        assert rid not in cbe._results
+        assert not cbe._queue
+        assert len(cbe._events) == base_events
+
+    def test_wait_timeout_frees_active_slot(self, cbe):
+        rid = cbe.submit([1, 2, 3], engine_lib.SamplingConfig(
+            max_new_tokens=8))
+        assert cbe.step()  # admitted into a slot
+        assert any(s is not None and s.request_id == rid
+                   for s in cbe._slots)
+        with pytest.raises(TimeoutError):
+            cbe.wait(rid, timeout=0.05)
+        cbe.run_until_idle()  # step() evicts the canceled request
+        assert rid not in cbe._events
+        assert rid not in cbe._results
+        assert all(s is None for s in cbe._slots)
+
+    def test_stream_timeout_releases_bookkeeping(self, cbe):
+        base_events = len(cbe._events)
+        rid = cbe.submit([5, 17, 3], engine_lib.SamplingConfig(
+            max_new_tokens=8), stream=True)
+        assert cbe.step()  # admit; a first token may already be queued
+        it = cbe.stream(rid, timeout=0.05)
+        with pytest.raises(TimeoutError):
+            for _ in it:  # drains queued tokens, then stalls
+                pass
+        cbe.run_until_idle()
+        assert rid not in cbe._events
+        assert rid not in cbe._results
+        assert rid not in cbe._stream_queues
+        assert all(s is None for s in cbe._slots)
+        assert len(cbe._events) == base_events
+
+
+class TestTopPSortSkip:
+    """When every nucleus row also ran top-k (`top_p_in_topk`), the
+    top-p cutoff reads the descending lax.top_k window instead of a
+    full-vocab sort.  The promise: rows with top_ps < 1.0 have
+    top_ks > 0; rows with top_ks <= 0 must carry top_ps >= 1.0."""
+
+    def _rows(self, top_p_in_topk):
+        key = jax.random.PRNGKey(3)
+        logits = jax.random.normal(key, (4, 96)) * 3.0
+        keys = jax.random.split(jax.random.PRNGKey(7), 4)
+        temps = jnp.ones((4,), jnp.float32)
+        # Row 2 is the keep-all edge: no top-k, top_p == 1.0.
+        top_ks = jnp.asarray([3, 5, 0, 8], jnp.int32)
+        top_ps = jnp.asarray([0.7, 0.9, 1.0, 0.5], jnp.float32)
+        return engine_lib.sample_logits_rows(
+            logits, keys, temps, top_ks, top_ps, max_k=8,
+            use_top_p=True, top_p_in_topk=top_p_in_topk)
+
+    def test_windowed_cutoff_matches_full_sort(self):
+        fast = self._rows(True)
+        slow = self._rows(False)
+        assert fast.tolist() == slow.tolist()
+
+    def test_topk_plus_topp_batch_matches_solo(self, cbe):
+        """A top-k+top-p row (sort-skip eligible) sharing the batch
+        with a plain top-k row reproduces its solo output."""
+        p1, p2 = [5, 17, 3, 42], [9, 1]
+        both_cfg = engine_lib.SamplingConfig(
+            max_new_tokens=5, temperature=1.0, top_k=6, top_p=0.7,
+            seed=31)
+        topk_cfg = engine_lib.SamplingConfig(
+            max_new_tokens=5, temperature=1.0, top_k=3, seed=22)
+        solo = cbe.generate([p1], both_cfg)[0]
+        rid_b = cbe.submit(p1, both_cfg)
+        rid_k = cbe.submit(p2, topk_cfg)
+        cbe.run_until_idle()
+        assert cbe.wait(rid_b) == solo
+        cbe.wait(rid_k)

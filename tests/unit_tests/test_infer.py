@@ -111,8 +111,8 @@ class TestServerSurface:
              '--help'], capture_output=True, text=True,
             timeout=120, env=env).stdout
         for flag in ('--mesh', '--quantize', '--prefill-chunk',
-                     '--kv-read-bucket', '--compilation-cache-dir',
-                     '--checkpoint-dir'):
+                     '--kv-read-bucket', '--kv-cache-dtype',
+                     '--compilation-cache-dir', '--checkpoint-dir'):
             assert flag in out, flag
 
 
