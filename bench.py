@@ -53,6 +53,11 @@ def _remaining_s() -> float:
 
 _FAILURES: list = []
 _FINAL_EMITTED = False
+# Exit code when the ONLY thing emitted was a stale cached metric
+# (BENCH_r05: rc=0 + {"stale": true} read as a fresh capture).  A
+# distinct non-zero rc keeps the line parseable while making "no live
+# measurement happened" impossible to miss in the driver's rc check.
+_STALE_RC = 3
 # Cluster the e2e rung has live right now; the signal handler must
 # tear it down (detached — the handler itself has to exit fast) or a
 # leaked job keeps the single-client TPU tunnel wedged for every
@@ -144,7 +149,10 @@ def _final_rung(reason: str) -> bool:
 def _on_deadline_signal(signum, frame):  # noqa: ARG001
     """SIGTERM (external driver timeout) / SIGALRM (our own budget
     backstop): emit the final rung NOW and exit.  rc=124 with nothing
-    parseable on stdout must be impossible (round-4 verdict)."""
+    parseable on stdout must be impossible (round-4 verdict).  Exit
+    codes match the ladder's: 3 = only a STALE cached number went out
+    (parseable but not a live capture — callers must not treat it as
+    rc=0 fresh), 1 = not even that."""
     name = signal.Signals(signum).name
     print(f'# bench received {name}; emitting final rung before exit',
           file=sys.stderr, flush=True)
@@ -169,7 +177,7 @@ def _on_deadline_signal(signum, frame):  # noqa: ARG001
                          f'{_TOTAL_BUDGET_S:.0f}s budget')
     sys.stdout.flush()
     sys.stderr.flush()
-    os._exit(0 if cached else 1)
+    os._exit(_STALE_RC if cached else 1)
 
 
 class BenchError(RuntimeError):
@@ -367,12 +375,16 @@ def run_direct(quick: bool, steps_arg) -> None:
           attn_flops_per_token=_attn_flops_per_token(overrides, seq))
 
 
-def run_decode(steps_arg) -> None:
-    """CPU decode microbench, two arms: grouped-bf16 KV vs
-    grouped-int8 KV — per-step decode throughput through the
+def run_decode(steps_arg, smoke: bool = False) -> None:
+    """CPU decode microbench, three arms: grouped-bf16 KV vs
+    grouped-int8 KV (uniform prompts), then contiguous vs PAGED KV on
+    a ragged-length workload — per-step decode throughput through the
     continuous-batching engine plus the per-step KV-cache read-bytes
     estimate (infer/engine.py decode_cache_read_bytes, scale leaves
-    included for the int8 arm).
+    included for the int8 arm, per-row allocated pages for the paged
+    arm).  `smoke` shrinks sequence lengths/steps so the whole thing
+    (including the paged arm's greedy-parity check) runs in tier-1 on
+    CPU.
 
     The config is DeepSeek-V2-Lite's *attention geometry* — 16 query
     heads scoring against a single absorbed [B, 1, S, 576] latent row
@@ -400,7 +412,11 @@ def run_decode(steps_arg) -> None:
     import logging
     for h in logging.getLogger('skypilot_tpu').handlers:
         if isinstance(h, logging.StreamHandler):
-            h.setStream(sys.stderr)
+            # Drop any stale per-instance flush override and swap the
+            # stream by hand: setStream() flushes the OLD stream
+            # first, which raises if a test harness already closed it.
+            h.__dict__.pop('flush', None)
+            h.stream = sys.stderr
             h.flush = sys.stderr.flush
 
     overrides = dict(
@@ -413,7 +429,7 @@ def run_decode(steps_arg) -> None:
         scan_layers=False, remat=False)
     n_slots = 4
     prompt_len = 16
-    max_new = steps_arg or 24
+    max_new = steps_arg or (6 if smoke else 24)
     rng = np.random.default_rng(0)
     prompts = [list(rng.integers(1, 1024, prompt_len))
                for _ in range(n_slots)]
@@ -455,6 +471,66 @@ def run_decode(steps_arg) -> None:
     _, int8_arm, int8_dt, int8_tokens = _arm('int8', params)
     ratio = (bf16_arm['cache_read_bytes_per_step_grouped']
              / int8_arm['cache_read_bytes_per_step_grouped'])
+
+    # --- third arm: paged vs contiguous KV on a RAGGED workload -----
+    # One long-context request rides with three short ones (mean live
+    # context <= max_seq_len/8).  The contiguous cache streams every
+    # slot's row up to the kv-read bucket regardless of how little of
+    # it is live; the paged cache gathers only the pages each slot
+    # actually allocated.  Same params, greedy, so the token streams
+    # must match exactly — parity is recorded, not just the speedup.
+    pg_seq = 256 if smoke else 512
+    pg_ps = 8
+    pg_new = 8 if smoke else 16
+    pg_lens = [pg_seq // 4 - pg_new, 8, 8, 8]
+    pg_prompts = [list(rng.integers(1, 1024, n)) for n in pg_lens]
+    pg_sampling = engine_lib.SamplingConfig(max_new_tokens=pg_new,
+                                            temperature=0.0)
+    pg_overrides = dict(overrides, max_seq_len=pg_seq)
+
+    def _ragged_arm(page_size):
+        eng = engine_lib.ContinuousBatchingEngine(
+            'deepseek-v2-lite', n_slots=n_slots, prefill_bucket=8,
+            model_overrides=dict(pg_overrides),
+            param_dtype=jnp.float32, params=params,
+            page_size=page_size)
+        eng.generate(pg_prompts, pg_sampling)      # compile warmup
+        t0 = time.time()
+        outs = eng.generate(pg_prompts, pg_sampling)
+        return eng, outs, time.time() - t0
+
+    contig_eng, contig_outs, contig_dt = _ragged_arm(0)
+    paged_eng, paged_outs, paged_dt = _ragged_arm(pg_ps)
+    # Final live context per slot: bucketed prompt pad + new tokens.
+    finals = [min(max(paged_eng._eng._bucketed(n), n),
+                  pg_seq - pg_new) + pg_new for n in pg_lens]
+    gran = contig_eng.kv_read_bucket
+    bucket = (min(pg_seq, -(-max(finals) // gran) * gran)
+              if gran > 0 else pg_seq)
+    contig_reads = contig_eng.cache_read_bytes_per_step(context=bucket)
+    paged_reads = paged_eng.cache_read_bytes_per_step(
+        row_contexts=finals)
+    pg_ratio = (contig_reads['grouped_bytes']
+                / paged_reads['grouped_bytes'])
+    pg_parity = [list(a) for a in paged_outs] == \
+        [list(a) for a in contig_outs]
+    paged_arm = {
+        'page_size': pg_ps,
+        'max_seq_len': pg_seq,
+        'row_contexts': finals,
+        'mean_live_context': round(sum(finals) / len(finals), 1),
+        'token_parity_vs_contiguous': pg_parity,
+        'tokens_per_sec_contiguous': round(
+            sum(len(o) for o in contig_outs) / contig_dt, 1),
+        'tokens_per_sec_paged': round(
+            sum(len(o) for o in paged_outs) / paged_dt, 1),
+        'cache_read_bytes_per_step_contiguous':
+            contig_reads['grouped_bytes'],
+        'cache_read_bytes_per_step_paged':
+            paged_reads['grouped_bytes'],
+        'read_reduction_vs_contiguous': round(pg_ratio, 2),
+    }
+
     result = {
         'metric': 'decode int8-KV cache-read reduction (B=4 slots, '
                   'deepseek-v2-lite attention geometry)',
@@ -465,7 +541,10 @@ def run_decode(steps_arg) -> None:
                        f' MB/step -> int8 KV '
                        f'{int8_arm["cache_read_bytes_per_step_grouped"] / 1e6:.2f}'
                        f' MB/step',
-        'arms': {'bf16': bf16_arm, 'int8': int8_arm},
+        'arms': {'bf16': bf16_arm, 'int8': int8_arm,
+                 'paged': paged_arm},
+        'paged_read_reduction_vs_contiguous': round(pg_ratio, 2),
+        'paged_token_parity': pg_parity,
         'n_heads': 16,
         'kv_heads_in_cache': 1,
         'device_kind': jax.devices()[0].device_kind,
@@ -485,6 +564,12 @@ def run_decode(steps_arg) -> None:
               f'repeated', file=sys.stderr)
     print(f'# decode: int8 KV reads {ratio:.2f}x fewer bytes/step '
           f'than bf16 KV (f32 scale rows included)', file=sys.stderr)
+    print(f'# decode [paged]: ragged contexts {finals} in a '
+          f'{pg_seq}-slot row; paged KV reads {pg_ratio:.2f}x fewer '
+          f'bytes/step than contiguous '
+          f'({contig_reads["grouped_bytes"] / 1e6:.2f} MB -> '
+          f'{paged_reads["grouped_bytes"] / 1e6:.2f} MB), greedy '
+          f'token parity: {pg_parity}', file=sys.stderr)
 
 
 def run_direct_subprocess(steps_arg) -> None:
@@ -655,10 +740,16 @@ def main() -> None:
     parser.add_argument('--steps', type=int, default=None)
     parser.add_argument('--decode', action='store_true',
                         help='CPU decode microbench: tokens/step + '
-                             'KV-cache read-bytes (grouped vs repeat).')
+                             'KV-cache read-bytes (grouped vs repeat, '
+                             'contiguous vs paged).')
+    parser.add_argument('--smoke', action='store_true',
+                        help='With --decode: shrink sequence lengths '
+                             'and step counts so the full three-arm '
+                             'bench (incl. paged parity) fits in a '
+                             'CPU-only tier-1 test.')
     args = parser.parse_args()
     if args.decode:
-        run_decode(args.steps)
+        run_decode(args.steps, smoke=args.smoke)
         return
     if args.quick or args.direct:
         run_direct(args.quick, args.steps)
@@ -737,16 +828,22 @@ def _run_ladder(args) -> None:
                   file=sys.stderr)
             break
         if attempt > 0:
-            # Sleep only what the budget can spare after reserving a
-            # minimum-length attempt; 0 means back-to-back.
-            sleep_s = min(spacing_s,
-                          max(0.0, headroom - direct_min_s))
-            if sleep_s > 0:
-                print(f'# waiting {sleep_s:.0f}s before --direct '
+            # Nap only when a full minimum-length attempt still fits
+            # AFTER the full spacing; a shortened nap that leaves less
+            # than direct_min_s is strictly worse than no nap at all
+            # (BENCH_r05: slept 600s, then skipped the attempt with
+            # 146s left — the window was burned sleeping).
+            if headroom - spacing_s >= direct_min_s:
+                print(f'# waiting {spacing_s:.0f}s before --direct '
                       f'attempt {attempt + 1}/{direct_attempts} '
                       f'(fresh backend window)', file=sys.stderr)
-                time.sleep(sleep_s)
-            headroom = _remaining_s() - _FINAL_RUNG_RESERVE_S - 10
+                time.sleep(spacing_s)
+                headroom = _remaining_s() - _FINAL_RUNG_RESERVE_S - 10
+            else:
+                print(f'# skipping the {spacing_s:.0f}s inter-attempt '
+                      f'sleep: {headroom:.0f}s headroom cannot fit it '
+                      f'plus a {direct_min_s:.0f}s attempt — retrying '
+                      f'back-to-back', file=sys.stderr)
         print(f'# falling back to --direct (subprocess trainer, '
               f'attempt {attempt + 1}/{direct_attempts})',
               file=sys.stderr)
@@ -761,9 +858,12 @@ def _run_ladder(args) -> None:
             _FAILURES.append(f'direct attempt {attempt + 1}: {e!r}')
             print(f'# bench --direct attempt {attempt + 1} failed: '
                   f'{e!r}', file=sys.stderr)
-    # Last rung: a dated in-round measurement beats no number at all.
-    if not _final_rung('ladder exhausted'):
-        sys.exit(1)
+    # Last rung: a dated in-round measurement beats no number at all —
+    # but it is NOT a live capture, so the rc says so: _STALE_RC when
+    # the stale cached line went out, 1 when not even that existed.
+    if _final_rung('ladder exhausted'):
+        sys.exit(_STALE_RC)
+    sys.exit(1)
 
 
 def _probe_forensics() -> dict:

@@ -260,7 +260,9 @@ def _cache_sharding(mesh, leaf) -> NamedSharding:
 
 
 def decode_cache_read_bytes(abstract_cache: Any, n_heads: int,
-                            context: Optional[int] = None
+                            context: Optional[int] = None,
+                            page_size: int = 0,
+                            row_contexts: Optional[Sequence[int]] = None
                             ) -> Dict[str, float]:
     """Per-decode-step KV-cache read traffic estimate (HBM bytes).
 
@@ -288,9 +290,46 @@ def decode_cache_read_bytes(abstract_cache: Any, n_heads: int,
     quantized rows PLUS the scale reads, keeping the int8-vs-float
     comparison honest (per position: 2*hd + 2*4 bytes vs
     2*hd*itemsize).
+
+    With ``page_size`` > 0 the cache is PAGED: K/V pool leaves are
+    [n_pages, kvh, page_size, hd] ([L, n_pages, ...] scanned) and a
+    decode step gathers only the pages a row has allocated, so the
+    charge is per-ROW: ``row_contexts`` (required) gives each live
+    row's context length, each charged ceil(ctx / page_size) pages of
+    page_size positions — reads track live context, not max_seq_len.
+    ``context`` still caps every row (the bucketed read high-water
+    mark).  Block tables / cursors (ndim <= 3 int32) are skipped as
+    negligible next to the K/V stream.
     """
     grouped = 0
     repeated = 0
+    if page_size > 0:
+        if row_contexts is None:
+            raise ValueError(
+                'row_contexts is required for paged accounting '
+                '(page_size > 0): per-row live context lengths.')
+        positions = 0
+        for ctx in row_contexts:
+            if context is not None:
+                ctx = min(ctx, context)
+            positions += -(-max(int(ctx), 0) // page_size) * page_size
+        for leaf in jax.tree.leaves(abstract_cache):
+            if leaf.ndim == 4:       # [n_pages, kvh, ps, hd]
+                layers, (_, kvh, ps, hd) = 1, leaf.shape
+            elif leaf.ndim == 5:     # [L, n_pages, kvh, ps, hd]
+                layers, _, kvh, ps, hd = leaf.shape
+            else:
+                continue             # block tables / cursors
+            itemsize = np.dtype(leaf.dtype).itemsize
+            leaf_bytes = layers * kvh * positions * hd * itemsize
+            grouped += leaf_bytes
+            repeated += leaf_bytes * max(1, n_heads // kvh)
+        return {
+            'grouped_bytes': float(grouped),
+            'repeat_bytes': float(repeated),
+            'reduction': float(repeated) / float(grouped)
+            if grouped else 1.0,
+        }
     for leaf in jax.tree.leaves(abstract_cache):
         if leaf.ndim == 4:
             layers, (b, kvh, s, hd) = 1, leaf.shape
@@ -310,6 +349,22 @@ def decode_cache_read_bytes(abstract_cache: Any, n_heads: int,
     }
 
 
+# Paged-pool leaf names (models/llama.py _paged_slot_attention) and
+# the batch-1 contiguous prefill-cache leaves they are fed from.
+_POOL_OF_CONTIG = {
+    'cached_key': 'page_key',
+    'cached_value': 'page_value',
+    'cached_key_scale': 'page_key_scale',
+    'cached_value_scale': 'page_value_scale',
+}
+_CONTIG_OF_POOL = {v: k for k, v in _POOL_OF_CONTIG.items()}
+
+
+def _path_names(path) -> tuple:
+    """Pytree key path -> plain name tuple (DictKey et al. -> str)."""
+    return tuple(getattr(k, 'key', str(k)) for k in path)
+
+
 @dataclasses.dataclass
 class _Slot:
     """Host-side state of one occupied decode slot."""
@@ -324,6 +379,9 @@ class _Slot:
     seed: int = 0
     generated: int = 0
     outputs: List[int] = dataclasses.field(default_factory=list)
+    # Paged cache only: this slot's allocated page ids (block-table
+    # prefix), released back to the allocator on completion/eviction.
+    pages: List[int] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -341,6 +399,10 @@ class _PendingPrefill:
     cache1: Any
     done: int = 0
     last_row: Any = None      # logits at the prompt's last true token
+    # Paged cache only:
+    pages: List[int] = dataclasses.field(default_factory=list)
+    table_row: Any = None     # np [pages_per_slot] int32 (0-filled tail)
+    shared_len: int = 0       # prefix positions already in the pool
 
 
 class ContinuousBatchingEngine:
@@ -386,6 +448,8 @@ class ContinuousBatchingEngine:
                  kv_read_bucket: int = 512,
                  quantize: Optional[str] = None,
                  kv_cache_dtype: str = 'auto',
+                 page_size: int = 0,
+                 max_pages: int = 0,
                  seed: int = 0) -> None:
         import collections
         import threading
@@ -398,6 +462,7 @@ class ContinuousBatchingEngine:
             max_seq_len=max_seq_len, model_overrides=model_overrides,
             param_dtype=param_dtype, prefill_bucket=prefill_bucket,
             quantize=quantize, kv_cache_dtype=kv_cache_dtype,
+            page_size=page_size, max_pages=max_pages,
             seed=seed)
         self.model = self._eng.model
         self.config = self._eng.config
@@ -407,6 +472,8 @@ class ContinuousBatchingEngine:
         self.mesh = mesh
         self.n_slots = n_slots
         self.max_seq_len = self._eng.max_seq_len
+        self.page_size = self._eng.page_size
+        self.n_pages = self._eng.n_pages
 
         # Batch-1 prefill cache template.
         rng = jax.random.PRNGKey(seed)
@@ -429,7 +496,22 @@ class ContinuousBatchingEngine:
                 kv_mask, mutable=['cache'])
             return logits, mutated['cache']
 
-        self._prefill1 = jax.jit(_forward, donate_argnums=(1,))
+        def _prefill_fwd(p, cache, tokens, positions, kv_mask,
+                         kv_bucket: int):
+            """Chunked-prefill forward with the cache READS capped at
+            `kv_bucket` (0 = uncapped).  The bucket is a trace-time
+            value (models/llama.py thread-local), so it MUST be a
+            static compile key here — a traced-through value would
+            silently pin every later chunk to the first chunk's
+            bucket via the jit cache."""
+            from skypilot_tpu.models import llama as llama_lib
+            with llama_lib.kv_read_bucket(
+                    kv_bucket if kv_bucket > 0 else None):
+                return _forward(p, cache, tokens, positions, kv_mask)
+
+        self._prefill1 = jax.jit(_prefill_fwd,
+                                 static_argnames=('kv_bucket',),
+                                 donate_argnums=(1,))
 
         def _insert(cache, last, kv_mask, cache1, last_row, mask_row,
                     slot):
@@ -452,6 +534,137 @@ class ContinuousBatchingEngine:
             return cache, last, kv_mask
 
         self._insert = jax.jit(_insert, donate_argnums=(0, 1, 2))
+
+        self._alloc = None
+        if self.page_size:
+            from skypilot_tpu.infer import paging as paging_lib
+            ps = self.page_size
+            pps = self.max_seq_len // ps
+            self._pages_per_slot = pps
+            self._alloc = paging_lib.PageAllocator(self.n_pages, ps)
+
+            def _insert_paged(cache, last, kv_mask, cache1, last_row,
+                              mask_row, table_row, slot,
+                              copy_start_page):
+                """Paged twin of _insert: scatter the batch-1
+                contiguous prefill cache into the slot's pool pages
+                and write its device block-table row.  Pages below
+                `copy_start_page` hold a SHARED prefix that is already
+                in the pool — their writes are redirected to the
+                reserved null page 0 so a refcounted page is never
+                rewritten."""
+                flat1 = {
+                    _path_names(p_): leaf for p_, leaf in
+                    jax.tree_util.tree_flatten_with_path(cache1)[0]}
+                phys = jnp.where(
+                    jnp.arange(pps) >= copy_start_page, table_row, 0)
+
+                def _scatter(path, pool):
+                    names = _path_names(path)
+                    src_name = _CONTIG_OF_POOL.get(names[-1])
+                    if src_name is not None:
+                        src = flat1[names[:-1] + (src_name,)]
+                        if pool.ndim == 4:
+                            # pool [n_pages, kvh, ps, d], src [1, kvh, S, d]
+                            kvh, _, d = src.shape[1:]
+                            content = src[0].reshape(kvh, pps, ps, d)
+                            content = jnp.transpose(content,
+                                                    (1, 0, 2, 3))
+                            return pool.at[phys].set(
+                                content.astype(pool.dtype))
+                        # scanned: pool [L, n_pages, kvh, ps, d],
+                        #          src  [L, 1, kvh, S, d]
+                        L = src.shape[0]
+                        kvh, _, d = src.shape[2:]
+                        content = src[:, 0].reshape(L, kvh, pps, ps, d)
+                        content = jnp.transpose(content,
+                                                (0, 2, 1, 3, 4))
+                        return pool.at[:, phys].set(
+                            content.astype(pool.dtype))
+                    if names[-1] == 'block_table':
+                        if pool.ndim == 2:      # [B, pps]
+                            return jax.lax.dynamic_update_slice(
+                                pool, table_row[None], (slot, 0))
+                        row = jnp.broadcast_to(  # scanned [L, B, pps]
+                            table_row[None, None],
+                            (pool.shape[0], 1, pool.shape[2]))
+                        return jax.lax.dynamic_update_slice(
+                            pool, row, (0, slot, 0))
+                    return pool          # cursors: unused in slot mode
+
+                cache = jax.tree_util.tree_map_with_path(_scatter,
+                                                         cache)
+                last = jax.lax.dynamic_update_slice(
+                    last, last_row[None], (slot, 0))
+                kv_mask = jax.lax.dynamic_update_slice(
+                    kv_mask, mask_row[None], (slot, 0))
+                return cache, last, kv_mask
+
+            self._insert_paged = jax.jit(_insert_paged,
+                                         donate_argnums=(0, 1, 2))
+
+            def _hydrate(cache1, cache, table_row, shared_pages,
+                         shared_len):
+                """Prefix hit: gather the slot's `shared_pages` leading
+                pages from the pool into the contiguous batch-1
+                prefill cache and advance its cursor to `shared_len`,
+                so the suffix chunks attend to the shared prefix
+                without re-prefilling it.  Positions past the prefix
+                gather the null page — garbage, but every such column
+                is either overwritten by a suffix chunk before its row
+                reads it (causal) or masked off (kv_mask/causal)."""
+                flat = {
+                    _path_names(p_): leaf for p_, leaf in
+                    jax.tree_util.tree_flatten_with_path(cache)[0]}
+                phys = jnp.where(jnp.arange(pps) < shared_pages,
+                                 table_row, 0)
+
+                def _gather(path, small):
+                    names = _path_names(path)
+                    pool_name = _POOL_OF_CONTIG.get(names[-1])
+                    if pool_name is not None:
+                        pool = flat[names[:-1] + (pool_name,)]
+                        if small.ndim == 4:     # [1, kvh, S, d]
+                            g = jnp.take(pool, phys, axis=0)
+                            g = jnp.transpose(g, (1, 0, 2, 3))
+                            return g.reshape(small.shape[1:])[None]
+                        L = pool.shape[0]       # scanned
+                        g = jnp.take(pool, phys, axis=1)
+                        g = jnp.transpose(g, (0, 2, 1, 3, 4))
+                        return g.reshape(
+                            (L,) + small.shape[2:])[:, None]
+                    if names[-1] == 'cache_index':
+                        return jnp.full(small.shape, shared_len,
+                                        small.dtype)
+                    return small
+
+                return jax.tree_util.tree_map_with_path(_gather,
+                                                        cache1)
+
+            self._hydrate1 = jax.jit(_hydrate, donate_argnums=(0,))
+
+            def _clear_table(cache, slot):
+                """Zero a dead slot's device block-table row: the
+                slot-mode write path scatters into table[row, cursor]
+                even for inactive rows, and a stale row would scribble
+                on pages the allocator already handed elsewhere.  The
+                zeroed row points at the reserved null page."""
+                def _clr(path, leaf):
+                    if _path_names(path)[-1] != 'block_table':
+                        return leaf
+                    if leaf.ndim == 2:
+                        zero = jnp.zeros((1, leaf.shape[1]),
+                                         leaf.dtype)
+                        return jax.lax.dynamic_update_slice(
+                            leaf, zero, (slot, 0))
+                    zero = jnp.zeros(
+                        (leaf.shape[0], 1, leaf.shape[2]), leaf.dtype)
+                    return jax.lax.dynamic_update_slice(
+                        leaf, zero, (0, slot, 0))
+                return jax.tree_util.tree_map_with_path(_clr, cache)
+
+            self._clear_table = jax.jit(_clear_table,
+                                        donate_argnums=(0,))
 
         def _decode_step(p, cache, last, kv_mask, rope_pos, cursors,
                          seeds, gens, active, temps, top_ks, top_ps,
@@ -516,11 +729,20 @@ class ContinuousBatchingEngine:
         # push a sentinel so readers never block forever.
         self._stream_queues: Dict[int, Any] = {}
 
-    def cache_read_bytes_per_step(self, context: Optional[int] = None
-                                  ) -> Dict[str, float]:
+    def cache_read_bytes_per_step(self, context: Optional[int] = None,
+                                  row_contexts: Optional[Sequence[int]]
+                                  = None) -> Dict[str, float]:
         """Estimated HBM bytes one decode step reads from the shared
-        [n_slots, ...] cache — see decode_cache_read_bytes."""
-        return self._eng.cache_read_bytes_per_step(context)
+        cache — see decode_cache_read_bytes.  On a paged engine with
+        no explicit `row_contexts`, the LIVE slots' contexts are used
+        (a decode step gathers only allocated pages), falling back to
+        the all-slots-at-`context` worst case when idle."""
+        if self.page_size and row_contexts is None:
+            live = [s.pad_len + s.generated + 1
+                    for s in self._slots if s is not None]
+            row_contexts = live or None
+        return self._eng.cache_read_bytes_per_step(context,
+                                                   row_contexts)
 
     @property
     def params(self):
@@ -667,27 +889,62 @@ class ContinuousBatchingEngine:
                             self._cache1_shardings)
 
     def _admit(self, slot_idx: int, rid: int, prompt: List[int],
-               cfg: SamplingConfig) -> None:
+               cfg: SamplingConfig) -> bool:
+        """Reserve slot `slot_idx` for request `rid` and start (or
+        finish) its prefill.  Returns False — WITHOUT consuming the
+        slot — when the paged allocator cannot cover the request
+        (admission backpressure: the caller requeues and retries after
+        decode frees pages)."""
         true_len = len(prompt)
         pad = max(self._eng._bucketed(true_len), true_len)
         pad = min(pad, self.max_seq_len - cfg.max_new_tokens)
         pad = max(pad, true_len)
+        pages: List[int] = []
+        table_row = None
+        shared_len = 0
+        if self.page_size:
+            ps = self.page_size
+            need = min(-(-(pad + cfg.max_new_tokens) // ps),
+                       self._pages_per_slot)
+            # Prefix sharing: reuse every already-cached page-aligned
+            # prompt page — capped one page short of the prompt's end,
+            # because the LAST true token must always prefill (its
+            # logits seed decode).
+            shared = self._alloc.lookup_prefix(
+                prompt, max_pages=min((true_len - 1) // ps, need))
+            fresh = self._alloc.alloc(need - len(shared))
+            if fresh is None:
+                for page in shared:
+                    self._alloc.release(page)
+                return False
+            pages = list(shared) + fresh
+            shared_len = len(shared) * ps
+            table_row = np.zeros((self._pages_per_slot,), np.int32)
+            table_row[:len(pages)] = pages
         tokens = np.zeros((1, pad), np.int32)
         tokens[0, :true_len] = prompt
         mask_row = np.zeros((self.max_seq_len,), bool)
         mask_row[:true_len] = True
+        cache1 = self._fresh_cache1()
+        if shared_len > 0:
+            cache1 = self._hydrate1(
+                cache1, self._cache, jnp.asarray(table_row),
+                jnp.int32(shared_len // self.page_size),
+                jnp.int32(shared_len))
         pending = _PendingPrefill(
             slot_idx=slot_idx, rid=rid, cfg=cfg, true_len=true_len,
             pad=pad, tokens=tokens, mask_row=mask_row,
-            cache1=self._fresh_cache1())
+            cache1=cache1, done=shared_len, pages=pages,
+            table_row=table_row, shared_len=shared_len)
         if self.prefill_chunk > 0:
             # Reserve the slot; one chunk runs per tick from
             # _step_inner so live slots keep decoding in between.
             self._prefills.append(pending)
-            return
+            return True
         while pending.done < pending.pad:
             self._prefill_chunk_step(pending)
         self._finish_prefill(pending)
+        return True
 
     def _prefill_chunk_step(self, pending: _PendingPrefill) -> None:
         """Run the next prompt chunk through the batch-1 forward; the
@@ -707,8 +964,19 @@ class ContinuousBatchingEngine:
         positions = jnp.arange(start, start + size,
                                dtype=jnp.int32)[None]
         kv_mask1 = jnp.asarray(pending.mask_row)[None]
+        if self.kv_read_bucket > 0:
+            # Chunk reads only need columns < start+size (causal) —
+            # round up to the decode bucket granularity so early
+            # chunks of a long prompt stop streaming the full
+            # [1, kvh, max_seq_len, hd] rows.
+            gran = self.kv_read_bucket
+            bucket = min(self.max_seq_len,
+                         ((start + size + gran - 1) // gran) * gran)
+        else:
+            bucket = 0
         logits, pending.cache1 = self._prefill1(
-            self.params, pending.cache1, tokens, positions, kv_mask1)
+            self.params, pending.cache1, tokens, positions, kv_mask1,
+            kv_bucket=bucket)
         last_idx = pending.true_len - 1
         if start <= last_idx < start + size:
             pending.last_row = logits[0, last_idx - start]
@@ -721,10 +989,25 @@ class ContinuousBatchingEngine:
 
     def _finish_prefill(self, pending: _PendingPrefill) -> None:
         assert pending.last_row is not None
-        self._cache, self._last, self._kv_mask = self._insert(
-            self._cache, self._last, self._kv_mask, pending.cache1,
-            pending.last_row, jnp.asarray(pending.mask_row),
-            jnp.int32(pending.slot_idx))
+        if self.page_size:
+            self._cache, self._last, self._kv_mask = \
+                self._insert_paged(
+                    self._cache, self._last, self._kv_mask,
+                    pending.cache1, pending.last_row,
+                    jnp.asarray(pending.mask_row),
+                    jnp.asarray(pending.table_row),
+                    jnp.int32(pending.slot_idx),
+                    jnp.int32(pending.shared_len // self.page_size))
+            # Publish the prompt's full pages so later requests with
+            # the same (page-aligned) prefix prefill it once.
+            self._alloc.register_prefix(
+                pending.tokens[0, :pending.true_len].tolist(),
+                pending.pages)
+        else:
+            self._cache, self._last, self._kv_mask = self._insert(
+                self._cache, self._last, self._kv_mask, pending.cache1,
+                pending.last_row, jnp.asarray(pending.mask_row),
+                jnp.int32(pending.slot_idx))
         cfg = pending.cfg
         seed = cfg.seed if cfg.seed is not None else (
             hash((self._seed0, pending.rid)) & 0x7FFFFFFF)
@@ -732,11 +1015,27 @@ class ContinuousBatchingEngine:
             request_id=pending.rid, prompt_len=pending.true_len,
             pad_len=pending.pad, max_new=cfg.max_new_tokens,
             eos_id=cfg.eos_id, temperature=cfg.temperature,
-            top_k=cfg.top_k, top_p=cfg.top_p, seed=seed)
+            top_k=cfg.top_k, top_p=cfg.top_p, seed=seed,
+            pages=pending.pages)
+
+    def _release_slot_pages(self, pages: List[int],
+                            slot_idx: Optional[int] = None) -> None:
+        """Return a dead request's pages to the allocator and zero its
+        device block-table row — a stale row would let the slot-mode
+        write path scribble on pages already handed to another
+        request (the zeroed row points at the reserved null page)."""
+        if not self.page_size:
+            return
+        for page in pages:
+            self._alloc.release(page)
+        if slot_idx is not None:
+            self._cache = self._clear_table(self._cache,
+                                            jnp.int32(slot_idx))
 
     def _complete(self, slot_idx: int) -> None:
         slot = self._slots[slot_idx]
         assert slot is not None
+        self._release_slot_pages(slot.pages, slot_idx)
         with self._submit_lock:
             if slot.request_id in self._canceled:
                 self._canceled.discard(slot.request_id)
@@ -765,9 +1064,18 @@ class ContinuousBatchingEngine:
             snapshot = set(self._canceled)
         for i, s in enumerate(self._slots):
             if s is not None and s.request_id in snapshot:
+                self._release_slot_pages(s.pages, i)
                 self._slots[i] = None
-        self._prefills = [p for p in self._prefills
-                          if p.rid not in snapshot]
+        keep: List[_PendingPrefill] = []
+        for p in self._prefills:
+            if p.rid in snapshot:
+                # Mid-prefill cancel: the device table row was never
+                # written (that happens at _finish_prefill), so only
+                # the host-side pages need returning.
+                self._release_slot_pages(p.pages)
+            else:
+                keep.append(p)
+        self._prefills = keep
         # Entries with no slot are stale (e.g. admission raised after a
         # mid-prefill cancel) — drop them too, the set must not grow.
         with self._submit_lock:
@@ -794,11 +1102,27 @@ class ContinuousBatchingEngine:
                     self._admitting_rid = item[0]
             if item is None:
                 break
+            admitted = True
             try:
-                self._admit(free.pop(0), *item)
+                admitted = self._admit(free[0], *item)
             finally:
                 with self._submit_lock:
                     self._admitting_rid = None
+            if admitted:
+                free.pop(0)
+                continue
+            # Paged admission backpressure: the pool can't cover this
+            # request right now.  Requeue at the FRONT (FIFO order
+            # preserved) and stop admitting this tick — decode below
+            # keeps draining live slots, whose completion returns
+            # pages.  A request canceled mid-backpressure is dropped
+            # instead of requeued.
+            with self._submit_lock:
+                if item[0] in self._canceled:
+                    self._canceled.discard(item[0])
+                else:
+                    self._queue.appendleft(item)
+            break
 
         # One prefill chunk per tick for EVERY pending prompt
         # (round-robin, not head-only): several long prompts make
@@ -918,6 +1242,8 @@ class InferenceEngine:
                  prefill_bucket: int = 64,
                  quantize: Optional[str] = None,
                  kv_cache_dtype: str = 'auto',
+                 page_size: int = 0,
+                 max_pages: int = 0,
                  seed: int = 0) -> None:
         if quantize not in (None, 'int8'):
             raise ValueError(f"quantize must be None or 'int8', got "
@@ -925,6 +1251,16 @@ class InferenceEngine:
         if kv_cache_dtype not in ('auto', 'int8'):
             raise ValueError(f"kv_cache_dtype must be 'auto' or "
                              f"'int8', got {kv_cache_dtype!r}.")
+        if page_size:
+            if page_size < 1 or page_size & (page_size - 1):
+                raise ValueError(f'page_size must be a power of two, '
+                                 f'got {page_size}')
+            if max(1, prefill_bucket) % page_size:
+                raise ValueError(
+                    f'page_size ({page_size}) must divide '
+                    f'prefill_bucket ({prefill_bucket})')
+        elif max_pages:
+            raise ValueError('max_pages requires page_size > 0')
         self.quantize = quantize
         overrides = dict(model_overrides or {})
         overrides.update(decode=True, remat=False)
@@ -941,10 +1277,28 @@ class InferenceEngine:
         overrides.setdefault('param_dtype', param_dtype)
         if max_seq_len is not None:
             overrides['max_seq_len'] = max_seq_len
+        if page_size:
+            # Two-pass build: peek the config for max_seq_len, then
+            # size the page pool.  Explicit model_overrides win, like
+            # kv_cache_dtype above.
+            _, peek = models_lib.get_model(model, **overrides)
+            if peek.max_seq_len % page_size:
+                raise ValueError(
+                    f'page_size ({page_size}) must divide max_seq_len '
+                    f'({peek.max_seq_len})')
+            # Default pool: every slot can fill its row, +1 for the
+            # reserved null page — capacity-neutral vs contiguous;
+            # smaller max_pages oversubscribes (admission backpressure).
+            n_pages = max_pages if max_pages else \
+                max_batch_size * (peek.max_seq_len // page_size) + 1
+            overrides.setdefault('kv_page_size', page_size)
+            overrides.setdefault('kv_n_pages', n_pages)
         self.model, self.config = models_lib.get_model(model, **overrides)
         self._model_name, self._overrides = model, dict(overrides)
         self.kv_cache_dtype = getattr(self.config, 'kv_cache_dtype',
                                       'auto')
+        self.page_size = getattr(self.config, 'kv_page_size', 0)
+        self.n_pages = getattr(self.config, 'kv_n_pages', 0)
         self.max_batch = max_batch_size
         self.max_seq_len = self.config.max_seq_len
         self.prefill_bucket = max(1, prefill_bucket)
@@ -956,7 +1310,23 @@ class InferenceEngine:
         def _init():
             return self.model.init(rng, init_tokens)
 
-        abstract = jax.eval_shape(_init)
+        if self.page_size:
+            # Paged cache vars only exist on the slot-mode trace (the
+            # batch-wide kv_mask drives per-row write positions), so
+            # the abstract cache must be shaped under that mode: page
+            # pools [n_pages, kvh, page_size, hd] + per-slot block
+            # tables instead of contiguous [B, kvh, S, hd] rows.
+            from skypilot_tpu.models import llama as llama_lib
+            kv_mask0 = jnp.zeros((max_batch_size, self.max_seq_len),
+                                 bool)
+
+            def _init_paged():
+                return self.model.init(rng, init_tokens, None, kv_mask0)
+
+            with llama_lib.slot_mode():
+                abstract = jax.eval_shape(_init_paged)
+        else:
+            abstract = jax.eval_shape(_init)
         if mesh is not None:
             param_shardings = sharding_lib.unbox(
                 sharding_lib.params_to_shardings(mesh,
@@ -1189,11 +1559,23 @@ class InferenceEngine:
         padded = ((s_max + b - 1) // b) * b
         return min(padded, self.max_seq_len)
 
-    def cache_read_bytes_per_step(self, context: Optional[int] = None
-                                  ) -> Dict[str, float]:
+    def cache_read_bytes_per_step(self, context: Optional[int] = None,
+                                  row_contexts: Optional[Sequence[int]]
+                                  = None) -> Dict[str, float]:
         """Estimated HBM bytes one decode step reads from THIS engine's
         cache (grouped epilogue vs the old repeat path) — see
-        decode_cache_read_bytes."""
+        decode_cache_read_bytes.  Paged engines charge per-row
+        allocated pages: pass `row_contexts` for live per-slot context
+        lengths; without it every slot is assumed at `context` (or
+        max_seq_len), the paged worst case."""
+        if self.page_size:
+            if row_contexts is None:
+                ctx = context if context is not None \
+                    else self.max_seq_len
+                row_contexts = [ctx] * self.max_batch
+            return decode_cache_read_bytes(
+                self._abstract_cache, self.config.n_heads, context,
+                page_size=self.page_size, row_contexts=row_contexts)
         return decode_cache_read_bytes(self._abstract_cache,
                                        self.config.n_heads, context)
 
@@ -1203,6 +1585,12 @@ class InferenceEngine:
                  ) -> List[List[int]]:
         """Generate continuations for up to `max_batch_size` prompts of
         (possibly) different lengths. Returns one id list per prompt."""
+        if self.page_size:
+            # The paged layout only exists on the slot-mode trace; the
+            # request-level whole-batch path has no allocator.
+            raise RuntimeError(
+                'paged KV cache (page_size > 0) requires slot-mode '
+                'serving — use ContinuousBatchingEngine')
         cfg = sampling or SamplingConfig()
         n = len(prompts)
         if n == 0:

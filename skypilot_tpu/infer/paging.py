@@ -1,0 +1,164 @@
+"""Host-side page allocator for the paged KV cache.
+
+The device holds a flat pool of `n_pages` KV pages (page 0 is a
+reserved null page — dead slot rows and redirected writes land there
+and are never read).  This allocator owns the host-side bookkeeping:
+
+- a free stack of page ids,
+- per-page refcounts (a page may back several slots at once when it
+  holds a shared prompt prefix),
+- a prefix map from a chain hash of page-aligned prompt token chunks
+  to the page holding that chunk's K/V, so N concurrent requests with
+  a common system prompt prefill it once and read it once,
+- an LRU pool of "reclaimable" pages: prefix pages whose refcount
+  dropped to zero keep their contents and stay matchable until the
+  free stack runs dry, at which point `alloc` cannibalises them
+  oldest-first (RadixAttention-style eviction, flattened to a chain).
+
+Chain hashing: page i of a prompt hashes `(hash_of_page_{i-1},
+tuple(tokens[i*ps:(i+1)*ps]))`.  Because prefill attention is causal,
+a page's K/V depend only on the tokens at and before it — two prompts
+agreeing on the first k*ps tokens produce byte-identical first k
+pages, which is exactly what the chain hash certifies.
+
+Pure host-side Python; no jax imports.  Thread-unsafe by design: the
+engine calls it only from its single scheduler thread.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence
+
+NULL_PAGE = 0
+
+
+class PageAllocator:
+    """Free list + refcounts + prefix-chain map over a fixed page pool."""
+
+    def __init__(self, n_pages: int, page_size: int):
+        if n_pages < 2:
+            raise ValueError(
+                f'n_pages must be >= 2 (page {NULL_PAGE} is reserved), '
+                f'got {n_pages}')
+        if page_size < 1:
+            raise ValueError(f'page_size must be >= 1, got {page_size}')
+        self.n_pages = n_pages
+        self.page_size = page_size
+        # LIFO so tests see deterministic low-page-first allocation.
+        self._free: List[int] = list(range(n_pages - 1, 0, -1))
+        self._ref: Dict[int, int] = {}
+        # chain hash -> page holding that prefix chunk's K/V.
+        self._prefix_page: Dict[int, int] = {}
+        # page -> its chain hash (only registered prefix pages).
+        self._page_hash: Dict[int, int] = {}
+        # ref==0 registered pages, insertion order == LRU order.
+        self._reclaimable: 'collections.OrderedDict[int, int]' = \
+            collections.OrderedDict()
+
+    # -- capacity ---------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        """Pages allocatable right now (fresh + reclaimable)."""
+        return len(self._free) + len(self._reclaimable)
+
+    @property
+    def live_pages(self) -> int:
+        """Pages currently referenced by at least one slot."""
+        return len(self._ref)
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
+    # -- alloc / retain / release -----------------------------------
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take `n` pages with refcount 1 each, or None if they don't
+        all fit (all-or-nothing, so admission never half-lands)."""
+        if n < 0:
+            raise ValueError(f'alloc({n})')
+        if n > self.free_pages:
+            return None
+        out = []
+        for _ in range(n):
+            if self._free:
+                page = self._free.pop()
+            else:
+                # Cannibalise the least-recently-released prefix page;
+                # its cached prefix is no longer matchable.
+                h, page = self._reclaimable.popitem(last=False)
+                del self._prefix_page[h]
+                del self._page_hash[page]
+            self._ref[page] = 1
+            out.append(page)
+        return out
+
+    def retain(self, page: int) -> None:
+        """Add a reference (prefix hit).  Resurrects a reclaimable
+        page — its contents are still valid until cannibalised."""
+        ref = self._ref.get(page, 0)
+        if ref == 0:
+            h = self._page_hash.get(page)
+            if h is None or h not in self._reclaimable:
+                raise ValueError(f'retain of unallocated page {page}')
+            del self._reclaimable[h]
+        self._ref[page] = ref + 1
+
+    def release(self, page: int) -> None:
+        """Drop one reference.  At zero, registered prefix pages park
+        in the reclaimable LRU (contents preserved); anonymous pages
+        go straight back to the free stack."""
+        ref = self._ref.get(page, 0)
+        if ref <= 0:
+            raise ValueError(f'release of unreferenced page {page}')
+        if ref > 1:
+            self._ref[page] = ref - 1
+            return
+        del self._ref[page]
+        h = self._page_hash.get(page)
+        if h is not None:
+            self._reclaimable[h] = page
+        else:
+            self._free.append(page)
+
+    # -- prefix sharing ---------------------------------------------
+
+    def _chain_hashes(self, tokens: Sequence[int]) -> List[int]:
+        ps = self.page_size
+        hashes, h = [], 0
+        for i in range(len(tokens) // ps):
+            h = hash((h, tuple(tokens[i * ps:(i + 1) * ps])))
+            hashes.append(h)
+        return hashes
+
+    def lookup_prefix(self, tokens: Sequence[int],
+                      max_pages: Optional[int] = None) -> List[int]:
+        """Longest already-cached page-aligned prefix of `tokens`.
+        Every returned page is retained (caller must release)."""
+        pages = []
+        for i, h in enumerate(self._chain_hashes(tokens)):
+            if max_pages is not None and i >= max_pages:
+                break
+            page = self._prefix_page.get(h)
+            if page is None:
+                break
+            pages.append(page)
+        for page in pages:
+            self.retain(page)
+        return pages
+
+    def register_prefix(self, tokens: Sequence[int],
+                        pages: Sequence[int]) -> None:
+        """Publish a prefilled prompt's pages for future sharing.
+        `pages[i]` must hold the K/V of tokens[i*ps:(i+1)*ps]; only
+        full pages are registrable, trailing tokens are ignored."""
+        for i, h in enumerate(self._chain_hashes(tokens)):
+            if i >= len(pages):
+                break
+            if h in self._prefix_page:
+                continue                      # already published
+            page = pages[i]
+            if page in self._page_hash or page == NULL_PAGE:
+                continue
+            self._prefix_page[h] = page
+            self._page_hash[page] = h

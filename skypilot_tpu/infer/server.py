@@ -63,6 +63,8 @@ class InferenceServer:
                  kv_read_bucket: int = 512,
                  quantize=None,
                  kv_cache_dtype: str = 'auto',
+                 page_size: int = 0,
+                 max_pages: int = 0,
                  compilation_cache_dir=None,
                  tokenizer: Optional[str] = None,
                  allow_random_weights: bool = False,
@@ -96,8 +98,14 @@ class InferenceServer:
                 model_overrides=model_overrides,
                 prefill_chunk=prefill_chunk,
                 kv_read_bucket=kv_read_bucket,
-                quantize=quantize, kv_cache_dtype=kv_cache_dtype)
+                quantize=quantize, kv_cache_dtype=kv_cache_dtype,
+                page_size=page_size, max_pages=max_pages)
         else:
+            if page_size:
+                raise ValueError(
+                    '--page-size requires continuous batching (the '
+                    'paged KV cache is slot-mode only); drop '
+                    '--no-continuous.')
             self.engine = engine_lib.InferenceEngine(
                 model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
                 max_batch_size=max_batch_size,
@@ -456,6 +464,24 @@ def main() -> None:
                              'doubles the contexts that fit; dequant '
                              'stays fused in the attention epilogue. '
                              'Composes with --quantize (weights).')
+    parser.add_argument('--page-size', type=int, default=0,
+                        help='Paged KV cache: split the cache into '
+                             'pages of this many positions (power of '
+                             'two dividing --max-seq-len and the '
+                             'prefill bucket) — decode HBM reads '
+                             'track each request\'s LIVE context '
+                             'instead of max-seq-len, and requests '
+                             'sharing a prompt prefix share its '
+                             'pages (prefilled once, refcounted). '
+                             '0 = contiguous per-slot rows. Requires '
+                             'continuous batching.')
+    parser.add_argument('--max-pages', type=int, default=0,
+                        help='Page-pool size for --page-size (incl. '
+                             'the reserved null page). Default sizes '
+                             'the pool so every slot can fill its '
+                             'row; smaller values oversubscribe — '
+                             'admission then waits for free pages '
+                             '(backpressure) instead of free slots.')
     parser.add_argument('--compilation-cache-dir', default=None,
                         help='Persistent XLA compile cache: '
                              'scale-up replicas/restarts skip the '
@@ -499,6 +525,8 @@ def main() -> None:
                     kv_read_bucket=args.kv_read_bucket,
                     quantize=args.quantize,
                     kv_cache_dtype=args.kv_cache_dtype,
+                    page_size=args.page_size,
+                    max_pages=args.max_pages,
                     compilation_cache_dir=args.compilation_cache_dir,
                     tokenizer=args.tokenizer,
                     allow_random_weights=args.allow_random_weights,

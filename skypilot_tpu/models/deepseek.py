@@ -105,6 +105,10 @@ class DeepSeekConfig:
     # quantization: one absmax scale per (latent, position) row of the
     # [B, 1, S, rkv+dr] cache — same layout as the GQA families.
     kv_cache_dtype: str = 'auto'     # 'auto' | 'int8' (llama.py)
+    # Paged slot-mode KV cache (llama.py run_cached_attention):
+    # 0 = contiguous rows.
+    kv_page_size: int = 0
+    kv_n_pages: int = 0
     partition_params: bool = True
     # Unused by MLA but read via getattr by shared helpers.
     sliding_window: Optional[int] = None
@@ -303,7 +307,9 @@ class MLAAttention(nn.Module):
         out_latent = llama.run_cached_attention(
             self, q_eff, k_eff, v_eff, kv_mask, n_kv_heads=1,
             max_seq_len=cfg.max_seq_len, dtype=cfg.dtype,
-            kv_cache_dtype=getattr(cfg, 'kv_cache_dtype', 'auto'))
+            kv_cache_dtype=getattr(cfg, 'kv_cache_dtype', 'auto'),
+            page_size=getattr(cfg, 'kv_page_size', 0),
+            n_pages=getattr(cfg, 'kv_n_pages', 0))
         out_latent = out_latent[..., :rkv]        # [B, S, H, rkv]
         return jnp.einsum('bshr,rhv->bshv', out_latent, wuv)
 

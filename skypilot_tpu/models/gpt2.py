@@ -42,6 +42,10 @@ class Gpt2Config:
     # Serving mode: KV cache via the shared llama.run_cached_attention.
     decode: bool = False
     kv_cache_dtype: str = 'auto'     # 'auto' | 'int8' (llama.py)
+    # Paged slot-mode KV cache (llama.py run_cached_attention):
+    # 0 = contiguous rows.
+    kv_page_size: int = 0
+    kv_n_pages: int = 0
     partition_params: bool = True
 
     @property
@@ -119,8 +123,10 @@ class Gpt2Attention(nn.Module):
                 self, q, k, v, kv_mask, n_kv_heads=h,
                 max_seq_len=cfg.max_seq_len,
                 dtype=cfg.dtype,
-                kv_cache_dtype=getattr(cfg, 'kv_cache_dtype',
-                                       'auto')).reshape(b, s, h * hd)
+                kv_cache_dtype=getattr(cfg, 'kv_cache_dtype', 'auto'),
+                page_size=getattr(cfg, 'kv_page_size', 0),
+                n_pages=getattr(cfg, 'kv_n_pages', 0),
+                ).reshape(b, s, h * hd)
         else:
             out = (fa.flash_attention(q, k, v)
                    if cfg.attention_impl == 'flash'

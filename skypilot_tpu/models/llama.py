@@ -69,6 +69,17 @@ class LlamaConfig:
     # (run_cached_attention) and reads through the fused-dequant
     # epilogue — halves decode cache traffic vs bf16.
     kv_cache_dtype: str = 'auto'
+    # Paged serving KV cache (slot-mode continuous batching only):
+    # kv_page_size > 0 stores the decode cache as a pool of
+    # [kv_n_pages, kvh, kv_page_size, hd] physical pages plus a per-slot
+    # block table, so decode HBM reads scale with each request's LIVE
+    # context instead of max_seq_len, and prefix pages can be
+    # refcount-shared between requests (infer/paging.py).  Page 0 is a
+    # reserved null page.  0 = contiguous [B, kvh, max_seq_len, hd]
+    # rows (the request-level engine always uses the contiguous
+    # layout).
+    kv_page_size: int = 0
+    kv_n_pages: int = 0
     # Attach logical-axis metadata to params (nn.with_partitioning).
     # Disabled when modules are applied inside a shard_map manual region
     # (pipeline stages): flax's apply-time shape validation eval_shapes
@@ -276,13 +287,112 @@ def slot_mode():
         _SLOT_MODE.on = prev
 
 
+def _paged_slot_attention(module: nn.Module, q: jax.Array,
+                          k: jax.Array, v: jax.Array,
+                          kv_mask: jax.Array, *, kvh: int, max_len: int,
+                          dtype: Any, window: Optional[int],
+                          quant: bool, page_size: int,
+                          n_pages: int) -> jax.Array:
+    """Slot-mode decode against the PAGED cache (PagedAttention layout).
+
+    The cache is a pool of physical pages [n_pages, kvh, page_size, hd]
+    (int8 pools carry sibling f32 scale pools) shared by every slot;
+    each slot's 'block_table' row maps its logical page i (cache
+    positions [i*ps, (i+1)*ps)) to a physical page.  Page 0 is a
+    reserved NULL page: unallocated/evicted table entries point there,
+    so a dead row's write (contiguous slot mode's "harmless rewrite")
+    lands in the null page instead of scribbling into a page that may
+    since belong to another request, and out-of-range gathers read
+    garbage that kv_mask hides.  Reads gather only the pages under the
+    engine's bucketed high-water mark (kv_read_bucket), so per-step HBM
+    traffic tracks allocated live context, not max_seq_len — and
+    prefix pages refcount-shared between slots (infer/paging.py) are
+    read through each sharer's table without ever being duplicated.
+    """
+    b, h, s, hd = q.shape
+    ps = page_size
+    if max_len % ps:
+        raise ValueError(
+            f'kv_page_size ({ps}) must divide max_seq_len ({max_len})')
+    if n_pages < 2:
+        raise ValueError(
+            f'kv_n_pages must be >= 2 (page 0 is the reserved null '
+            f'page), got {n_pages}')
+    pages_per_slot = max_len // ps
+    cache_dtype = jnp.int8 if quant else dtype
+    page_k = module.variable('cache', 'page_key', jnp.zeros,
+                             (n_pages, kvh, ps, hd), cache_dtype)
+    page_v = module.variable('cache', 'page_value', jnp.zeros,
+                             (n_pages, kvh, ps, hd), cache_dtype)
+    if quant:
+        pk_scale = module.variable('cache', 'page_key_scale',
+                                   jnp.zeros, (n_pages, kvh, ps, 1),
+                                   jnp.float32)
+        pv_scale = module.variable('cache', 'page_value_scale',
+                                   jnp.zeros, (n_pages, kvh, ps, 1),
+                                   jnp.float32)
+    table = module.variable('cache', 'block_table', jnp.zeros,
+                            (b, pages_per_slot), jnp.int32)
+    cursor = module.variable('cache', 'cache_index',
+                             lambda: jnp.zeros((), jnp.int32))
+    # Write position: the row's highest revealed kv_mask slot (same
+    # rule as the contiguous slot branch); the block table translates
+    # it to (physical page, in-page offset).
+    write_pos = jnp.max(
+        jnp.where(kv_mask, jnp.arange(max_len, dtype=jnp.int32), 0),
+        axis=-1)                                   # [B]
+    brange = jnp.arange(b)
+    phys = table.value[brange, write_pos // ps]    # [B]
+    off = write_pos % ps
+    if quant:
+        kq, ks = ga.quantize_int8_rows(k[:, :, 0, :])  # [b,kvh,hd]
+        vq, vs = ga.quantize_int8_rows(v[:, :, 0, :])
+        page_k.value = page_k.value.at[phys, :, off, :].set(kq)
+        page_v.value = page_v.value.at[phys, :, off, :].set(vq)
+        pk_scale.value = pk_scale.value.at[phys, :, off, :].set(ks)
+        pv_scale.value = pv_scale.value.at[phys, :, off, :].set(vs)
+    else:
+        page_k.value = page_k.value.at[phys, :, off, :].set(
+            k[:, :, 0, :].astype(dtype))
+        page_v.value = page_v.value.at[phys, :, off, :].set(
+            v[:, :, 0, :].astype(dtype))
+    cursor.value = cursor.value + 1
+    # Static page-granular read window: the engine's kv_read_bucket
+    # high-water mark, rounded up to whole pages.  Pages past it are
+    # unrevealed for every active row, so the truncation is exact.
+    bucket = getattr(_SLOT_MODE, 'kv_bucket', None)
+    read_len = bucket if (bucket is not None
+                          and bucket < max_len) else max_len
+    n_read = -(-read_len // ps)
+    read_len = n_read * ps
+    tbl = table.value[:, :n_read]
+    keys = ga.gather_pages(page_k.value, tbl)
+    values = ga.gather_pages(page_v.value, tbl)
+    visible = kv_mask
+    if window is not None:
+        visible = visible & (
+            jnp.arange(max_len)[None, :] >= write_pos[:, None]
+            - window + 1)
+    mask = visible[:, None, None, :read_len]
+    if quant:
+        k_sc = ga.gather_pages(pk_scale.value, tbl)
+        v_sc = ga.gather_pages(pv_scale.value, tbl)
+        return ga.quantized_grouped_attention(
+            q, keys, k_sc, values, v_sc, mask, scale=hd ** -0.5,
+            probs_dtype=dtype)
+    return ga.grouped_attention(q, keys, values, mask,
+                                scale=hd ** -0.5, probs_dtype=dtype)
+
+
 def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
                          v: jax.Array,
                          kv_mask: Optional[jax.Array], *,
                          n_kv_heads: int, max_seq_len: int,
                          dtype: Any,
                          window: Optional[int] = None,
-                         kv_cache_dtype: str = 'auto') -> jax.Array:
+                         kv_cache_dtype: str = 'auto',
+                         page_size: int = 0,
+                         n_pages: int = 0) -> jax.Array:
     """Attention against the KV cache (serving) — shared by every
     family (Llama/Gemma via llama.Attention, GPT-2's MHA).
 
@@ -310,6 +420,16 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
     b, h, s, hd = q.shape
     kvh = n_kv_heads
     max_len = max_seq_len
+    slot = (s == 1 and kv_mask is not None
+            and getattr(_SLOT_MODE, 'on', False))
+    if page_size > 0 and slot:
+        # Paged layout exists only for the slot-mode decode batch; the
+        # batch-1 chunked-prefill cache stays contiguous (its pages
+        # are scattered into the pool by the engine's paged insert).
+        return _paged_slot_attention(
+            module, q, k, v, kv_mask, kvh=kvh, max_len=max_len,
+            dtype=dtype, window=window, quant=quant,
+            page_size=page_size, n_pages=n_pages)
     cache_dtype = jnp.int8 if quant else dtype
     cached_k = module.variable('cache', 'cached_key', jnp.zeros,
                                (b, kvh, max_len, hd), cache_dtype)
@@ -327,8 +447,7 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
     cursor = module.variable('cache', 'cache_index',
                              lambda: jnp.zeros((), jnp.int32))
     idx = cursor.value
-    if s == 1 and kv_mask is not None and getattr(_SLOT_MODE, 'on',
-                                                  False):
+    if slot:
         # Slot-mode decode (continuous batching): each row's write
         # position is its highest *revealed* kv_mask slot — the engine
         # reveals the new token's slot before this forward, so rows at
@@ -400,17 +519,27 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
             cached_v.value = jax.lax.dynamic_update_slice(
                 cached_v.value, v.astype(dtype), (0, 0, idx, 0))
         cursor.value = idx + s
-        slots = jnp.arange(max_len)
+        # Chunked-prefill read cap (kv_read_bucket, same machinery as
+        # slot-mode decode): the engine guarantees bucket >= idx + s,
+        # and the causal term below zeroes every column >= idx + s, so
+        # slicing keys/values/mask to the bucket is exact — prefill
+        # chunk attention reads the live prefix, not max_seq_len.
+        bucket = getattr(_SLOT_MODE, 'kv_bucket', None)
+        read_len = bucket if (bucket is not None
+                              and bucket < max_len) else max_len
+        slots = jnp.arange(read_len)
         rows = idx + jnp.arange(s)
         causal = slots[None, :] <= rows[:, None]
         if window is not None:
             causal &= slots[None, :] >= rows[:, None] - window + 1
-        mask = causal[None, None]                  # [1,1,s,max]
+        mask = causal[None, None]                  # [1,1,s,read]
         if kv_mask is not None:
-            mask = mask & kv_mask[:, None, None, :]
-        keys, values = cached_k.value, cached_v.value
+            mask = mask & kv_mask[:, None, None, :read_len]
+        keys = cached_k.value[:, :, :read_len]
+        values = cached_v.value[:, :, :read_len]
         if quant:
-            k_sc, v_sc = k_scale.value, v_scale.value
+            k_sc = k_scale.value[:, :, :read_len]
+            v_sc = v_scale.value[:, :, :read_len]
     # Grouped epilogue: the cache stays [B, kvh, read_len, hd] — the
     # head-group broadcast happens inside the einsum, never in HBM
     # (ops/grouped_attention.py).  The scale intentionally uses q's
@@ -514,7 +643,11 @@ class Attention(nn.Module):
                                         cfg, 'sliding_window',
                                         None),
                                     kv_cache_dtype=getattr(
-                                        cfg, 'kv_cache_dtype', 'auto'))
+                                        cfg, 'kv_cache_dtype', 'auto'),
+                                    page_size=getattr(
+                                        cfg, 'kv_page_size', 0),
+                                    n_pages=getattr(
+                                        cfg, 'kv_n_pages', 0))
 
 
 class MLP(nn.Module):

@@ -54,6 +54,10 @@ class QwenConfig:
     attention_impl: str = 'flash'
     decode: bool = False
     kv_cache_dtype: str = 'auto'     # 'auto' | 'int8' (llama.py)
+    # Paged slot-mode KV cache (llama.py run_cached_attention):
+    # 0 = contiguous rows.
+    kv_page_size: int = 0
+    kv_n_pages: int = 0
     partition_params: bool = True
     attention_bias: bool = True      # the Qwen2 signature
     tie_embeddings: bool = False

@@ -94,6 +94,31 @@ def grouped_attention(q: jax.Array, keys: jax.Array, values: jax.Array,
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
+def gather_pages(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """Assemble per-row contiguous K/V views from a paged cache pool.
+
+    pool:  [n_pages, kvh, page_size, d] — the physical page pool (K, V,
+           or an int8 sibling scale pool with d == 1).
+    table: [B, n_read] int32 — each row's block table, truncated to the
+           n_read logical pages the decode step actually reads (the
+           bucketed high-water mark divided by page_size).  Entries for
+           pages a row never allocated point at the reserved null page
+           0; their content is garbage that kv_mask hides.
+
+    Returns [B, kvh, n_read * page_size, d]: position j of the result
+    is the row's absolute cache slot j, so kv_mask / sliding-window
+    semantics carry over from the contiguous layout unchanged.  One
+    gather per pool per step — HBM reads scale with n_read (allocated,
+    live pages), not max_seq_len.
+    """
+    b, n_read = table.shape
+    _, kvh, ps, d = pool.shape
+    g = jnp.take(pool, table.reshape(-1), axis=0)
+    g = g.reshape(b, n_read, kvh, ps, d)
+    return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(
+        b, kvh, n_read * ps, d)
+
+
 def quantize_int8_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Per-row symmetric int8 absmax quantization over the LAST axis.
 

@@ -24,6 +24,24 @@ jax.config.update('jax_platforms', 'cpu')
 import pytest
 
 
+@pytest.fixture(scope='session', autouse=True)
+def _xla_compilation_cache(tmp_path_factory):
+    """Session-wide persistent XLA compilation cache.  The suite
+    compiles the same tiny-model graphs dozens of times across files
+    (every engine build re-jits structurally identical prefill/decode
+    programs); content-addressed reuse cuts tier-1 wall time ~35% on
+    CPU.  Scoped to a per-session tmp dir so runs never share stale
+    artifacts."""
+    cache_dir = tmp_path_factory.mktemp('jax_compile_cache')
+    jax.config.update('jax_compilation_cache_dir', str(cache_dir))
+    # Tiny test graphs compile fast and small — cache them all, not
+    # just the >1s defaults.
+    jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                      0.0)
+    jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
+    yield
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         'markers',

@@ -103,7 +103,11 @@ def test_all_rungs_failing_emits_stale_cache_when_present(
     monkeypatch.setattr(
         bench, 'run_direct_subprocess',
         lambda _s: (_ for _ in ()).throw(RuntimeError('direct')))
-    bench.main()  # no SystemExit: the cache rung produced a metric
+    # The cache rung produced a metric line, but it is NOT a live
+    # capture: the driver must be able to tell (distinct rc).
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == bench._STALE_RC
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
     parsed = json.loads(out[0])
@@ -175,7 +179,10 @@ def test_tpu_emit_writes_cache_cpu_does_not(bench, monkeypatch,
     monkeypatch.setattr(
         bench, 'run_direct_subprocess',
         lambda _s: (_ for _ in ()).throw(RuntimeError('y')))
-    bench.main()  # no SystemExit: cache rung emits the capture
+    # The cache rung emits the capture, flagged stale via the rc.
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == bench._STALE_RC
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
     parsed = json.loads(out[0])
@@ -389,7 +396,39 @@ def test_sigterm_handler_prefers_cached_number(bench, monkeypatch,
     assert parsed['value'] == 2000.0
     assert parsed['stale'] is True
     assert parsed['raw_mfu_pct'] == 70.1  # raw fields survive caching
-    assert exits == [0]  # a cached number is a success exit
+    # A cached number is better than nothing but it is NOT a live
+    # capture: the distinct rc lets the driver tell the difference.
+    assert exits == [bench._STALE_RC]
+
+
+def test_stale_cache_exit_code_is_distinct(bench, monkeypatch, capsys,
+                                           tmp_path):
+    """The rc contract (BENCH_r05): 0 = live metric, 1 = no metric at
+    all, _STALE_RC = only a stale cached metric was emitted — three
+    outcomes the driver must be able to distinguish blindly."""
+    import signal as signal_mod
+    import time as time_mod
+    assert bench._STALE_RC == 3
+    assert bench._STALE_RC not in (0, 1)
+    # Without a cache the deadline handler still reports failure (1).
+    exits = []
+    monkeypatch.setattr(bench.os, '_exit', exits.append)
+    bench._on_deadline_signal(signal_mod.SIGTERM, None)
+    assert exits == [1]
+    capsys.readouterr()
+    # With a fresh cache the SAME handler exits _STALE_RC instead.
+    cache = tmp_path / 'bench_cache.json'
+    cache.write_text(json.dumps({
+        'metric': 'm', 'value': 5.0, 'unit': 'u', 'vs_baseline': 1.0,
+        'captured_at': '2026-08-01T00:00:00Z',
+        'captured_unix': time_mod.time() - 60,
+    }))
+    monkeypatch.setenv('SKYTPU_BENCH_CACHE', str(cache))
+    monkeypatch.setattr(bench, '_FINAL_EMITTED', False)  # fresh state
+    bench._on_deadline_signal(signal_mod.SIGTERM, None)
+    assert exits == [1, bench._STALE_RC]
+    assert json.loads(
+        capsys.readouterr().out.strip())['stale'] is True
 
 
 def test_emit_metrics_line_is_self_auditing(bench, capsys):
@@ -435,34 +474,49 @@ def test_emit_carries_tokens_per_dollar(bench, capsys):
     assert 'equiv_tokens_per_dollar' not in parsed
 
 
-def test_decode_emits_one_json_line_and_stderr_summary(
-        bench, monkeypatch, capsys):
-    """--decode must put exactly ONE machine-readable JSON line on
-    stdout (metric/value/unit/vs_baseline + both arms) and its human
-    summary on stderr — same contract as the train bench, so the
-    driver can parse stdout blindly."""
+def _fake_decode_engines(bench, monkeypatch):
+    """Swap ContinuousBatchingEngine for a deterministic fake that
+    mimics the read-bytes accounting of both cache layouts."""
     import itertools
+    import types
 
     from skypilot_tpu.infer import engine as engine_mod
 
     built = []
 
     class _FakeCBE:
+        kv_read_bucket = 512
+
         def __init__(self, model, n_slots=4, prefill_bucket=16,
                      model_overrides=None, param_dtype=None,
-                     params=None, kv_cache_dtype='auto', **_kw):
+                     params=None, kv_cache_dtype='auto', page_size=0,
+                     **_kw):
             self.kv_cache_dtype = kv_cache_dtype
+            self.page_size = page_size
+            self.max_seq_len = (model_overrides or {}).get(
+                'max_seq_len', 512)
             self.params = {'w': 0} if params is None else params
+            self._eng = types.SimpleNamespace(
+                _bucketed=lambda n, b=prefill_bucket:
+                    min(((n + b - 1) // b) * b, self.max_seq_len))
             built.append(self)
 
         def generate(self, prompts, sampling):
             return [[1] * sampling.max_new_tokens for _ in prompts]
 
-        def cache_read_bytes_per_step(self, context=None):
+        def cache_read_bytes_per_step(self, context=None,
+                                      row_contexts=None):
             # bf16: 2*576*2 bytes/pos; int8: 2*576 + 2*4 (scales).
             per_pos = 1160.0 if self.kv_cache_dtype == 'int8' \
                 else 2304.0
-            grouped = 2 * 4 * 44 * per_pos  # layers*B*context
+            if row_contexts is not None:       # paged: live rows only
+                ps = self.page_size or 1
+                positions = sum(-(-c // ps) * ps
+                                for c in row_contexts)
+            else:                              # contiguous: B * bucket
+                positions = 4 * (context if context is not None
+                                 else self.max_seq_len)
+            grouped = 2 * positions * per_pos  # layers * positions
             return {'grouped_bytes': grouped,
                     'repeat_bytes': grouped * 16.0,
                     'reduction': 16.0}
@@ -472,6 +526,16 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     ticks = itertools.count()
     monkeypatch.setattr(bench.time, 'time',
                         lambda: float(next(ticks)))
+    return built
+
+
+def test_decode_emits_one_json_line_and_stderr_summary(
+        bench, monkeypatch, capsys):
+    """--decode must put exactly ONE machine-readable JSON line on
+    stdout (metric/value/unit/vs_baseline + all three arms) and its
+    human summary on stderr — same contract as the train bench, so
+    the driver can parse stdout blindly."""
+    built = _fake_decode_engines(bench, monkeypatch)
     bench.run_decode(None)
     captured = capsys.readouterr()
     out = captured.out.strip().splitlines()
@@ -480,13 +544,89 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     for key in ('metric', 'value', 'unit', 'vs_baseline'):
         assert key in parsed, key
     assert parsed['value'] == round(2304.0 / 1160.0, 2)  # 1.99
-    assert set(parsed['arms']) == {'bf16', 'int8'}
+    assert set(parsed['arms']) == {'bf16', 'int8', 'paged'}
     assert parsed['arms']['int8']['kv_cache_dtype'] == 'int8'
     assert 'int8' in parsed['metric']
-    # Both arms served the SAME weights.
-    assert built[0].kv_cache_dtype == 'auto'
-    assert built[1].kv_cache_dtype == 'int8'
-    assert built[1].params is built[0].params
+    # Ragged arm: contiguous reads 4 slots * the full 512 bucket;
+    # paged reads only the live contexts [128, 24, 24, 24].
+    assert parsed['arms']['paged']['row_contexts'] == \
+        [128, 24, 24, 24]
+    assert parsed['paged_read_reduction_vs_contiguous'] == \
+        round(4 * 512 / 200, 2)  # 10.24
+    assert parsed['paged_token_parity'] is True
+    # Four engines, all serving the SAME weights.
+    assert [b.kv_cache_dtype for b in built] == \
+        ['auto', 'int8', 'auto', 'auto']
+    assert [b.page_size for b in built] == [0, 0, 0, 8]
+    assert all(b.params is built[0].params for b in built[1:])
     err = [l for l in captured.err.splitlines() if l.startswith('#')]
-    assert len(err) == 3  # one per arm + the ratio line
-    assert 'fewer bytes/step' in err[-1]
+    assert len(err) == 4  # one per dtype arm + ratio + paged line
+    assert 'fewer bytes/step' in err[-2]
+    assert 'token parity: True' in err[-1]
+
+
+def test_decode_smoke_paged_arm_flag(bench, monkeypatch, capsys):
+    """--smoke shrinks every arm to tier-1 scale but keeps the full
+    three-arm contract, including the paged ragged workload."""
+    _fake_decode_engines(bench, monkeypatch)
+    bench.run_decode(None, smoke=True)
+    parsed = json.loads(capsys.readouterr().out.strip())
+    arm = parsed['arms']['paged']
+    assert arm['max_seq_len'] == 256
+    assert arm['row_contexts'] == [64, 16, 16, 16]
+    assert arm['mean_live_context'] <= 256 / 8
+    assert parsed['paged_read_reduction_vs_contiguous'] == \
+        round(4 * 256 / 112, 2)  # 9.14
+    assert parsed['paged_token_parity'] is True
+
+
+def test_decode_smoke_paged_arm_end_to_end():
+    """The real thing, no fakes: `bench.py --decode --smoke` runs the
+    three-arm decode bench (tiny DeepSeek geometry) on CPU in under a
+    minute and must prove the tentpole's acceptance bar — >= 4x fewer
+    decode read-bytes paged-vs-contiguous on the ragged workload with
+    EXACT greedy token parity."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    proc = subprocess.run(
+        [sys.executable, _BENCH_PATH, '--decode', '--smoke'],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    parsed = json.loads(lines[0])
+    assert parsed['paged_token_parity'] is True
+    assert parsed['paged_read_reduction_vs_contiguous'] >= 4.0
+    arm = parsed['arms']['paged']
+    assert arm['token_parity_vs_contiguous'] is True
+    assert arm['cache_read_bytes_per_step_paged'] * 4 <= \
+        arm['cache_read_bytes_per_step_contiguous']
+
+
+def test_sleep_skip_when_spacing_would_burn_the_window(
+        bench, monkeypatch, capsys):
+    """BENCH_r05: with too little headroom for a full 600s nap PLUS a
+    minimum-length attempt, the ladder must retry back-to-back instead
+    of sleeping through its own window."""
+    sleeps = []
+    monkeypatch.setattr(bench.time, 'sleep', sleeps.append)
+    monkeypatch.setenv('SKYTPU_BENCH_DIRECT_ATTEMPTS', '3')
+    monkeypatch.setenv('SKYTPU_BENCH_DIRECT_SPACING_S', '600')
+    monkeypatch.setattr(bench, '_TOTAL_BUDGET_S', 400.0)
+    monkeypatch.setattr(
+        bench, 'run_through_launch',
+        lambda _s, **_kw: (_ for _ in ()).throw(RuntimeError('x')))
+    calls = {'direct': 0}
+
+    def _direct(_steps):
+        calls['direct'] += 1
+        raise bench.BenchError('hang')
+
+    monkeypatch.setattr(bench, 'run_direct_subprocess', _direct)
+    with pytest.raises(SystemExit):
+        bench.main()
+    assert calls['direct'] == 3          # every window actually used
+    assert 600.0 not in sleeps           # never slept the full nap
+    err = capsys.readouterr().err
+    assert 'skipping the 600s inter-attempt sleep' in err
+    assert 'back-to-back' in err
