@@ -405,6 +405,7 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
     import numpy as np
 
     from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.observability import metrics as metrics_lib
 
     # stdout carries exactly one JSON line; the framework logger
     # defaults to stdout (sky_logging), so point it at stderr here —
@@ -488,19 +489,24 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
                                             temperature=0.0)
     pg_overrides = dict(overrides, max_seq_len=pg_seq)
 
-    def _ragged_arm(page_size):
+    def _ragged_arm(page_size, registry=None):
         eng = engine_lib.ContinuousBatchingEngine(
             'deepseek-v2-lite', n_slots=n_slots, prefill_bucket=8,
             model_overrides=dict(pg_overrides),
             param_dtype=jnp.float32, params=params,
-            page_size=page_size)
+            page_size=page_size, registry=registry)
         eng.generate(pg_prompts, pg_sampling)      # compile warmup
         t0 = time.time()
         outs = eng.generate(pg_prompts, pg_sampling)
         return eng, outs, time.time() - t0
 
     contig_eng, contig_outs, contig_dt = _ragged_arm(0)
-    paged_eng, paged_outs, paged_dt = _ragged_arm(pg_ps)
+    # The paged arm runs against a private registry so the embedded
+    # telemetry snapshot reflects exactly this workload (the process
+    # global would mix in the earlier arms' series).
+    paged_reg = metrics_lib.Registry()
+    paged_eng, paged_outs, paged_dt = _ragged_arm(pg_ps,
+                                                  registry=paged_reg)
     # Final live context per slot: bucketed prompt pad + new tokens.
     finals = [min(max(paged_eng._eng._bucketed(n), n),
                   pg_seq - pg_new) + pg_new for n in pg_lens]
@@ -531,6 +537,48 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
         'read_reduction_vs_contiguous': round(pg_ratio, 2),
     }
 
+    # --- telemetry snapshot from the paged arm's private registry ----
+    # Zeros when the engine is faked out in tests (the fake never
+    # touches the registry).  The overhead numbers come from a direct
+    # microbench of the per-step publish path — the only telemetry
+    # cost on the decode hot path — expressed as a fraction of this
+    # run's measured step time, plus a whole-arm rerun with a DISABLED
+    # registry as an informational cross-check.
+    def _reg_val(name):
+        m = paged_reg.get(name)
+        return m.value if m is not None else 0.0
+
+    t_steps = _reg_val('skytpu_decode_steps_total')
+    t_slot_steps = _reg_val('skytpu_decode_slot_steps_total')
+    t_hits = _reg_val('skytpu_prefix_cache_page_hits_total')
+    t_misses = _reg_val('skytpu_prefix_cache_page_misses_total')
+    paged_steps = max(1, max((len(o) for o in paged_outs), default=1))
+    publish_s = 0.0
+    if hasattr(paged_eng, '_publish_step_metrics'):
+        iters = 256
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            paged_eng._publish_step_metrics(n_slots, 1e6)  # pylint: disable=protected-access
+        publish_s = (time.perf_counter() - t0) / iters
+    _, dis_outs, dis_dt = _ragged_arm(
+        pg_ps, registry=metrics_lib.Registry(enabled=False))
+    telemetry = {
+        'prefix_page_hits': t_hits,
+        'prefix_page_misses': t_misses,
+        'prefix_hit_ratio': round(
+            t_hits / (t_hits + t_misses), 3) if t_hits + t_misses
+            else 0.0,
+        'mean_batch_occupancy': round(
+            t_slot_steps / (t_steps * n_slots), 3) if t_steps else 0.0,
+        'pages_cannibalized': _reg_val(
+            'skytpu_kv_pages_cannibalized_total'),
+        'publish_us_per_step': round(publish_s * 1e6, 2),
+        'publish_pct_of_step': round(
+            100.0 * publish_s / max(paged_dt / paged_steps, 1e-9), 3),
+        'tokens_per_sec_paged_disabled_registry': round(
+            sum(len(o) for o in dis_outs) / max(dis_dt, 1e-9), 1),
+    }
+
     result = {
         'metric': 'decode int8-KV cache-read reduction (B=4 slots, '
                   'deepseek-v2-lite attention geometry)',
@@ -543,6 +591,7 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
                        f' MB/step',
         'arms': {'bf16': bf16_arm, 'int8': int8_arm,
                  'paged': paged_arm},
+        'telemetry': telemetry,
         'paged_read_reduction_vs_contiguous': round(pg_ratio, 2),
         'paged_token_parity': pg_parity,
         'n_heads': 16,
@@ -570,6 +619,15 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
           f'({contig_reads["grouped_bytes"] / 1e6:.2f} MB -> '
           f'{paged_reads["grouped_bytes"] / 1e6:.2f} MB), greedy '
           f'token parity: {pg_parity}', file=sys.stderr)
+    print(f'# telemetry: prefix hit ratio '
+          f'{telemetry["prefix_hit_ratio"]:.2f} '
+          f'({telemetry["prefix_page_hits"]:.0f} hits / '
+          f'{telemetry["prefix_page_misses"]:.0f} misses), mean '
+          f'occupancy {telemetry["mean_batch_occupancy"]:.2f}, '
+          f'{telemetry["pages_cannibalized"]:.0f} pages cannibalized; '
+          f'metric publish {telemetry["publish_us_per_step"]:.1f} '
+          f'us/step = {telemetry["publish_pct_of_step"]:.2f}% of a '
+          f'decode step', file=sys.stderr)
 
 
 def run_direct_subprocess(steps_arg) -> None:

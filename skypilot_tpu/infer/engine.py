@@ -28,6 +28,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import functools
+import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -37,6 +38,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import skypilot_tpu.models as models_lib
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import tracing as tracing_lib
 from skypilot_tpu.parallel import sharding as sharding_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -405,6 +408,130 @@ class _PendingPrefill:
     shared_len: int = 0       # prefix positions already in the pool
 
 
+class _ServingMetrics:
+    """Get-or-create handles for every serving metric.
+
+    All engines in a process share the same series (the registry
+    get-or-creates by name), so constructing several engines — the
+    test-suite norm — is cheap and safe.  Metric names follow the
+    repo-wide contract ``skytpu_<subsystem>_<what>_<unit-suffix>``
+    (guarded by a tier-1 test).  Every update is host-side bookkeeping
+    already in hand: nothing here reads a device array.
+    """
+
+    def __init__(self, registry: metrics_lib.Registry):
+        r = registry
+        # Request lifecycle counters.
+        self.submitted = r.counter(
+            'skytpu_requests_submitted_total',
+            'Requests accepted by submit()/generate().')
+        self.finished = r.counter(
+            'skytpu_requests_finished_total',
+            'Requests that completed normally (EOS or budget).')
+        self.cancelled = r.counter(
+            'skytpu_requests_cancelled_total',
+            'Requests cancelled before occupying a decode slot (or '
+            'racing completion).')
+        self.evicted = r.counter(
+            'skytpu_requests_evicted_total',
+            'Requests evicted from a decode slot or mid-prefill after '
+            'cancel().')
+        self.aborted = r.counter(
+            'skytpu_requests_aborted_total',
+            'In-flight requests dropped by a fatal decode abort().')
+        self.backpressure = r.counter(
+            'skytpu_admission_backpressure_total',
+            'Admission attempts deferred because the page pool could '
+            'not cover the request (retried next tick).')
+        # Token counters.
+        self.prompt_tokens = r.counter(
+            'skytpu_prompt_tokens_total',
+            'Prompt tokens admitted for prefill.')
+        self.output_tokens = r.counter(
+            'skytpu_output_tokens_total',
+            'Tokens sampled by decode steps.')
+        # Per-step scheduler state.
+        self.steps = r.counter(
+            'skytpu_decode_steps_total', 'Decode scheduler steps run.')
+        self.slot_steps = r.counter(
+            'skytpu_decode_slot_steps_total',
+            'Sum over decode steps of occupied slots (mean batch '
+            'occupancy = slot_steps / (steps * n_slots)).')
+        self.live_slots = r.gauge(
+            'skytpu_decode_live_slots',
+            'Occupied decode slots at the last step.')
+        self.occupancy = r.gauge(
+            'skytpu_decode_batch_occupancy_ratio',
+            'Occupied / total decode slots at the last step.')
+        self.queue_depth = r.gauge(
+            'skytpu_decode_queue_depth',
+            'Requests waiting in the admission queue (backpressure '
+            'signal).')
+        self.inflight = r.gauge(
+            'skytpu_requests_in_flight',
+            'Requests queued, prefilling, or decoding right now.')
+        self.read_bytes = r.histogram(
+            'skytpu_decode_cache_read_bytes',
+            'Estimated HBM bytes one decode step reads from the KV '
+            'cache (host-side estimate; see decode_cache_read_bytes).',
+            buckets=metrics_lib.DEFAULT_BYTE_BUCKETS)
+        # Paged-pool counters/gauges.
+        self.free_pages = r.gauge(
+            'skytpu_kv_free_pages',
+            'KV pages allocatable right now (fresh + reclaimable); 0 '
+            'on contiguous-cache engines.')
+        self.cannibalized = r.counter(
+            'skytpu_kv_pages_cannibalized_total',
+            'Reclaimable prefix pages cannibalised by the allocator '
+            '(their cached prefix became unmatchable).')
+        self.prefix_hits = r.counter(
+            'skytpu_prefix_cache_page_hits_total',
+            'Prompt pages served from the shared prefix cache (no '
+            're-prefill).')
+        self.prefix_misses = r.counter(
+            'skytpu_prefix_cache_page_misses_total',
+            'Prompt pages that had to be freshly allocated/prefilled.')
+        # Per-request latency histograms (derived from RequestTrace).
+        self.queue_seconds = r.histogram(
+            'skytpu_request_queue_seconds',
+            'Submit -> admission wait per finished request.')
+        self.ttft_seconds = r.histogram(
+            'skytpu_request_ttft_seconds',
+            'Submit -> first sampled token per finished request.')
+        self.tpot_seconds = r.histogram(
+            'skytpu_request_tpot_seconds',
+            'Mean seconds per output token after the first, per '
+            'finished request.')
+
+    def observe_finished(self, trace: Optional[tracing_lib.RequestTrace]
+                         ) -> None:
+        """Record the latency histograms a finished trace derives."""
+        if trace is None:
+            return
+        qs = trace.queue_seconds()
+        if qs is not None:
+            self.queue_seconds.observe(qs)
+        ttft = trace.ttft_seconds()
+        if ttft is not None:
+            self.ttft_seconds.observe(ttft)
+        tpot = trace.tpot_seconds()
+        if tpot is not None:
+            self.tpot_seconds.observe(tpot)
+
+
+def _trace_store_from_env() -> tracing_lib.TraceStore:
+    """Engine trace ring, env-tunable: SKYTPU_TRACE_RING caps the
+    completed-trace ring, SKYTPU_TRACE_JSONL mirrors transitions to a
+    JSONL event sink."""
+    try:
+        capacity = int(os.environ.get('SKYTPU_TRACE_RING', '') or 256)
+    except ValueError:
+        capacity = 256
+    return tracing_lib.TraceStore(
+        capacity=capacity,
+        jsonl_path=os.environ.get('SKYTPU_TRACE_JSONL') or None)
+
+
 class ContinuousBatchingEngine:
     """Slot-based continuous batching over the KV-cache model.
 
@@ -450,7 +577,8 @@ class ContinuousBatchingEngine:
                  kv_cache_dtype: str = 'auto',
                  page_size: int = 0,
                  max_pages: int = 0,
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 registry: Optional[metrics_lib.Registry] = None) -> None:
         import collections
         import threading
 
@@ -463,7 +591,7 @@ class ContinuousBatchingEngine:
             param_dtype=param_dtype, prefill_bucket=prefill_bucket,
             quantize=quantize, kv_cache_dtype=kv_cache_dtype,
             page_size=page_size, max_pages=max_pages,
-            seed=seed)
+            seed=seed, registry=registry)
         self.model = self._eng.model
         self.config = self._eng.config
         self.quantize = self._eng.quantize
@@ -729,6 +857,26 @@ class ContinuousBatchingEngine:
         # push a sentinel so readers never block forever.
         self._stream_queues: Dict[int, Any] = {}
 
+        # -- telemetry (host-side only; see _publish_step_metrics) ----
+        self.registry = (registry if registry is not None
+                         else metrics_lib.get_registry())
+        self._met = _ServingMetrics(self.registry)
+        self.traces = _trace_store_from_env()
+        self._cannibalized_seen = 0
+        # Precomputed read-traffic constants so the per-step estimate
+        # is O(live slots) arithmetic, not a cache-pytree walk:
+        # paged — bytes one PAGE contributes across all K/V leaves;
+        # contiguous — bytes ONE read position contributes across all
+        # B rows and leaves (a decode step reads `bucket` positions).
+        if self.page_size:
+            self._read_bytes_per_page = self._eng.cache_read_bytes_per_step(
+                row_contexts=[1])['grouped_bytes']
+            self._read_bytes_per_pos = 0.0
+        else:
+            self._read_bytes_per_page = 0.0
+            self._read_bytes_per_pos = self._eng.cache_read_bytes_per_step(
+                context=1)['grouped_bytes']
+
     def cache_read_bytes_per_step(self, context: Optional[int] = None,
                                   row_contexts: Optional[Sequence[int]]
                                   = None) -> Dict[str, float]:
@@ -789,6 +937,13 @@ class ContinuousBatchingEngine:
             if stream:
                 self._stream_queues[rid] = queue_mod.Queue()
             self._queue.append((rid, list(prompt_ids), cfg))
+            depth = len(self._queue)
+            # Trace begins inside the lock so the decode thread can
+            # never admit this rid before its trace exists.
+            self.traces.begin(rid, prompt_tokens=len(prompt_ids))
+        self._met.submitted.inc()
+        self._met.queue_depth.set(depth)
+        self._met.inflight.set(self.traces.inflight_count)
         return rid
 
     def cancel(self, request_id: int) -> None:
@@ -796,20 +951,31 @@ class ContinuousBatchingEngine:
         unread) and release its bookkeeping — abandoned requests must
         not leak results/events in a long-running replica."""
         with self._submit_lock:
+            before = len(self._queue)
             self._queue = type(self._queue)(
                 item for item in self._queue if item[0] != request_id)
+            removed_queued = len(self._queue) != before
+            depth = len(self._queue)
             self._results.pop(request_id, None)
             self._events.pop(request_id, None)
             q = self._stream_queues.pop(request_id, None)
             if q is not None:
                 q.put(self._STREAM_END)  # unblock a live reader
-            if request_id == self._admitting_rid or any(
-                    p.rid == request_id for p in self._prefills) or any(
-                    s is not None and s.request_id == request_id
-                    for s in self._slots):
+            in_engine = request_id == self._admitting_rid or any(
+                p.rid == request_id for p in self._prefills) or any(
+                s is not None and s.request_id == request_id
+                for s in self._slots)
+            if in_engine:
                 # In a slot — or popped from the queue and mid-prefill
                 # (the admission window): step() evicts it next tick.
                 self._canceled.add(request_id)
+        if removed_queued and not in_engine:
+            # Never reached a slot: terminal here.  Slot-resident
+            # cancels trace-finish as 'evicted' at the next tick.
+            if self.traces.finish(request_id, 'cancelled') is not None:
+                self._met.cancelled.inc()
+            self._met.inflight.set(self.traces.inflight_count)
+        self._met.queue_depth.set(depth)
 
     def wait(self, request_id: int,
              timeout: Optional[float] = None) -> List[int]:
@@ -841,6 +1007,10 @@ class ContinuousBatchingEngine:
             e.set()
         for q in queues:
             q.put(self._STREAM_END)  # stream() re-checks _fatal
+        dropped = self.traces.abort_all()
+        if dropped:
+            self._met.aborted.inc(len(dropped))
+        self._met.inflight.set(self.traces.inflight_count)
 
     def stream(self, request_id: int, timeout: Optional[float] = None):
         """Yield `request_id`'s tokens as they decode (submit() must
@@ -916,7 +1086,10 @@ class ContinuousBatchingEngine:
             if fresh is None:
                 for page in shared:
                     self._alloc.release(page)
+                self._met.backpressure.inc()
                 return False
+            self._met.prefix_hits.inc(len(shared))
+            self._met.prefix_misses.inc(len(fresh))
             pages = list(shared) + fresh
             shared_len = len(shared) * ps
             table_row = np.zeros((self._pages_per_slot,), np.int32)
@@ -936,6 +1109,9 @@ class ContinuousBatchingEngine:
             pad=pad, tokens=tokens, mask_row=mask_row,
             cache1=cache1, done=shared_len, pages=pages,
             table_row=table_row, shared_len=shared_len)
+        self.traces.event(rid, 'admitted',
+                          shared_prefix_tokens=shared_len)
+        self._met.prompt_tokens.inc(true_len)
         if self.prefill_chunk > 0:
             # Reserve the slot; one chunk runs per tick from
             # _step_inner so live slots keep decoding in between.
@@ -981,6 +1157,7 @@ class ContinuousBatchingEngine:
         if start <= last_idx < start + size:
             pending.last_row = logits[0, last_idx - start]
         pending.done = start + size
+        self.traces.event(pending.rid, 'prefill_chunk')
         if pending.done >= pending.true_len:
             # The rest of the padded length is masked-off zeros that
             # decode never reads (it writes at pad_len + generated):
@@ -1017,6 +1194,7 @@ class ContinuousBatchingEngine:
             eos_id=cfg.eos_id, temperature=cfg.temperature,
             top_k=cfg.top_k, top_p=cfg.top_p, seed=seed,
             pages=pending.pages)
+        self.traces.event(pending.rid, 'prefill_done')
 
     def _release_slot_pages(self, pages: List[int],
                             slot_idx: Optional[int] = None) -> None:
@@ -1037,7 +1215,8 @@ class ContinuousBatchingEngine:
         assert slot is not None
         self._release_slot_pages(slot.pages, slot_idx)
         with self._submit_lock:
-            if slot.request_id in self._canceled:
+            was_canceled = slot.request_id in self._canceled
+            if was_canceled:
                 self._canceled.discard(slot.request_id)
                 event = None
             else:
@@ -1049,6 +1228,16 @@ class ContinuousBatchingEngine:
         if event is not None:
             event.set()
         self._slots[slot_idx] = None
+        trace = self.traces.finish(
+            slot.request_id,
+            'cancelled' if was_canceled else 'finished',
+            output_tokens=len(slot.outputs))
+        if was_canceled:
+            self._met.cancelled.inc()
+        else:
+            self._met.finished.inc()
+            self._met.observe_finished(trace)
+        self._met.inflight.set(self.traces.inflight_count)
 
     def step(self) -> bool:
         """One scheduler tick: admit pending prompts into free slots,
@@ -1062,10 +1251,14 @@ class ContinuousBatchingEngine:
     def _evict_canceled(self) -> None:
         with self._submit_lock:
             snapshot = set(self._canceled)
+        evicted = 0
         for i, s in enumerate(self._slots):
             if s is not None and s.request_id in snapshot:
                 self._release_slot_pages(s.pages, i)
                 self._slots[i] = None
+                if self.traces.finish(s.request_id, 'evicted',
+                                      output_tokens=len(s.outputs)):
+                    evicted += 1
         keep: List[_PendingPrefill] = []
         for p in self._prefills:
             if p.rid in snapshot:
@@ -1073,9 +1266,14 @@ class ContinuousBatchingEngine:
                 # written (that happens at _finish_prefill), so only
                 # the host-side pages need returning.
                 self._release_slot_pages(p.pages)
+                if self.traces.finish(p.rid, 'evicted'):
+                    evicted += 1
             else:
                 keep.append(p)
         self._prefills = keep
+        if evicted:
+            self._met.evicted.inc(evicted)
+            self._met.inflight.set(self.traces.inflight_count)
         # Entries with no slot are stale (e.g. admission raised after a
         # mid-prefill cancel) — drop them too, the set must not grow.
         with self._submit_lock:
@@ -1120,8 +1318,15 @@ class ContinuousBatchingEngine:
             with self._submit_lock:
                 if item[0] in self._canceled:
                     self._canceled.discard(item[0])
+                    dropped_rid = item[0]
                 else:
                     self._queue.appendleft(item)
+                    dropped_rid = None
+            if dropped_rid is not None:
+                # Canceled mid-backpressure: never reached a slot.
+                if self.traces.finish(dropped_rid, 'cancelled'):
+                    self._met.cancelled.inc()
+                self._met.inflight.set(self.traces.inflight_count)
             break
 
         # One prefill chunk per tick for EVERY pending prompt
@@ -1142,6 +1347,11 @@ class ContinuousBatchingEngine:
         occupied = [i for i, s in enumerate(self._slots)
                     if s is not None]
         if not occupied:
+            # Keep the scheduler gauges honest while idle/prefilling.
+            self._met.live_slots.set(0)
+            self._met.occupancy.set(0.0)
+            self._met.queue_depth.set(len(self._queue))
+            self._met.inflight.set(self.traces.inflight_count)
             return bool(self._prefills) or bool(self._queue)
 
         b = self.n_slots
@@ -1192,6 +1402,16 @@ class ContinuousBatchingEngine:
                     max_k=max_k, use_top_p=use_top_p,
                     top_p_in_topk=top_p_in_topk, kv_bucket=bucket)
         toks = np.asarray(jax.device_get(tok_dev))
+        # Read-traffic estimate for THIS step, from the cursors already
+        # on the host (no device reads): paged decode gathers each live
+        # row's allocated pages; contiguous decode streams `bucket`
+        # positions of every row.
+        if self.page_size:
+            ps = self.page_size
+            read_bytes = self._read_bytes_per_page * sum(
+                -(-(int(cursors[i]) + 1) // ps) for i in occupied)
+        else:
+            read_bytes = self._read_bytes_per_pos * bucket
         # One dict ref for the whole step: dict.get is GIL-atomic, and
         # per-slot lock acquisitions in the decode hot loop would
         # contend with submit()/cancel() from the HTTP threads.
@@ -1201,13 +1421,38 @@ class ContinuousBatchingEngine:
             tok = int(toks[i])
             s.outputs.append(tok)
             s.generated += 1
+            if s.generated == 1:
+                self.traces.event(s.request_id, 'first_token')
             q = stream_queues.get(s.request_id)
             if q is not None:
                 q.put(tok)
             if (s.eos_id is not None and tok == s.eos_id) or \
                     s.generated >= s.max_new:
                 self._complete(i)
+        self._publish_step_metrics(len(occupied), read_bytes)
         return True
+
+    def _publish_step_metrics(self, n_occupied: int,
+                              read_bytes: float) -> None:
+        """Per-step telemetry: gauges + counters from host-side state
+        already in hand.  This is the entire per-step telemetry cost —
+        the overhead guard test times it directly against a measured
+        decode step, so keep it allocation-free."""
+        m = self._met
+        m.steps.inc()
+        m.slot_steps.inc(n_occupied)
+        m.output_tokens.inc(n_occupied)
+        m.live_slots.set(n_occupied)
+        m.occupancy.set(n_occupied / self.n_slots)
+        m.queue_depth.set(len(self._queue))
+        m.inflight.set(self.traces.inflight_count)
+        m.read_bytes.observe(read_bytes)
+        if self._alloc is not None:
+            m.free_pages.set(self._alloc.free_pages)
+            cann = self._alloc.cannibalized_total
+            if cann > self._cannibalized_seen:
+                m.cannibalized.inc(cann - self._cannibalized_seen)
+                self._cannibalized_seen = cann
 
     def run_until_idle(self) -> None:
         while self.step():
@@ -1244,7 +1489,8 @@ class InferenceEngine:
                  kv_cache_dtype: str = 'auto',
                  page_size: int = 0,
                  max_pages: int = 0,
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 registry: Optional[metrics_lib.Registry] = None) -> None:
         if quantize not in (None, 'int8'):
             raise ValueError(f"quantize must be None or 'int8', got "
                              f'{quantize!r}.')
@@ -1455,6 +1701,22 @@ class InferenceEngine:
         self._rng = jax.random.PRNGKey(seed + 1)
         self._generation = 0
 
+        # Telemetry.  Metric updates are host-side bookkeeping only;
+        # nothing below ever forces a device value.
+        self.registry = (registry if registry is not None
+                         else metrics_lib.get_registry())
+        self._met = _ServingMetrics(self.registry)
+        self.traces = _trace_store_from_env()
+        # Contiguous decode streams every cache position of the row;
+        # precompute bytes-per-position once so the per-step estimate
+        # is a single multiply.  (Paged serving goes through
+        # ContinuousBatchingEngine, which owns its own constants.)
+        if self.page_size:
+            self._read_bytes_per_pos = 0.0
+        else:
+            self._read_bytes_per_pos = self.cache_read_bytes_per_step(
+                context=1)['grouped_bytes']
+
     # -- weights -----------------------------------------------------------
     def _place(self, params, shardings):
         cast = jax.tree.map(
@@ -1632,6 +1894,16 @@ class InferenceEngine:
 
         cache = self._fresh_cache()
         self._generation += 1
+        met = self._met
+        rids = [f'gen{self._generation}-{i}' for i in range(n)]
+        for i, rid in enumerate(rids):
+            self.traces.begin(rid, prompt_tokens=int(lengths[i]))
+            # Whole-batch generate admits and prefills immediately.
+            self.traces.event(rid, 'admitted')
+        met.submitted.inc(n)
+        met.prompt_tokens.inc(int(lengths.sum()))
+        met.inflight.set(self.traces.inflight_count)
+        step_read_bytes = self._read_bytes_per_pos * self.max_seq_len
         if cfg.seed is not None:
             rng = jax.random.PRNGKey(int(cfg.seed) & 0x7FFFFFFF)
         else:
@@ -1644,6 +1916,9 @@ class InferenceEngine:
                 kv_mask)
             last = logits[jnp.arange(b),
                           jnp.maximum(lengths_dev - 1, 0)]
+            for rid in rids:
+                self.traces.event(rid, 'prefill_chunk')
+                self.traces.event(rid, 'prefill_done')
 
             outputs: List[List[int]] = [[] for _ in range(n)]
             done = np.zeros((b,), bool)
@@ -1655,12 +1930,30 @@ class InferenceEngine:
                     jnp.asarray(~done), temperature=cfg.temperature,
                     top_k=cfg.top_k, top_p=cfg.top_p)
                 next_tok = np.asarray(jax.device_get(tok_dev))
+                live = 0
                 for i in range(n):
                     if not done[i]:
+                        live += 1
                         outputs[i].append(int(next_tok[i]))
+                        if len(outputs[i]) == 1:
+                            self.traces.event(rids[i], 'first_token')
                         if cfg.eos_id is not None and \
                                 int(next_tok[i]) == cfg.eos_id:
                             done[i] = True
+                met.steps.inc()
+                met.slot_steps.inc(live)
+                met.output_tokens.inc(live)
+                met.live_slots.set(live)
+                met.occupancy.set(live / self.max_batch)
+                met.read_bytes.observe(step_read_bytes)
                 if done.all():
                     break
+        for i, rid in enumerate(rids):
+            trace = self.traces.finish(rid, 'finished',
+                                       output_tokens=len(outputs[i]))
+            met.finished.inc()
+            met.observe_finished(trace)
+        met.live_slots.set(0)
+        met.occupancy.set(0.0)
+        met.inflight.set(self.traces.inflight_count)
         return outputs

@@ -54,6 +54,10 @@ class PageAllocator:
         # ref==0 registered pages, insertion order == LRU order.
         self._reclaimable: 'collections.OrderedDict[int, int]' = \
             collections.OrderedDict()
+        # Lifetime count of reclaimable pages cannibalised by alloc().
+        # Plain int the engine's telemetry publisher diffs per step —
+        # this module stays dependency-free (no metrics import).
+        self.cannibalized_total = 0
 
     # -- capacity ---------------------------------------------------
 
@@ -89,6 +93,7 @@ class PageAllocator:
                 h, page = self._reclaimable.popitem(last=False)
                 del self._prefix_page[h]
                 del self._page_hash[page]
+                self.cannibalized_total += 1
             self._ref[page] = 1
             out.append(page)
         return out

@@ -10,8 +10,18 @@ http.server, matching the rest of the serve stack (serve/controller.py):
        body: {"prompt_ids": [[...], ...], "max_new_tokens": N,
               "temperature": T, "top_k": K, "top_p": P, "eos_id": E}
   GET  /v1/models           -> OpenAI model list
+  GET  /metrics             -> Prometheus text exposition (v0.0.4) of
+                               the process metric registry
+  GET  /traces              -> recent request lifecycle traces (JSON;
+                               ?limit=N caps the count)
   POST /v1/completions      -> OpenAI completions (stream + non-stream)
   POST /v1/chat/completions -> OpenAI chat (stream + non-stream)
+
+Every request gets an id (the client's X-Request-Id when it is a sane
+token, else a generated one), echoed in the X-Request-Id response
+header, attached to the engine-side request trace, stamped on access
+logs, and included in mid-stream SSE error events so a client can
+correlate a broken stream with server logs.
 
 The /v1 surface is the OpenAI-compatible API every reference LLM
 recipe serves through vLLM (`llm/qwen/qwen25-7b.yaml:30-33`):
@@ -36,11 +46,16 @@ import argparse
 import http.server
 import json
 import os
+import re
 import threading
+import time
+import urllib.parse
+import uuid
 from typing import Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.observability import metrics as metrics_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -48,6 +63,30 @@ logger = sky_logging.init_logger(__name__)
 from skypilot_tpu.utils import http_utils
 
 _HTTPServer = http_utils.HighBacklogHTTPServer
+
+# Known routes by method.  Unknown paths collapse to the 'other' route
+# label so a URL-scanning client cannot mint unbounded label sets.
+_GET_ROUTES = ('/health', '/v1/models', '/metrics', '/traces')
+_POST_ROUTES = ('/generate', '/v1/completions', '/v1/chat/completions')
+
+_REQUEST_ID_RE = re.compile(r'[A-Za-z0-9._:-]{1,64}$')
+
+
+def _http_metrics(registry: Optional[metrics_lib.Registry] = None):
+    """Get-or-create the HTTP front-end series (shared by every server
+    in the process; also exercised by the metric name-contract test)."""
+    r = registry if registry is not None else metrics_lib.get_registry()
+    return {
+        'requests': r.counter(
+            'skytpu_http_requests_total',
+            'HTTP requests served, by method/route/status code.',
+            labelnames=('method', 'route', 'code')),
+        'latency': r.histogram(
+            'skytpu_http_request_seconds',
+            'Wall-clock seconds per HTTP request (includes queueing '
+            'and generation on blocking routes).',
+            labelnames=('method', 'route')),
+    }
 
 
 class InferenceServer:
@@ -68,7 +107,9 @@ class InferenceServer:
                  compilation_cache_dir=None,
                  tokenizer: Optional[str] = None,
                  allow_random_weights: bool = False,
-                 served_model_name: Optional[str] = None) -> None:
+                 served_model_name: Optional[str] = None,
+                 registry: Optional[metrics_lib.Registry] = None
+                 ) -> None:
         from skypilot_tpu.parallel import mesh as mesh_lib
         # Hang-proof first backend touch: a wedged tunneled TPU makes
         # this raise (replica exits, probe marks it FAILED) instead of
@@ -99,7 +140,8 @@ class InferenceServer:
                 prefill_chunk=prefill_chunk,
                 kv_read_bucket=kv_read_bucket,
                 quantize=quantize, kv_cache_dtype=kv_cache_dtype,
-                page_size=page_size, max_pages=max_pages)
+                page_size=page_size, max_pages=max_pages,
+                registry=registry)
         else:
             if page_size:
                 raise ValueError(
@@ -111,7 +153,9 @@ class InferenceServer:
                 max_batch_size=max_batch_size,
                 max_seq_len=max_seq_len,
                 model_overrides=model_overrides, quantize=quantize,
-                kv_cache_dtype=kv_cache_dtype)
+                kv_cache_dtype=kv_cache_dtype, registry=registry)
+        self.registry = self.engine.registry
+        self._http_met = _http_metrics(self.registry)
         if not self.engine.loaded_real_weights and \
                 not allow_random_weights:
             raise ValueError(
@@ -165,7 +209,8 @@ class InferenceServer:
         assert self._server is not None
         return self._server.server_address[1]
 
-    def _handle_generate(self, payload: dict) -> dict:
+    def _handle_generate(self, payload: dict,
+                         http_request_id: Optional[str] = None) -> dict:
         prompts = payload.get('prompt_ids')
         if not isinstance(prompts, list) or not prompts:
             raise ValueError('prompt_ids must be a non-empty list of '
@@ -184,7 +229,10 @@ class InferenceServer:
             rids = []
             try:
                 for p in prompts:
-                    rids.append(self.engine.submit(p, sampling))
+                    rid = self.engine.submit(p, sampling)
+                    rids.append(rid)
+                    self.engine.traces.annotate(
+                        rid, http_request_id=http_request_id)
                 self._work.set()
                 tokens = [self.engine.wait(r, timeout=600)
                           for r in rids]
@@ -204,11 +252,14 @@ class InferenceServer:
             top_p=req.top_p, eos_id=self.tokenizer.eos_id,
             max_new_tokens=req.max_tokens, seed=req.seed)
 
-    def _openai_blocking(self, req, prompt_ids) -> dict:
+    def _openai_blocking(self, req, prompt_ids,
+                         http_request_id: Optional[str] = None) -> dict:
         from skypilot_tpu.infer import openai_api
         sampling = self._sampling_for(req)
         if self.continuous:
             rid = self.engine.submit(prompt_ids, sampling)
+            self.engine.traces.annotate(
+                rid, http_request_id=http_request_id)
             self._work.set()
             toks = self.engine.wait(rid, timeout=600)
         else:
@@ -231,7 +282,9 @@ class InferenceServer:
         from skypilot_tpu.infer import openai_api
         from skypilot_tpu.infer import tokenizer as tokenizer_lib
         sampling = self._sampling_for(req)
+        http_rid = getattr(handler, 'request_id', None)
         rid = self.engine.submit(prompt_ids, sampling, stream=True)
+        self.engine.traces.annotate(rid, http_request_id=http_rid)
         self._work.set()
 
         def _sse(obj) -> None:
@@ -242,11 +295,13 @@ class InferenceServer:
         def _sse_error(message: str) -> None:
             """Mid-stream failure with a live client: an error event
             + [DONE] is the only legal framing (a second HTTP status
-            line would be protocol garbage)."""
+            line would be protocol garbage).  Carries the request id so
+            the client can quote it back at the server logs/traces."""
             try:
                 _sse({'error': {
                     'message': message, 'type': 'server_error',
-                    'param': None, 'code': None}})
+                    'param': None, 'code': None,
+                    'request_id': http_rid}})
                 handler.wfile.write(b'data: [DONE]\n\n')
                 handler.wfile.flush()
             except OSError:
@@ -337,7 +392,8 @@ class InferenceServer:
                     '(server started with --no-continuous)')
             self._openai_stream(req, prompt_ids, handler)
             return None
-        return self._openai_blocking(req, prompt_ids)
+        return self._openai_blocking(
+            req, prompt_ids, getattr(handler, 'request_id', None))
 
     def serve_forever(self) -> None:
         self.start()
@@ -350,57 +406,128 @@ class InferenceServer:
 
         class Handler(http.server.BaseHTTPRequestHandler):
 
-            def log_message(self, *args):  # quiet
-                del args
+            request_id = '-'
+            _last_code = 0
 
-            def _reply(self, code: int, body: dict) -> None:
+            def log_message(self, format, *args):  # noqa: A002
+                # Access logs on the framework logger at DEBUG (JSON
+                # when SKYTPU_LOG_JSON=1), stamped with the request id
+                # — BaseHTTPRequestHandler would write raw stderr.
+                logger.debug(f'{self.address_string()} '
+                             f'[{self.request_id}] {format % args}')
+
+            def send_response(self, code, message=None):
+                super().send_response(code, message)
+                self.send_header('X-Request-Id', self.request_id)
+                self._last_code = code
+
+            def _reply(self, code: int, body: dict,
+                       allow: Optional[str] = None) -> None:
                 data = json.dumps(body).encode()
                 self.send_response(code)
                 self.send_header('Content-Type', 'application/json')
                 self.send_header('Content-Length', str(len(data)))
+                if allow is not None:
+                    self.send_header('Allow', allow)
                 self.end_headers()
                 self.wfile.write(data)
 
             def do_GET(self):  # noqa: N802
-                if self.path == '/health':
+                self._dispatch('GET')
+
+            def do_POST(self):  # noqa: N802
+                self._dispatch('POST')
+
+            def _dispatch(self, method: str) -> None:
+                incoming = self.headers.get('X-Request-Id', '')
+                self.request_id = (
+                    incoming if _REQUEST_ID_RE.match(incoming)
+                    else 'req-' + uuid.uuid4().hex[:16])
+                self._last_code = 0
+                route = self.path.split('?', 1)[0]
+                known = route in _GET_ROUTES or route in _POST_ROUTES
+                label = route if known else 'other'
+                met = outer._http_met  # pylint: disable=protected-access
+                start = time.perf_counter()
+                try:
+                    if method == 'GET':
+                        self._do_get(route)
+                    else:
+                        self._do_post(route)
+                finally:
+                    met['latency'].labels(
+                        method=method, route=label).observe(
+                            time.perf_counter() - start)
+                    met['requests'].labels(
+                        method=method, route=label,
+                        code=str(self._last_code or 0)).inc()
+
+            def _do_get(self, route: str) -> None:
+                if route == '/health':
                     if outer._fatal is not None:  # pylint: disable=protected-access
                         self._reply(503, {
                             'status': 'unhealthy',
                             'error': repr(outer._fatal)})  # pylint: disable=protected-access
                     else:
                         self._reply(200, {'status': 'ok'})
-                elif self.path == '/v1/models':
+                elif route == '/v1/models':
                     self._reply(200, {
                         'object': 'list',
                         'data': [{'id': outer.model_name,
                                   'object': 'model',
                                   'created': 0,
                                   'owned_by': 'skypilot-tpu'}]})
+                elif route == '/metrics':
+                    data = outer.registry.expose().encode()
+                    self.send_response(200)
+                    self.send_header('Content-Type',
+                                     metrics_lib.CONTENT_TYPE_LATEST)
+                    self.send_header('Content-Length', str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                elif route == '/traces':
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    try:
+                        limit = int(query.get('limit', ['100'])[0])
+                    except ValueError:
+                        limit = 100
+                    store = outer.engine.traces
+                    self._reply(200, {
+                        'traces': store.recent(limit),
+                        'in_flight': store.inflight_count})
+                elif route in _POST_ROUTES:
+                    self._reply(405, {'error': 'method not allowed'},
+                                allow='POST')
                 else:
                     self._reply(404, {'error': 'not found'})
 
-            def do_POST(self):  # noqa: N802
+            def _do_post(self, route: str) -> None:
                 from skypilot_tpu.infer import openai_api
-                routes = {'/generate', '/v1/completions',
-                          '/v1/chat/completions'}
-                if self.path not in routes:
-                    self._reply(404, {'error': 'not found'})
+                if route not in _POST_ROUTES:
+                    if route in _GET_ROUTES:
+                        self._reply(405,
+                                    {'error': 'method not allowed'},
+                                    allow='GET')
+                    else:
+                        self._reply(404, {'error': 'not found'})
                     return
                 try:
                     length = int(self.headers.get('Content-Length', 0))
                     payload = json.loads(self.rfile.read(length) or b'{}')
-                    if self.path == '/generate':
-                        self._reply(200, outer._handle_generate(payload))  # pylint: disable=protected-access
+                    if route == '/generate':
+                        self._reply(200, outer._handle_generate(  # pylint: disable=protected-access
+                            payload, self.request_id))
                         return
                     body = outer._handle_openai(  # pylint: disable=protected-access
-                        payload, chat=self.path.endswith(
+                        payload, chat=route.endswith(
                             '/chat/completions'), handler=self)
                     if body is not None:
                         self._reply(200, body)
                 except openai_api.OpenAIError as e:
                     self._reply(e.status, e.body())
                 except ValueError as e:
-                    if self.path == '/generate':
+                    if route == '/generate':
                         self._reply(400, {'error': str(e)})
                     else:
                         self._reply(

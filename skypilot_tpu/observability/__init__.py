@@ -1,0 +1,27 @@
+"""Observability: dependency-free metrics + per-request traces.
+
+`metrics` holds the thread-safe Counter/Gauge/Histogram primitives, the
+process-global `Registry`, and Prometheus text exposition; `tracing`
+holds `RequestTrace`/`TraceStore` for per-request lifecycle timelines.
+Both are pure stdlib so they can be imported from any layer (engine,
+server, trainer, bench) without dragging in JAX.
+"""
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import tracing
+from skypilot_tpu.observability.metrics import (CONTENT_TYPE_LATEST, Counter,
+                                                Gauge, Histogram, Registry,
+                                                get_registry)
+from skypilot_tpu.observability.tracing import RequestTrace, TraceStore
+
+__all__ = [
+    'CONTENT_TYPE_LATEST',
+    'Counter',
+    'Gauge',
+    'Histogram',
+    'Registry',
+    'RequestTrace',
+    'TraceStore',
+    'get_registry',
+    'metrics',
+    'tracing',
+]

@@ -1,0 +1,400 @@
+"""Dependency-free metrics core: Counter / Gauge / Histogram + Registry.
+
+Design goals, in order:
+
+1. **Zero third-party deps.**  The serving container must not grow a
+   `prometheus_client` requirement; exposition is ~100 lines of text
+   formatting (Prometheus text format v0.0.4).
+2. **Cheap on the hot path.**  The continuous-batching decode loop
+   publishes ~10 samples per step.  Every update is a dict lookup plus
+   a float add under a per-metric lock — no string formatting, no
+   allocation beyond the first `labels()` call for a given label set.
+   A disabled registry short-circuits updates to a single attribute
+   read so the overhead-guard bench can diff enabled vs. disabled.
+3. **Get-or-create registration.**  Tests (and the engine) construct
+   many engines per process against the process-global registry;
+   re-registering an identical metric returns the existing object,
+   while a type conflict raises.
+
+Naming contract (enforced by a tier-1 guard test): every metric this
+codebase registers matches
+
+    ^skytpu_[a-z0-9_]+(_total|_bytes|_seconds|_ratio|_count)?$
+
+i.e. snake_case with conventional unit suffixes.  The registry itself
+only enforces Prometheus-legal names so the module stays generic.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_METRIC_NAME_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
+_LABEL_NAME_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*$')
+
+# Per-metric cap on distinct label sets.  Beyond it, new label sets
+# collapse into a single overflow child so a buggy caller (e.g. a
+# request id used as a label) cannot grow memory without bound.
+DEFAULT_MAX_LABEL_SETS = 64
+_OVERFLOW_LABEL_VALUE = '_overflow'
+
+# Latency buckets (seconds): 1ms .. 10min, roughly 2.5x steps.
+DEFAULT_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                           0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+                           300.0, 600.0)
+# Byte-size buckets: 4 KiB .. 64 GiB, powers of 4.
+DEFAULT_BYTE_BUCKETS = tuple(float(4**i * 1024) for i in range(13))
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus-style float rendering: integers without exponents."""
+    if v == math.inf:
+        return '+Inf'
+    if v == -math.inf:
+        return '-Inf'
+    if v != v:  # NaN
+        return 'NaN'
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_help(text: str) -> str:
+    return text.replace('\\', r'\\').replace('\n', r'\n')
+
+
+def _escape_label_value(text: str) -> str:
+    return text.replace('\\', r'\\').replace('\n', r'\n').replace('"', r'\"')
+
+
+def _render_labels(labelnames: Sequence[str], labelvalues: Sequence[str],
+                   extra: Optional[Tuple[str, str]] = None) -> str:
+    pairs = [(n, v) for n, v in zip(labelnames, labelvalues)]
+    if extra is not None:
+        pairs.append(extra)
+    if not pairs:
+        return ''
+    inner = ','.join(
+        f'{n}="{_escape_label_value(str(v))}"' for n, v in pairs)
+    return '{' + inner + '}'
+
+
+class Metric:
+    """Base: a named family holding one child per label set."""
+
+    TYPE = 'untyped'
+
+    def __init__(self, registry: 'Registry', name: str, help_text: str,
+                 labelnames: Sequence[str] = ()):
+        if not _METRIC_NAME_RE.match(name):
+            raise ValueError(f'Invalid metric name: {name!r}')
+        for ln in labelnames:
+            if not _LABEL_NAME_RE.match(ln) or ln.startswith('__'):
+                raise ValueError(f'Invalid label name: {ln!r}')
+        if 'le' in labelnames and self.TYPE == 'histogram':
+            raise ValueError("Histogram label 'le' is reserved")
+        self._registry = registry
+        self.name = name
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], 'Metric'] = {}
+        self._overflow_logged = False
+        # An unlabeled metric is its own (single) child.
+        if not self.labelnames:
+            self._init_child()
+
+    # -- child state (overridden per type) -----------------------------
+    def _init_child(self) -> None:
+        raise NotImplementedError
+
+    def _check_enabled(self) -> bool:
+        return self._registry.enabled
+
+    def _new_child(self) -> 'Metric':
+        """Allocate an empty child sharing this family's identity/lock."""
+        child = self.__class__.__new__(self.__class__)
+        child._registry = self._registry
+        child.name = self.name
+        child.help = self.help
+        child.labelnames = ()
+        child._lock = self._lock
+        child._children = {}
+        child._overflow_logged = False
+        self._copy_config(child)
+        child._init_child()
+        return child
+
+    def _copy_config(self, child: 'Metric') -> None:
+        """Copy type-specific config (e.g. buckets) onto a new child."""
+
+    def labels(self, **labelvalues: str) -> 'Metric':
+        """Return (creating if needed) the child for this label set."""
+        if not self.labelnames:
+            raise ValueError(f'{self.name} has no labels')
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f'{self.name} expects labels {self.labelnames}, '
+                f'got {tuple(sorted(labelvalues))}')
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                if len(self._children) >= self._registry.max_label_sets:
+                    key = (_OVERFLOW_LABEL_VALUE,) * len(self.labelnames)
+                    child = self._children.get(key)
+                    if not self._overflow_logged:
+                        self._overflow_logged = True
+                        logger.warning(
+                            f'Metric {self.name} exceeded '
+                            f'{self._registry.max_label_sets} label sets; '
+                            'collapsing new series into '
+                            f'{_OVERFLOW_LABEL_VALUE!r}')
+                    if child is not None:
+                        return child
+                child = self._new_child()
+                self._children[key] = child
+        return child
+
+    def _iter_children(self) -> Iterable[Tuple[Tuple[str, ...], 'Metric']]:
+        # Snapshot under the lock, yield outside it: _render() needs to
+        # re-acquire the (non-reentrant) family lock to read values.
+        with self._lock:
+            if not self.labelnames:
+                items = [((), self)]
+            else:
+                items = [(k, self._children[k])
+                         for k in sorted(self._children)]
+        return items
+
+    def collect(self) -> List[str]:
+        lines = [
+            f'# HELP {self.name} {_escape_help(self.help)}',
+            f'# TYPE {self.name} {self.TYPE}',
+        ]
+        for key, child in self._iter_children():
+            lines.extend(child._render(self.labelnames, key))
+        return lines
+
+    def _render(self, labelnames: Sequence[str],
+                labelvalues: Sequence[str]) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonically increasing float."""
+
+    TYPE = 'counter'
+
+    def _init_child(self) -> None:
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError('Counter can only increase')
+        if not self._check_enabled():
+            return
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def value_for(self, **labelvalues: str) -> float:
+        return self.labels(**labelvalues).value
+
+    def _render(self, labelnames, labelvalues) -> List[str]:
+        return [f'{self.name}{_render_labels(labelnames, labelvalues)} '
+                f'{_fmt_value(self.value)}']
+
+
+class Gauge(Metric):
+    """Instantaneous value; can go up and down."""
+
+    TYPE = 'gauge'
+
+    def _init_child(self) -> None:
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        if not self._check_enabled():
+            return
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not self._check_enabled():
+            return
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def value_for(self, **labelvalues: str) -> float:
+        return self.labels(**labelvalues).value
+
+    def _render(self, labelnames, labelvalues) -> List[str]:
+        return [f'{self.name}{_render_labels(labelnames, labelvalues)} '
+                f'{_fmt_value(self.value)}']
+
+
+class Histogram(Metric):
+    """Cumulative-bucket histogram (Prometheus semantics: le = <=)."""
+
+    TYPE = 'histogram'
+
+    def __init__(self, registry, name, help_text, labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        b = sorted(float(x) for x in buckets)
+        if not b:
+            raise ValueError('Histogram needs at least one bucket')
+        if b[-1] != math.inf:
+            b.append(math.inf)
+        self._buckets = tuple(b)
+        super().__init__(registry, name, help_text, labelnames)
+
+    def _init_child(self) -> None:
+        self._bucket_counts = [0] * len(self._buckets)
+        self._sum = 0.0
+        self._count = 0
+
+    def _copy_config(self, child: 'Metric') -> None:
+        child._buckets = self._buckets  # type: ignore[attr-defined]
+
+    def observe(self, value: float) -> None:
+        if not self._check_enabled():
+            return
+        v = float(value)
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            # First bucket whose bound >= v; all later buckets are
+            # cumulative at render time so only one slot is bumped.
+            for i, bound in enumerate(self._buckets):
+                if v <= bound:
+                    self._bucket_counts[i] += 1
+                    break
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def _render(self, labelnames, labelvalues) -> List[str]:
+        with self._lock:
+            counts = list(self._bucket_counts)
+            total = self._count
+            s = self._sum
+        lines = []
+        cum = 0
+        for bound, c in zip(self._buckets, counts):
+            cum += c
+            le = _render_labels(labelnames, labelvalues,
+                                extra=('le', _fmt_value(bound)))
+            lines.append(f'{self.name}_bucket{le} {cum}')
+        plain = _render_labels(labelnames, labelvalues)
+        lines.append(f'{self.name}_sum{plain} {_fmt_value(s)}')
+        lines.append(f'{self.name}_count{plain} {total}')
+        return lines
+
+
+class Registry:
+    """A set of named metrics; renders Prometheus text format v0.0.4.
+
+    One process-global instance (`get_registry()`) backs the engine,
+    server, trainer and bench.  Tests and the overhead bench may build
+    private registries; `enabled=False` turns every update into a
+    near-free no-op while keeping the metric objects usable.
+    """
+
+    def __init__(self, enabled: bool = True,
+                 max_label_sets: int = DEFAULT_MAX_LABEL_SETS):
+        self.enabled = enabled
+        self.max_label_sets = max_label_sets
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Metric] = {}
+
+    def set_enabled(self, enabled: bool) -> None:
+        self.enabled = bool(enabled)
+
+    def _get_or_create(self, cls, name, help_text, labelnames, **kwargs):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls:
+                    raise ValueError(
+                        f'Metric {name} already registered as '
+                        f'{type(existing).__name__}, not {cls.__name__}')
+                if tuple(labelnames) != existing.labelnames:
+                    raise ValueError(
+                        f'Metric {name} already registered with labels '
+                        f'{existing.labelnames}, not {tuple(labelnames)}')
+                return existing
+            metric = cls(self, name, help_text, labelnames, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help_text: str = '',
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help_text, labelnames)
+
+    def gauge(self, name: str, help_text: str = '',
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, labelnames)
+
+    def histogram(self, name: str, help_text: str = '',
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS
+                  ) -> Histogram:
+        return self._get_or_create(Histogram, name, help_text, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def expose(self) -> str:
+        """Render every metric in Prometheus text format v0.0.4."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.collect())
+        return '\n'.join(lines) + '\n' if lines else ''
+
+
+CONTENT_TYPE_LATEST = 'text/plain; version=0.0.4; charset=utf-8'
+
+_GLOBAL_REGISTRY = Registry()
+
+
+def get_registry() -> Registry:
+    """The process-global registry shared by engine/server/train/bench."""
+    return _GLOBAL_REGISTRY

@@ -1,0 +1,228 @@
+"""Per-request lifecycle traces for the serving engines.
+
+A `RequestTrace` records the host-side timeline of one request:
+
+    queued -> admitted -> prefill chunk(s) -> first token -> decode
+           -> finished | cancelled | evicted | aborted
+
+and derives the latencies that matter for serving SLOs: queue wait,
+TTFT (time to first token, measured from submit), and TPOT (mean
+per-output-token latency over the decode phase).
+
+The `TraceStore` keeps in-flight traces in a dict keyed by request id
+plus a bounded ring of completed traces (newest last), and can mirror
+every transition to a JSONL event sink for offline ingestion
+(`SKYTPU_TRACE_JSONL=<path>`, read by the engines).  All methods are
+thread-safe and O(1); nothing here touches JAX or device memory.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# Terminal states a trace can land in.
+TERMINAL_STATES = ('finished', 'cancelled', 'evicted', 'aborted')
+
+
+@dataclasses.dataclass
+class RequestTrace:
+    """Host-side timeline of one request (all timestamps time.time())."""
+    request_id: int
+    queued_ts: float
+    prompt_tokens: int = 0
+    http_request_id: Optional[str] = None
+    state: str = 'queued'
+    admitted_ts: Optional[float] = None
+    prefill_chunks: int = 0
+    prefill_done_ts: Optional[float] = None
+    first_token_ts: Optional[float] = None
+    finished_ts: Optional[float] = None
+    output_tokens: int = 0
+    shared_prefix_tokens: int = 0
+
+    # -- derived latencies --------------------------------------------
+    def queue_seconds(self) -> Optional[float]:
+        if self.admitted_ts is None:
+            return None
+        return self.admitted_ts - self.queued_ts
+
+    def ttft_seconds(self) -> Optional[float]:
+        if self.first_token_ts is None:
+            return None
+        return self.first_token_ts - self.queued_ts
+
+    def tpot_seconds(self) -> Optional[float]:
+        """Mean seconds per output token after the first."""
+        if (self.first_token_ts is None or self.finished_ts is None or
+                self.output_tokens < 2):
+            return None
+        return ((self.finished_ts - self.first_token_ts) /
+                (self.output_tokens - 1))
+
+    def total_seconds(self) -> Optional[float]:
+        if self.finished_ts is None:
+            return None
+        return self.finished_ts - self.queued_ts
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d['queue_seconds'] = self.queue_seconds()
+        d['ttft_seconds'] = self.ttft_seconds()
+        d['tpot_seconds'] = self.tpot_seconds()
+        d['total_seconds'] = self.total_seconds()
+        return d
+
+
+class TraceStore:
+    """In-flight traces + a bounded ring of completed ones."""
+
+    def __init__(self, capacity: int = 256,
+                 jsonl_path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._inflight: Dict[int, RequestTrace] = {}
+        self._completed: 'collections.deque[RequestTrace]' = (
+            collections.deque(maxlen=max(1, capacity)))
+        self._jsonl_path = jsonl_path
+        self._jsonl_file = None
+        self._jsonl_failed = False
+
+    # -- lifecycle -----------------------------------------------------
+    def begin(self, request_id: int, prompt_tokens: int = 0,
+              http_request_id: Optional[str] = None) -> RequestTrace:
+        now = time.time()
+        trace = RequestTrace(request_id=request_id, queued_ts=now,
+                             prompt_tokens=prompt_tokens,
+                             http_request_id=http_request_id)
+        with self._lock:
+            self._inflight[request_id] = trace
+        self._emit_event(now, request_id, 'queued',
+                         prompt_tokens=prompt_tokens)
+        return trace
+
+    def annotate(self, request_id: int, **fields: Any) -> None:
+        """Attach metadata (e.g. the HTTP request id) to a live trace."""
+        with self._lock:
+            trace = self._inflight.get(request_id)
+            if trace is None:
+                return
+            for k, v in fields.items():
+                if hasattr(trace, k):
+                    setattr(trace, k, v)
+
+    def event(self, request_id: int, name: str, **fields: Any) -> None:
+        """Stamp a lifecycle event on a live trace.
+
+        Known events: 'admitted', 'prefill_chunk', 'prefill_done',
+        'first_token'.  Unknown request ids are ignored (the request
+        may have been evicted between the caller's check and now).
+        """
+        now = time.time()
+        with self._lock:
+            trace = self._inflight.get(request_id)
+            if trace is None:
+                return
+            if name == 'admitted':
+                trace.admitted_ts = now
+                trace.state = 'prefilling'
+                trace.shared_prefix_tokens = fields.get(
+                    'shared_prefix_tokens', 0)
+            elif name == 'prefill_chunk':
+                trace.prefill_chunks += 1
+            elif name == 'prefill_done':
+                trace.prefill_done_ts = now
+                trace.state = 'decoding'
+            elif name == 'first_token':
+                trace.first_token_ts = now
+        # prefill_chunk is per-chunk noise; keep the sink to transitions.
+        if name != 'prefill_chunk':
+            self._emit_event(now, request_id, name, **fields)
+
+    def finish(self, request_id: int, state: str,
+               output_tokens: Optional[int] = None
+               ) -> Optional[RequestTrace]:
+        """Move a trace to a terminal state; idempotent per request."""
+        assert state in TERMINAL_STATES, state
+        now = time.time()
+        with self._lock:
+            trace = self._inflight.pop(request_id, None)
+            if trace is None:
+                return None
+            trace.finished_ts = now
+            trace.state = state
+            if output_tokens is not None:
+                trace.output_tokens = output_tokens
+            self._completed.append(trace)
+        self._emit_event(now, request_id, state,
+                         output_tokens=trace.output_tokens)
+        return trace
+
+    def abort_all(self, state: str = 'aborted') -> List[RequestTrace]:
+        """Terminate every in-flight trace (engine fatal / shutdown)."""
+        now = time.time()
+        with self._lock:
+            traces = list(self._inflight.values())
+            self._inflight.clear()
+            for t in traces:
+                t.finished_ts = now
+                t.state = state
+                self._completed.append(t)
+        for t in traces:
+            self._emit_event(now, t.request_id, state,
+                             output_tokens=t.output_tokens)
+        return traces
+
+    # -- introspection -------------------------------------------------
+    @property
+    def inflight_count(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def get(self, request_id: int) -> Optional[RequestTrace]:
+        with self._lock:
+            trace = self._inflight.get(request_id)
+            if trace is not None:
+                return trace
+            for t in self._completed:
+                if t.request_id == request_id:
+                    return t
+        return None
+
+    def recent(self, limit: int = 100) -> List[Dict[str, Any]]:
+        """Newest-first trace dicts: in-flight first, then completed."""
+        with self._lock:
+            live = sorted(self._inflight.values(),
+                          key=lambda t: t.queued_ts, reverse=True)
+            done = list(self._completed)[::-1]
+        out = [t.to_dict() for t in live + done]
+        return out[:max(0, limit)]
+
+    # -- JSONL sink ----------------------------------------------------
+    def _emit_event(self, ts: float, request_id: int, event: str,
+                    **fields: Any) -> None:
+        if self._jsonl_path is None or self._jsonl_failed:
+            return
+        rec = {'ts': ts, 'rid': request_id, 'event': event}
+        rec.update(fields)
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            try:
+                if self._jsonl_file is None:
+                    self._jsonl_file = open(self._jsonl_path, 'a',
+                                            buffering=1)
+                self._jsonl_file.write(line + '\n')
+            except OSError:
+                # Telemetry must never take the engine down.
+                self._jsonl_failed = True
+
+    def close(self) -> None:
+        with self._lock:
+            if self._jsonl_file is not None:
+                try:
+                    self._jsonl_file.close()
+                except OSError:
+                    pass
+                self._jsonl_file = None

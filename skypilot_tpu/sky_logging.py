@@ -4,10 +4,16 @@ Env-tunable logger analogous to the reference's sky/sky_logging.py:1-179:
 a single stream handler with an optional rich-style prefix, module-level
 `init_logger`, and context managers to silence output in nested calls
 (used when controllers invoke the SDK recursively).
+
+Set ``SKYTPU_LOG_JSON=1`` to emit one JSON object per line
+(``{"ts", "level", "logger", "msg"}``) on the same handler, so framework
+logs can be machine-ingested alongside the bench JSON line and the
+trace JSONL sink.
 """
 from __future__ import annotations
 
 import contextlib
+import json
 import logging
 import os
 import sys
@@ -21,6 +27,28 @@ _default_handler = None
 _lock = threading.Lock()
 
 
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line: ts (unix seconds), level, logger, msg."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            'ts': round(record.created, 6),
+            'level': record.levelname,
+            'logger': record.name,
+            'msg': record.getMessage(),
+        }
+        if record.exc_info and record.exc_info[0] is not None:
+            payload['exc'] = self.formatException(record.exc_info)
+        return json.dumps(payload, default=str)
+
+
+def make_formatter() -> logging.Formatter:
+    """The formatter the shared handler should use (env-dependent)."""
+    if os.environ.get('SKYTPU_LOG_JSON') == '1':
+        return JsonFormatter()
+    return logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT)
+
+
 def _setup() -> None:
     global _default_handler
     with _lock:
@@ -31,8 +59,7 @@ def _setup() -> None:
         level = os.environ.get('SKYTPU_DEBUG')
         _default_handler.setLevel(
             logging.DEBUG if level == '1' else logging.INFO)
-        _default_handler.setFormatter(
-            logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
+        _default_handler.setFormatter(make_formatter())
         _root_logger.addHandler(_default_handler)
         _root_logger.setLevel(logging.DEBUG)
         _root_logger.propagate = False

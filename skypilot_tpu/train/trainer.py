@@ -270,6 +270,29 @@ def train_step(state: TrainState, batch: Dict[str, jax.Array],
                          opt_state=new_opt_state), metrics
 
 
+def _train_metrics(registry=None):
+    """Register (get-or-create) the trainer's telemetry instruments.
+
+    Shared with the serving registry so one `/metrics` scrape covers a
+    colocated trainer; import is local-ish (observability is stdlib-only)
+    and the per-window update cost is a handful of dict ops.
+    """
+    from skypilot_tpu.observability import metrics as metrics_lib
+    reg = registry if registry is not None else metrics_lib.get_registry()
+    return {
+        'step_seconds': reg.histogram(
+            'skytpu_train_step_seconds',
+            'Mean wall time per train step, observed once per log window.'),
+        'tokens_per_sec': reg.gauge(
+            'skytpu_train_tokens_per_sec',
+            'Training throughput over the last log window.'),
+        'steps': reg.counter('skytpu_train_steps_total',
+                             'Optimizer steps completed.'),
+        'tokens': reg.counter('skytpu_train_tokens_total',
+                              'Tokens consumed by training.'),
+    }
+
+
 class Trainer:
     """Owns mesh, sharded state, and the jit'd step."""
 
@@ -570,8 +593,14 @@ class Trainer:
         # would corrupt the harness's sec/step medians.
         bench_logger = (callbacks.BenchmarkLogger.maybe_from_env()
                         if jax.process_index() == 0 else None)
+        # Telemetry rides the same once-per-window cadence as the step
+        # log, so it adds no per-step host work (process 0 only — same
+        # rationale as bench_logger above).
+        telemetry = (_train_metrics()
+                     if jax.process_index() == 0 else None)
         t0 = time.time()
         window_tokens = 0
+        window_start_step = 0
         last: Dict[str, float] = {}
         try:
             for i in range(steps):
@@ -601,8 +630,17 @@ class Trainer:
                     logger.info(
                         f'step {last["step"]} loss {last["loss"]:.4f} '
                         f'acc {last["accuracy"]:.3f} {tps:,.0f} tok/s')
+                    if telemetry is not None:
+                        window_steps = (i + 1) - window_start_step
+                        if window_steps > 0 and dt > 0:
+                            telemetry['step_seconds'].observe(
+                                dt / window_steps)
+                        telemetry['tokens_per_sec'].set(tps)
+                        telemetry['steps'].inc(window_steps)
+                        telemetry['tokens'].inc(window_tokens)
                     t0 = time.time()
                     window_tokens = 0
+                    window_start_step = i + 1
                 if checkpoint_manager is not None and checkpoint_every and \
                         (i + 1) % checkpoint_every == 0:
                     from skypilot_tpu.train import checkpoint as ckpt_lib

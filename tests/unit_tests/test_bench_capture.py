@@ -554,15 +554,26 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     assert parsed['paged_read_reduction_vs_contiguous'] == \
         round(4 * 512 / 200, 2)  # 10.24
     assert parsed['paged_token_parity'] is True
-    # Four engines, all serving the SAME weights.
+    # Five engines (incl. the disabled-registry overhead arm), all
+    # serving the SAME weights.
     assert [b.kv_cache_dtype for b in built] == \
-        ['auto', 'int8', 'auto', 'auto']
-    assert [b.page_size for b in built] == [0, 0, 0, 8]
+        ['auto', 'int8', 'auto', 'auto', 'auto']
+    assert [b.page_size for b in built] == [0, 0, 0, 8, 8]
     assert all(b.params is built[0].params for b in built[1:])
+    # Telemetry snapshot rides the line; the fakes never touch the
+    # registry, so the counters are zero but the keys must exist.
+    tel = parsed['telemetry']
+    for key in ('prefix_page_hits', 'prefix_page_misses',
+                'prefix_hit_ratio', 'mean_batch_occupancy',
+                'pages_cannibalized', 'publish_us_per_step',
+                'publish_pct_of_step',
+                'tokens_per_sec_paged_disabled_registry'):
+        assert key in tel, key
     err = [l for l in captured.err.splitlines() if l.startswith('#')]
-    assert len(err) == 4  # one per dtype arm + ratio + paged line
-    assert 'fewer bytes/step' in err[-2]
-    assert 'token parity: True' in err[-1]
+    assert len(err) == 5  # dtype arms + ratio + paged + telemetry
+    assert 'fewer bytes/step' in err[-3]
+    assert 'token parity: True' in err[-2]
+    assert 'telemetry' in err[-1]
 
 
 def test_decode_smoke_paged_arm_flag(bench, monkeypatch, capsys):
@@ -601,6 +612,14 @@ def test_decode_smoke_paged_arm_end_to_end():
     assert arm['token_parity_vs_contiguous'] is True
     assert arm['cache_read_bytes_per_step_paged'] * 4 <= \
         arm['cache_read_bytes_per_step_contiguous']
+    # Telemetry overhead contract: the per-step metric publish must be
+    # a rounding error next to a real decode step (< 2%), and the real
+    # engines must report a live telemetry snapshot.
+    tel = parsed['telemetry']
+    assert tel['publish_pct_of_step'] < 2.0, tel
+    assert tel['mean_batch_occupancy'] > 0.0
+    assert tel['prefix_page_misses'] > 0  # fresh prompts miss
+    assert tel['tokens_per_sec_paged_disabled_registry'] > 0
 
 
 def test_sleep_skip_when_spacing_would_burn_the_window(
