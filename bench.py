@@ -668,6 +668,7 @@ def run_direct_subprocess(steps_arg) -> None:
             f'--direct subprocess failed (rc={proc.returncode}, '
             f'metric={"present" if metric else "missing"})',
             proc.stdout[-1000:])
+    # skylint: disable=stdout-purity (re-emits the JSON metric line)
     print(metric)
 
 
@@ -789,6 +790,26 @@ def _finish_through_launch(sky, cluster, job_id, handle, step_log,
               overrides, metrics['seq_len']))
 
 
+def _require_stdout_purity() -> None:
+    """Refuse to run when skylint's stdout-purity rule has unsuppressed
+    findings: the smoke capture contract is "exactly one JSON line on
+    stdout", and a stray print anywhere in the import graph corrupts
+    it.  Pure-AST check (no jax import), so it costs ~a second."""
+    from skypilot_tpu.devtools import skylint
+    root = os.path.dirname(os.path.abspath(__file__))
+    findings = skylint.unsuppressed(skylint.lint_paths(
+        [os.path.join(root, 'skypilot_tpu'),
+         os.path.join(root, 'bench.py')],
+        rule_ids=['stdout-purity']))
+    if findings:
+        for f in findings:
+            print(f'# skylint: {f.render()}', file=sys.stderr)
+        print('# bench --smoke refused: stdout-purity findings would '
+              'corrupt the JSON-line capture contract; fix or '
+              'suppress them first', file=sys.stderr, flush=True)
+        sys.exit(2)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--quick', action='store_true',
@@ -806,6 +827,8 @@ def main() -> None:
                              'bench (incl. paged parity) fits in a '
                              'CPU-only tier-1 test.')
     args = parser.parse_args()
+    if args.smoke:
+        _require_stdout_purity()
     if args.decode:
         run_decode(args.steps, smoke=args.smoke)
         return

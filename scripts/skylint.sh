@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Convenience wrapper: lint the shipped tree (package + bench.py) with
+# the committed baseline, forwarding any extra flags, e.g.
+#   scripts/skylint.sh
+#   scripts/skylint.sh --format json
+#   scripts/skylint.sh --rule stdout-purity
+#   scripts/skylint.sh some/file.py
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# Default to the shipped tree unless the caller named a real path.
+has_path=0
+for a in "$@"; do
+    [[ -e "${a}" ]] && has_path=1
+done
+if [[ ${has_path} -eq 1 ]]; then
+    exec python -m skypilot_tpu.devtools.skylint "$@"
+fi
+exec python -m skypilot_tpu.devtools.skylint "$@" skypilot_tpu bench.py

@@ -64,6 +64,7 @@ def run_with_log(cmd: List[str] | str,
             log_file.write(line)
             log_file.flush()
             if stream_logs:
+                # skylint: disable=stdout-purity (streams job logs)
                 sys.stdout.write(prefix + line)
                 sys.stdout.flush()
         proc.wait()

@@ -81,6 +81,7 @@ class CommandRunner:
             with open(_expand(log_path), 'a', encoding='utf-8') as f:
                 f.write(text)
         if stream_logs and text:
+            # skylint: disable=stdout-purity (relaying remote output)
             print(text, end='')
         if require_outputs:
             return proc.returncode, proc.stdout, proc.stderr

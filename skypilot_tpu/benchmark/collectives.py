@@ -146,9 +146,11 @@ def main() -> None:
     if args.json:
         print(json.dumps([r.to_dict() for r in results]))
         return
+    # skylint: disable=stdout-purity (human table; --json above)
     print(f'{"op":<15} {"payload":>12} {"time":>10} {"algbw":>10} '
           f'{"busbw":>10}')
     for r in results:
+        # skylint: disable=stdout-purity
         print(f'{r.op:<15} {r.payload_bytes/1e6:>10.1f}MB '
               f'{r.seconds*1e3:>8.2f}ms {r.algbw_gbps:>8.2f}GB/s '
               f'{r.busbw_gbps:>8.2f}GB/s')

@@ -157,7 +157,8 @@ def run_stream_level(base_url: str, concurrency: int,
                     ttfts.append(facts['ttft'])
                 gaps.extend(facts['gaps'])
 
-    threads = [threading.Thread(target=_stream, args=(i,))
+    threads = [threading.Thread(target=_stream, args=(i,),
+                                daemon=True)
                for i in range(concurrency)]
     t0 = time.time()
     for t in threads:
@@ -221,7 +222,8 @@ def run_level(base_url: str, concurrency: int, requests_per_stream: int,
                 tokens[idx] += n
                 latencies.append(dt)
 
-    threads = [threading.Thread(target=_stream, args=(i,))
+    threads = [threading.Thread(target=_stream, args=(i,),
+                                daemon=True)
                for i in range(concurrency)]
     t0 = time.time()
     for t in threads:

@@ -1,0 +1,9 @@
+"""Developer tooling that ships with the repo (not imported at runtime).
+
+Currently: ``skylint``, the AST-based static-analysis pass that
+mechanizes the repo's correctness contracts (host-sync hazards, retrace
+hazards, lock discipline, stdout purity, the metric-name contract, and
+dtype promotion in model code).  Run it as::
+
+    python -m skypilot_tpu.devtools.skylint skypilot_tpu bench.py
+"""

@@ -1,0 +1,18 @@
+"""Rule registry for skylint.
+
+Each submodule exports one or more ``skylint.Rule`` instances via a
+module-level ``RULES`` tuple; ``ALL_RULES`` is their concatenation in
+a stable order.  Adding a rule family == adding a module here.
+"""
+from skypilot_tpu.devtools.rules import dtype_promotion
+from skypilot_tpu.devtools.rules import host_sync
+from skypilot_tpu.devtools.rules import lock_discipline
+from skypilot_tpu.devtools.rules import metric_contract
+from skypilot_tpu.devtools.rules import retrace
+from skypilot_tpu.devtools.rules import stdout_purity
+
+ALL_RULES = (host_sync.RULES + retrace.RULES + lock_discipline.RULES
+             + stdout_purity.RULES + metric_contract.RULES
+             + dtype_promotion.RULES)
+
+__all__ = ['ALL_RULES']

@@ -1,0 +1,212 @@
+"""Shared traced-function index for the jit-aware rules.
+
+The repo's dominant idiom is a locally defined function handed to
+``jax.jit``/``pjit``/``jax.lax.scan`` at a call site (often inside
+``__init__``), not a decorator::
+
+    def _decode_step(p, cache, last, ...):
+        ...
+    self._decode = jax.jit(_decode_step,
+                           static_argnames=('max_k', 'kv_bucket'))
+
+so the index resolves both decorators and call-site references, and
+records the static argument names each jit site declares (including
+parameters pre-bound by a ``functools.partial`` wrapper, which are
+Python constants by construction).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+_JIT_NAMES = {'jit', 'pjit'}
+_TRACE_ONLY_NAMES = {'scan', 'checkpoint', 'remat', 'vmap', 'pmap',
+                     'grad', 'value_and_grad', 'while_loop', 'fori_loop',
+                     'cond', 'shard_map'}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.scan' for the matching Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def _last_part(dotted: Optional[str]) -> Optional[str]:
+    return dotted.rsplit('.', 1)[-1] if dotted else None
+
+
+@dataclasses.dataclass
+class TracedFunction:
+    node: ast.AST                      # FunctionDef / Lambda
+    name: str
+    via: str                           # 'jax.jit', 'jax.lax.scan', ...
+    jitted: bool                       # eligible for the retrace rule
+    static_names: Set[str] = dataclasses.field(default_factory=set)
+    static_nums: Set[int] = dataclasses.field(default_factory=set)
+    partial_bound: Set[str] = dataclasses.field(default_factory=set)
+    partial_positional: int = 0
+
+
+class JitIndex:
+    """All functions in a module that run under a jax trace."""
+
+    def __init__(self, tree: ast.Module):
+        self._defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs.setdefault(node.name, []).append(node)
+        self.traced: List[TracedFunction] = []
+        seen: Set[int] = set()
+
+        def add(fn_node: ast.AST, name: str, via: str, jitted: bool,
+                statics: Tuple[Set[str], Set[int]] = (set(), set()),
+                partial_bound: Optional[Set[str]] = None,
+                partial_positional: int = 0) -> None:
+            if id(fn_node) in seen:
+                # Same def marked from several sites (or same-named
+                # defs resolved by name): union the statics — a linter
+                # over-approximates rather than flag a declared-static
+                # param — and keep the jit entry if any site jits.
+                for tf in self.traced:
+                    if tf.node is fn_node:
+                        tf.static_names |= statics[0]
+                        tf.static_nums |= statics[1]
+                        if jitted and not tf.jitted:
+                            tf.jitted = True
+                            tf.via = via
+                return
+            seen.add(id(fn_node))
+            self.traced.append(TracedFunction(
+                node=fn_node, name=name, via=via, jitted=jitted,
+                static_names=set(statics[0]),
+                static_nums=set(statics[1]),
+                partial_bound=set(partial_bound or ()),
+                partial_positional=partial_positional))
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    info = self._classify(deco)
+                    if info is not None:
+                        via, jitted, statics = info
+                        add(node, node.name, via, jitted, statics)
+            elif isinstance(node, ast.Call):
+                info = self._classify(node)
+                if info is None:
+                    continue
+                via, jitted, statics = info
+                target = node.args[0] if node.args else None
+                self._mark_target(target, via, jitted, statics, add)
+
+    def _mark_target(self, target, via, jitted, statics, add) -> None:
+        if isinstance(target, ast.Name):
+            for fn_node in self._defs.get(target.id, ()):
+                add(fn_node, target.id, via, jitted, statics)
+        elif isinstance(target, ast.Lambda):
+            add(target, '<lambda>', via, jitted, statics)
+        elif isinstance(target, ast.Call):
+            # functools.partial(fn, *bound, **bound_kw) under jit: the
+            # bound parameters are static Python values.
+            if _last_part(_dotted(target.func)) == 'partial' \
+                    and target.args:
+                inner = target.args[0]
+                bound_kw = {kw.arg for kw in target.keywords
+                            if kw.arg is not None}
+                n_pos = len(target.args) - 1
+                if isinstance(inner, ast.Name):
+                    for fn_node in self._defs.get(inner.id, ()):
+                        add(fn_node, inner.id, via, jitted, statics,
+                            partial_bound=bound_kw,
+                            partial_positional=n_pos)
+
+    @staticmethod
+    def _classify(node: ast.AST):
+        """(via, jitted, (static_names, static_nums)) for a jit-ish
+        expression, else None.  Handles bare names, dotted paths, and
+        ``partial(jax.jit, static_argnames=...)`` decorators."""
+        if isinstance(node, ast.Call):
+            callee = _last_part(_dotted(node.func))
+            if callee == 'partial' and node.args:
+                inner = _last_part(_dotted(node.args[0]))
+                if inner in _JIT_NAMES:
+                    return (_dotted(node.args[0]) or inner, True,
+                            JitIndex._statics(node))
+                return None
+            if callee in _JIT_NAMES:
+                return (_dotted(node.func) or callee, True,
+                        JitIndex._statics(node))
+            if callee in _TRACE_ONLY_NAMES:
+                return (_dotted(node.func) or callee, False,
+                        (set(), set()))
+            return None
+        callee = _last_part(_dotted(node))
+        if callee in _JIT_NAMES:
+            return (_dotted(node) or callee, True, (set(), set()))
+        if callee in {'checkpoint', 'remat'}:
+            return (_dotted(node) or callee, False, (set(), set()))
+        return None
+
+    @staticmethod
+    def _statics(call: ast.Call) -> Tuple[Set[str], Set[int]]:
+        names: Set[str] = set()
+        nums: Set[int] = set()
+        for kw in call.keywords:
+            if kw.arg == 'static_argnames':
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str):
+                        names.add(sub.value)
+            elif kw.arg == 'static_argnums':
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, int) \
+                            and not isinstance(sub.value, bool):
+                        nums.add(sub.value)
+        return names, nums
+
+    def traced_bodies(self):
+        """Yield (TracedFunction, body_nodes), skipping entries nested
+        inside another traced function (the enclosing entry's walk
+        already covers them, so callers never see a node twice)."""
+        nodes = [tf.node for tf in self.traced]
+        for tf in self.traced:
+            if any(other is not tf.node and _contains(other, tf.node)
+                   for other in nodes):
+                continue
+            if isinstance(tf.node, ast.Lambda):
+                yield tf, [tf.node.body]
+            else:
+                yield tf, list(tf.node.body)
+
+
+def _contains(outer: ast.AST, inner: ast.AST) -> bool:
+    return any(child is inner for child in ast.walk(outer))
+
+
+def nontraced_static_params(tf: TracedFunction) -> Set[str]:
+    """Parameter names of a jitted function that are static (declared
+    via static_argnames/static_argnums or pre-bound by partial)."""
+    arg_nodes = tf.node.args
+    pos = [a.arg for a in arg_nodes.posonlyargs + arg_nodes.args]
+    kwonly = [a.arg for a in arg_nodes.kwonlyargs]
+    static = set(tf.static_names) | set(tf.partial_bound)
+    for num in tf.static_nums:
+        if 0 <= num < len(pos):
+            static.add(pos[num])
+    static.update(pos[:tf.partial_positional])
+    # 'self' is never traced.
+    static.add('self')
+    return static
+
+
+def param_names(tf: TracedFunction) -> List[str]:
+    args = tf.node.args
+    return ([a.arg for a in args.posonlyargs + args.args]
+            + [a.arg for a in args.kwonlyargs])

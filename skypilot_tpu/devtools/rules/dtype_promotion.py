@@ -1,0 +1,73 @@
+"""dtype-promotion: keep bf16 model arithmetic bf16.
+
+``jnp.array(1.0)`` (and friends) materializes float32; mixed into a
+bf16 activation it promotes the whole expression to f32, doubling HBM
+traffic and silently changing numerics between model families.  Bare
+Python literals are weakly typed and safe (``x * 2.0`` stays bf16) —
+the hazard is specifically a float literal *materialized* without an
+explicit dtype.  Scoped to ``models/``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from skypilot_tpu.devtools import skylint
+
+RULE_ID = 'dtype-promotion'
+
+_ARRAY_FNS = {'array', 'asarray', 'full', 'full_like'}
+_F32_CASTS = {'float32', 'float64'}
+
+
+def in_scope(posix: str) -> bool:
+    return 'models' in posix.split('/')
+
+
+def _has_float_literal(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) \
+                and isinstance(sub.value, float):
+            return True
+    return False
+
+
+def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        mod = func.value
+        is_np = isinstance(mod, ast.Name) and mod.id in (
+            'jnp', 'np', 'numpy', 'jax')
+        if not is_np:
+            continue
+        if func.attr in _ARRAY_FNS:
+            has_dtype = any(kw.arg == 'dtype' for kw in node.keywords)
+            if has_dtype:
+                continue
+            if any(_has_float_literal(arg) for arg in node.args):
+                findings.append(ctx.finding(
+                    RULE_ID, node, f'{mod.id}.{func.attr}',
+                    f'{mod.id}.{func.attr}(...) materializes a float '
+                    f'literal at float32 in model code; pass dtype= '
+                    f'(e.g. x.dtype) so bf16 arithmetic is not '
+                    f'promoted'))
+        elif func.attr in _F32_CASTS and node.args \
+                and any(_has_float_literal(arg) for arg in node.args):
+            findings.append(ctx.finding(
+                RULE_ID, node, f'{mod.id}.{func.attr}',
+                f'{mod.id}.{func.attr}(literal) creates an f32 scalar '
+                f'in model code; use the activation dtype instead'))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='no f32 float-literal materialization in models/ '
+            '(bf16 promotion hazard)',
+    check=check,
+    scope=in_scope),)

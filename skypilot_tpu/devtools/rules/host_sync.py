@@ -1,0 +1,85 @@
+"""host-sync: no host-device synchronization inside traced bodies.
+
+A ``float()``, ``.item()``, ``np.asarray`` or ``print`` inside a
+``jax.jit``/``pjit``/``lax.scan`` body blocks the host on the device
+stream (or burns a trace-time constant), and on a gang-scheduled pod
+slice one straggler host stalls every peer.  Scoped to the compute
+layers where jitted code lives: ``ops/``, ``models/``,
+``infer/engine.py``, ``train/trainer.py``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from skypilot_tpu.devtools import skylint
+from skypilot_tpu.devtools.rules import _jit
+
+RULE_ID = 'host-sync'
+
+_SYNC_ATTRS = {'item', 'tolist'}
+_TIME_FNS = {'time.time', 'time.perf_counter', 'time.monotonic'}
+_ASARRAY_FNS = {'np.asarray', 'numpy.asarray', 'np.array',
+                'numpy.array'}
+
+
+def in_scope(posix: str) -> bool:
+    parts = posix.split('/')
+    return ('ops' in parts or 'models' in parts
+            or posix.endswith('infer/engine.py')
+            or posix.endswith('train/trainer.py'))
+
+
+def _flag(node: ast.Call):
+    """(symbol, reason) when ``node`` syncs with the host, else None."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id == 'print':
+            return 'print', 'print() forces a host sync / trace-time ' \
+                            'side effect'
+        if func.id in ('float', 'int') and node.args and not all(
+                isinstance(a, ast.Constant) for a in node.args):
+            return (f'{func.id}()',
+                    f'{func.id}() on a traced value pulls it to host')
+        return None
+    if isinstance(func, ast.Attribute):
+        if func.attr in _SYNC_ATTRS:
+            return (f'.{func.attr}()',
+                    f'.{func.attr}() synchronously copies device '
+                    f'memory to host')
+        dotted = _jit._dotted(func)
+        if dotted in _TIME_FNS:
+            return (f'{dotted}()',
+                    f'{dotted}() is a trace-time constant inside jit; '
+                    f'it does not measure step time')
+        if dotted in _ASARRAY_FNS:
+            return (dotted,
+                    f'{dotted} materializes the traced value on host')
+    return None
+
+
+def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    index = _jit.JitIndex(ctx.tree)
+    findings: List[skylint.Finding] = []
+    for tf, body in index.traced_bodies():
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = _flag(node)
+                if hit is None:
+                    continue
+                symbol, reason = hit
+                findings.append(ctx.finding(
+                    RULE_ID, node, symbol,
+                    f'{symbol} inside traced function '
+                    f'{tf.name!r} (via {tf.via}): {reason}'))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='no host syncs (.item/float/print/time.time/np.asarray) '
+            'inside jit/scan bodies',
+    check=check,
+    scope=in_scope),)

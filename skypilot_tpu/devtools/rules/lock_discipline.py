@@ -1,0 +1,162 @@
+"""lock-discipline / thread-discipline: shared mutable state hygiene.
+
+The continuous-batching engine is two-threaded (HTTP handlers submit,
+one scheduler thread decodes); its convention is that any attribute
+ever written under ``with self.<...lock>:`` belongs to the locked
+shared set and must never be written outside one (``__init__`` runs
+before the object is shared and is exempt).  The rule derives the
+protected set from the lock sites themselves, so it tracks the code.
+Scoped to the serving files that own cross-thread state:
+``infer/engine.py``, ``infer/paging.py``, ``infer/server.py``.
+
+The companion thread-discipline rule (same family) flags
+``threading.Thread(...)`` constructions without an explicit
+``daemon=`` — an undeclared lifetime is how shutdown hangs and leaked
+non-daemon threads block interpreter exit.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, List
+
+from skypilot_tpu.devtools import skylint
+
+RULE_ID = 'lock-discipline'
+THREAD_RULE_ID = 'thread-discipline'
+
+_LOCK_FILES = ('infer/engine.py', 'infer/paging.py', 'infer/server.py')
+
+_MUTATORS = {'append', 'appendleft', 'extend', 'insert', 'add',
+             'update', 'setdefault', 'pop', 'popleft', 'popitem',
+             'remove', 'discard', 'clear', 'put'}
+
+_EXEMPT_METHODS = {'__init__', '__new__', '__del__'}
+
+
+def in_lock_scope(posix: str) -> bool:
+    return posix.endswith(_LOCK_FILES)
+
+
+def _self_attr(node: ast.AST):
+    """'X' when ``node`` is ``self.X`` (possibly behind a subscript)."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == 'self':
+        return node.attr
+    return None
+
+
+def _is_lock_ctx(item: ast.withitem) -> bool:
+    attr = _self_attr(item.context_expr)
+    return attr is not None and 'lock' in attr.lower()
+
+
+@dataclasses.dataclass
+class _Write:
+    attr: str
+    node: ast.AST
+    in_lock: bool
+    method: str
+
+
+def _collect_writes(cls: ast.ClassDef) -> List[_Write]:
+    writes: List[_Write] = []
+
+    def visit(node: ast.AST, in_lock: bool, method: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            method = node.name if method == '<class>' else method
+            for child in node.body:
+                visit(child, in_lock, method)
+            return
+        if isinstance(node, ast.With):
+            locked = in_lock or any(_is_lock_ctx(i) for i in node.items)
+            for child in node.body:
+                visit(child, locked, method)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for target in targets:
+                attr = _self_attr(target)
+                if attr:
+                    writes.append(_Write(attr, node, in_lock, method))
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                attr = _self_attr(target)
+                if attr:
+                    writes.append(_Write(attr, node, in_lock, method))
+        elif isinstance(node, ast.Expr) \
+                and isinstance(node.value, ast.Call):
+            func = node.value.func
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in _MUTATORS:
+                attr = _self_attr(func.value)
+                if attr:
+                    writes.append(_Write(attr, node, in_lock, method))
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_lock, method)
+
+    for stmt in cls.body:
+        visit(stmt, False, '<class>')
+    return writes
+
+
+def check_locks(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        writes = _collect_writes(node)
+        protected = {w.attr for w in writes if w.in_lock}
+        if not protected:
+            continue
+        for w in writes:
+            if w.in_lock or w.attr not in protected:
+                continue
+            if w.method in _EXEMPT_METHODS:
+                continue
+            findings.append(ctx.finding(
+                RULE_ID, w.node, f'{node.name}.{w.attr}',
+                f'{node.name}.{w.attr} is written under the lock '
+                f'elsewhere but mutated without it in '
+                f'{w.method}(); take the lock or move the attribute '
+                f'out of the locked set'))
+    return findings
+
+
+def check_threads(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else \
+            func.id if isinstance(func, ast.Name) else None
+        if name != 'Thread':
+            continue
+        kwargs = {kw.arg for kw in node.keywords}
+        if 'daemon' in kwargs or None in kwargs:   # None == **kwargs
+            continue
+        findings.append(ctx.finding(
+            THREAD_RULE_ID, node, 'threading.Thread',
+            'threading.Thread(...) without an explicit daemon= '
+            'flag: declare the thread\'s lifetime (daemon=True, or '
+            'daemon=False plus a stop event + join path)'))
+    return findings
+
+
+RULES = (
+    skylint.Rule(
+        id=RULE_ID,
+        summary='attrs written under a lock must never be written '
+                'outside it (engine/paging/server)',
+        check=check_locks,
+        scope=in_lock_scope),
+    skylint.Rule(
+        id=THREAD_RULE_ID,
+        summary='threading.Thread(...) must declare daemon=',
+        check=check_threads),
+)

@@ -1,0 +1,65 @@
+"""metric-contract: every registered metric comes from the contract.
+
+``skypilot_tpu.observability.METRIC_CONTRACT`` is the single source of
+truth for metric names (the exposition tests and dashboards key off
+it).  Any ``registry.counter/gauge/histogram('name', ...)`` call whose
+name is not in the contract — or does not match the ``skytpu_*``
+naming regex — is either a typo that silently breaks a scrape
+consumer or a new series that must be added to the contract export in
+``observability/__init__.py`` in the same PR.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from skypilot_tpu.devtools import skylint
+from skypilot_tpu.observability import METRIC_CONTRACT, METRIC_NAME_RE
+
+RULE_ID = 'metric-contract'
+
+_REGISTER_METHODS = {'counter', 'gauge', 'histogram'}
+
+
+def in_scope(posix: str) -> bool:
+    # The registry implementation defines these methods; everything
+    # else only calls them.
+    return not posix.endswith('observability/metrics.py')
+
+
+def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _REGISTER_METHODS):
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        name = first.value
+        if not METRIC_NAME_RE.fullmatch(name):
+            findings.append(ctx.finding(
+                RULE_ID, node, name,
+                f'metric name {name!r} does not match the naming '
+                f'contract {METRIC_NAME_RE.pattern!r}'))
+        elif name not in METRIC_CONTRACT:
+            findings.append(ctx.finding(
+                RULE_ID, node, name,
+                f'metric {name!r} is not in METRIC_CONTRACT '
+                f'(skypilot_tpu/observability/__init__.py); add it '
+                f'there so scrape consumers and tests see it'))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='registered metric names must match skytpu_* and appear '
+            'in METRIC_CONTRACT',
+    check=check,
+    scope=in_scope),)

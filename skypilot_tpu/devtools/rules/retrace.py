@@ -1,0 +1,126 @@
+"""retrace-hazard: traced params consumed as Python scalars.
+
+A jitted function that branches on a parameter (``if top_k > 0:``) or
+feeds it to a shape (``jnp.zeros((n,))``, ``range(n)``) either crashes
+with a tracer-bool error or — when callers pass plain ints — silently
+recompiles on every distinct value, which on TPU means a multi-second
+XLA compile stalling the whole slice.  Either way the parameter must
+be declared via ``static_argnames``/``static_argnums`` (the repo's
+decode/prefill jits all do this; the rule keeps it that way).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from skypilot_tpu.devtools import skylint
+from skypilot_tpu.devtools.rules import _jit
+
+RULE_ID = 'retrace-hazard'
+
+_SHAPE_FNS = {'zeros', 'ones', 'full', 'empty', 'arange', 'iota',
+              'broadcast_to', 'reshape', 'broadcasted_iota'}
+
+
+def _bare_names(node: ast.AST) -> Set[str]:
+    """Names used directly (not behind an attribute/subscript), i.e.
+    the parameter itself rather than ``param.shape`` or ``param[0]``."""
+    out: Set[str] = set()
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+            return
+        if isinstance(n, (ast.Attribute, ast.Subscript)):
+            return   # param.shape / param.ndim / param[i] are fine
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return out
+
+
+def _branch_hazards(test: ast.AST) -> Set[str]:
+    """Param-candidate names used as Python booleans in a branch test.
+    ``is``/``is not`` comparisons are identity checks on the tracer
+    object and resolve at trace time, so they are excluded, as are
+    names behind attribute/subscript access (``param.ndim == 4`` is a
+    static property) and call results."""
+    hazards: Set[str] = set()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+            return
+        if isinstance(node, ast.Name):
+            hazards.add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(test)
+    return hazards
+
+
+def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    index = _jit.JitIndex(ctx.tree)
+    findings: List[skylint.Finding] = []
+    for tf in index.traced:
+        if not tf.jitted or isinstance(tf.node, ast.Lambda):
+            continue
+        static = _jit.nontraced_static_params(tf)
+        traced_params = [p for p in _jit.param_names(tf)
+                         if p not in static]
+        if not traced_params:
+            continue
+        flagged: Set[str] = set()
+
+        def emit(param: str, node: ast.AST, where: str) -> None:
+            if param in flagged:
+                return
+            flagged.add(param)
+            findings.append(ctx.finding(
+                RULE_ID, node, f'{tf.name}.{param}',
+                f'parameter {param!r} of jitted {tf.name!r} is '
+                f'consumed as a Python scalar in {where}; declare it '
+                f'in static_argnames (or static_argnums) to avoid a '
+                f'retrace per value / tracer-bool error'))
+
+        for stmt in tf.node.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    for name in _branch_hazards(node.test):
+                        if name in traced_params:
+                            emit(name, node, 'a Python branch test')
+                elif isinstance(node, ast.Call):
+                    func = node.func
+                    callee = None
+                    if isinstance(func, ast.Name):
+                        callee = func.id
+                    elif isinstance(func, ast.Attribute):
+                        callee = func.attr
+                    if callee == 'range':
+                        for arg in node.args:
+                            for name in _bare_names(arg):
+                                if name in traced_params:
+                                    emit(name, node, 'range()')
+                    elif callee in _SHAPE_FNS and node.args:
+                        shape_args = [node.args[0]]
+                        if callee == 'reshape':
+                            shape_args = list(node.args)
+                        for arg in shape_args:
+                            for name in _bare_names(arg):
+                                if name in traced_params:
+                                    emit(name, node,
+                                         f'the shape argument of '
+                                         f'{callee}()')
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='jitted params used in shape/branch position must be '
+            'static_argnames/static_argnums',
+    check=check),)

@@ -1,0 +1,94 @@
+"""stdout-purity: stdout belongs to machine-readable output.
+
+The bench capture contract is "exactly one JSON line on stdout"; the
+agent/controller RPC protocols and ``SKYTPU_METRICS`` line make the
+same assumption.  A stray ``print`` anywhere in the import graph
+corrupts those streams, so outside the user-facing CLI every write to
+stdout must be a deliberate machine-readable emit.
+
+Allowed without suppression:
+* anything in ``cli.py`` (stdout is its interface) or under
+  ``devtools/`` (skylint's own CLI);
+* ``print(..., file=...)`` to a stream other than ``sys.stdout``;
+* prints whose payload expression contains a ``json.dumps(...)`` call
+  — the machine-readable emit idiom used by bench, the RPC framers,
+  and the benchmark drivers.
+
+Everything else (bare ``print``, ``sys.stdout.write``) is flagged and
+needs an inline ``# skylint: disable=stdout-purity`` (for deliberate
+human-facing tools) or a baseline entry.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from skypilot_tpu.devtools import skylint
+
+RULE_ID = 'stdout-purity'
+
+
+def in_scope(posix: str) -> bool:
+    if posix.endswith('cli.py'):
+        return False
+    return '/devtools/' not in posix \
+        and not posix.startswith('devtools/')
+
+
+def _is_sys_stdout(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == 'stdout'
+            and isinstance(node.value, ast.Name)
+            and node.value.id == 'sys')
+
+
+def _contains_json_dumps(nodes: Iterable[ast.AST]) -> bool:
+    for root in nodes:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == 'dumps' \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == 'json':
+                return True
+    return False
+
+
+def _print_target(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == 'file':
+            return kw.value
+    return None
+
+
+def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == 'print':
+            target = _print_target(node)
+            if target is not None and not _is_sys_stdout(target):
+                continue   # explicitly routed elsewhere (stderr, file)
+            if _contains_json_dumps(node.args):
+                continue   # machine-readable emit line
+            findings.append(ctx.finding(
+                RULE_ID, node, 'print',
+                'bare print() writes to stdout; route it through the '
+                'logger (or file=sys.stderr), or json.dumps the '
+                'payload if this is a machine-readable emit'))
+        elif isinstance(func, ast.Attribute) and func.attr == 'write' \
+                and _is_sys_stdout(func.value):
+            findings.append(ctx.finding(
+                RULE_ID, node, 'sys.stdout.write',
+                'sys.stdout.write() bypasses the logging layer and '
+                'corrupts machine-readable stdout'))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='no bare print/sys.stdout.write outside cli.py and '
+            'json-emit paths',
+    check=check,
+    scope=in_scope),)

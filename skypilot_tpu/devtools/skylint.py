@@ -1,0 +1,335 @@
+"""skylint: AST-based static analysis for the repo's correctness contracts.
+
+The serving and training stacks rely on invariants that unit tests can
+only probe one call site at a time: no host-device syncs inside jitted
+bodies, no Python-scalar consumption of traced arguments, engine state
+mutated only under its lock, a machine-readable stdout, `skytpu_*`
+metric names drawn from a single contract, and bf16 model arithmetic
+that is not silently promoted to f32.  skylint walks the AST and flags
+violations of each, so the contracts gate every PR via tier-1 instead
+of relying on review vigilance.
+
+Usage::
+
+    python -m skypilot_tpu.devtools.skylint [--format text|json]
+        [--rule RULE]... [--baseline PATH | --no-baseline] paths...
+
+Exit status: 0 when no unsuppressed findings, 1 otherwise, 2 on usage
+errors.
+
+Suppression comes in two layers:
+
+* inline — ``# skylint: disable=<rule>[,<rule>...]`` on the offending
+  line or the line directly above it; ``# skylint: disable-file=<rule>``
+  anywhere in a file disables the rule for that whole file.
+* baseline — a committed ``.skylint-baseline`` file (discovered by
+  walking up from the first scanned path, or passed via ``--baseline``)
+  with one ``rule:path:symbol`` entry per line; ``path`` and ``symbol``
+  are fnmatch globs resolved relative to the baseline's directory.
+
+Pure stdlib on purpose: importing this module must never pull in jax,
+so the pass can run in CI lanes and pre-flight hooks (e.g. the
+``bench.py --smoke`` stdout-purity gate) without touching a device.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+import sys
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+BASELINE_FILENAME = '.skylint-baseline'
+
+_DISABLE_RE = re.compile(
+    r'#\s*skylint:\s*disable=([A-Za-z0-9_,\- ]+)')
+_DISABLE_FILE_RE = re.compile(
+    r'#\s*skylint:\s*disable-file=([A-Za-z0-9_,\- ]+)')
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``symbol`` is a stable, line-number-free identifier (attribute
+    name, metric name, flagged call...) so baseline entries survive
+    unrelated edits to the file.
+    """
+    rule: str
+    path: str
+    line: int
+    col: int
+    symbol: str
+    message: str
+    suppressed: bool = False
+    suppressed_by: str = ''
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = f'  [suppressed: {self.suppressed_by}]' \
+            if self.suppressed else ''
+        return (f'{self.path}:{self.line}:{self.col}: '
+                f'{self.rule}: {self.message}{tag}')
+
+
+class FileContext:
+    """Parsed source plus per-file suppression state, handed to rules."""
+
+    def __init__(self, path: str, source: str,
+                 tree: Optional[ast.Module] = None):
+        self.path = path
+        self.posix = path.replace(os.sep, '/')
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree if tree is not None else ast.parse(source)
+        self.disabled_lines: Dict[int, Set[str]] = {}
+        self.disabled_file: Set[str] = set()
+        for lineno, line in enumerate(self.lines, start=1):
+            m = _DISABLE_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(',')
+                         if r.strip()}
+                # A bare comment line disables the statement below it;
+                # a trailing comment disables its own line.  Covering
+                # both keeps multi-line calls suppressible.
+                self.disabled_lines.setdefault(lineno, set()).update(
+                    rules)
+                self.disabled_lines.setdefault(lineno + 1, set()).update(
+                    rules)
+            m = _DISABLE_FILE_RE.search(line)
+            if m:
+                self.disabled_file.update(
+                    r.strip() for r in m.group(1).split(',') if r.strip())
+
+    def inline_disabled(self, rule: str, line: int) -> bool:
+        if rule in self.disabled_file or 'all' in self.disabled_file:
+            return True
+        rules = self.disabled_lines.get(line, ())
+        return rule in rules or 'all' in rules
+
+    def finding(self, rule: str, node: ast.AST, symbol: str,
+                message: str) -> Finding:
+        return Finding(rule=rule, path=self.path,
+                       line=getattr(node, 'lineno', 1),
+                       col=getattr(node, 'col_offset', 0) + 1,
+                       symbol=symbol, message=message)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    check: Callable[[FileContext], Iterable[Finding]]
+    # posix path -> whether the rule applies to this file.
+    scope: Callable[[str], bool] = lambda posix: True
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    rule: str
+    path_glob: str
+    symbol_glob: str
+
+    def matches(self, finding: Finding, rel_posix: str) -> bool:
+        return (self.rule == finding.rule
+                and fnmatch.fnmatch(rel_posix, self.path_glob)
+                and fnmatch.fnmatch(finding.symbol, self.symbol_glob))
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    entries: List[BaselineEntry] = []
+    with open(path, encoding='utf-8') as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith('#'):
+                continue
+            parts = line.split(':')
+            if len(parts) == 2:
+                parts.append('*')
+            if len(parts) != 3:
+                raise ValueError(
+                    f'{path}: bad baseline entry {line!r} '
+                    f'(want rule:path[:symbol])')
+            entries.append(BaselineEntry(*[p.strip() for p in parts]))
+    return entries
+
+
+def find_baseline(start: str) -> Optional[str]:
+    """Walk up from ``start`` looking for the committed baseline."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        cand = os.path.join(cur, BASELINE_FILENAME)
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if not d.startswith('.') and d != '__pycache__')
+                for fn in sorted(filenames):
+                    if fn.endswith('.py'):
+                        out.append(os.path.join(dirpath, fn))
+        else:
+            out.append(p)
+    return out
+
+
+def all_rules() -> List[Rule]:
+    from skypilot_tpu.devtools.rules import ALL_RULES
+    return list(ALL_RULES)
+
+
+def lint_files(files: Sequence[str],
+               rules: Optional[Sequence[Rule]] = None,
+               baseline: Optional[Sequence[BaselineEntry]] = None,
+               baseline_root: Optional[str] = None) -> List[Finding]:
+    """Lint ``files`` and return every finding, suppressed ones flagged.
+
+    ``baseline_root`` anchors the relative paths the baseline globs are
+    matched against (defaults to cwd).
+    """
+    rules = list(rules) if rules is not None else all_rules()
+    baseline = list(baseline or ())
+    root = os.path.abspath(baseline_root or os.getcwd())
+    findings: List[Finding] = []
+    for path in files:
+        try:
+            with open(path, encoding='utf-8') as f:
+                source = f.read()
+            ctx = FileContext(path, source)
+        except (OSError, SyntaxError, ValueError) as e:
+            findings.append(Finding(
+                rule='parse-error', path=path, line=1, col=1,
+                symbol='parse', message=f'could not lint: {e}'))
+            continue
+        rel = os.path.relpath(os.path.abspath(path), root)
+        rel_posix = rel.replace(os.sep, '/')
+        for rule in rules:
+            if not rule.scope(ctx.posix):
+                continue
+            for finding in rule.check(ctx):
+                if ctx.inline_disabled(finding.rule, finding.line):
+                    finding = dataclasses.replace(
+                        finding, suppressed=True, suppressed_by='inline')
+                elif any(e.matches(finding, rel_posix)
+                         for e in baseline):
+                    finding = dataclasses.replace(
+                        finding, suppressed=True,
+                        suppressed_by='baseline')
+                findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(paths: Sequence[str],
+               rule_ids: Optional[Sequence[str]] = None,
+               baseline_path: Optional[str] = None,
+               use_baseline: bool = True) -> List[Finding]:
+    """High-level entry point shared by the CLI, tests, and bench gate."""
+    rules = all_rules()
+    if rule_ids:
+        known = {r.id for r in rules}
+        unknown = set(rule_ids) - known
+        if unknown:
+            raise ValueError(
+                f'unknown rule(s): {", ".join(sorted(unknown))}; '
+                f'known: {", ".join(sorted(known))}')
+        rules = [r for r in rules if r.id in rule_ids]
+    baseline: List[BaselineEntry] = []
+    baseline_root = None
+    if use_baseline:
+        if baseline_path is None and paths:
+            baseline_path = find_baseline(paths[0])
+        if baseline_path:
+            baseline = load_baseline(baseline_path)
+            baseline_root = os.path.dirname(
+                os.path.abspath(baseline_path))
+    return lint_files(iter_py_files(paths), rules=rules,
+                      baseline=baseline, baseline_root=baseline_root)
+
+
+def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.suppressed]
+
+
+def to_json(findings: Sequence[Finding],
+            rules: Sequence[Rule]) -> Dict[str, object]:
+    live = unsuppressed(findings)
+    return {
+        'version': 1,
+        'rules': sorted(r.id for r in rules),
+        'counts': {'total': len(findings),
+                   'unsuppressed': len(live)},
+        'findings': [f.to_dict() for f in findings],
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.devtools.skylint',
+        description=__doc__.split('\n\n', maxsplit=1)[0])
+    parser.add_argument('paths', nargs='*',
+                        help='files or directories to lint')
+    parser.add_argument('--format', choices=('text', 'json'),
+                        default='text')
+    parser.add_argument('--rule', action='append', default=None,
+                        help='run only this rule (repeatable)')
+    parser.add_argument('--baseline', default=None,
+                        help=f'suppression file (default: nearest '
+                             f'{BASELINE_FILENAME} above the first '
+                             f'path)')
+    parser.add_argument('--no-baseline', action='store_true',
+                        help='ignore any baseline file')
+    parser.add_argument('--list-rules', action='store_true')
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for rule in sorted(rules, key=lambda r: r.id):
+            print(f'{rule.id:<18} {rule.summary}')
+        return 0
+    if not args.paths:
+        parser.error('no paths given')
+    try:
+        findings = lint_paths(
+            args.paths, rule_ids=args.rule,
+            baseline_path=args.baseline,
+            use_baseline=not args.no_baseline)
+    except (ValueError, OSError) as e:
+        print(f'skylint: {e}', file=sys.stderr)
+        return 2
+
+    live = unsuppressed(findings)
+    if args.format == 'json':
+        selected = rules if not args.rule else \
+            [r for r in rules if r.id in args.rule]
+        print(json.dumps(to_json(findings, selected), indent=1))
+    else:
+        for finding in findings:
+            if not finding.suppressed:
+                print(finding.render())
+        n_sup = len(findings) - len(live)
+        print(f'skylint: {len(live)} finding(s), '
+              f'{n_sup} suppressed', file=sys.stderr)
+    return 1 if live else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

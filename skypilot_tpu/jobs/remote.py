@@ -197,7 +197,7 @@ def _serve_dag(dag_path: str, name: Optional[str]) -> None:
     job_id = jobs_core.launch(dag, name=name, controller_mode='inline')
     from skypilot_tpu.jobs import state as jobs_state
     status = jobs_state.get_status(job_id)
-    print(f'managed job {job_id} finished: {status}', flush=True)
+    logger.info('managed job %s finished: %s', job_id, status)
     if status is not jobs_state.ManagedJobStatus.SUCCEEDED:
         sys.exit(1)
 

@@ -6,6 +6,8 @@ holds `RequestTrace`/`TraceStore` for per-request lifecycle timelines.
 Both are pure stdlib so they can be imported from any layer (engine,
 server, trainer, bench) without dragging in JAX.
 """
+import re
+
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.observability.metrics import (CONTENT_TYPE_LATEST, Counter,
@@ -13,7 +15,54 @@ from skypilot_tpu.observability.metrics import (CONTENT_TYPE_LATEST, Counter,
                                                 get_registry)
 from skypilot_tpu.observability.tracing import RequestTrace, TraceStore
 
+# Naming contract for every series the repo registers.  Type-suffix
+# conventions (Counter -> _total, Histogram -> _seconds/_bytes) are
+# asserted by tests/unit_tests/test_observability.py on top of this.
+METRIC_NAME_RE = re.compile(
+    r'skytpu_[a-z0-9_]+')
+
+# The single source of truth for metric names: the exposition tests,
+# dashboards, and the skylint metric-contract rule all key off this
+# set.  Registering a series whose name is absent here fails tier-1
+# (tests + skylint), so add the name in the same PR that adds the
+# series.
+METRIC_CONTRACT = frozenset({
+    # infer/engine.py — serving lifecycle
+    'skytpu_admission_backpressure_total',
+    'skytpu_decode_batch_occupancy_ratio',
+    'skytpu_decode_cache_read_bytes',
+    'skytpu_decode_live_slots',
+    'skytpu_decode_queue_depth',
+    'skytpu_decode_slot_steps_total',
+    'skytpu_decode_steps_total',
+    'skytpu_kv_free_pages',
+    'skytpu_kv_pages_cannibalized_total',
+    'skytpu_output_tokens_total',
+    'skytpu_prefix_cache_page_hits_total',
+    'skytpu_prefix_cache_page_misses_total',
+    'skytpu_prompt_tokens_total',
+    'skytpu_request_queue_seconds',
+    'skytpu_request_tpot_seconds',
+    'skytpu_request_ttft_seconds',
+    'skytpu_requests_aborted_total',
+    'skytpu_requests_cancelled_total',
+    'skytpu_requests_evicted_total',
+    'skytpu_requests_finished_total',
+    'skytpu_requests_in_flight',
+    'skytpu_requests_submitted_total',
+    # infer/server.py — HTTP surface
+    'skytpu_http_request_seconds',
+    'skytpu_http_requests_total',
+    # train/trainer.py — training loop
+    'skytpu_train_step_seconds',
+    'skytpu_train_steps_total',
+    'skytpu_train_tokens_per_sec',
+    'skytpu_train_tokens_total',
+})
+
 __all__ = [
+    'METRIC_CONTRACT',
+    'METRIC_NAME_RE',
     'CONTENT_TYPE_LATEST',
     'Counter',
     'Gauge',

@@ -366,11 +366,11 @@ def test_whole_batch_engine_counts(paged_engine):
 # Metric name contract + overhead guard (tier-1 acceptance)
 # ---------------------------------------------------------------------
 
-_NAME_CONTRACT = re.compile(
-    r'skytpu_[a-z0-9_]+(_total|_bytes|_seconds|_ratio|_count)?')
-
-
 def test_every_registered_metric_name_matches_contract(paged_engine):
+    """Single-sourced: the regex and allowed-name set both come from
+    skypilot_tpu.observability (METRIC_NAME_RE / METRIC_CONTRACT),
+    which the skylint metric-contract rule enforces statically."""
+    from skypilot_tpu import observability
     from skypilot_tpu.infer import server as server_lib
     from skypilot_tpu.train import trainer as trainer_lib
     _, reg = paged_engine
@@ -379,7 +379,8 @@ def test_every_registered_metric_name_matches_contract(paged_engine):
     names = reg.names()
     assert len(names) >= 20
     for name in names:
-        assert _NAME_CONTRACT.fullmatch(name), name
+        assert observability.METRIC_NAME_RE.fullmatch(name), name
+        assert name in observability.METRIC_CONTRACT, name
     # Unit suffixes are not just permitted, they are used correctly:
     for name in names:
         m = reg.get(name)

@@ -12,6 +12,7 @@ import urllib.request
 
 import pytest
 
+from skypilot_tpu import observability
 from skypilot_tpu.observability import metrics as metrics_lib
 
 _OVERRIDES = dict(n_heads=4, n_kv_heads=2, max_seq_len=64, n_layers=2,
@@ -71,14 +72,19 @@ def test_metrics_scrape_after_round_trip(server):
     assert code == 200
     assert hdrs['Content-Type'] == metrics_lib.CONTENT_TYPE_LATEST
     text = raw.decode()
+    # The full serving surface comes from the single-sourced contract
+    # (skypilot_tpu.observability.METRIC_CONTRACT): every engine/http
+    # series must be scraped, and nothing may be scraped that the
+    # contract does not know.
+    scraped = {line.split(' ')[2] for line in text.splitlines()
+               if line.startswith('# TYPE ')}
+    expected = {n for n in observability.METRIC_CONTRACT
+                if not n.startswith('skytpu_train_')}
+    assert scraped == expected, scraped ^ expected
+    # Exposition format details the contract set cannot express:
     for needle in ('skytpu_request_ttft_seconds_bucket',
-                   'skytpu_decode_batch_occupancy_ratio',
-                   'skytpu_kv_free_pages',
-                   'skytpu_prefix_cache_page_hits_total',
-                   'skytpu_prefix_cache_page_misses_total',
                    'skytpu_http_request_seconds_bucket',
-                   'route="/v1/completions"',
-                   'skytpu_http_requests_total'):
+                   'route="/v1/completions"'):
         assert needle in text, needle
     # Scrape is the registry's own rendering: every family the
     # registry knows appears with HELP + TYPE.  (Values race with the
