@@ -860,40 +860,54 @@ def main() -> None:
 
 
 def _run_ladder(args) -> None:
+    from skypilot_tpu.utils import retry as retry_lib
 
     # --- e2e rung(s): need provisioning + compile + steps headroom.
+    # Any loss of the metric (job failure, backend init, orchestration
+    # crash) must trigger the retry/fallback ladder, not a bare exit —
+    # hence retry_on=BaseException with only the exit signals fatal.
     e2e_min_s = 240.0
     e2e_env_deadline = float(
         os.environ.get('SKYTPU_BENCH_E2E_DEADLINE_S', '3600'))
-    for attempt in range(2):
-        headroom = _remaining_s() - _FINAL_RUNG_RESERVE_S - 60
-        if headroom < e2e_min_s:
-            print(f'# skipping e2e attempt {attempt + 1}: only '
+
+    def _e2e_budget() -> float:
+        return _remaining_s() - _FINAL_RUNG_RESERVE_S - 60
+
+    def _e2e_attempt() -> None:
+        run_through_launch(args.steps,
+                           deadline_s=min(e2e_env_deadline,
+                                          _e2e_budget()))
+
+    def _e2e_failed(attempt, e, _will_retry, _delay) -> None:
+        _FAILURES.append(f'e2e attempt {attempt}: {e!r}')
+        print(f'# bench e2e attempt {attempt} failed: {e!r}',
+              file=sys.stderr)
+        tail = getattr(e, 'log_tail', '')
+        if tail:
+            print(tail, file=sys.stderr)
+
+    try:
+        retry_lib.retry_with_backoff(
+            _e2e_attempt, max_attempts=2, base_delay_s=15.0,
+            factor=1.0, jitter='none',
+            retry_on=(BaseException,),
+            fatal=(KeyboardInterrupt, SystemExit),
+            remaining_s=_e2e_budget, min_attempt_s=e2e_min_s,
+            on_failure=_e2e_failed, describe='bench e2e rung')
+        return
+    except retry_lib.RetryError as e:
+        if e.attempts == 0:
+            print(f'# skipping the e2e rung: only '
                   f'{_remaining_s():.0f}s of budget left',
                   file=sys.stderr)
-            break
-        try:
-            run_through_launch(args.steps,
-                               deadline_s=min(e2e_env_deadline,
-                                              headroom))
-            return
-        except BaseException as e:  # noqa: BLE001 — any loss of the
-            # metric (job failure, backend init, orchestration crash)
-            # must trigger the retry/fallback ladder, not a bare exit.
-            if isinstance(e, (KeyboardInterrupt, SystemExit)):
-                raise
-            _FAILURES.append(f'e2e attempt {attempt + 1}: {e!r}')
-            print(f'# bench e2e attempt {attempt + 1} failed: {e!r}',
-                  file=sys.stderr)
-            tail = getattr(e, 'log_tail', '')
-            if tail:
-                print(tail, file=sys.stderr)
-            if attempt == 0:
-                time.sleep(min(15, max(0, _remaining_s() - e2e_min_s)))
 
     # --- --direct rung(s): spaced fresh-process attempts (the tunnel
-    # hang can outlast any single watchdog window), but the spacing
-    # now bends to the budget instead of overrunning it.
+    # hang can outlast any single watchdog window).  The budget-aware
+    # retry loop naps the full spacing only when a minimum-length
+    # attempt still fits AFTER it; otherwise it retries back-to-back —
+    # a shortened nap that leaves less than direct_min_s is strictly
+    # worse than no nap at all (BENCH_r05: slept 600s, then skipped
+    # the attempt with 146s left — the window was burned sleeping).
     direct_attempts = int(os.environ.get(
         'SKYTPU_BENCH_DIRECT_ATTEMPTS', '3'))
     spacing_s = float(os.environ.get(
@@ -901,44 +915,53 @@ def _run_ladder(args) -> None:
     direct_min_s = 150.0
     env_direct_timeout = float(os.environ.get(
         'SKYTPU_BENCH_DIRECT_TIMEOUT_S', '2400'))
-    for attempt in range(direct_attempts):
-        headroom = _remaining_s() - _FINAL_RUNG_RESERVE_S - 10
-        if headroom < direct_min_s:
-            print(f'# skipping --direct attempt {attempt + 1}: only '
+
+    def _direct_budget() -> float:
+        return _remaining_s() - _FINAL_RUNG_RESERVE_S - 10
+
+    state = {'attempt': 0}
+
+    def _direct_attempt() -> None:
+        state['attempt'] += 1
+        headroom = _direct_budget()
+        print(f'# falling back to --direct (subprocess trainer, '
+              f'attempt {state["attempt"]}/{direct_attempts})',
+              file=sys.stderr)
+        os.environ['SKYTPU_BENCH_DIRECT_TIMEOUT_S'] = str(
+            max(direct_min_s, min(env_direct_timeout, headroom)))
+        run_direct_subprocess(args.steps)
+
+    def _direct_failed(attempt, e, will_retry, delay) -> None:
+        _FAILURES.append(f'direct attempt {attempt}: {e!r}')
+        print(f'# bench --direct attempt {attempt} failed: {e!r}',
+              file=sys.stderr)
+        if not will_retry:
+            return
+        if delay > 0:
+            print(f'# waiting {delay:.0f}s before --direct attempt '
+                  f'{attempt + 1}/{direct_attempts} (fresh backend '
+                  f'window)', file=sys.stderr)
+        elif spacing_s > 0:
+            print(f'# skipping the {spacing_s:.0f}s inter-attempt '
+                  f'sleep: {_direct_budget():.0f}s headroom cannot '
+                  f'fit it plus a {direct_min_s:.0f}s attempt — '
+                  f'retrying back-to-back', file=sys.stderr)
+
+    try:
+        retry_lib.retry_with_backoff(
+            _direct_attempt, max_attempts=direct_attempts,
+            base_delay_s=spacing_s, factor=1.0, jitter='none',
+            retry_on=(BaseException,),
+            fatal=(KeyboardInterrupt, SystemExit),
+            remaining_s=_direct_budget, min_attempt_s=direct_min_s,
+            on_failure=_direct_failed, describe='bench --direct rung')
+        return
+    except retry_lib.RetryError as e:
+        if e.attempts == 0:
+            print(f'# skipping the --direct rung: only '
                   f'{_remaining_s():.0f}s of budget left',
                   file=sys.stderr)
-            break
-        if attempt > 0:
-            # Nap only when a full minimum-length attempt still fits
-            # AFTER the full spacing; a shortened nap that leaves less
-            # than direct_min_s is strictly worse than no nap at all
-            # (BENCH_r05: slept 600s, then skipped the attempt with
-            # 146s left — the window was burned sleeping).
-            if headroom - spacing_s >= direct_min_s:
-                print(f'# waiting {spacing_s:.0f}s before --direct '
-                      f'attempt {attempt + 1}/{direct_attempts} '
-                      f'(fresh backend window)', file=sys.stderr)
-                time.sleep(spacing_s)
-                headroom = _remaining_s() - _FINAL_RUNG_RESERVE_S - 10
-            else:
-                print(f'# skipping the {spacing_s:.0f}s inter-attempt '
-                      f'sleep: {headroom:.0f}s headroom cannot fit it '
-                      f'plus a {direct_min_s:.0f}s attempt — retrying '
-                      f'back-to-back', file=sys.stderr)
-        print(f'# falling back to --direct (subprocess trainer, '
-              f'attempt {attempt + 1}/{direct_attempts})',
-              file=sys.stderr)
-        try:
-            os.environ['SKYTPU_BENCH_DIRECT_TIMEOUT_S'] = str(
-                max(direct_min_s, min(env_direct_timeout, headroom)))
-            run_direct_subprocess(args.steps)
-            return
-        except BaseException as e:  # noqa: BLE001
-            if isinstance(e, (KeyboardInterrupt, SystemExit)):
-                raise
-            _FAILURES.append(f'direct attempt {attempt + 1}: {e!r}')
-            print(f'# bench --direct attempt {attempt + 1} failed: '
-                  f'{e!r}', file=sys.stderr)
+
     # Last rung: a dated in-round measurement beats no number at all —
     # but it is NOT a live capture, so the rc says so: _STALE_RC when
     # the stale cached line went out, 1 when not even that existed.

@@ -9,10 +9,11 @@ from skypilot_tpu.devtools.rules import host_sync
 from skypilot_tpu.devtools.rules import lock_discipline
 from skypilot_tpu.devtools.rules import metric_contract
 from skypilot_tpu.devtools.rules import retrace
+from skypilot_tpu.devtools.rules import sleep_discipline
 from skypilot_tpu.devtools.rules import stdout_purity
 
 ALL_RULES = (host_sync.RULES + retrace.RULES + lock_discipline.RULES
              + stdout_purity.RULES + metric_contract.RULES
-             + dtype_promotion.RULES)
+             + dtype_promotion.RULES + sleep_discipline.RULES)
 
 __all__ = ['ALL_RULES']

@@ -1,0 +1,98 @@
+"""sleep-discipline: long constant sleeps in loops belong to utils/retry.
+
+A hand-rolled retry loop that ``time.sleep(600)``s is how a process
+sleeps through its own budget window (BENCH_r05: the bench ladder
+burned its last capture window napping).  The repo's one sanctioned
+home for long inter-attempt naps is ``utils/retry.py`` — its
+``retry_with_backoff`` is budget-aware (it skips the nap when the
+remaining wall clock could no longer fund another attempt) and
+jittered.  Everywhere else, a constant ``time.sleep(>=30)`` lexically
+inside a loop is a finding: route the loop through
+``retry_with_backoff`` or justify it with an inline suppression.
+
+Short polling sleeps (``time.sleep(0.05)`` style) and sleeps whose
+duration is a computed expression (already budget-bent by the caller)
+are not flagged — the rule targets the fixed long nap specifically,
+because that is the shape that cannot react to a shrinking budget.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from skypilot_tpu.devtools import skylint
+
+RULE_ID = 'sleep-discipline'
+
+# Seconds at and above which a constant in-loop sleep is a finding.
+THRESHOLD_S = 30.0
+
+_LOOPS = (ast.For, ast.While, ast.AsyncFor)
+# Function boundaries: a def nested in a loop body runs on its own
+# schedule, not once per iteration.
+_BOUNDARIES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def in_scope(posix: str) -> bool:
+    # utils/retry.py IS the sanctioned retry/backoff sleeper.
+    return not posix.endswith('utils/retry.py')
+
+
+def _is_long_time_sleep(node: ast.AST) -> bool:
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == 'sleep'
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == 'time'
+            and node.args):
+        return False
+    arg = node.args[0]
+    return (isinstance(arg, ast.Constant)
+            and isinstance(arg.value, (int, float))
+            and not isinstance(arg.value, bool)
+            and float(arg.value) >= THRESHOLD_S)
+
+
+def _walk_loop_body(node: ast.AST, acc: List[ast.Call]) -> None:
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, _BOUNDARIES):
+            continue
+        if _is_long_time_sleep(child):
+            acc.append(child)  # type: ignore[arg-type]
+        _walk_loop_body(child, acc)
+
+
+def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    seen = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, _LOOPS):
+            continue
+        calls: List[ast.Call] = []
+        for part in node.body + getattr(node, 'orelse', []):
+            if isinstance(part, _BOUNDARIES):
+                continue  # a def in the loop body runs on its own schedule
+            if _is_long_time_sleep(part):
+                calls.append(part)  # type: ignore[arg-type]
+            _walk_loop_body(part, calls)
+        for call in calls:
+            key = (call.lineno, call.col_offset)
+            if key in seen:  # nested loops see the same call twice
+                continue
+            seen.add(key)
+            secs = call.args[0].value  # type: ignore[attr-defined]
+            findings.append(ctx.finding(
+                RULE_ID, call, 'time.sleep',
+                f'constant time.sleep({secs}) inside a loop: long '
+                'retry naps belong to utils/retry.retry_with_backoff '
+                '(budget-aware, jittered) — a fixed nap can sleep '
+                'through the budget window'))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary=f'no constant time.sleep(>={THRESHOLD_S:.0f}s) inside '
+            'loops outside utils/retry.py',
+    check=check,
+    scope=in_scope),)

@@ -29,6 +29,7 @@ import contextlib
 import dataclasses
 import functools
 import os
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -38,9 +39,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import skypilot_tpu.models as models_lib
 from skypilot_tpu import sky_logging
+from skypilot_tpu.infer import failures
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing as tracing_lib
 from skypilot_tpu.parallel import sharding as sharding_lib
+from skypilot_tpu.utils import chaos
 
 logger = sky_logging.init_logger(__name__)
 
@@ -439,6 +442,10 @@ class _ServingMetrics:
         self.aborted = r.counter(
             'skytpu_requests_aborted_total',
             'In-flight requests dropped by a fatal decode abort().')
+        self.deadline_expired = r.counter(
+            'skytpu_request_deadline_expired_total',
+            'Requests that missed their deadline: expired in the queue '
+            'before prefill, or timed out in wait().')
         self.backpressure = r.counter(
             'skytpu_admission_backpressure_total',
             'Admission attempts deferred because the page pool could '
@@ -856,6 +863,16 @@ class ContinuousBatchingEngine:
         # Tokens are pushed as they decode; completion/cancel/abort
         # push a sentinel so readers never block forever.
         self._stream_queues: Dict[int, Any] = {}
+        # rid -> per-request failure (deadline expiry, recovery abort,
+        # contained prefill error).  wait()/stream() raise and clear.
+        self._errors: Dict[int, BaseException] = {}
+        # rid -> absolute time.monotonic() deadline (requests without
+        # one have no entry).  Queue expiry and wait() both key off it.
+        self._deadlines: Dict[int, float] = {}
+        # EWMA of finished requests' submit->finish seconds; feeds the
+        # admission-wait estimate load shedding uses.  Only the
+        # scheduler thread writes it.
+        self._service_ewma_s: Optional[float] = None
 
         # -- telemetry (host-side only; see _publish_step_metrics) ----
         self.registry = (registry if registry is not None
@@ -901,15 +918,30 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt_ids: Sequence[int],
                sampling: Optional[SamplingConfig] = None,
-               stream: bool = False) -> int:
+               stream: bool = False,
+               deadline_s: Optional[float] = None) -> int:
         """Enqueue one prompt; returns a request id for wait() (or,
         with stream=True, for stream() — tokens are then ALSO pushed
-        to a per-request queue as each decode step lands)."""
+        to a per-request queue as each decode step lands).
+
+        `deadline_s` is a relative wall-clock budget: the request is
+        expired in the queue once it passes (before wasting prefill),
+        and wait() without an explicit timeout blocks at most until
+        it."""
         import queue as queue_mod
         import threading
         cfg = sampling or SamplingConfig()
         if len(prompt_ids) == 0:
             raise ValueError('empty prompt')
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f'deadline_s must be a number: {deadline_s!r}') from e
+            if deadline_s <= 0:
+                raise ValueError(
+                    f'deadline_s must be > 0, got {deadline_s}')
         if cfg.max_new_tokens < 1:
             # step() appends the sampled token before checking the
             # budget, so 0/negative would still emit one token (and a
@@ -931,12 +963,21 @@ class ContinuousBatchingEngine:
                 raise ValueError(f'seed must be an integer: '
                                  f'{cfg.seed!r}') from e
         with self._submit_lock:
+            if self._fatal is not None:
+                # The replica is dead; fail fast instead of queueing
+                # work whose waiter can only time out.
+                raise RuntimeError(
+                    f'engine aborted: {self._fatal!r}') from self._fatal
             rid = self._next_rid
             self._next_rid += 1
             self._events[rid] = threading.Event()
             if stream:
                 self._stream_queues[rid] = queue_mod.Queue()
-            self._queue.append((rid, list(prompt_ids), cfg))
+            deadline = None
+            if deadline_s is not None:
+                deadline = time.monotonic() + deadline_s
+                self._deadlines[rid] = deadline
+            self._queue.append((rid, list(prompt_ids), cfg, deadline))
             depth = len(self._queue)
             # Trace begins inside the lock so the decode thread can
             # never admit this rid before its trace exists.
@@ -958,6 +999,8 @@ class ContinuousBatchingEngine:
             depth = len(self._queue)
             self._results.pop(request_id, None)
             self._events.pop(request_id, None)
+            self._errors.pop(request_id, None)
+            self._deadlines.pop(request_id, None)
             q = self._stream_queues.pop(request_id, None)
             if q is not None:
                 q.put(self._STREAM_END)  # unblock a live reader
@@ -981,36 +1024,156 @@ class ContinuousBatchingEngine:
              timeout: Optional[float] = None) -> List[int]:
         """Block until `request_id` finishes; returns its token ids.
         On timeout the request is CANCELED (not left orphaned) and
-        TimeoutError raised."""
+        TimeoutError raised.  Without an explicit `timeout`, a request
+        submitted with `deadline_s` blocks at most until its deadline
+        (DeadlineExceededError).  Raises the per-request failure when
+        the request was aborted/expired by the engine."""
         event = self._events[request_id]
+        deadline = self._deadlines.get(request_id)
+        from_deadline = timeout is None and deadline is not None
+        if from_deadline:
+            timeout = max(0.0, deadline - time.monotonic())
         if not event.wait(timeout):
             self.cancel(request_id)
+            if from_deadline:
+                self._met.deadline_expired.inc()
+                raise failures.DeadlineExceededError(
+                    f'request {request_id} missed its deadline')
             raise TimeoutError(f'request {request_id} not done')
         with self._submit_lock:
+            err = self._errors.pop(request_id, None)
+            if err is not None:
+                self._events.pop(request_id, None)
+                self._deadlines.pop(request_id, None)
+                self._results.pop(request_id, None)
+                raise err
             if self._fatal is not None and \
                     request_id not in self._results:
                 self._events.pop(request_id, None)
+                self._deadlines.pop(request_id, None)
                 raise RuntimeError(
                     f'decode loop died: {self._fatal!r}') \
                     from self._fatal
             del self._events[request_id]
+            self._deadlines.pop(request_id, None)
             return self._results.pop(request_id)
 
     def abort(self, error: BaseException) -> None:
-        """Fatal decode failure: wake every waiter so none blocks its
-        full timeout; wait() raises for requests without results."""
+        """Fatal decode failure: the engine stops serving.  Wake every
+        waiter so none blocks its full timeout (wait() raises for
+        requests without results), drop the queue (submit() refuses
+        new work once `_fatal` is set), and return in-flight pages to
+        the allocator so page accounting ends leak-free even on the
+        abandon path.  Device state is left as-is — a dead replica's
+        buffers are not worth a device round-trip that may itself
+        hang."""
         with self._submit_lock:
             self._fatal = error
+            self._queue.clear()
             events = list(self._events.values())
             queues = list(self._stream_queues.values())
+        self._drop_inflight()
         for e in events:
             e.set()
         for q in queues:
             q.put(self._STREAM_END)  # stream() re-checks _fatal
-        dropped = self.traces.abort_all()
+        dropped = self.traces.abort_all(error=repr(error))
         if dropped:
             self._met.aborted.inc(len(dropped))
         self._met.inflight.set(self.traces.inflight_count)
+
+    def _drop_inflight(self) -> List[int]:
+        """Clear every slot and pending prefill, returning their pages
+        to the allocator; returns the rids dropped.  Host-side only:
+        no device ops (callers either rebuild the device state —
+        recover() — or are abandoning it — abort())."""
+        victims: List[int] = []
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                victims.append(s.request_id)
+                if self.page_size:
+                    for page in s.pages:
+                        self._alloc.release(page)
+                self._slots[i] = None
+        for p in self._prefills:
+            victims.append(p.rid)
+            if self.page_size:
+                for page in p.pages:
+                    self._alloc.release(page)
+        self._prefills = []
+        return victims
+
+    def recover(self, error: BaseException) -> None:
+        """Transient-failure recovery: keep the engine serving.
+
+        Called by the decode-loop supervisor (the same thread that
+        drives step()) after a step exception.  In-flight slots and
+        pending prefills are aborted — their waiters fail fast with
+        the cause — while QUEUED requests survive: they have no device
+        state yet.  Because the jitted step/insert paths donate the
+        cache buffers, a mid-step exception leaves them invalid, so
+        all device state is rebuilt from zeros and the allocator is
+        reset (its prefix registrations describe cache contents that
+        no longer exist).  The allocator must verify leak-free after
+        the drop; a failure raises PageLeakError, which classifies
+        fatal."""
+        victims = self._drop_inflight()
+        with self._submit_lock:
+            # Every canceled rid was in-engine and was just dropped.
+            self._canceled.clear()
+            self._admitting_rid = None
+            queued = len(self._queue)
+        if self._alloc is not None:
+            leak = self._alloc.leak_report()
+            if leak is not None:
+                raise failures.PageLeakError(
+                    f'allocator not clean after dropping in-flight '
+                    f'work: {leak}')
+            self._alloc.reset()
+        self._cache = self._eng._fresh_cache()
+        self._last = jnp.zeros((self.n_slots, self.config.vocab_size),
+                               jnp.float32)
+        self._kv_mask = jnp.zeros((self.n_slots, self.max_seq_len),
+                                  bool)
+        for rid in victims:
+            self._fail_request(rid, failures.wrap_abort(rid, error))
+        logger.warning(
+            f'engine recovered from {error!r}: aborted {len(victims)} '
+            f'in-flight request(s), preserved {queued} queued')
+
+    def _fail_request(self, rid: int, error: BaseException,
+                      state: str = 'aborted') -> None:
+        """Fail ONE request — record its error, wake its waiter and
+        stream reader, finish its trace — while the engine keeps
+        serving everything else.  `state='cancelled'` is the queued
+        deadline-expiry flavor (counted as a deadline expiry, not an
+        abort)."""
+        with self._submit_lock:
+            self._errors[rid] = error
+            self._results.pop(rid, None)
+            self._deadlines.pop(rid, None)
+            event = self._events.get(rid)
+            q = self._stream_queues.get(rid)
+        if q is not None:
+            q.put(self._STREAM_END)
+        if event is not None:
+            event.set()
+        if self.traces.finish(rid, state, error=repr(error)) is not None:
+            if state == 'cancelled':
+                self._met.deadline_expired.inc()
+                self._met.cancelled.inc()
+            else:
+                self._met.aborted.inc()
+        self._met.inflight.set(self.traces.inflight_count)
+
+    def _expire(self, rid: int) -> None:
+        """A queued request whose deadline already passed: terminal
+        'cancelled' without wasting a prefill on it."""
+        self._fail_request(
+            rid,
+            failures.DeadlineExceededError(
+                f'request {rid} expired in queue before admission'),
+            state='cancelled')
 
     def stream(self, request_id: int, timeout: Optional[float] = None):
         """Yield `request_id`'s tokens as they decode (submit() must
@@ -1036,11 +1199,17 @@ class ContinuousBatchingEngine:
             if tok is self._STREAM_END:
                 with self._submit_lock:
                     fatal = self._fatal
+                    err = self._errors.pop(request_id, None)
                     self._stream_queues.pop(request_id, None)
                     # wait()-side bookkeeping: a pure-stream consumer
                     # must not leak the event/result entries.
                     self._events.pop(request_id, None)
                     self._results.pop(request_id, None)
+                    self._deadlines.pop(request_id, None)
+                if err is not None:
+                    # Per-request failure beats replica-fatal: it names
+                    # THIS request's cause.
+                    raise err
                 if fatal is not None:
                     raise RuntimeError(
                         f'decode loop died: {fatal!r}') from fatal
@@ -1098,28 +1267,41 @@ class ContinuousBatchingEngine:
         tokens[0, :true_len] = prompt
         mask_row = np.zeros((self.max_seq_len,), bool)
         mask_row[:true_len] = True
-        cache1 = self._fresh_cache1()
-        if shared_len > 0:
-            cache1 = self._hydrate1(
-                cache1, self._cache, jnp.asarray(table_row),
-                jnp.int32(shared_len // self.page_size),
-                jnp.int32(shared_len))
-        pending = _PendingPrefill(
-            slot_idx=slot_idx, rid=rid, cfg=cfg, true_len=true_len,
-            pad=pad, tokens=tokens, mask_row=mask_row,
-            cache1=cache1, done=shared_len, pages=pages,
-            table_row=table_row, shared_len=shared_len)
-        self.traces.event(rid, 'admitted',
-                          shared_prefix_tokens=shared_len)
-        self._met.prompt_tokens.inc(true_len)
-        if self.prefill_chunk > 0:
-            # Reserve the slot; one chunk runs per tick from
-            # _step_inner so live slots keep decoding in between.
-            self._prefills.append(pending)
-            return True
-        while pending.done < pending.pad:
-            self._prefill_chunk_step(pending)
+        try:
+            cache1 = self._fresh_cache1()
+            if shared_len > 0:
+                cache1 = self._hydrate1(
+                    cache1, self._cache, jnp.asarray(table_row),
+                    jnp.int32(shared_len // self.page_size),
+                    jnp.int32(shared_len))
+            pending = _PendingPrefill(
+                slot_idx=slot_idx, rid=rid, cfg=cfg, true_len=true_len,
+                pad=pad, tokens=tokens, mask_row=mask_row,
+                cache1=cache1, done=shared_len, pages=pages,
+                table_row=table_row, shared_len=shared_len)
+            self.traces.event(rid, 'admitted',
+                              shared_prefix_tokens=shared_len)
+            self._met.prompt_tokens.inc(true_len)
+            if self.prefill_chunk > 0:
+                # Reserve the slot; one chunk runs per tick from
+                # _step_inner so live slots keep decoding in between.
+                self._prefills.append(pending)
+                return True
+            while pending.done < pending.pad:
+                self._prefill_chunk_step(pending)
+        except BaseException:
+            # Everything above touches only this request's private
+            # state: hand its pages back and let the caller contain
+            # the failure to this rid.
+            self._release_slot_pages(pages)
+            raise
+        # Park across the shared-cache insert: if it fails
+        # (SharedStateError, not containable), the supervisor's
+        # recover() finds the pending here, releases its pages and
+        # fails the rid.
+        self._prefills.append(pending)
         self._finish_prefill(pending)
+        self._prefills.pop()
         return True
 
     def _prefill_chunk_step(self, pending: _PendingPrefill) -> None:
@@ -1132,6 +1314,7 @@ class ContinuousBatchingEngine:
         size-1 chunk traced in slot mode would scatter its K/V at the
         row's highest revealed kv_mask slot (true_len-1) instead of
         the cursor, silently corrupting the prompt."""
+        chaos.maybe_raise('prefill_raise')
         chunk = self.prefill_chunk if self.prefill_chunk > 0 \
             else pending.pad
         start = pending.done
@@ -1166,6 +1349,18 @@ class ContinuousBatchingEngine:
 
     def _finish_prefill(self, pending: _PendingPrefill) -> None:
         assert pending.last_row is not None
+        try:
+            self._finish_prefill_inner(pending)
+        except Exception as e:  # pylint: disable=broad-except
+            # The insert DONATES the shared cache: a mid-insert
+            # failure leaves its buffers invalid.  Escalate past the
+            # per-request containment — the supervisor must rebuild
+            # device state (recover()).
+            raise failures.SharedStateError(
+                f'insert for request {pending.rid} failed mid-'
+                f'donation; shared cache state unknown') from e
+
+    def _finish_prefill_inner(self, pending: _PendingPrefill) -> None:
         if self.page_size:
             self._cache, self._last, self._kv_mask = \
                 self._insert_paged(
@@ -1222,6 +1417,7 @@ class ContinuousBatchingEngine:
             else:
                 self._results[slot.request_id] = slot.outputs
                 event = self._events.get(slot.request_id)
+            self._deadlines.pop(slot.request_id, None)
             q = self._stream_queues.get(slot.request_id)
         if q is not None:
             q.put(self._STREAM_END)
@@ -1237,12 +1433,25 @@ class ContinuousBatchingEngine:
         else:
             self._met.finished.inc()
             self._met.observe_finished(trace)
+            total = trace.total_seconds() if trace is not None else None
+            if total is not None:
+                # Service-time EWMA feeding estimate_queue_wait_s();
+                # only the scheduler thread writes it.
+                prev = self._service_ewma_s
+                self._service_ewma_s = total if prev is None \
+                    else 0.8 * prev + 0.2 * total
         self._met.inflight.set(self.traces.inflight_count)
 
     def step(self) -> bool:
         """One scheduler tick: admit pending prompts into free slots,
         then one decode step for all occupied slots.  Returns False
         when fully idle (nothing queued, nothing occupied)."""
+        # Chaos fault points (no-ops unless SKYTPU_CHAOS is live):
+        # a raise here is the transient step-failure class the
+        # supervisor recovers from; a hang is the wedged-device class
+        # the watchdog detects.
+        chaos.maybe_raise('step_raise')
+        chaos.maybe_hang('step_hang')
         ctx = self.mesh if self.mesh is not None \
             else contextlib.nullcontext()
         with ctx:
@@ -1292,6 +1501,7 @@ class ContinuousBatchingEngine:
         reserved = {p.slot_idx for p in self._prefills}
         free = [i for i, s in enumerate(self._slots)
                 if s is None and i not in reserved]
+        now = time.monotonic()
         while free:
             with self._submit_lock:
                 item = None
@@ -1300,9 +1510,34 @@ class ContinuousBatchingEngine:
                     self._admitting_rid = item[0]
             if item is None:
                 break
+            rid, prompt, cfg, deadline = item
+            if deadline is not None and now > deadline:
+                # Expired in the queue: terminal before wasting a
+                # prefill on output nobody is waiting for.
+                with self._submit_lock:
+                    self._admitting_rid = None
+                    self._canceled.discard(rid)
+                self._expire(rid)
+                continue
             admitted = True
             try:
-                admitted = self._admit(free[0], *item)
+                admitted = self._admit(free[0], rid, prompt, cfg)
+            except failures.SharedStateError:
+                # Shared cache possibly invalidated mid-insert: NOT
+                # containable.  The pending is parked in _prefills, so
+                # the supervisor's recover() releases its pages and
+                # fails the rid.
+                raise
+            except Exception as e:  # pylint: disable=broad-except
+                # Admission failures touch only the request's private
+                # prefill state (_admit released its pages): contain
+                # to this rid, keep serving.
+                with self._submit_lock:
+                    self._canceled.discard(rid)
+                self._fail_request(rid, failures.wrap_abort(rid, e))
+                logger.warning(
+                    f'request {rid}: admission failed, aborted ({e!r})')
+                continue
             finally:
                 with self._submit_lock:
                     self._admitting_rid = None
@@ -1337,7 +1572,22 @@ class ContinuousBatchingEngine:
         # chunk per pending prompt, bounded by n_slots.
         still_pending: List[_PendingPrefill] = []
         for pending in self._prefills:
-            self._prefill_chunk_step(pending)
+            try:
+                self._prefill_chunk_step(pending)
+            except Exception as e:  # pylint: disable=broad-except
+                # A chunk touches only the request's PRIVATE batch-1
+                # cache — containable to this rid.  (_finish_prefill
+                # below is NOT containable: it donates the shared
+                # cache, so its exceptions propagate to the
+                # supervisor, which rebuilds device state.)
+                self._release_slot_pages(pending.pages)
+                with self._submit_lock:
+                    self._canceled.discard(pending.rid)
+                self._fail_request(pending.rid,
+                                   failures.wrap_abort(pending.rid, e))
+                logger.warning(f'request {pending.rid}: prefill '
+                               f'failed, aborted ({e!r})')
+                continue
             if pending.done >= pending.pad:
                 self._finish_prefill(pending)
             else:
@@ -1457,6 +1707,27 @@ class ContinuousBatchingEngine:
     def run_until_idle(self) -> None:
         while self.step():
             pass
+
+    # -- admission outlook (shedding / drain signals) ---------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def is_idle(self) -> bool:
+        """True when nothing is queued, prefilling, or slot-resident.
+        Advisory (racy reads from other threads): drain polls it."""
+        return not self._queue and not self._prefills \
+            and all(s is None for s in self._slots)
+
+    def estimate_queue_wait_s(self) -> float:
+        """Rough admission-wait estimate for a NEW request: queued
+        work divided into n_slots-wide waves times the EWMA of recent
+        submit->finish service times.  0.0 with no history yet — the
+        shed check then falls back to queue depth alone."""
+        ewma = self._service_ewma_s
+        if not ewma:
+            return 0.0
+        return (len(self._queue) / self.n_slots) * ewma
 
     # -- convenience (request-level API parity) ---------------------------
     def generate(self, prompts: Sequence[Sequence[int]],

@@ -1,0 +1,91 @@
+"""Failure taxonomy for the serving engine.
+
+The supervised decode loop (``infer/server.py``) asks one question of
+every exception that escapes ``engine.step()``: is the *device state*
+still trustworthy enough to rebuild on?
+
+* **transient** — a step blew up but the process and backend are fine:
+  injected chaos, a bad batch, a host-side bug in one tick.  The
+  supervisor aborts in-flight slots, has the engine rebuild its device
+  caches (donated buffers are invalid after a mid-step exception), and
+  keeps serving.
+* **fatal** — the device or process is wedged or lying: a hung backend
+  (``BackendInitHang`` — an abandoned watchdog thread still holds the
+  backend-init lock, see ``parallel/mesh.py``), a watchdog-detected
+  stall, an XLA runtime error (device state unknown), a page-accounting
+  leak, or the restart budget itself running out.  The replica goes
+  unhealthy and every waiter fails fast; recovery is a process restart
+  (or, at the fleet layer, a replica replacement).
+
+Classification is by exception *type name* plus a few message markers
+rather than imports, so this module stays importable without dragging
+in jax (``BackendInitHang`` lives next to the jax bootstrap).
+"""
+from __future__ import annotations
+
+TRANSIENT = 'transient'
+FATAL = 'fatal'
+
+# Type names (not imports — see module docstring) that always mean the
+# backend or process can no longer be trusted.
+_FATAL_TYPE_NAMES = frozenset({
+    'BackendInitHang',
+    'StepStallError',
+    'PageLeakError',
+    'RestartBudgetExceededError',
+    'XlaRuntimeError',
+})
+
+# Substrings of XLA/PJRT error text that indicate a wedged device even
+# when the exception type is generic.
+_FATAL_MESSAGE_MARKERS = ('RESOURCE_EXHAUSTED', 'DATA_LOSS',
+                          'device halted', 'HBM OOM')
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline passed before it produced a result."""
+
+
+class RequestAbortedError(RuntimeError):
+    """One request was dropped (recovery, prefill failure) while the
+    engine itself kept serving.  ``__cause__`` carries the trigger."""
+
+
+class SharedStateError(RuntimeError):
+    """An operation that donates the SHARED decode cache failed midway,
+    so the cache buffers may be invalid.  Never containable to one
+    request: it must propagate to the supervisor, whose recover()
+    rebuilds the device state.  Transient by classification."""
+
+
+class StepStallError(RuntimeError):
+    """The watchdog saw a device step exceed the stall timeout — the
+    ``BackendInitHang`` class of wedge, detected instead of waited out."""
+
+
+class RestartBudgetExceededError(RuntimeError):
+    """Too many decode-loop restarts inside the rolling window; the
+    fault is evidently not transient after all."""
+
+
+class PageLeakError(RuntimeError):
+    """Post-recovery allocator verification failed: pages are still
+    referenced or unaccounted for, so the KV pool cannot be reused."""
+
+
+def wrap_abort(request_id: int, cause: BaseException) -> RequestAbortedError:
+    err = RequestAbortedError(f'request {request_id} aborted: {cause!r}')
+    err.__cause__ = cause
+    return err
+
+
+def classify(exc: BaseException) -> str:
+    """``TRANSIENT`` or ``FATAL`` for an exception out of the decode loop."""
+    if isinstance(exc, (MemoryError, KeyboardInterrupt, SystemExit)):
+        return FATAL
+    if type(exc).__name__ in _FATAL_TYPE_NAMES:
+        return FATAL
+    message = str(exc)
+    if any(marker in message for marker in _FATAL_MESSAGE_MARKERS):
+        return FATAL
+    return TRANSIENT

@@ -29,6 +29,8 @@ from __future__ import annotations
 import collections
 from typing import Dict, List, Optional, Sequence
 
+from skypilot_tpu.utils import chaos
+
 NULL_PAGE = 0
 
 
@@ -81,6 +83,8 @@ class PageAllocator:
         all fit (all-or-nothing, so admission never half-lands)."""
         if n < 0:
             raise ValueError(f'alloc({n})')
+        if n > 0 and chaos.should_inject('alloc_exhaust'):
+            return None
         if n > self.free_pages:
             return None
         out = []
@@ -125,6 +129,40 @@ class PageAllocator:
             self._reclaimable[h] = page
         else:
             self._free.append(page)
+
+    # -- recovery ---------------------------------------------------
+
+    def leak_report(self) -> Optional[str]:
+        """None when every page is accounted for, else a description.
+
+        After the engine releases all slot/prefill pages, the pool must
+        be leak-free: no page referenced, and every non-null page on
+        the free stack or parked in the reclaimable LRU.
+        """
+        problems = []
+        if self._ref:
+            sample = sorted(self._ref)[:4]
+            problems.append(f'{len(self._ref)} page(s) still referenced '
+                            f'(e.g. {sample})')
+        missing = (self.n_pages - 1) - len(self._ref) \
+            - len(self._free) - len(self._reclaimable)
+        if missing:
+            problems.append(f'{missing} page(s) unaccounted for')
+        return '; '.join(problems) or None
+
+    def reset(self) -> None:
+        """Forget all references and prefix registrations.
+
+        For post-failure recovery: the device pool is rebuilt from
+        zeros, so cached prefix contents are gone and registrations
+        must not survive.  ``cannibalized_total`` is a lifetime counter
+        and is deliberately preserved.
+        """
+        self._free = list(range(self.n_pages - 1, 0, -1))
+        self._ref.clear()
+        self._prefix_page.clear()
+        self._page_hash.clear()
+        self._reclaimable.clear()
 
     # -- prefix sharing ---------------------------------------------
 

@@ -16,6 +16,20 @@ http.server, matching the rest of the serve stack (serve/controller.py):
                                ?limit=N caps the count)
   POST /v1/completions      -> OpenAI completions (stream + non-stream)
   POST /v1/chat/completions -> OpenAI chat (stream + non-stream)
+  POST /drain               -> stop admission, finish in-flight work,
+                               then shut the server down (graceful
+                               replica retirement; /health reports
+                               "draining" while it runs)
+
+Failure containment: the decode loop runs SUPERVISED — a transient
+step() failure aborts the in-flight slots, rebuilds the engine's
+device state, and restarts the loop (bounded restarts per rolling
+window); fatal failures (wedged backend, watchdog-detected stall,
+page-accounting leak) mark the replica unhealthy and fail every
+waiter fast.  Every request carries a deadline (payload `deadline_s`,
+default SKYTPU_REQUEST_DEADLINE_S); admission sheds load with 503 +
+Retry-After when the queue is full, the server is draining, or the
+estimated queue wait already exceeds the request's deadline.
 
 Every request gets an id (the client's X-Request-Id when it is a sane
 token, else a generated one), echoed in the X-Request-Id response
@@ -43,6 +57,7 @@ Run: python -m skypilot_tpu.infer.server --model llama-tiny --port 8000
 from __future__ import annotations
 
 import argparse
+import collections
 import http.server
 import json
 import os
@@ -55,7 +70,10 @@ from typing import Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.infer import failures
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import retry as retry_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -67,9 +85,24 @@ _HTTPServer = http_utils.HighBacklogHTTPServer
 # Known routes by method.  Unknown paths collapse to the 'other' route
 # label so a URL-scanning client cannot mint unbounded label sets.
 _GET_ROUTES = ('/health', '/v1/models', '/metrics', '/traces')
-_POST_ROUTES = ('/generate', '/v1/completions', '/v1/chat/completions')
+_POST_ROUTES = ('/generate', '/v1/completions', '/v1/chat/completions',
+                '/drain')
 
 _REQUEST_ID_RE = re.compile(r'[A-Za-z0-9._:-]{1,64}$')
+
+# /health status -> skytpu_health_state gauge value.
+_HEALTH_STATES = {'ok': 0.0, 'draining': 1.0, 'unhealthy': 2.0}
+
+
+class _Shed(Exception):
+    """Admission-time load shed: becomes a 503 with a Retry-After
+    header instead of queueing work the request's deadline cannot
+    survive."""
+
+    def __init__(self, message: str, reason: str, retry_after: int = 1):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after = retry_after
 
 
 def _http_metrics(registry: Optional[metrics_lib.Registry] = None):
@@ -86,6 +119,33 @@ def _http_metrics(registry: Optional[metrics_lib.Registry] = None):
             'Wall-clock seconds per HTTP request (includes queueing '
             'and generation on blocking routes).',
             labelnames=('method', 'route')),
+    }
+
+
+def _failure_metrics(registry: Optional[metrics_lib.Registry] = None):
+    """Failure-containment series for the supervised decode loop."""
+    r = registry if registry is not None else metrics_lib.get_registry()
+    return {
+        'restarts': r.counter(
+            'skytpu_decode_loop_restarts_total',
+            'Supervised decode-loop restarts after a transient step '
+            'failure (in-flight slots aborted, device state rebuilt).'),
+        'stalls': r.counter(
+            'skytpu_decode_stalls_detected_total',
+            'Hung device steps detected by the watchdog (step exceeded '
+            'SKYTPU_STEP_STALL_TIMEOUT_S; replica marked unhealthy).'),
+        'shed': r.counter(
+            'skytpu_requests_shed_total',
+            'Requests rejected at admission (503 + Retry-After), by '
+            'reason.',
+            labelnames=('reason',)),
+        'health': r.gauge(
+            'skytpu_health_state',
+            'Replica health as reported by /health: 0=ok, 1=draining, '
+            '2=unhealthy.'),
+        # Registered eagerly (chaos itself lazily get-or-creates it on
+        # first injection) so /metrics always exposes the series.
+        'chaos': chaos.register_metric(r),
     }
 
 
@@ -108,7 +168,12 @@ class InferenceServer:
                  tokenizer: Optional[str] = None,
                  allow_random_weights: bool = False,
                  served_model_name: Optional[str] = None,
-                 registry: Optional[metrics_lib.Registry] = None
+                 registry: Optional[metrics_lib.Registry] = None,
+                 default_deadline_s: Optional[float] = None,
+                 max_queue_depth: Optional[int] = None,
+                 stall_timeout_s: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 restart_window_s: Optional[float] = None,
                  ) -> None:
         from skypilot_tpu.parallel import mesh as mesh_lib
         # Hang-proof first backend touch: a wedged tunneled TPU makes
@@ -185,32 +250,244 @@ class InferenceServer:
         self._decode_thread: Optional[threading.Thread] = None
         self._work = threading.Event()
         self._fatal: Optional[BaseException] = None
+        # -- failure containment (ctor args override the env knobs) ---
+        self.default_deadline_s = (
+            default_deadline_s if default_deadline_s is not None else
+            float(os.environ.get('SKYTPU_REQUEST_DEADLINE_S', '600')))
+        self.max_queue_depth = (
+            max_queue_depth if max_queue_depth is not None else
+            int(os.environ.get('SKYTPU_MAX_QUEUE_DEPTH',
+                               str(8 * max_batch_size))))
+        self.stall_timeout_s = (
+            stall_timeout_s if stall_timeout_s is not None else
+            float(os.environ.get('SKYTPU_STEP_STALL_TIMEOUT_S', '120')))
+        self.max_restarts = (
+            max_restarts if max_restarts is not None else
+            int(os.environ.get('SKYTPU_LOOP_MAX_RESTARTS', '5')))
+        self.restart_window_s = (
+            restart_window_s if restart_window_s is not None else
+            float(os.environ.get('SKYTPU_LOOP_RESTART_WINDOW_S', '60')))
+        self.drain_timeout_s = float(
+            os.environ.get('SKYTPU_DRAIN_TIMEOUT_S', '600'))
+        self.shutdown_join_s = float(
+            os.environ.get('SKYTPU_SHUTDOWN_JOIN_S', '5'))
+        self._fail_met = _failure_metrics(self.registry)
+        self._draining = False
+        self._drain_lock = threading.Lock()
+        self._drain_thread: Optional[threading.Thread] = None
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        # monotonic ts of the step() call in flight, None between steps;
+        # written only by the decode loop, read by the watchdog.
+        self._step_started: Optional[float] = None
+        # Chaos arms AFTER the warmup generate: injected faults must
+        # exercise the supervised loop, not the readiness compile.
+        chaos.init_from_env()
+        self._set_health('ok')
+
+    def _set_health(self, state: str) -> None:
+        self._health = state
+        self._fail_met['health'].set(_HEALTH_STATES[state])
+
+    def _fail_replica(self, error: BaseException) -> None:
+        """Terminal: mark unhealthy, stop the loop, fail every waiter
+        fast.  The readiness probe (503 /health) stops routing here;
+        recovery is a process restart."""
+        self._fatal = error
+        self._running = False
+        self._set_health('unhealthy')
+        self.engine.abort(error)
 
     def _decode_loop(self) -> None:
-        """Single driver of ContinuousBatchingEngine.step(): decodes
-        while any slot is occupied, sleeps on the work event when
-        idle.  Handler threads only submit()/wait().  A fatal step()
-        error (device wedge, OOM) marks the replica UNHEALTHY — the
-        readiness probe must stop routing here, and waiters must fail
-        fast instead of blocking their full timeout."""
-        try:
-            while self._running:
-                if not self.engine.step():
-                    self._work.wait(0.05)
+        """SUPERVISED driver of ContinuousBatchingEngine.step().
+
+        Decodes while any slot is occupied, sleeps on the work event
+        when idle.  Handler threads only submit()/wait().  When step()
+        raises, the supervisor classifies the failure:
+
+        * transient — abort the in-flight slots (waiters get
+          RequestAbortedError immediately), rebuild the engine's device
+          state (donated buffers are invalid mid-step), verify the page
+          allocator is leak-free, and restart the loop after a short
+          jittered backoff.  Queued-but-unadmitted requests survive.
+        * fatal (wedged backend, XLA runtime error, page leak) — or
+          more than max_restarts transients inside restart_window_s —
+          the replica goes unhealthy and stays down.
+        """
+        restarts = collections.deque()  # monotonic ts of recent restarts
+        while self._running:
+            try:
+                while self._running:
+                    self._step_started = time.monotonic()
+                    busy = self.engine.step()
+                    self._step_started = None
+                    if not busy:
+                        self._work.wait(0.05)
+                        self._work.clear()
+            except BaseException as e:  # noqa: BLE001 — supervisor sorts it
+                self._step_started = None
+                if not self._running:
+                    break  # shutdown raced the failure; nothing to save
+                if failures.classify(e) == failures.FATAL:
+                    logger.exception(
+                        'decode loop hit a fatal error; marking unhealthy')
+                    self._fail_replica(e)
+                    return
+                now = time.monotonic()
+                while restarts and \
+                        now - restarts[0] > self.restart_window_s:
+                    restarts.popleft()
+                restarts.append(now)
+                if len(restarts) > self.max_restarts:
+                    self._fail_replica(
+                        failures.RestartBudgetExceededError(
+                            f'{len(restarts)} decode-loop restarts '
+                            f'within {self.restart_window_s:.0f}s '
+                            f'(budget {self.max_restarts}); last '
+                            f'error: {e!r}'))
+                    return
+                logger.exception(
+                    'decode step failed (transient); aborting in-flight '
+                    'slots and rebuilding device state')
+                try:
+                    self.engine.recover(e)
+                except BaseException as rec_err:  # noqa: BLE001
+                    logger.exception('engine recovery failed')
+                    self._fail_replica(rec_err)
+                    return
+                self._fail_met['restarts'].inc()
+                delay = retry_lib.compute_delay(
+                    len(restarts) - 1, base_delay_s=0.05, max_delay_s=2.0)
+                if delay > 0:
+                    self._work.wait(delay)  # interruptible backoff
                     self._work.clear()
-        except BaseException as e:  # noqa: BLE001 — replica is dead
-            logger.exception('decode loop died; marking unhealthy')
-            self._fatal = e
-            self._running = False
-            self.engine.abort(e)
+
+    def _watchdog_loop(self) -> None:
+        """Off-thread heartbeat check: a device step that exceeds
+        stall_timeout_s (the BackendInitHang class of wedge — the call
+        never returns, so the decode loop cannot notice on its own)
+        becomes a detected stall.  Waiters fail fast instead of
+        blocking out their full deadline on a dead replica."""
+        poll = max(0.01, min(self.stall_timeout_s / 4.0, 1.0))
+        while not self._stop_evt.wait(poll):
+            started = self._step_started
+            if started is None:
+                continue
+            elapsed = time.monotonic() - started
+            if elapsed <= self.stall_timeout_s:
+                continue
+            self._fail_met['stalls'].inc()
+            err = failures.StepStallError(
+                f'device step exceeded {self.stall_timeout_s:.1f}s '
+                f'(running {elapsed:.1f}s); replica presumed wedged')
+            logger.error(str(err))
+            self._fail_replica(err)
+            # If the "stall" was an injected chaos hang, unwind it so
+            # the decode thread can observe _running=False and exit.
+            chaos.release_hangs()
+            return
 
     @property
     def port(self) -> int:
         assert self._server is not None
         return self._server.server_address[1]
 
+    # -- deadlines + load shedding ------------------------------------
+    def _deadline_from(self, payload: dict) -> float:
+        """Pop the request's `deadline_s` (seconds from now) off the
+        payload, defaulting to SKYTPU_REQUEST_DEADLINE_S.  Popped so
+        the OpenAI parsers never see the extension key."""
+        raw = payload.pop('deadline_s', None)
+        if raw is None:
+            return self.default_deadline_s
+        try:
+            deadline_s = float(raw)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f'deadline_s must be a positive number of seconds, '
+                f'got {raw!r}') from None
+        if deadline_s <= 0:
+            raise ValueError(
+                f'deadline_s must be > 0, got {deadline_s}')
+        return deadline_s
+
+    def _retry_after_s(self) -> int:
+        est = self.engine.estimate_queue_wait_s() if self.continuous \
+            else 0.0
+        return max(1, min(int(est), 60)) if est else 1
+
+    def _admission_check(self, deadline_s: float, n: int = 1) -> None:
+        """Shed (raise _Shed -> 503 + Retry-After) instead of admitting
+        work that cannot meet its deadline: the client's retry beats a
+        queue slot that expires before prefill."""
+        if self._draining:
+            raise _Shed('server is draining; no new work accepted',
+                        reason='draining', retry_after=30)
+        if not self.continuous:
+            return
+        depth = self.engine.queue_depth
+        if depth + n > self.max_queue_depth:
+            raise _Shed(
+                f'queue full ({depth} queued, limit '
+                f'{self.max_queue_depth})',
+                reason='queue_full', retry_after=self._retry_after_s())
+        est = self.engine.estimate_queue_wait_s()
+        if est > deadline_s:
+            raise _Shed(
+                f'estimated queue wait {est:.1f}s exceeds the request '
+                f'deadline of {deadline_s:.1f}s',
+                reason='deadline_unmeetable',
+                retry_after=self._retry_after_s())
+        alloc = getattr(self.engine, '_alloc', None)
+        if alloc is not None and alloc.free_pages == 0 and \
+                depth >= self.engine.n_slots:
+            raise _Shed(
+                'KV page pool exhausted with a deep admission queue',
+                reason='no_free_pages',
+                retry_after=self._retry_after_s())
+
+    # -- graceful drain -----------------------------------------------
+    def begin_drain(self) -> dict:
+        """Stop admission (everything new sheds with 503), let
+        in-flight work finish, then shut the server down.  Idempotent;
+        /health reports "draining" until exit."""
+        with self._drain_lock:
+            first = not self._draining
+            self._draining = True
+        if first:
+            logger.info('drain requested: admission stopped, waiting '
+                        'for in-flight work')
+            self._set_health('draining')
+            t = threading.Thread(target=self._drain_then_exit,
+                                 daemon=True, name='skytpu-drain')
+            self._drain_thread = t
+            t.start()
+        return {'status': 'draining',
+                'in_flight': self.engine.traces.inflight_count}
+
+    def _drain_then_exit(self) -> None:
+        deadline = time.monotonic() + self.drain_timeout_s
+        while time.monotonic() < deadline:
+            if self._fatal is not None:
+                break  # replica died mid-drain; nothing left to wait on
+            done = self.engine.traces.inflight_count == 0
+            if done and self.continuous:
+                done = self.engine.is_idle()
+            if done:
+                break
+            time.sleep(0.05)
+        else:
+            logger.warning(
+                f'drain timed out after {self.drain_timeout_s:.0f}s '
+                f'with {self.engine.traces.inflight_count} request(s) '
+                'still in flight; shutting down anyway')
+        time.sleep(0.2)  # let handler threads flush their responses
+        logger.info('drain complete; shutting down')
+        self.shutdown()
+
     def _handle_generate(self, payload: dict,
                          http_request_id: Optional[str] = None) -> dict:
+        deadline_s = self._deadline_from(payload)
         prompts = payload.get('prompt_ids')
         if not isinstance(prompts, list) or not prompts:
             raise ValueError('prompt_ids must be a non-empty list of '
@@ -223,19 +500,22 @@ class InferenceServer:
             max_new_tokens=int(payload.get('max_new_tokens', 64)),
             seed=(int(payload['seed'])
                   if payload.get('seed') is not None else None))
+        self._admission_check(deadline_s, n=len(prompts))
         if self.continuous:
             # All-or-nothing: a rejected prompt (e.g. overlong) must
             # not strand its siblings decoding with no reader.
             rids = []
             try:
                 for p in prompts:
-                    rid = self.engine.submit(p, sampling)
+                    rid = self.engine.submit(p, sampling,
+                                             deadline_s=deadline_s)
                     rids.append(rid)
                     self.engine.traces.annotate(
                         rid, http_request_id=http_request_id)
                 self._work.set()
-                tokens = [self.engine.wait(r, timeout=600)
-                          for r in rids]
+                # No explicit timeout: wait() derives it from the
+                # request's own deadline.
+                tokens = [self.engine.wait(r) for r in rids]
             except BaseException:
                 for r in rids:
                     self.engine.cancel(r)
@@ -253,15 +533,19 @@ class InferenceServer:
             max_new_tokens=req.max_tokens, seed=req.seed)
 
     def _openai_blocking(self, req, prompt_ids,
-                         http_request_id: Optional[str] = None) -> dict:
+                         http_request_id: Optional[str] = None,
+                         deadline_s: Optional[float] = None) -> dict:
         from skypilot_tpu.infer import openai_api
         sampling = self._sampling_for(req)
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
         if self.continuous:
-            rid = self.engine.submit(prompt_ids, sampling)
+            rid = self.engine.submit(prompt_ids, sampling,
+                                     deadline_s=deadline_s)
             self.engine.traces.annotate(
                 rid, http_request_id=http_request_id)
             self._work.set()
-            toks = self.engine.wait(rid, timeout=600)
+            toks = self.engine.wait(rid)
         else:
             with self._lock:
                 toks = self.engine.generate([prompt_ids], sampling)[0]
@@ -275,7 +559,8 @@ class InferenceServer:
             req, text, finish, prompt_tokens=len(prompt_ids),
             completion_tokens=len(toks))
 
-    def _openai_stream(self, req, prompt_ids, handler) -> None:
+    def _openai_stream(self, req, prompt_ids, handler,
+                       deadline_s: Optional[float] = None) -> None:
         """SSE: one `data:` event per decoded text fragment, riding
         the engine's per-token stream queue; ends with the
         finish_reason chunk and `data: [DONE]`."""
@@ -283,7 +568,10 @@ class InferenceServer:
         from skypilot_tpu.infer import tokenizer as tokenizer_lib
         sampling = self._sampling_for(req)
         http_rid = getattr(handler, 'request_id', None)
-        rid = self.engine.submit(prompt_ids, sampling, stream=True)
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        rid = self.engine.submit(prompt_ids, sampling, stream=True,
+                                 deadline_s=deadline_s)
         self.engine.traces.annotate(rid, http_request_id=http_rid)
         self._work.set()
 
@@ -324,6 +612,9 @@ class InferenceServer:
                 _sse(openai_api.stream_chunk(req, None, first=True))
             for tok in self.engine.stream(
                     rid, timeout=self.stream_token_timeout):
+                if chaos.should_inject('client_disconnect'):
+                    raise BrokenPipeError(
+                        'chaos: simulated client disconnect')
                 n_tokens += 1
                 if eos is not None and tok == eos:
                     eos_hit = True
@@ -378,6 +669,7 @@ class InferenceServer:
         """Returns a JSON body to reply with, or None if the handler
         already streamed the response itself."""
         from skypilot_tpu.infer import openai_api
+        deadline_s = self._deadline_from(payload)
         parse = openai_api.parse_chat_request if chat else \
             openai_api.parse_completion_request
         req = parse(payload, self.model_name)
@@ -385,15 +677,19 @@ class InferenceServer:
         if not prompt_ids:
             raise openai_api.OpenAIError(
                 'prompt encodes to zero tokens')
+        # Shed before any work (and before SSE headers go out on the
+        # stream path — a 503 must still be expressible).
+        self._admission_check(deadline_s)
         if req.stream:
             if not self.continuous:
                 raise openai_api.OpenAIError(
                     'stream=true requires continuous batching '
                     '(server started with --no-continuous)')
-            self._openai_stream(req, prompt_ids, handler)
+            self._openai_stream(req, prompt_ids, handler, deadline_s)
             return None
         return self._openai_blocking(
-            req, prompt_ids, getattr(handler, 'request_id', None))
+            req, prompt_ids, getattr(handler, 'request_id', None),
+            deadline_s)
 
     def serve_forever(self) -> None:
         self.start()
@@ -422,13 +718,16 @@ class InferenceServer:
                 self._last_code = code
 
             def _reply(self, code: int, body: dict,
-                       allow: Optional[str] = None) -> None:
+                       allow: Optional[str] = None,
+                       retry_after: Optional[int] = None) -> None:
                 data = json.dumps(body).encode()
                 self.send_response(code)
                 self.send_header('Content-Type', 'application/json')
                 self.send_header('Content-Length', str(len(data)))
                 if allow is not None:
                     self.send_header('Allow', allow)
+                if retry_after is not None:
+                    self.send_header('Retry-After', str(retry_after))
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -468,6 +767,10 @@ class InferenceServer:
                         self._reply(503, {
                             'status': 'unhealthy',
                             'error': repr(outer._fatal)})  # pylint: disable=protected-access
+                    elif outer._draining:  # pylint: disable=protected-access
+                        # 503 so the router stops sending traffic while
+                        # in-flight work finishes.
+                        self._reply(503, {'status': 'draining'})
                     else:
                         self._reply(200, {'status': 'ok'})
                 elif route == '/v1/models':
@@ -515,6 +818,9 @@ class InferenceServer:
                 try:
                     length = int(self.headers.get('Content-Length', 0))
                     payload = json.loads(self.rfile.read(length) or b'{}')
+                    if route == '/drain':
+                        self._reply(200, outer.begin_drain())
+                        return
                     if route == '/generate':
                         self._reply(200, outer._handle_generate(  # pylint: disable=protected-access
                             payload, self.request_id))
@@ -524,8 +830,19 @@ class InferenceServer:
                             '/chat/completions'), handler=self)
                     if body is not None:
                         self._reply(200, body)
+                except _Shed as e:
+                    outer._fail_met['shed'].labels(  # pylint: disable=protected-access
+                        reason=e.reason).inc()
+                    self._reply(503, {'error': str(e),
+                                      'reason': e.reason},
+                                retry_after=e.retry_after)
                 except openai_api.OpenAIError as e:
                     self._reply(e.status, e.body())
+                except TimeoutError as e:
+                    # Includes failures.DeadlineExceededError: the
+                    # request missed its deadline (queued too long or
+                    # decode too slow) — a gateway-timeout, not a 500.
+                    self._reply(504, {'error': str(e)})
                 except ValueError as e:
                     if route == '/generate':
                         self._reply(400, {'error': str(e)})
@@ -543,13 +860,36 @@ class InferenceServer:
                 target=self._decode_loop, daemon=True,
                 name='skytpu-decode-loop')
             self._decode_thread.start()
+            if self.stall_timeout_s > 0 and \
+                    self._watchdog_thread is None:
+                self._watchdog_thread = threading.Thread(
+                    target=self._watchdog_loop, daemon=True,
+                    name='skytpu-step-watchdog')
+                self._watchdog_thread.start()
 
     def shutdown(self) -> None:
+        # Flip the run flag and wake the decode loop BEFORE joining it
+        # (joining first would deadlock a loop parked on the work
+        # event until its 50ms poll fired).
         self._running = False
+        self._stop_evt.set()
         self._work.set()
+        chaos.release_hangs()
         if self._decode_thread is not None:
-            self._decode_thread.join(timeout=5)
+            self._decode_thread.join(timeout=self.shutdown_join_s)
+            if self._decode_thread.is_alive():
+                # A hung device step cannot be interrupted from Python;
+                # the thread is a daemon, so leaking it is survivable —
+                # but say so instead of silently pretending it joined.
+                logger.warning(
+                    f'decode thread still alive after '
+                    f'{self.shutdown_join_s:.1f}s join timeout '
+                    '(likely a hung device step); leaking the daemon '
+                    'thread')
             self._decode_thread = None
+        if self._watchdog_thread is not None:
+            self._watchdog_thread.join(timeout=self.shutdown_join_s)
+            self._watchdog_thread = None
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

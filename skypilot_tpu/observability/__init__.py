@@ -44,15 +44,22 @@ METRIC_CONTRACT = frozenset({
     'skytpu_request_queue_seconds',
     'skytpu_request_tpot_seconds',
     'skytpu_request_ttft_seconds',
+    'skytpu_request_deadline_expired_total',
     'skytpu_requests_aborted_total',
     'skytpu_requests_cancelled_total',
     'skytpu_requests_evicted_total',
     'skytpu_requests_finished_total',
     'skytpu_requests_in_flight',
     'skytpu_requests_submitted_total',
-    # infer/server.py — HTTP surface
+    # infer/server.py — HTTP surface + failure containment
+    'skytpu_decode_loop_restarts_total',
+    'skytpu_decode_stalls_detected_total',
+    'skytpu_health_state',
     'skytpu_http_request_seconds',
     'skytpu_http_requests_total',
+    'skytpu_requests_shed_total',
+    # utils/chaos.py — fault injection
+    'skytpu_chaos_injections_total',
     # train/trainer.py — training loop
     'skytpu_train_step_seconds',
     'skytpu_train_steps_total',

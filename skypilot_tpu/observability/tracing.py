@@ -43,6 +43,9 @@ class RequestTrace:
     finished_ts: Optional[float] = None
     output_tokens: int = 0
     shared_prefix_tokens: int = 0
+    # repr() of the failure for 'cancelled'/'aborted' terminals that
+    # have one (deadline expiry, recovery abort); None on clean exits.
+    error: Optional[str] = None
 
     # -- derived latencies --------------------------------------------
     def queue_seconds(self) -> Optional[float]:
@@ -142,7 +145,8 @@ class TraceStore:
             self._emit_event(now, request_id, name, **fields)
 
     def finish(self, request_id: int, state: str,
-               output_tokens: Optional[int] = None
+               output_tokens: Optional[int] = None,
+               error: Optional[str] = None
                ) -> Optional[RequestTrace]:
         """Move a trace to a terminal state; idempotent per request."""
         assert state in TERMINAL_STATES, state
@@ -155,12 +159,15 @@ class TraceStore:
             trace.state = state
             if output_tokens is not None:
                 trace.output_tokens = output_tokens
+            if error is not None:
+                trace.error = error
             self._completed.append(trace)
         self._emit_event(now, request_id, state,
                          output_tokens=trace.output_tokens)
         return trace
 
-    def abort_all(self, state: str = 'aborted') -> List[RequestTrace]:
+    def abort_all(self, state: str = 'aborted',
+                  error: Optional[str] = None) -> List[RequestTrace]:
         """Terminate every in-flight trace (engine fatal / shutdown)."""
         now = time.time()
         with self._lock:
@@ -169,6 +176,8 @@ class TraceStore:
             for t in traces:
                 t.finished_ts = now
                 t.state = state
+                if error is not None:
+                    t.error = error
                 self._completed.append(t)
         for t in traces:
             self._emit_event(now, t.request_id, state,

@@ -122,32 +122,46 @@ def _devices_with_retry() -> Sequence[jax.Device]:
     SKYTPU_BACKEND_INIT_RETRIES (default 3 extra attempts),
     SKYTPU_BACKEND_INIT_BACKOFF_S (default 5, doubled per attempt),
     SKYTPU_BACKEND_INIT_TIMEOUT_S (default 180; 0 disables watchdog).
+
+    The loop itself is utils/retry.retry_with_backoff; the hang class
+    rides its `fatal` channel (raised unchanged, never retried).
     """
     import os
-    import time
+
+    from skypilot_tpu.utils import retry as retry_lib
 
     retries = int(os.environ.get('SKYTPU_BACKEND_INIT_RETRIES', '3'))
     backoff = float(os.environ.get('SKYTPU_BACKEND_INIT_BACKOFF_S', '5'))
     timeout_s = float(os.environ.get('SKYTPU_BACKEND_INIT_TIMEOUT_S',
                                      '180'))
-    last_exc: Optional[Exception] = None
-    for attempt in range(retries + 1):
-        if attempt:
-            logger.warning(
-                f'TPU backend init failed ({last_exc}); retrying in '
-                f'{backoff:.0f}s (attempt {attempt}/{retries}).')
-            time.sleep(backoff)
-            backoff *= 2
+    state = {'attempt': 0}
+
+    def _attempt() -> Sequence[jax.Device]:
+        state['attempt'] += 1
+        if state['attempt'] > 1:
+            # JAX caches a failed platform init; clear it before the
+            # retry touches the device list again.
             _clear_backends_best_effort()
-        try:
-            return _touch_devices(timeout_s)
-        except BackendInitHang:
-            raise
-        except RuntimeError as e:  # jax wraps init failures in this
-            last_exc = e
-    raise RuntimeError(
-        f'TPU backend unavailable after {retries + 1} attempts: '
-        f'{last_exc}') from last_exc
+        return _touch_devices(timeout_s)
+
+    def _log(attempt: int, exc: BaseException, will_retry: bool,
+             delay: float) -> None:
+        if will_retry:
+            logger.warning(
+                f'TPU backend init failed ({exc}); retrying in '
+                f'{delay:.0f}s (attempt {attempt}/{retries + 1}).')
+
+    try:
+        return retry_lib.retry_with_backoff(
+            _attempt, max_attempts=retries + 1, base_delay_s=backoff,
+            factor=2.0, jitter='none',
+            retry_on=(RuntimeError,),  # jax wraps init failures in this
+            fatal=(BackendInitHang, KeyboardInterrupt, SystemExit),
+            on_failure=_log, describe='TPU backend init')
+    except retry_lib.RetryError as e:
+        raise RuntimeError(
+            f'TPU backend unavailable after {e.attempts} attempts: '
+            f'{e.last}') from e.last
 
 
 # Public name — bench.py and the trainer route their first backend

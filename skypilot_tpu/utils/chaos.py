@@ -1,0 +1,233 @@
+"""Chaos fault injection for the serving engine.
+
+Real TPU wedges (the ``BackendInitHang`` class, see BENCH_r03–r05) are
+too flaky to be a test fixture, so the failure-containment machinery is
+proven against *injected* faults instead.  Each fault point is a named
+site in the serving stack:
+
+==================  ====================================================
+``step_raise``      raise from the top of ``ContinuousBatchingEngine
+                    .step()`` — a transient device/step error
+``step_hang``       block inside ``step()`` for ``hang_s`` — a hung
+                    device call the watchdog must detect
+``alloc_exhaust``   ``PageAllocator.alloc`` reports exhaustion — the
+                    admission backpressure path
+``prefill_raise``   raise from the chunked-prefill forward — a
+                    per-request containable failure
+``client_disconnect``  the SSE write loop sees a broken pipe — the
+                    cancel-on-disconnect path
+==================  ====================================================
+
+Schedules come from ``SKYTPU_CHAOS`` (or :func:`configure` in tests):
+faults separated by ``;``, parameters by ``,``::
+
+    SKYTPU_CHAOS='step_raise:p=0.02,seed=7;step_hang:p=1,n=1,hang_s=0.5'
+
+``p`` is the per-visit injection probability (default 1.0), ``seed``
+makes the draw deterministic (default: derived from the point name),
+``n`` caps the number of injections (default: unbounded), ``hang_s``
+is the stall length for hang faults (default 30).  Hangs wait on an
+event, so :func:`release_hangs` (and server shutdown) can cut them
+short instead of leaking a sleeping thread.
+
+Disabled is the overwhelmingly common case and follows the
+observability disabled-mode pattern: the module-level controller is
+``None`` and every check is one global read plus an ``is None`` test —
+no parsing, no rng, no locks on the hot path.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, Optional
+
+__all__ = ['FAULT_POINTS', 'ChaosError', 'ChaosController', 'active',
+           'configure', 'disable', 'init_from_env', 'injection_counts',
+           'maybe_hang', 'maybe_raise', 'release_hangs', 'should_inject']
+
+FAULT_POINTS = ('step_raise', 'step_hang', 'alloc_exhaust',
+                'prefill_raise', 'client_disconnect')
+
+ENV_VAR = 'SKYTPU_CHAOS'
+
+
+class ChaosError(RuntimeError):
+    """An injected fault.  Transient by classification: the supervised
+    decode loop must recover from it, never die of it."""
+
+
+class _FaultSpec:
+
+    def __init__(self, name: str, p: float = 1.0, seed: Optional[int] = None,
+                 n: Optional[int] = None, hang_s: float = 30.0):
+        if name not in FAULT_POINTS:
+            raise ValueError(
+                f'unknown chaos fault point {name!r}; known points: '
+                f'{", ".join(FAULT_POINTS)}')
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f'{name}: p must be in [0, 1], got {p}')
+        self.name = name
+        self.p = p
+        self.n = n
+        self.hang_s = hang_s
+        if seed is None:
+            # Deterministic default so two processes with the same
+            # schedule string take the same fault trajectory.
+            seed = sum(ord(c) for c in name)
+        self.rng = random.Random(seed)
+        self.fired = 0
+
+
+class ChaosController:
+    """Holds the parsed schedule and draws injection decisions.
+
+    Thread-safe: decisions are drawn under a lock because the decode
+    thread, the watchdog, and HTTP handler threads all pass through
+    fault points.  Only ever touched when chaos is enabled.
+    """
+
+    def __init__(self, specs: Dict[str, _FaultSpec]):
+        self._specs = specs
+        self._mu = threading.Lock()
+        self._release = threading.Event()
+
+    def should_inject(self, point: str) -> bool:
+        spec = self._specs.get(point)
+        if spec is None:
+            return False
+        with self._mu:
+            if spec.n is not None and spec.fired >= spec.n:
+                return False
+            if spec.p < 1.0 and spec.rng.random() >= spec.p:
+                return False
+            spec.fired += 1
+        _count_injection(point)
+        return True
+
+    def maybe_raise(self, point: str) -> None:
+        if self.should_inject(point):
+            raise ChaosError(f'chaos: injected fault at {point!r}')
+
+    def maybe_hang(self, point: str) -> None:
+        spec = self._specs.get(point)
+        if spec is not None and self.should_inject(point):
+            # Interruptible: release_hangs() ends the stall early.
+            self._release.wait(spec.hang_s)
+
+    def release_hangs(self) -> None:
+        self._release.set()
+
+    def injection_counts(self) -> Dict[str, int]:
+        with self._mu:
+            return {name: spec.fired
+                    for name, spec in self._specs.items() if spec.fired}
+
+
+def register_metric(registry=None):
+    """Get-or-create the injection counter (the server registers it
+    eagerly so /metrics always exposes the series, even at zero)."""
+    # Imported lazily so the disabled path never touches observability.
+    from skypilot_tpu.observability import metrics
+    r = registry if registry is not None else metrics.get_registry()
+    return r.counter(
+        'skytpu_chaos_injections_total',
+        'Faults actually injected by the chaos schedule, by point.',
+        labelnames=('point',))
+
+
+def _count_injection(point: str) -> None:
+    register_metric().labels(point=point).inc()
+
+
+def _parse_schedule(schedule: str) -> Dict[str, _FaultSpec]:
+    specs: Dict[str, _FaultSpec] = {}
+    for clause in schedule.split(';'):
+        clause = clause.strip()
+        if not clause:
+            continue
+        name, _, params = clause.partition(':')
+        name = name.strip()
+        kwargs = {}
+        for pair in filter(None, (p.strip() for p in params.split(','))):
+            key, sep, value = pair.partition('=')
+            if not sep:
+                raise ValueError(
+                    f'chaos schedule parameter {pair!r} is not key=value')
+            key = key.strip()
+            if key == 'p':
+                kwargs['p'] = float(value)
+            elif key == 'seed':
+                kwargs['seed'] = int(value)
+            elif key == 'n':
+                kwargs['n'] = int(value)
+            elif key == 'hang_s':
+                kwargs['hang_s'] = float(value)
+            else:
+                raise ValueError(
+                    f'unknown chaos parameter {key!r} for {name!r} '
+                    f"(known: p, seed, n, hang_s)")
+        specs[name] = _FaultSpec(name, **kwargs)
+    if not specs:
+        raise ValueError(f'empty chaos schedule: {schedule!r}')
+    return specs
+
+
+_controller: Optional[ChaosController] = None
+
+
+def configure(schedule: str) -> ChaosController:
+    """Parse ``schedule`` and install it as the process-wide controller."""
+    global _controller
+    controller = ChaosController(_parse_schedule(schedule))
+    _controller = controller
+    return controller
+
+
+def disable() -> None:
+    global _controller
+    if _controller is not None:
+        _controller.release_hangs()
+    _controller = None
+
+
+def init_from_env(environ=None) -> Optional[ChaosController]:
+    """Install a controller from ``SKYTPU_CHAOS`` if set (else no-op)."""
+    import os
+    schedule = (environ or os.environ).get(ENV_VAR, '').strip()
+    if not schedule:
+        return None
+    return configure(schedule)
+
+
+def active() -> bool:
+    return _controller is not None
+
+
+# -- Hot-path checks: one global read + None test when disabled. ------
+
+def should_inject(point: str) -> bool:
+    controller = _controller
+    return controller is not None and controller.should_inject(point)
+
+
+def maybe_raise(point: str) -> None:
+    controller = _controller
+    if controller is not None:
+        controller.maybe_raise(point)
+
+
+def maybe_hang(point: str) -> None:
+    controller = _controller
+    if controller is not None:
+        controller.maybe_hang(point)
+
+
+def release_hangs() -> None:
+    controller = _controller
+    if controller is not None:
+        controller.release_hangs()
+
+
+def injection_counts() -> Dict[str, int]:
+    controller = _controller
+    return {} if controller is None else controller.injection_counts()

@@ -1,0 +1,134 @@
+"""Shared retry: exponential backoff, full jitter, budget-aware giving up.
+
+One implementation behind the three places that used to hand-roll the
+same loop (``parallel/mesh._devices_with_retry``, ``bench.py``'s e2e and
+direct attempt ladders) plus the decode-loop supervisor's restart
+backoff.  The shape follows the AWS "exponential backoff and jitter"
+guidance: delay for the k-th retry is ``base * factor**k`` capped at
+``max_delay_s``, and with ``jitter='full'`` the actual nap is uniform in
+``[0, delay]`` so a fleet of restarting clients decorrelates.
+
+Budget awareness: callers with a wall-clock budget pass ``remaining_s``
+(a callable, so it is re-read at decision time) and ``min_attempt_s``
+(the least time an attempt is worth starting with).  The loop gives up
+when the budget cannot fund another attempt, and skips the nap — retrying
+back-to-back — when the attempt still fits but the nap would starve it.
+
+This module is the one sanctioned home for long sleeps inside retry
+loops; skylint's ``sleep-discipline`` rule flags constant
+``time.sleep(>=30)`` in loops everywhere else in the tree.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ['RetryError', 'compute_delay', 'retry_with_backoff']
+
+
+class RetryError(RuntimeError):
+    """All attempts failed (or the budget ran out).
+
+    ``attempts`` is how many attempts actually ran (0 when the budget
+    was exhausted before the first one); ``last`` is the final
+    exception, also chained as ``__cause__``.
+    """
+
+    def __init__(self, message: str, attempts: int,
+                 last: Optional[BaseException]):
+        super().__init__(message)
+        self.attempts = attempts
+        self.last = last
+
+
+def compute_delay(retry_index: int,
+                  base_delay_s: float,
+                  factor: float = 2.0,
+                  max_delay_s: Optional[float] = None,
+                  jitter: str = 'full',
+                  rng: Optional[random.Random] = None) -> float:
+    """Backoff delay before retry number ``retry_index`` (0-based)."""
+    delay = base_delay_s * (factor ** retry_index)
+    if max_delay_s is not None:
+        delay = min(delay, max_delay_s)
+    if jitter == 'full':
+        delay = (rng or random).uniform(0.0, delay)
+    elif jitter != 'none':
+        raise ValueError(f"jitter must be 'full' or 'none', got {jitter!r}")
+    return max(0.0, delay)
+
+
+def retry_with_backoff(
+        fn: Callable[[], object],
+        *,
+        max_attempts: int = 4,
+        base_delay_s: float = 1.0,
+        factor: float = 2.0,
+        max_delay_s: Optional[float] = None,
+        jitter: str = 'full',
+        retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+        fatal: Tuple[Type[BaseException], ...] = (KeyboardInterrupt,
+                                                  SystemExit),
+        remaining_s: Optional[Callable[[], float]] = None,
+        min_attempt_s: float = 0.0,
+        on_failure: Optional[Callable[[int, BaseException, bool, float],
+                                      None]] = None,
+        sleep: Optional[Callable[[float], None]] = None,
+        rng: Optional[random.Random] = None,
+        describe: str = 'operation'):
+    """Call ``fn()`` until it succeeds, with backoff between attempts.
+
+    Raises the exception unchanged when it is in ``fatal`` or not in
+    ``retry_on``; raises :class:`RetryError` (chaining the last
+    exception) once attempts or budget run out.  ``on_failure(attempt,
+    exc, will_retry, delay_s)`` is invoked after every failed attempt —
+    the hook for logging and failure ledgers.  ``sleep`` defaults to
+    ``time.sleep`` resolved at call time (so tests that monkeypatch
+    ``time.sleep`` see the naps).
+    """
+    if max_attempts < 1:
+        raise ValueError('max_attempts must be >= 1')
+    if sleep is None:
+        sleep = time.sleep
+    last: Optional[BaseException] = None
+    attempts_run = 0
+    for attempt in range(1, max_attempts + 1):
+        if remaining_s is not None and remaining_s() < min_attempt_s:
+            break
+        attempts_run += 1
+        try:
+            return fn()
+        except BaseException as exc:  # pylint: disable=broad-except
+            if isinstance(exc, fatal) or not isinstance(exc, retry_on):
+                raise
+            last = exc
+            will_retry = attempt < max_attempts
+            delay = 0.0
+            if will_retry:
+                delay = compute_delay(attempt - 1, base_delay_s,
+                                      factor=factor,
+                                      max_delay_s=max_delay_s,
+                                      jitter=jitter, rng=rng)
+                if remaining_s is not None:
+                    rem = remaining_s()
+                    if rem < min_attempt_s:
+                        will_retry = False
+                        delay = 0.0
+                    elif rem - delay < min_attempt_s:
+                        # The attempt still fits but the nap would
+                        # starve it: retry back-to-back.
+                        delay = 0.0
+            if on_failure is not None:
+                on_failure(attempt, exc, will_retry, delay)
+            if not will_retry:
+                break
+            if delay > 0:
+                sleep(delay)
+    if attempts_run == 0:
+        raise RetryError(
+            f'{describe}: budget exhausted before the first attempt '
+            f'(< {min_attempt_s:.0f}s remaining)', 0, None)
+    raise RetryError(
+        f'{describe} failed after {attempts_run} attempt(s): {last!r}',
+        attempts_run, last) from last

@@ -1,0 +1,412 @@
+"""Failure containment: supervised decode loop, watchdog, deadlines,
+load shedding, and graceful drain.
+
+Server tests do real HTTP round trips so the contract covers the full
+stack (handler -> supervisor -> engine -> registry).
+
+ORDERING MATTERS: chaos schedules are process-global, and a server's
+decode loop free-runs — any live loop consumes injections armed for
+another.  Tests that build their own (function-scoped) server and arm
+chaos therefore run FIRST, before the shared module server exists;
+the module-server tests follow.  Tier-1 runs with -p no:randomly, so
+file order is execution order.
+"""
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.infer import failures
+from skypilot_tpu.infer.server import InferenceServer
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.utils import chaos
+from tests.unit_tests.test_infer import _OVERRIDES
+
+_GREEDY = engine_lib.SamplingConfig(max_new_tokens=4, temperature=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    chaos.disable()
+    yield
+    chaos.disable()
+
+
+def _start_server(**kw):
+    reg = metrics_lib.Registry()
+    srv = InferenceServer(model='llama-tiny', port=0, host='127.0.0.1',
+                          max_batch_size=2,
+                          model_overrides=dict(_OVERRIDES),
+                          allow_random_weights=True, page_size=8,
+                          registry=reg, **kw)
+    srv.start()
+    threading.Thread(target=srv._server.serve_forever,
+                     daemon=True).start()
+    return srv, reg, f'http://127.0.0.1:{srv.port}'
+
+
+def _req(base, path, body=None, method=None, timeout=120):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(base + path, data=data, method=method)
+    try:
+        resp = urllib.request.urlopen(r, timeout=timeout)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _completion(base, prompt='hello failure world', max_tokens=4,
+                **extra):
+    return _req(base, '/v1/completions',
+                body=dict(model='llama-tiny', prompt=prompt,
+                          max_tokens=max_tokens, **extra))
+
+
+def _wait_for(pred, timeout=10.0, what='condition'):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f'timed out waiting for {what}')
+
+
+# -- terminal-failure servers (own server each; run before the module
+# -- server exists so its loop cannot steal the chaos injections) -----
+
+def test_restart_budget_trips_to_unhealthy():
+    srv, reg, base = _start_server(max_restarts=1, restart_window_s=60)
+    try:
+        chaos.configure('step_raise:p=1')  # every step fails
+        _wait_for(lambda: srv._fatal is not None,
+                  what='replica to go unhealthy')
+        assert isinstance(srv._fatal,
+                          failures.RestartBudgetExceededError)
+        # One recover happened before the budget tripped.
+        assert reg.get('skytpu_decode_loop_restarts_total').value == 1
+        assert reg.get('skytpu_health_state').value \
+            == 2.0  # unhealthy
+        code, _, body = _req(base, '/health')
+        assert code == 503
+        assert json.loads(body)['status'] == 'unhealthy'
+        # Dead replica fails new work fast instead of queueing it.
+        chaos.disable()
+        code, _, _ = _completion(base)
+        assert code == 500
+    finally:
+        chaos.disable()
+        srv.shutdown()
+
+
+def test_watchdog_converts_hang_into_detected_stall():
+    srv, reg, base = _start_server(stall_timeout_s=0.3)
+    try:
+        chaos.configure('step_hang:n=1,hang_s=60')
+        _wait_for(lambda: srv._fatal is not None,
+                  what='watchdog to detect the stall')
+        assert isinstance(srv._fatal, failures.StepStallError)
+        assert reg.get(
+            'skytpu_decode_stalls_detected_total').value == 1
+        code, _, body = _req(base, '/health')
+        assert code == 503
+        assert json.loads(body)['status'] == 'unhealthy'
+        # The watchdog released the injected hang so the wedged
+        # decode thread can observe shutdown.
+        _wait_for(lambda: not srv._decode_thread.is_alive(),
+                  what='decode thread to unwind')
+    finally:
+        chaos.disable()
+        srv.shutdown()
+
+
+def test_drain_finishes_inflight_sheds_new_then_exits():
+    srv, reg, base = _start_server(stall_timeout_s=0)  # no watchdog
+    try:
+        # Wedge the decode loop so the in-flight request below cannot
+        # finish until we let it: the drain must hold open for it.
+        chaos.configure('step_hang:n=1,hang_s=120')
+        _wait_for(lambda: srv._step_started is not None
+                  and time.monotonic() - srv._step_started > 0.2,
+                  what='decode loop to wedge on the injected hang')
+        results = []
+        t = threading.Thread(
+            target=lambda: results.append(_completion(base)),
+            daemon=True)
+        t.start()
+        _wait_for(lambda: srv.engine.traces.inflight_count >= 1,
+                  what='request to be registered in flight')
+
+        code, _, body = _req(base, '/drain')
+        assert code == 405  # drain is POST-only
+        code, _, body = _req(base, '/drain', body={})
+        assert code == 200
+        drained = json.loads(body)
+        assert drained['status'] == 'draining'
+        assert drained['in_flight'] >= 1
+
+        code, _, body = _req(base, '/health')
+        assert code == 503
+        assert json.loads(body)['status'] == 'draining'
+
+        # New work is shed with a generous Retry-After while the
+        # in-flight request is still being finished.
+        code, hdrs, body = _completion(base)
+        assert code == 503
+        assert hdrs['Retry-After'] == '30'
+        assert reg.get('skytpu_requests_shed_total').value_for(
+            reason='draining') == 1
+
+        # Drain is idempotent: a second POST reports, doesn't restart.
+        code, _, body = _req(base, '/drain', body={})
+        assert code == 200 and json.loads(body)['status'] == 'draining'
+
+        # Release the hang: the held request completes with a real
+        # answer (drain finished it, did not kill it)...
+        chaos.release_hangs()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        code, _, body = results[0]
+        assert code == 200, body
+        assert json.loads(body)['usage']['completion_tokens'] == 4
+        # ...and the replica then exits cleanly on its own.
+        _wait_for(lambda: srv._decode_thread is None,
+                  what='drain to shut the server down')
+        assert not srv._running
+    finally:
+        chaos.disable()
+        srv.shutdown()
+
+
+def test_shutdown_warns_when_decode_thread_stays_wedged():
+    """shutdown() must wake the loop BEFORE joining, and must say so
+    when the join still times out (a hung device step is not
+    interruptible from Python)."""
+    srv = object.__new__(InferenceServer)
+    srv._running = True
+    srv._stop_evt = threading.Event()
+    srv._work = threading.Event()
+    srv._watchdog_thread = None
+    srv._server = None
+    srv.shutdown_join_s = 0.1
+    wedge = threading.Event()
+    t = threading.Thread(target=wedge.wait, daemon=True)
+    t.start()
+    srv._decode_thread = t
+    # Listen on the emitting logger directly (sky_logging handlers
+    # bypass both caplog propagation and pytest's stream capture).
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    server_logger = logging.getLogger('skypilot_tpu.infer.server')
+    server_logger.addHandler(handler)
+    try:
+        srv.shutdown()
+        assert srv._running is False
+        assert srv._work.is_set()  # woken before the join
+        assert any('still alive' in r.getMessage() for r in records)
+    finally:
+        server_logger.removeHandler(handler)
+        wedge.set()
+
+
+# -- shared module server (created here; all chaos armed below is
+# -- consumed by THIS server's loop) ---------------------------------
+
+@pytest.fixture(scope='module')
+def server():
+    srv, reg, base = _start_server()
+    try:
+        yield srv, reg, base
+    finally:
+        chaos.disable()
+        srv.shutdown()
+
+
+def test_supervised_loop_restarts_after_transient(server):
+    srv, reg, base = server
+    before = reg.get('skytpu_decode_loop_restarts_total').value
+    chaos.configure('step_raise:n=1')
+    # The loop hits the injected fault on its next tick, recovers,
+    # and the request (queued at fire time) completes normally.
+    code, _, body = _completion(base)
+    assert code == 200, body
+    _wait_for(lambda: reg.get(
+        'skytpu_decode_loop_restarts_total').value >= before + 1,
+        what='restart counter')
+    code, _, body = _req(base, '/health')
+    assert code == 200 and json.loads(body)['status'] == 'ok'
+
+
+def test_full_queue_sheds_503_with_retry_after(server):
+    srv, reg, base = server
+    saved = srv.max_queue_depth
+    srv.max_queue_depth = 0
+    try:
+        code, hdrs, body = _completion(base)
+        assert code == 503
+        assert 'Retry-After' in hdrs
+        assert int(hdrs['Retry-After']) >= 1
+        assert 'queue' in json.loads(body)['error']
+        assert reg.get('skytpu_requests_shed_total').value_for(
+            reason='queue_full') == 1
+    finally:
+        srv.max_queue_depth = saved
+
+
+def test_unmeetable_deadline_sheds_at_admission(server):
+    srv, reg, base = server
+    srv.engine.estimate_queue_wait_s = lambda: 999.0
+    try:
+        code, hdrs, body = _completion(base, deadline_s=1.0)
+        assert code == 503
+        assert 'Retry-After' in hdrs
+        assert 'deadline' in json.loads(body)['error']
+        assert reg.get('skytpu_requests_shed_total').value_for(
+            reason='deadline_unmeetable') == 1
+    finally:
+        del srv.engine.estimate_queue_wait_s
+    # With the estimator back to normal the same request is admitted.
+    code, _, body = _completion(base, deadline_s=30.0)
+    assert code == 200, body
+
+
+def test_invalid_deadline_is_a_400(server):
+    _, _, base = server
+    code, _, body = _completion(base, deadline_s=-2)
+    assert code == 400
+    assert 'deadline_s' in json.loads(body)['error']['message']
+
+
+def test_client_disconnect_cancels_streaming_request(server):
+    srv, _, base = server
+    chaos.configure('client_disconnect:n=1')
+    # Slow the decode ticks so the request is still live when the
+    # injected disconnect fires on the first streamed token — on CPU
+    # the tiny model would otherwise finish the whole stream before
+    # the handler thread gets scheduled.
+    orig_step = srv.engine.step
+
+    def _slow_step():
+        time.sleep(0.05)
+        return orig_step()
+
+    srv.engine.step = _slow_step
+    data = json.dumps(dict(model='llama-tiny', prompt='stream me',
+                           max_tokens=48, stream=True)).encode()
+    chunks = b''
+    try:
+        resp = urllib.request.urlopen(
+            urllib.request.Request(base + '/v1/completions',
+                                   data=data), timeout=30)
+        chunks = resp.read()
+    except Exception:  # noqa: BLE001 — server hung up mid-body
+        pass
+    finally:
+        srv.engine.step = orig_step
+    assert b'[DONE]' not in chunks  # stream truncated, never finished
+    # The engine side was cancelled, not leaked.
+    _wait_for(lambda: srv.engine.traces.inflight_count == 0,
+              what='cancelled request to drain')
+    _wait_for(lambda: srv.engine.is_idle(), what='engine idle')
+    assert srv.engine._alloc.leak_report() is None
+    code, _, body = _req(base, '/traces')
+    states = [t['state'] for t in json.loads(body)['traces']]
+    # Slot-resident cancels trace-finish as 'evicted' (the eviction
+    # path is what frees the slot + pages); a cancel that lands before
+    # admission is terminal as 'cancelled'.  Either way: terminal.
+    assert any(s in ('cancelled', 'evicted') for s in states)
+
+
+# -- deadlines (engine level; test-driven, no free-running loop) ------
+
+@pytest.fixture(scope='module')
+def eng():
+    return engine_lib.ContinuousBatchingEngine(
+        'llama-tiny', n_slots=2, model_overrides=dict(_OVERRIDES),
+        param_dtype=jnp.float32, prefill_bucket=8, page_size=8,
+        registry=metrics_lib.Registry())
+
+
+def test_wait_derives_timeout_from_deadline(eng):
+    before = eng.registry.get(
+        'skytpu_request_deadline_expired_total').value
+    rid = eng.submit([5, 17, 3], _GREEDY, deadline_s=0.05)
+    t0 = time.monotonic()
+    with pytest.raises(failures.DeadlineExceededError,
+                       match='missed its deadline'):
+        eng.wait(rid)  # no explicit timeout: the deadline bounds it
+    assert time.monotonic() - t0 < 5.0  # nowhere near the old 600s
+    assert eng.registry.get(
+        'skytpu_request_deadline_expired_total').value == before + 1
+    assert eng.traces.get(rid).state == 'cancelled'
+    eng.run_until_idle()
+    assert eng._alloc.leak_report() is None
+
+
+def test_queued_request_expires_before_prefill(eng):
+    rid = eng.submit([9, 1, 30], _GREEDY, deadline_s=0.01)
+    time.sleep(0.05)
+    eng.step()  # expiry check runs before admission spends a prefill
+    trace = eng.traces.get(rid)
+    assert trace.state == 'cancelled'
+    assert 'expired in queue' in trace.error
+    with pytest.raises(failures.DeadlineExceededError,
+                       match='expired in queue'):
+        eng.wait(rid)
+    assert eng.queue_depth == 0
+    assert eng._alloc.leak_report() is None
+
+
+def test_submit_rejects_bad_deadline(eng):
+    with pytest.raises(ValueError, match='deadline_s'):
+        eng.submit([1, 2], _GREEDY, deadline_s=0.0)
+    with pytest.raises(ValueError, match='deadline_s'):
+        eng.submit([1, 2], _GREEDY, deadline_s=-3)
+
+
+# -- abort: waiters fail fast, pages come back (satellite) ------------
+
+def test_abort_wakes_waiters_and_releases_pages():
+    eng = engine_lib.ContinuousBatchingEngine(
+        'llama-tiny', n_slots=2, model_overrides=dict(_OVERRIDES),
+        param_dtype=jnp.float32, prefill_bucket=8, page_size=8,
+        registry=metrics_lib.Registry())
+    total = eng._alloc.free_pages
+    rid = eng.submit([5, 17, 3, 42, 8, 11], _GREEDY)
+    eng.step()  # admit into a slot: pages now held
+    assert eng._alloc.free_pages < total
+    caught = []
+
+    def _waiter():
+        try:
+            eng.wait(rid, timeout=30)
+        except BaseException as e:  # noqa: BLE001
+            caught.append(e)
+
+    t = threading.Thread(target=_waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    boom = RuntimeError('replica going down')
+    t0 = time.monotonic()
+    eng.abort(boom)
+    t.join(timeout=5)
+    assert not t.is_alive()  # waiter woke immediately, not at timeout
+    assert time.monotonic() - t0 < 5.0
+    # abort() is the replica-terminal path: every waiter is told the
+    # loop died, with the original failure as the cause chain.  (The
+    # per-request RequestAbortedError flavor is recover()'s contract —
+    # covered in test_chaos.)
+    assert len(caught) == 1
+    assert isinstance(caught[0], RuntimeError)
+    assert 'decode loop died' in str(caught[0])
+    assert caught[0].__cause__ is boom
+    # Host-side page bookkeeping is restored without device work.
+    assert eng._alloc.free_pages == total
+    assert eng._alloc.leak_report() is None
+    assert eng.traces.get(rid).state == 'aborted'

@@ -1,0 +1,178 @@
+"""utils/retry: backoff math, fatal channels, and budget awareness.
+
+The retry loop backs three production call sites (mesh backend init,
+the bench attempt ladder, the decode-loop supervisor's restart nap),
+so its contract is pinned here independently of any of them.
+"""
+import random
+
+import pytest
+
+from skypilot_tpu.utils import retry as retry_lib
+
+
+def test_compute_delay_exponential_no_jitter():
+    delays = [retry_lib.compute_delay(k, 1.5, factor=2.0,
+                                      jitter='none')
+              for k in range(4)]
+    assert delays == [1.5, 3.0, 6.0, 12.0]
+
+
+def test_compute_delay_caps_at_max():
+    assert retry_lib.compute_delay(10, 1.0, factor=2.0,
+                                   max_delay_s=7.0,
+                                   jitter='none') == 7.0
+
+
+def test_compute_delay_full_jitter_within_envelope():
+    rng = random.Random(7)
+    for k in range(6):
+        d = retry_lib.compute_delay(k, 2.0, factor=2.0,
+                                    max_delay_s=16.0, jitter='full',
+                                    rng=rng)
+        assert 0.0 <= d <= min(2.0 * 2 ** k, 16.0)
+
+
+def test_compute_delay_rejects_unknown_jitter():
+    with pytest.raises(ValueError, match='jitter'):
+        retry_lib.compute_delay(0, 1.0, jitter='half')
+
+
+def test_succeeds_after_failures_and_sleeps_backoff():
+    sleeps = []
+    calls = {'n': 0}
+
+    def _fn():
+        calls['n'] += 1
+        if calls['n'] < 3:
+            raise RuntimeError(f'boom {calls["n"]}')
+        return 'ok'
+
+    out = retry_lib.retry_with_backoff(
+        _fn, max_attempts=5, base_delay_s=2.0, factor=2.0,
+        jitter='none', sleep=sleeps.append)
+    assert out == 'ok'
+    assert calls['n'] == 3
+    assert sleeps == [2.0, 4.0]  # naps before attempts 2 and 3 only
+
+
+def test_exhausted_attempts_raise_retry_error_with_cause():
+    last = RuntimeError('always')
+
+    def _fn():
+        raise last
+
+    with pytest.raises(retry_lib.RetryError,
+                       match='after 3 attempt') as ei:
+        retry_lib.retry_with_backoff(
+            _fn, max_attempts=3, base_delay_s=0.0, jitter='none',
+            sleep=lambda _s: None, describe='op')
+    assert ei.value.attempts == 3
+    assert ei.value.last is last
+    assert ei.value.__cause__ is last
+
+
+def test_fatal_exceptions_raise_through_unchanged():
+    class Hang(RuntimeError):
+        pass
+
+    def _fn():
+        raise Hang('wedged')
+
+    calls = {'n': 0}
+
+    def _count_and_raise():
+        calls['n'] += 1
+        raise Hang('wedged')
+
+    # Fatal wins even when the type also matches retry_on.
+    with pytest.raises(Hang):
+        retry_lib.retry_with_backoff(
+            _count_and_raise, max_attempts=5,
+            retry_on=(RuntimeError,), fatal=(Hang,),
+            sleep=lambda _s: None)
+    assert calls['n'] == 1  # never retried
+
+
+def test_non_retryable_exceptions_raise_through():
+    def _fn():
+        raise KeyError('nope')
+
+    with pytest.raises(KeyError):
+        retry_lib.retry_with_backoff(
+            _fn, max_attempts=5, retry_on=(RuntimeError,),
+            sleep=lambda _s: None)
+
+
+def test_budget_exhausted_before_first_attempt():
+    calls = {'n': 0}
+
+    def _fn():
+        calls['n'] += 1
+
+    with pytest.raises(retry_lib.RetryError,
+                       match='budget exhausted') as ei:
+        retry_lib.retry_with_backoff(
+            _fn, max_attempts=3, remaining_s=lambda: 10.0,
+            min_attempt_s=60.0, sleep=lambda _s: None)
+    assert calls['n'] == 0
+    assert ei.value.attempts == 0
+    assert ei.value.last is None
+
+
+def test_budget_skips_nap_but_keeps_attempting():
+    """The nap would starve the next attempt -> retry back-to-back."""
+    sleeps = []
+    calls = {'n': 0}
+
+    def _fn():
+        calls['n'] += 1
+        raise RuntimeError('x')
+
+    with pytest.raises(retry_lib.RetryError):
+        retry_lib.retry_with_backoff(
+            _fn, max_attempts=3, base_delay_s=600.0, factor=1.0,
+            jitter='none',
+            remaining_s=lambda: 400.0,  # attempt fits, nap does not
+            min_attempt_s=150.0, sleep=sleeps.append)
+    assert calls['n'] == 3
+    assert sleeps == []  # every nap skipped, never slept the 600
+
+
+def test_budget_gives_up_mid_ladder():
+    """Budget shrinks below min_attempt_s after the first failure."""
+    budget = {'left': 200.0}
+    calls = {'n': 0}
+
+    def _fn():
+        calls['n'] += 1
+        budget['left'] = 10.0  # the attempt consumed the budget
+        raise RuntimeError('x')
+
+    with pytest.raises(retry_lib.RetryError) as ei:
+        retry_lib.retry_with_backoff(
+            _fn, max_attempts=5, base_delay_s=0.0, jitter='none',
+            remaining_s=lambda: budget['left'], min_attempt_s=150.0,
+            sleep=lambda _s: None)
+    assert calls['n'] == 1
+    assert ei.value.attempts == 1
+
+
+def test_on_failure_hook_sees_retry_decisions():
+    seen = []
+
+    def _fn():
+        raise RuntimeError('x')
+
+    with pytest.raises(retry_lib.RetryError):
+        retry_lib.retry_with_backoff(
+            _fn, max_attempts=3, base_delay_s=5.0, factor=1.0,
+            jitter='none',
+            on_failure=lambda a, e, will, d: seen.append((a, will, d)),
+            sleep=lambda _s: None)
+    assert seen == [(1, True, 5.0), (2, True, 5.0), (3, False, 0.0)]
+
+
+def test_max_attempts_must_be_positive():
+    with pytest.raises(ValueError, match='max_attempts'):
+        retry_lib.retry_with_backoff(lambda: None, max_attempts=0)

@@ -343,6 +343,45 @@ def test_cli_unknown_rule_is_usage_error(tmp_path):
 # tier-1 guard: the shipped tree stays clean
 # ---------------------------------------------------------------------
 
+_LONG_NAP_LOOP = """
+    import time
+
+    def poll():
+        while True:
+            time.sleep(600)
+"""
+
+_SLEEP_NEGATIVES = """
+    import time
+
+    def ok(delay):
+        time.sleep(600)              # not in a loop
+        for _ in range(3):
+            time.sleep(0.05)         # short poll
+            time.sleep(delay)        # computed: caller budget-bends it
+
+            def later():
+                time.sleep(600)      # own schedule, not per-iteration
+"""
+
+
+def test_sleep_discipline_flags_long_constant_nap_in_loop(tmp_path):
+    findings = _live(_lint(tmp_path, 'anywhere/poller.py',
+                           _LONG_NAP_LOOP, rule='sleep-discipline'))
+    assert len(findings) == 1
+    assert findings[0].symbol == 'time.sleep'
+    assert 'retry_with_backoff' in findings[0].message
+
+
+def test_sleep_discipline_negatives_and_retry_py_scope(tmp_path):
+    assert not _live(_lint(tmp_path, 'infer/server.py',
+                           _SLEEP_NEGATIVES,
+                           rule='sleep-discipline'))
+    # utils/retry.py is the sanctioned home for long retry naps.
+    assert not _live(_lint(tmp_path, 'skypilot_tpu/utils/retry.py',
+                           _LONG_NAP_LOOP, rule='sleep-discipline'))
+
+
 def test_tree_has_zero_unsuppressed_findings():
     """Gates every future PR: skylint over the package + bench.py via
     the committed .skylint-baseline must come back clean."""
@@ -357,4 +396,4 @@ def test_all_six_rule_families_are_registered():
     ids = {r.id for r in skylint.all_rules()}
     assert {'host-sync', 'retrace-hazard', 'lock-discipline',
             'thread-discipline', 'stdout-purity', 'metric-contract',
-            'dtype-promotion'} <= ids
+            'dtype-promotion', 'sleep-discipline'} <= ids
