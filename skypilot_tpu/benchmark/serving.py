@@ -26,10 +26,13 @@ import json
 import statistics
 import threading
 import time
+import urllib.error
 import urllib.request
+import uuid
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import retry as retry_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -71,34 +74,82 @@ def _start_lb(replica_url: str):
     return lb, f'http://127.0.0.1:{lb._server.server_address[1]}'  # pylint: disable=protected-access
 
 
+class _Shed503(Exception):
+    """The server shed the request (503).  ``retry_after_s`` — parsed
+    from the Retry-After header — floors retry_with_backoff's nap, so
+    the client retries at the server's pace instead of hammering a
+    backpressured replica."""
+
+
+def _open_with_retry(req: urllib.request.Request, timeout: float,
+                     max_attempts: int = 4):
+    """urlopen honoring 503 + Retry-After: a shed is backpressure, not
+    failure — retry on the server's schedule.  Every other HTTP error
+    propagates unchanged (a 400 does not get better with retries)."""
+
+    def _attempt():
+        try:
+            return urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            if e.code != 503:
+                raise
+            with e:
+                raw = e.headers.get('Retry-After')
+            exc = _Shed503(f'503 shed from {req.full_url}')
+            try:
+                exc.retry_after_s = min(max(float(raw), 0.0), 30.0)
+            except (TypeError, ValueError):
+                pass
+            raise exc from None
+
+    return retry_lib.retry_with_backoff(
+        _attempt, max_attempts=max_attempts, base_delay_s=0.1,
+        max_delay_s=5.0, retry_on=(_Shed503,),
+        describe='bench request')
+
+
 def _one_request(base_url: str, prompt: List[int],
-                 max_new_tokens: int) -> int:
+                 max_new_tokens: int,
+                 request_id: Optional[str] = None) -> int:
+    request_id = request_id or 'bench-' + uuid.uuid4().hex[:16]
     req = urllib.request.Request(
         base_url + '/generate',
         data=json.dumps({'prompt_ids': [prompt],
                          'max_new_tokens': max_new_tokens}).encode(),
-        headers={'Content-Type': 'application/json'})
-    with urllib.request.urlopen(req, timeout=600) as r:
+        headers={'Content-Type': 'application/json',
+                 'X-Request-Id': request_id})
+    with _open_with_retry(req, timeout=600) as r:
+        echoed = r.headers.get('X-Request-Id')
+        if echoed != request_id:
+            # End-to-end id propagation is part of the serving
+            # contract (client -> router/LB -> replica -> traces); a
+            # mismatch means some hop dropped or rewrote it.
+            raise RuntimeError(
+                f'X-Request-Id not propagated: sent {request_id!r}, '
+                f'got {echoed!r}')
         return len(json.load(r)['tokens'][0])
 
 
-def _one_sse_request(base_url: str, prompt: str, max_tokens: int
+def _one_sse_request(base_url: str, prompt: str, max_tokens: int,
+                     request_id: Optional[str] = None
                      ) -> Dict[str, Any]:
     """One streamed /v1/completions request; returns timing facts:
     ttft (request start -> first content event) and per-event gaps."""
+    request_id = request_id or 'bench-' + uuid.uuid4().hex[:16]
     req = urllib.request.Request(
         base_url + '/v1/completions',
         data=json.dumps({'prompt': prompt, 'max_tokens': max_tokens,
                          'temperature': 0.0,
                          'stream': True}).encode(),
-        headers={'Content-Type': 'application/json'})
+        headers={'Content-Type': 'application/json',
+                 'X-Request-Id': request_id})
     t0 = time.time()
     events = 0
     ttft = None
     gaps: List[float] = []
     last = None
     done = False
-    with urllib.request.urlopen(req, timeout=600) as resp:
+    with _open_with_retry(req, timeout=600) as resp:
         buf = b''
         while True:
             chunk = resp.read1(65536)

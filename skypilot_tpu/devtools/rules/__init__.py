@@ -8,12 +8,14 @@ from skypilot_tpu.devtools.rules import dtype_promotion
 from skypilot_tpu.devtools.rules import host_sync
 from skypilot_tpu.devtools.rules import lock_discipline
 from skypilot_tpu.devtools.rules import metric_contract
+from skypilot_tpu.devtools.rules import net_timeout
 from skypilot_tpu.devtools.rules import retrace
 from skypilot_tpu.devtools.rules import sleep_discipline
 from skypilot_tpu.devtools.rules import stdout_purity
 
 ALL_RULES = (host_sync.RULES + retrace.RULES + lock_discipline.RULES
              + stdout_purity.RULES + metric_contract.RULES
-             + dtype_promotion.RULES + sleep_discipline.RULES)
+             + dtype_promotion.RULES + sleep_discipline.RULES
+             + net_timeout.RULES)
 
 __all__ = ['ALL_RULES']

@@ -1,0 +1,107 @@
+"""net-timeout: blocking HTTP calls in the serving path need timeouts.
+
+A ``urllib.request.urlopen`` (or ``http.client.HTTPConnection``)
+without an explicit ``timeout=`` blocks forever when the peer wedges —
+and in the serving data plane the peer DOES wedge: that is the
+``BackendInitHang`` failure class the whole containment stack exists
+for.  A router health probe without a timeout turns one wedged replica
+into a wedged health loop; a failover attempt without a timeout turns
+it into a wedged client.  Every blocking network call in ``serve/``,
+``infer/`` and ``benchmark/`` must bound its wait explicitly so the
+failure stays contained where it happened.
+
+The rule flags:
+
+* ``urlopen(...)`` / ``urllib.request.urlopen(...)`` calls with no
+  ``timeout=`` keyword (a ``**kwargs`` splat counts as providing it —
+  the caller is forwarding a configuration surface);
+* ``http.client.HTTPConnection(...)`` / ``HTTPSConnection(...)``
+  constructions with no ``timeout=``.
+
+Socket-level calls are not flagged (``socket.create_connection``
+already requires thought about its timeout argument and is rare), and
+code outside the serving path is out of scope — an offline devtool
+blocking on a download is annoying, not an outage.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from skypilot_tpu.devtools import skylint
+
+RULE_ID = 'net-timeout'
+
+_SCOPED_DIRS = ('skypilot_tpu/serve/', 'skypilot_tpu/infer/',
+                'skypilot_tpu/benchmark/')
+
+_CONN_CLASSES = ('HTTPConnection', 'HTTPSConnection')
+
+
+def in_scope(posix: str) -> bool:
+    return any(d in posix for d in _SCOPED_DIRS)
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('' when dynamic)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return ''
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == 'timeout':
+            return True
+        if kw.arg is None:
+            return True  # **kwargs forwards a configuration surface
+    return False
+
+
+def _flags_urlopen(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    if not (name == 'urlopen' or name.endswith('.urlopen')):
+        return False
+    # urlopen(url, data, timeout) — a third positional IS the timeout.
+    return not _has_timeout(call) and len(call.args) < 3
+
+
+def _flags_connection(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    short = name.rsplit('.', 1)[-1]
+    if short not in _CONN_CLASSES:
+        return False
+    return not _has_timeout(call)
+
+
+def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _flags_urlopen(node):
+            findings.append(ctx.finding(
+                RULE_ID, node, 'urlopen',
+                'urlopen without an explicit timeout= blocks forever '
+                'on a wedged peer; in the serving path every blocking '
+                'network call must bound its wait'))
+        elif _flags_connection(node):
+            findings.append(ctx.finding(
+                RULE_ID, node, _dotted(node.func),
+                'http.client connection without an explicit timeout= '
+                'blocks forever on a wedged peer; in the serving path '
+                'every blocking network call must bound its wait'))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='urlopen/http.client calls in serve/, infer/, benchmark/ '
+            'must pass an explicit timeout',
+    check=check,
+    scope=in_scope),)

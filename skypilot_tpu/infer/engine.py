@@ -1729,6 +1729,32 @@ class ContinuousBatchingEngine:
             return 0.0
         return (len(self._queue) / self.n_slots) * ewma
 
+    # -- router / health surface ------------------------------------------
+    def allocator_leak_report(self) -> Optional[str]:
+        """None when the page pool is clean (or unpaged), else the
+        allocator's description of what leaked.  The verbose health
+        endpoint exposes this so the chaos e2e can assert survivors
+        stayed leak-free without reaching into process internals."""
+        if self._alloc is None:
+            return None
+        return self._alloc.leak_report()
+
+    def free_pages(self) -> Optional[int]:
+        """Allocatable KV pages right now (None when unpaged)."""
+        if self._alloc is None:
+            return None
+        return self._alloc.free_pages
+
+    def prefix_routing_key(self, prompt_ids: Sequence[int]
+                           ) -> Optional[int]:
+        """The prefix-affinity key a router would compute for this
+        prompt (None when unpaged).  Same function, same page size —
+        the engine-side anchor for router affinity tests."""
+        if not self.page_size:
+            return None
+        from skypilot_tpu.infer import paging as paging_lib
+        return paging_lib.routing_key(prompt_ids, self.page_size)
+
     # -- convenience (request-level API parity) ---------------------------
     def generate(self, prompts: Sequence[Sequence[int]],
                  sampling: Optional[SamplingConfig] = None

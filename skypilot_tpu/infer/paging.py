@@ -34,6 +34,36 @@ from skypilot_tpu.utils import chaos
 NULL_PAGE = 0
 
 
+def chain_hashes(tokens: Sequence[int], page_size: int) -> List[int]:
+    """Chain hash of each full page-aligned chunk of ``tokens``.
+
+    ``hashes[i]`` commits to tokens[:(i+1)*page_size] (causal prefill
+    makes a page's K/V a pure function of the tokens at and before it).
+    Stable across processes for integer token ids: int and
+    tuple-of-int hashing does not depend on ``PYTHONHASHSEED``, so a
+    router process and its replica processes compute identical chains.
+    """
+    hashes: List[int] = []
+    h = 0
+    for i in range(len(tokens) // page_size):
+        h = hash((h, tuple(tokens[i * page_size:(i + 1) * page_size])))
+        hashes.append(h)
+    return hashes
+
+
+def routing_key(tokens: Sequence[int], page_size: int) -> int:
+    """Prefix-affinity routing key for a prompt: the chain hash of its
+    FIRST page (requests sharing a page-aligned prefix share it — the
+    granularity at which a replica's prefix cache can help), or a
+    direct hash of the whole short prompt when it fills no page.  The
+    router keys replica affinity off this so prompts that would share
+    prefix pages land on the replica already holding them."""
+    hashes = chain_hashes(tokens, page_size)
+    if hashes:
+        return hashes[0]
+    return hash((0, tuple(tokens)))
+
+
 class PageAllocator:
     """Free list + refcounts + prefix-chain map over a fixed page pool."""
 
@@ -167,12 +197,7 @@ class PageAllocator:
     # -- prefix sharing ---------------------------------------------
 
     def _chain_hashes(self, tokens: Sequence[int]) -> List[int]:
-        ps = self.page_size
-        hashes, h = [], 0
-        for i in range(len(tokens) // ps):
-            h = hash((h, tuple(tokens[i * ps:(i + 1) * ps])))
-            hashes.append(h)
-        return hashes
+        return chain_hashes(tokens, self.page_size)
 
     def lookup_prefix(self, tokens: Sequence[int],
                       max_pages: Optional[int] = None) -> List[int]:

@@ -289,6 +289,24 @@ class InferenceServer:
         self._health = state
         self._fail_met['health'].set(_HEALTH_STATES[state])
 
+    def health_detail(self) -> dict:
+        """Replica facts for ``GET /health?verbose=1``: the routing-
+        relevant geometry (page_size anchors the router's prefix-
+        affinity granularity) and the allocator leak report the chaos
+        e2e asserts on without reaching into process internals."""
+        eng = self.engine
+        detail = {
+            'model': self.model_name,
+            'n_slots': eng.n_slots,
+            'page_size': eng.page_size,
+            'queue_depth': eng.queue_depth,
+            'leak_report': eng.allocator_leak_report(),
+        }
+        free = eng.free_pages()
+        if free is not None:
+            detail['free_pages'] = free
+        return detail
+
     def _fail_replica(self, error: BaseException) -> None:
         """Terminal: mark unhealthy, stop the loop, fail every waiter
         fast.  The readiness probe (503 /health) stops routing here;
@@ -695,7 +713,8 @@ class InferenceServer:
         self.start()
         assert self._server is not None
         logger.info(f'inference server on :{self.port}')
-        self._server.serve_forever()
+        # 50ms poll: shutdown()/drain block on the serve loop noticing.
+        self._server.serve_forever(poll_interval=0.05)
 
     def start(self) -> None:
         outer = self
@@ -763,16 +782,23 @@ class InferenceServer:
 
             def _do_get(self, route: str) -> None:
                 if route == '/health':
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    verbose = query.get('verbose', ['0'])[0] \
+                        not in ('0', '', 'false')
+                    detail = outer.health_detail() if verbose else {}
                     if outer._fatal is not None:  # pylint: disable=protected-access
                         self._reply(503, {
                             'status': 'unhealthy',
-                            'error': repr(outer._fatal)})  # pylint: disable=protected-access
+                            'error': repr(outer._fatal),  # pylint: disable=protected-access
+                            **detail})
                     elif outer._draining:  # pylint: disable=protected-access
                         # 503 so the router stops sending traffic while
                         # in-flight work finishes.
-                        self._reply(503, {'status': 'draining'})
+                        self._reply(503, {'status': 'draining',
+                                          **detail})
                     else:
-                        self._reply(200, {'status': 'ok'})
+                        self._reply(200, {'status': 'ok', **detail})
                 elif route == '/v1/models':
                     self._reply(200, {
                         'object': 'list',
@@ -971,6 +997,11 @@ def main() -> None:
     parser.add_argument('--served-model-name', default=None,
                         help='Model id reported by /v1/models and in '
                              'OpenAI responses (default: --model).')
+    parser.add_argument('--model-overrides', default=None,
+                        help='JSON dict of model-config overrides '
+                             '(e.g. \'{"n_layers": 2, "dim": 64}\') — '
+                             'lets subprocess test replicas run tiny '
+                             'geometry without a bespoke model name.')
     parser.add_argument('--kv-read-bucket', type=int, default=512,
                         help='Decode attention reads only the live '
                              'cache prefix, rounded up to this bucket '
@@ -982,7 +1013,13 @@ def main() -> None:
     if args.platform:
         from skypilot_tpu.parallel import mesh as mesh_lib
         mesh_lib.force_platform_and_touch(args.platform)
+    overrides = None
+    if args.model_overrides:
+        overrides = json.loads(args.model_overrides)
+        if not isinstance(overrides, dict):
+            parser.error('--model-overrides must be a JSON object')
     InferenceServer(model=args.model, port=args.port, host=args.host,
+                    model_overrides=overrides,
                     max_batch_size=args.max_batch_size,
                     max_seq_len=args.max_seq_len,
                     checkpoint_dir=args.checkpoint_dir,

@@ -60,6 +60,20 @@ METRIC_CONTRACT = frozenset({
     'skytpu_requests_shed_total',
     # utils/chaos.py — fault injection
     'skytpu_chaos_injections_total',
+    # serve/router.py + serve/replica_supervisor.py — the self-healing
+    # serving data plane
+    'skytpu_router_affinity_total',
+    'skytpu_router_circuit_transitions_total',
+    'skytpu_router_desired_replicas',
+    'skytpu_router_failovers_total',
+    'skytpu_router_health_probes_total',
+    'skytpu_router_replica_restarts_total',
+    'skytpu_router_replicas_routable',
+    'skytpu_router_replicas_total',
+    'skytpu_router_request_seconds',
+    'skytpu_router_requests_total',
+    'skytpu_router_retries_total',
+    'skytpu_router_scale_events_total',
     # train/trainer.py — training loop
     'skytpu_train_step_seconds',
     'skytpu_train_steps_total',

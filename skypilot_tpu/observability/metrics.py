@@ -392,6 +392,93 @@ class Registry:
 
 CONTENT_TYPE_LATEST = 'text/plain; version=0.0.4; charset=utf-8'
 
+# -- scrape-side parsing (the router's health loop consumes replica
+# -- /metrics text; keeping the parser next to the renderer keeps the
+# -- two in lock-step) -------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)\s*$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str],
+                                                        ...], float]]:
+    """Parse Prometheus text format v0.0.4 into
+    ``{sample_name: {((label, value), ...): float}}``.
+
+    Inverse of :meth:`Registry.expose` for the subset this repo
+    renders; unparseable lines are skipped (a scrape consumer must
+    survive a half-written exposition rather than raise).  Histogram
+    samples appear under their ``_bucket``/``_sum``/``_count`` names.
+    """
+    out: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith('#'):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        try:
+            value = float(m.group('value'))
+        except ValueError:
+            continue
+        labels = tuple(sorted(
+            (k, v.replace('\\"', '"').replace('\\n', '\n')
+             .replace('\\\\', '\\'))
+            for k, v in _LABEL_RE.findall(m.group('labels') or '')))
+        out.setdefault(m.group('name'), {})[labels] = value
+    return out
+
+
+def sample_value(parsed: Dict[str, Dict[Tuple[Tuple[str, str], ...],
+                                        float]],
+                 name: str, **labels: str) -> Optional[float]:
+    """One sample's value from :func:`parse_exposition` output, or
+    None when the series/label set is absent."""
+    series = parsed.get(name)
+    if not series:
+        return None
+    key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+    return series.get(key)
+
+
+def histogram_quantile(parsed: Dict[str, Dict[Tuple[Tuple[str, str],
+                                                    ...], float]],
+                       name: str, q: float) -> Optional[float]:
+    """Estimate quantile ``q`` from a scraped histogram's cumulative
+    ``<name>_bucket`` samples (upper-bound estimate: the bound of the
+    first bucket whose cumulative count reaches ``q * count``).
+    Returns None with no observations; +Inf-bucket hits report the
+    largest finite bound (the histogram cannot resolve beyond it)."""
+    buckets = parsed.get(name + '_bucket')
+    if not buckets:
+        return None
+    bounds: List[Tuple[float, float]] = []
+    for labelset, value in buckets.items():
+        le = dict(labelset).get('le')
+        if le is None:
+            continue
+        bound = math.inf if le == '+Inf' else float(le)
+        bounds.append((bound, value))
+    if not bounds:
+        return None
+    bounds.sort()
+    total = bounds[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    largest_finite = max((b for b, _ in bounds if b != math.inf),
+                         default=None)
+    for bound, cum in bounds:
+        if cum >= target:
+            if bound == math.inf:
+                return largest_finite
+            return bound
+    return largest_finite
+
+
 _GLOBAL_REGISTRY = Registry()
 
 

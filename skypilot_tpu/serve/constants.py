@@ -27,6 +27,11 @@ LB_SYNC_INTERVAL_SECONDS = 20.0
 # never execute twice.
 LB_REPLICA_TIMEOUT_SECONDS = 300.0
 LB_MAX_ATTEMPTS = 3
+# How long a POSITIVE /health probe is trusted before the next forward
+# re-probes.  Caps the per-request probe overhead under burst traffic;
+# kept short so a replica that starts draining stops receiving new
+# requests almost immediately.  Failures are never cached.
+LB_PROBE_CACHE_SECONDS = 0.25
 # With min_replicas=0 the first request arrives while no replica
 # exists; the LB holds it while the autoscaler wakes one (cold starts
 # include provisioning) instead of bouncing 503 at the waker.
@@ -44,3 +49,55 @@ QPS_WINDOW_SECONDS = 60.0
 REPLICA_PORT_ENV = 'SKYTPU_SERVE_REPLICA_PORT'
 REPLICA_ID_ENV = 'SKYTPU_SERVE_REPLICA_ID'
 SERVICE_NAME_ENV = 'SKYTPU_SERVE_SERVICE_NAME'
+
+# -- Self-healing router (serve/router.py) ---------------------------
+# Health loop cadence and per-probe timeout.  The health probe is a
+# GET /health against an in-process handler — 2 s of silence already
+# means the replica is wedged, not slow.
+ROUTER_HEALTH_INTERVAL_SECONDS = 1.0
+ROUTER_HEALTH_TIMEOUT_SECONDS = 2.0
+# Per-delivery-attempt urllib timeout.  Generous like
+# LB_REPLICA_TIMEOUT_SECONDS: a streaming generation holds the
+# connection for its full decode.
+ROUTER_ATTEMPT_TIMEOUT_SECONDS = 300.0
+# Failover budget per request: rounds of (every untried routable
+# replica back-to-back), with jittered backoff — floored by any shed's
+# Retry-After — between rounds, all under a wall-clock budget capped by
+# the request's own deadline_s.
+ROUTER_MAX_ROUNDS = 3
+ROUTER_REQUEST_BUDGET_SECONDS = 120.0
+# Circuit breaker: consecutive delivery failures that open a replica's
+# circuit, and how long it stays open before a half-open trial.
+ROUTER_CB_FAILURE_THRESHOLD = 3
+ROUTER_CB_COOLDOWN_SECONDS = 5.0
+# Prefix-affinity granularity (token ids per chunk) used until the
+# fleet reports its real KV page size via /health?verbose=1.
+ROUTER_AFFINITY_PAGE_SIZE = 16
+# A replica whose scraped decode queue depth reaches this is
+# "saturated": affinity stops pinning requests to it.
+ROUTER_SATURATION_QUEUE_DEPTH = 8.0
+
+# -- Replica supervisor (serve/replica_supervisor.py) ----------------
+# Crash restarts: jittered exponential backoff between restarts of the
+# same replica slot, and how many restarts a slot may consume within
+# the rolling window before the supervisor gives the slot up.
+SUPERVISOR_RESTART_BASE_DELAY_SECONDS = 1.0
+SUPERVISOR_RESTART_MAX_DELAY_SECONDS = 30.0
+SUPERVISOR_RESTART_BUDGET = 5
+SUPERVISOR_RESTART_WINDOW_SECONDS = 300.0
+# Supervisor reconcile cadence (process liveness + autoscaler).
+SUPERVISOR_TICK_SECONDS = 1.0
+# Scale-down drains before kill: how long to wait for in-flight
+# requests to finish after POST /drain before SIGTERM.
+SUPERVISOR_DRAIN_TIMEOUT_SECONDS = 60.0
+
+# -- Metrics-driven autoscaling (EngineSignalsAutoscaler) ------------
+# Scale up when the fleet's mean decode queue depth per routable
+# replica exceeds this...
+AUTOSCALE_QUEUE_HIGH = 4.0
+# ...and scale down when it stays below this (with >min replicas).
+AUTOSCALE_QUEUE_LOW = 0.5
+# Consecutive over/under-threshold evaluations before acting
+# (hysteresis: one burst must not thrash the fleet).
+AUTOSCALE_UPSCALE_PATIENCE = 2
+AUTOSCALE_DOWNSCALE_PATIENCE = 5

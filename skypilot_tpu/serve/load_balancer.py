@@ -14,7 +14,6 @@ from __future__ import annotations
 import http.client
 import http.server
 import json
-import socket
 import threading
 import time
 import urllib.error
@@ -41,17 +40,31 @@ LBHTTPServer = http_utils.HighBacklogHTTPServer
 
 
 def _probe(replica_url: str) -> bool:
-    """TCP connect-probe a replica URL ('http://host:port')."""
+    """Probe a replica's ``GET /health``, honoring the three-state
+    contract: only ``ok`` is routable.
+
+    A bare TCP connect (the old probe) calls a DRAINING or UNHEALTHY
+    replica healthy — its listener still accepts while admission sheds
+    every request — so the LB kept routing to replicas that could only
+    503.  A non-health-aware backend (connects but 404s /health) still
+    counts as up, so the LB keeps working in front of plain HTTP
+    services.
+    """
     parsed = urllib.parse.urlparse(replica_url)
-    host = parsed.hostname
-    port = parsed.port or (443 if parsed.scheme == 'https' else 80)
-    if host is None:
+    if parsed.hostname is None:
         return False
     try:
-        with socket.create_connection((host, port),
-                                      timeout=_PROBE_TIMEOUT_SECONDS):
+        with urllib.request.urlopen(replica_url.rstrip('/') + '/health',
+                                    timeout=_PROBE_TIMEOUT_SECONDS):
             return True
-    except OSError:
+    except urllib.error.HTTPError as e:
+        with e:
+            # 503 carries draining/unhealthy — unroutable either way.
+            # Any other status means the backend is up but does not
+            # speak the health protocol; treat as routable.
+            return e.code != 503
+    except (urllib.error.URLError, ConnectionError, TimeoutError,
+            OSError, http.client.HTTPException):
         return False
 
 
@@ -100,6 +113,27 @@ class SkyServeLoadBalancer:
         self._stop = threading.Event()
         self._server: Optional[http.server.ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
+        # url -> monotonic expiry of a positive /health probe.  Only
+        # successes are cached (and only briefly): back-to-back
+        # requests skip the per-forward health roundtrip, while a
+        # replica that failed its last probe is always re-probed fresh
+        # so recovery and death are both seen immediately.
+        self._probe_cache: dict = {}
+        self._probe_lock = threading.Lock()
+
+    def _probe_cached(self, url: str) -> bool:
+        now = time.monotonic()
+        with self._probe_lock:
+            if self._probe_cache.get(url, 0.0) > now:
+                return True
+        ok = _probe(url)
+        with self._probe_lock:
+            if ok:
+                self._probe_cache[url] = (
+                    now + constants.LB_PROBE_CACHE_SECONDS)
+            else:
+                self._probe_cache.pop(url, None)
+        return ok
 
     # -- controller sync ---------------------------------------------------
     def _sync_once(self) -> None:
@@ -148,8 +182,9 @@ class SkyServeLoadBalancer:
                     return
                 data = self.rfile.read(length) if length > 0 else None
                 # Dead-replica failover happens BEFORE the request is
-                # forwarded: a cheap TCP probe weeds out replicas whose
-                # host is gone (preempted/terminated).  Once a replica
+                # forwarded: a /health probe (briefly cached when
+                # positive) weeds out replicas whose host is gone or
+                # that are draining.  Once a replica
                 # accepts a connection the request is sent exactly once
                 # — a timeout or reset after delivery is never retried,
                 # so non-idempotent inference calls cannot run twice.
@@ -160,10 +195,10 @@ class SkyServeLoadBalancer:
                     if cand is None:
                         break
                     tried.add(cand)
-                    if _probe(cand):
+                    if lb._probe_cached(cand):
                         replica = cand
                         break
-                    logger.warning(f'Replica {cand} failed TCP probe; '
+                    logger.warning(f'Replica {cand} failed health probe; '
                                    'trying another replica.')
                 if replica is None and not tried and \
                         lb.scale_from_zero_wait > 0:
@@ -188,7 +223,7 @@ class SkyServeLoadBalancer:
                 deadline = time.time() + lb.scale_from_zero_wait
                 while time.time() < deadline:
                     cand = lb.policy.select_replica()
-                    if cand is not None and _probe(cand):
+                    if cand is not None and lb._probe_cached(cand):
                         return cand
                     time.sleep(
                         constants.LB_SCALE_FROM_ZERO_POLL_SECONDS)
@@ -288,7 +323,10 @@ class SkyServeLoadBalancer:
     def start(self) -> None:
         self._server = LBHTTPServer(
             ('0.0.0.0', self.port), self._make_handler())
-        for target, name in ((self._server.serve_forever, 'http'),
+        # 50ms serve poll: stop() blocks on shutdown() until the serve
+        # loop next polls.
+        serve = lambda: self._server.serve_forever(poll_interval=0.05)
+        for target, name in ((serve, 'http'),
                              (self._sync_loop, 'sync')):
             t = threading.Thread(target=target, daemon=True,
                                  name=f'lb-{name}')

@@ -1,0 +1,429 @@
+"""Replica fleet supervisor: crash restarts, drain scale-down, chaos.
+
+Owns the lifecycle of the router's replica fleet so the data plane
+self-heals end to end:
+
+* **Crash restarts** — a replica process that exits without being
+  asked to is restarted with jittered exponential backoff
+  (``utils/retry.compute_delay``), up to ``restart_budget`` restarts
+  per slot inside a rolling ``restart_window_s``.  A slot that blows
+  its budget is FAILED and stays down — a crash-looping binary must
+  not burn the host forever (same budget shape as the in-replica
+  decode-loop supervisor).
+* **Scale events** — the supervisor holds the fleet at the
+  autoscaler's desired size.  Scale-up spawns a fresh replica (the
+  router's health loop admits it once ``/health`` says ok).  Scale-down
+  NEVER drops a request: the router stops routing to the victim first
+  (``mark_draining``), then ``POST /drain`` lets in-flight work finish
+  and the process exit on its own; only a drain-deadline overrun
+  escalates to SIGTERM.
+* **Chaos** — the ``replica_kill`` fault point SIGKILLs a live replica
+  from inside the supervision loop, so the whole
+  crash → reroute → restart → re-admit cycle is provable in tests
+  without an external killer.
+
+Replica processes are created by a ``factory(slot_id) -> (handle,
+url)`` callable; ``handle`` needs the ``subprocess.Popen`` surface
+(``poll``/``terminate``/``kill``).  Tests substitute in-process fakes;
+production uses :func:`subprocess_replica_factory`.
+
+The autoscaler here is **metrics-driven**: it reads the engine-native
+load signals the router already scrapes (decode queue depth, free KV
+pages) instead of request rate — queue depth is what actually predicts
+TTFT on a continuous-batching engine.  The spec/QPS autoscalers in
+``serve/autoscalers.py`` serve the control plane; this one serves the
+data plane and shares its hysteresis shape (consecutive-evaluation
+patience in both directions, scale-up more eager than scale-down).
+"""
+from __future__ import annotations
+
+import random
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve.router import Router
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import retry as retry_lib
+
+logger = sky_logging.init_logger(__name__)
+
+# Slot states.
+LIVE = 'live'            # process spawned (router decides routability)
+BACKOFF = 'backoff'      # crashed; waiting out the restart delay
+DRAINING = 'draining'    # asked to drain; waiting for self-exit
+STOPPED = 'stopped'      # scale-down complete
+FAILED = 'failed'        # restart budget exhausted; stays down
+
+
+def _supervisor_metrics(registry: Optional[metrics_lib.Registry] = None):
+    r = registry if registry is not None else metrics_lib.get_registry()
+    return {
+        'restarts': r.counter(
+            'skytpu_router_replica_restarts_total',
+            'Replica processes restarted by the supervisor after an '
+            'unexpected exit.'),
+        'scale_events': r.counter(
+            'skytpu_router_scale_events_total',
+            'Autoscaler-driven fleet size changes, by direction.',
+            labelnames=('direction',)),
+        'desired': r.gauge(
+            'skytpu_router_desired_replicas',
+            'Fleet size the autoscaler currently wants.'),
+    }
+
+
+class EngineSignalsAutoscaler:
+    """Desired fleet size from scraped engine signals, with hysteresis.
+
+    Scale up one replica when the mean decode queue depth across
+    routable replicas has exceeded ``queue_high`` for
+    ``upscale_patience`` consecutive evaluations (a saturated page pool
+    with queued work counts as high load too — no free pages means
+    admission is already blocking).  Scale down one replica when the
+    mean has stayed below ``queue_low`` for ``downscale_patience``
+    evaluations.  Asymmetric patience: adding capacity late costs TTFT
+    SLOs, removing it late costs only money.
+    """
+
+    def __init__(self, min_replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 queue_high: float = constants.AUTOSCALE_QUEUE_HIGH,
+                 queue_low: float = constants.AUTOSCALE_QUEUE_LOW,
+                 upscale_patience: int =
+                 constants.AUTOSCALE_UPSCALE_PATIENCE,
+                 downscale_patience: int =
+                 constants.AUTOSCALE_DOWNSCALE_PATIENCE):
+        if min_replicas < 1:
+            raise ValueError('min_replicas must be >= 1')
+        if max_replicas is not None and max_replicas < min_replicas:
+            raise ValueError('max_replicas must be >= min_replicas')
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.queue_high = queue_high
+        self.queue_low = queue_low
+        self.upscale_patience = upscale_patience
+        self.downscale_patience = downscale_patience
+        self._over = 0
+        self._under = 0
+
+    def desired(self, views, current: int) -> int:
+        """One evaluation: the new desired size given the router's
+        replica views and the current fleet size."""
+        current = max(current, 0)
+        routable = [v for v in views if v.routable]
+        if not routable:
+            # Blind: hold the fleet, let supervision restore health.
+            self._over = self._under = 0
+            return max(current, self.min_replicas)
+        mean_depth = sum(v.queue_depth for v in routable) / len(routable)
+        starved = any(v.free_pages == 0.0 and v.queue_depth > 0
+                      for v in routable)
+        if mean_depth >= self.queue_high or starved:
+            self._over += 1
+            self._under = 0
+        elif mean_depth <= self.queue_low:
+            self._under += 1
+            self._over = 0
+        else:
+            self._over = self._under = 0
+        target = current
+        if self._over >= self.upscale_patience:
+            target = current + 1
+            self._over = 0
+        elif self._under >= self.downscale_patience and \
+                current > self.min_replicas:
+            target = current - 1
+            self._under = 0
+        if self.max_replicas is not None:
+            target = min(target, self.max_replicas)
+        return max(target, self.min_replicas)
+
+
+class _Slot:
+
+    def __init__(self, slot_id: int):
+        self.slot_id = slot_id
+        self.state = BACKOFF         # spawn happens on the next tick
+        self.handle = None
+        self.url: Optional[str] = None
+        self.restart_times: List[float] = []
+        self.next_start_at = 0.0
+        self.drain_deadline = 0.0
+
+    def __repr__(self):
+        return (f'_Slot({self.slot_id}, {self.state}, url={self.url}, '
+                f'restarts={len(self.restart_times)})')
+
+
+class ReplicaSupervisor:
+    """Drives the fleet toward the autoscaler's desired size and keeps
+    every slot alive (or declared dead).  ``tick()`` is the whole
+    control loop and is public so tests can step it deterministically;
+    ``start()`` runs it on a daemon thread every ``tick_s``."""
+
+    def __init__(self, factory: Callable[[int], Tuple[object, str]],
+                 router: Router,
+                 min_replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 autoscaler: Optional[EngineSignalsAutoscaler] = None,
+                 tick_s: float = constants.SUPERVISOR_TICK_SECONDS,
+                 restart_base_delay_s: float =
+                 constants.SUPERVISOR_RESTART_BASE_DELAY_SECONDS,
+                 restart_max_delay_s: float =
+                 constants.SUPERVISOR_RESTART_MAX_DELAY_SECONDS,
+                 restart_budget: int = constants.SUPERVISOR_RESTART_BUDGET,
+                 restart_window_s: float =
+                 constants.SUPERVISOR_RESTART_WINDOW_SECONDS,
+                 drain_timeout_s: float =
+                 constants.SUPERVISOR_DRAIN_TIMEOUT_SECONDS,
+                 registry: Optional[metrics_lib.Registry] = None,
+                 rng: Optional[random.Random] = None):
+        self._factory = factory
+        self.router = router
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.autoscaler = autoscaler
+        self.tick_s = tick_s
+        self.restart_base_delay_s = restart_base_delay_s
+        self.restart_max_delay_s = restart_max_delay_s
+        self.restart_budget = restart_budget
+        self.restart_window_s = restart_window_s
+        self.drain_timeout_s = drain_timeout_s
+        self._rng = rng if rng is not None else random.Random()
+        self._met = _supervisor_metrics(registry)
+        self._lock = threading.Lock()
+        self._slots: Dict[int, _Slot] = {}
+        self._next_slot_id = 0
+        self.desired = min_replicas
+        self._met['desired'].set(self.desired)
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        for _ in range(min_replicas):
+            self._new_slot()
+
+    # -- slot bookkeeping ---------------------------------------------
+    def _new_slot(self) -> _Slot:
+        with self._lock:
+            slot = _Slot(self._next_slot_id)
+            self._next_slot_id += 1
+            self._slots[slot.slot_id] = slot
+        return slot
+
+    def slots(self) -> List[_Slot]:
+        with self._lock:
+            return list(self._slots.values())
+
+    def _active(self) -> List[_Slot]:
+        """Slots that count toward fleet size (spawned or respawning —
+        draining/failed/stopped ones are already on their way out)."""
+        return [s for s in self.slots() if s.state in (LIVE, BACKOFF)]
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name='skytpu-replica-sup')
+        self._thread.start()
+
+    def stop(self, kill_replicas: bool = True) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if kill_replicas:
+            for slot in self.slots():
+                if slot.handle is not None and slot.handle.poll() is None:
+                    slot.handle.terminate()
+            deadline = time.monotonic() + 5
+            for slot in self.slots():
+                if slot.handle is None:
+                    continue
+                while slot.handle.poll() is None and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.02)
+                if slot.handle.poll() is None:
+                    slot.handle.kill()
+
+    def _loop(self) -> None:
+        while not self._stop_evt.wait(self.tick_s):
+            try:
+                self.tick()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('supervisor tick failed')
+
+    # -- the control loop ---------------------------------------------
+    def tick(self) -> None:
+        self._maybe_chaos_kill()
+        self._reap_and_schedule_restarts()
+        self._spawn_pending()
+        self._finish_drains()
+        self._autoscale()
+
+    def _maybe_chaos_kill(self) -> None:
+        live = [s for s in self.slots()
+                if s.state == LIVE and s.handle is not None
+                and s.handle.poll() is None]
+        if live and chaos.should_inject('replica_kill'):
+            victim = self._rng.choice(live)
+            logger.warning(
+                f'chaos: SIGKILLing replica slot {victim.slot_id} '
+                f'({victim.url})')
+            victim.handle.kill()
+
+    def _reap_and_schedule_restarts(self) -> None:
+        now = time.monotonic()
+        for slot in self.slots():
+            if slot.state != LIVE or slot.handle is None:
+                continue
+            code = slot.handle.poll()
+            if code is None:
+                continue
+            # Unexpected exit: reroute first, then decide restart.
+            if slot.url is not None:
+                self.router.remove_replica(slot.url)
+            slot.restart_times = [
+                t for t in slot.restart_times
+                if now - t <= self.restart_window_s]
+            slot.restart_times.append(now)
+            if len(slot.restart_times) > self.restart_budget:
+                slot.state = FAILED
+                logger.error(
+                    f'replica slot {slot.slot_id} exceeded its restart '
+                    f'budget ({self.restart_budget} within '
+                    f'{self.restart_window_s:.0f}s); giving the slot up')
+                continue
+            delay = retry_lib.compute_delay(
+                len(slot.restart_times) - 1,
+                base_delay_s=self.restart_base_delay_s,
+                max_delay_s=self.restart_max_delay_s,
+                jitter='full', rng=self._rng)
+            slot.state = BACKOFF
+            slot.next_start_at = now + delay
+            self._met['restarts'].inc()
+            logger.warning(
+                f'replica slot {slot.slot_id} exited with code {code}; '
+                f'restarting in {delay:.2f}s '
+                f'(restart {len(slot.restart_times)}/'
+                f'{self.restart_budget})')
+
+    def _spawn_pending(self) -> None:
+        now = time.monotonic()
+        for slot in self.slots():
+            if slot.state != BACKOFF or now < slot.next_start_at:
+                continue
+            try:
+                handle, url = self._factory(slot.slot_id)
+            except Exception:  # pylint: disable=broad-except
+                logger.exception(
+                    f'spawn failed for replica slot {slot.slot_id}; '
+                    'will retry next tick')
+                slot.next_start_at = now + self.restart_base_delay_s
+                continue
+            slot.handle = handle
+            slot.url = url.rstrip('/')
+            slot.state = LIVE
+            self.router.add_replica(slot.url)
+            logger.info(
+                f'replica slot {slot.slot_id} spawned at {slot.url}')
+
+    # -- scale-down via drain -----------------------------------------
+    def _begin_drain(self, slot: _Slot) -> None:
+        slot.state = DRAINING
+        slot.drain_deadline = time.monotonic() + self.drain_timeout_s
+        if slot.url is not None:
+            # Unroutable BEFORE the drain request: zero requests may
+            # land on the victim after this point.
+            self.router.mark_draining(slot.url)
+            try:
+                req = urllib.request.Request(
+                    slot.url + '/drain', data=b'{}', method='POST',
+                    headers={'Content-Type': 'application/json'})
+                urllib.request.urlopen(req, timeout=5).close()
+            except (urllib.error.URLError, urllib.error.HTTPError,
+                    ConnectionError, TimeoutError, OSError):
+                # Unreachable for drain == already dead; escalation
+                # below cleans up.
+                logger.warning(
+                    f'drain request to {slot.url} failed; falling back '
+                    'to the drain deadline')
+
+    def _finish_drains(self) -> None:
+        now = time.monotonic()
+        for slot in self.slots():
+            if slot.state != DRAINING:
+                continue
+            exited = slot.handle is None or slot.handle.poll() is not None
+            if not exited and now > slot.drain_deadline:
+                logger.warning(
+                    f'replica slot {slot.slot_id} missed its drain '
+                    f'deadline; terminating')
+                slot.handle.terminate()
+                exited = True
+            if exited:
+                slot.state = STOPPED
+                if slot.url is not None:
+                    self.router.remove_replica(slot.url)
+                logger.info(
+                    f'replica slot {slot.slot_id} drained and stopped')
+
+    def _autoscale(self) -> None:
+        active = self._active()
+        if self.autoscaler is not None:
+            self.desired = self.autoscaler.desired(
+                self.router.views(), len(active))
+        else:
+            self.desired = max(self.min_replicas, len(active))
+        if self.max_replicas is not None:
+            self.desired = min(self.desired, self.max_replicas)
+        self._met['desired'].set(self.desired)
+        if len(active) < self.desired:
+            for _ in range(self.desired - len(active)):
+                self._new_slot()
+            self._met['scale_events'].labels(direction='up').inc()
+            logger.info(f'scaling up to {self.desired} replica(s)')
+        elif len(active) > self.desired:
+            # Newest-first victims (oldest replicas hold the warmest
+            # prefix caches and the most compile cache residency).
+            victims = sorted(
+                (s for s in active if s.state == LIVE),
+                key=lambda s: -s.slot_id)[:len(active) - self.desired]
+            if victims:
+                self._met['scale_events'].labels(direction='down').inc()
+            for slot in victims:
+                logger.info(
+                    f'scaling down: draining replica slot '
+                    f'{slot.slot_id} ({slot.url})')
+                self._begin_drain(slot)
+
+
+def subprocess_replica_factory(argv_template: List[str],
+                               host: str = '127.0.0.1',
+                               port_start: int =
+                               constants.LOCAL_REPLICA_PORT_START,
+                               env: Optional[Dict[str, str]] = None
+                               ) -> Callable[[int], Tuple[object, str]]:
+    """Factory spawning real ``infer.server`` subprocesses.
+
+    ``argv_template`` entries may contain ``{port}`` / ``{slot_id}``
+    placeholders.  Each spawn (including a restart of the same slot)
+    takes the next free port — the old port may linger in TIME_WAIT.
+    """
+    counter = {'n': 0}
+    lock = threading.Lock()
+
+    def factory(slot_id: int) -> Tuple[object, str]:
+        with lock:
+            port = port_start + counter['n']
+            counter['n'] += 1
+        argv = [a.format(port=port, slot_id=slot_id)
+                for a in argv_template]
+        proc = subprocess.Popen(argv, env=env)
+        return proc, f'http://{host}:{port}'
+
+    return factory

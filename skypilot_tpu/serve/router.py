@@ -1,0 +1,840 @@
+"""Self-healing serving router: health-driven failover over N replicas.
+
+The serving data plane in front of N ``infer.server.InferenceServer``
+replicas.  Where ``serve/load_balancer.py`` TCP-probes blindly and has
+never met the inference engine, this router leans on the replica-side
+failure containment PR 7 built for it:
+
+* **Health view** — a background loop polls every replica's
+  ``GET /health`` (three-state: ok / draining / unhealthy; only *ok* is
+  routable) and scrapes its ``/metrics`` for engine-native load signals
+  (queue depth, free KV pages, TTFT p99) so routing and autoscaling run
+  on what the engine actually feels, not generic QPS.
+* **Failover** — connection errors and 503 sheds retry on another
+  replica under a per-request budget built on
+  ``utils/retry.retry_with_backoff`` (a shed's ``Retry-After`` floors
+  the inter-round nap).  The idempotency rule: a request is never
+  retried once ANY response byte reached the client — a replica may
+  re-execute a request the client never saw tokens from, but a stream
+  the client started reading is unrecoverable and aborts instead.
+* **Circuit breakers** — per-replica: ``failure_threshold`` consecutive
+  delivery failures open the circuit (unroutable), a cooldown later it
+  goes half-open, and the next health probe (or request) through it
+  closes it again — a flapping replica cannot eat every request's
+  retry budget.
+* **Prefix affinity** — requests route by the page-chain routing key
+  from ``infer/paging.py`` via rendezvous hashing, so prompts sharing
+  a page-aligned prefix land on the replica already holding those
+  prefix pages; the affine replica is skipped when unroutable or
+  saturated (deep queue / no free pages) and the request falls back to
+  least-loaded.
+
+Chaos fault points (``utils/chaos.py``): ``slow_replica`` stalls the
+forward path, ``proxy_disconnect`` drops the upstream connection after
+connect — both land on the retry path, making failover provable in
+tier-1 without a real wedged host.
+
+Stdlib-only, same as the rest of the serve stack.  The replica set is
+dynamic: ``set_replicas()`` reconciles (the supervisor calls it on
+scale events), keeping breaker/health state for surviving URLs.
+"""
+from __future__ import annotations
+
+import http.client
+import http.server
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Dict, List, Optional, Set
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.infer import paging
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.serve import constants
+from skypilot_tpu.utils import chaos
+from skypilot_tpu.utils import http_utils
+from skypilot_tpu.utils import retry as retry_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_HOP_HEADERS = {'connection', 'keep-alive', 'proxy-authenticate',
+                'proxy-authorization', 'te', 'trailers',
+                'transfer-encoding', 'upgrade', 'host', 'content-length'}
+
+# Replica status codes the router retries on another replica.  503 is
+# handled separately (it is backpressure, not failure — it never trips
+# the breaker, and its Retry-After paces the next round); 504 is the
+# replica saying the request's own deadline died, so a retry would
+# only double-spend a dead budget; 4xx are the client's problem.
+_RETRYABLE_REPLICA_CODES = (500, 502)
+
+_PROXY_ROUTES = ('/generate', '/v1/completions', '/v1/chat/completions')
+
+
+def _router_metrics(registry: Optional[metrics_lib.Registry] = None):
+    """Get-or-create the skytpu_router_* series (all names are in
+    observability.METRIC_CONTRACT)."""
+    r = registry if registry is not None else metrics_lib.get_registry()
+    return {
+        'requests': r.counter(
+            'skytpu_router_requests_total',
+            'Requests through the router, by terminal outcome.',
+            labelnames=('outcome',)),
+        'latency': r.histogram(
+            'skytpu_router_request_seconds',
+            'Wall-clock seconds per routed request (all attempts).'),
+        'retries': r.counter(
+            'skytpu_router_retries_total',
+            'Per-attempt failovers/retries, by reason.',
+            labelnames=('reason',)),
+        'failovers': r.counter(
+            'skytpu_router_failovers_total',
+            'Requests that completed on a replica other than the '
+            'first one attempted.'),
+        'affinity': r.counter(
+            'skytpu_router_affinity_total',
+            'Prefix-affinity routing decisions: hit = routed to the '
+            'affine replica, miss = affine replica unroutable or '
+            'saturated, none = request carried no routing key.',
+            labelnames=('result',)),
+        'routable': r.gauge(
+            'skytpu_router_replicas_routable',
+            'Replicas the router would currently route to (health ok '
+            'and circuit not open).'),
+        'replicas': r.gauge(
+            'skytpu_router_replicas_total',
+            'Replicas in the routing table regardless of health.'),
+        'probes': r.counter(
+            'skytpu_router_health_probes_total',
+            'Health-loop probe results, by observed state.',
+            labelnames=('result',)),
+        'circuit': r.counter(
+            'skytpu_router_circuit_transitions_total',
+            'Circuit-breaker state transitions, by new state.',
+            labelnames=('state',)),
+    }
+
+
+class CircuitBreaker:
+    """Per-replica circuit breaker: closed -> open after
+    ``failure_threshold`` consecutive failures, open -> half-open after
+    ``cooldown_s``, half-open -> closed on the first success (probe or
+    request) and back to open on the first failure.
+
+    Thread-safe: handler threads and the health loop both touch it.
+    ``state`` is evaluated lazily so no timer thread is needed.
+    """
+
+    CLOSED = 'closed'
+    OPEN = 'open'
+    HALF_OPEN = 'half_open'
+
+    def __init__(self, failure_threshold: int =
+                 constants.ROUTER_CB_FAILURE_THRESHOLD,
+                 cooldown_s: float = constants.ROUTER_CB_COOLDOWN_SECONDS,
+                 clock=time.monotonic, on_transition=None):
+        if failure_threshold < 1:
+            raise ValueError('failure_threshold must be >= 1, got '
+                             f'{failure_threshold}')
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+
+    def _transition(self, state: str) -> None:
+        self._state = state
+        if self._on_transition is not None:
+            self._on_transition(state)
+
+    def _evaluate(self) -> str:
+        if self._state == self.OPEN and \
+                self._clock() - self._opened_at >= self.cooldown_s:
+            self._transition(self.HALF_OPEN)
+        return self._state
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._evaluate()
+
+    @property
+    def allows_requests(self) -> bool:
+        """False only while OPEN (half-open lets a trial through — its
+        outcome closes or reopens the circuit)."""
+        return self.state != self.OPEN
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._evaluate()
+            self._consecutive_failures = 0
+            if self._state != self.CLOSED:
+                self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            state = self._evaluate()
+            if state == self.HALF_OPEN:
+                # The trial failed: straight back to open, new cooldown.
+                self._opened_at = self._clock()
+                self._consecutive_failures = self.failure_threshold
+                self._transition(self.OPEN)
+                return
+            self._consecutive_failures += 1
+            if state == self.CLOSED and \
+                    self._consecutive_failures >= self.failure_threshold:
+                self._opened_at = self._clock()
+                self._transition(self.OPEN)
+
+    def on_probe(self, ok: bool) -> None:
+        """A health-loop probe doubles as the half-open trial: a
+        recovered replica is re-admitted without risking a live
+        request.  Probes never trip a closed breaker (request-delivery
+        failures own that) and never touch an open one (the cooldown
+        owns re-entry)."""
+        if self.state != self.HALF_OPEN:
+            return
+        if ok:
+            self.record_success()
+        else:
+            self.record_failure()
+
+
+class ReplicaView:
+    """The router's view of one replica: health, breaker, and the
+    engine signals scraped from its /metrics."""
+
+    def __init__(self, url: str, breaker: Optional[CircuitBreaker] = None):
+        self.url = url.rstrip('/')
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.health = 'unknown'    # ok | draining | unhealthy | unreachable
+        self.inflight = 0          # router-side live proxied requests
+        self.queue_depth = 0.0     # skytpu_decode_queue_depth
+        self.free_pages: Optional[float] = None  # skytpu_kv_free_pages
+        self.ttft_p99_s: Optional[float] = None  # from TTFT histogram
+        self.page_size: Optional[int] = None     # from /health?verbose=1
+        self.consecutive_probe_failures = 0
+
+    @property
+    def routable(self) -> bool:
+        return self.health == 'ok' and self.breaker.allows_requests
+
+    def snapshot(self) -> Dict[str, object]:
+        return {'url': self.url, 'health': self.health,
+                'circuit': self.breaker.state,
+                'inflight': self.inflight,
+                'queue_depth': self.queue_depth,
+                'free_pages': self.free_pages,
+                'ttft_p99_s': self.ttft_p99_s,
+                'routable': self.routable}
+
+
+class _RoundExhausted(Exception):
+    """Every candidate replica in one failover round failed or shed.
+    ``retry_after_s`` (the smallest Retry-After any shed named) floors
+    the nap retry_with_backoff takes before the next round."""
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        if retry_after_s is not None:
+            self.retry_after_s = retry_after_s
+
+
+def _parse_retry_after(headers) -> Optional[float]:
+    raw = headers.get('Retry-After') if headers is not None else None
+    if raw is None:
+        return None
+    try:
+        return min(max(float(raw), 0.0), 60.0)
+    except (TypeError, ValueError):
+        return None
+
+
+def extract_routing_key(path: str, body: Optional[bytes],
+                        page_size: int) -> Optional[int]:
+    """Routing key for prefix affinity, or None (no affinity).
+
+    ``/generate`` keys on the page-chain hash of the first prompt's
+    token ids — the exact chain ``infer/paging.py`` uses for prefix-
+    page sharing, so affinity aligns with what the replica's prefix
+    cache can actually reuse.  The OpenAI text routes key on the
+    prompt text's leading bytes at page-size granularity (tokenization
+    happens replica-side; byte-prefix equality is a conservative
+    stand-in for token-prefix equality).  Malformed bodies yield None:
+    the router stays thin and lets the replica produce the 400.
+    """
+    if body is None or not path:
+        return None
+    try:
+        payload = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if path == '/generate':
+        prompts = payload.get('prompt_ids')
+        if (isinstance(prompts, list) and prompts
+                and isinstance(prompts[0], list) and prompts[0]):
+            try:
+                return paging.routing_key(
+                    [int(t) for t in prompts[0]], page_size)
+            except (TypeError, ValueError):
+                return None
+        return None
+    if path == '/v1/completions':
+        text = payload.get('prompt')
+    elif path == '/v1/chat/completions':
+        messages = payload.get('messages')
+        if not isinstance(messages, list):
+            return None
+        text = json.dumps(messages, sort_keys=True)
+    else:
+        return None
+    if not isinstance(text, str) or not text:
+        return None
+    # ~4 bytes/token keeps byte-prefix granularity near page
+    # granularity; chain_hashes needs an int sequence.
+    return paging.routing_key(list(text.encode()), page_size * 4)
+
+
+class Router:
+    """HTTP front-end + health loop.  See the module docstring for the
+    routing/failover contract."""
+
+    def __init__(self, replicas: Optional[List[str]] = None,
+                 port: int = 0, host: str = '127.0.0.1',
+                 health_interval_s: float =
+                 constants.ROUTER_HEALTH_INTERVAL_SECONDS,
+                 health_timeout_s: float =
+                 constants.ROUTER_HEALTH_TIMEOUT_SECONDS,
+                 attempt_timeout_s: float =
+                 constants.ROUTER_ATTEMPT_TIMEOUT_SECONDS,
+                 request_budget_s: float =
+                 constants.ROUTER_REQUEST_BUDGET_SECONDS,
+                 max_rounds: int = constants.ROUTER_MAX_ROUNDS,
+                 affinity_page_size: int =
+                 constants.ROUTER_AFFINITY_PAGE_SIZE,
+                 saturation_queue_depth: float =
+                 constants.ROUTER_SATURATION_QUEUE_DEPTH,
+                 failure_threshold: int =
+                 constants.ROUTER_CB_FAILURE_THRESHOLD,
+                 cooldown_s: float = constants.ROUTER_CB_COOLDOWN_SECONDS,
+                 registry: Optional[metrics_lib.Registry] = None):
+        self._host = host
+        self._port = port
+        self.health_interval_s = health_interval_s
+        self.health_timeout_s = health_timeout_s
+        self.attempt_timeout_s = attempt_timeout_s
+        self.request_budget_s = request_budget_s
+        self.max_rounds = max_rounds
+        self.affinity_page_size = affinity_page_size
+        self.saturation_queue_depth = saturation_queue_depth
+        self._failure_threshold = failure_threshold
+        self._cooldown_s = cooldown_s
+        self._met = _router_metrics(registry)
+        self.registry = (registry if registry is not None
+                         else metrics_lib.get_registry())
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, ReplicaView] = {}
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+        self._stop_evt = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        if replicas:
+            self.set_replicas(replicas)
+
+    # -- replica set --------------------------------------------------
+    def _new_view(self, url: str) -> ReplicaView:
+        return ReplicaView(url, CircuitBreaker(
+            failure_threshold=self._failure_threshold,
+            cooldown_s=self._cooldown_s,
+            on_transition=lambda state: self._met['circuit'].labels(
+                state=state).inc()))
+
+    def set_replicas(self, urls: List[str]) -> None:
+        """Reconcile the routing table; existing views (health +
+        breaker history) survive for URLs that stay."""
+        with self._lock:
+            keep = {u.rstrip('/') for u in urls}
+            for url in list(self._replicas):
+                if url not in keep:
+                    del self._replicas[url]
+            for url in keep:
+                if url not in self._replicas:
+                    self._replicas[url] = self._new_view(url)
+        self._publish_replica_gauges()
+
+    def add_replica(self, url: str) -> None:
+        with self._lock:
+            url = url.rstrip('/')
+            if url not in self._replicas:
+                self._replicas[url] = self._new_view(url)
+        self._publish_replica_gauges()
+
+    def remove_replica(self, url: str) -> None:
+        with self._lock:
+            self._replicas.pop(url.rstrip('/'), None)
+        self._publish_replica_gauges()
+
+    def mark_draining(self, url: str) -> None:
+        """Supervisor handshake: stop routing to a replica that is
+        about to be drained without waiting for the next probe."""
+        with self._lock:
+            view = self._replicas.get(url.rstrip('/'))
+            if view is not None:
+                view.health = 'draining'
+        self._publish_replica_gauges()
+
+    def views(self) -> List[ReplicaView]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def _publish_replica_gauges(self) -> None:
+        views = self.views()
+        self._met['replicas'].set(len(views))
+        self._met['routable'].set(
+            sum(1 for v in views if v.routable))
+
+    # -- health loop --------------------------------------------------
+    def _probe_replica(self, view: ReplicaView) -> str:
+        """One GET /health round trip -> observed state string."""
+        try:
+            resp = urllib.request.urlopen(
+                view.url + '/health', timeout=self.health_timeout_s)
+            with resp:
+                body = json.loads(resp.read() or b'{}')
+            return body.get('status', 'ok') if isinstance(body, dict) \
+                else 'ok'
+        except urllib.error.HTTPError as e:
+            # Three-state contract: 503 carries draining/unhealthy in
+            # the body.  An unreadable body is 'unhealthy' (the replica
+            # answered 503 but could not say why).
+            try:
+                body = json.loads(e.read() or b'{}')
+            except ValueError:
+                body = {}
+            finally:
+                e.close()
+            status = body.get('status') if isinstance(body, dict) else None
+            return status if status in ('draining', 'unhealthy') \
+                else 'unhealthy'
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError, http.client.HTTPException, ValueError):
+            return 'unreachable'
+
+    def _scrape_signals(self, view: ReplicaView) -> None:
+        try:
+            resp = urllib.request.urlopen(
+                view.url + '/metrics', timeout=self.health_timeout_s)
+            with resp:
+                parsed = metrics_lib.parse_exposition(
+                    resp.read().decode('utf-8', 'replace'))
+        except (urllib.error.URLError, urllib.error.HTTPError,
+                ConnectionError, TimeoutError, OSError,
+                http.client.HTTPException, ValueError):
+            return  # stale signals beat no routing at all
+        depth = metrics_lib.sample_value(
+            parsed, 'skytpu_decode_queue_depth')
+        if depth is not None:
+            view.queue_depth = depth
+        view.free_pages = metrics_lib.sample_value(
+            parsed, 'skytpu_kv_free_pages')
+        view.ttft_p99_s = metrics_lib.histogram_quantile(
+            parsed, 'skytpu_request_ttft_seconds', 0.99)
+
+    def _fetch_page_size(self, view: ReplicaView) -> None:
+        if view.page_size is not None:
+            return
+        try:
+            resp = urllib.request.urlopen(
+                view.url + '/health?verbose=1',
+                timeout=self.health_timeout_s)
+            with resp:
+                body = json.loads(resp.read() or b'{}')
+        except (urllib.error.URLError, urllib.error.HTTPError,
+                ConnectionError, TimeoutError, OSError,
+                http.client.HTTPException, ValueError):
+            return
+        ps = body.get('page_size') if isinstance(body, dict) else None
+        if isinstance(ps, int) and ps > 0:
+            view.page_size = ps
+            # Align affinity granularity with the replicas' actual
+            # prefix-cache page size (first reporter wins; a mixed
+            # fleet keeps the configured default).
+            with self._lock:
+                sizes = {v.page_size for v in self._replicas.values()
+                         if v.page_size}
+                if len(sizes) == 1:
+                    self.affinity_page_size = sizes.pop()
+
+    def health_tick(self) -> None:
+        """One pass over every replica: probe /health, feed the
+        breaker's half-open trial, scrape /metrics signals.  Public so
+        tests (and the supervisor) can drive it synchronously."""
+        for view in self.views():
+            status = self._probe_replica(view)
+            self._met['probes'].labels(result=status).inc()
+            if status == 'ok':
+                view.consecutive_probe_failures = 0
+                view.health = 'ok'
+                view.breaker.on_probe(True)
+                self._fetch_page_size(view)
+                self._scrape_signals(view)
+            else:
+                view.consecutive_probe_failures += 1
+                view.health = status
+                view.breaker.on_probe(False)
+        self._publish_replica_gauges()
+
+    def _health_loop(self) -> None:
+        while not self._stop_evt.wait(self.health_interval_s):
+            try:
+                self.health_tick()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('router health tick failed')
+
+    # -- selection ----------------------------------------------------
+    def _saturated(self, view: ReplicaView) -> bool:
+        if view.queue_depth >= self.saturation_queue_depth:
+            return True
+        return view.free_pages == 0.0 and view.queue_depth > 0
+
+    def select_replica(self, key: Optional[int],
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[ReplicaView]:
+        """Affine replica by rendezvous hash when it is routable and
+        unsaturated; least-loaded routable otherwise."""
+        exclude = exclude or set()
+        with self._lock:
+            candidates = [v for v in self._replicas.values()
+                          if v.routable and v.url not in exclude]
+        if not candidates:
+            return None
+        if key is not None:
+            affine = max(candidates,
+                         key=lambda v: hash((key, v.url)))
+            if not self._saturated(affine):
+                self._met['affinity'].labels(result='hit').inc()
+                return affine
+            self._met['affinity'].labels(result='miss').inc()
+        else:
+            self._met['affinity'].labels(result='none').inc()
+        return min(candidates,
+                   key=lambda v: (v.inflight + v.queue_depth, v.url))
+
+    # -- lifecycle ----------------------------------------------------
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f'http://{self._host}:{self.port}'
+
+    def start(self) -> None:
+        self._server = http_utils.HighBacklogHTTPServer(
+            (self._host, self._port), self._make_handler())
+        # poll_interval: shutdown() blocks until the serve loop's next
+        # poll; 50ms keeps stop()/drain latency (and every test
+        # teardown) snappy at negligible idle cost.
+        threading.Thread(
+            target=lambda: self._server.serve_forever(
+                poll_interval=0.05),
+            daemon=True, name='skytpu-router-http').start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True,
+            name='skytpu-router-health')
+        self._health_thread.start()
+        logger.info(f'router on :{self.port} over '
+                    f'{len(self.views())} replica(s)')
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5)
+            self._health_thread = None
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # -- proxy --------------------------------------------------------
+    def _make_handler(self):
+        router = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+            request_id = '-'
+
+            def log_message(self, format, *args):  # noqa: A002
+                logger.debug(f'{self.address_string()} '
+                             f'[{self.request_id}] {format % args}')
+
+            def _reply(self, code: int, body: dict,
+                       retry_after: Optional[float] = None) -> None:
+                data = json.dumps(body).encode()
+                try:
+                    self.send_response(code)
+                    self.send_header('X-Request-Id', self.request_id)
+                    self.send_header('Content-Type', 'application/json')
+                    self.send_header('Content-Length', str(len(data)))
+                    if retry_after is not None:
+                        self.send_header(
+                            'Retry-After', str(max(1, int(retry_after))))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except OSError:
+                    self.close_connection = True
+
+            def do_GET(self):  # noqa: N802
+                route = self.path.split('?', 1)[0]
+                self.request_id = router._request_id(self.headers)
+                if route == '/health':
+                    views = router.views()
+                    routable = sum(1 for v in views if v.routable)
+                    code = 200 if routable else 503
+                    self._reply(code, {
+                        'status': 'ok' if routable else 'unhealthy',
+                        'replicas': len(views),
+                        'routable': routable})
+                elif route == '/metrics':
+                    data = router.registry.expose().encode()
+                    try:
+                        self.send_response(200)
+                        self.send_header(
+                            'Content-Type',
+                            metrics_lib.CONTENT_TYPE_LATEST)
+                        self.send_header('Content-Length',
+                                         str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                    except OSError:
+                        self.close_connection = True
+                elif route == '/router/replicas':
+                    self._reply(200, {
+                        'replicas': [v.snapshot()
+                                     for v in router.views()]})
+                elif route == '/v1/models':
+                    router._proxy(self, body=None)
+                else:
+                    self._reply(404, {'error': 'not found'})
+
+            def do_POST(self):  # noqa: N802
+                route = self.path.split('?', 1)[0]
+                self.request_id = router._request_id(self.headers)
+                if route not in _PROXY_ROUTES:
+                    self._reply(404, {'error': 'not found'})
+                    return
+                try:
+                    length = int(self.headers.get('Content-Length', 0))
+                except ValueError:
+                    self._reply(400, {'error': 'bad Content-Length'})
+                    return
+                body = self.rfile.read(length) if length > 0 else b''
+                router._proxy(self, body=body)
+
+        return Handler
+
+    @staticmethod
+    def _request_id(headers) -> str:
+        incoming = headers.get('X-Request-Id', '')
+        if re.fullmatch(r'[A-Za-z0-9._:-]{1,64}', incoming or ''):
+            return incoming
+        return 'rtr-' + uuid.uuid4().hex[:16]
+
+    def _budget_from(self, body: Optional[bytes]) -> float:
+        """The router's failover budget never outlives the request's
+        own deadline (retrying a request whose deadline died just
+        manufactures 504s)."""
+        budget = self.request_budget_s
+        if body:
+            try:
+                payload = json.loads(body)
+                deadline = float(payload.get('deadline_s'))
+                if deadline > 0:
+                    budget = min(budget, deadline)
+            except (ValueError, TypeError, AttributeError):
+                pass
+        return budget
+
+    def _proxy(self, handler, body: Optional[bytes]) -> None:
+        path = handler.path
+        route = path.split('?', 1)[0]
+        key = extract_routing_key(route, body, self.affinity_page_size)
+        headers = {k: v for k, v in handler.headers.items()
+                   if k.lower() not in _HOP_HEADERS}
+        headers['X-Request-Id'] = handler.request_id
+        deadline = time.monotonic() + self._budget_from(body)
+        state = {'client_started': False, 'attempts': 0,
+                 'first_url': None, 'served_url': None,
+                 'retry_after': None}
+        tried: Set[str] = set()
+        t0 = time.perf_counter()
+
+        def _one_round():
+            state['retry_after'] = None
+            progressed = False
+            while True:
+                view = self.select_replica(key, exclude=tried)
+                if view is None:
+                    break
+                tried.add(view.url)
+                progressed = True
+                state['attempts'] += 1
+                if state['first_url'] is None:
+                    state['first_url'] = view.url
+                if self._attempt(handler, view, path, body, headers,
+                                 state):
+                    return
+            # Candidates exhausted (or none routable): next round may
+            # retry everyone once backoff/Retry-After has elapsed.
+            tried.clear()
+            raise _RoundExhausted(
+                'no replica delivered the request'
+                + ('' if progressed else ' (none routable)'),
+                retry_after_s=state['retry_after'])
+
+        try:
+            retry_lib.retry_with_backoff(
+                _one_round,
+                max_attempts=self.max_rounds,
+                base_delay_s=0.05, max_delay_s=2.0,
+                retry_on=(_RoundExhausted,),
+                remaining_s=lambda: deadline - time.monotonic(),
+                min_attempt_s=0.01,
+                describe='router failover')
+        except retry_lib.RetryError:
+            if not state['client_started']:
+                self._met['requests'].labels(outcome='unroutable').inc()
+                handler._reply(  # pylint: disable=protected-access
+                    503, {'error': 'no routable replica delivered the '
+                                   'request within the retry budget',
+                          'attempts': state['attempts'],
+                          'request_id': handler.request_id},
+                    retry_after=state['retry_after'] or 1)
+            else:
+                self._met['requests'].labels(
+                    outcome='aborted_midstream').inc()
+            return
+        finally:
+            self._met['latency'].observe(time.perf_counter() - t0)
+        if state['served_url'] is not None and \
+                state['served_url'] != state['first_url']:
+            self._met['failovers'].inc()
+        self._met['requests'].labels(outcome='ok').inc()
+
+    def _attempt(self, handler, view: ReplicaView, path: str,
+                 body: Optional[bytes], headers: Dict[str, str],
+                 state: dict) -> bool:
+        """One delivery attempt.  True = terminal (a response reached
+        the client, successfully or not); False = retry on another
+        replica.  A False return NEVER follows client-visible bytes —
+        that is the no-double-execution rule for streamed requests."""
+        chaos.maybe_hang('slow_replica')
+        with self._lock:
+            view.inflight += 1
+        try:
+            req = urllib.request.Request(
+                view.url + path, data=body, headers=headers,
+                method=handler.command)
+            try:
+                resp = urllib.request.urlopen(
+                    req, timeout=self.attempt_timeout_s)
+            except urllib.error.HTTPError as e:
+                with e:
+                    if e.code == 503:
+                        ra = _parse_retry_after(e.headers)
+                        if ra is not None and (
+                                state['retry_after'] is None
+                                or ra < state['retry_after']):
+                            state['retry_after'] = ra
+                        self._met['retries'].labels(
+                            reason='shed').inc()
+                        return False
+                    if e.code in _RETRYABLE_REPLICA_CODES:
+                        view.breaker.record_failure()
+                        self._met['retries'].labels(
+                            reason='replica_5xx').inc()
+                        return False
+                    # Deterministic replica answer (4xx, 504): the
+                    # client's to see, not the router's to retry.
+                    self._relay(handler, e, view, state)
+                    return True
+            except (urllib.error.URLError, ConnectionError,
+                    TimeoutError, OSError,
+                    http.client.HTTPException) as e:
+                view.breaker.record_failure()
+                self._met['retries'].labels(reason='conn_error').inc()
+                logger.warning(
+                    f'replica {view.url} failed ({e!r}); failing over')
+                return False
+            with resp:
+                if chaos.should_inject('proxy_disconnect'):
+                    # Upstream dropped after connect, before any client
+                    # byte: retryable by the idempotency rule.
+                    view.breaker.record_failure()
+                    self._met['retries'].labels(
+                        reason='conn_error').inc()
+                    return False
+                view.breaker.record_success()
+                state['served_url'] = view.url
+                self._relay(handler, resp, view, state)
+            return True
+        finally:
+            with self._lock:
+                view.inflight -= 1
+
+    def _relay(self, handler, resp, view: ReplicaView,
+               state: dict) -> None:
+        """Stream the replica response to the client in chunks (SSE
+        reaches the client incrementally).  The first byte here makes
+        the request non-retryable; mid-relay failures close the client
+        connection instead of resurfacing in the failover loop."""
+        try:
+            status = getattr(resp, 'status', None)
+            if status is None:
+                status = resp.code
+            state['client_started'] = True
+            handler.send_response(status)
+            seen = set()
+            for k, v in resp.headers.items():
+                if k.lower() in _HOP_HEADERS:
+                    continue
+                handler.send_header(k, v)
+                seen.add(k.lower())
+            if 'x-request-id' not in seen:
+                handler.send_header('X-Request-Id', handler.request_id)
+            handler.send_header('X-Served-By', view.url)
+            length = resp.headers.get('Content-Length')
+            if length is not None:
+                handler.send_header('Content-Length', length)
+                handler.end_headers()
+            else:
+                handler.send_header('Transfer-Encoding', 'chunked')
+                handler.end_headers()
+            while True:
+                chunk = resp.read1(64 * 1024)
+                if length is not None:
+                    if not chunk:
+                        break
+                    handler.wfile.write(chunk)
+                else:
+                    if not chunk:
+                        handler.wfile.write(b'0\r\n\r\n')
+                        break
+                    handler.wfile.write(f'{len(chunk):x}\r\n'.encode())
+                    handler.wfile.write(chunk)
+                    handler.wfile.write(b'\r\n')
+                handler.wfile.flush()
+        except (OSError, ConnectionError, TimeoutError,
+                http.client.HTTPException) as e:
+            logger.warning(f'mid-relay failure via {view.url}: {e!r}; '
+                           'closing client connection')
+            handler.close_connection = True

@@ -80,11 +80,26 @@ class ServiceRuntime:
             serve_state.remove_service(self.service_name)
 
 
+def _env_interval(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    return float(raw) if raw else None
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--service-name', required=True)
     args = parser.parse_args()
-    runtime = ServiceRuntime(args.service_name)
+    # Detached-runtime analogue of the kwargs core.up(mode='inline')
+    # honors: operational (and test) knobs for the control loops, since
+    # a process runtime has no kwargs channel.
+    runtime = ServiceRuntime(
+        args.service_name,
+        autoscaler_interval_seconds=_env_interval(
+            'SKYTPU_SERVE_AUTOSCALER_INTERVAL_SECONDS'),
+        probe_interval_seconds=_env_interval(
+            'SKYTPU_SERVE_PROBE_INTERVAL_SECONDS'),
+        lb_sync_interval_seconds=_env_interval(
+            'SKYTPU_SERVE_LB_SYNC_INTERVAL_SECONDS'))
     serve_state.set_service_controller_pid(args.service_name, os.getpid())
     stop_event = threading.Event()
 

@@ -16,6 +16,13 @@ site in the serving stack:
                     per-request containable failure
 ``client_disconnect``  the SSE write loop sees a broken pipe — the
                     cancel-on-disconnect path
+``replica_kill``    the replica supervisor SIGKILLs a live replica —
+                    the router's crash-failover + restart path
+``proxy_disconnect``  the router's upstream connection drops after
+                    connect, before any client byte — the retryable
+                    mid-proxy failover path
+``slow_replica``    the router's forward path stalls for ``hang_s``
+                    before delivery — the per-attempt timeout path
 ==================  ====================================================
 
 Schedules come from ``SKYTPU_CHAOS`` (or :func:`configure` in tests):
@@ -46,7 +53,10 @@ __all__ = ['FAULT_POINTS', 'ChaosError', 'ChaosController', 'active',
            'maybe_hang', 'maybe_raise', 'release_hangs', 'should_inject']
 
 FAULT_POINTS = ('step_raise', 'step_hang', 'alloc_exhaust',
-                'prefill_raise', 'client_disconnect')
+                'prefill_raise', 'client_disconnect',
+                # Router-level fault points (serve/router.py + the
+                # replica supervisor) — every failover path provable.
+                'replica_kill', 'proxy_disconnect', 'slow_replica')
 
 ENV_VAR = 'SKYTPU_CHAOS'
 

@@ -14,6 +14,13 @@ Budget awareness: callers with a wall-clock budget pass ``remaining_s``
 when the budget cannot fund another attempt, and skips the nap — retrying
 back-to-back — when the attempt still fits but the nap would starve it.
 
+Server-paced retries: an exception carrying a ``retry_after_s``
+attribute (e.g. an HTTP 503 with a ``Retry-After`` header) FLOORS the
+computed backoff — the server named the earliest useful retry time, so
+napping less would only buy another shed.  Under a budget, a floored
+nap that would starve the next attempt ends the loop instead of
+retrying early (the early retry is known-useless).
+
 This module is the one sanctioned home for long sleeps inside retry
 loops; skylint's ``sleep-discipline`` rule flags constant
 ``time.sleep(>=30)`` in loops everywhere else in the tree.
@@ -110,15 +117,27 @@ def retry_with_backoff(
                                       factor=factor,
                                       max_delay_s=max_delay_s,
                                       jitter=jitter, rng=rng)
+                retry_after = getattr(exc, 'retry_after_s', None)
+                if retry_after is not None:
+                    # The server named the earliest useful retry time;
+                    # napping less would only buy another shed.
+                    delay = max(delay, float(retry_after))
                 if remaining_s is not None:
                     rem = remaining_s()
                     if rem < min_attempt_s:
                         will_retry = False
                         delay = 0.0
                     elif rem - delay < min_attempt_s:
-                        # The attempt still fits but the nap would
-                        # starve it: retry back-to-back.
-                        delay = 0.0
+                        if retry_after is not None:
+                            # Retrying before the server-mandated
+                            # pace is known-useless: give up rather
+                            # than hammer early.
+                            will_retry = False
+                            delay = 0.0
+                        else:
+                            # The attempt still fits but the nap would
+                            # starve it: retry back-to-back.
+                            delay = 0.0
             if on_failure is not None:
                 on_failure(attempt, exc, will_retry, delay)
             if not will_retry:

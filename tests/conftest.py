@@ -26,19 +26,39 @@ import pytest
 
 @pytest.fixture(scope='session', autouse=True)
 def _xla_compilation_cache(tmp_path_factory):
-    """Session-wide persistent XLA compilation cache.  The suite
+    """Persistent XLA compilation cache shared ACROSS runs.  The suite
     compiles the same tiny-model graphs dozens of times across files
     (every engine build re-jits structurally identical prefill/decode
     programs); content-addressed reuse cuts tier-1 wall time ~35% on
-    CPU.  Scoped to a per-session tmp dir so runs never share stale
-    artifacts."""
-    cache_dir = tmp_path_factory.mktemp('jax_compile_cache')
+    CPU within one run, and a repeated run (the common dev loop) skips
+    most compiles outright.  Sharing is safe: jax keys entries by the
+    HLO + compile options + jax/jaxlib version, and the directory name
+    carries the version stamp too, so a toolchain bump starts a fresh
+    cache rather than reading stale artifacts.  Override the location
+    with SKYTPU_TEST_COMPILE_CACHE (point it at a per-run tmp dir to
+    force cold compiles)."""
+    import sys
+    import tempfile
+    stamp = (f'jax{jax.__version__}'
+             f'-py{sys.version_info.major}.{sys.version_info.minor}')
+    cache_dir = os.environ.get(
+        'SKYTPU_TEST_COMPILE_CACHE',
+        os.path.join(tempfile.gettempdir(),
+                     f'skytpu-test-xla-cache-{stamp}'))
+    os.makedirs(cache_dir, exist_ok=True)
     jax.config.update('jax_compilation_cache_dir', str(cache_dir))
     # Tiny test graphs compile fast and small — cache them all, not
     # just the >1s defaults.
     jax.config.update('jax_persistent_cache_min_compile_time_secs',
                       0.0)
     jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
+    # Export the same cache to child python processes (tests that
+    # isolate jax into a subprocess — quantized serving, train CLI
+    # runs — otherwise recompile everything cold; a resumed train run
+    # re-lowers the exact graphs its first run already compiled).
+    os.environ['JAX_COMPILATION_CACHE_DIR'] = str(cache_dir)
+    os.environ['JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS'] = '0'
+    os.environ['JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES'] = '0'
     yield
 
 

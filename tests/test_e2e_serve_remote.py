@@ -34,6 +34,17 @@ _SERVER_PY = (
 
 
 @pytest.fixture(autouse=True)
+def _fast_runtime(monkeypatch):
+    """The detached service runtime inherits env through the agent
+    chain (same route SKYTPU_STATE_DIR takes); production control-loop
+    intervals (10-20s) would make this test wait out several cycles."""
+    monkeypatch.setenv('SKYTPU_SERVE_AUTOSCALER_INTERVAL_SECONDS', '0.3')
+    monkeypatch.setenv('SKYTPU_SERVE_PROBE_INTERVAL_SECONDS', '0.3')
+    monkeypatch.setenv('SKYTPU_SERVE_LB_SYNC_INTERVAL_SECONDS', '0.4')
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _teardown():
     yield
     try:

@@ -347,7 +347,7 @@ class TestContinuousServer:
         assert srv.continuous
         srv.start()
         import threading
-        threading.Thread(target=srv._server.serve_forever,  # pylint: disable=protected-access
+        threading.Thread(target=lambda s=srv._server: s.serve_forever(poll_interval=0.05),  # pylint: disable=protected-access
                          daemon=True).start()
         prompts = [[5, 17, 3], [9, 1], [30, 31, 32], [4, 4, 4, 4]]
 

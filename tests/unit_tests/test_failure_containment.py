@@ -46,7 +46,7 @@ def _start_server(**kw):
                           allow_random_weights=True, page_size=8,
                           registry=reg, **kw)
     srv.start()
-    threading.Thread(target=srv._server.serve_forever,
+    threading.Thread(target=lambda s=srv._server: s.serve_forever(poll_interval=0.05),
                      daemon=True).start()
     return srv, reg, f'http://127.0.0.1:{srv.port}'
 

@@ -228,7 +228,7 @@ class TestServer:
             model='llama-tiny', port=0, host='127.0.0.1',
             max_batch_size=2, model_overrides=dict(_OVERRIDES))
         srv.start()
-        thread = threading.Thread(target=srv._server.serve_forever,  # pylint: disable=protected-access
+        thread = threading.Thread(target=lambda s=srv._server: s.serve_forever(poll_interval=0.05),  # pylint: disable=protected-access
                                   daemon=True)
         thread.start()
         try:

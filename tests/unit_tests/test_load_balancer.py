@@ -29,6 +29,15 @@ class _Replica:
                 pass
 
             def do_GET(self):
+                if self.path == '/health':
+                    # Health-probe traffic (the LB probes before every
+                    # forward) answers fast and never counts as a hit.
+                    body = b'{"status": "ok"}'
+                    self.send_response(200)
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 outer.hits += 1
                 if outer.delay:
                     time.sleep(outer.delay)
@@ -51,7 +60,7 @@ class _Replica:
         self.server.daemon_threads = True
         self.port = self.server.server_address[1]
         self.url = f'http://127.0.0.1:{self.port}'
-        threading.Thread(target=self.server.serve_forever,
+        threading.Thread(target=lambda s=self.server: s.serve_forever(poll_interval=0.05),
                          daemon=True).start()
 
     def stop(self):
@@ -70,7 +79,7 @@ def _lb():
     lb._server = http.server.ThreadingHTTPServer(
         ('127.0.0.1', 0), lb._make_handler())
     lb._server.daemon_threads = True
-    threading.Thread(target=lb._server.serve_forever, daemon=True).start()
+    threading.Thread(target=lambda s=lb._server: s.serve_forever(poll_interval=0.05), daemon=True).start()
     lb.url = f'http://127.0.0.1:{lb._server.server_address[1]}'
     yield lb
     lb.stop()
@@ -149,7 +158,11 @@ class TestLoadBalancer:
                 pass
 
             def do_GET(self):
-                outer_hits['n'] += 1
+                # 500s everything, /health included: a non-503 health
+                # answer keeps the replica routable, and probe traffic
+                # is not counted as request hits.
+                if self.path != '/health':
+                    outer_hits['n'] += 1
                 body = b'boom'
                 self.send_response(500)
                 self.send_header('Content-Length', str(len(body)))
@@ -182,7 +195,7 @@ class TestLoadBalancer:
         lb._server = http.server.ThreadingHTTPServer(
             ('127.0.0.1', 0), lb._make_handler())
         lb._server.daemon_threads = True
-        threading.Thread(target=lb._server.serve_forever,
+        threading.Thread(target=lambda s=lb._server: s.serve_forever(poll_interval=0.05),
                          daemon=True).start()
         url = f'http://127.0.0.1:{lb._server.server_address[1]}'
         slow = _Replica(delay=2.0)
@@ -202,6 +215,77 @@ class TestLoadBalancer:
         slow.stop()
         other.stop()
         lb.stop()
+
+    def test_probe_honors_the_three_state_health_contract(self):
+        """_probe GETs /health instead of bare TCP connect: a replica
+        whose listener accepts but whose health says draining/unhealthy
+        (503) is NOT routable, while a non-health-aware backend that
+        404s /health still is."""
+        state = {'status': 'ok'}
+
+        class H(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path != '/health':
+                    body = b'{}'
+                    self.send_response(404)
+                elif state['status'] == 'ok':
+                    body = b'{"status": "ok"}'
+                    self.send_response(200)
+                else:
+                    body = json.dumps(
+                        {'status': state['status']}).encode()
+                    self.send_response(503)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = http.server.ThreadingHTTPServer(('127.0.0.1', 0), H)
+        srv.daemon_threads = True
+        threading.Thread(target=lambda s=srv: s.serve_forever(poll_interval=0.05), daemon=True).start()
+        url = f'http://127.0.0.1:{srv.server_address[1]}'
+        try:
+            assert lb_lib._probe(url) is True
+            state['status'] = 'draining'
+            assert lb_lib._probe(url) is False
+            state['status'] = 'unhealthy'
+            assert lb_lib._probe(url) is False
+            state['status'] = 'ok'
+            assert lb_lib._probe(url) is True  # recovery re-admits
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_probe_404_and_dead_port_split_correctly(self):
+        class H404(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                self.send_response(404)
+                self.send_header('Content-Length', '0')
+                self.end_headers()
+
+        srv = http.server.ThreadingHTTPServer(('127.0.0.1', 0), H404)
+        srv.daemon_threads = True
+        threading.Thread(target=lambda s=srv: s.serve_forever(poll_interval=0.05), daemon=True).start()
+        try:
+            # A backend that does not speak the health protocol at all
+            # (404s /health) counts as up...
+            assert lb_lib._probe(
+                f'http://127.0.0.1:{srv.server_address[1]}') is True
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        # ...but nothing listening is down, and a garbage URL is down.
+        assert lb_lib._probe('http://127.0.0.1:1') is False
+        assert lb_lib._probe('http:///nohost') is False
 
     def test_slow_replica_does_not_block_others(self, _lb):
         slow = _Replica(delay=1.5)

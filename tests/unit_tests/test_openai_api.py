@@ -115,7 +115,7 @@ def oai_server():
         max_batch_size=2, model_overrides=dict(_OVERRIDES),
         allow_random_weights=True)
     srv.start()
-    thread = threading.Thread(target=srv._server.serve_forever,  # pylint: disable=protected-access
+    thread = threading.Thread(target=lambda s=srv._server: s.serve_forever(poll_interval=0.05),  # pylint: disable=protected-access
                               daemon=True)
     thread.start()
     yield f'http://127.0.0.1:{srv.port}'

@@ -176,3 +176,64 @@ def test_on_failure_hook_sees_retry_decisions():
 def test_max_attempts_must_be_positive():
     with pytest.raises(ValueError, match='max_attempts'):
         retry_lib.retry_with_backoff(lambda: None, max_attempts=0)
+
+
+class _ShedError(RuntimeError):
+    """Carries retry_after_s like an HTTP 503 with Retry-After."""
+
+    def __init__(self, retry_after_s):
+        super().__init__('shed')
+        self.retry_after_s = retry_after_s
+
+
+def test_retry_after_floors_the_backoff_nap():
+    """A server-paced exception must never be retried EARLIER than the
+    server asked — the computed backoff (here 0.1s) is floored up."""
+    sleeps = []
+    calls = {'n': 0}
+
+    def _fn():
+        calls['n'] += 1
+        if calls['n'] < 3:
+            raise _ShedError(7.5)
+        return 'ok'
+
+    out = retry_lib.retry_with_backoff(
+        _fn, max_attempts=4, base_delay_s=0.1, factor=1.0,
+        jitter='none', sleep=sleeps.append)
+    assert out == 'ok'
+    assert sleeps == [7.5, 7.5]
+
+
+def test_retry_after_does_not_shorten_longer_backoff():
+    sleeps = []
+
+    def _fn():
+        raise _ShedError(0.5)
+
+    with pytest.raises(retry_lib.RetryError):
+        retry_lib.retry_with_backoff(
+            _fn, max_attempts=3, base_delay_s=60.0, factor=1.0,
+            jitter='none', sleep=sleeps.append)
+    assert sleeps == [60.0, 60.0]  # max(backoff, retry_after)
+
+
+def test_retry_after_that_starves_the_budget_gives_up():
+    """Under a budget, a floored nap that would leave less than
+    min_attempt_s ends the loop — retrying before the server's pace is
+    known-useless, so no early hammer and no wasted attempt."""
+    sleeps = []
+    calls = {'n': 0}
+
+    def _fn():
+        calls['n'] += 1
+        raise _ShedError(300.0)
+
+    with pytest.raises(retry_lib.RetryError) as ei:
+        retry_lib.retry_with_backoff(
+            _fn, max_attempts=5, base_delay_s=0.1, jitter='none',
+            remaining_s=lambda: 200.0, min_attempt_s=10.0,
+            sleep=sleeps.append)
+    assert calls['n'] == 1        # no back-to-back early retry
+    assert sleeps == []           # and no nap it could not afford
+    assert ei.value.attempts == 1

@@ -1,0 +1,726 @@
+"""serve/router unit suite: circuit breaker state machine, replica
+selection (three-state health + prefix affinity + saturation
+fallback), routing-key extraction, the metrics-driven autoscaler
+policy, and the proxy/failover path over scriptable fake replicas.
+
+The subprocess-free half of the data-plane contract; the engine-backed
+end-to-end story (kill mid-decode, drain scale-down, supervisor
+restarts) lives in test_router_e2e.py.
+"""
+import http.server
+import json
+import threading
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from skypilot_tpu.infer import paging
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.serve import replica_supervisor as sup_lib
+from skypilot_tpu.serve import router as router_lib
+from skypilot_tpu.serve.router import CircuitBreaker, ReplicaView, Router
+from skypilot_tpu.utils import chaos
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    chaos.disable()
+    yield
+    chaos.disable()
+
+
+# -- circuit breaker ---------------------------------------------------
+
+class _Clock:
+
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+def _breaker(threshold=3, cooldown=5.0, transitions=None):
+    clk = _Clock()
+    cb = CircuitBreaker(
+        failure_threshold=threshold, cooldown_s=cooldown, clock=clk,
+        on_transition=(transitions.append
+                       if transitions is not None else None))
+    return cb, clk
+
+
+class TestCircuitBreaker:
+
+    def test_opens_only_after_consecutive_failure_threshold(self):
+        cb, _ = _breaker(threshold=3)
+        cb.record_failure()
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.CLOSED
+        assert cb.allows_requests
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN
+        assert not cb.allows_requests
+
+    def test_success_resets_the_failure_streak(self):
+        cb, _ = _breaker(threshold=3)
+        cb.record_failure()
+        cb.record_failure()
+        cb.record_success()
+        cb.record_failure()
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.CLOSED  # streak broken
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN
+
+    def test_half_open_after_cooldown_then_closes_on_success(self):
+        cb, clk = _breaker(threshold=1, cooldown=5.0)
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN
+        clk.now += 4.99
+        assert cb.state == CircuitBreaker.OPEN
+        clk.now += 0.01
+        assert cb.state == CircuitBreaker.HALF_OPEN
+        assert cb.allows_requests  # the trial request may pass
+        cb.record_success()
+        assert cb.state == CircuitBreaker.CLOSED
+
+    def test_half_open_failure_reopens_with_a_fresh_cooldown(self):
+        cb, clk = _breaker(threshold=1, cooldown=5.0)
+        cb.record_failure()
+        clk.now += 5.0
+        assert cb.state == CircuitBreaker.HALF_OPEN
+        cb.record_failure()  # the trial failed
+        assert cb.state == CircuitBreaker.OPEN
+        clk.now += 4.99  # the cooldown restarted at the trial failure
+        assert cb.state == CircuitBreaker.OPEN
+        clk.now += 0.01
+        assert cb.state == CircuitBreaker.HALF_OPEN
+
+    def test_reclosed_breaker_needs_a_full_streak_to_reopen(self):
+        cb, clk = _breaker(threshold=2, cooldown=1.0)
+        cb.record_failure()
+        cb.record_failure()
+        clk.now += 1.0
+        cb.record_success()  # half-open trial succeeded
+        assert cb.state == CircuitBreaker.CLOSED
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.CLOSED  # not hair-triggered
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN
+
+    def test_probe_only_acts_in_half_open(self):
+        cb, clk = _breaker(threshold=2, cooldown=5.0)
+        for _ in range(10):
+            cb.on_probe(False)  # probes never trip a closed breaker
+        assert cb.state == CircuitBreaker.CLOSED
+        cb.record_failure()
+        cb.record_failure()
+        cb.on_probe(True)  # ...and never short-circuit a cooldown
+        assert cb.state == CircuitBreaker.OPEN
+        clk.now += 5.0
+        cb.on_probe(True)  # the probe IS the half-open trial
+        assert cb.state == CircuitBreaker.CLOSED
+
+    def test_transition_hook_sees_every_state_change(self):
+        seen = []
+        cb, clk = _breaker(threshold=1, cooldown=1.0, transitions=seen)
+        cb.record_failure()
+        clk.now += 1.0
+        _ = cb.state  # lazy open -> half_open evaluation
+        cb.record_success()
+        assert seen == [CircuitBreaker.OPEN, CircuitBreaker.HALF_OPEN,
+                        CircuitBreaker.CLOSED]
+
+    def test_threshold_must_be_positive(self):
+        with pytest.raises(ValueError, match='failure_threshold'):
+            CircuitBreaker(failure_threshold=0)
+
+
+# -- routing-key extraction --------------------------------------------
+
+class TestExtractRoutingKey:
+
+    def test_generate_keys_on_the_paging_chain_hash(self):
+        ids = list(range(40))
+        body = json.dumps({'prompt_ids': [ids]}).encode()
+        key = router_lib.extract_routing_key('/generate', body, 16)
+        assert key == paging.routing_key(ids, 16)
+
+    def test_shared_first_page_shares_the_key(self):
+        a = list(range(16)) + [100, 101]
+        b = list(range(16)) + [200]
+        key_a = router_lib.extract_routing_key(
+            '/generate', json.dumps({'prompt_ids': [a]}).encode(), 16)
+        key_b = router_lib.extract_routing_key(
+            '/generate', json.dumps({'prompt_ids': [b]}).encode(), 16)
+        assert key_a == key_b  # affinity at prefix-page granularity
+        c = [7] * 16 + [100]
+        key_c = router_lib.extract_routing_key(
+            '/generate', json.dumps({'prompt_ids': [c]}).encode(), 16)
+        assert key_c != key_a
+
+    def test_completions_keys_on_the_prompt_text(self):
+        body = json.dumps({'prompt': 'once upon a time ' * 20}).encode()
+        key = router_lib.extract_routing_key('/v1/completions', body, 16)
+        assert key is not None
+        again = router_lib.extract_routing_key('/v1/completions',
+                                               body, 16)
+        assert key == again
+
+    def test_chat_keys_on_the_canonicalized_messages(self):
+        msgs = [{'role': 'user', 'content': 'hello there, general'}]
+        b1 = json.dumps({'messages': msgs}).encode()
+        # Same messages, different JSON key order in the envelope.
+        b2 = json.dumps({'model': 'x', 'messages': msgs}).encode()
+        k1 = router_lib.extract_routing_key('/v1/chat/completions',
+                                            b1, 16)
+        k2 = router_lib.extract_routing_key('/v1/chat/completions',
+                                            b2, 16)
+        assert k1 is not None and k1 == k2
+
+    def test_malformed_bodies_yield_no_key(self):
+        cases = [
+            ('/generate', b'not json'),
+            ('/generate', b'[1, 2]'),
+            ('/generate', json.dumps({'prompt_ids': []}).encode()),
+            ('/generate', json.dumps({'prompt_ids': 'abc'}).encode()),
+            ('/v1/completions', json.dumps({'prompt': ''}).encode()),
+            ('/v1/completions', json.dumps({'prompt': 7}).encode()),
+            ('/v1/chat/completions',
+             json.dumps({'messages': 'hi'}).encode()),
+            ('/unknown', json.dumps({'prompt': 'x'}).encode()),
+            ('/generate', None),
+        ]
+        for path, body in cases:
+            assert router_lib.extract_routing_key(path, body, 16) \
+                is None, (path, body)
+
+
+# -- replica selection -------------------------------------------------
+
+def _router(urls, **kw):
+    kw.setdefault('registry', metrics_lib.Registry())
+    return Router(replicas=urls, **kw)
+
+
+def _mark_ok(router, urls=None):
+    for v in router.views():
+        if urls is None or v.url in urls:
+            v.health = 'ok'
+
+
+class TestSelection:
+
+    def test_only_ok_replicas_are_candidates(self):
+        r = _router(['http://a:1', 'http://b:1', 'http://c:1',
+                     'http://d:1'])
+        views = {v.url: v for v in r.views()}
+        views['http://a:1'].health = 'ok'
+        views['http://b:1'].health = 'draining'
+        views['http://c:1'].health = 'unhealthy'
+        views['http://d:1'].health = 'unreachable'
+        for _ in range(20):
+            pick = r.select_replica(key=None)
+            assert pick is not None and pick.url == 'http://a:1'
+
+    def test_open_breaker_disqualifies_an_ok_replica(self):
+        r = _router(['http://a:1', 'http://b:1'],
+                    failure_threshold=1)
+        _mark_ok(r)
+        views = {v.url: v for v in r.views()}
+        views['http://a:1'].breaker.record_failure()
+        for _ in range(10):
+            assert r.select_replica(key=None).url == 'http://b:1'
+
+    def test_no_routable_replica_selects_none(self):
+        r = _router(['http://a:1'])
+        assert r.select_replica(key=None) is None  # health unknown
+        _mark_ok(r)
+        assert r.select_replica(key=12345,
+                                exclude={'http://a:1'}) is None
+
+    def test_affinity_is_sticky_per_key_across_calls(self):
+        urls = [f'http://replica-{i}:1' for i in range(5)]
+        r = _router(urls)
+        _mark_ok(r)
+        for key in (11, 22, 33, 44):
+            picks = {r.select_replica(key=key).url for _ in range(8)}
+            assert len(picks) == 1, (key, picks)
+        # Different keys spread across the fleet (rendezvous, not a
+        # single hot replica).
+        spread = {r.select_replica(key=k).url for k in range(64)}
+        assert len(spread) >= 2
+
+    def test_affinity_survives_unrelated_replica_removal(self):
+        urls = [f'http://replica-{i}:1' for i in range(5)]
+        r = _router(urls)
+        _mark_ok(r)
+        key = 777
+        home = r.select_replica(key=key).url
+        victim = next(u for u in urls if u != home)
+        r.remove_replica(victim)
+        assert r.select_replica(key=key).url == home
+
+    def test_saturated_affine_replica_falls_back_to_least_loaded(self):
+        r = _router(['http://a:1', 'http://b:1'],
+                    saturation_queue_depth=4.0)
+        _mark_ok(r)
+        key = 42
+        home = r.select_replica(key=key)
+        other = next(v for v in r.views() if v.url != home.url)
+        home.queue_depth = 4.0  # at the saturation threshold
+        other.queue_depth = 1.0
+        assert r.select_replica(key=key).url == other.url
+        # Page starvation with queued work saturates too.
+        home.queue_depth = 1.0
+        home.free_pages = 0.0
+        other.queue_depth = 0.0
+        assert r.select_replica(key=key).url == other.url
+        # Recovered -> affinity resumes.
+        home.free_pages = 32.0
+        assert r.select_replica(key=key).url == home.url
+
+    def test_keyless_requests_go_least_loaded(self):
+        r = _router(['http://a:1', 'http://b:1'])
+        _mark_ok(r)
+        views = {v.url: v for v in r.views()}
+        views['http://a:1'].queue_depth = 3.0
+        views['http://b:1'].queue_depth = 0.0
+        assert r.select_replica(key=None).url == 'http://b:1'
+        views['http://b:1'].inflight = 5  # router-side load counts too
+        assert r.select_replica(key=None).url == 'http://a:1'
+
+    def test_mark_draining_takes_effect_before_the_next_probe(self):
+        r = _router(['http://a:1', 'http://b:1'])
+        _mark_ok(r)
+        r.mark_draining('http://a:1/')
+        for _ in range(10):
+            assert r.select_replica(key=None).url == 'http://b:1'
+
+    def test_set_replicas_keeps_surviving_state(self):
+        r = _router(['http://a:1', 'http://b:1'])
+        _mark_ok(r)
+        views = {v.url: v for v in r.views()}
+        views['http://a:1'].queue_depth = 7.0
+        r.set_replicas(['http://a:1', 'http://c:1'])
+        views = {v.url: v for v in r.views()}
+        assert set(views) == {'http://a:1', 'http://c:1'}
+        assert views['http://a:1'].health == 'ok'
+        assert views['http://a:1'].queue_depth == 7.0
+        assert views['http://c:1'].health == 'unknown'
+
+
+# -- request-id hygiene ------------------------------------------------
+
+class TestRequestId:
+
+    def test_wellformed_client_id_passes_through(self):
+        class _H(dict):
+            pass
+
+        h = {'X-Request-Id': 'bench-abc.123:run-7'}
+        assert Router._request_id(h) == 'bench-abc.123:run-7'
+
+    def test_missing_or_hostile_ids_are_replaced(self):
+        for bad in ('', 'x' * 65, 'has space', 'crlf\r\ninjected',
+                    'émoji'):
+            got = Router._request_id({'X-Request-Id': bad})
+            assert got.startswith('rtr-') and len(got) == 20, bad
+        assert Router._request_id({}).startswith('rtr-')
+
+
+# -- autoscaler policy -------------------------------------------------
+
+class _StubView:
+
+    def __init__(self, queue_depth=0.0, free_pages=None, routable=True):
+        self.queue_depth = queue_depth
+        self.free_pages = free_pages
+        self.routable = routable
+
+
+class TestEngineSignalsAutoscaler:
+
+    def test_upscale_needs_patience_not_one_spike(self):
+        a = sup_lib.EngineSignalsAutoscaler(
+            min_replicas=1, queue_high=4.0, upscale_patience=2)
+        hot = [_StubView(queue_depth=8.0)]
+        assert a.desired(hot, 2) == 2          # first hot evaluation
+        assert a.desired(hot, 2) == 3          # second -> +1
+        assert a.desired(hot, 3) == 3          # counter was consumed
+
+    def test_calm_evaluation_resets_the_upscale_streak(self):
+        a = sup_lib.EngineSignalsAutoscaler(
+            min_replicas=1, queue_high=4.0, queue_low=0.5,
+            upscale_patience=2)
+        assert a.desired([_StubView(queue_depth=8.0)], 2) == 2
+        assert a.desired([_StubView(queue_depth=2.0)], 2) == 2
+        assert a.desired([_StubView(queue_depth=8.0)], 2) == 2
+        assert a.desired([_StubView(queue_depth=8.0)], 2) == 3
+
+    def test_downscale_is_lazier_and_floors_at_min(self):
+        a = sup_lib.EngineSignalsAutoscaler(
+            min_replicas=1, queue_low=0.5, downscale_patience=3)
+        idle = [_StubView(queue_depth=0.0)]
+        assert a.desired(idle, 2) == 2
+        assert a.desired(idle, 2) == 2
+        assert a.desired(idle, 2) == 1         # third quiet eval -> -1
+        for _ in range(10):
+            assert a.desired(idle, 1) == 1     # never below min
+
+    def test_page_starvation_counts_as_load(self):
+        a = sup_lib.EngineSignalsAutoscaler(
+            min_replicas=1, queue_high=100.0, upscale_patience=1)
+        starved = [_StubView(queue_depth=1.0, free_pages=0.0)]
+        assert a.desired(starved, 1) == 2
+
+    def test_blind_fleet_holds_instead_of_flapping(self):
+        a = sup_lib.EngineSignalsAutoscaler(min_replicas=1,
+                                            downscale_patience=1)
+        dark = [_StubView(routable=False)]
+        assert a.desired(dark, 3) == 3
+        assert a.desired([], 0) == 1  # but never below min
+
+    def test_max_replicas_caps_upscale(self):
+        a = sup_lib.EngineSignalsAutoscaler(
+            min_replicas=1, max_replicas=2, queue_high=1.0,
+            upscale_patience=1)
+        hot = [_StubView(queue_depth=50.0)]
+        assert a.desired(hot, 2) == 2
+
+    def test_bounds_validation(self):
+        with pytest.raises(ValueError, match='min_replicas'):
+            sup_lib.EngineSignalsAutoscaler(min_replicas=0)
+        with pytest.raises(ValueError, match='max_replicas'):
+            sup_lib.EngineSignalsAutoscaler(min_replicas=3,
+                                            max_replicas=2)
+
+
+# -- proxy/failover over scriptable fake replicas ----------------------
+
+class _FakeReplica:
+    """A scriptable stand-in for an inference replica: /health speaks
+    the three-state contract, /metrics exposes a queue-depth gauge,
+    POSTs answer per ``mode``."""
+
+    def __init__(self, mode='ok', health='ok', queue_depth=0.0,
+                 retry_after=None):
+        self.mode = mode            # ok | shed | err500 | err404
+        self.health = health        # ok | draining | unhealthy
+        self.queue_depth = queue_depth
+        self.retry_after = retry_after
+        self.hits = []              # (path, request_id) per POST
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, payload, headers=()):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                route = self.path.split('?', 1)[0]
+                if route == '/health':
+                    code = 200 if outer.health == 'ok' else 503
+                    self._send(code, {'status': outer.health})
+                elif route == '/metrics':
+                    text = ('# TYPE skytpu_decode_queue_depth gauge\n'
+                            f'skytpu_decode_queue_depth '
+                            f'{outer.queue_depth}\n')
+                    data = text.encode()
+                    self.send_response(200)
+                    self.send_header('Content-Length', str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                else:
+                    self._send(404, {'error': 'not found'})
+
+            def do_POST(self):
+                n = int(self.headers.get('Content-Length', 0))
+                self.rfile.read(n)
+                outer.hits.append(
+                    (self.path, self.headers.get('X-Request-Id')))
+                if outer.mode == 'shed':
+                    hdrs = ()
+                    if outer.retry_after is not None:
+                        hdrs = (('Retry-After',
+                                 str(outer.retry_after)),)
+                    self._send(503, {'error': 'queue full'}, hdrs)
+                elif outer.mode == 'err500':
+                    self._send(500, {'error': 'boom'})
+                elif outer.mode == 'err404':
+                    self._send(404, {'error': 'no such model'})
+                else:
+                    self._send(200, {'text': f'from {outer.port}',
+                                     'port': outer.port})
+
+        self.server = http.server.ThreadingHTTPServer(('127.0.0.1', 0),
+                                                      H)
+        self.server.daemon_threads = True
+        self.port = self.server.server_address[1]
+        self.url = f'http://127.0.0.1:{self.port}'
+        threading.Thread(target=lambda s=self.server: s.serve_forever(poll_interval=0.05),
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _start_router(urls, **kw):
+    kw.setdefault('registry', metrics_lib.Registry())
+    kw.setdefault('health_interval_s', 3600.0)  # ticked by hand
+    kw.setdefault('attempt_timeout_s', 10.0)
+    kw.setdefault('request_budget_s', 10.0)
+    r = Router(replicas=urls, **kw)
+    r.start()
+    r.health_tick()
+    return r
+
+
+def _post(base, path='/v1/completions', body=None, timeout=15,
+          headers=None):
+    data = json.dumps(body if body is not None
+                      else {'prompt': 'hi', 'max_tokens': 1}).encode()
+    req = urllib.request.Request(base + path, data=data,
+                                 headers=dict(headers or ()),
+                                 method='POST')
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, dict(e.headers), e.read()
+
+
+class TestRouterProxy:
+
+    def test_proxies_and_stamps_request_id_and_served_by(self):
+        rep = _FakeReplica()
+        router = _start_router([rep.url])
+        try:
+            code, headers, body = _post(
+                router.url, headers={'X-Request-Id': 'client-1'})
+            assert code == 200
+            assert json.loads(body)['port'] == rep.port
+            assert headers['X-Request-Id'] == 'client-1'
+            assert headers['X-Served-By'] == rep.url
+            assert rep.hits == [('/v1/completions', 'client-1')]
+        finally:
+            router.stop()
+            rep.stop()
+
+    def test_dead_replica_fails_over_without_a_client_error(self):
+        live = _FakeReplica()
+        router = _start_router([live.url])
+        # A registered-but-dead replica the router has not probed yet:
+        # health 'unknown' is unroutable, so force it visible.
+        router.add_replica('http://127.0.0.1:1')
+        for v in router.views():
+            v.health = 'ok'
+        try:
+            codes = [_post(router.url)[0] for _ in range(6)]
+            assert codes == [200] * 6
+            reg = router.registry
+            parsed = metrics_lib.parse_exposition(reg.expose())
+            assert metrics_lib.sample_value(
+                parsed, 'skytpu_router_requests_total',
+                outcome='ok') == 6.0
+        finally:
+            router.stop()
+            live.stop()
+
+    def test_shed_replica_retries_elsewhere_and_counts_it(self):
+        shedding = _FakeReplica(mode='shed', retry_after=1)
+        live = _FakeReplica()
+        router = _start_router([shedding.url, live.url])
+        try:
+            # Pin load so least-loaded prefers the shedding replica
+            # first (keyless body: no prompt, no affinity): every
+            # request must still end on the live one.
+            views = {v.url: v for v in router.views()}
+            views[live.url].queue_depth = 5.0
+            code, headers, _ = _post(router.url,
+                                     body={'max_tokens': 1})
+            assert code == 200
+            assert headers['X-Served-By'] == live.url
+            assert len(shedding.hits) == 1  # shed once, failed over
+            parsed = metrics_lib.parse_exposition(
+                router.registry.expose())
+            assert metrics_lib.sample_value(
+                parsed, 'skytpu_router_retries_total',
+                reason='shed') == 1.0
+            assert metrics_lib.sample_value(
+                parsed, 'skytpu_router_failovers_total') == 1.0
+            # A shed is backpressure, not failure: breaker untouched.
+            assert views[shedding.url].breaker.state == \
+                CircuitBreaker.CLOSED
+        finally:
+            router.stop()
+            shedding.stop()
+            live.stop()
+
+    def test_replica_500_retries_and_trips_the_breaker(self):
+        erroring = _FakeReplica(mode='err500')
+        live = _FakeReplica()
+        router = _start_router([erroring.url, live.url],
+                               failure_threshold=2)
+        try:
+            views = {v.url: v for v in router.views()}
+            views[live.url].queue_depth = 5.0
+            for _ in range(2):
+                code, headers, _ = _post(router.url,
+                                         body={'max_tokens': 1})
+                assert code == 200
+                assert headers['X-Served-By'] == live.url
+            # Two delivery failures == the threshold: circuit open,
+            # the erroring replica no longer sees traffic.
+            assert views[erroring.url].breaker.state == \
+                CircuitBreaker.OPEN
+            before = len(erroring.hits)
+            assert _post(router.url)[0] == 200
+            assert len(erroring.hits) == before
+        finally:
+            router.stop()
+            erroring.stop()
+            live.stop()
+
+    def test_deterministic_replica_4xx_is_relayed_not_retried(self):
+        bad = _FakeReplica(mode='err404')
+        other = _FakeReplica()
+        router = _start_router([bad.url, other.url])
+        try:
+            views = {v.url: v for v in router.views()}
+            views[other.url].queue_depth = 5.0
+            code, _, body = _post(router.url, body={'max_tokens': 1})
+            assert code == 404
+            assert b'no such model' in body
+            assert len(bad.hits) == 1 and len(other.hits) == 0
+        finally:
+            router.stop()
+            bad.stop()
+            other.stop()
+
+    def test_all_replicas_shedding_is_503_with_retry_after(self):
+        # Retry-After 2: distinct from the 1s default floor (so the
+        # assert proves propagation, not the fallback) but small — the
+        # router honors it with a REAL sleep between rounds.
+        reps = [_FakeReplica(mode='shed', retry_after=2)
+                for _ in range(2)]
+        router = _start_router([r.url for r in reps], max_rounds=2)
+        try:
+            code, headers, body = _post(router.url)
+            assert code == 503
+            assert headers.get('Retry-After') == '2'
+            payload = json.loads(body)
+            assert 'request_id' in payload
+            # Two rounds over two replicas.
+            assert payload['attempts'] == 4
+        finally:
+            router.stop()
+            for r in reps:
+                r.stop()
+
+    def test_draining_replica_gets_zero_new_requests(self):
+        a, b = _FakeReplica(), _FakeReplica()
+        router = _start_router([a.url, b.url])
+        try:
+            a.health = 'draining'
+            router.health_tick()
+            for _ in range(8):
+                assert _post(router.url)[0] == 200
+            assert a.hits == []
+            assert len(b.hits) == 8
+        finally:
+            router.stop()
+            a.stop()
+            b.stop()
+
+    def test_health_tick_tracks_the_three_states_and_recovery(self):
+        rep = _FakeReplica()
+        router = _start_router([rep.url])
+        try:
+            view = router.views()[0]
+            assert view.health == 'ok'
+            rep.health = 'unhealthy'
+            router.health_tick()
+            assert view.health == 'unhealthy' and not view.routable
+            rep.health = 'ok'
+            router.health_tick()
+            assert view.routable
+            # /metrics signals came along with the ok probe.
+            rep.queue_depth = 3.5
+            router.health_tick()
+            assert view.queue_depth == 3.5
+        finally:
+            router.stop()
+            rep.stop()
+
+    def test_router_health_endpoint_reflects_routability(self):
+        rep = _FakeReplica()
+        router = _start_router([rep.url])
+        try:
+            with urllib.request.urlopen(router.url + '/health',
+                                        timeout=5) as r:
+                assert r.status == 200
+                assert json.loads(r.read())['routable'] == 1
+            rep.health = 'unhealthy'
+            router.health_tick()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(router.url + '/health',
+                                       timeout=5)
+            with ei.value:
+                assert ei.value.code == 503
+        finally:
+            router.stop()
+            rep.stop()
+
+    def test_proxy_disconnect_chaos_is_retried_pre_stream(self):
+        a, b = _FakeReplica(), _FakeReplica()
+        router = _start_router([a.url, b.url])
+        try:
+            chaos.configure('proxy_disconnect:n=1')
+            code, headers, _ = _post(router.url)
+            assert code == 200  # invisible to the client
+            parsed = metrics_lib.parse_exposition(
+                router.registry.expose())
+            assert metrics_lib.sample_value(
+                parsed, 'skytpu_router_retries_total',
+                reason='conn_error') == 1.0
+        finally:
+            chaos.disable()
+            router.stop()
+            a.stop()
+            b.stop()
+
+    def test_concurrent_requests_spread_and_all_succeed(self):
+        reps = [_FakeReplica() for _ in range(3)]
+        router = _start_router([r.url for r in reps])
+        try:
+            n = 30
+            with ThreadPoolExecutor(8) as pool:
+                codes = list(pool.map(
+                    lambda i: _post(
+                        router.url,
+                        body={'prompt': f'p{i}', 'max_tokens': 1})[0],
+                    range(n)))
+            assert codes == [200] * n
+            hit_counts = [len(r.hits) for r in reps]
+            assert sum(hit_counts) == n
+            assert all(c > 0 for c in hit_counts), hit_counts
+        finally:
+            router.stop()
+            for r in reps:
+                r.stop()

@@ -341,7 +341,7 @@ class TestScaleToZero:
             scale_from_zero_wait_seconds=20)
         lb._server = lb_lib.LBHTTPServer(
             ('127.0.0.1', 0), lb._make_handler())
-        threading.Thread(target=lb._server.serve_forever,
+        threading.Thread(target=lambda s=lb._server: s.serve_forever(poll_interval=0.05),
                          daemon=True).start()
         url = f'http://127.0.0.1:{lb._server.server_address[1]}'
 
@@ -358,7 +358,7 @@ class TestScaleToZero:
 
         replica_srv = http_server.ThreadingHTTPServer(
             ('127.0.0.1', 0), _Replica)
-        threading.Thread(target=replica_srv.serve_forever,
+        threading.Thread(target=lambda s=replica_srv: s.serve_forever(poll_interval=0.05),
                          daemon=True).start()
         replica_url = \
             f'http://127.0.0.1:{replica_srv.server_address[1]}'

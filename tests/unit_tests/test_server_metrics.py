@@ -30,7 +30,7 @@ def server():
                           allow_random_weights=True, page_size=8,
                           registry=reg)
     srv.start()
-    thread = threading.Thread(target=srv._server.serve_forever,
+    thread = threading.Thread(target=lambda s=srv._server: s.serve_forever(poll_interval=0.05),
                               daemon=True)
     thread.start()
     try:
@@ -78,8 +78,11 @@ def test_metrics_scrape_after_round_trip(server):
     # contract does not know.
     scraped = {line.split(' ')[2] for line in text.splitlines()
                if line.startswith('# TYPE ')}
+    # skytpu_train_* lives in the trainer and skytpu_router_* in the
+    # router/supervisor process — neither is a replica-side series.
     expected = {n for n in observability.METRIC_CONTRACT
-                if not n.startswith('skytpu_train_')}
+                if not n.startswith(('skytpu_train_',
+                                     'skytpu_router_'))}
     assert scraped == expected, scraped ^ expected
     # Exposition format details the contract set cannot express:
     for needle in ('skytpu_request_ttft_seconds_bucket',

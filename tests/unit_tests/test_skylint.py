@@ -382,6 +382,53 @@ def test_sleep_discipline_negatives_and_retry_py_scope(tmp_path):
                            _LONG_NAP_LOOP, rule='sleep-discipline'))
 
 
+# ---------------------------------------------------------------------
+# net-timeout
+# ---------------------------------------------------------------------
+
+_NET_NO_TIMEOUT = """
+    import http.client
+    import urllib.request
+
+    def probe(url, host):
+        r = urllib.request.urlopen(url)
+        c = http.client.HTTPConnection(host)
+        return r, c
+"""
+
+_NET_WITH_TIMEOUT = """
+    import http.client
+    import urllib.request
+
+    def probe(url, host, **kw):
+        a = urllib.request.urlopen(url, timeout=3.0)
+        b = urllib.request.urlopen(url, None, 3.0)   # positional
+        c = http.client.HTTPConnection(host, timeout=2)
+        d = urllib.request.urlopen(url, **kw)        # forwarded surface
+        return a, b, c, d
+"""
+
+
+def test_net_timeout_flags_unbounded_calls_in_serving_path(tmp_path):
+    findings = _live(_lint(
+        tmp_path, 'skypilot_tpu/serve/probe.py', _NET_NO_TIMEOUT,
+        rule='net-timeout'))
+    symbols = sorted(f.symbol for f in findings)
+    assert symbols == ['http.client.HTTPConnection', 'urlopen']
+
+
+def test_net_timeout_bounded_calls_and_scope_are_clean(tmp_path):
+    assert not _live(_lint(
+        tmp_path, 'skypilot_tpu/infer/client.py', _NET_WITH_TIMEOUT,
+        rule='net-timeout'))
+    # Outside serve/, infer/, benchmark/ the rule does not apply — an
+    # offline devtool blocking on a download is annoying, not an
+    # outage.
+    assert not _live(_lint(
+        tmp_path, 'skypilot_tpu/devtools/fetch.py', _NET_NO_TIMEOUT,
+        rule='net-timeout'))
+
+
 def test_tree_has_zero_unsuppressed_findings():
     """Gates every future PR: skylint over the package + bench.py via
     the committed .skylint-baseline must come back clean."""
@@ -392,8 +439,9 @@ def test_tree_has_zero_unsuppressed_findings():
         f.render() for f in live)
 
 
-def test_all_six_rule_families_are_registered():
+def test_all_rule_families_are_registered():
     ids = {r.id for r in skylint.all_rules()}
     assert {'host-sync', 'retrace-hazard', 'lock-discipline',
             'thread-discipline', 'stdout-purity', 'metric-contract',
-            'dtype-promotion', 'sleep-discipline'} <= ids
+            'dtype-promotion', 'sleep-discipline',
+            'net-timeout'} <= ids
