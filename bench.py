@@ -674,6 +674,12 @@ def run_serve(steps_arg, smoke: bool = False) -> None:
     # absolute numbers only need to be stable enough to compare runs.
     ttft_slo_s = 2.0 if smoke else 1.0
     tpot_slo_s = 0.5 if smoke else 0.25
+    # Arm replica-side SLO accounting with the same targets the bench
+    # judges client-side, so /fleet/slo goodput is cross-checkable
+    # against the bench's own verdicts below.  Must be set before the
+    # engines construct their metric registries.
+    os.environ['SKYTPU_SLO_TTFT_S'] = str(ttft_slo_s)
+    os.environ['SKYTPU_SLO_TPOT_S'] = str(tpot_slo_s)
     overrides = {'n_heads': 4, 'n_kv_heads': 2, 'n_layers': 2,
                  'dim': 64, 'ffn_dim': 128, 'vocab_size': 512,
                  'max_seq_len': 128}
@@ -726,6 +732,7 @@ def run_serve(steps_arg, smoke: bool = False) -> None:
     kill_after = arrivals[int(n_requests * 0.4)]
     killed = {'done': False}
     threads = []
+    fleet_obs: dict = {}
     bench_t0 = time.time()
     try:
         for i, at in enumerate(arrivals):
@@ -754,6 +761,62 @@ def run_serve(steps_arg, smoke: bool = False) -> None:
             threads.append(t)
         for t in threads:
             t.join(timeout=120)
+        # Fleet observability probes — while the router and the
+        # surviving replicas are still up.
+        import urllib.request
+
+        def _get(path, timeout=10):
+            with urllib.request.urlopen(rt.url + path,
+                                        timeout=timeout) as resp:
+                return resp.read()
+
+        try:
+            t_scrape = time.time()
+            fed_text = _get('/fleet/metrics').decode()
+            fleet_obs['fleet_scrape_s'] = round(
+                time.time() - t_scrape, 4)
+            # Round-trip: the federated exposition must parse back
+            # through the same parser Prometheus-compatible consumers
+            # model.
+            fed = metrics_lib.parse_exposition(fed_text)
+            fleet_obs['fleet_series'] = len(fed)
+            fleet_obs['fleet_replicas_routable'] = \
+                metrics_lib.sample_value(
+                    fed, 'skytpu_fleet_replicas_routable')
+            slo_doc = json.loads(_get('/fleet/slo'))
+            traces_doc = json.loads(_get('/traces?limit=200'))
+            stitched = 0
+            for tr in traces_doc.get('traces', [])[:8]:
+                doc = json.loads(_get(
+                    f'/traces?id={tr["trace_id"]}&stitch=1'))
+                if any(r.get('traces')
+                       for r in doc.get('replica_traces', [])):
+                    stitched += 1
+            fleet_obs['router_traces'] = len(
+                traces_doc.get('traces', []))
+            fleet_obs['stitched_traces_sampled'] = stitched
+            # Cross-check: replica-reported TTFT goodput (measured
+            # from admission) vs the bench's client-side verdicts
+            # (measured from send; includes queueing + retries, and
+            # failed requests count against only the client side).
+            ttft_slo = slo_doc.get('slos', {}).get('ttft', {})
+            fleet_obs['slo_goodput_ttft_fleet'] = \
+                ttft_slo.get('goodput')
+            with lock:
+                done = list(results)
+            client_ttft_good = sum(
+                1 for r in done if r['ok'] and r['ttft'] is not None
+                and r['ttft'] <= ttft_slo_s) / max(len(done), 1)
+            fleet_obs['slo_goodput_ttft_client'] = round(
+                client_ttft_good, 4)
+            if ttft_slo.get('goodput') is not None:
+                fleet_obs['slo_goodput_ttft_delta'] = round(
+                    ttft_slo['goodput'] - client_ttft_good, 4)
+            fleet_obs['slo_burn_rate_ttft'] = \
+                ttft_slo.get('burn_rate')
+        except Exception as e:  # noqa: BLE001 — observability probes
+            # must not fail the bench result they decorate.
+            fleet_obs['error'] = repr(e)
     finally:
         rt.stop()
         for srv in replicas:
@@ -794,6 +857,7 @@ def run_serve(steps_arg, smoke: bool = False) -> None:
             for labels, v in retries.items()},
         'rate_rps': rate_rps,
         'smoke': smoke,
+        'fleet': fleet_obs,
     }
     print(json.dumps(result))
     print(f'# serve: {len(good)}/{len(results)} requests in SLO '

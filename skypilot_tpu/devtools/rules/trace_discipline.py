@@ -1,0 +1,93 @@
+"""trace-discipline: event names are literals from EVENT_CONTRACT.
+
+``skypilot_tpu.observability.events.EVENT_CONTRACT`` is the single
+source of truth for flight-recorder and request-lifecycle event names
+(the exact analogue of METRIC_CONTRACT for metric names).  Every
+``<x>.events.record('name', ...)`` (EventRing) and
+``<x>.traces.event(rid, 'name', ...)`` (TraceStore) call site must
+pass the name as a STRING LITERAL drawn from that set:
+
+* a computed name defeats the contract — grep and the skylint check
+  can no longer prove the taxonomy is exhaustive;
+* a literal not in the contract is either a typo (EventRing would
+  raise at runtime, possibly only on a rarely-taken failure path) or
+  a new event that must be added to EVENT_CONTRACT in the same PR.
+
+Scope: the rule keys off the receiver attribute (``.events`` /
+``.traces``) — the idiom every call site in the tree uses — so
+unrelated ``record``/``event`` methods (e.g. ``timeline.event``) are
+not dragged in.  The implementations themselves
+(observability/events.py, observability/tracing.py) are exempt: they
+manipulate names generically by design.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from skypilot_tpu.devtools import skylint
+from skypilot_tpu.observability.events import EVENT_CONTRACT
+
+RULE_ID = 'trace-discipline'
+
+# method name -> (required receiver terminal name, index of the event
+# name in the positional args).
+_EVENT_METHODS = {
+    'record': ('events', 0),   # EventRing.record(name, **fields)
+    'event': ('traces', 1),    # TraceStore.event(rid, name, **fields)
+}
+
+
+def in_scope(posix: str) -> bool:
+    return not (posix.endswith('observability/events.py')
+                or posix.endswith('observability/tracing.py'))
+
+
+def _terminal_name(expr: ast.expr) -> Optional[str]:
+    """`self.router.events` -> 'events'; `events` -> 'events'."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _EVENT_METHODS):
+            continue
+        receiver, arg_idx = _EVENT_METHODS[func.attr]
+        if _terminal_name(func.value) != receiver:
+            continue
+        if len(node.args) <= arg_idx:
+            continue  # name passed by keyword/unpacking: not the idiom
+        name_node = node.args[arg_idx]
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)):
+            findings.append(ctx.finding(
+                RULE_ID, node, f'.{func.attr}',
+                f'event name passed to .{receiver}.{func.attr}() must '
+                f'be a string literal from EVENT_CONTRACT '
+                f'(observability/events.py), not a computed value'))
+            continue
+        name = name_node.value
+        if name not in EVENT_CONTRACT:
+            findings.append(ctx.finding(
+                RULE_ID, node, name,
+                f'event {name!r} is not in EVENT_CONTRACT '
+                f'(skypilot_tpu/observability/events.py); add it '
+                f'there in the same change that records it'))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='flight-recorder/trace event names must be string '
+            'literals drawn from EVENT_CONTRACT',
+    check=check,
+    scope=in_scope),)

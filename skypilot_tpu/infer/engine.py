@@ -509,10 +509,48 @@ class _ServingMetrics:
             'skytpu_request_tpot_seconds',
             'Mean seconds per output token after the first, per '
             'finished request.')
+        # Runtime telemetry: compile/retrace accounting, host-step
+        # wall breakdown, memory watermarks.
+        self.jit_compiles = r.counter(
+            'skytpu_jit_compiles_total',
+            'Jitted-path compilations: first call for a new static-'
+            'argument/shape key (later increments = retraces).',
+            labelnames=('fn',))
+        self.jit_compile_seconds = r.histogram(
+            'skytpu_jit_compile_seconds',
+            'Host wall seconds inside a compiling jitted call '
+            '(trace + lower + compile + the first execution).',
+            labelnames=('fn',))
+        self.dispatch_seconds = r.histogram(
+            'skytpu_step_dispatch_seconds',
+            'Host wall seconds to enqueue one cache-hit decode step '
+            '(async dispatch; the device_get wait is separate).')
+        self.device_wait_seconds = r.histogram(
+            'skytpu_step_device_wait_seconds',
+            'Host wall seconds blocked on device_get for the sampled '
+            'tokens (device execution + transfer).')
+        self.pages_used_peak = r.gauge(
+            'skytpu_kv_pages_used_peak',
+            'High-watermark of KV pages in use since engine start '
+            '(0 on contiguous-cache engines).')
+        self.device_memory_peak = r.gauge(
+            'skytpu_device_memory_peak_bytes',
+            'Device-allocator peak bytes in use, from '
+            'device.memory_stats(); 0 where the backend reports none '
+            '(e.g. CPU).')
+        # SLO accounting: targets come from SKYTPU_SLO_TTFT_S /
+        # SKYTPU_SLO_TPOT_S (seconds; unset or <= 0 disables that SLO).
+        self.slo_requests = r.counter(
+            'skytpu_slo_requests_total',
+            'Finished requests judged against the configured TTFT/'
+            'TPOT SLO targets.', labelnames=('slo', 'result'))
+        self.slo_ttft_s = _slo_target_from_env('SKYTPU_SLO_TTFT_S')
+        self.slo_tpot_s = _slo_target_from_env('SKYTPU_SLO_TPOT_S')
 
     def observe_finished(self, trace: Optional[tracing_lib.RequestTrace]
                          ) -> None:
-        """Record the latency histograms a finished trace derives."""
+        """Record the latency histograms a finished trace derives,
+        plus SLO verdicts when targets are configured."""
         if trace is None:
             return
         qs = trace.queue_seconds()
@@ -524,6 +562,41 @@ class _ServingMetrics:
         tpot = trace.tpot_seconds()
         if tpot is not None:
             self.tpot_seconds.observe(tpot)
+        if self.slo_ttft_s and ttft is not None:
+            self.slo_requests.labels(
+                slo='ttft',
+                result='good' if ttft <= self.slo_ttft_s
+                else 'violated').inc()
+        if self.slo_tpot_s and tpot is not None:
+            self.slo_requests.labels(
+                slo='tpot',
+                result='good' if tpot <= self.slo_tpot_s
+                else 'violated').inc()
+
+
+def _publish_device_memory_peak(met: _ServingMetrics) -> None:
+    """Set skytpu_device_memory_peak_bytes from the first local
+    device's allocator stats.  Scrape-time only — memory_stats() is a
+    runtime call, never part of the per-step hot path.  Backends
+    without the surface (CPU) leave the gauge at 0."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # pylint: disable=broad-except
+        stats = None  # backend-dependent surface; absence is normal
+    if stats:
+        peak = stats.get('peak_bytes_in_use') or 0
+        if peak:
+            met.device_memory_peak.set(float(peak))
+
+
+def _slo_target_from_env(name: str) -> float:
+    """SLO target in seconds from the environment; 0.0 = disabled
+    (unset, unparseable, or non-positive)."""
+    try:
+        v = float(os.environ.get(name, '') or 0.0)
+    except ValueError:
+        return 0.0
+    return v if v > 0 else 0.0
 
 
 def _trace_store_from_env() -> tracing_lib.TraceStore:
@@ -880,6 +953,13 @@ class ContinuousBatchingEngine:
         self._met = _ServingMetrics(self.registry)
         self.traces = _trace_store_from_env()
         self._cannibalized_seen = 0
+        # Compile/retrace accounting: the jitted decode/prefill paths
+        # recompile once per distinct static-argument key, so "first
+        # sight of a key" is a compile and everything after is a
+        # cache-hit dispatch.  Host-side sets — no private JAX APIs.
+        self._decode_keys_seen: set = set()
+        self._prefill_keys_seen: set = set()
+        self._pages_used_peak = 0
         # Precomputed read-traffic constants so the per-step estimate
         # is O(live slots) arithmetic, not a cache-pytree walk:
         # paged — bytes one PAGE contributes across all K/V leaves;
@@ -919,7 +999,9 @@ class ContinuousBatchingEngine:
     def submit(self, prompt_ids: Sequence[int],
                sampling: Optional[SamplingConfig] = None,
                stream: bool = False,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               http_request_id: Optional[str] = None,
+               trace_parent: Optional[str] = None) -> int:
         """Enqueue one prompt; returns a request id for wait() (or,
         with stream=True, for stream() — tokens are then ALSO pushed
         to a per-request queue as each decode step lands).
@@ -927,7 +1009,12 @@ class ContinuousBatchingEngine:
         `deadline_s` is a relative wall-clock budget: the request is
         expired in the queue once it passes (before wasting prefill),
         and wait() without an explicit timeout blocks at most until
-        it."""
+        it.
+
+        `http_request_id` / `trace_parent` stamp the external request
+        id (and the router's attempt-span id from X-Skytpu-Trace) on
+        the trace from birth, so every JSONL event line carries the
+        external id and stitched fleet traces can join on it."""
         import queue as queue_mod
         import threading
         cfg = sampling or SamplingConfig()
@@ -981,7 +1068,10 @@ class ContinuousBatchingEngine:
             depth = len(self._queue)
             # Trace begins inside the lock so the decode thread can
             # never admit this rid before its trace exists.
-            self.traces.begin(rid, prompt_tokens=len(prompt_ids))
+            trace = self.traces.begin(rid,
+                                      prompt_tokens=len(prompt_ids),
+                                      http_request_id=http_request_id)
+            trace.trace_parent = trace_parent
         self._met.submitted.inc()
         self._met.queue_depth.set(depth)
         self._met.inflight.set(self.traces.inflight_count)
@@ -1333,9 +1423,17 @@ class ContinuousBatchingEngine:
                          ((start + size + gran - 1) // gran) * gran)
         else:
             bucket = 0
+        prefill_key = (size, bucket)
+        prefill_compiled = prefill_key not in self._prefill_keys_seen
+        t_enter = time.perf_counter()
         logits, pending.cache1 = self._prefill1(
             self.params, pending.cache1, tokens, positions, kv_mask1,
             kv_bucket=bucket)
+        if prefill_compiled:
+            self._prefill_keys_seen.add(prefill_key)
+            self._met.jit_compiles.labels(fn='prefill').inc()
+            self._met.jit_compile_seconds.labels(fn='prefill').observe(
+                time.perf_counter() - t_enter)
         last_idx = pending.true_len - 1
         if start <= last_idx < start + size:
             pending.last_row = logits[0, last_idx - start]
@@ -1641,6 +1739,9 @@ class ContinuousBatchingEngine:
                          ((live + gran - 1) // gran) * gran)
         else:
             bucket = self.max_seq_len
+        decode_key = (max_k, use_top_p, top_p_in_topk, bucket)
+        compiled = decode_key not in self._decode_keys_seen
+        t_enter = time.perf_counter()
         with llama.slot_mode():
             tok_dev, self._last, self._cache, self._kv_mask = \
                 self._decode(
@@ -1651,7 +1752,11 @@ class ContinuousBatchingEngine:
                     jnp.asarray(top_ks), jnp.asarray(top_ps),
                     max_k=max_k, use_top_p=use_top_p,
                     top_p_in_topk=top_p_in_topk, kv_bucket=bucket)
+        t_dispatched = time.perf_counter()
         toks = np.asarray(jax.device_get(tok_dev))
+        t_fetched = time.perf_counter()
+        if compiled:
+            self._decode_keys_seen.add(decode_key)
         # Read-traffic estimate for THIS step, from the cursors already
         # on the host (no device reads): paged decode gathers each live
         # row's allocated pages; contiguous decode streams `bucket`
@@ -1679,15 +1784,28 @@ class ContinuousBatchingEngine:
             if (s.eos_id is not None and tok == s.eos_id) or \
                     s.generated >= s.max_new:
                 self._complete(i)
-        self._publish_step_metrics(len(occupied), read_bytes)
+        self._publish_step_metrics(
+            len(occupied), read_bytes,
+            dispatch_s=t_dispatched - t_enter,
+            device_wait_s=t_fetched - t_dispatched,
+            compiled=compiled)
         return True
 
     def _publish_step_metrics(self, n_occupied: int,
-                              read_bytes: float) -> None:
+                              read_bytes: float,
+                              dispatch_s: Optional[float] = None,
+                              device_wait_s: Optional[float] = None,
+                              compiled: bool = False) -> None:
         """Per-step telemetry: gauges + counters from host-side state
         already in hand.  This is the entire per-step telemetry cost —
         the overhead guard test times it directly against a measured
-        decode step, so keep it allocation-free."""
+        decode step, so keep it allocation-free.
+
+        `dispatch_s` is the wall time inside the jitted decode call;
+        on a first-sight static key (`compiled=True`) that includes
+        trace+compile and is booked as a compile, otherwise it is the
+        async-dispatch cost ROADMAP item 3 will be judged against.
+        `device_wait_s` is the host block on device_get."""
         m = self._met
         m.steps.inc()
         m.slot_steps.inc(n_occupied)
@@ -1697,8 +1815,22 @@ class ContinuousBatchingEngine:
         m.queue_depth.set(len(self._queue))
         m.inflight.set(self.traces.inflight_count)
         m.read_bytes.observe(read_bytes)
+        if dispatch_s is not None:
+            if compiled:
+                m.jit_compiles.labels(fn='decode').inc()
+                m.jit_compile_seconds.labels(fn='decode').observe(
+                    dispatch_s)
+            else:
+                m.dispatch_seconds.observe(dispatch_s)
+        if device_wait_s is not None:
+            m.device_wait_seconds.observe(device_wait_s)
         if self._alloc is not None:
-            m.free_pages.set(self._alloc.free_pages)
+            free = self._alloc.free_pages
+            m.free_pages.set(free)
+            used = self._alloc.n_pages - 1 - free  # page 0 reserved
+            if used > self._pages_used_peak:
+                self._pages_used_peak = used
+                m.pages_used_peak.set(used)
             cann = self._alloc.cannibalized_total
             if cann > self._cannibalized_seen:
                 m.cannibalized.inc(cann - self._cannibalized_seen)
@@ -1730,6 +1862,13 @@ class ContinuousBatchingEngine:
         return (len(self._queue) / self.n_slots) * ewma
 
     # -- router / health surface ------------------------------------------
+    def publish_memory_watermarks(self) -> None:
+        """Scrape-time (NOT per-step) device-memory watermark: sets
+        skytpu_device_memory_peak_bytes from the first local device's
+        allocator stats.  Backends without memory_stats (CPU) leave
+        the gauge at 0 — the call is always safe."""
+        _publish_device_memory_peak(self._met)
+
     def allocator_leak_report(self) -> Optional[str]:
         """None when the page pool is clean (or unpaged), else the
         allocator's description of what leaked.  The verbose health
@@ -2139,11 +2278,21 @@ class InferenceEngine:
                                        self.config.n_heads, context)
 
     # -- generation --------------------------------------------------------
+    def publish_memory_watermarks(self) -> None:
+        """Scrape-time device-memory watermark; see the continuous
+        engine's twin."""
+        _publish_device_memory_peak(self._met)
+
     def generate(self, prompts: Sequence[Sequence[int]],
-                 sampling: Optional[SamplingConfig] = None
+                 sampling: Optional[SamplingConfig] = None,
+                 http_request_id: Optional[str] = None,
+                 trace_parent: Optional[str] = None
                  ) -> List[List[int]]:
         """Generate continuations for up to `max_batch_size` prompts of
-        (possibly) different lengths. Returns one id list per prompt."""
+        (possibly) different lengths. Returns one id list per prompt.
+        `http_request_id`/`trace_parent` stamp the external request id
+        on every trace this batch begins (whole-batch serving runs one
+        HTTP request per batch)."""
         if self.page_size:
             # The paged layout only exists on the slot-mode trace; the
             # request-level whole-batch path has no allocator.
@@ -2194,7 +2343,10 @@ class InferenceEngine:
         met = self._met
         rids = [f'gen{self._generation}-{i}' for i in range(n)]
         for i, rid in enumerate(rids):
-            self.traces.begin(rid, prompt_tokens=int(lengths[i]))
+            trace = self.traces.begin(rid,
+                                      prompt_tokens=int(lengths[i]),
+                                      http_request_id=http_request_id)
+            trace.trace_parent = trace_parent
             # Whole-batch generate admits and prefills immediately.
             self.traces.event(rid, 'admitted')
         met.submitted.inc(n)

@@ -13,7 +13,11 @@ http.server, matching the rest of the serve stack (serve/controller.py):
   GET  /metrics             -> Prometheus text exposition (v0.0.4) of
                                the process metric registry
   GET  /traces              -> recent request lifecycle traces (JSON;
-                               ?limit=N caps the count)
+                               ?limit=N caps the count,
+                               ?request_id=X filters by the external
+                               X-Request-Id — the router's stitch key)
+  GET  /events              -> flight-recorder ring (restarts, stalls,
+                               drains, chaos injections; ?limit=N)
   POST /v1/completions      -> OpenAI completions (stream + non-stream)
   POST /v1/chat/completions -> OpenAI chat (stream + non-stream)
   POST /drain               -> stop admission, finish in-flight work,
@@ -71,7 +75,9 @@ from typing import Optional
 from skypilot_tpu import sky_logging
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import failures
+from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import tracing as tracing_lib
 from skypilot_tpu.utils import chaos
 from skypilot_tpu.utils import retry as retry_lib
 
@@ -84,7 +90,8 @@ _HTTPServer = http_utils.HighBacklogHTTPServer
 
 # Known routes by method.  Unknown paths collapse to the 'other' route
 # label so a URL-scanning client cannot mint unbounded label sets.
-_GET_ROUTES = ('/health', '/v1/models', '/metrics', '/traces')
+_GET_ROUTES = ('/health', '/v1/models', '/metrics', '/traces',
+               '/events')
 _POST_ROUTES = ('/generate', '/v1/completions', '/v1/chat/completions',
                 '/drain')
 
@@ -272,6 +279,12 @@ class InferenceServer:
         self.shutdown_join_s = float(
             os.environ.get('SKYTPU_SHUTDOWN_JOIN_S', '5'))
         self._fail_met = _failure_metrics(self.registry)
+        # Flight recorder (GET /events): decode-loop restarts, stalls,
+        # drains, and chaos injections — the replica-side half of the
+        # fleet's post-incident story.
+        self.events = events_lib.EventRing(registry=self.registry,
+                                           source='replica')
+        chaos.add_event_sink(self._record_chaos_event)
         self._draining = False
         self._drain_lock = threading.Lock()
         self._drain_thread: Optional[threading.Thread] = None
@@ -284,6 +297,9 @@ class InferenceServer:
         # exercise the supervised loop, not the readiness compile.
         chaos.init_from_env()
         self._set_health('ok')
+
+    def _record_chaos_event(self, point: str) -> None:
+        self.events.record('chaos_injection', point=point)
 
     def _set_health(self, state: str) -> None:
         self._health = state
@@ -314,6 +330,7 @@ class InferenceServer:
         self._fatal = error
         self._running = False
         self._set_health('unhealthy')
+        self.events.record('replica_failed', error=repr(error))
         self.engine.abort(error)
 
     def _decode_loop(self) -> None:
@@ -374,6 +391,9 @@ class InferenceServer:
                     self._fail_replica(rec_err)
                     return
                 self._fail_met['restarts'].inc()
+                self.events.record('decode_loop_restart',
+                                   error=repr(e),
+                                   restarts_in_window=len(restarts))
                 delay = retry_lib.compute_delay(
                     len(restarts) - 1, base_delay_s=0.05, max_delay_s=2.0)
                 if delay > 0:
@@ -395,6 +415,9 @@ class InferenceServer:
             if elapsed <= self.stall_timeout_s:
                 continue
             self._fail_met['stalls'].inc()
+            self.events.record('stall_detected',
+                               elapsed_s=round(elapsed, 3),
+                               timeout_s=self.stall_timeout_s)
             err = failures.StepStallError(
                 f'device step exceeded {self.stall_timeout_s:.1f}s '
                 f'(running {elapsed:.1f}s); replica presumed wedged')
@@ -476,6 +499,9 @@ class InferenceServer:
             logger.info('drain requested: admission stopped, waiting '
                         'for in-flight work')
             self._set_health('draining')
+            self.events.record(
+                'drain_begin',
+                in_flight=self.engine.traces.inflight_count)
             t = threading.Thread(target=self._drain_then_exit,
                                  daemon=True, name='skytpu-drain')
             self._drain_thread = t
@@ -501,10 +527,14 @@ class InferenceServer:
                 'still in flight; shutting down anyway')
         time.sleep(0.2)  # let handler threads flush their responses
         logger.info('drain complete; shutting down')
+        self.events.record(
+            'drain_complete',
+            in_flight=self.engine.traces.inflight_count)
         self.shutdown()
 
     def _handle_generate(self, payload: dict,
-                         http_request_id: Optional[str] = None) -> dict:
+                         http_request_id: Optional[str] = None,
+                         trace_parent: Optional[str] = None) -> dict:
         deadline_s = self._deadline_from(payload)
         prompts = payload.get('prompt_ids')
         if not isinstance(prompts, list) or not prompts:
@@ -525,11 +555,11 @@ class InferenceServer:
             rids = []
             try:
                 for p in prompts:
-                    rid = self.engine.submit(p, sampling,
-                                             deadline_s=deadline_s)
+                    rid = self.engine.submit(
+                        p, sampling, deadline_s=deadline_s,
+                        http_request_id=http_request_id,
+                        trace_parent=trace_parent)
                     rids.append(rid)
-                    self.engine.traces.annotate(
-                        rid, http_request_id=http_request_id)
                 self._work.set()
                 # No explicit timeout: wait() derives it from the
                 # request's own deadline.
@@ -540,7 +570,9 @@ class InferenceServer:
                 raise
             return {'tokens': tokens}
         with self._lock:
-            tokens = self.engine.generate(prompts, sampling)
+            tokens = self.engine.generate(
+                prompts, sampling, http_request_id=http_request_id,
+                trace_parent=trace_parent)
         return {'tokens': tokens}
 
     # -- OpenAI-compatible surface ------------------------------------
@@ -552,21 +584,25 @@ class InferenceServer:
 
     def _openai_blocking(self, req, prompt_ids,
                          http_request_id: Optional[str] = None,
-                         deadline_s: Optional[float] = None) -> dict:
+                         deadline_s: Optional[float] = None,
+                         trace_parent: Optional[str] = None) -> dict:
         from skypilot_tpu.infer import openai_api
         sampling = self._sampling_for(req)
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         if self.continuous:
             rid = self.engine.submit(prompt_ids, sampling,
-                                     deadline_s=deadline_s)
-            self.engine.traces.annotate(
-                rid, http_request_id=http_request_id)
+                                     deadline_s=deadline_s,
+                                     http_request_id=http_request_id,
+                                     trace_parent=trace_parent)
             self._work.set()
             toks = self.engine.wait(rid)
         else:
             with self._lock:
-                toks = self.engine.generate([prompt_ids], sampling)[0]
+                toks = self.engine.generate(
+                    [prompt_ids], sampling,
+                    http_request_id=http_request_id,
+                    trace_parent=trace_parent)[0]
         eos = self.tokenizer.eos_id
         eos_hit = bool(toks) and eos is not None and toks[-1] == eos
         scanner = openai_api.StopScanner(req.stop)
@@ -588,9 +624,10 @@ class InferenceServer:
         http_rid = getattr(handler, 'request_id', None)
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        rid = self.engine.submit(prompt_ids, sampling, stream=True,
-                                 deadline_s=deadline_s)
-        self.engine.traces.annotate(rid, http_request_id=http_rid)
+        rid = self.engine.submit(
+            prompt_ids, sampling, stream=True, deadline_s=deadline_s,
+            http_request_id=http_rid,
+            trace_parent=getattr(handler, 'trace_parent', None))
         self._work.set()
 
         def _sse(obj) -> None:
@@ -707,7 +744,8 @@ class InferenceServer:
             return None
         return self._openai_blocking(
             req, prompt_ids, getattr(handler, 'request_id', None),
-            deadline_s)
+            deadline_s,
+            trace_parent=getattr(handler, 'trace_parent', None))
 
     def serve_forever(self) -> None:
         self.start()
@@ -761,6 +799,16 @@ class InferenceServer:
                 self.request_id = (
                     incoming if _REQUEST_ID_RE.match(incoming)
                     else 'req-' + uuid.uuid4().hex[:16])
+                # Distributed-trace context: the router stamps
+                # X-Skytpu-Trace on forwarded attempts; the parent half
+                # lands on the engine trace so a stitched trace can
+                # join the router's attempt span to this replica's
+                # per-request lifecycle.
+                self.trace_parent = None
+                ctx = tracing_lib.parse_trace_context(
+                    self.headers.get(tracing_lib.TRACE_HEADER))
+                if ctx is not None:
+                    self.trace_parent = ctx[1]
                 self._last_code = 0
                 route = self.path.split('?', 1)[0]
                 known = route in _GET_ROUTES or route in _POST_ROUTES
@@ -807,6 +855,10 @@ class InferenceServer:
                                   'created': 0,
                                   'owned_by': 'skypilot-tpu'}]})
                 elif route == '/metrics':
+                    # Scrape-time watermarks (peak pages / device
+                    # memory) — polled here, not per step, so the
+                    # publish-overhead contract is untouched.
+                    outer.engine.publish_memory_watermarks()
                     data = outer.registry.expose().encode()
                     self.send_response(200)
                     self.send_header('Content-Type',
@@ -822,9 +874,30 @@ class InferenceServer:
                     except ValueError:
                         limit = 100
                     store = outer.engine.traces
+                    want = query.get('request_id', [None])[0]
+                    if want is not None:
+                        # Stitch support: the router looks up replica
+                        # traces by the EXTERNAL id it forwarded, which
+                        # lands on http_request_id (the engine rid is
+                        # replica-local).
+                        traces = [
+                            t for t in store.recent(100000)
+                            if t.get('http_request_id') == want
+                        ][:limit]
+                    else:
+                        traces = store.recent(limit)
                     self._reply(200, {
-                        'traces': store.recent(limit),
+                        'traces': traces,
                         'in_flight': store.inflight_count})
+                elif route == '/events':
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    try:
+                        limit = int(query.get('limit', ['100'])[0])
+                    except ValueError:
+                        limit = 100
+                    self._reply(200, {
+                        'events': outer.events.snapshot(limit)})
                 elif route in _POST_ROUTES:
                     self._reply(405, {'error': 'method not allowed'},
                                 allow='POST')
@@ -849,7 +922,8 @@ class InferenceServer:
                         return
                     if route == '/generate':
                         self._reply(200, outer._handle_generate(  # pylint: disable=protected-access
-                            payload, self.request_id))
+                            payload, self.request_id,
+                            trace_parent=self.trace_parent))
                         return
                     body = outer._handle_openai(  # pylint: disable=protected-access
                         payload, chat=route.endswith(

@@ -8,12 +8,17 @@ server, trainer, bench) without dragging in JAX.
 """
 import re
 
+from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.observability import tracing
+from skypilot_tpu.observability.events import EVENT_CONTRACT, EventRing
 from skypilot_tpu.observability.metrics import (CONTENT_TYPE_LATEST, Counter,
                                                 Gauge, Histogram, Registry,
                                                 get_registry)
-from skypilot_tpu.observability.tracing import RequestTrace, TraceStore
+from skypilot_tpu.observability.tracing import (TRACE_HEADER, RequestTrace,
+                                                Span, SpanStore, TraceStore,
+                                                format_trace_context,
+                                                parse_trace_context)
 
 # Naming contract for every series the repo registers.  Type-suffix
 # conventions (Counter -> _total, Histogram -> _seconds/_bytes) are
@@ -51,6 +56,19 @@ METRIC_CONTRACT = frozenset({
     'skytpu_requests_finished_total',
     'skytpu_requests_in_flight',
     'skytpu_requests_submitted_total',
+    # infer/engine.py + train/trainer.py — runtime (compile/retrace,
+    # host-step breakdown, memory watermarks); see the "Fleet
+    # observability" section of docs/architecture.md for semantics
+    'skytpu_jit_compiles_total',          # labels: fn=decode|prefill|train_step
+    'skytpu_jit_compile_seconds',         # compile (first-call) wall time
+    'skytpu_step_dispatch_seconds',       # enqueue wall time, cache-hit steps
+    'skytpu_step_device_wait_seconds',    # host blocked on device_get
+    'skytpu_kv_pages_used_peak',          # page-pool high-watermark
+    'skytpu_device_memory_peak_bytes',    # device allocator high-watermark
+    # infer/engine.py — SLO accounting (targets via SKYTPU_SLO_TTFT_S /
+    # SKYTPU_SLO_TPOT_S; zero/unset disables)
+    'skytpu_slo_requests_total',          # labels: slo=ttft|tpot, result=good|violated
+    'skytpu_slo_burn_rate',               # labels: slo; set by router /fleet/slo
     # infer/server.py — HTTP surface + failure containment
     'skytpu_decode_loop_restarts_total',
     'skytpu_decode_stalls_detected_total',
@@ -58,6 +76,8 @@ METRIC_CONTRACT = frozenset({
     'skytpu_http_request_seconds',
     'skytpu_http_requests_total',
     'skytpu_requests_shed_total',
+    # observability/events.py — flight recorder
+    'skytpu_events_total',                # labels: kind (EVENT_CONTRACT)
     # utils/chaos.py — fault injection
     'skytpu_chaos_injections_total',
     # serve/router.py + serve/replica_supervisor.py — the self-healing
@@ -74,6 +94,11 @@ METRIC_CONTRACT = frozenset({
     'skytpu_router_requests_total',
     'skytpu_router_retries_total',
     'skytpu_router_scale_events_total',
+    # serve/router.py — fleet federation (GET /fleet/metrics scrape)
+    'skytpu_fleet_replicas_routable',     # routable replicas at scrape time
+    'skytpu_fleet_free_pages',            # sum of free KV pages fleet-wide
+    'skytpu_fleet_queue_depth',           # sum of replica queue depths
+    'skytpu_fleet_scrape_seconds',        # one federated scrape, wall time
     # train/trainer.py — training loop
     'skytpu_train_step_seconds',
     'skytpu_train_steps_total',
@@ -82,16 +107,24 @@ METRIC_CONTRACT = frozenset({
 })
 
 __all__ = [
+    'EVENT_CONTRACT',
     'METRIC_CONTRACT',
     'METRIC_NAME_RE',
     'CONTENT_TYPE_LATEST',
+    'TRACE_HEADER',
     'Counter',
+    'EventRing',
     'Gauge',
     'Histogram',
     'Registry',
     'RequestTrace',
+    'Span',
+    'SpanStore',
     'TraceStore',
+    'events',
+    'format_trace_context',
     'get_registry',
     'metrics',
+    'parse_trace_context',
     'tracing',
 ]

@@ -1,0 +1,119 @@
+"""Flight recorder: a bounded ring of structured operational events.
+
+Where `tracing.TraceStore` follows ONE request through its lifecycle,
+the `EventRing` records the fleet-level control-plane story around all
+of them — supervisor restarts, breaker transitions, drains, scale
+decisions, chaos injections — so that after an incident the operator
+can read back "what did the system decide, and when" without grepping
+logs.  Both router and replica expose their ring at `GET /events`.
+
+`EVENT_CONTRACT` is the single source of truth for event names, the
+exact analogue of `METRIC_CONTRACT` for metric names: the skylint
+`trace-discipline` rule requires every `TraceStore.event(...)` and
+`EventRing.record(...)` call site to pass a string literal drawn from
+this set, so the taxonomy below is exhaustive by construction.
+
+Pure stdlib; safe to import from any layer.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List
+
+# The event taxonomy.  Two families share one namespace so a single
+# skylint rule covers both call surfaces:
+#
+# - request-lifecycle events, stamped on a RequestTrace via
+#   `TraceStore.event(rid, name)` (the store itself emits the
+#   'queued' + terminal transitions internally);
+# - fleet/control-plane events, recorded on an EventRing via
+#   `EventRing.record(name, **fields)`.
+EVENT_CONTRACT = frozenset({
+    # -- request lifecycle (TraceStore.event) -------------------------
+    'admitted',
+    'prefill_chunk',
+    'prefill_done',
+    'first_token',
+    # -- router data plane (EventRing.record) -------------------------
+    'breaker_transition',     # CircuitBreaker state change
+    'replica_unhealthy',      # health probe flipped a replica down
+    # -- replica server -----------------------------------------------
+    'decode_loop_restart',    # supervised decode loop recovered
+    'stall_detected',         # watchdog saw a wedged step
+    'replica_failed',         # restart budget exhausted / fatal error
+    'drain_begin',            # replica stopped admitting (scale-down)
+    'drain_complete',         # drain finished; replica exiting
+    # -- replica supervisor -------------------------------------------
+    'replica_spawn',          # new replica process launched
+    'replica_restart',        # crash scheduled for backoff + respawn
+    'scale_up',               # autoscaler grew the fleet
+    'scale_down',             # autoscaler shrank the fleet
+    # -- fault injection ----------------------------------------------
+    'chaos_injection',        # a chaos fault point fired
+})
+
+
+class EventRing:
+    """Thread-safe bounded ring of `{ts, seq, event, ...fields}` dicts.
+
+    `record()` validates the name against `EVENT_CONTRACT` (a typo'd
+    event name is a programming error, not data) and optionally counts
+    into `skytpu_events_total{kind=...}` when built with a registry.
+    `snapshot()` returns newest-first copies; the ring itself never
+    grows past `capacity`, so a wedged scraper cannot OOM the server.
+    """
+
+    def __init__(self, capacity: int = 512, registry: Any = None,
+                 source: str = ''):
+        self._lock = threading.Lock()
+        self._ring: 'collections.deque[Dict[str, Any]]' = (
+            collections.deque(maxlen=max(1, capacity)))
+        self._seq = 0
+        self._source = source
+        self._counter = None
+        if registry is not None:
+            self._counter = registry.counter(
+                'skytpu_events_total',
+                'Flight-recorder events recorded, by kind.',
+                labelnames=('kind',))
+
+    def record(self, event: str, **fields: Any) -> Dict[str, Any]:
+        """Append one event; returns the stored record (a copy is NOT
+        made — callers must not mutate it afterwards)."""
+        if event not in EVENT_CONTRACT:
+            raise ValueError(
+                f'unknown event name {event!r}: add it to '
+                f'observability.events.EVENT_CONTRACT in the same '
+                f'change that records it')
+        rec: Dict[str, Any] = {'ts': time.time(), 'event': event}
+        if self._source:
+            rec['source'] = self._source
+        rec.update(fields)
+        with self._lock:
+            self._seq += 1
+            rec['seq'] = self._seq
+            self._ring.append(rec)
+        if self._counter is not None:
+            self._counter.labels(kind=event).inc()
+        return rec
+
+    def snapshot(self, limit: int = 100) -> List[Dict[str, Any]]:
+        """Newest-first copies of the most recent `limit` events."""
+        with self._lock:
+            out = [dict(r) for r in list(self._ring)[::-1]]
+        return out[:max(0, limit)]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def total_recorded(self) -> int:
+        """Monotonic count of every record() ever made (ring may have
+        evicted older ones)."""
+        with self._lock:
+            return self._seq
+
+

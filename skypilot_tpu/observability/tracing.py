@@ -14,18 +14,144 @@ plus a bounded ring of completed traces (newest last), and can mirror
 every transition to a JSONL event sink for offline ingestion
 (`SKYTPU_TRACE_JSONL=<path>`, read by the engines).  All methods are
 thread-safe and O(1); nothing here touches JAX or device memory.
+
+Distributed tracing rides on top: a `Span` is one timed operation in
+one process, a `SpanStore` groups spans by trace id, and the trace id
+IS the external `X-Request-Id` — the router opens a root span per
+request, stamps `X-Skytpu-Trace: <trace_id>/<span_id>` on each
+upstream attempt, and the replica annotates its engine trace with both
+ids so `GET /traces?id=...&stitch=1` on the router can join the
+router-side spans with every replica-side engine timeline into one
+stitched document (including the failed attempts of a failover).
 """
 from __future__ import annotations
 
 import collections
 import dataclasses
 import json
+import re
 import threading
 import time
-from typing import Any, Dict, List, Optional
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
 
 # Terminal states a trace can land in.
 TERMINAL_STATES = ('finished', 'cancelled', 'evicted', 'aborted')
+
+# Propagation header carrying `<trace_id>/<parent_span_id>` from the
+# router to the replica it tries.  The trace id is the external
+# X-Request-Id; the parent span id names the router's attempt span so
+# a replica's work nests under the exact attempt that reached it.
+TRACE_HEADER = 'X-Skytpu-Trace'
+
+# Both halves share the router's request-id charset; anything else is
+# treated as absent rather than trusted.
+_CTX_RE = re.compile(r'^([A-Za-z0-9._:-]{1,64})/([A-Za-z0-9._:-]{1,64})$')
+
+
+def format_trace_context(trace_id: str, span_id: str) -> str:
+    """Render the `X-Skytpu-Trace` header value."""
+    return f'{trace_id}/{span_id}'
+
+
+def parse_trace_context(value: Optional[str]
+                        ) -> Optional[Tuple[str, str]]:
+    """`(trace_id, parent_span_id)` from a header value, or None if
+    the value is missing or malformed (never raises: a bad header from
+    an arbitrary client must not fail the request)."""
+    if not value:
+        return None
+    m = _CTX_RE.match(value.strip())
+    if m is None:
+        return None
+    return m.group(1), m.group(2)
+
+
+@dataclasses.dataclass
+class Span:
+    """One timed operation inside one process, keyed to a trace id.
+
+    Mutated only by the thread that started it (attrs/end); readers go
+    through `SpanStore` snapshots which copy the fields under the
+    store lock."""
+    trace_id: str
+    span_id: str
+    name: str
+    start_ts: float
+    parent_id: Optional[str] = None
+    end_ts: Optional[float] = None
+    status: str = 'ok'
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def end(self, status: Optional[str] = None, **attrs: Any) -> None:
+        """Close the span; idempotent (first end wins the timestamp)."""
+        if self.end_ts is None:
+            self.end_ts = time.time()
+        if status is not None:
+            self.status = status
+        self.attrs.update(attrs)
+
+    def duration_seconds(self) -> Optional[float]:
+        if self.end_ts is None:
+            return None
+        return self.end_ts - self.start_ts
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d['duration_seconds'] = self.duration_seconds()
+        return d
+
+
+class SpanStore:
+    """Spans grouped by trace id, bounded by distinct-trace count.
+
+    The router holds one of these: a root span per proxied request
+    plus one child span per upstream attempt.  Eviction is
+    oldest-trace-first, so a scrape always sees whole traces (never a
+    trace with its early spans dropped)."""
+
+    def __init__(self, capacity: int = 256):
+        self._lock = threading.Lock()
+        self._traces: 'collections.OrderedDict[str, List[Span]]' = (
+            collections.OrderedDict())
+        self._capacity = max(1, capacity)
+
+    @staticmethod
+    def new_span_id() -> str:
+        return uuid.uuid4().hex[:16]
+
+    def start(self, trace_id: str, name: str,
+              parent_id: Optional[str] = None, **attrs: Any) -> Span:
+        span = Span(trace_id=trace_id, span_id=self.new_span_id(),
+                    name=name, start_ts=time.time(),
+                    parent_id=parent_id, attrs=dict(attrs))
+        with self._lock:
+            if trace_id not in self._traces:
+                while len(self._traces) >= self._capacity:
+                    self._traces.popitem(last=False)
+                self._traces[trace_id] = []
+            self._traces[trace_id].append(span)
+        return span
+
+    def get(self, trace_id: str) -> List[Dict[str, Any]]:
+        """Span dicts for one trace, in start order ([] if unknown)."""
+        with self._lock:
+            spans = list(self._traces.get(trace_id, ()))
+        return [s.to_dict() for s in spans]
+
+    def recent(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Newest-first `{trace_id, spans}` documents."""
+        with self._lock:
+            items = [(tid, list(spans)) for tid, spans
+                     in self._traces.items()][::-1]
+        return [{'trace_id': tid,
+                 'spans': [s.to_dict() for s in spans]}
+                for tid, spans in items[:max(0, limit)]]
+
+    @property
+    def trace_count(self) -> int:
+        with self._lock:
+            return len(self._traces)
 
 
 @dataclasses.dataclass
@@ -35,6 +161,9 @@ class RequestTrace:
     queued_ts: float
     prompt_tokens: int = 0
     http_request_id: Optional[str] = None
+    # Parent span id from the router's X-Skytpu-Trace header, when the
+    # request arrived through the fleet router (None for direct hits).
+    trace_parent: Optional[str] = None
     state: str = 'queued'
     admitted_ts: Optional[float] = None
     prefill_chunks: int = 0
@@ -103,6 +232,7 @@ class TraceStore:
         with self._lock:
             self._inflight[request_id] = trace
         self._emit_event(now, request_id, 'queued',
+                         http_request_id=http_request_id,
                          prompt_tokens=prompt_tokens)
         return trace
 
@@ -140,9 +270,11 @@ class TraceStore:
                 trace.state = 'decoding'
             elif name == 'first_token':
                 trace.first_token_ts = now
+            http_id = trace.http_request_id
         # prefill_chunk is per-chunk noise; keep the sink to transitions.
         if name != 'prefill_chunk':
-            self._emit_event(now, request_id, name, **fields)
+            self._emit_event(now, request_id, name,
+                             http_request_id=http_id, **fields)
 
     def finish(self, request_id: int, state: str,
                output_tokens: Optional[int] = None,
@@ -163,6 +295,7 @@ class TraceStore:
                 trace.error = error
             self._completed.append(trace)
         self._emit_event(now, request_id, state,
+                         http_request_id=trace.http_request_id,
                          output_tokens=trace.output_tokens)
         return trace
 
@@ -181,6 +314,7 @@ class TraceStore:
                 self._completed.append(t)
         for t in traces:
             self._emit_event(now, t.request_id, state,
+                             http_request_id=t.http_request_id,
                              output_tokens=t.output_tokens)
         return traces
 
@@ -215,7 +349,9 @@ class TraceStore:
         if self._jsonl_path is None or self._jsonl_failed:
             return
         rec = {'ts': ts, 'rid': request_id, 'event': event}
-        rec.update(fields)
+        # Drop absent annotations (e.g. http_request_id on a direct
+        # engine use) so offline joins key on presence, not null.
+        rec.update({k: v for k, v in fields.items() if v is not None})
         line = json.dumps(rec, default=str)
         with self._lock:
             try:

@@ -9,9 +9,20 @@ routes are ALSO mounted on every serve controller (`/services`,
 separate process.
 
 Routes:
-  GET /              HTML page (auto-refreshing services + replicas).
+  GET /              HTML page (auto-refreshing services + replicas,
+                     plus the data-plane fleet when --router is set).
   GET /api/services  JSON: [{service record, replicas: [...]}, ...].
+  GET /api/fleet     JSON fleet snapshot proxied from the router's
+                     observability surfaces (/router/replicas +
+                     /fleet/slo); 404 unless started with --router.
   GET /healthz       liveness probe.
+
+Fleet mode (``--router http://host:port``) points the dashboard at a
+``serve/router.py`` data plane: per-replica health/breaker/queue rows
+from ``/router/replicas`` and SLO goodput + burn rate from
+``/fleet/slo``.  The serve_state mode above remains for control-plane
+(SkyServe) services; deep metric browsing belongs to ``/fleet/metrics``
+on the router, which any Prometheus can federate directly.
 """
 from __future__ import annotations
 
@@ -21,6 +32,7 @@ import http.server
 import json
 import threading
 import time
+import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import sky_logging
@@ -30,6 +42,8 @@ from skypilot_tpu.serve import serve_utils
 logger = sky_logging.init_logger(__name__)
 
 DEFAULT_PORT = 5051
+
+_FLEET_FETCH_TIMEOUT_S = 5.0
 
 
 def _jsonable(row: Dict[str, Any]) -> Dict[str, Any]:
@@ -58,6 +72,25 @@ def services_snapshot(
     return out
 
 
+def fleet_snapshot(router_url: str) -> Dict[str, Any]:
+    """One JSON document for the data-plane fleet: the router's replica
+    views plus its SLO accounting.  Unreachable halves degrade to an
+    'error' field instead of failing the whole snapshot — the dashboard
+    must stay useful mid-incident."""
+    base = router_url.rstrip('/')
+    out: Dict[str, Any] = {'router': base}
+    for key, path in (('replicas', '/router/replicas'),
+                      ('slo', '/fleet/slo')):
+        try:
+            with urllib.request.urlopen(
+                    base + path,
+                    timeout=_FLEET_FETCH_TIMEOUT_S) as resp:
+                out[key] = json.loads(resp.read())
+        except Exception as e:  # pylint: disable=broad-except
+            out[key] = {'error': repr(e)}
+    return out
+
+
 _PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>SkyServe services</title>
 <style>
@@ -77,6 +110,7 @@ _PAGE = """<!doctype html>
 <h2>SkyServe services</h2>
 <div id="meta">auto-refreshing every 5s</div>
 <div id="services">{body}</div>
+<div id="fleet"></div>
 <script>
 // Service/replica fields are user-controlled (names, endpoints):
 // build nodes with textContent, never innerHTML.
@@ -125,7 +159,37 @@ async function refresh() {{
       new Date().toLocaleTimeString();
   }} catch (e) {{ /* controller restarting; retry next tick */ }}
 }}
+async function refreshFleet() {{
+  const root = document.querySelector('#fleet');
+  try {{
+    const r = await fetch('/api/fleet');
+    if (!r.ok) return;  // fleet mode not configured
+    const f = await r.json();
+    const h = document.createElement('h3');
+    h.textContent = 'Data-plane fleet · ' + f.router;
+    const rows = (f.replicas.replicas ?? []).map(rep => {{
+      const tr = document.createElement('tr');
+      tr.append(cell(rep.url), cell(rep.health),
+                cell(rep.circuit), cell(rep.inflight),
+                cell(rep.queue_depth ?? '-'),
+                cell(rep.free_pages ?? '-'),
+                cell(rep.routable ? 'yes' : 'no'));
+      return tr;
+    }});
+    const slo = document.createElement('div');
+    const slos = f.slo.slos ?? {{}};
+    slo.textContent = 'SLO (target ' +
+      (f.slo.goodput_target ?? '-') + '): ' +
+      Object.entries(slos).map(([k, v]) =>
+        k + ' goodput ' + (v.goodput ?? 1).toFixed(4) +
+        ' burn ' + (v.burn_rate ?? 0).toFixed(2)).join(' · ');
+    root.replaceChildren(h,
+      table(['URL', 'Health', 'Breaker', 'In-flight', 'Queue',
+             'Free pages', 'Routable'], rows), slo);
+  }} catch (e) {{ /* router restarting; retry next tick */ }}
+}}
 refresh(); setInterval(refresh, 5000);
+refreshFleet(); setInterval(refreshFleet, 5000);
 </script>
 </body></html>
 """
@@ -162,6 +226,9 @@ def render_index(service_name: Optional[str] = None) -> str:
 
 class _Handler(http.server.BaseHTTPRequestHandler):
 
+    # Set by start(): router base URL for fleet mode, or None.
+    router_url: Optional[str] = None
+
     def log_message(self, fmt: str, *args: Any) -> None:
         logger.debug('serve-dashboard: ' + fmt % args)
 
@@ -183,6 +250,17 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send(200,
                            json.dumps(services_snapshot()).encode(),
                            'application/json')
+            elif path == '/api/fleet':
+                if self.router_url is None:
+                    self._send(404, b'{"error": "fleet mode off; '
+                                    b'start with --router URL"}',
+                               'application/json')
+                else:
+                    self._send(
+                        200,
+                        json.dumps(
+                            fleet_snapshot(self.router_url)).encode(),
+                        'application/json')
             else:
                 self._send(404, b'{"error": "not found"}',
                            'application/json')
@@ -191,11 +269,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
 
 def start(host: str = '127.0.0.1',
-          port: int = DEFAULT_PORT
+          port: int = DEFAULT_PORT,
+          router_url: Optional[str] = None
           ) -> Tuple[http.server.ThreadingHTTPServer, threading.Thread]:
     """Standalone dashboard (all services) in a daemon thread; callers
-    own shutdown.  port=0 binds ephemeral (tests)."""
-    server = http.server.ThreadingHTTPServer((host, port), _Handler)
+    own shutdown.  port=0 binds ephemeral (tests).  ``router_url``
+    turns on fleet mode (/api/fleet + the fleet page section)."""
+    handler = type('_BoundHandler', (_Handler,),
+                   {'router_url': router_url})
+    server = http.server.ThreadingHTTPServer((host, port), handler)
     server.daemon_threads = True
     thread = threading.Thread(target=server.serve_forever,
                               name='serve-dashboard', daemon=True)
@@ -206,8 +288,9 @@ def start(host: str = '127.0.0.1',
 
 
 def serve_forever(host: str = '127.0.0.1',
-                  port: int = DEFAULT_PORT) -> None:
-    server, thread = start(host, port)
+                  port: int = DEFAULT_PORT,
+                  router_url: Optional[str] = None) -> None:
+    server, thread = start(host, port, router_url=router_url)
     try:
         thread.join()
     finally:
@@ -220,5 +303,9 @@ if __name__ == '__main__':
     parser = argparse.ArgumentParser()
     parser.add_argument('--host', default='127.0.0.1')
     parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    parser.add_argument('--router', default=None,
+                        help='Router base URL (e.g. http://host:8080) '
+                             'to show the data-plane fleet: replica '
+                             'health/breakers plus /fleet/slo goodput.')
     args = parser.parse_args()
-    serve_forever(args.host, args.port)
+    serve_forever(args.host, args.port, router_url=args.router)

@@ -306,6 +306,10 @@ class ReplicaSupervisor:
             slot.state = BACKOFF
             slot.next_start_at = now + delay
             self._met['restarts'].inc()
+            self.router.events.record(
+                'replica_restart', slot=slot.slot_id, url=slot.url,
+                exit_code=code, delay_s=round(delay, 3),
+                restarts_in_window=len(slot.restart_times))
             logger.warning(
                 f'replica slot {slot.slot_id} exited with code {code}; '
                 f'restarting in {delay:.2f}s '
@@ -329,6 +333,8 @@ class ReplicaSupervisor:
             slot.url = url.rstrip('/')
             slot.state = LIVE
             self.router.add_replica(slot.url)
+            self.router.events.record(
+                'replica_spawn', slot=slot.slot_id, url=slot.url)
             logger.info(
                 f'replica slot {slot.slot_id} spawned at {slot.url}')
 
@@ -386,6 +392,8 @@ class ReplicaSupervisor:
             for _ in range(self.desired - len(active)):
                 self._new_slot()
             self._met['scale_events'].labels(direction='up').inc()
+            self.router.events.record(
+                'scale_up', desired=self.desired, was=len(active))
             logger.info(f'scaling up to {self.desired} replica(s)')
         elif len(active) > self.desired:
             # Newest-first victims (oldest replicas hold the warmest
@@ -395,6 +403,10 @@ class ReplicaSupervisor:
                 key=lambda s: -s.slot_id)[:len(active) - self.desired]
             if victims:
                 self._met['scale_events'].labels(direction='down').inc()
+                self.router.events.record(
+                    'scale_down', desired=self.desired,
+                    was=len(active),
+                    victims=[s.slot_id for s in victims])
             for slot in victims:
                 logger.info(
                     f'scaling down: draining replica slot '

@@ -43,17 +43,21 @@ from __future__ import annotations
 import http.client
 import http.server
 import json
+import os
 import re
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 import uuid
 from typing import Dict, List, Optional, Set
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.infer import paging
+from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.observability import tracing as tracing_lib
 from skypilot_tpu.serve import constants
 from skypilot_tpu.utils import chaos
 from skypilot_tpu.utils import http_utils
@@ -116,7 +120,39 @@ def _router_metrics(registry: Optional[metrics_lib.Registry] = None):
             'skytpu_router_circuit_transitions_total',
             'Circuit-breaker state transitions, by new state.',
             labelnames=('state',)),
+        # Fleet federation (GET /fleet/metrics + /fleet/slo).
+        'fleet_routable': r.gauge(
+            'skytpu_fleet_replicas_routable',
+            'Routable replicas at the last federated scrape.'),
+        'fleet_free_pages': r.gauge(
+            'skytpu_fleet_free_pages',
+            'Sum of free KV pages across routable replicas at the '
+            'last federated scrape.'),
+        'fleet_queue_depth': r.gauge(
+            'skytpu_fleet_queue_depth',
+            'Sum of decode queue depths across routable replicas at '
+            'the last federated scrape.'),
+        'fleet_scrape': r.histogram(
+            'skytpu_fleet_scrape_seconds',
+            'Wall seconds for one federated scrape of every routable '
+            'replica.'),
+        'slo_burn': r.gauge(
+            'skytpu_slo_burn_rate',
+            'Fleet SLO burn rate: violated fraction over the allowed '
+            'violation budget (1 = burning exactly the budget).',
+            labelnames=('slo',)),
     }
+
+
+def _goodput_target_from_env() -> float:
+    """Fleet goodput target in (0, 1) from SKYTPU_SLO_GOODPUT_TARGET;
+    defaults to 0.99 (a 1% violation budget)."""
+    try:
+        v = float(os.environ.get('SKYTPU_SLO_GOODPUT_TARGET', '')
+                  or 0.99)
+    except ValueError:
+        return 0.99
+    return v if 0.0 < v < 1.0 else 0.99
 
 
 class CircuitBreaker:
@@ -341,6 +377,19 @@ class Router:
         self._met = _router_metrics(registry)
         self.registry = (registry if registry is not None
                          else metrics_lib.get_registry())
+        # Router-side distributed tracing: one root span per proxied
+        # request + one child span per delivery attempt, keyed by the
+        # external X-Request-Id (GET /traces serves these).
+        self.spans = tracing_lib.SpanStore()
+        # Flight recorder (GET /events): breaker transitions, health
+        # flips, and — via the supervisor wiring — restarts/drains/
+        # scale decisions land here.
+        self.events = events_lib.EventRing(registry=self.registry,
+                                           source='router')
+        chaos.add_event_sink(self._record_chaos_event)
+        # SLO goodput target for burn-rate math (SRE convention:
+        # burn rate 1.0 = violating exactly the allowed budget).
+        self.slo_goodput_target = _goodput_target_from_env()
         self._lock = threading.Lock()
         self._replicas: Dict[str, ReplicaView] = {}
         self._server: Optional[http.server.ThreadingHTTPServer] = None
@@ -350,12 +399,18 @@ class Router:
             self.set_replicas(replicas)
 
     # -- replica set --------------------------------------------------
+    def _record_chaos_event(self, point: str) -> None:
+        self.events.record('chaos_injection', point=point)
+
     def _new_view(self, url: str) -> ReplicaView:
+        def _on_transition(state: str, url: str = url) -> None:
+            self._met['circuit'].labels(state=state).inc()
+            self.events.record('breaker_transition', url=url,
+                               state=state)
         return ReplicaView(url, CircuitBreaker(
             failure_threshold=self._failure_threshold,
             cooldown_s=self._cooldown_s,
-            on_transition=lambda state: self._met['circuit'].labels(
-                state=state).inc()))
+            on_transition=_on_transition))
 
     def set_replicas(self, urls: List[str]) -> None:
         """Reconcile the routing table; existing views (health +
@@ -488,8 +543,13 @@ class Router:
                 self._scrape_signals(view)
             else:
                 view.consecutive_probe_failures += 1
+                prev = view.health
                 view.health = status
                 view.breaker.on_probe(False)
+                if status in ('unhealthy', 'unreachable') and \
+                        prev not in ('unhealthy', 'unreachable'):
+                    self.events.record('replica_unhealthy',
+                                       url=view.url, status=status)
         self._publish_replica_gauges()
 
     def _health_loop(self) -> None:
@@ -498,6 +558,118 @@ class Router:
                 self.health_tick()
             except Exception:  # pylint: disable=broad-except
                 logger.exception('router health tick failed')
+
+    # -- fleet federation ---------------------------------------------
+    _SCRAPE_ERRORS = (urllib.error.URLError, urllib.error.HTTPError,
+                      ConnectionError, TimeoutError, OSError,
+                      http.client.HTTPException, ValueError)
+
+    def _scrape_exposition(self, view: ReplicaView):
+        """One replica's parsed /metrics, or None (scrape failure is a
+        data gap, not an error — the replica may have just died)."""
+        try:
+            resp = urllib.request.urlopen(
+                view.url + '/metrics', timeout=self.health_timeout_s)
+            with resp:
+                return metrics_lib.parse_exposition(
+                    resp.read().decode('utf-8', 'replace'))
+        except self._SCRAPE_ERRORS:
+            return None
+
+    def fleet_metrics(self) -> str:
+        """Federated exposition: every routable replica's samples
+        re-rendered with a ``replica`` label, plus the fleet-level
+        gauges.  The output round-trips through parse_exposition."""
+        t0 = time.perf_counter()
+        lines: List[str] = []
+        routable = [v for v in self.views() if v.routable]
+        fleet_free = 0.0
+        fleet_queue = 0.0
+        for view in sorted(routable, key=lambda v: v.url):
+            parsed = self._scrape_exposition(view)
+            if parsed is None:
+                continue
+            fleet_free += metrics_lib.sample_value(
+                parsed, 'skytpu_kv_free_pages') or 0.0
+            fleet_queue += metrics_lib.sample_value(
+                parsed, 'skytpu_decode_queue_depth') or 0.0
+            esc = metrics_lib._escape_label_value(view.url)
+            for name in sorted(parsed):
+                for labels, value in sorted(parsed[name].items()):
+                    pairs = [f'replica="{esc}"'] + [
+                        f'{k}="{metrics_lib._escape_label_value(v)}"'
+                        for k, v in labels]
+                    lines.append(
+                        f'{name}{{{",".join(pairs)}}} '
+                        f'{metrics_lib._fmt_value(value)}')
+        self._met['fleet_routable'].set(len(routable))
+        self._met['fleet_free_pages'].set(fleet_free)
+        self._met['fleet_queue_depth'].set(fleet_queue)
+        lines.append(f'skytpu_fleet_replicas_routable {len(routable)}')
+        lines.append('skytpu_fleet_free_pages '
+                     f'{metrics_lib._fmt_value(fleet_free)}')
+        lines.append('skytpu_fleet_queue_depth '
+                     f'{metrics_lib._fmt_value(fleet_queue)}')
+        self._met['fleet_scrape'].observe(time.perf_counter() - t0)
+        return '\n'.join(lines) + '\n'
+
+    def fleet_slo(self) -> Dict[str, object]:
+        """Fleet SLO account: sums each replica's
+        skytpu_slo_requests_total verdicts, derives per-SLO goodput
+        and burn rate (violated fraction over the violation budget
+        ``1 - goodput_target``), and publishes the burn gauges."""
+        counts: Dict[str, Dict[str, float]] = {}
+        for view in self.views():
+            if not view.routable:
+                continue
+            parsed = self._scrape_exposition(view)
+            if not parsed:
+                continue
+            for labels, value in parsed.get(
+                    'skytpu_slo_requests_total', {}).items():
+                ld = dict(labels)
+                slo = ld.get('slo')
+                result = ld.get('result')
+                if slo and result:
+                    counts.setdefault(slo, {}).setdefault(result, 0.0)
+                    counts[slo][result] += value
+        budget = 1.0 - self.slo_goodput_target
+        slos: Dict[str, object] = {}
+        for slo, by_result in sorted(counts.items()):
+            good = by_result.get('good', 0.0)
+            violated = by_result.get('violated', 0.0)
+            total = good + violated
+            goodput = good / total if total else None
+            violated_frac = violated / total if total else 0.0
+            burn = violated_frac / budget
+            self._met['slo_burn'].labels(slo=slo).set(burn)
+            slos[slo] = {'good': good, 'violated': violated,
+                         'goodput': goodput, 'burn_rate': burn}
+        return {'goodput_target': self.slo_goodput_target,
+                'slos': slos}
+
+    def stitch_trace(self, trace_id: str) -> List[Dict[str, object]]:
+        """Replica-side engine timelines for one external request id:
+        each replica's /traces filtered to that http request id.
+        Unreachable replicas (e.g. the corpse a failover routed
+        around) contribute nothing — the router-side attempt spans
+        already tell that part of the story."""
+        out: List[Dict[str, object]] = []
+        q = urllib.parse.urlencode({'request_id': trace_id})
+        for view in sorted(self.views(), key=lambda v: v.url):
+            try:
+                resp = urllib.request.urlopen(
+                    f'{view.url}/traces?{q}',
+                    timeout=self.health_timeout_s)
+                with resp:
+                    body = json.loads(resp.read() or b'{}')
+            except self._SCRAPE_ERRORS:
+                continue
+            traces = body.get('traces') if isinstance(body, dict) \
+                else None
+            if traces:
+                out.append({'replica': view.url, 'traces': traces})
+        return out
 
     # -- selection ----------------------------------------------------
     def _saturated(self, view: ReplicaView) -> bool:
@@ -593,8 +765,19 @@ class Router:
                 except OSError:
                     self.close_connection = True
 
+            def _send_text(self, data: bytes, content_type: str) -> None:
+                try:
+                    self.send_response(200)
+                    self.send_header('Content-Type', content_type)
+                    self.send_header('Content-Length', str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except OSError:
+                    self.close_connection = True
+
             def do_GET(self):  # noqa: N802
-                route = self.path.split('?', 1)[0]
+                route, _, query = self.path.partition('?')
+                params = urllib.parse.parse_qs(query)
                 self.request_id = router._request_id(self.headers)
                 if route == '/health':
                     views = router.views()
@@ -605,18 +788,37 @@ class Router:
                         'replicas': len(views),
                         'routable': routable})
                 elif route == '/metrics':
-                    data = router.registry.expose().encode()
+                    self._send_text(router.registry.expose().encode(),
+                                    metrics_lib.CONTENT_TYPE_LATEST)
+                elif route == '/fleet/metrics':
+                    self._send_text(router.fleet_metrics().encode(),
+                                    metrics_lib.CONTENT_TYPE_LATEST)
+                elif route == '/fleet/slo':
+                    self._reply(200, router.fleet_slo())
+                elif route == '/events':
                     try:
-                        self.send_response(200)
-                        self.send_header(
-                            'Content-Type',
-                            metrics_lib.CONTENT_TYPE_LATEST)
-                        self.send_header('Content-Length',
-                                         str(len(data)))
-                        self.end_headers()
-                        self.wfile.write(data)
-                    except OSError:
-                        self.close_connection = True
+                        limit = int(params.get('limit', ['100'])[0])
+                    except ValueError:
+                        limit = 100
+                    self._reply(200, {
+                        'events': router.events.snapshot(limit)})
+                elif route == '/traces':
+                    try:
+                        limit = int(params.get('limit', ['50'])[0])
+                    except ValueError:
+                        limit = 50
+                    trace_id = (params.get('id') or [None])[0]
+                    if trace_id is None:
+                        self._reply(200,
+                                    {'traces': router.spans.recent(limit)})
+                    else:
+                        doc = {'trace_id': trace_id,
+                               'spans': router.spans.get(trace_id)}
+                        if params.get('stitch', ['0'])[0] not in (
+                                '0', '', 'false'):
+                            doc['replica_traces'] = \
+                                router.stitch_trace(trace_id)
+                        self._reply(200, doc)
                 elif route == '/router/replicas':
                     self._reply(200, {
                         'replicas': [v.snapshot()
@@ -672,9 +874,14 @@ class Router:
                    if k.lower() not in _HOP_HEADERS}
         headers['X-Request-Id'] = handler.request_id
         deadline = time.monotonic() + self._budget_from(body)
+        # The external request id IS the trace id: every router span,
+        # the X-Skytpu-Trace header, and the replica-side engine trace
+        # all key off it so GET /traces?id=...&stitch=1 joins them.
+        root = self.spans.start(handler.request_id, 'router.request',
+                                route=route, affinity_key=key is not None)
         state = {'client_started': False, 'attempts': 0,
                  'first_url': None, 'served_url': None,
-                 'retry_after': None}
+                 'retry_after': None, 'root': root}
         tried: Set[str] = set()
         t0 = time.perf_counter()
 
@@ -713,6 +920,8 @@ class Router:
         except retry_lib.RetryError:
             if not state['client_started']:
                 self._met['requests'].labels(outcome='unroutable').inc()
+                root.end(status='unroutable',
+                         attempts=state['attempts'])
                 handler._reply(  # pylint: disable=protected-access
                     503, {'error': 'no routable replica delivered the '
                                    'request within the retry budget',
@@ -722,6 +931,8 @@ class Router:
             else:
                 self._met['requests'].labels(
                     outcome='aborted_midstream').inc()
+                root.end(status='aborted_midstream',
+                         attempts=state['attempts'])
             return
         finally:
             self._met['latency'].observe(time.perf_counter() - t0)
@@ -729,6 +940,10 @@ class Router:
                 state['served_url'] != state['first_url']:
             self._met['failovers'].inc()
         self._met['requests'].labels(outcome='ok').inc()
+        root.end(status='ok', attempts=state['attempts'],
+                 served_by=state['served_url'],
+                 failover=(state['served_url'] is not None
+                           and state['served_url'] != state['first_url']))
 
     def _attempt(self, handler, view: ReplicaView, path: str,
                  body: Optional[bytes], headers: Dict[str, str],
@@ -738,6 +953,18 @@ class Router:
         replica.  A False return NEVER follows client-visible bytes —
         that is the no-double-execution rule for streamed requests."""
         chaos.maybe_hang('slow_replica')
+        root = state['root']
+        span = self.spans.start(root.trace_id, 'router.attempt',
+                                parent_id=root.span_id, url=view.url,
+                                breaker=view.breaker.state)
+        # The attempt span is the replica's parent: its id rides the
+        # X-Skytpu-Trace header so the replica's engine trace nests
+        # under the exact attempt that reached it (overwritten per
+        # attempt in the shared headers dict).
+        headers[tracing_lib.TRACE_HEADER] = \
+            tracing_lib.format_trace_context(root.trace_id,
+                                             span.span_id)
+        outcome = 'unknown'
         with self._lock:
             view.inflight += 1
         try:
@@ -757,14 +984,17 @@ class Router:
                             state['retry_after'] = ra
                         self._met['retries'].labels(
                             reason='shed').inc()
+                        outcome = 'shed'
                         return False
                     if e.code in _RETRYABLE_REPLICA_CODES:
                         view.breaker.record_failure()
                         self._met['retries'].labels(
                             reason='replica_5xx').inc()
+                        outcome = 'replica_5xx'
                         return False
                     # Deterministic replica answer (4xx, 504): the
                     # client's to see, not the router's to retry.
+                    outcome = f'relayed_{e.code}'
                     self._relay(handler, e, view, state)
                     return True
             except (urllib.error.URLError, ConnectionError,
@@ -772,6 +1002,7 @@ class Router:
                     http.client.HTTPException) as e:
                 view.breaker.record_failure()
                 self._met['retries'].labels(reason='conn_error').inc()
+                outcome = 'conn_error'
                 logger.warning(
                     f'replica {view.url} failed ({e!r}); failing over')
                 return False
@@ -782,12 +1013,16 @@ class Router:
                     view.breaker.record_failure()
                     self._met['retries'].labels(
                         reason='conn_error').inc()
+                    outcome = 'proxy_disconnect'
                     return False
                 view.breaker.record_success()
                 state['served_url'] = view.url
+                outcome = 'relayed'
                 self._relay(handler, resp, view, state)
             return True
         finally:
+            span.end(status='ok' if outcome.startswith('relayed')
+                     else 'retry', outcome=outcome)
             with self._lock:
                 view.inflight -= 1
 

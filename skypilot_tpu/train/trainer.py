@@ -290,6 +290,18 @@ def _train_metrics(registry=None):
                              'Optimizer steps completed.'),
         'tokens': reg.counter('skytpu_train_tokens_total',
                               'Tokens consumed by training.'),
+        # Shared-name compile telemetry: the serving engine observes
+        # the same two series with fn=decode/prefill, so one dashboard
+        # query covers compile spend across both entry points.
+        'jit_compiles': reg.counter(
+            'skytpu_jit_compiles_total',
+            'XLA compilations triggered, by jitted function.',
+            labelnames=('fn',)),
+        'jit_compile_seconds': reg.histogram(
+            'skytpu_jit_compile_seconds',
+            'Wall seconds spent in the first (compiling) call of a '
+            'jitted function, by function.',
+            labelnames=('fn',)),
     }
 
 
@@ -608,7 +620,18 @@ class Trainer:
                     jax.profiler.start_trace(profile_dir)
                     profiling = True
                 batch = next(data_iter)
+                # First call pays the jit trace+compile synchronously
+                # before dispatch returns — its wall time IS the
+                # compile time (steady-state dispatch is ~ms).
+                compiling = telemetry is not None and i == 0
+                t_step = time.perf_counter() if compiling else 0.0
                 metrics = self.step(batch)
+                if compiling:
+                    telemetry['jit_compiles'].labels(
+                        fn='train_step').inc()
+                    telemetry['jit_compile_seconds'].labels(
+                        fn='train_step').observe(
+                            time.perf_counter() - t_step)
                 if profiling and i + 1 == prof_stop:
                     jax.device_get(metrics['loss'])  # drain async work
                     jax.profiler.stop_trace()

@@ -145,8 +145,27 @@ def register_metric(registry=None):
         labelnames=('point',))
 
 
+# Flight-recorder sinks: callables(point) invoked on every injection.
+# The router and replica server hook their EventRings here so chaos
+# faults show up in GET /events next to the restarts/failovers they
+# caused.  Sinks survive configure()/disable() — wiring is not
+# schedule state.
+_event_sinks: list = []
+
+
+def add_event_sink(sink) -> None:
+    """Register an injection observer; idempotent per callable."""
+    if sink not in _event_sinks:
+        _event_sinks.append(sink)
+
+
 def _count_injection(point: str) -> None:
     register_metric().labels(point=point).inc()
+    for sink in list(_event_sinks):
+        try:
+            sink(point)
+        except Exception:  # pylint: disable=broad-except
+            pass  # forensics must never fail the fault path
 
 
 def _parse_schedule(schedule: str) -> Dict[str, _FaultSpec]:

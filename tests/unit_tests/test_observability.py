@@ -240,6 +240,178 @@ def test_trace_abort_all():
     assert all(t['state'] == 'aborted' for t in store.recent())
 
 
+def test_trace_abort_all_mixed_lifecycle_states():
+    """abort_all must terminate traces wherever they are in the
+    lifecycle — decoding, prefilling, or still queued — and preserve
+    the timeline facts each had already accrued."""
+    store = tracing_lib.TraceStore(capacity=8)
+    store.begin(1)                         # will reach decoding
+    store.event(1, 'admitted')
+    store.event(1, 'prefill_done')
+    store.event(1, 'first_token')
+    store.begin(2)                         # will reach prefilling
+    store.event(2, 'admitted')
+    store.begin(3)                         # stays queued
+    dropped = store.abort_all(error='RuntimeError("wedged")')
+    assert sorted(t.request_id for t in dropped) == [1, 2, 3]
+    assert store.inflight_count == 0
+    by_id = {t.request_id: t for t in dropped}
+    assert all(t.state == 'aborted' for t in dropped)
+    assert all(t.error == 'RuntimeError("wedged")' for t in dropped)
+    # The decoding trace keeps its TTFT; the queued one never got one.
+    assert by_id[1].ttft_seconds() is not None
+    assert by_id[2].admitted_ts is not None
+    assert by_id[2].first_token_ts is None
+    assert by_id[3].admitted_ts is None
+    # A second abort_all is a no-op (nothing left in flight).
+    assert store.abort_all() == []
+
+
+def test_trace_jsonl_sink_close_flushes_and_reopens(tmp_path):
+    sink = tmp_path / 'traces.jsonl'
+    store = tracing_lib.TraceStore(capacity=4, jsonl_path=str(sink))
+    store.begin(1)
+    store.finish(1, 'finished')
+    store.close()
+    lines = [json.loads(l) for l in sink.read_text().splitlines()]
+    assert [e['event'] for e in lines] == ['queued', 'finished']
+    # The sink reopens in append mode after close(): late events from
+    # a drain race land in the file instead of being dropped.
+    store.begin(2)
+    store.finish(2, 'cancelled')
+    store.close()
+    lines = [json.loads(l) for l in sink.read_text().splitlines()]
+    assert [e['event'] for e in lines] == ['queued', 'finished',
+                                           'queued', 'cancelled']
+    store.close()                          # idempotent
+
+
+def test_trace_completed_ring_eviction_boundary():
+    """capacity bounds COMPLETED traces only; eviction is exact at the
+    boundary (oldest out as the (capacity+1)-th completion lands) and
+    in-flight traces never count toward it."""
+    store = tracing_lib.TraceStore(capacity=2)
+    for rid in (1, 2):
+        store.begin(rid)
+        store.finish(rid, 'finished')
+    assert [t['request_id'] for t in store.recent(10)] == [2, 1]
+    assert store.get(1) is not None        # at capacity, not past it
+    store.begin(3)
+    store.finish(3, 'finished')            # capacity+1: evicts rid 1
+    assert [t['request_id'] for t in store.recent(10)] == [3, 2]
+    assert store.get(1) is None
+    store.begin(4)                         # in-flight: outside the ring
+    assert [t['request_id'] for t in store.recent(10)] == [4, 3, 2]
+    assert store.get(2) is not None
+    store.finish(4, 'finished')            # completes: now evicts rid 2
+    assert store.get(2) is None
+
+
+# ---------------------------------------------------------------------
+# Distributed tracing primitives (spans + context propagation)
+# ---------------------------------------------------------------------
+
+def test_trace_context_header_round_trip():
+    hdr = tracing_lib.format_trace_context('req-1a2b', 'span-3c4d')
+    assert hdr == 'req-1a2b/span-3c4d'
+    assert tracing_lib.parse_trace_context(hdr) == ('req-1a2b',
+                                                    'span-3c4d')
+
+
+@pytest.mark.parametrize('bad', [
+    None, '', 'noseparator', 'a/b/c', 'sp ace/x', 'a/',
+    'x' * 65 + '/y', 'ok/' + 'y' * 65,
+])
+def test_trace_context_malformed_values_are_absent(bad):
+    assert tracing_lib.parse_trace_context(bad) is None
+
+
+def test_span_store_parenting_and_order():
+    store = tracing_lib.SpanStore()
+    root = store.start('req-1', 'router.request', route='/generate')
+    child = store.start('req-1', 'router.attempt',
+                        parent_id=root.span_id, url='http://r1')
+    child.end(status='retry', outcome='conn_error')
+    root.end(status='ok', attempts=1)
+    spans = store.get('req-1')
+    assert [s['name'] for s in spans] == ['router.request',
+                                         'router.attempt']
+    assert spans[1]['parent_id'] == root.span_id
+    assert spans[1]['status'] == 'retry'
+    assert spans[1]['attrs']['outcome'] == 'conn_error'
+    assert spans[0]['duration_seconds'] is not None
+    # end() is idempotent: the first end wins the timestamp.
+    first_end = root.end_ts
+    root.end(status='late')
+    assert root.end_ts == first_end
+    assert store.get('missing') == []
+
+
+def test_span_store_evicts_whole_oldest_traces():
+    store = tracing_lib.SpanStore(capacity=2)
+    for tid in ('t1', 't2', 't3'):
+        store.start(tid, 'root')
+        store.start(tid, 'child')
+    assert store.trace_count == 2
+    assert store.get('t1') == []           # evicted as a unit
+    assert len(store.get('t2')) == 2       # survivor keeps all spans
+    docs = store.recent(10)
+    assert [d['trace_id'] for d in docs] == ['t3', 't2']
+    # Re-starting an evicted trace id opens a fresh trace.
+    store.start('t1', 'root')
+    assert store.get('t2') == []           # t2 was oldest; now evicted
+
+
+# ---------------------------------------------------------------------
+# Flight recorder (EventRing)
+# ---------------------------------------------------------------------
+
+def test_event_ring_contract_capacity_and_counter():
+    from skypilot_tpu.observability import events as events_lib
+    reg = metrics_lib.Registry()
+    ring = events_lib.EventRing(capacity=3, registry=reg,
+                                source='router')
+    with pytest.raises(ValueError):
+        ring.record('not_a_real_event')
+    for i in range(5):
+        ring.record('chaos_injection', point=f'p{i}')
+    ring.record('breaker_transition', url='http://r1', state='open')
+    assert len(ring) == 3                  # ring stays bounded
+    assert ring.total_recorded == 6        # monotonic across eviction
+    snap = ring.snapshot()
+    assert [e['event'] for e in snap] == ['breaker_transition',
+                                          'chaos_injection',
+                                          'chaos_injection']
+    assert snap[0]['seq'] == 6 and snap[0]['source'] == 'router'
+    assert snap[0]['url'] == 'http://r1'
+    assert len(ring.snapshot(limit=1)) == 1
+    c = reg.get('skytpu_events_total')
+    assert c.value_for(kind='chaos_injection') == 5.0
+    assert c.value_for(kind='breaker_transition') == 1.0
+
+
+def test_chaos_injections_fan_out_to_event_sinks():
+    from skypilot_tpu.observability import events as events_lib
+    from skypilot_tpu.utils import chaos
+    ring = events_lib.EventRing(source='test')
+
+    def sink(point):
+        ring.record('chaos_injection', point=point)
+
+    chaos.add_event_sink(sink)
+    chaos.add_event_sink(sink)             # idempotent registration
+    try:
+        chaos.configure('step_raise:p=1,n=1')
+        assert chaos.should_inject('step_raise')
+        events = [e for e in ring.snapshot()
+                  if e['event'] == 'chaos_injection']
+        assert len(events) == 1            # one sink entry => one event
+        assert events[0]['point'] == 'step_raise'
+    finally:
+        chaos.disable()
+        chaos._event_sinks.remove(sink)
+
+
 # ---------------------------------------------------------------------
 # Engine lifecycle accounting (real tiny paged engine)
 # ---------------------------------------------------------------------
@@ -372,12 +544,18 @@ def test_every_registered_metric_name_matches_contract(paged_engine):
     which the skylint metric-contract rule enforces statically."""
     from skypilot_tpu import observability
     from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.observability import events as events_lib
+    from skypilot_tpu.serve import replica_supervisor
+    from skypilot_tpu.serve import router as router_lib
     from skypilot_tpu.train import trainer as trainer_lib
     _, reg = paged_engine
     server_lib._http_metrics(reg)
     trainer_lib._train_metrics(reg)
+    router_lib._router_metrics(reg)
+    replica_supervisor._supervisor_metrics(reg)
+    events_lib.EventRing(registry=reg)
     names = reg.names()
-    assert len(names) >= 20
+    assert len(names) >= 30
     for name in names:
         assert observability.METRIC_NAME_RE.fullmatch(name), name
         assert name in observability.METRIC_CONTRACT, name
@@ -408,7 +586,10 @@ def test_per_step_publish_overhead_under_two_percent(paged_engine):
     iters = 1000
     t0 = time.perf_counter()
     for _ in range(iters):
-        eng._publish_step_metrics(2, 1e6)
+        # Full runtime-telemetry surface: occupancy + KV reads + the
+        # host-step breakdown (dispatch vs device wait) per step.
+        eng._publish_step_metrics(2, 1e6, dispatch_s=0.004,
+                                  device_wait_s=0.001)
     publish_s = (time.perf_counter() - t0) / iters
     assert publish_s < 0.02 * step_s, (
         f'publish {publish_s * 1e6:.1f}us vs step '

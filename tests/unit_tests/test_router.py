@@ -10,6 +10,7 @@ restarts) lives in test_router_e2e.py.
 import http.server
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
@@ -534,7 +535,16 @@ class TestRouterProxy:
             codes = [_post(router.url)[0] for _ in range(6)]
             assert codes == [200] * 6
             reg = router.registry
-            parsed = metrics_lib.parse_exposition(reg.expose())
+            # The ok-outcome counter lands just AFTER the last response
+            # byte reaches the client; give the router thread a beat.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                parsed = metrics_lib.parse_exposition(reg.expose())
+                if metrics_lib.sample_value(
+                        parsed, 'skytpu_router_requests_total',
+                        outcome='ok') == 6.0:
+                    break
+                time.sleep(0.02)
             assert metrics_lib.sample_value(
                 parsed, 'skytpu_router_requests_total',
                 outcome='ok') == 6.0

@@ -78,11 +78,14 @@ def test_metrics_scrape_after_round_trip(server):
     # contract does not know.
     scraped = {line.split(' ')[2] for line in text.splitlines()
                if line.startswith('# TYPE ')}
-    # skytpu_train_* lives in the trainer and skytpu_router_* in the
-    # router/supervisor process — neither is a replica-side series.
+    # skytpu_train_* lives in the trainer; skytpu_router_*,
+    # skytpu_fleet_*, and the burn-rate gauge live in the
+    # router/supervisor process — none is a replica-side series.
     expected = {n for n in observability.METRIC_CONTRACT
                 if not n.startswith(('skytpu_train_',
-                                     'skytpu_router_'))}
+                                     'skytpu_router_',
+                                     'skytpu_fleet_'))
+                and n != 'skytpu_slo_burn_rate'}
     assert scraped == expected, scraped ^ expected
     # Exposition format details the contract set cannot express:
     for needle in ('skytpu_request_ttft_seconds_bucket',

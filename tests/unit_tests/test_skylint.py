@@ -255,6 +255,32 @@ def test_metric_contract_tp_and_tn(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# trace-discipline
+# ---------------------------------------------------------------------
+
+def test_trace_discipline_tp_and_tn(tmp_path):
+    src = """
+        def go(self, rid, name):
+            self.events.record('replica_spawn', slot=1)      # TN
+            self.events.record('bogus_event')                # TP: unknown
+            self.events.record(name)                         # TP: dynamic
+            self.traces.event(rid, 'first_token')            # TN
+            self.traces.event(rid, 'not_a_thing')            # TP: unknown
+            self.timeline.record('whatever')   # TN: other receiver
+            timeline.event('scope-name')       # TN: other receiver
+    """
+    findings = _live(_lint(tmp_path, 'serve/x.py', src,
+                           rule='trace-discipline'))
+    assert {f.symbol for f in findings} == {'bogus_event', '.record',
+                                            'not_a_thing'}
+    # The implementations manipulate names generically: out of scope.
+    assert not _live(_lint(tmp_path, 'observability/events.py', src,
+                           rule='trace-discipline'))
+    assert not _live(_lint(tmp_path, 'observability/tracing.py', src,
+                           rule='trace-discipline'))
+
+
+# ---------------------------------------------------------------------
 # dtype-promotion
 # ---------------------------------------------------------------------
 
@@ -444,4 +470,4 @@ def test_all_rule_families_are_registered():
     assert {'host-sync', 'retrace-hazard', 'lock-discipline',
             'thread-discipline', 'stdout-purity', 'metric-contract',
             'dtype-promotion', 'sleep-discipline',
-            'net-timeout'} <= ids
+            'net-timeout', 'trace-discipline'} <= ids
