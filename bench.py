@@ -580,6 +580,73 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
             sum(len(o) for o in dis_outs) / max(dis_dt, 1e-9), 1),
     }
 
+    # --- fourth arm: speculative decoding (gpt2 draft/target pair) ---
+    # Same-config/same-seed gpt2-tiny pair: the draft proposes the
+    # target's own greedy continuation, so acceptance is ~1 and the
+    # arm measures the PLUMBING ceiling — how few target forwards the
+    # multi-token verify path needs per committed token (1/(k+1)
+    # ideal).  Greedy, so the speculative stream must match the plain
+    # engine token-for-token (the parity guarantee is asserted by the
+    # capture test, not just recorded).  float32 end to end: the
+    # s-token verify forward must be bit-comparable to the plain s=1
+    # reference.
+    sp_k = 4
+    sp_new = 12 if smoke else 32
+    sp_overrides = dict(n_layers=2, dim=64, n_heads=4, ffn_dim=128,
+                        vocab_size=96, max_seq_len=128,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+    sp_prompts = [list(rng.integers(1, 96, 12)) for _ in range(n_slots)]
+    sp_sampling = engine_lib.SamplingConfig(max_new_tokens=sp_new,
+                                            temperature=0.0)
+
+    def _spec_arm(spec_kwargs, params=None, registry=None):
+        eng = engine_lib.ContinuousBatchingEngine(
+            'gpt2-tiny', n_slots=n_slots, prefill_bucket=8,
+            model_overrides=dict(sp_overrides),
+            param_dtype=jnp.float32, params=params, registry=registry,
+            **spec_kwargs)
+        eng.generate(sp_prompts, sp_sampling)      # compile warmup
+        info0 = (eng.speculation_info() if spec_kwargs
+                 else {'steps': 0})
+        t0 = time.time()
+        outs = eng.generate(sp_prompts, sp_sampling)
+        return eng, outs, time.time() - t0, info0
+
+    plain_eng, plain_outs, plain_dt, _ = _spec_arm({})
+    spec_reg = metrics_lib.Registry()
+    spec_eng, spec_outs, spec_dt, sp_info0 = _spec_arm(
+        dict(spec_k=sp_k, draft_model='gpt2-tiny',
+             draft_overrides=dict(sp_overrides)),
+        params=plain_eng.params, registry=spec_reg)
+    sp_info = spec_eng.speculation_info()
+    sp_tokens = sum(len(o) for o in spec_outs)
+    # Target verify steps in the MEASURED run only (warmup counted in
+    # the cumulative info); the seeded first token takes no step.
+    sp_steps = sp_info['steps'] - sp_info0['steps']
+    sp_parity = [list(a) for a in spec_outs] == \
+        [list(a) for a in plain_outs]
+    # Accepted-length histogram (cumulative le buckets) straight from
+    # the arm's private registry scrape — same series dashboards read.
+    sp_hist = {
+        dict(labels).get('le', ''): v
+        for labels, v in metrics_lib.parse_exposition(
+            spec_reg.expose()).get(
+                'skytpu_spec_accepted_tokens_bucket', {}).items()}
+    spec_arm = {
+        'spec_k': sp_k,
+        'mode': sp_info.get('mode', 'draft'),
+        'draft_model': 'gpt2-tiny',
+        'tokens_per_sec_plain': round(
+            sum(len(o) for o in plain_outs) / max(plain_dt, 1e-9), 1),
+        'tokens_per_sec_speculative': round(
+            sp_tokens / max(spec_dt, 1e-9), 1),
+        'target_steps_per_token': round(
+            sp_steps / max(sp_tokens, 1), 3),
+        'acceptance_rate': sp_info.get('acceptance_rate', 0.0),
+        'greedy_parity_vs_plain': sp_parity,
+        'accepted_length_histogram': sp_hist,
+    }
+
     result = {
         'metric': 'decode int8-KV cache-read reduction (B=4 slots, '
                   'deepseek-v2-lite attention geometry)',
@@ -591,10 +658,12 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
                        f'{int8_arm["cache_read_bytes_per_step_grouped"] / 1e6:.2f}'
                        f' MB/step',
         'arms': {'bf16': bf16_arm, 'int8': int8_arm,
-                 'paged': paged_arm},
+                 'paged': paged_arm, 'speculative': spec_arm},
         'telemetry': telemetry,
         'paged_read_reduction_vs_contiguous': round(pg_ratio, 2),
         'paged_token_parity': pg_parity,
+        'spec_steps_per_token': spec_arm['target_steps_per_token'],
+        'spec_token_parity': sp_parity,
         'n_heads': 16,
         'kv_heads_in_cache': 1,
         'device_kind': jax.devices()[0].device_kind,
@@ -620,6 +689,11 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
           f'({contig_reads["grouped_bytes"] / 1e6:.2f} MB -> '
           f'{paged_reads["grouped_bytes"] / 1e6:.2f} MB), greedy '
           f'token parity: {pg_parity}', file=sys.stderr)
+    print(f'# decode [speculative]: gpt2 pair spec-k={sp_k}, '
+          f'{spec_arm["target_steps_per_token"]:.3f} target '
+          f'steps/token (acceptance '
+          f'{spec_arm["acceptance_rate"]:.2f}), greedy '
+          f'token parity: {sp_parity}', file=sys.stderr)
     print(f'# telemetry: prefix hit ratio '
           f'{telemetry["prefix_hit_ratio"]:.2f} '
           f'({telemetry["prefix_page_hits"]:.0f} hits / '

@@ -106,6 +106,26 @@ def sample_logits_rows(logits: jax.Array, keys: jax.Array,
     (possible only with top_ps >= 1.0 under the promise) take the
     keep-all branch of the cutoff."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = filter_logits_rows(logits, temps, top_ks, top_ps,
+                                max_k=max_k, use_top_p=use_top_p,
+                                top_p_in_topk=top_p_in_topk)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row))(
+            keys, scaled).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+def filter_logits_rows(logits: jax.Array, temps: jax.Array,
+                       top_ks: jax.Array, top_ps: jax.Array, *,
+                       max_k: int, use_top_p: bool,
+                       top_p_in_topk: bool = False) -> jax.Array:
+    """The per-row temperature/top-k/top-p filter sample_logits_rows
+    draws from, exposed on its own: returns the temperature-scaled
+    logits with every filtered-out entry at -1e30, i.e. softmax of the
+    return value IS the decode-time sampling distribution.  The
+    speculative acceptance kernel (infer/speculative.py) scores draft
+    proposals against exactly this distribution, which is what makes
+    its accept/resample rule distribution-preserving."""
     safe = jnp.where(temps > 0, temps, 1.0)[:, None]
     scaled = logits / safe
     if max_k > 0:
@@ -131,10 +151,7 @@ def sample_logits_rows(logits: jax.Array, keys: jax.Array,
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         keep = (top_ps[:, None] >= 1.0) | (scaled >= cutoff)
         scaled = jnp.where(keep, scaled, -1e30)
-    sampled = jax.vmap(
-        lambda k, row: jax.random.categorical(k, row))(
-            keys, scaled).astype(jnp.int32)
-    return jnp.where(temps > 0, sampled, greedy)
+    return scaled
 
 
 def top_k_bucket(k: int, vocab_size: int) -> int:
@@ -371,6 +388,112 @@ def _path_names(path) -> tuple:
     return tuple(getattr(k, 'key', str(k)) for k in path)
 
 
+# -- slot-cache insert/clear builders -----------------------------------
+# Shared by ContinuousBatchingEngine and the speculative draft runner
+# (infer/speculative.py), whose private cache mirrors the target's slot
+# layout: the functions are generic over the cache pytree, so one
+# definition serves both models.
+
+def make_insert_fn():
+    """Build the contiguous slot-insert: write a freshly prefilled
+    request into slot `slot` — cache rows, last-logits row, kv_mask
+    row.  `slot` is a traced scalar, so one compile covers every
+    slot."""
+    def _insert(cache, last, kv_mask, cache1, last_row, mask_row,
+                slot):
+        def _ins(big, small):
+            if big.ndim == 4:      # [B, kvh, S, hd]
+                return jax.lax.dynamic_update_slice(
+                    big, small, (slot, 0, 0, 0))
+            if big.ndim == 5:      # scanned: [L, B, kvh, S, hd]
+                return jax.lax.dynamic_update_slice(
+                    big, small, (0, slot, 0, 0, 0))
+            return big             # cursor scalars: unused in slot mode
+        cache = jax.tree.map(_ins, cache, cache1)
+        last = jax.lax.dynamic_update_slice(
+            last, last_row[None], (slot, 0))
+        kv_mask = jax.lax.dynamic_update_slice(
+            kv_mask, mask_row[None], (slot, 0))
+        return cache, last, kv_mask
+    return _insert
+
+
+def make_paged_insert_fn(ps: int, pps: int):
+    """Build the paged twin of the contiguous insert: scatter the
+    batch-1 contiguous prefill cache into the slot's pool pages and
+    write its device block-table row.  Pages below `copy_start_page`
+    hold a SHARED prefix that is already in the pool — their writes
+    are redirected to the reserved null page 0 so a refcounted page is
+    never rewritten."""
+    def _insert_paged(cache, last, kv_mask, cache1, last_row,
+                      mask_row, table_row, slot, copy_start_page):
+        flat1 = {
+            _path_names(p_): leaf for p_, leaf in
+            jax.tree_util.tree_flatten_with_path(cache1)[0]}
+        phys = jnp.where(
+            jnp.arange(pps) >= copy_start_page, table_row, 0)
+
+        def _scatter(path, pool):
+            names = _path_names(path)
+            src_name = _CONTIG_OF_POOL.get(names[-1])
+            if src_name is not None:
+                src = flat1[names[:-1] + (src_name,)]
+                if pool.ndim == 4:
+                    # pool [n_pages, kvh, ps, d], src [1, kvh, S, d]
+                    kvh, _, d = src.shape[1:]
+                    content = src[0].reshape(kvh, pps, ps, d)
+                    content = jnp.transpose(content, (1, 0, 2, 3))
+                    return pool.at[phys].set(
+                        content.astype(pool.dtype))
+                # scanned: pool [L, n_pages, kvh, ps, d],
+                #          src  [L, 1, kvh, S, d]
+                L = src.shape[0]
+                kvh, _, d = src.shape[2:]
+                content = src[:, 0].reshape(L, kvh, pps, ps, d)
+                content = jnp.transpose(content, (0, 2, 1, 3, 4))
+                return pool.at[:, phys].set(
+                    content.astype(pool.dtype))
+            if names[-1] == 'block_table':
+                if pool.ndim == 2:      # [B, pps]
+                    return jax.lax.dynamic_update_slice(
+                        pool, table_row[None], (slot, 0))
+                row = jnp.broadcast_to(  # scanned [L, B, pps]
+                    table_row[None, None],
+                    (pool.shape[0], 1, pool.shape[2]))
+                return jax.lax.dynamic_update_slice(
+                    pool, row, (0, slot, 0))
+            return pool          # cursors: unused in slot mode
+
+        cache = jax.tree_util.tree_map_with_path(_scatter, cache)
+        last = jax.lax.dynamic_update_slice(
+            last, last_row[None], (slot, 0))
+        kv_mask = jax.lax.dynamic_update_slice(
+            kv_mask, mask_row[None], (slot, 0))
+        return cache, last, kv_mask
+    return _insert_paged
+
+
+def make_clear_table_fn():
+    """Build the dead-slot block-table clear: the slot-mode write path
+    scatters into table[row, cursor] even for inactive rows, and a
+    stale row would scribble on pages the allocator already handed
+    elsewhere.  The zeroed row points at the reserved null page."""
+    def _clear_table(cache, slot):
+        def _clr(path, leaf):
+            if _path_names(path)[-1] != 'block_table':
+                return leaf
+            if leaf.ndim == 2:
+                zero = jnp.zeros((1, leaf.shape[1]), leaf.dtype)
+                return jax.lax.dynamic_update_slice(
+                    leaf, zero, (slot, 0))
+            zero = jnp.zeros(
+                (leaf.shape[0], 1, leaf.shape[2]), leaf.dtype)
+            return jax.lax.dynamic_update_slice(
+                leaf, zero, (0, slot, 0))
+        return jax.tree_util.tree_map_with_path(_clr, cache)
+    return _clear_table
+
+
 @dataclasses.dataclass
 class _Slot:
     """Host-side state of one occupied decode slot."""
@@ -384,10 +507,17 @@ class _Slot:
     top_p: float
     seed: int = 0
     generated: int = 0
+    # Decode/verify steps this slot took part in — diverges from
+    # generated on a speculating engine (multi-token commits), and the
+    # per-request tokens_per_step trace field derives from it.
+    steps: int = 0
     outputs: List[int] = dataclasses.field(default_factory=list)
     # Paged cache only: this slot's allocated page ids (block-table
     # prefix), released back to the allocator on completion/eviction.
     pages: List[int] = dataclasses.field(default_factory=list)
+    # Self-drafting speculation only: the true prompt ids, kept so the
+    # n-gram proposer can match against prompt + outputs.
+    prompt_ids: Optional[List[int]] = None
 
 
 @dataclasses.dataclass
@@ -658,10 +788,16 @@ class ContinuousBatchingEngine:
                  page_size: int = 0,
                  max_pages: int = 0,
                  seed: int = 0,
-                 registry: Optional[metrics_lib.Registry] = None) -> None:
+                 registry: Optional[metrics_lib.Registry] = None,
+                 draft_model: Optional[str] = None,
+                 draft_checkpoint_dir: Optional[str] = None,
+                 draft_overrides: Optional[Dict[str, Any]] = None,
+                 spec_k: int = 0) -> None:
         import collections
         import threading
 
+        if draft_model is not None and spec_k <= 0:
+            raise ValueError('draft_model requires spec_k > 0')
         # Model build, param load/sharding, and the [n_slots, ...]
         # cache scaffolding are identical to the request-level engine.
         self._eng = InferenceEngine(
@@ -721,27 +857,7 @@ class ContinuousBatchingEngine:
                                  static_argnames=('kv_bucket',),
                                  donate_argnums=(1,))
 
-        def _insert(cache, last, kv_mask, cache1, last_row, mask_row,
-                    slot):
-            """Write a freshly prefilled request into slot `slot`:
-            cache rows, last-logits row, kv_mask row.  `slot` is a
-            traced scalar — one compile covers every slot."""
-            def _ins(big, small):
-                if big.ndim == 4:      # [B, kvh, S, hd]
-                    return jax.lax.dynamic_update_slice(
-                        big, small, (slot, 0, 0, 0))
-                if big.ndim == 5:      # scanned: [L, B, kvh, S, hd]
-                    return jax.lax.dynamic_update_slice(
-                        big, small, (0, slot, 0, 0, 0))
-                return big             # cursor scalars: unused in slot mode
-            cache = jax.tree.map(_ins, cache, cache1)
-            last = jax.lax.dynamic_update_slice(
-                last, last_row[None], (slot, 0))
-            kv_mask = jax.lax.dynamic_update_slice(
-                kv_mask, mask_row[None], (slot, 0))
-            return cache, last, kv_mask
-
-        self._insert = jax.jit(_insert, donate_argnums=(0, 1, 2))
+        self._insert = jax.jit(make_insert_fn(), donate_argnums=(0, 1, 2))
 
         self._alloc = None
         if self.page_size:
@@ -751,64 +867,7 @@ class ContinuousBatchingEngine:
             self._pages_per_slot = pps
             self._alloc = paging_lib.PageAllocator(self.n_pages, ps)
 
-            def _insert_paged(cache, last, kv_mask, cache1, last_row,
-                              mask_row, table_row, slot,
-                              copy_start_page):
-                """Paged twin of _insert: scatter the batch-1
-                contiguous prefill cache into the slot's pool pages
-                and write its device block-table row.  Pages below
-                `copy_start_page` hold a SHARED prefix that is already
-                in the pool — their writes are redirected to the
-                reserved null page 0 so a refcounted page is never
-                rewritten."""
-                flat1 = {
-                    _path_names(p_): leaf for p_, leaf in
-                    jax.tree_util.tree_flatten_with_path(cache1)[0]}
-                phys = jnp.where(
-                    jnp.arange(pps) >= copy_start_page, table_row, 0)
-
-                def _scatter(path, pool):
-                    names = _path_names(path)
-                    src_name = _CONTIG_OF_POOL.get(names[-1])
-                    if src_name is not None:
-                        src = flat1[names[:-1] + (src_name,)]
-                        if pool.ndim == 4:
-                            # pool [n_pages, kvh, ps, d], src [1, kvh, S, d]
-                            kvh, _, d = src.shape[1:]
-                            content = src[0].reshape(kvh, pps, ps, d)
-                            content = jnp.transpose(content,
-                                                    (1, 0, 2, 3))
-                            return pool.at[phys].set(
-                                content.astype(pool.dtype))
-                        # scanned: pool [L, n_pages, kvh, ps, d],
-                        #          src  [L, 1, kvh, S, d]
-                        L = src.shape[0]
-                        kvh, _, d = src.shape[2:]
-                        content = src[:, 0].reshape(L, kvh, pps, ps, d)
-                        content = jnp.transpose(content,
-                                                (0, 2, 1, 3, 4))
-                        return pool.at[:, phys].set(
-                            content.astype(pool.dtype))
-                    if names[-1] == 'block_table':
-                        if pool.ndim == 2:      # [B, pps]
-                            return jax.lax.dynamic_update_slice(
-                                pool, table_row[None], (slot, 0))
-                        row = jnp.broadcast_to(  # scanned [L, B, pps]
-                            table_row[None, None],
-                            (pool.shape[0], 1, pool.shape[2]))
-                        return jax.lax.dynamic_update_slice(
-                            pool, row, (0, slot, 0))
-                    return pool          # cursors: unused in slot mode
-
-                cache = jax.tree_util.tree_map_with_path(_scatter,
-                                                         cache)
-                last = jax.lax.dynamic_update_slice(
-                    last, last_row[None], (slot, 0))
-                kv_mask = jax.lax.dynamic_update_slice(
-                    kv_mask, mask_row[None], (slot, 0))
-                return cache, last, kv_mask
-
-            self._insert_paged = jax.jit(_insert_paged,
+            self._insert_paged = jax.jit(make_paged_insert_fn(ps, pps),
                                          donate_argnums=(0, 1, 2))
 
             def _hydrate(cache1, cache, table_row, shared_pages,
@@ -851,27 +910,7 @@ class ContinuousBatchingEngine:
 
             self._hydrate1 = jax.jit(_hydrate, donate_argnums=(0,))
 
-            def _clear_table(cache, slot):
-                """Zero a dead slot's device block-table row: the
-                slot-mode write path scatters into table[row, cursor]
-                even for inactive rows, and a stale row would scribble
-                on pages the allocator already handed elsewhere.  The
-                zeroed row points at the reserved null page."""
-                def _clr(path, leaf):
-                    if _path_names(path)[-1] != 'block_table':
-                        return leaf
-                    if leaf.ndim == 2:
-                        zero = jnp.zeros((1, leaf.shape[1]),
-                                         leaf.dtype)
-                        return jax.lax.dynamic_update_slice(
-                            leaf, zero, (slot, 0))
-                    zero = jnp.zeros(
-                        (leaf.shape[0], 1, leaf.shape[2]), leaf.dtype)
-                    return jax.lax.dynamic_update_slice(
-                        leaf, zero, (0, slot, 0))
-                return jax.tree_util.tree_map_with_path(_clr, cache)
-
-            self._clear_table = jax.jit(_clear_table,
+            self._clear_table = jax.jit(make_clear_table_fn(),
                                         donate_argnums=(0,))
 
         def _decode_step(p, cache, last, kv_mask, rope_pos, cursors,
@@ -910,6 +949,97 @@ class ContinuousBatchingEngine:
             static_argnames=('max_k', 'use_top_p', 'top_p_in_topk',
                              'kv_bucket'),
             donate_argnums=(1, 3))
+
+        # -- speculative decoding (infer/speculative.py) --------------
+        # spec_k > 0 swaps the one-token decode above for a verify
+        # step: k proposed tokens + the pending token forward together
+        # (s = k+1 multi-token slot attention), the acceptance kernel
+        # keeps the longest target-approved prefix, and the commit
+        # reveals only that prefix's cache slots — 1..k+1 tokens per
+        # target forward, output distribution unchanged.
+        self.spec_k = spec_k
+        self._draft = None
+        self._spec_met = None
+        self._spec_steps_n = 0
+        self._spec_proposed_n = 0
+        self._spec_accepted_n = 0
+        self._spec_keys_seen: set = set()
+        if spec_k:
+            from skypilot_tpu.infer import speculative as spec_lib
+            if draft_model is not None:
+                self._draft = spec_lib.DraftRunner(
+                    draft_model,
+                    target_vocab_size=self.config.vocab_size,
+                    n_slots=n_slots, max_seq_len=self.max_seq_len,
+                    spec_k=spec_k, mesh=mesh,
+                    checkpoint_dir=draft_checkpoint_dir,
+                    model_overrides=draft_overrides,
+                    param_dtype=param_dtype,
+                    prefill_bucket=prefill_bucket,
+                    kv_cache_dtype=kv_cache_dtype,
+                    page_size=page_size, seed=seed)
+
+            def _seed_sample(last_row, seed_, temp, top_k, top_p,
+                             max_k: int, use_top_p: bool,
+                             top_p_in_topk: bool):
+                """First-token sample at prefill end: the verify step
+                needs a PENDING token to feed, so spec mode samples
+                token 1 from the prefill logits immediately (same
+                kernel + key fold as the fused decode step's
+                generated=0 draw — bit-identical numerics, and TTFT no
+                longer waits for the first decode tick)."""
+                key = jax.random.fold_in(jax.random.PRNGKey(seed_), 0)
+                return sample_logits_rows(
+                    last_row[None], key[None], temp[None], top_k[None],
+                    top_p[None], max_k=max_k, use_top_p=use_top_p,
+                    top_p_in_topk=top_p_in_topk)[0]
+
+            self._seed_sample = jax.jit(
+                _seed_sample,
+                static_argnames=('max_k', 'use_top_p', 'top_p_in_topk'))
+
+            def _spec_verify(p, cache, kv_mask, t_pend, drafts, rope,
+                             cursors, n_prop, seeds, gens, active,
+                             temps, top_ks, top_ps, max_k: int,
+                             use_top_p: bool, top_p_in_topk: bool,
+                             kv_bucket: int):
+                """Fused verify: reveal each active row's pending slot
+                (exactly what the one-token step reveals), forward all
+                k+1 positions, run acceptance, and reveal ONLY the
+                committed window [cursor, cursor+count).  Rejected
+                proposals' K/V stays masked — rollback without a copy;
+                the next verify overwrites those slots in place."""
+                from skypilot_tpu.infer import speculative as sl
+                from skypilot_tpu.models import llama as llama_lib
+                brange = jnp.arange(t_pend.shape[0])
+                reveal = kv_mask[brange, cursors] | active
+                kv_mask = kv_mask.at[brange, cursors].set(reveal)
+                tokens = jnp.concatenate([t_pend[:, None], drafts],
+                                         axis=1)
+                positions = rope[:, None] + jnp.arange(
+                    drafts.shape[1] + 1, dtype=jnp.int32)[None, :]
+                with llama_lib.kv_read_bucket(kv_bucket):
+                    logits, cache = _forward(p, cache, tokens,
+                                             positions, kv_mask)
+                out, counts = sl.accept_draft_rows(
+                    logits, drafts, n_prop, seeds, gens, temps,
+                    top_ks, top_ps, max_k=max_k, use_top_p=use_top_p,
+                    top_p_in_topk=top_p_in_topk)
+                counts = jnp.where(active, counts, 0)
+                slots_idx = jnp.arange(kv_mask.shape[1],
+                                       dtype=jnp.int32)
+                window = (active[:, None]
+                          & (slots_idx[None, :] >= cursors[:, None])
+                          & (slots_idx[None, :]
+                             < (cursors + counts)[:, None]))
+                kv_mask = kv_mask | window
+                return out, counts, cache, kv_mask
+
+            self._spec_verify = jax.jit(
+                _spec_verify,
+                static_argnames=('max_k', 'use_top_p', 'top_p_in_topk',
+                                 'kv_bucket'),
+                donate_argnums=(1, 2))
 
         self._cache = self._eng._fresh_cache()
         self._last = jnp.zeros((n_slots, self.config.vocab_size),
@@ -951,6 +1081,11 @@ class ContinuousBatchingEngine:
         self.registry = (registry if registry is not None
                          else metrics_lib.get_registry())
         self._met = _ServingMetrics(self.registry)
+        if self.spec_k:
+            # Spec series registered only on speculating engines: a
+            # plain replica's /metrics scrape must not advertise them.
+            from skypilot_tpu.infer import speculative as spec_lib
+            self._spec_met = spec_lib.spec_metrics(self.registry)
         self.traces = _trace_store_from_env()
         self._cannibalized_seen = 0
         # Compile/retrace accounting: the jitted decode/prefill paths
@@ -1225,6 +1360,10 @@ class ContinuousBatchingEngine:
                                jnp.float32)
         self._kv_mask = jnp.zeros((self.n_slots, self.max_seq_len),
                                   bool)
+        if self._draft is not None:
+            # The draft's propose/insert paths donate its buffers the
+            # same way: rebuild them from zeros alongside the target's.
+            self._draft.reset()
         for rid in victims:
             self._fail_request(rid, failures.wrap_abort(rid, error))
         logger.warning(
@@ -1488,6 +1627,59 @@ class ContinuousBatchingEngine:
             top_k=cfg.top_k, top_p=cfg.top_p, seed=seed,
             pages=pending.pages)
         self.traces.event(pending.rid, 'prefill_done')
+        if self.spec_k:
+            self._spec_seed_slot(pending)
+
+    def _spec_seed_slot(self, pending: _PendingPrefill) -> None:
+        """Speculation bootstrap at prefill end: the verify step feeds
+        [pending token, proposals...], so a fresh slot needs its first
+        token NOW — sampled from the prefill logits with the same
+        kernel and (seed, 0) key fold the fused decode step would use:
+        the first token is bit-identical to plain decode's, and TTFT
+        stops waiting for the first decode tick.  Draft mode also
+        prefills the prompt into the draft's private cache here (a
+        draft insert donates draft buffers, so this runs inside the
+        _finish_prefill SharedStateError scope and a failure rebuilds
+        both caches via recover())."""
+        slot = self._slots[pending.slot_idx]
+        cfg = pending.cfg
+        if self._draft is not None:
+            self._draft.admit(pending.slot_idx, pending.tokens,
+                              pending.mask_row, pending.true_len,
+                              pending.pad)
+        else:
+            slot.prompt_ids = \
+                pending.tokens[0, :pending.true_len].tolist()
+        max_k = top_k_bucket(cfg.top_k, self.config.vocab_size)
+        use_top_p = cfg.top_p < 1.0
+        tok = int(jax.device_get(self._seed_sample(
+            pending.last_row, jnp.int32(slot.seed),
+            jnp.float32(cfg.temperature), jnp.int32(cfg.top_k),
+            jnp.float32(cfg.top_p), max_k=max_k, use_top_p=use_top_p,
+            top_p_in_topk=bool(use_top_p and max_k > 0))))
+        self._met.output_tokens.inc()
+        self._commit_token(pending.slot_idx, tok)
+
+    def _commit_token(self, slot_idx: int, tok: int) -> bool:
+        """Emit ONE token for the slot: append, stream, first-token
+        trace event, eos/budget completion.  Returns True when the
+        slot completed.  Runs once per TOKEN (not per step) so
+        multi-token speculative commits keep per-token accounting —
+        first_token fires on the first committed token, and TPOT stays
+        tokens-based (observability/tracing.py)."""
+        s = self._slots[slot_idx]
+        s.outputs.append(tok)
+        s.generated += 1
+        if s.generated == 1:
+            self.traces.event(s.request_id, 'first_token')
+        q = self._stream_queues.get(s.request_id)
+        if q is not None:
+            q.put(tok)
+        if (s.eos_id is not None and tok == s.eos_id) or \
+                s.generated >= s.max_new:
+            self._complete(slot_idx)
+            return True
+        return False
 
     def _release_slot_pages(self, pages: List[int],
                             slot_idx: Optional[int] = None) -> None:
@@ -1525,7 +1717,8 @@ class ContinuousBatchingEngine:
         trace = self.traces.finish(
             slot.request_id,
             'cancelled' if was_canceled else 'finished',
-            output_tokens=len(slot.outputs))
+            output_tokens=len(slot.outputs),
+            decode_steps=slot.steps)
         if was_canceled:
             self._met.cancelled.inc()
         else:
@@ -1564,7 +1757,8 @@ class ContinuousBatchingEngine:
                 self._release_slot_pages(s.pages, i)
                 self._slots[i] = None
                 if self.traces.finish(s.request_id, 'evicted',
-                                      output_tokens=len(s.outputs)):
+                                      output_tokens=len(s.outputs),
+                                      decode_steps=s.steps):
                     evicted += 1
         keep: List[_PendingPrefill] = []
         for p in self._prefills:
@@ -1702,6 +1896,9 @@ class ContinuousBatchingEngine:
             self._met.inflight.set(self.traces.inflight_count)
             return bool(self._prefills) or bool(self._queue)
 
+        if self.spec_k:
+            return self._spec_step(occupied)
+
         b = self.n_slots
         cursors = np.zeros((b,), np.int32)
         rope = np.zeros((b,), np.int32)
@@ -1767,23 +1964,9 @@ class ContinuousBatchingEngine:
                 -(-(int(cursors[i]) + 1) // ps) for i in occupied)
         else:
             read_bytes = self._read_bytes_per_pos * bucket
-        # One dict ref for the whole step: dict.get is GIL-atomic, and
-        # per-slot lock acquisitions in the decode hot loop would
-        # contend with submit()/cancel() from the HTTP threads.
-        stream_queues = self._stream_queues
         for i in occupied:
-            s = self._slots[i]
-            tok = int(toks[i])
-            s.outputs.append(tok)
-            s.generated += 1
-            if s.generated == 1:
-                self.traces.event(s.request_id, 'first_token')
-            q = stream_queues.get(s.request_id)
-            if q is not None:
-                q.put(tok)
-            if (s.eos_id is not None and tok == s.eos_id) or \
-                    s.generated >= s.max_new:
-                self._complete(i)
+            self._slots[i].steps += 1
+            self._commit_token(i, int(toks[i]))
         self._publish_step_metrics(
             len(occupied), read_bytes,
             dispatch_s=t_dispatched - t_enter,
@@ -1791,11 +1974,136 @@ class ContinuousBatchingEngine:
             compiled=compiled)
         return True
 
+    def _spec_step(self, occupied: List[int]) -> bool:
+        """One speculative tick for all occupied slots: propose k
+        tokens per row (draft model, or n-gram self-drafting when no
+        draft is configured), verify the pending token + proposals in
+        a single s=k+1 target forward, commit the accepted prefix plus
+        one sampled token per row.  Every slot here already holds its
+        pending token (_spec_seed_slot emitted it at prefill end)."""
+        from skypilot_tpu.infer import speculative as spec_lib
+        from skypilot_tpu.models import llama
+
+        b = self.n_slots
+        k = self.spec_k
+        cursors = np.zeros((b,), np.int32)
+        rope = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        temps = np.zeros((b,), np.float32)
+        seeds = np.zeros((b,), np.int32)
+        gens = np.zeros((b,), np.int32)
+        top_ks = np.zeros((b,), np.int32)
+        top_ps = np.ones((b,), np.float32)
+        t_pend = np.zeros((b,), np.int32)
+        n_prop = np.zeros((b,), np.int32)
+        for i in occupied:
+            s = self._slots[i]
+            # The pending token's KV is not yet in cache: the verify
+            # forwards it at the slot one BEFORE the plain-decode
+            # cursor, together with the proposals behind it.
+            cursors[i] = s.pad_len + s.generated - 1
+            rope[i] = s.prompt_len + s.generated - 1
+            active[i] = True
+            temps[i] = s.temperature
+            seeds[i] = s.seed
+            gens[i] = s.generated
+            top_ks[i] = s.top_k
+            top_ps[i] = s.top_p
+            t_pend[i] = s.outputs[-1]
+            # Commits per verify = accepted + 1 <= n_prop + 1, and the
+            # row may emit at most (max_new - generated) more tokens.
+            n_prop[i] = min(k, s.max_new - s.generated - 1)
+        max_k = top_k_bucket(int(top_ks.max()),
+                             self.config.vocab_size)
+        use_top_p = bool((top_ps < 1.0).any())
+        top_p_in_topk = bool(
+            use_top_p and max_k > 0
+            and (top_ks[top_ps < 1.0] > 0).all())
+        if self.kv_read_bucket > 0:
+            # Query k attends through position cursor + k.
+            live = int(cursors[occupied].max()) + k + 1
+            gran = self.kv_read_bucket
+            bucket = min(self.max_seq_len,
+                         ((live + gran - 1) // gran) * gran)
+        else:
+            bucket = self.max_seq_len
+        if self._draft is not None:
+            drafts = self._draft.propose(
+                jnp.asarray(t_pend), jnp.asarray(rope),
+                jnp.asarray(cursors), jnp.asarray(active),
+                kv_bucket=bucket)
+            self._spec_met['draft_steps'].inc(k + 1)
+        else:
+            drafts_np = np.zeros((b, k), np.int32)
+            for i in occupied:
+                s = self._slots[i]
+                props = spec_lib.ngram_propose(
+                    s.prompt_ids + s.outputs, int(n_prop[i]))
+                drafts_np[i, :len(props)] = props
+                n_prop[i] = len(props)
+            drafts = jnp.asarray(drafts_np)
+        decode_key = (max_k, use_top_p, top_p_in_topk, bucket)
+        compiled = decode_key not in self._spec_keys_seen
+        t_enter = time.perf_counter()
+        with llama.slot_mode():
+            out_dev, counts_dev, self._cache, self._kv_mask = \
+                self._spec_verify(
+                    self.params, self._cache, self._kv_mask,
+                    jnp.asarray(t_pend), drafts, jnp.asarray(rope),
+                    jnp.asarray(cursors), jnp.asarray(n_prop),
+                    jnp.asarray(seeds), jnp.asarray(gens),
+                    jnp.asarray(active), jnp.asarray(temps),
+                    jnp.asarray(top_ks), jnp.asarray(top_ps),
+                    max_k=max_k, use_top_p=use_top_p,
+                    top_p_in_topk=top_p_in_topk, kv_bucket=bucket)
+        if self._draft is not None:
+            # Reveal the committed window in the draft's mask too —
+            # its scan already wrote KV for every speculated position.
+            self._draft.commit(jnp.asarray(cursors), counts_dev,
+                               jnp.asarray(active))
+        t_dispatched = time.perf_counter()
+        toks = np.asarray(jax.device_get(out_dev))
+        counts = np.asarray(jax.device_get(counts_dev))
+        t_fetched = time.perf_counter()
+        if compiled:
+            self._spec_keys_seen.add(decode_key)
+        if self.page_size:
+            ps = self.page_size
+            read_bytes = self._read_bytes_per_page * sum(
+                -(-(int(cursors[i]) + k + 1) // ps) for i in occupied)
+        else:
+            read_bytes = self._read_bytes_per_pos * bucket
+        committed = 0
+        accepted = 0
+        for i in occupied:
+            n = int(counts[i])
+            self._spec_met['accepted_len'].observe(n)
+            accepted += n - 1
+            self._slots[i].steps += 1
+            for j in range(n):
+                committed += 1
+                if self._commit_token(i, int(toks[i, j])):
+                    break       # eos/budget: drop the tail
+        proposed = int(n_prop[occupied].sum())
+        self._spec_met['steps'].inc()
+        self._spec_met['proposed'].inc(proposed)
+        self._spec_met['accepted'].inc(accepted)
+        self._spec_steps_n += 1
+        self._spec_proposed_n += proposed
+        self._spec_accepted_n += accepted
+        self._publish_step_metrics(
+            len(occupied), read_bytes,
+            dispatch_s=t_dispatched - t_enter,
+            device_wait_s=t_fetched - t_dispatched,
+            compiled=compiled, n_tokens=committed)
+        return True
+
     def _publish_step_metrics(self, n_occupied: int,
                               read_bytes: float,
                               dispatch_s: Optional[float] = None,
                               device_wait_s: Optional[float] = None,
-                              compiled: bool = False) -> None:
+                              compiled: bool = False,
+                              n_tokens: Optional[int] = None) -> None:
         """Per-step telemetry: gauges + counters from host-side state
         already in hand.  This is the entire per-step telemetry cost —
         the overhead guard test times it directly against a measured
@@ -1805,11 +2113,17 @@ class ContinuousBatchingEngine:
         on a first-sight static key (`compiled=True`) that includes
         trace+compile and is booked as a compile, otherwise it is the
         async-dispatch cost ROADMAP item 3 will be judged against.
-        `device_wait_s` is the host block on device_get."""
+        `device_wait_s` is the host block on device_get.
+
+        `n_tokens` is the number of tokens the step actually emitted;
+        it defaults to one per occupied slot (plain decode), and the
+        speculative step passes its multi-token commit total — token
+        accounting must never assume 1 token per step."""
         m = self._met
         m.steps.inc()
         m.slot_steps.inc(n_occupied)
-        m.output_tokens.inc(n_occupied)
+        m.output_tokens.inc(n_occupied if n_tokens is None
+                            else n_tokens)
         m.live_slots.set(n_occupied)
         m.occupancy.set(n_occupied / self.n_slots)
         m.queue_depth.set(len(self._queue))
@@ -1883,6 +2197,27 @@ class ContinuousBatchingEngine:
         if self._alloc is None:
             return None
         return self._alloc.free_pages
+
+    def speculation_info(self) -> Optional[Dict[str, Any]]:
+        """Speculation summary for /health?verbose=1 (None when
+        disabled): mode, spec_k, cumulative step/proposal/acceptance
+        counts, and the acceptance rate the router/fleet views key
+        off.  Advisory racy reads — the decode thread owns the
+        counters."""
+        if not self.spec_k:
+            return None
+        proposed = self._spec_proposed_n
+        return dict(
+            mode='draft' if self._draft is not None else 'ngram',
+            draft_model=(self._draft.model_name
+                         if self._draft is not None else None),
+            spec_k=self.spec_k,
+            steps=self._spec_steps_n,
+            proposed_tokens=proposed,
+            accepted_tokens=self._spec_accepted_n,
+            acceptance_rate=(self._spec_accepted_n / proposed
+                             if proposed else None),
+        )
 
     def prefix_routing_key(self, prompt_ids: Sequence[int]
                            ) -> Optional[int]:

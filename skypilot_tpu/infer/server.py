@@ -181,6 +181,10 @@ class InferenceServer:
                  stall_timeout_s: Optional[float] = None,
                  max_restarts: Optional[int] = None,
                  restart_window_s: Optional[float] = None,
+                 draft_model: Optional[str] = None,
+                 draft_checkpoint_dir: Optional[str] = None,
+                 draft_overrides=None,
+                 spec_k: int = 0,
                  ) -> None:
         from skypilot_tpu.parallel import mesh as mesh_lib
         # Hang-proof first backend touch: a wedged tunneled TPU makes
@@ -213,13 +217,20 @@ class InferenceServer:
                 kv_read_bucket=kv_read_bucket,
                 quantize=quantize, kv_cache_dtype=kv_cache_dtype,
                 page_size=page_size, max_pages=max_pages,
-                registry=registry)
+                registry=registry, draft_model=draft_model,
+                draft_checkpoint_dir=draft_checkpoint_dir,
+                draft_overrides=draft_overrides, spec_k=spec_k)
         else:
             if page_size:
                 raise ValueError(
                     '--page-size requires continuous batching (the '
                     'paged KV cache is slot-mode only); drop '
                     '--no-continuous.')
+            if spec_k or draft_model:
+                raise ValueError(
+                    '--spec-k/--draft-model require continuous '
+                    'batching (speculation is a slot-mode decode '
+                    'path); drop --no-continuous.')
             self.engine = engine_lib.InferenceEngine(
                 model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
                 max_batch_size=max_batch_size,
@@ -321,6 +332,12 @@ class InferenceServer:
         free = eng.free_pages()
         if free is not None:
             detail['free_pages'] = free
+        spec = getattr(eng, 'speculation_info', lambda: None)()
+        if spec is not None:
+            # Router/fleet views key off the acceptance rate: a replica
+            # whose speculation stopped paying for itself is visible
+            # without a metrics scrape.
+            detail['speculation'] = spec
         return detail
 
     def _fail_replica(self, error: BaseException) -> None:
@@ -1076,6 +1093,31 @@ def main() -> None:
                              '(e.g. \'{"n_layers": 2, "dim": 64}\') — '
                              'lets subprocess test replicas run tiny '
                              'geometry without a bespoke model name.')
+    parser.add_argument('--draft-model', default=None,
+                        help='Speculative decoding draft model: a '
+                             'small model (same tokenizer family — '
+                             'vocab checked at init) that proposes '
+                             '--spec-k tokens per decode step; the '
+                             'target verifies all of them in one '
+                             'multi-token forward and commits the '
+                             'accepted prefix. Output is unchanged: '
+                             'greedy requests stay bit-identical, '
+                             'sampled requests keep their exact '
+                             'distribution (rejection sampling). '
+                             'Requires --spec-k.')
+    parser.add_argument('--draft-checkpoint-dir', default=None,
+                        help='Checkpoint for --draft-model (random '
+                             'init without it — tests/dev only).')
+    parser.add_argument('--draft-overrides', default=None,
+                        help='JSON dict of draft-model config '
+                             'overrides (like --model-overrides).')
+    parser.add_argument('--spec-k', type=int, default=0,
+                        help='Speculative tokens proposed per decode '
+                             'step (0 disables speculation). Without '
+                             '--draft-model, proposals come from '
+                             'n-gram prompt-lookup self-drafting: '
+                             'zero extra weights, wins on repetitive '
+                             '/ shared-prefix traffic.')
     parser.add_argument('--kv-read-bucket', type=int, default=512,
                         help='Decode attention reads only the live '
                              'cache prefix, rounded up to this bucket '
@@ -1092,6 +1134,13 @@ def main() -> None:
         overrides = json.loads(args.model_overrides)
         if not isinstance(overrides, dict):
             parser.error('--model-overrides must be a JSON object')
+    draft_overrides = None
+    if args.draft_overrides:
+        draft_overrides = json.loads(args.draft_overrides)
+        if not isinstance(draft_overrides, dict):
+            parser.error('--draft-overrides must be a JSON object')
+    if args.draft_model and not args.spec_k:
+        parser.error('--draft-model requires --spec-k > 0')
     InferenceServer(model=args.model, port=args.port, host=args.host,
                     model_overrides=overrides,
                     max_batch_size=args.max_batch_size,
@@ -1109,6 +1158,10 @@ def main() -> None:
                     tokenizer=args.tokenizer,
                     allow_random_weights=args.allow_random_weights,
                     served_model_name=args.served_model_name,
+                    draft_model=args.draft_model,
+                    draft_checkpoint_dir=args.draft_checkpoint_dir,
+                    draft_overrides=draft_overrides,
+                    spec_k=args.spec_k,
                     ).serve_forever()
 
 

@@ -1,0 +1,395 @@
+"""Speculative decoding: draft proposals + parity-guarded acceptance.
+
+Decode latency is bounded by the NUMBER of target-model steps per
+token (PR 7's paged cache already minimized the bytes per step).  This
+module lets one target forward commit several tokens:
+
+  1. a proposer guesses k tokens — either a small DRAFT MODEL decoding
+     greedily against its own private KV cache, or, with zero extra
+     weights, SELF-DRAFTING via prompt-lookup (n-gram) matching;
+  2. the target scores all k+1 positions (the pending token plus the k
+     proposals) in ONE multi-token slot forward (models/llama.py
+     `_verify_positions`) over its paged/contiguous cache;
+  3. the acceptance kernel keeps the longest draft prefix the target
+     agrees with and samples one extra token, so every verify commits
+     between 1 and k+1 tokens.
+
+Acceptance is parity-guarded:
+
+  * temperature == 0 — a proposal is accepted iff it IS the target's
+    argmax at that position, and the correction/bonus token is the
+    argmax after the accepted prefix: the committed stream is
+    bit-identical to plain greedy decode.
+  * temperature > 0 — standard rejection sampling against the target's
+    FILTERED distribution p (the exact softmax plain decode draws
+    from, engine.filter_logits_rows): accept d with probability p(d)
+    (proposals are point-mass), on rejection resample from the
+    leftover distribution (p with d removed, renormalized).  The
+    marginal of every committed token is exactly p — the output
+    distribution is provably unchanged.
+
+Rollback never copies tensors: rejected proposals' K/V was written to
+cache positions that acceptance simply does not reveal, so the next
+verify overwrites them in place (the paged cache's block tables are
+untouched — "rollback via block-table truncation" falls out of the
+mask being the only source of truth for what a row has committed).
+"""
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.observability import metrics as metrics_lib
+
+
+# -- self-drafting: prompt-lookup / n-gram proposals --------------------
+
+def ngram_propose(context: Sequence[int], k: int, max_ngram: int = 3,
+                  min_ngram: int = 1) -> List[int]:
+    """Prompt-lookup proposals (zero extra weights): find the most
+    recent earlier occurrence of the longest suffix n-gram of
+    `context` and propose the tokens that followed it, up to k.
+    Returns [] when nothing matches — the engine then verifies only
+    the pending token (a plain decode step's worth of progress).
+    Ideal for the shared-prefix / templated traffic the prefix cache
+    already serves: continuations of repeated spans are free tokens.
+    """
+    n_ctx = len(context)
+    if k <= 0 or n_ctx < min_ngram + 1:
+        return []
+    for n in range(min(max_ngram, n_ctx - 1), min_ngram - 1, -1):
+        suffix = tuple(context[n_ctx - n:])
+        # Most recent earlier occurrence wins: recency tracks local
+        # repetition (code, templates) better than first match.
+        for start in range(n_ctx - n - 1, -1, -1):
+            if tuple(context[start:start + n]) == suffix:
+                cont = context[start + n:start + n + k]
+                if cont:
+                    return list(cont)
+                break
+    return []
+
+
+# -- acceptance kernel --------------------------------------------------
+
+def accept_draft_rows(logits: jax.Array, drafts: jax.Array,
+                      n_prop: jax.Array, seeds: jax.Array,
+                      gens: jax.Array, temps: jax.Array,
+                      top_ks: jax.Array, top_ps: jax.Array, *,
+                      max_k: int, use_top_p: bool,
+                      top_p_in_topk: bool = False):
+    """Accept/resample one verify forward's proposals.
+
+    logits: [B, k+1, V] — verify logits; row j is the target's
+        distribution for the position AFTER the j-th fed token, so
+        logits[:, i-1] judges drafts[:, i-1] (the i-th proposal) and
+        logits[:, n] seeds the correction/bonus token after an
+        n-long accepted prefix.
+    drafts: [B, k] int32 proposals; n_prop: [B] per-row valid count
+        (<= k; positions past it are auto-rejected padding).
+    seeds/gens: per-row PRNG basis — keys fold (seed, generated, i) so
+        draws are reproducible regardless of batch composition.
+    temps/top_ks/top_ps + static max_k/use_top_p/top_p_in_topk: the
+        same per-row sampling surface as engine.sample_logits_rows.
+
+    Returns (out_tokens [B, k+1], counts [B]): out_tokens[b, :counts[b]]
+    are the committed tokens — the accepted draft prefix plus exactly
+    one sampled token (the leftover resample at the first rejection,
+    or the bonus token when everything was accepted).
+    """
+    b, s, v = logits.shape
+    k = s - 1
+    greedy_ok = drafts == jnp.argmax(logits[:, :k], axis=-1)  # [B, k]
+    # Filtered target distributions for every judged position, via the
+    # SAME kernel plain decode samples from: flatten [B, k] positions
+    # into rows, repeat each row's sampling config across positions.
+    flat = logits[:, :k].reshape(b * k, v)
+    filt = engine_lib.filter_logits_rows(
+        flat, jnp.repeat(temps, k), jnp.repeat(top_ks, k),
+        jnp.repeat(top_ps, k), max_k=max_k, use_top_p=use_top_p,
+        top_p_in_topk=top_p_in_topk)
+    probs = jax.nn.softmax(filt, axis=-1).reshape(b, k, v)
+    p_draft = jnp.take_along_axis(
+        probs, drafts[:, :, None], axis=-1)[..., 0]           # [B, k]
+    base_keys = jax.vmap(
+        lambda sd, g: jax.random.fold_in(
+            jax.random.PRNGKey(sd), g))(seeds, gens)
+    accept_keys = jax.vmap(
+        lambda kb: jax.vmap(
+            lambda i: jax.random.fold_in(kb, i + 1))(
+                jnp.arange(k)))(base_keys)                    # [B, k]
+    u = jax.vmap(jax.vmap(
+        lambda key: jax.random.uniform(key)))(accept_keys)    # [B, k]
+    stoch_ok = u < p_draft
+    ok = jnp.where(temps[:, None] > 0, stoch_ok, greedy_ok)
+    ok = ok & (jnp.arange(k)[None, :] < n_prop[:, None])
+    prefix = jnp.cumprod(ok.astype(jnp.int32), axis=-1)
+    n_acc = jnp.sum(prefix, axis=-1).astype(jnp.int32)        # [B]
+    # Correction/bonus token from the distribution after the accepted
+    # prefix.  Stochastic rows that REJECTED a proposal resample from
+    # the leftover distribution: the filtered target with the rejected
+    # token removed and renormalized (point-mass proposals make the
+    # general max(p-q, 0) residual collapse to exactly this).  Greedy
+    # rows need no exclusion — a greedy mismatch already means the
+    # argmax differs from the rejected proposal.
+    all_filt = engine_lib.filter_logits_rows(
+        logits.reshape(b * s, v), jnp.repeat(temps, s),
+        jnp.repeat(top_ks, s), jnp.repeat(top_ps, s), max_k=max_k,
+        use_top_p=use_top_p,
+        top_p_in_topk=top_p_in_topk).reshape(b, s, v)
+    final_filt = jnp.take_along_axis(
+        all_filt, n_acc[:, None, None], axis=1)[:, 0]         # [B, V]
+    final_raw = jnp.take_along_axis(
+        logits, n_acc[:, None, None], axis=1)[:, 0]
+    rejected = jnp.take_along_axis(
+        drafts, jnp.minimum(n_acc, k - 1)[:, None], axis=1)[:, 0]
+    exclude = (temps > 0) & (n_acc < n_prop)
+    final_filt = jnp.where(
+        exclude[:, None] & (jnp.arange(v)[None, :]
+                            == rejected[:, None]),
+        -1e30, final_filt)
+    final_keys = jax.vmap(
+        lambda kb: jax.random.fold_in(kb, 0))(base_keys)
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row))(
+            final_keys, final_filt).astype(jnp.int32)
+    t_new = jnp.where(temps > 0, sampled,
+                      jnp.argmax(final_raw, axis=-1).astype(jnp.int32))
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)       # [B, k+1]
+    pos_idx = jnp.arange(k + 1)[None, :]
+    out = jnp.where(pos_idx == n_acc[:, None], t_new[:, None],
+                    drafts_pad)
+    out = jnp.where(pos_idx <= n_acc[:, None], out, 0)
+    return out, n_acc + 1
+
+
+# -- spec observability --------------------------------------------------
+
+def spec_metrics(registry: metrics_lib.Registry) -> Dict[str, Any]:
+    """Register the skytpu_spec_* series (names single-sourced through
+    observability.METRIC_CONTRACT).  Registered only on engines with
+    speculation enabled — the replica-side scrape contract test filters
+    the prefix out for plain servers."""
+    return dict(
+        steps=registry.counter(
+            'skytpu_spec_steps_total',
+            'Speculative verify steps run (one multi-token target '
+            'forward each).'),
+        draft_steps=registry.counter(
+            'skytpu_spec_draft_steps_total',
+            'Draft-model decode forwards run (k+1 per verify step in '
+            'draft mode; 0 when self-drafting).'),
+        proposed=registry.counter(
+            'skytpu_spec_proposed_tokens_total',
+            'Draft tokens proposed for verification.'),
+        accepted=registry.counter(
+            'skytpu_spec_accepted_tokens_total',
+            'Proposed tokens the target accepted.'),
+        accepted_len=registry.histogram(
+            'skytpu_spec_accepted_tokens',
+            'Tokens committed per sequence per verify step (accepted '
+            'prefix + the resampled/bonus token): 1 = nothing '
+            'accepted, k+1 = full acceptance.',
+            buckets=(1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 8.0, 12.0, 16.0)),
+    )
+
+
+# -- draft-model runner --------------------------------------------------
+
+class DraftRunner:
+    """Draft-model proposer mirroring the target engine's slot layout.
+
+    Same n_slots / max_seq_len / pad cursors as the target, so target
+    cursors map 1:1 onto the draft cache.  When the target is paged
+    the draft rides its OWN smaller pool (draft-sized pages): sized at
+    full coverage (n_slots * pages_per_slot + 1) with no prefix
+    sharing or oversubscription, so no allocator is needed — slot i
+    owns the fixed page range [1 + i*pps, 1 + (i+1)*pps) forever and
+    rollback is kv-mask truncation exactly like the target.
+
+    Per verify iteration the draft runs k+1 sequential greedy decode
+    steps under one lax.scan: steps 1..k emit the proposals d_1..d_k;
+    the extra step feeds d_k back so its K/V lands in the draft cache
+    (full acceptance would otherwise leave a hole the next iteration's
+    context misses).  `commit()` then reveals only the committed
+    window, discarding the scan's speculative reveals.
+    """
+
+    def __init__(self, model: str, *, target_vocab_size: int,
+                 n_slots: int, max_seq_len: int, spec_k: int,
+                 mesh=None, checkpoint_dir: Optional[str] = None,
+                 model_overrides: Optional[Dict[str, Any]] = None,
+                 param_dtype: Any = jnp.bfloat16,
+                 prefill_bucket: int = 64,
+                 quantize: Optional[str] = None,
+                 kv_cache_dtype: str = 'auto',
+                 page_size: int = 0, seed: int = 0) -> None:
+        if spec_k <= 0:
+            raise ValueError(f'spec_k must be positive, got {spec_k}')
+        self.k = spec_k
+        self._eng = engine_lib.InferenceEngine(
+            model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
+            max_batch_size=n_slots, max_seq_len=max_seq_len,
+            model_overrides=model_overrides, param_dtype=param_dtype,
+            prefill_bucket=prefill_bucket, quantize=quantize,
+            kv_cache_dtype=kv_cache_dtype, page_size=page_size,
+            max_pages=0, seed=seed)
+        # Tokenizer-family guard: draft proposals are TARGET token ids
+        # — a draft trained on a different vocabulary would silently
+        # decode garbage (every proposal rejected at best, nonsense
+        # committed at worst).  Vocab size is the strongest signal the
+        # configs carry; fail loudly at init, not mid-request.
+        if self._eng.config.vocab_size != target_vocab_size:
+            raise ValueError(
+                f'draft model {model!r} has vocab_size='
+                f'{self._eng.config.vocab_size} but the target expects '
+                f'{target_vocab_size}: speculative decoding requires '
+                f'the SAME tokenizer family for draft and target '
+                f'(proposals are exchanged as token ids).')
+        self.model_name = model
+        self.loaded_real_weights = self._eng.loaded_real_weights
+        self.n_slots = n_slots
+        self.max_seq_len = self._eng.max_seq_len
+        self.page_size = self._eng.page_size
+        model_obj = self._eng.model
+
+        rng = jax.random.PRNGKey(seed)
+        abstract1 = jax.eval_shape(
+            lambda: model_obj.init(rng, jnp.zeros((1, 1), jnp.int32)))
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        self._abstract_cache1 = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            sharding_lib.unbox(abstract1['cache']))
+
+        def _forward(p, cache, tokens, positions, kv_mask):
+            p = engine_lib.maybe_dequantize_params(
+                p, self._eng.config.param_dtype)
+            logits, mutated = model_obj.apply(
+                {'params': p, 'cache': cache}, tokens, positions,
+                kv_mask, mutable=['cache'])
+            return logits, mutated['cache']
+
+        def _prefill_fwd(p, cache, tokens, positions, kv_mask):
+            return _forward(p, cache, tokens, positions, kv_mask)
+
+        self._prefill1 = jax.jit(_prefill_fwd, donate_argnums=(1,))
+        self._insert = jax.jit(engine_lib.make_insert_fn(),
+                               donate_argnums=(0, 1, 2))
+        if self.page_size:
+            ps = self.page_size
+            pps = self.max_seq_len // ps
+            self._pages_per_slot = pps
+            self._insert_paged = jax.jit(
+                engine_lib.make_paged_insert_fn(ps, pps),
+                donate_argnums=(0, 1, 2))
+
+        def _propose(p, cache, kv_mask, t_pend, rope, cursors, active,
+                     kv_bucket: int):
+            """k+1 greedy draft steps under one scan (see class doc).
+            kv_mask is a scan carry: each step reveals its write slot
+            so the s=1 slot-mode cursor advances, but the mutated mask
+            is DISCARDED by the caller — commit() re-derives reveals
+            from the acceptance outcome."""
+            from skypilot_tpu.models import llama as llama_lib
+            brange = jnp.arange(t_pend.shape[0])
+
+            def body(carry, j):
+                cache, kv_mask, tok = carry
+                reveal = kv_mask[brange, cursors + j] | active
+                kv_mask = kv_mask.at[brange, cursors + j].set(reveal)
+                with llama_lib.kv_read_bucket(kv_bucket):
+                    logits, cache = _forward(
+                        p, cache, tok[:, None], (rope + j)[:, None],
+                        kv_mask)
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(
+                    jnp.int32)
+                return (cache, kv_mask, nxt), nxt
+
+            (cache, kv_mask, _), outs = jax.lax.scan(
+                body, (cache, kv_mask, t_pend),
+                jnp.arange(self.k + 1, dtype=jnp.int32))
+            # outs [k+1, B]: rows 0..k-1 are d_1..d_k; row k is the
+            # cache-fill step's output, discarded.
+            return jnp.transpose(outs[:self.k]), cache
+
+        self._propose = jax.jit(_propose,
+                                static_argnames=('kv_bucket',),
+                                donate_argnums=(1,))
+
+        def _commit(kv_mask, cursors, counts, active):
+            slots_idx = jnp.arange(kv_mask.shape[1], dtype=jnp.int32)
+            window = (active[:, None]
+                      & (slots_idx[None, :] >= cursors[:, None])
+                      & (slots_idx[None, :]
+                         < (cursors + counts)[:, None]))
+            return kv_mask | window
+
+        self._commit = jax.jit(_commit, donate_argnums=(0,))
+        self.reset()
+
+    def reset(self) -> None:
+        """Rebuild device state from zeros (engine recover() path —
+        donated buffers may be invalid after a mid-step failure)."""
+        self.cache = self._eng._fresh_cache()
+        self.kv_mask = jnp.zeros((self.n_slots, self.max_seq_len),
+                                 bool)
+        self._last_dummy = jnp.zeros((self.n_slots, 1), jnp.float32)
+
+    @property
+    def params(self):
+        return self._eng.params
+
+    def admit(self, slot_idx: int, tokens: np.ndarray,
+              mask_row: np.ndarray, true_len: int, pad: int) -> None:
+        """Prefill the prompt into the draft's slot `slot_idx`: one
+        whole-prompt batch-1 forward (the draft is small; chunking
+        buys nothing) + the shared slot-insert.  `tokens`/`mask_row`
+        are the target's padded prompt row and kv-mask row, so draft
+        and target cursors stay aligned by construction."""
+        del true_len  # alignment comes from the shared mask row
+        cache1 = jax.tree.map(
+            lambda leaf: jnp.zeros(leaf.shape, leaf.dtype),
+            self._abstract_cache1)
+        positions = jnp.arange(pad, dtype=jnp.int32)[None]
+        _, cache1 = self._prefill1(
+            self.params, cache1, jnp.asarray(tokens[:, :pad]),
+            positions, jnp.asarray(mask_row)[None])
+        last_row = jnp.zeros((1,), jnp.float32)   # draft keeps no last
+        slot = jnp.int32(slot_idx)
+        if self.page_size:
+            pps = self._pages_per_slot
+            table_row = jnp.arange(1 + slot_idx * pps,
+                                   1 + (slot_idx + 1) * pps,
+                                   dtype=jnp.int32)
+            self.cache, self._last_dummy, self.kv_mask = \
+                self._insert_paged(
+                    self.cache, self._last_dummy, self.kv_mask,
+                    cache1, last_row, jnp.asarray(mask_row),
+                    table_row, slot, jnp.int32(0))
+        else:
+            self.cache, self._last_dummy, self.kv_mask = self._insert(
+                self.cache, self._last_dummy, self.kv_mask, cache1,
+                last_row, jnp.asarray(mask_row), slot)
+
+    def propose(self, t_pend: jax.Array, rope: jax.Array,
+                cursors: jax.Array, active: jax.Array,
+                kv_bucket: int) -> jax.Array:
+        """Draft k proposals per row; returns [B, k] device tokens
+        (never synced to host — the verify consumes them on device)."""
+        from skypilot_tpu.models import llama as llama_lib
+        with llama_lib.slot_mode():
+            drafts, self.cache = self._propose(
+                self.params, self.cache, self.kv_mask, t_pend, rope,
+                cursors, active, kv_bucket=kv_bucket)
+        return drafts
+
+    def commit(self, cursors: jax.Array, counts: jax.Array,
+               active: jax.Array) -> None:
+        """Reveal the committed window [cursor, cursor + counts) per
+        active row.  Positions the scan wrote beyond it stay
+        unrevealed — that is the draft-side rollback."""
+        self.kv_mask = self._commit(self.kv_mask, cursors, counts,
+                                    active)

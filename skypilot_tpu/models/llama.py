@@ -287,6 +287,46 @@ def slot_mode():
         _SLOT_MODE.on = prev
 
 
+def _verify_positions(kv_mask: jax.Array, s: int, max_len: int):
+    """Per-row write positions for a multi-token slot forward.
+
+    Speculative verify scores s = k+1 tokens (the pending token plus k
+    draft proposals) in one forward.  The engine reveals ONLY the
+    pending token's slot before the call (same protocol as one-token
+    decode), so the row's write base is its highest revealed slot and
+    query j writes (and may attend to) positions base..base+j.  The
+    proposals' slots are NOT pre-revealed: acceptance reveals just the
+    committed prefix afterwards, so a rejected suffix's K/V stays
+    unrevealed garbage that a later verify overwrites in place —
+    rollback is mask truncation, never a tensor copy.  Returns
+    (base [B], pos [B, S]) with pos possibly exceeding max_len-1 for
+    rows near the end of their budget (callers drop/redirect those
+    writes; visibility never reaches them).
+    """
+    base = jnp.max(
+        jnp.where(kv_mask, jnp.arange(max_len, dtype=jnp.int32), 0),
+        axis=-1)                                   # [B]
+    pos = base[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    return base, pos
+
+
+def _verify_mask(kv_mask: jax.Array, base: jax.Array, s: int,
+                 read_len: int, window: Optional[int]) -> jax.Array:
+    """[B, 1, S, read_len] visibility for a multi-token slot forward:
+    query j sees every previously revealed slot plus the in-flight
+    window base..base+j (its own position and the proposals before
+    it)."""
+    slots = jnp.arange(read_len, dtype=jnp.int32)
+    qpos = base[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    new_vis = ((slots[None, None, :] >= base[:, None, None]) &
+               (slots[None, None, :] <= qpos[:, :, None]))
+    visible = kv_mask[:, None, :read_len] | new_vis
+    if window is not None:
+        visible = visible & (
+            slots[None, None, :] >= qpos[:, :, None] - window + 1)
+    return visible[:, None]
+
+
 def _paged_slot_attention(module: nn.Module, q: jax.Array,
                           k: jax.Array, v: jax.Array,
                           kv_mask: jax.Array, *, kvh: int, max_len: int,
@@ -335,28 +375,56 @@ def _paged_slot_attention(module: nn.Module, q: jax.Array,
                             (b, pages_per_slot), jnp.int32)
     cursor = module.variable('cache', 'cache_index',
                              lambda: jnp.zeros((), jnp.int32))
-    # Write position: the row's highest revealed kv_mask slot (same
-    # rule as the contiguous slot branch); the block table translates
-    # it to (physical page, in-page offset).
-    write_pos = jnp.max(
-        jnp.where(kv_mask, jnp.arange(max_len, dtype=jnp.int32), 0),
-        axis=-1)                                   # [B]
     brange = jnp.arange(b)
-    phys = table.value[brange, write_pos // ps]    # [B]
-    off = write_pos % ps
-    if quant:
-        kq, ks = ga.quantize_int8_rows(k[:, :, 0, :])  # [b,kvh,hd]
-        vq, vs = ga.quantize_int8_rows(v[:, :, 0, :])
-        page_k.value = page_k.value.at[phys, :, off, :].set(kq)
-        page_v.value = page_v.value.at[phys, :, off, :].set(vq)
-        pk_scale.value = pk_scale.value.at[phys, :, off, :].set(ks)
-        pv_scale.value = pv_scale.value.at[phys, :, off, :].set(vs)
+    if s == 1:
+        # Write position: the row's highest revealed kv_mask slot (same
+        # rule as the contiguous slot branch); the block table
+        # translates it to (physical page, in-page offset).
+        write_pos = jnp.max(
+            jnp.where(kv_mask, jnp.arange(max_len, dtype=jnp.int32), 0),
+            axis=-1)                               # [B]
+        phys = table.value[brange, write_pos // ps]    # [B]
+        off = write_pos % ps
+        if quant:
+            kq, ks = ga.quantize_int8_rows(k[:, :, 0, :])  # [b,kvh,hd]
+            vq, vs = ga.quantize_int8_rows(v[:, :, 0, :])
+            page_k.value = page_k.value.at[phys, :, off, :].set(kq)
+            page_v.value = page_v.value.at[phys, :, off, :].set(vq)
+            pk_scale.value = pk_scale.value.at[phys, :, off, :].set(ks)
+            pv_scale.value = pv_scale.value.at[phys, :, off, :].set(vs)
+        else:
+            page_k.value = page_k.value.at[phys, :, off, :].set(
+                k[:, :, 0, :].astype(dtype))
+            page_v.value = page_v.value.at[phys, :, off, :].set(
+                v[:, :, 0, :].astype(dtype))
     else:
-        page_k.value = page_k.value.at[phys, :, off, :].set(
-            k[:, :, 0, :].astype(dtype))
-        page_v.value = page_v.value.at[phys, :, off, :].set(
-            v[:, :, 0, :].astype(dtype))
-    cursor.value = cursor.value + 1
+        # Multi-token slot decode (speculative verify): see the
+        # contiguous branch in run_cached_attention for the base /
+        # visibility rule.  Positions past the row's allocated pages
+        # (or past max_len) are redirected to the reserved null page —
+        # the paged twin of the contiguous branch's dropped writes.
+        base, pos = _verify_positions(kv_mask, s, max_len)
+        lp = jnp.minimum(pos // ps, pages_per_slot - 1)
+        phys = table.value[brange[:, None], lp]        # [B, S]
+        phys = jnp.where(pos < max_len, phys, 0)
+        off = pos % ps
+        if quant:
+            kq, ks = ga.quantize_int8_rows(k)      # [b,kvh,s,hd/1]
+            vq, vs = ga.quantize_int8_rows(v)
+            page_k.value = page_k.value.at[phys, :, off, :].set(
+                kq.transpose(0, 2, 1, 3))
+            page_v.value = page_v.value.at[phys, :, off, :].set(
+                vq.transpose(0, 2, 1, 3))
+            pk_scale.value = pk_scale.value.at[phys, :, off, :].set(
+                ks.transpose(0, 2, 1, 3))
+            pv_scale.value = pv_scale.value.at[phys, :, off, :].set(
+                vs.transpose(0, 2, 1, 3))
+        else:
+            page_k.value = page_k.value.at[phys, :, off, :].set(
+                k.astype(dtype).transpose(0, 2, 1, 3))
+            page_v.value = page_v.value.at[phys, :, off, :].set(
+                v.astype(dtype).transpose(0, 2, 1, 3))
+    cursor.value = cursor.value + s
     # Static page-granular read window: the engine's kv_read_bucket
     # high-water mark, rounded up to whole pages.  Pages past it are
     # unrevealed for every active row, so the truncation is exact.
@@ -368,12 +436,15 @@ def _paged_slot_attention(module: nn.Module, q: jax.Array,
     tbl = table.value[:, :n_read]
     keys = ga.gather_pages(page_k.value, tbl)
     values = ga.gather_pages(page_v.value, tbl)
-    visible = kv_mask
-    if window is not None:
-        visible = visible & (
-            jnp.arange(max_len)[None, :] >= write_pos[:, None]
-            - window + 1)
-    mask = visible[:, None, None, :read_len]
+    if s == 1:
+        visible = kv_mask
+        if window is not None:
+            visible = visible & (
+                jnp.arange(max_len)[None, :] >= write_pos[:, None]
+                - window + 1)
+        mask = visible[:, None, None, :read_len]
+    else:
+        mask = _verify_mask(kv_mask, base, s, read_len, window)
     if quant:
         k_sc = ga.gather_pages(pk_scale.value, tbl)
         v_sc = ga.gather_pages(pv_scale.value, tbl)
@@ -420,7 +491,7 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
     b, h, s, hd = q.shape
     kvh = n_kv_heads
     max_len = max_seq_len
-    slot = (s == 1 and kv_mask is not None
+    slot = (kv_mask is not None
             and getattr(_SLOT_MODE, 'on', False))
     if page_size > 0 and slot:
         # Paged layout exists only for the slot-mode decode batch; the
@@ -458,36 +529,65 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
         # (finished/empty slots) rewrite their last revealed slot with
         # a dead token's K/V — harmless: their outputs are discarded
         # and re-admission re-prefills the slot.
-        write_pos = jnp.max(
-            jnp.where(kv_mask, jnp.arange(max_len, dtype=jnp.int32), 0),
-            axis=-1)                               # [B]
         brange = jnp.arange(b)
-        if quant:
-            kq, ks = ga.quantize_int8_rows(k[:, :, 0, :])  # [b,kvh,hd]
-            vq, vs = ga.quantize_int8_rows(v[:, :, 0, :])
-            cached_k.value = cached_k.value.at[
-                brange, :, write_pos, :].set(kq)
-            cached_v.value = cached_v.value.at[
-                brange, :, write_pos, :].set(vq)
-            k_scale.value = k_scale.value.at[
-                brange, :, write_pos, :].set(ks)
-            v_scale.value = v_scale.value.at[
-                brange, :, write_pos, :].set(vs)
+        if s == 1:
+            write_pos = jnp.max(
+                jnp.where(kv_mask,
+                          jnp.arange(max_len, dtype=jnp.int32), 0),
+                axis=-1)                           # [B]
+            if quant:
+                kq, ks = ga.quantize_int8_rows(k[:, :, 0, :])
+                vq, vs = ga.quantize_int8_rows(v[:, :, 0, :])
+                cached_k.value = cached_k.value.at[
+                    brange, :, write_pos, :].set(kq)
+                cached_v.value = cached_v.value.at[
+                    brange, :, write_pos, :].set(vq)
+                k_scale.value = k_scale.value.at[
+                    brange, :, write_pos, :].set(ks)
+                v_scale.value = v_scale.value.at[
+                    brange, :, write_pos, :].set(vs)
+            else:
+                cached_k.value = cached_k.value.at[
+                    brange, :, write_pos, :].set(
+                        k[:, :, 0, :].astype(dtype))
+                cached_v.value = cached_v.value.at[
+                    brange, :, write_pos, :].set(
+                        v[:, :, 0, :].astype(dtype))
         else:
-            cached_k.value = cached_k.value.at[
-                brange, :, write_pos, :].set(k[:, :, 0, :].astype(dtype))
-            cached_v.value = cached_v.value.at[
-                brange, :, write_pos, :].set(v[:, :, 0, :].astype(dtype))
-        cursor.value = idx + 1
-        visible = kv_mask
-        if window is not None:
-            # A row's slots are its tokens in order, so windowing by
-            # slot index relative to the newest (write) slot matches
-            # training's position window exactly.
-            visible = visible & (
-                jnp.arange(max_len)[None, :] >=
-                write_pos[:, None] - window + 1)
-        mask = visible[:, None, None, :]
+            # Multi-token slot decode (speculative verify): positions
+            # base..base+s-1 are written WITHOUT being revealed; see
+            # _verify_positions.  mode='drop' discards writes past
+            # max_len for rows at the end of their budget (their pad
+            # queries' outputs are rolled back by acceptance anyway).
+            base, pos = _verify_positions(kv_mask, s, max_len)
+            bcol = brange[:, None]
+            if quant:
+                kq, ks = ga.quantize_int8_rows(k)  # [b,kvh,s,hd/1]
+                vq, vs = ga.quantize_int8_rows(v)
+                cached_k.value = cached_k.value.at[bcol, :, pos, :].set(
+                    kq.transpose(0, 2, 1, 3), mode='drop')
+                cached_v.value = cached_v.value.at[bcol, :, pos, :].set(
+                    vq.transpose(0, 2, 1, 3), mode='drop')
+                k_scale.value = k_scale.value.at[bcol, :, pos, :].set(
+                    ks.transpose(0, 2, 1, 3), mode='drop')
+                v_scale.value = v_scale.value.at[bcol, :, pos, :].set(
+                    vs.transpose(0, 2, 1, 3), mode='drop')
+            else:
+                cached_k.value = cached_k.value.at[bcol, :, pos, :].set(
+                    k.astype(dtype).transpose(0, 2, 1, 3), mode='drop')
+                cached_v.value = cached_v.value.at[bcol, :, pos, :].set(
+                    v.astype(dtype).transpose(0, 2, 1, 3), mode='drop')
+        cursor.value = idx + s
+        if s == 1:
+            visible = kv_mask
+            if window is not None:
+                # A row's slots are its tokens in order, so windowing
+                # by slot index relative to the newest (write) slot
+                # matches training's position window exactly.
+                visible = visible & (
+                    jnp.arange(max_len)[None, :] >=
+                    write_pos[:, None] - window + 1)
+            mask = visible[:, None, None, :]
         # Static read-window over the live prefix of the cache (see
         # kv_read_bucket) — everything past it is unrevealed for
         # active rows, so slicing keys/values/mask is exact.  The
@@ -500,7 +600,10 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
         if quant:
             k_sc = k_scale.value[:, :, :read_len]
             v_sc = v_scale.value[:, :, :read_len]
-        mask = mask[:, :, :, :read_len]
+        if s == 1:
+            mask = mask[:, :, :, :read_len]
+        else:
+            mask = _verify_mask(kv_mask, base, s, read_len, window)
     else:
         if quant:
             kq, ks = ga.quantize_int8_rows(k)      # [b,kvh,s,hd/1]

@@ -21,8 +21,10 @@ from skypilot_tpu.observability.tracing import (TRACE_HEADER, RequestTrace,
                                                 parse_trace_context)
 
 # Naming contract for every series the repo registers.  Type-suffix
-# conventions (Counter -> _total, Histogram -> _seconds/_bytes) are
-# asserted by tests/unit_tests/test_observability.py on top of this.
+# conventions (Counter -> _total, Histogram -> _seconds/_bytes, or
+# _tokens for count-valued histograms like the speculative accepted
+# length) are asserted by tests/unit_tests/test_observability.py on
+# top of this.
 METRIC_NAME_RE = re.compile(
     r'skytpu_[a-z0-9_]+')
 
@@ -46,6 +48,14 @@ METRIC_CONTRACT = frozenset({
     'skytpu_prefix_cache_page_hits_total',
     'skytpu_prefix_cache_page_misses_total',
     'skytpu_prompt_tokens_total',
+    # infer/speculative.py — speculative decoding (registered only on
+    # engines started with spec_k > 0; the replica scrape test filters
+    # the prefix out for plain servers)
+    'skytpu_spec_steps_total',
+    'skytpu_spec_draft_steps_total',
+    'skytpu_spec_proposed_tokens_total',
+    'skytpu_spec_accepted_tokens_total',
+    'skytpu_spec_accepted_tokens',
     'skytpu_request_queue_seconds',
     'skytpu_request_tpot_seconds',
     'skytpu_request_ttft_seconds',

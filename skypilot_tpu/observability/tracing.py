@@ -171,6 +171,14 @@ class RequestTrace:
     first_token_ts: Optional[float] = None
     finished_ts: Optional[float] = None
     output_tokens: int = 0
+    # Decode steps this request participated in.  NOT the same as
+    # output_tokens: speculative decoding commits up to spec_k+1
+    # tokens per step (and the seeded first token arrives with
+    # prefill, taking no decode step at all), so tokens > steps on a
+    # speculating engine.  Latency math must divide by TOKENS;
+    # steps/token (the speculation win) is tokens_per_step()'s
+    # inverse.
+    decode_steps: int = 0
     shared_prefix_tokens: int = 0
     # repr() of the failure for 'cancelled'/'aborted' terminals that
     # have one (deadline expiry, recovery abort); None on clean exits.
@@ -188,12 +196,23 @@ class RequestTrace:
         return self.first_token_ts - self.queued_ts
 
     def tpot_seconds(self) -> Optional[float]:
-        """Mean seconds per output token after the first."""
+        """Mean seconds per output token after the first — derived
+        from TOKENS EMITTED, never from decode steps: a speculative
+        step commits several tokens, so a per-step derivation would
+        overstate TPOT by the acceptance factor (and the TPOT SLO
+        verdict with it)."""
         if (self.first_token_ts is None or self.finished_ts is None or
                 self.output_tokens < 2):
             return None
         return ((self.finished_ts - self.first_token_ts) /
                 (self.output_tokens - 1))
+
+    def tokens_per_step(self) -> Optional[float]:
+        """Mean tokens committed per decode step (> 1 when
+        speculation is accepting; None before any step)."""
+        if self.decode_steps <= 0:
+            return None
+        return self.output_tokens / self.decode_steps
 
     def total_seconds(self) -> Optional[float]:
         if self.finished_ts is None:
@@ -205,6 +224,7 @@ class RequestTrace:
         d['queue_seconds'] = self.queue_seconds()
         d['ttft_seconds'] = self.ttft_seconds()
         d['tpot_seconds'] = self.tpot_seconds()
+        d['tokens_per_step'] = self.tokens_per_step()
         d['total_seconds'] = self.total_seconds()
         return d
 
@@ -278,7 +298,8 @@ class TraceStore:
 
     def finish(self, request_id: int, state: str,
                output_tokens: Optional[int] = None,
-               error: Optional[str] = None
+               error: Optional[str] = None,
+               decode_steps: Optional[int] = None
                ) -> Optional[RequestTrace]:
         """Move a trace to a terminal state; idempotent per request."""
         assert state in TERMINAL_STATES, state
@@ -291,6 +312,8 @@ class TraceStore:
             trace.state = state
             if output_tokens is not None:
                 trace.output_tokens = output_tokens
+            if decode_steps is not None:
+                trace.decode_steps = decode_steps
             if error is not None:
                 trace.error = error
             self._completed.append(trace)

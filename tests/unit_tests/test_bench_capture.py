@@ -504,6 +504,15 @@ def _fake_decode_engines(bench, monkeypatch):
         def generate(self, prompts, sampling):
             return [[1] * sampling.max_new_tokens for _ in prompts]
 
+        def speculation_info(self):
+            # Monotonic step counter: run_decode diffs two calls to
+            # charge only the measured run's verify steps.
+            self._spec_calls = getattr(self, '_spec_calls', 0) + 1
+            return {'mode': 'draft', 'spec_k': 4,
+                    'steps': 10 * self._spec_calls,
+                    'proposed_tokens': 40, 'accepted_tokens': 38,
+                    'acceptance_rate': 0.95}
+
         def cache_read_bytes_per_step(self, context=None,
                                       row_contexts=None):
             # bf16: 2*576*2 bytes/pos; int8: 2*576 + 2*4 (scales).
@@ -544,7 +553,8 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     for key in ('metric', 'value', 'unit', 'vs_baseline'):
         assert key in parsed, key
     assert parsed['value'] == round(2304.0 / 1160.0, 2)  # 1.99
-    assert set(parsed['arms']) == {'bf16', 'int8', 'paged'}
+    assert set(parsed['arms']) == {'bf16', 'int8', 'paged',
+                                   'speculative'}
     assert parsed['arms']['int8']['kv_cache_dtype'] == 'int8'
     assert 'int8' in parsed['metric']
     # Ragged arm: contiguous reads 4 slots * the full 512 bucket;
@@ -554,12 +564,22 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     assert parsed['paged_read_reduction_vs_contiguous'] == \
         round(4 * 512 / 200, 2)  # 10.24
     assert parsed['paged_token_parity'] is True
-    # Five engines (incl. the disabled-registry overhead arm), all
-    # serving the SAME weights.
+    # Seven engines: the five DeepSeek-geometry arms (incl. the
+    # disabled-registry overhead arm) all serving the SAME weights,
+    # then the gpt2 speculation pair (its own weights — plain
+    # reference engine + speculating twin sharing them).
     assert [b.kv_cache_dtype for b in built] == \
-        ['auto', 'int8', 'auto', 'auto', 'auto']
-    assert [b.page_size for b in built] == [0, 0, 0, 8, 8]
-    assert all(b.params is built[0].params for b in built[1:])
+        ['auto', 'int8', 'auto', 'auto', 'auto', 'auto', 'auto']
+    assert [b.page_size for b in built] == [0, 0, 0, 8, 8, 0, 0]
+    assert all(b.params is built[0].params for b in built[1:5])
+    assert built[6].params is built[5].params
+    spec = parsed['arms']['speculative']
+    assert spec['spec_k'] == 4
+    assert spec['greedy_parity_vs_plain'] is True
+    assert parsed['spec_token_parity'] is True
+    # Fake steps diff = 10 over 4 slots x 32 tokens.
+    assert parsed['spec_steps_per_token'] == round(10 / 128, 3)
+    assert 'accepted_length_histogram' in spec
     # Telemetry snapshot rides the line; the fakes never touch the
     # registry, so the counters are zero but the keys must exist.
     tel = parsed['telemetry']
@@ -570,9 +590,11 @@ def test_decode_emits_one_json_line_and_stderr_summary(
                 'tokens_per_sec_paged_disabled_registry'):
         assert key in tel, key
     err = [l for l in captured.err.splitlines() if l.startswith('#')]
-    assert len(err) == 5  # dtype arms + ratio + paged + telemetry
+    # dtype arms + ratio + paged + speculative + telemetry
+    assert len(err) == 6
     assert 'fewer bytes/step' in err[-3]
-    assert 'token parity: True' in err[-2]
+    assert 'token parity: True' in err[-2]  # the speculative line
+    assert 'steps/token' in err[-2]
     assert 'telemetry' in err[-1]
 
 
@@ -591,12 +613,10 @@ def test_decode_smoke_paged_arm_flag(bench, monkeypatch, capsys):
     assert parsed['paged_token_parity'] is True
 
 
-def test_decode_smoke_paged_arm_end_to_end():
-    """The real thing, no fakes: `bench.py --decode --smoke` runs the
-    three-arm decode bench (tiny DeepSeek geometry) on CPU in under a
-    minute and must prove the tentpole's acceptance bar — >= 4x fewer
-    decode read-bytes paged-vs-contiguous on the ragged workload with
-    EXACT greedy token parity."""
+@pytest.fixture(scope='module')
+def decode_smoke_json():
+    """ONE real `bench.py --decode --smoke` subprocess (no fakes),
+    shared by the paged and speculative e2e assertions below."""
     import subprocess
     env = dict(os.environ, JAX_PLATFORMS='cpu')
     proc = subprocess.run(
@@ -605,7 +625,16 @@ def test_decode_smoke_paged_arm_end_to_end():
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [l for l in proc.stdout.splitlines() if l.strip()]
     assert len(lines) == 1, proc.stdout
-    parsed = json.loads(lines[0])
+    return json.loads(lines[0])
+
+
+def test_decode_smoke_paged_arm_end_to_end(decode_smoke_json):
+    """The real thing, no fakes: `bench.py --decode --smoke` runs the
+    decode bench (tiny DeepSeek geometry) on CPU in under a
+    minute and must prove the tentpole's acceptance bar — >= 4x fewer
+    decode read-bytes paged-vs-contiguous on the ragged workload with
+    EXACT greedy token parity."""
+    parsed = decode_smoke_json
     assert parsed['paged_token_parity'] is True
     assert parsed['paged_read_reduction_vs_contiguous'] >= 4.0
     arm = parsed['arms']['paged']
@@ -620,6 +649,32 @@ def test_decode_smoke_paged_arm_end_to_end():
     assert tel['mean_batch_occupancy'] > 0.0
     assert tel['prefix_page_misses'] > 0  # fresh prompts miss
     assert tel['tokens_per_sec_paged_disabled_registry'] > 0
+
+
+def test_decode_smoke_speculative_arm(decode_smoke_json):
+    """Speculation acceptance bar, proven on the real engines in the
+    same --smoke run: the gpt2 draft/target pair at spec-k=4 commits
+    tokens in fewer than half a target forward each, the speculative
+    stream is greedy-parity-exact against the plain engine, and the
+    accepted-length histogram rides the JSON line."""
+    parsed = decode_smoke_json
+    arm = parsed['arms']['speculative']
+    assert arm['spec_k'] == 4
+    # < 0.5 target steps/token: each verify forward must commit > 2
+    # tokens on average (same-weights draft => near-ideal 1/(k+1)).
+    assert parsed['spec_steps_per_token'] < 0.5, arm
+    assert arm['target_steps_per_token'] == \
+        parsed['spec_steps_per_token']
+    assert arm['acceptance_rate'] > 0.9, arm
+    assert parsed['spec_token_parity'] is True
+    assert arm['greedy_parity_vs_plain'] is True
+    hist = arm['accepted_length_histogram']
+    assert hist, arm
+    # Cumulative le-bucket counts: the +Inf bucket carries every
+    # observation, and multi-token commits mean it exceeds the le=1
+    # bucket (accepted lengths > 1 occurred).
+    assert hist['+Inf'] > 0
+    assert hist['+Inf'] > hist['1']
 
 
 def test_sleep_skip_when_spacing_would_burn_the_window(

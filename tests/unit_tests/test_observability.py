@@ -544,6 +544,7 @@ def test_every_registered_metric_name_matches_contract(paged_engine):
     which the skylint metric-contract rule enforces statically."""
     from skypilot_tpu import observability
     from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.infer import speculative as speculative_lib
     from skypilot_tpu.observability import events as events_lib
     from skypilot_tpu.serve import replica_supervisor
     from skypilot_tpu.serve import router as router_lib
@@ -553,19 +554,22 @@ def test_every_registered_metric_name_matches_contract(paged_engine):
     trainer_lib._train_metrics(reg)
     router_lib._router_metrics(reg)
     replica_supervisor._supervisor_metrics(reg)
+    speculative_lib.spec_metrics(reg)
     events_lib.EventRing(registry=reg)
     names = reg.names()
     assert len(names) >= 30
     for name in names:
         assert observability.METRIC_NAME_RE.fullmatch(name), name
         assert name in observability.METRIC_CONTRACT, name
-    # Unit suffixes are not just permitted, they are used correctly:
+    # Unit suffixes are not just permitted, they are used correctly
+    # (_tokens: count-valued histograms, e.g. accepted spec length):
     for name in names:
         m = reg.get(name)
         if isinstance(m, metrics_lib.Counter):
             assert name.endswith('_total'), name
         if isinstance(m, metrics_lib.Histogram):
-            assert name.endswith(('_seconds', '_bytes')), name
+            assert name.endswith(('_seconds', '_bytes', '_tokens')), \
+                name
 
 
 def test_per_step_publish_overhead_under_two_percent(paged_engine):

@@ -85,6 +85,10 @@ def test_host_sync_scan_body_and_decorator_and_scope(tmp_path):
     """
     findings = _live(_lint(tmp_path, 'ops/k.py', src, rule='host-sync'))
     assert {f.symbol for f in findings} == {'print', 'time.time()'}
+    # The speculative-decoding module hosts jitted kernels (acceptance,
+    # draft scan): host-sync discipline applies there too.
+    assert _live(_lint(tmp_path, 'infer/speculative.py', src,
+                       rule='host-sync'))
     # Same file outside the compute layers: rule does not apply.
     assert not _live(_lint(tmp_path, 'serve/k.py', src,
                            rule='host-sync'))
