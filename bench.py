@@ -383,9 +383,11 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
     continuous-batching engine plus the per-step KV-cache read-bytes
     estimate (infer/engine.py decode_cache_read_bytes, scale leaves
     included for the int8 arm, per-row allocated pages for the paged
-    arm).  `smoke` shrinks sequence lengths/steps so the whole thing
-    (including the paged arm's greedy-parity check) runs in tier-1 on
-    CPU.
+    arm).  Two more arms ride along: speculative decoding (gpt2
+    draft/target pair) and the sync-vs-async decode pipeline
+    comparison on the paged int8 spec-k=4 configuration.  `smoke`
+    shrinks sequence lengths/steps so the whole thing (including the
+    greedy-parity checks) runs in tier-1 on CPU.
 
     The config is DeepSeek-V2-Lite's *attention geometry* — 16 query
     heads scoring against a single absorbed [B, 1, S, 576] latent row
@@ -647,6 +649,106 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
         'accepted_length_histogram': sp_hist,
     }
 
+    # --- fifth arm: sync vs ASYNC decode pipeline --------------------
+    # The double-buffered loop hides the scheduler's host work
+    # (admission, prefill chunk dispatch, spec acceptance bookkeeping,
+    # token commits, telemetry) behind the in-flight device step, so
+    # the arm runs the heaviest host-side configuration: paged int8
+    # KV with self-drafting speculation, and 6x more prompts than
+    # slots (short streams) so admission churn rides the pipeline on
+    # nearly every tick.  Same weights,
+    # same prompts, greedy — the async stream must be bit-identical to
+    # the synchronous loop (asserted in-run, recorded on the JSON
+    # line).  The headline is the device-wait fraction: the share of
+    # wall time the scheduler spends blocked on step results, which
+    # the overlap must strictly shrink.  Measurement discipline,
+    # because the per-tick waits being compared are sub-millisecond:
+    # the geometry is wider than the speculation arm's (the step must
+    # outweigh thread-wakeup latency for the wait to be measurable),
+    # both engines are warmed AND settled before measuring (the first
+    # post-warmup run still pays lazy-compile tails), and the
+    # reported numbers are medians over interleaved sync/async
+    # windows so slow drifts in machine load hit both modes alike.
+    ap_overrides = dict(n_layers=4, dim=256, n_heads=4, ffn_dim=512,
+                        vocab_size=96, max_seq_len=128,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+    ap_new = 4 if smoke else 8
+    ap_windows, ap_reps = 3, 2
+    ap_prompts = [list(rng.integers(1, 96, 12))
+                  for _ in range(6 * n_slots)]
+    ap_sampling = engine_lib.SamplingConfig(max_new_tokens=ap_new,
+                                            temperature=0.0)
+
+    def _pipeline_engine(async_on, params=None):
+        eng = engine_lib.ContinuousBatchingEngine(
+            'gpt2-tiny', n_slots=n_slots, prefill_bucket=8,
+            model_overrides=dict(ap_overrides),
+            param_dtype=jnp.float32, params=params,
+            kv_cache_dtype='int8', page_size=8, spec_k=sp_k,
+            registry=metrics_lib.Registry(), async_pipeline=async_on)
+        eng.generate(ap_prompts, ap_sampling)      # compile warmup
+        eng.generate(ap_prompts, ap_sampling)      # settle
+        return eng
+
+    def _pipeline_window(eng, outs):
+        met = getattr(eng, '_met', None)
+        wait0 = met.device_wait_seconds.sum if met is not None else 0.0
+        over0 = met.host_overlap_seconds.sum if met is not None else 0.0
+        t0 = time.time()
+        for _ in range(ap_reps):
+            outs.append(eng.generate(ap_prompts, ap_sampling))
+        dt = max(time.time() - t0, 1e-9)
+        wait_s = (met.device_wait_seconds.sum - wait0) \
+            if met is not None else 0.0
+        over_s = (met.host_overlap_seconds.sum - over0) \
+            if met is not None else 0.0
+        return dt, wait_s / dt, over_s
+
+    def _median(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    ap_sync_eng = _pipeline_engine(False)
+    ap_async_eng = _pipeline_engine(True, params=ap_sync_eng.params)
+    ap_sync_outs, ap_async_outs = [], []
+    ap_sync_wins, ap_async_wins = [], []
+    for _ in range(ap_windows):
+        ap_sync_wins.append(
+            _pipeline_window(ap_sync_eng, ap_sync_outs))
+        ap_async_wins.append(
+            _pipeline_window(ap_async_eng, ap_async_outs))
+    for eng in (ap_sync_eng, ap_async_eng):
+        close = getattr(eng, 'close', None)
+        if close is not None:
+            close()
+    ap_parity = [[list(a) for a in rep] for rep in ap_async_outs] == \
+        [[list(a) for a in rep] for rep in ap_sync_outs]
+    assert ap_parity, \
+        'async pipeline broke greedy parity vs the synchronous loop'
+    ap_sync_frac = _median([w[1] for w in ap_sync_wins])
+    ap_async_frac = _median([w[1] for w in ap_async_wins])
+    ap_sync_dt = _median([w[0] for w in ap_sync_wins])
+    ap_async_dt = _median([w[0] for w in ap_async_wins])
+    # Tokens per measured window (parity already proved the per-rep
+    # streams identical across modes).
+    ap_tokens = sum(len(o) for rep in ap_sync_outs
+                    for o in rep) // ap_windows
+    async_arm = {
+        'page_size': 8,
+        'kv_cache_dtype': 'int8',
+        'spec_k': sp_k,
+        'n_prompts': len(ap_prompts),
+        'measured_windows': ap_windows,
+        'generates_per_window': ap_reps,
+        'tokens_per_sec_sync': round(ap_tokens / ap_sync_dt, 1),
+        'tokens_per_sec_async': round(ap_tokens / ap_async_dt, 1),
+        'speedup_async_vs_sync': round(ap_sync_dt / ap_async_dt, 3),
+        'device_wait_fraction_sync': round(ap_sync_frac, 6),
+        'device_wait_fraction_async': round(ap_async_frac, 6),
+        'host_overlap_seconds': round(
+            sum(w[2] for w in ap_async_wins), 4),
+        'greedy_parity_vs_sync': ap_parity,
+    }
+
     result = {
         'metric': 'decode int8-KV cache-read reduction (B=4 slots, '
                   'deepseek-v2-lite attention geometry)',
@@ -658,12 +760,16 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
                        f'{int8_arm["cache_read_bytes_per_step_grouped"] / 1e6:.2f}'
                        f' MB/step',
         'arms': {'bf16': bf16_arm, 'int8': int8_arm,
-                 'paged': paged_arm, 'speculative': spec_arm},
+                 'paged': paged_arm, 'speculative': spec_arm,
+                 'async': async_arm},
         'telemetry': telemetry,
         'paged_read_reduction_vs_contiguous': round(pg_ratio, 2),
         'paged_token_parity': pg_parity,
         'spec_steps_per_token': spec_arm['target_steps_per_token'],
         'spec_token_parity': sp_parity,
+        'async_token_parity': ap_parity,
+        'async_device_wait_fraction_sync': round(ap_sync_frac, 6),
+        'async_device_wait_fraction_async': round(ap_async_frac, 6),
         'n_heads': 16,
         'kv_heads_in_cache': 1,
         'device_kind': jax.devices()[0].device_kind,
@@ -694,6 +800,12 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
           f'steps/token (acceptance '
           f'{spec_arm["acceptance_rate"]:.2f}), greedy '
           f'token parity: {sp_parity}', file=sys.stderr)
+    print(f'# decode [async]: paged-int8 spec-k={sp_k} x '
+          f'{len(ap_prompts)} prompts; device-wait fraction '
+          f'{ap_sync_frac:.3f} (sync) -> {ap_async_frac:.3f} (async), '
+          f'{async_arm["tokens_per_sec_sync"]:,.0f} -> '
+          f'{async_arm["tokens_per_sec_async"]:,.0f} tok/s, greedy '
+          f'token parity: {ap_parity}', file=sys.stderr)
     print(f'# telemetry: prefix hit ratio '
           f'{telemetry["prefix_hit_ratio"]:.2f} '
           f'({telemetry["prefix_page_hits"]:.0f} hits / '

@@ -9,6 +9,7 @@ from skypilot_tpu.devtools.rules import host_sync
 from skypilot_tpu.devtools.rules import lock_discipline
 from skypilot_tpu.devtools.rules import metric_contract
 from skypilot_tpu.devtools.rules import net_timeout
+from skypilot_tpu.devtools.rules import pipeline_discipline
 from skypilot_tpu.devtools.rules import retrace
 from skypilot_tpu.devtools.rules import sleep_discipline
 from skypilot_tpu.devtools.rules import stdout_purity
@@ -17,6 +18,7 @@ from skypilot_tpu.devtools.rules import trace_discipline
 ALL_RULES = (host_sync.RULES + retrace.RULES + lock_discipline.RULES
              + stdout_purity.RULES + metric_contract.RULES
              + dtype_promotion.RULES + sleep_discipline.RULES
-             + net_timeout.RULES + trace_discipline.RULES)
+             + net_timeout.RULES + trace_discipline.RULES
+             + pipeline_discipline.RULES)
 
 __all__ = ['ALL_RULES']

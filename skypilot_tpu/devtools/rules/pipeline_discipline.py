@@ -1,0 +1,139 @@
+"""pipeline-discipline: no host syncs on in-flight step futures from
+the dispatch side of the engine's decode pipeline.
+
+The async decode pipeline's contract is that the DISPATCH side only
+enqueues device work and hands the resulting futures (``*_dev``
+arrays, ``handle.arrays``) to the fetch thread; the single place they
+may be synchronized is the consume side (``_fetch_handle`` /
+``_consume_step`` / the pipeline worker / the join).  A
+``jax.device_get``, ``.block_until_ready()``, ``np.asarray``,
+``.item()`` or ``float()/int()`` on a step future anywhere else
+silently re-serializes the loop — the step still *works*, it just
+stops overlapping, which is exactly the regression a lint rule
+catches better than a benchmark.
+
+Call-site-aware like host-sync: only classes that actually define the
+pipeline split (a ``_dispatch*`` and a ``_consume*`` method) are
+checked, and only their non-consume-side methods are flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from skypilot_tpu.devtools import skylint
+from skypilot_tpu.devtools.rules import _jit
+
+RULE_ID = 'pipeline-discipline'
+
+# Methods allowed to synchronize in-flight step futures: the consume
+# side of the pipeline.  Name-based on purpose — a new consume-side
+# method must say so in its name (or carry a disable pragma with a
+# reason), keeping the split grep-visible.
+_CONSUME_MARKERS = ('consume', 'fetch', 'join', 'worker')
+
+_SYNC_ATTRS = {'item', 'block_until_ready'}
+_ASARRAY_FNS = {'np.asarray', 'numpy.asarray', 'np.array',
+                'numpy.array'}
+_DEVICE_GET_FNS = {'jax.device_get'}
+
+
+def in_scope(posix: str) -> bool:
+    return (posix.endswith('infer/engine.py')
+            or posix.endswith('infer/speculative.py'))
+
+
+def _is_pipeline_class(cls: ast.ClassDef) -> bool:
+    has_dispatch = has_consume = False
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name.startswith('_dispatch'):
+                has_dispatch = True
+            if node.name.startswith('_consume'):
+                has_consume = True
+    return has_dispatch and has_consume
+
+
+def _is_consume_side(name: str) -> bool:
+    return any(m in name for m in _CONSUME_MARKERS)
+
+
+def _future_expr(node: ast.AST) -> Optional[str]:
+    """The source-ish name when ``node`` denotes an in-flight step
+    future: a ``*_dev`` variable/attribute, or a handle's ``arrays``
+    tuple."""
+    if isinstance(node, ast.Name) and node.id.endswith('_dev'):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        if node.attr.endswith('_dev'):
+            return node.attr
+        if node.attr == 'arrays':
+            return f'{_jit._dotted(node) or "handle.arrays"}'
+    return None
+
+
+def _flag(node: ast.Call) -> Optional[tuple]:
+    """(symbol, future, reason) when ``node`` synchronizes a step
+    future with the host, else None."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id in ('float', 'int') and node.args:
+            fut = _future_expr(node.args[0])
+            if fut is not None:
+                return (f'{func.id}()', fut,
+                        f'{func.id}() blocks on the in-flight step')
+        return None
+    if isinstance(func, ast.Attribute):
+        if func.attr in _SYNC_ATTRS:
+            fut = _future_expr(func.value)
+            if fut is not None:
+                return (f'.{func.attr}()', fut,
+                        f'.{func.attr}() synchronizes the in-flight '
+                        f'step on the dispatch side')
+            return None
+        dotted = _jit._dotted(func)
+        if dotted in _DEVICE_GET_FNS or dotted in _ASARRAY_FNS:
+            for arg in node.args:
+                fut = _future_expr(arg)
+                if fut is not None:
+                    return (dotted, fut,
+                            f'{dotted} materializes the in-flight '
+                            f'step on the dispatch side')
+    return None
+
+
+def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef) \
+                or not _is_pipeline_class(cls):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if _is_consume_side(fn.name):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = _flag(node)
+                if hit is None:
+                    continue
+                symbol, fut, reason = hit
+                findings.append(ctx.finding(
+                    RULE_ID, node, symbol,
+                    f'{symbol} on step future {fut!r} in dispatch-'
+                    f'side method {cls.name}.{fn.name}: {reason}; '
+                    f'only the consume side (_consume*/_fetch*/'
+                    f'join/worker) may synchronize it'))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='no host syncs (device_get/.item/np.asarray/float/'
+            'block_until_ready) on in-flight step futures outside '
+            'the pipeline consume side',
+    check=check,
+    scope=in_scope),)

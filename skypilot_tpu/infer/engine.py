@@ -29,8 +29,10 @@ import contextlib
 import dataclasses
 import functools
 import os
+import queue as queue_lib
+import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -541,6 +543,50 @@ class _PendingPrefill:
     shared_len: int = 0       # prefix positions already in the pool
 
 
+class _InflightStep:
+    """One dispatched-but-not-yet-consumed decode step.
+
+    The dispatch side fills every field except `host`/`error`/
+    `t_fetched` and hands the handle to the pipeline fetch thread,
+    which ONLY calls device_get on `arrays` (never touches engine
+    state) and signals `done`.  The consume side — always the
+    scheduler thread — reads `host` and runs all commits.  `rids`
+    snapshots each occupied slot's request id at dispatch time so a
+    commit after an intervening evict/abort can be skipped instead of
+    landing on a recycled slot."""
+
+    __slots__ = ('mode', 'arrays', 'host', 'occupied', 'rids',
+                 'read_bytes', 'compiled', 'decode_key', 'spec_n_prop',
+                 'spec_proposed', 't_enter', 't_dispatched',
+                 't_fetched', 'error', 'done')
+
+    def __init__(self, mode: str, arrays: Tuple[Any, ...],
+                 occupied: List[int], rids: List[int],
+                 read_bytes: float, compiled: bool,
+                 decode_key: Any, t_enter: float, t_dispatched: float,
+                 spec_n_prop: Any = None, spec_proposed: int = 0):
+        self.mode = mode                  # 'plain' | 'spec'
+        self.arrays = arrays              # device futures to fetch
+        self.host: Optional[Tuple[Any, ...]] = None
+        self.occupied = occupied
+        self.rids = rids
+        self.read_bytes = read_bytes
+        self.compiled = compiled
+        self.decode_key = decode_key
+        self.spec_n_prop = spec_n_prop    # np [B] int32 (spec mode)
+        self.spec_proposed = spec_proposed
+        self.t_enter = t_enter
+        self.t_dispatched = t_dispatched
+        self.t_fetched: Optional[float] = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+
+# Wake token for the pipeline fetch thread's blocking queue.get() —
+# close() enqueues it so shutdown never waits out a poll interval.
+_PIPE_STOP = object()
+
+
 class _ServingMetrics:
     """Get-or-create handles for every serving metric.
 
@@ -657,8 +703,19 @@ class _ServingMetrics:
             '(async dispatch; the device_get wait is separate).')
         self.device_wait_seconds = r.histogram(
             'skytpu_step_device_wait_seconds',
-            'Host wall seconds blocked on device_get for the sampled '
-            'tokens (device execution + transfer).')
+            'Host wall seconds the scheduler thread spent blocked on '
+            'the step\'s sampled tokens (sync: the device_get wall; '
+            'async: the pipeline-join wait after host work overlapped).')
+        self.host_overlap_seconds = r.histogram(
+            'skytpu_step_host_overlap_seconds',
+            'Host scheduling/commit wall seconds hidden behind an '
+            'in-flight device step by the async pipeline (0 series on '
+            'a synchronous engine).')
+        self.pipeline_depth = r.gauge(
+            'skytpu_pipeline_depth',
+            'Decode steps dispatched but not yet consumed (0 = idle '
+            'or synchronous loop; the async pipeline is depth-1 '
+            'double buffering).')
         self.pages_used_peak = r.gauge(
             'skytpu_kv_pages_used_peak',
             'High-watermark of KV pages in use since engine start '
@@ -792,9 +849,9 @@ class ContinuousBatchingEngine:
                  draft_model: Optional[str] = None,
                  draft_checkpoint_dir: Optional[str] = None,
                  draft_overrides: Optional[Dict[str, Any]] = None,
-                 spec_k: int = 0) -> None:
+                 spec_k: int = 0,
+                 async_pipeline: bool = True) -> None:
         import collections
-        import threading
 
         if draft_model is not None and spec_k <= 0:
             raise ValueError('draft_model requires spec_k > 0')
@@ -1077,6 +1134,24 @@ class ContinuousBatchingEngine:
         # scheduler thread writes it.
         self._service_ewma_s: Optional[float] = None
 
+        # -- async decode pipeline (double-buffered stepping) ---------
+        # When on, each tick dispatches step N+1 while a fetch thread
+        # drains step N's tokens, so host scheduling/commit work hides
+        # behind device execution.  Depth is exactly 1: `_inflight`
+        # holds the single outstanding handle.  The fetch thread is
+        # lazily started on first dispatch and ONLY ever touches the
+        # handle it is given — all slot/cache/allocator mutation stays
+        # on the scheduler thread.
+        self.async_pipeline = bool(async_pipeline)
+        self._inflight: Optional[_InflightStep] = None
+        self._pipe_queue: Optional[queue_lib.Queue] = None
+        self._pipe_thread: Optional[threading.Thread] = None
+        self._pipe_stop: Optional[threading.Event] = None
+        self._pipe_steps_overlapped = 0
+        # Test seam: seconds the fetch thread sleeps before device_get
+        # (a deliberately slowed consumer for TPOT-attribution tests).
+        self._pipeline_delay_s = 0.0
+
         # -- telemetry (host-side only; see _publish_step_metrics) ----
         self.registry = (registry if registry is not None
                          else metrics_lib.get_registry())
@@ -1297,6 +1372,7 @@ class ContinuousBatchingEngine:
             self._queue.clear()
             events = list(self._events.values())
             queues = list(self._stream_queues.values())
+        self._pipeline_abandon()
         self._drop_inflight()
         for e in events:
             e.set()
@@ -1341,7 +1417,15 @@ class ContinuousBatchingEngine:
         reset (its prefix registrations describe cache contents that
         no longer exist).  The allocator must verify leak-free after
         the drop; a failure raises PageLeakError, which classifies
-        fatal."""
+        fatal.
+
+        Pipeline fencing: a step still in flight when the fault hit
+        (e.g. the fault was drawn at the top of the NEXT tick) is
+        abandoned un-consumed — its device outputs descend from the
+        same possibly-invalidated donated buffers being rebuilt here,
+        and its slots are among the victims below, so dropping it is
+        both safe and required."""
+        self._pipeline_abandon()
         victims = self._drop_inflight()
         with self._submit_lock:
             # Every canceled rid was in-engine and was just dropped.
@@ -1513,7 +1597,8 @@ class ContinuousBatchingEngine:
             self._met.prompt_tokens.inc(true_len)
             if self.prefill_chunk > 0:
                 # Reserve the slot; one chunk runs per tick from
-                # _step_inner so live slots keep decoding in between.
+                # _schedule_front so live slots keep decoding in
+                # between.
                 self._prefills.append(pending)
                 return True
             while pending.done < pending.pad:
@@ -1736,17 +1821,29 @@ class ContinuousBatchingEngine:
     def step(self) -> bool:
         """One scheduler tick: admit pending prompts into free slots,
         then one decode step for all occupied slots.  Returns False
-        when fully idle (nothing queued, nothing occupied)."""
+        when fully idle (nothing queued, nothing occupied, nothing in
+        flight).
+
+        With `async_pipeline` (the default) the tick is double-
+        buffered: the host front (admission, prefill chunks) runs
+        while the previously dispatched step executes on device, then
+        that step is joined/consumed and the next one dispatched —
+        see _step_async for the ordering and the parity argument."""
         # Chaos fault points (no-ops unless SKYTPU_CHAOS is live):
         # a raise here is the transient step-failure class the
         # supervisor recovers from; a hang is the wedged-device class
-        # the watchdog detects.
+        # the watchdog detects.  The pipeline fetch thread draws the
+        # same points against the in-flight step (see
+        # _pipeline_worker), so faults armed after a dispatch surface
+        # on consume.
         chaos.maybe_raise('step_raise')
         chaos.maybe_hang('step_hang')
         ctx = self.mesh if self.mesh is not None \
             else contextlib.nullcontext()
         with ctx:
-            return self._step_inner()
+            if self.async_pipeline:
+                return self._step_async()
+            return self._step_sync()
 
     def _evict_canceled(self) -> None:
         with self._submit_lock:
@@ -1780,9 +1877,16 @@ class ContinuousBatchingEngine:
         with self._submit_lock:
             self._canceled -= snapshot
 
-    def _step_inner(self) -> bool:
-        from skypilot_tpu.models import llama
-
+    def _schedule_front(self) -> None:
+        """The host front of one tick: cancellation eviction, queue
+        admission into free slots, and one prefill chunk per pending
+        prompt.  Pure host scheduling plus prefill dispatches on
+        PRIVATE batch-1 caches — in async mode this whole half runs
+        while the previously dispatched decode step executes on
+        device (insert/hydrate calls that touch the shared cache are
+        functionally sequenced after the in-flight step through its
+        future chain, so device-order correctness never depends on
+        the join)."""
         self._evict_canceled()
         # top_k/top_p ride the decode jit as per-row vectors, so
         # admission is unconditional FIFO — greedy, top-k and top-p
@@ -1886,18 +1990,226 @@ class ContinuousBatchingEngine:
                 still_pending.append(pending)
         self._prefills = still_pending
 
+    def _idle_gauges(self) -> None:
+        """Keep the scheduler gauges honest while idle/prefilling."""
+        self._met.live_slots.set(0)
+        self._met.occupancy.set(0.0)
+        self._met.queue_depth.set(len(self._queue))
+        self._met.inflight.set(self.traces.inflight_count)
+
+    def _step_sync(self) -> bool:
+        """The synchronous tick: front, dispatch, fetch, consume —
+        all inline on the scheduler thread.  This is the bit-exact
+        reference stream the async pipeline is judged against."""
+        self._schedule_front()
         occupied = [i for i, s in enumerate(self._slots)
                     if s is not None]
         if not occupied:
-            # Keep the scheduler gauges honest while idle/prefilling.
-            self._met.live_slots.set(0)
-            self._met.occupancy.set(0.0)
-            self._met.queue_depth.set(len(self._queue))
-            self._met.inflight.set(self.traces.inflight_count)
+            self._idle_gauges()
             return bool(self._prefills) or bool(self._queue)
+        handle = (self._dispatch_spec(occupied) if self.spec_k
+                  else self._dispatch_plain(occupied))
+        self._fetch_handle(handle)
+        if handle.error is not None:
+            raise handle.error
+        self._consume_step(
+            handle,
+            device_wait_s=handle.t_fetched - handle.t_dispatched)
+        return True
 
-        if self.spec_k:
-            return self._spec_step(occupied)
+    def _step_async(self) -> bool:
+        """One double-buffered tick.  Ordering:
+
+          1. front      — admission + prefill chunks (overlaps the
+                          in-flight step N on device);
+          2. join N     — wait for N's fetched tokens, then run every
+                          commit/trace/metric on THIS thread;
+          3. dispatch N+1 — build the step vectors from the
+                          just-committed slot state and enqueue the
+                          jitted step; hand the handle to the fetch
+                          thread and return.
+
+        Parity argument: commits always land before the next step's
+        input vectors are built, so each dispatched step sees exactly
+        the per-row state the synchronous loop would have given it.
+        Admission observes slot completions one tick later than sync
+        (they surface at the join), which can shift batch
+        composition, but greedy decode is row-independent under the
+        kv-mask so per-request token streams stay bit-identical —
+        the tier-1 parity suite enforces this across cache modes and
+        speculation modes.  Speculative rollback needs no extra care:
+        rejection of a speculated window is pure kv_mask bookkeeping
+        inside the verify step itself, so the one-step lookahead is
+        squashed on device, never copied or replayed on host."""
+        self._schedule_front()
+        consumed = self._pipeline_join()
+        if self._fatal is not None:
+            return False
+        occupied = [i for i, s in enumerate(self._slots)
+                    if s is not None]
+        if not occupied:
+            self._idle_gauges()
+            # A tick that consumed the final in-flight step did real
+            # work (commits, completions): report busy so callers
+            # observe the synchronous contract — False only from a
+            # tick that did nothing at all.
+            return consumed or bool(self._prefills) or bool(self._queue)
+        handle = (self._dispatch_spec(occupied) if self.spec_k
+                  else self._dispatch_plain(occupied))
+        self._pipeline_put(handle)
+        return True
+
+    # -- pipeline plumbing (fetch thread, join, fencing) ------------------
+
+    def _fetch_handle(self, handle: _InflightStep) -> None:
+        """Blocking device->host fetch of one handle's arrays — the
+        only place in-flight step futures are synchronized.  Never
+        raises: errors park on the handle for the consume side to
+        re-raise on the scheduler thread."""
+        try:
+            handle.host = tuple(np.asarray(jax.device_get(a))
+                                for a in handle.arrays)
+        except BaseException as e:  # noqa: B036 — must not kill the thread
+            handle.error = e
+        finally:
+            handle.t_fetched = time.perf_counter()
+            handle.done.set()
+
+    def _pipeline_worker(self) -> None:
+        """Fetch-thread loop (prefetch_to_device idiom, train/data.py):
+        take a handle, draw the step chaos points against it (so a
+        fault armed while the step was in flight surfaces on consume),
+        fetch, signal done.  Touches ONLY the handle — all engine
+        state stays with the scheduler thread."""
+        q = self._pipe_queue
+        stop = self._pipe_stop
+        while True:
+            handle = q.get()
+            if handle is _PIPE_STOP:
+                break
+            if stop.is_set():
+                # Drain path: close() raced a queued handle.  Unpark
+                # any joiner; nobody consumes the result.
+                handle.error = RuntimeError(
+                    'pipeline closed with a step in flight')
+                handle.t_fetched = time.perf_counter()
+                handle.done.set()
+                continue
+            try:
+                if self._pipeline_delay_s:
+                    # Test seam (slowed consumer).  Sleeps BEFORE the
+                    # chaos draws so a test can arm a fault against a
+                    # step that is already in flight.
+                    time.sleep(self._pipeline_delay_s)
+                chaos.maybe_raise('step_raise')
+                chaos.maybe_hang('step_hang')
+            except BaseException as e:  # noqa: B036 — park on handle
+                handle.error = e
+                handle.t_fetched = time.perf_counter()
+                handle.done.set()
+                continue
+            self._fetch_handle(handle)
+
+    def _pipeline_put(self, handle: _InflightStep) -> None:
+        """Record `handle` as the (single) in-flight step and hand it
+        to the fetch thread, starting the thread lazily on first
+        use."""
+        if self._pipe_thread is None or not self._pipe_thread.is_alive():
+            self._pipe_queue = queue_lib.Queue()
+            self._pipe_stop = threading.Event()
+            self._pipe_thread = threading.Thread(
+                target=self._pipeline_worker,
+                name='skytpu-pipeline-fetch', daemon=True)
+            self._pipe_thread.start()
+        self._inflight = handle
+        self._met.pipeline_depth.set(1)
+        self._pipe_queue.put(handle)
+
+    def _pipeline_join(self) -> bool:
+        """Consume the in-flight step: wait for its fetch, measure the
+        scheduler stall (async device-wait) and the host time hidden
+        behind the step (overlap), then run all commits here on the
+        scheduler thread.  Token commit timestamps — first_token
+        trace events, TPOT, SLO verdicts — are therefore stamped at
+        CONSUME time, never dispatch time: a slow consumer shows up
+        in TPOT instead of being flattered away.  A fetch-side error
+        re-raises here so transient/fatal classification and
+        recover() flow exactly as in the synchronous loop.  Returns
+        True when a step was consumed (the tick did real work)."""
+        handle = self._inflight
+        if handle is None:
+            return False
+        self._inflight = None
+        t_join = time.perf_counter()
+        while not handle.done.wait(0.5):
+            # The fetch thread always signals: chaos hangs are
+            # released by the watchdog/shutdown via release_hangs().
+            pass
+        waited = time.perf_counter() - t_join
+        self._met.pipeline_depth.set(0)
+        if self._fatal is not None:
+            return False    # aborted while in flight: results are void
+        if handle.error is not None:
+            raise handle.error
+        t_fetched = (handle.t_fetched if handle.t_fetched is not None
+                     else t_join)
+        overlap = max(0.0, min(t_join, t_fetched) - handle.t_dispatched)
+        if overlap > 0.0:
+            self._pipe_steps_overlapped += 1
+        self._consume_step(handle, device_wait_s=waited,
+                           overlap_s=overlap)
+        return True
+
+    def _pipeline_abandon(self) -> None:
+        """Forget the in-flight step without consuming it.  Does NOT
+        block: the fetch thread finishes with the handle's (possibly
+        donation-invalidated) device arrays on its own schedule and
+        nobody reads the result — stale commits are impossible
+        because consumption only ever happens via _pipeline_join.
+        recover()/abort() call this before rebuilding or abandoning
+        device state."""
+        if self._inflight is not None:
+            self._inflight = None
+            self._met.pipeline_depth.set(0)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Join the pipeline fetch thread (idempotent; a no-op on a
+        synchronous or never-stepped engine).  Shutdown/drain fencing:
+        after close() returns no step is in flight and — barring a
+        wedged device_get, which is logged — no pipeline thread is
+        alive."""
+        self._pipeline_abandon()
+        t = self._pipe_thread
+        if t is None:
+            return
+        self._pipe_stop.set()
+        self._pipe_queue.put(_PIPE_STOP)
+        t.join(timeout)
+        if t.is_alive():
+            logger.warning(
+                f'pipeline fetch thread still alive after {timeout}s '
+                f'join (wedged device_get?)')
+        else:
+            self._pipe_thread = None
+
+    def pipeline_info(self) -> Dict[str, Any]:
+        """Pipeline block for /health?verbose=1: mode, current depth,
+        fetch-thread liveness, and how many consumed steps actually
+        hid host work behind the device.  Advisory racy reads — the
+        scheduler thread owns the state."""
+        t = self._pipe_thread
+        return dict(
+            mode='async' if self.async_pipeline else 'sync',
+            depth=0 if self._inflight is None else 1,
+            max_depth=1 if self.async_pipeline else 0,
+            worker_alive=bool(t is not None and t.is_alive()),
+            steps_overlapped=self._pipe_steps_overlapped,
+        )
+
+    # -- dispatch / consume halves of one decode step ---------------------
+
+    def _dispatch_plain(self, occupied: List[int]) -> _InflightStep:
+        from skypilot_tpu.models import llama
 
         b = self.n_slots
         cursors = np.zeros((b,), np.int32)
@@ -1950,8 +2262,6 @@ class ContinuousBatchingEngine:
                     max_k=max_k, use_top_p=use_top_p,
                     top_p_in_topk=top_p_in_topk, kv_bucket=bucket)
         t_dispatched = time.perf_counter()
-        toks = np.asarray(jax.device_get(tok_dev))
-        t_fetched = time.perf_counter()
         if compiled:
             self._decode_keys_seen.add(decode_key)
         # Read-traffic estimate for THIS step, from the cursors already
@@ -1964,23 +2274,22 @@ class ContinuousBatchingEngine:
                 -(-(int(cursors[i]) + 1) // ps) for i in occupied)
         else:
             read_bytes = self._read_bytes_per_pos * bucket
-        for i in occupied:
-            self._slots[i].steps += 1
-            self._commit_token(i, int(toks[i]))
-        self._publish_step_metrics(
-            len(occupied), read_bytes,
-            dispatch_s=t_dispatched - t_enter,
-            device_wait_s=t_fetched - t_dispatched,
-            compiled=compiled)
-        return True
+        return _InflightStep(
+            'plain', (tok_dev,), list(occupied),
+            [self._slots[i].request_id for i in occupied],
+            read_bytes, compiled, decode_key, t_enter, t_dispatched)
 
-    def _spec_step(self, occupied: List[int]) -> bool:
-        """One speculative tick for all occupied slots: propose k
-        tokens per row (draft model, or n-gram self-drafting when no
-        draft is configured), verify the pending token + proposals in
-        a single s=k+1 target forward, commit the accepted prefix plus
-        one sampled token per row.  Every slot here already holds its
-        pending token (_spec_seed_slot emitted it at prefill end)."""
+    def _dispatch_spec(self, occupied: List[int]) -> _InflightStep:
+        """Dispatch half of one speculative step for all occupied
+        slots: propose k tokens per row (draft model, or n-gram
+        self-drafting when no draft is configured) and enqueue the
+        single s=k+1 verify forward.  The accepted prefix plus one
+        sampled token per row commit on consume (_consume_step).
+        Every slot here already holds its pending token
+        (_spec_seed_slot emitted it at prefill end); rejection of a
+        speculated window is squashed inside the verify step's
+        kv_mask arithmetic, so the lookahead needs no host-side
+        rollback or copies."""
         from skypilot_tpu.infer import speculative as spec_lib
         from skypilot_tpu.models import llama
 
@@ -2062,9 +2371,6 @@ class ContinuousBatchingEngine:
             self._draft.commit(jnp.asarray(cursors), counts_dev,
                                jnp.asarray(active))
         t_dispatched = time.perf_counter()
-        toks = np.asarray(jax.device_get(out_dev))
-        counts = np.asarray(jax.device_get(counts_dev))
-        t_fetched = time.perf_counter()
         if compiled:
             self._spec_keys_seen.add(decode_key)
         if self.page_size:
@@ -2073,37 +2379,70 @@ class ContinuousBatchingEngine:
                 -(-(int(cursors[i]) + k + 1) // ps) for i in occupied)
         else:
             read_bytes = self._read_bytes_per_pos * bucket
-        committed = 0
-        accepted = 0
-        for i in occupied:
-            n = int(counts[i])
-            self._spec_met['accepted_len'].observe(n)
-            accepted += n - 1
-            self._slots[i].steps += 1
-            for j in range(n):
-                committed += 1
-                if self._commit_token(i, int(toks[i, j])):
-                    break       # eos/budget: drop the tail
-        proposed = int(n_prop[occupied].sum())
-        self._spec_met['steps'].inc()
-        self._spec_met['proposed'].inc(proposed)
-        self._spec_met['accepted'].inc(accepted)
-        self._spec_steps_n += 1
-        self._spec_proposed_n += proposed
-        self._spec_accepted_n += accepted
+        return _InflightStep(
+            'spec', (out_dev, counts_dev), list(occupied),
+            [self._slots[i].request_id for i in occupied],
+            read_bytes, compiled, decode_key, t_enter, t_dispatched,
+            spec_n_prop=n_prop,
+            spec_proposed=int(n_prop[occupied].sum()))
+
+    def _consume_step(self, handle: _InflightStep,
+                      device_wait_s: Optional[float] = None,
+                      overlap_s: Optional[float] = None) -> None:
+        """Consume half of one decode step: commit the fetched tokens
+        into slot state and publish the step telemetry.  Always runs
+        on the scheduler thread (inline in sync mode, at the join in
+        async mode), so every commit timestamp is a consume-time
+        stamp.  A slot whose request id changed since dispatch
+        (evicted, aborted, recycled) is skipped — the guard that
+        makes abort/cancel between dispatch and consume safe."""
+        if handle.mode == 'plain':
+            toks = handle.host[0]
+            n_tokens = None
+            for i, rid in zip(handle.occupied, handle.rids):
+                s = self._slots[i]
+                if s is None or s.request_id != rid:
+                    continue
+                s.steps += 1
+                self._commit_token(i, int(toks[i]))
+        else:
+            toks, counts = handle.host
+            committed = 0
+            accepted = 0
+            for i, rid in zip(handle.occupied, handle.rids):
+                n = int(counts[i])
+                self._spec_met['accepted_len'].observe(n)
+                accepted += n - 1
+                s = self._slots[i]
+                if s is None or s.request_id != rid:
+                    continue
+                s.steps += 1
+                for j in range(n):
+                    committed += 1
+                    if self._commit_token(i, int(toks[i, j])):
+                        break       # eos/budget: drop the tail
+            self._spec_met['steps'].inc()
+            self._spec_met['proposed'].inc(handle.spec_proposed)
+            self._spec_met['accepted'].inc(accepted)
+            self._spec_steps_n += 1
+            self._spec_proposed_n += handle.spec_proposed
+            self._spec_accepted_n += accepted
+            n_tokens = committed
         self._publish_step_metrics(
-            len(occupied), read_bytes,
-            dispatch_s=t_dispatched - t_enter,
-            device_wait_s=t_fetched - t_dispatched,
-            compiled=compiled, n_tokens=committed)
-        return True
+            len(handle.occupied), handle.read_bytes,
+            dispatch_s=handle.t_dispatched - handle.t_enter,
+            device_wait_s=device_wait_s,
+            compiled=handle.compiled, n_tokens=n_tokens,
+            host_overlap_s=overlap_s)
 
     def _publish_step_metrics(self, n_occupied: int,
                               read_bytes: float,
                               dispatch_s: Optional[float] = None,
                               device_wait_s: Optional[float] = None,
                               compiled: bool = False,
-                              n_tokens: Optional[int] = None) -> None:
+                              n_tokens: Optional[int] = None,
+                              host_overlap_s: Optional[float] = None
+                              ) -> None:
         """Per-step telemetry: gauges + counters from host-side state
         already in hand.  This is the entire per-step telemetry cost —
         the overhead guard test times it directly against a measured
@@ -2113,7 +2452,10 @@ class ContinuousBatchingEngine:
         on a first-sight static key (`compiled=True`) that includes
         trace+compile and is booked as a compile, otherwise it is the
         async-dispatch cost ROADMAP item 3 will be judged against.
-        `device_wait_s` is the host block on device_get.
+        `device_wait_s` is the scheduler thread's block on the step's
+        results (device_get inline in sync mode, the pipeline join in
+        async mode); `host_overlap_s` is the host work the async
+        pipeline hid behind the in-flight step.
 
         `n_tokens` is the number of tokens the step actually emitted;
         it defaults to one per occupied slot (plain decode), and the
@@ -2138,6 +2480,8 @@ class ContinuousBatchingEngine:
                 m.dispatch_seconds.observe(dispatch_s)
         if device_wait_s is not None:
             m.device_wait_seconds.observe(device_wait_s)
+        if host_overlap_s is not None:
+            m.host_overlap_seconds.observe(host_overlap_s)
         if self._alloc is not None:
             free = self._alloc.free_pages
             m.free_pages.set(free)

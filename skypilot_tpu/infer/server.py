@@ -185,6 +185,7 @@ class InferenceServer:
                  draft_checkpoint_dir: Optional[str] = None,
                  draft_overrides=None,
                  spec_k: int = 0,
+                 async_pipeline: bool = True,
                  ) -> None:
         from skypilot_tpu.parallel import mesh as mesh_lib
         # Hang-proof first backend touch: a wedged tunneled TPU makes
@@ -219,7 +220,8 @@ class InferenceServer:
                 page_size=page_size, max_pages=max_pages,
                 registry=registry, draft_model=draft_model,
                 draft_checkpoint_dir=draft_checkpoint_dir,
-                draft_overrides=draft_overrides, spec_k=spec_k)
+                draft_overrides=draft_overrides, spec_k=spec_k,
+                async_pipeline=async_pipeline)
         else:
             if page_size:
                 raise ValueError(
@@ -338,6 +340,11 @@ class InferenceServer:
             # whose speculation stopped paying for itself is visible
             # without a metrics scrape.
             detail['speculation'] = spec
+        pipe = getattr(eng, 'pipeline_info', None)
+        if pipe is not None:
+            # Async decode pipeline state: mode, in-flight depth,
+            # fetch-thread liveness, overlapped-step count.
+            detail['pipeline'] = pipe()
         return detail
 
     def _fail_replica(self, error: BaseException) -> None:
@@ -1007,6 +1014,12 @@ class InferenceServer:
         if self._watchdog_thread is not None:
             self._watchdog_thread.join(timeout=self.shutdown_join_s)
             self._watchdog_thread = None
+        # Fence the engine's async pipeline: after the decode loop is
+        # down nothing will consume an in-flight step, so join the
+        # fetch thread too (no-op for sync/request-level engines).
+        close = getattr(getattr(self, 'engine', None), 'close', None)
+        if close is not None:
+            close(timeout=self.shutdown_join_s)
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -1118,6 +1131,20 @@ def main() -> None:
                              'n-gram prompt-lookup self-drafting: '
                              'zero extra weights, wins on repetitive '
                              '/ shared-prefix traffic.')
+    parser.add_argument('--async-pipeline', dest='async_pipeline',
+                        action='store_true', default=True,
+                        help='Double-buffered decode stepping: '
+                             'dispatch step N+1 while step N\'s '
+                             'tokens are fetched/committed, hiding '
+                             'host scheduling behind device '
+                             'execution. Greedy output stays '
+                             'bit-identical to the synchronous loop. '
+                             'Default on.')
+    parser.add_argument('--no-async-pipeline', dest='async_pipeline',
+                        action='store_false',
+                        help='Escape hatch: run the fully '
+                             'synchronous decode loop (dispatch, '
+                             'fetch, commit inline each tick).')
     parser.add_argument('--kv-read-bucket', type=int, default=512,
                         help='Decode attention reads only the live '
                              'cache prefix, rounded up to this bucket '
@@ -1162,6 +1189,7 @@ def main() -> None:
                     draft_checkpoint_dir=args.draft_checkpoint_dir,
                     draft_overrides=draft_overrides,
                     spec_k=args.spec_k,
+                    async_pipeline=args.async_pipeline,
                     ).serve_forever()
 
 

@@ -33,6 +33,20 @@ cache positions that acceptance simply does not reveal, so the next
 verify overwrites them in place (the paged cache's block tables are
 untouched — "rollback via block-table truncation" falls out of the
 mask being the only source of truth for what a row has committed).
+
+Async-pipeline sequencing (engine.py's double-buffered decode loop):
+a speculative dispatch reads HOST state — each row's pending token
+(``slot.outputs[-1]``) and commit count — that only exists after the
+previous verify was consumed, so the engine always JOINS the in-flight
+verify before proposing the next window; the one-step lookahead
+overlaps the in-flight verify with admission/prefill host work, never
+with a dependent propose.  Everything device-side needs no such
+fence: ``DraftRunner.commit`` reveals the accepted window in the
+draft's kv_mask from on-device ``counts`` (no host fetch), and a
+rejected window is squashed by the verify step's own mask arithmetic,
+so abandoning an in-flight verify (recover()/abort()) rolls back
+draft and target together for free — both caches are rebuilt, there
+is no host-side speculation state to unwind.
 """
 from typing import Any, Dict, List, Optional, Sequence
 

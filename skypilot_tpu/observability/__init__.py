@@ -72,7 +72,9 @@ METRIC_CONTRACT = frozenset({
     'skytpu_jit_compiles_total',          # labels: fn=decode|prefill|train_step
     'skytpu_jit_compile_seconds',         # compile (first-call) wall time
     'skytpu_step_dispatch_seconds',       # enqueue wall time, cache-hit steps
-    'skytpu_step_device_wait_seconds',    # host blocked on device_get
+    'skytpu_step_device_wait_seconds',    # scheduler blocked on step results
+    'skytpu_step_host_overlap_seconds',   # host work hidden behind device step
+    'skytpu_pipeline_depth',              # in-flight decode steps (async: 0/1)
     'skytpu_kv_pages_used_peak',          # page-pool high-watermark
     'skytpu_device_memory_peak_bytes',    # device allocator high-watermark
     # infer/engine.py — SLO accounting (targets via SKYTPU_SLO_TTFT_S /

@@ -554,7 +554,7 @@ def test_decode_emits_one_json_line_and_stderr_summary(
         assert key in parsed, key
     assert parsed['value'] == round(2304.0 / 1160.0, 2)  # 1.99
     assert set(parsed['arms']) == {'bf16', 'int8', 'paged',
-                                   'speculative'}
+                                   'speculative', 'async'}
     assert parsed['arms']['int8']['kv_cache_dtype'] == 'int8'
     assert 'int8' in parsed['metric']
     # Ragged arm: contiguous reads 4 slots * the full 512 bucket;
@@ -564,15 +564,19 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     assert parsed['paged_read_reduction_vs_contiguous'] == \
         round(4 * 512 / 200, 2)  # 10.24
     assert parsed['paged_token_parity'] is True
-    # Seven engines: the five DeepSeek-geometry arms (incl. the
+    # Nine engines: the five DeepSeek-geometry arms (incl. the
     # disabled-registry overhead arm) all serving the SAME weights,
     # then the gpt2 speculation pair (its own weights — plain
-    # reference engine + speculating twin sharing them).
+    # reference engine + speculating twin sharing them), then the
+    # sync/async pipeline pair (its own wider-geometry weights,
+    # shared between the two modes).
     assert [b.kv_cache_dtype for b in built] == \
-        ['auto', 'int8', 'auto', 'auto', 'auto', 'auto', 'auto']
-    assert [b.page_size for b in built] == [0, 0, 0, 8, 8, 0, 0]
+        ['auto', 'int8', 'auto', 'auto', 'auto', 'auto', 'auto',
+         'int8', 'int8']
+    assert [b.page_size for b in built] == [0, 0, 0, 8, 8, 0, 0, 8, 8]
     assert all(b.params is built[0].params for b in built[1:5])
     assert built[6].params is built[5].params
+    assert built[8].params is built[7].params
     spec = parsed['arms']['speculative']
     assert spec['spec_k'] == 4
     assert spec['greedy_parity_vs_plain'] is True
@@ -589,12 +593,24 @@ def test_decode_emits_one_json_line_and_stderr_summary(
                 'publish_pct_of_step',
                 'tokens_per_sec_paged_disabled_registry'):
         assert key in tel, key
+    # Async-pipeline arm: deterministic fake => bit-identical streams
+    # both modes, recorded on the line and at the top level.
+    ap = parsed['arms']['async']
+    assert ap['greedy_parity_vs_sync'] is True
+    assert parsed['async_token_parity'] is True
+    assert ap['kv_cache_dtype'] == 'int8' and ap['page_size'] == 8
+    for key in ('tokens_per_sec_sync', 'tokens_per_sec_async',
+                'device_wait_fraction_sync',
+                'device_wait_fraction_async'):
+        assert key in ap, key
     err = [l for l in captured.err.splitlines() if l.startswith('#')]
-    # dtype arms + ratio + paged + speculative + telemetry
-    assert len(err) == 6
-    assert 'fewer bytes/step' in err[-3]
-    assert 'token parity: True' in err[-2]  # the speculative line
-    assert 'steps/token' in err[-2]
+    # dtype arms + ratio + paged + speculative + async + telemetry
+    assert len(err) == 7
+    assert 'fewer bytes/step' in err[-4]
+    assert 'token parity: True' in err[-3]  # the speculative line
+    assert 'steps/token' in err[-3]
+    assert 'device-wait fraction' in err[-2]  # the async line
+    assert 'token parity: True' in err[-2]
     assert 'telemetry' in err[-1]
 
 
@@ -675,6 +691,30 @@ def test_decode_smoke_speculative_arm(decode_smoke_json):
     # bucket (accepted lengths > 1 occurred).
     assert hist['+Inf'] > 0
     assert hist['+Inf'] > hist['1']
+
+
+def test_decode_smoke_async_pipeline_arm(decode_smoke_json):
+    """The async decode pipeline's acceptance bar, proven on the real
+    engines in the same --smoke run: on the heaviest host-side
+    configuration (paged int8 KV, spec-k=4, 3x prompts per slot) the
+    double-buffered loop must (a) stream bit-identically to the
+    synchronous loop and (b) spend a strictly smaller fraction of
+    wall time blocked on step results — the host work it hides behind
+    the in-flight device step."""
+    parsed = decode_smoke_json
+    arm = parsed['arms']['async']
+    assert parsed['async_token_parity'] is True
+    assert arm['greedy_parity_vs_sync'] is True
+    assert arm['device_wait_fraction_async'] < \
+        arm['device_wait_fraction_sync'], arm
+    assert parsed['async_device_wait_fraction_async'] == \
+        arm['device_wait_fraction_async']
+    # Throughput must not regress materially (small slack: the smoke
+    # workload is a few hundred ms on CPU, so wall-clock noise is a
+    # few percent).
+    assert arm['tokens_per_sec_async'] >= \
+        0.8 * arm['tokens_per_sec_sync'], arm
+    assert arm['host_overlap_seconds'] > 0.0, arm
 
 
 def test_sleep_skip_when_spacing_would_burn_the_window(

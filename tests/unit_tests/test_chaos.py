@@ -201,3 +201,88 @@ def test_chaos_disabled_parity_is_bit_identical(paged):
     for p, got in zip(prompts, outs):
         assert got == _reference_greedy(paged.params, p, 4)
     _assert_leak_free(paged)
+
+
+# -- async pipeline: faults against a step already IN FLIGHT ----------
+#
+# The engine runs double-buffered by default: step N executes on
+# device while the scheduler works on N+1.  A fault injected into the
+# in-flight step is drawn on the fetch thread and must surface on the
+# CONSUME side (the scheduler's next join) with the same classify /
+# recover / leak-free contract as the synchronous loop.  The tests
+# use the worker's `_pipeline_delay_s` seam: with the delay armed the
+# fetch thread sleeps BEFORE its chaos draws, so a schedule configured
+# while the step is in flight is drawn against exactly that step.
+
+def _drive_until_inflight(eng, max_ticks=30):
+    import time
+    for _ in range(max_ticks):
+        eng.step()
+        if eng._inflight is not None:
+            return
+    raise AssertionError('no decode step went in flight')
+
+
+def test_async_inflight_raise_surfaces_on_consume_and_recovers(paged):
+    import time
+    slot_prompts = [[5, 17, 3, 42, 8], [9, 1, 30, 31]]
+    queued_prompt = [7, 8, 9, 10, 11]
+    rids = [paged.submit(p, _GREEDY) for p in slot_prompts]
+    rid_q = paged.submit(queued_prompt, _GREEDY)  # 2 slots: stays queued
+    paged._pipeline_delay_s = 0.3
+    try:
+        _drive_until_inflight(paged)
+        # The worker is sleeping in the delay seam with the dispatched
+        # step: this schedule is drawn against that in-flight step.
+        chaos.configure('step_raise:n=1')
+        time.sleep(0.8)       # worker wakes, draws, parks the fault
+        paged._pipeline_delay_s = 0.0
+        with pytest.raises(chaos.ChaosError) as ei:
+            paged.step()      # budget already spent: raises at the JOIN
+    finally:
+        paged._pipeline_delay_s = 0.0
+    assert failures.classify(ei.value) == failures.TRANSIENT
+    paged.recover(ei.value)
+    paged.run_until_idle()
+    # Slot-resident requests abort fast with the in-flight fault as
+    # the cause chain; the queued request survives to exact parity.
+    for rid in rids:
+        with pytest.raises(failures.RequestAbortedError) as aborted:
+            paged.wait(rid)
+        assert isinstance(aborted.value.__cause__, chaos.ChaosError)
+    assert paged.wait(rid_q) == _reference_greedy(
+        paged.params, queued_prompt, 4)
+    _assert_leak_free(paged)
+
+
+def test_async_inflight_hang_abort_is_nonblocking_then_released(paged):
+    """A hang wedging the fetch thread mid-step must not wedge the
+    scheduler: abort() abandons the in-flight step without joining it
+    (the server watchdog path), and release_hangs() — what the
+    watchdog and shutdown call — lets the worker finish so close()
+    can join the thread.  Fresh engine: abort() is terminal."""
+    import time
+    eng = engine_lib.ContinuousBatchingEngine(
+        'llama-tiny', n_slots=2, model_overrides=dict(_OVERRIDES),
+        param_dtype=jnp.float32, prefill_bucket=8, page_size=8,
+        params=paged.params, registry=metrics_lib.Registry())
+    rid = eng.submit([5, 17, 3, 42, 8], _GREEDY)
+    eng._pipeline_delay_s = 0.3
+    try:
+        _drive_until_inflight(eng)
+        chaos.configure('step_hang:n=1,hang_s=30')
+        time.sleep(0.8)       # worker is now wedged inside the hang
+        eng._pipeline_delay_s = 0.0
+        t0 = time.monotonic()
+        eng.abort(RuntimeError('watchdog: decode stall'))
+        assert time.monotonic() - t0 < 2.0   # abandoned, not joined
+        with pytest.raises(RuntimeError):
+            eng.wait(rid, timeout=5)
+        _assert_leak_free(eng)               # abort returned the pages
+        chaos.release_hangs()
+        eng.close()
+        assert eng.pipeline_info()['worker_alive'] is False
+    finally:
+        eng._pipeline_delay_s = 0.0
+        chaos.release_hangs()
+        eng.close()

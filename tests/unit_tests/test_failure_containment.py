@@ -215,6 +215,50 @@ def test_shutdown_warns_when_decode_thread_stays_wedged():
         wedge.set()
 
 
+# -- async pipeline fencing at the server level -----------------------
+
+def _n_pipeline_workers():
+    return sum(t.name == 'skytpu-pipeline-fetch'
+               for t in threading.enumerate())
+
+
+def test_health_verbose_reports_pipeline_and_shutdown_joins_worker():
+    base_workers = _n_pipeline_workers()
+    srv, _, base = _start_server()
+    try:
+        code, _, body = _completion(base)
+        assert code == 200, body
+        code, _, body = _req(base, '/health?verbose=1')
+        assert code == 200
+        pipe = json.loads(body)['pipeline']
+        assert pipe['mode'] == 'async'
+        assert pipe['max_depth'] == 1
+        assert pipe['worker_alive'] is True
+    finally:
+        srv.shutdown()
+    # shutdown() fences the engine pipeline after the decode loop is
+    # down: the fetch thread is joined, never leaked.
+    assert _n_pipeline_workers() == base_workers
+    assert srv.engine.pipeline_info()['worker_alive'] is False
+
+
+def test_no_async_pipeline_escape_hatch_serves_sync():
+    # Other modules' engines may hold their own fetch threads: assert
+    # on the delta, not the absolute count.
+    base_workers = _n_pipeline_workers()
+    srv, _, base = _start_server(async_pipeline=False)
+    try:
+        code, _, body = _completion(base)
+        assert code == 200, body
+        code, _, body = _req(base, '/health?verbose=1')
+        assert json.loads(body)['pipeline'] == dict(
+            mode='sync', depth=0, max_depth=0, worker_alive=False,
+            steps_overlapped=0)
+        assert _n_pipeline_workers() == base_workers
+    finally:
+        srv.shutdown()
+
+
 # -- shared module server (created here; all chaos armed below is
 # -- consumed by THIS server's loop) ---------------------------------
 

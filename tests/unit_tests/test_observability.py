@@ -591,13 +591,33 @@ def test_per_step_publish_overhead_under_two_percent(paged_engine):
     t0 = time.perf_counter()
     for _ in range(iters):
         # Full runtime-telemetry surface: occupancy + KV reads + the
-        # host-step breakdown (dispatch vs device wait) per step.
+        # host-step breakdown (dispatch vs device wait vs the host
+        # work the async pipeline hid behind the step).
         eng._publish_step_metrics(2, 1e6, dispatch_s=0.004,
-                                  device_wait_s=0.001)
+                                  device_wait_s=0.001,
+                                  host_overlap_s=0.002)
     publish_s = (time.perf_counter() - t0) / iters
     assert publish_s < 0.02 * step_s, (
         f'publish {publish_s * 1e6:.1f}us vs step '
         f'{step_s * 1e3:.2f}ms')
+
+
+def test_publish_books_host_overlap_only_when_measured(paged_engine):
+    """The overlap histogram is the async pipeline's accounting: a
+    synchronous tick (host_overlap_s=None) must not record a sample,
+    an async tick records exactly its measured overlap — 0.0 included
+    (an empty-overlap tick is a fact, not a gap)."""
+    eng, reg = paged_engine
+    h = reg.get('skytpu_step_host_overlap_seconds')
+    c0, s0 = h.count, h.sum
+    eng._publish_step_metrics(1, 0.0, device_wait_s=0.001)
+    assert (h.count, h.sum) == (c0, s0)     # sync tick: no sample
+    eng._publish_step_metrics(1, 0.0, host_overlap_s=0.25)
+    assert h.count == c0 + 1
+    assert h.sum == pytest.approx(s0 + 0.25)
+    eng._publish_step_metrics(1, 0.0, host_overlap_s=0.0)
+    assert h.count == c0 + 2
+    assert h.sum == pytest.approx(s0 + 0.25)
 
 
 # Test surfaces this PR added: scanned by the tier-1 guard below.

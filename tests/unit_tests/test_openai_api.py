@@ -273,9 +273,12 @@ class TestEngineStream:
 
         thread = threading.Thread(target=_reader, daemon=True)
         thread.start()
-        eng.step()  # admit + first decode -> at least one token
+        # Drive the loop like the decode thread would; with the async
+        # pipeline the first dispatched step commits on the NEXT
+        # tick's join, so one step() is not enough for a token.
         deadline = time.time() + 5
         while not got and time.time() < deadline:
+            eng.step()
             time.sleep(0.01)
         assert got, 'reader saw no token'
         eng.cancel(rid)  # pushes the end sentinel

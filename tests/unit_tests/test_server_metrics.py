@@ -105,6 +105,13 @@ def test_metrics_scrape_after_round_trip(server):
     http = reg.get('skytpu_http_requests_total')
     assert http.value_for(method='POST', route='/v1/completions',
                           code='200') >= 2
+    # The async decode pipeline (default on) recorded host work
+    # hidden behind at least one in-flight step, and the depth gauge
+    # reads drained between requests.
+    overlap = reg.get('skytpu_step_host_overlap_seconds')
+    assert overlap is not None and overlap.count >= 1
+    assert 'skytpu_step_host_overlap_seconds_bucket' in text
+    assert reg.get('skytpu_pipeline_depth').value == 0
 
 
 def test_traces_endpoint_carries_http_request_id(server):

@@ -469,9 +469,102 @@ def test_tree_has_zero_unsuppressed_findings():
         f.render() for f in live)
 
 
+# ---------------------------------------------------------------------
+# pipeline-discipline
+# ---------------------------------------------------------------------
+
+_PIPELINE_DISPATCH_SYNC = """
+    import jax
+    import numpy as np
+
+    class Engine:
+        def _dispatch_plain(self, occupied):
+            tok_dev = self._decode(occupied)
+            toks = np.asarray(jax.device_get(tok_dev))  # BAD: sync
+            return toks
+
+        def _consume_step(self, handle):
+            return handle
+"""
+
+
+def test_pipeline_discipline_flags_dispatch_side_sync(tmp_path):
+    findings = _live(_lint(tmp_path, 'infer/engine.py',
+                           _PIPELINE_DISPATCH_SYNC,
+                           rule='pipeline-discipline'))
+    assert findings, 'device_get on a _dev future in a dispatch-side ' \
+                     'method must be flagged'
+    assert any('jax.device_get' == f.symbol for f in findings)
+
+
+def test_pipeline_discipline_flags_item_and_block_until_ready(tmp_path):
+    src = """
+        class Engine:
+            def _dispatch_spec(self, occupied):
+                out_dev, counts_dev = self._verify(occupied)
+                out_dev.block_until_ready()        # BAD
+                n = int(counts_dev.item())         # BAD (x2)
+                return n
+
+            def _consume_step(self, handle):
+                return handle
+    """
+    findings = _live(_lint(tmp_path, 'infer/engine.py', src,
+                           rule='pipeline-discipline'))
+    symbols = {f.symbol for f in findings}
+    assert '.block_until_ready()' in symbols
+    assert '.item()' in symbols
+
+
+def test_pipeline_discipline_consume_side_is_clean(tmp_path):
+    src = """
+        import jax
+        import numpy as np
+
+        class Engine:
+            def _dispatch_plain(self, occupied):
+                tok_dev = self._decode(occupied)
+                return (tok_dev,)                  # futures only: OK
+
+            def _fetch_handle(self, handle):
+                handle.host = tuple(np.asarray(jax.device_get(a))
+                                    for a in handle.arrays)
+
+            def _consume_step(self, handle):
+                toks = handle.host[0]
+                return int(toks[0])
+    """
+    assert not _live(_lint(tmp_path, 'infer/engine.py', src,
+                           rule='pipeline-discipline'))
+
+
+def test_pipeline_discipline_ignores_non_pipeline_classes(tmp_path):
+    # A class without the dispatch/consume split (the request-level
+    # engine) may synchronize its own futures inline.
+    src = """
+        import jax
+        import numpy as np
+
+        class SimpleEngine:
+            def generate(self, prompts):
+                tok_dev = self._decode(prompts)
+                return np.asarray(jax.device_get(tok_dev))
+    """
+    assert not _live(_lint(tmp_path, 'infer/engine.py', src,
+                           rule='pipeline-discipline'))
+
+
+def test_pipeline_discipline_scoped_to_infer(tmp_path):
+    # Same code outside infer/engine.py|speculative.py: out of scope.
+    assert not _live(_lint(tmp_path, 'serve/router.py',
+                           _PIPELINE_DISPATCH_SYNC,
+                           rule='pipeline-discipline'))
+
+
 def test_all_rule_families_are_registered():
     ids = {r.id for r in skylint.all_rules()}
     assert {'host-sync', 'retrace-hazard', 'lock-discipline',
             'thread-discipline', 'stdout-purity', 'metric-contract',
             'dtype-promotion', 'sleep-discipline',
-            'net-timeout', 'trace-discipline'} <= ids
+            'net-timeout', 'trace-discipline',
+            'pipeline-discipline'} <= ids
