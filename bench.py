@@ -383,9 +383,11 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
     continuous-batching engine plus the per-step KV-cache read-bytes
     estimate (infer/engine.py decode_cache_read_bytes, scale leaves
     included for the int8 arm, per-row allocated pages for the paged
-    arm).  Two more arms ride along: speculative decoding (gpt2
-    draft/target pair) and the sync-vs-async decode pipeline
-    comparison on the paged int8 spec-k=4 configuration.  `smoke`
+    arm).  Three more arms ride along: speculative decoding (gpt2
+    draft/target pair), the sync-vs-async decode pipeline comparison
+    on the paged int8 spec-k=4 configuration, and the fused
+    paged-attention kernel vs the XLA gather path on that same
+    geometry (read bytes/step with the gather epilogue vs 0).  `smoke`
     shrinks sequence lengths/steps so the whole thing (including the
     greedy-parity checks) runs in tier-1 on CPU.
 
@@ -749,6 +751,80 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
         'greedy_parity_vs_sync': ap_parity,
     }
 
+    # --- sixth arm: fused paged-attention kernel vs XLA gather -------
+    # The Pallas decode kernel walks the block table in-kernel, so the
+    # gather_pages round-trip (a contiguous copy of every slot's pages
+    # written to and re-read from HBM each step, K + V + the int8
+    # scale siblings) never exists.  The arm runs the heaviest kernel
+    # configuration — paged int8 KV with spec-k=4 verify windows
+    # (s = k+1 queries per step) via ngram self-drafting on
+    # repetitive prompts, so proposals actually fire without paying
+    # for a separate draft model — and reports read bytes/step under
+    # both implementations via the epilogue-aware accounting
+    # (decode_cache_read_bytes), with the in-run assert that the
+    # fused stream is bit-identical to the XLA twin.  On CPU the
+    # kernel runs in Pallas interpreter mode (recorded in the
+    # decode_kernel block), so tokens/sec here is a correctness-path
+    # number, not the TPU speedup; the read-bytes delta is the
+    # headline.
+    fk_prompts = [([5, 17, 3, 42] * 3)[:12] for _ in range(n_slots)]
+
+    def _kernel_arm(decode_kernel, params=None):
+        eng = engine_lib.ContinuousBatchingEngine(
+            'gpt2-tiny', n_slots=n_slots, prefill_bucket=8,
+            model_overrides=dict(sp_overrides),
+            param_dtype=jnp.float32, params=params,
+            kv_cache_dtype='int8', page_size=8, spec_k=sp_k,
+            registry=metrics_lib.Registry(),
+            decode_kernel=decode_kernel)
+        eng.generate(fk_prompts, sp_sampling)      # compile warmup
+        t0 = time.time()
+        outs = eng.generate(fk_prompts, sp_sampling)
+        return eng, outs, time.time() - t0
+
+    fk_xla_eng, fk_xla_outs, fk_xla_dt = _kernel_arm('xla')
+    fk_fused_eng, fk_fused_outs, fk_fused_dt = _kernel_arm(
+        'fused', params=fk_xla_eng.params)
+    fk_parity = [list(a) for a in fk_fused_outs] == \
+        [list(a) for a in fk_xla_outs]
+    assert fk_parity, \
+        'fused paged-attention kernel broke greedy parity vs XLA'
+    # Verify windows (s = k+1) must actually have run through the
+    # kernel, or the parity assert above is vacuous.
+    assert fk_fused_eng.speculation_info()['proposed_tokens'] > 0
+    # Final live context per slot (bucketed prompt pad + new tokens):
+    # the same per-row charge both engines pay for pool reads; only
+    # the XLA arm adds the gather epilogue on top.
+    fk_finals = [fk_xla_eng._eng._bucketed(len(p)) + sp_new  # pylint: disable=protected-access
+                 for p in fk_prompts]
+    fk_xla_reads = fk_xla_eng.cache_read_bytes_per_step(
+        row_contexts=fk_finals)
+    fk_fused_reads = fk_fused_eng.cache_read_bytes_per_step(
+        row_contexts=fk_finals)
+    assert fk_fused_reads['epilogue_bytes'] == 0.0, fk_fused_reads
+    assert fk_fused_reads['total_bytes'] < fk_xla_reads['total_bytes']
+    fk_ratio = (fk_xla_reads['total_bytes']
+                / max(fk_fused_reads['total_bytes'], 1e-9))
+    fused_arm = {
+        'page_size': 8,
+        'kv_cache_dtype': 'int8',
+        'spec_k': sp_k,
+        'decode_kernel': fk_fused_eng.decode_kernel_info(),
+        'greedy_parity_vs_xla': fk_parity,
+        'tokens_per_sec_xla': round(
+            sum(len(o) for o in fk_xla_outs)
+            / max(fk_xla_dt, 1e-9), 1),
+        'tokens_per_sec_fused': round(
+            sum(len(o) for o in fk_fused_outs)
+            / max(fk_fused_dt, 1e-9), 1),
+        'read_bytes_per_step_xla': fk_xla_reads['total_bytes'],
+        'read_bytes_per_step_fused': fk_fused_reads['total_bytes'],
+        'epilogue_bytes_per_step_xla': fk_xla_reads['epilogue_bytes'],
+        'epilogue_bytes_per_step_fused':
+            fk_fused_reads['epilogue_bytes'],
+        'read_reduction_fused_vs_xla': round(fk_ratio, 2),
+    }
+
     result = {
         'metric': 'decode int8-KV cache-read reduction (B=4 slots, '
                   'deepseek-v2-lite attention geometry)',
@@ -761,13 +837,15 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
                        f' MB/step',
         'arms': {'bf16': bf16_arm, 'int8': int8_arm,
                  'paged': paged_arm, 'speculative': spec_arm,
-                 'async': async_arm},
+                 'async': async_arm, 'fused_kernel': fused_arm},
         'telemetry': telemetry,
         'paged_read_reduction_vs_contiguous': round(pg_ratio, 2),
         'paged_token_parity': pg_parity,
         'spec_steps_per_token': spec_arm['target_steps_per_token'],
         'spec_token_parity': sp_parity,
         'async_token_parity': ap_parity,
+        'fused_token_parity': fk_parity,
+        'fused_read_reduction_vs_xla': round(fk_ratio, 2),
         'async_device_wait_fraction_sync': round(ap_sync_frac, 6),
         'async_device_wait_fraction_async': round(ap_async_frac, 6),
         'n_heads': 16,
@@ -806,6 +884,14 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
           f'{async_arm["tokens_per_sec_sync"]:,.0f} -> '
           f'{async_arm["tokens_per_sec_async"]:,.0f} tok/s, greedy '
           f'token parity: {ap_parity}', file=sys.stderr)
+    print(f'# decode [fused-kernel]: paged-int8 spec-k={sp_k} '
+          f'({fused_arm["decode_kernel"]["path"]}, interpret='
+          f'{fused_arm["decode_kernel"]["interpret"]}); reads/step '
+          f'{fk_xla_reads["total_bytes"] / 1e6:.2f} MB (XLA gather, '
+          f'{fk_xla_reads["epilogue_bytes"] / 1e6:.2f} MB epilogue) '
+          f'-> {fk_fused_reads["total_bytes"] / 1e6:.2f} MB fused '
+          f'({fk_ratio:.2f}x), greedy token parity: {fk_parity}',
+          file=sys.stderr)
     print(f'# telemetry: prefix hit ratio '
           f'{telemetry["prefix_hit_ratio"]:.2f} '
           f'({telemetry["prefix_page_hits"]:.0f} hits / '

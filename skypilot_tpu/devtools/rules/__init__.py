@@ -6,6 +6,7 @@ a stable order.  Adding a rule family == adding a module here.
 """
 from skypilot_tpu.devtools.rules import dtype_promotion
 from skypilot_tpu.devtools.rules import host_sync
+from skypilot_tpu.devtools.rules import kernel_discipline
 from skypilot_tpu.devtools.rules import lock_discipline
 from skypilot_tpu.devtools.rules import metric_contract
 from skypilot_tpu.devtools.rules import net_timeout
@@ -19,6 +20,6 @@ ALL_RULES = (host_sync.RULES + retrace.RULES + lock_discipline.RULES
              + stdout_purity.RULES + metric_contract.RULES
              + dtype_promotion.RULES + sleep_discipline.RULES
              + net_timeout.RULES + trace_discipline.RULES
-             + pipeline_discipline.RULES)
+             + pipeline_discipline.RULES + kernel_discipline.RULES)
 
 __all__ = ['ALL_RULES']

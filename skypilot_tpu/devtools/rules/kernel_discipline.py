@@ -1,0 +1,80 @@
+"""kernel-discipline: every pallas_call in ops/ gates interpret on _on_tpu.
+
+Pallas kernels compile through Mosaic only on a real TPU backend; on
+CPU/GPU the same call must run under the Pallas interpreter or it
+fails at lowering time.  The repo's idiom (set by ops/flash_attention
+and ops/paged_attention) is to derive the ``interpret=`` kwarg from the
+``_on_tpu()`` backend probe — ``interpret=not _on_tpu()`` or a
+conditional that defaults to it — so kernels are compiled on TPU and
+interpreted (hence testable) everywhere else, with no hard-coded mode.
+
+A ``pl.pallas_call`` in ops/ with no ``interpret=`` kwarg silently
+hard-codes compiled mode (breaks every off-TPU test lane); one with a
+constant ``interpret=True`` silently hard-codes interpreter mode
+(throws away the TPU kernel in production).  Both are findings: the
+kwarg must be present and its value expression must consult
+``_on_tpu``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from skypilot_tpu.devtools import skylint
+
+RULE_ID = 'kernel-discipline'
+
+
+def in_scope(posix: str) -> bool:
+    # Kernels live in ops/; tests and benches may pin interpret
+    # explicitly to probe one mode.
+    return '/ops/' in posix or posix.startswith('ops/')
+
+
+def _is_pallas_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == 'pallas_call':
+        return True
+    return isinstance(f, ast.Name) and f.id == 'pallas_call'
+
+
+def _consults_on_tpu(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Name) and f.id == '_on_tpu':
+            return True
+        if isinstance(f, ast.Attribute) and f.attr == '_on_tpu':
+            return True
+    return False
+
+
+def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_pallas_call(node)):
+            continue
+        interp = next((kw.value for kw in node.keywords
+                       if kw.arg == 'interpret'), None)
+        if interp is None:
+            findings.append(ctx.finding(
+                RULE_ID, node, 'pallas_call',
+                'pl.pallas_call without interpret=: hard-codes '
+                'compiled Mosaic mode, which fails off-TPU — gate it '
+                'on the backend probe (interpret=not _on_tpu())'))
+        elif not _consults_on_tpu(interp):
+            findings.append(ctx.finding(
+                RULE_ID, node, 'pallas_call',
+                'pl.pallas_call interpret= does not consult _on_tpu(): '
+                'a hard-coded mode either fails off-TPU or throws away '
+                'the compiled TPU kernel — derive it from the backend '
+                'probe (interpret=not _on_tpu())'))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='pl.pallas_call in ops/ must gate interpret= on _on_tpu()',
+    check=check,
+    scope=in_scope),)

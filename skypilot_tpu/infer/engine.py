@@ -287,7 +287,8 @@ def _cache_sharding(mesh, leaf) -> NamedSharding:
 def decode_cache_read_bytes(abstract_cache: Any, n_heads: int,
                             context: Optional[int] = None,
                             page_size: int = 0,
-                            row_contexts: Optional[Sequence[int]] = None
+                            row_contexts: Optional[Sequence[int]] = None,
+                            decode_kernel: str = 'xla'
                             ) -> Dict[str, float]:
     """Per-decode-step KV-cache read traffic estimate (HBM bytes).
 
@@ -325,19 +326,38 @@ def decode_cache_read_bytes(abstract_cache: Any, n_heads: int,
     ``context`` still caps every row (the bucketed read high-water
     mark).  Block tables / cursors (ndim <= 3 int32) are skipped as
     negligible next to the K/V stream.
+
+    ``epilogue_bytes`` charges what the POOL reads alone undercount on
+    the paged XLA path (``decode_kernel='xla'``): gather_pages writes a
+    contiguous [B, kvh, n_read*ps, d] copy of every pool leaf (K, V,
+    and the int8 scale siblings) that the grouped einsum then re-reads
+    — 2x the gathered size, for EVERY row at the shared bucketed
+    window (the widest row's page-rounded context, further capped by
+    ``context``), live or not.  The fused Pallas kernel
+    (``decode_kernel='fused'``) streams pool tiles straight into VMEM,
+    so its epilogue term is exactly 0 — the delta the kernel removes.
+    ``total_bytes`` = grouped + epilogue, the honest per-step figure.
     """
     grouped = 0
     repeated = 0
+    if decode_kernel not in ('fused', 'xla'):
+        raise ValueError(
+            f"decode_kernel must be 'fused' or 'xla', got "
+            f'{decode_kernel!r}')
     if page_size > 0:
         if row_contexts is None:
             raise ValueError(
                 'row_contexts is required for paged accounting '
                 '(page_size > 0): per-row live context lengths.')
         positions = 0
+        window = 0
         for ctx in row_contexts:
             if context is not None:
                 ctx = min(ctx, context)
-            positions += -(-max(int(ctx), 0) // page_size) * page_size
+            row_pos = -(-max(int(ctx), 0) // page_size) * page_size
+            positions += row_pos
+            window = max(window, row_pos)
+        epilogue = 0
         for leaf in jax.tree.leaves(abstract_cache):
             if leaf.ndim == 4:       # [n_pages, kvh, ps, hd]
                 layers, (_, kvh, ps, hd) = 1, leaf.shape
@@ -349,9 +369,16 @@ def decode_cache_read_bytes(abstract_cache: Any, n_heads: int,
             leaf_bytes = layers * kvh * positions * hd * itemsize
             grouped += leaf_bytes
             repeated += leaf_bytes * max(1, n_heads // kvh)
+            if decode_kernel == 'xla':
+                # Write + re-read of the gathered contiguous copy,
+                # every row at the shared read window.
+                epilogue += 2 * layers * kvh * (
+                    len(row_contexts) * window) * hd * itemsize
         return {
             'grouped_bytes': float(grouped),
             'repeat_bytes': float(repeated),
+            'epilogue_bytes': float(epilogue),
+            'total_bytes': float(grouped + epilogue),
             'reduction': float(repeated) / float(grouped)
             if grouped else 1.0,
         }
@@ -370,6 +397,8 @@ def decode_cache_read_bytes(abstract_cache: Any, n_heads: int,
     return {
         'grouped_bytes': float(grouped),
         'repeat_bytes': float(repeated),
+        'epilogue_bytes': 0.0,       # contiguous reads need no gather
+        'total_bytes': float(grouped),
         'reduction': float(repeated) / float(grouped) if grouped else 1.0,
     }
 
@@ -640,6 +669,14 @@ class _ServingMetrics:
             'skytpu_decode_slot_steps_total',
             'Sum over decode steps of occupied slots (mean batch '
             'occupancy = slot_steps / (steps * n_slots)).')
+        self.decode_kernel_steps = r.counter(
+            'skytpu_decode_kernel_steps_total',
+            'Decode/verify device steps by paged-attention '
+            "implementation: path='fused' walks the block table "
+            "in-kernel (ops/paged_attention), path='xla' is the "
+            'gather_pages + grouped-einsum path (also counted by '
+            'contiguous-cache engines).',
+            labelnames=('path',))
         self.live_slots = r.gauge(
             'skytpu_decode_live_slots',
             'Occupied decode slots at the last step.')
@@ -850,11 +887,16 @@ class ContinuousBatchingEngine:
                  draft_checkpoint_dir: Optional[str] = None,
                  draft_overrides: Optional[Dict[str, Any]] = None,
                  spec_k: int = 0,
-                 async_pipeline: bool = True) -> None:
+                 async_pipeline: bool = True,
+                 decode_kernel: str = 'auto') -> None:
         import collections
 
         if draft_model is not None and spec_k <= 0:
             raise ValueError('draft_model requires spec_k > 0')
+        if decode_kernel not in ('auto', 'fused', 'xla'):
+            raise ValueError(
+                f"decode_kernel must be 'auto', 'fused' or 'xla', "
+                f'got {decode_kernel!r}')
         # Model build, param load/sharding, and the [n_slots, ...]
         # cache scaffolding are identical to the request-level engine.
         self._eng = InferenceEngine(
@@ -875,6 +917,24 @@ class ContinuousBatchingEngine:
         self.max_seq_len = self._eng.max_seq_len
         self.page_size = self._eng.page_size
         self.n_pages = self._eng.n_pages
+
+        # Paged decode-attention implementation (--decode-kernel).
+        # 'auto' resolves ON TPU to the fused Pallas kernel
+        # (ops/paged_attention — zero gather round-trip) and OFF TPU to
+        # the XLA gather path: the fused kernel off-TPU runs in the
+        # orders-of-magnitude-slower interpreter, so only an explicit
+        # 'fused' (tests, parity benches) ever selects it there.
+        on_tpu = jax.default_backend() == 'tpu'
+        if decode_kernel == 'auto':
+            decode_kernel = 'fused' if (on_tpu and self.page_size) \
+                else 'xla'
+        if decode_kernel == 'fused' and not self.page_size:
+            raise ValueError(
+                "decode_kernel='fused' requires a paged KV cache "
+                '(page_size > 0)')
+        self.decode_kernel = decode_kernel
+        self.decode_kernel_interpret = (decode_kernel == 'fused'
+                                        and not on_tpu)
 
         # Batch-1 prefill cache template.
         rng = jax.random.PRNGKey(seed)
@@ -996,7 +1056,8 @@ class ContinuousBatchingEngine:
             brange = jnp.arange(tok.shape[0])
             reveal = kv_mask[brange, cursors] | active
             kv_mask = kv_mask.at[brange, cursors].set(reveal)
-            with llama_lib.kv_read_bucket(kv_bucket):
+            with llama_lib.kv_read_bucket(kv_bucket), \
+                    llama_lib.decode_kernel(self.decode_kernel):
                 logits, cache = _forward(p, cache, tok[:, None],
                                          rope_pos[:, None], kv_mask)
             return tok, logits[:, 0], cache, kv_mask
@@ -1075,7 +1136,8 @@ class ContinuousBatchingEngine:
                                          axis=1)
                 positions = rope[:, None] + jnp.arange(
                     drafts.shape[1] + 1, dtype=jnp.int32)[None, :]
-                with llama_lib.kv_read_bucket(kv_bucket):
+                with llama_lib.kv_read_bucket(kv_bucket), \
+                        llama_lib.decode_kernel(self.decode_kernel):
                     logits, cache = _forward(p, cache, tokens,
                                              positions, kv_mask)
                 out, counts = sl.accept_draft_rows(
@@ -1179,8 +1241,20 @@ class ContinuousBatchingEngine:
             self._read_bytes_per_page = self._eng.cache_read_bytes_per_step(
                 row_contexts=[1])['grouped_bytes']
             self._read_bytes_per_pos = 0.0
+            # XLA-path gather epilogue: bytes ONE page of the shared
+            # read window costs PER SLOT (the gathered contiguous copy
+            # is written then re-read for every row at the bucketed
+            # window, live or not).  Zero on the fused kernel — it
+            # streams pool tiles straight into VMEM.
+            if self.decode_kernel == 'xla':
+                self._epilogue_bytes_per_page = \
+                    self._eng.cache_read_bytes_per_step(
+                        row_contexts=[1])['epilogue_bytes']
+            else:
+                self._epilogue_bytes_per_page = 0.0
         else:
             self._read_bytes_per_page = 0.0
+            self._epilogue_bytes_per_page = 0.0
             self._read_bytes_per_pos = self._eng.cache_read_bytes_per_step(
                 context=1)['grouped_bytes']
 
@@ -1191,13 +1265,27 @@ class ContinuousBatchingEngine:
         cache — see decode_cache_read_bytes.  On a paged engine with
         no explicit `row_contexts`, the LIVE slots' contexts are used
         (a decode step gathers only allocated pages), falling back to
-        the all-slots-at-`context` worst case when idle."""
+        the all-slots-at-`context` worst case when idle.  The engine's
+        own --decode-kernel choice sets the epilogue term: the XLA
+        gather path pays it, the fused kernel reports 0."""
         if self.page_size and row_contexts is None:
             live = [s.pad_len + s.generated + 1
                     for s in self._slots if s is not None]
             row_contexts = live or None
-        return self._eng.cache_read_bytes_per_step(context,
-                                                   row_contexts)
+        return self._eng.cache_read_bytes_per_step(
+            context, row_contexts, decode_kernel=self.decode_kernel)
+
+    def decode_kernel_info(self) -> Dict[str, Any]:
+        """decode_kernel block for /health?verbose=1: the resolved
+        paged-attention implementation, the page geometry it runs
+        over, and whether the Pallas kernel is in interpreter mode
+        (fused off-TPU — tests/benches only, never the 'auto'
+        default)."""
+        return dict(
+            path=self.decode_kernel,
+            page_size=self.page_size,
+            interpret=self.decode_kernel_interpret,
+        )
 
     @property
     def params(self):
@@ -2272,6 +2360,10 @@ class ContinuousBatchingEngine:
             ps = self.page_size
             read_bytes = self._read_bytes_per_page * sum(
                 -(-(int(cursors[i]) + 1) // ps) for i in occupied)
+            # XLA gather epilogue: every SLOT pays the shared bucketed
+            # window (see decode_cache_read_bytes); 0.0 when fused.
+            read_bytes += (self._epilogue_bytes_per_page
+                           * self.n_slots * -(-bucket // ps))
         else:
             read_bytes = self._read_bytes_per_pos * bucket
         return _InflightStep(
@@ -2377,6 +2469,8 @@ class ContinuousBatchingEngine:
             ps = self.page_size
             read_bytes = self._read_bytes_per_page * sum(
                 -(-(int(cursors[i]) + k + 1) // ps) for i in occupied)
+            read_bytes += (self._epilogue_bytes_per_page
+                           * self.n_slots * -(-bucket // ps))
         else:
             read_bytes = self._read_bytes_per_pos * bucket
         return _InflightStep(
@@ -2463,6 +2557,7 @@ class ContinuousBatchingEngine:
         accounting must never assume 1 token per step."""
         m = self._met
         m.steps.inc()
+        m.decode_kernel_steps.labels(path=self.decode_kernel).inc()
         m.slot_steps.inc(n_occupied)
         m.output_tokens.inc(n_occupied if n_tokens is None
                             else n_tokens)
@@ -2938,13 +3033,17 @@ class InferenceEngine:
 
     def cache_read_bytes_per_step(self, context: Optional[int] = None,
                                   row_contexts: Optional[Sequence[int]]
-                                  = None) -> Dict[str, float]:
+                                  = None,
+                                  decode_kernel: str = 'xla'
+                                  ) -> Dict[str, float]:
         """Estimated HBM bytes one decode step reads from THIS engine's
         cache (grouped epilogue vs the old repeat path) — see
         decode_cache_read_bytes.  Paged engines charge per-row
         allocated pages: pass `row_contexts` for live per-slot context
         lengths; without it every slot is assumed at `context` (or
-        max_seq_len), the paged worst case."""
+        max_seq_len), the paged worst case.  `decode_kernel` selects
+        the paged epilogue model: 'xla' charges the gather_pages
+        round-trip, 'fused' reports epilogue_bytes == 0."""
         if self.page_size:
             if row_contexts is None:
                 ctx = context if context is not None \
@@ -2952,7 +3051,8 @@ class InferenceEngine:
                 row_contexts = [ctx] * self.max_batch
             return decode_cache_read_bytes(
                 self._abstract_cache, self.config.n_heads, context,
-                page_size=self.page_size, row_contexts=row_contexts)
+                page_size=self.page_size, row_contexts=row_contexts,
+                decode_kernel=decode_kernel)
         return decode_cache_read_bytes(self._abstract_cache,
                                        self.config.n_heads, context)
 

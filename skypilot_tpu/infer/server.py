@@ -186,6 +186,7 @@ class InferenceServer:
                  draft_overrides=None,
                  spec_k: int = 0,
                  async_pipeline: bool = True,
+                 decode_kernel: str = 'auto',
                  ) -> None:
         from skypilot_tpu.parallel import mesh as mesh_lib
         # Hang-proof first backend touch: a wedged tunneled TPU makes
@@ -221,8 +222,14 @@ class InferenceServer:
                 registry=registry, draft_model=draft_model,
                 draft_checkpoint_dir=draft_checkpoint_dir,
                 draft_overrides=draft_overrides, spec_k=spec_k,
-                async_pipeline=async_pipeline)
+                async_pipeline=async_pipeline,
+                decode_kernel=decode_kernel)
         else:
+            if decode_kernel != 'auto':
+                raise ValueError(
+                    '--decode-kernel requires continuous batching '
+                    '(paged decode attention is slot-mode only); drop '
+                    '--no-continuous.')
             if page_size:
                 raise ValueError(
                     '--page-size requires continuous batching (the '
@@ -345,6 +352,12 @@ class InferenceServer:
             # Async decode pipeline state: mode, in-flight depth,
             # fetch-thread liveness, overlapped-step count.
             detail['pipeline'] = pipe()
+        dk = getattr(eng, 'decode_kernel_info', None)
+        if dk is not None:
+            # Paged decode-attention implementation: resolved path
+            # (fused Pallas vs XLA gather), page geometry, and whether
+            # the kernel runs in interpreter mode (off-TPU tests only).
+            detail['decode_kernel'] = dk()
         return detail
 
     def _fail_replica(self, error: BaseException) -> None:
@@ -1145,6 +1158,19 @@ def main() -> None:
                         help='Escape hatch: run the fully '
                              'synchronous decode loop (dispatch, '
                              'fetch, commit inline each tick).')
+    parser.add_argument('--decode-kernel', default='auto',
+                        choices=['auto', 'fused', 'xla'],
+                        help='Paged decode-attention implementation: '
+                             "'fused' walks the block table inside a "
+                             'Pallas kernel (page gather + int8 '
+                             'dequant + grouped attention + verify '
+                             'windows in one kernel, zero gather '
+                             "round-trip); 'xla' is the gather_pages "
+                             '+ grouped-einsum path (permanent '
+                             "fallback and parity oracle). 'auto' "
+                             'picks fused on TPU with --page-size, '
+                             'xla otherwise — off-TPU the fused '
+                             'kernel only runs interpreted (tests).')
     parser.add_argument('--kv-read-bucket', type=int, default=512,
                         help='Decode attention reads only the live '
                              'cache prefix, rounded up to this bucket '
@@ -1189,6 +1215,7 @@ def main() -> None:
                     draft_checkpoint_dir=args.draft_checkpoint_dir,
                     draft_overrides=draft_overrides,
                     spec_k=args.spec_k,
+                    decode_kernel=args.decode_kernel,
                     async_pipeline=args.async_pipeline,
                     ).serve_forever()
 

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu.ops import flash_attention as fa
 from skypilot_tpu.ops import grouped_attention as ga
+from skypilot_tpu.ops import paged_attention as pa
 
 
 @dataclasses.dataclass(frozen=True)
@@ -273,6 +274,27 @@ def kv_read_bucket(n: Optional[int]):
 
 
 @contextlib.contextmanager
+def decode_kernel(kind: str):
+    """Select the paged decode-attention implementation for calls
+    traced under this context (a static trace-time choice, like
+    slot_mode): 'fused' runs the Pallas kernel that walks the block
+    table in-kernel (ops/paged_attention — interpreter mode off-TPU),
+    'xla' keeps the gather_pages + grouped-einsum path.  The engine
+    resolves its --decode-kernel=auto flag to one of the two and wraps
+    its jitted decode/verify CALLS in this context; outside it the XLA
+    path is always used."""
+    if kind not in ('fused', 'xla'):
+        raise ValueError(
+            f"decode_kernel must be 'fused' or 'xla', got {kind!r}")
+    prev = getattr(_SLOT_MODE, 'decode_kernel', 'xla')
+    _SLOT_MODE.decode_kernel = kind
+    try:
+        yield
+    finally:
+        _SLOT_MODE.decode_kernel = prev
+
+
+@contextlib.contextmanager
 def slot_mode():
     """Enable per-row cache cursors in run_cached_attention for calls
     traced under this context (ContinuousBatchingEngine wraps its jit
@@ -434,8 +456,6 @@ def _paged_slot_attention(module: nn.Module, q: jax.Array,
     n_read = -(-read_len // ps)
     read_len = n_read * ps
     tbl = table.value[:, :n_read]
-    keys = ga.gather_pages(page_k.value, tbl)
-    values = ga.gather_pages(page_v.value, tbl)
     if s == 1:
         visible = kv_mask
         if window is not None:
@@ -445,6 +465,21 @@ def _paged_slot_attention(module: nn.Module, q: jax.Array,
         mask = visible[:, None, None, :read_len]
     else:
         mask = _verify_mask(kv_mask, base, s, read_len, window)
+    if getattr(_SLOT_MODE, 'decode_kernel', 'xla') == 'fused':
+        # Fused Pallas path (ops/paged_attention): the block table
+        # rides in as a scalar-prefetch operand and pages stream
+        # pool -> VMEM one tile at a time — no gathered contiguous
+        # K/V/scale copies ever hit HBM.  The mask already encodes
+        # every visibility rule (revealed slots, verify windows,
+        # sliding window, null-page entries), so semantics are shared
+        # with the XLA oracle below by construction.
+        return pa.paged_decode_attention(
+            q, page_k.value, page_v.value, tbl, mask,
+            scale=hd ** -0.5, probs_dtype=dtype,
+            key_scale=pk_scale.value if quant else None,
+            value_scale=pv_scale.value if quant else None)
+    keys = ga.gather_pages(page_k.value, tbl)
+    values = ga.gather_pages(page_v.value, tbl)
     if quant:
         k_sc = ga.gather_pages(pk_scale.value, tbl)
         v_sc = ga.gather_pages(pv_scale.value, tbl)

@@ -38,6 +38,7 @@ METRIC_CONTRACT = frozenset({
     'skytpu_admission_backpressure_total',
     'skytpu_decode_batch_occupancy_ratio',
     'skytpu_decode_cache_read_bytes',
+    'skytpu_decode_kernel_steps_total',   # labels: path=fused|xla
     'skytpu_decode_live_slots',
     'skytpu_decode_queue_depth',
     'skytpu_decode_slot_steps_total',

@@ -1,0 +1,215 @@
+"""Fused paged-attention decode kernel for TPU (Pallas).
+
+The XLA paged decode path (models/llama.py `_paged_slot_attention`)
+assembles each row's pages into a contiguous [B, kvh, n_read*ps, d]
+view (`ops/grouped_attention.gather_pages`) before the grouped einsum
+runs — an extra HBM round-trip (write + re-read of the gathered copy,
+plus the int8 scale siblings) that grows with live context, exactly
+the bytes the paging + int8 PRs fought to save.
+
+This kernel walks the block table *inside* the kernel instead: the
+table rides in as a scalar-prefetch operand, and each (row, kv-head,
+logical-page) program's K/V BlockSpec index map dereferences it —
+`(table[b, j], h, 0, 0)` — so one [page_size, d] tile streams from the
+physical pool straight into VMEM per grid step.  Fused in the same
+program, with zero intermediate HBM tensors:
+
+  - page gather (the BlockSpec indirection above);
+  - int8 dequant: the sibling per-(kv-head, position) f32 scale pages
+    are folded into the dots — key scales multiply the score columns
+    after the q.k contraction, value scales fold into the
+    probabilities before the PV contraction — so no float copy of the
+    cache ever exists, mirroring `quantized_grouped_attention`;
+  - grouped attention: the G = H/kvh query heads sharing a kv head ride
+    one program as a [G*S, d] q block (same unbroadcast-K/V property as
+    the grouped einsums and the flash kernels);
+  - online-softmax accumulation across the row's pages (f32 m/l/acc in
+    VMEM scratch, init at page 0, finalize at the last page);
+  - the s>1 speculative-verify window semantics: visibility arrives as
+    the SAME [B, 1, S, read_len] mask the XLA path computes (revealed
+    slots, per-query verify windows, sliding window, null-page-0
+    entries all pre-encoded), sliced per page by the BlockSpec.
+
+Off-TPU the kernel runs in interpreter mode (tests); serving defaults
+never select it off-TPU — the XLA gather path stays the production
+fallback and parity oracle (see `--decode-kernel` on the engine).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == 'tpu'
+
+
+def _decode_kernel_body(refs, *, scale: float, group: int, s: int,
+                        quant: bool) -> None:
+    """One grid step: fold page j of row b / kv-head h into the
+    running online-softmax state.  Grid is (B, kvh, n_read) with the
+    page axis innermost, so the o/scratch blocks stay VMEM-resident
+    across a row's whole page sweep (the Pallas revisiting rule)."""
+    if quant:
+        (_, q_ref, k_ref, v_ref, ks_ref, vs_ref, mask_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        (_, q_ref, k_ref, v_ref, mask_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)            # [G*S, d]
+    k = k_ref[0, 0].astype(jnp.float32)            # [ps, d]
+    v = v_ref[0, 0].astype(jnp.float32)            # [ps, d]
+    ps = k.shape[0]
+    sc = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [G*S, ps]
+    if quant:
+        # Key scales sit outside the contracted head_dim axis: they
+        # multiply the int-valued score columns, never a K tile copy.
+        sc = sc * ks_ref[0, 0][:, 0][None, :]
+    keep = mask_ref[0]                             # [S, ps]
+    if group > 1:
+        keep = jnp.broadcast_to(
+            keep[None], (group, s, ps)).reshape(group * s, ps)
+    sc = jnp.where(keep, sc, _NEG_INF)
+    m_prev = m_ref[:, :1]                          # [G*S, 1]
+    m_cur = jnp.max(sc, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(sc - m_new)                        # [G*S, ps]
+    correction = jnp.exp(m_prev - m_new)
+    l_new = correction * l_ref[:, :1] + jnp.sum(p, axis=1,
+                                                keepdims=True)
+    if quant:
+        # Value scales sit ON the contracted position axis of the PV
+        # dot: fold them into the probabilities, keep V int-valued.
+        p = p * vs_ref[0, 0][:, 0][None, :]
+    acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, page_key: jax.Array,
+                           page_value: jax.Array, table: jax.Array,
+                           mask: jax.Array, *, scale: float,
+                           probs_dtype: Any,
+                           key_scale: Optional[jax.Array] = None,
+                           value_scale: Optional[jax.Array] = None,
+                           interpret: Optional[bool] = None
+                           ) -> jax.Array:
+    """Decode attention straight from the paged KV pools.
+
+    q:          [B, H, S, d] float queries (S = 1 decode, S = k+1
+                speculative verify).
+    page_key /
+    page_value: [n_pages, kvh, page_size, d] physical pools (bf16/f32,
+                or int8 with the sibling scale pools below).
+    table:      [B, n_read] int32 — each row's block table truncated to
+                the pages under the bucketed read window.  Entries a
+                row never allocated point at the reserved null page 0;
+                `mask` hides their content.
+    mask:       bool [B, 1, S|1, n_read*page_size] — the visibility the
+                XLA path computes (revealed slots + verify windows +
+                sliding window + null-page masking), broadcast over kv
+                heads and the head group inside the kernel.
+    key_scale /
+    value_scale: [n_pages, kvh, page_size, 1] f32 absmax scale pools
+                for int8 K/V (both or neither).
+    interpret:  None = `not _on_tpu()` (interpreter mode off-TPU for
+                tests; compiled Mosaic on TPU).
+
+    Returns [B, S, H, d] in `probs_dtype` — same contract as
+    `grouped_attention` / `quantized_grouped_attention`.
+    """
+    b, h, s, d = q.shape
+    n_pages, kvh, ps, dp = page_key.shape
+    if h % kvh:
+        raise ValueError(
+            f'query heads ({h}) not divisible by kv heads ({kvh})')
+    if dp != d:
+        raise ValueError(
+            f'pool head_dim ({dp}) != query head_dim ({d})')
+    quant = key_scale is not None
+    if quant != (value_scale is not None):
+        raise ValueError('key_scale and value_scale must be passed '
+                         'together (int8 pools) or not at all')
+    group = h // kvh
+    gs = group * s
+    n_read = table.shape[1]
+    read_len = n_read * ps
+    # [B, H, S, d] -> [B, kvh, G*S, d]: the same head order the grouped
+    # einsum uses (head index = kv_head * G + group member).
+    qg = q.reshape(b, kvh, gs, d)
+    # [B, 1, S|1, read_len] -> [B, S, read_len] (kv-head axis is
+    # broadcast; a [B,1,1,L] decode mask broadcasts over S=1 queries).
+    mask3 = jnp.broadcast_to(mask[:, 0], (b, s, read_len))
+
+    def tile(index_map, block):
+        return pl.BlockSpec(block, index_map)
+
+    pool_spec = tile(lambda bi, hi, j, tbl: (tbl[bi, j], hi, 0, 0),
+                     (1, 1, ps, d))
+    in_specs = [
+        tile(lambda bi, hi, j, tbl: (bi, hi, 0, 0), (1, 1, gs, d)),
+        pool_spec,
+        pool_spec,
+    ]
+    args = [qg, page_key, page_value]
+    if quant:
+        scale_spec = tile(
+            lambda bi, hi, j, tbl: (tbl[bi, j], hi, 0, 0),
+            (1, 1, ps, 1))
+        in_specs += [scale_spec, scale_spec]
+        args += [key_scale, value_scale]
+    in_specs.append(tile(lambda bi, hi, j, tbl: (bi, 0, j),
+                         (1, s, ps)))
+    args.append(mask3)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, kvh, n_read),
+        in_specs=in_specs,
+        out_specs=tile(lambda bi, hi, j, tbl: (bi, hi, 0, 0),
+                       (1, 1, gs, d)),
+        scratch_shapes=[
+            pltpu.VMEM((gs, 128), jnp.float32),    # running max
+            pltpu.VMEM((gs, 128), jnp.float32),    # running denom
+            pltpu.VMEM((gs, d), jnp.float32),      # output acc
+        ],
+    )
+
+    def kernel(*refs):
+        _decode_kernel_body(refs, scale=scale, group=group, s=s,
+                            quant=quant)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, gs, d), probs_dtype),
+        interpret=(not _on_tpu()) if interpret is None else interpret,
+    )(table, *args)
+    # [B, kvh, G*S, d] -> [B, S, H, d] (grouped_attention's contract).
+    return out.reshape(b, kvh, group, s, d).transpose(
+        0, 3, 1, 2, 4).reshape(b, s, h, d)

@@ -490,9 +490,12 @@ def _fake_decode_engines(bench, monkeypatch):
         def __init__(self, model, n_slots=4, prefill_bucket=16,
                      model_overrides=None, param_dtype=None,
                      params=None, kv_cache_dtype='auto', page_size=0,
-                     **_kw):
+                     decode_kernel='auto', **_kw):
             self.kv_cache_dtype = kv_cache_dtype
             self.page_size = page_size
+            # Mirror the real resolution: 'auto' is XLA off-TPU.
+            self.decode_kernel = 'xla' if decode_kernel == 'auto' \
+                else decode_kernel
             self.max_seq_len = (model_overrides or {}).get(
                 'max_seq_len', 512)
             self.params = {'w': 0} if params is None else params
@@ -526,9 +529,21 @@ def _fake_decode_engines(bench, monkeypatch):
                 positions = 4 * (context if context is not None
                                  else self.max_seq_len)
             grouped = 2 * positions * per_pos  # layers * positions
+            # Paged XLA pays the gather round-trip on top; the fused
+            # kernel streams pool tiles directly (epilogue == 0).
+            epilogue = grouped if (self.page_size
+                                   and self.decode_kernel == 'xla') \
+                else 0.0
             return {'grouped_bytes': grouped,
                     'repeat_bytes': grouped * 16.0,
-                    'reduction': 16.0}
+                    'reduction': 16.0,
+                    'epilogue_bytes': epilogue,
+                    'total_bytes': grouped + epilogue}
+
+        def decode_kernel_info(self):
+            return {'path': self.decode_kernel,
+                    'page_size': self.page_size,
+                    'interpret': self.decode_kernel == 'fused'}
 
     monkeypatch.setattr(engine_mod, 'ContinuousBatchingEngine',
                         _FakeCBE)
@@ -554,7 +569,8 @@ def test_decode_emits_one_json_line_and_stderr_summary(
         assert key in parsed, key
     assert parsed['value'] == round(2304.0 / 1160.0, 2)  # 1.99
     assert set(parsed['arms']) == {'bf16', 'int8', 'paged',
-                                   'speculative', 'async'}
+                                   'speculative', 'async',
+                                   'fused_kernel'}
     assert parsed['arms']['int8']['kv_cache_dtype'] == 'int8'
     assert 'int8' in parsed['metric']
     # Ragged arm: contiguous reads 4 slots * the full 512 bucket;
@@ -564,19 +580,23 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     assert parsed['paged_read_reduction_vs_contiguous'] == \
         round(4 * 512 / 200, 2)  # 10.24
     assert parsed['paged_token_parity'] is True
-    # Nine engines: the five DeepSeek-geometry arms (incl. the
+    # Eleven engines: the five DeepSeek-geometry arms (incl. the
     # disabled-registry overhead arm) all serving the SAME weights,
     # then the gpt2 speculation pair (its own weights — plain
     # reference engine + speculating twin sharing them), then the
     # sync/async pipeline pair (its own wider-geometry weights,
-    # shared between the two modes).
+    # shared between the two modes), then the fused-kernel XLA/fused
+    # pair (speculation-geometry weights, shared across the pair).
     assert [b.kv_cache_dtype for b in built] == \
         ['auto', 'int8', 'auto', 'auto', 'auto', 'auto', 'auto',
-         'int8', 'int8']
-    assert [b.page_size for b in built] == [0, 0, 0, 8, 8, 0, 0, 8, 8]
+         'int8', 'int8', 'int8', 'int8']
+    assert [b.page_size for b in built] == \
+        [0, 0, 0, 8, 8, 0, 0, 8, 8, 8, 8]
     assert all(b.params is built[0].params for b in built[1:5])
     assert built[6].params is built[5].params
     assert built[8].params is built[7].params
+    assert built[10].params is built[9].params
+    assert [b.decode_kernel for b in built[9:]] == ['xla', 'fused']
     spec = parsed['arms']['speculative']
     assert spec['spec_k'] == 4
     assert spec['greedy_parity_vs_plain'] is True
@@ -603,13 +623,28 @@ def test_decode_emits_one_json_line_and_stderr_summary(
                 'device_wait_fraction_sync',
                 'device_wait_fraction_async'):
         assert key in ap, key
+    # Fused-kernel arm: deterministic fake => parity, epilogue model.
+    fk = parsed['arms']['fused_kernel']
+    assert fk['greedy_parity_vs_xla'] is True
+    assert parsed['fused_token_parity'] is True
+    assert fk['decode_kernel'] == {'path': 'fused', 'page_size': 8,
+                                   'interpret': True}
+    assert fk['epilogue_bytes_per_step_fused'] == 0.0
+    assert fk['epilogue_bytes_per_step_xla'] > 0.0
+    assert fk['read_bytes_per_step_fused'] < \
+        fk['read_bytes_per_step_xla']
+    assert parsed['fused_read_reduction_vs_xla'] == \
+        fk['read_reduction_fused_vs_xla'] > 1.0
     err = [l for l in captured.err.splitlines() if l.startswith('#')]
-    # dtype arms + ratio + paged + speculative + async + telemetry
-    assert len(err) == 7
-    assert 'fewer bytes/step' in err[-4]
-    assert 'token parity: True' in err[-3]  # the speculative line
-    assert 'steps/token' in err[-3]
-    assert 'device-wait fraction' in err[-2]  # the async line
+    # dtype arms + ratio + paged + speculative + async + fused-kernel
+    # + telemetry
+    assert len(err) == 8
+    assert 'fewer bytes/step' in err[-5]
+    assert 'token parity: True' in err[-4]  # the speculative line
+    assert 'steps/token' in err[-4]
+    assert 'device-wait fraction' in err[-3]  # the async line
+    assert 'token parity: True' in err[-3]
+    assert 'fused' in err[-2]               # the fused-kernel line
     assert 'token parity: True' in err[-2]
     assert 'telemetry' in err[-1]
 
@@ -715,6 +750,27 @@ def test_decode_smoke_async_pipeline_arm(decode_smoke_json):
     assert arm['tokens_per_sec_async'] >= \
         0.8 * arm['tokens_per_sec_sync'], arm
     assert arm['host_overlap_seconds'] > 0.0, arm
+
+
+def test_decode_smoke_fused_kernel_arm(decode_smoke_json):
+    """The fused paged-attention kernel's acceptance bar, proven on
+    the real engines in the same --smoke run: on the paged int8
+    spec-k=4 geometry the Pallas kernel (interpreter mode on CPU)
+    must stream bit-identically to the XLA gather path, report ZERO
+    gather-epilogue bytes, and strictly fewer total read bytes per
+    step."""
+    parsed = decode_smoke_json
+    arm = parsed['arms']['fused_kernel']
+    assert parsed['fused_token_parity'] is True
+    assert arm['greedy_parity_vs_xla'] is True
+    assert arm['decode_kernel'] == {'path': 'fused', 'page_size': 8,
+                                    'interpret': True}
+    assert arm['epilogue_bytes_per_step_fused'] == 0.0
+    assert arm['epilogue_bytes_per_step_xla'] > 0.0
+    assert arm['read_bytes_per_step_fused'] < \
+        arm['read_bytes_per_step_xla']
+    assert parsed['fused_read_reduction_vs_xla'] > 1.0
+    assert arm['tokens_per_sec_fused'] > 0
 
 
 def test_sleep_skip_when_spacing_would_burn_the_window(

@@ -237,6 +237,44 @@ class TestReadBytesScaling:
                 paged_eng._abstract_cache,
                 paged_eng.config.n_heads, 512, page_size=_PS)
 
+    def test_xla_epilogue_scales_with_window(self, paged_eng):
+        """The gather_pages round-trip (write + re-read of the
+        contiguous copies) is charged on the XLA path and scales with
+        the bucketed window, like the pool reads themselves."""
+        r512 = paged_eng.cache_read_bytes_per_step(context=512)
+        r4096 = paged_eng.cache_read_bytes_per_step(context=4096)
+        assert r512['epilogue_bytes'] > 0
+        assert r512['epilogue_bytes'] == pytest.approx(
+            r4096['epilogue_bytes'] / 8)
+        assert r512['total_bytes'] == pytest.approx(
+            r512['grouped_bytes'] + r512['epilogue_bytes'])
+
+    def test_xla_epilogue_charges_widest_row(self, paged_eng):
+        """gather_pages assembles EVERY slot at the shared bucketed
+        window (the widest row), so a ragged batch pays the same
+        epilogue as an all-wide batch — unlike the per-row pool
+        reads."""
+        ragged = paged_eng.cache_read_bytes_per_step(
+            row_contexts=[4096, 8])
+        full = paged_eng.cache_read_bytes_per_step(context=4096)
+        assert ragged['epilogue_bytes'] == pytest.approx(
+            full['epilogue_bytes'])
+        assert ragged['grouped_bytes'] < full['grouped_bytes']
+
+    def test_fused_kernel_has_zero_epilogue(self, paged_eng):
+        fused = paged_eng.cache_read_bytes_per_step(
+            context=4096, decode_kernel='fused')
+        xla = paged_eng.cache_read_bytes_per_step(context=4096)
+        assert fused['epilogue_bytes'] == 0.0
+        assert fused['grouped_bytes'] == xla['grouped_bytes']
+        assert fused['total_bytes'] == fused['grouped_bytes']
+        assert fused['total_bytes'] < xla['total_bytes']
+
+    def test_decode_kernel_validated(self, paged_eng):
+        with pytest.raises(ValueError, match='decode_kernel'):
+            paged_eng.cache_read_bytes_per_step(
+                context=512, decode_kernel='mosaic')
+
 
 class TestPageAllocator:
 

@@ -561,10 +561,68 @@ def test_pipeline_discipline_scoped_to_infer(tmp_path):
                            rule='pipeline-discipline'))
 
 
+# ---------------------------------------------------------------------
+# kernel-discipline
+# ---------------------------------------------------------------------
+
+_KERNEL_UNGATED = """
+    import jax
+    from jax.experimental import pallas as pl
+
+    def _on_tpu():
+        return jax.default_backend() == 'tpu'
+
+    def bad_missing(x):
+        return pl.pallas_call(lambda r, o: None,
+                              out_shape=x)(x)
+
+    def bad_hardcoded(x):
+        return pl.pallas_call(lambda r, o: None, out_shape=x,
+                              interpret=True)(x)
+"""
+
+_KERNEL_GATED = """
+    import jax
+    from jax.experimental import pallas as pl
+
+    def _on_tpu():
+        return jax.default_backend() == 'tpu'
+
+    def good_direct(x):
+        return pl.pallas_call(lambda r, o: None, out_shape=x,
+                              interpret=not _on_tpu())(x)
+
+    def good_default(x, interpret=None):
+        return pl.pallas_call(
+            lambda r, o: None, out_shape=x,
+            interpret=(not _on_tpu()) if interpret is None
+            else interpret)(x)
+"""
+
+
+def test_kernel_discipline_flags_ungated_pallas_call(tmp_path):
+    findings = _live(_lint(tmp_path, 'skypilot_tpu/ops/k.py',
+                           _KERNEL_UNGATED, rule='kernel-discipline'))
+    assert len(findings) == 2
+    assert all(f.symbol == 'pallas_call' for f in findings)
+    assert any('without interpret=' in f.message for f in findings)
+    assert any('does not consult _on_tpu' in f.message
+               for f in findings)
+
+
+def test_kernel_discipline_gated_calls_and_scope_are_clean(tmp_path):
+    assert not _live(_lint(tmp_path, 'skypilot_tpu/ops/k.py',
+                           _KERNEL_GATED, rule='kernel-discipline'))
+    # Outside ops/ the rule does not apply — tests and benches pin
+    # interpret explicitly to probe one mode.
+    assert not _live(_lint(tmp_path, 'tests/unit_tests/t.py',
+                           _KERNEL_UNGATED, rule='kernel-discipline'))
+
+
 def test_all_rule_families_are_registered():
     ids = {r.id for r in skylint.all_rules()}
     assert {'host-sync', 'retrace-hazard', 'lock-discipline',
             'thread-discipline', 'stdout-purity', 'metric-contract',
             'dtype-promotion', 'sleep-discipline',
             'net-timeout', 'trace-discipline',
-            'pipeline-discipline'} <= ids
+            'pipeline-discipline', 'kernel-discipline'} <= ids
