@@ -401,6 +401,15 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
     this shape); int8 storage multiplies that by
     2*576*2 / (2*576 + 2*4) ≈ 1.99x fewer bytes per position
     (quantized rows plus their f32 scales, vs bf16 rows)."""
+    # The sharded arm needs >= 4 virtual chips; the flag only works
+    # before the backend first initializes, so set it here (standalone
+    # runs — the test conftest already exposes 8).
+    if ('--xla_force_host_platform_device_count'
+            not in os.environ.get('XLA_FLAGS', '')):
+        os.environ['XLA_FLAGS'] = (
+            os.environ.get('XLA_FLAGS', '')
+            + ' --xla_force_host_platform_device_count=4')
+
     import jax
 
     # Same CPU pin as --quick: never touch the tunneled TPU backend.
@@ -411,6 +420,7 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
 
     from skypilot_tpu.infer import engine as engine_lib
     from skypilot_tpu.observability import metrics as metrics_lib
+    from skypilot_tpu.parallel import mesh as mesh_lib
 
     # stdout carries exactly one JSON line; the framework logger
     # defaults to stdout (sky_logging), so point it at stderr here —
@@ -825,6 +835,56 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
         'read_reduction_fused_vs_xla': round(fk_ratio, 2),
     }
 
+    # --- seventh arm: tensor-parallel sharded decode -----------------
+    # The same paged int8 spec-k geometry as the kernel arm, on a
+    # tensor=4 mesh: K/V/scale pools sharded on the kv-head axis
+    # (gpt2-tiny is MHA, 4 kv heads -> 1 per chip), block tables
+    # replicated, host allocator global.  Same seed as the 1-chip XLA
+    # engine, so the streams must be bit-identical — the parity assert
+    # rides the emitted JSON line.  On virtual CPU chips the per-chip
+    # throughput measures correctness-path overhead, not the TPU
+    # scaling; tokens/sec/chip at n_chips in {1, 4} is the headline
+    # shape dashboards track.
+    tp_n = 4
+    if len(jax.devices()) >= tp_n:
+        tp_mesh = mesh_lib.make_mesh(
+            mesh_lib.MeshConfig(data=1, fsdp=1, tensor=tp_n),
+            jax.devices()[:tp_n])
+        tp_eng = engine_lib.ContinuousBatchingEngine(
+            'gpt2-tiny', mesh=tp_mesh, n_slots=n_slots,
+            prefill_bucket=8, model_overrides=dict(sp_overrides),
+            param_dtype=jnp.float32, kv_cache_dtype='int8',
+            page_size=8, spec_k=sp_k,
+            registry=metrics_lib.Registry(), decode_kernel='auto')
+        tp_eng.generate(fk_prompts, sp_sampling)   # compile warmup
+        t0 = time.time()
+        tp_outs = tp_eng.generate(fk_prompts, sp_sampling)
+        tp_dt = time.time() - t0
+        tp_parity = [list(a) for a in tp_outs] == \
+            [list(a) for a in fk_xla_outs]
+        assert tp_parity, \
+            'tensor-parallel decode broke greedy parity vs 1 chip'
+        tp_tps = sum(len(o) for o in tp_outs) / max(tp_dt, 1e-9)
+        fk_tps = sum(len(o) for o in fk_xla_outs) / max(fk_xla_dt,
+                                                        1e-9)
+        sharded_arm = {
+            'n_chips': tp_n,
+            'page_size': 8,
+            'kv_cache_dtype': 'int8',
+            'spec_k': sp_k,
+            'sharding': tp_eng.sharding_info(),
+            'decode_kernel': tp_eng.decode_kernel_info(),
+            'greedy_parity_vs_1chip': tp_parity,
+            'tokens_per_sec_1chip': round(fk_tps, 1),
+            'tokens_per_sec_4chip': round(tp_tps, 1),
+            'tokens_per_sec_per_chip_1chip': round(fk_tps, 1),
+            'tokens_per_sec_per_chip_4chip': round(tp_tps / tp_n, 1),
+        }
+    else:                                          # pragma: no cover
+        tp_parity = None
+        sharded_arm = {'skipped': f'needs {tp_n} devices, have '
+                                  f'{len(jax.devices())}'}
+
     result = {
         'metric': 'decode int8-KV cache-read reduction (B=4 slots, '
                   'deepseek-v2-lite attention geometry)',
@@ -837,7 +897,8 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
                        f' MB/step',
         'arms': {'bf16': bf16_arm, 'int8': int8_arm,
                  'paged': paged_arm, 'speculative': spec_arm,
-                 'async': async_arm, 'fused_kernel': fused_arm},
+                 'async': async_arm, 'fused_kernel': fused_arm,
+                 'sharded': sharded_arm},
         'telemetry': telemetry,
         'paged_read_reduction_vs_contiguous': round(pg_ratio, 2),
         'paged_token_parity': pg_parity,
@@ -846,6 +907,7 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
         'async_token_parity': ap_parity,
         'fused_token_parity': fk_parity,
         'fused_read_reduction_vs_xla': round(fk_ratio, 2),
+        'sharded_token_parity': tp_parity,
         'async_device_wait_fraction_sync': round(ap_sync_frac, 6),
         'async_device_wait_fraction_async': round(ap_async_frac, 6),
         'n_heads': 16,
@@ -892,6 +954,17 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
           f'-> {fk_fused_reads["total_bytes"] / 1e6:.2f} MB fused '
           f'({fk_ratio:.2f}x), greedy token parity: {fk_parity}',
           file=sys.stderr)
+    if 'skipped' not in sharded_arm:
+        print(f'# decode [sharded]: paged-int8 spec-k={sp_k} on '
+              f'tensor={sharded_arm["n_chips"]} '
+              f'(pool {sharded_arm["sharding"]["pool_mode"]}, '
+              f'{sharded_arm["sharding"]["kvh_per_shard"]} kv '
+              f'head/chip); '
+              f'{sharded_arm["tokens_per_sec_per_chip_1chip"]:,.1f} '
+              f'tok/s/chip @ 1 chip -> '
+              f'{sharded_arm["tokens_per_sec_per_chip_4chip"]:,.1f} '
+              f'tok/s/chip @ {sharded_arm["n_chips"]}, greedy token '
+              f'parity: {tp_parity}', file=sys.stderr)
     print(f'# telemetry: prefix hit ratio '
           f'{telemetry["prefix_hit_ratio"]:.2f} '
           f'({telemetry["prefix_page_hits"]:.0f} hits / '

@@ -8,6 +8,7 @@ from skypilot_tpu.devtools.rules import dtype_promotion
 from skypilot_tpu.devtools.rules import host_sync
 from skypilot_tpu.devtools.rules import kernel_discipline
 from skypilot_tpu.devtools.rules import lock_discipline
+from skypilot_tpu.devtools.rules import mesh_axis_discipline
 from skypilot_tpu.devtools.rules import metric_contract
 from skypilot_tpu.devtools.rules import net_timeout
 from skypilot_tpu.devtools.rules import pipeline_discipline
@@ -20,6 +21,7 @@ ALL_RULES = (host_sync.RULES + retrace.RULES + lock_discipline.RULES
              + stdout_purity.RULES + metric_contract.RULES
              + dtype_promotion.RULES + sleep_discipline.RULES
              + net_timeout.RULES + trace_discipline.RULES
-             + pipeline_discipline.RULES + kernel_discipline.RULES)
+             + pipeline_discipline.RULES + kernel_discipline.RULES
+             + mesh_axis_discipline.RULES)
 
 __all__ = ['ALL_RULES']

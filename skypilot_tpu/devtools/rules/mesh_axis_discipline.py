@@ -1,0 +1,144 @@
+"""mesh-axis-discipline: axis-name literals come from parallel/mesh.py.
+
+The mesh axis names (``AXIS_DATA`` ... ``AXIS_TENSOR``, assembled into
+``AXES``) are single-sourced in ``skypilot_tpu/parallel/mesh.py``.  An
+axis-name string at a collective / ``PartitionSpec`` / ``shard_map``
+call site that is NOT one of those constants' values — a stray
+``'tp'``, ``'model'``, or a typo like ``'tensro'`` — does not error:
+GSPMD silently replicates instead of sharding (PartitionSpec) or the
+collective binds to a nonexistent axis and fails far from the typo.
+
+Checked call sites in ops//models//infer/:
+
+  - collectives (``psum``/``psum_scatter``/``all_gather``/
+    ``ppermute``/``pbroadcast``/``all_to_all``/``axis_index``/
+    ``axis_size``/``pmean``/``pmax``/``pmin``/``pcast``): string
+    literals in positional args / ``axis_name=`` (tuples included);
+  - ``PartitionSpec`` / ``P``: every string literal in the spec,
+    including inside tuples like ``P(('data', 'fsdp'))``;
+  - ``shard_map`` / ``shard_map_compat`` / ``_shard_map``: string
+    literals inside the ``axis_names=`` kwarg.
+
+Non-literal axis arguments (variables, attribute refs like
+``mesh_lib.AXIS_TENSOR``) are never flagged — routing through the
+constants is exactly the discipline this rule enforces.
+
+The allowed set is AST-parsed from parallel/mesh.py's source (this
+module must stay importable without jax, so it cannot import mesh.py);
+if that file ever stops defining the constants the rule degrades to
+no-findings — the fixture tests in test_skylint.py catch that.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Iterable, List, Optional, Set
+
+from skypilot_tpu.devtools import skylint
+
+RULE_ID = 'mesh-axis-discipline'
+
+_COLLECTIVES = {'psum', 'psum_scatter', 'all_gather', 'ppermute',
+                'pbroadcast', 'all_to_all', 'axis_index', 'axis_size',
+                'pmean', 'pmax', 'pmin', 'pcast'}
+_SPEC_NAMES = {'PartitionSpec', 'P'}
+_SHARD_MAPS = {'shard_map', 'shard_map_compat', '_shard_map'}
+
+_allowed_cache: Optional[frozenset] = None
+
+
+def _allowed_axes() -> frozenset:
+    """Axis-name values of the module-level ``AXIS_* = '<name>'``
+    assignments in parallel/mesh.py, parsed from source."""
+    global _allowed_cache
+    if _allowed_cache is not None:
+        return _allowed_cache
+    axes: Set[str] = set()
+    mesh_py = (pathlib.Path(__file__).resolve().parents[2]
+               / 'parallel' / 'mesh.py')
+    try:
+        tree = ast.parse(mesh_py.read_text())
+    except (OSError, SyntaxError):
+        _allowed_cache = frozenset()
+        return _allowed_cache
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Name)
+                    and tgt.id.startswith('AXIS_')
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                axes.add(node.value.value)
+    _allowed_cache = frozenset(axes)
+    return _allowed_cache
+
+
+def in_scope(posix: str) -> bool:
+    return any(f'/{pkg}/' in posix or posix.startswith(f'{pkg}/')
+               for pkg in ('ops', 'models', 'infer'))
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _string_literals(expr: ast.AST) -> Iterable[ast.Constant]:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub
+
+
+def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
+    allowed = _allowed_axes()
+    if not allowed:        # mesh.py constants missing: degrade open
+        return []
+    findings: List[skylint.Finding] = []
+
+    def _flag(const: ast.Constant, where: str) -> None:
+        if const.value in allowed:
+            return
+        findings.append(ctx.finding(
+            RULE_ID, const, const.value,
+            f'axis name {const.value!r} at a {where} call site is not '
+            f'one of the parallel/mesh.py axis constants '
+            f'({", ".join(sorted(allowed))}) — a typo here silently '
+            f'replicates instead of sharding; use mesh.AXIS_* (or its '
+            f'exact value)'))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name in _SPEC_NAMES:
+            for arg in node.args:
+                for const in _string_literals(arg):
+                    _flag(const, 'PartitionSpec')
+        elif name in _COLLECTIVES:
+            for arg in node.args:
+                for const in _string_literals(arg):
+                    _flag(const, f'{name} collective')
+            for kw in node.keywords:
+                if kw.arg == 'axis_name':
+                    for const in _string_literals(kw.value):
+                        _flag(const, f'{name} collective')
+        elif name in _SHARD_MAPS:
+            for kw in node.keywords:
+                if kw.arg == 'axis_names':
+                    for const in _string_literals(kw.value):
+                        _flag(const, 'shard_map axis_names')
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='axis-name literals at psum/PartitionSpec/shard_map call '
+            'sites in ops//models//infer/ must be parallel/mesh.py '
+            'axis constants',
+    check=check,
+    scope=in_scope),)

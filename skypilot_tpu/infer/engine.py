@@ -272,16 +272,107 @@ def maybe_dequantize_params(params: Any, dtype: Any) -> Any:
         params, is_leaf=_is_quant_leaf)
 
 
-def _cache_sharding(mesh, leaf) -> NamedSharding:
+def paged_pool_mode(tensor: int, kvh: int, n_pages: int,
+                    page_size: int) -> str:
+    """How the paged K/V/scale pools shard over a `tensor=N` mesh —
+    the single source both `_cache_sharding` (which builds the specs)
+    and `sharding_info()` (which reports them) derive from.
+
+      kv_heads — the fast path: pools split on the kv-head axis,
+                 matching the attention head sharding, so the fused
+                 kernel's per-shard block-table walk needs no
+                 collective (head order is kv_head-major).
+      pages    — kvh doesn't divide (DeepSeek's absorbed-latent pool
+                 has kvh == 1): split the page axis; gathers become
+                 GSPMD all-gathers.
+      sequence — neither divides (n_pages = B*pps + 1 is odd by
+                 construction): split within-page positions.
+      replicated — nothing divides; every chip holds the full pool.
+    """
+    if tensor <= 1:
+        return 'unsharded'
+    if kvh and kvh % tensor == 0:
+        return 'kv_heads'
+    if n_pages and n_pages % tensor == 0:
+        return 'pages'
+    if page_size and page_size % tensor == 0:
+        return 'sequence'
+    return 'replicated'
+
+
+def _cache_sharding(mesh, leaf, n_pages: int = 0) -> NamedSharding:
     """KV caches shard their kv-heads dim over `tensor` (matching the
-    attention head sharding); scalars/cursors replicate.  Leaf shapes:
-    [B, kvh, S, hd] unscanned, [L, B, kvh, S, hd] scanned."""
-    tensor = mesh.shape.get('tensor', 1)
-    if leaf.ndim == 4 and leaf.shape[1] % max(tensor, 1) == 0:
+    attention head sharding); scalars/cursors/block tables replicate.
+    Leaf shapes: [B, kvh, S, hd] contiguous / [n_pages, kvh, ps, hd]
+    paged pool unscanned, [L, B, kvh, S, hd] / [L, n_pages, kvh, ps,
+    hd] scanned.  Paged pool leaves (recognized by `n_pages` on the
+    leading pool axis) fall back to page- then sequence-axis sharding
+    when the kv-head axis doesn't divide (paged_pool_mode) — the
+    DeepSeek latent kvh==1 geometry stays sharded instead of silently
+    replicating the whole pool on every chip."""
+    tensor = max(mesh.shape.get('tensor', 1), 1)
+    if leaf.ndim == 4 and leaf.shape[1] % tensor == 0:
         return NamedSharding(mesh, P(None, 'tensor', None, None))
-    if leaf.ndim == 5 and leaf.shape[2] % max(tensor, 1) == 0:
+    if leaf.ndim == 5 and leaf.shape[2] % tensor == 0:
         return NamedSharding(mesh, P(None, None, 'tensor', None, None))
+    if n_pages and leaf.ndim == 4 and leaf.shape[0] == n_pages:
+        mode = paged_pool_mode(tensor, leaf.shape[1], n_pages,
+                               leaf.shape[2])
+        if mode == 'pages':
+            return NamedSharding(mesh, P('tensor', None, None, None))
+        if mode == 'sequence':
+            return NamedSharding(mesh, P(None, None, 'tensor', None))
+    if n_pages and leaf.ndim == 5 and leaf.shape[1] == n_pages:
+        mode = paged_pool_mode(tensor, leaf.shape[2], n_pages,
+                               leaf.shape[3])
+        if mode == 'pages':
+            return NamedSharding(mesh,
+                                 P(None, 'tensor', None, None, None))
+        if mode == 'sequence':
+            return NamedSharding(mesh,
+                                 P(None, None, None, 'tensor', None))
     return NamedSharding(mesh, P())
+
+
+def resolve_decode_kernel(decode_kernel: str, *, on_tpu: bool,
+                          page_size: int, tensor: int = 1,
+                          pool_kvh: Optional[int] = None
+                          ) -> Tuple[str, bool]:
+    """Resolve the --decode-kernel request to (kernel, interpret) —
+    the full table, deterministic, validated at startup so a bad
+    combination is a ValueError here and never a Pallas partitioning
+    crash mid-serve.
+
+    'auto' picks the fused Pallas kernel only where it is actually
+    lowered: on TPU, paged cache, and — under a tensor>1 mesh — only
+    when the pool kv-head axis divides (the shard_map lowering walks
+    per-shard kv-heads; the kvh==1 latent fallback shards pages/
+    positions instead, which only the XLA gather path handles).
+    Off-TPU the fused kernel runs in the orders-of-magnitude-slower
+    interpreter, so only an explicit 'fused' (tests, parity benches)
+    ever selects it there."""
+    if decode_kernel not in ('auto', 'fused', 'xla'):
+        raise ValueError(
+            f"decode_kernel must be 'auto', 'fused' or 'xla', "
+            f'got {decode_kernel!r}')
+    sharded_ok = (tensor <= 1
+                  or (pool_kvh or 0) % tensor == 0)
+    if decode_kernel == 'auto':
+        decode_kernel = 'fused' if (on_tpu and page_size
+                                    and sharded_ok) else 'xla'
+    elif decode_kernel == 'fused':
+        if not page_size:
+            raise ValueError(
+                "decode_kernel='fused' requires a paged KV cache "
+                '(page_size > 0)')
+        if not sharded_ok:
+            raise ValueError(
+                f"decode_kernel='fused' needs the pool kv-head axis "
+                f'({pool_kvh}) divisible by the tensor mesh axis '
+                f'({tensor}); this geometry falls back to page-/'
+                "sequence-sharded pools, which only "
+                "decode_kernel='xla' supports")
+    return decode_kernel, (decode_kernel == 'fused' and not on_tpu)
 
 
 def decode_cache_read_bytes(abstract_cache: Any, n_heads: int,
@@ -753,6 +844,16 @@ class _ServingMetrics:
             'Decode steps dispatched but not yet consumed (0 = idle '
             'or synchronous loop; the async pipeline is depth-1 '
             'double buffering).')
+        self.mesh_devices = r.gauge(
+            'skytpu_mesh_devices',
+            'Devices in the engine mesh (1 = unsharded single-chip '
+            'replica).')
+        self.decode_collective_seconds = r.histogram(
+            'skytpu_decode_collective_seconds',
+            'Host wall seconds blocked on a sharded (mesh devices > '
+            '1) decode step\'s results — an upper bound on the '
+            'step\'s collective + compute time; 0 series on '
+            'single-device engines.')
         self.pages_used_peak = r.gauge(
             'skytpu_kv_pages_used_peak',
             'High-watermark of KV pages in use since engine start '
@@ -918,23 +1019,19 @@ class ContinuousBatchingEngine:
         self.page_size = self._eng.page_size
         self.n_pages = self._eng.n_pages
 
-        # Paged decode-attention implementation (--decode-kernel).
-        # 'auto' resolves ON TPU to the fused Pallas kernel
-        # (ops/paged_attention — zero gather round-trip) and OFF TPU to
-        # the XLA gather path: the fused kernel off-TPU runs in the
-        # orders-of-magnitude-slower interpreter, so only an explicit
-        # 'fused' (tests, parity benches) ever selects it there.
-        on_tpu = jax.default_backend() == 'tpu'
-        if decode_kernel == 'auto':
-            decode_kernel = 'fused' if (on_tpu and self.page_size) \
-                else 'xla'
-        if decode_kernel == 'fused' and not self.page_size:
-            raise ValueError(
-                "decode_kernel='fused' requires a paged KV cache "
-                '(page_size > 0)')
-        self.decode_kernel = decode_kernel
-        self.decode_kernel_interpret = (decode_kernel == 'fused'
-                                        and not on_tpu)
+        # Paged decode-attention implementation (--decode-kernel) —
+        # the full resolution/validation table lives in
+        # resolve_decode_kernel (startup ValueError, never a Pallas
+        # partitioning crash mid-serve).
+        self.pool_kvh = self._eng.pool_kvh
+        tensor = max(mesh.shape.get('tensor', 1), 1) \
+            if mesh is not None else 1
+        self.decode_kernel, self.decode_kernel_interpret = \
+            resolve_decode_kernel(
+                decode_kernel,
+                on_tpu=jax.default_backend() == 'tpu',
+                page_size=self.page_size, tensor=tensor,
+                pool_kvh=self.pool_kvh)
 
         # Batch-1 prefill cache template.
         rng = jax.random.PRNGKey(seed)
@@ -1218,6 +1315,9 @@ class ContinuousBatchingEngine:
         self.registry = (registry if registry is not None
                          else metrics_lib.get_registry())
         self._met = _ServingMetrics(self.registry)
+        self._mesh_devices = (mesh.devices.size if mesh is not None
+                              else 1)
+        self._met.mesh_devices.set(self._mesh_devices)
         if self.spec_k:
             # Spec series registered only on speculating engines: a
             # plain replica's /metrics scrape must not advertise them.
@@ -1286,6 +1386,11 @@ class ContinuousBatchingEngine:
             page_size=self.page_size,
             interpret=self.decode_kernel_interpret,
         )
+
+    def sharding_info(self) -> Dict[str, Any]:
+        """`sharding` block for /health?verbose=1 — see
+        InferenceEngine.sharding_info."""
+        return self._eng.sharding_info()
 
     @property
     def params(self):
@@ -2575,6 +2680,8 @@ class ContinuousBatchingEngine:
                 m.dispatch_seconds.observe(dispatch_s)
         if device_wait_s is not None:
             m.device_wait_seconds.observe(device_wait_s)
+            if self._mesh_devices > 1:
+                m.decode_collective_seconds.observe(device_wait_s)
         if host_overlap_s is not None:
             m.host_overlap_seconds.observe(host_overlap_s)
         if self._alloc is not None:
@@ -2788,7 +2895,8 @@ class InferenceEngine:
                 sharding_lib.params_to_shardings(mesh,
                                                  abstract['params']))
             cache_shardings = jax.tree.map(
-                functools.partial(_cache_sharding, mesh),
+                functools.partial(_cache_sharding, mesh,
+                                  n_pages=self.n_pages),
                 abstract['cache'])
         else:
             param_shardings = cache_shardings = None
@@ -2797,6 +2905,26 @@ class InferenceEngine:
         self._abstract_cache = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
             sharding_lib.unbox(abstract['cache']))
+        # Pool kv-head count, read off the abstract cache (NOT the
+        # config: DeepSeek's absorbed-latent paged pool is kvh == 1
+        # regardless of n_heads) — drives decode-kernel resolution and
+        # the /health sharding block.
+        self.pool_kvh = 0
+        for leaf in jax.tree.leaves(self._abstract_cache):
+            if self.n_pages and leaf.ndim == 4 \
+                    and leaf.shape[0] == self.n_pages:
+                self.pool_kvh = leaf.shape[1]
+                break
+            if self.n_pages and leaf.ndim == 5 \
+                    and leaf.shape[1] == self.n_pages:
+                self.pool_kvh = leaf.shape[2]
+                break
+            if not self.n_pages and leaf.ndim == 4:
+                self.pool_kvh = leaf.shape[1]
+                break
+            if not self.n_pages and leaf.ndim == 5:
+                self.pool_kvh = leaf.shape[2]
+                break
         already_quantized = False
         self.loaded_real_weights = True
         if params is not None:
@@ -2916,6 +3044,8 @@ class InferenceEngine:
         self.registry = (registry if registry is not None
                          else metrics_lib.get_registry())
         self._met = _ServingMetrics(self.registry)
+        self._met.mesh_devices.set(mesh.devices.size
+                                   if mesh is not None else 1)
         self.traces = _trace_store_from_env()
         # Contiguous decode streams every cache position of the row;
         # precompute bytes-per-position once so the per-step estimate
@@ -3055,6 +3185,30 @@ class InferenceEngine:
                 decode_kernel=decode_kernel)
         return decode_cache_read_bytes(self._abstract_cache,
                                        self.config.n_heads, context)
+
+    def sharding_info(self) -> Dict[str, Any]:
+        """`sharding` block for /health?verbose=1: mesh geometry plus
+        how the KV pool actually sharded — `pool_mode` is the
+        paged_pool_mode ladder outcome, `fallback` flags the non-fast
+        paths (page-/sequence-sharded or replicated pools, i.e.
+        anything but the kv-head split the fused kernel lowers)."""
+        mesh = self.mesh
+        tensor = max(mesh.shape.get('tensor', 1), 1) \
+            if mesh is not None else 1
+        mode = paged_pool_mode(tensor, self.pool_kvh,
+                               self.n_pages if self.page_size else 0,
+                               self.page_size)
+        return dict(
+            mesh_devices=(mesh.devices.size if mesh is not None
+                          else 1),
+            axes=({a: int(s) for a, s in mesh.shape.items() if s > 1}
+                  if mesh is not None else {}),
+            pool_mode=mode,
+            pool_kvh=self.pool_kvh,
+            kvh_per_shard=(self.pool_kvh // tensor
+                           if mode == 'kv_heads' else self.pool_kvh),
+            fallback=mode in ('pages', 'sequence', 'replicated'),
+        )
 
     # -- generation --------------------------------------------------------
     def publish_memory_watermarks(self) -> None:

@@ -23,6 +23,12 @@ pages, which is exactly what the chain hash certifies.
 
 Pure host-side Python; no jax imports.  Thread-unsafe by design: the
 engine calls it only from its single scheduler thread.
+
+Tensor parallelism never reaches this layer: under a `tensor=N` mesh
+the engine shards the device pools on the KV-HEAD axis (every chip
+holds page i's slice of its local heads), so page ids, refcounts,
+prefix chains, and block tables stay GLOBAL — one allocator, one
+replicated block table, N pool shards (engine._cache_sharding).
 """
 from __future__ import annotations
 

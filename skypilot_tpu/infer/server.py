@@ -358,6 +358,12 @@ class InferenceServer:
             # (fused Pallas vs XLA gather), page geometry, and whether
             # the kernel runs in interpreter mode (off-TPU tests only).
             detail['decode_kernel'] = dk()
+        sh = getattr(eng, 'sharding_info', None)
+        if sh is not None:
+            # Tensor-parallel geometry: mesh axis sizes, how the KV
+            # pool sharded (kv_heads fast path vs page-/sequence-
+            # sharded fallback), and kv-heads per shard.
+            detail['sharding'] = sh()
         return detail
 
     def _fail_replica(self, error: BaseException) -> None:
@@ -1049,7 +1055,15 @@ def main() -> None:
                         help='trainer Orbax checkpoint to serve '
                              '(bucket-mounted path)')
     parser.add_argument('--mesh', default=None,
-                        help="shard over local devices, e.g. 'tensor=4'")
+                        help="shard over local devices, e.g. "
+                             "'tensor=4': params AND the paged KV "
+                             'pool split on the kv-head axis (page-/'
+                             'sequence-sharded fallback when kv-heads '
+                             "don't divide, e.g. DeepSeek MLA); "
+                             'composes with --page-size/'
+                             '--kv-cache-dtype/--spec-k/'
+                             '--decode-kernel. Greedy output is '
+                             'bit-identical to unsharded serving.')
     parser.add_argument('--no-continuous', dest='continuous',
                         action='store_false', default=True,
                         help='Request-level batching instead of '

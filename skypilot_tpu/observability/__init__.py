@@ -76,6 +76,8 @@ METRIC_CONTRACT = frozenset({
     'skytpu_step_device_wait_seconds',    # scheduler blocked on step results
     'skytpu_step_host_overlap_seconds',   # host work hidden behind device step
     'skytpu_pipeline_depth',              # in-flight decode steps (async: 0/1)
+    'skytpu_mesh_devices',                # devices in the engine mesh (1 = unsharded)
+    'skytpu_decode_collective_seconds',   # sharded-step wait (collectives bound)
     'skytpu_kv_pages_used_peak',          # page-pool high-watermark
     'skytpu_device_memory_peak_bytes',    # device allocator high-watermark
     # infer/engine.py — SLO accounting (targets via SKYTPU_SLO_TTFT_S /

@@ -43,7 +43,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from skypilot_tpu.parallel import mesh as mesh_lib
+
 _NEG_INF = -1e30
+_TENSOR_AXIS = mesh_lib.AXIS_TENSOR
 
 
 def _on_tpu() -> bool:
@@ -111,6 +114,21 @@ def _decode_kernel_body(refs, *, scale: float, group: int, s: int,
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
 
+def _in_manual_region(axis_name: str) -> bool:
+    """True when already inside a shard_map manual over `axis_name`
+    (e.g. a re-entrant trace) — the inputs are then local shards and
+    wrapping again would double-shard."""
+    try:
+        size_fn = getattr(jax.lax, 'axis_size', None)
+        if size_fn is not None:
+            size_fn(axis_name)
+        else:
+            jax.lax.psum(1, axis_name)
+        return True
+    except (NameError, KeyError, ValueError):
+        return False
+
+
 def paged_decode_attention(q: jax.Array, page_key: jax.Array,
                            page_value: jax.Array, table: jax.Array,
                            mask: jax.Array, *, scale: float,
@@ -120,6 +138,84 @@ def paged_decode_attention(q: jax.Array, page_key: jax.Array,
                            interpret: Optional[bool] = None
                            ) -> jax.Array:
     """Decode attention straight from the paged KV pools.
+
+    Under an ambient mesh with `tensor > 1` (the engine's decode step
+    traces inside `with mesh:`), the kernel self-lowers through
+    shard_map manual over the tensor axis: each chip walks the block
+    table over its LOCAL kv-head shard of the pools — q's head axis
+    splits into the same contiguous kv-head-major chunks (head index =
+    kv_head * G + member, so H-shards and kvh-shards align exactly),
+    the replicated table/mask ride in whole, and the [B, S, H, d]
+    output stays head-sharded for the downstream o_proj row-parallel
+    psum (the same collective the MLP already pays).  No collective
+    runs inside the kernel: softmax is per-head.  See
+    `_paged_decode_attention_impl` for the single-shard contract.
+    """
+    mesh = None
+    if not _in_manual_region(_TENSOR_AXIS):
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        mesh = sharding_lib.ambient_physical_mesh()
+    tensor = mesh.shape.get(_TENSOR_AXIS, 1) if mesh is not None else 1
+    if tensor <= 1:
+        return _paged_decode_attention_impl(
+            q, page_key, page_value, table, mask, scale=scale,
+            probs_dtype=probs_dtype, key_scale=key_scale,
+            value_scale=value_scale, interpret=interpret)
+    kvh = page_key.shape[1]
+    if kvh % tensor:
+        # Startup validation (engine.resolve_decode_kernel) refuses
+        # this combination; raising here too turns any path that slips
+        # through into a diagnosable error instead of a Pallas
+        # partitioning crash.
+        raise ValueError(
+            f'fused paged decode under tensor={tensor} needs the pool '
+            f'kv-head axis ({kvh}) divisible by it; this geometry '
+            "(DeepSeek latent kvh==1) must use decode_kernel='xla' "
+            'over page-/sequence-sharded pools')
+    from jax.sharding import PartitionSpec as P
+
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    quant = key_scale is not None
+    head_spec = P(None, _TENSOR_AXIS, None, None)
+    in_specs = [head_spec, head_spec, head_spec]   # q + K/V pools
+    if quant:
+        in_specs += [head_spec, head_spec]         # scale pools
+    in_specs += [P(), P()]                         # table, mask
+    out_spec = P(None, None, _TENSOR_AXIS, None)   # [B, S, H, d]
+
+    def _shard(q_, pk, pv, *rest):
+        if quant:
+            ks, vs, tbl, msk = rest
+        else:
+            ks = vs = None
+            tbl, msk = rest
+        return _paged_decode_attention_impl(
+            q_, pk, pv, tbl, msk, scale=scale,
+            probs_dtype=probs_dtype, key_scale=ks, value_scale=vs,
+            interpret=interpret)
+
+    args = [q, page_key, page_value]
+    if quant:
+        args += [key_scale, value_scale]
+    args += [table, mask]
+    wrapped = sharding_lib.shard_map_compat(
+        _shard, mesh=mesh, in_specs=tuple(in_specs),
+        out_specs=out_spec, axis_names=frozenset({_TENSOR_AXIS}))
+    return wrapped(*args)
+
+
+def _paged_decode_attention_impl(q: jax.Array, page_key: jax.Array,
+                                 page_value: jax.Array,
+                                 table: jax.Array,
+                                 mask: jax.Array, *, scale: float,
+                                 probs_dtype: Any,
+                                 key_scale: Optional[jax.Array] = None,
+                                 value_scale: Optional[jax.Array]
+                                 = None,
+                                 interpret: Optional[bool] = None
+                                 ) -> jax.Array:
+    """Single-shard pallas_call: decode attention over (a local shard
+    of) the paged KV pools.
 
     q:          [B, H, S, d] float queries (S = 1 decode, S = k+1
                 speculative verify).

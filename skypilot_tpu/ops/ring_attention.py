@@ -386,6 +386,13 @@ def context_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                   fa.DEFAULT_BLOCK_Q,
                                   fa.DEFAULT_BLOCK_KV, window)
     spec = P(None, None, axis_name, None)
+    # Deliberately jax.shard_map (not the compat shim): on older jax
+    # the experimental partial-manual fallback compiles here but then
+    # dies inside GSPMD ("PartitionId ... UNIMPLEMENTED", or a hard
+    # XLA abort for ulysses) whenever the auto complement has
+    # nontrivial axes (data/tensor > 1), which this training path
+    # always has.  An AttributeError at trace time is diagnosable; a
+    # backend abort kills the process.
     wrapped = jax.shard_map(
         functools.partial(fn, axis_name=axis_name, causal=causal,
                           window=window),

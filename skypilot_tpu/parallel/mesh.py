@@ -29,9 +29,22 @@ from skypilot_tpu import sky_logging
 
 logger = sky_logging.init_logger(__name__)
 
+# Single-source axis-name constants.  Every axis-name string literal at
+# a psum/all_gather/shard_map/PartitionSpec call site in ops//models//
+# infer/ must be one of these (enforced by the skylint
+# `mesh-axis-discipline` rule) — a stray 'tp'/'model' typo silently
+# replicates instead of sharding.
+AXIS_DATA = 'data'
+AXIS_FSDP = 'fsdp'
+AXIS_EXPERT = 'expert'
+AXIS_PIPE = 'pipe'
+AXIS_CONTEXT = 'context'
+AXIS_TENSOR = 'tensor'
+
 # Canonical axis order: fastest-varying (last) = most-communicating, so
 # neighboring devices (ICI) carry tensor/context traffic.
-AXES = ('data', 'fsdp', 'expert', 'pipe', 'context', 'tensor')
+AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_PIPE, AXIS_CONTEXT,
+        AXIS_TENSOR)
 
 
 @dataclasses.dataclass(frozen=True)

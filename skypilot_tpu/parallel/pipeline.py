@@ -45,19 +45,13 @@ def _spec_leading(axis_name: str):
 
 def _shard_map(f, *, mesh: Mesh, in_specs, out_specs,
                axis_names: frozenset):
-    """jax.shard_map with partially-manual axes, with a fallback for
-    older jax: the experimental shard_map spells the same thing as
-    `auto=` (the complement set) and has no VMA type system, so
-    check_rep is disabled (the replicated->varying casts below are
-    no-ops there)."""
-    new = getattr(jax, 'shard_map', None)
-    if new is not None:
-        return new(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   axis_names=axis_names)
-    from jax.experimental.shard_map import shard_map as old
-    auto = frozenset(mesh.axis_names) - frozenset(axis_names)
-    return old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-               auto=auto, check_rep=False)
+    """jax.shard_map with partially-manual axes; see
+    sharding.shard_map_compat for the older-jax fallback (no VMA type
+    system there, so the replicated->varying casts below are no-ops)."""
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    return sharding_lib.shard_map_compat(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        axis_names=axis_names)
 
 
 def _cast_varying(x, axis_name: str):

@@ -79,19 +79,63 @@ def tree_to_shardings(mesh: Mesh, logical_tree: Any,
             isinstance(a, (str, type(None))) for a in x))
 
 
+def spec_for_shape(mesh: Mesh, spec: P, shape: Sequence[int]) -> P:
+    """Drop mesh axes that don't divide their dimension — a geometry
+    too small for the mesh (llama-tiny's single kv head under
+    `tensor=4`) replicates that dim instead of failing placement.
+    This is the param-side twin of `paged_pool_mode`'s fallback
+    ladder: the rules describe the *preferred* layout, the shape
+    decides what is actually partitionable."""
+    out = []
+    for i, axis in enumerate(spec):
+        if axis is not None and i < len(shape):
+            flat = axis if isinstance(axis, tuple) else (axis,)
+            size = 1
+            for a in flat:
+                size *= mesh.shape[a]
+            if shape[i] % size != 0:
+                axis = None
+        out.append(axis)
+    return P(*out)
+
+
 def params_to_shardings(mesh: Mesh, params: Any,
                         rules: Optional[Dict[str, Any]] = None) -> Any:
     """Shardings for a flax param tree that used nn.with_partitioning
-    (leaves are nn.Partitioned) — unannotated leaves are replicated."""
+    (leaves are nn.Partitioned) — unannotated leaves are replicated,
+    and so is any dim whose size the ruled mesh axes don't divide."""
     import flax.linen as nn
 
     def _leaf(leaf):
         if isinstance(leaf, nn.Partitioned):
-            return NamedSharding(mesh, logical_to_spec(leaf.names, rules))
+            spec = logical_to_spec(leaf.names, rules)
+            value = leaf.value
+            if hasattr(value, 'shape'):
+                spec = spec_for_shape(mesh, spec, value.shape)
+            return NamedSharding(mesh, spec)
         return NamedSharding(mesh, P())
 
     return jax.tree.map(_leaf, params,
                         is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def shard_map_compat(f, *, mesh: Mesh, in_specs, out_specs,
+                     axis_names: frozenset):
+    """`jax.shard_map` manual over `axis_names` only (other mesh axes
+    stay compiler-partitioned), with a fallback for older jax where the
+    experimental shard_map spells partial-manual as `auto=` (the
+    complement set) and has no VMA type system, so check_rep is
+    disabled.  Single home for the compat dance — pipeline stages, ring
+    attention, and the sharded paged-decode lowering all route through
+    here."""
+    new = getattr(jax, 'shard_map', None)
+    if new is not None:
+        return new(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   axis_names=axis_names)
+    from jax.experimental.shard_map import shard_map as old
+    auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               auto=auto, check_rep=False)
 
 
 def ambient_physical_mesh() -> Optional[Mesh]:

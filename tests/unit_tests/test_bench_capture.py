@@ -493,6 +493,7 @@ def _fake_decode_engines(bench, monkeypatch):
                      decode_kernel='auto', **_kw):
             self.kv_cache_dtype = kv_cache_dtype
             self.page_size = page_size
+            self.mesh = _kw.get('mesh')
             # Mirror the real resolution: 'auto' is XLA off-TPU.
             self.decode_kernel = 'xla' if decode_kernel == 'auto' \
                 else decode_kernel
@@ -545,6 +546,17 @@ def _fake_decode_engines(bench, monkeypatch):
                     'page_size': self.page_size,
                     'interpret': self.decode_kernel == 'fused'}
 
+        def sharding_info(self):
+            # Mirror the real engine's /health sharding block for the
+            # tensor=4 arm (gpt2-tiny is MHA: 4 kv heads, 1/chip).
+            n = self.mesh.devices.size if self.mesh is not None else 1
+            return {'mesh_devices': n,
+                    'axes': {'tensor': n} if n > 1 else {},
+                    'pool_mode': 'kv_heads' if n > 1 else 'unsharded',
+                    'pool_kvh': 4,
+                    'kvh_per_shard': 4 // n,
+                    'fallback': False}
+
     monkeypatch.setattr(engine_mod, 'ContinuousBatchingEngine',
                         _FakeCBE)
     ticks = itertools.count()
@@ -570,7 +582,7 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     assert parsed['value'] == round(2304.0 / 1160.0, 2)  # 1.99
     assert set(parsed['arms']) == {'bf16', 'int8', 'paged',
                                    'speculative', 'async',
-                                   'fused_kernel'}
+                                   'fused_kernel', 'sharded'}
     assert parsed['arms']['int8']['kv_cache_dtype'] == 'int8'
     assert 'int8' in parsed['metric']
     # Ragged arm: contiguous reads 4 slots * the full 512 bucket;
@@ -580,23 +592,29 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     assert parsed['paged_read_reduction_vs_contiguous'] == \
         round(4 * 512 / 200, 2)  # 10.24
     assert parsed['paged_token_parity'] is True
-    # Eleven engines: the five DeepSeek-geometry arms (incl. the
+    # Twelve engines: the five DeepSeek-geometry arms (incl. the
     # disabled-registry overhead arm) all serving the SAME weights,
     # then the gpt2 speculation pair (its own weights — plain
     # reference engine + speculating twin sharing them), then the
     # sync/async pipeline pair (its own wider-geometry weights,
     # shared between the two modes), then the fused-kernel XLA/fused
-    # pair (speculation-geometry weights, shared across the pair).
+    # pair (speculation-geometry weights, shared across the pair),
+    # then the tensor=4 sharded twin of the kernel arm's XLA engine
+    # (same seed, so the parity assert needs no weight shipping).
     assert [b.kv_cache_dtype for b in built] == \
         ['auto', 'int8', 'auto', 'auto', 'auto', 'auto', 'auto',
-         'int8', 'int8', 'int8', 'int8']
+         'int8', 'int8', 'int8', 'int8', 'int8']
     assert [b.page_size for b in built] == \
-        [0, 0, 0, 8, 8, 0, 0, 8, 8, 8, 8]
+        [0, 0, 0, 8, 8, 0, 0, 8, 8, 8, 8, 8]
     assert all(b.params is built[0].params for b in built[1:5])
     assert built[6].params is built[5].params
     assert built[8].params is built[7].params
     assert built[10].params is built[9].params
-    assert [b.decode_kernel for b in built[9:]] == ['xla', 'fused']
+    assert [b.decode_kernel for b in built[9:]] == ['xla', 'fused',
+                                                    'xla']
+    assert built[11].mesh is not None
+    assert built[11].mesh.devices.size == 4
+    assert all(b.mesh is None for b in built[:11])
     spec = parsed['arms']['speculative']
     assert spec['spec_k'] == 4
     assert spec['greedy_parity_vs_plain'] is True
@@ -635,16 +653,30 @@ def test_decode_emits_one_json_line_and_stderr_summary(
         fk['read_bytes_per_step_xla']
     assert parsed['fused_read_reduction_vs_xla'] == \
         fk['read_reduction_fused_vs_xla'] > 1.0
+    # Sharded arm: tensor=4 twin of the kernel arm's XLA engine,
+    # tokens/sec/chip at both chip counts + parity on the line.
+    tp = parsed['arms']['sharded']
+    assert tp['n_chips'] == 4
+    assert tp['greedy_parity_vs_1chip'] is True
+    assert parsed['sharded_token_parity'] is True
+    assert tp['sharding']['pool_mode'] == 'kv_heads'
+    assert tp['sharding']['kvh_per_shard'] == 1
+    assert tp['tokens_per_sec_per_chip_4chip'] == \
+        round(tp['tokens_per_sec_4chip'] / 4, 1)
+    assert tp['tokens_per_sec_per_chip_1chip'] == \
+        tp['tokens_per_sec_1chip']
     err = [l for l in captured.err.splitlines() if l.startswith('#')]
     # dtype arms + ratio + paged + speculative + async + fused-kernel
-    # + telemetry
-    assert len(err) == 8
-    assert 'fewer bytes/step' in err[-5]
-    assert 'token parity: True' in err[-4]  # the speculative line
-    assert 'steps/token' in err[-4]
-    assert 'device-wait fraction' in err[-3]  # the async line
+    # + sharded + telemetry
+    assert len(err) == 9
+    assert 'fewer bytes/step' in err[-6]
+    assert 'token parity: True' in err[-5]  # the speculative line
+    assert 'steps/token' in err[-5]
+    assert 'device-wait fraction' in err[-4]  # the async line
+    assert 'token parity: True' in err[-4]
+    assert 'fused' in err[-3]               # the fused-kernel line
     assert 'token parity: True' in err[-3]
-    assert 'fused' in err[-2]               # the fused-kernel line
+    assert 'tok/s/chip' in err[-2]          # the sharded line
     assert 'token parity: True' in err[-2]
     assert 'telemetry' in err[-1]
 
@@ -771,6 +803,26 @@ def test_decode_smoke_fused_kernel_arm(decode_smoke_json):
         arm['read_bytes_per_step_xla']
     assert parsed['fused_read_reduction_vs_xla'] > 1.0
     assert arm['tokens_per_sec_fused'] > 0
+
+
+def test_decode_smoke_sharded_arm(decode_smoke_json):
+    """Tensor-parallel decode's acceptance bar, proven on the real
+    engines in the same --smoke run: the tensor=4 twin of the kernel
+    arm's XLA engine (paged int8 spec-k=4, pools split on the kv-head
+    axis, 1 head/chip) must stream bit-identically to the 1-chip
+    engine, and the line must carry tokens/sec/chip at both chip
+    counts."""
+    parsed = decode_smoke_json
+    arm = parsed['arms']['sharded']
+    assert parsed['sharded_token_parity'] is True
+    assert arm['greedy_parity_vs_1chip'] is True
+    assert arm['n_chips'] == 4
+    assert arm['sharding']['pool_mode'] == 'kv_heads'
+    assert arm['sharding']['axes'] == {'tensor': 4}
+    assert arm['sharding']['kvh_per_shard'] == 1
+    assert arm['sharding']['fallback'] is False
+    assert arm['tokens_per_sec_per_chip_4chip'] > 0
+    assert arm['tokens_per_sec_per_chip_1chip'] > 0
 
 
 def test_sleep_skip_when_spacing_would_burn_the_window(

@@ -1,0 +1,399 @@
+"""Tensor-parallel paged decode: sharding must never change tokens.
+
+The engine shards the paged K/V/scale pools on the kv-head axis over
+a `tensor=N` mesh (block tables replicated, host allocator global)
+and lowers the fused paged-attention kernel through shard_map so each
+chip walks the block table over its LOCAL kv-head shard.  Nothing
+about WHAT is decoded may change: greedy decode on a tensor=4 mesh
+must match the single-device engine bit-for-bit across llama/gpt2 x
+whole/chunked/paged/int8 caches x plain/ngram/draft speculation, the
+DeepSeek latent kvh==1 geometry must fall back to page-/sequence-
+sharded pools (XLA path) instead of crashing or silently replicating,
+and the fused kernel under the mesh must never materialize a gathered
+cache copy (HLO-asserted, like the unsharded kernel test).
+
+Cost discipline: unsharded cross-config parity (paged == contiguous,
+chunked == whole, spec == plain at the same cache dtype) is already
+pinned by test_paged_kv_cache / test_speculative / test_paged_
+attention_kernel, so every sharded combination here compares against
+ONE unsharded reference per (family, cache dtype) — a sharded
+mismatch is then a sharding bug by construction.
+
+Tier-1/CPU by design: the conftest exposes 8 virtual CPU devices, the
+mesh takes 4 of them, and the fused kernel runs in Pallas interpreter
+mode — everything runs under `JAX_PLATFORMS=cpu -m 'not slow'`.
+"""
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.ops import paged_attention as pa
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+_COMMON = {'max_seq_len': 128, 'n_layers': 2,
+           'dtype': jnp.float32, 'param_dtype': jnp.float32}
+_FAMILIES = {
+    # GQA 8:4 + rope: 2 query heads ride along with each kv head, so
+    # a tensor=4 shard holds 1 kv head + its 2 grouped q heads.
+    'llama-tiny': {**_COMMON, 'n_heads': 8, 'n_kv_heads': 4,
+                   'dim': 64, 'ffn_dim': 128, 'vocab_size': 96},
+    # MHA + learned positions: kvh == n_heads == 4, one head/shard.
+    'gpt2-tiny': {**_COMMON, 'n_heads': 4, 'dim': 64,
+                  'ffn_dim': 128, 'vocab_size': 96},
+}
+_PS = 8
+# Repetitive prompts so n-gram self-drafting actually proposes.
+_PROMPTS = [[5, 17, 3, 42, 5, 17, 3, 9, 5, 17, 3], [9, 1, 4, 9, 1, 4]]
+_MAX_NEW = 12
+_GREEDY = engine_lib.SamplingConfig(max_new_tokens=_MAX_NEW,
+                                    temperature=0.0)
+_K = 2
+_TENSOR = 4
+
+_INT8 = dict(page_size=_PS, kv_cache_dtype='int8')
+
+
+def _cbe(family, mesh=None, **kw):
+    kw.setdefault('n_slots', 2)
+    kw.setdefault('prefill_bucket', _PS)
+    return engine_lib.ContinuousBatchingEngine(
+        family, mesh=mesh, model_overrides=dict(_FAMILIES[family]),
+        **kw)
+
+
+def _draft_kw(family):
+    return dict(spec_k=_K, draft_model=family,
+                draft_overrides=dict(_FAMILIES[family]))
+
+
+@pytest.fixture(scope='module')
+def mesh4():
+    devices = jax.devices()
+    if len(devices) < _TENSOR:
+        pytest.skip(f'needs {_TENSOR} devices')
+    return mesh_lib.make_mesh(
+        mesh_lib.MeshConfig(data=1, fsdp=1, tensor=_TENSOR),
+        devices[:_TENSOR])
+
+
+# One unsharded reference token stream per (family, cache dtype).
+# seed=0 makes param init deterministic, so the sharded twin decodes
+# the same weights without shipping params across engines.
+_REFS = {}
+
+
+def _ref_tokens(family, kind):
+    key = (family, kind)
+    if key not in _REFS:
+        kw = dict(_INT8) if kind == 'int8' else {}
+        _REFS[key] = _cbe(family, **kw).generate(_PROMPTS, _GREEDY)
+    return _REFS[key]
+
+
+# ---------------------------------------------------------------------
+# greedy bit-parity: sharded engine vs the unsharded reference
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope='module')
+def sharded_llama_int8_ngram(mesh4):
+    """The flagship sharded engine — paged int8 pools + n-gram
+    speculation — shared by the parity, recover, and observability
+    tests below (module-scoped: one build)."""
+    reg = metrics_lib.Registry()
+    return _cbe('llama-tiny', mesh=mesh4, registry=reg,
+                spec_k=_K, **_INT8), reg
+
+
+class TestShardedGreedyParity:
+
+    # (family, engine kwargs, reference kind).  Together the rows
+    # cover whole/chunked/paged/int8 caches, plain/ngram/draft
+    # speculation, xla + fused kernels, and both head families.
+    _CASES = [
+        ('llama-tiny', {}, 'f32'),
+        ('llama-tiny', {'prefill_chunk': _PS, 'spec_k': _K}, 'f32'),
+        ('llama-tiny', {'page_size': _PS, 'decode_kernel': 'fused',
+                        **_draft_kw('llama-tiny')}, 'f32'),
+        ('llama-tiny', dict(_INT8), 'int8'),
+        ('llama-tiny', dict(_INT8, **_draft_kw('llama-tiny')),
+         'int8'),
+        ('gpt2-tiny', {'spec_k': _K}, 'f32'),
+        ('gpt2-tiny', dict(_INT8, **_draft_kw('gpt2-tiny')), 'int8'),
+    ]
+
+    @pytest.mark.parametrize('family,kw,ref', _CASES, ids=[
+        'llama-whole-plain', 'llama-chunked-ngram',
+        'llama-paged-fused-draft', 'llama-int8-plain',
+        'llama-int8-draft', 'gpt2-whole-ngram', 'gpt2-int8-draft'])
+    def test_matches_unsharded_reference(self, mesh4, family, kw,
+                                         ref):
+        eng = _cbe(family, mesh=mesh4, **kw)
+        assert eng.generate(_PROMPTS, _GREEDY) == _ref_tokens(family,
+                                                              ref)
+
+    def test_int8_ngram_and_kv_head_pool_split(
+            self, sharded_llama_int8_ngram):
+        eng, _ = sharded_llama_int8_ngram
+        assert eng.generate(_PROMPTS, _GREEDY) == \
+            _ref_tokens('llama-tiny', 'int8')
+        info = eng.sharding_info()
+        assert info['mesh_devices'] == _TENSOR
+        assert info['axes'] == {'tensor': _TENSOR}
+        assert info['pool_mode'] == 'kv_heads'
+        assert info['pool_kvh'] == 4
+        assert info['kvh_per_shard'] == 1
+        assert info['fallback'] is False
+
+    def test_recover_on_sharded_engine_is_leak_free(
+            self, sharded_llama_int8_ngram):
+        """recover() rebuilds the SHARDED pools + allocator: the page
+        pool must come back leak-free and later requests must still
+        hold greedy parity."""
+        eng, _ = sharded_llama_int8_ngram
+        want = _ref_tokens('llama-tiny', 'int8')
+        eng.recover(RuntimeError('injected'))
+        assert eng._alloc.leak_report() is None
+        assert eng.generate(_PROMPTS, _GREEDY) == want
+        assert eng._alloc.leak_report() is None
+
+
+# ---------------------------------------------------------------------
+# DeepSeek latent kvh==1: page-/sequence-sharded fallback, XLA path
+# ---------------------------------------------------------------------
+
+class TestLatentKvh1Fallback:
+
+    def test_parity_and_fallback_surface(self, mesh4):
+        base = engine_lib.ContinuousBatchingEngine(
+            'deepseek-tiny', n_slots=2, prefill_bucket=_PS, **_INT8)
+        want = base.generate(_PROMPTS, _GREEDY)
+        eng = engine_lib.ContinuousBatchingEngine(
+            'deepseek-tiny', mesh=mesh4, n_slots=2,
+            prefill_bucket=_PS, **_INT8)
+        assert eng.generate(_PROMPTS, _GREEDY) == want
+        info = eng.sharding_info()
+        # kvh == 1 can't split on heads: the pool must still shard
+        # (pages, or positions when n_pages is odd) — never silently
+        # replicate — and auto must resolve to the XLA gather path,
+        # the only one that reads page-/sequence-sharded pools.
+        assert info['pool_mode'] in ('pages', 'sequence')
+        assert info['fallback'] is True
+        assert eng.decode_kernel == 'xla'
+
+    def test_explicit_fused_on_fallback_geometry_is_rejected(
+            self, mesh4):
+        with pytest.raises(ValueError, match='divisible by the '
+                                             'tensor mesh axis'):
+            engine_lib.ContinuousBatchingEngine(
+                'deepseek-tiny', mesh=mesh4, n_slots=2,
+                prefill_bucket=_PS, page_size=_PS,
+                decode_kernel='fused')
+
+
+# ---------------------------------------------------------------------
+# --decode-kernel x --mesh resolution table (pure, no engine)
+# ---------------------------------------------------------------------
+
+class TestResolveDecodeKernel:
+
+    _TABLE = [
+        # (kernel, on_tpu, page_size, tensor, pool_kvh) -> resolved
+        (('auto', True, 8, 1, 4), 'fused'),
+        (('auto', True, 8, 4, 4), 'fused'),    # kvh divides: sharded fused
+        (('auto', True, 8, 4, 1), 'xla'),      # kvh==1 fallback pools
+        (('auto', True, 0, 1, 4), 'xla'),      # contiguous cache
+        (('auto', False, 8, 1, 4), 'xla'),     # off-TPU: interpreter
+        (('auto', False, 8, 4, 4), 'xla'),
+        (('xla', True, 8, 4, 4), 'xla'),       # explicit xla always ok
+        (('fused', True, 8, 4, 4), 'fused'),
+        (('fused', False, 8, 1, 4), 'fused'),  # tests/benches: interpret
+    ]
+
+    @pytest.mark.parametrize('args,want', _TABLE)
+    def test_resolution_is_deterministic(self, args, want):
+        kernel, on_tpu, ps, tensor, kvh = args
+        got, interpret = engine_lib.resolve_decode_kernel(
+            kernel, on_tpu=on_tpu, page_size=ps, tensor=tensor,
+            pool_kvh=kvh)
+        assert got == want
+        assert interpret == (got == 'fused' and not on_tpu)
+
+    def test_fused_without_pages_rejected(self):
+        with pytest.raises(ValueError, match='paged KV cache'):
+            engine_lib.resolve_decode_kernel(
+                'fused', on_tpu=True, page_size=0)
+
+    def test_fused_on_undividable_kv_heads_rejected(self):
+        with pytest.raises(ValueError, match="decode_kernel='xla'"):
+            engine_lib.resolve_decode_kernel(
+                'fused', on_tpu=True, page_size=8, tensor=4,
+                pool_kvh=1)
+
+    def test_unknown_kernel_rejected(self):
+        with pytest.raises(ValueError, match='auto'):
+            engine_lib.resolve_decode_kernel(
+                'pallas', on_tpu=True, page_size=8)
+
+    def test_pool_mode_ladder(self):
+        mode = engine_lib.paged_pool_mode
+        assert mode(1, 4, 9, 8) == 'unsharded'
+        assert mode(4, 4, 9, 8) == 'kv_heads'
+        assert mode(4, 1, 8, 8) == 'pages'
+        assert mode(4, 1, 9, 8) == 'sequence'   # n_pages odd
+        assert mode(4, 1, 9, 6) == 'replicated'
+
+    def test_param_shardings_replicate_non_divisible_dims(self, mesh4):
+        """The param-side twin of the pool ladder: a geometry the mesh
+        cannot divide (stock llama-tiny is GQA 2:1, so neither head
+        axis divides tensor=4) must REPLICATE that dim instead of
+        failing pjit placement — `--mesh tensor=N` on a too-small
+        model serves (fallback pool mode) rather than crashes."""
+        import flax.linen as nn
+        from jax.sharding import PartitionSpec as P
+        from skypilot_tpu.parallel import sharding as sharding_lib
+
+        kernel = nn.Partitioned(
+            jax.ShapeDtypeStruct((64, 1, 16), jnp.float32),
+            names=('embed_fsdp', 'kv_heads', 'head_dim'))
+        div = nn.Partitioned(
+            jax.ShapeDtypeStruct((64, 4, 16), jnp.float32),
+            names=('embed_fsdp', 'kv_heads', 'head_dim'))
+        sh = sharding_lib.params_to_shardings(
+            mesh4, {'k': kernel, 'ok': div})
+        # kvh == 1 cannot split 4 ways -> replicated on that dim only.
+        assert sh['k'].spec == P('fsdp', None, None)
+        # kvh == 4 keeps the ruled tensor sharding untouched.
+        assert sh['ok'].spec == P('fsdp', 'tensor', None)
+        # Direct helper: tuple axes use the product of the axis sizes.
+        spec = sharding_lib.spec_for_shape(
+            mesh4, P(('data', 'tensor'), None), (6, 8))
+        assert spec == P(None, None)
+        spec = sharding_lib.spec_for_shape(
+            mesh4, P(('data', 'tensor'), None), (8, 8))
+        assert spec == P(('data', 'tensor'), None)
+
+
+# ---------------------------------------------------------------------
+# compiled-HLO guard: per-shard walks, no gathered copy under the mesh
+# ---------------------------------------------------------------------
+
+class TestShardedNoGatherMaterialization:
+    """The tentpole at the compiler-output level: under the tensor
+    mesh the fused step holds neither the global [B, kvh, n_read*ps,
+    d] gathered cache copy nor a per-shard [B, kvh/t, n_read*ps, d]
+    one, and the pools it walks are the LOCAL kv-head shards."""
+
+    _B, _H, _KVH, _NREAD, _D = 2, 8, 4, 3, 16
+
+    def _case(self):
+        rng = np.random.RandomState(11)
+        n_pages = self._B * self._NREAD + 2
+        pk = rng.randn(n_pages, self._KVH, _PS, self._D) \
+            .astype(np.float32)
+        pv = rng.randn(n_pages, self._KVH, _PS, self._D) \
+            .astype(np.float32)
+        table = np.arange(1, 1 + self._B * self._NREAD, dtype=np.int32) \
+            .reshape(self._B, self._NREAD)
+        mask = np.ones((self._B, 1, 1, self._NREAD * _PS), bool)
+        q = rng.randn(self._B, self._H, 1, self._D).astype(np.float32)
+        return (jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+                jnp.asarray(table), jnp.asarray(mask))
+
+    def _hlo(self, mesh):
+        args = self._case()
+
+        def step(q, pk, pv, table, mask):
+            return pa.paged_decode_attention(
+                q, pk, pv, table, mask, scale=self._D ** -0.5,
+                probs_dtype=jnp.float32, interpret=True)
+
+        with mesh:
+            return jax.jit(step).lower(*args).compile().as_text()
+
+    def test_fused_walks_local_shards_without_gather(self, mesh4):
+        txt = self._hlo(mesh4)
+        # [2,4,24,16] = the global gathered copy; [2,1,24,16] = a
+        # per-shard gather regression inside the manual region.
+        assert not re.search(r'\[2,4,24,16\]', txt), (
+            'sharded fused decode materializes the global gathered '
+            'cache copy — the shard_map lowering regressed to a '
+            'full-pool gather')
+        assert not re.search(r'\[2,1,24,16\]', txt)
+        # Positive control on the same text: the kernel's pool operand
+        # is the local shard — 1 of 4 kv heads, full page axis.
+        assert re.search(r'\[8,1,8,16\]', txt), (
+            'local [n_pages, kvh/t, ps, d] pool shard never appears '
+            '— is the kernel still running inside shard_map?')
+
+    def test_unsharded_oracle_does_materialize_the_gather(self):
+        # The regex is not vacuous: the XLA gather path at the same
+        # geometry produces exactly that tensor.
+        from skypilot_tpu.ops import grouped_attention as ga
+        q, pk, pv, table, mask = self._case()
+
+        def oracle(q, pk, pv, table, mask):
+            keys = ga.gather_pages(pk, table)
+            values = ga.gather_pages(pv, table)
+            return ga.grouped_attention(q, keys, values, mask,
+                                        scale=self._D ** -0.5,
+                                        probs_dtype=jnp.float32)
+
+        txt = jax.jit(oracle).lower(q, pk, pv, table, mask) \
+            .compile().as_text()
+        assert re.search(r'f32\[2,4,24,16\]', txt)
+
+    def test_ops_level_kvh1_under_mesh_is_rejected(self, mesh4):
+        q, pk, pv, table, mask = self._case()
+        with mesh4:
+            with pytest.raises(ValueError, match='kv-head axis'):
+                pa.paged_decode_attention(
+                    q, pk[:, :1], pv[:, :1], table, mask,
+                    scale=self._D ** -0.5, probs_dtype=jnp.float32,
+                    interpret=True)
+
+
+# ---------------------------------------------------------------------
+# observability: metrics + /health?verbose=1 sharding block
+# ---------------------------------------------------------------------
+
+class TestShardingObservability:
+
+    def test_mesh_gauge_and_collective_histogram(
+            self, sharded_llama_int8_ngram):
+        eng, reg = sharded_llama_int8_ngram
+        eng.generate(_PROMPTS, _GREEDY)
+        parsed = metrics_lib.parse_exposition(reg.expose())
+        assert metrics_lib.sample_value(
+            parsed, 'skytpu_mesh_devices') == _TENSOR
+        # Sharded steps feed the collective-wait histogram.
+        assert metrics_lib.sample_value(
+            parsed, 'skytpu_decode_collective_seconds_count') >= 1
+
+    def test_unsharded_engine_reports_one_device(self):
+        reg = metrics_lib.Registry()
+        eng = _cbe('gpt2-tiny', registry=reg)
+        info = eng.sharding_info()
+        assert info['mesh_devices'] == 1
+        assert info['pool_mode'] == 'unsharded'
+        parsed = metrics_lib.parse_exposition(reg.expose())
+        assert metrics_lib.sample_value(
+            parsed, 'skytpu_mesh_devices') == 1
+
+    def test_health_detail_carries_the_sharding_block(
+            self, sharded_llama_int8_ngram):
+        """The server's /health?verbose=1 wiring, without a socket:
+        health_detail() on a stub server whose engine is the real
+        sharded engine must expose the sharding block verbatim."""
+        from types import SimpleNamespace
+
+        from skypilot_tpu.infer import server as server_lib
+        eng, _ = sharded_llama_int8_ngram
+        stub = SimpleNamespace(engine=eng, model_name='llama-tiny')
+        detail = server_lib.InferenceServer.health_detail(stub)
+        assert detail['sharding'] == eng.sharding_info()
+        assert detail['sharding']['pool_mode'] == 'kv_heads'

@@ -619,10 +619,73 @@ def test_kernel_discipline_gated_calls_and_scope_are_clean(tmp_path):
                            _KERNEL_UNGATED, rule='kernel-discipline'))
 
 
+# ---------------------------------------------------------------------
+# mesh-axis-discipline
+# ---------------------------------------------------------------------
+
+_MESH_AXIS_STRAYS = """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from skypilot_tpu.parallel import sharding as sharding_lib
+
+    def shard(x, mesh):
+        spec = P(None, 'tp', None)              # stray alias
+        y = jax.lax.psum(x, 'model')            # stray alias
+        z = jax.lax.all_gather(x, axis_name='tensro')  # typo
+        f = sharding_lib.shard_map_compat(
+            lambda a: a, mesh=mesh, in_specs=(spec,), out_specs=spec,
+            axis_names=frozenset({'head'}))     # stray axis
+        return y, z, f
+"""
+
+_MESH_AXIS_CLEAN = """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel import sharding as sharding_lib
+
+    _AXIS = mesh_lib.AXIS_TENSOR
+
+    def shard(x, mesh, axis):
+        spec = P(None, 'tensor', None)          # exact constant value
+        y = jax.lax.psum(x, _AXIS)              # routed via constant
+        z = jax.lax.all_gather(x, axis_name=axis)  # variable: unknowable
+        f = sharding_lib.shard_map_compat(
+            lambda a: a, mesh=mesh, in_specs=(spec,), out_specs=spec,
+            axis_names=frozenset({mesh_lib.AXIS_TENSOR}))
+        return y, z, f
+
+    MODES = ('pages', 'sequence')               # plain strings: not a call site
+"""
+
+
+def test_mesh_axis_discipline_flags_stray_axis_literals(tmp_path):
+    findings = _live(_lint(tmp_path, 'skypilot_tpu/ops/attn.py',
+                           _MESH_AXIS_STRAYS,
+                           rule='mesh-axis-discipline'))
+    symbols = sorted(f.symbol for f in findings)
+    assert symbols == ['head', 'model', 'tensro', 'tp']
+    assert all('parallel/mesh.py' in f.message for f in findings)
+
+
+def test_mesh_axis_discipline_constants_and_scope_are_clean(tmp_path):
+    assert not _live(_lint(tmp_path, 'skypilot_tpu/infer/engine.py',
+                           _MESH_AXIS_CLEAN,
+                           rule='mesh-axis-discipline'))
+    # Outside ops//models//infer/ the rule does not apply — trainer
+    # experiments and tests may spell ad-hoc axes.
+    assert not _live(_lint(tmp_path, 'skypilot_tpu/train/t.py',
+                           _MESH_AXIS_STRAYS,
+                           rule='mesh-axis-discipline'))
+
+
 def test_all_rule_families_are_registered():
     ids = {r.id for r in skylint.all_rules()}
     assert {'host-sync', 'retrace-hazard', 'lock-discipline',
             'thread-discipline', 'stdout-purity', 'metric-contract',
             'dtype-promotion', 'sleep-discipline',
             'net-timeout', 'trace-discipline',
-            'pipeline-discipline', 'kernel-discipline'} <= ids
+            'pipeline-discipline', 'kernel-discipline',
+            'mesh-axis-discipline'} <= ids
