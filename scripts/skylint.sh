@@ -4,6 +4,7 @@
 #   scripts/skylint.sh
 #   scripts/skylint.sh --format json
 #   scripts/skylint.sh --rule stdout-purity
+#   scripts/skylint.sh --changed-only origin/main   # only your diff
 #   scripts/skylint.sh some/file.py
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -16,4 +17,6 @@ done
 if [[ ${has_path} -eq 1 ]]; then
     exec python -m skypilot_tpu.devtools.skylint "$@"
 fi
-exec python -m skypilot_tpu.devtools.skylint "$@" skypilot_tpu bench.py
+# '--' keeps a trailing valueless --changed-only from swallowing the
+# default paths as its BASE ref.
+exec python -m skypilot_tpu.devtools.skylint "$@" -- skypilot_tpu bench.py

@@ -1,0 +1,469 @@
+"""Whole-program analysis index for skylint's deep rules.
+
+skylint 1.x rules were single-file AST walks: a host-sync hazard one
+call away in ``utils/``, a lock-order inversion between
+``infer/engine.py`` and ``infer/paging.py``, or a donated buffer read
+back by a caller in another module were all invisible.  This module is
+the shared second tier: **one parse of the scanned tree** (the
+``FileContext`` objects skylint already built — nothing here calls
+``ast.parse``) producing
+
+* a **module graph** — file path -> dotted module name, plus a per-
+  module import/alias table that resolves ``import a.b as c``,
+  ``from a.b import c as d`` and relative imports against the scanned
+  tree (function-local imports included: the engine's lazy
+  ``from skypilot_tpu.infer import paging as paging_lib`` idiom);
+* a **symbol table** — qualified name (``mod.Class.method``,
+  ``mod.fn.inner``) -> definition, with classes carrying their method
+  tables, resolved bases, and a ``self.<attr>`` -> class type map
+  inferred from ``self.X = SomeClass(...)`` assignments; and
+* an **interprocedural call graph** — every ``ast.Call`` resolved to a
+  project-local callee where possible: bare names through local defs /
+  imports / ``functools.partial`` pre-bindings (reusing the idiom
+  logic ``rules/_jit.py`` established for jit sites), ``self.method``
+  dispatch within a class (bases included), ``self.attr.method`` via
+  the inferred attribute types, and ``local = SomeClass(...)`` receiver
+  typing.
+
+Rules consume the index through :class:`Project`: ``edge_for_call``
+(call node -> resolved edge), ``calls_of`` (function -> outgoing
+edges), ``jit_index`` (per-module cached ``_jit.JitIndex`` so the jit
+site table is built once, not once per rule), and ``walk_own`` (a
+function body minus its nested defs, which have their own entries).
+
+Resolution is deliberately an over-approximation where Python's
+dynamism forces a choice (a linter must not crash on what it cannot
+prove), and a no-edge where the receiver is unknowable — a missing
+edge costs recall on a deep chain, never a false positive.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.devtools.rules import _jit
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    return _jit._dotted(node)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function/method definition, addressable by qualified name."""
+    qname: str
+    name: str
+    node: ast.AST                    # FunctionDef / AsyncFunctionDef
+    module: 'ModuleInfo'
+    cls: Optional['ClassInfo'] = None
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    qname: str
+    name: str
+    node: ast.ClassDef
+    module: 'ModuleInfo'
+    methods: Dict[str, FunctionInfo] = dataclasses.field(
+        default_factory=dict)
+    base_names: List[str] = dataclasses.field(default_factory=list)
+    # self.<attr> -> class qname, from `self.attr = SomeClass(...)`.
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class CallEdge:
+    """One resolved call site: ``caller`` invokes ``callee``.
+
+    ``via`` records how the edge was resolved ('call', 'partial',
+    'self', 'attr', 'instance', 'import') — 'partial' means the callee
+    was pre-bound by ``functools.partial`` at this site rather than
+    invoked directly.
+
+    ``arg_offset`` maps positional arguments at this call site onto
+    callee parameters: param_index = arg_index + arg_offset.  -1 at a
+    ``functools.partial(f, x)`` site itself (args[0] is the wrapped
+    function); +k when calling a local pre-bound by a partial with k
+    positional arguments.
+    """
+    caller: str
+    callee: str
+    node: ast.Call
+    via: str = 'call'
+    arg_offset: int = 0
+
+
+class ModuleInfo:
+    """One scanned file: dotted name, parsed tree, import aliases."""
+
+    def __init__(self, name: str, ctx) -> None:
+        self.name = name
+        self.ctx = ctx                      # skylint.FileContext
+        self.tree: ast.Module = ctx.tree
+        self.posix: str = ctx.posix
+        # local alias -> fully qualified dotted target (module or
+        # symbol); collected module-wide including function-local
+        # imports (an over-approximation that matches the repo's lazy
+        # import idiom).
+        self.imports: Dict[str, str] = {}
+
+    def package(self) -> str:
+        """Dotted package this module lives in ('' at top level)."""
+        return self.name.rsplit('.', 1)[0] if '.' in self.name else ''
+
+
+def module_name_for(path: str, anchor: str) -> str:
+    """Dotted module name of ``path`` relative to ``anchor``.
+
+    ``skypilot_tpu/infer/engine.py`` -> ``skypilot_tpu.infer.engine``;
+    a package ``__init__.py`` names the package itself.
+    """
+    rel = os.path.relpath(os.path.abspath(path), anchor)
+    rel = rel[:-3] if rel.endswith('.py') else rel
+    parts = [p for p in rel.replace(os.sep, '/').split('/')
+             if p not in ('.', '')]
+    if parts and parts[-1] == '__init__':
+        parts = parts[:-1]
+    return '.'.join(parts) if parts else os.path.basename(anchor)
+
+
+def _package_anchor(path: str) -> str:
+    """Walk up from ``path`` while ``__init__.py`` marks a package;
+    return the first non-package directory (the import root)."""
+    cur = os.path.dirname(os.path.abspath(path))
+    while os.path.isfile(os.path.join(cur, '__init__.py')):
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            break
+        cur = parent
+    return cur
+
+
+class Project:
+    """The whole-program index over one set of parsed files."""
+
+    def __init__(self, contexts: Iterable) -> None:
+        contexts = list(contexts)
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.modules_by_path: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self._fn_by_node: Dict[int, FunctionInfo] = {}
+        self._edges_by_caller: Dict[str, List[CallEdge]] = {}
+        self._edge_by_call: Dict[int, CallEdge] = {}
+        self._jit_cache: Dict[str, _jit.JitIndex] = {}
+        if not contexts:
+            return
+        # Import root: the shallowest of each file's package anchor and
+        # the common directory of the scanned set, so absolute imports
+        # resolve inside a real package tree AND bare fixture trees
+        # (tests write models/m.py + utils/h.py with no __init__.py).
+        anchors = {_package_anchor(ctx.path) for ctx in contexts}
+        paths = [os.path.abspath(ctx.path) for ctx in contexts]
+        common = os.path.commonpath(paths) if len(paths) > 1 \
+            else os.path.dirname(paths[0])
+        if os.path.isfile(common):
+            common = os.path.dirname(common)
+        anchor = min(anchors | {common}, key=lambda p: len(p))
+        for ctx in contexts:
+            name = module_name_for(ctx.path, anchor)
+            mod = ModuleInfo(name, ctx)
+            self.modules[name] = mod
+            self.modules_by_path[ctx.path] = mod
+        for mod in self.modules.values():
+            self._collect_imports(mod)
+            self._register_symbols(mod)
+        for cls in self.classes.values():
+            self._infer_attr_types(cls)
+        for fn in list(self.functions.values()):
+            self._build_edges(fn)
+
+    # -- construction -------------------------------------------------
+
+    def _collect_imports(self, mod: ModuleInfo) -> None:
+        pkg_parts = mod.package().split('.') if mod.package() else []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        mod.imports[alias.asname] = alias.name
+                    else:
+                        root = alias.name.split('.', 1)[0]
+                        mod.imports.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base_parts = pkg_parts[:len(pkg_parts)
+                                           - (node.level - 1)]
+                    base = '.'.join(
+                        p for p in base_parts + [node.module or '']
+                        if p)
+                else:
+                    base = node.module or ''
+                for alias in node.names:
+                    if alias.name == '*':
+                        continue
+                    local = alias.asname or alias.name
+                    mod.imports[local] = (f'{base}.{alias.name}'
+                                          if base else alias.name)
+
+    def _register_symbols(self, mod: ModuleInfo) -> None:
+        def visit(node: ast.AST, prefix: str,
+                  cls: Optional[ClassInfo]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qname = f'{prefix}.{child.name}'
+                    info = FunctionInfo(qname=qname, name=child.name,
+                                        node=child, module=mod, cls=cls)
+                    self.functions[qname] = info
+                    self._fn_by_node[id(child)] = info
+                    if cls is not None and prefix == cls.qname:
+                        cls.methods[child.name] = info
+                    # Keep the enclosing class: nested defs inside a
+                    # method (the engine's jit-body closures) resolve
+                    # `self.` through it.
+                    visit(child, qname, cls)
+                elif isinstance(child, ast.ClassDef):
+                    qname = f'{prefix}.{child.name}'
+                    cinfo = ClassInfo(qname=qname, name=child.name,
+                                      node=child, module=mod)
+                    for base in child.bases:
+                        dotted = _dotted(base)
+                        if dotted:
+                            cinfo.base_names.append(dotted)
+                    self.classes[qname] = cinfo
+                    visit(child, qname, cinfo)
+                else:
+                    visit(child, prefix, cls)
+
+        visit(mod.tree, mod.name, None)
+
+    def _resolve_class_name(self, mod: ModuleInfo,
+                            dotted: str) -> Optional[str]:
+        """Class qname for a (possibly aliased) dotted name in ``mod``."""
+        for cand in (f'{mod.name}.{dotted}', dotted):
+            if cand in self.classes:
+                return cand
+        head, _, rest = dotted.partition('.')
+        target = mod.imports.get(head)
+        if target:
+            cand = f'{target}.{rest}' if rest else target
+            if cand in self.classes:
+                return cand
+        return None
+
+    def _infer_attr_types(self, cls: ClassInfo) -> None:
+        for node in ast.walk(cls.node):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            dotted = _dotted(node.value.func)
+            if not dotted:
+                continue
+            target_cls = self._resolve_class_name(cls.module, dotted)
+            if not target_cls:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Attribute) \
+                        and isinstance(target.value, ast.Name) \
+                        and target.value.id == 'self':
+                    cls.attr_types[target.attr] = target_cls
+
+    def _local_env(self, fn: FunctionInfo
+                   ) -> Dict[str, Tuple[str, str, int]]:
+        """name -> ('partial'|'instance', qname, prebound) for
+        function-local ``x = functools.partial(f, a, b)`` (prebound =
+        positional args already bound, here 2) / ``x = SomeClass(...)``
+        (prebound 0)."""
+        env: Dict[str, Tuple[str, str, int]] = {}
+        for node in self.walk_own(fn):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            dotted = _dotted(call.func)
+            if dotted and dotted.rsplit('.', 1)[-1] == 'partial' \
+                    and call.args:
+                inner = _dotted(call.args[0])
+                if inner:
+                    callee = self._resolve_dotted(fn, inner)
+                    if callee:
+                        for n in names:
+                            env[n] = ('partial', callee,
+                                      len(call.args) - 1)
+                continue
+            if dotted:
+                cq = self._resolve_class_name(fn.module, dotted)
+                if cq:
+                    for n in names:
+                        env[n] = ('instance', cq, 0)
+        return env
+
+    def _lookup_method(self, cls_qname: str,
+                       name: str) -> Optional[FunctionInfo]:
+        seen: Set[str] = set()
+
+        def look(q: str) -> Optional[FunctionInfo]:
+            if q in seen:
+                return None
+            seen.add(q)
+            cls = self.classes.get(q)
+            if cls is None:
+                return None
+            if name in cls.methods:
+                return cls.methods[name]
+            for base in cls.base_names:
+                bq = self._resolve_class_name(cls.module, base)
+                if bq:
+                    hit = look(bq)
+                    if hit is not None:
+                        return hit
+            return None
+
+        return look(cls_qname)
+
+    def _resolve_dotted(self, fn: FunctionInfo,
+                        dotted: str) -> Optional[str]:
+        """Function qname for a dotted expression in ``fn``'s scope."""
+        parts = dotted.split('.')
+        head = parts[0]
+        if head == 'self' and fn.cls is not None:
+            if len(parts) == 2:
+                hit = self._lookup_method(fn.cls.qname, parts[1])
+                return hit.qname if hit else None
+            if len(parts) == 3:
+                attr_cls = fn.cls.attr_types.get(parts[1])
+                if attr_cls:
+                    hit = self._lookup_method(attr_cls, parts[2])
+                    return hit.qname if hit else None
+            return None
+        # Innermost function scopes first: a nested def shadows the
+        # module level.  Class scopes are skipped — a bare name inside
+        # a method does NOT reach sibling methods in Python.
+        scope = fn.qname
+        while scope and scope not in self.modules:
+            if scope not in self.classes:
+                cand = f'{scope}.{dotted}'
+                if cand in self.functions:
+                    return cand
+            scope = scope.rsplit('.', 1)[0] if '.' in scope else ''
+        cand = f'{fn.module.name}.{dotted}'
+        if cand in self.functions:
+            return cand
+        target = fn.module.imports.get(head)
+        if target:
+            rest = '.'.join(parts[1:])
+            cand = f'{target}.{rest}' if rest else target
+            if cand in self.functions:
+                return cand
+            cq = self._resolve_class_name(fn.module, dotted)
+            if cq:
+                hit = self._lookup_method(cq, '__init__')
+                return hit.qname if hit else None
+        if dotted in self.functions:
+            return dotted
+        return None
+
+    def _build_edges(self, fn: FunctionInfo) -> None:
+        env = self._local_env(fn)
+        edges: List[CallEdge] = []
+        for node in self.walk_own(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            edge = self._resolve_call(fn, env, node)
+            if edge is not None:
+                edges.append(edge)
+                self._edge_by_call[id(node)] = edge
+        self._edges_by_caller[fn.qname] = edges
+
+    def _resolve_call(self, fn: FunctionInfo,
+                      env: Dict[str, Tuple[str, str, int]],
+                      call: ast.Call) -> Optional[CallEdge]:
+        dotted = _dotted(call.func)
+        if dotted is None:
+            return None
+        last = dotted.rsplit('.', 1)[-1]
+        # functools.partial(f, ...): a pre-binding is a deferred call —
+        # record the edge so deep walks see through the wrapper.
+        if last == 'partial' and call.args:
+            inner = _dotted(call.args[0])
+            if inner:
+                callee = self._resolve_dotted(fn, inner)
+                if callee:
+                    return CallEdge(fn.qname, callee, call, 'partial',
+                                    arg_offset=-1)
+            return None
+        parts = dotted.split('.')
+        if len(parts) == 1 and parts[0] in env:
+            kind, target, prebound = env[parts[0]]
+            if kind == 'partial':
+                return CallEdge(fn.qname, target, call, 'partial',
+                                arg_offset=prebound)
+            hit = self._lookup_method(target, '__call__')
+            return CallEdge(fn.qname, hit.qname, call, 'instance') \
+                if hit else None
+        if len(parts) == 2 and parts[0] in env:
+            kind, target, _prebound = env[parts[0]]
+            if kind == 'instance':
+                hit = self._lookup_method(target, parts[1])
+                if hit:
+                    return CallEdge(fn.qname, hit.qname, call,
+                                    'instance')
+            return None
+        callee = self._resolve_dotted(fn, dotted)
+        if callee:
+            via = 'self' if parts[0] == 'self' else 'call'
+            return CallEdge(fn.qname, callee, call, via)
+        return None
+
+    # -- query API ----------------------------------------------------
+
+    def walk_own(self, fn: FunctionInfo) -> Iterator[ast.AST]:
+        """Every node of ``fn``'s body, excluding nested def/class
+        subtrees (those have their own FunctionInfo entries)."""
+        def walk(node: ast.AST) -> Iterator[ast.AST]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    continue
+                yield child
+                yield from walk(child)
+
+        yield from walk(fn.node)
+
+    def edge_for_call(self, call: ast.AST) -> Optional[CallEdge]:
+        return self._edge_by_call.get(id(call))
+
+    def calls_of(self, qname: str) -> List[CallEdge]:
+        return self._edges_by_caller.get(qname, [])
+
+    def function_for_node(self, node: ast.AST) -> Optional[FunctionInfo]:
+        return self._fn_by_node.get(id(node))
+
+    def jit_index(self, module_name: str) -> _jit.JitIndex:
+        """The module's traced-function table, built exactly once and
+        shared by every rule (the single-parse/single-index contract)."""
+        index = self._jit_cache.get(module_name)
+        if index is None:
+            index = _jit.JitIndex(self.modules[module_name].tree)
+            self._jit_cache[module_name] = index
+        return index
+
+    def iter_modules(self, scope=None) -> Iterator[ModuleInfo]:
+        for name in sorted(self.modules):
+            mod = self.modules[name]
+            if scope is None or scope(mod.posix):
+                yield mod
+
+    def location(self, qname: str) -> str:
+        fn = self.functions.get(qname)
+        if fn is None:
+            return qname
+        return f'{fn.module.posix}:{getattr(fn.node, "lineno", 0)}'

@@ -4,10 +4,13 @@ Each submodule exports one or more ``skylint.Rule`` instances via a
 module-level ``RULES`` tuple; ``ALL_RULES`` is their concatenation in
 a stable order.  Adding a rule family == adding a module here.
 """
+from skypilot_tpu.devtools.rules import donation
 from skypilot_tpu.devtools.rules import dtype_promotion
 from skypilot_tpu.devtools.rules import host_sync
 from skypilot_tpu.devtools.rules import kernel_discipline
+from skypilot_tpu.devtools.rules import key_reuse
 from skypilot_tpu.devtools.rules import lock_discipline
+from skypilot_tpu.devtools.rules import lock_order
 from skypilot_tpu.devtools.rules import mesh_axis_discipline
 from skypilot_tpu.devtools.rules import metric_contract
 from skypilot_tpu.devtools.rules import net_timeout
@@ -22,6 +25,7 @@ ALL_RULES = (host_sync.RULES + retrace.RULES + lock_discipline.RULES
              + dtype_promotion.RULES + sleep_discipline.RULES
              + net_timeout.RULES + trace_discipline.RULES
              + pipeline_discipline.RULES + kernel_discipline.RULES
-             + mesh_axis_discipline.RULES)
+             + mesh_axis_discipline.RULES + lock_order.RULES
+             + donation.RULES + key_reuse.RULES)
 
 __all__ = ['ALL_RULES']

@@ -1,0 +1,230 @@
+"""donation-discipline: no host reads of donated device buffers.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's device
+memory to XLA for reuse: after the call, the Python binding still
+exists but the buffer behind it is dead, and touching it raises a
+deleted-buffer error — *or worse*, on some backends silently reads
+garbage.  The repo donates every hot-path cache and params tree
+(decode step, prefill, insert), so the contract is: once a value is
+passed in a donated position, the only valid continuation is the
+function's own return value.
+
+The rule finds every jit site declaring ``donate_argnums`` /
+``donate_argnames``, follows the binding (``self._step = jax.jit(...)``
+or a local name) to its call sites in the same module/class, and flags
+any later host-path read of a name or ``self.<attr>`` that was passed
+in a donated position without being rebound first.  Rebinding at the
+call statement itself (``cache = self._step(cache, ...)``) is the
+sanctioned shape and is clean.
+
+The check is intra-function: a donated ``self.<attr>`` read back by a
+*different* method can't be ordered statically and is left to the
+runtime's deleted-buffer error.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu.devtools import skylint
+from skypilot_tpu.devtools.rules import _jit
+
+RULE_ID = 'donation-discipline'
+
+
+@dataclasses.dataclass
+class _JitSite:
+    """One ``<binding> = jax.jit(fn, donate_arg...)`` assignment."""
+    binding: Tuple[str, str]      # ('name', n) or ('self', attr)
+    donate_nums: Set[int]
+    donate_names: Set[str]
+    param_names: List[str]        # of the wrapped fn when resolvable
+    node: ast.Call
+
+
+def _donations(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == 'donate_argnums':
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, int) \
+                        and not isinstance(sub.value, bool):
+                    nums.add(sub.value)
+        elif kw.arg == 'donate_argnames':
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str):
+                    names.add(sub.value)
+    return nums, names
+
+
+def _jit_sites(project, mod) -> List[_JitSite]:
+    sites: List[_JitSite] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        callee = _jit._last_part(_jit._dotted(call.func))
+        if callee not in _jit._JIT_NAMES:
+            continue
+        nums, names = _donations(call)
+        if not nums and not names:
+            continue
+        params: List[str] = []
+        if call.args and isinstance(call.args[0], ast.Name):
+            # Resolve the wrapped fn for its signature, so donated
+            # positions also match keyword-style call sites.
+            for fq, fn in project.functions.items():
+                if fn.module is mod \
+                        and fn.name == call.args[0].id:
+                    args = fn.node.args
+                    params = [a.arg
+                              for a in args.posonlyargs + args.args]
+                    break
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                sites.append(_JitSite(('name', target.id), nums,
+                                      names, params, call))
+            elif isinstance(target, ast.Attribute) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == 'self':
+                sites.append(_JitSite(('self', target.attr), nums,
+                                      names, params, call))
+    return sites
+
+
+def _binding_called(site: _JitSite, call: ast.Call) -> bool:
+    kind, name = site.binding
+    func = call.func
+    if kind == 'name':
+        return isinstance(func, ast.Name) and func.id == name
+    return (isinstance(func, ast.Attribute) and func.attr == name
+            and isinstance(func.value, ast.Name)
+            and func.value.id == 'self')
+
+
+def _donated_args(site: _JitSite,
+                  call: ast.Call) -> List[Tuple[ast.AST, str]]:
+    """(arg_expr, display) for each argument in a donated position."""
+    out: List[Tuple[ast.AST, str]] = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        name = site.param_names[i] if i < len(site.param_names) else ''
+        if i in site.donate_nums or (name and name
+                                     in site.donate_names):
+            out.append((arg, name or f'arg{i}'))
+    for kw in call.keywords:
+        if kw.arg is None:
+            continue
+        idx = site.param_names.index(kw.arg) \
+            if kw.arg in site.param_names else -1
+        if kw.arg in site.donate_names or idx in site.donate_nums:
+            out.append((kw.value, kw.arg))
+    return out
+
+
+def _track_key(expr: ast.AST) -> Optional[Tuple[str, str]]:
+    """('name', x) / ('self', attr) when the donated expr is trackable."""
+    if isinstance(expr, ast.Name):
+        return ('name', expr.id)
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == 'self':
+        return ('self', expr.attr)
+    return None
+
+
+def _pos(node: ast.AST) -> Tuple[int, int]:
+    return (getattr(node, 'end_lineno', None) or node.lineno,
+            getattr(node, 'end_col_offset', None)
+            or node.col_offset)
+
+
+def _loads_and_stores(project, fn, key: Tuple[str, str]
+                      ) -> Tuple[List[ast.AST], List[ast.AST]]:
+    kind, name = key
+    loads: List[ast.AST] = []
+    stores: List[ast.AST] = []
+    for node in project.walk_own(fn):
+        if kind == 'name' and isinstance(node, ast.Name) \
+                and node.id == name:
+            (loads if isinstance(node.ctx, ast.Load)
+             else stores).append(node)
+        elif kind == 'self' and isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == 'self' and node.attr == name:
+            (loads if isinstance(node.ctx, ast.Load)
+             else stores).append(node)
+    return loads, stores
+
+
+def check(project) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for mod in project.iter_modules():
+        sites = _jit_sites(project, mod)
+        if not sites:
+            continue
+        ctx = mod.ctx
+        for fn in project.functions.values():
+            if fn.module is not mod:
+                continue
+            for call in project.walk_own(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                for site in sites:
+                    if not _binding_called(site, call):
+                        continue
+                    for arg, pname in _donated_args(site, call):
+                        key = _track_key(arg)
+                        if key is None:
+                            continue
+                        _scan_use_after(project, ctx, fn, site, call,
+                                        key, pname, findings)
+    return findings
+
+
+def _scan_use_after(project, ctx, fn, site: _JitSite, call: ast.Call,
+                    key: Tuple[str, str], pname: str,
+                    findings: List[skylint.Finding]) -> None:
+    loads, stores = _loads_and_stores(project, fn, key)
+    call_pos = _pos(call)
+    call_line = call.lineno
+    display = key[1] if key[0] == 'name' else f'self.{key[1]}'
+    bind = site.binding[1] if site.binding[0] == 'name' \
+        else f'self.{site.binding[1]}'
+    for load in sorted(loads, key=_pos):
+        lpos = _pos(load)
+        if lpos <= call_pos:
+            continue
+        # A store at or after the call line and before the read means
+        # the binding was refreshed (the `x = jitted(x, ...)` shape
+        # stores on the call line itself).
+        refreshed = any(call_line <= s.lineno and _pos(s) <= lpos
+                        for s in stores)
+        if refreshed:
+            break
+        findings.append(ctx.finding(
+            RULE_ID, load, f'{bind}.{pname or display}',
+            f'use-after-donate: {display!r} is donated to jitted '
+            f'{bind!r} at line {call_line} '
+            f'(donated parameter {pname or "?"!r}) and read again '
+            f'here; the device buffer is dead after the call — '
+            f'rebind the result instead',
+            call_chain=(f'{bind}(...) donates {display} '
+                        f'({ctx.posix}:{call_line})',
+                        f'{display} read '
+                        f'({ctx.posix}:{load.lineno})')))
+        break    # one finding per donated arg per call
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='a buffer donated to a jit (donate_argnums/argnames) is '
+            'dead after the call — rebind the result, never reread it',
+    check=check,
+    project=True),)

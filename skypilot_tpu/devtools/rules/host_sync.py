@@ -5,12 +5,20 @@ A ``float()``, ``.item()``, ``np.asarray`` or ``print`` inside a
 stream (or burns a trace-time constant), and on a gang-scheduled pod
 slice one straggler host stalls every peer.  Scoped to the compute
 layers where jitted code lives: ``ops/``, ``models/``,
-``infer/engine.py``, ``train/trainer.py``.
+``infer/engine.py``, ``infer/speculative.py``, ``train/trainer.py``.
+
+2.0: the rule is **interprocedural**.  A helper that lives in
+``utils/`` (outside the scope above) and calls ``time.time()`` is
+invisible to a single-file walk — but if a jitted body in scope
+*reaches* it through the project call graph, the hazard executes under
+trace all the same.  Such findings anchor at the call site inside the
+jit body (where the fix belongs: hoist the call or pass the value in)
+and carry the full call chain down to the syncing call.
 """
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from skypilot_tpu.devtools import skylint
 from skypilot_tpu.devtools.rules import _jit
@@ -21,6 +29,8 @@ _SYNC_ATTRS = {'item', 'tolist'}
 _TIME_FNS = {'time.time', 'time.perf_counter', 'time.monotonic'}
 _ASARRAY_FNS = {'np.asarray', 'numpy.asarray', 'np.array',
                 'numpy.array'}
+
+_MAX_DEPTH = 8
 
 
 def in_scope(posix: str) -> bool:
@@ -59,28 +69,119 @@ def _flag(node: ast.Call):
     return None
 
 
-def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
-    index = _jit.JitIndex(ctx.tree)
+# A hazard chain: descriptions of each hop plus the (symbol, reason)
+# of the syncing call at the end.
+_Chain = Tuple[List[str], Tuple[str, str]]
+
+
+def _direct_hazard(fn_node: ast.AST) -> Optional[Tuple[ast.Call,
+                                                       Tuple[str, str]]]:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            hit = _flag(node)
+            if hit is not None:
+                return node, hit
+    return None
+
+
+def _hazard_chain(project, qname: str,
+                  memo: Dict[str, Optional[_Chain]],
+                  boundary: Set[int],
+                  stack: Set[str], depth: int) -> Optional[_Chain]:
+    """Shortest-discovered chain from ``qname`` down to a syncing call,
+    or None.  ``boundary`` holds node ids of functions that are traced
+    entries of in-scope modules — their hazards are flagged at their
+    own jit entry, so the walk stops there instead of double-reporting.
+    """
+    if qname in memo:
+        return memo[qname]
+    fn = project.functions.get(qname)
+    if fn is None or depth <= 0:
+        return None
+    if id(fn.node) in boundary:
+        memo[qname] = None
+        return None
+    if qname in stack:           # cycle: no memo (partial exploration)
+        return None
+    stack.add(qname)
+    result: Optional[_Chain] = None
+    direct = _direct_hazard(fn.node)
+    if direct is not None:
+        node, hit = direct
+        result = ([f'{qname} ({fn.module.posix}:{node.lineno})'], hit)
+    else:
+        # Own calls plus calls of nested defs (closures handed to
+        # scan/cond inside the helper run under the same trace).
+        edges = list(project.calls_of(qname))
+        for sub_q in project.functions:
+            if sub_q.startswith(qname + '.'):
+                edges.extend(project.calls_of(sub_q))
+        for edge in edges:
+            sub = _hazard_chain(project, edge.callee, memo, boundary,
+                                stack, depth - 1)
+            if sub is not None:
+                hops, hit = sub
+                result = ([f'{qname} '
+                           f'({fn.module.posix}:{edge.node.lineno})']
+                          + hops, hit)
+                break
+    stack.discard(qname)
+    memo[qname] = result
+    return result
+
+
+def check(project) -> Iterable[skylint.Finding]:
     findings: List[skylint.Finding] = []
-    for tf, body in index.traced_bodies():
-        for stmt in body:
-            for node in ast.walk(stmt):
-                if not isinstance(node, ast.Call):
-                    continue
-                hit = _flag(node)
-                if hit is None:
-                    continue
-                symbol, reason = hit
-                findings.append(ctx.finding(
-                    RULE_ID, node, symbol,
-                    f'{symbol} inside traced function '
-                    f'{tf.name!r} (via {tf.via}): {reason}'))
+    memo: Dict[str, Optional[_Chain]] = {}
+    boundary: Set[int] = set()
+    scoped = list(project.iter_modules(in_scope))
+    for mod in scoped:
+        for tf in project.jit_index(mod.name).traced:
+            boundary.add(id(tf.node))
+    for mod in scoped:
+        ctx = mod.ctx
+        index = project.jit_index(mod.name)
+        for tf, body in index.traced_bodies():
+            reported: Set[Tuple[str, str]] = set()
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    hit = _flag(node)
+                    if hit is not None:
+                        symbol, reason = hit
+                        findings.append(ctx.finding(
+                            RULE_ID, node, symbol,
+                            f'{symbol} inside traced function '
+                            f'{tf.name!r} (via {tf.via}): {reason}'))
+                        continue
+                    edge = project.edge_for_call(node)
+                    if edge is None:
+                        continue
+                    chain = _hazard_chain(project, edge.callee, memo,
+                                          boundary, set(), _MAX_DEPTH)
+                    if chain is None:
+                        continue
+                    hops, (symbol, reason) = chain
+                    if (edge.callee, symbol) in reported:
+                        continue
+                    reported.add((edge.callee, symbol))
+                    full_chain = ([f'{tf.name} '
+                                   f'({mod.posix}:{node.lineno})']
+                                  + hops + [symbol])
+                    findings.append(ctx.finding(
+                        RULE_ID, node, symbol,
+                        f'{symbol} reachable from traced function '
+                        f'{tf.name!r} (via {tf.via}) through '
+                        f'{edge.callee}: {reason}',
+                        call_chain=full_chain))
     return findings
 
 
 RULES = (skylint.Rule(
     id=RULE_ID,
     summary='no host syncs (.item/float/print/time.time/np.asarray) '
-            'inside jit/scan bodies',
+            'inside or reachable from jit/scan bodies',
     check=check,
-    scope=in_scope),)
+    scope=in_scope,
+    project=True),)

@@ -1,0 +1,281 @@
+"""key-reuse: a PRNG key must not feed two consumers.
+
+``jax.random`` keys are pure values: feeding the same key to two
+sampling calls (or to ``split`` and then a sampler) produces
+*identical* randomness at both sites — in this repo that means a
+sampler drawing the same token twice, or every batch lane of a decode
+loop sharing one stream.  The functional contract is linear: every
+consumption must be preceded by a fresh ``split`` / ``fold_in``
+derivation.
+
+The rule tracks key-typed locals through each function body in source
+order: names bound from ``jax.random.PRNGKey`` / ``key`` / ``split`` /
+``fold_in`` (through import aliases — ``from jax import random as
+jr`` resolves), plus parameters with key-ish names (``key``, ``rng``,
+``*_key``, ``*_rng``).  Passing a tracked key bare into any call
+consumes it; a second consumption without an intervening rebind is
+flagged with both sites in the call chain.  Sanctioned non-consuming
+shapes:
+
+* ``fold_in(key, i)`` — per-data derivation from a reusable root key
+  (the repo's vmapped per-lane idiom); the root stays fresh.
+* ``key[i]`` / ``key.shape`` — indexing an array of keys or reading
+  metadata, not a handoff.
+* exclusive ``if``/``else`` arms — one consumption per path is linear;
+  branch states fork and re-merge.
+
+Loop bodies (and comprehension elements) are scanned twice so a key
+consumed in iteration *n* and again in *n+1* — the classic unrefreshed
+loop key — is caught even though the body text consumes it once.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from skypilot_tpu.devtools import skylint
+from skypilot_tpu.devtools.rules import _jit
+
+RULE_ID = 'key-reuse'
+
+# Producers bind fresh keys when their result is assigned.  fold_in is
+# both a producer (its result is fresh) and non-consuming of its input.
+_PRODUCERS = {'PRNGKey', 'key', 'split', 'fold_in', 'wrap_key_data'}
+_NONCONSUMING = {'fold_in', 'key_data', 'wrap_key_data'}
+
+_KEYISH = re.compile(r'(.*_)?(key|rng|subkey)$')
+
+# First use of a key; None in the state map means "fresh".
+_Use = Tuple[str, int]
+
+
+def in_scope(posix: str) -> bool:
+    parts = posix.split('/')
+    return ('infer' in parts or 'models' in parts or 'ops' in parts
+            or 'train' in parts)
+
+
+def _resolve(dotted: Optional[str],
+             imports: Dict[str, str]) -> Optional[str]:
+    if not dotted:
+        return None
+    head, _, rest = dotted.partition('.')
+    target = imports.get(head)
+    if target:
+        return f'{target}.{rest}' if rest else target
+    return dotted
+
+
+def _random_fn(call: ast.Call,
+               imports: Dict[str, str]) -> Optional[str]:
+    """Last component when ``call`` is a jax.random.* function."""
+    resolved = _resolve(_jit._dotted(call.func), imports)
+    if not resolved:
+        return None
+    base, _, last = resolved.rpartition('.')
+    if base in ('jax.random', 'random') or base.endswith('.random'):
+        return last
+    # `from jax.random import split` resolves to 'jax.random.split'
+    # already; a bare producer name with no dots is not trusted.
+    return None
+
+
+class _Scanner:
+    """Linear scan of one function body tracking key freshness."""
+
+    def __init__(self, ctx, fn_name: str, imports: Dict[str, str],
+                 findings: List[skylint.Finding]) -> None:
+        self.ctx = ctx
+        self.fn_name = fn_name
+        self.imports = imports
+        self.findings = findings
+        self.emitted: Set[Tuple[str, int]] = set()
+
+    # -- consumption --------------------------------------------------
+
+    def consume(self, name: str, node: ast.AST, desc: str,
+                state: Dict[str, Optional[_Use]]) -> None:
+        if name not in state:
+            return
+        first = state[name]
+        if first is None:
+            state[name] = (desc, node.lineno)
+            return
+        dedupe = (name, id(node))
+        if dedupe in self.emitted:
+            return
+        self.emitted.add(dedupe)
+        first_desc, first_line = first
+        self.findings.append(self.ctx.finding(
+            RULE_ID, node, f'{self.fn_name}.{name}',
+            f'PRNG key {name!r} already consumed by {first_desc} at '
+            f'line {first_line} flows into a second consumer here '
+            f'without split/fold_in — both sites draw identical '
+            f'randomness',
+            call_chain=(f'{name} -> {first_desc} '
+                        f'({self.ctx.posix}:{first_line})',
+                        f'{name} reused '
+                        f'({self.ctx.posix}:{node.lineno})')))
+
+    # -- expressions --------------------------------------------------
+
+    def expr(self, node: ast.AST,
+             state: Dict[str, Optional[_Use]]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return    # separate scope
+        if isinstance(node, ast.IfExp):
+            self.expr(node.test, state)
+            left, right = dict(state), dict(state)
+            self.expr(node.body, left)
+            self.expr(node.orelse, right)
+            _merge(state, left, right)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            for gen in node.generators:
+                self.expr(gen.iter, state)
+            # Element runs once per item: scan twice so an unrefreshed
+            # key reused across items surfaces.
+            elts = (node.key, node.value) \
+                if isinstance(node, ast.DictComp) else (node.elt,)
+            for _ in range(2):
+                for e in elts:
+                    self.expr(e, state)
+            return
+        if isinstance(node, ast.Call):
+            for child in ast.iter_child_nodes(node):
+                self.expr(child, state)
+            rand = _random_fn(node, self.imports)
+            if rand in _NONCONSUMING:
+                return
+            callee = _jit._dotted(node.func) or '<call>'
+            if callee.rpartition('.')[2] == 'eval_shape':
+                return    # abstract evaluation: no randomness drawn
+            desc = f'{callee}()'
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    self.consume(arg.id, arg, desc, state)
+            for kw in node.keywords:
+                if isinstance(kw.value, ast.Name):
+                    self.consume(kw.value.id, kw.value, desc, state)
+            return
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            # key.shape / keys[i] read metadata or select an element;
+            # not a handoff of the tracked binding itself.
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, ast.Name):
+                    self.expr(child, state)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.expr(child, state)
+
+    # -- statements ---------------------------------------------------
+
+    def block(self, stmts: List[ast.stmt],
+              state: Dict[str, Optional[_Use]]) -> None:
+        for stmt in stmts:
+            self.stmt(stmt, state)
+
+    def stmt(self, stmt: ast.stmt,
+             state: Dict[str, Optional[_Use]]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                             ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self.expr(value, state)
+            fresh = (isinstance(value, ast.Call)
+                     and _random_fn(value, self.imports)
+                     in _PRODUCERS)
+            targets = stmt.targets \
+                if isinstance(stmt, ast.Assign) else [stmt.target]
+            for name in _target_names(targets):
+                if fresh:
+                    state[name] = None
+                else:
+                    state.pop(name, None)
+            return
+        if isinstance(stmt, ast.If):
+            self.expr(stmt.test, state)
+            left, right = dict(state), dict(state)
+            self.block(stmt.body, left)
+            self.block(stmt.orelse, right)
+            _merge(state, left, right)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.expr(stmt.iter, state)
+            for name in _target_names([stmt.target]):
+                state.pop(name, None)
+            for _ in range(2):          # cross-iteration reuse
+                self.block(stmt.body, state)
+            self.block(stmt.orelse, state)
+            return
+        if isinstance(stmt, ast.While):
+            self.expr(stmt.test, state)
+            for _ in range(2):
+                self.block(stmt.body, state)
+            self.block(stmt.orelse, state)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.expr(item.context_expr, state)
+            self.block(stmt.body, state)
+            return
+        if isinstance(stmt, ast.Try):
+            self.block(stmt.body, state)
+            for handler in stmt.handlers:
+                self.block(handler.body, state)
+            self.block(stmt.orelse, state)
+            self.block(stmt.finalbody, state)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            self.expr(child, state)
+
+
+def _merge(state: Dict[str, Optional[_Use]],
+           left: Dict[str, Optional[_Use]],
+           right: Dict[str, Optional[_Use]]) -> None:
+    """Join branch states: consumed-on-either-path wins (a use after
+    the join is a reuse on at least one path)."""
+    state.clear()
+    for name in set(left) | set(right):
+        a, b = left.get(name), right.get(name)
+        state[name] = a if a is not None else b
+
+
+def _target_names(targets: List[ast.AST]) -> Iterable[str]:
+    for target in targets:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                yield node.id
+
+
+def check(project) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for mod in project.iter_modules(in_scope):
+        for fn in project.functions.values():
+            if fn.module is not mod \
+                    or isinstance(fn.node, ast.Lambda):
+                continue
+            args = fn.node.args
+            state: Dict[str, Optional[_Use]] = {}
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                if _KEYISH.fullmatch(a.arg):
+                    state[a.arg] = None
+            scanner = _Scanner(mod.ctx, fn.name, mod.imports,
+                               findings)
+            scanner.block(fn.node.body, state)
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='a jax.random key must be split/fold_in-refreshed between '
+            'consumers — reuse draws identical randomness',
+    check=check,
+    scope=in_scope,
+    project=True),)

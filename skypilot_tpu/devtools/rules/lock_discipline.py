@@ -13,6 +13,13 @@ The companion thread-discipline rule (same family) flags
 ``threading.Thread(...)`` constructions without an explicit
 ``daemon=`` — an undeclared lifetime is how shutdown hangs and leaked
 non-daemon threads block interpreter exit.
+
+This is the per-file half of the lock story: it keeps each lock's own
+region honest.  The whole-program half is ``lock-order-discipline``
+(rules/lock_order.py), which takes the project call graph and checks
+*pairwise* properties a single file can't show — acquire-while-
+holding cycles across classes and stale check-then-act around locked
+mutations.
 """
 from __future__ import annotations
 

@@ -1,0 +1,355 @@
+"""lock-order-discipline: deadlock-shaped lock usage across the fleet.
+
+The serving stack is a web of small locks: the engine's submit lock,
+the page allocator's lock, the router table lock, per-breaker locks,
+the metrics registry lock.  Each is individually disciplined
+(``lock-discipline`` enforces that), but deadlocks are a *pairwise*
+property: thread 1 takes A then B while thread 2 takes B then A, and
+nothing in either file looks wrong.  This rule builds the
+acquire-while-holding graph over every ``with self.<...lock>:`` region
+in ``infer/``, ``serve/`` and ``observability/`` — including locks
+acquired *transitively* through the project call graph (engine holds
+its submit lock and calls an allocator method that takes the allocator
+lock) — and reports:
+
+* **cycles** in the graph: a potential deadlock, with both acquire
+  sites and the call chains that close the loop; and
+* **check-then-act hazards**: a lock-protected attribute read outside
+  the lock in a conditional that guards a mutation of the same
+  attribute inside the lock.  Unless the locked region re-checks the
+  attribute (double-checked locking — the sanctioned pattern), the
+  check is stale by the time the lock arrives.
+
+Lock identity is ``Class.attr`` — two classes' ``_lock`` attributes
+are distinct locks (one per instance is assumed; a shared-instance
+lock handed between objects is out of AST reach).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from skypilot_tpu.devtools import skylint
+
+RULE_ID = 'lock-order-discipline'
+
+_MUTATORS = {'append', 'appendleft', 'extend', 'insert', 'add',
+             'update', 'setdefault', 'pop', 'popleft', 'popitem',
+             'remove', 'discard', 'clear', 'put'}
+
+_EXEMPT_METHODS = {'__init__', '__new__', '__del__'}
+
+_MAX_DEPTH = 5
+
+
+def in_scope(posix: str) -> bool:
+    parts = posix.split('/')
+    return ('infer' in parts or 'serve' in parts
+            or 'observability' in parts)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == 'self':
+        return node.attr
+    return None
+
+
+def _lock_attr(item: ast.withitem) -> Optional[str]:
+    attr = _self_attr(item.context_expr)
+    if attr is not None and 'lock' in attr.lower():
+        return attr
+    return None
+
+
+@dataclasses.dataclass
+class _Edge:
+    """held -> acquired, with the site that closes it."""
+    held: str
+    acquired: str
+    node: ast.AST
+    mod: object                       # ModuleInfo of the site
+    chain: Tuple[str, ...] = ()       # call chain for transitive edges
+
+
+def _direct_acquires(project, fn) -> List[Tuple[str, ast.AST]]:
+    """(lock_id, with_node) for every lock this function takes."""
+    if fn.cls is None:
+        return []
+    out = []
+    for node in project.walk_own(fn):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                attr = _lock_attr(item)
+                if attr is not None:
+                    out.append((f'{fn.cls.qname}.{attr}', node))
+    return out
+
+
+def _acquired_locks(project, qname: str,
+                    memo: Dict[str, Dict[str, Tuple[str, ...]]],
+                    stack: Set[str],
+                    depth: int) -> Dict[str, Tuple[str, ...]]:
+    """lock_id -> call chain, for every lock ``qname`` may take
+    (directly or through its callees)."""
+    if qname in memo:
+        return memo[qname]
+    if qname in stack or depth <= 0:
+        return {}
+    fn = project.functions.get(qname)
+    if fn is None:
+        return {}
+    stack.add(qname)
+    out: Dict[str, Tuple[str, ...]] = {}
+    for lock_id, _ in _direct_acquires(project, fn):
+        out.setdefault(lock_id, (qname,))
+    for edge in project.calls_of(qname):
+        for lock_id, chain in _acquired_locks(
+                project, edge.callee, memo, stack, depth - 1).items():
+            out.setdefault(lock_id, (qname,) + chain)
+    stack.discard(qname)
+    memo[qname] = out
+    return out
+
+
+def _short(lock_id: str) -> str:
+    """'pkg.mod.Class.attr' -> 'Class.attr' for messages."""
+    parts = lock_id.split('.')
+    return '.'.join(parts[-2:])
+
+
+def _collect_edges(project) -> List[_Edge]:
+    edges: List[_Edge] = []
+    memo: Dict[str, Dict[str, Tuple[str, ...]]] = {}
+    for mod in project.iter_modules(in_scope):
+        for fn in project.functions.values():
+            if fn.module is not mod or fn.cls is None:
+                continue
+
+            def visit(node: ast.AST, held: List[str]) -> None:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda,
+                                     ast.ClassDef)):
+                    return
+                if isinstance(node, ast.With):
+                    acquired = [f'{fn.cls.qname}.{a}'
+                                for a in (_lock_attr(i)
+                                          for i in node.items)
+                                if a is not None]
+                    for lock_id in acquired:
+                        for h in held:
+                            if h != lock_id:
+                                edges.append(_Edge(h, lock_id, node,
+                                                   mod))
+                    inner = held + acquired
+                    for child in ast.iter_child_nodes(node):
+                        visit(child, inner)
+                    return
+                if isinstance(node, ast.Call) and held:
+                    edge = project.edge_for_call(node)
+                    if edge is not None:
+                        for lock_id, chain in _acquired_locks(
+                                project, edge.callee, memo, set(),
+                                _MAX_DEPTH).items():
+                            for h in held:
+                                if h != lock_id:
+                                    edges.append(_Edge(
+                                        h, lock_id, node, mod,
+                                        chain=chain))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+
+            for stmt in fn.node.body:
+                visit(stmt, [])
+    return edges
+
+
+def _find_cycles(edges: List[_Edge]) -> List[Tuple[List[_Edge],
+                                                   List[str]]]:
+    """Each cycle once: (participating first-seen edges, node path)."""
+    graph: Dict[str, Dict[str, _Edge]] = {}
+    for e in edges:
+        graph.setdefault(e.held, {}).setdefault(e.acquired, e)
+    cycles: List[Tuple[List[_Edge], List[str]]] = []
+    seen: Set[frozenset] = set()
+
+    def dfs(start: str, cur: str, path: List[str]) -> None:
+        for nxt in sorted(graph.get(cur, ())):
+            if nxt == start and len(path) > 1:
+                key = frozenset(path)
+                if key not in seen:
+                    seen.add(key)
+                    cyc_edges = [graph[a][b] for a, b in
+                                 zip(path, path[1:] + [start])]
+                    cycles.append((cyc_edges, path + [start]))
+            elif nxt not in path and len(path) < 6:
+                dfs(start, nxt, path + [nxt])
+
+    for node in sorted(graph):
+        dfs(node, node, [node])
+    return cycles
+
+
+def _check_then_act(project) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    for mod in project.iter_modules(in_scope):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            protected = _protected_attrs(node)
+            if not protected:
+                continue
+            findings.extend(
+                _scan_class_check_act(mod, node, protected))
+    return findings
+
+
+def _protected_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attrs written under any ``with self.<lock>`` in this class."""
+    protected: Set[str] = set()
+
+    def visit(node: ast.AST, in_lock: bool) -> None:
+        if isinstance(node, ast.With):
+            in_lock = in_lock or any(_lock_attr(i) for i in node.items)
+        if in_lock:
+            for attr in _written_attrs(node):
+                protected.add(attr)
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_lock)
+
+    visit(cls, False)
+    return protected
+
+
+def _written_attrs(node: ast.AST) -> Iterable[str]:
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for target in targets:
+            attr = _self_attr(target)
+            if attr:
+                yield attr
+    elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        func = node.value.func
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            attr = _self_attr(func.value)
+            if attr:
+                yield attr
+
+
+def _read_attrs(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) \
+                and isinstance(sub.ctx, ast.Load) \
+                and isinstance(sub.value, ast.Name) \
+                and sub.value.id == 'self':
+            out.add(sub.attr)
+    return out
+
+
+def _scan_class_check_act(mod, cls: ast.ClassDef,
+                          protected: Set[str]
+                          ) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+
+    def visit(node: ast.AST, in_lock: bool, method: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            method = node.name if method == '<class>' else method
+            for child in node.body:
+                visit(child, in_lock, method)
+            return
+        if isinstance(node, ast.With):
+            locked = in_lock or any(_lock_attr(i) for i in node.items)
+            for child in node.body:
+                visit(child, locked, method)
+            return
+        if isinstance(node, ast.If) and not in_lock \
+                and method not in _EXEMPT_METHODS:
+            checked = _read_attrs(node.test) & protected
+            if checked:
+                hazards = _locked_writes_without_recheck(node, checked)
+                for attr in sorted(hazards):
+                    findings.append(skylint.Finding(
+                        rule=RULE_ID, path=mod.ctx.path,
+                        line=node.lineno, col=node.col_offset + 1,
+                        symbol=f'{cls.name}.{attr}',
+                        message=(
+                            f'check-then-act: {cls.name}.{attr} is '
+                            f'read outside the lock in this '
+                            f'conditional but mutated under the lock '
+                            f'inside it ({method}()); the check is '
+                            f'stale once the lock arrives — re-check '
+                            f'{attr!r} inside the locked region or '
+                            f'take the lock around the test')))
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_lock, method)
+
+    for stmt in cls.body:
+        visit(stmt, False, '<class>')
+    return findings
+
+
+def _locked_writes_without_recheck(if_node: ast.If,
+                                   attr_set: Set[str]) -> Set[str]:
+    """Attrs from ``attr_set`` that a with-lock region inside
+    ``if_node`` mutates WITHOUT re-reading in a nested test."""
+    hazards: Set[str] = set()
+    for sub in ast.walk(if_node):
+        if not isinstance(sub, ast.With) \
+                or not any(_lock_attr(i) for i in sub.items):
+            continue
+        written: Set[str] = set()
+        rechecked: Set[str] = set()
+        for inner in ast.walk(sub):
+            for attr in _written_attrs(inner):
+                written.add(attr)
+            if isinstance(inner, (ast.If, ast.IfExp, ast.While)):
+                rechecked |= _read_attrs(inner.test)
+            elif isinstance(inner, ast.Assert):
+                rechecked |= _read_attrs(inner.test)
+        hazards |= (written & attr_set) - rechecked
+    return hazards
+
+
+def check(project) -> Iterable[skylint.Finding]:
+    findings: List[skylint.Finding] = []
+    edges = _collect_edges(project)
+    for cyc_edges, path in _find_cycles(edges):
+        anchor = cyc_edges[0]
+        route = ' -> '.join(_short(p) for p in path)
+        sites = '; '.join(
+            f'{_short(e.held)} held while acquiring '
+            f'{_short(e.acquired)} at {e.mod.posix}:{e.node.lineno}'
+            + (f' (via {" -> ".join(e.chain)})' if e.chain else '')
+            for e in cyc_edges)
+        chain: List[str] = []
+        for e in cyc_edges:
+            chain.append(f'{_short(e.held)} -> {_short(e.acquired)} '
+                         f'({e.mod.posix}:{e.node.lineno})')
+            chain.extend(e.chain)
+        findings.append(skylint.Finding(
+            rule=RULE_ID, path=anchor.mod.ctx.path,
+            line=anchor.node.lineno, col=anchor.node.col_offset + 1,
+            symbol='cycle:' + '+'.join(
+                sorted({_short(p) for p in path})),
+            message=f'lock-order cycle (potential deadlock): {route}. '
+                    f'Acquire sites: {sites}. Pick one global order '
+                    f'and release before crossing it.',
+            call_chain=tuple(chain)))
+    findings.extend(_check_then_act(project))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='no acquire-while-holding cycles across classes; no '
+            'stale check-then-act around locked mutations '
+            '(infer/, serve/, observability/)',
+    check=check,
+    scope=in_scope,
+    project=True),)

@@ -7,11 +7,17 @@ recompiles on every distinct value, which on TPU means a multi-second
 XLA compile stalling the whole slice.  Either way the parameter must
 be declared via ``static_argnames``/``static_argnums`` (the repo's
 decode/prefill jits all do this; the rule keeps it that way).
+
+2.0: the check follows the traced parameter **through calls**.  A jit
+body that forwards its traced ``top_k`` to a helper (same module or
+imported) which then branches on it retraces exactly the same way; the
+finding anchors at the forwarding call inside the jit body and carries
+the call chain down to the consuming branch/shape site.
 """
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Set
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
 from skypilot_tpu.devtools import skylint
 from skypilot_tpu.devtools.rules import _jit
@@ -20,6 +26,8 @@ RULE_ID = 'retrace-hazard'
 
 _SHAPE_FNS = {'zeros', 'ones', 'full', 'empty', 'arange', 'iota',
               'broadcast_to', 'reshape', 'broadcasted_iota'}
+
+_MAX_DEPTH = 6
 
 
 def _bare_names(node: ast.AST) -> Set[str]:
@@ -64,63 +72,152 @@ def _branch_hazards(test: ast.AST) -> Set[str]:
     return hazards
 
 
-def check(ctx: skylint.FileContext) -> Iterable[skylint.Finding]:
-    index = _jit.JitIndex(ctx.tree)
+def _scan_hazards(body: List[ast.stmt],
+                  candidates: Set[str]
+                  ) -> Iterator[Tuple[str, ast.AST, str]]:
+    """Yield (name, node, where) for every scalar consumption of a
+    candidate name in ``body``."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                for name in _branch_hazards(node.test):
+                    if name in candidates:
+                        yield name, node, 'a Python branch test'
+            elif isinstance(node, ast.Call):
+                func = node.func
+                callee = None
+                if isinstance(func, ast.Name):
+                    callee = func.id
+                elif isinstance(func, ast.Attribute):
+                    callee = func.attr
+                if callee == 'range':
+                    for arg in node.args:
+                        for name in _bare_names(arg):
+                            if name in candidates:
+                                yield name, node, 'range()'
+                elif callee in _SHAPE_FNS and node.args:
+                    shape_args = [node.args[0]]
+                    if callee == 'reshape':
+                        shape_args = list(node.args)
+                    for arg in shape_args:
+                        for name in _bare_names(arg):
+                            if name in candidates:
+                                yield (name, node,
+                                       f'the shape argument of '
+                                       f'{callee}()')
+
+
+def _map_tainted_args(edge, callee_fn,
+                      taint: Dict[str, str]) -> Dict[str, str]:
+    """callee param -> originating jit param, for every argument at
+    ``edge`` that passes a tainted name bare."""
+    args = callee_fn.node.args
+    params = [a.arg for a in args.posonlyargs + args.args]
+    # Bound method call: `self` is not at the call site.  partial
+    # edges carry their own arg/param shift (-1 at the partial()
+    # site itself, +prebound when calling the bound local).
+    offset = edge.arg_offset
+    if params[:1] == ['self'] and edge.via in ('self', 'instance'):
+        offset += 1
+    mapping: Dict[str, str] = {}
+    for i, arg in enumerate(edge.node.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if isinstance(arg, ast.Name) and arg.id in taint:
+            idx = i + offset
+            if 0 <= idx < len(params):
+                mapping[params[idx]] = taint[arg.id]
+    kwonly = {a.arg for a in args.kwonlyargs}
+    for kw in edge.node.keywords:
+        if kw.arg and isinstance(kw.value, ast.Name) \
+                and kw.value.id in taint \
+                and (kw.arg in params or kw.arg in kwonly):
+            mapping[kw.arg] = taint[kw.value.id]
+    return mapping
+
+
+def check(project) -> Iterable[skylint.Finding]:
     findings: List[skylint.Finding] = []
-    for tf in index.traced:
-        if not tf.jitted or isinstance(tf.node, ast.Lambda):
-            continue
-        static = _jit.nontraced_static_params(tf)
-        traced_params = [p for p in _jit.param_names(tf)
-                         if p not in static]
-        if not traced_params:
-            continue
-        flagged: Set[str] = set()
+    for mod in project.iter_modules():
+        ctx = mod.ctx
+        index = project.jit_index(mod.name)
+        for tf in index.traced:
+            if not tf.jitted or isinstance(tf.node, ast.Lambda):
+                continue
+            static = _jit.nontraced_static_params(tf)
+            traced_params = [p for p in _jit.param_names(tf)
+                             if p not in static]
+            if not traced_params:
+                continue
+            flagged: Set[str] = set()
 
-        def emit(param: str, node: ast.AST, where: str) -> None:
-            if param in flagged:
-                return
-            flagged.add(param)
-            findings.append(ctx.finding(
-                RULE_ID, node, f'{tf.name}.{param}',
-                f'parameter {param!r} of jitted {tf.name!r} is '
-                f'consumed as a Python scalar in {where}; declare it '
-                f'in static_argnames (or static_argnums) to avoid a '
-                f'retrace per value / tracer-bool error'))
+            def emit(param: str, node: ast.AST, where: str,
+                     chain: Tuple[str, ...] = ()) -> None:
+                if param in flagged:
+                    return
+                flagged.add(param)
+                findings.append(ctx.finding(
+                    RULE_ID, node, f'{tf.name}.{param}',
+                    f'parameter {param!r} of jitted {tf.name!r} is '
+                    f'consumed as a Python scalar in {where}; '
+                    f'declare it in static_argnames (or '
+                    f'static_argnums) to avoid a retrace per value / '
+                    f'tracer-bool error', call_chain=chain))
 
-        for stmt in tf.node.body:
-            for node in ast.walk(stmt):
-                if isinstance(node, (ast.If, ast.While, ast.IfExp)):
-                    for name in _branch_hazards(node.test):
-                        if name in traced_params:
-                            emit(name, node, 'a Python branch test')
-                elif isinstance(node, ast.Call):
-                    func = node.func
-                    callee = None
-                    if isinstance(func, ast.Name):
-                        callee = func.id
-                    elif isinstance(func, ast.Attribute):
-                        callee = func.attr
-                    if callee == 'range':
-                        for arg in node.args:
-                            for name in _bare_names(arg):
-                                if name in traced_params:
-                                    emit(name, node, 'range()')
-                    elif callee in _SHAPE_FNS and node.args:
-                        shape_args = [node.args[0]]
-                        if callee == 'reshape':
-                            shape_args = list(node.args)
-                        for arg in shape_args:
-                            for name in _bare_names(arg):
-                                if name in traced_params:
-                                    emit(name, node,
-                                         f'the shape argument of '
-                                         f'{callee}()')
+            # Direct consumption inside the jit body (1.x behavior).
+            for name, node, where in _scan_hazards(
+                    tf.node.body, set(traced_params)):
+                if name in traced_params:
+                    emit(name, node, where)
+
+            # Interprocedural: follow tainted params through calls.
+            fi = project.function_for_node(tf.node)
+            if fi is None:
+                continue
+            seen: Set[Tuple[str, frozenset]] = set()
+            stack: List[Tuple[str, Dict[str, str],
+                              Optional[ast.AST], Tuple[str, ...],
+                              int]] = [
+                (fi.qname, {p: p for p in traced_params}, None, (),
+                 _MAX_DEPTH)]
+            while stack:
+                qname, taint, anchor, chain, depth = stack.pop()
+                if depth <= 0:
+                    continue
+                for edge in project.calls_of(qname):
+                    callee_fn = project.functions.get(edge.callee)
+                    if callee_fn is None \
+                            or isinstance(callee_fn.node, ast.Lambda):
+                        continue
+                    mapping = _map_tainted_args(edge, callee_fn, taint)
+                    if not mapping:
+                        continue
+                    key = (edge.callee, frozenset(mapping.items()))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    hop_anchor = anchor if anchor is not None \
+                        else edge.node
+                    hop = (f'{edge.callee} '
+                           f'({callee_fn.module.posix}:'
+                           f'{callee_fn.node.lineno})')
+                    new_chain = chain + (hop,)
+                    for name, node, where in _scan_hazards(
+                            callee_fn.node.body, set(mapping)):
+                        emit(mapping[name], hop_anchor,
+                             f'{where} of {edge.callee}',
+                             new_chain
+                             + (f'{where} at '
+                                f'{callee_fn.module.posix}:'
+                                f'{node.lineno}',))
+                    stack.append((edge.callee, mapping, hop_anchor,
+                                  new_chain, depth - 1))
     return findings
 
 
 RULES = (skylint.Rule(
     id=RULE_ID,
-    summary='jitted params used in shape/branch position must be '
-            'static_argnames/static_argnums',
-    check=check),)
+    summary='jitted params used in shape/branch position (directly or '
+            'through calls) must be static_argnames/static_argnums',
+    check=check,
+    project=True),)

@@ -9,10 +9,25 @@ that is not silently promoted to f32.  skylint walks the AST and flags
 violations of each, so the contracts gate every PR via tier-1 instead
 of relying on review vigilance.
 
+skylint 2.0 is two-tier: every file is parsed **exactly once** into a
+shared whole-program index (``devtools/analysis.py`` — module graph,
+symbol table, interprocedural call graph, per-module jit table), and
+rules come in two shapes: per-file visitors (``Rule.project=False``,
+handed one ``FileContext``) and whole-program rules
+(``Rule.project=True``, handed the ``analysis.Project``) whose
+findings can cross module boundaries and carry the call chain that
+reached the hazard.
+
 Usage::
 
     python -m skypilot_tpu.devtools.skylint [--format text|json]
-        [--rule RULE]... [--baseline PATH | --no-baseline] paths...
+        [--rule RULE]... [--baseline PATH | --no-baseline]
+        [--changed-only [BASE]] paths...
+
+``--changed-only`` restricts *findings* to files changed vs the git
+base ref (default HEAD) — the whole-program index is still built over
+every scanned file, so transitive findings stay correct while
+pre-commit runs stay fast.
 
 Exit status: 0 when no unsuppressed findings, 1 otherwise, 2 on usage
 errors.
@@ -24,8 +39,11 @@ Suppression comes in two layers:
   anywhere in a file disables the rule for that whole file.
 * baseline — a committed ``.skylint-baseline`` file (discovered by
   walking up from the first scanned path, or passed via ``--baseline``)
-  with one ``rule:path:symbol`` entry per line; ``path`` and ``symbol``
-  are fnmatch globs resolved relative to the baseline's directory.
+  with one ``rule:path:symbol`` entry per line (``path``/``symbol``
+  are fnmatch globs resolved relative to the baseline's directory), or
+  one ``fingerprint:<hex>`` entry pinning a single finding by its
+  stable fingerprint (rule + normalized path + symbol), which survives
+  line-number churn.
 
 Pure stdlib on purpose: importing this module must never pull in jax,
 so the pass can run in CI lanes and pre-flight hooks (e.g. the
@@ -37,13 +55,19 @@ import argparse
 import ast
 import dataclasses
 import fnmatch
+import hashlib
 import json
 import os
 import re
+import subprocess
 import sys
-from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 BASELINE_FILENAME = '.skylint-baseline'
+
+# Incremented once per ast.parse — the single-parse property tier-1
+# asserts (every rule shares one parse per file via the Project index).
+PARSE_COUNT = 0
 
 _DISABLE_RE = re.compile(
     r'#\s*skylint:\s*disable=([A-Za-z0-9_,\- ]+)')
@@ -57,7 +81,12 @@ class Finding:
 
     ``symbol`` is a stable, line-number-free identifier (attribute
     name, metric name, flagged call...) so baseline entries survive
-    unrelated edits to the file.
+    unrelated edits to the file.  ``call_chain`` is non-empty for
+    transitive findings from whole-program rules: each hop is
+    ``qname (path:line)`` from the flagged site down to the hazard.
+    ``fingerprint`` = sha1(rule|normalized path|symbol)[:12], stamped
+    by the lint driver, so baselines can pin one finding without
+    depending on line numbers.
     """
     rule: str
     path: str
@@ -67,15 +96,28 @@ class Finding:
     message: str
     suppressed: bool = False
     suppressed_by: str = ''
+    call_chain: Tuple[str, ...] = ()
+    fingerprint: str = ''
 
     def to_dict(self) -> Dict[str, object]:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d['call_chain'] = list(self.call_chain)
+        return d
 
     def render(self) -> str:
         tag = f'  [suppressed: {self.suppressed_by}]' \
             if self.suppressed else ''
+        chain = ''
+        if self.call_chain:
+            chain = '\n    via ' + '\n     -> '.join(self.call_chain)
         return (f'{self.path}:{self.line}:{self.col}: '
-                f'{self.rule}: {self.message}{tag}')
+                f'{self.rule}: {self.message}{tag}{chain}')
+
+
+def fingerprint_of(rule: str, rel_posix: str, symbol: str) -> str:
+    """Stable identity of a finding independent of line numbers."""
+    blob = f'{rule}|{rel_posix}|{symbol}'.encode('utf-8')
+    return hashlib.sha1(blob).hexdigest()[:12]
 
 
 class FileContext:
@@ -87,7 +129,11 @@ class FileContext:
         self.posix = path.replace(os.sep, '/')
         self.source = source
         self.lines = source.splitlines()
-        self.tree = tree if tree is not None else ast.parse(source)
+        if tree is None:
+            global PARSE_COUNT
+            PARSE_COUNT += 1
+            tree = ast.parse(source)
+        self.tree = tree
         self.disabled_lines: Dict[int, Set[str]] = {}
         self.disabled_file: Set[str] = set()
         for lineno, line in enumerate(self.lines, start=1):
@@ -114,20 +160,26 @@ class FileContext:
         return rule in rules or 'all' in rules
 
     def finding(self, rule: str, node: ast.AST, symbol: str,
-                message: str) -> Finding:
+                message: str,
+                call_chain: Sequence[str] = ()) -> Finding:
         return Finding(rule=rule, path=self.path,
                        line=getattr(node, 'lineno', 1),
                        col=getattr(node, 'col_offset', 0) + 1,
-                       symbol=symbol, message=message)
+                       symbol=symbol, message=message,
+                       call_chain=tuple(call_chain))
 
 
 @dataclasses.dataclass(frozen=True)
 class Rule:
     id: str
     summary: str
-    check: Callable[[FileContext], Iterable[Finding]]
+    # project=False: check(FileContext) per scoped file.
+    # project=True: check(analysis.Project) once per lint run; the
+    # rule iterates the modules it cares about itself.
+    check: Callable[..., Iterable[Finding]]
     # posix path -> whether the rule applies to this file.
     scope: Callable[[str], bool] = lambda posix: True
+    project: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,8 +187,11 @@ class BaselineEntry:
     rule: str
     path_glob: str
     symbol_glob: str
+    fingerprint: str = ''
 
     def matches(self, finding: Finding, rel_posix: str) -> bool:
+        if self.fingerprint:
+            return finding.fingerprint == self.fingerprint
         return (self.rule == finding.rule
                 and fnmatch.fnmatch(rel_posix, self.path_glob)
                 and fnmatch.fnmatch(finding.symbol, self.symbol_glob))
@@ -150,12 +205,17 @@ def load_baseline(path: str) -> List[BaselineEntry]:
             if not line or line.startswith('#'):
                 continue
             parts = line.split(':')
+            if parts[0] == 'fingerprint' and len(parts) == 2:
+                entries.append(BaselineEntry(
+                    rule='*', path_glob='*', symbol_glob='*',
+                    fingerprint=parts[1].strip()))
+                continue
             if len(parts) == 2:
                 parts.append('*')
             if len(parts) != 3:
                 raise ValueError(
                     f'{path}: bad baseline entry {line!r} '
-                    f'(want rule:path[:symbol])')
+                    f'(want rule:path[:symbol] or fingerprint:<hex>)')
             entries.append(BaselineEntry(*[p.strip() for p in parts]))
     return entries
 
@@ -202,47 +262,91 @@ def lint_files(files: Sequence[str],
                baseline_root: Optional[str] = None) -> List[Finding]:
     """Lint ``files`` and return every finding, suppressed ones flagged.
 
-    ``baseline_root`` anchors the relative paths the baseline globs are
-    matched against (defaults to cwd).
+    Each file is parsed exactly once; per-file rules run over the
+    resulting contexts and whole-program rules run once over the shared
+    ``analysis.Project`` built from them.  ``baseline_root`` anchors
+    the relative paths the baseline globs are matched against
+    (defaults to cwd).
     """
+    from skypilot_tpu.devtools import analysis
     rules = list(rules) if rules is not None else all_rules()
     baseline = list(baseline or ())
     root = os.path.abspath(baseline_root or os.getcwd())
     findings: List[Finding] = []
+    contexts: Dict[str, FileContext] = {}
     for path in files:
         try:
             with open(path, encoding='utf-8') as f:
                 source = f.read()
-            ctx = FileContext(path, source)
+            contexts[path] = FileContext(path, source)
         except (OSError, SyntaxError, ValueError) as e:
             findings.append(Finding(
                 rule='parse-error', path=path, line=1, col=1,
                 symbol='parse', message=f'could not lint: {e}'))
-            continue
-        rel = os.path.relpath(os.path.abspath(path), root)
-        rel_posix = rel.replace(os.sep, '/')
-        for rule in rules:
+    file_rules = [r for r in rules if not r.project]
+    project_rules = [r for r in rules if r.project]
+    for ctx in contexts.values():
+        for rule in file_rules:
             if not rule.scope(ctx.posix):
                 continue
-            for finding in rule.check(ctx):
-                if ctx.inline_disabled(finding.rule, finding.line):
-                    finding = dataclasses.replace(
-                        finding, suppressed=True, suppressed_by='inline')
-                elif any(e.matches(finding, rel_posix)
-                         for e in baseline):
-                    finding = dataclasses.replace(
-                        finding, suppressed=True,
-                        suppressed_by='baseline')
-                findings.append(finding)
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings
+            findings.extend(rule.check(ctx))
+    if project_rules:
+        project = analysis.Project(contexts.values())
+        for rule in project_rules:
+            findings.extend(rule.check(project))
+    out: List[Finding] = []
+    for finding in findings:
+        rel = os.path.relpath(os.path.abspath(finding.path), root)
+        rel_posix = rel.replace(os.sep, '/')
+        finding = dataclasses.replace(
+            finding, fingerprint=fingerprint_of(
+                finding.rule, rel_posix, finding.symbol))
+        ctx = contexts.get(finding.path)
+        if ctx is not None \
+                and ctx.inline_disabled(finding.rule, finding.line):
+            finding = dataclasses.replace(
+                finding, suppressed=True, suppressed_by='inline')
+        elif any(e.matches(finding, rel_posix) for e in baseline):
+            finding = dataclasses.replace(
+                finding, suppressed=True, suppressed_by='baseline')
+        out.append(finding)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def git_changed_files(base: str,
+                      cwd: Optional[str] = None) -> Set[str]:
+    """Absolute paths changed vs ``base`` (diff + untracked)."""
+    cwd = cwd or os.getcwd()
+    top = subprocess.run(['git', 'rev-parse', '--show-toplevel'],
+                         cwd=cwd, capture_output=True,
+                         text=True, timeout=30).stdout.strip() or cwd
+    changed: Set[str] = set()
+    for args in (['git', 'diff', '--name-only', base, '--'],
+                 ['git', 'ls-files', '--others', '--exclude-standard']):
+        proc = subprocess.run(args, cwd=cwd, capture_output=True,
+                              text=True, timeout=30)
+        if proc.returncode != 0:
+            raise ValueError(
+                f'{" ".join(args)} failed: {proc.stderr.strip()}')
+        for line in proc.stdout.splitlines():
+            if line.strip():
+                changed.add(os.path.abspath(
+                    os.path.join(top, line.strip())))
+    return changed
 
 
 def lint_paths(paths: Sequence[str],
                rule_ids: Optional[Sequence[str]] = None,
                baseline_path: Optional[str] = None,
-               use_baseline: bool = True) -> List[Finding]:
-    """High-level entry point shared by the CLI, tests, and bench gate."""
+               use_baseline: bool = True,
+               changed_only: Optional[str] = None) -> List[Finding]:
+    """High-level entry point shared by the CLI, tests, and bench gate.
+
+    ``changed_only`` names a git base ref: the whole-program index is
+    still built over every scanned file (transitive findings need it),
+    but only findings in files changed vs that ref are returned.
+    """
     rules = all_rules()
     if rule_ids:
         known = {r.id for r in rules}
@@ -261,8 +365,14 @@ def lint_paths(paths: Sequence[str],
             baseline = load_baseline(baseline_path)
             baseline_root = os.path.dirname(
                 os.path.abspath(baseline_path))
-    return lint_files(iter_py_files(paths), rules=rules,
-                      baseline=baseline, baseline_root=baseline_root)
+    findings = lint_files(iter_py_files(paths), rules=rules,
+                          baseline=baseline,
+                          baseline_root=baseline_root)
+    if changed_only is not None:
+        changed = git_changed_files(changed_only)
+        findings = [f for f in findings
+                    if os.path.abspath(f.path) in changed]
+    return findings
 
 
 def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:
@@ -297,6 +407,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              f'path)')
     parser.add_argument('--no-baseline', action='store_true',
                         help='ignore any baseline file')
+    parser.add_argument('--changed-only', nargs='?', const='HEAD',
+                        default=None, metavar='BASE',
+                        help='restrict findings to files changed vs '
+                             'the git base ref (default HEAD); the '
+                             'whole-program index still covers every '
+                             'scanned file')
     parser.add_argument('--list-rules', action='store_true')
     args = parser.parse_args(argv)
 
@@ -311,7 +427,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         findings = lint_paths(
             args.paths, rule_ids=args.rule,
             baseline_path=args.baseline,
-            use_baseline=not args.no_baseline)
+            use_baseline=not args.no_baseline,
+            changed_only=args.changed_only)
     except (ValueError, OSError) as e:
         print(f'skylint: {e}', file=sys.stderr)
         return 2

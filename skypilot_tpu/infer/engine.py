@@ -3308,6 +3308,7 @@ class InferenceEngine:
             for t in range(cfg.max_new_tokens):
                 tok_dev, last, cache, kv_mask = self._decode(
                     self.params, cache, last, kv_mask, lengths_dev,
+                    # skylint: disable=key-reuse (root key; _decode_step fold_ins per-step)
                     jnp.int32(s_max), jnp.int32(t), rng,
                     jnp.asarray(~done), temperature=cfg.temperature,
                     top_k=cfg.top_k, top_p=cfg.top_p)

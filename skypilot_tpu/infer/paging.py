@@ -24,6 +24,18 @@ pages, which is exactly what the chain hash certifies.
 Pure host-side Python; no jax imports.  Thread-unsafe by design: the
 engine calls it only from its single scheduler thread.
 
+Lock hierarchy note: this lock-free allocator is one instance of the
+serving stack's global locking discipline, which the skylint
+`lock-order-discipline` rule derived from the tree and now enforces —
+the hierarchy is deliberately FLAT.  One lock per component
+(engine `_submit_lock`, server `_lock`/`_drain_lock`, router/breaker/
+policy/supervisor `_lock`s, observability buffer `_lock`s), and no
+code path acquires a second lock while holding one, directly or
+through any call chain; cross-component calls release first.  The
+full table lives in docs/architecture.md ("Lock acquisition
+hierarchy").  Adding a nested acquire anywhere is how the first half
+of a deadlock starts, and the linter will flag it.
+
 Tensor parallelism never reaches this layer: under a `tensor=N` mesh
 the engine shards the device pools on the KV-HEAD axis (every chip
 holds page i's slice of its local heads), so page ids, refcounts,

@@ -8,10 +8,13 @@ path; everything runs in-process via skylint.lint_files so the guard
 costs one AST walk, not a subprocess.
 """
 import json
+import os
+import subprocess
 import textwrap
 from pathlib import Path
 
 from skypilot_tpu import observability
+from skypilot_tpu.devtools import analysis
 from skypilot_tpu.devtools import skylint
 
 REPO = Path(__file__).resolve().parents[2]
@@ -688,4 +691,669 @@ def test_all_rule_families_are_registered():
             'dtype-promotion', 'sleep-discipline',
             'net-timeout', 'trace-discipline',
             'pipeline-discipline', 'kernel-discipline',
-            'mesh-axis-discipline'} <= ids
+            'mesh-axis-discipline', 'lock-order-discipline',
+            'donation-discipline', 'key-reuse'} <= ids
+
+
+# =====================================================================
+# skylint 2.0: whole-program analysis
+# =====================================================================
+
+def _write_tree(tmp_path, files):
+    paths = []
+    for relpath, source in files.items():
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+        paths.append(str(path))
+    return paths
+
+
+def _lint_tree(tmp_path, files, rule=None, baseline=None):
+    paths = _write_tree(tmp_path, files)
+    rules = skylint.all_rules()
+    if rule is not None:
+        rules = [r for r in rules if r.id == rule]
+        assert rules, f'unknown rule {rule}'
+    return skylint.lint_files(paths, rules=rules, baseline=baseline,
+                              baseline_root=str(tmp_path))
+
+
+def _project(tmp_path, files):
+    paths = _write_tree(tmp_path, files)
+    ctxs = [skylint.FileContext(p, Path(p).read_text())
+            for p in paths]
+    return analysis.Project(ctxs)
+
+
+# ---------------------------------------------------------------------
+# analysis: module graph + call graph
+# ---------------------------------------------------------------------
+
+def test_analysis_module_names_and_import_aliases(tmp_path):
+    proj = _project(tmp_path, {
+        'models/m.py': """
+            from utils import helpers as h
+
+            def fwd(x):
+                return h.helper_a(x)
+        """,
+        'utils/helpers.py': """
+            def helper_a(x):
+                return helper_b(x)
+
+            def helper_b(x):
+                return x
+        """,
+    })
+    assert set(proj.modules) == {'models.m', 'utils.helpers'}
+    assert proj.modules['models.m'].imports['h'] == 'utils.helpers'
+    # Cross-module edge through the alias, then the local edge.
+    callees = {e.callee for e in proj.calls_of('models.m.fwd')}
+    assert callees == {'utils.helpers.helper_a'}
+    callees = {e.callee
+               for e in proj.calls_of('utils.helpers.helper_a')}
+    assert callees == {'utils.helpers.helper_b'}
+
+
+def test_analysis_self_dispatch_and_attr_types(tmp_path):
+    proj = _project(tmp_path, {
+        # The second top-level dir pins the import anchor at tmp_path,
+        # so the fixture's absolute imports resolve like the repo's.
+        'utils/anchor.py': '',
+        'infer/eng.py': """
+            from infer.pool import Pool
+
+            class Engine:
+                def __init__(self):
+                    self._pool = Pool()
+
+                def step(self):
+                    self._drop()
+                    self._pool.release(3)
+
+                def _drop(self):
+                    pass
+        """,
+        'infer/pool.py': """
+            class Pool:
+                def release(self, n):
+                    return n
+        """,
+    })
+    callees = {e.callee: e.via
+               for e in proj.calls_of('infer.eng.Engine.step')}
+    # self.method dispatch within the class...
+    assert callees.get('infer.eng.Engine._drop') == 'self'
+    # ...and self.attr.method through the inferred attribute type,
+    # minus the Pool() constructor edge.
+    assert callees.get('infer.pool.Pool.release') == 'self'
+
+
+def test_analysis_partial_prebinding_arg_offsets(tmp_path):
+    proj = _project(tmp_path, {
+        'models/p.py': """
+            import functools
+
+            def consume(scale, n):
+                return scale * n
+
+            def outer(n):
+                f = functools.partial(consume, 2.0)
+                return f(n)
+        """,
+    })
+    (outer_q,) = [q for q in proj.functions if q.endswith('outer')]
+    edges = {(e.callee.rsplit('.', 1)[-1], e.via, e.arg_offset)
+             for e in proj.calls_of(outer_q)}
+    # The partial() site itself (args shift -1) and the bound-local
+    # call (args shift +1 past the pre-bound scale).
+    assert ('consume', 'partial', -1) in edges
+    assert ('consume', 'partial', 1) in edges
+
+
+def test_analysis_single_parse_per_file(tmp_path):
+    files = {
+        'models/a.py': 'import jax\nx = 1\n',
+        'models/b.py': 'y = 2\n',
+        'utils/c.py': 'z = 3\n',
+    }
+    paths = _write_tree(tmp_path, files)
+    before = skylint.PARSE_COUNT
+    findings = skylint.lint_files(paths, rules=skylint.all_rules())
+    assert skylint.PARSE_COUNT - before == len(paths), \
+        'whole-program linting must parse each file exactly once'
+    assert not _live(findings)
+
+
+# ---------------------------------------------------------------------
+# host-sync 2.0: interprocedural
+# ---------------------------------------------------------------------
+
+_JIT_CALLS_HELPER = """
+    import jax
+    from utils import helpers as h
+
+    @jax.jit
+    def fwd(x):
+        h.helper_a(x)
+        return x
+"""
+
+_HELPERS_TWO_HOP = """
+    import time
+
+    def helper_a(x):
+        return helper_b(x)
+
+    def helper_b(x):
+        t = time.time()
+        return x, t
+"""
+
+
+def test_host_sync_transitive_two_hop_chain(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'models/m.py': _JIT_CALLS_HELPER,
+        'utils/helpers.py': _HELPERS_TWO_HOP,
+    }, rule='host-sync'))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.symbol == 'time.time()'
+    # Anchored at the jit-body call site, not in utils/.
+    assert f.path.endswith('models/m.py')
+    # Chain: jit entry -> helper_a -> helper_b -> the syncing call.
+    assert len(f.call_chain) == 4
+    assert 'helper_a' in f.call_chain[1]
+    assert 'helper_b' in f.call_chain[2]
+    assert f.call_chain[-1] == 'time.time()'
+
+
+def test_host_sync_single_file_pass_provably_misses_it(tmp_path):
+    # The same jit body linted WITHOUT the helper module on the scan
+    # list: the hazard lives two modules away, and a per-file pass
+    # (pre-2.0 behavior) has nothing to resolve the call against.
+    assert not _live(_lint_tree(tmp_path, {
+        'models/m.py': _JIT_CALLS_HELPER,
+    }, rule='host-sync'))
+    # With the helper scanned, the exact same file flags (see
+    # test_host_sync_transitive_two_hop_chain) — the delta IS the
+    # whole-program index.
+
+
+# ---------------------------------------------------------------------
+# retrace 2.0: taint through calls
+# ---------------------------------------------------------------------
+
+def test_retrace_transitive_through_helper_module(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'models/m.py': """
+            import jax
+            from utils import shapes as sh
+
+            def _decode(logits, top_k):
+                return sh.trim(logits, top_k)
+
+            decode = jax.jit(_decode)
+        """,
+        'utils/shapes.py': """
+            import jax.numpy as jnp
+
+            def trim(logits, k):
+                if k > 0:
+                    return jnp.zeros((k,))
+                return logits
+        """,
+    }, rule='retrace-hazard'))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.symbol == '_decode.top_k'
+    assert f.path.endswith('models/m.py')
+    assert any('trim' in hop for hop in f.call_chain)
+
+
+def test_retrace_transitive_through_partial_and_self(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'models/m.py': """
+            import functools
+            import jax
+
+            def consume(scale, k):
+                return list(range(k))
+
+            class Decoder:
+                def __init__(self):
+                    def _fwd(x, top_k):
+                        return self._trim(x, top_k)
+
+                    self._step = jax.jit(_fwd)
+
+                def _trim(self, x, k):
+                    f = functools.partial(consume, 2.0)
+                    return f(k)
+        """,
+    }, rule='retrace-hazard'))
+    assert len(findings) == 1
+    # Taint flows _fwd.top_k -> (self dispatch, +1 for the bound
+    # receiver) _trim.k -> (partial, pre-bound scale skipped)
+    # consume.k -> range(k).
+    assert findings[0].symbol == '_fwd.top_k'
+
+
+def test_retrace_static_param_stays_clean_through_calls(tmp_path):
+    assert not _live(_lint_tree(tmp_path, {
+        'models/m.py': """
+            import jax
+            from utils import shapes as sh
+
+            def _decode(logits, top_k):
+                return sh.trim(logits, top_k)
+
+            decode = jax.jit(_decode, static_argnames=('top_k',))
+        """,
+        'utils/shapes.py': """
+            import jax.numpy as jnp
+
+            def trim(logits, k):
+                if k > 0:
+                    return jnp.zeros((k,))
+                return logits
+        """,
+    }, rule='retrace-hazard'))
+
+
+# ---------------------------------------------------------------------
+# lock-order-discipline
+# ---------------------------------------------------------------------
+
+def test_lock_order_flags_ab_ba_cycle(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'infer/paging.py': """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._alloc_lock = threading.Lock()
+                    self._table_lock = threading.Lock()
+
+                def grow(self):
+                    with self._alloc_lock:
+                        with self._table_lock:
+                            pass
+
+                def shrink(self):
+                    with self._table_lock:
+                        with self._alloc_lock:
+                            pass
+        """,
+    }, rule='lock-order-discipline'))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.symbol.startswith('cycle:')
+    assert 'Pool._alloc_lock' in f.message
+    assert 'Pool._table_lock' in f.message
+    assert len(f.call_chain) >= 2
+
+
+def test_lock_order_cycle_through_call_graph(tmp_path):
+    # Engine holds its lock and calls the allocator (which takes the
+    # allocator lock); the allocator holds its lock and calls back
+    # into the engine.  Neither file looks wrong alone.
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'infer/eng.py': """
+            import threading
+            from infer.alloc import Alloc
+
+            class Engine:
+                def __init__(self):
+                    self._submit_lock = threading.Lock()
+                    self._alloc = Alloc()
+
+                def submit(self):
+                    with self._submit_lock:
+                        self._alloc.reserve(1)
+
+                def wake(self):
+                    with self._submit_lock:
+                        pass
+        """,
+        'infer/alloc.py': """
+            import threading
+            from infer.eng import Engine
+
+            class Alloc:
+                def __init__(self):
+                    self._alloc_lock = threading.Lock()
+                    self.eng = Engine()
+
+                def reserve(self, n):
+                    with self._alloc_lock:
+                        return n
+
+                def evict(self):
+                    with self._alloc_lock:
+                        self.eng.wake()
+        """,
+    }, rule='lock-order-discipline'))
+    assert len(findings) == 1
+    f = findings[0]
+    assert 'Engine._submit_lock' in f.message
+    assert 'Alloc._alloc_lock' in f.message
+
+
+def test_lock_order_consistent_nesting_is_clean(tmp_path):
+    # A -> B in two places, never B -> A: a hierarchy, not a cycle.
+    assert not _live(_lint_tree(tmp_path, {
+        'infer/paging.py': """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._alloc_lock = threading.Lock()
+                    self._table_lock = threading.Lock()
+
+                def grow(self):
+                    with self._alloc_lock:
+                        with self._table_lock:
+                            pass
+
+                def shrink(self):
+                    with self._alloc_lock:
+                        with self._table_lock:
+                            pass
+        """,
+    }, rule='lock-order-discipline'))
+
+
+def test_lock_order_check_then_act_and_dcl_exemption(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'serve/cache.py': """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._entries = None
+
+                def fill(self, v):
+                    with self._lock:
+                        self._entries = v
+
+                def racy_get(self):
+                    if self._entries is None:
+                        with self._lock:
+                            self._entries = []
+                    return self._entries
+
+                def dcl_get(self):
+                    if self._entries is None:
+                        with self._lock:
+                            if self._entries is None:
+                                self._entries = []
+                    return self._entries
+        """,
+    }, rule='lock-order-discipline'))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.symbol == 'Cache._entries'
+    assert 'check-then-act' in f.message
+    assert 'racy_get' in f.message
+
+
+def test_lock_order_scoped_to_serving_packages(tmp_path):
+    assert not _live(_lint_tree(tmp_path, {
+        'provision/x.py': """
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def f(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def g(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """,
+    }, rule='lock-order-discipline'))
+
+
+# ---------------------------------------------------------------------
+# donation-discipline
+# ---------------------------------------------------------------------
+
+def test_donation_flags_read_after_donated_call(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'infer/eng.py': """
+            import jax
+
+            def _step(cache, tok):
+                return cache
+
+            class Engine:
+                def __init__(self):
+                    self._step = jax.jit(_step, donate_argnums=(0,))
+
+                def run(self, cache, tok):
+                    out = self._step(cache, tok)
+                    return cache
+        """,
+    }, rule='donation-discipline'))
+    assert len(findings) == 1
+    f = findings[0]
+    assert 'use-after-donate' in f.message
+    assert len(f.call_chain) == 2
+
+
+def test_donation_rebound_result_and_argnames_are_clean(tmp_path):
+    assert not _live(_lint_tree(tmp_path, {
+        'infer/eng.py': """
+            import jax
+
+            def _step(cache, tok):
+                return cache
+
+            class Engine:
+                def __init__(self):
+                    self._step = jax.jit(_step,
+                                         donate_argnames=('cache',))
+
+                def run(self, cache, tok):
+                    cache = self._step(cache, tok)
+                    return cache
+        """,
+    }, rule='donation-discipline'))
+
+
+def test_donation_argnames_matches_keyword_call_site(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'infer/eng.py': """
+            import jax
+
+            def _step(cache, tok):
+                return cache
+
+            run_step = jax.jit(_step, donate_argnames=('cache',))
+
+            def drive(cache, tok):
+                out = run_step(tok=tok, cache=cache)
+                return cache.mean()
+        """,
+    }, rule='donation-discipline'))
+    assert len(findings) == 1
+    assert 'cache' in findings[0].symbol
+
+
+# ---------------------------------------------------------------------
+# key-reuse
+# ---------------------------------------------------------------------
+
+def test_key_reuse_flags_double_consumption_via_alias(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'models/sampling.py': """
+            from jax import random as jr
+
+            def sample_two(logits, key):
+                a = jr.categorical(key, logits)
+                b = jr.categorical(key, logits)
+                return a, b
+        """,
+    }, rule='key-reuse'))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.symbol == 'sample_two.key'
+    assert len(f.call_chain) == 2
+
+
+def test_key_reuse_split_and_fold_in_are_clean(tmp_path):
+    assert not _live(_lint_tree(tmp_path, {
+        'models/sampling.py': """
+            import jax
+
+            def sample_ok(logits, key):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.categorical(k1, logits)
+                b = jax.random.categorical(k2, logits)
+                return a, b
+
+            def per_lane(logits, key, n):
+                outs = []
+                for i in range(n):
+                    sub = jax.random.fold_in(key, i)
+                    outs.append(jax.random.categorical(sub, logits))
+                return outs
+        """,
+    }, rule='key-reuse'))
+
+
+def test_key_reuse_catches_unrefreshed_loop_key(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'models/sampling.py': """
+            import jax
+
+            def sample_loop(logits, key, n):
+                outs = []
+                for _ in range(n):
+                    outs.append(jax.random.categorical(key, logits))
+                return outs
+        """,
+    }, rule='key-reuse'))
+    assert len(findings) == 1
+    assert findings[0].symbol == 'sample_loop.key'
+
+
+def test_key_reuse_exclusive_branches_are_clean(tmp_path):
+    assert not _live(_lint_tree(tmp_path, {
+        'models/sampling.py': """
+            import jax
+
+            def sample(logits, key, greedy):
+                if greedy:
+                    return jax.random.categorical(key, logits)
+                else:
+                    return jax.random.gumbel(key, logits.shape)
+        """,
+    }, rule='key-reuse'))
+
+
+# ---------------------------------------------------------------------
+# JSON schema 2.0: call_chain + fingerprint; baseline v2
+# ---------------------------------------------------------------------
+
+def test_json_carries_call_chain_and_fingerprint(tmp_path, capsys):
+    _write_tree(tmp_path, {
+        'models/m.py': _JIT_CALLS_HELPER,
+        'utils/helpers.py': _HELPERS_TWO_HOP,
+    })
+    rc = skylint.main(['--format', 'json', '--no-baseline',
+                       '--rule', 'host-sync', str(tmp_path)])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    (finding,) = doc['findings']
+    assert finding['symbol'] == 'time.time()'
+    assert isinstance(finding['call_chain'], list)
+    assert len(finding['call_chain']) == 4
+    fp = finding['fingerprint']
+    assert fp and len(fp) == 12
+    # Fingerprints hash rule|path-relative-to-baseline-root|symbol
+    # (cwd when --no-baseline), stable across line drift.
+    rel = os.path.relpath(os.path.abspath(finding['path']),
+                          os.getcwd()).replace(os.sep, '/')
+    assert fp == skylint.fingerprint_of('host-sync', rel,
+                                        finding['symbol'])
+
+
+def test_baseline_fingerprint_entry_suppresses(tmp_path):
+    files = {
+        'models/m.py': _JIT_CALLS_HELPER,
+        'utils/helpers.py': _HELPERS_TWO_HOP,
+    }
+    (live,) = _live(_lint_tree(tmp_path, files, rule='host-sync'))
+    entry = skylint.BaselineEntry(rule='', path_glob='',
+                                  symbol_glob='',
+                                  fingerprint=live.fingerprint)
+    findings = _lint_tree(tmp_path, files, rule='host-sync',
+                          baseline=[entry])
+    flagged = [f for f in findings if f.rule == 'host-sync']
+    assert flagged and all(f.suppressed for f in flagged)
+    assert flagged[0].suppressed_by == 'baseline'
+
+
+def test_load_baseline_parses_fingerprint_lines(tmp_path):
+    bl = tmp_path / '.skylint-baseline'
+    bl.write_text('# v2\n'
+                  'stdout-purity:legacy/*.py:*\n'
+                  'fingerprint:abcdef012345\n')
+    entries = skylint.load_baseline(str(bl))
+    assert len(entries) == 2
+    assert entries[0].rule == 'stdout-purity'
+    assert entries[1].fingerprint == 'abcdef012345'
+
+
+# ---------------------------------------------------------------------
+# --changed-only
+# ---------------------------------------------------------------------
+
+def test_changed_only_filters_findings_but_keeps_index(tmp_path):
+    paths = _write_tree(tmp_path, {
+        'models/m.py': _JIT_CALLS_HELPER,
+        'utils/helpers.py': _HELPERS_TWO_HOP,
+    })
+    env = {'GIT_AUTHOR_NAME': 't', 'GIT_AUTHOR_EMAIL': 't@t',
+           'GIT_COMMITTER_NAME': 't', 'GIT_COMMITTER_EMAIL': 't@t',
+           'HOME': str(tmp_path), 'PATH': os.environ['PATH']}
+    run = lambda *args: subprocess.run(
+        args, cwd=str(tmp_path), env=env, check=True,
+        capture_output=True)
+    run('git', 'init', '-q')
+    run('git', 'add', '-A')
+    run('git', 'commit', '-qm', 'seed')
+    # Touch ONLY the jit-side file; the helper is unchanged.
+    (tmp_path / 'models' / 'm.py').write_text(
+        textwrap.dedent(_JIT_CALLS_HELPER) + '\n# touched\n')
+    cwd = os.getcwd()
+    os.chdir(str(tmp_path))
+    try:
+        findings = skylint.lint_paths(
+            ['.'], rule_ids=['host-sync'], use_baseline=False,
+            changed_only='HEAD')
+        live = _live(findings)
+        # The transitive finding (which NEEDS the unchanged helper in
+        # the index) survives, anchored in the changed file...
+        assert len(live) == 1
+        assert live[0].path.endswith('models/m.py')
+        # ...and with nothing changed, nothing is reported.
+        run('git', 'add', '-A')
+        run('git', 'commit', '-qm', 'touch')
+        findings = skylint.lint_paths(
+            ['.'], rule_ids=['host-sync'], use_baseline=False,
+            changed_only='HEAD')
+        assert not _live(findings)
+    finally:
+        os.chdir(cwd)

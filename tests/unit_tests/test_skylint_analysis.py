@@ -1,0 +1,189 @@
+"""devtools.analysis: the whole-program index skylint 2.0 rules ride —
+module-name anchoring, import/alias resolution (absolute, relative,
+function-local), symbol registration for nested defs, walk_own scope
+boundaries, and the single-jit-index contract.
+
+Fixture trees are written under tmp_path; everything builds a real
+``analysis.Project`` in-process (PR: skylint 2.0 whole-program
+analysis engine).
+"""
+import textwrap
+from pathlib import Path
+
+from skypilot_tpu.devtools import analysis
+from skypilot_tpu.devtools import skylint
+
+
+def _project(tmp_path, files):
+    ctxs = []
+    for relpath, source in files.items():
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+        ctxs.append(skylint.FileContext(str(path), path.read_text()))
+    return analysis.Project(ctxs)
+
+
+def _edge_map(proj, caller_suffix):
+    (qname,) = [q for q in proj.functions
+                if q.endswith(caller_suffix)]
+    return {e.callee: e.via for e in proj.calls_of(qname)}
+
+
+def test_package_anchor_follows_init_files(tmp_path):
+    # With __init__.py markers the dotted name starts at the package
+    # root even though the scanned set lives deeper.
+    proj = _project(tmp_path, {
+        'pkg/__init__.py': '',
+        'pkg/sub/__init__.py': '',
+        'pkg/sub/m.py': 'def f():\n    return 1\n',
+    })
+    assert 'pkg.sub.m' in proj.modules
+    assert 'pkg.sub.m.f' in proj.functions
+
+
+def test_relative_import_resolution(tmp_path):
+    proj = _project(tmp_path, {
+        'pkg/__init__.py': '',
+        'pkg/a.py': """
+            from . import b
+            from .b import helper as h
+
+            def caller():
+                b.helper()
+                h()
+        """,
+        'pkg/b.py': """
+            def helper():
+                return 1
+        """,
+    })
+    edges = _edge_map(proj, 'pkg.a.caller')
+    assert edges == {'pkg.b.helper': 'call'}
+
+
+def test_function_local_import_resolution(tmp_path):
+    # The engine's lazy-import idiom: `from x import y as z` inside a
+    # function body still resolves call edges.
+    proj = _project(tmp_path, {
+        'pkg/__init__.py': '',
+        'pkg/eng.py': """
+            def run():
+                from pkg import paging as paging_lib
+                return paging_lib.alloc(4)
+        """,
+        'pkg/paging.py': """
+            def alloc(n):
+                return n
+        """,
+    })
+    edges = _edge_map(proj, 'pkg.eng.run')
+    assert 'pkg.paging.alloc' in edges
+
+
+def test_nested_defs_keep_enclosing_class(tmp_path):
+    # Closures inside __init__ (the repo's jit-body idiom) must still
+    # resolve `self.` against the enclosing class.
+    proj = _project(tmp_path, {
+        'm.py': """
+            class Engine:
+                def __init__(self):
+                    def _step(x):
+                        return self._helper(x)
+
+                    self._step = _step
+
+                def _helper(self, x):
+                    return x
+        """,
+    })
+    (nested_q,) = [q for q in proj.functions if q.endswith('_step')]
+    fn = proj.functions[nested_q]
+    assert fn.cls is not None and fn.cls.name == 'Engine'
+    edges = {e.callee for e in proj.calls_of(nested_q)}
+    assert any(c.endswith('Engine._helper') for c in edges)
+
+
+def test_base_class_method_lookup(tmp_path):
+    proj = _project(tmp_path, {
+        'm.py': """
+            class Base:
+                def shared(self):
+                    return 1
+
+            class Child(Base):
+                def go(self):
+                    return self.shared()
+        """,
+    })
+    edges = _edge_map(proj, 'Child.go')
+    assert any(c.endswith('Base.shared') for c in edges)
+
+
+def test_bare_name_does_not_leak_across_class_scope(tmp_path):
+    # A bare `helper()` inside a method is NOT a call of a sibling
+    # method (Python scoping) — it must resolve to module level.
+    proj = _project(tmp_path, {
+        'm.py': """
+            def helper():
+                return 'module'
+
+            class C:
+                def helper(self):
+                    return 'method'
+
+                def go(self):
+                    return helper()
+        """,
+    })
+    edges = _edge_map(proj, 'C.go')
+    assert set(edges) == {'m.helper'}
+
+
+def test_walk_own_excludes_nested_subtrees(tmp_path):
+    import ast
+    proj = _project(tmp_path, {
+        'm.py': """
+            def outer():
+                a = 1
+
+                def inner():
+                    b = 2
+                    return b
+
+                return inner
+        """,
+    })
+    (outer_q,) = [q for q in proj.functions if q.endswith('outer')]
+    names = {n.id for n in proj.walk_own(proj.functions[outer_q])
+             if isinstance(n, ast.Name)}
+    assert 'a' in names
+    assert 'b' not in names, 'walk_own must stop at nested defs'
+
+
+def test_jit_index_is_cached_per_module(tmp_path):
+    # The single-index contract: every rule sharing the project gets
+    # the same JitIndex object, not a re-parse/re-scan per rule.
+    proj = _project(tmp_path, {
+        'm.py': """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x
+        """,
+    })
+    (name,) = proj.modules
+    assert proj.jit_index(name) is proj.jit_index(name)
+
+
+def test_location_reports_module_and_line(tmp_path):
+    proj = _project(tmp_path, {
+        'm.py': 'def f():\n    return 1\n',
+    })
+    (qname,) = [q for q in proj.functions if q.endswith('f')]
+    loc = proj.location(qname)
+    assert loc.endswith('m.py:1')
+    # Unknown symbols echo back rather than raise — rules interpolate
+    # locations into messages unconditionally.
+    assert proj.location('no.such.fn') == 'no.such.fn'
