@@ -335,10 +335,50 @@ def run_direct(quick: bool, steps_arg) -> None:
     from skypilot_tpu.train import data as data_lib
     from skypilot_tpu.train import trainer as trainer_lib
 
-    # First backend touch goes through the hang watchdog: a wedged
-    # tunnel raises (so the retry/fallback ladder runs) instead of
-    # blocking forever.
-    devices = mesh_lib.devices_with_retry()
+    # First backend touch goes through the hang watchdog AND a
+    # budget-aware bench-level ladder.  The tunneled-TPU first
+    # connection is a known-transient flake (BENCH_r03–r05:
+    # BackendInitHang burned whole --direct attempts plus their 600s
+    # inter-attempt spacing), so any init failure that classifies
+    # transient in the INIT context gets a fresh attempt window right
+    # here.  Give-up is budget-aware: once the remaining wall budget
+    # cannot fund another watchdog window plus the measurement itself,
+    # the original error propagates to the outer retry/fallback
+    # ladder (which fails over to a fresh process).
+    from skypilot_tpu.infer import failures
+    from skypilot_tpu.utils import retry as retry_lib
+
+    class _TransientInit(RuntimeError):
+        pass
+
+    def _backend_touch():
+        try:
+            return mesh_lib.devices_with_retry()
+        except BaseException as e:
+            if failures.classify(e, context='init') \
+                    == failures.TRANSIENT:
+                raise _TransientInit(repr(e)) from e
+            raise
+
+    def _init_failed(attempt, e, will_retry, delay):
+        outcome = (f'retrying in {delay:.0f}s' if will_retry
+                   else 'giving up to the outer ladder')
+        print(f'# bench backend init attempt {attempt} failed '
+              f'({e.__cause__!r}); {outcome}', file=sys.stderr)
+
+    init_watchdog_s = float(os.environ.get(
+        'SKYTPU_BACKEND_INIT_TIMEOUT_S', '180'))
+    try:
+        devices = retry_lib.retry_with_backoff(
+            _backend_touch, max_attempts=3, base_delay_s=10.0,
+            factor=2.0, jitter='none', retry_on=(_TransientInit,),
+            fatal=(KeyboardInterrupt, SystemExit),
+            remaining_s=lambda: _remaining_s() - 150.0,
+            min_attempt_s=min(init_watchdog_s, 120.0),
+            on_failure=_init_failed, describe='bench backend init')
+    except retry_lib.RetryError as e:
+        cause = e.last.__cause__ if e.last is not None else None
+        raise (cause or e) from e
     kinds = {getattr(d, 'device_kind', '') for d in devices}
     on_tpu = (jax.default_backend() in ('tpu', 'axon')
               or any('TPU' in k.upper() for k in kinds))
@@ -885,6 +925,149 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
         sharded_arm = {'skipped': f'needs {tp_n} devices, have '
                                   f'{len(jax.devices())}'}
 
+    # --- eighth arm: ragged-prefill interference (mix on vs off) -----
+    # A long prompt arrives while short requests are mid-decode.  With
+    # --prefill-mix-budget 0 the long prefill runs as dedicated chunk
+    # ticks: every scheduler tick pays the decode dispatch PLUS a
+    # batch-1 chunk forward, so co-resident decode TPOT inflates for
+    # the length of the prompt.  With mixing on (budget == chunk size,
+    # so both modes retire prefill tokens at the same per-tick rate)
+    # the same chunk tokens ride the decode step's s>1 verify-window
+    # rows — ONE mixed forward per tick — and the long prompt
+    # amortizes across decode steps instead of stalling them.  Greedy,
+    # same weights, so the short streams must be bit-identical across
+    # all three runs (alone, mix off, mix on).  TPOT is the wall time
+    # until the short streams finish divided by their per-stream token
+    # count; medians over interleaved windows, same measurement
+    # discipline as the async arm.
+    mi_new = 8 if smoke else 16
+    mi_long_len = 96 if smoke else 112
+    mi_chunk = 8
+    mi_budget = mi_chunk
+    mi_windows = 5
+    mi_shorts = [list(rng.integers(1, 96, 12)) for _ in range(3)]
+    mi_short_sampling = engine_lib.SamplingConfig(
+        max_new_tokens=mi_new, temperature=0.0)
+    mi_long = list(rng.integers(1, 96, mi_long_len))
+    mi_long_sampling = engine_lib.SamplingConfig(
+        max_new_tokens=1, temperature=0.0)
+    mi_off_reg = metrics_lib.Registry()
+    mi_on_reg = metrics_lib.Registry()
+
+    def _mix_engine(budget, registry, params=None):
+        return engine_lib.ContinuousBatchingEngine(
+            'gpt2-tiny', n_slots=n_slots, prefill_bucket=8,
+            model_overrides=dict(sp_overrides),
+            param_dtype=jnp.float32, params=params, page_size=8,
+            prefill_chunk=mi_chunk, prefill_mix_budget=budget,
+            registry=registry)
+
+    def _mix_window(eng, with_long):
+        rids = [eng.submit(p, mi_short_sampling) for p in mi_shorts]
+        if with_long:
+            eng.submit(mi_long, mi_long_sampling)
+        t0 = time.time()
+        live = set(rids)
+        while live:
+            if not eng.step():
+                break
+            live = {r for r in rids
+                    if not eng._events[r].is_set()}  # pylint: disable=protected-access
+        t_short = time.time() - t0
+        eng.run_until_idle()
+        t_total = time.time() - t0
+        outs = [eng.wait(r, timeout=1.0) for r in rids]
+        return outs, t_short, t_total
+
+    mi_off_eng = _mix_engine(0, mi_off_reg)
+    mi_on_eng = _mix_engine(mi_budget, mi_on_reg,
+                            params=mi_off_eng.params)
+    for eng in (mi_off_eng, mi_on_eng):
+        _mix_window(eng, True)                     # compile warmup
+        _mix_window(eng, False)
+        _mix_window(eng, True)                     # settle
+    mi_alone_outs, mi_alone_t, _ = _mix_window(mi_off_eng, False)
+    mi_off_ts, mi_on_ts, mi_off_tt, mi_on_tt = [], [], [], []
+    mi_off_outs = mi_on_outs = None
+    for _ in range(mi_windows):
+        mi_off_outs, ts, tt = _mix_window(mi_off_eng, True)
+        mi_off_ts.append(ts)
+        mi_off_tt.append(tt)
+        mi_on_outs, ts, tt = _mix_window(mi_on_eng, True)
+        mi_on_ts.append(ts)
+        mi_on_tt.append(tt)
+    mi_parity = ([list(a) for a in mi_on_outs]
+                 == [list(a) for a in mi_off_outs]
+                 == [list(a) for a in mi_alone_outs])
+    assert mi_parity, \
+        'mixed-batch stepping broke greedy parity on the short streams'
+    mi_alone_ms = mi_alone_t / mi_new * 1000
+    mi_off_ms = _median(mi_off_ts) / mi_new * 1000
+    mi_on_ms = _median(mi_on_ts) / mi_new * 1000
+    assert mi_on_ms < mi_off_ms, \
+        (f'mixing on did not improve decode TPOT under a concurrent '
+         f'long prefill: {mi_on_ms:.2f} ms/tok (on) vs '
+         f'{mi_off_ms:.2f} ms/tok (off)')
+    # Equal-throughput evidence: both modes retire the same workload
+    # (prompt + generated tokens) per window; report the wall rate.
+    mi_work = (sum(len(p) for p in mi_shorts) + 3 * mi_new
+               + mi_long_len + 1)
+    # Per-chunk prefill read traffic at the long prompt's bucketed
+    # read window: what the XLA sliced-copy path pays today vs the
+    # fused ragged-prefill kernel's epilogue-free streaming.
+    mi_ctx = mi_off_eng._eng._bucketed(mi_long_len)  # pylint: disable=protected-access
+    mi_xla = mi_off_eng.prefill_read_bytes_per_chunk(context=mi_ctx)
+    mi_fused = engine_lib.prefill_cache_read_bytes(
+        mi_off_eng._abstract_cache1, mi_off_eng.config.n_heads,
+        mi_ctx, prefill_kernel='fused')
+
+    def _mi_reg_val(reg, name, **labels):
+        m = reg.get(name)
+        if m is None:
+            return 0.0
+        return m.value_for(**labels) if labels else m.value
+
+    mi_chunk_steps = _mi_reg_val(mi_off_reg,
+                                 'skytpu_prefill_kernel_steps_total',
+                                 path=mi_off_eng.prefill_kernel)
+    mi_read_hist = mi_off_reg.get('skytpu_prefill_cache_read_bytes')
+    mi_read_sum = mi_read_hist.sum if mi_read_hist is not None else 0.0
+    interference_arm = {
+        'page_size': 8,
+        'prefill_chunk': mi_chunk,
+        'prefill_mix_budget': mi_budget,
+        'long_prompt_tokens': mi_long_len,
+        'short_new_tokens': mi_new,
+        'measured_windows': mi_windows,
+        'prefill_kernel': mi_on_eng.prefill_kernel_info(),
+        'decode_tpot_ms_alone': round(mi_alone_ms, 3),
+        'decode_tpot_ms_under_prefill_mix_off': round(mi_off_ms, 3),
+        'decode_tpot_ms_under_prefill_mix_on': round(mi_on_ms, 3),
+        'tpot_improvement_mix_on_vs_off': round(
+            mi_off_ms / max(mi_on_ms, 1e-9), 3),
+        'tokens_per_sec_total_mix_off': round(
+            mi_work / max(_median(mi_off_tt), 1e-9), 1),
+        'tokens_per_sec_total_mix_on': round(
+            mi_work / max(_median(mi_on_tt), 1e-9), 1),
+        'prefill_read_bytes_per_chunk_xla': mi_xla['total_bytes'],
+        'prefill_read_bytes_per_chunk_fused': mi_fused['total_bytes'],
+        'prefill_epilogue_bytes_per_chunk_xla':
+            mi_xla['epilogue_bytes'],
+        'prefill_epilogue_bytes_per_chunk_fused':
+            mi_fused['epilogue_bytes'],
+        'observed_prefill_read_bytes_per_chunk': round(
+            mi_read_sum / mi_chunk_steps, 1) if mi_chunk_steps else 0.0,
+        'mix_tokens_total': _mi_reg_val(
+            mi_on_reg, 'skytpu_prefill_mix_tokens_total'),
+        'mixed_steps_total': _mi_reg_val(
+            mi_on_reg, 'skytpu_prefill_mixed_steps_total'),
+        'greedy_parity_mix_on_vs_off': mi_parity,
+    }
+    for eng in (mi_off_eng, mi_on_eng):
+        close = getattr(eng, 'close', None)
+        if close is not None:
+            close()
+
     result = {
         'metric': 'decode int8-KV cache-read reduction (B=4 slots, '
                   'deepseek-v2-lite attention geometry)',
@@ -898,7 +1081,8 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
         'arms': {'bf16': bf16_arm, 'int8': int8_arm,
                  'paged': paged_arm, 'speculative': spec_arm,
                  'async': async_arm, 'fused_kernel': fused_arm,
-                 'sharded': sharded_arm},
+                 'sharded': sharded_arm,
+                 'prefill_interference': interference_arm},
         'telemetry': telemetry,
         'paged_read_reduction_vs_contiguous': round(pg_ratio, 2),
         'paged_token_parity': pg_parity,
@@ -908,6 +1092,9 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
         'fused_token_parity': fk_parity,
         'fused_read_reduction_vs_xla': round(fk_ratio, 2),
         'sharded_token_parity': tp_parity,
+        'prefill_mix_token_parity': mi_parity,
+        'prefill_mix_tpot_improvement':
+            interference_arm['tpot_improvement_mix_on_vs_off'],
         'async_device_wait_fraction_sync': round(ap_sync_frac, 6),
         'async_device_wait_fraction_async': round(ap_async_frac, 6),
         'n_heads': 16,
@@ -965,6 +1152,16 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
               f'{sharded_arm["tokens_per_sec_per_chip_4chip"]:,.1f} '
               f'tok/s/chip @ {sharded_arm["n_chips"]}, greedy token '
               f'parity: {tp_parity}', file=sys.stderr)
+    print(f'# decode [prefill-interference]: {mi_long_len}-token '
+          f'prompt over chunk={mi_chunk}; short-stream TPOT '
+          f'{mi_alone_ms:.2f} ms alone -> {mi_off_ms:.2f} ms under '
+          f'prefill (mix off) -> {mi_on_ms:.2f} ms (mix on, '
+          f'budget={mi_budget}, '
+          f'{interference_arm["tpot_improvement_mix_on_vs_off"]:.2f}x); '
+          f'prefill reads/chunk '
+          f'{mi_xla["total_bytes"] / 1e6:.2f} MB xla -> '
+          f'{mi_fused["total_bytes"] / 1e6:.2f} MB fused, greedy '
+          f'token parity: {mi_parity}', file=sys.stderr)
     print(f'# telemetry: prefix hit ratio '
           f'{telemetry["prefix_hit_ratio"]:.2f} '
           f'({telemetry["prefix_page_hits"]:.0f} hits / '

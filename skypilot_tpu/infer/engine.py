@@ -494,6 +494,112 @@ def decode_cache_read_bytes(abstract_cache: Any, n_heads: int,
     }
 
 
+def resolve_kernels(decode_kernel: str = 'auto',
+                    prefill_kernel: str = 'auto', *, on_tpu: bool,
+                    page_size: int, tensor: int = 1,
+                    pool_kvh: Optional[int] = None
+                    ) -> Dict[str, Tuple[str, bool]]:
+    """Resolve BOTH attention-kernel requests to {'decode': (kernel,
+    interpret), 'prefill': (kernel, interpret)} — one deterministic
+    table, validated at startup so a bad combination is a ValueError
+    here and never a Pallas crash mid-serve.
+
+    The prefill column mirrors the decode column's rules:
+    'auto' = fused on TPU iff the engine is paged (the ragged-prefill
+    kernel tiles the contiguous prefill cache at the page granularity,
+    so it only exists where a page geometry does) and — under a
+    tensor>1 mesh — only when the cache kv-head axis divides the mesh
+    axis (its shard_map lowering walks per-shard kv-heads, exactly
+    like the decode kernel's).  'xla' (the sliced-prefix grouped
+    einsum) is the permanent fallback and the parity oracle;
+    explicitly requesting 'fused' off-TPU runs the interpreter
+    (tests/benches only)."""
+    decode = resolve_decode_kernel(decode_kernel, on_tpu=on_tpu,
+                                   page_size=page_size, tensor=tensor,
+                                   pool_kvh=pool_kvh)
+    if prefill_kernel not in ('auto', 'fused', 'xla'):
+        raise ValueError(
+            f"prefill_kernel must be 'auto', 'fused' or 'xla', "
+            f'got {prefill_kernel!r}')
+    sharded_ok = (tensor <= 1
+                  or (pool_kvh or 0) % tensor == 0)
+    if prefill_kernel == 'auto':
+        prefill_kernel = 'fused' if (on_tpu and page_size
+                                     and sharded_ok) else 'xla'
+    elif prefill_kernel == 'fused':
+        if not page_size:
+            raise ValueError(
+                "prefill_kernel='fused' requires a paged KV cache "
+                '(page_size > 0): the ragged-prefill kernel walks the '
+                'prefill cache as logical pages')
+        if not sharded_ok:
+            raise ValueError(
+                f"prefill_kernel='fused' needs the cache kv-head axis "
+                f'({pool_kvh}) divisible by the tensor mesh axis '
+                f'({tensor}); this geometry must use '
+                "prefill_kernel='xla'")
+    return {
+        'decode': decode,
+        'prefill': (prefill_kernel,
+                    prefill_kernel == 'fused' and not on_tpu),
+    }
+
+
+def prefill_cache_read_bytes(abstract_cache1: Any, n_heads: int,
+                             context: int,
+                             prefill_kernel: str = 'xla'
+                             ) -> Dict[str, float]:
+    """Per-chunk prefill read-traffic estimate (HBM bytes) over the
+    CONTIGUOUS batch-1 prefill cache — the prefill twin of
+    decode_cache_read_bytes, so bench JSON and skytpu_prefill_* series
+    count the cost that was previously invisible.
+
+    ``context`` is the chunk's bucketed read window (the engine's
+    kv_read_bucket high-water mark; see models/llama.py).  Per K/V
+    leaf (int8 scale siblings walk the same ndim dispatch):
+
+      - ``grouped_bytes``: the live prefix streamed once —
+        layers * b * kvh * read_len * hd * itemsize;
+      - ``epilogue_bytes``: what the XLA path pays ON TOP — the
+        ``cached_k.value[:, :, :read_len]`` slice materialized as a
+        contiguous copy feeding the grouped einsum, written then
+        re-read (2x the window), exactly the decode-epilogue
+        convention.  The fused ragged-prefill kernel streams
+        page-shaped cache tiles straight into VMEM, so its epilogue
+        term is exactly 0 — the delta the kernel removes;
+      - ``total_bytes`` = grouped + epilogue.
+    """
+    if prefill_kernel not in ('fused', 'xla'):
+        raise ValueError(
+            f"prefill_kernel must be 'fused' or 'xla', got "
+            f'{prefill_kernel!r}')
+    grouped = 0
+    repeated = 0
+    epilogue = 0
+    for leaf in jax.tree.leaves(abstract_cache1):
+        if leaf.ndim == 4:           # [B, kvh, S, hd]
+            layers, (b, kvh, s, hd) = 1, leaf.shape
+        elif leaf.ndim == 5:         # [L, B, kvh, S, hd]
+            layers, b, kvh, s, hd = leaf.shape
+        else:
+            continue                 # cursors / scalars
+        read_len = min(max(int(context), 0), s)
+        itemsize = np.dtype(leaf.dtype).itemsize
+        leaf_bytes = layers * b * kvh * read_len * hd * itemsize
+        grouped += leaf_bytes
+        repeated += leaf_bytes * max(1, n_heads // kvh)
+        if prefill_kernel == 'xla':
+            epilogue += 2 * leaf_bytes
+    return {
+        'grouped_bytes': float(grouped),
+        'repeat_bytes': float(repeated),
+        'epilogue_bytes': float(epilogue),
+        'total_bytes': float(grouped + epilogue),
+        'reduction': float(repeated) / float(grouped)
+        if grouped else 1.0,
+    }
+
+
 # Paged-pool leaf names (models/llama.py _paged_slot_attention) and
 # the batch-1 contiguous prefill-cache leaves they are fed from.
 _POOL_OF_CONTIG = {
@@ -616,6 +722,29 @@ def make_clear_table_fn():
     return _clear_table
 
 
+def make_set_table_fn():
+    """Build the mixed-prefill slot reservation: write a slot's device
+    block-table row (and nothing else) so subsequent mixed decode
+    steps scatter the row's prefill chunks straight into its pool
+    pages — the mixed path has no batch-1 staging cache to insert
+    from.  The row arrives 0-filled past the allocated prefix, so
+    out-of-range writes land on the reserved null page."""
+    def _set_table(cache, table_row, slot):
+        def _set(path, leaf):
+            if _path_names(path)[-1] != 'block_table':
+                return leaf
+            if leaf.ndim == 2:      # [B, pps]
+                return jax.lax.dynamic_update_slice(
+                    leaf, table_row[None], (slot, 0))
+            row = jnp.broadcast_to(  # scanned [L, B, pps]
+                table_row[None, None],
+                (leaf.shape[0], 1, leaf.shape[2]))
+            return jax.lax.dynamic_update_slice(
+                leaf, row, (0, slot, 0))
+        return jax.tree_util.tree_map_with_path(_set, cache)
+    return _set_table
+
+
 @dataclasses.dataclass
 class _Slot:
     """Host-side state of one occupied decode slot."""
@@ -661,6 +790,14 @@ class _PendingPrefill:
     pages: List[int] = dataclasses.field(default_factory=list)
     table_row: Any = None     # np [pages_per_slot] int32 (0-filled tail)
     shared_len: int = 0       # prefix positions already in the pool
+    # Mixed-batch prefill (prefill_mix_budget > 0): the prompt's
+    # chunks ride DECODE steps (no batch-1 staging cache; cache1 is
+    # None), writing straight into the slot's shared-cache row /
+    # pool pages.  `seed` is the request's resolved sampling seed,
+    # fixed at admission so the in-graph seeding draw and the slot's
+    # later decode draws fold the same key.
+    mixed: bool = False
+    seed: int = 0
 
 
 class _InflightStep:
@@ -677,15 +814,16 @@ class _InflightStep:
 
     __slots__ = ('mode', 'arrays', 'host', 'occupied', 'rids',
                  'read_bytes', 'compiled', 'decode_key', 'spec_n_prop',
-                 'spec_proposed', 't_enter', 't_dispatched',
+                 'spec_proposed', 'mix', 't_enter', 't_dispatched',
                  't_fetched', 'error', 'done')
 
     def __init__(self, mode: str, arrays: Tuple[Any, ...],
                  occupied: List[int], rids: List[int],
                  read_bytes: float, compiled: bool,
                  decode_key: Any, t_enter: float, t_dispatched: float,
-                 spec_n_prop: Any = None, spec_proposed: int = 0):
-        self.mode = mode                  # 'plain' | 'spec'
+                 spec_n_prop: Any = None, spec_proposed: int = 0,
+                 mix: Optional[List[Tuple[Any, int]]] = None):
+        self.mode = mode                  # 'plain' | 'mixed' | 'spec'
         self.arrays = arrays              # device futures to fetch
         self.host: Optional[Tuple[Any, ...]] = None
         self.occupied = occupied
@@ -695,6 +833,9 @@ class _InflightStep:
         self.decode_key = decode_key
         self.spec_n_prop = spec_n_prop    # np [B] int32 (spec mode)
         self.spec_proposed = spec_proposed
+        # Mixed-batch prefill: (pending, chunk length) per pending
+        # whose chunk rode this step; advanced at CONSUME time.
+        self.mix = mix or []
         self.t_enter = t_enter
         self.t_dispatched = t_dispatched
         self.t_fetched: Optional[float] = None
@@ -786,6 +927,30 @@ class _ServingMetrics:
             'Estimated HBM bytes one decode step reads from the KV '
             'cache (host-side estimate; see decode_cache_read_bytes).',
             buckets=metrics_lib.DEFAULT_BYTE_BUCKETS)
+        # Chunked-prefill / mixed-batch series.
+        self.prefill_read_bytes = r.histogram(
+            'skytpu_prefill_cache_read_bytes',
+            'Estimated HBM bytes one chunked-prefill forward reads '
+            'from the prefill cache, including the XLA sliced-copy '
+            'epilogue the fused ragged-prefill kernel removes '
+            '(host-side estimate; see prefill_cache_read_bytes).',
+            buckets=metrics_lib.DEFAULT_BYTE_BUCKETS)
+        self.prefill_kernel_steps = r.counter(
+            'skytpu_prefill_kernel_steps_total',
+            'Chunked-prefill forwards by attention implementation: '
+            "path='fused' streams the cache prefix page-by-page "
+            "in-kernel (ops/ragged_prefill), path='xla' is the "
+            'sliced-prefix + grouped-einsum path.',
+            labelnames=('path',))
+        self.prefill_mix_tokens = r.counter(
+            'skytpu_prefill_mix_tokens_total',
+            'Prompt tokens admitted into mixed prefill/decode steps '
+            '(--prefill-mix-budget > 0): chunk tokens that rode a '
+            'decode step instead of a dedicated prefill tick.')
+        self.prefill_mixed_steps = r.counter(
+            'skytpu_prefill_mixed_steps_total',
+            'Decode steps that carried at least one prefill-chunk '
+            'token (mixed-batch stepping).')
         # Paged-pool counters/gauges.
         self.free_pages = r.gauge(
             'skytpu_kv_free_pages',
@@ -989,7 +1154,9 @@ class ContinuousBatchingEngine:
                  draft_overrides: Optional[Dict[str, Any]] = None,
                  spec_k: int = 0,
                  async_pipeline: bool = True,
-                 decode_kernel: str = 'auto') -> None:
+                 decode_kernel: str = 'auto',
+                 prefill_kernel: str = 'auto',
+                 prefill_mix_budget: int = 0) -> None:
         import collections
 
         if draft_model is not None and spec_k <= 0:
@@ -998,6 +1165,15 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"decode_kernel must be 'auto', 'fused' or 'xla', "
                 f'got {decode_kernel!r}')
+        if prefill_kernel not in ('auto', 'fused', 'xla'):
+            raise ValueError(
+                f"prefill_kernel must be 'auto', 'fused' or 'xla', "
+                f'got {prefill_kernel!r}')
+        prefill_mix_budget = int(prefill_mix_budget)
+        if prefill_mix_budget < 0:
+            raise ValueError(
+                f'prefill_mix_budget must be >= 0, '
+                f'got {prefill_mix_budget}')
         # Model build, param load/sharding, and the [n_slots, ...]
         # cache scaffolding are identical to the request-level engine.
         self._eng = InferenceEngine(
@@ -1019,19 +1195,31 @@ class ContinuousBatchingEngine:
         self.page_size = self._eng.page_size
         self.n_pages = self._eng.n_pages
 
-        # Paged decode-attention implementation (--decode-kernel) —
-        # the full resolution/validation table lives in
-        # resolve_decode_kernel (startup ValueError, never a Pallas
+        # Attention-kernel implementations (--decode-kernel /
+        # --prefill-kernel) — the full resolution/validation table
+        # lives in resolve_kernels (startup ValueError, never a Pallas
         # partitioning crash mid-serve).
         self.pool_kvh = self._eng.pool_kvh
         tensor = max(mesh.shape.get('tensor', 1), 1) \
             if mesh is not None else 1
+        kernels = resolve_kernels(
+            decode_kernel, prefill_kernel,
+            on_tpu=jax.default_backend() == 'tpu',
+            page_size=self.page_size, tensor=tensor,
+            pool_kvh=self.pool_kvh)
         self.decode_kernel, self.decode_kernel_interpret = \
-            resolve_decode_kernel(
-                decode_kernel,
-                on_tpu=jax.default_backend() == 'tpu',
-                page_size=self.page_size, tensor=tensor,
-                pool_kvh=self.pool_kvh)
+            kernels['decode']
+        self.prefill_kernel, self.prefill_kernel_interpret = \
+            kernels['prefill']
+        # Mixed-batch stepping (--prefill-mix-budget): each decode
+        # step admits up to this many prefill-chunk tokens into the
+        # same jitted graph (0 = dedicated prefill ticks only).
+        self.prefill_mix_budget = prefill_mix_budget
+        # Static query length of the mixed step: the budget, but at
+        # least 2 so the s>1 verify-window write path is exercised
+        # even at budget=1 (s==1 is the one-token decode layout).
+        self._mix_s = max(2, prefill_mix_budget) \
+            if prefill_mix_budget else 0
 
         # Batch-1 prefill cache template.
         rng = jax.random.PRNGKey(seed)
@@ -1064,7 +1252,8 @@ class ContinuousBatchingEngine:
             bucket via the jit cache."""
             from skypilot_tpu.models import llama as llama_lib
             with llama_lib.kv_read_bucket(
-                    kv_bucket if kv_bucket > 0 else None):
+                    kv_bucket if kv_bucket > 0 else None), \
+                    llama_lib.prefill_kernel(self.prefill_kernel):
                 return _forward(p, cache, tokens, positions, kv_mask)
 
         self._prefill1 = jax.jit(_prefill_fwd,
@@ -1127,6 +1316,9 @@ class ContinuousBatchingEngine:
             self._clear_table = jax.jit(make_clear_table_fn(),
                                         donate_argnums=(0,))
 
+            self._set_table = jax.jit(make_set_table_fn(),
+                                      donate_argnums=(0,))
+
         def _decode_step(p, cache, last, kv_mask, rope_pos, cursors,
                          seeds, gens, active, temps, top_ks, top_ps,
                          max_k: int, use_top_p: bool,
@@ -1161,6 +1353,81 @@ class ContinuousBatchingEngine:
 
         self._decode = jax.jit(
             _decode_step,
+            static_argnames=('max_k', 'use_top_p', 'top_p_in_topk',
+                             'kv_bucket'),
+            donate_argnums=(1, 3))
+
+        # -- mixed-batch stepping (--prefill-mix-budget) --------------
+        # One decode step that ALSO carries a bounded budget of
+        # prefill-chunk tokens: decode rows feed their sampled token
+        # at query 0 (pad queries after it), prefill rows feed chunk
+        # tokens, and the s>1 per-row verify-window machinery
+        # (models/llama.py _verify_positions/_verify_mask) gives every
+        # row its own write base and causal staircase — long prompts
+        # amortize across decode steps instead of stalling them.
+        def _reserve_mask_row(kv_mask, mask_row, slot):
+            """Mixed admission: reset the slot's kv_mask row (prefix
+            hits arrive pre-revealed; everything else hidden)."""
+            return jax.lax.dynamic_update_slice(
+                kv_mask, mask_row[None], (slot, 0))
+
+        self._reserve_mask_row = jax.jit(_reserve_mask_row,
+                                         donate_argnums=(0,))
+
+        def _mixed_step(p, cache, last, kv_mask, tokens, rope_pos,
+                        cursors, seeds, gens, active, n_commit,
+                        last_pos, update_last, temps, top_ks, top_ps,
+                        max_k: int, use_top_p: bool,
+                        top_p_in_topk: bool, kv_bucket: int):
+            """The plain decode step generalized to s > 1 queries per
+            row.  Decode rows (active) sample from `last` exactly like
+            _decode_step and feed the token at query 0; prefill rows
+            feed `n_commit` chunk tokens from `tokens`.  Every working
+            row's query-0 slot is revealed BEFORE the forward (the
+            write-base protocol _verify_positions expects — for decode
+            rows that is the new token's cursor, for prefill rows the
+            chunk's cache cursor), the forward writes all s positions
+            at base..base+s-1, and only [cursor, cursor+n_commit) is
+            revealed afterwards — pad queries' K/V stays unrevealed
+            garbage that the next step overwrites in place, the same
+            no-copy rollback the speculative verify uses.  `last` is
+            refreshed from each row's `last_pos` query (0 for decode
+            rows, take-1 for a prompt-completing prefill row — the
+            last true token's logits, bit-identical to what the
+            unmixed insert path stages)."""
+            from skypilot_tpu.models import llama as llama_lib
+            keys = jax.vmap(
+                lambda sd, g: jax.random.fold_in(
+                    jax.random.PRNGKey(sd), g))(seeds, gens)
+            tok = sample_logits_rows(last, keys, temps, top_ks, top_ps,
+                                     max_k=max_k, use_top_p=use_top_p,
+                                     top_p_in_topk=top_p_in_topk)
+            brange = jnp.arange(tok.shape[0])
+            has_work = n_commit > 0
+            reveal = kv_mask[brange, cursors] | has_work
+            kv_mask = kv_mask.at[brange, cursors].set(reveal)
+            feed0 = jnp.where(active, tok, tokens[:, 0])
+            feed = jnp.concatenate([feed0[:, None], tokens[:, 1:]],
+                                   axis=1)
+            s = feed.shape[1]
+            positions = rope_pos[:, None] + jnp.arange(
+                s, dtype=jnp.int32)[None, :]
+            with llama_lib.kv_read_bucket(kv_bucket), \
+                    llama_lib.decode_kernel(self.decode_kernel):
+                logits, cache = _forward(p, cache, feed, positions,
+                                         kv_mask)
+            slots_idx = jnp.arange(kv_mask.shape[1], dtype=jnp.int32)
+            window = (has_work[:, None]
+                      & (slots_idx[None, :] >= cursors[:, None])
+                      & (slots_idx[None, :]
+                         < (cursors + n_commit)[:, None]))
+            kv_mask = kv_mask | window
+            new_last = logits[brange, last_pos]
+            last = jnp.where(update_last[:, None], new_last, last)
+            return tok, last, cache, kv_mask
+
+        self._mixed = jax.jit(
+            _mixed_step,
             static_argnames=('max_k', 'use_top_p', 'top_p_in_topk',
                              'kv_bucket'),
             donate_argnums=(1, 3))
@@ -1213,21 +1480,40 @@ class ContinuousBatchingEngine:
                 _seed_sample,
                 static_argnames=('max_k', 'use_top_p', 'top_p_in_topk'))
 
+            # Mixed-batch stepping composes with speculation through
+            # the SAME verify graph: a prefill row rides the s = k+1
+            # forward with its chunk tokens in the t_pend/drafts lanes
+            # (active=False, n_prop=0 — acceptance ignores it),
+            # mix_real[i] = chunk length drives its reveal window, and
+            # a prompt-completing row's seeding draw happens in-graph
+            # (the same key fold and kernel as _seed_sample below, so
+            # streams stay bit-identical to the unmixed engine).
+            mix_on = prefill_mix_budget > 0
+
             def _spec_verify(p, cache, kv_mask, t_pend, drafts, rope,
                              cursors, n_prop, seeds, gens, active,
-                             temps, top_ks, top_ps, max_k: int,
-                             use_top_p: bool, top_p_in_topk: bool,
-                             kv_bucket: int):
+                             temps, top_ks, top_ps, mix_real, mix_seed,
+                             max_k: int, use_top_p: bool,
+                             top_p_in_topk: bool, kv_bucket: int):
                 """Fused verify: reveal each active row's pending slot
                 (exactly what the one-token step reveals), forward all
                 k+1 positions, run acceptance, and reveal ONLY the
                 committed window [cursor, cursor+count).  Rejected
                 proposals' K/V stays masked — rollback without a copy;
-                the next verify overwrites those slots in place."""
+                the next verify overwrites those slots in place.
+
+                mix_real/mix_seed (mixed-batch prefill; all-zero and
+                dead-code-eliminated when the budget is 0): rows with
+                mix_real > 0 are prefill rows — their chunk of
+                mix_real prompt tokens is revealed wholesale, and rows
+                flagged mix_seed get out[:, 0] replaced by the
+                first-token seeding draw from the prompt's last true
+                logits."""
                 from skypilot_tpu.infer import speculative as sl
                 from skypilot_tpu.models import llama as llama_lib
                 brange = jnp.arange(t_pend.shape[0])
-                reveal = kv_mask[brange, cursors] | active
+                act_w = (active | (mix_real > 0)) if mix_on else active
+                reveal = kv_mask[brange, cursors] | act_w
                 kv_mask = kv_mask.at[brange, cursors].set(reveal)
                 tokens = jnp.concatenate([t_pend[:, None], drafts],
                                          axis=1)
@@ -1242,13 +1528,27 @@ class ContinuousBatchingEngine:
                     top_ks, top_ps, max_k=max_k, use_top_p=use_top_p,
                     top_p_in_topk=top_p_in_topk)
                 counts = jnp.where(active, counts, 0)
+                counts_w = (jnp.where(mix_real > 0, mix_real, counts)
+                            if mix_on else counts)
                 slots_idx = jnp.arange(kv_mask.shape[1],
                                        dtype=jnp.int32)
-                window = (active[:, None]
+                window = (act_w[:, None]
                           & (slots_idx[None, :] >= cursors[:, None])
                           & (slots_idx[None, :]
-                             < (cursors + counts)[:, None]))
+                             < (cursors + counts_w)[:, None]))
                 kv_mask = kv_mask | window
+                if mix_on:
+                    keys0 = jax.vmap(
+                        lambda sd: jax.random.fold_in(
+                            jax.random.PRNGKey(sd), 0))(seeds)
+                    seed_logits = logits[
+                        brange, jnp.maximum(mix_real - 1, 0)]
+                    seed_tok = sample_logits_rows(
+                        seed_logits, keys0, temps, top_ks, top_ps,
+                        max_k=max_k, use_top_p=use_top_p,
+                        top_p_in_topk=top_p_in_topk)
+                    out = out.at[:, 0].set(
+                        jnp.where(mix_seed, seed_tok, out[:, 0]))
                 return out, counts, cache, kv_mask
 
             self._spec_verify = jax.jit(
@@ -1357,6 +1657,16 @@ class ContinuousBatchingEngine:
             self._epilogue_bytes_per_page = 0.0
             self._read_bytes_per_pos = self._eng.cache_read_bytes_per_step(
                 context=1)['grouped_bytes']
+        # Prefill read-traffic constants (per read POSITION of the
+        # batch-1 prefill cache): grouped = the prefix streamed once;
+        # epilogue = the XLA sliced-copy cost, exactly 0 under the
+        # fused ragged-prefill kernel — so the per-chunk estimate is
+        # two multiplies, not a pytree walk.
+        _pr = prefill_cache_read_bytes(
+            self._abstract_cache1, self.config.n_heads, context=1,
+            prefill_kernel=self.prefill_kernel)
+        self._prefill_read_bytes_per_pos = _pr['grouped_bytes']
+        self._prefill_epilogue_bytes_per_pos = _pr['epilogue_bytes']
 
     def cache_read_bytes_per_step(self, context: Optional[int] = None,
                                   row_contexts: Optional[Sequence[int]]
@@ -1385,6 +1695,30 @@ class ContinuousBatchingEngine:
             path=self.decode_kernel,
             page_size=self.page_size,
             interpret=self.decode_kernel_interpret,
+        )
+
+    def prefill_read_bytes_per_chunk(self, context: int
+                                     ) -> Dict[str, float]:
+        """Estimated HBM bytes one chunked-prefill forward reads from
+        the batch-1 prefill cache at read window `context` — see
+        prefill_cache_read_bytes.  The engine's own --prefill-kernel
+        choice sets the epilogue term: the XLA sliced-copy path pays
+        it, the fused ragged-prefill kernel reports 0."""
+        return prefill_cache_read_bytes(
+            self._abstract_cache1, self.config.n_heads, context,
+            prefill_kernel=self.prefill_kernel)
+
+    def prefill_kernel_info(self) -> Dict[str, Any]:
+        """prefill block for /health?verbose=1: the resolved
+        chunked-prefill attention implementation, its interpreter
+        flag, the mixed-batch token budget, and how many prompts are
+        mid-prefill right now."""
+        return dict(
+            path=self.prefill_kernel,
+            page_size=self.page_size,
+            interpret=self.prefill_kernel_interpret,
+            mix_budget=self.prefill_mix_budget,
+            pending=len(self._prefills),
         )
 
     def sharding_info(self) -> Dict[str, Any]:
@@ -1773,6 +2107,10 @@ class ContinuousBatchingEngine:
         tokens[0, :true_len] = prompt
         mask_row = np.zeros((self.max_seq_len,), bool)
         mask_row[:true_len] = True
+        if self.prefill_mix_budget > 0:
+            return self._admit_mixed(slot_idx, rid, cfg, true_len,
+                                     pad, tokens, mask_row, pages,
+                                     table_row, shared_len)
         try:
             cache1 = self._fresh_cache1()
             if shared_len > 0:
@@ -1809,6 +2147,53 @@ class ContinuousBatchingEngine:
         self._prefills.append(pending)
         self._finish_prefill(pending)
         self._prefills.pop()
+        return True
+
+    def _admit_mixed(self, slot_idx: int, rid: int,
+                     cfg: SamplingConfig, true_len: int, pad: int,
+                     tokens: Any, mask_row: Any, pages: List[int],
+                     table_row: Any, shared_len: int) -> bool:
+        """Mixed-batch admission (prefill_mix_budget > 0): there is no
+        batch-1 staging cache and no insert — the prompt's chunks ride
+        decode steps (_dispatch_mixed / _dispatch_spec) and write
+        straight into the slot's shared-cache row / pool pages.
+        Admission only RESERVES the slot: reset its kv_mask row (a
+        shared prefix arrives pre-revealed — its pages are in the pool
+        and the block-table row points at them, so no hydrate is
+        needed either) and, on a paged engine, write its device
+        block-table row.  Takes precedence over prefill_chunk, which
+        only governs the dedicated-tick staging path."""
+        seed = cfg.seed if cfg.seed is not None else (
+            hash((self._seed0, rid)) & 0x7FFFFFFF)
+        pending = _PendingPrefill(
+            slot_idx=slot_idx, rid=rid, cfg=cfg, true_len=true_len,
+            pad=pad, tokens=tokens, mask_row=mask_row, cache1=None,
+            done=shared_len, pages=pages, table_row=table_row,
+            shared_len=shared_len, mixed=True, seed=seed)
+        # Park BEFORE the donating device calls: on a mid-donation
+        # failure the supervisor's recover() finds the pending here,
+        # releases its pages (the allocator must verify leak-free) and
+        # fails the rid.
+        self._prefills.append(pending)
+        mask_init = np.zeros((self.max_seq_len,), bool)
+        mask_init[:shared_len] = True
+        try:
+            if self.page_size:
+                self._cache = self._set_table(
+                    self._cache, jnp.asarray(table_row),
+                    jnp.int32(slot_idx))
+            self._kv_mask = self._reserve_mask_row(
+                self._kv_mask, jnp.asarray(mask_init),
+                jnp.int32(slot_idx))
+        except Exception as e:  # pylint: disable=broad-except
+            # Both calls donate shared device buffers; a mid-donation
+            # failure is not containable to this rid.
+            raise failures.SharedStateError(
+                f'mixed-prefill reservation for request {rid} failed '
+                f'mid-donation; shared cache state unknown') from e
+        self.traces.event(rid, 'admitted',
+                          shared_prefix_tokens=shared_len)
+        self._met.prompt_tokens.inc(true_len)
         return True
 
     def _prefill_chunk_step(self, pending: _PendingPrefill) -> None:
@@ -1856,6 +2241,12 @@ class ContinuousBatchingEngine:
             pending.last_row = logits[0, last_idx - start]
         pending.done = start + size
         self.traces.event(pending.rid, 'prefill_chunk')
+        read_len = bucket if bucket else self.max_seq_len
+        self._met.prefill_kernel_steps.labels(
+            path=self.prefill_kernel).inc()
+        self._met.prefill_read_bytes.observe(
+            self._prefill_read_bytes_per_pos * read_len
+            + self._prefill_epilogue_bytes_per_pos * read_len)
         if pending.done >= pending.true_len:
             # The rest of the padded length is masked-off zeros that
             # decode never reads (it writes at pad_len + generated):
@@ -2053,10 +2444,14 @@ class ContinuousBatchingEngine:
         keep: List[_PendingPrefill] = []
         for p in self._prefills:
             if p.rid in snapshot:
-                # Mid-prefill cancel: the device table row was never
-                # written (that happens at _finish_prefill), so only
-                # the host-side pages need returning.
-                self._release_slot_pages(p.pages)
+                # Mid-prefill cancel: on the staging path the device
+                # table row was never written (that happens at
+                # _finish_prefill), so only the host-side pages need
+                # returning.  A MIXED pending wrote its table row at
+                # admission, so its row must be zeroed too before the
+                # pages can be reallocated.
+                self._release_slot_pages(
+                    p.pages, p.slot_idx if p.mixed else None)
                 if self.traces.finish(p.rid, 'evicted'):
                     evicted += 1
             else:
@@ -2161,6 +2556,12 @@ class ContinuousBatchingEngine:
         # chunk per pending prompt, bounded by n_slots.
         still_pending: List[_PendingPrefill] = []
         for pending in self._prefills:
+            if pending.mixed:
+                # Mixed pendings advance INSIDE decode steps
+                # (_dispatch_mixed / _dispatch_spec), not on dedicated
+                # prefill ticks.
+                still_pending.append(pending)
+                continue
             try:
                 self._prefill_chunk_step(pending)
             except Exception as e:  # pylint: disable=broad-except
@@ -2197,11 +2598,16 @@ class ContinuousBatchingEngine:
         self._schedule_front()
         occupied = [i for i, s in enumerate(self._slots)
                     if s is not None]
-        if not occupied:
+        mixed = [p for p in self._prefills if p.mixed]
+        if not occupied and not mixed:
             self._idle_gauges()
             return bool(self._prefills) or bool(self._queue)
-        handle = (self._dispatch_spec(occupied) if self.spec_k
-                  else self._dispatch_plain(occupied))
+        if self.spec_k:
+            handle = self._dispatch_spec(occupied, mixed)
+        elif mixed:
+            handle = self._dispatch_mixed(occupied, mixed)
+        else:
+            handle = self._dispatch_plain(occupied)
         self._fetch_handle(handle)
         if handle.error is not None:
             raise handle.error
@@ -2240,15 +2646,20 @@ class ContinuousBatchingEngine:
             return False
         occupied = [i for i, s in enumerate(self._slots)
                     if s is not None]
-        if not occupied:
+        mixed = [p for p in self._prefills if p.mixed]
+        if not occupied and not mixed:
             self._idle_gauges()
             # A tick that consumed the final in-flight step did real
             # work (commits, completions): report busy so callers
             # observe the synchronous contract — False only from a
             # tick that did nothing at all.
             return consumed or bool(self._prefills) or bool(self._queue)
-        handle = (self._dispatch_spec(occupied) if self.spec_k
-                  else self._dispatch_plain(occupied))
+        if self.spec_k:
+            handle = self._dispatch_spec(occupied, mixed)
+        elif mixed:
+            handle = self._dispatch_mixed(occupied, mixed)
+        else:
+            handle = self._dispatch_plain(occupied)
         self._pipeline_put(handle)
         return True
 
@@ -2476,7 +2887,129 @@ class ContinuousBatchingEngine:
             [self._slots[i].request_id for i in occupied],
             read_bytes, compiled, decode_key, t_enter, t_dispatched)
 
-    def _dispatch_spec(self, occupied: List[int]) -> _InflightStep:
+    def _mix_assignments(self, mixed: List[_PendingPrefill],
+                         s_cap: int) -> List[int]:
+        """FIFO split of the per-step prefill token budget across the
+        mixed pendings: earlier admissions drain first (bounded TTFT
+        for the head of the line), later ones wait their turn.  A row
+        never takes more than s_cap tokens (the step's query width) or
+        the tokens its prompt still needs."""
+        left = self.prefill_mix_budget
+        takes: List[int] = []
+        for p in mixed:
+            take = max(0, int(min(left, s_cap,
+                                  p.true_len - p.done)))
+            takes.append(take)
+            left -= take
+        return takes
+
+    def _dispatch_mixed(self, occupied: List[int],
+                        mixed: List[_PendingPrefill]) -> _InflightStep:
+        """Dispatch half of one MIXED step: live decode rows sample
+        and feed their next token exactly like _dispatch_plain, while
+        up to --prefill-mix-budget prompt-chunk tokens ride the same
+        s-query forward on the pending rows' slots — long prompts
+        amortize across decode steps instead of stalling them.  Decode
+        rows still commit exactly one token per step (the s>1 window
+        beyond query 0 is masked garbage for them), so their streams
+        stay bit-identical to unmixed plain decode."""
+        from skypilot_tpu.models import llama
+
+        b = self.n_slots
+        s = self._mix_s
+        cursors = np.zeros((b,), np.int32)
+        rope = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        temps = np.zeros((b,), np.float32)
+        seeds = np.zeros((b,), np.int32)
+        gens = np.zeros((b,), np.int32)
+        top_ks = np.zeros((b,), np.int32)
+        top_ps = np.ones((b,), np.float32)
+        tokens = np.zeros((b, s), np.int32)
+        n_commit = np.zeros((b,), np.int32)
+        last_pos = np.zeros((b,), np.int32)
+        update_last = np.zeros((b,), bool)
+        for i in occupied:
+            sl = self._slots[i]
+            cursors[i] = sl.pad_len + sl.generated
+            rope[i] = sl.prompt_len + sl.generated
+            active[i] = True
+            temps[i] = sl.temperature
+            seeds[i] = sl.seed
+            gens[i] = sl.generated
+            top_ks[i] = sl.top_k
+            top_ps[i] = sl.top_p
+            n_commit[i] = 1
+            update_last[i] = True
+        takes = self._mix_assignments(mixed, s)
+        mix: List[Tuple[Any, int]] = []
+        for p, take in zip(mixed, takes):
+            if take <= 0:
+                continue
+            i = p.slot_idx
+            # Chunk K/V lands at the cache cursor: slot == rope
+            # position == done for a prompt row.
+            cursors[i] = p.done
+            rope[i] = p.done
+            tokens[i, :take] = p.tokens[0, p.done:p.done + take]
+            n_commit[i] = take
+            seeding = p.done + take >= p.true_len
+            update_last[i] = seeding
+            last_pos[i] = take - 1 if seeding else 0
+            mix.append((p, take))
+        max_k = top_k_bucket(int(top_ks.max()),
+                             self.config.vocab_size)
+        use_top_p = bool((top_ps < 1.0).any())
+        top_p_in_topk = bool(
+            use_top_p and max_k > 0
+            and (top_ks[top_ps < 1.0] > 0).all())
+        work = occupied + [p.slot_idx for p, _ in mix]
+        if self.kv_read_bucket > 0:
+            # Query s-1 attends through position cursor + s - 1.
+            live = int(cursors[work].max()) + s
+            gran = self.kv_read_bucket
+            bucket = min(self.max_seq_len,
+                         ((live + gran - 1) // gran) * gran)
+        else:
+            bucket = self.max_seq_len
+        decode_key = ('mixed', max_k, use_top_p, top_p_in_topk,
+                      bucket)
+        compiled = decode_key not in self._decode_keys_seen
+        t_enter = time.perf_counter()
+        with llama.slot_mode():
+            tok_dev, self._last, self._cache, self._kv_mask = \
+                self._mixed(
+                    self.params, self._cache, self._last,
+                    self._kv_mask, jnp.asarray(tokens),
+                    jnp.asarray(rope), jnp.asarray(cursors),
+                    jnp.asarray(seeds), jnp.asarray(gens),
+                    jnp.asarray(active), jnp.asarray(n_commit),
+                    jnp.asarray(last_pos), jnp.asarray(update_last),
+                    jnp.asarray(temps), jnp.asarray(top_ks),
+                    jnp.asarray(top_ps), max_k=max_k,
+                    use_top_p=use_top_p, top_p_in_topk=top_p_in_topk,
+                    kv_bucket=bucket)
+        t_dispatched = time.perf_counter()
+        if compiled:
+            self._decode_keys_seen.add(decode_key)
+        if self.page_size:
+            ps = self.page_size
+            read_bytes = self._read_bytes_per_page * sum(
+                -(-(int(cursors[i]) + int(n_commit[i])) // ps)
+                for i in work)
+            read_bytes += (self._epilogue_bytes_per_page
+                           * self.n_slots * -(-bucket // ps))
+        else:
+            read_bytes = self._read_bytes_per_pos * bucket
+        return _InflightStep(
+            'mixed', (tok_dev,), list(occupied),
+            [self._slots[i].request_id for i in occupied],
+            read_bytes, compiled, decode_key, t_enter, t_dispatched,
+            mix=mix)
+
+    def _dispatch_spec(self, occupied: List[int],
+                       mixed: List[_PendingPrefill] = ()
+                       ) -> _InflightStep:
         """Dispatch half of one speculative step for all occupied
         slots: propose k tokens per row (draft model, or n-gram
         self-drafting when no draft is configured) and enqueue the
@@ -2519,15 +3052,42 @@ class ContinuousBatchingEngine:
             # Commits per verify = accepted + 1 <= n_prop + 1, and the
             # row may emit at most (max_new - generated) more tokens.
             n_prop[i] = min(k, s.max_new - s.generated - 1)
+        # Mixed-in prefill rows ride the same s = k+1 verify window:
+        # the chunk's first token takes the t_pend seat, the rest ride
+        # the draft seats, and mix_real[i] = take drives the wholesale
+        # reveal inside the verify (no acceptance test for prompt
+        # tokens).  The row stays inactive so the decode-side
+        # accept/commit arithmetic ignores it.
+        takes = self._mix_assignments(mixed, k + 1)
+        mix: List[Tuple[Any, int]] = []
+        mix_real = np.zeros((b,), np.int32)
+        mix_seed = np.zeros((b,), bool)
+        for p, take in zip(mixed, takes):
+            if take <= 0:
+                continue
+            i = p.slot_idx
+            cursors[i] = p.done
+            rope[i] = p.done
+            t_pend[i] = p.tokens[0, p.done]
+            cfg = p.cfg
+            temps[i] = cfg.temperature
+            seeds[i] = p.seed
+            gens[i] = 0
+            top_ks[i] = cfg.top_k
+            top_ps[i] = cfg.top_p
+            mix_real[i] = take
+            mix_seed[i] = p.done + take >= p.true_len
+            mix.append((p, take))
         max_k = top_k_bucket(int(top_ks.max()),
                              self.config.vocab_size)
         use_top_p = bool((top_ps < 1.0).any())
         top_p_in_topk = bool(
             use_top_p and max_k > 0
             and (top_ks[top_ps < 1.0] > 0).all())
+        work = occupied + [p.slot_idx for p, _ in mix]
         if self.kv_read_bucket > 0:
             # Query k attends through position cursor + k.
-            live = int(cursors[occupied].max()) + k + 1
+            live = int(cursors[work].max()) + k + 1
             gran = self.kv_read_bucket
             bucket = min(self.max_seq_len,
                          ((live + gran - 1) // gran) * gran)
@@ -2539,6 +3099,21 @@ class ContinuousBatchingEngine:
                 jnp.asarray(cursors), jnp.asarray(active),
                 kv_bucket=bucket)
             self._spec_met['draft_steps'].inc(k + 1)
+            if mix:
+                # Prompt rows override the draft's proposals with the
+                # real chunk tokens (the draft proposed garbage for
+                # these inactive rows; its private cache row is reset
+                # by draft.admit at seeding time).
+                mix_drafts = np.zeros((b, k), np.int32)
+                is_mix = np.zeros((b,), bool)
+                for p, take in mix:
+                    i = p.slot_idx
+                    is_mix[i] = True
+                    if take > 1:
+                        mix_drafts[i, :take - 1] = \
+                            p.tokens[0, p.done + 1:p.done + take]
+                drafts = jnp.where(jnp.asarray(is_mix)[:, None],
+                                   jnp.asarray(mix_drafts), drafts)
         else:
             drafts_np = np.zeros((b, k), np.int32)
             for i in occupied:
@@ -2547,6 +3122,10 @@ class ContinuousBatchingEngine:
                     s.prompt_ids + s.outputs, int(n_prop[i]))
                 drafts_np[i, :len(props)] = props
                 n_prop[i] = len(props)
+            for p, take in mix:
+                if take > 1:
+                    drafts_np[p.slot_idx, :take - 1] = \
+                        p.tokens[0, p.done + 1:p.done + take]
             drafts = jnp.asarray(drafts_np)
         decode_key = (max_k, use_top_p, top_p_in_topk, bucket)
         compiled = decode_key not in self._spec_keys_seen
@@ -2560,6 +3139,7 @@ class ContinuousBatchingEngine:
                     jnp.asarray(seeds), jnp.asarray(gens),
                     jnp.asarray(active), jnp.asarray(temps),
                     jnp.asarray(top_ks), jnp.asarray(top_ps),
+                    jnp.asarray(mix_real), jnp.asarray(mix_seed),
                     max_k=max_k, use_top_p=use_top_p,
                     top_p_in_topk=top_p_in_topk, kv_bucket=bucket)
         if self._draft is not None:
@@ -2573,7 +3153,7 @@ class ContinuousBatchingEngine:
         if self.page_size:
             ps = self.page_size
             read_bytes = self._read_bytes_per_page * sum(
-                -(-(int(cursors[i]) + k + 1) // ps) for i in occupied)
+                -(-(int(cursors[i]) + k + 1) // ps) for i in work)
             read_bytes += (self._epilogue_bytes_per_page
                            * self.n_slots * -(-bucket // ps))
         else:
@@ -2583,7 +3163,8 @@ class ContinuousBatchingEngine:
             [self._slots[i].request_id for i in occupied],
             read_bytes, compiled, decode_key, t_enter, t_dispatched,
             spec_n_prop=n_prop,
-            spec_proposed=int(n_prop[occupied].sum()))
+            spec_proposed=int(n_prop[occupied].sum()) if occupied
+            else 0, mix=mix)
 
     def _consume_step(self, handle: _InflightStep,
                       device_wait_s: Optional[float] = None,
@@ -2595,7 +3176,7 @@ class ContinuousBatchingEngine:
         stamp.  A slot whose request id changed since dispatch
         (evicted, aborted, recycled) is skipped — the guard that
         makes abort/cancel between dispatch and consume safe."""
-        if handle.mode == 'plain':
+        if handle.mode in ('plain', 'mixed'):
             toks = handle.host[0]
             n_tokens = None
             for i, rid in zip(handle.occupied, handle.rids):
@@ -2627,12 +3208,72 @@ class ContinuousBatchingEngine:
             self._spec_proposed_n += handle.spec_proposed
             self._spec_accepted_n += accepted
             n_tokens = committed
+        if handle.mix:
+            self._advance_mix(handle)
         self._publish_step_metrics(
             len(handle.occupied), handle.read_bytes,
             dispatch_s=handle.t_dispatched - handle.t_enter,
             device_wait_s=device_wait_s,
             compiled=handle.compiled, n_tokens=n_tokens,
             host_overlap_s=overlap_s)
+
+    def _advance_mix(self, handle: _InflightStep) -> None:
+        """Consume-side bookkeeping for the prefill chunks that rode
+        this step: advance each pending's cursor, and promote a
+        prompt that just finished into a live _Slot.  A pending
+        evicted between dispatch and consume is skipped — its device
+        writes were garbage on released pages, which the eviction
+        already zeroed out of the block table before any realloc."""
+        advanced = 0
+        for pending, take in handle.mix:
+            if pending not in self._prefills:
+                continue
+            pending.done += take
+            advanced += take
+            self.traces.event(pending.rid, 'prefill_chunk')
+            if pending.done >= pending.true_len:
+                self._prefills.remove(pending)
+                seed_tok = (int(handle.host[0][pending.slot_idx, 0])
+                            if handle.mode == 'spec' else None)
+                self._finish_mixed(pending, seed_tok)
+        if advanced:
+            self._met.prefill_mix_tokens.inc(advanced)
+            self._met.prefill_mixed_steps.inc()
+
+    def _finish_mixed(self, pending: _PendingPrefill,
+                      seed_tok: Optional[int]) -> None:
+        """Promote a drained mixed pending to a live slot.  The
+        prompt's K/V is already in the shared cache (chunks wrote in
+        place) and `last` already holds the final true token's logits
+        (the seeding row's update_last/last_pos), so there is no
+        insert and no donation hazard here.  On a spec engine the
+        first output token was sampled IN the final chunk's verify
+        step (mix_seed) and arrives via seed_tok — the same
+        (seed, gens=0) key fold _spec_seed_slot uses, so streams stay
+        bit-identical to the unmixed path."""
+        cfg = pending.cfg
+        self._slots[pending.slot_idx] = _Slot(
+            request_id=pending.rid, prompt_len=pending.true_len,
+            pad_len=pending.pad, max_new=cfg.max_new_tokens,
+            eos_id=cfg.eos_id, temperature=cfg.temperature,
+            top_k=cfg.top_k, top_p=cfg.top_p, seed=pending.seed,
+            pages=pending.pages)
+        if self.page_size:
+            self._alloc.register_prefix(
+                pending.tokens[0, :pending.true_len].tolist(),
+                pending.pages)
+        self.traces.event(pending.rid, 'prefill_done')
+        if self.spec_k:
+            slot = self._slots[pending.slot_idx]
+            if self._draft is not None:
+                self._draft.admit(pending.slot_idx, pending.tokens,
+                                  pending.mask_row, pending.true_len,
+                                  pending.pad)
+            else:
+                slot.prompt_ids = \
+                    pending.tokens[0, :pending.true_len].tolist()
+            self._met.output_tokens.inc()
+            self._commit_token(pending.slot_idx, int(seed_tok))
 
     def _publish_step_metrics(self, n_occupied: int,
                               read_bytes: float,

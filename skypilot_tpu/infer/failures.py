@@ -79,10 +79,26 @@ def wrap_abort(request_id: int, cause: BaseException) -> RequestAbortedError:
     return err
 
 
-def classify(exc: BaseException) -> str:
-    """``TRANSIENT`` or ``FATAL`` for an exception out of the decode loop."""
+def classify(exc: BaseException, context: str = 'decode') -> str:
+    """``TRANSIENT`` or ``FATAL`` for an exception out of the decode
+    loop (the default) or out of a backend bootstrap
+    (``context='init'``).
+
+    ``BackendInitHang`` flips class with the context: mid-serve it
+    means the LIVE backend is wedged under in-flight work — fatal,
+    replace the process.  During an init/bootstrap (the bench capture
+    ladder's first backend touch) it is the known-flaky tunneled-TPU
+    first connection (BENCH_r03–r05): a fresh attempt window routinely
+    succeeds, so init-context callers retry it under a wall budget
+    instead of burning the whole capture attempt on one flake.
+    """
+    if context not in ('decode', 'init'):
+        raise ValueError(
+            f"context must be 'decode' or 'init', got {context!r}")
     if isinstance(exc, (MemoryError, KeyboardInterrupt, SystemExit)):
         return FATAL
+    if context == 'init' and type(exc).__name__ == 'BackendInitHang':
+        return TRANSIENT
     if type(exc).__name__ in _FATAL_TYPE_NAMES:
         return FATAL
     message = str(exc)

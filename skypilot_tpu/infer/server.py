@@ -187,6 +187,8 @@ class InferenceServer:
                  spec_k: int = 0,
                  async_pipeline: bool = True,
                  decode_kernel: str = 'auto',
+                 prefill_kernel: str = 'auto',
+                 prefill_mix_budget: int = 0,
                  ) -> None:
         from skypilot_tpu.parallel import mesh as mesh_lib
         # Hang-proof first backend touch: a wedged tunneled TPU makes
@@ -223,13 +225,20 @@ class InferenceServer:
                 draft_checkpoint_dir=draft_checkpoint_dir,
                 draft_overrides=draft_overrides, spec_k=spec_k,
                 async_pipeline=async_pipeline,
-                decode_kernel=decode_kernel)
+                decode_kernel=decode_kernel,
+                prefill_kernel=prefill_kernel,
+                prefill_mix_budget=prefill_mix_budget)
         else:
             if decode_kernel != 'auto':
                 raise ValueError(
                     '--decode-kernel requires continuous batching '
                     '(paged decode attention is slot-mode only); drop '
                     '--no-continuous.')
+            if prefill_kernel != 'auto' or prefill_mix_budget:
+                raise ValueError(
+                    '--prefill-kernel/--prefill-mix-budget require '
+                    'continuous batching (chunked prefill is a '
+                    'slot-engine path); drop --no-continuous.')
             if page_size:
                 raise ValueError(
                     '--page-size requires continuous batching (the '
@@ -358,6 +367,12 @@ class InferenceServer:
             # (fused Pallas vs XLA gather), page geometry, and whether
             # the kernel runs in interpreter mode (off-TPU tests only).
             detail['decode_kernel'] = dk()
+        pk = getattr(eng, 'prefill_kernel_info', None)
+        if pk is not None:
+            # Chunked-prefill implementation: resolved path (fused
+            # ragged-prefill Pallas vs XLA sliced-prefix), the
+            # mixed-batch token budget, and pending prompt count.
+            detail['prefill_kernel'] = pk()
         sh = getattr(eng, 'sharding_info', None)
         if sh is not None:
             # Tensor-parallel geometry: mesh axis sizes, how the KV
@@ -1185,6 +1200,29 @@ def main() -> None:
                              'picks fused on TPU with --page-size, '
                              'xla otherwise — off-TPU the fused '
                              'kernel only runs interpreted (tests).')
+    parser.add_argument('--prefill-kernel', default='auto',
+                        choices=['auto', 'fused', 'xla'],
+                        help='Chunked-prefill attention '
+                             "implementation: 'fused' walks the "
+                             'paged cache prefix inside the ragged-'
+                             'prefill Pallas kernel (online-softmax '
+                             'tiling, int8 dequant, cursor-base '
+                             'causal masking, zero gathered '
+                             "intermediates); 'xla' is the sliced-"
+                             'prefix + grouped-einsum path (permanent '
+                             "fallback and parity oracle). 'auto' "
+                             'picks fused on TPU with --page-size, '
+                             'xla otherwise.')
+    parser.add_argument('--prefill-mix-budget', type=int, default=0,
+                        help='Mixed prefill/decode batching: admit up '
+                             'to this many prompt-chunk tokens into '
+                             'each decode step so long prompts '
+                             'amortize across steps instead of '
+                             'stalling co-resident decodes (0 = '
+                             'dedicated prefill ticks, today\'s '
+                             'behavior). Composes with --spec-k, '
+                             '--page-size, --mesh and the async '
+                             'pipeline.')
     parser.add_argument('--kv-read-bucket', type=int, default=512,
                         help='Decode attention reads only the live '
                              'cache prefix, rounded up to this bucket '
@@ -1230,6 +1268,8 @@ def main() -> None:
                     draft_overrides=draft_overrides,
                     spec_k=args.spec_k,
                     decode_kernel=args.decode_kernel,
+                    prefill_kernel=args.prefill_kernel,
+                    prefill_mix_budget=args.prefill_mix_budget,
                     async_pipeline=args.async_pipeline,
                     ).serve_forever()
 

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from skypilot_tpu.ops import flash_attention as fa
 from skypilot_tpu.ops import grouped_attention as ga
 from skypilot_tpu.ops import paged_attention as pa
+from skypilot_tpu.ops import ragged_prefill as rp
 
 
 @dataclasses.dataclass(frozen=True)
@@ -292,6 +293,29 @@ def decode_kernel(kind: str):
         yield
     finally:
         _SLOT_MODE.decode_kernel = prev
+
+
+@contextlib.contextmanager
+def prefill_kernel(kind: str):
+    """Select the chunked-prefill attention implementation for calls
+    traced under this context (the prefill sibling of decode_kernel):
+    'fused' runs the Pallas ragged-prefill kernel that streams the
+    live cache prefix page-by-page with in-kernel cursor-base causal
+    masking (ops/ragged_prefill — interpreter mode off-TPU), 'xla'
+    keeps the sliced-prefix + grouped-einsum path.  The engine
+    resolves its --prefill-kernel=auto flag to one of the two and
+    wraps its jitted prefill CALLS in this context; outside it the XLA
+    path — the permanent fallback and parity oracle — is always
+    used."""
+    if kind not in ('fused', 'xla'):
+        raise ValueError(
+            f"prefill_kernel must be 'fused' or 'xla', got {kind!r}")
+    prev = getattr(_SLOT_MODE, 'prefill_kernel', 'xla')
+    _SLOT_MODE.prefill_kernel = kind
+    try:
+        yield
+    finally:
+        _SLOT_MODE.prefill_kernel = prev
 
 
 @contextlib.contextmanager
@@ -665,6 +689,36 @@ def run_cached_attention(module: nn.Module, q: jax.Array, k: jax.Array,
         bucket = getattr(_SLOT_MODE, 'kv_bucket', None)
         read_len = bucket if (bucket is not None
                               and bucket < max_len) else max_len
+        if (page_size > 0
+                and getattr(_SLOT_MODE, 'prefill_kernel',
+                            'xla') == 'fused'):
+            # Fused ragged prefill (ops/ragged_prefill): stream the
+            # live prefix from the cache one page-shaped tile at a
+            # time, with the causal mask computed in-kernel against
+            # the chunk's cursor base — no [b, kvh, read_len, hd]
+            # sliced copy and no [s, read_len] mask tensor in HBM.
+            # The identity table walks the contiguous cache as logical
+            # pages; columns in the n_read*ps round-up past read_len
+            # sit at positions >= idx + s and are causally dead, so
+            # page-granular reads are exact.
+            n_read = -(-read_len // page_size)
+            tbl = jnp.broadcast_to(
+                jnp.arange(n_read, dtype=jnp.int32)[None],
+                (b, n_read))
+            vis = (kv_mask if kv_mask is not None
+                   else jnp.ones((b, max_len), bool))
+            if vis.shape[1] < max_len:
+                # Padded columns sit at positions >= idx + s (the
+                # engine's read bucket covers the mask) — causally
+                # dead either way, so padding False is exact.
+                vis = jnp.pad(
+                    vis, ((0, 0), (0, max_len - vis.shape[1])))
+            return rp.ragged_prefill_attention(
+                q, cached_k.value, cached_v.value, tbl, idx, vis,
+                scale=hd ** -0.5, probs_dtype=dtype,
+                page_size=page_size, window=window,
+                key_scale=k_scale.value if quant else None,
+                value_scale=v_scale.value if quant else None)
         slots = jnp.arange(read_len)
         rows = idx + jnp.arange(s)
         causal = slots[None, :] <= rows[:, None]

@@ -48,6 +48,12 @@ METRIC_CONTRACT = frozenset({
     'skytpu_output_tokens_total',
     'skytpu_prefix_cache_page_hits_total',
     'skytpu_prefix_cache_page_misses_total',
+    # infer/engine.py — chunked prefill (dedicated ticks and the
+    # mixed-batch path behind --prefill-mix-budget)
+    'skytpu_prefill_cache_read_bytes',
+    'skytpu_prefill_kernel_steps_total',  # labels: path=fused|xla
+    'skytpu_prefill_mix_tokens_total',
+    'skytpu_prefill_mixed_steps_total',
     'skytpu_prompt_tokens_total',
     # infer/speculative.py — speculative decoding (registered only on
     # engines started with spec_k > 0; the replica scrape test filters

@@ -1,0 +1,329 @@
+"""Fused ragged-prefill attention kernel for TPU (Pallas).
+
+The XLA chunked-prefill path (models/llama.py `run_cached_attention`,
+global-cursor branch) writes a chunk's K/V at the cache cursor and then
+slices the live prefix — `cached_k.value[:, :, :read_len]` — for the
+grouped epilogue.  XLA materializes that slice as a contiguous
+[B, kvh, read_len, hd] copy (plus the V and int8-scale siblings): an
+HBM round-trip that is written and immediately re-read every chunk,
+growing with the prompt's live prefix — the prefill twin of the decode
+gather `ops/paged_attention.py` killed in PR 12.
+
+This kernel streams the prefix straight from the cache instead.  The
+cache is viewed as LOGICAL pages of `page_size` positions and a block
+table rides in as a scalar-prefetch operand — the same
+`PrefetchScalarGridSpec` indirection the fused decode kernel uses —
+so each (row, kv-head, logical-page) program's K/V BlockSpec index map
+dereferences `(b, h, table[b, j], 0)` and one [page_size, d] tile
+streams cache -> VMEM per grid step.  For the contiguous prefill cache
+the table is the identity (logical page j at position j*ps); the
+indirection is kept so prefix-shared pages hydrated from the pool
+stream once through the same mechanism.  Fused in one program, with
+zero intermediate HBM tensors:
+
+  - prefix streaming (the BlockSpec indirection above; no sliced copy);
+  - causal masking against the chunk's cache-cursor base, computed
+    in-kernel from a second scalar-prefetch operand (never a
+    [S, read_len] mask tensor in HBM);
+  - the optional sliding window and the kv_mask validity row, the
+    latter sliced per page by its own BlockSpec;
+  - int8 dequant: per-(kv-head, position) f32 scales fold into the
+    dots (key scales scale the score columns post-QK, value scales
+    fold into the probabilities pre-PV) — no float copy of the cache;
+  - grouped attention: the G = H/kvh query heads sharing a kv head
+    ride one program as a [G*S, d] q block, S = the chunk length;
+  - online-softmax accumulation across the prefix's pages (the
+    f32 m/l/acc tiling from ops/flash_attention.py's fwd kernel).
+
+Off-TPU the kernel runs in interpreter mode (tests); serving defaults
+never select it off-TPU — the XLA slice path stays the production
+fallback and parity oracle (see `--prefill-kernel` on the engine).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+_NEG_INF = -1e30
+_TENSOR_AXIS = mesh_lib.AXIS_TENSOR
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == 'tpu'
+
+
+def _prefill_kernel_body(refs, *, scale: float, group: int, s: int,
+                         ps: int, window: Optional[int],
+                         quant: bool) -> None:
+    """One grid step: fold logical page j of row b / kv-head h into the
+    running online-softmax state.  Grid is (B, kvh, n_read) with the
+    page axis innermost, so the o/scratch blocks stay VMEM-resident
+    across a row's whole page sweep (the Pallas revisiting rule).
+
+    Visibility is computed IN-KERNEL: query row r is chunk position
+    i = r % s (the q block is [G, S] flattened group-major), its cache
+    position is base + i, and page j covers cache positions
+    [table[b, j]*ps, table[b, j]*ps + ps) — causal keeps kv_pos <=
+    qpos, the sliding window keeps kv_pos >= qpos - window + 1, and
+    the kv_mask page slice hides padding.  A page fully masked for
+    some query contributes p = exp(0) garbage that the next unmasked
+    page's correction factor exp(-1e30 - m) == 0 cancels exactly —
+    the same self-correcting flash recurrence the decode kernel uses.
+    """
+    if quant:
+        (tbl_ref, base_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+         kvm_ref, o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        (tbl_ref, base_ref, q_ref, k_ref, v_ref,
+         kvm_ref, o_ref, m_ref, l_ref, acc_ref) = refs
+    bi = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)            # [G*S, d]
+    k = k_ref[0, 0].astype(jnp.float32)            # [ps, d]
+    v = v_ref[0, 0].astype(jnp.float32)            # [ps, d]
+    gs = q.shape[0]
+    sc = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [G*S, ps]
+    if quant:
+        sc = sc * ks_ref[0, 0][:, 0][None, :]
+    # In-kernel ragged causal mask against the cache-cursor base.
+    row = jax.lax.broadcasted_iota(jnp.int32, (gs, ps), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (gs, ps), 1)
+    qpos = base_ref[bi] + jax.lax.rem(row, s)
+    kv_pos = tbl_ref[bi, j] * ps + col
+    keep = kv_pos <= qpos
+    if window is not None:
+        keep &= kv_pos >= qpos - window + 1
+    keep &= kvm_ref[0][None, :]
+    sc = jnp.where(keep, sc, _NEG_INF)
+    m_prev = m_ref[:, :1]                          # [G*S, 1]
+    m_cur = jnp.max(sc, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(sc - m_new)                        # [G*S, ps]
+    correction = jnp.exp(m_prev - m_new)
+    l_new = correction * l_ref[:, :1] + jnp.sum(p, axis=1,
+                                                keepdims=True)
+    if quant:
+        p = p * vs_ref[0, 0][:, 0][None, :]
+    acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def ragged_prefill_attention(q: jax.Array, keys: jax.Array,
+                             values: jax.Array, table: jax.Array,
+                             base: jax.Array, kv_mask: jax.Array, *,
+                             scale: float, probs_dtype: Any,
+                             page_size: int,
+                             window: Optional[int] = None,
+                             key_scale: Optional[jax.Array] = None,
+                             value_scale: Optional[jax.Array] = None,
+                             interpret: Optional[bool] = None
+                             ) -> jax.Array:
+    """Chunked-prefill attention straight from the contiguous cache.
+
+    Under an ambient mesh with `tensor > 1` the kernel self-lowers
+    through shard_map manual over the tensor axis, exactly like the
+    fused decode kernel: each chip streams its LOCAL kv-head shard of
+    the cache, q's head axis splits into the same contiguous
+    kv-head-major chunks, the table/base/kv_mask ride in whole, and
+    the [B, S, H, d] output stays head-sharded for the downstream
+    o_proj row-parallel psum.  No collective runs inside the kernel.
+    """
+    mesh = None
+    from skypilot_tpu.ops import paged_attention as pa
+    if not pa._in_manual_region(_TENSOR_AXIS):
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        mesh = sharding_lib.ambient_physical_mesh()
+    tensor = mesh.shape.get(_TENSOR_AXIS, 1) if mesh is not None else 1
+    if tensor <= 1:
+        return _ragged_prefill_impl(
+            q, keys, values, table, base, kv_mask, scale=scale,
+            probs_dtype=probs_dtype, page_size=page_size,
+            window=window, key_scale=key_scale,
+            value_scale=value_scale, interpret=interpret)
+    kvh = keys.shape[1]
+    if kvh % tensor:
+        # resolve_kernels refuses this combination at startup; raising
+        # here too turns any path that slips through into a
+        # diagnosable error instead of a Pallas partitioning crash.
+        raise ValueError(
+            f'fused ragged prefill under tensor={tensor} needs the '
+            f'cache kv-head axis ({kvh}) divisible by it; this '
+            "geometry must use prefill_kernel='xla'")
+    from jax.sharding import PartitionSpec as P
+
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    quant = key_scale is not None
+    head_spec = P(None, _TENSOR_AXIS, None, None)
+    in_specs = [head_spec, head_spec, head_spec]   # q + K/V caches
+    if quant:
+        in_specs += [head_spec, head_spec]         # scale caches
+    in_specs += [P(), P(), P()]                    # table, base, mask
+    out_spec = P(None, None, _TENSOR_AXIS, None)   # [B, S, H, d]
+
+    def _shard(q_, ck, cv, *rest):
+        if quant:
+            ks, vs, tbl, bs, msk = rest
+        else:
+            ks = vs = None
+            tbl, bs, msk = rest
+        return _ragged_prefill_impl(
+            q_, ck, cv, tbl, bs, msk, scale=scale,
+            probs_dtype=probs_dtype, page_size=page_size,
+            window=window, key_scale=ks, value_scale=vs,
+            interpret=interpret)
+
+    args = [q, keys, values]
+    if quant:
+        args += [key_scale, value_scale]
+    args += [table, base, kv_mask]
+    wrapped = sharding_lib.shard_map_compat(
+        _shard, mesh=mesh, in_specs=tuple(in_specs),
+        out_specs=out_spec, axis_names=frozenset({_TENSOR_AXIS}))
+    return wrapped(*args)
+
+
+def _ragged_prefill_impl(q: jax.Array, keys: jax.Array,
+                         values: jax.Array, table: jax.Array,
+                         base: jax.Array, kv_mask: jax.Array, *,
+                         scale: float, probs_dtype: Any,
+                         page_size: int,
+                         window: Optional[int] = None,
+                         key_scale: Optional[jax.Array] = None,
+                         value_scale: Optional[jax.Array] = None,
+                         interpret: Optional[bool] = None
+                         ) -> jax.Array:
+    """Single-shard pallas_call: one prefill chunk's attention over (a
+    local shard of) the contiguous cache.
+
+    q:          [B, H, S, d] float chunk queries (S = chunk length;
+                query i sits at cache position base + i).
+    keys /
+    values:     [B, kvh, L, d] contiguous cache (bf16/f32, or int8
+                with the sibling scale leaves below).  L % page_size
+                must be 0; the kernel reads it as L//page_size logical
+                pages.
+    table:      [B, n_read] int32 — each row's logical-page walk,
+                truncated to the pages under the bucketed read window.
+                Identity (page j at slot j) for the contiguous prefill
+                cache; kept general so hydrated prefix pages stream
+                through the same scalar-prefetch indirection.
+    base:       int32 scalar or [B] — each row's cache-cursor base:
+                causal visibility is kv_pos <= base[b] + i per query
+                i, computed in-kernel (no mask tensor in HBM).  A
+                scalar broadcasts (the batch-1 staging prefill).
+    kv_mask:    bool [B, L] — validity row (padding/unwritten slots);
+                sliced per logical page by its BlockSpec.
+    key_scale /
+    value_scale: [B, kvh, L, 1] f32 absmax scales for int8 K/V (both
+                or neither).
+    interpret:  None = `not _on_tpu()` (interpreter mode off-TPU for
+                tests; compiled Mosaic on TPU).
+
+    Returns [B, S, H, d] in `probs_dtype` — the same contract as
+    `grouped_attention` and the XLA chunked-prefill epilogue.
+    """
+    b, h, s, d = q.shape
+    bk, kvh, max_len, dk = keys.shape
+    ps = page_size
+    if ps <= 0:
+        raise ValueError(f'page_size must be > 0, got {ps}')
+    if max_len % ps:
+        raise ValueError(
+            f'cache length ({max_len}) must be a multiple of '
+            f'page_size ({ps})')
+    if h % kvh:
+        raise ValueError(
+            f'query heads ({h}) not divisible by kv heads ({kvh})')
+    if dk != d:
+        raise ValueError(
+            f'cache head_dim ({dk}) != query head_dim ({d})')
+    quant = key_scale is not None
+    if quant != (value_scale is not None):
+        raise ValueError('key_scale and value_scale must be passed '
+                         'together (int8 cache) or not at all')
+    group = h // kvh
+    gs = group * s
+    n_read = table.shape[1]
+    if n_read * ps > max_len:
+        raise ValueError(
+            f'table walks {n_read} pages of {ps} positions, beyond '
+            f'the cache length ({max_len})')
+    base = jnp.broadcast_to(
+        jnp.asarray(base, jnp.int32).reshape(-1), (b,))
+    # [B, H, S, d] -> [B, kvh, G*S, d]: the same head order the grouped
+    # einsum uses (head index = kv_head * G + group member).
+    qg = q.reshape(b, kvh, gs, d)
+
+    def tile(index_map, block):
+        return pl.BlockSpec(block, index_map)
+
+    cache_spec = tile(
+        lambda bi, hi, j, tbl, bs: (bi, hi, tbl[bi, j], 0),
+        (1, 1, ps, d))
+    in_specs = [
+        tile(lambda bi, hi, j, tbl, bs: (bi, hi, 0, 0), (1, 1, gs, d)),
+        cache_spec,
+        cache_spec,
+    ]
+    args = [qg, keys, values]
+    if quant:
+        scale_spec = tile(
+            lambda bi, hi, j, tbl, bs: (bi, hi, tbl[bi, j], 0),
+            (1, 1, ps, 1))
+        in_specs += [scale_spec, scale_spec]
+        args += [key_scale, value_scale]
+    in_specs.append(tile(lambda bi, hi, j, tbl, bs: (bi, tbl[bi, j]),
+                         (1, ps)))
+    args.append(kv_mask)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kvh, n_read),
+        in_specs=in_specs,
+        out_specs=tile(lambda bi, hi, j, tbl, bs: (bi, hi, 0, 0),
+                       (1, 1, gs, d)),
+        scratch_shapes=[
+            pltpu.VMEM((gs, 128), jnp.float32),    # running max
+            pltpu.VMEM((gs, 128), jnp.float32),    # running denom
+            pltpu.VMEM((gs, d), jnp.float32),      # output acc
+        ],
+    )
+
+    def kernel(*refs):
+        _prefill_kernel_body(refs, scale=scale, group=group, s=s,
+                             ps=ps, window=window, quant=quant)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, gs, d), probs_dtype),
+        interpret=(not _on_tpu()) if interpret is None else interpret,
+    )(table, base, *args)
+    # [B, kvh, G*S, d] -> [B, S, H, d] (grouped_attention's contract).
+    return out.reshape(b, kvh, group, s, d).transpose(
+        0, 3, 1, 2, 4).reshape(b, s, h, d)

@@ -484,13 +484,23 @@ def _fake_decode_engines(bench, monkeypatch):
 
     built = []
 
+    ticks = itertools.count()
+
+    class _FakeEvent:
+        def __init__(self):
+            self._set = False
+
+        def is_set(self):
+            return self._set
+
     class _FakeCBE:
         kv_read_bucket = 512
 
         def __init__(self, model, n_slots=4, prefill_bucket=16,
                      model_overrides=None, param_dtype=None,
                      params=None, kv_cache_dtype='auto', page_size=0,
-                     decode_kernel='auto', **_kw):
+                     decode_kernel='auto', prefill_chunk=0,
+                     prefill_mix_budget=0, **_kw):
             self.kv_cache_dtype = kv_cache_dtype
             self.page_size = page_size
             self.mesh = _kw.get('mesh')
@@ -500,6 +510,14 @@ def _fake_decode_engines(bench, monkeypatch):
             self.max_seq_len = (model_overrides or {}).get(
                 'max_seq_len', 512)
             self.params = {'w': 0} if params is None else params
+            self.prefill_chunk = prefill_chunk
+            self.prefill_mix_budget = prefill_mix_budget
+            self.prefill_kernel = 'xla'
+            self.config = types.SimpleNamespace(n_heads=4)
+            self._abstract_cache1 = {}
+            self._next_rid = 0
+            self._reqs = {}
+            self._events = {}
             self._eng = types.SimpleNamespace(
                 _bucketed=lambda n, b=prefill_bucket:
                     min(((n + b - 1) // b) * b, self.max_seq_len))
@@ -507,6 +525,75 @@ def _fake_decode_engines(bench, monkeypatch):
 
         def generate(self, prompts, sampling):
             return [[1] * sampling.max_new_tokens for _ in prompts]
+
+        # -- minimal submit/step scheduler for the interference arm --
+        # The fake clock (bench.time.time, one tick per call) is
+        # advanced once per DISPATCH, mirroring the real mechanism:
+        # mix off pays a decode forward PLUS one chunk forward per
+        # pending prompt each tick, mix on pays one mixed forward.
+        def submit(self, prompt_ids, sampling=None, **_kw):
+            rid = self._next_rid
+            self._next_rid += 1
+            self._reqs[rid] = {
+                'prefill_left': len(prompt_ids),
+                'decoded': 0, 'new': sampling.max_new_tokens,
+                'out': [1] * sampling.max_new_tokens}
+            self._events[rid] = _FakeEvent()
+            return rid
+
+        def step(self):
+            live = {rid: r for rid, r in self._reqs.items()
+                    if not self._events[rid].is_set()}
+            if not live:
+                return False
+            prefilling = [r for r in live.values()
+                          if r['prefill_left'] > 0]
+            decoding = [r for r in live.values()
+                        if r['prefill_left'] <= 0]
+            if self.prefill_mix_budget > 0:
+                dispatches = 1
+                budget = self.prefill_mix_budget
+                for r in prefilling:
+                    take = min(budget, r['prefill_left'])
+                    r['prefill_left'] -= take
+                    budget -= take
+                    if budget <= 0:
+                        break
+                for r in decoding:
+                    r['decoded'] += 1
+            else:
+                dispatches = len(prefilling) + (1 if decoding else 0)
+                chunk = self.prefill_chunk or self.max_seq_len
+                for r in prefilling:
+                    r['prefill_left'] -= min(chunk, r['prefill_left'])
+                for r in decoding:
+                    r['decoded'] += 1
+            for _ in range(dispatches):
+                next(ticks)                    # advance the fake clock
+            for rid, r in live.items():
+                if r['prefill_left'] <= 0 and r['decoded'] >= r['new']:
+                    self._events[rid]._set = True
+            return True
+
+        def run_until_idle(self):
+            while self.step():
+                pass
+
+        def wait(self, rid, timeout=None):
+            return self._reqs[rid]['out']
+
+        def prefill_read_bytes_per_chunk(self, context):
+            grouped = 100.0 * context
+            return {'grouped_bytes': grouped,
+                    'epilogue_bytes': 2 * grouped,
+                    'total_bytes': 3 * grouped,
+                    'repeat_bytes': 4 * grouped, 'reduction': 4.0}
+
+        def prefill_kernel_info(self):
+            return {'path': self.prefill_kernel,
+                    'page_size': self.page_size, 'interpret': False,
+                    'mix_budget': self.prefill_mix_budget,
+                    'pending': 0}
 
         def speculation_info(self):
             # Monotonic step counter: run_decode diffs two calls to
@@ -559,7 +646,6 @@ def _fake_decode_engines(bench, monkeypatch):
 
     monkeypatch.setattr(engine_mod, 'ContinuousBatchingEngine',
                         _FakeCBE)
-    ticks = itertools.count()
     monkeypatch.setattr(bench.time, 'time',
                         lambda: float(next(ticks)))
     return built
@@ -582,7 +668,8 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     assert parsed['value'] == round(2304.0 / 1160.0, 2)  # 1.99
     assert set(parsed['arms']) == {'bf16', 'int8', 'paged',
                                    'speculative', 'async',
-                                   'fused_kernel', 'sharded'}
+                                   'fused_kernel', 'sharded',
+                                   'prefill_interference'}
     assert parsed['arms']['int8']['kv_cache_dtype'] == 'int8'
     assert 'int8' in parsed['metric']
     # Ragged arm: contiguous reads 4 slots * the full 512 bucket;
@@ -592,7 +679,7 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     assert parsed['paged_read_reduction_vs_contiguous'] == \
         round(4 * 512 / 200, 2)  # 10.24
     assert parsed['paged_token_parity'] is True
-    # Twelve engines: the five DeepSeek-geometry arms (incl. the
+    # Fourteen engines: the five DeepSeek-geometry arms (incl. the
     # disabled-registry overhead arm) all serving the SAME weights,
     # then the gpt2 speculation pair (its own weights — plain
     # reference engine + speculating twin sharing them), then the
@@ -600,21 +687,25 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     # shared between the two modes), then the fused-kernel XLA/fused
     # pair (speculation-geometry weights, shared across the pair),
     # then the tensor=4 sharded twin of the kernel arm's XLA engine
-    # (same seed, so the parity assert needs no weight shipping).
+    # (same seed, so the parity assert needs no weight shipping),
+    # then the prefill-interference pair (mix off / mix on, shared
+    # weights).
     assert [b.kv_cache_dtype for b in built] == \
         ['auto', 'int8', 'auto', 'auto', 'auto', 'auto', 'auto',
-         'int8', 'int8', 'int8', 'int8', 'int8']
+         'int8', 'int8', 'int8', 'int8', 'int8', 'auto', 'auto']
     assert [b.page_size for b in built] == \
-        [0, 0, 0, 8, 8, 0, 0, 8, 8, 8, 8, 8]
+        [0, 0, 0, 8, 8, 0, 0, 8, 8, 8, 8, 8, 8, 8]
     assert all(b.params is built[0].params for b in built[1:5])
     assert built[6].params is built[5].params
     assert built[8].params is built[7].params
     assert built[10].params is built[9].params
-    assert [b.decode_kernel for b in built[9:]] == ['xla', 'fused',
-                                                    'xla']
+    assert [b.decode_kernel for b in built[9:12]] == ['xla', 'fused',
+                                                      'xla']
     assert built[11].mesh is not None
     assert built[11].mesh.devices.size == 4
-    assert all(b.mesh is None for b in built[:11])
+    assert all(b.mesh is None for b in built[:11] + built[12:])
+    assert [b.prefill_mix_budget for b in built[12:]] == [0, 8]
+    assert built[13].params is built[12].params
     spec = parsed['arms']['speculative']
     assert spec['spec_k'] == 4
     assert spec['greedy_parity_vs_plain'] is True
@@ -665,18 +756,40 @@ def test_decode_emits_one_json_line_and_stderr_summary(
         round(tp['tokens_per_sec_4chip'] / 4, 1)
     assert tp['tokens_per_sec_per_chip_1chip'] == \
         tp['tokens_per_sec_1chip']
+    # Prefill-interference arm: the fake's tick accounting (one clock
+    # tick per dispatch) must reproduce the real mechanism — mix on
+    # strictly improves decode TPOT under a concurrent long prefill.
+    mi = parsed['arms']['prefill_interference']
+    assert mi['greedy_parity_mix_on_vs_off'] is True
+    assert parsed['prefill_mix_token_parity'] is True
+    assert mi['decode_tpot_ms_under_prefill_mix_on'] < \
+        mi['decode_tpot_ms_under_prefill_mix_off']
+    assert parsed['prefill_mix_tpot_improvement'] == \
+        mi['tpot_improvement_mix_on_vs_off'] > 1.0
+    for key in ('decode_tpot_ms_alone', 'long_prompt_tokens',
+                'prefill_chunk', 'prefill_mix_budget',
+                'prefill_read_bytes_per_chunk_xla',
+                'prefill_read_bytes_per_chunk_fused',
+                'prefill_epilogue_bytes_per_chunk_xla',
+                'prefill_epilogue_bytes_per_chunk_fused',
+                'tokens_per_sec_total_mix_off',
+                'tokens_per_sec_total_mix_on', 'prefill_kernel'):
+        assert key in mi, key
+    assert mi['prefill_kernel']['mix_budget'] == 8
     err = [l for l in captured.err.splitlines() if l.startswith('#')]
     # dtype arms + ratio + paged + speculative + async + fused-kernel
-    # + sharded + telemetry
-    assert len(err) == 9
-    assert 'fewer bytes/step' in err[-6]
-    assert 'token parity: True' in err[-5]  # the speculative line
-    assert 'steps/token' in err[-5]
-    assert 'device-wait fraction' in err[-4]  # the async line
+    # + sharded + prefill-interference + telemetry
+    assert len(err) == 10
+    assert 'fewer bytes/step' in err[-7]
+    assert 'token parity: True' in err[-6]  # the speculative line
+    assert 'steps/token' in err[-6]
+    assert 'device-wait fraction' in err[-5]  # the async line
+    assert 'token parity: True' in err[-5]
+    assert 'fused' in err[-4]               # the fused-kernel line
     assert 'token parity: True' in err[-4]
-    assert 'fused' in err[-3]               # the fused-kernel line
+    assert 'tok/s/chip' in err[-3]          # the sharded line
     assert 'token parity: True' in err[-3]
-    assert 'tok/s/chip' in err[-2]          # the sharded line
+    assert 'prefill-interference' in err[-2]
     assert 'token parity: True' in err[-2]
     assert 'telemetry' in err[-1]
 
@@ -823,6 +936,103 @@ def test_decode_smoke_sharded_arm(decode_smoke_json):
     assert arm['sharding']['fallback'] is False
     assert arm['tokens_per_sec_per_chip_4chip'] > 0
     assert arm['tokens_per_sec_per_chip_1chip'] > 0
+
+
+def test_decode_smoke_prefill_interference_arm(decode_smoke_json):
+    """ISSUE 16's bench acceptance bar, proven on the real engines in
+    the same --smoke run: decode TPOT of short streams under a
+    concurrent long prefill strictly improves with mixed-batch
+    stepping on (budget == chunk, so both modes retire prefill tokens
+    at the same per-tick rate), with bit-identical greedy streams, and
+    the per-chunk prefill read-bytes model on the line (XLA sliced
+    copy pays a positive epilogue; the fused kernel reports 0)."""
+    parsed = decode_smoke_json
+    arm = parsed['arms']['prefill_interference']
+    assert parsed['prefill_mix_token_parity'] is True
+    assert arm['greedy_parity_mix_on_vs_off'] is True
+    assert arm['decode_tpot_ms_under_prefill_mix_on'] < \
+        arm['decode_tpot_ms_under_prefill_mix_off'], arm
+    assert parsed['prefill_mix_tpot_improvement'] > 1.0
+    assert arm['prefill_epilogue_bytes_per_chunk_fused'] == 0.0
+    assert arm['prefill_epilogue_bytes_per_chunk_xla'] > 0.0
+    assert arm['prefill_read_bytes_per_chunk_fused'] < \
+        arm['prefill_read_bytes_per_chunk_xla']
+    # The mixed engine actually mixed (tokens rode decode steps), and
+    # the unmixed engine's dedicated chunk ticks were observed by the
+    # skytpu_prefill_* series.
+    assert arm['mix_tokens_total'] > 0
+    assert arm['mixed_steps_total'] > 0
+    assert arm['observed_prefill_read_bytes_per_chunk'] > 0
+    assert arm['prefill_kernel']['mix_budget'] == \
+        arm['prefill_mix_budget'] > 0
+
+
+def test_backend_init_hang_transient_in_init_context():
+    """BENCH_r03–r05: the tunneled-TPU BackendInitHang is fatal for a
+    LIVE replica but transient for a bench bootstrap — the init
+    context flips its class so capture ladders retry it in a fresh
+    window instead of burning the whole attempt."""
+    from skypilot_tpu.infer import failures
+    from skypilot_tpu.parallel import mesh as mesh_lib
+
+    hang = mesh_lib.BackendInitHang('wedged tunnel')
+    assert failures.classify(hang) == failures.FATAL
+    assert failures.classify(hang, context='decode') == failures.FATAL
+    assert failures.classify(hang, context='init') == \
+        failures.TRANSIENT
+    # Everything else keeps its class in BOTH contexts.
+    assert failures.classify(RuntimeError('flake'),
+                             context='init') == failures.TRANSIENT
+    assert failures.classify(
+        failures.StepStallError('stall'),
+        context='init') == failures.FATAL
+    with pytest.raises(ValueError, match='context'):
+        failures.classify(RuntimeError('x'), context='serve')
+
+
+def test_run_direct_init_ladder_retries_transient_hang(bench,
+                                                       monkeypatch,
+                                                       capsys):
+    """run_direct's first backend touch rides a budget-aware
+    retry_with_backoff ladder: a BackendInitHang (transient in the
+    init context) gets fresh attempt windows in-process before the
+    whole --direct attempt is failed to the outer ladder."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+
+    attempts = {'n': 0}
+
+    def _flaky(*_a, **_kw):
+        attempts['n'] += 1
+        raise mesh_lib.BackendInitHang('tunnel wedged')
+
+    monkeypatch.setattr(mesh_lib, 'devices_with_retry', _flaky)
+    with pytest.raises(mesh_lib.BackendInitHang):
+        bench.run_direct(False, None)
+    assert attempts['n'] == 3            # ladder funded every window
+    err = capsys.readouterr().err
+    assert 'bench backend init attempt 1 failed' in err
+    assert 'giving up to the outer ladder' in err
+
+
+def test_run_direct_init_ladder_budget_aware_give_up(bench,
+                                                     monkeypatch):
+    """With no wall budget left, the init ladder gives up without
+    burning a single watchdog window."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+
+    attempts = {'n': 0}
+
+    def _flaky(*_a, **_kw):
+        attempts['n'] += 1
+        raise mesh_lib.BackendInitHang('tunnel wedged')
+
+    monkeypatch.setattr(mesh_lib, 'devices_with_retry', _flaky)
+    monkeypatch.setattr(bench, '_TOTAL_BUDGET_S', 0.0)
+    from skypilot_tpu.utils import retry as retry_lib
+    with pytest.raises(retry_lib.RetryError,
+                       match='budget exhausted'):
+        bench.run_direct(False, None)
+    assert attempts['n'] == 0
 
 
 def test_sleep_skip_when_spacing_would_burn_the_window(
