@@ -1173,6 +1173,170 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
           f'decode step', file=sys.stderr)
 
 
+def _serve_disagg_arm(smoke: bool, max_new: int, overrides: dict,
+                      ttft_slo_s: float, tpot_slo_s: float) -> dict:
+    """Disaggregation A/B at the same replica count: the same ragged
+    open-loop Poisson load served by (a) two ``--role both`` replicas
+    and (b) a prefill+decode pair with the page-id KV handoff between
+    them.  Decode-only replicas never absorb prefill bubbles, so the
+    disaggregated arm's decode-side p99 TPOT should improve while
+    TTFT holds; both verdicts are REPORTED, not asserted — tiny-model
+    CPU timings are too noisy to gate on.  Handoff bytes, latency,
+    and prefix-dedupe page counts are scraped from the replica
+    registries onto the JSON line."""
+    import numpy as np
+
+    from skypilot_tpu.benchmark import serving as serving_bench
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.observability import metrics as metrics_lib
+    from skypilot_tpu.serve import router as router_lib
+
+    n_requests = 10 if smoke else 48
+    rate_rps = 6.0 if smoke else 12.0
+    # Ragged prompt pool with recurrence: raggedness exercises the
+    # chunked prefill at mixed widths; recurring prompts give the
+    # decode side prefix pages to admit by id instead of by wire.
+    pool = ['disagg short request',
+            'disagg medium request ' + 'word ' * 6,
+            'disagg long request ' + 'token ' * 14,
+            'disagg extra long request ' + 'page ' * 12]
+    prompts = [pool[i % len(pool)] for i in range(n_requests)]
+
+    def _pct(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1, int(q * len(vals)))], 4)
+
+    def _arm(roles):
+        servers, regs = [], []
+        for role in roles:
+            reg = metrics_lib.Registry()
+            srv = server_lib.InferenceServer(
+                model='llama-tiny', port=0, host='127.0.0.1',
+                max_batch_size=4, model_overrides=dict(overrides),
+                allow_random_weights=True, page_size=8,
+                prefill_chunk=8, registry=reg, role=role)
+            srv.start()
+            threading.Thread(target=srv._server.serve_forever,  # pylint: disable=protected-access
+                             daemon=True).start()
+            servers.append(srv)
+            regs.append(reg)
+        rt = router_lib.Router(
+            [f'http://127.0.0.1:{s.port}' for s in servers],
+            health_interval_s=0.2, attempt_timeout_s=60.0,
+            registry=metrics_lib.Registry())
+        rt.start()
+        results: list = []
+        lock = threading.Lock()
+        try:
+            # Routable AND roles learned (prefill routing and the
+            # decode-target stamp both depend on the roles).
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                rt.health_tick()
+                views = rt.views()
+                if len(views) == len(roles) and \
+                        all(v.routable for v in views) and \
+                        {v.role for v in views} == set(roles):
+                    break
+                time.sleep(0.05)
+            serving_bench._one_sse_request(  # pylint: disable=protected-access
+                rt.url, 'disagg warmup ' + 'x' * 8, max_new)
+
+            def _fire(idx):
+                try:
+                    facts = serving_bench._one_sse_request(  # pylint: disable=protected-access
+                        rt.url, prompts[idx], max_new,
+                        request_id=f'bench-disagg-{idx}')
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        results.append({'ok': False,
+                                        'error': repr(e)})
+                    return
+                tpot = (sum(facts['gaps']) / len(facts['gaps'])
+                        if facts['gaps'] else 0.0)
+                with lock:
+                    results.append({'ok': True,
+                                    'ttft': facts['ttft'],
+                                    'tpot': tpot})
+
+            rng = np.random.default_rng(21)  # same arrivals per arm
+            arrivals = np.cumsum(
+                rng.exponential(1.0 / rate_rps, n_requests))
+            t0 = time.time()
+            threads = []
+            for i, at in enumerate(arrivals):
+                nap = at - (time.time() - t0)
+                if nap > 0:
+                    time.sleep(nap)
+                t = threading.Thread(target=_fire, args=(i,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=120)
+            handoff = {}
+            for reg in regs:
+                parsed = metrics_lib.parse_exposition(reg.expose())
+                for key, name, labels in (
+                        ('bytes_total', 'skytpu_handoff_bytes_sum', {}),
+                        ('artifacts',
+                         'skytpu_handoff_requests_total',
+                         {'side': 'admit'}),
+                        ('export_s_total',
+                         'skytpu_handoff_export_seconds_sum', {}),
+                        ('admit_s_total',
+                         'skytpu_handoff_admit_seconds_sum', {}),
+                        ('pages_shipped',
+                         'skytpu_handoff_pages_total',
+                         {'kind': 'shipped'}),
+                        ('pages_deduped',
+                         'skytpu_handoff_pages_total',
+                         {'kind': 'deduped'})):
+                    v = metrics_lib.sample_value(parsed, name,
+                                                 **labels)
+                    if v is not None:
+                        handoff[key] = round(
+                            handoff.get(key, 0.0) + v, 6)
+        finally:
+            rt.stop()
+            for srv in servers:
+                srv.shutdown()
+        ok = [r for r in results if r['ok']]
+        ttfts = [r['ttft'] for r in ok if r['ttft'] is not None]
+        tpots = [r['tpot'] for r in ok]
+        out = {
+            'roles': 'x'.join(roles),
+            'completed': len(ok),
+            'failed': len(results) - len(ok),
+            'p50_ttft_s': _pct(ttfts, 0.5),
+            'p99_ttft_s': _pct(ttfts, 0.99),
+            'p99_tpot_s': _pct(tpots, 0.99),
+        }
+        if handoff:
+            if handoff.get('artifacts'):
+                handoff['bytes_per_artifact'] = round(
+                    handoff.get('bytes_total', 0.0)
+                    / handoff['artifacts'], 1)
+            out['handoff'] = handoff
+        return out
+
+    both = _arm(('both', 'both'))
+    disagg = _arm(('prefill', 'decode'))
+    verdict = {}
+    if both['p99_tpot_s'] is not None and \
+            disagg['p99_tpot_s'] is not None:
+        verdict['tpot_p99_improved'] = \
+            disagg['p99_tpot_s'] < both['p99_tpot_s']
+    if both['p99_ttft_s'] is not None and \
+            disagg['p99_ttft_s'] is not None:
+        verdict['ttft_p99_regressed'] = \
+            disagg['p99_ttft_s'] > both['p99_ttft_s'] * 1.25
+    return {'n_requests': n_requests, 'rate_rps': rate_rps,
+            'both': both, 'disagg': disagg, **verdict}
+
+
 def run_serve(steps_arg, smoke: bool = False) -> None:
     """Open-loop Poisson serving bench through the self-healing router.
 
@@ -1364,6 +1528,11 @@ def run_serve(steps_arg, smoke: bool = False) -> None:
         for srv in replicas:
             srv.shutdown()
 
+    # Disaggregation A/B after the failover fleet is torn down (its
+    # jit caches stay warm in-process, so the arms compare fairly).
+    disagg_arm = _serve_disagg_arm(smoke, max_new, overrides,
+                                   ttft_slo_s, tpot_slo_s)
+
     ok = [r for r in results if r['ok']]
     good = [r for r in ok if r['ttft'] is not None
             and r['ttft'] <= ttft_slo_s and r['tpot'] <= tpot_slo_s]
@@ -1400,11 +1569,23 @@ def run_serve(steps_arg, smoke: bool = False) -> None:
         'rate_rps': rate_rps,
         'smoke': smoke,
         'fleet': fleet_obs,
+        'disaggregation': disagg_arm,
     }
     print(json.dumps(result))
     print(f'# serve: {len(good)}/{len(results)} requests in SLO '
           f'({len(results) - len(ok)} failed outright), '
           f'{failovers:.0f} failovers, {retry_total:.0f} retries',
+          file=sys.stderr)
+    da, db = disagg_arm['disagg'], disagg_arm['both']
+    ho = da.get('handoff', {})
+    print(f'# serve [disaggregation]: prefill+decode p99 TPOT '
+          f'{da["p99_tpot_s"]} s vs both-pool {db["p99_tpot_s"]} s '
+          f'(improved: {disagg_arm.get("tpot_p99_improved")}), p99 '
+          f'TTFT {da["p99_ttft_s"]} s vs {db["p99_ttft_s"]} s; '
+          f'{ho.get("artifacts", 0):.0f} handoffs, '
+          f'{ho.get("bytes_per_artifact", 0):.0f} B/artifact, pages '
+          f'{ho.get("pages_shipped", 0):.0f} shipped / '
+          f'{ho.get("pages_deduped", 0):.0f} deduped',
           file=sys.stderr)
 
 

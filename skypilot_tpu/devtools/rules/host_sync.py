@@ -38,6 +38,7 @@ def in_scope(posix: str) -> bool:
     return ('ops' in parts or 'models' in parts
             or posix.endswith('infer/engine.py')
             or posix.endswith('infer/speculative.py')
+            or posix.endswith('infer/handoff.py')
             or posix.endswith('train/trainer.py'))
 
 

@@ -32,7 +32,9 @@ from skypilot_tpu.devtools import skylint
 RULE_ID = 'lock-discipline'
 THREAD_RULE_ID = 'thread-discipline'
 
-_LOCK_FILES = ('infer/engine.py', 'infer/paging.py', 'infer/server.py')
+_LOCK_FILES = ('infer/engine.py', 'infer/paging.py', 'infer/server.py',
+               'infer/handoff.py', 'serve/router.py',
+               'serve/replica_supervisor.py')
 
 _MUTATORS = {'append', 'appendleft', 'extend', 'insert', 'add',
              'update', 'setdefault', 'pop', 'popleft', 'popitem',

@@ -769,6 +769,12 @@ class _Slot:
     # Self-drafting speculation only: the true prompt ids, kept so the
     # n-gram proposer can match against prompt + outputs.
     prompt_ids: Optional[List[int]] = None
+    # Handoff-admitted slots: the first `pre_emitted` committed tokens
+    # were already streamed to the client by the prefill-role replica
+    # (the seed token), so _commit_token appends them for eos/budget
+    # accounting but does NOT push them to the stream queue — the
+    # relayed stream stays byte-identical to a single-replica run.
+    pre_emitted: int = 0
 
 
 @dataclasses.dataclass
@@ -798,6 +804,10 @@ class _PendingPrefill:
     # later decode draws fold the same key.
     mixed: bool = False
     seed: int = 0
+    # Handoff admission (role='decode'): the "prefill" arrived as a
+    # wire artifact — cache1 was rebuilt from shipped tensors, done is
+    # already pad, and the slot must mark its seed token pre-emitted.
+    handoff: bool = False
 
 
 class _InflightStep:
@@ -1064,6 +1074,42 @@ class _ServingMetrics:
                 else 'violated').inc()
 
 
+def _handoff_metrics(registry: metrics_lib.Registry) -> Dict[str, Any]:
+    """Get-or-create handles for the disaggregated-serving series.
+    Registered only on engines with role != 'both' — a plain
+    replica's /metrics scrape must not advertise them (the exact-set
+    scrape test enforces this)."""
+    r = registry
+    return {
+        'export_seconds': r.histogram(
+            'skytpu_handoff_export_seconds',
+            'Prefill-role: seconds to turn one finished prefill into '
+            'the wire artifact (seed-token sample + device fetch + '
+            'encode + slot teardown).'),
+        'admit_seconds': r.histogram(
+            'skytpu_handoff_admit_seconds',
+            'Decode-role: seconds from artifact acceptance to live '
+            'decode slot (queue wait + dedupe + cache rebuild + '
+            'insert).'),
+        'bytes': r.histogram(
+            'skytpu_handoff_bytes',
+            'Serialized handoff artifact size on the wire.',
+            buckets=metrics_lib.DEFAULT_BYTE_BUCKETS),
+        'handoffs': r.counter(
+            'skytpu_handoff_requests_total',
+            "Handoff artifacts by side: side='export' = this prefill "
+            "replica serialized one, side='admit' = this decode "
+            'replica admitted one into a slot.',
+            labelnames=('side',)),
+        'pages': r.counter(
+            'skytpu_handoff_pages_total',
+            'Prompt pages of admitted handoffs: shipped (content '
+            'arrived over the wire) vs deduped (already held locally '
+            'via the chain-hash prefix map — admitted by page id, '
+            'not rewritten).', labelnames=('kind',)),
+    }
+
+
 def _publish_device_memory_peak(met: _ServingMetrics) -> None:
     """Set skytpu_device_memory_peak_bytes from the first local
     device's allocator stats.  Scrape-time only — memory_stats() is a
@@ -1156,11 +1202,16 @@ class ContinuousBatchingEngine:
                  async_pipeline: bool = True,
                  decode_kernel: str = 'auto',
                  prefill_kernel: str = 'auto',
-                 prefill_mix_budget: int = 0) -> None:
+                 prefill_mix_budget: int = 0,
+                 role: str = 'both') -> None:
         import collections
 
         if draft_model is not None and spec_k <= 0:
             raise ValueError('draft_model requires spec_k > 0')
+        if role not in ('both', 'prefill', 'decode'):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode', "
+                f'got {role!r}')
         if decode_kernel not in ('auto', 'fused', 'xla'):
             raise ValueError(
                 f"decode_kernel must be 'auto', 'fused' or 'xla', "
@@ -1174,6 +1225,12 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f'prefill_mix_budget must be >= 0, '
                 f'got {prefill_mix_budget}')
+        if role == 'prefill' and prefill_mix_budget > 0:
+            raise ValueError(
+                'role=prefill tears every slot down at prefill end, '
+                'so there are no decode steps for mixed-batch chunks '
+                'to ride; prefill_mix_budget requires role=both or '
+                'role=decode')
         # Model build, param load/sharding, and the [n_slots, ...]
         # cache scaffolding are identical to the request-level engine.
         self._eng = InferenceEngine(
@@ -1185,6 +1242,7 @@ class ContinuousBatchingEngine:
             page_size=page_size, max_pages=max_pages,
             seed=seed, registry=registry)
         self.model = self._eng.model
+        self._model_name = str(model)
         self.config = self._eng.config
         self.quantize = self._eng.quantize
         self.kv_cache_dtype = self._eng.kv_cache_dtype
@@ -1432,6 +1490,28 @@ class ContinuousBatchingEngine:
                              'kv_bucket'),
             donate_argnums=(1, 3))
 
+        def _seed_sample(last_row, seed_, temp, top_k, top_p,
+                         max_k: int, use_top_p: bool,
+                         top_p_in_topk: bool):
+            """First-token sample at prefill end, used by two
+            consumers that both need token 1 BEFORE any decode step:
+            spec mode (the verify step feeds a PENDING token, so it
+            is drawn from the prefill logits immediately) and
+            role='prefill' (the seed token streams to the client and
+            ships in the handoff artifact).  Same kernel + (seed, 0)
+            key fold as the fused decode step's generated=0 draw —
+            bit-identical numerics, and TTFT no longer waits for the
+            first decode tick."""
+            key = jax.random.fold_in(jax.random.PRNGKey(seed_), 0)
+            return sample_logits_rows(
+                last_row[None], key[None], temp[None], top_k[None],
+                top_p[None], max_k=max_k, use_top_p=use_top_p,
+                top_p_in_topk=top_p_in_topk)[0]
+
+        self._seed_sample = jax.jit(
+            _seed_sample,
+            static_argnames=('max_k', 'use_top_p', 'top_p_in_topk'))
+
         # -- speculative decoding (infer/speculative.py) --------------
         # spec_k > 0 swaps the one-token decode above for a verify
         # step: k proposed tokens + the pending token forward together
@@ -1461,32 +1541,13 @@ class ContinuousBatchingEngine:
                     kv_cache_dtype=kv_cache_dtype,
                     page_size=page_size, seed=seed)
 
-            def _seed_sample(last_row, seed_, temp, top_k, top_p,
-                             max_k: int, use_top_p: bool,
-                             top_p_in_topk: bool):
-                """First-token sample at prefill end: the verify step
-                needs a PENDING token to feed, so spec mode samples
-                token 1 from the prefill logits immediately (same
-                kernel + key fold as the fused decode step's
-                generated=0 draw — bit-identical numerics, and TTFT no
-                longer waits for the first decode tick)."""
-                key = jax.random.fold_in(jax.random.PRNGKey(seed_), 0)
-                return sample_logits_rows(
-                    last_row[None], key[None], temp[None], top_k[None],
-                    top_p[None], max_k=max_k, use_top_p=use_top_p,
-                    top_p_in_topk=top_p_in_topk)[0]
-
-            self._seed_sample = jax.jit(
-                _seed_sample,
-                static_argnames=('max_k', 'use_top_p', 'top_p_in_topk'))
-
             # Mixed-batch stepping composes with speculation through
             # the SAME verify graph: a prefill row rides the s = k+1
             # forward with its chunk tokens in the t_pend/drafts lanes
             # (active=False, n_prop=0 — acceptance ignores it),
             # mix_real[i] = chunk length drives its reveal window, and
             # a prompt-completing row's seeding draw happens in-graph
-            # (the same key fold and kernel as _seed_sample below, so
+            # (the same key fold and kernel as _seed_sample above, so
             # streams stay bit-identical to the unmixed engine).
             mix_on = prefill_mix_budget > 0
 
@@ -1573,6 +1634,22 @@ class ContinuousBatchingEngine:
         # chunks).  0 = whole-prompt prefill at admission.
         self.prefill_chunk = prefill_chunk
         self._prefills: List[_PendingPrefill] = []
+        # Disaggregated serving (--role): 'prefill' replicas run the
+        # prompt's chunked prefill then hand the request to a decode
+        # replica as a wire artifact (infer/handoff.py) instead of
+        # decoding; 'decode' replicas additionally admit those
+        # artifacts mid-stream.  'both' (the default) is the classic
+        # single-replica engine and changes nothing.
+        self.role = role
+        # rid -> serialized artifact parked by _handoff_export for the
+        # server thread to take (take_handoff) and relay to a decode
+        # replica.
+        self._handoffs: Dict[int, bytes] = {}
+        # (rid, meta, tensors, t_accept) artifacts accepted by
+        # admit_handoff; _schedule_front admits them into free slots
+        # AHEAD of the regular queue — their prefill cost was already
+        # spent on another replica.
+        self._handoff_queue: Any = collections.deque()
         # Decode-read bucket granularity (0 disables the read cap).
         self.kv_read_bucket = kv_read_bucket
         self._submit_lock = threading.Lock()
@@ -1623,6 +1700,11 @@ class ContinuousBatchingEngine:
             # plain replica's /metrics scrape must not advertise them.
             from skypilot_tpu.infer import speculative as spec_lib
             self._spec_met = spec_lib.spec_metrics(self.registry)
+        # Handoff series likewise register only on disaggregated
+        # replicas — a --role both scrape must not advertise them.
+        self._handoff_met = None
+        if role != 'both':
+            self._handoff_met = _handoff_metrics(self.registry)
         self.traces = _trace_store_from_env()
         self._cannibalized_seen = 0
         # Compile/retrace accounting: the jitted decode/prefill paths
@@ -1802,7 +1884,7 @@ class ContinuousBatchingEngine:
                 deadline = time.monotonic() + deadline_s
                 self._deadlines[rid] = deadline
             self._queue.append((rid, list(prompt_ids), cfg, deadline))
-            depth = len(self._queue)
+            depth = len(self._queue) + len(self._handoff_queue)
             # Trace begins inside the lock so the decode thread can
             # never admit this rid before its trace exists.
             trace = self.traces.begin(rid,
@@ -1819,11 +1901,15 @@ class ContinuousBatchingEngine:
         unread) and release its bookkeeping — abandoned requests must
         not leak results/events in a long-running replica."""
         with self._submit_lock:
-            before = len(self._queue)
+            before = len(self._queue) + len(self._handoff_queue)
             self._queue = type(self._queue)(
                 item for item in self._queue if item[0] != request_id)
-            removed_queued = len(self._queue) != before
-            depth = len(self._queue)
+            self._handoff_queue = type(self._handoff_queue)(
+                item for item in self._handoff_queue
+                if item[0] != request_id)
+            removed_queued = (len(self._queue)
+                              + len(self._handoff_queue)) != before
+            depth = len(self._queue) + len(self._handoff_queue)
             self._results.pop(request_id, None)
             self._events.pop(request_id, None)
             self._errors.pop(request_id, None)
@@ -1897,6 +1983,8 @@ class ContinuousBatchingEngine:
         with self._submit_lock:
             self._fatal = error
             self._queue.clear()
+            self._handoff_queue.clear()
+            self._handoffs.clear()
             events = list(self._events.values())
             queues = list(self._stream_queues.values())
         self._pipeline_abandon()
@@ -2294,8 +2382,15 @@ class ContinuousBatchingEngine:
             pad_len=pending.pad, max_new=cfg.max_new_tokens,
             eos_id=cfg.eos_id, temperature=cfg.temperature,
             top_k=cfg.top_k, top_p=cfg.top_p, seed=seed,
-            pages=pending.pages)
+            pages=pending.pages,
+            pre_emitted=1 if pending.handoff else 0)
         self.traces.event(pending.rid, 'prefill_done')
+        if self.role == 'prefill':
+            # Disaggregated prefill replica: sample + stream the seed
+            # token, serialize the slot into the wire artifact, tear
+            # the slot down.  This replica never decodes.
+            self._handoff_export(pending)
+            return
         if self.spec_k:
             self._spec_seed_slot(pending)
 
@@ -2329,6 +2424,415 @@ class ContinuousBatchingEngine:
         self._met.output_tokens.inc()
         self._commit_token(pending.slot_idx, tok)
 
+    def _handoff_export(self, pending: _PendingPrefill) -> None:
+        """role='prefill' epilogue, in place of keeping the slot:
+        sample the request's FIRST token from the prefill logits
+        (same (seed, 0) fold as the fused decode step, so the decode
+        replica's re-derived draw is bit-identical), stream it to the
+        local waiter, serialize the slot into the wire artifact
+        (infer/handoff.py), and tear the slot down.  The normal
+        insert/register_prefix above still ran, so the prompt's pages
+        stay in THIS replica's prefix cache for later prompts
+        (released pages are reclaimable, not erased).  A request that
+        finishes ON its seed token (eos, or max_new_tokens == 1)
+        completes here and nothing is exported.
+
+        Runs inside the _finish_prefill SharedStateError scope: the
+        teardown's block-table clear donates the shared cache, so a
+        mid-donation failure escalates to the supervisor's recover()
+        like any insert failure."""
+        from skypilot_tpu.infer import handoff as handoff_lib
+        slot_idx = pending.slot_idx
+        slot = self._slots[slot_idx]
+        cfg = pending.cfg
+        rid = pending.rid
+        t0 = time.perf_counter()
+        max_k = top_k_bucket(cfg.top_k, self.config.vocab_size)
+        use_top_p = cfg.top_p < 1.0
+        tok = int(jax.device_get(self._seed_sample(
+            pending.last_row, jnp.int32(slot.seed),
+            jnp.float32(cfg.temperature), jnp.int32(cfg.top_k),
+            jnp.float32(cfg.top_p), max_k=max_k, use_top_p=use_top_p,
+            top_p_in_topk=bool(use_top_p and max_k > 0))))
+        self._met.output_tokens.inc()
+        if self._commit_token(slot_idx, tok):
+            return
+        trace = self.traces.get(rid)
+        meta = {
+            'model': self._model_name,
+            'kv_cache_dtype': self.kv_cache_dtype,
+            'page_size': self.page_size,
+            'max_seq_len': self.max_seq_len,
+            'true_len': pending.true_len,
+            'pad': pending.pad,
+            'prompt_ids': pending.tokens[0, :pending.true_len].tolist(),
+            # The RESOLVED seed: the receiver cannot recompute the
+            # hash((seed0, rid)) default — rids differ across
+            # replicas.
+            'seed': slot.seed,
+            'seed_token': tok,
+            'sampling': {
+                'max_new_tokens': cfg.max_new_tokens,
+                'temperature': cfg.temperature,
+                'top_k': cfg.top_k,
+                'top_p': cfg.top_p,
+                'eos_id': cfg.eos_id,
+            },
+            'http_request_id': (trace.http_request_id
+                                if trace is not None else None),
+            'trace_parent': (trace.trace_parent
+                             if trace is not None else None),
+        }
+        # Ship the batch-1 prefill cache's [.., :true_len, ..] slice:
+        # cache1 holds the FULL prompt KV contiguously at prefill end
+        # (prefix hits were hydrated into it), the insert above did
+        # not donate it, and the seq axis is ndim-2 for every leaf
+        # kind (the int8 scale rows carry a trailing size-1 axis).
+        tensors: Dict[str, np.ndarray] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                pending.cache1)[0]:
+            names = _path_names(path)
+            if names[-1] not in handoff_lib.KV_LEAF_NAMES:
+                continue
+            arr = np.asarray(jax.device_get(leaf))
+            index = [slice(None)] * arr.ndim
+            index[arr.ndim - 2] = slice(0, pending.true_len)
+            tensors['/'.join(str(n) for n in names)] = \
+                arr[tuple(index)]
+        tensors[handoff_lib.LAST_ROW] = np.asarray(
+            jax.device_get(pending.last_row), np.float32)
+        blob = handoff_lib.serialize_artifact(meta, tensors)
+        n_pages = len(slot.pages)
+        self._release_slot_pages(slot.pages, slot_idx)
+        self._slots[slot_idx] = None
+        with self._submit_lock:
+            was_canceled = rid in self._canceled
+            if was_canceled:
+                self._canceled.discard(rid)
+                event = None
+                q = None
+            else:
+                self._results[rid] = slot.outputs
+                self._handoffs[rid] = blob
+                event = self._events.get(rid)
+                q = self._stream_queues.get(rid)
+            self._deadlines.pop(rid, None)
+        if q is not None:
+            q.put(self._STREAM_END)
+        if event is not None:
+            event.set()
+        dt = time.perf_counter() - t0
+        self.traces.event(rid, 'handoff_export', bytes=len(blob),
+                          pages=n_pages, seconds=dt)
+        trace = self.traces.finish(
+            rid, 'cancelled' if was_canceled else 'handed_off',
+            output_tokens=len(slot.outputs), decode_steps=slot.steps)
+        if was_canceled:
+            self._met.cancelled.inc()
+        else:
+            # TTFT is real on this side (the seed token streamed from
+            # here); the decode-side latencies live on the decode
+            # replica's trace, joined via http_request_id.
+            self._met.observe_finished(trace)
+            if self._handoff_met is not None:
+                self._handoff_met['handoffs'].labels(
+                    side='export').inc()
+                self._handoff_met['export_seconds'].observe(dt)
+                self._handoff_met['bytes'].observe(len(blob))
+        self._met.inflight.set(self.traces.inflight_count)
+
+    def take_handoff(self, request_id: int) -> Optional[bytes]:
+        """Pop and return `request_id`'s serialized handoff artifact
+        (None when the request completed locally — eos/budget on the
+        seed token, cancel, or a role != 'prefill' engine).  The
+        server calls this after the local stream ends to decide
+        whether to relay.  Thread-safe."""
+        with self._submit_lock:
+            return self._handoffs.pop(request_id, None)
+
+    def admit_handoff(self, blob: bytes,
+                      stream: bool = False,
+                      deadline_s: Optional[float] = None,
+                      http_request_id: Optional[str] = None,
+                      trace_parent: Optional[str] = None) -> int:
+        """Accept one wire artifact from a prefill-role replica and
+        enqueue it for mid-stream admission (ahead of the regular
+        queue — its prefill cost was already spent elsewhere).
+        Thread-safe like submit(); returns a request id for
+        wait()/stream().  Raises HandoffVersionError on a wire-format
+        mismatch and HandoffFormatError on anything malformed or
+        geometry-incompatible, both BEFORE any engine state is
+        created."""
+        import queue as queue_mod
+        import threading
+        from skypilot_tpu.infer import handoff as handoff_lib
+        if self.role == 'prefill':
+            raise handoff_lib.HandoffFormatError(
+                'prefill-role replicas do not ingest handoffs')
+        meta, tensors = handoff_lib.deserialize_artifact(blob)
+        self._validate_handoff(meta, tensors)
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            if deadline_s <= 0:
+                raise ValueError(
+                    f'deadline_s must be > 0, got {deadline_s}')
+        with self._submit_lock:
+            if self._fatal is not None:
+                raise RuntimeError(
+                    f'engine aborted: {self._fatal!r}') from self._fatal
+            rid = self._next_rid
+            self._next_rid += 1
+            self._events[rid] = threading.Event()
+            if stream:
+                self._stream_queues[rid] = queue_mod.Queue()
+            if deadline_s is not None:
+                self._deadlines[rid] = time.monotonic() + deadline_s
+            self._handoff_queue.append(
+                (rid, meta, tensors, time.perf_counter()))
+            depth = len(self._queue) + len(self._handoff_queue)
+            trace = self.traces.begin(
+                rid, prompt_tokens=int(meta['true_len']),
+                http_request_id=(http_request_id
+                                 or meta.get('http_request_id')))
+            trace.trace_parent = (trace_parent
+                                  or meta.get('trace_parent'))
+        self._met.submitted.inc()
+        self._met.queue_depth.set(depth)
+        self._met.inflight.set(self.traces.inflight_count)
+        return rid
+
+    def _validate_handoff(self, meta: Dict[str, Any],
+                          tensors: Dict[str, Any]) -> None:
+        """Reject an artifact this engine cannot admit — model/cache
+        geometry checks run against the engine's own abstract batch-1
+        cache, BEFORE any allocation."""
+        from skypilot_tpu.infer import handoff as handoff_lib
+
+        def _bad(msg: str):
+            return handoff_lib.HandoffFormatError(
+                f'handoff artifact incompatible: {msg}')
+
+        if meta['model'] != self._model_name:
+            raise _bad(f"model {meta['model']!r} != {self._model_name!r}")
+        if meta['kv_cache_dtype'] != self.kv_cache_dtype:
+            raise _bad(f"kv_cache_dtype {meta['kv_cache_dtype']!r} != "
+                       f'{self.kv_cache_dtype!r}')
+        if int(meta['page_size']) != self.page_size:
+            raise _bad(f"page_size {meta['page_size']} != "
+                       f'{self.page_size}')
+        if int(meta['max_seq_len']) != self.max_seq_len:
+            raise _bad(f"max_seq_len {meta['max_seq_len']} != "
+                       f'{self.max_seq_len}')
+        true_len = int(meta['true_len'])
+        pad = int(meta['pad'])
+        max_new = int(meta['sampling']['max_new_tokens'])
+        if not 1 <= true_len <= pad:
+            raise _bad(f'true_len {true_len} outside [1, pad={pad}]')
+        if max_new < 1 or pad + max_new > self.max_seq_len:
+            raise _bad(f'pad {pad} + max_new_tokens {max_new} exceeds '
+                       f'max_seq_len {self.max_seq_len}')
+        if len(meta['prompt_ids']) != true_len:
+            raise _bad(f"prompt_ids length {len(meta['prompt_ids'])} "
+                       f'!= true_len {true_len}')
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self._abstract_cache1)[0]:
+            names = _path_names(path)
+            if names[-1] not in handoff_lib.KV_LEAF_NAMES:
+                continue
+            key = '/'.join(str(n) for n in names)
+            src = tensors.get(key)
+            if src is None:
+                raise _bad(f'missing cache leaf {key!r}')
+            want = list(leaf.shape)
+            want[len(want) - 2] = true_len
+            if list(src.shape) != want:
+                raise _bad(f'leaf {key!r} shape {list(src.shape)} != '
+                           f'{want}')
+            if np.dtype(src.dtype) != np.dtype(leaf.dtype):
+                raise _bad(f'leaf {key!r} dtype {src.dtype} != '
+                           f'{np.dtype(leaf.dtype)}')
+        last = tensors.get(handoff_lib.LAST_ROW)
+        if last is None or last.shape != (self.config.vocab_size,):
+            raise _bad(
+                f'last_row missing or mis-shaped (want '
+                f'({self.config.vocab_size},))')
+
+    def _handoff_cache1(self, tensors: Dict[str, Any],
+                        true_len: int) -> Any:
+        """Rebuild a full-size batch-1 prefill cache from an
+        artifact's shipped [.., :true_len, ..] slices: zeros
+        everywhere, the shipped content at the origin of the seq axis
+        (ndim-2 for every KV leaf kind).  The padded tail stays zero —
+        those positions are masked forever on both sides, so the
+        reconstruction feeds the NORMAL insert path unchanged."""
+        from skypilot_tpu.infer import handoff as handoff_lib
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            self._fresh_cache1())
+        out = []
+        for path, leaf in flat:
+            names = _path_names(path)
+            if names[-1] in handoff_lib.KV_LEAF_NAMES:
+                key = '/'.join(str(n) for n in names)
+                src = jnp.asarray(np.ascontiguousarray(tensors[key]))
+                leaf = jax.lax.dynamic_update_slice(
+                    leaf, src.astype(leaf.dtype), (0,) * leaf.ndim)
+            elif names[-1] == 'cache_index':
+                # Cursor convention only — the slot-mode insert never
+                # reads it, but keep it honest for debugging.
+                leaf = jnp.full(leaf.shape, true_len, leaf.dtype)
+            out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _admit_from_handoffs(self, free: List[int]) -> None:
+        """Admit accepted handoff artifacts into free slots, AHEAD of
+        the regular queue: their prefill already ran on another
+        replica, so a free slot turns them into decode work
+        immediately.  Backpressure mirrors _admit — requeue at the
+        front and stop; decode keeps draining live slots whose
+        completion returns pages."""
+        now = time.monotonic()
+        while free:
+            with self._submit_lock:
+                item = None
+                if self._handoff_queue:
+                    item = self._handoff_queue.popleft()
+                    self._admitting_rid = item[0]
+            if item is None:
+                return
+            rid = item[0]
+            with self._submit_lock:
+                deadline = self._deadlines.get(rid)
+            if deadline is not None and now > deadline:
+                with self._submit_lock:
+                    self._admitting_rid = None
+                    self._canceled.discard(rid)
+                self._expire(rid)
+                continue
+            admitted = True
+            try:
+                admitted = self._admit_handoff(free[0], *item)
+            except failures.SharedStateError:
+                # Shared cache possibly invalidated mid-insert: NOT
+                # containable (the pending is parked in _prefills for
+                # recover() to find).
+                raise
+            except Exception as e:  # pylint: disable=broad-except
+                with self._submit_lock:
+                    self._canceled.discard(rid)
+                self._fail_request(rid, failures.wrap_abort(rid, e))
+                logger.warning(f'request {rid}: handoff admission '
+                               f'failed, aborted ({e!r})')
+                continue
+            finally:
+                with self._submit_lock:
+                    self._admitting_rid = None
+            if admitted:
+                free.pop(0)
+                continue
+            with self._submit_lock:
+                if rid in self._canceled:
+                    self._canceled.discard(rid)
+                    dropped_rid = rid
+                else:
+                    self._handoff_queue.appendleft(item)
+                    dropped_rid = None
+            if dropped_rid is not None:
+                if self.traces.finish(dropped_rid, 'cancelled'):
+                    self._met.cancelled.inc()
+                self._met.inflight.set(self.traces.inflight_count)
+            return
+
+    def _admit_handoff(self, slot_idx: int, rid: int,
+                       meta: Dict[str, Any], tensors: Dict[str, Any],
+                       t_accept: float) -> bool:
+        """Admit ONE deserialized artifact into slot `slot_idx`:
+        page-id dedupe through the chain-hash prefix map, fresh pages
+        for the rest, rebuild the batch-1 cache from the shipped
+        slice, then converge into the NORMAL _finish_prefill path —
+        the slot that comes out is indistinguishable from one this
+        engine prefilled itself (speculation seeding included).
+        Returns False on page backpressure without consuming
+        anything."""
+        from skypilot_tpu.infer import handoff as handoff_lib
+        sampling = meta['sampling']
+        cfg = SamplingConfig(
+            max_new_tokens=int(sampling['max_new_tokens']),
+            temperature=float(sampling['temperature']),
+            top_k=int(sampling['top_k']),
+            top_p=float(sampling['top_p']),
+            eos_id=(None if sampling['eos_id'] is None
+                    else int(sampling['eos_id'])),
+            seed=int(meta['seed']))
+        true_len = int(meta['true_len'])
+        pad = int(meta['pad'])
+        prompt = [int(t) for t in meta['prompt_ids']]
+        pages: List[int] = []
+        table_row = None
+        shared_len = 0
+        shipped = deduped = 0
+        if self.page_size:
+            ps = self.page_size
+            need = min(-(-(pad + cfg.max_new_tokens) // ps),
+                       self._pages_per_slot)
+            # Page-id dedupe: every page-aligned prompt page this
+            # replica already holds is admitted BY REFERENCE — the
+            # paged insert below redirects its columns to the null
+            # page instead of rewriting a refcounted page.  Capped
+            # one page short of the prompt's end, matching _admit.
+            shared = self._alloc.lookup_prefix(
+                prompt, max_pages=min((true_len - 1) // ps, need))
+            fresh = self._alloc.alloc(need - len(shared))
+            if fresh is None:
+                for page in shared:
+                    self._alloc.release(page)
+                self._met.backpressure.inc()
+                return False
+            self._met.prefix_hits.inc(len(shared))
+            self._met.prefix_misses.inc(len(fresh))
+            pages = list(shared) + fresh
+            shared_len = len(shared) * ps
+            table_row = np.zeros((self._pages_per_slot,), np.int32)
+            table_row[:len(pages)] = pages
+            shipped, deduped = handoff_lib.prompt_page_split(
+                prompt, len(shared), ps)
+        tokens = np.zeros((1, pad), np.int32)
+        tokens[0, :true_len] = prompt
+        mask_row = np.zeros((self.max_seq_len,), bool)
+        mask_row[:true_len] = True
+        try:
+            cache1 = self._handoff_cache1(tensors, true_len)
+            last_row = jnp.asarray(np.ascontiguousarray(
+                tensors[handoff_lib.LAST_ROW]))
+        except BaseException:
+            # Private-state failure: hand the pages back, let the
+            # caller contain it to this rid.
+            self._release_slot_pages(pages)
+            raise
+        pending = _PendingPrefill(
+            slot_idx=slot_idx, rid=rid, cfg=cfg, true_len=true_len,
+            pad=pad, tokens=tokens, mask_row=mask_row, cache1=cache1,
+            done=pad, last_row=last_row, pages=pages,
+            table_row=table_row, shared_len=shared_len, handoff=True)
+        self.traces.event(rid, 'admitted',
+                          shared_prefix_tokens=shared_len)
+        self.traces.event(rid, 'handoff_admitted',
+                          shipped_pages=shipped, deduped_pages=deduped)
+        # Park across the shared-cache insert (same protocol as
+        # _admit): a mid-donation failure escalates and recover()
+        # finds the pages here.
+        self._prefills.append(pending)
+        self._finish_prefill(pending)
+        self._prefills.pop()
+        if self._handoff_met is not None:
+            self._handoff_met['handoffs'].labels(side='admit').inc()
+            self._handoff_met['admit_seconds'].observe(
+                time.perf_counter() - t_accept)
+            self._handoff_met['pages'].labels(
+                kind='shipped').inc(shipped)
+            self._handoff_met['pages'].labels(
+                kind='deduped').inc(deduped)
+        return True
+
     def _commit_token(self, slot_idx: int, tok: int) -> bool:
         """Emit ONE token for the slot: append, stream, first-token
         trace event, eos/budget completion.  Returns True when the
@@ -2342,7 +2846,10 @@ class ContinuousBatchingEngine:
         if s.generated == 1:
             self.traces.event(s.request_id, 'first_token')
         q = self._stream_queues.get(s.request_id)
-        if q is not None:
+        if q is not None and s.generated > s.pre_emitted:
+            # Handoff-admitted slots re-derive the seed token the
+            # prefill replica already streamed (bit-identical draw):
+            # account for it above, but do not emit it twice.
             q.put(tok)
         if (s.eos_id is not None and tok == s.eos_id) or \
                 s.generated >= s.max_new:
@@ -2485,6 +2992,12 @@ class ContinuousBatchingEngine:
         reserved = {p.slot_idx for p in self._prefills}
         free = [i for i, s in enumerate(self._slots)
                 if s is None and i not in reserved]
+        # Handoff artifacts first: their prefill already ran on a
+        # prefill-role replica, so a free slot turns each into decode
+        # work immediately (len() on the deque is a GIL-atomic peek;
+        # the admission itself re-checks under the lock).
+        if self._handoff_queue:
+            self._admit_from_handoffs(free)
         now = time.monotonic()
         while free:
             with self._submit_lock:
@@ -2588,7 +3101,8 @@ class ContinuousBatchingEngine:
         """Keep the scheduler gauges honest while idle/prefilling."""
         self._met.live_slots.set(0)
         self._met.occupancy.set(0.0)
-        self._met.queue_depth.set(len(self._queue))
+        self._met.queue_depth.set(len(self._queue)
+                                  + len(self._handoff_queue))
         self._met.inflight.set(self.traces.inflight_count)
 
     def _step_sync(self) -> bool:
@@ -2601,7 +3115,8 @@ class ContinuousBatchingEngine:
         mixed = [p for p in self._prefills if p.mixed]
         if not occupied and not mixed:
             self._idle_gauges()
-            return bool(self._prefills) or bool(self._queue)
+            return bool(self._prefills) or bool(self._queue) \
+                or bool(self._handoff_queue)
         if self.spec_k:
             handle = self._dispatch_spec(occupied, mixed)
         elif mixed:
@@ -2653,7 +3168,8 @@ class ContinuousBatchingEngine:
             # work (commits, completions): report busy so callers
             # observe the synchronous contract — False only from a
             # tick that did nothing at all.
-            return consumed or bool(self._prefills) or bool(self._queue)
+            return consumed or bool(self._prefills) \
+                or bool(self._queue) or bool(self._handoff_queue)
         if self.spec_k:
             handle = self._dispatch_spec(occupied, mixed)
         elif mixed:
@@ -3344,12 +3860,13 @@ class ContinuousBatchingEngine:
     # -- admission outlook (shedding / drain signals) ---------------------
     @property
     def queue_depth(self) -> int:
-        return len(self._queue)
+        return len(self._queue) + len(self._handoff_queue)
 
     def is_idle(self) -> bool:
         """True when nothing is queued, prefilling, or slot-resident.
         Advisory (racy reads from other threads): drain polls it."""
-        return not self._queue and not self._prefills \
+        return not self._queue and not self._handoff_queue \
+            and not self._prefills \
             and all(s is None for s in self._slots)
 
     def estimate_queue_wait_s(self) -> float:
@@ -3428,7 +3945,13 @@ class ContinuousBatchingEngine:
             if not self.step():
                 break
             pending = {r for r in rids if not self._events[r].is_set()}
-        return [self.wait(r, timeout=0.001) for r in rids]
+        out = [self.wait(r, timeout=0.001) for r in rids]
+        # A role='prefill' engine parks a handoff artifact per request
+        # (nobody relays it on this synchronous path — e.g. the
+        # server's warmup generate): drain them so they cannot leak.
+        for r in rids:
+            self.take_handoff(r)
+        return out
 
 
 class InferenceEngine:

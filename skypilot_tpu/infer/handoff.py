@@ -1,0 +1,220 @@
+"""KV-handoff artifact: the wire format for disaggregated serving.
+
+A PREFILL-role replica runs a prompt's chunked prefill at full batch
+width, then hands the request to a DECODE-role replica as this
+artifact instead of keeping the slot: the prompt's KV prefix (int8
+pages ship with their sibling scale rows; bf16 ships as-is), the
+logits row at the prompt's last true token, and the complete sampler
+state — including the seed token already sampled from the prefill
+logits with the same (seed, 0) key fold the fused decode step would
+use, so the receiver's decode stream is bit-identical to a single
+`--role both` replica's.
+
+Page ids, not tensors, do the deduplication: the receiver looks the
+prompt up in its own chain-hash prefix map (`infer/paging.py`) and
+every page it already holds is admitted by reference — the paged
+insert redirects those columns to the reserved null page instead of
+rewriting a refcounted page.  Only the contiguous `[.., :true_len, ..]`
+slice of the batch-1 prefill cache crosses the wire; the padded tail
+is masked forever on both sides and never ships.
+
+Wire layout (versioned; `HandoffVersionError` on mismatch so a mixed
+fleet mid-rollout fails closed):
+
+    magic 'SKHO' | u16 version | u32 header_len | header JSON | tensors
+
+The header carries the model/cache geometry (checked by the receiver
+before any allocation), resolved sampling state, prompt token ids
+(the dedupe + prefix-registration key), and a tensor directory of
+``{name, dtype, shape, offset, nbytes}`` entries into the raw
+little-endian tensor payload that follows.
+
+ROADMAP item 2 (live KV migration, fleet-wide prefix cache) reuses
+this format verbatim — it is deliberately engine-agnostic: numpy +
+stdlib only (ml_dtypes supplies the bfloat16 wire dtype; it ships
+with jax), no jax import, so the router and tests can load it
+without touching a device runtime.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# 'SKHO' = SKytpu HandOff.  Bump VERSION on ANY layout or semantics
+# change — the receiver rejects other versions instead of guessing.
+MAGIC = b'SKHO'
+VERSION = 1
+
+# Router -> prefill-replica header naming the decode replica that the
+# rendezvous hash picked for this request; the prefill replica POSTs
+# the artifact there.  Lives here (not serve/ or server.py) so the
+# router can import it without dragging in a device runtime.
+DECODE_TARGET_HEADER = 'X-Skytpu-Decode-Target'
+
+_PREAMBLE = struct.Struct('>4sHI')
+
+# Batch-1 prefill-cache leaves that ship: K/V plus the sibling scale
+# rows of the int8 cache mode.  Names match models/llama.py's cache
+# collection; the cursor scalars never ship (the receiver rebuilds
+# them from true_len).
+KV_LEAF_NAMES = ('cached_key', 'cached_value',
+                 'cached_key_scale', 'cached_value_scale')
+
+# The logits row at the prompt's last true token: seeds the receiver's
+# first decode draw (or the verify step's re-derivation of it).
+LAST_ROW = 'last_row'
+
+_REQUIRED_META = ('model', 'kv_cache_dtype', 'page_size',
+                  'max_seq_len', 'true_len', 'pad', 'prompt_ids',
+                  'seed', 'seed_token', 'sampling')
+_REQUIRED_SAMPLING = ('max_new_tokens', 'temperature', 'top_k',
+                      'top_p', 'eos_id')
+
+
+class HandoffError(ValueError):
+    """Base class: anything wrong with a handoff artifact."""
+
+
+class HandoffFormatError(HandoffError):
+    """Malformed or geometry-incompatible artifact (HTTP 400/409)."""
+
+
+class HandoffVersionError(HandoffError):
+    """Artifact from a different wire-format version (HTTP 409)."""
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    """Wire dtype name -> numpy dtype; bfloat16 et al. resolve through
+    ml_dtypes (a jax dependency, so always importable next to an
+    engine; a stdlib-only consumer without it can still read int8/f32
+    artifacts)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError) as e:
+        raise HandoffFormatError(
+            f'unknown tensor dtype {name!r} in handoff artifact') from e
+
+
+def serialize_artifact(meta: Dict[str, Any],
+                       tensors: Dict[str, np.ndarray]) -> bytes:
+    """Render one handoff artifact.  `meta` must carry the
+    `_REQUIRED_META` fields; `tensors` maps leaf names (cache pytree
+    path joined with '/', plus 'last_row') to host arrays.  Iteration
+    order of `tensors` is the payload order."""
+    for key in _REQUIRED_META:
+        if key not in meta:
+            raise HandoffFormatError(
+                f'handoff meta missing required field {key!r}')
+    header = dict(meta)
+    directory: List[Dict[str, Any]] = []
+    chunks: List[bytes] = []
+    offset = 0
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        directory.append({
+            'name': name,
+            'dtype': np.dtype(arr.dtype).name,
+            'shape': list(arr.shape),
+            'offset': offset,
+            'nbytes': len(raw),
+        })
+        chunks.append(raw)
+        offset += len(raw)
+    header['tensors'] = directory
+    header_raw = json.dumps(header, separators=(',', ':')).encode()
+    return b''.join([_PREAMBLE.pack(MAGIC, VERSION, len(header_raw)),
+                     header_raw] + chunks)
+
+
+def deserialize_artifact(blob: bytes
+                         ) -> Tuple[Dict[str, Any],
+                                    Dict[str, np.ndarray]]:
+    """Parse one artifact -> (meta, {name: array}).  Arrays are
+    zero-copy views into `blob` (read-only); callers that mutate must
+    copy.  Raises HandoffVersionError on a version mismatch and
+    HandoffFormatError on anything malformed — both BEFORE any
+    allocation-sized work, so a hostile or stale artifact costs the
+    receiver one header parse."""
+    if len(blob) < _PREAMBLE.size:
+        raise HandoffFormatError('handoff artifact truncated (preamble)')
+    magic, version, header_len = _PREAMBLE.unpack_from(blob, 0)
+    if magic != MAGIC:
+        raise HandoffFormatError(
+            f'bad handoff magic {magic!r} (not a handoff artifact)')
+    if version != VERSION:
+        raise HandoffVersionError(
+            f'handoff artifact version {version} != supported '
+            f'{VERSION}; sender and receiver replicas must run the '
+            f'same wire format')
+    body = _PREAMBLE.size
+    if len(blob) < body + header_len:
+        raise HandoffFormatError('handoff artifact truncated (header)')
+    try:
+        meta = json.loads(blob[body:body + header_len])
+    except ValueError as e:
+        raise HandoffFormatError(
+            f'handoff header is not valid JSON: {e}') from e
+    if not isinstance(meta, dict):
+        raise HandoffFormatError('handoff header must be a JSON object')
+    for key in _REQUIRED_META:
+        if key not in meta:
+            raise HandoffFormatError(
+                f'handoff header missing required field {key!r}')
+    sampling = meta['sampling']
+    if not isinstance(sampling, dict):
+        raise HandoffFormatError('handoff sampling must be an object')
+    for key in _REQUIRED_SAMPLING:
+        if key not in sampling:
+            raise HandoffFormatError(
+                f'handoff sampling missing required field {key!r}')
+    directory = meta.get('tensors')
+    if not isinstance(directory, list):
+        raise HandoffFormatError('handoff header missing tensor '
+                                 'directory')
+    payload = body + header_len
+    tensors: Dict[str, np.ndarray] = {}
+    for entry in directory:
+        try:
+            name = entry['name']
+            dtype = _dtype_from_name(entry['dtype'])
+            shape = tuple(int(d) for d in entry['shape'])
+            offset = int(entry['offset'])
+            nbytes = int(entry['nbytes'])
+        except (TypeError, KeyError, ValueError) as e:
+            raise HandoffFormatError(
+                f'bad tensor directory entry {entry!r}') from e
+        expected = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        if nbytes != expected:
+            raise HandoffFormatError(
+                f'tensor {name!r}: nbytes {nbytes} != shape/dtype '
+                f'size {expected}')
+        start = payload + offset
+        if offset < 0 or start + nbytes > len(blob):
+            raise HandoffFormatError(
+                f'tensor {name!r} extends past the artifact payload')
+        tensors[name] = np.frombuffer(
+            blob, dtype=dtype, count=int(np.prod(shape, dtype=np.int64)),
+            offset=start).reshape(shape)
+    return meta, tensors
+
+
+def prompt_page_split(prompt_ids: Sequence[int], shared_pages: int,
+                      page_size: int) -> Tuple[int, int]:
+    """(shipped, deduped) prompt-page counts for the handoff metrics:
+    pages covering the true prompt that had to arrive over the wire vs
+    pages the receiver already held via its chain-hash prefix map.
+    Decode-headroom pages are excluded — nothing ships for them."""
+    if page_size <= 0:
+        return 0, 0
+    prompt_pages = -(-len(prompt_ids) // page_size)
+    deduped = min(max(int(shared_pages), 0), prompt_pages)
+    return prompt_pages - deduped, deduped

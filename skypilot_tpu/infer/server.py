@@ -68,13 +68,16 @@ import os
 import re
 import threading
 import time
+import urllib.error
 import urllib.parse
+import urllib.request
 import uuid
-from typing import Optional
+from typing import Iterator, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import failures
+from skypilot_tpu.infer import handoff as handoff_lib
 from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing as tracing_lib
@@ -93,7 +96,7 @@ _HTTPServer = http_utils.HighBacklogHTTPServer
 _GET_ROUTES = ('/health', '/v1/models', '/metrics', '/traces',
                '/events')
 _POST_ROUTES = ('/generate', '/v1/completions', '/v1/chat/completions',
-                '/drain')
+                '/drain', '/handoff')
 
 _REQUEST_ID_RE = re.compile(r'[A-Za-z0-9._:-]{1,64}$')
 
@@ -189,6 +192,8 @@ class InferenceServer:
                  decode_kernel: str = 'auto',
                  prefill_kernel: str = 'auto',
                  prefill_mix_budget: int = 0,
+                 role: str = 'both',
+                 decode_peers: Optional[str] = None,
                  ) -> None:
         from skypilot_tpu.parallel import mesh as mesh_lib
         # Hang-proof first backend touch: a wedged tunneled TPU makes
@@ -211,6 +216,18 @@ class InferenceServer:
                     kwargs[k] = int(v)
             mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(**kwargs))
         self.continuous = continuous
+        # Disaggregated serving: a prefill-role replica hands finished
+        # prefills to a decode-role replica as a KV artifact instead of
+        # decoding them itself (engine validates the role value).
+        self.role = role
+        self._decode_peers = [u.strip().rstrip('/')
+                              for u in (decode_peers or '').split(',')
+                              if u.strip()]
+        if role != 'both' and not continuous:
+            raise ValueError(
+                '--role prefill/decode requires continuous batching '
+                '(the handoff rides the slot engine); drop '
+                '--no-continuous.')
         if continuous:
             self.engine = engine_lib.ContinuousBatchingEngine(
                 model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
@@ -227,7 +244,8 @@ class InferenceServer:
                 async_pipeline=async_pipeline,
                 decode_kernel=decode_kernel,
                 prefill_kernel=prefill_kernel,
-                prefill_mix_budget=prefill_mix_budget)
+                prefill_mix_budget=prefill_mix_budget,
+                role=role)
         else:
             if decode_kernel != 'auto':
                 raise ValueError(
@@ -342,6 +360,12 @@ class InferenceServer:
         eng = self.engine
         detail = {
             'model': self.model_name,
+            # The router's role discovery: prefill-role replicas get
+            # client traffic + a decode target; decode-role replicas
+            # get /handoff traffic only.  Stub servers (observability
+            # tests bind health_detail to a bare namespace) predate
+            # roles and read as 'both'.
+            'role': getattr(self, 'role', 'both'),
             'n_slots': eng.n_slots,
             'page_size': eng.page_size,
             'queue_depth': eng.queue_depth,
@@ -592,7 +616,8 @@ class InferenceServer:
 
     def _handle_generate(self, payload: dict,
                          http_request_id: Optional[str] = None,
-                         trace_parent: Optional[str] = None) -> dict:
+                         trace_parent: Optional[str] = None,
+                         decode_target: Optional[str] = None) -> dict:
         deadline_s = self._deadline_from(payload)
         prompts = payload.get('prompt_ids')
         if not isinstance(prompts, list) or not prompts:
@@ -622,6 +647,11 @@ class InferenceServer:
                 # No explicit timeout: wait() derives it from the
                 # request's own deadline.
                 tokens = [self.engine.wait(r) for r in rids]
+                if self.role == 'prefill':
+                    tokens = [
+                        self._relay_blocking(r, t, decode_target,
+                                             http_request_id)
+                        for r, t in zip(rids, tokens)]
             except BaseException:
                 for r in rids:
                     self.engine.cancel(r)
@@ -633,6 +663,146 @@ class InferenceServer:
                 trace_parent=trace_parent)
         return {'tokens': tokens}
 
+    # -- disaggregated serving ----------------------------------------
+    def _handle_handoff(self, blob: bytes, handler) -> None:
+        """POST /handoff (decode-role side): admit a prefill replica's
+        KV artifact and stream the decoded tokens back as ndjson — one
+        ``{"token": t}`` line per committed token, then
+        ``{"done": true}``.  The body is the binary artifact and is
+        never JSON-parsed; geometry/version validation happens inside
+        admit_handoff BEFORE any engine state is touched, so a bad
+        artifact is a clean 400/409."""
+        hdr = handler.headers.get('X-Skytpu-Deadline-S')
+        try:
+            deadline_s = float(hdr) if hdr else self.default_deadline_s
+        except (TypeError, ValueError):
+            deadline_s = self.default_deadline_s
+        if deadline_s <= 0:
+            deadline_s = self.default_deadline_s
+        self._admission_check(deadline_s)
+        rid = self.engine.admit_handoff(
+            blob, stream=True, deadline_s=deadline_s,
+            http_request_id=handler.request_id,
+            trace_parent=handler.trace_parent)
+        self._work.set()
+        handler.send_response(200)
+        handler.send_header('Content-Type', 'application/x-ndjson')
+        handler.end_headers()
+
+        def _line(obj) -> None:
+            handler.wfile.write((json.dumps(obj) + '\n').encode())
+            handler.wfile.flush()
+
+        try:
+            for tok in self.engine.stream(
+                    rid, timeout=self.stream_token_timeout):
+                _line({'token': tok})
+            _line({'done': True})
+        except TimeoutError:
+            self.engine.cancel(rid)
+            try:
+                _line({'error': 'inter-token timeout: decode stalled'})
+            except OSError:
+                pass
+        except (BrokenPipeError, ConnectionError, OSError):
+            # The prefill relay went away mid-stream: release the slot
+            # so it stops decoding for nobody.
+            self.engine.cancel(rid)
+        finally:
+            # ndjson body is delimited by connection close (same
+            # framing as the SSE path — no Content-Length).
+            handler.close_connection = True
+
+    def _relay_handoff(self, blob: bytes,
+                       http_request_id: Optional[str],
+                       decode_target: Optional[str]
+                       ) -> Iterator[int]:
+        """Prefill-role side: ship the artifact to a decode replica and
+        yield the tokens it streams back.  The router's per-request
+        X-Skytpu-Decode-Target pick is tried first, then the static
+        --decode-peers list; a peer that refuses the CONNECTION (shed,
+        down) moves on to the next — the artifact is immutable bytes,
+        so resending is safe.  Once tokens flow, failures propagate:
+        replaying a partially-consumed stream would duplicate output."""
+        targets = []
+        if decode_target:
+            targets.append(decode_target.rstrip('/'))
+        targets.extend(t for t in self._decode_peers
+                       if t not in targets)
+        if not targets:
+            raise RuntimeError(
+                'prefill replica has no decode target: the router did '
+                'not stamp ' + handoff_lib.DECODE_TARGET_HEADER +
+                ' and --decode-peers is empty')
+        last: Optional[BaseException] = None
+        for target in targets:
+            req = urllib.request.Request(target + '/handoff',
+                                         data=blob, method='POST')
+            req.add_header('Content-Type', 'application/octet-stream')
+            if http_request_id:
+                req.add_header('X-Request-Id', http_request_id)
+            try:
+                resp = urllib.request.urlopen(
+                    req, timeout=self.stream_token_timeout)
+            except (urllib.error.URLError, OSError) as e:
+                logger.warning(
+                    f'decode target {target} refused a handoff '
+                    f'({e!r}); trying the next peer')
+                last = e
+                continue
+            try:
+                for raw in resp:
+                    msg = json.loads(raw)
+                    if 'token' in msg:
+                        yield msg['token']
+                    elif msg.get('done'):
+                        return
+                    else:
+                        raise RuntimeError(
+                            'decode replica failed mid-handoff: '
+                            f'{msg.get("error", msg)}')
+                raise RuntimeError('decode replica closed the handoff '
+                                   'stream before done')
+            finally:
+                resp.close()
+        raise RuntimeError(
+            f'no decode replica accepted the handoff (tried '
+            f'{len(targets)} target(s)); last error: {last!r}')
+
+    def _token_iter(self, rid: int,
+                    decode_target: Optional[str] = None,
+                    http_request_id: Optional[str] = None
+                    ) -> Iterator[int]:
+        """Unified per-token stream for one request: the local engine's
+        stream, then — iff this replica runs --role prefill and the
+        engine handed the request off — the decode replica's relayed
+        tail.  Callers cannot tell disaggregated serving from local
+        decode (the seed token comes from the local stream, the rest
+        from the wire)."""
+        for tok in self.engine.stream(
+                rid, timeout=self.stream_token_timeout):
+            yield tok
+        if self.role != 'prefill':
+            return
+        blob = self.engine.take_handoff(rid)
+        if blob is None:
+            return  # finished locally (eos / max_new on the seed token)
+        yield from self._relay_handoff(blob, http_request_id,
+                                       decode_target)
+
+    def _relay_blocking(self, rid: int, toks: list,
+                        decode_target: Optional[str],
+                        http_request_id: Optional[str]) -> list:
+        """Blocking-route tail of the handoff: append the decode
+        replica's tokens to the prefill replica's seed token."""
+        if self.role != 'prefill':
+            return toks
+        blob = self.engine.take_handoff(rid)
+        if blob is None:
+            return toks
+        return toks + list(self._relay_handoff(blob, http_request_id,
+                                               decode_target))
+
     # -- OpenAI-compatible surface ------------------------------------
     def _sampling_for(self, req) -> 'engine_lib.SamplingConfig':
         return engine_lib.SamplingConfig(
@@ -643,7 +813,8 @@ class InferenceServer:
     def _openai_blocking(self, req, prompt_ids,
                          http_request_id: Optional[str] = None,
                          deadline_s: Optional[float] = None,
-                         trace_parent: Optional[str] = None) -> dict:
+                         trace_parent: Optional[str] = None,
+                         decode_target: Optional[str] = None) -> dict:
         from skypilot_tpu.infer import openai_api
         sampling = self._sampling_for(req)
         if deadline_s is None:
@@ -655,6 +826,8 @@ class InferenceServer:
                                      trace_parent=trace_parent)
             self._work.set()
             toks = self.engine.wait(rid)
+            toks = self._relay_blocking(rid, toks, decode_target,
+                                        http_request_id)
         else:
             with self._lock:
                 toks = self.engine.generate(
@@ -723,8 +896,11 @@ class InferenceServer:
             started = True
             if req.chat:  # role announcement first
                 _sse(openai_api.stream_chunk(req, None, first=True))
-            for tok in self.engine.stream(
-                    rid, timeout=self.stream_token_timeout):
+            for tok in self._token_iter(
+                    rid,
+                    decode_target=getattr(handler, 'decode_target',
+                                          None),
+                    http_request_id=http_rid):
                 if chaos.should_inject('client_disconnect'):
                     raise BrokenPipeError(
                         'chaos: simulated client disconnect')
@@ -803,7 +979,8 @@ class InferenceServer:
         return self._openai_blocking(
             req, prompt_ids, getattr(handler, 'request_id', None),
             deadline_s,
-            trace_parent=getattr(handler, 'trace_parent', None))
+            trace_parent=getattr(handler, 'trace_parent', None),
+            decode_target=getattr(handler, 'decode_target', None))
 
     def serve_forever(self) -> None:
         self.start()
@@ -867,6 +1044,10 @@ class InferenceServer:
                     self.headers.get(tracing_lib.TRACE_HEADER))
                 if ctx is not None:
                     self.trace_parent = ctx[1]
+                # Router-picked decode replica for this request (only
+                # meaningful on a prefill-role replica).
+                self.decode_target = self.headers.get(
+                    handoff_lib.DECODE_TARGET_HEADER)
                 self._last_code = 0
                 route = self.path.split('?', 1)[0]
                 known = route in _GET_ROUTES or route in _POST_ROUTES
@@ -974,6 +1155,12 @@ class InferenceServer:
                     return
                 try:
                     length = int(self.headers.get('Content-Length', 0))
+                    if route == '/handoff':
+                        # Binary artifact body — MUST NOT hit the JSON
+                        # parse below.
+                        outer._handle_handoff(  # pylint: disable=protected-access
+                            self.rfile.read(length), self)
+                        return
                     payload = json.loads(self.rfile.read(length) or b'{}')
                     if route == '/drain':
                         self._reply(200, outer.begin_drain())
@@ -981,7 +1168,8 @@ class InferenceServer:
                     if route == '/generate':
                         self._reply(200, outer._handle_generate(  # pylint: disable=protected-access
                             payload, self.request_id,
-                            trace_parent=self.trace_parent))
+                            trace_parent=self.trace_parent,
+                            decode_target=self.decode_target))
                         return
                     body = outer._handle_openai(  # pylint: disable=protected-access
                         payload, chat=route.endswith(
@@ -994,6 +1182,15 @@ class InferenceServer:
                     self._reply(503, {'error': str(e),
                                       'reason': e.reason},
                                 retry_after=e.retry_after)
+                # Handoff errors subclass ValueError: these arms must
+                # precede the generic ValueError arm below.  409 for
+                # version skew (mixed fleet mid-rollout retries
+                # elsewhere), 400 for a malformed/incompatible
+                # artifact.
+                except handoff_lib.HandoffVersionError as e:
+                    self._reply(409, {'error': str(e)})
+                except handoff_lib.HandoffFormatError as e:
+                    self._reply(400, {'error': str(e)})
                 except openai_api.OpenAIError as e:
                     self._reply(e.status, e.body())
                 except TimeoutError as e:
@@ -1223,6 +1420,26 @@ def main() -> None:
                              'behavior). Composes with --spec-k, '
                              '--page-size, --mesh and the async '
                              'pipeline.')
+    parser.add_argument('--role', default='both',
+                        choices=['both', 'prefill', 'decode'],
+                        help='Disaggregated serving role. One binary, '
+                             "three modes: 'both' (default) serves "
+                             "prefill+decode as today; 'prefill' runs "
+                             'chunked prefill at full batch width, '
+                             'then hands each request to a decode '
+                             'replica as a KV page artifact (POST '
+                             "/handoff) and relays its tokens; "
+                             "'decode' accepts /handoff artifacts "
+                             'mid-stream (deduped against its prefix '
+                             'cache by page id) and decodes them. '
+                             'Greedy output across a handoff is '
+                             'bit-identical to --role both.')
+    parser.add_argument('--decode-peers', default=None,
+                        help='Comma-separated decode-replica base URLs '
+                             'a --role prefill replica may hand off '
+                             'to when the router did not stamp a '
+                             'per-request target (static fleets, '
+                             'tests).')
     parser.add_argument('--kv-read-bucket', type=int, default=512,
                         help='Decode attention reads only the live '
                              'cache prefix, rounded up to this bucket '
@@ -1271,6 +1488,8 @@ def main() -> None:
                     prefill_kernel=args.prefill_kernel,
                     prefill_mix_budget=args.prefill_mix_budget,
                     async_pipeline=args.async_pipeline,
+                    role=args.role,
+                    decode_peers=args.decode_peers,
                     ).serve_forever()
 
 

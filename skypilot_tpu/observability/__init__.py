@@ -63,6 +63,14 @@ METRIC_CONTRACT = frozenset({
     'skytpu_spec_proposed_tokens_total',
     'skytpu_spec_accepted_tokens_total',
     'skytpu_spec_accepted_tokens',
+    # infer/engine.py + infer/handoff.py — disaggregated prefill/decode
+    # (registered only on engines started with role != 'both'; a plain
+    # replica's scrape must not advertise them)
+    'skytpu_handoff_export_seconds',      # serialize KV -> wire artifact
+    'skytpu_handoff_admit_seconds',       # wire artifact -> live slot
+    'skytpu_handoff_bytes',               # artifact size on the wire
+    'skytpu_handoff_requests_total',      # labels: side=export|admit
+    'skytpu_handoff_pages_total',         # labels: kind=shipped|deduped
     'skytpu_request_queue_seconds',
     'skytpu_request_tpot_seconds',
     'skytpu_request_ttft_seconds',
@@ -115,6 +123,7 @@ METRIC_CONTRACT = frozenset({
     'skytpu_router_requests_total',
     'skytpu_router_retries_total',
     'skytpu_router_scale_events_total',
+    'skytpu_router_signal_age_seconds',   # labels: replica; scrape age
     # serve/router.py — fleet federation (GET /fleet/metrics scrape)
     'skytpu_fleet_replicas_routable',     # routable replicas at scrape time
     'skytpu_fleet_free_pages',            # sum of free KV pages fleet-wide

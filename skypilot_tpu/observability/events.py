@@ -36,6 +36,8 @@ EVENT_CONTRACT = frozenset({
     'prefill_chunk',
     'prefill_done',
     'first_token',
+    'handoff_export',         # prefill-role replica serialized the KV
+    'handoff_admitted',       # decode-role replica admitted mid-stream
     # -- router data plane (EventRing.record) -------------------------
     'breaker_transition',     # CircuitBreaker state change
     'replica_unhealthy',      # health probe flipped a replica down

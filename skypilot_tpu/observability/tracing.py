@@ -35,8 +35,11 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
-# Terminal states a trace can land in.
-TERMINAL_STATES = ('finished', 'cancelled', 'evicted', 'aborted')
+# Terminal states a trace can land in.  'handed_off' is terminal for
+# the PREFILL-role replica only: the request lives on, but on another
+# replica's timeline (joined via the shared http_request_id).
+TERMINAL_STATES = ('finished', 'cancelled', 'evicted', 'aborted',
+                   'handed_off')
 
 # Propagation header carrying `<trace_id>/<parent_span_id>` from the
 # router to the replica it tries.  The trace id is the external

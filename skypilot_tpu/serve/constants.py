@@ -76,6 +76,12 @@ ROUTER_AFFINITY_PAGE_SIZE = 16
 # A replica whose scraped decode queue depth reaches this is
 # "saturated": affinity stops pinning requests to it.
 ROUTER_SATURATION_QUEUE_DEPTH = 8.0
+# Scraped engine signals older than this many health-loop periods are
+# ignored (treated as neutral) by routing/saturation decisions: a
+# replica whose /metrics scrape keeps failing must not be routed on a
+# minutes-old queue depth.  Signals with no recorded scrape time (set
+# directly by tests or the supervisor) are trusted as fresh.
+ROUTER_SIGNAL_STALENESS_FACTOR = 2.0
 
 # -- Replica supervisor (serve/replica_supervisor.py) ----------------
 # Crash restarts: jittered exponential backoff between restarts of the

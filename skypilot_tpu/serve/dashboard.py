@@ -167,15 +167,29 @@ async function refreshFleet() {{
     const f = await r.json();
     const h = document.createElement('h3');
     h.textContent = 'Data-plane fleet · ' + f.router;
-    const rows = (f.replicas.replicas ?? []).map(rep => {{
+    const reps = f.replicas.replicas ?? [];
+    const rows = reps.map(rep => {{
       const tr = document.createElement('tr');
-      tr.append(cell(rep.url), cell(rep.health),
+      tr.append(cell(rep.url), cell(rep.role ?? 'both'),
+                cell(rep.health),
                 cell(rep.circuit), cell(rep.inflight),
                 cell(rep.queue_depth ?? '-'),
                 cell(rep.free_pages ?? '-'),
                 cell(rep.routable ? 'yes' : 'no'));
       return tr;
     }});
+    // Disaggregated-fleet pool aggregates: the prefill pool scales on
+    // queue depth, the decode pool on page starvation — surface both
+    // signals the way the autoscaler reads them.
+    const pools = document.createElement('div');
+    const inPool = (rep, roles) => roles.includes(rep.role ?? 'both');
+    const pre = reps.filter(r => inPool(r, ['prefill', 'both']));
+    const dec = reps.filter(r => inPool(r, ['decode', 'both']));
+    const sum = (rs, k) => rs.reduce((a, r) => a + (r[k] ?? 0), 0);
+    pools.textContent =
+      'Pools: prefill×' + pre.length +
+      ' (queue depth ' + sum(pre, 'queue_depth') + ') · decode×' +
+      dec.length + ' (free pages ' + sum(dec, 'free_pages') + ')';
     const slo = document.createElement('div');
     const slos = f.slo.slos ?? {{}};
     slo.textContent = 'SLO (target ' +
@@ -183,8 +197,8 @@ async function refreshFleet() {{
       Object.entries(slos).map(([k, v]) =>
         k + ' goodput ' + (v.goodput ?? 1).toFixed(4) +
         ' burn ' + (v.burn_rate ?? 0).toFixed(2)).join(' · ');
-    root.replaceChildren(h,
-      table(['URL', 'Health', 'Breaker', 'In-flight', 'Queue',
+    root.replaceChildren(h, pools,
+      table(['URL', 'Role', 'Health', 'Breaker', 'In-flight', 'Queue',
              'Free pages', 'Routable'], rows), slo);
   }} catch (e) {{ /* router restarting; retry next tick */ }}
 }}

@@ -90,6 +90,17 @@ class EngineSignalsAutoscaler:
     mean has stayed below ``queue_low`` for ``downscale_patience``
     evaluations.  Asymmetric patience: adding capacity late costs TTFT
     SLOs, removing it late costs only money.
+
+    ``signal`` picks what "pressure" means, so a disaggregated fleet
+    can scale its two pools on what each actually runs out of:
+
+    * ``'queue'`` (default) — prefill-shaped load: queue depth is what
+      predicts TTFT when admission is prefill-bound.
+    * ``'pages'`` — decode-shaped load: a decode-role replica stalls
+      on KV page starvation (handoffs waiting on free pages), not on
+      queue depth; pressure is any routable replica with zero free
+      pages and queued work, and scale-down additionally requires no
+      replica anywhere near starvation.
     """
 
     def __init__(self, min_replicas: int = 1,
@@ -99,17 +110,22 @@ class EngineSignalsAutoscaler:
                  upscale_patience: int =
                  constants.AUTOSCALE_UPSCALE_PATIENCE,
                  downscale_patience: int =
-                 constants.AUTOSCALE_DOWNSCALE_PATIENCE):
+                 constants.AUTOSCALE_DOWNSCALE_PATIENCE,
+                 signal: str = 'queue'):
         if min_replicas < 1:
             raise ValueError('min_replicas must be >= 1')
         if max_replicas is not None and max_replicas < min_replicas:
             raise ValueError('max_replicas must be >= min_replicas')
+        if signal not in ('queue', 'pages'):
+            raise ValueError(
+                f"signal must be 'queue' or 'pages', got {signal!r}")
         self.min_replicas = min_replicas
         self.max_replicas = max_replicas
         self.queue_high = queue_high
         self.queue_low = queue_low
         self.upscale_patience = upscale_patience
         self.downscale_patience = downscale_patience
+        self.signal = signal
         self._over = 0
         self._under = 0
 
@@ -125,10 +141,16 @@ class EngineSignalsAutoscaler:
         mean_depth = sum(v.queue_depth for v in routable) / len(routable)
         starved = any(v.free_pages == 0.0 and v.queue_depth > 0
                       for v in routable)
-        if mean_depth >= self.queue_high or starved:
+        if self.signal == 'pages':
+            high = starved
+            low = (not starved) and mean_depth <= self.queue_low
+        else:
+            high = mean_depth >= self.queue_high or starved
+            low = mean_depth <= self.queue_low
+        if high:
             self._over += 1
             self._under = 0
-        elif mean_depth <= self.queue_low:
+        elif low:
             self._under += 1
             self._over = 0
         else:
@@ -148,8 +170,9 @@ class EngineSignalsAutoscaler:
 
 class _Slot:
 
-    def __init__(self, slot_id: int):
+    def __init__(self, slot_id: int, role: str = 'both'):
         self.slot_id = slot_id
+        self.role = role             # both | prefill | decode
         self.state = BACKOFF         # spawn happens on the next tick
         self.handle = None
         self.url: Optional[str] = None
@@ -158,7 +181,8 @@ class _Slot:
         self.drain_deadline = 0.0
 
     def __repr__(self):
-        return (f'_Slot({self.slot_id}, {self.state}, url={self.url}, '
+        return (f'_Slot({self.slot_id}, {self.state}, '
+                f'role={self.role}, url={self.url}, '
                 f'restarts={len(self.restart_times)})')
 
 
@@ -184,9 +208,33 @@ class ReplicaSupervisor:
                  drain_timeout_s: float =
                  constants.SUPERVISOR_DRAIN_TIMEOUT_SECONDS,
                  registry: Optional[metrics_lib.Registry] = None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 pools: Optional[Dict[str, dict]] = None):
         self._factory = factory
         self.router = router
+        # Disaggregated fleets: ``pools`` maps a replica role
+        # ('prefill' / 'decode' / 'both') to a per-pool config dict
+        # ({'min_replicas': N, 'max_replicas': M, 'autoscaler': ...}).
+        # Each pool scales independently on its own signal (prefill on
+        # queue depth, decode on page starvation), victims are picked
+        # inside the shrinking pool only, and a crashed slot respawns
+        # with its own role.  The factory is then called as
+        # factory(slot_id, role).  Without ``pools`` everything
+        # behaves exactly as before (single homogeneous pool,
+        # factory(slot_id)).
+        self._pools = dict(pools) if pools else None
+        if self._pools:
+            for role, cfg in self._pools.items():
+                if role not in ('both', 'prefill', 'decode'):
+                    raise ValueError(f'unknown pool role {role!r}')
+                if not isinstance(cfg, dict):
+                    raise ValueError(
+                        f'pool {role!r} config must be a dict')
+            min_replicas = sum(
+                int(cfg.get('min_replicas', 1))
+                for cfg in self._pools.values())
+            max_replicas = None
+            autoscaler = None
         self.min_replicas = min_replicas
         self.max_replicas = max_replicas
         self.autoscaler = autoscaler
@@ -205,13 +253,18 @@ class ReplicaSupervisor:
         self._met['desired'].set(self.desired)
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        for _ in range(min_replicas):
-            self._new_slot()
+        if self._pools:
+            for role, cfg in self._pools.items():
+                for _ in range(int(cfg.get('min_replicas', 1))):
+                    self._new_slot(role)
+        else:
+            for _ in range(min_replicas):
+                self._new_slot()
 
     # -- slot bookkeeping ---------------------------------------------
-    def _new_slot(self) -> _Slot:
+    def _new_slot(self, role: str = 'both') -> _Slot:
         with self._lock:
-            slot = _Slot(self._next_slot_id)
+            slot = _Slot(self._next_slot_id, role=role)
             self._next_slot_id += 1
             self._slots[slot.slot_id] = slot
         return slot
@@ -322,7 +375,11 @@ class ReplicaSupervisor:
             if slot.state != BACKOFF or now < slot.next_start_at:
                 continue
             try:
-                handle, url = self._factory(slot.slot_id)
+                if self._pools:
+                    handle, url = self._factory(slot.slot_id,
+                                                slot.role)
+                else:
+                    handle, url = self._factory(slot.slot_id)
             except Exception:  # pylint: disable=broad-except
                 logger.exception(
                     f'spawn failed for replica slot {slot.slot_id}; '
@@ -379,6 +436,9 @@ class ReplicaSupervisor:
                     f'replica slot {slot.slot_id} drained and stopped')
 
     def _autoscale(self) -> None:
+        if self._pools:
+            self._autoscale_pools()
+            return
         active = self._active()
         if self.autoscaler is not None:
             self.desired = self.autoscaler.desired(
@@ -412,6 +472,56 @@ class ReplicaSupervisor:
                     f'scaling down: draining replica slot '
                     f'{slot.slot_id} ({slot.url})')
                 self._begin_drain(slot)
+
+    def _autoscale_pools(self) -> None:
+        """Per-pool autoscaling for disaggregated fleets: each pool
+        sees only its own replicas' views (role learned by the router
+        from /health?verbose=1 — undiscovered replicas still read as
+        'both' and scale with that pool), scales on its own signal,
+        and shrinks by draining its own newest slots only."""
+        views = self.router.views()
+        total = 0
+        for role, cfg in sorted(self._pools.items()):
+            active = [s for s in self._active() if s.role == role]
+            pool_min = int(cfg.get('min_replicas', 1))
+            scaler = cfg.get('autoscaler')
+            if scaler is not None:
+                pool_views = [v for v in views if v.role == role]
+                want = scaler.desired(pool_views, len(active))
+            else:
+                want = max(pool_min, len(active))
+            pool_max = cfg.get('max_replicas')
+            if pool_max is not None:
+                want = min(want, int(pool_max))
+            want = max(want, pool_min)
+            total += want
+            if len(active) < want:
+                for _ in range(want - len(active)):
+                    self._new_slot(role)
+                self._met['scale_events'].labels(direction='up').inc()
+                self.router.events.record(
+                    'scale_up', pool=role, desired=want,
+                    was=len(active))
+                logger.info(
+                    f'scaling {role} pool up to {want} replica(s)')
+            elif len(active) > want:
+                victims = sorted(
+                    (s for s in active if s.state == LIVE),
+                    key=lambda s: -s.slot_id)[:len(active) - want]
+                if victims:
+                    self._met['scale_events'].labels(
+                        direction='down').inc()
+                    self.router.events.record(
+                        'scale_down', pool=role, desired=want,
+                        was=len(active),
+                        victims=[s.slot_id for s in victims])
+                for slot in victims:
+                    logger.info(
+                        f'scaling {role} pool down: draining replica '
+                        f'slot {slot.slot_id} ({slot.url})')
+                    self._begin_drain(slot)
+        self.desired = total
+        self._met['desired'].set(self.desired)
 
 
 def subprocess_replica_factory(argv_template: List[str],

@@ -54,6 +54,7 @@ import uuid
 from typing import Dict, List, Optional, Set
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.infer import handoff as handoff_lib
 from skypilot_tpu.infer import paging
 from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.observability import metrics as metrics_lib
@@ -120,6 +121,13 @@ def _router_metrics(registry: Optional[metrics_lib.Registry] = None):
             'skytpu_router_circuit_transitions_total',
             'Circuit-breaker state transitions, by new state.',
             labelnames=('state',)),
+        'signal_age': r.gauge(
+            'skytpu_router_signal_age_seconds',
+            'Seconds since each replica\'s engine signals (queue '
+            'depth, free pages) were last scraped successfully; '
+            'signals older than ROUTER_SIGNAL_STALENESS_FACTOR '
+            'health-loop periods are ignored by routing.',
+            labelnames=('replica',)),
         # Fleet federation (GET /fleet/metrics + /fleet/slo).
         'fleet_routable': r.gauge(
             'skytpu_fleet_replicas_routable',
@@ -256,19 +264,33 @@ class ReplicaView:
         self.free_pages: Optional[float] = None  # skytpu_kv_free_pages
         self.ttft_p99_s: Optional[float] = None  # from TTFT histogram
         self.page_size: Optional[int] = None     # from /health?verbose=1
+        self.role = 'both'         # both | prefill | decode (verbose /health)
+        # monotonic ts of the last SUCCESSFUL /metrics scrape; None
+        # means "never stamped" and is trusted as fresh (tests and the
+        # supervisor set signal fields directly).
+        self.signals_at: Optional[float] = None
         self.consecutive_probe_failures = 0
 
     @property
     def routable(self) -> bool:
         return self.health == 'ok' and self.breaker.allows_requests
 
+    def signal_age_s(self) -> Optional[float]:
+        if self.signals_at is None:
+            return None
+        return time.monotonic() - self.signals_at
+
     def snapshot(self) -> Dict[str, object]:
+        age = self.signal_age_s()
         return {'url': self.url, 'health': self.health,
                 'circuit': self.breaker.state,
+                'role': self.role,
                 'inflight': self.inflight,
                 'queue_depth': self.queue_depth,
                 'free_pages': self.free_pages,
                 'ttft_p99_s': self.ttft_p99_s,
+                'signal_age_s': (round(age, 3)
+                                 if age is not None else None),
                 'routable': self.routable}
 
 
@@ -502,6 +524,9 @@ class Router:
             parsed, 'skytpu_kv_free_pages')
         view.ttft_p99_s = metrics_lib.histogram_quantile(
             parsed, 'skytpu_request_ttft_seconds', 0.99)
+        # Stamp the scrape time: routing trusts these signals only
+        # while they are younger than the staleness window.
+        view.signals_at = time.monotonic()
 
     def _fetch_page_size(self, view: ReplicaView) -> None:
         if view.page_size is not None:
@@ -516,6 +541,12 @@ class Router:
                 ConnectionError, TimeoutError, OSError,
                 http.client.HTTPException, ValueError):
             return
+        # Role discovery rides the same verbose probe: a prefill-role
+        # replica gets client traffic plus a decode target; a
+        # decode-role replica gets /handoff traffic only.
+        role = body.get('role') if isinstance(body, dict) else None
+        if role in ('both', 'prefill', 'decode'):
+            view.role = role
         ps = body.get('page_size') if isinstance(body, dict) else None
         if isinstance(ps, int) and ps > 0:
             view.page_size = ps
@@ -550,6 +581,10 @@ class Router:
                         prev not in ('unhealthy', 'unreachable'):
                     self.events.record('replica_unhealthy',
                                        url=view.url, status=status)
+            age = view.signal_age_s()
+            if age is not None:
+                self._met['signal_age'].labels(
+                    replica=view.url).set(age)
         self._publish_replica_gauges()
 
     def _health_loop(self) -> None:
@@ -594,9 +629,10 @@ class Router:
             fleet_queue += metrics_lib.sample_value(
                 parsed, 'skytpu_decode_queue_depth') or 0.0
             esc = metrics_lib._escape_label_value(view.url)
+            role = metrics_lib._escape_label_value(view.role)
             for name in sorted(parsed):
                 for labels, value in sorted(parsed[name].items()):
-                    pairs = [f'replica="{esc}"'] + [
+                    pairs = [f'replica="{esc}"', f'role="{role}"'] + [
                         f'{k}="{metrics_lib._escape_label_value(v)}"'
                         for k, v in labels]
                     lines.append(
@@ -672,20 +708,38 @@ class Router:
         return out
 
     # -- selection ----------------------------------------------------
+    def _signals(self, view: ReplicaView):
+        """(queue_depth, free_pages) with staleness applied: signals
+        scraped more than ROUTER_SIGNAL_STALENESS_FACTOR health-loop
+        periods ago are replaced by neutral values — routing on a
+        minutes-old queue depth is worse than routing blind.  An
+        unstamped view (signals set directly, never scraped) is
+        trusted as-is."""
+        age = view.signal_age_s()
+        if age is not None and age > (
+                constants.ROUTER_SIGNAL_STALENESS_FACTOR
+                * self.health_interval_s):
+            return 0.0, None
+        return view.queue_depth, view.free_pages
+
     def _saturated(self, view: ReplicaView) -> bool:
-        if view.queue_depth >= self.saturation_queue_depth:
+        queue_depth, free_pages = self._signals(view)
+        if queue_depth >= self.saturation_queue_depth:
             return True
-        return view.free_pages == 0.0 and view.queue_depth > 0
+        return free_pages == 0.0 and queue_depth > 0
 
     def select_replica(self, key: Optional[int],
                        exclude: Optional[Set[str]] = None
                        ) -> Optional[ReplicaView]:
         """Affine replica by rendezvous hash when it is routable and
-        unsaturated; least-loaded routable otherwise."""
+        unsaturated; least-loaded routable otherwise.  Decode-role
+        replicas never take client traffic — they are reached through
+        the handoff path only (_select_decode_target)."""
         exclude = exclude or set()
         with self._lock:
             candidates = [v for v in self._replicas.values()
-                          if v.routable and v.url not in exclude]
+                          if v.routable and v.url not in exclude
+                          and v.role in ('both', 'prefill')]
         if not candidates:
             return None
         if key is not None:
@@ -698,7 +752,33 @@ class Router:
         else:
             self._met['affinity'].labels(result='none').inc()
         return min(candidates,
-                   key=lambda v: (v.inflight + v.queue_depth, v.url))
+                   key=lambda v: (v.inflight + self._signals(v)[0],
+                                  v.url))
+
+    def _select_decode_target(self, key: Optional[int]
+                              ) -> Optional[ReplicaView]:
+        """The decode replica a prefill-role replica should hand off
+        to: rendezvous over decode-capable replicas with the SAME
+        affinity key client routing uses, so repeated prompts land
+        their handoffs where the prefix pages already live (the
+        page-id dedupe then ships only the tail).  Pure decode
+        replicas are preferred over --role both ones; least-loaded is
+        the saturation fallback."""
+        with self._lock:
+            candidates = [v for v in self._replicas.values()
+                          if v.routable
+                          and v.role in ('both', 'decode')]
+        pool = [v for v in candidates if v.role == 'decode'] \
+            or candidates
+        if not pool:
+            return None
+        if key is not None:
+            affine = max(pool, key=lambda v: hash((key, v.url)))
+            if not self._saturated(affine):
+                return affine
+        return min(pool,
+                   key=lambda v: (v.inflight + self._signals(v)[0],
+                                  v.url))
 
     # -- lifecycle ----------------------------------------------------
     @property
@@ -881,7 +961,7 @@ class Router:
                                 route=route, affinity_key=key is not None)
         state = {'client_started': False, 'attempts': 0,
                  'first_url': None, 'served_url': None,
-                 'retry_after': None, 'root': root}
+                 'retry_after': None, 'root': root, 'key': key}
         tried: Set[str] = set()
         t0 = time.perf_counter()
 
@@ -964,6 +1044,16 @@ class Router:
         headers[tracing_lib.TRACE_HEADER] = \
             tracing_lib.format_trace_context(root.trace_id,
                                              span.span_id)
+        # Disaggregated serving: a prefill-role replica needs to know
+        # where to ship the KV artifact.  The same affinity key drives
+        # the pick so a repeated prompt's handoff lands on the decode
+        # replica already holding its prefix pages.  Overwritten (or
+        # cleared) per attempt in the shared headers dict.
+        headers.pop(handoff_lib.DECODE_TARGET_HEADER, None)
+        if view.role == 'prefill':
+            target = self._select_decode_target(state.get('key'))
+            if target is not None:
+                headers[handoff_lib.DECODE_TARGET_HEADER] = target.url
         outcome = 'unknown'
         with self._lock:
             view.inflight += 1

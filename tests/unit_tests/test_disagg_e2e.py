@@ -1,0 +1,439 @@
+"""Disaggregated prefill/decode serving end-to-end: a prefill-role
+replica and a decode-role replica behind the router must be
+indistinguishable — byte-for-byte on the greedy token stream — from a
+single ``--role both`` replica, across model families, KV-cache modes,
+and both speculation flavors.
+
+The fleet is real: in-process ``InferenceServer`` replicas (one
+started with ``role='prefill'``, one with ``role='decode'``) behind a
+hand-ticked ``Router`` that learns the roles from /health?verbose=1
+and stamps the decode target header on every request it forwards to
+the prefill replica.  The prefill replica runs the chunked prefill,
+samples the seed token, ships the KV artifact to the decode replica
+over POST /handoff, and relays the decode replica's token stream back
+— the client sees one ordinary response.
+
+Also here: supervisor pool mechanics (per-role spawn/respawn, pools
+scaling independently on their own signals, per-pool drain victims)
+over stub process handles, and the HTTP rejection arms for hostile or
+version-skewed artifacts.
+
+Tier-1/CPU by design: everything in this file runs under
+`JAX_PLATFORMS=cpu -m 'not slow'` (TestTier1Guard enforces it for
+every test surface this PR added).
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.infer import handoff as handoff_lib
+from skypilot_tpu.infer.server import InferenceServer
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.serve import replica_supervisor as sup_lib
+from skypilot_tpu.serve.router import Router
+
+_COMMON = {'max_seq_len': 64, 'n_layers': 2,
+           'dtype': jnp.float32, 'param_dtype': jnp.float32}
+_FAMILIES = {
+    # GQA 4:2 + rope.
+    'llama-tiny': {**_COMMON, 'n_heads': 4, 'n_kv_heads': 2,
+                   'dim': 64, 'ffn_dim': 128, 'vocab_size': 96},
+    # MHA + learned positions (no rope): the handoff's cache-cursor
+    # contract must hold without rope interpolation too.
+    'gpt2-tiny': {**_COMMON, 'n_heads': 4, 'dim': 64,
+                  'ffn_dim': 128, 'vocab_size': 96},
+}
+_PS = 8
+# Repetitive prompts so n-gram self-drafting actually proposes.
+_PROMPTS = [[5, 17, 3, 42, 5, 17, 3, 9, 5, 17, 3], [9, 1, 4, 9, 1, 4]]
+_MAX_NEW = 8
+
+# families x cache modes x speculation: each mode builds a reference
+# `--role both` server plus a prefill+decode fleet from the same kwargs.
+_MODES = {
+    'llama-paged': dict(model='llama-tiny', page_size=_PS,
+                        prefill_chunk=_PS),
+    'llama-paged-int8-ngram': dict(model='llama-tiny', page_size=_PS,
+                                   kv_cache_dtype='int8', spec_k=4),
+    'gpt2-contig-draft': dict(model='gpt2-tiny', spec_k=4,
+                              draft_model='gpt2-tiny'),
+}
+
+
+def _server(model, role='both', **kw):
+    reg = metrics_lib.Registry()  # one registry per replica
+    overrides = dict(_FAMILIES[model])
+    if kw.get('draft_model'):
+        kw.setdefault('draft_overrides', dict(overrides))
+    srv = InferenceServer(model=model, port=0, host='127.0.0.1',
+                          max_batch_size=2,
+                          model_overrides=overrides,
+                          allow_random_weights=True, registry=reg,
+                          role=role, **kw)
+    srv.start()
+    threading.Thread(
+        target=lambda s=srv._server: s.serve_forever(poll_interval=0.05),
+        daemon=True).start()
+    return srv, reg
+
+
+@pytest.fixture(scope='module', params=sorted(_MODES))
+def fleet(request):
+    kw = dict(_MODES[request.param])
+    model = kw.pop('model')
+    ref, ref_reg = _server(model, **kw)
+    pre, pre_reg = _server(model, role='prefill', **kw)
+    dec, dec_reg = _server(model, role='decode', **kw)
+    registry = metrics_lib.Registry()
+    router = Router(
+        replicas=[f'http://127.0.0.1:{pre.port}',
+                  f'http://127.0.0.1:{dec.port}'],
+        registry=registry, health_interval_s=3600.0,  # hand-ticked
+        health_timeout_s=5.0, attempt_timeout_s=60.0,
+        request_budget_s=60.0)
+    router.start()
+    # Settle: both replicas routable AND the router has learned both
+    # roles from /health?verbose=1 (routing depends on them).
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        router.health_tick()
+        views = router.views()
+        if (len(views) == 2 and all(v.routable for v in views)
+                and {v.role for v in views} == {'prefill', 'decode'}):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(
+            f'fleet never settled: '
+            f'{[v.snapshot() for v in router.views()]}')
+    fl = SimpleNamespace(mode=request.param, kw=kw, router=router,
+                         ref=ref, pre=pre, dec=dec, ref_reg=ref_reg,
+                         pre_reg=pre_reg, dec_reg=dec_reg)
+    yield fl
+    router.stop()
+    for srv in (ref, pre, dec):
+        srv.shutdown()
+
+
+def _post_json(base, path, body, timeout=60):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(), method='POST',
+        headers={'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, dict(e.headers), e.read()
+
+
+def _generate(base, prompts, max_new=_MAX_NEW):
+    code, headers, body = _post_json(
+        base, '/generate',
+        {'prompt_ids': prompts, 'max_new_tokens': max_new,
+         'temperature': 0.0})
+    assert code == 200, body
+    return json.loads(body)['tokens'], headers
+
+
+def _sse_stream(base, prompt_text, max_new=_MAX_NEW, timeout=60):
+    """(ordered text fragments, finish_reason) from a completions SSE
+    stream — the byte-level payload minus per-server response ids."""
+    req = urllib.request.Request(
+        base + '/v1/completions',
+        data=json.dumps({'model': 'fleet-model', 'prompt': prompt_text,
+                         'max_tokens': max_new, 'temperature': 0.0,
+                         'stream': True}).encode(),
+        method='POST', headers={'Content-Type': 'application/json'})
+    fragments, finish = [], None
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        assert resp.headers['Content-Type'].startswith(
+            'text/event-stream')
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith('data: '):
+                continue
+            payload = line[len('data: '):]
+            if payload == '[DONE]':
+                break
+            obj = json.loads(payload)
+            assert 'error' not in obj, obj
+            choice = obj['choices'][0]
+            text = choice.get('text') or ''
+            if text:
+                fragments.append(text)
+            if choice.get('finish_reason'):
+                finish = choice['finish_reason']
+    return fragments, finish
+
+
+def _counter(reg, name, **labels):
+    parsed = metrics_lib.parse_exposition(reg.expose())
+    return metrics_lib.sample_value(parsed, name, **labels) or 0.0
+
+
+class TestDisaggFleet:
+
+    def test_roles_learned_and_decode_shielded(self, fleet):
+        """The router learned both roles and never selects the decode
+        replica for client traffic — it is reachable through the
+        handoff path only."""
+        by_role = {v.role: v for v in fleet.router.views()}
+        assert set(by_role) == {'prefill', 'decode'}
+        assert by_role['prefill'].url.endswith(str(fleet.pre.port))
+        for key in (None, 1, 2, 3):
+            picked = fleet.router.select_replica(key)
+            assert picked is not None and picked.role == 'prefill'
+        target = fleet.router._select_decode_target(1)
+        assert target is not None and target.role == 'decode'
+
+    def test_greedy_tokens_byte_identical_through_handoff(self, fleet):
+        """The tentpole parity pin: token ids through router ->
+        prefill -> handoff -> decode equal a single `--role both`
+        replica's, and the handoff counters prove the path was the
+        disaggregated one."""
+        export0 = _counter(fleet.pre_reg,
+                           'skytpu_handoff_requests_total',
+                           side='export')
+        admit0 = _counter(fleet.dec_reg,
+                          'skytpu_handoff_requests_total',
+                          side='admit')
+        want, _ = _generate(f'http://127.0.0.1:{fleet.ref.port}',
+                            _PROMPTS)
+        got, headers = _generate(fleet.router.url, _PROMPTS)
+        assert got == want, (fleet.mode, got, want)
+        # The router delivered to the prefill replica...
+        assert headers['X-Served-By'].endswith(str(fleet.pre.port))
+        # ...which exported one artifact per prompt; the decode
+        # replica admitted every one of them.  (Deltas, not lifetime
+        # totals: the prefill replica's startup warmup generate()
+        # exports and self-drains one artifact that never ships.)
+        assert _counter(fleet.pre_reg, 'skytpu_handoff_requests_total',
+                        side='export') - export0 == len(_PROMPTS)
+        assert _counter(fleet.dec_reg, 'skytpu_handoff_requests_total',
+                        side='admit') - admit0 == len(_PROMPTS)
+
+    def test_sse_stream_byte_identical_through_handoff(self, fleet):
+        """Streaming path: the relayed ndjson token stream re-emerges
+        as an SSE stream whose text fragments match the reference
+        replica's fragment-for-fragment."""
+        prompt = 'sky sky sky sky'
+        want = _sse_stream(f'http://127.0.0.1:{fleet.ref.port}', prompt)
+        got = _sse_stream(fleet.router.url, prompt)
+        assert got == want, (fleet.mode, got, want)
+
+    def test_prefix_dedupe_across_the_wire(self, fleet):
+        """A repeated prompt's second handoff ships only the tail: the
+        decode replica already holds the prefix pages via its
+        chain-hash map and admits them by page id."""
+        if not fleet.kw.get('page_size'):
+            pytest.skip('dedupe is a paged-allocator property')
+        prompt = [(7 + i) % 90 for i in range(19)]  # 3 pages at ps=8
+        base = _counter(fleet.dec_reg, 'skytpu_handoff_pages_total',
+                        kind='deduped')
+        _generate(fleet.router.url, [prompt])
+        _generate(fleet.router.url, [prompt])
+        shipped = _counter(fleet.dec_reg, 'skytpu_handoff_pages_total',
+                           kind='shipped')
+        deduped = _counter(fleet.dec_reg, 'skytpu_handoff_pages_total',
+                           kind='deduped')
+        assert shipped >= 1
+        assert deduped >= base + 2, (base, shipped, deduped)
+
+    def test_handoff_rejections_over_http(self, fleet):
+        """Hostile/skewed artifacts die at the door: 400 for garbage,
+        409 for a version the receiver does not speak."""
+        dec = f'http://127.0.0.1:{fleet.dec.port}'
+
+        def _post_blob(blob):
+            req = urllib.request.Request(
+                dec + '/handoff', data=blob, method='POST',
+                headers={'Content-Type': 'application/octet-stream'})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                with e:
+                    return e.code
+        assert _post_blob(b'garbage, not a handoff artifact') == 400
+        skewed = handoff_lib._PREAMBLE.pack(
+            handoff_lib.MAGIC, handoff_lib.VERSION + 1, 0)
+        assert _post_blob(skewed) == 409
+
+    def test_both_sides_leak_free(self, fleet):
+        """After all of the handoff traffic above, both allocators are
+        clean and each replica reports its role in verbose health."""
+        for srv, role in ((fleet.pre, 'prefill'), (fleet.dec, 'decode'),
+                          (fleet.ref, 'both')):
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{srv.port}/health?verbose=1',
+                    timeout=10) as resp:
+                detail = json.loads(resp.read())
+            assert detail['status'] == 'ok'
+            assert detail['role'] == role
+            assert detail['leak_report'] is None, (role, detail)
+
+
+# ---------------------------------------------------------------------
+# Supervisor pools (stub handles; replica processes are not the point)
+# ---------------------------------------------------------------------
+
+class _NullHandle:
+    """Inert Popen surface: alive until told otherwise."""
+
+    def __init__(self):
+        self._forced = None
+
+    def poll(self):
+        return self._forced
+
+    def kill(self):
+        self._forced = -9
+
+    def terminate(self):
+        self._forced = -15
+
+
+class _PoolHarness:
+
+    def __init__(self, pools, **sup_kw):
+        self.calls = []
+        self.registry = metrics_lib.Registry()
+        self.router = Router(registry=self.registry,
+                             health_interval_s=3600.0)
+        self.sup = sup_lib.ReplicaSupervisor(
+            self._factory, self.router, pools=pools, tick_s=3600.0,
+            restart_base_delay_s=0.0, restart_max_delay_s=0.0,
+            drain_timeout_s=0.05, registry=self.registry, **sup_kw)
+
+    def _factory(self, slot_id, role):
+        self.calls.append((slot_id, role))
+        handle = _NullHandle()
+        # Unroutable port: drain POSTs fail fast and fall through to
+        # the drain deadline, which is all these tests need.
+        return handle, f'http://127.0.0.1:1/{slot_id}'
+
+    def view_for(self, slot, **fields):
+        view = next(v for v in self.router.views()
+                    if v.url == slot.url)
+        for k, v in fields.items():
+            setattr(view, k, v)
+        return view
+
+
+class TestSupervisorPools:
+
+    def test_pools_spawn_role_slots_and_factory_signature(self):
+        h = _PoolHarness({'prefill': {'min_replicas': 1},
+                          'decode': {'min_replicas': 2}})
+        h.sup.tick()
+        assert sorted(role for _, role in h.calls) == \
+            ['decode', 'decode', 'prefill']
+        assert h.sup.min_replicas == 3
+        assert sorted(s.role for s in h.sup.slots()) == \
+            ['decode', 'decode', 'prefill']
+
+    def test_pools_scale_on_their_own_signals(self):
+        """Decode-pool page starvation adds a decode replica and ONLY
+        a decode replica; the prefill pool holds."""
+        h = _PoolHarness({
+            'prefill': {'min_replicas': 1},
+            'decode': {'min_replicas': 1,
+                       'autoscaler': sup_lib.EngineSignalsAutoscaler(
+                           min_replicas=1, signal='pages',
+                           upscale_patience=1)}})
+        h.sup.tick()
+        decode_slot = next(s for s in h.sup.slots()
+                           if s.role == 'decode')
+        h.view_for(decode_slot, role='decode', health='ok',
+                   queue_depth=1.0, free_pages=0.0)
+        h.sup.tick()   # autoscale: creates the pending decode slot
+        # Starvation over; the next tick spawns the pending slot
+        # (tick order: spawn before autoscale) without growing again.
+        h.view_for(decode_slot, free_pages=64.0)
+        h.sup.tick()
+        assert [role for _, role in h.calls].count('decode') == 2
+        assert [role for _, role in h.calls].count('prefill') == 1
+        assert h.sup.desired == 3
+
+    def test_pool_scale_down_drains_own_pool_only(self):
+        scaler = sup_lib.EngineSignalsAutoscaler(
+            min_replicas=1, signal='pages', downscale_patience=1)
+        h = _PoolHarness({'prefill': {'min_replicas': 1},
+                          'decode': {'min_replicas': 1,
+                                     'autoscaler': scaler}})
+        h.sup.tick()
+        # Grow the decode pool to 2 by hand, then let an idle pool
+        # shrink it: the victim must be the NEWEST decode slot.
+        h.sup._new_slot('decode')
+        h.sup.tick()
+        for slot in (s for s in h.sup.slots() if s.role == 'decode'):
+            h.view_for(slot, role='decode', health='ok',
+                       queue_depth=0.0, free_pages=64.0)
+        h.sup.tick()
+        draining = [s for s in h.sup.slots()
+                    if s.state == sup_lib.DRAINING]
+        assert [s.role for s in draining] == ['decode']
+        assert draining[0].slot_id == max(
+            s.slot_id for s in h.sup.slots() if s.role == 'decode')
+        assert all(s.state == sup_lib.LIVE for s in h.sup.slots()
+                   if s.role == 'prefill')
+
+    def test_crashed_slot_respawns_with_its_role(self):
+        h = _PoolHarness({'prefill': {'min_replicas': 1},
+                          'decode': {'min_replicas': 1}})
+        h.sup.tick()
+        victim = next(s for s in h.sup.slots() if s.role == 'decode')
+        victim.handle._forced = -9   # crash
+        h.sup.tick()                 # reap -> backoff(0 delay)
+        h.sup.tick()                 # respawn
+        assert h.calls[-1][1] == 'decode'
+        assert victim.state == sup_lib.LIVE and \
+            victim.role == 'decode'
+
+    def test_pool_validation(self):
+        with pytest.raises(ValueError, match='unknown pool role'):
+            _PoolHarness({'verifier': {'min_replicas': 1}})
+        with pytest.raises(ValueError, match="signal"):
+            sup_lib.EngineSignalsAutoscaler(signal='entropy')
+
+
+# Test surfaces this PR added: scanned by the tier-1 guard below.
+_PR_TEST_SURFACES = {
+    'test_disagg_e2e.py': None,          # whole file
+    'test_handoff.py': None,             # whole file
+}
+
+
+class TestTier1Guard:
+    """The disaggregated e2e fleet test and the handoff unit tests run
+    in the tier-1 lane: CPU backend, no `slow` marker, no TPU gating —
+    the byte-identical-stream guarantee is only a guarantee if CI
+    executes it on every PR."""
+
+    def test_runs_on_cpu_backend(self):
+        assert jax.default_backend() == 'cpu'
+
+    def test_new_tests_not_slow_marked(self):
+        import pathlib
+        here = pathlib.Path(__file__).parent
+        for fname, surfaces in _PR_TEST_SURFACES.items():
+            text = (here / fname).read_text()
+            if surfaces is None:
+                scopes = [text]
+            else:
+                scopes = []
+                for name in surfaces:
+                    assert name in text, (fname, name)
+                    scopes.append(text[text.index(name):])
+            slow, tpu = 'mark.' + 'slow', 'requires' + '_tpu'
+            for scope in scopes:
+                assert slow not in scope, fname
+                assert tpu not in scope, fname

@@ -1,0 +1,264 @@
+"""Disaggregated prefill/decode handoff (PR: specialized replica
+roles with a page-id KV handoff).
+
+Covers the wire format (round-trip across bf16/int8/f32 tensors,
+version gating, malformed-artifact rejection) and the engine-level
+handoff: a role='prefill' engine exports exactly one seed token plus
+an artifact, a role='decode' engine admits it mid-stream, and the
+combined token sequence is IDENTICAL to a single role='both' engine's
+— across contiguous/paged layouts, whole/chunked prefill, and the
+int8 KV cache whose scale rows ship alongside.  Page-id dedupe is
+pinned by counter (second handoff of a prompt ships fewer pages than
+the first), and both allocators must end leak-free.
+
+Tier-1/CPU by design: everything here runs under
+`JAX_PLATFORMS=cpu -m 'not slow'`.
+"""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.infer import handoff
+from skypilot_tpu.observability import metrics as metrics_lib
+
+_OV = {'max_seq_len': 64, 'n_layers': 2, 'n_heads': 4,
+       'n_kv_heads': 2, 'dim': 64, 'ffn_dim': 128, 'vocab_size': 96,
+       'dtype': jnp.bfloat16, 'param_dtype': jnp.float32}
+_PS = 8
+_PROMPTS = [[5, 17, 3, 42, 8], [9, 1, 33, 7]]
+_GREEDY = engine_lib.SamplingConfig(max_new_tokens=6, temperature=0.0)
+
+
+def _cbe(**kw):
+    kw.setdefault('n_slots', 2)
+    kw.setdefault('prefill_bucket', _PS)
+    return engine_lib.ContinuousBatchingEngine(
+        'llama-tiny', model_overrides=dict(_OV), **kw)
+
+
+def _drive(eng, rids, budget_s=120.0):
+    """Run the scheduler until every rid's event is set, then wait()
+    them all."""
+    deadline = time.monotonic() + budget_s
+    while any(not eng._events[r].is_set() for r in rids):
+        eng.step()
+        assert time.monotonic() < deadline, 'engine stalled'
+    return [eng.wait(r, timeout=1.0) for r in rids]
+
+
+def _meta(**over):
+    meta = dict(model='m', kv_cache_dtype='bfloat16', page_size=8,
+                max_seq_len=64, true_len=5, pad=8,
+                prompt_ids=[1, 2, 3, 4, 5], seed=7, seed_token=11,
+                sampling=dict(max_new_tokens=4, temperature=0.0,
+                              top_k=0, top_p=1.0, eos_id=None))
+    meta.update(over)
+    return meta
+
+
+class TestWireFormat:
+
+    def test_round_trip_preserves_meta_and_tensors(self):
+        import ml_dtypes
+        tensors = {
+            'layers_0/cached_key':
+                np.arange(24, dtype=np.float32).astype(
+                    ml_dtypes.bfloat16).reshape(1, 2, 3, 4),
+            'layers_0/cached_key_scale':
+                np.full((1, 2, 3, 1), 0.5, np.float32),
+            'layers_0/cached_value':
+                np.arange(-12, 12, dtype=np.int8).reshape(1, 2, 3, 4),
+            'last_row': np.linspace(0., 1., 96).astype(np.float32),
+        }
+        blob = handoff.serialize_artifact(_meta(), tensors)
+        meta, out = handoff.deserialize_artifact(blob)
+        assert meta['prompt_ids'] == [1, 2, 3, 4, 5]
+        assert meta['seed'] == 7 and meta['seed_token'] == 11
+        assert meta['sampling']['max_new_tokens'] == 4
+        assert set(out) == set(tensors)
+        for name, want in tensors.items():
+            got = out[name]
+            assert got.dtype == want.dtype, name
+            assert got.shape == want.shape, name
+            np.testing.assert_array_equal(
+                np.asarray(got, np.float32),
+                np.asarray(want, np.float32))
+
+    def test_version_mismatch_rejected(self):
+        blob = handoff.serialize_artifact(_meta(), {})
+        _, _, hlen = handoff._PREAMBLE.unpack_from(blob, 0)
+        bad = handoff._PREAMBLE.pack(
+            handoff.MAGIC, handoff.VERSION + 1, hlen) \
+            + blob[handoff._PREAMBLE.size:]
+        with pytest.raises(handoff.HandoffVersionError):
+            handoff.deserialize_artifact(bad)
+
+    def test_malformed_artifacts_rejected(self):
+        blob = handoff.serialize_artifact(_meta(), {})
+        with pytest.raises(handoff.HandoffFormatError):
+            handoff.deserialize_artifact(b'NOPE' + blob[4:])
+        with pytest.raises(handoff.HandoffFormatError):
+            handoff.deserialize_artifact(blob[:6])      # truncated
+        with pytest.raises(handoff.HandoffFormatError):
+            handoff.deserialize_artifact(blob[:-1] if len(blob) > 11
+                                         else blob)     # short header
+        meta = _meta()
+        del meta['seed']
+        with pytest.raises(handoff.HandoffFormatError):
+            handoff.serialize_artifact(meta, {})
+
+    def test_tensor_directory_bounds_checked(self):
+        tensors = {'t': np.ones((2, 2), np.float32)}
+        blob = handoff.serialize_artifact(_meta(), tensors)
+        # Drop payload bytes: the directory now points past the end.
+        with pytest.raises(handoff.HandoffFormatError):
+            handoff.deserialize_artifact(blob[:-4])
+
+    def test_prompt_page_split(self):
+        assert handoff.prompt_page_split(list(range(19)), 0, 8) == (3, 0)
+        assert handoff.prompt_page_split(list(range(19)), 2, 8) == (1, 2)
+        assert handoff.prompt_page_split(list(range(19)), 0, 0) == (0, 0)
+
+
+# Cache-mode / prefill-geometry matrix the parity tests sweep: the
+# artifact must be layout-agnostic (contiguous vs paged receiver
+# rebuilds from the same wire slice) and dtype-faithful (int8 scale
+# rows ride along).
+_MODES = {
+    'contig-bf16': dict(),
+    'paged-chunked-bf16': dict(page_size=_PS, prefill_chunk=2),
+    'paged-int8': dict(page_size=_PS, kv_cache_dtype='int8'),
+}
+
+
+@pytest.fixture(scope='module')
+def params():
+    return _cbe().params
+
+
+@pytest.fixture(scope='module', params=sorted(_MODES))
+def pair(request, params):
+    kw = _MODES[request.param]
+    both = _cbe(params=params, **kw)
+    want = both.generate(_PROMPTS, _GREEDY)
+    sender = _cbe(params=params, role='prefill', **kw)
+    receiver = _cbe(params=params, role='decode', **kw)
+    return want, sender, receiver
+
+
+class TestEngineHandoff:
+
+    def test_greedy_parity_across_handoff(self, pair):
+        want, sender, receiver = pair
+        for prompt, full in zip(_PROMPTS, want):
+            rid = sender.submit(prompt, _GREEDY)
+            head = _drive(sender, [rid])[0]
+            blob = sender.take_handoff(rid)
+            assert blob is not None
+            # The prefill replica emitted exactly the seed token,
+            # sampled with the same (seed, 0) fold decode would use.
+            assert head == full[:1]
+            meta, _ = handoff.deserialize_artifact(blob)
+            assert meta['seed_token'] == full[0]
+            rid2 = receiver.admit_handoff(blob)
+            out = _drive(receiver, [rid2])[0]
+            # The decode replica re-derives the seed token (bit-
+            # identical draw from the shipped logits row) and decodes
+            # the rest: its full sequence matches the single-replica
+            # engine exactly.
+            assert out == full
+        assert sender.allocator_leak_report() is None
+        assert receiver.allocator_leak_report() is None
+
+    def test_take_handoff_is_one_shot(self, pair):
+        _, sender, receiver = pair
+        rid = sender.submit(_PROMPTS[0], _GREEDY)
+        _drive(sender, [rid])
+        blob = sender.take_handoff(rid)
+        assert blob is not None
+        assert sender.take_handoff(rid) is None
+        rid2 = receiver.admit_handoff(blob)
+        _drive(receiver, [rid2])
+
+
+def test_prefix_dedupe_page_counts():
+    reg = metrics_lib.Registry()
+    sender = _cbe(role='prefill', page_size=_PS)
+    receiver = _cbe(params=sender.params, role='decode',
+                    page_size=_PS, registry=reg)
+    prompt = list(range(1, 20))        # 19 tokens = 3 prompt pages
+    blobs = []
+    for _ in range(2):
+        rid = sender.submit(prompt, _GREEDY)
+        _drive(sender, [rid])
+        blobs.append(sender.take_handoff(rid))
+    pages = reg.get('skytpu_handoff_pages_total')
+    r1 = receiver.admit_handoff(blobs[0])
+    _drive(receiver, [r1])
+    # Cold receiver: every prompt page shipped, nothing deduped.
+    assert pages.value_for(kind='shipped') == 3
+    assert pages.value_for(kind='deduped') == 0
+    r2 = receiver.admit_handoff(blobs[1])
+    _drive(receiver, [r2])
+    # Second handoff of the same prompt: the receiver already holds
+    # the page-aligned prefix via its chain-hash map — 2 of the 3
+    # prompt pages are admitted by page id (capped one page short of
+    # the prompt's end, the same rule local admission uses).
+    assert pages.value_for(kind='deduped') == 2
+    assert pages.value_for(kind='shipped') == 4
+    hand = reg.get('skytpu_handoff_requests_total')
+    assert hand.value_for(side='admit') == 2
+    assert sender.allocator_leak_report() is None
+    assert receiver.allocator_leak_report() is None
+
+
+def test_engine_rejects_incompatible_artifacts():
+    sender = _cbe(role='prefill', page_size=_PS)
+    rid = sender.submit(_PROMPTS[0], _GREEDY)
+    _drive(sender, [rid])
+    blob = sender.take_handoff(rid)
+    receiver = _cbe(params=sender.params, role='decode',
+                    page_size=_PS)
+    # Version skew fails closed (mixed fleet mid-rollout).
+    _, _, hlen = handoff._PREAMBLE.unpack_from(blob, 0)
+    bad = handoff._PREAMBLE.pack(
+        handoff.MAGIC, handoff.VERSION + 1, hlen) \
+        + blob[handoff._PREAMBLE.size:]
+    with pytest.raises(handoff.HandoffVersionError):
+        receiver.admit_handoff(bad)
+    # Geometry mismatches are rejected before any allocation.
+    shorter = engine_lib.ContinuousBatchingEngine(
+        'llama-tiny', model_overrides=dict(_OV, max_seq_len=32),
+        n_slots=2, prefill_bucket=_PS, page_size=_PS, role='decode')
+    with pytest.raises(handoff.HandoffFormatError):
+        shorter.admit_handoff(blob)
+    contiguous = _cbe(params=sender.params, role='decode')
+    with pytest.raises(handoff.HandoffFormatError):
+        contiguous.admit_handoff(blob)
+    # A prefill-role replica does not ingest.
+    with pytest.raises(handoff.HandoffFormatError):
+        sender.admit_handoff(blob)
+    # The rejecting engines created no request state.
+    assert receiver.queue_depth == 0
+    assert receiver.allocator_leak_report() is None
+
+
+def test_request_finishing_on_seed_token_never_exports():
+    sender = _cbe(role='prefill')
+    cfg = engine_lib.SamplingConfig(max_new_tokens=1, temperature=0.0)
+    rid = sender.submit(_PROMPTS[0], cfg)
+    out = _drive(sender, [rid])[0]
+    assert len(out) == 1
+    assert sender.take_handoff(rid) is None
+
+
+def test_role_validation():
+    with pytest.raises(ValueError):
+        _cbe(role='nope')
+    with pytest.raises(ValueError):
+        # No decode steps on a prefill replica for mixed chunks to
+        # ride.
+        _cbe(role='prefill', prefill_mix_budget=2)

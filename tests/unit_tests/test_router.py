@@ -313,6 +313,85 @@ class TestSelection:
         assert views['http://c:1'].health == 'unknown'
 
 
+# -- engine-signal staleness -------------------------------------------
+
+class TestSignalStaleness:
+    """Scraped engine signals decay: a replica whose /metrics scrape
+    keeps failing must not be routed (or saturation-skipped) on a
+    minutes-old queue depth.  Staleness window =
+    ROUTER_SIGNAL_STALENESS_FACTOR x health_interval_s; views whose
+    signals were set directly (signals_at is None) stay trusted."""
+
+    def test_stale_saturation_signal_is_ignored(self):
+        r = _router(['http://a:1', 'http://b:1'],
+                    health_interval_s=0.05,
+                    saturation_queue_depth=4.0)
+        _mark_ok(r)
+        key = 42
+        home = r.select_replica(key=key)
+        other = next(v for v in r.views() if v.url != home.url)
+        # Fresh saturation diverts affinity...
+        home.queue_depth = 50.0
+        home.signals_at = time.monotonic()
+        other.queue_depth = 1.0
+        other.signals_at = time.monotonic()
+        assert r.select_replica(key=key).url == other.url
+        # ...but once the scrape goes stale the depth is neutral and
+        # affinity resumes (window here: 2 x 0.05s = 0.1s).
+        home.signals_at = time.monotonic() - 1.0
+        assert r.select_replica(key=key).url == home.url
+
+    def test_stale_queue_depth_is_neutral_for_least_loaded(self):
+        r = _router(['http://a:1', 'http://b:1'],
+                    health_interval_s=0.05)
+        _mark_ok(r)
+        views = {v.url: v for v in r.views()}
+        views['http://a:1'].queue_depth = 50.0
+        views['http://a:1'].signals_at = time.monotonic() - 1.0
+        views['http://b:1'].queue_depth = 1.0
+        views['http://b:1'].signals_at = time.monotonic()
+        # a's depth is stale -> reads as 0 -> least-loaded picks a.
+        assert r.select_replica(key=None).url == 'http://a:1'
+
+    def test_unstamped_signals_stay_trusted(self):
+        r = _router(['http://a:1', 'http://b:1'],
+                    health_interval_s=0.05)
+        _mark_ok(r)
+        views = {v.url: v for v in r.views()}
+        views['http://a:1'].queue_depth = 50.0   # signals_at None
+        assert r.select_replica(key=None).url == 'http://b:1'
+        assert views['http://a:1'].snapshot()['signal_age_s'] is None
+
+    def test_signal_age_stamped_and_exported(self):
+        rep = _FakeReplica()
+        router = _start_router([rep.url])
+        try:
+            view = router.views()[0]
+            assert view.signals_at is not None
+            age = view.snapshot()['signal_age_s']
+            assert age is not None and age >= 0.0
+            parsed = metrics_lib.parse_exposition(
+                router.registry.expose())
+            assert metrics_lib.sample_value(
+                parsed, 'skytpu_router_signal_age_seconds',
+                replica=rep.url) is not None
+        finally:
+            router.stop()
+            rep.stop()
+
+    def test_fleet_metrics_carry_the_role_label(self):
+        rep = _FakeReplica()
+        router = _start_router([rep.url])
+        try:
+            with urllib.request.urlopen(
+                    router.url + '/fleet/metrics', timeout=10) as resp:
+                text = resp.read().decode()
+            assert 'role="both"' in text
+        finally:
+            router.stop()
+            rep.stop()
+
+
 # -- request-id hygiene ------------------------------------------------
 
 class TestRequestId:

@@ -81,12 +81,15 @@ def test_metrics_scrape_after_round_trip(server):
     # skytpu_train_* lives in the trainer; skytpu_router_*,
     # skytpu_fleet_*, and the burn-rate gauge live in the
     # router/supervisor process; skytpu_spec_* only registers on
-    # engines started with spec_k > 0 (this server speculates not).
+    # engines started with spec_k > 0 (this server speculates not);
+    # skytpu_handoff_* only registers on engines started with a
+    # disaggregated role (this server runs --role both).
     expected = {n for n in observability.METRIC_CONTRACT
                 if not n.startswith(('skytpu_train_',
                                      'skytpu_router_',
                                      'skytpu_fleet_',
-                                     'skytpu_spec_'))
+                                     'skytpu_spec_',
+                                     'skytpu_handoff_'))
                 and n != 'skytpu_slo_burn_rate'}
     assert scraped == expected, scraped ^ expected
     # Exposition format details the contract set cannot express:
