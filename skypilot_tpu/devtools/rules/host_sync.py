@@ -39,6 +39,7 @@ def in_scope(posix: str) -> bool:
             or posix.endswith('infer/engine.py')
             or posix.endswith('infer/speculative.py')
             or posix.endswith('infer/handoff.py')
+            or posix.endswith('infer/fleet_cache.py')
             or posix.endswith('train/trainer.py'))
 
 

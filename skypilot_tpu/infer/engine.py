@@ -745,6 +745,25 @@ def make_set_table_fn():
     return _set_table
 
 
+def make_page_write_fn():
+    """Build the host-tier rehydration writer: scatter ONE page's
+    leaves (host arrays uploaded as jit args) into every pool leaf at
+    a traced page id — a single compile covers any page.  Donates the
+    shared cache like the other pool mutators."""
+    def _page_write(cache, page, updates):
+        def _upd(path, leaf):
+            names = _path_names(path)
+            if names[-1] not in _CONTIG_OF_POOL:
+                return leaf
+            arr = updates['/'.join(str(n) for n in names)]
+            if leaf.ndim == 4:        # [n_pages, kvh, ps, hd]
+                return leaf.at[page].set(arr.astype(leaf.dtype))
+            return leaf.at[:, page].set(  # scanned [L, n_pages, ...]
+                arr.astype(leaf.dtype))
+        return jax.tree_util.tree_map_with_path(_upd, cache)
+    return _page_write
+
+
 @dataclasses.dataclass
 class _Slot:
     """Host-side state of one occupied decode slot."""
@@ -808,6 +827,10 @@ class _PendingPrefill:
     # wire artifact — cache1 was rebuilt from shipped tensors, done is
     # already pad, and the slot must mark its seed token pre-emitted.
     handoff: bool = False
+    # Live migration (kind='slot' artifact): the decode restart state
+    # _finish_prefill applies to the promoted slot — generated /
+    # outputs / steps, with every already-streamed token pre-emitted.
+    restore: Optional[Dict[str, Any]] = None
 
 
 class _InflightStep:
@@ -1093,7 +1116,10 @@ def _handoff_metrics(registry: metrics_lib.Registry) -> Dict[str, Any]:
             'insert).'),
         'bytes': r.histogram(
             'skytpu_handoff_bytes',
-            'Serialized handoff artifact size on the wire.',
+            'Serialized handoff artifact size: form=wire (as '
+            'shipped, possibly zlib-compressed) vs form=raw '
+            '(uncompressed tensor payload).',
+            labelnames=('form',),
             buckets=metrics_lib.DEFAULT_BYTE_BUCKETS),
         'handoffs': r.counter(
             'skytpu_handoff_requests_total',
@@ -1107,6 +1133,80 @@ def _handoff_metrics(registry: metrics_lib.Registry) -> Dict[str, Any]:
             'arrived over the wire) vs deduped (already held locally '
             'via the chain-hash prefix map — admitted by page id, '
             'not rewritten).', labelnames=('kind',)),
+    }
+
+
+def _fleet_cache_metrics(registry: metrics_lib.Registry
+                         ) -> Dict[str, Any]:
+    """Get-or-create handles for the host-RAM / fleet prefix-cache
+    series.  Registered only on engines constructed with a host cache
+    (host_cache_bytes > 0) — a cache-less replica's scrape must not
+    advertise them."""
+    r = registry
+    return {
+        'hits': r.counter(
+            'skytpu_fleet_cache_hits_total',
+            'Host-tier page lookups that found a spilled copy '
+            '(local rehydrate or /kv_prefix serve).'),
+        'misses': r.counter(
+            'skytpu_fleet_cache_misses_total',
+            'Host-tier page lookups that missed.'),
+        'spilled_pages': r.counter(
+            'skytpu_fleet_cache_spilled_pages_total',
+            'Device pages copied to the host-RAM tier just before '
+            'their device copy was cannibalised.'),
+        'spilled_bytes': r.counter(
+            'skytpu_fleet_cache_spilled_bytes_total',
+            'Bytes copied device -> host by spills.'),
+        'evicted_pages': r.counter(
+            'skytpu_fleet_cache_evicted_pages_total',
+            'Host-tier pages dropped by its LRU byte budget.'),
+        'rehydrated_pages': r.counter(
+            'skytpu_fleet_cache_rehydrated_pages_total',
+            'Host-tier pages uploaded back into the device pool on a '
+            'prefix hit (each one a page of prefill NOT re-run).'),
+        'saved_tokens': r.counter(
+            'skytpu_fleet_cache_reprefill_tokens_saved_total',
+            'Prompt tokens whose prefill was skipped because their '
+            'page rehydrated from the host tier.'),
+        'stored_bytes': r.gauge(
+            'skytpu_fleet_cache_stored_bytes',
+            'Bytes currently resident in the host-RAM tier.'),
+        'stored_pages': r.gauge(
+            'skytpu_fleet_cache_stored_pages',
+            'Pages currently resident in the host-RAM tier.'),
+    }
+
+
+def _migration_metrics(registry: metrics_lib.Registry
+                       ) -> Dict[str, Any]:
+    """Get-or-create handles for the live slot-migration series.
+    Registered lazily on first migration activity (any role can drain
+    or receive — there is no construction-time migration flag, and an
+    idle replica's scrape must not advertise them)."""
+    r = registry
+    return {
+        'migrations': r.counter(
+            'skytpu_migration_requests_total',
+            "Migrated in-flight slots by side: side='out' = this "
+            "replica checkpointed one at drain, side='in' = this "
+            'replica resumed one mid-generation.',
+            labelnames=('side',)),
+        'export_seconds': r.histogram(
+            'skytpu_migration_export_seconds',
+            'Seconds to checkpoint one live slot into the wire '
+            'artifact (pool gather + device fetch + encode + slot '
+            'teardown).'),
+        'admit_seconds': r.histogram(
+            'skytpu_migration_admit_seconds',
+            'Seconds from migrated-artifact acceptance to resumed '
+            'decode slot.'),
+        'bytes': r.histogram(
+            'skytpu_migration_bytes',
+            'Migrated slot-checkpoint size: form=wire (as shipped, '
+            'possibly zlib) vs form=raw (uncompressed tensor bytes).',
+            labelnames=('form',),
+            buckets=metrics_lib.DEFAULT_BYTE_BUCKETS),
     }
 
 
@@ -1203,11 +1303,20 @@ class ContinuousBatchingEngine:
                  decode_kernel: str = 'auto',
                  prefill_kernel: str = 'auto',
                  prefill_mix_budget: int = 0,
-                 role: str = 'both') -> None:
+                 role: str = 'both',
+                 host_cache_bytes: int = 0) -> None:
         import collections
 
         if draft_model is not None and spec_k <= 0:
             raise ValueError('draft_model requires spec_k > 0')
+        if host_cache_bytes < 0:
+            raise ValueError(
+                f'host_cache_bytes must be >= 0, got {host_cache_bytes}')
+        if host_cache_bytes > 0 and not page_size:
+            raise ValueError(
+                'host_cache_bytes requires a paged KV cache '
+                '(page_size > 0): the host tier stores pool pages '
+                'keyed by the chain-hash prefix map')
         if role not in ('both', 'prefill', 'decode'):
             raise ValueError(
                 f"role must be 'both', 'prefill' or 'decode', "
@@ -1750,6 +1859,67 @@ class ContinuousBatchingEngine:
         self._prefill_read_bytes_per_pos = _pr['grouped_bytes']
         self._prefill_epilogue_bytes_per_pos = _pr['epilogue_bytes']
 
+        # -- host-RAM spill tier + fleet prefix cache -----------------
+        # (infer/fleet_cache.py).  When configured, the allocator's
+        # cannibalisation path spills victim pages to host RAM instead
+        # of discarding them, and _admit rehydrates them on a later
+        # prefix hit — microseconds instead of a re-prefill.  The
+        # same tier backs GET /kv_prefix for fleet-peer warm-up.
+        self.host_cache_bytes = int(host_cache_bytes)
+        self._host_cache = None
+        self._fleet_met = None
+        if self.host_cache_bytes > 0:
+            from skypilot_tpu.infer import fleet_cache as fleet_lib
+            self._host_cache = fleet_lib.HostPrefixCache(
+                self.host_cache_bytes)
+            self._alloc.set_spill_hooks(self._spill_page,
+                                        self._host_cache.has)
+            self._fleet_met = _fleet_cache_metrics(self.registry)
+            # Jitted pool-page writer for rehydration: donates the
+            # shared cache and scatters one page's leaves at a traced
+            # page id (single compile for any page).
+            self._page_write = jax.jit(make_page_write_fn(),
+                                       donate_argnums=(0,))
+            # Expected per-page leaf shapes/dtypes, keyed like the
+            # host tier: pool leaves with the page axis dropped.
+            # Validates peer-fetched pages before they can reach the
+            # jitted writer.
+            self._pool_page_specs: Dict[str, Any] = {}
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    self._cache)[0]:
+                names = _path_names(path)
+                if names[-1] not in _CONTIG_OF_POOL:
+                    continue
+                key = '/'.join(str(n) for n in names)
+                shape = (leaf.shape[1:] if leaf.ndim == 4
+                         else leaf.shape[:1] + leaf.shape[2:])
+                self._pool_page_specs[key] = (shape,
+                                              np.dtype(leaf.dtype))
+        # Last-published fleet-cache counter values (diffed per step
+        # by _publish_step_metrics, same pattern as cannibalized).
+        self._spilled_seen = 0
+        self._spilled_bytes = 0
+        self._spilled_bytes_seen = 0
+        self._rehydrated_pages = 0
+        self._rehydrated_seen = 0
+        self._saved_tokens = 0
+        self._saved_seen = 0
+        self._fleet_hits_seen = 0
+        self._fleet_misses_seen = 0
+        self._fleet_evicted_seen = 0
+        # -- live migration (drain/preemption) ------------------------
+        # request_migrate_out() arms the flag from a server thread;
+        # the scheduler's next step() checkpoints every occupied slot
+        # into a kind='slot' artifact parked in _handoffs for the
+        # server to relay to a survivor.  Metrics register lazily on
+        # first migration activity.
+        self._migrate_requested = False
+        self._migration_met = None
+        # SKHO v2 zlib tensor section (opt-in; both sides run v2, so
+        # no negotiation beyond the version field is needed).
+        self._handoff_compress = \
+            os.environ.get('SKYTPU_HANDOFF_COMPRESS', '') == '1'
+
     def cache_read_bytes_per_step(self, context: Optional[int] = None,
                                   row_contexts: Optional[Sequence[int]]
                                   = None) -> Dict[str, float]:
@@ -1859,6 +2029,22 @@ class ContinuousBatchingEngine:
                 f'prompt ({len(prompt_ids)}) + max_new_tokens '
                 f'({cfg.max_new_tokens}) exceeds max_seq_len '
                 f'{self.max_seq_len}.')
+        if self.page_size:
+            # A request that could never fit the page pool must fail
+            # HERE (caller thread, -> 400): admission backpressure
+            # retries on the assumption that draining slots will free
+            # pages, which never helps when the worst-case footprint
+            # exceeds the pool itself.
+            pad, need = self._page_need(len(prompt_ids), cfg)
+            if need > self._alloc.capacity:
+                raise ValueError(
+                    f'request needs {need} KV pages (prompt '
+                    f'{len(prompt_ids)} tokens padded to {pad} for '
+                    f'the prefill bucket, + max_new_tokens '
+                    f'{cfg.max_new_tokens}, page_size '
+                    f'{self.page_size}) but the pool holds only '
+                    f'{self._alloc.capacity}; raise max_pages or '
+                    f'lower max_new_tokens.')
         if cfg.seed is not None:
             # Coerce + mask HERE (caller thread): a bad seed must 400
             # the one request, never blow up the shared decode loop.
@@ -2155,6 +2341,154 @@ class ContinuousBatchingEngine:
         return jax.tree.map(_zeros, self._abstract_cache1,
                             self._cache1_shardings)
 
+    # -- host-RAM spill tier + fleet prefix cache ---------------------
+
+    def _spill_page(self, h: int, page: int) -> None:
+        """Allocator spill hook: copy device page `page`'s pool
+        contents to the host tier under chain hash `h`, right before
+        the device copy is cannibalised.  Runs inside alloc() on the
+        scheduler thread; `self._cache` is always a valid (possibly
+        not-yet-ready) pool there, and device_get blocks until the
+        page's bytes exist."""
+        leaves: Dict[str, np.ndarray] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self._cache)[0]:
+            names = _path_names(path)
+            if names[-1] not in _CONTIG_OF_POOL:
+                continue
+            view = leaf[page] if leaf.ndim == 4 else leaf[:, page]
+            leaves['/'.join(str(n) for n in names)] = \
+                np.asarray(jax.device_get(view))
+        self._host_cache.put(h, leaves)
+        self._spilled_bytes += sum(int(a.nbytes)
+                                   for a in leaves.values())
+
+    def _rehydrate_chain(self, prompt: List[int], shared: List[int],
+                         cap: int) -> List[int]:
+        """Extend a device-tier prefix hit through the host tier:
+        walk the prompt's chain hashes past `shared`, uploading each
+        host-resident page into a fresh pool page (and re-registering
+        it) until the first page NO tier holds.  Device-registered
+        pages past a rehydrated gap resume by reference.  Every
+        returned page is retained, mirroring lookup_prefix."""
+        from skypilot_tpu.infer import paging as paging_lib
+        hashes = paging_lib.chain_hashes(prompt, self.page_size)
+        shared = list(shared)
+        while len(shared) < cap:
+            h = hashes[len(shared)]
+            page = self._alloc.take_registered(h)
+            if page is None:
+                leaves = self._host_cache.get(h)
+                if leaves is None:
+                    break
+                got = self._alloc.alloc(1)
+                if got is None:
+                    break                 # pool pressure: stop early
+                page = got[0]
+                updates = {key: jnp.asarray(arr)
+                           for key, arr in leaves.items()}
+                try:
+                    self._cache = self._page_write(
+                        self._cache, jnp.int32(page), updates)
+                except Exception as e:  # pylint: disable=broad-except
+                    # The writer donates the shared cache; a
+                    # mid-donation failure is not containable.
+                    raise failures.SharedStateError(
+                        f'host-tier rehydrate of page {page} failed '
+                        f'mid-donation; shared cache state unknown'
+                        ) from e
+                self._alloc.adopt_prefix(h, page)
+                self._rehydrated_pages += 1
+                self._saved_tokens += self.page_size
+            shared.append(page)
+        return shared
+
+    def kv_prefix_blob(self, hashes: Sequence[int]) -> Optional[bytes]:
+        """Serve GET /kv_prefix: the longest leading run of `hashes`
+        resident in the host tier, in the SKHO kv_prefix framing.
+        None when the tier is off or holds none of the chain.
+        Thread-safe — HTTP handler threads call it, and only
+        host-tier state is touched."""
+        from skypilot_tpu.infer import handoff as handoff_lib
+        if self._host_cache is None or not hashes:
+            return None
+        served_h, served_p = self._host_cache.snapshot_run(hashes)
+        if not served_h:
+            return None
+        return handoff_lib.serialize_kv_prefix(
+            self._model_name, self.kv_cache_dtype, self.page_size,
+            served_h, served_p, compress=self._handoff_compress)
+
+    def ingest_prefix_pages(self, pages: Sequence[Any]) -> int:
+        """Store fleet-peer pages [(chain_hash, {leaf: array})...]
+        into the LOCAL host tier; the scheduler's rehydration walk
+        picks them up at the next admission.  HTTP-handler-thread
+        safe.  Pages failing the pool-leaf geometry check are dropped
+        — a peer running different sharding or quantization must not
+        poison the tier."""
+        if self._host_cache is None:
+            return 0
+        n = 0
+        for h, leaves in pages:
+            ok = set(leaves) == set(self._pool_page_specs)
+            if ok:
+                for key, arr in leaves.items():
+                    shape, dtype = self._pool_page_specs[key]
+                    if tuple(arr.shape) != tuple(shape) \
+                            or np.dtype(arr.dtype) != dtype:
+                        ok = False
+                        break
+            if ok and self._host_cache.put(int(h), dict(leaves)):
+                n += 1
+        return n
+
+    def prefix_resident_run(self, hashes: Sequence[int]) -> int:
+        """Leading run of `hashes` already resident in SOME local tier
+        (device prefix map or host cache) — the server's fleet fetch
+        skips them and asks the peer only for the missing tail.
+        Advisory (racy reads from handler threads): a stale answer
+        costs one redundant fetch, never correctness."""
+        n = 0
+        for h in hashes:
+            if self._alloc is not None and self._alloc.has_prefix(h):
+                n += 1
+            elif self._host_cache is not None \
+                    and self._host_cache.has(h):
+                n += 1
+            else:
+                break
+        return n
+
+    def host_cache_stats(self) -> Optional[Dict[str, int]]:
+        """Host-tier stats + cross-tier lifetime counters for
+        /health and the dashboard; None when the tier is off.
+        Advisory (racy reads from handler threads)."""
+        if self._host_cache is None:
+            return None
+        s = self._host_cache.stats()
+        s['spilled_pages_total'] = self._alloc.spilled_total
+        s['spilled_bytes_total'] = self._spilled_bytes
+        s['rehydrated_pages_total'] = self._rehydrated_pages
+        s['reprefill_tokens_saved_total'] = self._saved_tokens
+        return s
+
+    def _page_need(self, true_len: int,
+                   cfg: SamplingConfig) -> Tuple[int, int]:
+        """(pad, pages) one request will hold at admission: the prompt
+        padded to its prefill bucket plus the decode budget, in pages.
+        Shared-prefix hits reduce what alloc() must find fresh, never
+        the total the request holds — submit() checks this against
+        pool CAPACITY so a request that could never fit 400s instead
+        of spinning in admission backpressure forever."""
+        pad = max(self._eng._bucketed(true_len), true_len)
+        pad = min(pad, self.max_seq_len - cfg.max_new_tokens)
+        pad = max(pad, true_len)
+        need = 0
+        if self.page_size:
+            need = min(-(-(pad + cfg.max_new_tokens) // self.page_size),
+                       self._pages_per_slot)
+        return pad, need
+
     def _admit(self, slot_idx: int, rid: int, prompt: List[int],
                cfg: SamplingConfig) -> bool:
         """Reserve slot `slot_idx` for request `rid` and start (or
@@ -2163,22 +2497,23 @@ class ContinuousBatchingEngine:
         (admission backpressure: the caller requeues and retries after
         decode frees pages)."""
         true_len = len(prompt)
-        pad = max(self._eng._bucketed(true_len), true_len)
-        pad = min(pad, self.max_seq_len - cfg.max_new_tokens)
-        pad = max(pad, true_len)
+        pad, need = self._page_need(true_len, cfg)
         pages: List[int] = []
         table_row = None
         shared_len = 0
         if self.page_size:
             ps = self.page_size
-            need = min(-(-(pad + cfg.max_new_tokens) // ps),
-                       self._pages_per_slot)
             # Prefix sharing: reuse every already-cached page-aligned
             # prompt page — capped one page short of the prompt's end,
             # because the LAST true token must always prefill (its
             # logits seed decode).
-            shared = self._alloc.lookup_prefix(
-                prompt, max_pages=min((true_len - 1) // ps, need))
+            cap = min((true_len - 1) // ps, need)
+            shared = self._alloc.lookup_prefix(prompt, max_pages=cap)
+            if self._host_cache is not None and len(shared) < cap:
+                # Host-tier extension: pages the device pool
+                # cannibalised (or a fleet peer shipped) rehydrate
+                # into fresh pool pages, skipping their prefill.
+                shared = self._rehydrate_chain(prompt, shared, cap)
             fresh = self._alloc.alloc(need - len(shared))
             if fresh is None:
                 for page in shared:
@@ -2383,8 +2718,28 @@ class ContinuousBatchingEngine:
             eos_id=cfg.eos_id, temperature=cfg.temperature,
             top_k=cfg.top_k, top_p=cfg.top_p, seed=seed,
             pages=pending.pages,
+            # Kept for every slot (not just ngram speculation): live
+            # migration re-ships the prompt ids with the checkpoint.
+            prompt_ids=pending.tokens[0, :pending.true_len].tolist(),
             pre_emitted=1 if pending.handoff else 0)
         self.traces.event(pending.rid, 'prefill_done')
+        if pending.restore is not None:
+            # Migrated slot: apply the checkpointed decode cursor and
+            # resume mid-generation.  Every restored token was already
+            # streamed by the victim replica, so none re-emit; the
+            # next decode step folds (seed, generated) exactly as the
+            # victim's would have — byte-identical continuation.  A
+            # speculating engine's pending token is outputs[-1]
+            # (pending form), so no seed sampling here either.
+            r = pending.restore
+            slot = self._slots[pending.slot_idx]
+            slot.outputs = [int(t) for t in r['outputs']]
+            slot.generated = int(r['generated'])
+            slot.steps = int(r['steps'])
+            slot.pre_emitted = len(slot.outputs)
+            self.traces.event(pending.rid, 'migrate_resume',
+                              generated=slot.generated)
+            return
         if self.role == 'prefill':
             # Disaggregated prefill replica: sample + stream the seed
             # token, serialize the slot into the wire artifact, tear
@@ -2501,7 +2856,9 @@ class ContinuousBatchingEngine:
                 arr[tuple(index)]
         tensors[handoff_lib.LAST_ROW] = np.asarray(
             jax.device_get(pending.last_row), np.float32)
-        blob = handoff_lib.serialize_artifact(meta, tensors)
+        raw_nbytes = sum(int(a.nbytes) for a in tensors.values())
+        blob = handoff_lib.serialize_artifact(
+            meta, tensors, compress=self._handoff_compress)
         n_pages = len(slot.pages)
         self._release_slot_pages(slot.pages, slot_idx)
         self._slots[slot_idx] = None
@@ -2538,7 +2895,10 @@ class ContinuousBatchingEngine:
                 self._handoff_met['handoffs'].labels(
                     side='export').inc()
                 self._handoff_met['export_seconds'].observe(dt)
-                self._handoff_met['bytes'].observe(len(blob))
+                self._handoff_met['bytes'].labels(
+                    form='wire').observe(len(blob))
+                self._handoff_met['bytes'].labels(
+                    form='raw').observe(raw_nbytes)
         self._met.inflight.set(self.traces.inflight_count)
 
     def take_handoff(self, request_id: int) -> Optional[bytes]:
@@ -2549,6 +2909,165 @@ class ContinuousBatchingEngine:
         whether to relay.  Thread-safe."""
         with self._submit_lock:
             return self._handoffs.pop(request_id, None)
+
+    # -- live migration (drain / preemption) --------------------------
+
+    def _ensure_migration_metrics(self) -> Dict[str, Any]:
+        if self._migration_met is None:
+            self._migration_met = _migration_metrics(self.registry)
+        return self._migration_met
+
+    def can_migrate_out(self) -> bool:
+        """Whether this engine's in-flight slots are checkpointable:
+        paged cache (page ids ARE the KV addressing) and no draft
+        model (a draft's private cache cannot be rebuilt
+        mid-generation on the survivor)."""
+        return bool(self.page_size) and self._draft is None
+
+    def request_migrate_out(self) -> None:
+        """Arm live migration: the scheduler's next step() checkpoints
+        every occupied decode slot into a kind='slot' SKHO artifact,
+        parks it for take_handoff(), and ends the local stream — the
+        server relays each artifact to a survivor replica whose
+        /handoff admission resumes it mid-generation.  Thread-safe
+        (called from the server's drain handler); a non-migratable
+        engine (contiguous cache, draft model) ignores the request
+        and drains the classic way — by finishing locally."""
+        if self.can_migrate_out():
+            self._migrate_requested = True
+
+    def _migrate_out_all(self) -> None:
+        """Checkpoint every occupied slot (scheduler thread).  Slots
+        still mid-prefill are left to finish locally — their KV is
+        in a private batch-1 cache, not pool pages, and the drain
+        window runs them to completion the classic way.  A failure
+        checkpointing one slot keeps that slot decoding locally
+        rather than killing its stream."""
+        self._pipeline_join()
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            try:
+                self._migrate_slot(i)
+            except failures.SharedStateError:
+                raise
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(
+                    f'request {s.request_id}: migrate-out failed, '
+                    f'continuing locally ({e!r})')
+
+    def _migrate_slot(self, slot_idx: int) -> None:
+        """Checkpoint ONE live slot into a kind='slot' artifact and
+        tear it down, exactly as _handoff_export tears down a
+        finished prefill: pages released (their prompt-prefix entries
+        stay reclaimable for later prompts), blob parked for the
+        server to relay, local stream ended.
+
+        Checkpoint forms: a plain engine ships kv_len = pad +
+        generated positions and the real last-logits row (the next
+        token samples from it on the survivor with the same
+        (seed, generated) fold).  A speculating engine holds the
+        PENDING token's KV out of cache, so it ships kv_len = pad +
+        generated - 1 and a zeros last row — the survivor's verify
+        step re-feeds outputs[-1] as t_pend and samples in-graph."""
+        from skypilot_tpu.infer import handoff as handoff_lib
+        s = self._slots[slot_idx]
+        rid = s.request_id
+        t0 = time.perf_counter()
+        pending_form = bool(self.spec_k)
+        kv_len = s.pad_len + s.generated - (1 if pending_form else 0)
+        n_used = -(-kv_len // self.page_size)
+        table_row = np.zeros((self._pages_per_slot,), np.int32)
+        table_row[:len(s.pages)] = s.pages
+        # Stage the slot's pool pages into a contiguous batch-1 cache
+        # with the SAME gather the prefix-hit path uses (traced page
+        # count — no per-slot recompile), then slice the live extent
+        # on host.  self._cache is read, not donated.
+        cache1 = self._hydrate1(
+            self._fresh_cache1(), self._cache, jnp.asarray(table_row),
+            jnp.int32(n_used), jnp.int32(kv_len))
+        tensors: Dict[str, np.ndarray] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                cache1)[0]:
+            names = _path_names(path)
+            if names[-1] not in handoff_lib.KV_LEAF_NAMES:
+                continue
+            arr = np.asarray(jax.device_get(leaf))
+            index = [slice(None)] * arr.ndim
+            index[arr.ndim - 2] = slice(0, kv_len)
+            tensors['/'.join(str(n) for n in names)] = \
+                arr[tuple(index)]
+        if pending_form:
+            last_row = np.zeros((self.config.vocab_size,), np.float32)
+        else:
+            last_row = np.asarray(
+                jax.device_get(self._last[slot_idx]), np.float32)
+        tensors[handoff_lib.LAST_ROW] = last_row
+        trace = self.traces.get(rid)
+        meta = {
+            'kind': handoff_lib.KIND_SLOT,
+            'model': self._model_name,
+            'kv_cache_dtype': self.kv_cache_dtype,
+            'page_size': self.page_size,
+            'max_seq_len': self.max_seq_len,
+            'true_len': s.prompt_len,
+            'pad': s.pad_len,
+            'prompt_ids': list(s.prompt_ids or []),
+            'seed': s.seed,
+            'seed_token': (s.outputs[0] if s.outputs else -1),
+            'sampling': {
+                'max_new_tokens': s.max_new,
+                'temperature': s.temperature,
+                'top_k': s.top_k,
+                'top_p': s.top_p,
+                'eos_id': s.eos_id,
+            },
+            'kv_len': kv_len,
+            'generated': s.generated,
+            'outputs': list(s.outputs),
+            'steps': s.steps,
+            'pending_form': pending_form,
+            'http_request_id': (trace.http_request_id
+                                if trace is not None else None),
+            'trace_parent': (trace.trace_parent
+                             if trace is not None else None),
+        }
+        raw_nbytes = sum(int(a.nbytes) for a in tensors.values())
+        blob = handoff_lib.serialize_artifact(
+            meta, tensors, compress=self._handoff_compress)
+        self._release_slot_pages(s.pages, slot_idx)
+        self._slots[slot_idx] = None
+        with self._submit_lock:
+            was_canceled = rid in self._canceled
+            if was_canceled:
+                self._canceled.discard(rid)
+                event = None
+                q = None
+            else:
+                self._results[rid] = s.outputs
+                self._handoffs[rid] = blob
+                event = self._events.get(rid)
+                q = self._stream_queues.get(rid)
+            self._deadlines.pop(rid, None)
+        if q is not None:
+            q.put(self._STREAM_END)
+        if event is not None:
+            event.set()
+        dt = time.perf_counter() - t0
+        self.traces.event(rid, 'migrate_export', bytes=len(blob),
+                          generated=s.generated, seconds=dt)
+        self.traces.finish(
+            rid, 'cancelled' if was_canceled else 'migrated',
+            output_tokens=len(s.outputs), decode_steps=s.steps)
+        if was_canceled:
+            self._met.cancelled.inc()
+        else:
+            met = self._ensure_migration_metrics()
+            met['migrations'].labels(side='out').inc()
+            met['export_seconds'].observe(dt)
+            met['bytes'].labels(form='wire').observe(len(blob))
+            met['bytes'].labels(form='raw').observe(raw_nbytes)
+        self._met.inflight.set(self.traces.inflight_count)
 
     def admit_handoff(self, blob: bytes,
                       stream: bool = False,
@@ -2612,6 +3131,10 @@ class ContinuousBatchingEngine:
             return handoff_lib.HandoffFormatError(
                 f'handoff artifact incompatible: {msg}')
 
+        kind = meta.get('kind', handoff_lib.KIND_PREFILL)
+        if kind == handoff_lib.KIND_KV_PREFIX:
+            raise _bad('kv_prefix artifacts are served over '
+                       'GET /kv_prefix, not POST /handoff')
         if meta['model'] != self._model_name:
             raise _bad(f"model {meta['model']!r} != {self._model_name!r}")
         if meta['kv_cache_dtype'] != self.kv_cache_dtype:
@@ -2634,6 +3157,41 @@ class ContinuousBatchingEngine:
         if len(meta['prompt_ids']) != true_len:
             raise _bad(f"prompt_ids length {len(meta['prompt_ids'])} "
                        f'!= true_len {true_len}')
+        extent = true_len
+        if kind == handoff_lib.KIND_SLOT:
+            # Migrated mid-generation slot: the shipped KV covers
+            # kv_len positions (prompt + pad gap + generated tokens)
+            # and the checkpoint form must match this engine's
+            # stepping mode — a plain engine cannot hold a
+            # speculation-pending token out of cache, and vice versa.
+            if not self.page_size:
+                raise _bad('slot migration requires a paged KV cache')
+            if self._draft is not None:
+                raise _bad('draft-model engines do not admit migrated '
+                           'slots (the draft cache cannot be rebuilt '
+                           'mid-generation)')
+            pending_form = bool(meta['pending_form'])
+            if pending_form != bool(self.spec_k):
+                raise _bad(
+                    f'checkpoint pending_form={pending_form} does not '
+                    f'match this engine (spec_k={self.spec_k}) — '
+                    f'migrate between like-stepping replicas')
+            generated = int(meta['generated'])
+            kv_len = int(meta['kv_len'])
+            outputs = meta['outputs']
+            if not isinstance(outputs, list) \
+                    or len(outputs) != generated:
+                raise _bad(f'outputs length != generated {generated}')
+            if pending_form and generated < 1:
+                raise _bad('pending-form checkpoint with no pending '
+                           'token (generated must be >= 1)')
+            if generated < 0 or generated >= max_new:
+                raise _bad(f'generated {generated} outside '
+                           f'[0, max_new_tokens={max_new})')
+            if kv_len != pad + generated - (1 if pending_form else 0):
+                raise _bad(f'kv_len {kv_len} inconsistent with pad '
+                           f'{pad} + generated {generated}')
+            extent = kv_len
         for path, leaf in jax.tree_util.tree_flatten_with_path(
                 self._abstract_cache1)[0]:
             names = _path_names(path)
@@ -2644,7 +3202,7 @@ class ContinuousBatchingEngine:
             if src is None:
                 raise _bad(f'missing cache leaf {key!r}')
             want = list(leaf.shape)
-            want[len(want) - 2] = true_len
+            want[len(want) - 2] = extent
             if list(src.shape) != want:
                 raise _bad(f'leaf {key!r} shape {list(src.shape)} != '
                            f'{want}')
@@ -2765,6 +3323,10 @@ class ContinuousBatchingEngine:
             seed=int(meta['seed']))
         true_len = int(meta['true_len'])
         pad = int(meta['pad'])
+        is_slot = meta.get('kind') == handoff_lib.KIND_SLOT
+        # Slot checkpoints ship kv_len positions of KV (prompt + pad
+        # gap + generated); prefill artifacts ship the prompt only.
+        extent = int(meta['kv_len']) if is_slot else true_len
         prompt = [int(t) for t in meta['prompt_ids']]
         pages: List[int] = []
         table_row = None
@@ -2799,8 +3361,14 @@ class ContinuousBatchingEngine:
         tokens[0, :true_len] = prompt
         mask_row = np.zeros((self.max_seq_len,), bool)
         mask_row[:true_len] = True
+        if is_slot:
+            # Reveal the checkpoint's generated-token KV as well —
+            # decode positions live at [pad, pad + generated), and a
+            # pending-form checkpoint holds the pending token's KV
+            # out of cache (kv_len = pad + generated - 1).
+            mask_row[pad:extent] = True
         try:
-            cache1 = self._handoff_cache1(tensors, true_len)
+            cache1 = self._handoff_cache1(tensors, extent)
             last_row = jnp.asarray(np.ascontiguousarray(
                 tensors[handoff_lib.LAST_ROW]))
         except BaseException:
@@ -2812,7 +3380,11 @@ class ContinuousBatchingEngine:
             slot_idx=slot_idx, rid=rid, cfg=cfg, true_len=true_len,
             pad=pad, tokens=tokens, mask_row=mask_row, cache1=cache1,
             done=pad, last_row=last_row, pages=pages,
-            table_row=table_row, shared_len=shared_len, handoff=True)
+            table_row=table_row, shared_len=shared_len, handoff=True,
+            restore=({'generated': int(meta['generated']),
+                      'outputs': meta['outputs'],
+                      'steps': int(meta['steps'])}
+                     if is_slot else None))
         self.traces.event(rid, 'admitted',
                           shared_prefix_tokens=shared_len)
         self.traces.event(rid, 'handoff_admitted',
@@ -2823,7 +3395,12 @@ class ContinuousBatchingEngine:
         self._prefills.append(pending)
         self._finish_prefill(pending)
         self._prefills.pop()
-        if self._handoff_met is not None:
+        if is_slot:
+            met = self._ensure_migration_metrics()
+            met['migrations'].labels(side='in').inc()
+            met['admit_seconds'].observe(
+                time.perf_counter() - t_accept)
+        elif self._handoff_met is not None:
             self._handoff_met['handoffs'].labels(side='admit').inc()
             self._handoff_met['admit_seconds'].observe(
                 time.perf_counter() - t_accept)
@@ -2932,6 +3509,12 @@ class ContinuousBatchingEngine:
         ctx = self.mesh if self.mesh is not None \
             else contextlib.nullcontext()
         with ctx:
+            if self._migrate_requested:
+                # Drain-time live migration: checkpoint every occupied
+                # slot out BEFORE this tick decodes — the scheduler
+                # thread owns all slot/cache/allocator state here.
+                self._migrate_requested = False
+                self._migrate_out_all()
             if self.async_pipeline:
                 return self._step_async()
             return self._step_sync()
@@ -3773,7 +4356,8 @@ class ContinuousBatchingEngine:
             pad_len=pending.pad, max_new=cfg.max_new_tokens,
             eos_id=cfg.eos_id, temperature=cfg.temperature,
             top_k=cfg.top_k, top_p=cfg.top_p, seed=pending.seed,
-            pages=pending.pages)
+            pages=pending.pages,
+            prompt_ids=pending.tokens[0, :pending.true_len].tolist())
         if self.page_size:
             self._alloc.register_prefix(
                 pending.tokens[0, :pending.true_len].tolist(),
@@ -3852,6 +4436,43 @@ class ContinuousBatchingEngine:
             if cann > self._cannibalized_seen:
                 m.cannibalized.inc(cann - self._cannibalized_seen)
                 self._cannibalized_seen = cann
+            if self._fleet_met is not None:
+                # Same diff pattern as cannibalized: lifetime counters
+                # read lock-free (plain int reads; the host tier's
+                # writers hold its lock, we only ever under-read).
+                fm = self._fleet_met
+                hc = self._host_cache
+                if self._alloc.spilled_total > self._spilled_seen:
+                    fm['spilled_pages'].inc(
+                        self._alloc.spilled_total - self._spilled_seen)
+                    self._spilled_seen = self._alloc.spilled_total
+                if self._spilled_bytes > self._spilled_bytes_seen:
+                    fm['spilled_bytes'].inc(
+                        self._spilled_bytes - self._spilled_bytes_seen)
+                    self._spilled_bytes_seen = self._spilled_bytes
+                if self._rehydrated_pages > self._rehydrated_seen:
+                    fm['rehydrated_pages'].inc(
+                        self._rehydrated_pages - self._rehydrated_seen)
+                    self._rehydrated_seen = self._rehydrated_pages
+                if self._saved_tokens > self._saved_seen:
+                    fm['saved_tokens'].inc(
+                        self._saved_tokens - self._saved_seen)
+                    self._saved_seen = self._saved_tokens
+                if hc.hits_total > self._fleet_hits_seen:
+                    fm['hits'].inc(hc.hits_total
+                                   - self._fleet_hits_seen)
+                    self._fleet_hits_seen = hc.hits_total
+                if hc.misses_total > self._fleet_misses_seen:
+                    fm['misses'].inc(hc.misses_total
+                                     - self._fleet_misses_seen)
+                    self._fleet_misses_seen = hc.misses_total
+                if hc.evicted_pages_total > self._fleet_evicted_seen:
+                    fm['evicted_pages'].inc(
+                        hc.evicted_pages_total
+                        - self._fleet_evicted_seen)
+                    self._fleet_evicted_seen = hc.evicted_pages_total
+                fm['stored_bytes'].set(hc.stored_bytes)
+                fm['stored_pages'].set(hc.stored_pages)
 
     def run_until_idle(self) -> None:
         while self.step():

@@ -1,0 +1,225 @@
+"""Host-RAM prefix-page tier + fleet peer fetch for the paged KV cache.
+
+The device page pool is small and hot: under memory pressure the
+allocator cannibalises reclaimable prefix pages and their KV is gone —
+the next request paying a full re-prefill for tokens the fleet already
+computed.  This module adds the two cheaper tiers in between:
+
+- **Host tier** (`HostPrefixCache`): a bounded LRU of spilled pages in
+  host RAM, keyed by the allocator's chain hashes.  The allocator's
+  spill hook copies a page here right before its device copy is
+  cannibalised (or prefers victims that already have a copy); a later
+  prefix hit rehydrates the device page from host RAM in microseconds
+  instead of re-running prefill.
+- **Fleet tier** (`fetch_prefix_from_peer`): a replica that misses
+  locally asks the rendezvous-hash OWNER of the prefix (the router
+  names it in the `X-Skytpu-Prefix-Peer` header) for its spilled pages
+  over `GET /kv_prefix`, shipped in the SKHO kv_prefix framing.  The
+  fetched pages land in the LOCAL host tier, and the single
+  rehydration path in the engine does the rest — scale-up replicas
+  warm from survivors instead of from zero.
+
+Thread-safety: unlike the allocator (single scheduler thread), this
+cache is touched from HTTP handler threads too — `/kv_prefix` serves
+from it and peer fetches populate it — so it owns exactly one lock,
+held only around dict/byte bookkeeping, never across a device or
+network call (flat lock hierarchy; see docs/architecture.md).
+
+numpy + stdlib only; no jax import.  The engine hands us host arrays
+(already device_get'd) and uploads them back itself.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from skypilot_tpu.infer import handoff
+
+# Default peer-fetch deadline.  A prefix fetch is an optimisation —
+# losing the race must never stall admission longer than a short
+# prefill would have.
+FETCH_TIMEOUT_S = 5.0
+
+
+class HostPrefixCache:
+    """Bounded LRU of spilled KV pages in host RAM.
+
+    One entry per chain hash: a dict of pool-leaf name (e.g.
+    'page_key', 'page_value_scale') -> that page's host array.  Entry
+    size is the sum of leaf nbytes; inserting past `max_bytes` evicts
+    least-recently-USED entries (get() refreshes recency, has() does
+    not — the allocator's victim scan must not perturb LRU order).
+    """
+
+    def __init__(self, max_bytes: int):
+        if max_bytes <= 0:
+            raise ValueError(f'max_bytes must be > 0, got {max_bytes}')
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._pages: 'collections.OrderedDict[int, Dict[str, np.ndarray]]' \
+            = collections.OrderedDict()
+        self._nbytes: Dict[int, int] = {}
+        self._bytes = 0
+        # Lifetime counters; the engine's telemetry publisher diffs
+        # them per step into the skytpu_fleet_cache_* series.
+        self.hits_total = 0
+        self.misses_total = 0
+        self.inserted_pages_total = 0
+        self.inserted_bytes_total = 0
+        self.evicted_pages_total = 0
+
+    @staticmethod
+    def _entry_bytes(leaves: Dict[str, np.ndarray]) -> int:
+        return sum(int(a.nbytes) for a in leaves.values())
+
+    # Lock-free reads for the engine's per-step telemetry publisher
+    # (torn reads are fine — gauges re-converge next step; taking the
+    # lock on the decode hot path is not).
+    @property
+    def stored_bytes(self) -> int:
+        return self._bytes
+
+    @property
+    def stored_pages(self) -> int:
+        return len(self._pages)
+
+    def put(self, h: int, leaves: Dict[str, np.ndarray]) -> bool:
+        """Store one page's leaves under chain hash `h` (arrays are
+        kept by reference — callers hand over host copies they no
+        longer mutate).  Returns False when the single page exceeds the
+        whole budget (nothing stored); otherwise evicts LRU entries
+        until it fits."""
+        size = self._entry_bytes(leaves)
+        if size > self.max_bytes:
+            return False
+        with self._lock:
+            old = self._nbytes.pop(h, None)
+            if old is not None:
+                del self._pages[h]
+                self._bytes -= old
+            while self._bytes + size > self.max_bytes and self._pages:
+                victim, _ = self._pages.popitem(last=False)
+                self._bytes -= self._nbytes.pop(victim)
+                self.evicted_pages_total += 1
+            self._pages[h] = leaves
+            self._nbytes[h] = size
+            self._bytes += size
+            self.inserted_pages_total += 1
+            self.inserted_bytes_total += size
+        return True
+
+    def get(self, h: int) -> Optional[Dict[str, np.ndarray]]:
+        """The page's leaves, refreshing LRU recency; None on miss."""
+        with self._lock:
+            leaves = self._pages.get(h)
+            if leaves is None:
+                self.misses_total += 1
+                return None
+            self._pages.move_to_end(h)
+            self.hits_total += 1
+            return leaves
+
+    def has(self, h: int) -> bool:
+        """Presence check WITHOUT touching LRU order or counters —
+        the allocator's victim scan calls this per candidate."""
+        with self._lock:
+            return h in self._pages
+
+    def discard(self, h: int) -> None:
+        with self._lock:
+            size = self._nbytes.pop(h, None)
+            if size is not None:
+                del self._pages[h]
+                self._bytes -= size
+
+    def snapshot_run(self, hashes: Sequence[int]
+                     ) -> Tuple[List[int],
+                                List[Dict[str, np.ndarray]]]:
+        """Longest leading run of `hashes` present, as parallel
+        (hashes, leaf-dicts) lists — what `GET /kv_prefix` serves.
+        Stops at the first miss because a chain's later pages are
+        useless without the earlier ones."""
+        served_h: List[int] = []
+        served_p: List[Dict[str, np.ndarray]] = []
+        with self._lock:
+            for h in hashes:
+                leaves = self._pages.get(h)
+                if leaves is None:
+                    break
+                self._pages.move_to_end(h)
+                served_h.append(int(h))
+                served_p.append(leaves)
+            self.hits_total += len(served_h)
+            if len(served_h) < len(hashes):
+                self.misses_total += 1
+        return served_h, served_p
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                'stored_pages': len(self._pages),
+                'stored_bytes': self._bytes,
+                'max_bytes': self.max_bytes,
+                'hits_total': self.hits_total,
+                'misses_total': self.misses_total,
+                'inserted_pages_total': self.inserted_pages_total,
+                'inserted_bytes_total': self.inserted_bytes_total,
+                'evicted_pages_total': self.evicted_pages_total,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._pages.clear()
+            self._nbytes.clear()
+            self._bytes = 0
+
+
+def fetch_prefix_from_peer(peer_url: str, hashes: Sequence[int],
+                           model: str, kv_cache_dtype: str,
+                           page_size: int,
+                           timeout: float = FETCH_TIMEOUT_S
+                           ) -> List[Tuple[int, Dict[str, np.ndarray]]]:
+    """Ask `peer_url`'s `GET /kv_prefix` for the leading run of
+    `hashes` it holds in its host tier.  Returns [(hash, leaves)...]
+    in chain order ([] on any failure — peer down, version skew,
+    geometry mismatch: a fleet-tier miss is always survivable, the
+    caller just prefills).  The arrays are copies (the response buffer
+    is ours), safe to stash in a HostPrefixCache."""
+    if not hashes:
+        return []
+    query = urllib.parse.urlencode({
+        'hashes': ','.join(str(int(h)) for h in hashes),
+    })
+    url = f'{peer_url.rstrip("/")}/kv_prefix?{query}'
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            blob = resp.read()
+        meta, tensors = handoff.deserialize_artifact(blob)
+    except (urllib.error.URLError, OSError, TimeoutError,
+            handoff.HandoffError):
+        return []
+    if meta.get('kind') != handoff.KIND_KV_PREFIX:
+        return []
+    if meta.get('model') != model \
+            or meta.get('kv_cache_dtype') != kv_cache_dtype \
+            or int(meta.get('page_size', -1)) != page_size:
+        return []
+    out: List[Tuple[int, Dict[str, np.ndarray]]] = []
+    want = [int(h) for h in hashes]
+    try:
+        pages = handoff.split_kv_prefix(meta, tensors)
+    except handoff.HandoffError:
+        return []
+    for i, (h, leaves) in enumerate(pages):
+        # Trust only the leading run that matches what we asked for.
+        if i >= len(want) or h != want[i] or not leaves:
+            break
+        out.append((h, {name: np.array(arr, copy=True)
+                        for name, arr in leaves.items()}))
+    return out

@@ -1,4 +1,5 @@
-"""KV-handoff artifact: the wire format for disaggregated serving.
+"""KV-handoff artifact: the wire format for disaggregated serving,
+fleet prefix-cache transfer, and live slot migration.
 
 A PREFILL-role replica runs a prompt's chunked prefill at full batch
 width, then hands the request to a DECODE-role replica as this
@@ -18,8 +19,23 @@ rewriting a refcounted page.  Only the contiguous `[.., :true_len, ..]`
 slice of the batch-1 prefill cache crosses the wire; the padded tail
 is masked forever on both sides and never ships.
 
+Version 2 generalizes the format along two axes:
+
+- ``kind`` (header field, default ``'prefill'``) names what the
+  artifact carries.  ``'slot'`` is a LIVE mid-generation decode slot
+  checkpointed for migration: the shipped KV covers ``kv_len``
+  positions (prompt + garbage pad gap + generated tokens) and the
+  header adds the full decode cursor/sampler restart state
+  (``generated``, ``outputs``, ``steps``, ``pending_form``).
+  ``'kv_prefix'`` is a fleet prefix-cache transfer: spilled host-RAM
+  pool pages keyed by their chain hashes, no sampler state at all.
+- an optional zlib-compressed tensor section (stdlib-only): the
+  header's ``compressed: 'zlib'`` + ``raw_nbytes`` announce it, and
+  the tensor directory's offsets index the DECOMPRESSED payload.
+
 Wire layout (versioned; `HandoffVersionError` on mismatch so a mixed
-fleet mid-rollout fails closed):
+fleet mid-rollout fails closed — v1 readers reject v2 artifacts and
+vice versa, both as HTTP 409):
 
     magic 'SKHO' | u16 version | u32 header_len | header JSON | tensors
 
@@ -29,24 +45,25 @@ before any allocation), resolved sampling state, prompt token ids
 ``{name, dtype, shape, offset, nbytes}`` entries into the raw
 little-endian tensor payload that follows.
 
-ROADMAP item 2 (live KV migration, fleet-wide prefix cache) reuses
-this format verbatim — it is deliberately engine-agnostic: numpy +
-stdlib only (ml_dtypes supplies the bfloat16 wire dtype; it ships
-with jax), no jax import, so the router and tests can load it
-without touching a device runtime.
+Deliberately engine-agnostic: numpy + stdlib only (ml_dtypes supplies
+the bfloat16 wire dtype; it ships with jax), no jax import, so the
+router and tests can load it without touching a device runtime.
 """
 from __future__ import annotations
 
 import json
 import struct
-from typing import Any, Dict, List, Sequence, Tuple
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 # 'SKHO' = SKytpu HandOff.  Bump VERSION on ANY layout or semantics
 # change — the receiver rejects other versions instead of guessing.
+# v2: artifact kinds (slot migration, fleet kv_prefix transfer) and
+# the optional zlib tensor section.
 MAGIC = b'SKHO'
-VERSION = 1
+VERSION = 2
 
 # Router -> prefill-replica header naming the decode replica that the
 # rendezvous hash picked for this request; the prefill replica POSTs
@@ -54,7 +71,20 @@ VERSION = 1
 # router can import it without dragging in a device runtime.
 DECODE_TARGET_HEADER = 'X-Skytpu-Decode-Target'
 
+# Router -> replica header naming the replica that the rendezvous hash
+# says OWNS this request's prefix-affinity key.  A replica that was
+# chosen by saturation fallback (not the owner) can ask the owner's
+# GET /kv_prefix for spilled prefix pages before prefilling from zero.
+PREFIX_PEER_HEADER = 'X-Skytpu-Prefix-Peer'
+
 _PREAMBLE = struct.Struct('>4sHI')
+
+# What the artifact carries (header `kind`; absent == 'prefill' so v2
+# prefill artifacts stay self-describing).
+KIND_PREFILL = 'prefill'
+KIND_SLOT = 'slot'
+KIND_KV_PREFIX = 'kv_prefix'
+KINDS = (KIND_PREFILL, KIND_SLOT, KIND_KV_PREFIX)
 
 # Batch-1 prefill-cache leaves that ship: K/V plus the sibling scale
 # rows of the int8 cache mode.  Names match models/llama.py's cache
@@ -72,6 +102,17 @@ _REQUIRED_META = ('model', 'kv_cache_dtype', 'page_size',
                   'seed', 'seed_token', 'sampling')
 _REQUIRED_SAMPLING = ('max_new_tokens', 'temperature', 'top_k',
                       'top_p', 'eos_id')
+# kind='slot' additions: the decode restart state.  kv_len is the
+# shipped KV extent (pad + generated, minus one in pending form —
+# speculating engines hold the pending token's KV OUT of cache);
+# pending_form says which convention the sender used, and the
+# receiver refuses a form its own stepping mode cannot resume.
+_REQUIRED_SLOT = ('kv_len', 'generated', 'outputs', 'steps',
+                  'pending_form')
+# kind='kv_prefix' carries no sampler state: just enough geometry for
+# the receiver to trust the pages, plus the chain hashes keying them.
+_REQUIRED_KV_PREFIX = ('model', 'kv_cache_dtype', 'page_size',
+                       'hashes')
 
 
 class HandoffError(ValueError):
@@ -103,13 +144,28 @@ def _dtype_from_name(name: str) -> np.dtype:
             f'unknown tensor dtype {name!r} in handoff artifact') from e
 
 
+def _required_fields(kind: str) -> Tuple[str, ...]:
+    if kind == KIND_KV_PREFIX:
+        return _REQUIRED_KV_PREFIX
+    if kind == KIND_SLOT:
+        return _REQUIRED_META + _REQUIRED_SLOT
+    return _REQUIRED_META
+
+
 def serialize_artifact(meta: Dict[str, Any],
-                       tensors: Dict[str, np.ndarray]) -> bytes:
-    """Render one handoff artifact.  `meta` must carry the
-    `_REQUIRED_META` fields; `tensors` maps leaf names (cache pytree
-    path joined with '/', plus 'last_row') to host arrays.  Iteration
-    order of `tensors` is the payload order."""
-    for key in _REQUIRED_META:
+                       tensors: Dict[str, np.ndarray],
+                       compress: bool = False) -> bytes:
+    """Render one handoff artifact.  `meta` must carry the required
+    fields for its `kind` (absent kind == 'prefill'); `tensors` maps
+    leaf names (cache pytree path joined with '/', plus 'last_row') to
+    host arrays.  Iteration order of `tensors` is the payload order.
+    With `compress`, the tensor payload ships zlib-deflated and the
+    header announces it (v1 readers never see this far — the version
+    check fails closed first)."""
+    kind = meta.get('kind', KIND_PREFILL)
+    if kind not in KINDS:
+        raise HandoffFormatError(f'unknown artifact kind {kind!r}')
+    for key in _required_fields(kind):
         if key not in meta:
             raise HandoffFormatError(
                 f'handoff meta missing required field {key!r}')
@@ -130,17 +186,34 @@ def serialize_artifact(meta: Dict[str, Any],
         chunks.append(raw)
         offset += len(raw)
     header['tensors'] = directory
+    payload = b''.join(chunks)
+    if compress:
+        header['compressed'] = 'zlib'
+        header['raw_nbytes'] = offset
+        payload = zlib.compress(payload)
     header_raw = json.dumps(header, separators=(',', ':')).encode()
     return b''.join([_PREAMBLE.pack(MAGIC, VERSION, len(header_raw)),
-                     header_raw] + chunks)
+                     header_raw, payload])
+
+
+def raw_payload_nbytes(meta: Dict[str, Any]) -> int:
+    """Uncompressed tensor-payload size of a (de)serialized artifact's
+    header — the `raw_nbytes` announcement when compressed, else the
+    directory sum.  Feeds the compressed-vs-raw bytes metrics/bench
+    reporting without a second serialization pass."""
+    if 'raw_nbytes' in meta:
+        return int(meta['raw_nbytes'])
+    return sum(int(e.get('nbytes', 0))
+               for e in meta.get('tensors', ()) or ())
 
 
 def deserialize_artifact(blob: bytes
                          ) -> Tuple[Dict[str, Any],
                                     Dict[str, np.ndarray]]:
     """Parse one artifact -> (meta, {name: array}).  Arrays are
-    zero-copy views into `blob` (read-only); callers that mutate must
-    copy.  Raises HandoffVersionError on a version mismatch and
+    zero-copy views into `blob` (read-only; into the decompressed
+    buffer for a zlib artifact); callers that mutate must copy.
+    Raises HandoffVersionError on a version mismatch and
     HandoffFormatError on anything malformed — both BEFORE any
     allocation-sized work, so a hostile or stale artifact costs the
     receiver one header parse."""
@@ -165,22 +238,52 @@ def deserialize_artifact(blob: bytes
             f'handoff header is not valid JSON: {e}') from e
     if not isinstance(meta, dict):
         raise HandoffFormatError('handoff header must be a JSON object')
-    for key in _REQUIRED_META:
+    kind = meta.get('kind', KIND_PREFILL)
+    if kind not in KINDS:
+        raise HandoffFormatError(f'unknown artifact kind {kind!r}')
+    for key in _required_fields(kind):
         if key not in meta:
             raise HandoffFormatError(
                 f'handoff header missing required field {key!r}')
-    sampling = meta['sampling']
-    if not isinstance(sampling, dict):
-        raise HandoffFormatError('handoff sampling must be an object')
-    for key in _REQUIRED_SAMPLING:
-        if key not in sampling:
+    if kind != KIND_KV_PREFIX:
+        sampling = meta['sampling']
+        if not isinstance(sampling, dict):
             raise HandoffFormatError(
-                f'handoff sampling missing required field {key!r}')
+                'handoff sampling must be an object')
+        for key in _REQUIRED_SAMPLING:
+            if key not in sampling:
+                raise HandoffFormatError(
+                    f'handoff sampling missing required field {key!r}')
     directory = meta.get('tensors')
     if not isinstance(directory, list):
         raise HandoffFormatError('handoff header missing tensor '
                                  'directory')
     payload = body + header_len
+    compressed = meta.get('compressed')
+    if compressed is None:
+        buf: Any = blob
+        base = payload
+        limit = len(blob)
+    elif compressed == 'zlib':
+        try:
+            buf = zlib.decompress(blob[payload:])
+        except zlib.error as e:
+            raise HandoffFormatError(
+                f'handoff tensor payload does not inflate: {e}') from e
+        try:
+            want = int(meta['raw_nbytes'])
+        except (KeyError, TypeError, ValueError) as e:
+            raise HandoffFormatError(
+                'compressed handoff header missing raw_nbytes') from e
+        if len(buf) != want:
+            raise HandoffFormatError(
+                f'handoff payload inflated to {len(buf)} bytes, '
+                f'header announced {want}')
+        base = 0
+        limit = len(buf)
+    else:
+        raise HandoffFormatError(
+            f'unknown handoff compression {compressed!r}')
     tensors: Dict[str, np.ndarray] = {}
     for entry in directory:
         try:
@@ -197,12 +300,12 @@ def deserialize_artifact(blob: bytes
             raise HandoffFormatError(
                 f'tensor {name!r}: nbytes {nbytes} != shape/dtype '
                 f'size {expected}')
-        start = payload + offset
-        if offset < 0 or start + nbytes > len(blob):
+        start = base + offset
+        if offset < 0 or start + nbytes > limit:
             raise HandoffFormatError(
                 f'tensor {name!r} extends past the artifact payload')
         tensors[name] = np.frombuffer(
-            blob, dtype=dtype, count=int(np.prod(shape, dtype=np.int64)),
+            buf, dtype=dtype, count=int(np.prod(shape, dtype=np.int64)),
             offset=start).reshape(shape)
     return meta, tensors
 
@@ -218,3 +321,49 @@ def prompt_page_split(prompt_ids: Sequence[int], shared_pages: int,
     prompt_pages = -(-len(prompt_ids) // page_size)
     deduped = min(max(int(shared_pages), 0), prompt_pages)
     return prompt_pages - deduped, deduped
+
+
+def serialize_kv_prefix(model: str, kv_cache_dtype: str,
+                        page_size: int, hashes: Sequence[int],
+                        pages: Sequence[Dict[str, np.ndarray]],
+                        compress: bool = False) -> bytes:
+    """Render a fleet prefix-cache transfer: `pages[i]` maps pool-leaf
+    names to that page's host arrays, keyed by chain hash
+    `hashes[i]`.  Tensor names are ``<leaf>/<i>`` so heterogeneous
+    per-leaf shapes (scanned vs unscanned pools) ship unmodified."""
+    if len(hashes) != len(pages):
+        raise HandoffFormatError(
+            f'{len(hashes)} hashes != {len(pages)} pages')
+    meta = {
+        'kind': KIND_KV_PREFIX,
+        'model': model,
+        'kv_cache_dtype': kv_cache_dtype,
+        'page_size': page_size,
+        'hashes': [int(h) for h in hashes],
+    }
+    tensors: Dict[str, np.ndarray] = {}
+    for i, leaves in enumerate(pages):
+        for name, arr in leaves.items():
+            tensors[f'{name}/{i}'] = arr
+    return serialize_artifact(meta, tensors, compress=compress)
+
+
+def split_kv_prefix(meta: Dict[str, Any],
+                    tensors: Dict[str, np.ndarray]
+                    ) -> List[Tuple[int, Dict[str, np.ndarray]]]:
+    """Invert serialize_kv_prefix on a deserialized artifact:
+    [(chain_hash, {leaf: array}), ...] in shipped order."""
+    hashes = meta.get('hashes') or []
+    pages: List[Dict[str, np.ndarray]] = [dict() for _ in hashes]
+    for key, arr in tensors.items():
+        name, _, idx = key.rpartition('/')
+        try:
+            i = int(idx)
+        except ValueError as e:
+            raise HandoffFormatError(
+                f'kv_prefix tensor {key!r} has no page index') from e
+        if not name or not 0 <= i < len(pages):
+            raise HandoffFormatError(
+                f'kv_prefix tensor {key!r} out of range')
+        pages[i][name] = arr
+    return [(int(h), leaves) for h, leaves in zip(hashes, pages)]

@@ -45,7 +45,7 @@ replicated block table, N pool shards (engine._cache_sharding).
 from __future__ import annotations
 
 import collections
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from skypilot_tpu.utils import chaos
 
@@ -108,8 +108,26 @@ class PageAllocator:
         # Plain int the engine's telemetry publisher diffs per step —
         # this module stays dependency-free (no metrics import).
         self.cannibalized_total = 0
+        # Lifetime count of pages copied to the host-RAM spill tier
+        # before their device copy was cannibalised (same diff
+        # pattern as cannibalized_total).
+        self.spilled_total = 0
+        # Host-RAM spill tier hooks (infer/fleet_cache.py), installed
+        # by the engine when a host cache is configured.  `_spill_fn`
+        # copies a device page's contents to host RAM keyed by its
+        # chain hash; `_has_spill` says whether a hash already has a
+        # host copy.  Unset (the default) leaves every code path in
+        # this class byte-identical to the spill-free allocator.
+        self._spill_fn: Optional[Callable[[int, int], None]] = None
+        self._has_spill: Optional[Callable[[int], bool]] = None
 
     # -- capacity ---------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        """Usable pool size: every page a request could ever hold
+        (page NULL_PAGE is reserved as the block-table sentinel)."""
+        return self.n_pages - 1
 
     @property
     def free_pages(self) -> int:
@@ -140,15 +158,46 @@ class PageAllocator:
             if self._free:
                 page = self._free.pop()
             else:
-                # Cannibalise the least-recently-released prefix page;
-                # its cached prefix is no longer matchable.
-                h, page = self._reclaimable.popitem(last=False)
+                h, page = self._pick_victim()
+                del self._reclaimable[h]
                 del self._prefix_page[h]
                 del self._page_hash[page]
                 self.cannibalized_total += 1
             self._ref[page] = 1
             out.append(page)
         return out
+
+    def _pick_victim(self) -> tuple:
+        """Choose the reclaimable page to cannibalise.
+
+        Preference order: the least-recently-released page that ALREADY
+        has a host-RAM spill copy (its device contents are recoverable,
+        so losing them costs a microsecond rehydrate, not a re-prefill);
+        otherwise the LRU-oldest page, spilled to host RAM first when a
+        spill tier is installed so the prefix stays recoverable.
+        """
+        if self._has_spill is not None:
+            for h, page in self._reclaimable.items():
+                if self._has_spill(h):
+                    return h, page
+        h, page = next(iter(self._reclaimable.items()))
+        if self._spill_fn is not None:
+            self._spill_fn(h, page)
+            self.spilled_total += 1
+        return h, page
+
+    def set_spill_hooks(self,
+                        spill_fn: Optional[Callable[[int, int], None]],
+                        has_spill: Optional[Callable[[int], bool]]
+                        ) -> None:
+        """Install (or clear, with Nones) the host-RAM spill tier.
+        `spill_fn(chain_hash, page)` must synchronously copy the device
+        page's contents to host RAM; `has_spill(chain_hash)` reports an
+        existing host copy.  Called once at engine construction, from
+        the same single scheduler-thread discipline as everything else
+        here."""
+        self._spill_fn = spill_fn
+        self._has_spill = has_spill
 
     def retain(self, page: int) -> None:
         """Add a reference (prefix hit).  Resurrects a reclaimable
@@ -232,6 +281,39 @@ class PageAllocator:
         for page in pages:
             self.retain(page)
         return pages
+
+    def has_prefix(self, h: int) -> bool:
+        """Whether chain hash `h` has a registered device page
+        (referenced or reclaimable).  Advisory — HTTP handler threads
+        use it to skip fleet fetches for locally resident pages; a
+        stale answer costs one redundant fetch, never correctness."""
+        return h in self._prefix_page
+
+    def take_registered(self, h: int) -> Optional[int]:
+        """Retained device page registered under chain hash `h`, or
+        None.  Lets the rehydration walk resume on device-resident
+        pages PAST a host-rehydrated gap — `lookup_prefix` stops at the
+        first miss, but a chain can be device/host interleaved when a
+        middle page was cannibalised."""
+        page = self._prefix_page.get(h)
+        if page is not None:
+            self.retain(page)
+        return page
+
+    def adopt_prefix(self, h: int, page: int) -> bool:
+        """Publish one rehydrated page (freshly alloc'd, contents just
+        restored from the host tier) under its chain hash.  Refcount is
+        untouched — the caller's alloc() reference becomes the slot's
+        reference, and release() parks it back in the reclaimable LRU
+        like any registered prefix page; there is exactly one owner per
+        tier, so cross-tier double-free cannot arise.  Returns False
+        (no-op) if the hash or page is already published."""
+        if page == NULL_PAGE or page in self._page_hash \
+                or h in self._prefix_page:
+            return False
+        self._prefix_page[h] = page
+        self._page_hash[page] = h
+        return True
 
     def register_prefix(self, tokens: Sequence[int],
                         pages: Sequence[int]) -> None:

@@ -23,7 +23,20 @@ http.server, matching the rest of the serve stack (serve/controller.py):
   POST /drain               -> stop admission, finish in-flight work,
                                then shut the server down (graceful
                                replica retirement; /health reports
-                               "draining" while it runs)
+                               "draining" while it runs).  Body
+                               {"migrate": true, "targets": [urls]}
+                               additionally checkpoints every live
+                               decode slot as a SKHO slot artifact and
+                               relays it to a survivor's /handoff, so
+                               in-flight streams finish byte-identical
+                               on the survivor instead of racing the
+                               drain window
+  GET  /kv_prefix?hashes=..  -> fleet prefix-cache tier: the longest
+                               leading run of the comma-separated
+                               chain hashes resident in this replica's
+                               host-RAM spill tier, as a SKHO
+                               kv_prefix artifact (404 when the tier
+                               is off or holds none of the chain)
 
 Failure containment: the decode loop runs SUPERVISED — a transient
 step() failure aborts the in-flight slots, rebuilds the engine's
@@ -94,7 +107,7 @@ _HTTPServer = http_utils.HighBacklogHTTPServer
 # Known routes by method.  Unknown paths collapse to the 'other' route
 # label so a URL-scanning client cannot mint unbounded label sets.
 _GET_ROUTES = ('/health', '/v1/models', '/metrics', '/traces',
-               '/events')
+               '/events', '/kv_prefix')
 _POST_ROUTES = ('/generate', '/v1/completions', '/v1/chat/completions',
                 '/drain', '/handoff')
 
@@ -194,6 +207,7 @@ class InferenceServer:
                  prefill_mix_budget: int = 0,
                  role: str = 'both',
                  decode_peers: Optional[str] = None,
+                 host_cache_bytes: int = 0,
                  ) -> None:
         from skypilot_tpu.parallel import mesh as mesh_lib
         # Hang-proof first backend touch: a wedged tunneled TPU makes
@@ -245,7 +259,8 @@ class InferenceServer:
                 decode_kernel=decode_kernel,
                 prefill_kernel=prefill_kernel,
                 prefill_mix_budget=prefill_mix_budget,
-                role=role)
+                role=role,
+                host_cache_bytes=host_cache_bytes)
         else:
             if decode_kernel != 'auto':
                 raise ValueError(
@@ -267,6 +282,11 @@ class InferenceServer:
                     '--spec-k/--draft-model require continuous '
                     'batching (speculation is a slot-mode decode '
                     'path); drop --no-continuous.')
+            if host_cache_bytes:
+                raise ValueError(
+                    '--host-cache-mb requires continuous batching '
+                    '(the host tier spills paged KV); drop '
+                    '--no-continuous.')
             self.engine = engine_lib.InferenceEngine(
                 model=model, mesh=mesh, checkpoint_dir=checkpoint_dir,
                 max_batch_size=max_batch_size,
@@ -334,6 +354,13 @@ class InferenceServer:
         chaos.add_event_sink(self._record_chaos_event)
         self._draining = False
         self._drain_lock = threading.Lock()
+        # Live migration: survivor replicas a migrate-drain relays slot
+        # artifacts to, and a count of relays in flight so the drain
+        # window outlives every relayed stream (its own lock — flat
+        # hierarchy, never held across another acquire or any I/O).
+        self._migrate_targets: list = []
+        self._relay_lock = threading.Lock()
+        self._active_relays = 0
         self._drain_thread: Optional[threading.Thread] = None
         self._watchdog_thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
@@ -397,6 +424,14 @@ class InferenceServer:
             # ragged-prefill Pallas vs XLA sliced-prefix), the
             # mixed-batch token budget, and pending prompt count.
             detail['prefill_kernel'] = pk()
+        hc = getattr(eng, 'host_cache_stats', None)
+        if hc is not None:
+            stats = hc()
+            if stats is not None:
+                # Fleet prefix-cache tier: host-RAM spill occupancy,
+                # hit/miss/rehydrate counters — what the dashboard's
+                # cache-tier columns and the fleet fetch path key off.
+                detail['fleet_cache'] = stats
         sh = getattr(eng, 'sharding_info', None)
         if sh is not None:
             # Tensor-parallel geometry: mesh axis sizes, how the KV
@@ -569,20 +604,77 @@ class InferenceServer:
                 reason='no_free_pages',
                 retry_after=self._retry_after_s())
 
+    # -- fleet prefix-cache tier --------------------------------------
+    def _prefetch_prefix(self, prompt_ids, peer: Optional[str]) -> None:
+        """Fleet tier: before admission, pull the prompt's missing
+        prefix pages from the rendezvous owner the router named in
+        X-Skytpu-Prefix-Peer into the LOCAL host tier; the engine's
+        rehydration walk then turns them into device pages at
+        admission, skipping their re-prefill.  Best-effort — any miss,
+        timeout, or geometry skew just means a normal prefill — and
+        only the locally absent tail of the chain goes on the wire."""
+        if not peer or not self.continuous:
+            return
+        eng = self.engine
+        ingest = getattr(eng, 'ingest_prefix_pages', None)
+        stats_fn = getattr(eng, 'host_cache_stats', None)
+        if ingest is None or stats_fn is None or stats_fn() is None \
+                or not eng.page_size:
+            return
+        from skypilot_tpu.infer import fleet_cache
+        from skypilot_tpu.infer import paging as paging_lib
+        # Only full pages short of the prompt's last token are ever
+        # shareable (the last true token always prefills locally to
+        # seed decode) — same cap as the engine's admission path.
+        cap = max(0, (len(prompt_ids) - 1) // eng.page_size)
+        hashes = paging_lib.chain_hashes(
+            prompt_ids, eng.page_size)[:cap]
+        hashes = hashes[eng.prefix_resident_run(hashes):]
+        if not hashes:
+            return
+        pages = fleet_cache.fetch_prefix_from_peer(
+            peer, hashes, eng._model_name,  # pylint: disable=protected-access
+            eng.kv_cache_dtype, eng.page_size)
+        if pages:
+            ingest(pages)
+
     # -- graceful drain -----------------------------------------------
-    def begin_drain(self) -> dict:
+    def begin_drain(self, migrate: bool = False,
+                    targets=()) -> dict:
         """Stop admission (everything new sheds with 503), let
         in-flight work finish, then shut the server down.  Idempotent;
-        /health reports "draining" until exit."""
+        /health reports "draining" until exit.
+
+        With ``migrate=True`` and survivor ``targets``, in-flight work
+        does NOT have to finish here: the engine checkpoints every
+        live decode slot into a SKHO slot artifact at its next step,
+        and the request's handler thread relays it to a survivor's
+        /handoff — the client's stream continues byte-identical from
+        the survivor while this replica exits in seconds instead of
+        minutes.  Non-migratable engines (contiguous cache, draft
+        model) quietly fall back to the classic finish-local drain."""
+        migrating = False
+        if migrate:
+            tlist = [str(t).strip().rstrip('/') for t in targets
+                     if str(t).strip()]
+            can = getattr(self.engine, 'can_migrate_out', None)
+            if tlist and can is not None and can():
+                self._migrate_targets = tlist
+                self.engine.request_migrate_out()
+                self._work.set()
+                migrating = True
         with self._drain_lock:
             first = not self._draining
             self._draining = True
         if first:
-            logger.info('drain requested: admission stopped, waiting '
-                        'for in-flight work')
+            logger.info(
+                'drain requested: admission stopped, '
+                + ('migrating live slots to '
+                   f'{len(self._migrate_targets)} survivor(s)'
+                   if migrating else 'waiting for in-flight work'))
             self._set_health('draining')
             self.events.record(
-                'drain_begin',
+                'drain_begin', migrate=migrating,
                 in_flight=self.engine.traces.inflight_count)
             t = threading.Thread(target=self._drain_then_exit,
                                  daemon=True, name='skytpu-drain')
@@ -599,6 +691,16 @@ class InferenceServer:
             done = self.engine.traces.inflight_count == 0
             if done and self.continuous:
                 done = self.engine.is_idle()
+            if done:
+                # Migrated streams outlive their engine request: the
+                # handler thread is still relaying the survivor's
+                # tokens to the client.  Exiting now would cut them
+                # off mid-stream — wait for parked artifacts to be
+                # picked up and every relay to finish.
+                with self._relay_lock:
+                    relays = self._active_relays
+                done = not getattr(self.engine, '_handoffs', None) \
+                    and relays == 0
             if done:
                 break
             time.sleep(0.05)
@@ -617,7 +719,8 @@ class InferenceServer:
     def _handle_generate(self, payload: dict,
                          http_request_id: Optional[str] = None,
                          trace_parent: Optional[str] = None,
-                         decode_target: Optional[str] = None) -> dict:
+                         decode_target: Optional[str] = None,
+                         prefix_peer: Optional[str] = None) -> dict:
         deadline_s = self._deadline_from(payload)
         prompts = payload.get('prompt_ids')
         if not isinstance(prompts, list) or not prompts:
@@ -632,6 +735,9 @@ class InferenceServer:
             seed=(int(payload['seed'])
                   if payload.get('seed') is not None else None))
         self._admission_check(deadline_s, n=len(prompts))
+        if prefix_peer:
+            for p in prompts:
+                self._prefetch_prefix(p, prefix_peer)
         if self.continuous:
             # All-or-nothing: a rejected prompt (e.g. overlong) must
             # not strand its siblings decoding with no reader.
@@ -647,11 +753,10 @@ class InferenceServer:
                 # No explicit timeout: wait() derives it from the
                 # request's own deadline.
                 tokens = [self.engine.wait(r) for r in rids]
-                if self.role == 'prefill':
-                    tokens = [
-                        self._relay_blocking(r, t, decode_target,
-                                             http_request_id)
-                        for r, t in zip(rids, tokens)]
+                tokens = [
+                    self._relay_blocking(r, t, decode_target,
+                                         http_request_id)
+                    for r, t in zip(rids, tokens)]
             except BaseException:
                 for r in rids:
                     self.engine.cancel(r)
@@ -697,6 +802,20 @@ class InferenceServer:
             for tok in self.engine.stream(
                     rid, timeout=self.stream_token_timeout):
                 _line({'token': tok})
+            # Chained migration: if a migrate-drain checkpointed THIS
+            # admitted slot too, relay the artifact onward and keep
+            # streaming — the upstream relay never notices.
+            with self._relay_lock:
+                self._active_relays += 1
+            try:
+                blob = self.engine.take_handoff(rid)
+                if blob is not None:
+                    for tok in self._relay_handoff(
+                            blob, handler.request_id, None):
+                        _line({'token': tok})
+            finally:
+                with self._relay_lock:
+                    self._active_relays -= 1
             _line({'done': True})
         except TimeoutError:
             self.engine.cancel(rid)
@@ -723,17 +842,23 @@ class InferenceServer:
         --decode-peers list; a peer that refuses the CONNECTION (shed,
         down) moves on to the next — the artifact is immutable bytes,
         so resending is safe.  Once tokens flow, failures propagate:
-        replaying a partially-consumed stream would duplicate output."""
+        replaying a partially-consumed stream would duplicate output.
+
+        A migrate-drain's slot artifacts travel the same path: the
+        drain's survivor targets join the candidate list, and the
+        survivor's /handoff resumes the slot mid-generation."""
         targets = []
         if decode_target:
             targets.append(decode_target.rstrip('/'))
         targets.extend(t for t in self._decode_peers
                        if t not in targets)
+        targets.extend(t for t in self._migrate_targets
+                       if t not in targets)
         if not targets:
             raise RuntimeError(
-                'prefill replica has no decode target: the router did '
-                'not stamp ' + handoff_lib.DECODE_TARGET_HEADER +
-                ' and --decode-peers is empty')
+                'no replica to hand off to: the router did not stamp '
+                + handoff_lib.DECODE_TARGET_HEADER + ', --decode-peers '
+                'is empty, and no migrate-drain named survivors')
         last: Optional[BaseException] = None
         for target in targets:
             req = urllib.request.Request(target + '/handoff',
@@ -774,34 +899,46 @@ class InferenceServer:
                     http_request_id: Optional[str] = None
                     ) -> Iterator[int]:
         """Unified per-token stream for one request: the local engine's
-        stream, then — iff this replica runs --role prefill and the
-        engine handed the request off — the decode replica's relayed
-        tail.  Callers cannot tell disaggregated serving from local
-        decode (the seed token comes from the local stream, the rest
-        from the wire)."""
+        stream, then — iff the engine handed the request off (prefill
+        role after its seed token, OR any role whose slot a
+        migrate-drain checkpointed) — the remote replica's relayed
+        tail.  Callers cannot tell disaggregated or migrated serving
+        from local decode (the early tokens come from the local
+        stream, the rest from the wire)."""
         for tok in self.engine.stream(
                 rid, timeout=self.stream_token_timeout):
             yield tok
-        if self.role != 'prefill':
-            return
-        blob = self.engine.take_handoff(rid)
-        if blob is None:
-            return  # finished locally (eos / max_new on the seed token)
-        yield from self._relay_handoff(blob, http_request_id,
-                                       decode_target)
+        # Count the relay BEFORE popping the artifact: between the two,
+        # a drain poll must still see work in flight.
+        with self._relay_lock:
+            self._active_relays += 1
+        try:
+            blob = self.engine.take_handoff(rid)
+            if blob is None:
+                return  # finished locally
+            yield from self._relay_handoff(blob, http_request_id,
+                                           decode_target)
+        finally:
+            with self._relay_lock:
+                self._active_relays -= 1
 
     def _relay_blocking(self, rid: int, toks: list,
                         decode_target: Optional[str],
                         http_request_id: Optional[str]) -> list:
-        """Blocking-route tail of the handoff: append the decode
-        replica's tokens to the prefill replica's seed token."""
-        if self.role != 'prefill':
-            return toks
-        blob = self.engine.take_handoff(rid)
-        if blob is None:
-            return toks
-        return toks + list(self._relay_handoff(blob, http_request_id,
-                                               decode_target))
+        """Blocking-route tail of the handoff: append the remote
+        replica's tokens to the locally produced ones (prefill role's
+        seed token, or a migrated slot's pre-migration output)."""
+        with self._relay_lock:
+            self._active_relays += 1
+        try:
+            blob = self.engine.take_handoff(rid)
+            if blob is None:
+                return toks
+            return toks + list(self._relay_handoff(
+                blob, http_request_id, decode_target))
+        finally:
+            with self._relay_lock:
+                self._active_relays -= 1
 
     # -- OpenAI-compatible surface ------------------------------------
     def _sampling_for(self, req) -> 'engine_lib.SamplingConfig':
@@ -969,6 +1106,8 @@ class InferenceServer:
         # Shed before any work (and before SSE headers go out on the
         # stream path — a 503 must still be expressible).
         self._admission_check(deadline_s)
+        self._prefetch_prefix(prompt_ids,
+                              getattr(handler, 'prefix_peer', None))
         if req.stream:
             if not self.continuous:
                 raise openai_api.OpenAIError(
@@ -1048,6 +1187,11 @@ class InferenceServer:
                 # meaningful on a prefill-role replica).
                 self.decode_target = self.headers.get(
                     handoff_lib.DECODE_TARGET_HEADER)
+                # Rendezvous owner of this prompt's prefix (stamped by
+                # the router when it had to route AWAY from the owner);
+                # admission pre-fetches the prefix pages from it.
+                self.prefix_peer = self.headers.get(
+                    handoff_lib.PREFIX_PEER_HEADER)
                 self._last_code = 0
                 route = self.path.split('?', 1)[0]
                 known = route in _GET_ROUTES or route in _POST_ROUTES
@@ -1137,6 +1281,33 @@ class InferenceServer:
                         limit = 100
                     self._reply(200, {
                         'events': outer.events.snapshot(limit)})
+                elif route == '/kv_prefix':
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    raw = query.get('hashes', [''])[0]
+                    try:
+                        hashes = [int(h) for h in raw.split(',')
+                                  if h.strip()]
+                    except ValueError:
+                        self._reply(400, {
+                            'error': 'hashes must be comma-separated '
+                                     'integers'})
+                        return
+                    blob_fn = getattr(outer.engine, 'kv_prefix_blob',
+                                      None)
+                    blob = blob_fn(hashes) if blob_fn is not None \
+                        and hashes else None
+                    if blob is None:
+                        self._reply(404, {
+                            'error': 'no host-tier pages for this '
+                                     'chain on this replica'})
+                        return
+                    self.send_response(200)
+                    self.send_header('Content-Type',
+                                     'application/octet-stream')
+                    self.send_header('Content-Length', str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
                 elif route in _POST_ROUTES:
                     self._reply(405, {'error': 'method not allowed'},
                                 allow='POST')
@@ -1163,7 +1334,9 @@ class InferenceServer:
                         return
                     payload = json.loads(self.rfile.read(length) or b'{}')
                     if route == '/drain':
-                        self._reply(200, outer.begin_drain())
+                        self._reply(200, outer.begin_drain(
+                            migrate=bool(payload.get('migrate')),
+                            targets=payload.get('targets') or ()))
                         return
                     if route == '/generate':
                         self._reply(200, outer._handle_generate(  # pylint: disable=protected-access
@@ -1434,6 +1607,16 @@ def main() -> None:
                              'cache by page id) and decodes them. '
                              'Greedy output across a handoff is '
                              'bit-identical to --role both.')
+    parser.add_argument('--host-cache-mb', type=int, default=0,
+                        help='Host-RAM prefix-cache tier budget in '
+                             'MiB (0 disables). With --page-size, '
+                             'reclaimable prefix pages the allocator '
+                             'would cannibalise spill here and later '
+                             'prefix hits rehydrate the device page '
+                             'instead of re-prefilling; GET '
+                             '/kv_prefix serves the tier to fleet '
+                             'peers and migrate-drains ride the same '
+                             'machinery.')
     parser.add_argument('--decode-peers', default=None,
                         help='Comma-separated decode-replica base URLs '
                              'a --role prefill replica may hand off '
@@ -1490,6 +1673,7 @@ def main() -> None:
                     async_pipeline=args.async_pipeline,
                     role=args.role,
                     decode_peers=args.decode_peers,
+                    host_cache_bytes=args.host_cache_mb << 20,
                     ).serve_forever()
 
 

@@ -68,9 +68,27 @@ METRIC_CONTRACT = frozenset({
     # replica's scrape must not advertise them)
     'skytpu_handoff_export_seconds',      # serialize KV -> wire artifact
     'skytpu_handoff_admit_seconds',       # wire artifact -> live slot
-    'skytpu_handoff_bytes',               # artifact size on the wire
+    'skytpu_handoff_bytes',               # labels: form=wire|raw (zlib)
     'skytpu_handoff_requests_total',      # labels: side=export|admit
     'skytpu_handoff_pages_total',         # labels: kind=shipped|deduped
+    # infer/engine.py + infer/fleet_cache.py — fleet-tiered prefix
+    # cache (registered only on engines started with host_cache_bytes
+    # > 0; a tier-less replica's scrape must not advertise them)
+    'skytpu_fleet_cache_hits_total',
+    'skytpu_fleet_cache_misses_total',
+    'skytpu_fleet_cache_spilled_pages_total',
+    'skytpu_fleet_cache_spilled_bytes_total',
+    'skytpu_fleet_cache_evicted_pages_total',
+    'skytpu_fleet_cache_rehydrated_pages_total',
+    'skytpu_fleet_cache_reprefill_tokens_saved_total',
+    'skytpu_fleet_cache_stored_bytes',
+    'skytpu_fleet_cache_stored_pages',
+    # infer/engine.py — live mid-generation migration (registered
+    # lazily on first migrate activity: ANY role can drain or admit)
+    'skytpu_migration_requests_total',    # labels: side=out|in
+    'skytpu_migration_export_seconds',    # slot checkpoint -> artifact
+    'skytpu_migration_admit_seconds',     # artifact -> resumed slot
+    'skytpu_migration_bytes',             # labels: form=wire|raw
     'skytpu_request_queue_seconds',
     'skytpu_request_tpot_seconds',
     'skytpu_request_ttft_seconds',

@@ -38,6 +38,8 @@ EVENT_CONTRACT = frozenset({
     'first_token',
     'handoff_export',         # prefill-role replica serialized the KV
     'handoff_admitted',       # decode-role replica admitted mid-stream
+    'migrate_export',         # draining replica checkpointed a live slot
+    'migrate_resume',         # survivor resumed a migrated slot
     # -- router data plane (EventRing.record) -------------------------
     'breaker_transition',     # CircuitBreaker state change
     'replica_unhealthy',      # health probe flipped a replica down

@@ -38,8 +38,11 @@ from typing import Any, Dict, List, Optional, Tuple
 # Terminal states a trace can land in.  'handed_off' is terminal for
 # the PREFILL-role replica only: the request lives on, but on another
 # replica's timeline (joined via the shared http_request_id).
+# 'migrated' is its drain-time sibling: the VICTIM replica
+# checkpointed the live slot and a survivor resumed it mid-generation
+# (same http_request_id join).
 TERMINAL_STATES = ('finished', 'cancelled', 'evicted', 'aborted',
-                   'handed_off')
+                   'handed_off', 'migrated')
 
 # Propagation header carrying `<trace_id>/<parent_span_id>` from the
 # router to the replica it tries.  The trace id is the external

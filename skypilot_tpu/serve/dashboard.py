@@ -36,6 +36,7 @@ import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import serve_utils
 
@@ -88,6 +89,44 @@ def fleet_snapshot(router_url: str) -> Dict[str, Any]:
                 out[key] = json.loads(resp.read())
         except Exception as e:  # pylint: disable=broad-except
             out[key] = {'error': repr(e)}
+    out['cache'] = _cache_tier_by_replica(base)
+    return out
+
+
+def _cache_tier_by_replica(base: str) -> Dict[str, Dict[str, Any]]:
+    """Per-replica host-tier prefix-cache stats distilled from the
+    router's federated /fleet/metrics (every series there carries a
+    ``replica`` label).  Replicas running without the tier publish no
+    skytpu_fleet_cache_* series at all and simply don't appear — the
+    dashboard renders '-' for them."""
+    try:
+        with urllib.request.urlopen(
+                base + '/fleet/metrics',
+                timeout=_FLEET_FETCH_TIMEOUT_S) as resp:
+            parsed = metrics_lib.parse_exposition(
+                resp.read().decode('utf-8', 'replace'))
+    except Exception:  # pylint: disable=broad-except
+        return {}
+    per: Dict[str, Dict[str, float]] = {}
+    for name, key in (('skytpu_fleet_cache_hits_total', 'hits'),
+                      ('skytpu_fleet_cache_misses_total', 'misses'),
+                      ('skytpu_fleet_cache_spilled_bytes_total',
+                       'spilled_bytes'),
+                      ('skytpu_fleet_cache_stored_bytes',
+                       'stored_bytes')):
+        for labels, value in parsed.get(name, {}).items():
+            url = dict(labels).get('replica')
+            if url:
+                per.setdefault(url, {})[key] = value
+    out: Dict[str, Dict[str, Any]] = {}
+    for url, vals in per.items():
+        lookups = vals.get('hits', 0.0) + vals.get('misses', 0.0)
+        out[url] = {
+            'hit_rate': (round(vals.get('hits', 0.0) / lookups, 4)
+                         if lookups else None),
+            'spilled_bytes': vals.get('spilled_bytes', 0.0),
+            'stored_bytes': vals.get('stored_bytes', 0.0),
+        }
     return out
 
 
@@ -168,13 +207,24 @@ async function refreshFleet() {{
     const h = document.createElement('h3');
     h.textContent = 'Data-plane fleet · ' + f.router;
     const reps = f.replicas.replicas ?? [];
+    // Host-tier prefix-cache columns come from the federated
+    // /fleet/metrics distillation; replicas without the tier have
+    // no entry and render '-'.
+    const cache = f.cache ?? {{}};
+    const fmtB = n => n >= 1048576 ?
+      (n / 1048576).toFixed(1) + ' MiB' : n >= 1024 ?
+      (n / 1024).toFixed(1) + ' KiB' : n + ' B';
     const rows = reps.map(rep => {{
       const tr = document.createElement('tr');
+      const c = cache[rep.url];
       tr.append(cell(rep.url), cell(rep.role ?? 'both'),
                 cell(rep.health),
                 cell(rep.circuit), cell(rep.inflight),
                 cell(rep.queue_depth ?? '-'),
                 cell(rep.free_pages ?? '-'),
+                cell(c && c.hit_rate != null ?
+                     (100 * c.hit_rate).toFixed(1) + '%' : '-'),
+                cell(c ? fmtB(c.spilled_bytes) : '-'),
                 cell(rep.routable ? 'yes' : 'no'));
       return tr;
     }});
@@ -199,7 +249,8 @@ async function refreshFleet() {{
         ' burn ' + (v.burn_rate ?? 0).toFixed(2)).join(' · ');
     root.replaceChildren(h, pools,
       table(['URL', 'Role', 'Health', 'Breaker', 'In-flight', 'Queue',
-             'Free pages', 'Routable'], rows), slo);
+             'Free pages', 'Cache hit', 'Spilled', 'Routable'],
+            rows), slo);
   }} catch (e) {{ /* router restarting; retry next tick */ }}
 }}
 refresh(); setInterval(refresh, 5000);

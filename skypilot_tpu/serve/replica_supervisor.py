@@ -37,6 +37,8 @@ patience in both directions, scale-up more eager than scale-down).
 """
 from __future__ import annotations
 
+import json
+import os
 import random
 import subprocess
 import threading
@@ -324,6 +326,21 @@ class ReplicaSupervisor:
                 and s.handle.poll() is None]
         if live and chaos.should_inject('replica_kill'):
             victim = self._rng.choice(live)
+            notice = float(
+                os.environ.get('SKYTPU_PREEMPT_NOTICE_S', '0') or 0)
+            if notice > 0 and victim.url is not None:
+                # TPU-preemption shape: spot VMs get a short notice
+                # window before the plug is pulled.  Spend it on a
+                # migrate-drain so in-flight slots checkpoint to
+                # survivors instead of losing their KV mid-stream.
+                survivors = self._survivor_urls(victim)
+                logger.warning(
+                    f'chaos: preempting replica slot {victim.slot_id} '
+                    f'({victim.url}) with {notice:.1f}s notice, '
+                    f'{len(survivors)} survivor(s)')
+                self.router.mark_draining(victim.url)
+                self._post_drain(victim.url, survivors)
+                time.sleep(notice)
             logger.warning(
                 f'chaos: SIGKILLing replica slot {victim.slot_id} '
                 f'({victim.url})')
@@ -396,6 +413,37 @@ class ReplicaSupervisor:
                 f'replica slot {slot.slot_id} spawned at {slot.url}')
 
     # -- scale-down via drain -----------------------------------------
+    def _survivor_urls(self, victim: _Slot) -> List[str]:
+        """Live replicas a drain/preemption can migrate the victim's
+        in-flight slots to — anything /handoff-capable (role both or
+        decode) that is not the victim itself."""
+        return [s.url for s in self.slots()
+                if s is not victim and s.state == LIVE
+                and s.url is not None
+                and s.role in ('both', 'decode')
+                and s.handle is not None and s.handle.poll() is None]
+
+    def _post_drain(self, url: str, survivors: List[str]) -> None:
+        """POST /drain, asking for live migration when survivors
+        exist (a non-migratable replica quietly finishes locally
+        instead).  Failures fall back to the drain deadline."""
+        payload = json.dumps({
+            'migrate': bool(survivors),
+            'targets': survivors,
+        }).encode()
+        try:
+            req = urllib.request.Request(
+                url + '/drain', data=payload, method='POST',
+                headers={'Content-Type': 'application/json'})
+            urllib.request.urlopen(req, timeout=5).close()
+        except (urllib.error.URLError, urllib.error.HTTPError,
+                ConnectionError, TimeoutError, OSError):
+            # Unreachable for drain == already dead; escalation
+            # cleans up.
+            logger.warning(
+                f'drain request to {url} failed; falling back '
+                'to the drain deadline')
+
     def _begin_drain(self, slot: _Slot) -> None:
         slot.state = DRAINING
         slot.drain_deadline = time.monotonic() + self.drain_timeout_s
@@ -403,18 +451,7 @@ class ReplicaSupervisor:
             # Unroutable BEFORE the drain request: zero requests may
             # land on the victim after this point.
             self.router.mark_draining(slot.url)
-            try:
-                req = urllib.request.Request(
-                    slot.url + '/drain', data=b'{}', method='POST',
-                    headers={'Content-Type': 'application/json'})
-                urllib.request.urlopen(req, timeout=5).close()
-            except (urllib.error.URLError, urllib.error.HTTPError,
-                    ConnectionError, TimeoutError, OSError):
-                # Unreachable for drain == already dead; escalation
-                # below cleans up.
-                logger.warning(
-                    f'drain request to {slot.url} failed; falling back '
-                    'to the drain deadline')
+            self._post_drain(slot.url, self._survivor_urls(slot))
 
     def _finish_drains(self) -> None:
         now = time.monotonic()

@@ -755,6 +755,20 @@ class Router:
                    key=lambda v: (v.inflight + self._signals(v)[0],
                                   v.url))
 
+    def _prefix_owner(self, key: int) -> Optional[str]:
+        """URL of the rendezvous OWNER of an affinity key — the
+        replica whose cache tiers most likely hold the prompt's prefix
+        pages.  Stamped as X-Skytpu-Prefix-Peer when saturation forced
+        routing AWAY from the owner, so the chosen replica can fetch
+        the pages over GET /kv_prefix instead of re-prefilling them."""
+        with self._lock:
+            candidates = [v for v in self._replicas.values()
+                          if v.routable
+                          and v.role in ('both', 'prefill')]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda v: hash((key, v.url))).url
+
     def _select_decode_target(self, key: Optional[int]
                               ) -> Optional[ReplicaView]:
         """The decode replica a prefill-role replica should hand off
@@ -1054,6 +1068,17 @@ class Router:
             target = self._select_decode_target(state.get('key'))
             if target is not None:
                 headers[handoff_lib.DECODE_TARGET_HEADER] = target.url
+        # Fleet prefix-cache tier: when this attempt is NOT going to
+        # the key's rendezvous owner (saturation overflow, failover),
+        # name the owner so the serving replica can pull the prefix
+        # pages it is missing.  Cleared per attempt — an attempt that
+        # DOES reach the owner must not fetch from itself.
+        headers.pop(handoff_lib.PREFIX_PEER_HEADER, None)
+        key = state.get('key')
+        if key is not None:
+            owner = self._prefix_owner(key)
+            if owner is not None and owner != view.url:
+                headers[handoff_lib.PREFIX_PEER_HEADER] = owner
         outcome = 'unknown'
         with self._lock:
             view.inflight += 1

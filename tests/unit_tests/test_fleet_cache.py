@@ -1,0 +1,475 @@
+"""Fleet-tiered KV prefix cache: host-RAM spill tier.
+
+Covers the three layers the tier spans:
+
+- `HostPrefixCache` bookkeeping: LRU-by-use eviction under the byte
+  budget, recency rules (get refreshes, has must not), replacement,
+  and the leading-run snapshot `GET /kv_prefix` serves from.
+- The allocator's cross-tier victim policy: cannibalisation spills
+  before destroying, victims with an existing host copy are preferred
+  over LRU order, and `adopt_prefix` keeps exactly one owner per tier
+  (refcounts never double-free; `leak_report()` stays clean through
+  spill/rehydrate churn).
+- `fetch_prefix_from_peer` failure modes: a fleet-tier miss (peer
+  down, garbage bytes, version skew, wrong model/dtype/page-size
+  geometry) always degrades to [] — the caller just prefills.
+- Engine end-to-end: a pool too small for its prefix chains spills on
+  cannibalisation and REHYDRATES on the next hit instead of
+  re-prefilling (asserted via the prefill-step counters), and greedy
+  decode is bit-identical spill-on vs spill-off across model families
+  x KV-cache dtypes x speculation modes.
+
+Tier-1/CPU by design: everything here runs under
+`JAX_PLATFORMS=cpu -m 'not slow'` (TestTier1Guard enforces that).
+"""
+import http.server
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.infer import fleet_cache
+from skypilot_tpu.infer import handoff
+from skypilot_tpu.infer import paging
+from skypilot_tpu.observability import metrics as metrics_lib
+
+_COMMON = {'max_seq_len': 128, 'n_layers': 2,
+           'dtype': jnp.float32, 'param_dtype': jnp.float32}
+_FAMILIES = {
+    # GQA 4:2 + rope.
+    'llama-tiny': {**_COMMON, 'n_heads': 4, 'n_kv_heads': 2,
+                   'dim': 64, 'ffn_dim': 128, 'vocab_size': 96},
+    # MHA + learned positions: rehydrated pages must replay correctly
+    # without rope re-rotation too.
+    'gpt2-tiny': {**_COMMON, 'n_heads': 4, 'dim': 64,
+                  'ffn_dim': 128, 'vocab_size': 96},
+}
+_PS = 8
+# Three DISTINCT multi-page chains (28 tokens = 3 full pages + tail).
+# With max_pages=10 (9 usable) the 9 registered prefix pages plus any
+# in-flight request's ~5 working pages cannot coexist — every pass
+# over the pool cannibalises, which is what makes the spill tier
+# observable.
+_POOL_PROMPTS = [list(range(1, 29)), list(range(30, 58)),
+                 list(range(60, 88))]
+_GREEDY = engine_lib.SamplingConfig(max_new_tokens=6, temperature=0.0)
+
+
+def _leaves(seed: int, nbytes: int = 32):
+    """One page's worth of leaf arrays totalling `nbytes`."""
+    rng = np.random.default_rng(seed)
+    return {'page_key': rng.random(nbytes // 8).astype(np.float32),
+            'page_value': rng.random(nbytes // 8).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------
+# HostPrefixCache bookkeeping
+# ---------------------------------------------------------------------
+
+class TestHostPrefixCache:
+
+    def test_round_trip_and_stats(self):
+        hc = fleet_cache.HostPrefixCache(max_bytes=1024)
+        leaves = _leaves(0)
+        assert hc.put(1, leaves)
+        got = hc.get(1)
+        assert set(got) == {'page_key', 'page_value'}
+        np.testing.assert_array_equal(got['page_key'],
+                                      leaves['page_key'])
+        s = hc.stats()
+        assert s['stored_pages'] == 1
+        assert s['stored_bytes'] == 32
+        assert s['hits_total'] == 1 and s['misses_total'] == 0
+        assert hc.get(99) is None
+        assert hc.stats()['misses_total'] == 1
+
+    def test_get_refreshes_lru_has_does_not(self):
+        hc = fleet_cache.HostPrefixCache(max_bytes=64)  # two entries
+        hc.put(1, _leaves(1))
+        hc.put(2, _leaves(2))
+        assert hc.get(1) is not None     # 1 now most-recently-used
+        assert hc.has(2)                 # must NOT refresh 2
+        hc.put(3, _leaves(3))            # evicts 2, not 1
+        assert hc.has(1) and hc.has(3) and not hc.has(2)
+        assert hc.stats()['evicted_pages_total'] == 1
+
+    def test_oversize_page_rejected_whole(self):
+        hc = fleet_cache.HostPrefixCache(max_bytes=16)
+        assert not hc.put(1, _leaves(1, nbytes=32))
+        assert hc.stats()['stored_pages'] == 0
+        assert hc.stats()['stored_bytes'] == 0
+
+    def test_replacement_does_not_double_count_bytes(self):
+        hc = fleet_cache.HostPrefixCache(max_bytes=1024)
+        hc.put(7, _leaves(0))
+        hc.put(7, _leaves(1))
+        s = hc.stats()
+        assert s['stored_pages'] == 1 and s['stored_bytes'] == 32
+
+    def test_discard_and_clear(self):
+        hc = fleet_cache.HostPrefixCache(max_bytes=1024)
+        hc.put(1, _leaves(1))
+        hc.put(2, _leaves(2))
+        hc.discard(1)
+        hc.discard(1)  # idempotent
+        assert not hc.has(1) and hc.stats()['stored_bytes'] == 32
+        hc.clear()
+        assert hc.stats()['stored_pages'] == 0
+        assert hc.stats()['stored_bytes'] == 0
+
+    def test_snapshot_run_stops_at_first_miss(self):
+        hc = fleet_cache.HostPrefixCache(max_bytes=1024)
+        hc.put(1, _leaves(1))
+        hc.put(3, _leaves(3))
+        served_h, served_p = hc.snapshot_run([1, 2, 3])
+        assert served_h == [1]
+        assert len(served_p) == 1
+        # The run stopped short -> one miss accounted.
+        assert hc.stats()['misses_total'] == 1
+        served_h, _ = hc.snapshot_run([1])
+        assert served_h == [1]
+
+
+# ---------------------------------------------------------------------
+# Allocator cross-tier victim policy
+# ---------------------------------------------------------------------
+
+def _tiered_alloc(n_pages=6, page_size=4):
+    alloc = paging.PageAllocator(n_pages=n_pages, page_size=page_size)
+    spilled = {}
+    alloc.set_spill_hooks(spilled.__setitem__,
+                          lambda h: h in spilled)
+    return alloc, spilled
+
+
+def _park_chain(alloc, tokens):
+    """Prefill-shaped lifecycle: alloc, register, release -> the
+    chain's full pages park in the reclaimable LRU."""
+    hashes = paging.chain_hashes(tokens, alloc.page_size)
+    pages = alloc.alloc(len(hashes))
+    assert pages is not None
+    alloc.register_prefix(tokens, pages)
+    for p in pages:
+        alloc.release(p)
+    return hashes, pages
+
+
+class TestAllocatorSpillTier:
+
+    def test_cannibalise_spills_first(self):
+        alloc, spilled = _tiered_alloc()
+        h, pages = _park_chain(alloc, list(range(8)))  # 2 pages parked
+        free_fresh = alloc.free_pages - 2
+        taken = alloc.alloc(free_fresh + 1)  # forces one cannibalise
+        assert taken is not None
+        assert alloc.cannibalized_total == 1
+        assert alloc.spilled_total == 1
+        # LRU-oldest chain page was copied out before destruction.
+        assert spilled == {h[0]: pages[0]}
+
+    def test_victim_prefers_existing_host_copy(self):
+        alloc, spilled = _tiered_alloc(n_pages=8)
+        ha, _ = _park_chain(alloc, list(range(4)))       # older
+        hb, pb = _park_chain(alloc, list(range(10, 14)))  # newer
+        spilled[hb[0]] = pb[0]  # B already has a host copy
+        before = alloc.spilled_total
+        assert alloc.alloc(alloc.free_pages) is not None
+        # B went first despite A being LRU-older, and no NEW spill was
+        # needed for it; A's page was spilled when its turn came.
+        assert alloc.spilled_total == before + 1
+        assert ha[0] in spilled
+        assert not alloc.has_prefix(hb[0])
+
+    def test_adopt_prefix_keeps_single_owner(self):
+        alloc, _ = _tiered_alloc()
+        tokens = list(range(4))
+        (h,) = paging.chain_hashes(tokens, alloc.page_size)
+        (page,) = alloc.alloc(1)
+        assert alloc.adopt_prefix(h, page)
+        # The alloc() reference became the slot's reference: adopting
+        # must not add one (that extra ref could never be released
+        # without double-freeing the host copy's owner).
+        assert alloc.refcount(page) == 1
+        assert not alloc.adopt_prefix(h, page)  # second publish: no-op
+        alloc.release(page)  # parks (registered), not freed
+        assert alloc.has_prefix(h)
+        got = alloc.take_registered(h)
+        assert got == page and alloc.refcount(page) == 1
+        alloc.release(page)
+        assert alloc.leak_report() is None
+
+    def test_leak_free_across_tier_churn(self):
+        alloc, spilled = _tiered_alloc(n_pages=8)
+        for rounds in range(3):
+            ha, _ = _park_chain(alloc, list(range(8)))
+            taken = alloc.alloc(alloc.free_pages)  # cannibalise all
+            for p in taken:
+                alloc.release(p)
+            # Rehydrate-shaped: adopt one page back for a spilled hash.
+            lost = next(h for h in ha if not alloc.has_prefix(h))
+            (page,) = alloc.alloc(1)
+            assert alloc.adopt_prefix(lost, page)
+            alloc.release(page)
+        assert alloc.leak_report() is None
+        assert alloc.spilled_total > 0
+
+
+# ---------------------------------------------------------------------
+# fetch_prefix_from_peer failure modes
+# ---------------------------------------------------------------------
+
+class _StubPeer:
+    """Single-purpose HTTP peer serving a canned /kv_prefix body."""
+
+    def __init__(self, body: bytes, status: int = 200):
+        outer = self
+
+        class _H(http.server.BaseHTTPRequestHandler):
+
+            def log_message(self, *a):  # noqa: D102 (stdlib name)
+                pass
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                self.send_response(outer.status)
+                self.send_header('Content-Length', str(len(outer.body)))
+                self.end_headers()
+                self.wfile.write(outer.body)
+
+        self.body, self.status = body, status
+        self.server = http.server.ThreadingHTTPServer(
+            ('127.0.0.1', 0), _H)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        self.url = f'http://127.0.0.1:{self.server.server_address[1]}'
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _fetch(url, hashes=(11, 22), model='m', dtype='bfloat16', ps=8):
+    return fleet_cache.fetch_prefix_from_peer(
+        url, list(hashes), model, dtype, ps, timeout=5.0)
+
+
+def _blob(hashes=(11, 22), model='m', dtype='bfloat16', ps=8):
+    pages = [_leaves(i) for i in range(len(hashes))]
+    return handoff.serialize_kv_prefix(model, dtype, ps,
+                                       list(hashes), pages)
+
+
+class TestFetchPrefixFromPeer:
+
+    def test_peer_down_returns_empty(self):
+        assert _fetch('http://127.0.0.1:1') == []
+
+    def test_http_error_returns_empty(self):
+        peer = _StubPeer(b'gone', status=404)
+        try:
+            assert _fetch(peer.url) == []
+        finally:
+            peer.close()
+
+    def test_garbage_body_returns_empty(self):
+        peer = _StubPeer(b'not a SKHO artifact at all')
+        try:
+            assert _fetch(peer.url) == []
+        finally:
+            peer.close()
+
+    def test_version_skew_returns_empty(self):
+        blob = _blob()
+        forged = handoff._PREAMBLE.pack(  # pylint: disable=protected-access
+            handoff.MAGIC, handoff.VERSION + 1, 0) \
+            + blob[handoff._PREAMBLE.size:]  # pylint: disable=protected-access
+        peer = _StubPeer(forged)
+        try:
+            assert _fetch(peer.url) == []
+        finally:
+            peer.close()
+
+    @pytest.mark.parametrize('kw', [
+        dict(model='other'),
+        dict(dtype='int8'),
+        dict(ps=16),
+    ], ids=['model', 'dtype', 'page_size'])
+    def test_geometry_mismatch_returns_empty(self, kw):
+        peer = _StubPeer(_blob())
+        try:
+            assert _fetch(peer.url, **kw) == []
+        finally:
+            peer.close()
+
+    def test_trusts_only_leading_matching_run(self):
+        # Peer serves [11, 99, 33] but we asked for [11, 22, 33]: only
+        # the leading match is usable (a chain's later pages are
+        # meaningless after a divergence).
+        peer = _StubPeer(_blob(hashes=(11, 99, 33)))
+        try:
+            out = _fetch(peer.url, hashes=(11, 22, 33))
+            assert [h for h, _ in out] == [11]
+            np.testing.assert_array_equal(
+                out[0][1]['page_key'], _leaves(0)['page_key'])
+        finally:
+            peer.close()
+
+    def test_full_run_round_trips(self):
+        peer = _StubPeer(_blob())
+        try:
+            out = _fetch(peer.url)
+            assert [h for h, _ in out] == [11, 22]
+        finally:
+            peer.close()
+
+
+# ---------------------------------------------------------------------
+# Engine: spill -> rehydrate skips re-prefill; greedy parity
+# ---------------------------------------------------------------------
+
+def _cbe(family, overrides, **kw):
+    kw.setdefault('n_slots', 2)
+    kw.setdefault('prefill_bucket', _PS)
+    return engine_lib.ContinuousBatchingEngine(
+        family, model_overrides=dict(overrides), **kw)
+
+
+def _prefill_steps(reg):
+    parsed = metrics_lib.parse_exposition(reg.expose())
+    return sum(parsed.get('skytpu_prefill_kernel_steps_total',
+                          {}).values())
+
+
+class TestSpillRehydrate:
+
+    def test_rehydrate_skips_reprefill_steps(self):
+        reg = metrics_lib.Registry()
+        eng = _cbe('llama-tiny', _FAMILIES['llama-tiny'],
+                   page_size=_PS, max_pages=10, prefill_chunk=_PS,
+                   host_cache_bytes=64 << 20, registry=reg)
+        outs1 = [eng.generate([p], _GREEDY) for p in _POOL_PROMPTS]
+        steps1 = _prefill_steps(reg)
+        stats1 = eng.host_cache_stats()
+        assert stats1['spilled_pages_total'] > 0, \
+            'pool sized to cannibalise; spill tier never engaged'
+        outs2 = [eng.generate([p], _GREEDY) for p in _POOL_PROMPTS]
+        steps2 = _prefill_steps(reg) - steps1
+        stats2 = eng.host_cache_stats()
+        # Pass 2 rehydrated spilled pages instead of re-prefilling:
+        # strictly fewer chunked-prefill forwards than the cold pass,
+        # and the saved-token counter owns the difference.
+        assert stats2['rehydrated_pages_total'] > 0
+        assert stats2['reprefill_tokens_saved_total'] >= \
+            stats2['rehydrated_pages_total'] * _PS
+        assert steps2 < steps1
+        assert outs1 == outs2
+        assert eng._alloc.leak_report() is None  # pylint: disable=protected-access
+
+    def test_ingest_rejects_foreign_geometry(self):
+        eng = _cbe('llama-tiny', _FAMILIES['llama-tiny'],
+                   page_size=_PS, max_pages=10,
+                   host_cache_bytes=64 << 20)
+        assert eng.ingest_prefix_pages(
+            [(123, {'bogus_leaf': np.zeros(3, np.float32)})]) == 0
+        spec = dict(eng._pool_page_specs)  # pylint: disable=protected-access
+        wrong = {name: np.zeros([d + 1 for d in shape],
+                                dtype) for name, (shape, dtype)
+                 in spec.items()}
+        assert eng.ingest_prefix_pages([(123, wrong)]) == 0
+        assert eng.host_cache_stats()['stored_pages'] == 0
+
+    def test_resident_run_spans_device_and_host(self):
+        eng = _cbe('llama-tiny', _FAMILIES['llama-tiny'],
+                   page_size=_PS, max_pages=10,
+                   host_cache_bytes=64 << 20)
+        for p in _POOL_PROMPTS:
+            eng.generate([p], _GREEDY)
+        hashes = paging.chain_hashes(_POOL_PROMPTS[0], _PS)
+        # After the churn every page of chain 0 is in SOME tier.
+        assert eng.prefix_resident_run(hashes) == len(hashes)
+        assert eng.prefix_resident_run([424242] + hashes) == 0
+
+
+def _spec_kw(family, mode):
+    if mode == 'draft':
+        return dict(spec_k=4, draft_model=family,
+                    draft_overrides=dict(_FAMILIES[family]))
+    if mode == 'ngram':
+        return dict(spec_k=4)
+    return {}
+
+
+class TestSpillParity:
+    """Greedy decode must be bit-identical with the spill tier on vs
+    off: rehydrated pages ARE the pages prefill would have written.
+    The off-arm runs the same starved pool, so it cannibalises and
+    re-prefills — any divergence in rehydrated contents shows up as a
+    token mismatch."""
+
+    @pytest.mark.parametrize('family,kv_dtype,spec', [
+        ('llama-tiny', 'auto', 'none'),
+        ('llama-tiny', 'int8', 'none'),
+        ('gpt2-tiny', 'auto', 'none'),
+        ('llama-tiny', 'auto', 'draft'),
+        ('gpt2-tiny', 'int8', 'ngram'),
+    ])
+    def test_greedy_bit_identical_spill_on_vs_off(
+            self, family, kv_dtype, spec):
+        ov = _FAMILIES[family]
+        kw = dict(page_size=_PS, max_pages=10, kv_cache_dtype=kv_dtype,
+                  **_spec_kw(family, spec))
+        off = _cbe(family, ov, host_cache_bytes=0, **kw)
+        on = _cbe(family, ov, params=off.params,
+                  host_cache_bytes=64 << 20, **kw)
+
+        def _two_passes(eng):
+            return [eng.generate([p], _GREEDY)
+                    for p in _POOL_PROMPTS * 2]
+
+        outs_off = _two_passes(off)
+        outs_on = _two_passes(on)
+        assert outs_on == outs_off
+        # The comparison only means something if the tier actually ran.
+        stats = on.host_cache_stats()
+        assert stats['spilled_pages_total'] > 0
+        assert stats['rehydrated_pages_total'] > 0
+        assert off.host_cache_stats() is None
+        for eng in (on, off):
+            assert eng._alloc.leak_report() is None  # pylint: disable=protected-access
+
+
+# ---------------------------------------------------------------------
+# Tier-1 guard
+# ---------------------------------------------------------------------
+
+_PR_TEST_SURFACES = {
+    'test_fleet_cache.py': None,          # whole file
+    'test_migration_e2e.py': None,        # whole file
+}
+
+
+class TestTier1Guard:
+    """The spill-tier guarantees only hold if CI executes them every
+    PR: CPU backend, no `slow` marker, no TPU gating."""
+
+    def test_runs_on_cpu_backend(self):
+        assert jax.default_backend() == 'cpu'
+
+    def test_new_tests_not_slow_marked(self):
+        import pathlib
+        here = pathlib.Path(__file__).parent
+        for fname, surfaces in _PR_TEST_SURFACES.items():
+            text = (here / fname).read_text()
+            if surfaces is None:
+                scopes = [text]
+            else:
+                scopes = []
+                for name in surfaces:
+                    assert name in text, (fname, name)
+                    scopes.append(text[text.index(name):])
+            slow, tpu = 'mark.' + 'slow', 'requires' + '_tpu'
+            for scope in scopes:
+                assert slow not in scope, fname
+                assert tpu not in scope, fname

@@ -90,11 +90,16 @@ class TestWireFormat:
     def test_version_mismatch_rejected(self):
         blob = handoff.serialize_artifact(_meta(), {})
         _, _, hlen = handoff._PREAMBLE.unpack_from(blob, 0)
-        bad = handoff._PREAMBLE.pack(
-            handoff.MAGIC, handoff.VERSION + 1, hlen) \
-            + blob[handoff._PREAMBLE.size:]
-        with pytest.raises(handoff.HandoffVersionError):
-            handoff.deserialize_artifact(bad)
+        # Both skew directions fail closed: a FUTURE version (v3 wire
+        # at a v2 reader) and the PRE-compression v1 wire at a v2
+        # reader — mixed fleets mid-rollout must reject, not
+        # misparse.
+        for version in (handoff.VERSION + 1, 1):
+            bad = handoff._PREAMBLE.pack(
+                handoff.MAGIC, version, hlen) \
+                + blob[handoff._PREAMBLE.size:]
+            with pytest.raises(handoff.HandoffVersionError):
+                handoff.deserialize_artifact(bad)
 
     def test_malformed_artifacts_rejected(self):
         blob = handoff.serialize_artifact(_meta(), {})
@@ -121,6 +126,98 @@ class TestWireFormat:
         assert handoff.prompt_page_split(list(range(19)), 0, 8) == (3, 0)
         assert handoff.prompt_page_split(list(range(19)), 2, 8) == (1, 2)
         assert handoff.prompt_page_split(list(range(19)), 0, 0) == (0, 0)
+
+
+def _edit_header(blob, **over):
+    """Re-emit `blob` with header fields overridden — forges the
+    corrupt/hostile artifacts the zlib section must fail closed on."""
+    import json
+    _, version, hlen = handoff._PREAMBLE.unpack_from(blob, 0)
+    start = handoff._PREAMBLE.size
+    header = json.loads(blob[start:start + hlen].decode())
+    header.update(over)
+    header_raw = json.dumps(header).encode()
+    return handoff._PREAMBLE.pack(
+        handoff.MAGIC, version, len(header_raw)) \
+        + header_raw + blob[start + hlen:]
+
+
+class TestCompressedWire:
+    """The v2 optional zlib tensor section (stdlib-only)."""
+
+    def _tensors(self):
+        # Compressible on purpose: zeros + a repeating ramp.
+        return {
+            'layers_0/cached_key':
+                np.zeros((2, 8, 4, 16), np.float32),
+            'layers_0/cached_value':
+                np.tile(np.arange(16, dtype=np.float32),
+                        (2, 8, 4, 1)),
+        }
+
+    def test_round_trip_and_wire_savings(self):
+        tensors = self._tensors()
+        raw = handoff.serialize_artifact(_meta(), tensors)
+        packed = handoff.serialize_artifact(_meta(), tensors,
+                                            compress=True)
+        assert len(packed) < len(raw)
+        meta, out = handoff.deserialize_artifact(packed)
+        assert meta['compressed'] == 'zlib'
+        # The header's raw_nbytes announcement is what the metrics
+        # and bench report as the uncompressed ('raw') byte count.
+        assert handoff.raw_payload_nbytes(meta) == \
+            sum(t.nbytes for t in tensors.values())
+        for name, want in tensors.items():
+            np.testing.assert_array_equal(np.asarray(out[name]), want)
+
+    def test_deserialized_views_are_read_only(self):
+        packed = handoff.serialize_artifact(_meta(), self._tensors(),
+                                            compress=True)
+        _, out = handoff.deserialize_artifact(packed)
+        arr = next(iter(out.values()))
+        with pytest.raises(ValueError):
+            arr[0] = 1.0
+
+    def test_raw_nbytes_mismatch_rejected(self):
+        packed = handoff.serialize_artifact(_meta(), self._tensors(),
+                                            compress=True)
+        meta, _ = handoff.deserialize_artifact(packed)
+        lying = _edit_header(packed,
+                             raw_nbytes=int(meta['raw_nbytes']) + 1)
+        with pytest.raises(handoff.HandoffFormatError):
+            handoff.deserialize_artifact(lying)
+        missing = _edit_header(packed, raw_nbytes=None)
+        with pytest.raises(handoff.HandoffFormatError):
+            handoff.deserialize_artifact(missing)
+
+    def test_garbage_deflate_rejected(self):
+        raw = handoff.serialize_artifact(_meta(), self._tensors())
+        # Header claims zlib but the payload was never deflated.
+        forged = _edit_header(
+            raw, compressed='zlib',
+            raw_nbytes=sum(t.nbytes for t in self._tensors().values()))
+        with pytest.raises(handoff.HandoffFormatError):
+            handoff.deserialize_artifact(forged)
+
+    def test_unknown_compression_rejected(self):
+        packed = handoff.serialize_artifact(_meta(), self._tensors(),
+                                            compress=True)
+        with pytest.raises(handoff.HandoffFormatError):
+            handoff.deserialize_artifact(
+                _edit_header(packed, compressed='lz4'))
+
+    def test_kv_prefix_compressed_round_trip(self):
+        pages = [{'k': np.zeros((2, 8, 4), np.float32),
+                  'v': np.zeros((2, 8, 4), np.float32)}
+                 for _ in range(3)]
+        blob = handoff.serialize_kv_prefix(
+            'm', 'float32', 8, [11, 22, 33], pages, compress=True)
+        meta, tensors = handoff.deserialize_artifact(blob)
+        assert meta['kind'] == handoff.KIND_KV_PREFIX
+        got = handoff.split_kv_prefix(meta, tensors)
+        assert [h for h, _ in got] == [11, 22, 33]
+        for _, leaves in got:
+            assert set(leaves) == {'k', 'v'}
 
 
 # Cache-mode / prefill-geometry matrix the parity tests sweep: the

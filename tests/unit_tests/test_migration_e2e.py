@@ -1,0 +1,490 @@
+"""Live mid-generation migration end-to-end: a replica taking a
+migrate-drain (`POST /drain {"migrate": true, "targets": [...]}`)
+checkpoints every in-flight decode slot into a SKHO slot artifact,
+relays it to a survivor's /handoff, and the client's token stream
+continues BYTE-IDENTICAL from the survivor — the preemption notice is
+spent moving work, not losing it.
+
+The fleet is real: in-process ``InferenceServer`` replicas; streams
+run over the OpenAI SSE surface while the drain lands mid-decode.
+Also here: the classic no-target drain still finishes locally, the
+supervisor's preemption-notice chaos path (mark-draining + migrate
+/drain POST before the SIGKILL), and the fleet prefix tier's HTTP
+surfaces (`GET /kv_prefix` + the `X-Skytpu-Prefix-Peer` prefetch).
+
+Tier-1/CPU by design: everything in this file runs under
+`JAX_PLATFORMS=cpu -m 'not slow'` (the tier-1 guard in
+test_fleet_cache.py scans this file).
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.infer import fleet_cache
+from skypilot_tpu.infer import handoff as handoff_lib
+from skypilot_tpu.infer import paging
+from skypilot_tpu.infer.server import InferenceServer
+from skypilot_tpu.observability import metrics as metrics_lib
+from skypilot_tpu.serve import replica_supervisor as sup_lib
+from skypilot_tpu.serve.router import Router
+from skypilot_tpu.utils import chaos
+
+_COMMON = {'max_seq_len': 128, 'n_layers': 2,
+           'dtype': jnp.float32, 'param_dtype': jnp.float32}
+_FAMILIES = {
+    'llama-tiny': {**_COMMON, 'n_heads': 4, 'n_kv_heads': 2,
+                   'dim': 64, 'ffn_dim': 128, 'vocab_size': 96},
+    'gpt2-tiny': {**_COMMON, 'n_heads': 4, 'dim': 64,
+                  'ffn_dim': 128, 'vocab_size': 96},
+}
+_PS = 8
+# Long decode so the drain reliably lands mid-generation.
+_MAX_NEW = 48
+# Uppercase: the ByteTokenizer maps bytes to ids past 3 specials, and
+# the tiny test vocab (96) only covers bytes <= 92 — lowercase would
+# clamp in the embedding and greedy-decode straight into specials,
+# streaming zero visible fragments.
+_STREAM_PROMPTS = ['MIGRATE ME ALPHA', 'MIGRATE ME BRAVO']
+
+# Migration requires the paged cache (can_migrate_out); cover both
+# families, an int8 cache, and n-gram speculation riding along.
+_MODES = {
+    'llama-paged': dict(model='llama-tiny', page_size=_PS,
+                        prefill_chunk=_PS),
+    'llama-paged-int8-ngram': dict(model='llama-tiny', page_size=_PS,
+                                   kv_cache_dtype='int8', spec_k=4),
+    'gpt2-paged': dict(model='gpt2-tiny', page_size=_PS),
+}
+
+
+def _server(model, **kw):
+    reg = metrics_lib.Registry()
+    srv = InferenceServer(model=model, port=0, host='127.0.0.1',
+                          max_batch_size=2,
+                          model_overrides=dict(_FAMILIES[model]),
+                          allow_random_weights=True, registry=reg,
+                          **kw)
+    srv.start()
+    threading.Thread(
+        target=lambda s=srv._server: s.serve_forever(poll_interval=0.05),
+        daemon=True).start()
+    return srv, reg
+
+
+def _url(srv):
+    return f'http://127.0.0.1:{srv.port}'
+
+
+def _post_json(base, path, body, timeout=60):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(), method='POST',
+        headers={'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, e.read()
+
+
+def _stream_into(base, prompt_text, frags, started, errors,
+                 max_new=_MAX_NEW, headers=None):
+    """Incrementally collect one completions SSE stream: fragments
+    append as they arrive and `started` fires on the FIRST one — the
+    signal that prefill is done and the slot is decoding."""
+    req = urllib.request.Request(
+        base + '/v1/completions',
+        data=json.dumps({'model': 'm', 'prompt': prompt_text,
+                         'max_tokens': max_new, 'temperature': 0.0,
+                         'stream': True}).encode(),
+        method='POST',
+        headers={'Content-Type': 'application/json',
+                 **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            for raw in resp:
+                line = raw.decode().strip()
+                if not line.startswith('data: '):
+                    continue
+                payload = line[len('data: '):]
+                if payload == '[DONE]':
+                    break
+                obj = json.loads(payload)
+                if 'error' in obj:
+                    errors.append(obj)
+                    return
+                text = obj['choices'][0].get('text') or ''
+                if text:
+                    frags.append(text)
+                    started.set()
+    except Exception as e:  # noqa: BLE001 — surfaced by the test
+        errors.append(repr(e))
+
+
+def _counter(reg, name, **labels):
+    parsed = metrics_lib.parse_exposition(reg.expose())
+    return metrics_lib.sample_value(parsed, name, **labels) or 0.0
+
+
+def _wait_down(srv, budget_s=30.0):
+    """Wait for a draining server to finish its self-shutdown."""
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(_url(srv) + '/health',
+                                        timeout=2) as resp:
+                resp.read()
+        except (urllib.error.URLError, ConnectionError, OSError):
+            return
+        time.sleep(0.1)
+    raise AssertionError('drained replica never shut down')
+
+
+class TestLiveMigration:
+
+    @pytest.mark.parametrize('mode', sorted(_MODES))
+    def test_migrate_drain_mid_generation_byte_identical(self, mode):
+        """The tentpole pin: kill-with-notice mid-generation loses no
+        stream and changes no byte.  Two concurrent greedy streams
+        start on the victim; once both are decoding, the victim takes
+        a migrate-drain naming the survivor; every stream must finish
+        with exactly the tokens an undisturbed replica produces, the
+        migration counters must prove the slots actually moved, and
+        both allocators end leak-free."""
+        kw = dict(_MODES[mode])
+        model = kw.pop('model')
+        ref, _ = _server(model, **kw)
+        victim, v_reg = _server(model, **kw)
+        survivor, s_reg = _server(model, **kw)
+        try:
+            expected = []
+            for p in _STREAM_PROMPTS:
+                frags, errs = [], []
+                _stream_into(_url(ref), p, frags, threading.Event(),
+                             errs)
+                assert not errs, errs
+                expected.append(''.join(frags))
+
+            outs = [([], threading.Event(), [])
+                    for _ in _STREAM_PROMPTS]
+            threads = [
+                threading.Thread(
+                    target=_stream_into,
+                    args=(_url(victim), p, frags, started, errs),
+                    daemon=True)
+                for p, (frags, started, errs)
+                in zip(_STREAM_PROMPTS, outs)]
+            for t in threads:
+                t.start()
+            for _, started, _ in outs:
+                assert started.wait(60), 'stream never started'
+            # Both slots are decoding: pull the plug with notice.
+            code, body = _post_json(
+                _url(victim), '/drain',
+                {'migrate': True, 'targets': [_url(survivor)]})
+            assert code == 200, body
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive(), 'stream wedged'
+            for (frags, _, errs), want in zip(outs, expected):
+                assert not errs, errs
+                assert ''.join(frags) == want, mode
+
+            moved = _counter(v_reg, 'skytpu_migration_requests_total',
+                             side='out')
+            resumed = _counter(s_reg, 'skytpu_migration_requests_total',
+                               side='in')
+            assert moved >= 1, 'drain never caught a live slot'
+            assert resumed == moved
+            assert _counter(v_reg, 'skytpu_migration_bytes_sum',
+                            form='raw') > 0
+
+            # The victim exits on its own once relays finish ...
+            _wait_down(victim)
+            # ... the chaos SIGKILL after the notice is then a no-op
+            # for in-flight work.  Both pools end clean.
+            assert victim.engine.allocator_leak_report() is None
+            with urllib.request.urlopen(
+                    _url(survivor) + '/health?verbose=1',
+                    timeout=10) as resp:
+                detail = json.loads(resp.read())
+            assert detail['leak_report'] is None, detail
+        finally:
+            for srv in (ref, victim, survivor):
+                srv.shutdown()
+
+    def test_classic_drain_still_finishes_locally(self):
+        """No targets -> the pre-migration contract: admission stops,
+        in-flight streams finish HERE, no migration counters move."""
+        srv, reg = _server('llama-tiny', page_size=_PS)
+        try:
+            frags, started, errs = [], threading.Event(), []
+            t = threading.Thread(
+                target=_stream_into,
+                args=(_url(srv), 'FINISH ME LOCALLY', frags, started,
+                      errs),
+                daemon=True)
+            t.start()
+            assert started.wait(60)
+            code, body = _post_json(_url(srv), '/drain', {})
+            assert code == 200, body
+            t.join(timeout=120)
+            assert not errs, errs
+            assert len(frags) >= 1
+            assert _counter(reg, 'skytpu_migration_requests_total',
+                            side='out') == 0
+            _wait_down(srv)
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------
+# Supervisor preemption notice (stub handles; the real migrate-drain
+# wire path is exercised above)
+# ---------------------------------------------------------------------
+
+class _NullHandle:
+
+    def __init__(self):
+        self._forced = None
+
+    def poll(self):
+        return self._forced
+
+    def kill(self):
+        self._forced = -9
+
+    def terminate(self):
+        self._forced = -15
+
+
+class _DrainRecorder:
+    """Stub replica endpoint recording /drain payloads."""
+
+    def __init__(self):
+        import http.server
+        outer = self
+
+        class _H(http.server.BaseHTTPRequestHandler):
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):  # noqa: N802 (stdlib API name)
+                n = int(self.headers.get('Content-Length', 0))
+                outer.posts.append(
+                    (self.path, json.loads(self.rfile.read(n))))
+                body = b'{"status": "draining"}'
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.posts = []
+        self.server = http.server.ThreadingHTTPServer(
+            ('127.0.0.1', 0), _H)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        self.url = f'http://127.0.0.1:{self.server.server_address[1]}'
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class TestPreemptionNotice:
+
+    def test_chaos_kill_with_notice_migrates_first(self, monkeypatch):
+        """With SKYTPU_PREEMPT_NOTICE_S set, the chaos replica_kill
+        becomes a TPU-preemption: the victim is marked draining,
+        receives a migrate /drain naming every survivor, and only
+        then gets the SIGKILL."""
+        recorder = _DrainRecorder()
+        registry = metrics_lib.Registry()
+        router = Router(registry=registry, health_interval_s=3600.0)
+        urls = [recorder.url, 'http://127.0.0.1:1/survivor']
+        handles = []
+
+        def factory(slot_id):
+            handle = _NullHandle()
+            handles.append(handle)
+            return handle, urls[slot_id % len(urls)]
+
+        sup = sup_lib.ReplicaSupervisor(
+            factory, router, min_replicas=2, tick_s=3600.0,
+            restart_base_delay_s=0.0, registry=registry)
+        try:
+            sup.tick()  # spawn both slots
+            assert len(handles) == 2
+            monkeypatch.setenv('SKYTPU_PREEMPT_NOTICE_S', '0.01')
+            # Deterministic victim: first live slot (seeded chaos).
+            chaos.configure('replica_kill:p=1,n=1,seed=0')
+            try:
+                sup.tick()
+            finally:
+                chaos.disable()
+            killed = [h for h in handles if h.poll() == -9]
+            assert len(killed) == 1
+            assert len(recorder.posts) <= 1
+            if recorder.posts:  # victim was the recordable slot
+                path, payload = recorder.posts[0]
+                assert path == '/drain'
+                assert payload['migrate'] is True
+                assert payload['targets'] == \
+                    ['http://127.0.0.1:1/survivor']
+                victim_view = next(v for v in router.views()
+                                   if v.url == recorder.url)
+                assert not victim_view.routable
+        finally:
+            sup.stop(kill_replicas=False)
+            router.stop()
+            recorder.close()
+
+    def test_scale_down_drain_names_survivors(self):
+        """The supervisor's graceful scale-down posts the same migrate
+        payload: every other live handoff-capable replica is a
+        target."""
+        recorder = _DrainRecorder()
+        registry = metrics_lib.Registry()
+        router = Router(registry=registry, health_interval_s=3600.0)
+
+        def factory(slot_id):
+            return _NullHandle(), \
+                recorder.url if slot_id == 0 else \
+                f'http://127.0.0.1:1/{slot_id}'
+
+        sup = sup_lib.ReplicaSupervisor(
+            factory, router, min_replicas=2, tick_s=3600.0,
+            restart_base_delay_s=0.0, registry=registry)
+        try:
+            sup.tick()
+            victim = next(s for s in sup.slots()
+                          if s.url == recorder.url)
+            sup._begin_drain(victim)  # pylint: disable=protected-access
+            assert recorder.posts, 'drain POST never arrived'
+            _, payload = recorder.posts[0]
+            assert payload['migrate'] is True
+            assert payload['targets'] == ['http://127.0.0.1:1/1']
+        finally:
+            sup.stop(kill_replicas=False)
+            router.stop()
+            recorder.close()
+
+
+# ---------------------------------------------------------------------
+# Fleet prefix tier HTTP surfaces
+# ---------------------------------------------------------------------
+
+class TestKvPrefixSurface:
+
+    @pytest.fixture(scope='class')
+    def spilled_pair(self):
+        """An owner replica whose starved pool has spilled prefix
+        pages to its host tier, plus a cold peer of identical
+        geometry."""
+        kw = dict(page_size=_PS, max_pages=10, prefill_chunk=_PS,
+                  host_cache_bytes=64 << 20)
+        owner, owner_reg = _server('llama-tiny', **kw)
+        peer, peer_reg = _server('llama-tiny', **kw)
+        prompts = [list(range(1, 29)), list(range(30, 58)),
+                   list(range(60, 88))]
+        for p in prompts:
+            code, body = _post_json(
+                _url(owner), '/generate',
+                {'prompt_ids': [p], 'max_new_tokens': 4,
+                 'temperature': 0.0})
+            assert code == 200, body
+        assert owner.engine.host_cache_stats()['stored_pages'] > 0
+        yield owner, peer, prompts, owner_reg, peer_reg
+        owner.shutdown()
+        peer.shutdown()
+
+    def test_bad_hashes_rejected(self, spilled_pair):
+        owner = spilled_pair[0]
+        # Malformed hashes are the caller's bug (400); an absent or
+        # empty chain is just a miss (404) — fetch treats both as
+        # survivable.
+        for q, want in (('', 404), ('?hashes=', 404),
+                        ('?hashes=1,nope', 400)):
+            try:
+                with urllib.request.urlopen(
+                        _url(owner) + '/kv_prefix' + q,
+                        timeout=10) as resp:
+                    code = resp.status
+            except urllib.error.HTTPError as e:
+                with e:
+                    code = e.code
+            assert code == want, q
+
+    def test_miss_is_404(self, spilled_pair):
+        owner = spilled_pair[0]
+        try:
+            with urllib.request.urlopen(
+                    _url(owner) + '/kv_prefix?hashes=424242',
+                    timeout=10) as resp:
+                code = resp.status
+        except urllib.error.HTTPError as e:
+            with e:
+                code = e.code
+        assert code == 404
+
+    def test_peer_fetch_and_ingest_round_trip(self, spilled_pair):
+        """fetch_prefix_from_peer against a real /kv_prefix serves the
+        spilled leading run, and a same-geometry peer ingests every
+        page into its own host tier."""
+        owner, peer, prompts = spilled_pair[:3]
+        eng = owner.engine
+        # Find a chain with at least one spilled page.
+        for p in prompts:
+            hashes = paging.chain_hashes(p, _PS)
+            pages = fleet_cache.fetch_prefix_from_peer(
+                _url(owner), hashes, eng._model_name,  # pylint: disable=protected-access
+                eng.kv_cache_dtype, _PS)
+            if pages:
+                break
+        else:
+            raise AssertionError('no chain had spilled pages')
+        assert peer.engine.ingest_prefix_pages(pages) == len(pages)
+        got = peer.engine.prefix_resident_run(
+            [h for h, _ in pages])
+        assert got == len(pages)
+
+    def test_prefix_peer_header_prefetches(self, spilled_pair):
+        """A request landing on the non-owner with the router's
+        X-Skytpu-Prefix-Peer header warms the local tier from the
+        owner before admission — and the answer matches the owner's
+        byte-for-byte."""
+        owner, peer, prompts = spilled_pair[:3]
+        prompt = prompts[0]
+        code, body = _post_json(
+            _url(owner), '/generate',
+            {'prompt_ids': [prompt], 'max_new_tokens': 4,
+             'temperature': 0.0})
+        assert code == 200
+        want = json.loads(body)['tokens']
+        req = urllib.request.Request(
+            _url(peer) + '/generate',
+            data=json.dumps({'prompt_ids': [prompt],
+                             'max_new_tokens': 4,
+                             'temperature': 0.0}).encode(),
+            method='POST',
+            headers={'Content-Type': 'application/json',
+                     handoff_lib.PREFIX_PEER_HEADER: _url(owner)})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            got = json.loads(resp.read())['tokens']
+        assert got == want
+        stats = peer.engine.host_cache_stats()
+        assert stats['rehydrated_pages_total'] > 0, \
+            'prefetch never warmed the peer tier'
+
+
+class TestTier1Guard:
+
+    def test_runs_on_cpu_backend(self):
+        assert jax.default_backend() == 'cpu'

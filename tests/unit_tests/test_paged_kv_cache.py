@@ -202,6 +202,24 @@ class TestAdmissionBackpressure:
         assert eng.generate(prompts, _GREEDY) == want
         assert eng._alloc.live_pages == 0
 
+    def test_impossible_request_rejected_at_submit(self):
+        # A request whose worst-case footprint (bucketed prompt pad +
+        # decode budget) exceeds pool CAPACITY can never be admitted,
+        # no matter what drains: submit() must fail it synchronously
+        # (-> HTTP 400) instead of letting admission spin on
+        # backpressure forever.
+        ov = _FAMILIES['llama-tiny']
+        eng = _cbe('llama-tiny', ov, page_size=_PS, max_pages=3)
+        # capacity = 2 usable pages = 16 token-slots; 5 prompt tokens
+        # pad to 8, +12 new = 20 > 16 -> 3 pages needed, 2 exist.
+        with pytest.raises(ValueError, match='pool holds only'):
+            eng.submit([5, 17, 3, 42, 8],
+                       engine_lib.SamplingConfig(max_new_tokens=12,
+                                                 temperature=0.0))
+        # The engine keeps serving admissible work afterwards.
+        assert len(eng.generate([[5, 17, 3]], _GREEDY)[0]) == _MAX_NEW
+        assert eng._alloc.live_pages == 0
+
 
 class TestReadBytesScaling:
     """The tentpole's claim: paged decode reads scale with LIVE

@@ -83,13 +83,16 @@ def test_metrics_scrape_after_round_trip(server):
     # router/supervisor process; skytpu_spec_* only registers on
     # engines started with spec_k > 0 (this server speculates not);
     # skytpu_handoff_* only registers on engines started with a
-    # disaggregated role (this server runs --role both).
+    # disaggregated role (this server runs --role both);
+    # skytpu_migration_* registers lazily on the first migrate-drain
+    # export/admit (this server never drains).
     expected = {n for n in observability.METRIC_CONTRACT
                 if not n.startswith(('skytpu_train_',
                                      'skytpu_router_',
                                      'skytpu_fleet_',
                                      'skytpu_spec_',
-                                     'skytpu_handoff_'))
+                                     'skytpu_handoff_',
+                                     'skytpu_migration_'))
                 and n != 'skytpu_slo_burn_rate'}
     assert scraped == expected, scraped ^ expected
     # Exposition format details the contract set cannot express:
