@@ -416,7 +416,7 @@ def run_direct(quick: bool, steps_arg) -> None:
           attn_flops_per_token=_attn_flops_per_token(overrides, seq))
 
 
-def run_decode(steps_arg, smoke: bool = False) -> None:
+def run_decode(steps_arg, smoke: bool = False) -> dict:
     """CPU decode microbench, three arms: grouped-bf16 KV vs
     grouped-int8 KV (uniform prompts), then contiguous vs PAGED KV on
     a ragged-length workload — per-step decode throughput through the
@@ -459,6 +459,7 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
     import numpy as np
 
     from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.observability import ledger as ledger_lib
     from skypilot_tpu.observability import metrics as metrics_lib
     from skypilot_tpu.parallel import mesh as mesh_lib
 
@@ -544,12 +545,13 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
                                             temperature=0.0)
     pg_overrides = dict(overrides, max_seq_len=pg_seq)
 
-    def _ragged_arm(page_size, registry=None):
+    def _ragged_arm(page_size, registry=None, step_ledger=None):
         eng = engine_lib.ContinuousBatchingEngine(
             'deepseek-v2-lite', n_slots=n_slots, prefill_bucket=8,
             model_overrides=dict(pg_overrides),
             param_dtype=jnp.float32, params=params,
-            page_size=page_size, registry=registry)
+            page_size=page_size, registry=registry,
+            step_ledger=step_ledger)
         eng.generate(pg_prompts, pg_sampling)      # compile warmup
         t0 = time.time()
         outs = eng.generate(pg_prompts, pg_sampling)
@@ -617,6 +619,30 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
         publish_s = (time.perf_counter() - t0) / iters
     _, dis_outs, dis_dt = _ragged_arm(
         pg_ps, registry=metrics_lib.Registry(enabled=False))
+    # Ledger-off rerun: the step ledger's contract is that disabling
+    # it changes NOTHING about the token stream (it only ever reads
+    # host scalars at commit time) — assert bit-identical greedy
+    # output, and report the wall-rate cross-check alongside the
+    # disabled-registry one.
+    _, loff_outs, loff_dt = _ragged_arm(
+        pg_ps, registry=metrics_lib.Registry(),
+        step_ledger=ledger_lib.StepLedger(enabled=False))
+    ledger_off_parity = [list(a) for a in loff_outs] == \
+        [list(a) for a in paged_outs]
+    assert ledger_off_parity, \
+        'disabling the step ledger changed the greedy token stream'
+    # record() microbench: the only ledger cost on the scheduler
+    # thread, as a fraction of this run's measured step time (same
+    # framing as the metric-publish microbench below).
+    led_iters = 256
+    led = paged_eng.step_ledger
+    t0 = time.perf_counter()
+    for i in range(led_iters):
+        led.record(step=i, mode='bench', t_enter=0.0, t_dispatch=0.0,
+                   t_join=1e-3, t_commit=1e-3, rows=n_slots,
+                   tokens=n_slots, ctx_sum=n_slots * 64,
+                   read_bytes=1e6)
+    ledger_record_s = (time.perf_counter() - t0) / led_iters
     telemetry = {
         'prefix_page_hits': t_hits,
         'prefix_page_misses': t_misses,
@@ -632,6 +658,13 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
             100.0 * publish_s / max(paged_dt / paged_steps, 1e-9), 3),
         'tokens_per_sec_paged_disabled_registry': round(
             sum(len(o) for o in dis_outs) / max(dis_dt, 1e-9), 1),
+        'tokens_per_sec_paged_ledger_off': round(
+            sum(len(o) for o in loff_outs) / max(loff_dt, 1e-9), 1),
+        'ledger_off_token_parity': ledger_off_parity,
+        'ledger_record_us_per_step': round(ledger_record_s * 1e6, 2),
+        'ledger_record_pct_of_step': round(
+            100.0 * ledger_record_s
+            / max(paged_dt / paged_steps, 1e-9), 3),
     }
 
     # --- fourth arm: speculative decoding (gpt2 draft/target pair) ---
@@ -1100,6 +1133,14 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
         'n_heads': 16,
         'kv_heads_in_cache': 1,
         'device_kind': jax.devices()[0].device_kind,
+        # Step-ledger window from the async arm's engine (paged-int8
+        # speculative — the headline serving configuration): achieved
+        # MFU, step-time percentiles, roofline verdict.  CPU MFU is
+        # normalized to v6e peak (same convention as the train-side
+        # MFU), so the absolute value is tiny but comparable across
+        # runs — which is what --check-baseline gates on.
+        'ledger': {**ap_async_eng.step_ledger.summary(),
+                   'info': ap_async_eng.ledger_info()},
     }
     print(json.dumps(result))
     for name, arm, dt, tokens in (('bf16-KV', bf16_arm, bf16_dt,
@@ -1171,6 +1212,19 @@ def run_decode(steps_arg, smoke: bool = False) -> None:
           f'metric publish {telemetry["publish_us_per_step"]:.1f} '
           f'us/step = {telemetry["publish_pct_of_step"]:.2f}% of a '
           f'decode step', file=sys.stderr)
+    led_block = result['ledger']
+    print(f'# ledger [async arm]: {led_block["steps"]} steps, '
+          f'achieved MFU {led_block["achieved_mfu"]:.6f}, step p50 '
+          f'{led_block["step_ms_p50"]:.2f} ms / p99 '
+          f'{led_block["step_ms_p99"]:.2f} ms, roofline '
+          f'{led_block["roofline_verdict"]} '
+          f'({100 * led_block["roofline"]["memory_bound"]:.0f}% '
+          f'memory-bound); ledger record '
+          f'{telemetry["ledger_record_us_per_step"]:.1f} us/step = '
+          f'{telemetry["ledger_record_pct_of_step"]:.2f}% of a decode '
+          f'step, ledger-off parity: {ledger_off_parity}',
+          file=sys.stderr)
+    return result
 
 
 def _serve_disagg_arm(smoke: bool, max_new: int, overrides: dict,
@@ -2028,6 +2082,71 @@ def _require_stdout_purity() -> None:
         sys.exit(2)
 
 
+def _check_baseline(result: dict, baseline_path: str,
+                    tolerance: float = None) -> int:
+    """Regression gate for --decode: compare this run's throughput and
+    achieved MFU against a saved JSON line (a BENCH_rXX.json capture,
+    or this run's own emission for the smoke self-check).  Returns a
+    process exit code — 0 when every comparable metric is within
+    tolerance, 1 on regression.  Metrics missing from either side are
+    skipped (old baselines predate the ledger block and must keep
+    passing); all diagnostics go to stderr (stdout purity)."""
+    tol = tolerance if tolerance is not None else float(
+        os.environ.get('SKYTPU_BENCH_REGRESSION_TOL', '0.25'))
+    try:
+        with open(baseline_path, encoding='utf-8') as f:
+            base = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f'# check-baseline: cannot read {baseline_path}: {e}',
+              file=sys.stderr)
+        return 1
+
+    def _num(doc, *keys):
+        for k in keys:
+            if not isinstance(doc, dict) or k not in doc:
+                return None
+            doc = doc[k]
+        return float(doc) if isinstance(doc, (int, float)) \
+            and not isinstance(doc, bool) else None
+
+    gates = (
+        ('async tokens/sec',
+         ('arms', 'async', 'tokens_per_sec_async')),
+        ('paged tokens/sec',
+         ('arms', 'paged', 'tokens_per_sec_paged')),
+        ('achieved MFU', ('ledger', 'achieved_mfu')),
+    )
+    failures = []
+    compared = 0
+    for name, keys in gates:
+        have = _num(result, *keys)
+        want = _num(base, *keys)
+        if have is None or want is None or want <= 0:
+            print(f'# check-baseline: {name} not comparable '
+                  f'(current={have}, baseline={want}); skipped',
+                  file=sys.stderr)
+            continue
+        compared += 1
+        floor = want * (1.0 - tol)
+        verdict = 'ok' if have >= floor else 'REGRESSION'
+        print(f'# check-baseline: {name} {have:g} vs baseline '
+              f'{want:g} (floor {floor:g}, tol {tol:.0%}) -> '
+              f'{verdict}', file=sys.stderr)
+        if have < floor:
+            failures.append(name)
+    if not compared:
+        print('# check-baseline: no comparable metrics in '
+              f'{baseline_path}', file=sys.stderr)
+        return 1
+    if failures:
+        print(f'# check-baseline FAILED: {", ".join(failures)} '
+              f'regressed beyond {tol:.0%}', file=sys.stderr)
+        return 1
+    print(f'# check-baseline passed: {compared} metrics within '
+          f'{tol:.0%} of {baseline_path}', file=sys.stderr)
+    return 0
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--quick', action='store_true',
@@ -2048,11 +2167,38 @@ def main() -> None:
                         help='With --decode/--serve: shrink the '
                              'workload so the full arm fits in a '
                              'CPU-only tier-1 test.')
+    parser.add_argument('--check-baseline', default=None,
+                        metavar='BENCH_rXX.json',
+                        help='With --decode: compare this run against '
+                             'a saved JSON line and exit nonzero when '
+                             'tokens/sec or achieved MFU regressed '
+                             'beyond SKYTPU_BENCH_REGRESSION_TOL '
+                             '(default 25%%).')
     args = parser.parse_args()
     if args.smoke:
         _require_stdout_purity()
     if args.decode:
-        run_decode(args.steps, smoke=args.smoke)
+        result = run_decode(args.steps, smoke=args.smoke)
+        if args.check_baseline:
+            sys.exit(_check_baseline(result, args.check_baseline))
+        if args.smoke:
+            # Self-check: the gate compared against this run's OWN
+            # emission must be trivially green — exercises the whole
+            # --check-baseline path (file read, key walk, tolerance
+            # math) in tier-1 without a stored baseline.
+            import tempfile
+            with tempfile.NamedTemporaryFile(
+                    'w', suffix='.json', delete=False) as f:
+                json.dump(result, f)
+                self_path = f.name
+            try:
+                rc = _check_baseline(result, self_path)
+            finally:
+                os.unlink(self_path)
+            if rc != 0:
+                print('# bench --smoke: check-baseline self-check '
+                      'FAILED', file=sys.stderr)
+                sys.exit(rc)
         return
     if args.serve:
         run_serve(args.steps, smoke=args.smoke)

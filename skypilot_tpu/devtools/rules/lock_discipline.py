@@ -34,7 +34,8 @@ THREAD_RULE_ID = 'thread-discipline'
 
 _LOCK_FILES = ('infer/engine.py', 'infer/paging.py', 'infer/server.py',
                'infer/handoff.py', 'infer/fleet_cache.py',
-               'serve/router.py', 'serve/replica_supervisor.py')
+               'serve/router.py', 'serve/replica_supervisor.py',
+               'observability/ledger.py')
 
 _MUTATORS = {'append', 'appendleft', 'extend', 'insert', 'add',
              'update', 'setdefault', 'pop', 'popleft', 'popitem',

@@ -42,6 +42,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import skypilot_tpu.models as models_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu.infer import failures
+from skypilot_tpu.observability import ledger as ledger_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing as tracing_lib
 from skypilot_tpu.parallel import sharding as sharding_lib
@@ -781,6 +782,11 @@ class _Slot:
     # generated on a speculating engine (multi-token commits), and the
     # per-request tokens_per_step trace field derives from it.
     steps: int = 0
+    # Global engine step indices of this slot's first/last token
+    # commits — stamped at consume time, handed to the request trace
+    # at completion so /traces?id= joins against the step ledger.
+    first_step_idx: Optional[int] = None
+    last_step_idx: Optional[int] = None
     outputs: List[int] = dataclasses.field(default_factory=list)
     # Paged cache only: this slot's allocated page ids (block-table
     # prefix), released back to the allocator on completion/eviction.
@@ -1056,6 +1062,20 @@ class _ServingMetrics:
             'skytpu_kv_pages_used_peak',
             'High-watermark of KV pages in use since engine start '
             '(0 on contiguous-cache engines).')
+        # Step-ledger roofline surface (observability/ledger.py): the
+        # last committed step's achieved MFU and the analytic forward
+        # FLOPs/token at its live context — 0 with the ledger off.
+        self.step_mfu = r.gauge(
+            'skytpu_step_mfu',
+            'Achieved model-FLOPs utilization of the last committed '
+            'step (analytic 2*active-params + attention model over '
+            'the chip generation\'s bf16 peak; 0 with the step '
+            'ledger disabled).')
+        self.model_flops_per_token = r.gauge(
+            'skytpu_model_flops_per_token',
+            'Analytic forward FLOPs per token at the last step\'s '
+            'live context (models.flops_per_token_parts; 0 with the '
+            'step ledger disabled).')
         self.device_memory_peak = r.gauge(
             'skytpu_device_memory_peak_bytes',
             'Device-allocator peak bytes in use, from '
@@ -1248,6 +1268,34 @@ def _trace_store_from_env() -> tracing_lib.TraceStore:
         jsonl_path=os.environ.get('SKYTPU_TRACE_JSONL') or None)
 
 
+def _step_ledger_from_env(config: Any, model_name: str,
+                          n_chips: int) -> ledger_lib.StepLedger:
+    """Step ledger wired to this engine's model + chips: FLOP
+    constants from the analytic per-family estimator, peak/bandwidth
+    from the accelerator registry (CPU dev backends normalize to v6e,
+    same convention as bench.py's _V6E_TFLOPS fallback, so roofline
+    verdicts stay comparable across machines).  SKYTPU_STEP_LEDGER=0
+    disables (near-free: record() early-returns per step);
+    SKYTPU_STEP_LEDGER_CAP sizes the ring."""
+    from skypilot_tpu.utils import accelerator_registry as accel_lib
+    enabled = os.environ.get('SKYTPU_STEP_LEDGER', '1') != '0'
+    try:
+        cap = int(os.environ.get('SKYTPU_STEP_LEDGER_CAP', '') or 512)
+    except ValueError:
+        cap = 512
+    base, attn = models_lib.flops_per_token_parts(config)
+    device_kind = jax.devices()[0].device_kind
+    gen = accel_lib.generation_for_device_kind(device_kind)
+    if gen is None:
+        gen = accel_lib.TPU_GENERATIONS['v6e']
+    return ledger_lib.StepLedger(
+        capacity=cap, enabled=enabled,
+        flops_per_token_base=base, attn_flops_per_ctx_token=attn,
+        peak_flops_per_sec=gen.bf16_tflops_per_chip * 1e12 * n_chips,
+        hbm_bytes_per_sec=gen.hbm_gbps_per_chip * 1e9 * n_chips,
+        model=model_name, device_kind=device_kind, n_chips=n_chips)
+
+
 class ContinuousBatchingEngine:
     """Slot-based continuous batching over the KV-cache model.
 
@@ -1304,7 +1352,9 @@ class ContinuousBatchingEngine:
                  prefill_kernel: str = 'auto',
                  prefill_mix_budget: int = 0,
                  role: str = 'both',
-                 host_cache_bytes: int = 0) -> None:
+                 host_cache_bytes: int = 0,
+                 step_ledger: Optional[ledger_lib.StepLedger] = None
+                 ) -> None:
         import collections
 
         if draft_model is not None and spec_k <= 0:
@@ -1858,6 +1908,17 @@ class ContinuousBatchingEngine:
             prefill_kernel=self.prefill_kernel)
         self._prefill_read_bytes_per_pos = _pr['grouped_bytes']
         self._prefill_epilogue_bytes_per_pos = _pr['epilogue_bytes']
+        # -- step-level performance ledger (observability/ledger.py) --
+        # Fed at step-COMMIT time in _consume_step (the consume half;
+        # the pipeline-discipline rule keeps it off the dispatch
+        # half).  The step counter increments whether or not the
+        # ledger records, so trace step-index joins survive a
+        # disabled ledger.
+        self._step_idx = 0
+        self.step_ledger = (step_ledger if step_ledger is not None
+                            else _step_ledger_from_env(
+                                self.config, self._model_name,
+                                self._mesh_devices))
 
         # -- host-RAM spill tier + fleet prefix cache -----------------
         # (infer/fleet_cache.py).  When configured, the allocator's
@@ -3471,7 +3532,9 @@ class ContinuousBatchingEngine:
             slot.request_id,
             'cancelled' if was_canceled else 'finished',
             output_tokens=len(slot.outputs),
-            decode_steps=slot.steps)
+            decode_steps=slot.steps,
+            first_step_idx=slot.first_step_idx,
+            last_step_idx=slot.last_step_idx)
         if was_canceled:
             self._met.cancelled.inc()
         else:
@@ -4275,6 +4338,10 @@ class ContinuousBatchingEngine:
         stamp.  A slot whose request id changed since dispatch
         (evicted, aborted, recycled) is skipped — the guard that
         makes abort/cancel between dispatch and consume safe."""
+        self._step_idx += 1
+        step_idx = self._step_idx
+        ctx_sum = 0
+        spec_accepted = 0
         if handle.mode in ('plain', 'mixed'):
             toks = handle.host[0]
             n_tokens = None
@@ -4283,6 +4350,13 @@ class ContinuousBatchingEngine:
                 if s is None or s.request_id != rid:
                     continue
                 s.steps += 1
+                # Live context this row's new token attended over
+                # (ledger FLOP estimate) — host ints already in hand.
+                ctx_sum += s.prompt_len + s.generated + 1
+                if s.first_step_idx is None:
+                    s.first_step_idx = step_idx
+                    self.traces.annotate(rid, first_step_idx=step_idx)
+                s.last_step_idx = step_idx
                 self._commit_token(i, int(toks[i]))
         else:
             toks, counts = handle.host
@@ -4296,10 +4370,17 @@ class ContinuousBatchingEngine:
                 if s is None or s.request_id != rid:
                     continue
                 s.steps += 1
+                if s.first_step_idx is None:
+                    s.first_step_idx = step_idx
+                    self.traces.annotate(rid, first_step_idx=step_idx)
+                s.last_step_idx = step_idx
                 for j in range(n):
                     committed += 1
                     if self._commit_token(i, int(toks[i, j])):
                         break       # eos/budget: drop the tail
+                # Post-commit context, n committed tokens' worth — an
+                # analytic estimate, not a per-position integral.
+                ctx_sum += n * (s.prompt_len + s.generated)
             self._spec_met['steps'].inc()
             self._spec_met['proposed'].inc(handle.spec_proposed)
             self._spec_met['accepted'].inc(accepted)
@@ -4307,16 +4388,43 @@ class ContinuousBatchingEngine:
             self._spec_proposed_n += handle.spec_proposed
             self._spec_accepted_n += accepted
             n_tokens = committed
-        if handle.mix:
-            self._advance_mix(handle)
+            spec_accepted = accepted
+        mix_tokens = self._advance_mix(handle) if handle.mix else 0
         self._publish_step_metrics(
             len(handle.occupied), handle.read_bytes,
             dispatch_s=handle.t_dispatched - handle.t_enter,
             device_wait_s=device_wait_s,
             compiled=handle.compiled, n_tokens=n_tokens,
             host_overlap_s=overlap_s)
+        led = self.step_ledger
+        if led.enabled:
+            free = used = None
+            if self._alloc is not None:
+                free = self._alloc.free_pages
+                used = self._alloc.n_pages - 1 - free
+            rec = led.record(
+                step=step_idx, mode=handle.mode,
+                t_enter=handle.t_enter,
+                t_dispatch=handle.t_dispatched,
+                t_join=handle.t_fetched,
+                t_commit=time.perf_counter(),
+                rows=len(handle.occupied),
+                tokens=(len(handle.occupied) if n_tokens is None
+                        else n_tokens),
+                ctx_sum=ctx_sum, read_bytes=handle.read_bytes,
+                mix_tokens=mix_tokens,
+                spec_proposed=handle.spec_proposed,
+                spec_accepted=spec_accepted,
+                decode_kernel=self.decode_kernel,
+                prefill_kernel=self.prefill_kernel,
+                free_pages=free, used_pages=used,
+                compiled=handle.compiled)
+            if rec is not None:
+                self._met.step_mfu.set(rec['mfu'])
+                self._met.model_flops_per_token.set(
+                    rec['flops_per_token'])
 
-    def _advance_mix(self, handle: _InflightStep) -> None:
+    def _advance_mix(self, handle: _InflightStep) -> int:
         """Consume-side bookkeeping for the prefill chunks that rode
         this step: advance each pending's cursor, and promote a
         prompt that just finished into a live _Slot.  A pending
@@ -4338,6 +4446,7 @@ class ContinuousBatchingEngine:
         if advanced:
             self._met.prefill_mix_tokens.inc(advanced)
             self._met.prefill_mixed_steps.inc()
+        return advanced
 
     def _finish_mixed(self, pending: _PendingPrefill,
                       seed_tok: Optional[int]) -> None:
@@ -4522,6 +4631,10 @@ class ContinuousBatchingEngine:
         if self._alloc is None:
             return None
         return self._alloc.free_pages
+
+    def ledger_info(self) -> Dict[str, Any]:
+        """Step-ledger config/state block for /health?verbose=1."""
+        return self.step_ledger.info()
 
     def speculation_info(self) -> Optional[Dict[str, Any]]:
         """Speculation summary for /health?verbose=1 (None when
@@ -4841,6 +4954,18 @@ class InferenceEngine:
         else:
             self._read_bytes_per_pos = self.cache_read_bytes_per_step(
                 context=1)['grouped_bytes']
+        # Per-step performance ledger (shares the continuous engine's
+        # env construction; see observability/ledger.py).  The step
+        # counter lives outside the ledger so /traces step-index joins
+        # survive SKYTPU_STEP_LEDGER=0.
+        self._step_idx = 0
+        self.step_ledger = _step_ledger_from_env(
+            self.config, self._model_name,
+            mesh.devices.size if mesh is not None else 1)
+
+    def ledger_info(self) -> Dict[str, Any]:
+        """Static ledger facts for /health?verbose=1 and /profile."""
+        return self.step_ledger.info()
 
     # -- weights -----------------------------------------------------------
     def _place(self, params, shardings):
@@ -5090,7 +5215,10 @@ class InferenceEngine:
             outputs: List[List[int]] = [[] for _ in range(n)]
             done = np.zeros((b,), bool)
             done[n:] = True
+            first_step: List[Optional[int]] = [None] * n
+            last_step: List[Optional[int]] = [None] * n
             for t in range(cfg.max_new_tokens):
+                t_dispatch = time.perf_counter()
                 tok_dev, last, cache, kv_mask = self._decode(
                     self.params, cache, last, kv_mask, lengths_dev,
                     # skylint: disable=key-reuse (root key; _decode_step fold_ins per-step)
@@ -5098,10 +5226,20 @@ class InferenceEngine:
                     jnp.asarray(~done), temperature=cfg.temperature,
                     top_k=cfg.top_k, top_p=cfg.top_p)
                 next_tok = np.asarray(jax.device_get(tok_dev))
+                t_join = time.perf_counter()
+                self._step_idx += 1
+                step_idx = self._step_idx
                 live = 0
+                ctx_sum = 0
                 for i in range(n):
                     if not done[i]:
                         live += 1
+                        # Attention this step spans the prompt plus
+                        # everything decoded so far plus this token.
+                        ctx_sum += int(lengths[i]) + t + 1
+                        if first_step[i] is None:
+                            first_step[i] = step_idx
+                        last_step[i] = step_idx
                         outputs[i].append(int(next_tok[i]))
                         if len(outputs[i]) == 1:
                             self.traces.event(rids[i], 'first_token')
@@ -5114,11 +5252,27 @@ class InferenceEngine:
                 met.live_slots.set(live)
                 met.occupancy.set(live / self.max_batch)
                 met.read_bytes.observe(step_read_bytes)
+                led = self.step_ledger
+                if led.enabled:
+                    # Whole-batch generate has no dispatch/consume
+                    # split: the step's wall time is dispatch->join.
+                    rec = led.record(
+                        step=step_idx, mode='plain',
+                        t_enter=t_dispatch, t_dispatch=t_dispatch,
+                        t_join=t_join, t_commit=time.perf_counter(),
+                        rows=live, tokens=live, ctx_sum=ctx_sum,
+                        read_bytes=step_read_bytes)
+                    if rec is not None:
+                        met.step_mfu.set(rec['mfu'])
+                        met.model_flops_per_token.set(
+                            rec['flops_per_token'])
                 if done.all():
                     break
         for i, rid in enumerate(rids):
             trace = self.traces.finish(rid, 'finished',
-                                       output_tokens=len(outputs[i]))
+                                       output_tokens=len(outputs[i]),
+                                       first_step_idx=first_step[i],
+                                       last_step_idx=last_step[i])
             met.finished.inc()
             met.observe_finished(trace)
         met.live_slots.set(0)

@@ -92,6 +92,7 @@ from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import failures
 from skypilot_tpu.infer import handoff as handoff_lib
 from skypilot_tpu.observability import events as events_lib
+from skypilot_tpu.observability import ledger as ledger_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing as tracing_lib
 from skypilot_tpu.utils import chaos
@@ -107,9 +108,10 @@ _HTTPServer = http_utils.HighBacklogHTTPServer
 # Known routes by method.  Unknown paths collapse to the 'other' route
 # label so a URL-scanning client cannot mint unbounded label sets.
 _GET_ROUTES = ('/health', '/v1/models', '/metrics', '/traces',
-               '/events', '/kv_prefix')
+               '/events', '/kv_prefix', '/profile/steps',
+               '/profile/timeline')
 _POST_ROUTES = ('/generate', '/v1/completions', '/v1/chat/completions',
-                '/drain', '/handoff')
+                '/drain', '/handoff', '/profile/device')
 
 _REQUEST_ID_RE = re.compile(r'[A-Za-z0-9._:-]{1,64}$')
 
@@ -126,6 +128,11 @@ class _Shed(Exception):
         super().__init__(message)
         self.reason = reason
         self.retry_after = retry_after
+
+
+class ProfileActiveError(Exception):
+    """POST /profile/device while a device capture is already armed or
+    running — single-flight, becomes a 409 (retry after the window)."""
 
 
 def _http_metrics(registry: Optional[metrics_lib.Registry] = None):
@@ -367,6 +374,14 @@ class InferenceServer:
         # monotonic ts of the step() call in flight, None between steps;
         # written only by the decode loop, read by the watchdog.
         self._step_started: Optional[float] = None
+        # On-demand device profiler (POST /profile/device): state dict
+        # {'remaining', 'dir', 'active'} or None.  Single-flight —
+        # armed by a handler thread under _profile_lock, consumed by
+        # the decode loop in _profile_tick (jax.profiler traces are
+        # process-global, so two overlapping windows would corrupt
+        # each other; the second POST gets a 409 instead).
+        self._profile_lock = threading.Lock()
+        self._profile: Optional[dict] = None
         # Chaos arms AFTER the warmup generate: injected faults must
         # exercise the supervised loop, not the readiness compile.
         chaos.init_from_env()
@@ -438,7 +453,95 @@ class InferenceServer:
             # pool sharded (kv_heads fast path vs page-/sequence-
             # sharded fallback), and kv-heads per shard.
             detail['sharding'] = sh()
+        li = getattr(eng, 'ledger_info', None)
+        if li is not None:
+            # Step-ledger state: the roofline model in force (peak
+            # TFLOP/s, HBM GB/s, analytic FLOPs/token) plus the last
+            # committed step's MFU/verdict.
+            detail['ledger'] = li()
         return detail
+
+    # -- on-demand device profiler + step-ledger surfaces -------------
+    def request_device_profile(self, steps: int) -> dict:
+        """Arm a windowed `jax.profiler` capture of the next `steps`
+        busy decode ticks (the trainer's SKYTPU_PROFILE_DIR idiom,
+        ported to serving).  The capture starts on the next busy step
+        — an armed-but-idle replica stays pending — and stops after
+        the window (or when work dries up).  Raises
+        ProfileActiveError (-> 409) while a window is armed/active."""
+        if not self.continuous:
+            raise ValueError(
+                'device profiling requires continuous batching (the '
+                'capture window rides the decode loop); drop '
+                '--no-continuous.')
+        if not isinstance(steps, int) or isinstance(steps, bool) \
+                or steps < 1:
+            raise ValueError(
+                f'steps must be a positive integer, got {steps!r}')
+        profile_dir = os.environ.get('SKYTPU_PROFILE_DIR', '')
+        if not profile_dir:
+            profile_dir = os.path.join(
+                os.environ.get('SKYTPU_LOG_DIR', os.getcwd()),
+                'profile')
+        with self._profile_lock:
+            if self._profile is not None:
+                state = ('active' if self._profile.get('active')
+                         else 'armed')
+                raise ProfileActiveError(
+                    f'a device-profile window is already {state} '
+                    f"({self._profile['remaining']} steps remaining); "
+                    'retry after it completes')
+            self._profile = {'remaining': steps, 'dir': profile_dir,
+                             'active': False}
+        self.events.record('device_profile_armed', steps=steps)
+        return {'status': 'armed', 'steps': steps, 'dir': profile_dir}
+
+    def _profile_tick(self, busy: bool) -> None:
+        """Decode-loop half of the device profiler: start the trace on
+        the first busy step after arming, count busy steps down, stop
+        when the window closes (or the engine goes idle mid-window)."""
+        import jax
+        with self._profile_lock:
+            prof = self._profile
+            if prof is None:
+                return
+            if not prof['active']:
+                if not busy:
+                    return  # armed, waiting for work
+                try:
+                    jax.profiler.start_trace(prof['dir'])
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.exception('device-profile start failed')
+                    self._profile = None
+                    self.events.record('device_profile_failed',
+                                       error=repr(e))
+                    return
+                prof['active'] = True
+                self.events.record('device_profile_started',
+                                   dir=prof['dir'],
+                                   steps=prof['remaining'])
+            if busy:
+                prof['remaining'] -= 1
+            if prof['remaining'] <= 0 or not busy:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.exception('device-profile stop failed')
+                    self.events.record('device_profile_failed',
+                                       error=repr(e))
+                finally:
+                    self._profile = None
+                self.events.record('device_profile_done',
+                                   dir=prof['dir'])
+
+    def profile_timeline(self, trace_limit: int = 256) -> dict:
+        """One Chrome-trace-event JSON joining the step ledger (engine
+        steps with MFU/roofline args) and the per-request lifecycle
+        rows (utils/timeline.py schema; load into Perfetto)."""
+        eng = self.engine
+        return ledger_lib.chrome_trace(
+            eng.step_ledger.snapshot(),
+            eng.traces.recent(trace_limit))
 
     def _fail_replica(self, error: BaseException) -> None:
         """Terminal: mark unhealthy, stop the loop, fail every waiter
@@ -473,6 +576,8 @@ class InferenceServer:
                     self._step_started = time.monotonic()
                     busy = self.engine.step()
                     self._step_started = None
+                    if self._profile is not None:
+                        self._profile_tick(busy)
                     if not busy:
                         self._work.wait(0.05)
                         self._work.clear()
@@ -1281,6 +1386,28 @@ class InferenceServer:
                         limit = 100
                     self._reply(200, {
                         'events': outer.events.snapshot(limit)})
+                elif route == '/profile/steps':
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    try:
+                        limit = int(query.get('limit', ['128'])[0])
+                    except ValueError:
+                        limit = 128
+                    eng = outer.engine
+                    self._reply(200, {
+                        'steps': eng.step_ledger.snapshot(limit),
+                        'info': eng.ledger_info(),
+                        'summary': eng.step_ledger.summary()})
+                elif route == '/profile/timeline':
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    try:
+                        trace_limit = int(
+                            query.get('traces', ['256'])[0])
+                    except ValueError:
+                        trace_limit = 256
+                    self._reply(200,
+                                outer.profile_timeline(trace_limit))
                 elif route == '/kv_prefix':
                     query = urllib.parse.parse_qs(
                         urllib.parse.urlsplit(self.path).query)
@@ -1338,6 +1465,10 @@ class InferenceServer:
                             migrate=bool(payload.get('migrate')),
                             targets=payload.get('targets') or ()))
                         return
+                    if route == '/profile/device':
+                        self._reply(200, outer.request_device_profile(
+                            payload.get('steps', 8)))
+                        return
                     if route == '/generate':
                         self._reply(200, outer._handle_generate(  # pylint: disable=protected-access
                             payload, self.request_id,
@@ -1362,6 +1493,10 @@ class InferenceServer:
                 # artifact.
                 except handoff_lib.HandoffVersionError as e:
                     self._reply(409, {'error': str(e)})
+                except ProfileActiveError as e:
+                    # Device capture is single-flight: a second arm
+                    # while one is pending/running conflicts (409).
+                    self._reply(409, {'error': str(e)})
                 except handoff_lib.HandoffFormatError as e:
                     self._reply(400, {'error': str(e)})
                 except openai_api.OpenAIError as e:
@@ -1372,7 +1507,7 @@ class InferenceServer:
                     # decode too slow) — a gateway-timeout, not a 500.
                     self._reply(504, {'error': str(e)})
                 except ValueError as e:
-                    if route == '/generate':
+                    if route in ('/generate', '/profile/device'):
                         self._reply(400, {'error': str(e)})
                     else:
                         self._reply(

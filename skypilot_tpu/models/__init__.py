@@ -53,6 +53,61 @@ def num_params(config: Any) -> int:
     return llama.num_params(config)
 
 
+def active_params(config: Any) -> int:
+    """Parameters that a forward pass actually multiplies per token.
+
+    Equal to num_params for dense families; MoE families only route
+    each token through `experts_per_token` of the `n_experts` expert
+    FFNs, so the inactive experts' weights are subtracted (DeepSeek's
+    shared experts and first-k dense layers always run and stay
+    counted).  Pure host-side arithmetic — no JAX, no device work."""
+    from skypilot_tpu.models import deepseek, moe
+    total = num_params(config)
+    if isinstance(config, deepseek.DeepSeekConfig):
+        moe_layers = max(0, config.n_layers - config.first_k_dense)
+        inactive = max(0, config.n_experts - config.experts_per_token)
+        # Router-gated experts are 3 matrices (gate/up/down) of
+        # [dim, moe_ffn_dim] each.
+        return total - moe_layers * inactive * 3 * config.dim \
+            * config.moe_ffn_dim
+    if isinstance(config, moe.MoEConfig):
+        inactive = max(0, config.n_experts - config.experts_per_token)
+        return total - config.n_layers * inactive * 3 * config.dim \
+            * config.ffn_dim
+    return total
+
+
+def flops_per_token_parts(config: Any) -> Tuple[float, float]:
+    """(base, attn_per_ctx): the analytic FORWARD cost of one decoded
+    token is ``base + attn_per_ctx * context``.
+
+    base is the context-free 2·active-params matmul cost (2 FLOPs per
+    MAC); attn_per_ctx prices the seq-dependent QK^T and PV matmuls —
+    2 FLOPs per MAC over n_heads query heads at the family's qk/v
+    head widths per live context position.  The serving ledger
+    (observability/ledger.py) composes these with per-step context
+    sums; bench.py's train-side twin (_attn_flops_per_token) applies
+    the same shape with the 6x fwd+bwd rule instead."""
+    from skypilot_tpu.models import deepseek
+    base = 2.0 * active_params(config)
+    if isinstance(config, deepseek.DeepSeekConfig):
+        # MLA: scores at qk_head_dim (nope+rope), values at v_head_dim.
+        width = config.qk_head_dim + config.v_head_dim
+    else:
+        head_dim = getattr(config, 'head_dim',
+                           config.dim // config.n_heads)
+        width = 2 * head_dim
+    attn_per_ctx = 2.0 * config.n_layers * config.n_heads * width
+    return base, attn_per_ctx
+
+
+def flops_per_token(config: Any, context: int) -> float:
+    """Analytic forward FLOPs to decode one token whose attention
+    spans `context` live positions."""
+    base, attn = flops_per_token_parts(config)
+    return base + attn * context
+
+
 def available_models():
     from skypilot_tpu.models import (deepseek, gemma, gpt2, llama, moe,
                                      qwen)

@@ -2,16 +2,20 @@
 
 `metrics` holds the thread-safe Counter/Gauge/Histogram primitives, the
 process-global `Registry`, and Prometheus text exposition; `tracing`
-holds `RequestTrace`/`TraceStore` for per-request lifecycle timelines.
-Both are pure stdlib so they can be imported from any layer (engine,
-server, trainer, bench) without dragging in JAX.
+holds `RequestTrace`/`TraceStore` for per-request lifecycle timelines;
+`ledger` holds `StepLedger`, the bounded per-step performance ring with
+roofline/MFU attribution.  All are pure stdlib so they can be imported
+from any layer (engine, server, trainer, bench) without dragging in
+JAX.
 """
 import re
 
 from skypilot_tpu.observability import events
+from skypilot_tpu.observability import ledger
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.observability.events import EVENT_CONTRACT, EventRing
+from skypilot_tpu.observability.ledger import StepLedger
 from skypilot_tpu.observability.metrics import (CONTENT_TYPE_LATEST, Counter,
                                                 Gauge, Histogram, Registry,
                                                 get_registry)
@@ -107,6 +111,8 @@ METRIC_CONTRACT = frozenset({
     'skytpu_step_dispatch_seconds',       # enqueue wall time, cache-hit steps
     'skytpu_step_device_wait_seconds',    # scheduler blocked on step results
     'skytpu_step_host_overlap_seconds',   # host work hidden behind device step
+    'skytpu_step_mfu',                    # achieved MFU of the last committed step
+    'skytpu_model_flops_per_token',       # analytic fwd FLOPs/token at live ctx
     'skytpu_pipeline_depth',              # in-flight decode steps (async: 0/1)
     'skytpu_mesh_devices',                # devices in the engine mesh (1 = unsharded)
     'skytpu_decode_collective_seconds',   # sharded-step wait (collectives bound)
@@ -168,8 +174,10 @@ __all__ = [
     'RequestTrace',
     'Span',
     'SpanStore',
+    'StepLedger',
     'TraceStore',
     'events',
+    'ledger',
     'format_trace_context',
     'get_registry',
     'metrics',

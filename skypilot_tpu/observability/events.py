@@ -49,6 +49,10 @@ EVENT_CONTRACT = frozenset({
     'replica_failed',         # restart budget exhausted / fatal error
     'drain_begin',            # replica stopped admitting (scale-down)
     'drain_complete',         # drain finished; replica exiting
+    'device_profile_armed',   # POST /profile/device accepted a window
+    'device_profile_started',  # first busy step opened the capture
+    'device_profile_done',    # windowed jax.profiler capture finished
+    'device_profile_failed',  # capture could not start/stop; disarmed
     # -- replica supervisor -------------------------------------------
     'replica_spawn',          # new replica process launched
     'replica_restart',        # crash scheduled for backoff + respawn

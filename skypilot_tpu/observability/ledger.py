@@ -1,0 +1,327 @@
+"""Step-level performance ledger: a bounded per-step flight recorder.
+
+The histogram aggregates (`skytpu_step_*_seconds`) say a replica is
+slow; they cannot say WHICH step, what was in it, or whether it was
+compute- or memory-bound.  `StepLedger` answers those questions: a
+bounded ring of per-step records fed by the serving engines at
+step-COMMIT time — always the scheduler thread, always the consume
+half of the dispatch/consume split, never the dispatch half — with
+data already in hand there (timestamps, batch composition, the
+precomputed KV read-byte totals, page-pool state).
+
+Each record is stamped with an analytic roofline verdict at append
+time: the engine passes the model's FLOP constants (from
+``models.flops_per_token_parts``) and the chip's peak/bandwidth (from
+``utils/accelerator_registry``), and ``record()`` derives achieved
+MFU plus an arithmetic-intensity verdict (``memory_bound`` when the
+step's FLOPs/byte sits below the machine-balance ridge,
+``compute_bound`` above it).
+
+Disabled mode mirrors ``metrics.Registry``: ``record()`` returns
+before computing or locking anything, so a ledger-off engine pays one
+attribute read and a branch per step — the bench's ledger-off rerun
+asserts bit-identical greedy streams and the <2% publish-overhead
+contract covers the enabled path.
+
+Lock discipline: the ring deque is mutated ONLY under ``self._lock``
+(skylint lock-discipline covers this file); records themselves are
+immutable-after-append dicts, so snapshots hand out the dicts without
+copying.  Nothing here imports JAX — the module stays importable from
+any layer, like the rest of observability/.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+MEMORY_BOUND = 'memory_bound'
+COMPUTE_BOUND = 'compute_bound'
+
+
+class StepLedger:
+    """Bounded ring of per-step performance records.
+
+    `flops_per_token_base` is the context-free forward cost of one
+    token (2·active-params); `attn_flops_per_ctx_token` the extra
+    FLOPs per (token, live-context-position) pair — together they
+    price a step as ``tokens * base + attn * ctx_sum`` where
+    ``ctx_sum`` sums each committed token's live context length.
+    `peak_flops_per_sec` / `hbm_bytes_per_sec` are whole-engine
+    (per-chip figures times chip count); their ratio is the roofline
+    ridge in FLOPs/byte.
+    """
+
+    def __init__(self, capacity: int = 512, enabled: bool = True, *,
+                 flops_per_token_base: float = 0.0,
+                 attn_flops_per_ctx_token: float = 0.0,
+                 peak_flops_per_sec: float = 0.0,
+                 hbm_bytes_per_sec: float = 0.0,
+                 model: str = '', device_kind: str = '',
+                 n_chips: int = 1):
+        self._lock = threading.Lock()
+        self.capacity = max(1, int(capacity))
+        self._ring: 'collections.deque[Dict[str, Any]]' = (
+            collections.deque(maxlen=self.capacity))
+        self.enabled = bool(enabled)
+        self.flops_per_token_base = float(flops_per_token_base)
+        self.attn_flops_per_ctx_token = float(attn_flops_per_ctx_token)
+        self.peak_flops_per_sec = float(peak_flops_per_sec)
+        self.hbm_bytes_per_sec = float(hbm_bytes_per_sec)
+        # Machine balance: FLOPs the chip can afford per HBM byte
+        # moved.  A step whose arithmetic intensity sits below this
+        # ridge cannot reach peak — it is waiting on the memory
+        # system, not the MXU.
+        self.ridge_flops_per_byte = (
+            self.peak_flops_per_sec / self.hbm_bytes_per_sec
+            if self.peak_flops_per_sec > 0 and self.hbm_bytes_per_sec > 0
+            else 0.0)
+        self.model = model
+        self.device_kind = device_kind
+        self.n_chips = max(1, int(n_chips))
+        self._recorded = 0          # lifetime count (ring evicts)
+
+    def set_enabled(self, enabled: bool) -> None:
+        self.enabled = bool(enabled)
+
+    # -- feed (scheduler thread, consume half only) -------------------
+    def record(self, *, step: int, mode: str,
+               t_enter: float, t_dispatch: float,
+               t_join: Optional[float], t_commit: float,
+               rows: int, tokens: int, ctx_sum: int,
+               read_bytes: float = 0.0,
+               mix_tokens: int = 0,
+               spec_proposed: int = 0, spec_accepted: int = 0,
+               decode_kernel: str = '', prefill_kernel: str = '',
+               free_pages: Optional[int] = None,
+               used_pages: Optional[int] = None,
+               compiled: bool = False) -> Optional[Dict[str, Any]]:
+        """Append one step-commit record; returns it (None when
+        disabled).  Everything passed in is host-side scalars the
+        scheduler thread already holds — no device reads, ever."""
+        if not self.enabled:
+            return None
+        flops = (tokens * self.flops_per_token_base
+                 + self.attn_flops_per_ctx_token * ctx_sum)
+        step_s = max(t_commit - t_dispatch, 1e-9)
+        mfu = (flops / (step_s * self.peak_flops_per_sec)
+               if self.peak_flops_per_sec > 0 else 0.0)
+        ai = flops / read_bytes if read_bytes > 0 else 0.0
+        if self.ridge_flops_per_byte > 0:
+            verdict = (MEMORY_BOUND if ai < self.ridge_flops_per_byte
+                       else COMPUTE_BOUND)
+        else:
+            verdict = MEMORY_BOUND if read_bytes > 0 else COMPUTE_BOUND
+        rec: Dict[str, Any] = {
+            'step': step,
+            'mode': mode,
+            't_enter': t_enter,
+            't_dispatch': t_dispatch,
+            't_join': t_join,
+            't_commit': t_commit,
+            'dispatch_s': t_dispatch - t_enter,
+            'step_s': step_s,
+            'rows': rows,
+            'tokens': tokens,
+            'ctx_sum': ctx_sum,
+            'mix_tokens': mix_tokens,
+            'spec_proposed': spec_proposed,
+            'spec_accepted': spec_accepted,
+            'read_bytes': read_bytes,
+            'decode_kernel': decode_kernel,
+            'prefill_kernel': prefill_kernel,
+            'free_pages': free_pages,
+            'used_pages': used_pages,
+            'compiled': compiled,
+            'flops': flops,
+            'flops_per_token': flops / tokens if tokens else 0.0,
+            'mfu': mfu,
+            'arith_intensity': ai,
+            'roofline': verdict,
+        }
+        with self._lock:
+            self._ring.append(rec)
+            self._recorded += 1
+        return rec
+
+    # -- read side (any thread) ---------------------------------------
+    def snapshot(self, limit: Optional[int] = None
+                 ) -> List[Dict[str, Any]]:
+        """Newest-last records; records are append-frozen, so the
+        dicts are shared, not copied."""
+        with self._lock:
+            steps = list(self._ring)
+        if limit is not None and limit >= 0:
+            steps = steps[-limit:]
+        return steps
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def info(self) -> Dict[str, Any]:
+        """Config + state block for /health?verbose=1."""
+        with self._lock:
+            recorded = self._recorded
+            held = len(self._ring)
+            last = self._ring[-1] if self._ring else None
+        out: Dict[str, Any] = {
+            'enabled': self.enabled,
+            'capacity': self.capacity,
+            'recorded': recorded,
+            'held': held,
+            'model': self.model,
+            'device_kind': self.device_kind,
+            'n_chips': self.n_chips,
+            'peak_tflops': self.peak_flops_per_sec / 1e12,
+            'hbm_gbps': self.hbm_bytes_per_sec / 1e9,
+            'ridge_flops_per_byte': self.ridge_flops_per_byte,
+            'flops_per_token_base': self.flops_per_token_base,
+            'attn_flops_per_ctx_token': self.attn_flops_per_ctx_token,
+        }
+        if last is not None:
+            out['last_step'] = last['step']
+            out['last_mfu'] = last['mfu']
+            out['last_roofline'] = last['roofline']
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """Window aggregate over the held ring: achieved MFU, step-time
+        percentiles, roofline mix — the bench `ledger` block and the
+        router's /fleet/profile aggregation both consume this shape."""
+        return summarize_steps(self.snapshot())
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def summarize_steps(steps: Sequence[Dict[str, Any]]
+                    ) -> Dict[str, Any]:
+    """Aggregate a list of ledger records (local ring or a replica's
+    /profile/steps payload) into the shared summary shape."""
+    n = len(steps)
+    if n == 0:
+        return {'steps': 0, 'achieved_mfu': 0.0, 'mfu_last': 0.0,
+                'step_ms_p50': 0.0, 'step_ms_p99': 0.0,
+                'tokens_per_sec': 0.0, 'flops_per_token': 0.0,
+                'roofline': {MEMORY_BOUND: 0.0, COMPUTE_BOUND: 0.0},
+                'roofline_verdict': None}
+    durs = sorted(float(s['step_s']) for s in steps)
+    mem = sum(1 for s in steps if s['roofline'] == MEMORY_BOUND)
+    tokens = sum(int(s['tokens']) for s in steps)
+    window_s = max(float(steps[-1]['t_commit'])
+                   - float(steps[0]['t_dispatch']), 1e-9)
+    mem_frac = mem / n
+    return {
+        'steps': n,
+        'achieved_mfu': sum(float(s['mfu']) for s in steps) / n,
+        'mfu_last': float(steps[-1]['mfu']),
+        'step_ms_p50': _percentile(durs, 0.5) * 1e3,
+        'step_ms_p99': _percentile(durs, 0.99) * 1e3,
+        'tokens_per_sec': tokens / window_s,
+        'flops_per_token': (sum(float(s['flops_per_token'])
+                                for s in steps) / n),
+        'roofline': {MEMORY_BOUND: mem_frac,
+                     COMPUTE_BOUND: 1.0 - mem_frac},
+        'roofline_verdict': (MEMORY_BOUND if mem_frac >= 0.5
+                             else COMPUTE_BOUND),
+    }
+
+
+# -- unified Perfetto timeline ---------------------------------------
+def chrome_trace(steps: Iterable[Dict[str, Any]],
+                 traces: Iterable[Dict[str, Any]] = (),
+                 pid: Optional[int] = None,
+                 process_name: str = 'skytpu-replica'
+                 ) -> Dict[str, Any]:
+    """One Chrome-trace-event document (the utils/timeline.py schema:
+    ``{'traceEvents': [...], 'displayTimeUnit': 'ms'}``) joining the
+    ledger's engine-step slices with RequestTrace lifecycle rows so
+    control plane and data plane open in a single Perfetto view.
+
+    Ledger timestamps are perf-counter seconds; RequestTrace
+    timestamps are wall-clock seconds — both map onto the SAME
+    monotonic-anchored epoch via utils/timeline's offset helpers, so
+    an NTP step mid-serve cannot make rows disagree.  Steps ride
+    tid 0; each request gets its own tid (named via 'M' metadata
+    events) with queued/prefill/decode phase slices whose args carry
+    the first/last ledger step indices — the /traces?id= join.
+    """
+    from skypilot_tpu.utils import timeline as timeline_lib
+    if pid is None:
+        pid = os.getpid()
+    events: List[Dict[str, Any]] = []
+    meta: List[Dict[str, Any]] = [
+        {'name': 'process_name', 'ph': 'M', 'pid': pid, 'ts': 0,
+         'args': {'name': process_name}},
+        {'name': 'thread_name', 'ph': 'M', 'pid': pid, 'tid': 0,
+         'ts': 0, 'args': {'name': 'engine steps'}},
+    ]
+    for rec in steps:
+        ts = timeline_lib.perf_counter_to_epoch_us(rec['t_dispatch'])
+        dur = max(1, int(float(rec['step_s']) * 1e6))
+        events.append({
+            'name': f"step {rec['step']} [{rec['mode']}]",
+            'cat': 'engine_step', 'ph': 'X',
+            'ts': ts, 'dur': dur, 'pid': pid, 'tid': 0,
+            'args': {
+                'step': rec['step'], 'mode': rec['mode'],
+                'rows': rec['rows'], 'tokens': rec['tokens'],
+                'mix_tokens': rec['mix_tokens'],
+                'spec_proposed': rec['spec_proposed'],
+                'spec_accepted': rec['spec_accepted'],
+                'read_bytes': rec['read_bytes'],
+                'decode_kernel': rec['decode_kernel'],
+                'prefill_kernel': rec['prefill_kernel'],
+                'free_pages': rec['free_pages'],
+                'mfu': rec['mfu'],
+                'roofline': rec['roofline'],
+                'arith_intensity': rec['arith_intensity'],
+                'compiled': rec['compiled'],
+            }})
+    tid = 0
+    for tr in traces:
+        tid += 1
+        rid = tr.get('request_id')
+        meta.append({'name': 'thread_name', 'ph': 'M', 'pid': pid,
+                     'tid': tid, 'ts': 0,
+                     'args': {'name': f'req {rid}'}})
+        join_args = {
+            'request_id': rid,
+            'http_request_id': tr.get('http_request_id'),
+            'state': tr.get('state'),
+            'first_step_idx': tr.get('first_step_idx'),
+            'last_step_idx': tr.get('last_step_idx'),
+            'output_tokens': tr.get('output_tokens'),
+            'decode_steps': tr.get('decode_steps'),
+        }
+        q = tr.get('queued_ts')
+        adm = tr.get('admitted_ts')
+        pre = tr.get('prefill_done_ts')
+        fin = tr.get('finished_ts')
+        now_us = timeline_lib.now_epoch_us()
+
+        def _us(wall_s: Optional[float]) -> Optional[int]:
+            return None if wall_s is None else int(wall_s * 1e6)
+
+        phases = (('queued', _us(q), _us(adm)),
+                  ('prefill', _us(adm), _us(pre)),
+                  ('decode', _us(pre), _us(fin)))
+        for phase, start, end in phases:
+            if start is None:
+                continue
+            if end is None:
+                end = now_us      # still-live phase: open to "now"
+            if end < start:
+                end = start
+            events.append({
+                'name': f'{phase} req {rid}', 'cat': 'request',
+                'ph': 'X', 'ts': start, 'dur': max(1, end - start),
+                'pid': pid, 'tid': tid, 'args': join_args})
+    events.sort(key=lambda e: e['ts'])
+    return {'traceEvents': meta + events, 'displayTimeUnit': 'ms'}

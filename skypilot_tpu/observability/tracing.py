@@ -185,6 +185,13 @@ class RequestTrace:
     # steps/token (the speculation win) is tokens_per_step()'s
     # inverse.
     decode_steps: int = 0
+    # Engine step-ledger join: the global step indices of the FIRST
+    # and LAST steps that committed a token for this request, so
+    # /traces?id= timing lines up against GET /profile/steps records
+    # ("this token waited on step 4812, a 96-token prefill-mix step").
+    # None until the first commit / on engines without a step counter.
+    first_step_idx: Optional[int] = None
+    last_step_idx: Optional[int] = None
     shared_prefix_tokens: int = 0
     # repr() of the failure for 'cancelled'/'aborted' terminals that
     # have one (deadline expiry, recovery abort); None on clean exits.
@@ -305,7 +312,9 @@ class TraceStore:
     def finish(self, request_id: int, state: str,
                output_tokens: Optional[int] = None,
                error: Optional[str] = None,
-               decode_steps: Optional[int] = None
+               decode_steps: Optional[int] = None,
+               first_step_idx: Optional[int] = None,
+               last_step_idx: Optional[int] = None
                ) -> Optional[RequestTrace]:
         """Move a trace to a terminal state; idempotent per request."""
         assert state in TERMINAL_STATES, state
@@ -320,6 +329,10 @@ class TraceStore:
                 trace.output_tokens = output_tokens
             if decode_steps is not None:
                 trace.decode_steps = decode_steps
+            if first_step_idx is not None:
+                trace.first_step_idx = first_step_idx
+            if last_step_idx is not None:
+                trace.last_step_idx = last_step_idx
             if error is not None:
                 trace.error = error
             self._completed.append(trace)

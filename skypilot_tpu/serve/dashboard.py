@@ -14,7 +14,8 @@ Routes:
   GET /api/services  JSON: [{service record, replicas: [...]}, ...].
   GET /api/fleet     JSON fleet snapshot proxied from the router's
                      observability surfaces (/router/replicas +
-                     /fleet/slo); 404 unless started with --router.
+                     /fleet/slo + /fleet/profile); 404 unless started
+                     with --router.
   GET /healthz       liveness probe.
 
 Fleet mode (``--router http://host:port``) points the dashboard at a
@@ -81,7 +82,8 @@ def fleet_snapshot(router_url: str) -> Dict[str, Any]:
     base = router_url.rstrip('/')
     out: Dict[str, Any] = {'router': base}
     for key, path in (('replicas', '/router/replicas'),
-                      ('slo', '/fleet/slo')):
+                      ('slo', '/fleet/slo'),
+                      ('profile', '/fleet/profile')):
         try:
             with urllib.request.urlopen(
                     base + path,
@@ -211,12 +213,33 @@ async function refreshFleet() {{
     // /fleet/metrics distillation; replicas without the tier have
     // no entry and render '-'.
     const cache = f.cache ?? {{}};
+    // MFU / step-p99 columns come from the router's /fleet/profile
+    // step-ledger roll-up; replicas with an empty (or disabled)
+    // ledger window render '-'.
+    const prof = {{}};
+    (f.profile && f.profile.replicas || []).forEach(p => {{
+      prof[p.replica] = p;
+    }});
     const fmtB = n => n >= 1048576 ?
       (n / 1048576).toFixed(1) + ' MiB' : n >= 1024 ?
       (n / 1024).toFixed(1) + ' KiB' : n + ' B';
     const rows = reps.map(rep => {{
       const tr = document.createElement('tr');
       const c = cache[rep.url];
+      const p = prof[rep.url];
+      const mfuCell = cell(p && p.steps ?
+        (100 * p.achieved_mfu).toFixed(2) + '%' : '-');
+      const p99Cell = cell(p && p.steps ?
+        p.step_ms_p99.toFixed(1) + ' ms' : '-');
+      if (p && p.steps && p.roofline_verdict) {{
+        // Roofline verdict rides as a tooltip, not a column: the
+        // mix fractions give the 'mostly memory-bound' nuance.
+        const tip = p.roofline_verdict + ' (' +
+          (100 * p.roofline.memory_bound).toFixed(0) + '% mem / ' +
+          (100 * p.roofline.compute_bound).toFixed(0) + '% compute)';
+        mfuCell.title = tip;
+        p99Cell.title = tip;
+      }}
       tr.append(cell(rep.url), cell(rep.role ?? 'both'),
                 cell(rep.health),
                 cell(rep.circuit), cell(rep.inflight),
@@ -225,6 +248,7 @@ async function refreshFleet() {{
                 cell(c && c.hit_rate != null ?
                      (100 * c.hit_rate).toFixed(1) + '%' : '-'),
                 cell(c ? fmtB(c.spilled_bytes) : '-'),
+                mfuCell, p99Cell,
                 cell(rep.routable ? 'yes' : 'no'));
       return tr;
     }});
@@ -249,7 +273,8 @@ async function refreshFleet() {{
         ' burn ' + (v.burn_rate ?? 0).toFixed(2)).join(' · ');
     root.replaceChildren(h, pools,
       table(['URL', 'Role', 'Health', 'Breaker', 'In-flight', 'Queue',
-             'Free pages', 'Cache hit', 'Spilled', 'Routable'],
+             'Free pages', 'Cache hit', 'Spilled', 'MFU', 'Step p99',
+             'Routable'],
             rows), slo);
   }} catch (e) {{ /* router restarting; retry next tick */ }}
 }}

@@ -57,6 +57,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.infer import handoff as handoff_lib
 from skypilot_tpu.infer import paging
 from skypilot_tpu.observability import events as events_lib
+from skypilot_tpu.observability import ledger as ledger_lib
 from skypilot_tpu.observability import metrics as metrics_lib
 from skypilot_tpu.observability import tracing as tracing_lib
 from skypilot_tpu.serve import constants
@@ -707,6 +708,53 @@ class Router:
                 out.append({'replica': view.url, 'traces': traces})
         return out
 
+    def fleet_profile(self, limit: int = 256) -> Dict[str, object]:
+        """Fleet performance roll-up: each routable replica's recent
+        step-ledger window (`GET /profile/steps`) summarized to
+        achieved MFU, step-time p50/p99, tokens/sec and the roofline
+        verdict mix — the dashboard's MFU/step-p99 columns and the
+        first place to look when one replica's goodput sags.
+        Unreachable replicas contribute nothing (data gap, like
+        fleet_metrics)."""
+        replicas: List[Dict[str, object]] = []
+        q = urllib.parse.urlencode({'limit': limit})
+        for view in sorted(self.views(), key=lambda v: v.url):
+            if not view.routable:
+                continue
+            try:
+                resp = urllib.request.urlopen(
+                    f'{view.url}/profile/steps?{q}',
+                    timeout=self.health_timeout_s)
+                with resp:
+                    body = json.loads(resp.read() or b'{}')
+            except self._SCRAPE_ERRORS:
+                continue
+            steps = body.get('steps') if isinstance(body, dict) \
+                else None
+            if steps is None:
+                continue
+            entry: Dict[str, object] = {
+                'replica': view.url,
+                'role': view.role,
+                **ledger_lib.summarize_steps(steps),
+            }
+            info = body.get('info')
+            if isinstance(info, dict):
+                # Static roofline model facts worth surfacing next to
+                # the window summary.
+                for key in ('model', 'device_kind', 'n_chips',
+                            'peak_tflops', 'ridge_flops_per_byte',
+                            'enabled'):
+                    if key in info:
+                        entry[key] = info[key]
+            replicas.append(entry)
+        mfus = [r['achieved_mfu'] for r in replicas
+                if r.get('achieved_mfu') is not None]
+        return {
+            'replicas': replicas,
+            'fleet_mfu': (sum(mfus) / len(mfus)) if mfus else None,
+        }
+
     # -- selection ----------------------------------------------------
     def _signals(self, view: ReplicaView):
         """(queue_depth, free_pages) with staleness applied: signals
@@ -889,6 +937,12 @@ class Router:
                                     metrics_lib.CONTENT_TYPE_LATEST)
                 elif route == '/fleet/slo':
                     self._reply(200, router.fleet_slo())
+                elif route == '/fleet/profile':
+                    try:
+                        limit = int(params.get('limit', ['256'])[0])
+                    except ValueError:
+                        limit = 256
+                    self._reply(200, router.fleet_profile(limit))
                 elif route == '/events':
                     try:
                         limit = int(params.get('limit', ['100'])[0])

@@ -37,19 +37,43 @@ class TpuGeneration:
     host_vcpus: int
     host_memory_gb: float
     supports_preemptible: bool = True
+    # Per-chip HBM bandwidth (GB/s, public Cloud TPU documentation) —
+    # with bf16_tflops_per_chip this gives the machine-balance ridge
+    # (FLOPs/byte) the serving ledger's roofline verdict keys off.
+    hbm_gbps_per_chip: float = 0.0
 
 
 # Host shapes: the reference hard-codes 96/240 vCPUs and 334/400GB for
 # TPU-VM hosts (sky/clouds/gcp.py:600-651); we keep per-generation values.
 TPU_GENERATIONS: Dict[str, TpuGeneration] = {
-    # per-CHIP figures: hbm_gb, bf16 peak TFLOP/s.
-    'v2': TpuGeneration('v2', 'v2', False, 2, 4, 16, 46, 96, 334),
-    'v3': TpuGeneration('v3', 'v3', False, 2, 4, 32, 123, 96, 334),
-    'v4': TpuGeneration('v4', 'v4', False, 2, 4, 32, 275, 240, 400),
-    'v5e': TpuGeneration('v5e', 'v5litepod', True, 1, 4, 16, 197, 112, 192),
-    'v5p': TpuGeneration('v5p', 'v5p', False, 2, 4, 95, 459, 208, 448),
-    'v6e': TpuGeneration('v6e', 'v6e', True, 1, 4, 32, 918, 180, 720),
+    # per-CHIP figures: hbm_gb, bf16 peak TFLOP/s, HBM GB/s.
+    'v2': TpuGeneration('v2', 'v2', False, 2, 4, 16, 46, 96, 334,
+                        hbm_gbps_per_chip=700),
+    'v3': TpuGeneration('v3', 'v3', False, 2, 4, 32, 123, 96, 334,
+                        hbm_gbps_per_chip=900),
+    'v4': TpuGeneration('v4', 'v4', False, 2, 4, 32, 275, 240, 400,
+                        hbm_gbps_per_chip=1228),
+    'v5e': TpuGeneration('v5e', 'v5litepod', True, 1, 4, 16, 197, 112,
+                         192, hbm_gbps_per_chip=819),
+    'v5p': TpuGeneration('v5p', 'v5p', False, 2, 4, 95, 459, 208, 448,
+                         hbm_gbps_per_chip=2765),
+    'v6e': TpuGeneration('v6e', 'v6e', True, 1, 4, 32, 918, 180, 720,
+                         hbm_gbps_per_chip=1640),
 }
+
+
+def generation_for_device_kind(device_kind: str
+                               ) -> Optional[TpuGeneration]:
+    """Resolve a jax.Device.device_kind string ('TPU v4', 'TPU v5e',
+    'TPU v5 lite', ...) to its generation record, or None for non-TPU
+    backends (CPU/GPU) — callers pick their own fallback (bench.py
+    and the serving ledger both normalize to v6e so CPU dev numbers
+    stay comparable across machines)."""
+    kind = device_kind.lower().replace(' ', '')
+    for name in ('v6e', 'v5p', 'v5e', 'v5lite', 'v4', 'v3', 'v2'):
+        if name in kind:
+            return TPU_GENERATIONS['v5e' if 'lite' in name else name]
+    return None
 
 _TPU_NAME_RE = re.compile(
     r'^tpu-(?P<gen>v2|v3|v4|v5e|v5litepod|v5p|v6e)-(?P<count>\d+)$')

@@ -28,8 +28,37 @@ _enabled = os.environ.get('SKYTPU_DEBUG') == '1'
 _save_path: Optional[str] = None
 
 
+# Trace timestamps must be steppable-clock-free: an NTP step mid-run
+# would make wall-clock ('time.time') events go BACKWARDS in Perfetto.
+# Capture the wall<->monotonic offset ONCE at module load and derive
+# every timestamp from the monotonic clocks + that fixed epoch anchor:
+# the absolute values stay human-meaningful, the deltas stay exact.
+_EPOCH_ANCHOR_US = int(time.time() * 1e6)
+_MONOTONIC_ANCHOR_US = int(time.monotonic() * 1e6)
+_PERF_ANCHOR_US = int(time.perf_counter() * 1e6)
+
+
+def monotonic_to_epoch_us(monotonic_s: float) -> int:
+    """Map a time.monotonic() reading onto the anchored epoch (µs)."""
+    return int(monotonic_s * 1e6) - _MONOTONIC_ANCHOR_US \
+        + _EPOCH_ANCHOR_US
+
+
+def perf_counter_to_epoch_us(perf_s: float) -> int:
+    """Map a time.perf_counter() reading onto the anchored epoch (µs)
+    — the serving engines stamp step records with perf_counter, and
+    the ledger's Chrome-trace exporter aligns them with wall-clock
+    request rows through this."""
+    return int(perf_s * 1e6) - _PERF_ANCHOR_US + _EPOCH_ANCHOR_US
+
+
+def now_epoch_us() -> int:
+    """Monotonic 'now' on the anchored epoch (µs)."""
+    return monotonic_to_epoch_us(time.monotonic())
+
+
 def _now_us() -> int:
-    return int(time.time() * 1e6)
+    return now_epoch_us()
 
 
 class Event:

@@ -481,6 +481,7 @@ def _fake_decode_engines(bench, monkeypatch):
     import types
 
     from skypilot_tpu.infer import engine as engine_mod
+    from skypilot_tpu.observability import ledger as ledger_mod
 
     built = []
 
@@ -521,7 +522,22 @@ def _fake_decode_engines(bench, monkeypatch):
             self._eng = types.SimpleNamespace(
                 _bucketed=lambda n, b=prefill_bucket:
                     min(((n + b - 1) // b) * b, self.max_seq_len))
+            # Real StepLedger (pure host code): bench microbenches
+            # record() on it and emits the async arm's summary/info.
+            # `is not None`, not `or` — an empty disabled ring is
+            # falsy (len 0) and must still be honored.
+            led = _kw.get('step_ledger')
+            self.step_ledger = led if led is not None \
+                else ledger_mod.StepLedger(
+                    model='fake', device_kind='cpu', n_chips=1,
+                    flops_per_token_base=1e6,
+                    attn_flops_per_ctx_token=1e3,
+                    peak_flops_per_sec=1e12,
+                    hbm_bytes_per_sec=1e11)
             built.append(self)
+
+        def ledger_info(self):
+            return self.step_ledger.info()
 
         def generate(self, prompts, sampling):
             return [[1] * sampling.max_new_tokens for _ in prompts]
@@ -672,6 +688,14 @@ def test_decode_emits_one_json_line_and_stderr_summary(
                                    'prefill_interference'}
     assert parsed['arms']['int8']['kv_cache_dtype'] == 'int8'
     assert 'int8' in parsed['metric']
+    # Step-ledger block: async arm's window summary + static info,
+    # plus the record() microbench and ledger-off parity telemetry.
+    assert parsed['ledger']['info']['enabled'] is True
+    assert parsed['ledger']['roofline_verdict'] in (
+        'memory_bound', 'compute_bound', None)
+    tel = parsed['telemetry']
+    assert tel['ledger_off_token_parity'] is True
+    assert tel['ledger_record_us_per_step'] >= 0
     # Ragged arm: contiguous reads 4 slots * the full 512 bucket;
     # paged reads only the live contexts [128, 24, 24, 24].
     assert parsed['arms']['paged']['row_contexts'] == \
@@ -679,33 +703,39 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     assert parsed['paged_read_reduction_vs_contiguous'] == \
         round(4 * 512 / 200, 2)  # 10.24
     assert parsed['paged_token_parity'] is True
-    # Fourteen engines: the five DeepSeek-geometry arms (incl. the
-    # disabled-registry overhead arm) all serving the SAME weights,
-    # then the gpt2 speculation pair (its own weights — plain
-    # reference engine + speculating twin sharing them), then the
-    # sync/async pipeline pair (its own wider-geometry weights,
-    # shared between the two modes), then the fused-kernel XLA/fused
-    # pair (speculation-geometry weights, shared across the pair),
-    # then the tensor=4 sharded twin of the kernel arm's XLA engine
-    # (same seed, so the parity assert needs no weight shipping),
-    # then the prefill-interference pair (mix off / mix on, shared
-    # weights).
+    # Fifteen engines: the six DeepSeek-geometry arms (incl. the
+    # disabled-registry overhead arm AND the ledger-off parity
+    # rerun) all serving the SAME weights, then the gpt2 speculation
+    # pair (its own weights — plain reference engine + speculating
+    # twin sharing them), then the sync/async pipeline pair (its own
+    # wider-geometry weights, shared between the two modes), then
+    # the fused-kernel XLA/fused pair (speculation-geometry weights,
+    # shared across the pair), then the tensor=4 sharded twin of the
+    # kernel arm's XLA engine (same seed, so the parity assert needs
+    # no weight shipping), then the prefill-interference pair (mix
+    # off / mix on, shared weights).
     assert [b.kv_cache_dtype for b in built] == \
         ['auto', 'int8', 'auto', 'auto', 'auto', 'auto', 'auto',
-         'int8', 'int8', 'int8', 'int8', 'int8', 'auto', 'auto']
+         'auto', 'int8', 'int8', 'int8', 'int8', 'int8', 'auto',
+         'auto']
     assert [b.page_size for b in built] == \
-        [0, 0, 0, 8, 8, 0, 0, 8, 8, 8, 8, 8, 8, 8]
-    assert all(b.params is built[0].params for b in built[1:5])
-    assert built[6].params is built[5].params
-    assert built[8].params is built[7].params
-    assert built[10].params is built[9].params
-    assert [b.decode_kernel for b in built[9:12]] == ['xla', 'fused',
-                                                      'xla']
-    assert built[11].mesh is not None
-    assert built[11].mesh.devices.size == 4
-    assert all(b.mesh is None for b in built[:11] + built[12:])
-    assert [b.prefill_mix_budget for b in built[12:]] == [0, 8]
-    assert built[13].params is built[12].params
+        [0, 0, 0, 8, 8, 8, 0, 0, 8, 8, 8, 8, 8, 8, 8]
+    assert all(b.params is built[0].params for b in built[1:6])
+    assert built[7].params is built[6].params
+    assert built[9].params is built[8].params
+    assert built[11].params is built[10].params
+    assert [b.decode_kernel for b in built[10:13]] == ['xla', 'fused',
+                                                       'xla']
+    assert built[12].mesh is not None
+    assert built[12].mesh.devices.size == 4
+    assert all(b.mesh is None for b in built[:12] + built[13:])
+    assert [b.prefill_mix_budget for b in built[13:]] == [0, 8]
+    assert built[14].params is built[13].params
+    # The ledger-off rerun gets a disabled ring; every other engine
+    # keeps its own live one.
+    assert built[5].step_ledger.enabled is False
+    assert all(b.step_ledger.enabled for i, b in enumerate(built)
+               if i != 5)
     spec = parsed['arms']['speculative']
     assert spec['spec_k'] == 4
     assert spec['greedy_parity_vs_plain'] is True
@@ -778,20 +808,22 @@ def test_decode_emits_one_json_line_and_stderr_summary(
     assert mi['prefill_kernel']['mix_budget'] == 8
     err = [l for l in captured.err.splitlines() if l.startswith('#')]
     # dtype arms + ratio + paged + speculative + async + fused-kernel
-    # + sharded + prefill-interference + telemetry
-    assert len(err) == 10
-    assert 'fewer bytes/step' in err[-7]
-    assert 'token parity: True' in err[-6]  # the speculative line
-    assert 'steps/token' in err[-6]
-    assert 'device-wait fraction' in err[-5]  # the async line
+    # + sharded + prefill-interference + telemetry + ledger
+    assert len(err) == 11
+    assert any(l.startswith('# ledger [async arm]:') for l in err)
+    assert 'fewer bytes/step' in err[-8]
+    assert 'token parity: True' in err[-7]  # the speculative line
+    assert 'steps/token' in err[-7]
+    assert 'device-wait fraction' in err[-6]  # the async line
+    assert 'token parity: True' in err[-6]
+    assert 'fused' in err[-5]               # the fused-kernel line
     assert 'token parity: True' in err[-5]
-    assert 'fused' in err[-4]               # the fused-kernel line
+    assert 'tok/s/chip' in err[-4]          # the sharded line
     assert 'token parity: True' in err[-4]
-    assert 'tok/s/chip' in err[-3]          # the sharded line
+    assert 'prefill-interference' in err[-3]
     assert 'token parity: True' in err[-3]
-    assert 'prefill-interference' in err[-2]
-    assert 'token parity: True' in err[-2]
-    assert 'telemetry' in err[-1]
+    assert 'telemetry' in err[-2]
+    assert 'ledger-off parity: True' in err[-1]  # the ledger line
 
 
 def test_decode_smoke_paged_arm_flag(bench, monkeypatch, capsys):
