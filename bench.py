@@ -2082,6 +2082,28 @@ def _require_stdout_purity() -> None:
         sys.exit(2)
 
 
+def _require_protocol_discipline() -> None:
+    """Refuse to serve when skylint's route-/header-discipline rules
+    have unsuppressed findings: the serve bench spins up the real
+    router+replica wire surface, and a route or header that drifted
+    from ROUTE_CONTRACT/HEADER_CONTRACT fails as mysterious 404s or
+    silently-ignored headers mid-bench.  Pure-AST check (no jax)."""
+    from skypilot_tpu.devtools import skylint
+    root = os.path.dirname(os.path.abspath(__file__))
+    findings = skylint.unsuppressed(skylint.lint_paths(
+        [os.path.join(root, 'skypilot_tpu'),
+         os.path.join(root, 'bench.py')],
+        rule_ids=['route-discipline', 'header-discipline']))
+    if findings:
+        for f in findings:
+            print(f'# skylint: {f.render()}', file=sys.stderr)
+        print('# bench --serve refused: route-/header-discipline '
+              'findings mean the client and server sides of the wire '
+              'disagree; fix or suppress them first',
+              file=sys.stderr, flush=True)
+        sys.exit(2)
+
+
 def _check_baseline(result: dict, baseline_path: str,
                     tolerance: float = None) -> int:
     """Regression gate for --decode: compare this run's throughput and
@@ -2201,6 +2223,7 @@ def main() -> None:
                 sys.exit(rc)
         return
     if args.serve:
+        _require_protocol_discipline()
         run_serve(args.steps, smoke=args.smoke)
         return
     if args.quick or args.direct:

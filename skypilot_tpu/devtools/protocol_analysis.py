@@ -1,0 +1,859 @@
+"""Protocol-surface extraction for skylint's cross-process rules.
+
+`devtools/analysis.py` indexes the *in-process* program: symbols,
+imports, call edges.  The fleet's failure modes since PR 15 live one
+level up, on the wire BETWEEN processes — a route string in the
+replica server, a header literal in the router, a status code branch
+in a bench client.  This module recovers both sides of that wire from
+the shared project index, structurally (no filenames are special):
+
+* **server routes** — any ``do_GET``/``do_POST`` (or the repo's
+  ``_do_get``/``_do_post``) handler: walking its ``if route == '/x'``
+  /``route in _ROUTES`` dispatch recovers the (method, path) set, the
+  status codes each branch can emit (following ``self.helper()`` call
+  edges a few hops, resolving ``code = 200 if ok else 503`` locals),
+  and whether the module guards wrong-method hits with 405+Allow;
+* **client calls** — every ``urllib.request.Request``/``urlopen``/
+  ``HTTPConnection.request`` site: the path (first ``'/...'`` string
+  constant in the URL expression; None when fully dynamic), the
+  method, and — per enclosing function — the status codes branched on
+  (``e.code == 503``, ``e.code in _RETRYABLE_REPLICA_CODES`` with the
+  tuple resolved through module constants) plus the *swallows-
+  fail-closed* shape: an ``except URLError`` arm that ``continue``s a
+  peer loop without ever looking at ``.code`` — which, because
+  ``HTTPError`` subclasses ``URLError``, silently retries terminal
+  statuses;
+* **header sites** — every stamp (``send_header``/``add_header``/
+  ``headers[...] =``/``headers={...}``) and read
+  (``.headers.get``/``[...]``/``getheader``) whose header name is a
+  literal or resolves through the project's import/constant tables
+  (``tracing_lib.TRACE_HEADER`` is a cross-module resolution — this
+  is what makes the check whole-program);
+* **env reads** — every ``os.environ``/``os.getenv`` read of a
+  literal name, with its inline default expression.
+
+The four ``*-discipline`` rules check this surface against
+``skypilot_tpu/protocol.py``; this module deliberately knows nothing
+about the contract, so extraction unit tests stay contract-free.
+Everything is an over-approximation in the usual linting direction:
+unresolvable dynamism drops the site (costing recall), never invents
+one (costing a false positive).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from skypilot_tpu.devtools import analysis
+
+HTTP_METHODS = ('GET', 'POST', 'PUT', 'DELETE', 'PATCH', 'HEAD')
+
+# Dispatch-method names -> HTTP method.  BaseHTTPRequestHandler's
+# do_GET/do_POST, plus the repo's split-out _do_get/_do_post helpers
+# (which receive the already-parsed route).
+_DISPATCH_NAMES = {
+    'do_GET': 'GET', '_do_get': 'GET',
+    'do_POST': 'POST', '_do_post': 'POST',
+}
+
+# Response-emission call names whose first argument is the status
+# code.  _send_text and friends with a hardcoded code inside are
+# reached by following the call edge into them instead.
+_EMIT_NAMES = ('_reply', '_send', '_client_write', 'send_response',
+               'send_error')
+
+# Reading `.headers.get(...)` / `.getheader(...)` off these is a
+# header *read*; `send_header`/`add_header`/subscript-store is a
+# *stamp*.
+_READ_ATTRS = ('get', 'getheader', 'get_all')
+_STAMP_CALLS = ('send_header', 'add_header', 'putheader')
+
+_MAX_CALLEE_DEPTH = 3
+
+
+@dataclasses.dataclass
+class ServerRoute:
+    """One (method, path) one dispatch function serves."""
+    method: str
+    path: str
+    module: analysis.ModuleInfo
+    qname: str                       # dispatch function
+    node: ast.AST                    # anchor (route test or def)
+    statuses: Dict[int, ast.AST] = dataclasses.field(
+        default_factory=dict)        # code -> emitting node
+
+
+@dataclasses.dataclass
+class Dispatch:
+    """One do_GET/do_POST-shaped function."""
+    method: str
+    module: analysis.ModuleInfo
+    qname: str
+    node: ast.AST
+    routes: Dict[str, ServerRoute] = dataclasses.field(
+        default_factory=dict)
+    # 405 emitted with an Allow header somewhere in this dispatch —
+    # the wrong-method guard for the OTHER method's routes.
+    guard_405_allow: bool = False
+
+
+@dataclasses.dataclass
+class ClientCall:
+    """One outbound HTTP call site."""
+    module: analysis.ModuleInfo
+    qname: str                       # enclosing function ('' at module level)
+    node: ast.AST
+    method: Optional[str]            # None = dynamic (matches any)
+    path: Optional[str]              # None = dynamic (matches any)
+    # except-URLError-then-continue around this site with no .code
+    # branch: retries terminal HTTP statuses on the next peer.
+    swallows_fail_closed: bool = False
+
+
+@dataclasses.dataclass
+class HeaderSite:
+    name: str
+    kind: str                        # 'stamp' | 'read'
+    module: analysis.ModuleInfo
+    qname: str
+    node: ast.AST
+
+
+_MISSING = object()
+
+
+@dataclasses.dataclass
+class EnvRead:
+    name: str
+    module: analysis.ModuleInfo
+    qname: str
+    node: ast.AST
+    default: object = _MISSING       # ast node of the inline default
+
+
+@dataclasses.dataclass
+class Surface:
+    dispatches: List[Dispatch]
+    client_calls: List[ClientCall]
+    header_sites: List[HeaderSite]
+    env_reads: List[EnvRead]
+    # per-function status handling (for the client side):
+    fn_status_tests: Dict[str, Set[int]]   # qname -> codes branched on
+    fn_retry_codes: Dict[str, Set[int]]    # qname -> codes a retry
+    #                                        classifier admits
+    callers: Dict[str, Set[str]]           # reverse call graph
+
+    def server_routes(self) -> List[ServerRoute]:
+        return [r for d in self.dispatches
+                for r in d.routes.values()]
+
+    def handled_near(self, qname: str, depth: int = 2) -> Set[int]:
+        """Status codes branched on in ``qname`` or within ``depth``
+        call-graph hops (either direction): handling legitimately
+        lives one frame away (`_open_with_retry`, `_proxy`)."""
+        return self._near(qname, depth, self.fn_status_tests)
+
+    def retried_near(self, qname: str, depth: int = 2) -> Set[int]:
+        return self._near(qname, depth, self.fn_retry_codes)
+
+    def _near(self, qname: str, depth: int,
+              table: Dict[str, Set[int]]) -> Set[int]:
+        seen = {qname}
+        frontier = {qname}
+        out: Set[int] = set(table.get(qname, ()))
+        project = self._project
+        for _ in range(depth):
+            nxt: Set[str] = set()
+            for q in frontier:
+                for edge in project.calls_of(q):
+                    nxt.add(edge.callee)
+                nxt.update(self.callers.get(q, ()))
+            frontier = nxt - seen
+            seen |= frontier
+            for q in frontier:
+                out |= table.get(q, set())
+        return out
+
+    _project: analysis.Project = None  # set by surface_of
+
+
+# ---------------------------------------------------------------------
+# shared resolution helpers
+# ---------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    return analysis._dotted(node)
+
+
+class _Resolver:
+    """Project-wide constant tables: ``module.NAME`` -> str value or
+    tuple-of-constants value, with import-alias chasing so a name
+    re-exported through ``from x import NAME`` resolves to its one
+    true definition."""
+
+    def __init__(self, project: analysis.Project) -> None:
+        self.project = project
+        self.str_consts: Dict[str, str] = {}
+        self.tuple_consts: Dict[str, Tuple] = {}
+        for mod in project.modules.values():
+            for node in mod.tree.body:
+                if not isinstance(node, ast.Assign):
+                    continue
+                val = node.value
+                const = None
+                tup = None
+                if isinstance(val, ast.Constant) \
+                        and isinstance(val.value, str):
+                    const = val.value
+                elif isinstance(val, (ast.Tuple, ast.List)) and all(
+                        isinstance(e, ast.Constant) for e in val.elts):
+                    tup = tuple(e.value for e in val.elts)
+                if const is None and tup is None:
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        key = f'{mod.name}.{target.id}'
+                        if const is not None:
+                            self.str_consts[key] = const
+                        else:
+                            self.tuple_consts[key] = tup
+
+    def _chase(self, qname: str, table: Dict[str, object],
+               seen: Set[str]) -> object:
+        if qname in seen:
+            return None
+        seen.add(qname)
+        if qname in table:
+            return table[qname]
+        if '.' not in qname:
+            return None
+        mod_name, leaf = qname.rsplit('.', 1)
+        mod = self.project.modules.get(mod_name)
+        if mod is None:
+            return None
+        target = mod.imports.get(leaf)
+        if target is None:
+            return None
+        return self._chase(target, table, seen)
+
+    def _resolve(self, mod: analysis.ModuleInfo, node: ast.AST,
+                 table: Dict[str, object]) -> object:
+        if isinstance(node, ast.Constant):
+            return node.value if table is self.str_consts else None
+        dotted = _dotted(node)
+        if not dotted:
+            return None
+        head = dotted.split('.', 1)[0]
+        # Local name / alias in this module first, then as-written.
+        for cand in (f'{mod.name}.{dotted}',):
+            hit = self._chase(cand, table, set())
+            if hit is not None:
+                return hit
+        target = mod.imports.get(head)
+        if target is not None:
+            rest = dotted.split('.', 1)[1] if '.' in dotted else ''
+            cand = f'{target}.{rest}' if rest else target
+            hit = self._chase(cand, table, set())
+            if hit is not None:
+                return hit
+        return self._chase(dotted, table, set())
+
+    def str_value(self, mod: analysis.ModuleInfo,
+                  node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        hit = self._resolve(mod, node, self.str_consts)
+        return hit if isinstance(hit, str) else None
+
+    def tuple_value(self, mod: analysis.ModuleInfo,
+                    node: ast.AST) -> Optional[Tuple]:
+        if isinstance(node, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) for e in node.elts):
+            return tuple(e.value for e in node.elts)
+        hit = self._resolve(mod, node, self.tuple_consts)
+        return hit if isinstance(hit, tuple) else None
+
+    def tuple_name(self, mod: analysis.ModuleInfo,
+                   node: ast.AST) -> str:
+        dotted = _dotted(node)
+        return dotted.rsplit('.', 1)[-1] if dotted else ''
+
+
+def _parents_of(tree: ast.Module) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _enclosing_fn(project: analysis.Project,
+                  parents: Dict[int, ast.AST],
+                  node: ast.AST) -> str:
+    cur = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = project.function_for_node(cur)
+            if info is not None:
+                return info.qname
+        cur = parents.get(id(cur))
+    return ''
+
+
+# ---------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------
+
+
+def _int_codes(resolver: _Resolver, mod: analysis.ModuleInfo,
+               node: ast.AST,
+               local_ints: Dict[str, Set[int]]) -> Set[int]:
+    """Possible int status values of an emission's first argument:
+    a literal, a conditional of literals, or a local assigned from
+    them (`code = 200 if ok else 503`)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return {node.value}
+    if isinstance(node, ast.IfExp):
+        return (_int_codes(resolver, mod, node.body, local_ints)
+                | _int_codes(resolver, mod, node.orelse, local_ints))
+    if isinstance(node, ast.Name) and node.id in local_ints:
+        return set(local_ints[node.id])
+    return set()
+
+
+def _local_int_assigns(resolver: _Resolver, mod: analysis.ModuleInfo,
+                       fn_node: ast.AST) -> Dict[str, Set[int]]:
+    out: Dict[str, Set[int]] = {}
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        codes = _int_codes(resolver, mod, node.value, {})
+        if not codes:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out.setdefault(t.id, set()).update(codes)
+    return out
+
+
+def _route_test(resolver: _Resolver, mod: analysis.ModuleInfo,
+                test: ast.AST) -> Tuple[Optional[List[str]], str]:
+    """Decode a dispatch branch test.  Returns (paths, op) where op is
+    'eq' (`route == '/x'`), 'in' (`route in ROUTES`), 'notin'
+    (`route not in ROUTES` — the body is the rejection, the
+    continuation serves every path), or ('', None) for anything
+    else."""
+    if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+        return None, ''
+    op = test.ops[0]
+    comp = test.comparators[0]
+    if isinstance(op, ast.Eq):
+        val = resolver.str_value(mod, comp)
+        if val is None and isinstance(test.left, ast.Constant):
+            val = resolver.str_value(mod, test.left)
+        if isinstance(val, str) and val.startswith('/'):
+            return [val], 'eq'
+        return None, ''
+    if isinstance(op, (ast.In, ast.NotIn)):
+        tup = resolver.tuple_value(mod, comp)
+        if tup and all(isinstance(p, str) and p.startswith('/')
+                       for p in tup):
+            return list(tup), \
+                'in' if isinstance(op, ast.In) else 'notin'
+    return None, ''
+
+
+def _emission_codes(resolver: _Resolver, mod: analysis.ModuleInfo,
+                    call: ast.Call,
+                    local_ints: Dict[str, Set[int]]) -> Set[int]:
+    dotted = _dotted(call.func) or ''
+    if dotted.rsplit('.', 1)[-1] not in _EMIT_NAMES or not call.args:
+        return set()
+    return _int_codes(resolver, mod, call.args[0], local_ints)
+
+
+def _has_allow(call: ast.Call, fn_node: ast.AST) -> bool:
+    for kw in call.keywords:
+        if kw.arg == 'allow':
+            return True
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func) or ''
+            if dotted.rsplit('.', 1)[-1] in _STAMP_CALLS \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value == 'Allow':
+                return True
+    return False
+
+
+def _extract_dispatch(project: analysis.Project, resolver: _Resolver,
+                      fn: analysis.FunctionInfo,
+                      method: str) -> Dispatch:
+    mod = fn.module
+    disp = Dispatch(method=method, module=mod, qname=fn.qname,
+                    node=fn.node)
+    local_ints = _local_int_assigns(resolver, mod, fn.node)
+    # statements with no route context yet, to attribute to every
+    # route this dispatch turned out to serve
+    pending: List[Tuple[ast.AST, int]] = []
+
+    def route_for(path: str, anchor: ast.AST) -> ServerRoute:
+        r = disp.routes.get(path)
+        if r is None:
+            r = ServerRoute(method=method, path=path, module=mod,
+                            qname=fn.qname, node=anchor)
+            disp.routes[path] = r
+        return r
+
+    def scan(node: ast.AST, paths: Optional[List[str]],
+             depth: int) -> None:
+        """Collect emissions under ``node``; also follow resolved
+        call edges a few hops (self.helper() emitting the code)."""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            codes = _emission_codes(resolver, mod, sub, local_ints)
+            for code in codes:
+                if code == 405 and _has_allow(sub, fn.node):
+                    disp.guard_405_allow = True
+                if paths is None:
+                    pending.append((sub, code))
+                else:
+                    for p in paths:
+                        route_for(p, sub).statuses.setdefault(
+                            code, sub)
+            if depth <= 0 or codes:
+                continue
+            edge = project.edge_for_call(sub)
+            if edge is None:
+                continue
+            callee = project.functions.get(edge.callee)
+            if callee is None or callee.module is not mod:
+                continue
+            callee_ints = _local_int_assigns(resolver, mod,
+                                             callee.node)
+            for cnode in project.walk_own(callee):
+                if isinstance(cnode, ast.Call):
+                    for code in _emission_codes(
+                            resolver, mod, cnode, callee_ints):
+                        if code == 405 and _has_allow(cnode,
+                                                      callee.node):
+                            disp.guard_405_allow = True
+                        if paths is None:
+                            pending.append((cnode, code))
+                        else:
+                            for p in paths:
+                                route_for(p, cnode).statuses \
+                                    .setdefault(code, cnode)
+
+    def visit(stmts: List[ast.stmt],
+              ctx: Optional[List[str]]) -> None:
+        i = 0
+        while i < len(stmts):
+            stmt = stmts[i]
+            if isinstance(stmt, ast.If):
+                paths, op = _route_test(resolver, mod, stmt.test)
+                if op == 'eq':
+                    for p in paths:
+                        route_for(p, stmt)
+                    visit(stmt.body, paths)
+                    visit(stmt.orelse, ctx)
+                elif op == 'in':
+                    # A membership branch is usually the wrong-method
+                    # guard (405 for the other method's routes) — scan
+                    # it without claiming this dispatch serves them.
+                    scan(stmt, None, _MAX_CALLEE_DEPTH)
+                    visit(stmt.orelse, ctx)
+                elif op == 'notin':
+                    # `if route not in ROUTES: reject; return` — the
+                    # continuation serves every listed route.
+                    scan(stmt, None, _MAX_CALLEE_DEPTH)
+                    for p in paths:
+                        route_for(p, stmt)
+                    visit(stmts[i + 1:], paths)
+                    return
+                else:
+                    visit(stmt.body, ctx)
+                    visit(stmt.orelse, ctx)
+                i += 1
+                continue
+            if isinstance(stmt, (ast.Try, ast.With)):
+                visit(stmt.body, ctx)
+                for h in getattr(stmt, 'handlers', ()):
+                    visit(h.body, ctx)
+                visit(getattr(stmt, 'finalbody', []) or [], ctx)
+                visit(getattr(stmt, 'orelse', []) or [], ctx)
+                i += 1
+                continue
+            scan(stmt, ctx, _MAX_CALLEE_DEPTH)
+            i += 1
+
+    visit(list(fn.node.body), None)
+    for node, code in pending:
+        for r in disp.routes.values():
+            r.statuses.setdefault(code, node)
+    return disp
+
+
+# ---------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------
+
+
+def _path_of_url(node: ast.AST) -> Optional[str]:
+    """First '/...'-shaped string constant inside a URL expression
+    (`base + '/drain'`, f'{peer}/kv_prefix?h={q}'), query-stripped.
+    None when the path is fully dynamic."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            val = sub.value
+            idx = val.find('/')
+            if idx < 0:
+                continue
+            if idx > 0 and '://' in val:
+                # absolute URL literal: path starts after authority
+                rest = val.split('://', 1)[1]
+                slash = rest.find('/')
+                if slash < 0:
+                    continue
+                val = rest[slash:]
+            else:
+                val = val[idx:]
+            path = val.split('?', 1)[0]
+            if path.startswith('/'):
+                return path
+    return None
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _client_method(call: ast.Call,
+                   resolver: _Resolver,
+                   mod: analysis.ModuleInfo) -> Optional[str]:
+    m = _kw(call, 'method')
+    if m is not None:
+        val = resolver.str_value(mod, m)
+        return val.upper() if isinstance(val, str) \
+            and val.upper() in HTTP_METHODS else None
+    data = _kw(call, 'data')
+    if data is not None:
+        return 'GET' if isinstance(data, ast.Constant) \
+            and data.value is None else 'POST'
+    if len(call.args) >= 2:
+        return 'POST'
+    return 'GET'
+
+
+def _exception_names(handler: ast.ExceptHandler) -> List[str]:
+    t = handler.type
+    elts = t.elts if isinstance(t, ast.Tuple) else \
+        ([] if t is None else [t])
+    names = []
+    for e in elts:
+        dotted = _dotted(e)
+        if dotted:
+            names.append(dotted.rsplit('.', 1)[-1])
+    return names
+
+
+def _swallows_fail_closed(parents: Dict[int, ast.AST],
+                          site: ast.AST) -> bool:
+    """True when ``site`` sits in a loop whose try/except catches
+    URLError (which HTTPError subclasses!) or OSError, never looks at
+    ``.code``, and ``continue``s — i.e. a terminal HTTP status is
+    silently retried on the next peer."""
+    cur = site
+    in_loop = False
+    while cur is not None:
+        parent = parents.get(id(cur))
+        if isinstance(parent, (ast.For, ast.While)):
+            in_loop = True
+        if isinstance(parent, ast.Try) and cur in parent.body:
+            for h in parent.handlers:
+                names = _exception_names(h)
+                if any(n in ('HTTPError',) for n in names):
+                    return False     # deliberate status handling first
+                if not any(n in ('URLError', 'OSError', 'Exception')
+                           for n in names):
+                    continue
+                looks_at_code = any(
+                    isinstance(n, ast.Attribute)
+                    and n.attr in ('code', 'status')
+                    for b in h.body for n in ast.walk(b))
+                has_continue = any(
+                    isinstance(n, ast.Continue)
+                    for b in h.body for n in ast.walk(b))
+                if not looks_at_code and has_continue:
+                    # the continue targets an enclosing loop
+                    if in_loop or _in_loop(parents, parent):
+                        return True
+        cur = parent
+    return False
+
+
+def _in_loop(parents: Dict[int, ast.AST], node: ast.AST) -> bool:
+    cur = parents.get(id(node))
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        if isinstance(cur, (ast.For, ast.While)):
+            return True
+        cur = parents.get(id(cur))
+    return False
+
+
+# ---------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------
+
+
+def _extract_headers(resolver: _Resolver, mod: analysis.ModuleInfo,
+                     project: analysis.Project,
+                     parents: Dict[int, ast.AST],
+                     out: List[HeaderSite]) -> None:
+    def add(kind: str, name_node: ast.AST, anchor: ast.AST) -> None:
+        name = resolver.str_value(mod, name_node)
+        if not isinstance(name, str) or not name:
+            return
+        out.append(HeaderSite(
+            name=name, kind=kind, module=mod,
+            qname=_enclosing_fn(project, parents, anchor),
+            node=anchor))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func) or ''
+            leaf = dotted.rsplit('.', 1)[-1]
+            if leaf in _STAMP_CALLS and node.args:
+                add('stamp', node.args[0], node)
+            elif leaf in _READ_ATTRS and node.args:
+                # only reads OFF a `.headers` receiver (or a bare
+                # `headers` param) — dict.get on arbitrary objects is
+                # not a wire-header read
+                recv = node.func.value \
+                    if isinstance(node.func, ast.Attribute) else None
+                recv_dot = (_dotted(recv) or '') if recv is not None \
+                    else ''
+                if recv_dot.endswith('headers') or leaf == 'getheader':
+                    add('read', node.args[0], node)
+            # Request(..., headers={...}) dict keys are stamps
+            hdrs = _kw(node, 'headers')
+            if isinstance(hdrs, ast.Dict):
+                for key in hdrs.keys:
+                    if key is not None:
+                        add('stamp', key, node)
+        elif isinstance(node, ast.Subscript):
+            recv_dot = _dotted(node.value) or ''
+            if not (recv_dot == 'headers'
+                    or recv_dot.endswith('.headers')):
+                continue
+            if isinstance(node.ctx, ast.Store):
+                add('stamp', node.slice, node)
+            elif isinstance(node.ctx, ast.Load):
+                add('read', node.slice, node)
+        elif isinstance(node, ast.Assign):
+            # headers['X-...'] = v  where `headers` is a plain dict
+            # later passed as Request(headers=headers)
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.ctx, ast.Store):
+                    recv_dot = _dotted(t.value) or ''
+                    if 'headers' in recv_dot.rsplit('.', 1)[-1]:
+                        add('stamp', t.slice, t)
+
+
+def _extract_env(mod: analysis.ModuleInfo,
+                 project: analysis.Project,
+                 parents: Dict[int, ast.AST],
+                 out: List[EnvRead]) -> None:
+    def add(name_node: ast.AST, anchor: ast.AST,
+            default: object) -> None:
+        if not isinstance(name_node, ast.Constant) \
+                or not isinstance(name_node.value, str):
+            return
+        out.append(EnvRead(
+            name=name_node.value, module=mod,
+            qname=_enclosing_fn(project, parents, anchor),
+            node=anchor, default=default))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func) or ''
+            if dotted in ('os.getenv', 'getenv'):
+                add(node.args[0] if node.args else None, node,
+                    node.args[1] if len(node.args) > 1 else _MISSING)
+                continue
+            leaf = dotted.rsplit('.', 1)[-1]
+            recv = dotted.rsplit('.', 1)[0] if '.' in dotted else ''
+            if leaf in ('get', 'setdefault') \
+                    and recv.endswith('environ') and node.args:
+                add(node.args[0], node,
+                    node.args[1] if len(node.args) > 1 else _MISSING)
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            dotted = _dotted(node.value) or ''
+            if dotted.endswith('environ'):
+                add(node.slice, node, _MISSING)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            dotted = _dotted(node.comparators[0]) or ''
+            if dotted.endswith('environ'):
+                add(node.left, node, _MISSING)
+
+
+def _extract_status_tests(resolver: _Resolver,
+                          mod: analysis.ModuleInfo,
+                          fn: analysis.FunctionInfo,
+                          project: analysis.Project,
+                          tests: Dict[str, Set[int]],
+                          retries: Dict[str, Set[int]]) -> None:
+    for node in project.walk_own(fn):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        left = node.left
+        if not (isinstance(left, ast.Attribute)
+                and left.attr in ('code', 'status')):
+            continue
+        op = node.ops[0]
+        comp = node.comparators[0]
+        codes: Set[int] = set()
+        is_retry_tuple = False
+        if isinstance(op, (ast.Eq, ast.NotEq)):
+            if isinstance(comp, ast.Constant) \
+                    and isinstance(comp.value, int):
+                codes = {comp.value}
+        elif isinstance(op, (ast.In, ast.NotIn)):
+            tup = resolver.tuple_value(mod, comp)
+            if tup and all(isinstance(c, int) for c in tup):
+                codes = set(tup)
+                name = resolver.tuple_name(mod, comp)
+                is_retry_tuple = isinstance(op, ast.In) \
+                    and 'RETRY' in name.upper()
+        if not codes:
+            continue
+        tests.setdefault(fn.qname, set()).update(codes)
+        if is_retry_tuple:
+            retries.setdefault(fn.qname, set()).update(codes)
+
+
+def _extract_clients(resolver: _Resolver, mod: analysis.ModuleInfo,
+                     project: analysis.Project,
+                     parents: Dict[int, ast.AST],
+                     out: List[ClientCall]) -> None:
+    # (qname, varname) -> the ClientCall a `req = Request(...)` assign
+    # produced, so a later `urlopen(req)` inside a try can contribute
+    # its swallow shape to the site.
+    by_assign: Dict[Tuple[str, str], ClientCall] = {}
+    opens: List[Tuple[str, str, ast.AST]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func) or ''
+        leaf = dotted.rsplit('.', 1)[-1]
+        method: Optional[str] = None
+        path: Optional[str] = None
+        if leaf == 'Request' and ('Request' == dotted
+                                  or 'request.Request' in dotted
+                                  or dotted.endswith('.Request')):
+            if not node.args:
+                continue
+            path = _path_of_url(node.args[0])
+            method = _client_method(node, resolver, mod)
+        elif leaf == 'urlopen':
+            if not node.args:
+                continue
+            url = node.args[0]
+            # urlopen(req) of a prebuilt Request: the Request() call
+            # is the site; counting both would double-report.  But the
+            # try/except swallow shape usually wraps ONLY the urlopen,
+            # so remember it for the linking pass below.
+            if isinstance(url, ast.Name):
+                if _swallows_fail_closed(parents, node):
+                    opens.append((
+                        _enclosing_fn(project, parents, node),
+                        url.id, node))
+                continue
+            if isinstance(url, ast.Call):
+                inner = _dotted(url.func) or ''
+                if inner.rsplit('.', 1)[-1] == 'Request':
+                    continue     # inline Request(...) — handled above
+            path = _path_of_url(url)
+            method = 'POST' if (len(node.args) > 1
+                                or _kw(node, 'data') is not None) \
+                else 'GET'
+        elif leaf == 'request' and isinstance(node.func,
+                                              ast.Attribute):
+            # HTTPConnection(...).request('GET', '/path', ...)
+            if len(node.args) < 2:
+                continue
+            m = resolver.str_value(mod, node.args[0])
+            if not (isinstance(m, str)
+                    and m.upper() in HTTP_METHODS):
+                continue
+            method = m.upper()
+            path = _path_of_url(node.args[1])
+        else:
+            continue
+        call = ClientCall(
+            module=mod,
+            qname=_enclosing_fn(project, parents, node),
+            node=node, method=method, path=path,
+            swallows_fail_closed=_swallows_fail_closed(parents,
+                                                       node))
+        out.append(call)
+        parent = parents.get(id(node))
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                if isinstance(t, ast.Name):
+                    by_assign[(call.qname, t.id)] = call
+    for qname, varname, _node in opens:
+        linked = by_assign.get((qname, varname))
+        if linked is not None:
+            linked.swallows_fail_closed = True
+
+
+def surface_of(project: analysis.Project) -> Surface:
+    """The protocol surface of one project index, built once and
+    cached on the project (the single-index contract: every protocol
+    rule shares one extraction)."""
+    cached = getattr(project, '_protocol_surface', None)
+    if cached is not None:
+        return cached
+    resolver = _Resolver(project)
+    dispatches: List[Dispatch] = []
+    clients: List[ClientCall] = []
+    headers: List[HeaderSite] = []
+    envs: List[EnvRead] = []
+    tests: Dict[str, Set[int]] = {}
+    retries: Dict[str, Set[int]] = {}
+    callers: Dict[str, Set[str]] = {}
+    for fn in project.functions.values():
+        for edge in project.calls_of(fn.qname):
+            callers.setdefault(edge.callee, set()).add(fn.qname)
+    for mod in project.iter_modules():
+        parents = _parents_of(mod.tree)
+        _extract_headers(resolver, mod, project, parents, headers)
+        _extract_env(mod, project, parents, envs)
+        _extract_clients(resolver, mod, project, parents, clients)
+    for fn in project.functions.values():
+        _extract_status_tests(resolver, fn.module, fn, project,
+                              tests, retries)
+        method = _DISPATCH_NAMES.get(fn.name)
+        if method is not None:
+            dispatches.append(
+                _extract_dispatch(project, resolver, fn, method))
+    surface = Surface(dispatches=dispatches, client_calls=clients,
+                      header_sites=headers, env_reads=envs,
+                      fn_status_tests=tests, fn_retry_codes=retries,
+                      callers=callers)
+    surface._project = project
+    project._protocol_surface = surface
+    return surface

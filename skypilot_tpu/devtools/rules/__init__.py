@@ -6,6 +6,8 @@ a stable order.  Adding a rule family == adding a module here.
 """
 from skypilot_tpu.devtools.rules import donation
 from skypilot_tpu.devtools.rules import dtype_promotion
+from skypilot_tpu.devtools.rules import env_discipline
+from skypilot_tpu.devtools.rules import header_discipline
 from skypilot_tpu.devtools.rules import host_sync
 from skypilot_tpu.devtools.rules import kernel_discipline
 from skypilot_tpu.devtools.rules import key_reuse
@@ -16,7 +18,9 @@ from skypilot_tpu.devtools.rules import metric_contract
 from skypilot_tpu.devtools.rules import net_timeout
 from skypilot_tpu.devtools.rules import pipeline_discipline
 from skypilot_tpu.devtools.rules import retrace
+from skypilot_tpu.devtools.rules import route_discipline
 from skypilot_tpu.devtools.rules import sleep_discipline
+from skypilot_tpu.devtools.rules import status_discipline
 from skypilot_tpu.devtools.rules import stdout_purity
 from skypilot_tpu.devtools.rules import trace_discipline
 
@@ -26,6 +30,8 @@ ALL_RULES = (host_sync.RULES + retrace.RULES + lock_discipline.RULES
              + net_timeout.RULES + trace_discipline.RULES
              + pipeline_discipline.RULES + kernel_discipline.RULES
              + mesh_axis_discipline.RULES + lock_order.RULES
-             + donation.RULES + key_reuse.RULES)
+             + donation.RULES + key_reuse.RULES
+             + route_discipline.RULES + header_discipline.RULES
+             + status_discipline.RULES + env_discipline.RULES)
 
 __all__ = ['ALL_RULES']

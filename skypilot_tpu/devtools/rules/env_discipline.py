@@ -1,0 +1,84 @@
+"""env-discipline: every SKYTPU_* environment read is registered in
+ENV_CONTRACT with a matching inline default.
+
+Env vars are the fleet's third wire: the launcher exports
+``SKYTPU_ROUTER_URL``, a process three layers down reads it.  Nothing
+checks that the reader and the docs agree — historically each read
+site carried its own inline default, and they drifted (the GCP
+provisioner's queue timeout defaulted to the *int* 1800 while the
+docs said the string ``'1800'``; same value today, silently
+divergent the first time someone edits one of them).  Two checks,
+whole-tree (env reads are not confined to the serving dirs):
+
+* a read of a ``SKYTPU_*`` name absent from ENV_CONTRACT is a
+  finding — the contract row is where the default, the parser and
+  the docs-table entry live, and the architecture docs table is
+  generated from it;
+* a read whose inline literal default diverges from the contract
+  default (different value, non-string literal, or no default where
+  the contract declares one) is a finding.  Non-literal defaults
+  (computed expressions) are skipped; contract rows with
+  ``default=None`` (computed / unset-disables semantics) skip the
+  comparison entirely.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from skypilot_tpu.devtools import analysis, protocol_analysis, skylint
+from skypilot_tpu.protocol import ENV_CONTRACT
+
+RULE_ID = 'env-discipline'
+
+_PREFIX = 'SKYTPU_'
+
+
+def check(project: analysis.Project) -> Iterable[skylint.Finding]:
+    surface = protocol_analysis.surface_of(project)
+    findings: List[skylint.Finding] = []
+    for read in surface.env_reads:
+        if not read.name.startswith(_PREFIX):
+            continue
+        if read.module.name.rsplit('.', 1)[-1] == 'protocol':
+            continue
+        spec = ENV_CONTRACT.get(read.name)
+        if spec is None:
+            findings.append(read.module.ctx.finding(
+                RULE_ID, read.node, read.name,
+                f'environment variable {read.name!r} is read here '
+                f'but not registered in ENV_CONTRACT '
+                f'(skypilot_tpu/protocol.py) — the contract row '
+                f'carries the default, parser and docs-table entry'))
+            continue
+        if spec.default is None:
+            continue      # computed / unset-disables: no one default
+        default = read.default
+        if default is protocol_analysis._MISSING:
+            findings.append(read.module.ctx.finding(
+                RULE_ID, read.node, read.name,
+                f'{read.name!r} is read with no inline default, but '
+                f'ENV_CONTRACT declares default '
+                f'{spec.default!r} — an unset var behaves '
+                f'differently here than everywhere else'))
+            continue
+        if not isinstance(default, ast.Constant):
+            continue      # computed default: not comparable
+        value = default.value
+        if not isinstance(value, str) or value != spec.default:
+            findings.append(read.module.ctx.finding(
+                RULE_ID, read.node, read.name,
+                f'inline default {value!r} for {read.name!r} '
+                f'diverges from the ENV_CONTRACT default '
+                f'{spec.default!r} (contract defaults are strings, '
+                f'parsed by {spec.parser}) — read sites must agree '
+                f'with the contract so the docs table stays true'))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='SKYTPU_* env reads must be registered in ENV_CONTRACT '
+            'with matching inline defaults',
+    check=check,
+    project=True),)

@@ -1,0 +1,122 @@
+"""header-discipline: fleet header literals must come from
+HEADER_CONTRACT, and every contract header needs both a stamper and a
+reader somewhere in the tree.
+
+Headers are the loosest part of the wire surface: the router stamps
+``X-Skytpu-Decode-Target`` so the prefill replica knows where to push
+KV pages, the replica reads it back by spelling the same string — and
+a one-character drift between the two spellings degrades silently
+(the replica just never sees the header; handoff falls back to the
+slow path).  Two whole-program checks close that hole:
+
+* any stamp or read site in the wire scope whose header name matches
+  the fleet namespace (``X-Skytpu-*`` or ``X-Request-Id``) but is not
+  a HEADER_CONTRACT name is a finding — add it to the contract or fix
+  the typo;
+* every HEADER_CONTRACT name is paired across the whole tree: stamped
+  somewhere but never read (or read but never stamped) is a finding
+  whose call chain lists every site on the populated side.  A
+  deliberately one-sided header (``X-Served-By`` exists for humans
+  reading curl output) carries an inline suppression with the
+  rationale at the stamp site.
+
+Name resolution goes through the project constant tables, so
+``tracing_lib.TRACE_HEADER`` counts as the contract name it resolves
+to — sites only flag when the *resolved string* is off-contract.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from skypilot_tpu.devtools import analysis, protocol_analysis, skylint
+from skypilot_tpu.devtools.rules.route_discipline import in_scope
+from skypilot_tpu.protocol import HEADER_CONTRACT
+
+RULE_ID = 'header-discipline'
+
+_FLEET_PREFIX = 'x-skytpu-'
+_FLEET_EXACT = ('x-request-id',)
+
+
+def _fleet_name(name: str) -> bool:
+    low = name.lower()
+    return low.startswith(_FLEET_PREFIX) or low in _FLEET_EXACT
+
+
+def _site_loc(site: protocol_analysis.HeaderSite) -> str:
+    qname = site.qname or site.module.name
+    return f'{qname} ({site.module.posix}:' \
+           f'{getattr(site.node, "lineno", 0)})'
+
+
+def check(project: analysis.Project) -> Iterable[skylint.Finding]:
+    surface = protocol_analysis.surface_of(project)
+    contract_lower = {name.lower(): name for name in HEADER_CONTRACT}
+    findings: List[skylint.Finding] = []
+    seen = set()
+
+    def emit(site: protocol_analysis.HeaderSite, symbol: str,
+             message: str, chain=()) -> None:
+        key = (symbol, site.module.posix,
+               getattr(site.node, 'lineno', 0))
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(site.module.ctx.finding(
+            RULE_ID, site.node, symbol, message, call_chain=chain))
+
+    # -- unknown fleet-namespace literals
+    for site in surface.header_sites:
+        if not in_scope(site.module.posix):
+            continue
+        if site.module.name.rsplit('.', 1)[-1] == 'protocol':
+            continue
+        if not _fleet_name(site.name):
+            continue
+        if site.name.lower() in contract_lower:
+            continue
+        emit(site, site.name,
+             f'header {site.name!r} ({site.kind}) is in the fleet '
+             f'namespace but not in HEADER_CONTRACT — a typo here '
+             f'degrades silently (the other side never sees it); '
+             f'use the constant from skypilot_tpu/protocol.py or '
+             f'register the new header there')
+
+    # -- pairing: every contract header stamped somewhere must be
+    #    read somewhere, and vice versa
+    by_name = {}
+    for site in surface.header_sites:
+        canon = contract_lower.get(site.name.lower())
+        if canon is None:
+            continue
+        if site.module.name.rsplit('.', 1)[-1] == 'protocol':
+            continue
+        by_name.setdefault(canon, []).append(site)
+    for name, sites in sorted(by_name.items()):
+        stamps = [s for s in sites if s.kind == 'stamp']
+        reads = [s for s in sites if s.kind == 'read']
+        if stamps and not reads:
+            chain = tuple(_site_loc(s) for s in stamps)
+            emit(stamps[0], name,
+                 f'header {name!r} is stamped at {len(stamps)} '
+                 f'site(s) but never read anywhere in the tree — '
+                 f'either the reader was renamed away, or the header '
+                 f'is informational-only and the stamp site should '
+                 f'carry a "# skylint: disable={RULE_ID}" with the '
+                 f'rationale', chain)
+        elif reads and not stamps:
+            chain = tuple(_site_loc(s) for s in reads)
+            emit(reads[0], name,
+                 f'header {name!r} is read at {len(reads)} site(s) '
+                 f'but never stamped anywhere in the tree — the read '
+                 f'always sees the default, which usually means the '
+                 f'stamping side was renamed or dropped', chain)
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='fleet header literals must come from HEADER_CONTRACT '
+            'and be both stamped and read across the tree',
+    check=check,
+    project=True),)

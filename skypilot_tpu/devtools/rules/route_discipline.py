@@ -1,0 +1,128 @@
+"""route-discipline: both sides of every fleet route must match the
+ROUTE_CONTRACT, and every server must guard wrong-method hits.
+
+The fleet's HTTP surface is two codebases talking through string
+literals: `infer/server.py` dispatches on `route == '/handoff'`, the
+router and benches build `target + '/handoff'` — and nothing ties the
+two spellings together.  Rename one side and every e2e still compiles;
+the first symptom is a 404 in production.  This rule closes the loop
+through ``skypilot_tpu/protocol.py``:
+
+* a **client** request whose (method, path) no server dispatch in the
+  tree serves and no ROUTE_CONTRACT entry declares is a finding — the
+  call chain names the dispatch functions that DO serve that method,
+  which is where the typo'd route actually lives;
+* a **server** route absent from ROUTE_CONTRACT is a finding — new
+  endpoints must land in the contract (where statuses, headers and
+  docs live), not just in a dispatch table;
+* a module that serves routes for one method but never answers the
+  other method with **405 + an Allow header** is a finding: the stdlib
+  default is a bare 501, which retry classifiers treat as a replica
+  bug rather than a caller bug.
+
+Whole-program on purpose: the client site, the dispatch table and the
+contract are three different files.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from skypilot_tpu.devtools import analysis, protocol_analysis, skylint
+from skypilot_tpu.protocol import ROUTE_CONTRACT
+
+RULE_ID = 'route-discipline'
+
+# The fleet wire surface: serving data plane, inference servers,
+# bench clients.  Fixture trees opt in by using the same directory
+# names.
+_WIRE_DIRS = ('serve/', 'infer/', 'benchmark/')
+
+
+def in_scope(posix: str) -> bool:
+    return any(d in posix for d in _WIRE_DIRS) \
+        or posix.endswith('bench.py')
+
+
+def _loc(qname: str, mod: analysis.ModuleInfo, node) -> str:
+    return f'{qname or mod.name} ({mod.posix}:' \
+           f'{getattr(node, "lineno", 0)})'
+
+
+def check(project: analysis.Project) -> Iterable[skylint.Finding]:
+    surface = protocol_analysis.surface_of(project)
+    findings: List[skylint.Finding] = []
+    served = {(r.method, r.path) for r in surface.server_routes()}
+
+    # -- server side: every dispatched route must be contract-backed
+    for disp in surface.dispatches:
+        if not in_scope(disp.module.posix):
+            continue
+        for route in disp.routes.values():
+            if (route.method, route.path) in ROUTE_CONTRACT:
+                continue
+            findings.append(disp.module.ctx.finding(
+                RULE_ID, route.node,
+                f'{route.method} {route.path}',
+                f'handler serves {route.method} {route.path} but '
+                f'ROUTE_CONTRACT has no such route; register it in '
+                f'skypilot_tpu/protocol.py (statuses, headers, docs '
+                f'live there)'))
+
+    # -- wrong-method guards: a module serving GET routes must 405
+    #    (with Allow) POSTs to them, and vice versa
+    by_module = {}
+    for disp in surface.dispatches:
+        by_module.setdefault(disp.module.posix, []).append(disp)
+    for posix, disps in sorted(by_module.items()):
+        if not in_scope(posix):
+            continue
+        for method, other in (('GET', 'POST'), ('POST', 'GET')):
+            serving = [d for d in disps
+                       if d.method == method and d.routes]
+            if not serving:
+                continue
+            if any(d.guard_405_allow for d in disps
+                   if d.method == other):
+                continue
+            anchor = serving[0]
+            findings.append(anchor.module.ctx.finding(
+                RULE_ID, anchor.node, f'{other}-405-guard',
+                f'{posix} serves {method} routes but a {other} to '
+                f'them gets no 405+Allow answer (the stdlib default '
+                f'is a bare 501, which failover classifiers read as '
+                f'a server bug); add a {other} handler replying 405 '
+                f'with an Allow header'))
+
+    # -- client side: every literal-path request must hit a known route
+    for call in surface.client_calls:
+        if not in_scope(call.module.posix):
+            continue
+        if call.path is None or call.method is None:
+            continue    # dynamic: matches whatever the caller passes
+        key = (call.method, call.path)
+        if key in ROUTE_CONTRACT or key in served:
+            continue
+        chain = [_loc(call.qname, call.module, call.node)]
+        for disp in surface.dispatches:
+            if disp.method == call.method and disp.routes:
+                chain.append(
+                    f'{disp.qname} serves {call.method} '
+                    f'{", ".join(sorted(disp.routes))} '
+                    f'({disp.module.posix}:'
+                    f'{getattr(disp.node, "lineno", 0)})')
+        findings.append(call.module.ctx.finding(
+            RULE_ID, call.node, f'{call.method} {call.path}',
+            f'client requests {call.method} {call.path}, but no '
+            f'server dispatch serves it and ROUTE_CONTRACT does not '
+            f'declare it — a renamed or typo\'d route only fails at '
+            f'runtime with a 404',
+            call_chain=chain))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='fleet routes must exist in ROUTE_CONTRACT on both the '
+            'server and client side, with 405+Allow method guards',
+    check=check,
+    project=True),)

@@ -1,0 +1,156 @@
+"""status-discipline: every status a server can emit on a contract
+route is either handled by some client of that route or declared
+generic — and fail-closed statuses are never retried.
+
+The route and header rules check *names*; this rule checks
+*behaviour*.  ROUTE_CONTRACT marks each (route, status) pair as
+``generic`` (any try/except or HTTP-level error path is fine) or
+``branch`` (some client of the route must explicitly branch on the
+code: ``e.code == 503``, ``e.code in _RETRYABLE_REPLICA_CODES``).
+Three checks:
+
+* **unmet branch obligation** — a ``branch`` status on a route with
+  at least one literal-path client, where no client of that route
+  (literal-path or dynamic wildcard, looking a couple of call-graph
+  hops around each site) branches on the code.  The 503 the replica
+  emits while shedding is only useful if the router's failover and
+  the bench's backoff actually distinguish it from a 500;
+* **off-contract emission** — a contract route whose handler emits a
+  status the contract doesn't list: either the contract is stale or
+  the new status silently falls into clients' generic error paths;
+* **fail-closed retry** — routes with ``fail_closed`` statuses
+  (``POST /handoff``: a 409 HandoffVersionError means the two ends
+  disagree about the wire format — retrying on another peer corrupts
+  the decode).  A client of such a route whose retry classifier
+  admits the code, or whose ``except URLError`` arm ``continue``s a
+  peer loop without looking at ``.code`` (HTTPError *subclasses*
+  URLError, so the except arm silently converts a terminal 409 into
+  a retry), is a finding.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Set
+
+from skypilot_tpu.devtools import analysis, protocol_analysis, skylint
+from skypilot_tpu.devtools.rules.route_discipline import in_scope
+from skypilot_tpu.protocol import BRANCH, ROUTE_CONTRACT
+
+RULE_ID = 'status-discipline'
+
+
+def _loc(call: protocol_analysis.ClientCall) -> str:
+    qname = call.qname or call.module.name
+    return f'{qname} ({call.module.posix}:' \
+           f'{getattr(call.node, "lineno", 0)})'
+
+
+def check(project: analysis.Project) -> Iterable[skylint.Finding]:
+    surface = protocol_analysis.surface_of(project)
+    findings: List[skylint.Finding] = []
+
+    routes_by_key = {}
+    for r in surface.server_routes():
+        routes_by_key.setdefault((r.method, r.path), []).append(r)
+
+    scoped_clients = [c for c in surface.client_calls
+                     if in_scope(c.module.posix)]
+
+    def clients_of(method: str, path: str,
+                   exact_only: bool = False):
+        exact = [c for c in scoped_clients
+                 if c.path == path
+                 and c.method in (method, None)]
+        if exact_only:
+            return exact
+        wild = [c for c in scoped_clients
+                if c.path is None and c.method in (method, None)]
+        return exact + wild
+
+    # -- unmet branch obligations + fail-closed retries
+    for (method, path), spec in sorted(ROUTE_CONTRACT.items()):
+        exact = clients_of(method, path, exact_only=True)
+        if not exact:
+            continue      # nobody in-tree calls it: no obligations
+        all_clients = clients_of(method, path)
+        handled: Set[int] = set()
+        for c in all_clients:
+            if c.qname:
+                handled |= surface.handled_near(c.qname)
+        branch_codes = sorted(
+            code for code, kind in spec.statuses.items()
+            if kind == BRANCH)
+        for code in branch_codes:
+            if code in handled:
+                continue
+            anchor = exact[0]
+            chain = [_loc(c) for c in exact]
+            for r in routes_by_key.get((method, path), ()):
+                emit = r.statuses.get(code)
+                if emit is not None:
+                    chain.append(
+                        f'{r.qname} emits {code} for {path} '
+                        f'({r.module.posix}:'
+                        f'{getattr(emit, "lineno", 0)})')
+            findings.append(anchor.module.ctx.finding(
+                RULE_ID, anchor.node, f'{method} {path} {code}',
+                f'{method} {path} can answer {code} (a branch-'
+                f'required status in ROUTE_CONTRACT) but no client '
+                f'of the route branches on it — the code falls into '
+                f'a generic error path and its meaning (shed/retry-'
+                f'after/version-conflict) is lost',
+                call_chain=chain))
+        for code in sorted(spec.fail_closed):
+            for c in exact:
+                retried = surface.retried_near(c.qname) \
+                    if c.qname else set()
+                if code in retried:
+                    findings.append(c.module.ctx.finding(
+                        RULE_ID, c.node,
+                        f'{method} {path} {code}',
+                        f'{code} on {method} {path} is fail-closed '
+                        f'(ROUTE_CONTRACT) but this client\'s retry '
+                        f'classifier admits it — a terminal '
+                        f'version/format conflict would be retried',
+                        call_chain=[_loc(c)]))
+                elif c.swallows_fail_closed:
+                    findings.append(c.module.ctx.finding(
+                        RULE_ID, c.node,
+                        f'{method} {path} {code}',
+                        f'{code} on {method} {path} is fail-closed '
+                        f'(ROUTE_CONTRACT) but this call sits in an '
+                        f'"except URLError/OSError: continue" peer '
+                        f'loop with no .code branch — HTTPError '
+                        f'subclasses URLError, so the terminal '
+                        f'{code} is silently retried on the next '
+                        f'peer; catch HTTPError first and re-raise '
+                        f'fail-closed codes',
+                        call_chain=[_loc(c)]))
+
+    # -- off-contract emissions
+    for (method, path), routes in sorted(routes_by_key.items()):
+        spec = ROUTE_CONTRACT.get((method, path))
+        if spec is None:
+            continue      # route-discipline already flags it
+        for r in routes:
+            if not in_scope(r.module.posix):
+                continue
+            for code, node in sorted(r.statuses.items()):
+                if code in spec.statuses:
+                    continue
+                findings.append(r.module.ctx.finding(
+                    RULE_ID, node, f'{method} {path} {code}',
+                    f'handler for {method} {path} emits {code} but '
+                    f'ROUTE_CONTRACT does not list it for this '
+                    f'route — clients only know the contract; add '
+                    f'the status there (and decide generic vs '
+                    f'branch) or stop emitting it'))
+    return findings
+
+
+RULES = (skylint.Rule(
+    id=RULE_ID,
+    summary='server-emitted statuses on contract routes must be '
+            'client-handled per contract; fail-closed statuses must '
+            'never be retried',
+    check=check,
+    project=True),)

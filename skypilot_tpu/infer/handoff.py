@@ -58,24 +58,16 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-# 'SKHO' = SKytpu HandOff.  Bump VERSION on ANY layout or semantics
-# change — the receiver rejects other versions instead of guessing.
-# v2: artifact kinds (slot migration, fleet kv_prefix transfer) and
-# the optional zlib tensor section.
-MAGIC = b'SKHO'
-VERSION = 2
-
-# Router -> prefill-replica header naming the decode replica that the
-# rendezvous hash picked for this request; the prefill replica POSTs
-# the artifact there.  Lives here (not serve/ or server.py) so the
-# router can import it without dragging in a device runtime.
-DECODE_TARGET_HEADER = 'X-Skytpu-Decode-Target'
-
-# Router -> replica header naming the replica that the rendezvous hash
-# says OWNS this request's prefix-affinity key.  A replica that was
-# chosen by saturation fallback (not the owner) can ask the owner's
-# GET /kv_prefix for spilled prefix pages before prefilling from zero.
-PREFIX_PEER_HEADER = 'X-Skytpu-Prefix-Peer'
+# Wire identity and header names live in skypilot_tpu/protocol.py —
+# the single source for the fleet's cross-process surface — and are
+# re-exported here under their historical names.  protocol is stdlib
+# only, so this module stays loadable without a device runtime.
+from skypilot_tpu.protocol import (
+    DECODE_TARGET_HEADER,
+    PREFIX_PEER_HEADER,
+    SKHO_MAGIC as MAGIC,
+    SKHO_VERSION as VERSION,
+)
 
 _PREAMBLE = struct.Struct('>4sHI')
 

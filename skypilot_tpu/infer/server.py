@@ -91,6 +91,8 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import failures
 from skypilot_tpu.infer import handoff as handoff_lib
+from skypilot_tpu.protocol import (DEADLINE_HEADER,
+                                   HANDOFF_FAIL_CLOSED)
 from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.observability import ledger as ledger_lib
 from skypilot_tpu.observability import metrics as metrics_lib
@@ -860,7 +862,8 @@ class InferenceServer:
                 tokens = [self.engine.wait(r) for r in rids]
                 tokens = [
                     self._relay_blocking(r, t, decode_target,
-                                         http_request_id)
+                                         http_request_id,
+                                         deadline_s=deadline_s)
                     for r, t in zip(rids, tokens)]
             except BaseException:
                 for r in rids:
@@ -882,7 +885,7 @@ class InferenceServer:
         never JSON-parsed; geometry/version validation happens inside
         admit_handoff BEFORE any engine state is touched, so a bad
         artifact is a clean 400/409."""
-        hdr = handler.headers.get('X-Skytpu-Deadline-S')
+        hdr = handler.headers.get(DEADLINE_HEADER)
         try:
             deadline_s = float(hdr) if hdr else self.default_deadline_s
         except (TypeError, ValueError):
@@ -916,7 +919,8 @@ class InferenceServer:
                 blob = self.engine.take_handoff(rid)
                 if blob is not None:
                     for tok in self._relay_handoff(
-                            blob, handler.request_id, None):
+                            blob, handler.request_id, None,
+                            deadline_s=deadline_s):
                         _line({'token': tok})
             finally:
                 with self._relay_lock:
@@ -939,7 +943,8 @@ class InferenceServer:
 
     def _relay_handoff(self, blob: bytes,
                        http_request_id: Optional[str],
-                       decode_target: Optional[str]
+                       decode_target: Optional[str],
+                       deadline_s: Optional[float] = None
                        ) -> Iterator[int]:
         """Prefill-role side: ship the artifact to a decode replica and
         yield the tokens it streams back.  The router's per-request
@@ -971,9 +976,30 @@ class InferenceServer:
             req.add_header('Content-Type', 'application/octet-stream')
             if http_request_id:
                 req.add_header('X-Request-Id', http_request_id)
+            if deadline_s is not None and deadline_s > 0:
+                # The decode replica runs its own admission check;
+                # without the deadline it falls back to its default
+                # and a tight-SLO request loses its budget mid-relay.
+                req.add_header(DEADLINE_HEADER, f'{deadline_s:g}')
             try:
                 resp = urllib.request.urlopen(
                     req, timeout=self.stream_token_timeout)
+            except urllib.error.HTTPError as e:
+                # Must come before URLError (its base class): the
+                # generic arm below retries on the next peer, and a
+                # fail-closed status (wire-version/format conflict)
+                # would fail identically everywhere — or worse,
+                # half-succeed and duplicate output.
+                if e.code in HANDOFF_FAIL_CLOSED:
+                    raise RuntimeError(
+                        f'decode target {target} rejected the '
+                        f'handoff with HTTP {e.code}; fail-closed, '
+                        f'not retrying') from e
+                logger.warning(
+                    f'decode target {target} answered HTTP {e.code} '
+                    f'to a handoff; trying the next peer')
+                last = e
+                continue
             except (urllib.error.URLError, OSError) as e:
                 logger.warning(
                     f'decode target {target} refused a handoff '
@@ -1001,7 +1027,8 @@ class InferenceServer:
 
     def _token_iter(self, rid: int,
                     decode_target: Optional[str] = None,
-                    http_request_id: Optional[str] = None
+                    http_request_id: Optional[str] = None,
+                    deadline_s: Optional[float] = None
                     ) -> Iterator[int]:
         """Unified per-token stream for one request: the local engine's
         stream, then — iff the engine handed the request off (prefill
@@ -1022,14 +1049,16 @@ class InferenceServer:
             if blob is None:
                 return  # finished locally
             yield from self._relay_handoff(blob, http_request_id,
-                                           decode_target)
+                                           decode_target,
+                                           deadline_s=deadline_s)
         finally:
             with self._relay_lock:
                 self._active_relays -= 1
 
     def _relay_blocking(self, rid: int, toks: list,
                         decode_target: Optional[str],
-                        http_request_id: Optional[str]) -> list:
+                        http_request_id: Optional[str],
+                        deadline_s: Optional[float] = None) -> list:
         """Blocking-route tail of the handoff: append the remote
         replica's tokens to the locally produced ones (prefill role's
         seed token, or a migrated slot's pre-migration output)."""
@@ -1040,7 +1069,8 @@ class InferenceServer:
             if blob is None:
                 return toks
             return toks + list(self._relay_handoff(
-                blob, http_request_id, decode_target))
+                blob, http_request_id, decode_target,
+                deadline_s=deadline_s))
         finally:
             with self._relay_lock:
                 self._active_relays -= 1
@@ -1069,7 +1099,8 @@ class InferenceServer:
             self._work.set()
             toks = self.engine.wait(rid)
             toks = self._relay_blocking(rid, toks, decode_target,
-                                        http_request_id)
+                                        http_request_id,
+                                        deadline_s=deadline_s)
         else:
             with self._lock:
                 toks = self.engine.generate(
@@ -1142,7 +1173,8 @@ class InferenceServer:
                     rid,
                     decode_target=getattr(handler, 'decode_target',
                                           None),
-                    http_request_id=http_rid):
+                    http_request_id=http_rid,
+                    deadline_s=deadline_s):
                 if chaos.should_inject('client_disconnect'):
                     raise BrokenPipeError(
                         'chaos: simulated client disconnect')

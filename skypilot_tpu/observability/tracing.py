@@ -48,7 +48,9 @@ TERMINAL_STATES = ('finished', 'cancelled', 'evicted', 'aborted',
 # router to the replica it tries.  The trace id is the external
 # X-Request-Id; the parent span id names the router's attempt span so
 # a replica's work nests under the exact attempt that reached it.
-TRACE_HEADER = 'X-Skytpu-Trace'
+# The name itself lives in the protocol contract (single source for
+# every fleet wire header); this re-export is the historical spelling.
+from skypilot_tpu.protocol import TRACE_HEADER
 
 # Both halves share the router's request-id charset; anything else is
 # treated as absent rather than trusted.

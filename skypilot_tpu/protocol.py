@@ -1,0 +1,539 @@
+"""The fleet wire contract: routes, headers, statuses, env vars, SKHO.
+
+PRs 15–17 grew a real cross-process surface — replica HTTP servers
+(`infer/server.py`), the self-healing router (`serve/router.py`), the
+dashboard (`serve/dashboard.py`), and the clients that call them
+(router proxy/scrapes, handoff relays, peer prefix fetches, the
+benches).  Each side of that surface is easy to change alone and
+silently wrong to change alone: a renamed header, a new status code no
+client classifies, or an env var with two different inline defaults
+only surfaces in an e2e run, or in production.
+
+This module is the single source of truth for that surface, in the
+same pattern as ``observability.METRIC_CONTRACT`` /
+``observability.events.EVENT_CONTRACT``:
+
+- ``ROUTE_CONTRACT`` — every (method, path) the fleet serves, which
+  server(s) own it, the statuses it may emit (and how clients must
+  handle each), and the custom headers on either side of it.
+- ``HEADER_CONTRACT`` — every ``X-Skytpu-*`` / ``X-Request-Id``
+  header: who stamps it, who reads it.
+- ``ENV_CONTRACT`` — every ``SKYTPU_*`` environment variable: its
+  default, its parser, and the one-line doc that generates the
+  "Environment variables" table in docs/architecture.md.
+- the SKHO artifact version constants (``infer/handoff.py`` imports
+  them from here, so the wire-format version and the header names
+  have exactly one home).
+
+`devtools/rules/{route,header,status,env}_discipline.py` mechanize the
+contract: an AST extraction pass (`devtools/protocol_analysis.py`)
+recovers both sides of the wire from the skylint whole-program index
+and checks them against these tables, so a protocol drift is a lint
+finding with a cross-file call chain instead of a production incident.
+
+Stdlib only, imports nothing from the package: the router, the
+replica server, `infer/handoff.py`, and skylint itself must all be
+able to load it without touching a device runtime.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping, Optional, Tuple
+
+# ---------------------------------------------------------------------
+# SKHO artifact versioning (single source; infer/handoff.py re-exports)
+# ---------------------------------------------------------------------
+
+# 'SKHO' = SKytpu HandOff.  Bump SKHO_VERSION on ANY layout or
+# semantics change — receivers reject other versions (HTTP 409,
+# fail-closed) instead of guessing.
+SKHO_MAGIC = b'SKHO'
+SKHO_VERSION = 2
+
+# Version matrix (docs/architecture.md renders this verbatim): what
+# each wire version can carry.  A v1 reader rejects v2 artifacts and
+# vice versa — there is no negotiation, by design.
+SKHO_VERSION_MATRIX: Mapping[int, str] = {
+    1: 'prefill handoff artifacts only; uncompressed tensor section',
+    2: "artifact kinds ('prefill', 'slot' migration, 'kv_prefix' "
+       'fleet transfer) + optional zlib tensor compression',
+}
+
+# ---------------------------------------------------------------------
+# Headers
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class HeaderSpec:
+    """One custom wire header: which side stamps it, which reads it.
+
+    ``stamped_by``/``read_by`` are informational role names
+    ('client', 'router', 'replica', ...); the header-discipline rule
+    derives the *actual* stamp/read sites from the AST and flags
+    one-sided headers — an empty ``read_by`` documents a deliberately
+    one-sided (diagnostic) header."""
+    name: str
+    stamped_by: Tuple[str, ...]
+    read_by: Tuple[str, ...]
+    doc: str
+
+
+# Canonical spellings.  Anything matching X-Skytpu-* or X-Request-Id
+# that is NOT one of these names (case-insensitive) is a
+# header-discipline finding — the typo'd side would wait forever for a
+# header nobody sends.
+REQUEST_ID_HEADER = 'X-Request-Id'
+TRACE_HEADER = 'X-Skytpu-Trace'
+DECODE_TARGET_HEADER = 'X-Skytpu-Decode-Target'
+PREFIX_PEER_HEADER = 'X-Skytpu-Prefix-Peer'
+DEADLINE_HEADER = 'X-Skytpu-Deadline-S'
+SERVED_BY_HEADER = 'X-Served-By'
+
+HEADER_CONTRACT: Dict[str, HeaderSpec] = {
+    spec.name: spec for spec in (
+        HeaderSpec(
+            REQUEST_ID_HEADER,
+            stamped_by=('client', 'router', 'replica'),
+            read_by=('router', 'replica', 'client'),
+            doc='External request id; echoed on every response and '
+                'used as the distributed trace id (the /traces stitch '
+                'key).  Routers generate one when the client sends '
+                'none or a non `[A-Za-z0-9._:-]{1,64}` token.'),
+        HeaderSpec(
+            TRACE_HEADER,
+            stamped_by=('router',),
+            read_by=('replica',),
+            doc='`<trace_id>/<parent_span_id>` propagation from the '
+                "router's per-attempt span to the replica, so replica "
+                'engine traces nest under the exact attempt that '
+                'reached them.'),
+        HeaderSpec(
+            DECODE_TARGET_HEADER,
+            stamped_by=('router',),
+            read_by=('replica',),
+            doc='Router -> prefill-replica: the decode replica the '
+                'rendezvous hash picked; the prefill replica POSTs '
+                'the SKHO artifact to its /handoff.'),
+        HeaderSpec(
+            PREFIX_PEER_HEADER,
+            stamped_by=('router',),
+            read_by=('replica',),
+            doc='Router -> replica: the rendezvous OWNER of this '
+                "request's prefix-affinity key; a saturation-fallback "
+                "replica asks the owner's GET /kv_prefix for spilled "
+                'prefix pages before prefilling from zero.'),
+        HeaderSpec(
+            DEADLINE_HEADER,
+            stamped_by=('replica',),
+            read_by=('replica',),
+            doc='Prefill -> decode replica on POST /handoff: the '
+                "relayed request's remaining deadline budget in "
+                'seconds, so the decode side sheds work the original '
+                "client already gave up on instead of inheriting the "
+                'default deadline.'),
+        HeaderSpec(
+            SERVED_BY_HEADER,
+            stamped_by=('router',),
+            read_by=(),     # deliberately one-sided: a human/debug aid
+            doc='Router -> client diagnostic: the replica URL that '
+                'actually served a proxied response (failovers make '
+                '"which replica was that?" otherwise unanswerable).'),
+    )
+}
+
+# ---------------------------------------------------------------------
+# Routes
+# ---------------------------------------------------------------------
+
+# How a client must handle a server-emitted status:
+#   'branch'  — some client must branch on the literal code (or a
+#               named retry-classifier tuple containing it); a status
+#               nobody classifies is a latent outage mode.
+#   'generic' — a generic HTTPError/error arm suffices (diagnostic or
+#               low-stakes codes).
+BRANCH = 'branch'
+GENERIC = 'generic'
+
+
+@dataclasses.dataclass(frozen=True)
+class RouteSpec:
+    """One (method, path) of the fleet wire surface."""
+    method: str
+    path: str
+    servers: Tuple[str, ...]          # 'replica' | 'router' | 'dashboard'
+    statuses: Mapping[int, str]       # code -> BRANCH | GENERIC
+    # Statuses that are fail-closed: a client must treat them as
+    # terminal for this artifact/request — retrying them (on the same
+    # or another peer) can never succeed and may duplicate output.
+    fail_closed: Tuple[int, ...] = ()
+    request_headers: Tuple[str, ...] = ()
+    response_headers: Tuple[str, ...] = ()
+    doc: str = ''
+
+
+def _route(method, path, servers, statuses, **kw) -> RouteSpec:
+    return RouteSpec(method=method, path=path, servers=servers,
+                     statuses=statuses, **kw)
+
+
+# Terminal statuses on POST /handoff: the two ends disagree about the
+# artifact (wire version, format) — retrying on another peer can never
+# succeed and may duplicate output.  A plain literal tuple so client
+# code (and skylint's constant resolver) can share it by name.
+HANDOFF_FAIL_CLOSED = (400, 409)
+
+# The replica server's generic arms apply to every route its dispatch
+# serves: 404 unknown path, 405+Allow wrong method, 500 handler error.
+_REPLICA_GENERIC = {404: GENERIC, 405: GENERIC, 500: GENERIC}
+# Every replica POST route shares one dispatch try/except, so every
+# one of its arms (shed 503, deadline 504, handoff 400/409, bad
+# payload 400, crash 500) is a possible answer on every POST route.
+# Which of them a client must BRANCH on is per-route below.
+_REPLICA_POST = {200: GENERIC, 400: GENERIC, 404: GENERIC,
+                 405: GENERIC, 409: GENERIC, 500: GENERIC,
+                 503: GENERIC, 504: GENERIC}
+
+ROUTE_CONTRACT: Dict[Tuple[str, str], RouteSpec] = {
+    (spec.method, spec.path): spec for spec in (
+        # -- replica + router shared surface --------------------------
+        _route('GET', '/health', ('replica', 'router'),
+               {200: GENERIC, 503: BRANCH, **_REPLICA_GENERIC},
+               response_headers=(REQUEST_ID_HEADER,),
+               doc='Three-state health: ok / draining / unhealthy.  '
+                   '503 carries the unroutable states — probes must '
+                   'branch on it (a draining listener still accepts '
+                   'TCP).'),
+        _route('GET', '/metrics', ('replica', 'router'),
+               {200: GENERIC, **_REPLICA_GENERIC},
+               doc='Prometheus exposition (per-process registry).'),
+        _route('GET', '/events', ('replica', 'router'),
+               {200: GENERIC, **_REPLICA_GENERIC},
+               doc='Flight-recorder ring snapshot (?limit=).'),
+        _route('GET', '/traces', ('replica', 'router'),
+               {200: GENERIC, **_REPLICA_GENERIC},
+               doc='Request traces; on the router ?id=&stitch=1 joins '
+                   'router spans with replica engine timelines.'),
+        _route('GET', '/v1/models', ('replica', 'router'),
+               {200: GENERIC, 502: GENERIC, 503: GENERIC,
+                **_REPLICA_GENERIC},
+               doc='OpenAI-compatible model listing (the router '
+                   'proxies it to a replica, so the 502/503 '
+                   'no-routable-replica arms apply).'),
+        # -- replica-only ---------------------------------------------
+        _route('GET', '/kv_prefix', ('replica',),
+               {200: GENERIC, 400: GENERIC, 404: GENERIC,
+                **_REPLICA_GENERIC},
+               doc='Fleet prefix-cache tier: the leading run of '
+                   'host-spilled KV pages for ?hashes=, as an SKHO '
+                   'kv_prefix artifact.  Misses (404) and skew are '
+                   'survivable by design — the caller just '
+                   'prefills.'),
+        _route('GET', '/profile/steps', ('replica',),
+               {200: GENERIC, **_REPLICA_GENERIC},
+               doc='Step-ledger snapshot (?limit=).'),
+        _route('GET', '/profile/timeline', ('replica',),
+               {200: GENERIC, **_REPLICA_GENERIC},
+               doc='Perfetto-style timeline document (?traces=).'),
+        _route('POST', '/generate', ('replica', 'router'),
+               {**_REPLICA_POST, 500: BRANCH, 502: BRANCH, 503: BRANCH},
+               request_headers=(REQUEST_ID_HEADER, TRACE_HEADER,
+                                DECODE_TARGET_HEADER,
+                                PREFIX_PEER_HEADER),
+               response_headers=(REQUEST_ID_HEADER, SERVED_BY_HEADER),
+               doc='Native generation (blocking or ndjson stream).  '
+                   '503+Retry-After = shed (retry at the given pace); '
+                   '504 = deadline exceeded (deterministic, relay '
+                   'as-is); 500/502 through the router are retried on '
+                   'another replica by the failover classifier.'),
+        _route('POST', '/v1/completions', ('replica', 'router'),
+               {**_REPLICA_POST, 500: BRANCH, 502: BRANCH, 503: BRANCH},
+               request_headers=(REQUEST_ID_HEADER, TRACE_HEADER,
+                                DECODE_TARGET_HEADER,
+                                PREFIX_PEER_HEADER),
+               response_headers=(REQUEST_ID_HEADER, SERVED_BY_HEADER),
+               doc='OpenAI completions (+SSE streaming).'),
+        _route('POST', '/v1/chat/completions', ('replica', 'router'),
+               {**_REPLICA_POST, 500: BRANCH, 502: BRANCH, 503: BRANCH},
+               request_headers=(REQUEST_ID_HEADER, TRACE_HEADER,
+                                DECODE_TARGET_HEADER,
+                                PREFIX_PEER_HEADER),
+               response_headers=(REQUEST_ID_HEADER, SERVED_BY_HEADER),
+               doc='OpenAI chat completions (+SSE streaming).'),
+        _route('POST', '/drain', ('replica',),
+               dict(_REPLICA_POST),
+               doc='Supervisor -> replica: stop admitting, finish or '
+                   'migrate in-flight work ({"migrate": bool, '
+                   '"targets": [...]}).  Best-effort: callers fall '
+                   'back to the drain deadline on any failure.'),
+        _route('POST', '/handoff', ('replica',),
+               {**_REPLICA_POST, 400: BRANCH, 409: BRANCH,
+                503: BRANCH},
+               fail_closed=HANDOFF_FAIL_CLOSED,
+               request_headers=(REQUEST_ID_HEADER, DEADLINE_HEADER),
+               doc='SKHO artifact ingest (disaggregated decode, live '
+                   'migration).  409 = version/geometry skew '
+                   '(HandoffVersionError): FAIL-CLOSED — every peer '
+                   'runs the same build mid-rollout, so retrying on '
+                   'another peer cannot succeed and must not be '
+                   'attempted.  400 = malformed artifact, equally '
+                   'terminal.  503 = shed; the artifact is immutable '
+                   'bytes, so trying the NEXT peer is safe.'),
+        _route('POST', '/profile/device', ('replica',),
+               dict(_REPLICA_POST),
+               doc='On-demand device profiler ({"steps": n}); 409 '
+                   'while a capture is already active '
+                   '(ProfileActiveError: single-flight, wait it '
+                   'out rather than retrying).'),
+        # -- router-only ----------------------------------------------
+        _route('GET', '/fleet/metrics', ('router',),
+               {200: GENERIC, **_REPLICA_GENERIC},
+               doc='Federated exposition: every routable replica\'s '
+                   '/metrics merged, each series labeled '
+                   'replica="url".'),
+        _route('GET', '/fleet/slo', ('router',),
+               {200: GENERIC, **_REPLICA_GENERIC},
+               doc='Fleet SLO roll-up: goodput vs target, burn '
+                   'rate.'),
+        _route('GET', '/fleet/profile', ('router',),
+               {200: GENERIC, **_REPLICA_GENERIC},
+               doc='Fleet step-ledger roll-up (?limit= per replica).'),
+        _route('GET', '/router/replicas', ('router',),
+               {200: GENERIC, **_REPLICA_GENERIC},
+               doc='Per-replica routing views: health, breaker, '
+                   'inflight, queue depth, role.'),
+        # -- controller (serve/controller.py) -------------------------
+        _route('POST', '/controller/load_balancer_sync',
+               ('controller',),
+               {200: GENERIC, 404: GENERIC, 405: GENERIC,
+                500: GENERIC},
+               doc='Load balancer -> controller heartbeat: request '
+                   'counts up, fresh replica URL set back.  '
+                   'Best-effort; the balancer keeps serving its last '
+                   'known set on any failure.'),
+        _route('POST', '/controller/update_service',
+               ('controller',),
+               {200: GENERIC, 404: GENERIC, 405: GENERIC,
+                500: GENERIC},
+               doc='Blue-green rollout trigger: adopt the already '
+                   'persisted spec for the given version.'),
+        _route('GET', '/controller/health', ('controller',),
+               {200: GENERIC, 404: GENERIC, 405: GENERIC},
+               doc='Controller liveness probe; echoes the service '
+                   'name.'),
+        _route('GET', '/services', ('controller',),
+               {200: GENERIC, 404: GENERIC, 405: GENERIC},
+               doc='Browsable `sky serve status` analog (HTML), '
+                   'scoped to this controller\'s service.'),
+        # -- dashboard ------------------------------------------------
+        _route('GET', '/', ('dashboard',),
+               {200: GENERIC, 404: GENERIC, 405: GENERIC},
+               doc='HTML services+fleet page.'),
+        _route('GET', '/healthz', ('dashboard',),
+               {200: GENERIC, 404: GENERIC, 405: GENERIC},
+               doc='Dashboard liveness probe.'),
+        _route('GET', '/api/services', ('dashboard', 'controller'),
+               {200: GENERIC, 404: GENERIC, 405: GENERIC},
+               doc='JSON service/replica snapshot (the controller '
+                   'serves the same shape so the dashboard page '
+                   'works against either).'),
+        _route('GET', '/api/fleet', ('dashboard',),
+               {200: GENERIC, 404: GENERIC, 405: GENERIC},
+               doc='Fleet snapshot proxied from the router; 404 until '
+                   'started with --router (the page script branches '
+                   'on it to hide the fleet section).'),
+    )
+}
+
+
+def routes_for(server: str) -> Dict[str, Tuple[str, ...]]:
+    """{'GET': (paths...), 'POST': (paths...)} for one server role —
+    what the round-trip tests compare against the live dispatch
+    tables."""
+    out: Dict[str, list] = {}
+    for (method, path), spec in sorted(ROUTE_CONTRACT.items()):
+        if server in spec.servers:
+            out.setdefault(method, []).append(path)
+    return {m: tuple(ps) for m, ps in out.items()}
+
+
+# ---------------------------------------------------------------------
+# Environment variables
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvSpec:
+    """One SKYTPU_* environment variable.
+
+    ``default`` is the exact literal a read site must pass as its
+    inline default (env-discipline flags divergence); None when the
+    default is computed (cwd, a path expansion, a batch-size
+    multiple) or when unset simply disables the feature —
+    ``default_doc`` is what the docs table shows either way."""
+    name: str
+    default: Optional[str]
+    parser: str                       # int|float|str|path|flag|schedule
+    default_doc: str
+    doc: str
+
+
+def _env(name, default, parser, doc,
+         default_doc: Optional[str] = None) -> EnvSpec:
+    return EnvSpec(name=name, default=default, parser=parser,
+                   default_doc=(default_doc if default_doc is not None
+                                else (default if default not in (None, '')
+                                      else 'unset')),
+                   doc=doc)
+
+
+ENV_CONTRACT: Dict[str, EnvSpec] = {
+    spec.name: spec for spec in (
+        # -- serving: replica server admission/lifecycle --------------
+        _env('SKYTPU_REQUEST_DEADLINE_S', '600', 'float',
+             'Default per-request deadline when the payload carries '
+             'no deadline_s; admission sheds work that cannot meet '
+             'it.'),
+        _env('SKYTPU_MAX_QUEUE_DEPTH', None, 'int',
+             'Admission queue-depth bound; deeper queues shed with '
+             '503+Retry-After.', default_doc='8 * max_batch_size'),
+        _env('SKYTPU_STREAM_TOKEN_TIMEOUT_S', '120', 'float',
+             'Inter-token timeout for streamed responses and handoff '
+             'relays; a stalled decode cancels instead of hanging '
+             'the client.'),
+        _env('SKYTPU_STEP_STALL_TIMEOUT_S', '120', 'float',
+             'Watchdog: a decode step exceeding this marks the '
+             'replica unhealthy.'),
+        _env('SKYTPU_LOOP_MAX_RESTARTS', '5', 'int',
+             'Supervised decode-loop restarts tolerated within the '
+             'restart window before the replica goes unhealthy.'),
+        _env('SKYTPU_LOOP_RESTART_WINDOW_S', '60', 'float',
+             'Sliding window for the restart budget.'),
+        _env('SKYTPU_DRAIN_TIMEOUT_S', '600', 'float',
+             'POST /drain grace: in-flight work gets this long '
+             'before hard shutdown.'),
+        _env('SKYTPU_SHUTDOWN_JOIN_S', '5', 'float',
+             'Thread-join grace during server shutdown.'),
+        _env('SKYTPU_PREEMPT_NOTICE_S', '0', 'float',
+             'Simulated preemption notice for the replica supervisor '
+             '(tests/chaos; 0 = disabled).'),
+        # -- serving: SLO + router ------------------------------------
+        _env('SKYTPU_SLO_TTFT_S', None, 'float',
+             'TTFT SLO target in seconds for goodput accounting; '
+             'unset or <= 0 disables that SLO.',
+             default_doc='unset (disabled)'),
+        _env('SKYTPU_SLO_TPOT_S', None, 'float',
+             'TPOT SLO target in seconds; unset or <= 0 disables.',
+             default_doc='unset (disabled)'),
+        _env('SKYTPU_SLO_GOODPUT_TARGET', '', 'float',
+             'Fleet goodput target in (0, 1) for /fleet/slo burn '
+             'rate.', default_doc='0.99'),
+        # -- serving: handoff/migration/cache -------------------------
+        _env('SKYTPU_HANDOFF_COMPRESS', None, 'flag',
+             'Non-empty enables the SKHO v2 zlib tensor section on '
+             'outbound handoff artifacts.',
+             default_doc='unset (uncompressed)'),
+        # -- observability --------------------------------------------
+        _env('SKYTPU_TRACE_RING', '', 'int',
+             'Completed-trace ring capacity for the engine '
+             'TraceStore.', default_doc='256'),
+        _env('SKYTPU_TRACE_JSONL', None, 'path',
+             'Mirror every trace transition to this JSONL file.',
+             default_doc='unset (off)'),
+        _env('SKYTPU_STEP_LEDGER', '1', 'flag',
+             "'0' disables the per-step performance ledger."),
+        _env('SKYTPU_STEP_LEDGER_CAP', '', 'int',
+             'Step-ledger ring capacity.', default_doc='512'),
+        _env('SKYTPU_PROFILE_DIR', '', 'path',
+             'Directory for on-demand device-profiler captures.',
+             default_doc='SKYTPU_LOG_DIR'),
+        _env('SKYTPU_LOG_DIR', None, 'path',
+             'Root for log/profile artifacts.',
+             default_doc='os.getcwd()'),
+        _env('SKYTPU_LOG_JSON', None, 'flag',
+             'Non-empty switches logging to one-JSON-object-per-line '
+             '(machine ingestion).', default_doc='unset (text)'),
+        _env('SKYTPU_DEBUG', None, 'flag',
+             'Non-empty enables debug-level logging and timeline '
+             'annotations.', default_doc='unset'),
+        _env('SKYTPU_TIMELINE_FILE', None, 'path',
+             'Host-side timeline event sink.',
+             default_doc='~/.skytpu/timeline-<pid>.jsonl'),
+        # -- chaos ----------------------------------------------------
+        _env('SKYTPU_CHAOS', '', 'schedule',
+             "Fault-injection schedule ('point:p=..,seed=..;...'); "
+             'unset disables every fault point.',
+             default_doc='unset (no faults)'),
+        # -- workload stack (train/ops/parallel) ----------------------
+        _env('SKYTPU_PREFETCH_DEPTH', '2', 'int',
+             'Device prefetch depth of the input pipeline.'),
+        _env('SKYTPU_PROFILE', None, 'flag',
+             'Non-empty captures a jax.profiler trace around the '
+             'trainer steady state.', default_doc='unset'),
+        _env('SKYTPU_FORCE_PALLAS', '', 'flag',
+             'Force the Pallas kernel paths even where the reference '
+             'path would be picked.', default_doc='unset'),
+        _env('SKYTPU_BACKEND_INIT_RETRIES', '3', 'int',
+             'Attempts to initialize the jax backend before giving '
+             'up.'),
+        _env('SKYTPU_BACKEND_INIT_BACKOFF_S', '5', 'float',
+             'Base backoff between backend-init attempts.'),
+        _env('SKYTPU_BACKEND_INIT_TIMEOUT_S', '180', 'float',
+             'Per-attempt backend-init watchdog.'),
+        # -- orchestrator ---------------------------------------------
+        _env('SKYTPU_STATE_DIR', None, 'path',
+             'Root of the local state database and logs.',
+             default_doc='~/.skytpu'),
+        _env('SKYTPU_USER_HASH', None, 'str',
+             'Stable user hash override for cluster-name '
+             'namespacing.', default_doc='derived'),
+        _env('SKYTPU_LOCAL_HOST_ROOT', None, 'path',
+             'Local-process cloud: fake host root for agent '
+             'daemon/RPC tests.', default_doc='unset'),
+        _env('SKYTPU_QUEUED_TIMEOUT', '1800', 'float',
+             'GCP TPU QUEUED->PROVISIONING wait before failing over '
+             'to the next zone.'),
+        _env('SKYTPU_AWS_SG_DELETE_WAIT_S', '120', 'float',
+             'AWS security-group delete wait during teardown.'),
+        _env('SKYTPU_JOBS_DASHBOARD_HOST', '127.0.0.1', 'str',
+             'Bind host of the managed-jobs dashboard.'),
+        _env('SKYTPU_JOBS_DASHBOARD_PORT', None, 'int',
+             'Port of the managed-jobs dashboard.',
+             default_doc='5050'),
+        # -- bench ----------------------------------------------------
+        _env('SKYTPU_BENCH_TOTAL_BUDGET_S', '1500', 'float',
+             'Total wall budget the bench ladder divides across its '
+             'rungs.'),
+        _env('SKYTPU_BENCH_E2E_DEADLINE_S', '3600', 'float',
+             'Hard deadline for one e2e bench attempt.'),
+        _env('SKYTPU_BENCH_DIRECT_TIMEOUT_S', '2400', 'float',
+             'Watchdog for one --direct bench run.'),
+        _env('SKYTPU_BENCH_DIRECT_ATTEMPTS', '3', 'int',
+             'Direct-rung attempts before falling back to the '
+             'cache.'),
+        _env('SKYTPU_BENCH_DIRECT_SPACING_S', '600', 'float',
+             'Spacing between direct-rung attempts.'),
+        _env('SKYTPU_BENCH_REGRESSION_TOL', '0.25', 'float',
+             'Relative tolerance of the --check-baseline regression '
+             'gate.'),
+        _env('SKYTPU_BENCH_CACHE', None, 'path',
+             'Location of the last-good bench capture.',
+             default_doc='<repo>/BENCH_cache.json'),
+        _env('SKYTPU_BENCH_CACHE_MAX_AGE_S', None, 'float',
+             'Max age before a cached capture stops counting as a '
+             'result.', default_doc='86400'),
+        _env('SKYTPU_BENCH_PROBE_LOG', None, 'path',
+             'Probe-ladder JSONL log location.',
+             default_doc='<repo>/BENCH_probes.jsonl'),
+        _env('SKYTPU_BENCH_TINY', None, 'flag',
+             "'1' shrinks bench shapes to CPU-smoke scale.",
+             default_doc='unset'),
+    )
+}
+
+
+def env_table_rows() -> Tuple[Tuple[str, str, str, str], ...]:
+    """(name, default, parser, doc) rows, sorted — the docs generator
+    and its checker both consume this, so the table cannot drift."""
+    return tuple((s.name, s.default_doc, s.parser, s.doc)
+                 for _, s in sorted(ENV_CONTRACT.items()))

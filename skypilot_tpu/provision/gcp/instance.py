@@ -157,7 +157,7 @@ def _tpu_node_body(node_cfg: Dict[str, Any], cluster_name_on_cloud: str,
 
 def _queued_timeout_s() -> float:
     try:
-        return float(os.environ.get('SKYTPU_QUEUED_TIMEOUT', 1800))
+        return float(os.environ.get('SKYTPU_QUEUED_TIMEOUT', '1800'))
     except ValueError:
         return 1800.0
 

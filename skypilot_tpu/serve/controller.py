@@ -30,6 +30,11 @@ if typing.TYPE_CHECKING:
 
 logger = sky_logging.init_logger(__name__)
 
+# Method surfaces, for the wrong-method 405+Allow guards below.
+_GET_ROUTES = ('/controller/health', '/services', '/api/services')
+_POST_ROUTES = ('/controller/load_balancer_sync',
+                '/controller/update_service')
+
 
 class SkyServeController:
 
@@ -143,7 +148,19 @@ class SkyServeController:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_405(self, allow: str) -> None:
+                # Explicit wrong-method answer: the stdlib default is
+                # a bare 501, which callers read as a controller bug.
+                self.send_response(405)
+                self.send_header('Allow', allow)
+                self.send_header('Content-Length', '0')
+                self.end_headers()
+
             def do_POST(self) -> None:  # noqa: N802
+                if self.path.split('?', 1)[0].rstrip('/') \
+                        in _GET_ROUTES:
+                    self._send_405('GET')
+                    return
                 length = int(self.headers.get('Content-Length', 0))
                 payload = json.loads(self.rfile.read(length) or b'{}')
                 if self.path == '/controller/load_balancer_sync':
@@ -165,7 +182,9 @@ class SkyServeController:
             def do_GET(self) -> None:  # noqa: N802
                 from skypilot_tpu.serve import dashboard
                 path = self.path.split('?', 1)[0].rstrip('/')
-                if path == '/controller/health':
+                if path in _POST_ROUTES:
+                    self._send_405('POST')
+                elif path == '/controller/health':
                     self._send_json({'service': controller.service_name})
                 elif path == '/services':
                     # Browsable `sky serve status` analog, scoped to

@@ -314,6 +314,9 @@ def render_index(service_name: Optional[str] = None) -> str:
     return _PAGE.format(body=''.join(parts))
 
 
+_GET_ROUTES = ('/', '/healthz', '/api/services', '/api/fleet')
+
+
 class _Handler(http.server.BaseHTTPRequestHandler):
 
     # Set by start(): router base URL for fleet mode, or None.
@@ -351,6 +354,23 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         json.dumps(
                             fleet_snapshot(self.router_url)).encode(),
                         'application/json')
+            else:
+                self._send(404, b'{"error": "not found"}',
+                           'application/json')
+        except OSError:
+            pass  # client went away mid-write
+
+    def do_POST(self) -> None:  # noqa: N802 (stdlib API name)
+        # Read-only server: a POST to a known page gets an explicit
+        # 405+Allow (the stdlib default is a bare 501, which retry
+        # classifiers read as a server bug), anything else a 404.
+        path = self.path.split('?', 1)[0].rstrip('/') or '/'
+        try:
+            if path in _GET_ROUTES:
+                self.send_response(405)
+                self.send_header('Allow', 'GET')
+                self.send_header('Content-Length', '0')
+                self.end_headers()
             else:
                 self._send(404, b'{"error": "not found"}',
                            'application/json')

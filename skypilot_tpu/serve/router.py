@@ -80,6 +80,13 @@ _RETRYABLE_REPLICA_CODES = (500, 502)
 
 _PROXY_ROUTES = ('/generate', '/v1/completions', '/v1/chat/completions')
 
+# GET surface, for the wrong-method 405+Allow guard in do_POST (the
+# stdlib default answer would be a bare 501, which failover
+# classifiers read as a server bug rather than a caller bug).
+_GET_ROUTES = ('/health', '/metrics', '/fleet/metrics', '/fleet/slo',
+               '/fleet/profile', '/events', '/traces',
+               '/router/replicas', '/v1/models')
+
 
 def _router_metrics(registry: Optional[metrics_lib.Registry] = None):
     """Get-or-create the skytpu_router_* series (all names are in
@@ -892,7 +899,8 @@ class Router:
                              f'[{self.request_id}] {format % args}')
 
             def _reply(self, code: int, body: dict,
-                       retry_after: Optional[float] = None) -> None:
+                       retry_after: Optional[float] = None,
+                       allow: Optional[str] = None) -> None:
                 data = json.dumps(body).encode()
                 try:
                     self.send_response(code)
@@ -902,6 +910,8 @@ class Router:
                     if retry_after is not None:
                         self.send_header(
                             'Retry-After', str(max(1, int(retry_after))))
+                    if allow is not None:
+                        self.send_header('Allow', allow)
                     self.end_headers()
                     self.wfile.write(data)
                 except OSError:
@@ -973,6 +983,9 @@ class Router:
                                      for v in router.views()]})
                 elif route == '/v1/models':
                     router._proxy(self, body=None)
+                elif route in _PROXY_ROUTES:
+                    self._reply(405, {'error': 'method not allowed'},
+                                allow='POST')
                 else:
                     self._reply(404, {'error': 'not found'})
 
@@ -980,7 +993,12 @@ class Router:
                 route = self.path.split('?', 1)[0]
                 self.request_id = router._request_id(self.headers)
                 if route not in _PROXY_ROUTES:
-                    self._reply(404, {'error': 'not found'})
+                    if route in _GET_ROUTES:
+                        self._reply(405,
+                                    {'error': 'method not allowed'},
+                                    allow='GET')
+                    else:
+                        self._reply(404, {'error': 'not found'})
                     return
                 try:
                     length = int(self.headers.get('Content-Length', 0))
@@ -1215,7 +1233,9 @@ class Router:
                 seen.add(k.lower())
             if 'x-request-id' not in seen:
                 handler.send_header('X-Request-Id', handler.request_id)
-            handler.send_header('X-Served-By', view.url)
+            # Deliberately one-sided: X-Served-By exists for humans
+            # reading curl output / access logs, no code reads it.
+            handler.send_header('X-Served-By', view.url)  # skylint: disable=header-discipline
             length = resp.headers.get('Content-Length')
             if length is not None:
                 handler.send_header('Content-Length', length)

@@ -1,0 +1,266 @@
+"""skypilot_tpu.protocol: the single-source wire contract — route
+round-trips against the live servers' actual dispatch tables, header
+constant identity across the modules that re-export them, the env
+contract vs the docs table, and regressions pinning the protocol
+fixes this contract surfaced (fail-closed handoff statuses, deadline
+propagation, 405+Allow wrong-method guards).
+
+(PR: skylint 3.0 cross-process protocol analysis.)
+"""
+import io
+import json
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from skypilot_tpu import protocol
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+# ---------------------------------------------------------------------
+# contract self-consistency
+# ---------------------------------------------------------------------
+
+def test_route_contract_keys_match_specs():
+    for (method, path), spec in protocol.ROUTE_CONTRACT.items():
+        assert spec.method == method and spec.path == path
+        assert spec.statuses, (method, path)
+        for code in spec.fail_closed:
+            assert code in spec.statuses, (method, path, code)
+        for name in spec.request_headers + spec.response_headers:
+            assert name in protocol.HEADER_CONTRACT, (method, path,
+                                                      name)
+
+
+def test_header_contract_names_are_canonical():
+    for name, spec in protocol.HEADER_CONTRACT.items():
+        assert spec.name == name
+        assert name.startswith('X-'), name
+
+
+def test_skho_version_matrix_covers_current_version():
+    assert protocol.SKHO_VERSION in protocol.SKHO_VERSION_MATRIX
+    assert protocol.SKHO_MAGIC == b'SKHO'
+
+
+def test_handoff_and_tracing_reexport_protocol_constants():
+    from skypilot_tpu.infer import handoff
+    from skypilot_tpu.observability import tracing
+    assert handoff.MAGIC is protocol.SKHO_MAGIC
+    assert handoff.VERSION == protocol.SKHO_VERSION
+    assert handoff.DECODE_TARGET_HEADER \
+        is protocol.DECODE_TARGET_HEADER
+    assert handoff.PREFIX_PEER_HEADER is protocol.PREFIX_PEER_HEADER
+    assert tracing.TRACE_HEADER is protocol.TRACE_HEADER
+
+
+# ---------------------------------------------------------------------
+# route round-trips against the real dispatch tables
+# ---------------------------------------------------------------------
+
+def test_contract_matches_replica_server_route_tables():
+    # The replica server declares its surface as module constants; the
+    # contract's replica view must be exactly that surface (a route
+    # added to one side only is how cross-process drift starts).
+    from skypilot_tpu.infer import server
+    declared = protocol.routes_for('replica')
+    assert set(declared['GET']) == set(server._GET_ROUTES)
+    assert set(declared['POST']) == set(server._POST_ROUTES)
+
+
+def test_contract_matches_router_proxy_tables():
+    from skypilot_tpu.serve import router
+    declared = protocol.routes_for('router')
+    assert set(declared['POST']) == set(router._PROXY_ROUTES)
+    assert set(declared['GET']) == set(router._GET_ROUTES)
+
+
+def test_contract_matches_extracted_dispatch_surface():
+    # Whole-program closure: run skylint's own extraction over the
+    # real tree and require every dispatched (method, path) to be a
+    # contract route and vice versa per server module.
+    from skypilot_tpu.devtools import analysis, protocol_analysis, \
+        skylint
+    paths = [str(REPO / 'skypilot_tpu' / 'infer' / 'server.py'),
+             str(REPO / 'skypilot_tpu' / 'serve' / 'router.py'),
+             str(REPO / 'skypilot_tpu' / 'serve' / 'dashboard.py'),
+             str(REPO / 'skypilot_tpu' / 'serve' / 'controller.py')]
+    ctxs = [skylint.FileContext(p, Path(p).read_text()) for p in paths]
+    surface = protocol_analysis.surface_of(analysis.Project(ctxs))
+    extracted = {(r.method, r.path) for r in surface.server_routes()}
+    assert extracted, 'extraction found no routes — extractor broke'
+    missing = extracted - set(protocol.ROUTE_CONTRACT)
+    assert not missing, f'dispatched but not in contract: {missing}'
+    # Contract routes that no in-tree dispatch serves must not claim
+    # an in-tree server.
+    servers_seen = {'replica', 'router', 'dashboard', 'controller'}
+    for key, spec in protocol.ROUTE_CONTRACT.items():
+        if set(spec.servers) & servers_seen:
+            assert key in extracted, \
+                f'{key} in contract but no dispatch serves it'
+
+
+def _get(base, path, timeout=10):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers)
+
+
+def _post(base, path, data=b'{}', timeout=10):
+    req = urllib.request.Request(base + path, data=data,
+                                 method='POST')
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers)
+
+
+def test_live_dashboard_serves_contract_routes():
+    from skypilot_tpu.serve import dashboard
+    server, _thread = dashboard.start(port=0)
+    base = f'http://127.0.0.1:{server.server_address[1]}'
+    try:
+        for path in protocol.routes_for('dashboard')['GET']:
+            spec = protocol.ROUTE_CONTRACT[('GET', path)]
+            code, _ = _get(base, path)
+            assert code in spec.statuses, (path, code)
+        code, _ = _get(base, '/definitely/not/a/route')
+        assert code == 404
+        # Wrong-method guard: POST to a GET page answers an explicit
+        # 405 naming the allowed method, not the stdlib's bare 501.
+        code, headers = _post(base, '/healthz')
+        assert code == 405
+        assert headers.get('Allow') == 'GET'
+        code, _ = _post(base, '/definitely/not/a/route')
+        assert code == 404
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_live_router_serves_contract_routes():
+    from skypilot_tpu.observability import metrics as metrics_lib
+    from skypilot_tpu.serve.router import Router
+    router = Router(replicas=[], registry=metrics_lib.Registry())
+    router.start()
+    base = router.url
+    try:
+        for path in protocol.routes_for('router')['GET']:
+            if path == '/v1/models':
+                continue    # proxied: needs a live replica
+            spec = protocol.ROUTE_CONTRACT[('GET', path)]
+            code, _ = _get(base, path)
+            assert code in spec.statuses, (path, code)
+        code, _ = _get(base, '/definitely/not/a/route')
+        assert code == 404
+        # Wrong-method guards, both directions.
+        code, headers = _post(base, '/health')
+        assert code == 405
+        assert headers.get('Allow') == 'GET'
+        code, headers = _get(base, '/generate')
+        assert code == 405
+        assert headers.get('Allow') == 'POST'
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------
+# env contract vs docs
+# ---------------------------------------------------------------------
+
+def test_env_table_rows_cover_contract():
+    rows = protocol.env_table_rows()
+    assert len(rows) == len(protocol.ENV_CONTRACT)
+    names = [r[0] for r in rows]
+    assert names == sorted(names), 'docs table must be sorted'
+
+
+def test_architecture_docs_env_table_is_generated_from_contract():
+    # The docs table is generated from env_table_rows(); every
+    # contract var must appear, and no SKYTPU_* row may exist in the
+    # docs without a contract entry backing it.
+    doc = (REPO / 'docs' / 'architecture.md').read_text()
+    for name, _default, _parser, _doc in protocol.env_table_rows():
+        assert f'`{name}`' in doc, \
+            f'{name} missing from docs/architecture.md env table'
+
+
+# ---------------------------------------------------------------------
+# regression: the true positives this contract surfaced
+# ---------------------------------------------------------------------
+
+def _relay_server():
+    """A detached InferenceServer-shaped receiver for exercising
+    _relay_handoff without an engine."""
+    from skypilot_tpu.infer import server as server_mod
+
+    class _Stub:
+        _decode_peers = ['http://peer-a:1', 'http://peer-b:1']
+        _migrate_targets = []
+        stream_token_timeout = 5.0
+        _relay_handoff = server_mod.InferenceServer._relay_handoff
+
+    return _Stub()
+
+
+def test_relay_handoff_fail_closed_statuses_are_terminal(monkeypatch):
+    # 409 (wire-version conflict) must raise immediately — retrying a
+    # terminal status on the next peer can never succeed and may
+    # duplicate output.  Before the HTTPError arm existed, the generic
+    # URLError arm (HTTPError's base class!) swallowed it and moved on.
+    srv = _relay_server()
+    calls = []
+
+    def _fake_urlopen(req, timeout=None):
+        calls.append(req)
+        raise urllib.error.HTTPError(req.full_url, 409, 'conflict',
+                                     {}, io.BytesIO(b''))
+
+    monkeypatch.setattr(urllib.request, 'urlopen', _fake_urlopen)
+    with pytest.raises(RuntimeError, match='fail-closed'):
+        list(srv._relay_handoff(b'blob', 'rid-1', None))
+    assert len(calls) == 1, '409 must not be retried on the next peer'
+
+
+def test_relay_handoff_retryable_status_moves_to_next_peer(
+        monkeypatch):
+    srv = _relay_server()
+    calls = []
+
+    def _fake_urlopen(req, timeout=None):
+        calls.append(req)
+        raise urllib.error.HTTPError(req.full_url, 503, 'shed', {},
+                                     io.BytesIO(b''))
+
+    monkeypatch.setattr(urllib.request, 'urlopen', _fake_urlopen)
+    with pytest.raises(RuntimeError, match='no decode replica'):
+        list(srv._relay_handoff(b'blob', 'rid-1', None))
+    assert len(calls) == 2, '503 is backpressure: try every peer'
+
+
+def test_relay_handoff_stamps_deadline_header(monkeypatch):
+    # The decode replica runs its own admission check; without the
+    # propagated deadline it falls back to its default and a
+    # tight-SLO request loses its budget mid-relay.
+    srv = _relay_server()
+    seen = {}
+
+    def _fake_urlopen(req, timeout=None):
+        seen['deadline'] = req.get_header(
+            protocol.DEADLINE_HEADER.capitalize())
+        lines = [json.dumps({'token': 7}), json.dumps({'done': True})]
+        resp = io.BytesIO(('\n'.join(lines) + '\n').encode())
+        resp.close = lambda: None
+        return resp
+
+    monkeypatch.setattr(urllib.request, 'urlopen', _fake_urlopen)
+    toks = list(srv._relay_handoff(b'blob', 'rid-1', None,
+                                   deadline_s=12.5))
+    assert toks == [7]
+    assert seen['deadline'] == '12.5'

@@ -692,7 +692,9 @@ def test_all_rule_families_are_registered():
             'net-timeout', 'trace-discipline',
             'pipeline-discipline', 'kernel-discipline',
             'mesh-axis-discipline', 'lock-order-discipline',
-            'donation-discipline', 'key-reuse'} <= ids
+            'donation-discipline', 'key-reuse',
+            'route-discipline', 'header-discipline',
+            'status-discipline', 'env-discipline'} <= ids
 
 
 # =====================================================================
@@ -1357,3 +1359,428 @@ def test_changed_only_filters_findings_but_keeps_index(tmp_path):
         assert not _live(findings)
     finally:
         os.chdir(cwd)
+
+
+# =====================================================================
+# skylint 3.0: cross-process protocol analysis
+# =====================================================================
+
+# Canonical guarded wire server: serves GET /health and POST /generate
+# (both in ROUTE_CONTRACT) and answers wrong-method hits with
+# 405+Allow, so route-discipline fixtures can isolate one defect at a
+# time.
+_WIRE_SERVER = """
+    _POST_ROUTES = ('/generate',)
+
+    class Handler:
+        def _reply(self, code, body, allow=None):
+            self.send_response(code)
+
+        def do_GET(self):
+            route = self.path
+            if route == '/health':
+                up = self.up
+                code = 200 if up else 503
+                self._reply(code, {})
+            elif route in _POST_ROUTES:
+                self._reply(405, {}, allow='POST')
+            else:
+                self._reply(404, {})
+
+        def do_POST(self):
+            route = self.path
+            if route not in _POST_ROUTES:
+                self._reply(405, {}, allow='GET')
+                return
+            self._reply(200, {})
+"""
+
+_WIRE_CLIENT = """
+    import urllib.request
+
+    def fire(base, body):
+        req = urllib.request.Request(base + '{path}', data=body,
+                                     method='POST')
+        return urllib.request.urlopen(req, timeout=5)
+"""
+
+
+# ---------------------------------------------------------------------
+# route-discipline
+# ---------------------------------------------------------------------
+
+def test_route_discipline_contract_pair_is_clean(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'serve/rt.py': _WIRE_SERVER,
+        'benchmark/cli.py': _WIRE_CLIENT.format(path='/generate'),
+    }, rule='route-discipline'))
+    assert not findings, [f.render() for f in findings]
+
+
+def test_route_discipline_mutation_renamed_client_path(tmp_path):
+    # THE cross-file case the old per-file pass cannot see: rename the
+    # client's spelling of a contract route and exactly one finding
+    # appears, whose call chain crosses into the server file that
+    # still serves the old spelling.
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'serve/rt.py': _WIRE_SERVER,
+        'benchmark/cli.py': _WIRE_CLIENT.format(path='/generat'),
+    }, rule='route-discipline'))
+    assert len(findings) == 1, [f.render() for f in findings]
+    f = findings[0]
+    assert f.symbol == 'POST /generat'
+    assert f.path.endswith('cli.py')
+    assert any('rt.py' in hop and '/generate' in hop
+               for hop in f.call_chain), f.call_chain
+    assert f.fingerprint
+
+
+def test_route_discipline_flags_server_route_not_in_contract(
+        tmp_path):
+    src = _WIRE_SERVER.replace(
+        "if route == '/health':",
+        "if route == '/bogus_route':\n"
+        "                self._reply(200, {})\n"
+        "            elif route == '/health':")
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'serve/rt.py': src,
+    }, rule='route-discipline'))
+    assert {f.symbol for f in findings} == {'GET /bogus_route'}
+    assert 'ROUTE_CONTRACT' in findings[0].message
+
+
+def test_route_discipline_flags_missing_405_guard(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'serve/rt.py': """
+            class Handler:
+                def _reply(self, code, body):
+                    self.send_response(code)
+
+                def do_GET(self):
+                    route = self.path
+                    if route == '/health':
+                        self._reply(200, {})
+                    else:
+                        self._reply(404, {})
+        """,
+    }, rule='route-discipline'))
+    assert {f.symbol for f in findings} == {'POST-405-guard'}
+    assert 'Allow' in findings[0].message
+
+
+def test_route_discipline_dynamic_paths_and_scope_are_clean(
+        tmp_path):
+    # A fully dynamic client (path and method from variables) matches
+    # whatever the caller passes; devtools code is out of scope.
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'serve/dyn.py': """
+            import urllib.request
+
+            def forward(url, body, method):
+                req = urllib.request.Request(url, data=body,
+                                             method=method)
+                return urllib.request.urlopen(req, timeout=5)
+        """,
+        'devtools/fetch.py': """
+            import urllib.request
+
+            def grab(base):
+                return urllib.request.urlopen(base + '/not_a_route',
+                                              timeout=5)
+        """,
+    }, rule='route-discipline'))
+    assert not findings, [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------
+# header-discipline
+# ---------------------------------------------------------------------
+
+def test_header_discipline_paired_contract_header_is_clean(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'serve/a.py': """
+            TRACE_HEADER = 'X-Skytpu-Trace'
+
+            class H:
+                def stamp(self):
+                    self.send_header(TRACE_HEADER, 'tid')
+        """,
+        'serve/b.py': """
+            class R:
+                def read(self):
+                    return self.headers.get('X-Skytpu-Trace')
+        """,
+    }, rule='header-discipline'))
+    assert not findings, [f.render() for f in findings]
+
+
+def test_header_discipline_mutation_renamed_reader_side(tmp_path):
+    # Rename the reading side's literal: the read becomes an unknown
+    # fleet-namespace header AND the stamp in the OTHER file becomes
+    # stamped-but-never-read — both sides of the drift are named.
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'serve/a.py': """
+            TRACE_HEADER = 'X-Skytpu-Trace'
+
+            class H:
+                def stamp(self):
+                    self.send_header(TRACE_HEADER, 'tid')
+        """,
+        'serve/b.py': """
+            class R:
+                def read(self):
+                    return self.headers.get('X-Skytpu-Tracing')
+        """,
+    }, rule='header-discipline'))
+    assert {f.symbol for f in findings} == {'X-Skytpu-Tracing',
+                                            'X-Skytpu-Trace'}
+    by_symbol = {f.symbol: f for f in findings}
+    assert by_symbol['X-Skytpu-Tracing'].path.endswith('b.py')
+    stale = by_symbol['X-Skytpu-Trace']
+    assert stale.path.endswith('a.py')
+    assert 'never read' in stale.message
+    assert any('a.py' in hop for hop in stale.call_chain)
+
+
+def test_header_discipline_read_without_stamp_is_flagged(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'infer/srv.py': """
+            class H:
+                def read(self):
+                    return self.headers.get('X-Skytpu-Decode-Target')
+        """,
+    }, rule='header-discipline'))
+    assert len(findings) == 1
+    assert findings[0].symbol == 'X-Skytpu-Decode-Target'
+    assert 'never stamped' in findings[0].message
+
+
+def test_header_discipline_scope_and_non_fleet_names(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        # Non-fleet headers are never checked ...
+        'serve/a.py': """
+            class H:
+                def stamp(self):
+                    self.send_header('Content-Type', 'text/html')
+        """,
+        # ... and devtools code is outside the wire scope even for
+        # fleet-namespace names.
+        'devtools/x.py': """
+            class H:
+                def stamp(self):
+                    self.send_header('X-Skytpu-Whatever', '1')
+        """,
+    }, rule='header-discipline'))
+    assert not findings, [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------
+# status-discipline
+# ---------------------------------------------------------------------
+
+def test_status_discipline_branched_client_is_clean(tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'serve/rt.py': _WIRE_SERVER,
+        'benchmark/cli.py': """
+            import urllib.error
+            import urllib.request
+
+            def probe(base):
+                try:
+                    return urllib.request.urlopen(base + '/health',
+                                                  timeout=1)
+                except urllib.error.HTTPError as e:
+                    return e.code == 503
+        """,
+    }, rule='status-discipline'))
+    assert not findings, [f.render() for f in findings]
+
+
+def test_status_discipline_flags_unhandled_branch_status(tmp_path):
+    # /health's 503 is branch-required (it is the shed/drain signal);
+    # a client that folds it into a generic error path loses the
+    # distinction.  The chain names the server line that emits it.
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'serve/rt.py': _WIRE_SERVER,
+        'benchmark/cli.py': """
+            import urllib.error
+            import urllib.request
+
+            def probe(base):
+                try:
+                    return urllib.request.urlopen(base + '/health',
+                                                  timeout=1)
+                except urllib.error.HTTPError:
+                    return None
+        """,
+    }, rule='status-discipline'))
+    assert len(findings) == 1, [f.render() for f in findings]
+    f = findings[0]
+    assert f.symbol == 'GET /health 503'
+    assert f.path.endswith('cli.py')
+    assert any('rt.py' in hop and 'emits 503' in hop
+               for hop in f.call_chain), f.call_chain
+
+
+def test_status_discipline_flags_fail_closed_swallow(tmp_path):
+    # The _relay_handoff shape: Request built outside the try, urlopen
+    # inside an `except URLError: continue` peer loop.  HTTPError
+    # subclasses URLError, so a terminal 409 is silently retried.
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'infer/relay.py': """
+            import urllib.error
+            import urllib.request
+
+            def relay(targets, blob):
+                for t in targets:
+                    req = urllib.request.Request(
+                        t + '/handoff', data=blob, method='POST')
+                    try:
+                        return urllib.request.urlopen(req, timeout=5)
+                    except (urllib.error.URLError, OSError):
+                        continue
+        """,
+    }, rule='status-discipline'))
+    swallow = [f for f in findings if 'subclasses URLError'
+               in f.message]
+    assert {f.symbol for f in swallow} == {'POST /handoff 400',
+                                           'POST /handoff 409'}
+
+
+def test_status_discipline_flags_retry_classifier_admitting_409(
+        tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'infer/push.py': """
+            import urllib.error
+            import urllib.request
+
+            _RETRY_CODES = (409, 500)
+
+            def push(base, blob):
+                req = urllib.request.Request(
+                    base + '/handoff', data=blob, method='POST')
+                try:
+                    return urllib.request.urlopen(req, timeout=5)
+                except urllib.error.HTTPError as e:
+                    if e.code in (400, 503):
+                        raise
+                    if e.code in _RETRY_CODES:
+                        return push(base, blob)
+                    raise
+        """,
+    }, rule='status-discipline'))
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert findings[0].symbol == 'POST /handoff 409'
+    assert 'retry classifier' in findings[0].message
+
+
+def test_status_discipline_fail_closed_terminal_client_is_clean(
+        tmp_path):
+    findings = _live(_lint_tree(tmp_path, {
+        'utils/anchor.py': '',
+        'infer/push.py': """
+            import urllib.error
+            import urllib.request
+
+            _RETRY_CODES = (500, 502)
+
+            def push(base, blob):
+                req = urllib.request.Request(
+                    base + '/handoff', data=blob, method='POST')
+                try:
+                    return urllib.request.urlopen(req, timeout=5)
+                except urllib.error.HTTPError as e:
+                    if e.code in (400, 409, 503):
+                        raise
+                    if e.code in _RETRY_CODES:
+                        return push(base, blob)
+                    raise
+        """,
+    }, rule='status-discipline'))
+    assert not findings, [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------
+# env-discipline
+# ---------------------------------------------------------------------
+
+def test_env_discipline_flags_unregistered_var(tmp_path):
+    findings = _live(_lint(tmp_path, 'utils/cfg.py', """
+        import os
+
+        def n():
+            return os.environ.get('SKYTPU_NO_SUCH_VAR', '')
+    """, rule='env-discipline'))
+    assert len(findings) == 1
+    assert findings[0].symbol == 'SKYTPU_NO_SUCH_VAR'
+    assert 'ENV_CONTRACT' in findings[0].message
+
+
+def test_env_discipline_flags_divergent_inline_default(tmp_path):
+    # The repo's own historical drift: the int 1800 vs the contract's
+    # '1800' — same value today, silently divergent on the next edit.
+    findings = _live(_lint(tmp_path, 'provision/x.py', """
+        import os
+
+        def t():
+            return float(os.environ.get('SKYTPU_QUEUED_TIMEOUT',
+                                        1800))
+    """, rule='env-discipline'))
+    assert len(findings) == 1
+    assert findings[0].symbol == 'SKYTPU_QUEUED_TIMEOUT'
+    assert "'1800'" in findings[0].message
+
+
+def test_env_discipline_flags_missing_inline_default(tmp_path):
+    findings = _live(_lint(tmp_path, 'utils/cfg.py', """
+        import os
+
+        def t():
+            return os.getenv('SKYTPU_QUEUED_TIMEOUT')
+    """, rule='env-discipline'))
+    assert len(findings) == 1
+    assert 'no inline default' in findings[0].message
+
+
+def test_env_discipline_matching_and_exempt_reads_are_clean(
+        tmp_path):
+    findings = _live(_lint(tmp_path, 'utils/cfg.py', """
+        import os
+
+        def t():
+            # matches the contract default exactly
+            a = os.environ.get('SKYTPU_QUEUED_TIMEOUT', '1800')
+            # contract default None (unset-disables): no comparison
+            b = os.environ.get('SKYTPU_HANDOFF_COMPRESS')
+            # not a SKYTPU_* name: out of scope
+            c = os.environ.get('HOME', '/root')
+            # computed default expressions are not comparable
+            d = os.environ.get('SKYTPU_QUEUED_TIMEOUT', default())
+            return a, b, c, d
+
+        def default():
+            return '1800'
+    """, rule='env-discipline'))
+    assert not findings, [f.render() for f in findings]
+
+
+def test_net_timeout_applies_to_bench_entrypoint(tmp_path):
+    # Satellite of the protocol PR: bench.py drives the same wire
+    # surface; its blocking calls wedge the bench run the same way.
+    assert _live(_lint(tmp_path, 'bench.py', _NET_NO_TIMEOUT,
+                       rule='net-timeout'))
+    assert not _live(_lint(tmp_path, 'bench.py', _NET_WITH_TIMEOUT,
+                           rule='net-timeout'))

@@ -187,3 +187,240 @@ def test_location_reports_module_and_line(tmp_path):
     # Unknown symbols echo back rather than raise — rules interpolate
     # locations into messages unconditionally.
     assert proj.location('no.such.fn') == 'no.such.fn'
+
+
+# ---------------------------------------------------------------------
+# protocol_analysis: the skylint 3.0 wire-surface extraction
+# (PR: cross-process protocol analysis)
+# ---------------------------------------------------------------------
+
+import ast
+
+from skypilot_tpu.devtools import protocol_analysis
+
+
+def _surface(tmp_path, files):
+    return protocol_analysis.surface_of(_project(tmp_path, files))
+
+
+_DISPATCH_SRC = """
+    _POST_ROUTES = ('/generate', '/handoff')
+
+    class Handler:
+        def _reply(self, code, body, allow=None):
+            self.send_response(code)
+
+        def do_GET(self):
+            route = self.path
+            if route == '/health':
+                ok = self.up
+                code = 200 if ok else 503
+                self._reply(code, {})
+            elif route in _POST_ROUTES:
+                self._reply(405, {}, allow='POST')
+            else:
+                self._reply(404, {})
+
+        def do_POST(self):
+            route = self.path
+            if route not in _POST_ROUTES:
+                self._reply(405, {}, allow='GET')
+                return
+            self._reply(200, {})
+"""
+
+
+def test_dispatch_extraction_routes_statuses_and_guards(tmp_path):
+    surface = _surface(tmp_path, {'serve/rt.py': _DISPATCH_SRC})
+    by_method = {d.method: d for d in surface.dispatches}
+    assert set(by_method) == {'GET', 'POST'}
+
+    get = by_method['GET']
+    # eq-branch claims the route; the `elif route in _POST_ROUTES`
+    # branch is a guard shape and must NOT claim those routes for GET.
+    assert set(get.routes) == {'/health'}
+    health = get.routes['/health']
+    # `code = 200 if ok else 503` resolves through the local int
+    # assignment; the else-404 has no route context and is attributed
+    # to every route the dispatch serves.
+    assert {200, 503, 404} <= set(health.statuses)
+    assert get.guard_405_allow, \
+        "_reply(405, ..., allow='POST') is the wrong-method guard"
+
+    post = by_method['POST']
+    # notin-guard continuation serves every route in the tuple
+    # (module-level constant resolution).
+    assert set(post.routes) == {'/generate', '/handoff'}
+    assert 200 in post.routes['/generate'].statuses
+    assert post.guard_405_allow
+
+
+def test_dispatch_guard_detected_through_helper_callee(tmp_path):
+    # The controller idiom: the 405+Allow lives in a helper method the
+    # dispatch calls, not inline — callee-following must find it.
+    surface = _surface(tmp_path, {'serve/ctl.py': """
+        class Handler:
+            def do_GET(self):
+                if self.path == '/health':
+                    self.send_response(200)
+                else:
+                    self._send_405('POST')
+
+            def _send_405(self, allow):
+                self.send_response(405)
+                self.send_header('Allow', allow)
+    """})
+    (disp,) = surface.dispatches
+    assert disp.guard_405_allow
+
+
+def test_client_extraction_request_urlopen_and_connection(tmp_path):
+    surface = _surface(tmp_path, {'benchmark/cli.py': """
+        import http.client
+        import urllib.request
+        from urllib.request import urlopen
+
+        def a(base, blob):
+            req = urllib.request.Request(base + '/handoff',
+                                         data=blob, method='POST')
+            return urllib.request.urlopen(req, timeout=5)
+
+        def b(base):
+            return urlopen(base + '/health', timeout=1)
+
+        def c(base, blob):
+            return urlopen(base + '/generate', data=blob, timeout=1)
+
+        def d(host, path):
+            conn = http.client.HTTPConnection(host, timeout=3)
+            conn.request('GET', path)
+            return conn.getresponse()
+    """})
+    sites = {(c.method, c.path) for c in surface.client_calls}
+    # urlopen(req) of the prebuilt Request is NOT double-counted: one
+    # site per wire call.
+    assert sites == {('POST', '/handoff'),   # Request(method=)
+                     ('GET', '/health'),     # urlopen, no data
+                     ('POST', '/generate'),  # urlopen, data= kwarg
+                     ('GET', None)}          # conn.request, dyn path
+    assert len(surface.client_calls) == 4
+
+
+def test_client_swallow_links_through_urlopen_of_name(tmp_path):
+    # The _relay_handoff shape: Request built OUTSIDE the try, only
+    # urlopen(req) inside `except URLError: continue`.  The swallow
+    # must attach to the Request site through the variable.
+    surface = _surface(tmp_path, {'infer/relay.py': """
+        import urllib.error
+        import urllib.request
+
+        def bad(targets, blob):
+            for t in targets:
+                req = urllib.request.Request(
+                    t + '/handoff', data=blob, method='POST')
+                try:
+                    return urllib.request.urlopen(req, timeout=5)
+                except (urllib.error.URLError, OSError):
+                    continue
+
+        def ok(targets, blob):
+            for t in targets:
+                req = urllib.request.Request(
+                    t + '/handoff', data=blob, method='POST')
+                try:
+                    return urllib.request.urlopen(req, timeout=5)
+                except urllib.error.HTTPError:
+                    raise
+                except urllib.error.URLError:
+                    continue
+    """})
+    by_fn = {c.qname.rsplit('.', 1)[-1]: c
+             for c in surface.client_calls}
+    assert by_fn['bad'].swallows_fail_closed
+    # An explicit HTTPError arm before the URLError arm means terminal
+    # statuses are NOT blindly retried: no swallow.
+    assert not by_fn['ok'].swallows_fail_closed
+
+
+def test_header_extraction_resolves_cross_module_constant(tmp_path):
+    surface = _surface(tmp_path, {
+        'pkg/__init__.py': '',
+        'pkg/proto.py': "TRACE_HEADER = 'X-Skytpu-Trace'\n",
+        'pkg/srv.py': """
+            from pkg.proto import TRACE_HEADER
+
+            class H:
+                def stamp(self):
+                    self.send_header(TRACE_HEADER, 'tid')
+
+                def read(self):
+                    a = self.headers.get('X-Skytpu-Trace')
+                    b = self.headers['X-Skytpu-Deadline-S']
+                    return a, b
+        """,
+    })
+    sites = {(s.name, s.kind) for s in surface.header_sites}
+    assert ('X-Skytpu-Trace', 'stamp') in sites, \
+        'imported constant must resolve to its literal'
+    assert ('X-Skytpu-Trace', 'read') in sites
+    assert ('X-Skytpu-Deadline-S', 'read') in sites
+
+
+def test_env_extraction_defaults_and_missing(tmp_path):
+    surface = _surface(tmp_path, {'utils/cfg.py': """
+        import os
+
+        def f():
+            a = os.environ.get('SKYTPU_A', '1')
+            b = os.getenv('SKYTPU_B')
+            c = 'SKYTPU_C' in os.environ
+            return a, b, c
+    """})
+    by_name = {r.name: r for r in surface.env_reads}
+    assert set(by_name) == {'SKYTPU_A', 'SKYTPU_B', 'SKYTPU_C'}
+    a = by_name['SKYTPU_A'].default
+    assert isinstance(a, ast.Constant) and a.value == '1'
+    assert by_name['SKYTPU_B'].default \
+        is protocol_analysis._MISSING
+    assert by_name['SKYTPU_C'].default \
+        is protocol_analysis._MISSING
+
+
+def test_status_tests_retry_tuples_and_caller_hop(tmp_path):
+    surface = _surface(tmp_path, {'serve/cli.py': """
+        import urllib.error
+        import urllib.request
+
+        _RETRY_CODES = (409, 500)
+
+        def outer(base):
+            try:
+                return inner(base)
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return None
+                if e.code in _RETRY_CODES:
+                    return outer(base)
+                raise
+
+        def inner(base):
+            return urllib.request.urlopen(base + '/health',
+                                          timeout=1)
+    """})
+    (outer_q,) = [q for q in surface.fn_status_tests
+                  if q.endswith('outer')]
+    assert surface.fn_status_tests[outer_q] == {404, 409, 500}
+    # Only membership in a *-RETRY*-named tuple classifies as retry;
+    # the eq-404 branch does not.
+    assert surface.fn_retry_codes[outer_q] == {409, 500}
+    (inner_q,) = [c.qname for c in surface.client_calls]
+    # The client site's handling is checked NEAR the call: codes
+    # branched on one caller hop up count as handled/retried there.
+    assert {404, 409, 500} <= surface.handled_near(inner_q)
+    assert 409 in surface.retried_near(inner_q)
+
+
+def test_surface_is_cached_on_the_project(tmp_path):
+    proj = _project(tmp_path, {'serve/rt.py': _DISPATCH_SRC})
+    assert protocol_analysis.surface_of(proj) \
+        is protocol_analysis.surface_of(proj)
